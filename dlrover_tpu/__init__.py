@@ -1,0 +1,38 @@
+"""dlrover_tpu — a TPU-native elastic/fault-tolerant distributed training framework.
+
+A ground-up JAX/XLA/pjit/Pallas re-design of the capabilities of DLRover
+(reference: intelligent-machine-learning/dlrover): a per-job master that forms
+and re-forms TPU worker groups (rendezvous, health checks, straggler detection,
+auto-scaling), a per-host elastic agent supervising training processes, Flash
+Checkpoint (async shared-memory pytree save/restore), dynamic data sharding,
+and an ``accelerate()`` layer expressing DP/FSDP/TP/SP/EP/PP strategies as
+GSPMD shardings over an ICI/DCN device mesh with Pallas kernels.
+
+Top-level convenience re-exports keep the public API surface shallow::
+
+    import dlrover_tpu as dt
+    strategy = dt.accelerate(model_def, mesh_spec="auto")
+    ckpt = dt.FlashCheckpointer(dirpath)
+"""
+
+__version__ = "0.1.0"
+
+# Lazy re-exports: importing the package must stay cheap (no jax import at
+# top level — agents/masters run on hosts that may not have devices).
+_LAZY = {
+    "accelerate": "dlrover_tpu.parallel.accelerate",
+    "MeshSpec": "dlrover_tpu.parallel.mesh",
+    "FlashCheckpointer": "dlrover_tpu.checkpoint.checkpointer",
+    "CheckpointEngine": "dlrover_tpu.checkpoint.engine",
+    "ElasticTrainer": "dlrover_tpu.trainer.elastic_trainer",
+    "ElasticSampler": "dlrover_tpu.trainer.sampler",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name])
+        return getattr(mod, name)
+    raise AttributeError(f"module 'dlrover_tpu' has no attribute {name!r}")

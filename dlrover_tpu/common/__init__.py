@@ -1,0 +1,9 @@
+"""L0 common substrate: constants, logging, node model, typed RPC messages,
+gRPC channel helpers, shared-memory IPC, storage abstraction, global context.
+
+Everything above (master, agent, trainer) sits on this layer; it depends on
+nothing internal.  Capability parity with the reference's
+``dlrover/python/common/`` (see SURVEY.md §1 L0) but with typed msgpack
+messages instead of pickled dataclasses over gRPC (reference wart:
+``common/grpc.py:161-512``).
+"""

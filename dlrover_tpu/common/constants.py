@@ -1,0 +1,182 @@
+"""Framework-wide constants.
+
+Capability parity with the reference's ``dlrover/python/common/constants.py``,
+re-cast for TPU: node types are TPU-slice roles (no parameter servers on the
+GPU-style data plane — the sparse/PS analogue lives in ``dlrover_tpu.embedding``),
+and the communication plane is XLA collectives over ICI/DCN instead of NCCL.
+"""
+
+from __future__ import annotations
+
+
+class NodeType:
+    """Roles a node (one TPU-VM host / one process in local mode) can take.
+
+    Reference: ``dlrover/python/common/constants.py`` NodeType (master/worker/
+    ps/chief/evaluator).  TPU build keeps master/worker; `chief` maps to the
+    worker that hosts the JAX coordinator; PS/evaluator become embedding-store
+    and eval roles.
+    """
+
+    MASTER = "master"
+    WORKER = "worker"
+    CHIEF = "chief"
+    EVALUATOR = "evaluator"
+    # Host-side sparse embedding store servers (TFPlus KvVariable analogue).
+    EMBEDDING = "embedding"
+
+
+class NodeStatus:
+    """Node lifecycle states and the terminal set.
+
+    Mirrors reference ``NodeStatus`` + status flow
+    (``master/node/status_flow.py:136``).
+    """
+
+    INITIAL = "initial"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    DELETED = "deleted"
+    FINISHED = "finished"
+    BREAKDOWN = "breakdown"  # health-check verdict: faulty hardware
+    UNKNOWN = "unknown"
+
+    TERMINAL = frozenset({SUCCEEDED, FAILED, DELETED, FINISHED, BREAKDOWN})
+
+
+class NodeEventType:
+    ADDED = "added"
+    MODIFIED = "modified"
+    DELETED = "deleted"
+
+
+class NodeExitReason:
+    """Why a node exited; drives the relaunch decision
+    (reference ``common/constants.py NodeExitReason``)."""
+
+    KILLED = "killed"
+    OOM = "oom"
+    FATAL_ERROR = "fatal_error"
+    HARDWARE_ERROR = "hardware_error"  # TPU chip / ICI failure
+    PREEMPTED = "preempted"  # spot/preemptible TPU reclaim
+    RELAUNCHED = "relaunched"
+    UNKNOWN_ERROR = "unknown_error"
+    SUCCEEDED = "succeeded"
+
+
+class JobStage:
+    """Coarse job lifecycle used by the master run-loop
+    (reference ``dist_master.py:226``)."""
+
+    INIT = "init"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUSPENDED = "suspended"
+    STOPPING = "stopping"
+    STOPPED = "stopped"
+
+
+class JobExitReason:
+    SUCCEEDED = "succeeded"
+    CODE_ERROR = "code_error"
+    NODE_OOM = "node_oom"
+    NODE_ERROR = "node_error"
+    HANG_ERROR = "hang_error"
+    RDZV_TIMEOUT = "rdzv_timeout"
+    PENDING_TIMEOUT = "pending_timeout"
+    UNKNOWN = "unknown"
+
+
+class RendezvousName:
+    """The two master-side rendezvous services (reference
+    ``master/elastic_training/rdzv_manager.py``)."""
+
+    TRAINING = "elastic-training"
+    NETWORK_CHECK = "network-check"
+
+
+class PlatformType:
+    """Where nodes run.  LOCAL = subprocesses on this host (test/dev,
+    reference ``PlatformType.LOCAL``); PROCESS = multi-process one-host
+    elastic cluster; GKE = TPU node pools via Kubernetes (reference K8S);
+    RAY kept as an API-compatible stub."""
+
+    LOCAL = "local"
+    PROCESS = "process"
+    GKE = "gke"
+    RAY = "ray"
+
+
+class TrainingExceptionLevel:
+    INFO = "info"
+    WARNING = "warning"
+    ERROR = "error"
+    NODE_ERROR = "node_error"
+    RDZV_ERROR = "rdzv_error"
+    PROCESS_ERROR = "process_error"
+
+
+class DiagnosisActionType:
+    """What the agent should do after a failure/heartbeat diagnosis
+    (reference ``diagnosis/common/constants.py`` + ``training.py:934``)."""
+
+    NONE = "no_action"
+    RESTART_WORKER = "restart_worker"  # in-place process restart, keep node
+    RELAUNCH_WORKER = "relaunch_worker"  # replace the node (pod/VM relaunch)
+    STOP_JOB = "stop_job"
+    EVENT = "event"
+
+
+class CheckpointConstant:
+    """Flash-checkpoint file naming (reference ``ckpt_saver.py`` commit
+    protocol: done files + tracker file)."""
+
+    TRACKER_FILE = "latest_checkpointed_step.txt"
+    DONE_FILE = ".done"
+    META_FILE = "checkpoint.meta"
+    SHARD_FILE_TMPL = "shard_{}.ckpt"
+    TMP_DIR_PREFIX = "._tmp_"
+
+
+class NodeEnv:
+    """Environment variables the agent/worker contract is built on
+    (reference ``common/constants.py NodeEnv``)."""
+
+    JOB_NAME = "DLROVER_TPU_JOB_NAME"
+    MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
+    NODE_ID = "DLROVER_TPU_NODE_ID"
+    NODE_RANK = "DLROVER_TPU_NODE_RANK"
+    NODE_NUM = "DLROVER_TPU_NODE_NUM"
+    # JAX distributed bootstrap (set by the agent for each worker process).
+    COORDINATOR_ADDR = "DLROVER_TPU_COORDINATOR"
+    PROCESS_ID = "DLROVER_TPU_PROCESS_ID"
+    NUM_PROCESSES = "DLROVER_TPU_NUM_PROCESSES"
+    RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
+    DEVICES_PER_PROC = "DLROVER_TPU_DEVICES_PER_PROC"
+    # Monitoring
+    MONITOR_INTERVAL = "DLROVER_TPU_MONITOR_INTERVAL"
+
+
+class GRPC:
+    # 256 MB: control plane carries shard metadata / straggler reports, never
+    # tensors; generous cap (reference uses unlimited pickled payloads).
+    MAX_MESSAGE_LENGTH = 256 * 1024 * 1024
+
+
+class TrainingLoopStatus:
+    START = 1
+    END = 2
+    PENDING = 3
+
+
+# Default timing knobs (overridable via Context, see global_context.py).
+class Defaults:
+    HEARTBEAT_INTERVAL = 15  # seconds, agent -> master
+    HEARTBEAT_TIMEOUT = 300  # master declares node dead
+    RDZV_TIMEOUT = 600
+    PENDING_TIMEOUT = 900
+    MONITOR_INTERVAL = 5
+    SCALE_INTERVAL = 30
+    SECONDS_TO_WAIT_FAILED_PS = 600

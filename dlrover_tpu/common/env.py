@@ -1,0 +1,77 @@
+"""Environment helpers (reference ``dlrover/python/common/env_utils.py``)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+
+
+def get_env_int(name: str, default: int = 0) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def get_env_str(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+def get_node_id() -> int:
+    return get_env_int(NodeEnv.NODE_ID, 0)
+
+
+def get_node_rank() -> int:
+    return get_env_int(NodeEnv.NODE_RANK, get_node_id())
+
+def get_node_num() -> int:
+    return get_env_int(NodeEnv.NODE_NUM, 1)
+
+
+def get_master_addr() -> str:
+    return get_env_str(NodeEnv.MASTER_ADDR)
+
+
+def get_job_name() -> str:
+    return get_env_str(NodeEnv.JOB_NAME, "local-job")
+
+
+def get_process_id() -> int:
+    return get_env_int(NodeEnv.PROCESS_ID, 0)
+
+
+def get_num_processes() -> int:
+    return get_env_int(NodeEnv.NUM_PROCESSES, 1)
+
+
+def get_coordinator() -> Optional[str]:
+    v = get_env_str(NodeEnv.COORDINATOR_ADDR)
+    return v or None
+
+
+def worker_env(
+    *,
+    job_name: str,
+    master_addr: str,
+    node_id: int,
+    node_rank: int,
+    node_num: int,
+    process_id: int,
+    num_processes: int,
+    coordinator: str,
+    restart_count: int = 0,
+) -> dict:
+    """The env contract the agent passes to each spawned worker process."""
+    return {
+        NodeEnv.JOB_NAME: job_name,
+        NodeEnv.MASTER_ADDR: master_addr,
+        NodeEnv.NODE_ID: str(node_id),
+        NodeEnv.NODE_RANK: str(node_rank),
+        NodeEnv.NODE_NUM: str(node_num),
+        NodeEnv.PROCESS_ID: str(process_id),
+        NodeEnv.NUM_PROCESSES: str(num_processes),
+        NodeEnv.COORDINATOR_ADDR: coordinator,
+        NodeEnv.RESTART_COUNT: str(restart_count),
+    }

@@ -1,0 +1,75 @@
+"""Global tunables singleton (reference ``dlrover/python/common/global_context.py:59``).
+
+One process-wide ``Context`` with every timing/size knob, overridable from
+environment variables (``DLROVER_TPU_<UPPER_NAME>``).  The master pushes a
+subset to agents via ``ElasticRunConfig`` so a job-level override reaches every
+node without per-node env plumbing.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+from dlrover_tpu.common.constants import Defaults
+
+
+class Context:
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self.master_port: int = 0
+        self.node_heartbeat_interval: float = Defaults.HEARTBEAT_INTERVAL
+        self.node_heartbeat_timeout: float = Defaults.HEARTBEAT_TIMEOUT
+        self.rdzv_timeout: float = Defaults.RDZV_TIMEOUT
+        self.pending_timeout: float = Defaults.PENDING_TIMEOUT
+        self.monitor_interval: float = Defaults.MONITOR_INTERVAL
+        self.scale_interval: float = Defaults.SCALE_INTERVAL
+        self.relaunch_always: bool = False
+        self.relaunch_on_worker_failure: int = 3
+        self.network_check: bool = False
+        self.straggler_threshold: float = 1.6  # x median elapsed => straggler
+        self.hang_timeout_s: float = 1800.0
+        self.train_speed_record_num: int = 50
+        self.seconds_to_autoscale_worker: float = 1800.0
+        self.ckpt_shard_io_workers: int = 4
+        self.auto_tune: bool = False
+        self._apply_env_overrides()
+
+    def _apply_env_overrides(self) -> None:
+        for name, cur in list(vars(self).items()):
+            if name.startswith("_"):
+                continue
+            env = os.environ.get(f"DLROVER_TPU_{name.upper()}")
+            if env is None:
+                continue
+            if isinstance(cur, bool):
+                setattr(self, name, env.lower() in ("1", "true", "yes"))
+            elif isinstance(cur, int):
+                setattr(self, name, int(env))
+            elif isinstance(cur, float):
+                setattr(self, name, float(env))
+            else:
+                setattr(self, name, env)
+
+    def update(self, **kwargs: Any) -> None:
+        for k, v in kwargs.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in vars(self).items() if not k.startswith("_")}
+
+    @classmethod
+    def singleton_instance(cls) -> "Context":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+
+def get_context() -> Context:
+    return Context.singleton_instance()

@@ -1,0 +1,468 @@
+"""Typed control-plane messages (agent <-> master).
+
+Capability parity with the ~60 message dataclasses of the reference
+(``dlrover/python/common/grpc.py:161-512``), but serialized as **msgpack of a
+typed registry** rather than pickle-over-gRPC (a reference wart — pickle is
+version-fragile and unsafe across trust boundaries).  Only control-plane data
+travels here: shard indices, rendezvous worlds, heartbeats, metrics.  Tensors
+never do — they ride the shm arena (``dlrover_tpu.common.shm``) or ICI.
+
+Every message is a dataclass registered by class name via
+``__init_subclass__``; nested messages / lists / dicts of messages round-trip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import msgpack
+
+_REGISTRY: Dict[str, type] = {}
+
+
+class Message:
+    """Base for all wire messages.  Subclasses must be dataclasses."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        _REGISTRY[cls.__name__] = cls
+
+
+def _encode(obj: Any) -> Any:
+    if isinstance(obj, Message):
+        return {
+            "__msg__": type(obj).__name__,
+            "f": {
+                f.name: _encode(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)  # type: ignore[arg-type]
+            },
+        }
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_encode(v) for v in obj]
+    return obj
+
+
+def _decode(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if "__msg__" in obj:
+            cls = _REGISTRY[obj["__msg__"]]
+            fields = {k: _decode(v) for k, v in obj["f"].items()}
+            return cls(**fields)
+        return {k: _decode(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode(v) for v in obj]
+    return obj
+
+
+def serialize(msg: Message) -> bytes:
+    return msgpack.packb(_encode(msg), use_bin_type=True)
+
+
+def deserialize(data: bytes) -> Message:
+    return _decode(msgpack.unpackb(data, raw=False, strict_map_key=False))
+
+
+# ---------------------------------------------------------------------------
+# Generic envelope / responses
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BaseResponse(Message):
+    success: bool = True
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class Empty(Message):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Node identity & lifecycle
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class NodeMeta(Message):
+    """Agent self-registration (reference ``grpc.py NodeMeta``)."""
+
+    node_type: str = "worker"
+    node_id: int = 0
+    node_rank: int = -1
+    host: str = ""
+    agent_port: int = 0
+    slice_id: str = ""
+    host_id: str = ""
+    tpu_chips: int = 0
+    local_world_size: int = 1
+
+
+@dataclasses.dataclass
+class ReportNodeStatus(Message):
+    node_id: int = 0
+    node_type: str = "worker"
+    status: str = ""
+    exit_reason: str = ""
+    restart_count: int = 0
+
+
+@dataclasses.dataclass
+class NodeFailure(Message):
+    """Agent-reported worker failure (reference ``grpc.py NodeFailure`` /
+    ``report_failures master_client.py``)."""
+
+    node_id: int = 0
+    node_rank: int = -1
+    error_data: str = ""
+    level: str = "error"
+    restart_count: int = 0
+
+
+@dataclasses.dataclass
+class Heartbeat(Message):
+    node_id: int = 0
+    timestamp: float = 0.0
+
+
+@dataclasses.dataclass
+class DiagnosisAction(Message):
+    """Master's instruction piggybacked on the heartbeat reply (reference
+    ``HeartbeatResponse`` carrying ``DiagnosisAction`` s)."""
+
+    action_type: str = "no_action"
+    instance: str = ""
+    reason: str = ""
+    payload: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class HeartbeatResponse(Message):
+    actions: List[DiagnosisAction] = dataclasses.field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class JoinRendezvous(Message):
+    """(reference ``grpc.py JoinRendezvousRequest``)"""
+
+    node_id: int = 0
+    node_rank: int = -1
+    local_world_size: int = 1
+    rdzv_name: str = "elastic-training"
+    node_ip: str = ""
+    slice_id: str = ""
+
+
+@dataclasses.dataclass
+class RendezvousRound(Message):
+    round: int = 0
+
+
+@dataclasses.dataclass
+class CommWorldRequest(Message):
+    node_id: int = 0
+    rdzv_name: str = "elastic-training"
+
+
+@dataclasses.dataclass
+class CommWorld(Message):
+    """The agreed world of one rendezvous round: ``world`` maps node_rank ->
+    meta dict (id, local_world_size, host, slice).  ``group`` distinguishes
+    paired sub-worlds in the network-check rendezvous
+    (reference ``grpc.py CommWorldResponse`` / ``rdzv_manager.py:335``)."""
+
+    rdzv_name: str = "elastic-training"
+    round: int = 0
+    group: int = 0
+    world: dict = dataclasses.field(default_factory=dict)
+    coordinator: str = ""  # host:port of the elected JAX coordinator
+
+
+@dataclasses.dataclass
+class WaitingNodeNumRequest(Message):
+    rdzv_name: str = "elastic-training"
+
+
+@dataclasses.dataclass
+class WaitingNodeNum(Message):
+    waiting_num: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Master-hosted KV store (bootstrap plane, reference master_kv_store.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class KVStoreSet(Message):
+    key: str = ""
+    value: bytes = b""
+
+
+@dataclasses.dataclass
+class KVStoreGet(Message):
+    key: str = ""
+
+
+@dataclasses.dataclass
+class KVStoreValue(Message):
+    key: str = ""
+    value: bytes = b""
+    found: bool = False
+
+
+@dataclasses.dataclass
+class KVStoreMultiSet(Message):
+    kvs: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class KVStoreMultiGet(Message):
+    keys: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class KVStoreMultiValue(Message):
+    kvs: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class KVStoreAdd(Message):
+    key: str = ""
+    delta: int = 1
+
+
+@dataclasses.dataclass
+class KVStoreCount(Message):
+    value: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Dynamic data sharding (reference master/shard + grpc.py Task* messages)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DatasetShardParams(Message):
+    """Worker -> master: register a dataset for dynamic sharding
+    (reference ``grpc.py DatasetShardParams``)."""
+
+    dataset_name: str = ""
+    dataset_size: int = 0
+    shard_size: int = 0
+    batch_size: int = 0
+    num_epochs: int = 1
+    shuffle: bool = False
+    task_type: str = "training"
+    storage_type: str = "text"
+    num_minibatches_per_shard: int = 0
+
+
+@dataclasses.dataclass
+class TaskRequest(Message):
+    dataset_name: str = ""
+    worker_id: int = 0
+
+
+@dataclasses.dataclass
+class Task(Message):
+    """One unit of data to consume: an index range [start, end) of a shard
+    (reference ``grpc.py Task``).  ``task_id < 0`` means no task available."""
+
+    task_id: int = -1
+    task_type: str = "training"
+    dataset_name: str = ""
+    start: int = 0
+    end: int = 0
+    epoch: int = 0
+
+
+@dataclasses.dataclass
+class TaskResult(Message):
+    dataset_name: str = ""
+    task_id: int = -1
+    worker_id: int = 0
+    success: bool = True
+    err_message: str = ""
+
+
+@dataclasses.dataclass
+class ShardCheckpointRequest(Message):
+    dataset_name: str = ""
+
+
+@dataclasses.dataclass
+class ShardCheckpoint(Message):
+    """Serialized dataset progress for exactly-once resume
+    (reference ``base_dataset_manager.py:60 DatasetShardCheckpoint``)."""
+
+    dataset_name: str = ""
+    content: str = ""  # JSON
+
+
+# ---------------------------------------------------------------------------
+# Health check / straggler detection
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class NetworkCheckResult(Message):
+    """Per-node result of the paired matmul+psum pre-flight benchmark
+    (reference ``report_network_check_status`` + ``grpc.py NetworkStatus``)."""
+
+    node_id: int = 0
+    succeeded: bool = True
+    elapsed: float = 0.0
+    round: int = 0
+
+
+@dataclasses.dataclass
+class NetworkReadyRequest(Message):
+    pass
+
+
+@dataclasses.dataclass
+class FaultNodeRequest(Message):
+    pass
+
+
+@dataclasses.dataclass
+class FaultNodes(Message):
+    nodes: List[int] = dataclasses.field(default_factory=list)
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class StragglerRequest(Message):
+    pass
+
+
+@dataclasses.dataclass
+class Stragglers(Message):
+    nodes: List[int] = dataclasses.field(default_factory=list)
+    times: dict = dataclasses.field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Metrics / monitoring
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GlobalStep(Message):
+    """(reference ``grpc.py GlobalStepRecord`` -> SpeedMonitor)"""
+
+    node_id: int = 0
+    step: int = 0
+    timestamp: float = 0.0
+
+
+@dataclasses.dataclass
+class UsedResource(Message):
+    node_id: int = 0
+    cpu_percent: float = 0.0
+    memory_mb: float = 0.0
+    tpu_duty_cycle: float = 0.0
+    hbm_used_mb: float = 0.0
+
+
+@dataclasses.dataclass
+class ModelInfo(Message):
+    num_params: int = 0
+    flops_per_step: float = 0.0
+    batch_size_per_step: int = 0
+    extra: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class DiagnosisReport(Message):
+    """Agent -> master periodic diagnosis payload (reference
+    ``diagnosis/common/diagnosis_data.py``)."""
+
+    node_id: int = 0
+    data_type: str = ""
+    content: str = ""
+    timestamp: float = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Sync service (named barriers, reference sync_service.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SyncJoin(Message):
+    sync_name: str = ""
+    node_id: int = 0
+    node_rank: int = -1
+
+
+@dataclasses.dataclass
+class SyncFinish(Message):
+    sync_name: str = ""
+
+
+@dataclasses.dataclass
+class SyncQuery(Message):
+    sync_name: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint coordination
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CheckpointSync(Message):
+    """Cross-node shard-step consistency barrier before commit
+    (reference ``servicer._sync_checkpoint :609``)."""
+
+    node_id: int = 0
+    step: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Config push (reference get_elastic_run_config / ParallelConfig)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ElasticRunConfigRequest(Message):
+    pass
+
+
+@dataclasses.dataclass
+class ElasticRunConfig(Message):
+    configs: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ParallelConfigRequest(Message):
+    node_id: int = 0
+
+
+@dataclasses.dataclass
+class ParallelConfig(Message):
+    """Master-tuned runtime knobs hot-reloaded by the trainer (reference
+    ``grpc.py ParallelConfig/DataLoaderConfig/OptimizerConfig:439-483``)."""
+
+    dataloader: dict = dataclasses.field(default_factory=dict)
+    optimizer: dict = dataclasses.field(default_factory=dict)
+    mesh: dict = dataclasses.field(default_factory=dict)
+    restart: bool = False
+    version: int = 0
+
+
+@dataclasses.dataclass
+class JobExitRequest(Message):
+    node_id: int = 0
+    reason: str = ""
+    success: bool = True

@@ -1,0 +1,82 @@
+"""Loader for the C++ native runtime pieces (built from ``native/``).
+
+Auto-builds ``libshm_arena.so`` with ``make`` on first use (cached); every
+consumer has a pure-Python fallback so the framework degrades gracefully on
+hosts without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.log import logger
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LOCK = threading.Lock()
+_LIBS: dict = {}
+
+
+def _build(lib: str) -> Optional[str]:
+    path = os.path.abspath(os.path.join(_NATIVE_DIR, lib))
+    if os.path.exists(path):
+        return path
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.abspath(_NATIVE_DIR), lib],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return path if os.path.exists(path) else None
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.warning("native build of %s failed: %s", lib, e)
+        return None
+
+
+def load_library(lib: str) -> Optional[ctypes.CDLL]:
+    with _LOCK:
+        if lib in _LIBS:
+            return _LIBS[lib]
+        path = _build(lib)
+        handle = None
+        if path:
+            try:
+                handle = ctypes.CDLL(path)
+            except OSError as e:
+                logger.warning("loading %s failed: %s", path, e)
+        _LIBS[lib] = handle
+        return handle
+
+
+def shm_lib() -> Optional[ctypes.CDLL]:
+    lib = load_library("libshm_arena.so")
+    if lib is not None and not getattr(lib, "_sigs_set", False):
+        lib.shm_arena_create.restype = ctypes.c_int
+        lib.shm_arena_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.shm_arena_open.restype = ctypes.c_int
+        lib.shm_arena_open.argtypes = [ctypes.c_char_p]
+        lib.shm_arena_size.restype = ctypes.c_int64
+        lib.shm_arena_size.argtypes = [ctypes.c_int]
+        lib.shm_arena_map.restype = ctypes.c_void_p
+        lib.shm_arena_map.argtypes = [ctypes.c_int, ctypes.c_uint64]
+        lib.shm_arena_unmap.restype = ctypes.c_int
+        lib.shm_arena_unmap.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.shm_arena_unlink.restype = ctypes.c_int
+        lib.shm_arena_unlink.argtypes = [ctypes.c_char_p]
+        lib.shm_arena_close.restype = ctypes.c_int
+        lib.shm_arena_close.argtypes = [ctypes.c_int]
+        lib.shm_parallel_memcpy.restype = None
+        lib.shm_parallel_memcpy.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_int,
+        ]
+        lib.shm_crc32.restype = ctypes.c_uint32
+        lib.shm_crc32.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32]
+        lib._sigs_set = True
+    return lib

@@ -1,0 +1,232 @@
+"""Node model: the master's view of one training node.
+
+Capability parity with reference ``dlrover/python/common/node.py``
+(``NodeResource:38``, ``Node:150``) re-cast for TPU: a "node" is one TPU-VM
+host (or one local process in dev mode) owning ``tpu_chips`` chips of a slice,
+plus host CPU/memory.  Includes the legal status-transition flow
+(reference ``master/node/status_flow.py:136``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from dlrover_tpu.common.constants import (
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+
+
+@dataclasses.dataclass
+class NodeResource:
+    """Requested/used resources of a node.
+
+    Reference ``common/node.py:38``.  ``tpu_chips`` replaces ``gpu_num``;
+    ``tpu_type`` carries the accelerator flavour (e.g. ``v5e``, ``v5p``).
+    """
+
+    cpu: float = 0.0
+    memory_mb: int = 0
+    tpu_chips: int = 0
+    tpu_type: str = ""
+    disk_mb: int = 0
+    priority: str = ""
+
+    @classmethod
+    def resource_str_to_node_resource(cls, resource_str: str) -> "NodeResource":
+        """Parse ``"cpu=4,memory=8192Mi,tpu=8"`` style strings
+        (reference ``NodeResource.resource_str_to_node_resource``)."""
+        res = cls()
+        if not resource_str:
+            return res
+        for kv in resource_str.split(","):
+            if "=" not in kv:
+                continue
+            k, v = kv.split("=", 1)
+            k, v = k.strip().lower(), v.strip()
+            if k == "cpu":
+                res.cpu = float(v)
+            elif k == "memory":
+                res.memory_mb = int(v.lower().replace("mi", "").replace("m", ""))
+            elif k in ("tpu", "tpu_chips"):
+                res.tpu_chips = int(v)
+            elif k == "tpu_type":
+                res.tpu_type = v
+        return res
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class NodeGroupResource:
+    """Resource spec for a group of same-typed nodes
+    (reference ``common/node.py NodeGroupResource``)."""
+
+    count: int = 0
+    node_resource: NodeResource = dataclasses.field(default_factory=NodeResource)
+
+
+class Node:
+    """One training node as tracked by the master's job manager.
+
+    Reference ``common/node.py:150``.  Keeps identity (type, id, rank),
+    status, restart accounting, heartbeat, health-check verdicts, and
+    resource usage.
+    """
+
+    def __init__(
+        self,
+        node_type: str,
+        node_id: int,
+        rank_index: Optional[int] = None,
+        name: str = "",
+        status: str = NodeStatus.INITIAL,
+        config_resource: Optional[NodeResource] = None,
+        max_relaunch_count: int = 3,
+        critical: bool = False,
+    ):
+        self.type = node_type
+        self.id = node_id
+        self.rank_index = rank_index if rank_index is not None else node_id
+        self.name = name or f"{node_type}-{node_id}"
+        self.status = status
+        self.config_resource = config_resource or NodeResource()
+        self.used_resource = NodeResource()
+
+        self.critical = critical
+        self.max_relaunch_count = max_relaunch_count
+        self.relaunch_count = 0
+        self.relaunchable = True
+        self.is_released = False
+        self.exit_reason = ""
+
+        self.create_time: Optional[float] = None
+        self.start_time: Optional[float] = None
+        self.finish_time: Optional[float] = None
+        self.heartbeat_time: float = 0.0
+        self.start_hang_time: float = 0.0
+
+        # Pre-flight health check results (reference Node.node_check fields).
+        self.node_check_passed: Optional[bool] = None
+        self.node_check_elapsed: float = 0.0
+        self.is_straggler = False
+
+        # Addressing: host:port of the agent on this node.
+        self.host: str = ""
+        self.agent_port: int = 0
+        # ICI/DCN locality key used by the topology-aware rank sort
+        # (reference net_topology.py NodeTopologyMeta asw/psw -> slice/host).
+        self.slice_id: str = ""
+        self.host_id: str = ""
+
+        self.paral_config: dict = {}
+
+    # -- status ------------------------------------------------------------
+    def update_status(self, status: str) -> None:
+        if NodeStatusFlow.is_allowed(self.status, status):
+            self.status = status
+            if status == NodeStatus.RUNNING and self.start_time is None:
+                self.start_time = time.time()
+            if status in NodeStatus.TERMINAL and self.finish_time is None:
+                self.finish_time = time.time()
+
+    def is_unrecoverable_failure(self) -> bool:
+        """Whether the master should stop relaunching this node
+        (reference ``Node.is_unrecoverable_failure``)."""
+        if not self.relaunchable:
+            return True
+        if self.relaunch_count >= self.max_relaunch_count:
+            return True
+        if self.exit_reason == NodeExitReason.FATAL_ERROR:
+            return True
+        return False
+
+    def inc_relaunch_count(self) -> None:
+        self.relaunch_count += 1
+
+    def update_heartbeat(self, ts: Optional[float] = None) -> None:
+        self.heartbeat_time = ts if ts is not None else time.time()
+
+    def get_relaunch_node(self, new_id: int) -> "Node":
+        """Create the successor node when this one is replaced
+        (reference ``Node.get_relaunch_node_info``)."""
+        new = Node(
+            self.type,
+            new_id,
+            rank_index=self.rank_index,
+            status=NodeStatus.INITIAL,
+            config_resource=self.config_resource,
+            max_relaunch_count=self.max_relaunch_count,
+            critical=self.critical,
+        )
+        new.relaunch_count = self.relaunch_count + 1
+        return new
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "id": self.id,
+            "rank_index": self.rank_index,
+            "name": self.name,
+            "status": self.status,
+            "relaunch_count": self.relaunch_count,
+            "exit_reason": self.exit_reason,
+            "host": self.host,
+            "slice_id": self.slice_id,
+            "is_straggler": self.is_straggler,
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Node({self.type}-{self.id} rank={self.rank_index} {self.status})"
+
+
+class NodeStatusFlow:
+    """Legal node status transitions (reference ``status_flow.py:136``
+    NODE_STATE_FLOWS).  Transitions not listed are ignored — this makes the
+    event loop idempotent under out-of-order platform events."""
+
+    _ALLOWED = {
+        NodeStatus.INITIAL: {
+            NodeStatus.PENDING,
+            NodeStatus.RUNNING,
+            NodeStatus.FAILED,
+            NodeStatus.DELETED,
+        },
+        NodeStatus.PENDING: {
+            NodeStatus.RUNNING,
+            NodeStatus.SUCCEEDED,
+            NodeStatus.FAILED,
+            NodeStatus.DELETED,
+        },
+        NodeStatus.RUNNING: {
+            NodeStatus.SUCCEEDED,
+            NodeStatus.FAILED,
+            NodeStatus.DELETED,
+            NodeStatus.BREAKDOWN,
+        },
+        NodeStatus.SUCCEEDED: {NodeStatus.DELETED},
+        NodeStatus.FAILED: {NodeStatus.DELETED, NodeStatus.RUNNING},
+        NodeStatus.BREAKDOWN: {NodeStatus.DELETED},
+        NodeStatus.DELETED: set(),
+        NodeStatus.UNKNOWN: set(NodeStatus.TERMINAL)
+        | {NodeStatus.PENDING, NodeStatus.RUNNING},
+    }
+
+    @classmethod
+    def is_allowed(cls, from_status: str, to_status: str) -> bool:
+        if from_status == to_status:
+            return False
+        return to_status in cls._ALLOWED.get(from_status, set())
+
+
+@dataclasses.dataclass
+class NodeEvent:
+    """A platform event about one node, consumed by the job manager's event
+    loop (reference ``master/watcher/base_watcher.py NodeEvent``)."""
+
+    event_type: str
+    node: Node

@@ -1,0 +1,185 @@
+"""Control-plane RPC: one gRPC route carrying typed msgpack messages.
+
+Parity with the reference's channel layer (``dlrover/python/common/grpc.py:30``
+``build_channel`` + the two-method ``elastic_training.proto`` service), built
+on gRPC *generic handlers* so no protoc codegen step is needed: a single
+``/dlrover_tpu.Master/call`` unary-unary method whose payload is a registered
+``Message`` (see ``messages.py``).  The servicer dispatches on message type.
+
+Retry policy mirrors reference ``retry_grpc_request`` (master_client.py:38):
+exponential backoff, bounded attempts, for transient UNAVAILABLE during
+master relaunches.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from concurrent import futures
+from typing import Callable, Optional
+
+import grpc
+
+from dlrover_tpu.common.constants import GRPC
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.messages import (
+    BaseResponse,
+    Message,
+    deserialize,
+    serialize,
+)
+
+SERVICE_NAME = "dlrover_tpu.Master"
+METHOD = f"/{SERVICE_NAME}/call"
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", GRPC.MAX_MESSAGE_LENGTH),
+    ("grpc.max_receive_message_length", GRPC.MAX_MESSAGE_LENGTH),
+    ("grpc.enable_retries", 1),
+]
+
+
+def find_free_port(host: str = "") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def addr_connectable(addr: str, timeout: float = 3.0) -> bool:
+    """TCP-probe an ``host:port`` address (reference
+    ``elastic_run.py:277 _check_dlrover_master_available``)."""
+    try:
+        host, port = addr.rsplit(":", 1)
+        with socket.create_connection((host, int(port)), timeout=timeout):
+            return True
+    except (OSError, ValueError):
+        return False
+
+
+def local_ip() -> str:
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+class RpcServer:
+    """gRPC server with a single generic unary-unary dispatch method.
+
+    ``handler(msg) -> Optional[Message]`` receives the deserialized request;
+    a ``None`` return is sent as a success ``BaseResponse``.  Exceptions are
+    caught and returned as failed ``BaseResponse`` (the control plane must
+    never take down the master; reference servicer logs-and-continues).
+    """
+
+    def __init__(
+        self,
+        port: int,
+        handler: Callable[[Message], Optional[Message]],
+        max_workers: int = 64,
+        host: str = "0.0.0.0",
+    ):
+        self._handler = handler
+        self._port = port
+        self._host = host
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=max_workers, thread_name_prefix="rpc"
+            ),
+            options=_CHANNEL_OPTIONS,
+        )
+
+        def _unary(request: bytes, context) -> bytes:
+            try:
+                msg = deserialize(request)
+                resp = self._handler(msg)
+                if resp is None:
+                    resp = BaseResponse(success=True)
+            except Exception as e:  # noqa: BLE001 - control plane stays up
+                logger.exception("RPC handler error")
+                resp = BaseResponse(success=False, reason=f"{type(e).__name__}: {e}")
+            return serialize(resp)
+
+        method_handler = grpc.unary_unary_rpc_method_handler(
+            _unary,
+            request_deserializer=None,  # raw bytes
+            response_serializer=None,
+        )
+        generic = grpc.method_handlers_generic_handler(
+            SERVICE_NAME, {"call": method_handler}
+        )
+        self._server.add_generic_rpc_handlers((generic,))
+        self._bound_port = self._server.add_insecure_port(f"{host}:{port}")
+
+    @property
+    def port(self) -> int:
+        return self._bound_port
+
+    def start(self) -> None:
+        self._server.start()
+        logger.info("RPC server listening on %s:%s", self._host, self._bound_port)
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)
+
+
+class RpcClient:
+    """Client side of the single-route control plane with bounded retry.
+
+    Reference: ``MasterClient`` channel handling + ``retry_grpc_request``
+    (``elastic_agent/master_client.py:38-60``).
+    """
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        self._addr = addr
+        self._timeout = timeout
+        self._channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+        self._call = self._channel.unary_unary(
+            METHOD, request_serializer=None, response_deserializer=None
+        )
+
+    @property
+    def addr(self) -> str:
+        return self._addr
+
+    def call(
+        self,
+        msg: Message,
+        timeout: Optional[float] = None,
+        retries: int = 5,
+        backoff: float = 0.5,
+    ) -> Message:
+        last_err: Optional[Exception] = None
+        for attempt in range(retries):
+            try:
+                data = self._call(
+                    serialize(msg), timeout=timeout or self._timeout
+                )
+                return deserialize(data)
+            except grpc.RpcError as e:
+                last_err = e
+                code = e.code() if hasattr(e, "code") else None
+                if code in (
+                    grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                ):
+                    sleep = min(backoff * (2**attempt), 8.0)
+                    logger.warning(
+                        "RPC %s to %s failed (%s), retry %d/%d in %.1fs",
+                        type(msg).__name__,
+                        self._addr,
+                        code,
+                        attempt + 1,
+                        retries,
+                        sleep,
+                    )
+                    time.sleep(sleep)
+                    continue
+                raise
+        assert last_err is not None
+        raise last_err
+
+    def close(self) -> None:
+        self._channel.close()

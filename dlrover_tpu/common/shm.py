@@ -1,0 +1,364 @@
+"""POSIX shared-memory tensor arena — the flash-checkpoint staging area.
+
+TPU-native re-design of the reference's shm scheme
+(``elastic_agent/torch/ckpt_saver.py:73 TensorMeta``, ``:148
+_create_shared_memory``, ``:218 SharedMemoryHandler``): a worker process
+stages a flattened state (dict of numpy arrays, produced from the addressable
+shards of a sharded jax pytree) into one named shm segment; the agent process
+maps the same segment and persists it to storage asynchronously.
+
+Segment layout::
+
+    [ header 64B | meta region (msgpack, fixed capacity) | tensor data ]
+
+Write protocol (single writer, fenced by a SharedLock at the engine layer):
+tensor bytes first, then meta, then the header's ``meta_len``/``commit_count``
+— a reader that sees a consistent header+crc sees consistent data.
+
+Two backends: the C++ native one (``native/shm_arena.cc`` via ctypes —
+shm_open/mmap with multi-threaded memcpy, no Python resource-tracker
+interference) and a ``multiprocessing.shared_memory`` fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+import struct
+from typing import Dict, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.native import shm_lib
+
+MAGIC = 0x44_4C_52_54_50_55_01_00  # "DLRTPU\x01\x00"
+HEADER_SIZE = 64
+DEFAULT_META_CAPACITY = 8 << 20  # 8 MB of msgpack metadata
+# header: magic u64 | data_capacity u64 | meta_capacity u64 | meta_len u64 |
+#         commit_count u64 | meta_crc u32 | pad
+_HEADER_FMT = "<QQQQQI"
+
+
+@dataclasses.dataclass
+class TensorMeta:
+    """Placement of one tensor inside the arena (reference
+    ``ckpt_saver.py:73``)."""
+
+    dtype: str
+    shape: tuple
+    offset: int
+    nbytes: int
+
+
+def _required_size(flat: Dict[str, np.ndarray], meta_capacity: int) -> int:
+    data = sum(int(a.nbytes) for a in flat.values())
+    # Round each tensor start to 128B for aligned copies.
+    data += 128 * max(1, len(flat))
+    return HEADER_SIZE + meta_capacity + data
+
+
+class _NativeSegment:
+    """shm_open/mmap backend via native/shm_arena.cc."""
+
+    def __init__(self, name: str, size: int, create: bool):
+        self._lib = shm_lib()
+        if self._lib is None:
+            raise OSError("native shm library unavailable")
+        cname = ("/" + name.lstrip("/")).encode()
+        self.name = name
+        if create:
+            fd = self._lib.shm_arena_create(cname, size)
+        else:
+            fd = self._lib.shm_arena_open(cname)
+        if fd < 0:
+            raise OSError(-fd, f"shm open failed for {name}")
+        real = self._lib.shm_arena_size(fd)
+        if real < 0:
+            self._lib.shm_arena_close(fd)
+            raise OSError(-real, f"fstat failed for {name}")
+        self.size = int(real) if not create else max(int(real), size)
+        ptr = self._lib.shm_arena_map(fd, self.size)
+        if not ptr:
+            self._lib.shm_arena_close(fd)
+            raise OSError(f"mmap failed for {name}")
+        self._fd = fd
+        self._ptr = ptr
+        self.buf = np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_ubyte)), shape=(self.size,)
+        )
+
+    def memcpy_in(self, offset: int, src: np.ndarray) -> None:
+        src = np.ascontiguousarray(src)
+        n = src.nbytes
+        if n >= (1 << 22):
+            self._lib.shm_parallel_memcpy(
+                self._ptr + offset, src.ctypes.data, n, 0
+            )
+        else:
+            self.buf[offset : offset + n] = src.reshape(-1).view(np.uint8)
+
+    def crc32(self, offset: int, n: int) -> int:
+        return int(self._lib.shm_crc32(self._ptr + offset, n, 0))
+
+    def close(self, unlink: bool = False) -> None:
+        try:
+            self._lib.shm_arena_unmap(self._ptr, self.size)
+            self._lib.shm_arena_close(self._fd)
+            if unlink:
+                self._lib.shm_arena_unlink(("/" + self.name.lstrip("/")).encode())
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class _PySegment:
+    """multiprocessing.shared_memory fallback backend."""
+
+    def __init__(self, name: str, size: int, create: bool):
+        from multiprocessing import resource_tracker, shared_memory
+
+        self.name = name
+        if create:
+            try:
+                self._shm = shared_memory.SharedMemory(
+                    name=name, create=True, size=size
+                )
+            except FileExistsError:
+                # A stale segment from a crashed run may be smaller than we
+                # need (this backend cannot ftruncate-grow): replace it.
+                existing = shared_memory.SharedMemory(name=name)
+                if existing.size >= size:
+                    self._shm = existing
+                else:
+                    existing.close()
+                    existing.unlink()
+                    self._shm = shared_memory.SharedMemory(
+                        name=name, create=True, size=size
+                    )
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+        # Detach from the resource tracker: segment lifetime is managed by the
+        # agent (creator), not whichever process exits first.
+        try:
+            resource_tracker.unregister(f"/{name}", "shared_memory")
+        except Exception:  # noqa: BLE001
+            pass
+        self.size = self._shm.size
+        self.buf = np.frombuffer(self._shm.buf, dtype=np.uint8)
+
+    def memcpy_in(self, offset: int, src: np.ndarray) -> None:
+        src = np.ascontiguousarray(src)
+        n = src.nbytes
+        self.buf[offset : offset + n] = src.reshape(-1).view(np.uint8)
+
+    def crc32(self, offset: int, n: int) -> int:
+        import zlib
+
+        return zlib.crc32(self.buf[offset : offset + n].tobytes()) & 0xFFFFFFFF
+
+    def close(self, unlink: bool = False) -> None:
+        try:
+            self.buf = None
+            self._shm.close()
+            if unlink:
+                self._shm.unlink()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _shm_stat(name: str):
+    """(st_ino, st_size) of the backing /dev/shm file, or None.  Both
+    backends materialize the segment there on Linux, so this is the shared
+    source of truth for 'has the writer re-created the segment?'."""
+    try:
+        st = os.stat(f"/dev/shm/{name.lstrip('/')}")
+        return (st.st_ino, st.st_size)
+    except OSError:
+        return None
+
+
+def _open_segment(name: str, size: int, create: bool):
+    if shm_lib() is not None:
+        try:
+            return _NativeSegment(name, size, create)
+        except OSError as e:
+            if not create:
+                raise FileNotFoundError(
+                    f"shm segment {name} not found: {e}"
+                ) from e
+            logger.warning("native shm open failed (%s); python fallback", e)
+    # No native toolchain: both read and write sides use the Python backend
+    # (they interoperate — same /dev/shm file).
+    try:
+        return _PySegment(name, size, create)
+    except FileNotFoundError:
+        raise
+    except OSError as e:
+        if not create:
+            raise FileNotFoundError(f"shm segment {name} not found: {e}") from e
+        raise
+
+
+class SharedMemoryArena:
+    """One named arena holding one staged checkpoint state.
+
+    Writers (worker processes) call :meth:`write_state`; readers (agent saver
+    daemon, or a restarted worker doing a warm restore) call
+    :meth:`read_state` / :meth:`metadata`.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        create: bool = False,
+        size: int = 0,
+        meta_capacity: int = DEFAULT_META_CAPACITY,
+    ):
+        self.name = name
+        self._meta_capacity = meta_capacity
+        self._seg = None
+        if create and size:
+            self._seg = _open_segment(name, size, create=True)
+
+    # -- writer side --------------------------------------------------------
+    def write_state(
+        self, flat: Dict[str, np.ndarray], extra: Optional[dict] = None
+    ) -> None:
+        """Stage a flat ``path -> ndarray`` state (+ JSON-able ``extra`` such
+        as step, treedef, sharding info) into the arena, growing it if needed.
+        """
+        need = _required_size(flat, self._meta_capacity)
+        if self._seg is None or self._seg.size < need:
+            if self._seg is not None:
+                self._seg.close(unlink=True)
+            self._seg = _open_segment(self.name, need, create=True)
+            self._seg_stat = _shm_stat(self.name)
+        seg = self._seg
+
+        offset = HEADER_SIZE + self._meta_capacity
+        metas: Dict[str, dict] = {}
+        for path, arr in flat.items():
+            arr = np.asarray(arr)
+            offset = (offset + 127) & ~127  # 128B alignment
+            seg.memcpy_in(offset, arr)
+            # dtype.name round-trips extended types (bfloat16/fp8 via
+            # ml_dtypes) where dtype.str degrades to raw void ('<V2').
+            try:
+                dtype_key = (
+                    arr.dtype.name
+                    if np.dtype(arr.dtype.name) == arr.dtype
+                    else arr.dtype.str
+                )
+            except TypeError:
+                dtype_key = arr.dtype.str
+            metas[path] = dataclasses.asdict(
+                TensorMeta(
+                    dtype=dtype_key, shape=tuple(arr.shape),
+                    offset=offset, nbytes=int(arr.nbytes),
+                )
+            )
+            offset += arr.nbytes
+
+        meta_blob = msgpack.packb(
+            {"tensors": metas, "extra": extra or {}}, use_bin_type=True
+        )
+        if len(meta_blob) > self._meta_capacity:
+            raise ValueError(
+                f"checkpoint metadata ({len(meta_blob)}B) exceeds meta region "
+                f"({self._meta_capacity}B); raise meta_capacity"
+            )
+        seg.buf[HEADER_SIZE : HEADER_SIZE + len(meta_blob)] = np.frombuffer(
+            meta_blob, dtype=np.uint8
+        )
+        crc = seg.crc32(HEADER_SIZE, len(meta_blob))
+        prev = self._read_header()
+        commit = (prev[4] + 1) if prev else 1
+        header = struct.pack(
+            _HEADER_FMT,
+            MAGIC,
+            seg.size,
+            self._meta_capacity,
+            len(meta_blob),
+            commit,
+            crc,
+        )
+        seg.buf[: len(header)] = np.frombuffer(header, dtype=np.uint8)
+
+    # -- reader side --------------------------------------------------------
+    def _ensure_open(self) -> None:
+        if self._seg is None:
+            self._seg = _open_segment(self.name, 0, create=False)
+            self._seg_stat = _shm_stat(self.name)
+
+    def _read_header(self):
+        if self._seg is None:
+            return None
+        raw = bytes(self._seg.buf[: struct.calcsize(_HEADER_FMT)])
+        vals = struct.unpack(_HEADER_FMT, raw)
+        if vals[0] != MAGIC:
+            return None
+        return vals
+
+    def reopen(self) -> None:
+        """Re-map the segment (it may have been re-created bigger)."""
+        if self._seg is not None:
+            self._seg.close()
+            self._seg = None
+        self._ensure_open()
+
+    def metadata(self) -> Optional[dict]:
+        """Read {tensors: {path: TensorMeta-dict}, extra: {...}} or None if
+        the arena holds no committed state."""
+        try:
+            self._ensure_open()
+        except FileNotFoundError:
+            return None
+        # Growth re-creates the named segment (new inode): a long-attached
+        # reader must notice and remap, or it would serve stale state forever.
+        cur_stat = _shm_stat(self.name)
+        if cur_stat is not None and cur_stat != getattr(self, "_seg_stat", None):
+            try:
+                self.reopen()
+            except FileNotFoundError:
+                return None
+        hdr = self._read_header()
+        if hdr is None:
+            return None
+        _, data_cap, meta_cap, meta_len, commit, crc = hdr
+        if commit == 0 or meta_len == 0:
+            return None
+        if self._seg.crc32(HEADER_SIZE, meta_len) != crc:
+            logger.warning("shm arena %s: meta crc mismatch (torn write?)", self.name)
+            return None
+        blob = bytes(self._seg.buf[HEADER_SIZE : HEADER_SIZE + meta_len])
+        meta = msgpack.unpackb(blob, raw=False)
+        meta["commit_count"] = commit
+        return meta
+
+    def read_state(
+        self, copy: bool = True
+    ) -> Optional[Tuple[Dict[str, np.ndarray], dict]]:
+        meta = self.metadata()
+        if meta is None:
+            return None
+        out: Dict[str, np.ndarray] = {}
+        for path, tm in meta["tensors"].items():
+            dtype = np.dtype(tm["dtype"])
+            n = tm["nbytes"]
+            view = self._seg.buf[tm["offset"] : tm["offset"] + n]
+            arr = view.view(dtype).reshape(tuple(tm["shape"]))
+            out[path] = arr.copy() if copy else arr
+        return out, meta["extra"]
+
+    def close(self, unlink: bool = False) -> None:
+        if self._seg is not None:
+            self._seg.close(unlink=unlink)
+            self._seg = None
+
+
+def arena_name(job_name: str, local_rank: int, purpose: str = "ckpt") -> str:
+    """Canonical per-rank arena naming (reference ``_get_shm_name``)."""
+    safe = job_name.replace("/", "_")
+    return f"dlrtpu_{safe}_{purpose}_{local_rank}"

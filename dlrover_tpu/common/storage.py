@@ -1,0 +1,108 @@
+"""Checkpoint storage abstraction.
+
+Parity with reference ``dlrover/python/common/storage.py`` (``CheckpointStorage``
+ABC ``:21``, ``PosixDiskStorage :128``): a minimal write/read surface that the
+async saver daemon targets, pluggable so GCS/NFS backends can slot in without
+touching the saver.  ``ClassMeta`` lets the trainer process tell the agent-side
+saver (a different OS process) which storage class to instantiate.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import importlib
+import os
+import shutil
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ClassMeta:
+    """Importable constructor spec, shippable over the control plane
+    (reference ``storage.py ClassMeta``)."""
+
+    module_path: str = "dlrover_tpu.common.storage"
+    class_name: str = "PosixDiskStorage"
+    kwargs: dict = dataclasses.field(default_factory=dict)
+
+    def build(self) -> "CheckpointStorage":
+        mod = importlib.import_module(self.module_path)
+        cls = getattr(mod, self.class_name)
+        return cls(**self.kwargs)
+
+
+class CheckpointStorage(abc.ABC):
+    """Byte-level storage surface used by the flash-checkpoint saver."""
+
+    @abc.abstractmethod
+    def write(self, content: bytes | str, path: str) -> None: ...
+
+    @abc.abstractmethod
+    def read(self, path: str, mode: str = "rb") -> Optional[bytes | str]: ...
+
+    @abc.abstractmethod
+    def safe_rmtree(self, dirpath: str) -> None: ...
+
+    @abc.abstractmethod
+    def safe_remove(self, path: str) -> None: ...
+
+    @abc.abstractmethod
+    def safe_makedirs(self, dirpath: str) -> None: ...
+
+    @abc.abstractmethod
+    def commit(self, step: int, success: bool) -> None:
+        """Hook invoked after all shards of ``step`` are persisted."""
+
+    @abc.abstractmethod
+    def exists(self, path: str) -> bool: ...
+
+    @abc.abstractmethod
+    def listdir(self, path: str) -> list[str]: ...
+
+
+class PosixDiskStorage(CheckpointStorage):
+    """Local/NFS POSIX filesystem backend (reference ``storage.py:128``)."""
+
+    def write(self, content: bytes | str, path: str) -> None:
+        mode = "wb" if isinstance(content, bytes) else "w"
+        tmp = path + ".tmp"
+        with open(tmp, mode) as f:
+            f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic publish
+
+    def read(self, path: str, mode: str = "rb") -> Optional[bytes | str]:
+        if not os.path.exists(path):
+            return None
+        with open(path, mode) as f:
+            return f.read()
+
+    def safe_rmtree(self, dirpath: str) -> None:
+        shutil.rmtree(dirpath, ignore_errors=True)
+
+    def safe_remove(self, path: str) -> None:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def safe_makedirs(self, dirpath: str) -> None:
+        os.makedirs(dirpath, exist_ok=True)
+
+    def commit(self, step: int, success: bool) -> None:
+        pass
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def listdir(self, path: str) -> list[str]:
+        try:
+            return sorted(os.listdir(path))
+        except OSError:
+            return []
+
+
+def get_checkpoint_storage(meta: Optional[ClassMeta] = None) -> CheckpointStorage:
+    return (meta or ClassMeta()).build()

@@ -1,0 +1,28 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax import.
+
+Mirrors the reference's test strategy (SURVEY.md §4): elasticity logic runs on
+one host against an in-process master + real RPC; collective logic runs on a
+virtual multi-device CPU mesh.
+"""
+
+import os
+
+# Must be set before any jax import anywhere in the test session.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual cpu devices, got {len(devs)}"
+    return devs

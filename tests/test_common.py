@@ -1,0 +1,166 @@
+"""L0 substrate tests: messages, RPC, node model, storage, context."""
+
+import threading
+
+import pytest
+
+from dlrover_tpu.common import messages as msgs
+from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.common.global_context import get_context
+from dlrover_tpu.common.node import Node, NodeResource, NodeStatusFlow
+from dlrover_tpu.common.rpc import RpcClient, RpcServer, addr_connectable
+
+
+class TestMessages:
+    def test_roundtrip_simple(self):
+        m = msgs.JoinRendezvous(node_id=3, node_rank=1, local_world_size=4)
+        out = msgs.deserialize(msgs.serialize(m))
+        assert out == m
+
+    def test_roundtrip_nested(self):
+        hb = msgs.HeartbeatResponse(
+            actions=[
+                msgs.DiagnosisAction(action_type="restart_worker", reason="hang"),
+                msgs.DiagnosisAction(action_type="no_action"),
+            ]
+        )
+        out = msgs.deserialize(msgs.serialize(hb))
+        assert isinstance(out, msgs.HeartbeatResponse)
+        assert out.actions[0].action_type == "restart_worker"
+        assert len(out.actions) == 2
+
+    def test_roundtrip_bytes_and_dict(self):
+        m = msgs.KVStoreSet(key="store/rank0", value=b"\x00\x01binary")
+        out = msgs.deserialize(msgs.serialize(m))
+        assert out.value == b"\x00\x01binary"
+        w = msgs.CommWorld(round=2, world={0: {"id": 0}, 1: {"id": 1}})
+        out2 = msgs.deserialize(msgs.serialize(w))
+        assert out2.world[1]["id"] == 1
+
+
+class TestRpc:
+    def test_server_dispatch_and_retry(self):
+        calls = []
+
+        def handler(msg):
+            calls.append(msg)
+            if isinstance(msg, msgs.TaskRequest):
+                return msgs.Task(task_id=7, start=0, end=10)
+            return None
+
+        server = RpcServer(0, handler)
+        server.start()
+        try:
+            addr = f"127.0.0.1:{server.port}"
+            assert addr_connectable(addr)
+            client = RpcClient(addr)
+            task = client.call(msgs.TaskRequest(dataset_name="d", worker_id=1))
+            assert isinstance(task, msgs.Task)
+            assert task.task_id == 7
+            # Unknown-handled message -> default success response.
+            resp = client.call(msgs.Heartbeat(node_id=1))
+            assert isinstance(resp, msgs.BaseResponse) and resp.success
+            client.close()
+        finally:
+            server.stop()
+        assert len(calls) == 2
+
+    def test_handler_exception_returns_failure(self):
+        def handler(msg):
+            raise ValueError("boom")
+
+        server = RpcServer(0, handler)
+        server.start()
+        try:
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            resp = client.call(msgs.Heartbeat())
+            assert isinstance(resp, msgs.BaseResponse)
+            assert not resp.success and "boom" in resp.reason
+            client.close()
+        finally:
+            server.stop()
+
+    def test_concurrent_calls(self):
+        lock = threading.Lock()
+        count = [0]
+
+        def handler(msg):
+            with lock:
+                count[0] += 1
+            return msgs.KVStoreCount(value=count[0])
+
+        server = RpcServer(0, handler)
+        server.start()
+        try:
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            threads = [
+                threading.Thread(target=lambda: client.call(msgs.Empty()))
+                for _ in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert count[0] == 8
+            client.close()
+        finally:
+            server.stop()
+
+
+class TestNode:
+    def test_status_flow(self):
+        n = Node("worker", 0)
+        n.update_status(NodeStatus.PENDING)
+        n.update_status(NodeStatus.RUNNING)
+        assert n.status == NodeStatus.RUNNING
+        # Illegal transition ignored.
+        n.update_status(NodeStatus.PENDING)
+        assert n.status == NodeStatus.RUNNING
+        n.update_status(NodeStatus.SUCCEEDED)
+        assert n.status == NodeStatus.SUCCEEDED
+        assert n.finish_time is not None
+
+    def test_status_flow_rules(self):
+        assert NodeStatusFlow.is_allowed(NodeStatus.FAILED, NodeStatus.RUNNING)
+        assert not NodeStatusFlow.is_allowed(NodeStatus.DELETED, NodeStatus.RUNNING)
+        assert not NodeStatusFlow.is_allowed(NodeStatus.RUNNING, NodeStatus.RUNNING)
+
+    def test_relaunch_accounting(self):
+        n = Node("worker", 0, max_relaunch_count=2)
+        assert not n.is_unrecoverable_failure()
+        n.inc_relaunch_count()
+        n.inc_relaunch_count()
+        assert n.is_unrecoverable_failure()
+        succ = n.get_relaunch_node(new_id=5)
+        assert succ.id == 5 and succ.rank_index == n.rank_index
+        assert succ.relaunch_count == 3
+
+    def test_resource_parse(self):
+        r = NodeResource.resource_str_to_node_resource("cpu=4,memory=8192Mi,tpu=8")
+        assert r.cpu == 4 and r.memory_mb == 8192 and r.tpu_chips == 8
+
+
+class TestStorageAndContext:
+    def test_posix_storage(self, tmp_path):
+        from dlrover_tpu.common.storage import ClassMeta, PosixDiskStorage
+
+        s = PosixDiskStorage()
+        p = str(tmp_path / "a" / "f.bin")
+        s.safe_makedirs(str(tmp_path / "a"))
+        s.write(b"hello", p)
+        assert s.read(p) == b"hello"
+        assert s.exists(p)
+        assert "f.bin" in s.listdir(str(tmp_path / "a"))
+        s.safe_remove(p)
+        assert not s.exists(p)
+        # ClassMeta round-trip builds the same backend.
+        built = ClassMeta().build()
+        assert isinstance(built, PosixDiskStorage)
+
+    def test_context_singleton_and_update(self):
+        ctx = get_context()
+        assert ctx is get_context()
+        old = ctx.rdzv_timeout
+        ctx.update(rdzv_timeout=123.0)
+        assert get_context().rdzv_timeout == 123.0
+        ctx.update(rdzv_timeout=old)
