@@ -1,0 +1,6 @@
+"""L4 elastic agent: per-node supervisor.
+
+Master-driven rendezvous, worker process lifecycle, async checkpoint saver,
+resource/training monitors, sharding client, diagnosis agent (SURVEY.md §1
+L4, reference ``dlrover/python/elastic_agent/``).
+"""

@@ -1,0 +1,215 @@
+"""Agent-side async checkpoint saver daemon.
+
+Parity with reference ``elastic_agent/torch/ckpt_saver.py``
+(``AsyncCheckpointSaver :353``, ``_sync_shm_to_storage :536``,
+``save_shm_to_storage :701``, ``commit_checkpoint :822``): runs inside the
+*agent* process, so persistence survives worker crashes; consumes save
+events from a SharedQueue, copies each local rank's shm arena to storage
+under the fencing lock, votes with done files, and (on the leader node)
+advances the tracker after the master's cross-node step barrier.
+
+Breakpoint-save: when the agent is about to stop workers (failure or
+membership change) it calls :meth:`save_shm_to_storage` to persist whatever
+steps are staged but not yet persisted — the "checkpoint-at-breakpoint" that
+makes kill-and-rejoin cheap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
+
+from dlrover_tpu.checkpoint import shard_file
+from dlrover_tpu.checkpoint.engine import (
+    ckpt_lock_name,
+    ckpt_queue_name,
+    ckpt_stat_name,
+)
+from dlrover_tpu.common.global_context import get_context
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.multi_process import (
+    SharedDict,
+    SharedLock,
+    SharedQueue,
+)
+from dlrover_tpu.common.shm import SharedMemoryArena, arena_name
+from dlrover_tpu.common.storage import PosixDiskStorage
+
+
+class AsyncCheckpointSaver:
+    def __init__(
+        self,
+        job_name: str,
+        nproc_per_node: int,
+        *,
+        master_client=None,
+        storage=None,
+    ):
+        self.job_name = job_name
+        self.nproc = nproc_per_node
+        self.client = master_client
+        self.storage = storage or PosixDiskStorage()
+        self._ctx = get_context()
+        # Server side of the worker-facing primitives.
+        self._queue = SharedQueue(ckpt_queue_name(job_name), create=True)
+        self._locks = [
+            SharedLock(ckpt_lock_name(job_name, lr), create=True)
+            for lr in range(nproc_per_node)
+        ]
+        self._stat = SharedDict(ckpt_stat_name(job_name), create=True)
+        self._arenas: Dict[int, SharedMemoryArena] = {}
+        self._persisted: Dict[int, int] = {}  # local_rank -> step
+        self._last_event: Dict[int, dict] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(2, self._ctx.ckpt_shard_io_workers),
+            thread_name_prefix="ckpt-io",
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._event_loop, name="async-ckpt-saver", daemon=True
+            )
+            self._thread.start()
+            logger.info(
+                "async checkpoint saver up (job=%s nproc=%d)",
+                self.job_name, self.nproc,
+            )
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._pool.shutdown(wait=False)
+        self._queue.close()
+        for lock in self._locks:
+            lock.close()
+        self._stat.close()
+        for arena in self._arenas.values():
+            arena.close()
+
+    def _arena(self, local_rank: int) -> SharedMemoryArena:
+        if local_rank not in self._arenas:
+            self._arenas[local_rank] = SharedMemoryArena(
+                arena_name(self.job_name, local_rank)
+            )
+        return self._arenas[local_rank]
+
+    # -- event loop (reference _sync_shm_to_storage :536) -------------------
+    def _event_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                event = self._queue.get(timeout=2.0)
+            except TimeoutError:
+                continue
+            except Exception:  # noqa: BLE001
+                if not self._stop.is_set():
+                    logger.exception("ckpt saver queue error")
+                    time.sleep(1.0)
+                continue
+            if not isinstance(event, dict) or event.get("event") != "save":
+                continue
+            self._last_event[event.get("local_rank", 0)] = event
+            try:
+                self._handle_save(event)
+            except Exception:  # noqa: BLE001
+                logger.exception("ckpt save event failed: %s", event)
+
+    def _handle_save(self, event: dict) -> None:
+        lr = int(event.get("local_rank", 0))
+        step = int(event.get("step", 0))
+        pid = int(event.get("process_id", lr))
+        nproc_global = int(event.get("num_processes", self.nproc))
+        ckpt_dir = event["ckpt_dir"]
+        lock = self._locks[lr] if lr < len(self._locks) else None
+        if lock is not None and not lock.acquire(timeout=60.0):
+            logger.warning("saver: lock for rank %d busy; skipping", lr)
+            return
+        try:
+            arena = self._arena(lr)
+            arena.reopen()
+            read = arena.read_state(copy=True)
+        finally:
+            if lock is not None:
+                lock.release()
+        if read is None:
+            logger.warning("saver: arena for rank %d empty", lr)
+            return
+        tensors, extra = read
+        staged_step = int(extra.get("step", -1))
+        if staged_step != step:
+            logger.info(
+                "saver: arena holds step %d (event wanted %d) — persisting "
+                "the staged one", staged_step, step,
+            )
+            step = staged_step
+        t0 = time.perf_counter()
+        shard_file.write_shard(
+            self.storage, ckpt_dir, step, pid, tensors, extra
+        )
+        self._persisted[lr] = step
+        self._stat.set(f"persisted_{lr}", step)
+        logger.info(
+            "saver: persisted rank %d step %d in %.2fs",
+            lr, step, time.perf_counter() - t0,
+        )
+        if pid == 0:
+            # Commit waits for the OTHER ranks' shards — never block the
+            # event loop on it (they may be persisted by this same loop).
+            self._pool.submit(self._commit, ckpt_dir, step, nproc_global)
+
+    def _commit(self, ckpt_dir: str, step: int, world: int,
+                timeout: float = 600.0) -> None:
+        deadline = time.time() + timeout
+        if self.client is not None:
+            while time.time() < deadline:
+                try:
+                    if self.client.sync_checkpoint(step):
+                        break
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.5)
+        while time.time() < deadline:
+            if shard_file.all_shards_done(self.storage, ckpt_dir, step, world):
+                shard_file.commit(self.storage, ckpt_dir, step)
+                return
+            time.sleep(0.5)
+        logger.warning("saver: commit of step %d timed out", step)
+
+    # -- breakpoint save (reference save_shm_to_storage :701) ---------------
+    def save_shm_to_storage(self, reason: str = "") -> None:
+        """Persist every staged-but-unpersisted arena now (called by the
+        agent right before stopping workers)."""
+        for lr in range(self.nproc):
+            try:
+                arena = self._arena(lr)
+                arena.reopen()
+                meta = arena.metadata()
+            except Exception:  # noqa: BLE001
+                continue
+            if meta is None:
+                continue
+            extra = meta.get("extra", {})
+            step = int(extra.get("step", -1))
+            ckpt_dir = extra.get("ckpt_dir", "")
+            if step < 0 or not ckpt_dir:
+                continue
+            if self._persisted.get(lr, -1) >= step:
+                continue
+            logger.info(
+                "breakpoint save (%s): persisting rank %d step %d",
+                reason, lr, step,
+            )
+            self._handle_save(
+                {
+                    "event": "save",
+                    "step": step,
+                    "local_rank": lr,
+                    "process_id": extra.get("process_id", lr),
+                    "num_processes": extra.get("num_processes", self.nproc),
+                    "ckpt_dir": ckpt_dir,
+                }
+            )

@@ -1,0 +1,358 @@
+"""Agent/worker -> master client: every control-plane call in one place.
+
+Parity with reference ``elastic_agent/master_client.py:60`` (~50 wrappers +
+singleton ``build_master_client :480``).  Each method is a typed wrapper over
+``RpcClient.call``; the transport retry lives in the RPC layer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.env import get_master_addr, get_node_id
+from dlrover_tpu.common.rpc import RpcClient
+
+
+class MasterClient:
+    def __init__(self, master_addr: str, node_id: int = 0):
+        self._client = RpcClient(master_addr)
+        self.node_id = node_id
+        self.master_addr = master_addr
+
+    # -- registration / lifecycle -----------------------------------------
+    def register_node(
+        self,
+        *,
+        node_type: str = "worker",
+        node_rank: int = -1,
+        host: str = "",
+        agent_port: int = 0,
+        slice_id: str = "",
+        host_id: str = "",
+        tpu_chips: int = 0,
+        local_world_size: int = 1,
+    ) -> None:
+        self._client.call(
+            m.NodeMeta(
+                node_type=node_type,
+                node_id=self.node_id,
+                node_rank=node_rank,
+                host=host,
+                agent_port=agent_port,
+                slice_id=slice_id,
+                host_id=host_id,
+                tpu_chips=tpu_chips,
+                local_world_size=local_world_size,
+            )
+        )
+
+    def report_node_status(
+        self, status: str, node_type: str = "worker", exit_reason: str = "",
+        restart_count: int = 0,
+    ) -> None:
+        self._client.call(
+            m.ReportNodeStatus(
+                node_id=self.node_id,
+                node_type=node_type,
+                status=status,
+                exit_reason=exit_reason,
+                restart_count=restart_count,
+            )
+        )
+
+    def report_failure(
+        self, error_data: str, level: str = "error", restart_count: int = 0,
+        node_rank: int = -1,
+    ) -> None:
+        self._client.call(
+            m.NodeFailure(
+                node_id=self.node_id,
+                node_rank=node_rank,
+                error_data=error_data,
+                level=level,
+                restart_count=restart_count,
+            )
+        )
+
+    def report_heartbeat(self) -> List[m.DiagnosisAction]:
+        resp = self._client.call(
+            m.Heartbeat(node_id=self.node_id, timestamp=time.time())
+        )
+        if isinstance(resp, m.HeartbeatResponse):
+            return resp.actions
+        return []
+
+    def report_job_exit(self, success: bool, reason: str = "") -> None:
+        self._client.call(
+            m.JobExitRequest(node_id=self.node_id, success=success, reason=reason)
+        )
+
+    # -- rendezvous --------------------------------------------------------
+    def join_rendezvous(
+        self,
+        node_rank: int,
+        local_world_size: int,
+        rdzv_name: str = "elastic-training",
+        slice_id: str = "",
+    ) -> int:
+        resp = self._client.call(
+            m.JoinRendezvous(
+                node_id=self.node_id,
+                node_rank=node_rank,
+                local_world_size=local_world_size,
+                rdzv_name=rdzv_name,
+                slice_id=slice_id,
+            )
+        )
+        return resp.round if isinstance(resp, m.RendezvousRound) else -1
+
+    def get_comm_world(
+        self, rdzv_name: str = "elastic-training"
+    ) -> Tuple[int, int, Dict[int, dict], str]:
+        resp = self._client.call(
+            m.CommWorldRequest(node_id=self.node_id, rdzv_name=rdzv_name)
+        )
+        if isinstance(resp, m.CommWorld):
+            return resp.round, resp.group, resp.world, resp.coordinator
+        return -1, 0, {}, ""
+
+    def num_nodes_waiting(self, rdzv_name: str = "elastic-training") -> int:
+        resp = self._client.call(m.WaitingNodeNumRequest(rdzv_name=rdzv_name))
+        return resp.waiting_num if isinstance(resp, m.WaitingNodeNum) else 0
+
+    # -- kv store ----------------------------------------------------------
+    def kv_store_set(self, key: str, value: bytes) -> None:
+        self._client.call(m.KVStoreSet(key=key, value=value))
+
+    def kv_store_get(self, key: str) -> Optional[bytes]:
+        resp = self._client.call(m.KVStoreGet(key=key))
+        if isinstance(resp, m.KVStoreValue) and resp.found:
+            return resp.value
+        return None
+
+    def kv_store_wait_get(
+        self, key: str, timeout: float = 60.0, poll: float = 0.2
+    ) -> Optional[bytes]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            val = self.kv_store_get(key)
+            if val is not None:
+                return val
+            time.sleep(poll)
+        return None
+
+    def kv_store_multi_set(self, kvs: Dict[str, bytes]) -> None:
+        self._client.call(m.KVStoreMultiSet(kvs=kvs))
+
+    def kv_store_multi_get(self, keys: List[str]) -> Dict[str, bytes]:
+        resp = self._client.call(m.KVStoreMultiGet(keys=keys))
+        return resp.kvs if isinstance(resp, m.KVStoreMultiValue) else {}
+
+    def kv_store_add(self, key: str, delta: int = 1) -> int:
+        resp = self._client.call(m.KVStoreAdd(key=key, delta=delta))
+        return resp.value if isinstance(resp, m.KVStoreCount) else 0
+
+    # -- data sharding -----------------------------------------------------
+    def report_dataset_shard_params(
+        self,
+        *,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        storage_type: str = "table",
+        batch_size: int = 0,
+    ) -> None:
+        self._client.call(
+            m.DatasetShardParams(
+                dataset_name=dataset_name,
+                dataset_size=dataset_size,
+                shard_size=shard_size,
+                num_epochs=num_epochs,
+                shuffle=shuffle,
+                storage_type=storage_type,
+                batch_size=batch_size,
+            )
+        )
+
+    def get_task(self, dataset_name: str) -> m.Task:
+        resp = self._client.call(
+            m.TaskRequest(dataset_name=dataset_name, worker_id=self.node_id)
+        )
+        return resp if isinstance(resp, m.Task) else m.Task(task_id=-1)
+
+    def report_task_result(
+        self, dataset_name: str, task_id: int, success: bool = True,
+        err_message: str = "",
+    ) -> None:
+        self._client.call(
+            m.TaskResult(
+                dataset_name=dataset_name,
+                task_id=task_id,
+                worker_id=self.node_id,
+                success=success,
+                err_message=err_message,
+            )
+        )
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        resp = self._client.call(
+            m.ShardCheckpointRequest(dataset_name=dataset_name)
+        )
+        return resp.content if isinstance(resp, m.ShardCheckpoint) else ""
+
+    def restore_shard_checkpoint(self, dataset_name: str, content: str) -> bool:
+        resp = self._client.call(
+            m.ShardCheckpoint(dataset_name=dataset_name, content=content)
+        )
+        return isinstance(resp, m.BaseResponse) and resp.success
+
+    # -- health check ------------------------------------------------------
+    def report_network_check(
+        self, succeeded: bool, elapsed: float, round_: int = -1
+    ) -> None:
+        self._client.call(
+            m.NetworkCheckResult(
+                node_id=self.node_id,
+                succeeded=succeeded,
+                elapsed=elapsed,
+                round=round_,
+            )
+        )
+
+    def network_ready(self) -> bool:
+        resp = self._client.call(m.NetworkReadyRequest())
+        return isinstance(resp, m.BaseResponse) and resp.success
+
+    def get_fault_nodes(self) -> Tuple[List[int], str]:
+        resp = self._client.call(m.FaultNodeRequest())
+        if isinstance(resp, m.FaultNodes):
+            return resp.nodes, resp.reason
+        return [], ""
+
+    def get_stragglers(self) -> Tuple[List[int], dict]:
+        resp = self._client.call(m.StragglerRequest())
+        if isinstance(resp, m.Stragglers):
+            return resp.nodes, resp.times
+        return [], {}
+
+    # -- metrics -----------------------------------------------------------
+    def report_global_step(self, step: int, timestamp: float = 0.0) -> None:
+        self._client.call(
+            m.GlobalStep(
+                node_id=self.node_id, step=step,
+                timestamp=timestamp or time.time(),
+            )
+        )
+
+    def report_used_resource(
+        self, cpu_percent: float, memory_mb: float,
+        tpu_duty_cycle: float = 0.0, hbm_used_mb: float = 0.0,
+    ) -> None:
+        self._client.call(
+            m.UsedResource(
+                node_id=self.node_id,
+                cpu_percent=cpu_percent,
+                memory_mb=memory_mb,
+                tpu_duty_cycle=tpu_duty_cycle,
+                hbm_used_mb=hbm_used_mb,
+            )
+        )
+
+    def report_model_info(
+        self, num_params: int, flops_per_step: float = 0.0,
+        batch_size_per_step: int = 0, **extra,
+    ) -> None:
+        self._client.call(
+            m.ModelInfo(
+                num_params=num_params,
+                flops_per_step=flops_per_step,
+                batch_size_per_step=batch_size_per_step,
+                extra=extra,
+            )
+        )
+
+    def report_diagnosis_data(self, data_type: str, content: str) -> None:
+        self._client.call(
+            m.DiagnosisReport(
+                node_id=self.node_id,
+                data_type=data_type,
+                content=content,
+                timestamp=time.time(),
+            )
+        )
+
+    # -- sync / ckpt -------------------------------------------------------
+    def join_sync(self, sync_name: str, node_rank: int = -1) -> None:
+        self._client.call(
+            m.SyncJoin(
+                sync_name=sync_name, node_id=self.node_id, node_rank=node_rank
+            )
+        )
+
+    def sync_finished(self, sync_name: str) -> bool:
+        resp = self._client.call(m.SyncQuery(sync_name=sync_name))
+        return isinstance(resp, m.BaseResponse) and resp.success
+
+    def barrier(self, sync_name: str, timeout: float = 120.0) -> bool:
+        """Join + poll a named barrier until it opens."""
+        self.join_sync(sync_name)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.sync_finished(sync_name):
+                return True
+            time.sleep(0.2)
+        return False
+
+    def sync_checkpoint(self, step: int) -> bool:
+        resp = self._client.call(
+            m.CheckpointSync(node_id=self.node_id, step=step)
+        )
+        return isinstance(resp, m.BaseResponse) and resp.success
+
+    # -- config ------------------------------------------------------------
+    def get_elastic_run_config(self) -> dict:
+        resp = self._client.call(m.ElasticRunConfigRequest())
+        return resp.configs if isinstance(resp, m.ElasticRunConfig) else {}
+
+    def get_parallel_config(self) -> m.ParallelConfig:
+        resp = self._client.call(m.ParallelConfigRequest(node_id=self.node_id))
+        return resp if isinstance(resp, m.ParallelConfig) else m.ParallelConfig()
+
+    def close(self) -> None:
+        self._client.close()
+
+
+_client_lock = threading.Lock()
+_client: Optional[MasterClient] = None
+
+
+def build_master_client(
+    master_addr: str = "", node_id: Optional[int] = None
+) -> MasterClient:
+    """Process-wide singleton (reference ``build_master_client :480``);
+    defaults from the agent-provided env contract."""
+    global _client
+    with _client_lock:
+        if _client is None:
+            addr = master_addr or get_master_addr()
+            nid = node_id if node_id is not None else get_node_id()
+            if not addr:
+                raise RuntimeError(
+                    "no master address: set DLROVER_TPU_MASTER_ADDR or pass "
+                    "master_addr"
+                )
+            _client = MasterClient(addr, nid)
+        return _client
+
+
+def reset_master_client() -> None:
+    global _client
+    with _client_lock:
+        if _client is not None:
+            _client.close()
+        _client = None

@@ -1,0 +1,390 @@
+"""Elastic training agent: the per-node supervisor process.
+
+Parity with reference ``elastic_agent/torch/training.py``
+(``ElasticLaunchConfig :143``, ``MasterRendezvousHandler :217``,
+``ElasticTrainingAgent :405``, ``launch_agent :1098``) re-designed for the
+JAX runtime: instead of torchelastic's c10d store bootstrap, a completed
+master rendezvous elects a **JAX coordinator** (rank-0 node, fresh port per
+round) and assigns contiguous ``process_id`` s; workers then run
+``jax.distributed.initialize``.  A membership change or worker failure tears
+the round down and re-forms the world (JAX requires runtime re-init +
+recompile — the flash-checkpoint shm restore hides the state reload,
+SURVEY.md §7 "hard parts").
+
+Agent responsibilities each round (reference ``_invoke_run :863``):
+  rendezvous -> spawn workers -> monitor (exit codes, heartbeats,
+  membership) -> on failure: breakpoint-save + diagnose -> restart/relaunch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import (
+    DiagnosisActionType,
+    NodeEnv,
+    NodeStatus,
+    RendezvousName,
+)
+from dlrover_tpu.common.env import worker_env
+from dlrover_tpu.common.global_context import get_context
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import find_free_port, local_ip
+
+
+@dataclasses.dataclass
+class ElasticLaunchConfig:
+    """Launch knobs (reference ``ElasticLaunchConfig :143`` +
+    ``auto_configure_params :186``)."""
+
+    min_nodes: int = 1
+    max_nodes: int = 1
+    nproc_per_node: int = 1
+    node_id: int = 0
+    node_rank: int = 0
+    max_restarts: int = 3
+    monitor_interval: float = 2.0
+    rdzv_timeout: float = 600.0
+    network_check: bool = False
+    comm_perf_test: bool = False
+    log_dir: str = ""
+    job_name: str = "local-job"
+    slice_id: str = ""
+
+    def auto_configure(self) -> None:
+        """Fill derived params from env (chips per host etc.)."""
+        env_chips = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        if self.slice_id == "":
+            self.slice_id = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+
+
+class WorkerProcess:
+    def __init__(self, local_rank: int, proc: subprocess.Popen, log_file=None):
+        self.local_rank = local_rank
+        self.proc = proc
+        self.log_file = log_file
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+
+class RunResult:
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    MEMBERSHIP_CHANGED = "membership_changed"
+    STOP_JOB = "stop_job"
+    RESTART_REQUESTED = "restart_requested"
+
+
+class ElasticTrainingAgent:
+    """One agent per node; supervises ``nproc_per_node`` worker processes
+    running the user script (reference ``ElasticTrainingAgent :405``)."""
+
+    def __init__(
+        self,
+        config: ElasticLaunchConfig,
+        entrypoint: List[str],
+        master_addr: str,
+        client: Optional[MasterClient] = None,
+    ):
+        self.config = config
+        self.entrypoint = entrypoint
+        self.master_addr = master_addr
+        self.client = client or MasterClient(master_addr, config.node_id)
+        self._ctx = get_context()
+        self._workers: List[WorkerProcess] = []
+        self._stop_evt = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._pending_action: Optional[str] = None
+        self._restart_count = 0
+        self._host = local_ip()
+        self._rdzv_round = -1
+        # Hooks the checkpoint saver plugs into (task: flash checkpoint).
+        self.on_workers_stopping = None  # callable(reason) before kill
+        self.saver = None  # AsyncCheckpointSaver, attached by launcher
+
+    # -- heartbeats --------------------------------------------------------
+    def _start_heartbeat(self) -> None:
+        if self._hb_thread is not None:
+            return
+
+        def loop():
+            while not self._stop_evt.wait(self._ctx.node_heartbeat_interval):
+                try:
+                    actions = self.client.report_heartbeat()
+                    for a in actions:
+                        if a.action_type != DiagnosisActionType.NONE:
+                            logger.info("heartbeat action: %s (%s)",
+                                        a.action_type, a.reason)
+                            self._pending_action = a.action_type
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("heartbeat failed: %s", e)
+
+        self._hb_thread = threading.Thread(
+            target=loop, name="agent-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
+
+    # -- rendezvous (reference MasterRendezvousHandler.next_rendezvous) ----
+    def _rendezvous(self) -> dict:
+        """Join + poll until this node is in a completed world.
+
+        Returns {round, world, my_rank, coordinator, num_processes}.
+        """
+        cfg = self.config
+        coord_port = find_free_port()
+        self.client.register_node(
+            node_rank=cfg.node_rank,
+            host=self._host,
+            agent_port=coord_port,
+            slice_id=cfg.slice_id,
+            local_world_size=cfg.nproc_per_node,
+        )
+        self.client.join_rendezvous(
+            cfg.node_rank, cfg.nproc_per_node,
+            rdzv_name=RendezvousName.TRAINING, slice_id=cfg.slice_id,
+        )
+        deadline = time.time() + cfg.rdzv_timeout
+        while time.time() < deadline:
+            round_, _, world, coordinator = self.client.get_comm_world(
+                RendezvousName.TRAINING
+            )
+            if world:
+                my_rank = None
+                for rank, meta in world.items():
+                    if meta["node_id"] == cfg.node_id:
+                        my_rank = int(rank)
+                        break
+                if my_rank is None:
+                    # Completed without us (node-unit cut) - keep waiting for
+                    # the next round.
+                    time.sleep(1.0)
+                    continue
+                num_processes = sum(
+                    w["local_world_size"] for w in world.values()
+                )
+                self._rdzv_round = round_
+                logger.info(
+                    "rendezvous round %d: world=%d nodes, my_rank=%d, "
+                    "coordinator=%s", round_, len(world), my_rank, coordinator,
+                )
+                return {
+                    "round": round_,
+                    "world": world,
+                    "my_rank": my_rank,
+                    "coordinator": coordinator,
+                    "num_processes": num_processes,
+                }
+            time.sleep(0.5)
+        raise TimeoutError(
+            f"rendezvous did not complete within {cfg.rdzv_timeout}s"
+        )
+
+    # -- worker lifecycle ---------------------------------------------------
+    def _start_workers(self, world_info: dict) -> None:
+        cfg = self.config
+        world = world_info["world"]
+        my = world[world_info["my_rank"]]
+        base = my["process_id_base"]
+        self._workers = []
+        # Workers run `python script.py`, whose sys.path[0] is the script's
+        # dir; make the launcher's cwd and this framework importable
+        # (torchrun's PYTHONPATH contract).
+        import dlrover_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+            dlrover_tpu.__file__)))
+        extra_path = [os.getcwd(), pkg_root]
+        for lr in range(cfg.nproc_per_node):
+            env = dict(os.environ)
+            old_pp = env.get("PYTHONPATH", "")
+            env["PYTHONPATH"] = os.pathsep.join(
+                [p for p in extra_path if p]
+                + ([old_pp] if old_pp else [])
+            )
+            env.update(
+                worker_env(
+                    job_name=cfg.job_name,
+                    master_addr=self.master_addr,
+                    node_id=cfg.node_id,
+                    node_rank=world_info["my_rank"],
+                    node_num=len(world),
+                    process_id=base + lr,
+                    num_processes=world_info["num_processes"],
+                    coordinator=world_info["coordinator"],
+                    restart_count=self._restart_count,
+                )
+            )
+            env["DLROVER_TPU_LOCAL_RANK"] = str(lr)
+            env["DLROVER_TPU_LOCAL_WORLD_SIZE"] = str(cfg.nproc_per_node)
+            env["DLROVER_TPU_RDZV_ROUND"] = str(world_info["round"])
+            log_file = None
+            stdout = stderr = None
+            if cfg.log_dir:
+                os.makedirs(cfg.log_dir, exist_ok=True)
+                path = os.path.join(
+                    cfg.log_dir,
+                    f"worker_r{world_info['my_rank']}_l{lr}"
+                    f"_round{world_info['round']}.log",
+                )
+                log_file = open(path, "ab")
+                stdout = stderr = log_file
+            proc = subprocess.Popen(
+                self.entrypoint,
+                env=env,
+                stdout=stdout,
+                stderr=stderr,
+                start_new_session=True,  # own process group for clean kill
+            )
+            self._workers.append(WorkerProcess(lr, proc, log_file))
+        logger.info(
+            "started %d worker(s): pids=%s",
+            len(self._workers), [w.proc.pid for w in self._workers],
+        )
+
+    def _stop_workers(self, reason: str = "", grace: float = 10.0) -> None:
+        if not self._workers:
+            return
+        if self.on_workers_stopping is not None:
+            try:
+                self.on_workers_stopping(reason)
+            except Exception:  # noqa: BLE001
+                logger.exception("on_workers_stopping hook failed")
+        for w in self._workers:
+            if w.poll() is None:
+                try:
+                    os.killpg(os.getpgid(w.proc.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.time() + grace
+        for w in self._workers:
+            remaining = max(0.1, deadline - time.time())
+            try:
+                w.proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(w.proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                w.proc.wait()
+        for w in self._workers:
+            if w.log_file:
+                w.log_file.close()
+        logger.info("stopped workers (%s)", reason or "requested")
+        self._workers = []
+
+    # -- monitor loop (reference training.py:886) ---------------------------
+    def _monitor(self) -> str:
+        cfg = self.config
+        while True:
+            time.sleep(cfg.monitor_interval)
+            # 1. master-pushed actions (via heartbeat thread)
+            action = self._pending_action
+            self._pending_action = None
+            if action == DiagnosisActionType.STOP_JOB:
+                return RunResult.STOP_JOB
+            if action in (
+                DiagnosisActionType.RESTART_WORKER,
+                DiagnosisActionType.RELAUNCH_WORKER,
+            ):
+                return RunResult.RESTART_REQUESTED
+            # 2. worker process health
+            codes = [w.poll() for w in self._workers]
+            if all(c == 0 for c in codes):
+                return RunResult.SUCCEEDED
+            if any(c is not None and c != 0 for c in codes):
+                bad = [
+                    (w.local_rank, c)
+                    for w, c in zip(self._workers, codes)
+                    if c not in (None, 0)
+                ]
+                logger.warning("worker failure(s): %s", bad)
+                return RunResult.FAILED
+            # 3. membership change -> re-rendezvous (reference
+            #    _membership_changed :1028)
+            try:
+                if self.client.num_nodes_waiting(RendezvousName.TRAINING) > 0:
+                    return RunResult.MEMBERSHIP_CHANGED
+            except Exception as e:  # noqa: BLE001
+                logger.warning("num_nodes_waiting failed: %s", e)
+
+    # -- main entry (reference _invoke_run :863) ----------------------------
+    def run(self) -> int:
+        cfg = self.config
+        self._start_heartbeat()
+        # Flash-checkpoint saver daemon: lives in the agent so persistence
+        # survives worker crashes (reference start_async_saving_ckpt :869).
+        if self.saver is None:
+            try:
+                from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+                self.saver = AsyncCheckpointSaver(
+                    cfg.job_name, cfg.nproc_per_node,
+                    master_client=self.client,
+                )
+                self.saver.start()
+                self.on_workers_stopping = self.saver.save_shm_to_storage
+            except Exception:  # noqa: BLE001
+                logger.exception("could not start async checkpoint saver")
+        try:
+            while True:
+                world_info = self._rendezvous()
+                self.client.report_node_status(NodeStatus.RUNNING)
+                self._start_workers(world_info)
+                result = self._monitor()
+                if result == RunResult.SUCCEEDED:
+                    self._stop_workers("success", grace=5.0)
+                    self.client.report_node_status(NodeStatus.SUCCEEDED)
+                    logger.info("node %d training succeeded", cfg.node_id)
+                    return 0
+                if result == RunResult.STOP_JOB:
+                    self._stop_workers("stop-job")
+                    self.client.report_node_status(
+                        NodeStatus.FAILED, exit_reason="stopped_by_master"
+                    )
+                    return 1
+                if result == RunResult.FAILED:
+                    self._restart_count += 1
+                    self.client.report_failure(
+                        f"worker failure (restart {self._restart_count}/"
+                        f"{cfg.max_restarts})",
+                        restart_count=self._restart_count,
+                    )
+                    if self._restart_count > cfg.max_restarts:
+                        self._stop_workers("restart budget exhausted")
+                        self.client.report_node_status(
+                            NodeStatus.FAILED, exit_reason="max_restarts"
+                        )
+                        return 1
+                    self._stop_workers("worker failure; re-rendezvous")
+                elif result in (
+                    RunResult.MEMBERSHIP_CHANGED,
+                    RunResult.RESTART_REQUESTED,
+                ):
+                    logger.info("restarting workers: %s", result)
+                    self._stop_workers(result)
+                # loop -> new rendezvous round
+        finally:
+            self._stop_evt.set()
+            self._stop_workers("agent exiting")
+            if self.saver is not None:
+                self.saver.stop()
+
+
+def launch_agent(
+    config: ElasticLaunchConfig,
+    entrypoint: List[str],
+    master_addr: str,
+) -> int:
+    """Build and run the agent (reference ``launch_agent :1098``)."""
+    agent = ElasticTrainingAgent(config, entrypoint, master_addr)
+    return agent.run()

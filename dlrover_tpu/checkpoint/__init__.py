@@ -1,0 +1,14 @@
+"""Flash Checkpoint: async shared-memory pytree checkpointing.
+
+TPU-native re-design of the reference's Flash Checkpoint (SURVEY.md §3.2,
+``trainer/torch/flash_checkpoint/`` + ``elastic_agent/torch/ckpt_saver.py``):
+workers stage the addressable shards of a sharded JAX pytree into a POSIX shm
+arena (microseconds-to-milliseconds of step blocking), an async daemon
+persists shm -> storage with a done-file commit protocol, and restore prefers
+the still-warm shm arena (seconds) over storage (minutes) — including
+**reshard-on-restore** when the world changed (Tenplex-style; the reference
+sidesteps this with fixed-world restarts).
+"""
+
+from dlrover_tpu.checkpoint.checkpointer import FlashCheckpointer  # noqa: F401
+from dlrover_tpu.checkpoint.engine import CheckpointEngine  # noqa: F401

@@ -1,0 +1,60 @@
+"""User-facing Flash Checkpointer.
+
+Parity with the reference's per-framework checkpointers
+(``flash_checkpoint/ddp.py:25 DdpCheckpointer`` etc.) — in the TPU build one
+class covers every parallelism since state is always a sharded pytree
+(GSPMD erases the DDP/FSDP/Megatron distinction the reference needs five
+engines for).
+
+Usage::
+
+    ckpt = FlashCheckpointer("/ckpt/run1")
+    ckpt.save(state, meta={"step": step})                # shm only (fast path)
+    ckpt.save(state, meta={"step": step}, storage=True)  # + async persist
+    restored = ckpt.load(target=state)                   # warm shm else disk
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+from dlrover_tpu.common.storage import CheckpointStorage
+
+
+class FlashCheckpointer:
+    def __init__(
+        self,
+        ckpt_dir: str,
+        *,
+        job_name: str = "",
+        storage: Optional[CheckpointStorage] = None,
+        master_client=None,
+    ):
+        self.engine = CheckpointEngine(
+            ckpt_dir,
+            job_name=job_name,
+            storage=storage,
+            master_client=master_client,
+        )
+
+    def save(
+        self,
+        state: Any,
+        meta: Optional[dict] = None,
+        storage: bool = False,
+    ) -> None:
+        step = int((meta or {}).get("step", 0))
+        if storage:
+            self.engine.save_to_storage(step, state, meta)
+        else:
+            self.engine.save_to_memory(step, state, meta)
+
+    def load(self, target: Any = None) -> Optional[Tuple[Any, dict]]:
+        return self.engine.load(target)
+
+    def wait(self, timeout: float = 600.0) -> bool:
+        return self.engine.wait(timeout)
+
+    def close(self) -> None:
+        self.engine.close()

@@ -1,0 +1,303 @@
+"""Worker-side checkpoint engine: pytree -> shm arena -> (async) storage.
+
+Parity with reference ``trainer/torch/flash_checkpoint/engine.py:136``
+(``save_to_memory :417``, ``save_to_storage :435``, ``load :454``) +
+``full_ckpt_engine.py``, TPU-native: the state is a sharded JAX pytree; each
+process stages only its **addressable shards** (no gather, no host blowup),
+with ``copy_to_host_async`` overlapping D2H against the step.
+
+Two runtime modes, auto-detected:
+
+- **agent mode** (production): the per-node agent runs an
+  ``AsyncCheckpointSaver`` hosting the event queue / fencing locks; persisting
+  shm -> storage happens in the *agent process*, so a crashing worker loses
+  nothing (breakpoint-save, reference ``save_shm_to_storage :701``).
+- **standalone mode** (no agent): a daemon thread in the worker persists; the
+  shm arena still survives worker death, so warm restart works either way.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from dlrover_tpu.checkpoint import shard_file, tree_utils
+from dlrover_tpu.common import env as env_utils
+from dlrover_tpu.common.global_context import get_context
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.multi_process import (
+    SharedDict,
+    SharedLock,
+    SharedQueue,
+    socket_path,
+)
+from dlrover_tpu.common.shm import SharedMemoryArena, arena_name
+from dlrover_tpu.common.storage import CheckpointStorage, PosixDiskStorage
+
+
+def ckpt_queue_name(job_name: str) -> str:
+    return f"{job_name}-ckptq"
+
+
+def ckpt_lock_name(job_name: str, local_rank: int) -> str:
+    return f"{job_name}-ckptlock-{local_rank}"
+
+
+def ckpt_stat_name(job_name: str) -> str:
+    return f"{job_name}-ckptstat"
+
+
+class CheckpointEngine:
+    def __init__(
+        self,
+        ckpt_dir: str,
+        *,
+        job_name: str = "",
+        storage: Optional[CheckpointStorage] = None,
+        master_client=None,
+    ):
+        self.ckpt_dir = ckpt_dir
+        self.job_name = job_name or env_utils.get_job_name()
+        self.storage = storage or PosixDiskStorage()
+        self.client = master_client
+        self._ctx = get_context()
+        self.process_id = env_utils.get_process_id()
+        self.num_processes = env_utils.get_num_processes()
+        self.local_rank = int(os.environ.get("DLROVER_TPU_LOCAL_RANK", 0))
+        self._arena = SharedMemoryArena(
+            arena_name(self.job_name, self.local_rank)
+        )
+        self._last_saved_step = -1
+        self._last_persist_step = -1
+
+        self.agent_mode = os.path.exists(
+            socket_path("queue", ckpt_queue_name(self.job_name))
+        )
+        self._queue: Optional[SharedQueue] = None
+        self._lock: Optional[SharedLock] = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._futures: list = []
+        if self.agent_mode:
+            self._queue = SharedQueue(ckpt_queue_name(self.job_name))
+            self._lock = SharedLock(
+                ckpt_lock_name(self.job_name, self.local_rank)
+            )
+            logger.info("checkpoint engine in agent mode")
+        else:
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-persist"
+            )
+
+    # -- save ---------------------------------------------------------------
+    def _stage(self, step: int, state: Any, meta: Optional[dict]) -> Tuple[
+        Dict[str, np.ndarray], dict
+    ]:
+        # Overlap all D2H copies before the synchronous flatten walk.
+        def _prefetch(x):
+            if isinstance(x, jax.Array):
+                try:
+                    x.copy_to_host_async()
+                except Exception:  # noqa: BLE001
+                    pass
+            return None
+
+        jax.tree_util.tree_map(_prefetch, state)
+        tensors, info = tree_utils.flatten_to_shards(state)
+        extra = {
+            "step": step,
+            "meta": meta or {},
+            "tensors_info": info,
+            "process_id": self.process_id,
+            "num_processes": self.num_processes,
+            "ckpt_dir": self.ckpt_dir,
+            "time": time.time(),
+        }
+        if self._lock is not None:
+            acquired = self._lock.acquire(timeout=60.0)
+            if not acquired:
+                raise TimeoutError("could not acquire checkpoint shm lock")
+        try:
+            self._arena.write_state(tensors, extra=extra)
+        finally:
+            if self._lock is not None:
+                self._lock.release()
+        self._last_saved_step = step
+        return tensors, extra
+
+    def save_to_memory(
+        self, step: int, state: Any, meta: Optional[dict] = None
+    ) -> None:
+        """Stage into shm only — microseconds of training pause; the state
+        survives worker crash/restart on this host."""
+        t0 = time.perf_counter()
+        self._stage(step, state, meta)
+        logger.info(
+            "flash ckpt: staged step %d to shm in %.3fs",
+            step, time.perf_counter() - t0,
+        )
+
+    def save_to_storage(
+        self, step: int, state: Any, meta: Optional[dict] = None
+    ) -> None:
+        """Stage into shm + request async persistence."""
+        tensors, extra = self._stage(step, state, meta)
+        if self.agent_mode:
+            self._queue.put(
+                {
+                    "event": "save",
+                    "step": step,
+                    "local_rank": self.local_rank,
+                    "process_id": self.process_id,
+                    "num_processes": self.num_processes,
+                    "ckpt_dir": self.ckpt_dir,
+                }
+            )
+        else:
+            fut = self._pool.submit(
+                self._persist, step, tensors, dict(extra)
+            )
+            self._futures.append((step, fut))
+
+    def _persist(self, step: int, tensors, extra) -> None:
+        try:
+            shard_file.write_shard(
+                self.storage, self.ckpt_dir, step, self.process_id,
+                tensors, extra,
+            )
+            self._last_persist_step = step
+            if self.process_id == 0:
+                self._commit_when_ready(step)
+        except Exception:  # noqa: BLE001
+            logger.exception("checkpoint persist of step %d failed", step)
+
+    def _commit_when_ready(self, step: int, timeout: float = 600.0) -> bool:
+        """Leader: wait for every process's done file (optionally gated by
+        the master's cross-node step barrier), then advance the tracker."""
+        deadline = time.time() + timeout
+        if self.client is not None:
+            while time.time() < deadline:
+                if self.client.sync_checkpoint(step):
+                    break
+                time.sleep(0.5)
+        while time.time() < deadline:
+            if shard_file.all_shards_done(
+                self.storage, self.ckpt_dir, step, self.num_processes
+            ):
+                shard_file.commit(self.storage, self.ckpt_dir, step)
+                return True
+            time.sleep(0.5)
+        logger.warning("commit of step %d timed out", step)
+        return False
+
+    def wait(self, timeout: float = 600.0) -> bool:
+        """Block until the last storage save is fully committed."""
+        for step, fut in self._futures:
+            try:
+                fut.result(timeout=timeout)
+            except Exception:  # noqa: BLE001
+                logger.exception("pending persist failed")
+        self._futures = []
+        if self._last_saved_step < 0:
+            return True
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            committed = shard_file.latest_step(self.storage, self.ckpt_dir)
+            if committed is not None and committed >= self._last_persist_step:
+                return True
+            if self.agent_mode:
+                stat = SharedDict(ckpt_stat_name(self.job_name))
+                try:
+                    done = stat.get(f"persisted_{self.local_rank}", -1)
+                    if done is not None and int(done) >= self._last_saved_step:
+                        return True
+                except Exception:  # noqa: BLE001
+                    pass
+            time.sleep(0.5)
+        return False
+
+    # -- load ---------------------------------------------------------------
+    def load(
+        self, target: Any = None
+    ) -> Optional[Tuple[Any, dict]]:
+        """Restore the newest available state: shm (warm) else storage.
+
+        With ``target`` given, returns (pytree-like-target, meta); without,
+        returns (ShardSource, meta) for caller-side assembly."""
+        got = self._load_from_shm()
+        if got is None:
+            got = self._load_from_storage()
+        if got is None:
+            return None
+        source, extra = got
+        meta = extra.get("meta", {})
+        meta.setdefault("step", extra.get("step", 0))
+        if target is None:
+            return source, meta
+        state = tree_utils.restore_to_target(target, source)
+        return state, meta
+
+    def _load_from_shm(self):
+        try:
+            self._arena.reopen()
+            read = self._arena.read_state(copy=True)
+        except (FileNotFoundError, OSError):
+            return None  # no arena yet: first run on this host
+        except Exception:  # noqa: BLE001
+            logger.exception("shm restore failed; trying storage")
+            return None
+        if read is None:
+            return None
+        tensors, extra = read
+        info = extra.get("tensors_info", {})
+        if not info:
+            return None
+        # A warm restore is only valid for the same world size — a changed
+        # world's local shards won't match this process's old layout;
+        # storage has every process's shards for true resharding.
+        if extra.get("num_processes") != self.num_processes or extra.get(
+            "process_id"
+        ) != self.process_id:
+            logger.info(
+                "shm state belongs to another world layout "
+                "(proc %s/%s vs %s/%s); falling back to storage",
+                extra.get("process_id"), extra.get("num_processes"),
+                self.process_id, self.num_processes,
+            )
+            return None
+        source = tree_utils.ShardSource()
+        source.add(tensors, info)
+        logger.info(
+            "flash ckpt: warm restore from shm (step %s)", extra.get("step")
+        )
+        return source, extra
+
+    def _load_from_storage(self):
+        step = shard_file.latest_step(self.storage, self.ckpt_dir)
+        if step is None:
+            return None
+        source = tree_utils.ShardSource()
+        extra_out = None
+        for pid in shard_file.list_shard_ids(self.storage, self.ckpt_dir, step):
+            got = shard_file.read_shard(self.storage, self.ckpt_dir, step, pid)
+            if got is None:
+                continue
+            tensors, extra = got
+            source.add(tensors, extra.get("tensors_info", {}))
+            if pid == self.process_id or extra_out is None:
+                extra_out = extra
+        if extra_out is None:
+            return None
+        logger.info("flash ckpt: restore from storage step %d", step)
+        return source, extra_out
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        self._arena.close()

@@ -1,0 +1,158 @@
+"""On-disk shard file format + the commit protocol helpers.
+
+One shard file per process per step::
+
+    <ckpt_dir>/step_<N>/shard_<process_id>.ckpt     (header|meta|tensor data)
+    <ckpt_dir>/step_<N>/.done_<process_id>          (done file, commit vote)
+    <ckpt_dir>/step_<N>/checkpoint.meta             (world info, leader)
+    <ckpt_dir>/latest_checkpointed_step.txt         (tracker, written last)
+
+Mirrors the reference's done-file + tracker commit
+(``ckpt_saver.py commit_checkpoint :822``): a step directory is valid iff the
+tracker names it, and the tracker is only advanced after every shard's done
+file exists — a crash mid-persist leaves the previous step intact.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from typing import Dict, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+from dlrover_tpu.common.constants import CheckpointConstant as CC
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.storage import CheckpointStorage
+
+_MAGIC = b"DLRTPUF1"
+
+
+def step_dir(ckpt_dir: str, step: int) -> str:
+    return os.path.join(ckpt_dir, f"step_{step:010d}")
+
+
+def shard_path(ckpt_dir: str, step: int, process_id: int) -> str:
+    return os.path.join(step_dir(ckpt_dir, step), f"shard_{process_id:05d}.ckpt")
+
+
+def done_path(ckpt_dir: str, step: int, process_id: int) -> str:
+    return os.path.join(step_dir(ckpt_dir, step), f".done_{process_id:05d}")
+
+
+def tracker_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, CC.TRACKER_FILE)
+
+
+def pack_shard(tensors: Dict[str, np.ndarray], extra: dict) -> bytes:
+    metas = {}
+    blobs = []
+    offset = 0
+    for key, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        try:
+            dtype_key = (
+                arr.dtype.name
+                if np.dtype(arr.dtype.name) == arr.dtype
+                else arr.dtype.str
+            )
+        except TypeError:
+            dtype_key = arr.dtype.str
+        metas[key] = {
+            "dtype": dtype_key,
+            "shape": list(arr.shape),
+            "offset": offset,
+            "nbytes": int(arr.nbytes),
+        }
+        blobs.append(arr.reshape(-1).view(np.uint8).tobytes())
+        offset += arr.nbytes
+    meta_blob = msgpack.packb(
+        {"tensors": metas, "extra": extra}, use_bin_type=True
+    )
+    header = _MAGIC + struct.pack("<Q", len(meta_blob))
+    return header + meta_blob + b"".join(blobs)
+
+
+def unpack_shard(data: bytes) -> Tuple[Dict[str, np.ndarray], dict]:
+    if data[:8] != _MAGIC:
+        raise ValueError("not a dlrover_tpu shard file")
+    (meta_len,) = struct.unpack("<Q", data[8:16])
+    meta = msgpack.unpackb(data[16 : 16 + meta_len], raw=False)
+    base = 16 + meta_len
+    tensors = {}
+    for key, tm in meta["tensors"].items():
+        start = base + tm["offset"]
+        buf = data[start : start + tm["nbytes"]]
+        tensors[key] = np.frombuffer(buf, dtype=np.dtype(tm["dtype"])).reshape(
+            tm["shape"]
+        ).copy()
+    return tensors, meta["extra"]
+
+
+def write_shard(
+    storage: CheckpointStorage,
+    ckpt_dir: str,
+    step: int,
+    process_id: int,
+    tensors: Dict[str, np.ndarray],
+    extra: dict,
+) -> None:
+    storage.safe_makedirs(step_dir(ckpt_dir, step))
+    storage.write(pack_shard(tensors, extra), shard_path(ckpt_dir, step, process_id))
+    storage.write(str(time.time()), done_path(ckpt_dir, step, process_id))
+
+
+def read_shard(
+    storage: CheckpointStorage, ckpt_dir: str, step: int, process_id: int
+) -> Optional[Tuple[Dict[str, np.ndarray], dict]]:
+    data = storage.read(shard_path(ckpt_dir, step, process_id))
+    if data is None:
+        return None
+    return unpack_shard(data)
+
+
+def list_shard_ids(storage: CheckpointStorage, ckpt_dir: str, step: int) -> list:
+    out = []
+    for name in storage.listdir(step_dir(ckpt_dir, step)):
+        if name.startswith("shard_") and name.endswith(".ckpt"):
+            out.append(int(name[len("shard_") : -len(".ckpt")]))
+    return sorted(out)
+
+
+def all_shards_done(
+    storage: CheckpointStorage, ckpt_dir: str, step: int, world_size: int
+) -> bool:
+    return all(
+        storage.exists(done_path(ckpt_dir, step, pid))
+        for pid in range(world_size)
+    )
+
+
+def commit(
+    storage: CheckpointStorage, ckpt_dir: str, step: int, keep_last: int = 3
+) -> None:
+    """Advance the tracker and GC old step dirs (leader only)."""
+    storage.write(str(step), tracker_path(ckpt_dir))
+    logger.info("checkpoint step %d committed at %s", step, ckpt_dir)
+    steps = []
+    for name in storage.listdir(ckpt_dir):
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name[len("step_"):]))
+            except ValueError:
+                pass
+    for old in sorted(steps)[:-keep_last] if keep_last > 0 else []:
+        if old != step:
+            storage.safe_rmtree(step_dir(ckpt_dir, old))
+
+
+def latest_step(storage: CheckpointStorage, ckpt_dir: str) -> Optional[int]:
+    content = storage.read(tracker_path(ckpt_dir), mode="r")
+    if not content:
+        return None
+    try:
+        return int(str(content).strip())
+    except ValueError:
+        return None

@@ -1,0 +1,169 @@
+"""Pytree <-> flat shard-dict conversion for checkpointing.
+
+The staging format is a flat ``{"<path>|<k>": np.ndarray}`` dict plus
+per-tensor placement info (global shape + index slices), so that
+
+- each *process* stores exactly its addressable shards (no gather),
+- restore can re-assemble **any** target sharding from the pieces available
+  (same-world: exact index match; changed-world: overlap copy — the
+  resharding restore SURVEY.md §7 calls out as a hard part).
+
+Restore is target-driven (orbax-style): the caller supplies a pytree of
+jax.Arrays / ShapeDtypeStructs whose structure names the paths.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+from jax.tree_util import keystr, tree_flatten_with_path, tree_unflatten
+
+
+def _norm_index(index, shape) -> Tuple[Tuple[int, int], ...]:
+    """Normalize a shard index (tuple of slices) to ((start, stop), ...)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def flatten_to_shards(
+    state: Any,
+) -> Tuple[Dict[str, np.ndarray], Dict[str, dict]]:
+    """Flatten a pytree of arrays into this process's shard dict.
+
+    Returns (tensors, info): ``tensors["path|k"]`` is the k-th unique local
+    shard of leaf ``path``; ``info["path|k"]`` records global_shape + index.
+    """
+    leaves = tree_flatten_with_path(state)[0]
+    tensors: Dict[str, np.ndarray] = {}
+    info: Dict[str, dict] = {}
+    for path, leaf in leaves:
+        name = keystr(path)
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+            gshape = tuple(leaf.shape)
+            seen = {}
+            for shard in leaf.addressable_shards:
+                idx = _norm_index(shard.index, gshape)
+                if idx in seen:
+                    continue
+                seen[idx] = np.asarray(shard.data)
+            for k, (idx, arr) in enumerate(sorted(seen.items())):
+                key = f"{name}|{k}"
+                tensors[key] = arr
+                info[key] = {
+                    "path": name,
+                    "global_shape": list(gshape),
+                    "index": [list(p) for p in idx],
+                }
+        else:
+            arr = np.asarray(leaf)
+            key = f"{name}|0"
+            tensors[key] = arr
+            info[key] = {
+                "path": name,
+                "global_shape": list(arr.shape),
+                "index": [[0, d] for d in arr.shape],
+            }
+    return tensors, info
+
+
+class ShardSource:
+    """All pieces known for the leaves of one checkpoint (possibly from
+    several processes' shard files)."""
+
+    def __init__(self):
+        # path -> list of (index, np.ndarray)
+        self.pieces: Dict[str, List[Tuple[Tuple[Tuple[int, int], ...], np.ndarray]]] = {}
+
+    def add(self, tensors: Dict[str, np.ndarray], info: Dict[str, dict]) -> None:
+        for key, arr in tensors.items():
+            meta = info.get(key)
+            if meta is None:
+                continue
+            idx = tuple(tuple(p) for p in meta["index"])
+            self.pieces.setdefault(meta["path"], []).append((idx, arr))
+
+    def paths(self) -> List[str]:
+        return list(self.pieces.keys())
+
+    def assemble(
+        self, path: str, index: Tuple[Tuple[int, int], ...], dtype=None
+    ) -> Optional[np.ndarray]:
+        """Build the sub-array of leaf ``path`` covering ``index`` from the
+        available pieces.  Exact-match fast path; otherwise overlap-copy
+        (resharding).  Returns None if any region is uncovered."""
+        pieces = self.pieces.get(path)
+        if not pieces:
+            return None
+        for idx, arr in pieces:
+            if idx == index:
+                return arr
+        shape = tuple(e - s for s, e in index)
+        out = np.empty(shape, dtype=dtype or pieces[0][1].dtype)
+        covered = np.zeros(shape, dtype=bool) if out.size else None
+        for idx, arr in pieces:
+            # Overlap of [idx] and [index] in global coords.
+            dst_sl, src_sl = [], []
+            ok = True
+            for (ps, pe), (rs, re) in zip(idx, index):
+                lo, hi = max(ps, rs), min(pe, re)
+                if lo >= hi:
+                    ok = False
+                    break
+                dst_sl.append(slice(lo - rs, hi - rs))
+                src_sl.append(slice(lo - ps, hi - ps))
+            if not ok:
+                continue
+            out[tuple(dst_sl)] = arr[tuple(src_sl)]
+            if covered is not None:
+                covered[tuple(dst_sl)] = True
+        if covered is not None and not covered.all():
+            return None
+        return out
+
+
+def restore_to_target(
+    target: Any, source: ShardSource
+) -> Any:
+    """Fill ``target`` (pytree of jax.Array / ShapeDtypeStruct / np arrays)
+    from ``source``.  jax.Array targets are rebuilt shard-by-shard on their
+    existing devices+sharding; others become full np arrays."""
+    flat, treedef = jax.tree_util.tree_flatten(target)
+    paths_leaves = tree_flatten_with_path(target)[0]
+    out_leaves = []
+    for (path, leaf) in paths_leaves:
+        name = keystr(path)
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            sharding = leaf.sharding
+            gshape = tuple(leaf.shape)
+            arrays = []
+            devices = []
+            for shard in leaf.addressable_shards:
+                idx = _norm_index(shard.index, gshape)
+                piece = source.assemble(name, idx, dtype=leaf.dtype)
+                if piece is None:
+                    raise KeyError(
+                        f"checkpoint missing data for {name} index {idx}"
+                    )
+                arrays.append(jax.device_put(piece, shard.device))
+                devices.append(shard.device)
+            restored = jax.make_array_from_single_device_arrays(
+                gshape, sharding, arrays
+            )
+            out_leaves.append(restored)
+        else:
+            shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+            full_idx = tuple((0, d) for d in shape)
+            piece = source.assemble(
+                name, full_idx, dtype=getattr(leaf, "dtype", None)
+            )
+            if piece is None:
+                raise KeyError(f"checkpoint missing data for {name}")
+            out_leaves.append(piece)
+    return tree_unflatten(treedef, out_leaves)

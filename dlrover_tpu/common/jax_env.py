@@ -1,0 +1,55 @@
+"""JAX platform/bootstrap helpers shared by workers, tests and bench.
+
+Some PJRT plugin shims prepend their platform to ``jax_platforms`` at import
+time, overriding the ``JAX_PLATFORMS`` env var (observed with tunneled-TPU
+plugins).  ``ensure_platform`` re-asserts the env var's choice explicitly so
+``JAX_PLATFORMS=cpu`` behaves as documented; call it after ``import jax`` and
+before first backend use.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def ensure_platform(platform: Optional[str] = None) -> None:
+    """Force the jax platform list to ``platform`` (default: the
+    ``JAX_PLATFORMS`` env var, if set).  No-op when neither is given."""
+    want = platform or os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    cur = jax.config.jax_platforms
+    if cur != want:
+        jax.config.update("jax_platforms", want)
+
+
+def initialize_distributed_from_env() -> bool:
+    """Run ``jax.distributed.initialize`` from the agent-provided env
+    contract (reference analogue: torchelastic's c10d store bootstrap, here
+    replaced by master rendezvous -> coordinator election, SURVEY.md §5
+    'Distributed communication backend').
+
+    Returns True if a multi-process runtime was initialized.
+    """
+    from dlrover_tpu.common.env import (
+        get_coordinator,
+        get_num_processes,
+        get_process_id,
+    )
+
+    ensure_platform()
+    coordinator = get_coordinator()
+    nproc = get_num_processes()
+    if not coordinator or nproc <= 1:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=nproc,
+        process_id=get_process_id(),
+    )
+    return True

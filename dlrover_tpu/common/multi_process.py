@@ -31,6 +31,20 @@ from dlrover_tpu.common.log import logger
 _SOCK_DIR = os.environ.get("DLROVER_TPU_SOCK_DIR", "/tmp/dlrover_tpu_sock")
 
 
+def _proc_start_time(pid: int) -> Optional[int]:
+    """Process start time in clock ticks (/proc/<pid>/stat field 22) — the
+    (pid, starttime) pair uniquely identifies a process across PID reuse."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode("utf-8", "replace")
+        # Field 2 (comm) may contain spaces/parens; fields after the last
+        # ')' are well-formed.
+        rest = stat.rsplit(")", 1)[1].split()
+        return int(rest[19])  # field 22 overall
+    except (OSError, IndexError, ValueError):
+        return None
+
+
 def socket_path(kind: str, name: str) -> str:
     os.makedirs(_SOCK_DIR, exist_ok=True)
     path = os.path.join(_SOCK_DIR, f"{kind}_{name}.sock")
@@ -61,7 +75,7 @@ def _recv_msg(sock: socket.socket) -> Any:
         if not chunk:
             raise ConnectionError("socket closed")
         buf += chunk
-    return msgpack.unpackb(bytes(buf), raw=False)
+    return msgpack.unpackb(bytes(buf), raw=False, strict_map_key=False)
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -173,10 +187,39 @@ class SharedLockServer(LocalSocketServer):
         self._cond = threading.Condition()
         super().__init__(name)
 
+    @staticmethod
+    def _holder_alive(holder: Optional[str]) -> bool:
+        # Holders are "pid-<pid>-<starttime>" on this host; a holder whose
+        # process died (e.g. a worker SIGKILLed mid-checkpoint) must not
+        # wedge the lock.  The start time guards against PID reuse: a
+        # recycled pid has a different /proc start time.
+        if not holder or not holder.startswith("pid-"):
+            return True
+        parts = holder.split("-")
+        try:
+            pid = int(parts[1])
+            os.kill(pid, 0)
+        except (ProcessLookupError, ValueError, IndexError):
+            return False
+        except PermissionError:
+            return True
+        if len(parts) >= 3:
+            start = _proc_start_time(pid)
+            if start is not None and str(start) != parts[2]:
+                return False  # pid was recycled
+        return True
+
     def op_acquire(self, holder: str, blocking: bool, timeout: float) -> bool:
         deadline = time.time() + timeout
         with self._cond:
             while self._owner is not None and self._owner != holder:
+                if not self._holder_alive(self._owner):
+                    logger.warning(
+                        "lock %s: stealing from dead holder %s",
+                        self.name, self._owner,
+                    )
+                    self._owner = None
+                    break
                 if not blocking:
                     return False
                 remaining = deadline - time.time()
@@ -208,7 +251,8 @@ class SharedLock:
         self.name = name
         self._server = SharedLockServer(name) if create else None
         self._client = _Client(SharedLockServer.KIND, name)
-        self._holder = f"pid-{os.getpid()}"
+        start = _proc_start_time(os.getpid())
+        self._holder = f"pid-{os.getpid()}-{start if start is not None else 0}"
 
     def acquire(self, blocking: bool = True, timeout: float = 60.0) -> bool:
         return bool(

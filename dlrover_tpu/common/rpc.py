@@ -161,10 +161,13 @@ class RpcClient:
             except grpc.RpcError as e:
                 last_err = e
                 code = e.code() if hasattr(e, "code") else None
-                if code in (
-                    grpc.StatusCode.UNAVAILABLE,
-                    grpc.StatusCode.DEADLINE_EXCEEDED,
-                ):
+                # Only UNAVAILABLE (connection-level, request not executed)
+                # is retried.  DEADLINE_EXCEEDED may mean the master already
+                # executed the request — re-sending would double-execute
+                # non-idempotent ops (kv add, task fetch, rendezvous join).
+                if code == grpc.StatusCode.UNAVAILABLE:
+                    if attempt + 1 >= retries:
+                        break
                     sleep = min(backoff * (2**attempt), 8.0)
                     logger.warning(
                         "RPC %s to %s failed (%s), retry %d/%d in %.1fs",

@@ -38,8 +38,12 @@ MAGIC = 0x44_4C_52_54_50_55_01_00  # "DLRTPU\x01\x00"
 HEADER_SIZE = 64
 DEFAULT_META_CAPACITY = 8 << 20  # 8 MB of msgpack metadata
 # header: magic u64 | data_capacity u64 | meta_capacity u64 | meta_len u64 |
-#         commit_count u64 | meta_crc u32 | pad
-_HEADER_FMT = "<QQQQQI"
+#         commit_count u64 | meta_crc u32 | dirty u32 | pad
+# ``dirty`` is set before tensor bytes are overwritten and cleared by the
+# final header write: a writer killed mid-write leaves dirty=1, and readers
+# treat the arena as holding no valid state (tensor bytes are torn; the CRC
+# only covers the meta blob).
+_HEADER_FMT = "<QQQQQII"
 
 
 @dataclasses.dataclass
@@ -237,6 +241,19 @@ class SharedMemoryArena:
             self._seg_stat = _shm_stat(self.name)
         seg = self._seg
 
+        # Mark the write in progress BEFORE touching tensor bytes, so a
+        # writer killed mid-copy cannot be mistaken for a committed state
+        # (the fencing lock may be stolen from a dead holder).
+        prev = self._read_header()
+        prev_commit = prev[4] if prev else 0
+        dirty_header = struct.pack(
+            _HEADER_FMT, MAGIC, seg.size, self._meta_capacity,
+            prev[3] if prev else 0, prev_commit, prev[5] if prev else 0, 1,
+        )
+        seg.buf[: len(dirty_header)] = np.frombuffer(
+            dirty_header, dtype=np.uint8
+        )
+
         offset = HEADER_SIZE + self._meta_capacity
         metas: Dict[str, dict] = {}
         for path, arr in flat.items():
@@ -273,16 +290,15 @@ class SharedMemoryArena:
             meta_blob, dtype=np.uint8
         )
         crc = seg.crc32(HEADER_SIZE, len(meta_blob))
-        prev = self._read_header()
-        commit = (prev[4] + 1) if prev else 1
         header = struct.pack(
             _HEADER_FMT,
             MAGIC,
             seg.size,
             self._meta_capacity,
             len(meta_blob),
-            commit,
+            prev_commit + 1,
             crc,
+            0,  # clear dirty: state is consistent again
         )
         seg.buf[: len(header)] = np.frombuffer(header, dtype=np.uint8)
 
@@ -326,14 +342,20 @@ class SharedMemoryArena:
         hdr = self._read_header()
         if hdr is None:
             return None
-        _, data_cap, meta_cap, meta_len, commit, crc = hdr
+        _, data_cap, meta_cap, meta_len, commit, crc, dirty = hdr
+        if dirty:
+            logger.warning(
+                "shm arena %s: writer died mid-write (dirty); no valid state",
+                self.name,
+            )
+            return None
         if commit == 0 or meta_len == 0:
             return None
         if self._seg.crc32(HEADER_SIZE, meta_len) != crc:
             logger.warning("shm arena %s: meta crc mismatch (torn write?)", self.name)
             return None
         blob = bytes(self._seg.buf[HEADER_SIZE : HEADER_SIZE + meta_len])
-        meta = msgpack.unpackb(blob, raw=False)
+        meta = msgpack.unpackb(blob, raw=False, strict_map_key=False)
         meta["commit_count"] = commit
         return meta
 

@@ -1,0 +1,1 @@
+"""Elastic data input: dynamic sharding client + elastic dataloaders."""

@@ -1,0 +1,102 @@
+"""Worker-side dynamic sharding client.
+
+Parity with reference ``elastic_agent/sharding/client.py`` (``ShardingClient
+:29``, ``IndexShardingClient :234``): workers *pull* index shards from the
+master's task manager instead of owning a static partition, report completion,
+and can checkpoint/restore the dataset position — the input-pipeline half of
+elasticity.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.log import logger
+
+
+class ShardingClient:
+    """Task-level client: one task == one index shard [start, end)."""
+
+    def __init__(
+        self,
+        client: MasterClient,
+        dataset_name: str,
+        *,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        storage_type: str = "table",
+        batch_size: int = 0,
+    ):
+        self._client = client
+        self.dataset_name = dataset_name
+        self._lock = threading.Lock()
+        self._current_task = None
+        client.report_dataset_shard_params(
+            dataset_name=dataset_name,
+            dataset_size=dataset_size,
+            shard_size=shard_size,
+            num_epochs=num_epochs,
+            shuffle=shuffle,
+            storage_type=storage_type,
+            batch_size=batch_size,
+        )
+
+    def fetch_task(self):
+        task = self._client.get_task(self.dataset_name)
+        if task.task_id < 0:
+            return None
+        with self._lock:
+            self._current_task = task
+        return task
+
+    def report_task_done(self, task_id: int, success: bool = True) -> None:
+        self._client.report_task_result(
+            self.dataset_name, task_id, success=success
+        )
+        with self._lock:
+            if self._current_task is not None and (
+                self._current_task.task_id == task_id
+            ):
+                self._current_task = None
+
+    def checkpoint(self) -> str:
+        return self._client.get_shard_checkpoint(self.dataset_name)
+
+    def restore(self, content: str) -> bool:
+        return self._client.restore_shard_checkpoint(self.dataset_name, content)
+
+
+class IndexShardingClient(ShardingClient):
+    """Record-index iterator over dynamically fetched shards
+    (reference ``IndexShardingClient :234``).
+
+    ``iter_indices`` yields global record indices; each exhausted shard is
+    acked so the master can account completion, and a crash before the ack
+    re-queues the whole shard (at-least-once delivery — pair with stateless
+    or idempotent batch consumption).
+    """
+
+    def iter_indices(self) -> Iterator[int]:
+        while True:
+            task = self.fetch_task()
+            if task is None:
+                return
+            for idx in range(task.start, task.end):
+                yield idx
+            self.report_task_done(task.task_id)
+
+    def iter_batches(self, batch_size: int) -> Iterator[List[int]]:
+        """Yield fixed-size index batches, spanning shard boundaries;
+        trailing partial batch is yielded last."""
+        batch: List[int] = []
+        for idx in self.iter_indices():
+            batch.append(idx)
+            if len(batch) == batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch

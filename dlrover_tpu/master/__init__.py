@@ -1,0 +1,7 @@
+"""L3 job master: the per-job control-plane brain.
+
+Composes the RPC servicer, rendezvous managers, KV store, sync service,
+dynamic-data-sharding task manager, speed monitor, node/job manager,
+auto-scaler and diagnosis manager (SURVEY.md §1 L3, reference
+``dlrover/python/master/``).
+"""

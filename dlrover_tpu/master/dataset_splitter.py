@@ -1,0 +1,130 @@
+"""Dataset splitters: carve a dataset into index shards.
+
+Parity with reference ``master/shard/dataset_splitter.py`` (``DatasetSplitter``
+ABC, ``TableDatasetSplitter:144``, ``TextDatasetSplitter:257``,
+``StreamingDatasetSplitter:359``).  A *shard* is an index range [start, end)
+(optionally with record indices for shuffled text data); the task manager
+dispatches shards as tasks and re-queues those of failed workers.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import random
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class Shard:
+    name: str
+    start: int
+    end: int
+    record_indices: Optional[List[int]] = None
+
+
+class DatasetSplitter(abc.ABC):
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int = 1):
+        self.dataset_name = dataset_name
+        self.dataset_size = dataset_size
+        self.shard_size = shard_size
+        self.num_epochs = num_epochs
+        self.epoch = 0
+
+    @abc.abstractmethod
+    def create_shards(self) -> List[Shard]: ...
+
+    def epoch_finished(self) -> bool:
+        return self.epoch >= self.num_epochs
+
+
+class TableDatasetSplitter(DatasetSplitter):
+    """Contiguous range shards over a table-like dataset
+    (reference ``TableDatasetSplitter:144``)."""
+
+    def create_shards(self) -> List[Shard]:
+        self.epoch += 1
+        shards = []
+        for i, start in enumerate(range(0, self.dataset_size, self.shard_size)):
+            end = min(start + self.shard_size, self.dataset_size)
+            shards.append(Shard(f"{self.dataset_name}-e{self.epoch}-{i}", start, end))
+        return shards
+
+
+class TextDatasetSplitter(DatasetSplitter):
+    """Shards with explicit (optionally shuffled) record indices
+    (reference ``TextDatasetSplitter:257``)."""
+
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int = 1, shuffle: bool = False, seed: int = 0):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self.shuffle = shuffle
+        self._seed = seed
+
+    def create_shards(self) -> List[Shard]:
+        self.epoch += 1
+        indices = list(range(self.dataset_size))
+        if self.shuffle:
+            # Deterministic per-epoch shuffle: a restarted master recreates
+            # identical shards for the same epoch (resume-safety).
+            random.Random(self._seed + self.epoch).shuffle(indices)
+        shards = []
+        for i, start in enumerate(range(0, self.dataset_size, self.shard_size)):
+            end = min(start + self.shard_size, self.dataset_size)
+            shards.append(
+                Shard(
+                    f"{self.dataset_name}-e{self.epoch}-{i}",
+                    start,
+                    end,
+                    record_indices=indices[start:end],
+                )
+            )
+        return shards
+
+
+class StreamingDatasetSplitter(DatasetSplitter):
+    """Unbounded stream: shards are generated on demand from a moving offset
+    (reference ``StreamingDatasetSplitter:359``)."""
+
+    def __init__(self, dataset_name: str, shard_size: int, start_offset: int = 0,
+                 fetch_batch: int = 8):
+        super().__init__(dataset_name, dataset_size=-1, shard_size=shard_size,
+                         num_epochs=1)
+        self._offset = start_offset
+        self._fetch_batch = fetch_batch
+
+    def create_shards(self) -> List[Shard]:
+        shards = []
+        for i in range(self._fetch_batch):
+            shards.append(
+                Shard(
+                    f"{self.dataset_name}-s{self._offset}",
+                    self._offset,
+                    self._offset + self.shard_size,
+                )
+            )
+            self._offset += self.shard_size
+        return shards
+
+    def epoch_finished(self) -> bool:
+        return False  # streams never end by epoch
+
+
+def new_dataset_splitter(
+    *,
+    dataset_name: str,
+    dataset_size: int,
+    shard_size: int,
+    num_epochs: int = 1,
+    shuffle: bool = False,
+    storage_type: str = "table",
+) -> DatasetSplitter:
+    """Factory (reference ``new_dataset_splitter``)."""
+    if storage_type == "text":
+        return TextDatasetSplitter(
+            dataset_name, dataset_size, shard_size, num_epochs, shuffle
+        )
+    if storage_type == "stream":
+        return StreamingDatasetSplitter(dataset_name, shard_size)
+    return TableDatasetSplitter(dataset_name, dataset_size, shard_size, num_epochs)

@@ -1,0 +1,74 @@
+"""Master-hosted KV store — the collective-bootstrap plane.
+
+Parity with reference ``master/elastic_training/kv_store_service.py:18`` +
+the agent-side ``MasterKVStore`` (a torch ``Store`` backed by master RPCs,
+``elastic_agent/torch/master_kv_store.py``).  In the TPU build this carries
+rank/port exchange before ``jax.distributed.initialize`` and any user-level
+cross-process key exchange; it replaces etcd/c10d-TCPStore so the master is
+the only stateful control-plane service.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class KVStoreService:
+    def __init__(self) -> None:
+        self._store: Dict[str, bytes] = {}
+        self._cond = threading.Condition()
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._cond:
+            self._store[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._cond:
+            return self._store.get(key)
+
+    def wait(self, keys: List[str], timeout: float = 60.0) -> bool:
+        """Block until all ``keys`` exist (torch-Store ``wait`` semantics the
+        agent's KV client exposes)."""
+        deadline = time.time() + timeout
+        with self._cond:
+            while not all(k in self._store for k in keys):
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 1.0))
+            return True
+
+    def add(self, key: str, delta: int) -> int:
+        """Atomic counter (torch-Store ``add``)."""
+        with self._cond:
+            cur = int(self._store.get(key, b"0"))
+            cur += delta
+            self._store[key] = str(cur).encode()
+            self._cond.notify_all()
+            return cur
+
+    def multi_set(self, kvs: Dict[str, bytes]) -> None:
+        with self._cond:
+            self._store.update(kvs)
+            self._cond.notify_all()
+
+    def multi_get(self, keys: List[str]) -> Dict[str, bytes]:
+        with self._cond:
+            return {k: self._store[k] for k in keys if k in self._store}
+
+    def delete(self, key: str) -> bool:
+        with self._cond:
+            return self._store.pop(key, None) is not None
+
+    def clear(self, prefix: str = "") -> None:
+        """Drop keys (optionally by prefix) — used when a new rendezvous
+        round invalidates stale bootstrap data."""
+        with self._cond:
+            if not prefix:
+                self._store.clear()
+            else:
+                for k in [k for k in self._store if k.startswith(prefix)]:
+                    del self._store[k]

@@ -1,0 +1,69 @@
+"""Master process entry: ``python -m dlrover_tpu.master.main``.
+
+Parity with reference ``master/main.py:43``.  The ``tpurun`` launcher spawns
+this as a subprocess for standalone jobs; on GKE the operator-created master
+pod runs it with ``--platform gke``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dlrover_tpu.common.log import logger, set_role
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("dlrover_tpu master")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--job_name", default="local-job")
+    p.add_argument("--platform", default="local",
+                   choices=["local", "process", "gke", "ray"])
+    p.add_argument("--min_nodes", type=int, default=1)
+    p.add_argument("--max_nodes", type=int, default=1)
+    p.add_argument("--node_unit", type=int, default=1)
+    p.add_argument("--network_check", action="store_true")
+    p.add_argument("--port_file", default="",
+                   help="write the bound port to this file (for launchers)")
+    return p.parse_args(argv)
+
+
+def run(args: argparse.Namespace) -> int:
+    set_role("master")
+    if args.platform in ("local", "process"):
+        from dlrover_tpu.master.master import LocalJobMaster
+
+        master = LocalJobMaster(
+            args.port,
+            job_name=args.job_name,
+            min_nodes=args.min_nodes,
+            max_nodes=args.max_nodes,
+            node_unit=args.node_unit,
+            network_check=args.network_check,
+        )
+    else:
+        from dlrover_tpu.master.dist_master import DistributedJobMaster
+
+        master = DistributedJobMaster(
+            args.port,
+            job_name=args.job_name,
+            platform=args.platform,
+            min_nodes=args.min_nodes,
+            max_nodes=args.max_nodes,
+            node_unit=args.node_unit,
+            network_check=args.network_check,
+        )
+    master.prepare()
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(str(master.port))
+    logger.info("master listening on port %d", master.port)
+    return master.run()
+
+
+def main() -> None:
+    sys.exit(run(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+"""Job master composition and run loop.
+
+Parity with reference ``master/master.py:17`` (``JobMaster`` ABC),
+``local_master.py:38`` (``LocalJobMaster``) and the run-loop shape of
+``dist_master.py:89/:226``.  The local master serves a single-host job —
+`tpurun --standalone` spawns it as a subprocess — and is also the in-process
+test fixture (SURVEY.md §4: "in-process local master as fixture").
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.constants import JobExitReason, JobStage, RendezvousName
+from dlrover_tpu.common.global_context import get_context
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RpcServer
+from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.node_manager import LocalJobManager
+from dlrover_tpu.master.rendezvous import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.sync_service import SyncService
+from dlrover_tpu.master.task_manager import TaskManager
+
+
+class JobMaster(abc.ABC):
+    @abc.abstractmethod
+    def prepare(self) -> None: ...
+
+    @abc.abstractmethod
+    def run(self) -> int: ...
+
+    @abc.abstractmethod
+    def stop(self) -> None: ...
+
+    @abc.abstractmethod
+    def request_stop(self, success: bool, reason: str) -> None: ...
+
+
+class LocalJobMaster(JobMaster):
+    """Single-host master: RPC server + all managers, no platform scaler.
+
+    ``port=0`` binds a free port (then read :attr:`port`).
+    """
+
+    def __init__(
+        self,
+        port: int = 0,
+        *,
+        job_name: str = "local-job",
+        min_nodes: int = 1,
+        max_nodes: int = 1,
+        node_unit: int = 1,
+        network_check: bool = False,
+        run_config: Optional[dict] = None,
+    ):
+        self.job_name = job_name
+        self._ctx = get_context()
+        self.run_config = run_config or {}
+        self.stage = JobStage.INIT
+        self._exit_code = 0
+        self._exit_reason = ""
+        self._stop_event = threading.Event()
+
+        self.task_manager = TaskManager()
+        self.job_manager = LocalJobManager(job_name)
+        self.speed_monitor = SpeedMonitor()
+        self.kv_store = KVStoreService()
+        self.sync_service = SyncService()
+        self.rdzv_managers = {
+            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+        }
+        for mgr in self.rdzv_managers.values():
+            mgr.update_rdzv_params(min_nodes, max_nodes, node_unit=node_unit)
+        self.diagnosis_manager = None  # attached by diagnosis module when used
+
+        self.servicer = MasterServicer(
+            task_manager=self.task_manager,
+            job_manager=self.job_manager,
+            rdzv_managers=self.rdzv_managers,
+            kv_store=self.kv_store,
+            sync_service=self.sync_service,
+            speed_monitor=self.speed_monitor,
+            diagnosis_manager=self.diagnosis_manager,
+            job_context=self,
+        )
+        self._server = RpcServer(port, self.servicer)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def prepare(self) -> None:
+        self.task_manager.start()
+        self.job_manager.start()
+        self._server.start()
+        self.stage = JobStage.RUNNING
+        logger.info("local master for %s ready on :%d", self.job_name, self.port)
+
+    def run(self) -> int:
+        """Block until the job finishes (reference run loop
+        ``dist_master.py:226``)."""
+        try:
+            while not self._stop_event.wait(2.0):
+                if self.job_manager.all_workers_exited():
+                    success = self.job_manager.all_workers_succeeded()
+                    self.request_stop(
+                        success,
+                        JobExitReason.SUCCEEDED
+                        if success
+                        else JobExitReason.NODE_ERROR,
+                    )
+        finally:
+            self.stop()
+        return self._exit_code
+
+    def request_stop(self, success: bool, reason: str) -> None:
+        if self.stage == JobStage.STOPPING:
+            return
+        self.stage = JobStage.STOPPING
+        self._exit_code = 0 if success else 1
+        self._exit_reason = reason
+        logger.info(
+            "master stopping: success=%s reason=%s goodput=%.3f",
+            success, reason, self.speed_monitor.goodput(),
+        )
+        self._stop_event.set()
+
+    def stop(self) -> None:
+        self.stage = JobStage.STOPPED
+        self.task_manager.stop()
+        self.job_manager.stop()
+        self._server.stop()
+
+
+def run_master_forever(master: JobMaster) -> int:
+    master.prepare()
+    return master.run()

@@ -1,0 +1,105 @@
+"""Training speed / goodput accounting.
+
+Parity with reference ``master/monitor/speed_monitor.py:45``
+(``collect_global_step :84``, ``running_speed :132``): tracks global step
+reports over a sliding window, computes steps/sec, and — new in the TPU
+build — **goodput**: the fraction of wall-clock time spent making new
+progress (the north-star metric, BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Deque, List, Optional, Tuple
+from collections import deque
+
+from dlrover_tpu.common.global_context import get_context
+
+
+class SpeedMonitor:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ctx = get_context()
+        self._records: Deque[Tuple[float, int]] = deque(
+            maxlen=self._ctx.train_speed_record_num
+        )
+        self._global_step = 0
+        self._first_step_time: Optional[float] = None
+        self._last_step_time: Optional[float] = None
+        self._start_time = time.time()
+        # Downtime accounting for goodput: intervals with no step progress
+        # (rendezvous, restarts, recompiles).
+        self._downtime_total = 0.0
+        self._down_since: Optional[float] = None
+        self._sample_count = 0
+
+    def collect_global_step(self, step: int, timestamp: float = 0.0) -> None:
+        ts = timestamp or time.time()
+        with self._lock:
+            if step <= self._global_step:
+                return
+            self._global_step = step
+            self._records.append((ts, step))
+            if self._first_step_time is None:
+                self._first_step_time = ts
+            self._last_step_time = ts
+            self._sample_count += 1
+            if self._down_since is not None:
+                self._downtime_total += ts - self._down_since
+                self._down_since = None
+
+    def mark_down(self) -> None:
+        """Called when the job manager knows training paused (restart,
+        rendezvous)."""
+        with self._lock:
+            if self._down_since is None:
+                self._down_since = time.time()
+
+    def mark_up(self) -> None:
+        with self._lock:
+            if self._down_since is not None:
+                self._downtime_total += time.time() - self._down_since
+                self._down_since = None
+
+    @property
+    def completed_global_step(self) -> int:
+        with self._lock:
+            return self._global_step
+
+    def running_speed(self) -> float:
+        """Steps/sec over the sliding window (reference ``running_speed``)."""
+        with self._lock:
+            if len(self._records) < 2:
+                return 0.0
+            (t0, s0), (t1, s1) = self._records[0], self._records[-1]
+            if t1 <= t0:
+                return 0.0
+            return (s1 - s0) / (t1 - t0)
+
+    def goodput(self) -> float:
+        """useful-time / elapsed-time since first step (BASELINE.md metric)."""
+        with self._lock:
+            if self._first_step_time is None:
+                return 0.0
+            now = time.time()
+            elapsed = now - self._first_step_time
+            down = self._downtime_total
+            if self._down_since is not None:
+                down += now - self._down_since
+            if elapsed <= 0:
+                return 0.0
+            return max(0.0, min(1.0, (elapsed - down) / elapsed))
+
+    def hang_detected(self, timeout: Optional[float] = None) -> bool:
+        """No step progress for longer than ``hang_timeout_s`` while steps
+        had been flowing (feeds the diagnosis chain)."""
+        with self._lock:
+            if self._last_step_time is None:
+                return False
+            t = timeout if timeout is not None else self._ctx.hang_timeout_s
+            return time.time() - self._last_step_time > t
+
+    def reset_running_speed_monitor(self) -> None:
+        with self._lock:
+            self._records.clear()

@@ -1,0 +1,48 @@
+"""Named barriers across workers (reference
+``master/elastic_training/sync_service.py:26``).
+
+A worker joins a named sync; when every node currently in the training world
+has joined (or the owner explicitly finishes it), the barrier opens.  Used
+e.g. to align all nodes before a mesh re-layout or a coordinated checkpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Set
+
+
+class SyncService:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._syncs: Dict[str, Set[int]] = {}
+        self._finished: Set[str] = set()
+        # The rendezvous manager tells us the current world membership.
+        self._world_nodes: Set[int] = set()
+
+    def set_world(self, node_ids) -> None:
+        with self._lock:
+            self._world_nodes = set(node_ids)
+
+    def join_sync(self, sync_name: str, node_id: int) -> bool:
+        with self._lock:
+            members = self._syncs.setdefault(sync_name, set())
+            members.add(node_id)
+            if self._world_nodes and self._world_nodes.issubset(members):
+                self._finished.add(sync_name)
+            return True
+
+    def sync_finished(self, sync_name: str) -> bool:
+        with self._lock:
+            return sync_name in self._finished
+
+    def finish_sync(self, sync_name: str) -> bool:
+        """Force-open a barrier (owner override, reference ``barrier``)."""
+        with self._lock:
+            self._finished.add(sync_name)
+            return True
+
+    def remove_sync(self, sync_name: str) -> None:
+        with self._lock:
+            self._syncs.pop(sync_name, None)
+            self._finished.discard(sync_name)

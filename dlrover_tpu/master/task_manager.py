@@ -1,0 +1,220 @@
+"""Dynamic data sharding: the master dispatches index shards as tasks.
+
+Parity with reference ``master/shard/task_manager.py:37`` +
+``batch_dataset_manager.py:29`` + ``base_dataset_manager.py:60``:
+workers pull tasks (shards) instead of owning a static partition, so
+
+- a failed/slow worker's in-flight shards are re-queued and re-dispatched
+  (``recover_tasks :169``, ``_check_and_reassign_timeout_tasks :216``),
+- scaling up/down needs no re-partitioning,
+- dataset position is checkpointable (todo + doing -> resume exactly).
+
+This is the elasticity mechanism for the input pipeline; the model-state
+elasticity lives in rendezvous + flash checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.dataset_splitter import DatasetSplitter, Shard
+
+
+@dataclasses.dataclass
+class DoingTask:
+    task_id: int
+    worker_id: int
+    start_time: float
+    shard: Shard
+    task_type: str = "training"
+
+
+class DatasetManager:
+    """One dataset's task queues (reference ``BatchDatasetManager:29``)."""
+
+    def __init__(self, splitter: DatasetSplitter, task_timeout: float = 1800.0):
+        self.splitter = splitter
+        self._task_timeout = task_timeout
+        self._todo: List[tuple] = []  # (task_id, Shard)
+        self._doing: Dict[int, DoingTask] = {}
+        self._task_id_seq = 0
+        self._completed_ids: set = set()
+        self._dispatched = 0
+
+    # -- queue ops ---------------------------------------------------------
+    def _refill_if_empty(self) -> None:
+        if not self._todo and not self.splitter.epoch_finished():
+            for shard in self.splitter.create_shards():
+                self._todo.append((self._task_id_seq, shard))
+                self._task_id_seq += 1
+
+    def get_task(self, worker_id: int, task_type: str = "training"):
+        self._refill_if_empty()
+        if not self._todo:
+            return None
+        task_id, shard = self._todo.pop(0)
+        self._doing[task_id] = DoingTask(
+            task_id, worker_id, time.time(), shard, task_type
+        )
+        self._dispatched += 1
+        return task_id, shard, self.splitter.epoch
+
+    def report_task_result(self, task_id: int, success: bool) -> None:
+        doing = self._doing.pop(task_id, None)
+        if doing is None:
+            return
+        if success:
+            self._completed_ids.add(task_id)
+        else:
+            self._todo.insert(0, (task_id, doing.shard))
+
+    def recover_worker_tasks(self, worker_id: int) -> int:
+        """Re-queue the in-flight shards of a dead worker
+        (reference ``recover_tasks :169``)."""
+        recovered = 0
+        for task_id in list(self._doing.keys()):
+            if self._doing[task_id].worker_id == worker_id:
+                doing = self._doing.pop(task_id)
+                self._todo.insert(0, (task_id, doing.shard))
+                recovered += 1
+        return recovered
+
+    def reassign_timeout_tasks(self) -> int:
+        now = time.time()
+        n = 0
+        for task_id in list(self._doing.keys()):
+            if now - self._doing[task_id].start_time > self._task_timeout:
+                doing = self._doing.pop(task_id)
+                self._todo.insert(0, (task_id, doing.shard))
+                n += 1
+        return n
+
+    def completed(self) -> bool:
+        self._refill_if_empty()
+        return (
+            not self._todo and not self._doing and self.splitter.epoch_finished()
+        )
+
+    # -- checkpoint (reference DatasetShardCheckpoint) ----------------------
+    def checkpoint(self) -> str:
+        todo = [(tid, dataclasses.asdict(s)) for tid, s in self._todo]
+        doing = [
+            (t.task_id, dataclasses.asdict(t.shard)) for t in self._doing.values()
+        ]
+        return json.dumps(
+            {
+                "dataset_name": self.splitter.dataset_name,
+                "todo": todo + doing,  # doing counts as not-done on resume
+                "epoch": self.splitter.epoch,
+                "task_id_seq": self._task_id_seq,
+            }
+        )
+
+    def restore(self, content: str) -> None:
+        data = json.loads(content)
+        self._todo = [
+            (tid, Shard(**shard)) for tid, shard in data.get("todo", [])
+        ]
+        self._doing.clear()
+        self.splitter.epoch = data.get("epoch", 0)
+        self._task_id_seq = data.get("task_id_seq", len(self._todo))
+
+
+class TaskManager:
+    """All datasets of one job + the timeout-reassignment loop
+    (reference ``TaskManager:37``)."""
+
+    def __init__(self, task_timeout: float = 1800.0):
+        self._lock = threading.Lock()
+        self._datasets: Dict[str, DatasetManager] = {}
+        self._task_timeout = task_timeout
+        self._worker_last_task: Dict[int, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def new_dataset(self, splitter: DatasetSplitter) -> None:
+        with self._lock:
+            if splitter.dataset_name not in self._datasets:
+                self._datasets[splitter.dataset_name] = DatasetManager(
+                    splitter, self._task_timeout
+                )
+                logger.info("task manager: registered dataset %s",
+                            splitter.dataset_name)
+
+    def has_dataset(self, name: str) -> bool:
+        with self._lock:
+            return name in self._datasets
+
+    def get_task(self, dataset_name: str, worker_id: int):
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return None
+            self._worker_last_task[worker_id] = time.time()
+            return ds.get_task(worker_id)
+
+    def report_task_result(
+        self, dataset_name: str, task_id: int, success: bool
+    ) -> None:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is not None:
+                ds.report_task_result(task_id, success)
+
+    def recover_worker_tasks(self, worker_id: int) -> int:
+        with self._lock:
+            return sum(
+                ds.recover_worker_tasks(worker_id)
+                for ds in self._datasets.values()
+            )
+
+    def dataset_completed(self, dataset_name: str) -> bool:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            return ds.completed() if ds is not None else True
+
+    def all_completed(self) -> bool:
+        with self._lock:
+            return bool(self._datasets) and all(
+                ds.completed() for ds in self._datasets.values()
+            )
+
+    def checkpoint_dataset(self, dataset_name: str) -> str:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            return ds.checkpoint() if ds is not None else ""
+
+    def restore_dataset(self, dataset_name: str, content: str) -> bool:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None or not content:
+                return False
+            ds.restore(content)
+            return True
+
+    # -- background loop ---------------------------------------------------
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._reassign_loop, name="task-reassign", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _reassign_loop(self) -> None:
+        while not self._stop.wait(30.0):
+            with self._lock:
+                for name, ds in self._datasets.items():
+                    n = ds.reassign_timeout_tasks()
+                    if n:
+                        logger.warning(
+                            "task manager: re-queued %d timed-out tasks of %s",
+                            n, name,
+                        )

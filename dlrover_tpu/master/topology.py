@@ -1,0 +1,55 @@
+"""Topology-aware rank assignment.
+
+Parity with reference ``master/elastic_training/net_topology.py``
+(``NodeTopologyMeta:20``, ``DpTopologySorter:50``), re-cast for TPU fabric:
+the reference sorts ranks so nodes under one access switch (asw) are
+contiguous; the TPU analogue sorts so hosts of one **ICI-connected slice**
+are contiguous, with slices ordered among themselves — data-parallel
+neighbours then communicate over ICI and the inter-slice (DCN) hop only
+carries the outermost collective segments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+
+@dataclasses.dataclass
+class NodeTopologyMeta:
+    node_id: int
+    node_rank: int
+    process_unit_size: int  # local world size (procs or chips per host)
+    slice_id: str = ""  # ICI domain (TPU slice); '' = unknown
+    host_id: str = ""  # physical host; distinguishes VMs on one host
+
+
+class TopologySorter:
+    """Base sorter: identity order (stable by node_rank)."""
+
+    def sort(self, nodes: Dict[int, NodeTopologyMeta]) -> List[NodeTopologyMeta]:
+        return sorted(nodes.values(), key=lambda n: n.node_rank)
+
+
+class DpTopologySorter(TopologySorter):
+    """Group hosts by slice so each slice's hosts get contiguous node ranks
+    (reference ``DpTopologySorter.sort`` groups by asw switch).
+
+    Slices are ordered by (size desc, slice_id) so the largest ICI domains
+    sit at the front — rank 0 (the JAX coordinator and usually the
+    checkpoint leader) lands in the biggest healthy slice.
+    """
+
+    def sort(self, nodes: Dict[int, NodeTopologyMeta]) -> List[NodeTopologyMeta]:
+        groups: Dict[str, List[NodeTopologyMeta]] = {}
+        for meta in nodes.values():
+            groups.setdefault(meta.slice_id, []).append(meta)
+        for members in groups.values():
+            members.sort(key=lambda n: (n.host_id, n.node_rank))
+        ordered_groups = sorted(
+            groups.items(), key=lambda kv: (-len(kv[1]), kv[0])
+        )
+        out: List[NodeTopologyMeta] = []
+        for _, members in ordered_groups:
+            out.extend(members)
+        return out

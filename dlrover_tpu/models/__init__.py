@@ -1,0 +1,3 @@
+"""Model zoo: functional JAX models with explicit parameter pytrees and
+partition-spec trees, so the parallel layer can shard them without
+framework-specific introspection."""

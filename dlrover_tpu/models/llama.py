@@ -1,0 +1,323 @@
+"""Llama-family decoder LM — the flagship model (BASELINE.json configs[1]).
+
+Functional JAX, TPU-first (analogue of the reference's Llama2 ATorch example
+``atorch/examples/llama2`` + HF modeling it wraps): RMSNorm (fused Pallas op),
+RoPE, grouped-query attention with pluggable attention backends
+(XLA-fused reference / Pallas flash / ring for long context / Ulysses SP),
+SwiGLU MLP, optional MoE layers (expert-parallel), weight-untied LM head.
+
+Sharding: :func:`param_logical_axes` names every parameter with logical axes
+('embed'/'heads'/'mlp'/'vocab'/'expert'), mapped to mesh axes by
+``dlrover_tpu.parallel.sharding`` rules — DP/FSDP/TP/SP/EP are rule changes,
+not model changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
+from dlrover_tpu.ops.flash_attention import flash_attention
+from dlrover_tpu.ops.rmsnorm import rmsnorm
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    n_layer: int = 32
+    n_head: int = 32
+    n_kv_head: int = 32
+    d_model: int = 4096
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # MoE: >0 turns every `moe_every`-th MLP into an expert layer.
+    num_experts: int = 0
+    top_k: int = 2
+    moe_every: int = 2
+    capacity_factor: float = 1.25
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @classmethod
+    def llama2_7b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls, **over) -> "LlamaConfig":
+        base = dict(
+            vocab_size=256, n_layer=2, n_head=4, n_kv_head=2, d_model=64,
+            d_ff=128, max_seq_len=128,
+        )
+        base.update(over)
+        return cls(**base)
+
+    @classmethod
+    def small_300m(cls) -> "LlamaConfig":
+        return cls(
+            vocab_size=32000, n_layer=12, n_head=16, n_kv_head=16,
+            d_model=1024, d_ff=2816, max_seq_len=2048,
+        )
+
+
+def _dense(key, fan_in, fan_out, std=0.02):
+    return jax.random.normal(key, (fan_in, fan_out), jnp.float32) * std
+
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> Dict:
+    keys = jax.random.split(rng, cfg.n_layer + 3)
+    params: Dict = {
+        "embed": _dense(keys[0], cfg.vocab_size, cfg.d_model),
+        "lm_head": _dense(keys[1], cfg.d_model, cfg.vocab_size),
+        "ln_f": jnp.ones((cfg.d_model,), jnp.float32),
+        "layers": [],
+    }
+    hd = cfg.head_dim
+    for i in range(cfg.n_layer):
+        k = jax.random.split(keys[2 + i], 8)
+        layer = {
+            "ln1": jnp.ones((cfg.d_model,), jnp.float32),
+            "wq": _dense(k[0], cfg.d_model, cfg.n_head * hd),
+            "wk": _dense(k[1], cfg.d_model, cfg.n_kv_head * hd),
+            "wv": _dense(k[2], cfg.d_model, cfg.n_kv_head * hd),
+            "wo": _dense(k[3], cfg.n_head * hd, cfg.d_model),
+            "ln2": jnp.ones((cfg.d_model,), jnp.float32),
+        }
+        if cfg.num_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1):
+            layer["moe"] = {
+                "router": _dense(k[4], cfg.d_model, cfg.num_experts),
+                "wi": jax.random.normal(
+                    k[5], (cfg.num_experts, cfg.d_model, cfg.d_ff),
+                    jnp.float32) * 0.02,
+                "wg": jax.random.normal(
+                    k[6], (cfg.num_experts, cfg.d_model, cfg.d_ff),
+                    jnp.float32) * 0.02,
+                "wo": jax.random.normal(
+                    k[7], (cfg.num_experts, cfg.d_ff, cfg.d_model),
+                    jnp.float32) * 0.02,
+            }
+        else:
+            layer["mlp"] = {
+                "w_gate": _dense(k[4], cfg.d_model, cfg.d_ff),
+                "w_up": _dense(k[5], cfg.d_model, cfg.d_ff),
+                "w_down": _dense(k[6], cfg.d_ff, cfg.d_model),
+            }
+        params["layers"].append(layer)
+    return params
+
+
+def param_logical_axes(cfg: LlamaConfig) -> Dict:
+    """Logical-axis names per parameter (consumed by
+    ``parallel.sharding.tree_logical_to_specs``)."""
+
+    def layer_axes(has_moe: bool) -> Dict:
+        ax = {
+            "ln1": (None,),
+            "wq": ("embed", "heads"),
+            "wk": ("embed", "heads"),
+            "wv": ("embed", "heads"),
+            "wo": ("heads", "embed"),
+            "ln2": (None,),
+        }
+        if has_moe:
+            ax["moe"] = {
+                "router": (None, None),
+                "wi": ("expert", "embed", "expert_mlp"),
+                "wg": ("expert", "embed", "expert_mlp"),
+                "wo": ("expert", "expert_mlp", "embed"),
+            }
+        else:
+            ax["mlp"] = {
+                "w_gate": ("embed", "mlp"),
+                "w_up": ("embed", "mlp"),
+                "w_down": ("mlp", "embed"),
+            }
+        return ax
+
+    layers = []
+    for i in range(cfg.n_layer):
+        has_moe = cfg.num_experts > 0 and (
+            i % cfg.moe_every == cfg.moe_every - 1
+        )
+        layers.append(layer_axes(has_moe))
+    return {
+        "embed": ("vocab", "embed"),
+        "lm_head": ("embed", "vocab"),
+        "ln_f": (None,),
+        "layers": layers,
+    }
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [B, S, H, D]; rotate pairs (d, d + D/2)."""
+    B, S, H, D = x.shape
+    half = D // 2
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, S, 1, half]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def _attention(
+    x, layer, cfg: LlamaConfig, positions, attn_impl: str, mesh,
+):
+    B, S, C = x.shape
+    H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    dt = cfg.dtype
+    q = (x @ layer["wq"].astype(dt)).reshape(B, S, H, D)
+    k = (x @ layer["wk"].astype(dt)).reshape(B, S, KV, D)
+    v = (x @ layer["wv"].astype(dt)).reshape(B, S, KV, D)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if KV != H:  # GQA: repeat kv heads
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    if attn_impl == "ring" and mesh is not None:
+        from dlrover_tpu.parallel.ring_attention import ring_attention
+
+        out = ring_attention(q, k, v, mesh, causal=True)
+    elif attn_impl == "ulysses" and mesh is not None:
+        from dlrover_tpu.parallel.sequence import ulysses_attention
+
+        out = ulysses_attention(q, k, v, mesh, causal=True)
+    else:
+        # [B,S,H,D] -> [B,H,S,D] for the flash kernel.
+        o = flash_attention(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            causal=True,
+            backend=None if attn_impl == "auto" else attn_impl,
+        )
+        out = o.transpose(0, 2, 1, 3)
+    out = out.reshape(B, S, H * D)
+    return out @ layer["wo"].astype(dt)
+
+
+def _swiglu(x, mlp, dt):
+    g = x @ mlp["w_gate"].astype(dt)
+    u = x @ mlp["w_up"].astype(dt)
+    return (jax.nn.silu(g) * u) @ mlp["w_down"].astype(dt)
+
+
+def _moe_swiglu(x, moe, cfg: LlamaConfig):
+    """Expert-parallel SwiGLU MoE (dense capacity dispatch, see
+    ``parallel.moe`` for the mechanism)."""
+    B, S, C = x.shape
+    E, K = cfg.num_experts, cfg.top_k
+    N = B * S
+    dt = cfg.dtype
+    tokens = x.reshape(N, C)
+    logits = tokens.astype(jnp.float32) @ moe["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, -1, keepdims=True), 1e-9
+    )
+    capacity = int(max(1, round(cfg.capacity_factor * N * K / E)))
+    onehot_e = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)
+    pos = (jnp.cumsum(onehot_e.reshape(N * K, E), axis=0)
+           * onehot_e.reshape(N * K, E) - 1).reshape(N, K, E).sum(-1)
+    keep = pos < capacity
+    dispatch = (
+        jax.nn.one_hot(gate_idx, E, dtype=dt)[..., None]
+        * jax.nn.one_hot(pos, capacity, dtype=dt)[..., None, :]
+        * keep[..., None, None].astype(dt)
+    )  # [N, K, E, C]
+    xin = jnp.einsum("nd,nkec->ecd", tokens.astype(dt), dispatch)
+    g = jnp.einsum("ecd,edf->ecf", xin, moe["wg"].astype(dt))
+    u = jnp.einsum("ecd,edf->ecf", xin, moe["wi"].astype(dt))
+    h = jax.nn.silu(g) * u
+    xout = jnp.einsum("ecf,efd->ecd", h, moe["wo"].astype(dt))
+    combine = dispatch * gate_vals[..., None, None].astype(dt)
+    out = jnp.einsum("ecd,nkec->nd", xout, combine)
+    # Aux load-balance loss, returned via a side dict by forward().
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32), axis=0)
+    aux = E * jnp.sum(me * ce)
+    return out.reshape(B, S, C), aux
+
+
+def forward(
+    params: Dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    attn_impl: str = "auto",
+    mesh=None,
+) -> tuple:
+    """tokens [B, S] -> (logits [B, S, vocab] fp32, aux dict)."""
+    B, S = tokens.shape
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    moe_aux = jnp.zeros((), jnp.float32)
+    for layer in params["layers"]:
+        h = rmsnorm(x, layer["ln1"], eps=cfg.rms_eps)
+        x = x + _attention(h, layer, cfg, positions, attn_impl, mesh)
+        h = rmsnorm(x, layer["ln2"], eps=cfg.rms_eps)
+        if "moe" in layer:
+            delta, aux = _moe_swiglu(h, layer["moe"], cfg)
+            moe_aux = moe_aux + aux
+            x = x + delta
+        else:
+            x = x + _swiglu(h, layer["mlp"], dt)
+    x = rmsnorm(x, params["ln_f"], eps=cfg.rms_eps)
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"moe_aux": moe_aux}
+
+
+def loss_fn(
+    params: Dict,
+    batch: Dict[str, jax.Array],  # {"tokens": [B,S+1]} or tokens/targets
+    cfg: LlamaConfig,
+    *,
+    attn_impl: str = "auto",
+    mesh=None,
+    moe_aux_weight: float = 1e-2,
+) -> jax.Array:
+    if "targets" in batch:
+        tokens, targets = batch["tokens"], batch["targets"]
+    else:
+        tokens, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    logits, aux = forward(
+        params, tokens, cfg, attn_impl=attn_impl, mesh=mesh
+    )
+    ce = jnp.mean(softmax_cross_entropy(logits, targets))
+    return ce + moe_aux_weight * aux["moe_aux"]
+
+
+def num_params(params: Dict) -> int:
+    return sum(int(np.prod(x.shape))
+               for x in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_token(cfg: LlamaConfig) -> float:
+    """~6 * non-embedding params + attention FLOPs (for MFU accounting)."""
+    p_layer = (
+        cfg.d_model * cfg.n_head * cfg.head_dim  # wq
+        + 2 * cfg.d_model * cfg.n_kv_head * cfg.head_dim  # wk, wv
+        + cfg.n_head * cfg.head_dim * cfg.d_model  # wo
+        + 3 * cfg.d_model * cfg.d_ff  # swiglu
+    )
+    dense = cfg.n_layer * p_layer + 2 * cfg.vocab_size * cfg.d_model
+    attn = 2 * cfg.n_layer * cfg.max_seq_len * cfg.n_head * cfg.head_dim
+    return 6.0 * dense + 6.0 * attn

@@ -1,0 +1,187 @@
+"""nanoGPT: a minimal causal-transformer LM as a functional JAX model.
+
+The end-to-end baseline model (BASELINE.json configs[0]; reference example
+``examples/pytorch/nanogpt``) and the smoke-test workhorse.  Pure-functional:
+``init_params`` -> param pytree, ``forward(params, tokens)`` -> logits,
+``param_specs`` -> a matching pytree of ``PartitionSpec`` so the parallel
+layer can apply DP/FSDP/TP without model surgery.
+
+TPU notes: weights/activations default to bfloat16 compute with float32
+params (MXU-friendly); attention uses a fused softmax formulation XLA maps
+well, with a Pallas flash-attention drop-in available via
+``dlrover_tpu.ops.flash_attention``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    block_size: int = 1024
+    dropout: float = 0.0  # functional model: dropout folded out by default
+    dtype: Any = jnp.bfloat16  # compute dtype; params stay float32
+
+    @classmethod
+    def tiny(cls) -> "GPTConfig":
+        """Sub-second-compile config for CPU tests."""
+        return cls(vocab_size=128, n_layer=1, n_head=2, n_embd=32,
+                   block_size=32)
+
+    @classmethod
+    def small(cls) -> "GPTConfig":
+        return cls(vocab_size=50304, n_layer=6, n_head=6, n_embd=384,
+                   block_size=256)
+
+
+def init_params(rng: jax.Array, cfg: GPTConfig) -> Dict:
+    """GPT-2-style init: normal(0.02), residual projections scaled by
+    1/sqrt(2*n_layer)."""
+    k_wte, k_wpe, k_blocks = jax.random.split(rng, 3)
+    std = 0.02
+    res_std = std / jnp.sqrt(2.0 * cfg.n_layer)
+
+    def dense(key, fan_in, fan_out, scale):
+        return {
+            "kernel": (jax.random.normal(key, (fan_in, fan_out), jnp.float32)
+                       * scale),
+            "bias": jnp.zeros((fan_out,), jnp.float32),
+        }
+
+    blocks = []
+    for i in range(cfg.n_layer):
+        k = jax.random.fold_in(k_blocks, i)
+        k_qkv, k_proj, k_fc, k_out = jax.random.split(k, 4)
+        blocks.append(
+            {
+                "ln1": {"scale": jnp.ones((cfg.n_embd,), jnp.float32),
+                        "bias": jnp.zeros((cfg.n_embd,), jnp.float32)},
+                "attn": {
+                    "qkv": dense(k_qkv, cfg.n_embd, 3 * cfg.n_embd, std),
+                    "proj": dense(k_proj, cfg.n_embd, cfg.n_embd, res_std),
+                },
+                "ln2": {"scale": jnp.ones((cfg.n_embd,), jnp.float32),
+                        "bias": jnp.zeros((cfg.n_embd,), jnp.float32)},
+                "mlp": {
+                    "fc": dense(k_fc, cfg.n_embd, 4 * cfg.n_embd, std),
+                    "proj": dense(k_out, 4 * cfg.n_embd, cfg.n_embd, res_std),
+                },
+            }
+        )
+    return {
+        "wte": jax.random.normal(
+            k_wte, (cfg.vocab_size, cfg.n_embd), jnp.float32) * std,
+        "wpe": jax.random.normal(
+            k_wpe, (cfg.block_size, cfg.n_embd), jnp.float32) * std,
+        "blocks": blocks,
+        "ln_f": {"scale": jnp.ones((cfg.n_embd,), jnp.float32),
+                 "bias": jnp.zeros((cfg.n_embd,), jnp.float32)},
+    }
+
+
+def param_specs(cfg: GPTConfig, tp_axis: Optional[str] = None,
+                fsdp_axis: Optional[str] = None) -> Dict:
+    """PartitionSpec tree mirroring :func:`init_params`.
+
+    ``tp_axis`` shards attention heads / MLP hidden (Megatron layout:
+    column-parallel qkv+fc, row-parallel proj).  ``fsdp_axis`` shards the
+    remaining largest dimension (ZeRO-3-style parameter sharding).
+    """
+    t, f = tp_axis, fsdp_axis
+
+    def ln():
+        return {"scale": P(), "bias": P()}
+
+    block = {
+        "ln1": ln(),
+        "attn": {
+            "qkv": {"kernel": P(f, t), "bias": P(t)},
+            "proj": {"kernel": P(t, f), "bias": P()},
+        },
+        "ln2": ln(),
+        "mlp": {
+            "fc": {"kernel": P(f, t), "bias": P(t)},
+            "proj": {"kernel": P(t, f), "bias": P()},
+        },
+    }
+    return {
+        "wte": P(t, f),
+        "wpe": P(None, f),
+        "blocks": [block] * cfg.n_layer,
+        "ln_f": ln(),
+    }
+
+
+def _layer_norm(x, p, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def _attention(x, p, cfg: GPTConfig):
+    B, T, C = x.shape
+    H = cfg.n_head
+    qkv = x @ p["qkv"]["kernel"].astype(cfg.dtype) + p["qkv"]["bias"].astype(
+        cfg.dtype
+    )
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, C // H).transpose(0, 2, 1, 3)
+    k = k.reshape(B, T, H, C // H).transpose(0, 2, 1, 3)
+    v = v.reshape(B, T, H, C // H).transpose(0, 2, 1, 3)
+    scale = 1.0 / jnp.sqrt(C // H).astype(cfg.dtype)
+    att = (q @ k.transpose(0, 1, 3, 2)) * scale
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    att = jnp.where(mask, att, jnp.finfo(cfg.dtype).min)
+    att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+    y = att @ v
+    y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
+    return y @ p["proj"]["kernel"].astype(cfg.dtype) + p["proj"][
+        "bias"
+    ].astype(cfg.dtype)
+
+
+def _mlp(x, p, cfg: GPTConfig):
+    h = x @ p["fc"]["kernel"].astype(cfg.dtype) + p["fc"]["bias"].astype(
+        cfg.dtype
+    )
+    h = jax.nn.gelu(h)
+    return h @ p["proj"]["kernel"].astype(cfg.dtype) + p["proj"][
+        "bias"
+    ].astype(cfg.dtype)
+
+
+def forward(params: Dict, tokens: jax.Array, cfg: GPTConfig) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab] (float32)."""
+    B, T = tokens.shape
+    x = params["wte"].astype(cfg.dtype)[tokens] + params["wpe"].astype(
+        cfg.dtype
+    )[:T]
+    for blk in params["blocks"]:
+        x = x + _attention(_layer_norm(x, blk["ln1"]), blk["attn"], cfg)
+        x = x + _mlp(_layer_norm(x, blk["ln2"]), blk["mlp"], cfg)
+    x = _layer_norm(x, params["ln_f"])
+    # Weight-tied LM head (nanoGPT convention).
+    logits = x @ params["wte"].astype(cfg.dtype).T
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Dict, tokens: jax.Array, targets: jax.Array,
+            cfg: GPTConfig) -> jax.Array:
+    logits = forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def num_params(params: Dict) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

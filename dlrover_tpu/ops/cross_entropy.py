@@ -1,0 +1,97 @@
+"""Fused softmax cross-entropy: Pallas TPU kernel + reference, custom VJP.
+
+Analogue of the reference's Triton cross-entropy
+(``kernels/triton_jit/cross_entropy.py`` via ``modules/transformer/
+layers.py`` dispatch): never materializes log-softmax over the vocab in HBM
+— each row block computes logsumexp + gathers the target logit in VMEM.
+Backward is the closed form (softmax - onehot) computed blockwise.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _reference(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -ll
+
+
+def _kernel(logits_ref, labels_ref, loss_ref):
+    x = logits_ref[:].astype(jnp.float32)  # [rows, V]
+    labels = labels_ref[:, 0]  # [rows] (2D block: TPU layout needs >=2D)
+    m = jnp.max(x, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m[:, None]), axis=-1))
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        == labels[:, None]
+    )
+    target = jnp.sum(jnp.where(onehot, x, 0.0), axis=-1)
+    loss_ref[:] = (lse - target)[:, None]
+
+
+def _pallas_loss(logits2d, labels1d, block_rows, interpret):
+    from jax.experimental import pallas as pl
+
+    R, V = logits2d.shape
+    block_rows = min(block_rows, R)
+    grid = (pl.cdiv(R, block_rows),)
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, V), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, 1), jnp.float32),
+        interpret=interpret,
+    )(logits2d, labels1d[:, None])
+    return out[:, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _xent(logits, labels, use_pallas, interpret):
+    if use_pallas:
+        shape = logits.shape
+        V = shape[-1]
+        # Keep the fp32 logits block within ~4MB of VMEM.
+        block_rows = max(8, min(256, (4 << 20) // max(1, V * 4)))
+        out = _pallas_loss(
+            logits.reshape(-1, V), labels.reshape(-1), block_rows, interpret
+        )
+        return out.reshape(shape[:-1])
+    return _reference(logits, labels)
+
+
+def _fwd(logits, labels, use_pallas, interpret):
+    return _xent(logits, labels, use_pallas, interpret), (logits, labels)
+
+
+def _bwd(use_pallas, interpret, res, g):
+    logits, labels = res
+    p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    dlogits = (p - onehot) * g[..., None]
+    return dlogits.astype(logits.dtype), None
+
+
+_xent.defvjp(_fwd, _bwd)
+
+
+def softmax_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    *,
+    backend: Optional[str] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """[..., V] logits x [...] int labels -> [...] per-token loss (fp32)."""
+    if backend is None:
+        backend = "pallas" if jax.default_backend() == "tpu" else "reference"
+    return _xent(logits, labels, backend == "pallas", interpret)

@@ -1,0 +1,16 @@
+"""L5 acceleration layer: parallelism strategies as mesh + sharding choices.
+
+The TPU-native collapse of ATorch's 16 opt_lib strategy methods (SURVEY.md
+§2b #40-52): where the reference wraps torch modules per-strategy
+(DDP/ZeRO/FSDP/TP/PP/SP/MoE/3D each a separate code path), here a *strategy*
+is one ``MeshSpec`` + logical-axis sharding rules + remat/dtype policy, and
+XLA's GSPMD partitioner derives the collectives.  ``accelerate()`` is the
+``auto_accelerate()`` analogue: compile-profile candidate strategies, pick
+the best, return a sharded, jitted train step.
+"""
+
+from dlrover_tpu.parallel.mesh import MeshSpec, build_mesh  # noqa: F401
+from dlrover_tpu.parallel.accelerate import (  # noqa: F401
+    Strategy,
+    accelerate,
+)

@@ -1,0 +1,123 @@
+"""Device mesh construction: named parallelism axes over TPU topology.
+
+Parity with ATorch's ``create_parallel_group`` (reference
+``atorch/distributed/distributed.py:416``: named ND groups "data"/"tensor"/
+"pipe"/"sequence"/"expert" from (name, size) specs) — TPU-first: one
+``jax.sharding.Mesh`` whose axis *order* encodes fabric locality.  Innermost
+axes map to adjacent devices (ICI neighbours); the outermost axis is the one
+that may ride DCN across slices.  Canonical order::
+
+    ('pp', 'dp', 'fsdp', 'ep', 'tp')   # outer .. inner
+
+- ``tp``  innermost: per-layer collectives (all-reduce/all-gather) every
+  matmul -> needs the fastest links.
+- ``ep``  expert all-to-all; ``fsdp`` param all-gathers once per layer;
+- ``dp``  gradient reduce once per step -> tolerates DCN;
+- ``pp``  point-to-point only -> outermost.
+
+Sequence parallelism (Ulysses) reuses the ``tp`` axis (head<->sequence
+all-to-all), matching the reference's SP group being orthogonal to DP
+(``sequence_parallel_optimization.py:9``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Sequence, Tuple
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    @property
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXIS_ORDER)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.sizes)
+
+    def axis_names(self) -> Tuple[str, ...]:
+        return AXIS_ORDER
+
+    def normalized(self, n_devices: int) -> "MeshSpec":
+        """Fill a single ``-1`` axis from the device count (torchrun-style
+        wildcard)."""
+        sizes = list(self.sizes)
+        if -1 in sizes:
+            i = sizes.index(-1)
+            rest = math.prod(s for s in sizes if s != -1)
+            if n_devices % rest:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by {rest}"
+                )
+            sizes[i] = n_devices // rest
+        spec = MeshSpec(**dict(zip(AXIS_ORDER, sizes)))
+        if spec.num_devices != n_devices:
+            raise ValueError(
+                f"mesh {spec} needs {spec.num_devices} devices, "
+                f"have {n_devices}"
+            )
+        return spec
+
+    def describe(self) -> str:
+        return "x".join(
+            f"{a}{s}" for a, s in zip(AXIS_ORDER, self.sizes) if s > 1
+        ) or "single"
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
+    """Build a ``jax.sharding.Mesh`` with the canonical axis order."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    spec = spec.normalized(len(devs))
+    arr = np.array(devs).reshape(spec.sizes)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def candidate_specs(
+    n_devices: int,
+    *,
+    max_tp: int = 8,
+    allow_pp: bool = False,
+    allow_ep: bool = False,
+) -> List[MeshSpec]:
+    """Enumerate plausible factorizations for the strategy search
+    (the combination half of reference ``combination_sg.py``; BO can rank
+    them, see ``accelerate.search``)."""
+    specs = []
+    for tp in [t for t in (1, 2, 4, 8) if t <= min(max_tp, n_devices)]:
+        rem = n_devices // tp
+        if tp * rem != n_devices:
+            continue
+        for fsdp in [f for f in _divisors(rem)]:
+            dp = rem // fsdp
+            specs.append(MeshSpec(dp=dp, fsdp=fsdp, tp=tp))
+            if allow_ep and fsdp > 1:
+                specs.append(MeshSpec(dp=dp, fsdp=1, ep=fsdp, tp=tp))
+        if allow_pp and rem >= 2:
+            for pp in (2, 4):
+                if rem % pp == 0:
+                    specs.append(MeshSpec(pp=pp, dp=rem // pp, tp=tp))
+    # Dedup, stable order.
+    seen, out = set(), []
+    for s in specs:
+        if s.sizes not in seen:
+            seen.add(s.sizes)
+            out.append(s)
+    return out
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]

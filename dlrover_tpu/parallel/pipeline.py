@@ -1,0 +1,123 @@
+"""Pipeline parallelism: staged execution over the 'pp' mesh axis.
+
+Parity with ATorch's PP stack (reference
+``pipeline_parallel/scheduler.py:15`` GPipe/1F1B schedulers,
+``distributed_pippy_compiler.py``, P2P ``communication/pipe_communicator.py``)
+— TPU-first as a **collective-matmul-style pipelined shard_map**: layer
+parameters are stacked with a leading ``[n_stages, ...]`` axis sharded on
+'pp'; microbatches stream through stages with ``ppermute`` neighbour hops
+(P2P on ICI/DCN), overlapping stage compute with transfer.  The schedule is
+GPipe (fill-drain) expressed as one ``lax.scan`` — XLA sees a static loop
+and can software-pipeline it; backward falls out of autodiff through the
+scan (no hand-written 1F1B needed for correctness; the scan's rematerialized
+backward reproduces 1F1B's memory profile when combined with
+``jax.checkpoint``).
+
+Use :func:`pipeline_apply` inside a jitted loss; params must be given with
+``stack_stage_params``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage axis."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params
+    )
+
+
+def stage_param_specs(stage_specs: Any) -> Any:
+    """Prepend the 'pp' axis to every per-stage PartitionSpec."""
+    return jax.tree_util.tree_map(
+        lambda spec: P("pp", *spec),
+        stage_specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,  # [n_micro * micro_bs, ...] global batch
+    mesh: Mesh,
+    *,
+    n_microbatches: int,
+    pp_axis: str = "pp",
+) -> jax.Array:
+    """Run ``x`` through ``n_stages`` pipeline stages.
+
+    ``stage_fn(stage_params, micro_activations) -> micro_activations`` is the
+    per-stage computation (e.g. a group of transformer blocks).  The input
+    batch is split into ``n_microbatches``; activations circulate so stage
+    ``s`` processes microbatch ``m`` at tick ``s + m`` (GPipe fill-drain,
+    total ticks = n_stages + n_micro - 1).
+    """
+    n_stages = mesh.shape[pp_axis]
+    if n_stages == 1:
+        return stage_fn(
+            jax.tree_util.tree_map(lambda p: p[0], stacked_params), x
+        )
+    assert x.shape[0] % n_microbatches == 0
+    micro_bs = x.shape[0] // n_microbatches
+
+    def body(params_local, x_local):
+        # params_local: this stage's params ([1, ...] leading) ; x_local:
+        # the full batch (replicated across pp for simplicity of entry).
+        params_me = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        stage_idx = jax.lax.axis_index(pp_axis)
+        micros = x_local.reshape((n_microbatches, micro_bs) + x_local.shape[1:])
+
+        n_ticks = n_stages + n_microbatches - 1
+        buf = jnp.zeros((micro_bs,) + x_local.shape[1:], x_local.dtype)
+        outputs = jnp.zeros_like(micros)
+
+        def tick(carry, t):
+            buf, outputs = carry
+            # Stage 0 injects microbatch t (when in range).
+            inject = jnp.where(t < n_microbatches, t, 0)
+            buf = jnp.where(stage_idx == 0,
+                            micros[inject].astype(buf.dtype), buf)
+            out = stage_fn(params_me, buf)
+            # Last stage emits microbatch (t - n_stages + 1).
+            emit = t - (n_stages - 1)
+            emit_clip = jnp.clip(emit, 0, n_microbatches - 1)
+            outputs = jnp.where(
+                (stage_idx == n_stages - 1) & (emit >= 0),
+                outputs.at[emit_clip].set(out.astype(outputs.dtype)),
+                outputs,
+            )
+            # Shift activations to the next stage.
+            perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
+            buf = jax.lax.ppermute(out, pp_axis, perm)
+            return (buf, outputs), None
+
+        (buf, outputs), _ = jax.lax.scan(
+            tick, (buf, outputs), jnp.arange(n_ticks)
+        )
+        # Everyone returns the last stage's outputs (broadcast over the ring
+        # so the loss can be computed replicated downstream).
+        outputs = jax.lax.ppermute(
+            outputs, pp_axis,
+            [(s, (s + 1) % n_stages) for s in range(n_stages)],
+        )
+        # After one hop, stage 0 holds last stage's outputs; psum-select it.
+        sel = (stage_idx == 0).astype(outputs.dtype)
+        outputs = jax.lax.psum(outputs * sel, pp_axis)
+        return outputs.reshape(x_local.shape)
+
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(pp_axis), stacked_params
+    )
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, x)

@@ -1,0 +1,120 @@
+"""Ring / blockwise attention for long-context training.
+
+Parity with the reference's blockwise distributed attention
+(``modules/distributed_transformer/distributed_attention.py:21``
+``DistributedSoftmax`` + ``:80 DistributedSelfAttention`` — global-softmax
+reduction over sequence shards) — TPU-first as a **ring**: K/V blocks rotate
+around the sequence-parallel axis via ``ppermute`` (neighbour hops on ICI)
+while each device keeps a running online-softmax accumulator (max, sum,
+weighted values), so memory stays O(S/n) per device and no device ever holds
+the full sequence.  Causality is handled per-hop: a device skips blocks that
+are entirely in its future.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attn(q, k, v, bias_mask):
+    """One q-block x kv-block partial attention with stable accumulators.
+
+    q: [B, Sq, H, D]; k,v: [B, Sk, H, D]; bias_mask [Sq, Sk] bool (True =
+    attend).  Returns (num [B,Sq,H,D], denom [B,Sq,H], m [B,Sq,H])."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = jnp.where(bias_mask[None, :, None, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # [B,Sq,H]
+    # All-masked rows: exp(-inf - -inf) guard.
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    denom = jnp.sum(p, axis=-1)
+    num = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return num, denom, jnp.where(jnp.isfinite(m), m, -jnp.inf)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "tp",
+    causal: bool = True,
+    batch_axes: Optional[tuple] = None,
+) -> jax.Array:
+    """Sequence-sharded attention: q,k,v [B, S/n, H, D] -> out [B, S/n, H, D].
+
+    Device i owns query block i; K/V blocks make n-1 ``ppermute`` hops around
+    the ring; accumulators merge per hop with the online-softmax rule.
+    """
+    n = mesh.shape[seq_axis]
+    if n == 1:
+        Sq = q.shape[1]
+        mask = jnp.tril(jnp.ones((Sq, Sq), bool)) if causal else jnp.ones(
+            (Sq, Sq), bool
+        )
+        num, denom, _ = _block_attn(q, k, v, mask)
+        return (num / jnp.maximum(denom, 1e-20)[..., None]).astype(q.dtype)
+
+    if batch_axes is None:
+        batch_axes = tuple(
+            a for a in ("dp", "fsdp") if a in mesh.shape and a != seq_axis
+        )
+    spec = P(batch_axes or None, seq_axis, None, None)
+
+    def ring_body(qb, kb, vb):
+        axis_idx = jax.lax.axis_index(seq_axis)
+        B, Sb, H, D = qb.shape
+
+        def make_mask(q_block_idx, kv_block_idx):
+            if not causal:
+                return jnp.ones((Sb, Sb), bool)
+            # Global positions: q in block q_block_idx, kv in kv_block_idx.
+            qpos = q_block_idx * Sb + jnp.arange(Sb)[:, None]
+            kpos = kv_block_idx * Sb + jnp.arange(Sb)[None, :]
+            return qpos >= kpos
+
+        def step(carry, hop):
+            kb_c, vb_c, num, denom, m = carry
+            kv_idx = (axis_idx - hop) % n
+            mask = make_mask(axis_idx, kv_idx)
+            bnum, bdenom, bm = _block_attn(qb, kb_c, vb_c, mask)
+            # Online softmax merge.
+            new_m = jnp.maximum(m, bm)
+            new_m_safe = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+            alpha = jnp.where(
+                jnp.isfinite(m), jnp.exp(m - new_m_safe), 0.0
+            )
+            beta = jnp.where(
+                jnp.isfinite(bm), jnp.exp(bm - new_m_safe), 0.0
+            )
+            num = num * alpha[..., None] + bnum * beta[..., None]
+            denom = denom * alpha + bdenom * beta
+            # Rotate K/V to the next device (neighbour hop on ICI).
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            kb_n = jax.lax.ppermute(kb_c, seq_axis, perm)
+            vb_n = jax.lax.ppermute(vb_c, seq_axis, perm)
+            return (kb_n, vb_n, num, denom, new_m), None
+
+        init = (
+            kb, vb,
+            jnp.zeros((B, Sb, H, D), jnp.float32),
+            jnp.zeros((B, Sb, H), jnp.float32),
+            jnp.full((B, Sb, H), -jnp.inf, jnp.float32),
+        )
+        (_, _, num, denom, _), _ = jax.lax.scan(
+            step, init, jnp.arange(n)
+        )
+        return (num / jnp.maximum(denom, 1e-20)[..., None]).astype(qb.dtype)
+
+    return jax.shard_map(
+        ring_body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

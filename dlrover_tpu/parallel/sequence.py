@@ -1,0 +1,84 @@
+"""Ulysses-style sequence parallelism: head<->sequence all-to-all attention.
+
+Parity with ATorch's SP (reference
+``auto/opt_lib/sequence_parallel_optimization.py:9``: "attention is
+head-parallel, the rest sequence-parallel; SP group independent of DP";
+alltoall utils ``modules/distributed_transformer/commu_utils.py``) — TPU
+native: activations outside attention are sharded on the sequence axis; at
+attention, a ``shard_map`` all-to-all re-shards [B, S/n, H, D] ->
+[B, S, H/n, D] so every device sees the full sequence for its head subset,
+then back.  The all-to-alls ride ICI on the same axis TP uses.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _attn_core(q, k, v, causal: bool):
+    # q,k,v: [B, S, H_local, D]
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    att = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+    if causal:
+        S, T = att.shape[-2], att.shape[-1]
+        mask = jnp.tril(jnp.ones((S, T), bool))
+        att = jnp.where(mask, att, jnp.finfo(att.dtype).min)
+    att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", att, v)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "tp",
+    causal: bool = True,
+    attn_fn: Optional[Callable] = None,
+    batch_axes: Optional[tuple] = None,
+) -> jax.Array:
+    """[B, S/n, H, D] sequence-sharded qkv -> [B, S/n, H, D] output.
+
+    ``attn_fn(q, k, v, causal)`` operates on full-sequence/head-sharded
+    blocks — plug the Pallas flash kernel here on real TPUs.
+    ``batch_axes``: mesh axes the batch dim is sharded on (default: any of
+    'dp'/'fsdp' present in the mesh).
+    """
+    core = attn_fn or _attn_core
+    n = mesh.shape[seq_axis]
+    if batch_axes is None:
+        batch_axes = tuple(
+            a for a in ("dp", "fsdp") if a in mesh.shape and a != seq_axis
+        )
+    spec = P(batch_axes or None, seq_axis, None, None)
+
+    def block(qb, kb, vb):
+        # qb: [B, S/n, H, D] local. a2a: split heads, gather sequence.
+        def a2a_fwd(x):
+            # -> [B, S, H/n, D]
+            return jax.lax.all_to_all(
+                x, seq_axis, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        def a2a_bwd(x):
+            # [B, S, H/n, D] -> [B, S/n, H, D]
+            return jax.lax.all_to_all(
+                x, seq_axis, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        qf, kf, vf = a2a_fwd(qb), a2a_fwd(kb), a2a_fwd(vb)
+        out = core(qf, kf, vf, causal)
+        return a2a_bwd(out)
+
+    if n == 1:
+        return core(q, k, v, causal)
+    return jax.shard_map(
+        block, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

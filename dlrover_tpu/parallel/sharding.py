@@ -1,0 +1,105 @@
+"""Logical-axis sharding rules: model code names axes, rules map to mesh.
+
+The TPU-native equivalent of ATorch's per-strategy module wrapping (reference
+``tensor_parallel/manual_tp.py TPInfo`` shard specs + Megatron-style layers
+``modules/distributed_modules/layers.py``): models annotate parameters with
+*logical* axis names; a rule table maps logical -> mesh axes; changing the
+strategy means changing the rules, never the model.
+
+Standard logical axes (t5x/maxtext convention):
+  'batch', 'seq', 'embed', 'heads', 'kv', 'mlp', 'vocab', 'layers', 'expert'
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# rule value: mesh axis name, tuple of axes, or None (replicate)
+Rules = Dict[str, Union[str, Tuple[str, ...], None]]
+
+# Megatron layout on one mesh: qkv/fc column-parallel on tp, proj
+# row-parallel; fsdp shards embed; batch over dp+fsdp (ZeRO-style: data
+# parallel over both, params gathered on fsdp).
+DEFAULT_RULES: Rules = {
+    "batch": ("dp", "fsdp"),
+    "seq": None,
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "layers": None,
+    "expert": "ep",
+    "expert_mlp": "tp",
+}
+
+
+def logical_to_spec(
+    logical_axes: Sequence[Optional[str]], rules: Optional[Rules] = None
+) -> P:
+    """('embed','mlp') -> PartitionSpec('fsdp','tp') under the rule table."""
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    out = []
+    used = set()
+    for ax in logical_axes:
+        if ax is None:
+            out.append(None)
+            continue
+        phys = rules.get(ax)
+        if phys is None:
+            out.append(None)
+            continue
+        # A mesh axis may appear only once in a PartitionSpec.
+        if isinstance(phys, tuple):
+            phys = tuple(p for p in phys if p not in used)
+            used.update(phys)
+            out.append(phys if phys else None)
+        else:
+            if phys in used:
+                out.append(None)
+            else:
+                used.add(phys)
+                out.append(phys)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def tree_logical_to_specs(logical_tree: Any, rules: Optional[Rules] = None):
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree_util.tree_map(
+        lambda axes: logical_to_spec(axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(a, (str, type(None))) for a in x),
+    )
+
+
+def shard_tree(tree: Any, specs: Any, mesh: Mesh):
+    """device_put a pytree with per-leaf NamedShardings."""
+    return jax.tree_util.tree_map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        tree,
+        specs,
+        is_leaf=lambda x: not isinstance(x, (dict, list, tuple)),
+    )
+
+
+def named_sharding_tree(specs: Any, mesh: Mesh):
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def constraint(x, logical_axes: Sequence[Optional[str]],
+               rules: Optional[Rules] = None):
+    """``with_sharding_constraint`` by logical axes — used inside model code
+    to pin activation layouts (the reference pins them by wrapping modules)."""
+    return jax.lax.with_sharding_constraint(
+        x, logical_to_spec(logical_axes, rules)
+    )

@@ -1,0 +1,184 @@
+"""``tpurun`` — the job launcher CLI (``python -m dlrover_tpu.run``).
+
+Parity with reference ``dlrover-run`` (``trainer/torch/elastic_run.py``:
+``parse_args :125``, ``_launch_dlrover_local_master :245``,
+``_check_dlrover_master_available :277``, ``run :413``): a torchrun-style
+front-end that (on node 0 of standalone jobs) spawns a local master
+subprocess, waits for it, merges master-pushed run config, then hands off to
+the elastic agent.
+
+Examples::
+
+    # single host, 2 worker processes, local master auto-spawned
+    tpurun --standalone --nproc_per_node=2 train.py --lr 3e-4
+
+    # multi-host: every host points at the job master
+    tpurun --master_addr=10.0.0.2:5001 --nnodes=2:4 --node_rank=$RANK train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import atexit
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training import ElasticLaunchConfig, launch_agent
+from dlrover_tpu.common.log import logger, set_role
+from dlrover_tpu.common.rpc import addr_connectable
+
+
+def parse_nnodes(spec: str) -> Tuple[int, int]:
+    if ":" in spec:
+        lo, hi = spec.split(":", 1)
+        return int(lo), int(hi)
+    n = int(spec)
+    return n, n
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        "tpurun", description="elastic TPU training launcher"
+    )
+    p.add_argument("--standalone", action="store_true",
+                   help="single-host mode: auto-spawn a local master")
+    p.add_argument("--nnodes", default="1",
+                   help="'N' or 'MIN:MAX' elastic node range")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("DLROVER_TPU_NODE_RANK", 0)))
+    p.add_argument("--node_id", type=int, default=-1,
+                   help="stable node id (defaults to node_rank)")
+    p.add_argument("--master_addr", default=os.environ.get(
+        "DLROVER_TPU_MASTER_ADDR", ""))
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--monitor_interval", type=float, default=2.0)
+    p.add_argument("--rdzv_timeout", type=float, default=600.0)
+    p.add_argument("--network_check", action="store_true",
+                   help="run the pre-flight matmul+psum node check")
+    p.add_argument("--comm_perf_test", action="store_true")
+    p.add_argument("--node_unit", type=int, default=1)
+    p.add_argument("--log_dir", default="")
+    p.add_argument("--job_name", default=os.environ.get(
+        "DLROVER_TPU_JOB_NAME", "local-job"))
+    p.add_argument("--no_python", action="store_true",
+                   help="entrypoint is a program, not a python script")
+    p.add_argument("entrypoint", help="training script")
+    p.add_argument("args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _launch_local_master(args) -> Tuple[subprocess.Popen, str]:
+    """Spawn ``python -m dlrover_tpu.master.main`` and wait for its port
+    (reference ``_launch_dlrover_local_master :245``)."""
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+    port_file = tempfile.mktemp(prefix="dlrtpu_master_port_")
+    cmd = [
+        sys.executable, "-m", "dlrover_tpu.master.main",
+        "--port", "0",
+        "--job_name", args.job_name,
+        "--platform", "local",
+        "--min_nodes", str(min_nodes),
+        "--max_nodes", str(max_nodes),
+        "--node_unit", str(args.node_unit),
+        "--port_file", port_file,
+    ]
+    proc = subprocess.Popen(cmd)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                content = f.read().strip()
+            if content:
+                os.unlink(port_file)
+                return proc, f"127.0.0.1:{content}"
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"local master exited early with code {proc.returncode}"
+            )
+        time.sleep(0.2)
+    raise TimeoutError("local master did not report its port in 60s")
+
+
+def run(args: argparse.Namespace) -> int:
+    set_role(f"agent-{args.node_rank}")
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+    master_proc = None
+    master_addr = args.master_addr
+    if args.standalone and not master_addr:
+        master_proc, master_addr = _launch_local_master(args)
+        atexit.register(
+            lambda: master_proc.poll() is None and master_proc.terminate()
+        )
+    if not master_addr:
+        raise SystemExit(
+            "either --standalone or --master_addr is required"
+        )
+    if not addr_connectable(master_addr, timeout=30):
+        raise SystemExit(f"master at {master_addr} is not reachable")
+
+    node_id = args.node_id if args.node_id >= 0 else args.node_rank
+    config = ElasticLaunchConfig(
+        min_nodes=min_nodes,
+        max_nodes=max_nodes,
+        nproc_per_node=args.nproc_per_node,
+        node_id=node_id,
+        node_rank=args.node_rank,
+        max_restarts=args.max_restarts,
+        monitor_interval=args.monitor_interval,
+        rdzv_timeout=args.rdzv_timeout,
+        network_check=args.network_check,
+        comm_perf_test=args.comm_perf_test,
+        log_dir=args.log_dir,
+        job_name=args.job_name,
+    )
+    config.auto_configure()
+
+    # Merge master-pushed run config (reference _elastic_config_from_master).
+    client = MasterClient(master_addr, node_id)
+    try:
+        pushed = client.get_elastic_run_config()
+        for key, val in pushed.items():
+            if hasattr(config, key):
+                setattr(config, key, type(getattr(config, key))(val))
+    except Exception as e:  # noqa: BLE001
+        logger.warning("could not fetch master run config: %s", e)
+
+    if args.network_check:
+        from dlrover_tpu.agent.node_check import node_health_check
+
+        ok = node_health_check(config, master_addr, client)
+        if not ok:
+            logger.error("node health check failed; exiting for relaunch")
+            return 3
+
+    entry = (
+        [args.entrypoint] if args.no_python
+        else [sys.executable, "-u", args.entrypoint]
+    )
+    script_args = args.args
+    if script_args and script_args[0] == "--":
+        script_args = script_args[1:]
+    rc = launch_agent(config, entry + script_args, master_addr)
+
+    if master_proc is not None:
+        try:
+            client.report_job_exit(rc == 0, "launcher done")
+        except Exception:  # noqa: BLE001
+            pass
+        master_proc.wait(timeout=30)
+    client.close()
+    return rc
+
+
+def main() -> None:
+    sys.exit(run(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

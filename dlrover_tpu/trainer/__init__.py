@@ -1,0 +1,9 @@
+"""L6 trainer SDK: what user training scripts import.
+
+``init()`` bootstraps the JAX distributed runtime from the agent's env
+contract; ``ElasticTrainer``/``ElasticSampler``/``ElasticDataLoader`` give
+elastic-aware training utilities (SURVEY.md §1 L6, reference
+``dlrover/trainer/``).
+"""
+
+from dlrover_tpu.trainer.bootstrap import ElasticContext, init  # noqa: F401

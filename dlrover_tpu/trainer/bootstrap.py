@@ -1,0 +1,97 @@
+"""Worker-process bootstrap: from agent env contract to a live JAX world.
+
+The TPU-native replacement for torch's ``init_process_group`` + torchelastic
+env plumbing (reference ``training.py _set_master_addr_port :570`` and the
+worker-side ``torch.distributed`` init): the agent hands each worker its
+``process_id``/``num_processes``/coordinator via env; ``init()`` brings up
+``jax.distributed``, connects the master client, and returns an
+:class:`ElasticContext` for step reporting, dynamic sharding and checkpoint
+access.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Optional
+
+from dlrover_tpu.agent.master_client import MasterClient, build_master_client
+from dlrover_tpu.common import env as env_utils
+from dlrover_tpu.common.jax_env import (
+    ensure_platform,
+    initialize_distributed_from_env,
+)
+from dlrover_tpu.common.log import logger, set_role
+
+
+class ElasticContext:
+    """What a worker knows about its place in the elastic job."""
+
+    def __init__(self):
+        self.node_id = env_utils.get_node_id()
+        self.node_rank = env_utils.get_node_rank()
+        self.node_num = env_utils.get_node_num()
+        self.process_id = env_utils.get_process_id()
+        self.num_processes = env_utils.get_num_processes()
+        self.local_rank = int(os.environ.get("DLROVER_TPU_LOCAL_RANK", 0))
+        self.restart_count = int(
+            os.environ.get("DLROVER_TPU_RESTART_COUNT", 0)
+        )
+        self.rdzv_round = int(os.environ.get("DLROVER_TPU_RDZV_ROUND", 0))
+        self.job_name = env_utils.get_job_name()
+        self.master_addr = env_utils.get_master_addr()
+        self.client: Optional[MasterClient] = None
+        self.distributed = False
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_id == 0
+
+    def report_step(self, step: int) -> None:
+        """Feed the master's speed monitor / goodput accounting (leader
+        only; reference ``report_global_step``)."""
+        if self.client is not None and self.is_leader:
+            try:
+                self.client.report_global_step(step)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("report_step failed: %s", e)
+
+
+_ctx: Optional[ElasticContext] = None
+
+
+def init(connect_master: bool = True) -> ElasticContext:
+    """Bootstrap this worker process.  Idempotent."""
+    global _ctx
+    if _ctx is not None:
+        return _ctx
+    ctx = ElasticContext()
+    set_role(f"worker-{ctx.process_id}")
+    ensure_platform()
+    ctx.distributed = initialize_distributed_from_env()
+    if ctx.distributed:
+        import jax
+
+        logger.info(
+            "jax.distributed up: process %d/%d, %d local / %d global devices",
+            ctx.process_id, ctx.num_processes,
+            jax.local_device_count(), jax.device_count(),
+        )
+        atexit.register(_shutdown)
+    if connect_master and ctx.master_addr:
+        ctx.client = build_master_client(ctx.master_addr, ctx.node_id)
+    _ctx = ctx
+    return ctx
+
+
+def get_elastic_context() -> Optional[ElasticContext]:
+    return _ctx
+
+
+def _shutdown() -> None:
+    try:
+        import jax
+
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001
+        pass

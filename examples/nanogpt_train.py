@@ -1,0 +1,146 @@
+"""End-to-end elastic nanoGPT pretraining (BASELINE.json configs[0]).
+
+Run standalone on one host (CPU devices or a TPU host)::
+
+    python -m dlrover_tpu.run --standalone --nproc_per_node=2 \
+        examples/nanogpt_train.py -- --steps 20
+
+The script demonstrates the minimum elastic slice: agent-bootstrapped
+``jax.distributed`` world, DP mesh, elastic sampler, per-step master
+reporting, flash-checkpoint save/restore (warm restart survives worker
+kills).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import dlrover_tpu.trainer as trainer_sdk
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch_per_proc", type=int, default=4)
+    p.add_argument("--seq_len", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--dataset_size", type=int, default=4096)
+    p.add_argument("--ckpt_dir", default="")
+    p.add_argument("--ckpt_interval", type=int, default=5)
+    return p.parse_args()
+
+
+def synth_batch(indices, seq_len, vocab):
+    """Deterministic synthetic tokens: record i is derived from i alone, so
+    any process can materialize any record (elastic re-partition safe)."""
+    import numpy as np
+
+    rngs = np.random.RandomState(0)
+    base = rngs.randint(0, vocab, size=(seq_len + 1,))
+    out = np.stack(
+        [(base + i) % vocab for i in indices], axis=0
+    ).astype("int32")
+    return out[:, :-1], out[:, 1:]
+
+
+def main() -> int:
+    args = parse_args()
+    ctx = trainer_sdk.init()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dlrover_tpu.models import nanogpt
+    from dlrover_tpu.trainer.sampler import ElasticSampler
+
+    cfg = nanogpt.GPTConfig.tiny()
+    cfg = type(cfg)(**{**cfg.__dict__, "block_size": args.seq_len})
+
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("dp",))
+    repl = NamedSharding(mesh, P())
+    data_sharding = NamedSharding(mesh, P("dp"))
+
+    params = jax.device_put(
+        nanogpt.init_params(jax.random.PRNGKey(0), cfg), repl
+    )
+    tx = optax.adamw(args.lr)
+    opt_state = jax.device_put(tx.init(params), repl)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(nanogpt.loss_fn)(
+            params, tokens, targets, cfg
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    start_step = 0
+    ckpt = None
+    if args.ckpt_dir:
+        from dlrover_tpu.checkpoint.checkpointer import FlashCheckpointer
+
+        ckpt = FlashCheckpointer(args.ckpt_dir, job_name=ctx.job_name)
+        restored = ckpt.load(
+            target={"params": params, "opt_state": opt_state}
+        )
+        if restored is not None:
+            state, meta = restored
+            params, opt_state = state["params"], state["opt_state"]
+            start_step = int(meta.get("step", 0))
+            print(f"[worker {ctx.process_id}] restored step={start_step}",
+                  flush=True)
+
+    sampler = ElasticSampler(
+        args.dataset_size,
+        batch_size_per_process=args.batch_per_proc,
+        num_processes=ctx.num_processes,
+        process_id=ctx.process_id,
+        seed=17,
+    )
+    sampler.completed_steps = start_step
+
+    step = start_step
+    loss = float("nan")
+    it = iter(sampler)
+    while step < args.steps:
+        try:
+            indices = next(it)
+        except StopIteration:
+            it = iter(sampler)
+            continue
+        x_np, y_np = synth_batch(indices, args.seq_len, cfg.vocab_size)
+        x = jax.make_array_from_process_local_data(data_sharding, x_np)
+        y = jax.make_array_from_process_local_data(data_sharding, y_np)
+        params, opt_state, loss = train_step(params, opt_state, x, y)
+        step += 1
+        ctx.report_step(step)
+        if ckpt is not None and step % args.ckpt_interval == 0:
+            ckpt.save(
+                {"params": params, "opt_state": opt_state},
+                meta={"step": step},
+            )
+        if step % 10 == 0 or step == args.steps:
+            print(
+                f"[worker {ctx.process_id}] step {step} loss "
+                f"{float(loss):.4f}", flush=True,
+            )
+    if ckpt is not None:
+        ckpt.save(
+            {"params": params, "opt_state": opt_state},
+            meta={"step": step},
+            storage=True,
+        )
+        ckpt.wait()
+    print(f"TRAIN_DONE step={step} loss={float(loss):.4f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

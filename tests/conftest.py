@@ -19,6 +19,16 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _force_cpu_platform():
+    """The tunneled-TPU PJRT shim prepends itself to jax_platforms at import,
+    overriding JAX_PLATFORMS=cpu; re-assert cpu explicitly."""
+    from dlrover_tpu.common.jax_env import ensure_platform
+
+    ensure_platform("cpu")
+    yield
+
+
 @pytest.fixture(scope="session")
 def cpu_mesh_devices():
     import jax

@@ -1,0 +1,306 @@
+"""Master control-plane tests: in-process LocalJobMaster + real RPC through
+MasterClient (SURVEY.md §4: the reference's `start_local_master` fixture
+pattern — real gRPC, single host, mocked platform)."""
+
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeStatus, RendezvousName
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.master.dataset_splitter import (
+    StreamingDatasetSplitter,
+    TableDatasetSplitter,
+    TextDatasetSplitter,
+)
+from dlrover_tpu.master.master import LocalJobMaster
+from dlrover_tpu.master.rendezvous import NetworkCheckRendezvousManager
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.topology import DpTopologySorter, NodeTopologyMeta
+
+
+@pytest.fixture()
+def master():
+    m = LocalJobMaster(0, job_name="test-job", min_nodes=2, max_nodes=4)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+def make_client(master, node_id):
+    c = MasterClient(master.addr, node_id)
+    c.register_node(
+        node_rank=node_id, host="127.0.0.1", agent_port=9000 + node_id,
+        local_world_size=2, slice_id=f"slice-{node_id % 2}",
+    )
+    return c
+
+
+class TestRendezvous:
+    def test_two_node_rendezvous(self, master):
+        c0, c1 = make_client(master, 0), make_client(master, 1)
+        c0.join_rendezvous(node_rank=0, local_world_size=2)
+        c1.join_rendezvous(node_rank=1, local_world_size=2)
+        # Round completes at max_nodes or after the lastcall window; with
+        # min=2 joined, poll until the world appears.
+        world = {}
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            rnd, group, world, coord = c0.get_comm_world()
+            if world:
+                break
+            time.sleep(0.5)
+        assert len(world) == 2
+        assert world[0]["process_id_base"] == 0
+        assert world[1]["process_id_base"] == 2  # rank0 had 2 local procs
+        assert coord  # coordinator elected from rank-0 node
+        # Node 1 sees the same world.
+        _, _, world1, _ = c1.get_comm_world()
+        assert set(world1.keys()) == {0, 1}
+        assert master.rdzv_managers[RendezvousName.TRAINING].num_nodes_waiting() == 0
+        c0.close(); c1.close()
+
+    def test_waiting_node_triggers_membership_change(self, master):
+        c0, c1 = make_client(master, 0), make_client(master, 1)
+        c0.join_rendezvous(0, 1)
+        c1.join_rendezvous(1, 1)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            _, _, w, _ = c0.get_comm_world()
+            if w:
+                break
+            time.sleep(0.5)
+        assert c0.num_nodes_waiting() == 0
+        # A third node joins -> agents should observe waiting>0 (restart cue).
+        c2 = make_client(master, 2)
+        c2.join_rendezvous(2, 1)
+        assert c0.num_nodes_waiting() == 1
+        for c in (c0, c1, c2):
+            c.close()
+
+    def test_node_unit_rounding(self):
+        m = LocalJobMaster(0, min_nodes=2, max_nodes=8, node_unit=2)
+        m.prepare()
+        try:
+            clients = [make_client(m, i) for i in range(3)]
+            for i, c in enumerate(clients):
+                c.join_rendezvous(i, 1)
+            mgr = m.rdzv_managers[RendezvousName.TRAINING]
+            deadline = time.time() + 15
+            world = {}
+            while time.time() < deadline:
+                _, _, world, _ = clients[0].get_comm_world()
+                if world:
+                    break
+                time.sleep(0.5)
+            # 3 nodes, unit=2 -> world of 2; 1 left waiting.
+            assert len(world) == 2
+            assert mgr.num_nodes_waiting() == 1
+            for c in clients:
+                c.close()
+        finally:
+            m.stop()
+
+
+class TestTopologySort:
+    def test_slice_contiguity(self):
+        nodes = {
+            0: NodeTopologyMeta(0, 0, 4, slice_id="sl-b"),
+            1: NodeTopologyMeta(1, 1, 4, slice_id="sl-a"),
+            2: NodeTopologyMeta(2, 2, 4, slice_id="sl-b"),
+            3: NodeTopologyMeta(3, 3, 4, slice_id="sl-a"),
+            4: NodeTopologyMeta(4, 4, 4, slice_id="sl-b"),
+        }
+        ordered = DpTopologySorter().sort(nodes)
+        slices = [n.slice_id for n in ordered]
+        # Largest slice first, each slice contiguous.
+        assert slices == ["sl-b", "sl-b", "sl-b", "sl-a", "sl-a"]
+
+
+class TestDataSharding:
+    def test_task_dispatch_and_recovery(self, master):
+        c = make_client(master, 0)
+        c.report_dataset_shard_params(
+            dataset_name="ds", dataset_size=100, shard_size=10, num_epochs=1
+        )
+        t1 = c.get_task("ds")
+        t2 = c.get_task("ds")
+        assert t1.task_id != t2.task_id
+        assert t1.end - t1.start == 10
+        c.report_task_result("ds", t1.task_id, success=True)
+        # Fail t2 -> it must be re-dispatched.
+        c.report_task_result("ds", t2.task_id, success=False)
+        t3 = c.get_task("ds")
+        assert t3.task_id == t2.task_id
+        c.close()
+
+    def test_worker_failure_requeues_tasks(self, master):
+        c0, c1 = make_client(master, 0), make_client(master, 1)
+        c0.report_dataset_shard_params(
+            dataset_name="ds2", dataset_size=30, shard_size=10
+        )
+        got = [c0.get_task("ds2") for _ in range(3)]
+        assert all(t.task_id >= 0 for t in got)
+        assert c1.get_task("ds2").task_id == -1  # exhausted
+        # Node 0 dies -> its 3 in-flight shards are recovered.
+        c1.report_failure("proc crashed", node_rank=0)
+        # reported by c1 about itself; emulate master noticing node 0:
+        master.task_manager.recover_worker_tasks(0)
+        t = c1.get_task("ds2")
+        assert t.task_id >= 0
+        c0.close(); c1.close()
+
+    def test_shard_checkpoint_roundtrip(self, master):
+        c = make_client(master, 0)
+        c.report_dataset_shard_params(
+            dataset_name="ds3", dataset_size=40, shard_size=10
+        )
+        t = c.get_task("ds3")
+        ckpt = c.get_shard_checkpoint("ds3")
+        assert ckpt
+        # Restore -> undone shards (incl. in-flight t) come back.
+        assert c.restore_shard_checkpoint("ds3", ckpt)
+        seen = set()
+        while True:
+            nt = c.get_task("ds3")
+            if nt.task_id < 0:
+                break
+            seen.add((nt.start, nt.end))
+            c.report_task_result("ds3", nt.task_id, True)
+        assert (t.start, t.end) in seen
+        assert len(seen) == 4  # all 4 shards re-served after restore
+        c.close()
+
+
+class TestSplitters:
+    def test_table_splitter(self):
+        s = TableDatasetSplitter("d", 25, 10, num_epochs=2)
+        shards = s.create_shards()
+        assert [(x.start, x.end) for x in shards] == [(0, 10), (10, 20), (20, 25)]
+        assert not s.epoch_finished()
+        s.create_shards()
+        assert s.epoch_finished()
+
+    def test_text_splitter_shuffle_deterministic(self):
+        a = TextDatasetSplitter("d", 20, 5, shuffle=True, seed=7)
+        b = TextDatasetSplitter("d", 20, 5, shuffle=True, seed=7)
+        sa, sb = a.create_shards(), b.create_shards()
+        assert sa[0].record_indices == sb[0].record_indices
+        all_indices = sorted(i for sh in sa for i in sh.record_indices)
+        assert all_indices == list(range(20))
+
+    def test_streaming_splitter(self):
+        s = StreamingDatasetSplitter("d", shard_size=4, fetch_batch=2)
+        first = s.create_shards()
+        second = s.create_shards()
+        assert first[0].start == 0 and second[0].start == 8
+        assert not s.epoch_finished()
+
+
+class TestKVSyncMetrics:
+    def test_kv_store(self, master):
+        c0, c1 = make_client(master, 0), make_client(master, 1)
+        c0.kv_store_set("addr/0", b"1.2.3.4:99")
+        assert c1.kv_store_wait_get("addr/0", timeout=5) == b"1.2.3.4:99"
+        assert c1.kv_store_get("missing") is None
+        assert c0.kv_store_add("cnt", 2) == 2
+        assert c1.kv_store_add("cnt", 3) == 5
+        c0.kv_store_multi_set({"a": b"1", "b": b"2"})
+        assert c1.kv_store_multi_get(["a", "b", "zz"]) == {"a": b"1", "b": b"2"}
+        c0.close(); c1.close()
+
+    def test_named_barrier(self, master):
+        c0, c1 = make_client(master, 0), make_client(master, 1)
+        # Establish a 2-node world first.
+        c0.join_rendezvous(0, 1); c1.join_rendezvous(1, 1)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            _, _, w, _ = c0.get_comm_world()
+            if w:
+                break
+            time.sleep(0.5)
+        results = {}
+
+        def join(c, key):
+            results[key] = c.barrier("before-reshard", timeout=20)
+
+        t0 = threading.Thread(target=join, args=(c0, 0))
+        t0.start()
+        time.sleep(0.3)
+        join(c1, 1)
+        t0.join(timeout=25)
+        assert results == {0: True, 1: True}
+        c0.close(); c1.close()
+
+    def test_speed_and_heartbeat(self, master):
+        c = make_client(master, 0)
+        base = time.time()
+        for s in range(1, 6):
+            c.report_global_step(s, base + s * 0.1)
+        assert master.speed_monitor.completed_global_step == 5
+        assert master.speed_monitor.running_speed() > 0
+        actions = c.report_heartbeat()
+        assert actions == []
+        node = master.job_manager.get_node(0)
+        assert node is not None and node.status == NodeStatus.RUNNING
+        c.close()
+
+
+class TestNetworkCheck:
+    def test_pairing_and_straggler_detection(self):
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(4, 4)
+        for i in range(4):
+            mgr.join(i, i, 1, host=f"h{i}", coordinator_port=9000 + i)
+        # Round 0: adjacent pairs.
+        _, g0, w0, _ = mgr.get_comm_world(0)
+        _, g1, w1, _ = mgr.get_comm_world(1)
+        assert g0 == g1 and set(x["node_id"] for x in w0.values()) == {0, 1}
+        _, g2, w2, _ = mgr.get_comm_world(2)
+        assert set(x["node_id"] for x in w2.values()) == {2, 3}
+        # Report: node 3 is slow.
+        for nid, t in [(0, 1.0), (1, 1.1), (2, 0.9), (3, 5.0)]:
+            mgr.report_result(nid, True, t)
+        times, stragglers = mgr.get_stragglers()
+        assert stragglers == [3]
+        # Round 1 pairs fastest with slowest.
+        mgr.next_check_round()
+        with mgr._lock:
+            groups = mgr._group_nodes_locked()
+        assert [2, 3] in groups  # fastest (2) with slowest (3)
+        # Fault detection: nobody failed.
+        faults, _ = mgr.check_fault_node()
+        assert faults == []
+
+    def test_fault_node_detection(self):
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(2, 2)
+        for i in range(2):
+            mgr.join(i, i, 1)
+        mgr.get_comm_world(0)
+        mgr.report_result(0, True, 1.0)
+        mgr.report_result(1, False, 0.0)
+        # Round 0 failure alone is inconclusive.
+        faults, reason = mgr.check_fault_node()
+        assert faults == [] and reason == "need another round"
+        mgr.next_check_round()
+        mgr.report_result(0, True, 1.0, round_=1)
+        mgr.report_result(1, False, 0.0, round_=1)
+        faults, _ = mgr.check_fault_node()
+        assert faults == [1]
+        assert not mgr.network_ready()
+
+
+class TestSpeedMonitor:
+    def test_goodput_accounting(self):
+        sm = SpeedMonitor()
+        t0 = time.time() - 10
+        sm.collect_global_step(1, t0)
+        sm.collect_global_step(5, t0 + 2)
+        # 3s downtime.
+        sm._downtime_total = 3.0
+        g = sm.goodput()
+        assert 0.5 < g < 0.8  # ~7/10
+        assert not sm.hang_detected(timeout=3600)
+        assert sm.hang_detected(timeout=5)

@@ -42,6 +42,23 @@ class TestArena:
         w.close(unlink=True)
         r.close()
 
+    def test_dirty_flag_invalidates_torn_write(self):
+        """A writer killed mid-write leaves dirty=1; readers must see no
+        valid state instead of torn tensor bytes."""
+        name = arena_name("t-dirty", 0)
+        w = SharedMemoryArena(name)
+        w.write_state({"a": np.ones(8, np.float32)}, extra={"step": 1})
+        assert w.metadata() is not None
+        # Simulate a mid-write kill: set the header's dirty u32 (offset 44).
+        w._seg.buf[44] = 1
+        r = SharedMemoryArena(name)
+        assert r.metadata() is None
+        # A completed write clears it again.
+        w.write_state({"a": np.ones(8, np.float32)}, extra={"step": 2})
+        assert r.metadata()["extra"]["step"] == 2
+        w.close(unlink=True)
+        r.close()
+
     def test_empty_arena_metadata_none(self):
         arena = SharedMemoryArena("dlrtpu_nonexistent_arena_xyz")
         assert arena.metadata() is None
@@ -96,8 +113,9 @@ class TestIpcPrimitives:
     def test_shared_lock_nonblocking(self):
         lock = SharedLock("t-lock2", create=True)
         other = SharedLock("t-lock2")
-        # Different holder-id: simulate another process by patching holder.
-        other._holder = "pid-fake"
+        # Different holder-id: simulate another live client.  (A "pid-…"
+        # id of a dead process would be stolen by design.)
+        other._holder = "other-live-holder"
         try:
             assert lock.acquire()
             assert not other.acquire(blocking=False, timeout=0.1)
