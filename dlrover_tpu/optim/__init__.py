@@ -1,0 +1,33 @@
+"""Optimizers (SURVEY.md #54/#56/#63).
+
+The reference ships torch optimizers (``atorch/optimizers/``: AGD
+``agd.py:18``, WSAM ``wsam.py:11``, BF16 master-weight optimizer
+``bf16_optimizer.py``) plus CUDA int8-state Adam
+(``ops/csrc/quantization/quantization_optimizer.cu``) and muP
+(``atorch/mup/``).  Here they are optax-style functional transforms: state
+lives in pytrees that shard on the mesh like any other (ZeRO falls out of
+GSPMD), and everything is jit/scan-safe.
+"""
+
+from dlrover_tpu.ops.quant import adam8bit
+from dlrover_tpu.optim.agd import agd
+from dlrover_tpu.optim.bf16 import bf16_master_weights
+from dlrover_tpu.optim.mup import (
+    InfShape,
+    infer_width_mults,
+    mup_init_params,
+    mup_scale_adam,
+)
+from dlrover_tpu.optim.wsam import WeightedSAM, wsam_gradient
+
+__all__ = [
+    "adam8bit",
+    "agd",
+    "bf16_master_weights",
+    "WeightedSAM",
+    "wsam_gradient",
+    "InfShape",
+    "infer_width_mults",
+    "mup_init_params",
+    "mup_scale_adam",
+]

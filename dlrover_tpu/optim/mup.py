@@ -1,0 +1,150 @@
+"""muP — Maximal Update Parametrization (Tensor Programs V).
+
+Capability parity with reference ``atorch/mup/`` (``module.py``,
+``shape.py`` infshape bookkeeping, ``optim.py`` MuAdam/MuSGD): transfer
+hyperparameters tuned at a small base width to a large target width by
+scaling init and per-param Adam learning rates.
+
+JAX formulation: instead of wrapping modules, we compare each param's shape
+against its *base-model* shape (``jax.eval_shape`` on the small config) to
+classify leaves, then (a) rescale an existing standard init and (b) wrap
+the optimizer with a per-leaf update scale.  Convention: 2-D weights are
+``(fan_in, fan_out)`` as used by ``x @ W`` throughout ``models/``.
+
+Rules (Adam):
+  - matrix-like (>=2 dims grown vs base): lr_mult = 1/width_mult,
+    init std already ~1/sqrt(fan_in) in standard inits — kept;
+  - vector-like (bias/norm/embedding rows): untouched;
+  - output head (fan_in grown, fan_out fixed = vocab): lr_mult =
+    1/width_mult and init scaled by 1/sqrt(width_mult) (zero-init also
+    valid and supported via ``zero_output=True``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class InfShape:
+    """Per-param muP classification (the reference's ``infshape``)."""
+
+    shape: tuple
+    base_shape: tuple
+    ninf: int  # number of dims that grow with width
+    width_mult: float  # fan_in ratio vs base (1.0 if fan_in fixed)
+
+    @property
+    def matrix_like(self) -> bool:
+        return self.ninf >= 2
+
+    @property
+    def hidden_grown(self) -> bool:
+        return self.ninf >= 1
+
+
+def _classify(shape, base_shape) -> InfShape:
+    shape = tuple(int(s) for s in shape)
+    base_shape = tuple(int(s) for s in base_shape)
+    if len(shape) != len(base_shape):
+        raise ValueError(
+            f"rank mismatch {shape} vs base {base_shape}; "
+            "base model must be the same architecture at smaller width"
+        )
+    inf_dims = [i for i, (s, b) in enumerate(zip(shape, base_shape)) if s != b]
+    if len(shape) >= 2:
+        fan_in_dim = len(shape) - 2  # (fan_in, fan_out) for 2-D
+        width_mult = (
+            shape[fan_in_dim] / base_shape[fan_in_dim]
+            if fan_in_dim in inf_dims
+            else 1.0
+        )
+    else:
+        width_mult = 1.0
+    return InfShape(shape, base_shape, len(inf_dims), width_mult)
+
+
+def infer_width_mults(params_or_shapes: Any, base_shapes: Any) -> Any:
+    """Tree of :class:`InfShape` from target params (or ShapeDtypeStructs)
+    and base-model shapes (``jax.eval_shape(init_fn_base, rng)``)."""
+    return jax.tree_util.tree_map(
+        lambda p, b: _classify(np.shape(p), np.shape(b)),
+        params_or_shapes,
+        base_shapes,
+    )
+
+
+def mup_init_params(
+    init_fn: Callable,
+    rng,
+    base_shapes: Any,
+    *,
+    output_match: Callable[[tuple], bool] | None = None,
+    zero_output: bool = False,
+) -> Any:
+    """Run ``init_fn(rng)`` then apply muP init corrections.
+
+    Standard inits (normal/sqrt-fan-in) are already muP-correct for hidden
+    matrices; the output head additionally shrinks by ``1/sqrt(width_mult)``
+    (or zero-inits).  ``output_match(path_tuple)`` selects head leaves; by
+    default any leaf whose key path contains ``'lm_head'`` or ``'output'``.
+    """
+    params = init_fn(rng)
+    infshapes = infer_width_mults(params, base_shapes)
+
+    def is_output(path) -> bool:
+        # DictKey has .key, SequenceKey .idx, GetAttrKey .name.
+        names = [
+            getattr(k, "key", None)
+            or getattr(k, "name", None)
+            or getattr(k, "idx", None)
+            or str(k)
+            for k in path
+        ]
+        joined = "/".join(str(n) for n in names).lower()
+        if output_match is not None:
+            return output_match(tuple(names))
+        return "lm_head" in joined or "output" in joined
+
+    def fix(path, p, inf: InfShape):
+        if is_output(path) and inf.hidden_grown:
+            if zero_output:
+                return jnp.zeros_like(p)
+            return p / np.sqrt(inf.width_mult)
+        return p
+
+    return jax.tree_util.tree_map_with_path(fix, params, infshapes)
+
+
+def mup_scale_adam(infshapes: Any) -> optax.GradientTransformation:
+    """Per-leaf update scaling implementing MuAdam (reference
+    ``mup/optim.py``): every leaf whose fan_in grew vs base — hidden
+    matrices AND the output head — gets ``1/width_mult`` lr; vector-like
+    leaves (bias/norm) and embeddings (fan_in = vocab, fixed) have
+    ``width_mult == 1`` and pass through.  Chain AFTER the Adam core:
+    ``optax.chain(optax.adam(lr), mup_scale_adam(s))``.
+    """
+    scales = jax.tree_util.tree_map(
+        lambda inf: 1.0 / inf.width_mult,
+        infshapes,
+        is_leaf=lambda x: isinstance(x, InfShape),
+    )
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        del params
+        scaled = jax.tree_util.tree_map(
+            lambda u, s: u * s, updates, scales
+        )
+        return scaled, state
+
+    return optax.GradientTransformation(init, update)

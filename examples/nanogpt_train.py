@@ -61,6 +61,13 @@ def main() -> int:
     cfg = nanogpt.GPTConfig.tiny()
     cfg = type(cfg)(**{**cfg.__dict__, "block_size": args.seq_len})
 
+    # Round per-proc batch up to a multiple of local devices so the global
+    # batch always divides the dp axis (each process contributes
+    # local_device_count devices to the mesh regardless of nproc).
+    local_dev = jax.local_device_count()
+    if args.batch_per_proc % local_dev:
+        args.batch_per_proc = -(-args.batch_per_proc // local_dev) * local_dev
+
     devices = np.array(jax.devices())
     mesh = Mesh(devices, ("dp",))
     repl = NamedSharding(mesh, P())
