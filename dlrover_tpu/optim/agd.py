@@ -47,14 +47,22 @@ def agd(
     )
 
     def init(params):
-        zeros = lambda: jax.tree_util.tree_map(  # noqa: E731
-            lambda p: jnp.zeros(p.shape, jnp.float32), params
-        )
+        def zeros():
+            return jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+
         return AGDState(
             count=jnp.zeros((), jnp.int32),
             exp_avg=zeros(),
             exp_avg_sq=zeros(),
-            max_exp_avg_sq=zeros(),
+            # Scalar placeholders when amsgrad is off — no param-sized
+            # fp32 copy wasted in HBM/checkpoints.
+            max_exp_avg_sq=zeros()
+            if amsgrad
+            else jax.tree_util.tree_map(
+                lambda _: jnp.zeros((), jnp.float32), params
+            ),
         )
 
     def update(grads, state, params=None):

@@ -93,10 +93,13 @@ def mup_init_params(
     Standard inits (normal/sqrt-fan-in) are already muP-correct for hidden
     matrices; the output head additionally shrinks by ``1/sqrt(width_mult)``
     (or zero-inits).  ``output_match(path_tuple)`` selects head leaves; by
-    default any leaf whose key path contains ``'lm_head'`` or ``'output'``.
+    default a leaf whose LAST path component is exactly one of
+    ``lm_head/output/readout/head``.
     """
     params = init_fn(rng)
     infshapes = infer_width_mults(params, base_shapes)
+
+    _HEAD_NAMES = {"lm_head", "output", "readout", "head"}
 
     def is_output(path) -> bool:
         # DictKey has .key, SequenceKey .idx, GetAttrKey .name.
@@ -107,10 +110,12 @@ def mup_init_params(
             or str(k)
             for k in path
         ]
-        joined = "/".join(str(n) for n in names).lower()
         if output_match is not None:
             return output_match(tuple(names))
-        return "lm_head" in joined or "output" in joined
+        # Only the LAST path component counts, and only on exact match —
+        # substring matching would catch hidden projections like
+        # 'attn/output_proj' and wrongly shrink their init.
+        return bool(names) and str(names[-1]).lower() in _HEAD_NAMES
 
     def fix(path, p, inf: InfShape):
         if is_output(path) and inf.hidden_grown:

@@ -172,6 +172,7 @@ def accelerate(
     batch_axes: Optional[Any] = None,  # PartitionSpec tree for batch
     devices: Optional[Sequence] = None,
     profile_steps: int = 0,  # >0: time real steps (DRYRUN), else cost model
+    grad_accum: Optional[int] = None,  # force on every candidate
 ) -> AcceleratedJob:
     devs = list(devices) if devices is not None else jax.devices()
     n = len(devs)
@@ -184,6 +185,11 @@ def accelerate(
         ]
     else:
         candidates = list(strategy)
+    if grad_accum is not None:
+        candidates = [
+            dataclasses.replace(c, grad_accum=grad_accum)
+            for c in candidates
+        ]
 
     best: Optional[AcceleratedJob] = None
     best_score = float("inf")

@@ -7,3 +7,10 @@ elastic-aware training utilities (SURVEY.md §1 L6, reference
 """
 
 from dlrover_tpu.trainer.bootstrap import ElasticContext, init  # noqa: F401
+from dlrover_tpu.trainer.elastic import (  # noqa: F401
+    ElasticDataLoader,
+    ElasticTrainer,
+    TrainerConfig,
+    resolve_grad_accum,
+)
+from dlrover_tpu.trainer.sampler import ElasticSampler  # noqa: F401

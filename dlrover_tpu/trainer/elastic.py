@@ -1,0 +1,261 @@
+"""ElasticTrainer: fixed-global-batch elastic training.
+
+Capability parity with reference ``trainer/torch/elastic/trainer.py:181``
+(``ElasticTrainer``) and ``dataloader.py:26`` (``ElasticDataLoader``): the
+user fixes a GLOBAL batch size once; when the world is re-formed with a
+different process count the trainer preserves it by adjusting gradient
+accumulation, so the optimization trajectory (effective batch, LR schedule)
+is invariant to elasticity.
+
+TPU-native design: instead of wrapping a torch module and hooking
+``optimizer.step``, the trainer owns a pjit'd step built by
+``parallel.accelerate`` and re-builds it (new mesh + new grad-accum) on
+``reshard``.  Checkpointable trainer state (step, sampler position) rides
+the same flash-checkpoint pytree as params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.trainer.sampler import ElasticSampler
+
+
+def resolve_grad_accum(
+    global_batch_size: int, num_processes: int, max_micro_per_proc: int
+) -> tuple[int, int]:
+    """-> (micro_batch_per_proc, grad_accum) with
+    micro*accum*num_processes == global_batch_size (reference
+    ``ElasticTrainer._get_gradient_accumulation`` behaviour: accum grows as
+    the world shrinks).  Raises if the global batch cannot be preserved."""
+    if global_batch_size % num_processes:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"{num_processes} processes"
+        )
+    per_proc = global_batch_size // num_processes
+    accum = -(-per_proc // max_micro_per_proc)  # ceil
+    while per_proc % accum:
+        accum += 1
+    return per_proc // accum, accum
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    global_batch_size: int
+    max_micro_batch_per_proc: int  # memory ceiling per process
+    seq_len: int = 0
+
+
+class ElasticTrainer:
+    """Owns the sharded train step + sampler; survives re-formed worlds.
+
+    Usage (inside a worker, after ``trainer_sdk.init()``)::
+
+        trainer = ElasticTrainer(
+            cfg, loss_fn=..., init_fn=..., optimizer=...,
+            fetch_batch=lambda idx: {...np arrays...},
+            dataset_size=N,
+        )
+        trainer.build(num_processes, process_id)
+        for metrics in trainer.epoch():
+            ...
+    """
+
+    def __init__(
+        self,
+        cfg: TrainerConfig,
+        *,
+        loss_fn: Callable,
+        init_fn: Callable,
+        optimizer,
+        fetch_batch: Callable[[np.ndarray], Any],
+        dataset_size: int,
+        strategy: Any = None,
+        sampler_seed: int = 0,
+        devices=None,
+    ):
+        self.cfg = cfg
+        self.loss_fn = loss_fn
+        self.init_fn = init_fn
+        self.optimizer = optimizer
+        self.fetch_batch = fetch_batch
+        self.dataset_size = dataset_size
+        self.base_strategy = strategy
+        self.sampler_seed = sampler_seed
+        self.devices = devices
+
+        self.job = None  # AcceleratedJob
+        self.state = None
+        self.sampler: Optional[ElasticSampler] = None
+        self.num_processes = 0
+        self.process_id = 0
+        self.grad_accum = 1
+        self.micro_batch = 0
+        self._rng_seed = 0
+
+    # -- world (re)formation -------------------------------------------------
+    def build(self, num_processes: int, process_id: int) -> None:
+        """(Re)build the pjit step for the current world.  Called at start
+        and after every membership change; preserves params/opt-state if
+        already initialized (device_put onto the new sharding) and the
+        sampler position (reference ``ElasticTrainer.reset``)."""
+        import jax
+
+        from dlrover_tpu.parallel.accelerate import accelerate
+
+        self.micro_batch, self.grad_accum = resolve_grad_accum(
+            self.cfg.global_batch_size,
+            num_processes,
+            self.cfg.max_micro_batch_per_proc,
+        )
+        logger.info(
+            "elastic trainer build: %d procs, micro=%d accum=%d "
+            "(global batch %d preserved)",
+            num_processes, self.micro_batch, self.grad_accum,
+            self.cfg.global_batch_size,
+        )
+        sample_idx = np.arange(
+            self.micro_batch * self.grad_accum, dtype=np.int64
+        )
+        sample_local = self.fetch_batch(sample_idx)
+        # accelerate() wants the batch with the GLOBAL leading dim.
+        devs = self.devices
+        if devs is None:
+            devs = jax.devices()
+        sample_global = jax.tree_util.tree_map(
+            lambda x: np.repeat(
+                np.asarray(x), num_processes, axis=0
+            )[: self.micro_batch * self.grad_accum * num_processes],
+            sample_local,
+        )
+        strat = self.base_strategy
+        if strat is None:
+            strat = "auto"
+        self.job = accelerate(
+            loss_fn=self.loss_fn,
+            init_fn=self.init_fn,
+            optimizer=self.optimizer,
+            sample_batch=sample_global,
+            strategy=strat,
+            devices=devs,
+            grad_accum=self.grad_accum,
+        )
+
+        old_state = self.state
+        if old_state is None:
+            self.state = self.job.create_state(
+                jax.random.PRNGKey(self._rng_seed)
+            )
+        else:
+            # Reshard carried state onto the new mesh/sharding.
+            self.state = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s),
+                old_state,
+                self.job.state_sharding,
+            )
+
+        if self.sampler is None:
+            self.sampler = ElasticSampler(
+                self.dataset_size,
+                batch_size_per_process=self.micro_batch * self.grad_accum,
+                num_processes=num_processes,
+                process_id=process_id,
+                seed=self.sampler_seed,
+            )
+        else:
+            self.sampler = self.sampler.reshard(num_processes, process_id)
+        self.num_processes = num_processes
+        self.process_id = process_id
+
+    # -- stepping ------------------------------------------------------------
+    @property
+    def step(self) -> int:
+        if self.state is None:
+            return 0
+        return int(np.asarray(self.state["step"]))
+
+    def train_on_indices(self, indices: np.ndarray):
+        import jax
+
+        batch_np = self.fetch_batch(indices)
+        batch = jax.tree_util.tree_map(
+            lambda x, s: jax.make_array_from_process_local_data(
+                s, np.asarray(x)
+            ),
+            batch_np,
+            self.job.batch_sharding,
+        )
+        self.state, metrics = self.job.train_step(self.state, batch)
+        return metrics
+
+    def epoch(self) -> Iterator[dict]:
+        """Iterate the rest of the current epoch, yielding metrics."""
+        for indices in self.sampler:
+            yield self.train_on_indices(indices)
+
+    # -- checkpointable trainer state ---------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "sampler": self.sampler.state_dict() if self.sampler else {},
+            "global_batch_size": self.cfg.global_batch_size,
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        if self.sampler is not None and sd.get("sampler"):
+            self.sampler.load_state_dict(sd["sampler"])
+
+
+class ElasticDataLoader:
+    """Index-stream loader with master-tunable batch size (reference
+    ``ElasticDataLoader trainer/torch/elastic/dataloader.py:26``: the
+    master's strategy generator pushes ``DataLoaderConfig`` updates and the
+    loader applies them between batches)."""
+
+    def __init__(
+        self,
+        sampler: ElasticSampler,
+        fetch_batch: Callable[[np.ndarray], Any],
+        *,
+        master_client=None,
+    ):
+        self.sampler = sampler
+        self.fetch_batch = fetch_batch
+        self.client = master_client
+        self._config_version = -1
+
+    def _maybe_apply_config(self) -> None:
+        if self.client is None:
+            return
+        try:
+            cfg = self.client.get_parallel_config()
+        except Exception as e:  # noqa: BLE001
+            logger.debug("parallel-config poll failed: %s", e)
+            return
+        if cfg.version <= self._config_version:
+            return
+        if self.sampler.completed_steps != 0:
+            # Mid-epoch resume: changing the batch size would reinterpret
+            # the checkpointed position under a different partition and
+            # skip/repeat samples; apply at the next epoch boundary.
+            return
+        self._config_version = cfg.version
+        bs = cfg.dataloader.get("batch_size")
+        if bs and int(bs) != self.sampler.batch_size_per_process:
+            logger.info(
+                "dataloader: master tuned batch size %d -> %d",
+                self.sampler.batch_size_per_process, int(bs),
+            )
+            self.sampler.batch_size_per_process = int(bs)
+
+    def __iter__(self):
+        """One epoch.  Master-pushed batch-size changes apply at epoch
+        boundaries (the sampler reads its batch size when iteration
+        starts)."""
+        self._maybe_apply_config()
+        for indices in self.sampler:
+            yield self.fetch_batch(indices)

@@ -91,8 +91,12 @@ class ElasticSampler:
             if len(gbatch) < gb and self.drop_last:
                 break
             lo = self.process_id * b
-            yield gbatch[lo : lo + b]
+            # Position advances when the batch is handed out, so a
+            # state_dict() taken after the consumer finishes the step
+            # includes it (checkpoint-after-step semantics); crash recovery
+            # restores from the checkpointed state, not this live counter.
             self.completed_steps = step + 1
+            yield gbatch[lo : lo + b]
         self.epoch += 1
         self.completed_steps = 0
 

@@ -85,6 +85,12 @@ class TestAGD:
         state = tx.init(params)
         assert state.exp_avg["w"].dtype == jnp.float32
 
+    def test_no_amsgrad_state_when_disabled(self):
+        state = agd(1e-3).init({"w": jnp.ones((1024,))})
+        assert state.max_exp_avg_sq["w"].shape == ()
+        state = agd(1e-3, amsgrad=True).init({"w": jnp.ones((1024,))})
+        assert state.max_exp_avg_sq["w"].shape == (1024,)
+
 
 class TestWSAM:
     def test_two_gradients(self):

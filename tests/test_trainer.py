@@ -1,0 +1,183 @@
+"""ElasticTrainer / ElasticDataLoader tests (SURVEY.md #28 parity).
+
+Mirrors the reference's elastic-trainer unit tests: verify the fixed-
+global-batch invariant across world sizes, state carry-over through a
+simulated membership change (reshard), and master-tunable dataloader
+batch size.
+"""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.trainer.elastic import (
+    ElasticDataLoader,
+    ElasticTrainer,
+    TrainerConfig,
+    resolve_grad_accum,
+)
+from dlrover_tpu.trainer.sampler import ElasticSampler
+
+
+class TestResolveGradAccum:
+    def test_exact_fit(self):
+        micro, accum = resolve_grad_accum(64, 8, 8)
+        assert (micro, accum) == (8, 1)
+
+    def test_world_shrinks_accum_grows(self):
+        micro, accum = resolve_grad_accum(64, 4, 8)
+        assert micro * accum * 4 == 64
+        assert accum == 2
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            resolve_grad_accum(64, 3, 8)
+
+    def test_awkward_micro_ceiling(self):
+        micro, accum = resolve_grad_accum(60, 2, 8)
+        assert micro * accum * 2 == 60
+        assert micro <= 8
+
+
+def _quadratic_trainer(devices, global_batch=16, max_micro=8):
+    import jax.numpy as jnp
+    import optax
+
+    d = 8
+    rng = np.random.RandomState(3)
+    w_true = rng.randn(d, 1).astype(np.float32)
+    data_x = rng.randn(512, d).astype(np.float32)
+    data_y = (data_x @ w_true).astype(np.float32)
+
+    def fetch_batch(indices):
+        return {"x": data_x[indices % 512], "y": data_y[indices % 512]}
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def init_fn(rng_key):
+        import jax
+
+        return {"w": jax.random.normal(rng_key, (d, 1)) * 0.1}
+
+    from dlrover_tpu.parallel.accelerate import Strategy
+    from dlrover_tpu.parallel.mesh import MeshSpec
+
+    return ElasticTrainer(
+        TrainerConfig(
+            global_batch_size=global_batch,
+            max_micro_batch_per_proc=max_micro,
+        ),
+        loss_fn=loss_fn,
+        init_fn=init_fn,
+        optimizer=optax.adam(3e-2),
+        fetch_batch=fetch_batch,
+        dataset_size=512,
+        strategy=Strategy(mesh=MeshSpec(dp=len(devices))),
+        devices=devices,
+    )
+
+
+class TestElasticTrainer:
+    def test_trains_and_survives_reshard(self, cpu_mesh_devices):
+        # Single-process world over 4 devices; the "membership change" is
+        # simulated by rebuilding over 2 devices — global batch preserved
+        # via grad accumulation.
+        trainer = _quadratic_trainer(cpu_mesh_devices[:4], global_batch=16,
+                                     max_micro=16)
+        trainer.build(num_processes=1, process_id=0)
+        losses = [
+            float(m["loss"])
+            for _, m in zip(range(5), trainer.epoch())
+        ]
+        step_before = trainer.step
+        assert step_before == 5
+        sampler_pos = trainer.sampler.completed_steps
+        assert sampler_pos == 5
+
+        # reshard to a smaller world; state (params/step) carries over
+        trainer.devices = cpu_mesh_devices[:2]
+        from dlrover_tpu.parallel.accelerate import Strategy
+        from dlrover_tpu.parallel.mesh import MeshSpec
+
+        trainer.base_strategy = Strategy(mesh=MeshSpec(dp=2))
+        trainer.build(num_processes=1, process_id=0)
+        assert trainer.step == step_before  # state survived
+        assert trainer.sampler.completed_steps == sampler_pos
+        more = [
+            float(m["loss"])
+            for _, m in zip(range(5), trainer.epoch())
+        ]
+        assert trainer.step == step_before + 5
+        assert more[-1] < losses[0]  # still converging after reshard
+
+    def test_auto_strategy_keeps_grad_accum(self, cpu_mesh_devices):
+        # strategy=None ("auto") must still compile the resolved accum,
+        # or the micro-batch memory ceiling is silently violated.
+        t = _quadratic_trainer(cpu_mesh_devices[:2], global_batch=16,
+                               max_micro=4)
+        t.base_strategy = None
+        t.build(1, 0)
+        assert t.grad_accum == 4
+        assert t.job.strategy.grad_accum == 4
+
+    def test_global_batch_invariant(self, cpu_mesh_devices):
+        # Same seed, same global batch: 1-accum and 2-accum runs follow the
+        # same loss trajectory (the ElasticTrainer guarantee).
+        t1 = _quadratic_trainer(cpu_mesh_devices[:4], global_batch=16,
+                                max_micro=16)
+        t1.build(1, 0)
+        l1 = [float(m["loss"]) for _, m in zip(range(4), t1.epoch())]
+
+        t2 = _quadratic_trainer(cpu_mesh_devices[:4], global_batch=16,
+                                max_micro=8)  # forces accum=2
+        t2.build(1, 0)
+        assert t2.grad_accum == 2
+        l2 = [float(m["loss"]) for _, m in zip(range(4), t2.epoch())]
+        np.testing.assert_allclose(l1, l2, rtol=2e-3)
+
+
+class _FakeParallelConfigClient:
+    def __init__(self, batch_size):
+        self.batch_size = batch_size
+        self.version = 1
+
+    def get_parallel_config(self):
+        from dlrover_tpu.common import messages as m
+
+        return m.ParallelConfig(
+            dataloader={"batch_size": self.batch_size},
+            version=self.version,
+        )
+
+
+class TestElasticDataLoader:
+    def test_epoch_batches(self):
+        sampler = ElasticSampler(
+            64, batch_size_per_process=8, num_processes=2, process_id=0,
+            shuffle=False,
+        )
+        loader = ElasticDataLoader(sampler, lambda idx: idx.copy())
+        batches = list(loader)
+        assert len(batches) == 4  # 64/(8*2)
+        assert all(len(b) == 8 for b in batches)
+
+    def test_master_tunes_batch_size(self):
+        sampler = ElasticSampler(
+            64, batch_size_per_process=8, num_processes=2, process_id=0,
+            shuffle=False,
+        )
+        client = _FakeParallelConfigClient(batch_size=16)
+        loader = ElasticDataLoader(
+            sampler, lambda idx: idx.copy(), master_client=client
+        )
+        batches = list(loader)
+        assert all(len(b) == 16 for b in batches)
+        # stale version is not re-applied
+        client.batch_size = 4
+        batches = list(loader)
+        assert all(len(b) == 16 for b in batches)
+        # new version is
+        client.version = 2
+        batches = list(loader)
+        assert all(len(b) == 4 for b in batches)
