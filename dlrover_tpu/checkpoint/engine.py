@@ -43,15 +43,15 @@ from dlrover_tpu.common.storage import CheckpointStorage, PosixDiskStorage
 
 
 def ckpt_queue_name(job_name: str) -> str:
-    return f"{job_name}-ckptq"
+    return env_utils.run_scoped(f"{job_name}-ckptq")
 
 
 def ckpt_lock_name(job_name: str, local_rank: int) -> str:
-    return f"{job_name}-ckptlock-{local_rank}"
+    return env_utils.run_scoped(f"{job_name}-ckptlock-{local_rank}")
 
 
 def ckpt_stat_name(job_name: str) -> str:
-    return f"{job_name}-ckptstat"
+    return env_utils.run_scoped(f"{job_name}-ckptstat")
 
 
 class CheckpointEngine:
@@ -231,6 +231,7 @@ class CheckpointEngine:
         With ``target`` given, returns (pytree-like-target, meta); without,
         returns (ShardSource, meta) for caller-side assembly."""
         got = self._load_from_shm()
+        got = self._agree_shm_step(got)
         if got is None:
             got = self._load_from_storage()
         if got is None:
@@ -242,6 +243,38 @@ class CheckpointEngine:
             return source, meta
         state = tree_utils.restore_to_target(target, source)
         return state, meta
+
+    def _agree_shm_step(self, got):
+        """Cross-rank shard-step consistency check (reference ckpt_saver's
+        ``check_complete_step_before_save`` / shard-step checks): a warm
+        restore is only valid when every process staged the SAME step —
+        staging lag at a crash can leave ranks a few steps apart, and mixing
+        them silently corrupts replicated state.  On disagreement fall back
+        to storage, whose commit protocol is all-ranks-atomic.
+
+        Every process must call this (it is a collective)."""
+        if self.num_processes <= 1:
+            return got
+        try:
+            from jax.experimental import multihost_utils
+
+            if jax.process_count() != self.num_processes:
+                return got
+            own = -1 if got is None else int(got[1].get("step", -1))
+            steps = np.asarray(
+                multihost_utils.process_allgather(np.int64(own))
+            ).reshape(-1)
+        except Exception:  # noqa: BLE001 - not in a distributed context
+            return got
+        if (steps >= 0).all() and (steps == steps[0]).all():
+            return got
+        if got is not None:
+            logger.warning(
+                "shm restore steps disagree across ranks (%s); "
+                "falling back to committed storage checkpoint",
+                steps.tolist(),
+            )
+        return None
 
     def _load_from_shm(self):
         try:

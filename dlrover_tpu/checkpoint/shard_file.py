@@ -51,6 +51,8 @@ def pack_shard(tensors: Dict[str, np.ndarray], extra: dict) -> bytes:
     blobs = []
     offset = 0
     for key, arr in tensors.items():
+        shape = list(np.shape(arr))
+        # ascontiguousarray promotes 0-d to 1-d; keep the true shape.
         arr = np.ascontiguousarray(arr)
         try:
             dtype_key = (
@@ -62,7 +64,7 @@ def pack_shard(tensors: Dict[str, np.ndarray], extra: dict) -> bytes:
             dtype_key = arr.dtype.str
         metas[key] = {
             "dtype": dtype_key,
-            "shape": list(arr.shape),
+            "shape": shape,
             "offset": offset,
             "nbytes": int(arr.nbytes),
         }

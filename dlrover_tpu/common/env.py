@@ -38,6 +38,20 @@ def get_job_name() -> str:
     return get_env_str(NodeEnv.JOB_NAME, "local-job")
 
 
+def get_run_id() -> str:
+    """Unique id of one launcher invocation (set by ``tpurun``).  Namespaces
+    host-local IPC objects (shm arenas, queues, locks) so a fresh launch
+    never warm-restores from a previous job's stale arena, while worker
+    restarts *within* a launch still share state."""
+    return get_env_str("DLROVER_TPU_RUN_ID", "")
+
+
+def run_scoped(name: str) -> str:
+    """Append the run id (when set) to an IPC object name."""
+    rid = get_run_id()
+    return f"{name}-{rid}" if rid else name
+
+
 def get_process_id() -> int:
     return get_env_int(NodeEnv.PROCESS_ID, 0)
 

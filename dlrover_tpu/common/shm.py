@@ -381,6 +381,10 @@ class SharedMemoryArena:
 
 
 def arena_name(job_name: str, local_rank: int, purpose: str = "ckpt") -> str:
-    """Canonical per-rank arena naming (reference ``_get_shm_name``)."""
-    safe = job_name.replace("/", "_")
+    """Canonical per-rank arena naming (reference ``_get_shm_name``),
+    scoped by the launcher run id so a fresh launch never reads a stale
+    arena left by a previous job of the same name."""
+    from dlrover_tpu.common.env import run_scoped
+
+    safe = run_scoped(job_name).replace("/", "_")
     return f"dlrtpu_{safe}_{purpose}_{local_rank}"

@@ -1,0 +1,312 @@
+"""Distributed job manager: launch, monitor and relaunch platform nodes.
+
+Parity with reference ``master/node/dist_job_manager.py`` (``DistributedJob
+Manager :93``: ``_monitor_nodes :448``, ``_process_event :694``,
+``_relaunch_node :918``) + ``training_node.py:185``.  Extends the local
+manager (which owns the RPC-facing bookkeeping) with:
+
+- initial node creation from :class:`JobArgs` via a scaler,
+- a watcher feeding platform events into :meth:`process_event`,
+- the relaunch ladder (exit-reason policy, relaunch budget, critical nodes),
+- slice-aware failure handling (a preempted slice fails all its hosts),
+- heartbeat-timeout -> treat as node death (reference
+  ``_monitor_node_heart_beat``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.event_callback import NodeEventCallback
+from dlrover_tpu.master.node_manager import LocalJobManager
+from dlrover_tpu.master.resource_optimizer import ResourceOptimizer
+from dlrover_tpu.master.scaler import ScalePlan, Scaler
+from dlrover_tpu.master.watcher import NodeWatcher
+from dlrover_tpu.scheduler.job import JobArgs
+from dlrover_tpu.scheduler.platform import (
+    PlatformClient,
+    PlatformNodeEvent,
+)
+
+# Exit reasons that never consume relaunch budget (the node did nothing
+# wrong; reference ``dist_job_manager.py`` preemption/killed handling).
+_BLAMELESS_EXITS = frozenset(
+    {NodeExitReason.PREEMPTED, NodeExitReason.KILLED, NodeExitReason.RELAUNCHED}
+)
+
+
+class DistributedJobManager(LocalJobManager):
+    def __init__(
+        self,
+        job_args: JobArgs,
+        platform: PlatformClient,
+        scaler: Scaler,
+        resource_optimizer: Optional[ResourceOptimizer] = None,
+    ):
+        super().__init__(job_args.job_name)
+        self._job_args = job_args
+        self._platform = platform
+        self._scaler = scaler
+        self._resource_optimizer = resource_optimizer
+        self._watcher = NodeWatcher(platform, self.process_event)
+        self._callbacks: List[NodeEventCallback] = []
+        self._id_iter = itertools.count()
+        self._stopped_early: Dict[int, str] = {}
+        # Heartbeat-timeout deaths feed the same failure ladder as platform
+        # events (reference _monitor_node_heart_beat -> _process_event).
+        self.on_node_dead = self._on_heartbeat_dead
+
+    def _on_heartbeat_dead(self, node: Node) -> None:
+        node.exit_reason = node.exit_reason or NodeExitReason.UNKNOWN_ERROR
+        self._fire(lambda cb: cb.on_node_failed(node))
+        self._handle_node_failure(node)
+
+    # -- lifecycle ---------------------------------------------------------
+    def add_node_event_callback(self, cb: NodeEventCallback) -> None:
+        self._callbacks.append(cb)
+
+    def start(self) -> None:
+        super().start()  # heartbeat monitor
+        self._create_initial_nodes()
+        for ev in self._watcher.list_current():
+            self.process_event(ev)
+        self._watcher.start()
+
+    def stop(self) -> None:
+        super().stop()
+        self._watcher.stop()
+
+    def _create_initial_nodes(self) -> None:
+        plan = ScalePlan()
+        for node_type, group in self._job_args.node_groups.items():
+            for _ in range(group.count):
+                node_id = next(self._id_iter)
+                node = Node(
+                    node_type,
+                    node_id,
+                    rank_index=node_id,
+                    config_resource=group.resource,
+                    max_relaunch_count=group.restart_count,
+                    critical=group.critical,
+                )
+                with self._lock:
+                    self._nodes[node.id] = node
+                plan.launch_nodes.append(node)
+        self._scaler.scale(plan)
+
+    # -- event loop (reference _process_event :694) ------------------------
+    def process_event(self, event: PlatformNodeEvent) -> None:
+        pn = event.node
+        with self._lock:
+            node = self._nodes.get(pn.node_id)
+            if node is None:
+                # Node created out-of-band (reconciliation path).
+                node = Node(
+                    pn.node_type,
+                    pn.node_id,
+                    rank_index=pn.rank_index,
+                    name=pn.name,
+                )
+                self._nodes[pn.node_id] = node
+            node.name = pn.name or node.name
+            if pn.slice_id:
+                node.slice_id = pn.slice_id
+            old_status = node.status
+            new_status = (
+                NodeStatus.DELETED
+                if event.event_type == NodeEventType.DELETED
+                else pn.status
+            )
+            node.update_status(new_status)
+            changed = node.status != old_status
+            if pn.exit_reason:
+                node.exit_reason = pn.exit_reason
+        if not changed:
+            return
+        logger.info(
+            "node event: %s %s -> %s (%s)",
+            node.name, old_status, node.status, node.exit_reason,
+        )
+        if node.status == NodeStatus.RUNNING:
+            self._fire(lambda cb: cb.on_node_started(node))
+        elif node.status == NodeStatus.SUCCEEDED:
+            self._fire(lambda cb: cb.on_node_succeeded(node))
+        elif node.status in (NodeStatus.FAILED, NodeStatus.BREAKDOWN):
+            self._fire(lambda cb: cb.on_node_failed(node))
+            self._handle_node_failure(node)
+        elif node.status == NodeStatus.DELETED:
+            self._fire(lambda cb: cb.on_node_deleted(node))
+            if not self._expected_deletion(node):
+                self._handle_node_failure(node)
+
+    def _expected_deletion(self, node: Node) -> bool:
+        # Released nodes were deleted by us (relaunch replacement or
+        # scale-down) — their DELETED event is not a new failure.
+        with self._lock:
+            return node.is_released or node.id in self._stopped_early
+
+    def _fire(self, fn) -> None:
+        for cb in self._callbacks:
+            try:
+                fn(cb)
+            except Exception:
+                logger.exception("node event callback failed")
+
+    # -- relaunch ladder (reference _relaunch_node :918) -------------------
+    def _handle_node_failure(self, node: Node) -> None:
+        if node.exit_reason == NodeExitReason.OOM and self._resource_optimizer:
+            plan = self._resource_optimizer.generate_oom_recovery_plan([node])
+            new_res = plan.node_resources.get(node.name)
+            if new_res is not None:
+                node.config_resource = new_res
+        blameless = node.exit_reason in _BLAMELESS_EXITS
+        if not blameless and not self._job_args.relaunch_always:
+            if node.is_unrecoverable_failure():
+                logger.error(
+                    "node %s unrecoverable (%s, relaunches=%d)",
+                    node.name, node.exit_reason, node.relaunch_count,
+                )
+                if node.critical:
+                    self._on_critical_node_lost(node)
+                return
+        self._relaunch_node(node, count_budget=not blameless)
+
+    def _relaunch_node(self, node: Node, count_budget: bool = True) -> None:
+        with self._lock:
+            new_id = next(self._id_iter)
+            new_node = node.get_relaunch_node(new_id)
+            if not count_budget:
+                new_node.relaunch_count = node.relaunch_count
+            new_node.slice_id = node.slice_id
+            self._nodes[new_id] = new_node
+            node.relaunchable = False
+            node.is_released = True
+        logger.info(
+            "relaunching %s as %s (relaunch_count=%d)",
+            node.name, new_node.name, new_node.relaunch_count,
+        )
+        plan = ScalePlan(
+            launch_nodes=[new_node],
+            remove_nodes=[node] if node.name else [],
+        )
+        self._scaler.scale(plan)
+
+    def _on_critical_node_lost(self, node: Node) -> None:
+        logger.error("critical node %s lost; job cannot continue", node.name)
+        if self.on_critical_failure is not None:
+            self.on_critical_failure(node)
+
+    on_critical_failure = None  # set by the master
+
+    # -- external mutations ------------------------------------------------
+    def scale_workers_to(self, count: int) -> int:
+        """Adjust live worker count to ``count`` (auto-scaler entry).
+        Returns the delta actually applied."""
+        group = self._job_args.workers
+        count = group.clamp(count)
+        with self._lock:
+            live = [
+                n
+                for n in self._nodes.values()
+                if n.type == NodeType.WORKER
+                and not n.is_released
+                and n.status
+                in (NodeStatus.INITIAL, NodeStatus.PENDING, NodeStatus.RUNNING)
+            ]
+            delta = count - len(live)
+            if delta == 0:
+                return 0
+            plan = ScalePlan()
+            if delta > 0:
+                for _ in range(delta):
+                    node_id = next(self._id_iter)
+                    node = Node(
+                        NodeType.WORKER,
+                        node_id,
+                        rank_index=node_id,
+                        config_resource=group.resource,
+                        max_relaunch_count=group.restart_count,
+                    )
+                    self._nodes[node_id] = node
+                    plan.launch_nodes.append(node)
+            else:
+                # Shrink from the highest ranks so surviving ranks stay
+                # contiguous for the next rendezvous round.
+                victims = sorted(live, key=lambda n: -n.rank_index)[:-delta]
+                for v in victims:
+                    v.relaunchable = False
+                    v.is_released = True
+                    self._stopped_early[v.id] = "scaled_down"
+                    plan.remove_nodes.append(v)
+        self._scaler.scale(plan)
+        return delta
+
+    def handle_training_failure(
+        self, node_id: int, restart_count: int, error_data: str, level: str
+    ) -> None:
+        """RPC entry: an agent reports a worker failure it can't absorb
+        (reference servicer ``report_failure``)."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+        if node is None:
+            return
+        node.exit_reason = NodeExitReason.FATAL_ERROR if level == "fatal" else (
+            node.exit_reason or NodeExitReason.UNKNOWN_ERROR
+        )
+        logger.warning(
+            "agent-reported failure on %s (restarts=%d): %s",
+            node.name, restart_count, error_data[:200],
+        )
+
+    # -- views -------------------------------------------------------------
+    def alive_workers(self) -> List[Node]:
+        with self._lock:
+            return [
+                n
+                for n in self._nodes.values()
+                if n.type == NodeType.WORKER
+                and n.status == NodeStatus.RUNNING
+            ]
+
+    def pending_workers(self) -> List[Node]:
+        with self._lock:
+            return [
+                n
+                for n in self._nodes.values()
+                if n.type == NodeType.WORKER
+                and n.status in (NodeStatus.INITIAL, NodeStatus.PENDING)
+            ]
+
+    def all_workers_exited(self) -> bool:
+        with self._lock:
+            workers = [
+                n
+                for n in self._nodes.values()
+                if n.type == NodeType.WORKER and not n.is_released
+            ]
+            return bool(workers) and all(
+                n.status in NodeStatus.TERMINAL for n in workers
+            )
+
+    def all_workers_succeeded(self) -> bool:
+        # Released nodes were replaced or scaled away; only live lineage
+        # members count toward job success.
+        with self._lock:
+            workers = [
+                n
+                for n in self._nodes.values()
+                if n.type == NodeType.WORKER and not n.is_released
+            ]
+            return bool(workers) and all(
+                n.status == NodeStatus.SUCCEEDED for n in workers
+            )

@@ -1,0 +1,180 @@
+"""Distributed job master: the per-job control plane for platform jobs.
+
+Parity with reference ``master/dist_master.py:89`` (``DistributedJobMaster``:
+compose JobManager + RendezvousManagers + TaskManager + SpeedMonitor +
+servicer; run loop ``:226``, ``request_stop :323``).  Differences from
+:class:`~dlrover_tpu.master.master.LocalJobMaster`: nodes are platform
+objects created/relaunched through a scaler, watched through a watcher, and
+auto-scaled during training.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.constants import (
+    JobExitReason,
+    JobStage,
+    RendezvousName,
+)
+from dlrover_tpu.common.global_context import get_context
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RpcServer
+from dlrover_tpu.master.dist_job_manager import DistributedJobManager
+from dlrover_tpu.master.event_callback import (
+    AllReduceNodeHandlingCallback,
+    TaskRescheduleCallback,
+)
+from dlrover_tpu.master.job_auto_scaler import new_job_auto_scaler
+from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.rendezvous import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.resource_optimizer import (
+    LocalHeuristicOptimizer,
+    ResourceOptimizer,
+)
+from dlrover_tpu.master.scaler import PlatformScaler, Scaler
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.sync_service import SyncService
+from dlrover_tpu.master.task_manager import TaskManager
+from dlrover_tpu.scheduler.job import JobArgs
+from dlrover_tpu.scheduler.platform import (
+    PlatformClient,
+    new_platform_client,
+)
+
+
+class DistributedJobMaster(JobMaster):
+    def __init__(
+        self,
+        job_args: JobArgs,
+        port: int = 0,
+        platform: Optional[PlatformClient] = None,
+        scaler: Optional[Scaler] = None,
+        resource_optimizer: Optional[ResourceOptimizer] = None,
+    ):
+        self.job_args = job_args
+        self._ctx = get_context()
+        self.stage = JobStage.INIT
+        self._exit_code = 0
+        self._exit_reason = ""
+        self._stop_event = threading.Event()
+
+        self.platform = platform or new_platform_client(job_args.platform)
+        self.scaler = scaler or PlatformScaler(
+            job_args.job_name,
+            self.platform,
+            hosts_per_slice=job_args.hosts_per_slice,
+        )
+        self.resource_optimizer = resource_optimizer or (
+            LocalHeuristicOptimizer()
+        )
+
+        self.task_manager = TaskManager()
+        self.speed_monitor = SpeedMonitor()
+        self.kv_store = KVStoreService()
+        self.sync_service = SyncService()
+        self.job_manager = DistributedJobManager(
+            job_args, self.platform, self.scaler, self.resource_optimizer
+        )
+        workers = job_args.workers
+        self.rdzv_managers = {
+            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+        }
+        for mgr in self.rdzv_managers.values():
+            mgr.update_rdzv_params(
+                workers.min_count,
+                workers.max_count,
+                node_unit=job_args.node_unit,
+            )
+        self.job_manager.add_node_event_callback(
+            TaskRescheduleCallback(self.task_manager)
+        )
+        self.job_manager.add_node_event_callback(
+            AllReduceNodeHandlingCallback(
+                self.rdzv_managers, self.speed_monitor
+            )
+        )
+        self.job_manager.on_critical_failure = lambda node: self.request_stop(
+            False, JobExitReason.NODE_ERROR
+        )
+        self.auto_scaler = new_job_auto_scaler(
+            job_args,
+            self.job_manager,
+            self.speed_monitor,
+            self.resource_optimizer,
+        )
+        self.diagnosis_manager = None
+
+        self.servicer = MasterServicer(
+            task_manager=self.task_manager,
+            job_manager=self.job_manager,
+            rdzv_managers=self.rdzv_managers,
+            kv_store=self.kv_store,
+            sync_service=self.sync_service,
+            speed_monitor=self.speed_monitor,
+            diagnosis_manager=self.diagnosis_manager,
+            job_context=self,
+        )
+        self._server = RpcServer(port, self.servicer)
+        self.run_config: dict = {}
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def prepare(self) -> None:
+        self._server.start()
+        self.task_manager.start()
+        self.job_manager.start()
+        self.auto_scaler.start_auto_scaling()
+        self.stage = JobStage.RUNNING
+        logger.info(
+            "distributed master for %s ready on :%d (%s)",
+            self.job_args.job_name, self.port, self.job_args.platform,
+        )
+
+    def run(self) -> int:
+        try:
+            while not self._stop_event.wait(2.0):
+                if self.job_manager.all_workers_exited():
+                    success = self.job_manager.all_workers_succeeded()
+                    self.request_stop(
+                        success,
+                        JobExitReason.SUCCEEDED
+                        if success
+                        else JobExitReason.NODE_ERROR,
+                    )
+        finally:
+            self.stop()
+        return self._exit_code
+
+    def request_stop(self, success: bool, reason: str) -> None:
+        if self.stage == JobStage.STOPPING:
+            return
+        self.stage = JobStage.STOPPING
+        self._exit_code = 0 if success else 1
+        self._exit_reason = reason
+        logger.info(
+            "master stopping: success=%s reason=%s goodput=%.3f",
+            success, reason, self.speed_monitor.goodput(),
+        )
+        self._stop_event.set()
+
+    def stop(self) -> None:
+        self.stage = JobStage.STOPPED
+        self.auto_scaler.stop_auto_scaling()
+        self.task_manager.stop()
+        self.job_manager.stop()
+        self._server.stop()
+        self.platform.close()

@@ -1,0 +1,82 @@
+"""Node-event callbacks: hooks on node start/succeed/fail.
+
+Parity with reference ``master/node/event_callback.py`` (``NodeEventCallback
+:42``, ``TaskRescheduleCallback :111``, ``AllReduceNodeHandlingCallback
+:218``; the TF-PS variant maps to the embedding-store callback).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node
+
+if TYPE_CHECKING:  # pragma: no cover
+    from dlrover_tpu.master.rendezvous import RendezvousManager
+    from dlrover_tpu.master.speed_monitor import SpeedMonitor
+    from dlrover_tpu.master.task_manager import TaskManager
+
+
+class NodeEventCallback:
+    """ABC (reference ``event_callback.py:42``)."""
+
+    def on_node_started(self, node: Node) -> None: ...
+
+    def on_node_succeeded(self, node: Node) -> None: ...
+
+    def on_node_failed(self, node: Node) -> None: ...
+
+    def on_node_deleted(self, node: Node) -> None: ...
+
+
+class TaskRescheduleCallback(NodeEventCallback):
+    """Requeue the data shards a dead worker was holding
+    (reference ``:111``)."""
+
+    def __init__(self, task_manager: "TaskManager"):
+        self._task_manager = task_manager
+
+    def on_node_failed(self, node: Node) -> None:
+        if node.type == NodeType.WORKER:
+            n = self._task_manager.recover_worker_tasks(node.id)
+            if n:
+                logger.info(
+                    "rescheduled %d shards of failed worker %d", n, node.id
+                )
+
+    def on_node_deleted(self, node: Node) -> None:
+        self.on_node_failed(node)
+
+
+class AllReduceNodeHandlingCallback(NodeEventCallback):
+    """Keeps rendezvous membership and the speed monitor in sync with node
+    lifecycle (reference ``:218``): failure -> remove from the alive list so
+    the next round forms without it; start -> mark the world resizable and
+    pause the speed clock until the new round trains.
+    """
+
+    def __init__(
+        self,
+        rdzv_managers: dict,
+        speed_monitor: "SpeedMonitor",
+    ):
+        self._rdzv_managers = rdzv_managers
+        self._speed_monitor = speed_monitor
+
+    def on_node_started(self, node: Node) -> None:
+        for mgr in self._rdzv_managers.values():
+            mgr.add_alive_node(node.id)
+
+    def on_node_succeeded(self, node: Node) -> None:
+        for mgr in self._rdzv_managers.values():
+            mgr.remove_alive_node(node.id)
+
+    def on_node_failed(self, node: Node) -> None:
+        for mgr in self._rdzv_managers.values():
+            mgr.remove_alive_node(node.id)
+        self._speed_monitor.mark_down()
+
+    def on_node_deleted(self, node: Node) -> None:
+        self.on_node_failed(node)

@@ -1,0 +1,125 @@
+"""Resource optimizer: runtime stats -> ResourcePlan.
+
+Parity with reference ``master/resource/optimizer.py`` (``ResourceOptimizer``
+ABC ``:134``), ``local_optimizer.py:66`` (heuristics) and the job-level
+policy objects (``job.py:196 PSJobResourceOptimizer``,
+``:517 AllreduceJobResourceOptimizer``).  The Brain-service-backed variant
+lives in ``dlrover_tpu.brain.optimizer`` (reference
+``brain_optimizer.py:64``).
+
+TPU heuristics differ from the GPU/PS reference in the scaling quantum:
+worker count moves in whole slices (or ``node_unit`` hosts), and the OOM
+bump targets host RAM (the HBM working set is fixed by the sharding, so an
+OOM on-device means a *sharding* change — reported to the paral-config
+generator, not solved by adding RAM).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeExitReason, NodeType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+
+
+@dataclasses.dataclass
+class ResourcePlan:
+    """Desired per-type counts/resources (reference ``ResourcePlan``)."""
+
+    node_group_resources: Dict[str, NodeGroupResource] = dataclasses.field(
+        default_factory=dict
+    )
+    node_resources: Dict[str, NodeResource] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def empty(self) -> bool:
+        return not self.node_group_resources and not self.node_resources
+
+
+class ResourceOptimizer:
+    """ABC (reference ``optimizer.py:134``)."""
+
+    def generate_job_create_resource(self) -> ResourcePlan:
+        raise NotImplementedError
+
+    def generate_oom_recovery_plan(
+        self, oom_nodes: List[Node]
+    ) -> ResourcePlan:
+        raise NotImplementedError
+
+    def generate_resource_plan_with_optimizer(
+        self, stats: dict
+    ) -> ResourcePlan:
+        raise NotImplementedError
+
+
+class LocalHeuristicOptimizer(ResourceOptimizer):
+    """Brain-less heuristics (reference ``PSLocalOptimizer local_optimizer
+    .py:66``, adapted): OOM -> host-memory bump by ``oom_factor``;
+    speed-based worker count suggestion capped by the group max.
+    """
+
+    def __init__(
+        self,
+        worker_group: Optional[NodeGroupResource] = None,
+        oom_factor: float = 1.5,
+        target_speedup_threshold: float = 0.8,
+    ):
+        self._worker_group = worker_group or NodeGroupResource()
+        self._oom_factor = oom_factor
+        # Keep scaling up while marginal throughput per added node stays
+        # above this fraction of linear.
+        self._speedup_threshold = target_speedup_threshold
+
+    def generate_job_create_resource(self) -> ResourcePlan:
+        plan = ResourcePlan()
+        plan.node_group_resources[NodeType.WORKER] = self._worker_group
+        return plan
+
+    def generate_oom_recovery_plan(
+        self, oom_nodes: List[Node]
+    ) -> ResourcePlan:
+        plan = ResourcePlan()
+        for node in oom_nodes:
+            if node.exit_reason != NodeExitReason.OOM:
+                continue
+            res = node.config_resource
+            new = NodeResource(
+                cpu=res.cpu,
+                memory_mb=max(1, int(res.memory_mb * self._oom_factor)),
+                tpu_chips=res.tpu_chips,
+                tpu_type=res.tpu_type,
+            )
+            plan.node_resources[node.name] = new
+            logger.info(
+                "OOM recovery: %s memory %dMi -> %dMi",
+                node.name, res.memory_mb, new.memory_mb,
+            )
+        return plan
+
+    def generate_resource_plan_with_optimizer(
+        self, stats: dict
+    ) -> ResourcePlan:
+        """``stats``: {"speed_history": [(num_workers, samples/s), ...],
+        "current_workers": int}.  Suggests more workers while scaling is
+        still near-linear (reference allreduce optimizer
+        ``job.py:517`` asks Brain; here: local extrapolation)."""
+        plan = ResourcePlan()
+        history = stats.get("speed_history") or []
+        current = stats.get("current_workers", 0)
+        if len(history) < 2 or current <= 0:
+            return plan
+        (n0, s0), (n1, s1) = history[-2], history[-1]
+        if n1 == n0 or s0 <= 0:
+            return plan
+        marginal = (s1 - s0) / max(1e-9, (n1 - n0) * (s0 / n0))
+        if marginal >= self._speedup_threshold:
+            group = NodeGroupResource(
+                count=current + max(1, n1 - n0),
+                node_resource=self._worker_group.node_resource,
+            )
+            plan.node_group_resources[NodeType.WORKER] = group
+        return plan

@@ -1,0 +1,133 @@
+"""Scalers: execute a ScalePlan against the platform.
+
+Parity with reference ``master/scaler/base_scaler.py`` (``ScalePlan :21``,
+``Scaler :49``) + ``pod_scaler.py:80`` (creates/deletes pods directly) +
+``elasticjob_scaler.py:153`` (emits ScalePlan CRs for the operator).  TPU
+semantics: scale-up respects the slice quantum — new hosts are grouped into
+slices of ``hosts_per_slice``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.scheduler.platform import PlatformClient, _node_name
+
+
+@dataclasses.dataclass
+class ScalePlan:
+    """What the job should look like after scaling
+    (reference ``base_scaler.py:21``)."""
+
+    # Desired total count per node type (empty = unchanged).
+    node_group_resources: Dict[str, NodeGroupResource] = dataclasses.field(
+        default_factory=dict
+    )
+    # Specific nodes to (re)launch / remove.
+    launch_nodes: List[Node] = dataclasses.field(default_factory=list)
+    remove_nodes: List[Node] = dataclasses.field(default_factory=list)
+    ps_addrs: List[str] = dataclasses.field(default_factory=list)
+
+    def empty(self) -> bool:
+        return (
+            not self.node_group_resources
+            and not self.launch_nodes
+            and not self.remove_nodes
+        )
+
+    def to_json(self) -> str:
+        def enc(o):
+            if isinstance(o, Node):
+                return o.to_dict()
+            return dataclasses.asdict(o)
+
+        return json.dumps(
+            {
+                "node_group_resources": {
+                    t: dataclasses.asdict(g)
+                    for t, g in self.node_group_resources.items()
+                },
+                "launch_nodes": [n.to_dict() for n in self.launch_nodes],
+                "remove_nodes": [n.to_dict() for n in self.remove_nodes],
+            }
+        )
+
+
+class Scaler:
+    """ABC (reference ``base_scaler.py:49``)."""
+
+    def __init__(self, job_name: str):
+        self._job_name = job_name
+
+    def scale(self, plan: ScalePlan) -> None:
+        raise NotImplementedError
+
+
+class PlatformScaler(Scaler):
+    """Creates/deletes nodes directly via the platform client
+    (reference ``PodScaler pod_scaler.py:80``: ``scale :200``,
+    ``_scale_up_pods :348``)."""
+
+    def __init__(
+        self,
+        job_name: str,
+        platform: PlatformClient,
+        hosts_per_slice: int = 1,
+    ):
+        super().__init__(job_name)
+        self._platform = platform
+        self._hosts_per_slice = max(1, hosts_per_slice)
+        self._lock = threading.Lock()
+
+    def scale(self, plan: ScalePlan) -> None:
+        if plan.empty():
+            return
+        with self._lock:
+            for node in plan.launch_nodes:
+                if not node.slice_id:
+                    node.slice_id = (
+                        f"slice-{node.id // self._hosts_per_slice}"
+                    )
+                pn = self._platform.create_node(node, self._job_name)
+                node.name = pn.name
+                node.create_time = time.time()
+                logger.info(
+                    "scaler: launched %s (slice=%s)", pn.name, pn.slice_id
+                )
+            for node in plan.remove_nodes:
+                name = node.name or _node_name(self._job_name, node)
+                if self._platform.delete_node(name):
+                    logger.info("scaler: removed %s", name)
+
+
+class ElasticJobScaler(Scaler):
+    """Emits the ScalePlan as a spec for an external controller instead of
+    acting directly (reference ``ElasticJobScaler elasticjob_scaler.py:153``
+    creates ScalePlan CRs consumed by the Go operator; here the native
+    controller consumes JSON specs from ``plan_dir``)."""
+
+    def __init__(self, job_name: str, plan_dir: str):
+        super().__init__(job_name)
+        self._plan_dir = plan_dir
+        os.makedirs(plan_dir, exist_ok=True)
+        self._index = 0
+
+    def scale(self, plan: ScalePlan) -> None:
+        if plan.empty():
+            return
+        self._index += 1
+        path = os.path.join(
+            self._plan_dir, f"{self._job_name}-scaleplan-{self._index}.json"
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(plan.to_json())
+        os.rename(tmp, path)
+        logger.info("scaler: emitted scale plan %s", path)

@@ -1,0 +1,66 @@
+"""Node watchers: platform events -> the job manager's event loop.
+
+Parity with reference ``master/watcher/`` (``NodeWatcher`` ABC
+``base_watcher.py``, ``PodWatcher k8s_watcher.py:164`` converting pod events
+to ``NodeEvent`` s).  One thread consumes ``PlatformClient.watch`` and calls
+the job manager's ``process_event``; ``list_and_reconcile`` replays current
+state on (re)start so missed events can't wedge the manager.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.scheduler.platform import (
+    PlatformClient,
+    PlatformNodeEvent,
+)
+
+
+class NodeWatcher:
+    """Watches the platform and feeds events to ``handler``."""
+
+    def __init__(
+        self,
+        platform: PlatformClient,
+        handler: Callable[[PlatformNodeEvent], None],
+    ):
+        self._platform = platform
+        self._handler = handler
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._watch_loop, name="node-watcher", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def list_current(self) -> List[PlatformNodeEvent]:
+        """Snapshot for reconciliation (reference ``PodWatcher.list``)."""
+        from dlrover_tpu.common.constants import NodeEventType
+
+        return [
+            PlatformNodeEvent(NodeEventType.MODIFIED, pn)
+            for pn in self._platform.list_nodes()
+        ]
+
+    def _watch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                for event in self._platform.watch(self._stop):
+                    self._handler(event)
+                    if self._stop.is_set():
+                        return
+            except Exception:
+                if self._stop.is_set():
+                    return
+                logger.exception("watch stream broke; re-listing")
+                for event in self.list_current():
+                    self._handler(event)

@@ -25,6 +25,7 @@ import subprocess
 import sys
 import tempfile
 import time
+import uuid
 from typing import List, Optional, Tuple
 
 from dlrover_tpu.agent.master_client import MasterClient
@@ -105,8 +106,32 @@ def _launch_local_master(args) -> Tuple[subprocess.Popen, str]:
     raise TimeoutError("local master did not report its port in 60s")
 
 
+def _gc_shm_arenas(job_name: str, run_id: str = "") -> None:
+    """Unlink /dev/shm arenas of ``job_name`` (all runs, or one run id)."""
+    import glob
+
+    safe = job_name.replace("/", "_")
+    scope = f"{safe}-{run_id}" if run_id else f"{safe}-*"
+    for path in glob.glob(f"/dev/shm/dlrtpu_{scope}_*"):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
 def run(args: argparse.Namespace) -> int:
     set_role(f"agent-{args.node_rank}")
+    # One id per launcher invocation: namespaces host-local IPC (shm
+    # arenas/queues/locks) so stale state from a previous launch of the
+    # same job name can't leak into this one.
+    os.environ.setdefault("DLROVER_TPU_RUN_ID", uuid.uuid4().hex[:8])
+    # Run-scoped arenas would otherwise accumulate in RAM-backed /dev/shm,
+    # one multi-GB set per launch: GC leftovers of earlier launches of this
+    # job now, and unlink our own at exit.  Durable state lives in storage
+    # (breakpoint saves persist before workers are torn down).
+    _gc_shm_arenas(args.job_name)
+    atexit.register(_gc_shm_arenas, args.job_name,
+                    os.environ["DLROVER_TPU_RUN_ID"])
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
     master_proc = None
     master_addr = args.master_addr

@@ -1,0 +1,27 @@
+"""Platform scheduler layer: where nodes actually run.
+
+Parity with reference ``dlrover/python/scheduler/`` (``k8sClient
+kubernetes.py:122``, ``K8sElasticJob :371``, ``JobArgs job.py:69``,
+``RayClient ray.py:51``) re-cast for TPU fleets: the scheduling quantum is a
+TPU-VM *host* inside a slice (all-or-nothing) or a whole slice in multislice
+jobs, not a pod-per-GPU.
+"""
+
+from dlrover_tpu.scheduler.job import JobArgs, NodeGroupArgs
+from dlrover_tpu.scheduler.platform import (
+    InMemoryPlatform,
+    PlatformClient,
+    PlatformNode,
+    PlatformNodeEvent,
+    new_platform_client,
+)
+
+__all__ = [
+    "JobArgs",
+    "NodeGroupArgs",
+    "InMemoryPlatform",
+    "PlatformClient",
+    "PlatformNode",
+    "PlatformNodeEvent",
+    "new_platform_client",
+]

@@ -1,0 +1,114 @@
+"""Job arguments: the master's platform-independent job spec.
+
+Parity with reference ``dlrover/python/scheduler/job.py`` (``JobArgs :69``,
+``NodeArgs``) + the CRD-to-args path (``K8sJobArgs.initilize
+kubernetes.py:400``).  A job is a set of node groups (worker / evaluator /
+embedding-store), each with a count range and per-node resources; TPU adds
+the slice topology (hosts per slice, chips per host).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import NodeType, PlatformType
+from dlrover_tpu.common.node import NodeResource
+
+
+@dataclasses.dataclass
+class NodeGroupArgs:
+    """Spec for one group of same-typed nodes (reference ``NodeArgs``)."""
+
+    count: int = 1
+    min_count: int = 1
+    max_count: int = 1
+    restart_count: int = 3
+    critical: bool = False
+    resource: NodeResource = dataclasses.field(default_factory=NodeResource)
+
+    def clamp(self, n: int) -> int:
+        return max(self.min_count, min(self.max_count, n))
+
+
+@dataclasses.dataclass
+class JobArgs:
+    """Platform-independent job description handed to the master.
+
+    Reference ``JobArgs job.py:69``: platform, namespace, job name, per-type
+    node args, plus TPU topology — ``hosts_per_slice`` is the elastic quantum
+    inside one slice, ``node_unit`` the rendezvous rounding.
+    """
+
+    platform: str = PlatformType.LOCAL
+    namespace: str = "default"
+    job_name: str = "job"
+    node_groups: Dict[str, NodeGroupArgs] = dataclasses.field(
+        default_factory=dict
+    )
+    # TPU topology.
+    tpu_type: str = ""
+    hosts_per_slice: int = 1
+    node_unit: int = 1
+    # Elastic behaviour.
+    relaunch_always: bool = False
+    network_check: bool = False
+    distribution_strategy: str = "allreduce"  # or "embedding" (PS analogue)
+    # Free-form platform extras (e.g. GKE node-pool selectors).
+    extras: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def workers(self) -> NodeGroupArgs:
+        return self.node_groups.setdefault(NodeType.WORKER, NodeGroupArgs())
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JobArgs":
+        groups = {}
+        for t, g in (d.get("node_groups") or {}).items():
+            res = NodeResource(**(g.get("resource") or {}))
+            groups[t] = NodeGroupArgs(
+                count=g.get("count", 1),
+                min_count=g.get("min_count", g.get("count", 1)),
+                max_count=g.get("max_count", g.get("count", 1)),
+                restart_count=g.get("restart_count", 3),
+                critical=g.get("critical", False),
+                resource=res,
+            )
+        return cls(
+            platform=d.get("platform", PlatformType.LOCAL),
+            namespace=d.get("namespace", "default"),
+            job_name=d.get("job_name", "job"),
+            node_groups=groups,
+            tpu_type=d.get("tpu_type", ""),
+            hosts_per_slice=d.get("hosts_per_slice", 1),
+            node_unit=d.get("node_unit", 1),
+            relaunch_always=d.get("relaunch_always", False),
+            network_check=d.get("network_check", False),
+            distribution_strategy=d.get("distribution_strategy", "allreduce"),
+            extras=d.get("extras") or {},
+        )
+
+    @classmethod
+    def from_json_file(cls, path: str) -> "JobArgs":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def standalone_job_args(
+    nnodes: int = 1,
+    max_nodes: Optional[int] = None,
+    tpu_type: str = "",
+) -> JobArgs:
+    """Args for `tpurun --standalone` (reference local-platform JobArgs)."""
+    args = JobArgs(platform=PlatformType.LOCAL, job_name="standalone")
+    args.node_groups[NodeType.WORKER] = NodeGroupArgs(
+        count=nnodes,
+        min_count=nnodes,
+        max_count=max_nodes or nnodes,
+    )
+    args.tpu_type = tpu_type
+    return args

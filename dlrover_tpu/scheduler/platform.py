@@ -1,0 +1,336 @@
+"""Platform client: create/delete/list/watch nodes on the hosting substrate.
+
+Parity with reference ``scheduler/kubernetes.py`` (``k8sClient :122`` pod
+CRUD + watch) behind an abstract interface so the job manager and scaler are
+platform-agnostic (the reference reaches the same effect by monkey-patching
+``k8sClient`` in tests, SURVEY.md §4).  Implementations:
+
+- :class:`InMemoryPlatform` — the authoritative test double *and* the local
+  dev platform: a node table + event queue, with fault-injection hooks
+  (``fail_node``, ``preempt_slice``) so elasticity paths (kill -> event ->
+  relaunch -> re-rendezvous) run on one host.
+- :class:`GkePlatform` — TPU node pools via the Kubernetes API (gated on the
+  ``kubernetes`` package; reference ``k8sClient``).  A TPU "node" here is one
+  TPU-VM host pod of a slice; slices are all-or-nothing, so deleting any host
+  of a slice marks its siblings ``preempted`` too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    PlatformType,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node, NodeResource
+
+
+@dataclasses.dataclass
+class PlatformNode:
+    """Platform-level view of one node (reference: a k8s Pod)."""
+
+    name: str
+    node_type: str
+    node_id: int
+    rank_index: int
+    status: str = NodeStatus.PENDING
+    exit_reason: str = ""
+    slice_id: str = ""
+    host: str = ""
+    resource: NodeResource = dataclasses.field(default_factory=NodeResource)
+    create_time: float = 0.0
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class PlatformNodeEvent:
+    """A node change event (reference ``master/watcher``'s ``NodeEvent``)."""
+
+    event_type: str  # NodeEventType
+    node: PlatformNode
+
+
+class PlatformClient:
+    """Abstract node CRUD + watch (reference ``k8sClient`` surface the
+    master actually uses: create/delete pod, list, watch)."""
+
+    def create_node(self, node: Node, job_name: str) -> PlatformNode:
+        raise NotImplementedError
+
+    def delete_node(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def list_nodes(self) -> List[PlatformNode]:
+        raise NotImplementedError
+
+    def watch(self, stop: threading.Event) -> Iterator[PlatformNodeEvent]:
+        """Blocking event stream until ``stop`` is set."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+def _node_name(job_name: str, node: Node) -> str:
+    return f"{job_name}-{node.type}-{node.id}"
+
+
+class InMemoryPlatform(PlatformClient):
+    """Node table + event queue; the local platform and the test double.
+
+    Fault injection mirrors the reference's mocked-k8s tests
+    (``test_utils.py:296 mock_k8s_client``): tests flip node states and the
+    watcher/job-manager react exactly as they would to real pod events.
+
+    ``auto_run`` (default) moves created nodes PENDING -> RUNNING after
+    ``schedule_delay`` seconds, emulating the scheduler; set it False to
+    exercise pending-timeout paths.
+    """
+
+    def __init__(
+        self,
+        auto_run: bool = True,
+        schedule_delay: float = 0.0,
+        hosts_per_slice: int = 1,
+    ):
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, PlatformNode] = {}
+        self._events: "queue.Queue[PlatformNodeEvent]" = queue.Queue()
+        self._auto_run = auto_run
+        self._schedule_delay = schedule_delay
+        self._hosts_per_slice = max(1, hosts_per_slice)
+        # Optional: called with the PlatformNode when it starts "running";
+        # the local launcher uses this to spawn a real agent process.
+        self.on_node_running: Optional[Callable[[PlatformNode], None]] = None
+
+    # -- CRUD --------------------------------------------------------------
+    def create_node(self, node: Node, job_name: str) -> PlatformNode:
+        name = _node_name(job_name, node)
+        slice_id = node.slice_id or f"slice-{node.id // self._hosts_per_slice}"
+        pn = PlatformNode(
+            name=name,
+            node_type=node.type,
+            node_id=node.id,
+            rank_index=node.rank_index,
+            status=NodeStatus.PENDING,
+            slice_id=slice_id,
+            host=f"127.0.0.1",
+            resource=node.config_resource,
+            create_time=time.time(),
+        )
+        with self._lock:
+            self._nodes[name] = pn
+        self._emit(NodeEventType.ADDED, pn)
+        if self._auto_run:
+            if self._schedule_delay > 0:
+                t = threading.Timer(
+                    self._schedule_delay, self._run_node, args=(name,)
+                )
+                t.daemon = True
+                t.start()
+            else:
+                self._run_node(name)
+        return pn
+
+    def delete_node(self, name: str) -> bool:
+        with self._lock:
+            pn = self._nodes.get(name)
+            if pn is None:
+                return False
+            pn.status = NodeStatus.DELETED
+        self._emit(NodeEventType.DELETED, pn)
+        return True
+
+    def list_nodes(self) -> List[PlatformNode]:
+        with self._lock:
+            return [dataclasses.replace(p) for p in self._nodes.values()]
+
+    def watch(self, stop: threading.Event) -> Iterator[PlatformNodeEvent]:
+        while not stop.is_set():
+            try:
+                yield self._events.get(timeout=0.2)
+            except queue.Empty:
+                continue
+
+    # -- scheduling emulation + fault injection ----------------------------
+    def _run_node(self, name: str) -> None:
+        with self._lock:
+            pn = self._nodes.get(name)
+            if pn is None or pn.status != NodeStatus.PENDING:
+                return
+            pn.status = NodeStatus.RUNNING
+        self._emit(NodeEventType.MODIFIED, pn)
+        if self.on_node_running is not None:
+            try:
+                self.on_node_running(pn)
+            except Exception:  # pragma: no cover - launcher hook errors
+                logger.exception("on_node_running hook failed for %s", name)
+
+    def set_node_status(
+        self, name: str, status: str, exit_reason: str = ""
+    ) -> None:
+        with self._lock:
+            pn = self._nodes.get(name)
+            if pn is None:
+                return
+            pn.status = status
+            pn.exit_reason = exit_reason
+        self._emit(NodeEventType.MODIFIED, pn)
+
+    def fail_node(
+        self, name: str, exit_reason: str = NodeExitReason.UNKNOWN_ERROR
+    ) -> None:
+        self.set_node_status(name, NodeStatus.FAILED, exit_reason)
+
+    def succeed_node(self, name: str) -> None:
+        self.set_node_status(name, NodeStatus.SUCCEEDED)
+
+    def preempt_slice(self, slice_id: str) -> None:
+        """Reclaim a whole slice (spot TPU preemption is all-or-nothing)."""
+        with self._lock:
+            victims = [
+                p for p in self._nodes.values()
+                if p.slice_id == slice_id
+                and p.status in (NodeStatus.PENDING, NodeStatus.RUNNING)
+            ]
+            for p in victims:
+                p.status = NodeStatus.FAILED
+                p.exit_reason = NodeExitReason.PREEMPTED
+        for p in victims:
+            self._emit(NodeEventType.MODIFIED, p)
+
+    def _emit(self, etype: str, pn: PlatformNode) -> None:
+        self._events.put(
+            PlatformNodeEvent(etype, dataclasses.replace(pn))
+        )
+
+
+class GkePlatform(PlatformClient):  # pragma: no cover - needs a cluster
+    """TPU node pods via the Kubernetes API (reference ``k8sClient :122``).
+
+    Pod template: one pod per TPU-VM host with
+    ``google.com/tpu: <chips_per_host>`` resource requests and the
+    ``cloud.google.com/gke-tpu-topology`` selector; slice membership comes
+    from the hostname suffix.  Gated on the ``kubernetes`` package.
+    """
+
+    def __init__(self, namespace: str = "default", image: str = ""):
+        try:
+            from kubernetes import client, config, watch  # type: ignore
+        except ImportError as e:  # keep import-time deps optional
+            raise RuntimeError(
+                "GkePlatform requires the 'kubernetes' package"
+            ) from e
+        config.load_incluster_config()
+        self._core = client.CoreV1Api()
+        self._watch_mod = watch
+        self._client_mod = client
+        self._namespace = namespace
+        self._image = image
+
+    def create_node(self, node: Node, job_name: str) -> PlatformNode:
+        name = _node_name(job_name, node)
+        c = self._client_mod
+        limits = {}
+        if node.config_resource.tpu_chips:
+            limits["google.com/tpu"] = str(node.config_resource.tpu_chips)
+        pod = c.V1Pod(
+            metadata=c.V1ObjectMeta(
+                name=name,
+                labels={
+                    "app": job_name,
+                    "node-type": node.type,
+                    "node-id": str(node.id),
+                    "rank-index": str(node.rank_index),
+                },
+            ),
+            spec=c.V1PodSpec(
+                restart_policy="Never",
+                containers=[
+                    c.V1Container(
+                        name="main",
+                        image=self._image,
+                        resources=c.V1ResourceRequirements(limits=limits),
+                    )
+                ],
+            ),
+        )
+        self._core.create_namespaced_pod(self._namespace, pod)
+        return PlatformNode(
+            name=name,
+            node_type=node.type,
+            node_id=node.id,
+            rank_index=node.rank_index,
+            resource=node.config_resource,
+            create_time=time.time(),
+        )
+
+    def delete_node(self, name: str) -> bool:
+        try:
+            self._core.delete_namespaced_pod(name, self._namespace)
+            return True
+        except Exception:
+            return False
+
+    def list_nodes(self) -> List[PlatformNode]:
+        pods = self._core.list_namespaced_pod(self._namespace).items
+        return [self._pod_to_node(p) for p in pods if self._pod_to_node(p)]
+
+    def watch(self, stop: threading.Event) -> Iterator[PlatformNodeEvent]:
+        w = self._watch_mod.Watch()
+        for ev in w.stream(
+            self._core.list_namespaced_pod, self._namespace
+        ):
+            if stop.is_set():
+                w.stop()
+                return
+            pn = self._pod_to_node(ev["object"])
+            if pn is not None:
+                yield PlatformNodeEvent(ev["type"].lower(), pn)
+
+    _PHASE_MAP = {
+        "Pending": NodeStatus.PENDING,
+        "Running": NodeStatus.RUNNING,
+        "Succeeded": NodeStatus.SUCCEEDED,
+        "Failed": NodeStatus.FAILED,
+        "Unknown": NodeStatus.UNKNOWN,
+    }
+
+    def _pod_to_node(self, pod) -> Optional[PlatformNode]:
+        labels = pod.metadata.labels or {}
+        if "node-id" not in labels:
+            return None
+        return PlatformNode(
+            name=pod.metadata.name,
+            node_type=labels.get("node-type", "worker"),
+            node_id=int(labels["node-id"]),
+            rank_index=int(labels.get("rank-index", labels["node-id"])),
+            status=self._PHASE_MAP.get(
+                pod.status.phase, NodeStatus.UNKNOWN
+            ),
+            host=pod.status.pod_ip or "",
+            labels=dict(labels),
+        )
+
+
+def new_platform_client(
+    platform: str, **kwargs
+) -> PlatformClient:
+    """Factory (reference: per-platform ``ElasticJob``/client factories)."""
+    if platform in (PlatformType.LOCAL, PlatformType.PROCESS):
+        return InMemoryPlatform(**kwargs)
+    if platform == PlatformType.GKE:
+        return GkePlatform(**kwargs)
+    if platform == PlatformType.RAY:
+        from dlrover_tpu.scheduler.ray_platform import RayPlatform
+
+        return RayPlatform(**kwargs)
+    raise ValueError(f"unknown platform {platform!r}")

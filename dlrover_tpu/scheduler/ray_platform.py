@@ -1,0 +1,100 @@
+"""Ray platform: nodes as Ray actors (API-compatible stub).
+
+Parity with reference ``scheduler/ray.py`` (``RayClient :51``) +
+``master/scaler/ray_scaler.py`` (``ActorScaler :39``) + the submitter
+(``client/platform/ray/ray_job_submitter.py``).  Gated on the ``ray``
+package; without it the class raises at construction, keeping the factory
+importable (SURVEY.md §2 #34).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterator, List
+
+from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.scheduler.platform import (
+    PlatformClient,
+    PlatformNode,
+    PlatformNodeEvent,
+    _node_name,
+)
+
+
+class RayPlatform(PlatformClient):  # pragma: no cover - needs ray
+    """Each node is a detached Ray actor running the elastic agent."""
+
+    def __init__(self, namespace: str = "dlrover_tpu"):
+        try:
+            import ray  # type: ignore
+        except ImportError as e:
+            raise RuntimeError("RayPlatform requires the 'ray' package") from e
+        self._ray = ray
+        if not ray.is_initialized():
+            ray.init(namespace=namespace, ignore_reinit_error=True)
+        self._actors = {}
+
+    def create_node(self, node: Node, job_name: str) -> PlatformNode:
+        ray = self._ray
+
+        @ray.remote
+        class AgentActor:
+            def run(self, env):  # pragma: no cover
+                import os
+                import runpy
+
+                os.environ.update(env)
+                runpy.run_module("dlrover_tpu.agent", run_name="__main__")
+
+            def ping(self):
+                return True
+
+        name = _node_name(job_name, node)
+        actor = AgentActor.options(
+            name=name, lifetime="detached"
+        ).remote()
+        self._actors[name] = actor
+        return PlatformNode(
+            name=name,
+            node_type=node.type,
+            node_id=node.id,
+            rank_index=node.rank_index,
+            status=NodeStatus.RUNNING,
+            create_time=time.time(),
+        )
+
+    def delete_node(self, name: str) -> bool:
+        actor = self._actors.pop(name, None)
+        if actor is None:
+            return False
+        self._ray.kill(actor)
+        return True
+
+    def list_nodes(self) -> List[PlatformNode]:
+        nodes = []
+        for name, actor in list(self._actors.items()):
+            try:
+                self._ray.get(actor.ping.remote(), timeout=5)
+                status = NodeStatus.RUNNING
+            except Exception:
+                status = NodeStatus.FAILED
+            nodes.append(
+                PlatformNode(
+                    name=name, node_type="worker", node_id=0, rank_index=0,
+                    status=status,
+                )
+            )
+        return nodes
+
+    def watch(self, stop: threading.Event) -> Iterator[PlatformNodeEvent]:
+        from dlrover_tpu.common.constants import NodeEventType
+
+        seen = {}
+        while not stop.is_set():
+            for pn in self.list_nodes():
+                if seen.get(pn.name) != pn.status:
+                    seen[pn.name] = pn.status
+                    yield PlatformNodeEvent(NodeEventType.MODIFIED, pn)
+            stop.wait(5.0)

@@ -90,8 +90,30 @@ def get_elastic_context() -> Optional[ElasticContext]:
 
 def _shutdown() -> None:
     try:
-        import jax
+        import threading
 
-        jax.distributed.shutdown()
+        import jax
+        from jax.experimental import multihost_utils
+
+        # Ranks can be many steps apart in wall-clock at exit (async
+        # dispatch); sync first so the coordination service's shutdown
+        # barrier (short timeout) sees everyone arrive together.  The sync
+        # is bounded: a worker exiting alone (crash path) must not block
+        # the agent's failure detection waiting for peers that will never
+        # arrive.
+        done = threading.Event()
+
+        def _sync():
+            try:
+                multihost_utils.sync_global_devices("dlrover_tpu_exit")
+            except Exception:  # noqa: BLE001
+                pass
+            done.set()
+
+        threading.Thread(target=_sync, daemon=True).start()
+        if done.wait(timeout=60.0):
+            jax.distributed.shutdown()
+        # else: skip the shutdown barrier entirely; process teardown
+        # closes the coordination channel and peers learn via heartbeat.
     except Exception:  # noqa: BLE001
         pass

@@ -88,6 +88,15 @@ class TestShardFile:
         np.testing.assert_array_equal(out["a|0"], tensors["a|0"])
         np.testing.assert_array_equal(out["b|0"], tensors["b|0"])
 
+    def test_pack_unpack_zero_d(self):
+        # Regression: np.ascontiguousarray promotes 0-d to (1,); a restored
+        # scalar (e.g. optimizer step count) must stay 0-d or
+        # make_array_from_single_device_arrays rejects the shard.
+        tensors = {"count|0": np.asarray(np.int32(7))}
+        out, _ = shard_file.unpack_shard(shard_file.pack_shard(tensors, {}))
+        assert out["count|0"].shape == ()
+        assert out["count|0"] == 7
+
     def test_commit_protocol(self, tmp_path):
         storage = PosixDiskStorage()
         d = str(tmp_path)

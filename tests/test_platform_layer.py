@@ -1,0 +1,286 @@
+"""Platform-layer tests: fake platform -> watcher -> dist job manager ->
+scaler round trips (reference test strategy SURVEY.md §4: mocked k8s client,
+kill node -> event -> relaunch on one host)."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.dist_job_manager import DistributedJobManager
+from dlrover_tpu.master.dist_master import DistributedJobMaster
+from dlrover_tpu.master.job_auto_scaler import AllreduceTrainingAutoScaler
+from dlrover_tpu.master.resource_optimizer import (
+    LocalHeuristicOptimizer,
+    ResourcePlan,
+)
+from dlrover_tpu.master.scaler import ElasticJobScaler, PlatformScaler, ScalePlan
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.scheduler.job import JobArgs, NodeGroupArgs
+from dlrover_tpu.scheduler.platform import InMemoryPlatform
+
+
+def make_job_args(count=2, min_count=1, max_count=4, **kw):
+    args = JobArgs(job_name="tj", **kw)
+    args.node_groups[NodeType.WORKER] = NodeGroupArgs(
+        count=count, min_count=min_count, max_count=max_count,
+        restart_count=2,
+    )
+    return args
+
+
+def wait_until(pred, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def manager():
+    platform = InMemoryPlatform()
+    args = make_job_args()
+    scaler = PlatformScaler("tj", platform)
+    mgr = DistributedJobManager(args, platform, scaler)
+    mgr.start()
+    yield mgr, platform
+    mgr.stop()
+
+
+class TestDistJobManager:
+    def test_initial_launch(self, manager):
+        mgr, platform = manager
+        assert wait_until(lambda: len(mgr.alive_workers()) == 2)
+        names = {p.name for p in platform.list_nodes()}
+        assert names == {"tj-worker-0", "tj-worker-1"}
+
+    def test_failure_relaunches_node(self, manager):
+        mgr, platform = manager
+        assert wait_until(lambda: len(mgr.alive_workers()) == 2)
+        platform.fail_node("tj-worker-0")
+        # A replacement node appears and runs; the old one is removed.
+        assert wait_until(
+            lambda: any(
+                p.name == "tj-worker-2" and p.status == NodeStatus.RUNNING
+                for p in platform.list_nodes()
+            )
+        )
+        assert wait_until(lambda: len(mgr.alive_workers()) == 2)
+        replacement = mgr.get_node(2)
+        assert replacement.relaunch_count == 1
+        assert replacement.rank_index == 0  # inherits the failed rank
+
+    def test_relaunch_budget_exhausted(self, manager):
+        mgr, platform = manager
+        assert wait_until(lambda: len(mgr.alive_workers()) == 2)
+        # restart_count=2: two failures consume the budget, third is final.
+        victim_rank = 0
+        for _ in range(3):
+            victims = [
+                n for n in mgr.alive_workers() if n.rank_index == victim_rank
+            ]
+            if not victims:
+                break
+            platform.fail_node(victims[0].name)
+            wait_until(
+                lambda v=victims[0]: any(
+                    n.rank_index == victim_rank and n.id != v.id
+                    for n in mgr.alive_workers()
+                )
+                or not any(
+                    n.rank_index == victim_rank for n in mgr.alive_workers()
+                ),
+                timeout=5,
+            )
+        lineage = [
+            n for n in mgr.all_nodes().values() if n.rank_index == victim_rank
+        ]
+        assert max(n.relaunch_count for n in lineage) == 2
+        # No node of that rank still alive after budget exhaustion.
+        time.sleep(0.2)
+        assert not any(
+            n.rank_index == victim_rank for n in mgr.alive_workers()
+        )
+
+    def test_preemption_does_not_consume_budget(self, manager):
+        mgr, platform = manager
+        assert wait_until(lambda: len(mgr.alive_workers()) == 2)
+        node = mgr.alive_workers()[0]
+        platform.fail_node(node.name, NodeExitReason.PREEMPTED)
+        assert wait_until(
+            lambda: any(
+                n.rank_index == node.rank_index and n.id != node.id
+                for n in mgr.alive_workers()
+            )
+        )
+        successor = [
+            n for n in mgr.alive_workers() if n.rank_index == node.rank_index
+        ][0]
+        assert successor.relaunch_count == 0
+
+    def test_slice_preemption_fails_all_hosts(self):
+        platform = InMemoryPlatform(hosts_per_slice=2)
+        args = make_job_args(count=4, max_count=4)
+        args.hosts_per_slice = 2
+        scaler = PlatformScaler("tj", platform, hosts_per_slice=2)
+        mgr = DistributedJobManager(args, platform, scaler)
+        mgr.start()
+        try:
+            assert wait_until(lambda: len(mgr.alive_workers()) == 4)
+            platform.preempt_slice("slice-0")
+            # Both hosts of slice-0 are replaced by fresh nodes.
+            assert wait_until(
+                lambda: {n.id for n in mgr.alive_workers()} == {2, 3, 4, 5}
+            )
+        finally:
+            mgr.stop()
+
+    def test_scale_workers_up_and_down(self, manager):
+        mgr, platform = manager
+        assert wait_until(lambda: len(mgr.alive_workers()) == 2)
+        assert mgr.scale_workers_to(4) == 2
+        assert wait_until(lambda: len(mgr.alive_workers()) == 4)
+        assert mgr.scale_workers_to(3) == -1
+        assert wait_until(lambda: len(mgr.alive_workers()) == 3)
+        # Scale-down is not a failure: no replacements appear.
+        time.sleep(0.3)
+        assert len(mgr.alive_workers()) == 3
+        # Clamped by max_count.
+        assert mgr.scale_workers_to(100) == 1
+
+    def test_oom_bumps_memory_on_relaunch(self):
+        platform = InMemoryPlatform()
+        args = make_job_args(count=1, max_count=2)
+        args.node_groups[NodeType.WORKER].resource = NodeResource(
+            cpu=4, memory_mb=1000
+        )
+        scaler = PlatformScaler("tj", platform)
+        mgr = DistributedJobManager(
+            args, platform, scaler, LocalHeuristicOptimizer(oom_factor=2.0)
+        )
+        mgr.start()
+        try:
+            assert wait_until(lambda: len(mgr.alive_workers()) == 1)
+            platform.fail_node("tj-worker-0", NodeExitReason.OOM)
+            assert wait_until(
+                lambda: any(n.id == 1 for n in mgr.alive_workers())
+            )
+            assert mgr.get_node(1).config_resource.memory_mb == 2000
+        finally:
+            mgr.stop()
+
+
+class TestAutoScaler:
+    def test_backfill_below_min(self):
+        platform = InMemoryPlatform()
+        args = make_job_args(count=3, min_count=3, max_count=6)
+        scaler = PlatformScaler("tj", platform)
+        mgr = DistributedJobManager(args, platform, scaler)
+        sm = SpeedMonitor()
+        auto = AllreduceTrainingAutoScaler(
+            args, mgr, sm, interval=3600
+        )
+        mgr.start()
+        try:
+            assert wait_until(lambda: len(mgr.alive_workers()) == 3)
+            # Exhaust one lineage's budget so backfill is the only recovery.
+            for _ in range(3):
+                live = mgr.alive_workers()
+                victim = [n for n in live if n.rank_index == 0]
+                if not victim:
+                    break
+                platform.fail_node(victim[0].name)
+                time.sleep(0.2)
+            wait_until(
+                lambda: not any(
+                    n.rank_index == 0 for n in mgr.alive_workers()
+                )
+            )
+            delta = auto.scale_once()
+            assert delta >= 1
+            assert wait_until(lambda: len(mgr.alive_workers()) >= 3)
+        finally:
+            mgr.stop()
+
+    def test_optimizer_growth(self):
+        platform = InMemoryPlatform()
+        args = make_job_args(count=2, min_count=1, max_count=8)
+        scaler = PlatformScaler("tj", platform)
+        opt = LocalHeuristicOptimizer()
+        mgr = DistributedJobManager(args, platform, scaler, opt)
+        sm = SpeedMonitor()
+        auto = AllreduceTrainingAutoScaler(args, mgr, sm, opt, interval=3600)
+        mgr.start()
+        try:
+            assert wait_until(lambda: len(mgr.alive_workers()) == 2)
+            # Near-linear history: 1 -> 2 workers doubled speed.
+            auto._speed_history = [(1, 10.0), (2, 19.5)]
+            delta = auto.scale_once()
+            assert delta >= 1
+        finally:
+            mgr.stop()
+
+
+class TestScalers:
+    def test_elasticjob_scaler_emits_plans(self, tmp_path):
+        scaler = ElasticJobScaler("tj", str(tmp_path))
+        plan = ScalePlan(launch_nodes=[Node(NodeType.WORKER, 0)])
+        scaler.scale(plan)
+        files = list(tmp_path.glob("tj-scaleplan-*.json"))
+        assert len(files) == 1
+        assert "launch_nodes" in files[0].read_text()
+
+    def test_empty_plan_is_noop(self, tmp_path):
+        scaler = ElasticJobScaler("tj", str(tmp_path))
+        scaler.scale(ScalePlan())
+        assert not list(tmp_path.glob("*.json"))
+
+
+class TestResourceOptimizer:
+    def test_oom_plan(self):
+        opt = LocalHeuristicOptimizer(oom_factor=1.5)
+        node = Node(NodeType.WORKER, 0, name="w0")
+        node.exit_reason = NodeExitReason.OOM
+        node.config_resource = NodeResource(memory_mb=1000)
+        plan = opt.generate_oom_recovery_plan([node])
+        assert plan.node_resources["w0"].memory_mb == 1500
+
+    def test_sublinear_speedup_stops_growth(self):
+        opt = LocalHeuristicOptimizer(target_speedup_threshold=0.8)
+        plan = opt.generate_resource_plan_with_optimizer(
+            {"speed_history": [(4, 40.0), (8, 44.0)], "current_workers": 8}
+        )
+        assert plan.empty()
+
+
+class TestDistributedJobMaster:
+    def test_end_to_end_lifecycle(self):
+        args = make_job_args(count=2, min_count=2, max_count=2)
+        master = DistributedJobMaster(args)
+        master.prepare()
+        try:
+            platform = master.platform
+            assert wait_until(
+                lambda: len(master.job_manager.alive_workers()) == 2
+            )
+            # Fail one node; it relaunches; then both succeed -> job done.
+            platform.fail_node("tj-worker-0")
+            assert wait_until(
+                lambda: {
+                    n.id for n in master.job_manager.alive_workers()
+                } == {1, 2}
+            )
+            for pn in platform.list_nodes():
+                if pn.status == NodeStatus.RUNNING:
+                    platform.succeed_node(pn.name)
+            assert wait_until(master.job_manager.all_workers_exited)
+            assert master.job_manager.all_workers_succeeded()
+        finally:
+            master.stop()
