@@ -1,0 +1,91 @@
+"""Agent-side parallel-config tuner.
+
+Parity with reference ``elastic_agent/config/paral_config_tuner.py:29``
+(``ParalConfigTuner``: poll the master's ``ParallelConfig``, write a JSON
+file the trainer hot-reloads).  The file path is exported to workers via
+``DLROVER_TPU_PARAL_CONFIG_PATH``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.log import logger
+
+CONFIG_PATH_ENV = "DLROVER_TPU_PARAL_CONFIG_PATH"
+
+
+class ParalConfigTuner:
+    def __init__(
+        self,
+        master_client,
+        config_path: str = "",
+        interval_s: float = 30.0,
+    ):
+        self._client = master_client
+        self._path = config_path or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"),
+            f"dlrover_tpu_paral_config_{os.getpid()}.json",
+        )
+        self._interval = interval_s
+        self._last_version = -1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.environ[CONFIG_PATH_ENV] = self._path
+
+    @property
+    def config_path(self) -> str:
+        return self._path
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="paral-config-tuner", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def poll_once(self) -> bool:
+        """Fetch the config; write the file if the version advanced."""
+        cfg = self._client.get_parallel_config()
+        if cfg is None or cfg.version <= self._last_version:
+            return False
+        payload = {
+            "version": cfg.version,
+            "dataloader": cfg.dataloader,
+            "optimizer": cfg.optimizer,
+            "mesh": cfg.mesh,
+        }
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self._path)
+        self._last_version = cfg.version
+        logger.info(
+            "paral config v%d written to %s", cfg.version, self._path
+        )
+        return True
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001
+                logger.debug("paral config poll failed: %s", e)
+
+
+def read_paral_config(path: str = "") -> Optional[dict]:
+    """Trainer-side hot-reload helper."""
+    path = path or os.environ.get(CONFIG_PATH_ENV, "")
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

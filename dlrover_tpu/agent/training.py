@@ -83,6 +83,7 @@ class RunResult:
     MEMBERSHIP_CHANGED = "membership_changed"
     STOP_JOB = "stop_job"
     RESTART_REQUESTED = "restart_requested"
+    RELAUNCH_REQUESTED = "relaunch_requested"
 
 
 class ElasticTrainingAgent:
@@ -111,6 +112,19 @@ class ElasticTrainingAgent:
         # Hooks the checkpoint saver plugs into (task: flash checkpoint).
         self.on_workers_stopping = None  # callable(reason) before kill
         self.saver = None  # AsyncCheckpointSaver, attached by launcher
+        self._last_failures: List[tuple] = []
+        from dlrover_tpu.diagnosis.agent import DiagnosisAgent
+
+        self.diagnosis = DiagnosisAgent(
+            self.client,
+            log_dir=config.log_dir,
+            max_in_place_restarts=config.max_restarts,
+        )
+        from dlrover_tpu.agent.config_tuner import ParalConfigTuner
+        from dlrover_tpu.agent.monitor import ResourceMonitor
+
+        self.resource_monitor = ResourceMonitor(self.client)
+        self.config_tuner = ParalConfigTuner(self.client)
 
     # -- heartbeats --------------------------------------------------------
     def _start_heartbeat(self) -> None:
@@ -292,10 +306,9 @@ class ElasticTrainingAgent:
             self._pending_action = None
             if action == DiagnosisActionType.STOP_JOB:
                 return RunResult.STOP_JOB
-            if action in (
-                DiagnosisActionType.RESTART_WORKER,
-                DiagnosisActionType.RELAUNCH_WORKER,
-            ):
+            if action == DiagnosisActionType.RELAUNCH_WORKER:
+                return RunResult.RELAUNCH_REQUESTED
+            if action == DiagnosisActionType.RESTART_WORKER:
                 return RunResult.RESTART_REQUESTED
             # 2. worker process health
             codes = [w.poll() for w in self._workers]
@@ -308,6 +321,7 @@ class ElasticTrainingAgent:
                     if c not in (None, 0)
                 ]
                 logger.warning("worker failure(s): %s", bad)
+                self._last_failures = bad
                 return RunResult.FAILED
             # 3. membership change -> re-rendezvous (reference
             #    _membership_changed :1028)
@@ -321,6 +335,9 @@ class ElasticTrainingAgent:
     def run(self) -> int:
         cfg = self.config
         self._start_heartbeat()
+        self.resource_monitor.start()
+        if self._ctx.auto_tune:
+            self.config_tuner.start()
         # Flash-checkpoint saver daemon: lives in the agent so persistence
         # survives worker crashes (reference start_async_saving_ckpt :869).
         if self.saver is None:
@@ -352,17 +369,36 @@ class ElasticTrainingAgent:
                         NodeStatus.FAILED, exit_reason="stopped_by_master"
                     )
                     return 1
+                if result == RunResult.RELAUNCH_REQUESTED:
+                    # Master diagnosed this node as sick: exit so the
+                    # platform replaces it (in-place restart won't help).
+                    self._stop_workers("master requested node relaunch")
+                    self.client.report_node_status(
+                        NodeStatus.FAILED, exit_reason="relaunch_requested"
+                    )
+                    return 1
                 if result == RunResult.FAILED:
                     self._restart_count += 1
                     self.client.report_failure(
                         f"worker failure (restart {self._restart_count}/"
-                        f"{cfg.max_restarts})",
+                        f"{cfg.max_restarts}): {self._last_failures}",
                         restart_count=self._restart_count,
                     )
-                    if self._restart_count > cfg.max_restarts:
-                        self._stop_workers("restart budget exhausted")
+                    # RESTART (in place) vs RELAUNCH (replace this node) —
+                    # reference diagnose_training_failure training.py:934.
+                    action = self.diagnosis.diagnose_training_failure(
+                        self._last_failures, self._restart_count
+                    )
+                    if (
+                        action == DiagnosisActionType.RELAUNCH_WORKER
+                        or self._restart_count > cfg.max_restarts
+                    ):
+                        self._stop_workers("relaunch requested")
                         self.client.report_node_status(
-                            NodeStatus.FAILED, exit_reason="max_restarts"
+                            NodeStatus.FAILED,
+                            exit_reason="relaunch_requested"
+                            if self._restart_count <= cfg.max_restarts
+                            else "max_restarts",
                         )
                         return 1
                     self._stop_workers("worker failure; re-rendezvous")

@@ -1,0 +1,31 @@
+"""Diagnosis subsystem: observe -> infer -> act on training anomalies.
+
+Parity with reference ``dlrover/python/master/diagnosis/`` (master side:
+``DiagnosisManager diagnosis_manager.py:46``, inference chain + operators)
+and ``dlrover/python/elastic_agent/diagnosis/`` (agent side:
+``DiagnosisAgent diagnosis_agent.py:59`` deciding RESTART vs RELAUNCH,
+data collectors).  TPU-adapted signals: per-step heartbeat files written by
+workers replace xpu-timer CUDA kernel probes; XLA compile stalls are
+whitelisted so a 30-min first compile is not "hung".
+"""
+
+from dlrover_tpu.diagnosis.data import DiagnosisDataManager
+from dlrover_tpu.diagnosis.inference import (
+    Inference,
+    InferenceChain,
+    InferenceOperator,
+    coordinate_solutions,
+)
+from dlrover_tpu.diagnosis.manager import DiagnosisManager
+from dlrover_tpu.diagnosis.agent import DiagnosisAgent, HangingDetector
+
+__all__ = [
+    "DiagnosisDataManager",
+    "Inference",
+    "InferenceChain",
+    "InferenceOperator",
+    "coordinate_solutions",
+    "DiagnosisManager",
+    "DiagnosisAgent",
+    "HangingDetector",
+]

@@ -1,0 +1,228 @@
+"""Agent/worker-side diagnosis: failure classification and hang watching.
+
+Parity with reference ``elastic_agent/diagnosis/diagnosis_agent.py:59``
+(``DiagnosisAgent.diagnose_training_failure`` -> RESTART vs RELAUNCH),
+``datacollector/training_log_collector.py`` (log tail scan) and ATorch's
+``fault_tolerance/hanging_detector.py:86`` (``HangingDetector``).  TPU
+signals: worker step heartbeats (file or callback) replace xpu-timer's CUDA
+kernel-launch gap metrics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.common.constants import DiagnosisActionType
+from dlrover_tpu.common.log import logger
+
+
+# Error patterns in worker logs that user-code restarts cannot fix: the
+# node must be replaced (reference diagnosis_agent's relaunch decision).
+NODE_ERROR_PATTERNS = (
+    "hardware error",
+    "tpu initialization failed",
+    "device unavailable",
+    "ici link",
+    "failed to allocate",
+    "resource_exhausted: out of memory",
+)
+
+# Patterns that are transient: in-place restart is enough.
+TRANSIENT_PATTERNS = (
+    "coordination service",
+    "deadline_exceeded",
+    "barrier timed out",
+    "connection reset",
+    "unavailable:",
+)
+
+
+class TrainingLogCollector:
+    """Tails worker log files for error evidence (reference
+    ``training_log_collector.py``)."""
+
+    def __init__(
+        self,
+        log_dir: str = "",
+        tail_bytes: int = 65536,
+        max_age_s: float = 600.0,
+    ):
+        self._log_dir = log_dir
+        self._tail = tail_bytes
+        # Only logs written recently are evidence for the CURRENT failure;
+        # a node-error pattern in an old round's log must not force
+        # RELAUNCH for every later unrelated crash.
+        self._max_age = max_age_s
+
+    def collect(self) -> str:
+        if not self._log_dir or not os.path.isdir(self._log_dir):
+            return ""
+        chunks: List[str] = []
+        now = time.time()
+        try:
+            for name in sorted(os.listdir(self._log_dir)):
+                path = os.path.join(self._log_dir, name)
+                if not os.path.isfile(path):
+                    continue
+                if now - os.stat(path).st_mtime > self._max_age:
+                    continue
+                with open(path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - self._tail))
+                    chunks.append(
+                        f.read().decode("utf-8", errors="replace")
+                    )
+        except OSError:
+            return ""
+        return "\n".join(chunks)
+
+
+class DiagnosisAgent:
+    """Per-node failure diagnosis (reference ``diagnosis_agent.py:59``)."""
+
+    def __init__(
+        self,
+        master_client=None,
+        log_dir: str = "",
+        max_in_place_restarts: int = 3,
+    ):
+        self.client = master_client
+        self._log_collector = TrainingLogCollector(log_dir)
+        self._max_restarts = max_in_place_restarts
+
+    def diagnose_training_failure(
+        self, failures: List[Tuple[int, int]], restart_count: int
+    ) -> str:
+        """Decide the recovery action after worker failures.
+
+        ``failures``: [(local_rank, exit_code)].  Returns a
+        ``DiagnosisActionType``: RESTART_WORKER keeps this node and respawns
+        processes; RELAUNCH_WORKER asks the master to replace the node.
+        """
+        logs = self._log_collector.collect().lower()
+        node_sick = any(p in logs for p in NODE_ERROR_PATTERNS)
+        # SIGKILLs (-9) from the OOM killer also mean the node is sick.
+        oom_kill = any(code == -9 for _, code in failures) and (
+            "out of memory" in logs or "oom" in logs
+        )
+        if node_sick or oom_kill:
+            reason = "node-level error in worker logs"
+            action = DiagnosisActionType.RELAUNCH_WORKER
+        elif restart_count > self._max_restarts:
+            reason = f"in-place restart budget ({self._max_restarts}) spent"
+            action = DiagnosisActionType.RELAUNCH_WORKER
+        else:
+            reason = "transient/user error; restarting in place"
+            action = DiagnosisActionType.RESTART_WORKER
+        logger.info(
+            "failure diagnosis: %s (%s; failures=%s restarts=%d)",
+            action, reason, failures, restart_count,
+        )
+        if self.client is not None:
+            try:
+                self.client.report_diagnosis_data(
+                    "failure",
+                    json.dumps(
+                        {
+                            "failures": failures,
+                            "restart_count": restart_count,
+                            "action": action,
+                            "reason": reason,
+                        }
+                    ),
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        return action
+
+
+class HangingDetector:
+    """Watches step progression; fires a callback when stalled
+    (ATorch ``hanging_detector.py:86``, TPU-adapted: step timestamps come
+    from ``record_step`` calls or a heartbeat file workers touch).
+
+    ``compile_grace_s`` suppresses alarms before the first recorded step
+    (XLA compilation can legitimately take tens of minutes).
+    """
+
+    def __init__(
+        self,
+        hang_timeout_s: float = 1800.0,
+        compile_grace_s: float = 3600.0,
+        on_hang=None,
+        heartbeat_file: str = "",
+        check_interval_s: float = 30.0,
+    ):
+        self._timeout = hang_timeout_s
+        self._grace = compile_grace_s
+        self._on_hang = on_hang
+        self._hb_file = heartbeat_file
+        self._interval = check_interval_s
+        self._last_step = -1
+        self._last_progress = time.time()
+        self._started = time.time()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- feed --------------------------------------------------------------
+    def record_step(self, step: int) -> None:
+        with self._lock:
+            if step != self._last_step:
+                self._last_step = step
+                self._last_progress = time.time()
+
+    def _file_mtime(self) -> Optional[float]:
+        if not self._hb_file:
+            return None
+        try:
+            return os.stat(self._hb_file).st_mtime
+        except OSError:
+            return None
+
+    # -- query -------------------------------------------------------------
+    def is_hanging(self) -> bool:
+        now = time.time()
+        with self._lock:
+            last_step = self._last_step
+            last_progress = self._last_progress
+        mtime = self._file_mtime()
+        if mtime is not None:
+            last_progress = max(last_progress, mtime)
+        if last_step < 0 and mtime is None:
+            # No step ever recorded: inside the compile grace window?
+            return now - self._started > self._grace
+        return now - last_progress > self._timeout
+
+    # -- background watcher ------------------------------------------------
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="hang-detector", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            if self.is_hanging():
+                logger.warning(
+                    "hang detected: no step progress for >%.0fs",
+                    self._timeout,
+                )
+                if self._on_hang is not None:
+                    try:
+                        self._on_hang()
+                    except Exception:  # noqa: BLE001
+                        logger.exception("on_hang callback failed")
+                # One alarm per stall: reset the clock so the callback is
+                # not hammered every interval.
+                with self._lock:
+                    self._last_progress = time.time()

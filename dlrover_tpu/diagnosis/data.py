@@ -1,0 +1,74 @@
+"""Diagnosis data store: reported metrics with TTL.
+
+Parity with reference ``master/diagnosis/diagnosis_data_manager.py:22``
+(``DiagnosisDataManager``: bounded per-type time series of agent reports).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class DiagnosisDataType:
+    """Well-known ``DiagnosisReport.data_type`` values (reference
+    ``diagnosis/common/constants.py DiagnosisDataType``)."""
+
+    TRAINING_LOG = "training_log"
+    STEP_METRICS = "step_metrics"  # xpu-timer analogue: step heartbeats
+    NODE_RESOURCE = "node_resource"
+    FAILURE = "failure"
+
+
+@dataclasses.dataclass
+class DiagnosisRecord:
+    node_id: int
+    data_type: str
+    content: str
+    timestamp: float
+
+
+class DiagnosisDataManager:
+    def __init__(self, ttl_s: float = 600.0, max_per_type: int = 1000):
+        self._ttl = ttl_s
+        self._max = max_per_type
+        self._lock = threading.Lock()
+        self._data: Dict[str, List[DiagnosisRecord]] = {}
+
+    def store_data(
+        self,
+        node_id: int,
+        data_type: str,
+        content: str,
+        timestamp: Optional[float] = None,
+    ) -> None:
+        rec = DiagnosisRecord(
+            node_id, data_type, content, timestamp or time.time()
+        )
+        with self._lock:
+            series = self._data.setdefault(data_type, [])
+            series.append(rec)
+            self._expire_locked(series)
+
+    def get_data(self, data_type: str) -> List[DiagnosisRecord]:
+        with self._lock:
+            series = self._data.get(data_type, [])
+            self._expire_locked(series)
+            return list(series)
+
+    def latest_per_node(self, data_type: str) -> Dict[int, DiagnosisRecord]:
+        out: Dict[int, DiagnosisRecord] = {}
+        for rec in self.get_data(data_type):
+            cur = out.get(rec.node_id)
+            if cur is None or rec.timestamp > cur.timestamp:
+                out[rec.node_id] = rec
+        return out
+
+    def _expire_locked(self, series: List[DiagnosisRecord]) -> None:
+        cutoff = time.time() - self._ttl
+        while series and series[0].timestamp < cutoff:
+            series.pop(0)
+        if len(series) > self._max:
+            del series[: len(series) - self._max]

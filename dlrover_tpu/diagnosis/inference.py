@@ -1,0 +1,106 @@
+"""Inference chain: problem hypotheses resolved by pluggable operators.
+
+Parity with reference ``master/diagnosis/inferencechain/``
+(``Inference``/``InferenceOperator`` ``common/inference_chain.py``,
+``InferenceChain inference_chain.py:24``, ``coordinate_solutions
+coordinator.py:33``).  An :class:`Inference` is a (name, attribution,
+configs) fact; operators expand unresolved facts into observed/resolved
+ones; the coordinator maps conclusions to :class:`DiagnosisAction` s.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.constants import DiagnosisActionType
+from dlrover_tpu.common.log import logger
+
+
+class InferenceName:
+    TRAINING_HANG = "training_hang"
+    NODE_FAILURE = "node_failure"
+    STRAGGLER = "straggler"
+
+
+@dataclasses.dataclass
+class Inference:
+    """One hypothesis or conclusion (reference ``Inference``)."""
+
+    name: str
+    attribution: str = ""  # "" = unresolved hypothesis
+    configs: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def resolved(self) -> bool:
+        return bool(self.attribution)
+
+
+class InferenceOperator:
+    """ABC (reference ``InferenceOperator``)."""
+
+    def is_compatible(self, inference: Inference) -> bool:
+        raise NotImplementedError
+
+    def infer(self, inferences: List[Inference]) -> List[Inference]:
+        raise NotImplementedError
+
+
+class InferenceChain:
+    """Runs operators over hypotheses until resolved
+    (reference ``inference_chain.py:24``)."""
+
+    def __init__(self, operators: List[InferenceOperator]):
+        self._operators = operators
+
+    def infer(self, hypotheses: List[Inference]) -> List[Inference]:
+        results: List[Inference] = []
+        for hyp in hypotheses:
+            expanded = [hyp]
+            for op in self._operators:
+                if not op.is_compatible(hyp):
+                    continue
+                try:
+                    expanded = op.infer(expanded)
+                except Exception:  # noqa: BLE001
+                    logger.exception(
+                        "inference operator %s failed", type(op).__name__
+                    )
+            results.extend(i for i in expanded if i.resolved)
+        return results
+
+
+class Attribution:
+    HANG = "hang"
+    FAILED = "failed"
+    STRAGGLER = "straggler"
+    HEALTHY = "healthy"
+
+
+def coordinate_solutions(
+    conclusions: List[Inference],
+) -> Dict[int, List[m.DiagnosisAction]]:
+    """Conclusions -> per-node actions (reference ``coordinator.py:33``).
+
+    Hang -> restart the hung node's workers; failure -> relaunch the node.
+    """
+    actions: Dict[int, List[m.DiagnosisAction]] = {}
+    for c in conclusions:
+        node_id = int(c.configs.get("node_id", -1))
+        if c.attribution == Attribution.HANG:
+            act = m.DiagnosisAction(
+                action_type=DiagnosisActionType.RESTART_WORKER,
+                instance=str(node_id),
+                reason=c.configs.get("reason", "training hang"),
+            )
+        elif c.attribution == Attribution.FAILED:
+            act = m.DiagnosisAction(
+                action_type=DiagnosisActionType.RELAUNCH_WORKER,
+                instance=str(node_id),
+                reason=c.configs.get("reason", "node failure"),
+            )
+        else:
+            continue
+        actions.setdefault(node_id, []).append(act)
+    return actions

@@ -1,0 +1,153 @@
+"""Master-side diagnosis manager.
+
+Parity with reference ``master/diagnosis/diagnosis_manager.py:46``
+(``DiagnosisManager``: periodic observe -> resolve loop over reported data,
+producing per-node actions delivered on heartbeat replies) +
+``pre_check`` stub.  Plugs into :class:`MasterServicer` via the
+``diagnosis_manager`` slot (``collect_data`` / ``report_failure`` /
+``pop_actions``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.constants import DiagnosisActionType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.diagnosis.data import (
+    DiagnosisDataManager,
+    DiagnosisDataType,
+)
+from dlrover_tpu.diagnosis.inference import (
+    Inference,
+    InferenceChain,
+    InferenceName,
+    coordinate_solutions,
+)
+from dlrover_tpu.diagnosis.operators import (
+    CheckFailureNodeOperator,
+    CheckTrainingHangOperator,
+)
+
+
+class DiagnosisManager:
+    def __init__(
+        self,
+        speed_monitor=None,
+        interval_s: float = 60.0,
+        hang_timeout_s: float = 1800.0,
+    ):
+        # TTL must exceed the hang timeout or per-node stall detection can
+        # never fire: a stalled node's records would expire before the
+        # stall becomes diagnosable.
+        self.data_manager = DiagnosisDataManager(
+            ttl_s=max(600.0, 2.0 * hang_timeout_s)
+        )
+        self._interval = interval_s
+        self._chain = InferenceChain(
+            [
+                CheckTrainingHangOperator(
+                    self.data_manager,
+                    speed_monitor,
+                    hang_timeout_s=hang_timeout_s,
+                ),
+                CheckFailureNodeOperator(self.data_manager),
+            ]
+        )
+        self._lock = threading.Lock()
+        self._pending: Dict[int, List[m.DiagnosisAction]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- servicer entry points ---------------------------------------------
+    def collect_data(self, msg: m.DiagnosisReport) -> None:
+        self.data_manager.store_data(
+            msg.node_id, msg.data_type, msg.content, msg.timestamp or None
+        )
+
+    def report_failure(self, msg: m.NodeFailure) -> None:
+        self.data_manager.store_data(
+            msg.node_id, DiagnosisDataType.FAILURE, msg.error_data
+        )
+
+    BROADCAST_TTL_S = 300.0
+
+    def pop_actions(self, node_id: int) -> List[m.DiagnosisAction]:
+        """Actions for ``node_id`` (+ broadcast actions under node -1),
+        consumed on delivery (reference heartbeat-reply piggyback).
+        Broadcasts go to each node at most once and expire after
+        ``BROADCAST_TTL_S`` so a one-off diagnosis can't restart-loop the
+        job forever."""
+        now = time.time()
+        with self._lock:
+            out = self._pending.pop(node_id, [])
+            broadcast = self._pending.get(-1, [])
+            keep = []
+            for act in broadcast:
+                if now - act.payload.get("created", 0.0) < (
+                    self.BROADCAST_TTL_S
+                ):
+                    keep.append(act)
+                seen = act.payload.setdefault("delivered", [])
+                if node_id not in seen:
+                    seen.append(node_id)
+                    out.append(act)
+            if keep:
+                self._pending[-1] = keep
+            else:
+                self._pending.pop(-1, None)
+        return out
+
+    # -- pre-check (reference pre_check stub) ------------------------------
+    def pre_check(self) -> bool:
+        return True
+
+    # -- observe loop ------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="diagnosis", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.diagnose_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("diagnosis pass failed")
+
+    def diagnose_once(self) -> Dict[int, List[m.DiagnosisAction]]:
+        hypotheses = [
+            Inference(InferenceName.TRAINING_HANG),
+            Inference(InferenceName.NODE_FAILURE),
+        ]
+        conclusions = self._chain.infer(hypotheses)
+        actions = coordinate_solutions(conclusions)
+        if actions:
+            logger.info(
+                "diagnosis: %s",
+                {
+                    nid: [a.action_type for a in acts]
+                    for nid, acts in actions.items()
+                },
+            )
+        now = time.time()
+        with self._lock:
+            for nid, acts in actions.items():
+                existing = self._pending.setdefault(nid, [])
+                for act in acts:
+                    if not any(
+                        e.action_type == act.action_type
+                        and e.reason == act.reason
+                        for e in existing
+                    ):
+                        act.payload.setdefault("created", now)
+                        existing.append(act)
+        return actions

@@ -1,0 +1,143 @@
+"""Master-side inference operators.
+
+Parity with reference ``master/diagnosis/inferencechain/inferenceoperator/``
+(``check_training_hang_operator.py:32``, ``check_failure_node_operator.py``).
+TPU signal sources: the speed monitor's global-step clock and per-node step
+reports replace xpu-timer kernel-gap metrics; compile grace windows keep a
+first XLA compile from reading as a hang.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional
+
+from dlrover_tpu.diagnosis.data import DiagnosisDataManager, DiagnosisDataType
+from dlrover_tpu.diagnosis.inference import (
+    Attribution,
+    Inference,
+    InferenceName,
+    InferenceOperator,
+)
+
+
+class CheckTrainingHangOperator(InferenceOperator):
+    """Flags nodes whose step reports stalled while the job is nominally
+    running (reference ``check_training_hang_operator.py:32``)."""
+
+    def __init__(
+        self,
+        data_manager: DiagnosisDataManager,
+        speed_monitor=None,
+        hang_timeout_s: float = 1800.0,
+        compile_grace_s: float = 3600.0,
+    ):
+        self._data = data_manager
+        self._speed_monitor = speed_monitor
+        self._hang_timeout = hang_timeout_s
+        self._compile_grace = compile_grace_s
+        self._started_at = time.time()
+
+    def is_compatible(self, inference: Inference) -> bool:
+        return inference.name == InferenceName.TRAINING_HANG
+
+    def infer(self, inferences: List[Inference]) -> List[Inference]:
+        now = time.time()
+        # Whole-job hang: the global step stopped advancing.
+        if self._speed_monitor is not None:
+            if (
+                self._speed_monitor.completed_global_step == 0
+                and now - self._started_at < self._compile_grace
+            ):
+                return []  # still compiling / warming up
+            if self._speed_monitor.hang_detected(self._hang_timeout):
+                return [
+                    Inference(
+                        InferenceName.TRAINING_HANG,
+                        Attribution.HANG,
+                        {
+                            "node_id": "-1",
+                            "reason": (
+                                f"global step stalled >"
+                                f"{self._hang_timeout:.0f}s"
+                            ),
+                        },
+                    )
+                ]
+        # Per-node hang: a node's own step reports went quiet while others
+        # kept reporting.
+        latest = self._data.latest_per_node(DiagnosisDataType.STEP_METRICS)
+        if len(latest) < 2:
+            return []
+        times = {nid: rec.timestamp for nid, rec in latest.items()}
+        freshest = max(times.values())
+        out = []
+        for nid, ts in times.items():
+            if freshest - ts > self._hang_timeout:
+                out.append(
+                    Inference(
+                        InferenceName.TRAINING_HANG,
+                        Attribution.HANG,
+                        {
+                            "node_id": str(nid),
+                            "reason": (
+                                f"node {nid} step reports stalled "
+                                f"{freshest - ts:.0f}s behind peers"
+                            ),
+                        },
+                    )
+                )
+        return out
+
+
+class CheckFailureNodeOperator(InferenceOperator):
+    """Classifies reported node failures (reference
+    ``check_failure_node_operator.py``): fatal error patterns in the
+    reported logs mean the node itself is sick -> relaunch."""
+
+    # Patterns that indicate the *node/runtime*, not the user code, failed.
+    NODE_ERROR_PATTERNS = (
+        "hardware",
+        "ici link",
+        "device unavailable",
+        "tpu initialization failed",
+        "out of memory",
+        "coordination service",
+        "heartbeat",
+    )
+
+    def __init__(self, data_manager: DiagnosisDataManager):
+        self._data = data_manager
+
+    def is_compatible(self, inference: Inference) -> bool:
+        return inference.name == InferenceName.NODE_FAILURE
+
+    def infer(self, inferences: List[Inference]) -> List[Inference]:
+        out = []
+        for rec in self._data.get_data(DiagnosisDataType.FAILURE):
+            content = rec.content.lower()
+            node_error = any(
+                p in content for p in self.NODE_ERROR_PATTERNS
+            )
+            out.append(
+                Inference(
+                    InferenceName.NODE_FAILURE,
+                    Attribution.FAILED if node_error else Attribution.HEALTHY,
+                    {
+                        "node_id": str(rec.node_id),
+                        "reason": rec.content[:200],
+                        "node_error": str(node_error),
+                    },
+                )
+            )
+        return out
+
+
+def parse_step_metrics(content: str) -> Optional[dict]:
+    """Parse a STEP_METRICS report payload ({"step": int, "ts": float})."""
+    try:
+        d = json.loads(content)
+        return d if isinstance(d, dict) else None
+    except (ValueError, TypeError):
+        return None

@@ -110,7 +110,17 @@ class DistributedJobMaster(JobMaster):
             self.speed_monitor,
             self.resource_optimizer,
         )
-        self.diagnosis_manager = None
+        from dlrover_tpu.diagnosis.manager import DiagnosisManager
+        from dlrover_tpu.master.strategy_generator import (
+            SimpleStrategyGenerator,
+        )
+
+        self.diagnosis_manager = DiagnosisManager(
+            self.speed_monitor, hang_timeout_s=self._ctx.hang_timeout_s
+        )
+        self.strategy_generator = SimpleStrategyGenerator(
+            self.job_manager, self.speed_monitor
+        )
 
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
@@ -138,6 +148,9 @@ class DistributedJobMaster(JobMaster):
         self.task_manager.start()
         self.job_manager.start()
         self.auto_scaler.start_auto_scaling()
+        self.diagnosis_manager.start()
+        if self._ctx.auto_tune:
+            self.strategy_generator.start()
         self.stage = JobStage.RUNNING
         logger.info(
             "distributed master for %s ready on :%d (%s)",
@@ -176,5 +189,7 @@ class DistributedJobMaster(JobMaster):
         self.auto_scaler.stop_auto_scaling()
         self.task_manager.stop()
         self.job_manager.stop()
+        self.diagnosis_manager.stop()
+        self.strategy_generator.stop()
         self._server.stop()
         self.platform.close()

@@ -80,7 +80,17 @@ class LocalJobMaster(JobMaster):
         }
         for mgr in self.rdzv_managers.values():
             mgr.update_rdzv_params(min_nodes, max_nodes, node_unit=node_unit)
-        self.diagnosis_manager = None  # attached by diagnosis module when used
+        from dlrover_tpu.diagnosis.manager import DiagnosisManager
+        from dlrover_tpu.master.strategy_generator import (
+            SimpleStrategyGenerator,
+        )
+
+        self.diagnosis_manager = DiagnosisManager(
+            self.speed_monitor, hang_timeout_s=self._ctx.hang_timeout_s
+        )
+        self.strategy_generator = SimpleStrategyGenerator(
+            self.job_manager, self.speed_monitor
+        )
 
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
@@ -105,6 +115,9 @@ class LocalJobMaster(JobMaster):
     def prepare(self) -> None:
         self.task_manager.start()
         self.job_manager.start()
+        self.diagnosis_manager.start()
+        if self._ctx.auto_tune:
+            self.strategy_generator.start()
         self._server.start()
         self.stage = JobStage.RUNNING
         logger.info("local master for %s ready on :%d", self.job_name, self.port)
@@ -142,6 +155,8 @@ class LocalJobMaster(JobMaster):
         self.stage = JobStage.STOPPED
         self.task_manager.stop()
         self.job_manager.stop()
+        self.diagnosis_manager.stop()
+        self.strategy_generator.stop()
         self._server.stop()
 
 

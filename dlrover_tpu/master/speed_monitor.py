@@ -93,11 +93,17 @@ class SpeedMonitor:
 
     def hang_detected(self, timeout: Optional[float] = None) -> bool:
         """No step progress for longer than ``hang_timeout_s`` while steps
-        had been flowing (feeds the diagnosis chain)."""
+        had been flowing (feeds the diagnosis chain).  A known down window
+        (restart/rendezvous -> XLA recompile) is not a hang: the clock
+        restarts when steps resume (``mark_down``/``collect_global_step``)."""
         with self._lock:
             if self._last_step_time is None:
                 return False
             t = timeout if timeout is not None else self._ctx.hang_timeout_s
+            if self._down_since is not None:
+                # Known pause (restart -> recompile): give it double the
+                # hang budget before calling the recovery itself hung.
+                return time.time() - self._down_since > 2 * t
             return time.time() - self._last_step_time > t
 
     def reset_running_speed_monitor(self) -> None:

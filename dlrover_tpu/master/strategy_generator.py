@@ -1,0 +1,102 @@
+"""Hyperparameter / parallel-config generator (master-side auto-tuning).
+
+Parity with reference ``master/hyperparams/simple_strategy_generator.py:40``
+(``SimpleStrategyGenerator``: tune dataloader workers / batch size from
+per-node resource reports, push ``ParallelConfig`` to agents).  TPU twist:
+on-device batch size is fixed by the compiled program, so the tunables are
+host-side input-pipeline knobs (dataloader workers, prefetch depth) and a
+*suggested* grad-accumulation count the elastic trainer can apply without
+recompiling the per-microbatch step.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.log import logger
+
+
+class SimpleStrategyGenerator:
+    def __init__(
+        self,
+        job_manager=None,
+        speed_monitor=None,
+        interval_s: float = 60.0,
+    ):
+        self._job_manager = job_manager
+        self._speed_monitor = speed_monitor
+        self._lock = threading.Lock()
+        self._version = 0
+        self._config = m.ParallelConfig()
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- periodic push (reference: master pushes configs agents poll) ------
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="strategy-generator", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                cfg = self.generate_config()
+                if self._job_manager is not None:
+                    for node_id in self._job_manager.all_nodes():
+                        self._job_manager.set_parallel_config(node_id, cfg)
+            except Exception:  # noqa: BLE001
+                logger.exception("strategy generation failed")
+
+    def current_config(self) -> m.ParallelConfig:
+        with self._lock:
+            return self._config
+
+    def generate_config(self) -> m.ParallelConfig:
+        """One tuning pass from observed node resources
+        (reference ``generate_config``): CPU headroom -> more dataloader
+        workers; memory pressure -> fewer + smaller prefetch."""
+        cpu_percent = 0.0
+        mem_pressure = False
+        n = 0
+        if self._job_manager is not None:
+            for node in self._job_manager.all_nodes().values():
+                used = node.used_resource
+                if used.cpu > 0:
+                    cpu_percent += used.cpu
+                    n += 1
+                cfg_mem = node.config_resource.memory_mb
+                if cfg_mem and used.memory_mb > 0.9 * cfg_mem:
+                    mem_pressure = True
+        cpu_percent = cpu_percent / n if n else 0.0
+
+        with self._lock:
+            dl = dict(self._config.dataloader)
+            workers = int(dl.get("num_workers", 2))
+            prefetch = int(dl.get("prefetch", 2))
+            if mem_pressure:
+                workers = max(1, workers - 1)
+                prefetch = max(1, prefetch - 1)
+            elif cpu_percent and cpu_percent < 50.0:
+                workers = min(16, workers + 1)
+            new_dl = {"num_workers": workers, "prefetch": prefetch}
+            if new_dl != dl:
+                self._version += 1
+                self._config = m.ParallelConfig(
+                    dataloader=new_dl,
+                    optimizer=dict(self._config.optimizer),
+                    mesh=dict(self._config.mesh),
+                    version=self._version,
+                )
+                logger.info(
+                    "strategy generator: v%d dataloader=%s",
+                    self._version, new_dl,
+                )
+            return self._config

@@ -42,6 +42,7 @@ class ElasticContext:
         self.master_addr = env_utils.get_master_addr()
         self.client: Optional[MasterClient] = None
         self.distributed = False
+        self._last_metrics_report = 0.0
 
     @property
     def is_leader(self) -> bool:
@@ -49,12 +50,31 @@ class ElasticContext:
 
     def report_step(self, step: int) -> None:
         """Feed the master's speed monitor / goodput accounting (leader
-        only; reference ``report_global_step``)."""
-        if self.client is not None and self.is_leader:
+        only; reference ``report_global_step``) and, throttled, this node's
+        step-metrics diagnosis stream (per-node stall detection,
+        reference xpu-timer collector)."""
+        if self.client is None:
+            return
+        if self.is_leader:
             try:
                 self.client.report_global_step(step)
             except Exception as e:  # noqa: BLE001
                 logger.warning("report_step failed: %s", e)
+        if self.local_rank == 0:
+            import time as _time
+
+            now = _time.time()
+            if now - self._last_metrics_report > 30.0:
+                self._last_metrics_report = now
+                try:
+                    import json as _json
+
+                    self.client.report_diagnosis_data(
+                        "step_metrics",
+                        _json.dumps({"step": step, "ts": now}),
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
 
 
 _ctx: Optional[ElasticContext] = None
