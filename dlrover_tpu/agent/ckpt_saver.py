@@ -64,10 +64,23 @@ class AsyncCheckpointSaver:
         self._last_event: Dict[int, dict] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._replica_thread: Optional[threading.Thread] = None
         self._pool = ThreadPoolExecutor(
             max_workers=max(2, self._ctx.ckpt_shard_io_workers),
             thread_name_prefix="ckpt-io",
         )
+        # Cross-node in-memory replicas (reference replica.py; opt-in via
+        # DLROVER_TPU_CKPT_REPLICA=1 — costs DCN bandwidth per save).
+        self.replica = None
+        if self._ctx.ckpt_replica and master_client is not None:
+            try:
+                from dlrover_tpu.checkpoint.replica import (
+                    CkptReplicaManager,
+                )
+
+                self.replica = CkptReplicaManager(master_client)
+            except Exception:  # noqa: BLE001
+                logger.exception("replica manager unavailable")
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -80,9 +93,102 @@ class AsyncCheckpointSaver:
                 "async checkpoint saver up (job=%s nproc=%d)",
                 self.job_name, self.nproc,
             )
+        if self.replica is not None and self._replica_thread is None:
+            # Memory-only saves never enqueue events; replicate by
+            # watching the arenas' staged steps directly.
+            self._replica_thread = threading.Thread(
+                target=self._replica_loop, name="ckpt-replica", daemon=True
+            )
+            self._replica_thread.start()
+
+    def _replica_loop(self) -> None:
+        interval = max(5.0, self.replica.push_interval / 2)
+        pushed: Dict[int, int] = {}
+        while not self._stop.wait(interval):
+            for lr in range(self.nproc):
+                try:
+                    arena = self._arena(lr)
+                    arena.reopen()
+                    # Cheap metadata peek first: copying the full state
+                    # every poll just to compare steps would hold the
+                    # fencing lock for a multi-GB memcpy.
+                    meta = arena.metadata()
+                    if meta is None or int(
+                        meta.get("extra", {}).get("step", -1)
+                    ) <= pushed.get(lr, -1):
+                        continue
+                    lock = self._locks[lr] if lr < len(self._locks) else None
+                    if lock is not None and not lock.acquire(timeout=5.0):
+                        continue
+                    try:
+                        read = arena.read_state(copy=True)
+                    finally:
+                        if lock is not None:
+                            lock.release()
+                    if read is None:
+                        continue
+                    tensors, extra = read
+                    step = int(extra.get("step", -1))
+                    if step <= pushed.get(lr, -1):
+                        continue
+                    pid = int(extra.get("process_id", lr))
+                    if self.replica.backup_shard(pid, step, tensors, extra):
+                        pushed[lr] = step
+                except FileNotFoundError:
+                    continue  # no staged state yet on this rank
+                except Exception:  # noqa: BLE001
+                    logger.exception("replica push for rank %d failed", lr)
+
+    def update_world(self, node_rank: int, world_size: int) -> None:
+        """Refresh replica ring neighbours after a rendezvous round."""
+        if self.replica is not None:
+            self.replica.update_world(node_rank, world_size)
+
+    def seed_from_replicas(
+        self, process_ids: Dict[int, int], num_processes: int
+    ) -> int:
+        """Seed empty/stale local arenas from peer replicas before workers
+        start (reference FullCkptReplicaManager gather-on-restart).
+
+        ``process_ids``: local_rank -> global process_id for the coming
+        round.  Returns how many arenas were seeded."""
+        if self.replica is None:
+            return 0
+        seeded = 0
+        for lr, pid in process_ids.items():
+            arena = self._arena(lr)
+            cur_step = -1
+            try:
+                arena.reopen()
+                meta = arena.metadata()
+                if meta is not None:
+                    cur_step = int(meta.get("extra", {}).get("step", -1))
+            except Exception:  # noqa: BLE001
+                pass
+            got = self.replica.fetch_replica(pid, min_step=cur_step + 1)
+            if got is None:
+                continue
+            step, tensors, extra = got
+            if extra.get("num_processes") != num_processes:
+                continue  # world changed: resharding goes through storage
+            lock = self._locks[lr] if lr < len(self._locks) else None
+            if lock is not None and not lock.acquire(timeout=30.0):
+                continue
+            try:
+                arena.write_state(tensors, extra=extra)
+                seeded += 1
+                logger.info(
+                    "replica: seeded local arena %d with step %d", lr, step
+                )
+            finally:
+                if lock is not None:
+                    lock.release()
+        return seeded
 
     def stop(self) -> None:
         self._stop.set()
+        if self.replica is not None:
+            self.replica.stop()
         self._pool.shutdown(wait=False)
         self._queue.close()
         for lock in self._locks:
@@ -156,6 +262,10 @@ class AsyncCheckpointSaver:
             "saver: persisted rank %d step %d in %.2fs",
             lr, step, time.perf_counter() - t0,
         )
+        if self.replica is not None:
+            self._pool.submit(
+                self.replica.backup_shard, pid, step, tensors, extra
+            )
         if pid == 0:
             # Commit waits for the OTHER ranks' shards — never block the
             # event loop on it (they may be persisted by this same loop).
@@ -187,7 +297,20 @@ class AsyncCheckpointSaver:
             try:
                 arena = self._arena(lr)
                 arena.reopen()
-                meta = arena.metadata()
+                # Take the fencing lock so an in-flight worker write
+                # finishes first — an unlocked peek mid-write reads the
+                # dirty flag and would silently skip this rank's state.
+                lock = self._locks[lr] if lr < len(self._locks) else None
+                if lock is not None and not lock.acquire(timeout=60.0):
+                    logger.warning(
+                        "breakpoint save: rank %d lock busy; skipping", lr
+                    )
+                    continue
+                try:
+                    meta = arena.metadata()
+                finally:
+                    if lock is not None:
+                        lock.release()
             except Exception:  # noqa: BLE001
                 continue
             if meta is None:

@@ -97,7 +97,10 @@ class MasterClient:
         local_world_size: int,
         rdzv_name: str = "elastic-training",
         slice_id: str = "",
+        attempt_id: str = "",
     ) -> int:
+        import uuid as _uuid
+
         resp = self._client.call(
             m.JoinRendezvous(
                 node_id=self.node_id,
@@ -105,6 +108,7 @@ class MasterClient:
                 local_world_size=local_world_size,
                 rdzv_name=rdzv_name,
                 slice_id=slice_id,
+                attempt_id=attempt_id or _uuid.uuid4().hex,
             )
         )
         return resp.round if isinstance(resp, m.RendezvousRound) else -1

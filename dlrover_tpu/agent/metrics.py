@@ -1,0 +1,87 @@
+"""Agent /metrics endpoint (Prometheus text exposition).
+
+The TPU stand-in for the reference's xpu-timer Prometheus scrape
+(``xpu_timer_metric_collector.py:22`` reads a worker-local metrics port):
+here the *agent* exposes its own gauges — restart counts, persisted
+checkpoint steps, host resource usage — for cluster scrapers.  Enabled by
+``DLROVER_TPU_METRICS_PORT`` (0/unset = off).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from dlrover_tpu.common.log import logger
+
+PREFIX = "dlrover_tpu"
+
+
+class MetricsRegistry:
+    """Name -> callable returning a float (sampled at scrape time)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gauges: Dict[str, Callable[[], float]] = {}
+
+    def gauge(self, name: str, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[name] = fn
+
+    def set(self, name: str, value: float) -> None:
+        self.gauge(name, lambda v=value: v)
+
+    def render(self) -> str:
+        lines = []
+        with self._lock:
+            items = list(self._gauges.items())
+        for name, fn in items:
+            try:
+                val = float(fn())
+            except Exception:  # noqa: BLE001
+                continue
+            lines.append(f"# TYPE {PREFIX}_{name} gauge")
+            lines.append(f"{PREFIX}_{name} {val}")
+        return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    def __init__(self, registry: MetricsRegistry, port: int = 0):
+        self.registry = registry
+        reg = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = reg.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request logs
+                pass
+
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self._httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="metrics-http",
+                daemon=True,
+            )
+            self._thread.start()
+            logger.info("metrics endpoint on :%d/metrics", self.port)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()

@@ -210,6 +210,17 @@ class ElasticTrainingAgent:
         my = world[world_info["my_rank"]]
         base = my["process_id_base"]
         self._workers = []
+        if self.saver is not None:
+            # Refresh replica ring + seed arenas from peers (a replaced
+            # node recovers the last staged step without storage).
+            try:
+                self.saver.update_world(world_info["my_rank"], len(world))
+                self.saver.seed_from_replicas(
+                    {lr: base + lr for lr in range(cfg.nproc_per_node)},
+                    world_info["num_processes"],
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("replica seeding failed")
         # Workers run `python script.py`, whose sys.path[0] is the script's
         # dir; make the launcher's cwd and this framework importable
         # (torchrun's PYTHONPATH contract).
@@ -338,6 +349,32 @@ class ElasticTrainingAgent:
         self.resource_monitor.start()
         if self._ctx.auto_tune:
             self.config_tuner.start()
+        metrics_port = int(os.environ.get("DLROVER_TPU_METRICS_PORT", "0"))
+        if metrics_port:
+            from dlrover_tpu.agent.metrics import (
+                MetricsRegistry,
+                MetricsServer,
+            )
+            from dlrover_tpu.agent.monitor import current_usage
+
+            reg = MetricsRegistry()
+            reg.gauge("restart_count", lambda: float(self._restart_count))
+            reg.gauge("rdzv_round", lambda: float(self._rdzv_round))
+            reg.gauge(
+                "node_cpu_percent",
+                lambda: current_usage()["cpu_percent"],
+            )
+            reg.gauge(
+                "node_memory_mb", lambda: current_usage()["memory_mb"]
+            )
+            try:
+                self.metrics_server = MetricsServer(reg, metrics_port)
+                self.metrics_server.start()
+            except OSError:
+                logger.warning(
+                    "metrics port %d unavailable; endpoint disabled",
+                    metrics_port,
+                )
         # Flash-checkpoint saver daemon: lives in the agent so persistence
         # survives worker crashes (reference start_async_saving_ckpt :869).
         if self.saver is None:

@@ -231,12 +231,111 @@ class CheckpointEngine:
         With ``target`` given, returns (pytree-like-target, meta); without,
         returns (ShardSource, meta) for caller-side assembly."""
         got = self._load_from_shm()
-        got = self._agree_shm_step(got)
-        if got is None:
-            got = self._load_from_storage()
-        if got is None:
+        got = self._agree_shm_step(got)  # collective: same branch all ranks
+        if got is not None:
+            source, extra = got
+            try:
+                result = self._finish_load(source, extra, target)
+            except KeyError:
+                result = None
+                logger.warning(
+                    "shm restore incomplete; falling back to storage"
+                )
+            # Collective: if any rank's shm assembly failed, all ranks
+            # fall back together (collective-count symmetry).
+            if self._all_ranks_ok(result is not None):
+                return result
+        # Storage: committed step first, then newer uncommitted steps whose
+        # available shards still cover the target (e.g. a breakpoint save
+        # from a partial world with replicated state).
+        result = None
+        chosen = -1
+        for source, extra in self._storage_candidates():
+            try:
+                result = self._finish_load(source, extra, target)
+                chosen = int(extra.get("step", 0))
+                break
+            except KeyError as e:
+                logger.warning(
+                    "storage step %s not restorable (%s); trying older",
+                    extra.get("step"), e,
+                )
+        return self._agree_storage_step(result, chosen, target)
+
+    def _all_ranks_ok(self, ok: bool) -> bool:
+        """Collective AND over processes (True everywhere or False
+        everywhere); trivially ``ok`` single-process."""
+        if self.num_processes <= 1:
+            return ok
+        try:
+            import jax as _jax
+            from jax.experimental import multihost_utils
+
+            if _jax.process_count() != self.num_processes:
+                return ok
+            flags = np.asarray(
+                multihost_utils.process_allgather(np.int64(1 if ok else 0))
+            ).reshape(-1)
+            return bool(flags.all())
+        except Exception:  # noqa: BLE001
+            return ok
+
+    def _agree_storage_step(self, result, chosen: int, target):
+        """Cross-rank agreement on the restored storage step: per-rank read
+        failures must not let ranks silently resume from different steps.
+        All processes call this (collective); single-process is a no-op."""
+        if self.num_processes <= 1:
+            return result
+        try:
+            import jax as _jax
+            from jax.experimental import multihost_utils
+
+            if _jax.process_count() != self.num_processes:
+                return result
+            steps = np.asarray(
+                multihost_utils.process_allgather(np.int64(chosen))
+            ).reshape(-1)
+        except Exception:  # noqa: BLE001 - not in a distributed context
+            return result
+        if (steps == chosen).all():
+            return result  # unanimous (including unanimous "nothing")
+        if (steps < 0).any():
+            agreed = -1  # someone has nothing restorable: all start fresh
+        else:
+            agreed = int(steps.min())
+        logger.warning(
+            "storage restore steps disagree across ranks (%s); agreeing "
+            "on %s", steps.tolist(), agreed if agreed >= 0 else "fresh start",
+        )
+        retry = None
+        if agreed >= 0:
+            if chosen == agreed:
+                retry = result
+            else:
+                for source, extra in self._storage_candidates():
+                    if int(extra.get("step", -1)) != agreed:
+                        continue
+                    try:
+                        retry = self._finish_load(source, extra, target)
+                    except KeyError:
+                        retry = None
+                    break
+        # Second collective: every rank must have the agreed step or all
+        # abandon the restore together.
+        ok = np.asarray(
+            multihost_utils.process_allgather(
+                np.int64(1 if (retry is not None or agreed < 0) else 0)
+            )
+        ).reshape(-1)
+        if not ok.all():
+            logger.warning(
+                "agreed storage step %d unrestorable on some rank; "
+                "starting fresh", agreed,
+            )
             return None
-        source, extra = got
+        return retry if agreed >= 0 else None
+
+    def _finish_load(self, source, extra, target):
         meta = extra.get("meta", {})
         meta.setdefault("step", extra.get("step", 0))
         if target is None:
@@ -311,24 +410,42 @@ class CheckpointEngine:
         )
         return source, extra
 
-    def _load_from_storage(self):
-        step = shard_file.latest_step(self.storage, self.ckpt_dir)
-        if step is None:
-            return None
-        source = tree_utils.ShardSource()
-        extra_out = None
-        for pid in shard_file.list_shard_ids(self.storage, self.ckpt_dir, step):
-            got = shard_file.read_shard(self.storage, self.ckpt_dir, step, pid)
-            if got is None:
+    def _storage_candidates(self):
+        """Yield (source, extra) per restorable storage step: the committed
+        (tracker) step first, then remaining step dirs newest-first.  The
+        caller validates coverage by attempting assembly — an uncommitted
+        step is usable when its present shards cover the target (fully
+        replicated layouts need any one rank's shard)."""
+        committed = shard_file.latest_step(self.storage, self.ckpt_dir)
+        steps = shard_file.list_steps(self.storage, self.ckpt_dir)
+        candidates = []
+        if committed is not None:
+            candidates.append(committed)
+        candidates.extend(
+            s for s in sorted(steps, reverse=True) if s != committed
+        )
+        for step in candidates:
+            source = tree_utils.ShardSource()
+            extra_out = None
+            for pid in shard_file.list_shard_ids(
+                self.storage, self.ckpt_dir, step
+            ):
+                got = shard_file.read_shard(
+                    self.storage, self.ckpt_dir, step, pid
+                )
+                if got is None:
+                    continue
+                tensors, extra = got
+                source.add(tensors, extra.get("tensors_info", {}))
+                if pid == self.process_id or extra_out is None:
+                    extra_out = extra
+            if extra_out is None:
                 continue
-            tensors, extra = got
-            source.add(tensors, extra.get("tensors_info", {}))
-            if pid == self.process_id or extra_out is None:
-                extra_out = extra
-        if extra_out is None:
-            return None
-        logger.info("flash ckpt: restore from storage step %d", step)
-        return source, extra_out
+            logger.info(
+                "flash ckpt: restore from storage step %d%s",
+                step, "" if step == committed else " (uncommitted)",
+            )
+            yield source, extra_out
 
     def close(self) -> None:
         if self._pool is not None:

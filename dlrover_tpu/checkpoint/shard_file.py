@@ -150,6 +150,18 @@ def commit(
             storage.safe_rmtree(step_dir(ckpt_dir, old))
 
 
+def list_steps(storage: CheckpointStorage, ckpt_dir: str) -> list:
+    """All step numbers with a step dir present (committed or not)."""
+    steps = []
+    for name in storage.listdir(ckpt_dir):
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name[len("step_"):]))
+            except ValueError:
+                pass
+    return steps
+
+
 def latest_step(storage: CheckpointStorage, ckpt_dir: str) -> Optional[int]:
     content = storage.read(tracker_path(ckpt_dir), mode="r")
     if not content:

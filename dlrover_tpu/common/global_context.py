@@ -36,6 +36,9 @@ class Context:
         self.seconds_to_autoscale_worker: float = 1800.0
         self.ckpt_shard_io_workers: int = 4
         self.auto_tune: bool = False
+        # Cross-node in-memory checkpoint replicas (flash-ckpt replica.py
+        # analogue); off by default — costs DCN bandwidth per save.
+        self.ckpt_replica: bool = False
         self._apply_env_overrides()
 
     def _apply_env_overrides(self) -> None:

@@ -159,6 +159,10 @@ class JoinRendezvous(Message):
     rdzv_name: str = "elastic-training"
     node_ip: str = ""
     slice_id: str = ""
+    # Unique per join *attempt*: lets the master tell an RPC-retried
+    # duplicate (same id -> no-op) from a genuine re-join after restart
+    # (new id -> evict the stale world membership).
+    attempt_id: str = ""
 
 
 @dataclasses.dataclass
@@ -466,3 +470,32 @@ class JobExitRequest(Message):
     node_id: int = 0
     reason: str = ""
     success: bool = True
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint replicas (agent <-> agent; reference flash_checkpoint/replica.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ReplicaPush(Message):
+    """Backup one process's staged checkpoint shard onto a peer node
+    (reference ``CkptReplicaManger.backup replica.py:57``)."""
+
+    owner_node: int = 0
+    process_id: int = 0
+    step: int = 0
+    payload: bytes = b""
+
+
+@dataclasses.dataclass
+class ReplicaFetch(Message):
+    process_id: int = 0
+    min_step: int = -1
+
+
+@dataclasses.dataclass
+class ReplicaData(Message):
+    found: bool = False
+    step: int = -1
+    payload: bytes = b""

@@ -194,9 +194,14 @@ class HangingDetector:
         mtime = self._file_mtime()
         if mtime is not None:
             last_progress = max(last_progress, mtime)
-        if last_step < 0 and mtime is None:
-            # No step ever recorded: inside the compile grace window?
-            return now - self._started > self._grace
+        if last_step < 0:
+            # No step ever recorded: the first XLA compile can take tens
+            # of minutes — apply the grace window even if a heartbeat
+            # file was created (but not yet touched) at startup.
+            return (
+                now - self._started > self._grace
+                and now - last_progress > self._timeout
+            )
         return now - last_progress > self._timeout
 
     # -- background watcher ------------------------------------------------

@@ -59,6 +59,12 @@ class DiagnosisManager:
         )
         self._lock = threading.Lock()
         self._pending: Dict[int, List[m.DiagnosisAction]] = {}
+        # (node_id, action_type, reason) -> delivery time: an action is not
+        # re-queued while its source record still sits in the data store,
+        # or a relaunched replacement node would be killed again by the
+        # same stale failure record on every diagnosis pass.
+        self._delivered: Dict[tuple, float] = {}
+        self._redeliver_cooldown_s = self.data_manager._ttl
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -87,10 +93,11 @@ class DiagnosisManager:
             broadcast = self._pending.get(-1, [])
             keep = []
             for act in broadcast:
-                if now - act.payload.get("created", 0.0) < (
+                if now - act.payload.get("created", 0.0) >= (
                     self.BROADCAST_TTL_S
                 ):
-                    keep.append(act)
+                    continue  # expired: neither kept nor delivered
+                keep.append(act)
                 seen = act.payload.setdefault("delivered", [])
                 if node_id not in seen:
                     seen.append(node_id)
@@ -140,9 +147,15 @@ class DiagnosisManager:
             )
         now = time.time()
         with self._lock:
+            for key, ts in list(self._delivered.items()):
+                if now - ts > self._redeliver_cooldown_s:
+                    del self._delivered[key]
             for nid, acts in actions.items():
                 existing = self._pending.setdefault(nid, [])
                 for act in acts:
+                    key = (nid, act.action_type, act.reason)
+                    if key in self._delivered:
+                        continue  # already acted on this record
                     if not any(
                         e.action_type == act.action_type
                         and e.reason == act.reason
@@ -150,4 +163,5 @@ class DiagnosisManager:
                     ):
                         act.payload.setdefault("created", now)
                         existing.append(act)
+                        self._delivered[key] = now
         return actions

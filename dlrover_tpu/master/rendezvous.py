@@ -87,6 +87,7 @@ class RendezvousManager:
         coordinator_port: int = 0,
         slice_id: str = "",
         host_id: str = "",
+        attempt_id: str = "",
     ) -> int:
         """Add a node to the waiting list; returns the round it will join
         (reference ``join_rendezvous :255``)."""
@@ -98,10 +99,31 @@ class RendezvousManager:
                 slice_id=slice_id,
                 host_id=host_id or host,
             )
+            if node_id in self._rdzv_nodes:
+                prev_attempt = self._node_extra.get(node_id, {}).get(
+                    "attempt_id", ""
+                )
+                if attempt_id and attempt_id == prev_attempt:
+                    # RPC-retried duplicate of the join that formed this
+                    # round: the node is alive and placed — no-op.
+                    return self._rdzv_round
+                # A member of the current world re-joining means its old
+                # incarnation died (agent restart / node relaunch): evict
+                # it so (a) it cannot be handed the stale round's world
+                # with a dead coordinator, and (b) peers observe
+                # num_nodes_waiting > 0 and re-rendezvous promptly.
+                del self._rdzv_nodes[node_id]
+                logger.info(
+                    "rdzv[%s]: node %d re-joined; evicted from round %d "
+                    "world (%d members remain)",
+                    self.name, node_id, self._latched_round,
+                    len(self._rdzv_nodes),
+                )
             self._waiting_nodes[node_id] = meta
             self._node_extra[node_id] = {
                 "host": host,
                 "coordinator_port": coordinator_port,
+                "attempt_id": attempt_id,
             }
             self._alive_nodes.add(node_id)
             self._lastcall_time = time.time()

@@ -134,6 +134,7 @@ class MasterServicer:
             coordinator_port=meta.get("coordinator_port", 0),
             slice_id=msg.slice_id or meta.get("slice_id", ""),
             host_id=meta.get("host_id", ""),
+            attempt_id=msg.attempt_id,
         )
         return m.RendezvousRound(round=round_)
 

@@ -106,14 +106,25 @@ def _launch_local_master(args) -> Tuple[subprocess.Popen, str]:
     raise TimeoutError("local master did not report its port in 60s")
 
 
-def _gc_shm_arenas(job_name: str, run_id: str = "") -> None:
-    """Unlink /dev/shm arenas of ``job_name`` (all runs, or one run id)."""
+def _gc_shm_arenas(
+    job_name: str, run_id: str = "", min_age_s: float = 3600.0
+) -> None:
+    """Unlink /dev/shm arenas of ``job_name``: one run id exactly (exit
+    cleanup), or — with no run id — only arenas idle for ``min_age_s``
+    (startup GC).  The age guard matters: several nodes of one job can
+    share a host, and a relaunching node must never wipe a live sibling's
+    staged checkpoint (live arenas are rewritten every few steps, so their
+    mtime is always fresh)."""
     import glob
+    import time as _time
 
     safe = job_name.replace("/", "_")
     scope = f"{safe}-{run_id}" if run_id else f"{safe}-*"
+    now = _time.time()
     for path in glob.glob(f"/dev/shm/dlrtpu_{scope}_*"):
         try:
+            if not run_id and now - os.stat(path).st_mtime < min_age_s:
+                continue
             os.unlink(path)
         except OSError:
             pass

@@ -1,0 +1,88 @@
+"""Loss-spike detection and forensics.
+
+Parity with reference ``atorch/atorch/utils/loss_spike_utils.py``
+(``TokenLossSpike``: detect spikes against a sliding window, persist the
+offending step/sample info for later replay).  JAX-friendly: feed it host
+floats (``float(loss)``) — never trace it into a jitted function.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from collections import deque
+from typing import Deque, List, Optional
+
+from dlrover_tpu.common.log import logger
+
+
+class LossSpikeDetector:
+    """Flags steps whose loss jumps above the recent trend.
+
+    A spike is ``loss > mean + zscore_threshold * std`` AND
+    ``loss > ratio_threshold * mean`` over the window (both conditions, so
+    flat-but-noisy early training doesn't false-positive).  NaN/Inf always
+    count as spikes."""
+
+    def __init__(
+        self,
+        window: int = 100,
+        zscore_threshold: float = 4.0,
+        ratio_threshold: float = 1.5,
+        min_samples: int = 20,
+        spike_log_dir: str = "",
+    ):
+        self._window: Deque[float] = deque(maxlen=window)
+        self._z = zscore_threshold
+        self._ratio = ratio_threshold
+        self._min = min_samples
+        self._dir = spike_log_dir
+        self.spikes: List[dict] = []
+
+    def update(
+        self,
+        step: int,
+        loss: float,
+        sample_info: Optional[dict] = None,
+    ) -> bool:
+        """Record one step's loss; returns True if it is a spike."""
+        is_bad = math.isnan(loss) or math.isinf(loss)
+        is_spike = is_bad
+        if not is_bad and len(self._window) >= self._min:
+            n = len(self._window)
+            mean = sum(self._window) / n
+            var = sum((x - mean) ** 2 for x in self._window) / n
+            std = math.sqrt(var)
+            if (
+                loss > mean + self._z * max(std, 1e-12)
+                and loss > self._ratio * mean
+            ):
+                is_spike = True
+        if is_spike:
+            rec = {
+                "step": step,
+                "loss": loss,
+                "time": time.time(),
+                "sample_info": sample_info or {},
+            }
+            self.spikes.append(rec)
+            logger.warning(
+                "loss spike at step %d: loss=%s", step, loss
+            )
+            self._persist(rec)
+        else:
+            self._window.append(loss)
+        return is_spike
+
+    def _persist(self, rec: dict) -> None:
+        if not self._dir:
+            return
+        try:
+            os.makedirs(self._dir, exist_ok=True)
+            path = os.path.join(self._dir, "loss_spikes.jsonl")
+            with open(path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:  # pragma: no cover
+            logger.exception("could not persist loss spike record")

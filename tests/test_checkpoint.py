@@ -88,6 +88,56 @@ class TestShardFile:
         np.testing.assert_array_equal(out["a|0"], tensors["a|0"])
         np.testing.assert_array_equal(out["b|0"], tensors["b|0"])
 
+    def test_uncommitted_step_restorable_when_covered(self, tmp_path, monkeypatch):
+        """A breakpoint save from a partial world (no commit) must still
+        restore when its shards cover the target (replicated layout)."""
+        monkeypatch.setenv("DLROVER_TPU_JOB_NAME", "ckpt-unc")
+        monkeypatch.setenv("DLROVER_TPU_RUN_ID", "unc1")
+        monkeypatch.setenv("DLROVER_TPU_PROCESS_ID", "0")
+        monkeypatch.setenv("DLROVER_TPU_NUM_PROCESSES", "1")
+        from dlrover_tpu.checkpoint.engine import CheckpointEngine
+
+        # Committed step 10 and an uncommitted (newer) step 20 whose one
+        # shard fully covers the replicated tensor.
+        for step, val, commit_it in ((10, 1.0, True), (20, 2.0, False)):
+            tensors = {"['w']|0": np.full(4, val, np.float32)}
+            extra = {
+                "step": step,
+                "meta": {"step": step},
+                "tensors_info": {
+                    "['w']|0": {
+                        "path": "['w']",
+                        "global_shape": [4],
+                        "index": [[0, 4]],
+                    }
+                },
+                "num_processes": 1,
+                "process_id": 0,
+            }
+            shard_file.write_shard(
+                PosixDiskStorage(), str(tmp_path), step, 0, tensors, extra
+            )
+            if commit_it:
+                shard_file.commit(PosixDiskStorage(), str(tmp_path), step)
+        eng = CheckpointEngine(str(tmp_path), job_name="ckpt-unc")
+        try:
+            got = eng.load(target={"w": np.zeros(4, np.float32)})
+            assert got is not None
+            state, meta = got
+            # Committed step wins (deterministic across ranks) ...
+            assert meta["step"] == 10
+            np.testing.assert_array_equal(state["w"], np.full(4, 1.0))
+            # ... but with no tracker at all, the newest covered step is
+            # used.
+            import os as _os
+
+            _os.unlink(shard_file.tracker_path(str(tmp_path)))
+            got2 = eng.load(target={"w": np.zeros(4, np.float32)})
+            assert got2[1]["step"] == 20
+            np.testing.assert_array_equal(got2[0]["w"], np.full(4, 2.0))
+        finally:
+            eng.close()
+
     def test_pack_unpack_zero_d(self):
         # Regression: np.ascontiguousarray promotes 0-d to (1,); a restored
         # scalar (e.g. optimizer step count) must stay 0-d or

@@ -38,6 +38,52 @@ def make_client(master, node_id):
 
 
 class TestRendezvous:
+    def test_rejoin_evicts_stale_world(self, master):
+        """A relaunched node re-joining must NOT receive the old round's
+        world (dead coordinator); peers must see a pending re-rendezvous."""
+        mgr = master.rdzv_managers[RendezvousName.TRAINING]
+        c0, c1 = make_client(master, 0), make_client(master, 1)
+        c0.join_rendezvous(node_rank=0, local_world_size=1)
+        c1.join_rendezvous(node_rank=1, local_world_size=1)
+        deadline = time.time() + 15
+        world = {}
+        while time.time() < deadline and not world:
+            _, _, world, coord0 = c0.get_comm_world()
+            time.sleep(0.2)
+        assert len(world) == 2
+        # An RPC-retried duplicate of node 1's ORIGINAL join (same
+        # attempt id) must be a no-op, not an eviction.
+        c1.join_rendezvous(
+            node_rank=1, local_world_size=1, attempt_id="same-attempt"
+        )
+        c1.join_rendezvous(
+            node_rank=1, local_world_size=1, attempt_id="same-attempt"
+        )
+        # The first of those evicted node 1 (new attempt vs the admitted
+        # one); let the round re-form before the agent-death scenario.
+        c0.join_rendezvous(node_rank=0, local_world_size=1)
+        world = {}
+        deadline = time.time() + 15
+        while time.time() < deadline and not world:
+            _, _, world, _ = c0.get_comm_world()
+            time.sleep(0.2)
+        assert len(world) == 2
+        assert mgr.num_nodes_waiting() == 0  # duplicate didn't evict
+        # Node 1's agent dies and a replacement re-joins.
+        c1b = MasterClient(master.addr, 1)
+        c1b.join_rendezvous(node_rank=1, local_world_size=1)
+        rnd, _, world1b, _ = c1b.get_comm_world()
+        assert world1b == {}  # stale round not handed out
+        assert mgr.num_nodes_waiting() > 0  # peers notice promptly
+        # Node 0 re-joins -> new round completes for both.
+        c0.join_rendezvous(node_rank=0, local_world_size=1)
+        world = {}
+        deadline = time.time() + 15
+        while time.time() < deadline and not world:
+            _, _, world, coord = c1b.get_comm_world()
+            time.sleep(0.2)
+        assert len(world) == 2
+
     def test_two_node_rendezvous(self, master):
         c0, c1 = make_client(master, 0), make_client(master, 1)
         c0.join_rendezvous(node_rank=0, local_world_size=2)
