@@ -499,3 +499,35 @@ class ReplicaData(Message):
     found: bool = False
     step: int = -1
     payload: bytes = b""
+
+
+# ---------------------------------------------------------------------------
+# Embedding store service (PS analogue; reference tfplus KvVariable serving)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class EmbeddingOp(Message):
+    """One embedding-store RPC: op in {lookup, apply, export, import,
+    filter, size}.  keys/grads/blob are packed numpy bytes."""
+
+    table: str = ""
+    op: str = "lookup"
+    keys: bytes = b""
+    grads: bytes = b""
+    blob: bytes = b""
+    train: bool = True
+    optimizer: dict = dataclasses.field(default_factory=dict)
+    rank_filter: int = 0
+    world: int = 1
+    min_freq: int = 0
+    max_version_age: int = 0
+
+
+@dataclasses.dataclass
+class EmbeddingResult(Message):
+    success: bool = True
+    reason: str = ""
+    rows: bytes = b""
+    blob: bytes = b""
+    count: int = 0

@@ -1,0 +1,38 @@
+"""Sparse embedding subsystem — the TPU build's TFPlus equivalent.
+
+Parity map (reference ``tfplus/tfplus/kv_variable/``):
+- C++ hash-table store with freq/version metadata, filtering and
+  export/import: ``native/kv_store.cc`` (reference ``kernels/kv_variable.h``,
+  ``hashmap.h``, ``embedding_value.h``)
+- sparse optimizer apply kernels (SGD/Adagrad/Adam/group-FTRL):
+  ``native/kv_store.cc`` (reference ``kernels/training_ops.cc``)
+- python variable/lookup API: :mod:`dlrover_tpu.embedding.store`,
+  :mod:`dlrover_tpu.embedding.layer` (reference ``python/ops/*``)
+- distributed PS-style serving + elastic resharding:
+  :mod:`dlrover_tpu.embedding.service` (reference PS + hybrid storage)
+
+TPU architecture: dense compute (the model body and the gathered embedding
+activations) runs under jit on the MXU; the sparse, unbounded-vocabulary
+lookup/update path stays host-side in C++ (TPU SparseCore's programming
+model mirrored on the host), with dedup + gather/scatter marshalling in
+numpy at the jit boundary.
+"""
+
+from dlrover_tpu.embedding.store import EmbeddingStore
+from dlrover_tpu.embedding.layer import EmbeddingLayer, embedding_lookup
+from dlrover_tpu.embedding.optim import (
+    SparseAdagrad,
+    SparseAdam,
+    SparseGroupFtrl,
+    SparseSGD,
+)
+
+__all__ = [
+    "EmbeddingStore",
+    "EmbeddingLayer",
+    "embedding_lookup",
+    "SparseAdagrad",
+    "SparseAdam",
+    "SparseGroupFtrl",
+    "SparseSGD",
+]

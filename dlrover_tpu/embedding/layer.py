@@ -1,0 +1,89 @@
+"""JAX-side embedding integration: host store <-> jitted dense compute.
+
+Reference: ``tfplus/python/ops/embedding_ops.py`` lookups inside the TF
+graph.  The TPU-native shape is different (and faster for the dense half):
+the unbounded sparse table lives host-side; per step we
+
+1. deduplicate the batch's feature ids (host, numpy),
+2. pull the unique rows from the store (C++ gather),
+3. hand the dense ``[U, dim]`` block to the jitted step as a regular input
+   and gather ``rows[inv]`` ON DEVICE (MXU-friendly, fused by XLA),
+4. take the step's gradient w.r.t. the row block (dense, exact — each
+   unique row's grad is the sum over its occurrences, which is precisely
+   the sparse-segment-sum the reference computes),
+5. push it into the store's sparse optimizer kernel (C++ scatter-apply).
+
+Steps 1/2/5 overlap with device compute when the caller double-buffers
+batches (see ``examples/deepfm_train.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.embedding.store import EmbeddingStore
+
+
+def embedding_lookup(
+    store: EmbeddingStore, keys: np.ndarray, train: bool = True
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Dedup + pull: returns (rows[U, dim], uniq[U], inv) with
+    ``rows[inv].reshape(*keys.shape, dim)`` the per-slot embeddings."""
+    keys = np.asarray(keys, np.int64)
+    uniq, inv = np.unique(keys.reshape(-1), return_inverse=True)
+    rows = store.lookup(uniq, train=train)
+    return rows, uniq, inv.astype(np.int32)
+
+
+class EmbeddingLayer:
+    """One embedding table + its sparse optimizer, step-oriented API.
+
+    Usage per step::
+
+        rows, pull = layer.pull(batch_keys)           # host
+        (loss, grads_rows) = jitted_step(rows, ...)   # device
+        layer.push(pull, np.asarray(grads_rows))      # host scatter-apply
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        optimizer=None,
+        *,
+        num_shards: int = 64,
+        init_scale: float = 0.05,
+        seed: int = 42,
+    ):
+        from dlrover_tpu.embedding.optim import SparseAdagrad
+
+        self.store = EmbeddingStore(
+            dim, num_shards=num_shards, init_scale=init_scale, seed=seed
+        )
+        self.optimizer = optimizer or SparseAdagrad()
+        self.dim = dim
+
+    def pull(
+        self, keys: np.ndarray, train: bool = True
+    ) -> Tuple[np.ndarray, dict]:
+        rows, uniq, inv = embedding_lookup(self.store, keys, train=train)
+        return rows, {"uniq": uniq, "inv": inv, "shape": np.shape(keys)}
+
+    def push(self, pull_ctx: dict, grad_rows: np.ndarray) -> None:
+        self.optimizer.apply(self.store, pull_ctx["uniq"], grad_rows)
+
+    def gather_fn(self):
+        """Returns a jit-safe ``(rows, inv, shape) -> [*, dim]`` gather for
+        use inside the step function."""
+        import jax.numpy as jnp
+
+        def gather(rows, inv, batch_shape):
+            return jnp.take(rows, inv, axis=0).reshape(
+                *batch_shape, self.dim
+            )
+
+        return gather
+
+    def __len__(self) -> int:
+        return len(self.store)

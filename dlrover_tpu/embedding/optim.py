@@ -1,0 +1,60 @@
+"""Sparse optimizer configs dispatching to the native apply kernels.
+
+Reference: ``tfplus/python/training/{adam,adagrad,group_adam,
+sparse_group_ftrl}.py`` wrapping the C++ ``training_ops.cc`` kernels — here
+thin config dataclasses with an ``apply(store, keys, grads)`` method so the
+trainer treats them uniformly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dlrover_tpu.embedding.store import EmbeddingStore
+
+
+@dataclasses.dataclass
+class SparseSGD:
+    lr: float = 0.01
+
+    def apply(self, store: EmbeddingStore, keys, grads) -> None:
+        store.apply_sgd(keys, grads, self.lr)
+
+
+@dataclasses.dataclass
+class SparseAdagrad:
+    lr: float = 0.05
+    eps: float = 1e-8
+
+    def apply(self, store: EmbeddingStore, keys, grads) -> None:
+        store.apply_adagrad(keys, grads, self.lr, self.eps)
+
+
+@dataclasses.dataclass
+class SparseAdam:
+    lr: float = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+
+    def apply(self, store: EmbeddingStore, keys, grads) -> None:
+        store.apply_adam(
+            keys, grads, self.lr, self.beta1, self.beta2, self.eps
+        )
+
+
+@dataclasses.dataclass
+class SparseGroupFtrl:
+    """Group-lasso FTRL (reference ``sparse_group_ftrl.py``): drives whole
+    rarely-useful rows to exact zero; combine with
+    ``EmbeddingStore.filter`` to reclaim their memory."""
+
+    alpha: float = 0.05
+    beta: float = 1.0
+    lambda1: float = 0.001
+    lambda2: float = 0.001
+
+    def apply(self, store: EmbeddingStore, keys, grads) -> None:
+        store.apply_group_ftrl(
+            keys, grads, self.alpha, self.beta, self.lambda1, self.lambda2
+        )

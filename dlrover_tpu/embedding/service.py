@@ -1,0 +1,274 @@
+"""Distributed embedding serving: PS-style store servers + client router.
+
+Reference: the TF parameter-server path (``dlrover/trainer/tensorflow`` PS
+elasticity + tfplus hybrid storage tables).  TPU-native shape: N
+``EmbeddingServer`` processes (NodeType.EMBEDDING) each own a key
+partition; trainers route by key hash, pulling/pushing over the control
+RPC.  Elastic resize = :func:`rebalance` moving misplaced rows via the
+store's export/import (reference import/export ops for scaling).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as futures
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RpcClient, RpcServer, local_ip
+from dlrover_tpu.embedding.store import EmbeddingStore
+
+_KV_PREFIX = "embedding/addr/"
+
+
+def _owner(keys: np.ndarray, world: int) -> np.ndarray:
+    """Key -> owning server (same mix as the C++ shard hash so export's
+    ``rank_filter``/``world`` partition matches the router)."""
+    h = (keys.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)) >> np.uint64(
+        33
+    )
+    return (h % np.uint64(world)).astype(np.int64)
+
+
+class EmbeddingServicer:
+    """RPC handler owning this server's tables."""
+
+    def __init__(self, dim_by_table: Optional[Dict[str, int]] = None):
+        self._lock = threading.Lock()
+        self._tables: Dict[str, EmbeddingStore] = {}
+        self._dims = dict(dim_by_table or {})
+
+    def table(self, name: str, dim: int = 0) -> EmbeddingStore:
+        with self._lock:
+            st = self._tables.get(name)
+            if st is None:
+                d = dim or self._dims.get(name)
+                if not d:
+                    raise KeyError(f"unknown embedding table {name!r}")
+                st = EmbeddingStore(d)
+                self._tables[name] = st
+            return st
+
+    def __call__(self, msg: m.Message) -> Optional[m.Message]:
+        if not isinstance(msg, m.EmbeddingOp):
+            return m.BaseResponse(success=False, reason="bad message")
+        try:
+            return self._dispatch(msg)
+        except Exception as e:  # noqa: BLE001
+            return m.EmbeddingResult(
+                success=False, reason=f"{type(e).__name__}: {e}"
+            )
+
+    def _dispatch(self, msg: m.EmbeddingOp) -> m.Message:
+        if msg.op == "lookup":
+            keys = np.frombuffer(msg.keys, np.int64)
+            dim = int(msg.optimizer.get("dim", 0))
+            st = self.table(msg.table, dim)
+            rows = st.lookup(keys, train=msg.train)
+            return m.EmbeddingResult(rows=rows.tobytes(), count=len(keys))
+        if msg.op == "apply":
+            keys = np.frombuffer(msg.keys, np.int64)
+            st = self.table(msg.table)
+            grads = np.frombuffer(msg.grads, np.float32).reshape(
+                len(keys), st.dim
+            )
+            opt = dict(msg.optimizer)
+            kind = opt.pop("kind", "adagrad")
+            opt.pop("dim", None)
+            getattr(st, f"apply_{kind}")(keys, grads, **opt)
+            return m.EmbeddingResult(count=len(keys))
+        if msg.op == "export":
+            st = self.table(msg.table)
+            blob = st.export(msg.rank_filter, msg.world)
+            return m.EmbeddingResult(
+                blob=blob, count=len(blob) // st.row_bytes
+            )
+        if msg.op == "import":
+            dim = int(msg.optimizer.get("dim", 0))
+            st = self.table(msg.table, dim)
+            n = st.import_rows(msg.blob)
+            return m.EmbeddingResult(count=n)
+        if msg.op == "filter":
+            st = self.table(msg.table)
+            n = st.filter(msg.min_freq, msg.max_version_age)
+            return m.EmbeddingResult(count=n)
+        if msg.op == "size":
+            st = self.table(msg.table)
+            return m.EmbeddingResult(count=len(st))
+        return m.EmbeddingResult(success=False, reason=f"bad op {msg.op}")
+
+
+class EmbeddingServer:
+    """One store-server process (reference: a PS replica)."""
+
+    def __init__(
+        self,
+        server_rank: int,
+        master_client=None,
+        dim_by_table: Optional[Dict[str, int]] = None,
+        port: int = 0,
+    ):
+        self.server_rank = server_rank
+        self.servicer = EmbeddingServicer(dim_by_table)
+        self._server = RpcServer(port, self.servicer)
+        self._server.start()
+        self.addr = f"{local_ip()}:{self._server.port}"
+        self.client = master_client
+        if master_client is not None:
+            master_client.kv_store_set(
+                f"{_KV_PREFIX}{server_rank}", self.addr.encode()
+            )
+        logger.info(
+            "embedding server %d serving at %s", server_rank, self.addr
+        )
+
+    def stop(self) -> None:
+        self._server.stop()
+
+
+class DistributedEmbedding:
+    """Trainer-side router over N embedding servers.
+
+    ``addrs`` explicit, or discovered from the master KV
+    (``embedding/addr/{rank}`` for rank < world)."""
+
+    def __init__(
+        self,
+        table: str,
+        dim: int,
+        addrs: Optional[Sequence[str]] = None,
+        master_client=None,
+        world: int = 0,
+        optimizer: Optional[dict] = None,
+    ):
+        self.table = table
+        self.dim = dim
+        self.optimizer = optimizer or {"kind": "adagrad", "lr": 0.05}
+        if addrs is None:
+            if master_client is None or world <= 0:
+                raise ValueError("need addrs, or master_client + world")
+            addrs = []
+            for r in range(world):
+                raw = master_client.kv_store_wait_get(
+                    f"{_KV_PREFIX}{r}", timeout=60.0
+                )
+                addrs.append(raw.decode())
+        self._clients: List[RpcClient] = [
+            RpcClient(a, timeout=60.0) for a in addrs
+        ]
+        self._pool = futures.ThreadPoolExecutor(
+            max_workers=max(2, len(self._clients))
+        )
+
+    @property
+    def world(self) -> int:
+        return len(self._clients)
+
+    # -- data path ---------------------------------------------------------
+    def lookup(self, keys: np.ndarray, train: bool = True) -> np.ndarray:
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        owners = _owner(keys, self.world)
+        out = np.empty((len(keys), self.dim), np.float32)
+        futs = {}
+        for r in range(self.world):
+            idx = np.nonzero(owners == r)[0]
+            if len(idx) == 0:
+                continue
+            futs[r] = (
+                idx,
+                self._pool.submit(
+                    self._clients[r].call,
+                    m.EmbeddingOp(
+                        table=self.table, op="lookup",
+                        keys=keys[idx].tobytes(), train=train,
+                        optimizer={"dim": self.dim},
+                    ),
+                ),
+            )
+        for r, (idx, fut) in futs.items():
+            resp = fut.result()
+            if not resp.success:
+                raise RuntimeError(f"lookup on server {r}: {resp.reason}")
+            out[idx] = np.frombuffer(resp.rows, np.float32).reshape(
+                len(idx), self.dim
+            )
+        return out
+
+    def apply_gradients(self, keys: np.ndarray, grads: np.ndarray) -> None:
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(keys), self.dim)
+        owners = _owner(keys, self.world)
+        futs = []
+        for r in range(self.world):
+            idx = np.nonzero(owners == r)[0]
+            if len(idx) == 0:
+                continue
+            futs.append(
+                self._pool.submit(
+                    self._clients[r].call,
+                    m.EmbeddingOp(
+                        table=self.table, op="apply",
+                        keys=keys[idx].tobytes(),
+                        grads=grads[idx].tobytes(),
+                        optimizer={**self.optimizer, "dim": self.dim},
+                    ),
+                )
+            )
+        for fut in futs:
+            resp = fut.result()
+            if not resp.success:
+                raise RuntimeError(f"apply failed: {resp.reason}")
+
+    def size(self) -> int:
+        total = 0
+        for c in self._clients:
+            resp = c.call(m.EmbeddingOp(table=self.table, op="size"))
+            total += resp.count
+        return total
+
+    # -- elastic resize ----------------------------------------------------
+    def rebalance(self, new_addrs: Sequence[str]) -> int:
+        """Move every row to its owner under the new server set
+        (reference PS scale-up + hot-PS migration).  Returns moved rows."""
+        old_clients = self._clients
+        new_clients = [RpcClient(a, timeout=120.0) for a in new_addrs]
+        moved = 0
+        for c in old_clients:
+            resp = c.call(
+                m.EmbeddingOp(table=self.table, op="export", world=1)
+            )
+            if not resp.success or not resp.blob:
+                continue
+            rb = 24 + 12 * self.dim
+            arr = np.frombuffer(resp.blob, np.uint8).reshape(-1, rb)
+            keys = arr[:, :8].copy().view(np.int64).reshape(-1)
+            owners = _owner(keys, len(new_clients))
+            for r in range(len(new_clients)):
+                idx = np.nonzero(owners == r)[0]
+                if len(idx) == 0:
+                    continue
+                blob = arr[idx].tobytes()
+                new_clients[r].call(
+                    m.EmbeddingOp(
+                        table=self.table, op="import", blob=blob,
+                        optimizer={"dim": self.dim},
+                    )
+                )
+                moved += len(idx)
+        self._clients = new_clients
+        for c in old_clients:
+            if c not in new_clients:
+                c.close()
+        logger.info(
+            "embedding rebalance: %d rows over %d servers",
+            moved, len(new_clients),
+        )
+        return moved
+
+    def close(self) -> None:
+        for c in self._clients:
+            c.close()
+        self._pool.shutdown(wait=False)

@@ -1,0 +1,270 @@
+"""ctypes bindings for the native embedding KV store.
+
+Reference surface: ``tfplus`` ``get_kv_variable`` + ``KvVariable`` ops
+(``python/ops/kv_variable_ops.py``) — here one :class:`EmbeddingStore`
+object per table.  A pure-Python fallback keeps tests/hosts without g++
+working (same semantics, slower).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.native import load_library
+
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_I64P = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_F32P = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_U8P = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        lib = load_library("libkv_store.so")
+        if lib is None:
+            return None
+        c = ctypes.c_int
+        i64 = ctypes.c_int64
+        u64 = ctypes.c_uint64
+        f32 = ctypes.c_float
+        lib.kv_create.restype = c
+        lib.kv_create.argtypes = [c, c, f32, u64]
+        lib.kv_destroy.argtypes = [c]
+        lib.kv_size.restype = i64
+        lib.kv_size.argtypes = [c]
+        lib.kv_lookup.restype = c
+        lib.kv_lookup.argtypes = [c, _I64P, i64, _F32P, c]
+        lib.kv_apply_sgd.restype = c
+        lib.kv_apply_sgd.argtypes = [c, _I64P, i64, _F32P, f32]
+        lib.kv_apply_adagrad.restype = c
+        lib.kv_apply_adagrad.argtypes = [c, _I64P, i64, _F32P, f32, f32]
+        lib.kv_apply_adam.restype = c
+        lib.kv_apply_adam.argtypes = [
+            c, _I64P, i64, _F32P, f32, f32, f32, f32, i64,
+        ]
+        lib.kv_apply_group_ftrl.restype = c
+        lib.kv_apply_group_ftrl.argtypes = [
+            c, _I64P, i64, _F32P, f32, f32, f32, f32,
+        ]
+        lib.kv_metadata.restype = c
+        lib.kv_metadata.argtypes = [c, _I64P, i64, _I64P, _I64P]
+        lib.kv_filter.restype = i64
+        lib.kv_filter.argtypes = [c, i64, i64]
+        lib.kv_row_bytes.restype = i64
+        lib.kv_row_bytes.argtypes = [c]
+        lib.kv_export.restype = i64
+        lib.kv_export.argtypes = [c, _U8P, i64, c, c]
+        lib.kv_import.restype = i64
+        lib.kv_import.argtypes = [c, _U8P, i64]
+        _LIB = lib
+        return lib
+
+
+class _PyStore:
+    """Pure-Python fallback mirroring kv_store.cc semantics."""
+
+    def __init__(self, dim: int, init_scale: float, seed: int):
+        self.dim = dim
+        self.init_scale = init_scale
+        self.seed = seed
+        self.rows: dict = {}
+        self.version = 0
+
+    def _init_row(self, key: int) -> np.ndarray:
+        if self.init_scale > 0:
+            gen = np.random.default_rng(self.seed ^ (key & 0x7FFFFFFFFFFFFFFF))
+            return gen.uniform(
+                -self.init_scale, self.init_scale, self.dim
+            ).astype(np.float32)
+        return np.zeros(self.dim, np.float32)
+
+    def lookup(self, keys, train):
+        out = np.zeros((len(keys), self.dim), np.float32)
+        for i, k in enumerate(keys):
+            k = int(k)
+            row = self.rows.get(k)
+            if row is None:
+                if not train:
+                    continue
+                row = {
+                    "emb": self._init_row(k), "s0": None, "s1": None,
+                    "freq": 0, "version": 0,
+                }
+                self.rows[k] = row
+            if train:
+                row["freq"] += 1
+                row["version"] = self.version
+            out[i] = row["emb"]
+        return out
+
+
+class EmbeddingStore:
+    """One elastic embedding table (reference ``get_kv_variable``)."""
+
+    def __init__(
+        self,
+        dim: int,
+        *,
+        num_shards: int = 64,
+        init_scale: float = 0.05,
+        seed: int = 42,
+    ):
+        self.dim = dim
+        self._lib = _lib()
+        self._py: Optional[_PyStore] = None
+        self._step = 0
+        if self._lib is not None:
+            self._handle = self._lib.kv_create(
+                dim, num_shards, init_scale, seed
+            )
+            if self._handle < 0:
+                raise RuntimeError("kv_create failed")
+        else:  # pragma: no cover - toolchain-less fallback
+            logger.warning("native kv store unavailable; python fallback")
+            self._py = _PyStore(dim, init_scale, seed)
+
+    # -- core --------------------------------------------------------------
+    def lookup(self, keys: np.ndarray, train: bool = True) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        out = np.empty((len(keys), self.dim), np.float32)
+        if self._py is not None:
+            return self._py.lookup(keys, train)
+        rc = self._lib.kv_lookup(
+            self._handle, keys, len(keys), out, 1 if train else 0
+        )
+        if rc != 0:
+            raise RuntimeError("kv_lookup failed")
+        return out
+
+    def __len__(self) -> int:
+        if self._py is not None:
+            return len(self._py.rows)
+        return int(self._lib.kv_size(self._handle))
+
+    # -- optimizer applies -------------------------------------------------
+    def _check(self, keys, grads):
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            len(keys), self.dim
+        )
+        return keys, grads
+
+    def apply_sgd(self, keys, grads, lr: float) -> None:
+        keys, grads = self._check(keys, grads)
+        if self._py is not None:
+            self._py_apply(keys, grads, lambda row, g: row.__setitem__(
+                slice(None), row - lr * g))
+            return
+        self._lib.kv_apply_sgd(self._handle, keys, len(keys), grads, lr)
+
+    def apply_adagrad(self, keys, grads, lr: float, eps: float = 1e-8):
+        keys, grads = self._check(keys, grads)
+        if self._py is not None:  # pragma: no cover
+            raise NotImplementedError("adagrad needs the native store")
+        self._lib.kv_apply_adagrad(
+            self._handle, keys, len(keys), grads, lr, eps
+        )
+
+    def apply_adam(
+        self, keys, grads, lr: float,
+        beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8,
+    ):
+        keys, grads = self._check(keys, grads)
+        self._step += 1
+        if self._py is not None:  # pragma: no cover
+            raise NotImplementedError("adam needs the native store")
+        self._lib.kv_apply_adam(
+            self._handle, keys, len(keys), grads, lr, beta1, beta2, eps,
+            self._step,
+        )
+
+    def apply_group_ftrl(
+        self, keys, grads,
+        alpha: float = 0.05, beta: float = 1.0,
+        lambda1: float = 0.001, lambda2: float = 0.001,
+    ):
+        keys, grads = self._check(keys, grads)
+        if self._py is not None:  # pragma: no cover
+            raise NotImplementedError("ftrl needs the native store")
+        self._lib.kv_apply_group_ftrl(
+            self._handle, keys, len(keys), grads, alpha, beta, lambda1,
+            lambda2,
+        )
+
+    def _py_apply(self, keys, grads, fn):  # sgd-only fallback
+        for k, g in zip(keys, grads):
+            row = self._py.rows.get(int(k))
+            if row is not None:
+                fn(row["emb"], g)
+
+    # -- metadata / filtering ----------------------------------------------
+    def metadata(self, keys) -> Tuple[np.ndarray, np.ndarray]:
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        freq = np.empty(len(keys), np.int64)
+        ver = np.empty(len(keys), np.int64)
+        if self._py is not None:
+            for i, k in enumerate(keys):
+                row = self._py.rows.get(int(k))
+                freq[i] = row["freq"] if row else -1
+                ver[i] = row["version"] if row else -1
+            return freq, ver
+        self._lib.kv_metadata(self._handle, keys, len(keys), freq, ver)
+        return freq, ver
+
+    def filter(self, min_freq: int = 0, max_version_age: int = 0) -> int:
+        """Evict under-threshold rows (reference under-threshold
+        filtering); returns evicted count."""
+        if self._py is not None:
+            before = len(self._py.rows)
+            self._py.rows = {
+                k: r for k, r in self._py.rows.items()
+                if not (min_freq > 0 and r["freq"] < min_freq)
+            }
+            return before - len(self._py.rows)
+        return int(
+            self._lib.kv_filter(self._handle, min_freq, max_version_age)
+        )
+
+    # -- export / import (checkpoint + resharding) -------------------------
+    @property
+    def row_bytes(self) -> int:
+        if self._py is not None:
+            return 24 + 12 * self.dim
+        return int(self._lib.kv_row_bytes(self._handle))
+
+    def export(self, rank_filter: int = 0, world: int = 1) -> bytes:
+        if self._py is not None:
+            raise NotImplementedError("export needs the native store")
+        n = len(self)
+        buf = np.empty(max(1, n) * self.row_bytes, np.uint8)
+        written = self._lib.kv_export(
+            self._handle, buf, n, rank_filter, world
+        )
+        return buf[: written * self.row_bytes].tobytes()
+
+    def import_rows(self, blob: bytes) -> int:
+        if self._py is not None:
+            raise NotImplementedError("import needs the native store")
+        arr = np.frombuffer(blob, np.uint8).copy()
+        rows = len(arr) // self.row_bytes
+        return int(self._lib.kv_import(self._handle, arr, rows))
+
+    def close(self) -> None:
+        if self._py is None and getattr(self, "_handle", -1) >= 0:
+            self._lib.kv_destroy(self._handle)
+            self._handle = -1
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

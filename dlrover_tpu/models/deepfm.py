@@ -1,0 +1,111 @@
+"""DeepFM for sparse recommendation — the framework's PS/sparse-path model
+(reference examples: ``examples/tensorflow/criteo_deeprec`` DeepFM built on
+tfplus KvVariable embeddings; system test ``dlrover-system-test-criteo``).
+
+Architecture (Guo et al., 2017): shared sparse embeddings feed
+- a first-order linear term (1-d embedding per feature),
+- an FM second-order term: 0.5 * ((sum_f e_f)^2 - sum_f e_f^2),
+- a deep MLP over the concatenated field embeddings,
+summed into one logit.  The dense half is pure jit (MXU); the unbounded
+sparse tables live in :mod:`dlrover_tpu.embedding` host/servers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepFMConfig:
+    num_fields: int = 10
+    embed_dim: int = 16
+    mlp_hidden: Tuple[int, ...] = (64, 32)
+
+    @classmethod
+    def tiny(cls) -> "DeepFMConfig":
+        return cls(num_fields=4, embed_dim=8, mlp_hidden=(16,))
+
+
+def init_dense_params(rng, cfg: DeepFMConfig) -> Dict:
+    """Dense (MLP + bias) parameters; embeddings live in the KV store."""
+    sizes = [cfg.num_fields * cfg.embed_dim, *cfg.mlp_hidden, 1]
+    params = {"bias": jnp.zeros(())}
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        params[f"w{i}"] = jax.random.normal(
+            keys[i], (fan_in, fan_out)
+        ) * jnp.sqrt(2.0 / fan_in)
+        params[f"b{i}"] = jnp.zeros((fan_out,))
+    return params
+
+
+def forward(
+    params: Dict,
+    emb: jnp.ndarray,     # [B, F, D] field embeddings (from the KV store)
+    emb1: jnp.ndarray,    # [B, F, 1] first-order weights
+    cfg: DeepFMConfig,
+) -> jnp.ndarray:
+    """Returns logits [B]."""
+    b = emb.shape[0]
+    first_order = jnp.sum(emb1.reshape(b, -1), axis=1)
+    # FM second order over fields.
+    sum_emb = jnp.sum(emb, axis=1)                 # [B, D]
+    sum_sq = sum_emb * sum_emb
+    sq_sum = jnp.sum(emb * emb, axis=1)            # [B, D]
+    fm = 0.5 * jnp.sum(sum_sq - sq_sum, axis=1)    # [B]
+    # Deep part.
+    h = emb.reshape(b, -1)
+    n = len(cfg.mlp_hidden) + 1
+    for i in range(n):
+        h = h @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n - 1:
+            h = jax.nn.relu(h)
+    deep = h[:, 0]
+    return first_order + fm + deep + params["bias"]
+
+
+def loss_fn(
+    params: Dict,
+    emb: jnp.ndarray,
+    emb1: jnp.ndarray,
+    labels: jnp.ndarray,  # [B] in {0, 1}
+    cfg: DeepFMConfig,
+) -> jnp.ndarray:
+    logits = forward(params, emb, emb1, cfg)
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * labels
+        + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+
+
+def make_train_step(cfg: DeepFMConfig, tx):
+    """Builds the jitted step: grads flow to dense params AND to the pulled
+    embedding row blocks (whose grads go back to the sparse optimizer)."""
+
+    def step(params, opt_state, rows, inv, rows1, inv1, labels):
+        b = labels.shape[0]
+
+        def loss_of(p, r, r1):
+            emb = jnp.take(r, inv, axis=0).reshape(
+                b, cfg.num_fields, cfg.embed_dim
+            )
+            emb1 = jnp.take(r1, inv1, axis=0).reshape(b, cfg.num_fields, 1)
+            return loss_fn(p, emb, emb1, labels, cfg)
+
+        loss, grads = jax.value_and_grad(loss_of, argnums=(0, 1, 2))(
+            params, rows, rows1
+        )
+        import optax
+
+        p_grads, rows_grad, rows1_grad = grads
+        updates, opt_state = tx.update(p_grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, rows_grad, rows1_grad
+
+    return jax.jit(step)

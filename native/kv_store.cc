@@ -1,0 +1,418 @@
+// Host-side hash-table embedding store — the TPU build's equivalent of
+// TFPlus KvVariable (reference tfplus/tfplus/kv_variable/kernels/
+// kv_variable.h:1021 concurrent hashmap + embedding_value.h frequency/
+// version metadata + training_ops.cc sparse optimizer apply kernels).
+//
+// Design: striped-lock open-addressing-free sharded unordered_maps keyed by
+// int64 feature ids; each row stores the embedding vector, optimizer slot
+// vectors (allocated lazily per optimizer family), and metadata (frequency,
+// last-update version) used for under-threshold filtering and elastic
+// export/import (reference kv_variable_ops.cc import/export ops).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).  All
+// batch entry points parallelize across a small thread pool when the batch
+// is large; per-shard mutexes make concurrent callers safe.
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Row {
+  std::vector<float> emb;
+  std::vector<float> slot0;  // adagrad accum / adam m / ftrl z
+  std::vector<float> slot1;  // adam v / ftrl n
+  int64_t freq = 0;
+  int64_t version = 0;
+};
+
+struct Shard {
+  std::mutex mu;
+  std::unordered_map<int64_t, Row> rows;
+};
+
+struct Store {
+  int dim;
+  int num_shards;
+  float init_scale;      // uniform(-s, s) init for new rows
+  uint64_t seed;
+  std::vector<Shard> shards;
+  std::atomic<int64_t> version{0};
+
+  Store(int d, int ns, float scale, uint64_t sd)
+      : dim(d), num_shards(ns), init_scale(scale), seed(sd), shards(ns) {}
+
+  Shard& shard_for(int64_t key) {
+    uint64_t h = (uint64_t)key * 0x9E3779B97F4A7C15ull;
+    return shards[(h >> 33) % num_shards];
+  }
+
+  void init_row(Row& row, int64_t key) {
+    row.emb.resize(dim);
+    if (init_scale > 0.f) {
+      // Deterministic per-key init: elastic relaunches and different
+      // store servers agree on a row's initial value.
+      std::mt19937_64 gen(seed ^ (uint64_t)key);
+      std::uniform_real_distribution<float> dist(-init_scale, init_scale);
+      for (int i = 0; i < dim; ++i) row.emb[i] = dist(gen);
+    } else {
+      std::fill(row.emb.begin(), row.emb.end(), 0.f);
+    }
+  }
+};
+
+const int kMaxStores = 1024;
+std::mutex g_stores_mu;
+std::vector<Store*> g_stores(kMaxStores, nullptr);
+
+Store* get(int handle) {
+  if (handle < 0 || handle >= kMaxStores) return nullptr;
+  return g_stores[handle];
+}
+
+// Run fn(begin, end) over [0, n) on up to `threads` workers.
+template <typename F>
+void parallel_for(int64_t n, const F& fn, int threads = 8) {
+  if (n < (1 << 12) || threads <= 1) {
+    fn(0, n);
+    return;
+  }
+  int nw = std::min<int64_t>(threads, (n + 4095) / 4096);
+  std::vector<std::thread> pool;
+  int64_t chunk = (n + nw - 1) / nw;
+  for (int w = 0; w < nw; ++w) {
+    int64_t b = w * chunk, e = std::min(n, b + chunk);
+    if (b >= e) break;
+    pool.emplace_back([&fn, b, e] { fn(b, e); });
+  }
+  for (auto& t : pool) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a store; returns handle >= 0 or -1.
+int kv_create(int dim, int num_shards, float init_scale, uint64_t seed) {
+  if (dim <= 0 || num_shards <= 0) return -1;
+  std::lock_guard<std::mutex> g(g_stores_mu);
+  for (int h = 0; h < kMaxStores; ++h) {
+    if (g_stores[h] == nullptr) {
+      g_stores[h] = new Store(dim, num_shards, init_scale, seed);
+      return h;
+    }
+  }
+  return -1;
+}
+
+void kv_destroy(int handle) {
+  std::lock_guard<std::mutex> g(g_stores_mu);
+  if (handle >= 0 && handle < kMaxStores) {
+    delete g_stores[handle];
+    g_stores[handle] = nullptr;
+  }
+}
+
+int64_t kv_size(int handle) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  int64_t n = 0;
+  for (auto& sh : s->shards) {
+    std::lock_guard<std::mutex> g(sh.mu);
+    n += (int64_t)sh.rows.size();
+  }
+  return n;
+}
+
+// Gather rows for `keys` into out[n, dim].  train != 0: missing keys are
+// initialized+inserted and frequency/version updated (reference KvVariable
+// lookup-or-create); train == 0: missing keys read as zeros, no mutation.
+int kv_lookup(int handle, const int64_t* keys, int64_t n, float* out,
+              int train) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  int64_t ver = s->version.load(std::memory_order_relaxed);
+  int dim = s->dim;
+  parallel_for(n, [&](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      int64_t key = keys[i];
+      Shard& sh = s->shard_for(key);
+      std::lock_guard<std::mutex> g(sh.mu);
+      auto it = sh.rows.find(key);
+      if (it == sh.rows.end()) {
+        if (!train) {
+          std::memset(out + i * dim, 0, sizeof(float) * dim);
+          continue;
+        }
+        Row row;
+        s->init_row(row, key);
+        it = sh.rows.emplace(key, std::move(row)).first;
+      }
+      Row& row = it->second;
+      if (train) {
+        row.freq++;
+        row.version = ver;
+      }
+      std::memcpy(out + i * dim, row.emb.data(), sizeof(float) * dim);
+    }
+  });
+  return 0;
+}
+
+// --- sparse optimizer apply kernels (reference training_ops.cc) -----------
+
+// SGD: emb -= lr * grad
+int kv_apply_sgd(int handle, const int64_t* keys, int64_t n,
+                 const float* grads, float lr) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  int dim = s->dim;
+  int64_t ver = ++s->version;
+  parallel_for(n, [&](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      Shard& sh = s->shard_for(keys[i]);
+      std::lock_guard<std::mutex> g(sh.mu);
+      auto it = sh.rows.find(keys[i]);
+      if (it == sh.rows.end()) continue;
+      Row& row = it->second;
+      const float* gr = grads + i * dim;
+      for (int d = 0; d < dim; ++d) row.emb[d] -= lr * gr[d];
+      row.version = ver;
+    }
+  });
+  return 0;
+}
+
+// Adagrad: accum += g^2; emb -= lr * g / (sqrt(accum) + eps)
+int kv_apply_adagrad(int handle, const int64_t* keys, int64_t n,
+                     const float* grads, float lr, float eps) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  int dim = s->dim;
+  int64_t ver = ++s->version;
+  parallel_for(n, [&](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      Shard& sh = s->shard_for(keys[i]);
+      std::lock_guard<std::mutex> g(sh.mu);
+      auto it = sh.rows.find(keys[i]);
+      if (it == sh.rows.end()) continue;
+      Row& row = it->second;
+      if (row.slot0.empty()) row.slot0.assign(dim, 0.f);
+      const float* gr = grads + i * dim;
+      for (int d = 0; d < dim; ++d) {
+        row.slot0[d] += gr[d] * gr[d];
+        row.emb[d] -= lr * gr[d] / (std::sqrt(row.slot0[d]) + eps);
+      }
+      row.version = ver;
+    }
+  });
+  return 0;
+}
+
+// Adam (per-row step count approximated by row.freq of updates):
+// m = b1*m + (1-b1)*g; v = b2*v + (1-b2)*g^2; emb -= lr_t * m/(sqrt(v)+eps)
+int kv_apply_adam(int handle, const int64_t* keys, int64_t n,
+                  const float* grads, float lr, float beta1, float beta2,
+                  float eps, int64_t step) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  int dim = s->dim;
+  int64_t ver = ++s->version;
+  float bc1 = 1.f - std::pow(beta1, (float)step);
+  float bc2 = 1.f - std::pow(beta2, (float)step);
+  float lr_t = lr * std::sqrt(bc2) / bc1;
+  parallel_for(n, [&](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      Shard& sh = s->shard_for(keys[i]);
+      std::lock_guard<std::mutex> g(sh.mu);
+      auto it = sh.rows.find(keys[i]);
+      if (it == sh.rows.end()) continue;
+      Row& row = it->second;
+      if (row.slot0.empty()) row.slot0.assign(dim, 0.f);
+      if (row.slot1.empty()) row.slot1.assign(dim, 0.f);
+      const float* gr = grads + i * dim;
+      for (int d = 0; d < dim; ++d) {
+        row.slot0[d] = beta1 * row.slot0[d] + (1.f - beta1) * gr[d];
+        row.slot1[d] = beta2 * row.slot1[d] + (1.f - beta2) * gr[d] * gr[d];
+        row.emb[d] -= lr_t * row.slot0[d] / (std::sqrt(row.slot1[d]) + eps);
+      }
+      row.version = ver;
+    }
+  });
+  return 0;
+}
+
+// Group-lasso FTRL (reference sparse_group_ftrl): accumulator-based FTRL
+// with an L2,1 (whole-row) penalty that zeroes rarely-useful rows.
+// z += g - (sqrt(n+g^2)-sqrt(n))/alpha * emb;  n += g^2
+// row ||z|| <= lambda1*sqrt(dim) -> emb = 0 else closed-form update.
+int kv_apply_group_ftrl(int handle, const int64_t* keys, int64_t n,
+                        const float* grads, float alpha, float beta,
+                        float lambda1, float lambda2) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  int dim = s->dim;
+  int64_t ver = ++s->version;
+  parallel_for(n, [&](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      Shard& sh = s->shard_for(keys[i]);
+      std::lock_guard<std::mutex> g(sh.mu);
+      auto it = sh.rows.find(keys[i]);
+      if (it == sh.rows.end()) continue;
+      Row& row = it->second;
+      if (row.slot0.empty()) row.slot0.assign(dim, 0.f);  // z
+      if (row.slot1.empty()) row.slot1.assign(dim, 0.f);  // n
+      const float* gr = grads + i * dim;
+      for (int d = 0; d < dim; ++d) {
+        float g2 = gr[d] * gr[d];
+        float sigma =
+            (std::sqrt(row.slot1[d] + g2) - std::sqrt(row.slot1[d])) / alpha;
+        row.slot0[d] += gr[d] - sigma * row.emb[d];
+        row.slot1[d] += g2;
+      }
+      // Group (row) shrinkage: L2 norm of z against lambda1*sqrt(dim).
+      float znorm = 0.f;
+      for (int d = 0; d < dim; ++d) znorm += row.slot0[d] * row.slot0[d];
+      znorm = std::sqrt(znorm);
+      float thresh = lambda1 * std::sqrt((float)dim);
+      if (znorm <= thresh) {
+        std::fill(row.emb.begin(), row.emb.end(), 0.f);
+      } else {
+        float scale = (znorm - thresh) / znorm;
+        for (int d = 0; d < dim; ++d) {
+          float eta = (beta + std::sqrt(row.slot1[d])) / alpha + lambda2;
+          row.emb[d] = -scale * row.slot0[d] / eta;
+        }
+      }
+      row.version = ver;
+    }
+  });
+  return 0;
+}
+
+// --- metadata / filtering (reference embedding_value.h + filters) ---------
+
+// Copy per-key (freq, version) into out_freq/out_version (missing -> -1).
+int kv_metadata(int handle, const int64_t* keys, int64_t n,
+                int64_t* out_freq, int64_t* out_version) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& sh = s->shard_for(keys[i]);
+    std::lock_guard<std::mutex> g(sh.mu);
+    auto it = sh.rows.find(keys[i]);
+    if (it == sh.rows.end()) {
+      out_freq[i] = -1;
+      out_version[i] = -1;
+    } else {
+      out_freq[i] = it->second.freq;
+      out_version[i] = it->second.version;
+    }
+  }
+  return 0;
+}
+
+// Evict rows with freq < min_freq or version older than
+// (current - max_version_age); returns number evicted.
+int64_t kv_filter(int handle, int64_t min_freq, int64_t max_version_age) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  int64_t cur = s->version.load();
+  int64_t evicted = 0;
+  for (auto& sh : s->shards) {
+    std::lock_guard<std::mutex> g(sh.mu);
+    for (auto it = sh.rows.begin(); it != sh.rows.end();) {
+      bool low_freq = min_freq > 0 && it->second.freq < min_freq;
+      bool stale = max_version_age > 0 &&
+                   cur - it->second.version > max_version_age;
+      if (low_freq || stale) {
+        it = sh.rows.erase(it);
+        ++evicted;
+      } else {
+        ++it;
+      }
+    }
+  }
+  return evicted;
+}
+
+// --- export / import (checkpoint + elastic resharding) --------------------
+// Export layout per row: key(i64), freq(i64), version(i64),
+// emb[dim], slot0[dim], slot1[dim]  (slots zero-filled if unallocated).
+
+int64_t kv_export_count(int handle) { return kv_size(handle); }
+
+int64_t kv_row_bytes(int handle) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  return 3 * (int64_t)sizeof(int64_t) + 3ll * s->dim * sizeof(float);
+}
+
+// Export up to max_rows rows whose shard index % world == rank_filter
+// (world==1 exports all).  Returns rows written.
+int64_t kv_export(int handle, uint8_t* buf, int64_t max_rows,
+                  int rank_filter, int world) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  int dim = s->dim;
+  int64_t rb = kv_row_bytes(handle);
+  int64_t written = 0;
+  for (int si = 0; si < s->num_shards; ++si) {
+    if (world > 1 && si % world != rank_filter) continue;
+    Shard& sh = s->shards[si];
+    std::lock_guard<std::mutex> g(sh.mu);
+    for (auto& kv : sh.rows) {
+      if (written >= max_rows) return written;
+      uint8_t* p = buf + written * rb;
+      int64_t meta[3] = {kv.first, kv.second.freq, kv.second.version};
+      std::memcpy(p, meta, sizeof(meta));
+      p += sizeof(meta);
+      std::memcpy(p, kv.second.emb.data(), sizeof(float) * dim);
+      p += sizeof(float) * dim;
+      if (!kv.second.slot0.empty())
+        std::memcpy(p, kv.second.slot0.data(), sizeof(float) * dim);
+      else
+        std::memset(p, 0, sizeof(float) * dim);
+      p += sizeof(float) * dim;
+      if (!kv.second.slot1.empty())
+        std::memcpy(p, kv.second.slot1.data(), sizeof(float) * dim);
+      else
+        std::memset(p, 0, sizeof(float) * dim);
+      ++written;
+    }
+  }
+  return written;
+}
+
+int64_t kv_import(int handle, const uint8_t* buf, int64_t rows) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  int dim = s->dim;
+  int64_t rb = kv_row_bytes(handle);
+  for (int64_t i = 0; i < rows; ++i) {
+    const uint8_t* p = buf + i * rb;
+    int64_t meta[3];
+    std::memcpy(meta, p, sizeof(meta));
+    p += sizeof(meta);
+    Shard& sh = s->shard_for(meta[0]);
+    std::lock_guard<std::mutex> g(sh.mu);
+    Row& row = sh.rows[meta[0]];
+    row.freq = meta[1];
+    row.version = meta[2];
+    row.emb.assign((const float*)p, (const float*)p + dim);
+    p += sizeof(float) * dim;
+    row.slot0.assign((const float*)p, (const float*)p + dim);
+    p += sizeof(float) * dim;
+    row.slot1.assign((const float*)p, (const float*)p + dim);
+  }
+  return rows;
+}
+
+}  // extern "C"
