@@ -8,6 +8,10 @@ available TPU (single chip under the driver).  ``vs_baseline`` compares
 against the reference's published Llama2-7B HFU of 62.5% on A100s
 (BASELINE.md, `atorch/examples/llama2/README.md:398-407`) — an imperfect but
 honest cross-hardware anchor until multi-chip goodput runs exist.
+
+The step is built by the framework's own ``accelerate()`` (strategy -> mesh +
+shardings + remat + donation + compiled SPMD step), so this number measures
+the product path, not a hand-rolled ``jax.jit`` (round-1 review Weak #2).
 """
 
 from __future__ import annotations
@@ -66,11 +70,15 @@ def model_flops_per_step(cfg, batch, seq) -> float:
 
 
 def main() -> int:
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
     import optax
 
     from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.accelerate import Strategy, accelerate
+    from dlrover_tpu.parallel.mesh import MeshSpec
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
@@ -80,37 +88,40 @@ def main() -> int:
         cfg = llama.LlamaConfig.tiny()
         batch, seq, iters = 4, 64, 3
 
-    params = llama.init_params(jax.random.PRNGKey(0), cfg)
     tx = optax.adamw(3e-4)
-    opt_state = tx.init(params)
 
-    def loss_fn(p, tokens):
-        return llama.loss_fn(p, {"tokens": tokens}, cfg)
+    rng = np.random.RandomState(0)
+    sample_tokens = rng.randint(
+        0, cfg.vocab_size, size=(batch, seq + 1)
+    ).astype(np.int32)
 
-    @jax.jit
-    def step(p, o, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(p, tokens)
-        updates, o = tx.update(grads, o, p)
-        import optax as _optax
-
-        p = _optax.apply_updates(p, updates)
-        return p, o, loss
-
-    import numpy as _np
-
-    rng = _np.random.RandomState(0)
-    tokens = jnp.asarray(
-        rng.randint(0, cfg.vocab_size, size=(batch, seq + 1)), jnp.int32
+    # Single candidate (single-chip dp mesh, no remat — the 300M state fits
+    # HBM comfortably; donation recycles the state buffers): accelerate()
+    # builds the sharded, donated, compiled step.
+    job = accelerate(
+        loss_fn=lambda p, b: llama.loss_fn(p, b, cfg),
+        init_fn=lambda r: llama.init_params(r, cfg),
+        optimizer=tx,
+        sample_batch={"tokens": sample_tokens},
+        strategy=Strategy(mesh=MeshSpec(dp=jax.local_device_count()),
+                          remat="none"),
     )
+    print(
+        f"bench: strategy {job.strategy.describe()}",
+        file=sys.stderr,
+    )
+
+    state = job.create_state(jax.random.PRNGKey(0))
+    batch_pt = {"tokens": jnp.asarray(sample_tokens)}
     # Warmup/compile; the float() host transfer forces full completion even
     # on tunneled/async backends where block_until_ready is a no-op.
-    params, opt_state, loss = step(params, opt_state, tokens)
-    _ = float(loss)
+    state, metrics = job.train_step(state, batch_pt)
+    _ = float(metrics["loss"])
     t0 = time.perf_counter()
     for _ in range(iters):
-        params, opt_state, loss = step(params, opt_state, tokens)
-    _ = float(loss)
-    jax.block_until_ready(params)
+        state, metrics = job.train_step(state, batch_pt)
+    loss = float(metrics["loss"])
+    jax.block_until_ready(state)
     dt = (time.perf_counter() - t0) / iters
 
     flops = model_flops_per_step(cfg, batch, seq)
@@ -118,6 +129,7 @@ def main() -> int:
     peak = detect_peak() * n_dev
     mfu_pct = 100.0 * flops / dt / peak
     tokens_per_sec = batch * seq / dt
+    n_params = llama.num_params(state["params"])
 
     print(
         json.dumps(
@@ -126,12 +138,13 @@ def main() -> int:
                 "value": round(mfu_pct, 2),
                 "unit": "%",
                 "vs_baseline": round(mfu_pct / REFERENCE_HFU_PCT, 4),
-                "model": f"llama_{llama.num_params(params)/1e6:.0f}M",
+                "model": f"llama_{n_params/1e6:.0f}M",
                 "backend": jax.default_backend(),
                 "devices": n_dev,
+                "strategy": job.strategy.describe(),
                 "step_time_s": round(dt, 4),
                 "tokens_per_sec": round(tokens_per_sec, 1),
-                "final_loss": round(float(loss), 4),
+                "final_loss": round(loss, 4),
             }
         )
     )

@@ -20,8 +20,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Defaults tuned on v5e at [8,16,2048,64]: large blocks amortize MXU
+# pipeline fill (128x128 blocks ran at ~5% of peak; 512x512 at ~17%).
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+DEFAULT_BWD_BLOCK_Q = 256
+DEFAULT_BWD_BLOCK_K = 512
 NEG_INF = -1e30
 
 
@@ -114,10 +118,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m, l, acc))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse = m + jnp.log(l_safe)
-    lse_ref[0] = jnp.broadcast_to(
-        lse[:, None], lse_ref.shape[1:]
-    ).astype(jnp.float32)
+    # lse block is [1, 1, block_q]: block_q rides the 128-lane dim directly,
+    # no 128x broadcast materialization (round-1 review Weak #3).
+    lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _block_sizes(S: int, block_q: int, block_k: int):
+    """Clamp blocks to powers of two <= pow2-ceil(S) and pad S to a multiple
+    of the larger block.  Power-of-two blocks keep the padding bounded (the
+    naive lcm of a block and a clamped-to-S block can blow the sequence up
+    by the block size itself, e.g. lcm(256, 301) = 77056)."""
+    p2_ceil = 1 << max(0, (S - 1).bit_length())
+    block_q = min(1 << (block_q.bit_length() - 1), p2_ceil)
+    block_k = min(1 << (block_k.bit_length() - 1), p2_ceil)
+    unit = max(block_q, block_k)
+    S_pad = ((S + unit - 1) // unit) * unit
+    return block_q, block_k, S_pad
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
@@ -125,14 +141,10 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 
     B, H, S, D = q.shape
     sm_scale = 1.0 / np.sqrt(D)
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
     # Pad the sequence to block multiples: pl.ds clamps out-of-bounds
     # starts (dynamic_slice semantics), which would silently shift the
     # ragged last K block.  Padded keys are masked by seq_len below.
-    S_pad = int(np.lcm(block_q, block_k)) * int(
-        np.ceil(S / np.lcm(block_q, block_k))
-    )
+    block_q, block_k, S_pad = _block_sizes(S, block_q, block_k)
     if S_pad != S:
         pad = [(0, 0), (0, 0), (0, S_pad - S), (0, 0)]
         q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
@@ -156,23 +168,229 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S_pad, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, S_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, 1, S_pad), jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3)
     return (
         out.reshape(B, H, S_pad, D)[:, :, :S],
-        lse[..., 0].reshape(B, H, S_pad)[:, :, :S],
+        lse.reshape(B, H, S_pad)[:, :, :S],
     )
 
 
 # ---------------------------------------------------------------------------
-# Backward (reference math, jnp) — used for the custom VJP; a fully blocked
-# Pallas backward follows the same recompute pattern and slots in here.
+# Pallas backward kernels (FlashAttention-2 style, recompute-based).
+#
+# Two kernels, neither materializing the [S, S] score matrix:
+#   dq kernel : grid (B*H, q_blocks); inner loop over K blocks recomputes
+#               p = exp(q k^T * scale - lse), ds = p (dp - delta) scale,
+#               accumulates dq += ds @ k.
+#   dkv kernel: grid (B*H, k_blocks); inner loop over Q blocks (starting at
+#               the first causally-unmasked Q block) accumulates
+#               dv += p^T g and dk += ds^T q.
+# delta = rowsum(o * do) is precomputed outside (cheap fused elementwise).
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, *,
+                   block_k, causal, sm_scale, seq_len, padded_len):
+    from jax.experimental import pallas as pl
+
+    # q_ref/g_ref/dq_ref: [1, block_q, D]; k_ref/v_ref: [1, S_pad, D];
+    # lse_ref/delta_ref: [1, 1, block_q].
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+
+    q = q_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+
+    num_k_blocks = pl.cdiv(padded_len, block_k)
+    if causal:
+        last_q = q_start + block_q - 1
+        num_k_blocks = jnp.minimum(num_k_blocks, (last_q // block_k) + 1)
+
+    def body(ki, acc):
+        k_start = ki * block_k
+        kb = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # [block_q, block_k]
+        kpos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(kpos < seq_len, s, NEG_INF)
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # masked entries -> exp(-inf) = 0
+        dp = jax.lax.dot_general(
+            g, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return acc + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    acc = jax.lax.fori_loop(
+        0, num_k_blocks, body, jnp.zeros((block_q, d), jnp.float32)
+    )
+    dq_ref[0] = acc.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q, causal, sm_scale, seq_len,
+                    padded_len):
+    from jax.experimental import pallas as pl
+
+    # k_ref/v_ref/dk_ref/dv_ref: [1, block_k, D]; q_ref/g_ref: [1, S_pad, D];
+    # lse_ref/delta_ref: [1, 1, S_pad].
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2]
+    ki = pl.program_id(1)
+    k_start = ki * block_k
+
+    kb = k_ref[0].astype(jnp.float32)
+    vb = v_ref[0].astype(jnp.float32)
+
+    num_q_blocks = pl.cdiv(padded_len, block_q)
+    # Q blocks whose last row precedes k_start are fully causally masked.
+    start_qi = (k_start // block_q) if causal else 0
+
+    def body(qi, carry):
+        dk_acc, dv_acc = carry
+        q_start = qi * block_q
+        qb = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        gb = g_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        lse_b = lse_ref[0, 0, pl.ds(q_start, block_q)]
+        delta_b = delta_ref[0, 0, pl.ds(q_start, block_q)]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # [block_q, block_k]
+        qpos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        kpos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(qpos < seq_len, s, NEG_INF)
+        s = jnp.where(kpos < seq_len, s, NEG_INF)
+        if causal:
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse_b[:, None])
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, gb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # p^T @ g -> [block_k, D]
+        dp = jax.lax.dot_general(
+            gb, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_b[:, None]) * sm_scale
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # ds^T @ q -> [block_k, D]
+        return dk_acc, dv_acc
+
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(
+        start_qi, num_q_blocks, body, (zeros, zeros)
+    )
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
+                      interpret):
+    from jax.experimental import pallas as pl
+
+    B, H, S, D = q.shape
+    sm_scale = 1.0 / np.sqrt(D)
+    block_q, block_k, S_pad = _block_sizes(S, block_q, block_k)
+    delta = jnp.sum(
+        out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1
+    )  # [B, H, S]
+    if S_pad != S:
+        pad4 = [(0, 0), (0, 0), (0, S_pad - S), (0, 0)]
+        pad3 = [(0, 0), (0, 0), (0, S_pad - S)]
+        q, k, v, g = (jnp.pad(t, pad4) for t in (q, k, v, g))
+        lse = jnp.pad(lse, pad3)
+        delta = jnp.pad(delta, pad3)
+
+    q3, k3, v3, g3 = (t.reshape(B * H, S_pad, D) for t in (q, k, v, g))
+    lse2 = lse.reshape(B * H, 1, S_pad).astype(jnp.float32)
+    delta2 = delta.reshape(B * H, 1, S_pad)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, block_k=block_k, causal=causal,
+            sm_scale=sm_scale, seq_len=S, padded_len=S_pad,
+        ),
+        grid=(B * H, pl.cdiv(S_pad, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S_pad, D), q.dtype),
+        interpret=interpret,
+    )(q3, k3, v3, g3, lse2, delta2)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, causal=causal,
+            sm_scale=sm_scale, seq_len=S, padded_len=S_pad,
+        ),
+        grid=(B * H, pl.cdiv(S_pad, block_k)),
+        in_specs=[
+            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, S_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, S_pad), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S_pad, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, S_pad, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, g3, lse2, delta2)
+
+    return (
+        dq.reshape(B, H, S_pad, D)[:, :, :S],
+        dk.reshape(B, H, S_pad, D)[:, :, :S],
+        dv.reshape(B, H, S_pad, D)[:, :, :S],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Backward (reference math, jnp) — ground truth for the Pallas backward in
+# tests.  (The CPU path, backend="reference", differentiates
+# reference_attention with plain autodiff and never reaches this.)
 # ---------------------------------------------------------------------------
 
 
@@ -204,21 +422,26 @@ def _flash_bwd_reference(q, k, v, out, lse, g, causal):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
 )
-def _flash_attention(q, k, v, causal, block_q, block_k, interpret):
+def _flash_attention(q, k, v, causal, block_q, block_k, bwd_block_q,
+                     bwd_block_k, interpret):
     out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
     return out
 
 
-def _fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+def _fwd_rule(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k,
+              interpret):
     out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
 
 
-def _bwd_rule(causal, block_q, block_k, interpret, res, g):
+def _bwd_rule(causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret,
+              res, g):
     q, k, v, out, lse = res
-    dq, dk, dv = _flash_bwd_reference(q, k, v, out, lse, g, causal)
+    dq, dk, dv = _flash_bwd_pallas(
+        q, k, v, out, lse, g, causal, bwd_block_q, bwd_block_k, interpret
+    )
     return dq, dk, dv
 
 
@@ -233,6 +456,8 @@ def flash_attention(
     causal: bool = True,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    bwd_block_q: int = DEFAULT_BWD_BLOCK_Q,
+    bwd_block_k: int = DEFAULT_BWD_BLOCK_K,
     backend: Optional[str] = None,  # None=auto | 'pallas' | 'reference'
     interpret: bool = False,
 ) -> jax.Array:
@@ -245,4 +470,5 @@ def flash_attention(
         backend = "pallas" if jax.default_backend() == "tpu" else "reference"
     if backend == "reference":
         return reference_attention(q, k, v, causal)
-    return _flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_attention(q, k, v, causal, block_q, block_k, bwd_block_q,
+                            bwd_block_k, interpret)

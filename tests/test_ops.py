@@ -73,6 +73,46 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("S,bq,bk", [(64, 16, 16), (48, 32, 16),
+                                         (40, 16, 32)])
+    def test_pallas_bwd_matches_reference_bwd(self, causal, S, bq, bk):
+        from dlrover_tpu.ops.flash_attention import (
+            _flash_bwd_pallas,
+            _flash_bwd_reference,
+            _flash_fwd,
+        )
+
+        q, k, v = _qkv(B=2, H=2, S=S, D=16, seed=3)
+        g = jax.random.normal(jax.random.PRNGKey(9), q.shape, q.dtype)
+        out, lse = _flash_fwd(q, k, v, causal, bq, bk, True)
+        want = _flash_bwd_reference(q, k, v, out, lse, g, causal)
+        got = _flash_bwd_pallas(q, k, v, out, lse, g, causal, bq, bk, True)
+        for a, b, name in zip(got, want, "dq dk dv".split()):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-4, err_msg=name
+            )
+
+    def test_bwd_no_full_score_matrix(self):
+        # The custom-VJP backward must be the blocked Pallas path: peak
+        # live memory in its jaxpr should never include a [B,H,S,S] array.
+        q, k, v = _qkv(B=1, H=1, S=64, D=16)
+
+        def f(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=True, backend="pallas",
+                                block_q=16, block_k=16, interpret=True)
+            )
+
+        jaxpr = jax.make_jaxpr(jax.grad(f, argnums=(0, 1, 2)))(q, k, v)
+        for eqn in jaxpr.jaxpr.eqns:
+            for var in eqn.outvars:
+                shape = getattr(var.aval, "shape", ())
+                assert not (len(shape) >= 2 and shape[-1] == 64
+                            and shape[-2] == 64), (
+                    f"full score matrix materialized: {eqn.primitive}"
+                )
+
 
 class TestRMSNorm:
     def test_pallas_matches_reference(self):
