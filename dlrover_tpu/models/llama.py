@@ -256,6 +256,26 @@ def _moe_swiglu(x, moe, cfg: LlamaConfig):
     return out.reshape(B, S, C), aux
 
 
+def block_apply(
+    layer: Dict,
+    x: jax.Array,
+    cfg: LlamaConfig,
+    positions: jax.Array,
+    *,
+    attn_impl: str = "auto",
+    mesh=None,
+) -> tuple:
+    """One transformer block: (x, layer) -> (x, moe_aux scalar).  The unit
+    the pipeline stage partitioner groups (``models.llama_pp``)."""
+    h = rmsnorm(x, layer["ln1"], eps=cfg.rms_eps)
+    x = x + _attention(h, layer, cfg, positions, attn_impl, mesh)
+    h = rmsnorm(x, layer["ln2"], eps=cfg.rms_eps)
+    if "moe" in layer:
+        delta, aux = _moe_swiglu(h, layer["moe"], cfg)
+        return x + delta, aux
+    return x + _swiglu(h, layer["mlp"], cfg.dtype), jnp.zeros((), jnp.float32)
+
+
 def forward(
     params: Dict,
     tokens: jax.Array,
@@ -271,18 +291,20 @@ def forward(
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     moe_aux = jnp.zeros((), jnp.float32)
     for layer in params["layers"]:
-        h = rmsnorm(x, layer["ln1"], eps=cfg.rms_eps)
-        x = x + _attention(h, layer, cfg, positions, attn_impl, mesh)
-        h = rmsnorm(x, layer["ln2"], eps=cfg.rms_eps)
-        if "moe" in layer:
-            delta, aux = _moe_swiglu(h, layer["moe"], cfg)
-            moe_aux = moe_aux + aux
-            x = x + delta
-        else:
-            x = x + _swiglu(h, layer["mlp"], dt)
+        x, aux = block_apply(
+            layer, x, cfg, positions, attn_impl=attn_impl, mesh=mesh
+        )
+        moe_aux = moe_aux + aux
     x = rmsnorm(x, params["ln_f"], eps=cfg.rms_eps)
     logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
     return logits, {"moe_aux": moe_aux}
+
+
+def split_batch(batch: Dict[str, jax.Array]) -> tuple:
+    """{"tokens": [B,S+1]} or {"tokens","targets"} -> (tokens, targets)."""
+    if "targets" in batch:
+        return batch["tokens"], batch["targets"]
+    return batch["tokens"][:, :-1], batch["tokens"][:, 1:]
 
 
 def loss_fn(
@@ -294,10 +316,7 @@ def loss_fn(
     mesh=None,
     moe_aux_weight: float = 1e-2,
 ) -> jax.Array:
-    if "targets" in batch:
-        tokens, targets = batch["tokens"], batch["targets"]
-    else:
-        tokens, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    tokens, targets = split_batch(batch)
     logits, aux = forward(
         params, tokens, cfg, attn_impl=attn_impl, mesh=mesh
     )

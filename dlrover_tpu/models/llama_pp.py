@@ -1,0 +1,148 @@
+"""Pipeline-parallel Llama: stage partitioner + pipelined loss/grads.
+
+The analogue of the reference's pipeline model partitioner + PipelineStage
+(``atorch/pipeline_parallel/pipe_module.py``, ``PipelineStage.py``): Llama
+blocks are grouped into ``n_stages`` equal stages with a stacked leading
+stage axis sharded on the mesh's 'pp' axis; the embedding runs as the
+stage-0 entry (``pre_fn``) and final-norm + lm-head + loss as the last-stage
+exit (``post_fn``).  Schedules: differentiable GPipe
+(:func:`pipeline_loss_fn`) or true 1F1B with recompute backward
+(:func:`pipeline_train_grads` -> ``parallel.pipeline.pipeline_value_and_grad``).
+
+Stage homogeneity: each stage must contain the same *pattern* of blocks
+(e.g. with ``moe_every=2`` use layers-per-stage divisible by 2) so stage
+trees stack.  The MoE aux loss is not propagated through the pipeline
+(weight it 0 for parity checks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.models.llama import LlamaConfig
+from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
+from dlrover_tpu.ops.rmsnorm import rmsnorm
+from dlrover_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_value_and_grad,
+    stack_stage_params,
+)
+
+
+def split_stage_params(
+    params: Dict, n_stages: int
+) -> Tuple[Any, Dict, Dict]:
+    """Llama params -> (stacked_blocks [n_stages, ...], pre, post).
+
+    Layers are split contiguously: stage s gets layers
+    [s*L/S, (s+1)*L/S).  L must divide evenly and each stage must have the
+    same block pattern (dense/moe) for the trees to stack.
+    """
+    layers = params["layers"]
+    L = len(layers)
+    if L % n_stages != 0:
+        raise ValueError(f"n_layer={L} not divisible by n_stages={n_stages}")
+    per = L // n_stages
+    stages = [layers[s * per:(s + 1) * per] for s in range(n_stages)]
+    stacked = stack_stage_params(stages)
+    pre = {"embed": params["embed"]}
+    post = {"ln_f": params["ln_f"], "lm_head": params["lm_head"]}
+    return stacked, pre, post
+
+
+def merge_stage_grads(
+    d_blocks: Any, d_pre: Dict, d_post: Dict, n_stages: int
+) -> Dict:
+    """Inverse of :func:`split_stage_params` for gradient trees."""
+    layers = []
+    per = len(d_blocks)  # list of per-position block trees, stage-stacked
+    for s in range(n_stages):
+        for i in range(per):
+            layers.append(
+                jax.tree_util.tree_map(lambda g: g[s], d_blocks[i])
+            )
+    return {
+        "embed": d_pre["embed"],
+        "layers": layers,
+        "ln_f": d_post["ln_f"],
+        "lm_head": d_post["lm_head"],
+    }
+
+
+def _stage_fn(cfg: LlamaConfig):
+    def fn(stage_blocks, x):
+        B = x.shape[0]
+        pos = jnp.broadcast_to(jnp.arange(x.shape[1]), (B, x.shape[1]))
+        for layer in stage_blocks:  # list of block trees (leading axis gone)
+            x, _aux = llama.block_apply(layer, x, cfg, pos)
+        return x
+
+    return fn
+
+
+def _pre_fn(cfg: LlamaConfig):
+    def fn(pre, tokens):
+        return pre["embed"].astype(cfg.dtype)[tokens]
+
+    return fn
+
+
+def _post_fn(cfg: LlamaConfig):
+    def fn(post, x, targets):
+        x = rmsnorm(x, post["ln_f"], eps=cfg.rms_eps)
+        logits = (x @ post["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+        return jnp.mean(softmax_cross_entropy(logits, targets))
+
+    return fn
+
+
+def pipeline_loss_fn(
+    params: Dict,
+    batch: Dict[str, jax.Array],
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    *,
+    n_microbatches: int,
+    pp_axis: str = "pp",
+) -> jax.Array:
+    """Differentiable GPipe loss: split -> pipeline_apply -> head loss.
+    Use under ``jax.value_and_grad`` like ``llama.loss_fn``."""
+    tokens, targets = llama.split_batch(batch)
+    n_stages = mesh.shape[pp_axis]
+    stacked, pre, post = split_stage_params(params, n_stages)
+    x = _pre_fn(cfg)(pre, tokens)
+    out = pipeline_apply(
+        _stage_fn(cfg), stacked, x, mesh,
+        n_microbatches=n_microbatches, pp_axis=pp_axis,
+    )
+    return _post_fn(cfg)(post, out, targets)
+
+
+def pipeline_train_grads(
+    params: Dict,
+    batch: Dict[str, jax.Array],
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    *,
+    n_microbatches: int,
+    pp_axis: str = "pp",
+) -> Tuple[jax.Array, Dict]:
+    """1F1B loss + grads in ``params``' tree structure (the drop-in
+    replacement for ``jax.value_and_grad(llama.loss_fn)`` when pipelining)."""
+    tokens, targets = llama.split_batch(batch)
+    n_stages = mesh.shape[pp_axis]
+    stacked, pre, post = split_stage_params(params, n_stages)
+    loss, (d_blocks, d_pre, d_post) = pipeline_value_and_grad(
+        _stage_fn(cfg),
+        _pre_fn(cfg),
+        _post_fn(cfg),
+        stacked, pre, post, tokens, targets, mesh,
+        n_microbatches=n_microbatches, pp_axis=pp_axis,
+    )
+    grads = merge_stage_grads(d_blocks, d_pre, d_post, n_stages)
+    return loss, grads

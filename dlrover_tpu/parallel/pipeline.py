@@ -3,23 +3,30 @@
 Parity with ATorch's PP stack (reference
 ``pipeline_parallel/scheduler.py:15`` GPipe/1F1B schedulers,
 ``distributed_pippy_compiler.py``, P2P ``communication/pipe_communicator.py``)
-— TPU-first as a **collective-matmul-style pipelined shard_map**: layer
-parameters are stacked with a leading ``[n_stages, ...]`` axis sharded on
-'pp'; microbatches stream through stages with ``ppermute`` neighbour hops
-(P2P on ICI/DCN), overlapping stage compute with transfer.  The schedule is
-GPipe (fill-drain) expressed as one ``lax.scan`` — XLA sees a static loop
-and can software-pipeline it; backward falls out of autodiff through the
-scan (no hand-written 1F1B needed for correctness; the scan's rematerialized
-backward reproduces 1F1B's memory profile when combined with
-``jax.checkpoint``).
+— TPU-first, two schedules:
 
-Use :func:`pipeline_apply` inside a jitted loss; params must be given with
-``stack_stage_params``.
+- **GPipe** (:func:`pipeline_apply`): fill-drain expressed as one
+  ``lax.scan`` with ``ppermute`` neighbour hops; differentiable (backward
+  falls out of autodiff through the scan).
+- **1F1B** (:func:`pipeline_value_and_grad`): the Megatron-style
+  one-forward-one-backward schedule, built as an explicit static schedule
+  table (:func:`build_1f1b_schedule`) executed tick-by-tick; the backward of
+  each stage recomputes from the saved stage *input* (``jax.vjp``), so live
+  activation memory is O(n_stages) microbatch inputs per stage instead of
+  GPipe's O(n_microbatches).
+
+Both run inside a **partial-manual** ``shard_map`` (``axis_names={'pp'}``):
+only the pipeline axis is manual; parameters may additionally be sharded on
+'tp'/'fsdp'/'dp', which GSPMD handles automatically inside each stage — this
+is how pp composes with the other parallel axes in one mesh.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+import functools
+from typing import Any, Callable, NamedTuple, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -27,7 +34,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def stack_stage_params(per_stage_params: list) -> Any:
-    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage axis."""
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage axis.
+
+    Stage trees must share a structure (e.g. each stage = the same pattern of
+    transformer blocks); heterogeneity must live *inside* a stage.
+    """
     return jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs, axis=0), *per_stage_params
     )
@@ -42,6 +53,33 @@ def stage_param_specs(stage_specs: Any) -> Any:
     )
 
 
+def _pcast_pp(tree, pp_axis):
+    """Mark a carry tree as varying over pp so scan carries typecheck."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.pcast(x, (pp_axis,), to="varying"), tree
+    )
+
+
+def _safe_ppermute(tree, axis, perm):
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.ppermute(x, axis, perm), tree
+    )
+
+
+def _carry_dtype(dt):
+    """Pipeline scan-carry dtype: 16-bit carries inside a partial-manual
+    shard_map scan crash the XLA CPU compiler ("Invalid binary instruction
+    opcode copy"); widen to f32 on CPU, keep native on TPU."""
+    if jax.default_backend() == "cpu" and dt in (jnp.bfloat16, jnp.float16):
+        return jnp.dtype(jnp.float32)
+    return jnp.dtype(dt)
+
+
+# ---------------------------------------------------------------------------
+# GPipe (differentiable fill-drain scan)
+# ---------------------------------------------------------------------------
+
+
 def pipeline_apply(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     stacked_params: Any,
@@ -51,13 +89,14 @@ def pipeline_apply(
     n_microbatches: int,
     pp_axis: str = "pp",
 ) -> jax.Array:
-    """Run ``x`` through ``n_stages`` pipeline stages.
+    """Run ``x`` through ``n_stages`` pipeline stages (GPipe fill-drain).
 
     ``stage_fn(stage_params, micro_activations) -> micro_activations`` is the
     per-stage computation (e.g. a group of transformer blocks).  The input
     batch is split into ``n_microbatches``; activations circulate so stage
-    ``s`` processes microbatch ``m`` at tick ``s + m`` (GPipe fill-drain,
-    total ticks = n_stages + n_micro - 1).
+    ``s`` processes microbatch ``m`` at tick ``s + m`` (total ticks =
+    n_stages + n_micro - 1).  Differentiable; compose with ``jax.checkpoint``
+    on ``stage_fn`` for the 1F1B-like memory profile.
     """
     n_stages = mesh.shape[pp_axis]
     if n_stages == 1:
@@ -68,56 +107,348 @@ def pipeline_apply(
     micro_bs = x.shape[0] // n_microbatches
 
     def body(params_local, x_local):
-        # params_local: this stage's params ([1, ...] leading) ; x_local:
-        # the full batch (replicated across pp for simplicity of entry).
         params_me = jax.tree_util.tree_map(lambda p: p[0], params_local)
         stage_idx = jax.lax.axis_index(pp_axis)
-        micros = x_local.reshape((n_microbatches, micro_bs) + x_local.shape[1:])
+        micros = x_local.reshape(
+            (n_microbatches, micro_bs) + x_local.shape[1:]
+        )
 
         n_ticks = n_stages + n_microbatches - 1
-        buf = jnp.zeros((micro_bs,) + x_local.shape[1:], x_local.dtype)
-        outputs = jnp.zeros_like(micros)
+        cdt = _carry_dtype(x_local.dtype)
+        buf = jnp.zeros((micro_bs,) + x_local.shape[1:], cdt)
+        outputs = jnp.zeros(micros.shape, cdt)
 
         def tick(carry, t):
             buf, outputs = carry
             # Stage 0 injects microbatch t (when in range).
             inject = jnp.where(t < n_microbatches, t, 0)
             buf = jnp.where(stage_idx == 0,
-                            micros[inject].astype(buf.dtype), buf)
-            out = stage_fn(params_me, buf)
+                            micros[inject].astype(cdt), buf)
+            out = stage_fn(params_me, buf.astype(x_local.dtype))
             # Last stage emits microbatch (t - n_stages + 1).
             emit = t - (n_stages - 1)
             emit_clip = jnp.clip(emit, 0, n_microbatches - 1)
             outputs = jnp.where(
                 (stage_idx == n_stages - 1) & (emit >= 0),
-                outputs.at[emit_clip].set(out.astype(outputs.dtype)),
+                outputs.at[emit_clip].set(out.astype(cdt)),
                 outputs,
             )
             # Shift activations to the next stage.
             perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
-            buf = jax.lax.ppermute(out, pp_axis, perm)
+            buf = _safe_ppermute(out.astype(cdt), pp_axis, perm)
             return (buf, outputs), None
 
         (buf, outputs), _ = jax.lax.scan(
-            tick, (buf, outputs), jnp.arange(n_ticks)
+            tick, _pcast_pp((buf, outputs), pp_axis), jnp.arange(n_ticks)
         )
-        # Everyone returns the last stage's outputs (broadcast over the ring
-        # so the loss can be computed replicated downstream).
-        outputs = jax.lax.ppermute(
+        # Rotate so stage 0 holds the last stage's outputs, then psum-select
+        # to make the result provably replicated over pp.
+        outputs = _safe_ppermute(
             outputs, pp_axis,
             [(s, (s + 1) % n_stages) for s in range(n_stages)],
         )
-        # After one hop, stage 0 holds last stage's outputs; psum-select it.
         sel = (stage_idx == 0).astype(outputs.dtype)
         outputs = jax.lax.psum(outputs * sel, pp_axis)
-        return outputs.reshape(x_local.shape)
+        return outputs.reshape(x_local.shape).astype(x_local.dtype)
 
     param_specs = jax.tree_util.tree_map(
         lambda _: P(pp_axis), stacked_params
     )
+    # Barrier: a gather (e.g. embedding lookup) feeding directly into the
+    # partial-manual shard_map trips an XLA CPU SPMD partitioner crash
+    # ("Invalid binary instruction opcode copy"); the barrier pins the
+    # producer outside the manual region.
+    x = jax.lax.optimization_barrier(x)
     return jax.shard_map(
         body, mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=P(),
-        check_vma=False,
+        axis_names={pp_axis},
     )(stacked_params, x)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule
+# ---------------------------------------------------------------------------
+
+
+class Schedule(NamedTuple):
+    """Static 1F1B schedule: per-(tick, stage) microbatch indices, -1 = idle.
+    Shapes [n_ticks, n_stages]."""
+
+    fwd: np.ndarray
+    bwd: np.ndarray
+
+
+def build_1f1b_schedule(n_stages: int, n_micro: int) -> Schedule:
+    """Megatron-style non-interleaved 1F1B (reference
+    ``pipeline_parallel/scheduler.py:15`` PipeSchedulerType.OneFOneB).
+
+    Per-stage action order: ``min(S-1-s, M)`` warmup forwards, then
+    alternating f/b until forwards are exhausted, then cooldown backwards.
+    Actions are placed at the earliest tick satisfying (a) one action per
+    stage per tick and (b) cross-stage dependencies (activations/grads arrive
+    at the end of the producing tick).
+    """
+    S, M = n_stages, n_micro
+    actions = []  # per stage: list of ('f'|'b', micro)
+    for s in range(S):
+        warmup = min(S - 1 - s, M)
+        acts = [("f", m) for m in range(warmup)]
+        nf, nb = warmup, 0
+        while nf < M or nb < M:
+            if nf < M:
+                acts.append(("f", nf))
+                nf += 1
+            if nb < M and (nb < nf):
+                acts.append(("b", nb))
+                nb += 1
+        actions.append(acts)
+
+    done_f = {}  # (m, s) -> tick
+    done_b = {}
+    ptr = [0] * S
+    fwd_rows, bwd_rows = [], []
+    t = 0
+    while any(ptr[s] < len(actions[s]) for s in range(S)):
+        frow = [-1] * S
+        brow = [-1] * S
+        for s in range(S):
+            # The executor runs one fwd AND one bwd unit per tick (both are
+            # computed SPMD-uniformly anyway), so co-schedule up to one of
+            # each kind per tick, in action-list order.
+            for _ in range(2):
+                if ptr[s] >= len(actions[s]):
+                    break
+                kind, m = actions[s][ptr[s]]
+                if kind == "f":
+                    if frow[s] >= 0:
+                        break  # fwd slot already used this tick
+                    ready = s == 0 or done_f.get((m, s - 1), t) < t
+                    if not ready:
+                        break
+                    frow[s] = m
+                    done_f[(m, s)] = t
+                    ptr[s] += 1
+                else:
+                    if brow[s] >= 0:
+                        break
+                    if s == S - 1:
+                        ready = done_f.get((m, s), t) < t
+                    else:
+                        ready = done_b.get((m, s + 1), t) < t
+                    if not ready:
+                        break
+                    brow[s] = m
+                    done_b[(m, s)] = t
+                    ptr[s] += 1
+        fwd_rows.append(frow)
+        bwd_rows.append(brow)
+        t += 1
+        if t > 4 * (S + M) + 8:  # safety: schedule must terminate
+            raise RuntimeError("1F1B schedule failed to converge")
+    return Schedule(
+        np.asarray(fwd_rows, np.int32), np.asarray(bwd_rows, np.int32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1F1B executor
+# ---------------------------------------------------------------------------
+
+
+def pipeline_value_and_grad(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    pre_fn: Callable[[Any, jax.Array], jax.Array],
+    post_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    stacked_params: Any,
+    pre_params: Any,
+    post_params: Any,
+    inputs: jax.Array,   # [n_micro * micro_bs, ...] (e.g. token ids)
+    targets: jax.Array,  # [n_micro * micro_bs, ...]
+    mesh: Mesh,
+    *,
+    n_microbatches: int,
+    pp_axis: str = "pp",
+) -> Tuple[jax.Array, Tuple[Any, Any, Any]]:
+    """1F1B pipelined loss + grads for a (pre -> stages -> post) model.
+
+    - ``pre_fn(pre_params, micro_inputs) -> x``    (stage-0 head, e.g. embed)
+    - ``stage_fn(stage_params, x) -> x``           (homogeneous stage body)
+    - ``post_fn(post_params, x, micro_targets) -> scalar`` (last-stage loss,
+      mean over the microbatch)
+
+    Returns ``(loss, (d_stacked, d_pre, d_post))`` where loss and grads match
+    ``value_and_grad`` of the unpipelined mean-over-microbatches loss.
+    Backward recomputes each stage from its saved input (FlashAttention-style
+    recompute), so per-stage live memory is O(S) microbatch activations.
+    """
+    n_stages = mesh.shape[pp_axis]
+    assert inputs.shape[0] % n_microbatches == 0
+    micro_bs = inputs.shape[0] // n_microbatches
+    M, S = n_microbatches, n_stages
+    sched = build_1f1b_schedule(S, M)
+    fwd_tab = jnp.asarray(sched.fwd)
+    bwd_tab = jnp.asarray(sched.bwd)
+    n_ticks = sched.fwd.shape[0]
+
+    # Activation shape probe (host-side, no device compute).
+    x_shape = jax.eval_shape(
+        pre_fn, pre_params,
+        jax.ShapeDtypeStruct((micro_bs,) + inputs.shape[1:], inputs.dtype),
+    )
+
+    def body(stacked_local, pre_p, post_p, inputs_, targets_):
+        blocks_me = jax.tree_util.tree_map(lambda p: p[0], stacked_local)
+        s_idx = jax.lax.axis_index(pp_axis)
+        is_first = s_idx == 0
+        is_last = s_idx == S - 1
+        micros_in = inputs_.reshape((M, micro_bs) + inputs_.shape[1:])
+        micros_tgt = targets_.reshape((M, micro_bs) + targets_.shape[1:])
+
+        ring_dt = _carry_dtype(x_shape.dtype)
+
+        def zeros_ring():
+            return jnp.zeros((S,) + x_shape.shape, ring_dt)
+
+        def scaled_post(post_p_, y, tgt):
+            # 1/M so per-micro grads sum to the grad of the mean loss.
+            return post_fn(post_p_, y, tgt) / M
+
+        zero_tree = functools.partial(
+            jax.tree_util.tree_map, lambda p: jnp.zeros(p.shape, jnp.float32)
+        )
+
+        # Everything differentiable is cast VARYING over pp first: inside a
+        # manual-axes region, jax.vjp cotangents w.r.t. pp-invariant inputs
+        # carry an implicit psum over 'pp' (while custom_vjp ops skip it) —
+        # per-stage masking is only sound when every cotangent is the plain
+        # per-stage value, so grads flow from varying params and get one
+        # explicit psum at the end.
+        pre_v = _pcast_pp(pre_p, pp_axis)
+        post_v = _pcast_pp(post_p, pp_axis)
+
+        carry0 = dict(
+            in_ring=zeros_ring(),    # activations awaiting fwd
+            g_ring=zeros_ring(),     # grads awaiting bwd
+            seed_ring=zeros_ring(),  # last-stage loss grads
+            x_saved=zeros_ring(),    # saved stage inputs (recompute bwd)
+            loss=jnp.zeros((), jnp.float32),
+            d_blocks=zero_tree(blocks_me),
+            d_pre=zero_tree(pre_p),
+            d_post=zero_tree(post_p),
+        )
+
+        def masked_add(acc, delta, valid):
+            return jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(valid, d.astype(a.dtype), 0.0),
+                acc, delta,
+            )
+
+        def tick(carry, t):
+            mf = fwd_tab[t, s_idx]
+            f_valid = mf >= 0
+            mfc = jnp.clip(mf, 0, M - 1)
+            slot_f = mfc % S
+
+            # ---- forward unit ----
+            x_entry = pre_fn(pre_v, micros_in[mfc]).astype(ring_dt)
+            x_in = jnp.where(is_first, x_entry, carry["in_ring"][slot_f])
+            x_saved = carry["x_saved"].at[slot_f].set(
+                jnp.where(f_valid, x_in, carry["x_saved"][slot_f])
+            )
+            y = stage_fn(blocks_me, x_in.astype(x_shape.dtype))
+            lv = f_valid & is_last
+            # Last stage: micro loss + seed grad + post grads, in-slot.
+            (loss_m, (gy, d_post_m)) = jax.value_and_grad(
+                lambda y_, pp_: scaled_post(pp_, y_, micros_tgt[mfc]),
+                argnums=(0, 1),
+            )(y, post_v)
+            loss = carry["loss"] + jnp.where(lv, loss_m, 0.0)
+            d_post = masked_add(carry["d_post"], d_post_m, lv)
+            seed_ring = carry["seed_ring"].at[slot_f].set(
+                jnp.where(lv, gy.astype(ring_dt),
+                          carry["seed_ring"][slot_f])
+            )
+
+            # ---- backward unit ----
+            mb = bwd_tab[t, s_idx]
+            b_valid = mb >= 0
+            mbc = jnp.clip(mb, 0, M - 1)
+            slot_b = mbc % S
+            g_in = jnp.where(
+                is_last, seed_ring[slot_b], carry["g_ring"][slot_b]
+            ).astype(x_shape.dtype)
+            _, stage_vjp = jax.vjp(
+                stage_fn, blocks_me,
+                carry["x_saved"][slot_b].astype(x_shape.dtype),
+            )
+            d_blocks_m, dx = stage_vjp(g_in)
+            d_blocks = masked_add(carry["d_blocks"], d_blocks_m, b_valid)
+            # Stage 0: fold dx into the pre (embed) params.
+            _, pre_vjp = jax.vjp(
+                lambda pp_: pre_fn(pp_, micros_in[mbc]), pre_v
+            )
+            (d_pre_m,) = pre_vjp(dx.astype(x_shape.dtype))
+            d_pre = masked_add(carry["d_pre"], d_pre_m,
+                               b_valid & is_first)
+
+            # ---- neighbour exchange (end of tick) ----
+            # Micro index rides along, +1-encoded so ppermute's zero-fill on
+            # unpaired receivers decodes as invalid.
+            send_f_ok = f_valid & (s_idx < S - 1)
+            f_payload = (
+                y.astype(ring_dt),
+                jnp.where(send_f_ok, mf + 1, 0),
+            )
+            perm_f = [(s, s + 1) for s in range(S - 1)]
+            y_in, mfe_in = _safe_ppermute(f_payload, pp_axis, perm_f)
+            in_slot = jnp.clip(mfe_in - 1, 0, M - 1) % S
+            in_ring = carry["in_ring"].at[in_slot].set(
+                jnp.where(mfe_in > 0, y_in, carry["in_ring"][in_slot])
+            )
+
+            send_b_ok = b_valid & (s_idx > 0)
+            b_payload = (
+                dx.astype(ring_dt),
+                jnp.where(send_b_ok, mb + 1, 0),
+            )
+            perm_b = [(s, s - 1) for s in range(1, S)]
+            dx_in, mbe_in = _safe_ppermute(b_payload, pp_axis, perm_b)
+            g_slot = jnp.clip(mbe_in - 1, 0, M - 1) % S
+            g_ring = carry["g_ring"].at[g_slot].set(
+                jnp.where(mbe_in > 0, dx_in, carry["g_ring"][g_slot])
+            )
+
+            return dict(
+                in_ring=in_ring, g_ring=g_ring, seed_ring=seed_ring,
+                x_saved=x_saved, loss=loss, d_blocks=d_blocks,
+                d_pre=d_pre, d_post=d_post,
+            ), None
+
+        carry, _ = jax.lax.scan(
+            tick, _pcast_pp(carry0, pp_axis), jnp.arange(n_ticks)
+        )
+
+        loss = jax.lax.psum(carry["loss"], pp_axis)  # only last stage != 0
+        d_pre = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, pp_axis), carry["d_pre"]
+        )
+        d_post = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, pp_axis), carry["d_post"]
+        )
+        d_blocks = jax.tree_util.tree_map(
+            lambda g: g[None], carry["d_blocks"]
+        )
+        return loss, d_blocks, d_pre, d_post
+
+    stacked_specs = jax.tree_util.tree_map(
+        lambda _: P(pp_axis), stacked_params
+    )
+    loss, d_blocks, d_pre, d_post = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(stacked_specs, P(), P(), P(), P()),
+        out_specs=(P(), stacked_specs, P(), P()),
+        axis_names={pp_axis},
+    )(stacked_params, pre_params, post_params, inputs, targets)
+    return loss, (d_blocks, d_pre, d_post)
