@@ -60,6 +60,12 @@ class AsyncCheckpointSaver:
         ]
         self._stat = SharedDict(ckpt_stat_name(job_name), create=True)
         self._arenas: Dict[int, SharedMemoryArena] = {}
+        # In-process mutex per rank: the replica thread, the save-event
+        # thread and breakpoint saves share one cached arena object, and
+        # reopen() munmaps the mapping — concurrent reopen()/read_state()
+        # on the same instance is a use-after-munmap.  Always taken
+        # *inside* the cross-process fencing lock (never around it).
+        self._arena_mus: Dict[int, threading.Lock] = {}
         self._persisted: Dict[int, int] = {}  # local_rank -> step
         self._last_event: Dict[int, dict] = {}
         self._stop = threading.Event()
@@ -108,11 +114,12 @@ class AsyncCheckpointSaver:
             for lr in range(self.nproc):
                 try:
                     arena = self._arena(lr)
-                    arena.reopen()
-                    # Cheap metadata peek first: copying the full state
-                    # every poll just to compare steps would hold the
-                    # fencing lock for a multi-GB memcpy.
-                    meta = arena.metadata()
+                    with self._arena_mu(lr):
+                        arena.reopen()
+                        # Cheap metadata peek first: copying the full state
+                        # every poll just to compare steps would hold the
+                        # fencing lock for a multi-GB memcpy.
+                        meta = arena.metadata()
                     if meta is None or int(
                         meta.get("extra", {}).get("step", -1)
                     ) <= pushed.get(lr, -1):
@@ -121,7 +128,8 @@ class AsyncCheckpointSaver:
                     if lock is not None and not lock.acquire(timeout=5.0):
                         continue
                     try:
-                        read = arena.read_state(copy=True)
+                        with self._arena_mu(lr):
+                            read = arena.read_state(copy=True)
                     finally:
                         if lock is not None:
                             lock.release()
@@ -159,8 +167,9 @@ class AsyncCheckpointSaver:
             arena = self._arena(lr)
             cur_step = -1
             try:
-                arena.reopen()
-                meta = arena.metadata()
+                with self._arena_mu(lr):
+                    arena.reopen()
+                    meta = arena.metadata()
                 if meta is not None:
                     cur_step = int(meta.get("extra", {}).get("step", -1))
             except Exception:  # noqa: BLE001
@@ -175,7 +184,8 @@ class AsyncCheckpointSaver:
             if lock is not None and not lock.acquire(timeout=30.0):
                 continue
             try:
-                arena.write_state(tensors, extra=extra)
+                with self._arena_mu(lr):
+                    arena.write_state(tensors, extra=extra)
                 seeded += 1
                 logger.info(
                     "replica: seeded local arena %d with step %d", lr, step
@@ -202,7 +212,12 @@ class AsyncCheckpointSaver:
             self._arenas[local_rank] = SharedMemoryArena(
                 arena_name(self.job_name, local_rank)
             )
+            self._arena_mus[local_rank] = threading.Lock()
         return self._arenas[local_rank]
+
+    def _arena_mu(self, local_rank: int) -> threading.Lock:
+        self._arena(local_rank)
+        return self._arena_mus[local_rank]
 
     # -- event loop (reference _sync_shm_to_storage :536) -------------------
     def _event_loop(self) -> None:
@@ -236,8 +251,9 @@ class AsyncCheckpointSaver:
             return
         try:
             arena = self._arena(lr)
-            arena.reopen()
-            read = arena.read_state(copy=True)
+            with self._arena_mu(lr):
+                arena.reopen()
+                read = arena.read_state(copy=True)
         finally:
             if lock is not None:
                 lock.release()
@@ -296,7 +312,6 @@ class AsyncCheckpointSaver:
         for lr in range(self.nproc):
             try:
                 arena = self._arena(lr)
-                arena.reopen()
                 # Take the fencing lock so an in-flight worker write
                 # finishes first — an unlocked peek mid-write reads the
                 # dirty flag and would silently skip this rank's state.
@@ -307,7 +322,9 @@ class AsyncCheckpointSaver:
                     )
                     continue
                 try:
-                    meta = arena.metadata()
+                    with self._arena_mu(lr):
+                        arena.reopen()
+                        meta = arena.metadata()
                 finally:
                     if lock is not None:
                         lock.release()

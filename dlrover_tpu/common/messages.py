@@ -509,7 +509,7 @@ class ReplicaData(Message):
 @dataclasses.dataclass
 class EmbeddingOp(Message):
     """One embedding-store RPC: op in {lookup, apply, export, import,
-    filter, size}.  keys/grads/blob are packed numpy bytes."""
+    delete, filter, size}.  keys/grads/blob are packed numpy bytes."""
 
     table: str = ""
     op: str = "lookup"

@@ -301,6 +301,15 @@ class SharedMemoryArena:
             0,  # clear dirty: state is consistent again
         )
         seg.buf[: len(header)] = np.frombuffer(header, dtype=np.uint8)
+        # mmap stores do not reliably bump the tmpfs file's mtime, so a
+        # live arena written only through memcpy looks idle forever.
+        # Touch it explicitly: the launcher's startup GC keys "live" on
+        # mtime freshness and must never wipe a sibling run's staged
+        # checkpoint on a shared host.
+        try:
+            os.utime(f"/dev/shm/{self.name.lstrip('/')}")
+        except OSError:  # pragma: no cover - segment raced away
+            pass
 
     # -- reader side --------------------------------------------------------
     def _ensure_open(self) -> None:

@@ -91,6 +91,10 @@ class EmbeddingServicer:
             st = self.table(msg.table, dim)
             n = st.import_rows(msg.blob)
             return m.EmbeddingResult(count=n)
+        if msg.op == "delete":
+            keys = np.frombuffer(msg.keys, np.int64)
+            st = self.table(msg.table)
+            return m.EmbeddingResult(count=st.delete(keys))
         if msg.op == "filter":
             st = self.table(msg.table)
             n = st.filter(msg.min_freq, msg.max_version_age)
@@ -232,9 +236,17 @@ class DistributedEmbedding:
     # -- elastic resize ----------------------------------------------------
     def rebalance(self, new_addrs: Sequence[str]) -> int:
         """Move every row to its owner under the new server set
-        (reference PS scale-up + hot-PS migration).  Returns moved rows."""
+        (reference PS scale-up + hot-PS migration).  Returns moved rows.
+
+        The move is transactional per (source, destination) slice: rows
+        already living on their new owner are left untouched, and a moved
+        slice is deleted from its source only after the destination
+        acknowledges the import — so overlapping old/new server sets never
+        accumulate stale duplicate rows that a later rebalance could
+        resurrect, and ``size()``/export never double-count."""
         old_clients = self._clients
         new_clients = [RpcClient(a, timeout=120.0) for a in new_addrs]
+        new_index = {a: r for r, a in enumerate(new_addrs)}
         moved = 0
         for c in old_clients:
             resp = c.call(
@@ -246,22 +258,35 @@ class DistributedEmbedding:
             arr = np.frombuffer(resp.blob, np.uint8).reshape(-1, rb)
             keys = arr[:, :8].copy().view(np.int64).reshape(-1)
             owners = _owner(keys, len(new_clients))
+            src_rank = new_index.get(c.addr, -1)
             for r in range(len(new_clients)):
+                if r == src_rank:
+                    continue  # already on its new owner
                 idx = np.nonzero(owners == r)[0]
                 if len(idx) == 0:
                     continue
                 blob = arr[idx].tobytes()
-                new_clients[r].call(
+                resp_imp = new_clients[r].call(
                     m.EmbeddingOp(
                         table=self.table, op="import", blob=blob,
                         optimizer={"dim": self.dim},
                     )
                 )
+                if not resp_imp.success:
+                    raise RuntimeError(
+                        f"rebalance import to server {r} failed: "
+                        f"{resp_imp.reason}"
+                    )
+                c.call(
+                    m.EmbeddingOp(
+                        table=self.table, op="delete",
+                        keys=keys[idx].tobytes(),
+                    )
+                )
                 moved += len(idx)
         self._clients = new_clients
         for c in old_clients:
-            if c not in new_clients:
-                c.close()
+            c.close()  # new_clients hold their own channels
         logger.info(
             "embedding rebalance: %d rows over %d servers",
             moved, len(new_clients),

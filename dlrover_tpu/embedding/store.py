@@ -55,6 +55,12 @@ def _lib() -> Optional[ctypes.CDLL]:
         lib.kv_apply_group_ftrl.argtypes = [
             c, _I64P, i64, _F32P, f32, f32, f32, f32,
         ]
+        lib.kv_apply_group_adam.restype = c
+        lib.kv_apply_group_adam.argtypes = [
+            c, _I64P, i64, _F32P, f32, f32, f32, f32, i64, f32,
+        ]
+        lib.kv_delete.restype = i64
+        lib.kv_delete.argtypes = [c, _I64P, i64]
         lib.kv_metadata.restype = c
         lib.kv_metadata.argtypes = [c, _I64P, i64, _I64P, _I64P]
         lib.kv_filter.restype = i64
@@ -117,19 +123,26 @@ class EmbeddingStore:
         num_shards: int = 64,
         init_scale: float = 0.05,
         seed: int = 42,
+        backend: str = "auto",
     ):
+        """``backend``: "auto" prefers the native library and falls back
+        to pure Python; "python" forces the fallback; "native" requires
+        the library (raises if unavailable)."""
         self.dim = dim
-        self._lib = _lib()
+        self._lib = _lib() if backend in ("auto", "native") else None
         self._py: Optional[_PyStore] = None
         self._step = 0
+        if backend == "native" and self._lib is None:
+            raise RuntimeError("native kv store requested but unavailable")
         if self._lib is not None:
             self._handle = self._lib.kv_create(
                 dim, num_shards, init_scale, seed
             )
             if self._handle < 0:
                 raise RuntimeError("kv_create failed")
-        else:  # pragma: no cover - toolchain-less fallback
-            logger.warning("native kv store unavailable; python fallback")
+        else:
+            if backend == "auto":  # pragma: no cover - toolchain-less host
+                logger.warning("native kv store unavailable; python fallback")
             self._py = _PyStore(dim, init_scale, seed)
 
     # -- core --------------------------------------------------------------
@@ -161,15 +174,22 @@ class EmbeddingStore:
     def apply_sgd(self, keys, grads, lr: float) -> None:
         keys, grads = self._check(keys, grads)
         if self._py is not None:
-            self._py_apply(keys, grads, lambda row, g: row.__setitem__(
-                slice(None), row - lr * g))
+            self._py_apply(
+                keys, grads, lambda row, g: row["emb"].__isub__(lr * g)
+            )
             return
         self._lib.kv_apply_sgd(self._handle, keys, len(keys), grads, lr)
 
     def apply_adagrad(self, keys, grads, lr: float, eps: float = 1e-8):
         keys, grads = self._check(keys, grads)
-        if self._py is not None:  # pragma: no cover
-            raise NotImplementedError("adagrad needs the native store")
+        if self._py is not None:
+            def fn(row, g):
+                if row["s0"] is None:
+                    row["s0"] = np.zeros(self.dim, np.float32)
+                row["s0"] += g * g
+                row["emb"] -= lr * g / (np.sqrt(row["s0"]) + eps)
+            self._py_apply(keys, grads, fn)
+            return
         self._lib.kv_apply_adagrad(
             self._handle, keys, len(keys), grads, lr, eps
         )
@@ -180,8 +200,22 @@ class EmbeddingStore:
     ):
         keys, grads = self._check(keys, grads)
         self._step += 1
-        if self._py is not None:  # pragma: no cover
-            raise NotImplementedError("adam needs the native store")
+        if self._py is not None:
+            lr_t = (
+                lr * np.sqrt(1.0 - beta2 ** self._step)
+                / (1.0 - beta1 ** self._step)
+            )
+            def fn(row, g):
+                if row["s0"] is None:
+                    row["s0"] = np.zeros(self.dim, np.float32)
+                    row["s1"] = np.zeros(self.dim, np.float32)
+                row["s0"] *= beta1
+                row["s0"] += (1.0 - beta1) * g
+                row["s1"] *= beta2
+                row["s1"] += (1.0 - beta2) * g * g
+                row["emb"] -= lr_t * row["s0"] / (np.sqrt(row["s1"]) + eps)
+            self._py_apply(keys, grads, fn)
+            return
         self._lib.kv_apply_adam(
             self._handle, keys, len(keys), grads, lr, beta1, beta2, eps,
             self._step,
@@ -193,18 +227,85 @@ class EmbeddingStore:
         lambda1: float = 0.001, lambda2: float = 0.001,
     ):
         keys, grads = self._check(keys, grads)
-        if self._py is not None:  # pragma: no cover
-            raise NotImplementedError("ftrl needs the native store")
+        if self._py is not None:
+            thresh = lambda1 * np.sqrt(self.dim)
+            def fn(row, g):
+                if row["s0"] is None:
+                    row["s0"] = np.zeros(self.dim, np.float32)  # z
+                    row["s1"] = np.zeros(self.dim, np.float32)  # n
+                sigma = (np.sqrt(row["s1"] + g * g) - np.sqrt(row["s1"])) \
+                    / alpha
+                row["s0"] += g - sigma * row["emb"]
+                row["s1"] += g * g
+                znorm = float(np.linalg.norm(row["s0"]))
+                if znorm <= thresh:
+                    row["emb"][:] = 0.0
+                else:
+                    eta = (beta + np.sqrt(row["s1"])) / alpha + lambda2
+                    row["emb"][:] = -(znorm - thresh) / znorm \
+                        * row["s0"] / eta
+            self._py_apply(keys, grads, fn)
+            return
         self._lib.kv_apply_group_ftrl(
             self._handle, keys, len(keys), grads, alpha, beta, lambda1,
             lambda2,
         )
 
-    def _py_apply(self, keys, grads, fn):  # sgd-only fallback
+    def apply_group_adam(
+        self, keys, grads, lr: float,
+        beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8,
+        lasso: float = 0.0,
+    ):
+        """Adam + whole-row (L2,1) lasso shrinkage — rarely-useful rows are
+        driven exactly to zero so :meth:`filter` can evict them (reference
+        tfplus ``training_ops.cc`` GroupAdam)."""
+        keys, grads = self._check(keys, grads)
+        self._step += 1
+        if self._py is not None:
+            lr_t = (
+                lr * np.sqrt(1.0 - beta2 ** self._step)
+                / (1.0 - beta1 ** self._step)
+            )
+            thresh = lr_t * lasso * np.sqrt(self.dim)
+            def fn(row, g):
+                if row["s0"] is None:
+                    row["s0"] = np.zeros(self.dim, np.float32)
+                    row["s1"] = np.zeros(self.dim, np.float32)
+                row["s0"] *= beta1
+                row["s0"] += (1.0 - beta1) * g
+                row["s1"] *= beta2
+                row["s1"] += (1.0 - beta2) * g * g
+                row["emb"] -= lr_t * row["s0"] / (np.sqrt(row["s1"]) + eps)
+                if lasso > 0.0:
+                    norm = float(np.linalg.norm(row["emb"]))
+                    if norm <= thresh:
+                        row["emb"][:] = 0.0
+                    else:
+                        row["emb"] *= (norm - thresh) / norm
+            self._py_apply(keys, grads, fn)
+            return
+        self._lib.kv_apply_group_adam(
+            self._handle, keys, len(keys), grads, lr, beta1, beta2, eps,
+            self._step, lasso,
+        )
+
+    def _py_apply(self, keys, grads, fn):
+        self._py.version += 1  # native parity: one version tick per apply
         for k, g in zip(keys, grads):
             row = self._py.rows.get(int(k))
             if row is not None:
-                fn(row["emb"], g)
+                fn(row, g)
+                row["version"] = self._py.version
+
+    def delete(self, keys) -> int:
+        """Remove rows by key (rebalance move semantics); returns removed."""
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        if self._py is not None:
+            removed = 0
+            for k in keys:
+                removed += self._py.rows.pop(int(k), None) is not None
+            return removed
+        return int(self._lib.kv_delete(self._handle, keys, len(keys)))
 
     # -- metadata / filtering ----------------------------------------------
     def metadata(self, keys) -> Tuple[np.ndarray, np.ndarray]:
@@ -242,8 +343,29 @@ class EmbeddingStore:
         return int(self._lib.kv_row_bytes(self._handle))
 
     def export(self, rank_filter: int = 0, world: int = 1) -> bytes:
+        """Serialize rows (all, or this rank's router partition when
+        ``world > 1``) in the shared binary layout:
+        ``key,freq,version (i64) + emb,slot0,slot1 (f32[dim])``."""
         if self._py is not None:
-            raise NotImplementedError("export needs the native store")
+            out = []
+            for k, row in self._py.rows.items():
+                if world > 1:
+                    h = ((int(k) & 0xFFFFFFFFFFFFFFFF)
+                         * 0x9E3779B97F4A7C15) % (1 << 64) >> 33
+                    if h % world != rank_filter:
+                        continue
+                zeros = np.zeros(self.dim, np.float32)
+                out.append(
+                    np.array(
+                        [k, row["freq"], row["version"]], np.int64
+                    ).tobytes()
+                    + row["emb"].astype(np.float32).tobytes()
+                    + (row["s0"] if row["s0"] is not None else zeros)
+                    .astype(np.float32).tobytes()
+                    + (row["s1"] if row["s1"] is not None else zeros)
+                    .astype(np.float32).tobytes()
+                )
+            return b"".join(out)
         n = len(self)
         buf = np.empty(max(1, n) * self.row_bytes, np.uint8)
         written = self._lib.kv_export(
@@ -252,10 +374,22 @@ class EmbeddingStore:
         return buf[: written * self.row_bytes].tobytes()
 
     def import_rows(self, blob: bytes) -> int:
-        if self._py is not None:
-            raise NotImplementedError("import needs the native store")
         arr = np.frombuffer(blob, np.uint8).copy()
         rows = len(arr) // self.row_bytes
+        if self._py is not None:
+            d = self.dim
+            rec = arr[: rows * self.row_bytes].reshape(rows, self.row_bytes)
+            for i in range(rows):
+                meta = rec[i, :24].view(np.int64)
+                vecs = rec[i, 24:].view(np.float32)
+                self._py.rows[int(meta[0])] = {
+                    "emb": vecs[:d].copy(),
+                    "s0": vecs[d:2 * d].copy(),
+                    "s1": vecs[2 * d:3 * d].copy(),
+                    "freq": int(meta[1]),
+                    "version": int(meta[2]),
+                }
+            return rows
         return int(self._lib.kv_import(self._handle, arr, rows))
 
     def close(self) -> None:

@@ -228,12 +228,21 @@ class DistributedJobManager(LocalJobManager):
                 return 0
             plan = ScalePlan()
             if delta > 0:
+                # Fill rank holes first: the global id counter is shared
+                # with relaunches, so reusing it as a rank would leave
+                # gaps (e.g. {0,1,3}) that break the shrink path's
+                # contiguous-ranks invariant and node-unit rounding.
+                used_ranks = {n.rank_index for n in live}
+                next_rank = 0
                 for _ in range(delta):
+                    while next_rank in used_ranks:
+                        next_rank += 1
+                    used_ranks.add(next_rank)
                     node_id = next(self._id_iter)
                     node = Node(
                         NodeType.WORKER,
                         node_id,
-                        rank_index=node_id,
+                        rank_index=next_rank,
                         config_resource=group.resource,
                         max_relaunch_count=group.restart_count,
                     )

@@ -297,6 +297,68 @@ int kv_apply_group_ftrl(int handle, const int64_t* keys, int64_t n,
   return 0;
 }
 
+// GroupAdam (reference tfplus group_adam in training_ops.cc): Adam moments
+// plus an L2,1 whole-row lasso applied to the updated row — rows whose
+// post-step norm falls under lambda*sqrt(dim) are zeroed, others shrunk.
+int kv_apply_group_adam(int handle, const int64_t* keys, int64_t n,
+                        const float* grads, float lr, float beta1,
+                        float beta2, float eps, int64_t step,
+                        float lambda_) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  int dim = s->dim;
+  int64_t ver = ++s->version;
+  float bc1 = 1.f - std::pow(beta1, (float)step);
+  float bc2 = 1.f - std::pow(beta2, (float)step);
+  float lr_t = lr * std::sqrt(bc2) / bc1;
+  parallel_for(n, [&](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      Shard& sh = s->shard_for(keys[i]);
+      std::lock_guard<std::mutex> g(sh.mu);
+      auto it = sh.rows.find(keys[i]);
+      if (it == sh.rows.end()) continue;
+      Row& row = it->second;
+      if (row.slot0.empty()) row.slot0.assign(dim, 0.f);
+      if (row.slot1.empty()) row.slot1.assign(dim, 0.f);
+      const float* gr = grads + i * dim;
+      for (int d = 0; d < dim; ++d) {
+        row.slot0[d] = beta1 * row.slot0[d] + (1.f - beta1) * gr[d];
+        row.slot1[d] = beta2 * row.slot1[d] + (1.f - beta2) * gr[d] * gr[d];
+        row.emb[d] -= lr_t * row.slot0[d] / (std::sqrt(row.slot1[d]) + eps);
+      }
+      if (lambda_ > 0.f) {
+        float norm = 0.f;
+        for (int d = 0; d < dim; ++d) norm += row.emb[d] * row.emb[d];
+        norm = std::sqrt(norm);
+        float thresh = lr_t * lambda_ * std::sqrt((float)dim);
+        if (norm <= thresh) {
+          std::fill(row.emb.begin(), row.emb.end(), 0.f);
+        } else {
+          float scale = (norm - thresh) / norm;
+          for (int d = 0; d < dim; ++d) row.emb[d] *= scale;
+        }
+      }
+      row.version = ver;
+    }
+  });
+  return 0;
+}
+
+// Delete rows by key (elastic rebalance move semantics: the router imports
+// a row to its new owner, then deletes it here on the old one).  Returns
+// rows actually removed.
+int64_t kv_delete(int handle, const int64_t* keys, int64_t n) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  int64_t removed = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& sh = s->shard_for(keys[i]);
+    std::lock_guard<std::mutex> g(sh.mu);
+    removed += (int64_t)sh.rows.erase(keys[i]);
+  }
+  return removed;
+}
+
 // --- metadata / filtering (reference embedding_value.h + filters) ---------
 
 // Copy per-key (freq, version) into out_freq/out_version (missing -> -1).
@@ -355,8 +417,11 @@ int64_t kv_row_bytes(int handle) {
   return 3 * (int64_t)sizeof(int64_t) + 3ll * s->dim * sizeof(float);
 }
 
-// Export up to max_rows rows whose shard index % world == rank_filter
-// (world==1 exports all).  Returns rows written.
+// Export up to max_rows rows whose ROUTER partition matches: per-key
+// ((key * 0x9E3779B97F4A7C15) >> 33) % world == rank_filter — the exact
+// hash the Python router's _owner() uses, so the rank_filter/world export
+// path matches router ownership for ANY world, not only worlds dividing
+// num_shards.  world<=1 exports all.  Returns rows written.
 int64_t kv_export(int handle, uint8_t* buf, int64_t max_rows,
                   int rank_filter, int world) {
   Store* s = get(handle);
@@ -365,10 +430,13 @@ int64_t kv_export(int handle, uint8_t* buf, int64_t max_rows,
   int64_t rb = kv_row_bytes(handle);
   int64_t written = 0;
   for (int si = 0; si < s->num_shards; ++si) {
-    if (world > 1 && si % world != rank_filter) continue;
     Shard& sh = s->shards[si];
     std::lock_guard<std::mutex> g(sh.mu);
     for (auto& kv : sh.rows) {
+      if (world > 1) {
+        uint64_t h = ((uint64_t)kv.first * 0x9E3779B97F4A7C15ull) >> 33;
+        if ((int)(h % (uint64_t)world) != rank_filter) continue;
+      }
       if (written >= max_rows) return written;
       uint8_t* p = buf + written * rb;
       int64_t meta[3] = {kv.first, kv.second.freq, kv.second.version};

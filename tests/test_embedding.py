@@ -108,6 +108,54 @@ class TestStore:
         )
         st2.close()
 
+    def test_group_adam_descends_and_lasso_zeroes(self, store):
+        keys = np.arange(8, dtype=np.int64)
+        for _ in range(100):
+            rows = store.lookup(keys)
+            store.apply_group_adam(keys, rows, lr=0.05)
+        assert np.abs(store.lookup(keys)).max() < 0.05
+        # Strong lasso drives whole rows to exactly zero.
+        st2 = EmbeddingStore(4, init_scale=0.1, seed=9)
+        zkeys = np.array([1, 2], np.int64)
+        st2.lookup(zkeys)
+        for _ in range(10):
+            g = np.full((2, 4), 1e-4, np.float32)
+            st2.apply_group_adam(zkeys, g, lr=0.05, lasso=100.0)
+        np.testing.assert_array_equal(
+            st2.lookup(zkeys, train=False), np.zeros((2, 4))
+        )
+        st2.close()
+
+    def test_delete(self, store):
+        keys = np.arange(10, dtype=np.int64)
+        store.lookup(keys)
+        assert store.delete(np.array([3, 4, 99], np.int64)) == 2
+        assert len(store) == 8
+        np.testing.assert_array_equal(
+            store.lookup(np.array([3], np.int64), train=False),
+            np.zeros((1, 4)),
+        )
+
+    def test_export_partition_matches_router(self, store):
+        """The rank_filter/world export path must agree with the Python
+        router's hash for worlds that do NOT divide num_shards."""
+        from dlrover_tpu.embedding.service import _owner
+
+        keys = np.arange(200, dtype=np.int64)
+        store.lookup(keys)
+        world = 3  # 3 does not divide the default 64 shards
+        seen = []
+        for r in range(world):
+            blob = store.export(rank_filter=r, world=world)
+            if not blob:
+                continue
+            arr = np.frombuffer(blob, np.uint8).reshape(-1, store.row_bytes)
+            got = np.sort(arr[:, :8].copy().view(np.int64).reshape(-1))
+            want = np.sort(keys[_owner(keys, world) == r])
+            np.testing.assert_array_equal(got, want)
+            seen.append(got)
+        assert sum(len(s) for s in seen) == 200
+
     def test_checkpoint_helpers(self, store, tmp_path):
         keys = np.arange(6, dtype=np.int64)
         expected = store.lookup(keys)
@@ -118,6 +166,98 @@ class TestStore:
             st2.lookup(keys, train=False), expected
         )
         st2.close()
+
+
+@pytest.fixture()
+def py_store():
+    """An EmbeddingStore forced onto the pure-Python fallback path."""
+    st = EmbeddingStore(4, init_scale=0.1, seed=7, backend="python")
+    assert st._py is not None
+    yield st
+
+
+class TestPyFallback:
+    """The fallback must cover the full optimizer/export surface
+    (round-1 review: it only did SGD and raised elsewhere)."""
+
+    def test_all_optimizers_descend(self, py_store):
+        keys = np.arange(8, dtype=np.int64)
+        for kind in ("adagrad", "adam", "group_adam"):
+            st = EmbeddingStore(
+                4, init_scale=0.1, seed=3, backend="python"
+            )
+            for _ in range(100):
+                rows = st.lookup(keys)
+                getattr(st, f"apply_{kind}")(keys, rows, lr=0.1)
+            assert np.abs(st.lookup(keys)).max() < 0.05, kind
+
+    def test_group_ftrl_zeroes(self, py_store):
+        keys = np.array([1, 2], np.int64)
+        py_store.lookup(keys)
+        for _ in range(5):
+            g = np.full((2, 4), 1e-4, np.float32)
+            py_store.apply_group_ftrl(keys, g, lambda1=1.0)
+        np.testing.assert_array_equal(
+            py_store.lookup(keys, train=False), np.zeros((2, 4))
+        )
+
+    def test_native_python_blob_interop(self, py_store):
+        """Export layout is shared: native blob -> python store and back."""
+        native = EmbeddingStore(4, init_scale=0.1, seed=7)
+        if native._py is not None:
+            pytest.skip("native store unavailable")
+        keys = np.arange(20, dtype=np.int64)
+        native.lookup(keys)
+        native.apply_adagrad(keys, np.ones((20, 4), np.float32), lr=0.1)
+        expected = native.lookup(keys, train=False)
+
+        assert py_store.import_rows(native.export()) == 20
+        np.testing.assert_allclose(
+            py_store.lookup(keys, train=False), expected, rtol=1e-6
+        )
+        # Continued training agrees (slots survived the round trip).
+        g = np.ones((20, 4), np.float32)
+        native.apply_adagrad(keys, g, lr=0.1)
+        py_store.apply_adagrad(keys, g, lr=0.1)
+        np.testing.assert_allclose(
+            py_store.lookup(keys, train=False),
+            native.lookup(keys, train=False),
+            rtol=1e-5,
+        )
+        # And back: python export -> fresh native store.
+        nat2 = EmbeddingStore(4, init_scale=0.0)
+        assert nat2.import_rows(py_store.export()) == 20
+        np.testing.assert_allclose(
+            nat2.lookup(keys, train=False),
+            py_store.lookup(keys, train=False),
+            rtol=1e-6,
+        )
+        native.close()
+        nat2.close()
+
+    def test_partitioned_export_matches_router(self, py_store):
+        from dlrover_tpu.embedding.service import _owner
+
+        keys = np.arange(100, dtype=np.int64)
+        py_store.lookup(keys)
+        world = 3
+        total = 0
+        for r in range(world):
+            blob = py_store.export(rank_filter=r, world=world)
+            arr = np.frombuffer(blob, np.uint8).reshape(
+                -1, py_store.row_bytes
+            )
+            got = np.sort(arr[:, :8].copy().view(np.int64).reshape(-1))
+            want = np.sort(keys[_owner(keys, world) == r])
+            np.testing.assert_array_equal(got, want)
+            total += len(got)
+        assert total == 100
+
+    def test_delete(self, py_store):
+        keys = np.arange(5, dtype=np.int64)
+        py_store.lookup(keys)
+        assert py_store.delete(np.array([0, 1], np.int64)) == 2
+        assert len(py_store) == 3
 
 
 class TestLayer:
@@ -212,6 +352,19 @@ class TestDistributedServing:
                 de.lookup(keys, train=False), after, rtol=1e-6
             )
             assert len(s2.servicer.table("t")) > 0
+            # Move semantics: overlapping old/new sets must not leave
+            # stale duplicates behind (size would double-count).
+            assert de.size() == 100
+            # Train more, then shrink back — the values must track; a
+            # non-transactional rebalance would resurrect the pre-move
+            # rows still sitting on their old owners.
+            de.apply_gradients(keys, np.ones((100, 4), np.float32))
+            trained = de.lookup(keys, train=False)
+            de.rebalance([s0.addr, s1.addr])
+            assert de.size() == 100
+            np.testing.assert_allclose(
+                de.lookup(keys, train=False), trained, rtol=1e-6
+            )
         finally:
             de.close()
             for s in (s0, s1, s2):
