@@ -59,13 +59,19 @@ class AsyncCheckpointSaver:
             for lr in range(nproc_per_node)
         ]
         self._stat = SharedDict(ckpt_stat_name(job_name), create=True)
-        self._arenas: Dict[int, SharedMemoryArena] = {}
         # In-process mutex per rank: the replica thread, the save-event
         # thread and breakpoint saves share one cached arena object, and
         # reopen() munmaps the mapping — concurrent reopen()/read_state()
         # on the same instance is a use-after-munmap.  Always taken
         # *inside* the cross-process fencing lock (never around it).
-        self._arena_mus: Dict[int, threading.Lock] = {}
+        # Pre-populated for every rank so lazy init can't race either.
+        self._arenas: Dict[int, SharedMemoryArena] = {
+            lr: SharedMemoryArena(arena_name(job_name, lr))
+            for lr in range(nproc_per_node)
+        }
+        self._arena_mus: Dict[int, threading.Lock] = {
+            lr: threading.Lock() for lr in range(nproc_per_node)
+        }
         self._persisted: Dict[int, int] = {}  # local_rank -> step
         self._last_event: Dict[int, dict] = {}
         self._stop = threading.Event()
@@ -208,15 +214,9 @@ class AsyncCheckpointSaver:
             arena.close()
 
     def _arena(self, local_rank: int) -> SharedMemoryArena:
-        if local_rank not in self._arenas:
-            self._arenas[local_rank] = SharedMemoryArena(
-                arena_name(self.job_name, local_rank)
-            )
-            self._arena_mus[local_rank] = threading.Lock()
         return self._arenas[local_rank]
 
     def _arena_mu(self, local_rank: int) -> threading.Lock:
-        self._arena(local_rank)
         return self._arena_mus[local_rank]
 
     # -- event loop (reference _sync_shm_to_storage :536) -------------------

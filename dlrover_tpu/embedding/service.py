@@ -24,6 +24,17 @@ from dlrover_tpu.embedding.store import EmbeddingStore
 _KV_PREFIX = "embedding/addr/"
 
 
+def _norm_addr(addr: str) -> str:
+    """Resolve ``host:port`` to ``ip:port`` for identity comparison."""
+    import socket
+
+    host, _, port = addr.rpartition(":")
+    try:
+        return f"{socket.gethostbyname(host)}:{port}"
+    except OSError:
+        return addr
+
+
 def _owner(keys: np.ndarray, world: int) -> np.ndarray:
     """Key -> owning server (same mix as the C++ shard hash so export's
     ``rank_filter``/``world`` partition matches the router)."""
@@ -238,16 +249,27 @@ class DistributedEmbedding:
         """Move every row to its owner under the new server set
         (reference PS scale-up + hot-PS migration).  Returns moved rows.
 
-        The move is transactional per (source, destination) slice: rows
-        already living on their new owner are left untouched, and a moved
-        slice is deleted from its source only after the destination
-        acknowledges the import — so overlapping old/new server sets never
-        accumulate stale duplicate rows that a later rebalance could
-        resurrect, and ``size()``/export never double-count."""
+        Two-phase move, so a mid-rebalance failure is never lossy:
+
+        1. **Copy**: every misplaced row is imported to its new owner.
+           Nothing is deleted yet — a failure here raises with the OLD
+           routing fully intact (the copies are harmless duplicates; a
+           retry re-imports the same values).
+        2. **Switch + delete**: routing flips to the new servers, then the
+           moved keys are deleted from their sources (responses checked).
+           A delete failure raises — the values are already authoritative
+           on their new owners, but stale source copies remain, so the
+           caller must retry the rebalance before resuming training lest a
+           LATER rebalance re-export the stale rows over trained ones.
+
+        Rows already on their new owner are skipped (addresses compared in
+        resolved ``ip:port`` form, so ``localhost``/``127.0.0.1`` aliases
+        can't turn the self-move skip into a self-delete)."""
         old_clients = self._clients
         new_clients = [RpcClient(a, timeout=120.0) for a in new_addrs]
-        new_index = {a: r for r, a in enumerate(new_addrs)}
+        norm = {_norm_addr(a): r for r, a in enumerate(new_addrs)}
         moved = 0
+        deletes = []  # (source client, keys) to apply after the switch
         for c in old_clients:
             resp = c.call(
                 m.EmbeddingOp(table=self.table, op="export", world=1)
@@ -258,35 +280,54 @@ class DistributedEmbedding:
             arr = np.frombuffer(resp.blob, np.uint8).reshape(-1, rb)
             keys = arr[:, :8].copy().view(np.int64).reshape(-1)
             owners = _owner(keys, len(new_clients))
-            src_rank = new_index.get(c.addr, -1)
+            src_rank = norm.get(_norm_addr(c.addr), -1)
             for r in range(len(new_clients)):
                 if r == src_rank:
                     continue  # already on its new owner
                 idx = np.nonzero(owners == r)[0]
                 if len(idx) == 0:
                     continue
-                blob = arr[idx].tobytes()
                 resp_imp = new_clients[r].call(
                     m.EmbeddingOp(
-                        table=self.table, op="import", blob=blob,
+                        table=self.table, op="import",
+                        blob=arr[idx].tobytes(),
                         optimizer={"dim": self.dim},
                     )
                 )
                 if not resp_imp.success:
+                    for nc in new_clients:
+                        nc.close()
                     raise RuntimeError(
-                        f"rebalance import to server {r} failed: "
-                        f"{resp_imp.reason}"
+                        f"rebalance copy to server {r} failed (old routing "
+                        f"kept, no rows lost): {resp_imp.reason}"
                     )
-                c.call(
+                deletes.append((c, keys[idx]))
+                moved += len(idx)
+
+        # Phase 2: all copies landed — flip routing, then clean sources.
+        self._clients = new_clients
+        failed = []
+        for c, dkeys in deletes:
+            resp_del = c.call(
+                m.EmbeddingOp(
+                    table=self.table, op="delete", keys=dkeys.tobytes()
+                )
+            )
+            if not resp_del.success:  # one bounded retry
+                resp_del = c.call(
                     m.EmbeddingOp(
-                        table=self.table, op="delete",
-                        keys=keys[idx].tobytes(),
+                        table=self.table, op="delete", keys=dkeys.tobytes()
                     )
                 )
-                moved += len(idx)
-        self._clients = new_clients
+            if not resp_del.success:
+                failed.append((c.addr, len(dkeys), resp_del.reason))
         for c in old_clients:
             c.close()  # new_clients hold their own channels
+        if failed:
+            raise RuntimeError(
+                "rebalance moved all rows but could not delete stale "
+                f"source copies {failed}; retry rebalance before training"
+            )
         logger.info(
             "embedding rebalance: %d rows over %d servers",
             moved, len(new_clients),

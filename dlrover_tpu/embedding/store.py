@@ -208,6 +208,7 @@ class EmbeddingStore:
             def fn(row, g):
                 if row["s0"] is None:
                     row["s0"] = np.zeros(self.dim, np.float32)
+                if row["s1"] is None:
                     row["s1"] = np.zeros(self.dim, np.float32)
                 row["s0"] *= beta1
                 row["s0"] += (1.0 - beta1) * g
@@ -232,6 +233,7 @@ class EmbeddingStore:
             def fn(row, g):
                 if row["s0"] is None:
                     row["s0"] = np.zeros(self.dim, np.float32)  # z
+                if row["s1"] is None:
                     row["s1"] = np.zeros(self.dim, np.float32)  # n
                 sigma = (np.sqrt(row["s1"] + g * g) - np.sqrt(row["s1"])) \
                     / alpha
@@ -270,6 +272,7 @@ class EmbeddingStore:
             def fn(row, g):
                 if row["s0"] is None:
                     row["s0"] = np.zeros(self.dim, np.float32)
+                if row["s1"] is None:
                     row["s1"] = np.zeros(self.dim, np.float32)
                 row["s0"] *= beta1
                 row["s0"] += (1.0 - beta1) * g

@@ -8,20 +8,29 @@ steps the slices exchange parameter deltas once and apply an outer optimizer
 (Nesterov momentum per the DiLoCo recipe).  ICI carries the inner-step
 collectives; DCN only sees one delta exchange per H steps.
 
-Implemented as explicit functions over a mesh 'dp' axis so it composes with
-any inner sharding::
+Representation: replica-divergent parameters are held as what they really
+are on a device mesh — ONE global array per leaf with a leading ``dp`` axis
+of size ``n_replicas``, sharded ``P('dp', ...)``, each replica owning its
+slice.  Inner steps map over that axis (:meth:`LocalSGDSync.inner_apply`);
+the periodic sync reduces over it and returns dp-invariant parameters.
+This keeps shard_map's replication checker fully on (no ``check_vma``
+escape hatch): divergence is visible in the type, not smuggled through
+"replicated" specs holding different values per device.
 
     sync = LocalSGDSync(outer_lr=0.7, outer_momentum=0.9, sync_every=16)
-    anchor = sync.init(params)
-    ...every step... params = inner_step(params, batch)   # no dp collectives
+    anchor, outer_m = sync.init(params)          # dp-invariant
+    local = sync.scatter(mesh, params)           # [n_dp, ...] P('dp')
+    ...every step...                             # no dp collectives:
+    local = sync.inner_apply(mesh, inner_step, local, batch)
     if step % sync.sync_every == 0:
-        params, anchor, outer_m = sync.apply(mesh, params, anchor, outer_m)
+        params, anchor, outer_m = sync.apply(mesh, local, anchor, outer_m)
+        local = sync.scatter(mesh, params)
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,37 +45,84 @@ class LocalSGDSync:
     dp_axis: str = "dp"
 
     def init(self, params: Any) -> Tuple[Any, Any]:
-        """(anchor=copy of params, zero outer momentum)."""
+        """(anchor=copy of params, zero outer momentum) — both dp-invariant
+        (they are only ever written by the all-replica sync)."""
         anchor = jax.tree_util.tree_map(jnp.array, params)
         mom = jax.tree_util.tree_map(jnp.zeros_like, params)
         return anchor, mom
 
+    # -- representation ----------------------------------------------------
+    def scatter(self, mesh: Mesh, params: Any) -> Any:
+        """Broadcast dp-invariant params to the per-replica stacked form:
+        every leaf gains a leading axis of size n_dp, sharded P('dp').
+        Each replica then drifts its own slice during inner steps."""
+        n_dp = mesh.shape[self.dp_axis]
+
+        def leaf(p):
+            stacked = jnp.broadcast_to(p[None], (n_dp,) + p.shape)
+            return jax.device_put(
+                stacked, NamedSharding(mesh, P(self.dp_axis))
+            )
+
+        return jax.tree_util.tree_map(leaf, params)
+
+    def inner_apply(
+        self,
+        mesh: Mesh,
+        step_fn: Callable[..., Any],
+        local_params: Any,
+        *batched_args: Any,
+    ) -> Any:
+        """Run ``step_fn(params, *args) -> params`` independently on every
+        dp replica (no cross-replica communication).  ``local_params`` is
+        the stacked form from :meth:`scatter`; each extra arg must carry a
+        leading dp axis too (e.g. per-replica batches)."""
+
+        def body(p_local, *args_local):
+            squeeze = lambda t: jax.tree_util.tree_map(
+                lambda x: x[0], t
+            )
+            out = step_fn(squeeze(p_local), *(squeeze(a) for a in args_local))
+            return jax.tree_util.tree_map(lambda x: x[None], out)
+
+        spec = lambda t: jax.tree_util.tree_map(
+            lambda _: P(self.dp_axis), t
+        )
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(spec(local_params),)
+            + tuple(spec(a) for a in batched_args),
+            out_specs=spec(local_params),
+            axis_names={self.dp_axis},
+        )(local_params, *batched_args)
+
+    # -- periodic outer sync ----------------------------------------------
     def apply(
-        self, mesh: Mesh, params: Any, anchor: Any, outer_mom: Any
+        self, mesh: Mesh, local_params: Any, anchor: Any, outer_mom: Any
     ) -> Tuple[Any, Any, Any]:
-        """One outer step: average deltas across 'dp', Nesterov update.
+        """One outer step: average per-replica drift over 'dp', Nesterov
+        update from the anchor.
 
-        params enter replica-divergent (each dp replica drifted for H inner
-        steps); leave identical on every replica."""
+        ``local_params`` is the stacked [n_dp, ...] form (replica-divergent);
+        ``anchor``/``outer_mom`` are dp-invariant.  Returns dp-invariant
+        (new_params, new_anchor, new_momentum) — re-:meth:`scatter` to
+        resume inner steps."""
 
-        def leaf_sync(p, a, m):
-            def body(p_l, a_l, m_l):
-                delta = a_l - p_l  # drift of this replica
+        def body(p_stack, a, m):
+            def leaf(p_l, a_l, m_l):
+                delta = a_l - p_l[0]  # this replica's drift
                 delta = jax.lax.pmean(delta, self.dp_axis)
                 new_m = self.outer_momentum * m_l + delta
                 step = self.outer_momentum * new_m + delta  # Nesterov
                 new_p = a_l - self.outer_lr * step
                 return new_p, new_m
 
-            return body(p, a, m)
-
-        def all_sync(params, anchor, mom):
-            flat_p, treedef = jax.tree_util.tree_flatten(params)
-            flat_a = jax.tree_util.tree_leaves(anchor)
-            flat_m = jax.tree_util.tree_leaves(mom)
+            flat_p, treedef = jax.tree_util.tree_flatten(p_stack)
+            flat_a = jax.tree_util.tree_leaves(a)
+            flat_m = jax.tree_util.tree_leaves(m)
             new_p, new_m = [], []
-            for p, a, mo in zip(flat_p, flat_a, flat_m):
-                np_, nm = leaf_sync(p, a, mo)
+            for p_l, a_l, m_l in zip(flat_p, flat_a, flat_m):
+                np_, nm = leaf(p_l, a_l, m_l)
                 new_p.append(np_)
                 new_m.append(nm)
             return (
@@ -74,17 +130,16 @@ class LocalSGDSync:
                 jax.tree_util.tree_unflatten(treedef, new_m),
             )
 
-        # Under shard_map over 'dp': params conceptually carry a per-replica
-        # value; callers hold them as arrays sharded P() within each replica
-        # but *divergent across replicas* — represent that by mapping over
-        # the dp axis with identity specs.
-        spec = jax.tree_util.tree_map(lambda _: P(), params)
+        stacked_spec = jax.tree_util.tree_map(
+            lambda _: P(self.dp_axis), local_params
+        )
+        flat_spec = jax.tree_util.tree_map(lambda _: P(), anchor)
         new_params, new_mom = jax.shard_map(
-            all_sync, mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=(spec, spec),
-            check_vma=False,
-        )(params, anchor, outer_mom)
+            body, mesh=mesh,
+            in_specs=(stacked_spec, flat_spec, flat_spec),
+            out_specs=(flat_spec, flat_spec),
+            axis_names={self.dp_axis},
+        )(local_params, anchor, outer_mom)
         new_anchor = jax.tree_util.tree_map(jnp.array, new_params)
         return new_params, new_anchor, new_mom
 

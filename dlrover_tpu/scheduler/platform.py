@@ -213,26 +213,46 @@ class InMemoryPlatform(PlatformClient):
         )
 
 
-class GkePlatform(PlatformClient):  # pragma: no cover - needs a cluster
+class GkePlatform(PlatformClient):
     """TPU node pods via the Kubernetes API (reference ``k8sClient :122``).
 
     Pod template: one pod per TPU-VM host with
     ``google.com/tpu: <chips_per_host>`` resource requests and the
     ``cloud.google.com/gke-tpu-topology`` selector; slice membership comes
-    from the hostname suffix.  Gated on the ``kubernetes`` package.
+    from the hostname suffix.  Gated on the ``kubernetes`` package unless
+    ``api``/``client_mod``/``watch_mod`` are injected — tests drive this
+    class through a fake API server (reference mocks ``k8sClient`` the same
+    way, ``python/tests/test_utils.py:296 mock_k8s_client``).
     """
 
-    def __init__(self, namespace: str = "default", image: str = ""):
-        try:
-            from kubernetes import client, config, watch  # type: ignore
-        except ImportError as e:  # keep import-time deps optional
-            raise RuntimeError(
-                "GkePlatform requires the 'kubernetes' package"
-            ) from e
-        config.load_incluster_config()
-        self._core = client.CoreV1Api()
-        self._watch_mod = watch
-        self._client_mod = client
+    def __init__(
+        self,
+        namespace: str = "default",
+        image: str = "",
+        api=None,
+        client_mod=None,
+        watch_mod=None,
+    ):
+        if api is not None:
+            self._core = api
+            self._client_mod = client_mod
+            self._watch_mod = watch_mod
+        else:  # pragma: no cover - needs the kubernetes package
+            try:
+                from kubernetes import client, config, watch  # type: ignore
+            except ImportError as e:  # keep import-time deps optional
+                raise RuntimeError(
+                    "GkePlatform requires the 'kubernetes' package"
+                ) from e
+            try:
+                config.load_incluster_config()
+            except Exception:  # noqa: BLE001 - not running inside a pod
+                # Dev-box path: fall back to the operator's kubeconfig
+                # (reference k8sClient supports both).
+                config.load_kube_config()
+            self._core = client.CoreV1Api()
+            self._watch_mod = watch
+            self._client_mod = client
         self._namespace = namespace
         self._image = image
 

@@ -1,0 +1,369 @@
+"""L1 control plane: the ElasticJob reconciler (k8s-operator equivalent).
+
+Parity with the reference Go operator
+(``go/operator/pkg/controllers/elasticjob_controller.go:1`` Reconcile loop,
+``controllers/master/master.go:1`` master-pod bootstrap,
+``scaleplan_controller.go`` ScalePlan application,
+``api/v1alpha1/elasticjob_types.go:39`` the ElasticJob/ReplicaSpec schema).
+
+TPU-first shape: instead of CRDs + controller-runtime, a small
+**level-triggered reconcile loop** over the :class:`PlatformClient` node
+table.  The desired state is a :class:`JobSpec`; the observed state is
+``platform.list_nodes()``; each :meth:`JobReconciler.reconcile_once` computes
+and applies the diff through the SAME platform client the master's scaler
+uses, so a test that kills an InMemory node and a GKE pod deletion exercise
+one code path.
+
+Master-first bootstrap: the job master node is created before any worker
+(reference ``master.go`` creates the master pod when the job is created) and
+workers are only launched once the master reports RUNNING.  The master's
+auto-scaler feeds back through :class:`~dlrover_tpu.master.scaler.
+ElasticJobScaler` plan files (the ScalePlan-CR analogue), which the
+reconciler consumes on each pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.scheduler.platform import PlatformClient, PlatformNode
+
+_LIVE = (NodeStatus.INITIAL, NodeStatus.PENDING, NodeStatus.RUNNING)
+_DEAD = (NodeStatus.FAILED, NodeStatus.DELETED)
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """Desired replicas of one node type (reference
+    ``elasticjob_types.go:39`` ReplicaSpec: replicas + restart policy)."""
+
+    count: int
+    resource: NodeResource = dataclasses.field(default_factory=NodeResource)
+    max_relaunch: int = 3
+
+
+@dataclasses.dataclass
+class JobSpec:
+    """Desired job state — the ElasticJob-CR analogue."""
+
+    job_name: str
+    replicas: Dict[str, ReplicaSpec]
+    with_master: bool = True
+    master_resource: NodeResource = dataclasses.field(
+        default_factory=NodeResource
+    )
+    master_max_relaunch: int = 2
+
+
+class JobPhase:
+    PENDING = "pending"          # master not yet running
+    RUNNING = "running"
+    COMPLETED = "completed"
+    FAILED = "failed"
+
+
+class JobReconciler:
+    """Owns desired replica state and drives the platform toward it.
+
+    One instance per job.  Thread-safe; run :meth:`reconcile_once` from a
+    test, or :meth:`start` for the watch-triggered background loop.
+    """
+
+    def __init__(
+        self,
+        spec: JobSpec,
+        platform: PlatformClient,
+        *,
+        plan_dir: Optional[str] = None,
+        resync_interval: float = 2.0,
+    ):
+        self.spec = spec
+        self.platform = platform
+        self.plan_dir = plan_dir
+        self.phase = JobPhase.PENDING
+        self._lock = threading.Lock()
+        self._next_id = 0
+        # (node_type, rank) -> relaunches consumed.
+        self._relaunches: Dict[Tuple[str, int], int] = {}
+        # Node names whose failure we've already answered with a relaunch.
+        self._handled_failures: set = set()
+        self._consumed_plans: set = set()
+        self._resync = resync_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- desired-state mutation (ScalePlan entry) ---------------------------
+    def set_replicas(self, node_type: str, count: int) -> None:
+        with self._lock:
+            if node_type in self.spec.replicas:
+                self.spec.replicas[node_type].count = max(0, count)
+            else:
+                self.spec.replicas[node_type] = ReplicaSpec(count=max(0, count))
+
+    def _consume_plan_files(self) -> None:
+        """Apply ScalePlan JSON specs emitted by
+        :class:`~dlrover_tpu.master.scaler.ElasticJobScaler` (the
+        ScalePlan-CR analogue, reference ``scaleplan_controller.go``)."""
+        if not self.plan_dir:
+            return
+        pattern = os.path.join(
+            self.plan_dir, f"{self.spec.job_name}-scaleplan-*.json"
+        )
+        for path in sorted(glob.glob(pattern)):
+            if path in self._consumed_plans:
+                continue
+            try:
+                with open(path) as f:
+                    plan = json.load(f)
+            except (OSError, ValueError):
+                continue
+            for ntype, group in plan.get("node_group_resources", {}).items():
+                self.set_replicas(ntype, int(group.get("count", 0)))
+                logger.info(
+                    "reconciler: plan %s -> %s=%d",
+                    os.path.basename(path), ntype, group.get("count"),
+                )
+            self._consumed_plans.add(path)
+
+    # -- observation helpers ------------------------------------------------
+    def _observe(self) -> Dict[str, List[PlatformNode]]:
+        by_type: Dict[str, List[PlatformNode]] = {}
+        for pn in self.platform.list_nodes():
+            by_type.setdefault(pn.node_type, []).append(pn)
+            self._next_id = max(self._next_id, pn.node_id + 1)
+        return by_type
+
+    def _alloc_id(self) -> int:
+        nid = self._next_id
+        self._next_id += 1
+        return nid
+
+    def _launch(
+        self, node_type: str, rank: int, resource: NodeResource,
+        max_relaunch: int,
+    ) -> PlatformNode:
+        node = Node(
+            node_type,
+            self._alloc_id(),
+            rank_index=rank,
+            config_resource=resource,
+            max_relaunch_count=max_relaunch,
+        )
+        pn = self.platform.create_node(node, self.spec.job_name)
+        logger.info(
+            "reconciler: launched %s (type=%s rank=%d)",
+            pn.name, node_type, rank,
+        )
+        return pn
+
+    # -- the reconcile pass --------------------------------------------------
+    def reconcile_once(self) -> Dict[str, int]:
+        """One level-triggered pass: observe, diff, act.  Returns a summary
+        ``{"launched": n, "removed": n}`` of the actions taken."""
+        self._consume_plan_files()
+        with self._lock:
+            return self._reconcile_locked()
+
+    def _reconcile_locked(self) -> Dict[str, int]:
+        if self.phase in (JobPhase.COMPLETED, JobPhase.FAILED):
+            return {"launched": 0, "removed": 0}
+        by_type = self._observe()
+        launched = removed = 0
+
+        # 1. Master bootstrap (reference master.go: master pod first).
+        if self.spec.with_master:
+            masters = by_type.get(NodeType.MASTER, [])
+            live = [m for m in masters if m.status in _LIVE]
+            if not live:
+                budget = self._relaunches.get((NodeType.MASTER, 0), 0)
+                if any(m.status == NodeStatus.FAILED for m in masters):
+                    if budget >= self.spec.master_max_relaunch:
+                        self.phase = JobPhase.FAILED
+                        logger.error(
+                            "reconciler: master exhausted %d relaunches",
+                            budget,
+                        )
+                        return {"launched": launched, "removed": removed}
+                    self._relaunches[(NodeType.MASTER, 0)] = budget + 1
+                self._launch(
+                    NodeType.MASTER, 0, self.spec.master_resource,
+                    self.spec.master_max_relaunch,
+                )
+                launched += 1
+                self.phase = JobPhase.PENDING
+                return {"launched": launched, "removed": removed}
+            if all(m.status != NodeStatus.RUNNING for m in live):
+                # Master scheduled but not up: workers wait.
+                self.phase = JobPhase.PENDING
+                return {"launched": launched, "removed": removed}
+        self.phase = JobPhase.RUNNING
+
+        # 2. Per-type replica reconciliation.
+        all_done = bool(self.spec.replicas)
+        for ntype, rspec in self.spec.replicas.items():
+            nodes = by_type.get(ntype, [])
+            live = [n for n in nodes if n.status in _LIVE]
+            succeeded = [
+                n for n in nodes if n.status == NodeStatus.SUCCEEDED
+            ]
+            if len(succeeded) < rspec.count:
+                all_done = False
+            live_ranks = {n.rank_index for n in live}
+            done_ranks = {n.rank_index for n in succeeded}
+
+            # 2a. Relaunch failed nodes (same rank, new id) within budget.
+            for n in nodes:
+                if n.status != NodeStatus.FAILED:
+                    continue
+                if n.name in self._handled_failures:
+                    continue
+                self._handled_failures.add(n.name)
+                if (
+                    n.rank_index in live_ranks
+                    or n.rank_index in done_ranks
+                    or n.rank_index >= rspec.count
+                ):
+                    continue  # rank already covered or scaled away
+                key = (ntype, n.rank_index)
+                used = self._relaunches.get(key, 0)
+                if used >= rspec.max_relaunch:
+                    self.phase = JobPhase.FAILED
+                    logger.error(
+                        "reconciler: %s rank %d exhausted %d relaunches",
+                        ntype, n.rank_index, used,
+                    )
+                    return {"launched": launched, "removed": removed}
+                self._relaunches[key] = used + 1
+                self._launch(
+                    ntype, n.rank_index, rspec.resource, rspec.max_relaunch
+                )
+                live_ranks.add(n.rank_index)
+                launched += 1
+
+            # 2b. Scale up: fill missing ranks [0, count).
+            covered = live_ranks | done_ranks
+            for rank in range(rspec.count):
+                if rank in covered:
+                    continue
+                self._launch(ntype, rank, rspec.resource, rspec.max_relaunch)
+                covered.add(rank)
+                launched += 1
+
+            # 2c. Scale down: remove live nodes with rank >= count
+            # (highest first, keeping surviving ranks contiguous).
+            extras = sorted(
+                (n for n in live if n.rank_index >= rspec.count),
+                key=lambda n: -n.rank_index,
+            )
+            for n in extras:
+                if self.platform.delete_node(n.name):
+                    logger.info("reconciler: removed %s", n.name)
+                    removed += 1
+
+        # 3. Completion: every replica rank succeeded.
+        if all_done:
+            self.phase = JobPhase.COMPLETED
+            logger.info("reconciler: job %s completed", self.spec.job_name)
+        return {"launched": launched, "removed": removed}
+
+    # -- background loop ----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="job-reconciler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        # Level-triggered with watch acceleration: a platform event only
+        # wakes the loop early; every pass re-lists the world.
+        wake = threading.Event()
+
+        def watcher():
+            try:
+                for _ in self.platform.watch(self._stop):
+                    wake.set()
+            except Exception:  # noqa: BLE001 - watch streams may drop
+                logger.exception("reconciler watch stream ended")
+
+        wt = threading.Thread(target=watcher, daemon=True)
+        wt.start()
+        while not self._stop.is_set():
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("reconcile pass failed")
+            if self.phase in (JobPhase.COMPLETED, JobPhase.FAILED):
+                return
+            wake.wait(timeout=self._resync)
+            wake.clear()
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin CLI shell
+    """Standalone operator process: ``python -m
+    dlrover_tpu.scheduler.reconciler --job_name j --workers 4 --platform gke``
+    (the deployment analogue of the reference's operator Deployment)."""
+    import argparse
+
+    from dlrover_tpu.scheduler.platform import new_platform_client
+
+    p = argparse.ArgumentParser("dlrover-tpu-operator")
+    p.add_argument("--job_name", required=True)
+    p.add_argument("--workers", type=int, required=True)
+    p.add_argument("--platform", default="gke")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--image", default="")
+    p.add_argument("--plan_dir", default="")
+    p.add_argument("--max_relaunch", type=int, default=3)
+    p.add_argument("--tpu_chips", type=int, default=4)
+    args = p.parse_args(argv)
+
+    kwargs = (
+        {"namespace": args.namespace, "image": args.image}
+        if args.platform == "gke"
+        else {}
+    )
+    platform = new_platform_client(args.platform, **kwargs)
+    spec = JobSpec(
+        job_name=args.job_name,
+        replicas={
+            NodeType.WORKER: ReplicaSpec(
+                count=args.workers,
+                resource=NodeResource(tpu_chips=args.tpu_chips),
+                max_relaunch=args.max_relaunch,
+            )
+        },
+    )
+    rec = JobReconciler(
+        spec, platform, plan_dir=args.plan_dir or None
+    )
+    rec.start()
+    try:
+        while rec.phase not in (JobPhase.COMPLETED, JobPhase.FAILED):
+            rec._stop.wait(2.0)
+            if rec._stop.is_set():
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        rec.stop()
+    return 0 if rec.phase == JobPhase.COMPLETED else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

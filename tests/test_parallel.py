@@ -16,6 +16,14 @@ from dlrover_tpu.parallel.accelerate import (
     infer_param_specs,
 )
 from dlrover_tpu.parallel.mesh import MeshSpec, build_mesh, candidate_specs
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 from dlrover_tpu.parallel.sharding import (
     DEFAULT_RULES,
     logical_to_spec,
@@ -408,17 +416,110 @@ class TestPipeline:
 
 
 class TestLocalSGD:
-    def test_diloco_sync_converges_replicas(self, cpu_mesh_devices):
+    def test_diloco_sync_with_divergent_replicas(self, cpu_mesh_devices):
+        """Replica-divergent state is held as a stacked P('dp') array, so
+        the replication checker stays ON (no check_vma escape)."""
         from dlrover_tpu.parallel.local_sgd import LocalSGDSync
 
         mesh = Mesh(np.array(cpu_mesh_devices[:4]), ("dp",))
         sync = LocalSGDSync(outer_lr=1.0, outer_momentum=0.0, dp_axis="dp")
         params = {"w": jnp.ones((4, 4))}
         anchor, mom = sync.init(params)
-        # Simulate divergent replicas: shard_map sees per-replica values;
-        # here all replicas drifted identically by -0.5 => delta = +0.5.
-        drifted = {"w": params["w"] - 0.5}
-        new_p, new_anchor, new_m = sync.apply(mesh, drifted, anchor, mom)
+        local = sync.scatter(mesh, params)
+        assert local["w"].shape == (4, 4, 4)
+
+        # Each replica drifts by a DIFFERENT amount: replica r subtracts
+        # (r+1)*0.1, so mean drift = 0.25 and new params = 1 - 0.25.
+        drifts = jnp.arange(1, 5, dtype=jnp.float32) * 0.1
+
+        def inner(p, d):
+            return {"w": p["w"] - d}
+
+        local = sync.inner_apply(mesh, inner, local, drifts)
+        new_p, new_anchor, new_m = sync.apply(mesh, local, anchor, mom)
         np.testing.assert_allclose(
-            np.asarray(new_p["w"]), np.full((4, 4), 0.5), atol=1e-6
+            np.asarray(new_p["w"]), np.full((4, 4), 0.75), atol=1e-6
         )
+        np.testing.assert_allclose(
+            np.asarray(new_anchor["w"]), np.asarray(new_p["w"])
+        )
+        # Momentum accumulated the mean delta.
+        np.testing.assert_allclose(
+            np.asarray(new_m["w"]), np.full((4, 4), 0.25), atol=1e-6
+        )
+
+    def test_diloco_inner_steps_stay_local(self, cpu_mesh_devices):
+        """inner_apply must not introduce cross-replica collectives: the
+        jaxpr of the lowered step contains no psum/pmean over dp."""
+        from dlrover_tpu.parallel.local_sgd import LocalSGDSync
+
+        mesh = Mesh(np.array(cpu_mesh_devices[:2]), ("dp",))
+        sync = LocalSGDSync(dp_axis="dp")
+        params = {"w": jnp.ones((2, 2))}
+        local = sync.scatter(mesh, params)
+        batches = jnp.ones((2, 4, 2))
+
+        def inner(p, b):
+            g = jax.grad(lambda w: jnp.sum((b @ w) ** 2))(p["w"])
+            return {"w": p["w"] - 0.01 * g}
+
+        lowered = jax.jit(
+            lambda lp, bb: sync.inner_apply(mesh, inner, lp, bb)
+        ).lower(local, batches)
+        text = lowered.as_text()
+        assert "all-reduce" not in text and "all-gather" not in text, (
+            "inner step leaked a cross-replica collective"
+        )
+
+    def test_diloco_sync_multiprocess(self, tmp_path):
+        """Two real OS processes under jax.distributed, one CPU device
+        each, forming a global dp=2 mesh: both must agree on the synced
+        parameters (reference outer_optim_model_averager 2-rank DDP test).
+        """
+        import subprocess
+        import sys
+
+        port = _free_port()
+        script = r"""
+import os, sys
+import numpy as np
+pid = int(sys.argv[1]); coord = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.distributed.initialize(coord, num_processes=2, process_id=pid)
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from dlrover_tpu.parallel.local_sgd import LocalSGDSync
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+sync = LocalSGDSync(outer_lr=1.0, outer_momentum=0.0)
+params = {"w": jnp.ones((2, 2))}
+anchor, mom = sync.init(params)
+local = sync.scatter(mesh, params)
+# Divergent inner drift: process r subtracts (r+1)*0.2 from its slice.
+drifts = jnp.arange(1, 3, dtype=jnp.float32) * 0.2
+local = sync.inner_apply(
+    mesh, lambda p, d: {"w": p["w"] - d}, local, drifts
+)
+new_p, _, _ = sync.apply(mesh, local, anchor, mom)
+got = np.asarray(jax.device_get(new_p["w"]))
+np.testing.assert_allclose(got, np.full((2, 2), 0.7), atol=1e-6)
+print(f"RESULT {pid} {got[0,0]:.6f}")
+"""
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ, "PYTHONPATH": repo}
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", script, str(i), f"127.0.0.1:{port}"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=repo, env=env,
+            )
+            for i in range(2)
+        ]
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"proc {i} failed:\n{out}"
+            assert f"RESULT {i} 0.700000" in out, out

@@ -1,0 +1,401 @@
+"""L1 control-plane tests: JobReconciler driving GkePlatform against a fake
+Kubernetes API server (test model: the reference's mocked ``k8sClient``,
+``dlrover/python/tests/test_utils.py:296``, and the Go operator's
+envtest-based controller tests)."""
+
+import queue
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.scaler import ElasticJobScaler, ScalePlan
+from dlrover_tpu.scheduler.platform import GkePlatform, InMemoryPlatform
+from dlrover_tpu.scheduler.reconciler import (
+    JobPhase,
+    JobReconciler,
+    JobSpec,
+    ReplicaSpec,
+)
+
+
+# ---------------------------------------------------------------------------
+# Fake kubernetes API (the shapes GkePlatform actually touches)
+# ---------------------------------------------------------------------------
+
+
+class _FakeClientMod:
+    """Stand-ins for the kubernetes.client model classes."""
+
+    class V1ObjectMeta(SimpleNamespace):
+        def __init__(self, name=None, labels=None):
+            super().__init__(name=name, labels=labels or {})
+
+    class V1ResourceRequirements(SimpleNamespace):
+        def __init__(self, limits=None):
+            super().__init__(limits=limits or {})
+
+    class V1Container(SimpleNamespace):
+        def __init__(self, name=None, image=None, resources=None):
+            super().__init__(name=name, image=image, resources=resources)
+
+    class V1PodSpec(SimpleNamespace):
+        def __init__(self, restart_policy=None, containers=None):
+            super().__init__(
+                restart_policy=restart_policy, containers=containers or []
+            )
+
+    class V1Pod(SimpleNamespace):
+        def __init__(self, metadata=None, spec=None):
+            super().__init__(
+                metadata=metadata,
+                spec=spec,
+                status=SimpleNamespace(phase="Pending", pod_ip=""),
+            )
+
+
+class FakeKubeApi:
+    """In-memory pod store with the CoreV1Api surface GkePlatform uses,
+    plus fault-injection (``set_phase``) for tests."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pods = {}
+        self.events = queue.Queue()
+        self.create_count = 0
+
+    @staticmethod
+    def _snapshot(pod):
+        """Real watches deliver object snapshots, not live references."""
+        return SimpleNamespace(
+            metadata=SimpleNamespace(
+                name=pod.metadata.name, labels=dict(pod.metadata.labels)
+            ),
+            spec=pod.spec,
+            status=SimpleNamespace(
+                phase=pod.status.phase, pod_ip=pod.status.pod_ip
+            ),
+        )
+
+    def create_namespaced_pod(self, namespace, pod):
+        with self._lock:
+            name = pod.metadata.name
+            if name in self.pods:
+                raise RuntimeError(f"409 pod {name} already exists")
+            self.pods[name] = pod
+            self.create_count += 1
+            self.events.put(("ADDED", self._snapshot(pod)))
+        return pod
+
+    def delete_namespaced_pod(self, name, namespace):
+        with self._lock:
+            pod = self.pods.pop(name, None)
+            if pod is None:
+                raise RuntimeError(f"404 pod {name} not found")
+            self.events.put(("DELETED", self._snapshot(pod)))
+        return pod
+
+    def list_namespaced_pod(self, namespace):
+        with self._lock:
+            return SimpleNamespace(items=list(self.pods.values()))
+
+    # -- fault injection ----------------------------------------------------
+    def set_phase(self, name, phase, pod_ip="10.0.0.1"):
+        with self._lock:
+            pod = self.pods[name]
+            pod.status.phase = phase
+            pod.status.pod_ip = pod_ip
+            self.events.put(("MODIFIED", self._snapshot(pod)))
+
+    def set_all(self, phase, node_type=None):
+        with self._lock:
+            names = [
+                n for n, p in self.pods.items()
+                if node_type is None
+                or p.metadata.labels.get("node-type") == node_type
+            ]
+        for n in names:
+            self.set_phase(n, phase)
+
+
+class _FakeWatchMod:
+    class Watch:
+        def __init__(self):
+            self._stopped = False
+
+        def stream(self, list_fn, namespace):
+            api = list_fn.__self__
+            while not self._stopped:
+                try:
+                    etype, pod = api.events.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                yield {"type": etype, "object": pod}
+
+        def stop(self):
+            self._stopped = True
+
+
+def make_gke():
+    api = FakeKubeApi()
+    platform = GkePlatform(
+        namespace="test", image="img",
+        api=api, client_mod=_FakeClientMod, watch_mod=_FakeWatchMod,
+    )
+    return api, platform
+
+
+# ---------------------------------------------------------------------------
+# GkePlatform against the fake API
+# ---------------------------------------------------------------------------
+
+
+class TestGkePlatform:
+    def test_create_list_delete(self):
+        api, platform = make_gke()
+        node = Node(
+            NodeType.WORKER, 3, rank_index=1,
+            config_resource=NodeResource(tpu_chips=4),
+        )
+        pn = platform.create_node(node, "jobx")
+        assert pn.name == "jobx-worker-3"
+        pod = api.pods["jobx-worker-3"]
+        assert pod.metadata.labels["rank-index"] == "1"
+        limits = pod.spec.containers[0].resources.limits
+        assert limits["google.com/tpu"] == "4"
+
+        api.set_phase("jobx-worker-3", "Running")
+        nodes = platform.list_nodes()
+        assert len(nodes) == 1
+        assert nodes[0].status == NodeStatus.RUNNING
+        assert nodes[0].node_id == 3 and nodes[0].rank_index == 1
+
+        assert platform.delete_node("jobx-worker-3")
+        assert not platform.delete_node("jobx-worker-3")
+        assert platform.list_nodes() == []
+
+    def test_watch_streams_events(self):
+        api, platform = make_gke()
+        stop = threading.Event()
+        got = []
+
+        def consume():
+            for ev in platform.watch(stop):
+                got.append((ev.event_type, ev.node.name, ev.node.status))
+                if len(got) >= 2:
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        platform.create_node(Node(NodeType.WORKER, 0, rank_index=0), "jobx")
+        api.set_phase("jobx-worker-0", "Failed")
+        t.join(timeout=5.0)
+        stop.set()
+        assert ("added", "jobx-worker-0", NodeStatus.PENDING) in got
+        assert ("modified", "jobx-worker-0", NodeStatus.FAILED) in got
+
+
+# ---------------------------------------------------------------------------
+# JobReconciler
+# ---------------------------------------------------------------------------
+
+
+def make_reconciler(n_workers=2, plan_dir=None, max_relaunch=2):
+    api, platform = make_gke()
+    spec = JobSpec(
+        job_name="jobx",
+        replicas={
+            NodeType.WORKER: ReplicaSpec(
+                count=n_workers, max_relaunch=max_relaunch
+            )
+        },
+    )
+    rec = JobReconciler(spec, platform, plan_dir=plan_dir)
+    return api, platform, rec
+
+
+class TestJobReconciler:
+    def test_master_first_bootstrap(self):
+        api, platform, rec = make_reconciler(n_workers=2)
+        # Pass 1: only the master is created; workers wait.
+        summary = rec.reconcile_once()
+        assert summary["launched"] == 1
+        assert list(api.pods) == ["jobx-master-0"]
+        assert rec.phase == JobPhase.PENDING
+        # Master pending (not yet running): still no workers.
+        rec.reconcile_once()
+        assert len(api.pods) == 1
+        # Master up: workers launch, ranks 0..n-1.
+        api.set_phase("jobx-master-0", "Running")
+        summary = rec.reconcile_once()
+        assert summary["launched"] == 2
+        assert rec.phase == JobPhase.RUNNING
+        ranks = sorted(
+            int(p.metadata.labels["rank-index"])
+            for p in api.pods.values()
+            if p.metadata.labels["node-type"] == NodeType.WORKER
+        )
+        assert ranks == [0, 1]
+        # Steady state: reconcile is a no-op.
+        assert rec.reconcile_once() == {"launched": 0, "removed": 0}
+
+    def test_failed_worker_relaunched_same_rank(self):
+        api, platform, rec = make_reconciler(n_workers=2)
+        rec.reconcile_once()
+        api.set_phase("jobx-master-0", "Running")
+        rec.reconcile_once()
+        api.set_all("Running", node_type=NodeType.WORKER)
+
+        api.set_phase("jobx-worker-2", "Failed")  # rank 1 (ids 1,2)
+        rank = int(api.pods["jobx-worker-2"].metadata.labels["rank-index"])
+        summary = rec.reconcile_once()
+        assert summary["launched"] == 1
+        replacement = [
+            p for p in api.pods.values()
+            if p.metadata.labels["node-type"] == NodeType.WORKER
+            and p.status.phase == "Pending"
+        ]
+        assert len(replacement) == 1
+        assert int(replacement[0].metadata.labels["rank-index"]) == rank
+        # New pod, new node id — never reuses the dead pod's name.
+        assert replacement[0].metadata.name != "jobx-worker-2"
+        # The dead pod's failure is answered exactly once.
+        assert rec.reconcile_once()["launched"] == 0
+
+    def test_relaunch_budget_exhaustion_fails_job(self):
+        api, platform, rec = make_reconciler(n_workers=1, max_relaunch=1)
+        rec.reconcile_once()
+        api.set_phase("jobx-master-0", "Running")
+        rec.reconcile_once()
+
+        def fail_running_worker():
+            for name, p in list(api.pods.items()):
+                if (
+                    p.metadata.labels["node-type"] == NodeType.WORKER
+                    and p.status.phase in ("Pending", "Running")
+                ):
+                    api.set_phase(name, "Failed")
+
+        fail_running_worker()
+        rec.reconcile_once()  # relaunch 1/1
+        assert rec.phase == JobPhase.RUNNING
+        fail_running_worker()
+        rec.reconcile_once()  # budget exhausted
+        assert rec.phase == JobPhase.FAILED
+
+    def test_scale_plan_files_applied(self, tmp_path):
+        api, platform, rec = make_reconciler(
+            n_workers=2, plan_dir=str(tmp_path)
+        )
+        rec.reconcile_once()
+        api.set_phase("jobx-master-0", "Running")
+        rec.reconcile_once()
+        api.set_all("Running", node_type=NodeType.WORKER)
+
+        # The master's auto-scaler emits a ScalePlan spec (CR analogue).
+        scaler = ElasticJobScaler("jobx", str(tmp_path))
+        scaler.scale(
+            ScalePlan(
+                node_group_resources={
+                    NodeType.WORKER: NodeGroupResource(
+                        count=3, node_resource=NodeResource()
+                    )
+                }
+            )
+        )
+        summary = rec.reconcile_once()
+        assert summary["launched"] == 1
+        workers = [
+            p for p in api.pods.values()
+            if p.metadata.labels["node-type"] == NodeType.WORKER
+        ]
+        assert len(workers) == 3
+        # Scale back down to 1: the two highest ranks are removed.
+        scaler.scale(
+            ScalePlan(
+                node_group_resources={
+                    NodeType.WORKER: NodeGroupResource(
+                        count=1, node_resource=NodeResource()
+                    )
+                }
+            )
+        )
+        summary = rec.reconcile_once()
+        assert summary["removed"] == 2
+        ranks = [
+            int(p.metadata.labels["rank-index"])
+            for p in api.pods.values()
+            if p.metadata.labels["node-type"] == NodeType.WORKER
+        ]
+        assert ranks == [0]
+
+    def test_job_completion(self):
+        api, platform, rec = make_reconciler(n_workers=2)
+        rec.reconcile_once()
+        api.set_phase("jobx-master-0", "Running")
+        rec.reconcile_once()
+        api.set_all("Succeeded", node_type=NodeType.WORKER)
+        rec.reconcile_once()
+        assert rec.phase == JobPhase.COMPLETED
+        # Terminal: no further action even if pods vanish.
+        api.pods.clear()
+        assert rec.reconcile_once() == {"launched": 0, "removed": 0}
+
+    def test_background_loop_relaunches_on_watch_event(self):
+        api, platform, rec = make_reconciler(n_workers=1)
+        rec._resync = 0.2
+        rec.start()
+        try:
+            deadline = time.time() + 10
+            while "jobx-master-0" not in api.pods and time.time() < deadline:
+                time.sleep(0.05)
+            api.set_phase("jobx-master-0", "Running")
+            while (
+                len(api.pods) < 2 and time.time() < deadline
+            ):
+                time.sleep(0.05)
+            api.set_all("Running", node_type=NodeType.WORKER)
+            # Kill the worker; the watch-triggered loop must replace it.
+            worker = [
+                n for n, p in api.pods.items()
+                if p.metadata.labels["node-type"] == NodeType.WORKER
+            ][0]
+            api.set_phase(worker, "Failed")
+            ok = False
+            while time.time() < deadline:
+                live = [
+                    p for p in api.pods.values()
+                    if p.metadata.labels["node-type"] == NodeType.WORKER
+                    and p.status.phase in ("Pending", "Running")
+                ]
+                if live:
+                    ok = True
+                    break
+                time.sleep(0.05)
+            assert ok, "reconciler loop did not relaunch the dead worker"
+        finally:
+            rec.stop()
+
+    def test_reconciler_on_inmemory_platform(self):
+        """Same reconciler code path over the InMemory platform (local
+        dev / e2e substrate)."""
+        platform = InMemoryPlatform()
+        spec = JobSpec(
+            job_name="jobl",
+            replicas={NodeType.WORKER: ReplicaSpec(count=2)},
+            with_master=False,
+        )
+        rec = JobReconciler(spec, platform)
+        assert rec.reconcile_once()["launched"] == 2
+        assert rec.phase == JobPhase.RUNNING
+        name = platform.list_nodes()[0].name
+        platform.fail_node(name)
+        assert rec.reconcile_once()["launched"] == 1
+        live = [
+            n for n in platform.list_nodes()
+            if n.status in (NodeStatus.PENDING, NodeStatus.RUNNING)
+        ]
+        assert len(live) == 2
