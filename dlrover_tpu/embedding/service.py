@@ -270,39 +270,53 @@ class DistributedEmbedding:
         norm = {_norm_addr(a): r for r, a in enumerate(new_addrs)}
         moved = 0
         deletes = []  # (source client, keys) to apply after the switch
-        for c in old_clients:
-            resp = c.call(
-                m.EmbeddingOp(table=self.table, op="export", world=1)
-            )
-            if not resp.success or not resp.blob:
-                continue
-            rb = 24 + 12 * self.dim
-            arr = np.frombuffer(resp.blob, np.uint8).reshape(-1, rb)
-            keys = arr[:, :8].copy().view(np.int64).reshape(-1)
-            owners = _owner(keys, len(new_clients))
-            src_rank = norm.get(_norm_addr(c.addr), -1)
-            for r in range(len(new_clients)):
-                if r == src_rank:
-                    continue  # already on its new owner
-                idx = np.nonzero(owners == r)[0]
-                if len(idx) == 0:
-                    continue
-                resp_imp = new_clients[r].call(
-                    m.EmbeddingOp(
-                        table=self.table, op="import",
-                        blob=arr[idx].tobytes(),
-                        optimizer={"dim": self.dim},
-                    )
+        try:
+            for c in old_clients:
+                resp = c.call(
+                    m.EmbeddingOp(table=self.table, op="export", world=1)
                 )
-                if not resp_imp.success:
-                    for nc in new_clients:
-                        nc.close()
+                if not resp.success:
+                    # NOT the same as an empty table: this server's rows
+                    # are unaccounted for — flipping routing would lose
+                    # them all.  Keep old routing and surface the error.
                     raise RuntimeError(
-                        f"rebalance copy to server {r} failed (old routing "
-                        f"kept, no rows lost): {resp_imp.reason}"
+                        f"rebalance export from {c.addr} failed (old "
+                        f"routing kept, no rows lost): {resp.reason}"
                     )
-                deletes.append((c, keys[idx]))
-                moved += len(idx)
+                if not resp.blob:
+                    continue  # genuinely empty source
+                rb = 24 + 12 * self.dim
+                arr = np.frombuffer(resp.blob, np.uint8).reshape(-1, rb)
+                keys = arr[:, :8].copy().view(np.int64).reshape(-1)
+                owners = _owner(keys, len(new_clients))
+                src_rank = norm.get(_norm_addr(c.addr), -1)
+                for r in range(len(new_clients)):
+                    if r == src_rank:
+                        continue  # already on its new owner
+                    idx = np.nonzero(owners == r)[0]
+                    if len(idx) == 0:
+                        continue
+                    resp_imp = new_clients[r].call(
+                        m.EmbeddingOp(
+                            table=self.table, op="import",
+                            blob=arr[idx].tobytes(),
+                            optimizer={"dim": self.dim},
+                        )
+                    )
+                    if not resp_imp.success:
+                        raise RuntimeError(
+                            f"rebalance copy to server {r} failed (old "
+                            f"routing kept, no rows lost): "
+                            f"{resp_imp.reason}"
+                        )
+                    deletes.append((c, keys[idx]))
+                    moved += len(idx)
+        except BaseException:
+            # Phase 1 failed (app-level or transport): nothing was deleted,
+            # old routing stands — just don't leak the new channels.
+            for nc in new_clients:
+                nc.close()
+            raise
 
         # Phase 2: all copies landed — flip routing, then clean sources.
         self._clients = new_clients

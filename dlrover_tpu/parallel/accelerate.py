@@ -98,6 +98,7 @@ class AcceleratedJob:
     state_sharding: Any
     batch_sharding: Any
     cost: Optional[dict] = None
+    abstract_batch: Any = None  # ShapeDtypeStruct tree of the sample batch
 
 
 def _build_train_step(
@@ -173,6 +174,8 @@ def accelerate(
     devices: Optional[Sequence] = None,
     profile_steps: int = 0,  # >0: time real steps (DRYRUN), else cost model
     grad_accum: Optional[int] = None,  # force on every candidate
+    search_evals: int = 10,  # strategy="bo": timed-dry-run budget
+    cache: Union[None, str, Any] = None,  # StrategyCache or its path
 ) -> AcceleratedJob:
     devs = list(devices) if devices is not None else jax.devices()
     n = len(devs)
@@ -183,6 +186,15 @@ def accelerate(
         candidates = [
             Strategy(mesh=s) for s in candidate_specs(n)
         ]
+    elif isinstance(strategy, str) and strategy == "bo":
+        best = search(
+            loss_fn=loss_fn, init_fn=init_fn, optimizer=optimizer,
+            sample_batch=sample_batch, param_specs=param_specs,
+            batch_axes=batch_axes, devices=devs,
+            profile_steps=max(2, profile_steps), max_evals=search_evals,
+            grad_accum=grad_accum, cache=cache,
+        )
+        candidates = [best]
     else:
         candidates = list(strategy)
     if grad_accum is not None:
@@ -307,7 +319,80 @@ def _compile_candidate(
         state_sharding=state_sharding,
         batch_sharding=batch_sharding,
         cost=cost,
+        abstract_batch=abstract_batch,
     )
+
+
+def search(
+    *,
+    loss_fn: Callable,
+    init_fn: Callable,
+    optimizer,
+    sample_batch: Any,
+    param_specs: Union[None, Any, Callable[[Strategy], Any]] = None,
+    batch_axes: Optional[Any] = None,
+    devices: Optional[Sequence] = None,
+    profile_steps: int = 3,
+    max_evals: int = 10,
+    grad_accum: Optional[int] = None,
+    warm_start: Sequence[Strategy] = (),
+    cache: Union[None, str, Any] = None,
+) -> Strategy:
+    """Bayesian strategy search with a timed-dry-run objective and a
+    persistent cache (reference ``bayes_opt_sg.py`` + strategy save/load).
+
+    Each objective evaluation compiles the candidate end-to-end and times
+    ``profile_steps`` real steps; a GP-EI loop spends at most ``max_evals``
+    evaluations.  When ``cache`` is given (a path or StrategyCache), a hit
+    on the (model, batch, topology) fingerprint skips the search — this is
+    what makes elastic restarts cheap."""
+    from dlrover_tpu.parallel.strategy_search import (
+        BayesStrategySearch,
+        StrategyCache,
+        default_space,
+        fingerprint,
+    )
+
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    cache_obj = (
+        StrategyCache(cache) if isinstance(cache, str) else cache
+    )
+    params_shape = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    opt_shape = jax.eval_shape(optimizer.init, params_shape)
+    fp = fingerprint(params_shape, sample_batch, n, opt_shape)
+    if cache_obj is not None:
+        hit = cache_obj.get(fp)
+        if hit is not None:
+            logger.info(
+                "strategy search: cache hit %s -> %s", fp, hit.describe()
+            )
+            return hit
+
+    def objective(s: Strategy) -> float:
+        if grad_accum is not None:
+            s = dataclasses.replace(s, grad_accum=grad_accum)
+        job = _compile_candidate(
+            s, loss_fn, init_fn, optimizer, sample_batch,
+            param_specs, batch_axes, devs,
+        )
+        return _score(job, profile_steps, init_fn)
+
+    # A forced grad_accum collapses the accum dimension of the space —
+    # otherwise 3 grid points per (mesh, remat) are one effective strategy
+    # and the search would pay for (and the GP would see) duplicates.
+    space = (
+        default_space(n, accum=(grad_accum,))
+        if grad_accum is not None
+        else default_space(n)
+    )
+    result = BayesStrategySearch(
+        objective, space,
+        max_evals=max_evals, warm_start=list(warm_start),
+    ).run()
+    if cache_obj is not None:
+        cache_obj.put(fp, result.best)
+    return result.best
 
 
 def _score(job: AcceleratedJob, profile_steps: int, init_fn) -> float:
@@ -317,11 +402,11 @@ def _score(job: AcceleratedJob, profile_steps: int, init_fn) -> float:
     if profile_steps > 0:
         state = job.create_state(jax.random.PRNGKey(0))
         batch = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype),
-            jax.tree_util.tree_map(
-                lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
-                job.batch_sharding,
+            lambda s, sh: jax.device_put(
+                jnp.zeros(s.shape, s.dtype), sh
             ),
+            job.abstract_batch,
+            job.batch_sharding,
         )
         # warmup + timed
         state, _ = job.train_step(state, batch)

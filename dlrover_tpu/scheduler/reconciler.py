@@ -206,8 +206,10 @@ class JobReconciler:
                 return {"launched": launched, "removed": removed}
         self.phase = JobPhase.RUNNING
 
-        # 2. Per-type replica reconciliation.
-        all_done = bool(self.spec.replicas)
+        # 2. Per-type replica reconciliation.  Completion requires at least
+        # one type that actually wants replicas — a job scaled to 0 (pause)
+        # must stay reconcilable, not flip to terminal COMPLETED.
+        all_done = any(r.count > 0 for r in self.spec.replicas.values())
         for ntype, rspec in self.spec.replicas.items():
             nodes = by_type.get(ntype, [])
             live = [n for n in nodes if n.status in _LIVE]
