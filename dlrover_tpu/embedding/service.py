@@ -321,22 +321,25 @@ class DistributedEmbedding:
         # Phase 2: all copies landed — flip routing, then clean sources.
         self._clients = new_clients
         failed = []
-        for c, dkeys in deletes:
-            resp_del = c.call(
-                m.EmbeddingOp(
-                    table=self.table, op="delete", keys=dkeys.tobytes()
-                )
-            )
-            if not resp_del.success:  # one bounded retry
+        try:
+            for c, dkeys in deletes:
                 resp_del = c.call(
                     m.EmbeddingOp(
                         table=self.table, op="delete", keys=dkeys.tobytes()
                     )
                 )
-            if not resp_del.success:
-                failed.append((c.addr, len(dkeys), resp_del.reason))
-        for c in old_clients:
-            c.close()  # new_clients hold their own channels
+                if not resp_del.success:  # one bounded retry
+                    resp_del = c.call(
+                        m.EmbeddingOp(
+                            table=self.table, op="delete",
+                            keys=dkeys.tobytes(),
+                        )
+                    )
+                if not resp_del.success:
+                    failed.append((c.addr, len(dkeys), resp_del.reason))
+        finally:
+            for c in old_clients:
+                c.close()  # new_clients hold their own channels
         if failed:
             raise RuntimeError(
                 "rebalance moved all rows but could not delete stale "

@@ -187,13 +187,21 @@ def accelerate(
             Strategy(mesh=s) for s in candidate_specs(n)
         ]
     elif isinstance(strategy, str) and strategy == "bo":
+        job_out: dict = {}
         best = search(
             loss_fn=loss_fn, init_fn=init_fn, optimizer=optimizer,
             sample_batch=sample_batch, param_specs=param_specs,
             batch_axes=batch_axes, devices=devs,
             profile_steps=max(2, profile_steps), max_evals=search_evals,
-            grad_accum=grad_accum, cache=cache,
+            grad_accum=grad_accum, cache=cache, job_out=job_out,
         )
+        if job_out.get("job") is not None:
+            # The search already compiled (and timed) the winner — don't
+            # pay a second XLA lower+compile for the same strategy.
+            logger.info(
+                "accelerate: selected %s (from search)", best.describe()
+            )
+            return job_out["job"]
         candidates = [best]
     else:
         candidates = list(strategy)
@@ -337,6 +345,7 @@ def search(
     grad_accum: Optional[int] = None,
     warm_start: Sequence[Strategy] = (),
     cache: Union[None, str, Any] = None,
+    job_out: Optional[dict] = None,
 ) -> Strategy:
     """Bayesian strategy search with a timed-dry-run objective and a
     persistent cache (reference ``bayes_opt_sg.py`` + strategy save/load).
@@ -344,13 +353,23 @@ def search(
     Each objective evaluation compiles the candidate end-to-end and times
     ``profile_steps`` real steps; a GP-EI loop spends at most ``max_evals``
     evaluations.  When ``cache`` is given (a path or StrategyCache), a hit
-    on the (model, batch, topology) fingerprint skips the search — this is
-    what makes elastic restarts cheap."""
+    on the (model, optimizer, batch, topology) fingerprint skips the
+    search — this is what makes elastic restarts cheap.
+
+    Multi-process SPMD: timings differ per process, so letting every
+    process search independently would pick different candidates and hang
+    the first mismatched collective.  Only JAX process 0 searches; the
+    winner is broadcast to all (the reference runs its tuner on one
+    coordinator for the same reason).  ``job_out``, when provided, receives
+    the winner's already-compiled :class:`AcceleratedJob` under ``"job"``
+    if one is available locally."""
     from dlrover_tpu.parallel.strategy_search import (
         BayesStrategySearch,
         StrategyCache,
         default_space,
         fingerprint,
+        strategy_from_dict,
+        strategy_to_dict,
     )
 
     devs = list(devices) if devices is not None else jax.devices()
@@ -361,22 +380,60 @@ def search(
     params_shape = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
     opt_shape = jax.eval_shape(optimizer.init, params_shape)
     fp = fingerprint(params_shape, sample_batch, n, opt_shape)
+
+    def forced(s: Strategy) -> Strategy:
+        if grad_accum is not None and s.grad_accum != grad_accum:
+            return dataclasses.replace(s, grad_accum=grad_accum)
+        return s
+
+    multiproc = jax.process_count() > 1
+
+    def broadcast_winner(best: Optional[Strategy]) -> Strategy:
+        """Ship process 0's pick to everyone as a fixed-size JSON blob."""
+        import json
+
+        from jax.experimental import multihost_utils
+
+        payload = np.zeros(512, np.uint8)
+        if best is not None:
+            raw = json.dumps(strategy_to_dict(best)).encode()
+            payload[: len(raw)] = np.frombuffer(raw, np.uint8)
+        got = np.asarray(multihost_utils.broadcast_one_to_all(payload))
+        return strategy_from_dict(
+            json.loads(bytes(got.tobytes()).rstrip(b"\x00").decode())
+        )
+
+    # Non-leader processes never search (or even consult the cache — it's
+    # host-local, and a split hit/miss would deadlock the broadcast); they
+    # wait for the leader's pick.
+    if multiproc and jax.process_index() != 0:
+        return broadcast_winner(None)
+
     if cache_obj is not None:
         hit = cache_obj.get(fp)
         if hit is not None:
+            # The fingerprint excludes grad_accum, so a forced value must
+            # be re-applied to a cached winner.
+            hit = forced(hit)
             logger.info(
                 "strategy search: cache hit %s -> %s", fp, hit.describe()
             )
+            if multiproc:
+                broadcast_winner(hit)
             return hit
 
+    best_job: dict = {}
+
     def objective(s: Strategy) -> float:
-        if grad_accum is not None:
-            s = dataclasses.replace(s, grad_accum=grad_accum)
+        s = forced(s)
         job = _compile_candidate(
             s, loss_fn, init_fn, optimizer, sample_batch,
             param_specs, batch_axes, devs,
         )
-        return _score(job, profile_steps, init_fn)
+        t = _score(job, profile_steps, init_fn)
+        if t < best_job.get("cost", float("inf")):
+            best_job.update(job=job, cost=t, key=s.describe())
+        return t
 
     # A forced grad_accum collapses the accum dimension of the space —
     # otherwise 3 grid points per (mesh, remat) are one effective strategy
@@ -390,9 +447,14 @@ def search(
         objective, space,
         max_evals=max_evals, warm_start=list(warm_start),
     ).run()
+    best = forced(result.best)
     if cache_obj is not None:
-        cache_obj.put(fp, result.best)
-    return result.best
+        cache_obj.put(fp, best)
+    if job_out is not None and best_job.get("key") == best.describe():
+        job_out["job"] = best_job["job"]
+    if multiproc:
+        broadcast_winner(best)
+    return best
 
 
 def _score(job: AcceleratedJob, profile_steps: int, init_fn) -> float:

@@ -14,3 +14,14 @@ from dlrover_tpu.trainer.elastic import (  # noqa: F401
     resolve_grad_accum,
 )
 from dlrover_tpu.trainer.sampler import ElasticSampler  # noqa: F401
+from dlrover_tpu.trainer.trainer import (  # noqa: F401
+    EarlyStoppingCallback,
+    LoggingCallback,
+    Trainer,
+    TrainerCallback,
+    TrainerControl,
+    TrainerState,
+    TrainingArgs,
+    build_lr_schedule,
+    build_optimizer,
+)

@@ -1,0 +1,500 @@
+"""Full training-loop SDK: eval, LR schedules, callbacks, checkpoint cadence.
+
+Parity with the reference ``AtorchTrainer``
+(``atorch/trainer/atorch_trainer.py:142``: train loop + evaluate + LR
+scheduler resume + callback dispatch + save cadence, and its
+``TrainingArgs``/``TrainerState``/``TrainerCallback`` surface modeled on
+the HF trainer).  TPU-native shape: the step itself is the pjit'd
+function built by :class:`~dlrover_tpu.trainer.elastic.ElasticTrainer`
+(global batch preserved under elasticity); the LR schedule is an optax
+step-indexed schedule living *inside* the optimizer state, so restoring
+the flash checkpoint resumes the schedule exactly; eval is a second jit
+over the same sharded params.  Kill-and-restore goes through the flash
+checkpoint engine: params/opt-state from shm or storage, sampler position
+and trainer counters from the checkpoint's meta.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.trainer.elastic import ElasticTrainer, TrainerConfig
+
+
+# ---------------------------------------------------------------------------
+# Arguments / state / control
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TrainingArgs:
+    """The knobs of the loop (reference ``AtorchTrainingArgs``)."""
+
+    # batch & elasticity
+    global_batch_size: int = 32
+    max_micro_batch_per_proc: int = 32
+    # duration: max_steps wins if > 0, else num_epochs
+    max_steps: int = 0
+    num_epochs: int = 1
+    # optimizer / schedule
+    learning_rate: float = 3e-4
+    lr_schedule: str = "cosine"  # cosine | linear | constant
+    warmup_steps: int = 0
+    min_lr_ratio: float = 0.1
+    weight_decay: float = 0.0
+    max_grad_norm: float = 0.0  # 0 = no clipping
+    # cadences (steps; 0 disables)
+    logging_steps: int = 10
+    eval_steps: int = 0
+    save_steps: int = 0
+    # checkpointing
+    ckpt_dir: str = ""
+    job_name: str = ""  # shm-arena namespace; derived from ckpt_dir if ""
+    persist_every_n_saves: int = 1  # 1 = every save goes to storage
+    # eval micro batch (defaults to the train micro batch)
+    eval_batch_per_proc: int = 0
+    # misc
+    seed: int = 0
+    early_stopping_patience: int = 0  # evals w/o improvement; 0 = off
+    greater_is_better: bool = False  # for the eval metric
+
+
+@dataclasses.dataclass
+class TrainerState:
+    """Loop counters + history (reference ``TrainerState``); checkpointed
+    via the flash-ckpt meta so restores resume cadences correctly."""
+
+    step: int = 0
+    epoch: int = 0
+    samples_seen: int = 0
+    best_metric: Optional[float] = None
+    evals_since_best: int = 0
+    saves: int = 0
+    log_history: List[dict] = dataclasses.field(default_factory=list)
+
+    def to_meta(self) -> dict:
+        return {
+            "step": self.step,
+            "epoch": self.epoch,
+            "samples_seen": self.samples_seen,
+            "best_metric": self.best_metric,
+            "evals_since_best": self.evals_since_best,
+            "saves": self.saves,
+        }
+
+    def load_meta(self, meta: dict) -> None:
+        self.step = int(meta.get("step", 0))
+        self.epoch = int(meta.get("epoch", 0))
+        self.samples_seen = int(meta.get("samples_seen", 0))
+        bm = meta.get("best_metric")
+        self.best_metric = None if bm is None else float(bm)
+        self.evals_since_best = int(meta.get("evals_since_best", 0))
+        self.saves = int(meta.get("saves", 0))
+
+
+@dataclasses.dataclass
+class TrainerControl:
+    should_stop: bool = False
+    should_save: bool = False
+    should_evaluate: bool = False
+
+
+class TrainerCallback:
+    """Hook surface (reference ``TrainerCallback`` dispatch in
+    ``atorch_trainer.py``).  Every hook may mutate ``control``."""
+
+    def on_train_begin(self, args, state, control) -> None: ...
+
+    def on_step_end(self, args, state, control, metrics: dict) -> None: ...
+
+    def on_log(self, args, state, control, logs: dict) -> None: ...
+
+    def on_evaluate(self, args, state, control, metrics: dict) -> None: ...
+
+    def on_save(self, args, state, control) -> None: ...
+
+    def on_epoch_end(self, args, state, control) -> None: ...
+
+    def on_train_end(self, args, state, control) -> None: ...
+
+
+class LoggingCallback(TrainerCallback):
+    def on_log(self, args, state, control, logs) -> None:
+        logger.info(
+            "step %d | %s",
+            state.step,
+            " ".join(
+                f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in logs.items()
+            ),
+        )
+
+
+class EarlyStoppingCallback(TrainerCallback):
+    """Stop after ``args.early_stopping_patience`` evals w/o improvement."""
+
+    def on_evaluate(self, args, state, control, metrics) -> None:
+        if args.early_stopping_patience <= 0:
+            return
+        if state.evals_since_best >= args.early_stopping_patience:
+            logger.info(
+                "early stop: no improvement in %d evals",
+                state.evals_since_best,
+            )
+            control.should_stop = True
+
+
+# ---------------------------------------------------------------------------
+# Optimizer / schedule factory
+# ---------------------------------------------------------------------------
+
+
+def build_lr_schedule(args: TrainingArgs, total_steps: int):
+    """Warmup + decay as an optax step-indexed schedule.  Because the
+    schedule is a pure function of the optimizer's internal count, a
+    restored checkpoint resumes it exactly (reference: the LR-scheduler
+    state_dict save/load dance in ``atorch_trainer.py``)."""
+    import optax
+
+    peak = args.learning_rate
+    floor = peak * args.min_lr_ratio
+    decay_steps = max(1, total_steps - args.warmup_steps)
+    if args.lr_schedule == "constant":
+        decay = optax.constant_schedule(peak)
+    elif args.lr_schedule == "linear":
+        decay = optax.linear_schedule(peak, floor, decay_steps)
+    elif args.lr_schedule == "cosine":
+        decay = optax.cosine_decay_schedule(
+            peak, decay_steps, alpha=args.min_lr_ratio
+        )
+    else:
+        raise ValueError(f"unknown lr_schedule {args.lr_schedule!r}")
+    if args.warmup_steps > 0:
+        warmup = optax.linear_schedule(0.0, peak, args.warmup_steps)
+        return optax.join_schedules([warmup, decay], [args.warmup_steps])
+    return decay
+
+
+def build_optimizer(args: TrainingArgs, total_steps: int):
+    """AdamW + schedule (+ optional global-norm clipping)."""
+    import optax
+
+    schedule = build_lr_schedule(args, total_steps)
+    tx = optax.adamw(
+        learning_rate=schedule, weight_decay=args.weight_decay
+    )
+    if args.max_grad_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(args.max_grad_norm), tx)
+    return tx, schedule
+
+
+# ---------------------------------------------------------------------------
+# The trainer
+# ---------------------------------------------------------------------------
+
+
+class Trainer:
+    """The full loop over the elastic core.
+
+    ``fetch_batch(indices) -> batch pytree`` feeds training;
+    ``eval_fetch`` (same contract) feeds :meth:`evaluate`.  The optimizer
+    defaults to AdamW with the scheduled LR; pass ``optimizer_fn``
+    (schedule -> optax tx) to customize while keeping schedule resume.
+    """
+
+    def __init__(
+        self,
+        *,
+        loss_fn: Callable,
+        init_fn: Callable,
+        args: TrainingArgs,
+        fetch_batch: Callable[[np.ndarray], Any],
+        dataset_size: int,
+        eval_fetch: Optional[Callable[[np.ndarray], Any]] = None,
+        eval_dataset_size: int = 0,
+        optimizer_fn: Optional[Callable[[Any], Any]] = None,
+        strategy: Any = None,
+        callbacks: Sequence[TrainerCallback] = (),
+        master_client=None,
+        step_reporter: Optional[Callable[[int], None]] = None,
+        devices=None,
+        num_processes: int = 1,
+        process_id: int = 0,
+    ):
+        self.args = args
+        self.loss_fn = loss_fn
+        self.init_fn = init_fn
+        self.eval_fetch = eval_fetch
+        self.eval_dataset_size = eval_dataset_size
+        self.client = master_client
+        self.step_reporter = step_reporter
+        self.state = TrainerState()
+        self.control = TrainerControl()
+        self.callbacks: List[TrainerCallback] = [LoggingCallback()]
+        self.callbacks += list(callbacks)
+        if args.early_stopping_patience > 0:
+            self.callbacks.append(EarlyStoppingCallback())
+
+        total = self.total_steps(dataset_size)
+        if optimizer_fn is not None:
+            self.schedule = build_lr_schedule(args, total)
+            tx = optimizer_fn(self.schedule)
+        else:
+            tx, self.schedule = build_optimizer(args, total)
+        self.optimizer = tx
+
+        self.core = ElasticTrainer(
+            TrainerConfig(
+                global_batch_size=args.global_batch_size,
+                max_micro_batch_per_proc=args.max_micro_batch_per_proc,
+            ),
+            loss_fn=loss_fn,
+            init_fn=init_fn,
+            optimizer=tx,
+            fetch_batch=fetch_batch,
+            dataset_size=dataset_size,
+            strategy=strategy,
+            sampler_seed=args.seed,
+            devices=devices,
+        )
+        self._num_processes = num_processes
+        self._process_id = process_id
+        self._ckpt = None
+        self._eval_step = None
+        self._sampler_restored = False
+        if args.ckpt_dir:
+            import hashlib
+
+            from dlrover_tpu.checkpoint.checkpointer import (
+                FlashCheckpointer,
+            )
+
+            # Namespace the shm staging arena by the checkpoint dir, so
+            # two jobs (or two tests) on one host never share state.
+            job = args.job_name or "t" + hashlib.sha1(
+                args.ckpt_dir.encode()
+            ).hexdigest()[:10]
+            self._ckpt = FlashCheckpointer(
+                args.ckpt_dir, job_name=job, master_client=master_client
+            )
+
+    # -- sizing --------------------------------------------------------------
+    def total_steps(self, dataset_size: int) -> int:
+        if self.args.max_steps > 0:
+            return self.args.max_steps
+        per_epoch = max(1, dataset_size // self.args.global_batch_size)
+        return per_epoch * max(1, self.args.num_epochs)
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return max(
+            1, self.core.dataset_size // self.args.global_batch_size
+        )
+
+    # -- checkpoint ----------------------------------------------------------
+    def _restore(self) -> bool:
+        self._sampler_restored = False
+        if self._ckpt is None:
+            return False
+        restored = self._ckpt.load(target=self.core.state)
+        if restored is None:
+            return False
+        ckpt_state, meta = restored
+        self.core.state = ckpt_state
+        self.state.load_meta(meta.get("trainer", {}))
+        if meta.get("sampler") and self.core.sampler is not None:
+            self.core.sampler.load_state_dict(meta["sampler"])
+            self._sampler_restored = True
+        logger.info(
+            "trainer: restored step %d (epoch %d)",
+            self.state.step, self.state.epoch,
+        )
+        return True
+
+    def save(self, storage: Optional[bool] = None) -> None:
+        if self._ckpt is None:
+            return
+        self.state.saves += 1
+        if storage is None:
+            storage = (
+                self.args.persist_every_n_saves <= 1
+                or self.state.saves % self.args.persist_every_n_saves == 0
+            )
+        meta = {
+            "step": self.state.step,
+            "trainer": self.state.to_meta(),
+            "sampler": (
+                self.core.sampler.state_dict() if self.core.sampler else {}
+            ),
+        }
+        self._ckpt.save(self.core.state, meta=meta, storage=storage)
+        for cb in self.callbacks:
+            cb.on_save(self.args, self.state, self.control)
+
+    # -- eval ----------------------------------------------------------------
+    def _build_eval_step(self):
+        import jax
+
+        if self._eval_step is not None:
+            return
+        job = self.core.job
+
+        def eval_loss(state, batch):
+            return self.loss_fn(state["params"], batch)
+
+        self._eval_step = jax.jit(
+            eval_loss,
+            in_shardings=(job.state_sharding, job.batch_sharding),
+        )
+
+    def evaluate(self) -> Dict[str, float]:
+        """Mean loss over the eval dataset (reference ``evaluate`` +
+        ``prediction_loop``)."""
+        if self.eval_fetch is None or self.eval_dataset_size <= 0:
+            return {}
+        import jax
+
+        self._build_eval_step()
+        per_proc = (
+            self.args.eval_batch_per_proc
+            or self.core.micro_batch * self.core.grad_accum
+        )
+        global_bs = per_proc * max(1, self._num_processes)
+        n_batches = max(1, self.eval_dataset_size // global_bs)
+        losses = []
+        for b in range(n_batches):
+            lo = b * global_bs + self._process_id * per_proc
+            indices = np.arange(lo, lo + per_proc, dtype=np.int64)
+            indices %= self.eval_dataset_size
+            batch_np = self.eval_fetch(indices)
+            batch = jax.tree_util.tree_map(
+                lambda x, s: jax.make_array_from_process_local_data(
+                    s, np.asarray(x)
+                ),
+                batch_np,
+                self.core.job.batch_sharding,
+            )
+            losses.append(float(self._eval_step(self.core.state, batch)))
+        metrics = {"eval_loss": float(np.mean(losses))}
+        metric = metrics["eval_loss"]
+        better = (
+            self.state.best_metric is None
+            or (metric > self.state.best_metric
+                if self.args.greater_is_better
+                else metric < self.state.best_metric)
+        )
+        if better:
+            self.state.best_metric = metric
+            self.state.evals_since_best = 0
+        else:
+            self.state.evals_since_best += 1
+        for cb in self.callbacks:
+            cb.on_evaluate(self.args, self.state, self.control, metrics)
+        self._log(metrics)
+        return metrics
+
+    # -- logging -------------------------------------------------------------
+    def current_lr(self) -> float:
+        return float(self.schedule(self.state.step))
+
+    def _log(self, logs: dict) -> None:
+        logs = dict(logs)
+        logs.setdefault("lr", self.current_lr())
+        logs.setdefault("epoch", self.state.epoch)
+        self.state.log_history.append({"step": self.state.step, **logs})
+        for cb in self.callbacks:
+            cb.on_log(self.args, self.state, self.control, logs)
+
+    # -- the loop ------------------------------------------------------------
+    def train(self, resume: bool = True) -> TrainerState:
+        args = self.args
+        self.core.build(self._num_processes, self._process_id)
+        total = self.total_steps(self.core.dataset_size)
+        restored = self._restore() if resume else False
+        # Fast-forward the sampler ONLY when the checkpoint carried no
+        # sampler state (e.g. a checkpoint written outside this trainer);
+        # the restored position is authoritative — a boundary checkpoint
+        # (step % steps_per_epoch == 0) would otherwise replay the whole
+        # epoch under the wrong shuffle.
+        if (
+            restored
+            and not self._sampler_restored
+            and self.core.sampler is not None
+        ):
+            self.core.sampler.completed_steps = (
+                self.state.step % self.steps_per_epoch
+            )
+        for cb in self.callbacks:
+            cb.on_train_begin(args, self.state, self.control)
+
+        window: List[float] = []
+        t_last = time.perf_counter()
+        empty_passes = 0
+        while self.state.step < total and not self.control.should_stop:
+            made_progress = False
+            for metrics in self.core.epoch():
+                made_progress = True
+                self.state.step += 1
+                self.state.samples_seen += args.global_batch_size
+                window.append(float(metrics["loss"]))
+                if self.step_reporter is not None:
+                    try:
+                        self.step_reporter(self.state.step)
+                    except Exception:  # noqa: BLE001
+                        pass
+                for cb in self.callbacks:
+                    cb.on_step_end(
+                        args, self.state, self.control, metrics
+                    )
+
+                if (
+                    args.logging_steps > 0
+                    and self.state.step % args.logging_steps == 0
+                ):
+                    dt = time.perf_counter() - t_last
+                    self._log(
+                        {
+                            "loss": float(np.mean(window)),
+                            "steps_per_s": len(window) / max(dt, 1e-9),
+                        }
+                    )
+                    window.clear()
+                    t_last = time.perf_counter()
+                if (
+                    args.eval_steps > 0
+                    and self.state.step % args.eval_steps == 0
+                ) or self.control.should_evaluate:
+                    self.control.should_evaluate = False
+                    self.evaluate()
+                if (
+                    args.save_steps > 0
+                    and self.state.step % args.save_steps == 0
+                ) or self.control.should_save:
+                    self.control.should_save = False
+                    self.save()
+                if (
+                    self.state.step >= total
+                    or self.control.should_stop
+                ):
+                    break
+            self.state.epoch += 1
+            for cb in self.callbacks:
+                cb.on_epoch_end(args, self.state, self.control)
+            # A pass that yields nothing is normal exactly once after a
+            # boundary restore (the exhausted epoch rolls the sampler to
+            # the next one); twice in a row means a truly empty partition.
+            empty_passes = 0 if made_progress else empty_passes + 1
+            if empty_passes >= 2:
+                break
+
+        if self._ckpt is not None:
+            self.save(storage=True)
+            self._ckpt.wait()
+        for cb in self.callbacks:
+            cb.on_train_end(args, self.state, self.control)
+        return self.state

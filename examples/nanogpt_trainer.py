@@ -1,0 +1,108 @@
+"""Elastic nanoGPT pretraining through the full Trainer SDK.
+
+The Trainer-SDK variant of ``nanogpt_train.py`` (reference
+``AtorchTrainer`` usage): eval loop, warmup+cosine LR schedule, callbacks,
+checkpoint cadence — all surviving worker kills via the flash-checkpoint
+restore (the schedule resumes because it lives in the optimizer state).
+
+Run standalone on one host::
+
+    python -m dlrover_tpu.run --standalone --nproc_per_node=2 \
+        examples/nanogpt_trainer.py -- --steps 40 --ckpt_dir /tmp/ck
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=40)
+    p.add_argument("--global_batch", type=int, default=8)
+    p.add_argument("--seq_len", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--warmup_steps", type=int, default=4)
+    p.add_argument("--dataset_size", type=int, default=4096)
+    p.add_argument("--eval_steps", type=int, default=10)
+    p.add_argument("--ckpt_dir", default="")
+    p.add_argument("--save_steps", type=int, default=5)
+    return p.parse_args()
+
+
+def main() -> int:
+    args = parse_args()
+
+    import dlrover_tpu.trainer as sdk
+
+    ctx = sdk.init()
+
+    import jax
+    import numpy as np
+
+    from dlrover_tpu.models import nanogpt
+    from dlrover_tpu.trainer.trainer import Trainer, TrainingArgs
+
+    cfg = nanogpt.GPTConfig.tiny()
+    cfg = type(cfg)(**{**cfg.__dict__, "block_size": args.seq_len})
+
+    def synth(indices):
+        rngs = np.random.RandomState(0)
+        base = rngs.randint(0, cfg.vocab_size, size=(args.seq_len + 1,))
+        out = np.stack(
+            [(base + int(i)) % cfg.vocab_size for i in indices], axis=0
+        ).astype("int32")
+        return {"tokens": out[:, :-1], "targets": out[:, 1:]}
+
+    def loss_fn(params, batch):
+        return nanogpt.loss_fn(
+            params, batch["tokens"], batch["targets"], cfg
+        )
+
+    local_dev = jax.local_device_count()
+    gb = args.global_batch
+    total_dev = local_dev * ctx.num_processes
+    if gb % total_dev:
+        gb = -(-gb // total_dev) * total_dev
+
+    targs = TrainingArgs(
+        global_batch_size=gb,
+        max_micro_batch_per_proc=max(1, gb // ctx.num_processes),
+        max_steps=args.steps,
+        learning_rate=args.lr,
+        lr_schedule="cosine",
+        warmup_steps=args.warmup_steps,
+        logging_steps=5,
+        eval_steps=args.eval_steps,
+        save_steps=args.save_steps,
+        ckpt_dir=args.ckpt_dir,
+        job_name=ctx.job_name,
+        seed=17,
+    )
+    trainer = Trainer(
+        loss_fn=loss_fn,
+        init_fn=lambda rng: nanogpt.init_params(rng, cfg),
+        args=targs,
+        fetch_batch=synth,
+        dataset_size=args.dataset_size,
+        eval_fetch=synth,
+        eval_dataset_size=max(64, gb * 4),
+        master_client=ctx.client,
+        step_reporter=ctx.report_step,
+        num_processes=ctx.num_processes,
+        process_id=ctx.process_id,
+    )
+    state = trainer.train(resume=True)
+    final = [h for h in state.log_history if "eval_loss" in h]
+    eval_loss = final[-1]["eval_loss"] if final else float("nan")
+    print(
+        f"TRAIN_DONE step={state.step} eval_loss={eval_loss:.4f} "
+        f"lr={trainer.current_lr():.6f}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

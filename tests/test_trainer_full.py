@@ -1,0 +1,246 @@
+"""Full-trainer tests: LR schedules, eval loop, callbacks, checkpoint
+cadence, and crash-resume equivalence (test model: the reference
+AtorchTrainer resume/eval unit tests)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.parallel.accelerate import Strategy
+from dlrover_tpu.parallel.mesh import MeshSpec
+from dlrover_tpu.trainer.trainer import (
+    EarlyStoppingCallback,
+    Trainer,
+    TrainerCallback,
+    TrainingArgs,
+    build_lr_schedule,
+)
+
+
+def _problem():
+    def init_fn(rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w1": jax.random.normal(k1, (8, 16)) * 0.1,
+            "w2": jax.random.normal(k2, (16, 4)) * 0.1,
+        }
+
+    def loss_fn(params, batch):
+        h = jnp.tanh(batch["x"] @ params["w1"])
+        pred = h @ params["w2"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(512, 8).astype(np.float32)
+    W = rs.randn(8, 4).astype(np.float32)
+    Y = (X @ W).astype(np.float32)
+
+    def fetch(indices):
+        return {"x": X[indices % 512], "y": Y[indices % 512]}
+
+    return init_fn, loss_fn, fetch
+
+
+def _make_trainer(tmp_path=None, callbacks=(), **kw):
+    init_fn, loss_fn, fetch = _problem()
+    args = TrainingArgs(
+        global_batch_size=16,
+        max_micro_batch_per_proc=16,
+        max_steps=kw.pop("max_steps", 8),
+        learning_rate=kw.pop("learning_rate", 1e-2),
+        lr_schedule=kw.pop("lr_schedule", "cosine"),
+        warmup_steps=kw.pop("warmup_steps", 2),
+        logging_steps=2,
+        eval_steps=kw.pop("eval_steps", 0),
+        save_steps=kw.pop("save_steps", 0),
+        ckpt_dir=str(tmp_path) if tmp_path else "",
+        seed=3,
+        **kw,
+    )
+    return Trainer(
+        loss_fn=loss_fn,
+        init_fn=init_fn,
+        args=args,
+        fetch_batch=fetch,
+        dataset_size=512,
+        eval_fetch=fetch,
+        eval_dataset_size=64,
+        strategy=Strategy(mesh=MeshSpec(dp=1)),
+        devices=[jax.devices("cpu")[0]],
+        callbacks=callbacks,
+    )
+
+
+class TestSchedules:
+    def test_warmup_cosine(self):
+        args = TrainingArgs(
+            learning_rate=1.0, warmup_steps=10, lr_schedule="cosine",
+            min_lr_ratio=0.1,
+        )
+        sched = build_lr_schedule(args, total_steps=110)
+        assert float(sched(0)) == 0.0
+        assert float(sched(10)) == pytest.approx(1.0)
+        assert float(sched(60)) < 1.0
+        assert float(sched(110)) == pytest.approx(0.1, rel=1e-3)
+
+    def test_linear_and_constant(self):
+        a = TrainingArgs(
+            learning_rate=2.0, warmup_steps=0, lr_schedule="linear",
+            min_lr_ratio=0.5,
+        )
+        s = build_lr_schedule(a, 10)
+        assert float(s(0)) == pytest.approx(2.0)
+        assert float(s(10)) == pytest.approx(1.0)
+        c = build_lr_schedule(
+            TrainingArgs(learning_rate=3.0, lr_schedule="constant"), 10
+        )
+        assert float(c(7)) == pytest.approx(3.0)
+
+
+class TestTrainLoop:
+    def test_trains_with_eval_logging_and_schedule(self):
+        trainer = _make_trainer(max_steps=32, eval_steps=16)
+        state = trainer.train()
+        assert state.step == 32
+        losses = [
+            h["loss"] for h in state.log_history if "loss" in h
+        ]
+        assert losses[-1] < losses[0]
+        evals = [
+            h["eval_loss"] for h in state.log_history if "eval_loss" in h
+        ]
+        assert len(evals) == 2  # steps 16 and 32
+        assert evals[-1] <= evals[0]
+        # Logged LR follows the schedule at the logged step.
+        for h in state.log_history:
+            if "lr" in h and "loss" in h:
+                assert h["lr"] == pytest.approx(
+                    float(trainer.schedule(h["step"])), rel=1e-6
+                )
+
+    def test_callbacks_and_early_stop(self):
+        seen = {"steps": 0, "train_end": 0}
+
+        class Counter(TrainerCallback):
+            def on_step_end(self, args, state, control, metrics):
+                seen["steps"] += 1
+                if state.step >= 3:
+                    control.should_stop = True
+
+            def on_train_end(self, args, state, control):
+                seen["train_end"] += 1
+
+        trainer = _make_trainer(max_steps=50, callbacks=(Counter(),))
+        state = trainer.train()
+        assert state.step == 3
+        assert seen["steps"] == 3
+        assert seen["train_end"] == 1
+
+    def test_early_stopping_on_plateau(self):
+        # LR 0 => loss never improves after the first eval.
+        trainer = _make_trainer(
+            max_steps=40, eval_steps=2, warmup_steps=0,
+            lr_schedule="constant", early_stopping_patience=2,
+            learning_rate=0.0,
+        )
+        state = trainer.train()
+        assert state.step < 40  # stopped early
+        assert state.evals_since_best >= 2
+
+
+class TestCrashResume:
+    def test_resume_equivalence(self, tmp_path):
+        """Crash after step 3 (last save at step 2), restore, finish: the
+        final params must equal an uninterrupted run's — proving params,
+        opt-state (incl. the schedule's internal count), sampler position
+        and trainer counters all resume exactly."""
+
+        class CrashAt(TrainerCallback):
+            def __init__(self, at):
+                self.at = at
+
+            def on_step_end(self, args, state, control, metrics):
+                if state.step == self.at:
+                    raise RuntimeError("simulated crash")
+
+        # Uninterrupted reference run.
+        ref = _make_trainer(tmp_path / "ref", max_steps=6, save_steps=2)
+        ref_state = ref.train()
+        assert ref_state.step == 6
+        ref_params = jax.device_get(ref.core.state["params"])
+
+        # Crash at step 3; the step-2 save is the restore point.
+        crashed = _make_trainer(
+            tmp_path / "ck", max_steps=6, save_steps=2,
+            callbacks=(CrashAt(3),),
+        )
+        with pytest.raises(RuntimeError, match="simulated crash"):
+            crashed.train()
+
+        resumed = _make_trainer(tmp_path / "ck", max_steps=6, save_steps=2)
+        state = resumed.train(resume=True)
+        assert state.step == 6
+        # It really resumed (first logged step after restore is > 2).
+        post = [h["step"] for h in state.log_history if "loss" in h]
+        assert min(post) > 2
+        got = jax.device_get(resumed.core.state["params"])
+        for k in ref_params:
+            np.testing.assert_allclose(
+                got[k], ref_params[k], rtol=1e-5, atol=1e-6
+            )
+
+    def test_resume_from_epoch_boundary_checkpoint(self, tmp_path):
+        """A checkpoint taken exactly at an epoch boundary must resume
+        into the NEXT epoch's shuffle, not replay the finished epoch."""
+        init_fn, loss_fn, fetch = _problem()
+
+        def make(callbacks=(), sub="bd"):
+            args = TrainingArgs(
+                global_batch_size=16, max_micro_batch_per_proc=16,
+                max_steps=8, learning_rate=1e-2, lr_schedule="constant",
+                warmup_steps=0, logging_steps=1, save_steps=4,
+                ckpt_dir=str(tmp_path / sub), seed=3,
+            )
+            return Trainer(
+                loss_fn=loss_fn, init_fn=init_fn, args=args,
+                fetch_batch=fetch, dataset_size=64,  # steps_per_epoch=4
+                strategy=Strategy(mesh=MeshSpec(dp=1)),
+                devices=[jax.devices("cpu")[0]],
+                callbacks=callbacks,
+            )
+
+        class CrashAt(TrainerCallback):
+            def on_step_end(self, args, state, control, metrics):
+                if state.step == 5:
+                    raise RuntimeError("boom")
+
+        ref = make(sub="bd_ref")
+        ref.train()
+        ref_params = jax.device_get(ref.core.state["params"])
+
+        crashed = make(callbacks=(CrashAt(),))
+        with pytest.raises(RuntimeError):
+            crashed.train()  # last save at step 4 == epoch boundary
+        resumed = make()
+        state = resumed.train(resume=True)
+        assert state.step == 8
+        got = jax.device_get(resumed.core.state["params"])
+        for k in ref_params:
+            np.testing.assert_allclose(
+                got[k], ref_params[k], rtol=1e-5, atol=1e-6
+            )
+
+    def test_restore_resumes_lr_schedule(self, tmp_path):
+        trainer = _make_trainer(
+            tmp_path, max_steps=4, save_steps=2, warmup_steps=0
+        )
+        trainer.train()
+        fresh = _make_trainer(tmp_path, max_steps=6, save_steps=2)
+        fresh.core.build(1, 0)
+        assert fresh._restore()
+        assert fresh.state.step == 4
+        assert fresh.current_lr() == pytest.approx(
+            float(fresh.schedule(4)), rel=1e-6
+        )
