@@ -1,0 +1,313 @@
+"""Coworker input pipeline: a producer process feeding a shm ring buffer.
+
+Parity with the reference shm dataloader + coworker preprocessing
+(``atorch/data/shm_dataloader.py:138 ShmDataloader``,
+``atorch/data/shm_context.py`` the shared-memory queue of serialized
+batches, ``coworker_dataset.py:13`` CPU-coworker preprocessing): batch
+materialization (decode, augmentation, tokenization — host CPU work) runs
+in a separate OS process so it overlaps device step time, with batches
+crossing process boundaries through POSIX shared memory instead of pickle
+pipes.
+
+TPU-first notes: on TPU-VM hosts the input pipeline competes with the
+runtime for the same cores, so the producer is a *separate process* (GIL-
+free) and the transport is zero-copy-read shm.  The ring is crash-aware:
+slots move EMPTY -> WRITING -> READY, the consumer detects a dead
+producer, drains the READY backlog, and respawns the producer from the
+exact next batch index — no sample is lost or duplicated (the elasticity
+contract the flash-checkpoint sampler state depends on).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import struct
+import time
+from multiprocessing import shared_memory
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import msgpack
+import numpy as np
+
+from dlrover_tpu.common.log import logger
+
+# Slot states.
+_EMPTY, _WRITING, _READY = 0, 1, 2
+_SLOT_HEADER = struct.Struct("<BxxxxxxxQQ")  # state, payload len, seq
+
+
+def _pack_batch(batch: Any) -> bytes:
+    """Pytree of np arrays -> one buffer (msgpack meta + raw tensor bytes).
+    Only flat dicts of arrays are supported — the standard batch shape."""
+    metas: Dict[str, dict] = {}
+    blobs = []
+    offset = 0
+    for key, arr in batch.items():
+        arr = np.ascontiguousarray(arr)
+        metas[key] = {
+            "dtype": arr.dtype.str,
+            "shape": list(arr.shape),
+            "offset": offset,
+            "nbytes": arr.nbytes,
+        }
+        blobs.append(arr.tobytes())
+        offset += arr.nbytes
+    head = msgpack.packb(metas, use_bin_type=True)
+    return struct.pack("<I", len(head)) + head + b"".join(blobs)
+
+
+def _unpack_batch(buf: memoryview) -> Dict[str, np.ndarray]:
+    (hlen,) = struct.unpack_from("<I", buf, 0)
+    metas = msgpack.unpackb(bytes(buf[4 : 4 + hlen]), raw=False)
+    base = 4 + hlen
+    out = {}
+    for key, m in metas.items():
+        arr = np.frombuffer(
+            buf, dtype=np.dtype(m["dtype"]),
+            count=int(np.prod(m["shape"])) if m["shape"] else 1,
+            offset=base + m["offset"],
+        ).reshape(m["shape"])
+        out[key] = arr.copy()  # detach from the ring before the slot frees
+    return out
+
+
+class ShmRing:
+    """Fixed-slot SPSC ring over one POSIX shm segment.
+
+    Layout: ``n_slots * (slot_header + slot_bytes)``.  The single producer
+    writes slot ``seq % n_slots`` (waiting for EMPTY); the single consumer
+    reads in seq order (waiting for READY).  State bytes are the fences:
+    state is flipped to READY only after the payload memcpy completes, and
+    to EMPTY only after the consumer has copied out.
+    """
+
+    def __init__(self, name: str, slot_bytes: int, n_slots: int,
+                 create: bool):
+        self.name = name
+        self.slot_bytes = slot_bytes
+        self.n_slots = n_slots
+        self._stride = _SLOT_HEADER.size + slot_bytes
+        size = self._stride * n_slots
+        if create:
+            try:
+                old = shared_memory.SharedMemory(name=name)
+                old.close()
+                old.unlink()
+            except FileNotFoundError:
+                pass
+            self._shm = shared_memory.SharedMemory(
+                name=name, create=True, size=size
+            )
+            self._shm.buf[:size] = b"\x00" * size
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+        self._created = create
+
+    # -- slot access ---------------------------------------------------------
+    def _hdr(self, slot: int):
+        off = slot * self._stride
+        return _SLOT_HEADER.unpack_from(self._shm.buf, off)
+
+    def _set_hdr(self, slot: int, state: int, length: int, seq: int):
+        off = slot * self._stride
+        _SLOT_HEADER.pack_into(self._shm.buf, off, state, length, seq)
+
+    def state(self, slot: int) -> int:
+        return self._hdr(slot)[0]
+
+    def put(self, seq: int, payload: bytes,
+            stop: Optional[Callable[[], bool]] = None,
+            timeout: float = 60.0) -> bool:
+        """Producer side: write batch ``seq``; False on timeout/stop."""
+        if len(payload) > self.slot_bytes:
+            raise ValueError(
+                f"batch of {len(payload)}B exceeds slot size "
+                f"{self.slot_bytes}B"
+            )
+        slot = seq % self.n_slots
+        deadline = time.monotonic() + timeout
+        while self.state(slot) != _EMPTY:
+            if stop is not None and stop():
+                return False
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.0002)
+        off = slot * self._stride
+        self._set_hdr(slot, _WRITING, len(payload), seq)
+        self._shm.buf[
+            off + _SLOT_HEADER.size : off + _SLOT_HEADER.size + len(payload)
+        ] = payload
+        self._set_hdr(slot, _READY, len(payload), seq)
+        return True
+
+    def get(self, seq: int, *, wait: bool = True,
+            alive: Optional[Callable[[], bool]] = None,
+            timeout: float = 60.0) -> Optional[Dict[str, np.ndarray]]:
+        """Consumer side: read batch ``seq``; None if not READY (and not
+        waiting, or the producer died, or timeout)."""
+        slot = seq % self.n_slots
+        deadline = time.monotonic() + timeout
+        while True:
+            st, length, got_seq = self._hdr(slot)
+            if st == _READY and got_seq == seq:
+                off = slot * self._stride + _SLOT_HEADER.size
+                batch = _unpack_batch(self._shm.buf[off : off + length])
+                self._set_hdr(slot, _EMPTY, 0, 0)
+                return batch
+            if not wait:
+                return None
+            if alive is not None and not alive():
+                # Producer is gone; only drain what is already READY.
+                if st != _READY or got_seq != seq:
+                    return None
+            if time.monotonic() > deadline:
+                return None
+            time.sleep(0.0002)
+
+    def reset(self) -> None:
+        for s in range(self.n_slots):
+            self._set_hdr(s, _EMPTY, 0, 0)
+
+    def close(self, unlink: bool = False) -> None:
+        self._shm.close()
+        if unlink or self._created:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+
+def _producer_main(
+    ring_name: str,
+    slot_bytes: int,
+    n_slots: int,
+    fetch_batch: Callable[[np.ndarray], Any],
+    index_batches: list,
+    start_seq: int,
+    crash_after: int = -1,
+) -> None:
+    """Runs in the coworker process: materialize batches, fill the ring."""
+    ring = ShmRing(ring_name, slot_bytes, n_slots, create=False)
+    try:
+        for seq in range(start_seq, len(index_batches)):
+            if crash_after >= 0 and seq >= crash_after:
+                os._exit(17)  # fault injection: die mid-stream
+            batch = fetch_batch(np.asarray(index_batches[seq]))
+            if not ring.put(seq, _pack_batch(batch)):
+                return
+    finally:
+        ring.close()
+
+
+class ShmDataLoader:
+    """Prefetching loader: a coworker process keeps the ring full while
+    the training process consumes (reference ``ShmDataloader``).
+
+    ``index_batches``: the epoch's per-step index arrays (e.g. from
+    ``list(ElasticSampler)``); the full list is shipped to the producer at
+    spawn so the coworker needs no live sampler.  ``fetch_batch`` must be
+    picklable (top-level function / partial) — it runs in the coworker.
+    """
+
+    def __init__(
+        self,
+        fetch_batch: Callable[[np.ndarray], Any],
+        index_batches,
+        *,
+        slot_bytes: int = 0,
+        n_slots: int = 4,
+        name: str = "",
+        max_respawns: int = 3,
+        _crash_after: int = -1,  # test hook
+    ):
+        self.fetch_batch = fetch_batch
+        self.index_batches = [np.asarray(b) for b in index_batches]
+        self.n_slots = max(2, n_slots)
+        self.max_respawns = max_respawns
+        self._crash_after = _crash_after
+        self.name = name or f"dlrtpu_ring_{os.getpid()}_{id(self) & 0xFFFF}"
+        if slot_bytes <= 0 and self.index_batches:
+            sample = _pack_batch(fetch_batch(self.index_batches[0]))
+            slot_bytes = int(len(sample) * 1.25) + 1024
+        self.slot_bytes = slot_bytes
+        self._ring = ShmRing(
+            self.name, self.slot_bytes, self.n_slots, create=True
+        )
+        self._proc: Optional[mp.Process] = None
+        self._consumed = 0
+        self._respawns = 0
+
+    # -- producer lifecycle --------------------------------------------------
+    def _spawn(self, start_seq: int) -> None:
+        ctx = mp.get_context("spawn")
+        self._proc = ctx.Process(
+            target=_producer_main,
+            args=(
+                self.name, self.slot_bytes, self.n_slots,
+                self.fetch_batch, self.index_batches, start_seq,
+                self._crash_after,
+            ),
+            daemon=True,
+        )
+        self._proc.start()
+
+    def _producer_alive(self) -> bool:
+        return self._proc is not None and self._proc.is_alive()
+
+    # -- consumer ------------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.index_batches)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if self._proc is None:
+            self._spawn(self._consumed)
+        while self._consumed < len(self.index_batches):
+            seq = self._consumed
+            batch = self._ring.get(
+                seq, alive=self._producer_alive
+            )
+            if batch is None:
+                if self._producer_alive():
+                    raise TimeoutError(
+                        f"shm dataloader: batch {seq} not produced in time"
+                    )
+                # Producer died with nothing READY for us: respawn it at
+                # exactly the next needed batch (no loss, no duplicates).
+                self._respawns += 1
+                if self._respawns > self.max_respawns:
+                    raise RuntimeError(
+                        "shm dataloader: producer died "
+                        f"{self._respawns} times; giving up"
+                    )
+                code = self._proc.exitcode if self._proc else None
+                logger.warning(
+                    "shm dataloader: producer died (exit=%s); respawning "
+                    "at batch %d", code, seq,
+                )
+                self._crash_after = -1  # the injected fault fires once
+                self._ring.reset()
+                self._spawn(seq)
+                continue
+            self._consumed = seq + 1
+            yield batch
+
+    @classmethod
+    def from_sampler(cls, sampler, fetch_batch, **kw) -> "ShmDataLoader":
+        """Snapshot the sampler's remaining epoch into a prefetching
+        loader (integrates with the elastic sampler without mutating its
+        checkpointable position)."""
+        shadow = sampler.reshard(sampler.num_processes, sampler.process_id)
+        return cls(fetch_batch, list(shadow), **kw)
+
+    def close(self) -> None:
+        if self._proc is not None and self._proc.is_alive():
+            self._proc.terminate()
+            self._proc.join(timeout=5.0)
+        self._ring.close(unlink=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -386,41 +386,50 @@ def search(
             return dataclasses.replace(s, grad_accum=grad_accum)
         return s
 
+    # Multi-process SPMD discipline: every process must launch the SAME
+    # device programs in the same order.  So (a) the leader's cache
+    # hit/miss decision is broadcast before anyone searches, (b) on a
+    # miss EVERY process runs the identical BO loop — the compiles and
+    # timed steps are collectives all processes join — and (c) after each
+    # evaluation the leader's measured wall-clock is broadcast so every
+    # process feeds the GP identical observations, making candidate
+    # selection (and the final winner) deterministic and identical
+    # everywhere.  (The reference runs its tuner on one coordinator; SPMD
+    # timing forces the run-together/agree-on-cost shape here.)
     multiproc = jax.process_count() > 1
+    is_leader = jax.process_index() == 0
 
-    def broadcast_winner(best: Optional[Strategy]) -> Strategy:
-        """Ship process 0's pick to everyone as a fixed-size JSON blob."""
-        import json
-
+    def bcast_blob(payload_bytes: Optional[bytes]) -> bytes:
+        """Leader ships a small blob; everyone gets it."""
         from jax.experimental import multihost_utils
 
-        payload = np.zeros(512, np.uint8)
-        if best is not None:
-            raw = json.dumps(strategy_to_dict(best)).encode()
-            payload[: len(raw)] = np.frombuffer(raw, np.uint8)
-        got = np.asarray(multihost_utils.broadcast_one_to_all(payload))
-        return strategy_from_dict(
-            json.loads(bytes(got.tobytes()).rstrip(b"\x00").decode())
-        )
-
-    # Non-leader processes never search (or even consult the cache — it's
-    # host-local, and a split hit/miss would deadlock the broadcast); they
-    # wait for the leader's pick.
-    if multiproc and jax.process_index() != 0:
-        return broadcast_winner(None)
-
-    if cache_obj is not None:
-        hit = cache_obj.get(fp)
-        if hit is not None:
-            # The fingerprint excludes grad_accum, so a forced value must
-            # be re-applied to a cached winner.
-            hit = forced(hit)
-            logger.info(
-                "strategy search: cache hit %s -> %s", fp, hit.describe()
+        buf = np.zeros(512, np.uint8)
+        if payload_bytes:
+            buf[: len(payload_bytes)] = np.frombuffer(
+                payload_bytes, np.uint8
             )
-            if multiproc:
-                broadcast_winner(hit)
-            return hit
+        got = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        return bytes(got.tobytes()).rstrip(b"\x00")
+
+    hit: Optional[Strategy] = None
+    if is_leader and cache_obj is not None:
+        hit = cache_obj.get(fp)
+    if multiproc:
+        import json
+
+        raw = bcast_blob(
+            json.dumps(strategy_to_dict(hit)).encode() if hit else b""
+        )
+        if raw:
+            hit = strategy_from_dict(json.loads(raw.decode()))
+        else:
+            hit = None
+    if hit is not None:
+        hit = forced(hit)  # fingerprint excludes grad_accum: re-apply
+        logger.info(
+            "strategy search: cache hit %s -> %s", fp, hit.describe()
+        )
+        return hit
 
     best_job: dict = {}
 
@@ -431,6 +440,18 @@ def search(
             param_specs, batch_axes, devs,
         )
         t = _score(job, profile_steps, init_fn)
+        if multiproc:
+            # Agree on the leader's measurement so GP state (and thus the
+            # next candidate) stays identical on every process.
+            from jax.experimental import multihost_utils
+
+            t = float(
+                np.asarray(
+                    multihost_utils.broadcast_one_to_all(
+                        np.asarray(t, np.float64)
+                    )
+                )
+            )
         if t < best_job.get("cost", float("inf")):
             best_job.update(job=job, cost=t, key=s.describe())
         return t
@@ -448,12 +469,10 @@ def search(
         max_evals=max_evals, warm_start=list(warm_start),
     ).run()
     best = forced(result.best)
-    if cache_obj is not None:
+    if is_leader and cache_obj is not None:
         cache_obj.put(fp, best)
     if job_out is not None and best_job.get("key") == best.describe():
         job_out["job"] = best_job["job"]
-    if multiproc:
-        broadcast_winner(best)
     return best
 
 

@@ -266,6 +266,7 @@ class Trainer:
         self._process_id = process_id
         self._ckpt = None
         self._eval_step = None
+        self._eval_step_job = None
         self._sampler_restored = False
         if args.ckpt_dir:
             import hashlib
@@ -340,9 +341,13 @@ class Trainer:
     def _build_eval_step(self):
         import jax
 
-        if self._eval_step is not None:
-            return
         job = self.core.job
+        # Rebuilt whenever the elastic core re-forms the mesh — a cached
+        # jit pinned to the old shardings would reject (or reference
+        # departed devices of) the new world's batches.
+        if self._eval_step is not None and self._eval_step_job is job:
+            return
+        self._eval_step_job = job
 
         def eval_loss(state, batch):
             return self.loss_fn(state["params"], batch)

@@ -1,0 +1,150 @@
+"""Coworker shm-ring dataloader tests: ordering, crash-respawn with
+exactly-once delivery, prefetch overlap, sampler integration (test model:
+the reference's shm_dataloader/coworker unit tests)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.data.shm_dataloader import (
+    ShmDataLoader,
+    ShmRing,
+    _pack_batch,
+    _unpack_batch,
+)
+from dlrover_tpu.trainer.sampler import ElasticSampler
+
+
+def fetch_squares(indices: np.ndarray):
+    """Module-level so the spawn-context producer can pickle it."""
+    idx = np.asarray(indices, np.int64)
+    return {
+        "x": (idx[:, None] * np.ones((1, 4))).astype(np.float32),
+        "y": (idx**2).astype(np.int64),
+    }
+
+
+def fetch_slow(indices: np.ndarray):
+    time.sleep(0.05)
+    return fetch_squares(indices)
+
+
+class TestPacking:
+    def test_round_trip(self):
+        batch = {
+            "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.array([7], dtype=np.int64),
+        }
+        buf = _pack_batch(batch)
+        out = _unpack_batch(memoryview(buf))
+        np.testing.assert_array_equal(out["a"], batch["a"])
+        np.testing.assert_array_equal(out["b"], batch["b"])
+
+
+class TestRing:
+    def test_put_get_wraparound(self):
+        ring = ShmRing("dlrtpu_test_ring_a", 4096, 2, create=True)
+        try:
+            for seq in range(5):
+                payload = _pack_batch(
+                    {"v": np.array([seq], dtype=np.int64)}
+                )
+                assert ring.put(seq, payload, timeout=5.0)
+                got = ring.get(seq, timeout=5.0)
+                assert int(got["v"][0]) == seq
+        finally:
+            ring.close(unlink=True)
+
+    def test_oversized_payload_rejected(self):
+        ring = ShmRing("dlrtpu_test_ring_b", 64, 2, create=True)
+        try:
+            with pytest.raises(ValueError, match="exceeds slot"):
+                ring.put(0, b"x" * 100)
+        finally:
+            ring.close(unlink=True)
+
+
+class TestLoader:
+    def test_yields_all_batches_in_order(self):
+        batches = [np.arange(i * 4, (i + 1) * 4) for i in range(8)]
+        with ShmDataLoader(fetch_squares, batches, n_slots=3) as loader:
+            got = list(loader)
+        assert len(got) == 8
+        for i, b in enumerate(got):
+            np.testing.assert_array_equal(
+                b["y"], (np.arange(i * 4, (i + 1) * 4) ** 2)
+            )
+
+    def test_producer_crash_respawns_exactly_once_delivery(self):
+        batches = [np.array([i]) for i in range(10)]
+        loader = ShmDataLoader(
+            fetch_squares, batches, n_slots=2, _crash_after=4
+        )
+        try:
+            got = [int(b["y"][0]) for b in loader]
+            # Every batch delivered exactly once despite the crash at 4.
+            assert got == [i * i for i in range(10)]
+            assert loader._respawns >= 1
+        finally:
+            loader.close()
+
+    def test_producer_dies_repeatedly_gives_up(self):
+        batches = [np.array([i]) for i in range(6)]
+        loader = ShmDataLoader(
+            fetch_squares, batches, n_slots=2, max_respawns=0,
+            _crash_after=2,
+        )
+        # the _crash_after=-1 reset is skipped when max_respawns=0
+        try:
+            with pytest.raises(RuntimeError, match="producer died"):
+                list(loader)
+        finally:
+            loader.close()
+
+    def test_prefetch_overlaps_fetch_with_consumption(self):
+        """Pipelined wall-clock must beat serial fetch+consume."""
+        n = 10
+        batches = [np.array([i]) for i in range(n)]
+        consume_s = 0.05
+
+        # Steady-state measurement: the first batch absorbs the one-time
+        # producer spawn (process start + imports); overlap is a property
+        # of the remaining stream.
+        with ShmDataLoader(fetch_slow, batches, n_slots=4) as loader:
+            it = iter(loader)
+            next(it)
+            t0 = time.perf_counter()
+            for _ in it:
+                time.sleep(consume_s)  # the "train step"
+            pipelined = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for b in batches[1:]:
+            fetch_slow(b)
+            time.sleep(consume_s)
+        serial = time.perf_counter() - t0
+        assert pipelined < serial * 0.85, (pipelined, serial)
+
+    def test_from_sampler_preserves_position(self):
+        sampler = ElasticSampler(
+            32, batch_size_per_process=4, num_processes=1, process_id=0,
+            seed=5,
+        )
+        # Consume 2 steps directly, then hand the rest to the loader.
+        it = iter(sampler)
+        first_two = [next(it), next(it)]
+        del it
+        expect = []
+        shadow = sampler.reshard(1, 0)
+        expect = list(shadow)
+        with ShmDataLoader.from_sampler(
+            sampler, fetch_squares, n_slots=3
+        ) as loader:
+            got = list(loader)
+        assert len(got) == len(expect) == 6  # 8 steps/epoch - 2 consumed
+        for g, e in zip(got, expect):
+            np.testing.assert_array_equal(g["y"], np.asarray(e) ** 2)
+        # And the loader never touched the sampler's own position.
+        assert sampler.completed_steps == 2
+        assert len(first_two[0]) == 4
