@@ -35,7 +35,13 @@ pid = int(os.environ.get("DLROVER_TPU_CHECK_PID", "0"))
 if coord and nproc > 1:
     jax.distributed.initialize(coord, num_processes=nproc, process_id=pid)
 import jax.numpy as jnp
-n = int(os.environ.get("DLROVER_TPU_CHECK_MATMUL_N", "1024"))
+# Payload must be big enough to discriminate a sick chip from dispatch
+# noise (reference uses a large matmul + a 16M-element allreduce): on an
+# accelerator, 8 x 4096^3 matmuls ~ 1.1 TFLOP and the allreduce moves
+# 64 MB; on CPU (tests) the small sizes keep the check sub-second.
+on_cpu = jax.default_backend() == "cpu"
+n = int(os.environ.get(
+    "DLROVER_TPU_CHECK_MATMUL_N", "512" if on_cpu else "4096"))
 x = jnp.ones((n, n), jnp.bfloat16)
 f = jax.jit(lambda a: a @ a)
 f(x).block_until_ready()  # compile outside the timed region
@@ -43,6 +49,9 @@ t0 = time.perf_counter()
 for _ in range(8):
     x = f(x)
 x.block_until_ready()
+# Fault injection for tests: a "slow node" pays a fixed tax inside the
+# timed region so straggler detection has something to catch.
+time.sleep(float(os.environ.get("DLROVER_TPU_CHECK_DELAY_S", "0")))
 matmul_t = time.perf_counter() - t0
 if coord and nproc > 1:
     from jax.sharding import Mesh, PartitionSpec as P, NamedSharding
@@ -50,7 +59,9 @@ if coord and nproc > 1:
     mesh = Mesh(np.array(jax.devices()), ("x",))
     sharding = NamedSharding(mesh, P("x"))
     g = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))
-    m = int(os.environ.get("DLROVER_TPU_CHECK_ALLREDUCE_M", "1048576"))
+    m = int(os.environ.get(
+        "DLROVER_TPU_CHECK_ALLREDUCE_M",
+        "1048576" if on_cpu else "16777216"))
     per = m // max(1, jax.device_count())
     arr = jax.make_array_from_process_local_data(
         sharding, np.ones((per * jax.local_device_count(),), np.float32))
@@ -140,6 +151,20 @@ def node_health_check(
             # Round advance is master-driven in the dist master; standalone
             # agents simply re-join and report with the next round index.
             time.sleep(1.0)
+    # Peers may still be reporting their final round; the verdict is only
+    # meaningful over the full result set, so wait for it to settle: two
+    # consecutive polls agreeing (covers the 0/1-node degenerate cases
+    # without burning the deadline) or a bounded deadline.
+    prev_times: dict = {}
+    polls = 0
+    deadline = time.time() + 15.0
+    while time.time() < deadline:
+        stragglers, times = client.get_stragglers()
+        polls += 1
+        if polls >= 2 and times == prev_times:
+            break
+        prev_times = times
+        time.sleep(0.75)
     faults, _ = client.get_fault_nodes()
     if config.node_id in faults:
         return False

@@ -46,14 +46,27 @@ def find_free_port(host: str = "") -> int:
 
 
 def addr_connectable(addr: str, timeout: float = 3.0) -> bool:
-    """TCP-probe an ``host:port`` address (reference
-    ``elastic_run.py:277 _check_dlrover_master_available``)."""
+    """TCP-probe an ``host:port`` address, retrying until ``timeout``
+    (reference ``elastic_run.py:277 _check_dlrover_master_available``,
+    which polls for up to 300s).  A refused connection fails in
+    microseconds, so a single attempt would make multi-node launches
+    race the master's startup."""
     try:
-        host, port = addr.rsplit(":", 1)
-        with socket.create_connection((host, int(port)), timeout=timeout):
-            return True
-    except (OSError, ValueError):
+        host, port_s = addr.rsplit(":", 1)
+        port = int(port_s)
+    except ValueError:
         return False
+    deadline = time.time() + timeout
+    while True:
+        try:
+            with socket.create_connection(
+                (host, port), timeout=max(1.0, deadline - time.time())
+            ):
+                return True
+        except OSError:
+            if time.time() >= deadline:
+                return False
+            time.sleep(0.5)
 
 
 def local_ip() -> str:

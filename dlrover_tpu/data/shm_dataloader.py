@@ -178,6 +178,10 @@ class ShmRing:
                 pass
 
 
+# Producer exit codes the consumer gives meaning to.
+_EXIT_OVERSIZED = 13  # a batch exceeded the slot: deterministic, no respawn
+
+
 def _producer_main(
     ring_name: str,
     slot_bytes: int,
@@ -185,6 +189,7 @@ def _producer_main(
     fetch_batch: Callable[[np.ndarray], Any],
     index_batches: list,
     start_seq: int,
+    put_timeout: float,
     crash_after: int = -1,
 ) -> None:
     """Runs in the coworker process: materialize batches, fill the ring."""
@@ -194,7 +199,14 @@ def _producer_main(
             if crash_after >= 0 and seq >= crash_after:
                 os._exit(17)  # fault injection: die mid-stream
             batch = fetch_batch(np.asarray(index_batches[seq]))
-            if not ring.put(seq, _pack_batch(batch)):
+            try:
+                payload = _pack_batch(batch)
+                ok = ring.put(seq, payload, timeout=put_timeout)
+            except ValueError:
+                # Oversized batch: retrying can never succeed — signal a
+                # fatal, non-respawnable condition to the consumer.
+                os._exit(_EXIT_OVERSIZED)
+            if not ok:
                 return
     finally:
         ring.close()
@@ -219,12 +231,22 @@ class ShmDataLoader:
         n_slots: int = 4,
         name: str = "",
         max_respawns: int = 3,
+        batch_timeout: float = 600.0,
+        stall_timeout: float = 3600.0,
         _crash_after: int = -1,  # test hook
     ):
+        """``batch_timeout``: how long the consumer waits for one batch
+        from a LIVE producer before giving up (cover the coworker's spawn
+        imports + the slowest single fetch).  ``stall_timeout``: how long
+        the producer waits for a free slot before concluding the consumer
+        is gone — cover the longest consumer pause (eval pass, checkpoint
+        persist, re-mesh recompiles)."""
         self.fetch_batch = fetch_batch
         self.index_batches = [np.asarray(b) for b in index_batches]
         self.n_slots = max(2, n_slots)
         self.max_respawns = max_respawns
+        self.batch_timeout = batch_timeout
+        self.stall_timeout = stall_timeout
         self._crash_after = _crash_after
         self.name = name or f"dlrtpu_ring_{os.getpid()}_{id(self) & 0xFFFF}"
         if slot_bytes <= 0 and self.index_batches:
@@ -246,7 +268,7 @@ class ShmDataLoader:
             args=(
                 self.name, self.slot_bytes, self.n_slots,
                 self.fetch_batch, self.index_batches, start_seq,
-                self._crash_after,
+                self.stall_timeout, self._crash_after,
             ),
             daemon=True,
         )
@@ -265,12 +287,25 @@ class ShmDataLoader:
         while self._consumed < len(self.index_batches):
             seq = self._consumed
             batch = self._ring.get(
-                seq, alive=self._producer_alive
+                seq, alive=self._producer_alive,
+                timeout=self.batch_timeout,
             )
             if batch is None:
                 if self._producer_alive():
                     raise TimeoutError(
-                        f"shm dataloader: batch {seq} not produced in time"
+                        f"shm dataloader: batch {seq} not produced within "
+                        f"batch_timeout={self.batch_timeout}s; raise it if "
+                        "single-batch materialization is legitimately "
+                        "slower"
+                    )
+                code = self._proc.exitcode if self._proc else None
+                if code == _EXIT_OVERSIZED:
+                    raise ValueError(
+                        f"shm dataloader: batch {seq} exceeds the "
+                        f"{self.slot_bytes}B slot — pass a larger "
+                        "slot_bytes (auto-sizing uses batch 0 + 25% "
+                        "headroom, which variable-shaped batches can "
+                        "overflow); not respawning a deterministic failure"
                     )
                 # Producer died with nothing READY for us: respawn it at
                 # exactly the next needed batch (no loss, no duplicates).
@@ -280,7 +315,6 @@ class ShmDataLoader:
                         "shm dataloader: producer died "
                         f"{self._respawns} times; giving up"
                     )
-                code = self._proc.exitcode if self._proc else None
                 logger.warning(
                     "shm dataloader: producer died (exit=%s); respawning "
                     "at batch %d", code, seq,

@@ -359,7 +359,14 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             if len(times) < 2:
                 return times, []
             values = sorted(times.values())
-            median = values[len(values) // 2]
+            # True median: averaging the middles matters for even counts —
+            # picking the upper-middle would let the slow half of a 2-node
+            # pair define the baseline and never exceed it.
+            mid = len(values) // 2
+            if len(values) % 2:
+                median = values[mid]
+            else:
+                median = 0.5 * (values[mid - 1] + values[mid])
             if median <= 0:
                 return times, []
             thr = self._ctx.straggler_threshold

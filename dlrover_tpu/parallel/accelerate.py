@@ -435,10 +435,31 @@ def search(
 
     def objective(s: Strategy) -> float:
         s = forced(s)
-        job = _compile_candidate(
-            s, loss_fn, init_fn, optimizer, sample_batch,
-            param_specs, batch_axes, devs,
-        )
+        # Compile is host-local; a subset-of-hosts failure must be agreed
+        # on BEFORE anyone launches the timed steps (collectives), or the
+        # healthy hosts block in a program the failed host never joins.
+        job, err = None, None
+        try:
+            job = _compile_candidate(
+                s, loss_fn, init_fn, optimizer, sample_batch,
+                param_specs, batch_axes, devs,
+            )
+        except Exception as e:  # noqa: BLE001
+            err = e
+        if multiproc:
+            from jax.experimental import multihost_utils
+
+            oks = np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray(1 if job is not None else 0, np.int32)
+                )
+            )
+            if not bool(np.all(oks)):
+                raise err or RuntimeError(
+                    f"{s.describe()} infeasible on a peer process"
+                )
+        elif job is None:
+            raise err  # type: ignore[misc]
         t = _score(job, profile_steps, init_fn)
         if multiproc:
             # Agree on the leader's measurement so GP state (and thus the
@@ -471,7 +492,14 @@ def search(
     best = forced(result.best)
     if is_leader and cache_obj is not None:
         cache_obj.put(fp, best)
-    if job_out is not None and best_job.get("key") == best.describe():
+    # The compiled-winner shortcut is single-process only: in multiproc a
+    # host whose local compile of the winner failed mid-search would skip
+    # the final compile while peers re-run it — paths must stay symmetric.
+    if (
+        not multiproc
+        and job_out is not None
+        and best_job.get("key") == best.describe()
+    ):
         job_out["job"] = best_job["job"]
     return best
 

@@ -69,19 +69,15 @@ class TestEndToEnd:
             [f"--ckpt_dir={ckpt_dir}", "--ckpt_interval=3"],
             steps=2000,  # long enough that the kill lands mid-run
         )
-        # Wait for a checkpoint to be staged (step >= 3 reported).
-        worker_pids = []
+        # Wait for a checkpoint to be staged (step >= 10 reported).
         deadline = time.time() + 300
         killed = False
         while time.time() < deadline:
             content = _read(log) if os.path.exists(log) else ""
             m = re.search(r"started 2 worker\(s\): pids=\[(\d+), (\d+)\]",
                           content)
-            if m and "step 10 " in content.replace("step 10\n", "step 10 "):
-                pass
             if m and re.search(r"step (1[0-9]|[2-9][0-9]) loss", content):
-                worker_pids = [int(m.group(1)), int(m.group(2))]
-                os.kill(worker_pids[1], signal.SIGKILL)
+                os.kill(int(m.group(2)), signal.SIGKILL)
                 killed = True
                 break
             if proc.poll() is not None:
@@ -101,15 +97,200 @@ class TestEndToEnd:
                 break
             time.sleep(2.0)
         content = _read(log)
-        assert "breakpoint save" in content or "persisted" in content, (
-            content[-3000:]
-        )
+        # The kill must have been absorbed via the agent's breakpoint save
+        # (staged-but-unpersisted state flushed before restarting workers).
+        assert "breakpoint save" in content, content[-3000:]
         assert restored, "no restore observed:\n" + content[-3000:]
         step = int(re.search(r"restored step=(\d+)", content).group(1))
         assert step >= 3
+        # And specifically the warm path: same host, staged shm state —
+        # restore must come from shm, not a storage round trip.
+        assert "warm restore from shm" in content, content[-3000:]
         proc.send_signal(signal.SIGTERM)
         try:
             proc.wait(timeout=60)
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait()
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _start_master(tmp_path, job_name, port, extra=()):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    log = open(tmp_path / "master.log", "w")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dlrover_tpu.master.main",
+            f"--port={port}", f"--job_name={job_name}",
+            "--min_nodes=2", "--max_nodes=2", *extra,
+        ],
+        cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
+    )
+    return proc, tmp_path / "master.log"
+
+
+def _start_node(tmp_path, job_name, master_port, node_rank, script_args,
+                env_extra=None):
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PYTHONPATH": REPO,
+        }
+    )
+    if env_extra:
+        env.update(env_extra)
+    log = open(tmp_path / f"node{node_rank}.log", "w")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dlrover_tpu.run",
+            "--nnodes=2", "--nproc_per_node=1",
+            f"--node_rank={node_rank}",
+            f"--master_addr=127.0.0.1:{master_port}",
+            f"--job_name={job_name}",
+            "--monitor_interval=1",
+            *script_args,
+        ],
+        cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
+    )
+    return proc, tmp_path / f"node{node_rank}.log"
+
+
+@pytest.mark.e2e
+class TestMultiNode:
+    def test_agent_kill_node_relaunch(self, tmp_path):
+        """Kill a whole NODE (its agent process), not just a worker: the
+        master must evict the dead incarnation, the surviving node must
+        re-rendezvous with the replacement, and training must resume from
+        the flash checkpoint (VERDICT round-1 e2e matrix item)."""
+        job = "e2e-agentkill"
+        port = _free_port()
+        ckpt = str(tmp_path / "ckpt")
+        mproc, mlog = _start_master(tmp_path, job, port)
+        script = [
+            os.path.join(REPO, "examples", "nanogpt_train.py"),
+            "--", "--steps=2000", f"--ckpt_dir={ckpt}",
+            "--ckpt_interval=3", "--batch_per_proc=2",
+        ]
+        n0, log0 = _start_node(tmp_path, job, port, 0, script)
+        n1, log1 = _start_node(tmp_path, job, port, 1, script)
+        procs = [mproc, n0, n1]
+        try:
+            # Wait until both nodes are training (a double-digit step).
+            deadline = time.time() + 420
+            while time.time() < deadline:
+                c1 = _read(log1) if os.path.exists(log1) else ""
+                if re.search(r"step (1[0-9]|[2-9][0-9]) loss", c1):
+                    break
+                for p, plog, nm in (
+                    (mproc, mlog, "master"),
+                    (n0, log0, "node0"),
+                    (n1, log1, "node1"),
+                ):
+                    if p.poll() is not None:
+                        pytest.fail(
+                            f"{nm} exited early:\n" + _read(plog)[-3000:]
+                        )
+                time.sleep(1.0)
+            else:
+                pytest.fail("never reached training:\n" + _read(log1)[-3000:])
+
+            n1.kill()  # SIGKILL the agent: the whole node dies
+            n1.wait(timeout=30)
+
+            # Platform-relaunch stand-in: a replacement agent process for
+            # the same node_rank (what the reconciler/GKE would do).
+            time.sleep(3.0)
+            n1b, log1b = _start_node(
+                tmp_path, job, port, 1, script,
+            )
+            procs.append(n1b)
+
+            resumed = False
+            deadline = time.time() + 420
+            while time.time() < deadline:
+                c1b = _read(log1b) if os.path.exists(log1b) else ""
+                if re.search(r"restored step=(\d+)", c1b) and re.search(
+                    r"step \d+ loss", c1b
+                ):
+                    resumed = True
+                    break
+                if n1b.poll() is not None:
+                    pytest.fail(
+                        "replacement node exited:\n" + c1b[-3000:]
+                    )
+                time.sleep(2.0)
+            c1b = _read(log1b)
+            assert resumed, (
+                "replacement never resumed:\nnode1b:\n" + c1b[-2500:]
+                + "\nnode0:\n" + _read(log0)[-1500:]
+            )
+            step = int(re.search(r"restored step=(\d+)", c1b).group(1))
+            assert step >= 3
+            # The surviving node went through a fresh rendezvous round.
+            assert re.search(r"restored step=\d+", _read(log0))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
+    def test_network_check_flags_slow_node(self, tmp_path):
+        """Pre-flight node check with an injected slow node: the paired
+        benchmark must finish on both nodes and the master's straggler
+        detection must flag the slow one (VERDICT round-1 item; reference
+        NetworkCheckRendezvousManager straggler isolation)."""
+        job = "e2e-netcheck"
+        port = _free_port()
+        mproc, mlog = _start_master(
+            tmp_path, job, port, extra=("--network_check",)
+        )
+        script = [
+            "--network_check",
+            os.path.join(REPO, "examples", "nanogpt_train.py"),
+            "--", "--steps=4", "--batch_per_proc=2",
+        ]
+        n0, log0 = _start_node(tmp_path, job, port, 0, script)
+        n1, log1 = _start_node(
+            tmp_path, job, port, 1, script,
+            env_extra={"DLROVER_TPU_CHECK_DELAY_S": "3"},
+        )
+        procs = [mproc, n0, n1]
+        try:
+            rc0 = n0.wait(timeout=600)
+            rc1 = n1.wait(timeout=600)
+            c0, c1 = _read(log0), _read(log1)
+            assert rc0 == 0, c0[-3000:]
+            assert rc1 == 0, c1[-3000:]
+            # Both checks ran to completion...
+            assert "node check round 1" in c0
+            assert "node check round 1" in c1
+            # ...and the delayed node (only) was flagged as the straggler.
+            assert "flagged as straggler" in c1, c1[-3000:]
+            assert "flagged as straggler" not in c0, c0[-3000:]
+            # The check is advisory for stragglers: training still ran.
+            assert "TRAIN_DONE" in c0 and "TRAIN_DONE" in c1
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
