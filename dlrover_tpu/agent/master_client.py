@@ -238,11 +238,17 @@ class MasterClient:
             return resp.nodes, resp.reason
         return [], ""
 
-    def get_stragglers(self) -> Tuple[List[int], dict]:
+    def get_stragglers(
+        self, full: bool = False
+    ) -> Tuple[List[int], dict]:
+        """(straggler node ids, elapsed-by-node); with ``full`` also a
+        completeness flag for the latest check round."""
         resp = self._client.call(m.StragglerRequest())
         if isinstance(resp, m.Stragglers):
+            if full:
+                return resp.nodes, resp.times, resp.complete
             return resp.nodes, resp.times
-        return [], {}
+        return ([], {}, False) if full else ([], {})
 
     # -- metrics -----------------------------------------------------------
     def report_global_step(self, step: int, timestamp: float = 0.0) -> None:

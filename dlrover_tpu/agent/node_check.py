@@ -152,18 +152,14 @@ def node_health_check(
             # agents simply re-join and report with the next round index.
             time.sleep(1.0)
     # Peers may still be reporting their final round; the verdict is only
-    # meaningful over the full result set, so wait for it to settle: two
-    # consecutive polls agreeing (covers the 0/1-node degenerate cases
-    # without burning the deadline) or a bounded deadline.
-    prev_times: dict = {}
-    polls = 0
-    deadline = time.time() + 15.0
+    # final once the master has every participant's result (the `complete`
+    # flag) — a stability heuristic would false-settle exactly when a peer
+    # is the straggler being waited on.
+    deadline = time.time() + 30.0
     while time.time() < deadline:
-        stragglers, times = client.get_stragglers()
-        polls += 1
-        if polls >= 2 and times == prev_times:
+        _, _, complete = client.get_stragglers(full=True)
+        if complete:
             break
-        prev_times = times
         time.sleep(0.75)
     faults, _ = client.get_fault_nodes()
     if config.node_id in faults:

@@ -1,0 +1,15 @@
+"""Brain: the out-of-job optimization service (L1, reference ``go/brain``).
+
+The reference runs a Go service backed by MySQL that collects job runtime
+metrics, serves resource-optimization plans computed by pluggable
+algorithms (``pkg/optimizer/implementation/optalgorithm``), and hosts the
+Bayesian hyperparameter search (``python/brain/hpsearch/bo.py``).  The
+TPU-native build keeps the same split on lighter infrastructure: a
+sqlite-persisted metrics store, the same algorithm surface, and the RPC
+control plane this framework already speaks.
+"""
+
+from dlrover_tpu.brain.hpsearch import BayesianOptimizer  # noqa: F401
+from dlrover_tpu.brain.optimizer import BrainResourceOptimizer  # noqa: F401
+from dlrover_tpu.brain.service import BrainService  # noqa: F401
+from dlrover_tpu.brain.store import JobMetricsStore  # noqa: F401

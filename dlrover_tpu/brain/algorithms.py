@@ -1,0 +1,114 @@
+"""Optimization algorithms over stored job metrics.
+
+Reference surface: ``go/brain/pkg/optimizer/implementation/optalgorithm``
+— notably ``optimize_job_worker_resource.go:1`` (scale the worker count
+along the measured speed curve until marginal gain decays) and the
+create-resource algorithms that seed a new job from similar historical
+jobs' peak usage.  TPU adaptation: worker counts move in ``node_unit``
+quanta and device memory is excluded (HBM working set is a sharding
+concern, not a scheduler one).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dlrover_tpu.brain.store import JobMetricsStore
+
+# Resource headroom over observed peaks for cold-start plans (the
+# reference applies similar safety factors over historical usage).
+_MEM_MARGIN = 1.4
+_CPU_MARGIN = 1.25
+
+
+def fit_speed_curve(
+    points: Sequence[Tuple[int, float]]
+) -> Optional[Tuple[float, float]]:
+    """Fit the diminishing-returns model ``speed(n) = a*n / (1 + b*n)``
+    (Amdahl-flavoured) to (workers, speed) observations; returns (a, b)
+    or None if underdetermined.  Linearized: n/speed = (1/a) + (b/a)*n.
+    """
+    pts = [(n, s) for n, s in points if n > 0 and s > 0]
+    if len({n for n, _ in pts}) < 2:
+        return None
+    n = np.array([p[0] for p in pts], np.float64)
+    s = np.array([p[1] for p in pts], np.float64)
+    y = n / s
+    A = np.stack([np.ones_like(n), n], axis=1)
+    (c0, c1), *_ = np.linalg.lstsq(A, y, rcond=None)
+    if c0 <= 0:
+        return None
+    a = 1.0 / c0
+    b = max(0.0, c1 * a)
+    return float(a), float(b)
+
+
+def predict_speed(ab: Tuple[float, float], n: int) -> float:
+    a, b = ab
+    return a * n / (1.0 + b * n)
+
+
+def optimize_worker_count(
+    curve: Sequence[Tuple[int, float]],
+    current: int,
+    *,
+    max_workers: int,
+    node_unit: int = 1,
+    marginal_threshold: float = 0.5,
+) -> Optional[int]:
+    """Recommend a worker count: walk up in ``node_unit`` steps while the
+    model's marginal speedup per added worker stays above
+    ``marginal_threshold`` of the per-worker speed at the current count
+    (reference OptimizeJobWorkerResource's throughput-slope rule); walk
+    DOWN when the marginal contribution of the last increment was below
+    threshold.  None = no change."""
+    ab = fit_speed_curve(curve)
+    if ab is None or current <= 0:
+        return None
+    per_worker_now = predict_speed(ab, current) / current
+    best = current
+    # Scale up while marginal gain holds.
+    n = current
+    while n + node_unit <= max_workers:
+        gain = predict_speed(ab, n + node_unit) - predict_speed(ab, n)
+        if gain / (node_unit * per_worker_now) < marginal_threshold:
+            break
+        n += node_unit
+        best = n
+    if best != current:
+        return best
+    # Consider scaling down: if removing a unit costs almost nothing,
+    # the tail workers are wasted.
+    if current - node_unit >= node_unit:
+        loss = predict_speed(ab, current) - predict_speed(
+            ab, current - node_unit
+        )
+        if loss / (node_unit * per_worker_now) < marginal_threshold / 2:
+            return current - node_unit
+    return None
+
+
+def cold_start_resources(
+    store: JobMetricsStore, job_name: str
+) -> Optional[Dict[str, float]]:
+    """Initial per-worker resources from similar completed jobs' peak
+    usage (reference optimize_job_*_create_resource): margins over the
+    max of the last few runs."""
+    peaks_cpu: List[float] = []
+    peaks_mem: List[float] = []
+    for uuid in store.similar_completed_jobs(job_name):
+        cpu, mem = store.peak_usage(uuid)
+        if cpu > 0:
+            peaks_cpu.append(cpu)
+        if mem > 0:
+            peaks_mem.append(mem)
+    if not peaks_cpu and not peaks_mem:
+        return None
+    out: Dict[str, float] = {}
+    if peaks_cpu:
+        out["cpu_percent"] = max(peaks_cpu) * _CPU_MARGIN
+    if peaks_mem:
+        out["memory_mb"] = max(peaks_mem) * _MEM_MARGIN
+    return out

@@ -1,0 +1,144 @@
+"""Bayesian hyperparameter search over bounded continuous/integer spaces.
+
+Parity with reference ``dlrover/python/brain/hpsearch/bo.py:148``
+(``BayesianOptimizer`` over scikit-learn GPs) — here a small exact numpy
+GP with expected-improvement acquisition maximized over random candidate
+draws, which matches the reference's ask/tell surface without the
+sklearn dependency.  Minimization convention (negate for rewards).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Param:
+    name: str
+    low: float
+    high: float
+    integer: bool = False
+    log: bool = False  # search in log10 space (e.g. learning rates)
+
+    def to_unit(self, v: float) -> float:
+        lo, hi = self._range()
+        x = np.log10(v) if self.log else v
+        return (x - lo) / (hi - lo)
+
+    def from_unit(self, u: float) -> float:
+        lo, hi = self._range()
+        x = lo + float(np.clip(u, 0.0, 1.0)) * (hi - lo)
+        v = 10.0**x if self.log else x
+        if self.integer:
+            v = float(int(round(v)))
+        return v
+
+    def _range(self) -> Tuple[float, float]:
+        if self.log:
+            return np.log10(self.low), np.log10(self.high)
+        return self.low, self.high
+
+
+class BayesianOptimizer:
+    """Ask/tell BO: ``suggest()`` proposes configs, ``observe()`` records
+    results; repeat.  ``minimize()`` wraps the loop for a callable."""
+
+    def __init__(
+        self,
+        params: Sequence[Param],
+        *,
+        n_init: int = 4,
+        candidates_per_step: int = 256,
+        seed: int = 0,
+    ):
+        self.params = list(params)
+        self.n_init = n_init
+        self.n_candidates = candidates_per_step
+        self.rng = np.random.default_rng(seed)
+        self._X: List[np.ndarray] = []  # unit-cube points
+        self._y: List[float] = []
+
+    # -- ask/tell ------------------------------------------------------------
+    def suggest(self, n: int = 1) -> List[Dict[str, float]]:
+        return [self._suggest_one() for _ in range(n)]
+
+    def observe(self, config: Dict[str, float], value: float) -> None:
+        u = np.array(
+            [p.to_unit(config[p.name]) for p in self.params], np.float64
+        )
+        self._X.append(u)
+        self._y.append(float(value))
+
+    @property
+    def best(self) -> Tuple[Optional[Dict[str, float]], float]:
+        finite = [
+            (x, y) for x, y in zip(self._X, self._y) if np.isfinite(y)
+        ]
+        if not finite:
+            return None, float("inf")
+        x, y = min(finite, key=lambda t: t[1])
+        return self._to_config(x), y
+
+    # -- internals -----------------------------------------------------------
+    def _to_config(self, u: np.ndarray) -> Dict[str, float]:
+        return {
+            p.name: p.from_unit(u[i]) for i, p in enumerate(self.params)
+        }
+
+    def _suggest_one(self) -> Dict[str, float]:
+        d = len(self.params)
+        finite = [
+            (x, y) for x, y in zip(self._X, self._y) if np.isfinite(y)
+        ]
+        if len(finite) < self.n_init:
+            return self._to_config(self.rng.random(d))
+        X = np.stack([x for x, _ in finite])
+        y = np.array([v for _, v in finite])
+        ymean, ystd = y.mean(), y.std() or 1.0
+        yn = (y - ymean) / ystd
+        ls = 0.3
+        K = self._rbf(X, X, ls) + 1e-5 * np.eye(len(X))
+        try:
+            L = np.linalg.cholesky(K)
+        except np.linalg.LinAlgError:
+            return self._to_config(self.rng.random(d))
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        cand = self.rng.random((self.n_candidates, d))
+        Ks = self._rbf(cand, X, ls)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        sigma = np.sqrt(np.clip(1.0 - (v**2).sum(0), 1e-12, None))
+        best = float(yn.min())
+        z = (best - mu) / sigma
+        from scipy.special import ndtr
+
+        ei = (best - mu) * ndtr(z) + sigma * np.exp(-0.5 * z**2) / np.sqrt(
+            2 * np.pi
+        )
+        return self._to_config(cand[int(np.argmax(ei))])
+
+    @staticmethod
+    def _rbf(A: np.ndarray, B: np.ndarray, ls: float) -> np.ndarray:
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / ls**2)
+
+    # -- convenience loop ----------------------------------------------------
+    def minimize(
+        self,
+        fn: Callable[[Dict[str, float]], float],
+        n_trials: int = 20,
+    ) -> Tuple[Dict[str, float], float]:
+        for _ in range(n_trials):
+            cfg = self._suggest_one()
+            try:
+                val = float(fn(cfg))
+            except Exception:  # noqa: BLE001 - infeasible config
+                val = float("inf")
+            self.observe(cfg, val)
+        best_cfg, best_val = self.best
+        if best_cfg is None:
+            raise RuntimeError("hpsearch: every trial failed")
+        return best_cfg, best_val

@@ -1,0 +1,157 @@
+"""Master-side ResourceOptimizer backed by the Brain service.
+
+Reference ``dlrover/python/master/resource/brain_optimizer.py:64``
+(``BrainResourceOptimizer``): same ABC as the local heuristics, but every
+decision is an RPC to the out-of-job service, falling back to "no plan"
+when the brain is unreachable (the master then keeps its local policy).
+"""
+
+from __future__ import annotations
+
+import uuid as uuid_mod
+from typing import List, Optional
+
+from dlrover_tpu.brain.service import (
+    BrainJobEvent,
+    BrainOptimizeRequest,
+    BrainPlan,
+    BrainRuntimeReport,
+)
+from dlrover_tpu.common.constants import NodeExitReason, NodeType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.common.rpc import RpcClient
+from dlrover_tpu.master.resource_optimizer import (
+    ResourceOptimizer,
+    ResourcePlan,
+)
+
+
+class BrainResourceOptimizer(ResourceOptimizer):
+    def __init__(
+        self,
+        brain_addr: str,
+        job_name: str,
+        *,
+        job_uuid: str = "",
+        max_workers: int = 0,
+        node_unit: int = 1,
+        timeout: float = 10.0,
+    ):
+        self.job_name = job_name
+        self.job_uuid = job_uuid or f"{job_name}-{uuid_mod.uuid4().hex[:8]}"
+        self.max_workers = max_workers
+        self.node_unit = node_unit
+        self._client = RpcClient(brain_addr, timeout=timeout)
+        self._call(
+            BrainJobEvent(
+                job_uuid=self.job_uuid, job_name=job_name, op="create"
+            )
+        )
+
+    def _call(self, msg) -> Optional[BrainPlan]:
+        # Brain advice is best-effort: never let retry backoff serialize
+        # the caller (the auto-scaler's backfill pass runs on this thread).
+        try:
+            resp = self._client.call(msg, retries=2, backoff=0.2)
+        except Exception as e:  # noqa: BLE001 - brain down: no plan
+            logger.warning("brain unreachable: %s", e)
+            return None
+        return resp if isinstance(resp, BrainPlan) else None
+
+    # -- metric feed (the master's speed monitor calls this) -----------------
+    def report_runtime(
+        self,
+        num_workers: int,
+        speed: float,
+        cpu_percent: float = 0.0,
+        memory_mb: float = 0.0,
+    ) -> None:
+        try:
+            # Fire-and-forget telemetry: one attempt, short deadline.
+            self._client.call(
+                BrainRuntimeReport(
+                    job_uuid=self.job_uuid, num_workers=num_workers,
+                    speed=speed, cpu_percent=cpu_percent,
+                    memory_mb=memory_mb,
+                ),
+                timeout=3.0, retries=1,
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.debug("brain report failed: %s", e)
+
+    def finish(self, success: bool = True) -> None:
+        self._call(
+            BrainJobEvent(
+                job_uuid=self.job_uuid, job_name=self.job_name,
+                op="complete" if success else "fail",
+            )
+        )
+
+    # -- ResourceOptimizer ---------------------------------------------------
+    def generate_job_create_resource(self) -> ResourcePlan:
+        plan = ResourcePlan()
+        resp = self._call(
+            BrainOptimizeRequest(
+                job_uuid=self.job_uuid, job_name=self.job_name,
+                kind="create",
+            )
+        )
+        if resp is None or not resp.success:
+            return plan
+        res = NodeResource(
+            cpu=float(resp.resources.get("cpu_percent", 0.0)) / 100.0,
+            memory_mb=int(resp.resources.get("memory_mb", 0)),
+        )
+        plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+            count=0, node_resource=res
+        )
+        return plan
+
+    def generate_oom_recovery_plan(
+        self, oom_nodes: List[Node]
+    ) -> ResourcePlan:
+        plan = ResourcePlan()
+        for node in oom_nodes:
+            if node.exit_reason != NodeExitReason.OOM:
+                continue
+            resp = self._call(
+                BrainOptimizeRequest(
+                    job_uuid=self.job_uuid, job_name=self.job_name,
+                    kind="oom",
+                    memory_mb=float(node.config_resource.memory_mb),
+                    cpu_percent=node.config_resource.cpu * 100.0,
+                )
+            )
+            if resp is None or not resp.success:
+                continue
+            plan.node_resources[node.name] = NodeResource(
+                cpu=node.config_resource.cpu,
+                memory_mb=int(resp.resources.get("memory_mb", 0)),
+                tpu_chips=node.config_resource.tpu_chips,
+                tpu_type=node.config_resource.tpu_type,
+            )
+        return plan
+
+    def generate_resource_plan_with_optimizer(
+        self, stats: dict
+    ) -> ResourcePlan:
+        plan = ResourcePlan()
+        current = stats.get("current_workers", 0)
+        resp = self._call(
+            BrainOptimizeRequest(
+                job_uuid=self.job_uuid, job_name=self.job_name,
+                kind="workers", current_workers=current,
+                max_workers=self.max_workers, node_unit=self.node_unit,
+            )
+        )
+        if resp is None or not resp.success or resp.worker_count < 0:
+            return plan
+        if resp.worker_count != current:
+            plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+                count=resp.worker_count, node_resource=NodeResource()
+            )
+        return plan
+
+    def close(self) -> None:
+        self._client.close()

@@ -1,0 +1,165 @@
+"""The Brain RPC service: metrics sink + plan oracle.
+
+Reference: ``go/brain`` (``pkg/server`` gRPC surface: persist_metrics +
+optimize, backed by the datastore and the optimizer implementations).
+One process can serve many jobs' masters; masters talk to it through
+:class:`~dlrover_tpu.brain.optimizer.BrainResourceOptimizer`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from dlrover_tpu.brain import algorithms
+from dlrover_tpu.brain.store import JobMetricsStore
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RpcServer, local_ip
+
+
+# -- wire messages (register into the shared typed registry) -----------------
+
+
+@dataclasses.dataclass
+class BrainJobEvent(m.Message):
+    """Master -> brain: job lifecycle (op: create | complete | fail)."""
+
+    job_uuid: str = ""
+    job_name: str = ""
+    op: str = "create"
+    config: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class BrainRuntimeReport(m.Message):
+    """Master -> brain: periodic runtime stats."""
+
+    job_uuid: str = ""
+    num_workers: int = 0
+    speed: float = 0.0
+    cpu_percent: float = 0.0
+    memory_mb: float = 0.0
+
+
+@dataclasses.dataclass
+class BrainOptimizeRequest(m.Message):
+    """Master -> brain: ask for a plan.  kind: create | workers | oom."""
+
+    job_uuid: str = ""
+    job_name: str = ""
+    kind: str = "workers"
+    current_workers: int = 0
+    max_workers: int = 0
+    node_unit: int = 1
+    # oom kind: current per-node resources
+    memory_mb: float = 0.0
+    cpu_percent: float = 0.0
+
+
+@dataclasses.dataclass
+class BrainPlan(m.Message):
+    success: bool = True
+    reason: str = ""
+    worker_count: int = -1  # -1 = no recommendation
+    resources: dict = dataclasses.field(default_factory=dict)
+
+
+class BrainServicer:
+    def __init__(self, store: JobMetricsStore):
+        self.store = store
+
+    def __call__(self, msg: m.Message) -> Optional[m.Message]:
+        try:
+            if isinstance(msg, BrainJobEvent):
+                return self._on_job_event(msg)
+            if isinstance(msg, BrainRuntimeReport):
+                self.store.record_runtime(
+                    msg.job_uuid, msg.num_workers, msg.speed,
+                    msg.cpu_percent, msg.memory_mb,
+                )
+                return m.BaseResponse(success=True)
+            if isinstance(msg, BrainOptimizeRequest):
+                return self._on_optimize(msg)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("brain request failed")
+            return m.BaseResponse(
+                success=False, reason=f"{type(e).__name__}: {e}"
+            )
+        return m.BaseResponse(success=False, reason="bad message")
+
+    def _on_job_event(self, msg: BrainJobEvent) -> m.Message:
+        if msg.op == "create":
+            self.store.create_job(msg.job_uuid, msg.job_name, msg.config)
+        elif msg.op in ("complete", "fail"):
+            self.store.finish_job(
+                msg.job_uuid,
+                "completed" if msg.op == "complete" else "failed",
+            )
+        return m.BaseResponse(success=True)
+
+    def _on_optimize(self, msg: BrainOptimizeRequest) -> BrainPlan:
+        if msg.kind == "create":
+            res = algorithms.cold_start_resources(self.store, msg.job_name)
+            if res is None:
+                return BrainPlan(
+                    success=False, reason="no similar completed jobs"
+                )
+            return BrainPlan(resources=res)
+        if msg.kind == "workers":
+            curve = self.store.speed_curve(msg.job_uuid)
+            count = algorithms.optimize_worker_count(
+                curve, msg.current_workers,
+                max_workers=msg.max_workers or 10**6,
+                node_unit=max(1, msg.node_unit),
+            )
+            if count is None:
+                return BrainPlan(reason="no change")
+            return BrainPlan(worker_count=count)
+        if msg.kind == "oom":
+            return BrainPlan(
+                resources={
+                    "memory_mb": max(1.0, msg.memory_mb) * 1.5,
+                    "cpu_percent": msg.cpu_percent,
+                }
+            )
+        return BrainPlan(success=False, reason=f"bad kind {msg.kind!r}")
+
+
+class BrainService:
+    """Standalone brain process wrapper (also embeddable in tests)."""
+
+    def __init__(self, db_path: str = ":memory:", port: int = 0):
+        self.store = JobMetricsStore(db_path)
+        self.servicer = BrainServicer(self.store)
+        self._server = RpcServer(port, self.servicer)
+        self._server.start()
+        self.addr = f"{local_ip()}:{self._server.port}"
+        logger.info("brain service at %s (db=%s)", self.addr, db_path)
+
+    def stop(self) -> None:
+        self._server.stop()
+        self.store.close()
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin CLI shell
+    import argparse
+    import threading
+
+    p = argparse.ArgumentParser("dlrover-tpu-brain")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--db", default="brain.sqlite")
+    args = p.parse_args(argv)
+    svc = BrainService(args.db, args.port)
+    print(f"BRAIN_ADDR {svc.addr}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        svc.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

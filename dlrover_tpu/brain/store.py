@@ -1,0 +1,128 @@
+"""Persistent job-metrics store (reference ``go/brain/pkg/datastore`` +
+the MySQL job_metrics/job_node tables): sqlite keeps it dependency-free
+while surviving master/brain restarts, which is what the cold-start
+algorithms need — a new job's initial resources come from *prior* jobs'
+observed usage."""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class JobMetricsStore:
+    def __init__(self, path: str = ":memory:"):
+        if path != ":memory:":
+            os.makedirs(
+                os.path.dirname(os.path.abspath(path)), exist_ok=True
+            )
+        self._lock = threading.Lock()
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.executescript(
+            """
+            CREATE TABLE IF NOT EXISTS jobs (
+                uuid TEXT PRIMARY KEY,
+                name TEXT,
+                created REAL,
+                status TEXT DEFAULT 'running',
+                config TEXT DEFAULT '{}'
+            );
+            CREATE TABLE IF NOT EXISTS runtime_metrics (
+                job_uuid TEXT,
+                ts REAL,
+                num_workers INTEGER,
+                speed REAL,          -- global samples/s
+                cpu_percent REAL,    -- mean per-worker host cpu
+                memory_mb REAL       -- peak per-worker host memory
+            );
+            CREATE INDEX IF NOT EXISTS idx_rm_job
+                ON runtime_metrics (job_uuid, ts);
+            """
+        )
+
+    # -- writes --------------------------------------------------------------
+    def create_job(self, uuid: str, name: str, config: dict = None) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO jobs (uuid, name, created, config) "
+                "VALUES (?, ?, ?, ?)",
+                (uuid, name, time.time(), json.dumps(config or {})),
+            )
+            self._db.commit()
+
+    def finish_job(self, uuid: str, status: str = "completed") -> None:
+        with self._lock:
+            self._db.execute(
+                "UPDATE jobs SET status = ? WHERE uuid = ?", (status, uuid)
+            )
+            self._db.commit()
+
+    def record_runtime(
+        self,
+        uuid: str,
+        num_workers: int,
+        speed: float,
+        cpu_percent: float = 0.0,
+        memory_mb: float = 0.0,
+    ) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO runtime_metrics VALUES (?, ?, ?, ?, ?, ?)",
+                (uuid, time.time(), num_workers, speed, cpu_percent,
+                 memory_mb),
+            )
+            self._db.commit()
+
+    # -- reads ---------------------------------------------------------------
+    def speed_curve(self, uuid: str) -> List[Tuple[int, float]]:
+        """Latest observed speed per distinct worker count, time-ordered."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT num_workers, speed, ts FROM runtime_metrics "
+                "WHERE job_uuid = ? ORDER BY ts", (uuid,)
+            ).fetchall()
+        latest: Dict[int, Tuple[float, float]] = {}
+        order: List[int] = []
+        for n, s, ts in rows:
+            if n not in latest:
+                order.append(n)
+            latest[n] = (s, ts)
+        return [(n, latest[n][0]) for n in order]
+
+    def peak_usage(self, uuid: str) -> Tuple[float, float]:
+        """(max cpu_percent, max memory_mb) seen for the job."""
+        with self._lock:
+            row = self._db.execute(
+                "SELECT MAX(cpu_percent), MAX(memory_mb) FROM "
+                "runtime_metrics WHERE job_uuid = ?", (uuid,)
+            ).fetchone()
+        return (row[0] or 0.0, row[1] or 0.0)
+
+    def similar_completed_jobs(
+        self, name: str, limit: int = 5
+    ) -> List[str]:
+        """uuids of completed jobs sharing ``name`` (newest first) — the
+        cold-start population (reference optimize_job_*_create_resource
+        querying historical jobs of the same name)."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT uuid FROM jobs WHERE name = ? AND "
+                "status = 'completed' ORDER BY created DESC LIMIT ?",
+                (name, limit),
+            ).fetchall()
+        return [r[0] for r in rows]
+
+    def job_status(self, uuid: str) -> Optional[str]:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT status FROM jobs WHERE uuid = ?", (uuid,)
+            ).fetchone()
+        return row[0] if row else None
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()

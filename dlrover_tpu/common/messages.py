@@ -353,6 +353,9 @@ class StragglerRequest(Message):
 class Stragglers(Message):
     nodes: List[int] = dataclasses.field(default_factory=list)
     times: dict = dataclasses.field(default_factory=dict)
+    # True when the latest check round has results from every rendezvous
+    # participant — agents poll until this settles instead of guessing.
+    complete: bool = False
 
 
 # ---------------------------------------------------------------------------

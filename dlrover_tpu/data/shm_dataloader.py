@@ -126,13 +126,18 @@ class ShmRing:
                 f"{self.slot_bytes}B"
             )
         slot = seq % self.n_slots
-        deadline = time.monotonic() + timeout
+        start = time.monotonic()
+        deadline = start + timeout
         while self.state(slot) != _EMPTY:
             if stop is not None and stop():
                 return False
-            if time.monotonic() > deadline:
+            now = time.monotonic()
+            if now > deadline:
                 return False
-            time.sleep(0.0002)
+            # Fine-grained at first (consumer usually frees a slot within
+            # a step), coarse once clearly stalled — an orphaned producer
+            # must not spin a core for the whole stall window.
+            time.sleep(0.0002 if now - start < 1.0 else 0.02)
         off = slot * self._stride
         self._set_hdr(slot, _WRITING, len(payload), seq)
         self._shm.buf[
@@ -194,6 +199,10 @@ def _producer_main(
 ) -> None:
     """Runs in the coworker process: materialize batches, fill the ring."""
     ring = ShmRing(ring_name, slot_bytes, n_slots, create=False)
+    # The consumer is this process's parent (mp spawn); reparenting means
+    # it died — stop instead of busy-waiting out the stall timeout.
+    ppid0 = os.getppid()
+    orphaned = lambda: os.getppid() != ppid0  # noqa: E731
     try:
         for seq in range(start_seq, len(index_batches)):
             if crash_after >= 0 and seq >= crash_after:
@@ -201,7 +210,9 @@ def _producer_main(
             batch = fetch_batch(np.asarray(index_batches[seq]))
             try:
                 payload = _pack_batch(batch)
-                ok = ring.put(seq, payload, timeout=put_timeout)
+                ok = ring.put(
+                    seq, payload, stop=orphaned, timeout=put_timeout
+                )
             except ValueError:
                 # Oversized batch: retrying can never succeed — signal a
                 # fatal, non-respawnable condition to the consumer.

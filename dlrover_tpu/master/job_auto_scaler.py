@@ -93,6 +93,11 @@ class AllreduceTrainingAutoScaler(JobAutoScaler):
                 or self._speed_history[-1][0] != alive
             ):
                 self._speed_history.append((alive, speed))
+            # A Brain-backed optimizer also wants the raw curve persisted
+            # for cross-job cold starts (reference persist_metrics).
+            report = getattr(self._optimizer, "report_runtime", None)
+            if report is not None and alive > 0:
+                report(alive, speed)
         if self._optimizer is not None and live < group.max_count:
             plan = self._optimizer.generate_resource_plan_with_optimizer(
                 {

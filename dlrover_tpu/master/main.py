@@ -25,11 +25,22 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--network_check", action="store_true")
     p.add_argument("--port_file", default="",
                    help="write the bound port to this file (for launchers)")
+    p.add_argument("--brain_addr", default="",
+                   help="host:port of a Brain service; resource decisions "
+                        "are delegated to it (reference brain_optimizer)")
     return p.parse_args(argv)
 
 
 def run(args: argparse.Namespace) -> int:
     set_role("master")
+    optimizer = None
+    if args.brain_addr:
+        from dlrover_tpu.brain.optimizer import BrainResourceOptimizer
+
+        optimizer = BrainResourceOptimizer(
+            args.brain_addr, args.job_name,
+            max_workers=args.max_nodes, node_unit=args.node_unit,
+        )
     if args.platform in ("local", "process"):
         from dlrover_tpu.master.master import LocalJobMaster
 
@@ -40,18 +51,29 @@ def run(args: argparse.Namespace) -> int:
             max_nodes=args.max_nodes,
             node_unit=args.node_unit,
             network_check=args.network_check,
+            resource_optimizer=optimizer,
         )
     else:
         from dlrover_tpu.master.dist_master import DistributedJobMaster
+        from dlrover_tpu.scheduler.job import JobArgs, NodeGroupArgs
 
-        master = DistributedJobMaster(
-            args.port,
-            job_name=args.job_name,
+        job_args = JobArgs(
             platform=args.platform,
-            min_nodes=args.min_nodes,
-            max_nodes=args.max_nodes,
+            job_name=args.job_name,
+            node_groups={
+                "worker": NodeGroupArgs(
+                    count=args.max_nodes,
+                    min_count=args.min_nodes,
+                    max_count=args.max_nodes,
+                )
+            },
             node_unit=args.node_unit,
             network_check=args.network_check,
+        )
+        master = DistributedJobMaster(
+            job_args,
+            port=args.port,
+            resource_optimizer=optimizer,
         )
     master.prepare()
     if args.port_file:

@@ -60,8 +60,12 @@ class LocalJobMaster(JobMaster):
         node_unit: int = 1,
         network_check: bool = False,
         run_config: Optional[dict] = None,
+        resource_optimizer=None,
     ):
         self.job_name = job_name
+        # Local mode has no platform to scale, but a Brain-backed optimizer
+        # still gets the speed curve persisted for cross-job cold starts.
+        self.resource_optimizer = resource_optimizer
         self._ctx = get_context()
         self.run_config = run_config or {}
         self.stage = JobStage.INIT
@@ -125,8 +129,14 @@ class LocalJobMaster(JobMaster):
     def run(self) -> int:
         """Block until the job finishes (reference run loop
         ``dist_master.py:226``)."""
+        report = getattr(self.resource_optimizer, "report_runtime", None)
         try:
             while not self._stop_event.wait(2.0):
+                if report is not None:
+                    speed = self.speed_monitor.running_speed()
+                    workers = len(self.job_manager.all_nodes())
+                    if speed > 0 and workers > 0:
+                        report(workers, speed)
                 if self.job_manager.all_workers_exited():
                     success = self.job_manager.all_workers_succeeded()
                     self.request_stop(

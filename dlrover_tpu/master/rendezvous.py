@@ -375,6 +375,18 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             ]
             return times, sorted(stragglers)
 
+    def results_complete(self) -> bool:
+        """Latest round has a result (ok or not) from every rendezvous
+        participant — the straggler/fault verdict is final."""
+        with self._lock:
+            if not self._results:
+                return False
+            last = max(self._results.keys())
+            world = set(self._rdzv_nodes.keys())
+            return bool(world) and world.issubset(
+                self._results[last].keys()
+            )
+
     def network_ready(self) -> bool:
         with self._lock:
             if not self._results:

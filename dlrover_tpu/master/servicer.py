@@ -252,7 +252,10 @@ class MasterServicer:
         if mgr is None:
             return m.Stragglers()
         times, stragglers = mgr.get_stragglers()
-        return m.Stragglers(nodes=stragglers, times=times)
+        return m.Stragglers(
+            nodes=stragglers, times=times,
+            complete=mgr.results_complete(),
+        )
 
     # -- metrics -----------------------------------------------------------
     def _on_global_step(self, msg: m.GlobalStep):

@@ -115,11 +115,9 @@ class TestEndToEnd:
 
 
 def _free_port() -> int:
-    import socket
+    from dlrover_tpu.common.rpc import find_free_port
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    return find_free_port()
 
 
 def _start_master(tmp_path, job_name, port, extra=()):

@@ -228,6 +228,42 @@ class TestAutoScaler:
             mgr.stop()
 
 
+class TestBrainAutoScaler:
+    def test_brain_backed_growth_and_metric_persistence(self, tmp_path):
+        """The auto-scaler delegates to a real Brain service over RPC and
+        persists the speed curve it observed (reference
+        AllreduceJobResourceOptimizer -> brain optimize flow)."""
+        from dlrover_tpu.brain.optimizer import BrainResourceOptimizer
+        from dlrover_tpu.brain.service import BrainService
+
+        svc = BrainService(str(tmp_path / "b.sqlite"))
+        platform = InMemoryPlatform()
+        args = make_job_args(count=2, min_count=1, max_count=8)
+        scaler = PlatformScaler("tj", platform)
+        opt = BrainResourceOptimizer(
+            svc.addr, "tj", max_workers=8, node_unit=1
+        )
+        mgr = DistributedJobManager(args, platform, scaler, opt)
+        sm = SpeedMonitor()
+        auto = AllreduceTrainingAutoScaler(args, mgr, sm, opt, interval=3600)
+        mgr.start()
+        try:
+            assert wait_until(lambda: len(mgr.alive_workers()) == 2)
+            # Seed the brain's curve directly (near-linear 2 -> 4).
+            opt.report_runtime(2, 100.0)
+            opt.report_runtime(4, 199.0)
+            delta = auto.scale_once()
+            assert delta >= 1
+            # The report path persisted the curve server-side.
+            assert svc.store.speed_curve(opt.job_uuid)[:2] == [
+                (2, 100.0), (4, 199.0),
+            ]
+        finally:
+            mgr.stop()
+            opt.close()
+            svc.stop()
+
+
 class TestScalers:
     def test_elasticjob_scaler_emits_plans(self, tmp_path):
         scaler = ElasticJobScaler("tj", str(tmp_path))
