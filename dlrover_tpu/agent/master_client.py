@@ -238,17 +238,17 @@ class MasterClient:
             return resp.nodes, resp.reason
         return [], ""
 
-    def get_stragglers(
-        self, full: bool = False
-    ) -> Tuple[List[int], dict]:
-        """(straggler node ids, elapsed-by-node); with ``full`` also a
-        completeness flag for the latest check round."""
+    def get_stragglers(self) -> Tuple[List[int], dict]:
+        """(straggler node ids, elapsed-by-node)."""
+        nodes, times, _ = self.get_stragglers_full()
+        return nodes, times
+
+    def get_stragglers_full(self) -> Tuple[List[int], dict, bool]:
+        """(straggler node ids, elapsed-by-node, results-complete flag)."""
         resp = self._client.call(m.StragglerRequest())
         if isinstance(resp, m.Stragglers):
-            if full:
-                return resp.nodes, resp.times, resp.complete
-            return resp.nodes, resp.times
-        return ([], {}, False) if full else ([], {})
+            return resp.nodes, resp.times, resp.complete
+        return [], {}, False
 
     # -- metrics -----------------------------------------------------------
     def report_global_step(self, step: int, timestamp: float = 0.0) -> None:

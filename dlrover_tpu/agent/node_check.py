@@ -157,7 +157,7 @@ def node_health_check(
     # is the straggler being waited on.
     deadline = time.time() + 30.0
     while time.time() < deadline:
-        _, _, complete = client.get_stragglers(full=True)
+        _, _, complete = client.get_stragglers_full()
         if complete:
             break
         time.sleep(0.75)

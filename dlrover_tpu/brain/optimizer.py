@@ -42,6 +42,11 @@ class BrainResourceOptimizer(ResourceOptimizer):
         self.job_uuid = job_uuid or f"{job_name}-{uuid_mod.uuid4().hex[:8]}"
         self.max_workers = max_workers
         self.node_unit = node_unit
+        from dlrover_tpu.master.resource_optimizer import (
+            LocalHeuristicOptimizer,
+        )
+
+        self._local = LocalHeuristicOptimizer()  # brain-down fallback
         self._client = RpcClient(brain_addr, timeout=timeout)
         self._call(
             BrainJobEvent(
@@ -124,6 +129,11 @@ class BrainResourceOptimizer(ResourceOptimizer):
                 )
             )
             if resp is None or not resp.success:
+                # Brain down must not disable OOM recovery entirely —
+                # relaunching with unchanged memory just OOMs again until
+                # the budget burns out.  Fall back to the local policy.
+                local = self._local.generate_oom_recovery_plan([node])
+                plan.node_resources.update(local.node_resources)
                 continue
             plan.node_resources[node.name] = NodeResource(
                 cpu=node.config_resource.cpu,

@@ -98,7 +98,7 @@ class AllreduceTrainingAutoScaler(JobAutoScaler):
             report = getattr(self._optimizer, "report_runtime", None)
             if report is not None and alive > 0:
                 report(alive, speed)
-        if self._optimizer is not None and live < group.max_count:
+        if self._optimizer is not None:
             plan = self._optimizer.generate_resource_plan_with_optimizer(
                 {
                     "speed_history": self._speed_history,
@@ -113,6 +113,18 @@ class AllreduceTrainingAutoScaler(JobAutoScaler):
                 if target > live:
                     logger.info(
                         "auto-scaler: growing workers %d -> %d", live, target
+                    )
+                    return self._job_manager.scale_workers_to(target)
+            elif suggested is not None and 0 < suggested.count < live:
+                # Shrink: the optimizer judged the tail workers wasted
+                # (diminishing-returns walk-down); release them.
+                target = self._round_to_unit(
+                    max(suggested.count, group.min_count)
+                )
+                if 0 < target < live:
+                    logger.info(
+                        "auto-scaler: shrinking workers %d -> %d",
+                        live, target,
                     )
                     return self._job_manager.scale_workers_to(target)
         return 0

@@ -80,7 +80,13 @@ def run(args: argparse.Namespace) -> int:
         with open(args.port_file, "w") as f:
             f.write(str(master.port))
     logger.info("master listening on port %d", master.port)
-    return master.run()
+    rc = master.run()
+    if optimizer is not None:
+        # Mark the job terminal in the brain store — the cross-job
+        # cold-start path only learns from *completed* jobs.
+        optimizer.finish(success=rc == 0)
+        optimizer.close()
+    return rc
 
 
 def main() -> None:

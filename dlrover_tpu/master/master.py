@@ -130,12 +130,23 @@ class LocalJobMaster(JobMaster):
         """Block until the job finishes (reference run loop
         ``dist_master.py:226``)."""
         report = getattr(self.resource_optimizer, "report_runtime", None)
+        last_report = 0.0
         try:
             while not self._stop_event.wait(2.0):
-                if report is not None:
+                if report is not None and time.time() - last_report >= 30:
                     speed = self.speed_monitor.running_speed()
-                    workers = len(self.job_manager.all_nodes())
+                    # Only LIVE workers: counting exited nodes would file
+                    # the post-shrink speed under the old worker count
+                    # and corrupt the brain's speed curve.
+                    from dlrover_tpu.common.constants import NodeStatus
+
+                    workers = sum(
+                        1 for n in self.job_manager.all_nodes().values()
+                        if n.status
+                        in (NodeStatus.RUNNING, NodeStatus.INITIAL)
+                    )
                     if speed > 0 and workers > 0:
+                        last_report = time.time()
                         report(workers, speed)
                 if self.job_manager.all_workers_exited():
                     success = self.job_manager.all_workers_succeeded()

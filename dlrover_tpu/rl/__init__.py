@@ -1,0 +1,16 @@
+"""RL engine: PPO post-training for language models, TPU-native.
+
+Parity with reference ``atorch/rl`` (``model_engine/model_engine.py:35``
+per-role model management with per-model acceleration strategies,
+``ppo_utils/ppo_util.py`` the PPO math, ``replay_buffer/replay_buffer.py``,
+``trainer/ppo_trainer.py`` + ``trainer/rl_trainer.py`` the
+make-experience -> train loop).  TPU-first shape: the four model roles
+(actor, critic, reference, reward) are pytrees + pure apply fns sharded
+through ``accelerate()``; generation is a jitted ``lax.scan`` decode; the
+PPO update is one pjit'd step over actor+critic jointly.
+"""
+
+from dlrover_tpu.rl.config import PPOConfig  # noqa: F401
+from dlrover_tpu.rl.engine import ModelEngine, ModelRole  # noqa: F401
+from dlrover_tpu.rl.replay_buffer import ReplayBuffer  # noqa: F401
+from dlrover_tpu.rl.trainer import PPOTrainer  # noqa: F401

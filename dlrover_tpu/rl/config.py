@@ -1,0 +1,74 @@
+"""RL configuration (reference ``atorch/rl/config.py``: AtorchRLConfig
+with per-model strategies + PPO hyperparameters from the trlx lineage)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    # Rollout shape.
+    rollout_batch_size: int = 16
+    response_length: int = 8
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = full softmax sampling
+
+    # PPO core (reference ppo_util.loss defaults).
+    ppo_epochs: int = 4
+    minibatch_size: int = 8
+    gamma: float = 1.0
+    lam: float = 0.95
+    cliprange: float = 0.2
+    cliprange_value: float = 0.2
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.0
+    use_whitening: bool = True
+    max_grad_norm: float = 1.0
+
+    # KL regularization against the frozen reference model.
+    init_kl_coef: float = 0.1
+    kl_target: Optional[float] = None  # None = fixed coefficient
+    kl_horizon: int = 10000
+
+    # Optimization.
+    actor_lr: float = 1e-4
+    critic_lr: float = 1e-3
+
+    def __post_init__(self):
+        assert self.rollout_batch_size % self.minibatch_size == 0, (
+            "rollout batch must be a multiple of the minibatch"
+        )
+
+
+class FixedKLController:
+    """Constant beta (reference ppo_util/trlx FixedKLController)."""
+
+    def __init__(self, value: float):
+        self.value = float(value)
+
+    def update(self, current_kl: float, n_steps: int) -> None:
+        pass
+
+
+class AdaptiveKLController:
+    """Proportional controller driving measured KL toward a target
+    (reference AdaptiveKLController; Ziegler et al. 2019)."""
+
+    def __init__(self, init_kl_coef: float, target: float, horizon: int):
+        self.value = float(init_kl_coef)
+        self.target = float(target)
+        self.horizon = int(horizon)
+
+    def update(self, current_kl: float, n_steps: int) -> None:
+        error = min(max(current_kl / self.target - 1.0, -0.2), 0.2)
+        self.value *= 1.0 + error * n_steps / self.horizon
+
+
+def make_kl_controller(cfg: PPOConfig):
+    if cfg.kl_target is None:
+        return FixedKLController(cfg.init_kl_coef)
+    return AdaptiveKLController(
+        cfg.init_kl_coef, cfg.kl_target, cfg.kl_horizon
+    )

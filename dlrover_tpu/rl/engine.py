@@ -1,0 +1,224 @@
+"""Model engine: the four PPO model roles and their jitted programs.
+
+Parity with reference ``rl/model_engine/model_engine.py:35`` (ModelEngine
+holding actor/ref/critic/reward models, applying a per-model acceleration
+strategy, exposing train/eval modes and save/load).  TPU-native shape:
+each role is (pure apply fn, params pytree); "strategies" are sharding
+placements on the params — jit propagates them (GSPMD) — plus donation on
+the train step.  Generation is a jitted fixed-length ``lax.scan`` decode
+(static shapes; TPU-friendly), the analogue of the reference's separate
+inference-mode model unwrapping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.rl.config import PPOConfig
+from dlrover_tpu.rl.ppo import logprobs_from_logits
+
+
+class ModelRole:
+    ACTOR = "actor"
+    CRITIC = "critic"
+    REFERENCE = "reference"
+    REWARD = "reward"
+
+
+@dataclasses.dataclass
+class RoleSpec:
+    """One model role: ``apply(params, tokens) -> output``.
+
+    actor/reference outputs logits [B, T, V]; critic outputs values
+    [B, T]; reward outputs sequence scores [B]."""
+
+    apply_fn: Callable[[Any, jax.Array], jax.Array]
+    params: Any
+    trainable: bool = False
+
+
+class ModelEngine:
+    """Owns role specs and compiles the rollout-side programs."""
+
+    def __init__(
+        self,
+        roles: Dict[str, RoleSpec],
+        config: PPOConfig,
+        *,
+        reward_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+        eos_token: int = -1,
+    ):
+        """``roles`` must contain actor + critic; reference defaults to a
+        frozen copy of the actor's initial params; reward comes from the
+        REWARD role or a host ``reward_fn(tokens) -> scores`` (e.g. a
+        programmatic verifier — the RLVR shape)."""
+        assert ModelRole.ACTOR in roles and ModelRole.CRITIC in roles
+        self.roles = dict(roles)
+        if ModelRole.REFERENCE not in self.roles:
+            actor = self.roles[ModelRole.ACTOR]
+            self.roles[ModelRole.REFERENCE] = RoleSpec(
+                apply_fn=actor.apply_fn,
+                params=jax.tree_util.tree_map(jnp.array, actor.params),
+            )
+        if reward_fn is None and ModelRole.REWARD not in self.roles:
+            raise ValueError("need a REWARD role or a reward_fn")
+        self.reward_fn = reward_fn
+        self.config = config
+        self.eos_token = eos_token
+        self._generate = None
+        self._rollout_forward = None
+
+    # -- role access (reference get_model/actor/critic properties) ----------
+    def params(self, role: str) -> Any:
+        return self.roles[role].params
+
+    def set_params(self, role: str, params: Any) -> None:
+        self.roles[role].params = params
+
+    def sync_reference_to_actor(self) -> None:
+        """Refresh the frozen reference from the current actor (reference
+        hybrid-engine weight sync before each experience phase when KL is
+        measured against the latest policy)."""
+        self.roles[ModelRole.REFERENCE].params = jax.tree_util.tree_map(
+            jnp.array, self.roles[ModelRole.ACTOR].params
+        )
+
+    # -- generation ----------------------------------------------------------
+    def _build_generate(self, prompt_len: int):
+        cfg = self.config
+        actor = self.roles[ModelRole.ACTOR]
+        R = cfg.response_length
+
+        def generate(params, prompts, rng):
+            B = prompts.shape[0]
+            buf = jnp.concatenate(
+                [prompts, jnp.zeros((B, R), prompts.dtype)], axis=1
+            )
+
+            def step(carry, i):
+                buf, rng = carry
+                rng, sub = jax.random.split(rng)
+                logits = actor.apply_fn(params, buf)
+                pos = prompt_len + i - 1
+                next_logits = logits[:, pos, :] / cfg.temperature
+                if cfg.top_k > 0:
+                    kth = jnp.sort(next_logits, axis=-1)[
+                        :, -cfg.top_k, None
+                    ]
+                    next_logits = jnp.where(
+                        next_logits < kth, -jnp.inf, next_logits
+                    )
+                tok = jax.random.categorical(sub, next_logits)
+                buf = buf.at[:, prompt_len + i].set(
+                    tok.astype(buf.dtype)
+                )
+                return (buf, rng), None
+
+            (buf, _), _ = jax.lax.scan(
+                step, (buf, rng), jnp.arange(R)
+            )
+            return buf
+
+        return jax.jit(generate)
+
+    def generate(
+        self, prompts: jax.Array, rng: jax.Array
+    ) -> jax.Array:
+        """Sample ``response_length`` tokens after each prompt; returns
+        the full [B, P+R] token buffer."""
+        if self._generate is None:
+            self._generate = self._build_generate(prompts.shape[1])
+        return self._generate(
+            self.params(ModelRole.ACTOR), prompts, rng
+        )
+
+    # -- rollout-side forward (logprobs, ref logprobs, values) ---------------
+    def _build_rollout_forward(self, prompt_len: int):
+        actor = self.roles[ModelRole.ACTOR]
+        ref = self.roles[ModelRole.REFERENCE]
+        critic = self.roles[ModelRole.CRITIC]
+        R = self.config.response_length
+
+        def forward(actor_p, ref_p, critic_p, tokens):
+            # Response tokens are predicted from the previous position.
+            resp = tokens[:, prompt_len : prompt_len + R]
+            logits = actor.apply_fn(actor_p, tokens)[
+                :, prompt_len - 1 : prompt_len + R - 1, :
+            ]
+            ref_logits = ref.apply_fn(ref_p, tokens)[
+                :, prompt_len - 1 : prompt_len + R - 1, :
+            ]
+            values = critic.apply_fn(critic_p, tokens)[
+                :, prompt_len : prompt_len + R
+            ]
+            return (
+                logprobs_from_logits(logits, resp),
+                logprobs_from_logits(ref_logits, resp),
+                values,
+            )
+
+        return jax.jit(forward)
+
+    def rollout_forward(self, tokens: jax.Array, prompt_len: int):
+        if self._rollout_forward is None:
+            self._rollout_forward = self._build_rollout_forward(prompt_len)
+        return self._rollout_forward(
+            self.params(ModelRole.ACTOR),
+            self.params(ModelRole.REFERENCE),
+            self.params(ModelRole.CRITIC),
+            tokens,
+        )
+
+    def score(self, tokens: np.ndarray) -> np.ndarray:
+        """Sequence-level rewards from the reward model or host fn."""
+        if self.reward_fn is not None:
+            return np.asarray(self.reward_fn(np.asarray(tokens)))
+        spec = self.roles[ModelRole.REWARD]
+        return np.asarray(spec.apply_fn(spec.params, jnp.asarray(tokens)))
+
+    def response_mask(self, tokens: jax.Array, prompt_len: int):
+        """[B, R] mask: 1 up to and including the first EOS (if any)."""
+        R = self.config.response_length
+        resp = tokens[:, prompt_len : prompt_len + R]
+        if self.eos_token < 0:
+            return jnp.ones(resp.shape, jnp.float32)
+        is_eos = (resp == self.eos_token).astype(jnp.int32)
+        after_eos = jnp.cumsum(
+            jnp.concatenate(
+                [jnp.zeros_like(is_eos[:, :1]), is_eos[:, :-1]], axis=1
+            ),
+            axis=1,
+        )
+        return (after_eos == 0).astype(jnp.float32)
+
+    # -- persistence (reference ModelEngine.save/load) -----------------------
+    def save(self, ckpt, step: int, opt_states: Optional[dict] = None
+             ) -> None:
+        """Stage all roles (+ optimizer states) through a
+        FlashCheckpointer."""
+        state = {
+            r: spec.params for r, spec in self.roles.items()
+        }
+        if opt_states:
+            state["opt"] = opt_states
+        ckpt.save(state, meta={"step": step}, storage=True)
+
+    def load(self, ckpt) -> Optional[Tuple[int, Optional[dict]]]:
+        state = {r: spec.params for r, spec in self.roles.items()}
+        restored = ckpt.load(target=state)
+        if restored is None:
+            return None
+        got, meta = restored
+        opt = got.pop("opt", None)
+        for r, params in got.items():
+            if r in self.roles:
+                self.roles[r].params = params
+        logger.info("rl engine: restored step %s", meta.get("step"))
+        return int(meta.get("step", 0)), opt

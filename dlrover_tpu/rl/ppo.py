@@ -1,0 +1,149 @@
+"""PPO math as pure jnp functions (reference ``rl/ppo_utils/ppo_util.py``:
+``get_kl_penalty :19``, ``get_rewards :55``, ``loss :79``,
+``get_advantages_and_returns :147``).  Everything here is jit-safe:
+static shapes, ``lax.scan`` for the reverse-time GAE recursion, masks for
+variable-length responses."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def logprobs_from_logits(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Per-token log p(token) — [B, T, V], [B, T] -> [B, T]."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(
+        logp, tokens[..., None], axis=-1
+    ).squeeze(-1)
+
+
+def whiten(x: jax.Array, mask: jax.Array, shift_mean: bool = True):
+    """Mask-aware whitening (reference ``whiten`` with use_whitening)."""
+    denom = jnp.maximum(mask.sum(), 1.0)
+    mean = (x * mask).sum() / denom
+    var = ((x - mean) ** 2 * mask).sum() / denom
+    out = (x - mean) * jax.lax.rsqrt(var + 1e-8)
+    if not shift_mean:
+        out = out + mean
+    return out
+
+
+def kl_penalty(
+    logprobs: jax.Array, ref_logprobs: jax.Array
+) -> jax.Array:
+    """Per-token KL estimate between actor and frozen reference
+    (reference ``get_kl_penalty``: logprob difference on the sampled
+    tokens)."""
+    return logprobs - ref_logprobs
+
+
+def compute_rewards(
+    scores: jax.Array,       # [B] sequence-level reward-model scores
+    logprobs: jax.Array,     # [B, T] actor logprobs of the response
+    ref_logprobs: jax.Array, # [B, T]
+    mask: jax.Array,         # [B, T] 1 on response tokens
+    kl_coef: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Dense rewards: -beta*KL per token, plus the score on each
+    sequence's LAST response token (reference ``get_rewards``).
+    Returns (rewards [B, T], mean per-sequence KL [B])."""
+    kl = kl_penalty(logprobs, ref_logprobs) * mask
+    rewards = -kl_coef * kl
+    # Index of the last mask=1 position per row.
+    last = jnp.maximum(mask.sum(axis=1) - 1, 0).astype(jnp.int32)
+    rewards = rewards.at[jnp.arange(rewards.shape[0]), last].add(scores)
+    seq_kl = kl.sum(axis=1) / jnp.maximum(mask.sum(axis=1), 1.0)
+    return rewards, seq_kl
+
+
+def gae_advantages(
+    values: jax.Array,   # [B, T]
+    rewards: jax.Array,  # [B, T]
+    mask: jax.Array,     # [B, T]
+    gamma: float,
+    lam: float,
+    use_whitening: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Generalized advantage estimation over the response window
+    (reference ``get_advantages_and_returns``): the reverse-time
+    recursion is a ``lax.scan`` over T (no Python loop under jit).
+    Returns (advantages, returns), both [B, T]."""
+    B, T = values.shape
+    next_values = jnp.concatenate(
+        [values[:, 1:], jnp.zeros((B, 1), values.dtype)], axis=1
+    )
+    # Tokens past the response end contribute nothing.
+    deltas = (rewards + gamma * next_values * mask - values) * mask
+
+    def step(carry, xs):
+        delta_t, mask_t = xs
+        carry = delta_t + gamma * lam * carry * mask_t
+        return carry, carry
+
+    _, adv_rev = jax.lax.scan(
+        step,
+        jnp.zeros((B,), values.dtype),
+        (deltas.T[::-1], mask.T[::-1]),
+    )
+    advantages = adv_rev[::-1].T * mask
+    returns = advantages + values * mask
+    if use_whitening:
+        advantages = whiten(advantages, mask) * mask
+    return jax.lax.stop_gradient(advantages), jax.lax.stop_gradient(returns)
+
+
+def ppo_loss(
+    logprobs: jax.Array,      # [B, T] current actor logprobs
+    values: jax.Array,        # [B, T] current critic values
+    old_logprobs: jax.Array,  # [B, T] rollout-time actor logprobs
+    old_values: jax.Array,    # [B, T] rollout-time critic values
+    advantages: jax.Array,    # [B, T]
+    returns: jax.Array,       # [B, T]
+    mask: jax.Array,          # [B, T]
+    *,
+    cliprange: float,
+    cliprange_value: float,
+    vf_coef: float,
+    entropy: jax.Array = None,  # [B, T] optional policy entropy
+    entropy_coef: float = 0.0,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Clipped-surrogate policy loss + clipped value loss (reference
+    ``ppo_util.loss :79``).  Returns (scalar loss, stats dict)."""
+    n = jnp.maximum(mask.sum(), 1.0)
+
+    ratio = jnp.exp((logprobs - old_logprobs) * mask)
+    pg1 = -advantages * ratio
+    pg2 = -advantages * jnp.clip(
+        ratio, 1.0 - cliprange, 1.0 + cliprange
+    )
+    pg_loss = (jnp.maximum(pg1, pg2) * mask).sum() / n
+    pg_clipfrac = ((pg2 > pg1).astype(jnp.float32) * mask).sum() / n
+
+    v_clipped = old_values + jnp.clip(
+        values - old_values, -cliprange_value, cliprange_value
+    )
+    vf1 = (values - returns) ** 2
+    vf2 = (v_clipped - returns) ** 2
+    vf_loss = 0.5 * (jnp.maximum(vf1, vf2) * mask).sum() / n
+    vf_clipfrac = ((vf2 > vf1).astype(jnp.float32) * mask).sum() / n
+
+    loss = pg_loss + vf_coef * vf_loss
+    stats = {
+        "loss/policy": pg_loss,
+        "loss/value": vf_loss,
+        "policy/clipfrac": pg_clipfrac,
+        "value/clipfrac": vf_clipfrac,
+        "policy/approx_kl": (
+            0.5 * ((logprobs - old_logprobs) ** 2 * mask).sum() / n
+        ),
+        "ratio/mean": (ratio * mask).sum() / n,
+    }
+    if entropy is not None and entropy_coef > 0.0:
+        ent = (entropy * mask).sum() / n
+        loss = loss - entropy_coef * ent
+        stats["policy/entropy"] = ent
+    stats["loss/total"] = loss
+    return loss, stats

@@ -1,0 +1,51 @@
+"""Rollout storage + minibatch iteration (reference
+``rl/replay_buffer/replay_buffer.py`` ReplayBuffer over PPORLElement
+batches: store experience dicts, shuffle, yield minibatches)."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+
+class ReplayBuffer:
+    """Holds one (or more) rollouts of experience as a dict of arrays
+    sharing a leading batch dim; iterates shuffled minibatches."""
+
+    def __init__(self, seed: int = 0):
+        self._items: List[Dict[str, np.ndarray]] = []
+        self.rng = np.random.default_rng(seed)
+
+    def add(self, experience: Dict[str, np.ndarray]) -> None:
+        sizes = {k: len(v) for k, v in experience.items()}
+        assert len(set(sizes.values())) == 1, f"ragged batch: {sizes}"
+        self._items.append(
+            {k: np.asarray(v) for k, v in experience.items()}
+        )
+
+    def clear(self) -> None:
+        self._items.clear()
+
+    def __len__(self) -> int:
+        return sum(len(next(iter(it.values()))) for it in self._items)
+
+    def _stacked(self) -> Dict[str, np.ndarray]:
+        keys = self._items[0].keys()
+        return {
+            k: np.concatenate([it[k] for it in self._items]) for k in keys
+        }
+
+    def minibatches(
+        self, minibatch_size: int, shuffle: bool = True
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        if not self._items:
+            return
+        data = self._stacked()
+        n = len(next(iter(data.values())))
+        order = np.arange(n)
+        if shuffle:
+            self.rng.shuffle(order)
+        for lo in range(0, n - minibatch_size + 1, minibatch_size):
+            idx = order[lo : lo + minibatch_size]
+            yield {k: v[idx] for k, v in data.items()}

@@ -1,0 +1,199 @@
+"""PPOTrainer: the make-experience -> PPO-update loop.
+
+Parity with reference ``rl/trainer/ppo_trainer.py`` (+ ``rl_trainer.py``
+base): ``make_experience`` rolls the actor out on a prompt batch, scores
+it, computes KL-shaped rewards and GAE; ``train`` iterates PPO epochs of
+shuffled minibatches through one jitted actor+critic update (donated
+state, optax chains with clipping).  The KL controller adapts the
+penalty between batches (reference AdaptiveKLController wiring).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.rl import ppo
+from dlrover_tpu.rl.config import PPOConfig, make_kl_controller
+from dlrover_tpu.rl.engine import ModelEngine, ModelRole
+from dlrover_tpu.rl.replay_buffer import ReplayBuffer
+
+
+class PPOTrainer:
+    def __init__(
+        self,
+        engine: ModelEngine,
+        config: Optional[PPOConfig] = None,
+        *,
+        seed: int = 0,
+    ):
+        import optax
+
+        self.engine = engine
+        self.config = config or engine.config
+        self.kl_ctl = make_kl_controller(self.config)
+        self.buffer = ReplayBuffer(seed=seed)
+        self.rng = jax.random.PRNGKey(seed)
+        self.step = 0
+
+        c = self.config
+        self.actor_tx = optax.chain(
+            optax.clip_by_global_norm(c.max_grad_norm),
+            optax.adam(c.actor_lr),
+        )
+        self.critic_tx = optax.chain(
+            optax.clip_by_global_norm(c.max_grad_norm),
+            optax.adam(c.critic_lr),
+        )
+        self.actor_opt = self.actor_tx.init(
+            engine.params(ModelRole.ACTOR)
+        )
+        self.critic_opt = self.critic_tx.init(
+            engine.params(ModelRole.CRITIC)
+        )
+        self._train_step = None
+        self._prompt_len: Optional[int] = None
+
+    # -- experience ----------------------------------------------------------
+    def make_experience(
+        self, prompts: np.ndarray
+    ) -> Dict[str, np.ndarray]:
+        """One rollout batch -> buffered experience (reference
+        ``make_experience``)."""
+        cfg = self.config
+        prompts = jnp.asarray(prompts)
+        self._prompt_len = int(prompts.shape[1])
+        self.rng, sub = jax.random.split(self.rng)
+        tokens = self.engine.generate(prompts, sub)
+        logprobs, ref_logprobs, values = self.engine.rollout_forward(
+            tokens, self._prompt_len
+        )
+        mask = self.engine.response_mask(tokens, self._prompt_len)
+        scores = jnp.asarray(
+            self.engine.score(np.asarray(tokens)), jnp.float32
+        )
+        rewards, seq_kl = ppo.compute_rewards(
+            scores, logprobs, ref_logprobs, mask, self.kl_ctl.value
+        )
+        advantages, returns = ppo.gae_advantages(
+            values, rewards, mask, cfg.gamma, cfg.lam, cfg.use_whitening
+        )
+        exp = {
+            "tokens": np.asarray(tokens),
+            "mask": np.asarray(mask),
+            "old_logprobs": np.asarray(logprobs),
+            "old_values": np.asarray(values),
+            "advantages": np.asarray(advantages),
+            "returns": np.asarray(returns),
+        }
+        self.buffer.add(exp)
+        self.kl_ctl.update(
+            float(seq_kl.mean()), n_steps=prompts.shape[0]
+        )
+        return {
+            "score_mean": float(scores.mean()),
+            "kl_mean": float(seq_kl.mean()),
+            "kl_coef": self.kl_ctl.value,
+        }
+
+    # -- update --------------------------------------------------------------
+    def _build_train_step(self):
+        cfg = self.config
+        engine = self.engine
+        actor = engine.roles[ModelRole.ACTOR]
+        critic = engine.roles[ModelRole.CRITIC]
+        P = self._prompt_len
+        R = cfg.response_length
+
+        def loss_fn(actor_p, critic_p, mb):
+            tokens = mb["tokens"]
+            resp = tokens[:, P : P + R]
+            logits = actor.apply_fn(actor_p, tokens)[
+                :, P - 1 : P + R - 1, :
+            ]
+            logprobs = ppo.logprobs_from_logits(logits, resp)
+            values = critic.apply_fn(critic_p, tokens)[:, P : P + R]
+            entropy = None
+            if cfg.entropy_coef > 0:
+                logp_all = jax.nn.log_softmax(logits, axis=-1)
+                entropy = -(jnp.exp(logp_all) * logp_all).sum(-1)
+            return ppo.ppo_loss(
+                logprobs, values,
+                mb["old_logprobs"], mb["old_values"],
+                mb["advantages"], mb["returns"], mb["mask"],
+                cliprange=cfg.cliprange,
+                cliprange_value=cfg.cliprange_value,
+                vf_coef=cfg.vf_coef,
+                entropy=entropy,
+                entropy_coef=cfg.entropy_coef,
+            )
+
+        def train_step(actor_p, critic_p, actor_opt, critic_opt, mb):
+            import optax
+
+            (_, stats), (ga, gc) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(actor_p, critic_p, mb)
+            ua, actor_opt = self.actor_tx.update(ga, actor_opt, actor_p)
+            actor_p = optax.apply_updates(actor_p, ua)
+            uc, critic_opt = self.critic_tx.update(
+                gc, critic_opt, critic_p
+            )
+            critic_p = optax.apply_updates(critic_p, uc)
+            return actor_p, critic_p, actor_opt, critic_opt, stats
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2, 3))
+
+    def train(self) -> Dict[str, float]:
+        """Consume the buffer: ``ppo_epochs`` passes of shuffled
+        minibatches (reference ``rl_training``).  Returns mean stats."""
+        cfg = self.config
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        actor_p = self.engine.params(ModelRole.ACTOR)
+        critic_p = self.engine.params(ModelRole.CRITIC)
+        agg: Dict[str, list] = {}
+        for _ in range(cfg.ppo_epochs):
+            for mb in self.buffer.minibatches(cfg.minibatch_size):
+                mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                (actor_p, critic_p, self.actor_opt, self.critic_opt,
+                 stats) = self._train_step(
+                    actor_p, critic_p, self.actor_opt, self.critic_opt,
+                    mb,
+                )
+                for k, v in stats.items():
+                    agg.setdefault(k, []).append(float(v))
+                self.step += 1
+        self.engine.set_params(ModelRole.ACTOR, actor_p)
+        self.engine.set_params(ModelRole.CRITIC, critic_p)
+        self.buffer.clear()
+        return {k: float(np.mean(v)) for k, v in agg.items()}
+
+    # -- the outer loop ------------------------------------------------------
+    def learn(
+        self,
+        prompt_iter,
+        total_iterations: int,
+        *,
+        log_every: int = 1,
+    ) -> Dict[str, float]:
+        """make_experience + train, ``total_iterations`` times
+        (reference ``rl_training`` outer loop)."""
+        last: Dict[str, float] = {}
+        for it in range(total_iterations):
+            prompts = next(prompt_iter)
+            roll = self.make_experience(np.asarray(prompts))
+            stats = self.train()
+            last = {**roll, **stats}
+            if log_every and it % log_every == 0:
+                logger.info(
+                    "ppo iter %d | score %.4f kl %.4f loss %.4f",
+                    it, roll["score_mean"], roll["kl_mean"],
+                    stats.get("loss/total", float("nan")),
+                )
+        return last
