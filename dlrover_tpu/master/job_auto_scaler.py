@@ -117,11 +117,16 @@ class AllreduceTrainingAutoScaler(JobAutoScaler):
                     return self._job_manager.scale_workers_to(target)
             elif suggested is not None and 0 < suggested.count < live:
                 # Shrink: the optimizer judged the tail workers wasted
-                # (diminishing-returns walk-down); release them.
+                # (diminishing-returns walk-down); release them — but
+                # never below min_count (unit-rounding UP at the floor,
+                # or the next pass's backfill would re-grow and flap).
                 target = self._round_to_unit(
                     max(suggested.count, group.min_count)
                 )
-                if 0 < target < live:
+                if target < group.min_count:
+                    unit = max(1, self._job_args.node_unit)
+                    target = -(-group.min_count // unit) * unit
+                if group.min_count <= target < live:
                     logger.info(
                         "auto-scaler: shrinking workers %d -> %d",
                         live, target,

@@ -75,17 +75,21 @@ def run(args: argparse.Namespace) -> int:
             port=args.port,
             resource_optimizer=optimizer,
         )
-    master.prepare()
-    if args.port_file:
-        with open(args.port_file, "w") as f:
-            f.write(str(master.port))
-    logger.info("master listening on port %d", master.port)
-    rc = master.run()
-    if optimizer is not None:
-        # Mark the job terminal in the brain store — the cross-job
-        # cold-start path only learns from *completed* jobs.
-        optimizer.finish(success=rc == 0)
-        optimizer.close()
+    rc = 1
+    try:
+        master.prepare()
+        if args.port_file:
+            with open(args.port_file, "w") as f:
+                f.write(str(master.port))
+        logger.info("master listening on port %d", master.port)
+        rc = master.run()
+    finally:
+        if optimizer is not None:
+            # Mark the job terminal in the brain store even on a crash —
+            # the cross-job cold-start path only learns from terminal
+            # jobs, and crashed ones must not linger as 'running'.
+            optimizer.finish(success=rc == 0)
+            optimizer.close()
     return rc
 
 

@@ -1,0 +1,108 @@
+"""AMP: mixed-precision policy + dynamic loss scaling.
+
+Parity with the reference's AMP opt methods
+(``atorch/auto/opt_lib/amp_optimization.py``: AmpNativeOptimization with
+GradScaler, Fp8Optimization) on TPU terms: bf16 needs no loss scale (the
+``compute_dtype`` policy in ``accelerate()`` covers it); fp16 — and
+aggressive fp8 recipes — do.  The scaler is a functional optax-style
+wrapper: loss is scaled before grad, grads are unscaled and checked for
+non-finites; a bad step is SKIPPED and the scale backs off, good-step
+streaks grow it (the torch.cuda.amp.GradScaler contract, jit-safe via
+``lax.cond``-free masking).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class LossScaleState(NamedTuple):
+    scale: jax.Array        # current loss scale (f32 scalar)
+    good_steps: jax.Array   # consecutive finite steps (i32)
+    inner: optax.OptState
+
+
+def dynamic_loss_scaling(
+    inner: optax.GradientTransformation,
+    *,
+    init_scale: float = 2.0**15,
+    growth_interval: int = 2000,
+    growth_factor: float = 2.0,
+    backoff_factor: float = 0.5,
+    min_scale: float = 1.0,
+) -> optax.GradientTransformation:
+    """Wrap ``inner`` so updates are computed from UNSCALED grads and
+    non-finite steps are skipped (zero update) while the scale backs off.
+
+    The caller must scale its loss by ``current_scale(state)`` (or use
+    :func:`scaled_value_and_grad`, which handles both ends)."""
+
+    def init(params):
+        return LossScaleState(
+            scale=jnp.asarray(init_scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            inner=inner.init(params),
+        )
+
+    def update(grads, state, params=None):
+        unscaled = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) / state.scale, grads
+        )
+        finite = jnp.all(
+            jnp.stack(
+                [
+                    jnp.all(jnp.isfinite(g))
+                    for g in jax.tree_util.tree_leaves(unscaled)
+                ]
+            )
+        )
+        updates, new_inner = inner.update(
+            jax.tree_util.tree_map(
+                lambda g: jnp.where(finite, g, 0.0), unscaled
+            ),
+            state.inner,
+            params,
+        )
+        # Skip the step entirely on overflow (zero updates, keep opt
+        # state) — masking matches GradScaler.step's skip semantics.
+        updates = jax.tree_util.tree_map(
+            lambda u: jnp.where(finite, u, jnp.zeros_like(u)), updates
+        )
+        new_inner = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(finite, new, old),
+            new_inner, state.inner,
+        )
+        good = jnp.where(finite, state.good_steps + 1, 0)
+        grew = good >= growth_interval
+        scale = jnp.where(
+            finite,
+            jnp.where(grew, state.scale * growth_factor, state.scale),
+            jnp.maximum(state.scale * backoff_factor, min_scale),
+        )
+        good = jnp.where(grew, 0, good)
+        return updates, LossScaleState(scale, good, new_inner)
+
+    return optax.GradientTransformation(init, update)
+
+
+def current_scale(state: LossScaleState) -> jax.Array:
+    return state.scale
+
+
+def scaled_value_and_grad(loss_fn):
+    """``(params, scale, *args) -> ((loss, grads))`` with the loss scaled
+    before differentiation and the TRUE loss returned — pair with
+    :func:`dynamic_loss_scaling`, which unscales the grads."""
+
+    def fn(params, scale, *args):
+        def scaled(p):
+            return loss_fn(p, *args) * scale
+
+        sloss, grads = jax.value_and_grad(scaled)(params)
+        return sloss / scale, grads
+
+    return fn

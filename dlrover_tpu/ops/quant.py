@@ -38,11 +38,68 @@ def _unpad(vals: jax.Array, shape, dtype) -> jax.Array:
     return vals.reshape(-1)[:n].reshape(shape).astype(dtype)
 
 
-def quantize_blockwise(
-    x: jax.Array, *, stochastic: bool = False, key: jax.Array | None = None
+def _quant_kernel(x_ref, codes_ref, scale_ref):
+    """Fused abs-max + scale + round in VMEM — one HBM read of x, int8
+    write-out (the Pallas variant the reference implements as
+    ``quantize.cu``/``swizzled_quantize.cu``)."""
+    x = x_ref[:].astype(jnp.float32)  # [rows, 128]
+    scale = jnp.maximum(jnp.max(jnp.abs(x), axis=-1) / 127.0, 1e-12)
+    codes = jnp.clip(jnp.round(x / scale[:, None]), -127, 127)
+    codes_ref[:] = codes.astype(jnp.int8)
+    scale_ref[:] = scale[:, None]
+
+
+def _quantize_pallas(
+    blocks: jax.Array, block_rows: int = 256, interpret: bool = False
 ) -> Tuple[jax.Array, jax.Array]:
-    """x -> (int8 codes [ceil(n/128), 128], fp32 scales [ceil(n/128)])."""
+    from jax.experimental import pallas as pl
+
+    R = blocks.shape[0]
+    block_rows = min(block_rows, R)
+    codes, scale = pl.pallas_call(
+        _quant_kernel,
+        grid=(pl.cdiv(R, block_rows),),
+        in_specs=[pl.BlockSpec((block_rows, BLOCK), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((block_rows, BLOCK), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, BLOCK), jnp.int8),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(blocks)
+    return codes, scale[:, 0]
+
+
+def quantize_blockwise(
+    x: jax.Array,
+    *,
+    stochastic: bool = False,
+    key: jax.Array | None = None,
+    backend: str = "auto",
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """x -> (int8 codes [ceil(n/128), 128], fp32 scales [ceil(n/128)]).
+
+    ``backend``: "auto" uses the fused Pallas kernel on TPU (jnp
+    elsewhere); "pallas"/"jnp" force a path (pallas + ``interpret=True``
+    runs the kernel on CPU for tests).  Stochastic rounding stays on the
+    jnp path (it needs a threaded PRNG)."""
+    if backend == "pallas" and stochastic:
+        raise ValueError(
+            "stochastic rounding is jnp-only (needs a threaded PRNG); "
+            "don't force backend='pallas' with stochastic=True"
+        )
     blocks, n = _pad_to_block(x.astype(jnp.float32))
+    use_pallas = backend == "pallas" or (
+        backend == "auto"
+        and not stochastic
+        and jax.default_backend() == "tpu"
+    )
+    if use_pallas:
+        return _quantize_pallas(blocks, interpret=interpret)
     scale = jnp.max(jnp.abs(blocks), axis=-1) / 127.0
     scale = jnp.maximum(scale, 1e-12)
     scaled = blocks / scale[:, None]

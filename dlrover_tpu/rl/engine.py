@@ -72,8 +72,11 @@ class ModelEngine:
         self.reward_fn = reward_fn
         self.config = config
         self.eos_token = eos_token
-        self._generate = None
-        self._rollout_forward = None
+        # Jitted programs are specialized on prompt_len (slicing offsets
+        # are static); cache per length so a changed prompt shape rebuilds
+        # instead of silently computing with stale offsets.
+        self._generate = {}
+        self._rollout_forward = {}
 
     # -- role access (reference get_model/actor/critic properties) ----------
     def params(self, role: str) -> Any:
@@ -133,9 +136,10 @@ class ModelEngine:
     ) -> jax.Array:
         """Sample ``response_length`` tokens after each prompt; returns
         the full [B, P+R] token buffer."""
-        if self._generate is None:
-            self._generate = self._build_generate(prompts.shape[1])
-        return self._generate(
+        plen = int(prompts.shape[1])
+        if plen not in self._generate:
+            self._generate[plen] = self._build_generate(plen)
+        return self._generate[plen](
             self.params(ModelRole.ACTOR), prompts, rng
         )
 
@@ -167,9 +171,11 @@ class ModelEngine:
         return jax.jit(forward)
 
     def rollout_forward(self, tokens: jax.Array, prompt_len: int):
-        if self._rollout_forward is None:
-            self._rollout_forward = self._build_rollout_forward(prompt_len)
-        return self._rollout_forward(
+        if prompt_len not in self._rollout_forward:
+            self._rollout_forward[prompt_len] = (
+                self._build_rollout_forward(prompt_len)
+            )
+        return self._rollout_forward[prompt_len](
             self.params(ModelRole.ACTOR),
             self.params(ModelRole.REFERENCE),
             self.params(ModelRole.CRITIC),
@@ -210,8 +216,15 @@ class ModelEngine:
             state["opt"] = opt_states
         ckpt.save(state, meta={"step": step}, storage=True)
 
-    def load(self, ckpt) -> Optional[Tuple[int, Optional[dict]]]:
+    def load(
+        self, ckpt, opt_template: Optional[dict] = None
+    ) -> Optional[Tuple[int, Optional[dict]]]:
+        """Restore all roles; pass the optimizer-state pytree structure as
+        ``opt_template`` to get the saved optimizer state back too (the
+        restore target must contain the key for it to be filled)."""
         state = {r: spec.params for r, spec in self.roles.items()}
+        if opt_template is not None:
+            state["opt"] = opt_template
         restored = ckpt.load(target=state)
         if restored is None:
             return None

@@ -56,7 +56,7 @@ class PPOTrainer:
         self.critic_opt = self.critic_tx.init(
             engine.params(ModelRole.CRITIC)
         )
-        self._train_step = None
+        self._train_step: dict = {}  # prompt_len -> jitted step
         self._prompt_len: Optional[int] = None
 
     # -- experience ----------------------------------------------------------
@@ -102,12 +102,11 @@ class PPOTrainer:
         }
 
     # -- update --------------------------------------------------------------
-    def _build_train_step(self):
+    def _build_train_step(self, P: int):
         cfg = self.config
         engine = self.engine
         actor = engine.roles[ModelRole.ACTOR]
         critic = engine.roles[ModelRole.CRITIC]
-        P = self._prompt_len
         R = cfg.response_length
 
         def loss_fn(actor_p, critic_p, mb):
@@ -153,24 +152,33 @@ class PPOTrainer:
         """Consume the buffer: ``ppo_epochs`` passes of shuffled
         minibatches (reference ``rl_training``).  Returns mean stats."""
         cfg = self.config
-        if self._train_step is None:
-            self._train_step = self._build_train_step()
+        P = self._prompt_len
+        assert P is not None, "call make_experience before train"
+        if P not in self._train_step:
+            self._train_step[P] = self._build_train_step(P)
+        step_fn = self._train_step[P]
         actor_p = self.engine.params(ModelRole.ACTOR)
         critic_p = self.engine.params(ModelRole.CRITIC)
         agg: Dict[str, list] = {}
-        for _ in range(cfg.ppo_epochs):
-            for mb in self.buffer.minibatches(cfg.minibatch_size):
-                mb = {k: jnp.asarray(v) for k, v in mb.items()}
-                (actor_p, critic_p, self.actor_opt, self.critic_opt,
-                 stats) = self._train_step(
-                    actor_p, critic_p, self.actor_opt, self.critic_opt,
-                    mb,
-                )
-                for k, v in stats.items():
-                    agg.setdefault(k, []).append(float(v))
-                self.step += 1
-        self.engine.set_params(ModelRole.ACTOR, actor_p)
-        self.engine.set_params(ModelRole.CRITIC, critic_p)
+        try:
+            for _ in range(cfg.ppo_epochs):
+                for mb in self.buffer.minibatches(cfg.minibatch_size):
+                    mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                    (actor_p, critic_p, self.actor_opt, self.critic_opt,
+                     stats) = step_fn(
+                        actor_p, critic_p, self.actor_opt,
+                        self.critic_opt, mb,
+                    )
+                    for k, v in stats.items():
+                        agg.setdefault(k, []).append(float(v))
+                    self.step += 1
+        finally:
+            # The step donates its inputs (incl. the arrays the engine
+            # held), so the engine must always be re-pointed at the
+            # latest LIVE buffers — even when a minibatch raises, or the
+            # engine is left holding deleted arrays.
+            self.engine.set_params(ModelRole.ACTOR, actor_p)
+            self.engine.set_params(ModelRole.CRITIC, critic_p)
         self.buffer.clear()
         return {k: float(np.mean(v)) for k, v in agg.items()}
 

@@ -1,0 +1,170 @@
+"""FP8 matmul, dynamic loss scaling, and fused quant kernel tests
+(test model: the reference's amp/fp8 opt-method unit tests + quantization
+op tests)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu.ops.amp import (
+    LossScaleState,
+    current_scale,
+    dynamic_loss_scaling,
+    scaled_value_and_grad,
+)
+from dlrover_tpu.ops.fp8 import E4M3, E5M2, Fp8State, fp8_dot
+from dlrover_tpu.ops.quant import (
+    dequantize_blockwise,
+    quantize_blockwise,
+)
+
+
+class TestFp8Dot:
+    def test_forward_close_to_fp32(self):
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(32, 64), jnp.float32)
+        w = jnp.asarray(rs.randn(64, 16), jnp.float32) * 0.1
+        state = Fp8State.init()
+        # First call uses scale=1 (empty history); warm the history so
+        # the scales reflect real amax, then compare.
+        _, state = fp8_dot(x, w, state)
+        out, state = fp8_dot(x, w, state)
+        ref = x @ w
+        err = jnp.linalg.norm(out - ref) / jnp.linalg.norm(ref)
+        assert float(err) < 0.06, float(err)  # e4m3 has ~2 decimal digits
+
+    def test_gradients_flow_and_match_fp32_direction(self):
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(16, 32), jnp.float32)
+        w = jnp.asarray(rs.randn(32, 8), jnp.float32) * 0.2
+        state = Fp8State.init()
+        _, state = fp8_dot(x, w, state)  # warm scales
+
+        def loss(w_):
+            out, _ = fp8_dot(x, w_, state)
+            return jnp.sum(out**2)
+
+        g = jax.grad(loss)(w)
+        g_ref = jax.grad(lambda w_: jnp.sum((x @ w_) ** 2))(w)
+        cos = jnp.sum(g * g_ref) / (
+            jnp.linalg.norm(g) * jnp.linalg.norm(g_ref)
+        )
+        # e5m2 grads carry ~2 mantissa bits; direction, not precision.
+        assert float(cos) > 0.97, float(cos)
+
+    def test_state_tracks_amax_and_scales_large_inputs(self):
+        x = jnp.full((8, 8), 1000.0)  # far beyond e4m3 max (448)
+        w = jnp.eye(8, dtype=jnp.float32)
+        state = Fp8State.init()
+        out1, state = fp8_dot(x, w, state)  # scale=1: clipped to 448
+        assert float(jnp.max(out1)) == pytest.approx(448.0, rel=1e-3)
+        out2, state = fp8_dot(x, w, state)  # scaled: representable now
+        # e4m3 spacing near the top of the range is ~6%.
+        assert float(jnp.max(out2)) == pytest.approx(1000.0, rel=0.10)
+        assert float(jnp.max(state.x_hist)) == pytest.approx(1000.0)
+
+    def test_jit_and_scan_compatible(self):
+        """The state threads through lax.scan (training-loop shape)."""
+        x = jnp.ones((4, 8))
+        w = jnp.ones((8, 4)) * 0.5
+
+        def step(state, _):
+            out, state = fp8_dot(x, w, state)
+            return state, jnp.sum(out)
+
+        state, sums = jax.jit(
+            lambda s: jax.lax.scan(step, s, jnp.arange(3))
+        )(Fp8State.init())
+        assert sums.shape == (3,)
+        assert np.isfinite(np.asarray(sums)).all()
+
+
+class TestDynamicLossScaling:
+    def _setup(self, init_scale=2.0**4):
+        tx = dynamic_loss_scaling(
+            optax.sgd(0.1), init_scale=init_scale,
+            growth_interval=3, growth_factor=2.0, backoff_factor=0.5,
+        )
+        params = {"w": jnp.ones((4,))}
+        return tx, params, tx.init(params)
+
+    def test_unscales_grads(self):
+        tx, params, state = self._setup()
+        scale = current_scale(state)
+        # Caller scaled the loss: grads arrive multiplied by scale.
+        grads = {"w": jnp.full((4,), 2.0) * scale}
+        updates, state = tx.update(grads, state, params)
+        np.testing.assert_allclose(
+            np.asarray(updates["w"]), -0.2 * np.ones(4), rtol=1e-6
+        )
+
+    def test_overflow_skips_step_and_backs_off(self):
+        tx, params, state = self._setup()
+        s0 = float(current_scale(state))
+        grads = {"w": jnp.array([jnp.inf, 1.0, 1.0, 1.0])}
+        updates, state = tx.update(grads, state, params)
+        np.testing.assert_array_equal(np.asarray(updates["w"]), 0.0)
+        assert float(current_scale(state)) == s0 * 0.5
+        assert int(state.good_steps) == 0
+
+    def test_growth_after_streak(self):
+        tx, params, state = self._setup()
+        s0 = float(current_scale(state))
+        grads = {"w": jnp.ones((4,))}
+        for _ in range(3):
+            _, state = tx.update(grads, state, params)
+        assert float(current_scale(state)) == s0 * 2.0
+
+    def test_scaled_value_and_grad_roundtrip(self):
+        tx, params, state = self._setup()
+
+        def loss_fn(p, x):
+            return jnp.sum((p["w"] * x) ** 2)
+
+        fn = scaled_value_and_grad(loss_fn)
+        x = jnp.ones((4,))
+        loss, grads = fn(params, current_scale(state), x)
+        assert float(loss) == pytest.approx(4.0)  # true loss, unscaled
+        updates, state = tx.update(grads, state, params)
+        # grad of true loss = 2 -> sgd(0.1) update = -0.2
+        np.testing.assert_allclose(
+            np.asarray(updates["w"]), -0.2, rtol=1e-6
+        )
+
+    def test_full_fp16_step_jit(self):
+        tx = dynamic_loss_scaling(optax.adam(1e-2))
+        params = {"w": jnp.ones((8,), jnp.float16)}
+        state = tx.init(params)
+
+        def loss_fn(p):
+            return jnp.sum(p["w"].astype(jnp.float32) ** 2)
+
+        @jax.jit
+        def step(params, state):
+            fn = scaled_value_and_grad(lambda p: loss_fn(p))
+            loss, grads = fn(params, current_scale(state))
+            updates, state = tx.update(grads, state, params)
+            return optax.apply_updates(params, updates), state, loss
+
+        for _ in range(5):
+            params, state, loss = step(params, state)
+        assert float(loss) < 8.0  # descended from 8.0
+
+
+class TestPallasQuant:
+    def test_pallas_matches_jnp_path(self):
+        rs = np.random.RandomState(3)
+        x = jnp.asarray(rs.randn(1000) * 10, jnp.float32)
+        cj, sj = quantize_blockwise(x, backend="jnp")
+        cp, sp = quantize_blockwise(x, backend="pallas", interpret=True)
+        np.testing.assert_array_equal(np.asarray(cj), np.asarray(cp))
+        np.testing.assert_allclose(
+            np.asarray(sj), np.asarray(sp), rtol=1e-6
+        )
+        back = dequantize_blockwise(cp, sp, x.shape)
+        assert float(jnp.max(jnp.abs(back - x))) <= float(
+            jnp.max(sp)
+        )  # within one quantization step
