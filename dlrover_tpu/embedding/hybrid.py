@@ -1,0 +1,297 @@
+"""Hybrid mem+disk embedding table: hot rows in RAM, cold rows spilled.
+
+Parity with reference tfplus hybrid storage
+(``tfplus/kv_variable/kernels/hybrid_embedding/table_manager.h:1`` +
+``storage_table.h``: a RAM table fronting a disk table with
+frequency-driven placement).  TPU-host shape: the RAM tier is the
+existing :class:`EmbeddingStore` (native hashmap, full optimizer slots);
+the disk tier is an append-only row log in the store's export layout
+with an in-memory key index, persisted beside it.  Rows move down by an
+LFU-with-aging policy (lowest ``freq``, oldest ``version`` first) when
+the RAM tier exceeds its budget, and move back up on access.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.embedding.store import EmbeddingStore
+
+
+class _DiskTier:
+    """Append-only row log + key index (offset into the log)."""
+
+    def __init__(self, path: str, row_bytes: int):
+        self.data_path = path + ".rows"
+        self.index_path = path + ".idx"
+        self.row_bytes = row_bytes
+        self.index: Dict[int, int] = {}
+        self.dead = 0  # stale rows in the log (promoted/overwritten)
+        if os.path.exists(self.index_path):
+            with open(self.index_path) as f:
+                meta = json.load(f)
+            assert meta["row_bytes"] == row_bytes, (
+                "disk tier dim mismatch"
+            )
+            self.index = {int(k): v for k, v in meta["index"].items()}
+            self.dead = int(meta.get("dead", 0))
+        self._f = open(self.data_path, "ab+")
+
+    def __len__(self) -> int:
+        return len(self.index)
+
+    def __contains__(self, key: int) -> bool:
+        return key in self.index
+
+    def append(self, blob: bytes) -> None:
+        """Write rows (export layout); keys already present are
+        superseded (old bytes become dead)."""
+        if not blob:
+            return
+        self._f.seek(0, os.SEEK_END)
+        base = self._f.tell()
+        assert base % self.row_bytes == 0
+        self._f.write(blob)
+        self._f.flush()
+        n = len(blob) // self.row_bytes
+        arr = np.frombuffer(blob, np.uint8).reshape(n, self.row_bytes)
+        keys = arr[:, :8].copy().view(np.int64).reshape(-1)
+        for i, k in enumerate(keys):
+            k = int(k)
+            if k in self.index:
+                self.dead += 1
+            self.index[k] = base + i * self.row_bytes
+
+    def read(self, keys) -> Tuple[bytes, np.ndarray]:
+        """(concatenated rows, mask of which keys were found).
+
+        Every row read is validated against the key embedded in its
+        bytes: a mismatch (possible after a crash between a compaction
+        and its index sync) is treated as missing and purged from the
+        index — the row re-initializes instead of silently serving
+        another key's embedding."""
+        out = []
+        found = np.zeros(len(keys), bool)
+        for i, k in enumerate(keys):
+            k = int(k)
+            off = self.index.get(k)
+            if off is None:
+                continue
+            self._f.seek(off)
+            raw = self._f.read(self.row_bytes)
+            if (
+                len(raw) != self.row_bytes
+                or int(np.frombuffer(raw[:8], np.int64)[0]) != k
+            ):
+                del self.index[k]
+                self.dead += 1
+                continue
+            out.append(raw)
+            found[i] = True
+        return b"".join(out), found
+
+    def remove(self, keys) -> None:
+        for k in keys:
+            if self.index.pop(int(k), None) is not None:
+                self.dead += 1
+
+    def live_fraction(self) -> float:
+        self._f.seek(0, os.SEEK_END)
+        total = self._f.tell() // self.row_bytes
+        return len(self.index) / total if total else 1.0
+
+    def compact(self) -> None:
+        """Rewrite the log with only live rows.  The index is synced
+        immediately after the file swap; a crash inside the window leaves
+        stale offsets, which read()'s embedded-key validation turns into
+        missing-row re-inits rather than silent wrong values."""
+        tmp = self.data_path + ".tmp"
+        new_index: Dict[int, int] = {}
+        with open(tmp, "wb") as out:
+            for k, off in self.index.items():
+                self._f.seek(off)
+                new_index[k] = out.tell()
+                out.write(self._f.read(self.row_bytes))
+            out.flush()
+            os.fsync(out.fileno())
+        self._f.close()
+        os.replace(tmp, self.data_path)
+        self.index = new_index
+        self.dead = 0
+        self._f = open(self.data_path, "ab+")
+        self.sync()
+
+    def sync(self) -> None:
+        tmp = self.index_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "row_bytes": self.row_bytes,
+                    "dead": self.dead,
+                    "index": {str(k): v for k, v in self.index.items()},
+                },
+                f,
+            )
+        os.replace(tmp, self.index_path)
+
+    def close(self) -> None:
+        self.sync()
+        self._f.close()
+
+
+class HybridEmbeddingStore:
+    """EmbeddingStore-compatible facade over a RAM tier + disk tier.
+
+    ``max_mem_rows`` bounds the RAM tier; exceeding it spills the coldest
+    rows (by (freq, version)) down to ``spill_target`` of the budget.
+    Lookups transparently promote disk rows (with their optimizer slots)
+    back to RAM, so training through a demote/promote cycle is exact.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        spill_path: str,
+        *,
+        max_mem_rows: int = 1_000_000,
+        spill_target: float = 0.8,
+        compact_threshold: float = 0.5,
+        sync_every: int = 8,  # index persists every N spills (and close)
+        **store_kwargs,
+    ):
+        self.dim = dim
+        self.max_mem_rows = max_mem_rows
+        self.spill_target = spill_target
+        self.compact_threshold = compact_threshold
+        self.sync_every = max(1, sync_every)
+        self._spills = 0
+        self.ram = EmbeddingStore(dim, **store_kwargs)
+        os.makedirs(
+            os.path.dirname(os.path.abspath(spill_path)), exist_ok=True
+        )
+        self.disk = _DiskTier(spill_path, self.ram.row_bytes)
+        self._lock = threading.Lock()
+
+    # -- tiering -------------------------------------------------------------
+    def _promote(self, keys: np.ndarray) -> int:
+        """Move any of ``keys`` living on disk back into RAM."""
+        on_disk = [k for k in keys if int(k) in self.disk]
+        if not on_disk:
+            return 0
+        blob, found = self.disk.read(on_disk)
+        n = self.ram.import_rows(blob)
+        self.disk.remove(on_disk)
+        return n
+
+    def maybe_spill(self) -> int:
+        """Enforce the RAM budget; returns rows spilled."""
+        with self._lock:
+            n = len(self.ram)
+            if n <= self.max_mem_rows:
+                return 0
+            target = int(self.max_mem_rows * self.spill_target)
+            keys, freq, ver = self.ram.dump_keys()
+            # Coldest first: LFU with version (recency) as tiebreak.
+            order = np.lexsort((ver, freq))
+            victims = keys[order[: n - target]]
+            blob = self.ram.export_keys(victims)
+            self.disk.append(blob)
+            self.ram.delete(victims)
+            if self.disk.live_fraction() < self.compact_threshold:
+                self.disk.compact()  # compact syncs the index itself
+            # Index syncs are periodic, not per-spill: rewriting the full
+            # key map as JSON on every spill would stall the training
+            # step that triggered it.  Rows spilled since the last sync
+            # are unreachable after a crash (they re-init) — the same
+            # durability class as un-checkpointed training state.
+            self._spills += 1
+            if self._spills % self.sync_every == 0:
+                self.disk.sync()
+            logger.info(
+                "hybrid store: spilled %d rows (ram=%d disk=%d)",
+                len(victims), len(self.ram), len(self.disk),
+            )
+            return len(victims)
+
+    # -- EmbeddingStore surface ---------------------------------------------
+    def lookup(self, keys, train: bool = True) -> np.ndarray:
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        with self._lock:
+            self._promote(keys)
+            out = self.ram.lookup(keys, train=train)
+        # Budget is enforced on EVERY path: inference promotes rows too,
+        # and a serving workload over a long cold tail would otherwise
+        # grow RAM toward the full table.
+        self.maybe_spill()
+        return out
+
+    def _apply(self, kind: str, keys, grads, **kw) -> None:
+        """Optimizer applies promote first: a spill triggered by the
+        preceding lookup may have demoted rows of this very batch, and
+        the RAM tier's apply silently skips missing keys."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        with self._lock:
+            self._promote(keys)
+            getattr(self.ram, f"apply_{kind}")(keys, grads, **kw)
+        self.maybe_spill()
+
+    def apply_sgd(self, keys, grads, **kw):
+        self._apply("sgd", keys, grads, **kw)
+
+    def apply_adagrad(self, keys, grads, **kw):
+        self._apply("adagrad", keys, grads, **kw)
+
+    def apply_adam(self, keys, grads, **kw):
+        self._apply("adam", keys, grads, **kw)
+
+    def apply_group_ftrl(self, keys, grads, **kw):
+        self._apply("group_ftrl", keys, grads, **kw)
+
+    def apply_group_adam(self, keys, grads, **kw):
+        self._apply("group_adam", keys, grads, **kw)
+
+    def delete(self, keys) -> int:
+        """Remove rows from BOTH tiers; returns rows removed."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        with self._lock:
+            removed = self.ram.delete(keys)
+            on_disk = [k for k in keys if int(k) in self.disk]
+            self.disk.remove(on_disk)
+            return removed + len(on_disk)
+
+    def __getattr__(self, name):
+        # metadata/import act on the RAM tier.  filter() too — spilled
+        # rows keep the freq they had at spill time and are NOT
+        # re-filtered on disk (they are already the cold set).
+        if name in ("metadata", "filter", "import_rows", "row_bytes"):
+            return getattr(self.ram, name)
+        raise AttributeError(name)
+
+    def __len__(self) -> int:
+        return len(self.ram) + len(self.disk)
+
+    def export(self, rank_filter: int = 0, world: int = 1) -> bytes:
+        """Both tiers (RAM rows first)."""
+        with self._lock:
+            ram = self.ram.export(rank_filter, world)
+            disk_keys = np.fromiter(
+                self.disk.index.keys(), np.int64, count=len(self.disk)
+            )
+            if world > 1 and len(disk_keys):
+                from dlrover_tpu.embedding.service import _owner
+
+                disk_keys = disk_keys[
+                    _owner(disk_keys, world) == rank_filter
+                ]
+            blob, _ = self.disk.read(disk_keys)
+        return ram + blob
+
+    def close(self) -> None:
+        self.disk.close()
+        self.ram.close()

@@ -61,6 +61,10 @@ def _lib() -> Optional[ctypes.CDLL]:
         ]
         lib.kv_delete.restype = i64
         lib.kv_delete.argtypes = [c, _I64P, i64]
+        lib.kv_dump_keys.restype = i64
+        lib.kv_dump_keys.argtypes = [c, _I64P, _I64P, _I64P, i64]
+        lib.kv_export_keys.restype = i64
+        lib.kv_export_keys.argtypes = [c, _I64P, i64, _U8P]
         lib.kv_metadata.restype = c
         lib.kv_metadata.argtypes = [c, _I64P, i64, _I64P, _I64P]
         lib.kv_filter.restype = i64
@@ -111,6 +115,21 @@ class _PyStore:
                 row["version"] = self.version
             out[i] = row["emb"]
         return out
+
+    def pack_row(self, key: int, row: dict) -> bytes:
+        """One row in the shared export layout (mirrors write_row in
+        kv_store.cc)."""
+        zeros = np.zeros(self.dim, np.float32)
+        return (
+            np.array(
+                [key, row["freq"], row["version"]], np.int64
+            ).tobytes()
+            + row["emb"].astype(np.float32).tobytes()
+            + (row["s0"] if row["s0"] is not None else zeros)
+            .astype(np.float32).tobytes()
+            + (row["s1"] if row["s1"] is not None else zeros)
+            .astype(np.float32).tobytes()
+        )
 
 
 class EmbeddingStore:
@@ -310,6 +329,46 @@ class EmbeddingStore:
             return removed
         return int(self._lib.kv_delete(self._handle, keys, len(keys)))
 
+    def dump_keys(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """All (keys, freqs, versions) — the scan the hybrid tier's
+        eviction policy runs."""
+        if self._py is not None:
+            keys = np.fromiter(
+                (int(k) for k in self._py.rows), np.int64,
+                count=len(self._py.rows),
+            )
+            freq = np.array(
+                [self._py.rows[int(k)]["freq"] for k in keys], np.int64
+            )
+            ver = np.array(
+                [self._py.rows[int(k)]["version"] for k in keys], np.int64
+            )
+            return keys, freq, ver
+        n = len(self)
+        keys = np.empty(max(1, n), np.int64)
+        freq = np.empty(max(1, n), np.int64)
+        ver = np.empty(max(1, n), np.int64)
+        got = int(
+            self._lib.kv_dump_keys(self._handle, keys, freq, ver, n)
+        )
+        return keys[:got], freq[:got], ver[:got]
+
+    def export_keys(self, keys) -> bytes:
+        """Serialize exactly ``keys``' rows (missing keys skipped)."""
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        if self._py is not None:
+            out = []
+            for k in keys:
+                row = self._py.rows.get(int(k))
+                if row is not None:
+                    out.append(self._py.pack_row(int(k), row))
+            return b"".join(out)
+        buf = np.empty(max(1, len(keys)) * self.row_bytes, np.uint8)
+        written = int(
+            self._lib.kv_export_keys(self._handle, keys, len(keys), buf)
+        )
+        return buf[: written * self.row_bytes].tobytes()
+
     # -- metadata / filtering ----------------------------------------------
     def metadata(self, keys) -> Tuple[np.ndarray, np.ndarray]:
         keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
@@ -357,17 +416,7 @@ class EmbeddingStore:
                          * 0x9E3779B97F4A7C15) % (1 << 64) >> 33
                     if h % world != rank_filter:
                         continue
-                zeros = np.zeros(self.dim, np.float32)
-                out.append(
-                    np.array(
-                        [k, row["freq"], row["version"]], np.int64
-                    ).tobytes()
-                    + row["emb"].astype(np.float32).tobytes()
-                    + (row["s0"] if row["s0"] is not None else zeros)
-                    .astype(np.float32).tobytes()
-                    + (row["s1"] if row["s1"] is not None else zeros)
-                    .astype(np.float32).tobytes()
-                )
+                out.append(self._py.pack_row(int(k), row))
             return b"".join(out)
         n = len(self)
         buf = np.empty(max(1, n) * self.row_bytes, np.uint8)

@@ -68,6 +68,25 @@ struct Store {
   }
 };
 
+
+// Serialize one row at p: key,freq,version (i64) + emb,slot0,slot1 (f32[dim]).
+void write_row(uint8_t* p, int64_t key, const Row& row, int dim) {
+  int64_t meta[3] = {key, row.freq, row.version};
+  std::memcpy(p, meta, sizeof(meta));
+  p += sizeof(meta);
+  std::memcpy(p, row.emb.data(), sizeof(float) * dim);
+  p += sizeof(float) * dim;
+  if (!row.slot0.empty())
+    std::memcpy(p, row.slot0.data(), sizeof(float) * dim);
+  else
+    std::memset(p, 0, sizeof(float) * dim);
+  p += sizeof(float) * dim;
+  if (!row.slot1.empty())
+    std::memcpy(p, row.slot1.data(), sizeof(float) * dim);
+  else
+    std::memset(p, 0, sizeof(float) * dim);
+}
+
 const int kMaxStores = 1024;
 std::mutex g_stores_mu;
 std::vector<Store*> g_stores(kMaxStores, nullptr);
@@ -438,23 +457,50 @@ int64_t kv_export(int handle, uint8_t* buf, int64_t max_rows,
         if ((int)(h % (uint64_t)world) != rank_filter) continue;
       }
       if (written >= max_rows) return written;
-      uint8_t* p = buf + written * rb;
-      int64_t meta[3] = {kv.first, kv.second.freq, kv.second.version};
-      std::memcpy(p, meta, sizeof(meta));
-      p += sizeof(meta);
-      std::memcpy(p, kv.second.emb.data(), sizeof(float) * dim);
-      p += sizeof(float) * dim;
-      if (!kv.second.slot0.empty())
-        std::memcpy(p, kv.second.slot0.data(), sizeof(float) * dim);
-      else
-        std::memset(p, 0, sizeof(float) * dim);
-      p += sizeof(float) * dim;
-      if (!kv.second.slot1.empty())
-        std::memcpy(p, kv.second.slot1.data(), sizeof(float) * dim);
-      else
-        std::memset(p, 0, sizeof(float) * dim);
+      write_row(buf + written * rb, kv.first, kv.second, dim);
       ++written;
     }
+  }
+  return written;
+}
+
+// Dump up to max_keys (key, freq, version) triples — the scan the hybrid
+// mem+disk tier uses to pick cold rows for spilling (reference tfplus
+// hybrid_embedding/table_manager.h eviction scan).  Returns count.
+int64_t kv_dump_keys(int handle, int64_t* keys_out, int64_t* freq_out,
+                     int64_t* ver_out, int64_t max_keys) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  int64_t n = 0;
+  for (auto& sh : s->shards) {
+    std::lock_guard<std::mutex> g(sh.mu);
+    for (auto& kv : sh.rows) {
+      if (n >= max_keys) return n;
+      keys_out[n] = kv.first;
+      freq_out[n] = kv.second.freq;
+      ver_out[n] = kv.second.version;
+      ++n;
+    }
+  }
+  return n;
+}
+
+// Export exactly the given keys' rows (same layout as kv_export) into buf;
+// missing keys are skipped.  Returns rows written.
+int64_t kv_export_keys(int handle, const int64_t* keys, int64_t n,
+                       uint8_t* buf) {
+  Store* s = get(handle);
+  if (!s) return -1;
+  int dim = s->dim;
+  int64_t rb = kv_row_bytes(handle);
+  int64_t written = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& sh = s->shard_for(keys[i]);
+    std::lock_guard<std::mutex> g(sh.mu);
+    auto it = sh.rows.find(keys[i]);
+    if (it == sh.rows.end()) continue;
+    write_row(buf + written * rb, keys[i], it->second, dim);
+    ++written;
   }
   return written;
 }
