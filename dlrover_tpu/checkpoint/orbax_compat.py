@@ -1,0 +1,75 @@
+"""Orbax interop: bridge flash checkpoints to/from the JAX ecosystem.
+
+The reference integrates per-framework checkpoint zoos (Megatron, DS,
+HF ``transformers`` save_pretrained); the JAX ecosystem's lingua franca
+is Orbax.  This module lets users (a) hand a flash-checkpoint state to
+any Orbax-consuming tool (evaluation harnesses, serving stacks,
+``ocp.StandardCheckpointer`` pipelines) and (b) seed a flash-checkpoint
+run from an Orbax checkpoint produced elsewhere — closing the
+reference's "resume from a foreign checkpoint" capability
+(``dlrover/python/common/storage.py`` pluggable backends +
+``flash_checkpoint`` per-framework adapters) the TPU-native way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from dlrover_tpu.common.log import logger
+
+
+def save_as_orbax(state: Any, path: str) -> None:
+    """Write a pytree as a standard Orbax checkpoint at ``path``."""
+    import orbax.checkpoint as ocp
+
+    ck = ocp.StandardCheckpointer()
+    ck.save(path, state, force=True)
+    ck.wait_until_finished()
+    logger.info("orbax: wrote checkpoint at %s", path)
+
+
+def load_from_orbax(path: str, target: Any) -> Any:
+    """Restore a pytree (shaped/typed like ``target``) from an Orbax
+    checkpoint."""
+    import orbax.checkpoint as ocp
+
+    ck = ocp.StandardCheckpointer()
+    return ck.restore(path, target=target)
+
+
+def flash_to_orbax(
+    flash_ckpt, orbax_path: str, target: Any
+) -> Optional[Tuple[int, str]]:
+    """Convert the latest flash checkpoint (shm or storage) into an Orbax
+    directory.  ``flash_ckpt`` is a
+    :class:`~dlrover_tpu.checkpoint.checkpointer.FlashCheckpointer`;
+    ``target`` the state pytree structure.  Returns (step, path) or None
+    when there is nothing to convert.
+
+    Note: operates on this process's view of the state — convert from a
+    single-process run or a replicated state, or run once per shard with
+    distinct paths for partitioned states."""
+    restored = flash_ckpt.load(target=target)
+    if restored is None:
+        return None
+    state, meta = restored
+    step = int(meta.get("step", 0))
+    path = f"{orbax_path.rstrip('/')}/step_{step:010d}"
+    save_as_orbax(state, path)
+    return step, path
+
+
+def orbax_to_flash(
+    orbax_path: str, flash_ckpt, target: Any, *, step: int = 0
+) -> int:
+    """Seed a flash-checkpoint run from an Orbax checkpoint: restore into
+    ``target``'s structure and persist through the flash engine so the
+    next elastic (re)start warm-loads it.  Returns the step recorded."""
+    state = load_from_orbax(orbax_path, target)
+    flash_ckpt.save(state, meta={"step": step}, storage=True)
+    flash_ckpt.wait()
+    logger.info(
+        "orbax: seeded flash checkpoint (step %d) from %s",
+        step, orbax_path,
+    )
+    return step

@@ -42,6 +42,13 @@ class _DiskTier:
             self.index = {int(k): v for k, v in meta["index"].items()}
             self.dead = int(meta.get("dead", 0))
         self._f = open(self.data_path, "ab+")
+        # A crash mid-append can leave a torn row at the tail; truncate
+        # to the last whole-row boundary so future appends stay aligned
+        # (offsets past the cut fail read()'s key validation -> re-init).
+        self._f.seek(0, os.SEEK_END)
+        size = self._f.tell()
+        if size % row_bytes:
+            self._f.truncate(size - size % row_bytes)
 
     def __len__(self) -> int:
         return len(self.index)
@@ -56,7 +63,6 @@ class _DiskTier:
             return
         self._f.seek(0, os.SEEK_END)
         base = self._f.tell()
-        assert base % self.row_bytes == 0
         self._f.write(blob)
         self._f.flush()
         n = len(blob) // self.row_bytes
@@ -265,11 +271,29 @@ class HybridEmbeddingStore:
             self.disk.remove(on_disk)
             return removed + len(on_disk)
 
+    def import_rows(self, blob: bytes) -> int:
+        """Imported rows are authoritative: any disk-tier copy of the
+        same key is invalidated, or a later promote would clobber the
+        fresh row with its stale spill-time bytes."""
+        rb = self.ram.row_bytes
+        n = len(blob) // rb
+        with self._lock:
+            if n and len(self.disk):
+                arr = np.frombuffer(blob, np.uint8)[: n * rb]
+                keys = (
+                    arr.reshape(n, rb)[:, :8].copy()
+                    .view(np.int64).reshape(-1)
+                )
+                self.disk.remove(
+                    [k for k in keys if int(k) in self.disk]
+                )
+            return self.ram.import_rows(blob)
+
     def __getattr__(self, name):
-        # metadata/import act on the RAM tier.  filter() too — spilled
-        # rows keep the freq they had at spill time and are NOT
-        # re-filtered on disk (they are already the cold set).
-        if name in ("metadata", "filter", "import_rows", "row_bytes"):
+        # metadata acts on the RAM tier.  filter() too — spilled rows
+        # keep the freq they had at spill time and are NOT re-filtered
+        # on disk (they are already the cold set).
+        if name in ("metadata", "filter", "row_bytes"):
             return getattr(self.ram, name)
         raise AttributeError(name)
 

@@ -1,0 +1,137 @@
+"""Object-store storage backend + Orbax interop tests (completes the
+round-1 partial: checkpoint storage was POSIX-only with no ecosystem
+interop)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.checkpoint.checkpointer import FlashCheckpointer
+from dlrover_tpu.checkpoint.orbax_compat import (
+    flash_to_orbax,
+    load_from_orbax,
+    orbax_to_flash,
+    save_as_orbax,
+)
+from dlrover_tpu.common.storage import (
+    ClassMeta,
+    ObjectStoreStorage,
+    PosixDiskStorage,
+)
+
+
+@pytest.fixture(params=["memory", "file"])
+def object_store(request, tmp_path):
+    if request.param == "memory":
+        spec = {"driver": "memory"}
+    else:
+        spec = {"driver": "file", "path": str(tmp_path / "objs") + "/"}
+    return ObjectStoreStorage(spec)
+
+
+class TestObjectStoreStorage:
+    def test_write_read_exists_remove(self, object_store):
+        st = object_store
+        st.write(b"abc", "/ck/step_1/shard_0.bin")
+        assert st.exists("/ck/step_1/shard_0.bin")
+        assert st.read("/ck/step_1/shard_0.bin") == b"abc"
+        st.write("text", "/ck/meta.txt")
+        assert st.read("/ck/meta.txt", mode="r") == "text"
+        st.safe_remove("/ck/meta.txt")
+        assert st.read("/ck/meta.txt") is None
+        assert st.read("/missing") is None
+
+    def test_listdir_and_prefix_delete(self, object_store):
+        st = object_store
+        for p in ("a/1.bin", "a/2.bin", "a/sub/3.bin", "b/4.bin"):
+            st.write(b"x", f"/root/{p}")
+        assert st.listdir("/root") == ["a", "b"]
+        assert st.listdir("/root/a") == ["1.bin", "2.bin", "sub"]
+        st.safe_rmtree("/root/a")
+        assert st.listdir("/root/a") == []
+        assert st.exists("/root/b/4.bin")
+
+    def test_flash_checkpoint_over_object_store(self, object_store):
+        """The whole flash-checkpoint engine runs against the object
+        store backend (the saver only speaks the storage ABC)."""
+        ckpt = FlashCheckpointer(
+            "/jobs/ck", job_name="obj-store-test", storage=object_store
+        )
+        state = {"w": jnp.arange(8.0), "step": jnp.asarray(3)}
+        ckpt.save(state, meta={"step": 3}, storage=True)
+        assert ckpt.wait(timeout=60)
+        target = jax.tree_util.tree_map(jnp.zeros_like, state)
+        # A fresh checkpointer (cold process analogue) restores from the
+        # object store.
+        ckpt2 = FlashCheckpointer(
+            "/jobs/ck", job_name="obj-store-test2", storage=object_store
+        )
+        got, meta = ckpt2.load(target=target)
+        assert int(meta["step"]) == 3
+        np.testing.assert_array_equal(
+            np.asarray(got["w"]), np.arange(8.0)
+        )
+
+    def test_class_meta_builds_it(self, tmp_path):
+        meta = ClassMeta(
+            class_name="ObjectStoreStorage",
+            kwargs={"spec": {"driver": "memory"}},
+        )
+        st = meta.build()
+        assert isinstance(st, ObjectStoreStorage)
+        st.write(b"z", "/k")
+        assert st.read("/k") == b"z"
+
+
+class TestOrbaxInterop:
+    def _state(self):
+        return {
+            "params": {"w": jnp.arange(6.0).reshape(2, 3)},
+            "step": jnp.asarray(7),
+        }
+
+    def test_round_trip(self, tmp_path):
+        state = self._state()
+        save_as_orbax(state, str(tmp_path / "obx"))
+        target = jax.tree_util.tree_map(jnp.zeros_like, state)
+        got = load_from_orbax(str(tmp_path / "obx"), target)
+        np.testing.assert_array_equal(
+            np.asarray(got["params"]["w"]),
+            np.asarray(state["params"]["w"]),
+        )
+        assert int(got["step"]) == 7
+
+    def test_flash_to_orbax_and_back(self, tmp_path):
+        state = self._state()
+        flash = FlashCheckpointer(
+            str(tmp_path / "flash"), job_name="obx-a"
+        )
+        flash.save(state, meta={"step": 7}, storage=True)
+        assert flash.wait(timeout=60)
+
+        out = flash_to_orbax(
+            flash, str(tmp_path / "obx"),
+            jax.tree_util.tree_map(jnp.zeros_like, state),
+        )
+        assert out is not None
+        step, path = out
+        assert step == 7
+
+        # Seed a brand-new flash run from that orbax dir.
+        flash2 = FlashCheckpointer(
+            str(tmp_path / "flash2"), job_name="obx-b"
+        )
+        orbax_to_flash(
+            path, flash2,
+            jax.tree_util.tree_map(jnp.zeros_like, state), step=step,
+        )
+        got, meta = flash2.load(
+            target=jax.tree_util.tree_map(jnp.zeros_like, state)
+        )
+        assert int(meta["step"]) == 7
+        np.testing.assert_array_equal(
+            np.asarray(got["params"]["w"]),
+            np.asarray(state["params"]["w"]),
+        )
