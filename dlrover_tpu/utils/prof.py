@@ -19,6 +19,12 @@ from typing import Deque, Dict, Optional
 from dlrover_tpu.common.log import logger
 
 
+def percentile(sorted_xs, p: float) -> float:
+    """Nearest-rank percentile over a pre-sorted sequence."""
+    n = len(sorted_xs)
+    return sorted_xs[min(n - 1, int(p * n))]
+
+
 class StepProfiler:
     """Per-step wall-time stats with percentile summaries.
 
@@ -57,7 +63,7 @@ class StepProfiler:
         n = len(xs)
 
         def pct(p: float) -> float:
-            return xs[min(n - 1, int(p * n))]
+            return percentile(xs, p)
 
         return {
             "steps": float(self.total_steps),
