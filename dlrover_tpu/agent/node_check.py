@@ -70,6 +70,24 @@ if coord and nproc > 1:
     for _ in range(4):
         g(arr).block_until_ready()
     comm_t = time.perf_counter() - t0
+    if os.environ.get("DLROVER_TPU_COMM_PERF", "") == "1":
+        # Bandwidth sweep (reference --comm-perftest): allreduce bus
+        # bandwidth at growing payloads, algbw = 2*(n-1)/n * bytes / t.
+        nd = jax.device_count()
+        for m_sweep in (1 << 20, 1 << 22, 1 << 24):
+            per_s = m_sweep // nd
+            a = jax.make_array_from_process_local_data(
+                sharding,
+                np.ones((per_s * jax.local_device_count(),), np.float32))
+            g(a).block_until_ready()
+            t1 = time.perf_counter()
+            reps = 4
+            for _ in range(reps):
+                g(a).block_until_ready()
+            el = (time.perf_counter() - t1) / reps
+            busbw = 2.0 * (nd - 1) / nd * (m_sweep * 4) / el / 1e9
+            print(f"COMM_PERF bytes={m_sweep * 4} time_s={el:.6f} "
+                  f"busbw_gbps={busbw:.3f}", flush=True)
 else:
     comm_t = 0.0
 print(f"NODE_CHECK_RESULT {matmul_t + comm_t:.6f}", flush=True)
@@ -77,12 +95,15 @@ print(f"NODE_CHECK_RESULT {matmul_t + comm_t:.6f}", flush=True)
 
 
 def _run_check_payload(
-    coord: str, nproc: int, pid: int, timeout: float = 300.0
+    coord: str, nproc: int, pid: int, timeout: float = 300.0,
+    comm_perf: bool = False,
 ) -> Optional[float]:
     env = dict(os.environ)
     env["DLROVER_TPU_CHECK_COORD"] = coord
     env["DLROVER_TPU_CHECK_NPROC"] = str(nproc)
     env["DLROVER_TPU_CHECK_PID"] = str(pid)
+    if comm_perf:
+        env["DLROVER_TPU_COMM_PERF"] = "1"
     try:
         out = subprocess.run(
             [sys.executable, "-c", _PAYLOAD],
@@ -94,9 +115,14 @@ def _run_check_payload(
     except subprocess.TimeoutExpired:
         logger.error("node check payload timed out")
         return None
+    result = None
     for line in out.stdout.splitlines():
+        if line.startswith("COMM_PERF"):
+            logger.info("comm perf: %s", line[len("COMM_PERF "):])
         if line.startswith("NODE_CHECK_RESULT"):
-            return float(line.split()[1])
+            result = float(line.split()[1])
+    if result is not None:
+        return result
     logger.error(
         "node check payload failed rc=%d stderr=%s",
         out.returncode, out.stderr[-2000:],
@@ -108,8 +134,11 @@ def node_health_check(
     config, master_addr: str, client: MasterClient, rounds: int = 2
 ) -> bool:
     """Run ``rounds`` of the paired benchmark; returns False if the master
-    declares this node faulty (reference ``node_health_check :1460``)."""
+    declares this node faulty (reference ``node_health_check :1460``).
+    With ``config.comm_perf_test`` the final round also sweeps allreduce
+    payload sizes and logs bus bandwidth (reference ``--comm-perftest``)."""
     host = local_ip()
+    comm_perf = bool(getattr(config, "comm_perf_test", False))
     for r in range(rounds):
         port = find_free_port()
         client.register_node(
@@ -136,7 +165,10 @@ def node_health_check(
             for rank, meta in world.items():
                 if meta["node_id"] == config.node_id:
                     my_pid = int(rank)
-        elapsed = _run_check_payload(coord if nproc > 1 else "", nproc, my_pid)
+        elapsed = _run_check_payload(
+            coord if nproc > 1 else "", nproc, my_pid,
+            comm_perf=comm_perf and r == rounds - 1,
+        )
         succeeded = elapsed is not None
         client.report_network_check(
             succeeded, elapsed if elapsed else 0.0, round_=r

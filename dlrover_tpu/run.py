@@ -185,7 +185,10 @@ def run(args: argparse.Namespace) -> int:
     except Exception as e:  # noqa: BLE001
         logger.warning("could not fetch master run config: %s", e)
 
-    if args.network_check:
+    # Gate on the CONFIG (CLI merged with master-pushed run config just
+    # above) so a master enabling/disabling the checks actually takes
+    # effect — node_health_check reads config.comm_perf_test too.
+    if config.network_check or config.comm_perf_test:
         from dlrover_tpu.agent.node_check import node_health_check
 
         ok = node_health_check(config, master_addr, client)

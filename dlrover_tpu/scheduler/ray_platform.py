@@ -1,19 +1,23 @@
-"""Ray platform: nodes as Ray actors (API-compatible stub).
+"""Ray platform: nodes as Ray actors.
 
 Parity with reference ``scheduler/ray.py`` (``RayClient :51``) +
 ``master/scaler/ray_scaler.py`` (``ActorScaler :39``) + the submitter
-(``client/platform/ray/ray_job_submitter.py``).  Gated on the ``ray``
-package; without it the class raises at construction, keeping the factory
-importable (SURVEY.md §2 #34).
+(``client/platform/ray/ray_job_submitter.py``).  Each node is a detached
+actor that runs the elastic agent with the env contract the launcher
+would have provided.  Gated on the ``ray`` package unless a ``ray_mod``
+is injected — tests drive the full CRUD/watch/failure-detection logic
+against a fake Ray (the same pattern as GkePlatform's fake kube API).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import queue
 import threading
 import time
-from typing import Iterator, List
+from typing import Dict, Iterator, List, Optional, Sequence
 
-from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.common.constants import NodeEventType, NodeStatus
 from dlrover_tpu.common.node import Node
 from dlrover_tpu.scheduler.platform import (
     PlatformClient,
@@ -23,78 +27,146 @@ from dlrover_tpu.scheduler.platform import (
 )
 
 
-class RayPlatform(PlatformClient):  # pragma: no cover - needs ray
+class RayPlatform(PlatformClient):
     """Each node is a detached Ray actor running the elastic agent."""
 
-    def __init__(self, namespace: str = "dlrover_tpu"):
-        try:
-            import ray  # type: ignore
-        except ImportError as e:
-            raise RuntimeError("RayPlatform requires the 'ray' package") from e
-        self._ray = ray
-        if not ray.is_initialized():
-            ray.init(namespace=namespace, ignore_reinit_error=True)
-        self._actors = {}
+    def __init__(
+        self,
+        namespace: str = "dlrover_tpu",
+        agent_env: Optional[Dict[str, str]] = None,
+        agent_args: Optional[Sequence[str]] = None,
+        poll_interval: float = 5.0,
+        ray_mod=None,
+    ):
+        """``agent_args``: the launcher argv every node shares (e.g.
+        ``["--nnodes=4", "--nproc_per_node=4", "--master_addr=H:P",
+        "train.py", "--", "--steps=100"]``); per-node identity flags are
+        appended by :meth:`create_node`."""
+        if ray_mod is not None:
+            self._ray = ray_mod
+        else:  # pragma: no cover - needs the ray package
+            try:
+                import ray  # type: ignore
+            except ImportError as e:
+                raise RuntimeError(
+                    "RayPlatform requires the 'ray' package"
+                ) from e
+            self._ray = ray
+            if not ray.is_initialized():
+                ray.init(namespace=namespace, ignore_reinit_error=True)
+        self._agent_env = dict(agent_env or {})
+        self._agent_args = list(agent_args or [])
+        self._poll_interval = poll_interval
+        self._lock = threading.Lock()
+        self._actors: Dict[str, object] = {}
+        self._nodes: Dict[str, PlatformNode] = {}
+        self._events: "queue.Queue[PlatformNodeEvent]" = queue.Queue()
 
-    def create_node(self, node: Node, job_name: str) -> PlatformNode:
+    def _agent_actor_cls(self):
         ray = self._ray
 
         @ray.remote
         class AgentActor:
-            def run(self, env):  # pragma: no cover
+            def run(self, env, argv):  # pragma: no cover - inside ray
                 import os
-                import runpy
 
                 os.environ.update(env)
-                runpy.run_module("dlrover_tpu.agent", run_name="__main__")
+                from dlrover_tpu import run as run_mod
+
+                return run_mod.run(run_mod.parse_args(argv))
 
             def ping(self):
                 return True
 
+        return AgentActor
+
+    def create_node(self, node: Node, job_name: str) -> PlatformNode:
         name = _node_name(job_name, node)
-        actor = AgentActor.options(
+        actor = self._agent_actor_cls().options(
             name=name, lifetime="detached"
         ).remote()
-        self._actors[name] = actor
-        return PlatformNode(
+        # Start the agent (fire-and-forget): the actor IS the node.
+        # Identity travels as launcher argv — the surface run.py reads.
+        # Per-node flags go before the entrypoint (and before the "--"
+        # separating the training script's own args).
+        ident = [
+            f"--job_name={job_name}",
+            f"--node_rank={node.rank_index}",
+            f"--node_id={node.id}",
+        ]
+        cut = len(self._agent_args)
+        for i, a in enumerate(self._agent_args):
+            if a == "--" or not a.startswith("--"):
+                cut = i
+                break
+        argv = [*self._agent_args[:cut], *ident, *self._agent_args[cut:]]
+        actor.run.remote(dict(self._agent_env), argv)
+        pn = PlatformNode(
             name=name,
             node_type=node.type,
             node_id=node.id,
             rank_index=node.rank_index,
             status=NodeStatus.RUNNING,
+            resource=node.config_resource,
             create_time=time.time(),
         )
+        with self._lock:
+            self._actors[name] = actor
+            self._nodes[name] = pn
+        return dataclasses.replace(pn)
 
     def delete_node(self, name: str) -> bool:
-        actor = self._actors.pop(name, None)
+        with self._lock:
+            actor = self._actors.pop(name, None)
+            pn = self._nodes.pop(name, None)
         if actor is None:
             return False
         self._ray.kill(actor)
+        if pn is not None:
+            pn.status = NodeStatus.DELETED
+            # Deleted nodes vanish from polls; the job manager's DELETED
+            # handling needs an explicit event (InMemoryPlatform parity).
+            self._events.put(
+                PlatformNodeEvent(
+                    NodeEventType.DELETED, dataclasses.replace(pn)
+                )
+            )
         return True
 
     def list_nodes(self) -> List[PlatformNode]:
-        nodes = []
-        for name, actor in list(self._actors.items()):
+        out = []
+        with self._lock:
+            snapshot = list(self._actors.items())
+        for name, actor in snapshot:
+            with self._lock:
+                pn = self._nodes.get(name)
+            if pn is None:  # deleted between snapshot and here
+                continue
             try:
                 self._ray.get(actor.ping.remote(), timeout=5)
-                status = NodeStatus.RUNNING
-            except Exception:
-                status = NodeStatus.FAILED
-            nodes.append(
-                PlatformNode(
-                    name=name, node_type="worker", node_id=0, rank_index=0,
-                    status=status,
-                )
-            )
-        return nodes
+                pn.status = NodeStatus.RUNNING
+            except Exception:  # noqa: BLE001 - actor dead/unreachable
+                pn.status = NodeStatus.FAILED
+            out.append(dataclasses.replace(pn))
+        return out
 
     def watch(self, stop: threading.Event) -> Iterator[PlatformNodeEvent]:
-        from dlrover_tpu.common.constants import NodeEventType
-
-        seen = {}
+        """Change stream: explicit delete events + status polling (Ray
+        has no pod-watch analogue; the poll pings every actor, so the
+        interval trades detection latency against O(actors) RPCs)."""
+        seen: Dict[str, str] = {}
         while not stop.is_set():
+            try:
+                while True:
+                    ev = self._events.get_nowait()
+                    seen.pop(ev.node.name, None)
+                    yield ev
+            except queue.Empty:
+                pass
             for pn in self.list_nodes():
                 if seen.get(pn.name) != pn.status:
                     seen[pn.name] = pn.status
-                    yield PlatformNodeEvent(NodeEventType.MODIFIED, pn)
-            stop.wait(5.0)
+                    yield PlatformNodeEvent(
+                        NodeEventType.MODIFIED, dataclasses.replace(pn)
+                    )
+            stop.wait(self._poll_interval)
