@@ -177,11 +177,18 @@ def run(args: argparse.Namespace) -> int:
 
     # Merge master-pushed run config (reference _elastic_config_from_master).
     client = MasterClient(master_addr, node_id)
+    def _coerce(cur, val):
+        # bool("false") is True: string-valued run configs (the usual
+        # wire form) need explicit truthiness parsing for bool fields.
+        if isinstance(cur, bool) and isinstance(val, str):
+            return val.strip().lower() in ("1", "true", "yes", "on")
+        return type(cur)(val)
+
     try:
         pushed = client.get_elastic_run_config()
         for key, val in pushed.items():
             if hasattr(config, key):
-                setattr(config, key, type(getattr(config, key))(val))
+                setattr(config, key, _coerce(getattr(config, key), val))
     except Exception as e:  # noqa: BLE001
         logger.warning("could not fetch master run config: %s", e)
 

@@ -18,6 +18,7 @@ import time
 from typing import Dict, Iterator, List, Optional, Sequence
 
 from dlrover_tpu.common.constants import NodeEventType, NodeStatus
+from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.node import Node
 from dlrover_tpu.scheduler.platform import (
     PlatformClient,
@@ -65,7 +66,10 @@ class RayPlatform(PlatformClient):
     def _agent_actor_cls(self):
         ray = self._ray
 
-        @ray.remote
+        # max_concurrency=2: run() blocks the actor for the job's whole
+        # lifetime; ping() must be served concurrently or health checks
+        # would report every busy (healthy) node as dead.
+        @ray.remote(max_concurrency=2)
         class AgentActor:
             def run(self, env, argv):  # pragma: no cover - inside ray
                 import os
@@ -82,13 +86,30 @@ class RayPlatform(PlatformClient):
 
     def create_node(self, node: Node, job_name: str) -> PlatformNode:
         name = _node_name(job_name, node)
+        # Detached actors outlive a crashed master; a same-named orphan
+        # from the previous incarnation must be killed or the named
+        # create below raises and the orphan trains invisibly forever.
+        get_actor = getattr(self._ray, "get_actor", None)
+        if get_actor is not None and name not in self._actors:
+            try:
+                orphan = get_actor(name)
+            except Exception:  # noqa: BLE001 - no such actor
+                orphan = None
+            if orphan is not None:
+                logger.warning(
+                    "ray: killing orphaned actor %s from a previous "
+                    "master incarnation", name,
+                )
+                self._ray.kill(orphan)
         actor = self._agent_actor_cls().options(
             name=name, lifetime="detached"
         ).remote()
         # Start the agent (fire-and-forget): the actor IS the node.
         # Identity travels as launcher argv — the surface run.py reads.
         # Per-node flags go before the entrypoint (and before the "--"
-        # separating the training script's own args).
+        # separating the training script's own args).  Flags in
+        # agent_args must use the --flag=value form: with space-separated
+        # values the entrypoint boundary is ambiguous without the parser.
         ident = [
             f"--job_name={job_name}",
             f"--node_rank={node.rank_index}",
@@ -99,6 +120,14 @@ class RayPlatform(PlatformClient):
             if a == "--" or not a.startswith("--"):
                 cut = i
                 break
+            if "=" not in a and i + 1 < len(self._agent_args) and not (
+                self._agent_args[i + 1].startswith("--")
+            ):
+                raise ValueError(
+                    f"agent_args flag {a!r} uses a space-separated "
+                    "value; use --flag=value so the entrypoint boundary "
+                    "is unambiguous"
+                )
         argv = [*self._agent_args[:cut], *ident, *self._agent_args[cut:]]
         actor.run.remote(dict(self._agent_env), argv)
         pn = PlatformNode(
@@ -134,20 +163,46 @@ class RayPlatform(PlatformClient):
         return True
 
     def list_nodes(self) -> List[PlatformNode]:
-        out = []
         with self._lock:
             snapshot = list(self._actors.items())
+        # Fire every ping first, then resolve with ONE shared deadline —
+        # serial 5s-per-dead-actor waits would stall the watch loop and
+        # delay failure detection for every other node.
+        refs = []
         for name, actor in snapshot:
+            try:
+                refs.append((name, actor.ping.remote()))
+            except Exception:  # noqa: BLE001
+                refs.append((name, None))
+        wait = getattr(self._ray, "wait", None)
+        ready = None
+        if wait is not None and refs:
+            live_refs = [r for _, r in refs if r is not None]
+            try:
+                done, _ = wait(
+                    live_refs, num_returns=len(live_refs), timeout=5
+                )
+                ready = set(map(id, done))
+            except Exception:  # noqa: BLE001
+                ready = None
+        out = []
+        for name, ref in refs:
+            if ready is not None:
+                ok = ref is not None and id(ref) in ready
+            else:
+                try:
+                    ok = ref is not None and bool(
+                        self._ray.get(ref, timeout=5)
+                    )
+                except Exception:  # noqa: BLE001
+                    ok = False
+            status = NodeStatus.RUNNING if ok else NodeStatus.FAILED
             with self._lock:
                 pn = self._nodes.get(name)
-            if pn is None:  # deleted between snapshot and here
-                continue
-            try:
-                self._ray.get(actor.ping.remote(), timeout=5)
-                pn.status = NodeStatus.RUNNING
-            except Exception:  # noqa: BLE001 - actor dead/unreachable
-                pn.status = NodeStatus.FAILED
-            out.append(dataclasses.replace(pn))
+                if pn is None:
+                    continue  # deleted mid-listing: not a failure
+                pn.status = status
+                out.append(dataclasses.replace(pn))
         return out
 
     def watch(self, stop: threading.Event) -> Iterator[PlatformNodeEvent]:

@@ -55,9 +55,13 @@ class FakeRay:
     def __init__(self):
         self.actors = {}
         self.alive = {}
+        self.remote_kwargs = None
 
-    def remote(self, cls):
+    def remote(self, cls=None, **kwargs):
         fake = self
+        if cls is None:  # parameterized form: @ray.remote(max_concurrency=2)
+            fake.remote_kwargs = kwargs
+            return lambda c: fake.remote(c)
 
         class _Factory:
             @staticmethod
@@ -79,6 +83,16 @@ class FakeRay:
         if not self.alive.get(name, False):
             raise RuntimeError(f"actor {name} dead")
         return True
+
+    def wait(self, refs, num_returns=None, timeout=None):
+        done = [r for r in refs if self.alive.get(r[1], False)]
+        pending = [r for r in refs if r not in done]
+        return done, pending
+
+    def get_actor(self, name):
+        if name in self.actors and self.alive.get(name):
+            return self.actors[name]
+        raise ValueError(f"no actor {name}")
 
     def kill(self, handle):
         self.alive[handle._name] = False
