@@ -69,7 +69,9 @@ def model_flops_per_step(cfg, batch, seq) -> float:
     return 6.0 * dense * tokens + attn
 
 
-def main() -> int:
+def _measure_candidate(cfg, batch, seq, remat, iters):
+    """Compile + time one (model, batch, remat) point through
+    accelerate(); returns (sec/step, final loss) or raises (e.g. OOM)."""
     import numpy as np
 
     import jax
@@ -80,41 +82,23 @@ def main() -> int:
     from dlrover_tpu.parallel.accelerate import Strategy, accelerate
     from dlrover_tpu.parallel.mesh import MeshSpec
 
-    on_tpu = jax.default_backend() == "tpu"
-    if on_tpu:
-        cfg = llama.LlamaConfig.small_300m()
-        batch, seq, iters = 8, 2048, 10
-    else:
-        cfg = llama.LlamaConfig.tiny()
-        batch, seq, iters = 4, 64, 3
-
-    tx = optax.adamw(3e-4)
-
     rng = np.random.RandomState(0)
     sample_tokens = rng.randint(
         0, cfg.vocab_size, size=(batch, seq + 1)
     ).astype(np.int32)
-
-    # Single candidate (single-chip dp mesh, no remat — the 300M state fits
-    # HBM comfortably; donation recycles the state buffers): accelerate()
-    # builds the sharded, donated, compiled step.
     job = accelerate(
         loss_fn=lambda p, b: llama.loss_fn(p, b, cfg),
         init_fn=lambda r: llama.init_params(r, cfg),
-        optimizer=tx,
+        optimizer=optax.adamw(3e-4),
         sample_batch={"tokens": sample_tokens},
-        strategy=Strategy(mesh=MeshSpec(dp=jax.local_device_count()),
-                          remat="none"),
+        strategy=Strategy(
+            mesh=MeshSpec(dp=jax.local_device_count()), remat=remat
+        ),
     )
-    print(
-        f"bench: strategy {job.strategy.describe()}",
-        file=sys.stderr,
-    )
-
     state = job.create_state(jax.random.PRNGKey(0))
     batch_pt = {"tokens": jnp.asarray(sample_tokens)}
-    # Warmup/compile; the float() host transfer forces full completion even
-    # on tunneled/async backends where block_until_ready is a no-op.
+    # Warmup/compile; the float() host transfer forces full completion
+    # even on tunneled/async backends where block_until_ready is lazy.
     state, metrics = job.train_step(state, batch_pt)
     _ = float(metrics["loss"])
     t0 = time.perf_counter()
@@ -123,13 +107,72 @@ def main() -> int:
     loss = float(metrics["loss"])
     jax.block_until_ready(state)
     dt = (time.perf_counter() - t0) / iters
+    # Free this candidate's state before the next one compiles.
+    del state, job, batch_pt
+    return dt, loss
+
+
+def main() -> int:
+    import jax
+
+    from dlrover_tpu.models import llama
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # Candidate sweep, measured on the real chip, best kept: batch
+        # and remat trade HBM for efficiency, and the 800M config's
+        # wider GEMMs use the MXU better IF its optimizer state fits.
+        # OOM (or any failure) just eliminates a candidate.
+        candidates = [
+            ("llama_300m", llama.LlamaConfig.small_300m(), 8, "none", 3),
+            ("llama_300m", llama.LlamaConfig.small_300m(), 16, "dots", 3),
+            ("llama_800m", llama.LlamaConfig.medium_800m(), 8, "dots", 3),
+            ("llama_800m", llama.LlamaConfig.medium_800m(), 16, "full", 3),
+        ]
+        seq, iters = 2048, 10
+    else:
+        candidates = [("llama_tiny", llama.LlamaConfig.tiny(), 4, "none", 1)]
+        seq, iters = 64, 3
+
+    best = None  # (flops/sec, name, cfg, batch, remat, dt, loss)
+    for name, cfg, batch, remat, probe_iters in candidates:
+        try:
+            dt, loss = _measure_candidate(cfg, batch, seq, remat,
+                                          probe_iters)
+        except Exception as e:  # noqa: BLE001 - OOM/compile failure
+            print(
+                f"bench: candidate {name} b={batch} remat={remat} "
+                f"failed: {type(e).__name__}: {str(e)[:200]}",
+                file=sys.stderr,
+            )
+            continue
+        flops = model_flops_per_step(cfg, batch, seq)
+        rate = flops / dt
+        print(
+            f"bench: candidate {name} b={batch} remat={remat}: "
+            f"{dt*1e3:.1f} ms/step, {rate/1e12:.1f} model TFLOP/s",
+            file=sys.stderr,
+        )
+        if best is None or rate > best[0]:
+            best = (rate, name, cfg, batch, remat, dt, loss)
+    if best is None:
+        print(json.dumps({"metric": "llama_train_mfu", "value": 0.0,
+                          "unit": "%", "vs_baseline": 0.0,
+                          "error": "all candidates failed"}))
+        return 1
+
+    _, name, cfg, batch, remat, dt, loss = best
+    # Re-measure the winner at full iteration count for a stable number.
+    try:
+        dt, loss = _measure_candidate(cfg, batch, seq, remat, iters)
+    except Exception:  # noqa: BLE001 - keep the probe measurement
+        pass
 
     flops = model_flops_per_step(cfg, batch, seq)
     n_dev = jax.local_device_count()
     peak = detect_peak() * n_dev
     mfu_pct = 100.0 * flops / dt / peak
     tokens_per_sec = batch * seq / dt
-    n_params = llama.num_params(state["params"])
 
     print(
         json.dumps(
@@ -138,10 +181,10 @@ def main() -> int:
                 "value": round(mfu_pct, 2),
                 "unit": "%",
                 "vs_baseline": round(mfu_pct / REFERENCE_HFU_PCT, 4),
-                "model": f"llama_{n_params/1e6:.0f}M",
+                "model": name,
                 "backend": jax.default_backend(),
                 "devices": n_dev,
-                "strategy": job.strategy.describe(),
+                "strategy": f"dp{n_dev} remat={remat} batch={batch}",
                 "step_time_s": round(dt, 4),
                 "tokens_per_sec": round(tokens_per_sec, 1),
                 "final_loss": round(loss, 4),

@@ -68,6 +68,15 @@ class LlamaConfig:
             d_model=1024, d_ff=2816, max_seq_len=2048,
         )
 
+    @classmethod
+    def medium_800m(cls) -> "LlamaConfig":
+        """~780M params: d_model 1536 keeps matmuls MXU-sized (the 300M
+        config's 1024-wide GEMMs leave systolic-array lanes idle)."""
+        return cls(
+            vocab_size=32000, n_layer=24, n_head=16, n_kv_head=16,
+            d_model=1536, d_ff=4096, max_seq_len=2048,
+        )
+
 
 def _dense(key, fan_in, fan_out, std=0.02):
     return jax.random.normal(key, (fan_in, fan_out), jnp.float32) * std

@@ -101,34 +101,44 @@ class RayPlatform(PlatformClient):
                     "master incarnation", name,
                 )
                 self._ray.kill(orphan)
-        actor = self._agent_actor_cls().options(
-            name=name, lifetime="detached"
-        ).remote()
-        # Start the agent (fire-and-forget): the actor IS the node.
-        # Identity travels as launcher argv — the surface run.py reads.
-        # Per-node flags go before the entrypoint (and before the "--"
-        # separating the training script's own args).  Flags in
-        # agent_args must use the --flag=value form: with space-separated
-        # values the entrypoint boundary is ambiguous without the parser.
+        # Build the agent argv FIRST (a bad conf must not leak a named
+        # detached actor).  Identity flags go before the entrypoint; the
+        # REAL parser finds the entrypoint boundary, so bare store_true
+        # flags and space-separated values both split correctly.
+        from dlrover_tpu import run as run_mod
+
+        try:
+            parsed = run_mod.parse_args(list(self._agent_args))
+        except SystemExit as e:
+            raise ValueError(
+                f"agent_args is not a valid launcher argv: "
+                f"{self._agent_args}"
+            ) from e
+        cut = self._agent_args.index(parsed.entrypoint)
         ident = [
             f"--job_name={job_name}",
             f"--node_rank={node.rank_index}",
             f"--node_id={node.id}",
         ]
-        cut = len(self._agent_args)
-        for i, a in enumerate(self._agent_args):
-            if a == "--" or not a.startswith("--"):
-                cut = i
-                break
-            if "=" not in a and i + 1 < len(self._agent_args) and not (
-                self._agent_args[i + 1].startswith("--")
-            ):
-                raise ValueError(
-                    f"agent_args flag {a!r} uses a space-separated "
-                    "value; use --flag=value so the entrypoint boundary "
-                    "is unambiguous"
-                )
         argv = [*self._agent_args[:cut], *ident, *self._agent_args[cut:]]
+        # ray.kill returns before the GCS releases the actor name, so a
+        # named create right after killing the orphan can still collide;
+        # retry briefly.
+        actor = None
+        err = None
+        for _ in range(20):
+            try:
+                actor = self._agent_actor_cls().options(
+                    name=name, lifetime="detached"
+                ).remote()
+                break
+            except Exception as e:  # noqa: BLE001 - name still taken
+                err = e
+                time.sleep(0.5)
+        if actor is None:
+            raise RuntimeError(
+                f"could not create actor {name}: {err}"
+            )
         actor.run.remote(dict(self._agent_env), argv)
         pn = PlatformNode(
             name=name,
@@ -188,7 +198,16 @@ class RayPlatform(PlatformClient):
         out = []
         for name, ref in refs:
             if ready is not None:
+                # ray.wait marks ERRORED refs ready too (a dead actor's
+                # ping resolves to RayActorError immediately) — the get
+                # below is what distinguishes alive from crashed, and it
+                # is instant for a resolved ref.
                 ok = ref is not None and id(ref) in ready
+                if ok:
+                    try:
+                        self._ray.get(ref, timeout=1)
+                    except Exception:  # noqa: BLE001
+                        ok = False
             else:
                 try:
                     ok = ref is not None and bool(

@@ -25,7 +25,7 @@ import numpy as np
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.trainer.trainer import Trainer, TrainerState, TrainingArgs
 
-# family name -> builder(conf) -> (loss_fn, init_fn, fetch_batch, size)
+# family name -> builder(conf) -> (loss_fn, init_fn, fetch_batch)
 _FAMILIES: Dict[str, Callable] = {}
 
 
@@ -70,10 +70,18 @@ class TrainConf:
 # -- built-in families -------------------------------------------------------
 
 
+def _synthetic_tokens(indices, seq_len: int, vocab: int) -> np.ndarray:
+    """Deterministic, index-addressable token sequences (elastic
+    re-partition safe: any process can materialize any record)."""
+    rngs = np.random.RandomState(0)
+    base = rngs.randint(0, vocab, size=(seq_len + 1,))
+    return np.stack(
+        [(base + int(i)) % vocab for i in indices]
+    ).astype("int32")
+
+
 @register_model_family("nanogpt")
 def _nanogpt(conf: TrainConf):
-    import jax
-
     from dlrover_tpu.models import nanogpt
 
     cfg = nanogpt.GPTConfig.tiny()
@@ -82,11 +90,7 @@ def _nanogpt(conf: TrainConf):
     )
 
     def fetch(indices):
-        rngs = np.random.RandomState(0)
-        base = rngs.randint(0, cfg.vocab_size, size=(conf.seq_len + 1,))
-        out = np.stack(
-            [(base + int(i)) % cfg.vocab_size for i in indices]
-        ).astype("int32")
+        out = _synthetic_tokens(indices, conf.seq_len, cfg.vocab_size)
         return {"tokens": out[:, :-1], "targets": out[:, 1:]}
 
     def loss_fn(params, batch):
@@ -105,11 +109,7 @@ def _llama(conf: TrainConf):
     cfg = dataclasses.replace(cfg, **conf.model_args)
 
     def fetch(indices):
-        rngs = np.random.RandomState(0)
-        base = rngs.randint(0, cfg.vocab_size, size=(conf.seq_len + 1,))
-        out = np.stack(
-            [(base + int(i)) % cfg.vocab_size for i in indices]
-        ).astype("int32")
+        out = _synthetic_tokens(indices, conf.seq_len, cfg.vocab_size)
         return {"tokens": out}
 
     def loss_fn(params, batch):
