@@ -1,0 +1,159 @@
+"""Vision Transformer: the non-LLM model family.
+
+The reference trains arbitrary torch models (its examples include
+vision/CV workloads alongside Llama); this ViT shows the framework's
+model-agnostic surface — ``accelerate()``, the Trainer, flash ckpt and
+the conf executor all operate on (init_fn, loss_fn) pairs, so a vision
+model needs nothing framework-side.  TPU notes: patch embedding is a
+single reshaped matmul (not a conv — XLA maps it onto the MXU
+directly), attention reuses the Pallas flash-attention dispatcher, and
+shapes are static throughout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.ops.flash_attention import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    d_model: int = 384
+    n_layer: int = 12
+    n_head: int = 6
+    d_ff: int = 1536
+    num_classes: int = 1000
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.channels * self.patch_size**2
+
+    @classmethod
+    def tiny(cls, **over) -> "ViTConfig":
+        base = dict(
+            image_size=32, patch_size=8, channels=3, d_model=64,
+            n_layer=2, n_head=4, d_ff=128, num_classes=10,
+        )
+        base.update(over)
+        return cls(**base)
+
+    @classmethod
+    def base_86m(cls) -> "ViTConfig":
+        return cls(d_model=768, n_layer=12, n_head=12, d_ff=3072)
+
+
+def _dense(key, n_in, n_out, scale=None):
+    scale = scale if scale is not None else (2.0 / (n_in + n_out)) ** 0.5
+    return jax.random.normal(key, (n_in, n_out), jnp.float32) * scale
+
+
+def init_params(rng: jax.Array, cfg: ViTConfig) -> Dict:
+    keys = jax.random.split(rng, cfg.n_layer + 3)
+    params: Dict = {
+        "patch_embed": _dense(keys[0], cfg.patch_dim, cfg.d_model),
+        "pos_embed": jax.random.normal(
+            keys[1], (cfg.n_patches + 1, cfg.d_model), jnp.float32
+        ) * 0.02,
+        "cls_token": jnp.zeros((cfg.d_model,), jnp.float32),
+        "head": _dense(keys[2], cfg.d_model, cfg.num_classes),
+        "ln_f": {"g": jnp.ones((cfg.d_model,)), "b": jnp.zeros((cfg.d_model,))},
+        "layers": [],
+    }
+    for i in range(cfg.n_layer):
+        k = jax.random.split(keys[3 + i], 4)
+        params["layers"].append(
+            {
+                "ln1": {"g": jnp.ones((cfg.d_model,)),
+                        "b": jnp.zeros((cfg.d_model,))},
+                "qkv": _dense(k[0], cfg.d_model, 3 * cfg.d_model),
+                "proj": _dense(k[1], cfg.d_model, cfg.d_model),
+                "ln2": {"g": jnp.ones((cfg.d_model,)),
+                        "b": jnp.zeros((cfg.d_model,))},
+                "fc1": _dense(k[2], cfg.d_model, cfg.d_ff),
+                "fc2": _dense(k[3], cfg.d_ff, cfg.d_model),
+            }
+        )
+    return params
+
+
+def _layernorm(x, p):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * p["g"] + p["b"]
+
+
+def patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[B, H, W, C] -> [B, n_patches, patch_dim] via reshape/transpose —
+    the MXU-friendly formulation of the patch conv."""
+    B = images.shape[0]
+    P = cfg.patch_size
+    g = cfg.image_size // P
+    x = images.reshape(B, g, P, g, P, cfg.channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, g * g, cfg.patch_dim)
+
+
+def forward(params: Dict, images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[B, H, W, C] float images -> [B, num_classes] logits."""
+    B = images.shape[0]
+    x = patchify(images.astype(jnp.bfloat16), cfg)
+    x = x @ params["patch_embed"].astype(jnp.bfloat16)
+    cls = jnp.broadcast_to(
+        params["cls_token"].astype(jnp.bfloat16), (B, 1, cfg.d_model)
+    )
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(jnp.bfloat16)
+
+    hd = cfg.d_model // cfg.n_head
+    for lp in params["layers"]:
+        h = _layernorm(x.astype(jnp.float32), lp["ln1"]).astype(
+            jnp.bfloat16
+        )
+        qkv = h @ lp["qkv"].astype(jnp.bfloat16)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        S = x.shape[1]
+
+        def heads(t):
+            return t.reshape(B, S, cfg.n_head, hd).transpose(0, 2, 1, 3)
+
+        # Bidirectional attention (no causal mask) over patches+cls.
+        att = flash_attention(
+            heads(q), heads(k), heads(v), causal=False
+        )
+        att = att.transpose(0, 2, 1, 3).reshape(B, S, cfg.d_model)
+        x = x + att @ lp["proj"].astype(jnp.bfloat16)
+
+        h = _layernorm(x.astype(jnp.float32), lp["ln2"]).astype(
+            jnp.bfloat16
+        )
+        h = jax.nn.gelu(h @ lp["fc1"].astype(jnp.bfloat16))
+        x = x + h @ lp["fc2"].astype(jnp.bfloat16)
+
+    x = _layernorm(x.astype(jnp.float32), params["ln_f"])
+    return (x[:, 0, :] @ params["head"]).astype(jnp.float32)
+
+
+def loss_fn(params: Dict, batch: Dict, cfg: ViTConfig) -> jax.Array:
+    """Softmax cross-entropy over classes; batch = {images, labels}."""
+    logits = forward(params, batch["images"], cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(
+        logp, batch["labels"][:, None], axis=-1
+    )[:, 0]
+    return -jnp.mean(ll)
+
+
+def num_params(params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

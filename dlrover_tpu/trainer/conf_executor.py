@@ -118,6 +118,43 @@ def _llama(conf: TrainConf):
     return loss_fn, lambda r: llama.init_params(r, cfg), fetch
 
 
+@register_model_family("vit")
+def _vit(conf: TrainConf):
+    from dlrover_tpu.models import vit
+
+    cfg = vit.ViTConfig.tiny(**conf.model_args)
+    # Class prototypes are index-independent: build once, not per fetch.
+    protos = np.random.RandomState(0).randn(
+        cfg.num_classes, cfg.image_size, cfg.image_size, cfg.channels
+    ).astype(np.float32)
+
+    def fetch(indices):
+        # Index-addressable synthetic images whose label is recoverable
+        # from pixel statistics (learnable; elastic re-partition safe).
+        idx = np.asarray(indices, np.int64)
+        labels = (idx % cfg.num_classes).astype(np.int32)
+        noise = np.stack(
+            [
+                # Offset the seed so record 0's stream is not the
+                # prototype generator's (which would make its "noise"
+                # perfectly correlated with protos[0]).
+                np.random.RandomState(int(i) + 1).randn(
+                    cfg.image_size, cfg.image_size, cfg.channels
+                )
+                for i in idx
+            ]
+        ).astype(np.float32)
+        return {
+            "images": protos[labels] + 0.3 * noise,
+            "labels": labels,
+        }
+
+    def loss_fn(params, batch):
+        return vit.loss_fn(params, batch, cfg)
+
+    return loss_fn, lambda r: vit.init_params(r, cfg), fetch
+
+
 # -- the executor ------------------------------------------------------------
 
 
