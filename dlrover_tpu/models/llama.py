@@ -15,13 +15,17 @@ not model changes.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
+from dlrover_tpu.ops.cross_entropy import (
+    linear_softmax_cross_entropy,
+    softmax_cross_entropy,
+)
 from dlrover_tpu.ops.flash_attention import flash_attention
 from dlrover_tpu.ops.rmsnorm import rmsnorm
 
@@ -43,6 +47,12 @@ class LlamaConfig:
     top_k: int = 2
     moe_every: int = 2
     capacity_factor: float = 1.25
+    # Per-block rematerialization: save only the residual stream at layer
+    # boundaries, recompute attention/MLP internals in the backward pass.
+    # Far better peak-HBM than whole-loss remat policies, which either
+    # save every dot output (``dots_saveable``) or re-run a forward whose
+    # own intermediates still peak the same (``nothing_saveable``).
+    remat_block: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -285,6 +295,30 @@ def block_apply(
     return x + _swiglu(h, layer["mlp"], cfg.dtype), jnp.zeros((), jnp.float32)
 
 
+def forward_hidden(
+    params: Dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    attn_impl: str = "auto",
+    mesh=None,
+) -> tuple:
+    """tokens [B, S] -> (final-norm hidden [B, S, D], aux dict)."""
+    B, S = tokens.shape
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    moe_aux = jnp.zeros((), jnp.float32)
+    apply = functools.partial(block_apply, attn_impl=attn_impl, mesh=mesh)
+    if cfg.remat_block:
+        apply = jax.checkpoint(apply, static_argnums=(2,))
+    for layer in params["layers"]:
+        x, aux = apply(layer, x, cfg, positions)
+        moe_aux = moe_aux + aux
+    x = rmsnorm(x, params["ln_f"], eps=cfg.rms_eps)
+    return x, {"moe_aux": moe_aux}
+
+
 def forward(
     params: Dict,
     tokens: jax.Array,
@@ -294,19 +328,11 @@ def forward(
     mesh=None,
 ) -> tuple:
     """tokens [B, S] -> (logits [B, S, vocab] fp32, aux dict)."""
-    B, S = tokens.shape
-    dt = cfg.dtype
-    x = params["embed"].astype(dt)[tokens]
-    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-    moe_aux = jnp.zeros((), jnp.float32)
-    for layer in params["layers"]:
-        x, aux = block_apply(
-            layer, x, cfg, positions, attn_impl=attn_impl, mesh=mesh
-        )
-        moe_aux = moe_aux + aux
-    x = rmsnorm(x, params["ln_f"], eps=cfg.rms_eps)
-    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
-    return logits, {"moe_aux": moe_aux}
+    x, aux = forward_hidden(
+        params, tokens, cfg, attn_impl=attn_impl, mesh=mesh
+    )
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, aux
 
 
 def split_batch(batch: Dict[str, jax.Array]) -> tuple:
@@ -324,12 +350,27 @@ def loss_fn(
     attn_impl: str = "auto",
     mesh=None,
     moe_aux_weight: float = 1e-2,
+    fused_lm_head: Optional[bool] = None,
 ) -> jax.Array:
+    """Next-token loss.  ``fused_lm_head`` (default: auto — on for large
+    vocabs) routes the projection through the chunked fused lm-head
+    cross-entropy so the [B, S, vocab] logits never hit HBM."""
     tokens, targets = split_batch(batch)
-    logits, aux = forward(
-        params, tokens, cfg, attn_impl=attn_impl, mesh=mesh
-    )
-    ce = jnp.mean(softmax_cross_entropy(logits, targets))
+    if fused_lm_head is None:
+        fused_lm_head = cfg.vocab_size >= 4096
+    if fused_lm_head:
+        x, aux = forward_hidden(
+            params, tokens, cfg, attn_impl=attn_impl, mesh=mesh
+        )
+        per_tok = linear_softmax_cross_entropy(
+            x, params["lm_head"].astype(cfg.dtype), targets
+        )
+        ce = jnp.mean(per_tok)
+    else:
+        logits, aux = forward(
+            params, tokens, cfg, attn_impl=attn_impl, mesh=mesh
+        )
+        ce = jnp.mean(softmax_cross_entropy(logits, targets))
     return ce + moe_aux_weight * aux["moe_aux"]
 
 

@@ -147,12 +147,10 @@ def forward(params: Dict, images: jax.Array, cfg: ViTConfig) -> jax.Array:
 
 def loss_fn(params: Dict, batch: Dict, cfg: ViTConfig) -> jax.Array:
     """Softmax cross-entropy over classes; batch = {images, labels}."""
+    from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
+
     logits = forward(params, batch["images"], cfg)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(
-        logp, batch["labels"][:, None], axis=-1
-    )[:, 0]
-    return -jnp.mean(ll)
+    return jnp.mean(softmax_cross_entropy(logits, batch["labels"]))
 
 
 def num_params(params) -> int:

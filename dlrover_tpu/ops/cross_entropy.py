@@ -95,3 +95,111 @@ def softmax_cross_entropy(
     if backend is None:
         backend = "pallas" if jax.default_backend() == "tpu" else "reference"
     return _xent(logits, labels, backend == "pallas", interpret)
+
+
+# ---------------------------------------------------------------------------
+# Fused lm-head + cross-entropy: loss(x @ w, labels) without ever
+# materializing the [tokens, vocab] logits in HBM.  Analogue of the memory
+# win the reference gets from its Triton cross-entropy dispatch
+# (``atorch/atorch/modules/transformer/layers.py:54-70``), taken one step
+# further: the projection itself is chunked over token rows with a
+# ``lax.scan`` so peak HBM holds one [chunk, V] block instead of [B*S, V]
+# (fp32 logits of a 32k-vocab 2k-seq batch are GBs; a 1k-row chunk is
+# 128MB).  Backward recomputes each chunk's logits (flash-style) and
+# accumulates dw in fp32.
+# ---------------------------------------------------------------------------
+
+
+def _chunk(x2, labels, chunk_rows):
+    R = x2.shape[0]
+    n = max(1, -(-R // chunk_rows))
+    pad = n * chunk_rows - R
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad))
+    return (
+        x2.reshape(n, chunk_rows, x2.shape[1]),
+        labels.reshape(n, chunk_rows),
+        pad,
+    )
+
+
+def _chunk_loss(x_c, w, l_c):
+    logits = jnp.dot(x_c, w, preferred_element_type=jnp.float32)
+    m = jnp.max(logits, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) == l_c[:, None]
+    )
+    target = jnp.sum(jnp.where(onehot, logits, 0.0), axis=-1)
+    return lse - target
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _linear_xent(x2, w, labels, chunk_rows):
+    xs, ls, pad = _chunk(x2, labels, chunk_rows)
+
+    def body(_, xl):
+        return None, _chunk_loss(xl[0], w, xl[1])
+
+    _, loss = jax.lax.scan(body, None, (xs, ls))
+    loss = loss.reshape(-1)
+    return loss[: x2.shape[0]] if pad else loss
+
+
+def _linear_xent_fwd(x2, w, labels, chunk_rows):
+    return _linear_xent(x2, w, labels, chunk_rows), (x2, w, labels)
+
+
+def _linear_xent_bwd(chunk_rows, res, g):
+    x2, w, labels = res
+    R = x2.shape[0]
+    xs, ls, pad = _chunk(x2, labels, chunk_rows)
+    gs = (jnp.pad(g, (0, pad)) if pad else g).reshape(ls.shape)
+
+    def body(dw, xlg):
+        x_c, l_c, g_c = xlg
+        logits = jnp.dot(x_c, w, preferred_element_type=jnp.float32)
+        p = jax.nn.softmax(logits, axis=-1)
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+            == l_c[:, None]
+        )
+        dlogits = (p - onehot.astype(jnp.float32)) * g_c[:, None]
+        dx_c = jnp.dot(
+            dlogits.astype(w.dtype), w.T, preferred_element_type=jnp.float32
+        )
+        dw = dw + jnp.dot(
+            x_c.T.astype(jnp.float32), dlogits,
+            preferred_element_type=jnp.float32,
+        )
+        return dw, dx_c.astype(x2.dtype)
+
+    dw, dx = jax.lax.scan(
+        body, jnp.zeros(w.shape, jnp.float32), (xs, ls, gs)
+    )
+    dx = dx.reshape(-1, x2.shape[1])[:R]
+    return dx, dw.astype(w.dtype), None
+
+
+_linear_xent.defvjp(_linear_xent_fwd, _linear_xent_bwd)
+
+
+def linear_softmax_cross_entropy(
+    x: jax.Array,
+    w: jax.Array,
+    labels: jax.Array,
+    *,
+    chunk_rows: int = 1024,
+) -> jax.Array:
+    """Fused ``softmax_cross_entropy(x @ w, labels)`` per-token loss.
+
+    x: [..., D] activations (any float dtype), w: [D, V] lm head,
+    labels: [...] int — returns fp32 [...] loss without materializing the
+    full [..., V] logits (HBM peak is one [chunk_rows, V] fp32 block).
+    """
+    shape = labels.shape
+    out = _linear_xent(
+        x.reshape(-1, x.shape[-1]), w, labels.reshape(-1), chunk_rows
+    )
+    return out.reshape(shape)

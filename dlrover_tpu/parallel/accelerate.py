@@ -245,6 +245,15 @@ def _compile_candidate(
     params_shape = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
     if callable(param_specs):
         p_specs = param_specs(strategy)
+    elif isinstance(param_specs, str) and param_specs == "planner":
+        # Cost-model layout search over (fsdp, tp) axis->dim assignments
+        # (the MIP-TP-planner analogue, ``parallel/layout_planner.py``).
+        from dlrover_tpu.parallel.layout_planner import plan_layout
+
+        p_specs = plan_layout(
+            params_shape,
+            {"fsdp": mesh_spec.fsdp, "tp": mesh_spec.tp},
+        )
     elif param_specs is not None:
         p_specs = param_specs
     else:

@@ -97,21 +97,71 @@ class LocalSGDSync:
         )(local_params, *batched_args)
 
     # -- periodic outer sync ----------------------------------------------
+    def delta_norms(
+        self, mesh: Mesh, local_params: Any, anchor: Any
+    ) -> jax.Array:
+        """Per-replica drift norm ||anchor - params_i|| -> [n_dp] fp32.
+
+        Cheap (one reduction, no collective); feed these to an
+        :class:`OnlineEWMADetector` to decide per-replica ``replica
+        weights`` for :meth:`apply` (drop a replica whose drift is a
+        z-score outlier — e.g. it silently restarted or diverged)."""
+
+        def body(p_stack, a):
+            sq = jnp.zeros((), jnp.float32)
+            for p_l, a_l in zip(
+                jax.tree_util.tree_leaves(p_stack),
+                jax.tree_util.tree_leaves(a),
+            ):
+                d = (a_l - p_l[0]).astype(jnp.float32)
+                sq = sq + jnp.sum(d * d)
+            return jnp.sqrt(sq)[None]
+
+        stacked_spec = jax.tree_util.tree_map(
+            lambda _: P(self.dp_axis), local_params
+        )
+        flat_spec = jax.tree_util.tree_map(lambda _: P(), anchor)
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(stacked_spec, flat_spec),
+            out_specs=P(self.dp_axis),
+            axis_names={self.dp_axis},
+        )(local_params, anchor)
+
     def apply(
-        self, mesh: Mesh, local_params: Any, anchor: Any, outer_mom: Any
+        self,
+        mesh: Mesh,
+        local_params: Any,
+        anchor: Any,
+        outer_mom: Any,
+        replica_weights: Optional[jax.Array] = None,
     ) -> Tuple[Any, Any, Any]:
         """One outer step: average per-replica drift over 'dp', Nesterov
         update from the anchor.
 
         ``local_params`` is the stacked [n_dp, ...] form (replica-divergent);
-        ``anchor``/``outer_mom`` are dp-invariant.  Returns dp-invariant
-        (new_params, new_anchor, new_momentum) — re-:meth:`scatter` to
-        resume inner steps."""
+        ``anchor``/``outer_mom`` are dp-invariant.  ``replica_weights``
+        ([n_dp], optional) down-weights or masks replicas (0 = exclude an
+        anomalous replica's drift, see :class:`OnlineEWMADetector`).
+        Returns dp-invariant (new_params, new_anchor, new_momentum) —
+        re-:meth:`scatter` to resume inner steps."""
+        if replica_weights is None:
+            n_dp = mesh.shape[self.dp_axis]
+            replica_weights = jnp.ones((n_dp,), jnp.float32)
 
-        def body(p_stack, a, m):
+        def body(p_stack, a, m, w):
+            w_l = w[0].astype(jnp.float32)
+            w_sum = jax.lax.psum(w_l, self.dp_axis)
+            # All replicas flagged anomalous -> fall back to a uniform
+            # average rather than dividing the drift sum by zero (NaN
+            # params would silently corrupt anchor and momentum too).
+            n_rep = jax.lax.psum(jnp.ones((), jnp.float32), self.dp_axis)
+            w_l = jnp.where(w_sum > 0.0, w_l, 1.0)
+            w_sum = jnp.where(w_sum > 0.0, w_sum, n_rep)
+
             def leaf(p_l, a_l, m_l):
-                delta = a_l - p_l[0]  # this replica's drift
-                delta = jax.lax.pmean(delta, self.dp_axis)
+                delta = (a_l - p_l[0]) * w_l  # this replica's drift
+                delta = jax.lax.psum(delta, self.dp_axis) / w_sum
                 new_m = self.outer_momentum * m_l + delta
                 step = self.outer_momentum * new_m + delta  # Nesterov
                 new_p = a_l - self.outer_lr * step
@@ -136,12 +186,88 @@ class LocalSGDSync:
         flat_spec = jax.tree_util.tree_map(lambda _: P(), anchor)
         new_params, new_mom = jax.shard_map(
             body, mesh=mesh,
-            in_specs=(stacked_spec, flat_spec, flat_spec),
+            in_specs=(stacked_spec, flat_spec, flat_spec, P(self.dp_axis)),
             out_specs=(flat_spec, flat_spec),
             axis_names={self.dp_axis},
-        )(local_params, anchor, outer_mom)
+        )(local_params, anchor, outer_mom, replica_weights)
         new_anchor = jax.tree_util.tree_map(jnp.array, new_params)
         return new_params, new_anchor, new_mom
+
+
+class OnlineEWMADetector:
+    """Online EWMA mean/variance z-score detector for sync-time anomalies.
+
+    Host-side parity with the reference's local-SGD anomaly detection
+    (``atorch/atorch/local_sgd/anomaly_detection.py:1 OnlineDynamicEWMA``):
+    feed it a scalar stream (per-replica drift norms, sync wall-clock
+    gaps); it keeps exponentially-weighted mean/variance and flags values
+    whose z-score exceeds a threshold scaled up while recent data is
+    itself noisy.  State round-trips through ``state_dict`` so elastic
+    restarts keep the learned baseline."""
+
+    def __init__(
+        self,
+        alpha: float = 0.02,
+        warmup_steps: int = 100,
+        base_threshold: float = 3.0,
+    ):
+        self.alpha = alpha
+        self.warmup_steps = warmup_steps
+        self.base_threshold = base_threshold
+        self.mean = 0.0
+        self.var = 0.0
+        self.count = 0
+        self._recent_z: list = []
+
+    def update(self, value: float) -> float:
+        """Fold in one observation; returns its z-score (0 in warmup)."""
+        value = float(value)
+        z = self.z_score(value)
+        self.count += 1
+        delta = value - self.mean
+        self.mean += self.alpha * delta
+        self.var = (1 - self.alpha) * (
+            self.var + self.alpha * delta * (value - self.mean)
+        )
+        self._recent_z.append(abs(z))
+        if len(self._recent_z) > self.warmup_steps:
+            self._recent_z.pop(0)
+        return z
+
+    def z_score(self, value: float) -> float:
+        if self.count < self.warmup_steps or self.var <= 0.0:
+            return 0.0
+        return (float(value) - self.mean) / (self.var ** 0.5)
+
+    def threshold(self) -> float:
+        """Base threshold, widened when recent z-scores are themselves
+        turbulent (so a noisy phase doesn't mass-flag)."""
+        if self.count < self.warmup_steps or not self._recent_z:
+            return self.base_threshold
+        recent = sum(self._recent_z) / len(self._recent_z)
+        return self.base_threshold * max(1.0, recent)
+
+    def is_anomaly(self, value: float) -> bool:
+        return abs(self.z_score(value)) > self.threshold()
+
+    def state_dict(self) -> dict:
+        return {
+            "mean": self.mean, "var": self.var, "count": self.count,
+            "recent_z": list(self._recent_z),
+            "alpha": self.alpha, "warmup_steps": self.warmup_steps,
+            "base_threshold": self.base_threshold,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.mean = state.get("mean", self.mean)
+        self.var = state.get("var", self.var)
+        self.count = state.get("count", self.count)
+        self._recent_z = list(state.get("recent_z", self._recent_z))
+        self.alpha = state.get("alpha", self.alpha)
+        self.warmup_steps = state.get("warmup_steps", self.warmup_steps)
+        self.base_threshold = state.get(
+            "base_threshold", self.base_threshold
+        )
 
 
 def diloco_inner_outer(

@@ -167,6 +167,48 @@ class TestCrossEntropy:
         np.testing.assert_allclose(np.asarray(jnp.sum(g, -1)),
                                    np.zeros(4), atol=1e-6)
 
+    def test_fused_linear_xent_matches_unfused(self):
+        from dlrover_tpu.ops.cross_entropy import (
+            linear_softmax_cross_entropy,
+        )
+
+        D, V = 16, 64
+        x = jax.random.normal(jax.random.PRNGKey(0), (3, 10, D))
+        w = jax.random.normal(jax.random.PRNGKey(1), (D, V)) * 0.1
+        labels = jax.random.randint(jax.random.PRNGKey(2), (3, 10), 0, V)
+        # chunk_rows=8 forces multiple chunks + row padding (30 rows).
+        fused = linear_softmax_cross_entropy(x, w, labels, chunk_rows=8)
+        ref = softmax_cross_entropy(x @ w, labels, backend="reference")
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_fused_linear_xent_grads_match(self):
+        from dlrover_tpu.ops.cross_entropy import (
+            linear_softmax_cross_entropy,
+        )
+
+        D, V = 12, 32
+        x = jax.random.normal(jax.random.PRNGKey(0), (26, D))
+        w = jax.random.normal(jax.random.PRNGKey(1), (D, V)) * 0.2
+        labels = jax.random.randint(jax.random.PRNGKey(2), (26,), 0, V)
+
+        def fused(x, w):
+            return jnp.mean(
+                linear_softmax_cross_entropy(x, w, labels, chunk_rows=8)
+            )
+
+        def unfused(x, w):
+            return jnp.mean(
+                softmax_cross_entropy(x @ w, labels, backend="reference")
+            )
+
+        gx, gw = jax.grad(fused, argnums=(0, 1))(x, w)
+        gx_ref, gw_ref = jax.grad(unfused, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref),
+                                   atol=1e-5)
+
 
 class TestQuant:
     def test_quant_roundtrip_error_bounded(self):

@@ -127,6 +127,35 @@ class TestAccelerate:
         state, metrics = job.train_step(state, b)
         assert np.isfinite(float(metrics["loss"]))
 
+    def test_remat_block_matches_unremat(self):
+        """Per-block remat (LlamaConfig.remat_block) must be a pure
+        memory/compute trade: loss and grads identical to the plain
+        forward."""
+        import dataclasses
+
+        from dlrover_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny(n_layer=3)
+        cfg_r = dataclasses.replace(cfg, remat_block=True)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 33), 0, cfg.vocab_size
+        )
+        batch = {"tokens": tokens}
+        l0, g0 = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, batch, cfg)
+        )(params)
+        l1, g1 = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, batch, cfg_r)
+        )(params)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            )
+
     def test_infer_param_specs_zero3(self):
         params = {"big": np.zeros((64, 8)), "tiny": np.zeros((3,)),
                   "scalar": np.zeros(())}
@@ -447,6 +476,48 @@ class TestLocalSGD:
         np.testing.assert_allclose(
             np.asarray(new_m["w"]), np.full((4, 4), 0.25), atol=1e-6
         )
+
+    def test_diloco_masked_replica_excluded(self, cpu_mesh_devices):
+        """replica_weights=0 drops an anomalous replica's drift from the
+        outer update (anomaly-detection integration point)."""
+        from dlrover_tpu.parallel.local_sgd import LocalSGDSync
+
+        mesh = Mesh(np.array(cpu_mesh_devices[:4]), ("dp",))
+        sync = LocalSGDSync(outer_lr=1.0, outer_momentum=0.0, dp_axis="dp")
+        params = {"w": jnp.ones((4, 4))}
+        anchor, mom = sync.init(params)
+        local = sync.scatter(mesh, params)
+        # Replica 3 "diverged": huge drift.  Mask it out.
+        drifts = jnp.array([0.1, 0.2, 0.3, 100.0], jnp.float32)
+        local = sync.inner_apply(
+            mesh, lambda p, d: {"w": p["w"] - d}, local, drifts
+        )
+        norms = sync.delta_norms(mesh, local, anchor)
+        assert norms.shape == (4,)
+        assert float(norms[3]) > 50 * float(norms[2])
+        weights = jnp.array([1.0, 1.0, 1.0, 0.0], jnp.float32)
+        new_p, _, _ = sync.apply(
+            mesh, local, anchor, mom, replica_weights=weights
+        )
+        # Mean drift over the surviving replicas = 0.2.
+        np.testing.assert_allclose(
+            np.asarray(new_p["w"]), np.full((4, 4), 0.8), atol=1e-6
+        )
+
+    def test_ewma_detector_flags_outlier(self):
+        from dlrover_tpu.parallel.local_sgd import OnlineEWMADetector
+
+        det = OnlineEWMADetector(alpha=0.1, warmup_steps=20,
+                                 base_threshold=3.0)
+        rng = np.random.RandomState(0)
+        for _ in range(200):
+            det.update(1.0 + 0.01 * rng.randn())
+        assert not det.is_anomaly(1.02)
+        assert det.is_anomaly(5.0)
+        # State round-trips (elastic restart keeps the baseline).
+        clone = OnlineEWMADetector()
+        clone.load_state_dict(det.state_dict())
+        assert clone.is_anomaly(5.0) and not clone.is_anomaly(1.02)
 
     def test_diloco_inner_steps_stay_local(self, cpu_mesh_devices):
         """inner_apply must not introduce cross-replica collectives: the
