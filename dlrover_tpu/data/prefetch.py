@@ -1,0 +1,64 @@
+"""Device prefetcher: overlap host->device transfer with compute.
+
+Analogue of the reference's data preloader (``atorch/atorch/data/
+preloader.py`` — CUDA-stream prefetch of the next batch).  On TPU the
+same overlap falls out of JAX's async dispatch: ``jax.device_put`` of
+batch N+1..N+depth is enqueued while the step consuming batch N runs, so
+the input pipeline hides behind compute instead of serializing with it.
+
+    loader = DevicePrefetcher(host_batches, sharding=job.batch_sharding)
+    for batch in loader:              # batch is already device-resident
+        state, metrics = job.train_step(state, batch)
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+
+
+class DevicePrefetcher:
+    """Wraps a host-batch iterable; yields device-resident batches with
+    ``depth`` transfers in flight ahead of the consumer."""
+
+    def __init__(
+        self,
+        batches: Iterable[Any],
+        sharding: Any = None,
+        depth: int = 2,
+    ):
+        if depth < 1:
+            raise ValueError("prefetch depth must be >= 1")
+        self._batches = batches
+        self._sharding = sharding
+        self.depth = depth
+
+    def _put(self, batch: Any) -> Any:
+        if self._sharding is None:
+            return jax.tree_util.tree_map(jax.device_put, batch)
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), batch, self._sharding
+        )
+
+    def __iter__(self) -> Iterator[Any]:
+        queue: collections.deque = collections.deque()
+        it = iter(self._batches)
+        exhausted = False
+        while True:
+            while not exhausted and len(queue) < self.depth:
+                try:
+                    queue.append(self._put(next(it)))
+                except StopIteration:
+                    exhausted = True
+            if not queue:
+                return
+            yield queue.popleft()
+
+
+def prefetch_to_device(
+    batches: Iterable[Any], sharding: Any = None, depth: int = 2
+) -> Iterator[Any]:
+    """Functional form of :class:`DevicePrefetcher`."""
+    return iter(DevicePrefetcher(batches, sharding=sharding, depth=depth))

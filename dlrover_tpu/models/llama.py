@@ -335,6 +335,13 @@ def forward(
     return logits, aux
 
 
+def uses_fused_lm_head(cfg: LlamaConfig) -> bool:
+    """Default policy for routing the loss through the chunked fused
+    lm-head cross-entropy (single source of truth — bench reporting and
+    ``loss_fn`` must agree on what was actually measured)."""
+    return cfg.vocab_size >= 4096
+
+
 def split_batch(batch: Dict[str, jax.Array]) -> tuple:
     """{"tokens": [B,S+1]} or {"tokens","targets"} -> (tokens, targets)."""
     if "targets" in batch:
@@ -357,7 +364,7 @@ def loss_fn(
     cross-entropy so the [B, S, vocab] logits never hit HBM."""
     tokens, targets = split_batch(batch)
     if fused_lm_head is None:
-        fused_lm_head = cfg.vocab_size >= 4096
+        fused_lm_head = uses_fused_lm_head(cfg)
     if fused_lm_head:
         x, aux = forward_hidden(
             params, tokens, cfg, attn_impl=attn_impl, mesh=mesh

@@ -59,11 +59,15 @@ class Strategy:
     compute_dtype: Any = jnp.bfloat16
     grad_accum: int = 1
     donate: bool = True
+    # Park optimizer state in host DRAM (ZeRO-Offload analogue,
+    # optim/offload.py): XLA streams it through HBM during the update.
+    offload_opt: bool = False
 
     def describe(self) -> str:
         return (
             f"mesh={self.mesh.describe()} remat={self.remat} "
             f"accum={self.grad_accum}"
+            + (" offload_opt" if self.offload_opt else "")
         )
 
 
@@ -278,6 +282,13 @@ def _compile_candidate(
     o_specs = jax.tree_util.tree_map(opt_spec, opt_shape)
     state_specs = {"params": p_specs, "opt_state": o_specs, "step": P()}
     state_sharding = named_sharding_tree(state_specs, mesh)
+    if strategy.offload_opt:
+        from dlrover_tpu.optim.offload import host_shardings_for
+
+        state_sharding = dict(
+            state_sharding,
+            opt_state=host_shardings_for(state_sharding["opt_state"]),
+        )
 
     if batch_axes is None:
         batch_axes = jax.tree_util.tree_map(

@@ -1,0 +1,152 @@
+"""Flagship elastic Llama pretraining through the full product stack.
+
+The Llama-2 analogue of the reference's headline example
+(``atorch/examples/llama2``): model + ``accelerate()`` strategy (mesh x
+remat x dtype, layout planner), fused lm-head loss, elastic sampler fed
+by the master's task manager, and flash checkpointing — all launched
+under the elastic agent::
+
+    python -m dlrover_tpu.run --standalone --nproc_per_node=2 \
+        examples/llama_train.py -- --steps 20
+
+Scale knobs: ``--model {tiny,300m,800m}`` picks the config;
+``--strategy auto`` searches mesh factorizations instead of pure DP.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+import dlrover_tpu.trainer as trainer_sdk
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--model", default="tiny",
+                   choices=["tiny", "300m", "800m"])
+    p.add_argument("--batch_per_proc", type=int, default=4)
+    p.add_argument("--seq_len", type=int, default=32)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--strategy", default="dp",
+                   choices=["dp", "auto"])
+    p.add_argument("--remat_block", action="store_true")
+    p.add_argument("--dataset_size", type=int, default=4096)
+    p.add_argument("--ckpt_dir", default="")
+    p.add_argument("--ckpt_interval", type=int, default=5)
+    return p.parse_args()
+
+
+def build_config(args):
+    from dlrover_tpu.models import llama
+
+    if args.model == "300m":
+        cfg = llama.LlamaConfig.small_300m()
+    elif args.model == "800m":
+        cfg = llama.LlamaConfig.medium_800m()
+    else:
+        cfg = llama.LlamaConfig.tiny(max_seq_len=args.seq_len)
+    return dataclasses.replace(cfg, remat_block=args.remat_block)
+
+
+def synth_tokens(indices, seq_len, vocab):
+    import numpy as np
+
+    base = np.random.RandomState(0).randint(0, vocab, size=(seq_len + 1,))
+    return np.stack(
+        [(base + i) % vocab for i in indices], axis=0
+    ).astype("int32")
+
+
+def main() -> int:
+    args = parse_args()
+    ctx = trainer_sdk.init()
+
+    import jax
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.accelerate import Strategy, accelerate
+    from dlrover_tpu.parallel.mesh import MeshSpec
+    from dlrover_tpu.trainer.sampler import ElasticSampler
+
+    cfg = build_config(args)
+    local_dev = jax.local_device_count()
+    if args.batch_per_proc % local_dev:
+        args.batch_per_proc = -(-args.batch_per_proc // local_dev) * local_dev
+    global_batch = args.batch_per_proc * ctx.num_processes
+
+    sample = synth_tokens(
+        range(global_batch), args.seq_len, cfg.vocab_size
+    )
+    strategy = (
+        "auto" if args.strategy == "auto"
+        else Strategy(mesh=MeshSpec(dp=len(jax.devices())))
+    )
+    job = accelerate(
+        loss_fn=lambda p, b: llama.loss_fn(p, b, cfg),
+        init_fn=lambda r: llama.init_params(r, cfg),
+        optimizer=optax.adamw(args.lr),
+        sample_batch={"tokens": sample},
+        strategy=strategy,
+        param_specs="planner",
+    )
+    state = job.create_state(jax.random.PRNGKey(0))
+
+    start_step = 0
+    ckpt = None
+    if args.ckpt_dir:
+        from dlrover_tpu.checkpoint.checkpointer import FlashCheckpointer
+
+        ckpt = FlashCheckpointer(args.ckpt_dir, job_name=ctx.job_name)
+        restored = ckpt.load(target=state)
+        if restored is not None:
+            state, meta = restored
+            start_step = int(meta.get("step", 0))
+            print(f"[worker {ctx.process_id}] restored step={start_step}",
+                  flush=True)
+
+    sampler = ElasticSampler(
+        args.dataset_size,
+        batch_size_per_process=args.batch_per_proc,
+        num_processes=ctx.num_processes,
+        process_id=ctx.process_id,
+        seed=17,
+    )
+    sampler.completed_steps = start_step
+
+    step, loss = start_step, float("nan")
+    it = iter(sampler)
+    while step < args.steps:
+        try:
+            indices = next(it)
+        except StopIteration:
+            it = iter(sampler)
+            continue
+        toks = synth_tokens(indices, args.seq_len, cfg.vocab_size)
+        batch = {
+            "tokens": jax.make_array_from_process_local_data(
+                job.batch_sharding["tokens"], toks
+            )
+        }
+        state, metrics = job.train_step(state, batch)
+        loss = float(metrics["loss"])
+        step += 1
+        ctx.report_step(step)
+        if ckpt is not None and step % args.ckpt_interval == 0:
+            ckpt.save(state, meta={"step": step})
+        if step % 10 == 0 or step == args.steps:
+            print(f"[worker {ctx.process_id}] step {step} loss "
+                  f"{loss:.4f}", flush=True)
+    if ckpt is not None:
+        ckpt.save(state, meta={"step": step}, storage=True)
+        ckpt.wait()
+    print(f"TRAIN_DONE step={step} loss={loss:.4f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
