@@ -215,9 +215,39 @@ def accelerate(
             for c in candidates
         ]
 
+    # Strategy persistence for the "auto" path too (the "bo" path handles
+    # its own cache inside search(); explicit Strategy/list choices are
+    # the caller's to make and are never overridden by a stale hit).  A
+    # hit goes FIRST and short-circuits the sweep — an elastic rebuild
+    # skips re-scoring mid-recovery — but the full candidate list stays
+    # behind it as fallback: a hit cached on different hardware may no
+    # longer compile, and recovery must not die on it.
+    cache_obj = fp = None
+    cache_hit = False
+    if cache is not None and strategy == "auto":
+        from dlrover_tpu.parallel.strategy_search import (
+            StrategyCache,
+            fingerprint,
+        )
+
+        cache_obj = StrategyCache(cache) if isinstance(cache, str) else cache
+        params_fp = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        opt_fp = jax.eval_shape(optimizer.init, params_fp)
+        fp = fingerprint(params_fp, sample_batch, n, opt_fp)
+        hit = cache_obj.get(fp)
+        if hit is not None:
+            if grad_accum is not None:
+                # The override is current-run config, not cached state.
+                hit = dataclasses.replace(hit, grad_accum=grad_accum)
+            logger.info(
+                "accelerate: strategy cache hit %s", hit.describe()
+            )
+            candidates = [hit] + candidates
+            cache_hit = True
+
     best: Optional[AcceleratedJob] = None
     best_score = float("inf")
-    for cand in candidates:
+    for i, cand in enumerate(candidates):
         try:
             job = _compile_candidate(
                 cand, loss_fn, init_fn, optimizer, sample_batch,
@@ -226,6 +256,10 @@ def accelerate(
         except Exception as e:  # noqa: BLE001
             logger.info("strategy %s rejected: %s", cand.describe(), e)
             continue
+        if cache_hit and i == 0:
+            # Viable hit: take it without scoring the rest.
+            best = job
+            break
         score = _score(job, profile_steps, init_fn)
         logger.info("strategy %s scored %.4g", cand.describe(), score)
         if score < best_score:
@@ -235,6 +269,8 @@ def accelerate(
     if best is None:
         raise RuntimeError("no viable strategy found")
     logger.info("accelerate: selected %s", best.strategy.describe())
+    if cache_obj is not None and fp is not None:
+        cache_obj.put(fp, best.strategy)
     return best
 
 

@@ -86,6 +86,68 @@ def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
     return Mesh(arr, AXIS_ORDER)
 
 
+def build_hybrid_mesh(
+    spec: MeshSpec,
+    devices: Optional[Sequence] = None,
+    *,
+    dcn_axes: Tuple[str, ...] = ("pp", "dp"),
+    slice_of=None,
+):
+    """Multislice (hybrid ICI/DCN) mesh: the HSDP analogue.
+
+    Parity with the reference's hierarchical FSDP / node-aware process
+    groups (``atorch/local_sgd/HSDP``, ``distributed.py`` rank-order
+    args): axes in ``dcn_axes`` span *slices* (linked by DCN), every
+    other axis stays inside one slice (ICI).  So ``MeshSpec(dp=2,
+    fsdp=4)`` over two 4-chip slices gives gradient all-reduce on DCN
+    once per step and param all-gathers on ICI only.
+
+    ``slice_of(device) -> slice id`` overrides slice discovery (default:
+    ``device.slice_index`` where the runtime exposes it, else the
+    owning ``process_index`` — correct for one-process-per-host CPU/test
+    worlds).  ``dcn_axes`` must be a prefix of the canonical axis order
+    (they are the outermost axes by design — see module docstring), and
+    their product must equal the slice count.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if tuple(dcn_axes) != AXIS_ORDER[: len(dcn_axes)]:
+        raise ValueError(
+            f"dcn_axes {dcn_axes} must be a prefix of {AXIS_ORDER} "
+            "(outer axes ride DCN)"
+        )
+    devs = list(devices) if devices is not None else jax.devices()
+    spec = spec.normalized(len(devs))
+
+    if slice_of is None:
+        def slice_of(d):
+            si = getattr(d, "slice_index", None)
+            return d.process_index if si is None else si
+
+    groups: dict = {}
+    for d in devs:
+        groups.setdefault(slice_of(d), []).append(d)
+    slice_ids = sorted(groups)
+    sizes = dict(zip(AXIS_ORDER, spec.sizes))
+    dcn_total = math.prod(sizes[a] for a in dcn_axes)
+    per_slice = spec.num_devices // dcn_total
+    if dcn_total != len(slice_ids):
+        raise ValueError(
+            f"dcn axes {dcn_axes} give {dcn_total} slices, topology has "
+            f"{len(slice_ids)}"
+        )
+    if any(len(groups[s]) != per_slice for s in slice_ids):
+        raise ValueError(
+            f"every slice must contribute {per_slice} devices, got "
+            f"{[len(groups[s]) for s in slice_ids]}"
+        )
+    ordered = [d for s in slice_ids for d in groups[s]]
+    arr = np.array(ordered).reshape(spec.sizes)
+    return Mesh(arr, AXIS_ORDER)
+
+
 def candidate_specs(
     n_devices: int,
     *,

@@ -52,6 +52,7 @@ def strategy_to_dict(strategy) -> dict:
         "compute_dtype": jnp.dtype(strategy.compute_dtype).name,
         "grad_accum": strategy.grad_accum,
         "donate": strategy.donate,
+        "offload_opt": strategy.offload_opt,
     }
 
 
@@ -66,6 +67,7 @@ def strategy_from_dict(d: dict):
         compute_dtype=jnp.dtype(d["compute_dtype"]),
         grad_accum=int(d["grad_accum"]),
         donate=bool(d.get("donate", True)),
+        offload_opt=bool(d.get("offload_opt", False)),
     )
 
 
@@ -368,3 +370,37 @@ class StrategyCache:
             with open(tmp, "w") as f:
                 json.dump(data, f, indent=1, sort_keys=True)
             os.replace(tmp, self.path)
+
+
+class MasterStrategyCache:
+    """Strategy cache backed by the job master's KV store (the service
+    half of the reference's acceleration engine, ``auto/engine/
+    servicer.py``: strategies outlive any one node).  A worker relaunched
+    on a *fresh* host — no local JSON file — still skips the search
+    because the winning strategy lives with the master."""
+
+    PREFIX = "strategy-cache/"
+
+    def __init__(self, master_client):
+        self.client = master_client
+
+    def get(self, key: str):
+        try:
+            raw = self.client.kv_store_get(self.PREFIX + key)
+        except Exception:  # noqa: BLE001 - master unreachable
+            return None
+        if not raw:
+            return None
+        try:
+            return strategy_from_dict(json.loads(raw.decode()))
+        except Exception:  # noqa: BLE001 - stale/corrupt entry
+            return None
+
+    def put(self, key: str, strategy) -> None:
+        try:
+            self.client.kv_store_set(
+                self.PREFIX + key,
+                json.dumps(strategy_to_dict(strategy)).encode(),
+            )
+        except Exception:  # noqa: BLE001 - cache write is best-effort
+            pass

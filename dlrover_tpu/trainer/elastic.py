@@ -78,6 +78,7 @@ class ElasticTrainer:
         strategy: Any = None,
         sampler_seed: int = 0,
         devices=None,
+        strategy_cache: Any = None,
     ):
         self.cfg = cfg
         self.loss_fn = loss_fn
@@ -88,6 +89,10 @@ class ElasticTrainer:
         self.base_strategy = strategy
         self.sampler_seed = sampler_seed
         self.devices = devices
+        # Strategy persistence (StrategyCache / MasterStrategyCache):
+        # an elastic rebuild with an unchanged fingerprint skips the
+        # search instead of re-profiling mid-recovery.
+        self.strategy_cache = strategy_cache
 
         self.job = None  # AcceleratedJob
         self.state = None
@@ -144,6 +149,7 @@ class ElasticTrainer:
             strategy=strat,
             devices=devs,
             grad_accum=self.grad_accum,
+            cache=self.strategy_cache,
         )
 
         old_state = self.state

@@ -248,6 +248,15 @@ class Trainer:
             tx, self.schedule = build_optimizer(args, total)
         self.optimizer = tx
 
+        strategy_cache = None
+        if master_client is not None:
+            # Persist winning strategies with the master: a worker
+            # relaunched on a fresh host skips the search mid-recovery.
+            from dlrover_tpu.parallel.strategy_search import (
+                MasterStrategyCache,
+            )
+
+            strategy_cache = MasterStrategyCache(master_client)
         self.core = ElasticTrainer(
             TrainerConfig(
                 global_batch_size=args.global_batch_size,
@@ -261,6 +270,7 @@ class Trainer:
             strategy=strategy,
             sampler_seed=args.seed,
             devices=devices,
+            strategy_cache=strategy_cache,
         )
         self._num_processes = num_processes
         self._process_id = process_id
@@ -446,7 +456,15 @@ class Trainer:
                 made_progress = True
                 self.state.step += 1
                 self.state.samples_seen += args.global_batch_size
-                window.append(float(metrics["loss"]))
+                # Defer the host transfer: float() here would sync every
+                # step and serialize the async-dispatch pipeline (device
+                # idles while python rounds the loss); losses are forced
+                # in a batch at the logging boundary instead.  With
+                # logging disabled there is no boundary to drain at, so
+                # skip accumulating (live device buffers would otherwise
+                # pile up for the whole run).
+                if args.logging_steps > 0:
+                    window.append(metrics["loss"])
                 if self.step_reporter is not None:
                     try:
                         self.step_reporter(self.state.step)
@@ -464,7 +482,9 @@ class Trainer:
                     dt = time.perf_counter() - t_last
                     self._log(
                         {
-                            "loss": float(np.mean(window)),
+                            "loss": float(
+                                np.mean([float(x) for x in window])
+                            ),
                             "steps_per_s": len(window) / max(dt, 1e-9),
                         }
                     )

@@ -594,3 +594,74 @@ print(f"RESULT {pid} {got[0,0]:.6f}")
         for i, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"proc {i} failed:\n{out}"
             assert f"RESULT {i} 0.700000" in out, out
+
+
+class TestHybridMesh:
+    def test_dcn_axes_span_slices(self, cpu_mesh_devices):
+        """dp rides across slices; fsdp stays inside one slice."""
+        from dlrover_tpu.parallel.mesh import MeshSpec, build_hybrid_mesh
+
+        devs = cpu_mesh_devices[:8]
+        # Fake 2 slices of 4 chips each.
+        fake_slice = {id(d): i // 4 for i, d in enumerate(devs)}
+        mesh = build_hybrid_mesh(
+            MeshSpec(dp=2, fsdp=4),
+            devs,
+            dcn_axes=("pp", "dp"),
+            slice_of=lambda d: fake_slice[id(d)],
+        )
+        arr = mesh.devices  # [pp=1, dp=2, fsdp=4, ep=1, tp=1]
+        assert arr.shape == (1, 2, 4, 1, 1)
+        # Each dp row holds exactly one slice's devices.
+        for dp_i in range(2):
+            row = arr[0, dp_i].reshape(-1)
+            assert {fake_slice[id(d)] for d in row} == {dp_i}
+
+    def test_slice_count_mismatch_rejected(self, cpu_mesh_devices):
+        from dlrover_tpu.parallel.mesh import MeshSpec, build_hybrid_mesh
+
+        devs = cpu_mesh_devices[:8]
+        fake_slice = {id(d): i // 4 for i, d in enumerate(devs)}
+        import pytest
+
+        with pytest.raises(ValueError, match="slices"):
+            build_hybrid_mesh(
+                MeshSpec(dp=4, fsdp=2), devs,
+                slice_of=lambda d: fake_slice[id(d)],
+            )
+
+    def test_non_prefix_dcn_axes_rejected(self, cpu_mesh_devices):
+        from dlrover_tpu.parallel.mesh import MeshSpec, build_hybrid_mesh
+
+        import pytest
+
+        with pytest.raises(ValueError, match="prefix"):
+            build_hybrid_mesh(
+                MeshSpec(dp=2, fsdp=4), cpu_mesh_devices[:8],
+                dcn_axes=("fsdp",),
+            )
+
+    def test_diloco_over_hybrid_mesh(self, cpu_mesh_devices):
+        """The multislice DiLoCo composition: dp (DCN, per-slice replicas)
+        x fsdp (ICI, sharded params inside each slice)."""
+        from dlrover_tpu.parallel.local_sgd import LocalSGDSync
+        from dlrover_tpu.parallel.mesh import MeshSpec, build_hybrid_mesh
+
+        devs = cpu_mesh_devices[:4]
+        fake_slice = {id(d): i // 2 for i, d in enumerate(devs)}
+        mesh = build_hybrid_mesh(
+            MeshSpec(dp=2, fsdp=2), devs,
+            slice_of=lambda d: fake_slice[id(d)],
+        )
+        sync = LocalSGDSync(outer_lr=1.0, outer_momentum=0.0)
+        params = {"w": jnp.ones((4, 4))}
+        anchor, mom = sync.init(params)
+        local = sync.scatter(mesh, params)
+        drifts = jnp.array([0.1, 0.3], jnp.float32)
+        local = sync.inner_apply(
+            mesh, lambda p, d: {"w": p["w"] - d}, local, drifts
+        )
+        new_p, _, _ = sync.apply(mesh, local, anchor, mom)
+        np.testing.assert_allclose(
+            np.asarray(new_p["w"]), np.full((4, 4), 0.8), atol=1e-6
+        )

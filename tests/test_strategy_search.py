@@ -200,3 +200,139 @@ class TestSearchEndToEnd:
         b = jax.device_put(batch, job.batch_sharding)
         state, metrics = job.train_step(state, b)
         assert np.isfinite(float(metrics["loss"]))
+
+
+class TestMasterStrategyCache:
+    def test_round_trip_through_master_kv(self):
+        """The cache rides the master's KV store, so a relaunched worker
+        on a fresh host (no local JSON) still skips the search."""
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.master.master import LocalJobMaster
+        from dlrover_tpu.parallel.accelerate import Strategy
+        from dlrover_tpu.parallel.mesh import MeshSpec
+        from dlrover_tpu.parallel.strategy_search import (
+            MasterStrategyCache,
+            strategy_to_dict,
+        )
+
+        m = LocalJobMaster(0, job_name="strat-cache", min_nodes=1,
+                           max_nodes=1)
+        m.prepare()
+        try:
+            client = MasterClient(m.addr, 0)
+            cache = MasterStrategyCache(client)
+            assert cache.get("deadbeef") is None
+            strat = Strategy(mesh=MeshSpec(dp=2, fsdp=4), remat="dots",
+                             grad_accum=2)
+            cache.put("deadbeef", strat)
+            # A *different* client (fresh host) sees the same strategy.
+            other = MasterStrategyCache(MasterClient(m.addr, 1))
+            got = other.get("deadbeef")
+            assert got is not None
+            assert strategy_to_dict(got) == strategy_to_dict(strat)
+        finally:
+            m.stop()
+
+    def test_unreachable_master_degrades_to_miss(self):
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.parallel.accelerate import Strategy
+        from dlrover_tpu.parallel.strategy_search import (
+            MasterStrategyCache,
+        )
+
+        from dlrover_tpu.common.rpc import RpcClient
+
+        client = MasterClient("127.0.0.1:1", 0)
+        client._client = RpcClient("127.0.0.1:1", timeout=0.2)
+        cache = MasterStrategyCache(client)
+        assert cache.get("k") is None
+        cache.put("k", Strategy())  # best-effort: must not raise
+
+
+class TestAutoPathCache:
+    def test_auto_candidates_cached(self, tmp_path, cpu_mesh_devices):
+        """accelerate(strategy='auto', cache=...) stores the winner; a
+        second call compiles exactly one candidate (the cached one)."""
+        import sys
+
+        init_fn, loss_fn, batch = _problem()
+        devs = cpu_mesh_devices[:8]
+        cache = StrategyCache(str(tmp_path / "auto.json"))
+        acc = sys.modules["dlrover_tpu.parallel.accelerate"]
+        calls = {"n": 0}
+        orig = acc._compile_candidate
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return orig(*a, **kw)
+
+        acc._compile_candidate = counting
+        try:
+            job1 = acc.accelerate(
+                loss_fn=loss_fn, init_fn=init_fn,
+                optimizer=optax.sgd(0.1), sample_batch=batch,
+                strategy="auto", devices=devs, cache=cache,
+            )
+            first = calls["n"]
+            assert first >= 2
+            job2 = acc.accelerate(
+                loss_fn=loss_fn, init_fn=init_fn,
+                optimizer=optax.sgd(0.1), sample_batch=batch,
+                strategy="auto", devices=devs, cache=cache,
+            )
+            assert calls["n"] == first + 1  # only the cached winner
+            assert (job2.strategy.mesh.describe()
+                    == job1.strategy.mesh.describe())
+        finally:
+            acc._compile_candidate = orig
+
+
+class TestCacheRobustness:
+    def test_offload_opt_survives_round_trip(self):
+        s = Strategy(mesh=MeshSpec(dp=2), offload_opt=True)
+        s2 = strategy_from_dict(strategy_to_dict(s))
+        assert s2.offload_opt is True
+
+    def test_stale_hit_falls_back_to_sweep(self, tmp_path,
+                                           cpu_mesh_devices):
+        """A cached strategy that no longer compiles (e.g. cached on
+        different hardware) must not hard-fail recovery: the auto sweep
+        runs behind it."""
+        init_fn, loss_fn, batch = _problem()
+        devs = cpu_mesh_devices[:8]
+        cache = StrategyCache(str(tmp_path / "stale.json"))
+        # Poison the cache: a mesh needing 16 devices on an 8-device world.
+        import jax as _jax
+
+        p_fp = _jax.eval_shape(init_fn, _jax.random.PRNGKey(0))
+        o_fp = _jax.eval_shape(optax.sgd(0.1).init, p_fp)
+        fp = fingerprint(p_fp, batch, 8, o_fp)
+        cache.put(fp, Strategy(mesh=MeshSpec(dp=16)))
+        job = accelerate(
+            loss_fn=loss_fn, init_fn=init_fn, optimizer=optax.sgd(0.1),
+            sample_batch=batch, strategy="auto", devices=devs,
+            cache=cache,
+        )
+        assert job.strategy.mesh.num_devices == 8  # sweep rescued it
+        # And the poisoned entry was overwritten with the real winner.
+        assert cache.get(fp).mesh.num_devices == 8
+
+    def test_explicit_strategy_never_overridden_by_cache(
+        self, tmp_path, cpu_mesh_devices
+    ):
+        init_fn, loss_fn, batch = _problem()
+        devs = cpu_mesh_devices[:8]
+        cache = StrategyCache(str(tmp_path / "c.json"))
+        import jax as _jax
+
+        p_fp = _jax.eval_shape(init_fn, _jax.random.PRNGKey(0))
+        o_fp = _jax.eval_shape(optax.sgd(0.1).init, p_fp)
+        fp = fingerprint(p_fp, batch, 8, o_fp)
+        cache.put(fp, Strategy(mesh=MeshSpec(fsdp=8)))
+        job = accelerate(
+            loss_fn=loss_fn, init_fn=init_fn, optimizer=optax.sgd(0.1),
+            sample_batch=batch,
+            strategy=Strategy(mesh=MeshSpec(dp=8)),  # explicit choice
+            devices=devs, cache=cache,
+        )
+        assert job.strategy.mesh.describe() == "dp8"
