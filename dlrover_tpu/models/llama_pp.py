@@ -28,8 +28,11 @@ from dlrover_tpu.models.llama import LlamaConfig
 from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
 from dlrover_tpu.ops.rmsnorm import rmsnorm
 from dlrover_tpu.parallel.pipeline import (
+    deinterleave_stage_grads,
+    interleave_stage_params,
     pipeline_apply,
     pipeline_value_and_grad,
+    pipeline_value_and_grad_interleaved,
     stack_stage_params,
 )
 
@@ -130,19 +133,61 @@ def pipeline_train_grads(
     mesh: Mesh,
     *,
     n_microbatches: int,
+    n_chunks: int = 1,
     pp_axis: str = "pp",
 ) -> Tuple[jax.Array, Dict]:
     """1F1B loss + grads in ``params``' tree structure (the drop-in
-    replacement for ``jax.value_and_grad(llama.loss_fn)`` when pipelining)."""
+    replacement for ``jax.value_and_grad(llama.loss_fn)`` when pipelining).
+
+    ``n_chunks > 1`` selects the interleaved schedule: each physical
+    stage hosts ``n_chunks`` virtual stages (layer groups), shrinking the
+    pipeline bubble by ~``n_chunks`` at the price of ``n_chunks``x the
+    ring hops (reference ``StageInterleaver``)."""
     tokens, targets = llama.split_batch(batch)
     n_stages = mesh.shape[pp_axis]
-    stacked, pre, post = split_stage_params(params, n_stages)
-    loss, (d_blocks, d_pre, d_post) = pipeline_value_and_grad(
+    if n_chunks <= 1:
+        stacked, pre, post = split_stage_params(params, n_stages)
+        loss, (d_blocks, d_pre, d_post) = pipeline_value_and_grad(
+            _stage_fn(cfg),
+            _pre_fn(cfg),
+            _post_fn(cfg),
+            stacked, pre, post, tokens, targets, mesh,
+            n_microbatches=n_microbatches, pp_axis=pp_axis,
+        )
+        grads = merge_stage_grads(d_blocks, d_pre, d_post, n_stages)
+        return loss, grads
+
+    # Interleaved: layers split into S*V virtual stages in layer order;
+    # virtual j lives on physical j % S.
+    SV = n_stages * n_chunks
+    layers = params["layers"]
+    L = len(layers)
+    if L % SV != 0:
+        raise ValueError(
+            f"n_layer={L} not divisible by stages*chunks={SV}"
+        )
+    per = L // SV
+    virt = [layers[j * per:(j + 1) * per] for j in range(SV)]
+    stacked = interleave_stage_params(virt, n_stages)
+    pre = {"embed": params["embed"]}
+    post = {"ln_f": params["ln_f"], "lm_head": params["lm_head"]}
+    loss, (d_blocks, d_pre, d_post) = pipeline_value_and_grad_interleaved(
         _stage_fn(cfg),
         _pre_fn(cfg),
         _post_fn(cfg),
         stacked, pre, post, tokens, targets, mesh,
-        n_microbatches=n_microbatches, pp_axis=pp_axis,
+        n_microbatches=n_microbatches, n_chunks=n_chunks,
+        pp_axis=pp_axis,
     )
-    grads = merge_stage_grads(d_blocks, d_pre, d_post, n_stages)
+    virt_grads = deinterleave_stage_grads(d_blocks, n_stages, n_chunks)
+    grad_layers = []
+    for j in range(SV):
+        # virt_grads[j] is the list of this virtual stage's block trees.
+        grad_layers.extend(virt_grads[j])
+    grads = {
+        "embed": d_pre["embed"],
+        "layers": grad_layers,
+        "ln_f": d_post["ln_f"],
+        "lm_head": d_post["lm_head"],
+    }
     return loss, grads

@@ -215,26 +215,80 @@ def accelerate(
             for c in candidates
         ]
 
+    # SPMD discipline for the candidate sweep: every process must launch
+    # the same device programs in the same order, so compile failures are
+    # agreed across processes and (when timing) the leader's score is
+    # broadcast — same contract search() enforces for the "bo" path.
+    multiproc = jax.process_count() > 1
+    is_leader = jax.process_index() == 0
+
+    def _all_ok(ok: bool) -> bool:
+        if not multiproc:
+            return ok
+        from jax.experimental import multihost_utils
+
+        oks = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray(1 if ok else 0, np.int32)
+            )
+        )
+        return bool(np.all(oks))
+
+    def _leader_score(t: float) -> float:
+        if not multiproc:
+            return t
+        from jax.experimental import multihost_utils
+
+        return float(
+            np.asarray(
+                multihost_utils.broadcast_one_to_all(
+                    np.asarray(t, np.float64)
+                )
+            )
+        )
+
     # Strategy persistence for the "auto" path too (the "bo" path handles
     # its own cache inside search(); explicit Strategy/list choices are
     # the caller's to make and are never overridden by a stale hit).  A
     # hit goes FIRST and short-circuits the sweep — an elastic rebuild
     # skips re-scoring mid-recovery — but the full candidate list stays
     # behind it as fallback: a hit cached on different hardware may no
-    # longer compile, and recovery must not die on it.
+    # longer compile, and recovery must not die on it.  The leader reads
+    # the cache and broadcasts hit/miss, so processes never diverge on a
+    # flaky cache RPC.
     cache_obj = fp = None
     cache_hit = False
     if cache is not None and strategy == "auto":
         from dlrover_tpu.parallel.strategy_search import (
             StrategyCache,
             fingerprint,
+            strategy_from_dict,
+            strategy_to_dict,
         )
 
         cache_obj = StrategyCache(cache) if isinstance(cache, str) else cache
         params_fp = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
         opt_fp = jax.eval_shape(optimizer.init, params_fp)
         fp = fingerprint(params_fp, sample_batch, n, opt_fp)
-        hit = cache_obj.get(fp)
+        hit = cache_obj.get(fp) if is_leader else None
+        if multiproc:
+            import json as _json
+
+            from jax.experimental import multihost_utils
+
+            buf = np.zeros(512, np.uint8)
+            if hit is not None:
+                blob = _json.dumps(strategy_to_dict(hit)).encode()
+                buf[: len(blob)] = np.frombuffer(blob, np.uint8)
+            got = bytes(
+                np.asarray(
+                    multihost_utils.broadcast_one_to_all(buf)
+                ).tobytes()
+            ).rstrip(b"\x00")
+            hit = (
+                strategy_from_dict(_json.loads(got.decode()))
+                if got else None
+            )
         if hit is not None:
             if grad_accum is not None:
                 # The override is current-run config, not cached state.
@@ -255,12 +309,16 @@ def accelerate(
             )
         except Exception as e:  # noqa: BLE001
             logger.info("strategy %s rejected: %s", cand.describe(), e)
+            job = None
+        if not _all_ok(job is not None):
+            # Some process failed this candidate: all must skip together
+            # or the next collective deadlocks the job.
             continue
         if cache_hit and i == 0:
-            # Viable hit: take it without scoring the rest.
+            # Viable hit everywhere: take it without scoring the rest.
             best = job
             break
-        score = _score(job, profile_steps, init_fn)
+        score = _leader_score(_score(job, profile_steps, init_fn))
         logger.info("strategy %s scored %.4g", cand.describe(), score)
         if score < best_score:
             best, best_score = job, score
@@ -269,8 +327,14 @@ def accelerate(
     if best is None:
         raise RuntimeError("no viable strategy found")
     logger.info("accelerate: selected %s", best.strategy.describe())
-    if cache_obj is not None and fp is not None:
-        cache_obj.put(fp, best.strategy)
+    if is_leader and cache_obj is not None and fp is not None:
+        # A forced grad_accum is this run's config, not a property of the
+        # winning strategy — never persist it (a later run without the
+        # override must not inherit 4x accumulation it never asked for).
+        to_cache = best.strategy
+        if grad_accum is not None:
+            to_cache = dataclasses.replace(to_cache, grad_accum=1)
+        cache_obj.put(fp, to_cache)
     return best
 
 

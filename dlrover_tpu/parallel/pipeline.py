@@ -184,69 +184,109 @@ def build_1f1b_schedule(n_stages: int, n_micro: int) -> Schedule:
     """Megatron-style non-interleaved 1F1B (reference
     ``pipeline_parallel/scheduler.py:15`` PipeSchedulerType.OneFOneB).
 
-    Per-stage action order: ``min(S-1-s, M)`` warmup forwards, then
-    alternating f/b until forwards are exhausted, then cooldown backwards.
-    Actions are placed at the earliest tick satisfying (a) one action per
-    stage per tick and (b) cross-stage dependencies (activations/grads arrive
-    at the end of the producing tick).
-    """
-    S, M = n_stages, n_micro
-    actions = []  # per stage: list of ('f'|'b', micro)
-    for s in range(S):
-        warmup = min(S - 1 - s, M)
-        acts = [("f", m) for m in range(warmup)]
-        nf, nb = warmup, 0
-        while nf < M or nb < M:
-            if nf < M:
-                acts.append(("f", nf))
-                nf += 1
-            if nb < M and (nb < nf):
-                acts.append(("b", nb))
-                nb += 1
-        actions.append(acts)
+    The single-chunk case of :func:`build_interleaved_1f1b_schedule`
+    (with ``V=1`` the entry encoding ``m * V + v`` is just ``m``)."""
+    return build_interleaved_1f1b_schedule(n_stages, 1, n_micro)
 
-    done_f = {}  # (m, s) -> tick
-    done_b = {}
-    ptr = [0] * S
+
+def build_interleaved_1f1b_schedule(
+    n_stages: int, n_chunks: int, n_micro: int
+) -> Schedule:
+    """Interleaved 1F1B (reference ``StageInterleaver`` +
+    ``PipeSchedulerType`` interleaved mode): each physical stage holds
+    ``n_chunks`` *virtual* stages — virtual stage ``j`` (of ``S*V``) lives
+    on physical ``j % S``, so every virtual hop is one +1 ring hop
+    (including the ``S-1 -> 0`` wrap between chunks).
+
+    Entries in the returned [n_ticks, S] tables encode ``m * V + v``
+    (microbatch m through local chunk v), -1 = idle.  Constraint: one fwd
+    and one bwd *unit* per physical stage per tick (a unit is one chunk,
+    1/V the work of a non-interleaved stage) — the warmup ramp is paid in
+    chunk-sized units, which is where the bubble shrinks by ~V.
+    """
+    S, V, M = n_stages, n_chunks, n_micro
+    SV = S * V
+
+    # Per-VIRTUAL-stage action queues, exactly the 1F1B ramp at depth SV.
+    queues: list = []  # [S][V] -> list[('f'|'b', m)]
+    for s in range(S):
+        per_chunk = []
+        for v in range(V):
+            j = v * S + s
+            warmup = min(SV - 1 - j, M)
+            acts = [("f", m) for m in range(warmup)]
+            nf, nb = warmup, 0
+            while nf < M or nb < M:
+                if nf < M:
+                    acts.append(("f", nf))
+                    nf += 1
+                if nb < M and nb < nf:
+                    acts.append(("b", nb))
+                    nb += 1
+            per_chunk.append(acts)
+        queues.append(per_chunk)
+
+    done_f: dict = {}  # (m, j) -> tick
+    done_b: dict = {}
+    ptr = [[0] * V for _ in range(S)]
     fwd_rows, bwd_rows = [], []
     t = 0
-    while any(ptr[s] < len(actions[s]) for s in range(S)):
+    while any(
+        ptr[s][v] < len(queues[s][v]) for s in range(S) for v in range(V)
+    ):
         frow = [-1] * S
         brow = [-1] * S
+
+        def rank(m: int, v: int) -> int:
+            # Megatron interleaved order: microbatches advance in groups
+            # of S per chunk, cycling chunks — group-major, then chunk,
+            # then micro-within-group.  Without this the lowest chunk
+            # monopolizes the per-tick slot and the pipeline degenerates
+            # toward a depth-S*V non-interleaved schedule.
+            return (m // S) * (V * S) + v * S + (m % S)
+
         for s in range(S):
-            # The executor runs one fwd AND one bwd unit per tick (both are
-            # computed SPMD-uniformly anyway), so co-schedule up to one of
-            # each kind per tick, in action-list order.
+            # At most one fwd and one bwd unit per physical stage per
+            # tick, taken from the *heads* of its V virtual queues
+            # (within a virtual stage the 1F1B order is fixed; across
+            # chunks the grouped rank decides who gets the slot).  Two
+            # picks per tick so an f and a b can land in either order —
+            # a queue whose head is 'b' must not starve its trailing 'f'.
             for _ in range(2):
-                if ptr[s] >= len(actions[s]):
-                    break
-                kind, m = actions[s][ptr[s]]
-                if kind == "f":
-                    if frow[s] >= 0:
-                        break  # fwd slot already used this tick
-                    ready = s == 0 or done_f.get((m, s - 1), t) < t
-                    if not ready:
-                        break
-                    frow[s] = m
-                    done_f[(m, s)] = t
-                    ptr[s] += 1
-                else:
-                    if brow[s] >= 0:
-                        break
-                    if s == S - 1:
-                        ready = done_f.get((m, s), t) < t
+                cands = []
+                for v in range(V):
+                    if ptr[s][v] >= len(queues[s][v]):
+                        continue
+                    kind, m = queues[s][v][ptr[s][v]]
+                    j = v * S + s
+                    if kind == "f":
+                        if frow[s] >= 0:
+                            continue
+                        ready = j == 0 or done_f.get((m, j - 1), t) < t
                     else:
-                        ready = done_b.get((m, s + 1), t) < t
-                    if not ready:
-                        break
-                    brow[s] = m
-                    done_b[(m, s)] = t
-                    ptr[s] += 1
+                        if brow[s] >= 0:
+                            continue
+                        if j == SV - 1:
+                            ready = done_f.get((m, j), t) < t
+                        else:
+                            ready = done_b.get((m, j + 1), t) < t
+                    if ready:
+                        cands.append((rank(m, v), kind, v, m, j))
+                if not cands:
+                    break
+                _, kind, v, m, j = min(cands)
+                if kind == "f":
+                    frow[s] = m * V + v
+                    done_f[(m, j)] = t
+                else:
+                    brow[s] = m * V + v
+                    done_b[(m, j)] = t
+                ptr[s][v] += 1
         fwd_rows.append(frow)
         bwd_rows.append(brow)
         t += 1
-        if t > 4 * (S + M) + 8:  # safety: schedule must terminate
-            raise RuntimeError("1F1B schedule failed to converge")
+        if t > 4 * (SV + M * V) + 8:  # safety: schedule must terminate
+            raise RuntimeError("interleaved 1F1B schedule non-convergent")
     return Schedule(
         np.asarray(fwd_rows, np.int32), np.asarray(bwd_rows, np.int32)
     )
@@ -281,63 +321,133 @@ def pipeline_value_and_grad(
     Returns ``(loss, (d_stacked, d_pre, d_post))`` where loss and grads match
     ``value_and_grad`` of the unpipelined mean-over-microbatches loss.
     Backward recomputes each stage from its saved input (FlashAttention-style
-    recompute), so per-stage live memory is O(S) microbatch activations.
+    recompute), so per-stage live memory is O(S) microbatch inputs.
+
+    The single-chunk case of :func:`pipeline_value_and_grad_interleaved`
+    (one virtual stage per device; with ``V=1`` the interleaved schedule
+    is tick-for-tick the plain 1F1B table and the chunk-transition wrap
+    hops are never taken).
+    """
+    return pipeline_value_and_grad_interleaved(
+        stage_fn, pre_fn, post_fn,
+        stacked_params, pre_params, post_params,
+        inputs, targets, mesh,
+        n_microbatches=n_microbatches, n_chunks=1, pp_axis=pp_axis,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Interleaved 1F1B executor (virtual pipeline stages)
+# ---------------------------------------------------------------------------
+
+
+def interleave_stage_params(per_virtual_stage: list, n_stages: int) -> Any:
+    """[virt0_tree, ..., virt(S*V-1)_tree] -> stacked tree whose leading
+    dim is ordered physical-stage-major: row ``s*V + v`` holds virtual
+    stage ``v*S + s`` (what ``P('pp')`` hands physical stage ``s`` as its
+    ``V`` local chunks)."""
+    SV = len(per_virtual_stage)
+    S = n_stages
+    assert SV % S == 0
+    V = SV // S
+    order = [v * S + s for s in range(S) for v in range(V)]
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([xs[j] for j in order], axis=0),
+        *per_virtual_stage,
+    )
+
+
+def deinterleave_stage_grads(stacked: Any, n_stages: int,
+                             n_chunks: int) -> list:
+    """Inverse of :func:`interleave_stage_params`: stacked [S*V, ...]
+    (physical-major) -> per-virtual-stage list ordered by virtual index."""
+    S, V = n_stages, n_chunks
+    out = []
+    for j in range(S * V):
+        v, s = divmod(j, S)
+        row = s * V + v
+        out.append(
+            jax.tree_util.tree_map(lambda p, r=row: p[r], stacked)
+        )
+    return out
+
+
+def pipeline_value_and_grad_interleaved(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    pre_fn: Callable[[Any, jax.Array], jax.Array],
+    post_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    stacked_params: Any,  # [S*V, ...] physical-major (interleave_stage_params)
+    pre_params: Any,
+    post_params: Any,
+    inputs: jax.Array,
+    targets: jax.Array,
+    mesh: Mesh,
+    *,
+    n_microbatches: int,
+    n_chunks: int,
+    pp_axis: str = "pp",
+) -> Tuple[jax.Array, Tuple[Any, Any, Any]]:
+    """Interleaved-1F1B pipelined loss + grads (reference
+    ``StageInterleaver``): physical stage ``s`` hosts virtual stages
+    ``{s, s+S, ...}`` — ``n_chunks`` per device — so the warmup/cooldown
+    bubble is paid in chunk-sized units (~``1/n_chunks`` of a
+    non-interleaved stage).  Semantics match
+    :func:`pipeline_value_and_grad` with ``stage_fn`` applied ``S*V``
+    times per microbatch; every virtual hop is one +1 ring ``ppermute``
+    (the chunk transition rides the ``S-1 -> 0`` wrap).
     """
     n_stages = mesh.shape[pp_axis]
-    assert inputs.shape[0] % n_microbatches == 0
-    micro_bs = inputs.shape[0] // n_microbatches
-    M, S = n_microbatches, n_stages
-    sched = build_1f1b_schedule(S, M)
+    S, V, M = n_stages, n_chunks, n_microbatches
+    SV = S * V
+    assert inputs.shape[0] % M == 0
+    micro_bs = inputs.shape[0] // M
+    sched = build_interleaved_1f1b_schedule(S, V, M)
     fwd_tab = jnp.asarray(sched.fwd)
     bwd_tab = jnp.asarray(sched.bwd)
     n_ticks = sched.fwd.shape[0]
+    n_slot = min(M, SV)
 
-    # Activation shape probe (host-side, no device compute).
     x_shape = jax.eval_shape(
         pre_fn, pre_params,
         jax.ShapeDtypeStruct((micro_bs,) + inputs.shape[1:], inputs.dtype),
     )
 
     def body(stacked_local, pre_p, post_p, inputs_, targets_):
-        blocks_me = jax.tree_util.tree_map(lambda p: p[0], stacked_local)
+        # stacked_local leading dim = V: this stage's chunks, v-minor.
+        blocks_me = stacked_local
         s_idx = jax.lax.axis_index(pp_axis)
-        is_first = s_idx == 0
-        is_last = s_idx == S - 1
         micros_in = inputs_.reshape((M, micro_bs) + inputs_.shape[1:])
         micros_tgt = targets_.reshape((M, micro_bs) + targets_.shape[1:])
 
         ring_dt = _carry_dtype(x_shape.dtype)
 
-        def zeros_ring():
-            return jnp.zeros((S,) + x_shape.shape, ring_dt)
+        def zeros_ring(lead):
+            return jnp.zeros(lead + x_shape.shape, ring_dt)
 
         def scaled_post(post_p_, y, tgt):
-            # 1/M so per-micro grads sum to the grad of the mean loss.
             return post_fn(post_p_, y, tgt) / M
 
         zero_tree = functools.partial(
-            jax.tree_util.tree_map, lambda p: jnp.zeros(p.shape, jnp.float32)
+            jax.tree_util.tree_map,
+            lambda p: jnp.zeros(p.shape, jnp.float32),
         )
 
-        # Everything differentiable is cast VARYING over pp first: inside a
-        # manual-axes region, jax.vjp cotangents w.r.t. pp-invariant inputs
-        # carry an implicit psum over 'pp' (while custom_vjp ops skip it) —
-        # per-stage masking is only sound when every cotangent is the plain
-        # per-stage value, so grads flow from varying params and get one
-        # explicit psum at the end.
         pre_v = _pcast_pp(pre_p, pp_axis)
         post_v = _pcast_pp(post_p, pp_axis)
 
         carry0 = dict(
-            in_ring=zeros_ring(),    # activations awaiting fwd
-            g_ring=zeros_ring(),     # grads awaiting bwd
-            seed_ring=zeros_ring(),  # last-stage loss grads
-            x_saved=zeros_ring(),    # saved stage inputs (recompute bwd)
+            in_ring=zeros_ring((V, n_slot)),
+            g_ring=zeros_ring((V, n_slot)),
+            seed_ring=zeros_ring((n_slot,)),
+            x_saved=zeros_ring((V, n_slot)),
             loss=jnp.zeros((), jnp.float32),
-            d_blocks=zero_tree(blocks_me),
+            d_blocks=zero_tree(blocks_me),  # [V, ...]
             d_pre=zero_tree(pre_p),
             d_post=zero_tree(post_p),
         )
+
+        def chunk_of(v):
+            return jax.tree_util.tree_map(lambda p: p[v], blocks_me)
 
         def masked_add(acc, delta, valid):
             return jax.tree_util.tree_map(
@@ -345,23 +455,35 @@ def pipeline_value_and_grad(
                 acc, delta,
             )
 
-        def tick(carry, t):
-            mf = fwd_tab[t, s_idx]
-            f_valid = mf >= 0
-            mfc = jnp.clip(mf, 0, M - 1)
-            slot_f = mfc % S
-
-            # ---- forward unit ----
-            x_entry = pre_fn(pre_v, micros_in[mfc]).astype(ring_dt)
-            x_in = jnp.where(is_first, x_entry, carry["in_ring"][slot_f])
-            x_saved = carry["x_saved"].at[slot_f].set(
-                jnp.where(f_valid, x_in, carry["x_saved"][slot_f])
+        def masked_chunk_add(acc, delta, v, valid):
+            # acc [V, ...] += delta at chunk v (when valid).
+            return jax.tree_util.tree_map(
+                lambda a, d: a.at[v].add(
+                    jnp.where(valid, d.astype(a.dtype), 0.0)
+                ),
+                acc, delta,
             )
-            y = stage_fn(blocks_me, x_in.astype(x_shape.dtype))
-            lv = f_valid & is_last
-            # Last stage: micro loss + seed grad + post grads, in-slot.
+
+        def tick(carry, t):
+            # ---- forward unit ----
+            ef = fwd_tab[t, s_idx]
+            f_valid = ef >= 0
+            efc = jnp.clip(ef, 0, M * V - 1)
+            mf, vf = efc // V, efc % V
+            jf = vf * S + s_idx
+            slot_f = mf % n_slot
+            is_j0 = jf == 0
+            is_jlast = jf == SV - 1
+
+            x_entry = pre_fn(pre_v, micros_in[mf]).astype(ring_dt)
+            x_in = jnp.where(is_j0, x_entry, carry["in_ring"][vf, slot_f])
+            x_saved = carry["x_saved"].at[vf, slot_f].set(
+                jnp.where(f_valid, x_in, carry["x_saved"][vf, slot_f])
+            )
+            y = stage_fn(chunk_of(vf), x_in.astype(x_shape.dtype))
+            lv = f_valid & is_jlast
             (loss_m, (gy, d_post_m)) = jax.value_and_grad(
-                lambda y_, pp_: scaled_post(pp_, y_, micros_tgt[mfc]),
+                lambda y_, pp_: scaled_post(pp_, y_, micros_tgt[mf]),
                 argnums=(0, 1),
             )(y, post_v)
             loss = carry["loss"] + jnp.where(lv, loss_m, 0.0)
@@ -372,52 +494,65 @@ def pipeline_value_and_grad(
             )
 
             # ---- backward unit ----
-            mb = bwd_tab[t, s_idx]
-            b_valid = mb >= 0
-            mbc = jnp.clip(mb, 0, M - 1)
-            slot_b = mbc % S
+            eb = bwd_tab[t, s_idx]
+            b_valid = eb >= 0
+            ebc = jnp.clip(eb, 0, M * V - 1)
+            mb, vb = ebc // V, ebc % V
+            jb = vb * S + s_idx
+            slot_b = mb % n_slot
             g_in = jnp.where(
-                is_last, seed_ring[slot_b], carry["g_ring"][slot_b]
+                jb == SV - 1,
+                seed_ring[slot_b],
+                carry["g_ring"][vb, slot_b],
             ).astype(x_shape.dtype)
             _, stage_vjp = jax.vjp(
-                stage_fn, blocks_me,
-                carry["x_saved"][slot_b].astype(x_shape.dtype),
+                stage_fn, chunk_of(vb),
+                carry["x_saved"][vb, slot_b].astype(x_shape.dtype),
             )
-            d_blocks_m, dx = stage_vjp(g_in)
-            d_blocks = masked_add(carry["d_blocks"], d_blocks_m, b_valid)
-            # Stage 0: fold dx into the pre (embed) params.
+            d_chunk_m, dx = stage_vjp(g_in)
+            d_blocks = masked_chunk_add(
+                carry["d_blocks"], d_chunk_m, vb, b_valid
+            )
             _, pre_vjp = jax.vjp(
-                lambda pp_: pre_fn(pp_, micros_in[mbc]), pre_v
+                lambda pp_: pre_fn(pp_, micros_in[mb]), pre_v
             )
             (d_pre_m,) = pre_vjp(dx.astype(x_shape.dtype))
             d_pre = masked_add(carry["d_pre"], d_pre_m,
-                               b_valid & is_first)
+                               b_valid & (jb == 0))
 
-            # ---- neighbour exchange (end of tick) ----
-            # Micro index rides along, +1-encoded so ppermute's zero-fill on
-            # unpaired receivers decodes as invalid.
-            send_f_ok = f_valid & (s_idx < S - 1)
-            f_payload = (
-                y.astype(ring_dt),
-                jnp.where(send_f_ok, mf + 1, 0),
+            # ---- neighbour exchange (full ring, both directions) ----
+            # fwd: virtual j -> j+1 is physical +1; the chunk increments
+            # exactly on the S-1 -> 0 wrap.
+            vf_next = vf + jnp.where(s_idx == S - 1, 1, 0)
+            send_f_ok = f_valid & ~is_jlast
+            enc_f = jnp.where(send_f_ok, mf * V + vf_next + 1, 0)
+            perm_ring_f = [(s, (s + 1) % S) for s in range(S)]
+            y_in, enc_fin = _safe_ppermute(
+                (y.astype(ring_dt), enc_f), pp_axis, perm_ring_f
             )
-            perm_f = [(s, s + 1) for s in range(S - 1)]
-            y_in, mfe_in = _safe_ppermute(f_payload, pp_axis, perm_f)
-            in_slot = jnp.clip(mfe_in - 1, 0, M - 1) % S
-            in_ring = carry["in_ring"].at[in_slot].set(
-                jnp.where(mfe_in > 0, y_in, carry["in_ring"][in_slot])
+            dec_f = jnp.clip(enc_fin - 1, 0, M * V - 1)
+            m_fin, v_fin = dec_f // V, dec_f % V
+            slot_fin = m_fin % n_slot
+            in_ring = carry["in_ring"].at[v_fin, slot_fin].set(
+                jnp.where(enc_fin > 0, y_in,
+                          carry["in_ring"][v_fin, slot_fin])
             )
 
-            send_b_ok = b_valid & (s_idx > 0)
-            b_payload = (
-                dx.astype(ring_dt),
-                jnp.where(send_b_ok, mb + 1, 0),
+            # bwd: virtual j -> j-1 is physical -1; chunk decrements on
+            # the 0 -> S-1 wrap.
+            vb_next = vb - jnp.where(s_idx == 0, 1, 0)
+            send_b_ok = b_valid & (jb > 0)
+            enc_b = jnp.where(send_b_ok, mb * V + vb_next + 1, 0)
+            perm_ring_b = [(s, (s - 1) % S) for s in range(S)]
+            dx_in, enc_bin = _safe_ppermute(
+                (dx.astype(ring_dt), enc_b), pp_axis, perm_ring_b
             )
-            perm_b = [(s, s - 1) for s in range(1, S)]
-            dx_in, mbe_in = _safe_ppermute(b_payload, pp_axis, perm_b)
-            g_slot = jnp.clip(mbe_in - 1, 0, M - 1) % S
-            g_ring = carry["g_ring"].at[g_slot].set(
-                jnp.where(mbe_in > 0, dx_in, carry["g_ring"][g_slot])
+            dec_b = jnp.clip(enc_bin - 1, 0, M * V - 1)
+            m_bin, v_bin = dec_b // V, dec_b % V
+            slot_bin = m_bin % n_slot
+            g_ring = carry["g_ring"].at[v_bin, slot_bin].set(
+                jnp.where(enc_bin > 0, dx_in,
+                          carry["g_ring"][v_bin, slot_bin])
             )
 
             return dict(
@@ -430,17 +565,14 @@ def pipeline_value_and_grad(
             tick, _pcast_pp(carry0, pp_axis), jnp.arange(n_ticks)
         )
 
-        loss = jax.lax.psum(carry["loss"], pp_axis)  # only last stage != 0
+        loss = jax.lax.psum(carry["loss"], pp_axis)
         d_pre = jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, pp_axis), carry["d_pre"]
         )
         d_post = jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, pp_axis), carry["d_post"]
         )
-        d_blocks = jax.tree_util.tree_map(
-            lambda g: g[None], carry["d_blocks"]
-        )
-        return loss, d_blocks, d_pre, d_post
+        return loss, carry["d_blocks"], d_pre, d_post
 
     stacked_specs = jax.tree_util.tree_map(
         lambda _: P(pp_axis), stacked_params
