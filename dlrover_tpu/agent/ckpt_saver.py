@@ -245,6 +245,7 @@ class AsyncCheckpointSaver:
         pid = int(event.get("process_id", lr))
         nproc_global = int(event.get("num_processes", self.nproc))
         ckpt_dir = event["ckpt_dir"]
+        keep_last = int(event.get("max_to_keep", 0)) or 3
         lock = self._locks[lr] if lr < len(self._locks) else None
         if lock is not None and not lock.acquire(timeout=60.0):
             logger.warning("saver: lock for rank %d busy; skipping", lr)
@@ -285,10 +286,12 @@ class AsyncCheckpointSaver:
         if pid == 0:
             # Commit waits for the OTHER ranks' shards — never block the
             # event loop on it (they may be persisted by this same loop).
-            self._pool.submit(self._commit, ckpt_dir, step, nproc_global)
+            self._pool.submit(
+                self._commit, ckpt_dir, step, nproc_global, keep_last
+            )
 
     def _commit(self, ckpt_dir: str, step: int, world: int,
-                timeout: float = 600.0) -> None:
+                keep_last: int = 3, timeout: float = 600.0) -> None:
         deadline = time.time() + timeout
         if self.client is not None:
             while time.time() < deadline:
@@ -300,7 +303,9 @@ class AsyncCheckpointSaver:
                 time.sleep(0.5)
         while time.time() < deadline:
             if shard_file.all_shards_done(self.storage, ckpt_dir, step, world):
-                shard_file.commit(self.storage, ckpt_dir, step)
+                shard_file.commit(
+                    self.storage, ckpt_dir, step, keep_last=keep_last
+                )
                 return
             time.sleep(0.5)
         logger.warning("saver: commit of step %d timed out", step)

@@ -30,12 +30,14 @@ class FlashCheckpointer:
         job_name: str = "",
         storage: Optional[CheckpointStorage] = None,
         master_client=None,
+        max_to_keep: int = 0,  # >0 overrides commit-time step rotation
     ):
         self.engine = CheckpointEngine(
             ckpt_dir,
             job_name=job_name,
             storage=storage,
             master_client=master_client,
+            max_to_keep=max_to_keep,
         )
 
     def save(

@@ -62,10 +62,12 @@ class CheckpointEngine:
         job_name: str = "",
         storage: Optional[CheckpointStorage] = None,
         master_client=None,
+        max_to_keep: int = 0,  # >0: override commit's step-dir rotation
     ):
         self.ckpt_dir = ckpt_dir
         self.job_name = job_name or env_utils.get_job_name()
         self.storage = storage or PosixDiskStorage()
+        self.max_to_keep = max_to_keep
         self.client = master_client
         self._ctx = get_context()
         self.process_id = env_utils.get_process_id()
@@ -157,6 +159,7 @@ class CheckpointEngine:
                     "process_id": self.process_id,
                     "num_processes": self.num_processes,
                     "ckpt_dir": self.ckpt_dir,
+                    "max_to_keep": self.max_to_keep,
                 }
             )
         else:
@@ -190,7 +193,10 @@ class CheckpointEngine:
             if shard_file.all_shards_done(
                 self.storage, self.ckpt_dir, step, self.num_processes
             ):
-                shard_file.commit(self.storage, self.ckpt_dir, step)
+                shard_file.commit(
+                    self.storage, self.ckpt_dir, step,
+                    keep_last=self.max_to_keep or 3,
+                )
                 return True
             time.sleep(0.5)
         logger.warning("commit of step %d timed out", step)

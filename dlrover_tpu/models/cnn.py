@@ -1,0 +1,105 @@
+"""Small convolutional classifier: the MNIST-class model family.
+
+Parity target: the reference's CNN example job
+(``examples/pytorch/mnist/cnn_train.py`` — the smallest end-to-end model
+family its elastic stack is exercised with).  TPU-first shape choices:
+NHWC layout (the TPU-native convolution layout), bf16 compute with fp32
+params, channel counts in MXU-friendly multiples, and a pure functional
+(init / forward / loss) surface matching the other families so it drops
+into ``accelerate()`` / the Trainer / the conf executor unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
+
+
+@dataclasses.dataclass(frozen=True)
+class CNNConfig:
+    image_size: int = 28
+    channels: int = 1
+    num_classes: int = 10
+    widths: tuple = (32, 64)   # conv channels per block (3x3, stride 2)
+    hidden: int = 128
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def tiny(cls, **over) -> "CNNConfig":
+        base = dict(image_size=16, channels=3, num_classes=10,
+                    widths=(16, 32), hidden=64)
+        base.update(over)
+        return cls(**base)
+
+    @property
+    def final_spatial(self) -> int:
+        s = self.image_size
+        for _ in self.widths:
+            s = -(-s // 2)  # stride-2 ceil
+        return s
+
+
+def init_params(rng: jax.Array, cfg: CNNConfig) -> Dict:
+    keys = jax.random.split(rng, len(cfg.widths) + 2)
+    params: Dict[str, Any] = {"convs": []}
+    c_in = cfg.channels
+    for i, c_out in enumerate(cfg.widths):
+        fan_in = 3 * 3 * c_in
+        params["convs"].append({
+            "w": jax.random.normal(
+                keys[i], (3, 3, c_in, c_out), jnp.float32
+            ) * np.sqrt(2.0 / fan_in),
+            "b": jnp.zeros((c_out,), jnp.float32),
+        })
+        c_in = c_out
+    flat = cfg.final_spatial ** 2 * c_in
+    params["fc1"] = {
+        "w": jax.random.normal(keys[-2], (flat, cfg.hidden), jnp.float32)
+        * np.sqrt(2.0 / flat),
+        "b": jnp.zeros((cfg.hidden,), jnp.float32),
+    }
+    params["head"] = {
+        "w": jax.random.normal(
+            keys[-1], (cfg.hidden, cfg.num_classes), jnp.float32
+        ) * np.sqrt(1.0 / cfg.hidden),
+        "b": jnp.zeros((cfg.num_classes,), jnp.float32),
+    }
+    return params
+
+
+def forward(params: Dict, images: jax.Array, cfg: CNNConfig) -> jax.Array:
+    """images [B, H, W, C] (NHWC) -> logits [B, num_classes] fp32."""
+    dt = cfg.dtype
+    x = images.astype(dt)
+    for conv in params["convs"]:
+        x = jax.lax.conv_general_dilated(
+            x, conv["w"].astype(dt),
+            window_strides=(2, 2), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + conv["b"].astype(dt)
+        x = jax.nn.relu(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"]["w"].astype(dt)
+                    + params["fc1"]["b"].astype(dt))
+    logits = (x @ params["head"]["w"].astype(dt)
+              + params["head"]["b"].astype(dt))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Dict, batch: Dict, cfg: CNNConfig) -> jax.Array:
+    """batch = {images [B,H,W,C], labels [B]} -> scalar."""
+    logits = forward(params, batch["images"], cfg)
+    return jnp.mean(softmax_cross_entropy(logits, batch["labels"]))
+
+
+def accuracy(params: Dict, batch: Dict, cfg: CNNConfig) -> jax.Array:
+    logits = forward(params, batch["images"], cfg)
+    return jnp.mean(
+        (jnp.argmax(logits, -1) == batch["labels"]).astype(jnp.float32)
+    )

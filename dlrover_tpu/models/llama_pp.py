@@ -37,24 +37,33 @@ from dlrover_tpu.parallel.pipeline import (
 )
 
 
+def split_layer_groups(params: Dict, n_groups: int) -> list:
+    """Llama layers -> ``n_groups`` contiguous equal groups (each a list
+    of block trees).  L must divide evenly and every group must share a
+    block pattern (dense/moe) so the group trees stack."""
+    layers = params["layers"]
+    L = len(layers)
+    if L % n_groups != 0:
+        raise ValueError(f"n_layer={L} not divisible by {n_groups} groups")
+    per = L // n_groups
+    return [layers[g * per:(g + 1) * per] for g in range(n_groups)]
+
+
+def head_tail_params(params: Dict) -> Tuple[Dict, Dict]:
+    """(pre, post) halves of the non-block params: embedding enters at
+    the first (virtual) stage, final-norm + lm-head leave at the last."""
+    return (
+        {"embed": params["embed"]},
+        {"ln_f": params["ln_f"], "lm_head": params["lm_head"]},
+    )
+
+
 def split_stage_params(
     params: Dict, n_stages: int
 ) -> Tuple[Any, Dict, Dict]:
-    """Llama params -> (stacked_blocks [n_stages, ...], pre, post).
-
-    Layers are split contiguously: stage s gets layers
-    [s*L/S, (s+1)*L/S).  L must divide evenly and each stage must have the
-    same block pattern (dense/moe) for the trees to stack.
-    """
-    layers = params["layers"]
-    L = len(layers)
-    if L % n_stages != 0:
-        raise ValueError(f"n_layer={L} not divisible by n_stages={n_stages}")
-    per = L // n_stages
-    stages = [layers[s * per:(s + 1) * per] for s in range(n_stages)]
-    stacked = stack_stage_params(stages)
-    pre = {"embed": params["embed"]}
-    post = {"ln_f": params["ln_f"], "lm_head": params["lm_head"]}
+    """Llama params -> (stacked_blocks [n_stages, ...], pre, post)."""
+    stacked = stack_stage_params(split_layer_groups(params, n_stages))
+    pre, post = head_tail_params(params)
     return stacked, pre, post
 
 
@@ -160,17 +169,9 @@ def pipeline_train_grads(
     # Interleaved: layers split into S*V virtual stages in layer order;
     # virtual j lives on physical j % S.
     SV = n_stages * n_chunks
-    layers = params["layers"]
-    L = len(layers)
-    if L % SV != 0:
-        raise ValueError(
-            f"n_layer={L} not divisible by stages*chunks={SV}"
-        )
-    per = L // SV
-    virt = [layers[j * per:(j + 1) * per] for j in range(SV)]
+    virt = split_layer_groups(params, SV)
     stacked = interleave_stage_params(virt, n_stages)
-    pre = {"embed": params["embed"]}
-    post = {"ln_f": params["ln_f"], "lm_head": params["lm_head"]}
+    pre, post = head_tail_params(params)
     loss, (d_blocks, d_pre, d_post) = pipeline_value_and_grad_interleaved(
         _stage_fn(cfg),
         _pre_fn(cfg),

@@ -47,6 +47,40 @@ REMAT_POLICIES = {
     "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
 }
 
+_BCAST_BYTES = 1024  # fixed blob size for leader->all strategy broadcast
+
+
+def _bcast_blob(payload_bytes: Optional[bytes]) -> bytes:
+    """Leader ships a small blob to every process; one fixed-size
+    zero-padded buffer so the collective's shape is process-uniform."""
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(_BCAST_BYTES, np.uint8)
+    if payload_bytes:
+        if len(payload_bytes) > _BCAST_BYTES:
+            raise ValueError(
+                f"strategy blob {len(payload_bytes)}B exceeds the "
+                f"{_BCAST_BYTES}B broadcast buffer"
+            )
+        buf[: len(payload_bytes)] = np.frombuffer(payload_bytes, np.uint8)
+    got = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return bytes(got.tobytes()).rstrip(b"\x00")
+
+
+def _bcast_strategy(hit) -> Optional["Strategy"]:
+    """Broadcast the leader's cache hit (or miss) to every process."""
+    import json
+
+    from dlrover_tpu.parallel.strategy_search import (
+        strategy_from_dict,
+        strategy_to_dict,
+    )
+
+    raw = _bcast_blob(
+        json.dumps(strategy_to_dict(hit)).encode() if hit else b""
+    )
+    return strategy_from_dict(json.loads(raw.decode())) if raw else None
+
 
 @dataclasses.dataclass
 class Strategy:
@@ -262,8 +296,6 @@ def accelerate(
         from dlrover_tpu.parallel.strategy_search import (
             StrategyCache,
             fingerprint,
-            strategy_from_dict,
-            strategy_to_dict,
         )
 
         cache_obj = StrategyCache(cache) if isinstance(cache, str) else cache
@@ -272,23 +304,7 @@ def accelerate(
         fp = fingerprint(params_fp, sample_batch, n, opt_fp)
         hit = cache_obj.get(fp) if is_leader else None
         if multiproc:
-            import json as _json
-
-            from jax.experimental import multihost_utils
-
-            buf = np.zeros(512, np.uint8)
-            if hit is not None:
-                blob = _json.dumps(strategy_to_dict(hit)).encode()
-                buf[: len(blob)] = np.frombuffer(blob, np.uint8)
-            got = bytes(
-                np.asarray(
-                    multihost_utils.broadcast_one_to_all(buf)
-                ).tobytes()
-            ).rstrip(b"\x00")
-            hit = (
-                strategy_from_dict(_json.loads(got.decode()))
-                if got else None
-            )
+            hit = _bcast_strategy(hit)
         if hit is not None:
             if grad_accum is not None:
                 # The override is current-run config, not cached state.
@@ -488,8 +504,6 @@ def search(
         StrategyCache,
         default_space,
         fingerprint,
-        strategy_from_dict,
-        strategy_to_dict,
     )
 
     devs = list(devices) if devices is not None else jax.devices()
@@ -519,31 +533,11 @@ def search(
     multiproc = jax.process_count() > 1
     is_leader = jax.process_index() == 0
 
-    def bcast_blob(payload_bytes: Optional[bytes]) -> bytes:
-        """Leader ships a small blob; everyone gets it."""
-        from jax.experimental import multihost_utils
-
-        buf = np.zeros(512, np.uint8)
-        if payload_bytes:
-            buf[: len(payload_bytes)] = np.frombuffer(
-                payload_bytes, np.uint8
-            )
-        got = np.asarray(multihost_utils.broadcast_one_to_all(buf))
-        return bytes(got.tobytes()).rstrip(b"\x00")
-
     hit: Optional[Strategy] = None
     if is_leader and cache_obj is not None:
         hit = cache_obj.get(fp)
     if multiproc:
-        import json
-
-        raw = bcast_blob(
-            json.dumps(strategy_to_dict(hit)).encode() if hit else b""
-        )
-        if raw:
-            hit = strategy_from_dict(json.loads(raw.decode()))
-        else:
-            hit = None
+        hit = _bcast_strategy(hit)
     if hit is not None:
         hit = forced(hit)  # fingerprint excludes grad_accum: re-apply
         logger.info(

@@ -118,28 +118,24 @@ def _llama(conf: TrainConf):
     return loss_fn, lambda r: llama.init_params(r, cfg), fetch
 
 
-@register_model_family("vit")
-def _vit(conf: TrainConf):
-    from dlrover_tpu.models import vit
-
-    cfg = vit.ViTConfig.tiny(**conf.model_args)
-    # Class prototypes are index-independent: build once, not per fetch.
+def _synthetic_image_fetch(num_classes, image_size, channels):
+    """Index-addressable synthetic labeled images (learnable; elastic
+    re-partition safe): record i's label and pixels derive from i alone.
+    Class prototypes are index-independent — built once, not per fetch."""
     protos = np.random.RandomState(0).randn(
-        cfg.num_classes, cfg.image_size, cfg.image_size, cfg.channels
+        num_classes, image_size, image_size, channels
     ).astype(np.float32)
 
     def fetch(indices):
-        # Index-addressable synthetic images whose label is recoverable
-        # from pixel statistics (learnable; elastic re-partition safe).
         idx = np.asarray(indices, np.int64)
-        labels = (idx % cfg.num_classes).astype(np.int32)
+        labels = (idx % num_classes).astype(np.int32)
         noise = np.stack(
             [
                 # Offset the seed so record 0's stream is not the
                 # prototype generator's (which would make its "noise"
                 # perfectly correlated with protos[0]).
                 np.random.RandomState(int(i) + 1).randn(
-                    cfg.image_size, cfg.image_size, cfg.channels
+                    image_size, image_size, channels
                 )
                 for i in idx
             ]
@@ -149,10 +145,37 @@ def _vit(conf: TrainConf):
             "labels": labels,
         }
 
+    return fetch
+
+
+@register_model_family("vit")
+def _vit(conf: TrainConf):
+    from dlrover_tpu.models import vit
+
+    cfg = vit.ViTConfig.tiny(**conf.model_args)
+    fetch = _synthetic_image_fetch(
+        cfg.num_classes, cfg.image_size, cfg.channels
+    )
+
     def loss_fn(params, batch):
         return vit.loss_fn(params, batch, cfg)
 
     return loss_fn, lambda r: vit.init_params(r, cfg), fetch
+
+
+@register_model_family("cnn")
+def _cnn(conf: TrainConf):
+    from dlrover_tpu.models import cnn
+
+    cfg = cnn.CNNConfig.tiny(**conf.model_args)
+    fetch = _synthetic_image_fetch(
+        cfg.num_classes, cfg.image_size, cfg.channels
+    )
+
+    def loss_fn(params, batch):
+        return cnn.loss_fn(params, batch, cfg)
+
+    return loss_fn, lambda r: cnn.init_params(r, cfg), fetch
 
 
 # -- the executor ------------------------------------------------------------
