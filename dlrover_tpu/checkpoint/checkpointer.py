@@ -30,7 +30,8 @@ class FlashCheckpointer:
         job_name: str = "",
         storage: Optional[CheckpointStorage] = None,
         master_client=None,
-        max_to_keep: int = 0,  # >0 overrides commit-time step rotation
+        # None = default rotation (3); 0 = keep all; N > 0 = keep newest N
+        max_to_keep: Optional[int] = None,
     ):
         self.engine = CheckpointEngine(
             ckpt_dir,

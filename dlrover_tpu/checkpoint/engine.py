@@ -62,7 +62,9 @@ class CheckpointEngine:
         job_name: str = "",
         storage: Optional[CheckpointStorage] = None,
         master_client=None,
-        max_to_keep: int = 0,  # >0: override commit's step-dir rotation
+        # None = default rotation (keep 3); 0 = keep ALL step dirs;
+        # N > 0 = keep the newest N.
+        max_to_keep: Optional[int] = None,
     ):
         self.ckpt_dir = ckpt_dir
         self.job_name = job_name or env_utils.get_job_name()
@@ -195,7 +197,9 @@ class CheckpointEngine:
             ):
                 shard_file.commit(
                     self.storage, self.ckpt_dir, step,
-                    keep_last=self.max_to_keep or 3,
+                    keep_last=(
+                        3 if self.max_to_keep is None else self.max_to_keep
+                    ),
                 )
                 return True
             time.sleep(0.5)

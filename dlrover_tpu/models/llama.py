@@ -195,6 +195,7 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 def _attention(
     x, layer, cfg: LlamaConfig, positions, attn_impl: str, mesh,
+    segment_ids=None,
 ):
     B, S, C = x.shape
     H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
@@ -210,10 +211,20 @@ def _attention(
         v = jnp.repeat(v, rep, axis=2)
 
     if attn_impl == "ring" and mesh is not None:
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "packed sequences (segment_ids) require the flash "
+                "attention path, not ring"
+            )
         from dlrover_tpu.parallel.ring_attention import ring_attention
 
         out = ring_attention(q, k, v, mesh, causal=True)
     elif attn_impl == "ulysses" and mesh is not None:
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "packed sequences (segment_ids) require the flash "
+                "attention path, not ulysses"
+            )
         from dlrover_tpu.parallel.sequence import ulysses_attention
 
         out = ulysses_attention(q, k, v, mesh, causal=True)
@@ -224,6 +235,7 @@ def _attention(
             k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3),
             causal=True,
+            segment_ids=segment_ids,
             backend=None if attn_impl == "auto" else attn_impl,
         )
         out = o.transpose(0, 2, 1, 3)
@@ -283,16 +295,36 @@ def block_apply(
     *,
     attn_impl: str = "auto",
     mesh=None,
+    segment_ids=None,
 ) -> tuple:
     """One transformer block: (x, layer) -> (x, moe_aux scalar).  The unit
     the pipeline stage partitioner groups (``models.llama_pp``)."""
     h = rmsnorm(x, layer["ln1"], eps=cfg.rms_eps)
-    x = x + _attention(h, layer, cfg, positions, attn_impl, mesh)
+    x = x + _attention(h, layer, cfg, positions, attn_impl, mesh,
+                       segment_ids)
     h = rmsnorm(x, layer["ln2"], eps=cfg.rms_eps)
     if "moe" in layer:
         delta, aux = _moe_swiglu(h, layer["moe"], cfg)
         return x + delta, aux
     return x + _swiglu(h, layer["mlp"], cfg.dtype), jnp.zeros((), jnp.float32)
+
+
+def segment_positions(segment_ids: jax.Array) -> jax.Array:
+    """[B, S] segment ids -> [B, S] within-segment positions (rope resets
+    at every packed-sequence boundary)."""
+    S = segment_ids.shape[-1]
+    idx = jnp.arange(S)
+    change = jnp.concatenate(
+        [
+            jnp.ones(segment_ids.shape[:-1] + (1,), bool),
+            segment_ids[..., 1:] != segment_ids[..., :-1],
+        ],
+        axis=-1,
+    )
+    start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(change, idx, 0), axis=-1
+    )
+    return idx - start
 
 
 def forward_hidden(
@@ -302,14 +334,25 @@ def forward_hidden(
     *,
     attn_impl: str = "auto",
     mesh=None,
+    segment_ids=None,
 ) -> tuple:
-    """tokens [B, S] -> (final-norm hidden [B, S, D], aux dict)."""
+    """tokens [B, S] -> (final-norm hidden [B, S, D], aux dict).
+
+    ``segment_ids`` [B, S] enables packed-sequence training: attention is
+    restricted to same-segment pairs (flash-kernel mask) and rope
+    positions reset at each segment boundary."""
     B, S = tokens.shape
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens]
-    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    if segment_ids is not None:
+        positions = segment_positions(segment_ids)
+    else:
+        positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     moe_aux = jnp.zeros((), jnp.float32)
-    apply = functools.partial(block_apply, attn_impl=attn_impl, mesh=mesh)
+    apply = functools.partial(
+        block_apply, attn_impl=attn_impl, mesh=mesh,
+        segment_ids=segment_ids,
+    )
     if cfg.remat_block:
         apply = jax.checkpoint(apply, static_argnums=(2,))
     for layer in params["layers"]:
@@ -326,10 +369,12 @@ def forward(
     *,
     attn_impl: str = "auto",
     mesh=None,
+    segment_ids=None,
 ) -> tuple:
     """tokens [B, S] -> (logits [B, S, vocab] fp32, aux dict)."""
     x, aux = forward_hidden(
-        params, tokens, cfg, attn_impl=attn_impl, mesh=mesh
+        params, tokens, cfg, attn_impl=attn_impl, mesh=mesh,
+        segment_ids=segment_ids,
     )
     logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
     return logits, aux
@@ -361,23 +406,53 @@ def loss_fn(
 ) -> jax.Array:
     """Next-token loss.  ``fused_lm_head`` (default: auto — on for large
     vocabs) routes the projection through the chunked fused lm-head
-    cross-entropy so the [B, S, vocab] logits never hit HBM."""
+    cross-entropy so the [B, S, vocab] logits never hit HBM.  A
+    ``batch["segment_ids"]`` entry ([B, S] or [B, S+1] matching tokens)
+    enables packed-sequence training."""
     tokens, targets = split_batch(batch)
+    seg_full = batch.get("segment_ids")
+    seg = valid = None
+    if seg_full is not None:
+        S = tokens.shape[-1]
+        if seg_full.shape[-1] == S + 1:
+            seg = seg_full[:, :-1]  # align with the input tokens
+            # A position's target is the NEXT token: drop pairs that
+            # cross a packed-sequence boundary.
+            valid = (seg_full[:, 1:] == seg_full[:, :-1]).astype(
+                jnp.float32
+            )
+        else:
+            seg = seg_full
+            # [B, S] form can't see the target of the LAST position (it
+            # lives at S, outside this view) — mask it conservatively;
+            # pass the [B, S+1] form to keep that token's loss.
+            valid = jnp.concatenate(
+                [
+                    (seg[:, 1:] == seg[:, :-1]).astype(jnp.float32),
+                    jnp.zeros(seg.shape[:-1] + (1,), jnp.float32),
+                ],
+                axis=-1,
+            )
     if fused_lm_head is None:
         fused_lm_head = uses_fused_lm_head(cfg)
     if fused_lm_head:
         x, aux = forward_hidden(
-            params, tokens, cfg, attn_impl=attn_impl, mesh=mesh
+            params, tokens, cfg, attn_impl=attn_impl, mesh=mesh,
+            segment_ids=seg,
         )
         per_tok = linear_softmax_cross_entropy(
             x, params["lm_head"].astype(cfg.dtype), targets
         )
-        ce = jnp.mean(per_tok)
     else:
         logits, aux = forward(
-            params, tokens, cfg, attn_impl=attn_impl, mesh=mesh
+            params, tokens, cfg, attn_impl=attn_impl, mesh=mesh,
+            segment_ids=seg,
         )
-        ce = jnp.mean(softmax_cross_entropy(logits, targets))
+        per_tok = softmax_cross_entropy(logits, targets)
+    if valid is not None:
+        ce = jnp.sum(per_tok * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+    else:
+        ce = jnp.mean(per_tok)
     return ce + moe_aux_weight * aux["moe_aux"]
 
 

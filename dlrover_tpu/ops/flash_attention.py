@@ -35,9 +35,14 @@ NEG_INF = -1e30
 
 
 def reference_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """[B,H,S,D] attention in fp32 accumulation."""
+    """[B,H,S,D] attention in fp32 accumulation.  ``segment_ids`` [B,S]
+    restricts attention to same-segment pairs (packed sequences)."""
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
@@ -46,6 +51,11 @@ def reference_attention(
         Sq, Sk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((Sq, Sk), bool), Sk - Sq)
         s = jnp.where(mask, s, NEG_INF)
+    if segment_ids is not None:
+        seg = (
+            segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        )  # [B, 1, Sq, Sk]
+        s = jnp.where(seg, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(
         q.dtype
@@ -57,13 +67,18 @@ def reference_attention(
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
-                sm_scale, seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal,
+                sm_scale, seq_len, segmented=False):
     from jax.experimental import pallas as pl
 
     # Blocks carry a leading unit (batch*head) dim:
     # q_ref: [1, block_q, D]; k_ref/v_ref: [1, S, D]; o_ref: [1, block_q, D];
-    # lse_ref: [1, block_q, 128] (lane-padded).
+    # lse_ref: [1, 1, block_q]; segmented adds seg_ref: [1, 1, S_pad] int32.
+    if segmented:
+        seg_ref, o_ref, lse_ref = rest
+    else:
+        seg_ref = None
+        o_ref, lse_ref = rest
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
     qi = pl.program_id(1)
@@ -99,6 +114,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(qpos >= kpos, s, NEG_INF)
+        if segmented:
+            seg_q = seg_ref[0, 0, pl.ds(q_start, block_q)]
+            seg_k = seg_ref[0, 0, pl.ds(k_start, block_k)]
+            s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
         # Mask K padding beyond seq_len.
         kpos2 = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
@@ -136,7 +155,19 @@ def _block_sizes(S: int, block_q: int, block_k: int):
     return block_q, block_k, S_pad
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _seg3(segment_ids, S, S_pad):
+    """[B, S] segment ids -> [B, 1, S_pad] int32, padding = -1 (matches
+    no real segment, so padded positions are always masked).  Kept one
+    row per BATCH — the grid's b axis covers B*H programs, so the seg
+    BlockSpec index map divides by H instead of materializing H copies."""
+    seg = segment_ids.astype(jnp.int32)
+    if S_pad != S:
+        seg = jnp.pad(seg, [(0, 0), (0, S_pad - S)], constant_values=-1)
+    return seg[:, None, :]
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
+               segment_ids=None):
     from jax.experimental import pallas as pl
 
     B, H, S, D = q.shape
@@ -154,18 +185,26 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     k3 = k.reshape(B * H, S_pad, D)
     v3 = v.reshape(B * H, S_pad, D)
 
+    segmented = segment_ids is not None
     kernel = functools.partial(
         _fwd_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale,
-        seq_len=S,
+        seq_len=S, segmented=segmented,
     )
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
+    ]
+    inputs = [q3, k3, v3]
+    if segmented:
+        in_specs.append(
+            pl.BlockSpec((1, 1, S_pad), lambda b, i: (b // H, 0, 0))
+        )
+        inputs.append(_seg3(segment_ids, S, S_pad))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
@@ -175,7 +214,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((B * H, 1, S_pad), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3)
+    )(*inputs)
     return (
         out.reshape(B, H, S_pad, D)[:, :, :S],
         lse.reshape(B, H, S_pad)[:, :, :S],
@@ -196,12 +235,18 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, *,
-                   block_k, causal, sm_scale, seq_len, padded_len):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
+                   block_k, causal, sm_scale, seq_len, padded_len,
+                   segmented=False):
     from jax.experimental import pallas as pl
 
     # q_ref/g_ref/dq_ref: [1, block_q, D]; k_ref/v_ref: [1, S_pad, D];
-    # lse_ref/delta_ref: [1, 1, block_q].
+    # lse_ref/delta_ref: [1, 1, block_q]; seg_ref: [1, 1, S_pad] int32.
+    if segmented:
+        seg_ref, dq_ref = rest
+    else:
+        seg_ref = None
+        (dq_ref,) = rest
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
     qi = pl.program_id(1)
@@ -234,6 +279,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, *,
                 jnp.int32, (block_q, block_k), 0
             )
             s = jnp.where(qpos >= kpos, s, NEG_INF)
+        if segmented:
+            seg_q = seg_ref[0, 0, pl.ds(q_start, block_q)]
+            seg_k = seg_ref[0, 0, pl.ds(k_start, block_k)]
+            s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
         p = jnp.exp(s - lse[:, None])  # masked entries -> exp(-inf) = 0
         dp = jax.lax.dot_general(
             g, vb, (((1,), (1,)), ((), ())),
@@ -252,12 +301,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, *,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_q, causal, sm_scale, seq_len,
-                    padded_len):
+                    *rest, block_q, causal, sm_scale, seq_len,
+                    padded_len, segmented=False):
     from jax.experimental import pallas as pl
 
     # k_ref/v_ref/dk_ref/dv_ref: [1, block_k, D]; q_ref/g_ref: [1, S_pad, D];
-    # lse_ref/delta_ref: [1, 1, S_pad].
+    # lse_ref/delta_ref: [1, 1, S_pad]; seg_ref: [1, 1, S_pad] int32.
+    if segmented:
+        seg_ref, dk_ref, dv_ref = rest
+    else:
+        seg_ref = None
+        dk_ref, dv_ref = rest
     block_k = k_ref.shape[1]
     d = k_ref.shape[2]
     ki = pl.program_id(1)
@@ -291,6 +345,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         s = jnp.where(kpos < seq_len, s, NEG_INF)
         if causal:
             s = jnp.where(qpos >= kpos, s, NEG_INF)
+        if segmented:
+            seg_q = seg_ref[0, 0, pl.ds(q_start, block_q)]
+            seg_k = seg_ref[0, 0, pl.ds(k_start, block_k)]
+            s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
         p = jnp.exp(s - lse_b[:, None])
         dv_acc = dv_acc + jax.lax.dot_general(
             p, gb, (((0,), (0,)), ((), ())),
@@ -316,7 +374,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
-                      interpret):
+                      interpret, segment_ids=None):
     from jax.experimental import pallas as pl
 
     B, H, S, D = q.shape
@@ -336,10 +394,20 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
     lse2 = lse.reshape(B * H, 1, S_pad).astype(jnp.float32)
     delta2 = delta.reshape(B * H, 1, S_pad)
 
+    segmented = segment_ids is not None
+    common = [q3, k3, v3, g3, lse2, delta2]
+    seg_spec = []
+    if segmented:
+        common.append(_seg3(segment_ids, S, S_pad))
+        seg_spec = [
+            pl.BlockSpec((1, 1, S_pad), lambda b, i: (b // H, 0, 0))
+        ]
+
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, block_k=block_k, causal=causal,
             sm_scale=sm_scale, seq_len=S, padded_len=S_pad,
+            segmented=segmented,
         ),
         grid=(B * H, pl.cdiv(S_pad, block_q)),
         in_specs=[
@@ -349,16 +417,17 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
             pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-        ],
+        ] + seg_spec,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, S_pad, D), q.dtype),
         interpret=interpret,
-    )(q3, k3, v3, g3, lse2, delta2)
+    )(*common)
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, block_q=block_q, causal=causal,
             sm_scale=sm_scale, seq_len=S, padded_len=S_pad,
+            segmented=segmented,
         ),
         grid=(B * H, pl.cdiv(S_pad, block_k)),
         in_specs=[
@@ -368,7 +437,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
             pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, 1, S_pad), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, 1, S_pad), lambda b, i: (b, 0, 0)),
-        ],
+        ] + seg_spec,
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
@@ -378,7 +447,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
             jax.ShapeDtypeStruct((B * H, S_pad, D), v.dtype),
         ],
         interpret=interpret,
-    )(q3, k3, v3, g3, lse2, delta2)
+    )(*common)
 
     return (
         dq.reshape(B, H, S_pad, D)[:, :, :S],
@@ -448,12 +517,48 @@ def _bwd_rule(causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret,
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)
 
 
+# Segmented (packed-sequence) variant: segment_ids is a traced arg whose
+# cotangent is None.  Separate from the dense path so the unsegmented
+# kernels stay byte-identical (no dead mask ops on the hot path).
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
+)
+def _flash_attention_seg(q, k, v, seg, causal, block_q, block_k,
+                         bwd_block_q, bwd_block_k, interpret):
+    out, _ = _flash_fwd(
+        q, k, v, causal, block_q, block_k, interpret, segment_ids=seg
+    )
+    return out
+
+
+def _seg_fwd_rule(q, k, v, seg, causal, block_q, block_k, bwd_block_q,
+                  bwd_block_k, interpret):
+    out, lse = _flash_fwd(
+        q, k, v, causal, block_q, block_k, interpret, segment_ids=seg
+    )
+    return out, (q, k, v, seg, out, lse)
+
+
+def _seg_bwd_rule(causal, block_q, block_k, bwd_block_q, bwd_block_k,
+                  interpret, res, g):
+    q, k, v, seg, out, lse = res
+    dq, dk, dv = _flash_bwd_pallas(
+        q, k, v, out, lse, g, causal, bwd_block_q, bwd_block_k, interpret,
+        segment_ids=seg,
+    )
+    return dq, dk, dv, None
+
+
+_flash_attention_seg.defvjp(_seg_fwd_rule, _seg_bwd_rule)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     *,
     causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,  # [B, S] packed sequences
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     bwd_block_q: int = DEFAULT_BWD_BLOCK_Q,
@@ -463,12 +568,22 @@ def flash_attention(
 ) -> jax.Array:
     """[B, H, S, D] flash attention.
 
+    ``segment_ids`` [B, S] restricts attention to same-segment pairs —
+    packed-sequence training (the reference's pack-mask flash-attn
+    variants, ``flash_attn_func_ext.py`` GLM/pack masks) without
+    materializing the mask.
+
     auto backend: Pallas on TPU, jnp reference elsewhere (XLA fuses it
     acceptably on CPU; the Pallas path is the production TPU path).
     """
     if backend is None:
         backend = "pallas" if jax.default_backend() == "tpu" else "reference"
     if backend == "reference":
-        return reference_attention(q, k, v, causal)
+        return reference_attention(q, k, v, causal, segment_ids)
+    if segment_ids is not None:
+        return _flash_attention_seg(
+            q, k, v, segment_ids, causal, block_q, block_k, bwd_block_q,
+            bwd_block_k, interpret,
+        )
     return _flash_attention(q, k, v, causal, block_q, block_k, bwd_block_q,
                             bwd_block_k, interpret)

@@ -52,17 +52,26 @@ _BCAST_BYTES = 1024  # fixed blob size for leader->all strategy broadcast
 
 def _bcast_blob(payload_bytes: Optional[bytes]) -> bytes:
     """Leader ships a small blob to every process; one fixed-size
-    zero-padded buffer so the collective's shape is process-uniform."""
+    zero-padded buffer so the collective's shape is process-uniform.
+
+    An oversize payload degrades to broadcasting a miss (empty blob) —
+    raising on the leader alone would leave the other processes blocked
+    in the collective (a distributed hang, far worse than a cache miss).
+    """
     from jax.experimental import multihost_utils
 
     buf = np.zeros(_BCAST_BYTES, np.uint8)
     if payload_bytes:
         if len(payload_bytes) > _BCAST_BYTES:
-            raise ValueError(
-                f"strategy blob {len(payload_bytes)}B exceeds the "
-                f"{_BCAST_BYTES}B broadcast buffer"
+            logger.warning(
+                "strategy blob %dB exceeds the %dB broadcast buffer; "
+                "treating as a cache miss",
+                len(payload_bytes), _BCAST_BYTES,
             )
-        buf[: len(payload_bytes)] = np.frombuffer(payload_bytes, np.uint8)
+        else:
+            buf[: len(payload_bytes)] = np.frombuffer(
+                payload_bytes, np.uint8
+            )
     got = np.asarray(multihost_utils.broadcast_one_to_all(buf))
     return bytes(got.tobytes()).rstrip(b"\x00")
 
