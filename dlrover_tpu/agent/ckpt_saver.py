@@ -245,8 +245,7 @@ class AsyncCheckpointSaver:
         pid = int(event.get("process_id", lr))
         nproc_global = int(event.get("num_processes", self.nproc))
         ckpt_dir = event["ckpt_dir"]
-        mk = event.get("max_to_keep")
-        keep_last = 3 if mk is None else int(mk)  # 0 = keep all
+        keep_last = shard_file.resolve_keep_last(event.get("max_to_keep"))
         lock = self._locks[lr] if lr < len(self._locks) else None
         if lock is not None and not lock.acquire(timeout=60.0):
             logger.warning("saver: lock for rank %d busy; skipping", lr)

@@ -197,8 +197,8 @@ class CheckpointEngine:
             ):
                 shard_file.commit(
                     self.storage, self.ckpt_dir, step,
-                    keep_last=(
-                        3 if self.max_to_keep is None else self.max_to_keep
+                    keep_last=shard_file.resolve_keep_last(
+                        self.max_to_keep
                     ),
                 )
                 return True

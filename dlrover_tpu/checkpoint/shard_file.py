@@ -132,6 +132,12 @@ def all_shards_done(
     )
 
 
+def resolve_keep_last(max_to_keep) -> int:
+    """One home for the rotation contract: ``None`` -> default (keep 3),
+    ``0`` -> keep ALL step dirs, ``N > 0`` -> keep the newest N."""
+    return 3 if max_to_keep is None else int(max_to_keep)
+
+
 def commit(
     storage: CheckpointStorage, ckpt_dir: str, step: int, keep_last: int = 3
 ) -> None:

@@ -81,31 +81,53 @@ class DiagnosisManager:
 
     BROADCAST_TTL_S = 300.0
 
+    def enqueue_broadcast(
+        self, action_type: str, reason: str, node_ids
+    ) -> None:
+        """Queue an action for each of ``node_ids``' next heartbeats (the
+        master-initiated path — e.g. a peer died, survivors must rebuild
+        the collective world now rather than wait out its timeout).
+
+        Fan-out happens HERE, scoped to the nodes alive at enqueue time:
+        a node that joins later never inherits the stale instruction, a
+        repeat failure re-queues cleanly once the prior entries were
+        delivered, and every reply carries its own action object (no
+        master-internal bookkeeping leaks into RPC payloads)."""
+        now = time.time()
+        queued = 0
+        with self._lock:
+            for nid in node_ids:
+                existing = self._pending.setdefault(nid, [])
+                if any(
+                    e.action_type == action_type and e.reason == reason
+                    for e in existing
+                ):
+                    continue  # this node already has the instruction
+                existing.append(
+                    m.DiagnosisAction(
+                        action_type=action_type, reason=reason,
+                        payload={"created": now},
+                    )
+                )
+                queued += 1
+        if queued:
+            logger.info(
+                "diagnosis: broadcast %s to %d node(s) (%s)",
+                action_type, queued, reason,
+            )
+
     def pop_actions(self, node_id: int) -> List[m.DiagnosisAction]:
-        """Actions for ``node_id`` (+ broadcast actions under node -1),
-        consumed on delivery (reference heartbeat-reply piggyback).
-        Broadcasts go to each node at most once and expire after
-        ``BROADCAST_TTL_S`` so a one-off diagnosis can't restart-loop the
-        job forever."""
+        """Actions for ``node_id``, consumed on delivery (reference
+        heartbeat-reply piggyback).  Entries older than
+        ``BROADCAST_TTL_S`` are dropped — a node that was unreachable for
+        minutes must not be restarted by a long-resolved incident."""
         now = time.time()
         with self._lock:
-            out = self._pending.pop(node_id, [])
-            broadcast = self._pending.get(-1, [])
-            keep = []
-            for act in broadcast:
-                if now - act.payload.get("created", 0.0) >= (
-                    self.BROADCAST_TTL_S
-                ):
-                    continue  # expired: neither kept nor delivered
-                keep.append(act)
-                seen = act.payload.setdefault("delivered", [])
-                if node_id not in seen:
-                    seen.append(node_id)
-                    out.append(act)
-            if keep:
-                self._pending[-1] = keep
-            else:
-                self._pending.pop(-1, None)
+            out = [
+                a for a in self._pending.pop(node_id, [])
+                if now - a.payload.get("created", now)
+                < self.BROADCAST_TTL_S
+            ]
         return out
 
     # -- pre-check (reference pre_check stub) ------------------------------

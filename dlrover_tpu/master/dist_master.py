@@ -93,12 +93,21 @@ class DistributedJobMaster(JobMaster):
                 workers.max_count,
                 node_unit=job_args.node_unit,
             )
+        from dlrover_tpu.diagnosis.manager import DiagnosisManager
+        from dlrover_tpu.master.strategy_generator import (
+            SimpleStrategyGenerator,
+        )
+
+        self.diagnosis_manager = DiagnosisManager(
+            self.speed_monitor, hang_timeout_s=self._ctx.hang_timeout_s
+        )
         self.job_manager.add_node_event_callback(
             TaskRescheduleCallback(self.task_manager)
         )
         self.job_manager.add_node_event_callback(
             AllReduceNodeHandlingCallback(
-                self.rdzv_managers, self.speed_monitor
+                self.rdzv_managers, self.speed_monitor,
+                diagnosis_manager=self.diagnosis_manager,
             )
         )
         self.job_manager.on_critical_failure = lambda node: self.request_stop(
@@ -109,14 +118,6 @@ class DistributedJobMaster(JobMaster):
             self.job_manager,
             self.speed_monitor,
             self.resource_optimizer,
-        )
-        from dlrover_tpu.diagnosis.manager import DiagnosisManager
-        from dlrover_tpu.master.strategy_generator import (
-            SimpleStrategyGenerator,
-        )
-
-        self.diagnosis_manager = DiagnosisManager(
-            self.speed_monitor, hang_timeout_s=self._ctx.hang_timeout_s
         )
         self.strategy_generator = SimpleStrategyGenerator(
             self.job_manager, self.speed_monitor

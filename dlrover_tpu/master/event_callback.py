@@ -61,9 +61,11 @@ class AllReduceNodeHandlingCallback(NodeEventCallback):
         self,
         rdzv_managers: dict,
         speed_monitor: "SpeedMonitor",
+        diagnosis_manager=None,
     ):
         self._rdzv_managers = rdzv_managers
         self._speed_monitor = speed_monitor
+        self._diagnosis = diagnosis_manager
 
     def on_node_started(self, node: Node) -> None:
         for mgr in self._rdzv_managers.values():
@@ -77,6 +79,21 @@ class AllReduceNodeHandlingCallback(NodeEventCallback):
         for mgr in self._rdzv_managers.values():
             mgr.remove_alive_node(node.id)
         self._speed_monitor.mark_down()
+        # Survivors are hung in collectives with the dead peer; tell them
+        # to rebuild the world NOW instead of waiting out the runtime's
+        # own timeout (minutes).  Fan out to the nodes alive right now —
+        # every rank must rebuild for the next rendezvous round anyway.
+        if self._diagnosis is not None:
+            from dlrover_tpu.common.constants import DiagnosisActionType
+            from dlrover_tpu.common.constants import RendezvousName
+
+            mgr = self._rdzv_managers.get(RendezvousName.TRAINING)
+            survivors = mgr.alive_nodes() if mgr else []
+            self._diagnosis.enqueue_broadcast(
+                DiagnosisActionType.RESTART_WORKER,
+                f"peer node {node.id} failed; rebuild the world",
+                survivors,
+            )
 
     def on_node_deleted(self, node: Node) -> None:
         self.on_node_failed(node)

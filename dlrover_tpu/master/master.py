@@ -96,6 +96,27 @@ class LocalJobMaster(JobMaster):
             self.job_manager, self.speed_monitor
         )
 
+        def _on_node_dead(node):
+            # Same contract as AllReduceNodeHandlingCallback.on_node_failed
+            # on the distributed master: drop the dead node from the next
+            # rendezvous round and tell the hung survivors to rebuild the
+            # world now instead of waiting out the collective's timeout.
+            from dlrover_tpu.common.constants import DiagnosisActionType
+
+            for mgr in self.rdzv_managers.values():
+                mgr.remove_alive_node(node.id)
+            self.speed_monitor.mark_down()
+            survivors = self.rdzv_managers[
+                RendezvousName.TRAINING
+            ].alive_nodes()
+            self.diagnosis_manager.enqueue_broadcast(
+                DiagnosisActionType.RESTART_WORKER,
+                f"peer node {node.id} failed; rebuild the world",
+                survivors,
+            )
+
+        self.job_manager.on_node_dead = _on_node_dead
+
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             job_manager=self.job_manager,

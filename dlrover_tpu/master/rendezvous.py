@@ -77,6 +77,10 @@ class RendezvousManager:
             if node_id in self._waiting_nodes:
                 del self._waiting_nodes[node_id]
 
+    def alive_nodes(self) -> list:
+        with self._lock:
+            return sorted(self._alive_nodes)
+
     # -- agent-facing ------------------------------------------------------
     def join(
         self,

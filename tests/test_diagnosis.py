@@ -266,3 +266,63 @@ class TestStrategyGenerator:
         cfg = gen.generate_config()
         assert cfg.dataloader["num_workers"] == 1
         assert cfg.version == 1
+
+
+class TestBroadcastActions:
+    def _mgr(self):
+        from dlrover_tpu.diagnosis.manager import DiagnosisManager
+        from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+        return DiagnosisManager(SpeedMonitor())
+
+    def test_fanout_scoped_to_named_nodes(self):
+        """Only nodes alive at enqueue time receive the instruction — a
+        later joiner must NOT inherit it."""
+        mgr = self._mgr()
+        mgr.enqueue_broadcast("restart_worker", "peer 2 failed", [0, 1])
+        a0 = mgr.pop_actions(0)
+        assert [a.action_type for a in a0] == ["restart_worker"]
+        # Delivery consumed it; no repeat on the next heartbeat.
+        assert mgr.pop_actions(0) == []
+        # Node 5 joined after the incident: nothing for it.
+        assert mgr.pop_actions(5) == []
+        # Node 1 still gets its own copy.
+        assert [a.action_type for a in mgr.pop_actions(1)] == [
+            "restart_worker"
+        ]
+
+    def test_repeat_failure_requeues_after_delivery(self):
+        mgr = self._mgr()
+        mgr.enqueue_broadcast("restart_worker", "peer 2 failed", [0])
+        assert len(mgr.pop_actions(0)) == 1
+        # Second incident with the SAME reason after delivery: re-queued.
+        mgr.enqueue_broadcast("restart_worker", "peer 2 failed", [0])
+        assert len(mgr.pop_actions(0)) == 1
+
+    def test_pending_duplicate_not_double_queued(self):
+        mgr = self._mgr()
+        mgr.enqueue_broadcast("restart_worker", "peer 2 failed", [0])
+        mgr.enqueue_broadcast("restart_worker", "peer 2 failed", [0])
+        assert len(mgr.pop_actions(0)) == 1
+
+    def test_stale_action_expires(self, monkeypatch):
+        import time as _time
+
+        mgr = self._mgr()
+        mgr.enqueue_broadcast("restart_worker", "old incident", [0])
+        real = _time.time
+        monkeypatch.setattr(
+            "dlrover_tpu.diagnosis.manager.time.time",
+            lambda: real() + mgr.BROADCAST_TTL_S + 1,
+        )
+        # The node was unreachable past the TTL: must not be restarted
+        # by a long-resolved incident.
+        assert mgr.pop_actions(0) == []
+
+    def test_payload_is_private_per_node(self):
+        mgr = self._mgr()
+        mgr.enqueue_broadcast("restart_worker", "peer failed", [0, 1])
+        a0 = mgr.pop_actions(0)[0]
+        a1 = mgr.pop_actions(1)[0]
+        assert a0 is not a1  # no shared mutable object across replies
+        assert "delivered" not in a0.payload

@@ -292,3 +292,231 @@ class TestMultiNode:
                 except subprocess.TimeoutExpired:
                     p.kill()
                     p.wait()
+
+
+@pytest.mark.e2e
+class TestScaleUp:
+    def test_node_join_grows_world(self, tmp_path):
+        """Elastic scale-UP: training starts with one node (min_nodes=1),
+        a second node joins mid-run, the master's waiting-list triggers a
+        membership change, and training resumes as a 2-process world from
+        the flash checkpoint (the allreduce auto-scaler's grow path,
+        end-to-end)."""
+        job = "e2e-scaleup"
+        port = _free_port()
+        ckpt = str(tmp_path / "ckpt")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        mlog_f = open(tmp_path / "master.log", "w")
+        mproc = subprocess.Popen(
+            [
+                sys.executable, "-m", "dlrover_tpu.master.main",
+                f"--port={port}", f"--job_name={job}",
+                "--min_nodes=1", "--max_nodes=2",
+            ],
+            cwd=REPO, env=env, stdout=mlog_f, stderr=subprocess.STDOUT,
+        )
+        mlog = tmp_path / "master.log"
+
+        def start_node(rank):
+            nenv = dict(os.environ)
+            nenv.update(
+                {
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                    "PYTHONPATH": REPO,
+                }
+            )
+            log = open(tmp_path / f"node{rank}.log", "w")
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "dlrover_tpu.run",
+                    "--nnodes=1:2", "--nproc_per_node=1",
+                    f"--node_rank={rank}",
+                    f"--master_addr=127.0.0.1:{port}",
+                    f"--job_name={job}", "--monitor_interval=1",
+                    os.path.join(REPO, "examples", "nanogpt_train.py"),
+                    # Big enough that the solo phase can't finish before
+                    # the join (tiny nanogpt is ~ms/step on CPU).
+                    "--", "--steps=100000", f"--ckpt_dir={ckpt}",
+                    "--ckpt_interval=3", "--batch_per_proc=8",
+                    "--seq_len=64",
+                ],
+                cwd=REPO, env=nenv, stdout=log, stderr=subprocess.STDOUT,
+            )
+            return proc, tmp_path / f"node{rank}.log"
+
+        n0, log0 = start_node(0)
+        procs = [mproc, n0]
+        try:
+            # Phase 1: single-node world training.
+            deadline = time.time() + 420
+            while time.time() < deadline:
+                c0 = _read(log0) if os.path.exists(log0) else ""
+                # A world of 1 skips jax.distributed init; the agent's
+                # rendezvous log carries the world size instead.
+                if (
+                    "world=1 nodes" in c0
+                    and re.search(r"step (1[0-9]|[2-9][0-9]) loss", c0)
+                ):
+                    break
+                if n0.poll() is not None or mproc.poll() is not None:
+                    pytest.fail("early exit:\n" + c0[-3000:]
+                                + _read(mlog)[-1500:])
+                time.sleep(1.0)
+            else:
+                pytest.fail("node0 never trained solo:\n"
+                            + _read(log0)[-3000:])
+
+            # Phase 2: node 1 joins mid-run.
+            n1, log1 = start_node(1)
+            procs.append(n1)
+            grown = False
+            deadline = time.time() + 420
+            while time.time() < deadline:
+                c0 = _read(log0)
+                c1 = _read(log1) if os.path.exists(log1) else ""
+                if (
+                    "jax.distributed up: process 0/2" in c0
+                    and "jax.distributed up: process 1/2" in c1
+                    and re.search(r"restored step=\d+", c0)
+                    and re.search(r"step \d+ loss", c1)
+                ):
+                    grown = True
+                    break
+                for p, nm in ((mproc, "master"), (n0, "node0"),
+                              (n1, "node1")):
+                    if p.poll() is not None:
+                        pytest.fail(f"{nm} died during scale-up:\n"
+                                    + c0[-2000:] + c1[-2000:])
+                time.sleep(1.0)
+            assert grown, (
+                "world never grew to 2:\nnode0:\n" + _read(log0)[-2500:]
+                + "\nnode1:\n" + (_read(log1) if os.path.exists(log1)
+                                  else "")[-2500:]
+            )
+            # The restore carried training state across the resize.
+            step = int(re.search(r"restored step=(\d+)",
+                                 _read(log0)).group(1))
+            assert step >= 3
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
+
+@pytest.mark.e2e
+class TestScaleDown:
+    def test_node_loss_shrinks_world(self, tmp_path):
+        """Elastic scale-DOWN: two nodes train; one dies and is NOT
+        replaced; with min_nodes=1 the survivor must re-rendezvous as a
+        1-node world and keep training from the checkpoint."""
+        job = "e2e-scaledown"
+        port = _free_port()
+        ckpt = str(tmp_path / "ckpt")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        # Fast failure detection so the test (and recovery) is snappy:
+        # master declares a silent node dead after 20s of missed
+        # heartbeats and broadcasts RESTART_WORKER to the survivors.
+        env["DLROVER_TPU_NODE_HEARTBEAT_TIMEOUT"] = "20"
+        mproc = subprocess.Popen(
+            [
+                sys.executable, "-m", "dlrover_tpu.master.main",
+                f"--port={port}", f"--job_name={job}",
+                "--min_nodes=1", "--max_nodes=2",
+            ],
+            cwd=REPO, env=env,
+            stdout=open(tmp_path / "master.log", "w"),
+            stderr=subprocess.STDOUT,
+        )
+
+        def start_node(rank):
+            nenv = dict(os.environ)
+            nenv.update(
+                {
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                    "PYTHONPATH": REPO,
+                }
+            )
+            log = open(tmp_path / f"node{rank}.log", "w")
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "dlrover_tpu.run",
+                    "--nnodes=1:2", "--nproc_per_node=1",
+                    f"--node_rank={rank}",
+                    f"--master_addr=127.0.0.1:{port}",
+                    f"--job_name={job}", "--monitor_interval=1",
+                    os.path.join(REPO, "examples", "nanogpt_train.py"),
+                    "--", "--steps=100000", f"--ckpt_dir={ckpt}",
+                    "--ckpt_interval=3", "--batch_per_proc=8",
+                    "--seq_len=64",
+                ],
+                cwd=REPO, env=nenv, stdout=log, stderr=subprocess.STDOUT,
+                start_new_session=True,  # killpg must take the whole node
+            )
+            return proc, tmp_path / f"node{rank}.log"
+
+        n0, log0 = start_node(0)
+        n1, log1 = start_node(1)
+        procs = [mproc, n0, n1]
+        try:
+            deadline = time.time() + 420
+            while time.time() < deadline:
+                c0 = _read(log0) if os.path.exists(log0) else ""
+                if (
+                    "jax.distributed up: process 0/2" in c0
+                    and re.search(r"step (1[0-9]|[2-9][0-9]) loss", c0)
+                ):
+                    break
+                for p, nm in ((mproc, "master"), (n0, "node0"),
+                              (n1, "node1")):
+                    if p.poll() is not None:
+                        pytest.fail(f"{nm} exited early:\n" + c0[-3000:])
+                time.sleep(1.0)
+            else:
+                pytest.fail("2-node world never trained:\n"
+                            + _read(log0)[-3000:])
+
+            # Node 1 is gone for good (spot preemption): kill its WHOLE
+            # process group — agent and workers — so nothing lingers.
+            os.killpg(os.getpgid(n1.pid), signal.SIGKILL)
+            n1.wait(timeout=30)
+
+            shrunk = False
+            deadline = time.time() + 420
+            while time.time() < deadline:
+                c0 = _read(log0)
+                # After the failure round the survivor re-forms a world
+                # of 1 and keeps stepping (restore from shm/storage).
+                tail = c0.split("jax.distributed up: process 0/2")[-1]
+                if (
+                    "world=1 nodes" in tail
+                    and re.search(r"restored step=\d+", tail)
+                    and re.search(r"step \d+ loss", tail)
+                ):
+                    shrunk = True
+                    break
+                if n0.poll() is not None or mproc.poll() is not None:
+                    pytest.fail("survivor/master died:\n" + c0[-3000:])
+                time.sleep(1.0)
+            assert shrunk, (
+                "world never shrank to 1:\n" + _read(log0)[-3000:]
+            )
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
