@@ -39,7 +39,9 @@ class DiagnosisManager:
         speed_monitor=None,
         interval_s: float = 60.0,
         hang_timeout_s: float = 1800.0,
+        alive_nodes_fn=None,  # () -> node ids; expands whole-job actions
     ):
+        self.alive_nodes_fn = alive_nodes_fn
         # TTL must exceed the hang timeout or per-node stall detection can
         # never fire: a stalled node's records would expire before the
         # stall becomes diagnosable.
@@ -97,7 +99,14 @@ class DiagnosisManager:
         queued = 0
         with self._lock:
             for nid in node_ids:
-                existing = self._pending.setdefault(nid, [])
+                # Expired-but-undelivered entries must not mask a FRESH
+                # incident with the same reason: purge them first.
+                existing = [
+                    e for e in self._pending.get(nid, [])
+                    if now - e.payload.get("created", now)
+                    < self.BROADCAST_TTL_S
+                ]
+                self._pending[nid] = existing
                 if any(
                     e.action_type == action_type and e.reason == reason
                     for e in existing
@@ -172,18 +181,37 @@ class DiagnosisManager:
             for key, ts in list(self._delivered.items()):
                 if now - ts > self._redeliver_cooldown_s:
                     del self._delivered[key]
+            whole_job: List[tuple] = []
             for nid, acts in actions.items():
-                existing = self._pending.setdefault(nid, [])
                 for act in acts:
+                    # Cooldown keys on the DIAGNOSED scope (a whole-job
+                    # incident is one incident, however many nodes it
+                    # fans out to below).
                     key = (nid, act.action_type, act.reason)
                     if key in self._delivered:
                         continue  # already acted on this record
+                    act.payload.setdefault("created", now)
+                    if nid == -1:
+                        # Whole-job diagnosis (e.g. global hang): fan out
+                        # to every currently-alive node outside the lock.
+                        whole_job.append((act.action_type, act.reason))
+                        self._delivered[key] = now
+                        continue
+                    existing = self._pending.setdefault(nid, [])
                     if not any(
                         e.action_type == act.action_type
                         and e.reason == act.reason
                         for e in existing
                     ):
-                        act.payload.setdefault("created", now)
                         existing.append(act)
                         self._delivered[key] = now
+        for action_type, reason in whole_job:
+            targets = self.alive_nodes_fn() if self.alive_nodes_fn else []
+            if targets:
+                self.enqueue_broadcast(action_type, reason, targets)
+            else:
+                logger.warning(
+                    "whole-job action %s (%s) has no alive-nodes source; "
+                    "dropping", action_type, reason,
+                )
         return actions

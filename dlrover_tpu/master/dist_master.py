@@ -99,7 +99,10 @@ class DistributedJobMaster(JobMaster):
         )
 
         self.diagnosis_manager = DiagnosisManager(
-            self.speed_monitor, hang_timeout_s=self._ctx.hang_timeout_s
+            self.speed_monitor, hang_timeout_s=self._ctx.hang_timeout_s,
+            alive_nodes_fn=self.rdzv_managers[
+                RendezvousName.TRAINING
+            ].alive_nodes,
         )
         self.job_manager.add_node_event_callback(
             TaskRescheduleCallback(self.task_manager)

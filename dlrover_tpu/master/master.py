@@ -90,32 +90,24 @@ class LocalJobMaster(JobMaster):
         )
 
         self.diagnosis_manager = DiagnosisManager(
-            self.speed_monitor, hang_timeout_s=self._ctx.hang_timeout_s
+            self.speed_monitor, hang_timeout_s=self._ctx.hang_timeout_s,
+            alive_nodes_fn=self.rdzv_managers[
+                RendezvousName.TRAINING
+            ].alive_nodes,
         )
         self.strategy_generator = SimpleStrategyGenerator(
             self.job_manager, self.speed_monitor
         )
+        # Same dead-peer sequence as the distributed master: one
+        # implementation, wired as the heartbeat-timeout hook.
+        from dlrover_tpu.master.event_callback import (
+            AllReduceNodeHandlingCallback,
+        )
 
-        def _on_node_dead(node):
-            # Same contract as AllReduceNodeHandlingCallback.on_node_failed
-            # on the distributed master: drop the dead node from the next
-            # rendezvous round and tell the hung survivors to rebuild the
-            # world now instead of waiting out the collective's timeout.
-            from dlrover_tpu.common.constants import DiagnosisActionType
-
-            for mgr in self.rdzv_managers.values():
-                mgr.remove_alive_node(node.id)
-            self.speed_monitor.mark_down()
-            survivors = self.rdzv_managers[
-                RendezvousName.TRAINING
-            ].alive_nodes()
-            self.diagnosis_manager.enqueue_broadcast(
-                DiagnosisActionType.RESTART_WORKER,
-                f"peer node {node.id} failed; rebuild the world",
-                survivors,
-            )
-
-        self.job_manager.on_node_dead = _on_node_dead
+        self.job_manager.on_node_dead = AllReduceNodeHandlingCallback(
+            self.rdzv_managers, self.speed_monitor,
+            diagnosis_manager=self.diagnosis_manager,
+        ).on_node_failed
 
         self.servicer = MasterServicer(
             task_manager=self.task_manager,

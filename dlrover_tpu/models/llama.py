@@ -205,7 +205,9 @@ def _attention(
     v = (x @ layer["wv"].astype(dt)).reshape(B, S, KV, D)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    if KV != H:  # GQA: repeat kv heads
+    if KV != H and attn_impl in ("ring", "ulysses") and mesh is not None:
+        # Ring/Ulysses shard over heads and need the full head count; the
+        # flash path handles GQA in-kernel (no materialized repeat).
         rep = H // KV
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)

@@ -42,7 +42,12 @@ def reference_attention(
     segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """[B,H,S,D] attention in fp32 accumulation.  ``segment_ids`` [B,S]
-    restricts attention to same-segment pairs (packed sequences)."""
+    restricts attention to same-segment pairs (packed sequences).  GQA:
+    k/v may carry KV < H heads (H % KV == 0)."""
+    if k.shape[1] != q.shape[1]:  # GQA: broadcast kv heads
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
@@ -166,11 +171,25 @@ def _seg3(segment_ids, S, S_pad):
     return seg[:, None, :]
 
 
+def _kv_row_map(H: int, KV: int):
+    """Grid row b in [0, B*H) -> row of the [B*KV, ...] k/v array its
+    query head attends to (GQA: H % KV == 0 query heads share a kv head;
+    the kernel reads the shared head in place, never materializing the
+    repeat)."""
+    rep = H // KV
+
+    def index_map(b, i):
+        return (b // H) * KV + (b % H) // rep, 0, 0
+
+    return index_map
+
+
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
                segment_ids=None):
     from jax.experimental import pallas as pl
 
     B, H, S, D = q.shape
+    KV = k.shape[1]
     sm_scale = 1.0 / np.sqrt(D)
     # Pad the sequence to block multiples: pl.ds clamps out-of-bounds
     # starts (dynamic_slice semantics), which would silently shift the
@@ -182,8 +201,9 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
     grid = (B * H, pl.cdiv(S_pad, block_q))
 
     q3 = q.reshape(B * H, S_pad, D)
-    k3 = k.reshape(B * H, S_pad, D)
-    v3 = v.reshape(B * H, S_pad, D)
+    k3 = k.reshape(B * KV, S_pad, D)
+    v3 = v.reshape(B * KV, S_pad, D)
+    kv_map = _kv_row_map(H, KV)
 
     segmented = segment_ids is not None
     kernel = functools.partial(
@@ -192,8 +212,8 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, S_pad, D), kv_map),
+        pl.BlockSpec((1, S_pad, D), kv_map),
     ]
     inputs = [q3, k3, v3]
     if segmented:
@@ -378,6 +398,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
     from jax.experimental import pallas as pl
 
     B, H, S, D = q.shape
+    KV = k.shape[1]
+    rep = H // KV
     sm_scale = 1.0 / np.sqrt(D)
     block_q, block_k, S_pad = _block_sizes(S, block_q, block_k)
     delta = jnp.sum(
@@ -390,7 +412,10 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
         lse = jnp.pad(lse, pad3)
         delta = jnp.pad(delta, pad3)
 
-    q3, k3, v3, g3 = (t.reshape(B * H, S_pad, D) for t in (q, k, v, g))
+    q3, g3 = (t.reshape(B * H, S_pad, D) for t in (q, g))
+    k3 = k.reshape(B * KV, S_pad, D)
+    v3 = v.reshape(B * KV, S_pad, D)
+    kv_map = _kv_row_map(H, KV)
     lse2 = lse.reshape(B * H, 1, S_pad).astype(jnp.float32)
     delta2 = delta.reshape(B * H, 1, S_pad)
 
@@ -412,8 +437,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
         grid=(B * H, pl.cdiv(S_pad, block_q)),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S_pad, D), kv_map),
+            pl.BlockSpec((1, S_pad, D), kv_map),
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
             pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
@@ -423,6 +448,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
         interpret=interpret,
     )(*common)
 
+    # dk/dv come out PER QUERY HEAD ([B*H, ...]); a GQA group's grads are
+    # the sum over its rep query heads (the vjp of the shared read).
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, block_q=block_q, causal=causal,
@@ -432,8 +459,10 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
         grid=(B * H, pl.cdiv(S_pad, block_k)),
         in_specs=[
             pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda b, i, _m=kv_map: (_m(b, i)[0], i, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda b, i, _m=kv_map: (_m(b, i)[0], i, 0)),
             pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, 1, S_pad), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, 1, S_pad), lambda b, i: (b, 0, 0)),
@@ -449,10 +478,15 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
         interpret=interpret,
     )(*common)
 
+    dk4 = dk.reshape(B, H, S_pad, D)[:, :, :S]
+    dv4 = dv.reshape(B, H, S_pad, D)[:, :, :S]
+    if KV != H:
+        dk4 = dk4.reshape(B, KV, rep, S, D).sum(axis=2).astype(k.dtype)
+        dv4 = dv4.reshape(B, KV, rep, S, D).sum(axis=2).astype(v.dtype)
     return (
         dq.reshape(B, H, S_pad, D)[:, :, :S],
-        dk.reshape(B, H, S_pad, D)[:, :, :S],
-        dv.reshape(B, H, S_pad, D)[:, :, :S],
+        dk4,
+        dv4,
     )
 
 
@@ -568,6 +602,10 @@ def flash_attention(
 ) -> jax.Array:
     """[B, H, S, D] flash attention.
 
+    GQA: ``k``/``v`` may carry ``KV < H`` heads (``H % KV == 0``); the
+    kernels read each shared kv head in place — the repeat is never
+    materialized in HBM — and ``dk``/``dv`` come back ``[B, KV, S, D]``.
+
     ``segment_ids`` [B, S] restricts attention to same-segment pairs —
     packed-sequence training (the reference's pack-mask flash-attn
     variants, ``flash_attn_func_ext.py`` GLM/pack masks) without
@@ -576,6 +614,10 @@ def flash_attention(
     auto backend: Pallas on TPU, jnp reference elsewhere (XLA fuses it
     acceptably on CPU; the Pallas path is the production TPU path).
     """
+    if q.shape[1] % k.shape[1] != 0:
+        raise ValueError(
+            f"GQA needs H % KV == 0, got H={q.shape[1]} KV={k.shape[1]}"
+        )
     if backend is None:
         backend = "pallas" if jax.default_backend() == "tpu" else "reference"
     if backend == "reference":

@@ -326,3 +326,28 @@ class TestBroadcastActions:
         a1 = mgr.pop_actions(1)[0]
         assert a0 is not a1  # no shared mutable object across replies
         assert "delivered" not in a0.payload
+
+
+class TestWholeJobHangFanout:
+    def test_global_hang_reaches_every_alive_node(self):
+        """Regression: a whole-job hang (diagnosed under node -1) must
+        fan out to the alive nodes' heartbeat queues — the action was
+        silently undeliverable when pop_actions only served real ids."""
+        from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+        sm = SpeedMonitor()
+        sm.collect_global_step(10, timestamp=time.time() - 100)
+        mgr = DiagnosisManager(
+            sm, hang_timeout_s=50.0, alive_nodes_fn=lambda: [0, 1]
+        )
+        actions = mgr.diagnose_once()
+        assert -1 in actions  # the hang was diagnosed job-wide
+        for nid in (0, 1):
+            got = mgr.pop_actions(nid)
+            assert got and got[0].action_type == (
+                DiagnosisActionType.RESTART_WORKER
+            ), nid
+        # Later joiner inherits nothing; incident cooldown holds.
+        assert mgr.pop_actions(9) == []
+        mgr.diagnose_once()
+        assert mgr.pop_actions(0) == []
