@@ -1,0 +1,69 @@
+"""Sequence packing: variable-length samples -> fixed [B, S] rows.
+
+The host-side half of packed-sequence training (the kernel half is the
+segment-id mask in ``ops/flash_attention.py``; the model half is
+``models/llama.py``'s per-segment rope + boundary loss mask).  Parity
+target: the packing the reference's pack-mask flash-attn variants
+consume (``flash_attn_func_ext.py`` GLM/pack masks).
+
+Greedy first-fit packing: documents are placed into the first open row
+with room; rows close when full.  Remainder positions are filled with
+``pad_id`` under segment ``-1`` (matches no real segment, so padded
+positions are masked out of attention AND loss).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def pack_sequences(
+    docs: Sequence[np.ndarray],
+    seq_len: int,
+    *,
+    pad_id: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pack 1-D token arrays into rows of ``seq_len``.
+
+    Returns ``(tokens [B, seq_len], segment_ids [B, seq_len])`` where
+    segment ids number the documents within each row (0, 1, ...) and
+    padding carries segment ``-1``.  Documents longer than ``seq_len``
+    are split into ``seq_len``-sized pieces (each piece its own
+    segment — attention never spans a split).
+    """
+    pieces: List[np.ndarray] = []
+    for doc in docs:
+        doc = np.asarray(doc).reshape(-1)
+        if doc.size == 0:
+            continue
+        for lo in range(0, doc.size, seq_len):
+            pieces.append(doc[lo:lo + seq_len])
+
+    # First-fit: rows = list of (used, [piece, ...]).
+    rows: List[Tuple[int, List[np.ndarray]]] = []
+    for piece in pieces:
+        for i, (used, items) in enumerate(rows):
+            if used + piece.size <= seq_len:
+                items.append(piece)
+                rows[i] = (used + piece.size, items)
+                break
+        else:
+            rows.append((piece.size, [piece]))
+
+    B = max(1, len(rows))
+    tokens = np.full((B, seq_len), pad_id, dtype=np.int32)
+    segs = np.full((B, seq_len), -1, dtype=np.int32)
+    for r, (_, items) in enumerate(rows):
+        at = 0
+        for s, piece in enumerate(items):
+            tokens[r, at:at + piece.size] = piece
+            segs[r, at:at + piece.size] = s
+            at += piece.size
+    return tokens, segs
+
+
+def packing_efficiency(segment_ids: np.ndarray) -> float:
+    """Fraction of positions holding real tokens (segment != -1)."""
+    return float((segment_ids >= 0).mean())

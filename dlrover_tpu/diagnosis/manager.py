@@ -85,7 +85,7 @@ class DiagnosisManager:
 
     def enqueue_broadcast(
         self, action_type: str, reason: str, node_ids
-    ) -> None:
+    ) -> int:
         """Queue an action for each of ``node_ids``' next heartbeats (the
         master-initiated path — e.g. a peer died, survivors must rebuild
         the collective world now rather than wait out its timeout).
@@ -124,6 +124,7 @@ class DiagnosisManager:
                 "diagnosis: broadcast %s to %d node(s) (%s)",
                 action_type, queued, reason,
             )
+        return queued
 
     def pop_actions(self, node_id: int) -> List[m.DiagnosisAction]:
         """Actions for ``node_id``, consumed on delivery (reference
@@ -194,8 +195,11 @@ class DiagnosisManager:
                     if nid == -1:
                         # Whole-job diagnosis (e.g. global hang): fan out
                         # to every currently-alive node outside the lock.
-                        whole_job.append((act.action_type, act.reason))
-                        self._delivered[key] = now
+                        # The cooldown is recorded only once the fan-out
+                        # actually queues somewhere — an empty alive set
+                        # (everyone just died) must not suppress the
+                        # incident for the whole cooldown window.
+                        whole_job.append((key, act.action_type, act.reason))
                         continue
                     existing = self._pending.setdefault(nid, [])
                     if not any(
@@ -205,13 +209,18 @@ class DiagnosisManager:
                     ):
                         existing.append(act)
                         self._delivered[key] = now
-        for action_type, reason in whole_job:
+        for key, action_type, reason in whole_job:
             targets = self.alive_nodes_fn() if self.alive_nodes_fn else []
             if targets:
+                # queued == 0 here only when every target already holds
+                # the identical pending instruction — delivered either
+                # way, so start the incident cooldown.
                 self.enqueue_broadcast(action_type, reason, targets)
+                with self._lock:
+                    self._delivered[key] = now
             else:
                 logger.warning(
-                    "whole-job action %s (%s) has no alive-nodes source; "
-                    "dropping", action_type, reason,
+                    "whole-job action %s (%s) has no alive nodes yet; "
+                    "will retry next diagnosis pass", action_type, reason,
                 )
         return actions

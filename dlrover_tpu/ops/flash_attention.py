@@ -322,11 +322,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                     *rest, block_q, causal, sm_scale, seq_len,
-                    padded_len, segmented=False):
+                    padded_len, segmented=False, rep=1):
     from jax.experimental import pallas as pl
 
-    # k_ref/v_ref/dk_ref/dv_ref: [1, block_k, D]; q_ref/g_ref: [1, S_pad, D];
-    # lse_ref/delta_ref: [1, 1, S_pad]; seg_ref: [1, 1, S_pad] int32.
+    # Grid rows cover B*KV kv heads.  k_ref/v_ref/dk_ref/dv_ref:
+    # [1, block_k, D]; q_ref/g_ref: [1, rep, S_pad, D] (this kv head's
+    # ``rep`` GQA query heads); lse_ref/delta_ref: [1, rep, S_pad];
+    # seg_ref: [1, 1, S_pad] int32.  The group's dk/dv accumulate
+    # IN-KERNEL, so the output stays at the compact kv-head size.
     if segmented:
         seg_ref, dk_ref, dv_ref = rest
     else:
@@ -347,42 +350,50 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     def body(qi, carry):
         dk_acc, dv_acc = carry
         q_start = qi * block_q
-        qb = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
-        gb = g_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
-        lse_b = lse_ref[0, 0, pl.ds(q_start, block_q)]
-        delta_b = delta_ref[0, 0, pl.ds(q_start, block_q)]
-        s = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * sm_scale  # [block_q, block_k]
         qpos = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
         kpos = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        s = jnp.where(qpos < seq_len, s, NEG_INF)
-        s = jnp.where(kpos < seq_len, s, NEG_INF)
-        if causal:
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
         if segmented:
             seg_q = seg_ref[0, 0, pl.ds(q_start, block_q)]
             seg_k = seg_ref[0, 0, pl.ds(k_start, block_k)]
-            s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
-        p = jnp.exp(s - lse_b[:, None])
-        dv_acc = dv_acc + jax.lax.dot_general(
-            p, gb, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # p^T @ g -> [block_k, D]
-        dp = jax.lax.dot_general(
-            gb, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta_b[:, None]) * sm_scale
-        dk_acc = dk_acc + jax.lax.dot_general(
-            ds, qb, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # ds^T @ q -> [block_k, D]
+        for r in range(rep):  # static unroll over the GQA group
+            qb = q_ref[0, r, pl.ds(q_start, block_q), :].astype(
+                jnp.float32
+            )
+            gb = g_ref[0, r, pl.ds(q_start, block_q), :].astype(
+                jnp.float32
+            )
+            lse_b = lse_ref[0, r, pl.ds(q_start, block_q)]
+            delta_b = delta_ref[0, r, pl.ds(q_start, block_q)]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale  # [block_q, block_k]
+            s = jnp.where(qpos < seq_len, s, NEG_INF)
+            s = jnp.where(kpos < seq_len, s, NEG_INF)
+            if causal:
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            if segmented:
+                s = jnp.where(
+                    seg_q[:, None] == seg_k[None, :], s, NEG_INF
+                )
+            p = jnp.exp(s - lse_b[:, None])
+            dv_acc = dv_acc + jax.lax.dot_general(
+                p, gb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # p^T @ g -> [block_k, D]
+            dp = jax.lax.dot_general(
+                gb, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_b[:, None]) * sm_scale
+            dk_acc = dk_acc + jax.lax.dot_general(
+                ds, qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # ds^T @ q -> [block_k, D]
         return dk_acc, dv_acc
 
     zeros = jnp.zeros((block_k, d), jnp.float32)
@@ -448,45 +459,51 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
         interpret=interpret,
     )(*common)
 
-    # dk/dv come out PER QUERY HEAD ([B*H, ...]); a GQA group's grads are
-    # the sum over its rep query heads (the vjp of the shared read).
+    # dkv: grid over B*KV kv heads; each program sees its group's ``rep``
+    # query heads ([1, rep, S_pad, D] blocks) and accumulates the group's
+    # dk/dv in-kernel, so the output is the compact [B*KV, ...] shape —
+    # no query-head-sized grad temporaries in HBM, no extra reduce pass.
+    q4 = q3.reshape(B * KV, rep, S_pad, D)
+    g4 = g3.reshape(B * KV, rep, S_pad, D)
+    lse3 = lse2.reshape(B * KV, rep, S_pad)
+    delta3 = delta2.reshape(B * KV, rep, S_pad)
+    dkv_in = [q4, k3, v3, g4, lse3, delta3]
+    dkv_seg_spec = []
+    if segmented:
+        dkv_in.append(common[-1])
+        dkv_seg_spec = [
+            pl.BlockSpec((1, 1, S_pad), lambda b, i: (b // KV, 0, 0))
+        ]
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, block_q=block_q, causal=causal,
             sm_scale=sm_scale, seq_len=S, padded_len=S_pad,
-            segmented=segmented,
+            segmented=segmented, rep=rep,
         ),
-        grid=(B * H, pl.cdiv(S_pad, block_k)),
+        grid=(B * KV, pl.cdiv(S_pad, block_k)),
         in_specs=[
-            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, D),
-                         lambda b, i, _m=kv_map: (_m(b, i)[0], i, 0)),
-            pl.BlockSpec((1, block_k, D),
-                         lambda b, i, _m=kv_map: (_m(b, i)[0], i, 0)),
-            pl.BlockSpec((1, S_pad, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, S_pad), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, S_pad), lambda b, i: (b, 0, 0)),
-        ] + seg_spec,
+            pl.BlockSpec((1, rep, S_pad, D), lambda b, i: (b, 0, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, rep, S_pad, D), lambda b, i: (b, 0, 0, 0)),
+            pl.BlockSpec((1, rep, S_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, rep, S_pad), lambda b, i: (b, 0, 0)),
+        ] + dkv_seg_spec,
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, S_pad, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, S_pad, D), v.dtype),
+            jax.ShapeDtypeStruct((B * KV, S_pad, D), k.dtype),
+            jax.ShapeDtypeStruct((B * KV, S_pad, D), v.dtype),
         ],
         interpret=interpret,
-    )(*common)
+    )(*dkv_in)
 
-    dk4 = dk.reshape(B, H, S_pad, D)[:, :, :S]
-    dv4 = dv.reshape(B, H, S_pad, D)[:, :, :S]
-    if KV != H:
-        dk4 = dk4.reshape(B, KV, rep, S, D).sum(axis=2).astype(k.dtype)
-        dv4 = dv4.reshape(B, KV, rep, S, D).sum(axis=2).astype(v.dtype)
     return (
         dq.reshape(B, H, S_pad, D)[:, :, :S],
-        dk4,
-        dv4,
+        dk.reshape(B, KV, S_pad, D)[:, :, :S],
+        dv.reshape(B, KV, S_pad, D)[:, :, :S],
     )
 
 

@@ -79,6 +79,7 @@ class ElasticTrainer:
         sampler_seed: int = 0,
         devices=None,
         strategy_cache: Any = None,
+        param_specs: Any = None,  # e.g. "planner" | spec tree | callable
     ):
         self.cfg = cfg
         self.loss_fn = loss_fn
@@ -93,6 +94,7 @@ class ElasticTrainer:
         # an elastic rebuild with an unchanged fingerprint skips the
         # search instead of re-profiling mid-recovery.
         self.strategy_cache = strategy_cache
+        self.param_specs = param_specs
 
         self.job = None  # AcceleratedJob
         self.state = None
@@ -150,6 +152,7 @@ class ElasticTrainer:
             devices=devs,
             grad_accum=self.grad_accum,
             cache=self.strategy_cache,
+            param_specs=self.param_specs,
         )
 
         old_state = self.state

@@ -62,6 +62,9 @@ class TrainingArgs:
     seed: int = 0
     early_stopping_patience: int = 0  # evals w/o improvement; 0 = off
     greater_is_better: bool = False  # for the eval metric
+    # Parameter layouts from the cost-model planner (axis->dim search,
+    # parallel/layout_planner.py) instead of the ZeRO-3 heuristic.
+    layout_planner: bool = False
 
 
 @dataclasses.dataclass
@@ -271,6 +274,7 @@ class Trainer:
             sampler_seed=args.seed,
             devices=devices,
             strategy_cache=strategy_cache,
+            param_specs="planner" if args.layout_planner else None,
         )
         self._num_processes = num_processes
         self._process_id = process_id

@@ -244,3 +244,48 @@ class TestCrashResume:
         assert fresh.current_lr() == pytest.approx(
             float(fresh.schedule(4)), rel=1e-6
         )
+
+
+class TestLayoutPlannerWiring:
+    def test_trainer_uses_planner_layouts(self, cpu_mesh_devices):
+        """TrainingArgs(layout_planner=True) routes param placement
+        through the cost-model planner (big weights get sharded)."""
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.parallel.accelerate import Strategy
+        from dlrover_tpu.parallel.mesh import MeshSpec
+        from dlrover_tpu.trainer.trainer import Trainer, TrainingArgs
+
+        def init_fn(rng):
+            return {"w": jax.random.normal(rng, (256, 512)) * 0.05}
+
+        def loss_fn(p, b):
+            return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+        def fetch(indices):
+            r = np.random.RandomState(0)
+            return {
+                "x": r.randn(len(indices), 256).astype(np.float32),
+                "y": r.randn(len(indices), 512).astype(np.float32),
+            }
+
+        trainer = Trainer(
+            loss_fn=loss_fn,
+            init_fn=init_fn,
+            args=TrainingArgs(
+                global_batch_size=8, max_micro_batch_per_proc=8,
+                max_steps=2, logging_steps=0, eval_steps=0, save_steps=0,
+                layout_planner=True,
+            ),
+            fetch_batch=fetch,
+            dataset_size=64,
+            strategy=Strategy(mesh=MeshSpec(dp=2, fsdp=2, tp=2)),
+            devices=cpu_mesh_devices[:8],
+        )
+        state = trainer.train(resume=False)
+        assert state.step == 2
+        w = trainer.core.state["params"]["w"]
+        assert any(ax is not None for ax in w.sharding.spec)
