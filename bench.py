@@ -22,6 +22,53 @@ import time
 
 REFERENCE_HFU_PCT = 62.5  # reference Llama2-7B FSDP HFU (BASELINE.md)
 
+
+def ensure_live_backend(probe_timeout_s: float = 120.0) -> None:
+    """Guard against a wedged device tunnel: probe the configured backend
+    in a THROWAWAY subprocess (a hung ``jax.devices()`` cannot be
+    recovered in-process) and fall back to CPU if it never answers — a
+    benchmark that hangs forever reports nothing; one that reports
+    ``backend: cpu`` tells the truth about what happened."""
+    import os
+    import subprocess
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return
+    probe = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((128, 128));"
+        "print(float((x @ x).sum()))"
+    )
+    import signal
+
+    # DEVNULL (nothing reads the output) + its own session: on timeout
+    # the WHOLE process group dies — a wedged runtime's forked helpers
+    # would otherwise hold inherited pipes (hanging communicate()) and
+    # possibly the device lock.
+    proc = subprocess.Popen(
+        [sys.executable, "-c", probe],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    try:
+        if proc.wait(timeout=probe_timeout_s) == 0:
+            return
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+    print(
+        "bench: configured backend unresponsive; falling back to CPU",
+        file=sys.stderr,
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 PEAK_BF16_FLOPS = {
     # per-chip dense bf16 peak
     "v4": 275e12,
@@ -233,6 +280,7 @@ def measure_goodput(total_steps=80, timeout_s=900):
 
 
 def main() -> int:
+    ensure_live_backend()
     import jax
 
     from dlrover_tpu.models import llama

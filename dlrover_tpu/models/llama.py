@@ -419,10 +419,13 @@ def loss_fn(
         if seg_full.shape[-1] == S + 1:
             seg = seg_full[:, :-1]  # align with the input tokens
             # A position's target is the NEXT token: drop pairs that
-            # cross a packed-sequence boundary.
-            valid = (seg_full[:, 1:] == seg_full[:, :-1]).astype(
-                jnp.float32
-            )
+            # cross a packed-sequence boundary — and padding (segment
+            # < 0, e.g. the packer's -1 fill), or pad->pad pairs would
+            # train "predict pad from pad" and deflate the loss.
+            valid = (
+                (seg_full[:, 1:] == seg_full[:, :-1])
+                & (seg_full[:, :-1] >= 0)
+            ).astype(jnp.float32)
         else:
             seg = seg_full
             # [B, S] form can't see the target of the LAST position (it
@@ -430,7 +433,9 @@ def loss_fn(
             # pass the [B, S+1] form to keep that token's loss.
             valid = jnp.concatenate(
                 [
-                    (seg[:, 1:] == seg[:, :-1]).astype(jnp.float32),
+                    (
+                        (seg[:, 1:] == seg[:, :-1]) & (seg[:, :-1] >= 0)
+                    ).astype(jnp.float32),
                     jnp.zeros(seg.shape[:-1] + (1,), jnp.float32),
                 ],
                 axis=-1,

@@ -322,14 +322,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                     *rest, block_q, causal, sm_scale, seq_len,
-                    padded_len, segmented=False, rep=1):
+                    padded_len, segmented=False):
     from jax.experimental import pallas as pl
 
-    # Grid rows cover B*KV kv heads.  k_ref/v_ref/dk_ref/dv_ref:
-    # [1, block_k, D]; q_ref/g_ref: [1, rep, S_pad, D] (this kv head's
-    # ``rep`` GQA query heads); lse_ref/delta_ref: [1, rep, S_pad];
-    # seg_ref: [1, 1, S_pad] int32.  The group's dk/dv accumulate
-    # IN-KERNEL, so the output stays at the compact kv-head size.
+    # Grid (B*KV, k_blocks, rep): the innermost r axis streams one GQA
+    # query head at a time (VMEM holds ONE [1,1,S_pad,D] q/g block, not
+    # the whole group), revisiting the same compact [1, block_k, D]
+    # dk/dv output block — r==0 initializes it, r>0 accumulates (fp32
+    # output; cast to the param dtype happens outside).
+    # lse_ref/delta_ref: [1, 1, S_pad]; seg_ref: [1, 1, S_pad] int32.
     if segmented:
         seg_ref, dk_ref, dv_ref = rest
     else:
@@ -338,6 +339,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     block_k = k_ref.shape[1]
     d = k_ref.shape[2]
     ki = pl.program_id(1)
+    r = pl.program_id(2)
     k_start = ki * block_k
 
     kb = k_ref[0].astype(jnp.float32)
@@ -356,52 +358,52 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         kpos = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
+        qb = q_ref[0, 0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        gb = g_ref[0, 0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        lse_b = lse_ref[0, 0, pl.ds(q_start, block_q)]
+        delta_b = delta_ref[0, 0, pl.ds(q_start, block_q)]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # [block_q, block_k]
+        s = jnp.where(qpos < seq_len, s, NEG_INF)
+        s = jnp.where(kpos < seq_len, s, NEG_INF)
+        if causal:
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
         if segmented:
             seg_q = seg_ref[0, 0, pl.ds(q_start, block_q)]
             seg_k = seg_ref[0, 0, pl.ds(k_start, block_k)]
-        for r in range(rep):  # static unroll over the GQA group
-            qb = q_ref[0, r, pl.ds(q_start, block_q), :].astype(
-                jnp.float32
-            )
-            gb = g_ref[0, r, pl.ds(q_start, block_q), :].astype(
-                jnp.float32
-            )
-            lse_b = lse_ref[0, r, pl.ds(q_start, block_q)]
-            delta_b = delta_ref[0, r, pl.ds(q_start, block_q)]
-            s = jax.lax.dot_general(
-                qb, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * sm_scale  # [block_q, block_k]
-            s = jnp.where(qpos < seq_len, s, NEG_INF)
-            s = jnp.where(kpos < seq_len, s, NEG_INF)
-            if causal:
-                s = jnp.where(qpos >= kpos, s, NEG_INF)
-            if segmented:
-                s = jnp.where(
-                    seg_q[:, None] == seg_k[None, :], s, NEG_INF
-                )
-            p = jnp.exp(s - lse_b[:, None])
-            dv_acc = dv_acc + jax.lax.dot_general(
-                p, gb, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # p^T @ g -> [block_k, D]
-            dp = jax.lax.dot_general(
-                gb, vb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            ds = p * (dp - delta_b[:, None]) * sm_scale
-            dk_acc = dk_acc + jax.lax.dot_general(
-                ds, qb, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # ds^T @ q -> [block_k, D]
+            s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
+        p = jnp.exp(s - lse_b[:, None])
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, gb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # p^T @ g -> [block_k, D]
+        dp = jax.lax.dot_general(
+            gb, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_b[:, None]) * sm_scale
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # ds^T @ q -> [block_k, D]
         return dk_acc, dv_acc
 
     zeros = jnp.zeros((block_k, d), jnp.float32)
     dk_acc, dv_acc = jax.lax.fori_loop(
         start_qi, num_q_blocks, body, (zeros, zeros)
     )
-    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
-    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+    @pl.when(r == 0)
+    def _init():
+        dk_ref[0] = dk_acc
+        dv_ref[0] = dv_acc
+
+    @pl.when(r > 0)
+    def _accum():
+        dk_ref[0] = dk_ref[0] + dk_acc
+        dv_ref[0] = dv_ref[0] + dv_acc
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
@@ -459,10 +461,10 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
         interpret=interpret,
     )(*common)
 
-    # dkv: grid over B*KV kv heads; each program sees its group's ``rep``
-    # query heads ([1, rep, S_pad, D] blocks) and accumulates the group's
-    # dk/dv in-kernel, so the output is the compact [B*KV, ...] shape —
-    # no query-head-sized grad temporaries in HBM, no extra reduce pass.
+    # dkv: grid (B*KV, k_blocks, rep) — the innermost axis streams the
+    # GQA group's query heads one at a time into the SAME compact output
+    # block (fp32 accumulation), so dk/dv never exist at query-head size
+    # in HBM and per-program VMEM stays at one head's footprint.
     q4 = q3.reshape(B * KV, rep, S_pad, D)
     g4 = g3.reshape(B * KV, rep, S_pad, D)
     lse3 = lse2.reshape(B * KV, rep, S_pad)
@@ -472,38 +474,38 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
     if segmented:
         dkv_in.append(common[-1])
         dkv_seg_spec = [
-            pl.BlockSpec((1, 1, S_pad), lambda b, i: (b // KV, 0, 0))
+            pl.BlockSpec((1, 1, S_pad), lambda b, i, r: (b // KV, 0, 0))
         ]
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, block_q=block_q, causal=causal,
             sm_scale=sm_scale, seq_len=S, padded_len=S_pad,
-            segmented=segmented, rep=rep,
+            segmented=segmented,
         ),
-        grid=(B * KV, pl.cdiv(S_pad, block_k)),
+        grid=(B * KV, pl.cdiv(S_pad, block_k), rep),
         in_specs=[
-            pl.BlockSpec((1, rep, S_pad, D), lambda b, i: (b, 0, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, rep, S_pad, D), lambda b, i: (b, 0, 0, 0)),
-            pl.BlockSpec((1, rep, S_pad), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, rep, S_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, S_pad, D), lambda b, i, r: (b, r, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, r: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, r: (b, i, 0)),
+            pl.BlockSpec((1, 1, S_pad, D), lambda b, i, r: (b, r, 0, 0)),
+            pl.BlockSpec((1, 1, S_pad), lambda b, i, r: (b, r, 0)),
+            pl.BlockSpec((1, 1, S_pad), lambda b, i, r: (b, r, 0)),
         ] + dkv_seg_spec,
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, r: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, r: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * KV, S_pad, D), k.dtype),
-            jax.ShapeDtypeStruct((B * KV, S_pad, D), v.dtype),
+            jax.ShapeDtypeStruct((B * KV, S_pad, D), jnp.float32),
+            jax.ShapeDtypeStruct((B * KV, S_pad, D), jnp.float32),
         ],
         interpret=interpret,
     )(*dkv_in)
 
     return (
         dq.reshape(B, H, S_pad, D)[:, :, :S],
-        dk.reshape(B, KV, S_pad, D)[:, :, :S],
-        dv.reshape(B, KV, S_pad, D)[:, :, :S],
+        dk.reshape(B, KV, S_pad, D)[:, :, :S].astype(k.dtype),
+        dv.reshape(B, KV, S_pad, D)[:, :, :S].astype(v.dtype),
     )
 
 

@@ -879,3 +879,32 @@ class TestPackedSequences:
         np.testing.assert_array_equal(
             np.asarray(pos[0]), [0, 1, 2, 0, 1, 0, 1, 2]
         )
+
+
+class TestPaddedPackingLoss:
+    def test_pad_positions_excluded_from_loss(self):
+        """A padded packed row's loss must equal the unpadded sequence's
+        loss: pad->pad pairs (segment -1) contribute nothing."""
+        from dlrover_tpu.data.packing import pack_sequences
+        from dlrover_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny(n_layer=2)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        doc = np.random.RandomState(0).randint(1, 250, size=(9,))
+        tokens, segs = pack_sequences([doc], seq_len=16)
+        assert (segs == -1).sum() > 0  # padding present
+        packed_loss = float(
+            llama.loss_fn(
+                params,
+                {"tokens": jnp.asarray(tokens),
+                 "segment_ids": jnp.asarray(segs)},
+                cfg, moe_aux_weight=0.0,
+            )
+        )
+        plain_loss = float(
+            llama.loss_fn(
+                params, {"tokens": jnp.asarray(doc[None])}, cfg,
+                moe_aux_weight=0.0,
+            )
+        )
+        np.testing.assert_allclose(packed_loss, plain_loss, rtol=1e-5)
