@@ -267,8 +267,13 @@ def _moe_swiglu(x, moe, cfg: LlamaConfig):
     )
     capacity = int(max(1, round(cfg.capacity_factor * N * K / E)))
     onehot_e = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)
+    # Rank within the expert: the -1 must come AFTER the sum over E —
+    # inside it, every non-selected expert column contributes a spurious
+    # -1 (pos = rank - (E-1)), and rank-0 assignments land on pos -1
+    # where one_hot() is all-zero: each expert's first token silently
+    # vanished from the dispatch.
     pos = (jnp.cumsum(onehot_e.reshape(N * K, E), axis=0)
-           * onehot_e.reshape(N * K, E) - 1).reshape(N, K, E).sum(-1)
+           * onehot_e.reshape(N * K, E)).reshape(N, K, E).sum(-1) - 1
     keep = pos < capacity
     dispatch = (
         jax.nn.one_hot(gate_idx, E, dtype=dt)[..., None]

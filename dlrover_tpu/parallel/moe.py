@@ -82,10 +82,14 @@ def moe_layer(
     capacity = int(max(1, round(cfg.capacity_factor * N * K / E)))
 
     # Position of each (token, k) within its expert's capacity buffer.
+    # The -1 comes AFTER the sum over E: inside it, every non-selected
+    # expert column adds a spurious -1 (pos = rank - (E-1)) and rank-0
+    # assignments land on pos -1, where one_hot() is all-zero — each
+    # expert's first token silently vanished from the dispatch.
     onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # [N, K, E]
     flat_onehot = onehot.reshape(N * K, E)
-    pos_in_expert = jnp.cumsum(flat_onehot, axis=0) * flat_onehot - 1
-    pos = pos_in_expert.reshape(N, K, E).sum(-1)  # [N, K]
+    pos_in_expert = jnp.cumsum(flat_onehot, axis=0) * flat_onehot
+    pos = pos_in_expert.reshape(N, K, E).sum(-1) - 1  # [N, K]
     expert_of = gate_idx  # [N, K]
     keep = pos < capacity
 

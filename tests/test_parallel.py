@@ -908,3 +908,39 @@ class TestPaddedPackingLoss:
             )
         )
         np.testing.assert_allclose(packed_loss, plain_loss, rtol=1e-5)
+
+
+class TestMoEExactness:
+    def test_dispatch_matches_per_token_math(self):
+        """Capacity-dispatch MoE must equal the explicit per-token
+        sum_k gate_k * expert_k(x) when nothing is dropped (regression:
+        an off-by-(E-1) in the capacity position dropped every expert's
+        FIRST token from the dispatch)."""
+        from dlrover_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny(
+            n_layer=2, num_experts=2, moe_every=2, dtype=jnp.float32
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        moe = params["layers"][1]["moe"]
+        x = jax.random.normal(
+            jax.random.PRNGKey(7), (2, 8, cfg.d_model), jnp.float32
+        )
+        toks = x.reshape(-1, cfg.d_model)
+        probs = jax.nn.softmax(toks @ moe["router"], -1)
+        gv, gi = jax.lax.top_k(probs, cfg.top_k)
+        gv = gv / jnp.maximum(gv.sum(-1, keepdims=True), 1e-9)
+        ref = jnp.zeros_like(toks)
+        for n in range(toks.shape[0]):
+            acc = 0
+            for k in range(cfg.top_k):
+                e = int(gi[n, k])
+                h = jax.nn.silu(toks[n] @ moe["wg"][e]) * (
+                    toks[n] @ moe["wi"][e]
+                )
+                acc = acc + gv[n, k] * (h @ moe["wo"][e])
+            ref = ref.at[n].set(acc)
+        out, _aux = llama._moe_swiglu(x, moe, cfg)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref.reshape(x.shape)), atol=1e-6
+        )
