@@ -20,12 +20,25 @@ __version__ = "0.1.0"
 # Lazy re-exports: importing the package must stay cheap (no jax import at
 # top level — agents/masters run on hosts that may not have devices).
 _LAZY = {
+    # acceleration
     "accelerate": "dlrover_tpu.parallel.accelerate",
+    "Strategy": "dlrover_tpu.parallel.accelerate",
     "MeshSpec": "dlrover_tpu.parallel.mesh",
+    "build_mesh": "dlrover_tpu.parallel.mesh",
+    "build_hybrid_mesh": "dlrover_tpu.parallel.mesh",
+    "plan_layout": "dlrover_tpu.parallel.layout_planner",
+    "LocalSGDSync": "dlrover_tpu.parallel.local_sgd",
+    # checkpointing
     "FlashCheckpointer": "dlrover_tpu.checkpoint.checkpointer",
     "CheckpointEngine": "dlrover_tpu.checkpoint.engine",
-    "ElasticTrainer": "dlrover_tpu.trainer.elastic_trainer",
+    # trainer SDK
+    "Trainer": "dlrover_tpu.trainer.trainer",
+    "TrainingArgs": "dlrover_tpu.trainer.trainer",
+    "ElasticTrainer": "dlrover_tpu.trainer.elastic",
     "ElasticSampler": "dlrover_tpu.trainer.sampler",
+    # data
+    "DevicePrefetcher": "dlrover_tpu.data.prefetch",
+    "pack_sequences": "dlrover_tpu.data.packing",
 }
 
 

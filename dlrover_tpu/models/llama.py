@@ -251,9 +251,12 @@ def _swiglu(x, mlp, dt):
     return (jax.nn.silu(g) * u) @ mlp["w_down"].astype(dt)
 
 
-def _moe_swiglu(x, moe, cfg: LlamaConfig):
+def _moe_swiglu(x, moe, cfg: LlamaConfig, capacity: Optional[int] = None):
     """Expert-parallel SwiGLU MoE (dense capacity dispatch, see
-    ``parallel.moe`` for the mechanism)."""
+    ``parallel.moe`` for the mechanism).  ``capacity`` overrides the
+    config-derived expert capacity — decode passes a no-drop value,
+    since at T=1 the rounded capacity is so coarse that two batch rows
+    landing on one expert would silently drop the second."""
     B, S, C = x.shape
     E, K = cfg.num_experts, cfg.top_k
     N = B * S
@@ -265,7 +268,8 @@ def _moe_swiglu(x, moe, cfg: LlamaConfig):
     gate_vals = gate_vals / jnp.maximum(
         jnp.sum(gate_vals, -1, keepdims=True), 1e-9
     )
-    capacity = int(max(1, round(cfg.capacity_factor * N * K / E)))
+    if capacity is None:
+        capacity = int(max(1, round(cfg.capacity_factor * N * K / E)))
     onehot_e = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)
     # Rank within the expert: the -1 must come AFTER the sum over E —
     # inside it, every non-selected expert column contributes a spurious
@@ -303,15 +307,24 @@ def block_apply(
     attn_impl: str = "auto",
     mesh=None,
     segment_ids=None,
+    attn_fn=None,  # (h, layer, cfg, positions) -> attn out; overrides
+    moe_capacity: Optional[int] = None,
 ) -> tuple:
     """One transformer block: (x, layer) -> (x, moe_aux scalar).  The unit
-    the pipeline stage partitioner groups (``models.llama_pp``)."""
+    the pipeline stage partitioner groups (``models.llama_pp``).
+    ``attn_fn`` swaps the attention implementation (the KV-cache decoder
+    plugs in here, so train and decode share one block wiring)."""
     h = rmsnorm(x, layer["ln1"], eps=cfg.rms_eps)
-    x = x + _attention(h, layer, cfg, positions, attn_impl, mesh,
-                       segment_ids)
+    if attn_fn is not None:
+        attn = attn_fn(h, layer, cfg, positions)
+    else:
+        attn = _attention(h, layer, cfg, positions, attn_impl, mesh,
+                          segment_ids)
+    x = x + attn
     h = rmsnorm(x, layer["ln2"], eps=cfg.rms_eps)
     if "moe" in layer:
-        delta, aux = _moe_swiglu(h, layer["moe"], cfg)
+        delta, aux = _moe_swiglu(h, layer["moe"], cfg,
+                                 capacity=moe_capacity)
         return x + delta, aux
     return x + _swiglu(h, layer["mlp"], cfg.dtype), jnp.zeros((), jnp.float32)
 

@@ -64,24 +64,29 @@ def _cached_attention(x, layer, cfg, cache_layer, offset, positions):
 
     max_len = k_cache.shape[2]
     rep = H // KV
-    # Grouped attention against the COMPACT cache: q regrouped to
-    # [B, KV, rep, T, D] so no [B, H, max_len, D] repeat/upcast of the
-    # cache is ever materialized (that copy would cost 2*rep x the cache
-    # bytes per layer per decoded token).
+    # Grouped attention against the COMPACT cache, in its stored dtype:
+    # no [B, H, max_len, D] repeat and no fp32 cache copy is ever
+    # materialized — the einsums accumulate in fp32 via
+    # preferred_element_type (only q, [B,KV,rep,T,D] with tiny T, is
+    # upcast).
     qf = (
         q.transpose(0, 2, 1, 3)
         .reshape(B, KV, rep, T, D)
-        .astype(jnp.float32)
+        .astype(k_cache.dtype)
     )
-    kf = k_cache.astype(jnp.float32)
-    vf = v_cache.astype(jnp.float32)
-    s = jnp.einsum("bgrtd,bgkd->bgrtk", qf, kf) / np.sqrt(D)
+    s = jnp.einsum(
+        "bgrtd,bgkd->bgrtk", qf, k_cache,
+        preferred_element_type=jnp.float32,
+    ) / np.sqrt(D)
     # Causal over absolute positions; cache slots >= offset+T are unwritten.
     kpos = jnp.arange(max_len)[None, None, None, None, :]
     qpos = positions[:, None, None, :, None]
     s = jnp.where(kpos <= qpos, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bgrtk,bgkd->bgrtd", p, vf)
+    out = jnp.einsum(
+        "bgrtk,bgkd->bgrtd", p.astype(k_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
     out = (
         out.reshape(B, H, T, D)
         .transpose(0, 2, 1, 3)
@@ -98,27 +103,35 @@ def forward_step(
     cache: Dict,
 ) -> Tuple[jax.Array, Dict]:
     """Score ``tokens`` continuing the cached context.  Returns
-    (logits [B, T, vocab] fp32, updated cache).  MoE layers fall back to
-    the training MoE block (dense dispatch) — fine at decode sizes."""
+    (logits [B, T, vocab] fp32, updated cache).
+
+    Reuses ``llama.block_apply`` with the cached attention plugged in,
+    so the block wiring (norm/residual/mlp-or-moe order) cannot drift
+    from the training forward.  MoE layers run with a no-drop capacity:
+    at T=1 the config-derived capacity rounds so coarsely that batch
+    rows colliding on an expert would be silently dropped."""
     B, T = tokens.shape
     dt = cfg.dtype
     offset = cache["offset"]
     x = params["embed"].astype(dt)[tokens]
     positions = offset + jnp.broadcast_to(jnp.arange(T), (B, T))
+    no_drop_capacity = B * T * cfg.top_k
     new_layers = []
     for layer, cache_layer in zip(params["layers"], cache["layers"]):
-        h = rmsnorm(x, layer["ln1"], eps=cfg.rms_eps)
-        attn, cache_layer = _cached_attention(
-            h, layer, cfg, cache_layer, offset, positions
+        cell = {}
+
+        def attn_fn(h, layer_, cfg_, positions_, _cache=cache_layer,
+                    _cell=cell):
+            out, _cell["cache"] = _cached_attention(
+                h, layer_, cfg_, _cache, offset, positions_
+            )
+            return out
+
+        x, _aux = llama.block_apply(
+            layer, x, cfg, positions,
+            attn_fn=attn_fn, moe_capacity=no_drop_capacity,
         )
-        x = x + attn
-        h = rmsnorm(x, layer["ln2"], eps=cfg.rms_eps)
-        if "moe" in layer:
-            delta, _aux = llama._moe_swiglu(h, layer["moe"], cfg)
-            x = x + delta
-        else:
-            x = x + llama._swiglu(h, layer["mlp"], dt)
-        new_layers.append(cache_layer)
+        new_layers.append(cell["cache"])
     x = rmsnorm(x, params["ln_f"], eps=cfg.rms_eps)
     logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
     return logits, {"layers": new_layers, "offset": offset + T}

@@ -164,3 +164,23 @@ class TestStorageAndContext:
         ctx.update(rdzv_timeout=123.0)
         assert get_context().rdzv_timeout == 123.0
         ctx.update(rdzv_timeout=old)
+
+
+class TestPublicAPI:
+    def test_every_lazy_export_resolves(self):
+        """dt.<name> must import for every advertised top-level symbol
+        (regression: a stale module path made dt.ElasticTrainer raise
+        ModuleNotFoundError)."""
+        import dlrover_tpu as dt
+
+        for name in dt._LAZY:
+            obj = getattr(dt, name)
+            assert obj is not None, name
+
+    def test_unknown_attribute_raises(self):
+        import pytest
+
+        import dlrover_tpu as dt
+
+        with pytest.raises(AttributeError):
+            dt.does_not_exist

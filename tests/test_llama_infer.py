@@ -10,11 +10,11 @@ import jax.numpy as jnp
 from dlrover_tpu.models import llama, llama_infer
 
 
-def _setup(**cfg_over):
+def _setup(batch=2, **cfg_over):
     cfg = llama.LlamaConfig.tiny(n_layer=2, **cfg_over)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     prompts = jax.random.randint(
-        jax.random.PRNGKey(1), (2, 7), 0, cfg.vocab_size
+        jax.random.PRNGKey(1), (batch, 7), 0, cfg.vocab_size
     )
     return cfg, params, prompts
 
@@ -28,8 +28,11 @@ class TestKVCacheDecode:
         )
         ref, _ = llama.forward(params, prompts, cfg,
                                attn_impl="reference")
+        # bf16 tolerance: the cache path keeps attention weights in the
+        # cache dtype for the p@v product (no fp32 cache copies), which
+        # costs ~1e-3 vs the fp32-operand reference.
         np.testing.assert_allclose(
-            np.asarray(logits), np.asarray(ref), atol=2e-4
+            np.asarray(logits), np.asarray(ref), atol=5e-3
         )
         assert int(cache["offset"]) == prompts.shape[1]
 
@@ -46,7 +49,19 @@ class TestKVCacheDecode:
         ref, _ = llama.forward(params, prompts, cfg,
                                attn_impl="reference")
         np.testing.assert_allclose(
-            np.asarray(logits[:, 0]), np.asarray(ref[:, -1]), atol=2e-4
+            np.asarray(logits[:, 0]), np.asarray(ref[:, -1]), atol=5e-3
+        )
+        # And exactly (1e-6) when compute is fp32 end to end.
+        cfg32, params32, prompts32 = _setup(dtype=jnp.float32)
+        cache32 = llama_infer.init_cache(cfg32, *prompts32.shape)
+        for t in range(prompts32.shape[1]):
+            l32, cache32 = llama_infer.forward_step(
+                params32, prompts32[:, t:t + 1], cfg32, cache32
+            )
+        ref32, _ = llama.forward(params32, prompts32, cfg32,
+                                 attn_impl="reference")
+        np.testing.assert_allclose(
+            np.asarray(l32[:, 0]), np.asarray(ref32[:, -1]), atol=1e-5
         )
 
     def test_greedy_generate_matches_full_recompute(self):
@@ -75,9 +90,14 @@ class TestKVCacheDecode:
         fp32 compute: in bf16 a random tiny model's top-2 logits sit
         within rounding noise of each other, so argmax parity only
         exists where the paths are numerically equivalent."""
+        # num_experts > top_k and B > 1 so expert collisions at decode
+        # T=1 are possible (regression: config-derived capacity at T=1
+        # dropped colliding rows); capacity_factor is ample so the
+        # TRAINING forward also drops nothing — required for exact
+        # parity, since decode always runs drop-free.
         cfg, params, prompts = _setup(
-            n_head=4, n_kv_head=2, num_experts=2, moe_every=2,
-            dtype=jnp.float32,
+            batch=4, n_head=4, n_kv_head=2, num_experts=4, moe_every=2,
+            dtype=jnp.float32, capacity_factor=8.0,
         )
         N = 4
         got = llama_infer.generate(
