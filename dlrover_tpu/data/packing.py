@@ -32,6 +32,14 @@ def pack_sequences(
     padding carries segment ``-1``.  Documents longer than ``seq_len``
     are split into ``seq_len``-sized pieces (each piece its own
     segment — attention never spans a split).
+
+    For next-token training with ``models.llama.loss_fn`` pack to
+    ``seq_len = train_seq + 1`` and pass the returned segment_ids
+    whole: the ``[B, S+1]`` form (aligned with the un-split tokens) is
+    the *lossless* one.  With the ``[B, S]`` form the loss cannot see
+    whether the last position's target continues its segment and must
+    conservatively mask that token, so the same data yields a slightly
+    smaller effective token count.
     """
     pieces: List[np.ndarray] = []
     for doc in docs:

@@ -58,6 +58,13 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_head
 
+    def is_moe_layer(self, i: int) -> bool:
+        """Single source of truth for MoE placement (init_params,
+        param_logical_axes and init_fp8_states must agree)."""
+        return self.num_experts > 0 and (
+            i % self.moe_every == self.moe_every - 1
+        )
+
     @classmethod
     def llama2_7b(cls) -> "LlamaConfig":
         return cls()
@@ -111,7 +118,7 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Dict:
             "wo": _dense(k[3], cfg.n_head * hd, cfg.d_model),
             "ln2": jnp.ones((cfg.d_model,), jnp.float32),
         }
-        if cfg.num_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1):
+        if cfg.is_moe_layer(i):
             layer["moe"] = {
                 "router": _dense(k[4], cfg.d_model, cfg.num_experts),
                 "wi": jax.random.normal(
@@ -164,10 +171,7 @@ def param_logical_axes(cfg: LlamaConfig) -> Dict:
 
     layers = []
     for i in range(cfg.n_layer):
-        has_moe = cfg.num_experts > 0 and (
-            i % cfg.moe_every == cfg.moe_every - 1
-        )
-        layers.append(layer_axes(has_moe))
+        layers.append(layer_axes(cfg.is_moe_layer(i)))
     return {
         "embed": ("vocab", "embed"),
         "lm_head": ("embed", "vocab"),
@@ -193,16 +197,43 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     ).astype(x.dtype)
 
 
+def _fp8_proj(x, w, st, dt):
+    """[..., K] @ [K, N] through ops.fp8.fp8_dot (delayed scaling).
+    Returns (out [..., N] in compute dtype, new Fp8State)."""
+    from dlrover_tpu.ops.fp8 import fp8_dot
+
+    out, new = fp8_dot(
+        x.reshape(-1, x.shape[-1]), w.astype(dt), st
+    )
+    return out.reshape(x.shape[:-1] + (w.shape[-1],)), new
+
+
 def _attention(
     x, layer, cfg: LlamaConfig, positions, attn_impl: str, mesh,
-    segment_ids=None,
+    segment_ids=None, fp8_layer=None,
 ):
+    """Returns ``(out, new_fp8_layer)``; ``new_fp8_layer`` is None unless
+    ``fp8_layer`` (a dict of ``ops.fp8.Fp8State`` for wq/wk/wv/wo) routes
+    the projections through e4m3/e5m2 fp8_dot — the reference's
+    ``Fp8Optimization`` rewrite of eligible linears
+    (``atorch/auto/opt_lib/amp_optimization.py:396``) as a functional
+    strategy knob."""
     B, S, C = x.shape
     H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
     dt = cfg.dtype
-    q = (x @ layer["wq"].astype(dt)).reshape(B, S, H, D)
-    k = (x @ layer["wk"].astype(dt)).reshape(B, S, KV, D)
-    v = (x @ layer["wv"].astype(dt)).reshape(B, S, KV, D)
+    new_fp8 = None
+    if fp8_layer is not None:
+        new_fp8 = {}
+        q, new_fp8["wq"] = _fp8_proj(x, layer["wq"], fp8_layer["wq"], dt)
+        k, new_fp8["wk"] = _fp8_proj(x, layer["wk"], fp8_layer["wk"], dt)
+        v, new_fp8["wv"] = _fp8_proj(x, layer["wv"], fp8_layer["wv"], dt)
+        q = q.reshape(B, S, H, D)
+        k = k.reshape(B, S, KV, D)
+        v = v.reshape(B, S, KV, D)
+    else:
+        q = (x @ layer["wq"].astype(dt)).reshape(B, S, H, D)
+        k = (x @ layer["wk"].astype(dt)).reshape(B, S, KV, D)
+        v = (x @ layer["wv"].astype(dt)).reshape(B, S, KV, D)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     if KV != H and attn_impl in ("ring", "ulysses") and mesh is not None:
@@ -242,21 +273,44 @@ def _attention(
         )
         out = o.transpose(0, 2, 1, 3)
     out = out.reshape(B, S, H * D)
-    return out @ layer["wo"].astype(dt)
+    if fp8_layer is not None:
+        out, new_fp8["wo"] = _fp8_proj(out, layer["wo"],
+                                       fp8_layer["wo"], dt)
+        return out, new_fp8
+    return out @ layer["wo"].astype(dt), None
 
 
-def _swiglu(x, mlp, dt):
+def _swiglu(x, mlp, dt, fp8_mlp=None):
+    """Returns ``(out, new_fp8_mlp)``; fp8 routing as in
+    :func:`_attention` when ``fp8_mlp`` carries Fp8States for
+    w_gate/w_up/w_down."""
+    if fp8_mlp is not None:
+        new = {}
+        g, new["w_gate"] = _fp8_proj(x, mlp["w_gate"],
+                                     fp8_mlp["w_gate"], dt)
+        u, new["w_up"] = _fp8_proj(x, mlp["w_up"], fp8_mlp["w_up"], dt)
+        out, new["w_down"] = _fp8_proj(
+            jax.nn.silu(g) * u, mlp["w_down"], fp8_mlp["w_down"], dt
+        )
+        return out, new
     g = x @ mlp["w_gate"].astype(dt)
     u = x @ mlp["w_up"].astype(dt)
-    return (jax.nn.silu(g) * u) @ mlp["w_down"].astype(dt)
+    return (jax.nn.silu(g) * u) @ mlp["w_down"].astype(dt), None
 
 
-def _moe_swiglu(x, moe, cfg: LlamaConfig, capacity: Optional[int] = None):
+def _moe_swiglu(x, moe, cfg: LlamaConfig, capacity: Optional[int] = None,
+                valid=None):
     """Expert-parallel SwiGLU MoE (dense capacity dispatch, see
     ``parallel.moe`` for the mechanism).  ``capacity`` overrides the
     config-derived expert capacity — decode passes a no-drop value,
     since at T=1 the rounded capacity is so coarse that two batch rows
-    landing on one expert would silently drop the second."""
+    landing on one expert would silently drop the second.
+
+    ``valid`` [B, S] bool marks real tokens in packed-sequence training:
+    pad positions are excluded from expert routing — they take no
+    capacity slots (the position-ordered cumsum would otherwise let a
+    pad displace a real token that follows it in the flattened order)
+    and contribute nothing to the load-balance aux statistics."""
     B, S, C = x.shape
     E, K = cfg.num_experts, cfg.top_k
     N = B * S
@@ -268,9 +322,14 @@ def _moe_swiglu(x, moe, cfg: LlamaConfig, capacity: Optional[int] = None):
     gate_vals = gate_vals / jnp.maximum(
         jnp.sum(gate_vals, -1, keepdims=True), 1e-9
     )
+    valid_n = None if valid is None else valid.reshape(N)
     if capacity is None:
         capacity = int(max(1, round(cfg.capacity_factor * N * K / E)))
     onehot_e = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)
+    if valid_n is not None:
+        # Pads claim no expert slot: drop them before the capacity
+        # cumsum so they can't displace later real tokens.
+        onehot_e = onehot_e * valid_n[:, None, None].astype(jnp.int32)
     # Rank within the expert: the -1 must come AFTER the sum over E —
     # inside it, every non-selected expert column contributes a spurious
     # -1 (pos = rank - (E-1)), and rank-0 assignments land on pos -1
@@ -279,6 +338,8 @@ def _moe_swiglu(x, moe, cfg: LlamaConfig, capacity: Optional[int] = None):
     pos = (jnp.cumsum(onehot_e.reshape(N * K, E), axis=0)
            * onehot_e.reshape(N * K, E)).reshape(N, K, E).sum(-1) - 1
     keep = pos < capacity
+    if valid_n is not None:
+        keep = keep & valid_n[:, None]
     dispatch = (
         jax.nn.one_hot(gate_idx, E, dtype=dt)[..., None]
         * jax.nn.one_hot(pos, capacity, dtype=dt)[..., None, :]
@@ -292,8 +353,19 @@ def _moe_swiglu(x, moe, cfg: LlamaConfig, capacity: Optional[int] = None):
     combine = dispatch * gate_vals[..., None, None].astype(dt)
     out = jnp.einsum("ecd,nkec->nd", xout, combine)
     # Aux load-balance loss, returned via a side dict by forward().
-    me = jnp.mean(probs, axis=0)
-    ce = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32), axis=0)
+    if valid_n is None:
+        me = jnp.mean(probs, axis=0)
+        ce = jnp.mean(
+            jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32), axis=0
+        )
+    else:
+        w = valid_n.astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(w), 1.0)
+        me = jnp.sum(probs * w[:, None], axis=0) / denom
+        ce = jnp.sum(
+            jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32)
+            * w[:, None], axis=0,
+        ) / denom
     aux = E * jnp.sum(me * ce)
     return out.reshape(B, S, C), aux
 
@@ -309,24 +381,51 @@ def block_apply(
     segment_ids=None,
     attn_fn=None,  # (h, layer, cfg, positions) -> attn out; overrides
     moe_capacity: Optional[int] = None,
+    fp8_layer=None,
 ) -> tuple:
     """One transformer block: (x, layer) -> (x, moe_aux scalar).  The unit
     the pipeline stage partitioner groups (``models.llama_pp``).
     ``attn_fn`` swaps the attention implementation (the KV-cache decoder
-    plugs in here, so train and decode share one block wiring)."""
+    plugs in here, so train and decode share one block wiring).
+
+    With ``fp8_layer`` (per-layer Fp8State dict from
+    :func:`init_fp8_states`) the attention/MLP projections run through
+    fp8_dot and the return becomes a 3-tuple
+    ``(x, moe_aux, new_fp8_layer)``; MoE expert matmuls and the router
+    stay in the compute dtype (matching the reference, which only
+    rewrites plain linears)."""
     h = rmsnorm(x, layer["ln1"], eps=cfg.rms_eps)
     if attn_fn is not None:
-        attn = attn_fn(h, layer, cfg, positions)
+        if fp8_layer is not None:
+            raise ValueError(
+                "block_apply: fp8_layer is not supported with a custom "
+                "attn_fn (fp8 is a training-path strategy; the KV-cache "
+                "decode path stays in the compute dtype)"
+            )
+        attn, new_fp8_attn = attn_fn(h, layer, cfg, positions), None
     else:
-        attn = _attention(h, layer, cfg, positions, attn_impl, mesh,
-                          segment_ids)
+        attn, new_fp8_attn = _attention(
+            h, layer, cfg, positions, attn_impl, mesh, segment_ids,
+            fp8_layer=fp8_layer,
+        )
     x = x + attn
     h = rmsnorm(x, layer["ln2"], eps=cfg.rms_eps)
     if "moe" in layer:
-        delta, aux = _moe_swiglu(h, layer["moe"], cfg,
-                                 capacity=moe_capacity)
+        delta, aux = _moe_swiglu(
+            h, layer["moe"], cfg, capacity=moe_capacity,
+            valid=None if segment_ids is None else segment_ids >= 0,
+        )
+        if fp8_layer is not None:
+            return x + delta, aux, new_fp8_attn
         return x + delta, aux
-    return x + _swiglu(h, layer["mlp"], cfg.dtype), jnp.zeros((), jnp.float32)
+    out_m, new_fp8_mlp = _swiglu(
+        h, layer["mlp"], cfg.dtype,
+        fp8_mlp=None if fp8_layer is None else fp8_layer["mlp"],
+    )
+    if fp8_layer is not None:
+        new_fp8_attn["mlp"] = new_fp8_mlp
+        return x + out_m, jnp.zeros((), jnp.float32), new_fp8_attn
+    return x + out_m, jnp.zeros((), jnp.float32)
 
 
 def segment_positions(segment_ids: jax.Array) -> jax.Array:
@@ -347,6 +446,27 @@ def segment_positions(segment_ids: jax.Array) -> jax.Array:
     return idx - start
 
 
+def init_fp8_states(cfg: LlamaConfig):
+    """Per-layer delayed-scaling Fp8State pytree for :func:`loss_fn`'s
+    ``fp8_states`` (one state per rewritten linear: wq/wk/wv/wo and, for
+    dense-MLP layers, w_gate/w_up/w_down).  Thread through the train
+    state and feed each step's output back in — the functional analogue
+    of the reference's TE amax history
+    (``atorch/auto/opt_lib/amp_optimization.py:396``)."""
+    from dlrover_tpu.ops.fp8 import Fp8State
+
+    states = []
+    for i in range(cfg.n_layer):
+        st = {k: Fp8State.init() for k in ("wq", "wk", "wv", "wo")}
+        if not cfg.is_moe_layer(i):
+            st["mlp"] = {
+                k: Fp8State.init()
+                for k in ("w_gate", "w_up", "w_down")
+            }
+        states.append(st)
+    return states
+
+
 def forward_hidden(
     params: Dict,
     tokens: jax.Array,
@@ -355,12 +475,15 @@ def forward_hidden(
     attn_impl: str = "auto",
     mesh=None,
     segment_ids=None,
+    fp8_states=None,
 ) -> tuple:
     """tokens [B, S] -> (final-norm hidden [B, S, D], aux dict).
 
     ``segment_ids`` [B, S] enables packed-sequence training: attention is
     restricted to same-segment pairs (flash-kernel mask) and rope
-    positions reset at each segment boundary."""
+    positions reset at each segment boundary.  ``fp8_states`` (from
+    :func:`init_fp8_states`) routes the block linears through fp8 and
+    adds the updated states to the aux dict as ``aux["fp8_states"]``."""
     B, S = tokens.shape
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens]
@@ -375,11 +498,21 @@ def forward_hidden(
     )
     if cfg.remat_block:
         apply = jax.checkpoint(apply, static_argnums=(2,))
-    for layer in params["layers"]:
-        x, aux = apply(layer, x, cfg, positions)
+    new_fp8 = [] if fp8_states is not None else None
+    for i, layer in enumerate(params["layers"]):
+        if fp8_states is None:
+            x, aux = apply(layer, x, cfg, positions)
+        else:
+            x, aux, nf = apply(
+                layer, x, cfg, positions, fp8_layer=fp8_states[i]
+            )
+            new_fp8.append(nf)
         moe_aux = moe_aux + aux
     x = rmsnorm(x, params["ln_f"], eps=cfg.rms_eps)
-    return x, {"moe_aux": moe_aux}
+    out_aux = {"moe_aux": moe_aux}
+    if new_fp8 is not None:
+        out_aux["fp8_states"] = new_fp8
+    return x, out_aux
 
 
 def forward(
@@ -390,11 +523,12 @@ def forward(
     attn_impl: str = "auto",
     mesh=None,
     segment_ids=None,
+    fp8_states=None,
 ) -> tuple:
     """tokens [B, S] -> (logits [B, S, vocab] fp32, aux dict)."""
     x, aux = forward_hidden(
         params, tokens, cfg, attn_impl=attn_impl, mesh=mesh,
-        segment_ids=segment_ids,
+        segment_ids=segment_ids, fp8_states=fp8_states,
     )
     logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
     return logits, aux
@@ -423,12 +557,16 @@ def loss_fn(
     mesh=None,
     moe_aux_weight: float = 1e-2,
     fused_lm_head: Optional[bool] = None,
+    fp8_states=None,
 ) -> jax.Array:
     """Next-token loss.  ``fused_lm_head`` (default: auto — on for large
     vocabs) routes the projection through the chunked fused lm-head
     cross-entropy so the [B, S, vocab] logits never hit HBM.  A
     ``batch["segment_ids"]`` entry ([B, S] or [B, S+1] matching tokens)
-    enables packed-sequence training."""
+    enables packed-sequence training.  Prefer the [B, S+1] form (what
+    ``data.packing.pack_sequences`` returns at ``seq_len = S+1``): it is
+    lossless, while the [B, S] form cannot see the last position's
+    target segment and conservatively masks that token's loss."""
     tokens, targets = split_batch(batch)
     seg_full = batch.get("segment_ids")
     seg = valid = None
@@ -463,7 +601,7 @@ def loss_fn(
     if fused_lm_head:
         x, aux = forward_hidden(
             params, tokens, cfg, attn_impl=attn_impl, mesh=mesh,
-            segment_ids=seg,
+            segment_ids=seg, fp8_states=fp8_states,
         )
         per_tok = linear_softmax_cross_entropy(
             x, params["lm_head"].astype(cfg.dtype), targets
@@ -471,14 +609,19 @@ def loss_fn(
     else:
         logits, aux = forward(
             params, tokens, cfg, attn_impl=attn_impl, mesh=mesh,
-            segment_ids=seg,
+            segment_ids=seg, fp8_states=fp8_states,
         )
         per_tok = softmax_cross_entropy(logits, targets)
     if valid is not None:
         ce = jnp.sum(per_tok * valid) / jnp.maximum(jnp.sum(valid), 1.0)
     else:
         ce = jnp.mean(per_tok)
-    return ce + moe_aux_weight * aux["moe_aux"]
+    loss = ce + moe_aux_weight * aux["moe_aux"]
+    if fp8_states is not None:
+        # (loss, new_fp8_states): use under value_and_grad(has_aux=True)
+        # and feed the states back in next step (delayed scaling).
+        return loss, aux["fp8_states"]
+    return loss
 
 
 def num_params(params: Dict) -> int:

@@ -30,9 +30,16 @@ import optax
 
 def host_memory_kind() -> Optional[str]:
     """'pinned_host' when the default device exposes a host memory space
-    (TPU runtimes do), else None."""
+    (TPU runtimes do), else None.
+
+    Probes ``jax.local_devices()[0]`` — the first device addressable
+    from THIS process — never ``jax.devices()[0]``: on multi-host jobs
+    the globally-first device belongs to process 0, and probing it from
+    other processes raises, which would make the probe answer True on
+    process 0 and False elsewhere, so each process would compile a
+    different step (SPMD divergence → deadlock)."""
     try:
-        dev = jax.devices()[0]
+        dev = jax.local_devices()[0]
         kinds = {m.kind for m in dev.addressable_memories()}
     except Exception:  # noqa: BLE001 - older runtimes
         return None
@@ -51,7 +58,7 @@ def supports_host_offload() -> bool:
         import jax.numpy as jnp
         from jax.sharding import SingleDeviceSharding
 
-        dev = jax.devices()[0]
+        dev = jax.local_devices()[0]  # addressable from this process
         hs = SingleDeviceSharding(dev, memory_kind=kind)
         x = jax.device_put(jnp.zeros((8,), jnp.float32), hs)
         jax.jit(lambda v: v * 2.0, out_shardings=hs)(x).block_until_ready()

@@ -105,12 +105,20 @@ class Strategy:
     # Park optimizer state in host DRAM (ZeRO-Offload analogue,
     # optim/offload.py): XLA streams it through HBM during the update.
     offload_opt: bool = False
+    # Route eligible linears through e4m3/e5m2 fp8_dot with delayed
+    # scaling (the reference's Fp8Optimization,
+    # ``atorch/auto/opt_lib/amp_optimization.py:396``).  Requires
+    # accelerate(fp8_init=...) and a loss_fn taking ``fp8_states=`` and
+    # returning ``(loss, new_states)`` — e.g. ``models.llama.loss_fn``
+    # with ``init_fp8_states``.
+    fp8: bool = False
 
     def describe(self) -> str:
         return (
             f"mesh={self.mesh.describe()} remat={self.remat} "
             f"accum={self.grad_accum}"
             + (" offload_opt" if self.offload_opt else "")
+            + (" fp8" if self.fp8 else "")
         )
 
 
@@ -161,8 +169,24 @@ def _build_train_step(
     if strategy.remat != "none":
         lfn = jax.checkpoint(loss_fn, policy=remat_policy)
 
+    fp8_on = strategy.fp8
+
+    def _value_and_grad(params, mb, fp8):
+        """(loss, grads, new_fp8) for one microbatch; new_fp8 is None
+        when the fp8 strategy is off."""
+        if fp8_on:
+            (loss, new_fp8), grads = jax.value_and_grad(
+                lfn, has_aux=True
+            )(params, mb, fp8_states=fp8)
+            return loss, grads, new_fp8
+        loss, grads = jax.value_and_grad(lfn)(params, mb)
+        return loss, grads, None
+
     def train_step(state, batch):
         params = state["params"]
+        # Indexing (not .get): a state restored from a pre-fp8 checkpoint
+        # must fail fast here, not as an opaque has_aux tracing error.
+        fp8 = state["fp8"] if fp8_on else None
 
         if strategy.grad_accum > 1:
             micro = jax.tree_util.tree_map(
@@ -173,10 +197,12 @@ def _build_train_step(
             )
 
             def acc_fn(carry, mb):
-                loss, grads = jax.value_and_grad(lfn)(params, mb)
+                loss_sum, grads_sum, fp8_c = carry
+                loss, grads, new_fp8 = _value_and_grad(params, mb, fp8_c)
                 carry = (
-                    carry[0] + loss,
-                    jax.tree_util.tree_map(jnp.add, carry[1], grads),
+                    loss_sum + loss,
+                    jax.tree_util.tree_map(jnp.add, grads_sum, grads),
+                    new_fp8 if fp8_on else fp8_c,
                 )
                 return carry, None
 
@@ -185,14 +211,17 @@ def _build_train_step(
                 jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, jnp.float32), params
                 ),
+                fp8,
             )
-            (loss_sum, grad_sum), _ = jax.lax.scan(acc_fn, zero, micro)
+            (loss_sum, grad_sum, new_fp8), _ = jax.lax.scan(
+                acc_fn, zero, micro
+            )
             loss = loss_sum / strategy.grad_accum
             grads = jax.tree_util.tree_map(
                 lambda g: g / strategy.grad_accum, grad_sum
             )
         else:
-            loss, grads = jax.value_and_grad(lfn)(params, batch)
+            loss, grads, new_fp8 = _value_and_grad(params, batch, fp8)
 
         updates, opt_state = tx.update(grads, state["opt_state"], params)
         import optax
@@ -203,6 +232,8 @@ def _build_train_step(
             "opt_state": opt_state,
             "step": state["step"] + 1,
         }
+        if fp8_on:
+            new_state["fp8"] = new_fp8
         gnorm = optax.global_norm(grads)
         return new_state, {"loss": loss, "grad_norm": gnorm}
 
@@ -223,6 +254,7 @@ def accelerate(
     grad_accum: Optional[int] = None,  # force on every candidate
     search_evals: int = 10,  # strategy="bo": timed-dry-run budget
     cache: Union[None, str, Any] = None,  # StrategyCache or its path
+    fp8_init: Optional[Callable] = None,  # () -> fp8-state pytree
 ) -> AcceleratedJob:
     devs = list(devices) if devices is not None else jax.devices()
     n = len(devs)
@@ -241,6 +273,7 @@ def accelerate(
             batch_axes=batch_axes, devices=devs,
             profile_steps=max(2, profile_steps), max_evals=search_evals,
             grad_accum=grad_accum, cache=cache, job_out=job_out,
+            fp8_init=fp8_init,
         )
         if job_out.get("job") is not None:
             # The search already compiled (and timed) the winner — don't
@@ -257,6 +290,14 @@ def accelerate(
             dataclasses.replace(c, grad_accum=grad_accum)
             for c in candidates
         ]
+    if fp8_init is None and any(c.fp8 for c in candidates):
+        # Fail fast with the real cause: inside the candidate loop this
+        # ValueError would be swallowed and resurface only as the generic
+        # "no viable strategy found".
+        raise ValueError(
+            "Strategy.fp8 requires accelerate(fp8_init=...) — e.g. "
+            "lambda: llama.init_fp8_states(cfg)"
+        )
 
     # SPMD discipline for the candidate sweep: every process must launch
     # the same device programs in the same order, so compile failures are
@@ -330,7 +371,7 @@ def accelerate(
         try:
             job = _compile_candidate(
                 cand, loss_fn, init_fn, optimizer, sample_batch,
-                param_specs, batch_axes, devs,
+                param_specs, batch_axes, devs, fp8_init=fp8_init,
             )
         except Exception as e:  # noqa: BLE001
             logger.info("strategy %s rejected: %s", cand.describe(), e)
@@ -365,7 +406,7 @@ def accelerate(
 
 def _compile_candidate(
     strategy, loss_fn, init_fn, optimizer, sample_batch,
-    param_specs, batch_axes, devs,
+    param_specs, batch_axes, devs, fp8_init=None,
 ) -> AcceleratedJob:
     mesh_spec = strategy.mesh.normalized(len(devs))
     strategy = dataclasses.replace(strategy, mesh=mesh_spec)
@@ -406,6 +447,18 @@ def _compile_candidate(
 
     o_specs = jax.tree_util.tree_map(opt_spec, opt_shape)
     state_specs = {"params": p_specs, "opt_state": o_specs, "step": P()}
+    fp8_shape = None
+    if strategy.fp8:
+        if fp8_init is None:
+            raise ValueError(
+                "Strategy.fp8 requires accelerate(fp8_init=...) — e.g. "
+                "lambda: llama.init_fp8_states(cfg)"
+            )
+        fp8_shape = jax.eval_shape(fp8_init)
+        # Delayed-scaling histories are tiny scalar-ish arrays: replicate.
+        state_specs["fp8"] = jax.tree_util.tree_map(
+            lambda _: P(), fp8_shape
+        )
     state_sharding = named_sharding_tree(state_specs, mesh)
     if strategy.offload_opt:
         from dlrover_tpu.optim.offload import host_shardings_for
@@ -432,21 +485,29 @@ def _compile_candidate(
 
     def create_state(rng):
         with mesh:
-            init_jit = jax.jit(
-                lambda r: {
+            def mk(r):
+                st = {
                     "params": init_fn(r),
                     "opt_state": optimizer.init(init_fn(r)),
                     "step": jnp.zeros((), jnp.int32),
-                },
-                out_shardings=state_sharding,
-            )
+                }
+                if strategy.fp8:
+                    st["fp8"] = fp8_init()
+                return st
+
+            init_jit = jax.jit(mk, out_shardings=state_sharding)
             return init_jit(rng)
 
     # AOT compile for cost analysis without touching devices.
+    abstract_parts = {
+        "params": params_shape, "opt_state": opt_shape,
+        "step": jax.ShapeDtypeStruct((), jnp.int32),
+    }
+    if strategy.fp8:
+        abstract_parts["fp8"] = fp8_shape
     abstract_state = jax.tree_util.tree_map(
         lambda x, s: jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=s),
-        {"params": params_shape, "opt_state": opt_shape,
-         "step": jax.ShapeDtypeStruct((), jnp.int32)},
+        abstract_parts,
         state_sharding,
         is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype"),
     )
@@ -491,6 +552,7 @@ def search(
     warm_start: Sequence[Strategy] = (),
     cache: Union[None, str, Any] = None,
     job_out: Optional[dict] = None,
+    fp8_init: Optional[Callable] = None,
 ) -> Strategy:
     """Bayesian strategy search with a timed-dry-run objective and a
     persistent cache (reference ``bayes_opt_sg.py`` + strategy save/load).
@@ -565,7 +627,7 @@ def search(
         try:
             job = _compile_candidate(
                 s, loss_fn, init_fn, optimizer, sample_batch,
-                param_specs, batch_axes, devs,
+                param_specs, batch_axes, devs, fp8_init=fp8_init,
             )
         except Exception as e:  # noqa: BLE001
             err = e

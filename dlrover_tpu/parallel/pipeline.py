@@ -440,32 +440,48 @@ def pipeline_value_and_grad_interleaved(
             g_ring=zeros_ring((V, n_slot)),
             seed_ring=zeros_ring((n_slot,)),
             x_saved=zeros_ring((V, n_slot)),
+            # Per-micro stage-0 input grads: the pre_fn (embed) parameter
+            # grad is deferred to ONE batched vjp AFTER the tick scan (a
+            # per-tick embedding vjp would materialize a dense [vocab, d]
+            # scatter every entry tick).  d_post stays in-scan — its
+            # grad-wrt-params is a dense matmul anyway — but its
+            # accumulator is only touched inside the cond-gated loss unit.
+            dx0=zeros_ring((M,)),
             loss=jnp.zeros((), jnp.float32),
             d_blocks=zero_tree(blocks_me),  # [V, ...]
-            d_pre=zero_tree(pre_p),
             d_post=zero_tree(post_p),
         )
 
         def chunk_of(v):
+            # Static (python-int) chunk slice: loop-invariant, so XLA
+            # hoists it out of the tick scan; a traced index here would be
+            # a dynamic-slice of the whole [V, ...] param tree per tick.
             return jax.tree_util.tree_map(lambda p: p[v], blocks_me)
 
-        def masked_add(acc, delta, valid):
-            return jax.tree_util.tree_map(
-                lambda a, d: a + jnp.where(valid, d.astype(a.dtype), 0.0),
-                acc, delta,
+        def switch_chunk(v_traced, fn):
+            # Dispatch fn(chunk) over the V statically-sliced chunks.
+            if V == 1:
+                return fn(chunk_of(0))
+            return jax.lax.switch(
+                v_traced, [lambda v=v: fn(chunk_of(v)) for v in range(V)]
             )
 
-        def masked_chunk_add(acc, delta, v, valid):
-            # acc [V, ...] += delta at chunk v (when valid).
-            return jax.tree_util.tree_map(
-                lambda a, d: a.at[v].add(
-                    jnp.where(valid, d.astype(a.dtype), 0.0)
-                ),
-                acc, delta,
-            )
+        # Zero templates for skipped lax.cond branches, pcast to varying so
+        # both branches of every cond agree on VMA types.
+        z_x = _pcast_pp(jnp.zeros(x_shape.shape, ring_dt), pp_axis)
+        z_loss = _pcast_pp(jnp.zeros((), jnp.float32), pp_axis)
+        z_pre = _pcast_pp(zero_tree(pre_p), pp_axis)  # f32, like pre_grads
 
         def tick(carry, t):
             # ---- forward unit ----
+            # Every unit body (pre_fn embed, stage_fn, post_fn lm-head loss,
+            # and their vjps) is gated by lax.cond so a tick only pays for
+            # scheduled work: idle stages skip the whole unit, non-entry
+            # stages skip pre_fn, non-last stages skip the lm-head loss —
+            # matching the reference scheduler's per-tick action list
+            # (atorch pipeline_parallel/scheduler.py:15) where unscheduled
+            # cells simply do nothing.  Collective hops (ppermute) stay
+            # outside all conds: every device takes them unconditionally.
             ef = fwd_tab[t, s_idx]
             f_valid = ef >= 0
             efc = jnp.clip(ef, 0, M * V - 1)
@@ -475,22 +491,48 @@ def pipeline_value_and_grad_interleaved(
             is_j0 = jf == 0
             is_jlast = jf == SV - 1
 
-            x_entry = pre_fn(pre_v, micros_in[mf]).astype(ring_dt)
-            x_in = jnp.where(is_j0, x_entry, carry["in_ring"][vf, slot_f])
+            def fwd_run(d_post_in):
+                x_in = jax.lax.cond(
+                    is_j0,
+                    lambda: pre_fn(pre_v, micros_in[mf]).astype(ring_dt),
+                    lambda: carry["in_ring"][vf, slot_f],
+                )
+                y = switch_chunk(
+                    vf,
+                    lambda ck: stage_fn(ck, x_in.astype(x_shape.dtype)),
+                )
+
+                def loss_run(dp_in):
+                    loss_m, (gy, d_post_m) = jax.value_and_grad(
+                        lambda y_, pp_: scaled_post(
+                            pp_, y_, micros_tgt[mf]
+                        ),
+                        argnums=(0, 1),
+                    )(y, post_v)
+                    dp_out = jax.tree_util.tree_map(
+                        lambda a, d: a + d.astype(a.dtype), dp_in, d_post_m
+                    )
+                    return (loss_m.astype(jnp.float32),
+                            gy.astype(ring_dt), dp_out)
+
+                loss_m, gy, d_post_out = jax.lax.cond(
+                    is_jlast, loss_run,
+                    lambda dp: (z_loss, z_x, dp), d_post_in,
+                )
+                return x_in, y.astype(ring_dt), loss_m, gy, d_post_out
+
+            x_in, y, loss_m, gy, d_post = jax.lax.cond(
+                f_valid, fwd_run,
+                lambda dp: (z_x, z_x, z_loss, z_x, dp),
+                carry["d_post"],
+            )
+            lv = f_valid & is_jlast
             x_saved = carry["x_saved"].at[vf, slot_f].set(
                 jnp.where(f_valid, x_in, carry["x_saved"][vf, slot_f])
             )
-            y = stage_fn(chunk_of(vf), x_in.astype(x_shape.dtype))
-            lv = f_valid & is_jlast
-            (loss_m, (gy, d_post_m)) = jax.value_and_grad(
-                lambda y_, pp_: scaled_post(pp_, y_, micros_tgt[mf]),
-                argnums=(0, 1),
-            )(y, post_v)
-            loss = carry["loss"] + jnp.where(lv, loss_m, 0.0)
-            d_post = masked_add(carry["d_post"], d_post_m, lv)
+            loss = carry["loss"] + loss_m
             seed_ring = carry["seed_ring"].at[slot_f].set(
-                jnp.where(lv, gy.astype(ring_dt),
-                          carry["seed_ring"][slot_f])
+                jnp.where(lv, gy, carry["seed_ring"][slot_f])
             )
 
             # ---- backward unit ----
@@ -500,25 +542,37 @@ def pipeline_value_and_grad_interleaved(
             mb, vb = ebc // V, ebc % V
             jb = vb * S + s_idx
             slot_b = mb % n_slot
-            g_in = jnp.where(
-                jb == SV - 1,
-                seed_ring[slot_b],
-                carry["g_ring"][vb, slot_b],
-            ).astype(x_shape.dtype)
-            _, stage_vjp = jax.vjp(
-                stage_fn, chunk_of(vb),
-                carry["x_saved"][vb, slot_b].astype(x_shape.dtype),
+
+            def bwd_run(d_blocks_in):
+                g_in = jnp.where(
+                    jb == SV - 1,
+                    seed_ring[slot_b],
+                    carry["g_ring"][vb, slot_b],
+                ).astype(x_shape.dtype)
+                xs = carry["x_saved"][vb, slot_b].astype(x_shape.dtype)
+
+                def run_v(v):
+                    _, stage_vjp = jax.vjp(stage_fn, chunk_of(v), xs)
+                    d_chunk_m, dx = stage_vjp(g_in)
+                    d_blocks_out = jax.tree_util.tree_map(
+                        lambda a, d: a.at[v].add(d.astype(a.dtype)),
+                        d_blocks_in, d_chunk_m,
+                    )
+                    return d_blocks_out, dx.astype(ring_dt)
+
+                if V == 1:
+                    return run_v(0)
+                return jax.lax.switch(
+                    vb, [lambda v=v: run_v(v) for v in range(V)]
+                )
+
+            d_blocks, dx = jax.lax.cond(
+                b_valid, bwd_run, lambda db: (db, z_x),
+                carry["d_blocks"],
             )
-            d_chunk_m, dx = stage_vjp(g_in)
-            d_blocks = masked_chunk_add(
-                carry["d_blocks"], d_chunk_m, vb, b_valid
+            dx0 = carry["dx0"].at[mb].set(
+                jnp.where(b_valid & (jb == 0), dx, carry["dx0"][mb])
             )
-            _, pre_vjp = jax.vjp(
-                lambda pp_: pre_fn(pp_, micros_in[mb]), pre_v
-            )
-            (d_pre_m,) = pre_vjp(dx.astype(x_shape.dtype))
-            d_pre = masked_add(carry["d_pre"], d_pre_m,
-                               b_valid & (jb == 0))
 
             # ---- neighbour exchange (full ring, both directions) ----
             # fwd: virtual j -> j+1 is physical +1; the chunk increments
@@ -557,8 +611,8 @@ def pipeline_value_and_grad_interleaved(
 
             return dict(
                 in_ring=in_ring, g_ring=g_ring, seed_ring=seed_ring,
-                x_saved=x_saved, loss=loss, d_blocks=d_blocks,
-                d_pre=d_pre, d_post=d_post,
+                x_saved=x_saved, dx0=dx0,
+                loss=loss, d_blocks=d_blocks, d_post=d_post,
             ), None
 
         carry, _ = jax.lax.scan(
@@ -566,11 +620,41 @@ def pipeline_value_and_grad_interleaved(
         )
 
         loss = jax.lax.psum(carry["loss"], pp_axis)
+        d_post = carry["d_post"]
+
+        # Deferred pre parameter grad: ONE batched vjp over the per-micro
+        # entry-grads saved during the scan.  Only physical stage 0 has
+        # real dx0 data; the others contribute (cond-gated) zeros, folded
+        # by the psum.
+        dx0 = carry["dx0"]
+
+        def pre_grads():
+            # Grad against an f32 copy of the pre params so the
+            # cross-microbatch cotangent accumulation in the scan
+            # transpose happens in f32 (matching the old f32 masked_add
+            # accumulator) even when pre_params are bf16.
+            pre32 = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.float32), pre_v
+            )
+
+            def total_pre(pp_):
+                def step(acc, args):
+                    tm, dm = args
+                    x = pre_fn(pp_, tm)
+                    return acc + jnp.sum(
+                        x * dm.astype(x.dtype)
+                    ).astype(jnp.float32), None
+                acc, _ = jax.lax.scan(step, z_loss, (micros_in, dx0))
+                return acc
+            return jax.grad(total_pre)(pre32)
+
+        d_pre = jax.lax.cond(s_idx == 0, pre_grads, lambda: z_pre)
+
         d_pre = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(g, pp_axis), carry["d_pre"]
+            lambda g: jax.lax.psum(g.astype(jnp.float32), pp_axis), d_pre
         )
         d_post = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(g, pp_axis), carry["d_post"]
+            lambda g: jax.lax.psum(g.astype(jnp.float32), pp_axis), d_post
         )
         return loss, carry["d_blocks"], d_pre, d_post
 

@@ -103,11 +103,19 @@ def ring_attention(
             vb_n = jax.lax.ppermute(vb_c, seq_axis, perm)
             return (kb_n, vb_n, num, denom, new_m), None
 
+        # Accumulator inits must carry the same device-varying type as
+        # the loop-updated values (which inherit qb's variance) or the
+        # scan carry fails the shard_map VMA typecheck.
+        var_axes = tuple(batch_axes) + (seq_axis,)
+
+        def pvary(x):
+            return jax.lax.pcast(x, var_axes, to="varying")
+
         init = (
             kb, vb,
-            jnp.zeros((B, Sb, H, D), jnp.float32),
-            jnp.zeros((B, Sb, H), jnp.float32),
-            jnp.full((B, Sb, H), -jnp.inf, jnp.float32),
+            pvary(jnp.zeros((B, Sb, H, D), jnp.float32)),
+            pvary(jnp.zeros((B, Sb, H), jnp.float32)),
+            pvary(jnp.full((B, Sb, H), -jnp.inf, jnp.float32)),
         )
         (_, _, num, denom, _), _ = jax.lax.scan(
             step, init, jnp.arange(n)
@@ -116,5 +124,4 @@ def ring_attention(
 
     return jax.shard_map(
         ring_body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
     )(q, k, v)

@@ -80,5 +80,4 @@ def ulysses_attention(
         return core(q, k, v, causal)
     return jax.shard_map(
         block, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
     )(q, k, v)

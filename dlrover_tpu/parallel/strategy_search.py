@@ -53,6 +53,7 @@ def strategy_to_dict(strategy) -> dict:
         "grad_accum": strategy.grad_accum,
         "donate": strategy.donate,
         "offload_opt": strategy.offload_opt,
+        "fp8": strategy.fp8,
     }
 
 
@@ -68,6 +69,7 @@ def strategy_from_dict(d: dict):
         grad_accum=int(d["grad_accum"]),
         donate=bool(d.get("donate", True)),
         offload_opt=bool(d.get("offload_opt", False)),
+        fp8=bool(d.get("fp8", False)),
     )
 
 
