@@ -333,6 +333,10 @@ def main() -> int:
             ("llama_800m_h128", m800h, 8, "block", "adamw", 3, False),
             ("llama_800m_h128", m800h, 16, "block", "adam8bit", 3, False),
             ("llama_800m_h128_fp8", m800h, 8, "block", "adamw", 3, True),
+            # Activation-offload remat: block residuals parked in host
+            # DRAM — the lever for b=16 if block-remat alone still OOMs
+            # (VERDICT r2 next #9).
+            ("llama_800m_h128", m800h, 16, "offload", "adamw", 3, False),
         ]
         seq, iters = 2048, 10
     else:

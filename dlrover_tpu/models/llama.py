@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 
 from dlrover_tpu.ops.cross_entropy import (
     linear_softmax_cross_entropy,
@@ -507,6 +508,12 @@ def forward_hidden(
                 layer, x, cfg, positions, fp8_layer=fp8_states[i]
             )
             new_fp8.append(nf)
+        # Identity unless a remat policy references the name: lets
+        # Strategy(remat="offload") park the inter-block residual
+        # stream in host DRAM (reference
+        # selective_offloading_checkpoint.py:252) while everything
+        # inside the block rematerializes.
+        x = checkpoint_name(x, "block_out")
         moe_aux = moe_aux + aux
     x = rmsnorm(x, params["ln_f"], eps=cfg.rms_eps)
     out_aux = {"moe_aux": moe_aux}

@@ -45,6 +45,18 @@ REMAT_POLICIES = {
     "full": jax.checkpoint_policies.nothing_saveable,
     "dots": jax.checkpoint_policies.dots_saveable,
     "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    # Activation offload (reference selective_offloading_checkpoint
+    # .py:252): everything rematerializes EXCEPT values tagged
+    # ``checkpoint_name(x, "block_out")`` (llama tags the inter-block
+    # residual stream), which are parked in host DRAM instead of HBM —
+    # the memory profile of whole-model remat with the recompute cost of
+    # per-block remat.
+    "offload": jax.checkpoint_policies.save_and_offload_only_these_names(
+        names_which_can_be_saved=[],
+        names_which_can_be_offloaded=["block_out"],
+        offload_src="device",
+        offload_dst="pinned_host",
+    ),
 }
 
 _BCAST_BYTES = 1024  # fixed blob size for leader->all strategy broadcast
@@ -166,7 +178,10 @@ def _build_train_step(
     global batch under elasticity, reference ``ElasticTrainer`` trick)."""
     remat_policy = REMAT_POLICIES.get(strategy.remat, None)
     lfn = loss_fn
-    if strategy.remat != "none":
+    # "block" is the MODEL-level per-block policy (e.g. llama's
+    # cfg.remat_block, applied by the caller's loss_fn_builder) — no
+    # outer checkpoint here or the model would remat twice.
+    if strategy.remat not in ("none", "block"):
         lfn = jax.checkpoint(loss_fn, policy=remat_policy)
 
     fp8_on = strategy.fp8
@@ -255,6 +270,10 @@ def accelerate(
     search_evals: int = 10,  # strategy="bo": timed-dry-run budget
     cache: Union[None, str, Any] = None,  # StrategyCache or its path
     fp8_init: Optional[Callable] = None,  # () -> fp8-state pytree
+    # (strategy) -> loss_fn: lets a candidate rewrite the MODEL (e.g.
+    # remat="block" -> cfg.remat_block=True), the reference opt_lib
+    # transform shape.  Overrides loss_fn per candidate when given.
+    loss_fn_builder: Optional[Callable] = None,
 ) -> AcceleratedJob:
     devs = list(devices) if devices is not None else jax.devices()
     n = len(devs)
@@ -273,7 +292,7 @@ def accelerate(
             batch_axes=batch_axes, devices=devs,
             profile_steps=max(2, profile_steps), max_evals=search_evals,
             grad_accum=grad_accum, cache=cache, job_out=job_out,
-            fp8_init=fp8_init,
+            fp8_init=fp8_init, loss_fn_builder=loss_fn_builder,
         )
         if job_out.get("job") is not None:
             # The search already compiled (and timed) the winner — don't
@@ -297,6 +316,19 @@ def accelerate(
         raise ValueError(
             "Strategy.fp8 requires accelerate(fp8_init=...) — e.g. "
             "lambda: llama.init_fp8_states(cfg)"
+        )
+    if loss_fn_builder is None and any(
+        c.remat == "block" for c in candidates
+    ):
+        # Without a model-rewriting builder nothing sets the model's
+        # per-block remat flag, and _build_train_step deliberately adds
+        # no outer checkpoint for "block" — the step would silently run
+        # with remat='none' memory and OOM at exactly the scale 'block'
+        # was chosen for.
+        raise ValueError(
+            "Strategy.remat='block' requires "
+            "accelerate(loss_fn_builder=...) to set the model's "
+            "per-block remat (e.g. cfg.remat_block=True)"
         )
 
     # SPMD discipline for the candidate sweep: every process must launch
@@ -369,8 +401,9 @@ def accelerate(
     best_score = float("inf")
     for i, cand in enumerate(candidates):
         try:
+            lf = loss_fn_builder(cand) if loss_fn_builder else loss_fn
             job = _compile_candidate(
-                cand, loss_fn, init_fn, optimizer, sample_batch,
+                cand, lf, init_fn, optimizer, sample_batch,
                 param_specs, batch_axes, devs, fp8_init=fp8_init,
             )
         except Exception as e:  # noqa: BLE001
@@ -476,12 +509,24 @@ def _compile_candidate(
     batch_sharding = named_sharding_tree(batch_axes, mesh)
 
     step_fn = _build_train_step(loss_fn, optimizer, strategy)
-    jitted = jax.jit(
-        step_fn,
+    jit_kwargs: dict = dict(
         in_shardings=(state_sharding, batch_sharding),
         out_shardings=(state_sharding, None),
         donate_argnums=(0,) if strategy.donate else (),
     )
+    if strategy.remat == "offload" and not strategy.offload_opt:
+        # XLA's SPMD partitioner (jax 0.9) RET_CHECKs on the unsharded
+        # device-placement custom-calls that explicit out_shardings
+        # insert once host memories are in play ("Side-effect HLO must
+        # have sharding").  Outputs inherit the state shardings from
+        # in_shardings by inference, so dropping out_shardings is
+        # placement-equivalent here.  With offload_opt the opt_state
+        # OUTPUT must keep its explicit pinned_host sharding (inference
+        # could re-materialize it in HBM) — keep out_shardings there and
+        # let the candidate self-reject in the sweep if the partitioner
+        # still objects on this jax version.
+        jit_kwargs.pop("out_shardings")
+    jitted = jax.jit(step_fn, **jit_kwargs)
 
     def create_state(rng):
         with mesh:
@@ -553,6 +598,7 @@ def search(
     cache: Union[None, str, Any] = None,
     job_out: Optional[dict] = None,
     fp8_init: Optional[Callable] = None,
+    loss_fn_builder: Optional[Callable] = None,
 ) -> Strategy:
     """Bayesian strategy search with a timed-dry-run objective and a
     persistent cache (reference ``bayes_opt_sg.py`` + strategy save/load).
@@ -625,8 +671,9 @@ def search(
         # healthy hosts block in a program the failed host never joins.
         job, err = None, None
         try:
+            lf = loss_fn_builder(s) if loss_fn_builder else loss_fn
             job = _compile_candidate(
-                s, loss_fn, init_fn, optimizer, sample_batch,
+                s, lf, init_fn, optimizer, sample_batch,
                 param_specs, batch_axes, devs, fp8_init=fp8_init,
             )
         except Exception as e:  # noqa: BLE001
@@ -663,13 +710,36 @@ def search(
         return t
 
     # A forced grad_accum collapses the accum dimension of the space —
-    # otherwise 3 grid points per (mesh, remat) are one effective strategy
+    # otherwise N grid points per (mesh, remat) are one effective strategy
     # and the search would pay for (and the GP would see) duplicates.
-    space = (
-        default_space(n, accum=(grad_accum,))
-        if grad_accum is not None
-        else default_space(n)
-    )
+    space_kw: dict = {}
+    if grad_accum is not None:
+        space_kw["accum"] = (grad_accum,)
+    if fp8_init is not None:
+        space_kw["fp8"] = (False, True)
+    if loss_fn_builder is None:
+        # Without a model-rewriting builder, remat="block" is
+        # indistinguishable from "none" and a pp>1 mesh is pure
+        # replication (nothing builds a pipelined loss) — drop both or
+        # the GP pays full compiles for strictly-duplicate points.
+        from dlrover_tpu.parallel.strategy_search import REMAT_CHOICES
+
+        space_kw["remat"] = tuple(
+            r for r in REMAT_CHOICES if r != "block"
+        )
+        space_kw["allow_pp"] = False
+    space = default_space(n, **space_kw)
+    # Cheap static HBM model prunes obviously-over-budget points before
+    # any compile is paid (reference analyser -> bayes_opt_sg pipeline).
+    hbm = _device_hbm_bytes(devs)
+    if hbm is not None:
+        from dlrover_tpu.parallel.strategy_search import (
+            prune_space_by_memory,
+        )
+
+        space = prune_space_by_memory(
+            space, params_shape, sample_batch, hbm
+        )
     result = BayesStrategySearch(
         objective, space,
         max_evals=max_evals, warm_start=list(warm_start),
@@ -687,6 +757,27 @@ def search(
     ):
         job_out["job"] = best_job["job"]
     return best
+
+
+def _device_hbm_bytes(devs) -> Optional[float]:
+    """Per-device memory budget for static pruning: the runtime's own
+    number when exposed, the DLROVER_TPU_HBM_BYTES override, or None
+    (no pruning — e.g. virtual CPU devices, where host RAM is the only
+    limit and the dry-run is the arbiter)."""
+    import os
+
+    env = os.environ.get("DLROVER_TPU_HBM_BYTES")
+    if env:
+        return float(env)
+    try:
+        stats = devs[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            if getattr(devs[0], "platform", "") == "cpu":
+                return None
+            return float(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001
+        pass
+    return None
 
 
 def _score(job: AcceleratedJob, profile_steps: int, init_fn) -> float:

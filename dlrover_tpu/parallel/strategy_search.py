@@ -31,8 +31,8 @@ from dlrover_tpu.parallel.mesh import MeshSpec, candidate_specs
 # Strategy import is deferred in functions to avoid a cycle with
 # accelerate.py (which imports this module for search()).
 
-REMAT_CHOICES = ("none", "dots", "full")
-ACCUM_CHOICES = (1, 2, 4)
+REMAT_CHOICES = ("none", "dots", "full", "block", "offload")
+ACCUM_CHOICES = (1, 2, 4, 8)
 
 
 # ---------------------------------------------------------------------------
@@ -84,23 +84,155 @@ def default_space(
     remat: Sequence[str] = REMAT_CHOICES,
     accum: Sequence[int] = ACCUM_CHOICES,
     allow_ep: bool = False,
+    allow_pp: bool = True,
+    offload_opt: Sequence[bool] = (False, True),
+    fp8: Sequence[bool] = (False,),
     base=None,
 ) -> List[Any]:
     """The discrete Strategy grid for ``n_devices`` (the combination half
-    of reference ``combination_sg.py`` crossed with tunables)."""
+    of reference ``combination_sg.py`` crossed with tunables).
+
+    Covers every lever the bench sweeps by hand (r2 NOTES "next perf
+    wins"): pp factorizations, per-block/offload remat, host-offloaded
+    optimizer state, grad-accum up to 8, and (opt-in, needs
+    ``accelerate(fp8_init=...)``) fp8 linears."""
     from dlrover_tpu.parallel.accelerate import Strategy
 
     base = base or Strategy()
     out = []
-    for spec in candidate_specs(n_devices, allow_ep=allow_ep):
+    for spec in candidate_specs(
+        n_devices, allow_ep=allow_ep, allow_pp=allow_pp
+    ):
         for r in remat:
             for a in accum:
-                out.append(
-                    dataclasses.replace(
-                        base, mesh=spec, remat=r, grad_accum=a
-                    )
-                )
+                for oo in offload_opt:
+                    for f8 in fp8:
+                        out.append(
+                            dataclasses.replace(
+                                base, mesh=spec, remat=r, grad_accum=a,
+                                offload_opt=oo, fp8=f8,
+                            )
+                        )
     return out
+
+
+def estimate_step_hbm_bytes(
+    params_shape: Any,
+    sample_batch: Any,
+    strategy,
+    *,
+    opt_state_multiplier: float = 2.0,
+    d_model_hint: Optional[int] = None,
+) -> float:
+    """Cheap per-device HBM model for pruning strategies BEFORE the
+    expensive compile (reference ``analyser`` static pass feeding
+    ``bayes_opt_sg``).  Deliberately coarse — it only needs to reject
+    configurations that are OBVIOUSLY over budget:
+
+    - params: f32 master copy sharded over (fsdp*tp*pp)
+    - optimizer state: ``opt_state_multiplier`` x params (0 when
+      ``offload_opt`` parks it host-side)
+    - gradients: one more params-worth
+    - activations: tokens_per_device x d_model x ~24 residual-stream
+      copies for remat="none", scaled down by remat policy and
+      grad-accum (microbatching divides live activations).
+    """
+    import jax as _jax
+
+    sizes = [
+        int(np.prod(x.shape)) * _dtype_bytes(x)
+        for x in _jax.tree_util.tree_leaves(params_shape)
+        if hasattr(x, "shape")
+    ]
+    p_bytes = float(sum(sizes))
+    m = strategy.mesh
+    model_shards = max(1, m.fsdp) * max(1, m.tp) * max(1, m.pp)
+    params_dev = 4.0 / _avg_dtype_bytes(params_shape) * p_bytes \
+        / model_shards  # master f32 copy
+    opt_dev = 0.0 if strategy.offload_opt else (
+        opt_state_multiplier * params_dev
+    )
+    grads_dev = params_dev
+
+    batch_leaves = [
+        x for x in _jax.tree_util.tree_leaves(sample_batch)
+        if hasattr(x, "shape") and np.ndim(x) >= 2
+    ]
+    tokens = max(
+        (int(np.prod(np.shape(x))) for x in batch_leaves), default=0
+    )
+    data_shards = max(1, m.dp) * max(1, m.fsdp)
+    d_model = d_model_hint or _guess_d_model(params_shape)
+    act_factor = {
+        "none": 24.0, "dots": 8.0, "block": 2.0, "offload": 1.0,
+        "full": 1.0,
+    }.get(strategy.remat, 8.0)
+    acts_dev = (
+        tokens / data_shards / max(1, strategy.grad_accum)
+        * d_model * 2.0 * act_factor  # bf16 activations
+    )
+    return params_dev + opt_dev + grads_dev + acts_dev
+
+
+def _dtype_bytes(x) -> int:
+    try:
+        return int(np.dtype(x.dtype).itemsize)
+    except Exception:  # noqa: BLE001
+        return 4
+
+
+def _avg_dtype_bytes(params_shape) -> float:
+    import jax as _jax
+
+    bs = [
+        _dtype_bytes(x)
+        for x in _jax.tree_util.tree_leaves(params_shape)
+        if hasattr(x, "dtype")
+    ]
+    return float(np.mean(bs)) if bs else 4.0
+
+
+def _guess_d_model(params_shape) -> int:
+    """Most common trailing dim among 2-D params — a good-enough proxy
+    for the residual width."""
+    import jax as _jax
+    from collections import Counter
+
+    dims = Counter()
+    for x in _jax.tree_util.tree_leaves(params_shape):
+        shape = getattr(x, "shape", ())
+        if len(shape) == 2:
+            dims[int(min(shape))] += 1
+    return dims.most_common(1)[0][0] if dims else 1024
+
+
+def prune_space_by_memory(
+    space: Sequence[Any],
+    params_shape: Any,
+    sample_batch: Any,
+    hbm_bytes: float,
+    **kw,
+) -> List[Any]:
+    """Drop strategies whose estimated per-device HBM exceeds the budget
+    (keeps everything if that would empty the space — the model is
+    coarse and the timed dry-run is the real arbiter)."""
+    kept = [
+        s for s in space
+        if estimate_step_hbm_bytes(params_shape, sample_batch, s, **kw)
+        <= hbm_bytes
+    ]
+    if not kept:
+        logger.warning(
+            "memory pruning would empty the space (budget %.1f GB); "
+            "keeping all %d candidates", hbm_bytes / 1e9, len(space)
+        )
+        return list(space)
+    if len(kept) < len(space):
+        logger.info(
+            "memory pruning: %d -> %d candidates under %.1f GB",
+            len(space), len(kept), hbm_bytes / 1e9,
+        )
+    return kept
 
 
 def _features(strategy) -> np.ndarray:
@@ -118,6 +250,8 @@ def _features(strategy) -> np.ndarray:
             if strategy.remat in REMAT_CHOICES
             else 1.0,
             np.log2(max(1, strategy.grad_accum)),
+            float(strategy.offload_opt),
+            float(strategy.fp8),
         ],
         dtype=np.float64,
     )

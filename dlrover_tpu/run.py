@@ -69,9 +69,65 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         "DLROVER_TPU_JOB_NAME", "local-job"))
     p.add_argument("--no_python", action="store_true",
                    help="entrypoint is a program, not a python script")
-    p.add_argument("entrypoint", help="training script")
+    p.add_argument("--job_file", default="",
+                   help="declarative ElasticJob YAML (script, args, "
+                        "replicas, ckpt config); explicit CLI flags win")
+    p.add_argument("entrypoint", nargs="?", default="",
+                   help="training script (optional with --job_file)")
     p.add_argument("args", nargs=argparse.REMAINDER)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.job_file:
+        _apply_job_file(p, args)
+    elif not args.entrypoint:
+        p.error("entrypoint is required (or pass --job_file)")
+    return args
+
+
+def _apply_job_file(parser: argparse.ArgumentParser,
+                    args: argparse.Namespace) -> None:
+    """Fill launcher settings from an ElasticJob YAML (reference
+    ``elastic_job.yaml`` consumed by the operator; here the launcher
+    reads it directly).  A flag the user set explicitly (i.e. differs
+    from the parser default) is never overridden."""
+    from dlrover_tpu.scheduler.jobfile import load_elastic_job, nnodes_arg
+
+    jf = load_elastic_job(args.job_file)
+
+    def default_only(name: str, value) -> None:
+        if getattr(args, name) == parser.get_default(name):
+            setattr(args, name, value)
+
+    if not args.entrypoint and jf.script:
+        args.entrypoint = jf.script
+    if not args.entrypoint:
+        parser.error(
+            f"--job_file {args.job_file}: no spec.template.script and no "
+            "entrypoint argument"
+        )
+    default_only("job_name", jf.name)
+    default_only("nnodes", nnodes_arg(jf))
+    default_only("nproc_per_node", jf.nproc_per_node)
+    default_only("node_unit", jf.node_unit)
+    default_only("max_restarts", jf.max_restarts)
+    if jf.network_check:
+        args.network_check = True
+    ckpt_extra = []
+    if jf.ckpt_dir:
+        ckpt_extra.append(f"--ckpt_dir={jf.ckpt_dir}")
+    if jf.ckpt_interval:
+        ckpt_extra.append(f"--ckpt_interval={jf.ckpt_interval}")
+    if not args.args:
+        extra = list(jf.script_args) + ckpt_extra
+        args.args = ["--", *extra] if extra else []
+    else:
+        # User-provided script args replace the YAML's, but the
+        # checkpoint config is durability state, not a script arg —
+        # keep it unless the user explicitly overrides the same flag.
+        joined = " ".join(args.args)
+        args.args = list(args.args) + [
+            e for e in ckpt_extra
+            if e.split("=", 1)[0] not in joined
+        ]
 
 
 def _launch_local_master(args) -> Tuple[subprocess.Popen, str]:

@@ -1,0 +1,175 @@
+"""Declarative ElasticJob file: the YAML job spec an operator checks in.
+
+Parity with the reference's ElasticJob CRD
+(``go/operator/api/v1alpha1/elasticjob_types.go:39`` ElasticJobSpec /
+ReplicaSpec and the user-facing example
+``examples/pytorch/nanogpt/elastic_job.yaml``).  TPU-first shape: one
+YAML document consumed by BOTH entry points —
+
+- ``python -m dlrover_tpu.scheduler.reconciler --job_file job.yaml``
+  (desired replica state for the reconcile loop), and
+- ``python -m dlrover_tpu.run --job_file job.yaml`` (launcher defaults:
+  script, args, nproc, elastic node range).
+
+Schema (all spec fields optional unless noted)::
+
+    apiVersion: elastic.dlrover-tpu/v1alpha1
+    kind: ElasticJob
+    metadata:
+      name: nanogpt            # required
+    spec:
+      distributionStrategy: AllreduceStrategy
+      nodeUnit: 1
+      maxRestarts: 3
+      networkCheck: false
+      replicaSpecs:
+        worker:                # required: at least one replica type
+          replicas: 2          # required
+          minReplicas: 1       # elastic range (defaults to replicas)
+          maxReplicas: 4
+          maxRelaunch: 3
+          resources:
+            tpuChips: 4
+            tpuType: v5e
+            cpu: 4
+            memoryMB: 8192
+      template:
+        script: examples/nanogpt_train.py
+        args: ["--steps=40"]
+        nprocPerNode: 2
+      checkpoint:
+        dir: /ckpt
+        interval: 5
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common.node import NodeResource
+
+API_VERSION = "elastic.dlrover-tpu/v1alpha1"
+KIND = "ElasticJob"
+
+
+@dataclasses.dataclass
+class ReplicaFileSpec:
+    replicas: int
+    min_replicas: int
+    max_replicas: int
+    max_relaunch: int = 3
+    resource: NodeResource = dataclasses.field(default_factory=NodeResource)
+
+
+@dataclasses.dataclass
+class ElasticJobFile:
+    """Parsed + validated ElasticJob YAML."""
+
+    name: str
+    replica_specs: Dict[str, ReplicaFileSpec]
+    distribution_strategy: str = "AllreduceStrategy"
+    node_unit: int = 1
+    max_restarts: int = 3
+    network_check: bool = False
+    script: str = ""
+    script_args: List[str] = dataclasses.field(default_factory=list)
+    nproc_per_node: int = 1
+    ckpt_dir: str = ""
+    ckpt_interval: int = 0
+
+    @property
+    def worker(self) -> ReplicaFileSpec:
+        if "worker" not in self.replica_specs:
+            raise ValueError("ElasticJob has no 'worker' replicaSpec")
+        return self.replica_specs["worker"]
+
+
+def _req(d: Dict, key: str, ctx: str) -> Any:
+    if key not in d:
+        raise ValueError(f"ElasticJob file: missing '{key}' in {ctx}")
+    return d[key]
+
+
+def parse_elastic_job(doc: Dict[str, Any]) -> ElasticJobFile:
+    if doc.get("kind", KIND) != KIND:
+        raise ValueError(
+            f"ElasticJob file: kind must be {KIND}, got {doc.get('kind')}"
+        )
+    meta = _req(doc, "metadata", "document")
+    name = _req(meta, "name", "metadata")
+    spec = _req(doc, "spec", "document")
+    raw_replicas = _req(spec, "replicaSpecs", "spec")
+    if not raw_replicas:
+        raise ValueError("ElasticJob file: replicaSpecs is empty")
+
+    replica_specs: Dict[str, ReplicaFileSpec] = {}
+    for rtype, r in raw_replicas.items():
+        r = r or {}  # `worker:` with no body parses to None
+        n = int(_req(r, "replicas", f"replicaSpecs.{rtype}"))
+        res = r.get("resources", {}) or {}
+        replica_specs[rtype] = ReplicaFileSpec(
+            replicas=n,
+            min_replicas=int(r.get("minReplicas", n)),
+            max_replicas=int(r.get("maxReplicas", n)),
+            max_relaunch=int(r.get("maxRelaunch", 3)),
+            resource=NodeResource(
+                cpu=float(res.get("cpu", 0)),
+                memory_mb=int(res.get("memoryMB", 0)),
+                tpu_chips=int(res.get("tpuChips", 0)),
+                tpu_type=str(res.get("tpuType", "")),
+            ),
+        )
+
+    tmpl = spec.get("template", {}) or {}
+    ckpt = spec.get("checkpoint", {}) or {}
+    return ElasticJobFile(
+        name=str(name),
+        replica_specs=replica_specs,
+        distribution_strategy=str(
+            spec.get("distributionStrategy", "AllreduceStrategy")
+        ),
+        node_unit=int(spec.get("nodeUnit", 1)),
+        max_restarts=int(spec.get("maxRestarts", 3)),
+        network_check=bool(spec.get("networkCheck", False)),
+        script=str(tmpl.get("script", "")),
+        script_args=[str(a) for a in (tmpl.get("args", []) or [])],
+        nproc_per_node=int(tmpl.get("nprocPerNode", 1)),
+        ckpt_dir=str(ckpt.get("dir", "")),
+        ckpt_interval=int(ckpt.get("interval", 0)),
+    )
+
+
+def load_elastic_job(path: str) -> ElasticJobFile:
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"ElasticJob file {path}: not a YAML mapping")
+    return parse_elastic_job(doc)
+
+
+def to_job_spec(jf: ElasticJobFile):
+    """ElasticJobFile -> the reconciler's :class:`JobSpec` (desired
+    replica state; the CR half of the operator contract)."""
+    from dlrover_tpu.scheduler.reconciler import JobSpec, ReplicaSpec
+
+    return JobSpec(
+        job_name=jf.name,
+        replicas={
+            rtype: ReplicaSpec(
+                count=r.replicas,
+                resource=r.resource,
+                max_relaunch=r.max_relaunch,
+            )
+            for rtype, r in jf.replica_specs.items()
+        },
+    )
+
+
+def nnodes_arg(jf: ElasticJobFile) -> str:
+    w = jf.worker
+    if w.min_replicas == w.max_replicas:
+        return str(w.replicas)
+    return f"{w.min_replicas}:{w.max_replicas}"

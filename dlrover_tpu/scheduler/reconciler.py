@@ -325,8 +325,11 @@ def main(argv=None) -> int:  # pragma: no cover - thin CLI shell
     from dlrover_tpu.scheduler.platform import new_platform_client
 
     p = argparse.ArgumentParser("dlrover-tpu-operator")
-    p.add_argument("--job_name", required=True)
-    p.add_argument("--workers", type=int, required=True)
+    p.add_argument("--job_file", default="",
+                   help="declarative ElasticJob YAML (replaces "
+                        "--job_name/--workers/resource flags)")
+    p.add_argument("--job_name", default="")
+    p.add_argument("--workers", type=int, default=0)
     p.add_argument("--platform", default="gke")
     p.add_argument("--namespace", default="default")
     p.add_argument("--image", default="")
@@ -341,16 +344,27 @@ def main(argv=None) -> int:  # pragma: no cover - thin CLI shell
         else {}
     )
     platform = new_platform_client(args.platform, **kwargs)
-    spec = JobSpec(
-        job_name=args.job_name,
-        replicas={
-            NodeType.WORKER: ReplicaSpec(
-                count=args.workers,
-                resource=NodeResource(tpu_chips=args.tpu_chips),
-                max_relaunch=args.max_relaunch,
-            )
-        },
-    )
+    if args.job_file:
+        from dlrover_tpu.scheduler.jobfile import (
+            load_elastic_job,
+            to_job_spec,
+        )
+
+        spec = to_job_spec(load_elastic_job(args.job_file))
+    else:
+        if not args.job_name or args.workers <= 0:
+            p.error("--job_name and --workers are required "
+                    "(or pass --job_file)")
+        spec = JobSpec(
+            job_name=args.job_name,
+            replicas={
+                NodeType.WORKER: ReplicaSpec(
+                    count=args.workers,
+                    resource=NodeResource(tpu_chips=args.tpu_chips),
+                    max_relaunch=args.max_relaunch,
+                )
+            },
+        )
     rec = JobReconciler(
         spec, platform, plan_dir=args.plan_dir or None
     )

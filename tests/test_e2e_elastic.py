@@ -520,3 +520,51 @@ class TestScaleDown:
                 except subprocess.TimeoutExpired:
                     p.kill()
                     p.wait()
+
+
+@pytest.mark.e2e
+class TestJobFileLaunch:
+    def test_yaml_job_file_launches_nanogpt(self, tmp_path):
+        """The declarative ElasticJob YAML drives tpurun end-to-end
+        (VERDICT r2 next #10): script, args, nproc and ckpt config all
+        come from the file."""
+        yaml_text = f"""\
+apiVersion: elastic.dlrover-tpu/v1alpha1
+kind: ElasticJob
+metadata:
+  name: e2e-yaml
+spec:
+  replicaSpecs:
+    worker:
+      replicas: 1
+  template:
+    script: examples/nanogpt_train.py
+    args: ["--steps=8"]
+    nprocPerNode: 2
+  checkpoint:
+    dir: {tmp_path / 'ckpt'}
+    interval: 3
+"""
+        job_file = tmp_path / "job.yaml"
+        job_file.write_text(yaml_text)
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "PYTHONPATH": REPO,
+        })
+        log = open(tmp_path / "run.log", "w")
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "dlrover_tpu.run",
+                "--standalone", "--monitor_interval=1",
+                f"--job_file={job_file}",
+            ],
+            cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+        rc = proc.wait(timeout=420)
+        content = _read(tmp_path / "run.log")
+        assert rc == 0, content[-3000:]
+        assert content.count("TRAIN_DONE step=8") == 2, content[-3000:]
+        # ckpt config came from the YAML
+        assert (tmp_path / "ckpt").exists(), content[-1500:]

@@ -399,3 +399,107 @@ class TestJobReconciler:
             if n.status in (NodeStatus.PENDING, NodeStatus.RUNNING)
         ]
         assert len(live) == 2
+
+
+class TestElasticJobFile:
+    """Declarative ElasticJob YAML (VERDICT r2 next #10; reference CRD
+    elasticjob_types.go:39 + examples/pytorch/nanogpt/elastic_job.yaml)."""
+
+    YAML = """\
+apiVersion: elastic.dlrover-tpu/v1alpha1
+kind: ElasticJob
+metadata:
+  name: testjob
+spec:
+  nodeUnit: 2
+  maxRestarts: 5
+  replicaSpecs:
+    worker:
+      replicas: 3
+      minReplicas: 2
+      maxReplicas: 6
+      maxRelaunch: 4
+      resources:
+        tpuChips: 8
+        tpuType: v5p
+        cpu: 16
+        memoryMB: 4096
+  template:
+    script: train.py
+    args: ["--lr=3e-4"]
+    nprocPerNode: 4
+  checkpoint:
+    dir: /ckpt
+    interval: 7
+"""
+
+    def test_parse_and_to_job_spec(self, tmp_path):
+        from dlrover_tpu.scheduler.jobfile import (
+            load_elastic_job,
+            nnodes_arg,
+            to_job_spec,
+        )
+
+        f = tmp_path / "job.yaml"
+        f.write_text(self.YAML)
+        jf = load_elastic_job(str(f))
+        assert jf.name == "testjob"
+        assert jf.worker.replicas == 3
+        assert jf.worker.resource.tpu_chips == 8
+        assert jf.worker.resource.tpu_type == "v5p"
+        assert jf.nproc_per_node == 4
+        assert jf.script == "train.py"
+        assert jf.script_args == ["--lr=3e-4"]
+        assert jf.ckpt_dir == "/ckpt" and jf.ckpt_interval == 7
+        assert nnodes_arg(jf) == "2:6"
+
+        spec = to_job_spec(jf)
+        assert spec.job_name == "testjob"
+        w = spec.replicas["worker"]
+        assert w.count == 3 and w.max_relaunch == 4
+        assert w.resource.memory_mb == 4096
+
+    def test_validation_errors(self, tmp_path):
+        from dlrover_tpu.scheduler.jobfile import parse_elastic_job
+
+        with pytest.raises(ValueError, match="missing 'metadata'"):
+            parse_elastic_job({"kind": "ElasticJob"})
+        with pytest.raises(ValueError, match="kind"):
+            parse_elastic_job({"kind": "Job", "metadata": {"name": "x"},
+                               "spec": {}})
+        with pytest.raises(ValueError, match="replicaSpecs"):
+            parse_elastic_job(
+                {"metadata": {"name": "x"}, "spec": {"replicaSpecs": {}}}
+            )
+        with pytest.raises(ValueError, match="missing 'replicas'"):
+            parse_elastic_job(
+                {"metadata": {"name": "x"},
+                 "spec": {"replicaSpecs": {"worker": {}}}}
+            )
+
+    def test_reconciler_consumes_job_file(self, tmp_path):
+        """The reconcile loop reaches the desired replica count from a
+        YAML JobSpec on the in-memory platform."""
+        from dlrover_tpu.scheduler.jobfile import (
+            load_elastic_job,
+            to_job_spec,
+        )
+        from dlrover_tpu.scheduler.platform import InMemoryPlatform
+        from dlrover_tpu.scheduler.reconciler import JobReconciler
+
+        f = tmp_path / "job.yaml"
+        f.write_text(self.YAML)
+        spec = to_job_spec(load_elastic_job(str(f)))
+        platform = InMemoryPlatform()  # auto_run: nodes go RUNNING
+        rec = JobReconciler(spec, platform)
+        rec.reconcile_once()
+        nodes = platform.list_nodes()
+        # master-first bootstrap: only the master exists on pass 1
+        assert any(n.node_type == "master" for n in nodes)
+        assert not any(n.node_type == "worker" for n in nodes)
+        rec.reconcile_once()
+        workers = [
+            n for n in platform.list_nodes() if n.node_type == "worker"
+        ]
+        assert len(workers) == 3
+        assert all(n.resource.tpu_chips == 8 for n in workers)

@@ -354,3 +354,106 @@ class TestPPOEndToEnd:
             np.asarray(engine2.params(ModelRole.ACTOR)["emb"]),
             np.asarray(engine.params(ModelRole.ACTOR)["emb"]),
         )
+
+
+class TestCachedRollout:
+    """RL rollouts through the KV-cache decoder (VERDICT r2 next #4):
+    the actor's generate_fn replaces the O(T^2) full-recompute scan."""
+
+    def _llama(self, **over):
+        from dlrover_tpu.models import llama
+
+        # fp32: in bf16 a random tiny model's top-2 logits sit within
+        # rounding noise, so greedy parity only exists where the cached
+        # and full paths are numerically equivalent.
+        cfg = llama.LlamaConfig.tiny(
+            n_layer=2, max_seq_len=256, dtype=jnp.float32, **over
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        return cfg, params
+
+    def test_engine_uses_cached_decoder_and_matches_greedy(self):
+        from dlrover_tpu.models import llama
+        from dlrover_tpu.rl.engine import llama_cached_generate
+
+        cfg, params = self._llama()
+        pcfg = PPOConfig(response_length=6, temperature=0.0)
+        gen = llama_cached_generate(cfg, pcfg)
+        engine = ModelEngine(
+            {
+                ModelRole.ACTOR: RoleSpec(
+                    lambda p, t: llama.forward(p, t, cfg)[0], params,
+                    trainable=True, generate_fn=gen,
+                ),
+                ModelRole.CRITIC: RoleSpec(
+                    _critic_apply, _critic_init(jax.random.PRNGKey(1)),
+                ),
+            },
+            pcfg,
+            reward_fn=lambda t: np.zeros(t.shape[0], np.float32),
+        )
+        prompts = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 5))
+        )
+        out = engine.generate(prompts, jax.random.PRNGKey(0))
+        assert out.shape == (2, 5 + 6)
+        # Greedy reference: argmax over the full forward, token by token.
+        buf = np.asarray(prompts)
+        for _ in range(6):
+            logits, _ = llama.forward(params, jnp.asarray(buf), cfg)
+            nxt = np.asarray(jnp.argmax(logits[:, -1, :], -1))
+            buf = np.concatenate([buf, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), buf)
+
+    def test_cached_rollout_at_least_5x_faster_at_t128(self):
+        """VERDICT done-criterion: >=5x tokens/s over the full-recompute
+        scan at T=128 on CPU."""
+        import time
+
+        from dlrover_tpu.models import llama
+        from dlrover_tpu.rl.engine import llama_cached_generate
+
+        cfg, params = self._llama()
+        R = 128
+        pcfg = PPOConfig(response_length=R, temperature=0.0)
+        prompts = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8))
+        )
+
+        def mk_engine(gen):
+            return ModelEngine(
+                {
+                    ModelRole.ACTOR: RoleSpec(
+                        lambda p, t: llama.forward(p, t, cfg)[0], params,
+                        trainable=True, generate_fn=gen,
+                    ),
+                    ModelRole.CRITIC: RoleSpec(
+                        _critic_apply, _critic_init(jax.random.PRNGKey(1)),
+                    ),
+                },
+                pcfg,
+                reward_fn=lambda t: np.zeros(t.shape[0], np.float32),
+            )
+
+        cached = mk_engine(llama_cached_generate(cfg, pcfg))
+        recompute = mk_engine(None)
+
+        def best_of(engine, n=3):
+            jax.block_until_ready(
+                engine.generate(prompts, jax.random.PRNGKey(0))
+            )
+            ts = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                jax.block_until_ready(
+                    engine.generate(prompts, jax.random.PRNGKey(0))
+                )
+                ts.append(time.perf_counter() - t0)
+            return min(ts)
+
+        t_cached = best_of(cached)
+        t_recompute = best_of(recompute)
+        assert t_recompute / t_cached >= 5.0, (
+            f"cached {t_cached*1e3:.1f} ms vs recompute "
+            f"{t_recompute*1e3:.1f} ms — only {t_recompute/t_cached:.1f}x"
+        )
