@@ -1,0 +1,310 @@
+"""Device-resident hot-row embedding cache: the SparseCore-shaped path.
+
+Parity target: tfplus's KvVariable is the TRAINING-path sparse engine —
+lookups and optimizer applies happen inside the step
+(``tfplus/kv_variable/kernels/kv_variable.h:1``,
+``kv_variable_ops.cc:1``, apply kernels ``training_ops.cc``).  The
+host-side :class:`~dlrover_tpu.embedding.store.EmbeddingStore` keeps the
+unbounded table (and stays the elasticity/checkpoint source of truth),
+but pulling every batch's rows host->device->host makes the embedding
+data plane PCIe-bound.  This module keeps the HOT rows device-resident:
+
+- a fixed-capacity ``[C, D]`` table (+ per-element adagrad accumulator)
+  lives on device; the jitted train step gathers ``table[slots]``,
+  computes grads, segment-sums duplicate slots and applies the sparse
+  adagrad update ON DEVICE — zero host transfer for cache hits,
+- the host maps feature ids -> slots with an LRU clock; misses pull
+  full rows (emb + accumulator, via the store's binary row export) and
+  scatter them into the table in one small transfer,
+- evicted and (periodically) dirty rows flush back into the host store
+  through the same binary row format, so server-kill/rebalance
+  elasticity and checkpoints see every update no older than
+  ``flush_every`` steps.
+
+The update math matches ``EmbeddingStore.apply_adagrad`` exactly
+(s0 += g^2; emb -= lr * g / (sqrt(s0) + eps)), so a row's trajectory is
+identical whether it trains device-side or host-side — asserted by
+``tests/test_embedding.py::TestDeviceCache``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.embedding.store import EmbeddingStore
+
+
+def adagrad_update(
+    table: jax.Array,     # [C, D]
+    accum: jax.Array,     # [C, D]
+    g: jax.Array,         # [C, D] dense (segment-summed) grads
+    *,
+    lr: float,
+    eps: float = 1e-8,
+) -> Tuple[jax.Array, jax.Array]:
+    """Adagrad over the whole cache table, inside jit.  Untouched rows
+    see g=0, for which the update is exactly identity — the full-table
+    form is correct and keeps shapes static.  (The grad of an in-step
+    ``jnp.take(table, slots)`` IS the segment-sum over duplicate slots,
+    so most callers feed ``jax.grad``'s table cotangent straight in.)"""
+    accum = accum + g * g
+    table = table - lr * g / (jnp.sqrt(accum) + eps)
+    return table, accum
+
+
+def sparse_adagrad_apply(
+    table: jax.Array,     # [C, D]
+    accum: jax.Array,     # [C, D]
+    slots: jax.Array,     # [N] int32 slot per occurrence
+    grads: jax.Array,     # [N, D] per-occurrence grads
+    *,
+    lr: float,
+    eps: float = 1e-8,
+) -> Tuple[jax.Array, jax.Array]:
+    """Segment-sum duplicate slots + adagrad, all inside jit."""
+    g = jnp.zeros_like(table).at[slots.reshape(-1)].add(
+        grads.reshape(-1, table.shape[1]).astype(table.dtype)
+    )
+    return adagrad_update(table, accum, g, lr=lr, eps=eps)
+
+
+class DeviceEmbeddingCache:
+    """LRU cache of store rows in device memory, trained in-step.
+
+    Per step::
+
+        slots = cache.map_batch(keys)          # host: ids -> slots,
+                                               # misses pulled + scattered
+        table, accum = cache.table, cache.accum
+        ... jitted step: emb = table[slots]; grads ->
+            sparse_adagrad_apply(table, accum, slots, grads, lr=...)
+        cache.update(new_table, new_accum)     # adopt step outputs
+        cache.maybe_flush()                    # async write-back cadence
+    """
+
+    def __init__(
+        self,
+        store: EmbeddingStore,
+        capacity: int,
+        *,
+        flush_every: int = 50,
+        device=None,
+    ):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.store = store
+        self.dim = store.dim
+        self.capacity = int(capacity)
+        self.flush_every = int(flush_every)
+        dev = device or jax.local_devices()[0]
+        self.table = jax.device_put(
+            jnp.zeros((self.capacity, self.dim), jnp.float32), dev
+        )
+        self.accum = jax.device_put(
+            jnp.zeros((self.capacity, self.dim), jnp.float32), dev
+        )
+        self._dev = dev
+        self._slot_of: Dict[int, int] = {}
+        self._id_of = np.full(self.capacity, -1, np.int64)
+        self._stamp = np.zeros(self.capacity, np.int64)  # LRU clock
+        self._meta = np.zeros((self.capacity, 2), np.int64)  # freq, version
+        self._hits = np.zeros(self.capacity, np.int64)  # since admit/flush
+        self._s1 = np.zeros((self.capacity, self.dim), np.float32)
+        self._tick = 0
+        self._steps_since_flush = 0
+        self._flush_thread: Optional[threading.Thread] = None
+
+    # -- host half ---------------------------------------------------------
+    def map_batch(self, keys: np.ndarray) -> np.ndarray:
+        """ids [..] -> device slots [..] (int32); pulls misses from the
+        store (full rows: emb + accumulator) and scatters them into the
+        device table.  Evicted rows flush back first."""
+        keys = np.asarray(keys, np.int64)
+        uniq = np.unique(keys.reshape(-1))
+        if len(uniq) > self.capacity:
+            raise ValueError(
+                f"batch touches {len(uniq)} unique ids > cache capacity "
+                f"{self.capacity}"
+            )
+        self._tick += 1
+        misses = [int(k) for k in uniq if int(k) not in self._slot_of]
+        if misses:
+            self._admit(np.asarray(misses, np.int64), pinned=uniq)
+        slot_map = self._slot_of
+        flat = np.fromiter(
+            (slot_map[int(k)] for k in keys.reshape(-1)),
+            np.int32, count=keys.size,
+        )
+        for k in uniq:
+            s = slot_map[int(k)]
+            self._stamp[s] = self._tick
+            self._hits[s] += 1  # feeds freq on write-back
+        return flat.reshape(keys.shape)
+
+    def _admit(self, miss_ids: np.ndarray,
+               pinned: Optional[np.ndarray] = None) -> None:
+        n = len(miss_ids)
+        free = np.flatnonzero(self._id_of < 0)
+        if len(free) < n:
+            # Evict the least-recently-used occupied slots — but never a
+            # slot the CURRENT batch hit (its id must stay mapped).
+            pin = set(int(k) for k in pinned) if pinned is not None else set()
+            occupied = np.asarray([
+                s for s in np.flatnonzero(self._id_of >= 0)
+                if int(self._id_of[s]) not in pin
+            ])
+            if len(free) + len(occupied) < n:
+                raise ValueError(
+                    f"cache capacity {self.capacity} cannot hold the "
+                    f"current batch's working set"
+                )
+            order = occupied[np.argsort(self._stamp[occupied])]
+            to_evict = order[: n - len(free)]
+            # Order writes: an in-flight async flush holds OLDER values
+            # for these rows — let it land before the eviction's write.
+            self._join_flush()
+            self._flush_slots(to_evict)
+            for s in to_evict:
+                del self._slot_of[int(self._id_of[s])]
+                self._id_of[s] = -1
+            free = np.flatnonzero(self._id_of < 0)
+        slots = free[:n]
+
+        # Materialize (or create) the rows host-side, then read the FULL
+        # row (emb + adagrad slot0 + freq/version) via the binary export.
+        emb = self.store.lookup(miss_ids, train=True)  # creates if new
+        rows, s0, s1, meta = self._unpack(
+            self.store.export_keys(miss_ids), miss_ids, emb
+        )
+        self.table = self.table.at[jnp.asarray(slots)].set(
+            jnp.asarray(rows)
+        )
+        self.accum = self.accum.at[jnp.asarray(slots)].set(
+            jnp.asarray(s0)
+        )
+        for k, s in zip(miss_ids, slots):
+            self._slot_of[int(k)] = int(s)
+            self._id_of[s] = int(k)
+            self._stamp[s] = self._tick
+        self._meta[slots] = meta
+        self._hits[slots] = 0
+        self._s1[slots] = s1
+
+    def _unpack(self, blob: bytes, ids: np.ndarray, emb_fallback):
+        """Store row blob -> (emb, s0, s1 [n,D], meta [n,2]) in ``ids``
+        order (rows the export skipped fall back to the lookup's emb +
+        zero state).  s1 (the second optimizer slot, e.g. adam's v) is
+        carried through untouched so a flush never wipes it."""
+        D = self.dim
+        rb = self.store.row_bytes
+        arr = np.frombuffer(blob, np.uint8)
+        n = len(arr) // rb
+        rec = arr[: n * rb].reshape(n, rb)
+        emb = np.array(emb_fallback, np.float32, copy=True)
+        s0 = np.zeros((len(ids), D), np.float32)
+        s1 = np.zeros((len(ids), D), np.float32)
+        meta = np.zeros((len(ids), 2), np.int64)
+        pos = {int(k): i for i, k in enumerate(ids)}
+        for i in range(n):
+            m = rec[i, :24].view(np.int64)
+            v = rec[i, 24:].view(np.float32)
+            j = pos.get(int(m[0]))
+            if j is None:
+                continue
+            emb[j] = v[:D]
+            s0[j] = v[D:2 * D]
+            s1[j] = v[2 * D:3 * D]
+            meta[j] = (int(m[1]), int(m[2]))
+        return emb, s0, s1, meta
+
+    # -- step adoption / write-back -----------------------------------------
+    def update(self, table: jax.Array, accum: jax.Array) -> None:
+        """Adopt the train step's outputs (donate-friendly: just rebind)."""
+        self.table = table
+        self.accum = accum
+        self._steps_since_flush += 1
+
+    def _snapshot(self, slots: np.ndarray) -> bytes:
+        """Pack ``slots`` into the store's binary row layout.  Runs on
+        the TRAINING thread (reads self.table before the next donating
+        step can invalidate it); freq/version reflect device-side
+        activity: freq grows by the hits since admit, version bumps once
+        per write-back."""
+        slots = np.asarray(slots)
+        D = self.dim
+        n = len(slots)
+        rb = self.store.row_bytes
+        assert rb == 24 + 12 * D, (
+            f"store row layout changed ({rb} != {24 + 12 * D}); "
+            "update DeviceEmbeddingCache._snapshot"
+        )
+        idx = jnp.asarray(slots)
+        rows = np.asarray(jax.device_get(self.table[idx]))
+        s0 = np.asarray(jax.device_get(self.accum[idx]))
+        out = np.zeros((n, rb), np.uint8)
+        meta = out[:, :24].view(np.int64).reshape(n, 3)
+        meta[:, 0] = self._id_of[slots]
+        meta[:, 1] = self._meta[slots, 0] + self._hits[slots]
+        meta[:, 2] = self._meta[slots, 1] + 1
+        vec = out[:, 24:].view(np.float32).reshape(n, 3 * D)
+        vec[:, :D] = rows
+        vec[:, D:2 * D] = s0
+        vec[:, 2 * D:] = self._s1[slots]
+        # The written values become the new baseline.
+        self._meta[slots, 0] += self._hits[slots]
+        self._meta[slots, 1] += 1
+        self._hits[slots] = 0
+        return out.tobytes()
+
+    def _flush_slots(self, slots: np.ndarray) -> None:
+        blob = self._snapshot(slots) if len(np.asarray(slots)) else b""
+        if blob:
+            self.store.import_rows(blob)
+
+    def flush(self, wait: bool = True) -> None:
+        """Write every resident row back to the host store (elasticity /
+        checkpoint barrier: after this the store holds the device's
+        training progress).  The device/metadata snapshot is taken
+        synchronously — safe against buffer donation by the next step —
+        and with ``wait=False`` only the host-side store import runs on
+        a background thread."""
+        self._join_flush()
+        occupied = np.flatnonzero(self._id_of >= 0)
+        self._steps_since_flush = 0
+        if len(occupied) == 0:
+            return
+        blob = self._snapshot(occupied)
+        if wait:
+            self.store.import_rows(blob)
+            return
+        t = threading.Thread(
+            target=self.store.import_rows, args=(blob,), daemon=True
+        )
+        t.start()
+        self._flush_thread = t
+
+    def _join_flush(self) -> None:
+        if self._flush_thread is not None:
+            self._flush_thread.join()
+            self._flush_thread = None
+
+    def maybe_flush(self) -> None:
+        """Write-back on the ``flush_every`` cadence (snapshot sync,
+        store import async)."""
+        if self.flush_every <= 0:
+            return
+        if self._steps_since_flush < self.flush_every:
+            return
+        if self._flush_thread is not None and self._flush_thread.is_alive():
+            return  # previous import still draining
+        self.flush(wait=False)
+
+    def __len__(self) -> int:
+        return len(self._slot_of)

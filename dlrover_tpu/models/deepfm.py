@@ -109,3 +109,45 @@ def make_train_step(cfg: DeepFMConfig, tx):
         return params, opt_state, loss, rows_grad, rows1_grad
 
     return jax.jit(step)
+
+
+def make_cached_train_step(cfg: DeepFMConfig, tx, *, emb_lr: float,
+                           eps: float = 1e-8):
+    """Device-cache variant of :func:`make_train_step`: the embedding
+    gather AND the sparse adagrad update run inside the jitted step
+    against the device-resident cache tables (the SparseCore shape;
+    reference tfplus trains through in-graph KvVariable kernels,
+    ``kv_variable_ops.cc:1`` + ``training_ops.cc``).  The grad of the
+    in-step ``jnp.take`` is the segment-sum over duplicate slots, so no
+    host-side dedup/scatter is needed at all."""
+    from dlrover_tpu.embedding.device_cache import adagrad_update
+
+    def step(params, opt_state, table, accum, slots,
+             table1, accum1, slots1, labels):
+        b = labels.shape[0]
+
+        def loss_of(p, t, t1):
+            emb = jnp.take(t, slots.reshape(-1), axis=0).reshape(
+                b, cfg.num_fields, cfg.embed_dim
+            )
+            emb1 = jnp.take(t1, slots1.reshape(-1), axis=0).reshape(
+                b, cfg.num_fields, 1
+            )
+            return loss_fn(p, emb, emb1, labels, cfg)
+
+        loss, (p_grads, t_grad, t1_grad) = jax.value_and_grad(
+            loss_of, argnums=(0, 1, 2)
+        )(params, table, table1)
+        import optax
+
+        updates, opt_state = tx.update(p_grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        table, accum = adagrad_update(
+            table, accum, t_grad, lr=emb_lr, eps=eps
+        )
+        table1, accum1 = adagrad_update(
+            table1, accum1, t1_grad, lr=emb_lr, eps=eps
+        )
+        return params, opt_state, table, accum, table1, accum1, loss
+
+    return jax.jit(step, donate_argnums=(2, 3, 5, 6))

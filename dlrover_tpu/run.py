@@ -122,11 +122,15 @@ def _apply_job_file(parser: argparse.ArgumentParser,
     else:
         # User-provided script args replace the YAML's, but the
         # checkpoint config is durability state, not a script arg —
-        # keep it unless the user explicitly overrides the same flag.
-        joined = " ".join(args.args)
+        # keep it unless the user explicitly overrides the same flag
+        # (exact flag-name match; a substring test would false-positive
+        # on e.g. --ckpt_dirs).
+        user_flags = {
+            a.split("=", 1)[0] for a in args.args if a.startswith("--")
+        }
         args.args = list(args.args) + [
             e for e in ckpt_extra
-            if e.split("=", 1)[0] not in joined
+            if e.split("=", 1)[0] not in user_flags
         ]
 
 

@@ -27,6 +27,10 @@ def parse_args():
     p.add_argument("--lr", type=float, default=1e-2)
     p.add_argument("--num_servers", type=int, default=0,
                    help="0 = in-process store; N = PS-style servers")
+    p.add_argument("--device_cache", type=int, default=0,
+                   help="hot-row cache capacity: keeps embeddings "
+                        "device-resident and trains them INSIDE the "
+                        "jitted step (SparseCore shape)")
     p.add_argument("--ckpt_dir", default="")
     return p.parse_args()
 
@@ -46,6 +50,9 @@ def main() -> int:
     params = deepfm.init_dense_params(jax.random.PRNGKey(0), cfg)
     tx = optax.adam(args.lr)
     opt_state = tx.init(params)
+
+    if args.device_cache > 0 and args.num_servers == 0:
+        return run_device_cached(args, cfg, params, opt_state, tx)
     step = deepfm.make_train_step(cfg, tx)
 
     servers = []
@@ -118,6 +125,61 @@ def main() -> int:
     for s in servers:
         s.stop()
     print(f"TRAIN_DONE step={args.steps} loss={float(loss):.4f}", flush=True)
+    return 0
+
+
+def run_device_cached(args, cfg, params, opt_state, tx) -> int:
+    """Device-resident embedding path: gather + sparse adagrad inside
+    the compiled step; host store synced on a cadence + at the end."""
+    import jax
+
+    from dlrover_tpu.embedding.device_cache import DeviceEmbeddingCache
+    from dlrover_tpu.embedding.store import EmbeddingStore
+    from dlrover_tpu.models import deepfm
+
+    store = EmbeddingStore(cfg.embed_dim, seed=1)
+    store1 = EmbeddingStore(1, seed=2)
+    cache = DeviceEmbeddingCache(
+        store, args.device_cache, flush_every=50
+    )
+    cache1 = DeviceEmbeddingCache(
+        store1, args.device_cache, flush_every=50
+    )
+    step = deepfm.make_cached_train_step(cfg, tx, emb_lr=0.1)
+
+    rng = np.random.default_rng(0)
+    loss = None
+    for i in range(1, args.steps + 1):
+        keys = rng.integers(
+            0, args.vocab, size=(args.batch_size, cfg.num_fields)
+        )
+        labels = (
+            (keys[:, 0] % 3 == 0) ^ (keys[:, 1] % 2 == 0)
+        ).astype(np.float32)
+        slots = cache.map_batch(keys)
+        slots1 = cache1.map_batch(keys)
+        (params, opt_state, table, accum, table1, accum1, loss) = step(
+            params, opt_state, cache.table, cache.accum, slots,
+            cache1.table, cache1.accum, slots1, labels,
+        )
+        cache.update(table, accum)
+        cache1.update(table1, accum1)
+        cache.maybe_flush()
+        cache1.maybe_flush()
+        if i % 20 == 0:
+            print(f"step {i} loss {float(loss):.4f}", flush=True)
+
+    cache.flush()
+    cache1.flush()
+    if args.ckpt_dir:
+        from dlrover_tpu.embedding.checkpoint import save_table
+
+        save_table(store, args.ckpt_dir, "feat")
+        save_table(store1, args.ckpt_dir, "feat1")
+    print(
+        f"TRAIN_DONE step={args.steps} loss={float(loss):.4f} "
+        f"device_cache={args.device_cache}", flush=True,
+    )
     return 0
 
 
