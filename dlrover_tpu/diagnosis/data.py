@@ -18,6 +18,7 @@ class DiagnosisDataType:
 
     TRAINING_LOG = "training_log"
     STEP_METRICS = "step_metrics"  # xpu-timer analogue: step heartbeats
+    OP_METRICS = "op_metrics"  # per-op timings (utils.op_metrics JSON)
     NODE_RESOURCE = "node_resource"
     FAILURE = "failure"
 

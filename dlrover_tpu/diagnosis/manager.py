@@ -29,6 +29,7 @@ from dlrover_tpu.diagnosis.inference import (
 )
 from dlrover_tpu.diagnosis.operators import (
     CheckFailureNodeOperator,
+    CheckStragglerOperator,
     CheckTrainingHangOperator,
 )
 
@@ -57,8 +58,13 @@ class DiagnosisManager:
                     hang_timeout_s=hang_timeout_s,
                 ),
                 CheckFailureNodeOperator(self.data_manager),
+                CheckStragglerOperator(self.data_manager),
             ]
         )
+        # Latest runtime-straggler conclusions (op-metrics based);
+        # observational — exposed for queries/operators, no destructive
+        # action is taken on a slow-but-alive node.
+        self.runtime_stragglers: Dict[int, str] = {}
         self._lock = threading.Lock()
         self._pending: Dict[int, List[m.DiagnosisAction]] = {}
         # (node_id, action_type, reason) -> delivery time: an action is not
@@ -166,8 +172,19 @@ class DiagnosisManager:
         hypotheses = [
             Inference(InferenceName.TRAINING_HANG),
             Inference(InferenceName.NODE_FAILURE),
+            Inference(InferenceName.STRAGGLER),
         ]
         conclusions = self._chain.infer(hypotheses)
+        # Straggler conclusions are observational: record + log, never
+        # restart a slow-but-progressing node.
+        stragglers = {
+            int(c.configs.get("node_id", -1)): c.configs.get("reason", "")
+            for c in conclusions
+            if c.name == InferenceName.STRAGGLER and c.resolved
+        }
+        if stragglers and stragglers != self.runtime_stragglers:
+            logger.warning("runtime stragglers: %s", stragglers)
+        self.runtime_stragglers = stragglers
         actions = coordinate_solutions(conclusions)
         if actions:
             logger.info(

@@ -141,3 +141,82 @@ def parse_step_metrics(content: str) -> Optional[dict]:
         return d if isinstance(d, dict) else None
     except (ValueError, TypeError):
         return None
+
+
+class CheckStragglerOperator(InferenceOperator):
+    """Runtime straggler detection from per-op metrics (the in-training
+    complement of the pre-flight node-check pairing; reference feeds
+    xpu-timer per-op scrape into diagnosis,
+    ``diagnosis/datacollector/xpu_timer_metric_collector.py:22``).
+
+    Workers report ``utils.op_metrics`` JSON (step percentiles + device
+    time split by op class) as ``DiagnosisDataType.OP_METRICS``; a node
+    whose step p50 exceeds ``ratio`` x the cluster median is flagged.
+    The collective fraction rides along in the reason: a sick node's
+    PEERS show collective share exploding (they wait in the collective),
+    while the straggler itself shows compute time growing."""
+
+    def __init__(
+        self,
+        data_manager: DiagnosisDataManager,
+        *,
+        ratio: float = 2.0,
+        min_nodes: int = 2,
+        stale_s: float = 600.0,
+    ):
+        self._data = data_manager
+        self._ratio = ratio
+        self._min_nodes = min_nodes
+        self._stale = stale_s
+
+    def is_compatible(self, inference: Inference) -> bool:
+        return inference.name == InferenceName.STRAGGLER
+
+    def infer(self, inferences: List[Inference]) -> List[Inference]:
+        latest = self._data.latest_per_node(DiagnosisDataType.OP_METRICS)
+        now = time.time()
+        p50 = {}
+        coll = {}
+        for nid, rec in latest.items():
+            if now - rec.timestamp > self._stale:
+                continue
+            try:
+                payload = json.loads(rec.content)
+                if not isinstance(payload, dict):
+                    continue  # malformed report must not kill the pass
+                metrics = payload.get("metrics", payload)
+                if not isinstance(metrics, dict):
+                    continue
+                v = float(metrics.get("step_p50_s", 0.0))
+            except (ValueError, TypeError, AttributeError):
+                continue
+            if v > 0:
+                p50[nid] = v
+                coll[nid] = float(
+                    metrics.get("optime_collective_frac", 0.0)
+                )
+        if len(p50) < self._min_nodes:
+            return []
+        xs = sorted(p50.values())
+        # LOWER median: with 2 nodes the upper median is the straggler's
+        # own value and the ratio test could never fire.
+        median = xs[(len(xs) - 1) // 2]
+        out = []
+        for nid, v in p50.items():
+            if median > 0 and v > self._ratio * median:
+                out.append(
+                    Inference(
+                        InferenceName.STRAGGLER,
+                        Attribution.STRAGGLER,
+                        {
+                            "node_id": str(nid),
+                            "reason": (
+                                f"node {nid} step p50 {v * 1e3:.0f}ms > "
+                                f"{self._ratio:.1f}x cluster median "
+                                f"{median * 1e3:.0f}ms "
+                                f"(collective_frac={coll.get(nid, 0):.2f})"
+                            ),
+                        },
+                    )
+                )
+        return out

@@ -127,7 +127,7 @@ class DeviceEmbeddingCache:
         store (full rows: emb + accumulator) and scatters them into the
         device table.  Evicted rows flush back first."""
         keys = np.asarray(keys, np.int64)
-        uniq = np.unique(keys.reshape(-1))
+        uniq, inv = np.unique(keys.reshape(-1), return_inverse=True)
         if len(uniq) > self.capacity:
             raise ValueError(
                 f"batch touches {len(uniq)} unique ids > cache capacity "
@@ -138,15 +138,15 @@ class DeviceEmbeddingCache:
         if misses:
             self._admit(np.asarray(misses, np.int64), pinned=uniq)
         slot_map = self._slot_of
-        flat = np.fromiter(
-            (slot_map[int(k)] for k in keys.reshape(-1)),
-            np.int32, count=keys.size,
+        # One python lookup per UNIQUE id; occurrences expand through the
+        # vectorized inverse (the per-occurrence loop would dominate the
+        # host side at production batch sizes).
+        uniq_slots = np.fromiter(
+            (slot_map[int(k)] for k in uniq), np.int32, count=len(uniq)
         )
-        for k in uniq:
-            s = slot_map[int(k)]
-            self._stamp[s] = self._tick
-            self._hits[s] += 1  # feeds freq on write-back
-        return flat.reshape(keys.shape)
+        self._stamp[uniq_slots] = self._tick
+        self._hits[uniq_slots] += 1  # feeds freq on write-back
+        return uniq_slots[inv].reshape(keys.shape)
 
     def _admit(self, miss_ids: np.ndarray,
                pinned: Optional[np.ndarray] = None) -> None:

@@ -1,0 +1,163 @@
+"""Per-op runtime metrics -> diagnosis (VERDICT r2 missing #6; the
+xpu-timer scrape analogue, reference
+diagnosis/datacollector/xpu_timer_metric_collector.py:22)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.diagnosis.data import (
+    DiagnosisDataManager,
+    DiagnosisDataType,
+)
+from dlrover_tpu.diagnosis.inference import Inference, InferenceName
+from dlrover_tpu.diagnosis.operators import CheckStragglerOperator
+from dlrover_tpu.utils.op_metrics import (
+    OpMetricsCallback,
+    OpMetricsCollector,
+    classify_op,
+)
+
+
+class TestCollector:
+    def test_capture_classifies_ops_and_reports(self, tmp_path):
+        col = OpMetricsCollector(
+            capture_every=2,
+            metrics_path=str(tmp_path / "opm.json"),
+        )
+        f = jax.jit(lambda x: jnp.tanh(x @ x).sum())
+        x = jnp.ones((128, 128))
+        f(x).block_until_ready()  # compile outside the windows
+        for step in range(1, 6):
+            col.step_begin(step)
+            f(x).block_until_ready()
+            col.step_end(step)
+        m = col.metrics()
+        assert m["step_steps"] >= 5
+        assert m["step_p50_s"] > 0
+        # A capture ran and saw the matmul.
+        assert m["last_capture_step"] >= 2
+        assert m["optime_matmul_frac"] > 0, m
+        fr = sum(
+            m[f"optime_{c}_frac"] for c in ("collective", "matmul", "other")
+        )
+        assert 0.99 < fr < 1.01
+        # The metrics file is scrape-able JSON.
+        payload = json.loads((tmp_path / "opm.json").read_text())
+        assert payload["metrics"]["step_p50_s"] > 0
+        assert payload["top_ops"]
+
+    def test_classify_op(self):
+        assert classify_op("all-reduce.17") == "collective"
+        assert classify_op("ppermute") == "collective"
+        assert classify_op("dot_general") == "matmul"
+        assert classify_op("end: dot_general") == "matmul"
+        assert classify_op("wrapped_tanh") == "other"
+
+
+class TestStragglerOperator:
+    def _record(self, dm, nid, p50, coll=0.1, ts=None):
+        dm.store_data(
+            nid, DiagnosisDataType.OP_METRICS,
+            json.dumps({"metrics": {
+                "step_p50_s": p50, "optime_collective_frac": coll,
+            }}),
+            ts,
+        )
+
+    def test_flags_slow_node(self):
+        dm = DiagnosisDataManager(ttl_s=600)
+        for nid in range(3):
+            self._record(dm, nid, 0.10)
+        self._record(dm, 3, 0.35, coll=0.02)  # 3.5x median
+        op = CheckStragglerOperator(dm, ratio=2.0)
+        out = op.infer([Inference(InferenceName.STRAGGLER)])
+        assert len(out) == 1
+        assert out[0].configs["node_id"] == "3"
+        assert "3.5" in out[0].configs["reason"] or "350" in (
+            out[0].configs["reason"]
+        )
+
+    def test_two_node_straggler_detectable(self):
+        """Lower median: with exactly 2 nodes the slow one must still be
+        flaggable (upper median would be the straggler's own value)."""
+        dm = DiagnosisDataManager(ttl_s=600)
+        self._record(dm, 0, 0.10)
+        self._record(dm, 1, 0.90)
+        op = CheckStragglerOperator(dm, ratio=2.0)
+        out = op.infer([Inference(InferenceName.STRAGGLER)])
+        assert [o.configs["node_id"] for o in out] == ["1"]
+
+    def test_malformed_report_does_not_kill_pass(self):
+        dm = DiagnosisDataManager(ttl_s=600)
+        for nid in range(3):
+            self._record(dm, nid, 0.10)
+        self._record(dm, 3, 0.90)
+        dm.store_data(4, DiagnosisDataType.OP_METRICS, "[1, 2]")
+        dm.store_data(5, DiagnosisDataType.OP_METRICS, "not json")
+        op = CheckStragglerOperator(dm, ratio=2.0)
+        out = op.infer([Inference(InferenceName.STRAGGLER)])
+        assert [o.configs["node_id"] for o in out] == ["3"]
+
+    def test_no_flag_when_uniform_or_stale(self):
+        dm = DiagnosisDataManager(ttl_s=6000)
+        for nid in range(4):
+            self._record(dm, nid, 0.10)
+        op = CheckStragglerOperator(dm, ratio=2.0)
+        assert op.infer([Inference(InferenceName.STRAGGLER)]) == []
+        # A stale slow record is ignored.
+        self._record(dm, 9, 1.0, ts=time.time() - 3600)
+        assert op.infer([Inference(InferenceName.STRAGGLER)]) == []
+
+
+class TestManagerIntegration:
+    def test_straggler_is_observational_not_actionable(self):
+        from dlrover_tpu.diagnosis.manager import DiagnosisManager
+
+        mgr = DiagnosisManager()
+        for nid in range(3):
+            mgr.data_manager.store_data(
+                nid, DiagnosisDataType.OP_METRICS,
+                json.dumps({"metrics": {"step_p50_s": 0.1}}),
+            )
+        mgr.data_manager.store_data(
+            7, DiagnosisDataType.OP_METRICS,
+            json.dumps({"metrics": {"step_p50_s": 0.9}}),
+        )
+        actions = mgr.diagnose_once()
+        assert 7 in mgr.runtime_stragglers
+        assert "step p50" in mgr.runtime_stragglers[7]
+        # No restart/relaunch for a slow-but-progressing node.
+        assert 7 not in actions
+
+
+class TestCallback:
+    def test_callback_reports_to_master(self):
+        class FakeClient:
+            def __init__(self):
+                self.reports = []
+
+            def report_diagnosis_data(self, data_type, content):
+                self.reports.append((data_type, content))
+
+        class S:  # minimal TrainerState stand-in
+            step = 0
+
+        client = FakeClient()
+        cb = OpMetricsCallback(report_every=2, master_client=client)
+        f = jax.jit(lambda x: (x * 2).sum())
+        x = jnp.ones((8,))
+        for step in range(1, 5):
+            S.step = step
+            f(x).block_until_ready()
+            cb.on_step_end(None, S, None, {})
+        kinds = {k for k, _ in client.reports}
+        assert kinds == {"op_metrics"}
+        assert len(client.reports) == 2  # steps 2 and 4
+        payload = json.loads(client.reports[-1][1])
+        assert "metrics" in payload
