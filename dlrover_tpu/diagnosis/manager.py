@@ -182,7 +182,11 @@ class DiagnosisManager:
             for c in conclusions
             if c.name == InferenceName.STRAGGLER and c.resolved
         }
-        if stragglers and stragglers != self.runtime_stragglers:
+        # Dedup on the node SET: reasons embed fluctuating p50 numbers,
+        # so comparing whole dicts would log every pass.
+        if stragglers and (
+            stragglers.keys() != self.runtime_stragglers.keys()
+        ):
             logger.warning("runtime stragglers: %s", stragglers)
         self.runtime_stragglers = stragglers
         actions = coordinate_solutions(conclusions)

@@ -206,82 +206,67 @@ def build_interleaved_1f1b_schedule(
     """
     S, V, M = n_stages, n_chunks, n_micro
     SV = S * V
+    n_slot = min(M, SV)
 
-    # Per-VIRTUAL-stage action queues, exactly the 1F1B ramp at depth SV.
-    queues: list = []  # [S][V] -> list[('f'|'b', m)]
-    for s in range(S):
-        per_chunk = []
-        for v in range(V):
-            j = v * S + s
-            warmup = min(SV - 1 - j, M)
-            acts = [("f", m) for m in range(warmup)]
-            nf, nb = warmup, 0
-            while nf < M or nb < M:
-                if nf < M:
-                    acts.append(("f", nf))
-                    nf += 1
-                if nb < M and nb < nf:
-                    acts.append(("b", nb))
-                    nb += 1
-            per_chunk.append(acts)
-        queues.append(per_chunk)
-
+    # Dependency-driven list scheduling: per (tick, physical stage) at
+    # most one fwd and one bwd unit; bwd picked first (it drains live
+    # activations — the 1F1B discipline); fwd admission is bounded by
+    # the executor's ring capacity (in-flight fwd-not-yet-bwd micros per
+    # virtual stage < n_slot, which with in-order admission/retirement
+    # also guarantees the m % n_slot ring slots never collide).  This
+    # replaces the earlier per-virtual-stage fixed 1F1B action queues,
+    # whose depth-SV warmup over-serialized at V > 1 (~25% more ticks at
+    # S2 V4 M8).
     done_f: dict = {}  # (m, j) -> tick
     done_b: dict = {}
-    ptr = [[0] * V for _ in range(S)]
+    next_f = [0] * SV  # next micro to forward at virtual stage j
+    next_b = [0] * SV
     fwd_rows, bwd_rows = [], []
     t = 0
-    while any(
-        ptr[s][v] < len(queues[s][v]) for s in range(S) for v in range(V)
-    ):
+    while any(next_b[j] < M for j in range(SV)):
         frow = [-1] * S
         brow = [-1] * S
-
-        def rank(m: int, v: int) -> int:
-            # Megatron interleaved order: microbatches advance in groups
-            # of S per chunk, cycling chunks — group-major, then chunk,
-            # then micro-within-group.  Without this the lowest chunk
-            # monopolizes the per-tick slot and the pipeline degenerates
-            # toward a depth-S*V non-interleaved schedule.
-            return (m // S) * (V * S) + v * S + (m % S)
-
         for s in range(S):
-            # At most one fwd and one bwd unit per physical stage per
-            # tick, taken from the *heads* of its V virtual queues
-            # (within a virtual stage the 1F1B order is fixed; across
-            # chunks the grouped rank decides who gets the slot).  Two
-            # picks per tick so an f and a b can land in either order —
-            # a queue whose head is 'b' must not starve its trailing 'f'.
-            for _ in range(2):
-                cands = []
-                for v in range(V):
-                    if ptr[s][v] >= len(queues[s][v]):
-                        continue
-                    kind, m = queues[s][v][ptr[s][v]]
-                    j = v * S + s
-                    if kind == "f":
-                        if frow[s] >= 0:
-                            continue
-                        ready = j == 0 or done_f.get((m, j - 1), t) < t
-                    else:
-                        if brow[s] >= 0:
-                            continue
-                        if j == SV - 1:
-                            ready = done_f.get((m, j), t) < t
-                        else:
-                            ready = done_b.get((m, j + 1), t) < t
-                    if ready:
-                        cands.append((rank(m, v), kind, v, m, j))
-                if not cands:
-                    break
-                _, kind, v, m, j = min(cands)
-                if kind == "f":
-                    frow[s] = m * V + v
-                    done_f[(m, j)] = t
+            # Backward unit: earliest micro first, deeper chunk breaking
+            # ties (it unblocks the longest dependency chain).
+            cands = []
+            for v in range(V):
+                j = v * S + s
+                m = next_b[j]
+                if m >= M:
+                    continue
+                if j == SV - 1:
+                    ready = done_f.get((m, j), t) < t
                 else:
-                    brow[s] = m * V + v
-                    done_b[(m, j)] = t
-                ptr[s][v] += 1
+                    ready = done_b.get((m, j + 1), t) < t
+                if ready:
+                    cands.append(((m, -j), v, m, j))
+            if cands:
+                _, v, m, j = min(cands)
+                brow[s] = m * V + v
+                done_b[(m, j)] = t
+                next_b[j] += 1
+
+            # Forward unit: Megatron grouped order — microbatches advance
+            # in groups of S per chunk, cycling chunks — so no chunk
+            # monopolizes the slot.
+            cands = []
+            for v in range(V):
+                j = v * S + s
+                m = next_f[j]
+                if m >= M:
+                    continue
+                ready = j == 0 or done_f.get((m, j - 1), t) < t
+                if m - next_b[j] >= n_slot:
+                    ready = False  # ring full at this virtual stage
+                if ready:
+                    rank = (m // S) * (V * S) + v * S + (m % S)
+                    cands.append((rank, v, m, j))
+            if cands:
+                _, v, m, j = min(cands)
+                frow[s] = m * V + v
+                done_f[(m, j)] = t
+                next_f[j] += 1
         fwd_rows.append(frow)
         bwd_rows.append(brow)
         t += 1
