@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import sys
 import time
+from typing import Optional
 
 REFERENCE_HFU_PCT = 62.5  # reference Llama2-7B FSDP HFU (BASELINE.md)
 
@@ -194,6 +195,106 @@ def _measure_candidate(cfg, batch, seq, remat, iters, opt="adamw",
     return dt, loss
 
 
+def _measure_candidate_subproc(
+    name, cfg, batch, seq, remat, iters, opt, fp8,
+    timeout_s: Optional[float] = None,
+):
+    """Run one candidate measurement in a subprocess with a hard kill.
+
+    The in-process watchdog (``ensure_live_backend``) only probes ONCE
+    at startup: if the device tunnel wedges MID-sweep, a compile or
+    execute blocks forever inside C++ where no signal-based timeout can
+    reach, and the whole bench (the round's one verified-perf artifact)
+    produces nothing.  A subprocess can always be killed; a candidate
+    that hangs just scores as failed and the sweep moves on."""
+    import os
+    import signal
+    import subprocess
+    import tempfile
+
+    if timeout_s is None:
+        timeout_s = float(
+            os.environ.get("DLROVER_TPU_BENCH_CANDIDATE_TIMEOUT", "1800")
+        )
+    spec = {
+        "model": name, "batch": batch, "seq": seq, "remat": remat,
+        "iters": iters, "opt": opt, "fp8": fp8,
+        "cfg": {
+            k: v for k, v in cfg.__dict__.items()
+            if isinstance(v, (int, float, str, bool))
+        },
+    }
+    out_path = tempfile.mktemp(prefix="bench_cand_")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--measure-one", out_path],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.DEVNULL, stderr=None,
+        start_new_session=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    try:
+        proc.communicate(json.dumps(spec).encode(), timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+        raise TimeoutError(
+            f"candidate {name} exceeded {timeout_s:.0f}s (wedged backend?)"
+        )
+    try:
+        with open(out_path) as f:
+            result = json.load(f)
+    except (OSError, ValueError):
+        raise RuntimeError(
+            f"candidate {name} failed (exit {proc.returncode})"
+        )
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+    if "error" in result:
+        raise RuntimeError(result["error"])
+    return result["dt"], result["loss"]
+
+
+def _measure_one_main(out_path: str) -> int:
+    """Subprocess entry: read a candidate spec JSON on stdin, measure
+    in-process, write {dt, loss} (or {error}) to ``out_path``."""
+    import dataclasses as _dc
+
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # beat the tunnel shim
+    spec = json.load(sys.stdin)
+    result: dict
+    try:
+        from dlrover_tpu.models import llama
+
+        cfg_kwargs = dict(spec["cfg"])
+        # dtype is not JSON-serializable; configs here are bf16 anyway.
+        cfg = llama.LlamaConfig(**{
+            k: v for k, v in cfg_kwargs.items()
+            if k in {f.name for f in _dc.fields(llama.LlamaConfig)}
+        })
+        dt, loss = _measure_candidate(
+            cfg, spec["batch"], spec["seq"], spec["remat"],
+            spec["iters"], spec["opt"], spec["fp8"],
+        )
+        result = {"dt": dt, "loss": loss}
+    except Exception as e:  # noqa: BLE001
+        result = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+    return 0 if "error" not in result else 1
+
+
 def measure_goodput(total_steps=80, timeout_s=900):
     """North-star probe (BASELINE.md): goodput under an injected worker
     failure.  Runs the real launcher->master->agent->worker tree on CPU
@@ -347,8 +448,15 @@ def main() -> int:
     best = None  # (flops/sec, name, cfg, batch, remat, opt, dt, loss, fp8)
     for name, cfg, batch, remat, opt, probe_iters, fp8 in candidates:
         try:
-            dt, loss = _measure_candidate(cfg, batch, seq, remat,
-                                          probe_iters, opt, fp8)
+            if on_tpu:
+                # Subprocess + hard timeout: a tunnel that wedges
+                # mid-sweep must cost one candidate, not the bench.
+                dt, loss = _measure_candidate_subproc(
+                    name, cfg, batch, seq, remat, probe_iters, opt, fp8
+                )
+            else:
+                dt, loss = _measure_candidate(cfg, batch, seq, remat,
+                                              probe_iters, opt, fp8)
         except Exception as e:  # noqa: BLE001 - OOM/compile failure
             print(
                 f"bench: candidate {name} b={batch} remat={remat} "
@@ -374,8 +482,13 @@ def main() -> int:
     _, name, cfg, batch, remat, opt, dt, loss, fp8 = best
     # Re-measure the winner at full iteration count for a stable number.
     try:
-        dt, loss = _measure_candidate(cfg, batch, seq, remat, iters, opt,
-                                      fp8)
+        if on_tpu:
+            dt, loss = _measure_candidate_subproc(
+                name, cfg, batch, seq, remat, iters, opt, fp8
+            )
+        else:
+            dt, loss = _measure_candidate(cfg, batch, seq, remat, iters,
+                                          opt, fp8)
     except Exception:  # noqa: BLE001 - keep the probe measurement
         pass
 
@@ -423,4 +536,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "--measure-one":
+        sys.exit(_measure_one_main(sys.argv[2]))
     sys.exit(main())
