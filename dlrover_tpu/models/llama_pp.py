@@ -192,3 +192,58 @@ def pipeline_train_grads(
         "lm_head": d_post["lm_head"],
     }
     return loss, grads
+
+
+def strategy_loss_builder(cfg: LlamaConfig, *, devices=None,
+                          n_microbatches=None, **loss_kw):
+    """``accelerate(loss_fn_builder=...)`` bridge: candidates rewrite
+    the MODEL the way the reference's opt_lib transforms do.
+
+    - ``remat == "block"`` -> ``cfg.remat_block=True`` (per-block
+      checkpointing inside the model);
+    - ``mesh.pp > 1`` -> the GPipe pipelined loss over the candidate's
+      own mesh (so the BO search can genuinely score pipeline points
+      instead of treating the pp axis as replication);
+    - otherwise the plain :func:`llama.loss_fn`.
+    """
+    import dataclasses as _dc
+
+    from dlrover_tpu.parallel.mesh import build_mesh
+
+    def builder(strategy):
+        c = (
+            _dc.replace(cfg, remat_block=True)
+            if strategy.remat == "block" else cfg
+        )
+        spec = strategy.mesh
+        if spec.pp > 1:
+            # The pipelined loss has no moe_aux/fused-lm-head knobs: a
+            # pp candidate silently training a DIFFERENT objective than
+            # its dp peers would corrupt the search — reject loudly
+            # (the sweep logs it and moves on).  moe_aux_weight=0.0 is
+            # equivalent (the pipeline head never adds aux).
+            unsupported = {
+                k: v for k, v in loss_kw.items()
+                if not (k == "moe_aux_weight" and v == 0.0)
+            }
+            if unsupported:
+                raise ValueError(
+                    "strategy_loss_builder: pipeline path cannot honor "
+                    f"loss kwargs {sorted(unsupported)}"
+                )
+            mesh = build_mesh(spec, devices)  # defaults + normalizes
+            M = n_microbatches or max(2, spec.pp)
+
+            def pp_loss(params, batch):
+                return pipeline_loss_fn(
+                    params, batch, c, mesh, n_microbatches=M
+                )
+
+            return pp_loss
+
+        def loss(params, batch):
+            return llama.loss_fn(params, batch, c, **loss_kw)
+
+        return loss
+
+    return builder

@@ -65,6 +65,11 @@ class TrainingArgs:
     # Parameter layouts from the cost-model planner (axis->dim search,
     # parallel/layout_planner.py) instead of the ZeRO-3 heuristic.
     layout_planner: bool = False
+    # Per-op runtime metrics (utils/op_metrics.py, the xpu-timer
+    # analogue): capture a jax-profiler trace of one step every N steps
+    # and feed step percentiles + op-class fractions to the master's
+    # diagnosis chain. 0 = off.
+    op_metrics_every: int = 0
 
 
 @dataclasses.dataclass
@@ -242,6 +247,16 @@ class Trainer:
         self.callbacks += list(callbacks)
         if args.early_stopping_patience > 0:
             self.callbacks.append(EarlyStoppingCallback())
+        if args.op_metrics_every > 0:
+            from dlrover_tpu.utils.op_metrics import OpMetricsCallback
+
+            self.callbacks.append(
+                OpMetricsCallback(
+                    capture_every=args.op_metrics_every,
+                    report_every=args.op_metrics_every,
+                    master_client=master_client,
+                )
+            )
 
         total = self.total_steps(dataset_size)
         if optimizer_fn is not None:

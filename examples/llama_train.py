@@ -33,6 +33,9 @@ def parse_args():
     p.add_argument("--strategy", default="dp",
                    choices=["dp", "auto"])
     p.add_argument("--remat_block", action="store_true")
+    p.add_argument("--fp8", action="store_true",
+                   help="route attention/MLP linears through e4m3/e5m2 "
+                        "fp8_dot with delayed scaling")
     p.add_argument("--dataset_size", type=int, default=4096)
     p.add_argument("--ckpt_dir", default="")
     p.add_argument("--ckpt_interval", type=int, default=5)
@@ -84,15 +87,26 @@ def main() -> int:
     )
     strategy = (
         "auto" if args.strategy == "auto"
-        else Strategy(mesh=MeshSpec(dp=len(jax.devices())))
+        else Strategy(
+            mesh=MeshSpec(dp=len(jax.devices())), fp8=args.fp8
+        )
+    )
+    # One signature for both modes (fp8_states defaults to None in
+    # llama.loss_fn): under --strategy auto the sweep mixes fp8 and
+    # non-fp8 candidates, and a required fp8_states would silently
+    # reject every non-fp8 point.
+    loss_fn = lambda p, b, fp8_states=None: llama.loss_fn(  # noqa: E731
+        p, b, cfg, fp8_states=fp8_states
     )
     job = accelerate(
-        loss_fn=lambda p, b: llama.loss_fn(p, b, cfg),
+        loss_fn=loss_fn,
         init_fn=lambda r: llama.init_params(r, cfg),
         optimizer=optax.adamw(args.lr),
         sample_batch={"tokens": sample},
         strategy=strategy,
         param_specs="planner",
+        fp8_init=(lambda: llama.init_fp8_states(cfg))
+        if args.fp8 else None,
     )
     state = job.create_state(jax.random.PRNGKey(0))
 

@@ -289,3 +289,21 @@ class TestLayoutPlannerWiring:
         assert state.step == 2
         w = trainer.core.state["params"]["w"]
         assert any(ax is not None for ax in w.sharding.spec)
+
+
+class TestOpMetricsIntegration:
+    def test_trainer_collects_op_metrics(self):
+        """TrainingArgs(op_metrics_every=N) attaches the xpu-timer
+        analogue: per-step stats + a per-op capture happen inside the
+        real loop."""
+        from dlrover_tpu.utils.op_metrics import OpMetricsCallback
+
+        tr = _make_trainer(max_steps=6, op_metrics_every=2)
+        cbs = [c for c in tr.callbacks
+               if isinstance(c, OpMetricsCallback)]
+        assert len(cbs) == 1
+        tr.train(resume=False)
+        m = cbs[0].collector.metrics()
+        assert m["step_steps"] >= 6
+        assert m["step_p50_s"] > 0
+        assert m["last_capture_step"] >= 2  # a capture actually ran
