@@ -37,6 +37,9 @@ def parse_args():
 
 def main() -> int:
     args = parse_args()
+    from dlrover_tpu.common.jax_env import ensure_platform
+
+    ensure_platform()  # the tunnel shim can override JAX_PLATFORMS
     import jax
     import optax
 
