@@ -125,14 +125,18 @@ def pipeline_apply(
             buf = jnp.where(stage_idx == 0,
                             micros[inject].astype(cdt), buf)
             out = stage_fn(params_me, buf.astype(x_local.dtype))
-            # Last stage emits microbatch (t - n_stages + 1).
+            # Last stage emits microbatch (t - n_stages + 1).  The
+            # select happens on the SLICE, not the whole [M, ...]
+            # buffer — a full-buffer where() per tick would add
+            # O(M x micro) memory traffic to every stage.
             emit = t - (n_stages - 1)
             emit_clip = jnp.clip(emit, 0, n_microbatches - 1)
-            outputs = jnp.where(
+            slice_new = jnp.where(
                 (stage_idx == n_stages - 1) & (emit >= 0),
-                outputs.at[emit_clip].set(out.astype(cdt)),
-                outputs,
+                out.astype(cdt),
+                outputs[emit_clip],
             )
+            outputs = outputs.at[emit_clip].set(slice_new)
             # Shift activations to the next stage.
             perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
             buf = _safe_ppermute(out.astype(cdt), pp_axis, perm)
