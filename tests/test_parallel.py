@@ -1251,3 +1251,84 @@ class TestMoEExactness:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref.reshape(x.shape)), atol=1e-6
         )
+
+
+class TestLongContextLlama:
+    """Model-level long-context paths: llama trains with the sequence
+    sharded over the mesh via ring attention / Ulysses SP, matching the
+    single-device reference loss (SURVEY §5 long-context; reference
+    distributed_attention.py:21 + sequence_parallel_optimization.py:9)."""
+
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_llama_loss_matches_reference(self, cpu_mesh_devices, impl):
+        from dlrover_tpu.models import llama
+
+        # fp32 + n_kv_head == n_head: ring/ulysses repeat KV heads so
+        # GQA parity is exercised elsewhere; here the check is the
+        # sequence-sharded attention itself.
+        cfg = llama.LlamaConfig.tiny(
+            n_layer=2, n_head=4, n_kv_head=4, dtype=jnp.float32,
+            max_seq_len=128,
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 65), 0, cfg.vocab_size
+        )
+        batch = {"tokens": tokens}
+        ref = float(
+            llama.loss_fn(params, batch, cfg, attn_impl="reference",
+                          moe_aux_weight=0.0)
+        )
+        mesh = Mesh(
+            np.array(cpu_mesh_devices[:4]).reshape(2, 2), ("dp", "tp")
+        )
+        with mesh:
+            got = float(
+                jax.jit(
+                    lambda p, b: llama.loss_fn(
+                        p, b, cfg, attn_impl=impl, mesh=mesh,
+                        moe_aux_weight=0.0,
+                    )
+                )(params, batch)
+            )
+        np.testing.assert_allclose(got, ref, rtol=2e-5)
+
+    def test_llama_trains_with_ring_attention(self, cpu_mesh_devices):
+        """A few steps of real training through the ring path: loss
+        falls (the long-context configuration is trainable end-to-end,
+        not just a forward parity point)."""
+        import optax
+
+        from dlrover_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny(
+            n_layer=2, n_head=4, n_kv_head=4, max_seq_len=128
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        mesh = Mesh(
+            np.array(cpu_mesh_devices[:2]).reshape(1, 2), ("dp", "tp")
+        )
+        tx = optax.adamw(5e-3)
+        opt = tx.init(params)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 65), 0, 64
+        )
+        batch = {"tokens": tokens}
+
+        @jax.jit
+        def step(p, o, b):
+            loss, g = jax.value_and_grad(
+                lambda pp: llama.loss_fn(
+                    pp, b, cfg, attn_impl="ring", mesh=mesh,
+                    moe_aux_weight=0.0,
+                )
+            )(p)
+            up, o = tx.update(g, o, p)
+            return optax.apply_updates(p, up), o, loss
+
+        with mesh:
+            losses = []
+            for _ in range(8):
+                params, opt, loss = step(params, opt, batch)
+                losses.append(float(loss))
+        assert losses[-1] < losses[0] - 0.3, losses
