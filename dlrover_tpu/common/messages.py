@@ -511,8 +511,9 @@ class ReplicaData(Message):
 
 @dataclasses.dataclass
 class EmbeddingOp(Message):
-    """One embedding-store RPC: op in {lookup, apply, export, import,
-    delete, filter, size}.  keys/grads/blob are packed numpy bytes."""
+    """One embedding-store RPC: op in {lookup, apply, export,
+    export_keys, import, delete, filter, size}.  keys/grads/blob are
+    packed numpy bytes."""
 
     table: str = ""
     op: str = "lookup"

@@ -240,9 +240,11 @@ class DeviceEmbeddingCache:
         slots = np.asarray(slots)
         D = self.dim
         n = len(slots)
+        from dlrover_tpu.embedding.store import row_bytes_for
+
         rb = self.store.row_bytes
-        assert rb == 24 + 12 * D, (
-            f"store row layout changed ({rb} != {24 + 12 * D}); "
+        assert rb == row_bytes_for(D), (
+            f"store row layout changed ({rb} != {row_bytes_for(D)}); "
             "update DeviceEmbeddingCache._snapshot"
         )
         idx = jnp.asarray(slots)

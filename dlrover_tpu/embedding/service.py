@@ -97,6 +97,14 @@ class EmbeddingServicer:
             return m.EmbeddingResult(
                 blob=blob, count=len(blob) // st.row_bytes
             )
+        if msg.op == "export_keys":
+            keys = np.frombuffer(msg.keys, np.int64)
+            dim = int(msg.optimizer.get("dim", 0))
+            st = self.table(msg.table, dim)
+            blob = st.export_keys(keys)
+            return m.EmbeddingResult(
+                blob=blob, count=len(blob) // st.row_bytes
+            )
         if msg.op == "import":
             dim = int(msg.optimizer.get("dim", 0))
             st = self.table(msg.table, dim)
@@ -182,31 +190,42 @@ class DistributedEmbedding:
     def world(self) -> int:
         return len(self._clients)
 
-    # -- data path ---------------------------------------------------------
-    def lookup(self, keys: np.ndarray, train: bool = True) -> np.ndarray:
-        keys = np.asarray(keys, np.int64).reshape(-1)
-        owners = _owner(keys, self.world)
-        out = np.empty((len(keys), self.dim), np.float32)
-        futs = {}
+    def _fanout(self, owners: np.ndarray, build_op) -> list:
+        """Owner-routed scatter/gather: ``build_op(rank, idx) ->
+        EmbeddingOp`` per non-empty rank; returns ``[(rank, idx,
+        EmbeddingResult)]`` with per-rank failures raised.  The one copy
+        of the routing pattern lookup/apply/export_keys/import share."""
+        futs = []
         for r in range(self.world):
             idx = np.nonzero(owners == r)[0]
             if len(idx) == 0:
                 continue
-            futs[r] = (
-                idx,
-                self._pool.submit(
-                    self._clients[r].call,
-                    m.EmbeddingOp(
-                        table=self.table, op="lookup",
-                        keys=keys[idx].tobytes(), train=train,
-                        optimizer={"dim": self.dim},
-                    ),
-                ),
-            )
-        for r, (idx, fut) in futs.items():
+            futs.append((r, idx, self._pool.submit(
+                self._clients[r].call, build_op(r, idx)
+            )))
+        out = []
+        for r, idx, fut in futs:
             resp = fut.result()
             if not resp.success:
-                raise RuntimeError(f"lookup on server {r}: {resp.reason}")
+                raise RuntimeError(
+                    f"embedding rpc on server {r}: {resp.reason}"
+                )
+            out.append((r, idx, resp))
+        return out
+
+    # -- data path ---------------------------------------------------------
+    def lookup(self, keys: np.ndarray, train: bool = True) -> np.ndarray:
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        out = np.empty((len(keys), self.dim), np.float32)
+        results = self._fanout(
+            _owner(keys, self.world),
+            lambda r, idx: m.EmbeddingOp(
+                table=self.table, op="lookup",
+                keys=keys[idx].tobytes(), train=train,
+                optimizer={"dim": self.dim},
+            ),
+        )
+        for _, idx, resp in results:
             out[idx] = np.frombuffer(resp.rows, np.float32).reshape(
                 len(idx), self.dim
             )
@@ -215,27 +234,15 @@ class DistributedEmbedding:
     def apply_gradients(self, keys: np.ndarray, grads: np.ndarray) -> None:
         keys = np.asarray(keys, np.int64).reshape(-1)
         grads = np.asarray(grads, np.float32).reshape(len(keys), self.dim)
-        owners = _owner(keys, self.world)
-        futs = []
-        for r in range(self.world):
-            idx = np.nonzero(owners == r)[0]
-            if len(idx) == 0:
-                continue
-            futs.append(
-                self._pool.submit(
-                    self._clients[r].call,
-                    m.EmbeddingOp(
-                        table=self.table, op="apply",
-                        keys=keys[idx].tobytes(),
-                        grads=grads[idx].tobytes(),
-                        optimizer={**self.optimizer, "dim": self.dim},
-                    ),
-                )
-            )
-        for fut in futs:
-            resp = fut.result()
-            if not resp.success:
-                raise RuntimeError(f"apply failed: {resp.reason}")
+        self._fanout(
+            _owner(keys, self.world),
+            lambda r, idx: m.EmbeddingOp(
+                table=self.table, op="apply",
+                keys=keys[idx].tobytes(),
+                grads=grads[idx].tobytes(),
+                optimizer={**self.optimizer, "dim": self.dim},
+            ),
+        )
 
     def size(self) -> int:
         total = 0
@@ -243,6 +250,50 @@ class DistributedEmbedding:
             resp = c.call(m.EmbeddingOp(table=self.table, op="size"))
             total += resp.count
         return total
+
+    # -- full-row fetch / write-back (DeviceEmbeddingCache backend) --------
+    @property
+    def row_bytes(self) -> int:
+        """Shared binary row layout record size (see
+        ``store.row_bytes_for`` — the single source of truth)."""
+        from dlrover_tpu.embedding.store import row_bytes_for
+
+        return row_bytes_for(self.dim)
+
+    def export_keys(self, keys: np.ndarray) -> bytes:
+        """Fetch exactly ``keys``' full rows (emb + optimizer slots +
+        metadata), routed to their owners — what the device-resident
+        cache needs on admit."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        results = self._fanout(
+            _owner(keys, self.world),
+            lambda r, idx: m.EmbeddingOp(
+                table=self.table, op="export_keys",
+                keys=keys[idx].tobytes(),
+                optimizer={"dim": self.dim},
+            ),
+        )
+        return b"".join(resp.blob for _, _, resp in results)
+
+    def import_rows(self, blob: bytes) -> int:
+        """Write full rows back, each to its owner (the cache's flush
+        path)."""
+        rb = self.row_bytes
+        arr = np.frombuffer(blob, np.uint8)
+        n = len(arr) // rb
+        if n == 0:
+            return 0
+        rec = arr[: n * rb].reshape(n, rb)
+        row_keys = rec[:, :8].copy().view(np.int64).reshape(-1)
+        results = self._fanout(
+            _owner(row_keys, self.world),
+            lambda r, idx: m.EmbeddingOp(
+                table=self.table, op="import",
+                blob=rec[idx].tobytes(),
+                optimizer={"dim": self.dim},
+            ),
+        )
+        return sum(resp.count for _, _, resp in results)
 
     # -- elastic resize ----------------------------------------------------
     def rebalance(self, new_addrs: Sequence[str]) -> int:

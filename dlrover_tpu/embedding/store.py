@@ -15,6 +15,15 @@ from typing import Optional, Tuple
 import numpy as np
 
 from dlrover_tpu.common.log import logger
+
+
+def row_bytes_for(dim: int) -> int:
+    """The shared binary row layout's record size:
+    ``key,freq,version (i64 x3) + emb,slot0,slot1 (f32[dim] x3)``.
+    Single source of truth for every layout-aware consumer
+    (export/import here, the service router, the device cache); the
+    native backend's ``kv_row_bytes`` must agree."""
+    return 24 + 12 * dim
 from dlrover_tpu.common.native import load_library
 
 _LIB_LOCK = threading.Lock()
@@ -401,7 +410,7 @@ class EmbeddingStore:
     @property
     def row_bytes(self) -> int:
         if self._py is not None:
-            return 24 + 12 * self.dim
+            return row_bytes_for(self.dim)
         return int(self._lib.kv_row_bytes(self._handle))
 
     def export(self, rank_filter: int = 0, world: int = 1) -> bytes:

@@ -679,3 +679,70 @@ class TestDeviceCache:
         ids = np.unique(keys.reshape(-1))[:8]
         got = store.lookup(ids, train=False)
         assert np.abs(got).sum() > 0
+
+
+class TestDeviceCacheOverService:
+    def test_cache_trains_over_servers_and_survives_rebalance(self):
+        """DeviceEmbeddingCache duck-types over DistributedEmbedding:
+        admits pull full rows from their owner servers, flushes write
+        them back, and a server-set rebalance (the PS-elasticity path)
+        preserves the device-trained values."""
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.embedding.device_cache import (
+            DeviceEmbeddingCache,
+            sparse_adagrad_apply,
+        )
+        from dlrover_tpu.embedding.service import (
+            DistributedEmbedding,
+            EmbeddingServer,
+        )
+
+        dim, lr = 4, 0.1
+        s0 = EmbeddingServer(0, dim_by_table={"t": dim})
+        s1 = EmbeddingServer(1, dim_by_table={"t": dim})
+        s2 = EmbeddingServer(2, dim_by_table={"t": dim})
+        try:
+            de = DistributedEmbedding("t", dim, addrs=[s0.addr, s1.addr])
+            cache = DeviceEmbeddingCache(de, 64, flush_every=0)
+            apply_j = jax.jit(
+                lambda t, a, s, g: sparse_adagrad_apply(t, a, s, g, lr=lr)
+            )
+            keys = np.arange(20, dtype=np.int64)
+            g = np.ones((20, dim), np.float32)
+            for _ in range(3):
+                slots = cache.map_batch(keys)
+                t, a = apply_j(cache.table, cache.accum,
+                               jnp.asarray(slots), jnp.asarray(g))
+                cache.update(t, a)
+            trained = np.asarray(
+                cache.table[jnp.asarray(cache.map_batch(keys))]
+            )
+            cache.flush()
+            # The servers now hold the device-trained rows...
+            np.testing.assert_allclose(
+                de.lookup(keys, train=False), trained, rtol=1e-5
+            )
+            # ...and survive an elastic rebalance 2 -> 3 servers.
+            de.rebalance([s0.addr, s1.addr, s2.addr])
+            np.testing.assert_allclose(
+                de.lookup(keys, train=False), trained, rtol=1e-5
+            )
+            # A fresh cache over the new server set re-admits the same
+            # values AND the adagrad accumulator (full-row round trip).
+            cache2 = DeviceEmbeddingCache(de, 64, flush_every=0)
+            slots2 = cache2.map_batch(keys)
+            np.testing.assert_allclose(
+                np.asarray(cache2.table[jnp.asarray(slots2)]), trained,
+                rtol=1e-5,
+            )
+            np.testing.assert_allclose(
+                np.asarray(cache2.accum[jnp.asarray(slots2)]),
+                np.asarray(cache.accum[jnp.asarray(cache.map_batch(keys))]),
+                rtol=1e-5,
+            )
+        finally:
+            de.close()
+            for s in (s0, s1, s2):
+                s.stop()
