@@ -26,6 +26,37 @@ def ensure_platform(platform: Optional[str] = None) -> None:
         jax.config.update("jax_platforms", want)
 
 
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> bool:
+    """Persistent XLA compilation cache (SURVEY §7 'warm-restart design:
+    cache compiled executables keyed by topology').
+
+    Elastic recovery is recompile-dominated: a restarted worker rebuilds
+    the SAME jitted step the pre-kill worker already compiled, so a
+    disk-backed cache turns most of that downtime into a cache read.
+    Controlled by ``DLROVER_TPU_COMPILE_CACHE``: unset/1 -> on at
+    ``~/.cache/dlrover_tpu/xla`` (or ``cache_dir``), a path -> on
+    there, ``0``/``off`` -> disabled.  Returns True when enabled."""
+    env = os.environ.get("DLROVER_TPU_COMPILE_CACHE", "")
+    if env.lower() in ("0", "off", "false"):
+        return False
+    if env and env not in ("1", "on", "true"):
+        cache_dir = env
+    if not cache_dir:
+        cache_dir = os.path.expanduser("~/.cache/dlrover_tpu/xla")
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Cache every executable: recovery cares about the long tail of
+        # small programs too (the defaults skip fast compiles).
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        return True
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        return False
+
+
 def initialize_distributed_from_env() -> bool:
     """Run ``jax.distributed.initialize`` from the agent-provided env
     contract (reference analogue: torchelastic's c10d store bootstrap, here

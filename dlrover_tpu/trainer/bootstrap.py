@@ -88,6 +88,10 @@ def init(connect_master: bool = True) -> ElasticContext:
     ctx = ElasticContext()
     set_role(f"worker-{ctx.process_id}")
     ensure_platform()
+    from dlrover_tpu.common.jax_env import enable_compilation_cache
+
+    if enable_compilation_cache():
+        logger.info("persistent XLA compilation cache enabled")
     ctx.distributed = initialize_distributed_from_env()
     if ctx.distributed:
         import jax

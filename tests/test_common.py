@@ -184,3 +184,28 @@ class TestPublicAPI:
 
         with pytest.raises(AttributeError):
             dt.does_not_exist
+
+
+class TestCompilationCache:
+    def test_enable_compilation_cache_modes(self, tmp_path, monkeypatch):
+        import jax
+
+        from dlrover_tpu.common.jax_env import enable_compilation_cache
+
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            monkeypatch.setenv("DLROVER_TPU_COMPILE_CACHE", "0")
+            assert enable_compilation_cache() is False
+
+            d = str(tmp_path / "xla")
+            monkeypatch.setenv("DLROVER_TPU_COMPILE_CACHE", d)
+            assert enable_compilation_cache() is True
+            assert jax.config.jax_compilation_cache_dir == d
+            assert (tmp_path / "xla").is_dir()
+
+            # A compiled program actually lands in the cache dir.
+            jax.jit(lambda x: x * 2 + 1)(jax.numpy.ones((32,))
+                                         ).block_until_ready()
+            assert any((tmp_path / "xla").iterdir())
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
