@@ -146,13 +146,15 @@ def generate(
     rng: Optional[jax.Array] = None,
     temperature: float = 0.0,  # 0 = greedy
     top_k: int = 0,
+    top_p: float = 0.0,  # 0 = off; else nucleus sampling
 ) -> jax.Array:
     """[B, P + max_new_tokens] — prompt + sampled continuation.
 
     Prefill scores the prompt in one pass; decode is a ``lax.scan`` of
     single-token steps against the KV cache.  ``temperature=0`` is
     greedy (deterministic); otherwise categorical sampling with optional
-    top-k truncation.
+    top-k truncation and/or top-p (nucleus) filtering — the sampling
+    surface of the serving engine the reference RL stack delegates to.
     """
     if max_new_tokens == 0:
         return prompts
@@ -170,6 +172,19 @@ def generate(
         if top_k > 0:
             kth = jnp.sort(scaled, axis=-1)[:, -top_k, None]
             scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        if top_p > 0.0:
+            # Nucleus: keep the smallest prefix of the sorted
+            # distribution whose mass reaches top_p (the top token
+            # always survives).
+            srt = jnp.sort(scaled, axis=-1)[:, ::-1]  # descending
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = cum - probs < top_p
+            n_keep = jnp.maximum(1, jnp.sum(keep_sorted, axis=-1))
+            cutoff = jnp.take_along_axis(
+                srt, (n_keep - 1)[:, None], axis=-1
+            )
+            scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
         return jax.random.categorical(sub, scaled)
 
     rng, sub = jax.random.split(rng)

@@ -124,3 +124,34 @@ class TestKVCacheDecode:
             params, cfg, prompts, max_new_tokens=8, temperature=0.0
         )
         np.testing.assert_array_equal(np.asarray(got), np.asarray(greedy))
+
+
+class TestTopP:
+    def test_sampling_respects_top_p(self):
+        """With top_p covering only the single most likely token, nucleus
+        sampling must reduce to greedy regardless of temperature."""
+        cfg, params, prompts = _setup()
+        greedy = llama_infer.generate(
+            params, cfg, prompts, max_new_tokens=5, temperature=0.0
+        )
+        tiny_p = llama_infer.generate(
+            params, cfg, prompts, max_new_tokens=5,
+            rng=jax.random.PRNGKey(3), temperature=1.0, top_p=1e-6,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(tiny_p), np.asarray(greedy)
+        )
+
+    def test_top_p_one_matches_full_sampling(self):
+        """top_p=1.0 keeps the whole distribution: same rng draws the
+        same tokens as unfiltered sampling."""
+        cfg, params, prompts = _setup()
+        a = llama_infer.generate(
+            params, cfg, prompts, max_new_tokens=5,
+            rng=jax.random.PRNGKey(5), temperature=0.8, top_p=1.0,
+        )
+        b = llama_infer.generate(
+            params, cfg, prompts, max_new_tokens=5,
+            rng=jax.random.PRNGKey(5), temperature=0.8,
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
