@@ -796,7 +796,9 @@ class TestInterleavedPipeline:
                     for a, b in zip(ss, ss[1:]):
                         assert not overlap(a, b), (S, V, M, j, kind, slot)
 
-    @pytest.mark.parametrize("S,V,M", [(2, 2, 4), (4, 2, 4), (2, 3, 6)])
+    @pytest.mark.parametrize(
+        "S,V,M", [(2, 2, 4), (4, 2, 4), (2, 3, 6), (2, 4, 4), (4, 3, 6)]
+    )
     def test_interleaved_matches_autodiff(self, cpu_mesh_devices, S, V, M):
         from dlrover_tpu.parallel.pipeline import (
             deinterleave_stage_grads,
