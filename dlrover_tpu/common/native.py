@@ -80,3 +80,18 @@ def shm_lib() -> Optional[ctypes.CDLL]:
         lib.shm_crc32.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32]
         lib._sigs_set = True
     return lib
+
+
+def packer_lib() -> Optional[ctypes.CDLL]:
+    """Native first-fit sequence packer (``native/packer.cc``)."""
+    lib = load_library("libpacker.so")
+    if lib is not None and not getattr(lib, "_sigs_set", False):
+        import numpy as np
+
+        i64 = ctypes.c_int64
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.pack_first_fit.restype = i64
+        lib.pack_first_fit.argtypes = [i64p, i64, i64, i32p, i32p, i32p]
+        lib._sigs_set = True
+    return lib

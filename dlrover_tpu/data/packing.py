@@ -18,12 +18,22 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
+from dlrover_tpu.common.log import logger
+
+
+def _packer_lib():
+    """ctypes handle for the native first-fit core (None -> fallback)."""
+    from dlrover_tpu.common.native import packer_lib
+
+    return packer_lib()
+
 
 def pack_sequences(
     docs: Sequence[np.ndarray],
     seq_len: int,
     *,
     pad_id: int = 0,
+    backend: str = "auto",  # auto | native | python
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Pack 1-D token arrays into rows of ``seq_len``.
 
@@ -48,8 +58,51 @@ def pack_sequences(
             continue
         for lo in range(0, doc.size, seq_len):
             pieces.append(doc[lo:lo + seq_len])
+    if not pieces:
+        return (
+            np.full((1, seq_len), pad_id, np.int32),
+            np.full((1, seq_len), -1, np.int32),
+        )
 
-    # First-fit: rows = list of (used, [piece, ...]).
+    if backend not in ("auto", "native", "python"):
+        raise ValueError(f"pack_sequences: unknown backend {backend!r}")
+    lib = _packer_lib() if backend in ("auto", "native") else None
+    if backend == "native" and lib is None:
+        raise RuntimeError("native packer unavailable (no toolchain?)")
+    if lib is not None:
+        # Native first-fit core (byte-identical layout to the Python
+        # loop below) + fully vectorized scatter: the interpreter never
+        # touches per-token or per-row work.
+        n = len(pieces)
+        lengths = np.fromiter(
+            (p.size for p in pieces), np.int64, count=n
+        )
+        row = np.empty(n, np.int32)
+        off = np.empty(n, np.int32)
+        seg = np.empty(n, np.int32)
+        n_rows = int(
+            lib.pack_first_fit(lengths, n, seq_len, row, off, seg)
+        )
+        if n_rows > 0:
+            tokens = np.full((n_rows, seq_len), pad_id, np.int32)
+            segs = np.full((n_rows, seq_len), -1, np.int32)
+            flat = np.concatenate(pieces).astype(np.int32)
+            total = int(lengths.sum())
+            # Destination of token t of piece i:
+            #   row[i]*seq_len + off[i] + (t - piece_start[i])
+            starts = np.repeat(
+                row.astype(np.int64) * seq_len + off, lengths
+            )
+            within = np.arange(total) - np.repeat(
+                np.cumsum(lengths) - lengths, lengths
+            )
+            dest = starts + within
+            tokens.reshape(-1)[dest] = flat
+            segs.reshape(-1)[dest] = np.repeat(seg, lengths)
+            return tokens, segs
+        logger.warning("native packer rejected input; python fallback")
+
+    # Pure-Python first-fit: rows = list of (used, [piece, ...]).
     rows: List[Tuple[int, List[np.ndarray]]] = []
     for piece in pieces:
         for i, (used, items) in enumerate(rows):

@@ -257,3 +257,46 @@ class TestSequencePacking:
         )
         # 20+4 share a row; 10 in the second: 2 rows, not 3.
         assert tokens.shape[0] == 2
+
+
+class TestNativePacker:
+    def test_native_matches_python_layout(self):
+        """The C++ first-fit core must produce byte-identical layouts to
+        the Python reference (same first-fit semantics)."""
+        import numpy as np
+
+        from dlrover_tpu.data.packing import _packer_lib, pack_sequences
+
+        if _packer_lib() is None:
+            import pytest
+
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(7)
+        docs = [
+            rng.integers(0, 500, size=int(rng.integers(1, 120)))
+            for _ in range(500)
+        ]
+        # Include oversize docs (split path) and empties.
+        docs += [rng.integers(0, 500, size=300), np.array([], np.int64)]
+        tn, sn = pack_sequences(docs, 96, backend="native")
+        tp, sp = pack_sequences(docs, 96, backend="python")
+        np.testing.assert_array_equal(tn, tp)
+        np.testing.assert_array_equal(sn, sp)
+
+    def test_native_empty_and_exact_fit(self):
+        import numpy as np
+
+        from dlrover_tpu.data.packing import _packer_lib, pack_sequences
+
+        if _packer_lib() is None:
+            import pytest
+
+            pytest.skip("no native toolchain")
+        t, s = pack_sequences([], 16, backend="auto")
+        assert t.shape == (1, 16) and (s == -1).all()
+        # Exact fits fill rows completely.
+        t, s = pack_sequences(
+            [np.arange(16), np.arange(16)], 16, backend="native"
+        )
+        assert t.shape == (2, 16)
+        assert (s >= 0).all()
