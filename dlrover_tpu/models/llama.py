@@ -48,6 +48,10 @@ class LlamaConfig:
     top_k: int = 2
     moe_every: int = 2
     capacity_factor: float = 1.25
+    # Sliding-window attention (>0: each position attends the last
+    # `sliding_window` positions only — Mistral-style long-context;
+    # flash path only, kernels skip out-of-window blocks).
+    sliding_window: int = 0
     # Per-block rematerialization: save only the residual stream at layer
     # boundaries, recompute attention/MLP internals in the backward pass.
     # Far better peak-HBM than whole-loss remat policies, which either
@@ -244,6 +248,10 @@ def _attention(
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
 
+    if cfg.sliding_window > 0 and attn_impl in ("ring", "ulysses"):
+        raise NotImplementedError(
+            "sliding_window requires the flash attention path"
+        )
     if attn_impl == "ring" and mesh is not None:
         if segment_ids is not None:
             raise NotImplementedError(
@@ -271,6 +279,7 @@ def _attention(
             causal=True,
             segment_ids=segment_ids,
             backend=None if attn_impl == "auto" else attn_impl,
+            window=cfg.sliding_window,
         )
         out = o.transpose(0, 2, 1, 3)
     out = out.reshape(B, S, H * D)

@@ -82,6 +82,11 @@ def _cached_attention(x, layer, cfg, cache_layer, offset, positions):
     kpos = jnp.arange(max_len)[None, None, None, None, :]
     qpos = positions[:, None, None, :, None]
     s = jnp.where(kpos <= qpos, s, -1e30)
+    if cfg.sliding_window > 0:
+        # Sliding window: only the last `sliding_window` positions are
+        # visible (the cache stays full-length; a rolling buffer is a
+        # memory optimization, this is the correctness mask).
+        s = jnp.where(qpos - kpos < cfg.sliding_window, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
         "bgrtk,bgkd->bgrtd", p.astype(k_cache.dtype), v_cache,

@@ -40,10 +40,13 @@ def reference_attention(
     v: jax.Array,
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
+    window: int = 0,
 ) -> jax.Array:
     """[B,H,S,D] attention in fp32 accumulation.  ``segment_ids`` [B,S]
     restricts attention to same-segment pairs (packed sequences).  GQA:
-    k/v may carry KV < H heads (H % KV == 0)."""
+    k/v may carry KV < H heads (H % KV == 0).  ``window > 0`` adds
+    sliding-window attention: position q attends only keys with
+    ``0 <= q - k < window``."""
     if k.shape[1] != q.shape[1]:  # GQA: broadcast kv heads
         rep = q.shape[1] // k.shape[1]
         k = jnp.repeat(k, rep, axis=1)
@@ -56,6 +59,17 @@ def reference_attention(
         Sq, Sk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((Sq, Sk), bool), Sk - Sq)
         s = jnp.where(mask, s, NEG_INF)
+    if window > 0:
+        # Honors the full contract 0 <= q - k < window even when
+        # causal=False (the lower bound duplicates causal's mask, but
+        # without it this ground-truth path would silently leave future
+        # keys visible).
+        Sq, Sk = s.shape[-2], s.shape[-1]
+        qpos = (Sk - Sq) + np.arange(Sq)[:, None]
+        kpos = np.arange(Sk)[None, :]
+        diff = qpos - kpos
+        s = jnp.where(jnp.asarray((diff >= 0) & (diff < window)),
+                      s, NEG_INF)
     if segment_ids is not None:
         seg = (
             segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
@@ -73,7 +87,7 @@ def reference_attention(
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal,
-                sm_scale, seq_len, segmented=False):
+                sm_scale, seq_len, segmented=False, window=0):
     from jax.experimental import pallas as pl
 
     # Blocks carry a leading unit (batch*head) dim:
@@ -101,6 +115,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal,
         num_k_blocks = jnp.minimum(
             num_k_blocks, (last_q // block_k) + 1
         )
+    start_ki = 0
+    if window > 0:
+        # K blocks entirely BELOW this Q block's window are skipped:
+        # the earliest visible key is q_start - window + 1.
+        start_ki = jnp.maximum(0, (q_start - window + 1) // block_k)
 
     def body(ki, carry):
         m, l, acc = carry
@@ -111,14 +130,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal,
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
-        if causal:
+        if causal or window > 0:
             qpos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             kpos = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            if causal:
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            if window > 0:
+                s = jnp.where(qpos - kpos < window, s, NEG_INF)
         if segmented:
             seg_q = seg_ref[0, 0, pl.ds(q_start, block_q)]
             seg_k = seg_ref[0, 0, pl.ds(k_start, block_k)]
@@ -139,7 +161,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal,
         )
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m, l, acc))
+    m, l, acc = jax.lax.fori_loop(start_ki, num_k_blocks, body, (m, l, acc))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     # lse block is [1, 1, block_q]: block_q rides the 128-lane dim directly,
@@ -185,7 +207,7 @@ def _kv_row_map(H: int, KV: int):
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
-               segment_ids=None):
+               segment_ids=None, window=0):
     from jax.experimental import pallas as pl
 
     B, H, S, D = q.shape
@@ -208,7 +230,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
     segmented = segment_ids is not None
     kernel = functools.partial(
         _fwd_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale,
-        seq_len=S, segmented=segmented,
+        seq_len=S, segmented=segmented, window=window,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
@@ -257,7 +279,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
                    block_k, causal, sm_scale, seq_len, padded_len,
-                   segmented=False):
+                   segmented=False, window=0):
     from jax.experimental import pallas as pl
 
     # q_ref/g_ref/dq_ref: [1, block_q, D]; k_ref/v_ref: [1, S_pad, D];
@@ -281,6 +303,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
     if causal:
         last_q = q_start + block_q - 1
         num_k_blocks = jnp.minimum(num_k_blocks, (last_q // block_k) + 1)
+    start_ki = 0
+    if window > 0:
+        start_ki = jnp.maximum(0, (q_start - window + 1) // block_k)
 
     def body(ki, acc):
         k_start = ki * block_k
@@ -294,11 +319,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
             jnp.int32, (block_q, block_k), 1
         )
         s = jnp.where(kpos < seq_len, s, NEG_INF)
-        if causal:
+        if causal or window > 0:
             qpos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            if causal:
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            if window > 0:
+                s = jnp.where(qpos - kpos < window, s, NEG_INF)
         if segmented:
             seg_q = seg_ref[0, 0, pl.ds(q_start, block_q)]
             seg_k = seg_ref[0, 0, pl.ds(k_start, block_k)]
@@ -315,14 +343,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
         )
 
     acc = jax.lax.fori_loop(
-        0, num_k_blocks, body, jnp.zeros((block_q, d), jnp.float32)
+        start_ki, num_k_blocks, body, jnp.zeros((block_q, d), jnp.float32)
     )
     dq_ref[0] = acc.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                     *rest, block_q, causal, sm_scale, seq_len,
-                    padded_len, segmented=False):
+                    padded_len, segmented=False, window=0):
     from jax.experimental import pallas as pl
 
     # Grid (B*KV, k_blocks, rep): the innermost r axis streams one GQA
@@ -348,6 +376,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     num_q_blocks = pl.cdiv(padded_len, block_q)
     # Q blocks whose last row precedes k_start are fully causally masked.
     start_qi = (k_start // block_q) if causal else 0
+    if window > 0:
+        # Q rows beyond k_start + block_k - 1 + window - 1 see none of
+        # this K block.
+        num_q_blocks = jnp.minimum(
+            num_q_blocks,
+            ((k_start + block_k + window - 2) // block_q) + 1,
+        )
 
     def body(qi, carry):
         dk_acc, dv_acc = carry
@@ -370,6 +405,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         s = jnp.where(kpos < seq_len, s, NEG_INF)
         if causal:
             s = jnp.where(qpos >= kpos, s, NEG_INF)
+        if window > 0:
+            s = jnp.where(qpos - kpos < window, s, NEG_INF)
         if segmented:
             seg_q = seg_ref[0, 0, pl.ds(q_start, block_q)]
             seg_k = seg_ref[0, 0, pl.ds(k_start, block_k)]
@@ -407,7 +444,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
-                      interpret, segment_ids=None):
+                      interpret, segment_ids=None, window=0):
     from jax.experimental import pallas as pl
 
     B, H, S, D = q.shape
@@ -443,7 +480,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
 
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, block_k=block_k, causal=causal,
+            _bwd_dq_kernel, block_k=block_k, causal=causal, window=window,
             sm_scale=sm_scale, seq_len=S, padded_len=S_pad,
             segmented=segmented,
         ),
@@ -478,7 +515,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
         ]
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, block_q=block_q, causal=causal,
+            _bwd_dkv_kernel, block_q=block_q, causal=causal, window=window,
             sm_scale=sm_scale, seq_len=S, padded_len=S_pad,
             segmented=segmented,
         ),
@@ -544,25 +581,28 @@ def _flash_bwd_reference(q, k, v, out, lse, g, causal):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
 )
 def _flash_attention(q, k, v, causal, block_q, block_k, bwd_block_q,
-                     bwd_block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+                     bwd_block_k, interpret, window):
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
+                        window=window)
     return out
 
 
 def _fwd_rule(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k,
-              interpret):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+              interpret, window):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
+                          window=window)
     return out, (q, k, v, out, lse)
 
 
 def _bwd_rule(causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret,
-              res, g):
+              window, res, g):
     q, k, v, out, lse = res
     dq, dk, dv = _flash_bwd_pallas(
-        q, k, v, out, lse, g, causal, bwd_block_q, bwd_block_k, interpret
+        q, k, v, out, lse, g, causal, bwd_block_q, bwd_block_k, interpret,
+        window=window,
     )
     return dq, dk, dv
 
@@ -574,30 +614,32 @@ _flash_attention.defvjp(_fwd_rule, _bwd_rule)
 # cotangent is None.  Separate from the dense path so the unsegmented
 # kernels stay byte-identical (no dead mask ops on the hot path).
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10)
 )
 def _flash_attention_seg(q, k, v, seg, causal, block_q, block_k,
-                         bwd_block_q, bwd_block_k, interpret):
+                         bwd_block_q, bwd_block_k, interpret, window):
     out, _ = _flash_fwd(
-        q, k, v, causal, block_q, block_k, interpret, segment_ids=seg
+        q, k, v, causal, block_q, block_k, interpret, segment_ids=seg,
+        window=window,
     )
     return out
 
 
 def _seg_fwd_rule(q, k, v, seg, causal, block_q, block_k, bwd_block_q,
-                  bwd_block_k, interpret):
+                  bwd_block_k, interpret, window):
     out, lse = _flash_fwd(
-        q, k, v, causal, block_q, block_k, interpret, segment_ids=seg
+        q, k, v, causal, block_q, block_k, interpret, segment_ids=seg,
+        window=window,
     )
     return out, (q, k, v, seg, out, lse)
 
 
 def _seg_bwd_rule(causal, block_q, block_k, bwd_block_q, bwd_block_k,
-                  interpret, res, g):
+                  interpret, window, res, g):
     q, k, v, seg, out, lse = res
     dq, dk, dv = _flash_bwd_pallas(
         q, k, v, out, lse, g, causal, bwd_block_q, bwd_block_k, interpret,
-        segment_ids=seg,
+        segment_ids=seg, window=window,
     )
     return dq, dk, dv, None
 
@@ -618,6 +660,7 @@ def flash_attention(
     bwd_block_k: int = DEFAULT_BWD_BLOCK_K,
     backend: Optional[str] = None,  # None=auto | 'pallas' | 'reference'
     interpret: bool = False,
+    window: int = 0,  # >0: sliding-window (needs causal)
 ) -> jax.Array:
     """[B, H, S, D] flash attention.
 
@@ -637,14 +680,16 @@ def flash_attention(
         raise ValueError(
             f"GQA needs H % KV == 0, got H={q.shape[1]} KV={k.shape[1]}"
         )
+    if window > 0 and not causal:
+        raise ValueError("window > 0 requires causal attention")
     if backend is None:
         backend = "pallas" if jax.default_backend() == "tpu" else "reference"
     if backend == "reference":
-        return reference_attention(q, k, v, causal, segment_ids)
+        return reference_attention(q, k, v, causal, segment_ids, window)
     if segment_ids is not None:
         return _flash_attention_seg(
             q, k, v, segment_ids, causal, block_q, block_k, bwd_block_q,
-            bwd_block_k, interpret,
+            bwd_block_k, interpret, window,
         )
     return _flash_attention(q, k, v, causal, block_q, block_k, bwd_block_q,
-                            bwd_block_k, interpret)
+                            bwd_block_k, interpret, window)
