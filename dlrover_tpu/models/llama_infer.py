@@ -26,23 +26,46 @@ from dlrover_tpu.models.llama import LlamaConfig, _rope
 from dlrover_tpu.ops.rmsnorm import rmsnorm
 
 
-def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict:
-    """Zeroed per-layer k/v cache (compact KV-head count) + write offset."""
+def init_cache(
+    cfg: LlamaConfig, batch: int, max_len: int, *,
+    ring_len: Optional[int] = None,
+) -> Dict:
+    """Zeroed per-layer k/v cache (compact KV-head count) + write offset.
+
+    With ``cfg.sliding_window > 0`` the cache is a ROLLING buffer of
+    ``ring_len`` slots (default ``max_len``): writes wrap modulo the
+    buffer and a per-slot absolute-position array drives the masks, so
+    decode memory is O(window), not O(total sequence).  Constraints for
+    a chunk of T new tokens: ``T <= ring_len`` always, and
+    ``window + T - 1 <= ring_len`` when continuing past a non-empty
+    cache (single-token decode only needs ``ring_len >= window``)."""
     KV, D = cfg.n_kv_head, cfg.head_dim
-    return {
+    L = max_len
+    if cfg.sliding_window > 0 and ring_len is not None:
+        L = min(max_len, ring_len)
+    cache = {
         "layers": [
             {
-                "k": jnp.zeros((batch, KV, max_len, D), cfg.dtype),
-                "v": jnp.zeros((batch, KV, max_len, D), cfg.dtype),
+                "k": jnp.zeros((batch, KV, L, D), cfg.dtype),
+                "v": jnp.zeros((batch, KV, L, D), cfg.dtype),
             }
             for _ in range(cfg.n_layer)
         ],
         "offset": jnp.zeros((), jnp.int32),
     }
+    if cfg.sliding_window > 0:
+        # Absolute position held by each ring slot (-1 = unwritten).
+        cache["pos"] = jnp.full((L,), -1, jnp.int32)
+    return cache
 
 
-def _cached_attention(x, layer, cfg, cache_layer, offset, positions):
-    """x: [B, T, C] new tokens; attends to cache[:offset] + itself."""
+def _cached_attention(x, layer, cfg, cache_layer, offset, positions,
+                      slot_pos=None):
+    """x: [B, T, C] new tokens; attends to cache[:offset] + itself.
+
+    ``slot_pos`` (ring mode, sliding-window models): the ALREADY-updated
+    per-slot absolute positions; writes wrap modulo the buffer length
+    and masks key on these positions instead of the slot index."""
     B, T, C = x.shape
     H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
     dt = cfg.dtype
@@ -52,15 +75,39 @@ def _cached_attention(x, layer, cfg, cache_layer, offset, positions):
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
 
-    # Write the new k/v into the cache at [offset, offset+T).
-    k_cache = jax.lax.dynamic_update_slice(
-        cache_layer["k"], k.transpose(0, 2, 1, 3).astype(dt),
-        (0, 0, offset, 0),
-    )
-    v_cache = jax.lax.dynamic_update_slice(
-        cache_layer["v"], v.transpose(0, 2, 1, 3).astype(dt),
-        (0, 0, offset, 0),
-    )
+    if slot_pos is not None:
+        # Ring write (slot mapping computed ONCE by forward_step).
+        ring_slots, slot_pos = slot_pos
+        if T == 1:
+            # Decode hot path: a single contiguous slot — XLA lowers a
+            # dynamic_update_slice far better than an indexed scatter.
+            k_cache = jax.lax.dynamic_update_slice(
+                cache_layer["k"],
+                k.transpose(0, 2, 1, 3).astype(dt),
+                (0, 0, ring_slots[0], 0),
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                cache_layer["v"],
+                v.transpose(0, 2, 1, 3).astype(dt),
+                (0, 0, ring_slots[0], 0),
+            )
+        else:
+            k_cache = cache_layer["k"].at[:, :, ring_slots].set(
+                k.transpose(0, 2, 1, 3).astype(dt)
+            )
+            v_cache = cache_layer["v"].at[:, :, ring_slots].set(
+                v.transpose(0, 2, 1, 3).astype(dt)
+            )
+    else:
+        # Write the new k/v into the cache at [offset, offset+T).
+        k_cache = jax.lax.dynamic_update_slice(
+            cache_layer["k"], k.transpose(0, 2, 1, 3).astype(dt),
+            (0, 0, offset, 0),
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            cache_layer["v"], v.transpose(0, 2, 1, 3).astype(dt),
+            (0, 0, offset, 0),
+        )
 
     max_len = k_cache.shape[2]
     rep = H // KV
@@ -78,14 +125,17 @@ def _cached_attention(x, layer, cfg, cache_layer, offset, positions):
         "bgrtd,bgkd->bgrtk", qf, k_cache,
         preferred_element_type=jnp.float32,
     ) / np.sqrt(D)
-    # Causal over absolute positions; cache slots >= offset+T are unwritten.
-    kpos = jnp.arange(max_len)[None, None, None, None, :]
+    # Causal over absolute positions; unwritten slots are masked (ring
+    # mode: pos -1; dense mode: slot index beyond offset+T).
+    if slot_pos is not None:
+        kpos = slot_pos[None, None, None, None, :]
+    else:
+        kpos = jnp.arange(max_len)[None, None, None, None, :]
     qpos = positions[:, None, None, :, None]
-    s = jnp.where(kpos <= qpos, s, -1e30)
+    s = jnp.where((kpos >= 0) & (kpos <= qpos), s, -1e30)
     if cfg.sliding_window > 0:
         # Sliding window: only the last `sliding_window` positions are
-        # visible (the cache stays full-length; a rolling buffer is a
-        # memory optimization, this is the correctness mask).
+        # visible.
         s = jnp.where(qpos - kpos < cfg.sliding_window, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
@@ -106,6 +156,8 @@ def forward_step(
     tokens: jax.Array,  # [B, T] new tokens
     cfg: LlamaConfig,
     cache: Dict,
+    *,
+    assume_empty_cache: bool = False,  # ring mode: offset-0 prefill
 ) -> Tuple[jax.Array, Dict]:
     """Score ``tokens`` continuing the cached context.  Returns
     (logits [B, T, vocab] fp32, updated cache).
@@ -121,6 +173,33 @@ def forward_step(
     x = params["embed"].astype(dt)[tokens]
     positions = offset + jnp.broadcast_to(jnp.arange(T), (B, T))
     no_drop_capacity = B * T * cfg.top_k
+    ring = None
+    if "pos" in cache:  # ring mode (sliding-window models)
+        L = cache["pos"].shape[0]
+        W = cfg.sliding_window
+        if T > L:
+            raise ValueError(
+                f"chunk of {T} tokens exceeds the {L}-slot ring cache"
+            )
+        if T > 1 and W + T - 1 > L and not assume_empty_cache:
+            # A multi-token chunk on a NON-empty ring would overwrite
+            # keys still inside earlier queries' windows (silently wrong
+            # logits). Prefill at offset 0 is safe — callers declare it.
+            raise ValueError(
+                f"continuation chunk of {T} tokens needs ring_len >= "
+                f"window + T - 1 = {W + T - 1}, have {L}; pass "
+                "assume_empty_cache=True only for the offset-0 prefill"
+            )
+        slots = (offset + jnp.arange(T)) % L
+        if T == 1:
+            slot_pos = jax.lax.dynamic_update_slice(
+                cache["pos"], offset[None] + jnp.arange(1), (slots[0],)
+            )
+        else:
+            slot_pos = cache["pos"].at[slots].set(
+                offset + jnp.arange(T)
+            )
+        ring = (slots, slot_pos)
     new_layers = []
     for layer, cache_layer in zip(params["layers"], cache["layers"]):
         cell = {}
@@ -128,7 +207,8 @@ def forward_step(
         def attn_fn(h, layer_, cfg_, positions_, _cache=cache_layer,
                     _cell=cell):
             out, _cell["cache"] = _cached_attention(
-                h, layer_, cfg_, _cache, offset, positions_
+                h, layer_, cfg_, _cache, offset, positions_,
+                slot_pos=ring,
             )
             return out
 
@@ -139,7 +219,10 @@ def forward_step(
         new_layers.append(cell["cache"])
     x = rmsnorm(x, params["ln_f"], eps=cfg.rms_eps)
     logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
-    return logits, {"layers": new_layers, "offset": offset + T}
+    new_cache = {"layers": new_layers, "offset": offset + T}
+    if ring is not None:
+        new_cache["pos"] = ring[1]
+    return logits, new_cache
 
 
 def generate(
@@ -165,8 +248,15 @@ def generate(
         return prompts
     B, P = prompts.shape
     max_len = P + max_new_tokens
-    cache = init_cache(cfg, B, max_len)
-    logits, cache = forward_step(params, prompts, cfg, cache)
+    ring_len = None
+    if cfg.sliding_window > 0:
+        # Rolling buffer: prefill needs P slots, decode needs `window`
+        # retained keys — memory O(max(P, window)), not O(P + N).
+        ring_len = max(P, cfg.sliding_window)
+    cache = init_cache(cfg, B, max_len, ring_len=ring_len)
+    logits, cache = forward_step(
+        params, prompts, cfg, cache, assume_empty_cache=True
+    )
     if rng is None:
         rng = jax.random.PRNGKey(0)
 

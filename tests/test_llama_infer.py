@@ -3,6 +3,7 @@ forward, and greedy decoding with the cache must match token-by-token
 full-recompute argmax decoding."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -155,3 +156,59 @@ class TestTopP:
             rng=jax.random.PRNGKey(5), temperature=0.8,
         )
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestRollingWindowCache:
+    def test_ring_decode_matches_full_forward_and_shrinks_memory(self):
+        """Sliding-window decode through the ROLLING cache: greedy
+        parity with the windowed full forward while the cache holds
+        max(P, window) slots instead of P + N."""
+        from dlrover_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny(
+            n_layer=2, n_head=4, n_kv_head=2, dtype=jnp.float32,
+            sliding_window=6, max_seq_len=128,
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        prompts = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size
+        )
+        N = 24  # enough decode steps to wrap the ring several times
+        got = llama_infer.generate(
+            params, cfg, prompts, max_new_tokens=N, temperature=0.0
+        )
+        seq = prompts
+        for _ in range(N):
+            logits, _ = llama.forward(params, seq, cfg)
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+            seq = jnp.concatenate(
+                [seq, nxt[:, None].astype(seq.dtype)], axis=1
+            )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(seq))
+
+        # The ring really is bounded: forward_step on a ring cache of
+        # max(P, W) slots, not P + N.
+        cache = llama_infer.init_cache(
+            cfg, 2, P := 8 + N, ring_len=max(8, cfg.sliding_window)
+        )
+        assert cache["layers"][0]["k"].shape[2] == 8
+        assert cache["pos"].shape == (8,)
+
+    def test_ring_rejects_oversized_chunk(self):
+        from dlrover_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny(
+            n_layer=1, sliding_window=4, max_seq_len=64
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        cache = llama_infer.init_cache(cfg, 1, 64, ring_len=4)
+        with pytest.raises(ValueError, match="ring"):
+            llama_infer.forward_step(
+                params, jnp.zeros((1, 8), jnp.int32), cfg, cache
+            )
+        # A continuation chunk that would clobber in-window keys is
+        # rejected even when it fits the ring.
+        with pytest.raises(ValueError, match="continuation"):
+            llama_infer.forward_step(
+                params, jnp.zeros((1, 2), jnp.int32), cfg, cache
+            )
