@@ -1,0 +1,74 @@
+"""LoRA fine-tuning: pytree factors + pure merge over the unchanged
+llama machinery (reference: atorch llama2 fine-tuning's LoRA mode)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu.models import llama, lora
+
+
+def _setup():
+    cfg = llama.LlamaConfig.tiny(n_layer=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+class TestLora:
+    def test_merge_is_identity_at_init(self):
+        cfg, params = _setup()
+        l = lora.init_lora(jax.random.PRNGKey(1), params, rank=4)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(2), (2, 17), 0, cfg.vocab_size
+        )
+        base = llama.loss_fn(params, {"tokens": tokens}, cfg,
+                             moe_aux_weight=0.0)
+        merged = llama.loss_fn(lora.merge(params, l), {"tokens": tokens},
+                               cfg, moe_aux_weight=0.0)
+        np.testing.assert_allclose(float(base), float(merged), rtol=1e-6)
+
+    def test_lora_trains_factors_only(self):
+        cfg, params = _setup()
+        l = lora.init_lora(jax.random.PRNGKey(1), params, rank=8,
+                           targets=lora.ATTN_TARGETS + lora.MLP_TARGETS)
+        assert lora.num_lora_params(l) < 0.2 * llama.num_params(params)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(2), (4, 17), 0, 64
+        )
+        batch = {"tokens": tokens}
+        tx = optax.masked(optax.adamw(1e-2), lora.trainable_mask(l))
+        opt = tx.init(l)
+
+        @jax.jit
+        def step(l, opt):
+            loss, g = jax.value_and_grad(
+                lambda ll: llama.loss_fn(
+                    lora.merge(params, ll), batch, cfg,
+                    moe_aux_weight=0.0,
+                )
+            )(l)
+            up, opt = tx.update(g, opt, l)
+            return optax.apply_updates(l, up), opt, loss
+
+        losses = []
+        for _ in range(10):
+            l, opt, loss = step(l, opt)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] - 0.3, losses
+        # Base params untouched by construction; decode works on the
+        # merged tree through the standard machinery.
+        from dlrover_tpu.models import llama_infer
+
+        out = llama_infer.generate(
+            lora.merge(params, l), cfg, tokens[:, :5], max_new_tokens=3,
+            temperature=0.0,
+        )
+        assert out.shape == (4, 8)
+
+    def test_targets_subset(self):
+        cfg, params = _setup()
+        l = lora.init_lora(jax.random.PRNGKey(1), params, rank=2,
+                           targets=("wq",))
+        assert set(l["layers"][0].keys()) == {"wq"}
