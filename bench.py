@@ -224,7 +224,8 @@ def _measure_candidate_subproc(
             if isinstance(v, (int, float, str, bool))
         },
     }
-    out_path = tempfile.mktemp(prefix="bench_cand_")
+    out_fd, out_path = tempfile.mkstemp(prefix="bench_cand_")
+    os.close(out_fd)
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__),
          "--measure-one", out_path],
@@ -398,6 +399,28 @@ def measure_goodput(total_steps=80, timeout_s=900):
     }
 
 
+def _partial_path() -> str:
+    import os
+
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_PARTIAL.json"
+    )
+
+
+def _flush_partial(entries: list) -> None:
+    """Write per-candidate results to disk AS THEY COMPLETE.
+
+    A tunnel that answers for 20 minutes then wedges must still leave
+    verified per-candidate numbers on disk (round-3 review Weak #1) —
+    the final JSON line alone only exists if the whole sweep survives.
+    """
+    try:
+        with open(_partial_path(), "w") as f:
+            json.dump({"candidates": entries}, f, indent=1)
+    except OSError:
+        pass
+
+
 def main() -> int:
     ensure_live_backend()
     import jax
@@ -446,7 +469,14 @@ def main() -> int:
         seq, iters = 64, 3
 
     best = None  # (flops/sec, name, cfg, batch, remat, opt, dt, loss, fp8)
+    partial: list = []
+    _flush_partial(partial)  # truncate any previous run's stale data
+    peak_all = detect_peak() * jax.local_device_count()
     for name, cfg, batch, remat, opt, probe_iters, fp8 in candidates:
+        entry = {
+            "model": name, "batch": batch, "remat": remat, "opt": opt,
+            "fp8": fp8, "backend": jax.default_backend(),
+        }
         try:
             if on_tpu:
                 # Subprocess + hard timeout: a tunnel that wedges
@@ -463,6 +493,9 @@ def main() -> int:
                 f"opt={opt} failed: {type(e).__name__}: {str(e)[:200]}",
                 file=sys.stderr,
             )
+            entry["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+            partial.append(entry)
+            _flush_partial(partial)
             continue
         flops = model_flops_per_step(cfg, batch, seq)
         rate = flops / dt
@@ -471,6 +504,14 @@ def main() -> int:
             f"{dt*1e3:.1f} ms/step, {rate/1e12:.1f} model TFLOP/s",
             file=sys.stderr,
         )
+        entry.update({
+            "step_time_s": round(dt, 4),
+            "model_tflops": round(rate / 1e12, 2),
+            "mfu_pct": round(100.0 * rate / peak_all, 2),
+            "final_loss": round(loss, 4),
+        })
+        partial.append(entry)
+        _flush_partial(partial)
         if best is None or rate > best[0]:
             best = (rate, name, cfg, batch, remat, opt, dt, loss, fp8)
     if best is None:
@@ -535,7 +576,36 @@ def main() -> int:
     return 0
 
 
+def kernel_smoke_main(argv: list) -> int:
+    """Compile + execute + grad-check every Pallas kernel with
+    interpret=False on the live backend, flushing per-kernel results to
+    KERNEL_SMOKE.json as they complete (round-3 review Weak #2: no
+    kernel newer than round 1 has been through Mosaic).  Run this FIRST
+    in any live-TPU session — it costs minutes and de-risks the sweep."""
+    import os
+
+    ensure_live_backend()
+    from dlrover_tpu.ops.smoke import run_kernel_smoke
+
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "KERNEL_SMOKE.json"
+    )
+    only = argv[0] if argv else None
+    results = run_kernel_smoke(out_path=out, only=only)
+    print(json.dumps({
+        "metric": "kernel_smoke",
+        "value": results["n_ok"],
+        "unit": f"kernels_ok_of_{results['n_total']}",
+        "vs_baseline": 1.0 if results["all_ok"] else 0.0,
+        "backend": results["backend"],
+        "artifact": out,
+    }))
+    return 0 if results["all_ok"] else 1
+
+
 if __name__ == "__main__":
     if len(sys.argv) == 3 and sys.argv[1] == "--measure-one":
         sys.exit(_measure_one_main(sys.argv[2]))
+    if len(sys.argv) >= 2 and sys.argv[1] == "--kernel_smoke":
+        sys.exit(kernel_smoke_main(sys.argv[2:]))
     sys.exit(main())
