@@ -535,8 +535,7 @@ def main() -> int:
 
     flops = model_flops_per_step(cfg, batch, seq)
     n_dev = jax.local_device_count()
-    peak = detect_peak() * n_dev
-    mfu_pct = 100.0 * flops / dt / peak
+    mfu_pct = 100.0 * flops / dt / peak_all
     tokens_per_sec = batch * seq / dt
 
     # North-star elasticity probe (worker kill -> warm restore), on by

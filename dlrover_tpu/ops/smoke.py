@@ -260,9 +260,11 @@ def _run_quant() -> Dict:
     fwd = jax.jit(lambda x: quantize_blockwise(x, backend="pallas"))
     codes, scale = fwd(x)
     back = dequantize_blockwise(codes, scale, x.shape)
-    # int8 symmetric: worst-case error is scale/2 per block ≈ max/254.
+    # int8 symmetric round-to-nearest: worst case is scale/2 per block
+    # ≈ max/254; a kernel that truncates instead of rounds (a classic
+    # lowering bug) errs up to max/127 and must FAIL this bound.
     err = float(np.max(np.abs(np.asarray(back) - np.asarray(x))))
-    bound = float(np.max(np.abs(np.asarray(x)))) / 127.0
+    bound = float(np.max(np.abs(np.asarray(x)))) / 254.0
     us = _time_fn(fwd, x)
     return {
         "ok": bool(err <= bound * 1.01),

@@ -107,6 +107,11 @@ def default_space(
             for a in accum:
                 for oo in offload_opt:
                     for f8 in fp8:
+                        if f8 and spec.pp > 1:
+                            # The pipelined loss path takes no
+                            # fp8_states; such a point would burn a
+                            # compile and die as an opaque TypeError.
+                            continue
                         out.append(
                             dataclasses.replace(
                                 base, mesh=spec, remat=r, grad_accum=a,

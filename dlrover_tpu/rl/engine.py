@@ -32,6 +32,21 @@ class ModelRole:
     REWARD = "reward"
 
 
+class _BoundedCache(dict):
+    """Insertion-ordered dict capped at ``maxsize``: free-form prompt
+    lengths in long RL runs must not grow the per-length jit memo (and
+    XLA executable count) without bound — evict the oldest entry."""
+
+    def __init__(self, maxsize: int = 16):
+        super().__init__()
+        self.maxsize = maxsize
+
+    def __setitem__(self, key, value):
+        if key not in self and len(self) >= self.maxsize:
+            del self[next(iter(self))]
+        super().__setitem__(key, value)
+
+
 @dataclasses.dataclass
 class RoleSpec:
     """One model role: ``apply(params, tokens) -> output``.
@@ -63,7 +78,7 @@ def llama_cached_generate(cfg, ppo_config: PPOConfig) -> Callable:
     ``atorch/rl/model_engine/model_engine.py:35``)."""
     from dlrover_tpu.models import llama_infer
 
-    jitted: Dict[int, Callable] = {}
+    jitted: Dict[int, Callable] = _BoundedCache()
 
     def gen(params, prompts, rng):
         plen = int(prompts.shape[1])
@@ -112,9 +127,10 @@ class ModelEngine:
         self.eos_token = eos_token
         # Jitted programs are specialized on prompt_len (slicing offsets
         # are static); cache per length so a changed prompt shape rebuilds
-        # instead of silently computing with stale offsets.
-        self._generate = {}
-        self._rollout_forward = {}
+        # instead of silently computing with stale offsets.  Bounded:
+        # free-form prompt lengths must not grow executables unboundedly.
+        self._generate = _BoundedCache()
+        self._rollout_forward = _BoundedCache()
 
     # -- role access (reference get_model/actor/critic properties) ----------
     def params(self, role: str) -> Any:

@@ -145,8 +145,7 @@ class OpMetricsCollector:
                              "*.trace.json.gz"),
                 recursive=True,
             )
-            if files:
-                self._analyze(files[0])
+            if files and self._analyze(files):
                 self._last_capture_ts = time.time()
         except Exception as e:  # noqa: BLE001
             logger.warning("op-metrics trace analysis failed: %s", e)
@@ -155,29 +154,43 @@ class OpMetricsCollector:
                 shutil.rmtree(self._trace_dir, ignore_errors=True)
                 self._trace_dir = None
 
-    def _analyze(self, path: str) -> None:
+    def _analyze(self, paths) -> bool:
+        """Aggregate op durations over ALL trace files of the capture —
+        multi-device/multi-track captures emit one .trace.json.gz per
+        track; analyzing only the first skews the fractions the
+        straggler operator consumes.  Returns False (keeping the
+        previously published fractions intact) when no file yielded any
+        events, so an all-corrupt capture doesn't wipe good data."""
         from dlrover_tpu.utils.trace_analysis import TraceAnalysis
 
-        ta = TraceAnalysis.from_file(path)
+        if isinstance(paths, str):
+            paths = [paths]
         by_class: Dict[str, float] = {}
         per_op: Dict[str, float] = {}
-        for ev in ta.events:
-            # Framework/bookkeeping events pollute fractions: keep only
-            # op-shaped events (heuristic: no '::' and not $-internal).
-            if "::" in ev.name or ev.name.startswith("$"):
+        for path in paths:
+            try:
+                ta = TraceAnalysis.from_file(path)
+            except Exception as e:  # noqa: BLE001 - skip a bad track
+                logger.warning("op-metrics: unreadable trace %s: %s",
+                               path, e)
                 continue
-            cls = classify_op(ev.name)
-            by_class[cls] = by_class.get(cls, 0.0) + ev.dur_us
-            key = ev.name.split(".")[0]
-            per_op[key] = per_op.get(key, 0.0) + ev.dur_us
+            for ev in ta.events:
+                # Framework/bookkeeping events pollute fractions: keep
+                # only op-shaped events (no '::' and not $-internal).
+                if "::" in ev.name or ev.name.startswith("$"):
+                    continue
+                cls = classify_op(ev.name)
+                by_class[cls] = by_class.get(cls, 0.0) + ev.dur_us
+                key = ev.name.split(".")[0]
+                per_op[key] = per_op.get(key, 0.0) + ev.dur_us
         total = sum(by_class.values())
-        self._op_fracs = {
-            k: (v / total if total > 0 else 0.0)
-            for k, v in by_class.items()
-        }
+        if total <= 0:
+            return False
+        self._op_fracs = {k: v / total for k, v in by_class.items()}
         self._top_ops = sorted(
             per_op.items(), key=lambda kv: -kv[1]
         )[: self.top_k]
+        return True
 
     # -- outputs ------------------------------------------------------------
     def metrics(self) -> Dict[str, float]:

@@ -59,6 +59,36 @@ class TestCollector:
         assert classify_op("end: dot_general") == "matmul"
         assert classify_op("wrapped_tanh") == "other"
 
+    def test_analyze_aggregates_all_trace_files(self, tmp_path):
+        """Multi-track captures emit several .trace.json.gz; fractions
+        must aggregate over ALL of them (ADVICE r3)."""
+        import gzip
+
+        def write_trace(path, name, dur):
+            events = {"traceEvents": [{
+                "ph": "X", "name": name, "ts": 0, "dur": dur,
+                "pid": 1, "tid": 1,
+            }]}
+            with gzip.open(path, "wt") as f:
+                json.dump(events, f)
+
+        p1 = tmp_path / "a.trace.json.gz"
+        p2 = tmp_path / "b.trace.json.gz"
+        write_trace(p1, "dot_general", 100)
+        write_trace(p2, "all-reduce.1", 300)
+        col = OpMetricsCollector()
+        col._analyze([str(p1), str(p2)])
+        assert col._op_fracs["matmul"] == pytest.approx(0.25)
+        assert col._op_fracs["collective"] == pytest.approx(0.75)
+        # A bad file is skipped, not fatal.
+        assert col._analyze(
+            [str(tmp_path / "missing.trace.json.gz"), str(p1)]
+        )
+        assert col._op_fracs["matmul"] == pytest.approx(1.0)
+        # An all-bad capture keeps the previous fractions intact.
+        assert not col._analyze([str(tmp_path / "nope.trace.json.gz")])
+        assert col._op_fracs["matmul"] == pytest.approx(1.0)
+
 
 class TestStragglerOperator:
     def _record(self, dm, nid, p50, coll=0.1, ts=None):

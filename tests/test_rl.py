@@ -405,6 +405,19 @@ class TestCachedRollout:
             buf = np.concatenate([buf, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(np.asarray(out), buf)
 
+    def test_jit_memo_is_bounded(self):
+        """Free-form prompt lengths must not grow the per-length jit
+        memo (and XLA executable count) without bound (ADVICE r3)."""
+        from dlrover_tpu.rl.engine import _BoundedCache
+
+        c = _BoundedCache(maxsize=3)
+        for i in range(10):
+            c[i] = i
+        assert len(c) == 3
+        assert list(c) == [7, 8, 9]
+        c[8] = "updated"  # refresh without eviction
+        assert len(c) == 3 and c[8] == "updated"
+
     def test_cached_rollout_at_least_5x_faster_at_t128(self):
         """VERDICT done-criterion: >=5x tokens/s over the full-recompute
         scan at T=128 on CPU."""

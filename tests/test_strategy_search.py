@@ -55,6 +55,17 @@ class TestSerialization:
         assert jnp.dtype(s2.compute_dtype) == jnp.dtype(s.compute_dtype)
 
 
+class TestSpace:
+    def test_no_pp_fp8_points(self):
+        """pp>1 x fp8 can't be honored by the pipelined loss path
+        (takes no fp8_states) — such points must be pruned from the
+        grid, not burn a compile and die as a TypeError (ADVICE r3)."""
+        space = default_space(8, fp8=(False, True), allow_pp=True)
+        assert any(s.fp8 for s in space)
+        assert any(s.mesh.pp > 1 for s in space)
+        assert not any(s.fp8 and s.mesh.pp > 1 for s in space)
+
+
 class TestBayesSearch:
     def test_finds_synthetic_optimum(self):
         """On a synthetic objective with a known best point, BO with a
