@@ -161,21 +161,32 @@ class AcceleratedJob:
     mesh: Mesh
     strategy: Strategy
     train_step: Callable  # (state, batch) -> (state, metrics)
-    create_state: Callable  # (rng) -> sharded state pytree
+    create_state: Callable  # (rng, frozen_values=None) -> sharded state
     state_sharding: Any
     batch_sharding: Any
     cost: Optional[dict] = None
     abstract_batch: Any = None  # ShapeDtypeStruct tree of the sample batch
+    has_frozen: bool = False
 
 
 def _build_train_step(
     loss_fn: Callable,
     tx,
     strategy: Strategy,
+    has_frozen: bool = False,
 ):
     """state={'params','opt_state','step'}; batch pytree; returns jittable
     step with optional remat and grad accumulation (grad-accum preserves
-    global batch under elasticity, reference ``ElasticTrainer`` trick)."""
+    global batch under elasticity, reference ``ElasticTrainer`` trick).
+
+    ``has_frozen``: the step takes a third argument — a pytree of
+    non-trained arrays passed to the loss as ``loss_fn(params, batch,
+    frozen=...)`` — with no gradient and no optimizer state (the
+    LoRA/peft shape: reference ``fsdp_lora_load_test.py``).  It rides
+    OUTSIDE the donated state argument: donation would invalidate the
+    caller's base-model buffers (device_put onto an identical sharding
+    aliases them) and re-copying a multi-GB base every step to dodge
+    that would be worse."""
     remat_policy = REMAT_POLICIES.get(strategy.remat, None)
     lfn = loss_fn
     # "block" is the MODEL-level per-block policy (e.g. llama's
@@ -186,18 +197,19 @@ def _build_train_step(
 
     fp8_on = strategy.fp8
 
-    def _value_and_grad(params, mb, fp8):
+    def _value_and_grad(params, mb, fp8, frozen):
         """(loss, grads, new_fp8) for one microbatch; new_fp8 is None
         when the fp8 strategy is off."""
+        kw = {"frozen": frozen} if has_frozen else {}
         if fp8_on:
             (loss, new_fp8), grads = jax.value_and_grad(
                 lfn, has_aux=True
-            )(params, mb, fp8_states=fp8)
+            )(params, mb, fp8_states=fp8, **kw)
             return loss, grads, new_fp8
-        loss, grads = jax.value_and_grad(lfn)(params, mb)
+        loss, grads = jax.value_and_grad(lfn)(params, mb, **kw)
         return loss, grads, None
 
-    def train_step(state, batch):
+    def train_step(state, batch, frozen=None):
         params = state["params"]
         # Indexing (not .get): a state restored from a pre-fp8 checkpoint
         # must fail fast here, not as an opaque has_aux tracing error.
@@ -213,7 +225,9 @@ def _build_train_step(
 
             def acc_fn(carry, mb):
                 loss_sum, grads_sum, fp8_c = carry
-                loss, grads, new_fp8 = _value_and_grad(params, mb, fp8_c)
+                loss, grads, new_fp8 = _value_and_grad(
+                    params, mb, fp8_c, frozen
+                )
                 carry = (
                     loss_sum + loss,
                     jax.tree_util.tree_map(jnp.add, grads_sum, grads),
@@ -236,7 +250,8 @@ def _build_train_step(
                 lambda g: g / strategy.grad_accum, grad_sum
             )
         else:
-            loss, grads, new_fp8 = _value_and_grad(params, batch, fp8)
+            loss, grads, new_fp8 = _value_and_grad(params, batch, fp8,
+                                                   frozen)
 
         updates, opt_state = tx.update(grads, state["opt_state"], params)
         import optax
@@ -274,6 +289,17 @@ def accelerate(
     # remat="block" -> cfg.remat_block=True), the reference opt_lib
     # transform shape.  Overrides loss_fn per candidate when given.
     loss_fn_builder: Optional[Callable] = None,
+    # Pytree of NON-trained arrays (e.g. the base model under LoRA,
+    # reference fsdp_lora_load_test.py): rides the train state as
+    # state['frozen'] with its own (fsdp-sharded) placement, reaches the
+    # loss as loss_fn(params, batch, frozen=...), gets no gradient and
+    # no optimizer state, and is returned untouched every step.  Leaves
+    # may be concrete arrays (small models) or ShapeDtypeStructs — the
+    # 7B-scale flow: pass shapes here, compile, stream the checkpoint
+    # straight onto job.state_sharding['frozen'] (hf_convert.
+    # from_hf_llama_dir), then create_state(rng, frozen_values=tree),
+    # so an unsharded copy never exists anywhere.
+    frozen: Any = None,
 ) -> AcceleratedJob:
     devs = list(devices) if devices is not None else jax.devices()
     n = len(devs)
@@ -293,6 +319,7 @@ def accelerate(
             profile_steps=max(2, profile_steps), max_evals=search_evals,
             grad_accum=grad_accum, cache=cache, job_out=job_out,
             fp8_init=fp8_init, loss_fn_builder=loss_fn_builder,
+            frozen=frozen,
         )
         if job_out.get("job") is not None:
             # The search already compiled (and timed) the winner — don't
@@ -405,6 +432,7 @@ def accelerate(
             job = _compile_candidate(
                 cand, lf, init_fn, optimizer, sample_batch,
                 param_specs, batch_axes, devs, fp8_init=fp8_init,
+                frozen=frozen,
             )
         except Exception as e:  # noqa: BLE001
             logger.info("strategy %s rejected: %s", cand.describe(), e)
@@ -439,7 +467,7 @@ def accelerate(
 
 def _compile_candidate(
     strategy, loss_fn, init_fn, optimizer, sample_batch,
-    param_specs, batch_axes, devs, fp8_init=None,
+    param_specs, batch_axes, devs, fp8_init=None, frozen=None,
 ) -> AcceleratedJob:
     mesh_spec = strategy.mesh.normalized(len(devs))
     strategy = dataclasses.replace(strategy, mesh=mesh_spec)
@@ -480,6 +508,32 @@ def _compile_candidate(
 
     o_specs = jax.tree_util.tree_map(opt_spec, opt_shape)
     state_specs = {"params": p_specs, "opt_state": o_specs, "step": P()}
+    frozen_shape = None
+    if frozen is not None:
+        # Leaves may already be ShapeDtypeStructs (the 7B flow passes
+        # shapes only); .shape/.dtype covers both.
+        frozen_shape = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                tuple(x.shape) if hasattr(x, "shape") else np.shape(x),
+                getattr(x, "dtype", None) or np.asarray(x).dtype,
+            ),
+            frozen,
+        )
+        # The frozen tree is usually the BIG one (a base model under
+        # LoRA): give it the same layout treatment trained params get —
+        # the cost-model planner when requested, ZeRO-3 inference
+        # otherwise (a callable/explicit param_specs describes the
+        # TRAINABLE tree, not this one).
+        if isinstance(param_specs, str) and param_specs == "planner":
+            from dlrover_tpu.parallel.layout_planner import plan_layout
+
+            f_specs = plan_layout(
+                frozen_shape,
+                {"fsdp": mesh_spec.fsdp, "tp": mesh_spec.tp},
+            )
+        else:
+            f_specs = infer_param_specs(frozen_shape, mesh_spec)
+        state_specs["frozen"] = f_specs
     fp8_shape = None
     if strategy.fp8:
         if fp8_init is None:
@@ -508,10 +562,20 @@ def _compile_candidate(
         )
     batch_sharding = named_sharding_tree(batch_axes, mesh)
 
-    step_fn = _build_train_step(loss_fn, optimizer, strategy)
+    step_fn = _build_train_step(
+        loss_fn, optimizer, strategy, has_frozen=frozen is not None
+    )
+    # The frozen tree is a separate, never-donated jit argument (see
+    # _build_train_step); the public train_step keeps the state-dict API.
+    step_state_sharding = {
+        k: v for k, v in state_sharding.items() if k != "frozen"
+    }
+    in_shardings: tuple = (step_state_sharding, batch_sharding)
+    if frozen is not None:
+        in_shardings += (state_sharding["frozen"],)
     jit_kwargs: dict = dict(
-        in_shardings=(state_sharding, batch_sharding),
-        out_shardings=(state_sharding, None),
+        in_shardings=in_shardings,
+        out_shardings=(step_state_sharding, None),
         donate_argnums=(0,) if strategy.donate else (),
     )
     if strategy.remat == "offload" and not strategy.offload_opt:
@@ -528,7 +592,21 @@ def _compile_candidate(
         jit_kwargs.pop("out_shardings")
     jitted = jax.jit(step_fn, **jit_kwargs)
 
-    def create_state(rng):
+    if frozen is not None:
+        def public_step(state, batch, _jitted=jitted):
+            inner = {k: v for k, v in state.items() if k != "frozen"}
+            new_inner, metrics = _jitted(inner, batch, state["frozen"])
+            new_inner["frozen"] = state["frozen"]
+            return new_inner, metrics
+    else:
+        public_step = jitted
+
+    def create_state(rng, frozen_values=None):
+        """``frozen_values``: concrete tree for state['frozen'] (e.g.
+        streamed in already-sharded via from_hf_llama_dir); defaults to
+        the tree given to accelerate() when that was concrete; "zeros"
+        builds sharded zeros (strategy scoring — same FLOPs, no
+        multi-GB transfer per candidate)."""
         with mesh:
             def mk(r):
                 st = {
@@ -540,14 +618,52 @@ def _compile_candidate(
                     st["fp8"] = fp8_init()
                 return st
 
-            init_jit = jax.jit(mk, out_shardings=state_sharding)
-            return init_jit(rng)
+            init_jit = jax.jit(mk, out_shardings=step_state_sharding)
+            st = init_jit(rng)
+            if frozen is None:
+                return st
+            src = frozen_values if frozen_values is not None else frozen
+            want_zeros = isinstance(src, str)
+            if want_zeros and src != "zeros":
+                raise ValueError(f"unknown frozen_values {src!r}")
+            if not want_zeros and any(
+                isinstance(x, jax.ShapeDtypeStruct)
+                for x in jax.tree_util.tree_leaves(src)
+            ):
+                # Never silently train against a zeros base: shapes-only
+                # accelerate() REQUIRES the real weights here (stream
+                # them onto state_sharding['frozen'] first).  Scoring
+                # opts into zeros explicitly via frozen_values="zeros".
+                raise ValueError(
+                    "create_state: accelerate() was given an abstract "
+                    "frozen tree — pass frozen_values=<concrete tree> "
+                    '(or "zeros" for throwaway scoring state)'
+                )
+            if want_zeros:
+                st["frozen"] = jax.jit(
+                    lambda: jax.tree_util.tree_map(
+                        lambda s: jnp.zeros(s.shape, s.dtype),
+                        frozen_shape,
+                    ),
+                    out_shardings=state_sharding["frozen"],
+                )()
+            else:
+                # Placed OUTSIDE the jit: baking a multi-GB base model
+                # into the executable as a constant would be absurd;
+                # device_put streams each leaf onto its sharding (a
+                # no-op for leaves already placed there).
+                st["frozen"] = jax.tree_util.tree_map(
+                    jax.device_put, src, state_sharding["frozen"]
+                )
+            return st
 
     # AOT compile for cost analysis without touching devices.
     abstract_parts = {
         "params": params_shape, "opt_state": opt_shape,
         "step": jax.ShapeDtypeStruct((), jnp.int32),
     }
+    if frozen is not None:
+        abstract_parts["frozen"] = frozen_shape
     if strategy.fp8:
         abstract_parts["fp8"] = fp8_shape
     abstract_state = jax.tree_util.tree_map(
@@ -562,7 +678,13 @@ def _compile_candidate(
         sample_batch,
         batch_sharding,
     )
-    compiled = jitted.lower(abstract_state, abstract_batch).compile()
+    abstract_inner = {
+        k: v for k, v in abstract_state.items() if k != "frozen"
+    }
+    lower_args = (abstract_inner, abstract_batch)
+    if frozen is not None:
+        lower_args += (abstract_state["frozen"],)
+    compiled = jitted.lower(*lower_args).compile()
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, list):
@@ -573,12 +695,13 @@ def _compile_candidate(
     return AcceleratedJob(
         mesh=mesh,
         strategy=strategy,
-        train_step=jitted,
+        train_step=public_step,
         create_state=create_state,
         state_sharding=state_sharding,
         batch_sharding=batch_sharding,
         cost=cost,
         abstract_batch=abstract_batch,
+        has_frozen=frozen is not None,
     )
 
 
@@ -599,6 +722,7 @@ def search(
     job_out: Optional[dict] = None,
     fp8_init: Optional[Callable] = None,
     loss_fn_builder: Optional[Callable] = None,
+    frozen: Any = None,
 ) -> Strategy:
     """Bayesian strategy search with a timed-dry-run objective and a
     persistent cache (reference ``bayes_opt_sg.py`` + strategy save/load).
@@ -675,6 +799,7 @@ def search(
             job = _compile_candidate(
                 s, lf, init_fn, optimizer, sample_batch,
                 param_specs, batch_axes, devs, fp8_init=fp8_init,
+                frozen=frozen,
             )
         except Exception as e:  # noqa: BLE001
             err = e
@@ -785,7 +910,13 @@ def _score(job: AcceleratedJob, profile_steps: int, init_fn) -> float:
     (the reference scores dry-run throughput; we expose that via
     ``profile_steps``)."""
     if profile_steps > 0:
-        state = job.create_state(jax.random.PRNGKey(0))
+        # Scoring with a frozen tree uses sharded zeros: same FLOPs and
+        # layout, no multi-GB base transfer per scored candidate.
+        state = (
+            job.create_state(jax.random.PRNGKey(0), frozen_values="zeros")
+            if job.has_frozen
+            else job.create_state(jax.random.PRNGKey(0))
+        )
         batch = jax.tree_util.tree_map(
             lambda s, sh: jax.device_put(
                 jnp.zeros(s.shape, s.dtype), sh

@@ -35,15 +35,26 @@ class ModelRole:
 class _BoundedCache(dict):
     """Insertion-ordered dict capped at ``maxsize``: free-form prompt
     lengths in long RL runs must not grow the per-length jit memo (and
-    XLA executable count) without bound — evict the oldest entry."""
+    XLA executable count) without bound — evict the oldest entry.
+
+    Every eviction logs: a working set larger than ``maxsize`` means a
+    recompile per generate call, and that thrash must be visible (fix:
+    bucket prompt lengths, or raise ``jit_cache_size``)."""
 
     def __init__(self, maxsize: int = 16):
         super().__init__()
-        self.maxsize = maxsize
+        self.maxsize = max(1, maxsize)  # 0 would crash the eviction
 
     def __setitem__(self, key, value):
         if key not in self and len(self) >= self.maxsize:
-            del self[next(iter(self))]
+            evicted = next(iter(self))
+            del self[evicted]
+            logger.warning(
+                "jit memo full (%d entries): evicting key %r for %r — "
+                "a working set above the cap recompiles every call; "
+                "bucket prompt lengths or raise jit_cache_size",
+                self.maxsize, evicted, key,
+            )
         super().__setitem__(key, value)
 
 
@@ -68,7 +79,8 @@ class RoleSpec:
                                    jax.Array]] = None
 
 
-def llama_cached_generate(cfg, ppo_config: PPOConfig) -> Callable:
+def llama_cached_generate(cfg, ppo_config: PPOConfig,
+                          jit_cache_size: int = 16) -> Callable:
     """Build an actor ``generate_fn`` backed by the KV-cache decoder
     (``models.llama_infer``: prefill + single-token ``lax.scan`` decode,
     O(T) attention per new token).  Jitted per prompt length — pass the
@@ -78,7 +90,7 @@ def llama_cached_generate(cfg, ppo_config: PPOConfig) -> Callable:
     ``atorch/rl/model_engine/model_engine.py:35``)."""
     from dlrover_tpu.models import llama_infer
 
-    jitted: Dict[int, Callable] = _BoundedCache()
+    jitted: Dict[int, Callable] = _BoundedCache(jit_cache_size)
 
     def gen(params, prompts, rng):
         plen = int(prompts.shape[1])

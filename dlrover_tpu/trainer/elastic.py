@@ -80,6 +80,7 @@ class ElasticTrainer:
         devices=None,
         strategy_cache: Any = None,
         param_specs: Any = None,  # e.g. "planner" | spec tree | callable
+        frozen: Any = None,  # non-trained pytree (LoRA base model)
     ):
         self.cfg = cfg
         self.loss_fn = loss_fn
@@ -95,6 +96,7 @@ class ElasticTrainer:
         # search instead of re-profiling mid-recovery.
         self.strategy_cache = strategy_cache
         self.param_specs = param_specs
+        self.frozen = frozen
 
         self.job = None  # AcceleratedJob
         self.state = None
@@ -153,6 +155,7 @@ class ElasticTrainer:
             grad_accum=self.grad_accum,
             cache=self.strategy_cache,
             param_specs=self.param_specs,
+            frozen=self.frozen,
         )
 
         old_state = self.state

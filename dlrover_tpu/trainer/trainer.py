@@ -233,10 +233,16 @@ class Trainer:
         devices=None,
         num_processes: int = 1,
         process_id: int = 0,
+        frozen: Any = None,
     ):
         self.args = args
         self.loss_fn = loss_fn
         self.init_fn = init_fn
+        # Non-trained base tree (LoRA): rides the train state, reaches
+        # loss_fn as ``frozen=``; excluded from checkpoints (saving a 7B
+        # base per factor-save would defeat flash checkpointing) and
+        # re-attached from the live state on restore.
+        self.frozen = frozen
         self.eval_fetch = eval_fetch
         self.eval_dataset_size = eval_dataset_size
         self.client = master_client
@@ -290,6 +296,7 @@ class Trainer:
             devices=devices,
             strategy_cache=strategy_cache,
             param_specs="planner" if args.layout_planner else None,
+            frozen=frozen,
         )
         self._num_processes = num_processes
         self._process_id = process_id
@@ -331,10 +338,20 @@ class Trainer:
         self._sampler_restored = False
         if self._ckpt is None:
             return False
+        live_frozen = (
+            self.core.state.pop("frozen", None)
+            if self.frozen is not None else None
+        )
         restored = self._ckpt.load(target=self.core.state)
+        if live_frozen is not None:
+            self.core.state["frozen"] = live_frozen
         if restored is None:
             return False
         ckpt_state, meta = restored
+        if live_frozen is not None:
+            # Checkpoints hold the factor tree only; the frozen base
+            # stays the live (device-resident) copy.
+            ckpt_state = dict(ckpt_state, frozen=live_frozen)
         self.core.state = ckpt_state
         self.state.load_meta(meta.get("trainer", {}))
         if meta.get("sampler") and self.core.sampler is not None:
@@ -362,7 +379,15 @@ class Trainer:
                 self.core.sampler.state_dict() if self.core.sampler else {}
             ),
         }
-        self._ckpt.save(self.core.state, meta=meta, storage=storage)
+        to_save = self.core.state
+        if self.frozen is not None:
+            # Factor-tree checkpoints: the frozen base is config, not
+            # training progress — a LoRA save must cost KBs, not the 7B
+            # base per save.
+            to_save = {
+                k: v for k, v in to_save.items() if k != "frozen"
+            }
+        self._ckpt.save(to_save, meta=meta, storage=storage)
         for cb in self.callbacks:
             cb.on_save(self.args, self.state, self.control)
 
@@ -378,7 +403,13 @@ class Trainer:
             return
         self._eval_step_job = job
 
+        has_frozen = self.frozen is not None
+
         def eval_loss(state, batch):
+            if has_frozen:
+                return self.loss_fn(
+                    state["params"], batch, frozen=state["frozen"]
+                )
             return self.loss_fn(state["params"], batch)
 
         self._eval_step = jax.jit(

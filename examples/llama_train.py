@@ -36,6 +36,18 @@ def parse_args():
     p.add_argument("--fp8", action="store_true",
                    help="route attention/MLP linears through e4m3/e5m2 "
                         "fp8_dot with delayed scaling")
+    p.add_argument("--lora_rank", type=int, default=0,
+                   help=">0: LoRA fine-tuning — train rank-r (A,B) "
+                        "factors on the targeted projections, base "
+                        "model frozen (reference fsdp_llama2.py "
+                        "--use_lora/peft path)")
+    p.add_argument("--lora_alpha", type=float, default=16.0)
+    p.add_argument("--lora_targets", default="wq,wk,wv,wo",
+                   help="comma-separated projection names; mlp adds "
+                        "w_gate,w_up,w_down")
+    p.add_argument("--init_from", default="",
+                   help="HuggingFace Llama checkpoint dir to import as "
+                        "the (frozen, for LoRA) base model")
     p.add_argument("--dataset_size", type=int, default=4096)
     p.add_argument("--ckpt_dir", default="")
     p.add_argument("--ckpt_interval", type=int, default=5)
@@ -91,24 +103,104 @@ def main() -> int:
             mesh=MeshSpec(dp=len(jax.devices())), fp8=args.fp8
         )
     )
-    # One signature for both modes (fp8_states defaults to None in
-    # llama.loss_fn): under --strategy auto the sweep mixes fp8 and
-    # non-fp8 candidates, and a required fp8_states would silently
-    # reject every non-fp8 point.
-    loss_fn = lambda p, b, fp8_states=None: llama.loss_fn(  # noqa: E731
-        p, b, cfg, fp8_states=fp8_states
-    )
+
+    if args.init_from and args.lora_rank == 0:
+        # Full fine-tune from an import: compile against shapes first;
+        # the weights stream onto the params sharding after create_state
+        # (never an unsharded full copy — same discipline as the LoRA
+        # branch below).
+        from dlrover_tpu.models import hf_convert
+
+        cfg = hf_convert.config_from_hf_dir(args.init_from)
+        cfg = dataclasses.replace(cfg, remat_block=args.remat_block)
+    if args.lora_rank > 0:
+        # LoRA: base model frozen (rides the state as 'frozen'), only
+        # the (A, B) factors train — reference fsdp_llama2.py peft path.
+        from dlrover_tpu.models import lora
+
+        if args.init_from:
+            # 7B-scale flow: accelerate() sees SHAPES only; the real
+            # weights stream from the checkpoint straight onto the
+            # frozen sharding after compile (never an unsharded copy).
+            from dlrover_tpu.models import hf_convert
+
+            cfg = hf_convert.config_from_hf_dir(args.init_from)
+            cfg = dataclasses.replace(cfg, remat_block=args.remat_block)
+            frozen = jax.eval_shape(
+                lambda: llama.init_params(jax.random.PRNGKey(0), cfg)
+            )
+        else:
+            frozen = llama.init_params(jax.random.PRNGKey(0), cfg)
+        targets = tuple(
+            t.strip() for t in args.lora_targets.split(",") if t.strip()
+        )
+
+        def loss_fn(factors, b, frozen, fp8_states=None):
+            return llama.loss_fn(
+                lora.merge(frozen, factors), b, cfg,
+                fp8_states=fp8_states,
+            )
+
+        base_for_shapes = frozen
+
+        init_fn = lambda r: lora.init_lora(  # noqa: E731
+            r, base_for_shapes, rank=args.lora_rank,
+            alpha=args.lora_alpha, targets=targets,
+        )
+        optimizer = optax.masked(
+            optax.adamw(args.lr), lora.trainable_mask
+        )
+    else:
+        # One signature for both modes (fp8_states defaults to None in
+        # llama.loss_fn): under --strategy auto the sweep mixes fp8 and
+        # non-fp8 candidates, and a required fp8_states would silently
+        # reject every non-fp8 point.
+        loss_fn = lambda p, b, fp8_states=None: llama.loss_fn(  # noqa: E731
+            p, b, cfg, fp8_states=fp8_states
+        )
+        init_fn = lambda r: llama.init_params(r, cfg)  # noqa: E731
+        optimizer = optax.adamw(args.lr)
+        frozen = None
+
     job = accelerate(
         loss_fn=loss_fn,
-        init_fn=lambda r: llama.init_params(r, cfg),
-        optimizer=optax.adamw(args.lr),
+        init_fn=init_fn,
+        optimizer=optimizer,
         sample_batch={"tokens": sample},
         strategy=strategy,
         param_specs="planner",
         fp8_init=(lambda: llama.init_fp8_states(cfg))
         if args.fp8 else None,
+        frozen=frozen,
     )
-    state = job.create_state(jax.random.PRNGKey(0))
+    if args.lora_rank > 0 and args.init_from:
+        # Stream the checkpoint leaf-by-leaf onto the compiled frozen
+        # sharding: peak host memory ~ one tensor, device memory only
+        # ever holds the sharded copy.
+        from dlrover_tpu.models import hf_convert
+
+        sharded_base, _ = hf_convert.from_hf_llama_dir(
+            args.init_from, cfg, dtype=cfg.dtype,
+            shardings=job.state_sharding["frozen"],
+        )
+        state = job.create_state(
+            jax.random.PRNGKey(0), frozen_values=sharded_base
+        )
+    else:
+        state = job.create_state(jax.random.PRNGKey(0))
+        if args.init_from:
+            from dlrover_tpu.models import hf_convert
+
+            sharded, _ = hf_convert.from_hf_llama_dir(
+                args.init_from, cfg, dtype=cfg.dtype,
+                shardings=job.state_sharding["params"],
+            )
+            state["params"] = sharded
+
+    def split_ckpt(st):
+        """Checkpoints exclude the frozen base under LoRA: a factor
+        save costs KBs, the base is re-attached from the live copy."""
+        return {k: v for k, v in st.items() if k != "frozen"}
 
     start_step = 0
     ckpt = None
@@ -116,9 +208,12 @@ def main() -> int:
         from dlrover_tpu.checkpoint.checkpointer import FlashCheckpointer
 
         ckpt = FlashCheckpointer(args.ckpt_dir, job_name=ctx.job_name)
-        restored = ckpt.load(target=state)
+        restored = ckpt.load(target=split_ckpt(state))
         if restored is not None:
-            state, meta = restored
+            got, meta = restored
+            if "frozen" in state:
+                got = dict(got, frozen=state["frozen"])
+            state = got
             start_step = int(meta.get("step", 0))
             print(f"[worker {ctx.process_id}] restored step={start_step}",
                   flush=True)
@@ -151,12 +246,12 @@ def main() -> int:
         step += 1
         ctx.report_step(step)
         if ckpt is not None and step % args.ckpt_interval == 0:
-            ckpt.save(state, meta={"step": step})
+            ckpt.save(split_ckpt(state), meta={"step": step})
         if step % 10 == 0 or step == args.steps:
             print(f"[worker {ctx.process_id}] step {step} loss "
                   f"{loss:.4f}", flush=True)
     if ckpt is not None:
-        ckpt.save(state, meta={"step": step}, storage=True)
+        ckpt.save(split_ckpt(state), meta={"step": step}, storage=True)
         ckpt.wait()
     print(f"TRAIN_DONE step={step} loss={loss:.4f}", flush=True)
     return 0
