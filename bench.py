@@ -195,6 +195,38 @@ def _measure_candidate(cfg, batch, seq, remat, iters, opt="adamw",
     return dt, loss
 
 
+def _measure_decode(cfg, batch, prompt_len, new_tokens):
+    """Decode tokens/s through the KV-cache generate path (the serving
+    half; reference delegates this to vllm).  Returns tokens/sec."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import llama, llama_infer
+
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    prompts = jnp.asarray(
+        np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (batch, prompt_len)
+        ).astype("int32")
+    )
+    gen = jax.jit(
+        lambda p, pr: llama_infer.generate(
+            p, cfg, pr, max_new_tokens=new_tokens, temperature=0.0
+        )
+    )
+    out = gen(params, prompts)
+    jax.block_until_ready(out)
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = gen(params, prompts)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    return batch * new_tokens / dt
+
+
 def _measure_candidate_subproc(
     name, cfg, batch, seq, remat, iters, opt, fp8,
     timeout_s: Optional[float] = None,
@@ -208,9 +240,6 @@ def _measure_candidate_subproc(
     produces nothing.  A subprocess can always be killed; a candidate
     that hangs just scores as failed and the sweep moves on."""
     import os
-    import signal
-    import subprocess
-    import tempfile
 
     if timeout_s is None:
         timeout_s = float(
@@ -224,6 +253,19 @@ def _measure_candidate_subproc(
             if isinstance(v, (int, float, str, bool))
         },
     }
+    result = _run_one_subproc(spec, name, timeout_s)
+    return result["dt"], result["loss"]
+
+
+def _run_one_subproc(spec, name, timeout_s):
+    """Ship a measurement spec to a killable --measure-one subprocess
+    and return its result dict (see _measure_candidate_subproc for why
+    in-process timeouts cannot work against a wedged device runtime)."""
+    import os
+    import signal
+    import subprocess
+    import tempfile
+
     out_fd, out_path = tempfile.mkstemp(prefix="bench_cand_")
     os.close(out_fd)
     proc = subprocess.Popen(
@@ -259,7 +301,7 @@ def _measure_candidate_subproc(
             pass
     if "error" in result:
         raise RuntimeError(result["error"])
-    return result["dt"], result["loss"]
+    return result
 
 
 def _measure_one_main(out_path: str) -> int:
@@ -284,11 +326,18 @@ def _measure_one_main(out_path: str) -> int:
             k: v for k, v in cfg_kwargs.items()
             if k in {f.name for f in _dc.fields(llama.LlamaConfig)}
         })
-        dt, loss = _measure_candidate(
-            cfg, spec["batch"], spec["seq"], spec["remat"],
-            spec["iters"], spec["opt"], spec["fp8"],
-        )
-        result = {"dt": dt, "loss": loss}
+        if spec.get("kind") == "decode":
+            tps = _measure_decode(
+                cfg, spec["batch"], spec["prompt_len"],
+                spec["new_tokens"],
+            )
+            result = {"dt": 0.0, "loss": 0.0, "tokens_per_sec": tps}
+        else:
+            dt, loss = _measure_candidate(
+                cfg, spec["batch"], spec["seq"], spec["remat"],
+                spec["iters"], spec["opt"], spec["fp8"],
+            )
+            result = {"dt": dt, "loss": loss}
     except Exception as e:  # noqa: BLE001
         result = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
     with open(out_path, "w") as f:
@@ -538,6 +587,34 @@ def main() -> int:
     mfu_pct = 100.0 * flops / dt / peak_all
     tokens_per_sec = batch * seq / dt
 
+    # Decode (serving) throughput through the KV-cache generate path —
+    # inference gets a driver-verified number too (VERDICT r3 next #5).
+    decode: dict = {}
+    try:
+        if on_tpu:
+            dcfg = llama.LlamaConfig.small_300m()
+            spec = {
+                "kind": "decode", "batch": 8, "prompt_len": 128,
+                "new_tokens": 128,
+                "cfg": {
+                    k: v for k, v in dcfg.__dict__.items()
+                    if isinstance(v, (int, float, str, bool))
+                },
+            }
+            res = _run_one_subproc(spec, "decode", 1800.0)
+            decode = {
+                "decode_tokens_per_sec": round(res["tokens_per_sec"], 1)
+            }
+        else:
+            tps = _measure_decode(
+                llama.LlamaConfig.tiny(), 2, 8, 8
+            )
+            decode = {"decode_tokens_per_sec": round(tps, 1)}
+        partial.append({"model": "decode", **decode})
+        _flush_partial(partial)
+    except Exception as e:  # noqa: BLE001 - keep the MFU result
+        print(f"bench: decode probe failed: {e}", file=sys.stderr)
+
     # North-star elasticity probe (worker kill -> warm restore), on by
     # default for the flagship TPU run; DLROVER_TPU_BENCH_GOODPUT=0 skips.
     import os
@@ -568,6 +645,7 @@ def main() -> int:
                 "step_time_s": round(dt, 4),
                 "tokens_per_sec": round(tokens_per_sec, 1),
                 "final_loss": round(loss, 4),
+                **decode,
                 **goodput,
             }
         )

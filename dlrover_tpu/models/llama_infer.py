@@ -63,6 +63,10 @@ def _cached_attention(x, layer, cfg, cache_layer, offset, positions,
                       slot_pos=None):
     """x: [B, T, C] new tokens; attends to cache[:offset] + itself.
 
+    ``offset`` may be a scalar (all sequences aligned) or a [B] vector
+    (ragged batch, T must be 1): each sequence writes its token at its
+    OWN slot and masks causally against its own position.
+
     ``slot_pos`` (ring mode, sliding-window models): the ALREADY-updated
     per-slot absolute positions; writes wrap modulo the buffer length
     and masks key on these positions instead of the slot index."""
@@ -75,7 +79,17 @@ def _cached_attention(x, layer, cfg, cache_layer, offset, positions,
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
 
-    if slot_pos is not None:
+    if jnp.ndim(offset) == 1:
+        # Ragged decode: sequence b's token lands at ITS slot offset[b]
+        # (one batched scatter; positions == slot indices, so the
+        # standard kpos <= qpos mask below stays correct per row).
+        k_cache = cache_layer["k"].at[jnp.arange(B), :, offset].set(
+            k[:, 0].astype(dt)  # [B, KV, D] straight onto its slots
+        )
+        v_cache = cache_layer["v"].at[jnp.arange(B), :, offset].set(
+            v[:, 0].astype(dt)
+        )
+    elif slot_pos is not None:
         # Ring write (slot mapping computed ONCE by forward_step).
         ring_slots, slot_pos = slot_pos
         if T == 1:
@@ -171,7 +185,24 @@ def forward_step(
     dt = cfg.dtype
     offset = cache["offset"]
     x = params["embed"].astype(dt)[tokens]
-    positions = offset + jnp.broadcast_to(jnp.arange(T), (B, T))
+    if jnp.ndim(offset) == 1:
+        # Ragged batch: per-sequence write slots/positions (decode-only;
+        # ragged PREFILL needs no special handling — pad tokens written
+        # at their slot positions are causally invisible to every later
+        # real query).
+        if T != 1:
+            raise ValueError(
+                "per-sequence cache offsets support single-token decode "
+                f"steps only, got a chunk of {T}"
+            )
+        if "pos" in cache:
+            raise ValueError(
+                "ragged offsets are not supported with the sliding-"
+                "window ring cache"
+            )
+        positions = offset[:, None]
+    else:
+        positions = offset + jnp.broadcast_to(jnp.arange(T), (B, T))
     no_drop_capacity = B * T * cfg.top_k
     ring = None
     if "pos" in cache:  # ring mode (sliding-window models)
@@ -225,6 +256,35 @@ def forward_step(
     return logits, new_cache
 
 
+def _make_sampler(temperature: float, top_k: int, top_p: float):
+    """(logits [B, V], rng) -> [B] token picker: greedy at T=0, else
+    categorical with optional top-k truncation / top-p nucleus."""
+
+    def pick(logits_1, sub):
+        if temperature <= 0.0:
+            return jnp.argmax(logits_1, axis=-1)
+        scaled = logits_1 / temperature
+        if top_k > 0:
+            kth = jnp.sort(scaled, axis=-1)[:, -top_k, None]
+            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        if top_p > 0.0:
+            # Nucleus: keep the smallest prefix of the sorted
+            # distribution whose mass reaches top_p (the top token
+            # always survives).
+            srt = jnp.sort(scaled, axis=-1)[:, ::-1]  # descending
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = cum - probs < top_p
+            n_keep = jnp.maximum(1, jnp.sum(keep_sorted, axis=-1))
+            cutoff = jnp.take_along_axis(
+                srt, (n_keep - 1)[:, None], axis=-1
+            )
+            scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+        return jax.random.categorical(sub, scaled)
+
+    return pick
+
+
 def generate(
     params: Dict,
     cfg: LlamaConfig,
@@ -260,28 +320,7 @@ def generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    def pick(logits_1, sub):
-        if temperature <= 0.0:
-            return jnp.argmax(logits_1, axis=-1)
-        scaled = logits_1 / temperature
-        if top_k > 0:
-            kth = jnp.sort(scaled, axis=-1)[:, -top_k, None]
-            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-        if top_p > 0.0:
-            # Nucleus: keep the smallest prefix of the sorted
-            # distribution whose mass reaches top_p (the top token
-            # always survives).
-            srt = jnp.sort(scaled, axis=-1)[:, ::-1]  # descending
-            probs = jax.nn.softmax(srt, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            keep_sorted = cum - probs < top_p
-            n_keep = jnp.maximum(1, jnp.sum(keep_sorted, axis=-1))
-            cutoff = jnp.take_along_axis(
-                srt, (n_keep - 1)[:, None], axis=-1
-            )
-            scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
-        return jax.random.categorical(sub, scaled)
-
+    pick = _make_sampler(temperature, top_k, top_p)
     rng, sub = jax.random.split(rng)
     first = pick(logits[:, -1, :], sub).astype(prompts.dtype)
 
@@ -302,3 +341,323 @@ def generate(
     return jnp.concatenate(
         [prompts, jnp.moveaxis(toks, 0, 1)], axis=1
     )
+
+
+def generate_ragged(
+    params: Dict,
+    cfg: LlamaConfig,
+    prompts: jax.Array,  # [B, P] right-padded prompt token ids
+    prompt_lens: jax.Array,  # [B] true prompt lengths (1..P)
+    *,
+    max_new_tokens: int,
+    eos_token: int = -1,  # >=0: per-sequence stop on this token
+    pad_token: int = 0,
+    rng: Optional[jax.Array] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Ragged batched decode: per-sequence lengths, per-sequence EOS.
+
+    Returns ``(tokens [B, P + max_new_tokens], lengths [B])`` where row
+    b holds ``prompt_b`` (its true ``prompt_lens[b]`` tokens), then its
+    continuation immediately after (no pad gap), then ``pad_token``;
+    ``lengths[b]`` is the total valid length.  The decode loop is a
+    ``lax.while_loop`` that EXITS as soon as every sequence has emitted
+    ``eos_token`` — a batch of short answers does not pay for
+    ``max_new_tokens`` steps (the role per-sequence scheduling plays in
+    the serving engine the reference RL stack delegates to,
+    ``atorch/rl/model_engine/model_engine.py:35``).
+
+    Correctness of the ragged PREFILL needs no masking tricks: padded
+    tail tokens are written at their slot positions, and every later
+    real query q for sequence b sits at position ``>=`` those slots only
+    after they have been overwritten by real decode writes — until then
+    the causal mask ``kpos <= qpos`` hides exactly the pad entries that
+    are still stale, because sequence b's next query position IS its
+    first stale slot.
+    """
+    if cfg.sliding_window > 0:
+        raise ValueError(
+            "generate_ragged does not support sliding-window ring "
+            "caches yet; use generate() with aligned prompts"
+        )
+    B, P = prompts.shape
+    N = max_new_tokens
+    if N == 0:
+        return prompts, jnp.asarray(prompt_lens, jnp.int32)
+    prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+    cache = init_cache(cfg, B, P + N)
+    logits, cache = forward_step(params, prompts, cfg, cache)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    pick = _make_sampler(temperature, top_k, top_p)
+
+    # First token: sampled from each sequence's OWN last-prompt logit.
+    last = jnp.take_along_axis(
+        logits, (prompt_lens - 1)[:, None, None], axis=1
+    )[:, 0, :]
+    rng, sub = jax.random.split(rng)
+    first = pick(last, sub).astype(prompts.dtype)
+
+    # Per-sequence decode offsets: sequence b continues at its length.
+    cache = dict(cache, offset=prompt_lens)
+
+    def cond(c):
+        i, _, _, done, _, _ = c
+        return (i < N) & ~jnp.all(done)
+
+    def body(c):
+        # ``done`` means "this row's EOS is already RECORDED" — the EOS
+        # token itself must land in the buffer before the row freezes.
+        i, buf, tok, done, cache, rng = c
+        buf = buf.at[:, i].set(jnp.where(done, pad_token, tok))
+        done_next = done | (
+            (tok == eos_token) if eos_token >= 0
+            else jnp.zeros((B,), bool)
+        )
+        logits, new_cache = forward_step(params, tok[:, None], cfg, cache)
+        rng, sub = jax.random.split(rng)
+        nxt = pick(logits[:, -1, :], sub).astype(tok.dtype)
+        # Finished rows freeze: offset stops advancing so their cache
+        # rows stop changing (their compute rides along masked).
+        frozen = jnp.where(done_next, cache["offset"],
+                           new_cache["offset"])
+        new_cache = dict(new_cache, offset=frozen)
+        return (i + 1, buf, jnp.where(done_next, tok, nxt),
+                done_next, new_cache, rng)
+
+    buf = jnp.full((B, N), pad_token, prompts.dtype)
+    i, buf, _, done, _, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.zeros((), jnp.int32), buf, first,
+         jnp.zeros((B,), bool), cache, rng),
+    )
+    # Number of valid generated tokens per row: the column of the first
+    # pad-after-generation; EOS itself is kept as a valid token.
+    written = jnp.minimum(
+        jnp.where(
+            jnp.any(buf == eos_token, axis=1) if eos_token >= 0
+            else jnp.zeros((B,), bool),
+            jnp.argmax(buf == eos_token, axis=1) + 1,
+            i,
+        ),
+        i,
+    ).astype(jnp.int32)
+
+    # Compact each row: prompt tokens then continuation, no pad gap.
+    j = jnp.arange(P + N)[None, :]
+    gen_idx = jnp.clip(j - prompt_lens[:, None], 0, N - 1)
+    gen_vals = jnp.take_along_axis(buf, gen_idx, axis=1)
+    prompt_padded = jnp.pad(prompts, ((0, 0), (0, N)))
+    lens = prompt_lens + written
+    out = jnp.where(j < prompt_lens[:, None], prompt_padded, gen_vals)
+    out = jnp.where(j < lens[:, None], out, pad_token)
+    return out, lens
+
+
+class DecodeServer:
+    """Continuous-batching greedy/sampled decode over fixed slots — the
+    role vllm plays for the reference's RL engine
+    (``atorch/rl/model_engine/model_engine.py:35``): admission of new
+    prompts into slots as sequences finish, so a stream of requests
+    keeps every slot busy instead of waiting for the batch's slowest
+    member.
+
+    TPU shape: ONE jitted single-token step over all ``slots`` (ragged
+    per-slot offsets), plus one jitted per-bucket prefill that scores a
+    new prompt into a single slot's cache rows.  The host loop only
+    schedules; every FLOP runs under jit at static shapes.
+
+        srv = DecodeServer(params, cfg, slots=8, max_len=512,
+                           eos_token=2)
+        outs = srv.serve(list_of_prompt_arrays, max_new_tokens=128)
+    """
+
+    def __init__(
+        self,
+        params: Dict,
+        cfg: LlamaConfig,
+        *,
+        slots: int = 8,
+        max_len: int = 512,
+        eos_token: int = -1,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 0.0,
+        prompt_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256),
+        seed: int = 0,
+    ):
+        if cfg.sliding_window > 0:
+            raise ValueError("DecodeServer: sliding-window models "
+                             "are not supported yet")
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.max_len = max_len
+        self.eos_token = eos_token
+        self.buckets = tuple(
+            b for b in sorted(prompt_buckets) if b <= max_len
+        )
+        self._pick = _make_sampler(temperature, top_k, top_p)
+        self._prefill_jit: Dict[int, Any] = {}
+        # Host-managed sampling stream: every step/prefill consumes a
+        # FRESH subkey (a constant key would make non-greedy serving
+        # degenerate — identical noise each step collapses samples into
+        # short loops).
+        self._rng = jax.random.PRNGKey(seed)
+
+        def step(params, cache, toks, active, sub):
+            logits, new_cache = forward_step(
+                params, toks[:, None], cfg, cache
+            )
+            nxt = self._pick(logits[:, -1, :], sub)
+            # Inactive slots freeze (offset unchanged -> cache rows
+            # stable while awaiting admission).
+            frozen = jnp.where(
+                active, new_cache["offset"], cache["offset"]
+            )
+            return dict(new_cache, offset=frozen), nxt.astype(toks.dtype)
+
+        self._step = jax.jit(step)
+
+    def _next_key(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"prompt of {n} tokens exceeds largest bucket "
+            f"{self.buckets[-1]}"
+        )
+
+    def _prefill(self, bucket: int):
+        """Jitted: score one right-padded prompt into slot ``s``'s cache
+        rows; returns (cache, first sampled token)."""
+        cfg = self.cfg
+
+        def fn(params, cache, s, prompt, plen, key):
+            sub_layers = [
+                {
+                    "k": jax.lax.dynamic_slice_in_dim(cl["k"], s, 1, 0),
+                    "v": jax.lax.dynamic_slice_in_dim(cl["v"], s, 1, 0),
+                }
+                for cl in cache["layers"]
+            ]
+            # Fresh zero rows for this slot (slot reuse must not see a
+            # previous occupant's keys beyond the causal mask).
+            sub = {
+                "layers": [
+                    {
+                        "k": jnp.zeros_like(c["k"]),
+                        "v": jnp.zeros_like(c["v"]),
+                    }
+                    for c in sub_layers
+                ],
+                "offset": jnp.zeros((), jnp.int32),
+            }
+            logits, sub = forward_step(params, prompt[None, :], cfg, sub)
+            last = logits[0, plen - 1, :]
+            first = self._pick(last[None, :], key)[0]
+            new_layers = [
+                {
+                    "k": jax.lax.dynamic_update_slice_in_dim(
+                        cl["k"], sc["k"], s, 0
+                    ),
+                    "v": jax.lax.dynamic_update_slice_in_dim(
+                        cl["v"], sc["v"], s, 0
+                    ),
+                }
+                for cl, sc in zip(cache["layers"], sub["layers"])
+            ]
+            new_offset = cache["offset"].at[s].set(plen)
+            return dict(cache, layers=new_layers, offset=new_offset), first
+
+        return jax.jit(fn)
+
+    def serve(self, prompts, max_new_tokens: int):
+        """Decode every prompt (a list of 1-D int arrays); returns a
+        list of 1-D arrays (prompt + continuation, EOS included)."""
+        import numpy as onp
+
+        cfg = self.cfg
+        B = self.slots
+        queue = list(enumerate(prompts))[::-1]  # pop() admits in order
+        results: Dict[int, Any] = {}
+        cache = init_cache(cfg, B, self.max_len)
+        cache = dict(cache, offset=jnp.zeros((B,), jnp.int32))
+        toks = jnp.zeros((B,), jnp.int32)
+        active = onp.zeros((B,), bool)
+        slot_req = [-1] * B  # request id per slot
+        slot_out: list = [None] * B
+        budget = [0] * B
+
+        # Capacity: every write slot a request will ever touch must fit
+        # the cache — an out-of-range scatter is silently DROPPED by
+        # JAX and would emit a plausible-but-wrong continuation.
+        for rid, prompt in enumerate(prompts):
+            need = len(prompt) + max_new_tokens
+            if need > self.max_len:
+                raise ValueError(
+                    f"request {rid}: prompt {len(prompt)} + "
+                    f"max_new_tokens {max_new_tokens} = {need} exceeds "
+                    f"max_len {self.max_len}"
+                )
+
+        def admit(slot):
+            rid, prompt = queue.pop()
+            prompt = onp.asarray(prompt, onp.int32)
+            n = len(prompt)
+            b = self._bucket(n)
+            padded = onp.zeros((b,), onp.int32)
+            padded[:n] = prompt
+            if b not in self._prefill_jit:
+                self._prefill_jit[b] = self._prefill(b)
+            nonlocal cache, toks
+            cache, first = self._prefill_jit[b](
+                self.params, cache, slot, jnp.asarray(padded),
+                jnp.asarray(n, jnp.int32), self._next_key(),
+            )
+            toks = toks.at[slot].set(first.astype(toks.dtype))
+            active[slot] = True
+            slot_req[slot] = rid
+            slot_out[slot] = [int(first)]
+            budget[slot] = max_new_tokens - 1
+            if int(first) == self.eos_token or budget[slot] <= 0:
+                finish(slot)
+
+        def finish(slot):
+            rid = slot_req[slot]
+            prompt = onp.asarray(prompts[rid], onp.int32)
+            results[rid] = onp.concatenate(
+                [prompt, onp.asarray(slot_out[slot], onp.int32)]
+            )
+            active[slot] = False
+            slot_req[slot] = -1
+
+        while queue or active.any():
+            for s in range(B):
+                if not active[s] and queue:
+                    admit(s)
+            if not active.any():
+                continue
+            cache, nxt = self._step(
+                self.params, cache, toks, jnp.asarray(active),
+                self._next_key(),
+            )
+            toks = nxt
+            host_next = onp.asarray(nxt)
+            for s in range(B):
+                if not active[s]:
+                    continue
+                slot_out[s].append(int(host_next[s]))
+                budget[s] -= 1
+                if (
+                    int(host_next[s]) == self.eos_token
+                    or budget[s] <= 0
+                ):
+                    finish(s)
+        return [results[i] for i in range(len(prompts))]
