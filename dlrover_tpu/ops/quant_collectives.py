@@ -1,0 +1,145 @@
+"""Quantized-gradient collectives: int8 compress -> reduce -> dequant.
+
+The TPU-native analogue of the reference's compressed-communication CUDA
+kernels (``atorch/atorch/ops/csrc/quantization/quant_reduce.cu:1-248``
+and ``swizzled_quantize.cu`` — 8-bit quantize feeding reduce paths).
+Where the reference hand-writes NCCL ring stages, here the compression
+wraps XLA collectives inside ``shard_map``:
+
+two-phase quantized allreduce over axis of size N (the quant_reduce
+scheme):
+  1. blockwise int8 quantize the local tensor (128-wide blocks,
+     per-block fp32 scale — ``ops.quant``'s format);
+  2. ``all_to_all`` the code/scale chunks so each device owns 1/N of
+     the blocks from every peer   (bytes moved: ~n/4 per device);
+  3. dequantize + sum (fp32) the owned chunk, requantize;
+  4. int8 ``psum`` of one-hot-placed chunks (each position has exactly
+     one contributor, so the sum IS the concatenation; int8 payload
+     keeps the wire compressed at ~n/2, and psum — unlike all_gather —
+     is provably replicated, keeping shard_map's check_vma ON) +
+     dequantize.
+
+Per-device traffic ~3n/4 bytes vs ~8n for a ring fp32 allreduce — the
+bandwidth that matters on DCN-crossing axes (multislice hybrid mesh,
+local-SGD outer sync), where ICI-class allreduce throughput does not
+exist.
+
+Use inside ``shard_map``/``pmap`` bodies (an ``axis_name`` must be in
+scope)::
+
+    grads = jax.tree_util.tree_map(
+        lambda g: quantized_pmean(g, "dp"), grads
+    )
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.ops.quant import BLOCK
+
+# Leaves below this many elements take the plain-fp32 path: the
+# compression header (scales, padding to N*BLOCK) and the extra
+# collective hop cost more than they save.
+MIN_QUANT_ELEMS = 8192
+
+
+def _axis_size(axis_name: str) -> int:
+    return jax.lax.axis_size(axis_name)
+
+
+def _quantize(x: jax.Array):
+    """flat fp32 -> (codes int8 [nb, BLOCK], scales fp32 [nb])."""
+    n = x.size
+    nb = -(-n // BLOCK)
+    flat = jnp.zeros((nb * BLOCK,), jnp.float32).at[:n].set(
+        x.reshape(-1).astype(jnp.float32)
+    )
+    blocks = flat.reshape(nb, BLOCK)
+    scale = jnp.maximum(jnp.max(jnp.abs(blocks), axis=-1) / 127.0, 1e-12)
+    codes = jnp.clip(jnp.round(blocks / scale[:, None]), -127, 127)
+    return codes.astype(jnp.int8), scale
+
+
+def _dequantize(codes: jax.Array, scale: jax.Array) -> jax.Array:
+    return codes.astype(jnp.float32) * scale[..., None]
+
+
+def quantized_psum(x: jax.Array, axis_name: str, *, mean: bool = False
+                   ) -> jax.Array:
+    """Sum (or mean) of ``x`` across ``axis_name`` with int8-compressed
+    communication.  Bit-identical across participants (every device
+    computes the same dequantized result); falls back to plain
+    psum/pmean for small leaves.
+
+    Accuracy: two symmetric int8 round-trips — worst-case ~1% relative
+    per 128-block, zero-mean; the convergence-parity test pins the
+    training impact."""
+    N = _axis_size(axis_name)
+    if N == 1:
+        return x
+    if x.size < MIN_QUANT_ELEMS:
+        s = jax.lax.psum(x, axis_name)
+        return s / N if mean else s
+
+    orig_dtype = x.dtype
+    orig_shape = x.shape
+    codes, scale = _quantize(x)
+    nb = codes.shape[0]
+    # Pad block count to a multiple of N so every device owns an equal
+    # chunk of the reduction.
+    nb_pad = -(-nb // N) * N
+    if nb_pad != nb:
+        codes = jnp.pad(codes, ((0, nb_pad - nb), (0, 0)))
+        scale = jnp.pad(scale, (0, nb_pad - nb))
+    chunk = nb_pad // N
+
+    # Phase 1: all_to_all — device d receives chunk d of every peer.
+    # split_axis=0 (the N chunks), concat on a fresh leading axis.
+    c = codes.reshape(N, chunk, BLOCK)
+    s = scale.reshape(N, chunk)
+    c_recv = jax.lax.all_to_all(
+        c, axis_name, split_axis=0, concat_axis=0, tiled=False
+    )  # [N, chunk, BLOCK]: peer p's chunk for this device
+    s_recv = jax.lax.all_to_all(
+        s, axis_name, split_axis=0, concat_axis=0, tiled=False
+    )  # [N, chunk]
+
+    # Phase 2: local fp32 reduction of the owned chunk, requantize.
+    part = jnp.sum(_dequantize(c_recv, s_recv), axis=0)  # [chunk, BLOCK]
+    if mean:
+        part = part / N
+    pscale = jnp.maximum(
+        jnp.max(jnp.abs(part), axis=-1) / 127.0, 1e-12
+    )
+    pcodes = jnp.clip(
+        jnp.round(part / pscale[:, None]), -127, 127
+    ).astype(jnp.int8)
+
+    # Phase 3: exchange the reduced chunks.  One-hot placement + psum
+    # (single contributor per position -> sum == concatenation): the
+    # int8 payload keeps the wire compressed, and psum's output is
+    # statically replicated so check_vma stays on (all_gather's is not).
+    me = jax.lax.axis_index(axis_name)
+    g_codes = jax.lax.psum(
+        jnp.zeros((N, chunk, BLOCK), jnp.int8).at[me].set(pcodes),
+        axis_name,
+    ).reshape(nb_pad, BLOCK)
+    g_scale = jax.lax.psum(
+        jnp.zeros((N, chunk), jnp.float32).at[me].set(pscale),
+        axis_name,
+    ).reshape(nb_pad)
+    out = _dequantize(g_codes, g_scale).reshape(-1)[: x.size]
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+def quantized_pmean(x: jax.Array, axis_name: str) -> jax.Array:
+    return quantized_psum(x, axis_name, mean=True)
+
+
+def tree_quantized_pmean(tree, axis_name: str):
+    """Apply :func:`quantized_pmean` to every leaf of a gradient tree."""
+    return jax.tree_util.tree_map(
+        lambda g: quantized_pmean(g, axis_name), tree
+    )

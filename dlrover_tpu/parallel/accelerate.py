@@ -124,6 +124,13 @@ class Strategy:
     # returning ``(loss, new_states)`` — e.g. ``models.llama.loss_fn``
     # with ``init_fp8_states``.
     fp8: bool = False
+    # Compress the dp-axis gradient reduction to int8 (blockwise
+    # quantize -> all_to_all partial sums -> all_gather), the
+    # reference's quant_reduce.cu capability
+    # (``atorch/ops/csrc/quantization/quant_reduce.cu``).  The win is
+    # bandwidth on a DCN-crossing dp axis (multislice hybrid mesh);
+    # needs mesh.dp > 1 and is incompatible with fp8 for now.
+    quant_grads: bool = False
 
     def describe(self) -> str:
         return (
@@ -131,7 +138,31 @@ class Strategy:
             f"accum={self.grad_accum}"
             + (" offload_opt" if self.offload_opt else "")
             + (" fp8" if self.fp8 else "")
+            + (" quant_grads" if self.quant_grads else "")
         )
+
+
+def quant_grads_incompat(strategy: "Strategy") -> Optional[str]:
+    """The ONE source of truth for quant_grads compatibility (used by
+    the pre-flight check, candidate compilation, and the search-space
+    generator): returns a reason string when the strategy cannot run
+    with compressed gradient reduction, else None."""
+    if not strategy.quant_grads:
+        return None
+    if strategy.fp8:
+        return (
+            "Strategy(quant_grads=True) is incompatible with fp8 for "
+            "now (fp8 state reduction across dp is undefined)"
+        )
+    m = strategy.mesh
+    if any(getattr(m, a) > 1 for a in ("pp", "fsdp", "ep", "tp")):
+        return (
+            "Strategy(quant_grads=True) needs a pure-dp mesh (got "
+            f"{m.describe()}); compressed DCN sync for hybrid/sharded "
+            "layouts goes through local_sgd's quantized outer step "
+            "instead"
+        )
+    return None
 
 
 def infer_param_specs(params: Any, spec: MeshSpec) -> Any:
@@ -174,6 +205,8 @@ def _build_train_step(
     tx,
     strategy: Strategy,
     has_frozen: bool = False,
+    mesh: Optional[Mesh] = None,
+    batch_axes: Any = None,  # resolved PartitionSpec tree (quant path)
 ):
     """state={'params','opt_state','step'}; batch pytree; returns jittable
     step with optional remat and grad accumulation (grad-accum preserves
@@ -196,6 +229,119 @@ def _build_train_step(
         lfn = jax.checkpoint(loss_fn, policy=remat_policy)
 
     fp8_on = strategy.fp8
+    quant_on = strategy.quant_grads and strategy.mesh.dp > 1
+
+    def _quant_loss_and_grads(params, batch, frozen):
+        """Full-step (loss, grads) with int8-compressed dp reduction.
+
+        Each dp shard differentiates its LOCAL batch shard (all
+        grad-accum microbatches accumulate locally), then ONE explicit
+        int8-compressed reduction replaces the gradient psum XLA would
+        have inserted implicitly — the quant_reduce.cu role.
+
+        Semantics: pmean of per-shard mean losses/grads — identical to
+        DDP's per-rank averaging (the reference's own data plane).  For
+        batches whose loss normalizes by a data-dependent count (packed
+        sequences), shards with fewer valid tokens are up-weighted
+        exactly as under DDP, and differ from the single-global-mean
+        GSPMD path by that same factor.
+
+        The shard_map is FULL-manual over a dp-only view of the mesh
+        (same devices, same order): partial-manual (axis_names=) with
+        any extra mesh axis — even size 1 — hard-crashes this XLA
+        build's partitioner ("Invalid binary instruction opcode copy"),
+        which is why quant_grads requires a pure-dp mesh; hybrid/fsdp
+        layouts get compressed DCN sync via local_sgd's outer step
+        instead."""
+        from dlrover_tpu.ops.quant_collectives import (
+            tree_quantized_pmean,
+        )
+
+        dp_mesh = Mesh(np.asarray(mesh.devices).reshape(-1), ("dp",))
+        A = strategy.grad_accum
+
+        def local(params, b_local, frozen):
+            kw_l = {"frozen": frozen} if has_frozen else {}
+            # pcast to varying: custom-VJP rules (rmsnorm, flash
+            # attention, fused lm-head) emit per-shard cotangents, and
+            # the vma type check requires input/cotangent variance to
+            # match (invariance is restored by the reduction below).
+            params = jax.tree_util.tree_map(
+                lambda x: jax.lax.pcast(x, "dp", to="varying"), params
+            )
+            if has_frozen:
+                kw_l["frozen"] = jax.tree_util.tree_map(
+                    lambda x: jax.lax.pcast(x, "dp", to="varying"),
+                    kw_l["frozen"],
+                )
+
+            if A > 1:
+                micro = jax.tree_util.tree_map(
+                    lambda x: x.reshape((A, -1) + x.shape[1:]), b_local
+                )
+
+                def acc_fn(carry, mb):
+                    loss_sum, grads_sum = carry
+                    loss, grads = jax.value_and_grad(lfn)(
+                        params, mb, **kw_l
+                    )
+                    return (
+                        loss_sum + loss,
+                        jax.tree_util.tree_map(
+                            jnp.add, grads_sum, grads
+                        ),
+                    ), None
+
+                # The whole carry is dp-varying (local sums).
+                zero = jax.tree_util.tree_map(
+                    lambda p: jax.lax.pcast(
+                        jnp.zeros(np.shape(p), jnp.float32), "dp",
+                        to="varying",
+                    ),
+                    (jnp.zeros(()), params),
+                )
+                (loss, grads), _ = jax.lax.scan(acc_fn, zero, micro)
+                loss = loss / A
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / A, grads
+                )
+            else:
+                loss, grads = jax.value_and_grad(lfn)(
+                    params, b_local, **kw_l
+                )
+            # ONE compressed reduction per step, after accumulation —
+            # not one per microbatch (the DCN bytes are the point).
+            return (
+                jax.lax.pmean(loss, "dp"),
+                tree_quantized_pmean(grads, "dp"),
+            )
+
+        def dp_only(spec):
+            # Honor the caller's batch placement, translated to the
+            # dp-only inner mesh: axes entries containing 'dp' keep it
+            # (('dp','fsdp') == 'dp' here: the mesh is pure-dp), all
+            # others are replicated.  Force-sharding every leaf P('dp')
+            # would silently split replicated batch leaves.
+            parts = []
+            for part in spec:
+                if part == "dp" or (
+                    isinstance(part, (tuple, list)) and "dp" in part
+                ):
+                    parts.append("dp")
+                else:
+                    parts.append(None)
+            return P(*parts)
+
+        mb_specs = jax.tree_util.tree_map(
+            dp_only, batch_axes, is_leaf=lambda s: isinstance(s, P)
+        )
+        frozen_arg = frozen if has_frozen else jnp.zeros(())
+        return jax.shard_map(
+            local,
+            mesh=dp_mesh,
+            in_specs=(P(), mb_specs, P()),
+            out_specs=(P(), P()),
+        )(params, batch, frozen_arg)
 
     def _value_and_grad(params, mb, fp8, frozen):
         """(loss, grads, new_fp8) for one microbatch; new_fp8 is None
@@ -215,7 +361,12 @@ def _build_train_step(
         # must fail fast here, not as an opaque has_aux tracing error.
         fp8 = state["fp8"] if fp8_on else None
 
-        if strategy.grad_accum > 1:
+        if quant_on:
+            # Accumulation happens INSIDE the sharded local step; one
+            # compressed reduction per optimizer step.
+            loss, grads = _quant_loss_and_grads(params, batch, frozen)
+            new_fp8 = None
+        elif strategy.grad_accum > 1:
             micro = jax.tree_util.tree_map(
                 lambda x: x.reshape(
                     (strategy.grad_accum, -1) + x.shape[1:]
@@ -336,6 +487,14 @@ def accelerate(
             dataclasses.replace(c, grad_accum=grad_accum)
             for c in candidates
         ]
+    if any(c.quant_grads and c.fp8 for c in candidates):
+        # Fail fast with the real cause (an explicit-Strategy caller
+        # would otherwise only see "no viable strategy found").
+        raise ValueError(
+            quant_grads_incompat(
+                next(c for c in candidates if c.quant_grads and c.fp8)
+            )
+        )
     if fp8_init is None and any(c.fp8 for c in candidates):
         # Fail fast with the real cause: inside the candidate loop this
         # ValueError would be swallowed and resurface only as the generic
@@ -562,8 +721,13 @@ def _compile_candidate(
         )
     batch_sharding = named_sharding_tree(batch_axes, mesh)
 
+    if strategy.quant_grads:
+        reason = quant_grads_incompat(strategy)
+        if reason:
+            raise ValueError(reason)
     step_fn = _build_train_step(
-        loss_fn, optimizer, strategy, has_frozen=frozen is not None
+        loss_fn, optimizer, strategy, has_frozen=frozen is not None,
+        mesh=mesh, batch_axes=batch_axes,
     )
     # The frozen tree is a separate, never-donated jit argument (see
     # _build_train_step); the public train_step keeps the state-dict API.

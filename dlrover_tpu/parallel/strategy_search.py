@@ -54,6 +54,7 @@ def strategy_to_dict(strategy) -> dict:
         "donate": strategy.donate,
         "offload_opt": strategy.offload_opt,
         "fp8": strategy.fp8,
+        "quant_grads": strategy.quant_grads,
     }
 
 
@@ -70,6 +71,7 @@ def strategy_from_dict(d: dict):
         donate=bool(d.get("donate", True)),
         offload_opt=bool(d.get("offload_opt", False)),
         fp8=bool(d.get("fp8", False)),
+        quant_grads=bool(d.get("quant_grads", False)),
     )
 
 
@@ -87,6 +89,7 @@ def default_space(
     allow_pp: bool = True,
     offload_opt: Sequence[bool] = (False, True),
     fp8: Sequence[bool] = (False,),
+    quant_grads: Sequence[bool] = (False,),
     base=None,
 ) -> List[Any]:
     """The discrete Strategy grid for ``n_devices`` (the combination half
@@ -112,12 +115,25 @@ def default_space(
                             # fp8_states; such a point would burn a
                             # compile and die as an opaque TypeError.
                             continue
-                        out.append(
-                            dataclasses.replace(
-                                base, mesh=spec, remat=r, grad_accum=a,
-                                offload_opt=oo, fp8=f8,
+                        for qg in quant_grads:
+                            cand = dataclasses.replace(
+                                base, mesh=spec, remat=r,
+                                grad_accum=a, offload_opt=oo,
+                                fp8=f8, quant_grads=qg,
                             )
-                        )
+                            if qg:
+                                from dlrover_tpu.parallel.accelerate \
+                                    import quant_grads_incompat
+
+                                # No dp axis to compress, or the
+                                # combination is rejected — skip
+                                # rather than burn a compile.
+                                if (
+                                    spec.dp <= 1
+                                    or quant_grads_incompat(cand)
+                                ):
+                                    continue
+                            out.append(cand)
     return out
 
 

@@ -36,6 +36,9 @@ def parse_args():
     p.add_argument("--fp8", action="store_true",
                    help="route attention/MLP linears through e4m3/e5m2 "
                         "fp8_dot with delayed scaling")
+    p.add_argument("--quant_grads", action="store_true",
+                   help="int8-compress the dp gradient reduction "
+                        "(pure-dp mesh; the DCN-bandwidth lever)")
     p.add_argument("--lora_rank", type=int, default=0,
                    help=">0: LoRA fine-tuning — train rank-r (A,B) "
                         "factors on the targeted projections, base "
@@ -100,7 +103,8 @@ def main() -> int:
     strategy = (
         "auto" if args.strategy == "auto"
         else Strategy(
-            mesh=MeshSpec(dp=len(jax.devices())), fp8=args.fp8
+            mesh=MeshSpec(dp=len(jax.devices())), fp8=args.fp8,
+            quant_grads=args.quant_grads,
         )
     )
 
