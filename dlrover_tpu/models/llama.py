@@ -309,7 +309,7 @@ def _swiglu(x, mlp, dt, fp8_mlp=None):
 
 
 def _moe_swiglu(x, moe, cfg: LlamaConfig, capacity: Optional[int] = None,
-                valid=None):
+                valid=None, fp8_moe=None):
     """Expert-parallel SwiGLU MoE (dense capacity dispatch, see
     ``parallel.moe`` for the mechanism).  ``capacity`` overrides the
     config-derived expert capacity — decode passes a no-drop value,
@@ -320,7 +320,16 @@ def _moe_swiglu(x, moe, cfg: LlamaConfig, capacity: Optional[int] = None,
     pad positions are excluded from expert routing — they take no
     capacity slots (the position-ordered cumsum would otherwise let a
     pad displace a real token that follows it in the flattened order)
-    and contribute nothing to the load-balance aux statistics."""
+    and contribute nothing to the load-balance aux statistics.
+
+    ``fp8_moe`` (a dict of ``ops.fp8.Fp8State`` for wg/wi/wo) routes the
+    expert projections — the bulk of a MoE model's FLOPs — through the
+    batched e4m3/e5m2 path (``ops.fp8.fp8_batched_dot``); the router and
+    the dispatch/combine einsums stay in fp32/compute dtype (they are
+    permutation-weighted sums, not GEMM hot spots).  Returns a third
+    element (the new fp8 dict) when set — the reference rewrites every
+    eligible expert linear the same way
+    (``atorch/auto/opt_lib/amp_optimization.py:396``)."""
     B, S, C = x.shape
     E, K = cfg.num_experts, cfg.top_k
     N = B * S
@@ -356,10 +365,26 @@ def _moe_swiglu(x, moe, cfg: LlamaConfig, capacity: Optional[int] = None,
         * keep[..., None, None].astype(dt)
     )  # [N, K, E, C]
     xin = jnp.einsum("nd,nkec->ecd", tokens.astype(dt), dispatch)
-    g = jnp.einsum("ecd,edf->ecf", xin, moe["wg"].astype(dt))
-    u = jnp.einsum("ecd,edf->ecf", xin, moe["wi"].astype(dt))
-    h = jax.nn.silu(g) * u
-    xout = jnp.einsum("ecf,efd->ecd", h, moe["wo"].astype(dt))
+    if fp8_moe is not None:
+        from dlrover_tpu.ops.fp8 import fp8_batched_dot
+
+        new_fp8 = {}
+        g, new_fp8["wg"] = fp8_batched_dot(
+            xin, moe["wg"].astype(dt), fp8_moe["wg"]
+        )
+        u, new_fp8["wi"] = fp8_batched_dot(
+            xin, moe["wi"].astype(dt), fp8_moe["wi"]
+        )
+        h = jax.nn.silu(g) * u
+        xout, new_fp8["wo"] = fp8_batched_dot(
+            h, moe["wo"].astype(dt), fp8_moe["wo"]
+        )
+    else:
+        new_fp8 = None
+        g = jnp.einsum("ecd,edf->ecf", xin, moe["wg"].astype(dt))
+        u = jnp.einsum("ecd,edf->ecf", xin, moe["wi"].astype(dt))
+        h = jax.nn.silu(g) * u
+        xout = jnp.einsum("ecf,efd->ecd", h, moe["wo"].astype(dt))
     combine = dispatch * gate_vals[..., None, None].astype(dt)
     out = jnp.einsum("ecd,nkec->nd", xout, combine)
     # Aux load-balance loss, returned via a side dict by forward().
@@ -377,6 +402,8 @@ def _moe_swiglu(x, moe, cfg: LlamaConfig, capacity: Optional[int] = None,
             * w[:, None], axis=0,
         ) / denom
     aux = E * jnp.sum(me * ce)
+    if fp8_moe is not None:
+        return out.reshape(B, S, C), aux, new_fp8
     return out.reshape(B, S, C), aux
 
 
@@ -401,9 +428,10 @@ def block_apply(
     With ``fp8_layer`` (per-layer Fp8State dict from
     :func:`init_fp8_states`) the attention/MLP projections run through
     fp8_dot and the return becomes a 3-tuple
-    ``(x, moe_aux, new_fp8_layer)``; MoE expert matmuls and the router
-    stay in the compute dtype (matching the reference, which only
-    rewrites plain linears)."""
+    ``(x, moe_aux, new_fp8_layer)``; on MoE layers the expert
+    projections (the bulk of the layer's FLOPs) go through the batched
+    fp8 grouped dot as well — only the router and dispatch/combine stay
+    in the compute dtype."""
     h = rmsnorm(x, layer["ln1"], eps=cfg.rms_eps)
     if attn_fn is not None:
         if fp8_layer is not None:
@@ -421,12 +449,15 @@ def block_apply(
     x = x + attn
     h = rmsnorm(x, layer["ln2"], eps=cfg.rms_eps)
     if "moe" in layer:
-        delta, aux = _moe_swiglu(
+        res = _moe_swiglu(
             h, layer["moe"], cfg, capacity=moe_capacity,
             valid=None if segment_ids is None else segment_ids >= 0,
+            fp8_moe=None if fp8_layer is None else fp8_layer["moe"],
         )
         if fp8_layer is not None:
+            delta, aux, new_fp8_attn["moe"] = res
             return x + delta, aux, new_fp8_attn
+        delta, aux = res
         return x + delta, aux
     out_m, new_fp8_mlp = _swiglu(
         h, layer["mlp"], cfg.dtype,
@@ -458,8 +489,9 @@ def segment_positions(segment_ids: jax.Array) -> jax.Array:
 
 def init_fp8_states(cfg: LlamaConfig):
     """Per-layer delayed-scaling Fp8State pytree for :func:`loss_fn`'s
-    ``fp8_states`` (one state per rewritten linear: wq/wk/wv/wo and, for
-    dense-MLP layers, w_gate/w_up/w_down).  Thread through the train
+    ``fp8_states`` (one state per rewritten linear: wq/wk/wv/wo, plus
+    w_gate/w_up/w_down on dense-MLP layers and the stacked wg/wi/wo
+    expert tensors on MoE layers).  Thread through the train
     state and feed each step's output back in — the functional analogue
     of the reference's TE amax history
     (``atorch/auto/opt_lib/amp_optimization.py:396``)."""
@@ -468,7 +500,11 @@ def init_fp8_states(cfg: LlamaConfig):
     states = []
     for i in range(cfg.n_layer):
         st = {k: Fp8State.init() for k in ("wq", "wk", "wv", "wo")}
-        if not cfg.is_moe_layer(i):
+        if cfg.is_moe_layer(i):
+            st["moe"] = {
+                k: Fp8State.init() for k in ("wg", "wi", "wo")
+            }
+        else:
             st["mlp"] = {
                 k: Fp8State.init()
                 for k in ("w_gate", "w_up", "w_down")

@@ -19,7 +19,6 @@ registry; it rides checkpoints like any other state.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Tuple
 
 import jax
@@ -73,34 +72,61 @@ def _cast_fp8(x: jax.Array, scale: jax.Array, dtype) -> jax.Array:
     ).astype(dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=())
-def _fp8_dot(x, w, x_scale, w_scale, g_scale):
-    xq = _cast_fp8(x, x_scale, E4M3)
-    wq = _cast_fp8(w, w_scale, E4M3)
-    out = jnp.dot(xq, wq, preferred_element_type=jnp.float32)
-    return (out * (x_scale * w_scale)).astype(x.dtype)
+def _build_fp8_dot(fwd_dn, dx_dn, dw_dn):
+    """One delayed-scaling fp8 dot with custom VJP, parameterized by
+    ``dot_general`` dimension numbers: forward ``x @ w`` (e4m3 x e4m3),
+    backward ``dX = g ·dx_dn w`` and ``dW = x ·dw_dn g`` with the
+    incoming grad in e5m2.  The plain-linear and batched-expert variants
+    below differ ONLY in these dimension numbers — everything else
+    (cast recipe, descaling, VJP scaffolding) is this one definition."""
+
+    @jax.custom_vjp
+    def dot(x, w, x_scale, w_scale, g_scale):
+        xq = _cast_fp8(x, x_scale, E4M3)
+        wq = _cast_fp8(w, w_scale, E4M3)
+        out = jax.lax.dot_general(
+            xq, wq, fwd_dn, preferred_element_type=jnp.float32
+        )
+        return (out * (x_scale * w_scale)).astype(x.dtype)
+
+    def fwd(x, w, x_scale, w_scale, g_scale):
+        return dot(x, w, x_scale, w_scale, g_scale), (
+            x, w, x_scale, w_scale, g_scale,
+        )
+
+    def bwd(res, g):
+        x, w, x_scale, w_scale, g_scale = res
+        gq = _cast_fp8(g, g_scale, E5M2)
+        wq = _cast_fp8(w, w_scale, E4M3)
+        xq = _cast_fp8(x, x_scale, E4M3)
+        dx = jax.lax.dot_general(
+            gq, wq, dx_dn, preferred_element_type=jnp.float32
+        )
+        dx = (dx * (g_scale * w_scale)).astype(x.dtype)
+        dw = jax.lax.dot_general(
+            xq, gq, dw_dn, preferred_element_type=jnp.float32
+        )
+        dw = (dw * (x_scale * g_scale)).astype(w.dtype)
+        return dx, dw, None, None, None
+
+    dot.defvjp(fwd, bwd)
+    return dot
 
 
-def _fp8_dot_fwd(x, w, x_scale, w_scale, g_scale):
-    return _fp8_dot(x, w, x_scale, w_scale, g_scale), (
-        x, w, x_scale, w_scale, g_scale,
-    )
+# x [M, K] @ w [K, N]: dX = g @ W^T, dW = X^T @ g.
+_fp8_dot = _build_fp8_dot(
+    (((1,), (0,)), ((), ())),
+    (((1,), (1,)), ((), ())),
+    (((0,), (0,)), ((), ())),
+)
 
-
-def _fp8_dot_bwd(res, g):
-    x, w, x_scale, w_scale, g_scale = res
-    gq = _cast_fp8(g, g_scale, E5M2)
-    wq = _cast_fp8(w, w_scale, E4M3)
-    xq = _cast_fp8(x, x_scale, E4M3)
-    # dX = g @ W^T in fp8 x fp8; dW = X^T @ g likewise.
-    dx = jnp.dot(gq, wq.T, preferred_element_type=jnp.float32)
-    dx = (dx * (g_scale * w_scale)).astype(x.dtype)
-    dw = jnp.dot(xq.T, gq, preferred_element_type=jnp.float32)
-    dw = (dw * (x_scale * g_scale)).astype(w.dtype)
-    return dx, dw, None, None, None
-
-
-_fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
+# x [E, C, D] @ w [E, D, F], batched over the expert dim: dX contracts
+# F, dW contracts C, both carrying E as the batch dim.
+_fp8_bdot = _build_fp8_dot(
+    (((2,), (1,)), ((0,), (0,))),
+    (((2,), (2,)), ((0,), (0,))),
+    (((1,), (1,)), ((0,), (0,))),
+)
 
 
 def fp8_dot(
@@ -118,6 +144,34 @@ def fp8_dot(
     w_scale = _scale_from_hist(state.w_hist, E4M3_MAX)
     g_scale = _scale_from_hist(state.g_hist, E5M2_MAX)
     out = _fp8_dot(x, w, x_scale, w_scale, g_scale)
+    new_state = Fp8State(
+        x_hist=_push(
+            state.x_hist, jnp.max(jnp.abs(x)).astype(jnp.float32)
+        ),
+        w_hist=_push(
+            state.w_hist, jnp.max(jnp.abs(w)).astype(jnp.float32)
+        ),
+        g_hist=_push(
+            state.g_hist, jnp.max(jnp.abs(out)).astype(jnp.float32)
+        ),
+    )
+    return out, new_state
+
+
+def fp8_batched_dot(
+    x: jax.Array, w: jax.Array, state: Fp8State
+) -> Tuple[jax.Array, Fp8State]:
+    """Per-expert batched ``x[e] @ w[e]`` with e4m3 forward / e5m2
+    backward — the MoE grouped-matmul analogue of :func:`fp8_dot`.
+
+    Scales are per-STACKED-tensor (one amax over all experts), the
+    "shared" variant: a per-expert scale would need a gather per token
+    block and buys little when experts share an init distribution.
+    Shapes: x [E, C, D], w [E, D, F] -> [E, C, F]."""
+    x_scale = _scale_from_hist(state.x_hist, E4M3_MAX)
+    w_scale = _scale_from_hist(state.w_hist, E4M3_MAX)
+    g_scale = _scale_from_hist(state.g_hist, E5M2_MAX)
+    out = _fp8_bdot(x, w, x_scale, w_scale, g_scale)
     new_state = Fp8State(
         x_hist=_push(
             state.x_hist, jnp.max(jnp.abs(x)).astype(jnp.float32)

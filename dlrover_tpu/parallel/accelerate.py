@@ -125,11 +125,13 @@ class Strategy:
     # with ``init_fp8_states``.
     fp8: bool = False
     # Compress the dp-axis gradient reduction to int8 (blockwise
-    # quantize -> all_to_all partial sums -> all_gather), the
-    # reference's quant_reduce.cu capability
-    # (``atorch/ops/csrc/quantization/quant_reduce.cu``).  The win is
-    # bandwidth on a DCN-crossing dp axis (multislice hybrid mesh);
-    # needs mesh.dp > 1 and is incompatible with fp8 for now.
+    # quantize -> all_to_all of int8 shard-partials -> local dequant
+    # reduce -> one-hot int8 psum to re-replicate; all_gather is
+    # deliberately NOT used — its output is not statically replicated,
+    # which breaks check_vma), the reference's quant_reduce.cu
+    # capability (``atorch/ops/csrc/quantization/quant_reduce.cu``).
+    # The win is bandwidth on a DCN-crossing dp axis (multislice hybrid
+    # mesh); needs mesh.dp > 1 and is incompatible with fp8 for now.
     quant_grads: bool = False
 
     def describe(self) -> str:
@@ -161,6 +163,12 @@ def quant_grads_incompat(strategy: "Strategy") -> Optional[str]:
             f"{m.describe()}); compressed DCN sync for hybrid/sharded "
             "layouts goes through local_sgd's quantized outer step "
             "instead"
+        )
+    if m.dp <= 1:
+        return (
+            "Strategy(quant_grads=True) needs mesh.dp > 1 (got "
+            f"{m.describe()}): there is no dp gradient reduction to "
+            "compress"
         )
     return None
 
@@ -229,7 +237,9 @@ def _build_train_step(
         lfn = jax.checkpoint(loss_fn, policy=remat_policy)
 
     fp8_on = strategy.fp8
-    quant_on = strategy.quant_grads and strategy.mesh.dp > 1
+    quant_on = (
+        strategy.quant_grads and quant_grads_incompat(strategy) is None
+    )
 
     def _quant_loss_and_grads(params, batch, frozen):
         """Full-step (loss, grads) with int8-compressed dp reduction.
@@ -487,14 +497,13 @@ def accelerate(
             dataclasses.replace(c, grad_accum=grad_accum)
             for c in candidates
         ]
-    if any(c.quant_grads and c.fp8 for c in candidates):
-        # Fail fast with the real cause (an explicit-Strategy caller
-        # would otherwise only see "no viable strategy found").
-        raise ValueError(
-            quant_grads_incompat(
-                next(c for c in candidates if c.quant_grads and c.fp8)
-            )
-        )
+    qg_reasons = [quant_grads_incompat(c) for c in candidates]
+    if qg_reasons and all(qg_reasons):
+        # Every candidate is an incompatible quant_grads combination
+        # (fp8, hybrid mesh, or dp<=1): fail fast with the real cause —
+        # an explicit-Strategy caller would otherwise only see the
+        # generic "no viable strategy found".
+        raise ValueError(qg_reasons[0])
     if fp8_init is None and any(c.fp8 for c in candidates):
         # Fail fast with the real cause: inside the candidate loop this
         # ValueError would be swallowed and resurface only as the generic

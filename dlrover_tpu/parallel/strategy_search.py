@@ -125,13 +125,10 @@ def default_space(
                                 from dlrover_tpu.parallel.accelerate \
                                     import quant_grads_incompat
 
-                                # No dp axis to compress, or the
-                                # combination is rejected — skip
+                                # Incompatible combination (no dp axis
+                                # to compress, hybrid mesh, fp8): skip
                                 # rather than burn a compile.
-                                if (
-                                    spec.dp <= 1
-                                    or quant_grads_incompat(cand)
-                                ):
+                                if quant_grads_incompat(cand):
                                     continue
                             out.append(cand)
     return out
@@ -273,6 +270,7 @@ def _features(strategy) -> np.ndarray:
             np.log2(max(1, strategy.grad_accum)),
             float(strategy.offload_opt),
             float(strategy.fp8),
+            float(strategy.quant_grads),
         ],
         dtype=np.float64,
     )

@@ -189,13 +189,20 @@ class TestQuantGradsStrategy:
             optimizer=optax.adamw(1e-2),
             sample_batch={"tokens": toks},
         )
-        with pytest.raises(RuntimeError, match="no viable strategy"):
-            # fsdp x quant_grads: the sole candidate is rejected.
+        with pytest.raises(ValueError, match="pure-dp mesh"):
+            # fsdp x quant_grads: fail fast with the real cause.
             accelerate(
                 strategy=Strategy(
                     mesh=MeshSpec(dp=2, fsdp=2), quant_grads=True
                 ),
                 devices=cpu_mesh_devices[:4], **kw,
+            )
+        with pytest.raises(ValueError, match="dp > 1"):
+            # dp=1 x quant_grads: nothing to compress — fail fast, not
+            # a silent no-op.
+            accelerate(
+                strategy=Strategy(quant_grads=True),
+                devices=cpu_mesh_devices[:1], **kw,
             )
         with pytest.raises(ValueError, match="incompatible with fp8"):
             accelerate(
