@@ -345,11 +345,18 @@ def _measure_one_main(out_path: str) -> int:
     return 0 if "error" not in result else 1
 
 
-def measure_goodput(total_steps=80, timeout_s=900):
+def measure_goodput(total_steps=80, timeout_s=900, backend="cpu"):
     """North-star probe (BASELINE.md): goodput under an injected worker
-    failure.  Runs the real launcher->master->agent->worker tree on CPU
-    devices, SIGKILLs one worker mid-run, and lets the stack breakpoint-
-    save -> re-rendezvous -> warm-restore from shm and finish the job.
+    failure.  Runs the real launcher->master->agent->worker tree,
+    SIGKILLs one worker mid-run, and lets the stack breakpoint-save ->
+    re-rendezvous -> warm-restore from shm and finish the job.
+
+    ``backend="cpu"`` (default): 2 workers on forced-CPU devices — the
+    hardware-free elasticity probe.  ``backend="tpu"``: ONE worker that
+    keeps the ambient (tunneled TPU) backend, so the measured downtime
+    includes real device-state transfer + XLA recompilation — the
+    "restore in seconds" north star measured with a device in the loop
+    (reference ``docs/blogs/flash_checkpoint.md:402-409``).
 
     Returns {downtime_s, restore_from, probe_goodput, goodput_1h_pct} —
     ``goodput_1h_pct`` extrapolates the measured downtime to a 1-hour job
@@ -366,16 +373,20 @@ def measure_goodput(total_steps=80, timeout_s=900):
     tmp = tempfile.mkdtemp(prefix="bench_goodput_")
     log_path = os.path.join(tmp, "run.log")
     env = dict(os.environ)
-    env.update({
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-        "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
-    })
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    if backend == "tpu":
+        nproc = 1  # the tunnel exposes one chip
+    else:
+        nproc = 2
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        })
     with open(log_path, "w") as log:
         proc = subprocess.Popen(
             [
                 sys.executable, "-m", "dlrover_tpu.run",
-                "--standalone", "--nproc_per_node=2",
+                "--standalone", f"--nproc_per_node={nproc}",
                 "--job_name=bench-goodput", "--monitor_interval=1",
                 os.path.join(repo, "examples", "nanogpt_train.py"),
                 "--", f"--steps={total_steps}",
@@ -404,12 +415,14 @@ def measure_goodput(total_steps=80, timeout_s=900):
                 # lines stale (killing a stale pid could hit an
                 # unrelated process).
                 pids = re.findall(
-                    r"started 2 worker\(s\): pids=\[(\d+), (\d+)\]",
+                    r"started %d worker\(s\): pids=\[([0-9, ]+)\]"
+                    % nproc,
                     content,
                 )
                 if pids and re.search(r"step (1[0-9]|[2-9][0-9]) loss",
                                       content):
-                    os.kill(int(pids[-1][1]), signal.SIGKILL)
+                    victim = int(pids[-1].split(",")[-1].strip())
+                    os.kill(victim, signal.SIGKILL)
                     t_kill = time.time()
                     steps_before = len(re.findall(r"step \d+ loss",
                                                   content))
@@ -491,18 +504,22 @@ def main() -> int:
         m300h = _dc.replace(m300, n_head=8, n_kv_head=8)
         m800 = llama.LlamaConfig.medium_800m()
         m800h = _dc.replace(m800, n_head=12, n_kv_head=12)
+        # BEST-KNOWN-FIRST (r4 live session, BENCH_PARTIAL r4: h128 b8
+        # 50.8% > b16 block 48.8% > 800m block 48.6% > fp8 48.2% >
+        # base 43.2%): the tunnel has wedged mid-sweep twice — the
+        # verified-best candidate must land before it can.
         candidates = [
-            ("llama_300m", m300, 8, "none", "adamw", 3, False),
             ("llama_300m_h128", m300h, 8, "none", "adamw", 3, False),
             ("llama_300m_h128", m300h, 16, "block", "adamw", 3, False),
-            # fp8 linears (delayed scaling): only wins where the chip
-            # lowers e4m3 dots natively (v5p/v6); elsewhere XLA upcasts
-            # and the candidate loses cleanly.
-            ("llama_300m_h128_fp8", m300h, 8, "none", "adamw", 3, True),
             # The 800m's wider GEMMs (d=1536, ff=4096) feed the MXU
             # better; fused lm-head loss + per-block remat + int8 Adam
             # state make it fit in 16G HBM.
             ("llama_800m", m800, 8, "block", "adamw", 3, False),
+            # fp8 linears (delayed scaling): only wins where the chip
+            # lowers e4m3 dots natively (v5p/v6); elsewhere XLA upcasts
+            # and the candidate loses cleanly.
+            ("llama_300m_h128_fp8", m300h, 8, "none", "adamw", 3, True),
+            ("llama_300m", m300, 8, "none", "adamw", 3, False),
             ("llama_800m_h128", m800h, 8, "block", "adamw", 3, False),
             ("llama_800m_h128", m800h, 16, "block", "adam8bit", 3, False),
             ("llama_800m_h128_fp8", m800h, 8, "block", "adamw", 3, True),
@@ -517,6 +534,22 @@ def main() -> int:
                        "adamw", 1, False)]
         seq, iters = 64, 3
 
+    import os
+
+    # Global deadline: the driver needs ONE final JSON line.  A tunnel
+    # that wedges mid-sweep must cost the remaining candidates, not the
+    # artifact — measured partials are summarized when time is up.
+    try:
+        _deadline_s = float(
+            os.environ.get("DLROVER_TPU_BENCH_DEADLINE", "2700")
+        )
+    except ValueError:  # malformed knob must not cost the artifact
+        _deadline_s = 2700.0
+    bench_deadline = time.time() + _deadline_s
+
+    def _time_left() -> float:
+        return bench_deadline - time.time()
+
     best = None  # (flops/sec, name, cfg, batch, remat, opt, dt, loss, fp8)
     partial: list = []
     _flush_partial(partial)  # truncate any previous run's stale data
@@ -526,12 +559,18 @@ def main() -> int:
             "model": name, "batch": batch, "remat": remat, "opt": opt,
             "fp8": fp8, "backend": jax.default_backend(),
         }
+        if on_tpu and _time_left() < 300.0:
+            entry["error"] = "skipped: bench deadline reached"
+            partial.append(entry)
+            _flush_partial(partial)
+            continue
         try:
             if on_tpu:
                 # Subprocess + hard timeout: a tunnel that wedges
                 # mid-sweep must cost one candidate, not the bench.
                 dt, loss = _measure_candidate_subproc(
-                    name, cfg, batch, seq, remat, probe_iters, opt, fp8
+                    name, cfg, batch, seq, remat, probe_iters, opt, fp8,
+                    timeout_s=min(1800.0, max(60.0, _time_left() - 30)),
                 )
             else:
                 dt, loss = _measure_candidate(cfg, batch, seq, remat,
@@ -570,13 +609,15 @@ def main() -> int:
         return 1
 
     _, name, cfg, batch, remat, opt, dt, loss, fp8 = best
-    # Re-measure the winner at full iteration count for a stable number.
+    # Re-measure the winner at full iteration count for a stable number
+    # (deadline permitting; the probe number stands otherwise).
     try:
-        if on_tpu:
+        if on_tpu and _time_left() > 400.0:
             dt, loss = _measure_candidate_subproc(
-                name, cfg, batch, seq, remat, iters, opt, fp8
+                name, cfg, batch, seq, remat, iters, opt, fp8,
+                timeout_s=min(1800.0, _time_left() - 30),
             )
-        else:
+        elif not on_tpu:
             dt, loss = _measure_candidate(cfg, batch, seq, remat, iters,
                                           opt, fp8)
     except Exception:  # noqa: BLE001 - keep the probe measurement
@@ -591,7 +632,7 @@ def main() -> int:
     # inference gets a driver-verified number too (VERDICT r3 next #5).
     decode: dict = {}
     try:
-        if on_tpu:
+        if on_tpu and _time_left() > 300.0:
             dcfg = llama.LlamaConfig.small_300m()
             spec = {
                 "kind": "decode", "batch": 8, "prompt_len": 128,
@@ -601,30 +642,42 @@ def main() -> int:
                     if isinstance(v, (int, float, str, bool))
                 },
             }
-            res = _run_one_subproc(spec, "decode", 1800.0)
+            res = _run_one_subproc(
+                spec, "decode", min(1500.0, _time_left() - 30)
+            )
             decode = {
                 "decode_tokens_per_sec": round(res["tokens_per_sec"], 1)
             }
-        else:
+        elif not on_tpu:
             tps = _measure_decode(
                 llama.LlamaConfig.tiny(), 2, 8, 8
             )
             decode = {"decode_tokens_per_sec": round(tps, 1)}
-        partial.append({"model": "decode", **decode})
-        _flush_partial(partial)
+        if decode:
+            partial.append({"model": "decode", **decode})
+            _flush_partial(partial)
     except Exception as e:  # noqa: BLE001 - keep the MFU result
         print(f"bench: decode probe failed: {e}", file=sys.stderr)
 
     # North-star elasticity probe (worker kill -> warm restore), on by
     # default for the flagship TPU run; DLROVER_TPU_BENCH_GOODPUT=0 skips.
-    import os
-
+    # With a live chip and budget, the worker keeps the TPU backend so
+    # downtime includes device-state restore + recompile (VERDICT r3
+    # next #3); the CPU tree is the fallback probe.
     goodput: dict = {}
-    if on_tpu and os.environ.get("DLROVER_TPU_BENCH_GOODPUT", "1") != "0":
+    if os.environ.get("DLROVER_TPU_BENCH_GOODPUT", "1") != "0" and on_tpu:
         try:
-            goodput = measure_goodput()
+            if _time_left() > 1000.0:
+                goodput = measure_goodput(backend="tpu")
+                goodput["goodput_backend"] = "tpu"
+            elif _time_left() > 400.0:
+                goodput = measure_goodput(backend="cpu")
+                goodput["goodput_backend"] = "cpu"
         except Exception as e:  # noqa: BLE001 - keep the MFU result
             print(f"bench: goodput probe failed: {e}", file=sys.stderr)
+        if goodput:
+            partial.append({"model": "goodput", **goodput})
+            _flush_partial(partial)
 
     print(
         json.dumps(

@@ -22,10 +22,26 @@ import numpy as np
 
 # Defaults tuned on v5e at [8,16,2048,64]: large blocks amortize MXU
 # pipeline fill (128x128 blocks ran at ~5% of peak; 512x512 at ~17%).
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
-DEFAULT_BWD_BLOCK_Q = 256
-DEFAULT_BWD_BLOCK_K = 512
+# Env overrides (read once at import) let a hardware tuning sweep try
+# block shapes per subprocess without touching call sites:
+# DLROVER_TPU_FLASH_BLOCK_{Q,K} / DLROVER_TPU_FLASH_BWD_BLOCK_{Q,K}.
+import os as _os
+
+
+def _env_block(name: str, default: int) -> int:
+    try:
+        v = int(_os.environ.get(name, default))
+    except ValueError:
+        return default
+    # 0/negative would crash deep inside _block_sizes with no mention
+    # of the env var; fall back instead.
+    return v if v > 0 else default
+
+
+DEFAULT_BLOCK_Q = _env_block("DLROVER_TPU_FLASH_BLOCK_Q", 512)
+DEFAULT_BLOCK_K = _env_block("DLROVER_TPU_FLASH_BLOCK_K", 512)
+DEFAULT_BWD_BLOCK_Q = _env_block("DLROVER_TPU_FLASH_BWD_BLOCK_Q", 256)
+DEFAULT_BWD_BLOCK_K = _env_block("DLROVER_TPU_FLASH_BWD_BLOCK_K", 512)
 NEG_INF = -1e30
 
 

@@ -129,21 +129,17 @@ _fp8_bdot = _build_fp8_dot(
 )
 
 
-def fp8_dot(
-    x: jax.Array, w: jax.Array, state: Fp8State
-) -> Tuple[jax.Array, Fp8State]:
-    """``x @ w`` with both operands in e4m3 and the backward in e5m2.
-
-    Returns (output, new_state).  The state update uses the CURRENT
-    tensors' amax (pushed into the history) while the scales applied come
-    from the PREVIOUS history — the delayed-scaling recipe, which keeps
-    the cast scale-free of a same-step data dependency.  The grad amax is
-    approximated by the forward output's amax (a standard proxy; the true
-    grad amax would need a round trip through the backward)."""
+def _delayed_scaling_dot(dot, x, w, state: Fp8State):
+    """The ONE delayed-scaling recipe both public entry points share:
+    scales applied come from the PREVIOUS amax history while the CURRENT
+    tensors' amax are pushed in — keeping the cast free of a same-step
+    data dependency.  The grad amax is approximated by the forward
+    output's amax (a standard proxy; the true grad amax would need a
+    round trip through the backward)."""
     x_scale = _scale_from_hist(state.x_hist, E4M3_MAX)
     w_scale = _scale_from_hist(state.w_hist, E4M3_MAX)
     g_scale = _scale_from_hist(state.g_hist, E5M2_MAX)
-    out = _fp8_dot(x, w, x_scale, w_scale, g_scale)
+    out = dot(x, w, x_scale, w_scale, g_scale)
     new_state = Fp8State(
         x_hist=_push(
             state.x_hist, jnp.max(jnp.abs(x)).astype(jnp.float32)
@@ -156,34 +152,27 @@ def fp8_dot(
         ),
     )
     return out, new_state
+
+
+def fp8_dot(
+    x: jax.Array, w: jax.Array, state: Fp8State
+) -> Tuple[jax.Array, Fp8State]:
+    """``x [M, K] @ w [K, N]`` with both operands in e4m3 and the
+    backward in e5m2 (delayed scaling).  Returns (output, new_state)."""
+    return _delayed_scaling_dot(_fp8_dot, x, w, state)
 
 
 def fp8_batched_dot(
     x: jax.Array, w: jax.Array, state: Fp8State
 ) -> Tuple[jax.Array, Fp8State]:
-    """Per-expert batched ``x[e] @ w[e]`` with e4m3 forward / e5m2
-    backward — the MoE grouped-matmul analogue of :func:`fp8_dot`.
+    """Per-expert batched ``x[e] @ w[e]`` — the MoE grouped-matmul
+    analogue of :func:`fp8_dot`.
 
     Scales are per-STACKED-tensor (one amax over all experts), the
     "shared" variant: a per-expert scale would need a gather per token
     block and buys little when experts share an init distribution.
     Shapes: x [E, C, D], w [E, D, F] -> [E, C, F]."""
-    x_scale = _scale_from_hist(state.x_hist, E4M3_MAX)
-    w_scale = _scale_from_hist(state.w_hist, E4M3_MAX)
-    g_scale = _scale_from_hist(state.g_hist, E5M2_MAX)
-    out = _fp8_bdot(x, w, x_scale, w_scale, g_scale)
-    new_state = Fp8State(
-        x_hist=_push(
-            state.x_hist, jnp.max(jnp.abs(x)).astype(jnp.float32)
-        ),
-        w_hist=_push(
-            state.w_hist, jnp.max(jnp.abs(w)).astype(jnp.float32)
-        ),
-        g_hist=_push(
-            state.g_hist, jnp.max(jnp.abs(out)).astype(jnp.float32)
-        ),
-    )
-    return out, new_state
+    return _delayed_scaling_dot(_fp8_bdot, x, w, state)
 
 
 def fp8_supported() -> bool:

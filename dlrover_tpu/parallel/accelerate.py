@@ -204,6 +204,12 @@ class AcceleratedJob:
     state_sharding: Any
     batch_sharding: Any
     cost: Optional[dict] = None
+    # Compiled-truth memory accounting from XLA's buffer assignment
+    # (``compiled.memory_analysis()``): peak/temp/argument/output bytes
+    # per device.  The ground truth the static HBM estimator
+    # (``strategy_search.estimate_step_hbm_bytes``) is calibrated
+    # against.
+    memory: Optional[dict] = None
     abstract_batch: Any = None  # ShapeDtypeStruct tree of the sample batch
     has_frozen: bool = False
 
@@ -497,7 +503,23 @@ def accelerate(
             dataclasses.replace(c, grad_accum=grad_accum)
             for c in candidates
         ]
-    qg_reasons = [quant_grads_incompat(c) for c in candidates]
+    # Judge quant_grads on the NORMALIZED mesh: wildcard (-1) axes and
+    # implicit dp must resolve to real sizes first, or dp=-1 over 8
+    # devices would be rejected as dp<=1.  A mesh that doesn't fit the
+    # device count at all is NOT a quant_grads problem — leave those to
+    # the candidate loop's own per-candidate rejection.
+    def _qg_reason(c):
+        if not c.quant_grads:
+            return None
+        try:
+            norm = c.mesh.normalized(len(devs))
+        except ValueError:
+            return None
+        return quant_grads_incompat(
+            dataclasses.replace(c, mesh=norm)
+        )
+
+    qg_reasons = [_qg_reason(c) for c in candidates]
     if qg_reasons and all(qg_reasons):
         # Every candidate is an incompatible quant_grads combination
         # (fp8, hybrid mesh, or dp<=1): fail fast with the real cause —
@@ -631,6 +653,37 @@ def accelerate(
             to_cache = dataclasses.replace(to_cache, grad_accum=1)
         cache_obj.put(fp, to_cache)
     return best
+
+
+def aot_analyze(
+    *,
+    loss_fn: Callable,
+    init_fn: Callable,
+    optimizer,
+    sample_batch: Any,
+    strategy: Strategy,
+    param_specs: Union[None, Any, Callable[[Strategy], Any]] = None,
+    batch_axes: Optional[Any] = None,
+    devices: Optional[Sequence] = None,
+    fp8_init: Optional[Callable] = None,
+    loss_fn_builder: Optional[Callable] = None,
+    frozen: Any = None,
+) -> AcceleratedJob:
+    """Compile ONE explicit strategy ahead-of-time and return its job
+    with XLA cost/memory analysis attached — no state is created and no
+    step is executed, so a model far bigger than host or device memory
+    can be analyzed (the reference analyser's static pass,
+    ``atorch/auto/analyser/analyser.py``).
+
+    ``job.memory["peak_bytes"]`` is the per-device peak from XLA's
+    buffer assignment: the ground truth ``estimate_step_hbm_bytes`` is
+    calibrated against (``tools/calibrate_hbm.py``)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    lf = loss_fn_builder(strategy) if loss_fn_builder else loss_fn
+    return _compile_candidate(
+        strategy, lf, init_fn, optimizer, sample_batch,
+        param_specs, batch_axes, devs, fp8_init=fp8_init, frozen=frozen,
+    )
 
 
 def _compile_candidate(
@@ -864,6 +917,19 @@ def _compile_candidate(
             cost = cost[0] if cost else {}
     except Exception:  # noqa: BLE001
         cost = {}
+    try:
+        ma = compiled.memory_analysis()
+        if isinstance(ma, list):
+            ma = ma[0] if ma else None
+        memory = None if ma is None else {
+            "peak_bytes": int(ma.peak_memory_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+        }
+    except Exception:  # noqa: BLE001
+        memory = None
 
     return AcceleratedJob(
         mesh=mesh,
@@ -873,6 +939,7 @@ def _compile_candidate(
         state_sharding=state_sharding,
         batch_sharding=batch_sharding,
         cost=cost,
+        memory=memory,
         abstract_batch=abstract_batch,
         has_frozen=frozen is not None,
     )

@@ -404,6 +404,62 @@ class TestWidenedSpace:
             [lean, fat], params_shape, batch, 1.0
         ) == [lean, fat]
 
+    def test_estimate_tracks_compiled_truth(self, cpu_mesh_devices):
+        """The static HBM estimator must stay within a small factor of
+        XLA's buffer-assignment peak (``compiled.memory_analysis()``) or
+        BO pruning rejects viable candidates / admits OOM ones.  Full
+        calibration matrix: ``tools/calibrate_hbm.py`` (14 llama
+        300m/800m points, artifact CALIBRATE_HBM.json); this is the fast
+        subset (VERDICT r3 next #8)."""
+        import dataclasses
+
+        import jax
+        import optax
+
+        from dlrover_tpu.models import llama
+        from dlrover_tpu.parallel.accelerate import Strategy, aot_analyze
+        from dlrover_tpu.parallel.mesh import MeshSpec
+        from dlrover_tpu.parallel.strategy_search import (
+            estimate_step_hbm_bytes,
+        )
+
+        cfg = llama.LlamaConfig(
+            vocab_size=8192, n_layer=4, n_head=4, n_kv_head=4,
+            d_model=256, d_ff=704, max_seq_len=512,
+        )
+        pts = [
+            (cfg, Strategy(mesh=MeshSpec(dp=8))),
+            (dataclasses.replace(cfg, remat_block=True),
+             Strategy(mesh=MeshSpec(fsdp=8))),
+            # tp point: guards the "tp does not reduce peak" law.
+            (cfg, Strategy(mesh=MeshSpec(dp=2, fsdp=2, tp=2))),
+        ]
+        sample = {"tokens": np.zeros((8, 257), np.int32)}
+        for c, s in pts:
+            job = aot_analyze(
+                loss_fn=(lambda cc: lambda p, b: llama.loss_fn(
+                    p, b, cc))(c),
+                init_fn=(lambda cc: lambda r: llama.init_params(
+                    r, cc))(c),
+                optimizer=optax.adamw(3e-4),
+                sample_batch=sample,
+                strategy=s,
+                devices=cpu_mesh_devices[:8],
+            )
+            assert job.memory is not None
+            ps = jax.eval_shape(
+                (lambda cc: lambda r: llama.init_params(r, cc))(c),
+                jax.random.PRNGKey(0),
+            )
+            est_s = job.strategy
+            if c.remat_block:
+                est_s = dataclasses.replace(est_s, remat="block")
+            pred = estimate_step_hbm_bytes(ps, sample, est_s)
+            ratio = pred / job.memory["peak_bytes"]
+            assert 0.6 <= ratio <= 1.5, (
+                s.describe(), pred, job.memory["peak_bytes"], ratio,
+            )
+
     def test_loss_fn_builder_rewrites_model_per_candidate(
         self, cpu_mesh_devices
     ):

@@ -1,0 +1,105 @@
+"""Tune the Pallas flash-attention block sizes on live hardware.
+
+Runs the winning bench candidate once per block-shape point, each in a
+killable subprocess (``bench._run_one_subproc``) with the
+``DLROVER_TPU_FLASH_*`` env overrides set, and reports step times.  The
+winner goes into ``ops/flash_attention.py``'s defaults (VERDICT r3 next
+#1: "tune DEFAULT_BWD_BLOCK_* on the winner").
+
+Run on the chip:  python tools/tune_flash_blocks.py [--model 300m_h128]
+Writes FLASH_TUNE.json next to bench.py as points complete.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def candidate_spec(model: str) -> dict:
+    from dlrover_tpu.models import llama
+
+    if model == "300m_h128":
+        cfg = dataclasses.replace(
+            llama.LlamaConfig.small_300m(), n_head=8, n_kv_head=8
+        )
+        batch = 8
+    elif model == "800m_h128":
+        cfg = dataclasses.replace(
+            llama.LlamaConfig.medium_800m(), n_head=12, n_kv_head=12,
+        )
+        batch = 8
+    else:
+        raise SystemExit(f"unknown --model {model}")
+    return {
+        "model": f"llama_{model}", "batch": batch, "seq": 2048,
+        "remat": "none" if model == "300m_h128" else "block",
+        "iters": 3, "opt": "adamw", "fp8": False,
+        "cfg": {
+            k: v for k, v in cfg.__dict__.items()
+            if isinstance(v, (int, float, str, bool))
+        },
+    }
+
+
+# (fwd_q, fwd_k, bwd_q, bwd_k) — first point is the current default.
+GRID = [
+    (512, 512, 256, 512),
+    (512, 512, 512, 512),
+    (512, 512, 256, 256),
+    (512, 512, 128, 512),
+    (512, 512, 512, 256),
+    (1024, 512, 256, 512),
+    (256, 512, 256, 512),
+    (512, 256, 256, 512),
+    (1024, 1024, 512, 512),
+]
+
+
+def main() -> int:
+    import bench
+
+    model = "300m_h128"
+    if "--model" in sys.argv:
+        model = sys.argv[sys.argv.index("--model") + 1]
+    spec = candidate_spec(model)
+    out_path = os.path.join(REPO, "FLASH_TUNE.json")
+    results = []
+    for fq, fk, bq, bk in GRID:
+        os.environ["DLROVER_TPU_FLASH_BLOCK_Q"] = str(fq)
+        os.environ["DLROVER_TPU_FLASH_BLOCK_K"] = str(fk)
+        os.environ["DLROVER_TPU_FLASH_BWD_BLOCK_Q"] = str(bq)
+        os.environ["DLROVER_TPU_FLASH_BWD_BLOCK_K"] = str(bk)
+        label = f"fwd{fq}x{fk}_bwd{bq}x{bk}"
+        try:
+            res = bench._run_one_subproc(spec, label, 900.0)
+            entry = {
+                "blocks": [fq, fk, bq, bk],
+                "step_time_s": round(res["dt"], 4),
+            }
+        except Exception as e:  # noqa: BLE001
+            entry = {
+                "blocks": [fq, fk, bq, bk],
+                "error": f"{type(e).__name__}: {str(e)[:160]}",
+            }
+        print(f"{label}: {entry}", file=sys.stderr)
+        results.append(entry)
+        with open(out_path, "w") as f:
+            json.dump({"model": model, "points": results}, f, indent=1)
+    ok = [r for r in results if "step_time_s" in r]
+    if ok:
+        best = min(ok, key=lambda r: r["step_time_s"])
+        print(json.dumps({"best": best, "model": model}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
