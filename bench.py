@@ -667,11 +667,18 @@ def main() -> int:
     goodput: dict = {}
     if os.environ.get("DLROVER_TPU_BENCH_GOODPUT", "1") != "0" and on_tpu:
         try:
+            # The probe's own timeout must fit the remaining budget or
+            # it runs past the very deadline that gates it.
+            gp_budget = min(900.0, _time_left() - 100.0)
             if _time_left() > 1000.0:
-                goodput = measure_goodput(backend="tpu")
+                goodput = measure_goodput(
+                    backend="tpu", timeout_s=gp_budget
+                )
                 goodput["goodput_backend"] = "tpu"
             elif _time_left() > 400.0:
-                goodput = measure_goodput(backend="cpu")
+                goodput = measure_goodput(
+                    backend="cpu", timeout_s=gp_budget
+                )
                 goodput["goodput_backend"] = "cpu"
         except Exception as e:  # noqa: BLE001 - keep the MFU result
             print(f"bench: goodput probe failed: {e}", file=sys.stderr)

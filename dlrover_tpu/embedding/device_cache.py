@@ -29,6 +29,7 @@ identical whether it trains device-side or host-side — asserted by
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from typing import Dict, Optional, Tuple
 
@@ -75,6 +76,22 @@ def sparse_adagrad_apply(
     return adagrad_update(table, accum, g, lr=lr, eps=eps)
 
 
+@dataclasses.dataclass
+class CachePlan:
+    """A planned admission (see ``DeviceEmbeddingCache.plan_batch``):
+    the batch's unique/inverse decomposition plus the store rows its
+    misses need, pulled ahead of time."""
+
+    shape: tuple
+    uniq: np.ndarray
+    inv: np.ndarray
+    miss_ids: np.ndarray
+    emb: Optional[np.ndarray]
+    s0: Optional[np.ndarray]
+    s1: Optional[np.ndarray]
+    meta: Optional[np.ndarray]
+
+
 class DeviceEmbeddingCache:
     """LRU cache of store rows in device memory, trained in-step.
 
@@ -87,6 +104,11 @@ class DeviceEmbeddingCache:
             sparse_adagrad_apply(table, accum, slots, grads, lr=...)
         cache.update(new_table, new_accum)     # adopt step outputs
         cache.maybe_flush()                    # async write-back cadence
+
+    To hide the host half (store I/O + id mapping) behind device
+    compute, split ``map_batch`` into ``plan_batch`` (worker thread,
+    overlaps the step) + ``apply_plan`` (cheap commit) — see
+    :meth:`plan_batch` for the loop shape.
     """
 
     def __init__(
@@ -125,7 +147,34 @@ class DeviceEmbeddingCache:
     def map_batch(self, keys: np.ndarray) -> np.ndarray:
         """ids [..] -> device slots [..] (int32); pulls misses from the
         store (full rows: emb + accumulator) and scatters them into the
-        device table.  Evicted rows flush back first."""
+        device table.  Evicted rows flush back first.
+
+        Equivalent to ``apply_plan(plan_batch(keys))`` — split those two
+        to overlap the expensive host half (store I/O) with the device
+        step; see :meth:`plan_batch`."""
+        return self.apply_plan(self.plan_batch(keys))
+
+    def plan_batch(self, keys: np.ndarray) -> "CachePlan":
+        """The PURE-HOST half of admission: unique the batch, detect
+        misses against the current mapping, and pull their full rows
+        from the store — no cache state is mutated, so this can run on
+        a worker thread WHILE the device executes the previous step
+        (admission double-buffering; the PCIe/store latency the r3
+        review flagged as unoverlapped).  Commit with
+        :meth:`apply_plan` AFTER adopting that step's outputs::
+
+            plan = cache.plan_batch(first_keys)
+            for keys, nxt in batches:
+                slots = cache.apply_plan(plan)       # cheap scatter
+                fut = pool.submit(cache.plan_batch, nxt)  # overlaps...
+                step(...)                            # ...device compute
+                cache.update(...)
+                plan = fut.result()
+
+        One plan in flight at a time: a plan's miss set is computed
+        against the mapping as of planning; apply_plan re-checks it
+        (ids admitted meanwhile are skipped), but two CONCURRENT plans
+        would pull the same rows twice."""
         keys = np.asarray(keys, np.int64)
         uniq, inv = np.unique(keys.reshape(-1), return_inverse=True)
         if len(uniq) > self.capacity:
@@ -133,23 +182,57 @@ class DeviceEmbeddingCache:
                 f"batch touches {len(uniq)} unique ids > cache capacity "
                 f"{self.capacity}"
             )
+        misses = np.asarray(
+            [int(k) for k in uniq if int(k) not in self._slot_of],
+            np.int64,
+        )
+        if len(misses):
+            emb = self.store.lookup(misses, train=True)  # creates new
+            emb, s0, s1, meta = self._unpack(
+                self.store.export_keys(misses), misses, emb
+            )
+        else:
+            emb = s0 = s1 = meta = None
+        return CachePlan(
+            shape=keys.shape, uniq=uniq, inv=inv, miss_ids=misses,
+            emb=emb, s0=s0, s1=s1, meta=meta,
+        )
+
+    def apply_plan(self, plan: "CachePlan") -> np.ndarray:
+        """Commit a :meth:`plan_batch` result: evict + scatter the
+        planned miss rows into the device table (reading the CURRENT
+        post-step table for eviction flushes) and return the batch's
+        slot array.  Cheap — one small device scatter; all store I/O
+        already happened at plan time."""
         self._tick += 1
-        misses = [int(k) for k in uniq if int(k) not in self._slot_of]
-        if misses:
-            self._admit(np.asarray(misses, np.int64), pinned=uniq)
+        # Ids admitted since planning (defensive; the documented
+        # protocol keeps one plan in flight) keep their TRAINED rows —
+        # re-scattering the planned (stale) pull would clobber them.
+        if len(plan.miss_ids):
+            still = np.asarray([
+                i for i, k in enumerate(plan.miss_ids)
+                if int(k) not in self._slot_of
+            ], np.int64)
+            if len(still):
+                self._admit_planned(
+                    plan.miss_ids[still],
+                    plan.emb[still], plan.s0[still], plan.s1[still],
+                    plan.meta[still], pinned=plan.uniq,
+                )
         slot_map = self._slot_of
         # One python lookup per UNIQUE id; occurrences expand through the
         # vectorized inverse (the per-occurrence loop would dominate the
         # host side at production batch sizes).
         uniq_slots = np.fromiter(
-            (slot_map[int(k)] for k in uniq), np.int32, count=len(uniq)
+            (slot_map[int(k)] for k in plan.uniq), np.int32,
+            count=len(plan.uniq),
         )
         self._stamp[uniq_slots] = self._tick
         self._hits[uniq_slots] += 1  # feeds freq on write-back
-        return uniq_slots[inv].reshape(keys.shape)
+        return uniq_slots[plan.inv].reshape(plan.shape)
 
-    def _admit(self, miss_ids: np.ndarray,
-               pinned: Optional[np.ndarray] = None) -> None:
+    def _admit_planned(self, miss_ids, rows, s0, s1, meta,
+                       pinned: Optional[np.ndarray] = None) -> None:
         n = len(miss_ids)
         free = np.flatnonzero(self._id_of < 0)
         if len(free) < n:
@@ -177,12 +260,8 @@ class DeviceEmbeddingCache:
             free = np.flatnonzero(self._id_of < 0)
         slots = free[:n]
 
-        # Materialize (or create) the rows host-side, then read the FULL
-        # row (emb + adagrad slot0 + freq/version) via the binary export.
-        emb = self.store.lookup(miss_ids, train=True)  # creates if new
-        rows, s0, s1, meta = self._unpack(
-            self.store.export_keys(miss_ids), miss_ids, emb
-        )
+        # Rows were pulled at plan time (store lookup + binary export);
+        # here is just the one small device scatter + mapping commit.
         self.table = self.table.at[jnp.asarray(slots)].set(
             jnp.asarray(rows)
         )

@@ -185,12 +185,29 @@ def _linear_xent_bwd(chunk_rows, res, g):
 _linear_xent.defvjp(_linear_xent_fwd, _linear_xent_bwd)
 
 
+def _default_chunk_rows() -> int:
+    """1024 balances scan count vs the [chunk, V] fp32 logits block
+    (128 MB at V=32k).  ``DLROVER_TPU_CE_CHUNK_ROWS`` overrides for
+    hardware tuning sweeps (larger chunks = fewer scan trips = better
+    MXU utilization, at more HBM)."""
+    import os
+
+    try:
+        v = int(os.environ.get("DLROVER_TPU_CE_CHUNK_ROWS", "1024"))
+    except ValueError:
+        return 1024
+    return v if v > 0 else 1024
+
+
+_DEFAULT_CHUNK_ROWS = _default_chunk_rows()
+
+
 def linear_softmax_cross_entropy(
     x: jax.Array,
     w: jax.Array,
     labels: jax.Array,
     *,
-    chunk_rows: int = 1024,
+    chunk_rows: int = _DEFAULT_CHUNK_ROWS,
 ) -> jax.Array:
     """Fused ``softmax_cross_entropy(x @ w, labels)`` per-token loss.
 

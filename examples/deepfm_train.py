@@ -151,16 +151,33 @@ def run_device_cached(args, cfg, params, opt_state, tx) -> int:
     step = deepfm.make_cached_train_step(cfg, tx, emb_lr=0.1)
 
     rng = np.random.default_rng(0)
-    loss = None
-    for i in range(1, args.steps + 1):
+
+    def make_batch():
         keys = rng.integers(
             0, args.vocab, size=(args.batch_size, cfg.num_fields)
         )
         labels = (
             (keys[:, 0] % 3 == 0) ^ (keys[:, 1] % 2 == 0)
         ).astype(np.float32)
-        slots = cache.map_batch(keys)
-        slots1 = cache1.map_batch(keys)
+        return keys, labels
+
+    # Admission double-buffering: the NEXT batch's store pulls + id
+    # mapping (the host half) run on a worker thread while the device
+    # executes the CURRENT step; apply_plan after update() is one cheap
+    # scatter.  One plan in flight per cache (plan_batch contract).
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=2)
+    loss = None
+    keys, labels = make_batch()
+    plan, plan1 = cache.plan_batch(keys), cache1.plan_batch(keys)
+    for i in range(1, args.steps + 1):
+        slots = cache.apply_plan(plan)
+        slots1 = cache1.apply_plan(plan1)
+        if i < args.steps:
+            nxt_keys, nxt_labels = make_batch()
+            fut = pool.submit(cache.plan_batch, nxt_keys)
+            fut1 = pool.submit(cache1.plan_batch, nxt_keys)
         (params, opt_state, table, accum, table1, accum1, loss) = step(
             params, opt_state, cache.table, cache.accum, slots,
             cache1.table, cache1.accum, slots1, labels,
@@ -171,6 +188,10 @@ def run_device_cached(args, cfg, params, opt_state, tx) -> int:
         cache1.maybe_flush()
         if i % 20 == 0:
             print(f"step {i} loss {float(loss):.4f}", flush=True)
+        if i < args.steps:
+            plan, plan1 = fut.result(), fut1.result()
+            keys, labels = nxt_keys, nxt_labels
+    pool.shutdown()
 
     cache.flush()
     cache1.flush()

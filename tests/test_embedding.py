@@ -594,6 +594,98 @@ class TestDeviceCache:
             rtol=1e-5, atol=1e-6,
         )
 
+    def test_overlapped_plan_apply_matches_map_batch(self):
+        """plan_batch on a worker thread (admission double-buffering —
+        the r3 review's unoverlapped-host-round-trip finding) must
+        produce the EXACT trajectory of the synchronous map_batch path,
+        including through evictions."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.embedding.device_cache import (
+            DeviceEmbeddingCache,
+            sparse_adagrad_apply,
+        )
+
+        dim, lr, cap = 4, 0.1, 8  # cap 8 over 20 ids: evictions happen
+        rng = np.random.default_rng(0)
+        keys_seq = [rng.integers(0, 20, size=(6,)) for _ in range(12)]
+        grads_seq = [
+            rng.normal(size=(6, dim)).astype(np.float32)
+            for _ in range(12)
+        ]
+        apply_j = jax.jit(
+            lambda t, a, s, g: sparse_adagrad_apply(t, a, s, g, lr=lr)
+        )
+
+        def run(overlapped: bool):
+            store = EmbeddingStore(dim, seed=7)
+            cache = DeviceEmbeddingCache(store, cap, flush_every=0)
+            if not overlapped:
+                for keys, grads in zip(keys_seq, grads_seq):
+                    slots = cache.map_batch(keys)
+                    t, a = apply_j(
+                        cache.table, cache.accum, jnp.asarray(slots),
+                        jnp.asarray(grads),
+                    )
+                    cache.update(t, a)
+            else:
+                pool = ThreadPoolExecutor(max_workers=1)
+                plan = cache.plan_batch(keys_seq[0])
+                for i, (keys, grads) in enumerate(
+                    zip(keys_seq, grads_seq)
+                ):
+                    slots = cache.apply_plan(plan)
+                    fut = (
+                        pool.submit(cache.plan_batch, keys_seq[i + 1])
+                        if i + 1 < len(keys_seq) else None
+                    )
+                    t, a = apply_j(
+                        cache.table, cache.accum, jnp.asarray(slots),
+                        jnp.asarray(grads),
+                    )
+                    cache.update(t, a)
+                    if fut is not None:
+                        plan = fut.result()
+                pool.shutdown()
+            cache.flush()
+            ids = np.unique(np.concatenate(keys_seq))
+            return store.lookup(ids, train=False)
+
+        np.testing.assert_array_equal(run(False), run(True))
+
+    def test_apply_plan_skips_already_admitted_ids(self):
+        """A stale plan (id admitted+trained since planning) must NOT
+        clobber the trained row with its planned (older) pull."""
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.embedding.device_cache import (
+            DeviceEmbeddingCache,
+            sparse_adagrad_apply,
+        )
+
+        dim, lr = 4, 0.1
+        store = EmbeddingStore(dim, seed=3)
+        cache = DeviceEmbeddingCache(store, 8, flush_every=0)
+        keys = np.array([5, 6])
+        stale = cache.plan_batch(keys)  # pulls init rows for 5, 6
+        # Admit + train 5/6 through the normal path.
+        slots = cache.map_batch(keys)
+        t, a = jax.jit(
+            lambda t, a, s, g: sparse_adagrad_apply(t, a, s, g, lr=lr)
+        )(cache.table, cache.accum, jnp.asarray(slots),
+          jnp.ones((2, dim), np.float32))
+        cache.update(t, a)
+        trained = np.asarray(cache.table)[np.asarray(slots)]
+        # Applying the stale plan keeps the trained values.
+        slots2 = cache.apply_plan(stale)
+        np.testing.assert_array_equal(
+            np.asarray(cache.table)[np.asarray(slots2)], trained
+        )
+
     def test_eviction_round_trips_through_store(self):
         """Rows evicted by the LRU and re-admitted keep their trained
         values AND their adagrad accumulator."""

@@ -98,6 +98,36 @@ def decode_stage_argv() -> list:
     return [sys.executable, "-c", code]
 
 
+def repro_800m_argv() -> list:
+    # r4 sweep: llama_800m_h128 b8 block died with a swallowed
+    # "no viable strategy found" while the plain 800m (identical sizes,
+    # hd=96) passed.  Reproduce IN-PROCESS with stderr visible so
+    # accelerate's per-candidate rejection log reaches LIVE_SESSION.log.
+    # The EXPECTED outcome is a reproduced failure: the artifact must
+    # be written either way (error + traceback on failure) or the
+    # watcher would retry the 30-minute repro forever and never reach
+    # the later stages.
+    code = (
+        "import dataclasses, json, sys, traceback; "
+        "sys.path.insert(0, %r); "
+        "import bench; from dlrover_tpu.models import llama; "
+        "cfg = dataclasses.replace(llama.LlamaConfig.medium_800m(), "
+        "n_head=12, n_kv_head=12)\n"
+        "try:\n"
+        "    dt, loss = bench._measure_candidate("
+        "cfg, 8, 2048, 'block', 3, 'adamw', False)\n"
+        "    out = {'dt': dt, 'loss': loss, 'mfu_pct': round(100.0 * "
+        "bench.model_flops_per_step(cfg, 8, 2048) / dt / "
+        "bench.detect_peak(), 2)}\n"
+        "except Exception as e:\n"
+        "    out = {'error': '%%s: %%s' %% (type(e).__name__, e), "
+        "'traceback': traceback.format_exc()[-4000:]}\n"
+        "open(%r, 'w').write(json.dumps(out, indent=1)); print(out)"
+        % (REPO, os.path.join(REPO, "REPRO_800M_H128.json"))
+    )
+    return [sys.executable, "-c", code]
+
+
 STAGES = [
     # (name, artifact-to-skip-if-present, argv builder, timeout_s)
     ("kernel_smoke", "KERNEL_SMOKE.json",
@@ -107,6 +137,8 @@ STAGES = [
      lambda: [sys.executable,
               os.path.join(REPO, "tools", "tune_flash_blocks.py")],
      7200.0),
+    ("repro_800m_h128", "REPRO_800M_H128.json", repro_800m_argv,
+     1800.0),
     ("op_metrics", "OP_METRICS_TPU.json",
      lambda: [sys.executable,
               os.path.join(REPO, "tools", "validate_op_metrics.py")],

@@ -50,17 +50,23 @@ def candidate_spec(model: str) -> dict:
     }
 
 
-# (fwd_q, fwd_k, bwd_q, bwd_k) — first point is the current default.
+# (fwd_q, fwd_k, bwd_q, bwd_k, ce_chunk_rows) — first point is the
+# current default.  The last entries hold flash blocks at default and
+# sweep the fused lm-head CE chunking instead (the other hot kernel:
+# ~20% of 300m FLOPs live in the lm-head GEMM inside a lax.scan).
 GRID = [
-    (512, 512, 256, 512),
-    (512, 512, 512, 512),
-    (512, 512, 256, 256),
-    (512, 512, 128, 512),
-    (512, 512, 512, 256),
-    (1024, 512, 256, 512),
-    (256, 512, 256, 512),
-    (512, 256, 256, 512),
-    (1024, 1024, 512, 512),
+    (512, 512, 256, 512, 1024),
+    (512, 512, 512, 512, 1024),
+    (512, 512, 256, 256, 1024),
+    (512, 512, 128, 512, 1024),
+    (512, 512, 512, 256, 1024),
+    (1024, 512, 256, 512, 1024),
+    (256, 512, 256, 512, 1024),
+    (512, 256, 256, 512, 1024),
+    (1024, 1024, 512, 512, 1024),
+    (512, 512, 256, 512, 2048),
+    (512, 512, 256, 512, 4096),
+    (512, 512, 256, 512, 512),
 ]
 
 
@@ -73,21 +79,22 @@ def main() -> int:
     spec = candidate_spec(model)
     out_path = os.path.join(REPO, "FLASH_TUNE.json")
     results = []
-    for fq, fk, bq, bk in GRID:
+    for fq, fk, bq, bk, ce in GRID:
         os.environ["DLROVER_TPU_FLASH_BLOCK_Q"] = str(fq)
         os.environ["DLROVER_TPU_FLASH_BLOCK_K"] = str(fk)
         os.environ["DLROVER_TPU_FLASH_BWD_BLOCK_Q"] = str(bq)
         os.environ["DLROVER_TPU_FLASH_BWD_BLOCK_K"] = str(bk)
-        label = f"fwd{fq}x{fk}_bwd{bq}x{bk}"
+        os.environ["DLROVER_TPU_CE_CHUNK_ROWS"] = str(ce)
+        label = f"fwd{fq}x{fk}_bwd{bq}x{bk}_ce{ce}"
         try:
             res = bench._run_one_subproc(spec, label, 900.0)
             entry = {
-                "blocks": [fq, fk, bq, bk],
+                "blocks": [fq, fk, bq, bk], "ce_chunk_rows": ce,
                 "step_time_s": round(res["dt"], 4),
             }
         except Exception as e:  # noqa: BLE001
             entry = {
-                "blocks": [fq, fk, bq, bk],
+                "blocks": [fq, fk, bq, bk], "ce_chunk_rows": ce,
                 "error": f"{type(e).__name__}: {str(e)[:160]}",
             }
         print(f"{label}: {entry}", file=sys.stderr)
