@@ -469,16 +469,58 @@ def _partial_path() -> str:
     )
 
 
-def _flush_partial(entries: list) -> None:
+_TPU_RUN_ID: Optional[str] = None
+
+
+def _flush_partial(entries: list, tpu: bool = False) -> None:
     """Write per-candidate results to disk AS THEY COMPLETE.
 
     A tunnel that answers for 20 minutes then wedges must still leave
     verified per-candidate numbers on disk (round-3 review Weak #1) —
     the final JSON line alone only exists if the whole sweep survives.
+
+    TPU-measured entries additionally go to ``BENCH_TPU_VERIFIED.json``
+    (append-per-run, last 5 runs kept): the round-4 live session's
+    hardware numbers were lost when a later CPU-fallback run truncated
+    the single partial file — hardware evidence must never be clobbered
+    by a run that didn't reach hardware.
     """
+    import os
+
     try:
         with open(_partial_path(), "w") as f:
             json.dump({"candidates": entries}, f, indent=1)
+    except OSError:
+        pass
+    if not tpu or not entries:
+        return
+    global _TPU_RUN_ID
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_TPU_VERIFIED.json",
+    )
+    try:
+        with open(path) as f:
+            hist = json.load(f)
+        assert isinstance(hist.get("runs"), list)
+    except (OSError, ValueError, AssertionError):
+        hist = {"runs": []}
+    if _TPU_RUN_ID is None:
+        _TPU_RUN_ID = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
+    for run in hist["runs"]:
+        if run.get("started") == _TPU_RUN_ID:
+            run["candidates"] = entries
+            break
+    else:
+        hist["runs"].append(
+            {"started": _TPU_RUN_ID, "candidates": entries}
+        )
+    hist["runs"] = hist["runs"][-5:]
+    try:
+        with open(path, "w") as f:
+            json.dump(hist, f, indent=1)
     except OSError:
         pass
 
@@ -562,7 +604,7 @@ def main() -> int:
         if on_tpu and _time_left() < 300.0:
             entry["error"] = "skipped: bench deadline reached"
             partial.append(entry)
-            _flush_partial(partial)
+            _flush_partial(partial, tpu=on_tpu)
             continue
         try:
             if on_tpu:
@@ -583,7 +625,7 @@ def main() -> int:
             )
             entry["error"] = f"{type(e).__name__}: {str(e)[:200]}"
             partial.append(entry)
-            _flush_partial(partial)
+            _flush_partial(partial, tpu=on_tpu)
             continue
         flops = model_flops_per_step(cfg, batch, seq)
         rate = flops / dt
@@ -599,7 +641,7 @@ def main() -> int:
             "final_loss": round(loss, 4),
         })
         partial.append(entry)
-        _flush_partial(partial)
+        _flush_partial(partial, tpu=on_tpu)
         if best is None or rate > best[0]:
             best = (rate, name, cfg, batch, remat, opt, dt, loss, fp8)
     if best is None:
@@ -655,7 +697,7 @@ def main() -> int:
             decode = {"decode_tokens_per_sec": round(tps, 1)}
         if decode:
             partial.append({"model": "decode", **decode})
-            _flush_partial(partial)
+            _flush_partial(partial, tpu=on_tpu)
     except Exception as e:  # noqa: BLE001 - keep the MFU result
         print(f"bench: decode probe failed: {e}", file=sys.stderr)
 
@@ -684,7 +726,7 @@ def main() -> int:
             print(f"bench: goodput probe failed: {e}", file=sys.stderr)
         if goodput:
             partial.append({"model": "goodput", **goodput})
-            _flush_partial(partial)
+            _flush_partial(partial, tpu=on_tpu)
 
     print(
         json.dumps(

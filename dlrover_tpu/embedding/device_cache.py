@@ -219,6 +219,22 @@ class DeviceEmbeddingCache:
                     plan.emb[still], plan.s0[still], plan.s1[still],
                     plan.meta[still], pinned=plan.uniq,
                 )
+        # Mirror case: ids that were HITS at plan time but were evicted
+        # by an intervening admission (map_batch outside the documented
+        # one-plan protocol).  Their eviction flushed the trained rows
+        # to the store, so a fresh pull is value-correct — pay the store
+        # I/O here rather than KeyError on the mapping below.
+        evicted = np.asarray([
+            int(k) for k in plan.uniq if int(k) not in self._slot_of
+        ], np.int64)
+        if len(evicted):
+            emb = self.store.lookup(evicted, train=True)
+            emb, s0, s1, meta = self._unpack(
+                self.store.export_keys(evicted), evicted, emb
+            )
+            self._admit_planned(
+                evicted, emb, s0, s1, meta, pinned=plan.uniq
+            )
         slot_map = self._slot_of
         # One python lookup per UNIQUE id; occurrences expand through the
         # vectorized inverse (the per-occurrence loop would dominate the

@@ -77,7 +77,12 @@ def _run_flash_case(case: Dict) -> Dict:
     import jax
     import jax.numpy as jnp
 
-    from dlrover_tpu.ops import flash_attention as fa
+    # The package re-exports the flash_attention FUNCTION, shadowing the
+    # submodule for any ``import ... as`` form — import through
+    # importlib to get the module itself.
+    import importlib
+
+    fa = importlib.import_module("dlrover_tpu.ops.flash_attention")
 
     B, H, KV, S, D = case["shape"]
     kw = dict(case["kw"])

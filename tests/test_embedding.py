@@ -686,6 +686,42 @@ class TestDeviceCache:
             np.asarray(cache.table)[np.asarray(slots2)], trained
         )
 
+    def test_apply_plan_readmits_hits_evicted_since_planning(self):
+        """The mirror stale-plan case: an id that was a cache HIT at
+        plan time (so the plan pulled no row for it) but was EVICTED by
+        an intervening admission must be re-pulled at apply time — with
+        its trained value (the eviction flushed it to the store) — not
+        KeyError on the slot mapping."""
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.embedding.device_cache import (
+            DeviceEmbeddingCache,
+            sparse_adagrad_apply,
+        )
+
+        dim, lr = 4, 0.1
+        store = EmbeddingStore(dim, seed=3)
+        cache = DeviceEmbeddingCache(store, 4, flush_every=0)
+        # Admit + train id 1 so its row differs from the store init.
+        slots = cache.map_batch(np.array([1, 2, 3, 4]))
+        t, a = jax.jit(
+            lambda t, a, s, g: sparse_adagrad_apply(t, a, s, g, lr=lr)
+        )(cache.table, cache.accum, jnp.asarray(slots),
+          np.ones((4, dim), np.float32))
+        cache.update(t, a)
+        trained_1 = np.asarray(cache.table)[int(slots[0])].copy()
+        # Plan a batch where 1 is a hit (not in the plan's miss set)...
+        plan = cache.plan_batch(np.array([1, 5]))
+        assert 1 not in set(int(k) for k in plan.miss_ids)
+        # ...then evict 1 via a full-capacity admission.
+        cache.map_batch(np.array([6, 7, 8, 9]))
+        assert 1 not in cache._slot_of
+        # Applying the stale plan re-admits 1 with its trained value.
+        slots2 = cache.apply_plan(plan)
+        got = np.asarray(cache.table)[np.asarray(slots2)]
+        np.testing.assert_allclose(got[0], trained_1, rtol=1e-6)
+
     def test_eviction_round_trips_through_store(self):
         """Rows evicted by the LRU and re-admitted keep their trained
         values AND their adagrad accumulator."""
