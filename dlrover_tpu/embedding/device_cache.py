@@ -219,15 +219,23 @@ class DeviceEmbeddingCache:
                     plan.emb[still], plan.s0[still], plan.s1[still],
                     plan.meta[still], pinned=plan.uniq,
                 )
-        # Mirror case: ids that were HITS at plan time but were evicted
-        # by an intervening admission (map_batch outside the documented
-        # one-plan protocol).  Their eviction flushed the trained rows
-        # to the store, so a fresh pull is value-correct — pay the store
-        # I/O here rather than KeyError on the mapping below.
-        evicted = np.asarray([
-            int(k) for k in plan.uniq if int(k) not in self._slot_of
-        ], np.int64)
-        if len(evicted):
+        slot_map = self._slot_of
+        # One python lookup per UNIQUE id; occurrences expand through the
+        # vectorized inverse (the per-occurrence loop would dominate the
+        # host side at production batch sizes).  ``.get``: an id that was
+        # a HIT at plan time may have been EVICTED by an intervening
+        # admission (map_batch outside the documented one-plan protocol)
+        # — those resolve to -1 here and are re-admitted below; the
+        # steady-state protocol pays this single pass only.
+        uniq_slots = np.fromiter(
+            (slot_map.get(int(k), -1) for k in plan.uniq), np.int32,
+            count=len(plan.uniq),
+        )
+        if (uniq_slots < 0).any():
+            # Eviction flushed the trained rows to the store, so a fresh
+            # pull is value-correct — pay the store I/O here rather than
+            # KeyError on the mapping.
+            evicted = plan.uniq[uniq_slots < 0].astype(np.int64)
             emb = self.store.lookup(evicted, train=True)
             emb, s0, s1, meta = self._unpack(
                 self.store.export_keys(evicted), evicted, emb
@@ -235,14 +243,10 @@ class DeviceEmbeddingCache:
             self._admit_planned(
                 evicted, emb, s0, s1, meta, pinned=plan.uniq
             )
-        slot_map = self._slot_of
-        # One python lookup per UNIQUE id; occurrences expand through the
-        # vectorized inverse (the per-occurrence loop would dominate the
-        # host side at production batch sizes).
-        uniq_slots = np.fromiter(
-            (slot_map[int(k)] for k in plan.uniq), np.int32,
-            count=len(plan.uniq),
-        )
+            uniq_slots = np.fromiter(
+                (slot_map[int(k)] for k in plan.uniq), np.int32,
+                count=len(plan.uniq),
+            )
         self._stamp[uniq_slots] = self._tick
         self._hits[uniq_slots] += 1  # feeds freq on write-back
         return uniq_slots[plan.inv].reshape(plan.shape)

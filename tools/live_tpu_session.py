@@ -50,7 +50,10 @@ def tunnel_alive(timeout_s: float = 90.0) -> bool:
         return False
 
 
-def run_stage(name: str, argv: list, timeout_s: float, log) -> bool:
+def run_stage(name: str, argv: list, timeout_s: float, log) -> str:
+    """Returns "ok", "failed", or "timeout" (the caller treats a
+    timeout differently: a SIGKILLed subprocess can't have written its
+    own artifact)."""
     print(f"[live] stage {name}: starting", file=log, flush=True)
     t0 = time.time()
     try:
@@ -62,13 +65,13 @@ def run_stage(name: str, argv: list, timeout_s: float, log) -> bool:
     except subprocess.TimeoutExpired:
         print(f"[live] stage {name}: TIMEOUT after {timeout_s:.0f}s",
               file=log, flush=True)
-        return False
+        return "timeout"
     print(
         f"[live] stage {name}: {'ok' if ok else 'FAILED'} "
         f"({time.time() - t0:.0f}s)",
         file=log, flush=True,
     )
-    return ok
+    return "ok" if ok else "failed"
 
 
 def goodput_stage_argv() -> list:
@@ -184,20 +187,25 @@ def main() -> int:
             for name, artifact, argv_fn, timeout_s in STAGES:
                 if _stage_done(name, artifact):
                     continue
-                ok = run_stage(name, argv_fn(), timeout_s, log)
-                if name == "repro_800m_h128" and not os.path.exists(
-                    os.path.join(REPO, artifact)
+                outcome = run_stage(name, argv_fn(), timeout_s, log)
+                if (
+                    name == "repro_800m_h128"
+                    and outcome == "timeout"
+                    and not os.path.exists(os.path.join(REPO, artifact))
                 ):
                     # The stage's in-process except can't fire on a
                     # SIGKILLed (hung) subprocess; persist the outcome
                     # anyway or every future cycle re-burns the
                     # 30-minute repro before reaching later stages.
+                    # ONLY on timeout: a fast rc!=0 death (broken env,
+                    # OOM-kill) should retry next cycle, not be masked
+                    # by a fabricated "hung" record.
                     with open(os.path.join(REPO, artifact), "w") as f:
                         json.dump(
                             {"error": "hung until stage timeout "
                                       "(wedged backend?)"}, f,
                         )
-                if not ok and not tunnel_alive():
+                if outcome != "ok" and not tunnel_alive():
                     print("[live] tunnel re-wedged; back to waiting",
                           file=log, flush=True)
                     all_done = False

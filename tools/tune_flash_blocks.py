@@ -86,19 +86,34 @@ def main() -> int:
         model = sys.argv[sys.argv.index("--model") + 1]
     spec = candidate_spec(model)
     out_path = os.path.join(REPO, "FLASH_TUNE.json")
+    MAX_ATTEMPTS = 2
     results: list = []
     done: set = set()
+    attempts: dict = {}
     try:
         with open(out_path) as f:
             prev = json.load(f)
         if prev.get("model") == model:
             for p in prev.get("points", []):
-                # keep measured points; retry errored/timed-out ones
                 # (.get: a pre-hardening artifact may lack ce_chunk_rows
                 # — treat those as stale and re-measure)
-                if "step_time_s" in p and "ce_chunk_rows" in p:
+                if "ce_chunk_rows" not in p:
+                    continue
+                key = (tuple(p["blocks"]), p["ce_chunk_rows"])
+                if "step_time_s" in p:
+                    # keep measured points
                     results.append(p)
-                    done.add((tuple(p["blocks"]), p["ce_chunk_rows"]))
+                    done.add(key)
+                elif p.get("attempts", 1) >= MAX_ATTEMPTS:
+                    # A point that keeps erroring/timing out counts as
+                    # permanently failed — it must not block the grid's
+                    # "complete" flag forever (the watcher would re-burn
+                    # 2x600s every cycle and never reach its terminal
+                    # state).
+                    results.append(p)
+                    done.add(key)
+                else:
+                    attempts[key] = p.get("attempts", 1)
     except (OSError, ValueError):
         pass
     if results:
@@ -129,21 +144,28 @@ def main() -> int:
             entry = {
                 "blocks": [fq, fk, bq, bk], "ce_chunk_rows": ce,
                 "error": f"TimeoutError: {str(e)[:160]}",
+                "attempts": attempts.get(((fq, fk, bq, bk), ce), 0) + 1,
             }
             consecutive_timeouts += 1
         except Exception as e:  # noqa: BLE001
             entry = {
                 "blocks": [fq, fk, bq, bk], "ce_chunk_rows": ce,
                 "error": f"{type(e).__name__}: {str(e)[:160]}",
+                "attempts": attempts.get(((fq, fk, bq, bk), ce), 0) + 1,
             }
             consecutive_timeouts = 0
         print(f"{label}: {entry}", file=sys.stderr)
         results.append(entry)
         with open(out_path, "w") as f:
             json.dump({"model": model, "points": results}, f, indent=1)
-    measured = {(tuple(r["blocks"]), r["ce_chunk_rows"])
-                for r in results if "step_time_s" in r}
-    complete = all(((fq, fk, bq, bk), ce) in measured
+    # A point is settled when measured OR permanently failed (attempt
+    # cap hit); only settled-everywhere marks the grid complete.
+    settled = {
+        (tuple(r["blocks"]), r["ce_chunk_rows"])
+        for r in results
+        if "step_time_s" in r or r.get("attempts", 0) >= MAX_ATTEMPTS
+    }
+    complete = all(((fq, fk, bq, bk), ce) in settled
                    for fq, fk, bq, bk, ce in GRID)
     with open(out_path, "w") as f:
         json.dump({"model": model, "points": results,
