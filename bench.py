@@ -198,9 +198,12 @@ def _measure_candidate(cfg, batch, seq, remat, iters, opt="adamw",
     return dt, loss
 
 
-def _measure_decode(cfg, batch, prompt_len, new_tokens):
+def _measure_decode(cfg, batch, prompt_len, new_tokens,
+                    quant_kv=False):
     """Decode tokens/s through the KV-cache generate path (the serving
-    half; reference delegates this to vllm).  Returns tokens/sec."""
+    half; reference delegates this to vllm).  ``quant_kv`` stores the
+    cache as int8 (half the HBM traffic per decoded token).  Returns
+    tokens/sec."""
     import numpy as np
 
     import jax
@@ -216,7 +219,8 @@ def _measure_decode(cfg, batch, prompt_len, new_tokens):
     )
     gen = jax.jit(
         lambda p, pr: llama_infer.generate(
-            p, cfg, pr, max_new_tokens=new_tokens, temperature=0.0
+            p, cfg, pr, max_new_tokens=new_tokens, temperature=0.0,
+            quant_kv=quant_kv,
         )
     )
     out = gen(params, prompts)
@@ -332,7 +336,7 @@ def _measure_one_main(out_path: str) -> int:
         if spec.get("kind") == "decode":
             tps = _measure_decode(
                 cfg, spec["batch"], spec["prompt_len"],
-                spec["new_tokens"],
+                spec["new_tokens"], spec.get("quant_kv", False),
             )
             result = {"dt": 0.0, "loss": 0.0, "tokens_per_sec": tps}
         else:

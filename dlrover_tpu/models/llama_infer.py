@@ -29,6 +29,7 @@ from dlrover_tpu.ops.rmsnorm import rmsnorm
 def init_cache(
     cfg: LlamaConfig, batch: int, max_len: int, *,
     ring_len: Optional[int] = None,
+    quant_kv: bool = False,
 ) -> Dict:
     """Zeroed per-layer k/v cache (compact KV-head count) + write offset.
 
@@ -38,25 +39,52 @@ def init_cache(
     decode memory is O(window), not O(total sequence).  Constraints for
     a chunk of T new tokens: ``T <= ring_len`` always, and
     ``window + T - 1 <= ring_len`` when continuing past a non-empty
-    cache (single-token decode only needs ``ring_len >= window``)."""
+    cache (single-token decode only needs ``ring_len >= window``).
+
+    ``quant_kv``: store k/v as int8 with a per-(sequence, head, slot)
+    absmax scale — decode is HBM-bandwidth-bound, so halving the cache
+    bytes speeds the token loop AND doubles the servable context (the
+    fp8/int8 kv-cache mode of the serving engine the reference RL stack
+    delegates to).  The attention reads the int8 codes directly (an
+    operand dtype-convert fuses into the dot) and applies the scales to
+    the small score/probability tensors — by construction nothing
+    cache-sized is materialized in full precision."""
     KV, D = cfg.n_kv_head, cfg.head_dim
     L = max_len
     if cfg.sliding_window > 0 and ring_len is not None:
         L = min(max_len, ring_len)
-    cache = {
-        "layers": [
-            {
-                "k": jnp.zeros((batch, KV, L, D), cfg.dtype),
-                "v": jnp.zeros((batch, KV, L, D), cfg.dtype),
+
+    def _layer() -> Dict:
+        if quant_kv:
+            return {
+                "k": jnp.zeros((batch, KV, L, D), jnp.int8),
+                "v": jnp.zeros((batch, KV, L, D), jnp.int8),
+                "ks": jnp.zeros((batch, KV, L), jnp.float32),
+                "vs": jnp.zeros((batch, KV, L), jnp.float32),
             }
-            for _ in range(cfg.n_layer)
-        ],
+        return {
+            "k": jnp.zeros((batch, KV, L, D), cfg.dtype),
+            "v": jnp.zeros((batch, KV, L, D), cfg.dtype),
+        }
+
+    cache = {
+        "layers": [_layer() for _ in range(cfg.n_layer)],
         "offset": jnp.zeros((), jnp.int32),
     }
     if cfg.sliding_window > 0:
         # Absolute position held by each ring slot (-1 = unwritten).
         cache["pos"] = jnp.full((L,), -1, jnp.int32)
     return cache
+
+
+def _quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[B, KV, T, D] -> (int8 codes, f32 absmax scale [B, KV, T])."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    codes = jnp.clip(
+        jnp.round(xf / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return codes, scale
 
 
 def _cached_attention(x, layer, cfg, cache_layer, offset, positions,
@@ -79,51 +107,59 @@ def _cached_attention(x, layer, cfg, cache_layer, offset, positions,
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
 
-    if jnp.ndim(offset) == 1:
-        # Ragged decode: sequence b's token lands at ITS slot offset[b]
-        # (one batched scatter; positions == slot indices, so the
-        # standard kpos <= qpos mask below stays correct per row).
-        k_cache = cache_layer["k"].at[jnp.arange(B), :, offset].set(
-            k[:, 0].astype(dt)  # [B, KV, D] straight onto its slots
-        )
-        v_cache = cache_layer["v"].at[jnp.arange(B), :, offset].set(
-            v[:, 0].astype(dt)
-        )
-    elif slot_pos is not None:
-        # Ring write (slot mapping computed ONCE by forward_step).
-        ring_slots, slot_pos = slot_pos
-        if T == 1:
-            # Decode hot path: a single contiguous slot — XLA lowers a
-            # dynamic_update_slice far better than an indexed scatter.
-            k_cache = jax.lax.dynamic_update_slice(
-                cache_layer["k"],
-                k.transpose(0, 2, 1, 3).astype(dt),
-                (0, 0, ring_slots[0], 0),
-            )
-            v_cache = jax.lax.dynamic_update_slice(
-                cache_layer["v"],
-                v.transpose(0, 2, 1, 3).astype(dt),
-                (0, 0, ring_slots[0], 0),
-            )
-        else:
-            k_cache = cache_layer["k"].at[:, :, ring_slots].set(
-                k.transpose(0, 2, 1, 3).astype(dt)
-            )
-            v_cache = cache_layer["v"].at[:, :, ring_slots].set(
-                v.transpose(0, 2, 1, 3).astype(dt)
-            )
-    else:
-        # Write the new k/v into the cache at [offset, offset+T).
-        k_cache = jax.lax.dynamic_update_slice(
-            cache_layer["k"], k.transpose(0, 2, 1, 3).astype(dt),
-            (0, 0, offset, 0),
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            cache_layer["v"], v.transpose(0, 2, 1, 3).astype(dt),
-            (0, 0, offset, 0),
-        )
+    quant = "ks" in cache_layer
 
-    max_len = k_cache.shape[2]
+    def _write(cur: jax.Array, new: jax.Array) -> jax.Array:
+        """Write ``new`` [B, KV, T, ...] into cache array ``cur``
+        [B, KV, L, ...] at the mode's slots (slot axis = 2).  Shared by
+        the code arrays and (in quant mode) their scale arrays so the
+        three write modes are spelled once."""
+        if jnp.ndim(offset) == 1:
+            # Ragged decode: sequence b's token lands at ITS slot
+            # offset[b] (one batched scatter; positions == slot
+            # indices, so the standard kpos <= qpos mask below stays
+            # correct per row).
+            return cur.at[jnp.arange(B), :, offset].set(new[:, :, 0])
+        if slot_pos is not None:
+            ring_slots = slot_pos[0]
+            if T == 1:
+                # Decode hot path: a single contiguous slot — XLA
+                # lowers a dynamic_update_slice far better than an
+                # indexed scatter.
+                start = (0, 0, ring_slots[0]) + (0,) * (new.ndim - 3)
+                return jax.lax.dynamic_update_slice(cur, new, start)
+            return cur.at[:, :, ring_slots].set(new)
+        # Dense: the new k/v land at [offset, offset+T).
+        start = (0, 0, offset) + (0,) * (new.ndim - 3)
+        return jax.lax.dynamic_update_slice(cur, new, start)
+
+    k_t = k.transpose(0, 2, 1, 3)  # [B, KV, T, D]
+    v_t = v.transpose(0, 2, 1, 3)
+    new_layer = dict(cache_layer)
+    if quant:
+        k_codes, k_scale = _quantize_kv(k_t)
+        v_codes, v_scale = _quantize_kv(v_t)
+        new_layer["k"] = _write(cache_layer["k"], k_codes)
+        new_layer["v"] = _write(cache_layer["v"], v_codes)
+        new_layer["ks"] = _write(cache_layer["ks"], k_scale)
+        new_layer["vs"] = _write(cache_layer["vs"], v_scale)
+        # The einsums below read the int8 CODES (a dtype convert on a
+        # dot operand reliably fuses into the dot's read stream); the
+        # scales — constant over D — are applied to the tiny [.., T, L]
+        # score and probability tensors instead, so no full-size
+        # [B, KV, L, D] dequantized product exists even if XLA declines
+        # to fuse an elementwise producer into the MXU op.
+        k_eff = new_layer["k"].astype(dt)
+        v_eff = new_layer["v"].astype(dt)
+    else:
+        new_layer["k"] = _write(cache_layer["k"], k_t.astype(dt))
+        new_layer["v"] = _write(cache_layer["v"], v_t.astype(dt))
+        k_eff, v_eff = new_layer["k"], new_layer["v"]
+
+    if slot_pos is not None:
+        slot_pos = slot_pos[1]
+
+    max_len = k_eff.shape[2]
     rep = H // KV
     # Grouped attention against the COMPACT cache, in its stored dtype:
     # no [B, H, max_len, D] repeat and no fp32 cache copy is ever
@@ -133,12 +169,15 @@ def _cached_attention(x, layer, cfg, cache_layer, offset, positions,
     qf = (
         q.transpose(0, 2, 1, 3)
         .reshape(B, KV, rep, T, D)
-        .astype(k_cache.dtype)
+        .astype(k_eff.dtype)
     )
     s = jnp.einsum(
-        "bgrtd,bgkd->bgrtk", qf, k_cache,
+        "bgrtd,bgkd->bgrtk", qf, k_eff,
         preferred_element_type=jnp.float32,
     ) / np.sqrt(D)
+    if quant:
+        # s_k = (q . codes_k) * scale_k  ==  q . (codes_k * scale_k)
+        s = s * new_layer["ks"][:, :, None, None, :]
     # Causal over absolute positions; unwritten slots are masked (ring
     # mode: pos -1; dense mode: slot index beyond offset+T).
     if slot_pos is not None:
@@ -152,8 +191,11 @@ def _cached_attention(x, layer, cfg, cache_layer, offset, positions,
         # visible.
         s = jnp.where(qpos - kpos < cfg.sliding_window, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
+    if quant:
+        # sum_k p_k * (codes_vk * vs_k)  ==  sum_k (p_k * vs_k) * codes_vk
+        p = p * new_layer["vs"][:, :, None, None, :]
     out = jnp.einsum(
-        "bgrtk,bgkd->bgrtd", p.astype(k_cache.dtype), v_cache,
+        "bgrtk,bgkd->bgrtd", p.astype(v_eff.dtype), v_eff,
         preferred_element_type=jnp.float32,
     )
     out = (
@@ -162,7 +204,7 @@ def _cached_attention(x, layer, cfg, cache_layer, offset, positions,
         .reshape(B, T, H * D)
         .astype(dt)
     )
-    return out @ layer["wo"].astype(dt), {"k": k_cache, "v": v_cache}
+    return out @ layer["wo"].astype(dt), new_layer
 
 
 def forward_step(
@@ -295,6 +337,7 @@ def generate(
     temperature: float = 0.0,  # 0 = greedy
     top_k: int = 0,
     top_p: float = 0.0,  # 0 = off; else nucleus sampling
+    quant_kv: bool = False,  # int8 kv cache (see init_cache)
 ) -> jax.Array:
     """[B, P + max_new_tokens] — prompt + sampled continuation.
 
@@ -313,7 +356,8 @@ def generate(
         # Rolling buffer: prefill needs P slots, decode needs `window`
         # retained keys — memory O(max(P, window)), not O(P + N).
         ring_len = max(P, cfg.sliding_window)
-    cache = init_cache(cfg, B, max_len, ring_len=ring_len)
+    cache = init_cache(cfg, B, max_len, ring_len=ring_len,
+                       quant_kv=quant_kv)
     logits, cache = forward_step(
         params, prompts, cfg, cache, assume_empty_cache=True
     )
@@ -356,6 +400,7 @@ def generate_ragged(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 0.0,
+    quant_kv: bool = False,  # int8 kv cache (see init_cache)
 ) -> Tuple[jax.Array, jax.Array]:
     """Ragged batched decode: per-sequence lengths, per-sequence EOS.
 
@@ -387,7 +432,7 @@ def generate_ragged(
     if N == 0:
         return prompts, jnp.asarray(prompt_lens, jnp.int32)
     prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
-    cache = init_cache(cfg, B, P + N)
+    cache = init_cache(cfg, B, P + N, quant_kv=quant_kv)
     logits, cache = forward_step(params, prompts, cfg, cache)
     if rng is None:
         rng = jax.random.PRNGKey(0)
@@ -487,6 +532,7 @@ class DecodeServer:
         top_p: float = 0.0,
         prompt_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256),
         seed: int = 0,
+        quant_kv: bool = False,  # int8 kv cache (see init_cache)
     ):
         if cfg.sliding_window > 0:
             raise ValueError("DecodeServer: sliding-window models "
@@ -496,6 +542,7 @@ class DecodeServer:
         self.slots = slots
         self.max_len = max_len
         self.eos_token = eos_token
+        self.quant_kv = quant_kv
         self.buckets = tuple(
             b for b in sorted(prompt_buckets) if b <= max_len
         )
@@ -540,10 +587,13 @@ class DecodeServer:
         cfg = self.cfg
 
         def fn(params, cache, s, prompt, plen, key):
+            # Iterate the layer dict's keys so the int8 layout's scale
+            # arrays ("ks"/"vs") ride along with "k"/"v" (every cache
+            # array is [slots, ...]-leading).
             sub_layers = [
                 {
-                    "k": jax.lax.dynamic_slice_in_dim(cl["k"], s, 1, 0),
-                    "v": jax.lax.dynamic_slice_in_dim(cl["v"], s, 1, 0),
+                    kk: jax.lax.dynamic_slice_in_dim(cl[kk], s, 1, 0)
+                    for kk in cl
                 }
                 for cl in cache["layers"]
             ]
@@ -551,10 +601,7 @@ class DecodeServer:
             # previous occupant's keys beyond the causal mask).
             sub = {
                 "layers": [
-                    {
-                        "k": jnp.zeros_like(c["k"]),
-                        "v": jnp.zeros_like(c["v"]),
-                    }
+                    {kk: jnp.zeros_like(c[kk]) for kk in c}
                     for c in sub_layers
                 ],
                 "offset": jnp.zeros((), jnp.int32),
@@ -564,12 +611,10 @@ class DecodeServer:
             first = self._pick(last[None, :], key)[0]
             new_layers = [
                 {
-                    "k": jax.lax.dynamic_update_slice_in_dim(
-                        cl["k"], sc["k"], s, 0
-                    ),
-                    "v": jax.lax.dynamic_update_slice_in_dim(
-                        cl["v"], sc["v"], s, 0
-                    ),
+                    kk: jax.lax.dynamic_update_slice_in_dim(
+                        cl[kk], sc[kk], s, 0
+                    )
+                    for kk in cl
                 }
                 for cl, sc in zip(cache["layers"], sub["layers"])
             ]
@@ -587,7 +632,8 @@ class DecodeServer:
         B = self.slots
         queue = list(enumerate(prompts))[::-1]  # pop() admits in order
         results: Dict[int, Any] = {}
-        cache = init_cache(cfg, B, self.max_len)
+        cache = init_cache(cfg, B, self.max_len,
+                           quant_kv=self.quant_kv)
         cache = dict(cache, offset=jnp.zeros((B,), jnp.int32))
         toks = jnp.zeros((B,), jnp.int32)
         active = onp.zeros((B,), bool)

@@ -416,3 +416,135 @@ class TestDecodeServerGuards:
         # has far more distinct adjacent pairs.
         pairs = {(int(a), int(b)) for a, b in zip(gen[:-1], gen[1:])}
         assert len(pairs) > 5, gen
+
+
+class TestQuantKVCache:
+    """int8 kv cache: per-(seq, head, slot) absmax quantization of the
+    cached k/v (the fp8/int8 kv-cache mode of the serving engine the
+    reference RL stack delegates to) — halves decode HBM traffic."""
+
+    def test_quantize_kv_error_bound(self):
+        """Round-to-nearest absmax int8: elementwise error <= scale/2."""
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 3, 5, 8)), jnp.float32)
+        codes, scale = llama_infer._quantize_kv(x)
+        assert codes.dtype == jnp.int8 and scale.shape == (2, 3, 5)
+        back = np.asarray(codes, np.float32) * np.asarray(scale)[..., None]
+        err = np.abs(back - np.asarray(x))
+        assert (err <= np.asarray(scale)[..., None] / 2 + 1e-7).all()
+
+    def test_quant_prefill_and_decode_logits_close(self):
+        """fp32 model: the int8-cache logits track the dense-cache
+        logits through prefill AND several decode steps."""
+        cfg, params, prompts = _setup(dtype=jnp.float32)
+        B, P = prompts.shape
+        dense = llama_infer.init_cache(cfg, B, P + 4)
+        quant = llama_infer.init_cache(cfg, B, P + 4, quant_kv=True)
+        ld, dense = llama_infer.forward_step(params, prompts, cfg, dense)
+        lq, quant = llama_infer.forward_step(params, prompts, cfg, quant)
+        span = float(np.max(np.abs(np.asarray(ld)))) + 1e-6
+        assert float(np.max(np.abs(np.asarray(lq - ld)))) / span < 0.05
+        tok = jnp.argmax(ld[:, -1, :], axis=-1).astype(prompts.dtype)
+        for _ in range(4):
+            ld, dense = llama_infer.forward_step(
+                params, tok[:, None], cfg, dense
+            )
+            lq, quant = llama_infer.forward_step(
+                params, tok[:, None], cfg, quant
+            )
+            assert (
+                float(np.max(np.abs(np.asarray(lq - ld)))) / span < 0.08
+            )
+            tok = jnp.argmax(ld[:, -1, :], axis=-1).astype(tok.dtype)
+
+    def test_quant_cache_is_half_the_bytes(self):
+        # Production head_dim (the tiny default's D=16 would make the
+        # f32 per-slot scale loom large; at D=64 it is a 3% overhead).
+        cfg = llama.LlamaConfig.tiny(
+            n_layer=2, dtype=jnp.bfloat16, n_head=4, n_kv_head=2,
+            d_model=256,
+        )
+        dense = llama_infer.init_cache(cfg, 2, 32)
+        quant = llama_infer.init_cache(cfg, 2, 32, quant_kv=True)
+
+        def nbytes(c):
+            return sum(
+                int(np.prod(a.shape)) * a.dtype.itemsize
+                for layer in c["layers"] for a in layer.values()
+            )
+
+        # int8 codes + f32 per-slot scale: ~0.5x of bf16 + scale overhead
+        assert nbytes(quant) < 0.6 * nbytes(dense)
+
+    def test_quant_ragged_generate_runs_and_stops_on_eos(self):
+        cfg = llama.LlamaConfig.tiny(n_layer=2, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        prompts = np.zeros((2, 6), np.int32)
+        prompts[0, :4] = [1, 2, 3, 4]
+        prompts[1, :6] = [5, 6, 7, 1, 2, 3]
+        out, lens = llama_infer.generate_ragged(
+            params, cfg, jnp.asarray(prompts),
+            jnp.asarray([4, 6], np.int32),
+            max_new_tokens=6, quant_kv=True,
+        )
+        assert out.shape == (2, 12)
+        assert int(lens[0]) >= 4 and int(lens[1]) >= 6
+        # prompt is preserved verbatim at the head of each row
+        np.testing.assert_array_equal(np.asarray(out[0, :4]),
+                                      prompts[0, :4])
+        np.testing.assert_array_equal(np.asarray(out[1, :6]),
+                                      prompts[1, :6])
+
+    def test_quant_server_matches_quant_solo_decode(self):
+        """Continuous batching with the int8 cache must emit exactly the
+        solo int8-cache greedy decode (both paths quantize identically)."""
+        cfg = llama.LlamaConfig.tiny(n_layer=2, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        prompts = [
+            (np.arange(4, dtype=np.int32) % 7) + 1,
+            (np.arange(6, dtype=np.int32) % 5) + 2,
+        ]
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=32, prompt_buckets=(8,),
+            quant_kv=True,
+        )
+        outs = srv.serve(prompts, max_new_tokens=5)
+        for p, got in zip(prompts, outs):
+            solo = llama_infer.generate(
+                params, cfg, jnp.asarray(p)[None, :],
+                max_new_tokens=5, quant_kv=True,
+            )[0]
+            np.testing.assert_array_equal(got, np.asarray(solo))
+
+    def test_quant_ring_decode_close_to_dense_ring(self):
+        """Sliding-window ring cache composes with int8 quant."""
+        cfg = llama.LlamaConfig.tiny(
+            n_layer=2, dtype=jnp.float32, sliding_window=6
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        prompts = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 7), 0, cfg.vocab_size
+        )
+        dense = llama_infer.init_cache(cfg, 2, 16, ring_len=8)
+        quant = llama_infer.init_cache(cfg, 2, 16, ring_len=8,
+                                       quant_kv=True)
+        ld, dense = llama_infer.forward_step(
+            params, prompts, cfg, dense, assume_empty_cache=True
+        )
+        lq, quant = llama_infer.forward_step(
+            params, prompts, cfg, quant, assume_empty_cache=True
+        )
+        span = float(np.max(np.abs(np.asarray(ld)))) + 1e-6
+        assert float(np.max(np.abs(np.asarray(lq - ld)))) / span < 0.05
+        tok = jnp.argmax(ld[:, -1, :], axis=-1).astype(prompts.dtype)
+        for _ in range(3):
+            ld, dense = llama_infer.forward_step(
+                params, tok[:, None], cfg, dense
+            )
+            lq, quant = llama_infer.forward_step(
+                params, tok[:, None], cfg, quant
+            )
+            assert (
+                float(np.max(np.abs(np.asarray(lq - ld)))) / span < 0.08
+            )
+            tok = jnp.argmax(ld[:, -1, :], axis=-1).astype(tok.dtype)

@@ -77,7 +77,10 @@ def run_stage(name: str, argv: list, timeout_s: float, log) -> str:
 def goodput_stage_argv() -> list:
     # measure_goodput writes its dict; wrap to save an artifact.
     code = (
-        "import json, sys; sys.path.insert(0, %r); import bench; "
+        "import json, sys; sys.path.insert(0, %r); "
+        "import jax; assert jax.default_backend() == 'tpu', "
+        "'shim fell back to %%s' %% jax.default_backend(); "
+        "import bench; "
         "r = bench.measure_goodput(backend='tpu'); "
         "r['goodput_backend'] = 'tpu'; "
         "open(%r, 'w').write(json.dumps(r, indent=1)); print(r)"
@@ -87,15 +90,25 @@ def goodput_stage_argv() -> list:
 
 
 def decode_stage_argv() -> list:
+    # Dense and int8-kv variants: decode is HBM-bandwidth-bound, so the
+    # quant cache's half-sized reads should show directly in tokens/s.
     code = (
         "import json, sys; sys.path.insert(0, %r); import bench; "
         "from dlrover_tpu.models import llama; "
-        "cfg = llama.LlamaConfig.small_300m(); "
-        "spec = {'kind': 'decode', 'batch': 8, 'prompt_len': 128, "
-        "'new_tokens': 128, 'cfg': {k: v for k, v in cfg.__dict__.items()"
-        " if isinstance(v, (int, float, str, bool))}}; "
-        "r = bench._run_one_subproc(spec, 'decode', 1500.0); "
-        "open(%r, 'w').write(json.dumps(r, indent=1)); print(r)"
+        "cfg = llama.LlamaConfig.small_300m()\n"
+        "out = {}\n"
+        "for name, q in (('dense', False), ('int8_kv', True)):\n"
+        "    spec = {'kind': 'decode', 'batch': 8, 'prompt_len': 128,\n"
+        "            'new_tokens': 128, 'quant_kv': q,\n"
+        "            'cfg': {k: v for k, v in cfg.__dict__.items()\n"
+        "                    if isinstance(v, (int, float, str, bool))}}\n"
+        "    try:\n"
+        "        r = bench._run_one_subproc(spec, 'decode_' + name, 900.0)\n"
+        "        out[name] = {'tokens_per_sec': round(r['tokens_per_sec'], 1)}\n"
+        "    except Exception as e:\n"
+        "        out[name] = {'error': '%%s: %%s' %% (type(e).__name__, str(e)[:200])}\n"
+        "    open(%r, 'w').write(json.dumps(out, indent=1))\n"
+        "print(out)"
         % (REPO, os.path.join(REPO, "DECODE_TPU.json"))
     )
     return [sys.executable, "-c", code]
@@ -160,10 +173,14 @@ STAGES = [
      1800.0),
     ("op_metrics", "OP_METRICS_TPU.json",
      lambda: [sys.executable,
-              os.path.join(REPO, "tools", "validate_op_metrics.py")],
+              os.path.join(REPO, "tools", "validate_op_metrics.py"),
+              "--require-tpu"],
      1800.0),
     ("goodput", "GOODPUT_TPU.json", goodput_stage_argv, 2400.0),
-    ("decode", "DECODE_TPU.json", decode_stage_argv, 1800.0),
+    # Outer timeout must exceed the stage's inner budgets (2 x 900s
+    # variants) with startup headroom, or a SIGKILL lands between
+    # variants and a partial artifact permanently marks the stage done.
+    ("decode", "DECODE_TPU.json", decode_stage_argv, 2400.0),
 ]
 
 

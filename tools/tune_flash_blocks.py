@@ -113,6 +113,12 @@ def main() -> int:
                     results.append(p)
                     done.add(key)
                 else:
+                    # Pending retry: STAY in results so the attempt
+                    # count survives an interruption before the retry
+                    # lands (it is replaced in place when re-measured);
+                    # dropping it would reset the counter every cycle
+                    # and the permanent-failure cap could never fire.
+                    results.append(p)
                     attempts[key] = p.get("attempts", 1)
     except (OSError, ValueError):
         pass
@@ -155,7 +161,16 @@ def main() -> int:
             }
             consecutive_timeouts = 0
         print(f"{label}: {entry}", file=sys.stderr)
-        results.append(entry)
+        # Replace a carried pending-retry entry for this key in place;
+        # append otherwise.
+        for i, p in enumerate(results):
+            if (tuple(p["blocks"]), p["ce_chunk_rows"]) == (
+                (fq, fk, bq, bk), ce
+            ):
+                results[i] = entry
+                break
+        else:
+            results.append(entry)
         with open(out_path, "w") as f:
             json.dump({"model": model, "points": results}, f, indent=1)
     # A point is settled when measured OR permanently failed (attempt

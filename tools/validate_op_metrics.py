@@ -37,6 +37,12 @@ def main() -> int:
     from dlrover_tpu.utils.op_metrics import OpMetricsCollector
 
     backend = jax.default_backend()
+    if "--require-tpu" in sys.argv and backend != "tpu":
+        # Watcher mode: a shim fallback to CPU must NOT write the
+        # artifact (the stage would wrongly count as done with
+        # CPU-trace data — exactly the stale artifact r4 had to purge).
+        print(f"FAIL: backend is {backend}, not tpu", file=sys.stderr)
+        return 1
     if backend == "tpu":
         cfg = llama.LlamaConfig.small_300m()
         seq = 512
