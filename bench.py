@@ -126,13 +126,17 @@ def model_flops_per_step(cfg, batch, seq) -> float:
 
 
 def _measure_candidate(cfg, batch, seq, remat, iters, opt="adamw",
-                       fp8=False, accum=1):
+                       fp8=False, accum=1, fused=None):
     """Compile + time one (model, batch, remat, optimizer, fp8, accum)
     point through accelerate(); returns (sec/step, final loss) or
     raises (e.g. OOM).  ``accum`` microbatches inside the jitted step:
     batch B with accum A runs A microbatches of B/A — the activation
     memory of B/A with B tokens of work per dispatch (amortizes tunnel
-    dispatch + optimizer overhead per token)."""
+    dispatch + optimizer overhead per token).  ``fused`` overrides the
+    fused-lm-head auto policy: False materializes the [tokens, V]
+    logits as ONE big MXU-friendly GEMM — ~24% of the 300m FLOPs live
+    in the lm head, and at b<=16 the logits fit HBM, so the scanned
+    chunked CE may be leaving MXU efficiency on the table."""
     import numpy as np
 
     import jax
@@ -166,10 +170,12 @@ def _measure_candidate(cfg, batch, seq, remat, iters, opt="adamw",
     ).astype(np.int32)
     if fp8:
         loss_fn = lambda p, b, fp8_states: llama.loss_fn(  # noqa: E731
-            p, b, cfg, fp8_states=fp8_states
+            p, b, cfg, fp8_states=fp8_states, fused_lm_head=fused
         )
     else:
-        loss_fn = lambda p, b: llama.loss_fn(p, b, cfg)  # noqa: E731
+        loss_fn = lambda p, b: llama.loss_fn(  # noqa: E731
+            p, b, cfg, fused_lm_head=fused
+        )
     job = accelerate(
         loss_fn=loss_fn,
         init_fn=lambda r: llama.init_params(r, cfg),
@@ -235,7 +241,7 @@ def _measure_decode(cfg, batch, prompt_len, new_tokens,
 
 
 def _measure_candidate_subproc(
-    name, cfg, batch, seq, remat, iters, opt, fp8, accum=1,
+    name, cfg, batch, seq, remat, iters, opt, fp8, accum=1, fused=None,
     timeout_s: Optional[float] = None,
 ):
     """Run one candidate measurement in a subprocess with a hard kill.
@@ -255,6 +261,7 @@ def _measure_candidate_subproc(
     spec = {
         "model": name, "batch": batch, "seq": seq, "remat": remat,
         "iters": iters, "opt": opt, "fp8": fp8, "accum": accum,
+        "fused": fused,
         "cfg": {
             k: v for k, v in cfg.__dict__.items()
             if isinstance(v, (int, float, str, bool))
@@ -343,7 +350,7 @@ def _measure_one_main(out_path: str) -> int:
             dt, loss = _measure_candidate(
                 cfg, spec["batch"], spec["seq"], spec["remat"],
                 spec["iters"], spec["opt"], spec["fp8"],
-                spec.get("accum", 1),
+                spec.get("accum", 1), spec.get("fused"),
             )
             result = {"dt": dt, "loss": loss}
     except Exception as e:  # noqa: BLE001
@@ -569,6 +576,11 @@ def main() -> int:
             # accum=2: b16-sized activations with b32 tokens/dispatch —
             # the fallback if b32 flat OOMs.
             ("llama_300m_h128", m300h, 32, "none", "adamw", 3, False, 2),
+            # Unfused lm head: ~24% of the 300m FLOPs are the vocab
+            # GEMM; at b8 the [16k, 32k] bf16 logits fit HBM, and one
+            # big MXU GEMM may beat the scanned chunked CE.
+            ("llama_300m_h128_nofuse", m300h, 8, "none", "adamw", 3,
+             False, 1),
             # The 800m's wider GEMMs (d=1536, ff=4096) feed the MXU
             # better; fused lm-head loss + per-block remat + int8 Adam
             # state make it fit in 16G HBM.
@@ -619,6 +631,9 @@ def main() -> int:
     peak_all = detect_peak() * jax.local_device_count()
     for (name, cfg, batch, remat, opt, probe_iters, fp8,
          accum) in candidates:
+        # "_nofuse" candidates override the fused-lm-head auto policy
+        # (materialized-logits CE vs the scanned chunked CE).
+        fused = False if name.endswith("_nofuse") else None
         entry = {
             "model": name, "batch": batch, "remat": remat, "opt": opt,
             "fp8": fp8, "accum": accum,
@@ -635,13 +650,13 @@ def main() -> int:
                 # mid-sweep must cost one candidate, not the bench.
                 dt, loss = _measure_candidate_subproc(
                     name, cfg, batch, seq, remat, probe_iters, opt, fp8,
-                    accum,
+                    accum, fused,
                     timeout_s=min(1800.0, max(60.0, _time_left() - 30)),
                 )
             else:
                 dt, loss = _measure_candidate(cfg, batch, seq, remat,
                                               probe_iters, opt, fp8,
-                                              accum)
+                                              accum, fused)
         except Exception as e:  # noqa: BLE001 - OOM/compile failure
             print(
                 f"bench: candidate {name} b={batch} remat={remat} "
@@ -669,25 +684,26 @@ def main() -> int:
         _flush_partial(partial, tpu=on_tpu)
         if best is None or rate > best[0]:
             best = (rate, name, cfg, batch, remat, opt, dt, loss, fp8,
-                    accum)
+                    accum, fused)
     if best is None:
         print(json.dumps({"metric": "llama_train_mfu", "value": 0.0,
                           "unit": "%", "vs_baseline": 0.0,
                           "error": "all candidates failed"}))
         return 1
 
-    _, name, cfg, batch, remat, opt, dt, loss, fp8, accum = best
+    _, name, cfg, batch, remat, opt, dt, loss, fp8, accum, fused = best
     # Re-measure the winner at full iteration count for a stable number
     # (deadline permitting; the probe number stands otherwise).
     try:
         if on_tpu and _time_left() > 400.0:
             dt, loss = _measure_candidate_subproc(
                 name, cfg, batch, seq, remat, iters, opt, fp8, accum,
+                fused,
                 timeout_s=min(1800.0, _time_left() - 30),
             )
         elif not on_tpu:
             dt, loss = _measure_candidate(cfg, batch, seq, remat, iters,
-                                          opt, fp8, accum)
+                                          opt, fp8, accum, fused)
     except Exception:  # noqa: BLE001 - keep the probe measurement
         pass
 
@@ -769,7 +785,8 @@ def main() -> int:
                     + (f" accum={accum}" if accum > 1 else "")
                     + (" fp8" if fp8 else "")
                     + (" fused_lm_head"
-                       if llama.uses_fused_lm_head(cfg) else "")
+                       if (llama.uses_fused_lm_head(cfg)
+                           if fused is None else fused) else "")
                 ),
                 "step_time_s": round(dt, 4),
                 "tokens_per_sec": round(tokens_per_sec, 1),

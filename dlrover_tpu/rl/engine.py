@@ -80,7 +80,8 @@ class RoleSpec:
 
 
 def llama_cached_generate(cfg, ppo_config: PPOConfig,
-                          jit_cache_size: int = 16) -> Callable:
+                          jit_cache_size: int = 16,
+                          quant_kv: bool = False) -> Callable:
     """Build an actor ``generate_fn`` backed by the KV-cache decoder
     (``models.llama_infer``: prefill + single-token decode, O(T)
     attention per new token).  Prompts are right-padded to a power-of-
@@ -108,6 +109,7 @@ def llama_cached_generate(cfg, ppo_config: PPOConfig,
                         rng=r,
                         temperature=ppo_config.temperature,
                         top_k=ppo_config.top_k,
+                        quant_kv=quant_kv,
                     )
                 )
             return jitted[("win", plen)](params, prompts, rng)
@@ -120,6 +122,7 @@ def llama_cached_generate(cfg, ppo_config: PPOConfig,
                     rng=r,
                     temperature=ppo_config.temperature,
                     top_k=ppo_config.top_k,
+                    quant_kv=quant_kv,
                 )
                 return out
 

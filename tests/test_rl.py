@@ -372,6 +372,23 @@ class TestCachedRollout:
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
         return cfg, params
 
+    def test_cached_generate_quant_kv_rollout(self):
+        """quant_kv=True rollouts go through the int8 cache and keep
+        the RL contract [B, plen + R]."""
+        from dlrover_tpu.rl.engine import llama_cached_generate
+
+        cfg, params = self._llama()
+        pcfg = PPOConfig(response_length=6, temperature=0.0)
+        gen = llama_cached_generate(cfg, pcfg, quant_kv=True)
+        prompts = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 5))
+        )
+        out = gen(params, prompts, jax.random.PRNGKey(0))
+        assert out.shape == (2, 5 + 6)
+        np.testing.assert_array_equal(
+            np.asarray(out[:, :5]), np.asarray(prompts)
+        )
+
     def test_engine_uses_cached_decoder_and_matches_greedy(self):
         from dlrover_tpu.models import llama
         from dlrover_tpu.rl.engine import llama_cached_generate

@@ -92,6 +92,11 @@ def goodput_stage_argv() -> list:
 def decode_stage_argv() -> list:
     # Dense and int8-kv variants: decode is HBM-bandwidth-bound, so the
     # quant cache's half-sized reads should show directly in tokens/s.
+    # The artifact is written ONCE, only when BOTH variants measured:
+    # error-only or partial runs leave no artifact, so _stage_done()'s
+    # existence check retries the stage next cycle (a transient wedge
+    # must not permanently mask the int8 measurement this stage exists
+    # to collect).
     code = (
         "import json, sys; sys.path.insert(0, %r); import bench; "
         "from dlrover_tpu.models import llama; "
@@ -102,12 +107,10 @@ def decode_stage_argv() -> list:
         "            'new_tokens': 128, 'quant_kv': q,\n"
         "            'cfg': {k: v for k, v in cfg.__dict__.items()\n"
         "                    if isinstance(v, (int, float, str, bool))}}\n"
-        "    try:\n"
-        "        r = bench._run_one_subproc(spec, 'decode_' + name, 900.0)\n"
-        "        out[name] = {'tokens_per_sec': round(r['tokens_per_sec'], 1)}\n"
-        "    except Exception as e:\n"
-        "        out[name] = {'error': '%%s: %%s' %% (type(e).__name__, str(e)[:200])}\n"
-        "    open(%r, 'w').write(json.dumps(out, indent=1))\n"
+        "    r = bench._run_one_subproc(spec, 'decode_' + name, 900.0)\n"
+        "    out[name] = {'tokens_per_sec': round(r['tokens_per_sec'], 1)}\n"
+        "    print(name, out[name])\n"
+        "open(%r, 'w').write(json.dumps(out, indent=1))\n"
         "print(out)"
         % (REPO, os.path.join(REPO, "DECODE_TPU.json"))
     )
