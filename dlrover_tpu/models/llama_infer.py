@@ -298,6 +298,31 @@ def forward_step(
     return logits, new_cache
 
 
+def shard_params_for_decode(params: Dict, cfg: LlamaConfig, mesh):
+    """Tensor-parallel serving layout: device_put ``params`` onto
+    ``mesh`` (axis name ``'tp'``) with column-parallel wq/wk/wv and
+    mlp-in, row-parallel wo/w_down, vocab-sharded lm_head — the layout
+    vllm's TP serving uses, expressed as shardings instead of module
+    surgery.  The decode computation itself needs no changes: jit the
+    usual :func:`generate`/:func:`forward_step` and GSPMD partitions the
+    einsums and inserts the row-parallel reductions (computation
+    follows the data).  Returns (sharded_params, specs).
+
+    GQA note: the KV cache follows the kv-head einsum operands, so tp
+    greater than ``cfg.n_kv_head`` still works (XLA gathers k/v) but
+    shards only the q-head work."""
+    from dlrover_tpu.parallel import sharding as sh
+
+    # Only the overrides: neutralize the training axes that have no
+    # mesh axis here (batch/embed/expert); heads/mlp/vocab already map
+    # to 'tp' in DEFAULT_RULES and keep tracking it.
+    rules: sh.Rules = {"batch": None, "embed": None, "expert": None}
+    specs = sh.tree_logical_to_specs(
+        llama.param_logical_axes(cfg), rules
+    )
+    return sh.shard_tree(params, specs, mesh), specs
+
+
 def _make_sampler(temperature: float, top_k: int, top_p: float):
     """(logits [B, V], rng) -> [B] token picker: greedy at T=0, else
     categorical with optional top-k truncation / top-p nucleus."""
