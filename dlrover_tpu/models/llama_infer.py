@@ -526,6 +526,141 @@ def generate_ragged(
     return out, lens
 
 
+def generate_speculative(
+    params: Dict,
+    cfg: LlamaConfig,
+    draft_params: Dict,
+    draft_cfg: LlamaConfig,
+    prompts: jax.Array,  # [1, P] — single-sequence (low-latency serving)
+    *,
+    max_new_tokens: int,
+    k: int = 4,
+    quant_kv: bool = False,
+    stats: Optional[Dict] = None,  # out-param: rounds, tokens_per_round
+) -> jax.Array:
+    """Greedy speculative decoding: a small DRAFT model proposes ``k``
+    tokens per round; the TARGET model scores all of them in ONE chunked
+    forward and accepts the longest matching prefix (+ its own next
+    token).  Output is EXACTLY the target model's greedy decode — the
+    draft only changes how many target forwards it takes — while each
+    accepted token costs the target 1/(j+1) of a sequential step's
+    dispatch + weight-read traffic (the speculative-decoding role of
+    the serving engine the reference RL stack delegates to).
+
+    TPU shape: three fixed-shape jitted programs (draft k-step scan,
+    draft (k+1)-token catch-up, target (k+1)-token verify) driven by a
+    host loop.  Cache bookkeeping rides the DENSE cache's slot-index
+    masking: slots past ``offset`` are invisible, so rejecting a
+    speculated suffix is just rewinding ``offset`` — the stale slots
+    are overwritten by the next round's writes.
+
+    Single-sequence only (``B == 1``): per-row acceptance lengths would
+    need ragged multi-token cache offsets.  Sliding-window ring caches
+    are not supported (ring slots are position-mapped, not
+    offset-masked, so rewind would not hide stale writes).
+
+    Numerics: "exactly greedy" holds where the (k+1)-token verify
+    forward is numerically equivalent to the T=1 decode step (fp32, or
+    comfortably-separated top logits).  In bf16 a near-tie between the
+    top two logits can resolve differently under the chunked matmul's
+    tiling and the sequences legitimately diverge there — same caveat
+    as any chunked-vs-incremental scoring on real accelerators."""
+    B, P = prompts.shape
+    if B != 1:
+        raise ValueError(
+            f"speculative decode is single-sequence (got batch {B})"
+        )
+    if cfg.sliding_window > 0 or draft_cfg.sliding_window > 0:
+        raise ValueError(
+            "speculative decode does not support sliding-window ring "
+            "caches (offset rewind cannot hide stale ring writes)"
+        )
+    if max_new_tokens == 0:
+        return prompts
+    max_len = P + max_new_tokens + k + 2  # + one overshooting round
+    cache_t = init_cache(cfg, 1, max_len, quant_kv=quant_kv)
+    cache_d = init_cache(draft_cfg, 1, max_len, quant_kv=quant_kv)
+    logits, cache_t = forward_step(params, prompts, cfg, cache_t)
+    _, cache_d = forward_step(draft_params, prompts, draft_cfg, cache_d)
+    cur = jnp.argmax(logits[:, -1, :], axis=-1).astype(prompts.dtype)
+
+    @jax.jit
+    def draft_roll(dp, cache, tok):
+        def body(carry, _):
+            cache, tok = carry
+            lg, cache = forward_step(dp, tok[:, None], draft_cfg, cache)
+            nxt = jnp.argmax(lg[:, -1, :], axis=-1).astype(tok.dtype)
+            return (cache, nxt), nxt
+
+        (cache, _), toks = jax.lax.scan(
+            body, (cache, tok), None, length=k
+        )
+        return toks[:, 0], cache  # [k] proposals
+
+    @jax.jit
+    def target_verify(tp, cache, chunk):
+        lg, cache = forward_step(tp, chunk, cfg, cache)
+        return jnp.argmax(lg[0], axis=-1).astype(chunk.dtype), cache
+
+    @jax.jit
+    def draft_write_one(dp, cache, tok):
+        # KV-write of one accepted token into the draft cache (logits
+        # discarded) — only needed on FULL acceptance, when the last
+        # proposal d_k entered the context but draft_roll never wrote
+        # its kv (the roll writes each step's INPUT, i.e. cur..d_{k-1}).
+        _, cache = forward_step(dp, tok[:, None], draft_cfg, cache)
+        return cache
+
+    out = [int(cur[0])]
+    rounds = 0
+    while len(out) < max_new_tokens:
+        n = int(cache_t["offset"])  # accepted context in both caches
+        d, cache_d = draft_roll(draft_params, cache_d, cur)
+        # chunk = [cur, d_1..d_k]: target logits after each give the
+        # greedy continuation g_i at every speculated position.
+        chunk = jnp.concatenate(
+            [cur[:, None], d[None, :]], axis=1
+        )  # [1, k+1]
+        g, cache_t = target_verify(params, cache_t, chunk)
+        d_host = np.asarray(d)
+        g_host = np.asarray(g)
+        j = 0
+        while j < k and d_host[j] == g_host[j]:
+            j += 1
+        # Accept d_1..d_j then the target's own next token g_{j+1}.
+        accepted = list(d_host[:j]) + [g_host[j]]
+        out.extend(int(t) for t in accepted)
+        # Rewind to the accepted context (slots past offset are masked
+        # until overwritten).  The draft roll already wrote exactly the
+        # accepted slots n..n+j (its inputs were cur, d_1..d_{j-1}, and
+        # slot values match the proposals), so no replay is needed —
+        # except on full acceptance, where d_k's kv is still missing.
+        new_n = n + 1 + j  # cur + d_1..d_j now in-context
+        if j == k:
+            cache_d = dict(
+                cache_d, offset=jnp.asarray(new_n - 1, jnp.int32)
+            )
+            cache_d = draft_write_one(
+                draft_params, cache_d,
+                jnp.asarray([d_host[k - 1]], prompts.dtype),
+            )
+        else:
+            cache_d = dict(cache_d, offset=jnp.asarray(new_n, jnp.int32))
+        cache_t = dict(cache_t, offset=jnp.asarray(new_n, jnp.int32))
+        cur = jnp.asarray([g_host[j]], prompts.dtype)
+        rounds += 1
+    emitted = min(len(out), max_new_tokens)
+    if stats is not None:
+        # Accepted tokens per verify round (the prefill's first token
+        # costs no round); the acceptance-rate signal for tuning k.
+        stats["rounds"] = rounds
+        stats["tokens_per_round"] = (
+            (emitted - 1) / rounds if rounds else 0.0
+        )
+    toks = jnp.asarray(out[:max_new_tokens], prompts.dtype)
+    return jnp.concatenate([prompts, toks[None, :]], axis=1)
+
+
 class DecodeServer:
     """Continuous-batching greedy/sampled decode over fixed slots — the
     role vllm plays for the reference's RL engine

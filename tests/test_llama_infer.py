@@ -622,3 +622,74 @@ class TestTensorParallelDecode:
             params, cfg, prompts, max_new_tokens=6, quant_kv=True
         )
         np.testing.assert_array_equal(np.asarray(outq), np.asarray(refq))
+
+
+class TestSpeculativeDecode:
+    """Draft-propose-k / target-verify-in-one-chunk greedy speculative
+    decoding: the output must be EXACTLY the target model's greedy
+    decode, independent of the draft."""
+
+    def _target(self):
+        cfg = llama.LlamaConfig.tiny(n_layer=2, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        prompts = jax.random.randint(
+            jax.random.PRNGKey(1), (1, 6), 0, cfg.vocab_size
+        )
+        return cfg, params, prompts
+
+    def test_same_model_draft_accepts_everything(self):
+        cfg, params, prompts = self._target()
+        ref = llama_infer.generate(
+            params, cfg, prompts, max_new_tokens=10
+        )
+        stats = {}
+        got = llama_infer.generate_speculative(
+            params, cfg, params, cfg, prompts, max_new_tokens=10, k=4,
+            stats=stats,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        # Perfect draft: every round lands k+1 tokens.
+        assert stats["tokens_per_round"] > 4, stats
+
+    def test_disagreeing_draft_still_exact(self):
+        cfg, params, prompts = self._target()
+        # Different seed => frequent disagreement => rejects exercised.
+        draft_params = llama.init_params(jax.random.PRNGKey(9), cfg)
+        ref = llama_infer.generate(
+            params, cfg, prompts, max_new_tokens=12
+        )
+        got = llama_infer.generate_speculative(
+            params, cfg, draft_params, cfg, prompts,
+            max_new_tokens=12, k=3,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_smaller_draft_model_and_quant_compose(self):
+        cfg, params, prompts = self._target()
+        dcfg = llama.LlamaConfig.tiny(n_layer=1, dtype=jnp.float32)
+        dparams = llama.init_params(jax.random.PRNGKey(3), dcfg)
+        ref = llama_infer.generate(
+            params, cfg, prompts, max_new_tokens=8, quant_kv=True
+        )
+        got = llama_infer.generate_speculative(
+            params, cfg, dparams, dcfg, prompts, max_new_tokens=8,
+            k=2, quant_kv=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_guards(self):
+        cfg, params, _ = self._target()
+        two = jnp.zeros((2, 4), jnp.int32)
+        with pytest.raises(ValueError, match="single-sequence"):
+            llama_infer.generate_speculative(
+                params, cfg, params, cfg, two, max_new_tokens=4
+            )
+        wcfg = llama.LlamaConfig.tiny(
+            n_layer=1, dtype=jnp.float32, sliding_window=4
+        )
+        wparams = llama.init_params(jax.random.PRNGKey(0), wcfg)
+        one = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="sliding-window"):
+            llama_infer.generate_speculative(
+                wparams, wcfg, wparams, wcfg, one, max_new_tokens=4
+            )
