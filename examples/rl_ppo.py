@@ -33,6 +33,9 @@ def parse_args():
     p.add_argument("--prompt_len", type=int, default=2)
     p.add_argument("--target_token", type=int, default=7)
     p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--quant_kv", action="store_true",
+                   help="int8 kv cache for rollouts (half the decode "
+                        "HBM traffic)")
     p.add_argument("--llama", action="store_true",
                    help="tiny-llama actor with KV-cache rollouts "
                         "(default: a 1-layer toy LM — faster on CPU)")
@@ -83,7 +86,9 @@ def main() -> int:
             lambda p, t: llama.forward(p, t, mcfg)[0],
             actor_params,
             trainable=True,
-            generate_fn=llama_cached_generate(mcfg, cfg),
+            generate_fn=llama_cached_generate(
+                mcfg, cfg, quant_kv=args.quant_kv
+            ),
         )
         vocab = mcfg.vocab_size
     else:
