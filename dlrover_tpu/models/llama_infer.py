@@ -526,6 +526,37 @@ def generate_ragged(
     return out, lens
 
 
+def _spec_accept_round(
+    p: np.ndarray,  # [k+1, V] target probs at each speculated position
+    q: np.ndarray,  # [k, V] draft probs the proposals were drawn from
+    d: np.ndarray,  # [k] proposals
+    rng: "np.random.Generator",
+) -> Tuple[int, int]:
+    """Rejection-sampling acceptance (Leviathan et al.): accept the
+    i-th proposal with prob ``min(1, p_i[d_i] / q_i[d_i])``; on the
+    first rejection draw the replacement from the residual
+    ``norm(max(0, p_i - q_i))``; if all ``k`` survive, draw a bonus
+    token from ``p_{k+1}``.  Returns ``(j, next_token)`` — ``j``
+    accepted proposals plus the round's final token.  The emitted
+    sequence is distributed EXACTLY as sequential target sampling,
+    whatever the draft proposes (a bad draft only costs acceptance
+    rate, never correctness)."""
+    V = p.shape[1]
+    k = len(d)
+    for i in range(k):
+        di = int(d[i])
+        if rng.random() < p[i, di] / max(float(q[i, di]), 1e-30):
+            continue
+        resid = np.clip(p[i] - q[i], 0.0, None)
+        s = float(resid.sum())
+        if s <= 0.0:
+            # p == q to numerical precision: the residual is empty;
+            # any draw from p is distribution-correct.
+            resid, s = p[i], float(p[i].sum())
+        return i, int(rng.choice(V, p=resid / s))
+    return k, int(rng.choice(V, p=p[k] / float(p[k].sum())))
+
+
 def generate_speculative(
     params: Dict,
     cfg: LlamaConfig,
@@ -536,14 +567,19 @@ def generate_speculative(
     max_new_tokens: int,
     k: int = 4,
     quant_kv: bool = False,
+    temperature: float = 0.0,  # 0 = greedy; >0 = rejection sampling
+    rng: Optional[jax.Array] = None,
     stats: Optional[Dict] = None,  # out-param: rounds, tokens_per_round
 ) -> jax.Array:
-    """Greedy speculative decoding: a small DRAFT model proposes ``k``
+    """Speculative decoding: a small DRAFT model proposes ``k``
     tokens per round; the TARGET model scores all of them in ONE chunked
-    forward and accepts the longest matching prefix (+ its own next
-    token).  Output is EXACTLY the target model's greedy decode — the
-    draft only changes how many target forwards it takes — while each
-    accepted token costs the target 1/(j+1) of a sequential step's
+    forward.  At ``temperature=0`` the longest argmax-matching prefix
+    (+ the target's own next token) is accepted — output is EXACTLY the
+    target model's greedy decode.  At ``temperature>0`` proposals pass
+    through rejection sampling (:func:`_spec_accept_round`) — output is
+    distributed exactly as the target model's sampled decode.  Either
+    way the draft only changes how many target forwards it takes, and
+    each accepted token costs the target 1/(j+1) of a sequential step's
     dispatch + weight-read traffic (the speculative-decoding role of
     the serving engine the reference RL stack delegates to).
 
@@ -577,29 +613,60 @@ def generate_speculative(
         )
     if max_new_tokens == 0:
         return prompts
+    sample = temperature > 0.0
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    # Dedicated key for the host-side acceptance-coin stream (reusing
+    # ``rng`` itself would couple it to the proposal-sampling keys
+    # split from the same key below).
+    rng, seed_key = jax.random.split(rng)
+    np_rng = np.random.default_rng(
+        int(jax.random.randint(seed_key, (), 0, 2**31 - 1))
+    )
     max_len = P + max_new_tokens + k + 2  # + one overshooting round
     cache_t = init_cache(cfg, 1, max_len, quant_kv=quant_kv)
     cache_d = init_cache(draft_cfg, 1, max_len, quant_kv=quant_kv)
     logits, cache_t = forward_step(params, prompts, cfg, cache_t)
     _, cache_d = forward_step(draft_params, prompts, draft_cfg, cache_d)
-    cur = jnp.argmax(logits[:, -1, :], axis=-1).astype(prompts.dtype)
+    if sample:
+        first_p = np.asarray(
+            jax.nn.softmax(logits[0, -1, :] / temperature)
+        ).astype(np.float64)
+        first = int(np_rng.choice(
+            first_p.shape[0], p=first_p / first_p.sum()
+        ))
+        cur = jnp.asarray([first], prompts.dtype)
+    else:
+        cur = jnp.argmax(logits[:, -1, :], axis=-1).astype(prompts.dtype)
 
     @jax.jit
-    def draft_roll(dp, cache, tok):
-        def body(carry, _):
+    def draft_roll(dp, cache, tok, key):
+        # ``sample`` is a trace-time constant: the greedy trace emits
+        # (and returns) no [k, V] probs array at all.
+        def body(carry, sub):
             cache, tok = carry
             lg, cache = forward_step(dp, tok[:, None], draft_cfg, cache)
-            nxt = jnp.argmax(lg[:, -1, :], axis=-1).astype(tok.dtype)
+            lg1 = lg[:, -1, :]
+            if sample:
+                nxt = jax.random.categorical(
+                    sub, lg1 / temperature, axis=-1
+                ).astype(tok.dtype)
+                probs = jax.nn.softmax(lg1[0] / temperature)
+                return (cache, nxt), (nxt, probs)
+            nxt = jnp.argmax(lg1, axis=-1).astype(tok.dtype)
             return (cache, nxt), nxt
 
-        (cache, _), toks = jax.lax.scan(
-            body, (cache, tok), None, length=k
+        (cache, _), ys = jax.lax.scan(
+            body, (cache, tok), jax.random.split(key, k)
         )
-        return toks[:, 0], cache  # [k] proposals
+        toks, q = ys if sample else (ys, None)
+        return toks[:, 0], q, cache  # [k] proposals, [k, V] draft probs
 
     @jax.jit
     def target_verify(tp, cache, chunk):
         lg, cache = forward_step(tp, chunk, cfg, cache)
+        if sample:
+            return jax.nn.softmax(lg[0] / temperature, axis=-1), cache
         return jnp.argmax(lg[0], axis=-1).astype(chunk.dtype), cache
 
     @jax.jit
@@ -615,20 +682,28 @@ def generate_speculative(
     rounds = 0
     while len(out) < max_new_tokens:
         n = int(cache_t["offset"])  # accepted context in both caches
-        d, cache_d = draft_roll(draft_params, cache_d, cur)
+        rng, sub = jax.random.split(rng)
+        d, q, cache_d = draft_roll(draft_params, cache_d, cur, sub)
         # chunk = [cur, d_1..d_k]: target logits after each give the
-        # greedy continuation g_i at every speculated position.
+        # target's continuation law at every speculated position.
         chunk = jnp.concatenate(
             [cur[:, None], d[None, :]], axis=1
         )  # [1, k+1]
         g, cache_t = target_verify(params, cache_t, chunk)
         d_host = np.asarray(d)
-        g_host = np.asarray(g)
-        j = 0
-        while j < k and d_host[j] == g_host[j]:
-            j += 1
-        # Accept d_1..d_j then the target's own next token g_{j+1}.
-        accepted = list(d_host[:j]) + [g_host[j]]
+        if sample:
+            j, nxt = _spec_accept_round(
+                np.asarray(g, np.float64), np.asarray(q, np.float64),
+                d_host, np_rng,
+            )
+        else:
+            g_host = np.asarray(g)
+            j = 0
+            while j < k and d_host[j] == g_host[j]:
+                j += 1
+            nxt = int(g_host[j])
+        # Accept d_1..d_j then the round's final token.
+        accepted = list(d_host[:j]) + [nxt]
         out.extend(int(t) for t in accepted)
         # Rewind to the accepted context (slots past offset are masked
         # until overwritten).  The draft roll already wrote exactly the
@@ -647,7 +722,7 @@ def generate_speculative(
         else:
             cache_d = dict(cache_d, offset=jnp.asarray(new_n, jnp.int32))
         cache_t = dict(cache_t, offset=jnp.asarray(new_n, jnp.int32))
-        cur = jnp.asarray([g_host[j]], prompts.dtype)
+        cur = jnp.asarray([nxt], prompts.dtype)
         rounds += 1
     emitted = min(len(out), max_new_tokens)
     if stats is not None:

@@ -76,11 +76,6 @@ def main() -> int:
 
     t0 = time.perf_counter()
     if args.speculative:
-        if args.temperature != 0.0:
-            raise SystemExit(
-                "--speculative is greedy-only (the acceptance rule is "
-                "argmax equality); drop --temperature"
-            )
         import jax.numpy as jnp
 
         dcfg = llama.LlamaConfig.tiny(n_layer=args.draft_layers)
@@ -94,11 +89,14 @@ def main() -> int:
         draft = llama.init_params(jax.random.PRNGKey(7), dcfg)
         outs = []
         stats: dict = {}
+        key = jax.random.PRNGKey(args.seed)
         for p in prompts:
+            key, sub = jax.random.split(key)
             out = llama_infer.generate_speculative(
                 params, cfg, draft, dcfg, jnp.asarray(p)[None, :],
                 max_new_tokens=args.max_new_tokens,
                 quant_kv=args.quant_kv, stats=stats,
+                temperature=args.temperature, rng=sub,
             )
             outs.append(np.asarray(out[0]))
         mode = (f"speculative k=4 tokens/round="

@@ -693,3 +693,54 @@ class TestSpeculativeDecode:
             llama_infer.generate_speculative(
                 wparams, wcfg, wparams, wcfg, one, max_new_tokens=4
             )
+
+    def test_rejection_sampling_law(self):
+        """Monte-Carlo: whatever the draft distribution, the FIRST
+        emitted token of a round must be distributed as the target's
+        p[0] (the Leviathan et al. correctness property)."""
+        rng = np.random.default_rng(0)
+        V, k = 8, 3
+        # deliberately mismatched target/draft distributions
+        p = rng.dirichlet(np.ones(V), size=k + 1)
+        q = rng.dirichlet(np.ones(V) * 0.3, size=k)
+        N = 40000
+        counts = np.zeros(V)
+        for _ in range(N):
+            d = np.array([rng.choice(V, p=q[i]) for i in range(k)])
+            j, nxt = llama_infer._spec_accept_round(p, q, d, rng)
+            first = int(d[0]) if j >= 1 else nxt
+            counts[first] += 1
+        emp = counts / N
+        assert np.max(np.abs(emp - p[0])) < 0.015, (emp, p[0])
+
+    def test_sampled_speculative_runs_and_differs_by_seed(self):
+        cfg, params, prompts = self._target()
+        dparams = llama.init_params(jax.random.PRNGKey(9), cfg)
+        stats = {}
+        a = llama_infer.generate_speculative(
+            params, cfg, dparams, cfg, prompts, max_new_tokens=10,
+            k=3, temperature=1.0, rng=jax.random.PRNGKey(1),
+            stats=stats,
+        )
+        b = llama_infer.generate_speculative(
+            params, cfg, dparams, cfg, prompts, max_new_tokens=10,
+            k=3, temperature=1.0, rng=jax.random.PRNGKey(2),
+        )
+        assert a.shape == b.shape == (1, prompts.shape[1] + 10)
+        assert stats["rounds"] >= 1
+        assert (np.asarray(a) >= 0).all()
+        assert (np.asarray(a) < cfg.vocab_size).all()
+        # different seeds should draw different continuations
+        assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_same_model_sampled_draft_high_acceptance(self):
+        """Draft == target: p/q == 1 everywhere, so acceptance is
+        near-total and every round lands ~k+1 tokens."""
+        cfg, params, prompts = self._target()
+        stats = {}
+        llama_infer.generate_speculative(
+            params, cfg, params, cfg, prompts, max_new_tokens=12,
+            k=4, temperature=0.7, rng=jax.random.PRNGKey(3),
+            stats=stats,
+        )
+        assert stats["tokens_per_round"] > 3.5, stats
