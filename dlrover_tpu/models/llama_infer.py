@@ -323,6 +323,29 @@ def shard_params_for_decode(params: Dict, cfg: LlamaConfig, mesh):
     return sh.shard_tree(params, specs, mesh), specs
 
 
+def _filter_logits(scaled: jax.Array, top_k: int,
+                   top_p: float) -> jax.Array:
+    """[B, V] temperature-scaled logits -> same with everything outside
+    the top-k / top-p nucleus set to -inf (the top token always
+    survives)."""
+    if top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if top_p > 0.0:
+        # Nucleus: keep the smallest prefix of the sorted
+        # distribution whose mass reaches top_p.
+        srt = jnp.sort(scaled, axis=-1)[:, ::-1]  # descending
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = cum - probs < top_p
+        n_keep = jnp.maximum(1, jnp.sum(keep_sorted, axis=-1))
+        cutoff = jnp.take_along_axis(
+            srt, (n_keep - 1)[:, None], axis=-1
+        )
+        scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+    return scaled
+
+
 def _make_sampler(temperature: float, top_k: int, top_p: float):
     """(logits [B, V], rng) -> [B] token picker: greedy at T=0, else
     categorical with optional top-k truncation / top-p nucleus."""
@@ -330,24 +353,9 @@ def _make_sampler(temperature: float, top_k: int, top_p: float):
     def pick(logits_1, sub):
         if temperature <= 0.0:
             return jnp.argmax(logits_1, axis=-1)
-        scaled = logits_1 / temperature
-        if top_k > 0:
-            kth = jnp.sort(scaled, axis=-1)[:, -top_k, None]
-            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-        if top_p > 0.0:
-            # Nucleus: keep the smallest prefix of the sorted
-            # distribution whose mass reaches top_p (the top token
-            # always survives).
-            srt = jnp.sort(scaled, axis=-1)[:, ::-1]  # descending
-            probs = jax.nn.softmax(srt, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            keep_sorted = cum - probs < top_p
-            n_keep = jnp.maximum(1, jnp.sum(keep_sorted, axis=-1))
-            cutoff = jnp.take_along_axis(
-                srt, (n_keep - 1)[:, None], axis=-1
-            )
-            scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
-        return jax.random.categorical(sub, scaled)
+        return jax.random.categorical(
+            sub, _filter_logits(logits_1 / temperature, top_k, top_p)
+        )
 
     return pick
 
@@ -568,6 +576,8 @@ def generate_speculative(
     k: int = 4,
     quant_kv: bool = False,
     temperature: float = 0.0,  # 0 = greedy; >0 = rejection sampling
+    top_k: int = 0,
+    top_p: float = 0.0,
     rng: Optional[jax.Array] = None,
     stats: Optional[Dict] = None,  # out-param: rounds, tokens_per_round
 ) -> jax.Array:
@@ -582,6 +592,13 @@ def generate_speculative(
     each accepted token costs the target 1/(j+1) of a sequential step's
     dispatch + weight-read traffic (the speculative-decoding role of
     the serving engine the reference RL stack delegates to).
+
+    ``top_k``/``top_p`` apply the same truncation to BOTH the draft's
+    proposal distribution and the target's acceptance law, so the
+    output is distributed exactly as :func:`generate` with the same
+    knobs (rejection sampling is filter-agnostic: correctness needs
+    only that q is what proposals were drawn from and p is the law
+    being targeted).
 
     TPU shape: three fixed-shape jitted programs (draft k-step scan,
     draft (k+1)-token catch-up, target (k+1)-token verify) driven by a
@@ -628,16 +645,9 @@ def generate_speculative(
     cache_d = init_cache(draft_cfg, 1, max_len, quant_kv=quant_kv)
     logits, cache_t = forward_step(params, prompts, cfg, cache_t)
     _, cache_d = forward_step(draft_params, prompts, draft_cfg, cache_d)
-    if sample:
-        first_p = np.asarray(
-            jax.nn.softmax(logits[0, -1, :] / temperature)
-        ).astype(np.float64)
-        first = int(np_rng.choice(
-            first_p.shape[0], p=first_p / first_p.sum()
-        ))
-        cur = jnp.asarray([first], prompts.dtype)
-    else:
-        cur = jnp.argmax(logits[:, -1, :], axis=-1).astype(prompts.dtype)
+    pick = _make_sampler(temperature, top_k, top_p)
+    rng, first_key = jax.random.split(rng)
+    cur = pick(logits[:, -1, :], first_key).astype(prompts.dtype)
 
     @jax.jit
     def draft_roll(dp, cache, tok, key):
@@ -648,10 +658,11 @@ def generate_speculative(
             lg, cache = forward_step(dp, tok[:, None], draft_cfg, cache)
             lg1 = lg[:, -1, :]
             if sample:
+                filt = _filter_logits(lg1 / temperature, top_k, top_p)
                 nxt = jax.random.categorical(
-                    sub, lg1 / temperature, axis=-1
+                    sub, filt, axis=-1
                 ).astype(tok.dtype)
-                probs = jax.nn.softmax(lg1[0] / temperature)
+                probs = jax.nn.softmax(filt[0])
                 return (cache, nxt), (nxt, probs)
             nxt = jnp.argmax(lg1, axis=-1).astype(tok.dtype)
             return (cache, nxt), nxt
@@ -666,7 +677,8 @@ def generate_speculative(
     def target_verify(tp, cache, chunk):
         lg, cache = forward_step(tp, chunk, cfg, cache)
         if sample:
-            return jax.nn.softmax(lg[0] / temperature, axis=-1), cache
+            filt = _filter_logits(lg[0] / temperature, top_k, top_p)
+            return jax.nn.softmax(filt, axis=-1), cache
         return jnp.argmax(lg[0], axis=-1).astype(chunk.dtype), cache
 
     @jax.jit
@@ -680,9 +692,13 @@ def generate_speculative(
 
     out = [int(cur[0])]
     rounds = 0
+    greedy_key = jax.random.PRNGKey(0)  # dead in the greedy trace
     while len(out) < max_new_tokens:
         n = int(cache_t["offset"])  # accepted context in both caches
-        rng, sub = jax.random.split(rng)
+        if sample:
+            rng, sub = jax.random.split(rng)
+        else:
+            sub = greedy_key
         d, q, cache_d = draft_roll(draft_params, cache_d, cur, sub)
         # chunk = [cur, d_1..d_k]: target logits after each give the
         # target's continuation law at every speculated position.

@@ -81,17 +81,19 @@ def main() -> int:
         dcfg = llama.LlamaConfig.tiny(n_layer=args.draft_layers)
         if args.hf_dir:
             # A real deployment would load a small checkpoint here; the
-            # example drafts with a random model (acceptance suffers,
-            # output is still exactly the target's greedy decode).
+            # example drafts with a random model (acceptance suffers —
+            # but the output law is still exactly the target model's
+            # greedy/sampled decode; a bad draft only costs speed).
             dcfg = llama.LlamaConfig(**{
                 **cfg.__dict__, "n_layer": args.draft_layers
             })
         draft = llama.init_params(jax.random.PRNGKey(7), dcfg)
         outs = []
-        stats: dict = {}
+        tot_rounds, tot_toks = 0, 0
         key = jax.random.PRNGKey(args.seed)
         for p in prompts:
             key, sub = jax.random.split(key)
+            stats: dict = {}
             out = llama_infer.generate_speculative(
                 params, cfg, draft, dcfg, jnp.asarray(p)[None, :],
                 max_new_tokens=args.max_new_tokens,
@@ -99,8 +101,12 @@ def main() -> int:
                 temperature=args.temperature, rng=sub,
             )
             outs.append(np.asarray(out[0]))
+            tot_rounds += stats.get("rounds", 0)
+            tot_toks += stats.get("rounds", 0) * stats.get(
+                "tokens_per_round", 0.0
+            )
         mode = (f"speculative k=4 tokens/round="
-                f"{stats.get('tokens_per_round', 0):.2f}")
+                f"{tot_toks / max(tot_rounds, 1):.2f}")
     else:
         srv = llama_infer.DecodeServer(
             params, cfg, slots=args.slots,

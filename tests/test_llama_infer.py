@@ -744,3 +744,18 @@ class TestSpeculativeDecode:
             stats=stats,
         )
         assert stats["tokens_per_round"] > 3.5, stats
+
+    def test_speculative_top_k_one_is_greedy(self):
+        """top_k=1 truncation at any temperature collapses both the
+        proposal and acceptance laws to argmax — speculative sampled
+        output must equal the plain greedy decode exactly."""
+        cfg, params, prompts = self._target()
+        dparams = llama.init_params(jax.random.PRNGKey(9), cfg)
+        ref = llama_infer.generate(
+            params, cfg, prompts, max_new_tokens=8
+        )
+        got = llama_infer.generate_speculative(
+            params, cfg, dparams, cfg, prompts, max_new_tokens=8,
+            k=3, temperature=1.0, top_k=1, rng=jax.random.PRNGKey(4),
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
