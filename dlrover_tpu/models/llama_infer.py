@@ -578,6 +578,7 @@ def generate_speculative(
     temperature: float = 0.0,  # 0 = greedy; >0 = rejection sampling
     top_k: int = 0,
     top_p: float = 0.0,
+    eos_token: int = -1,  # >=0: stop after emitting this token
     rng: Optional[jax.Array] = None,
     stats: Optional[Dict] = None,  # out-param: rounds, tokens_per_round
 ) -> jax.Array:
@@ -599,6 +600,11 @@ def generate_speculative(
     knobs (rejection sampling is filter-agnostic: correctness needs
     only that q is what proposals were drawn from and p is the law
     being targeted).
+
+    ``eos_token >= 0`` stops at the first EOS: the result is then
+    [1, P + n] with n <= max_new_tokens, ending at the EOS (variable
+    length — this is a host-driven serving loop, not a fixed-shape
+    jitted program).
 
     TPU shape: three fixed-shape jitted programs (draft k-step scan,
     draft (k+1)-token catch-up, target (k+1)-token verify) driven by a
@@ -692,8 +698,9 @@ def generate_speculative(
 
     out = [int(cur[0])]
     rounds = 0
+    done = eos_token >= 0 and out[0] == eos_token
     greedy_key = jax.random.PRNGKey(0)  # dead in the greedy trace
-    while len(out) < max_new_tokens:
+    while len(out) < max_new_tokens and not done:
         n = int(cache_t["offset"])  # accepted context in both caches
         if sample:
             rng, sub = jax.random.split(rng)
@@ -718,8 +725,18 @@ def generate_speculative(
             while j < k and d_host[j] == g_host[j]:
                 j += 1
             nxt = int(g_host[j])
-        # Accept d_1..d_j then the round's final token.
+        # Accept d_1..d_j then the round's final token — truncated at
+        # the first EOS (tokens "accepted" past an EOS are artifacts of
+        # the fixed-k round; the sequence ends at the EOS).
         accepted = list(d_host[:j]) + [nxt]
+        if eos_token >= 0:
+            for i, t in enumerate(accepted):
+                if int(t) == eos_token:
+                    accepted = accepted[: i + 1]
+                    done = True
+                    # Rewind bookkeeping below must match what we kept.
+                    j = min(j, i)
+                    break
         out.extend(int(t) for t in accepted)
         # Rewind to the accepted context (slots past offset are masked
         # until overwritten).  The draft roll already wrote exactly the

@@ -759,3 +759,24 @@ class TestSpeculativeDecode:
             k=3, temperature=1.0, top_k=1, rng=jax.random.PRNGKey(4),
         )
         np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_speculative_eos_stops_and_matches_greedy_prefix(self):
+        """EOS in the greedy stream ends the speculative output at the
+        same position greedy generate() emits it."""
+        cfg, params, prompts = self._target()
+        dparams = llama.init_params(jax.random.PRNGKey(9), cfg)
+        N = 14
+        ref = np.asarray(llama_infer.generate(
+            params, cfg, prompts, max_new_tokens=N
+        ))[0]
+        gen_part = ref[prompts.shape[1]:]
+        # Pick an EOS token that actually occurs mid-stream.
+        eos = int(gen_part[len(gen_part) // 2])
+        first_at = int(np.argmax(gen_part == eos))
+        got = np.asarray(llama_infer.generate_speculative(
+            params, cfg, dparams, cfg, prompts, max_new_tokens=N,
+            k=3, eos_token=eos,
+        ))[0]
+        expect = ref[: prompts.shape[1] + first_at + 1]
+        np.testing.assert_array_equal(got, expect)
+        assert got[-1] == eos
