@@ -92,8 +92,9 @@ def _cached_attention(x, layer, cfg, cache_layer, offset, positions,
     """x: [B, T, C] new tokens; attends to cache[:offset] + itself.
 
     ``offset`` may be a scalar (all sequences aligned) or a [B] vector
-    (ragged batch, T must be 1): each sequence writes its token at its
-    OWN slot and masks causally against its own position.
+    (ragged batch): each sequence writes its T-token chunk at its OWN
+    slots ``offset[b]..offset[b]+T-1`` and masks causally against its
+    own positions.
 
     ``slot_pos`` (ring mode, sliding-window models): the ALREADY-updated
     per-slot absolute positions; writes wrap modulo the buffer length
@@ -115,11 +116,21 @@ def _cached_attention(x, layer, cfg, cache_layer, offset, positions,
         the code arrays and (in quant mode) their scale arrays so the
         three write modes are spelled once."""
         if jnp.ndim(offset) == 1:
-            # Ragged decode: sequence b's token lands at ITS slot
-            # offset[b] (one batched scatter; positions == slot
-            # indices, so the standard kpos <= qpos mask below stays
-            # correct per row).
-            return cur.at[jnp.arange(B), :, offset].set(new[:, :, 0])
+            # Ragged mode: sequence b's chunk lands at ITS slots
+            # offset[b]..offset[b]+T-1 (one batched scatter; positions
+            # == slot indices, so the standard kpos <= qpos mask below
+            # stays correct per row).
+            if T == 1:
+                return cur.at[jnp.arange(B), :, offset].set(
+                    new[:, :, 0]
+                )
+            b_idx = jnp.arange(B)[:, None]  # [B, 1]
+            slots = offset[:, None] + jnp.arange(T)[None, :]  # [B, T]
+            # new is [B, KV, T, ...]; index (b, t) pairs over the slot
+            # axis with KV broadcast.
+            return cur.at[b_idx, :, slots].set(
+                jnp.moveaxis(new, 2, 1)  # [B, T, KV, ...]
+            )
         if slot_pos is not None:
             ring_slots = slot_pos[0]
             if T == 1:
@@ -228,21 +239,20 @@ def forward_step(
     offset = cache["offset"]
     x = params["embed"].astype(dt)[tokens]
     if jnp.ndim(offset) == 1:
-        # Ragged batch: per-sequence write slots/positions (decode-only;
-        # ragged PREFILL needs no special handling — pad tokens written
-        # at their slot positions are causally invisible to every later
-        # real query).
-        if T != 1:
-            raise ValueError(
-                "per-sequence cache offsets support single-token decode "
-                f"steps only, got a chunk of {T}"
-            )
+        # Ragged batch: per-sequence write slots/positions — T=1 is the
+        # decode hot path; T>1 scores a chunk continuing each row at
+        # its OWN offset (batched speculative verify, chunked ragged
+        # continuation).  (Ragged PREFILL from zero needs no special
+        # handling — pad tokens written at their slot positions are
+        # causally invisible to every later real query.)
         if "pos" in cache:
             raise ValueError(
                 "ragged offsets are not supported with the sliding-"
                 "window ring cache"
             )
-        positions = offset[:, None]
+        positions = offset[:, None] + jnp.broadcast_to(
+            jnp.arange(T), (B, T)
+        )
     else:
         positions = offset + jnp.broadcast_to(jnp.arange(T), (B, T))
     no_drop_capacity = B * T * cfg.top_k
@@ -582,83 +592,119 @@ def generate_speculative(
     rng: Optional[jax.Array] = None,
     stats: Optional[Dict] = None,  # out-param: rounds, tokens_per_round
 ) -> jax.Array:
-    """Speculative decoding: a small DRAFT model proposes ``k``
-    tokens per round; the TARGET model scores all of them in ONE chunked
-    forward.  At ``temperature=0`` the longest argmax-matching prefix
-    (+ the target's own next token) is accepted — output is EXACTLY the
-    target model's greedy decode.  At ``temperature>0`` proposals pass
-    through rejection sampling (:func:`_spec_accept_round`) — output is
-    distributed exactly as the target model's sampled decode.  Either
-    way the draft only changes how many target forwards it takes, and
-    each accepted token costs the target 1/(j+1) of a sequential step's
-    dispatch + weight-read traffic (the speculative-decoding role of
+    """Single-stream speculative decoding: a small DRAFT model proposes
+    ``k`` tokens per round; the TARGET model scores all of them in ONE
+    chunked forward.  At ``temperature=0`` the longest argmax-matching
+    prefix (+ the target's own next token) is accepted — output is
+    EXACTLY the target model's greedy decode.  At ``temperature>0``
+    proposals pass through rejection sampling
+    (:func:`_spec_accept_round`) — output is distributed exactly as the
+    target model's sampled decode.  Either way the draft only changes
+    how many target forwards it takes (the speculative-decoding role of
     the serving engine the reference RL stack delegates to).
 
-    ``top_k``/``top_p`` apply the same truncation to BOTH the draft's
-    proposal distribution and the target's acceptance law, so the
-    output is distributed exactly as :func:`generate` with the same
-    knobs (rejection sampling is filter-agnostic: correctness needs
-    only that q is what proposals were drawn from and p is the law
-    being targeted).
+    The machinery lives in :func:`generate_speculative_batched` (this
+    is its B=1 case); see there for the cache-rewind design, the
+    filtered-law guarantee for ``top_k``/``top_p``, and the numerics
+    caveat on chunked-vs-incremental scoring.
 
     ``eos_token >= 0`` stops at the first EOS: the result is then
     [1, P + n] with n <= max_new_tokens, ending at the EOS (variable
     length — this is a host-driven serving loop, not a fixed-shape
-    jitted program).
-
-    TPU shape: three fixed-shape jitted programs (draft k-step scan,
-    draft (k+1)-token catch-up, target (k+1)-token verify) driven by a
-    host loop.  Cache bookkeeping rides the DENSE cache's slot-index
-    masking: slots past ``offset`` are invisible, so rejecting a
-    speculated suffix is just rewinding ``offset`` — the stale slots
-    are overwritten by the next round's writes.
-
-    Single-sequence only (``B == 1``): per-row acceptance lengths would
-    need ragged multi-token cache offsets.  Sliding-window ring caches
-    are not supported (ring slots are position-mapped, not
-    offset-masked, so rewind would not hide stale writes).
-
-    Numerics: "exactly greedy" holds where the (k+1)-token verify
-    forward is numerically equivalent to the T=1 decode step (fp32, or
-    comfortably-separated top logits).  In bf16 a near-tie between the
-    top two logits can resolve differently under the chunked matmul's
-    tiling and the sequences legitimately diverge there — same caveat
-    as any chunked-vs-incremental scoring on real accelerators."""
+    jitted program)."""
     B, P = prompts.shape
     if B != 1:
         raise ValueError(
-            f"speculative decode is single-sequence (got batch {B})"
+            f"speculative decode is single-sequence (got batch {B}); "
+            "use generate_speculative_batched for ragged batches"
         )
+    if max_new_tokens == 0:
+        return prompts
+    out, lens = generate_speculative_batched(
+        params, cfg, draft_params, draft_cfg, prompts,
+        jnp.asarray([P], jnp.int32),
+        max_new_tokens=max_new_tokens, k=k, quant_kv=quant_kv,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_token=eos_token, rng=rng, stats=stats,
+    )
+    return out[:, : int(lens[0])]
+
+
+def generate_speculative_batched(
+    params: Dict,
+    cfg: LlamaConfig,
+    draft_params: Dict,
+    draft_cfg: LlamaConfig,
+    prompts: jax.Array,  # [B, P] right-padded prompts
+    prompt_lens: jax.Array,  # [B] true prompt lengths
+    *,
+    max_new_tokens: int,
+    k: int = 4,
+    quant_kv: bool = False,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    eos_token: int = -1,
+    pad_token: int = 0,
+    rng: Optional[jax.Array] = None,
+    stats: Optional[Dict] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Batched speculative decoding over a RAGGED batch: every row
+    drafts ``k`` proposals, ONE (k+1)-token ragged verify scores all
+    rows at their own offsets, and acceptance is per-row — combining
+    :func:`generate_speculative`'s draft/verify economics with
+    :func:`generate_ragged`'s per-sequence lengths and EOS exit (the
+    batched speculative mode of the serving engine the reference RL
+    stack delegates to).
+
+    Output contract matches :func:`generate_ragged`: ``(tokens
+    [B, P + max_new_tokens], lengths [B])``, row b = prompt then
+    continuation then ``pad_token``.  The output law per row equals
+    :func:`generate` with the same sampling knobs (greedy exactness at
+    ``temperature=0``; rejection sampling otherwise).
+
+    Cache bookkeeping is the per-row generalization of the
+    single-stream version: rejection rewinds that row's offset (dense-
+    cache slot masking hides its stale writes); rows that accepted all
+    ``k`` get their missing ``d_k`` kv written by a batched 1-token
+    catch-up whose other rows write their next token's kv early
+    (harmless — the next roll rewrites the same value).  Finished rows
+    freeze their offset and ride along masked."""
+    B, P = prompts.shape
     if cfg.sliding_window > 0 or draft_cfg.sliding_window > 0:
         raise ValueError(
             "speculative decode does not support sliding-window ring "
             "caches (offset rewind cannot hide stale ring writes)"
         )
-    if max_new_tokens == 0:
-        return prompts
+    N = max_new_tokens
+    prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+    if N == 0:
+        return prompts, prompt_lens
     sample = temperature > 0.0
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    # Dedicated key for the host-side acceptance-coin stream (reusing
-    # ``rng`` itself would couple it to the proposal-sampling keys
-    # split from the same key below).
     rng, seed_key = jax.random.split(rng)
     np_rng = np.random.default_rng(
         int(jax.random.randint(seed_key, (), 0, 2**31 - 1))
     )
-    max_len = P + max_new_tokens + k + 2  # + one overshooting round
-    cache_t = init_cache(cfg, 1, max_len, quant_kv=quant_kv)
-    cache_d = init_cache(draft_cfg, 1, max_len, quant_kv=quant_kv)
+    max_len = P + N + k + 2
+    cache_t = init_cache(cfg, B, max_len, quant_kv=quant_kv)
+    cache_d = init_cache(draft_cfg, B, max_len, quant_kv=quant_kv)
     logits, cache_t = forward_step(params, prompts, cfg, cache_t)
     _, cache_d = forward_step(draft_params, prompts, draft_cfg, cache_d)
     pick = _make_sampler(temperature, top_k, top_p)
+    last = jnp.take_along_axis(
+        logits, (prompt_lens - 1)[:, None, None], axis=1
+    )[:, 0, :]
     rng, first_key = jax.random.split(rng)
-    cur = pick(logits[:, -1, :], first_key).astype(prompts.dtype)
+    cur = pick(last, first_key).astype(prompts.dtype)
+    # Per-row ragged offsets: each row continues at its true length.
+    off = prompt_lens
+    cache_t = dict(cache_t, offset=off)
+    cache_d = dict(cache_d, offset=off)
 
     @jax.jit
     def draft_roll(dp, cache, tok, key):
-        # ``sample`` is a trace-time constant: the greedy trace emits
-        # (and returns) no [k, V] probs array at all.
         def body(carry, sub):
             cache, tok = carry
             lg, cache = forward_step(dp, tok[:, None], draft_cfg, cache)
@@ -668,7 +714,7 @@ def generate_speculative(
                 nxt = jax.random.categorical(
                     sub, filt, axis=-1
                 ).astype(tok.dtype)
-                probs = jax.nn.softmax(filt[0])
+                probs = jax.nn.softmax(filt, axis=-1)  # [B, V]
                 return (cache, nxt), (nxt, probs)
             nxt = jnp.argmax(lg1, axis=-1).astype(tok.dtype)
             return (cache, nxt), nxt
@@ -677,96 +723,133 @@ def generate_speculative(
             body, (cache, tok), jax.random.split(key, k)
         )
         toks, q = ys if sample else (ys, None)
-        return toks[:, 0], q, cache  # [k] proposals, [k, V] draft probs
+        # toks [k, B] -> [B, k]; q [k, B, V] -> [B, k, V]
+        return (
+            jnp.moveaxis(toks, 0, 1),
+            None if q is None else jnp.moveaxis(q, 0, 1),
+            cache,
+        )
 
     @jax.jit
     def target_verify(tp, cache, chunk):
         lg, cache = forward_step(tp, chunk, cfg, cache)
         if sample:
-            filt = _filter_logits(lg[0] / temperature, top_k, top_p)
-            return jax.nn.softmax(filt, axis=-1), cache
-        return jnp.argmax(lg[0], axis=-1).astype(chunk.dtype), cache
+            filt = _filter_logits(
+                lg.reshape(-1, lg.shape[-1]) / temperature, top_k, top_p
+            ).reshape(lg.shape)
+            return jax.nn.softmax(filt, axis=-1), cache  # [B, k+1, V]
+        return jnp.argmax(lg, axis=-1).astype(chunk.dtype), cache
 
     @jax.jit
-    def draft_write_one(dp, cache, tok):
-        # KV-write of one accepted token into the draft cache (logits
-        # discarded) — only needed on FULL acceptance, when the last
-        # proposal d_k entered the context but draft_roll never wrote
-        # its kv (the roll writes each step's INPUT, i.e. cur..d_{k-1}).
+    def draft_catch_up(dp, cache, tok):
         _, cache = forward_step(dp, tok[:, None], draft_cfg, cache)
         return cache
 
-    out = [int(cur[0])]
+    buf = np.full((B, N), pad_token, dtype=np.asarray(prompts).dtype)
+    emitted = np.zeros(B, np.int64)
+    done = np.zeros(B, bool)
+    cur_h = np.asarray(cur)
+    if eos_token >= 0:
+        hit = cur_h == eos_token
+    else:
+        hit = np.zeros(B, bool)
+    for b in range(B):
+        buf[b, 0] = cur_h[b]
+    emitted[:] = 1
+    done |= hit
     rounds = 0
-    done = eos_token >= 0 and out[0] == eos_token
     greedy_key = jax.random.PRNGKey(0)  # dead in the greedy trace
-    while len(out) < max_new_tokens and not done:
-        n = int(cache_t["offset"])  # accepted context in both caches
+    while not done.all() and (emitted < N).any():
+        n = np.asarray(cache_t["offset"])  # [B]
         if sample:
             rng, sub = jax.random.split(rng)
         else:
             sub = greedy_key
         d, q, cache_d = draft_roll(draft_params, cache_d, cur, sub)
-        # chunk = [cur, d_1..d_k]: target logits after each give the
-        # target's continuation law at every speculated position.
-        chunk = jnp.concatenate(
-            [cur[:, None], d[None, :]], axis=1
-        )  # [1, k+1]
+        chunk = jnp.concatenate([cur[:, None], d], axis=1)  # [B, k+1]
         g, cache_t = target_verify(params, cache_t, chunk)
         d_host = np.asarray(d)
+        j = np.zeros(B, np.int64)
+        nxt = np.asarray(cur).copy()
         if sample:
-            j, nxt = _spec_accept_round(
-                np.asarray(g, np.float64), np.asarray(q, np.float64),
-                d_host, np_rng,
-            )
+            g_host = np.asarray(g, np.float64)  # [B, k+1, V]
+            q_host = np.asarray(q, np.float64)  # [B, k, V]
+            for b in range(B):
+                if done[b]:
+                    continue
+                j[b], nxt[b] = _spec_accept_round(
+                    g_host[b], q_host[b], d_host[b], np_rng
+                )
         else:
-            g_host = np.asarray(g)
-            j = 0
-            while j < k and d_host[j] == g_host[j]:
-                j += 1
-            nxt = int(g_host[j])
-        # Accept d_1..d_j then the round's final token — truncated at
-        # the first EOS (tokens "accepted" past an EOS are artifacts of
-        # the fixed-k round; the sequence ends at the EOS).
-        accepted = list(d_host[:j]) + [nxt]
-        if eos_token >= 0:
-            for i, t in enumerate(accepted):
-                if int(t) == eos_token:
-                    accepted = accepted[: i + 1]
-                    done = True
-                    # Rewind bookkeeping below must match what we kept.
-                    j = min(j, i)
-                    break
-        out.extend(int(t) for t in accepted)
-        # Rewind to the accepted context (slots past offset are masked
-        # until overwritten).  The draft roll already wrote exactly the
-        # accepted slots n..n+j (its inputs were cur, d_1..d_{j-1}, and
-        # slot values match the proposals), so no replay is needed —
-        # except on full acceptance, where d_k's kv is still missing.
-        new_n = n + 1 + j  # cur + d_1..d_j now in-context
-        if j == k:
+            g_host = np.asarray(g)  # [B, k+1]
+            for b in range(B):
+                if done[b]:
+                    continue
+                while j[b] < k and d_host[b, j[b]] == g_host[b, j[b]]:
+                    j[b] += 1
+                nxt[b] = g_host[b, j[b]]
+        # Emit per row (truncated at EOS and at the N budget).
+        new_done = done.copy()
+        for b in range(B):
+            if done[b]:
+                continue
+            accepted = list(d_host[b, : j[b]]) + [nxt[b]]
+            if eos_token >= 0:
+                for i, t in enumerate(accepted):
+                    if int(t) == eos_token:
+                        accepted = accepted[: i + 1]
+                        j[b] = min(j[b], i)
+                        new_done[b] = True
+                        break
+            room = N - int(emitted[b])
+            if len(accepted) >= room:
+                accepted = accepted[:room]
+                j[b] = min(j[b], max(len(accepted) - 1, 0))
+                new_done[b] = True
+            for t in accepted:
+                buf[b, emitted[b]] = t
+                emitted[b] += 1
+        # Per-row rewind; finished rows freeze at their old offset.
+        new_n = np.where(done, n, n + 1 + j)
+        full = (~done) & (j == k)
+        if full.any():
+            # Batched 1-token catch-up: full-acceptance rows write the
+            # missing d_k at slot n+k; everyone else harmlessly writes
+            # its next token's kv at its own next slot.
+            tok_cu = np.where(full, d_host[:, k - 1], nxt).astype(
+                cur_h.dtype
+            )
+            pos_cu = np.where(full, n + k, new_n)
             cache_d = dict(
-                cache_d, offset=jnp.asarray(new_n - 1, jnp.int32)
+                cache_d, offset=jnp.asarray(pos_cu, jnp.int32)
             )
-            cache_d = draft_write_one(
-                draft_params, cache_d,
-                jnp.asarray([d_host[k - 1]], prompts.dtype),
+            cache_d = draft_catch_up(
+                draft_params, cache_d, jnp.asarray(tok_cu)
             )
-        else:
-            cache_d = dict(cache_d, offset=jnp.asarray(new_n, jnp.int32))
+        cache_d = dict(cache_d, offset=jnp.asarray(new_n, jnp.int32))
         cache_t = dict(cache_t, offset=jnp.asarray(new_n, jnp.int32))
-        cur = jnp.asarray([nxt], prompts.dtype)
+        done = new_done
+        cur_h = nxt
+        cur = jnp.asarray(nxt)
         rounds += 1
-    emitted = min(len(out), max_new_tokens)
     if stats is not None:
-        # Accepted tokens per verify round (the prefill's first token
-        # costs no round); the acceptance-rate signal for tuning k.
         stats["rounds"] = rounds
         stats["tokens_per_round"] = (
-            (emitted - 1) / rounds if rounds else 0.0
+            float(emitted.sum() - B) / (rounds * B) if rounds else 0.0
         )
-    toks = jnp.asarray(out[:max_new_tokens], prompts.dtype)
-    return jnp.concatenate([prompts, toks[None, :]], axis=1)
+    # Assemble the generate_ragged output contract.
+    full_buf = np.full((B, P + N), pad_token, buf.dtype)
+    prompts_h = np.asarray(prompts)
+    lens = np.zeros(B, np.int64)
+    pl = np.asarray(prompt_lens)
+    for b in range(B):
+        full_buf[b, : pl[b]] = prompts_h[b, : pl[b]]
+        full_buf[b, pl[b]: pl[b] + emitted[b]] = buf[b, : emitted[b]]
+        lens[b] = pl[b] + emitted[b]
+    return (
+        jnp.asarray(full_buf),
+        jnp.asarray(lens, jnp.int32),
+    )
 
 
 class DecodeServer:

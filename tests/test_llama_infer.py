@@ -780,3 +780,143 @@ class TestSpeculativeDecode:
         expect = ref[: prompts.shape[1] + first_at + 1]
         np.testing.assert_array_equal(got, expect)
         assert got[-1] == eos
+
+
+class TestRaggedChunkScoring:
+    def test_ragged_multi_token_chunk_matches_per_token_loop(self):
+        """A T>1 chunk scored at per-row offsets must produce exactly
+        the logits of stepping the same tokens one at a time (the
+        primitive batched speculative verify needs)."""
+        cfg = llama.LlamaConfig.tiny(n_layer=2, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        B, P, T = 2, 6, 3
+        prompts = jax.random.randint(
+            jax.random.PRNGKey(1), (B, P), 0, cfg.vocab_size
+        )
+        lens = jnp.asarray([4, 6], jnp.int32)  # ragged true lengths
+        chunk = jax.random.randint(
+            jax.random.PRNGKey(2), (B, T), 0, cfg.vocab_size
+        )
+
+        def fresh_cache():
+            c = llama_infer.init_cache(cfg, B, P + T + 2)
+            _, c = llama_infer.forward_step(params, prompts, cfg, c)
+            return dict(c, offset=lens)
+
+        # chunked: one T-token ragged forward
+        chunk_logits, chunk_cache = llama_infer.forward_step(
+            params, chunk, cfg, fresh_cache()
+        )
+        # reference: the same tokens one at a time
+        ref_cache = fresh_cache()
+        ref_logits = []
+        for t in range(T):
+            lg, ref_cache = llama_infer.forward_step(
+                params, chunk[:, t:t + 1], cfg, ref_cache
+            )
+            ref_logits.append(lg[:, 0])
+        ref = jnp.stack(ref_logits, axis=1)
+        np.testing.assert_allclose(
+            np.asarray(chunk_logits), np.asarray(ref), atol=2e-4
+        )
+        np.testing.assert_array_equal(
+            np.asarray(chunk_cache["offset"]), np.asarray(lens + T)
+        )
+
+    def test_ragged_chunk_int8_cache(self):
+        """The T>1 ragged write keeps codes and scales in lockstep."""
+        cfg = llama.LlamaConfig.tiny(n_layer=2, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        B, P, T = 2, 6, 3
+        prompts = jax.random.randint(
+            jax.random.PRNGKey(1), (B, P), 0, cfg.vocab_size
+        )
+        lens = jnp.asarray([4, 6], jnp.int32)
+        chunk = jax.random.randint(
+            jax.random.PRNGKey(2), (B, T), 0, cfg.vocab_size
+        )
+        dense = llama_infer.init_cache(cfg, B, P + T + 2)
+        quant = llama_infer.init_cache(cfg, B, P + T + 2, quant_kv=True)
+        _, dense = llama_infer.forward_step(params, prompts, cfg, dense)
+        _, quant = llama_infer.forward_step(params, prompts, cfg, quant)
+        ld, _ = llama_infer.forward_step(
+            params, chunk, cfg, dict(dense, offset=lens)
+        )
+        lq, _ = llama_infer.forward_step(
+            params, chunk, cfg, dict(quant, offset=lens)
+        )
+        span = float(np.max(np.abs(np.asarray(ld)))) + 1e-6
+        assert float(np.max(np.abs(np.asarray(lq - ld)))) / span < 0.08
+
+
+class TestBatchedSpeculative:
+    def _setup(self):
+        cfg = llama.LlamaConfig.tiny(n_layer=2, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        draft = llama.init_params(jax.random.PRNGKey(9), cfg)
+        prompts = np.zeros((3, 7), np.int32)
+        lens = np.asarray([4, 7, 5], np.int32)
+        r = np.random.RandomState(0)
+        for b in range(3):
+            prompts[b, : lens[b]] = r.randint(1, cfg.vocab_size,
+                                              size=(lens[b],))
+        return cfg, params, draft, jnp.asarray(prompts), jnp.asarray(lens)
+
+    def test_batched_greedy_matches_per_row_solo(self):
+        cfg, params, draft, prompts, lens = self._setup()
+        N = 9
+        out, out_lens = llama_infer.generate_speculative_batched(
+            params, cfg, draft, cfg, prompts, lens,
+            max_new_tokens=N, k=3,
+        )
+        out = np.asarray(out)
+        for b in range(prompts.shape[0]):
+            solo = np.asarray(llama_infer.generate(
+                params, cfg, prompts[b: b + 1, : int(lens[b])],
+                max_new_tokens=N,
+            ))[0]
+            np.testing.assert_array_equal(
+                out[b, : int(lens[b]) + N], solo
+            )
+            assert int(out_lens[b]) == int(lens[b]) + N
+
+    def test_batched_eos_stops_rows_independently(self):
+        cfg, params, draft, prompts, lens = self._setup()
+        N = 12
+        # find each row's greedy stream and choose row 0's 3rd token as
+        # the shared EOS so different rows stop at different places.
+        solo0 = np.asarray(llama_infer.generate(
+            params, cfg, prompts[0:1, : int(lens[0])], max_new_tokens=N
+        ))[0][int(lens[0]):]
+        eos = int(solo0[2])
+        out, out_lens = llama_infer.generate_speculative_batched(
+            params, cfg, draft, cfg, prompts, lens,
+            max_new_tokens=N, k=3, eos_token=eos,
+        )
+        out = np.asarray(out)
+        for b in range(prompts.shape[0]):
+            solo = np.asarray(llama_infer.generate(
+                params, cfg, prompts[b: b + 1, : int(lens[b])],
+                max_new_tokens=N,
+            ))[0][int(lens[b]):]
+            stop = np.argmax(solo == eos) + 1 if (solo == eos).any() \
+                else N
+            got_gen = out[b, int(lens[b]): int(out_lens[b])]
+            np.testing.assert_array_equal(got_gen, solo[:stop])
+        # row 0 definitely stopped early at its 3rd token
+        assert int(out_lens[0]) == int(lens[0]) + 3
+
+    def test_batched_sampled_and_quant_smoke(self):
+        cfg, params, draft, prompts, lens = self._setup()
+        stats = {}
+        out, out_lens = llama_infer.generate_speculative_batched(
+            params, cfg, draft, cfg, prompts, lens,
+            max_new_tokens=8, k=3, temperature=0.9, quant_kv=True,
+            rng=jax.random.PRNGKey(5), stats=stats,
+        )
+        assert out.shape == (3, prompts.shape[1] + 8)
+        assert stats["rounds"] >= 1
+        for b in range(3):
+            assert int(out_lens[b]) == int(lens[b]) + 8
+            row = np.asarray(out[b])
+            assert (row[: int(out_lens[b])] < cfg.vocab_size).all()
