@@ -389,6 +389,28 @@ class TestCachedRollout:
             np.asarray(out[:, :5]), np.asarray(prompts)
         )
 
+    def test_cached_generate_speculative_rollout(self):
+        """draft=(params, cfg) routes rollouts through batched
+        speculative decoding; greedy law must match the plain cached
+        rollout exactly."""
+        from dlrover_tpu.rl.engine import llama_cached_generate
+
+        from dlrover_tpu.models import llama as llama_mod
+
+        cfg, params = self._llama()
+        draft_params = llama_mod.init_params(jax.random.PRNGKey(5), cfg)
+        pcfg = PPOConfig(response_length=6, temperature=0.0)
+        plain = llama_cached_generate(cfg, pcfg)
+        spec = llama_cached_generate(
+            cfg, pcfg, draft=(draft_params, cfg), draft_k=3
+        )
+        prompts = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 5))
+        )
+        a = plain(params, prompts, jax.random.PRNGKey(0))
+        b = spec(params, prompts, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
     def test_engine_uses_cached_decoder_and_matches_greedy(self):
         from dlrover_tpu.models import llama
         from dlrover_tpu.rl.engine import llama_cached_generate
