@@ -15,6 +15,8 @@ O(S^2)-per-token full recompute.
 
 from __future__ import annotations
 
+import functools
+
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -630,6 +632,74 @@ def generate_speculative(
     return out[:, : int(lens[0])]
 
 
+@functools.lru_cache(maxsize=32)
+def _spec_programs(cfg: LlamaConfig, draft_cfg: LlamaConfig, k: int,
+                   temperature: float, top_k: int, top_p: float) -> Dict:
+    """Compiled speculative-decoding programs, memoized per
+    (configs, k, sampling knobs): RL rollouts call
+    generate_speculative_batched once per PPO iteration, and without
+    this memo every call would re-trace and re-XLA-compile the draft
+    scan, the (k+1)-token verify, and the catch-up step (jax.jit caches
+    by function identity).  LlamaConfig is frozen/hashable."""
+    sample = temperature > 0.0
+
+    @jax.jit
+    def prefill_t(tp, prompts, cache):
+        return forward_step(tp, prompts, cfg, cache)
+
+    @jax.jit
+    def prefill_d(dp, prompts, cache):
+        return forward_step(dp, prompts, draft_cfg, cache)
+
+    @jax.jit
+    def draft_roll(dp, cache, tok, key):
+        def body(carry, sub):
+            cache, tok = carry
+            lg, cache = forward_step(dp, tok[:, None], draft_cfg, cache)
+            lg1 = lg[:, -1, :]
+            if sample:
+                filt = _filter_logits(lg1 / temperature, top_k, top_p)
+                nxt = jax.random.categorical(
+                    sub, filt, axis=-1
+                ).astype(tok.dtype)
+                probs = jax.nn.softmax(filt, axis=-1)  # [B, V]
+                return (cache, nxt), (nxt, probs)
+            nxt = jnp.argmax(lg1, axis=-1).astype(tok.dtype)
+            return (cache, nxt), nxt
+
+        (cache, _), ys = jax.lax.scan(
+            body, (cache, tok), jax.random.split(key, k)
+        )
+        toks, q = ys if sample else (ys, None)
+        # toks [k, B] -> [B, k]; q [k, B, V] -> [B, k, V]
+        return (
+            jnp.moveaxis(toks, 0, 1),
+            None if q is None else jnp.moveaxis(q, 0, 1),
+            cache,
+        )
+
+    @jax.jit
+    def target_verify(tp, cache, chunk):
+        lg, cache = forward_step(tp, chunk, cfg, cache)
+        if sample:
+            filt = _filter_logits(
+                lg.reshape(-1, lg.shape[-1]) / temperature, top_k, top_p
+            ).reshape(lg.shape)
+            return jax.nn.softmax(filt, axis=-1), cache  # [B, k+1, V]
+        return jnp.argmax(lg, axis=-1).astype(chunk.dtype), cache
+
+    @jax.jit
+    def draft_catch_up(dp, cache, tok):
+        _, cache = forward_step(dp, tok[:, None], draft_cfg, cache)
+        return cache
+
+    return {
+        "prefill_t": prefill_t, "prefill_d": prefill_d,
+        "draft_roll": draft_roll, "target_verify": target_verify,
+        "draft_catch_up": draft_catch_up,
+    }
+
+
 def generate_speculative_batched(
     params: Dict,
     cfg: LlamaConfig,
@@ -688,10 +758,14 @@ def generate_speculative_batched(
         int(jax.random.randint(seed_key, (), 0, 2**31 - 1))
     )
     max_len = P + N + k + 2
+    progs = _spec_programs(cfg, draft_cfg, k, temperature, top_k, top_p)
+    draft_roll = progs["draft_roll"]
+    target_verify = progs["target_verify"]
+    draft_catch_up = progs["draft_catch_up"]
     cache_t = init_cache(cfg, B, max_len, quant_kv=quant_kv)
     cache_d = init_cache(draft_cfg, B, max_len, quant_kv=quant_kv)
-    logits, cache_t = forward_step(params, prompts, cfg, cache_t)
-    _, cache_d = forward_step(draft_params, prompts, draft_cfg, cache_d)
+    logits, cache_t = progs["prefill_t"](params, prompts, cache_t)
+    _, cache_d = progs["prefill_d"](draft_params, prompts, cache_d)
     pick = _make_sampler(temperature, top_k, top_p)
     last = jnp.take_along_axis(
         logits, (prompt_lens - 1)[:, None, None], axis=1
@@ -702,48 +776,6 @@ def generate_speculative_batched(
     off = prompt_lens
     cache_t = dict(cache_t, offset=off)
     cache_d = dict(cache_d, offset=off)
-
-    @jax.jit
-    def draft_roll(dp, cache, tok, key):
-        def body(carry, sub):
-            cache, tok = carry
-            lg, cache = forward_step(dp, tok[:, None], draft_cfg, cache)
-            lg1 = lg[:, -1, :]
-            if sample:
-                filt = _filter_logits(lg1 / temperature, top_k, top_p)
-                nxt = jax.random.categorical(
-                    sub, filt, axis=-1
-                ).astype(tok.dtype)
-                probs = jax.nn.softmax(filt, axis=-1)  # [B, V]
-                return (cache, nxt), (nxt, probs)
-            nxt = jnp.argmax(lg1, axis=-1).astype(tok.dtype)
-            return (cache, nxt), nxt
-
-        (cache, _), ys = jax.lax.scan(
-            body, (cache, tok), jax.random.split(key, k)
-        )
-        toks, q = ys if sample else (ys, None)
-        # toks [k, B] -> [B, k]; q [k, B, V] -> [B, k, V]
-        return (
-            jnp.moveaxis(toks, 0, 1),
-            None if q is None else jnp.moveaxis(q, 0, 1),
-            cache,
-        )
-
-    @jax.jit
-    def target_verify(tp, cache, chunk):
-        lg, cache = forward_step(tp, chunk, cfg, cache)
-        if sample:
-            filt = _filter_logits(
-                lg.reshape(-1, lg.shape[-1]) / temperature, top_k, top_p
-            ).reshape(lg.shape)
-            return jax.nn.softmax(filt, axis=-1), cache  # [B, k+1, V]
-        return jnp.argmax(lg, axis=-1).astype(chunk.dtype), cache
-
-    @jax.jit
-    def draft_catch_up(dp, cache, tok):
-        _, cache = forward_step(dp, tok[:, None], draft_cfg, cache)
-        return cache
 
     buf = np.full((B, N), pad_token, dtype=np.asarray(prompts).dtype)
     emitted = np.zeros(B, np.int64)
@@ -932,45 +964,86 @@ class DecodeServer:
             f"{self.buckets[-1]}"
         )
 
+    @staticmethod
+    def _slot_subcache(cache: Dict, s) -> list:
+        """Per-layer [1, ...] views of slot ``s``'s cache rows.
+        Iterates the layer dict's KEYS so the int8 layout's scale
+        arrays ("ks"/"vs") ride along with "k"/"v" (every cache array
+        is [slots, ...]-leading)."""
+        return [
+            {
+                kk: jax.lax.dynamic_slice_in_dim(cl[kk], s, 1, 0)
+                for kk in cl
+            }
+            for cl in cache["layers"]
+        ]
+
+    @staticmethod
+    def _slot_writeback(cache: Dict, sub_layers: list, s) -> list:
+        """Write per-layer [1, ...] sub-rows back into slot ``s``."""
+        return [
+            {
+                kk: jax.lax.dynamic_update_slice_in_dim(
+                    cl[kk], sc[kk], s, 0
+                )
+                for kk in cl
+            }
+            for cl, sc in zip(cache["layers"], sub_layers)
+        ]
+
     def _prefill(self, bucket: int):
         """Jitted: score one right-padded prompt into slot ``s``'s cache
         rows; returns (cache, first sampled token)."""
         cfg = self.cfg
 
         def fn(params, cache, s, prompt, plen, key):
-            # Iterate the layer dict's keys so the int8 layout's scale
-            # arrays ("ks"/"vs") ride along with "k"/"v" (every cache
-            # array is [slots, ...]-leading).
-            sub_layers = [
-                {
-                    kk: jax.lax.dynamic_slice_in_dim(cl[kk], s, 1, 0)
-                    for kk in cl
-                }
-                for cl in cache["layers"]
-            ]
             # Fresh zero rows for this slot (slot reuse must not see a
             # previous occupant's keys beyond the causal mask).
             sub = {
                 "layers": [
                     {kk: jnp.zeros_like(c[kk]) for kk in c}
-                    for c in sub_layers
+                    for c in self._slot_subcache(cache, s)
                 ],
                 "offset": jnp.zeros((), jnp.int32),
             }
             logits, sub = forward_step(params, prompt[None, :], cfg, sub)
             last = logits[0, plen - 1, :]
             first = self._pick(last[None, :], key)[0]
-            new_layers = [
-                {
-                    kk: jax.lax.dynamic_update_slice_in_dim(
-                        cl[kk], sc[kk], s, 0
-                    )
-                    for kk in cl
-                }
-                for cl, sc in zip(cache["layers"], sub["layers"])
-            ]
+            new_layers = self._slot_writeback(cache, sub["layers"], s)
             new_offset = cache["offset"].at[s].set(plen)
             return dict(cache, layers=new_layers, offset=new_offset), first
+
+        return jax.jit(fn)
+
+    def _prefill_chunk(self, C: int):
+        """Jitted: score ONE full [1, C] chunk continuing slot ``s``'s
+        sub-cache at offset ``off`` (``zero_first`` wipes the slot's
+        rows for fresh admission).  Returns (cache, chunk logits
+        [C, V]).  Looping this admits prompts of ANY length with one
+        compiled program (see ``admit_chunked`` for the final-chunk
+        window shift that keeps every write in bounds)."""
+        cfg = self.cfg
+
+        def fn(params, cache, s, chunk, off, zero_first):
+            sub = {
+                "layers": [
+                    {
+                        kk: jnp.where(
+                            zero_first, jnp.zeros_like(c[kk]), c[kk]
+                        )
+                        for kk in c
+                    }
+                    for c in self._slot_subcache(cache, s)
+                ],
+                "offset": off,
+            }
+            logits, sub = forward_step(params, chunk, cfg, sub)
+            new_layers = self._slot_writeback(cache, sub["layers"], s)
+            new_offset = cache["offset"].at[s].set(off + C)
+            return (
+                dict(cache, layers=new_layers, offset=new_offset),
+                logits[0],
+            )
 
         return jax.jit(fn)
 
@@ -1004,20 +1077,56 @@ class DecodeServer:
                     f"max_len {self.max_len}"
                 )
 
+        def admit_chunked(slot, prompt, n):
+            """Prompts past the largest bucket: loop ONE compiled
+            C-token chunk scorer (chunked prefill).  Every chunk is
+            FULL: the final chunk's window shifts back to [n-C, n) —
+            re-scoring already-written positions rewrites value-
+            identical kv (k/v depend only on token and position), so no
+            chunk ever pads past the prompt or writes beyond slot n-1
+            (a padded tail could run past max_len, where the dense
+            write's dynamic_update_slice CLAMPS the start and silently
+            corrupts live rows)."""
+            nonlocal cache
+            C = self.buckets[-1]
+            if "chunk" not in self._prefill_jit:
+                self._prefill_jit["chunk"] = self._prefill_chunk(C)
+            step = self._prefill_jit["chunk"]
+            last = None
+            for c0 in range(0, n, C):
+                start = c0 if c0 + C <= n else n - C
+                piece = prompt[start: start + C]
+                cache, logits = step(
+                    self.params, cache, slot, jnp.asarray(piece)[None],
+                    jnp.asarray(start, jnp.int32),
+                    jnp.asarray(start == 0),
+                )
+                if start + C >= n:
+                    last = logits[(n - 1) - start]
+            # True prompt length, not the chunk-rounded offset.
+            cache = dict(
+                cache,
+                offset=cache["offset"].at[slot].set(n),
+            )
+            return self._pick(last[None, :], self._next_key())[0]
+
         def admit(slot):
             rid, prompt = queue.pop()
             prompt = onp.asarray(prompt, onp.int32)
             n = len(prompt)
-            b = self._bucket(n)
-            padded = onp.zeros((b,), onp.int32)
-            padded[:n] = prompt
-            if b not in self._prefill_jit:
-                self._prefill_jit[b] = self._prefill(b)
             nonlocal cache, toks
-            cache, first = self._prefill_jit[b](
-                self.params, cache, slot, jnp.asarray(padded),
-                jnp.asarray(n, jnp.int32), self._next_key(),
-            )
+            if n > self.buckets[-1]:
+                first = admit_chunked(slot, prompt, n)
+            else:
+                b = self._bucket(n)
+                padded = onp.zeros((b,), onp.int32)
+                padded[:n] = prompt
+                if b not in self._prefill_jit:
+                    self._prefill_jit[b] = self._prefill(b)
+                cache, first = self._prefill_jit[b](
+                    self.params, cache, slot, jnp.asarray(padded),
+                    jnp.asarray(n, jnp.int32), self._next_key(),
+                )
             toks = toks.at[slot].set(first.astype(toks.dtype))
             active[slot] = True
             slot_req[slot] = rid

@@ -920,3 +920,37 @@ class TestBatchedSpeculative:
             assert int(out_lens[b]) == int(lens[b]) + 8
             row = np.asarray(out[b])
             assert (row[: int(out_lens[b])] < cfg.vocab_size).all()
+
+
+class TestChunkedPrefillAdmission:
+    def test_long_prompt_beyond_buckets_matches_solo(self):
+        """A prompt longer than the largest bucket admits through the
+        chunked prefill and decodes exactly like solo generate."""
+        cfg = llama.LlamaConfig.tiny(n_layer=2, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        long_p = (np.arange(20, dtype=np.int32) % 11) + 1  # > bucket 8
+        short_p = (np.arange(5, dtype=np.int32) % 7) + 1
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=64, prompt_buckets=(8,),
+        )
+        outs = srv.serve([long_p, short_p], max_new_tokens=6)
+        for p, got in zip([long_p, short_p], outs):
+            solo = np.asarray(llama_infer.generate(
+                params, cfg, jnp.asarray(p)[None, :], max_new_tokens=6
+            ))[0]
+            np.testing.assert_array_equal(got, solo)
+
+    def test_long_prompt_quant_kv(self):
+        cfg = llama.LlamaConfig.tiny(n_layer=1, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        long_p = (np.arange(19, dtype=np.int32) % 9) + 1
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=1, max_len=48, prompt_buckets=(8,),
+            quant_kv=True,
+        )
+        outs = srv.serve([long_p], max_new_tokens=5)
+        solo = np.asarray(llama_infer.generate(
+            params, cfg, jnp.asarray(long_p)[None, :],
+            max_new_tokens=5, quant_kv=True,
+        ))[0]
+        np.testing.assert_array_equal(outs[0], solo)
