@@ -184,6 +184,15 @@ STAGES = [
     # variants) with startup headroom, or a SIGKILL lands between
     # variants and a partial artifact permanently marks the stage done.
     ("decode", "DECODE_TPU.json", decode_stage_argv, 2400.0),
+    # Last: the full training sweep.  bench.py flushes TPU-measured
+    # candidates to BENCH_TPU_VERIFIED.json as they complete (the
+    # durable append-per-run artifact), so even a wedge mid-sweep
+    # leaves verified numbers.  Goodput/decode probes are skipped —
+    # their dedicated stages above already landed artifacts.
+    ("bench_sweep", "BENCH_TPU_VERIFIED.json",
+     lambda: ["/usr/bin/env", "DLROVER_TPU_BENCH_GOODPUT=0",
+              "DLROVER_TPU_BENCH_DEADLINE=3300",
+              sys.executable, os.path.join(REPO, "bench.py")], 3600.0),
 ]
 
 
