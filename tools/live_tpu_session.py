@@ -184,6 +184,13 @@ STAGES = [
     # variants) with startup headroom, or a SIGKILL lands between
     # variants and a partial artifact permanently marks the stage done.
     ("decode", "DECODE_TPU.json", decode_stage_argv, 2400.0),
+    # Remaining hardware unknowns (offload_opt x remat=offload on the
+    # real partitioner, node-check payload timing, device-cache hit
+    # path vs host pull) — each probe is its own killable subprocess.
+    ("hw_probes", "HW_PROBES.json",
+     lambda: [sys.executable,
+              os.path.join(REPO, "tools", "probe_hw_unknowns.py")],
+     3000.0),
     # Last: the full training sweep.  bench.py flushes TPU-measured
     # candidates to BENCH_TPU_VERIFIED.json as they complete (the
     # durable append-per-run artifact), so even a wedge mid-sweep
