@@ -700,6 +700,86 @@ def _spec_programs(cfg: LlamaConfig, draft_cfg: LlamaConfig, k: int,
     }
 
 
+def _spec_decode_round(
+    progs: Dict,
+    params: Dict,
+    draft_params: Dict,
+    cache_t: Dict,
+    cache_d: Dict,
+    cur: jax.Array,  # [B] current input token per row
+    done: np.ndarray,  # [B] frozen rows (ride along masked)
+    k: int,
+    sample: bool,
+    np_rng: "np.random.Generator",
+    sub: jax.Array,  # draft-sampling key (dead in the greedy trace)
+    max_off: Optional[np.ndarray] = None,  # [B] per-row offset bound
+) -> Tuple[list, np.ndarray, Dict, Dict]:
+    """ONE speculative round over a ragged batch: draft k proposals per
+    row, one chunked (k+1)-token verify at per-row offsets, per-row
+    acceptance, cache rewind + full-acceptance catch-up.  Frozen rows
+    keep their offsets (their compute rides along masked).  Returns
+    ``(accepted_rows, nxt, cache_t, cache_d)``: ``accepted_rows[b]`` is
+    the round's emitted tokens for row b (empty when frozen) BEFORE any
+    EOS/budget truncation — truncation only marks rows done, it never
+    changes cache state, so callers (the batched generator, the
+    speculative DecodeServer) own it."""
+    B = int(cur.shape[0])
+    n = np.asarray(cache_t["offset"])  # [B]
+    d, q, cache_d = progs["draft_roll"](draft_params, cache_d, cur, sub)
+    chunk = jnp.concatenate([cur[:, None], d], axis=1)  # [B, k+1]
+    g, cache_t = progs["target_verify"](params, cache_t, chunk)
+    d_host = np.asarray(d)
+    j = np.zeros(B, np.int64)
+    nxt = np.asarray(cur).copy()
+    if sample:
+        g_host = np.asarray(g, np.float64)  # [B, k+1, V]
+        q_host = np.asarray(q, np.float64)  # [B, k, V]
+        for b in range(B):
+            if done[b]:
+                continue
+            j[b], nxt[b] = _spec_accept_round(
+                g_host[b], q_host[b], d_host[b], np_rng
+            )
+    else:
+        g_host = np.asarray(g)  # [B, k+1]
+        for b in range(B):
+            if done[b]:
+                continue
+            while j[b] < k and d_host[b, j[b]] == g_host[b, j[b]]:
+                j[b] += 1
+            nxt[b] = g_host[b, j[b]]
+    # Per-row rewind; frozen rows keep their old offset.  ``max_off``
+    # clamps rows finishing this round (emission stops at their budget/
+    # EOS, so the clamp never loses live context) — without it a
+    # full-acceptance final round leaves a frozen offset past the
+    # capacity-checked bound, and later ride-along rounds would scatter
+    # beyond max_len (silently dropped today, corruption under any
+    # dense-write lowering).
+    new_n = np.where(done, n, n + 1 + j)
+    if max_off is not None:
+        new_n = np.minimum(new_n, max_off)
+    full = (~done) & (j == k)
+    if full.any():
+        # Batched 1-token catch-up: full-acceptance rows write the
+        # missing d_k at slot n+k; everyone else harmlessly writes its
+        # next token's kv at its own next slot.
+        tok_cu = np.where(full, d_host[:, k - 1], nxt).astype(
+            np.asarray(cur).dtype
+        )
+        pos_cu = np.where(full, n + k, new_n)
+        cache_d = dict(cache_d, offset=jnp.asarray(pos_cu, jnp.int32))
+        cache_d = progs["draft_catch_up"](
+            draft_params, cache_d, jnp.asarray(tok_cu)
+        )
+    cache_d = dict(cache_d, offset=jnp.asarray(new_n, jnp.int32))
+    cache_t = dict(cache_t, offset=jnp.asarray(new_n, jnp.int32))
+    accepted_rows = [
+        [] if done[b] else list(d_host[b, : j[b]]) + [nxt[b]]
+        for b in range(B)
+    ]
+    return accepted_rows, nxt, cache_t, cache_d
+
+
 def generate_speculative_batched(
     params: Dict,
     cfg: LlamaConfig,
@@ -759,9 +839,6 @@ def generate_speculative_batched(
     )
     max_len = P + N + k + 2
     progs = _spec_programs(cfg, draft_cfg, k, temperature, top_k, top_p)
-    draft_roll = progs["draft_roll"]
-    target_verify = progs["target_verify"]
-    draft_catch_up = progs["draft_catch_up"]
     cache_t = init_cache(cfg, B, max_len, quant_kv=quant_kv)
     cache_d = init_cache(draft_cfg, B, max_len, quant_kv=quant_kv)
     logits, cache_t = progs["prefill_t"](params, prompts, cache_t)
@@ -792,76 +869,35 @@ def generate_speculative_batched(
     rounds = 0
     greedy_key = jax.random.PRNGKey(0)  # dead in the greedy trace
     while not done.all() and (emitted < N).any():
-        n = np.asarray(cache_t["offset"])  # [B]
         if sample:
             rng, sub = jax.random.split(rng)
         else:
             sub = greedy_key
-        d, q, cache_d = draft_roll(draft_params, cache_d, cur, sub)
-        chunk = jnp.concatenate([cur[:, None], d], axis=1)  # [B, k+1]
-        g, cache_t = target_verify(params, cache_t, chunk)
-        d_host = np.asarray(d)
-        j = np.zeros(B, np.int64)
-        nxt = np.asarray(cur).copy()
-        if sample:
-            g_host = np.asarray(g, np.float64)  # [B, k+1, V]
-            q_host = np.asarray(q, np.float64)  # [B, k, V]
-            for b in range(B):
-                if done[b]:
-                    continue
-                j[b], nxt[b] = _spec_accept_round(
-                    g_host[b], q_host[b], d_host[b], np_rng
-                )
-        else:
-            g_host = np.asarray(g)  # [B, k+1]
-            for b in range(B):
-                if done[b]:
-                    continue
-                while j[b] < k and d_host[b, j[b]] == g_host[b, j[b]]:
-                    j[b] += 1
-                nxt[b] = g_host[b, j[b]]
+        accepted_rows, nxt, cache_t, cache_d = _spec_decode_round(
+            progs, params, draft_params, cache_t, cache_d, cur, done,
+            k, sample, np_rng, sub,
+            max_off=np.asarray(prompt_lens) + N,
+        )
         # Emit per row (truncated at EOS and at the N budget).
         new_done = done.copy()
         for b in range(B):
             if done[b]:
                 continue
-            accepted = list(d_host[b, : j[b]]) + [nxt[b]]
+            accepted = accepted_rows[b]
             if eos_token >= 0:
                 for i, t in enumerate(accepted):
                     if int(t) == eos_token:
                         accepted = accepted[: i + 1]
-                        j[b] = min(j[b], i)
                         new_done[b] = True
                         break
             room = N - int(emitted[b])
             if len(accepted) >= room:
                 accepted = accepted[:room]
-                j[b] = min(j[b], max(len(accepted) - 1, 0))
                 new_done[b] = True
             for t in accepted:
                 buf[b, emitted[b]] = t
                 emitted[b] += 1
-        # Per-row rewind; finished rows freeze at their old offset.
-        new_n = np.where(done, n, n + 1 + j)
-        full = (~done) & (j == k)
-        if full.any():
-            # Batched 1-token catch-up: full-acceptance rows write the
-            # missing d_k at slot n+k; everyone else harmlessly writes
-            # its next token's kv at its own next slot.
-            tok_cu = np.where(full, d_host[:, k - 1], nxt).astype(
-                cur_h.dtype
-            )
-            pos_cu = np.where(full, n + k, new_n)
-            cache_d = dict(
-                cache_d, offset=jnp.asarray(pos_cu, jnp.int32)
-            )
-            cache_d = draft_catch_up(
-                draft_params, cache_d, jnp.asarray(tok_cu)
-            )
-        cache_d = dict(cache_d, offset=jnp.asarray(new_n, jnp.int32))
-        cache_t = dict(cache_t, offset=jnp.asarray(new_n, jnp.int32))
         done = new_done
-        cur_h = nxt
         cur = jnp.asarray(nxt)
         rounds += 1
     if stats is not None:
@@ -916,21 +952,37 @@ class DecodeServer:
         prompt_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256),
         seed: int = 0,
         quant_kv: bool = False,  # int8 kv cache (see init_cache)
+        draft: Optional[Tuple[Dict, LlamaConfig]] = None,
+        draft_k: int = 4,
     ):
         if cfg.sliding_window > 0:
             raise ValueError("DecodeServer: sliding-window models "
                              "are not supported yet")
+        if draft is not None and draft[1].sliding_window > 0:
+            raise ValueError("DecodeServer: sliding-window draft "
+                             "models are not supported")
         self.params = params
         self.cfg = cfg
         self.slots = slots
         self.max_len = max_len
         self.eos_token = eos_token
         self.quant_kv = quant_kv
+        # ``draft=(draft_params, draft_cfg)``: serve() steps via
+        # speculative rounds (draft proposes draft_k, ONE chunked
+        # ragged verify over all slots, per-slot acceptance) —
+        # continuous batching x speculation, the full vllm-spec-decode
+        # shape.  Token law per request is unchanged.
+        self.draft = draft
+        self.draft_k = draft_k
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self._np_rng = np.random.default_rng(seed + 1)
         self.buckets = tuple(
             b for b in sorted(prompt_buckets) if b <= max_len
         )
         self._pick = _make_sampler(temperature, top_k, top_p)
-        self._prefill_jit: Dict[int, Any] = {}
+        self._prefill_jit: Dict[Any, Any] = {}
         # Host-managed sampling stream: every step/prefill consumes a
         # FRESH subkey (a constant key would make non-greedy serving
         # degenerate — identical noise each step collapses samples into
@@ -991,10 +1043,12 @@ class DecodeServer:
             for cl, sc in zip(cache["layers"], sub_layers)
         ]
 
-    def _prefill(self, bucket: int):
+    def _prefill(self, bucket: int, cfg: Optional[LlamaConfig] = None):
         """Jitted: score one right-padded prompt into slot ``s``'s cache
-        rows; returns (cache, first sampled token)."""
-        cfg = self.cfg
+        rows; returns (cache, first sampled token).  ``cfg`` defaults
+        to the target model's (pass the draft's to admit into the
+        draft cache)."""
+        cfg = cfg or self.cfg
 
         def fn(params, cache, s, prompt, plen, key):
             # Fresh zero rows for this slot (slot reuse must not see a
@@ -1015,14 +1069,15 @@ class DecodeServer:
 
         return jax.jit(fn)
 
-    def _prefill_chunk(self, C: int):
+    def _prefill_chunk(self, C: int,
+                       cfg: Optional[LlamaConfig] = None):
         """Jitted: score ONE full [1, C] chunk continuing slot ``s``'s
         sub-cache at offset ``off`` (``zero_first`` wipes the slot's
         rows for fresh admission).  Returns (cache, chunk logits
         [C, V]).  Looping this admits prompts of ANY length with one
         compiled program (see ``admit_chunked`` for the final-chunk
         window shift that keeps every write in bounds)."""
-        cfg = self.cfg
+        cfg = cfg or self.cfg
 
         def fn(params, cache, s, chunk, off, zero_first):
             sub = {
@@ -1059,75 +1114,101 @@ class DecodeServer:
         cache = init_cache(cfg, B, self.max_len,
                            quant_kv=self.quant_kv)
         cache = dict(cache, offset=jnp.zeros((B,), jnp.int32))
+        cache_d = None
+        if self.draft is not None:
+            cache_d = init_cache(self.draft[1], B, self.max_len,
+                                 quant_kv=self.quant_kv)
+            cache_d = dict(cache_d, offset=jnp.zeros((B,), jnp.int32))
         toks = jnp.zeros((B,), jnp.int32)
         active = onp.zeros((B,), bool)
         slot_req = [-1] * B  # request id per slot
         slot_out: list = [None] * B
         budget = [0] * B
+        # Per-slot offset bound (speculative rounds clamp finishing
+        # rows here; see _spec_decode_round's max_off).
+        slot_bound = onp.zeros((B,), onp.int64)
 
         # Capacity: every write slot a request will ever touch must fit
         # the cache — an out-of-range scatter is silently DROPPED by
         # JAX and would emit a plausible-but-wrong continuation.
+        # Speculative rounds overshoot by up to draft_k+1 slots before
+        # the rewind — the capacity check must include that headroom.
+        slack = (self.draft_k + 1) if self.draft is not None else 0
         for rid, prompt in enumerate(prompts):
-            need = len(prompt) + max_new_tokens
+            need = len(prompt) + max_new_tokens + slack
             if need > self.max_len:
                 raise ValueError(
                     f"request {rid}: prompt {len(prompt)} + "
-                    f"max_new_tokens {max_new_tokens} = {need} exceeds "
-                    f"max_len {self.max_len}"
+                    f"max_new_tokens {max_new_tokens} + headroom "
+                    f"{slack} = {need} exceeds max_len {self.max_len}"
                 )
 
-        def admit_chunked(slot, prompt, n):
-            """Prompts past the largest bucket: loop ONE compiled
-            C-token chunk scorer (chunked prefill).  Every chunk is
-            FULL: the final chunk's window shifts back to [n-C, n) —
-            re-scoring already-written positions rewrites value-
-            identical kv (k/v depend only on token and position), so no
-            chunk ever pads past the prompt or writes beyond slot n-1
-            (a padded tail could run past max_len, where the dense
-            write's dynamic_update_slice CLAMPS the start and silently
-            corrupts live rows)."""
-            nonlocal cache
-            C = self.buckets[-1]
-            if "chunk" not in self._prefill_jit:
-                self._prefill_jit["chunk"] = self._prefill_chunk(C)
-            step = self._prefill_jit["chunk"]
-            last = None
-            for c0 in range(0, n, C):
-                start = c0 if c0 + C <= n else n - C
-                piece = prompt[start: start + C]
-                cache, logits = step(
-                    self.params, cache, slot, jnp.asarray(piece)[None],
-                    jnp.asarray(start, jnp.int32),
-                    jnp.asarray(start == 0),
-                )
-                if start + C >= n:
-                    last = logits[(n - 1) - start]
-            # True prompt length, not the chunk-rounded offset.
-            cache = dict(
-                cache,
-                offset=cache["offset"].at[slot].set(n),
+        def admit_one_cache(slot, prompt, n, c, mparams, mcfg, role):
+            """Prefill ``prompt`` into ``c``'s slot rows under one
+            model (target or draft); returns (new cache, first sampled
+            token — meaningful for the target only; the draft role uses
+            a CONSTANT key so its discarded pick never shifts the
+            sampling stream)."""
+            if n > self.buckets[-1]:
+                # Chunked prefill: every chunk is FULL — the final
+                # chunk's window shifts back to [n-C, n), re-scoring
+                # already-written positions with value-identical kv
+                # (k/v depend only on token and position), so no chunk
+                # pads past the prompt or writes beyond slot n-1 (a
+                # padded tail could run past max_len, where the dense
+                # write's dynamic_update_slice CLAMPS the start and
+                # silently corrupts live rows).
+                C = self.buckets[-1]
+                jkey = ("chunk", role)
+                if jkey not in self._prefill_jit:
+                    self._prefill_jit[jkey] = self._prefill_chunk(
+                        C, mcfg
+                    )
+                step = self._prefill_jit[jkey]
+                last = None
+                for c0 in range(0, n, C):
+                    start = c0 if c0 + C <= n else n - C
+                    piece = prompt[start: start + C]
+                    c, logits = step(
+                        mparams, c, slot, jnp.asarray(piece)[None],
+                        jnp.asarray(start, jnp.int32),
+                        jnp.asarray(start == 0),
+                    )
+                    if start + C >= n:
+                        last = logits[(n - 1) - start]
+                # True prompt length, not the chunk-rounded offset.
+                c = dict(c, offset=c["offset"].at[slot].set(n))
+                if role != "t":
+                    return c, None
+                return c, self._pick(last[None, :], self._next_key())[0]
+            b = self._bucket(n)
+            padded = onp.zeros((b,), onp.int32)
+            padded[:n] = prompt
+            jkey = (b, role)
+            if jkey not in self._prefill_jit:
+                self._prefill_jit[jkey] = self._prefill(b, mcfg)
+            key = (self._next_key() if role == "t"
+                   else jax.random.PRNGKey(0))
+            return self._prefill_jit[jkey](
+                mparams, c, slot, jnp.asarray(padded),
+                jnp.asarray(n, jnp.int32), key,
             )
-            return self._pick(last[None, :], self._next_key())[0]
 
         def admit(slot):
             rid, prompt = queue.pop()
             prompt = onp.asarray(prompt, onp.int32)
             n = len(prompt)
-            nonlocal cache, toks
-            if n > self.buckets[-1]:
-                first = admit_chunked(slot, prompt, n)
-            else:
-                b = self._bucket(n)
-                padded = onp.zeros((b,), onp.int32)
-                padded[:n] = prompt
-                if b not in self._prefill_jit:
-                    self._prefill_jit[b] = self._prefill(b)
-                cache, first = self._prefill_jit[b](
-                    self.params, cache, slot, jnp.asarray(padded),
-                    jnp.asarray(n, jnp.int32), self._next_key(),
+            nonlocal cache, cache_d, toks
+            cache, first = admit_one_cache(
+                slot, prompt, n, cache, self.params, self.cfg, "t"
+            )
+            if self.draft is not None:
+                cache_d, _ = admit_one_cache(
+                    slot, prompt, n, cache_d, self.draft[0],
+                    self.draft[1], "d"
                 )
             toks = toks.at[slot].set(first.astype(toks.dtype))
+            slot_bound[slot] = n + max_new_tokens
             active[slot] = True
             slot_req[slot] = rid
             slot_out[slot] = [int(first)]
@@ -1144,11 +1225,44 @@ class DecodeServer:
             active[slot] = False
             slot_req[slot] = -1
 
+        sample = self.temperature > 0.0
+        greedy_key = jax.random.PRNGKey(0)  # dead in the greedy trace
+        spec_progs = None
+        if self.draft is not None:
+            spec_progs = _spec_programs(
+                cfg, self.draft[1], self.draft_k, self.temperature,
+                self.top_k, self.top_p,
+            )
         while queue or active.any():
             for s in range(B):
                 if not active[s] and queue:
                     admit(s)
             if not active.any():
+                continue
+            if self.draft is not None:
+                # Speculative round over ALL slots: each drafts k, one
+                # chunked ragged verify, per-slot acceptance; idle
+                # slots ride along frozen (done mask).
+                accepted_rows, nxt, cache, cache_d = _spec_decode_round(
+                    spec_progs, self.params, self.draft[0], cache,
+                    cache_d, toks, ~active, self.draft_k, sample,
+                    self._np_rng,
+                    self._next_key() if sample else greedy_key,
+                    max_off=slot_bound,
+                )
+                toks = jnp.asarray(nxt)
+                for s in range(B):
+                    if not active[s]:
+                        continue
+                    for t in accepted_rows[s]:
+                        slot_out[s].append(int(t))
+                        budget[s] -= 1
+                        if (
+                            int(t) == self.eos_token
+                            or budget[s] <= 0
+                        ):
+                            finish(s)
+                            break
                 continue
             cache, nxt = self._step(
                 self.params, cache, toks, jnp.asarray(active),

@@ -954,3 +954,102 @@ class TestChunkedPrefillAdmission:
             max_new_tokens=5, quant_kv=True,
         ))[0]
         np.testing.assert_array_equal(outs[0], solo)
+
+
+class TestSpeculativeServer:
+    """Continuous batching x speculation: DecodeServer(draft=...) steps
+    all slots through speculative rounds; the per-request token law is
+    unchanged."""
+
+    def _models(self):
+        cfg = llama.LlamaConfig.tiny(n_layer=2, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        dcfg = llama.LlamaConfig.tiny(n_layer=1, dtype=jnp.float32)
+        draft = llama.init_params(jax.random.PRNGKey(7), dcfg)
+        return cfg, params, dcfg, draft
+
+    def test_spec_server_matches_solo_greedy(self):
+        cfg, params, dcfg, draft = self._models()
+        prompts = [
+            (np.arange(4, dtype=np.int32) % 7) + 1,
+            (np.arange(6, dtype=np.int32) % 5) + 2,
+            (np.arange(5, dtype=np.int32) % 9) + 1,
+        ]
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=48, prompt_buckets=(8,),
+            draft=(draft, dcfg), draft_k=3,
+        )
+        outs = srv.serve(prompts, max_new_tokens=6)
+        for p, got in zip(prompts, outs):
+            solo = np.asarray(llama_infer.generate(
+                params, cfg, jnp.asarray(p)[None, :], max_new_tokens=6
+            ))[0]
+            np.testing.assert_array_equal(got, solo)
+
+    def test_spec_server_eos_frees_slot_and_matches(self):
+        cfg, params, dcfg, draft = self._models()
+        p0 = (np.arange(4, dtype=np.int32) % 7) + 1
+        solo = np.asarray(llama_infer.generate(
+            params, cfg, jnp.asarray(p0)[None, :], max_new_tokens=10
+        ))[0][len(p0):]
+        eos = int(solo[1])  # stops row 0 after 2 tokens
+        prompts = [p0, (np.arange(6, dtype=np.int32) % 5) + 2]
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=1, max_len=48, prompt_buckets=(8,),
+            draft=(draft, dcfg), draft_k=3, eos_token=eos,
+        )
+        outs = srv.serve(prompts, max_new_tokens=10)
+        # row 0 ends at its EOS position
+        got0 = outs[0][len(p0):]
+        stop = int(np.argmax(solo == eos)) + 1
+        np.testing.assert_array_equal(got0, solo[:stop])
+        # row 1 (admitted into the freed slot) matches its solo decode
+        solo1 = np.asarray(llama_infer.generate(
+            params, cfg, jnp.asarray(prompts[1])[None, :],
+            max_new_tokens=10,
+        ))[0]
+        gen1 = solo1[len(prompts[1]):]
+        stop1 = (int(np.argmax(gen1 == eos)) + 1
+                 if (gen1 == eos).any() else 10)
+        np.testing.assert_array_equal(
+            outs[1], solo1[: len(prompts[1]) + stop1]
+        )
+
+    def test_spec_server_long_prompt_and_quant(self):
+        cfg, params, dcfg, draft = self._models()
+        long_p = (np.arange(20, dtype=np.int32) % 11) + 1  # > bucket 8
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+            draft=(draft, dcfg), draft_k=2, quant_kv=True,
+        )
+        outs = srv.serve([long_p], max_new_tokens=5)
+        solo = np.asarray(llama_infer.generate(
+            params, cfg, jnp.asarray(long_p)[None, :],
+            max_new_tokens=5, quant_kv=True,
+        ))[0]
+        np.testing.assert_array_equal(outs[0], solo)
+
+    def test_spec_server_sampled_smoke_and_seed_sensitivity(self):
+        cfg, params, dcfg, draft = self._models()
+        prompts = [
+            (np.arange(4, dtype=np.int32) % 7) + 1,
+            (np.arange(6, dtype=np.int32) % 5) + 2,
+        ]
+
+        def run(seed):
+            srv = llama_infer.DecodeServer(
+                params, cfg, slots=2, max_len=48, prompt_buckets=(8,),
+                draft=(draft, dcfg), draft_k=3, temperature=0.9,
+                seed=seed,
+            )
+            return srv.serve(prompts, max_new_tokens=8)
+
+        a, b = run(1), run(2)
+        for p, o in zip(prompts, a):
+            assert len(o) == len(p) + 8
+            assert (o < cfg.vocab_size).all() and (o >= 0).all()
+            np.testing.assert_array_equal(o[: len(p)], p)
+        # different seeds draw different continuations somewhere
+        assert any(
+            not np.array_equal(x, y) for x, y in zip(a, b)
+        )
