@@ -30,7 +30,12 @@ def main() -> int:
     ap.add_argument("--quant_kv", action="store_true",
                     help="int8 kv cache (half the decode HBM traffic)")
     ap.add_argument("--speculative", action="store_true",
-                    help="draft-model speculative decode (single stream)")
+                    help="draft-model speculative decode (one batched "
+                         "call over all requests)")
+    ap.add_argument("--spec_server", action="store_true",
+                    help="speculative rounds INSIDE the continuous-"
+                         "batching server (slot admission + per-slot "
+                         "acceptance)")
     ap.add_argument("--draft_layers", type=int, default=1)
     ap.add_argument("--tp", type=int, default=0,
                     help="shard params over an N-way 'tp' mesh")
@@ -112,14 +117,26 @@ def main() -> int:
         mode = (f"speculative(batched) k=4 tokens/round="
                 f"{stats.get('tokens_per_round', 0):.2f}")
     else:
+        draft_kw = {}
+        mode = f"continuous-batching slots={args.slots}"
+        if args.spec_server:
+            dcfg = llama.LlamaConfig.tiny(n_layer=args.draft_layers)
+            draft_kw = {
+                "draft": (
+                    llama.init_params(jax.random.PRNGKey(7), dcfg),
+                    dcfg,
+                ),
+                "draft_k": 4,
+            }
+            mode = (f"continuous-batching+speculative "
+                    f"slots={args.slots} k=4")
         srv = llama_infer.DecodeServer(
             params, cfg, slots=args.slots,
-            max_len=max(64, args.max_new_tokens + 16),
+            max_len=max(64, args.max_new_tokens + 24),
             temperature=args.temperature, seed=args.seed,
-            quant_kv=args.quant_kv,
+            quant_kv=args.quant_kv, **draft_kw,
         )
         outs = srv.serve(prompts, max_new_tokens=args.max_new_tokens)
-        mode = f"continuous-batching slots={args.slots}"
     dt = time.perf_counter() - t0
     total_new = sum(len(o) - len(p) for o, p in zip(outs, prompts))
     for i, o in enumerate(outs[:3]):
