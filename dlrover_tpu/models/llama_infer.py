@@ -577,6 +577,50 @@ def _spec_accept_round(
     return k, int(rng.choice(V, p=p[k] / float(p[k].sum())))
 
 
+def _spec_accept_batch(
+    p: np.ndarray,  # [B, k+1, V] target probs per row/slot
+    q: np.ndarray,  # [B, k, V] draft probs per row/slot
+    d: np.ndarray,  # [B, k] draft proposals
+    done: np.ndarray,  # [B] frozen rows (consume draws, results ignored)
+    np_rng: "np.random.Generator",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized rejection-sampling acceptance over the batch — the
+    numpy-batched form of :func:`_spec_accept_round` (the scalar
+    executable spec; a Monte-Carlo test asserts both implement the same
+    law).  One pass, no per-row Python, so the serving hot loop pays a
+    single host sync per round.  Returns ``(j, tok)``: per row the
+    accepted-prefix length and the round's final sampled token.  Frozen
+    rows draw uniforms they ignore; each active row's law is unchanged
+    (independent draws)."""
+    B, k = d.shape
+    V = p.shape[-1]
+    rows = np.arange(B)
+    cols = np.arange(k)
+    p_sel = p[rows[:, None], cols[None, :], d]  # [B, k]
+    q_sel = q[rows[:, None], cols[None, :], d]  # [B, k]
+    acc = np_rng.random((B, k)) < p_sel / np.maximum(q_sel, 1e-30)
+    # First rejected position (k if none): the accepted-prefix length.
+    j = acc.astype(np.int64).cumprod(axis=1).sum(axis=1)
+    j = np.where(done, 0, j)
+    # Rejected rows draw from the residual law at position j; fully
+    # accepting rows draw the bonus token from the target's p[k].
+    p_j = p[rows, j]  # [B, V]
+    q_j = q[rows, np.minimum(j, k - 1)]  # [B, V]
+    resid = np.where((j < k)[:, None], np.clip(p_j - q_j, 0.0, None), p_j)
+    s = resid.sum(axis=1)
+    # p == q to numerical precision: the residual is empty; any draw
+    # from p is distribution-correct.
+    empty = s <= 0.0
+    if empty.any():
+        resid = np.where(empty[:, None], p_j, resid)
+        s = resid.sum(axis=1)
+    # Inverse-CDF sample, one uniform per row.
+    tok = (
+        np.cumsum(resid, axis=1) < (np_rng.random(B) * s)[:, None]
+    ).sum(axis=1)
+    return j, np.minimum(tok, V - 1)
+
+
 def generate_speculative(
     params: Dict,
     cfg: LlamaConfig,
@@ -724,30 +768,30 @@ def _spec_decode_round(
     changes cache state, so callers (the batched generator, the
     speculative DecodeServer) own it."""
     B = int(cur.shape[0])
-    n = np.asarray(cache_t["offset"])  # [B]
+    n_dev = cache_t["offset"]  # [B] handle; fetched with the round's sync
     d, q, cache_d = progs["draft_roll"](draft_params, cache_d, cur, sub)
     chunk = jnp.concatenate([cur[:, None], d], axis=1)  # [B, k+1]
     g, cache_t = progs["target_verify"](params, cache_t, chunk)
-    d_host = np.asarray(d)
-    j = np.zeros(B, np.int64)
-    nxt = np.asarray(cur).copy()
+    # ONE host sync per round: acceptance below is pure numpy over the
+    # batch dimension (per-row Python loops + separate np.asarray syncs
+    # serialized the serving hot loop on the host — r4 advisor).  Frozen
+    # rows consume RNG draws they ignore; each active row's law is
+    # unchanged (independent uniforms).
+    rows = np.arange(B)
+    cur_h = np.asarray(cur)
     if sample:
-        g_host = np.asarray(g, np.float64)  # [B, k+1, V]
-        q_host = np.asarray(q, np.float64)  # [B, k, V]
-        for b in range(B):
-            if done[b]:
-                continue
-            j[b], nxt[b] = _spec_accept_round(
-                g_host[b], q_host[b], d_host[b], np_rng
-            )
+        n, d_host, g_raw, q_raw = jax.device_get((n_dev, d, g, q))
+        g_host = np.asarray(g_raw, np.float64)  # [B, k+1, V]
+        q_host = np.asarray(q_raw, np.float64)  # [B, k, V]
+        j, tok = _spec_accept_batch(g_host, q_host, d_host, done, np_rng)
+        nxt = np.where(done, cur_h, tok).astype(cur_h.dtype)
     else:
-        g_host = np.asarray(g)  # [B, k+1]
-        for b in range(B):
-            if done[b]:
-                continue
-            while j[b] < k and d_host[b, j[b]] == g_host[b, j[b]]:
-                j[b] += 1
-            nxt[b] = g_host[b, j[b]]
+        n, d_host, g_host = jax.device_get((n_dev, d, g))  # g [B, k+1]
+        match = (d_host == g_host[:, :k]).astype(np.int64)
+        j = match.cumprod(axis=1).sum(axis=1)  # longest matching prefix
+        j = np.where(done, 0, j)
+        nxt = np.where(done, cur_h, g_host[rows, j]).astype(cur_h.dtype)
+    n = np.asarray(n)
     # Per-row rewind; frozen rows keep their old offset.  ``max_off``
     # clamps rows finishing this round (emission stops at their budget/
     # EOS, so the clamp never loses live context) — without it a
@@ -764,7 +808,7 @@ def _spec_decode_round(
         # missing d_k at slot n+k; everyone else harmlessly writes its
         # next token's kv at its own next slot.
         tok_cu = np.where(full, d_host[:, k - 1], nxt).astype(
-            np.asarray(cur).dtype
+            cur_h.dtype
         )
         pos_cu = np.where(full, n + k, new_n)
         cache_d = dict(cache_d, offset=jnp.asarray(pos_cu, jnp.int32))
@@ -867,12 +911,14 @@ def generate_speculative_batched(
     emitted[:] = 1
     done |= hit
     rounds = 0
+    active_row_rounds = 0  # sum over rounds of non-frozen rows
     greedy_key = jax.random.PRNGKey(0)  # dead in the greedy trace
     while not done.all() and (emitted < N).any():
         if sample:
             rng, sub = jax.random.split(rng)
         else:
             sub = greedy_key
+        active_row_rounds += int((~done).sum())
         accepted_rows, nxt, cache_t, cache_d = _spec_decode_round(
             progs, params, draft_params, cache_t, cache_d, cur, done,
             k, sample, np_rng, sub,
@@ -902,8 +948,12 @@ def generate_speculative_batched(
         rounds += 1
     if stats is not None:
         stats["rounds"] = rounds
+        # Normalize by ACTIVE row-rounds, not rounds*B: frozen (done)
+        # rows ride along masked for most of a ragged batch's rounds and
+        # would dilute the per-row acceptance signal (r4 advisor).
         stats["tokens_per_round"] = (
-            float(emitted.sum() - B) / (rounds * B) if rounds else 0.0
+            float(emitted.sum() - B) / active_row_rounds
+            if active_row_rounds else 0.0
         )
     # Assemble the generate_ragged output contract.
     full_buf = np.full((B, P + N), pad_token, buf.dtype)
@@ -1152,12 +1202,19 @@ class DecodeServer:
             if n > self.buckets[-1]:
                 # Chunked prefill: every chunk is FULL — the final
                 # chunk's window shifts back to [n-C, n), re-scoring
-                # already-written positions with value-identical kv
-                # (k/v depend only on token and position), so no chunk
-                # pads past the prompt or writes beyond slot n-1 (a
-                # padded tail could run past max_len, where the dense
-                # write's dynamic_update_slice CLAMPS the start and
-                # silently corrupts live rows).
+                # already-written positions.  The re-score is value-
+                # identical because by the time the window shifts back,
+                # every cache slot before it is already correctly
+                # populated and attention is causal: position t's k/v
+                # recompute from the same complete prefix that produced
+                # them the first time.  (NOT because k/v depend only on
+                # token+position — for layers > 0 they depend on the
+                # whole prefix through the residual stream; re-scoring
+                # with an INCOMPLETE prefix would not be identical.)
+                # So no chunk pads past the prompt or writes beyond slot
+                # n-1 (a padded tail could run past max_len, where the
+                # dense write's dynamic_update_slice CLAMPS the start
+                # and silently corrupts live rows).
                 C = self.buckets[-1]
                 jkey = ("chunk", role)
                 if jkey not in self._prefill_jit:

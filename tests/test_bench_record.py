@@ -1,0 +1,96 @@
+"""The bench's CPU-fallback number of record.
+
+Round-4 failure mode: the driver's bench silently fell back to CPU and
+published a meaningless 0.01%-MFU headline while real hardware numbers
+sat (un-created) in the durable artifact.  `_tpu_number_of_record`
+resolves the best TPU-measured candidate across the append-per-run
+``BENCH_TPU_VERIFIED.json`` history so a fallback run can cite hardware
+data instead of noise (reference analogue: the benchmark tables the
+reference publishes are always hardware-measured,
+atorch/examples/llama2/README.md).
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import bench  # noqa: E402
+
+
+def test_no_file_returns_none(tmp_path):
+    assert bench._tpu_number_of_record(str(tmp_path / "nope.json")) is None
+
+
+def test_malformed_file_returns_none(tmp_path):
+    p = tmp_path / "BENCH_TPU_VERIFIED.json"
+    p.write_text("{not json")
+    assert bench._tpu_number_of_record(str(p)) is None
+    p.write_text(json.dumps({"runs": "oops"}))
+    assert bench._tpu_number_of_record(str(p)) is None
+
+
+def test_best_row_across_runs_newest_wins_ties(tmp_path):
+    p = tmp_path / "BENCH_TPU_VERIFIED.json"
+    p.write_text(json.dumps({
+        "runs": [
+            {"started": "2026-07-30T00:00:00Z", "candidates": [
+                {"model": "a", "mfu_pct": 43.2, "step_time_s": 0.31,
+                 "batch": 8, "remat": "none"},
+                {"model": "b", "error": "OOM"},
+            ]},
+            {"started": "2026-07-31T00:00:00Z", "candidates": [
+                {"model": "c", "mfu_pct": 50.8, "step_time_s": 0.27,
+                 "batch": 8, "remat": "none"},
+                {"model": "d", "mfu_pct": 50.8, "step_time_s": 0.28,
+                 "batch": 16, "remat": "block"},
+            ]},
+        ]
+    }))
+    rec = bench._tpu_number_of_record(str(p))
+    assert rec is not None
+    assert rec["mfu_pct"] == 50.8
+    # ties broken toward the later-listed (newer) row
+    assert rec["model"] == "d"
+    assert rec["run_started"] == "2026-07-31T00:00:00Z"
+
+
+def test_error_only_history_returns_none(tmp_path):
+    p = tmp_path / "BENCH_TPU_VERIFIED.json"
+    p.write_text(json.dumps({
+        "runs": [{"started": "x", "candidates": [{"error": "wedged"}]}]
+    }))
+    assert bench._tpu_number_of_record(str(p)) is None
+
+
+def test_non_numeric_mfu_rows_are_skipped(tmp_path):
+    p = tmp_path / "BENCH_TPU_VERIFIED.json"
+    p.write_text(json.dumps({
+        "runs": [{"started": "x", "candidates": [
+            {"model": "a", "mfu_pct": None},
+            {"model": "b", "mfu_pct": "50.8"},
+            {"model": "c", "mfu_pct": True},
+            {"model": "d", "mfu_pct": 43.2, "step_time_s": 0.3},
+        ]}]
+    }))
+    rec = bench._tpu_number_of_record(str(p))
+    assert rec is not None and rec["model"] == "d"
+
+
+def test_flush_and_read_share_schema(tmp_path, monkeypatch):
+    """The writer (_flush_partial) and reader (_tpu_number_of_record)
+    must agree on path + schema — both ride _load_tpu_history."""
+    monkeypatch.setattr(
+        bench, "_tpu_history_path",
+        lambda: str(tmp_path / "BENCH_TPU_VERIFIED.json"),
+    )
+    monkeypatch.setattr(bench, "_TPU_RUN_ID", None)
+    monkeypatch.setattr(
+        bench, "_partial_path", lambda: str(tmp_path / "p.json")
+    )
+    bench._flush_partial(
+        [{"model": "m", "mfu_pct": 51.0, "step_time_s": 0.2}], tpu=True
+    )
+    rec = bench._tpu_number_of_record()
+    assert rec is not None and rec["mfu_pct"] == 51.0

@@ -713,6 +713,56 @@ class TestSpeculativeDecode:
         emp = counts / N
         assert np.max(np.abs(emp - p[0])) < 0.015, (emp, p[0])
 
+    def test_batched_acceptance_matches_scalar_spec_law(self):
+        """_spec_accept_batch is the vectorized serving-path form of
+        _spec_accept_round (the scalar executable spec).  Monte-Carlo:
+        both must emit the round's FIRST token with the target's p[0]
+        law, and their accepted-length distributions must agree — drift
+        between the two implementations ships silently otherwise."""
+        rng = np.random.default_rng(0)
+        V, k, B = 8, 3, 16
+        p = rng.dirichlet(np.ones(V), size=k + 1)
+        q = rng.dirichlet(np.ones(V) * 0.3, size=k)
+        N = 3000  # x B rows = 48k trials
+        counts = np.zeros(V)
+        jcounts = np.zeros(k + 1)
+        pb = np.broadcast_to(p, (B, k + 1, V))
+        qb = np.broadcast_to(q, (B, k, V))
+        done = np.zeros(B, bool)
+        for _ in range(N):
+            d = np.stack(
+                [rng.choice(V, p=q[i], size=B) for i in range(k)], axis=1
+            )
+            j, tok = llama_infer._spec_accept_batch(pb, qb, d, done, rng)
+            first = np.where(j >= 1, d[:, 0], tok)
+            np.add.at(counts, first, 1)
+            np.add.at(jcounts, j, 1)
+        emp = counts / (N * B)
+        assert np.max(np.abs(emp - p[0])) < 0.01, (emp, p[0])
+        # Accepted-length law must match the scalar spec's.
+        sc_j = np.zeros(k + 1)
+        for _ in range(20000):
+            d = np.array([rng.choice(V, p=q[i]) for i in range(k)])
+            j, _ = llama_infer._spec_accept_round(p, q, d, rng)
+            sc_j[j] += 1
+        assert np.max(np.abs(jcounts / (N * B) - sc_j / 20000)) < 0.02, (
+            jcounts / (N * B), sc_j / 20000,
+        )
+
+    def test_batched_acceptance_frozen_rows_ride_along(self):
+        """done rows must come back with j=0 and any token — and their
+        presence must not perturb active rows' indexing."""
+        rng = np.random.default_rng(1)
+        V, k, B = 5, 2, 4
+        p = rng.dirichlet(np.ones(V), size=(B, k + 1))
+        q = rng.dirichlet(np.ones(V), size=(B, k))
+        d = rng.integers(0, V, size=(B, k))
+        done = np.array([False, True, False, True])
+        j, tok = llama_infer._spec_accept_batch(p, q, d, done, rng)
+        assert (j[done] == 0).all()
+        assert j.shape == (B,) and tok.shape == (B,)
+        assert (tok >= 0).all() and (tok < V).all()
+
     def test_sampled_speculative_runs_and_differs_by_seed(self):
         cfg, params, prompts = self._target()
         dparams = llama.init_params(jax.random.PRNGKey(9), cfg)
