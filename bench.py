@@ -126,7 +126,7 @@ def model_flops_per_step(cfg, batch, seq) -> float:
 
 
 def _measure_candidate(cfg, batch, seq, remat, iters, opt="adamw",
-                       fp8=False, accum=1, fused=None):
+                       fp8=False, accum=1, fused=None, progress=None):
     """Compile + time one (model, batch, remat, optimizer, fp8, accum)
     point through accelerate(); returns (sec/step, final loss) or
     raises (e.g. OOM).  ``accum`` microbatches inside the jitted step:
@@ -176,6 +176,7 @@ def _measure_candidate(cfg, batch, seq, remat, iters, opt="adamw",
         loss_fn = lambda p, b: llama.loss_fn(  # noqa: E731
             p, b, cfg, fused_lm_head=fused
         )
+    mark = progress or (lambda _m: None)
     job = accelerate(
         loss_fn=loss_fn,
         init_fn=lambda r: llama.init_params(r, cfg),
@@ -187,25 +188,32 @@ def _measure_candidate(cfg, batch, seq, remat, iters, opt="adamw",
         ),
         fp8_init=(lambda: llama.init_fp8_states(cfg)) if fp8 else None,
     )
+    mark("accelerate done (traced; XLA compile is the warmup step)")
     state = job.create_state(jax.random.PRNGKey(0))
     batch_pt = {"tokens": jnp.asarray(sample_tokens)}
     # Warmup/compile; the float() host transfer forces full completion
     # even on tunneled/async backends where block_until_ready is lazy.
     state, metrics = job.train_step(state, batch_pt)
     _ = float(metrics["loss"])
+    mark("warmup step done")
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for i in range(iters):
         state, metrics = job.train_step(state, batch_pt)
+        # A per-step host sync would distort the measurement; the mark
+        # only proves the DISPATCH is advancing (a wedged tunnel blocks
+        # dispatch too once its buffers back up).
+        mark(f"step {i + 1}/{iters} dispatched")
     loss = float(metrics["loss"])
     jax.block_until_ready(state)
     dt = (time.perf_counter() - t0) / iters
+    mark("timed steps complete")
     # Free this candidate's state before the next one compiles.
     del state, job, batch_pt
     return dt, loss
 
 
 def _measure_decode(cfg, batch, prompt_len, new_tokens,
-                    quant_kv=False):
+                    quant_kv=False, progress=None):
     """Decode tokens/s through the KV-cache generate path (the serving
     half; reference delegates this to vllm).  ``quant_kv`` stores the
     cache as int8 (half the HBM traffic per decoded token).  Returns
@@ -223,6 +231,7 @@ def _measure_decode(cfg, batch, prompt_len, new_tokens,
             0, cfg.vocab_size, (batch, prompt_len)
         ).astype("int32")
     )
+    mark = progress or (lambda _m: None)
     gen = jax.jit(
         lambda p, pr: llama_infer.generate(
             p, cfg, pr, max_new_tokens=new_tokens, temperature=0.0,
@@ -231,12 +240,15 @@ def _measure_decode(cfg, batch, prompt_len, new_tokens,
     )
     out = gen(params, prompts)
     jax.block_until_ready(out)
+    mark("decode warmup done")
     iters = 3
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for i in range(iters):
         out = gen(params, prompts)
+        mark(f"decode iter {i + 1}/{iters} dispatched")
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / iters
+    mark("decode complete")
     return batch * new_tokens / dt
 
 
@@ -252,11 +264,9 @@ def _measure_candidate_subproc(
     reach, and the whole bench (the round's one verified-perf artifact)
     produces nothing.  A subprocess can always be killed; a candidate
     that hangs just scores as failed and the sweep moves on."""
-    import os
-
     if timeout_s is None:
-        timeout_s = float(
-            os.environ.get("DLROVER_TPU_BENCH_CANDIDATE_TIMEOUT", "1800")
+        timeout_s = _env_float(
+            "DLROVER_TPU_BENCH_CANDIDATE_TIMEOUT", 1800.0
         )
     spec = {
         "model": name, "batch": batch, "seq": seq, "remat": remat,
@@ -271,17 +281,85 @@ def _measure_candidate_subproc(
     return result["dt"], result["loss"]
 
 
-def _run_one_subproc(spec, name, timeout_s):
-    """Ship a measurement spec to a killable --measure-one subprocess
-    and return its result dict (see _measure_candidate_subproc for why
-    in-process timeouts cannot work against a wedged device runtime)."""
+def _env_float(name: str, default: float) -> float:
+    """One parse for every float knob: a malformed env value falls back
+    to the default everywhere, instead of crashing at whichever of the
+    three call sites happened to be unguarded."""
+    import os
+
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _kill_group(proc) -> None:
     import os
     import signal
+
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    proc.wait()
+
+
+def _wait_with_progress(
+    proc, progress_path: str, timeout_s: float, stall_s: float,
+    poll_s: float = 2.0,
+) -> str:
+    """Wait for ``proc``, killing its whole group on either budget:
+    ``timeout_s`` total, or ``stall_s`` with no update to
+    ``progress_path`` (the subprocess touches it at every milestone —
+    import, accelerate, warmup, each timed step).
+
+    This is the wedge detector (VERDICT r4 weak #8): a tunnel that
+    wedges mid-candidate stops producing progress marks within seconds,
+    so the candidate dies after ``stall_s`` (~minutes) instead of the
+    full per-candidate timeout (900-1800s) — in a ~75-min live window
+    that difference is 2-3 extra measured candidates.  Compile is the
+    longest legitimately silent phase (~20-40s observed on the chip,
+    CALIBRATE_HBM rows), so the default 300s stall budget has >7x
+    headroom.  Returns "ok", "timeout", or "stalled"."""
+    import os
+    import time as _time
+
+    t0 = _time.time()
+
+    def _mtime() -> float:
+        try:
+            return os.path.getmtime(progress_path)
+        except OSError:
+            return t0
+
+    while True:
+        if proc.poll() is not None:
+            return "ok"
+        now = _time.time()
+        if now - t0 > timeout_s:
+            _kill_group(proc)
+            return "timeout"
+        if now - max(t0, _mtime()) > stall_s:
+            _kill_group(proc)
+            return "stalled"
+        _time.sleep(poll_s)
+
+
+def _run_one_subproc(spec, name, timeout_s, stall_s=None):
+    """Ship a measurement spec to a killable --measure-one subprocess
+    and return its result dict (see _measure_candidate_subproc for why
+    in-process timeouts cannot work against a wedged device runtime).
+    The subprocess writes progress marks to ``<out>.progress``; a
+    ``stall_s`` silence kills it early (wedge detector)."""
+    import os
     import subprocess
     import tempfile
 
+    if stall_s is None:
+        stall_s = _env_float("DLROVER_TPU_WEDGE_STALL_S", 300.0)
     out_fd, out_path = tempfile.mkstemp(prefix="bench_cand_")
     os.close(out_fd)
+    progress_path = out_path + ".progress"
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__),
          "--measure-one", out_path],
@@ -291,38 +369,69 @@ def _run_one_subproc(spec, name, timeout_s):
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
     try:
-        proc.communicate(json.dumps(spec).encode(), timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
-        proc.wait()
-        raise TimeoutError(
-            f"candidate {name} exceeded {timeout_s:.0f}s (wedged backend?)"
-        )
+        proc.stdin.write(json.dumps(spec).encode())
+        proc.stdin.close()
+    except OSError:
+        pass  # subprocess died at startup; the poll below reports it
+    outcome = _wait_with_progress(proc, progress_path, timeout_s, stall_s)
     try:
-        with open(out_path) as f:
-            result = json.load(f)
-    except (OSError, ValueError):
-        raise RuntimeError(
-            f"candidate {name} failed (exit {proc.returncode})"
-        )
-    finally:
+        if outcome == "timeout":
+            raise TimeoutError(
+                f"candidate {name} exceeded {timeout_s:.0f}s "
+                "(wedged backend?)"
+            )
+        if outcome == "stalled":
+            raise TimeoutError(
+                f"candidate {name} made no progress for {stall_s:.0f}s "
+                "(wedged backend?)"
+            )
         try:
-            os.unlink(out_path)
-        except OSError:
-            pass
+            with open(out_path) as f:
+                result = json.load(f)
+        except (OSError, ValueError):
+            raise RuntimeError(
+                f"candidate {name} failed (exit {proc.returncode})"
+            )
+    finally:
+        for p in (out_path, progress_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
     if "error" in result:
         raise RuntimeError(result["error"])
     return result
 
 
+_PROGRESS_FILES: dict = {}
+
+
+def _progress_mark(progress_path: Optional[str], msg: str) -> None:
+    """Append a milestone line to the wedge-detector sidecar (the parent
+    watches its mtime; content is for post-mortems).  The handle is
+    opened once and kept (one write+flush per mark, ~10us): some marks
+    land inside the timed measurement window, and per-mark open/close
+    syscalls would bias the reported step time."""
+    if not progress_path:
+        return
+    try:
+        f = _PROGRESS_FILES.get(progress_path)
+        if f is None:
+            f = _PROGRESS_FILES[progress_path] = open(progress_path, "a")
+        f.write(f"{time.time():.1f} {msg}\n")
+        f.flush()
+    except OSError:
+        pass
+
+
 def _measure_one_main(out_path: str) -> int:
     """Subprocess entry: read a candidate spec JSON on stdin, measure
-    in-process, write {dt, loss} (or {error}) to ``out_path``."""
+    in-process, write {dt, loss} (or {error}) to ``out_path``.  Emits
+    progress marks to ``<out>.progress`` so the parent's wedge detector
+    can distinguish a long compile from a dead tunnel."""
     import dataclasses as _dc
 
+    import functools
     import os
 
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
@@ -330,10 +439,13 @@ def _measure_one_main(out_path: str) -> int:
 
         jax.config.update("jax_platforms", "cpu")  # beat the tunnel shim
     spec = json.load(sys.stdin)
+    mark = functools.partial(_progress_mark, out_path + ".progress")
+    mark("spec read")
     result: dict
     try:
         from dlrover_tpu.models import llama
 
+        mark("imports done")
         cfg_kwargs = dict(spec["cfg"])
         # dtype is not JSON-serializable; configs here are bf16 anyway.
         cfg = llama.LlamaConfig(**{
@@ -344,6 +456,7 @@ def _measure_one_main(out_path: str) -> int:
             tps = _measure_decode(
                 cfg, spec["batch"], spec["prompt_len"],
                 spec["new_tokens"], spec.get("quant_kv", False),
+                progress=mark,
             )
             result = {"dt": 0.0, "loss": 0.0, "tokens_per_sec": tps}
         else:
@@ -351,6 +464,7 @@ def _measure_one_main(out_path: str) -> int:
                 cfg, spec["batch"], spec["seq"], spec["remat"],
                 spec["iters"], spec["opt"], spec["fp8"],
                 spec.get("accum", 1), spec.get("fused"),
+                progress=mark,
             )
             result = {"dt": dt, "loss": loss}
     except Exception as e:  # noqa: BLE001
@@ -672,13 +786,9 @@ def main() -> int:
     # Global deadline: the driver needs ONE final JSON line.  A tunnel
     # that wedges mid-sweep must cost the remaining candidates, not the
     # artifact — measured partials are summarized when time is up.
-    try:
-        _deadline_s = float(
-            os.environ.get("DLROVER_TPU_BENCH_DEADLINE", "2700")
-        )
-    except ValueError:  # malformed knob must not cost the artifact
-        _deadline_s = 2700.0
-    bench_deadline = time.time() + _deadline_s
+    bench_deadline = time.time() + _env_float(
+        "DLROVER_TPU_BENCH_DEADLINE", 2700.0
+    )
 
     def _time_left() -> float:
         return bench_deadline - time.time()
