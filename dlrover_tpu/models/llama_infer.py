@@ -970,6 +970,22 @@ def generate_speculative_batched(
     )
 
 
+def _adapt_spec_k(cur_k: int, draft_k: int, acc: float) -> int:
+    """The adaptive-speculation policy, pure so the arithmetic is
+    directly testable.  ``acc`` is measured tokens-per-active-row-round
+    in [1, cur_k+1].  A weak draft (acc near 1) makes every round pay
+    cur_k wasted draft forwards — halve.  A strong draft saturating its
+    window (acc near cur_k+1) earns a bigger one — double, CAPPED at
+    the construction-time ``draft_k``: serve()'s cache-headroom
+    capacity check was sized with draft_k, and growing past it would
+    let a full-acceptance round scatter beyond max_len."""
+    if acc < 1.0 + 0.3 * cur_k and cur_k > 1:
+        return max(1, cur_k // 2)
+    if acc > 1.0 + 0.8 * cur_k and cur_k < draft_k:
+        return min(draft_k, cur_k * 2)
+    return cur_k
+
+
 class DecodeServer:
     """Continuous-batching greedy/sampled decode over fixed slots — the
     role vllm plays for the reference's RL engine
@@ -1004,6 +1020,8 @@ class DecodeServer:
         quant_kv: bool = False,  # int8 kv cache (see init_cache)
         draft: Optional[Tuple[Dict, LlamaConfig]] = None,
         draft_k: int = 4,
+        adapt_k: bool = False,  # shrink/regrow k from measured acceptance
+        adapt_every: int = 16,  # rounds per adaptation window
     ):
         if cfg.sliding_window > 0:
             raise ValueError("DecodeServer: sliding-window models "
@@ -1024,6 +1042,12 @@ class DecodeServer:
         # shape.  Token law per request is unchanged.
         self.draft = draft
         self.draft_k = draft_k
+        self.adapt_k = adapt_k
+        self.adapt_every = max(1, adapt_every)
+        # Telemetry of the last serve() call: rounds, active row-rounds,
+        # emitted tokens, tokens_per_round (the acceptance signal), and
+        # the k trajectory when adapt_k is on.
+        self.last_stats: Dict[str, Any] = {}
         self.temperature = temperature
         self.top_k = top_k
         self.top_p = top_p
@@ -1285,9 +1309,16 @@ class DecodeServer:
         sample = self.temperature > 0.0
         greedy_key = jax.random.PRNGKey(0)  # dead in the greedy trace
         spec_progs = None
+        cur_k = self.draft_k
+        # Acceptance telemetry (whole serve + current adaptation
+        # window): tokens_per_round over ACTIVE row-rounds is the
+        # speculation-efficiency signal adapt_k steers on.
+        spec_rounds = spec_row_rounds = spec_tokens = 0
+        win_row_rounds = win_tokens = 0
+        k_history = [cur_k]
         if self.draft is not None:
             spec_progs = _spec_programs(
-                cfg, self.draft[1], self.draft_k, self.temperature,
+                cfg, self.draft[1], cur_k, self.temperature,
                 self.top_k, self.top_p,
             )
         while queue or active.any():
@@ -1300,14 +1331,20 @@ class DecodeServer:
                 # Speculative round over ALL slots: each drafts k, one
                 # chunked ragged verify, per-slot acceptance; idle
                 # slots ride along frozen (done mask).
+                round_active = int(active.sum())
                 accepted_rows, nxt, cache, cache_d = _spec_decode_round(
                     spec_progs, self.params, self.draft[0], cache,
-                    cache_d, toks, ~active, self.draft_k, sample,
+                    cache_d, toks, ~active, cur_k, sample,
                     self._np_rng,
                     self._next_key() if sample else greedy_key,
                     max_off=slot_bound,
                 )
                 toks = jnp.asarray(nxt)
+                # Acceptance BEFORE EOS/budget truncation: what the
+                # draft earned, the signal k adapts on.
+                round_tokens = sum(
+                    len(accepted_rows[s]) for s in range(B) if active[s]
+                )
                 for s in range(B):
                     if not active[s]:
                         continue
@@ -1320,6 +1357,28 @@ class DecodeServer:
                         ):
                             finish(s)
                             break
+                spec_rounds += 1
+                spec_row_rounds += round_active
+                spec_tokens += round_tokens
+                win_row_rounds += round_active
+                win_tokens += round_tokens
+                if (
+                    self.adapt_k
+                    and spec_rounds % self.adapt_every == 0
+                    and win_row_rounds
+                ):
+                    new_k = _adapt_spec_k(
+                        cur_k, self.draft_k,
+                        win_tokens / win_row_rounds,
+                    )
+                    if new_k != cur_k:
+                        cur_k = new_k
+                        k_history.append(cur_k)
+                        spec_progs = _spec_programs(
+                            cfg, self.draft[1], cur_k, self.temperature,
+                            self.top_k, self.top_p,
+                        )
+                    win_row_rounds = win_tokens = 0
                 continue
             cache, nxt = self._step(
                 self.params, cache, toks, jnp.asarray(active),
@@ -1337,4 +1396,16 @@ class DecodeServer:
                     or budget[s] <= 0
                 ):
                     finish(s)
+        if self.draft is not None:
+            self.last_stats = {
+                "rounds": spec_rounds,
+                "active_row_rounds": spec_row_rounds,
+                "accepted_tokens": spec_tokens,
+                "tokens_per_round": (
+                    spec_tokens / spec_row_rounds
+                    if spec_row_rounds else 0.0
+                ),
+                "k_final": cur_k,
+                "k_history": k_history,
+            }
         return [results[i] for i in range(len(prompts))]

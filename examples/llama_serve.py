@@ -37,6 +37,9 @@ def main() -> int:
                          "batching server (slot admission + per-slot "
                          "acceptance)")
     ap.add_argument("--draft_layers", type=int, default=1)
+    ap.add_argument("--adapt_k", action="store_true",
+                    help="(--spec_server) shrink/regrow the draft "
+                         "window from measured acceptance")
     ap.add_argument("--tp", type=int, default=0,
                     help="shard params over an N-way 'tp' mesh")
     ap.add_argument("--temperature", type=float, default=0.0)
@@ -127,9 +130,11 @@ def main() -> int:
                     dcfg,
                 ),
                 "draft_k": 4,
+                "adapt_k": args.adapt_k,
             }
             mode = (f"continuous-batching+speculative "
-                    f"slots={args.slots} k=4")
+                    f"slots={args.slots} k=4"
+                    + (" adapt_k" if args.adapt_k else ""))
         srv = llama_infer.DecodeServer(
             params, cfg, slots=args.slots,
             max_len=max(64, args.max_new_tokens + 24),
@@ -137,6 +142,10 @@ def main() -> int:
             quant_kv=args.quant_kv, **draft_kw,
         )
         outs = srv.serve(prompts, max_new_tokens=args.max_new_tokens)
+        if srv.last_stats:
+            st = srv.last_stats
+            mode += (f" tokens/round={st['tokens_per_round']:.2f}"
+                     f" k_final={st['k_final']}")
     dt = time.perf_counter() - t0
     total_new = sum(len(o) - len(p) for o, p in zip(outs, prompts))
     for i, o in enumerate(outs[:3]):

@@ -1079,6 +1079,85 @@ class TestSpeculativeServer:
         ))[0]
         np.testing.assert_array_equal(outs[0], solo)
 
+    def test_spec_server_acceptance_telemetry(self):
+        """serve() must surface the speculation-efficiency signal:
+        a perfect draft (== target) accepts ~k+1 tokens per round, a
+        disagreeing random draft ~1."""
+        cfg, params, dcfg, draft = self._models()
+        prompts = [(np.arange(4, dtype=np.int32) % 7) + 1]
+        perfect = llama_infer.DecodeServer(
+            params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+            draft=(params, cfg), draft_k=3,
+        )
+        perfect.serve(prompts, max_new_tokens=12)
+        assert perfect.last_stats["tokens_per_round"] > 3.0, (
+            perfect.last_stats
+        )
+        bad = llama_infer.DecodeServer(
+            params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+            draft=(draft, dcfg), draft_k=3,
+        )
+        bad.serve(prompts, max_new_tokens=12)
+        assert bad.last_stats["tokens_per_round"] < 2.5, bad.last_stats
+        assert bad.last_stats["rounds"] >= 1
+        assert bad.last_stats["k_final"] == 3  # adapt_k off: k untouched
+
+    def test_spec_server_adaptive_k_shrinks_on_bad_draft(self):
+        """A draft that never agrees wastes k forwards per round —
+        adapt_k must walk k down to 1, and the output law must stay
+        exactly the target's greedy decode throughout the k changes."""
+        cfg, params, dcfg, draft = self._models()
+        prompts = [(np.arange(4, dtype=np.int32) % 7) + 1,
+                   (np.arange(6, dtype=np.int32) % 5) + 2]
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=96, prompt_buckets=(8,),
+            draft=(draft, dcfg), draft_k=4, adapt_k=True, adapt_every=4,
+        )
+        outs = srv.serve(prompts, max_new_tokens=24)
+        assert srv.last_stats["k_final"] == 1, srv.last_stats
+        assert srv.last_stats["k_history"][0] == 4
+        for p, got in zip(prompts, outs):
+            solo = np.asarray(llama_infer.generate(
+                params, cfg, jnp.asarray(p)[None, :], max_new_tokens=24
+            ))[0]
+            np.testing.assert_array_equal(got, solo)
+
+    def test_adapt_policy_arithmetic(self):
+        """The pure policy: shrink on weak acceptance, regrow on
+        saturation, hard cap at draft_k (the cache headroom was sized
+        with it), floor at 1.  The regrow/cap arithmetic is only
+        reachable in serve() after a shrink, so it is pinned here."""
+        f = llama_infer._adapt_spec_k
+        # shrink: acc near 1 halves k, floors at 1
+        assert f(4, 4, 1.0) == 2
+        assert f(2, 4, 1.0) == 1
+        assert f(1, 4, 1.0) == 1  # floor
+        # hold: mid acceptance changes nothing
+        assert f(4, 4, 3.0) == 4
+        # regrow: saturated window doubles, capped at draft_k
+        assert f(2, 4, 3.0) == 4
+        assert f(1, 4, 2.0) == 2
+        assert f(2, 3, 3.0) == 3  # cap clips the doubling
+        assert f(4, 4, 5.0) == 4  # never past draft_k
+        # shrink threshold scales with k: acc=2.0 at k=4 is weak...
+        assert f(4, 4, 2.0) == 2
+        # ...but at k=2 it is healthy
+        assert f(2, 4, 2.0) == 2
+
+    def test_spec_server_adaptive_k_holds_on_perfect_draft(self):
+        """Draft == target saturates every window: k must stay at
+        draft_k (and never exceed it — the cache headroom capacity
+        check was sized with it)."""
+        cfg, params, _, _ = self._models()
+        prompts = [(np.arange(4, dtype=np.int32) % 7) + 1]
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=1, max_len=96, prompt_buckets=(8,),
+            draft=(params, cfg), draft_k=3, adapt_k=True, adapt_every=2,
+        )
+        srv.serve(prompts, max_new_tokens=20)
+        assert srv.last_stats["k_final"] == 3, srv.last_stats
+        assert max(srv.last_stats["k_history"]) <= 3
+
     def test_spec_server_sampled_smoke_and_seed_sensitivity(self):
         cfg, params, dcfg, draft = self._models()
         prompts = [

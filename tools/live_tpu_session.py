@@ -147,14 +147,21 @@ def repro_800m_argv() -> list:
     return [sys.executable, "-c", code]
 
 
+# Stages whose artifact is a RESUMABLE partial: existence alone does
+# not mean done — the tool marks "complete" once every row/point is
+# settled, and an incomplete artifact means "retry; measured rows are
+# kept".
+_RESUMABLE = {"flash_tune", "spec_decode"}
+
+
 def _stage_done(name: str, artifact: str) -> bool:
-    """A stage is done when its artifact exists — except flash_tune,
-    which RESUMES from a partial artifact and is only done once the
-    tool has marked the whole grid measured."""
+    """A stage is done when its artifact exists — except resumable
+    stages, which are only done once the tool has marked the whole
+    table/grid measured."""
     apath = os.path.join(REPO, artifact)
     if not os.path.exists(apath):
         return False
-    if name != "flash_tune":
+    if name not in _RESUMABLE:
         return True
     try:
         with open(apath) as f:
@@ -184,6 +191,13 @@ STAGES = [
     # variants) with startup headroom, or a SIGKILL lands between
     # variants and a partial artifact permanently marks the stage done.
     ("decode", "DECODE_TPU.json", decode_stage_argv, 2400.0),
+    # Speculation's win condition on hardware: plain vs spec ceiling/
+    # floor plus component-derived break-even (bench spec_bench_main
+    # flushes rows as they complete and resumes measured rows; outer
+    # timeout must exceed 4 rows x 900s inner budgets + headroom).
+    ("spec_decode", "SPEC_DECODE_TPU.json",
+     lambda: [sys.executable, os.path.join(REPO, "bench.py"),
+              "--spec_bench"], 4200.0),
     # Remaining hardware unknowns (offload_opt x remat=offload on the
     # real partitioner, node-check payload timing, device-cache hit
     # path vs host pull) — each probe is its own killable subprocess.
