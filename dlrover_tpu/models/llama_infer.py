@@ -1176,9 +1176,15 @@ class DecodeServer:
 
         return jax.jit(fn)
 
-    def serve(self, prompts, max_new_tokens: int):
+    def serve(self, prompts, max_new_tokens: int, on_finish=None):
         """Decode every prompt (a list of 1-D int arrays); returns a
-        list of 1-D arrays (prompt + continuation, EOS included)."""
+        list of 1-D arrays (prompt + continuation, EOS included).
+
+        ``on_finish(rid, tokens)`` fires the moment request ``rid``
+        completes (its slot is freed for re-admission) — the hook
+        elastic serving journals completions through, so a worker kill
+        mid-serve only costs the in-flight requests (replayed on
+        restart), never the finished ones."""
         import numpy as onp
 
         cfg = self.cfg
@@ -1305,6 +1311,8 @@ class DecodeServer:
             )
             active[slot] = False
             slot_req[slot] = -1
+            if on_finish is not None:
+                on_finish(rid, results[rid])
 
         sample = self.temperature > 0.0
         greedy_key = jax.random.PRNGKey(0)  # dead in the greedy trace
@@ -1409,3 +1417,96 @@ class DecodeServer:
                 "k_history": k_history,
             }
         return [results[i] for i in range(len(prompts))]
+
+
+def serve_journaled(
+    server: "DecodeServer",
+    prompts: list,
+    max_new_tokens: int,
+    journal_path: str,
+    on_serve=None,
+) -> list:
+    """Elastic serving: an append-only completion journal + idempotent
+    replay — the serving analogue of the trainer's flash checkpoint.
+
+    A KV cache dies with its process, so the recovery unit for serving
+    is the REQUEST, not device state: every completed request is
+    fsync'd to ``journal_path`` (one JSON line) the moment its slot
+    frees; a restarted worker loads the journal, skips finished
+    requests, and re-serves only the in-flight remainder (greedy decode
+    is deterministic, so replay emits byte-identical results).  A torn
+    final line from a SIGKILL mid-append is ignored and that request is
+    simply replayed.  The reference has no elastic serving story at all
+    (its RL stack shells out to a vllm the job master never supervises,
+    atorch/rl/model_engine/model_engine.py:35) — this composes the
+    continuous-batching server with the same kill-tolerance contract
+    the trainer gets from agent restart + warm restore.
+
+    Returns the full result list in request order.  ``on_serve(rid,
+    tokens)`` additionally fires for every newly served (non-replayed)
+    completion — progress reporting for the elastic agent's hang
+    detector.
+    """
+    import json as _json
+    import os as _os
+
+    if server.temperature > 0.0:
+        # Replay determinism is the whole contract: a restarted worker
+        # re-serves only the in-flight subset, so a sampling server's
+        # RNG stream and admission order differ across incarnations and
+        # the results would silently mix two different draws.
+        raise ValueError(
+            "serve_journaled requires a greedy server "
+            "(temperature=0): sampled replay after a restart is not "
+            "byte-identical"
+        )
+    done: Dict[int, np.ndarray] = {}
+    try:
+        with open(journal_path, "r+") as f:
+            content = f.read()
+            # Torn tail from a kill mid-append: TRUNCATE to the last
+            # complete line before any new append — otherwise the next
+            # record concatenates onto the partial one and both become
+            # unparseable (losing a FINISHED request on a later
+            # restart).
+            cut = content.rfind("\n") + 1
+            if cut < len(content):
+                f.truncate(cut)
+            for line in content[:cut].split("\n"):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = _json.loads(line)
+                except ValueError:
+                    continue  # a torn line persisted by an old writer
+                done[int(rec["rid"])] = np.asarray(
+                    rec["tokens"], np.int32
+                )
+    except OSError:
+        pass
+    todo = [
+        (rid, p) for rid, p in enumerate(prompts) if rid not in done
+    ]
+    if todo:
+        jf = open(journal_path, "a")
+        try:
+            def _journal(local_rid, tokens):
+                rid = todo[local_rid][0]
+                jf.write(_json.dumps({
+                    "rid": rid,
+                    "tokens": [int(t) for t in tokens],
+                }) + "\n")
+                jf.flush()
+                _os.fsync(jf.fileno())
+                done[rid] = np.asarray(tokens, np.int32)
+                if on_serve is not None:
+                    on_serve(rid, tokens)
+
+            server.serve(
+                [p for _, p in todo], max_new_tokens,
+                on_finish=_journal,
+            )
+        finally:
+            jf.close()
+    return [done[r] for r in range(len(prompts))]

@@ -1,0 +1,101 @@
+"""Elastic serving worker: a DecodeServer supervised by the elastic
+agent, with a completion journal so worker kills never lose finished
+requests.
+
+Run under the launcher (the agent restarts the worker on failure; the
+restarted worker replays only in-flight requests)::
+
+    python -m dlrover_tpu.run --standalone --nproc_per_node=1 \
+        examples/llama_serve_elastic.py -- \
+        --requests 12 --max_new_tokens 96 --journal_dir /tmp/j
+
+The reference's serving story has no elasticity at all (its RL stack
+shells out to an unsupervised vllm, atorch/rl/model_engine/
+model_engine.py:35); here the same master->agent supervision tree that
+restarts training workers restarts the serving worker, and
+``serve_journaled`` gives the serving-side restore contract (journal +
+deterministic replay instead of shm checkpoint restore).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--requests", type=int, default=12)
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--max_new_tokens", type=int, default=96)
+    p.add_argument("--journal_dir", required=True)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--throttle_s", type=float, default=0.0,
+                   help="sleep per completion (stretches the serve "
+                        "window so tests can land a kill mid-run)")
+    return p.parse_args()
+
+
+def main() -> int:
+    args = parse_args()
+
+    import dlrover_tpu.trainer as trainer_sdk
+
+    ctx = trainer_sdk.init()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models import llama, llama_infer
+
+    # Seeded model + requests: a restarted worker rebuilds the SAME
+    # server, so greedy replay is byte-identical.  float32 keeps the
+    # continuation independent of slot-batch shape too (bf16 argmax can
+    # flip near ties between batched and solo scoring).
+    cfg = llama.LlamaConfig.tiny(n_layer=2, dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(args.seed), cfg)
+    rng = np.random.RandomState(args.seed + 1)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=(int(n),)).astype(np.int32)
+        for n in rng.randint(4, 12, size=(args.requests,))
+    ]
+    os.makedirs(args.journal_dir, exist_ok=True)
+    journal = os.path.join(args.journal_dir, "results.jsonl")
+
+    srv = llama_infer.DecodeServer(
+        params, cfg, slots=args.slots,
+        max_len=max(64, args.max_new_tokens + 16),
+    )
+    served = [0]
+
+    def on_serve(rid, tokens):
+        served[0] += 1
+        # Progress for the agent's hang detector AND for kill-timing in
+        # the e2e test.
+        ctx.report_step(served[0])
+        print(f"SERVED rid={rid} ({served[0]} new this incarnation)",
+              flush=True)
+        if args.throttle_s > 0:
+            time.sleep(args.throttle_s)
+
+    t0 = time.perf_counter()
+    outs = llama_infer.serve_journaled(
+        srv, prompts, args.max_new_tokens, journal, on_serve=on_serve,
+    )
+    dt = time.perf_counter() - t0
+    replayed = len(prompts) - served[0]
+    total_new = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+    print(
+        f"SERVE_ELASTIC_DONE requests={len(outs)} "
+        f"served_now={served[0]} from_journal={replayed} "
+        f"new_tokens={total_new} tokens_per_sec={total_new / dt:.1f}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1213,3 +1213,103 @@ class TestTpServer:
                 params, cfg, jnp.asarray(p)[None, :], max_new_tokens=5
             ))[0]
             np.testing.assert_array_equal(got, solo)
+
+
+class TestServeJournaled:
+    """Elastic serving primitive: append-only completion journal +
+    idempotent replay (the serving analogue of flash checkpoint; the
+    reference has no elastic serving story at all)."""
+
+    def _setup(self, tmp_path, n=6):
+        cfg = llama.LlamaConfig.tiny(n_layer=2, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(1)
+        prompts = [
+            rng.randint(1, cfg.vocab_size, size=(int(ln),)).astype(
+                np.int32
+            )
+            for ln in rng.randint(4, 12, size=(n,))
+        ]
+        journal = str(tmp_path / "results.jsonl")
+        return cfg, params, prompts, journal
+
+    def _solo(self, params, cfg, p, n=16):
+        return np.asarray(llama_infer.generate(
+            params, cfg, jnp.asarray(p)[None], max_new_tokens=n
+        ))[0]
+
+    def test_first_pass_serves_all_and_journals(self, tmp_path):
+        cfg, params, prompts, journal = self._setup(tmp_path)
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=64
+        )
+        served = []
+        outs = llama_infer.serve_journaled(
+            srv, prompts, 16, journal,
+            on_serve=lambda r, t: served.append(r),
+        )
+        assert sorted(served) == list(range(6))
+        for p, o in zip(prompts, outs):
+            np.testing.assert_array_equal(o, self._solo(params, cfg, p))
+        with open(journal) as f:
+            assert sum(1 for _ in f) == 6
+
+    def test_replay_after_kill_skips_done_and_tolerates_torn_tail(
+        self, tmp_path
+    ):
+        cfg, params, prompts, journal = self._setup(tmp_path)
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=64
+        )
+        llama_infer.serve_journaled(srv, prompts, 16, journal)
+        lines = open(journal).read().strip().split("\n")
+        # Simulate a SIGKILL: 3 intact lines + one torn mid-record.
+        with open(journal, "w") as f:
+            f.write("\n".join(lines[:3]) + "\n" + lines[3][:20])
+        srv2 = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=64
+        )
+        served = []
+        outs = llama_infer.serve_journaled(
+            srv2, prompts, 16, journal,
+            on_serve=lambda r, t: served.append(r),
+        )
+        # Only the 3 lost requests (incl. the torn one) re-served.
+        assert len(served) == 3, served
+        for p, o in zip(prompts, outs):
+            np.testing.assert_array_equal(o, self._solo(params, cfg, p))
+        # The torn tail must have been TRUNCATED before the appends: a
+        # THIRD incarnation reads every record back (if the partial
+        # line had concatenated with the next append, both records
+        # would parse as garbage and finished work would re-serve).
+        served3 = []
+        llama_infer.serve_journaled(
+            srv2, prompts, 16, journal,
+            on_serve=lambda r, t: served3.append(r),
+        )
+        assert served3 == [], served3
+
+    def test_sampling_server_is_rejected(self, tmp_path):
+        """Replay of a sampled stream is not byte-identical across
+        incarnations — the journal contract is greedy-only."""
+        cfg, params, prompts, journal = self._setup(tmp_path)
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=64, temperature=0.7,
+        )
+        with pytest.raises(ValueError, match="greedy"):
+            llama_infer.serve_journaled(srv, prompts, 16, journal)
+
+    def test_fully_journaled_run_serves_nothing(self, tmp_path):
+        cfg, params, prompts, journal = self._setup(tmp_path)
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=64
+        )
+        llama_infer.serve_journaled(srv, prompts, 16, journal)
+        served = []
+        outs = llama_infer.serve_journaled(
+            srv, prompts, 16, journal,
+            on_serve=lambda r, t: served.append(r),
+        )
+        assert served == []
+        for p, o in zip(prompts, outs):
+            np.testing.assert_array_equal(o, self._solo(params, cfg, p))
