@@ -8,6 +8,7 @@ when the brain is unreachable (the master then keeps its local policy).
 
 from __future__ import annotations
 
+import dataclasses
 import uuid as uuid_mod
 from typing import List, Optional
 
@@ -135,11 +136,12 @@ class BrainResourceOptimizer(ResourceOptimizer):
                 local = self._local.generate_oom_recovery_plan([node])
                 plan.node_resources.update(local.node_resources)
                 continue
-            plan.node_resources[node.name] = NodeResource(
-                cpu=node.config_resource.cpu,
+            # replace() keeps every other resource field (tpu_type,
+            # tpu_topology, ...) — the relaunched pod must retain its
+            # scheduling contract.
+            plan.node_resources[node.name] = dataclasses.replace(
+                node.config_resource,
                 memory_mb=int(resp.resources.get("memory_mb", 0)),
-                tpu_chips=node.config_resource.tpu_chips,
-                tpu_type=node.config_resource.tpu_type,
             )
         return plan
 

@@ -32,6 +32,10 @@ class NodeResource:
     memory_mb: int = 0
     tpu_chips: int = 0
     tpu_type: str = ""
+    # GKE slice topology (``2x4``, ``4x4x4``): the
+    # ``cloud.google.com/gke-tpu-topology`` node selector — which slice
+    # SHAPE the pod's host must belong to, not how many chips it uses.
+    tpu_topology: str = ""
     disk_mb: int = 0
     priority: str = ""
 
@@ -55,6 +59,8 @@ class NodeResource:
                 res.tpu_chips = int(v)
             elif k == "tpu_type":
                 res.tpu_type = v
+            elif k == "tpu_topology":
+                res.tpu_topology = v
         return res
 
     def to_dict(self) -> dict:

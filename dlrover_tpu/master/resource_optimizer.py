@@ -87,11 +87,12 @@ class LocalHeuristicOptimizer(ResourceOptimizer):
             if node.exit_reason != NodeExitReason.OOM:
                 continue
             res = node.config_resource
-            new = NodeResource(
-                cpu=res.cpu,
+            # replace(), not a field-by-field rebuild: every OTHER
+            # resource field (tpu_type, tpu_topology, ...) must survive
+            # the relaunch or the new pod loses its scheduling contract.
+            new = dataclasses.replace(
+                res,
                 memory_mb=max(1, int(res.memory_mb * self._oom_factor)),
-                tpu_chips=res.tpu_chips,
-                tpu_type=res.tpu_type,
             )
             plan.node_resources[node.name] = new
             logger.info(

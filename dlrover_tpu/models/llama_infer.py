@@ -1433,8 +1433,15 @@ def serve_journaled(
     is the REQUEST, not device state: every completed request is
     fsync'd to ``journal_path`` (one JSON line) the moment its slot
     frees; a restarted worker loads the journal, skips finished
-    requests, and re-serves only the in-flight remainder (greedy decode
-    is deterministic, so replay emits byte-identical results).  A torn
+    requests, and re-serves only the in-flight remainder.  Replay is
+    byte-identical because greedy decode is deterministic AND the
+    server's compiled program shapes are fixed by its construction
+    (``slots``/buckets), not by the request subset: each slot row's
+    result is computationally independent of what rides in the other
+    slots, so serving fewer requests after a restart reproduces each
+    remaining request exactly — at any dtype.  (Comparing against a
+    B=1 solo decode is a DIFFERENT program shape, where bf16 argmax
+    can flip near ties — that's why the tests pin float32.)  A torn
     final line from a SIGKILL mid-append is ignored and that request is
     simply replayed.  The reference has no elastic serving story at all
     (its RL stack shells out to a vllm the job master never supervises,

@@ -118,6 +118,7 @@ def parse_elastic_job(doc: Dict[str, Any]) -> ElasticJobFile:
                 memory_mb=int(res.get("memoryMB", 0)),
                 tpu_chips=int(res.get("tpuChips", 0)),
                 tpu_type=str(res.get("tpuType", "")),
+                tpu_topology=str(res.get("tpuTopology", "")),
             ),
         )
 

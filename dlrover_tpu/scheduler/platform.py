@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import queue
+import re
 import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional
@@ -213,6 +214,96 @@ class InMemoryPlatform(PlatformClient):
         )
 
 
+# Accelerator flavour -> the cloud.google.com/gke-tpu-accelerator node
+# label GKE schedules TPU slices by.  A value already in label form
+# (contains a dash) passes through, so new flavours need no code change.
+_GKE_TPU_ACCELERATOR = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5litepod": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+_RFC1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+_TOPOLOGY = re.compile(r"^\d+x\d+(x\d+)?$")
+
+
+def gke_tpu_accelerator(tpu_type: str) -> str:
+    """Map a NodeResource.tpu_type (``v5e``) to GKE's accelerator node
+    label; unknown values with a dash are assumed to BE label values.
+    An empty type raises: guessing a flavour would pin the pod to hosts
+    the cluster may not have (a type-less resource simply emits no
+    selector — the caller's decision, not this mapping's)."""
+    t = (tpu_type or "").lower()
+    if t in _GKE_TPU_ACCELERATOR:
+        return _GKE_TPU_ACCELERATOR[t]
+    if "-" in t:
+        return t
+    raise ValueError(
+        f"unknown tpu_type {tpu_type!r}: expected one of "
+        f"{sorted(_GKE_TPU_ACCELERATOR)} or a full "
+        "gke-tpu-accelerator label value"
+    )
+
+
+def validate_gke_tpu_pod(pod, expect_tpu: bool = True) -> None:
+    """Schema-validate a pod we are about to submit against the GKE TPU
+    contract — the closest this environment gets to the reference's
+    envtest-based controller validation
+    (``go/operator/pkg/controllers/suite_test.go``): no cluster ever
+    sees our specs, so the invariants the API server / GKE webhook
+    would enforce are pinned here and exercised by the fake-API tests.
+
+    Raises ``ValueError`` with every violation (not just the first)."""
+    errs = []
+    name = getattr(pod.metadata, "name", None) or ""
+    if not _RFC1123.match(name) or len(name) > 63:
+        errs.append(f"pod name {name!r} is not RFC1123 (<=63 chars)")
+    labels = getattr(pod.metadata, "labels", None) or {}
+    for req in ("app", "node-type", "node-id", "rank-index"):
+        if req not in labels:
+            errs.append(f"missing label {req!r}")
+    for key in ("node-id", "rank-index"):
+        if key in labels and not str(labels[key]).isdigit():
+            errs.append(f"label {key}={labels[key]!r} is not an integer")
+    spec = pod.spec
+    if getattr(spec, "restart_policy", None) != "Never":
+        errs.append("restart_policy must be 'Never' (the master owns "
+                    "relaunch decisions, not the kubelet)")
+    containers = getattr(spec, "containers", None) or []
+    if not containers:
+        errs.append("no containers")
+    for cont in containers:
+        limits = getattr(
+            getattr(cont, "resources", None), "limits", None
+        ) or {}
+        tpu = limits.get("google.com/tpu")
+        if expect_tpu:
+            if tpu is None:
+                errs.append("expected a google.com/tpu limit")
+            elif not str(tpu).isdigit() or int(tpu) <= 0:
+                errs.append(f"google.com/tpu={tpu!r} must be a "
+                            "positive integer string")
+    selector = getattr(spec, "node_selector", None) or {}
+    if expect_tpu:
+        accel = selector.get("cloud.google.com/gke-tpu-accelerator")
+        topo = selector.get("cloud.google.com/gke-tpu-topology")
+        # A type-less resource legitimately emits no selector at all
+        # (the operator's choice); but topology WITHOUT the accelerator
+        # flavour is incoherent — GKE matches both labels together.
+        if topo is not None and not accel:
+            errs.append("gke-tpu-topology selector without the "
+                        "gke-tpu-accelerator flavour")
+        if topo is not None and not _TOPOLOGY.match(str(topo)):
+            errs.append(f"gke-tpu-topology {topo!r} must look like "
+                        "'2x4' or '4x4x4'")
+    if errs:
+        raise ValueError(
+            "pod spec violates the GKE TPU contract: " + "; ".join(errs)
+        )
+
+
 class GkePlatform(PlatformClient):
     """TPU node pods via the Kubernetes API (reference ``k8sClient :122``).
 
@@ -259,9 +350,30 @@ class GkePlatform(PlatformClient):
     def create_node(self, node: Node, job_name: str) -> PlatformNode:
         name = _node_name(job_name, node)
         c = self._client_mod
+        res = node.config_resource
         limits = {}
-        if node.config_resource.tpu_chips:
-            limits["google.com/tpu"] = str(node.config_resource.tpu_chips)
+        if res.tpu_chips:
+            limits["google.com/tpu"] = str(res.tpu_chips)
+        if res.cpu:
+            limits["cpu"] = str(res.cpu)
+        if res.memory_mb:
+            limits["memory"] = f"{res.memory_mb}Mi"
+        # GKE TPU scheduling contract: a pod requesting google.com/tpu
+        # SHOULD also select the accelerator flavour and slice topology,
+        # or the scheduler can place it on a host of the wrong slice
+        # shape (the pod then sits Pending or the runtime hands it the
+        # wrong chip count).  Selectors are emitted only when the config
+        # DECLARES a flavour — silently guessing one would pin the pod
+        # to hosts the cluster may not have.
+        selector = {}
+        if res.tpu_chips and res.tpu_type:
+            selector["cloud.google.com/gke-tpu-accelerator"] = (
+                gke_tpu_accelerator(res.tpu_type)
+            )
+            if res.tpu_topology:
+                selector["cloud.google.com/gke-tpu-topology"] = (
+                    res.tpu_topology
+                )
         pod = c.V1Pod(
             metadata=c.V1ObjectMeta(
                 name=name,
@@ -274,6 +386,7 @@ class GkePlatform(PlatformClient):
             ),
             spec=c.V1PodSpec(
                 restart_policy="Never",
+                node_selector=selector or None,
                 containers=[
                     c.V1Container(
                         name="main",
@@ -283,6 +396,7 @@ class GkePlatform(PlatformClient):
                 ],
             ),
         )
+        validate_gke_tpu_pod(pod, expect_tpu=bool(res.tpu_chips))
         self._core.create_namespaced_pod(self._namespace, pod)
         return PlatformNode(
             name=name,

@@ -43,9 +43,12 @@ class _FakeClientMod:
             super().__init__(name=name, image=image, resources=resources)
 
     class V1PodSpec(SimpleNamespace):
-        def __init__(self, restart_policy=None, containers=None):
+        def __init__(self, restart_policy=None, containers=None,
+                     node_selector=None):
             super().__init__(
-                restart_policy=restart_policy, containers=containers or []
+                restart_policy=restart_policy,
+                containers=containers or [],
+                node_selector=node_selector,
             )
 
     class V1Pod(SimpleNamespace):
@@ -176,6 +179,138 @@ class TestGkePlatform:
         assert platform.delete_node("jobx-worker-3")
         assert not platform.delete_node("jobx-worker-3")
         assert platform.list_nodes() == []
+
+    def test_tpu_pod_carries_gke_scheduling_contract(self):
+        """A TPU pod must select the accelerator flavour + slice
+        topology (GKE schedules slices by those node labels; the
+        reference pins pod-spec details with envtest, suite_test.go)."""
+        api, platform = make_gke()
+        node = Node(
+            NodeType.WORKER, 0, rank_index=0,
+            config_resource=NodeResource(
+                tpu_chips=8, tpu_type="v5p", tpu_topology="2x2x2",
+                cpu=4, memory_mb=8192,
+            ),
+        )
+        platform.create_node(node, "jobt")
+        pod = api.pods["jobt-worker-0"]
+        sel = pod.spec.node_selector
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == (
+            "tpu-v5p-slice"
+        )
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x2x2"
+        limits = pod.spec.containers[0].resources.limits
+        assert limits == {
+            "google.com/tpu": "8", "cpu": "4", "memory": "8192Mi",
+        }
+        # And the submitted pod passes the schema validator.
+        from dlrover_tpu.scheduler.platform import validate_gke_tpu_pod
+
+        validate_gke_tpu_pod(pod, expect_tpu=True)
+
+    def test_cpu_only_pod_has_no_tpu_selector(self):
+        api, platform = make_gke()
+        platform.create_node(
+            Node(NodeType.WORKER, 1, rank_index=1,
+                 config_resource=NodeResource(cpu=2)),
+            "jobt",
+        )
+        pod = api.pods["jobt-worker-1"]
+        assert pod.spec.node_selector is None
+        assert "google.com/tpu" not in (
+            pod.spec.containers[0].resources.limits
+        )
+
+    def test_typeless_tpu_pod_emits_no_selector(self):
+        """tpu_chips without tpu_type: the operator declared no flavour,
+        so no selector is guessed (pre-r5 behavior preserved — a silent
+        v5e default would strand the pod Pending on a v4/v5p cluster)."""
+        api, platform = make_gke()
+        platform.create_node(
+            Node(NodeType.WORKER, 2, rank_index=2,
+                 config_resource=NodeResource(tpu_chips=4)),
+            "jobt",
+        )
+        pod = api.pods["jobt-worker-2"]
+        assert pod.spec.node_selector is None
+        assert pod.spec.containers[0].resources.limits[
+            "google.com/tpu"] == "4"
+
+    def test_schema_validator_rejects_contract_violations(self):
+        from dlrover_tpu.scheduler.platform import (
+            gke_tpu_accelerator,
+            validate_gke_tpu_pod,
+        )
+
+        c = _FakeClientMod
+
+        def pod(name="jobx-worker-0", labels=None, restart="Never",
+                limits=None, selector="default"):
+            if selector == "default":
+                selector = {
+                    "cloud.google.com/gke-tpu-accelerator":
+                        "tpu-v5-lite-podslice",
+                    "cloud.google.com/gke-tpu-topology": "2x4",
+                }
+            return c.V1Pod(
+                metadata=c.V1ObjectMeta(
+                    name=name,
+                    labels=labels if labels is not None else {
+                        "app": "jobx", "node-type": "worker",
+                        "node-id": "0", "rank-index": "0",
+                    },
+                ),
+                spec=c.V1PodSpec(
+                    restart_policy=restart,
+                    node_selector=selector,
+                    containers=[c.V1Container(
+                        name="main", image="img",
+                        resources=c.V1ResourceRequirements(
+                            limits=limits if limits is not None
+                            else {"google.com/tpu": "4"},
+                        ),
+                    )],
+                ),
+            )
+
+        validate_gke_tpu_pod(pod())  # the good spec passes
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="RFC1123"):
+            validate_gke_tpu_pod(pod(name="Bad_Name"))
+        with _pytest.raises(ValueError, match="missing label"):
+            validate_gke_tpu_pod(pod(labels={"app": "jobx"}))
+        with _pytest.raises(ValueError, match="restart_policy"):
+            validate_gke_tpu_pod(pod(restart="Always"))
+        with _pytest.raises(ValueError, match="positive integer"):
+            validate_gke_tpu_pod(pod(limits={"google.com/tpu": "-1"}))
+        # no selector at all is legal (type-less resource)...
+        validate_gke_tpu_pod(pod(selector=None))
+        # ...but topology without the accelerator flavour is incoherent
+        with _pytest.raises(ValueError, match="gke-tpu-accelerator"):
+            validate_gke_tpu_pod(pod(selector={
+                "cloud.google.com/gke-tpu-topology": "2x4",
+            }))
+        with _pytest.raises(ValueError, match="gke-tpu-topology"):
+            validate_gke_tpu_pod(pod(selector={
+                "cloud.google.com/gke-tpu-accelerator":
+                    "tpu-v5-lite-podslice",
+                "cloud.google.com/gke-tpu-topology": "8chips",
+            }))
+        # every violation reported, not just the first
+        with _pytest.raises(ValueError) as ei:
+            validate_gke_tpu_pod(pod(name="Bad", restart="Always"))
+        assert "RFC1123" in str(ei.value)
+        assert "restart_policy" in str(ei.value)
+        # accelerator mapping: known flavours, pass-through, rejection
+        # (incl. the empty type — guessing a flavour would pin the pod
+        # to hosts the cluster may not have)
+        assert gke_tpu_accelerator("v6e") == "tpu-v6e-slice"
+        assert gke_tpu_accelerator("tpu-v7x-slice") == "tpu-v7x-slice"
+        with _pytest.raises(ValueError, match="unknown tpu_type"):
+            gke_tpu_accelerator("v99")
+        with _pytest.raises(ValueError, match="unknown tpu_type"):
+            gke_tpu_accelerator("")
 
     def test_watch_streams_events(self):
         api, platform = make_gke()
@@ -422,6 +557,7 @@ spec:
       resources:
         tpuChips: 8
         tpuType: v5p
+        tpuTopology: 2x2x2
         cpu: 16
         memoryMB: 4096
   template:
@@ -446,6 +582,7 @@ spec:
         assert jf.name == "testjob"
         assert jf.worker.replicas == 3
         assert jf.worker.resource.tpu_chips == 8
+        assert jf.worker.resource.tpu_topology == "2x2x2"
         assert jf.worker.resource.tpu_type == "v5p"
         assert jf.nproc_per_node == 4
         assert jf.script == "train.py"
