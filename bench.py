@@ -24,14 +24,83 @@ from typing import Optional
 REFERENCE_HFU_PCT = 62.5  # reference Llama2-7B FSDP HFU (BASELINE.md)
 
 
+def probe_live_backend(timeout_s: float = 120.0) -> str:
+    """Probe for a live DEVICE backend in throwaway subprocesses (a
+    hung ``jax.devices()`` cannot be recovered in-process).  The one
+    shared implementation of the probe policy — the bench guard and the
+    live-session watcher must not drift apart on it.
+
+    Returns:
+      - ``"ambient"``: the configured platform answered with a non-cpu
+        backend;
+      - ``"auto"``: only ``JAX_PLATFORMS=''`` auto-selection answered
+        (the tunnel shim has been observed to register under a
+        different platform name across restarts — 'axon' erroring with
+        "known backends: ['cpu', 'tpu']"); the caller should export
+        that choice to anything it spawns;
+      - ``"wedged"``: the probe HUNG (device endpoint dead mid-init; no
+        point trying other names — the endpoint itself is hung);
+      - ``"dead"``: every candidate failed fast.
+    """
+    import os
+    import signal
+    import subprocess
+
+    # Success = the matmul ran AND the backend is a device, whatever
+    # the platform registered itself as this time (name-matching 'tpu'
+    # would sleep through a live window if the shim picked another).
+    probe = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((128, 128));"
+        "assert float((x @ x).sum()) > 0;"
+        "assert jax.default_backend() != 'cpu';"
+        "print(jax.default_backend())"
+    )
+
+    def _probe_once(env) -> str:
+        # DEVNULL + its own session: on timeout the WHOLE process group
+        # dies — a wedged runtime's forked helpers would otherwise hold
+        # inherited pipes and possibly the device lock.
+        proc = subprocess.Popen(
+            [sys.executable, "-c", probe],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,
+            env=env,
+        )
+        try:
+            return "ok" if proc.wait(timeout=timeout_s) == 0 \
+                else "error"
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+            return "timeout"
+
+    outcome = _probe_once(dict(os.environ))
+    if outcome == "ok":
+        return "ambient"
+    if outcome == "timeout":
+        return "wedged"
+    if os.environ.get("JAX_PLATFORMS", "") != "":
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = ""
+        sub = _probe_once(env)
+        if sub == "ok":
+            return "auto"
+        if sub == "timeout":
+            return "wedged"
+    return "dead"
+
+
 def ensure_live_backend(probe_timeout_s: float = 120.0) -> None:
     """Guard against a wedged device tunnel: probe the configured backend
-    in a THROWAWAY subprocess (a hung ``jax.devices()`` cannot be
-    recovered in-process) and fall back to CPU if it never answers — a
-    benchmark that hangs forever reports nothing; one that reports
-    ``backend: cpu`` tells the truth about what happened."""
+    (see :func:`probe_live_backend`) and fall back to CPU if nothing
+    answers — a benchmark that hangs forever reports nothing; one that
+    reports ``backend: cpu`` tells the truth about what happened."""
     import os
-    import subprocess
 
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         # The tunneled-TPU PJRT shim prepends itself to jax_platforms at
@@ -43,34 +112,28 @@ def ensure_live_backend(probe_timeout_s: float = 120.0) -> None:
 
         jax.config.update("jax_platforms", "cpu")
         return
-    probe = (
-        "import jax, jax.numpy as jnp;"
-        "x = jnp.ones((128, 128));"
-        "print(float((x @ x).sum()))"
-    )
-    import signal
+    outcome = probe_live_backend(probe_timeout_s)
+    if outcome == "ambient":
+        return
+    if outcome == "auto":
+        print(
+            "bench: configured platform name failed; auto-select found "
+            "a live backend", file=sys.stderr,
+        )
+        # Export for subprocesses AND re-assert in-process: the shim's
+        # interpreter-start prepend would otherwise still resolve the
+        # failing name when this process imports jax (same reason the
+        # cpu branch above updates the config).
+        os.environ["JAX_PLATFORMS"] = ""
+        import jax
 
-    # DEVNULL (nothing reads the output) + its own session: on timeout
-    # the WHOLE process group dies — a wedged runtime's forked helpers
-    # would otherwise hold inherited pipes (hanging communicate()) and
-    # possibly the device lock.
-    proc = subprocess.Popen(
-        [sys.executable, "-c", probe],
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-        start_new_session=True,
-    )
-    try:
-        if proc.wait(timeout=probe_timeout_s) == 0:
-            return
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
-        proc.wait()
+        jax.config.update("jax_platforms", None)
+        return
     print(
-        "bench: configured backend unresponsive; falling back to CPU",
+        "bench: no live device backend ("
+        + ("probe hung — wedged tunnel?" if outcome == "wedged"
+           else "platform errored at registration")
+        + "); falling back to CPU",
         file=sys.stderr,
     )
     os.environ["JAX_PLATFORMS"] = "cpu"

@@ -31,23 +31,17 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-PROBE = (
-    "import jax, jax.numpy as jnp; "
-    "x = jnp.ones((128, 128)); "
-    "assert float((x @ x).sum()) > 0; "
-    "print(jax.default_backend())"
-)
-
-
 def tunnel_alive(timeout_s: float = 90.0) -> bool:
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", PROBE],
-            capture_output=True, timeout=timeout_s, cwd=REPO,
-        )
-        return out.returncode == 0 and b"tpu" in out.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    """One probe policy, shared with the bench guard
+    (``bench.probe_live_backend``): ambient platform first, then
+    auto-selection for the renamed-shim case.  When only auto answers,
+    the choice is exported so every stage subprocess inherits it."""
+    import bench
+
+    outcome = bench.probe_live_backend(timeout_s)
+    if outcome == "auto":
+        os.environ["JAX_PLATFORMS"] = ""
+    return outcome in ("ambient", "auto")
 
 
 def run_stage(name: str, argv: list, timeout_s: float, log) -> str:
