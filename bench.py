@@ -315,6 +315,45 @@ def _measure_decode(cfg, batch, prompt_len, new_tokens,
     return batch * new_tokens / dt
 
 
+def _measure_server_decode(cfg, slots, prompt_len, new_tokens,
+                           decode_chunk=1, quant_kv=False,
+                           progress=None, n_requests=None):
+    """Continuous-batching DecodeServer tokens/s — the SERVING number
+    (admission churn + host emit loop included), vs _measure_decode's
+    pure fixed-batch scan.  ``decode_chunk`` is the K-tokens-per-
+    dispatch lever: on a tunneled backend each dispatch costs real
+    latency, so K divides the dominant per-token cost."""
+    import numpy as np
+
+    import jax
+
+    from dlrover_tpu.models import llama, llama_infer
+
+    mark = progress or (lambda _m: None)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    n_requests = n_requests or slots * 3
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=(prompt_len,)).astype(
+            "int32"
+        )
+        for _ in range(n_requests)
+    ]
+    srv = llama_infer.DecodeServer(
+        params, cfg, slots=slots,
+        max_len=prompt_len + new_tokens + max(0, decode_chunk - 1),
+        decode_chunk=decode_chunk, quant_kv=quant_kv,
+    )
+    srv.serve(prompts[:slots], max_new_tokens=8)  # warmup/compile
+    mark("server warmup done")
+    t0 = time.perf_counter()
+    outs = srv.serve(prompts, max_new_tokens=new_tokens)
+    dt = time.perf_counter() - t0
+    mark("server serve done")
+    new = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+    return new / dt
+
+
 def _measure_spec_decode(cfg, draft_cfg, batch, prompt_len, new_tokens,
                          k, share_params=False, progress=None):
     """Speculative decode tokens/s + acceptance through the batched
@@ -649,6 +688,14 @@ def _measure_one_main(out_path: str) -> int:
                 progress=mark,
             )
             result = {"dt": 0.0, "loss": 0.0, "tokens_per_sec": tps}
+        elif spec.get("kind") == "server_decode":
+            tps = _measure_server_decode(
+                cfg, spec["slots"], spec["prompt_len"],
+                spec["new_tokens"],
+                spec.get("decode_chunk", 1),
+                spec.get("quant_kv", False), progress=mark,
+            )
+            result = {"dt": 0.0, "loss": 0.0, "tokens_per_sec": tps}
         elif spec.get("kind") in ("spec_decode", "spec_components"):
             dcfg = llama.LlamaConfig(**{
                 k: v for k, v in dict(spec["draft_cfg"]).items()
@@ -675,7 +722,7 @@ def _measure_one_main(out_path: str) -> int:
             )
             result = {"dt": dt, "loss": loss}
     except Exception as e:  # noqa: BLE001
-        result = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        result = {"error": f"{type(e).__name__}: {str(e)[:600]}"}
     with open(out_path, "w") as f:
         json.dump(result, f)
     return 0 if "error" not in result else 1
@@ -1035,10 +1082,10 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 - OOM/compile failure
             print(
                 f"bench: candidate {name} b={batch} remat={remat} "
-                f"opt={opt} failed: {type(e).__name__}: {str(e)[:200]}",
+                f"opt={opt} failed: {type(e).__name__}: {str(e)[:600]}",
                 file=sys.stderr,
             )
-            entry["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+            entry["error"] = f"{type(e).__name__}: {str(e)[:600]}"
             partial.append(entry)
             _flush_partial(partial, tpu=on_tpu)
             continue

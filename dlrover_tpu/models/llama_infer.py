@@ -1022,6 +1022,14 @@ class DecodeServer:
         draft_k: int = 4,
         adapt_k: bool = False,  # shrink/regrow k from measured acceptance
         adapt_every: int = 16,  # rounds per adaptation window
+        # Plain (non-speculative) decode: tokens per dispatch.  K > 1
+        # runs K steps under one lax.scan dispatch — K x fewer device
+        # round-trips and host emit loops.  The cost is admission
+        # latency (a slot finishing mid-chunk waits out the remainder
+        # before its slot re-admits) and up to K-1 wasted writes per
+        # finishing slot (covered by the capacity check's headroom;
+        # finished slots are re-zeroed at admission).
+        decode_chunk: int = 1,
     ):
         if cfg.sliding_window > 0:
             raise ValueError("DecodeServer: sliding-window models "
@@ -1044,6 +1052,20 @@ class DecodeServer:
         self.draft_k = draft_k
         self.adapt_k = adapt_k
         self.adapt_every = max(1, adapt_every)
+        if decode_chunk < 1:
+            raise ValueError(f"decode_chunk must be >= 1, got "
+                             f"{decode_chunk}")
+        if decode_chunk > 1 and draft is not None:
+            # Speculative rounds already batch k+1 tokens per dispatch;
+            # silently ignoring the flag would let a user believe they
+            # are benchmarking the K-dispatch lever while measuring
+            # plain speculative rounds.
+            raise ValueError(
+                "decode_chunk > 1 does not compose with a draft model "
+                "(speculative rounds already batch tokens per "
+                "dispatch); set one or the other"
+            )
+        self.decode_chunk = decode_chunk
         # Telemetry of the last serve() call: rounds, active row-rounds,
         # emitted tokens, tokens_per_round (the acceptance signal), and
         # the k trajectory when adapt_k is on.
@@ -1076,6 +1098,24 @@ class DecodeServer:
             return dict(new_cache, offset=frozen), nxt.astype(toks.dtype)
 
         self._step = jax.jit(step)
+
+        def chunk_step(params, cache, toks, active, sub):
+            # decode_chunk steps under ONE dispatch (lax.scan): on a
+            # tunneled/async backend each dispatch costs real latency,
+            # and the host emit loop costs more — K tokens per round
+            # divides both by K.
+            def body(carry, key):
+                cache, toks = carry
+                cache, nxt = step(params, cache, toks, active, key)
+                return (cache, nxt), nxt
+
+            (cache, toks), ys = jax.lax.scan(
+                body, (cache, toks),
+                jax.random.split(sub, self.decode_chunk),
+            )
+            return cache, toks, jnp.moveaxis(ys, 0, 1)  # [B, K]
+
+        self._chunk_step = jax.jit(chunk_step)
 
     def _next_key(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -1212,8 +1252,13 @@ class DecodeServer:
         # the cache — an out-of-range scatter is silently DROPPED by
         # JAX and would emit a plausible-but-wrong continuation.
         # Speculative rounds overshoot by up to draft_k+1 slots before
-        # the rewind — the capacity check must include that headroom.
-        slack = (self.draft_k + 1) if self.draft is not None else 0
+        # the rewind; chunked decode writes up to decode_chunk-1 slots
+        # past a mid-chunk EOS/budget finish — the capacity check must
+        # include that headroom.
+        slack = (
+            (self.draft_k + 1) if self.draft is not None
+            else self.decode_chunk - 1
+        )
         for rid, prompt in enumerate(prompts):
             need = len(prompt) + max_new_tokens + slack
             if need > self.max_len:
@@ -1314,6 +1359,26 @@ class DecodeServer:
             if on_finish is not None:
                 on_finish(rid, results[rid])
 
+        def emit_rows(rows):
+            """THE per-slot emit/finish law, shared by every decode
+            path (1-token step, K-token chunk, speculative round):
+            append each of slot s's new tokens until its EOS or budget,
+            then free the slot; the path's remaining tokens for a
+            finished slot are discarded (rows re-zero at admission,
+            capacity slack covered the extra writes)."""
+            for s in range(B):
+                if not active[s]:
+                    continue
+                for t in rows[s]:
+                    slot_out[s].append(int(t))
+                    budget[s] -= 1
+                    if (
+                        int(t) == self.eos_token
+                        or budget[s] <= 0
+                    ):
+                        finish(s)
+                        break
+
         sample = self.temperature > 0.0
         greedy_key = jax.random.PRNGKey(0)  # dead in the greedy trace
         spec_progs = None
@@ -1353,18 +1418,7 @@ class DecodeServer:
                 round_tokens = sum(
                     len(accepted_rows[s]) for s in range(B) if active[s]
                 )
-                for s in range(B):
-                    if not active[s]:
-                        continue
-                    for t in accepted_rows[s]:
-                        slot_out[s].append(int(t))
-                        budget[s] -= 1
-                        if (
-                            int(t) == self.eos_token
-                            or budget[s] <= 0
-                        ):
-                            finish(s)
-                            break
+                emit_rows(accepted_rows)
                 spec_rounds += 1
                 spec_row_rounds += round_active
                 spec_tokens += round_tokens
@@ -1388,22 +1442,19 @@ class DecodeServer:
                         )
                     win_row_rounds = win_tokens = 0
                 continue
+            if self.decode_chunk > 1:
+                cache, toks, chunk = self._chunk_step(
+                    self.params, cache, toks, jnp.asarray(active),
+                    self._next_key(),
+                )
+                emit_rows(onp.asarray(chunk))  # [B, K]
+                continue
             cache, nxt = self._step(
                 self.params, cache, toks, jnp.asarray(active),
                 self._next_key(),
             )
             toks = nxt
-            host_next = onp.asarray(nxt)
-            for s in range(B):
-                if not active[s]:
-                    continue
-                slot_out[s].append(int(host_next[s]))
-                budget[s] -= 1
-                if (
-                    int(host_next[s]) == self.eos_token
-                    or budget[s] <= 0
-                ):
-                    finish(s)
+            emit_rows(onp.asarray(nxt)[:, None])
         if self.draft is not None:
             self.last_stats = {
                 "rounds": spec_rounds,

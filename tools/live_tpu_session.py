@@ -84,24 +84,34 @@ def goodput_stage_argv() -> list:
 
 
 def decode_stage_argv() -> list:
-    # Dense and int8-kv variants: decode is HBM-bandwidth-bound, so the
-    # quant cache's half-sized reads should show directly in tokens/s.
-    # The artifact is written ONCE, only when BOTH variants measured:
-    # error-only or partial runs leave no artifact, so _stage_done()'s
-    # existence check retries the stage next cycle (a transient wedge
-    # must not permanently mask the int8 measurement this stage exists
-    # to collect).
+    # Dense and int8-kv generate() variants (decode is HBM-bandwidth-
+    # bound, so the quant cache's half-sized reads should show directly
+    # in tokens/s), plus the continuous-batching SERVER at 1 and 8
+    # tokens per dispatch (the decode_chunk lever: each tunnel dispatch
+    # costs real latency; K=8 measured ~6.5x tokens/s on the CPU
+    # host-loop bound).  The artifact is written ONCE, only when ALL
+    # variants measured: error-only or partial runs leave no artifact,
+    # so _stage_done()'s existence check retries the stage next cycle
+    # (a transient wedge must not permanently mask the measurements
+    # this stage exists to collect).
     code = (
         "import json, sys; sys.path.insert(0, %r); import bench; "
         "from dlrover_tpu.models import llama; "
         "cfg = llama.LlamaConfig.small_300m()\n"
+        "cfg_d = {k: v for k, v in cfg.__dict__.items()\n"
+        "         if isinstance(v, (int, float, str, bool))}\n"
         "out = {}\n"
         "for name, q in (('dense', False), ('int8_kv', True)):\n"
         "    spec = {'kind': 'decode', 'batch': 8, 'prompt_len': 128,\n"
-        "            'new_tokens': 128, 'quant_kv': q,\n"
-        "            'cfg': {k: v for k, v in cfg.__dict__.items()\n"
-        "                    if isinstance(v, (int, float, str, bool))}}\n"
+        "            'new_tokens': 128, 'quant_kv': q, 'cfg': cfg_d}\n"
         "    r = bench._run_one_subproc(spec, 'decode_' + name, 900.0)\n"
+        "    out[name] = {'tokens_per_sec': round(r['tokens_per_sec'], 1)}\n"
+        "    print(name, out[name])\n"
+        "for name, k in (('server_k1', 1), ('server_k8', 8)):\n"
+        "    spec = {'kind': 'server_decode', 'slots': 8,\n"
+        "            'prompt_len': 128, 'new_tokens': 128,\n"
+        "            'decode_chunk': k, 'cfg': cfg_d}\n"
+        "    r = bench._run_one_subproc(spec, name, 900.0)\n"
         "    out[name] = {'tokens_per_sec': round(r['tokens_per_sec'], 1)}\n"
         "    print(name, out[name])\n"
         "open(%r, 'w').write(json.dumps(out, indent=1))\n"
@@ -181,10 +191,11 @@ STAGES = [
               "--require-tpu"],
      1800.0),
     ("goodput", "GOODPUT_TPU.json", goodput_stage_argv, 2400.0),
-    # Outer timeout must exceed the stage's inner budgets (2 x 900s
+    # Outer timeout must exceed the stage's inner budgets (4 x 900s
     # variants) with startup headroom, or a SIGKILL lands between
-    # variants and a partial artifact permanently marks the stage done.
-    ("decode", "DECODE_TPU.json", decode_stage_argv, 2400.0),
+    # variants — the all-or-nothing artifact then retries from scratch
+    # next cycle.
+    ("decode", "DECODE_TPU.json", decode_stage_argv, 4200.0),
     # Speculation's win condition on hardware: plain vs spec ceiling/
     # floor plus component-derived break-even (bench spec_bench_main
     # flushes rows as they complete and resumes measured rows; outer
