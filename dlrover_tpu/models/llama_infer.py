@@ -1216,7 +1216,8 @@ class DecodeServer:
 
         return jax.jit(fn)
 
-    def serve(self, prompts, max_new_tokens: int, on_finish=None):
+    def serve(self, prompts, max_new_tokens: int, on_finish=None,
+              on_token=None):
         """Decode every prompt (a list of 1-D int arrays); returns a
         list of 1-D arrays (prompt + continuation, EOS included).
 
@@ -1224,7 +1225,14 @@ class DecodeServer:
         completes (its slot is freed for re-admission) — the hook
         elastic serving journals completions through, so a worker kill
         mid-serve only costs the in-flight requests (replayed on
-        restart), never the finished ones."""
+        restart), never the finished ones.
+
+        ``on_token(rid, token)`` fires for every emitted token the
+        round it lands on the host — token streaming (the role of
+        vllm's streaming API), including each request's FIRST token
+        (sampled at prefill).  With ``decode_chunk=K`` or a draft,
+        tokens arrive in bursts of up to K / k+1 per round — that is
+        the latency the dispatch batching buys throughput with."""
         import numpy as onp
 
         cfg = self.cfg
@@ -1345,6 +1353,8 @@ class DecodeServer:
             slot_req[slot] = rid
             slot_out[slot] = [int(first)]
             budget[slot] = max_new_tokens - 1
+            if on_token is not None:
+                on_token(rid, int(first))
             if int(first) == self.eos_token or budget[slot] <= 0:
                 finish(slot)
 
@@ -1372,6 +1382,8 @@ class DecodeServer:
                 for t in rows[s]:
                     slot_out[s].append(int(t))
                     budget[s] -= 1
+                    if on_token is not None:
+                        on_token(slot_req[s], int(t))
                     if (
                         int(t) == self.eos_token
                         or budget[s] <= 0

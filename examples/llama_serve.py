@@ -44,6 +44,10 @@ def main() -> int:
                     help="tokens per dispatch in plain serving (K x "
                          "fewer device round-trips; ~9x tokens/s at "
                          "K=16 on the CPU host-loop bound)")
+    ap.add_argument("--stream", action="store_true",
+                    help="print request 0's tokens as they decode "
+                         "(the vllm-streaming role of serve's "
+                         "on_token hook)")
     ap.add_argument("--tp", type=int, default=0,
                     help="shard params over an N-way 'tp' mesh")
     ap.add_argument("--temperature", type=float, default=0.0)
@@ -67,6 +71,12 @@ def main() -> int:
         cfg = llama.LlamaConfig.tiny(n_layer=2)
         params = llama.init_params(jax.random.PRNGKey(args.seed), cfg)
 
+    if args.stream and args.speculative:
+        raise SystemExit(
+            "--stream requires a server mode (it rides "
+            "DecodeServer.serve's on_token hook); the one-shot "
+            "--speculative batched call has no streaming surface"
+        )
     if args.tp > 0:
         from jax.sharding import Mesh
 
@@ -151,7 +161,13 @@ def main() -> int:
             quant_kv=args.quant_kv, decode_chunk=args.decode_chunk,
             **draft_kw,
         )
-        outs = srv.serve(prompts, max_new_tokens=args.max_new_tokens)
+        on_token = None
+        if args.stream:
+            def on_token(rid, tok):
+                if rid == 0:
+                    print(f"STREAM r0 +{tok}", flush=True)
+        outs = srv.serve(prompts, max_new_tokens=args.max_new_tokens,
+                         on_token=on_token)
         if srv.last_stats:
             st = srv.last_stats
             mode += (f" tokens/round={st['tokens_per_round']:.2f}"

@@ -1158,6 +1158,24 @@ class TestSpeculativeServer:
         assert srv.last_stats["k_final"] == 3, srv.last_stats
         assert max(srv.last_stats["k_history"]) <= 3
 
+    def test_spec_server_streams_tokens(self):
+        """on_token rides the shared emit path: speculative rounds
+        stream their accepted bursts too, in continuation order."""
+        cfg, params, dcfg, draft = self._models()
+        prompts = [(np.arange(4, dtype=np.int32) % 7) + 1,
+                   (np.arange(6, dtype=np.int32) % 5) + 2]
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=48, prompt_buckets=(8,),
+            draft=(draft, dcfg), draft_k=3,
+        )
+        streamed: dict = {}
+        outs = srv.serve(
+            prompts, max_new_tokens=7,
+            on_token=lambda r, t: streamed.setdefault(r, []).append(t),
+        )
+        for rid, (p, o) in enumerate(zip(prompts, outs)):
+            assert streamed[rid] == list(o[len(p):]), rid
+
     def test_spec_server_sampled_smoke_and_seed_sensitivity(self):
         cfg, params, dcfg, draft = self._models()
         prompts = [
@@ -1296,6 +1314,52 @@ class TestChunkedDecodeServer:
             solo = np.asarray(llama_infer.generate(
                 params, cfg, jnp.asarray(p)[None], max_new_tokens=9,
                 quant_kv=True,
+            ))[0]
+            np.testing.assert_array_equal(got, solo)
+
+    def test_on_token_streams_every_emitted_token_in_order(self):
+        """Token streaming: the on_token callback must deliver, per
+        request, exactly its continuation in order — first token
+        (sampled at prefill) included — across admission churn, both
+        chunked and unchunked."""
+        cfg, params, prompts = self._setup(n=5)
+        for K in (1, 4):
+            srv = llama_infer.DecodeServer(
+                params, cfg, slots=2, max_len=64, decode_chunk=K,
+            )
+            streamed: dict = {}
+            outs = srv.serve(
+                prompts, max_new_tokens=9,
+                on_token=lambda r, t: streamed.setdefault(r, []).append(t),
+            )
+            for rid, (p, o) in enumerate(zip(prompts, outs)):
+                assert streamed[rid] == list(o[len(p):]), (K, rid)
+
+    def test_moe_model_serves_exactly(self):
+        """A MoE+GQA model through the continuous-batching server
+        (chunked dispatch included) — the Mixtral-shaped serving case;
+        must equal its solo greedy decode exactly (fp32: argmax parity
+        needs numeric equivalence, expert-capacity ample so training
+        forward drops nothing)."""
+        cfg = llama.LlamaConfig.tiny(
+            n_layer=2, n_head=4, n_kv_head=2, num_experts=4,
+            moe_every=2, dtype=jnp.float32, capacity_factor=8.0,
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(5)
+        prompts = [
+            rng.randint(1, cfg.vocab_size, size=(int(ln),)).astype(
+                np.int32
+            )
+            for ln in rng.randint(4, 10, size=(4,))
+        ]
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=64, decode_chunk=4,
+        )
+        outs = srv.serve(prompts, max_new_tokens=8)
+        for p, got in zip(prompts, outs):
+            solo = np.asarray(llama_infer.generate(
+                params, cfg, jnp.asarray(p)[None], max_new_tokens=8
             ))[0]
             np.testing.assert_array_equal(got, solo)
 
