@@ -32,6 +32,7 @@ def init_cache(
     cfg: LlamaConfig, batch: int, max_len: int, *,
     ring_len: Optional[int] = None,
     quant_kv: bool = False,
+    ring: bool = True,
 ) -> Dict:
     """Zeroed per-layer k/v cache (compact KV-head count) + write offset.
 
@@ -50,10 +51,17 @@ def init_cache(
     delegates to).  The attention reads the int8 codes directly (an
     operand dtype-convert fuses into the dot) and applies the scales to
     the small score/probability tensors — by construction nothing
-    cache-sized is materialized in full precision."""
+    cache-sized is materialized in full precision.
+
+    ``ring=False`` gives a windowed model a DENSE cache instead: the
+    sliding-window mask still applies in attention (the ring is purely
+    a memory optimization — O(window) instead of O(sequence)), but a
+    dense layout supports ragged per-row offsets and rewind-by-offset,
+    which is what the continuous-batching server and speculative
+    decoding need.  Memory cost: the full max_len rows."""
     KV, D = cfg.n_kv_head, cfg.head_dim
     L = max_len
-    if cfg.sliding_window > 0 and ring_len is not None:
+    if cfg.sliding_window > 0 and ring and ring_len is not None:
         L = min(max_len, ring_len)
 
     def _layer() -> Dict:
@@ -73,7 +81,7 @@ def init_cache(
         "layers": [_layer() for _ in range(cfg.n_layer)],
         "offset": jnp.zeros((), jnp.int32),
     }
-    if cfg.sliding_window > 0:
+    if cfg.sliding_window > 0 and ring:
         # Absolute position held by each ring slot (-1 = unwritten).
         cache["pos"] = jnp.full((L,), -1, jnp.int32)
     return cache
@@ -467,17 +475,15 @@ def generate_ragged(
     are still stale, because sequence b's next query position IS its
     first stale slot.
     """
-    if cfg.sliding_window > 0:
-        raise ValueError(
-            "generate_ragged does not support sliding-window ring "
-            "caches yet; use generate() with aligned prompts"
-        )
     B, P = prompts.shape
     N = max_new_tokens
     if N == 0:
         return prompts, jnp.asarray(prompt_lens, jnp.int32)
     prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
-    cache = init_cache(cfg, B, P + N, quant_kv=quant_kv)
+    # ring=False: windowed models run ragged on a DENSE cache (window
+    # masking still applies; the ring layout cannot take per-row
+    # offsets).
+    cache = init_cache(cfg, B, P + N, quant_kv=quant_kv, ring=False)
     logits, cache = forward_step(params, prompts, cfg, cache)
     if rng is None:
         rng = jax.random.PRNGKey(0)
@@ -865,11 +871,6 @@ def generate_speculative_batched(
     (harmless — the next roll rewrites the same value).  Finished rows
     freeze their offset and ride along masked."""
     B, P = prompts.shape
-    if cfg.sliding_window > 0 or draft_cfg.sliding_window > 0:
-        raise ValueError(
-            "speculative decode does not support sliding-window ring "
-            "caches (offset rewind cannot hide stale ring writes)"
-        )
     N = max_new_tokens
     prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
     if N == 0:
@@ -883,8 +884,13 @@ def generate_speculative_batched(
     )
     max_len = P + N + k + 2
     progs = _spec_programs(cfg, draft_cfg, k, temperature, top_k, top_p)
-    cache_t = init_cache(cfg, B, max_len, quant_kv=quant_kv)
-    cache_d = init_cache(draft_cfg, B, max_len, quant_kv=quant_kv)
+    # ring=False: windowed models speculate on a DENSE cache — offset
+    # rewind relies on slot masking to hide stale writes, which a ring
+    # layout cannot provide (wrapped writes destroy live keys).
+    cache_t = init_cache(cfg, B, max_len, quant_kv=quant_kv,
+                         ring=False)
+    cache_d = init_cache(draft_cfg, B, max_len, quant_kv=quant_kv,
+                         ring=False)
     logits, cache_t = progs["prefill_t"](params, prompts, cache_t)
     _, cache_d = progs["prefill_d"](draft_params, prompts, cache_d)
     pick = _make_sampler(temperature, top_k, top_p)
@@ -1031,12 +1037,10 @@ class DecodeServer:
         # finished slots are re-zeroed at admission).
         decode_chunk: int = 1,
     ):
-        if cfg.sliding_window > 0:
-            raise ValueError("DecodeServer: sliding-window models "
-                             "are not supported yet")
-        if draft is not None and draft[1].sliding_window > 0:
-            raise ValueError("DecodeServer: sliding-window draft "
-                             "models are not supported")
+        # Sliding-window models serve on a DENSE cache (init_cache
+        # ring=False): the window mask still applies in attention; the
+        # ring layout's O(window) memory is incompatible with the
+        # per-slot ragged offsets and rewinds this server relies on.
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -1240,12 +1244,12 @@ class DecodeServer:
         queue = list(enumerate(prompts))[::-1]  # pop() admits in order
         results: Dict[int, Any] = {}
         cache = init_cache(cfg, B, self.max_len,
-                           quant_kv=self.quant_kv)
+                           quant_kv=self.quant_kv, ring=False)
         cache = dict(cache, offset=jnp.zeros((B,), jnp.int32))
         cache_d = None
         if self.draft is not None:
             cache_d = init_cache(self.draft[1], B, self.max_len,
-                                 quant_kv=self.quant_kv)
+                                 quant_kv=self.quant_kv, ring=False)
             cache_d = dict(cache_d, offset=jnp.zeros((B,), jnp.int32))
         toks = jnp.zeros((B,), jnp.int32)
         active = onp.zeros((B,), bool)

@@ -103,10 +103,6 @@ def llama_cached_generate(cfg, ppo_config: PPOConfig,
     from dlrover_tpu.models import llama_infer
 
     jitted: Dict[int, Callable] = _BoundedCache(jit_cache_size)
-    if draft is not None and cfg.sliding_window > 0:
-        raise ValueError(
-            "speculative rollouts do not support sliding-window models"
-        )
 
     def gen(params, prompts, rng):
         plen = int(prompts.shape[1])
@@ -122,9 +118,12 @@ def llama_cached_generate(cfg, ppo_config: PPOConfig,
             )
             return out[:, : plen + ppo_config.response_length]
         if cfg.sliding_window > 0:
-            # The ragged path has no ring-cache support yet; keep the
-            # exact-length rolling-buffer decode for windowed models
-            # (memoized per true length, still bounded).
+            # Windowed models COULD ride the ragged path on a dense
+            # cache (llama_infer ring=False), but rollouts are
+            # batch-aligned anyway, and generate()'s ROLLING ring
+            # buffer keeps decode memory O(window) instead of
+            # O(prompt+response) — the reason this per-exact-length
+            # jit special case stays (memoized, still bounded).
             if ("win", plen) not in jitted:
                 jitted[("win", plen)] = jax.jit(
                     lambda p, pr, r: llama_infer.generate(

@@ -389,6 +389,24 @@ class TestCachedRollout:
             np.asarray(out[:, :5]), np.asarray(prompts)
         )
 
+    def test_cached_generate_speculative_windowed_rollout(self):
+        """Windowed (Mistral-shaped) actors may speculate now: the
+        lower layer runs them on a dense cache (llama_infer ring=False)
+        where offset rewind is slot-masked; greedy law == the plain
+        windowed rollout."""
+        from dlrover_tpu.rl.engine import llama_cached_generate
+
+        cfg, params = self._llama(sliding_window=5)
+        pcfg = PPOConfig(response_length=6, temperature=0.0)
+        plain = llama_cached_generate(cfg, pcfg)
+        spec = llama_cached_generate(cfg, pcfg, draft=(params, cfg))
+        prompts = jnp.asarray(
+            np.random.RandomState(0).randint(1, cfg.vocab_size, (2, 5))
+        )
+        a = plain(params, prompts, jax.random.PRNGKey(0))
+        b = spec(params, prompts, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
     def test_cached_generate_speculative_rollout(self):
         """draft=(params, cfg) routes rollouts through batched
         speculative decoding; greedy law must match the plain cached
