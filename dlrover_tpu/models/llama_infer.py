@@ -1221,7 +1221,7 @@ class DecodeServer:
         return jax.jit(fn)
 
     def serve(self, prompts, max_new_tokens: int, on_finish=None,
-              on_token=None):
+              on_token=None, shared_prefix=None):
         """Decode every prompt (a list of 1-D int arrays); returns a
         list of 1-D arrays (prompt + continuation, EOS included).
 
@@ -1236,11 +1236,29 @@ class DecodeServer:
         vllm's streaming API), including each request's FIRST token
         (sampled at prefill).  With ``decode_chunk=K`` or a draft,
         tokens arrive in bursts of up to K / k+1 per round — that is
-        the latency the dispatch batching buys throughput with."""
+        the latency the dispatch batching buys throughput with.
+
+        ``shared_prefix`` (1-D int array): PREFIX CACHING, the role of
+        vllm's automatic prefix caching for the common case of one
+        system prompt shared by every request.  The prefix prefills
+        ONCE into a template; each admission copies the template's kv
+        rows into its slot (one dynamic_update_slice per layer — a
+        memory move, no FLOPs) and chunk-scores only from the first
+        chunk containing its own tokens.  Results and the output law
+        are EXACTLY ``serve([prefix + p for p in prompts])``; admission
+        cost drops from O(prefix + prompt) to O(chunk + prompt) scoring
+        FLOPs per request."""
         import numpy as onp
 
         cfg = self.cfg
         B = self.slots
+        prefix = None
+        if shared_prefix is not None:
+            prefix = onp.asarray(shared_prefix, onp.int32)
+            if prefix.ndim != 1 or prefix.size == 0:
+                raise ValueError(
+                    "shared_prefix must be a non-empty 1-D token array"
+                )
         queue = list(enumerate(prompts))[::-1]  # pop() admits in order
         results: Dict[int, Any] = {}
         cache = init_cache(cfg, B, self.max_len,
@@ -1271,22 +1289,85 @@ class DecodeServer:
             (self.draft_k + 1) if self.draft is not None
             else self.decode_chunk - 1
         )
+        P0 = 0 if prefix is None else len(prefix)
         for rid, prompt in enumerate(prompts):
-            need = len(prompt) + max_new_tokens + slack
+            need = P0 + len(prompt) + max_new_tokens + slack
             if need > self.max_len:
                 raise ValueError(
-                    f"request {rid}: prompt {len(prompt)} + "
+                    f"request {rid}: "
+                    + (f"prefix {P0} + " if P0 else "")
+                    + f"prompt {len(prompt)} + "
                     f"max_new_tokens {max_new_tokens} + headroom "
                     f"{slack} = {need} exceeds max_len {self.max_len}"
                 )
 
-        def admit_one_cache(slot, prompt, n, c, mparams, mcfg, role):
+        # Prefix templates: the shared prefix prefilled ONCE per model
+        # into a 1-row cache with the server's row length, so admission
+        # can copy whole slot rows (zeros beyond P0 included — the copy
+        # doubles as the fresh-slot zeroing).
+        templates: Dict[str, Any] = {}
+        if prefix is not None and any(
+            P0 + len(p) > self.buckets[-1] for p in prompts
+        ):
+            # (gated: if every combined prompt fits one bucket, every
+            # admission scratch-prefills and the template would be
+            # built for nothing)
+            pref_dev = jnp.asarray(prefix)[None, :]
+            roles = [("t", self.params, cfg)]
+            if self.draft is not None:
+                roles.append(("d", self.draft[0], self.draft[1]))
+            for role, mparams, mcfg in roles:
+                tc = init_cache(mcfg, 1, self.max_len,
+                                quant_kv=self.quant_kv, ring=False)
+                # Memoized per (role, prefix length): a fresh lambda
+                # every serve() would recompile the whole prefix
+                # forward each call (jax.jit caches by function
+                # identity) and eat the very FLOPs the template saves.
+                # Only the CACHE is returned — the template never needs
+                # logits, and dropping them inside the jit lets XLA
+                # dead-code-eliminate the whole lm_head matmul.
+                jkey = ("tmpl_prefill", role, P0)
+                if jkey not in self._prefill_jit:
+                    def fn(p, pr, c, _cfg=mcfg):
+                        return forward_step(p, pr, _cfg, c)[1]
+
+                    self._prefill_jit[jkey] = jax.jit(fn)
+                tc = self._prefill_jit[jkey](mparams, pref_dev, tc)
+                templates[role] = tc["layers"]
+
+        def copy_template(c, slot, role):
+            """Slot rows := template rows (one dynamic_update_slice per
+            layer array); slot offset := P0.  The prefix LENGTH rides
+            as a dynamic scalar — the compiled copy is memoized across
+            serve() calls, which may use different prefixes."""
+            jkey = ("tmplcopy", role)
+            if jkey not in self._prefill_jit:
+                def fn(cache, tmpl, s, p0):
+                    new_layers = self._slot_writeback(cache, tmpl, s)
+                    return dict(
+                        cache, layers=new_layers,
+                        offset=cache["offset"].at[s].set(p0),
+                    )
+
+                self._prefill_jit[jkey] = jax.jit(fn)
+            return self._prefill_jit[jkey](
+                c, templates[role], jnp.asarray(slot),
+                jnp.asarray(P0, jnp.int32),
+            )
+
+        def admit_one_cache(slot, prompt, n, c, mparams, mcfg, role,
+                            use_template=False):
             """Prefill ``prompt`` into ``c``'s slot rows under one
             model (target or draft); returns (new cache, first sampled
             token — meaningful for the target only; the draft role uses
             a CONSTANT key so its discarded pick never shifts the
-            sampling stream)."""
-            if n > self.buckets[-1]:
+            sampling stream).  ``use_template``: ``prompt`` is the
+            prefix+request combined array; slot rows start as a copy of
+            the prefix template and chunk scoring begins at the first
+            chunk containing a non-prefix token (positions re-scored
+            inside that chunk recompute identical kv — complete prefix,
+            causal attention)."""
+            if use_template or n > self.buckets[-1]:
                 # Chunked prefill: every chunk is FULL — the final
                 # chunk's window shifts back to [n-C, n), re-scoring
                 # already-written positions.  The re-score is value-
@@ -1309,14 +1390,26 @@ class DecodeServer:
                         C, mcfg
                     )
                 step = self._prefill_jit[jkey]
+                c_start = 0
+                if use_template:
+                    c = copy_template(c, slot, role)
+                    # Skip chunks fully inside the prefix (their kv
+                    # just arrived via the template copy); the copy
+                    # also zeroed the slot, so no chunk needs
+                    # zero_first.  Clamp to n - C so at least one
+                    # chunk always runs — an EMPTY request prompt with
+                    # P0 a multiple of C would otherwise skip the loop
+                    # entirely and leave no last-logits to sample the
+                    # first token from.
+                    c_start = min(C * (P0 // C), n - C)
                 last = None
-                for c0 in range(0, n, C):
+                for c0 in range(c_start, n, C):
                     start = c0 if c0 + C <= n else n - C
                     piece = prompt[start: start + C]
                     c, logits = step(
                         mparams, c, slot, jnp.asarray(piece)[None],
                         jnp.asarray(start, jnp.int32),
-                        jnp.asarray(start == 0),
+                        jnp.asarray(start == 0 and not use_template),
                     )
                     if start + C >= n:
                         last = logits[(n - 1) - start]
@@ -1341,15 +1434,21 @@ class DecodeServer:
         def admit(slot):
             rid, prompt = queue.pop()
             prompt = onp.asarray(prompt, onp.int32)
+            if prefix is not None:
+                prompt = onp.concatenate([prefix, prompt])
             n = len(prompt)
+            # Short combined prompts fit one bucketed prefill anyway —
+            # the template saves nothing there; scratch-prefill them.
+            use_tmpl = prefix is not None and n > self.buckets[-1]
             nonlocal cache, cache_d, toks
             cache, first = admit_one_cache(
-                slot, prompt, n, cache, self.params, self.cfg, "t"
+                slot, prompt, n, cache, self.params, self.cfg, "t",
+                use_template=use_tmpl,
             )
             if self.draft is not None:
                 cache_d, _ = admit_one_cache(
                     slot, prompt, n, cache_d, self.draft[0],
-                    self.draft[1], "d"
+                    self.draft[1], "d", use_template=use_tmpl,
                 )
             toks = toks.at[slot].set(first.astype(toks.dtype))
             slot_bound[slot] = n + max_new_tokens
@@ -1365,6 +1464,9 @@ class DecodeServer:
         def finish(slot):
             rid = slot_req[slot]
             prompt = onp.asarray(prompts[rid], onp.int32)
+            if prefix is not None:
+                # Output contract matches serve([prefix + p ...]).
+                prompt = onp.concatenate([prefix, prompt])
             results[rid] = onp.concatenate(
                 [prompt, onp.asarray(slot_out[slot], onp.int32)]
             )

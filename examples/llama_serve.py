@@ -48,6 +48,11 @@ def main() -> int:
                     help="print request 0's tokens as they decode "
                          "(the vllm-streaming role of serve's "
                          "on_token hook)")
+    ap.add_argument("--prefix_len", type=int, default=0,
+                    help="share a random system prefix of N tokens "
+                         "across all requests via prefix caching "
+                         "(prefills once; admissions copy kv rows — "
+                         "vllm's automatic-prefix-caching role)")
     ap.add_argument("--tp", type=int, default=0,
                     help="shard params over an N-way 'tp' mesh")
     ap.add_argument("--temperature", type=float, default=0.0)
@@ -153,10 +158,11 @@ def main() -> int:
                     + (" adapt_k" if args.adapt_k else ""))
         srv = llama_infer.DecodeServer(
             params, cfg, slots=args.slots,
-            # + chunk headroom: serve()'s capacity check counts the up
-            # to K-1 writes a mid-chunk finish leaves behind.
+            # + chunk headroom (serve()'s capacity check counts the up
+            # to K-1 writes a mid-chunk finish leaves behind) + the
+            # shared prefix every request's cache rows now hold.
             max_len=max(64, args.max_new_tokens + 24)
-            + max(0, args.decode_chunk - 1),
+            + max(0, args.decode_chunk - 1) + args.prefix_len,
             temperature=args.temperature, seed=args.seed,
             quant_kv=args.quant_kv, decode_chunk=args.decode_chunk,
             **draft_kw,
@@ -166,8 +172,15 @@ def main() -> int:
             def on_token(rid, tok):
                 if rid == 0:
                     print(f"STREAM r0 +{tok}", flush=True)
+        shared_prefix = None
+        if args.prefix_len > 0:
+            shared_prefix = rng.randint(
+                1, cfg.vocab_size, size=(args.prefix_len,)
+            ).astype(np.int32)
+            mode += f" prefix_cached={args.prefix_len}"
         outs = srv.serve(prompts, max_new_tokens=args.max_new_tokens,
-                         on_token=on_token)
+                         on_token=on_token,
+                         shared_prefix=shared_prefix)
         if srv.last_stats:
             st = srv.last_stats
             mode += (f" tokens/round={st['tokens_per_round']:.2f}"

@@ -1453,6 +1453,100 @@ class TestChunkedDecodeServer:
             )
 
 
+class TestPrefixCaching:
+    """shared_prefix: the system prompt prefills once into a template;
+    admissions copy rows and score only their own tokens.  Contract:
+    results and law EXACTLY equal serve([prefix + p for p in prompts])."""
+
+    def _setup(self, n=4):
+        cfg = llama.LlamaConfig.tiny(n_layer=2, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(11)
+        prompts = [
+            rng.randint(1, cfg.vocab_size, size=(int(ln),)).astype(
+                np.int32
+            )
+            for ln in rng.randint(3, 8, size=(n,))
+        ]
+        return cfg, params, prompts, rng
+
+    def _serve_pair(self, cfg, params, prompts, prefix, **kw):
+        a = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=96, prompt_buckets=(8,), **kw
+        ).serve(prompts, max_new_tokens=8, shared_prefix=prefix)
+        b = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=96, prompt_buckets=(8,), **kw
+        ).serve(
+            [np.concatenate([prefix, p]) for p in prompts],
+            max_new_tokens=8,
+        )
+        return a, b
+
+    def test_long_prefix_template_path_exact(self):
+        cfg, params, prompts, rng = self._setup()
+        # prefix 20 > bucket 8: every admission rides the template.
+        prefix = rng.randint(1, cfg.vocab_size, 20).astype(np.int32)
+        a, b = self._serve_pair(cfg, params, prompts, prefix)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_short_prefix_scratch_path_exact(self):
+        cfg, params, prompts, rng = self._setup()
+        # combined fits one bucket: scratch prefill, same contract.
+        prefix = rng.randint(1, cfg.vocab_size, 2).astype(np.int32)
+        prompts = [p[:4] for p in prompts]
+        a, b = self._serve_pair(cfg, params, prompts, prefix)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_prefix_composes_with_quant_kv(self):
+        cfg, params, prompts, rng = self._setup(n=3)
+        prefix = rng.randint(1, cfg.vocab_size, 17).astype(np.int32)
+        a, b = self._serve_pair(
+            cfg, params, prompts, prefix, quant_kv=True
+        )
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_prefix_composes_with_speculative(self):
+        cfg, params, prompts, rng = self._setup(n=3)
+        dcfg = llama.LlamaConfig.tiny(n_layer=1, dtype=jnp.float32)
+        draft = llama.init_params(jax.random.PRNGKey(7), dcfg)
+        prefix = rng.randint(1, cfg.vocab_size, 19).astype(np.int32)
+        a, b = self._serve_pair(
+            cfg, params, prompts, prefix,
+            draft=(draft, dcfg), draft_k=3,
+        )
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_empty_prompt_with_chunk_aligned_prefix(self):
+        """n == P0 with P0 a multiple of the chunk size: the chunk-skip
+        must clamp so one chunk still runs (the first sampled token
+        comes from its last logits) — exactness vs the concatenated
+        baseline holds."""
+        cfg, params, _, rng = self._setup()
+        prefix = rng.randint(1, cfg.vocab_size, 16).astype(np.int32)
+        prompts = [np.zeros((0,), np.int32),
+                   rng.randint(1, cfg.vocab_size, 5).astype(np.int32)]
+        a, b = self._serve_pair(cfg, params, prompts, prefix)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_prefix_validation_and_capacity(self):
+        cfg, params, prompts, rng = self._setup()
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=1, max_len=32, prompt_buckets=(8,),
+        )
+        with pytest.raises(ValueError, match="non-empty 1-D"):
+            srv.serve(prompts, max_new_tokens=4,
+                      shared_prefix=np.zeros((2, 2), np.int32))
+        # prefix counts against capacity
+        prefix = rng.randint(1, cfg.vocab_size, 24).astype(np.int32)
+        with pytest.raises(ValueError, match="prefix 24"):
+            srv.serve(prompts, max_new_tokens=8, shared_prefix=prefix)
+
+
 class TestServeJournaled:
     """Elastic serving primitive: append-only completion journal +
     idempotent replay (the serving analogue of flash checkpoint; the
