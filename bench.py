@@ -653,6 +653,18 @@ def _progress_mark(progress_path: Optional[str], msg: str) -> None:
         pass
 
 
+def _progress_close() -> None:
+    """Close every cached progress handle (measurement done).  The
+    cache exists to keep per-mark cost off the timed window, not to
+    hold handles for the process lifetime."""
+    while _PROGRESS_FILES:
+        _, f = _PROGRESS_FILES.popitem()
+        try:
+            f.close()
+        except OSError:
+            pass
+
+
 def _measure_one_main(out_path: str) -> int:
     """Subprocess entry: read a candidate spec JSON on stdin, measure
     in-process, write {dt, loss} (or {error}) to ``out_path``.  Emits
@@ -723,6 +735,8 @@ def _measure_one_main(out_path: str) -> int:
             result = {"dt": dt, "loss": loss}
     except Exception as e:  # noqa: BLE001
         result = {"error": f"{type(e).__name__}: {str(e)[:600]}"}
+    finally:
+        _progress_close()
     with open(out_path, "w") as f:
         json.dump(result, f)
     return 0 if "error" not in result else 1
@@ -1126,8 +1140,9 @@ def main() -> int:
         elif not on_tpu:
             dt, loss = _measure_candidate(cfg, batch, seq, remat, iters,
                                           opt, fp8, accum, fused)
-    except Exception:  # noqa: BLE001 - keep the probe measurement
-        pass
+    except Exception as e:  # noqa: BLE001 - keep the probe measurement
+        print(f"# re-measure failed, keeping probe number: {e}",
+              file=sys.stderr)
 
     flops = model_flops_per_step(cfg, batch, seq)
     n_dev = jax.local_device_count()

@@ -178,8 +178,13 @@ class AsyncCheckpointSaver:
                     meta = arena.metadata()
                 if meta is not None:
                     cur_step = int(meta.get("extra", {}).get("step", -1))
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                # No local arena yet is normal on a fresh node; the
+                # fetch below then pulls the full replica (min_step=0).
+                logger.debug(
+                    "replica restore: arena peek failed for rank %d: "
+                    "%s", lr, e,
+                )
             got = self.replica.fetch_replica(pid, min_step=cur_step + 1)
             if got is None:
                 continue
@@ -298,8 +303,13 @@ class AsyncCheckpointSaver:
                 try:
                     if self.client.sync_checkpoint(step):
                         break
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    # Master may be restarting mid-rendezvous; keep
+                    # retrying until the commit deadline, but visibly.
+                    logger.debug(
+                        "saver: sync_checkpoint(%d) RPC failed "
+                        "(retrying): %s", step, e,
+                    )
                 time.sleep(0.5)
         while time.time() < deadline:
             if shard_file.all_shards_done(self.storage, ckpt_dir, step, world):
@@ -333,7 +343,13 @@ class AsyncCheckpointSaver:
                 finally:
                     if lock is not None:
                         lock.release()
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
+                # Skipping a rank's state here silently loses it on the
+                # next hard kill — this must be loud.
+                logger.warning(
+                    "breakpoint save: arena peek failed for rank %d "
+                    "(state NOT persisted): %s", lr, e,
+                )
                 continue
             if meta is None:
                 continue

@@ -39,7 +39,10 @@ class MetricsRegistry:
         for name, fn in items:
             try:
                 val = float(fn())
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
+                # A broken gauge callback should not kill the scrape,
+                # but a permanently-failing one deserves a trace.
+                logger.debug("metrics: gauge %s failed: %s", name, e)
                 continue
             lines.append(f"# TYPE {PREFIX}_{name} gauge")
             lines.append(f"{PREFIX}_{name} {val}")

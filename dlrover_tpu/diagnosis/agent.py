@@ -136,8 +136,10 @@ class DiagnosisAgent:
                         }
                     ),
                 )
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                # The restart decision stands either way; only the
+                # master-side diagnosis record is lost.
+                logger.debug("failure-diagnosis report failed: %s", e)
         return action
 
 

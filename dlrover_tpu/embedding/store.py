@@ -461,5 +461,8 @@ class EmbeddingStore:
     def __del__(self):  # pragma: no cover
         try:
             self.close()
+        # graftcheck: disable=CC104 -- __del__ may run during
+        # interpreter teardown when the ctypes lib is half-unloaded;
+        # raising here aborts GC
         except Exception:  # noqa: BLE001
             pass

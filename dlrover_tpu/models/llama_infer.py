@@ -1070,9 +1070,10 @@ class DecodeServer:
                 "dispatch); set one or the other"
             )
         self.decode_chunk = decode_chunk
-        # Telemetry of the last serve() call: rounds, active row-rounds,
-        # emitted tokens, tokens_per_round (the acceptance signal), and
-        # the k trajectory when adapt_k is on.
+        # Telemetry of the last serve() call, reset at the top of every
+        # serve(): the speculative path reports rounds / acceptance /
+        # the k trajectory; the plain and decode_chunk paths report
+        # rounds and emitted tokens.
         self.last_stats: Dict[str, Any] = {}
         self.temperature = temperature
         self.top_k = top_k
@@ -1250,6 +1251,10 @@ class DecodeServer:
         FLOPs per request."""
         import numpy as onp
 
+        # Telemetry contract: last_stats describes THIS call for every
+        # decode path (stale stats from a previous speculative serve
+        # must not survive into a plain one).
+        self.last_stats = {}
         cfg = self.cfg
         B = self.slots
         prefix = None
@@ -1331,6 +1336,9 @@ class DecodeServer:
                     def fn(p, pr, c, _cfg=mcfg):
                         return forward_step(p, pr, _cfg, c)[1]
 
+                    # graftcheck: disable=JX003 -- memoized in
+                    # self._prefill_jit keyed by (role, P0): compiled
+                    # at most once per prefix length, by construction
                     self._prefill_jit[jkey] = jax.jit(fn)
                 tc = self._prefill_jit[jkey](mparams, pref_dev, tc)
                 templates[role] = tc["layers"]
@@ -1481,12 +1489,16 @@ class DecodeServer:
             append each of slot s's new tokens until its EOS or budget,
             then free the slot; the path's remaining tokens for a
             finished slot are discarded (rows re-zero at admission,
-            capacity slack covered the extra writes)."""
+            capacity slack covered the extra writes).  Returns the
+            number of tokens actually appended (the emitted-token
+            telemetry for the non-speculative paths)."""
+            appended = 0
             for s in range(B):
                 if not active[s]:
                     continue
                 for t in rows[s]:
                     slot_out[s].append(int(t))
+                    appended += 1
                     budget[s] -= 1
                     if on_token is not None:
                         on_token(slot_req[s], int(t))
@@ -1496,6 +1508,7 @@ class DecodeServer:
                     ):
                         finish(s)
                         break
+            return appended
 
         sample = self.temperature > 0.0
         greedy_key = jax.random.PRNGKey(0)  # dead in the greedy trace
@@ -1506,6 +1519,7 @@ class DecodeServer:
         # speculation-efficiency signal adapt_k steers on.
         spec_rounds = spec_row_rounds = spec_tokens = 0
         win_row_rounds = win_tokens = 0
+        plain_rounds = plain_tokens = 0
         k_history = [cur_k]
         if self.draft is not None:
             spec_progs = _spec_programs(
@@ -1565,14 +1579,16 @@ class DecodeServer:
                     self.params, cache, toks, jnp.asarray(active),
                     self._next_key(),
                 )
-                emit_rows(onp.asarray(chunk))  # [B, K]
+                plain_rounds += 1
+                plain_tokens += emit_rows(onp.asarray(chunk))  # [B, K]
                 continue
             cache, nxt = self._step(
                 self.params, cache, toks, jnp.asarray(active),
                 self._next_key(),
             )
             toks = nxt
-            emit_rows(onp.asarray(nxt)[:, None])
+            plain_rounds += 1
+            plain_tokens += emit_rows(onp.asarray(nxt)[:, None])
         if self.draft is not None:
             self.last_stats = {
                 "rounds": spec_rounds,
@@ -1584,6 +1600,17 @@ class DecodeServer:
                 ),
                 "k_final": cur_k,
                 "k_history": k_history,
+            }
+        else:
+            self.last_stats = {
+                "path": ("decode_chunk" if self.decode_chunk > 1
+                         else "plain"),
+                "rounds": plain_rounds,
+                "emitted_tokens": plain_tokens,
+                "tokens_per_round": (
+                    plain_tokens / plain_rounds
+                    if plain_rounds else 0.0
+                ),
             }
         return [results[i] for i in range(len(prompts))]
 
@@ -1600,9 +1627,13 @@ def serve_journaled(
 
     A KV cache dies with its process, so the recovery unit for serving
     is the REQUEST, not device state: every completed request is
-    fsync'd to ``journal_path`` (one JSON line) the moment its slot
-    frees; a restarted worker loads the journal, skips finished
-    requests, and re-serves only the in-flight remainder.  Replay is
+    fsync'd to ``journal_path`` (one JSON line, keyed by request id
+    AND a hash of the prompt tokens) the moment its slot frees; a
+    restarted worker loads the journal, skips finished requests whose
+    prompt hash still matches, and re-serves only the in-flight
+    remainder.  The hash keying makes replay safe against journal-path
+    reuse: running a DIFFERENT prompt list against an old journal
+    re-serves everything instead of returning stale completions.  Replay is
     byte-identical because greedy decode is deterministic AND the
     server's compiled program shapes are fixed by its construction
     (``slots``/buckets), not by the request subset: each slot row's
@@ -1623,6 +1654,7 @@ def serve_journaled(
     completion — progress reporting for the elastic agent's hang
     detector.
     """
+    import hashlib as _hashlib
     import json as _json
     import os as _os
 
@@ -1636,6 +1668,17 @@ def serve_journaled(
             "(temperature=0): sampled replay after a restart is not "
             "byte-identical"
         )
+    def _phash(p) -> str:
+        return _hashlib.sha1(
+            np.asarray(p, np.int32).tobytes()
+        ).hexdigest()[:16]
+
+    # Journal records are keyed by (rid, prompt hash), not rid alone:
+    # rerunning against an existing journal with a DIFFERENT prompt
+    # list must re-serve, not silently replay the old run's completion
+    # for a colliding rid.  Records whose hash mismatches (or predates
+    # the hash field) are ignored and the request is simply re-served.
+    want = {rid: _phash(p) for rid, p in enumerate(prompts)}
     done: Dict[int, np.ndarray] = {}
     try:
         with open(journal_path, "r+") as f:
@@ -1656,9 +1699,10 @@ def serve_journaled(
                     rec = _json.loads(line)
                 except ValueError:
                     continue  # a torn line persisted by an old writer
-                done[int(rec["rid"])] = np.asarray(
-                    rec["tokens"], np.int32
-                )
+                rid = int(rec["rid"])
+                if want.get(rid) != rec.get("ph"):
+                    continue  # different prompt set: stale record
+                done[rid] = np.asarray(rec["tokens"], np.int32)
     except OSError:
         pass
     todo = [
@@ -1671,6 +1715,7 @@ def serve_journaled(
                 rid = todo[local_rid][0]
                 jf.write(_json.dumps({
                     "rid": rid,
+                    "ph": want[rid],
                     "tokens": [int(t) for t in tokens],
                 }) + "\n")
                 jf.flush()

@@ -571,5 +571,7 @@ class MasterStrategyCache:
                 self.PREFIX + key,
                 json.dumps(strategy_to_dict(strategy)).encode(),
             )
+        # graftcheck: disable=CC104 -- strategy-cache write is
+        # best-effort: a miss only costs the next job a re-search
         except Exception:  # noqa: BLE001 - cache write is best-effort
             pass

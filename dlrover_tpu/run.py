@@ -275,8 +275,10 @@ def run(args: argparse.Namespace) -> int:
     if master_proc is not None:
         try:
             client.report_job_exit(rc == 0, "launcher done")
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001
+            # Best-effort courtesy RPC, but a dead master here usually
+            # explains a confusing exit — leave a trace.
+            logger.debug("job-exit report to master failed: %s", e)
         master_proc.wait(timeout=30)
     client.close()
     return rc

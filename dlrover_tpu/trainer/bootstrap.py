@@ -73,8 +73,10 @@ class ElasticContext:
                         "step_metrics",
                         _json.dumps({"step": step, "ts": now}),
                     )
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    # Missing a heartbeat is survivable; a silent
+                    # string of them looks like a hang to the master.
+                    logger.debug("step-metrics report failed: %s", e)
 
 
 _ctx: Optional[ElasticContext] = None
@@ -130,6 +132,9 @@ def _shutdown() -> None:
         def _sync():
             try:
                 multihost_utils.sync_global_devices("dlrover_tpu_exit")
+            # graftcheck: disable=CC104 -- exit barrier is best-effort
+            # by design: a crashed peer must not turn our clean exit
+            # into a hang (the timeout path below documents this)
             except Exception:  # noqa: BLE001
                 pass
             done.set()
@@ -139,5 +144,7 @@ def _shutdown() -> None:
             jax.distributed.shutdown()
         # else: skip the shutdown barrier entirely; process teardown
         # closes the coordination channel and peers learn via heartbeat.
+    # graftcheck: disable=CC104 -- teardown must never mask the
+    # worker's real exit status with a shutdown-path error
     except Exception:  # noqa: BLE001
         pass

@@ -518,8 +518,10 @@ class Trainer:
                 if self.step_reporter is not None:
                     try:
                         self.step_reporter(self.state.step)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as e:  # noqa: BLE001
+                        # The reporter feeds the hang detector; losing
+                        # it silently mimics the hang it should catch.
+                        logger.debug("step reporter failed: %s", e)
                 for cb in self.callbacks:
                     cb.on_step_end(
                         args, self.state, self.control, metrics

@@ -222,6 +222,9 @@ class OpMetricsCollector:
             for k, v in m.items():
                 try:
                     self.registry.set(f"worker_{k}", float(v))
+                # graftcheck: disable=CC104 -- metrics publish is
+                # advisory; a registry closing mid-shutdown races this
+                # publisher by design
                 except Exception:  # noqa: BLE001
                     pass
         if self.metrics_path:
@@ -276,8 +279,8 @@ class OpMetricsCallback:
                 self.client.report_diagnosis_data(
                     "op_metrics", self.collector.diagnosis_data()
                 )
-            except Exception:  # noqa: BLE001 - advisory path
-                pass
+            except Exception as e:  # noqa: BLE001 - advisory path
+                logger.debug("op-metrics report failed: %s", e)
         self.collector.step_begin(state.step + 1)
 
     def on_log(self, args, state, control, logs) -> None: ...

@@ -182,9 +182,12 @@ def main() -> int:
                          on_token=on_token,
                          shared_prefix=shared_prefix)
         if srv.last_stats:
+            # Every path reports tokens_per_round; k_final is
+            # speculative-only (plain/chunk report path+emitted).
             st = srv.last_stats
-            mode += (f" tokens/round={st['tokens_per_round']:.2f}"
-                     f" k_final={st['k_final']}")
+            mode += f" tokens/round={st['tokens_per_round']:.2f}"
+            if "k_final" in st:
+                mode += f" k_final={st['k_final']}"
     dt = time.perf_counter() - t0
     total_new = sum(len(o) - len(p) for o, p in zip(outs, prompts))
     for i, o in enumerate(outs[:3]):

@@ -94,3 +94,22 @@ def test_flush_and_read_share_schema(tmp_path, monkeypatch):
     )
     rec = bench._tpu_number_of_record()
     assert rec is not None and rec["mfu_pct"] == 51.0
+
+
+def test_progress_handles_closed_after_measurement(tmp_path):
+    """_progress_mark caches its handle for the timed window, but the
+    cache must drain when the measurement completes — a long-lived
+    process reusing _progress_mark must not leak one fd per sidecar."""
+    sidecar = str(tmp_path / "m.progress")
+    bench._progress_mark(sidecar, "spec read")
+    bench._progress_mark(sidecar, "imports done")
+    f = bench._PROGRESS_FILES[sidecar]
+    bench._progress_close()
+    assert not bench._PROGRESS_FILES
+    assert f.closed
+    lines = open(sidecar).read().strip().split("\n")
+    assert len(lines) == 2 and lines[0].endswith("spec read")
+    # Reuse after close reopens cleanly (append mode).
+    bench._progress_mark(sidecar, "again")
+    bench._progress_close()
+    assert sum(1 for _ in open(sidecar)) == 3

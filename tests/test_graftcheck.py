@@ -1,0 +1,541 @@
+"""graftcheck rule tests: one positive and one negative fixture per
+rule, the suppression contract (justification REQUIRED), both
+reporters, the CLI exit code, and the tier-1 gate that keeps
+``dlrover_tpu/`` at zero unsuppressed findings.
+
+These are pure-AST tests — no jax import, no devices.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.graftcheck import check_source, run_paths, RULES
+from tools.graftcheck.engine import render_human, render_json
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(src: str):
+    """Unsuppressed rule ids triggered by a source snippet."""
+    return {
+        f.rule for f in check_source(textwrap.dedent(src))
+        if not f.suppressed
+    }
+
+
+class TestJaxRules:
+    def test_jx001_traced_branch_in_jit(self):
+        assert "JX001" in rules_of("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+
+    def test_jx001_traced_while(self):
+        assert "JX001" in rules_of("""
+            import jax
+
+            def step(carry):
+                while carry > 0:
+                    carry = carry - 1
+                return carry
+
+            run = jax.jit(step)
+        """)
+
+    def test_jx001_negative_static_branches(self):
+        # None-checks, len() (static shape), and un-jitted functions
+        # all stay silent.
+        assert "JX001" not in rules_of("""
+            import jax
+
+            @jax.jit
+            def f(x, y=None):
+                if y is None:
+                    return x
+                if len(x) > 2:
+                    return x + y
+                return x
+
+            def plain(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+
+    def test_jx001_name_collision_is_scoped(self):
+        # A method sharing its name with a nested jitted helper must
+        # not inherit jit scope (the rl/engine.py shape).
+        assert "JX001" not in rules_of("""
+            import jax
+
+            class Engine:
+                def build(self):
+                    def generate(params, x):
+                        return x
+                    return jax.jit(generate)
+
+                def generate(self, x):
+                    if x not in self.cache:
+                        self.cache[x] = self.build()
+                    return self.cache[x]
+        """)
+
+    def test_jx002_host_sync_in_jit(self):
+        src = """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                s = float(x.sum())
+                t = x.item()
+                u = np.asarray(x)
+                x.block_until_ready()
+                return s + t
+        """
+        findings = [
+            f for f in check_source(textwrap.dedent(src))
+            if f.rule == "JX002"
+        ]
+        assert len(findings) == 4
+
+    def test_jx002_negative_outside_jit(self):
+        assert "JX002" not in rules_of("""
+            import numpy as np
+
+            def summarize(x):
+                return float(x.sum()) + x.item() + np.asarray(x)[0]
+        """)
+
+    def test_jx003_jit_in_loop(self):
+        assert "JX003" in rules_of("""
+            import jax
+
+            fns = []
+            for i in range(3):
+                fns.append(jax.jit(lambda x: x + i))
+        """)
+
+    def test_jx003_negative_jit_in_function_called_from_loop(self):
+        assert "JX003" not in rules_of("""
+            import jax
+
+            def make():
+                return jax.jit(lambda x: x)
+
+            for i in range(3):
+                make()
+        """)
+
+    def test_jx004_key_reused_twice(self):
+        assert "JX004" in rules_of("""
+            import jax
+
+            def f(key):
+                a = jax.random.normal(key, (2,))
+                b = jax.random.uniform(key, (2,))
+                return a + b
+        """)
+
+    def test_jx004_key_reused_in_loop(self):
+        assert "JX004" in rules_of("""
+            import jax
+
+            def f(key):
+                out = []
+                for _ in range(3):
+                    out.append(jax.random.normal(key, (2,)))
+                return out
+        """)
+
+    def test_jx004_with_statement_binding_does_not_crash(self):
+        # withitems carry no lineno; the binding walk must use the
+        # With statement's line instead of crashing.
+        got = rules_of("""
+            import jax
+
+            def f(key, path):
+                with open(path) as fh:
+                    fh.read()
+                a = jax.random.normal(key, (2,))
+                b = jax.random.uniform(key, (2,))
+                return a + b
+        """)
+        assert "JX004" in got
+
+    def test_jx004_with_as_rebinding_counts(self):
+        assert "JX004" not in rules_of("""
+            import jax
+
+            def f(key, mgr):
+                a = jax.random.normal(key, (2,))
+                with mgr() as key:
+                    b = jax.random.uniform(key, (2,))
+                return a + b
+        """)
+
+    def test_jx004_negative_split_between_uses(self):
+        assert "JX004" not in rules_of("""
+            import jax
+
+            def f(key):
+                k1, k2 = jax.random.split(key)
+                a = jax.random.normal(k1, (2,))
+                b = jax.random.uniform(k2, (2,))
+                return a + b
+
+            def g(key):
+                out = []
+                for _ in range(3):
+                    key, sub = jax.random.split(key)
+                    out.append(jax.random.normal(sub, (2,)))
+                return out
+        """)
+
+    def test_jx005_unhashable_static_arg(self):
+        assert "JX005" in rules_of("""
+            import jax
+
+            def g(x, shape):
+                return x.reshape(shape)
+
+            f = jax.jit(g, static_argnums=(1,))
+            y = f(x, [4, 4])
+        """)
+
+    def test_jx005_negative_tuple_static_arg(self):
+        assert "JX005" not in rules_of("""
+            import jax
+
+            def g(x, shape):
+                return x.reshape(shape)
+
+            f = jax.jit(g, static_argnums=(1,))
+            y = f(x, (4, 4))
+        """)
+
+
+class TestConcurrencyRules:
+    def test_cc101_mixed_locked_unlocked_writes(self):
+        assert "CC101" in rules_of("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def inc(self):
+                    with self._lock:
+                        self.n += 1
+
+                def reset(self):
+                    self.n = 0
+        """)
+
+    def test_cc101_negative_all_writes_locked(self):
+        # __init__ writes don't count: no other thread exists yet.
+        assert "CC101" not in rules_of("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def inc(self):
+                    with self._lock:
+                        self.n += 1
+
+                def reset(self):
+                    with self._lock:
+                        self.n = 0
+        """)
+
+    def test_cc102_sleep_under_lock(self):
+        assert "CC102" in rules_of("""
+            import threading
+            import time
+
+            class Poller:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def run(self):
+                    with self._lock:
+                        time.sleep(1.0)
+        """)
+
+    def test_cc102_negative_sleep_outside_lock(self):
+        assert "CC102" not in rules_of("""
+            import threading
+            import time
+
+            class Poller:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def run(self):
+                    with self._lock:
+                        n = 1
+                    time.sleep(1.0)
+        """)
+
+    def test_cc103_unjoined_nondaemon_thread(self):
+        assert "CC103" in rules_of("""
+            import threading
+
+            t = threading.Thread(target=print)
+            t.start()
+        """)
+
+    def test_cc103_anonymous_nondaemon_thread(self):
+        assert "CC103" in rules_of("""
+            import threading
+
+            threading.Thread(target=print).start()
+        """)
+
+    def test_cc103_negative_daemon_or_joined(self):
+        assert "CC103" not in rules_of("""
+            import threading
+
+            threading.Thread(target=print, daemon=True).start()
+
+            t = threading.Thread(target=print)
+            t.start()
+            t.join()
+        """)
+
+    def test_cc104_broad_except_pass(self):
+        assert "CC104" in rules_of("""
+            try:
+                x = 1
+            except Exception:
+                pass
+        """)
+
+    def test_cc104_bare_except_continue(self):
+        assert "CC104" in rules_of("""
+            for i in range(3):
+                try:
+                    x = 1
+                except:
+                    continue
+        """)
+
+    def test_cc104_negative_narrow_or_handled(self):
+        assert "CC104" not in rules_of("""
+            try:
+                x = 1
+            except OSError:
+                pass
+
+            try:
+                y = 2
+            except Exception as e:
+                print(e)
+        """)
+
+
+class TestSuppression:
+    SRC_UNJUSTIFIED = """
+        try:
+            x = 1
+        # graftcheck: disable=CC104
+        except Exception:
+            pass
+    """
+    SRC_JUSTIFIED = """
+        try:
+            x = 1
+        # graftcheck: disable=CC104 -- cleanup path must not raise
+        except Exception:
+            pass
+    """
+
+    def test_justified_suppression_suppresses(self):
+        findings = check_source(textwrap.dedent(self.SRC_JUSTIFIED))
+        assert all(f.suppressed for f in findings)
+        (f,) = findings
+        assert f.rule == "CC104"
+        assert "cleanup path" in f.justification
+
+    def test_unjustified_suppression_is_gc000_and_not_honored(self):
+        got = rules_of(self.SRC_UNJUSTIFIED)
+        assert got == {"GC000", "CC104"}
+
+    def test_trailing_suppression_on_the_finding_line(self):
+        assert rules_of("""
+            try:
+                x = 1
+            except Exception:  # graftcheck: disable=CC104 -- teardown
+                pass
+        """) == set()
+
+    def test_multiline_justification_attaches_to_next_code_line(self):
+        findings = check_source(textwrap.dedent("""
+            try:
+                x = 1
+            # graftcheck: disable=CC104 -- the justification wraps
+            # over a second comment line before the except
+            except Exception:
+                pass
+        """))
+        (f,) = findings
+        assert f.suppressed
+        assert "second comment line" in f.justification
+
+    def test_standalone_suppression_with_trailing_on_same_line(self):
+        """A standalone suppression above a code line that carries its
+        own trailing suppression: BOTH cover that line, and neither
+        leaks onto the next one."""
+        findings = check_source(textwrap.dedent("""
+            import threading
+            import time
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def run(self):
+                    with self._lock:
+                        # graftcheck: disable=CC102 -- first deliberate
+                        time.sleep(1.0)  # graftcheck: disable=CC102 -- same line
+                        time.sleep(2.0)
+        """))
+        by_line = {f.line: f for f in findings if f.rule == "CC102"}
+        lines = sorted(by_line)
+        assert by_line[lines[0]].suppressed
+        assert not by_line[lines[1]].suppressed
+
+    def test_dangling_suppression_at_eof_is_reported(self):
+        # A standalone suppression followed by no code line covers
+        # nothing; it must surface as GC000, not vanish.
+        findings = check_source(
+            "x = 1\n# graftcheck: disable=CC102 -- orphaned\n"
+        )
+        (f,) = findings
+        assert f.rule == "GC000"
+        assert "covers nothing" in f.message
+
+    def test_suppression_only_covers_named_rule(self):
+        # A CC104 suppression must not hide a CC102 on the same line.
+        got = rules_of("""
+            import threading
+            import time
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def run(self):
+                    with self._lock:
+                        # graftcheck: disable=CC104 -- wrong rule id
+                        time.sleep(1.0)
+        """)
+        assert "CC102" in got
+
+
+class TestReporters:
+    SRC = """
+        try:
+            x = 1
+        except Exception:
+            pass
+    """
+
+    def test_json_reporter_shape(self):
+        findings = check_source(textwrap.dedent(self.SRC), "snippet.py")
+        blob = json.loads(render_json(findings))
+        assert blob["unsuppressed"] == 1
+        assert blob["suppressed"] == 0
+        (rec,) = blob["findings"]
+        assert rec["rule"] == "CC104"
+        assert rec["path"] == "snippet.py"
+        assert rec["line"] == 4
+        assert rec["suppressed"] is False
+
+    def test_human_reporter_mentions_rule_and_location(self):
+        findings = check_source(textwrap.dedent(self.SRC), "snippet.py")
+        out = render_human(findings)
+        assert "snippet.py:4: CC104" in out
+        assert "1 finding(s)" in out
+
+    def test_cli_exit_codes(self, tmp_path):
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(textwrap.dedent(self.SRC))
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        env = dict(os.environ, PYTHONPATH=REPO)
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graftcheck", str(dirty),
+             "--format", "json"],
+            capture_output=True, text=True, cwd=REPO, env=env,
+        )
+        assert r.returncode == 1, r.stderr
+        assert json.loads(r.stdout)["unsuppressed"] == 1
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graftcheck", str(clean)],
+            capture_output=True, text=True, cwd=REPO, env=env,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_non_utf8_file_is_a_finding_not_a_crash(self, tmp_path):
+        bad = tmp_path / "latin1.py"
+        bad.write_bytes(b"# -*- coding: latin-1 -*-\nx = '\xe9'\n")
+        from tools.graftcheck import check_file
+
+        (f,) = check_file(str(bad))
+        assert f.rule == "GC000"
+        assert "not valid UTF-8" in f.message
+        assert not f.suppressed
+
+    def test_cli_missing_path_fails_loudly(self, tmp_path):
+        # A typo'd CI target must not pass as an empty "clean" tree.
+        env = dict(os.environ, PYTHONPATH=REPO)
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graftcheck",
+             str(tmp_path / "no_such_dir")],
+            capture_output=True, text=True, cwd=REPO, env=env,
+        )
+        assert r.returncode == 2, r.stdout
+        assert "no such file or directory" in r.stderr
+
+
+@pytest.mark.graftcheck
+class TestRepoGate:
+    """Tier-1 gate: the production tree stays graftcheck-clean, and
+    every suppression carries its written justification."""
+
+    def test_dlrover_tpu_has_zero_unsuppressed_findings(self):
+        findings = run_paths([os.path.join(REPO, "dlrover_tpu")])
+        bad = [f for f in findings if not f.suppressed]
+        assert not bad, "\n" + "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in bad
+        )
+
+    def test_every_suppression_is_justified(self):
+        findings = run_paths([os.path.join(REPO, "dlrover_tpu")])
+        suppressed = [f for f in findings if f.suppressed]
+        assert suppressed, "expected the documented suppressions"
+        for f in suppressed:
+            assert f.justification.strip(), (
+                f"{f.path}:{f.line} suppressed without justification"
+            )
+
+    def test_every_rule_id_is_documented(self):
+        assert set(RULES) >= {
+            "JX001", "JX002", "JX003", "JX004", "JX005",
+            "CC101", "CC102", "CC103", "CC104", "GC000",
+        }

@@ -1676,3 +1676,107 @@ class TestServeJournaled:
         assert served == []
         for p, o in zip(prompts, outs):
             np.testing.assert_array_equal(o, self._solo(params, cfg, p))
+
+    def test_different_prompts_invalidate_journal_records(
+        self, tmp_path
+    ):
+        """Replay is keyed by (rid, prompt hash): reusing a journal
+        path with a DIFFERENT prompt list must re-serve every changed
+        request, never return the old run's completion for a colliding
+        rid."""
+        cfg, params, prompts, journal = self._setup(tmp_path)
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=64
+        )
+        llama_infer.serve_journaled(srv, prompts, 16, journal)
+        # Same rids, different prompts for rids 1 and 4.
+        rng = np.random.RandomState(7)
+        prompts2 = list(prompts)
+        for rid in (1, 4):
+            prompts2[rid] = rng.randint(
+                1, cfg.vocab_size, size=(9,)
+            ).astype(np.int32)
+        served = []
+        outs = llama_infer.serve_journaled(
+            srv, prompts2, 16, journal,
+            on_serve=lambda r, t: served.append(r),
+        )
+        assert sorted(served) == [1, 4]
+        for p, o in zip(prompts2, outs):
+            np.testing.assert_array_equal(o, self._solo(params, cfg, p))
+
+    def test_legacy_records_without_hash_are_reserved(self, tmp_path):
+        """Pre-hash journal lines (no "ph" field) cannot be verified
+        against the current prompts, so they are ignored — stale
+        results are never returned, at the cost of re-serving."""
+        import json as _json
+
+        cfg, params, prompts, journal = self._setup(tmp_path)
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=64
+        )
+        llama_infer.serve_journaled(srv, prompts, 16, journal)
+        lines = [
+            _json.loads(line)
+            for line in open(journal).read().strip().split("\n")
+        ]
+        for rec in lines[:2]:
+            rec.pop("ph")
+        with open(journal, "w") as f:
+            for rec in lines:
+                f.write(_json.dumps(rec) + "\n")
+        served = []
+        llama_infer.serve_journaled(
+            srv, prompts, 16, journal,
+            on_serve=lambda r, t: served.append(r),
+        )
+        assert sorted(served) == sorted(
+            rec["rid"] for rec in lines[:2]
+        )
+
+
+class TestServeStats:
+    """last_stats is per-call telemetry for EVERY decode path, not
+    just the speculative one — and never stale across calls."""
+
+    def _serve(self, **server_kw):
+        cfg = llama.LlamaConfig.tiny(n_layer=2)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(3)
+        prompts = [
+            rng.randint(1, cfg.vocab_size, size=(6,)).astype(np.int32)
+            for _ in range(3)
+        ]
+        srv = llama_infer.DecodeServer(
+            params, cfg, slots=2, max_len=64, **server_kw
+        )
+        srv.serve(prompts, max_new_tokens=8)
+        return srv
+
+    def test_plain_path_populates_stats(self):
+        srv = self._serve()
+        assert srv.last_stats["path"] == "plain"
+        assert srv.last_stats["rounds"] >= 1
+        # 3 requests x 8 new tokens, minus the 3 prefill-sampled
+        # first tokens which are emitted at admission, not in rounds.
+        assert srv.last_stats["emitted_tokens"] == 3 * 8 - 3
+        assert srv.last_stats["tokens_per_round"] > 0
+
+    def test_chunk_path_populates_stats(self):
+        srv = self._serve(decode_chunk=4)
+        assert srv.last_stats["path"] == "decode_chunk"
+        assert srv.last_stats["rounds"] >= 1
+        assert srv.last_stats["emitted_tokens"] == 3 * 8 - 3
+
+    def test_stats_reset_between_calls(self):
+        srv = self._serve()
+        first = dict(srv.last_stats)
+        rng = np.random.RandomState(4)
+        srv.serve(
+            [rng.randint(1, srv.cfg.vocab_size, size=(6,)).astype(
+                np.int32
+            )],
+            max_new_tokens=4,
+        )
+        assert srv.last_stats["emitted_tokens"] == 4 - 1
+        assert srv.last_stats != first

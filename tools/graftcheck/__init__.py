@@ -1,0 +1,60 @@
+"""graftcheck — repo-native static analysis for JAX/TPU and
+concurrency hazards.
+
+The classes of bug that hurt this codebase most are exactly the ones
+the test suite catches late or never: tracer leaks and silent
+recompilation in the jit-heavy data plane, and lock-discipline races in
+the threaded master/agent control plane.  graftcheck is an AST pass
+that flags those shapes *before* they run.
+
+Rule families
+-------------
+JAX (data plane):
+
+- ``JX001`` — Python ``if``/``while`` branching on a traced value
+  inside a jitted function (tracer leak / ConcretizationTypeError).
+- ``JX002`` — host sync inside jit scope: ``float()``, ``.item()``,
+  ``np.asarray``/``np.array``, ``.block_until_ready()``.
+- ``JX003`` — ``jax.jit`` constructed inside a loop body (every
+  iteration makes a fresh callable -> silent recompilation).
+- ``JX004`` — PRNG key reuse: the same key fed to >=2 consuming
+  ``jax.random`` calls (or re-consumed across loop iterations) without
+  an intervening ``split``/rebind.
+- ``JX005`` — non-hashable argument (list/dict/set display or
+  comprehension) passed in a ``static_argnums`` position of a jitted
+  function.
+
+Concurrency (control plane):
+
+- ``CC101`` — an instance attribute written both inside and outside
+  ``with self.<lock>:`` (outside ``__init__``): torn-read hazard.
+- ``CC102`` — ``time.sleep`` while holding a lock: every other thread
+  on that lock sleeps too.
+- ``CC103`` — a non-daemon ``threading.Thread`` that is never joined
+  (and never flipped to daemon): hangs interpreter shutdown.
+- ``CC104`` — ``except:`` / ``except Exception:`` whose body is only
+  ``pass``/``continue``: swallows errors on RPC/retry paths.
+
+Meta:
+
+- ``GC000`` — a suppression comment without a justification.  An
+  unjustified suppression does NOT suppress; the policy is enforced by
+  the tool itself.
+
+Suppression syntax
+------------------
+``# graftcheck: disable=JX003 -- memoized in self._cache, compiled once``
+
+The ``-- justification`` text is REQUIRED.  Several ids may be given
+comma-separated.  A suppression on its own line applies to the next
+code line; trailing on a code line it applies to that line.
+"""
+
+from .engine import (  # noqa: F401
+    Finding,
+    RULES,
+    check_source,
+    check_file,
+    run_paths,
+    main,
+)

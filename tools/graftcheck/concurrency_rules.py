@@ -1,0 +1,291 @@
+"""Concurrency control-plane rules (CC101–CC104).
+
+Aimed at the threaded master/agent: heartbeat loops, watchers, and
+RPC retry paths where a torn read or a swallowed exception shows up as
+a hung job hours later.  Lock regions are recognized lexically:
+``with self.<attr>:`` where ``<attr>`` was assigned a
+``threading.Lock/RLock/Condition`` in the class, or any ``with`` whose
+context name contains "lock"/"cond".  ``acquire()``/``release()``
+pairs are NOT tracked — the repo idiom is ``with``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import Finding
+from .jax_rules import _Ancestry, _ancestors, _dotted
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+
+def _lock_attrs_of_class(cls: ast.ClassDef) -> Set[str]:
+    """Attrs assigned from a threading lock factory anywhere in the
+    class: ``self._lock = threading.Lock()``."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not (isinstance(v, ast.Call)):
+            continue
+        fname = None
+        if isinstance(v.func, ast.Attribute):
+            fname = v.func.attr
+        elif isinstance(v.func, ast.Name):
+            fname = v.func.id
+        if fname not in _LOCK_FACTORIES:
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out.add(t.attr)
+    return out
+
+
+def _is_lock_expr(expr, lock_attrs: Set[str]) -> bool:
+    name = _dotted(expr)
+    if name is None and isinstance(expr, ast.Call):
+        # with self._lock_for(x): / with lock() styles
+        name = _dotted(expr.func)
+    if name is None:
+        return False
+    last = name.split(".")[-1].lower()
+    if isinstance(expr, ast.Attribute) and expr.attr in lock_attrs:
+        return True
+    return "lock" in last or "cond" in last
+
+
+def _self_write_target(node) -> Optional[str]:
+    """The self attr a statement mutates: ``self.X = ...``,
+    ``self.X += ...``, ``self.X[k] = ...``."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    for t in targets:
+        base = t
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"):
+            return base.attr
+    return None
+
+
+class _LockWalk(ast.NodeVisitor):
+    """Walk one function recording (a) self-attr writes with their
+    lock state and (b) time.sleep calls under a lock.  Nested defs
+    reset the lock state: a closure defined under ``with lock`` does
+    not RUN under it."""
+
+    def __init__(self, lock_attrs: Set[str], path: str):
+        self.lock_attrs = lock_attrs
+        self.path = path
+        self.locked = False
+        self.writes: List[Tuple[str, int, bool]] = []  # attr, line, locked
+        self.sleeps: List[Finding] = []
+
+    def visit_With(self, node):
+        entered = any(
+            _is_lock_expr(item.context_expr, self.lock_attrs)
+            for item in node.items
+        )
+        prev, self.locked = self.locked, self.locked or entered
+        for child in node.body:
+            self.visit(child)
+        self.locked = prev
+
+    visit_AsyncWith = visit_With
+
+    def _visit_fn(self, node):
+        prev, self.locked = self.locked, False
+        self.generic_visit(node)
+        self.locked = prev
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Call(self, node):
+        f = node.func
+        is_sleep = (
+            (isinstance(f, ast.Attribute) and f.attr == "sleep"
+             and isinstance(f.value, ast.Name)
+             and f.value.id == "time")
+            or (isinstance(f, ast.Name) and f.id == "sleep")
+        )
+        if is_sleep and self.locked:
+            self.sleeps.append(Finding(
+                "CC102", self.path, node.lineno,
+                "time.sleep while holding a lock stalls every thread "
+                "contending for it — sleep outside, or use "
+                "Condition.wait with a timeout",
+            ))
+        self.generic_visit(node)
+
+    def generic_visit(self, node):
+        attr = _self_write_target(node)
+        if attr is not None:
+            self.writes.append((attr, node.lineno, self.locked))
+        super().generic_visit(node)
+
+
+def _check_lock_discipline(tree, path, findings) -> None:
+    """CC101 + CC102, per class."""
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        lock_attrs = _lock_attrs_of_class(cls)
+        per_attr: Dict[str, Dict[str, List[Tuple[int, str]]]] = {}
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            w = _LockWalk(lock_attrs, path)
+            for stmt in meth.body:
+                w.visit(stmt)
+            findings.extend(w.sleeps)
+            if not lock_attrs:
+                continue  # CC101 needs a lock to measure against
+            for attr, line, locked in w.writes:
+                if attr in lock_attrs:
+                    continue
+                slot = per_attr.setdefault(
+                    attr, {"locked": [], "bare": []}
+                )
+                slot["locked" if locked else "bare"].append(
+                    (line, meth.name)
+                )
+        for attr, slot in per_attr.items():
+            if not slot["locked"]:
+                continue
+            bare = [(ln, m) for ln, m in slot["bare"]
+                    if m != "__init__"]
+            for line, meth_name in bare:
+                lk_line, lk_meth = slot["locked"][0]
+                findings.append(Finding(
+                    "CC101", path, line,
+                    f"self.{attr} written without the lock in "
+                    f"{meth_name}() but written under it in "
+                    f"{lk_meth}() (line {lk_line}) — take the lock or "
+                    "document single-threaded ownership",
+                ))
+    # Module-level / function-level sleeps-under-lock outside classes.
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            w = _LockWalk(set(), path)
+            for stmt in node.body:
+                w.visit(stmt)
+            findings.extend(w.sleeps)
+
+
+def _check_threads(tree, path, findings) -> None:
+    """CC103: a non-daemon Thread never joined and never flipped to
+    daemon — it pins interpreter shutdown."""
+    joined_attrs: Set[str] = set()
+    daemon_flipped: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in ("join",
+                                                           "setDaemon"):
+                name = _dotted(f.value)
+                if name:
+                    target = name.split(".")[-1]
+                    (joined_attrs if f.attr == "join"
+                     else daemon_flipped).add(target)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                    name = _dotted(t.value)
+                    if name:
+                        daemon_flipped.add(name.split(".")[-1])
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_thread = (
+            (isinstance(f, ast.Attribute) and f.attr == "Thread")
+            or (isinstance(f, ast.Name) and f.id == "Thread")
+        )
+        if not is_thread:
+            continue
+        daemon_kw = next(
+            (kw for kw in node.keywords if kw.arg == "daemon"), None
+        )
+        if daemon_kw is not None:
+            if (isinstance(daemon_kw.value, ast.Constant)
+                    and daemon_kw.value.value is False):
+                pass  # explicit daemon=False: still needs a join
+            else:
+                continue  # daemon=True or a runtime expression
+        bound = None
+        for anc in _ancestors(node):
+            if isinstance(anc, ast.Assign):
+                for t in anc.targets:
+                    name = _dotted(t)
+                    if name:
+                        bound = name.split(".")[-1]
+                break
+            if isinstance(anc, (ast.stmt,)):
+                break
+        if bound is not None and (bound in joined_attrs
+                                  or bound in daemon_flipped):
+            continue
+        where = (f"bound to {bound!r} but" if bound is not None
+                 else "anonymous and")
+        findings.append(Finding(
+            "CC103", path, node.lineno,
+            f"non-daemon Thread is {where} never joined (and never "
+            "set daemon) — it blocks interpreter shutdown; pass "
+            "daemon=True or join it on stop",
+        ))
+
+
+def _is_broad_type(t) -> bool:
+    if t is None:
+        return True  # bare except:
+    if isinstance(t, ast.Name):
+        return t.id in ("Exception", "BaseException")
+    if isinstance(t, ast.Tuple):
+        return any(_is_broad_type(e) for e in t.elts)
+    return False
+
+
+def _check_swallowed(tree, path, findings) -> None:
+    """CC104: broad except with a pass-only body."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_type(node.type):
+            continue
+        body_is_noop = all(
+            isinstance(s, (ast.Pass, ast.Continue))
+            or (isinstance(s, ast.Expr)
+                and isinstance(s.value, ast.Constant))
+            for s in node.body
+        )
+        if body_is_noop:
+            findings.append(Finding(
+                "CC104", path, node.lineno,
+                "broad except with a pass-only body swallows every "
+                "error (RPC faults included) — log it, narrow the "
+                "type, or re-raise",
+            ))
+
+
+def check(tree: ast.Module, path: str) -> Iterable[Finding]:
+    _Ancestry().visit(tree)
+    findings: List[Finding] = []
+    _check_lock_discipline(tree, path, findings)
+    _check_threads(tree, path, findings)
+    _check_swallowed(tree, path, findings)
+    uniq: Dict[Tuple[str, int], Finding] = {}
+    for f in findings:
+        uniq.setdefault((f.rule, f.line), f)
+    return list(uniq.values())

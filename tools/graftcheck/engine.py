@@ -1,0 +1,245 @@
+"""graftcheck engine: file walking, suppression parsing, reporters.
+
+The engine owns everything rule-independent: turning a source blob into
+an AST plus a suppression map, dispatching to the rule modules, marking
+findings suppressed, and rendering human/JSON reports.  Rules live in
+``jax_rules.py`` and ``concurrency_rules.py`` and are pure functions
+``(tree, path) -> Iterable[Finding]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Dict, Iterable, List, Tuple
+
+RULES: Dict[str, str] = {
+    "GC000": "suppression comment without justification",
+    "JX001": "Python if/while branches on a traced value inside jit",
+    "JX002": "host sync inside jit scope (float()/.item()/np.asarray/"
+             "block_until_ready)",
+    "JX003": "jax.jit constructed inside a loop body (recompilation "
+             "hazard)",
+    "JX004": "PRNG key reuse without split",
+    "JX005": "non-hashable argument in a static_argnums position",
+    "CC101": "instance attribute written both with and without the "
+             "object's lock held",
+    "CC102": "time.sleep while holding a lock",
+    "CC103": "non-daemon thread never joined",
+    "CC104": "except:/except Exception: with a pass-only body swallows "
+             "errors",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    justification: str = ""
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftcheck:\s*disable=([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
+    r"\s*(?:--\s*(\S.*?))?\s*$"
+)
+
+
+def _parse_suppressions(
+    source: str, path: str
+) -> Tuple[Dict[int, Dict[str, str]], List[Finding]]:
+    """Return ({line: {rule_id: justification}}, [GC000 findings]).
+
+    A suppression trailing a code line covers that line; one on a
+    comment-only line covers the next CODE line (intervening comment /
+    blank lines — e.g. a justification spanning several comment lines —
+    are skipped).  A suppression with no ``-- justification`` text
+    covers NOTHING and is itself a GC000 finding — the justification
+    policy is enforced here, not by review.
+    """
+    per_line: Dict[int, Dict[str, str]] = {}
+    meta: List[Finding] = []
+    pending: Dict[str, str] = {}
+    pending_line = 0
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        stripped = text.strip()
+        m = _SUPPRESS_RE.search(text)
+        comment_only = stripped.startswith("#")
+        if pending and stripped and not comment_only:
+            # First code line after a standalone suppression — it gets
+            # the pending cover even if it ALSO carries a trailing
+            # suppression of its own.
+            per_line.setdefault(lineno, {}).update(pending)
+            pending = {}
+        elif pending and comment_only and not m:
+            # Justifications may wrap over several comment lines.
+            extra = stripped.lstrip("#").strip()
+            if extra:
+                pending = {
+                    rid: f"{j} {extra}" for rid, j in pending.items()
+                }
+        if not m:
+            continue
+        ids = [r.strip() for r in m.group(1).split(",")]
+        justification = (m.group(2) or "").strip()
+        if not justification:
+            meta.append(Finding(
+                "GC000", path, lineno,
+                "suppression of "
+                + ",".join(ids)
+                + " has no justification (write "
+                  "`# graftcheck: disable=ID -- why`); not honored",
+            ))
+        elif comment_only:
+            for rid in ids:  # standalone: covers next code line
+                pending[rid] = justification
+            pending_line = lineno
+        else:
+            slot = per_line.setdefault(lineno, {})
+            for rid in ids:
+                slot[rid] = justification
+    if pending:
+        # A standalone suppression with no following code line covers
+        # nothing — surface it instead of silently dropping it.
+        meta.append(Finding(
+            "GC000", path, pending_line,
+            "suppression of " + ",".join(sorted(pending))
+            + " is followed by no code line and covers nothing — "
+              "remove it or move it above the intended statement",
+        ))
+    return per_line, meta
+
+
+def check_source(source: str, path: str = "<string>") -> List[Finding]:
+    """Run every rule over one source blob; returns ALL findings,
+    suppressed ones included (``suppressed=True`` + justification)."""
+    from . import concurrency_rules, jax_rules
+
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(
+            "GC000", path, e.lineno or 1,
+            f"file does not parse: {e.msg}",
+        )]
+    suppress, findings = _parse_suppressions(source, path)
+    for rule_mod in (jax_rules, concurrency_rules):
+        findings.extend(rule_mod.check(tree, path))
+    for f in findings:
+        just = suppress.get(f.line, {}).get(f.rule)
+        if just is not None and f.rule != "GC000":
+            f.suppressed = True
+            f.justification = just
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def check_file(path: str) -> List[Finding]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+    except UnicodeDecodeError as e:
+        # Same contract as a SyntaxError: one finding, not a crash —
+        # the gate must stay readable on a stray latin-1 file.
+        return [Finding(
+            "GC000", path, 1,
+            f"file is not valid UTF-8 ({e.reason} at byte "
+            f"{e.start}); not analyzed",
+        )]
+    return check_source(source, path)
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        if not os.path.isdir(p):
+            # A typo'd CI target must fail loudly, not pass as an
+            # empty (and therefore "clean") tree.
+            raise FileNotFoundError(
+                f"graftcheck: no such file or directory: {p}"
+            )
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if d != "__pycache__" and not d.startswith(".")
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def run_paths(paths: Iterable[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        findings.extend(check_file(path))
+    return findings
+
+
+def render_human(findings: List[Finding], show_suppressed=False) -> str:
+    lines = []
+    unsuppressed = 0
+    for f in findings:
+        if f.suppressed:
+            if show_suppressed:
+                lines.append(
+                    f"{f.path}:{f.line}: {f.rule} [suppressed: "
+                    f"{f.justification}] {f.message}"
+                )
+            continue
+        unsuppressed += 1
+        lines.append(f"{f.path}:{f.line}: {f.rule} {f.message}")
+    n_sup = sum(1 for f in findings if f.suppressed)
+    lines.append(
+        f"graftcheck: {unsuppressed} finding(s), {n_sup} suppressed"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: List[Finding]) -> str:
+    return json.dumps({
+        "findings": [f.to_json() for f in findings],
+        "unsuppressed": sum(1 for f in findings if not f.suppressed),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+    }, indent=2)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftcheck",
+        description="repo-native static analysis for JAX/TPU and "
+                    "concurrency hazards",
+    )
+    ap.add_argument("paths", nargs="*", default=["dlrover_tpu"],
+                    help="files or directories (default: dlrover_tpu)")
+    ap.add_argument("--format", choices=("human", "json"),
+                    default="human")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="include suppressed findings in human output")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for rid, desc in sorted(RULES.items()):
+            print(f"{rid}  {desc}")
+        return 0
+    try:
+        findings = run_paths(args.paths or ["dlrover_tpu"])
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_human(findings, args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0

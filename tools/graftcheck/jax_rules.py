@@ -1,0 +1,463 @@
+"""JAX data-plane rules (JX001–JX005).
+
+Scope detection is deliberately lexical: a function is "jit scope" if
+it is decorated with a jit-like decorator (``@jax.jit``, ``@jit``,
+``@pjit``, ``@functools.partial(jax.jit, ...)``) or if its name is
+passed to a jit-like call in the SAME lexical scope as its ``def``
+(``self._step = jax.jit(step)`` with ``step`` defined in the same
+method).  The same-scope restriction is what keeps a method and an
+unrelated nested helper that happen to share a name from
+contaminating each other; the cost is that a module-level function
+jitted from inside some other scope is not treated as jit scope.
+Lambdas passed to jit count too,
+as do functions wrapped through one transform level
+(``jax.jit(jax.grad(loss))``).  Nested ``def``s inside a jitted
+function are traced with it, so their parameters are traced values as
+well (the ``lax.scan`` body-carry idiom).
+
+Everything here is a linter heuristic, not an interpreter: a finding
+means "this shape is how the bug class looks", and a deliberate,
+correct instance is suppressed WITH a justification at the site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import Finding
+
+_JIT_NAMES = {"jit", "pjit"}
+_JIT_ATTRS = {"jit", "pjit", "pmap"}
+# jax.random callees that MINT or DERIVE keys rather than consume them.
+_KEY_NONCONSUMING = {"split", "PRNGKey", "key", "fold_in", "clone",
+                     "wrap_key_data", "key_data"}
+_NUMPY_ALIASES = {"np", "onp", "numpy", "jnp"}
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+               ast.DictComp, ast.GeneratorExp)
+
+
+def _is_jit_callee(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_ATTRS
+    return False
+
+
+def _is_jit_factory(call: ast.Call) -> bool:
+    """``jax.jit(...)`` or ``functools.partial(jax.jit, ...)``."""
+    if _is_jit_callee(call.func):
+        return True
+    f = call.func
+    is_partial = (
+        (isinstance(f, ast.Attribute) and f.attr == "partial")
+        or (isinstance(f, ast.Name) and f.id == "partial")
+    )
+    return (is_partial and bool(call.args)
+            and _is_jit_callee(call.args[0]))
+
+
+def _has_jit_decorator(fn) -> bool:
+    for dec in fn.decorator_list:
+        if _is_jit_callee(dec):
+            return True
+        if isinstance(dec, ast.Call) and _is_jit_factory(dec):
+            return True
+    return False
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'self._rng' for Attribute chains, 'key' for Names, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _walk_scope(node: ast.AST, skip_nested=True):
+    """Yield nodes of ``node``'s body without descending into nested
+    function/class scopes (their bindings are separate)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if skip_nested and isinstance(
+            child,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+             ast.ClassDef),
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+class _Ancestry(ast.NodeVisitor):
+    """Annotate every node with a ``_gc_parent`` backlink."""
+
+    def visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            child._gc_parent = node
+        self.generic_visit(node)
+
+
+def _ancestors(node):
+    node = getattr(node, "_gc_parent", None)
+    while node is not None:
+        yield node
+        node = getattr(node, "_gc_parent", None)
+
+
+def _scope_of(node):
+    """Nearest enclosing scope node (requires _Ancestry annotation)."""
+    for anc in _ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda, ast.ClassDef, ast.Module)):
+            return anc
+    return None
+
+
+def _collect_jit_roots(tree: ast.Module):
+    """Functions/lambdas that become jit-compiled callables.  A name
+    passed to ``jax.jit(name)`` only marks defs in the SAME lexical
+    scope as the jit call — a method and a nested helper sharing a
+    name must not contaminate each other."""
+    jitted_names: Dict[str, Set[int]] = {}
+    roots: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_factory(node):
+            args = list(node.args)
+            if not _is_jit_callee(node.func) and args:
+                args = args[1:]  # partial(jax.jit, ...) carries jit
+            for arg in args[:1]:
+                # one transform level deep: jax.jit(jax.grad(loss))
+                if isinstance(arg, ast.Call):
+                    arg = arg.args[0] if arg.args else arg
+                if isinstance(arg, ast.Name):
+                    jitted_names.setdefault(arg.id, set()).add(
+                        id(_scope_of(node))
+                    )
+                elif isinstance(arg, ast.Lambda):
+                    roots.append(arg)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            same_scope = id(_scope_of(node)) in jitted_names.get(
+                node.name, set()
+            )
+            if _has_jit_decorator(node) or same_scope:
+                roots.append(node)
+    return roots
+
+
+def _params_of(fn) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+class _TracedRoots(ast.NodeVisitor):
+    """Root Names an expression's VALUE depends on, pruning subtrees
+    that are static under trace: ``len(x)``, ``x.shape``/``ndim``/
+    ``dtype``/``size``, ``isinstance``/``hasattr``/``getattr``/
+    ``type`` calls (Python-level, resolved at trace time)."""
+
+    STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type",
+                    "range"}
+    STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def visit_Call(self, node):
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in self.STATIC_CALLS):
+            return
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if node.attr in self.STATIC_ATTRS:
+            return
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        self.names.add(node.id)
+
+
+def _traced_roots(expr) -> Set[str]:
+    v = _TracedRoots()
+    v.visit(expr)
+    return v.names
+
+
+def _is_none_check(test) -> bool:
+    """``x is None`` / ``x is not None`` — static under trace."""
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops))
+
+
+def _check_jit_scope(root, path: str, findings: List[Finding]) -> None:
+    """JX001 + JX002 inside one jit root (nested defs included)."""
+    # Params of the root and of every nested def are all traced (the
+    # lax.scan body-carry idiom nests defs inside the jitted fn).
+    traced: Set[str] = set()
+    for node in ast.walk(root):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            traced |= _params_of(node)
+    for node in ast.walk(root):
+        if isinstance(node, (ast.If, ast.While)):
+            if _is_none_check(node.test):
+                continue
+            hit = _traced_roots(node.test) & traced
+            if hit:
+                kind = ("while" if isinstance(node, ast.While)
+                        else "if")
+                findings.append(Finding(
+                    "JX001", path, node.lineno,
+                    f"`{kind}` branches on traced value "
+                    f"{sorted(hit)[0]!r} inside a jitted function — "
+                    "use jnp.where/lax.cond or hoist the branch",
+                ))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Name) and f.id == "float"
+                    and node.args
+                    and not isinstance(node.args[0], ast.Constant)):
+                findings.append(Finding(
+                    "JX002", path, node.lineno,
+                    "float() on a traced value inside jit forces a "
+                    "host sync (ConcretizationTypeError at trace)",
+                ))
+            elif isinstance(f, ast.Attribute) and f.attr in (
+                "item", "block_until_ready",
+            ):
+                findings.append(Finding(
+                    "JX002", path, node.lineno,
+                    f".{f.attr}() inside jit scope is a host sync on "
+                    "a tracer",
+                ))
+            elif (isinstance(f, ast.Attribute)
+                  and f.attr in ("asarray", "array")
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id in _NUMPY_ALIASES - {"jnp"}):
+                findings.append(Finding(
+                    "JX002", path, node.lineno,
+                    f"{f.value.id}.{f.attr}() inside jit scope pulls "
+                    "the value to host — use jnp",
+                ))
+
+
+def _check_jit_in_loop(tree, path, findings) -> None:
+    """JX003: a jit factory call lexically under a for/while (before
+    the nearest enclosing function boundary)."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_jit_factory(node)):
+            continue
+        for anc in _ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+            if isinstance(anc, (ast.For, ast.While)):
+                findings.append(Finding(
+                    "JX003", path, node.lineno,
+                    "jax.jit called inside a loop body builds a fresh "
+                    "callable each iteration — jit caches by function "
+                    "identity, so this recompiles every pass; hoist "
+                    "or memoize it",
+                ))
+                break
+
+
+def _bindings_in(scope_node) -> List[Tuple[str, int]]:
+    """(dotted-name, line) for every binding in one function scope."""
+    out: List[Tuple[str, int]] = []
+
+    def targets_of(node):
+        if isinstance(node, ast.Assign):
+            return node.targets
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign,
+                             ast.NamedExpr)):
+            return [node.target]
+        if isinstance(node, ast.For):
+            return [node.target]
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            # withitems carry no lineno of their own — bind them at
+            # the With statement's line.
+            return [item.optional_vars for item in node.items
+                    if item.optional_vars is not None]
+        return []
+
+    def flatten(t):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from flatten(e)
+        elif t is not None:
+            name = _dotted(t)
+            if name:
+                yield name
+
+    for node in _walk_scope(scope_node):
+        for t in targets_of(node):
+            for name in flatten(t):
+                out.append((name, node.lineno))
+    return out
+
+
+def _check_key_reuse(tree, path, findings) -> None:
+    """JX004: the same key name consumed twice with no rebinding in
+    between, or consumed inside a loop that never rebinds it."""
+    scopes = [tree] + [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for scope in scopes:
+        uses: Dict[str, List[ast.Call]] = {}
+        for node in _walk_scope(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, (ast.Name, ast.Attribute))
+                    and _dotted(f.value) is not None
+                    and _dotted(f.value).split(".")[-1] == "random"
+                    and f.attr not in _KEY_NONCONSUMING):
+                continue
+            if not node.args:
+                continue
+            key = _dotted(node.args[0])
+            if key:
+                uses.setdefault(key, []).append(node)
+        if not uses:
+            continue
+        binds = _bindings_in(scope)
+        flagged: Set[Tuple[str, int]] = set()
+        for key, calls in uses.items():
+            calls.sort(key=lambda c: c.lineno)
+            lines = sorted(ln for n, ln in binds if n == key)
+            for prev, cur in zip(calls, calls[1:]):
+                rebound = any(
+                    prev.lineno < ln <= cur.lineno for ln in lines
+                )
+                if not rebound and (key, cur.lineno) not in flagged:
+                    flagged.add((key, cur.lineno))
+                    findings.append(Finding(
+                        "JX004", path, cur.lineno,
+                        f"PRNG key {key!r} already consumed at line "
+                        f"{prev.lineno} — split it (reuse makes "
+                        "\"random\" draws identical)",
+                    ))
+            # Loop form: consumed each iteration, never rebound inside.
+            for call in calls:
+                for anc in _ancestors(call):
+                    if isinstance(anc, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.Lambda)):
+                        break
+                    if isinstance(anc, (ast.For, ast.While)):
+                        span = (anc.lineno,
+                                max(getattr(anc, "end_lineno",
+                                            anc.lineno), anc.lineno))
+                        rebound = any(
+                            n == key and span[0] <= ln <= span[1]
+                            for n, ln in binds
+                        )
+                        if (not rebound
+                                and (key, call.lineno) not in flagged):
+                            flagged.add((key, call.lineno))
+                            findings.append(Finding(
+                                "JX004", path, call.lineno,
+                                f"PRNG key {key!r} consumed inside a "
+                                "loop without a per-iteration split — "
+                                "every iteration draws the same "
+                                "randomness",
+                            ))
+                        break
+
+
+def _static_positions(call: ast.Call) -> Optional[List[int]]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return [v.value]
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if (isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)):
+                        out.append(e.value)
+                return out
+    return None
+
+
+def _check_static_argnums(tree, path, findings) -> None:
+    """JX005: list/dict/set (unhashable) passed in a static position —
+    jit hashes static args to key its compile cache; this raises at
+    call time."""
+    static_fns: Dict[str, List[int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            if _is_jit_factory(node.value):
+                pos = _static_positions(node.value)
+                if pos:
+                    for t in node.targets:
+                        name = _dotted(t)
+                        if name:
+                            static_fns[name] = pos
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _is_jit_factory(dec):
+                    pos = _static_positions(dec)
+                    if pos:
+                        static_fns[node.name] = pos
+
+    def check_call(call: ast.Call, pos: List[int]):
+        for i in pos:
+            if i < len(call.args) and isinstance(call.args[i],
+                                                 _UNHASHABLE):
+                findings.append(Finding(
+                    "JX005", path, call.args[i].lineno,
+                    f"unhashable argument in static_argnums position "
+                    f"{i} — jit keys its compile cache by hashing "
+                    "static args; pass a tuple/frozen value",
+                ))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name in static_fns:
+            check_call(node, static_fns[name])
+        elif (isinstance(node.func, ast.Call)
+              and _is_jit_factory(node.func)):
+            pos = _static_positions(node.func)
+            if pos:
+                check_call(node, pos)
+
+
+def check(tree: ast.Module, path: str) -> Iterable[Finding]:
+    _Ancestry().visit(tree)
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    for root in _collect_jit_roots(tree):
+        if id(root) in seen:
+            continue
+        seen.add(id(root))
+        _check_jit_scope(root, path, findings)
+    _check_jit_in_loop(tree, path, findings)
+    _check_key_reuse(tree, path, findings)
+    _check_static_argnums(tree, path, findings)
+    # One finding per (rule, line): nested jit roots can overlap.
+    uniq: Dict[Tuple[str, int], Finding] = {}
+    for f in findings:
+        uniq.setdefault((f.rule, f.line), f)
+    return list(uniq.values())
