@@ -21,6 +21,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
+from dlrover_tpu import chaos
 from dlrover_tpu.checkpoint import shard_file
 from dlrover_tpu.checkpoint.engine import (
     ckpt_lock_name,
@@ -275,6 +276,7 @@ class AsyncCheckpointSaver:
             )
             step = staged_step
         t0 = time.perf_counter()
+        chaos.inject("ckpt.slow_storage", step=step, rank=pid)
         shard_file.write_shard(
             self.storage, ckpt_dir, step, pid, tensors, extra
         )
@@ -298,24 +300,26 @@ class AsyncCheckpointSaver:
     def _commit(self, ckpt_dir: str, step: int, world: int,
                 keep_last: int = 3, timeout: float = 600.0) -> None:
         deadline = time.time() + timeout
-        if self.client is not None:
-            while time.time() < deadline:
-                try:
-                    if self.client.sync_checkpoint(step):
-                        break
-                except Exception as e:  # noqa: BLE001
-                    # Master may be restarting mid-rendezvous; keep
-                    # retrying until the commit deadline, but visibly.
-                    logger.debug(
-                        "saver: sync_checkpoint(%d) RPC failed "
-                        "(retrying): %s", step, e,
-                    )
-                time.sleep(0.5)
+        if not shard_file.wait_sync_barrier(
+            self.client, step, min(60.0, timeout / 4), self._stop
+        ) and not self._stop.is_set():
+            logger.warning(
+                "saver: step-%d sync barrier did not open; "
+                "committing on done files alone", step,
+            )
         while time.time() < deadline:
             if shard_file.all_shards_done(self.storage, ckpt_dir, step, world):
                 shard_file.commit(
                     self.storage, ckpt_dir, step, keep_last=keep_last
                 )
+                return
+            if self._stop.is_set():
+                # Saver shutdown while shards are still missing: these
+                # pool threads are non-daemon and would otherwise pin the
+                # dying agent process for the rest of the timeout.  (A
+                # ready commit is still taken — the check above runs
+                # first.)
+                logger.info("saver: commit of step %d aborted (stop)", step)
                 return
             time.sleep(0.5)
         logger.warning("saver: commit of step %d timed out", step)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import messages as m
@@ -78,6 +79,10 @@ class MasterClient:
         )
 
     def report_heartbeat(self) -> List[m.DiagnosisAction]:
+        # NOT idempotent: the master's heartbeat handler destructively
+        # pops pending DiagnosisActions, so a DEADLINE retry could eat an
+        # action whose first reply was lost.  UNAVAILABLE-only retry; the
+        # next interval's heartbeat covers the gap.
         resp = self._client.call(
             m.Heartbeat(node_id=self.node_id, timestamp=time.time())
         )
@@ -99,8 +104,8 @@ class MasterClient:
         slice_id: str = "",
         attempt_id: str = "",
     ) -> int:
-        import uuid as _uuid
-
+        # The attempt_id makes the join idempotent master-side (a retried
+        # duplicate is a no-op), so DEADLINE_EXCEEDED is safe to retry.
         resp = self._client.call(
             m.JoinRendezvous(
                 node_id=self.node_id,
@@ -108,8 +113,9 @@ class MasterClient:
                 local_world_size=local_world_size,
                 rdzv_name=rdzv_name,
                 slice_id=slice_id,
-                attempt_id=attempt_id or _uuid.uuid4().hex,
-            )
+                attempt_id=attempt_id or uuid.uuid4().hex,
+            ),
+            idempotent=True,
         )
         return resp.round if isinstance(resp, m.RendezvousRound) else -1
 
@@ -117,22 +123,26 @@ class MasterClient:
         self, rdzv_name: str = "elastic-training"
     ) -> Tuple[int, int, Dict[int, dict], str]:
         resp = self._client.call(
-            m.CommWorldRequest(node_id=self.node_id, rdzv_name=rdzv_name)
+            m.CommWorldRequest(node_id=self.node_id, rdzv_name=rdzv_name),
+            idempotent=True,
         )
         if isinstance(resp, m.CommWorld):
             return resp.round, resp.group, resp.world, resp.coordinator
         return -1, 0, {}, ""
 
     def num_nodes_waiting(self, rdzv_name: str = "elastic-training") -> int:
-        resp = self._client.call(m.WaitingNodeNumRequest(rdzv_name=rdzv_name))
+        resp = self._client.call(
+            m.WaitingNodeNumRequest(rdzv_name=rdzv_name), idempotent=True
+        )
         return resp.waiting_num if isinstance(resp, m.WaitingNodeNum) else 0
 
     # -- kv store ----------------------------------------------------------
     def kv_store_set(self, key: str, value: bytes) -> None:
-        self._client.call(m.KVStoreSet(key=key, value=value))
+        # Last-writer-wins set: re-sending the same value is harmless.
+        self._client.call(m.KVStoreSet(key=key, value=value), idempotent=True)
 
     def kv_store_get(self, key: str) -> Optional[bytes]:
-        resp = self._client.call(m.KVStoreGet(key=key))
+        resp = self._client.call(m.KVStoreGet(key=key), idempotent=True)
         if isinstance(resp, m.KVStoreValue) and resp.found:
             return resp.value
         return None
@@ -141,22 +151,31 @@ class MasterClient:
         self, key: str, timeout: float = 60.0, poll: float = 0.2
     ) -> Optional[bytes]:
         deadline = time.time() + timeout
-        while time.time() < deadline:
+        while True:
             val = self.kv_store_get(key)
             if val is not None:
                 return val
-            time.sleep(poll)
-        return None
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return None
+            time.sleep(min(poll, remaining))
 
     def kv_store_multi_set(self, kvs: Dict[str, bytes]) -> None:
-        self._client.call(m.KVStoreMultiSet(kvs=kvs))
+        self._client.call(m.KVStoreMultiSet(kvs=kvs), idempotent=True)
 
     def kv_store_multi_get(self, keys: List[str]) -> Dict[str, bytes]:
-        resp = self._client.call(m.KVStoreMultiGet(keys=keys))
+        resp = self._client.call(
+            m.KVStoreMultiGet(keys=keys), idempotent=True
+        )
         return resp.kvs if isinstance(resp, m.KVStoreMultiValue) else {}
 
     def kv_store_add(self, key: str, delta: int = 1) -> int:
-        resp = self._client.call(m.KVStoreAdd(key=key, delta=delta))
+        # The token lets the master dedupe a retried add (exactly-once
+        # counter semantics even when the first reply was lost).
+        resp = self._client.call(
+            m.KVStoreAdd(key=key, delta=delta, token=uuid.uuid4().hex),
+            idempotent=True,
+        )
         return resp.value if isinstance(resp, m.KVStoreCount) else 0
 
     # -- data sharding -----------------------------------------------------
@@ -184,8 +203,15 @@ class MasterClient:
         )
 
     def get_task(self, dataset_name: str) -> m.Task:
+        # Tokened fetch: a retried request returns the SAME task instead of
+        # popping a second shard (exactly-once dispatch under retry).
         resp = self._client.call(
-            m.TaskRequest(dataset_name=dataset_name, worker_id=self.node_id)
+            m.TaskRequest(
+                dataset_name=dataset_name,
+                worker_id=self.node_id,
+                token=uuid.uuid4().hex,
+            ),
+            idempotent=True,
         )
         return resp if isinstance(resp, m.Task) else m.Task(task_id=-1)
 
@@ -205,7 +231,8 @@ class MasterClient:
 
     def get_shard_checkpoint(self, dataset_name: str) -> str:
         resp = self._client.call(
-            m.ShardCheckpointRequest(dataset_name=dataset_name)
+            m.ShardCheckpointRequest(dataset_name=dataset_name),
+            idempotent=True,
         )
         return resp.content if isinstance(resp, m.ShardCheckpoint) else ""
 
@@ -229,11 +256,11 @@ class MasterClient:
         )
 
     def network_ready(self) -> bool:
-        resp = self._client.call(m.NetworkReadyRequest())
+        resp = self._client.call(m.NetworkReadyRequest(), idempotent=True)
         return isinstance(resp, m.BaseResponse) and resp.success
 
     def get_fault_nodes(self) -> Tuple[List[int], str]:
-        resp = self._client.call(m.FaultNodeRequest())
+        resp = self._client.call(m.FaultNodeRequest(), idempotent=True)
         if isinstance(resp, m.FaultNodes):
             return resp.nodes, resp.reason
         return [], ""
@@ -245,7 +272,7 @@ class MasterClient:
 
     def get_stragglers_full(self) -> Tuple[List[int], dict, bool]:
         """(straggler node ids, elapsed-by-node, results-complete flag)."""
-        resp = self._client.call(m.StragglerRequest())
+        resp = self._client.call(m.StragglerRequest(), idempotent=True)
         if isinstance(resp, m.Stragglers):
             return resp.nodes, resp.times, resp.complete
         return [], {}, False
@@ -305,18 +332,22 @@ class MasterClient:
         )
 
     def sync_finished(self, sync_name: str) -> bool:
-        resp = self._client.call(m.SyncQuery(sync_name=sync_name))
+        resp = self._client.call(
+            m.SyncQuery(sync_name=sync_name), idempotent=True
+        )
         return isinstance(resp, m.BaseResponse) and resp.success
 
     def barrier(self, sync_name: str, timeout: float = 120.0) -> bool:
         """Join + poll a named barrier until it opens."""
         self.join_sync(sync_name)
         deadline = time.time() + timeout
-        while time.time() < deadline:
+        while True:
             if self.sync_finished(sync_name):
                 return True
-            time.sleep(0.2)
-        return False
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return False
+            time.sleep(min(0.2, remaining))
 
     def sync_checkpoint(self, step: int) -> bool:
         resp = self._client.call(
@@ -326,12 +357,21 @@ class MasterClient:
 
     # -- config ------------------------------------------------------------
     def get_elastic_run_config(self) -> dict:
-        resp = self._client.call(m.ElasticRunConfigRequest())
+        resp = self._client.call(
+            m.ElasticRunConfigRequest(), idempotent=True
+        )
         return resp.configs if isinstance(resp, m.ElasticRunConfig) else {}
 
     def get_parallel_config(self) -> m.ParallelConfig:
-        resp = self._client.call(m.ParallelConfigRequest(node_id=self.node_id))
+        resp = self._client.call(
+            m.ParallelConfigRequest(node_id=self.node_id), idempotent=True
+        )
         return resp if isinstance(resp, m.ParallelConfig) else m.ParallelConfig()
+
+    def reconnect(self) -> None:
+        """Rebuild the underlying channel after a persistent outage (see
+        ``RpcClient.reconnect``)."""
+        self._client.reconnect(force=True)
 
     def close(self) -> None:
         self._client.close()

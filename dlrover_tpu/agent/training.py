@@ -26,6 +26,7 @@ import subprocess
 import sys
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional
 
 from dlrover_tpu.agent.master_client import MasterClient
@@ -113,6 +114,10 @@ class ElasticTrainingAgent:
         self.on_workers_stopping = None  # callable(reason) before kill
         self.saver = None  # AsyncCheckpointSaver, attached by launcher
         self._last_failures: List[tuple] = []
+        # Sticky: chaos crash sites observed once (by their exit codes)
+        # stay scrubbed from every later worker generation
+        # (see _start_workers).
+        self._spent_crash_sites: set = set()
         from dlrover_tpu.diagnosis.agent import DiagnosisAgent
 
         self.diagnosis = DiagnosisAgent(
@@ -125,6 +130,32 @@ class ElasticTrainingAgent:
 
         self.resource_monitor = ResourceMonitor(self.client)
         self.config_tuner = ParalConfigTuner(self.client)
+
+    def _report_status(self, status: str, exit_reason: str = "") -> None:
+        """Status reports are at-least-once best-effort: a report that
+        exhausts its RPC retries (master restarting, network flap) must
+        never take down the agent that is supposed to survive it."""
+        try:
+            self.client.report_node_status(status, exit_reason=exit_reason)
+        except Exception as e:  # noqa: BLE001
+            logger.warning(
+                "status report %r failed (continuing): %s", status, e
+            )
+
+    def _report_failure_safe(
+        self, error_data: str, restart_count: int = 0
+    ) -> None:
+        """Best-effort failure report (same contract as _report_status):
+        the agent is about to recover from the failure locally, and a
+        flaky master must not turn that recovery into a crash."""
+        try:
+            self.client.report_failure(
+                error_data, restart_count=restart_count
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning(
+                "failure report failed (continuing): %s", e
+            )
 
     # -- heartbeats --------------------------------------------------------
     def _start_heartbeat(self) -> None:
@@ -153,25 +184,72 @@ class ElasticTrainingAgent:
         """Join + poll until this node is in a completed world.
 
         Returns {round, world, my_rank, coordinator, num_processes}.
+
+        Hardened against a master restart mid-rendezvous (chaos
+        ``master.restart`` / ``rdzv.lost_node``): RPC failures during the
+        poll are retried until the rendezvous deadline, and while no world
+        has formed the join (+ registration, which the join's world
+        metadata depends on) is re-sent every ``rdzv_rejoin_interval``
+        seconds with the SAME attempt id — a no-op on a healthy master,
+        a state re-seed on one that lost its membership.
         """
         cfg = self.config
         coord_port = find_free_port()
-        self.client.register_node(
-            node_rank=cfg.node_rank,
-            host=self._host,
-            agent_port=coord_port,
-            slice_id=cfg.slice_id,
-            local_world_size=cfg.nproc_per_node,
-        )
-        self.client.join_rendezvous(
-            cfg.node_rank, cfg.nproc_per_node,
-            rdzv_name=RendezvousName.TRAINING, slice_id=cfg.slice_id,
-        )
+        attempt_id = uuid.uuid4().hex
         deadline = time.time() + cfg.rdzv_timeout
-        while time.time() < deadline:
-            round_, _, world, coordinator = self.client.get_comm_world(
-                RendezvousName.TRAINING
+        rejoin_interval = max(1.0, self._ctx.rdzv_rejoin_interval)
+        joined = False
+        last_join = 0.0
+        join_failures = 0
+
+        def _join() -> None:
+            self.client.register_node(
+                node_rank=cfg.node_rank,
+                host=self._host,
+                agent_port=coord_port,
+                slice_id=cfg.slice_id,
+                local_world_size=cfg.nproc_per_node,
             )
+            self.client.join_rendezvous(
+                cfg.node_rank, cfg.nproc_per_node,
+                rdzv_name=RendezvousName.TRAINING, slice_id=cfg.slice_id,
+                attempt_id=attempt_id,
+            )
+
+        while time.time() < deadline:
+            if not joined or time.time() - last_join >= rejoin_interval:
+                try:
+                    _join()
+                    if joined:
+                        logger.info(
+                            "rendezvous: re-sent join (no world after "
+                            "%.0fs; master may have restarted)",
+                            time.time() - last_join,
+                        )
+                    joined = True
+                    last_join = time.time()
+                    join_failures = 0
+                except Exception as e:  # noqa: BLE001
+                    join_failures += 1
+                    logger.warning(
+                        "rendezvous join failed (will retry): %s", e
+                    )
+                    if join_failures % 3 == 0:
+                        # A channel that rode out a master restart can
+                        # stay wedged in TRANSIENT_FAILURE; start fresh.
+                        self.client.reconnect()
+                    time.sleep(min(1.0, max(0.0, deadline - time.time())))
+                    continue
+            try:
+                round_, _, world, coordinator = self.client.get_comm_world(
+                    RendezvousName.TRAINING
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "rendezvous poll failed (will retry): %s", e
+                )
+                time.sleep(min(1.0, max(0.0, deadline - time.time())))
+                continue
             if world:
                 my_rank = None
                 for rank, meta in world.items():
@@ -229,8 +307,31 @@ class ElasticTrainingAgent:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
             dlrover_tpu.__file__)))
         extra_path = [os.getcwd(), pkg_root]
+        # A one-shot chaos crash fault that already fired in a worker
+        # (worker.kill, or ckpt.crash_* in standalone-engine mode) must
+        # not re-arm in the replacements — fault-firing state is per
+        # process, so an inherited plan would crash-loop the job.
+        # Non-crash faults intentionally survive the restart.  The spent
+        # set is sticky (a later unrelated failure must not resurrect a
+        # fault) and keyed on the plan's own exit codes, so exit=
+        # overrides are recognized.
+        from dlrover_tpu import chaos
+
+        plan = chaos.active_plan()
+        if plan is not None:
+            crash_sites = {
+                s.exit_code: s.site
+                for s in plan.specs
+                if s.kind == "crash" and s.site != "master.restart"
+            }
+            for _, code in self._last_failures:
+                site = crash_sites.get(code)
+                if site:
+                    self._spent_crash_sites.add(site)
         for lr in range(cfg.nproc_per_node):
             env = dict(os.environ)
+            if self._spent_crash_sites:
+                chaos.scrub_env(env, self._spent_crash_sites)
             old_pp = env.get("PYTHONPATH", "")
             env["PYTHONPATH"] = os.pathsep.join(
                 [p for p in extra_path if p]
@@ -392,17 +493,17 @@ class ElasticTrainingAgent:
         try:
             while True:
                 world_info = self._rendezvous()
-                self.client.report_node_status(NodeStatus.RUNNING)
+                self._report_status(NodeStatus.RUNNING)
                 self._start_workers(world_info)
                 result = self._monitor()
                 if result == RunResult.SUCCEEDED:
                     self._stop_workers("success", grace=5.0)
-                    self.client.report_node_status(NodeStatus.SUCCEEDED)
+                    self._report_status(NodeStatus.SUCCEEDED)
                     logger.info("node %d training succeeded", cfg.node_id)
                     return 0
                 if result == RunResult.STOP_JOB:
                     self._stop_workers("stop-job")
-                    self.client.report_node_status(
+                    self._report_status(
                         NodeStatus.FAILED, exit_reason="stopped_by_master"
                     )
                     return 1
@@ -410,13 +511,13 @@ class ElasticTrainingAgent:
                     # Master diagnosed this node as sick: exit so the
                     # platform replaces it (in-place restart won't help).
                     self._stop_workers("master requested node relaunch")
-                    self.client.report_node_status(
+                    self._report_status(
                         NodeStatus.FAILED, exit_reason="relaunch_requested"
                     )
                     return 1
                 if result == RunResult.FAILED:
                     self._restart_count += 1
-                    self.client.report_failure(
+                    self._report_failure_safe(
                         f"worker failure (restart {self._restart_count}/"
                         f"{cfg.max_restarts}): {self._last_failures}",
                         restart_count=self._restart_count,
@@ -431,7 +532,7 @@ class ElasticTrainingAgent:
                         or self._restart_count > cfg.max_restarts
                     ):
                         self._stop_workers("relaunch requested")
-                        self.client.report_node_status(
+                        self._report_status(
                             NodeStatus.FAILED,
                             exit_reason="relaunch_requested"
                             if self._restart_count <= cfg.max_restarts

@@ -1,0 +1,23 @@
+"""chaosd: deterministic fault injection across the control plane.
+
+See :mod:`dlrover_tpu.chaos.plan` for the ``DLROVER_TPU_FAULTS`` grammar
+and the injection-point catalog.  The hot entry point is :func:`inject`,
+a single ``None``-check when no plan is configured.
+"""
+
+from dlrover_tpu.chaos.plan import (  # noqa: F401
+    ENV_VAR,
+    EXIT_CKPT_AFTER_COMMIT,
+    EXIT_CKPT_BEFORE_COMMIT,
+    EXIT_MASTER_RESTART,
+    EXIT_WORKER_KILL,
+    SITES,
+    FaultPlan,
+    FaultSpec,
+    active_plan,
+    configure,
+    inject,
+    reset,
+    scrub_env,
+    without_sites,
+)

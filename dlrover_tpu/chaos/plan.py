@@ -1,0 +1,360 @@
+"""chaosd — deterministic, seeded fault injection for the control plane.
+
+ElasWave (PAPERS.md) argues elastic-native systems must treat failure
+handling as a continuously-tested subsystem; this module is how we do that
+on CPU-only CI.  A :class:`FaultPlan` is parsed from the
+``DLROVER_TPU_FAULTS`` env var (or set explicitly via :func:`configure`)
+and consulted by named *injection points* threaded through the layers that
+matter (RPC client/server, rendezvous, checkpoint commit, shm reads,
+worker steps).  With no plan configured every injection point is a
+single ``None``-check no-op.
+
+Grammar (``;``-separated specs, each ``site:key=val,key=val``)::
+
+    DLROVER_TPU_FAULTS="rpc.unavailable:p=0.2,seed=7;master.restart:at=10s;\
+ckpt.crash_before_commit:step=5;worker.kill:rank=1,step=6"
+
+Spec keys:
+
+==========  =============================================================
+``p``       probability per evaluation (default 1.0)
+``seed``    decision seed (plan-wide; the last spec that sets it wins)
+``at``      only fire once this many seconds have elapsed (``10s``/``500ms``)
+``step``    only fire when the site reports this step
+``rank``    only fire for this rank / process id / node rank
+``method``  only fire for this RPC message type (e.g. ``JoinRendezvous``)
+``times``   max firings (default 1 for crash sites, unlimited otherwise)
+``every``   fire on every Nth matching evaluation (deterministic flap)
+``delay``   sleep duration for latency sites (``2s``/``50ms``)
+``exit``    exit code override for crash sites
+==========  =============================================================
+
+Determinism: the decision for the *n*-th evaluation of a site is a pure
+function of ``(seed, site, n)`` — no shared RNG stream — so two runs of
+the same scenario inject the identical fault sequence for the same
+evaluation sequence, and concurrent sites never perturb each other.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+
+ENV_VAR = "DLROVER_TPU_FAULTS"
+
+# Exit codes picked outside the usual 0/1/2 band so a chaos crash is
+# recognizable in launcher/e2e logs.
+EXIT_CKPT_BEFORE_COMMIT = 66
+EXIT_CKPT_AFTER_COMMIT = 67
+EXIT_WORKER_KILL = 77
+EXIT_MASTER_RESTART = 42
+
+#: site name -> (kind, defaults).  Kinds: ``error`` (caller raises),
+#: ``latency`` (inject() sleeps), ``crash`` (inject() calls os._exit),
+#: ``flag`` (caller applies the effect, e.g. "pretend the read was torn").
+SITES: Dict[str, dict] = {
+    "rpc.unavailable": {"kind": "error"},
+    "rpc.latency": {"kind": "latency", "delay": 0.2},
+    "rpc.drop": {"kind": "error"},
+    "rdzv.late_join": {"kind": "latency", "delay": 2.0},
+    "rdzv.lost_node": {"kind": "flag"},
+    "ckpt.crash_before_commit": {
+        "kind": "crash", "exit": EXIT_CKPT_BEFORE_COMMIT, "times": 1,
+    },
+    "ckpt.crash_after_commit": {
+        "kind": "crash", "exit": EXIT_CKPT_AFTER_COMMIT, "times": 1,
+    },
+    "ckpt.slow_storage": {"kind": "latency", "delay": 1.0},
+    "shm.torn_read": {"kind": "flag", "times": 1},
+    "worker.kill": {"kind": "crash", "exit": EXIT_WORKER_KILL, "times": 1},
+    "master.restart": {
+        "kind": "crash", "exit": EXIT_MASTER_RESTART, "times": 1,
+    },
+}
+
+
+def _parse_duration(text: str) -> float:
+    text = text.strip()
+    if text.endswith("ms"):
+        return float(text[:-2]) / 1000.0
+    if text.endswith("s"):
+        return float(text[:-1])
+    return float(text)
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One parsed fault: a site plus its matching filters."""
+
+    site: str
+    kind: str = "flag"
+    p: float = 1.0
+    at: Optional[float] = None
+    step: Optional[int] = None
+    rank: Optional[int] = None
+    method: str = ""
+    times: int = -1  # -1 = unlimited
+    every: int = 0  # 0 = off; N = every Nth matching evaluation
+    delay: float = 0.0
+    exit_code: int = 1
+    plan_seed: Optional[int] = None  # a spec's seed= sets the plan seed
+    # Runtime counters (per process), guarded by the plan lock.
+    evals: int = 0
+    fired: int = 0
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        """Parse one ``site:key=val,...`` spec.  Raises ``ValueError`` on an
+        unknown site or key — a typo'd chaos plan must fail loudly, not
+        silently inject nothing."""
+        site, _, rest = text.strip().partition(":")
+        site = site.strip()
+        if site not in SITES:
+            raise ValueError(
+                f"unknown fault site {site!r}; known: {sorted(SITES)}"
+            )
+        defaults = SITES[site]
+        spec = cls(
+            site=site,
+            kind=defaults["kind"],
+            times=defaults.get("times", -1),
+            delay=defaults.get("delay", 0.0),
+            exit_code=defaults.get("exit", 1),
+        )
+        for part in filter(None, (p.strip() for p in rest.split(","))):
+            key, sep, val = part.partition("=")
+            key = key.strip()
+            if not sep:
+                raise ValueError(f"fault param {part!r} is not key=value")
+            val = val.strip()
+            if key == "p":
+                spec.p = float(val)
+            elif key == "seed":
+                spec.plan_seed = int(val)
+            elif key == "at":
+                spec.at = _parse_duration(val)
+            elif key == "step":
+                spec.step = int(val)
+            elif key == "rank":
+                spec.rank = int(val)
+            elif key == "method":
+                spec.method = val
+            elif key == "times":
+                spec.times = int(val)
+            elif key == "every":
+                spec.every = int(val)
+            elif key == "delay":
+                spec.delay = _parse_duration(val)
+            elif key == "exit":
+                spec.exit_code = int(val)
+            else:
+                raise ValueError(
+                    f"unknown fault param {key!r} in spec {text!r}"
+                )
+        return spec
+
+
+def _decide(seed: int, site: str, n: int, p: float) -> bool:
+    """Deterministic Bernoulli draw for the n-th evaluation of ``site``:
+    a pure function of (seed, site, n), so runs replay identically and
+    sites never share an RNG stream."""
+    if p >= 1.0:
+        return True
+    if p <= 0.0:
+        return False
+    h = zlib.crc32(f"{seed}:{site}:{n}".encode())
+    return (h & 0xFFFFFF) / float(1 << 24) < p
+
+
+class FaultPlan:
+    """A parsed set of :class:`FaultSpec` s plus the decision engine."""
+
+    def __init__(self, specs: List[FaultSpec], seed: int = 0):
+        self.specs = specs
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        specs = [
+            FaultSpec.parse(part)
+            for part in filter(None, (p.strip() for p in text.split(";")))
+        ]
+        if not specs:
+            raise ValueError(f"empty fault plan {text!r}")
+        seed = 0
+        for spec in specs:
+            if spec.plan_seed is not None:
+                seed = spec.plan_seed
+        return cls(specs, seed=seed)
+
+    def has_site(self, site: str) -> bool:
+        return any(s.site == site for s in self.specs)
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self._t0
+
+    def fire(self, site: str, **ctx) -> Optional[FaultSpec]:
+        """Decide whether a fault fires at ``site`` for this evaluation.
+        Pure decision — effects are applied by :func:`inject`."""
+        hit = None
+        with self._lock:
+            for spec in self.specs:
+                if spec.site != site:
+                    continue
+                if spec.rank is not None and ctx.get("rank") != spec.rank:
+                    continue
+                if spec.step is not None and ctx.get("step") != spec.step:
+                    continue
+                if spec.method and ctx.get("method") != spec.method:
+                    continue
+                if spec.at is not None and self.elapsed() < spec.at:
+                    continue
+                if 0 <= spec.times <= spec.fired:
+                    continue
+                spec.evals += 1
+                if spec.every > 0 and spec.evals % spec.every != 0:
+                    continue
+                if not _decide(self.seed, site, spec.evals, spec.p):
+                    continue
+                spec.fired += 1
+                hit = spec
+                break
+        return hit
+
+    def stats(self) -> Dict[str, int]:
+        """site -> total firings (for tests and exit logging)."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for spec in self.specs:
+                out[spec.site] = out.get(spec.site, 0) + spec.fired
+            return out
+
+    def describe(self) -> str:
+        return "; ".join(
+            f"{s.site}(p={s.p}, times={s.times})" for s in self.specs
+        )
+
+
+_PLAN: Optional[FaultPlan] = None
+
+
+def _load_from_env() -> Optional[FaultPlan]:
+    text = os.environ.get(ENV_VAR, "").strip()
+    if not text:
+        return None
+    try:
+        plan = FaultPlan.parse(text)
+    except ValueError:
+        # A malformed chaos knob must not take down a production job; the
+        # chaos tests themselves assert on injection counts, so a typo'd
+        # plan is still caught where it matters.
+        logger.exception("chaos: invalid %s=%r ignored", ENV_VAR, text)
+        return None
+    logger.warning(
+        "chaos: fault plan active (seed=%d): %s", plan.seed, plan.describe()
+    )
+    return plan
+
+
+def configure(plan: "FaultPlan | str | None") -> Optional[FaultPlan]:
+    """Install a fault plan explicitly (tests / embedders).  Pass ``None``
+    to clear.  Raises ``ValueError`` on a malformed plan string."""
+    global _PLAN
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    _PLAN = plan
+    return _PLAN
+
+
+def reset() -> None:
+    configure(None)
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def inject(site: str, **ctx) -> Optional[FaultSpec]:
+    """The injection point.  Returns ``None`` (and does nothing) unless a
+    configured fault fires here.  Latency faults sleep in place; crash
+    faults never return (``os._exit``); error/flag faults return the spec
+    and the caller applies the effect."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    spec = plan.fire(site, **ctx)
+    if spec is None:
+        return None
+    if spec.kind == "latency":
+        logger.warning(
+            "chaos: %s fired (ctx=%s): sleeping %.3fs", site, ctx, spec.delay
+        )
+        time.sleep(spec.delay)
+    elif spec.kind == "crash":
+        logger.warning(
+            "chaos: %s fired (ctx=%s): os._exit(%d)", site, ctx,
+            spec.exit_code,
+        )
+        # Hard exit on purpose: a chaos crash simulates SIGKILL/OOM — no
+        # atexit hooks, no finally blocks, no flushing beyond this line.
+        os._exit(spec.exit_code)
+    else:
+        logger.warning("chaos: %s fired (ctx=%s)", site, ctx)
+    return spec
+
+
+def without_sites(plan_text: str, sites) -> str:
+    """Drop every spec whose site is in ``sites`` from a raw plan string.
+
+    Fault-firing state is per process, so a one-shot crash fault would
+    re-arm in every relaunched process that inherits the env and kill the
+    replacement too.  Relaunchers therefore scrub the crash site that
+    just fired before spawning the successor: the launcher's local-master
+    supervisor strips ``master.restart`` after an exit-42, and the agent
+    strips ``worker.kill`` from worker envs after observing exit-77.
+    Non-crash faults (flaps, latency) intentionally survive relaunch."""
+    sites = set(sites)
+    kept = [
+        part for part in (p.strip() for p in plan_text.split(";"))
+        if part and part.partition(":")[0].strip() not in sites
+    ]
+    if not kept:
+        return ""
+    # The plan-wide seed may have ridden on a stripped spec ("the last
+    # spec that sets it wins"); deterministic replay of the surviving
+    # faults must not silently fall back to seed 0.  Re-pin it on the
+    # last survivor (last-wins makes that the effective seed).
+    try:
+        want = FaultPlan.parse(plan_text).seed
+        if FaultPlan.parse(";".join(kept)).seed != want:
+            sep = "," if ":" in kept[-1] else ":"
+            kept[-1] += f"{sep}seed={want}"
+    except ValueError:
+        pass  # unparseable input: return the filtered text as-is
+    return ";".join(kept)
+
+
+def scrub_env(env: dict, sites) -> dict:
+    """Strip ``sites`` from ``env``'s fault plan in place (removing the
+    variable entirely when nothing survives) and return ``env``.  The one
+    implementation both relaunchers use — the launcher's master
+    supervisor and the agent's worker respawn."""
+    text = env.get(ENV_VAR)
+    if text:
+        stripped = without_sites(text, sites)
+        if stripped:
+            env[ENV_VAR] = stripped
+        else:
+            env.pop(ENV_VAR)
+    return env
+
+
+_PLAN = _load_from_env()

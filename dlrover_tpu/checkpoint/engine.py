@@ -28,6 +28,7 @@ import numpy as np
 
 import jax
 
+from dlrover_tpu import chaos
 from dlrover_tpu.checkpoint import shard_file, tree_utils
 from dlrover_tpu.common import env as env_utils
 from dlrover_tpu.common.global_context import get_context
@@ -175,6 +176,9 @@ class CheckpointEngine:
 
     def _persist(self, step: int, tensors, extra) -> None:
         try:
+            chaos.inject(
+                "ckpt.slow_storage", step=step, rank=self.process_id
+            )
             shard_file.write_shard(
                 self.storage, self.ckpt_dir, step, self.process_id,
                 tensors, extra,
@@ -189,11 +193,9 @@ class CheckpointEngine:
         """Leader: wait for every process's done file (optionally gated by
         the master's cross-node step barrier), then advance the tracker."""
         deadline = time.time() + timeout
-        if self.client is not None:
-            while time.time() < deadline:
-                if self.client.sync_checkpoint(step):
-                    break
-                time.sleep(0.5)
+        shard_file.wait_sync_barrier(
+            self.client, step, min(60.0, timeout / 4)
+        )
         while time.time() < deadline:
             if shard_file.all_shards_done(
                 self.storage, self.ckpt_dir, step, self.num_processes

@@ -23,6 +23,7 @@ from typing import Dict, Optional, Tuple
 import msgpack
 import numpy as np
 
+from dlrover_tpu import chaos
 from dlrover_tpu.common.constants import CheckpointConstant as CC
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.storage import CheckpointStorage
@@ -132,6 +133,32 @@ def all_shards_done(
     )
 
 
+def wait_sync_barrier(client, step: int, timeout: float,
+                      stop_event=None) -> bool:
+    """Bounded wait on the master's cross-node step barrier before commit.
+
+    The barrier is advisory (skew detection) — the done files are the real
+    commit votes — so a master that restarted and lost its rendezvous
+    state (the barrier can then never open) or died outright must not
+    block durability past ``timeout``.  Returns True once the barrier
+    opened; False on timeout or when ``stop_event`` was set."""
+    if client is None:
+        return True
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if stop_event is not None and stop_event.is_set():
+            return False
+        try:
+            if client.sync_checkpoint(step):
+                return True
+        except Exception as e:  # noqa: BLE001
+            logger.debug(
+                "sync_checkpoint(%d) RPC failed (retrying): %s", step, e
+            )
+        time.sleep(0.5)
+    return False
+
+
 def resolve_keep_last(max_to_keep) -> int:
     """One home for the rotation contract: ``None`` -> default (keep 3),
     ``0`` -> keep ALL step dirs, ``N > 0`` -> keep the newest N."""
@@ -141,8 +168,16 @@ def resolve_keep_last(max_to_keep) -> int:
 def commit(
     storage: CheckpointStorage, ckpt_dir: str, step: int, keep_last: int = 3
 ) -> None:
-    """Advance the tracker and GC old step dirs (leader only)."""
+    """Advance the tracker and GC old step dirs (leader only).
+
+    The tracker write is the atomic commit point (temp + fsync + rename):
+    a crash before it leaves the previous committed step intact; a crash
+    after it leaves this step fully committed.  The two chaos sites below
+    pin down exactly those two halves.
+    """
+    chaos.inject("ckpt.crash_before_commit", step=step)
     storage.write(str(step), tracker_path(ckpt_dir))
+    chaos.inject("ckpt.crash_after_commit", step=step)
     logger.info("checkpoint step %d committed at %s", step, ckpt_dir)
     steps = []
     for name in storage.listdir(ckpt_dir):

@@ -24,6 +24,13 @@ class Context:
         self.node_heartbeat_interval: float = Defaults.HEARTBEAT_INTERVAL
         self.node_heartbeat_timeout: float = Defaults.HEARTBEAT_TIMEOUT
         self.rdzv_timeout: float = Defaults.RDZV_TIMEOUT
+        # While waiting for a world, agents re-send their join (same
+        # attempt id — a no-op on a healthy master) every this-many
+        # seconds, so a restarted master that lost its rendezvous state
+        # re-learns the membership instead of stalling the round forever.
+        # Must exceed the master's lastcall waiting window (default 3s) or
+        # re-joins would keep re-arming it.
+        self.rdzv_rejoin_interval: float = 10.0
         self.pending_timeout: float = Defaults.PENDING_TIMEOUT
         self.monitor_interval: float = Defaults.MONITOR_INTERVAL
         self.scale_interval: float = Defaults.SCALE_INTERVAL

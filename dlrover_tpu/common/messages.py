@@ -242,6 +242,10 @@ class KVStoreMultiValue(Message):
 class KVStoreAdd(Message):
     key: str = ""
     delta: int = 1
+    # Idempotency token: the master caches token -> result, so an
+    # RPC-retried add is applied exactly once (missing field on old
+    # senders decodes to "" = no dedup, preserving wire compat).
+    token: str = ""
 
 
 @dataclasses.dataclass
@@ -274,6 +278,9 @@ class DatasetShardParams(Message):
 class TaskRequest(Message):
     dataset_name: str = ""
     worker_id: int = 0
+    # Idempotency token: a retried fetch returns the SAME task instead of
+    # popping (and leaking) a second shard.
+    token: str = ""
 
 
 @dataclasses.dataclass

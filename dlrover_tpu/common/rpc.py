@@ -7,19 +7,24 @@ on gRPC *generic handlers* so no protoc codegen step is needed: a single
 ``Message`` (see ``messages.py``).  The servicer dispatches on message type.
 
 Retry policy mirrors reference ``retry_grpc_request`` (master_client.py:38):
-exponential backoff, bounded attempts, for transient UNAVAILABLE during
-master relaunches.
+jittered exponential backoff under a total deadline budget, bounded
+attempts, for transient UNAVAILABLE during master relaunches.  Calls the
+caller marks ``idempotent`` (pure reads, or writes carrying an idempotency
+token the master dedupes on) additionally retry DEADLINE_EXCEEDED.
 """
 
 from __future__ import annotations
 
+import random
 import socket
+import threading
 import time
 from concurrent import futures
 from typing import Callable, Optional
 
 import grpc
 
+from dlrover_tpu import chaos
 from dlrover_tpu.common.constants import GRPC
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.messages import (
@@ -58,15 +63,22 @@ def addr_connectable(addr: str, timeout: float = 3.0) -> bool:
         return False
     deadline = time.time() + timeout
     while True:
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            return False
         try:
+            # Clamp the per-attempt connect timeout to the remaining
+            # budget: a blackholed host must not overshoot the deadline,
+            # but a reachable-yet-slow one may use the whole budget.
             with socket.create_connection(
-                (host, port), timeout=max(1.0, deadline - time.time())
+                (host, port), timeout=max(0.1, remaining)
             ):
                 return True
         except OSError:
-            if time.time() >= deadline:
+            remaining = deadline - time.time()
+            if remaining <= 0:
                 return False
-            time.sleep(0.5)
+            time.sleep(min(0.5, remaining))
 
 
 def local_ip() -> str:
@@ -107,6 +119,20 @@ class RpcServer:
         def _unary(request: bytes, context) -> bytes:
             try:
                 msg = deserialize(request)
+            except Exception as e:  # noqa: BLE001 - control plane stays up
+                logger.exception("RPC deserialize error")
+                return serialize(
+                    BaseResponse(
+                        success=False, reason=f"{type(e).__name__}: {e}"
+                    )
+                )
+            if chaos.inject("rpc.drop", method=type(msg).__name__) is not None:
+                # Simulate the request evaporating mid-flight: the client
+                # sees UNAVAILABLE and the handler never ran.
+                context.abort(
+                    grpc.StatusCode.UNAVAILABLE, "chaos: rpc.drop"
+                )
+            try:
                 resp = self._handler(msg)
                 if resp is None:
                     resp = BaseResponse(success=True)
@@ -138,6 +164,25 @@ class RpcServer:
         self._server.stop(grace)
 
 
+class ChaosRpcError(grpc.RpcError):
+    """A synthetic gRPC error raised by chaos injection (client side), so
+    the retry loop exercises exactly the code path a real flap would."""
+
+    def __init__(self, code: grpc.StatusCode, details: str = "chaos"):
+        super().__init__()
+        self._code = code
+        self._details = details
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._details
+
+    def __str__(self) -> str:
+        return f"ChaosRpcError({self._code}, {self._details!r})"
+
+
 class RpcClient:
     """Client side of the single-route control plane with bounded retry.
 
@@ -145,13 +190,54 @@ class RpcClient:
     (``elastic_agent/master_client.py:38-60``).
     """
 
+    #: Default total retry budget per call, seconds.  Attempts stop once
+    #: the budget is spent even if ``retries`` remain — many agents
+    #: hammering a restarting master must converge, not queue forever.
+    DEFAULT_DEADLINE = 60.0
+
     def __init__(self, addr: str, timeout: float = 30.0):
         self._addr = addr
         self._timeout = timeout
-        self._channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+        self._reconnect_mu = threading.Lock()
+        self._connect()
+
+    def _connect(self) -> None:
+        self._channel = grpc.insecure_channel(
+            self._addr, options=_CHANNEL_OPTIONS
+        )
         self._call = self._channel.unary_unary(
             METHOD, request_serializer=None, response_deserializer=None
         )
+        self._connected_at = time.monotonic()
+
+    def reconnect(self, force: bool = False) -> None:
+        """Tear down and rebuild the channel.  A subchannel that rode out a
+        master outage can stay wedged in TRANSIENT_FAILURE (its reconnect
+        backoff grows toward minutes) even after a replacement master is
+        listening on the same port; ``call`` invokes this automatically
+        after repeated UNAVAILABLE attempts.  Rate-limited (unless
+        ``force``) so many concurrently-failing threads share one rebuild;
+        in-flight calls on the old channel fail with an RpcError they were
+        already handling."""
+        with self._reconnect_mu:
+            if not force and time.monotonic() - self._connected_at < 2.0:
+                return  # another caller just rebuilt it
+            old = self._channel
+            self._connect()
+            # Retire the old channel instead of closing it immediately:
+            # a concurrent thread may have a healthy in-flight RPC on it,
+            # and an instant close would fail that call with CANCELLED
+            # (not retriable).  It is closed on the NEXT reconnect — a
+            # full rebuild cycle of grace — or at client close().
+            prev, self._retired_channel = (
+                getattr(self, "_retired_channel", None), old
+            )
+            if prev is not None:
+                try:
+                    prev.close()
+                except Exception as e:  # noqa: BLE001
+                    logger.debug("retired channel close failed: %s", e)
+            logger.info("RPC channel to %s rebuilt", self._addr)
 
     @property
     def addr(self) -> str:
@@ -163,39 +249,83 @@ class RpcClient:
         timeout: Optional[float] = None,
         retries: int = 5,
         backoff: float = 0.5,
+        deadline: Optional[float] = None,
+        idempotent: bool = False,
     ) -> Message:
+        """Send ``msg`` with bounded, jittered-exponential retry.
+
+        Only UNAVAILABLE (connection-level, request not executed) is
+        retried unconditionally.  DEADLINE_EXCEEDED may mean the master
+        already executed the request, so it is retried only for
+        ``idempotent`` calls: pure reads, or writes that carry an
+        idempotency token the master dedupes on (kv add, task fetch,
+        rendezvous join).  ``deadline`` is the total wall-clock budget for
+        all attempts and backoff sleeps combined.
+        """
+        # An explicitly configured per-call/per-client timeout is never
+        # silently shortened: the default budget stretches to cover it.
+        budget = (
+            max(self.DEFAULT_DEADLINE, timeout or self._timeout)
+            if deadline is None
+            else deadline
+        )
+        start = time.time()
         last_err: Optional[Exception] = None
+        name = type(msg).__name__
         for attempt in range(retries):
             try:
+                chaos.inject("rpc.latency", method=name)
+                if chaos.inject("rpc.unavailable", method=name) is not None:
+                    raise ChaosRpcError(
+                        grpc.StatusCode.UNAVAILABLE, "chaos: rpc.unavailable"
+                    )
+                remaining = budget - (time.time() - start)
+                if remaining <= 0:
+                    break
                 data = self._call(
-                    serialize(msg), timeout=timeout or self._timeout
+                    serialize(msg),
+                    timeout=min(timeout or self._timeout, remaining),
                 )
                 return deserialize(data)
             except grpc.RpcError as e:
                 last_err = e
                 code = e.code() if hasattr(e, "code") else None
-                # Only UNAVAILABLE (connection-level, request not executed)
-                # is retried.  DEADLINE_EXCEEDED may mean the master already
-                # executed the request — re-sending would double-execute
-                # non-idempotent ops (kv add, task fetch, rendezvous join).
-                if code == grpc.StatusCode.UNAVAILABLE:
-                    if attempt + 1 >= retries:
-                        break
-                    sleep = min(backoff * (2**attempt), 8.0)
-                    logger.warning(
-                        "RPC %s to %s failed (%s), retry %d/%d in %.1fs",
-                        type(msg).__name__,
-                        self._addr,
-                        code,
-                        attempt + 1,
-                        retries,
-                        sleep,
-                    )
-                    time.sleep(sleep)
-                    continue
-                raise
-        assert last_err is not None
+                retriable = code == grpc.StatusCode.UNAVAILABLE or (
+                    idempotent and code == grpc.StatusCode.DEADLINE_EXCEEDED
+                )
+                if not retriable:
+                    raise
+                if attempt + 1 >= retries:
+                    break
+                # Half-jittered exponential backoff: a fleet of agents
+                # whose master just came back must not stampede it in
+                # lockstep (the fixed backoff*2**attempt schedule did).
+                base = min(backoff * (2**attempt), 8.0)
+                sleep = random.uniform(0.5 * base, base)
+                remaining = budget - (time.time() - start)
+                if remaining <= sleep:
+                    break  # the budget is spent; re-raise below
+                logger.warning(
+                    "RPC %s to %s failed (%s), retry %d/%d in %.1fs",
+                    name, self._addr, code, attempt + 1, retries, sleep,
+                )
+                time.sleep(sleep)
+                if code == grpc.StatusCode.UNAVAILABLE and attempt >= 1:
+                    # Two strikes: the outage may be a restarted master
+                    # this channel refuses to re-dial; rebuild it.
+                    self.reconnect()
+        if last_err is None:
+            raise TimeoutError(
+                f"RPC {name} to {self._addr}: deadline budget "
+                f"{budget:.1f}s spent before the first attempt"
+            )
         raise last_err
 
     def close(self) -> None:
+        retired = getattr(self, "_retired_channel", None)
+        if retired is not None:
+            try:
+                retired.close()
+            except Exception as e:  # noqa: BLE001
+                logger.debug("retired channel close failed: %s", e)
         self._channel.close()

@@ -31,6 +31,7 @@ from typing import Dict, Optional, Tuple
 import msgpack
 import numpy as np
 
+from dlrover_tpu import chaos
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.native import shm_lib
 
@@ -359,6 +360,14 @@ class SharedMemoryArena:
         if hdr is None:
             return None
         _, data_cap, meta_cap, meta_len, commit, crc, dirty = hdr
+        if chaos.inject("shm.torn_read") is not None:
+            # Behave exactly as if the writer died mid-write: readers see
+            # no valid state and must take their storage-fallback path.
+            logger.warning(
+                "chaos: shm.torn_read — arena %s reports torn state",
+                self.name,
+            )
+            return None
         if dirty:
             logger.warning(
                 "shm arena %s: writer died mid-write (dirty); no valid state",

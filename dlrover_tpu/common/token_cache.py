@@ -1,0 +1,42 @@
+"""Bounded idempotency-token cache shared by master-side services.
+
+The RPC layer retries UNAVAILABLE (and, for tokened calls,
+DEADLINE_EXCEEDED); the master dedupes a retried mutation by caching
+``token -> first result`` here.  One implementation so eviction policy
+changes land everywhere at once (kv add, task fetch).
+
+Not thread-safe by itself: callers mutate it under their own service
+lock, which they already hold to apply the mutation being deduped.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Optional
+
+
+class BoundedTokenCache:
+    """FIFO-bounded ``token -> result`` map.  The bound is far larger than
+    any plausible in-flight retry window; it exists so a long job cannot
+    leak memory one token per call."""
+
+    def __init__(self, capacity: int = 4096):
+        self._capacity = capacity
+        self._items: "collections.OrderedDict[str, Any]" = (
+            collections.OrderedDict()
+        )
+
+    def get(self, token: str) -> Optional[Any]:
+        if not token:
+            return None
+        return self._items.get(token)
+
+    def put(self, token: str, result: Any) -> None:
+        if not token:
+            return
+        self._items[token] = result
+        while len(self._items) > self._capacity:
+            self._items.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._items)

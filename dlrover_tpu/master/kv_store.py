@@ -14,11 +14,14 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from dlrover_tpu.common.token_cache import BoundedTokenCache
+
 
 class KVStoreService:
     def __init__(self) -> None:
         self._store: Dict[str, bytes] = {}
         self._cond = threading.Condition()
+        self._add_tokens = BoundedTokenCache()
 
     def set(self, key: str, value: bytes) -> None:
         with self._cond:
@@ -41,12 +44,18 @@ class KVStoreService:
                 self._cond.wait(min(remaining, 1.0))
             return True
 
-    def add(self, key: str, delta: int) -> int:
-        """Atomic counter (torch-Store ``add``)."""
+    def add(self, key: str, delta: int, token: str = "") -> int:
+        """Atomic counter (torch-Store ``add``).  A non-empty ``token``
+        makes the add idempotent: an RPC-retried duplicate (same token)
+        returns the first result without bumping the counter again."""
         with self._cond:
+            cached = self._add_tokens.get(token)
+            if cached is not None:
+                return cached
             cur = int(self._store.get(key, b"0"))
             cur += delta
             self._store[key] = str(cur).encode()
+            self._add_tokens.put(token, cur)
             self._cond.notify_all()
             return cur
 

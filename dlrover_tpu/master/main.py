@@ -9,8 +9,30 @@ from __future__ import annotations
 
 import argparse
 import sys
+import threading
+import time
 
+from dlrover_tpu import chaos
 from dlrover_tpu.common.log import logger, set_role
+
+
+def _arm_chaos_restart() -> None:
+    """If the fault plan schedules a ``master.restart``, poll it from a
+    daemon thread: the injection point hard-exits this process (exit 42)
+    when its time/filters match, and the launcher's local-master
+    supervisor (run.py) relaunches us on the same port."""
+    plan = chaos.active_plan()
+    if plan is None or not plan.has_site("master.restart"):
+        return
+
+    def loop() -> None:
+        while True:
+            chaos.inject("master.restart")
+            time.sleep(0.2)
+
+    threading.Thread(
+        target=loop, name="chaos-master-restart", daemon=True
+    ).start()
 
 
 def parse_args(argv=None) -> argparse.Namespace:
@@ -76,6 +98,7 @@ def run(args: argparse.Namespace) -> int:
             resource_optimizer=optimizer,
         )
     rc = 1
+    _arm_chaos_restart()
     try:
         master.prepare()
         if args.port_file:

@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from dlrover_tpu import chaos
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.master.topology import DpTopologySorter, NodeTopologyMeta
@@ -95,6 +96,15 @@ class RendezvousManager:
     ) -> int:
         """Add a node to the waiting list; returns the round it will join
         (reference ``join_rendezvous :255``)."""
+        # Chaos: delay the join (late joiner) — sleep happens here, OUTSIDE
+        # the manager lock, so injected latency never blocks other joins.
+        chaos.inject("rdzv.late_join", rank=node_rank)
+        if chaos.inject("rdzv.lost_node", rank=node_rank) is not None:
+            # Pretend the join evaporated in flight: the node is told its
+            # round but never enters the waiting list — exercising the
+            # agent's periodic re-join recovery.
+            with self._lock:
+                return self._rdzv_round
         with self._lock:
             meta = NodeTopologyMeta(
                 node_id=node_id,
@@ -103,6 +113,17 @@ class RendezvousManager:
                 slice_id=slice_id,
                 host_id=host_id or host,
             )
+            if node_id in self._waiting_nodes:
+                prev_attempt = self._node_extra.get(node_id, {}).get(
+                    "attempt_id", ""
+                )
+                if attempt_id and attempt_id == prev_attempt:
+                    # Periodic re-join heartbeat of an already-waiting
+                    # node: a no-op that must NOT re-arm _lastcall_time —
+                    # with enough agents re-joining on uncorrelated
+                    # timers, the lastcall quiescence window would never
+                    # elapse and the round could never complete.
+                    return self._rdzv_round
             if node_id in self._rdzv_nodes:
                 prev_attempt = self._node_extra.get(node_id, {}).get(
                     "attempt_id", ""

@@ -173,7 +173,9 @@ class MasterServicer:
         return m.KVStoreMultiValue(kvs=self.kv_store.multi_get(msg.keys))
 
     def _on_kv_add(self, msg: m.KVStoreAdd):
-        return m.KVStoreCount(value=self.kv_store.add(msg.key, msg.delta))
+        return m.KVStoreCount(
+            value=self.kv_store.add(msg.key, msg.delta, token=msg.token)
+        )
 
     # -- data sharding -----------------------------------------------------
     def _on_dataset_params(self, msg: m.DatasetShardParams):
@@ -192,7 +194,9 @@ class MasterServicer:
         return None
 
     def _on_task_request(self, msg: m.TaskRequest):
-        got = self.task_manager.get_task(msg.dataset_name, msg.worker_id)
+        got = self.task_manager.get_task(
+            msg.dataset_name, msg.worker_id, token=msg.token
+        )
         if got is None:
             return m.Task(task_id=-1, dataset_name=msg.dataset_name)
         task_id, shard, epoch = got

@@ -22,6 +22,7 @@ import time
 from typing import Dict, List, Optional
 
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.token_cache import BoundedTokenCache
 from dlrover_tpu.master.dataset_splitter import DatasetSplitter, Shard
 
 
@@ -134,6 +135,8 @@ class TaskManager:
         self._datasets: Dict[str, DatasetManager] = {}
         self._task_timeout = task_timeout
         self._worker_last_task: Dict[int, float] = {}
+        # Idempotency tokens of retried task fetches.
+        self._fetch_tokens = BoundedTokenCache()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -150,13 +153,22 @@ class TaskManager:
         with self._lock:
             return name in self._datasets
 
-    def get_task(self, dataset_name: str, worker_id: int):
+    def get_task(self, dataset_name: str, worker_id: int, token: str = ""):
+        """Pop the next task.  A non-empty ``token`` makes the fetch
+        idempotent: an RPC-retried duplicate returns the same task instead
+        of popping (and stranding) a second shard."""
         with self._lock:
+            cached = self._fetch_tokens.get(token)
+            if cached is not None:
+                return cached
             ds = self._datasets.get(dataset_name)
             if ds is None:
                 return None
             self._worker_last_task[worker_id] = time.time()
-            return ds.get_task(worker_id)
+            got = ds.get_task(worker_id)
+            if got is not None:
+                self._fetch_tokens.put(token, got)
+            return got
 
     def report_task_result(
         self, dataset_name: str, task_id: int, success: bool

@@ -24,10 +24,12 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import uuid
 from typing import List, Optional, Tuple
 
+from dlrover_tpu import chaos
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.agent.training import ElasticLaunchConfig, launch_agent
 from dlrover_tpu.common.log import logger, set_role
@@ -134,22 +136,27 @@ def _apply_job_file(parser: argparse.ArgumentParser,
         ]
 
 
-def _launch_local_master(args) -> Tuple[subprocess.Popen, str]:
-    """Spawn ``python -m dlrover_tpu.master.main`` and wait for its port
-    (reference ``_launch_dlrover_local_master :245``)."""
+def _master_cmd(args, port: int, port_file: str = "") -> List[str]:
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
-    port_file = tempfile.mktemp(prefix="dlrtpu_master_port_")
     cmd = [
         sys.executable, "-m", "dlrover_tpu.master.main",
-        "--port", "0",
+        "--port", str(port),
         "--job_name", args.job_name,
         "--platform", "local",
         "--min_nodes", str(min_nodes),
         "--max_nodes", str(max_nodes),
         "--node_unit", str(args.node_unit),
-        "--port_file", port_file,
     ]
-    proc = subprocess.Popen(cmd)
+    if port_file:
+        cmd += ["--port_file", port_file]
+    return cmd
+
+
+def _launch_local_master(args) -> Tuple[subprocess.Popen, str, int]:
+    """Spawn ``python -m dlrover_tpu.master.main`` and wait for its port
+    (reference ``_launch_dlrover_local_master :245``)."""
+    port_file = tempfile.mktemp(prefix="dlrtpu_master_port_")
+    proc = subprocess.Popen(_master_cmd(args, 0, port_file))
     deadline = time.time() + 60
     while time.time() < deadline:
         if os.path.exists(port_file):
@@ -157,13 +164,72 @@ def _launch_local_master(args) -> Tuple[subprocess.Popen, str]:
                 content = f.read().strip()
             if content:
                 os.unlink(port_file)
-                return proc, f"127.0.0.1:{content}"
+                return proc, f"127.0.0.1:{content}", int(content)
         if proc.poll() is not None:
             raise RuntimeError(
                 f"local master exited early with code {proc.returncode}"
             )
         time.sleep(0.2)
     raise TimeoutError("local master did not report its port in 60s")
+
+
+def _supervise_local_master(
+    args,
+    holder: List[subprocess.Popen],
+    port: int,
+    stop_evt: threading.Event,
+    max_restarts: int = 3,
+) -> threading.Thread:
+    """Keep the standalone job's local master alive: if it exits nonzero
+    while the job is still running, relaunch it on the SAME port (agents
+    ride the gap via RPC retry + rendezvous re-join).  A clean exit (rc=0,
+    job finished) ends supervision.  This is what turns a chaos
+    ``master.restart`` — or a real master crash — into a blip instead of
+    a dead job."""
+
+    def loop() -> None:
+        restarts = 0
+        while not stop_evt.wait(1.0):
+            proc = holder[0]
+            rc = proc.poll()
+            if rc is None:
+                continue
+            if rc == 0 or rc < 0:
+                # rc 0: job finished.  rc < 0: killed by a signal — the
+                # master never signals itself, so this is the launcher's
+                # own teardown (atexit terminate on an abnormal exit
+                # path); respawning would orphan a master on the port.
+                return
+            if restarts >= max_restarts:
+                logger.error(
+                    "local master exited rc=%d and restart budget (%d) is "
+                    "spent; agents will time out", rc, max_restarts,
+                )
+                return
+            restarts += 1
+            logger.warning(
+                "local master exited rc=%d; relaunching on port %d "
+                "(restart %d/%d)", rc, port, restarts, max_restarts,
+            )
+            env = dict(os.environ)
+            plan = chaos.active_plan()
+            restart_codes = {
+                s.exit_code for s in plan.specs
+                if s.site == "master.restart"
+            } if plan is not None else set()
+            if rc in restart_codes:
+                # The one-shot crash fault fired (matched by the plan's
+                # own exit code, so exit= overrides are recognized); a
+                # replacement inheriting the plan verbatim would re-arm
+                # it and die identically.
+                chaos.scrub_env(env, ("master.restart",))
+            holder[0] = subprocess.Popen(_master_cmd(args, port), env=env)
+
+    thread = threading.Thread(
+        target=loop, name="master-supervisor", daemon=True
+    )
+    thread.start()
+    return thread
 
 
 def _gc_shm_arenas(
@@ -203,13 +269,22 @@ def run(args: argparse.Namespace) -> int:
     _gc_shm_arenas(args.job_name)
     atexit.register(_gc_shm_arenas, args.job_name,
                     os.environ["DLROVER_TPU_RUN_ID"])
+    if chaos.active_plan() is not None:
+        logger.warning(
+            "launcher: chaos fault plan is ACTIVE: %s",
+            chaos.active_plan().describe(),
+        )
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
-    master_proc = None
+    master_holder: List[subprocess.Popen] = []
+    master_stop = threading.Event()
     master_addr = args.master_addr
     if args.standalone and not master_addr:
-        master_proc, master_addr = _launch_local_master(args)
+        proc, master_addr, master_port = _launch_local_master(args)
+        master_holder.append(proc)
+        _supervise_local_master(args, master_holder, master_port, master_stop)
         atexit.register(
-            lambda: master_proc.poll() is None and master_proc.terminate()
+            lambda: master_holder[0].poll() is None
+            and master_holder[0].terminate()
         )
     if not master_addr:
         raise SystemExit(
@@ -270,16 +345,24 @@ def run(args: argparse.Namespace) -> int:
     script_args = args.args
     if script_args and script_args[0] == "--":
         script_args = script_args[1:]
-    rc = launch_agent(config, entry + script_args, master_addr)
-
-    if master_proc is not None:
+    try:
+        rc = launch_agent(config, entry + script_args, master_addr)
+    finally:
+        # Stop master supervision on EVERY exit path: if the agent raised,
+        # the atexit terminate must not race a supervisor respawn.
+        master_stop.set()
+    if master_holder:
         try:
             client.report_job_exit(rc == 0, "launcher done")
         except Exception as e:  # noqa: BLE001
             # Best-effort courtesy RPC, but a dead master here usually
             # explains a confusing exit — leave a trace.
             logger.debug("job-exit report to master failed: %s", e)
-        master_proc.wait(timeout=30)
+        try:
+            master_holder[0].wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            logger.warning("local master did not exit in 30s; terminating")
+            master_holder[0].terminate()
     client.close()
     return rc
 

@@ -15,6 +15,7 @@ import atexit
 import os
 from typing import Optional
 
+from dlrover_tpu import chaos
 from dlrover_tpu.agent.master_client import MasterClient, build_master_client
 from dlrover_tpu.common import env as env_utils
 from dlrover_tpu.common.jax_env import (
@@ -53,6 +54,10 @@ class ElasticContext:
         only; reference ``report_global_step``) and, throttled, this node's
         step-metrics diagnosis stream (per-node stall detection,
         reference xpu-timer collector)."""
+        # Chaos: ``worker.kill:rank=R,step=N`` hard-kills this worker at a
+        # deterministic step; the agent's monitor loop must detect it,
+        # breakpoint-save, and re-form the world.
+        chaos.inject("worker.kill", rank=self.process_id, step=step)
         if self.client is None:
             return
         if self.is_leader:

@@ -29,6 +29,10 @@ def parse_args():
     p.add_argument("--dataset_size", type=int, default=4096)
     p.add_argument("--ckpt_dir", default="")
     p.add_argument("--ckpt_interval", type=int, default=5)
+    # 0 = memory-only periodic saves (durable persistence rides the
+    # agent's breakpoint save); N = also request async storage persist
+    # (and its commit protocol) every N steps.
+    p.add_argument("--ckpt_storage_interval", type=int, default=0)
     return p.parse_args()
 
 
@@ -129,9 +133,14 @@ def main() -> int:
         step += 1
         ctx.report_step(step)
         if ckpt is not None and step % args.ckpt_interval == 0:
+            durable = (
+                args.ckpt_storage_interval > 0
+                and step % args.ckpt_storage_interval == 0
+            )
             ckpt.save(
                 {"params": params, "opt_state": opt_state},
                 meta={"step": step},
+                storage=durable,
             )
         if step % 10 == 0 or step == args.steps:
             print(

@@ -1,0 +1,470 @@
+"""chaosd unit tests: plan grammar, deterministic decisions, injection
+points, idempotency-token dedup, and crash-site commit atomicity.
+
+Everything here is deterministic and sub-second (tier-1); the full
+process-tree chaos scenarios live in ``test_chaos_e2e.py``.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu import chaos
+from dlrover_tpu.chaos import FaultPlan, FaultSpec
+from dlrover_tpu.common import messages as msgs
+from dlrover_tpu.common.rpc import RpcClient, RpcServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+@pytest.mark.chaos
+class TestPlanGrammar:
+    def test_full_example_parses(self):
+        plan = FaultPlan.parse(
+            "rpc.unavailable:p=0.2,seed=7;master.restart:at=10s;"
+            "ckpt.crash_before_commit:step=5;worker.kill:rank=1,step=6"
+        )
+        assert plan.seed == 7
+        sites = [s.site for s in plan.specs]
+        assert sites == [
+            "rpc.unavailable", "master.restart",
+            "ckpt.crash_before_commit", "worker.kill",
+        ]
+        kill = plan.specs[3]
+        assert kill.rank == 1 and kill.step == 6
+        assert kill.kind == "crash" and kill.times == 1
+
+    def test_durations_and_defaults(self):
+        spec = FaultSpec.parse("rpc.latency:delay=250ms")
+        assert spec.delay == pytest.approx(0.25)
+        assert FaultSpec.parse("master.restart:at=3s").at == 3.0
+        # Crash sites default to one-shot; error sites to unlimited.
+        assert FaultSpec.parse("ckpt.crash_after_commit").times == 1
+        assert FaultSpec.parse("rpc.unavailable").times == -1
+
+    def test_unknown_site_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            FaultPlan.parse("rpc.unavaliable:p=1")  # typo
+
+    def test_unknown_param_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault param"):
+            FaultSpec.parse("rpc.unavailable:prob=0.2")
+
+    def test_malformed_param_rejected(self):
+        with pytest.raises(ValueError, match="key=value"):
+            FaultSpec.parse("rpc.unavailable:p")
+
+    def test_empty_plan_rejected(self):
+        with pytest.raises(ValueError):
+            FaultPlan.parse("  ;  ")
+
+    def test_without_sites_strips_spent_crash_faults(self):
+        """Relaunchers scrub the crash site that just fired so the
+        replacement process does not re-arm it and die identically."""
+        plan = (
+            "rpc.unavailable:p=0.2,seed=7;master.restart:at=10s;"
+            "worker.kill:rank=1,step=6"
+        )
+        out = chaos.without_sites(plan, ("master.restart",))
+        assert out == "rpc.unavailable:p=0.2,seed=7;worker.kill:rank=1,step=6"
+        out = chaos.without_sites(out, ("worker.kill",))
+        assert out == "rpc.unavailable:p=0.2,seed=7"
+        assert chaos.without_sites(out, ("rpc.unavailable",)) == ""
+        # The stripped string still parses (round-trip safety).
+        assert chaos.FaultPlan.parse(out).specs[0].site == "rpc.unavailable"
+
+    def test_without_sites_preserves_plan_seed(self):
+        """Stripping the spec that carried seed= must re-pin the seed on a
+        survivor so deterministic replay crosses the relaunch."""
+        out = chaos.without_sites(
+            "master.restart:at=1s,seed=7;rpc.drop:p=0.5",
+            ("master.restart",),
+        )
+        assert chaos.FaultPlan.parse(out).seed == 7
+        # No-op when the seed survives on its own spec.
+        out = chaos.without_sites(
+            "rpc.drop:p=0.5,seed=9;master.restart:at=1s",
+            ("master.restart",),
+        )
+        assert out == "rpc.drop:p=0.5,seed=9"
+        # A paramless survivor gets ':seed=N', not an unparseable ',...'.
+        out = chaos.without_sites(
+            "master.restart:at=1s,seed=7;rpc.drop", ("master.restart",)
+        )
+        assert chaos.FaultPlan.parse(out).seed == 7
+
+    def test_scrub_env_strips_or_removes(self):
+        env = {chaos.ENV_VAR: "worker.kill:rank=0,step=3;rpc.drop:p=0.1"}
+        chaos.scrub_env(env, ("worker.kill",))
+        assert env[chaos.ENV_VAR] == "rpc.drop:p=0.1"
+        chaos.scrub_env(env, ("rpc.drop",))
+        assert chaos.ENV_VAR not in env
+        chaos.scrub_env(env, ("rpc.drop",))  # absent var: no-op
+        assert chaos.ENV_VAR not in env
+
+    def test_env_load_in_subprocess(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        env["DLROVER_TPU_FAULTS"] = "rpc.unavailable:times=1"
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "from dlrover_tpu import chaos; "
+             "print(chaos.active_plan() is not None)"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0 and "True" in out.stdout
+        # A malformed env plan is ignored loudly, never fatal.
+        env["DLROVER_TPU_FAULTS"] = "not-a-site:oops"
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "from dlrover_tpu import chaos; "
+             "print(chaos.active_plan() is None)"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0 and "True" in out.stdout
+
+
+@pytest.mark.chaos
+class TestDecisions:
+    def test_seeded_sequence_is_reproducible(self):
+        seq = []
+        for _ in range(2):
+            plan = FaultPlan.parse("rpc.unavailable:p=0.3,seed=11")
+            seq.append(
+                [plan.fire("rpc.unavailable") is not None
+                 for _ in range(200)]
+            )
+        assert seq[0] == seq[1]
+        assert 20 < sum(seq[0]) < 100  # p=0.3 actually bites
+
+    def test_different_seeds_differ(self):
+        a = FaultPlan.parse("rpc.unavailable:p=0.5,seed=1")
+        b = FaultPlan.parse("rpc.unavailable:p=0.5,seed=2")
+        sa = [a.fire("rpc.unavailable") is not None for _ in range(100)]
+        sb = [b.fire("rpc.unavailable") is not None for _ in range(100)]
+        assert sa != sb
+
+    def test_sites_do_not_share_a_stream(self):
+        """Interleaving evaluations of another site must not perturb a
+        site's decision sequence (index-keyed draws, not a shared RNG)."""
+        lone = FaultPlan.parse("rpc.unavailable:p=0.3,seed=5")
+        solo = [lone.fire("rpc.unavailable") is not None for _ in range(50)]
+        mixed_plan = FaultPlan.parse(
+            "rpc.unavailable:p=0.3,seed=5;rpc.drop:p=0.3"
+        )
+        mixed = []
+        for _ in range(50):
+            mixed_plan.fire("rpc.drop")
+            mixed.append(mixed_plan.fire("rpc.unavailable") is not None)
+        assert solo == mixed
+
+    def test_times_and_every(self):
+        plan = FaultPlan.parse("rpc.unavailable:every=3,times=2")
+        fired = [
+            i for i in range(1, 13)
+            if plan.fire("rpc.unavailable") is not None
+        ]
+        assert fired == [3, 6]
+
+    def test_rank_step_method_filters(self):
+        plan = FaultPlan.parse("worker.kill:rank=1,step=6")
+        assert plan.fire("worker.kill", rank=0, step=6) is None
+        assert plan.fire("worker.kill", rank=1, step=5) is None
+        assert plan.fire("worker.kill", rank=1, step=6) is not None
+        plan2 = FaultPlan.parse("rpc.unavailable:method=JoinRendezvous")
+        assert plan2.fire("rpc.unavailable", method="Heartbeat") is None
+        assert plan2.fire(
+            "rpc.unavailable", method="JoinRendezvous"
+        ) is not None
+
+    def test_at_gate(self):
+        plan = FaultPlan.parse("rpc.unavailable:at=50ms,times=1")
+        assert plan.fire("rpc.unavailable") is None
+        time.sleep(0.07)
+        assert plan.fire("rpc.unavailable") is not None
+        assert plan.fire("rpc.unavailable") is None  # one-shot spent
+
+    def test_inject_noop_without_plan(self):
+        assert chaos.active_plan() is None
+        assert chaos.inject("rpc.unavailable") is None
+        assert chaos.inject("worker.kill", rank=0, step=0) is None
+
+    def test_crash_kind_calls_exit(self, monkeypatch):
+        exits = []
+        monkeypatch.setattr(os, "_exit", lambda code: exits.append(code))
+        chaos.configure("worker.kill:rank=1,step=6")
+        chaos.inject("worker.kill", rank=1, step=5)
+        assert exits == []
+        chaos.inject("worker.kill", rank=1, step=6)
+        assert exits == [chaos.EXIT_WORKER_KILL]
+
+    def test_latency_kind_sleeps(self):
+        chaos.configure("rdzv.late_join:delay=50ms,times=1")
+        t0 = time.perf_counter()
+        assert chaos.inject("rdzv.late_join") is not None
+        assert time.perf_counter() - t0 >= 0.05
+        t0 = time.perf_counter()
+        assert chaos.inject("rdzv.late_join") is None  # spent
+        assert time.perf_counter() - t0 < 0.04
+
+
+@pytest.mark.chaos
+class TestRpcInjection:
+    def _serve(self):
+        seen = []
+
+        def handler(msg):
+            seen.append(type(msg).__name__)
+            return msgs.BaseResponse(success=True)
+
+        server = RpcServer(0, handler)
+        server.start()
+        return server, seen
+
+    def test_client_unavailable_retried_to_success(self):
+        server, seen = self._serve()
+        try:
+            chaos.configure("rpc.unavailable:times=2")
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            resp = client.call(msgs.Heartbeat(), backoff=0.01)
+            assert isinstance(resp, msgs.BaseResponse) and resp.success
+            assert chaos.active_plan().stats()["rpc.unavailable"] == 2
+            # The first two attempts never reached the server.
+            assert len(seen) == 1
+            client.close()
+        finally:
+            server.stop()
+
+    def test_server_drop_retried_to_success(self):
+        server, seen = self._serve()
+        try:
+            chaos.configure("rpc.drop:times=1")
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            resp = client.call(msgs.Heartbeat(), backoff=0.01)
+            assert isinstance(resp, msgs.BaseResponse) and resp.success
+            assert chaos.active_plan().stats()["rpc.drop"] == 1
+            client.close()
+        finally:
+            server.stop()
+
+    def test_client_latency_injected(self):
+        server, _ = self._serve()
+        try:
+            chaos.configure("rpc.latency:delay=80ms,times=1")
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            t0 = time.perf_counter()
+            client.call(msgs.Heartbeat())
+            assert time.perf_counter() - t0 >= 0.08
+            client.close()
+        finally:
+            server.stop()
+
+    def test_method_filter_spares_other_calls(self):
+        server, seen = self._serve()
+        try:
+            chaos.configure("rpc.unavailable:method=JoinRendezvous,times=99")
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            client.call(msgs.Heartbeat(), retries=1)
+            assert seen == ["Heartbeat"]
+            with pytest.raises(Exception):
+                client.call(
+                    msgs.JoinRendezvous(), retries=2, backoff=0.01
+                )
+            client.close()
+        finally:
+            server.stop()
+
+
+@pytest.mark.chaos
+class TestRendezvousInjection:
+    def test_lost_node_then_rejoin_recovers(self):
+        from dlrover_tpu.master.rendezvous import (
+            ElasticTrainingRendezvousManager,
+        )
+
+        chaos.configure("rdzv.lost_node:times=1")
+        mgr = ElasticTrainingRendezvousManager()
+        mgr.update_rdzv_params(1, 1, waiting_timeout=0.1)
+        mgr.join(0, 0, 1, host="h0", attempt_id="a1")
+        # The join evaporated: no world forms for this node.
+        _, _, world, _ = mgr.get_comm_world(0)
+        assert world == {}
+        # The agent's periodic re-join (same attempt id) heals it.
+        mgr.join(0, 0, 1, host="h0", attempt_id="a1")
+        _, _, world, _ = mgr.get_comm_world(0)
+        assert 0 in world and world[0]["node_id"] == 0
+
+    def test_rejoin_heartbeat_does_not_rearm_lastcall(self):
+        """An already-waiting node's periodic re-join (same attempt id)
+        must not reset the lastcall quiescence window, or enough agents
+        re-joining on uncorrelated timers would stall the round forever."""
+        from dlrover_tpu.master.rendezvous import (
+            ElasticTrainingRendezvousManager,
+        )
+
+        mgr = ElasticTrainingRendezvousManager()
+        mgr.update_rdzv_params(2, 4, waiting_timeout=0.2)
+        mgr.join(1, 0, 1, host="h1", attempt_id="a1")
+        mgr.join(2, 1, 1, host="h2", attempt_id="a2")
+        time.sleep(0.25)  # quiescence window elapses
+        mgr.join(1, 0, 1, host="h1", attempt_id="a1")  # heartbeat re-join
+        # Completion must fire NOW: the re-join did not re-arm lastcall.
+        _, _, world, _ = mgr.get_comm_world(1)
+        assert len(world) == 2
+
+    def test_late_join_delays_outside_lock(self):
+        from dlrover_tpu.master.rendezvous import (
+            ElasticTrainingRendezvousManager,
+        )
+
+        chaos.configure("rdzv.late_join:delay=60ms,times=1")
+        mgr = ElasticTrainingRendezvousManager()
+        mgr.update_rdzv_params(1, 1, waiting_timeout=0.1)
+        t0 = time.perf_counter()
+        mgr.join(0, 0, 1, host="h0", attempt_id="a1")
+        assert time.perf_counter() - t0 >= 0.06
+        _, _, world, _ = mgr.get_comm_world(0)
+        assert 0 in world
+
+
+@pytest.mark.chaos
+class TestShmTornRead:
+    def test_torn_read_once_then_recovers(self, tmp_path):
+        import numpy as np
+
+        from dlrover_tpu.common.shm import SharedMemoryArena
+
+        name = f"dlrtpu_test_torn_{os.getpid()}"
+        arena = SharedMemoryArena(name)
+        try:
+            arena.write_state(
+                {"w": np.arange(8, dtype=np.float32)}, extra={"step": 3}
+            )
+            chaos.configure("shm.torn_read")  # one-shot by default
+            assert arena.metadata() is None  # torn
+            meta = arena.metadata()  # healthy again
+            assert meta is not None and meta["extra"]["step"] == 3
+        finally:
+            arena.close(unlink=True)
+
+
+@pytest.mark.chaos
+class TestIdempotencyTokens:
+    def test_kv_add_token_dedups(self):
+        from dlrover_tpu.master.kv_store import KVStoreService
+
+        kv = KVStoreService()
+        assert kv.add("c", 1, token="t1") == 1
+        assert kv.add("c", 1, token="t1") == 1  # retried duplicate
+        assert kv.add("c", 1, token="t2") == 2
+        assert kv.add("c", 1) == 3  # tokenless keeps old semantics
+
+    def test_task_fetch_token_returns_same_task(self):
+        from dlrover_tpu.master.dataset_splitter import new_dataset_splitter
+        from dlrover_tpu.master.task_manager import TaskManager
+
+        tm = TaskManager()
+        tm.new_dataset(
+            new_dataset_splitter(
+                dataset_name="d", dataset_size=100, shard_size=10,
+            )
+        )
+        first = tm.get_task("d", worker_id=0, token="tok")
+        again = tm.get_task("d", worker_id=0, token="tok")
+        assert first is not None and again == first
+        other = tm.get_task("d", worker_id=0, token="tok2")
+        assert other[0] != first[0]
+
+    def test_tokened_add_over_the_wire(self):
+        from dlrover_tpu.master.kv_store import KVStoreService
+        from dlrover_tpu.master.servicer import MasterServicer
+
+        servicer = MasterServicer(kv_store=KVStoreService())
+        server = RpcServer(0, servicer)
+        server.start()
+        try:
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            add = msgs.KVStoreAdd(key="k", delta=2, token="wire-tok")
+            r1 = client.call(add)
+            r2 = client.call(add)  # simulated retry of the same request
+            assert r1.value == 2 and r2.value == 2
+            client.close()
+        finally:
+            server.stop()
+
+
+@pytest.mark.chaos
+class TestCommitCrashSites:
+    """Crash-before/after-commit injection proves the tracker write is the
+    atomic commit point (a real subprocess takes the os._exit)."""
+
+    SCRIPT = (
+        "import sys\n"
+        "import numpy as np\n"
+        "from dlrover_tpu import chaos\n"
+        "from dlrover_tpu.checkpoint import shard_file\n"
+        "from dlrover_tpu.common.storage import PosixDiskStorage\n"
+        "ckpt_dir, plan = sys.argv[1], sys.argv[2]\n"
+        "if plan != '-':\n"
+        "    chaos.configure(plan)\n"
+        "storage = PosixDiskStorage()\n"
+        "for step in (3, 5):\n"
+        "    shard_file.write_shard(\n"
+        "        storage, ckpt_dir, step, 0,\n"
+        "        {'w': np.arange(4.0) + step}, {'step': step})\n"
+        "    shard_file.commit(storage, ckpt_dir, step)\n"
+        "print('ALL_COMMITS_DONE')\n"
+    )
+
+    def _run(self, ckpt_dir, plan):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        env.pop(chaos.ENV_VAR, None)
+        return subprocess.run(
+            [sys.executable, "-c", self.SCRIPT, str(ckpt_dir), plan],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+
+    def test_no_plan_commits_all(self, tmp_path):
+        from dlrover_tpu.checkpoint import shard_file
+        from dlrover_tpu.common.storage import PosixDiskStorage
+
+        out = self._run(tmp_path, "-")
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert shard_file.latest_step(PosixDiskStorage(), str(tmp_path)) == 5
+
+    def test_crash_before_commit_keeps_previous_step(self, tmp_path):
+        from dlrover_tpu.checkpoint import shard_file
+        from dlrover_tpu.common.storage import PosixDiskStorage
+
+        out = self._run(tmp_path, "ckpt.crash_before_commit:step=5")
+        assert out.returncode == chaos.EXIT_CKPT_BEFORE_COMMIT, (
+            out.stderr[-2000:]
+        )
+        storage = PosixDiskStorage()
+        # Step 3 committed; step 5's shards exist but the tracker still
+        # names 3 — the crash cost progress, never consistency.
+        assert shard_file.latest_step(storage, str(tmp_path)) == 3
+        assert storage.exists(shard_file.shard_path(str(tmp_path), 5, 0))
+        got = shard_file.read_shard(storage, str(tmp_path), 3, 0)
+        assert got is not None and got[1]["step"] == 3
+
+    def test_crash_after_commit_is_durable(self, tmp_path):
+        from dlrover_tpu.checkpoint import shard_file
+        from dlrover_tpu.common.storage import PosixDiskStorage
+
+        out = self._run(tmp_path, "ckpt.crash_after_commit:step=5")
+        assert out.returncode == chaos.EXIT_CKPT_AFTER_COMMIT, (
+            out.stderr[-2000:]
+        )
+        assert shard_file.latest_step(PosixDiskStorage(), str(tmp_path)) == 5

@@ -1,14 +1,21 @@
 """L0 substrate tests: messages, RPC, node model, storage, context."""
 
 import threading
+import time
 
+import grpc
 import pytest
 
 from dlrover_tpu.common import messages as msgs
 from dlrover_tpu.common.constants import NodeStatus
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.node import Node, NodeResource, NodeStatusFlow
-from dlrover_tpu.common.rpc import RpcClient, RpcServer, addr_connectable
+from dlrover_tpu.common.rpc import (
+    ChaosRpcError,
+    RpcClient,
+    RpcServer,
+    addr_connectable,
+)
 
 
 class TestMessages:
@@ -105,6 +112,182 @@ class TestRpc:
             client.close()
         finally:
             server.stop()
+
+
+def _fake_client(responses):
+    """An RpcClient whose channel is scripted: each entry in ``responses``
+    is either an exception to raise or bytes to return.  No real server."""
+    client = RpcClient("127.0.0.1:1")
+    attempts = []
+
+    def fake_call(data, timeout=None):
+        attempts.append(timeout)
+        item = responses.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    client._call = fake_call
+    return client, attempts
+
+
+class TestRpcRetryPolicy:
+    """The retry contract itself, against a scripted channel: UNAVAILABLE
+    retried under jittered-bounded backoff, DEADLINE_EXCEEDED only for
+    idempotent calls, exhausted retries re-raise the LAST error, and the
+    total deadline budget caps the loop."""
+
+    def _unavailable(self):
+        return ChaosRpcError(grpc.StatusCode.UNAVAILABLE, "test")
+
+    def _deadline(self):
+        return ChaosRpcError(grpc.StatusCode.DEADLINE_EXCEEDED, "test")
+
+    def test_unavailable_retried_with_bounded_backoff(self, monkeypatch):
+        ok = msgs.serialize(msgs.BaseResponse(success=True))
+        client, attempts = _fake_client(
+            [self._unavailable(), self._unavailable(),
+             self._unavailable(), ok]
+        )
+        sleeps = []
+        monkeypatch.setattr(
+            "dlrover_tpu.common.rpc.time.sleep", sleeps.append
+        )
+        resp = client.call(msgs.Heartbeat(), retries=5, backoff=0.5)
+        assert isinstance(resp, msgs.BaseResponse) and resp.success
+        assert len(attempts) == 4
+        assert len(sleeps) == 3
+        for i, s in enumerate(sleeps):
+            base = min(0.5 * (2**i), 8.0)
+            # Half-jittered exponential: within [base/2, base], capped.
+            assert 0.5 * base <= s <= base
+
+    def test_deadline_exceeded_not_retried(self, monkeypatch):
+        client, attempts = _fake_client([self._deadline()])
+        monkeypatch.setattr(
+            "dlrover_tpu.common.rpc.time.sleep", lambda s: None
+        )
+        with pytest.raises(grpc.RpcError):
+            client.call(msgs.KVStoreSet(key="k", value=b"v"), retries=5)
+        assert len(attempts) == 1  # the request may have executed: no resend
+
+    def test_deadline_exceeded_retried_when_idempotent(self, monkeypatch):
+        ok = msgs.serialize(msgs.BaseResponse(success=True))
+        client, attempts = _fake_client([self._deadline(), ok])
+        monkeypatch.setattr(
+            "dlrover_tpu.common.rpc.time.sleep", lambda s: None
+        )
+        resp = client.call(
+            msgs.KVStoreGet(key="k"), retries=5, idempotent=True
+        )
+        assert isinstance(resp, msgs.BaseResponse)
+        assert len(attempts) == 2
+
+    def test_exhausted_retries_reraise_last_error(self, monkeypatch):
+        errs = [self._unavailable() for _ in range(3)]
+        client, attempts = _fake_client(list(errs))
+        monkeypatch.setattr(
+            "dlrover_tpu.common.rpc.time.sleep", lambda s: None
+        )
+        with pytest.raises(grpc.RpcError) as ei:
+            client.call(msgs.Heartbeat(), retries=3, backoff=0.001)
+        assert ei.value is errs[-1]
+        assert len(attempts) == 3
+
+    def test_other_codes_raise_immediately(self, monkeypatch):
+        err = ChaosRpcError(grpc.StatusCode.INTERNAL, "boom")
+        client, attempts = _fake_client([err])
+        with pytest.raises(grpc.RpcError):
+            client.call(msgs.Heartbeat(), retries=5)
+        assert len(attempts) == 1
+
+    def test_deadline_budget_caps_retries(self, monkeypatch):
+        """With a tiny total budget the loop stops early even though
+        ``retries`` remain — and still raises the transport error."""
+        client, attempts = _fake_client(
+            [self._unavailable() for _ in range(10)]
+        )
+        with pytest.raises(grpc.RpcError):
+            client.call(
+                msgs.Heartbeat(), retries=10, backoff=0.05, deadline=0.08
+            )
+        assert len(attempts) < 10
+
+    def test_per_attempt_timeout_clamped_to_budget(self):
+        ok = msgs.serialize(msgs.BaseResponse(success=True))
+        client, attempts = _fake_client([ok])
+        client.call(msgs.Heartbeat(), timeout=500.0, deadline=2.0)
+        assert attempts[0] <= 2.0
+
+    def test_default_budget_never_shortens_explicit_timeout(self):
+        """A caller-configured timeout beyond DEFAULT_DEADLINE must get
+        its full window (the default budget stretches to cover it)."""
+        ok = msgs.serialize(msgs.BaseResponse(success=True))
+        client, attempts = _fake_client([ok])
+        client.call(msgs.Heartbeat(), timeout=120.0)
+        assert attempts[0] > 60.0
+
+
+class TestRpcReconnect:
+    def test_reconnect_survives_server_restart_on_same_port(self):
+        from dlrover_tpu.common.rpc import find_free_port
+
+        port = find_free_port()
+        s1 = RpcServer(port, lambda m: msgs.BaseResponse(success=True))
+        s1.start()
+        client = RpcClient(f"127.0.0.1:{port}")
+        try:
+            assert client.call(msgs.Heartbeat()).success
+            s1.stop(grace=0.1)
+            s2 = RpcServer(port, lambda m: msgs.BaseResponse(success=True))
+            s2.start()
+            try:
+                # A rebuilt channel must reach the new incarnation even if
+                # the old one is sulking in reconnect backoff.
+                client.reconnect(force=True)
+                resp = client.call(msgs.Heartbeat(), backoff=0.05)
+                assert resp.success
+            finally:
+                s2.stop()
+        finally:
+            client.close()
+
+
+class TestDeadlineClamps:
+    def test_addr_connectable_respects_deadline(self):
+        from dlrover_tpu.common.rpc import find_free_port
+
+        port = find_free_port()  # nothing listens here: instant refusal
+        t0 = time.perf_counter()
+        assert not addr_connectable(f"127.0.0.1:{port}", timeout=0.6)
+        # The old loop slept a fixed 0.5s past the deadline; the clamp
+        # keeps total time near the budget.
+        assert time.perf_counter() - t0 < 1.5
+
+    def test_barrier_poll_clamped(self, monkeypatch):
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient.__new__(MasterClient)
+        monkeypatch.setattr(
+            client, "join_sync", lambda *a, **k: None, raising=False
+        )
+        monkeypatch.setattr(
+            client, "sync_finished", lambda *a, **k: False, raising=False
+        )
+        t0 = time.perf_counter()
+        assert client.barrier("b", timeout=0.3) is False
+        assert time.perf_counter() - t0 < 0.8
+
+    def test_kv_wait_get_clamped(self, monkeypatch):
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient.__new__(MasterClient)
+        monkeypatch.setattr(
+            client, "kv_store_get", lambda *a, **k: None, raising=False
+        )
+        t0 = time.perf_counter()
+        assert client.kv_store_wait_get("k", timeout=0.3, poll=0.2) is None
+        assert time.perf_counter() - t0 < 0.8
 
 
 class TestNode:
