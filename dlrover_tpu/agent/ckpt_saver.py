@@ -22,6 +22,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
 from dlrover_tpu import chaos
+from dlrover_tpu.agent.metrics import integrity_counters
 from dlrover_tpu.checkpoint import shard_file
 from dlrover_tpu.checkpoint.engine import (
     ckpt_lock_name,
@@ -275,6 +276,22 @@ class AsyncCheckpointSaver:
                 "the staged one", staged_step, step,
             )
             step = staged_step
+        # The arena's CRC covers the meta blob only; validate the staged
+        # state's own layout metadata before it becomes a durable shard —
+        # a torn/mismatched stage must never be persisted (and later
+        # trusted) under this event's identity.
+        reason = shard_file.validate_staged_state(
+            tensors, extra,
+            expect_process_id=pid,
+            expect_num_processes=nproc_global,
+        )
+        if reason is not None:
+            integrity_counters.inc("ckpt_staged_rejected")
+            logger.error(
+                "saver: rank %d staged state rejected, NOT persisted (%s)",
+                lr, reason,
+            )
+            return
         t0 = time.perf_counter()
         chaos.inject("ckpt.slow_storage", step=step, rank=pid)
         shard_file.write_shard(

@@ -18,6 +18,45 @@ from dlrover_tpu.common.log import logger
 PREFIX = "dlrover_tpu"
 
 
+class CounterSet:
+    """Monotonic named counters, thread-safe, sampled by gauges.
+
+    Process-global instances (``integrity_counters``) let deep layers
+    (checkpoint engine, replica exchange, saver) count rare-but-serious
+    events without holding a registry reference; the agent registers one
+    gauge per name at startup so the counts reach Prometheus."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            val = self._counts.get(name, 0) + n
+            self._counts[name] = val
+            return val
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+#: Checkpoint-integrity signals (ISSUE 3): silent bit-rot must surface as
+#: an operator signal, not a log line lost in the noise.
+INTEGRITY_COUNTER_NAMES = (
+    "ckpt_corruption_detected",  # shard failed CRC/structural verification
+    "ckpt_step_quarantined",  # step dir renamed/markered out of the ladder
+    "ckpt_replica_rejected",  # replica payload failed verification
+    "ckpt_staged_rejected",  # shm-staged state refused before persist
+)
+
+integrity_counters = CounterSet()
+
+
 class MetricsRegistry:
     """Name -> callable returning a float (sampled at scrape time)."""
 

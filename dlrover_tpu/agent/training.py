@@ -453,14 +453,25 @@ class ElasticTrainingAgent:
         metrics_port = int(os.environ.get("DLROVER_TPU_METRICS_PORT", "0"))
         if metrics_port:
             from dlrover_tpu.agent.metrics import (
+                INTEGRITY_COUNTER_NAMES,
                 MetricsRegistry,
                 MetricsServer,
+                integrity_counters,
             )
             from dlrover_tpu.agent.monitor import current_usage
 
             reg = MetricsRegistry()
             reg.gauge("restart_count", lambda: float(self._restart_count))
             reg.gauge("rdzv_round", lambda: float(self._rdzv_round))
+            # Checkpoint-integrity signals (replica rejections and staged
+            # -state rejections happen in this process; corruption found
+            # by worker-side restores reaches the master via the
+            # ckpt_integrity diagnosis reports instead).
+            for cname in INTEGRITY_COUNTER_NAMES:
+                reg.gauge(
+                    cname,
+                    lambda n=cname: float(integrity_counters.get(n)),
+                )
             reg.gauge(
                 "node_cpu_percent",
                 lambda: current_usage()["cpu_percent"],

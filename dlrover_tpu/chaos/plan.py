@@ -72,6 +72,13 @@ SITES: Dict[str, dict] = {
     },
     "ckpt.slow_storage": {"kind": "latency", "delay": 1.0},
     "shm.torn_read": {"kind": "flag", "times": 1},
+    # Data-corruption sites: the caller damages the payload it was about
+    # to write/send (silent bit-rot, torn transfers) — the commit
+    # protocol proceeds normally, so restore-side verification is what
+    # must catch it.
+    "storage.corrupt_shard": {"kind": "flag", "times": 1},
+    "storage.truncate_shard": {"kind": "flag", "times": 1},
+    "replica.torn_push": {"kind": "flag", "times": 1},
     "worker.kill": {"kind": "crash", "exit": EXIT_WORKER_KILL, "times": 1},
     "master.restart": {
         "kind": "crash", "exit": EXIT_MASTER_RESTART, "times": 1,

@@ -8,7 +8,23 @@ persists shm -> storage with a done-file commit protocol, and restore prefers
 the still-warm shm arena (seconds) over storage (minutes) — including
 **reshard-on-restore** when the world changed (Tenplex-style; the reference
 sidesteps this with fixed-world restarts).
+
+Re-exports are lazy (PEP 562): ``python -m dlrover_tpu.checkpoint.fsck``
+runs on operator/CI hosts without pulling jax in through the engine import.
 """
 
-from dlrover_tpu.checkpoint.checkpointer import FlashCheckpointer  # noqa: F401
-from dlrover_tpu.checkpoint.engine import CheckpointEngine  # noqa: F401
+_LAZY = {
+    "FlashCheckpointer": "dlrover_tpu.checkpoint.checkpointer",
+    "CheckpointEngine": "dlrover_tpu.checkpoint.engine",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name])
+        return getattr(mod, name)
+    raise AttributeError(
+        f"module 'dlrover_tpu.checkpoint' has no attribute {name!r}"
+    )

@@ -18,6 +18,7 @@ Two runtime modes, auto-detected:
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -29,8 +30,10 @@ import numpy as np
 import jax
 
 from dlrover_tpu import chaos
+from dlrover_tpu.agent.metrics import integrity_counters
 from dlrover_tpu.checkpoint import shard_file, tree_utils
 from dlrover_tpu.common import env as env_utils
+from dlrover_tpu.diagnosis.data import DiagnosisDataType
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.multi_process import (
@@ -81,6 +84,9 @@ class CheckpointEngine:
         )
         self._last_saved_step = -1
         self._last_persist_step = -1
+        # step -> "a corrupt shard was seen while reading this step's
+        # candidates" (populated per load; drives quarantine decisions).
+        self._step_had_corruption: Dict[int, bool] = {}
 
         self.agent_mode = os.path.exists(
             socket_path("queue", ckpt_queue_name(self.job_name))
@@ -176,6 +182,18 @@ class CheckpointEngine:
 
     def _persist(self, step: int, tensors, extra) -> None:
         try:
+            reason = shard_file.validate_staged_state(
+                tensors, extra,
+                expect_process_id=self.process_id,
+                expect_num_processes=self.num_processes,
+            )
+            if reason is not None:
+                integrity_counters.inc("ckpt_staged_rejected")
+                logger.error(
+                    "NOT persisting step %d: staged state invalid (%s)",
+                    step, reason,
+                )
+                return
             chaos.inject(
                 "ckpt.slow_storage", step=step, rank=self.process_id
             )
@@ -265,19 +283,33 @@ class CheckpointEngine:
                 return result
         # Storage: committed step first, then newer uncommitted steps whose
         # available shards still cover the target (e.g. a breakpoint save
-        # from a partial world with replicated state).
+        # from a partial world with replicated state).  Corruption is
+        # treated like absence — a damaged step is skipped (and
+        # quarantined), never allowed to abort the whole restore.
         result = None
         chosen = -1
+        self._step_had_corruption = {}
         for source, extra in self._storage_candidates():
+            cand_step = int(extra.get("step", -1))
             try:
                 result = self._finish_load(source, extra, target)
-                chosen = int(extra.get("step", 0))
+                chosen = max(cand_step, 0)
                 break
             except KeyError as e:
                 logger.warning(
                     "storage step %s not restorable (%s); trying older",
                     extra.get("step"), e,
                 )
+            except Exception as e:  # noqa: BLE001 - unverified v1 payloads
+                # can fail assembly in arbitrary ways; the ladder must
+                # fall through to an older candidate, not crash.
+                logger.warning(
+                    "storage step %s failed to assemble (%s: %s); "
+                    "trying older",
+                    extra.get("step"), type(e).__name__, e,
+                )
+            if self._step_had_corruption.get(cand_step):
+                self._quarantine(cand_step)
         return self._agree_storage_step(result, chosen, target)
 
     def _all_ranks_ok(self, ok: bool) -> bool:
@@ -335,7 +367,12 @@ class CheckpointEngine:
                         continue
                     try:
                         retry = self._finish_load(source, extra, target)
-                    except KeyError:
+                    except Exception as e:  # noqa: BLE001 - uncoverable or
+                        # damaged agreed step: fall to the collective below
+                        logger.warning(
+                            "agreed step %d failed to assemble: %s",
+                            agreed, e,
+                        )
                         retry = None
                     break
         # Second collective: every rank must have the agreed step or all
@@ -433,11 +470,20 @@ class CheckpointEngine:
         (tracker) step first, then remaining step dirs newest-first.  The
         caller validates coverage by attempting assembly — an uncommitted
         step is usable when its present shards cover the target (fully
-        replicated layouts need any one rank's shard)."""
+        replicated layouts need any one rank's shard).
+
+        A shard that fails verification is skipped like an absent one (the
+        step may still cover the target from other ranks' shards); a step
+        whose every shard is unreadable *and* showed corruption is
+        quarantined on the spot."""
         committed = shard_file.latest_step(self.storage, self.ckpt_dir)
         steps = shard_file.list_steps(self.storage, self.ckpt_dir)
         candidates = []
-        if committed is not None:
+        # Only a LIVE committed step is a candidate: on backends without
+        # rename the quarantine is a marker file (list_steps filters it),
+        # and the tracker must not smuggle the damaged step back in on
+        # every restart.
+        if committed is not None and committed in steps:
             candidates.append(committed)
         candidates.extend(
             s for s in sorted(steps, reverse=True) if s != committed
@@ -445,25 +491,84 @@ class CheckpointEngine:
         for step in candidates:
             source = tree_utils.ShardSource()
             extra_out = None
+            corrupt = False
             for pid in shard_file.list_shard_ids(
                 self.storage, self.ckpt_dir, step
             ):
-                got = shard_file.read_shard(
-                    self.storage, self.ckpt_dir, step, pid
-                )
+                try:
+                    got = shard_file.read_shard(
+                        self.storage, self.ckpt_dir, step, pid
+                    )
+                except shard_file.ShardCorruptionError as e:
+                    corrupt = True
+                    self._note_corruption(step, pid, e)
+                    continue
+                except Exception as e:  # noqa: BLE001 - I/O hiccup: treat
+                    # the shard as absent (no quarantine — nothing proves
+                    # the bytes themselves are damaged).
+                    logger.warning(
+                        "shard (step %d, proc %d) unreadable (%s: %s); "
+                        "skipping", step, pid, type(e).__name__, e,
+                    )
+                    continue
                 if got is None:
                     continue
                 tensors, extra = got
                 source.add(tensors, extra.get("tensors_info", {}))
                 if pid == self.process_id or extra_out is None:
                     extra_out = extra
+            self._step_had_corruption[step] = corrupt
             if extra_out is None:
+                if corrupt:
+                    self._quarantine(step)
                 continue
             logger.info(
                 "flash ckpt: restore from storage step %d%s",
                 step, "" if step == committed else " (uncommitted)",
             )
             yield source, extra_out
+
+    # -- integrity bookkeeping ----------------------------------------------
+    def _note_corruption(
+        self, step: int, pid: int, err: Exception
+    ) -> None:
+        integrity_counters.inc("ckpt_corruption_detected")
+        logger.warning(
+            "corrupt checkpoint shard (step %d, proc %d): %s",
+            step, pid, err,
+        )
+        self._report_integrity(
+            {
+                "event": "corruption_detected",
+                "step": step,
+                "process_id": pid,
+                "reason": str(err),
+            }
+        )
+
+    def _quarantine(self, step: int) -> None:
+        where = shard_file.quarantine_step(
+            self.storage, self.ckpt_dir, step
+        )
+        if where is None:
+            return
+        integrity_counters.inc("ckpt_step_quarantined")
+        self._report_integrity(
+            {"event": "step_quarantined", "step": step, "path": where}
+        )
+
+    def _report_integrity(self, event: dict) -> None:
+        """Best-effort diagnosis report: the master log is where silent
+        bit-rot becomes an operator signal; the restore proceeds either
+        way."""
+        if self.client is None:
+            return
+        try:
+            self.client.report_diagnosis_data(
+                DiagnosisDataType.CKPT_INTEGRITY, json.dumps(event)
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.debug("ckpt integrity report failed: %s", e)
 
     def close(self) -> None:
         if self._pool is not None:

@@ -21,12 +21,59 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu import chaos
+from dlrover_tpu.agent.metrics import integrity_counters
 from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RpcClient, RpcServer, local_ip
 from dlrover_tpu.checkpoint import shard_file
 
 _KV_PREFIX = "replica/addr/"
+
+
+def _layout_mismatch(
+    extra: dict, expect_process_id: int, expect_step: int
+) -> Optional[str]:
+    """Step/world-layout metadata check on a replica payload's ``extra``.
+    Returns a rejection reason or ``None``."""
+    if int(extra.get("step", -1)) != int(expect_step):
+        return (
+            f"step mismatch (payload says {extra.get('step')}, "
+            f"envelope says {expect_step})"
+        )
+    pid = extra.get("process_id")
+    if pid is not None and int(pid) != int(expect_process_id):
+        return (
+            f"process mismatch (payload proc {pid}, "
+            f"envelope proc {expect_process_id})"
+        )
+    if not extra.get("tensors_info"):
+        return "tensors_info missing (payload could never seed a restore)"
+    if int(extra.get("num_processes", 0) or 0) <= 0:
+        return "num_processes missing"
+    return None
+
+
+def check_replica_payload(
+    payload: bytes, process_id: int, step: int
+) -> Optional[str]:
+    """CRC + layout verification of a replica payload (both directions of
+    the ring exchange).  Returns a rejection reason or ``None``."""
+    try:
+        extra = shard_file.verify_shard(payload)
+    except shard_file.ShardCorruptionError as e:
+        return f"corrupt payload: {e}"
+    return _layout_mismatch(extra, process_id, step)
+
+
+def _chaos_torn_push(payload: bytes, step: int, process_id: int) -> bytes:
+    """``replica.torn_push`` chaos site: only a prefix of the payload
+    survives the transfer — the receiver's verification must reject it."""
+    if chaos.inject(
+        "replica.torn_push", step=step, rank=process_id
+    ) is None:
+        return payload
+    return payload[: max(1, len(payload) // 2)]
 
 
 class ReplicaStore:
@@ -80,6 +127,19 @@ class ReplicaServicer:
 
     def __call__(self, msg: m.Message) -> Optional[m.Message]:
         if isinstance(msg, m.ReplicaPush):
+            # Verify before accepting: a torn push stored here would
+            # poison a replaced node's warm restore later, when the
+            # original copy is long gone.
+            reason = check_replica_payload(
+                msg.payload, msg.process_id, msg.step
+            )
+            if reason is not None:
+                integrity_counters.inc("ckpt_replica_rejected")
+                logger.warning(
+                    "replica push (proc %d step %d) rejected: %s",
+                    msg.process_id, msg.step, reason,
+                )
+                return m.BaseResponse(success=False, reason=reason)
             ok = self._store.put(msg.process_id, msg.step, msg.payload)
             return m.BaseResponse(success=ok)
         if isinstance(msg, m.ReplicaFetch):
@@ -177,6 +237,7 @@ class CkptReplicaManager:
         if peer is None:
             return False
         payload = shard_file.pack_shard(tensors, extra)
+        payload = _chaos_torn_push(payload, step, process_id)
         try:
             resp = peer.call(
                 m.ReplicaPush(
@@ -191,6 +252,11 @@ class CkptReplicaManager:
             logger.warning("replica push to rank %d failed: %s",
                            self.backup_rank, e)
             return False
+        if not ok and getattr(resp, "reason", ""):
+            logger.warning(
+                "replica push (proc %d step %d) refused by node %d: %s",
+                process_id, step, self.backup_rank, resp.reason,
+            )
         if ok:
             self._last_push[process_id] = now
             logger.info(
@@ -217,7 +283,25 @@ class CkptReplicaManager:
             return None
         if not isinstance(resp, m.ReplicaData) or not resp.found:
             return None
-        tensors, extra = shard_file.unpack_shard(resp.payload)
+        # Verify on fetch too: the store's copy was verified on push, but
+        # the fetch rides the same wire — a torn transfer here would seed
+        # the local arena with garbage the warm restore then trusts.
+        try:
+            tensors, extra = shard_file.unpack_shard(resp.payload)
+        except shard_file.ShardCorruptionError as e:
+            integrity_counters.inc("ckpt_replica_rejected")
+            logger.warning(
+                "replica fetch for proc %d rejected (corrupt payload): %s",
+                process_id, e,
+            )
+            return None
+        reason = _layout_mismatch(extra, process_id, resp.step)
+        if reason is not None:
+            integrity_counters.inc("ckpt_replica_rejected")
+            logger.warning(
+                "replica fetch for proc %d rejected: %s", process_id, reason
+            )
+            return None
         logger.info(
             "replica: recovered proc %d step %d from node %d",
             process_id, resp.step, self.backup_rank,

@@ -11,6 +11,19 @@ Mirrors the reference's done-file + tracker commit
 (``ckpt_saver.py commit_checkpoint :822``): a step directory is valid iff the
 tracker names it, and the tracker is only advanced after every shard's done
 file exists — a crash mid-persist leaves the previous step intact.
+
+Format v2 (magic ``DLRTPUF2``) adds end-to-end integrity: the 20-byte header
+carries a CRC-32 of the msgpack meta blob, and every tensor's meta carries a
+CRC-32 of its data blob, both computed on :func:`pack_shard` and verified on
+:func:`unpack_shard`/:func:`verify_shard`.  v1 shards (``DLRTPUF1``, no CRCs)
+remain readable — only structural checks apply to them.  Every way a payload
+can be damaged (short file, bad magic, meta past EOF, undecodable meta, blob
+out of bounds, CRC mismatch, garbage dtype/shape) surfaces as one typed
+:class:`ShardCorruptionError`, which the restore ladder treats like absence
+and :mod:`dlrover_tpu.checkpoint.fsck` reports to operators.  A step that
+fails verification is **quarantined** (:func:`quarantine_step`): its dir is
+renamed ``step_N.corrupt`` (marker file on backends without rename) and
+excluded from :func:`list_steps`, restore candidates, and rotation.
 """
 
 from __future__ import annotations
@@ -18,6 +31,7 @@ from __future__ import annotations
 import os
 import struct
 import time
+import zlib
 from typing import Dict, Optional, Tuple
 
 import msgpack
@@ -26,9 +40,60 @@ import numpy as np
 from dlrover_tpu import chaos
 from dlrover_tpu.common.constants import CheckpointConstant as CC
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.native import shm_lib
 from dlrover_tpu.common.storage import CheckpointStorage
 
-_MAGIC = b"DLRTPUF1"
+FORMAT_VERSION = 2
+_MAGIC_V1 = b"DLRTPUF1"
+_MAGIC = b"DLRTPUF2"
+_V1_HEADER = 16  # magic u64 | meta_len u64
+_V2_HEADER = 20  # magic u64 | meta_len u64 | meta_crc u32
+
+# Below this size the ctypes round-trip costs more than it saves; zlib's
+# C loop is already fast for small buffers.
+_NATIVE_CRC_MIN_BYTES = 1 << 20
+
+QUARANTINE_SUFFIX = ".corrupt"
+QUARANTINE_MARKER = ".quarantined"
+
+
+class ShardCorruptionError(Exception):
+    """A shard payload failed structural or CRC verification.
+
+    The one exception type for every corruption mode, so callers (restore
+    ladder, replica exchange, fsck) can treat damage uniformly — skip the
+    shard, fall through to an older step — instead of crashing on raw
+    ``struct.error``/``ValueError`` from whichever parse line tripped.
+    """
+
+    def __init__(self, reason: str, path: str = ""):
+        self.reason = reason
+        self.path = path
+        super().__init__(f"{path}: {reason}" if path else reason)
+
+
+def shard_version(data: bytes) -> Optional[int]:
+    """Format version by magic (1 or 2), or ``None`` for foreign bytes."""
+    magic = bytes(data[:8])
+    if magic == _MAGIC:
+        return 2
+    if magic == _MAGIC_V1:
+        return 1
+    return None
+
+
+def crc32_bytes(buf) -> int:
+    """CRC-32 (zlib polynomial) of a bytes-like buffer.
+
+    Large buffers go through the native ``shm_crc32`` kernel
+    (``native/shm_arena.cc``) when the toolchain built it — same
+    polynomial, same result — with ``zlib.crc32`` as the fallback."""
+    if len(buf) >= _NATIVE_CRC_MIN_BYTES:
+        lib = shm_lib()
+        if lib is not None:
+            arr = np.frombuffer(buf, dtype=np.uint8)
+            return int(lib.shm_crc32(arr.ctypes.data, arr.nbytes, 0))
+    return zlib.crc32(buf) & 0xFFFFFFFF
 
 
 def step_dir(ckpt_dir: str, step: int) -> str:
@@ -63,35 +128,192 @@ def pack_shard(tensors: Dict[str, np.ndarray], extra: dict) -> bytes:
             )
         except TypeError:
             dtype_key = arr.dtype.str
+        blob = arr.reshape(-1).view(np.uint8).tobytes()
         metas[key] = {
             "dtype": dtype_key,
             "shape": shape,
             "offset": offset,
             "nbytes": int(arr.nbytes),
+            "crc32": crc32_bytes(blob),
         }
-        blobs.append(arr.reshape(-1).view(np.uint8).tobytes())
+        blobs.append(blob)
         offset += arr.nbytes
     meta_blob = msgpack.packb(
-        {"tensors": metas, "extra": extra}, use_bin_type=True
+        {"format": FORMAT_VERSION, "tensors": metas, "extra": extra},
+        use_bin_type=True,
     )
-    header = _MAGIC + struct.pack("<Q", len(meta_blob))
+    header = _MAGIC + struct.pack("<QI", len(meta_blob), crc32_bytes(meta_blob))
     return header + meta_blob + b"".join(blobs)
 
 
-def unpack_shard(data: bytes) -> Tuple[Dict[str, np.ndarray], dict]:
-    if data[:8] != _MAGIC:
-        raise ValueError("not a dlrover_tpu shard file")
-    (meta_len,) = struct.unpack("<Q", data[8:16])
-    meta = msgpack.unpackb(data[16 : 16 + meta_len], raw=False)
-    base = 16 + meta_len
+def _parse_meta(data: bytes, path: str = "") -> Tuple[dict, int, int]:
+    """Validate header + meta blob; returns (meta, data_base, version).
+
+    Every structural defect — not just the happy-path magic check —
+    raises :class:`ShardCorruptionError`."""
+    if len(data) < _V1_HEADER:
+        raise ShardCorruptionError(
+            f"file shorter than the shard header ({len(data)} bytes)", path
+        )
+    magic = bytes(data[:8])
+    if magic == _MAGIC:
+        version = 2
+        if len(data) < _V2_HEADER:
+            raise ShardCorruptionError("v2 header truncated", path)
+        meta_len, meta_crc = struct.unpack("<QI", data[8:_V2_HEADER])
+        base = _V2_HEADER
+    elif magic == _MAGIC_V1:
+        version = 1
+        (meta_len,) = struct.unpack("<Q", data[8:_V1_HEADER])
+        meta_crc = None
+        base = _V1_HEADER
+    else:
+        raise ShardCorruptionError(
+            f"bad magic {magic!r} — not a dlrover_tpu shard", path
+        )
+    if base + meta_len > len(data):
+        raise ShardCorruptionError(
+            f"meta region ({meta_len}B) extends past EOF "
+            f"({len(data)}B file)", path,
+        )
+    meta_raw = bytes(data[base : base + meta_len])
+    if meta_crc is not None and crc32_bytes(meta_raw) != meta_crc:
+        raise ShardCorruptionError("meta CRC mismatch", path)
+    try:
+        meta = msgpack.unpackb(meta_raw, raw=False)
+    except Exception as e:  # noqa: BLE001 - any decode failure is corruption
+        raise ShardCorruptionError(f"meta blob undecodable: {e}", path) from e
+    if (
+        not isinstance(meta, dict)
+        or not isinstance(meta.get("tensors"), dict)
+        or not isinstance(meta.get("extra"), dict)
+    ):
+        raise ShardCorruptionError("meta structure invalid", path)
+    return meta, base + meta_len, version
+
+
+def _tensor_blob(data: bytes, base: int, key: str, tm, path: str):
+    """Bounds-checked zero-copy view of one tensor's bytes."""
+    try:
+        offset = int(tm["offset"])
+        nbytes = int(tm["nbytes"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise ShardCorruptionError(
+            f"tensor {key!r} meta invalid: {e}", path
+        ) from e
+    if offset < 0 or nbytes < 0 or base + offset + nbytes > len(data):
+        raise ShardCorruptionError(
+            f"tensor {key!r} blob (offset={offset}, nbytes={nbytes}) "
+            "truncated or out of bounds", path,
+        )
+    return memoryview(data)[base + offset : base + offset + nbytes]
+
+
+def _check_tensor_crc(buf, key: str, tm, version: int, path: str) -> None:
+    if version < 2:
+        return  # v1 shards carry no CRCs
+    want = tm.get("crc32")
+    if not isinstance(want, int):
+        raise ShardCorruptionError(
+            f"tensor {key!r} missing crc32 in v2 meta", path
+        )
+    if crc32_bytes(buf) != want:
+        raise ShardCorruptionError(
+            f"tensor {key!r} CRC mismatch (bit rot or torn write)", path
+        )
+
+
+def verify_shard(data: bytes, path: str = "") -> dict:
+    """Full integrity check without materializing arrays: header, meta CRC,
+    per-tensor bounds + CRCs.  Returns the shard's ``extra`` metadata;
+    raises :class:`ShardCorruptionError` on any damage."""
+    meta, base, version = _parse_meta(data, path)
+    for key, tm in meta["tensors"].items():
+        buf = _tensor_blob(data, base, key, tm, path)
+        _check_tensor_crc(buf, key, tm, version, path)
+    return meta["extra"]
+
+
+def unpack_shard(
+    data: bytes, path: str = ""
+) -> Tuple[Dict[str, np.ndarray], dict]:
+    """Decode (and verify) a shard payload; ``path`` only labels errors."""
+    meta, base, version = _parse_meta(data, path)
     tensors = {}
     for key, tm in meta["tensors"].items():
-        start = base + tm["offset"]
-        buf = data[start : start + tm["nbytes"]]
-        tensors[key] = np.frombuffer(buf, dtype=np.dtype(tm["dtype"])).reshape(
-            tm["shape"]
-        ).copy()
+        buf = _tensor_blob(data, base, key, tm, path)
+        _check_tensor_crc(buf, key, tm, version, path)
+        try:
+            arr = (
+                np.frombuffer(buf, dtype=np.dtype(tm["dtype"]))
+                .reshape(tm["shape"])
+                .copy()
+            )
+        except Exception as e:  # noqa: BLE001 - garbage dtype/shape meta
+            raise ShardCorruptionError(
+                f"tensor {key!r} undecodable: {e}", path
+            ) from e
+        tensors[key] = arr
     return tensors, meta["extra"]
+
+
+def validate_staged_state(
+    tensors,
+    extra,
+    *,
+    expect_process_id: Optional[int] = None,
+    expect_num_processes: Optional[int] = None,
+) -> Optional[str]:
+    """Sanity-check a shm-staged state before it is persisted or
+    replicated.  Returns a rejection reason, or ``None`` when coherent —
+    a torn arena read must never become a committed shard."""
+    if not isinstance(tensors, dict) or not tensors:
+        return "no tensors staged"
+    if not isinstance(extra, dict):
+        return "extra metadata missing"
+    try:
+        step = int(extra.get("step"))
+    except (TypeError, ValueError):
+        return f"staged step {extra.get('step')!r} is not an int"
+    if step < 0:
+        return f"staged step {step} is negative"
+    if not extra.get("tensors_info"):
+        return "tensors_info missing (state could never be reassembled)"
+    pid = extra.get("process_id")
+    if (
+        expect_process_id is not None
+        and pid is not None
+        and int(pid) != int(expect_process_id)
+    ):
+        return f"staged process_id {pid} != expected {expect_process_id}"
+    world = extra.get("num_processes")
+    if (
+        expect_num_processes is not None
+        and world is not None
+        and int(world) != int(expect_num_processes)
+    ):
+        return f"staged num_processes {world} != expected {expect_num_processes}"
+    return None
+
+
+def _chaos_damage_blob(blob: bytes, step: int, process_id: int) -> bytes:
+    """Data-corruption chaos sites, applied to the packed payload just
+    before the storage write — the written file carries the damage while
+    the done-file/commit protocol proceeds normally, exactly the silent
+    bit-rot / torn-write scenario the restore ladder must survive."""
+    if chaos.inject(
+        "storage.corrupt_shard", step=step, rank=process_id
+    ) is not None:
+        # Flip a byte near the tail (tensor data region when any tensor
+        # bytes exist, meta otherwise — both are CRC-covered).
+        damaged = bytearray(blob)
+        damaged[max(0, len(damaged) - 7)] ^= 0xFF
+        blob = bytes(damaged)
+    if chaos.inject(
+        "storage.truncate_shard", step=step, rank=process_id
+    ) is not None:
+        blob = blob[: max(1, len(blob) // 2)]
+    return blob
 
 
 def write_shard(
@@ -103,17 +325,21 @@ def write_shard(
     extra: dict,
 ) -> None:
     storage.safe_makedirs(step_dir(ckpt_dir, step))
-    storage.write(pack_shard(tensors, extra), shard_path(ckpt_dir, step, process_id))
+    blob = _chaos_damage_blob(pack_shard(tensors, extra), step, process_id)
+    storage.write(blob, shard_path(ckpt_dir, step, process_id))
     storage.write(str(time.time()), done_path(ckpt_dir, step, process_id))
 
 
 def read_shard(
     storage: CheckpointStorage, ckpt_dir: str, step: int, process_id: int
 ) -> Optional[Tuple[Dict[str, np.ndarray], dict]]:
-    data = storage.read(shard_path(ckpt_dir, step, process_id))
+    """Read + verify one shard.  ``None`` when absent; raises
+    :class:`ShardCorruptionError` (with the path filled in) on damage."""
+    path = shard_path(ckpt_dir, step, process_id)
+    data = storage.read(path)
     if data is None:
         return None
-    return unpack_shard(data)
+    return unpack_shard(data, path=path)
 
 
 def list_shard_ids(storage: CheckpointStorage, ckpt_dir: str, step: int) -> list:
@@ -179,28 +405,93 @@ def commit(
     storage.write(str(step), tracker_path(ckpt_dir))
     chaos.inject("ckpt.crash_after_commit", step=step)
     logger.info("checkpoint step %d committed at %s", step, ckpt_dir)
-    steps = []
-    for name in storage.listdir(ckpt_dir):
-        if name.startswith("step_"):
-            try:
-                steps.append(int(name[len("step_"):]))
-            except ValueError:
-                pass
+    # Rotation only counts live steps: quarantined dirs are operator
+    # evidence, neither GC'd here nor taking a keep_last slot.
+    steps = list_steps(storage, ckpt_dir)
     for old in sorted(steps)[:-keep_last] if keep_last > 0 else []:
         if old != step:
             storage.safe_rmtree(step_dir(ckpt_dir, old))
 
 
+def is_step_quarantined(
+    storage: CheckpointStorage, ckpt_dir: str, step: int
+) -> bool:
+    """Marker-file quarantine check (backends without directory rename)."""
+    return storage.exists(
+        os.path.join(step_dir(ckpt_dir, step), QUARANTINE_MARKER)
+    )
+
+
+def quarantine_step(
+    storage: CheckpointStorage, ckpt_dir: str, step: int
+) -> Optional[str]:
+    """Exclude a verification-failed step from every restore path.
+
+    Renames ``step_N`` -> ``step_N.corrupt`` (atomic on POSIX); backends
+    without directory rename get a ``.quarantined`` marker file instead.
+    Both forms are invisible to :func:`list_steps` and rotation but kept
+    on disk as operator evidence for ``checkpoint.fsck``.  Returns the
+    quarantined path, or ``None`` when the dir was already gone (e.g. a
+    concurrent rank won the rename race)."""
+    src = step_dir(ckpt_dir, step)
+    if not storage.exists(src):
+        return None
+    dst = src + QUARANTINE_SUFFIX
+    if storage.rename_dir(src, dst):
+        logger.warning("checkpoint step %d quarantined -> %s", step, dst)
+        return dst
+    try:
+        storage.write(
+            str(time.time()), os.path.join(src, QUARANTINE_MARKER)
+        )
+    except Exception as e:  # noqa: BLE001 - dir raced away mid-quarantine
+        logger.warning("quarantine of step %d failed: %s", step, e)
+        return None
+    logger.warning(
+        "checkpoint step %d quarantined in place (marker file)", step
+    )
+    return src
+
+
 def list_steps(storage: CheckpointStorage, ckpt_dir: str) -> list:
-    """All step numbers with a step dir present (committed or not)."""
+    """All step numbers with a live step dir present (committed or not);
+    quarantined dirs (renamed or marker) are excluded."""
     steps = []
     for name in storage.listdir(ckpt_dir):
-        if name.startswith("step_"):
-            try:
-                steps.append(int(name[len("step_"):]))
-            except ValueError:
-                pass
+        if not name.startswith("step_") or name.endswith(QUARANTINE_SUFFIX):
+            continue
+        try:
+            step = int(name[len("step_"):])
+        except ValueError:
+            continue
+        if is_step_quarantined(storage, ckpt_dir, step):
+            continue
+        steps.append(step)
     return steps
+
+
+def list_quarantined(storage: CheckpointStorage, ckpt_dir: str) -> list:
+    """(step, dirpath) per quarantined step dir, either form."""
+    out = []
+    for name in storage.listdir(ckpt_dir):
+        if not name.startswith("step_"):
+            continue
+        if name.endswith(QUARANTINE_SUFFIX):
+            try:
+                step = int(
+                    name[len("step_") : -len(QUARANTINE_SUFFIX)]
+                )
+            except ValueError:
+                continue
+            out.append((step, os.path.join(ckpt_dir, name)))
+        else:
+            try:
+                step = int(name[len("step_"):])
+            except ValueError:
+                continue
+            if is_step_quarantined(storage, ckpt_dir, step):
+                out.append((step, os.path.join(ckpt_dir, name)))
+    return sorted(out)
 
 
 def latest_step(storage: CheckpointStorage, ckpt_dir: str) -> Optional[int]:

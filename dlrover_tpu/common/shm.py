@@ -166,7 +166,9 @@ class _PySegment:
     def crc32(self, offset: int, n: int) -> int:
         import zlib
 
-        return zlib.crc32(self.buf[offset : offset + n].tobytes()) & 0xFFFFFFFF
+        # zlib hashes the mapped bytes through the buffer protocol —
+        # no tobytes() copy of the whole region just to checksum it.
+        return zlib.crc32(self.buf[offset : offset + n]) & 0xFFFFFFFF
 
     def close(self, unlink: bool = False) -> None:
         try:

@@ -60,6 +60,12 @@ class CheckpointStorage(abc.ABC):
     @abc.abstractmethod
     def listdir(self, path: str) -> list[str]: ...
 
+    def rename_dir(self, src: str, dst: str) -> bool:
+        """Atomically rename a directory (used by checkpoint quarantine).
+        Backends that cannot (object stores: a prefix rename is a full
+        copy) return ``False`` and callers fall back to a marker file."""
+        return False
+
 
 class PosixDiskStorage(CheckpointStorage):
     """Local/NFS POSIX filesystem backend (reference ``storage.py:128``)."""
@@ -90,6 +96,16 @@ class PosixDiskStorage(CheckpointStorage):
 
     def safe_makedirs(self, dirpath: str) -> None:
         os.makedirs(dirpath, exist_ok=True)
+
+    def rename_dir(self, src: str, dst: str) -> bool:
+        try:
+            os.replace(src, dst)
+            return True
+        except OSError:
+            # A concurrent rank may have won the rename race, or dst may
+            # be an earlier non-empty quarantine dir; callers fall back
+            # to the marker file.
+            return False
 
     def commit(self, step: int, success: bool) -> None:
         pass

@@ -21,6 +21,9 @@ class DiagnosisDataType:
     OP_METRICS = "op_metrics"  # per-op timings (utils.op_metrics JSON)
     NODE_RESOURCE = "node_resource"
     FAILURE = "failure"
+    # Checkpoint corruption / quarantine / replica-rejection events
+    # (checkpoint.engine/_replica integrity checks, ISSUE 3).
+    CKPT_INTEGRITY = "ckpt_integrity"
 
 
 @dataclasses.dataclass

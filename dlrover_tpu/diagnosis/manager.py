@@ -73,6 +73,8 @@ class DiagnosisManager:
         # same stale failure record on every diagnosis pass.
         self._delivered: Dict[tuple, float] = {}
         self._redeliver_cooldown_s = self.data_manager._ttl
+        # Newest ckpt-integrity record already echoed to the master log.
+        self._integrity_seen_ts = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -168,7 +170,24 @@ class DiagnosisManager:
             except Exception:  # noqa: BLE001
                 logger.exception("diagnosis pass failed")
 
+    def _surface_integrity_reports(self) -> None:
+        """Echo new checkpoint-integrity events (corruption detected, step
+        quarantined, replica rejected) into the master log.  They are rare
+        and serious — silent bit-rot must be an operator signal — but
+        observational: the agent-side restore ladder already routed around
+        the damage, so no destructive action is queued here."""
+        recs = self.data_manager.get_data(DiagnosisDataType.CKPT_INTEGRITY)
+        fresh = [r for r in recs if r.timestamp > self._integrity_seen_ts]
+        if not fresh:
+            return
+        self._integrity_seen_ts = max(r.timestamp for r in fresh)
+        for rec in fresh:
+            logger.warning(
+                "ckpt integrity (node %d): %s", rec.node_id, rec.content
+            )
+
     def diagnose_once(self) -> Dict[int, List[m.DiagnosisAction]]:
+        self._surface_integrity_reports()
         hypotheses = [
             Inference(InferenceName.TRAINING_HANG),
             Inference(InferenceName.NODE_FAILURE),

@@ -288,3 +288,78 @@ class TestCrashMidCommit:
             assert step >= committed
         finally:
             _terminate([proc2])
+
+
+class TestCorruptCommittedShard:
+    def test_restore_falls_back_and_fsck_flags(self, tmp_path):
+        """Scenario 4 (ISSUE 3 flagship): chaos corrupts the committed
+        step's shard bytes as the agent persists them — the done file and
+        tracker advance normally, exactly silent bit-rot.  A cold
+        relaunch (new run id, no warm shm) must detect the damage,
+        quarantine the step dir as ``step_N.corrupt``, and restore the
+        previous committed step; ``checkpoint.fsck`` must exit nonzero
+        naming the corrupt shard."""
+        job = "chaos-corrupt"
+        ckpt = str(tmp_path / "ckpt")
+        proc, log = _launch_standalone(
+            tmp_path, job,
+            ["--steps=8", f"--ckpt_dir={ckpt}", "--ckpt_interval=3",
+             "--ckpt_storage_interval=3", "--batch_per_proc=2"],
+            env_extra={
+                "DLROVER_TPU_FAULTS": "storage.corrupt_shard:step=8",
+                "DLROVER_TPU_RUN_ID": "corrupt1",
+            },
+            log_name="run1.log",
+        )
+        try:
+            rc = proc.wait(timeout=420)
+        finally:
+            _terminate([proc])
+        content = _read(log)
+        assert rc == 0, content[-3000:]
+        assert "chaos: storage.corrupt_shard fired" in content, (
+            content[-3000:]
+        )
+        # The commit protocol proceeded: the tracker names the damaged
+        # final step (the trainer's end-of-run durable save) — integrity
+        # is restore-side verification's job.
+        tracker = os.path.join(ckpt, "latest_checkpointed_step.txt")
+        assert int(_read(tracker).strip()) == 8
+
+        # fsck flags the damage, naming the corrupt shard.
+        fsck = subprocess.run(
+            [sys.executable, "-m", "dlrover_tpu.checkpoint.fsck", ckpt],
+            cwd=REPO, env=_env(), capture_output=True, text=True,
+            timeout=120,
+        )
+        assert fsck.returncode == 1, fsck.stdout + fsck.stderr
+        assert "shard_00000.ckpt" in fsck.stdout, fsck.stdout
+
+        # Cold relaunch (different run id -> fresh shm arena): the ladder
+        # must skip the corrupt committed step 8 and restore step 6.
+        proc2, log2 = _launch_standalone(
+            tmp_path, job,
+            ["--steps=8", f"--ckpt_dir={ckpt}", "--ckpt_interval=3",
+             "--batch_per_proc=2"],
+            env_extra={"DLROVER_TPU_RUN_ID": "corrupt2"},
+            log_name="run2.log",
+        )
+        try:
+            rc2 = proc2.wait(timeout=420)
+        finally:
+            _terminate([proc2])
+        c2 = _read(log2)
+        assert rc2 == 0, c2[-3000:]
+        assert "restored step=6" in c2, c2[-3000:]
+        assert "corrupt checkpoint shard (step 8" in c2, c2[-3000:]
+        assert os.path.isdir(
+            os.path.join(ckpt, "step_0000000008.corrupt")
+        ), sorted(os.listdir(ckpt))
+        # The quarantined dir still holds the evidence for fsck.
+        fsck2 = subprocess.run(
+            [sys.executable, "-m", "dlrover_tpu.checkpoint.fsck", ckpt],
+            cwd=REPO, env=_env(), capture_output=True, text=True,
+            timeout=120,
+        )
+        assert fsck2.returncode == 1
+        assert "quarantined" in fsck2.stdout.lower()

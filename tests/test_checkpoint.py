@@ -138,6 +138,22 @@ class TestShardFile:
         finally:
             eng.close()
 
+    def test_truncated_shard_raises_typed_error(self, tmp_path):
+        """Regression (ISSUE 3): a truncated on-disk shard used to
+        surface as raw struct.error/ValueError from unpack; every damage
+        mode is now one typed ShardCorruptionError."""
+        storage = PosixDiskStorage()
+        d = str(tmp_path)
+        shard_file.write_shard(storage, d, 10, 0, {"x|0": np.ones(3)}, {})
+        path = shard_file.shard_path(d, 10, 0)
+        with open(path, "rb") as f:
+            raw = f.read()
+        for cut in (0, 7, 18, len(raw) - 2):
+            with open(path, "wb") as f:
+                f.write(raw[:cut])
+            with pytest.raises(shard_file.ShardCorruptionError):
+                shard_file.read_shard(storage, d, 10, 0)
+
     def test_pack_unpack_zero_d(self):
         # Regression: np.ascontiguousarray promotes 0-d to (1,); a restored
         # scalar (e.g. optimizer step count) must stay 0-d or
@@ -190,6 +206,12 @@ class TestEngineStandalone:
         assert ckpt.wait(timeout=60)
         assert shard_file.latest_step(PosixDiskStorage(), str(tmp_path)) == 6
         ckpt.close()
+        # What the engine writes is fsck-clean (CRCs, done votes,
+        # tracker, coverage).
+        from dlrover_tpu.checkpoint import fsck
+
+        report = fsck.fsck(str(tmp_path))
+        assert not report.damaged, report.findings
 
     def test_cold_restore_from_storage(self, tmp_path, monkeypatch):
         """Simulates full host restart: shm gone, restore reads shard files."""

@@ -1,0 +1,767 @@
+"""Checkpoint integrity: checksummed v2 shard format, the corruption-
+tolerant restore ladder (skip -> fall through -> quarantine), replica
+payload verification, the fsck CLI, the data-corruption chaos sites, and
+the integrity counters/diagnosis surfacing (ISSUE 3).
+
+Everything here is deterministic and sub-second (tier-1)."""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+
+import msgpack
+import numpy as np
+import pytest
+
+from dlrover_tpu import chaos
+from dlrover_tpu.agent.metrics import (
+    INTEGRITY_COUNTER_NAMES,
+    CounterSet,
+    MetricsRegistry,
+    integrity_counters,
+)
+from dlrover_tpu.checkpoint import fsck, shard_file
+from dlrover_tpu.checkpoint import replica as replica_mod
+from dlrover_tpu.common.storage import PosixDiskStorage
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _tensors():
+    return {
+        "a|0": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b|0": np.array([True, False]),
+    }
+
+
+def _pack_v1(tensors, extra):
+    """Byte-for-byte the pre-ISSUE-3 v1 format (magic DLRTPUF1, no CRCs)."""
+    metas, blobs, off = {}, [], 0
+    for k, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        metas[k] = {
+            "dtype": arr.dtype.name,
+            "shape": list(np.shape(arr)),
+            "offset": off,
+            "nbytes": int(arr.nbytes),
+        }
+        blobs.append(arr.reshape(-1).view(np.uint8).tobytes())
+        off += arr.nbytes
+    meta_blob = msgpack.packb(
+        {"tensors": metas, "extra": extra}, use_bin_type=True
+    )
+    return (
+        b"DLRTPUF1"
+        + struct.pack("<Q", len(meta_blob))
+        + meta_blob
+        + b"".join(blobs)
+    )
+
+
+_INFO = {
+    "['w']|0": {"path": "['w']", "global_shape": [4], "index": [[0, 4]]}
+}
+
+
+def _extra(step, world=1, pid=0):
+    return {
+        "step": step,
+        "meta": {"step": step},
+        "tensors_info": _INFO,
+        "num_processes": world,
+        "process_id": pid,
+    }
+
+
+def _write_step(d, step, val, commit=False, keep_last=3):
+    st = PosixDiskStorage()
+    shard_file.write_shard(
+        st, d, step, 0,
+        {"['w']|0": np.full(4, val, np.float32)}, _extra(step),
+    )
+    if commit:
+        shard_file.commit(st, d, step, keep_last=keep_last)
+
+
+def _damage_file(path, pos=-2):
+    with open(path, "rb") as f:
+        raw = bytearray(f.read())
+    raw[pos] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+
+
+def _engine(tmp_path, monkeypatch, job):
+    monkeypatch.setenv("DLROVER_TPU_JOB_NAME", job)
+    monkeypatch.setenv("DLROVER_TPU_RUN_ID", f"run-{job}")
+    monkeypatch.setenv("DLROVER_TPU_PROCESS_ID", "0")
+    monkeypatch.setenv("DLROVER_TPU_NUM_PROCESSES", "1")
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+
+    return CheckpointEngine(str(tmp_path), job_name=job)
+
+
+class TestShardFormatV2:
+    def test_roundtrip_carries_crcs(self):
+        blob = shard_file.pack_shard(_tensors(), {"step": 3})
+        assert shard_file.shard_version(blob) == 2
+        out, extra = shard_file.unpack_shard(blob)
+        assert extra["step"] == 3
+        np.testing.assert_array_equal(out["a|0"], _tensors()["a|0"])
+        # The meta really holds per-tensor CRCs.
+        meta, _, version = shard_file._parse_meta(blob)
+        assert version == 2
+        assert all(
+            isinstance(tm["crc32"], int) for tm in meta["tensors"].values()
+        )
+        assert shard_file.verify_shard(blob) == {"step": 3}
+
+    def test_tensor_bitflip_detected(self):
+        blob = bytearray(shard_file.pack_shard(_tensors(), {}))
+        blob[-3] ^= 0x01  # inside the last tensor's data
+        with pytest.raises(shard_file.ShardCorruptionError, match="CRC"):
+            shard_file.unpack_shard(bytes(blob))
+        with pytest.raises(shard_file.ShardCorruptionError):
+            shard_file.verify_shard(bytes(blob))
+
+    def test_meta_bitflip_detected(self):
+        blob = bytearray(shard_file.pack_shard(_tensors(), {"step": 1}))
+        blob[shard_file._V2_HEADER + 2] ^= 0x01
+        with pytest.raises(
+            shard_file.ShardCorruptionError, match="meta CRC"
+        ):
+            shard_file.unpack_shard(bytes(blob))
+
+    @pytest.mark.parametrize("cut", [0, 5, 12, 17])
+    def test_short_file_typed_error(self, cut):
+        """Files shorter than the header must raise the typed error, not
+        raw struct.error (satellite: unpack edge cases)."""
+        blob = shard_file.pack_shard(_tensors(), {})
+        with pytest.raises(shard_file.ShardCorruptionError):
+            shard_file.unpack_shard(blob[:cut])
+
+    def test_meta_past_eof_and_truncated_blob(self):
+        blob = shard_file.pack_shard(_tensors(), {})
+        with pytest.raises(
+            shard_file.ShardCorruptionError, match="past EOF"
+        ):
+            shard_file.unpack_shard(blob[: shard_file._V2_HEADER + 4])
+        with pytest.raises(
+            shard_file.ShardCorruptionError, match="truncated|out of bounds"
+        ):
+            shard_file.unpack_shard(blob[:-4])
+
+    def test_garbage_bytes_typed_error(self):
+        for junk in (b"", b"x", b"hello world, definitely not a shard"):
+            with pytest.raises(shard_file.ShardCorruptionError):
+                shard_file.unpack_shard(junk)
+
+    def test_v1_shard_still_readable(self):
+        v1 = _pack_v1(_tensors(), {"step": 9})
+        assert shard_file.shard_version(v1) == 1
+        out, extra = shard_file.unpack_shard(v1)
+        assert extra["step"] == 9
+        np.testing.assert_array_equal(out["a|0"], _tensors()["a|0"])
+        # verify_shard passes structurally (no CRCs to check on v1).
+        assert shard_file.verify_shard(v1)["step"] == 9
+
+    def test_v1_truncation_typed_error(self):
+        v1 = _pack_v1(_tensors(), {})
+        for cut in (3, 12, 20, len(v1) - 4):
+            with pytest.raises(shard_file.ShardCorruptionError):
+                shard_file.unpack_shard(v1[:cut])
+
+    def test_zero_d_and_empty_extra_roundtrip(self):
+        t = {"count|0": np.asarray(np.int32(7))}
+        out, _ = shard_file.unpack_shard(shard_file.pack_shard(t, {}))
+        assert out["count|0"].shape == ()
+        assert out["count|0"] == 7
+
+    def test_crc32_bytes_matches_zlib(self):
+        import zlib
+
+        data = os.urandom(4096)
+        assert shard_file.crc32_bytes(data) == zlib.crc32(data) & 0xFFFFFFFF
+
+    def test_native_crc_matches_zlib_when_available(self):
+        from dlrover_tpu.common.native import shm_lib
+        import zlib
+
+        lib = shm_lib()
+        if lib is None:
+            pytest.skip("native toolchain unavailable")
+        data = os.urandom(1 << 10)
+        arr = np.frombuffer(data, dtype=np.uint8)
+        assert int(lib.shm_crc32(arr.ctypes.data, arr.nbytes, 0)) == (
+            zlib.crc32(data) & 0xFFFFFFFF
+        )
+
+
+class TestValidateStagedState:
+    def test_accepts_coherent_state(self):
+        assert shard_file.validate_staged_state(
+            {"w|0": np.ones(2)}, _extra(5),
+            expect_process_id=0, expect_num_processes=1,
+        ) is None
+
+    def test_rejects_bad_states(self):
+        good = _extra(5)
+        assert "no tensors" in shard_file.validate_staged_state({}, good)
+        assert "not an int" in shard_file.validate_staged_state(
+            {"w|0": np.ones(2)}, {**good, "step": "six"}
+        )
+        assert "negative" in shard_file.validate_staged_state(
+            {"w|0": np.ones(2)}, {**good, "step": -1}
+        )
+        assert "tensors_info" in shard_file.validate_staged_state(
+            {"w|0": np.ones(2)}, {**good, "tensors_info": {}}
+        )
+        assert "process_id" in shard_file.validate_staged_state(
+            {"w|0": np.ones(2)}, good, expect_process_id=3
+        )
+        assert "num_processes" in shard_file.validate_staged_state(
+            {"w|0": np.ones(2)}, good, expect_num_processes=8
+        )
+
+
+class TestQuarantine:
+    def test_rename_and_exclusion(self, tmp_path):
+        st = PosixDiskStorage()
+        d = str(tmp_path)
+        _write_step(d, 5, 1.0, commit=True)
+        _write_step(d, 6, 2.0)
+        assert sorted(shard_file.list_steps(st, d)) == [5, 6]
+        where = shard_file.quarantine_step(st, d, 6)
+        assert where.endswith("step_0000000006.corrupt")
+        assert os.path.isdir(where)
+        assert shard_file.list_steps(st, d) == [5]
+        assert shard_file.list_quarantined(st, d) == [(6, where)]
+        # Idempotent-ish: the dir is gone, a second call is a no-op.
+        assert shard_file.quarantine_step(st, d, 6) is None
+
+    def test_marker_fallback_without_rename(self, tmp_path):
+        class NoRename(PosixDiskStorage):
+            def rename_dir(self, src, dst):
+                return False
+
+        st = NoRename()
+        d = str(tmp_path)
+        _write_step(d, 7, 1.0)
+        where = shard_file.quarantine_step(st, d, 7)
+        assert where == shard_file.step_dir(d, 7)
+        assert shard_file.is_step_quarantined(st, d, 7)
+        assert shard_file.list_steps(st, d) == []
+        assert shard_file.list_quarantined(st, d) == [(7, where)]
+
+    def test_rotation_skips_quarantined(self, tmp_path):
+        st = PosixDiskStorage()
+        d = str(tmp_path)
+        for step in (1, 2, 3):
+            _write_step(d, step, float(step), commit=True, keep_last=0)
+        shard_file.quarantine_step(st, d, 1)
+        # keep_last=1 GC: only live steps are counted and removed; the
+        # quarantined dir is untouched evidence.
+        _write_step(d, 4, 4.0, commit=True, keep_last=1)
+        assert shard_file.list_steps(st, d) == [4]
+        assert [s for s, _ in shard_file.list_quarantined(st, d)] == [1]
+
+
+class TestRestoreLadder:
+    def test_corrupt_newest_falls_back_and_quarantines(
+        self, tmp_path, monkeypatch
+    ):
+        d = str(tmp_path)
+        _write_step(d, 10, 1.0, commit=True)
+        _write_step(d, 20, 2.0, commit=True)  # tracker -> 20
+        _damage_file(shard_file.shard_path(d, 20, 0))
+        before = integrity_counters.snapshot()
+        eng = _engine(tmp_path, monkeypatch, "ladder-corrupt")
+        try:
+            state, meta = eng.load(target={"w": np.zeros(4, np.float32)})
+            assert meta["step"] == 10
+            np.testing.assert_array_equal(state["w"], np.full(4, 1.0))
+        finally:
+            eng.close()
+        assert os.path.isdir(os.path.join(d, "step_0000000020.corrupt"))
+        after = integrity_counters.snapshot()
+        assert after.get("ckpt_corruption_detected", 0) > before.get(
+            "ckpt_corruption_detected", 0
+        )
+        assert after.get("ckpt_step_quarantined", 0) > before.get(
+            "ckpt_step_quarantined", 0
+        )
+
+    def test_hand_truncated_shard_regression(self, tmp_path, monkeypatch):
+        """Satellite: load() used to catch only KeyError — a truncated
+        shard raised struct.error/ValueError and crashed the restore."""
+        d = str(tmp_path)
+        _write_step(d, 10, 1.0, commit=True)
+        _write_step(d, 20, 2.0, commit=True)
+        path = shard_file.shard_path(d, 20, 0)
+        with open(path, "rb") as f:
+            raw = f.read()
+        with open(path, "wb") as f:
+            f.write(raw[:10])  # shorter than the header
+        eng = _engine(tmp_path, monkeypatch, "ladder-trunc")
+        try:
+            got = eng.load(target={"w": np.zeros(4, np.float32)})
+            assert got is not None
+            assert got[1]["step"] == 10
+        finally:
+            eng.close()
+
+    def test_garbage_shard_regression(self, tmp_path, monkeypatch):
+        d = str(tmp_path)
+        _write_step(d, 10, 1.0, commit=True)
+        _write_step(d, 20, 2.0, commit=True)
+        with open(shard_file.shard_path(d, 20, 0), "wb") as f:
+            f.write(b"\x00" * 64)
+        eng = _engine(tmp_path, monkeypatch, "ladder-garbage")
+        try:
+            assert eng.load(
+                target={"w": np.zeros(4, np.float32)}
+            )[1]["step"] == 10
+        finally:
+            eng.close()
+
+    def test_tracker_pointing_at_gcd_step(self, tmp_path, monkeypatch):
+        """Satellite: tracker names a step whose dir was GC'd/lost."""
+        d = str(tmp_path)
+        _write_step(d, 10, 1.0, commit=True)
+        PosixDiskStorage().write("99", shard_file.tracker_path(d))
+        eng = _engine(tmp_path, monkeypatch, "ladder-gcd")
+        try:
+            assert eng.load(
+                target={"w": np.zeros(4, np.float32)}
+            )[1]["step"] == 10
+        finally:
+            eng.close()
+
+    def test_garbage_tracker_content(self, tmp_path, monkeypatch):
+        d = str(tmp_path)
+        _write_step(d, 10, 1.0, commit=True)
+        PosixDiskStorage().write(
+            "definitely-not-a-step", shard_file.tracker_path(d)
+        )
+        eng = _engine(tmp_path, monkeypatch, "ladder-badtrk")
+        try:
+            assert eng.load(
+                target={"w": np.zeros(4, np.float32)}
+            )[1]["step"] == 10
+        finally:
+            eng.close()
+
+    def test_done_file_without_shard(self, tmp_path, monkeypatch):
+        """Satellite: a done vote whose shard file is missing must fall
+        through cleanly to an older candidate."""
+        d = str(tmp_path)
+        st = PosixDiskStorage()
+        _write_step(d, 10, 1.0, commit=True)
+        st.safe_makedirs(shard_file.step_dir(d, 30))
+        st.write("123.0", shard_file.done_path(d, 30, 0))
+        eng = _engine(tmp_path, monkeypatch, "ladder-doneonly")
+        try:
+            assert eng.load(
+                target={"w": np.zeros(4, np.float32)}
+            )[1]["step"] == 10
+        finally:
+            eng.close()
+
+    def test_v1_shards_restore_unchanged(self, tmp_path, monkeypatch):
+        """Acceptance: pre-existing v1 shards (no CRCs) still restore."""
+        d = str(tmp_path)
+        st = PosixDiskStorage()
+        st.safe_makedirs(shard_file.step_dir(d, 12))
+        st.write(
+            _pack_v1(
+                {"['w']|0": np.full(4, 7.0, np.float32)}, _extra(12)
+            ),
+            shard_file.shard_path(d, 12, 0),
+        )
+        st.write("1.0", shard_file.done_path(d, 12, 0))
+        st.write("12", shard_file.tracker_path(d))
+        eng = _engine(tmp_path, monkeypatch, "ladder-v1")
+        try:
+            state, meta = eng.load(target={"w": np.zeros(4, np.float32)})
+            assert meta["step"] == 12
+            np.testing.assert_array_equal(state["w"], np.full(4, 7.0))
+        finally:
+            eng.close()
+
+    @pytest.mark.chaos
+    def test_chaos_corrupt_committed_step_acceptance(
+        self, tmp_path, monkeypatch
+    ):
+        """Acceptance criterion, tier-1 half: storage.corrupt_shard on the
+        committed step -> load() restores the previous committed step, the
+        damaged dir is quarantined as step_N.corrupt, and fsck exits
+        nonzero naming the corrupt shard."""
+        d = str(tmp_path)
+        _write_step(d, 5, 1.0, commit=True)
+        chaos.configure("storage.corrupt_shard:step=6")
+        _write_step(d, 6, 2.0, commit=True)  # done+tracker land; bytes rot
+        chaos.reset()
+        report = fsck.fsck(d)
+        assert report.damaged
+        assert any(
+            "shard_00000.ckpt" in f.path and f.severity == fsck.SEV_DAMAGE
+            for f in report.findings
+        )
+        eng = _engine(tmp_path, monkeypatch, "ladder-chaos")
+        try:
+            state, meta = eng.load(target={"w": np.zeros(4, np.float32)})
+            assert meta["step"] == 5
+            np.testing.assert_array_equal(state["w"], np.full(4, 1.0))
+        finally:
+            eng.close()
+        assert os.path.isdir(os.path.join(d, "step_0000000006.corrupt"))
+
+    def test_marker_quarantined_committed_step_not_recandidated(
+        self, tmp_path, monkeypatch
+    ):
+        """On backends without rename_dir the quarantine is a marker file
+        and the tracker still names the damaged step — it must not
+        re-enter the candidate list (and re-count corruption) on every
+        subsequent load."""
+
+        class NoRename(PosixDiskStorage):
+            def rename_dir(self, src, dst):
+                return False
+
+        d = str(tmp_path)
+        _write_step(d, 10, 1.0, commit=True)
+        _write_step(d, 20, 2.0, commit=True)  # tracker -> 20
+        _damage_file(shard_file.shard_path(d, 20, 0))
+        monkeypatch.setenv("DLROVER_TPU_JOB_NAME", "ladder-marker")
+        monkeypatch.setenv("DLROVER_TPU_RUN_ID", "mk1")
+        monkeypatch.setenv("DLROVER_TPU_PROCESS_ID", "0")
+        monkeypatch.setenv("DLROVER_TPU_NUM_PROCESSES", "1")
+        from dlrover_tpu.checkpoint.engine import CheckpointEngine
+
+        eng = CheckpointEngine(
+            d, job_name="ladder-marker", storage=NoRename()
+        )
+        try:
+            assert eng.load(
+                target={"w": np.zeros(4, np.float32)}
+            )[1]["step"] == 10
+            assert shard_file.is_step_quarantined(NoRename(), d, 20)
+            # Second load: the marker-quarantined committed step is
+            # excluded up front — no re-detection, no re-quarantine.
+            before = integrity_counters.snapshot()
+            assert eng.load(
+                target={"w": np.zeros(4, np.float32)}
+            )[1]["step"] == 10
+            after = integrity_counters.snapshot()
+            assert after.get("ckpt_corruption_detected", 0) == before.get(
+                "ckpt_corruption_detected", 0
+            )
+            assert after.get("ckpt_step_quarantined", 0) == before.get(
+                "ckpt_step_quarantined", 0
+            )
+        finally:
+            eng.close()
+
+    def test_quarantine_reported_to_master(self, tmp_path, monkeypatch):
+        """Quarantine events ride the existing diagnosis report path."""
+        d = str(tmp_path)
+        _write_step(d, 10, 1.0, commit=True)
+        _write_step(d, 20, 2.0, commit=True)
+        _damage_file(shard_file.shard_path(d, 20, 0))
+
+        reports = []
+
+        class _Client:
+            def report_diagnosis_data(self, data_type, content):
+                reports.append((data_type, json.loads(content)))
+
+        monkeypatch.setenv("DLROVER_TPU_JOB_NAME", "ladder-report")
+        monkeypatch.setenv("DLROVER_TPU_RUN_ID", "rep1")
+        monkeypatch.setenv("DLROVER_TPU_PROCESS_ID", "0")
+        monkeypatch.setenv("DLROVER_TPU_NUM_PROCESSES", "1")
+        from dlrover_tpu.checkpoint.engine import CheckpointEngine
+
+        eng = CheckpointEngine(
+            str(tmp_path), job_name="ladder-report", master_client=_Client()
+        )
+        try:
+            assert eng.load(
+                target={"w": np.zeros(4, np.float32)}
+            )[1]["step"] == 10
+        finally:
+            eng.close()
+        events = [c["event"] for t, c in reports if t == "ckpt_integrity"]
+        assert "corruption_detected" in events
+        assert "step_quarantined" in events
+
+
+class TestReplicaIntegrity:
+    def _payload(self, step=9, pid=0, world=2):
+        return shard_file.pack_shard(
+            {"w|0": np.ones(4, np.float32)},
+            {
+                "step": step,
+                "process_id": pid,
+                "num_processes": world,
+                "tensors_info": {
+                    "w|0": {
+                        "path": "w", "global_shape": [4], "index": [[0, 4]]
+                    }
+                },
+            },
+        )
+
+    def test_servicer_rejects_corrupt_push(self):
+        from dlrover_tpu.common import messages as m
+
+        store = replica_mod.ReplicaStore()
+        servicer = replica_mod.ReplicaServicer(store)
+        before = integrity_counters.get("ckpt_replica_rejected")
+        resp = servicer(
+            m.ReplicaPush(
+                owner_node=0, process_id=0, step=9,
+                payload=self._payload()[:50],
+            )
+        )
+        assert not resp.success
+        assert "corrupt" in resp.reason
+        assert store.get(0) is None
+        assert integrity_counters.get("ckpt_replica_rejected") == before + 1
+        # A verified push is accepted.
+        resp2 = servicer(
+            m.ReplicaPush(
+                owner_node=0, process_id=0, step=9, payload=self._payload()
+            )
+        )
+        assert resp2.success
+        assert store.get(0)[0] == 9
+
+    def test_servicer_rejects_layout_mismatch(self):
+        from dlrover_tpu.common import messages as m
+
+        servicer = replica_mod.ReplicaServicer(replica_mod.ReplicaStore())
+        resp = servicer(
+            m.ReplicaPush(
+                owner_node=0, process_id=0, step=11,
+                payload=self._payload(step=9),
+            )
+        )
+        assert not resp.success and "step mismatch" in resp.reason
+        resp = servicer(
+            m.ReplicaPush(
+                owner_node=0, process_id=1, step=9,
+                payload=self._payload(pid=0),
+            )
+        )
+        assert not resp.success and "process mismatch" in resp.reason
+
+    def test_torn_push_chaos_site(self):
+        chaos.configure("replica.torn_push:step=9")
+        payload = self._payload()
+        torn = replica_mod._chaos_torn_push(payload, 9, 0)
+        assert len(torn) < len(payload)
+        assert replica_mod.check_replica_payload(torn, 0, 9) is not None
+        # One-shot by default: the next push goes through intact.
+        again = replica_mod._chaos_torn_push(payload, 9, 0)
+        assert again == payload
+
+    def test_check_replica_payload_good(self):
+        assert replica_mod.check_replica_payload(
+            self._payload(), 0, 9
+        ) is None
+
+
+class TestFsck:
+    def _committed_dir(self, tmp_path):
+        d = str(tmp_path)
+        _write_step(d, 5, 1.0, commit=True)
+        _write_step(d, 6, 2.0, commit=True)
+        return d
+
+    def test_clean(self, tmp_path):
+        report = fsck.fsck(self._committed_dir(tmp_path))
+        assert not report.damaged
+        assert report.committed_step == 6
+        assert report.steps_checked == 2 and report.shards_checked == 2
+
+    def test_corrupt_shard_named(self, tmp_path):
+        d = self._committed_dir(tmp_path)
+        _damage_file(shard_file.shard_path(d, 6, 0))
+        report = fsck.fsck(d)
+        assert report.damaged
+        assert any(
+            "shard_00000.ckpt" in f.path and "corrupt" in f.reason
+            for f in report.findings
+        )
+
+    def test_done_without_shard_and_dangling_tracker(self, tmp_path):
+        st = PosixDiskStorage()
+        d = str(tmp_path)
+        _write_step(d, 5, 1.0, commit=True)
+        os.remove(shard_file.shard_path(d, 5, 0))  # done vote orphaned
+        report = fsck.fsck(d)
+        assert report.damaged
+        reasons = " | ".join(f.reason for f in report.findings)
+        assert "done vote" in reasons
+        # Dangling tracker:
+        st.write("77", shard_file.tracker_path(d))
+        report2 = fsck.fsck(d)
+        assert any("no step dir" in f.reason for f in report2.findings)
+
+    def test_garbage_tracker(self, tmp_path):
+        d = str(tmp_path)
+        _write_step(d, 5, 1.0, commit=True)
+        PosixDiskStorage().write("garbage", shard_file.tracker_path(d))
+        report = fsck.fsck(d)
+        assert report.damaged
+        assert any("garbage" in f.reason for f in report.findings)
+
+    def test_quarantined_dir_reported_with_bad_shard(self, tmp_path):
+        d = self._committed_dir(tmp_path)
+        _damage_file(shard_file.shard_path(d, 6, 0))
+        shard_file.quarantine_step(PosixDiskStorage(), d, 6)
+        report = fsck.fsck(d)
+        assert report.damaged
+        assert report.quarantined_steps == [6]
+        reasons = " | ".join(f.reason for f in report.findings)
+        assert "QUARANTINED" in reasons  # tracker still names step 6
+        assert any(
+            "step_0000000006.corrupt" in f.path and "corrupt shard" in f.reason
+            for f in report.findings
+        )
+
+    def test_missing_committed_shard_coverage(self, tmp_path):
+        st = PosixDiskStorage()
+        d = str(tmp_path)
+        # Two-process world, but only proc 0's shard made it.
+        shard_file.write_shard(
+            st, d, 8, 0,
+            {"['w']|0": np.full(4, 1.0, np.float32)}, _extra(8, world=2),
+        )
+        shard_file.commit(st, d, 8)
+        report = fsck.fsck(d)
+        assert report.damaged
+        assert any("covers 1/2" in f.reason for f in report.findings)
+
+    def test_v1_shard_noted_not_damaged(self, tmp_path):
+        st = PosixDiskStorage()
+        d = str(tmp_path)
+        st.safe_makedirs(shard_file.step_dir(d, 3))
+        st.write(
+            _pack_v1({"['w']|0": np.ones(4, np.float32)}, _extra(3)),
+            shard_file.shard_path(d, 3, 0),
+        )
+        st.write("1.0", shard_file.done_path(d, 3, 0))
+        st.write("3", shard_file.tracker_path(d))
+        report = fsck.fsck(d)
+        assert not report.damaged
+        assert any("legacy v1" in f.reason for f in report.findings)
+
+    def test_module_entry_point(self, tmp_path):
+        """python -m dlrover_tpu.checkpoint.fsck: rc 0 clean, 1 damaged,
+        2 on a missing dir — and the import stays jax-free."""
+        d = self._committed_dir(tmp_path)
+        env = {**os.environ, "PYTHONPATH": REPO}
+        run = lambda *a: subprocess.run(  # noqa: E731
+            [sys.executable, "-m", "dlrover_tpu.checkpoint.fsck", *a],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=60,
+        )
+        clean = run(d, "--json")
+        assert clean.returncode == 0, clean.stderr
+        assert json.loads(clean.stdout)["damaged"] is False
+        _damage_file(shard_file.shard_path(d, 6, 0))
+        damaged = run(d)
+        assert damaged.returncode == 1
+        assert "shard_00000.ckpt" in damaged.stdout
+        assert run(str(tmp_path / "nope")).returncode == 2
+
+
+class TestChaosSites:
+    @pytest.mark.chaos
+    def test_new_sites_parse_and_are_one_shot(self):
+        from dlrover_tpu.chaos import FaultSpec
+
+        for site in (
+            "storage.corrupt_shard", "storage.truncate_shard",
+            "replica.torn_push",
+        ):
+            spec = FaultSpec.parse(site)
+            assert spec.kind == "flag" and spec.times == 1
+
+    @pytest.mark.chaos
+    def test_truncate_shard_site(self, tmp_path):
+        st = PosixDiskStorage()
+        d = str(tmp_path)
+        chaos.configure("storage.truncate_shard:step=7")
+        _write_step(d, 7, 1.0)
+        with pytest.raises(shard_file.ShardCorruptionError):
+            shard_file.read_shard(st, d, 7, 0)
+        # One-shot: the next write is intact.
+        _write_step(d, 8, 1.0)
+        assert shard_file.read_shard(st, d, 8, 0) is not None
+
+
+class TestCountersAndDiagnosis:
+    def test_counter_set(self):
+        c = CounterSet()
+        assert c.get("x") == 0
+        assert c.inc("x") == 1
+        assert c.inc("x", 2) == 3
+        assert c.snapshot() == {"x": 3}
+
+    def test_gauges_render(self):
+        reg = MetricsRegistry()
+        for name in INTEGRITY_COUNTER_NAMES:
+            reg.gauge(
+                name, lambda n=name: float(integrity_counters.get(n))
+            )
+        text = reg.render()
+        for name in INTEGRITY_COUNTER_NAMES:
+            assert f"dlrover_tpu_{name}" in text
+
+    def test_manager_surfaces_integrity_reports(self):
+        import logging
+
+        from dlrover_tpu.common import messages as m
+        from dlrover_tpu.common.log import logger as dl_logger
+        from dlrover_tpu.diagnosis.data import DiagnosisDataType
+        from dlrover_tpu.diagnosis.manager import DiagnosisManager
+
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = _Capture(level=logging.WARNING)
+        dl_logger.addHandler(handler)
+        try:
+            mgr = DiagnosisManager()
+            mgr.collect_data(
+                m.DiagnosisReport(
+                    node_id=2,
+                    data_type=DiagnosisDataType.CKPT_INTEGRITY,
+                    content=json.dumps(
+                        {"event": "step_quarantined", "step": 6}
+                    ),
+                    timestamp=time.time(),
+                )
+            )
+            mgr.diagnose_once()
+            assert any("ckpt integrity (node 2)" in msg for msg in records)
+            # Already-seen records are not echoed again.
+            records.clear()
+            mgr.diagnose_once()
+            assert not any("ckpt integrity" in msg for msg in records)
+        finally:
+            dl_logger.removeHandler(handler)

@@ -55,7 +55,18 @@ class TestReplicaRing:
                                 push_interval_s=0.0)
         try:
             tensors = {"w|0": np.arange(6, dtype=np.float32)}
-            extra = {"step": 7, "tensors_info": {}, "num_processes": 2}
+            # Push verification (ISSUE 3) rejects payloads that could
+            # never seed a restore: carry a real layout.
+            extra = {
+                "step": 7,
+                "process_id": 0,
+                "num_processes": 2,
+                "tensors_info": {
+                    "w|0": {
+                        "path": "w", "global_shape": [6], "index": [[0, 6]]
+                    }
+                },
+            }
             # Node 0 backs its proc 0 shard onto node 1 (ring successor).
             assert m0.backup_shard(0, 7, tensors, extra, force=True)
             assert m1.store.get(0)[0] == 7
@@ -77,10 +88,24 @@ class TestReplicaRing:
         m1 = CkptReplicaManager(kv, node_rank=1, world_size=2)
         try:
             t = {"w|0": np.zeros(1, np.float32)}
-            e = {"step": 1, "tensors_info": {}}
-            assert m0.backup_shard(0, 1, t, e)   # first push goes out
-            assert not m0.backup_shard(0, 2, t, e)  # throttled
-            assert m0.backup_shard(0, 3, t, e, force=True)
+
+            def e(step):
+                return {
+                    "step": step,
+                    "process_id": 0,
+                    "num_processes": 2,
+                    "tensors_info": {
+                        "w|0": {
+                            "path": "w",
+                            "global_shape": [1],
+                            "index": [[0, 1]],
+                        }
+                    },
+                }
+
+            assert m0.backup_shard(0, 1, t, e(1))   # first push goes out
+            assert not m0.backup_shard(0, 2, t, e(2))  # throttled
+            assert m0.backup_shard(0, 3, t, e(3), force=True)
         finally:
             m0.stop()
             m1.stop()
