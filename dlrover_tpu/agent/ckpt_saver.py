@@ -22,7 +22,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
 from dlrover_tpu import chaos
-from dlrover_tpu.agent.metrics import integrity_counters
+from dlrover_tpu.agent.metrics import integrity_counters, perf_stats
 from dlrover_tpu.checkpoint import shard_file
 from dlrover_tpu.checkpoint.engine import (
     ckpt_lock_name,
@@ -75,6 +75,7 @@ class AsyncCheckpointSaver:
             lr: threading.Lock() for lr in range(nproc_per_node)
         }
         self._persisted: Dict[int, int] = {}  # local_rank -> step
+        self._perf_cache: tuple = (0.0, {})  # (fetched_at, stat snapshot)
         self._last_event: Dict[int, dict] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -257,62 +258,145 @@ class AsyncCheckpointSaver:
         if lock is not None and not lock.acquire(timeout=60.0):
             logger.warning("saver: lock for rank %d busy; skipping", lr)
             return
+        # Zero-copy fast path: stream the arena's mapped bytes straight to
+        # storage, holding the fencing lock + arena mutex for the whole
+        # persist (the views' lifetime contract — see
+        # SharedMemoryArena.read_state).  A worker staging its next step
+        # waits on the lock for the persist duration, exactly like the
+        # reference saver; the bench measures that stall.  Copy mode —
+        # one full state copy under the lock, persist from the copy with
+        # the lock released (the old bounded stall) — is kept for every
+        # consumer that outlives the lock: the replica-ring push, and
+        # operators on slow storage who set ckpt_zero_copy=False.
+        copy_mode = self.replica is not None or not self._ctx.ckpt_zero_copy
+        tensors = extra = None
+        stats = None
         try:
             arena = self._arena(lr)
             with self._arena_mu(lr):
                 arena.reopen()
-                read = arena.read_state(copy=True)
+                read = arena.read_state(copy=copy_mode)
+                if read is None:
+                    logger.warning("saver: arena for rank %d empty", lr)
+                    return
+                tensors, extra = read
+                staged_step = int(extra.get("step", -1))
+                if staged_step != step:
+                    logger.info(
+                        "saver: arena holds step %d (event wanted %d) — "
+                        "persisting the staged one", staged_step, step,
+                    )
+                    step = staged_step
+                # The arena's CRC covers the meta blob only; validate the
+                # staged state's own layout metadata before it becomes a
+                # durable shard — a torn/mismatched stage must never be
+                # persisted (and later trusted) under this event's
+                # identity.
+                reason = shard_file.validate_staged_state(
+                    tensors, extra,
+                    expect_process_id=pid,
+                    expect_num_processes=nproc_global,
+                )
+                if reason is not None:
+                    integrity_counters.inc("ckpt_staged_rejected")
+                    logger.error(
+                        "saver: rank %d staged state rejected, NOT "
+                        "persisted (%s)", lr, reason,
+                    )
+                    return
+                if not copy_mode:
+                    stats = self._persist(ckpt_dir, step, pid, tensors, extra)
         finally:
             if lock is not None:
                 lock.release()
-        if read is None:
-            logger.warning("saver: arena for rank %d empty", lr)
-            return
-        tensors, extra = read
-        staged_step = int(extra.get("step", -1))
-        if staged_step != step:
-            logger.info(
-                "saver: arena holds step %d (event wanted %d) — persisting "
-                "the staged one", staged_step, step,
-            )
-            step = staged_step
-        # The arena's CRC covers the meta blob only; validate the staged
-        # state's own layout metadata before it becomes a durable shard —
-        # a torn/mismatched stage must never be persisted (and later
-        # trusted) under this event's identity.
-        reason = shard_file.validate_staged_state(
-            tensors, extra,
-            expect_process_id=pid,
-            expect_num_processes=nproc_global,
-        )
-        if reason is not None:
-            integrity_counters.inc("ckpt_staged_rejected")
-            logger.error(
-                "saver: rank %d staged state rejected, NOT persisted (%s)",
-                lr, reason,
-            )
-            return
-        t0 = time.perf_counter()
-        chaos.inject("ckpt.slow_storage", step=step, rank=pid)
-        shard_file.write_shard(
-            self.storage, ckpt_dir, step, pid, tensors, extra
-        )
+        if copy_mode:
+            # Stable copies: persist outside the locks, then push.
+            stats = self._persist(ckpt_dir, step, pid, tensors, extra)
+            if self.replica is not None:
+                self._pool.submit(
+                    self.replica.backup_shard, pid, step, tensors, extra
+                )
+        self._report_persist_perf(step, stats["mbps"])
         self._persisted[lr] = step
         self._stat.set(f"persisted_{lr}", step)
         logger.info(
-            "saver: persisted rank %d step %d in %.2fs",
-            lr, step, time.perf_counter() - t0,
+            "saver: persisted rank %d step %d in %.2fs (%.0f MB/s)",
+            lr, step, stats["seconds"], stats["mbps"],
         )
-        if self.replica is not None:
-            self._pool.submit(
-                self.replica.backup_shard, pid, step, tensors, extra
-            )
         if pid == 0:
             # Commit waits for the OTHER ranks' shards — never block the
             # event loop on it (they may be persisted by this same loop).
             self._pool.submit(
                 self._commit, ckpt_dir, step, nproc_global, keep_last
             )
+
+    def _persist(
+        self, ckpt_dir: str, step: int, pid: int, tensors, extra
+    ) -> dict:
+        """One streamed shard write + throughput stats/gauges."""
+        t0 = time.perf_counter()
+        chaos.inject("ckpt.slow_storage", step=step, rank=pid)
+        stats = shard_file.write_shard_from_views(
+            self.storage, ckpt_dir, step, pid, tensors, extra,
+            workers=self._ctx.ckpt_persist_workers,
+        )
+        stats["seconds"] = max(1e-9, time.perf_counter() - t0)
+        stats["mbps"] = stats["total_bytes"] / stats["seconds"] / (1 << 20)
+        perf_stats.set("ckpt_persist_mbps", stats["mbps"])
+        return stats
+
+    def _report_persist_perf(self, step: int, mbps: float) -> None:
+        """Throughput-only CkptPerf to the master (stall_ms=0 touches no
+        stall bookkeeping).  Called AFTER the fencing lock/arena mutex
+        are released — a slow master must never stretch the lock hold
+        the trainer's next save waits on.  Best-effort, short budget."""
+        if self.client is None:
+            return
+        try:
+            self.client.report_ckpt_perf(
+                step=step, stall_ms=0.0, persist_mbps=mbps
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.debug("persist perf report failed: %s", e)
+
+    def worker_perf(self) -> Dict[str, float]:
+        """One snapshot of the workers' reported perf stats — a single
+        short-budget round trip, because this runs inside a Prometheus
+        scrape handler (per-rank gets would cost nproc x timeout against
+        a sick stat server and black out the whole endpoint).  A 1s TTL
+        cache collapses the multiple gauges sampled by one scrape into
+        ONE round trip (and one bounded wait against a hung server)."""
+        ts, snap = self._perf_cache
+        if time.time() - ts < 1.0:
+            return snap
+        try:
+            snap = self._stat.to_dict(timeout=2.0) or {}
+        except Exception as e:  # noqa: BLE001
+            logger.debug("perf stat snapshot failed: %s", e)
+            snap = {}
+        self._perf_cache = (time.time(), snap)
+        return snap
+
+    def last_stall_ms(self) -> float:
+        """Worst save_to_memory blocking time across local ranks, as the
+        engines report it into the shared stat dict — the agent-side
+        gauge behind ``ckpt_stall_ms_last``."""
+        snap = self.worker_perf()
+        return max(
+            (float(v) for k, v in snap.items()
+             if k.startswith("stall_ms_") and v is not None),
+            default=0.0,
+        )
+
+    def staged_mbps(self) -> float:
+        """Slowest rank's worker->shm staging throughput (the staging
+        bottleneck) — the gauge behind ``ckpt_staged_mbps``."""
+        snap = self.worker_perf()
+        return min(
+            (float(v) for k, v in snap.items()
+             if k.startswith("staged_mbps_") and v is not None),
+            default=0.0,
+        )
 
     def _commit(self, ckpt_dir: str, step: int, world: int,
                 keep_last: int = 3, timeout: float = 600.0) -> None:

@@ -286,6 +286,25 @@ class MasterClient:
             )
         )
 
+    def report_ckpt_perf(
+        self, step: int, stall_ms: float,
+        staged_mbps: float = 0.0, persist_mbps: float = 0.0,
+    ) -> None:
+        """Feed the master's goodput accounting with the measured
+        save_to_memory stall (flash-ckpt fast path observability).
+
+        Single attempt, 1s budget, no retries: this call sits inside the
+        trainer's save path, whose whole point is a tens-of-ms stall — a
+        master outage must cost at most one short timeout, not the
+        default retry ladder.  Losing a sample is fine (it's a gauge)."""
+        self._client.call(
+            m.CkptPerf(
+                node_id=self.node_id, step=step, stall_ms=stall_ms,
+                staged_mbps=staged_mbps, persist_mbps=persist_mbps,
+            ),
+            timeout=1.0, retries=1, deadline=1.0,
+        )
+
     def report_used_resource(
         self, cpu_percent: float, memory_mb: float,
         tpu_duty_cycle: float = 0.0, hbm_used_mb: float = 0.0,

@@ -57,6 +57,39 @@ INTEGRITY_COUNTER_NAMES = (
 integrity_counters = CounterSet()
 
 
+class StatSet:
+    """Last-value named stats, thread-safe, sampled by gauges — the
+    peer of :class:`CounterSet` for non-monotonic signals (latencies,
+    throughputs) that deep layers set and the agent exposes."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vals: Dict[str, float] = {}
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._vals[name] = float(value)
+
+    def get(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._vals.get(name, default)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._vals)
+
+
+#: Checkpoint fast-path signals (ISSUE 4): the trainer's save_to_memory
+#: stall and the saver's persist throughput are the paper's headline
+#: numbers — they must be scrapeable, not grep-able.  The agent registers
+#: three gauges (training.py): ``ckpt_persist_mbps`` from this process's
+#: ``perf_stats`` (the saver persists in-process), and
+#: ``ckpt_stall_ms_last`` / ``ckpt_staged_mbps`` from the workers'
+#: reports in the saver's stat SharedDict (the engines run in worker
+#: processes, so their in-memory ``perf_stats`` is invisible here).
+perf_stats = StatSet()
+
+
 class MetricsRegistry:
     """Name -> callable returning a float (sampled at scrape time)."""
 

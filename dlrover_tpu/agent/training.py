@@ -457,6 +457,7 @@ class ElasticTrainingAgent:
                 MetricsRegistry,
                 MetricsServer,
                 integrity_counters,
+                perf_stats,
             )
             from dlrover_tpu.agent.monitor import current_usage
 
@@ -472,6 +473,30 @@ class ElasticTrainingAgent:
                     cname,
                     lambda n=cname: float(integrity_counters.get(n)),
                 )
+            # Flash-ckpt fast-path signals (ISSUE 4): persist throughput
+            # is set by the in-process saver; the train-stall and staging
+            # gauges read the workers' reports out of the saver's shared
+            # stat dict (one short-budget snapshot per gauge sample).
+            reg.gauge(
+                "ckpt_persist_mbps",
+                lambda: perf_stats.get("ckpt_persist_mbps"),
+            )
+            reg.gauge(
+                "ckpt_stall_ms_last",
+                lambda: (
+                    self.saver.last_stall_ms()
+                    if self.saver is not None
+                    else perf_stats.get("ckpt_stall_ms_last")
+                ),
+            )
+            reg.gauge(
+                "ckpt_staged_mbps",
+                lambda: (
+                    self.saver.staged_mbps()
+                    if self.saver is not None
+                    else perf_stats.get("ckpt_staged_mbps")
+                ),
+            )
             reg.gauge(
                 "node_cpu_percent",
                 lambda: current_usage()["cpu_percent"],

@@ -30,7 +30,7 @@ import numpy as np
 import jax
 
 from dlrover_tpu import chaos
-from dlrover_tpu.agent.metrics import integrity_counters
+from dlrover_tpu.agent.metrics import integrity_counters, perf_stats
 from dlrover_tpu.checkpoint import shard_file, tree_utils
 from dlrover_tpu.common import env as env_utils
 from dlrover_tpu.diagnosis.data import DiagnosisDataType
@@ -82,8 +82,18 @@ class CheckpointEngine:
         self._arena = SharedMemoryArena(
             arena_name(self.job_name, self.local_rank)
         )
+        # In-process arena fence: the standalone persist thread streams
+        # from the arena's mapped bytes while the trainer may be staging
+        # the next step into it — same contract the agent saver gets from
+        # its arena mutex.  Taken INSIDE the cross-process fencing lock.
+        self._arena_mu = threading.Lock()
         self._last_saved_step = -1
         self._last_persist_step = -1
+        # Train-stall accounting: how long save_to_memory/_storage blocked
+        # the step loop (the paper's headline "second-scale stall").
+        self.last_stall_ms = 0.0
+        self._last_staged_bytes = 0
+        self._stat_client: Optional[SharedDict] = None
         # step -> "a corrupt shard was seen while reading this step's
         # candidates" (populated per load; drives quarantine decisions).
         self._step_had_corruption: Dict[int, bool] = {}
@@ -124,6 +134,9 @@ class CheckpointEngine:
 
         jax.tree_util.tree_map(_prefetch, state)
         tensors, info = tree_utils.flatten_to_shards(state)
+        self._last_staged_bytes = sum(
+            int(np.asarray(a).nbytes) for a in tensors.values()
+        )
         extra = {
             "step": step,
             "meta": meta or {},
@@ -133,35 +146,111 @@ class CheckpointEngine:
             "ckpt_dir": self.ckpt_dir,
             "time": time.time(),
         }
+        # A zero-copy persist (agent saver on the fencing lock, or the
+        # standalone persist thread on the arena mutex) legitimately
+        # holds its lock for a WHOLE streamed storage write, which can
+        # exceed a minute on slow storage — waiting is correct; crashing
+        # the trainer's save (or hanging it silently) is not.
         if self._lock is not None:
-            acquired = self._lock.acquire(timeout=60.0)
-            if not acquired:
-                raise TimeoutError("could not acquire checkpoint shm lock")
+            self._acquire_patiently(
+                self._lock.acquire, "shm fencing lock"
+            )
         try:
-            self._arena.write_state(tensors, extra=extra)
+            self._acquire_patiently(
+                self._arena_mu.acquire, "arena mutex"
+            )
+            try:
+                self._arena.write_state(tensors, extra=extra)
+            finally:
+                self._arena_mu.release()
         finally:
             if self._lock is not None:
                 self._lock.release()
         self._last_saved_step = step
         return tensors, extra
 
+    @staticmethod
+    def _acquire_patiently(
+        acquire, what: str, budget: float = 600.0
+    ) -> None:
+        """Bounded lock wait for the save path: warn each minute, raise
+        only after the persist path's own 600s budget — one home for the
+        deadline arithmetic both save-path locks share."""
+        deadline = time.time() + budget
+        while not acquire(timeout=60.0):
+            if time.time() >= deadline:
+                raise TimeoutError(f"could not acquire {what}")
+            logger.warning(
+                "save: %s still held (persist in flight?); waiting", what
+            )
+
     def save_to_memory(
         self, step: int, state: Any, meta: Optional[dict] = None
     ) -> None:
-        """Stage into shm only — microseconds of training pause; the state
+        """Stage into shm only — the synchronous train stall; the state
         survives worker crash/restart on this host."""
         t0 = time.perf_counter()
         self._stage(step, state, meta)
-        logger.info(
-            "flash ckpt: staged step %d to shm in %.3fs",
-            step, time.perf_counter() - t0,
+        self._note_stall(step, time.perf_counter() - t0)
+
+    def _note_stall(self, step: int, seconds: float) -> None:
+        """Surface the measured train stall: local gauge, the agent's
+        shared stat dict (scraped as ``ckpt_stall_ms_last``), and the
+        master's goodput accounting — the stall is real lost train time
+        even though no restart happened."""
+        self.last_stall_ms = seconds * 1000.0
+        staged_mbps = (
+            self._last_staged_bytes / max(seconds, 1e-9) / (1 << 20)
         )
+        perf_stats.set("ckpt_stall_ms_last", self.last_stall_ms)
+        perf_stats.set("ckpt_staged_mbps", staged_mbps)
+        logger.info(
+            "flash ckpt: staged step %d to shm in %.3fs (%.0f MB/s, "
+            "train stalled %.1fms)",
+            step, seconds, staged_mbps, self.last_stall_ms,
+        )
+        if self.agent_mode:
+            try:
+                # One round trip for both stats, short timeout: this sits
+                # inside the save path whose whole point is a tens-of-ms
+                # stall — a dead stat server (agent restarting) must cost
+                # ~2s once, not the 60s default retry budget per save.
+                self._stat().update(
+                    {
+                        f"stall_ms_{self.local_rank}": round(
+                            self.last_stall_ms, 3
+                        ),
+                        f"staged_mbps_{self.local_rank}": round(
+                            staged_mbps, 1
+                        ),
+                    },
+                    timeout=2.0,
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.debug("stall stat report failed: %s", e)
+        if self.client is not None:
+            try:
+                self.client.report_ckpt_perf(
+                    step=step,
+                    stall_ms=self.last_stall_ms,
+                    staged_mbps=staged_mbps,
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.debug("ckpt perf report failed: %s", e)
+
+    def _stat(self) -> SharedDict:
+        """Cached client connection to the agent saver's stat dict."""
+        if self._stat_client is None:
+            self._stat_client = SharedDict(ckpt_stat_name(self.job_name))
+        return self._stat_client
 
     def save_to_storage(
         self, step: int, state: Any, meta: Optional[dict] = None
     ) -> None:
         """Stage into shm + request async persistence."""
+        t0 = time.perf_counter()
         tensors, extra = self._stage(step, state, meta)
+        self._note_stall(step, time.perf_counter() - t0)
         if self.agent_mode:
             self._queue.put(
                 {
@@ -175,37 +264,74 @@ class CheckpointEngine:
                 }
             )
         else:
-            fut = self._pool.submit(
-                self._persist, step, tensors, dict(extra)
-            )
+            fut = self._pool.submit(self._persist, step)
             self._futures.append((step, fut))
 
-    def _persist(self, step: int, tensors, extra) -> None:
+    def _persist(self, step: int) -> None:
+        """Standalone async persist: stream the shm arena's staged bytes.
+
+        NOT the host arrays from ``flatten_to_shards`` — on the CPU
+        backend those can be zero-copy aliases of live (donated) jax
+        buffers, and an async stream from them races the next train step
+        into a torn shard whose CRC (computed in the same pass over the
+        same torn bytes) would still validate.  The arena holds a stable
+        staged copy; ``_arena_mu`` fences it against concurrent
+        re-staging for the duration of the zero-copy stream (the
+        ``ckpt_zero_copy=False`` knob trades that hold for one copy,
+        exactly like the agent saver)."""
         try:
-            reason = shard_file.validate_staged_state(
-                tensors, extra,
-                expect_process_id=self.process_id,
-                expect_num_processes=self.num_processes,
-            )
-            if reason is not None:
-                integrity_counters.inc("ckpt_staged_rejected")
-                logger.error(
-                    "NOT persisting step %d: staged state invalid (%s)",
-                    step, reason,
+            zero_copy = self._ctx.ckpt_zero_copy
+            with self._arena_mu:
+                read = self._arena.read_state(copy=not zero_copy)
+                if read is None:
+                    logger.error(
+                        "NOT persisting step %d: arena holds no state",
+                        step,
+                    )
+                    return
+                tensors, extra = read
+                staged_step = int(extra.get("step", -1))
+                if staged_step != step:
+                    logger.info(
+                        "persist: arena holds step %d (wanted %d) — "
+                        "persisting the staged one", staged_step, step,
+                    )
+                    step = staged_step
+                reason = shard_file.validate_staged_state(
+                    tensors, extra,
+                    expect_process_id=self.process_id,
+                    expect_num_processes=self.num_processes,
                 )
-                return
-            chaos.inject(
-                "ckpt.slow_storage", step=step, rank=self.process_id
-            )
-            shard_file.write_shard(
-                self.storage, self.ckpt_dir, step, self.process_id,
-                tensors, extra,
-            )
+                if reason is not None:
+                    integrity_counters.inc("ckpt_staged_rejected")
+                    logger.error(
+                        "NOT persisting step %d: staged state invalid "
+                        "(%s)", step, reason,
+                    )
+                    return
+                if zero_copy:
+                    self._stream_shard(step, tensors, extra)
+            if not zero_copy:
+                self._stream_shard(step, tensors, extra)
             self._last_persist_step = step
             if self.process_id == 0:
                 self._commit_when_ready(step)
         except Exception:  # noqa: BLE001
             logger.exception("checkpoint persist of step %d failed", step)
+
+    def _stream_shard(self, step: int, tensors, extra) -> None:
+        chaos.inject("ckpt.slow_storage", step=step, rank=self.process_id)
+        t0 = time.perf_counter()
+        stats = shard_file.write_shard_from_views(
+            self.storage, self.ckpt_dir, step, self.process_id,
+            tensors, extra,
+            workers=self._ctx.ckpt_persist_workers,
+        )
+        mbps = (
+            stats["total_bytes"]
+            / max(time.perf_counter() - t0, 1e-9) / (1 << 20)
+        )
+        perf_stats.set("ckpt_persist_mbps", mbps)
 
     def _commit_when_ready(self, step: int, timeout: float = 600.0) -> bool:
         """Leader: wait for every process's done file (optionally gated by
@@ -245,7 +371,7 @@ class CheckpointEngine:
             if committed is not None and committed >= self._last_persist_step:
                 return True
             if self.agent_mode:
-                stat = SharedDict(ckpt_stat_name(self.job_name))
+                stat = self._stat()
                 try:
                     done = stat.get(f"persisted_{self.local_rank}", -1)
                     if done is not None and int(done) >= self._last_saved_step:
@@ -266,7 +392,19 @@ class CheckpointEngine:
 
         With ``target`` given, returns (pytree-like-target, meta); without,
         returns (ShardSource, meta) for caller-side assembly."""
-        got = self._load_from_shm()
+        # Zero-copy shm read when the tree is materialized HERE and this
+        # process is provably the arena's only writer: with a target,
+        # restore_to_target device_puts every piece before load() returns,
+        # while the arena stays mapped and (standalone mode) nothing else
+        # can write it — so views never outlive their mapping.  In AGENT
+        # mode the saver process may concurrently write_state the same
+        # arena (replica seed_from_replicas after a re-rendezvous) and
+        # this unlocked read would see torn bytes, so it copies.  Without
+        # a target the ShardSource escapes to the caller with unbounded
+        # lifetime: copy.
+        got = self._load_from_shm(
+            copy=target is None or self.agent_mode
+        )
         got = self._agree_shm_step(got)  # collective: same branch all ranks
         if got is not None:
             source, extra = got
@@ -430,10 +568,13 @@ class CheckpointEngine:
             )
         return None
 
-    def _load_from_shm(self):
+    def _load_from_shm(self, copy: bool = True):
         try:
-            self._arena.reopen()
-            read = self._arena.read_state(copy=True)
+            # reopen() munmaps: fence against a concurrent standalone
+            # persist thread streaming from the current mapping.
+            with self._arena_mu:
+                self._arena.reopen()
+                read = self._arena.read_state(copy=copy)
         except (FileNotFoundError, OSError):
             return None  # no arena yet: first run on this host
         except Exception:  # noqa: BLE001

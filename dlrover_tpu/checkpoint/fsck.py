@@ -1,7 +1,9 @@
 """Offline checkpoint fsck — the operator-facing integrity surface.
 
 Walks a flash-checkpoint directory, verifies every shard against its CRCs
-(format v2; v1 legacy shards get structural checks only), and cross-checks
+in bounded chunks (stream + incremental CRC — peak memory is one chunk
+plus the meta blob, so shards larger than host RAM verify fine; format
+v2; v1 legacy shards get structural checks only), and cross-checks
 the commit protocol per step: tracker -> step dir, done votes <-> shard
 files, and shard coverage of the committed step.  Quarantined dirs
 (``step_N.corrupt`` / ``.quarantined`` marker) are re-verified so the
@@ -99,18 +101,36 @@ def _check_step_dir(
     verified = set()
     for pid in sorted(shards):
         path = os.path.join(dirpath, shards[pid])
-        data = storage.read(path)
-        if data is None:
-            report.add(SEV_WARN, step, path, "shard listed but unreadable")
+        # Stream + incremental CRC: peak memory is one chunk (+ meta), so
+        # fsck verifies shards larger than host RAM headroom.  POSIX
+        # backends hand back the real file; others fall back to a
+        # materialized buffer inside open_read.
+        # An unreadable shard of the COMMITTED step is damage (the
+        # committed checkpoint is not restorable as promised), not a
+        # warning — and it must not silently defuse the coverage check
+        # below by keeping `world` unknown.
+        f = storage.open_read(path)
+        if f is None:
+            report.add(
+                SEV_DAMAGE if committed else SEV_WARN, step, path,
+                "shard listed but unreadable",
+            )
             continue
         report.shards_checked += 1
         try:
-            extra = shard_file.verify_shard(data, path=path)
+            with f:
+                extra, version = shard_file.verify_shard_file(f, path=path)
         except shard_file.ShardCorruptionError as e:
             report.add(SEV_DAMAGE, step, path, f"corrupt shard: {e.reason}")
             continue
+        except OSError as e:
+            report.add(
+                SEV_DAMAGE if committed else SEV_WARN, step, path,
+                f"shard unreadable mid-verify: {e}",
+            )
+            continue
         verified.add(pid)
-        if shard_file.shard_version(data) == 1:
+        if version == 1:
             report.add(
                 SEV_INFO, step, path, "legacy v1 shard (no CRCs to verify)"
             )
@@ -128,6 +148,11 @@ def _check_step_dir(
             SEV_DAMAGE, step, os.path.join(dirpath, f".done_{pid:05d}"),
             "done vote present but its shard file is missing",
         )
+    # Done votes also bound the world: with every shard unreadable the
+    # verified extras can't name num_processes, and the coverage check
+    # must still fire for a committed step.
+    if done:
+        world = max(world or 0, max(done) + 1)
     if committed and world:
         missing = sorted(set(range(world)) - verified)
         if missing:

@@ -24,6 +24,15 @@ and :mod:`dlrover_tpu.checkpoint.fsck` reports to operators.  A step that
 fails verification is **quarantined** (:func:`quarantine_step`): its dir is
 renamed ``step_N.corrupt`` (marker file on backends without rename) and
 excluded from :func:`list_steps`, restore candidates, and rotation.
+
+Two writers produce the same bytes: :func:`pack_shard` (reference
+implementation, materializes the blob) and :class:`ShardStreamWriter` /
+:func:`write_shard_from_views` (the hot path: streams tensor bytes
+straight from the caller's views — typically the shm arena mapping — in
+bounded chunks, CRC folded into the same single pass, zero intermediate
+full-state buffers, optional parallel range workers).
+:func:`verify_shard_file` is the bounded-memory counterpart of
+:func:`verify_shard` for shards larger than RAM headroom.
 """
 
 from __future__ import annotations
@@ -38,10 +47,11 @@ import msgpack
 import numpy as np
 
 from dlrover_tpu import chaos
+from dlrover_tpu.common.byte_audit import audit
 from dlrover_tpu.common.constants import CheckpointConstant as CC
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.native import shm_lib
-from dlrover_tpu.common.storage import CheckpointStorage
+from dlrover_tpu.common.storage import CheckpointStorage, drain_ranges
 
 FORMAT_VERSION = 2
 _MAGIC_V1 = b"DLRTPUF1"
@@ -52,6 +62,25 @@ _V2_HEADER = 20  # magic u64 | meta_len u64 | meta_crc u32
 # Below this size the ctypes round-trip costs more than it saves; zlib's
 # C loop is already fast for small buffers.
 _NATIVE_CRC_MIN_BYTES = 1 << 20
+
+# Streaming writer: bytes per write/CRC chunk.  Large enough that syscall
+# and ctypes overheads vanish, small enough to bound resident pressure.
+STREAM_CHUNK_BYTES = 8 << 20
+
+# Chunked-verify meta-read ceiling (see verify_shard_file): far above any
+# real meta blob, far below "materialize the data region by accident".
+_VERIFY_META_CAP = 256 << 20
+
+# Meta placeholder for the single-pass streamed write: tensor CRCs are only
+# known after the data pass, but the meta region (which *contains* them)
+# precedes the data in the file.  msgpack minimally encodes ints, so the
+# meta's byte length depends on the CRC values; 0xFFFFFFFF pins each
+# placeholder to msgpack's 5-byte uint32 form — the same width as any real
+# CRC >= 65536.  A shard whose every tensor CRC matches that width (all but
+# ~1.5e-5 per tensor) gets its header+meta patched in place after the one
+# data pass; otherwise the writer re-streams at the corrected base (rare
+# second pass, counted by the byte audit).
+_CRC_PLACEHOLDER = 0xFFFFFFFF
 
 QUARANTINE_SUFFIX = ".corrupt"
 QUARANTINE_MARKER = ".quarantined"
@@ -82,18 +111,67 @@ def shard_version(data: bytes) -> Optional[int]:
     return None
 
 
-def crc32_bytes(buf) -> int:
-    """CRC-32 (zlib polynomial) of a bytes-like buffer.
+_NATIVE_CRC_FASTER: Optional[bool] = None
 
-    Large buffers go through the native ``shm_crc32`` kernel
-    (``native/shm_arena.cc``) when the toolchain built it — same
-    polynomial, same result — with ``zlib.crc32`` as the fallback."""
-    if len(buf) >= _NATIVE_CRC_MIN_BYTES:
+
+def _native_crc_faster() -> bool:
+    """One-time measured choice between the native ``shm_crc32`` kernel
+    and ``zlib.crc32`` for large buffers.
+
+    PR 3 assumed the native kernel wins; on hosts whose zlib carries a
+    slice-by-8/SIMD CRC it is the *byte-at-a-time table loop* that loses
+    (measured 327 vs 1000 MB/s on the CI container), and the CRC pass is
+    half the streamed persist's cost.  Both produce the same polynomial,
+    so the choice is pure throughput: hash 1 MB with each once and cache
+    the verdict (a benign race — both racers compute the same answer)."""
+    global _NATIVE_CRC_FASTER
+    if _NATIVE_CRC_FASTER is None:
         lib = shm_lib()
-        if lib is not None:
-            arr = np.frombuffer(buf, dtype=np.uint8)
-            return int(lib.shm_crc32(arr.ctypes.data, arr.nbytes, 0))
-    return zlib.crc32(buf) & 0xFFFFFFFF
+        if lib is None:
+            _NATIVE_CRC_FASTER = False
+        else:
+            # Pre-touch the pages and warm both code paths, then take
+            # best-of-3: the lazy first call can land mid-persist on a
+            # contended core, and a single preempted sample (or the
+            # cold-page bias of whichever backend runs first) must not
+            # stick the slower backend for the process lifetime.
+            probe = np.ones(1 << 20, dtype=np.uint8)
+            lib.shm_crc32(probe.ctypes.data, probe.nbytes, 0)
+            zlib.crc32(probe)
+            t_native = t_zlib = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                lib.shm_crc32(probe.ctypes.data, probe.nbytes, 0)
+                t_native = min(t_native, time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                zlib.crc32(probe)
+                t_zlib = min(t_zlib, time.perf_counter() - t0)
+            _NATIVE_CRC_FASTER = t_native < t_zlib
+            logger.debug(
+                "crc32 backend: native %.1f MB/s vs zlib %.1f MB/s -> %s",
+                1.0 / max(t_native, 1e-9), 1.0 / max(t_zlib, 1e-9),
+                "native" if _NATIVE_CRC_FASTER else "zlib",
+            )
+    return _NATIVE_CRC_FASTER
+
+
+def crc32_update(buf, crc: int = 0) -> int:
+    """Fold a bytes-like buffer into a running CRC-32 (zlib polynomial).
+
+    ``crc32_update(b, crc32_update(a))`` == ``crc32_bytes(a + b)`` — the
+    streaming writer and chunked verifier hash tensor bytes in bounded
+    chunks with no concatenation.  Large chunks go through whichever of
+    the native ``shm_crc32`` kernel (``native/shm_arena.cc``,
+    seed-continuable) and ``zlib.crc32`` measured faster on this host."""
+    if len(buf) >= _NATIVE_CRC_MIN_BYTES and _native_crc_faster():
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        return int(shm_lib().shm_crc32(arr.ctypes.data, arr.nbytes, crc))
+    return zlib.crc32(buf, crc) & 0xFFFFFFFF
+
+
+def crc32_bytes(buf) -> int:
+    """CRC-32 (zlib polynomial) of a whole bytes-like buffer."""
+    return crc32_update(buf, 0)
 
 
 def step_dir(ckpt_dir: str, step: int) -> str:
@@ -112,6 +190,33 @@ def tracker_path(ckpt_dir: str) -> str:
     return os.path.join(ckpt_dir, CC.TRACKER_FILE)
 
 
+def _dtype_key(dtype) -> str:
+    """dtype.name round-trips extended types (bfloat16/fp8 via ml_dtypes)
+    where dtype.str degrades to raw void ('<V2')."""
+    try:
+        return dtype.name if np.dtype(dtype.name) == dtype else dtype.str
+    except TypeError:
+        return dtype.str
+
+
+def _byte_view(arr: np.ndarray) -> memoryview:
+    """Flat uint8 memoryview of an array's data — zero-copy for
+    contiguous inputs (the shm arena case); a non-contiguous input costs
+    one per-tensor compaction copy (audited).  0-d inputs get a new 1-d
+    VIEW from ascontiguousarray (identity changes, memory doesn't), so
+    the audit gates on shares_memory, not identity."""
+    contig = np.ascontiguousarray(arr)
+    if (
+        audit.enabled
+        and contig is not arr
+        and not np.shares_memory(contig, arr)
+    ):
+        audit.record_copy(int(contig.nbytes), "ascontiguousarray")
+    if contig.nbytes == 0:
+        return memoryview(b"")
+    return memoryview(contig.reshape(-1).view(np.uint8))
+
+
 def pack_shard(tensors: Dict[str, np.ndarray], extra: dict) -> bytes:
     metas = {}
     blobs = []
@@ -120,17 +225,10 @@ def pack_shard(tensors: Dict[str, np.ndarray], extra: dict) -> bytes:
         shape = list(np.shape(arr))
         # ascontiguousarray promotes 0-d to 1-d; keep the true shape.
         arr = np.ascontiguousarray(arr)
-        try:
-            dtype_key = (
-                arr.dtype.name
-                if np.dtype(arr.dtype.name) == arr.dtype
-                else arr.dtype.str
-            )
-        except TypeError:
-            dtype_key = arr.dtype.str
         blob = arr.reshape(-1).view(np.uint8).tobytes()
+        audit.record_copy(len(blob), "pack_tobytes")
         metas[key] = {
-            "dtype": dtype_key,
+            "dtype": _dtype_key(arr.dtype),
             "shape": shape,
             "offset": offset,
             "nbytes": int(arr.nbytes),
@@ -143,40 +241,48 @@ def pack_shard(tensors: Dict[str, np.ndarray], extra: dict) -> bytes:
         use_bin_type=True,
     )
     header = _MAGIC + struct.pack("<QI", len(meta_blob), crc32_bytes(meta_blob))
+    audit.record_copy(offset, "pack_join")
     return header + meta_blob + b"".join(blobs)
 
 
-def _parse_meta(data: bytes, path: str = "") -> Tuple[dict, int, int]:
-    """Validate header + meta blob; returns (meta, data_base, version).
-
-    Every structural defect — not just the happy-path magic check —
-    raises :class:`ShardCorruptionError`."""
-    if len(data) < _V1_HEADER:
+def _parse_header(
+    head: bytes, total_len: int, path: str = ""
+) -> Tuple[int, int, Optional[int], int]:
+    """Validate the fixed header given the file's total length; returns
+    (version, meta_len, meta_crc, meta_base).  Shared by the in-memory
+    and streaming verifiers so every structural defect raises the same
+    :class:`ShardCorruptionError`."""
+    if total_len < _V1_HEADER:
         raise ShardCorruptionError(
-            f"file shorter than the shard header ({len(data)} bytes)", path
+            f"file shorter than the shard header ({total_len} bytes)", path
         )
-    magic = bytes(data[:8])
+    magic = bytes(head[:8])
     if magic == _MAGIC:
         version = 2
-        if len(data) < _V2_HEADER:
+        if total_len < _V2_HEADER:
             raise ShardCorruptionError("v2 header truncated", path)
-        meta_len, meta_crc = struct.unpack("<QI", data[8:_V2_HEADER])
+        meta_len, meta_crc = struct.unpack("<QI", head[8:_V2_HEADER])
         base = _V2_HEADER
     elif magic == _MAGIC_V1:
         version = 1
-        (meta_len,) = struct.unpack("<Q", data[8:_V1_HEADER])
+        (meta_len,) = struct.unpack("<Q", head[8:_V1_HEADER])
         meta_crc = None
         base = _V1_HEADER
     else:
         raise ShardCorruptionError(
             f"bad magic {magic!r} — not a dlrover_tpu shard", path
         )
-    if base + meta_len > len(data):
+    if base + meta_len > total_len:
         raise ShardCorruptionError(
             f"meta region ({meta_len}B) extends past EOF "
-            f"({len(data)}B file)", path,
+            f"({total_len}B file)", path,
         )
-    meta_raw = bytes(data[base : base + meta_len])
+    return version, int(meta_len), meta_crc, base
+
+
+def _decode_meta(
+    meta_raw: bytes, meta_crc: Optional[int], path: str = ""
+) -> dict:
     if meta_crc is not None and crc32_bytes(meta_raw) != meta_crc:
         raise ShardCorruptionError("meta CRC mismatch", path)
     try:
@@ -189,11 +295,21 @@ def _parse_meta(data: bytes, path: str = "") -> Tuple[dict, int, int]:
         or not isinstance(meta.get("extra"), dict)
     ):
         raise ShardCorruptionError("meta structure invalid", path)
+    return meta
+
+
+def _parse_meta(data: bytes, path: str = "") -> Tuple[dict, int, int]:
+    """Validate header + meta blob; returns (meta, data_base, version)."""
+    version, meta_len, meta_crc, base = _parse_header(data, len(data), path)
+    meta = _decode_meta(bytes(data[base : base + meta_len]), meta_crc, path)
     return meta, base + meta_len, version
 
 
-def _tensor_blob(data: bytes, base: int, key: str, tm, path: str):
-    """Bounds-checked zero-copy view of one tensor's bytes."""
+def _blob_bounds(
+    key: str, tm, limit: int, path: str = ""
+) -> Tuple[int, int]:
+    """Validated (offset, nbytes) of one tensor's blob relative to the
+    data region, against ``limit`` bytes of data-region capacity."""
     try:
         offset = int(tm["offset"])
         nbytes = int(tm["nbytes"])
@@ -201,11 +317,17 @@ def _tensor_blob(data: bytes, base: int, key: str, tm, path: str):
         raise ShardCorruptionError(
             f"tensor {key!r} meta invalid: {e}", path
         ) from e
-    if offset < 0 or nbytes < 0 or base + offset + nbytes > len(data):
+    if offset < 0 or nbytes < 0 or offset + nbytes > limit:
         raise ShardCorruptionError(
             f"tensor {key!r} blob (offset={offset}, nbytes={nbytes}) "
             "truncated or out of bounds", path,
         )
+    return offset, nbytes
+
+
+def _tensor_blob(data: bytes, base: int, key: str, tm, path: str):
+    """Bounds-checked zero-copy view of one tensor's bytes."""
+    offset, nbytes = _blob_bounds(key, tm, len(data) - base, path)
     return memoryview(data)[base + offset : base + offset + nbytes]
 
 
@@ -232,6 +354,71 @@ def verify_shard(data: bytes, path: str = "") -> dict:
         buf = _tensor_blob(data, base, key, tm, path)
         _check_tensor_crc(buf, key, tm, version, path)
     return meta["extra"]
+
+
+def verify_shard_file(
+    f, path: str = "", chunk_bytes: int = STREAM_CHUNK_BYTES
+) -> Tuple[dict, int]:
+    """:func:`verify_shard` over a seekable binary file in bounded chunks.
+
+    Peak memory is ``max(meta_len, chunk_bytes)`` regardless of shard
+    size, so fsck can verify shards larger than host RAM headroom.
+    Returns ``(extra, format_version)``; raises
+    :class:`ShardCorruptionError` on any damage (same reasons as the
+    in-memory verifier — both ride the shared parse helpers)."""
+    f.seek(0, os.SEEK_END)
+    size = f.tell()
+    f.seek(0)
+    version, meta_len, meta_crc, base = _parse_header(
+        f.read(min(size, _V2_HEADER)), size, path
+    )
+    # Cap the meta read: a bit-flipped meta_len that still lands inside
+    # the file would otherwise materialize gigabytes here and OOM the
+    # verifier on exactly the damaged shard it exists to diagnose.  Real
+    # metas are a few KB..MB (the shm arena caps staging meta at 8MB).
+    if meta_len > _VERIFY_META_CAP:
+        raise ShardCorruptionError(
+            f"meta region ({meta_len}B) implausibly large "
+            f"(cap {_VERIFY_META_CAP}B) — header corrupt", path,
+        )
+    f.seek(base)
+    meta = _decode_meta(f.read(meta_len), meta_crc, path)
+    data_base = base + meta_len
+    # Offset order == file order for packed/streamed shards; sorting keeps
+    # the read head moving forward even on adversarial metas.
+    items = sorted(
+        meta["tensors"].items(),
+        key=lambda kv: kv[1].get("offset", 0)
+        if isinstance(kv[1], dict) and isinstance(kv[1].get("offset"), int)
+        else 0,
+    )
+    for key, tm in items:
+        offset, nbytes = _blob_bounds(key, tm, size - data_base, path)
+        if version < 2:
+            continue  # v1 shards carry no CRCs; bounds checks only
+        want = tm.get("crc32")
+        if not isinstance(want, int):
+            raise ShardCorruptionError(
+                f"tensor {key!r} missing crc32 in v2 meta", path
+            )
+        f.seek(data_base + offset)
+        crc = 0
+        remaining = nbytes
+        while remaining > 0:
+            chunk = f.read(min(chunk_bytes, remaining))
+            if not chunk:
+                raise ShardCorruptionError(
+                    f"tensor {key!r} blob (offset={offset}, "
+                    f"nbytes={nbytes}) truncated or out of bounds", path,
+                )
+            crc = crc32_update(chunk, crc)
+            remaining -= len(chunk)
+        if crc != want:
+            raise ShardCorruptionError(
+                f"tensor {key!r} CRC mismatch (bit rot or torn write)",
+                path,
+            )
+    return meta["extra"], version
 
 
 def unpack_shard(
@@ -324,10 +511,230 @@ def write_shard(
     tensors: Dict[str, np.ndarray],
     extra: dict,
 ) -> None:
+    """Legacy pack-then-write persist (one monolithic blob).  The hot
+    paths use :func:`write_shard_from_views`; this stays as the reference
+    implementation the interop tests compare against byte-for-byte."""
     storage.safe_makedirs(step_dir(ckpt_dir, step))
     blob = _chaos_damage_blob(pack_shard(tensors, extra), step, process_id)
     storage.write(blob, shard_path(ckpt_dir, step, process_id))
     storage.write(str(time.time()), done_path(ckpt_dir, step, process_id))
+
+
+class ShardStreamWriter:
+    """Single-pass, zero-copy v2 shard writer.
+
+    Where :func:`pack_shard` materializes three full copies of the state
+    (arena read copy, per-tensor ``tobytes``, blob join) before the bytes
+    ever reach storage, this writer streams tensor bytes **directly from
+    the caller's memoryviews** (typically the shm arena mapping) to the
+    storage sink in ``chunk_bytes`` chunks, folding each tensor's CRC-32
+    incrementally during that same pass.  The header+meta region — whose
+    byte length depends on those CRCs (see ``_CRC_PLACEHOLDER``) — is
+    patched in place afterwards.  Output is **byte-identical** to
+    ``pack_shard(tensors, extra)`` for the same inputs.
+
+    ``workers > 1`` splits the tensors into contiguous byte-balanced
+    ranges drained concurrently via positional writes into the
+    preallocated file (``CheckpointStorage.write_shard_ranges``; POSIX
+    pwrite fast path, sequential on object stores).
+
+    Lifetime contract: the caller must keep the views' backing memory
+    mapped and fenced against writers for the duration of
+    :meth:`write` — the agent saver holds the per-rank fencing lock and
+    arena mutex across this call.
+    """
+
+    def __init__(
+        self,
+        storage: CheckpointStorage,
+        path: str,
+        tensors: Dict[str, np.ndarray],
+        extra: dict,
+        *,
+        workers: int = 1,
+        chunk_bytes: int = STREAM_CHUNK_BYTES,
+        damage_ctx: Optional[Tuple[int, int]] = None,
+    ):
+        self._storage = storage
+        self._path = path
+        self._tensors = tensors
+        self._extra = extra
+        self._workers = max(1, int(workers))
+        self._chunk = max(1 << 16, int(chunk_bytes))
+        self._damage_ctx = damage_ctx
+        self._crcs: Dict[str, int] = {}
+        self._stats: dict = {}
+
+    # -- layout --------------------------------------------------------------
+    def _layout(self):
+        """(placeholder metas, [(key, byte_view, rel_offset)], data_bytes) —
+        identical field order and offsets to :func:`pack_shard`."""
+        metas: Dict[str, dict] = {}
+        views = []
+        offset = 0
+        for key, arr in self._tensors.items():
+            arr = np.asarray(arr)
+            shape = list(np.shape(arr))
+            view = _byte_view(arr)
+            metas[key] = {
+                "dtype": _dtype_key(arr.dtype),
+                "shape": shape,
+                "offset": offset,
+                "nbytes": int(arr.nbytes),
+                # An empty blob's CRC is exactly 0 — pin it now so a 0-d
+                # optimizer scalar or empty buffer never forces the
+                # relayout pass just to shrink a placeholder.
+                "crc32": _CRC_PLACEHOLDER if arr.nbytes else 0,
+            }
+            views.append((key, view, offset))
+            offset += int(arr.nbytes)
+        return metas, views, offset
+
+    def _partition(self, views, n: int):
+        """Contiguous byte-balanced groups, one per range worker."""
+        if n <= 1 or len(views) <= 1:
+            return [views] if views else []
+        total = sum(len(v) for _, v, _ in views)
+        target = max(1, total // n)
+        groups, cur, cur_bytes = [], [], 0
+        for item in views:
+            cur.append(item)
+            cur_bytes += len(item[1])
+            if cur_bytes >= target and len(groups) < n - 1:
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            groups.append(cur)
+        return groups
+
+    def _gen(self, group):
+        """Yield one group's tensor bytes in bounded chunks, folding each
+        tensor's CRC-32 as a side effect of the same traversal."""
+        for key, view, _rel in group:
+            crc = 0
+            for lo in range(0, len(view), self._chunk):
+                chunk = view[lo : lo + self._chunk]
+                crc = crc32_update(chunk, crc)
+                audit.record_write(len(chunk))
+                yield chunk
+            self._crcs[key] = crc
+
+    def _ranges(self, groups, base: int):
+        return [(base + g[0][2], self._gen(g)) for g in groups if g]
+
+    # -- write ---------------------------------------------------------------
+    def write(self) -> dict:
+        metas, views, data_bytes = self._layout()
+        meta_ph = msgpack.packb(
+            {"format": FORMAT_VERSION, "tensors": metas, "extra": self._extra},
+            use_bin_type=True,
+        )
+        base = _V2_HEADER + len(meta_ph)
+        groups = self._partition(views, self._workers)
+        self._stats = {
+            "data_bytes": data_bytes,
+            "tensors": len(views),
+            "workers": min(self._workers, max(1, len(groups))),
+            "passes": 1,
+        }
+
+        def _finalize(sink):
+            nonlocal base
+            # Real CRCs are known only now; dict(m, ...) keeps key order,
+            # so the meta blob matches pack_shard's byte-for-byte.
+            real = {
+                k: dict(m, crc32=self._crcs.get(k, 0))
+                for k, m in metas.items()
+            }
+            meta_blob = msgpack.packb(
+                {
+                    "format": FORMAT_VERSION,
+                    "tensors": real,
+                    "extra": self._extra,
+                },
+                use_bin_type=True,
+            )
+            if len(meta_blob) != len(meta_ph):
+                # A tensor CRC landed below 65536 (~1.5e-5 per tensor) and
+                # msgpack encodes it narrower than the placeholder: the
+                # data region must shift.  Rare second pass, audited.
+                base = _V2_HEADER + len(meta_blob)
+                audit.record_pass("stream_relayout")
+                self._stats["passes"] += 1
+                drain_ranges(
+                    sink, self._ranges(groups, base), self._workers
+                )
+                sink.truncate(base + data_bytes)
+            total = base + data_bytes
+            sink.write_at(
+                _MAGIC
+                + struct.pack(
+                    "<QI", len(meta_blob), crc32_bytes(meta_blob)
+                ),
+                0,
+            )
+            sink.write_at(meta_blob, _V2_HEADER)
+            self._apply_chaos(sink, total)
+            self._stats["total_bytes"] = total
+
+        audit.record_pass("stream_data")
+        self._storage.write_shard_ranges(
+            self._path,
+            base + data_bytes,
+            self._ranges(groups, base),
+            workers=self._workers,
+            finalize=_finalize,
+        )
+        return dict(self._stats)
+
+    def _apply_chaos(self, sink, total: int) -> None:
+        """Same damage semantics as ``_chaos_damage_blob``, applied to the
+        streamed file before its atomic publish."""
+        if self._damage_ctx is None:
+            return
+        step, pid = self._damage_ctx
+        if chaos.inject(
+            "storage.corrupt_shard", step=step, rank=pid
+        ) is not None:
+            pos = max(0, total - 7)
+            cur = sink.read_at(1, pos)
+            if cur:
+                sink.write_at(bytes([cur[0] ^ 0xFF]), pos)
+        if chaos.inject(
+            "storage.truncate_shard", step=step, rank=pid
+        ) is not None:
+            sink.truncate(max(1, total // 2))
+
+
+def write_shard_from_views(
+    storage: CheckpointStorage,
+    ckpt_dir: str,
+    step: int,
+    process_id: int,
+    tensors: Dict[str, np.ndarray],
+    extra: dict,
+    *,
+    workers: int = 1,
+    chunk_bytes: int = STREAM_CHUNK_BYTES,
+) -> dict:
+    """Streamed, zero-copy counterpart of :func:`write_shard`: same file
+    bytes, same done-file vote, no intermediate full-state buffers.
+    ``tensors`` may be live shm-arena views — see
+    :class:`ShardStreamWriter` for the lifetime contract.  Returns the
+    writer's stats dict (bytes, passes, workers)."""
+    storage.safe_makedirs(step_dir(ckpt_dir, step))
+    writer = ShardStreamWriter(
+        storage,
+        shard_path(ckpt_dir, step, process_id),
+        tensors,
+        extra,
+        workers=workers,
+        chunk_bytes=chunk_bytes,
+        damage_ctx=(step, process_id),
+    )
+    stats = writer.write()
+    storage.write(str(time.time()), done_path(ckpt_dir, step, process_id))
+    return stats
 
 
 def read_shard(

@@ -128,6 +128,22 @@ class ShardSource:
         return out
 
 
+def _owned(piece: np.ndarray) -> np.ndarray:
+    """Ensure a restored piece owns its bytes.
+
+    ``assemble()``'s exact-match fast path returns the source array
+    itself, which on the zero-copy shm restore is a VIEW into the live
+    arena — it must not reach the restored tree (directly, or via
+    ``jax.device_put``, which on the CPU backend may alias an aligned
+    numpy buffer instead of copying): the next ``save_to_memory`` would
+    rewrite the bytes underfoot.  ``base is not None`` is exactly "this
+    array borrows someone else's buffer"; storage-restored pieces
+    (``unpack_shard`` copies) and overlap-assembled pieces (fresh
+    ``np.empty``) pass through untouched."""
+    piece = np.asarray(piece)
+    return np.array(piece) if piece.base is not None else piece
+
+
 def restore_to_target(
     target: Any, source: ShardSource
 ) -> Any:
@@ -151,7 +167,7 @@ def restore_to_target(
                     raise KeyError(
                         f"checkpoint missing data for {name} index {idx}"
                     )
-                arrays.append(jax.device_put(piece, shard.device))
+                arrays.append(jax.device_put(_owned(piece), shard.device))
                 devices.append(shard.device)
             restored = jax.make_array_from_single_device_arrays(
                 gshape, sharding, arrays
@@ -165,5 +181,5 @@ def restore_to_target(
             )
             if piece is None:
                 raise KeyError(f"checkpoint missing data for {name}")
-            out_leaves.append(piece)
+            out_leaves.append(_owned(piece))
     return tree_unflatten(treedef, out_leaves)

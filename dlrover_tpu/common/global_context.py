@@ -42,6 +42,19 @@ class Context:
         self.train_speed_record_num: int = 50
         self.seconds_to_autoscale_worker: float = 1800.0
         self.ckpt_shard_io_workers: int = 4
+        # Streamed-persist range workers (shm -> storage, per shard): 1 =
+        # sequential single pass; N > 1 splits the shard into contiguous
+        # tensor ranges written concurrently via pwrite into the
+        # preallocated file (POSIX backends only — object stores fall
+        # back to sequential).  Worth raising when storage bandwidth
+        # exceeds a single core's CRC+write throughput.
+        self.ckpt_persist_workers: int = 1
+        # Zero-copy persist streams from the shm mapping holding the
+        # per-rank fencing lock for the WHOLE persist (the trainer's next
+        # save waits that long).  On slow/flaky storage where that hold
+        # is worse than one extra state copy, set False to restore the
+        # old bounded stall: copy under the lock, persist from the copy.
+        self.ckpt_zero_copy: bool = True
         self.auto_tune: bool = False
         # Cross-node in-memory checkpoint replicas (flash-ckpt replica.py
         # analogue); off by default — costs DCN bandwidth per save.

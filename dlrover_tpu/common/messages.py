@@ -380,6 +380,19 @@ class GlobalStep(Message):
 
 
 @dataclasses.dataclass
+class CkptPerf(Message):
+    """Per-save flash-checkpoint timings (ISSUE 4): the worker's
+    save_to_memory stall feeds the master's goodput accounting — a
+    synchronous stall is lost train time even without a restart."""
+
+    node_id: int = 0
+    step: int = 0
+    stall_ms: float = 0.0
+    staged_mbps: float = 0.0
+    persist_mbps: float = 0.0
+
+
+@dataclasses.dataclass
 class UsedResource(Message):
     node_id: int = 0
     cpu_percent: float = 0.0

@@ -136,13 +136,22 @@ class _Client:
     def __init__(self, kind: str, name: str):
         self._path = socket_path(kind, name)
 
-    # Extra slack past the server-side op timeout: the server's own wait is
-    # bounded by the op timeout it receives, so with this margin it always
-    # answers before the client socket deadline — a reply is only lost on a
-    # real crash, never on a close race.
+    # Extra slack past the server-side op timeout: for ops the server may
+    # WAIT on (lock acquire, queue get) its wait is bounded by the op
+    # timeout it receives, so with this margin it always answers before
+    # the client socket deadline — a reply is only lost on a real crash,
+    # never on a close race.  Ops the server answers immediately (dict
+    # get/set) pass a small ``reply_margin`` instead: against a hung
+    # server whose kernel backlog still accepts connects, the margin IS
+    # the caller's real latency bound past its timeout, and 30s there
+    # defeats the short budgets the save path and scrape handler rely on.
     _REPLY_MARGIN = 30.0
 
-    def request(self, op: str, *args: Any, timeout: float = 60.0) -> Any:
+    def request(
+        self, op: str, *args: Any, timeout: float = 60.0,
+        reply_margin: Optional[float] = None,
+    ) -> Any:
+        margin = self._REPLY_MARGIN if reply_margin is None else reply_margin
         deadline = time.time() + timeout
         last: Optional[Exception] = None
         while True:
@@ -150,7 +159,7 @@ class _Client:
             try:
                 with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
                     s.settimeout(
-                        max(0.1, deadline - time.time()) + self._REPLY_MARGIN
+                        max(0.1, deadline - time.time()) + margin
                     )
                     s.connect(self._path)
                     _send_msg(s, [op, *args])
@@ -404,26 +413,39 @@ class SharedDictServer(LocalSocketServer):
 
 
 class SharedDict:
+    # Dict ops are answered immediately (no server-side wait), so the
+    # reply margin only needs to cover serialization/scheduling latency —
+    # a hung-but-accepting server then costs callers timeout+2s, not
+    # timeout+30s (the save path and metrics scrape pass timeout=2.0 and
+    # rely on that bound actually holding).
+    _REPLY_MARGIN = 2.0
+
     def __init__(self, name: str, create: bool = False):
         self.name = name
         self._server = SharedDictServer(name) if create else None
         self._client = _Client(SharedDictServer.KIND, name)
 
-    def set(self, key: str, value: Any) -> None:
-        self._client.request("set", key, value)
+    def set(self, key: str, value: Any, timeout: float = 60.0) -> None:
+        self._client.request("set", key, value, timeout=timeout,
+                             reply_margin=self._REPLY_MARGIN)
 
-    def get(self, key: str, default: Any = None) -> Any:
-        ok, val = self._client.request("get", key)
+    def get(self, key: str, default: Any = None,
+            timeout: float = 60.0) -> Any:
+        ok, val = self._client.request("get", key, timeout=timeout,
+                                       reply_margin=self._REPLY_MARGIN)
         return val if ok else default
 
-    def update(self, other: dict) -> None:
-        self._client.request("update", other)
+    def update(self, other: dict, timeout: float = 60.0) -> None:
+        self._client.request("update", other, timeout=timeout,
+                             reply_margin=self._REPLY_MARGIN)
 
-    def to_dict(self) -> dict:
-        return self._client.request("dict")
+    def to_dict(self, timeout: float = 60.0) -> dict:
+        return self._client.request("dict", timeout=timeout,
+                                    reply_margin=self._REPLY_MARGIN)
 
     def delete(self, key: str) -> None:
-        self._client.request("delete", key)
+        self._client.request("delete", key,
+                             reply_margin=self._REPLY_MARGIN)
 
     def close(self) -> None:
         if self._server:

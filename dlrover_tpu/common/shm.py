@@ -32,6 +32,7 @@ import msgpack
 import numpy as np
 
 from dlrover_tpu import chaos
+from dlrover_tpu.common.byte_audit import audit
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.native import shm_lib
 
@@ -389,16 +390,31 @@ class SharedMemoryArena:
     def read_state(
         self, copy: bool = True
     ) -> Optional[Tuple[Dict[str, np.ndarray], dict]]:
+        """Read the staged state.
+
+        ``copy=False`` returns **views into the live shm mapping** — the
+        flash-checkpoint zero-copy fast path.  Lifetime contract: the
+        views are valid only while (a) this arena object stays mapped (no
+        concurrent :meth:`reopen`/:meth:`close` — callers serialize on
+        their arena mutex) and (b) the writer is fenced out (the per-rank
+        SharedLock), since a concurrent :meth:`write_state` would rewrite
+        the bytes under them.  Use ``copy=True`` whenever the consumer
+        outlives those guarantees (e.g. the replica push, whose payload
+        is shipped after the lock is released)."""
         meta = self.metadata()
         if meta is None:
             return None
         out: Dict[str, np.ndarray] = {}
+        nbytes_total = 0
         for path, tm in meta["tensors"].items():
             dtype = np.dtype(tm["dtype"])
             n = tm["nbytes"]
             view = self._seg.buf[tm["offset"] : tm["offset"] + n]
             arr = view.view(dtype).reshape(tuple(tm["shape"]))
             out[path] = arr.copy() if copy else arr
+            nbytes_total += n
+        if copy:
+            audit.record_copy(nbytes_total, "arena_read_copy")
         return out, meta["extra"]
 
     def close(self, unlink: bool = False) -> None:

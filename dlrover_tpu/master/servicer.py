@@ -65,6 +65,7 @@ class MasterServicer:
             m.FaultNodeRequest: self._on_fault_nodes,
             m.StragglerRequest: self._on_stragglers,
             m.GlobalStep: self._on_global_step,
+            m.CkptPerf: self._on_ckpt_perf,
             m.UsedResource: self._on_used_resource,
             m.ModelInfo: self._on_model_info,
             m.DiagnosisReport: self._on_diagnosis_report,
@@ -266,6 +267,15 @@ class MasterServicer:
         if self.speed_monitor is not None:
             self.speed_monitor.collect_global_step(
                 msg.step, msg.timestamp or time.time()
+            )
+        return None
+
+    def _on_ckpt_perf(self, msg: m.CkptPerf):
+        if self.speed_monitor is not None:
+            self.speed_monitor.record_ckpt_stall(
+                msg.stall_ms / 1000.0, step=msg.step,
+                persist_mbps=msg.persist_mbps,
+                staged_mbps=msg.staged_mbps,
             )
         return None
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 from collections import deque
 
 from dlrover_tpu.common.global_context import get_context
@@ -33,6 +33,19 @@ class SpeedMonitor:
         self._downtime_total = 0.0
         self._down_since: Optional[float] = None
         self._sample_count = 0
+        # Synchronous checkpoint stalls (save_to_memory blocking the step
+        # loop): lost train time that never shows as a down window because
+        # steps keep flowing around it — folded into goodput separately.
+        # Ranks stall CONCURRENTLY for the same save, so per (save) step
+        # the total charges the worst rank's stall, not the N-rank sum;
+        # the per-step maxima live in a small insertion-ordered window so
+        # one rank's report straggling past the NEXT save's reports still
+        # dedups correctly (single-slot tracking double-counted there).
+        self._ckpt_stall_total = 0.0
+        self._ckpt_stall_last_ms = 0.0
+        self._ckpt_stall_by_step: Dict[int, float] = {}
+        self._ckpt_persist_mbps = 0.0
+        self._ckpt_staged_mbps = 0.0
 
     def collect_global_step(self, step: int, timestamp: float = 0.0) -> None:
         ts = timestamp or time.time()
@@ -62,6 +75,65 @@ class SpeedMonitor:
                 self._downtime_total += time.time() - self._down_since
                 self._down_since = None
 
+    def record_ckpt_stall(
+        self, seconds: float, step: Optional[int] = None,
+        persist_mbps: float = 0.0, staged_mbps: float = 0.0,
+    ) -> None:
+        """One worker-reported save_to_memory stall (CkptPerf message).
+        Not counted while already inside a down window — that time is
+        being charged to downtime already.  Reports from multiple ranks
+        for the SAME step describe one concurrent wall-clock stall, so
+        the total takes the per-step max, not the sum (a bounded window
+        of recent steps, tolerant of cross-step report interleaving).
+        ``seconds <= 0`` is a throughput-only report (the saver's
+        persist MB/s) and touches no stall bookkeeping."""
+        with self._lock:
+            if persist_mbps > 0.0:
+                self._ckpt_persist_mbps = persist_mbps
+            if staged_mbps > 0.0:
+                self._ckpt_staged_mbps = staged_mbps
+            if seconds <= 0.0:
+                return
+            self._ckpt_stall_last_ms = seconds * 1000.0
+            if self._down_since is not None:
+                return
+            if step is None:
+                self._ckpt_stall_total += seconds
+                return
+            prev = self._ckpt_stall_by_step.get(step)
+            if prev is None:
+                self._ckpt_stall_by_step[step] = seconds
+                self._ckpt_stall_total += seconds
+                while len(self._ckpt_stall_by_step) > 16:
+                    self._ckpt_stall_by_step.pop(
+                        next(iter(self._ckpt_stall_by_step))
+                    )
+            elif seconds > prev:
+                self._ckpt_stall_total += seconds - prev
+                self._ckpt_stall_by_step[step] = seconds
+
+    @property
+    def ckpt_persist_mbps(self) -> float:
+        """Last saver-reported shm->storage persist throughput."""
+        with self._lock:
+            return self._ckpt_persist_mbps
+
+    @property
+    def ckpt_staged_mbps(self) -> float:
+        """Last worker-reported worker->shm staging throughput."""
+        with self._lock:
+            return self._ckpt_staged_mbps
+
+    @property
+    def ckpt_stall_total(self) -> float:
+        with self._lock:
+            return self._ckpt_stall_total
+
+    @property
+    def ckpt_stall_last_ms(self) -> float:
+        with self._lock:
+            return self._ckpt_stall_last_ms
+
     @property
     def completed_global_step(self) -> int:
         with self._lock:
@@ -78,13 +150,17 @@ class SpeedMonitor:
             return (s1 - s0) / (t1 - t0)
 
     def goodput(self) -> float:
-        """useful-time / elapsed-time since first step (BASELINE.md metric)."""
+        """useful-time / elapsed-time since first step (BASELINE.md
+        metric).  Downtime covers restart/rendezvous windows; checkpoint
+        stalls (synchronous save_to_memory pauses reported per save) are
+        added on top — they steal train time without ever opening a down
+        window."""
         with self._lock:
             if self._first_step_time is None:
                 return 0.0
             now = time.time()
             elapsed = now - self._first_step_time
-            down = self._downtime_total
+            down = self._downtime_total + self._ckpt_stall_total
             if self._down_since is not None:
                 down += now - self._down_since
             if elapsed <= 0:

@@ -96,6 +96,50 @@ def test_flush_and_read_share_schema(tmp_path, monkeypatch):
     assert rec is not None and rec["mfu_pct"] == 51.0
 
 
+def test_ckpt_bench_smoke_schema(tmp_path):
+    """Tier-1 gate for ISSUE 4's checkpoint bench: the tiny config runs
+    end-to-end on CPU inside the 5s budget and emits schema-valid JSON —
+    before/after persist rows with the copy audit, the per-save stall
+    list, byte-identity and fsck flags, and the final metric line."""
+    import os
+    import subprocess
+    import time
+
+    out = tmp_path / "CKPT_BENCH_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(Path(bench.__file__)), "--ckpt_bench",
+         "--smoke", f"--out={out}"],
+        capture_output=True, text=True, timeout=60, env=env,
+        cwd=str(Path(bench.__file__).parent),
+    )
+    elapsed = time.time() - t0
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # <5s is the spec on an idle host; allow CI contention headroom but
+    # fail loudly if the smoke config ever becomes heavyweight.
+    assert elapsed < 20.0, f"smoke bench took {elapsed:.1f}s"
+    result = json.loads(out.read_text())
+    assert result["complete"] is True
+    assert result["byte_identical"] is True
+    assert result["fsck_clean_on_streamed"] is True
+    rows = {r["path"]: r for r in result["rows"]}
+    assert "before_pack_copy" in rows and "after_stream_w1" in rows
+    # The acceptance hook: legacy copies the state 3x; the streamed path
+    # does exactly one pass with zero intermediate copies.
+    assert rows["before_pack_copy"]["state_copies"] == 3.0
+    assert rows["after_stream_w1"]["state_copies"] == 0.0
+    assert rows["after_stream_w1"]["write_passes"] == 1
+    stalls = result["save_to_memory"]["stall_ms_per_save"]
+    assert len(stalls) >= 2 and all(s > 0 for s in stalls)
+    assert result["restore_mbps"] > 0
+    # Final stdout line is the standard bench metric record.
+    metric = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert metric["metric"] == "ckpt_persist_speedup"
+    assert metric["artifact"] == str(out)
+    assert isinstance(metric["value"], (int, float))
+
+
 def test_progress_handles_closed_after_measurement(tmp_path):
     """_progress_mark caches its handle for the timed window, but the
     cache must drain when the measurement completes — a long-lived

@@ -163,3 +163,32 @@ class TestIpcPrimitives:
             assert d.get("step") is None
         finally:
             d.close()
+
+    def test_shared_dict_timeout_bounds_hung_server(self):
+        """A hung stat server whose kernel backlog still ACCEPTS connects
+        must cost a short-timeout dict op ~timeout+2s (the dict reply
+        margin), not timeout+30s — the flash-ckpt save path and metrics
+        scrape pass timeout=2.0 and rely on the bound actually holding
+        (ISSUE 4 review finding)."""
+        import socket as _socket
+
+        from dlrover_tpu.common.multi_process import socket_path
+
+        path = socket_path("dict", "t-hung")
+        srv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        try:
+            srv.bind(path)
+            srv.listen(4)  # accepts into the backlog, never replies
+            d = SharedDict("t-hung")  # client only
+            t0 = time.time()
+            with pytest.raises((ConnectionError, TimeoutError, OSError)):
+                d.get("k", timeout=0.5)
+            assert time.time() - t0 < 5.0
+        finally:
+            srv.close()
+            import os as _os
+
+            try:
+                _os.unlink(path)
+            except OSError:
+                pass
