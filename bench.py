@@ -1639,13 +1639,322 @@ def ckpt_bench_main(argv: list) -> int:
     return 0 if result.get("complete") else 1
 
 
+def serve_bench_main(argv: list) -> int:
+    """Serving-fleet bench (ISSUE 5 acceptance artifact).
+
+    Drives ``dlrover_tpu.serving`` end to end on the CPU host: one
+    gateway, N tiny-llama replicas, a seeded Poisson request stream —
+    and records p50/p99 TTFT, request-latency percentiles, and
+    aggregate tokens/s at 1 vs 2+ replicas into
+    ``SERVE_BENCH_CPU.json``.
+
+    Replica rows run as SUBPROCESSES (each with its own jax runtime)
+    against the gateway's real gRPC port, so the measured path is the
+    wire path.  ``--device_round_ms`` (default 20) puts a latency floor
+    under every decode round, modelling the accelerator-bound regime:
+    on TPU the round's model time is off-host and N replicas' rounds
+    overlap; on this 1-core CI host pure-CPU decode compute cannot
+    overlap across processes, so the floor — a blocking sleep exactly
+    where the device future would block — is what makes the fleet-
+    scaling measurement about the CONTROL PLANE (admission, routing,
+    streaming, journal fsync) rather than about timesharing XLA-CPU.
+    ``--device_round_ms=0`` measures the raw timeshared regime.
+
+    Flags: ``--requests=N`` (24) ``--mnt=N`` (24 new tokens)
+    ``--slots=N`` (2 per replica) ``--rps=F`` (50 Poisson arrivals/s)
+    ``--replicas=1,2`` (rows) ``--device_round_ms=F`` (20)
+    ``--seed=N`` ``--out=PATH`` ``--smoke`` (tiny single-replica
+    in-process row for the tier-1 gate: loopback transport, no
+    subprocesses, no round floor).
+    """
+    import argparse
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+
+    t_start = time.perf_counter()
+    opts = {
+        "requests": 24, "mnt": 24, "slots": 2, "rps": 50.0,
+        "seed": 0, "device_round_ms": 20.0, "timeout": 300.0,
+    }
+    replicas_rows = [1, 2]
+    out_path = None
+    smoke = False
+    for a in argv:
+        if a == "--smoke":
+            smoke = True
+            opts.update(requests=5, mnt=6, device_round_ms=0.0,
+                        timeout=60.0)
+            replicas_rows = [1]
+        elif a.startswith("--out="):
+            out_path = a.split("=", 1)[1]
+        elif a.startswith("--replicas="):
+            replicas_rows = [
+                int(x) for x in a.split("=", 1)[1].split(",") if x
+            ]
+        elif "=" in a and a.startswith("--"):
+            k, v = a[2:].split("=", 1)
+            if k in opts:
+                opts[k] = type(opts[k])(v)
+    if not os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        ensure_live_backend()
+    import numpy as np
+
+    import jax
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.serving import (
+        Gateway,
+        GatewayConfig,
+        LoopbackTransport,
+        ServeClient,
+    )
+
+    backend = jax.default_backend()
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f"SERVE_BENCH_{'TPU' if backend == 'tpu' else 'CPU'}.json",
+        )
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cfg = llama.LlamaConfig.tiny(n_layer=2)
+    sys.path.insert(0, os.path.join(repo, "examples"))
+    import serve_common  # noqa: E402
+
+    prompts, _ = serve_common.seeded_requests(
+        cfg, opts["requests"], opts["seed"] + 1
+    )
+    arr_rng = np.random.RandomState(opts["seed"] + 7)
+    gaps = arr_rng.exponential(
+        1.0 / max(opts["rps"], 1e-6), size=opts["requests"]
+    )
+    result = {
+        "bench": "serve_fleet",
+        "backend": backend,
+        "model": {"layers": cfg.n_layer, "vocab": cfg.vocab_size,
+                  "dtype": "float32"},
+        "workload": {
+            "requests": opts["requests"],
+            "max_new_tokens": opts["mnt"],
+            "slots_per_replica": opts["slots"],
+            "poisson_rps": opts["rps"],
+            "seed": opts["seed"],
+        },
+        "device_round_ms": opts["device_round_ms"],
+        "note": (
+            "device_round_ms models the accelerator-bound regime: a "
+            "blocking per-round floor standing in for off-host device "
+            "time (on the 1-core CI host pure-CPU decode compute "
+            "timeshares instead of overlapping, which would measure "
+            "XLA-CPU scheduling, not the serving control plane); "
+            "device_round_ms=0 rows measure that raw regime"
+        ),
+        "rows": [],
+    }
+
+    def flush():
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+
+    def run_row(n_replicas: int) -> dict:
+        tmp = tempfile.mkdtemp(prefix="serve_bench_")
+        gw = Gateway(port=0, config=GatewayConfig(queue_cap=512))
+        gw.start()
+        procs = []
+        threads = []
+        runners = []
+        try:
+            if smoke:
+                # In-process loopback replica: the tier-1 gate must not
+                # pay subprocess jax imports.
+                fleet_args = argparse.Namespace(
+                    slots=opts["slots"], max_len=64,
+                    journal_dir=os.path.join(tmp, "j"),
+                    replica_id="r0", seed=opts["seed"],
+                    poll_interval=0.005, round_floor_ms=0.0,
+                )
+                sys.path.insert(0, os.path.join(repo, "examples"))
+                import llama_serve_fleet as fleet_mod
+                runner = fleet_mod.build_replica(
+                    fleet_args, LoopbackTransport(gw.handle)
+                )
+                runners.append(runner)
+                th = threading.Thread(target=runner.run, daemon=True)
+                th.start()
+                threads.append(th)
+            else:
+                env = dict(os.environ, JAX_PLATFORMS="cpu",
+                           PYTHONPATH=repo)
+                env.pop("DLROVER_TPU_FAULTS", None)
+                for i in range(n_replicas):
+                    log = open(os.path.join(tmp, f"r{i}.log"), "w")
+                    procs.append((subprocess.Popen(
+                        [sys.executable,
+                         os.path.join(repo, "examples",
+                                      "llama_serve_fleet.py"),
+                         "--role", "replica",
+                         "--gateway", f"127.0.0.1:{gw.port}",
+                         "--replica_id", f"r{i}",
+                         "--slots", str(opts["slots"]),
+                         "--max_len",
+                         str(16 + opts["mnt"] + 16),
+                         "--journal_dir", os.path.join(tmp, "j"),
+                         "--seed", str(opts["seed"]),
+                         "--poll_interval", "0.01",
+                         "--round_floor_ms",
+                         str(opts["device_round_ms"])],
+                        cwd=repo, env=env, stdout=log,
+                        stderr=subprocess.STDOUT,
+                    ), log))
+            deadline = time.time() + opts["timeout"]
+            while time.time() < deadline:
+                snap = gw.core.stats_snapshot()
+                if snap["replicas_alive"] >= n_replicas:
+                    break
+                time.sleep(0.2)
+            else:
+                raise TimeoutError(
+                    f"{n_replicas} replicas never registered"
+                )
+            client = ServeClient(LoopbackTransport(gw.handle),
+                                 poll_interval=0.01)
+            t0 = time.perf_counter()
+            for i, prompt in enumerate(prompts):
+                time.sleep(float(gaps[i]))
+                client.submit(f"b{n_replicas}-{i}", prompt,
+                              opts["mnt"])
+            completed = 0
+            total_new = 0
+            for i in range(opts["requests"]):
+                reply = client.result(
+                    f"b{n_replicas}-{i}",
+                    timeout=max(5.0, deadline - time.time()),
+                )
+                if reply.state == "done":
+                    completed += 1
+                    total_new += len(reply.tokens)
+            dt = max(time.perf_counter() - t0, 1e-9)
+            snap = gw.core.stats_snapshot()
+            return {
+                "replicas": n_replicas,
+                "completed": completed,
+                "new_tokens": total_new,
+                "tokens_per_sec": round(total_new / dt, 2),
+                "ttft_ms_p50": gw.ttft_ms.percentile(0.50),
+                "ttft_ms_p99": gw.ttft_ms.percentile(0.99),
+                "latency_ms_p50": gw.latency_ms.percentile(0.50),
+                "latency_ms_p99": gw.latency_ms.percentile(0.99),
+                "elapsed_s": round(dt, 2),
+                "rejected": snap["counters"]["rejected"],
+                "redispatched": snap["counters"]["redispatched"],
+                "duplicate_completions":
+                    snap["counters"]["duplicate_completions"],
+            }
+        finally:
+            for runner in runners:
+                gw.core.drain(runner.replica_id)
+            for rid in list(
+                gw.core.stats_snapshot()["replicas"]
+            ):
+                gw.core.drain(rid)
+            for th in threads:
+                th.join(timeout=30)
+            for proc, log in procs:
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+                log.close()
+            gw.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def run_rows(dest: list, label: str = "") -> None:
+        for n in replicas_rows:
+            try:
+                row = run_row(n)
+            except Exception as e:  # noqa: BLE001 - record the row
+                row = {"replicas": n,
+                       "error": f"{type(e).__name__}: {str(e)[:200]}"}
+            dest.append(row)
+            flush()
+            print(f"{label}replicas={n}: {row}", file=sys.stderr)
+
+    run_rows(result["rows"])
+
+    def _speedup(rows):
+        ok = [r for r in rows if "error" not in r]
+        by_n = {r["replicas"]: r for r in ok}
+        if 1 not in by_n or len(by_n) < 2:
+            return None, None
+        best_n = max(n for n in by_n if n > 1)
+        base = by_n[1]["tokens_per_sec"]
+        if base <= 0:
+            return None, None
+        return round(by_n[best_n]["tokens_per_sec"] / base, 2), best_n
+
+    if not smoke and opts["device_round_ms"] > 0:
+        # Honesty rows: the same fleet with NO round floor — the raw
+        # 1-core timeshared regime, where replica scaling measures
+        # XLA-CPU contention rather than the control plane.
+        result["raw_cpu_rows"] = []
+        saved_floor = opts["device_round_ms"]
+        opts["device_round_ms"] = 0.0
+        run_rows(result["raw_cpu_rows"], label="raw ")
+        opts["device_round_ms"] = saved_floor
+        raw_speedup, _ = _speedup(result["raw_cpu_rows"])
+        if raw_speedup is not None:
+            result["raw_speedup_multi_vs_single"] = raw_speedup
+
+    speedup, best_n = _speedup(result["rows"])
+    if speedup is not None:
+        result["speedup_multi_vs_single"] = speedup
+        result["speedup_replicas"] = best_n
+    else:
+        speedup = 0.0
+    main_ok = [r for r in result["rows"] if "error" not in r]
+    result["complete"] = (
+        len(main_ok) == len(replicas_rows)
+        and all(r["completed"] == opts["requests"] for r in main_ok)
+    )
+    result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+    flush()
+    print(json.dumps({
+        "metric": "serve_fleet_speedup",
+        "value": speedup,
+        "unit": "x_tokens_per_sec_vs_single_replica",
+        "vs_baseline": speedup,
+        "backend": backend,
+        "artifact": out_path,
+    }))
+    return 0 if result["complete"] else 1
+
+
+def _measure_one_cmd(argv: list) -> int:
+    if len(argv) != 1:
+        print("usage: bench.py --measure-one SPEC_PATH", file=sys.stderr)
+        return 2
+    return _measure_one_main(argv[0])
+
+
+#: Subcommand table: every bench registers here (satellite of ISSUE 5 —
+#: the tail-of-file if-chain made each new bench a copy-paste edit).
+SUBCOMMANDS = {
+    "--measure-one": _measure_one_cmd,
+    "--kernel_smoke": kernel_smoke_main,
+    "--spec_bench": spec_bench_main,
+    "--ckpt_bench": ckpt_bench_main,
+    "--serve_bench": serve_bench_main,
+}
+
+
+def dispatch(argv: list) -> int:
+    if argv and argv[0] in SUBCOMMANDS:
+        return SUBCOMMANDS[argv[0]](argv[1:])
+    return main()
+
+
 if __name__ == "__main__":
-    if len(sys.argv) == 3 and sys.argv[1] == "--measure-one":
-        sys.exit(_measure_one_main(sys.argv[2]))
-    if len(sys.argv) >= 2 and sys.argv[1] == "--kernel_smoke":
-        sys.exit(kernel_smoke_main(sys.argv[2:]))
-    if len(sys.argv) >= 2 and sys.argv[1] == "--spec_bench":
-        sys.exit(spec_bench_main(sys.argv[2:]))
-    if len(sys.argv) >= 2 and sys.argv[1] == "--ckpt_bench":
-        sys.exit(ckpt_bench_main(sys.argv[2:]))
-    sys.exit(main())
+    sys.exit(dispatch(sys.argv[1:]))

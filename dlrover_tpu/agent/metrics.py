@@ -10,6 +10,7 @@ checkpoint steps, host resource usage — for cluster scrapers.  Enabled by
 from __future__ import annotations
 
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
@@ -88,6 +89,134 @@ class StatSet:
 #: reports in the saver's stat SharedDict (the engines run in worker
 #: processes, so their in-memory ``perf_stats`` is invisible here).
 perf_stats = StatSet()
+
+
+class Histogram:
+    """Fixed-bucket latency histogram, thread-safe — the gateway's
+    request-latency / TTFT instrument (ISSUE 5).  Prometheus-shaped:
+    ``observe`` increments the first bucket whose upper bound holds the
+    value; ``percentile`` answers with that bucket's upper bound (the
+    standard conservative bucketed estimate), so p50/p95/p99 gauges are
+    O(buckets) at scrape time with no per-observation allocation."""
+
+    #: Default bounds in milliseconds: sub-ms through 30s.
+    DEFAULT_BUCKETS_MS = (
+        1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+        1000.0, 2000.0, 5000.0, 10000.0, 30000.0,
+    )
+
+    def __init__(self, buckets=DEFAULT_BUCKETS_MS,
+                 window_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._bounds = tuple(sorted(float(b) for b in buckets))
+        # RLock: _roll_locked re-takes it under the public methods'
+        # hold, keeping every state write lexically inside a lock.
+        self._lock = threading.RLock()
+        self._counts = [0] * (len(self._bounds) + 1)  # +inf tail
+        self._total = 0
+        self._sum = 0.0
+        #: ``window_s``: percentiles cover the current + previous
+        #: window only, instead of the process lifetime.  A signal that
+        #: drives CONTROL (the autoscaler's TTFT pressure) must decay:
+        #: a cumulative histogram ratchets — one bad cold-start period
+        #: keeps p95 above threshold ~forever and the fleet would scale
+        #: up and never back down.
+        self._window_s = window_s
+        self._clock = clock
+        self._epoch_start = clock()
+        self._prev_counts = [0] * (len(self._bounds) + 1)
+        self._prev_total = 0
+        self._prev_sum = 0.0
+
+    def _roll_locked(self) -> None:
+        with self._lock:  # re-entrant under the public methods' hold
+            if self._window_s is None:
+                return
+            now = self._clock()
+            elapsed = now - self._epoch_start
+            if elapsed < self._window_s:
+                return
+            fresh = [0] * (len(self._bounds) + 1)
+            if elapsed < 2 * self._window_s:
+                # Current window ages into "previous"; observations
+                # older than that fall out.
+                self._prev_counts = self._counts
+                self._prev_total = self._total
+                self._prev_sum = self._sum
+            else:
+                # Idle for 2+ windows: everything has aged out.
+                self._prev_counts = list(fresh)
+                self._prev_total = 0
+                self._prev_sum = 0.0
+            self._counts = fresh
+            self._total = 0
+            self._sum = 0.0
+            self._epoch_start = now
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = 0
+        for i, b in enumerate(self._bounds):  # noqa: B007
+            if v <= b:
+                break
+        else:
+            i = len(self._bounds)
+        with self._lock:
+            self._roll_locked()
+            self._counts[i] += 1
+            self._total += 1
+            self._sum += v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            self._roll_locked()
+            return self._total + self._prev_total
+
+    def mean(self) -> float:
+        with self._lock:
+            self._roll_locked()
+            total = self._total + self._prev_total
+            return (self._sum + self._prev_sum) / total if total else 0.0
+
+    def percentile(self, p: float) -> float:
+        """Upper bound of the bucket containing the p-quantile (p in
+        [0, 1]) over the covered span (lifetime, or the last 1-2
+        windows when ``window_s`` is set).  Values past the last bound
+        report that bound — the histogram saturates rather than
+        guessing at the tail."""
+        with self._lock:
+            self._roll_locked()
+            total = self._total + self._prev_total
+            if not total:
+                return 0.0
+            rank = p * total
+            seen = 0
+            for i in range(len(self._counts)):
+                c = self._counts[i] + self._prev_counts[i]
+                seen += c
+                if seen >= rank and c:
+                    return self._bounds[min(i, len(self._bounds) - 1)]
+            return self._bounds[-1]
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": float(self.count),
+            "mean": round(self.mean(), 3),
+            "p50": self.percentile(0.50),
+            "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
+        }
+
+    def register_gauges(self, registry: "MetricsRegistry",
+                        name: str) -> None:
+        """Expose count/p50/p95/p99 as ``<name>_*`` gauges."""
+        registry.gauge(f"{name}_count", lambda: float(self.count))
+        for q, label in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+            registry.gauge(
+                f"{name}_{label}_ms",
+                lambda q=q: self.percentile(q),
+            )
 
 
 class MetricsRegistry:

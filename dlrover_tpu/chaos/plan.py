@@ -54,6 +54,7 @@ EXIT_CKPT_BEFORE_COMMIT = 66
 EXIT_CKPT_AFTER_COMMIT = 67
 EXIT_WORKER_KILL = 77
 EXIT_MASTER_RESTART = 42
+EXIT_REPLICA_KILL = 78
 
 #: site name -> (kind, defaults).  Kinds: ``error`` (caller raises),
 #: ``latency`` (inject() sleeps), ``crash`` (inject() calls os._exit),
@@ -80,6 +81,15 @@ SITES: Dict[str, dict] = {
     "storage.truncate_shard": {"kind": "flag", "times": 1},
     "replica.torn_push": {"kind": "flag", "times": 1},
     "worker.kill": {"kind": "crash", "exit": EXIT_WORKER_KILL, "times": 1},
+    # Serving-fleet sites (ISSUE 5): kill a replica mid-stream, lose a
+    # granted request before the replica ever sees it (the gateway's
+    # poll-reconcile must re-dispatch), or slow one replica's rounds
+    # (the p95-TTFT signal the autoscaler steers on).
+    "serving.replica_kill": {
+        "kind": "crash", "exit": EXIT_REPLICA_KILL, "times": 1,
+    },
+    "serving.drop_request": {"kind": "flag", "times": 1},
+    "serving.slow_replica": {"kind": "latency", "delay": 0.5},
     "master.restart": {
         "kind": "crash", "exit": EXIT_MASTER_RESTART, "times": 1,
     },

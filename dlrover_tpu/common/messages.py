@@ -525,6 +525,143 @@ class ReplicaData(Message):
 
 
 # ---------------------------------------------------------------------------
+# Serving fleet (gateway <-> clients, gateway <-> replicas; ISSUE 5).
+# The reference has no serving control plane at all (its RL stack shells
+# out to an unsupervised vllm, atorch/rl/model_engine/model_engine.py:35);
+# these messages are the typed wire surface of dlrover_tpu.serving.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ServeSubmit(Message):
+    """Client -> gateway: one inference request.  ``req_id`` doubles as
+    the idempotency token (BoundedTokenCache dedupe): a retried submit
+    of a completed request returns the cached result instead of
+    decoding twice."""
+
+    req_id: str = ""
+    prompt: List[int] = dataclasses.field(default_factory=list)
+    max_new_tokens: int = 16
+    deadline_s: float = 0.0  # 0 = no per-request deadline
+
+
+@dataclasses.dataclass
+class ServeAck(Message):
+    """Gateway's immediate answer to a submit: ``accepted`` (queued),
+    ``rejected`` with an explicit ``retry_after_s`` (bounded-queue
+    backpressure: the client backs off instead of the queue growing
+    without bound), or a terminal state from the dedupe cache —
+    ``done`` (tokens included), ``failed``, or ``timeout`` (the req_id
+    is the idempotency key; retry a failure under a fresh id)."""
+
+    req_id: str = ""
+    status: str = "accepted"  # accepted | done | rejected
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    retry_after_s: float = 0.0
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class ServeStatusRequest(Message):
+    req_id: str = ""
+
+
+@dataclasses.dataclass
+class ServeStatusReply(Message):
+    """``state``: queued | running | done | failed | timeout | unknown.
+    ``tokens`` carries the streamed-so-far prefix while running and the
+    full completion once done."""
+
+    req_id: str = ""
+    state: str = "unknown"
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    replica: str = ""
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class ServeReplicaRegister(Message):
+    replica_id: str = ""
+    slots: int = 0
+
+
+@dataclasses.dataclass
+class ServeReplicaDeregister(Message):
+    replica_id: str = ""
+
+
+@dataclasses.dataclass
+class ServeReplicaPoll(Message):
+    """Replica -> gateway heartbeat + work pull.  ``active`` lists every
+    req_id the replica currently owns (pending + in-flight) so the
+    gateway can reconcile lost grants; ``stats`` carries slot occupancy
+    / queue depth / TTFT / tokens-per-second / speculative acceptance
+    for the fleet gauges and the autoscaler."""
+
+    replica_id: str = ""
+    free_slots: int = 0
+    active: List[str] = dataclasses.field(default_factory=list)
+    stats: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ServeGrants(Message):
+    """Gateway -> replica poll reply: new work, cancellations (deadline
+    expiries — the replica drops them from its pending queue, or sheds
+    the slot mid-decode via ``DecodeServer.abort``), the drain flag
+    (stop admitting, finish in-flight, deregister), and ``known``
+    (False = the gateway restarted and lost this replica — re-register)."""
+
+    requests: List[ServeSubmit] = dataclasses.field(default_factory=list)
+    cancel: List[str] = dataclasses.field(default_factory=list)
+    drain: bool = False
+    known: bool = True
+
+
+@dataclasses.dataclass
+class ServeTokens(Message):
+    """Replica -> gateway: streamed tokens for one in-flight request
+    (batched per poll round — the burst size is the dispatch batching
+    the decode paths buy throughput with)."""
+
+    replica_id: str = ""
+    req_id: str = ""
+    tokens: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ServeDone(Message):
+    """Replica -> gateway: terminal completion report.  Idempotent: the
+    gateway dedupes by req_id, so a journal replay after a replica kill
+    (``replayed=True``) or a re-dispatch race can never complete a
+    request twice."""
+
+    replica_id: str = ""
+    req_id: str = ""
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    ok: bool = True
+    reason: str = ""
+    replayed: bool = False
+
+
+@dataclasses.dataclass
+class ServeDrainRequest(Message):
+    """Operator/autoscaler -> gateway: drain one replica (scale-down)."""
+
+    replica_id: str = ""
+
+
+@dataclasses.dataclass
+class ServeFleetStatsRequest(Message):
+    pass
+
+
+@dataclasses.dataclass
+class ServeFleetStats(Message):
+    stats: dict = dataclasses.field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
 # Embedding store service (PS analogue; reference tfplus KvVariable serving)
 # ---------------------------------------------------------------------------
 

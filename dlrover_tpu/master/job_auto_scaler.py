@@ -174,13 +174,154 @@ class EmbeddingStoreAutoScaler(JobAutoScaler):
             pass  # resize handled reactively via OOM recovery plans today
 
 
+class ServingFleetAutoScaler(JobAutoScaler):
+    """Replica-count adjustment for a SERVING fleet (ISSUE 5): the
+    training scalers steer on speed history; this one steers on the
+    gateway's live load signals (queue depth per replica, p95 TTFT,
+    slot occupancy) via the pure policy in
+    ``dlrover_tpu.serving.autoscale``.
+
+    Scale-up asks the job manager for more replica workers (the same
+    supervision tree that backfills training workers launches them;
+    each new replica registers with the gateway on boot).  Scale-down
+    is DRAIN-FIRST: the gateway stops admitting to the least-loaded
+    replica, in-flight requests finish, the replica deregisters — only
+    then does the job manager release the worker, so no request ever
+    observes the shrink."""
+
+    def __init__(
+        self,
+        job_args: JobArgs,
+        job_manager: DistributedJobManager,
+        gateway,  # GatewayCore-shaped: stats_snapshot/pick_drain_victim/drain
+        policy=None,
+        interval: Optional[float] = None,
+    ):
+        from dlrover_tpu.serving.autoscale import ScalePolicy, ScaleState
+
+        self._job_args = job_args
+        self._job_manager = job_manager
+        self._gateway = gateway
+        group = job_args.workers
+        self._policy = policy or ScalePolicy(
+            min_replicas=max(1, group.min_count),
+            max_replicas=max(group.max_count, 1),
+        )
+        self._state = ScaleState()
+        self._interval = interval or get_context().scale_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: In-progress two-phase scale-down: (victim replica id, target
+        #: worker count).  The manager's count is lowered ONLY after
+        #: the drained replica has deregistered and its worker exit is
+        #: reaped — an immediate scale_workers_to would kill the
+        #: HIGHEST-RANK live worker (dist_job_manager shrink order),
+        #: which is generally NOT the replica the gateway is draining.
+        self._pending_drain: Optional[tuple] = None
+
+    def _live_workers(self) -> int:
+        return len(self._job_manager.alive_workers()) + len(
+            self._job_manager.pending_workers()
+        )
+
+    def scale_once(self) -> int:
+        """One decision pass; returns the applied worker delta."""
+        from dlrover_tpu.serving import autoscale
+
+        snap = self._gateway.stats_snapshot()
+        alive = max(1, int(snap.get("replicas_alive", 1)))
+        live = self._live_workers()
+        if self._pending_drain is not None:
+            # Phase B of a scale-down: hold every decision until the
+            # drained victim has left the gateway AND its worker exit
+            # has been reaped; only then lower the manager's target —
+            # at that point it is pure bookkeeping (delta >= 0, no live
+            # worker is ever killed), it just stops the backfill.
+            victim, target = self._pending_drain
+            if victim in snap.get("replicas", {}) or live > target:
+                return 0
+            self._pending_drain = None
+            logger.info(
+                "serving auto-scaler: drain of %s complete; worker "
+                "target -> %d", victim, target,
+            )
+            self._job_manager.scale_workers_to(target)
+            return 0
+        target = autoscale.decide(snap, self._policy, self._state)
+        if target > alive:
+            if live > alive:
+                # Workers beyond the registered replicas are still
+                # warming up (registration follows the jit warmup):
+                # capacity is already on its way, and an absolute
+                # scale_workers_to computed from gateway-registered
+                # counts could even KILL a warming worker.
+                logger.info(
+                    "serving auto-scaler: pressure with %d worker(s) "
+                    "still warming (%d live, %d registered); holding",
+                    live - alive, live, alive,
+                )
+                return 0
+            logger.info(
+                "serving auto-scaler: growing replicas %d -> %d "
+                "(queue=%s)", alive, target, snap.get("queue_depth"),
+            )
+            return self._job_manager.scale_workers_to(target)
+        if target < alive:
+            victim = self._gateway.pick_drain_victim()
+            if victim is None:
+                return 0
+            logger.info(
+                "serving auto-scaler: draining replica %s (%d -> %d)",
+                victim, alive, target,
+            )
+            self._gateway.drain(victim)
+            self._pending_drain = (victim, target)
+        return 0
+
+    def start_auto_scaling(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="serving-auto-scaler",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def stop_auto_scaling(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.scale_once()
+            except Exception:
+                logger.exception("serving auto-scale pass failed")
+
+
 def new_job_auto_scaler(
     job_args: JobArgs,
     job_manager: DistributedJobManager,
     speed_monitor: SpeedMonitor,
     resource_optimizer: Optional[ResourceOptimizer] = None,
+    serving_gateway=None,
 ) -> JobAutoScaler:
-    """Factory (reference ``new_job_auto_scaler :41``)."""
+    """Factory (reference ``new_job_auto_scaler :41``).  A serving job
+    (``distribution_strategy == "serving"``) needs the gateway handle —
+    its scaler steers on live admission-queue signals, not speed.
+    Without one (today's dist_master does not wire a gateway) the job
+    still boots: it falls back to the training scaler with a loud
+    error, rather than crashing the master at startup."""
+    if job_args.distribution_strategy == "serving":
+        if serving_gateway is None:
+            logger.error(
+                "serving-strategy job has no gateway wired into the "
+                "master (pass new_job_auto_scaler(serving_gateway=...)"
+                "); falling back to the speed-based training scaler — "
+                "queue/TTFT-driven serving autoscale is DISABLED"
+            )
+        else:
+            return ServingFleetAutoScaler(
+                job_args, job_manager, serving_gateway
+            )
     if job_args.distribution_strategy == "embedding":
         return EmbeddingStoreAutoScaler(
             job_args, job_manager, resource_optimizer

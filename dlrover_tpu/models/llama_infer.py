@@ -15,7 +15,10 @@ O(S^2)-per-token full recompute.
 
 from __future__ import annotations
 
+import collections
 import functools
+import threading
+import time
 
 from typing import Any, Dict, Optional, Tuple
 
@@ -1089,6 +1092,19 @@ class DecodeServer:
         # degenerate — identical noise each step collapses samples into
         # short loops).
         self._rng = jax.random.PRNGKey(seed)
+        # Incremental admission surface (ISSUE 5): ``submit`` enqueues
+        # (rid, prompt, max_new_tokens) and the serve loop admits from
+        # this deque as slots free — the fleet replica feeds gateway
+        # grants in while decoding, instead of handing the full prompt
+        # list up front.  The lock makes submit/cancel safe from a
+        # second thread, though the fleet runner is single-threaded.
+        self._pending: "collections.deque" = collections.deque()
+        self._pending_mu = threading.Lock()
+        self._abort_rids: set = set()
+        # Live views for the replica runner's poll report (valid while
+        # a serve loop runs; empty otherwise).
+        self._live_active: Any = None
+        self._live_slot_req: Any = None
 
         def step(params, cache, toks, active, sub):
             logits, new_cache = forward_step(
@@ -1134,6 +1150,97 @@ class DecodeServer:
             f"prompt of {n} tokens exceeds largest bucket "
             f"{self.buckets[-1]}"
         )
+
+    def _write_slack(self) -> int:
+        """Cache-write headroom past the emission budget: speculative
+        rounds overshoot by up to draft_k+1 slots before the rewind;
+        chunked decode writes up to decode_chunk-1 slots past a
+        mid-chunk finish.  An out-of-range scatter is silently DROPPED
+        by JAX, so every capacity check must include this."""
+        return (
+            (self.draft_k + 1) if self.draft is not None
+            else self.decode_chunk - 1
+        )
+
+    def check_capacity(self, prompt_len: int, max_new_tokens: int,
+                       prefix_len: int = 0) -> None:
+        """Raise ValueError if a request of this shape could ever write
+        past ``max_len`` (shared by serve()'s upfront sweep and
+        submit()'s per-request admission check)."""
+        need = prefix_len + prompt_len + max_new_tokens + \
+            self._write_slack()
+        if need > self.max_len:
+            raise ValueError(
+                (f"prefix {prefix_len} + " if prefix_len else "")
+                + f"prompt {prompt_len} + max_new_tokens "
+                f"{max_new_tokens} + headroom {self._write_slack()} "
+                f"= {need} exceeds max_len {self.max_len}"
+            )
+
+    def submit(self, rid, prompt, max_new_tokens: int) -> None:
+        """Enqueue one request for incremental admission: the running
+        serve loop (``serve_incremental``) admits it the next time a
+        slot frees.  ``rid`` is the caller's request key (any hashable
+        — the fleet uses gateway request-id strings).  Raises
+        ValueError immediately if the request can never fit."""
+        p = np.asarray(prompt, np.int32)
+        self.check_capacity(len(p), max_new_tokens)
+        with self._pending_mu:
+            self._pending.append((rid, p, int(max_new_tokens)))
+
+    def cancel(self, rid) -> bool:
+        """Drop a not-yet-admitted request (deadline expiry at the
+        gateway).  Returns False when ``rid`` is unknown or already
+        decoding — in-flight work is never interrupted."""
+        with self._pending_mu:
+            for i, item in enumerate(self._pending):
+                if item[0] == rid:
+                    del self._pending[i]
+                    return True
+        return False
+
+    def abort(self, rid) -> bool:
+        """Mid-decode load shedding (gateway deadline expiry): a
+        pending ``rid`` is dropped immediately; an ACTIVE one is freed
+        at the loop's next admission point, its partial output
+        discarded — no ``on_finish``, no results entry, the slot
+        re-admits.  Returns False for an unknown (or already finished)
+        rid."""
+        if self.cancel(rid):
+            return True
+        if rid in self.active_rids():
+            with self._pending_mu:
+                self._abort_rids.add(rid)
+            return True
+        return False
+
+    def _pop_pending(self):
+        with self._pending_mu:
+            return self._pending.popleft() if self._pending else None
+
+    def pending_count(self) -> int:
+        with self._pending_mu:
+            return len(self._pending)
+
+    def pending_rids(self) -> list:
+        with self._pending_mu:
+            return [item[0] for item in self._pending]
+
+    def active_rids(self) -> list:
+        """Request ids currently decoding in slots (live only while a
+        serve loop runs)."""
+        act, req = self._live_active, self._live_slot_req
+        if act is None or req is None:
+            return []
+        return [req[s] for s in range(self.slots) if act[s]]
+
+    def free_slots(self) -> int:
+        """Slots a new admission could use right now: total minus
+        decoding minus already-queued — the load signal the replica
+        reports to the gateway's least-loaded router."""
+        act = self._live_active
+        busy = int(act.sum()) if act is not None else 0
+        return max(0, self.slots - busy - self.pending_count())
 
     @staticmethod
     def _slot_subcache(cache: Dict, s) -> list:
@@ -1251,12 +1358,6 @@ class DecodeServer:
         FLOPs per request."""
         import numpy as onp
 
-        # Telemetry contract: last_stats describes THIS call for every
-        # decode path (stale stats from a previous speculative serve
-        # must not survive into a plain one).
-        self.last_stats = {}
-        cfg = self.cfg
-        B = self.slots
         prefix = None
         if shared_prefix is not None:
             prefix = onp.asarray(shared_prefix, onp.int32)
@@ -1264,53 +1365,65 @@ class DecodeServer:
                 raise ValueError(
                     "shared_prefix must be a non-empty 1-D token array"
                 )
-        queue = list(enumerate(prompts))[::-1]  # pop() admits in order
-        results: Dict[int, Any] = {}
-        cache = init_cache(cfg, B, self.max_len,
-                           quant_kv=self.quant_kv, ring=False)
-        cache = dict(cache, offset=jnp.zeros((B,), jnp.int32))
-        cache_d = None
-        if self.draft is not None:
-            cache_d = init_cache(self.draft[1], B, self.max_len,
-                                 quant_kv=self.quant_kv, ring=False)
-            cache_d = dict(cache_d, offset=jnp.zeros((B,), jnp.int32))
-        toks = jnp.zeros((B,), jnp.int32)
-        active = onp.zeros((B,), bool)
-        slot_req = [-1] * B  # request id per slot
-        slot_out: list = [None] * B
-        budget = [0] * B
-        # Per-slot offset bound (speculative rounds clamp finishing
-        # rows here; see _spec_decode_round's max_off).
-        slot_bound = onp.zeros((B,), onp.int64)
-
-        # Capacity: every write slot a request will ever touch must fit
-        # the cache — an out-of-range scatter is silently DROPPED by
-        # JAX and would emit a plausible-but-wrong continuation.
-        # Speculative rounds overshoot by up to draft_k+1 slots before
-        # the rewind; chunked decode writes up to decode_chunk-1 slots
-        # past a mid-chunk EOS/budget finish — the capacity check must
-        # include that headroom.
-        slack = (
-            (self.draft_k + 1) if self.draft is not None
-            else self.decode_chunk - 1
-        )
         P0 = 0 if prefix is None else len(prefix)
         for rid, prompt in enumerate(prompts):
-            need = P0 + len(prompt) + max_new_tokens + slack
-            if need > self.max_len:
-                raise ValueError(
-                    f"request {rid}: "
-                    + (f"prefix {P0} + " if P0 else "")
-                    + f"prompt {len(prompt)} + "
-                    f"max_new_tokens {max_new_tokens} + headroom "
-                    f"{slack} = {need} exceeds max_len {self.max_len}"
+            try:
+                self.check_capacity(len(prompt), max_new_tokens, P0)
+            except ValueError as e:
+                raise ValueError(f"request {rid}: {e}") from None
+        with self._pending_mu:
+            if self._pending:
+                # serve() and the incremental surface are exclusive
+                # modes: silently clearing would DROP submitted
+                # requests with no error and no on_finish.  (Checked
+                # BEFORE the prefix-template prefill below — the error
+                # must be immediate and free, not after seconds of
+                # discarded XLA work.)
+                raise RuntimeError(
+                    f"serve() cannot run with {len(self._pending)} "
+                    "incremental submission(s) queued; drain or "
+                    "cancel them first (serve()/serve_incremental "
+                    "are exclusive modes)"
                 )
+        templates = self._build_prefix_templates(prefix, prompts)
+        with self._pending_mu:
+            for rid, prompt in enumerate(prompts):
+                self._pending.append(
+                    (rid, onp.asarray(prompt, onp.int32),
+                     int(max_new_tokens))
+                )
+        results = self._run(
+            on_finish=on_finish, on_token=on_token,
+            prefix=prefix, templates=templates,
+        )
+        return [results[i] for i in range(len(prompts))]
 
-        # Prefix templates: the shared prefix prefilled ONCE per model
-        # into a 1-row cache with the server's row length, so admission
-        # can copy whole slot rows (zeros beyond P0 included — the copy
-        # doubles as the fresh-slot zeroing).
+    def serve_incremental(self, tick=None, on_finish=None,
+                          on_token=None, idle_wait: float = 0.002):
+        """Serve requests fed in by :meth:`submit` — the fleet
+        replica's decode loop (ISSUE 5).  ``tick()`` is called once per
+        loop iteration (the admission point): the replica runner polls
+        the gateway there, submits new grants, flushes token streams
+        and reports completions.  Returning ``False`` from ``tick``
+        drains the loop — in-flight and already-submitted requests
+        finish, then the call returns (the scale-down contract: no
+        admitted request ever observes the shrink).  With no pending or
+        active work the loop idles at ``idle_wait`` granularity until
+        ``tick`` stops it.  Completions are delivered via ``on_finish``
+        ONLY (the batch-mode result dict is not retained — it would
+        grow without bound over a replica's lifetime); returns {}."""
+        return self._run(
+            on_finish=on_finish, on_token=on_token,
+            prefix=None, templates={}, tick=tick, idle_wait=idle_wait,
+        )
+
+    def _build_prefix_templates(self, prefix, prompts) -> Dict[str, Any]:
+        """Prefix templates: the shared prefix prefilled ONCE per model
+        into a 1-row cache with the server's row length, so admission
+        can copy whole slot rows (zeros beyond P0 included — the copy
+        doubles as the fresh-slot zeroing)."""
         templates: Dict[str, Any] = {}
+        P0 = 0 if prefix is None else len(prefix)
         if prefix is not None and any(
             P0 + len(p) > self.buckets[-1] for p in prompts
         ):
@@ -1318,7 +1431,7 @@ class DecodeServer:
             # admission scratch-prefills and the template would be
             # built for nothing)
             pref_dev = jnp.asarray(prefix)[None, :]
-            roles = [("t", self.params, cfg)]
+            roles = [("t", self.params, self.cfg)]
             if self.draft is not None:
                 roles.append(("d", self.draft[0], self.draft[1]))
             for role, mparams, mcfg in roles:
@@ -1342,6 +1455,43 @@ class DecodeServer:
                     self._prefill_jit[jkey] = jax.jit(fn)
                 tc = self._prefill_jit[jkey](mparams, pref_dev, tc)
                 templates[role] = tc["layers"]
+        return templates
+
+    def _run(self, on_finish=None, on_token=None, prefix=None,
+             templates=None, tick=None, idle_wait: float = 0.002):
+        """The decode loop shared by :meth:`serve` (batch mode: the
+        pending queue is pre-filled and runs to drain) and
+        :meth:`serve_incremental` (``tick`` feeds the queue while the
+        loop runs).  Admission draws from ``self._pending``; every
+        request carries its OWN max_new_tokens budget."""
+        import numpy as onp
+
+        # Telemetry contract: last_stats describes THIS call for every
+        # decode path (stale stats from a previous speculative serve
+        # must not survive into a plain one).
+        self.last_stats = {}
+        cfg = self.cfg
+        B = self.slots
+        templates = templates or {}
+        P0 = 0 if prefix is None else len(prefix)
+        results: Dict[Any, Any] = {}
+        cache = init_cache(cfg, B, self.max_len,
+                           quant_kv=self.quant_kv, ring=False)
+        cache = dict(cache, offset=jnp.zeros((B,), jnp.int32))
+        cache_d = None
+        if self.draft is not None:
+            cache_d = init_cache(self.draft[1], B, self.max_len,
+                                 quant_kv=self.quant_kv, ring=False)
+            cache_d = dict(cache_d, offset=jnp.zeros((B,), jnp.int32))
+        toks = jnp.zeros((B,), jnp.int32)
+        active = onp.zeros((B,), bool)
+        slot_req: list = [None] * B  # request id per slot
+        slot_prompt: list = [None] * B  # prefix+prompt per slot
+        slot_out: list = [None] * B
+        budget = [0] * B
+        # Per-slot offset bound (speculative rounds clamp finishing
+        # rows here; see _spec_decode_round's max_off).
+        slot_bound = onp.zeros((B,), onp.int64)
 
         def copy_template(c, slot, role):
             """Slot rows := template rows (one dynamic_update_slice per
@@ -1439,10 +1589,10 @@ class DecodeServer:
                 jnp.asarray(n, jnp.int32), key,
             )
 
-        def admit(slot):
-            rid, prompt = queue.pop()
-            prompt = onp.asarray(prompt, onp.int32)
+        def admit(slot, item):
+            rid, prompt, mnt = item
             if prefix is not None:
+                # Output contract matches serve([prefix + p ...]).
                 prompt = onp.concatenate([prefix, prompt])
             n = len(prompt)
             # Short combined prompts fit one bucketed prefill anyway —
@@ -1459,11 +1609,12 @@ class DecodeServer:
                     self.draft[1], "d", use_template=use_tmpl,
                 )
             toks = toks.at[slot].set(first.astype(toks.dtype))
-            slot_bound[slot] = n + max_new_tokens
+            slot_bound[slot] = n + mnt
             active[slot] = True
             slot_req[slot] = rid
+            slot_prompt[slot] = prompt
             slot_out[slot] = [int(first)]
-            budget[slot] = max_new_tokens - 1
+            budget[slot] = mnt - 1
             if on_token is not None:
                 on_token(rid, int(first))
             if int(first) == self.eos_token or budget[slot] <= 0:
@@ -1471,17 +1622,21 @@ class DecodeServer:
 
         def finish(slot):
             rid = slot_req[slot]
-            prompt = onp.asarray(prompts[rid], onp.int32)
-            if prefix is not None:
-                # Output contract matches serve([prefix + p ...]).
-                prompt = onp.concatenate([prefix, prompt])
-            results[rid] = onp.concatenate(
-                [prompt, onp.asarray(slot_out[slot], onp.int32)]
+            out = onp.concatenate(
+                [slot_prompt[slot], onp.asarray(slot_out[slot], onp.int32)]
             )
+            if tick is None:
+                # Batch mode returns the result dict; the incremental
+                # loop delivers via on_finish ONLY — retaining every
+                # completion would grow without bound for the life of
+                # a fleet replica.
+                results[rid] = out
             active[slot] = False
-            slot_req[slot] = -1
+            slot_req[slot] = None
+            slot_prompt[slot] = None
+            slot_out[slot] = None
             if on_finish is not None:
-                on_finish(rid, results[rid])
+                on_finish(rid, out)
 
         def emit_rows(rows):
             """THE per-slot emit/finish law, shared by every decode
@@ -1526,11 +1681,67 @@ class DecodeServer:
                 cfg, self.draft[1], cur_k, self.temperature,
                 self.top_k, self.top_p,
             )
-        while queue or active.any():
+
+        def publish_stats():
+            """Refresh ``last_stats`` from the running counters —
+            called every loop iteration so an incremental tick (the
+            fleet replica's poll) reports LIVE telemetry, not the
+            previous call's final numbers."""
+            if self.draft is not None:
+                self.last_stats = {
+                    "rounds": spec_rounds,
+                    "active_row_rounds": spec_row_rounds,
+                    "accepted_tokens": spec_tokens,
+                    "tokens_per_round": (
+                        spec_tokens / spec_row_rounds
+                        if spec_row_rounds else 0.0
+                    ),
+                    "k_final": cur_k,
+                    "k_history": k_history,
+                }
+            else:
+                self.last_stats = {
+                    "path": ("decode_chunk" if self.decode_chunk > 1
+                             else "plain"),
+                    "rounds": plain_rounds,
+                    "emitted_tokens": plain_tokens,
+                    "tokens_per_round": (
+                        plain_tokens / plain_rounds
+                        if plain_rounds else 0.0
+                    ),
+                }
+
+        self._live_active = active
+        self._live_slot_req = slot_req
+        while True:
+            publish_stats()
+            keep = True
+            if tick is not None:
+                keep = tick() is not False
+            if self._abort_rids:
+                with self._pending_mu:
+                    doomed, self._abort_rids = self._abort_rids, set()
+                for s in range(B):
+                    if active[s] and slot_req[s] in doomed:
+                        # Shed the slot: partial output discarded, no
+                        # on_finish; admission re-zeros the rows.
+                        active[s] = False
+                        slot_req[s] = None
+                        slot_prompt[s] = None
+                        slot_out[s] = None
             for s in range(B):
-                if not active[s] and queue:
-                    admit(s)
+                if not active[s]:
+                    item = self._pop_pending()
+                    if item is None:
+                        break
+                    admit(s, item)
             if not active.any():
+                if self.pending_count() == 0:
+                    if tick is None or not keep:
+                        break
+                    # Idle incremental loop: nothing to decode until
+                    # the next tick feeds the queue.
+                    time.sleep(idle_wait)
                 continue
             if self.draft is not None:
                 # Speculative round over ALL slots: each drafts k, one
@@ -1589,30 +1800,10 @@ class DecodeServer:
             toks = nxt
             plain_rounds += 1
             plain_tokens += emit_rows(onp.asarray(nxt)[:, None])
-        if self.draft is not None:
-            self.last_stats = {
-                "rounds": spec_rounds,
-                "active_row_rounds": spec_row_rounds,
-                "accepted_tokens": spec_tokens,
-                "tokens_per_round": (
-                    spec_tokens / spec_row_rounds
-                    if spec_row_rounds else 0.0
-                ),
-                "k_final": cur_k,
-                "k_history": k_history,
-            }
-        else:
-            self.last_stats = {
-                "path": ("decode_chunk" if self.decode_chunk > 1
-                         else "plain"),
-                "rounds": plain_rounds,
-                "emitted_tokens": plain_tokens,
-                "tokens_per_round": (
-                    plain_tokens / plain_rounds
-                    if plain_rounds else 0.0
-                ),
-            }
-        return [results[i] for i in range(len(prompts))]
+        self._live_active = None
+        self._live_slot_req = None
+        publish_stats()
+        return results
 
 
 def serve_journaled(

@@ -1,0 +1,37 @@
+"""Elastic multi-replica inference control plane (ISSUE 5).
+
+The reference has no serving control plane at all — its RL stack shells
+out to an unsupervised vllm (``atorch/rl/model_engine/model_engine.py:35``).
+This package composes what the repo already owns into one elastic
+inference service:
+
+- :mod:`dlrover_tpu.serving.gateway` — typed-RPC front door: bounded
+  admission queue with explicit backpressure, least-loaded routing,
+  per-request deadlines, request-id dedupe (exactly-once completion
+  across replica kills and re-dispatch).
+- :mod:`dlrover_tpu.serving.replica` — the long-lived worker loop that
+  feeds gateway grants into a continuous-batching ``DecodeServer`` as
+  slots free, streams tokens back, journals completions, and reports
+  occupancy / TTFT / tokens-per-second.
+- :mod:`dlrover_tpu.serving.autoscale` — queue-depth and p95-TTFT
+  driven replica-count policy with drain-aware scale-down (no request
+  ever observes the shrink).
+
+Imports stay lazy: the gateway and autoscaler are pure control plane
+(no jax); only the replica touches the model stack.
+"""
+
+from dlrover_tpu.serving.autoscale import (  # noqa: F401
+    ScalePolicy,
+    ScaleState,
+    ServeAutoScaler,
+    decide,
+)
+from dlrover_tpu.serving.gateway import (  # noqa: F401
+    Gateway,
+    GatewayConfig,
+    GatewayCore,
+    LoopbackTransport,
+    ServeClient,
+)
+from dlrover_tpu.serving.replica import ReplicaRunner  # noqa: F401

@@ -1,0 +1,167 @@
+"""Queue-driven serving autoscale: replica count from load signals.
+
+The policy is a PURE function (:func:`decide`) over a gateway stats
+snapshot — queue depth per alive replica, p95 TTFT, slot occupancy —
+with hysteresis carried in an explicit :class:`ScaleState`, so the
+arithmetic is unit-testable without threads, RPC, or models (the shape
+``master/job_auto_scaler.py`` uses for training: signals in, target
+count out, actuation elsewhere).
+
+Scale-up triggers on pressure (deep queue OR slow p95 TTFT) sustained
+for ``up_patience`` consecutive passes; scale-down on sustained idleness
+(shallow queue AND low occupancy) for ``down_patience`` passes —
+asymmetric patience because adding a replica is cheap and shedding one
+mid-burst is not.  Scale-down is DRAIN-AWARE: the actuator
+(:class:`ServeAutoScaler`, or the master's ``ServingFleetAutoScaler``)
+picks the least-loaded replica and asks the gateway to drain it; the
+replica finishes in-flight work, deregisters, and only then goes away —
+no admitted request ever observes the shrink.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from dlrover_tpu.common.log import logger
+
+
+@dataclasses.dataclass
+class ScalePolicy:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    #: Scale up when queued requests per alive replica exceed this.
+    queue_high_per_replica: float = 4.0
+    #: Scale up when gateway p95 TTFT exceeds this (0 = signal off).
+    ttft_p95_high_ms: float = 0.0
+    #: Scale down only when queued per replica is below this ...
+    queue_low_per_replica: float = 0.5
+    #: ... AND mean slot occupancy is below this.
+    occupancy_low: float = 0.3
+    up_patience: int = 2
+    down_patience: int = 5
+    #: Replicas added per up decision (load can spike faster than one
+    #: replica's worth; shrink is always one at a time — drains are
+    #: serialized so capacity never cliff-drops).
+    up_step: int = 1
+
+
+@dataclasses.dataclass
+class ScaleState:
+    up_streak: int = 0
+    down_streak: int = 0
+
+
+def decide(snapshot: Dict[str, Any], policy: ScalePolicy,
+           state: ScaleState) -> int:
+    """Target replica count for one pass.  ``snapshot`` is
+    ``GatewayCore.stats_snapshot()`` (needs ``replicas_alive``,
+    ``queue_depth``, ``occupancy``; ``ttft_p95_ms`` optional).
+    Mutates ``state`` streaks; returns the target (== alive when no
+    change is warranted)."""
+    alive = max(1, int(snapshot.get("replicas_alive", 1)))
+    queue_per = snapshot.get("queue_depth", 0) / alive
+    occupancy = float(snapshot.get("occupancy", 0.0))
+    ttft_p95 = float(snapshot.get("ttft_p95_ms", 0.0))
+
+    pressure = queue_per > policy.queue_high_per_replica or (
+        policy.ttft_p95_high_ms > 0
+        and ttft_p95 > policy.ttft_p95_high_ms
+    )
+    idle = (
+        queue_per < policy.queue_low_per_replica
+        and occupancy < policy.occupancy_low
+    )
+    if pressure:
+        state.up_streak += 1
+        state.down_streak = 0
+    elif idle:
+        state.down_streak += 1
+        state.up_streak = 0
+    else:
+        state.up_streak = 0
+        state.down_streak = 0
+
+    target = alive
+    if state.up_streak >= policy.up_patience:
+        target = min(policy.max_replicas, alive + policy.up_step)
+        state.up_streak = 0
+    elif state.down_streak >= policy.down_patience:
+        target = max(policy.min_replicas, alive - 1)
+        state.down_streak = 0
+    return target
+
+
+class ServeAutoScaler:
+    """Periodic actuator around :func:`decide`.
+
+    ``snapshot_fn`` reads the gateway (a bound
+    ``GatewayCore.stats_snapshot`` enriched with the TTFT p95 by the
+    :class:`~dlrover_tpu.serving.gateway.Gateway` wrapper);
+    ``scale_up_fn(n)`` asks the platform for ``n`` more replicas (the
+    master's job manager in a supervised fleet, a subprocess spawner in
+    the bench); ``drain_fn()`` picks and drains one replica for
+    scale-down (``GatewayCore.pick_drain_victim`` + ``drain``)."""
+
+    def __init__(
+        self,
+        snapshot_fn: Callable[[], Dict[str, Any]],
+        scale_up_fn: Callable[[int], Any],
+        drain_fn: Callable[[], Any],
+        policy: Optional[ScalePolicy] = None,
+        interval: float = 1.0,
+    ):
+        self.policy = policy or ScalePolicy()
+        self.state = ScaleState()
+        self._snapshot_fn = snapshot_fn
+        self._scale_up_fn = scale_up_fn
+        self._drain_fn = drain_fn
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.decisions: list = []  # (ts, alive, target) audit trail
+
+    def scale_once(self) -> int:
+        """One decision + actuation pass; returns the applied delta."""
+        snap = self._snapshot_fn()
+        alive = max(1, int(snap.get("replicas_alive", 1)))
+        target = decide(snap, self.policy, self.state)
+        if target == alive:
+            return 0
+        self.decisions.append((time.time(), alive, target))
+        if target > alive:
+            logger.info(
+                "serve-autoscaler: scaling up %d -> %d "
+                "(queue=%s p95_ttft=%.0fms)", alive, target,
+                snap.get("queue_depth"), snap.get("ttft_p95_ms", 0.0),
+            )
+            self._scale_up_fn(target - alive)
+        else:
+            logger.info(
+                "serve-autoscaler: draining one replica (%d -> %d)",
+                alive, target,
+            )
+            self._drain_fn()
+        return target - alive
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="serve-autoscaler", daemon=True
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.scale_once()
+            except Exception:  # noqa: BLE001 - scaler must survive
+                logger.exception("serve-autoscale pass failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,728 @@
+"""Serving gateway: the fleet's front door (ISSUE 5 tentpole).
+
+One :class:`GatewayCore` holds the whole control-plane state machine —
+pure Python, injectable clock, no RPC, no jax — so every admission /
+routing / deadline / dedupe / drain law is unit-testable in
+microseconds.  :class:`Gateway` wraps it with the repo's typed msgpack
+RPC (``common/rpc.py``) and a lease sweeper thread.
+
+Design contracts:
+
+- **Bounded admission with explicit backpressure.**  The queue cap
+  counts queued + assigned work; past it a submit is REJECTED with a
+  ``retry_after_s`` hint instead of growing an unbounded buffer (the
+  client backs off; the autoscaler sees the pressure and grows the
+  fleet).
+- **Exactly-once completion.**  ``req_id`` is the idempotency token:
+  completed results live in a :class:`BoundedTokenCache`; a duplicate
+  completion (journal replay after a replica kill racing a
+  re-dispatch) is counted and dropped, a resubmit of a finished
+  request answers from the cache.  The REPLICA's journal decides what
+  already completed — the gateway never asks a replica to re-decode
+  work its journal can prove finished.
+- **Pull routing == least-loaded routing.**  Replicas poll with their
+  free-slot count and get up to that many grants; capacity asks for
+  work exactly when it exists, so work flows to the least-loaded
+  replica without the gateway modelling per-replica speed.
+- **Reconciliation.**  Each poll carries the replica's full owned set;
+  a grant handed out before the replica's previous poll that the
+  replica does not report owning was LOST in flight (or dropped —
+  chaos ``serving.drop_request``) and is re-queued at the front.
+- **Drain-aware scale-down.**  A draining replica gets no new grants;
+  its poll reply carries ``drain=True`` once, the replica finishes
+  in-flight work, deregisters, and exits — no request observes the
+  shrink.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from dlrover_tpu.agent.metrics import CounterSet
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.messages import (
+    BaseResponse,
+    Message,
+    ServeAck,
+    ServeDone,
+    ServeDrainRequest,
+    ServeFleetStats,
+    ServeFleetStatsRequest,
+    ServeGrants,
+    ServeReplicaDeregister,
+    ServeReplicaPoll,
+    ServeReplicaRegister,
+    ServeStatusReply,
+    ServeStatusRequest,
+    ServeSubmit,
+    ServeTokens,
+)
+from dlrover_tpu.common.token_cache import BoundedTokenCache
+
+
+class GatewayConfig:
+    """Knobs, deliberately a plain object (tests tweak freely)."""
+
+    def __init__(
+        self,
+        queue_cap: int = 256,
+        lease_timeout_s: float = 10.0,
+        default_deadline_s: float = 0.0,  # 0 = none
+        retry_after_s: float = 0.5,
+        done_cache_cap: int = 4096,
+        max_attempts: int = 5,
+    ):
+        self.queue_cap = queue_cap
+        self.lease_timeout_s = lease_timeout_s
+        self.default_deadline_s = default_deadline_s
+        self.retry_after_s = retry_after_s
+        self.done_cache_cap = done_cache_cap
+        #: Re-dispatches a request may survive before it is failed
+        #: terminally: a poison request (one that reliably crashes its
+        #: replica, or is repeatedly lost) re-queues at the FRONT and
+        #: would otherwise head-of-line-block the fleet forever.
+        self.max_attempts = max_attempts
+
+
+class _Request:
+    __slots__ = (
+        "req_id", "prompt", "max_new_tokens", "deadline", "submitted_at",
+        "attempts", "assigned_to", "grant_seq", "first_token_at",
+        "partial",
+    )
+
+    def __init__(self, req_id: str, prompt: List[int],
+                 max_new_tokens: int, deadline: Optional[float],
+                 now: float):
+        self.req_id = req_id
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline = deadline
+        self.submitted_at = now
+        self.attempts = 0
+        self.assigned_to: Optional[str] = None
+        self.grant_seq = -1
+        self.first_token_at: Optional[float] = None
+        self.partial: List[int] = []
+
+
+class _Replica:
+    __slots__ = (
+        "replica_id", "slots", "assigned", "last_seen", "poll_seq",
+        "draining", "stats",
+    )
+
+    def __init__(self, replica_id: str, slots: int, now: float):
+        self.replica_id = replica_id
+        self.slots = int(slots)
+        self.assigned: Dict[str, _Request] = {}
+        self.last_seen = now
+        self.poll_seq = 0
+        self.draining = False
+        self.stats: Dict[str, Any] = {}
+
+
+class GatewayCore:
+    """The serving control-plane state machine (see module docstring).
+
+    Thread-safe: every public method takes the single mutex.  Latency
+    instruments are injected (``observe_latency_ms`` /
+    ``observe_ttft_ms`` callables) so the core stays import-light;
+    :class:`Gateway` wires them to ``agent.metrics.Histogram``.
+    """
+
+    def __init__(self, config: Optional[GatewayConfig] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = config or GatewayConfig()
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._queue: List[_Request] = []  # FIFO; requeues go to front
+        self._by_id: Dict[str, _Request] = {}  # queued + assigned
+        self._done = BoundedTokenCache(self.cfg.done_cache_cap)
+        self._replicas: Dict[str, _Replica] = {}
+        # CounterSet (thread-safe by itself) rather than a plain dict:
+        # several counts are bumped from the *_locked helpers, and the
+        # set's own lock keeps the increments race-free without tying
+        # them to the core mutex.
+        self._counters = CounterSet()
+        for name in (
+            "submitted", "accepted", "rejected", "dedupe_hits",
+            "completed", "failed", "timeout", "duplicate_completions",
+            "redispatched", "replicas_lost", "streamed_tokens",
+            "late_completions",
+        ):
+            self._counters.inc(name, 0)
+        self._last_sweep = float("-inf")
+        self.observe_latency_ms: Optional[Callable[[float], None]] = None
+        self.observe_ttft_ms: Optional[Callable[[float], None]] = None
+        #: Optional provider merged into stats_snapshot() — the Gateway
+        #: wrapper injects its histogram percentiles here so consumers
+        #: of the snapshot (the autoscaler's ttft_p95_ms signal, the
+        #: fleet example's stats line) see them.
+        self.snapshot_extras: Optional[Callable[[], Dict[str, Any]]] = None
+
+    @property
+    def counters(self) -> Dict[str, int]:
+        """Point-in-time counter snapshot (a fresh dict)."""
+        return self._counters.snapshot()
+
+    # -- client surface ---------------------------------------------------
+
+    def submit(self, req_id: str, prompt: List[int],
+               max_new_tokens: int, deadline_s: float = 0.0) -> ServeAck:
+        now = self._clock()
+        if not req_id:
+            # BoundedTokenCache treats "" as no-token: the completion
+            # would be unrecordable and the client would poll an
+            # 'unknown' id to its timeout.
+            return ServeAck(req_id=req_id, status="failed",
+                            reason="empty req_id")
+        with self._mu:
+            self._counters.inc("submitted")
+            hit = self._done.get(req_id)
+            if hit is not None:
+                # Idempotent resubmit of a request with a TERMINAL
+                # outcome: answer from the cache with that outcome —
+                # the decode never runs twice, and a timed-out/failed
+                # request must NOT be masked as a zero-token success
+                # (the req_id is the idempotency key; retry a failure
+                # under a fresh id).
+                self._counters.inc("dedupe_hits")
+                return ServeAck(
+                    req_id=req_id,
+                    status=hit.get("state", "done"),
+                    tokens=list(hit.get("tokens", [])),
+                    reason=hit.get("reason", ""),
+                )
+            if req_id in self._by_id:
+                # Retried submit of an in-flight request: already
+                # admitted, no second queue entry.
+                return ServeAck(req_id=req_id, status="accepted",
+                                reason="duplicate-submit")
+            in_flight = len(self._by_id)
+            if in_flight >= self.cfg.queue_cap:
+                self._counters.inc("rejected")
+                return ServeAck(
+                    req_id=req_id, status="rejected",
+                    retry_after_s=self.cfg.retry_after_s,
+                    reason=f"admission queue full ({in_flight} >= "
+                           f"{self.cfg.queue_cap})",
+                )
+            if deadline_s <= 0.0:
+                deadline_s = self.cfg.default_deadline_s
+            req = _Request(
+                req_id, prompt, max_new_tokens,
+                now + deadline_s if deadline_s > 0 else None, now,
+            )
+            self._queue.append(req)
+            self._by_id[req_id] = req
+            self._counters.inc("accepted")
+            return ServeAck(req_id=req_id, status="accepted")
+
+    def status(self, req_id: str) -> ServeStatusReply:
+        with self._mu:
+            hit = self._done.get(req_id)
+            if hit is not None:
+                return ServeStatusReply(
+                    req_id=req_id, state=hit.get("state", "done"),
+                    tokens=list(hit.get("tokens", [])),
+                    replica=hit.get("replica", ""),
+                    reason=hit.get("reason", ""),
+                )
+            req = self._by_id.get(req_id)
+            if req is None:
+                return ServeStatusReply(req_id=req_id, state="unknown")
+            if req.assigned_to is not None:
+                return ServeStatusReply(
+                    req_id=req_id, state="running",
+                    tokens=list(req.partial), replica=req.assigned_to,
+                )
+            return ServeStatusReply(req_id=req_id, state="queued")
+
+    # -- replica surface --------------------------------------------------
+
+    def register(self, replica_id: str, slots: int) -> None:
+        with self._mu:
+            rep = self._replicas.get(replica_id)
+            if rep is None:
+                self._replicas[replica_id] = _Replica(
+                    replica_id, slots, self._clock()
+                )
+                logger.info(
+                    "gateway: replica %s registered (%d slots)",
+                    replica_id, slots,
+                )
+            else:
+                # Restarted replica re-registering under the same id:
+                # whatever it was assigned before the crash is either in
+                # its journal (it will replay a completion) or must be
+                # re-dispatched.
+                rep.slots = int(slots)
+                rep.last_seen = self._clock()
+                rep.draining = False
+                self._requeue_assigned_locked(rep, "re-register")
+
+    def deregister(self, replica_id: str) -> None:
+        with self._mu:
+            rep = self._replicas.pop(replica_id, None)
+            if rep is None:
+                return
+            self._requeue_assigned_locked(rep, "deregister")
+            logger.info("gateway: replica %s deregistered", replica_id)
+
+    def poll(self, replica_id: str, free_slots: int,
+             active: List[str], stats: Optional[dict] = None
+             ) -> ServeGrants:
+        now = self._clock()
+        with self._mu:
+            # Rate-limited safety-net sweep (bare-core users have no
+            # sweeper thread): a full lease/deadline scan on EVERY poll
+            # would be O(replicas + queue) on the hottest RPC path.
+            if now - self._last_sweep >= 1.0:
+                self._sweep_locked(now)
+                self._last_sweep = now
+            rep = self._replicas.get(replica_id)
+            if rep is None:
+                # The gateway restarted (or the replica was reaped after
+                # a lease lapse): tell it to re-register.
+                return ServeGrants(known=False)
+            rep.last_seen = now
+            rep.poll_seq += 1
+            if stats:
+                rep.stats = dict(stats)
+            owned = set(active)
+            # Reconcile lost grants: anything granted before this
+            # replica's PREVIOUS poll must show up in its owned set by
+            # now (the replica runner admits grants before its next
+            # poll); a missing one evaporated in flight.
+            cancels: List[str] = []
+            for rid_key in list(rep.assigned):
+                req = rep.assigned[rid_key]
+                if req.deadline is not None and now > req.deadline:
+                    # Deadline passed mid-decode: terminal timeout; tell
+                    # the replica to drop it if still pending.
+                    self._finish_locked(
+                        req, "timeout", [], replica_id,
+                        reason="deadline exceeded in flight",
+                    )
+                    cancels.append(rid_key)
+                    continue
+                if rid_key not in owned and req.grant_seq < rep.poll_seq - 1:
+                    del rep.assigned[rid_key]
+                    self._requeue_locked(
+                        req, f"lost by replica {replica_id}"
+                    )
+            grants: List[ServeSubmit] = []
+            if not rep.draining:
+                while len(grants) < max(0, int(free_slots)) and self._queue:
+                    req = self._queue.pop(0)
+                    if req.deadline is not None and now > req.deadline:
+                        self._finish_locked(
+                            req, "timeout", [], "",
+                            reason="deadline exceeded in queue",
+                        )
+                        continue
+                    req.assigned_to = replica_id
+                    req.grant_seq = rep.poll_seq
+                    rep.assigned[req.req_id] = req
+                    grants.append(ServeSubmit(
+                        req_id=req.req_id, prompt=list(req.prompt),
+                        max_new_tokens=req.max_new_tokens,
+                        deadline_s=(
+                            max(0.0, req.deadline - now)
+                            if req.deadline is not None else 0.0
+                        ),
+                    ))
+            drain = rep.draining and not rep.assigned
+            return ServeGrants(
+                requests=grants, cancel=cancels, drain=drain, known=True,
+            )
+
+    def stream(self, replica_id: str, req_id: str,
+               tokens: List[int]) -> None:
+        now = self._clock()
+        with self._mu:
+            req = self._by_id.get(req_id)
+            if req is None or req.assigned_to != replica_id:
+                return  # stale stream from a superseded assignment
+            if req.first_token_at is None and tokens:
+                req.first_token_at = now
+                if self.observe_ttft_ms is not None:
+                    self.observe_ttft_ms(
+                        (now - req.submitted_at) * 1000.0
+                    )
+            req.partial.extend(int(t) for t in tokens)
+            self._counters.inc("streamed_tokens", len(tokens))
+
+    def complete(self, replica_id: str, req_id: str, tokens: List[int],
+                 ok: bool = True, reason: str = "",
+                 replayed: bool = False) -> str:
+        """Terminal report.  Returns ``recorded`` | ``duplicate`` |
+        ``unknown`` (the replica does not branch on it; tests do)."""
+        with self._mu:
+            hit = self._done.get(req_id)
+            if hit is not None:
+                if hit.get("state") == "timeout":
+                    # The replica finished work the gateway had already
+                    # timed out: not a dedupe event — keep the
+                    # duplicate counter meaningful (the e2e reads it as
+                    # journal-replay evidence).
+                    self._counters.inc("late_completions")
+                else:
+                    self._counters.inc("duplicate_completions")
+                req = self._by_id.get(req_id)
+                if req is not None:
+                    # A re-dispatched copy still in the books: the first
+                    # completion already answered the client; release it.
+                    self._detach_locked(req)
+                return "duplicate"
+            req = self._by_id.get(req_id)
+            if req is None:
+                # A journal replay for a request this gateway never
+                # admitted (fresh gateway, old journal): nothing to
+                # complete.
+                return "unknown"
+            state = "done" if ok else "failed"
+            self._finish_locked(
+                req, state, tokens, replica_id, reason=reason,
+            )
+            if replayed:
+                logger.info(
+                    "gateway: request %s completed from %s's journal "
+                    "replay", req_id, replica_id,
+                )
+            return "recorded"
+
+    # -- operator surface -------------------------------------------------
+
+    def drain(self, replica_id: str) -> bool:
+        with self._mu:
+            rep = self._replicas.get(replica_id)
+            if rep is None:
+                return False
+            rep.draining = True
+            logger.info("gateway: draining replica %s", replica_id)
+            return True
+
+    def pick_drain_victim(self) -> Optional[str]:
+        """Least-loaded non-draining replica — the scale-down choice."""
+        with self._mu:
+            best = None
+            for rep in self._replicas.values():
+                if rep.draining:
+                    continue
+                key = (len(rep.assigned), rep.replica_id)
+                if best is None or key < best[0]:
+                    best = (key, rep.replica_id)
+            return best[1] if best else None
+
+    def sweep(self) -> None:
+        with self._mu:
+            self._sweep_locked(self._clock())
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        with self._mu:
+            reps = {
+                rid_key: {
+                    "slots": rep.slots,
+                    "assigned": len(rep.assigned),
+                    "draining": rep.draining,
+                    "stats": dict(rep.stats),
+                }
+                for rid_key, rep in self._replicas.items()
+            }
+            alive = [r for r in self._replicas.values() if not r.draining]
+            total_slots = sum(r.slots for r in alive)
+            total_assigned = sum(len(r.assigned) for r in alive)
+            snap = {
+                "queue_depth": len(self._queue),
+                "in_flight": len(self._by_id),
+                "replicas_alive": len(alive),
+                "replicas_draining": len(self._replicas) - len(alive),
+                "occupancy": (
+                    total_assigned / total_slots if total_slots else 0.0
+                ),
+                "counters": self._counters.snapshot(),
+                "replicas": reps,
+            }
+        # Outside the mutex: the extras provider (the Gateway wrapper's
+        # latency/TTFT histograms) has its own locking, and the
+        # autoscaler's ttft_p95_ms signal reads THIS snapshot — without
+        # the hook that policy knob would be dead in production.
+        if self.snapshot_extras is not None:
+            try:
+                snap.update(self.snapshot_extras())
+            except Exception as e:  # noqa: BLE001 - stats must answer
+                logger.warning("gateway snapshot extras failed: %s", e)
+        return snap
+
+    # -- internals (call with self._mu held) ------------------------------
+
+    def _detach_locked(self, req: _Request) -> None:
+        self._by_id.pop(req.req_id, None)
+        if req.assigned_to is not None:
+            rep = self._replicas.get(req.assigned_to)
+            if rep is not None:
+                rep.assigned.pop(req.req_id, None)
+        elif req in self._queue:
+            self._queue.remove(req)
+
+    def _finish_locked(self, req: _Request, state: str,
+                       tokens: List[int], replica_id: str,
+                       reason: str = "") -> None:
+        self._detach_locked(req)
+        self._done.put(req.req_id, {
+            "state": state, "tokens": [int(t) for t in tokens],
+            "replica": replica_id, "reason": reason,
+        })
+        now = self._clock()
+        if state == "done":
+            self._counters.inc("completed")
+            if self.observe_latency_ms is not None:
+                self.observe_latency_ms(
+                    (now - req.submitted_at) * 1000.0
+                )
+        elif state == "timeout":
+            self._counters.inc("timeout")
+        else:
+            self._counters.inc("failed")
+
+    def _requeue_locked(self, req: _Request, why: str) -> None:
+        """Return a lost/orphaned request to the FRONT of the queue —
+        or fail it terminally once it has burned ``max_attempts``
+        re-dispatches (a poison request must not serially kill the
+        fleet while head-of-line-blocking everything behind it)."""
+        req.assigned_to = None
+        req.attempts += 1
+        req.partial = []
+        if req.attempts >= self.cfg.max_attempts:
+            self._finish_locked(
+                req, "failed", [], "",
+                reason=f"re-dispatched {req.attempts} times "
+                       f"(max_attempts={self.cfg.max_attempts}); "
+                       f"last: {why}",
+            )
+            logger.error(
+                "gateway: request %s failed terminally after %d "
+                "re-dispatches (%s)", req.req_id, req.attempts, why,
+            )
+            return
+        self._queue.insert(0, req)
+        self._counters.inc("redispatched")
+        logger.warning(
+            "gateway: request %s re-queued (%s)", req.req_id, why,
+        )
+
+    def _requeue_assigned_locked(self, rep: _Replica,
+                                 why: str) -> None:
+        for req in list(rep.assigned.values()):
+            rep.assigned.pop(req.req_id, None)
+            self._requeue_locked(req, f"{why} of replica {rep.replica_id}")
+
+    def _sweep_locked(self, now: float) -> None:
+        # Dead replicas: lease lapsed -> requeue their work.
+        for rid_key in list(self._replicas):
+            rep = self._replicas[rid_key]
+            if now - rep.last_seen > self.cfg.lease_timeout_s:
+                self._counters.inc("replicas_lost")
+                logger.warning(
+                    "gateway: replica %s lease expired (%.1fs); "
+                    "re-dispatching %d in-flight request(s)",
+                    rid_key, now - rep.last_seen, len(rep.assigned),
+                )
+                self._requeue_assigned_locked(rep, "lease expiry")
+                del self._replicas[rid_key]
+        # Queued requests past their deadline: terminal timeout.
+        for req in list(self._queue):
+            if req.deadline is not None and now > req.deadline:
+                self._finish_locked(
+                    req, "timeout", [], "",
+                    reason="deadline exceeded in queue",
+                )
+
+
+class Gateway:
+    """RPC front of :class:`GatewayCore`: one msgpack route
+    (``common/rpc.py``) dispatching on message type, plus a lease
+    sweeper thread and the latency/TTFT histograms."""
+
+    def __init__(self, port: int = 0,
+                 config: Optional[GatewayConfig] = None,
+                 sweep_interval: float = 1.0,
+                 metrics_registry=None,
+                 histogram_window_s: float = 60.0):
+        from dlrover_tpu.agent.metrics import Histogram
+        from dlrover_tpu.common.rpc import RpcServer
+
+        self.core = GatewayCore(config)
+        # Windowed: these percentiles steer the autoscaler and the
+        # gauges — a lifetime histogram would ratchet (one bad warmup
+        # period keeps p95 high forever and the fleet never shrinks).
+        self.latency_ms = Histogram(window_s=histogram_window_s)
+        self.ttft_ms = Histogram(window_s=histogram_window_s)
+        self.core.observe_latency_ms = self.latency_ms.observe
+        self.core.observe_ttft_ms = self.ttft_ms.observe
+        self.core.snapshot_extras = lambda: {
+            "ttft_p95_ms": self.ttft_ms.percentile(0.95),
+            "latency_p95_ms": self.latency_ms.percentile(0.95),
+        }
+        if metrics_registry is not None:
+            self.register_gauges(metrics_registry)
+        self._sweep_interval = sweep_interval
+        self._stop = threading.Event()
+        self._server = RpcServer(port, self.handle)
+        self._sweeper: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def register_gauges(self, registry) -> None:
+        """Gateway latency histograms + fleet gauges on an agent
+        metrics registry (``serve_*`` namespace)."""
+        self.latency_ms.register_gauges(registry, "serve_latency")
+        self.ttft_ms.register_gauges(registry, "serve_ttft")
+
+        # One snapshot per scrape, not one per gauge: four gauges each
+        # taking the core mutex and copying all counters would contend
+        # with the submit/poll hot path (the worker_perf TTL-cache
+        # pattern from the checkpoint saver).
+        cache = {"ts": 0.0, "snap": {}}
+
+        def _snap():
+            now = time.monotonic()
+            if now - cache["ts"] > 0.5:
+                cache["snap"] = self.core.stats_snapshot()
+                cache["ts"] = now
+            return cache["snap"]
+
+        def _snap_gauge(key):
+            def read():
+                return float(_snap().get(key, 0.0))
+            return read
+
+        for key in ("queue_depth", "in_flight", "replicas_alive",
+                    "occupancy"):
+            registry.gauge(f"serve_{key}", _snap_gauge(key))
+
+    def handle(self, msg: Message) -> Optional[Message]:
+        core = self.core
+        if isinstance(msg, ServeSubmit):
+            return core.submit(msg.req_id, msg.prompt,
+                               msg.max_new_tokens, msg.deadline_s)
+        if isinstance(msg, ServeStatusRequest):
+            return core.status(msg.req_id)
+        if isinstance(msg, ServeReplicaRegister):
+            core.register(msg.replica_id, msg.slots)
+            return BaseResponse(success=True)
+        if isinstance(msg, ServeReplicaDeregister):
+            core.deregister(msg.replica_id)
+            return BaseResponse(success=True)
+        if isinstance(msg, ServeReplicaPoll):
+            return core.poll(msg.replica_id, msg.free_slots,
+                             msg.active, msg.stats)
+        if isinstance(msg, ServeTokens):
+            core.stream(msg.replica_id, msg.req_id, msg.tokens)
+            return BaseResponse(success=True)
+        if isinstance(msg, ServeDone):
+            outcome = core.complete(
+                msg.replica_id, msg.req_id, msg.tokens, msg.ok,
+                msg.reason, msg.replayed,
+            )
+            return BaseResponse(success=True, reason=outcome)
+        if isinstance(msg, ServeDrainRequest):
+            ok = core.drain(msg.replica_id)
+            return BaseResponse(success=ok)
+        if isinstance(msg, ServeFleetStatsRequest):
+            return ServeFleetStats(stats=self.core.stats_snapshot())
+        return BaseResponse(
+            success=False, reason=f"unhandled {type(msg).__name__}"
+        )
+
+    def start(self) -> None:
+        self._server.start()
+        if self._sweeper is None:
+            self._sweeper = threading.Thread(
+                target=self._sweep_loop, name="gw-sweeper", daemon=True
+            )
+            self._sweeper.start()
+
+    def _sweep_loop(self) -> None:
+        while not self._stop.wait(self._sweep_interval):
+            try:
+                self.core.sweep()
+            except Exception:  # noqa: BLE001 - sweeper must survive
+                logger.exception("gateway sweep failed")
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._stop.set()
+        self._server.stop(grace)
+        if self._sweeper is not None:
+            self._sweeper.join(timeout=5.0)
+            self._sweeper = None
+
+
+class LoopbackTransport:
+    """In-process transport with the RPC client's calling convention:
+    ``call(msg) -> reply``.  Lets the bench's smoke mode and the unit
+    tests run a whole fleet (core + replicas) in one process with zero
+    sockets."""
+
+    def __init__(self, handler: Callable[[Message], Optional[Message]]):
+        self._handler = handler
+
+    def call(self, msg: Message, **_kw) -> Message:
+        resp = self._handler(msg)
+        return resp if resp is not None else BaseResponse(success=True)
+
+
+class ServeClient:
+    """Convenience client: submit with bounded backpressure retry, poll
+    for the result.  ``transport`` is anything with the ``call(msg,
+    **kw)`` convention — an ``RpcClient`` or a
+    :class:`LoopbackTransport`."""
+
+    def __init__(self, transport, poll_interval: float = 0.02):
+        self._t = transport
+        self._poll_interval = poll_interval
+
+    def submit(self, req_id: str, prompt, max_new_tokens: int,
+               deadline_s: float = 0.0, submit_timeout: float = 30.0
+               ) -> ServeAck:
+        """Submit, honouring rejection backpressure: sleeps the
+        gateway's ``retry_after_s`` and retries until accepted (or
+        ``submit_timeout`` is spent — then the last rejected ack is
+        returned for the caller to surface)."""
+        start = time.monotonic()
+        while True:
+            ack = self._t.call(ServeSubmit(
+                req_id=req_id, prompt=[int(t) for t in prompt],
+                max_new_tokens=max_new_tokens, deadline_s=deadline_s,
+            ))
+            if not isinstance(ack, ServeAck) or ack.status != "rejected":
+                return ack
+            wait = max(0.01, ack.retry_after_s)
+            if time.monotonic() - start + wait > submit_timeout:
+                return ack
+            time.sleep(wait)
+
+    def status(self, req_id: str) -> ServeStatusReply:
+        reply = self._t.call(ServeStatusRequest(req_id=req_id))
+        if not isinstance(reply, ServeStatusReply):
+            return ServeStatusReply(req_id=req_id, state="unknown",
+                                    reason=str(reply))
+        return reply
+
+    def result(self, req_id: str, timeout: float = 60.0
+               ) -> ServeStatusReply:
+        """Poll until the request reaches a terminal state."""
+        deadline = time.monotonic() + timeout
+        while True:
+            reply = self.status(req_id)
+            if reply.state in ("done", "failed", "timeout"):
+                return reply
+            if time.monotonic() >= deadline:
+                return reply
+            time.sleep(self._poll_interval)

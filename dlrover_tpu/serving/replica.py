@@ -1,0 +1,436 @@
+"""Drain-aware serving replica: the fleet's worker loop (ISSUE 5).
+
+:class:`ReplicaRunner` turns one continuous-batching ``DecodeServer``
+into a gateway-fed replica: it registers, then rides the server's
+incremental admission surface (``serve_incremental`` + ``submit``) —
+the runner's ``tick`` runs at every admission point of the decode loop,
+where it polls the gateway with its free-slot count, feeds grants into
+slots as they free, streams the round's tokens back, journals and
+reports completions, and honours cancels and the drain flag.
+
+Exactly-once across a kill is a two-party contract:
+
+- the runner journals a completion (fsync'd JSON line keyed by request
+  id + prompt hash) BEFORE reporting it, so a kill between the two is
+  replayed from the journal at restart (``replayed=True`` reports);
+- the gateway dedupes completions by request id, so the replay racing a
+  re-dispatch on another replica can never answer a client twice.
+
+The generalized form of ``examples/llama_serve_elastic.py``'s role: the
+journal contract is ``serve_journaled``'s, lifted from a fixed prompt
+list to a gateway request stream.
+
+No jax at module level — the decode server is injected, so the gateway
+side of a fleet (and every unit test of the runner's protocol) runs
+without the model stack.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from dlrover_tpu import chaos
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.messages import (
+    ServeDone,
+    ServeGrants,
+    ServeReplicaDeregister,
+    ServeReplicaPoll,
+    ServeReplicaRegister,
+    ServeTokens,
+)
+
+
+def _prompt_hash(prompt) -> str:
+    return hashlib.sha1(
+        np.asarray(prompt, np.int32).tobytes()
+    ).hexdigest()[:16]
+
+
+class CompletionJournal:
+    """Append-only fsync'd completion journal keyed by (req_id, prompt
+    hash) — ``serve_journaled``'s record format on a request stream.  A
+    torn tail from a SIGKILL mid-append is truncated away before the
+    first new append; records whose prompt hash mismatches a re-granted
+    request are ignored (journal-path reuse must re-serve, not replay
+    stale tokens).
+
+    BOUNDED: only the newest ``max_records`` completions are retained
+    (memory and disk both) — the journal's job is crash recovery of
+    RECENT work, not an archive; a long-lived replica must not grow
+    its RSS and fsync file forever.  Compaction rewrites the file
+    atomically once it exceeds the cap by 25% slack (amortized cost)."""
+
+    def __init__(self, path: str, max_records: int = 10000):
+        self.path = path
+        self.max_records = max_records
+        self._records: Dict[str, Dict[str, Any]] = {}
+        self._f = None
+        self._load()
+        if len(self._records) > self.max_records:
+            self._compact()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, "r+") as f:
+                content = f.read()
+                cut = content.rfind("\n") + 1
+                if cut < len(content):
+                    f.truncate(cut)
+                for line in content[:cut].split("\n"):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn line persisted by an old writer
+                    self._records[str(rec["rid"])] = rec
+        except OSError:
+            pass  # no journal yet
+
+    def replayable(self) -> Dict[str, Dict[str, Any]]:
+        return dict(self._records)
+
+    def lookup(self, req_id: str, prompt) -> Optional[List[int]]:
+        rec = self._records.get(req_id)
+        if rec is None or rec.get("ph") != _prompt_hash(prompt):
+            return None
+        return [int(t) for t in rec["tokens"]]
+
+    def append(self, req_id: str, prompt, tokens) -> None:
+        if self._f is None:
+            self._f = open(self.path, "a")
+        rec = {
+            "rid": req_id,
+            "ph": _prompt_hash(prompt),
+            "tokens": [int(t) for t in tokens],
+        }
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._records[req_id] = rec
+        if len(self._records) >= self.max_records + max(
+            64, self.max_records // 4
+        ):
+            self._compact()
+
+    def _compact(self) -> None:
+        """Trim to the newest ``max_records`` and rewrite the file
+        atomically (tmp + rename; the old handle is replaced)."""
+        drop = len(self._records) - self.max_records
+        if drop > 0:
+            for req_id in list(self._records)[:drop]:
+                del self._records[req_id]
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        tmp = self.path + ".compact"
+        with open(tmp, "w") as f:
+            for rec in self._records.values():
+                f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class ReplicaRunner:
+    """One replica's control loop (see module docstring).
+
+    ``transport`` follows the repo RPC calling convention
+    (``call(msg, **kw) -> reply``): an ``RpcClient`` against a real
+    gateway or a ``LoopbackTransport`` for in-process fleets.
+    """
+
+    def __init__(
+        self,
+        server,  # DecodeServer (or any object with its serve surface)
+        transport,
+        replica_id: str,
+        journal_path: Optional[str] = None,
+        poll_interval: float = 0.05,
+        round_floor_s: float = 0.0,
+        replay_limit: int = 256,
+        clock=time.monotonic,
+    ):
+        self.server = server
+        self.transport = transport
+        self.replica_id = replica_id
+        self.journal = (
+            CompletionJournal(journal_path) if journal_path else None
+        )
+        self.poll_interval = poll_interval
+        self.replay_limit = replay_limit
+        #: Optional per-round latency floor: models the device-bound
+        #: regime on hosts where decode compute shares the CPU with the
+        #: control plane (see bench.py --serve_bench).  The sleep sits
+        #: in tick — between dispatch rounds — exactly where a blocking
+        #: device future would.
+        self.round_floor_s = round_floor_s
+        self._clock = clock
+        self._last_poll = 0.0
+        self._draining = False
+        self._stopped = False
+        self._journal_replayed = False
+        self._granted: Dict[str, Dict[str, Any]] = {}  # rid -> grant
+        self._stream_buf: Dict[str, List[int]] = {}
+        self._first_token_at: Dict[str, float] = {}
+        self._admitted_at: Dict[str, float] = {}
+        # Sliding-window throughput accounting for the poll stats.
+        self._win_start = clock()
+        self._win_tokens = 0
+        self._last_tps = 0.0
+        self._last_ttft_ms = 0.0
+        self.served = 0
+        self.replayed = 0
+        self.dropped = 0
+
+    # -- protocol steps ---------------------------------------------------
+
+    def register(self) -> None:
+        # Best-effort like every other control-plane send: a gateway
+        # still booting (or flapping again right after a known=False
+        # poll) must not kill the replica — the next poll's
+        # known=False reply retries the registration.
+        self._call_quiet(ServeReplicaRegister(
+            replica_id=self.replica_id, slots=self.server.slots,
+        ))
+        if self.journal is not None and not self._journal_replayed:
+            # Journal replay, ONCE per incarnation: report every
+            # completed request before any new work — the gateway's
+            # dedupe makes this idempotent, so a restarted replica can
+            # never lose a finished request nor decode it twice.  A
+            # later re-register (gateway flap) skips the bulk replay —
+            # a restarted gateway answers "unknown" for all of it, and
+            # any request it re-dispatches hits the journal at grant
+            # time anyway (the _admit lookup).
+            self._journal_replayed = True
+            # Eager replay covers only the NEWEST records: the gateway
+            # only cares about completions it still tracks (recent
+            # in-flight work); a full 10k-record replay would be tens
+            # of seconds of sequential RPCs with no polls — long past
+            # the lease timeout, so the gateway would declare the
+            # freshly registered replica dead mid-replay.  Older
+            # records still answer re-dispatched grants through the
+            # _admit journal lookup.
+            items = list(self.journal.replayable().items())
+            for req_id, rec in items[-self.replay_limit:]:
+                self.replayed += 1
+                self._call_quiet(ServeDone(
+                    replica_id=self.replica_id, req_id=req_id,
+                    tokens=[int(t) for t in rec["tokens"]],
+                    ok=True, replayed=True,
+                ))
+
+    def run(self) -> None:
+        """Blocking: register, serve until drained, deregister."""
+        self.register()
+        try:
+            self.server.serve_incremental(
+                tick=self.tick,
+                on_finish=self._on_finish,
+                on_token=self._on_token,
+            )
+        finally:
+            self._call_quiet(ServeReplicaDeregister(
+                replica_id=self.replica_id
+            ))
+            if self.journal is not None:
+                self.journal.close()
+
+    def tick(self) -> bool:
+        """One admission-point visit from the decode loop: rate-limited
+        gateway poll + stream flush.  Returns False once draining is
+        complete (the serve loop then finishes in-flight work and
+        returns)."""
+        chaos.inject("serving.slow_replica", replica=self.replica_id)
+        if chaos.inject(
+            "serving.replica_kill", replica=self.replica_id,
+            step=self.served,
+        ) is not None:
+            # crash kind: inject() already called os._exit; this branch
+            # only runs when a test stubs the plan to a flag.
+            self._stopped = True
+        if self.round_floor_s > 0:
+            time.sleep(self.round_floor_s)
+        now = self._clock()
+        if now - self._last_poll < self.poll_interval:
+            return not self._stopped and not self._done_draining()
+        self._last_poll = now
+        self._flush_streams()
+        reply = self._call_quiet(ServeReplicaPoll(
+            replica_id=self.replica_id,
+            free_slots=self.server.free_slots(),
+            active=self._owned_rids(),
+            stats=self._stats(),
+        ))
+        if isinstance(reply, ServeGrants):
+            if not reply.known:
+                # Gateway restarted: re-register (and re-replay the
+                # journal — dedupe makes it cheap) before the next poll.
+                logger.info(
+                    "replica %s: gateway lost us; re-registering",
+                    self.replica_id,
+                )
+                self.register()
+            for rid_key in reply.cancel:
+                # Pending: drop before admission.  In-flight: shed the
+                # slot mid-decode (abort discards the partial output
+                # and frees the slot for live work — a deadline-expired
+                # request must not occupy a slot to its full budget).
+                abort = getattr(self.server, "abort", None)
+                if self.server.cancel(rid_key) or (
+                    abort is not None and abort(rid_key)
+                ):
+                    self._forget(rid_key)
+            for grant in reply.requests:
+                self._admit(grant)
+            if reply.drain:
+                self._draining = True
+        return not self._stopped and not self._done_draining()
+
+    # -- internals --------------------------------------------------------
+
+    def _done_draining(self) -> bool:
+        return self._draining and not self._owned_rids()
+
+    def _owned_rids(self) -> List[str]:
+        return list(self.server.active_rids()) + \
+            list(self.server.pending_rids())
+
+    def _admit(self, grant) -> None:
+        rid_key = grant.req_id
+        if rid_key in self._granted or rid_key in self._owned_rids():
+            return  # duplicate grant (shouldn't happen; be safe)
+        if self.journal is not None:
+            cached = self.journal.lookup(rid_key, grant.prompt)
+            if cached is not None:
+                # This replica already served it in a previous
+                # incarnation: answer from the journal, never re-decode.
+                self.replayed += 1
+                self._call_quiet(ServeDone(
+                    replica_id=self.replica_id, req_id=rid_key,
+                    tokens=cached, ok=True, replayed=True,
+                ))
+                return
+        if chaos.inject(
+            "serving.drop_request", replica=self.replica_id,
+        ) is not None:
+            # Simulate the grant evaporating before admission: the
+            # gateway's poll-reconcile must re-dispatch it.
+            self.dropped += 1
+            logger.warning(
+                "replica %s: chaos dropped request %s",
+                self.replica_id, rid_key,
+            )
+            return
+        try:
+            self.server.submit(
+                rid_key, np.asarray(grant.prompt, np.int32),
+                grant.max_new_tokens,
+            )
+        except ValueError as e:
+            # Can never fit this replica's cache: a terminal, visible
+            # failure beats a silent requeue loop.
+            self._call_quiet(ServeDone(
+                replica_id=self.replica_id, req_id=rid_key,
+                tokens=[], ok=False, reason=f"capacity: {e}",
+            ))
+            return
+        self._granted[rid_key] = {
+            "prompt": [int(t) for t in grant.prompt],
+        }
+        self._admitted_at[rid_key] = self._clock()
+
+    def _on_token(self, rid_key, tok) -> None:
+        self._stream_buf.setdefault(rid_key, []).append(int(tok))
+        self._win_tokens += 1
+        if rid_key not in self._first_token_at:
+            now = self._clock()
+            self._first_token_at[rid_key] = now
+            admitted = self._admitted_at.get(rid_key)
+            if admitted is not None:
+                self._last_ttft_ms = (now - admitted) * 1000.0
+
+    def _on_finish(self, rid_key, tokens) -> None:
+        grant = self._granted.get(rid_key)
+        prompt = grant["prompt"] if grant else []
+        # The result contract strips the echoed prompt: the gateway
+        # client gets exactly the NEW tokens (the journal stores the
+        # same, so replay and fresh serve agree byte-for-byte).
+        new_tokens = [int(t) for t in tokens[len(prompt):]]
+        if self.journal is not None:
+            self.journal.append(rid_key, prompt, new_tokens)
+        self.served += 1
+        self._flush_streams(only=rid_key)
+        self._call_quiet(ServeDone(
+            replica_id=self.replica_id, req_id=rid_key,
+            tokens=new_tokens, ok=True,
+        ))
+        self._forget(rid_key)
+
+    def _forget(self, rid_key) -> None:
+        self._granted.pop(rid_key, None)
+        self._stream_buf.pop(rid_key, None)
+        self._admitted_at.pop(rid_key, None)
+        self._first_token_at.pop(rid_key, None)
+
+    def _flush_streams(self, only=None) -> None:
+        keys = [only] if only is not None else list(self._stream_buf)
+        for rid_key in keys:
+            buf = self._stream_buf.get(rid_key)
+            if not buf:
+                continue
+            self._stream_buf[rid_key] = []
+            self._call_quiet(ServeTokens(
+                replica_id=self.replica_id, req_id=rid_key,
+                tokens=buf,
+            ))
+
+    def _stats(self) -> Dict[str, Any]:
+        now = self._clock()
+        span = now - self._win_start
+        if span >= 1.0:
+            self._last_tps = self._win_tokens / span
+            self._win_start = now
+            self._win_tokens = 0
+        active = len(self.server.active_rids())
+        stats = {
+            "slot_occupancy": active / max(1, self.server.slots),
+            "queue_depth": self.server.pending_count(),
+            "tokens_per_sec": round(self._last_tps, 2),
+            "ttft_ms_last": round(self._last_ttft_ms, 2),
+            "served": self.served,
+            "replayed": self.replayed,
+        }
+        last = getattr(self.server, "last_stats", None)
+        if last and "tokens_per_round" in last:
+            # Speculative acceptance (or plain tokens/round) telemetry.
+            stats["tokens_per_round"] = round(
+                last["tokens_per_round"], 3
+            )
+        return stats
+
+    def _call_quiet(self, msg):
+        """Control-plane sends are best-effort from the decode loop's
+        perspective: a flapping gateway must not kill the replica (the
+        lease/reconcile machinery recovers the state)."""
+        try:
+            return self.transport.call(msg)
+        except Exception as e:  # noqa: BLE001
+            logger.warning(
+                "replica %s: %s to gateway failed: %s",
+                self.replica_id, type(msg).__name__, e,
+            )
+            return None

@@ -68,13 +68,17 @@ def main() -> int:
 
     from dlrover_tpu.models import llama, llama_infer
 
+    try:
+        from examples import serve_common
+    except ImportError:  # run as a script: examples/ is sys.path[0]
+        import serve_common
+
     if args.hf_dir:
         from dlrover_tpu.models import hf_convert
 
         params, cfg = hf_convert.from_hf_llama_dir(args.hf_dir)
     else:
-        cfg = llama.LlamaConfig.tiny(n_layer=2)
-        params = llama.init_params(jax.random.PRNGKey(args.seed), cfg)
+        params, cfg = serve_common.tiny_llama(seed=args.seed)
 
     if args.stream and args.speculative:
         raise SystemExit(
@@ -95,11 +99,9 @@ def main() -> int:
         params, _ = llama_infer.shard_params_for_decode(
             params, cfg, mesh
         )
-    rng = np.random.RandomState(args.seed)
-    prompts = [
-        rng.randint(1, cfg.vocab_size, size=(int(n),)).astype(np.int32)
-        for n in rng.randint(4, 12, size=(args.requests,))
-    ]
+    prompts, rng = serve_common.seeded_requests(
+        cfg, args.requests, args.seed
+    )
 
     t0 = time.perf_counter()
     if args.speculative:

@@ -45,23 +45,25 @@ def main() -> int:
 
     ctx = trainer_sdk.init()
 
-    import jax
     import jax.numpy as jnp
-    import numpy as np
 
-    from dlrover_tpu.models import llama, llama_infer
+    from dlrover_tpu.models import llama_infer
+
+    try:
+        from examples import serve_common
+    except ImportError:  # launched as a worker script
+        import serve_common
 
     # Seeded model + requests: a restarted worker rebuilds the SAME
     # server, so greedy replay is byte-identical.  float32 keeps the
     # continuation independent of slot-batch shape too (bf16 argmax can
     # flip near ties between batched and solo scoring).
-    cfg = llama.LlamaConfig.tiny(n_layer=2, dtype=jnp.float32)
-    params = llama.init_params(jax.random.PRNGKey(args.seed), cfg)
-    rng = np.random.RandomState(args.seed + 1)
-    prompts = [
-        rng.randint(1, cfg.vocab_size, size=(int(n),)).astype(np.int32)
-        for n in rng.randint(4, 12, size=(args.requests,))
-    ]
+    params, cfg = serve_common.tiny_llama(
+        seed=args.seed, dtype=jnp.float32
+    )
+    prompts, _ = serve_common.seeded_requests(
+        cfg, args.requests, args.seed + 1
+    )
     os.makedirs(args.journal_dir, exist_ok=True)
     journal = os.path.join(args.journal_dir, "results.jsonl")
 

@@ -1,0 +1,261 @@
+"""Elastic serving fleet example: N drain-aware replicas behind one
+gateway (ISSUE 5) — the multi-replica generalization of
+``llama_serve_elastic.py``.
+
+Single-process demo (gateway + replicas as threads, loopback driver)::
+
+    python examples/llama_serve_fleet.py --replicas 2 --requests 12
+
+Process-per-role (what the chaos e2e and ``bench.py --serve_bench``
+compose; each role is also how a supervised deployment runs under the
+elastic agent)::
+
+    python examples/llama_serve_fleet.py --role gateway --port 8710
+    python examples/llama_serve_fleet.py --role replica \
+        --gateway 127.0.0.1:8710 --replica_id r0 --journal_dir /tmp/j
+    python examples/llama_serve_fleet.py --role driver \
+        --gateway 127.0.0.1:8710 --requests 12 --rps 20
+
+Every replica rebuilds the SAME seeded float32 tiny-llama
+(``serve_common``), so greedy decode is byte-identical across replicas
+— a re-dispatched request completes with exactly the tokens its first
+assignment would have produced, and journal replay after a kill agrees
+with a fresh decode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--role", default="all",
+                   choices=("all", "gateway", "replica", "driver"))
+    p.add_argument("--port", type=int, default=0,
+                   help="(gateway) listen port; 0 = ephemeral")
+    p.add_argument("--gateway", default="",
+                   help="(replica/driver) gateway host:port")
+    p.add_argument("--replica_id", default="r0")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="(all) replica threads to run")
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--max_len", type=int, default=96)
+    p.add_argument("--requests", type=int, default=12)
+    p.add_argument("--max_new_tokens", type=int, default=16)
+    p.add_argument("--rps", type=float, default=50.0,
+                   help="(driver) Poisson arrival rate")
+    p.add_argument("--deadline_s", type=float, default=0.0)
+    p.add_argument("--journal_dir", default="")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--poll_interval", type=float, default=0.02)
+    p.add_argument("--round_floor_ms", type=float, default=0.0,
+                   help="(replica) per-round latency floor — models "
+                        "the device-bound regime on a shared-CPU host")
+    p.add_argument("--queue_cap", type=int, default=256)
+    p.add_argument("--lease_timeout", type=float, default=10.0,
+                   help="(gateway) seconds without a poll before a "
+                        "replica is presumed dead and its work "
+                        "re-dispatched")
+    p.add_argument("--timeout", type=float, default=120.0)
+    return p.parse_args(argv)
+
+
+def build_replica(args, transport):
+    """One seeded replica: tiny float32 llama + DecodeServer +
+    ReplicaRunner (all replicas identical by construction)."""
+    import os
+
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import llama_infer
+    from dlrover_tpu.serving import ReplicaRunner
+
+    try:
+        from examples import serve_common
+    except ImportError:  # run as a script
+        import serve_common
+
+    params, cfg = serve_common.tiny_llama(
+        seed=args.seed, dtype=jnp.float32
+    )
+    srv = llama_infer.DecodeServer(
+        params, cfg, slots=args.slots, max_len=args.max_len,
+        prompt_buckets=(16, 32), seed=args.seed,
+    )
+    import numpy as np
+
+    # Warm the compile caches BEFORE registering with the gateway: the
+    # fleet's TTFT percentiles must measure admission+decode latency,
+    # not the first request's XLA compile (~1.5s for even the tiny
+    # model on CPU).
+    srv.serve([np.arange(1, 5, dtype=np.int32)], max_new_tokens=2)
+    journal = None
+    if args.journal_dir:
+        os.makedirs(args.journal_dir, exist_ok=True)
+        journal = os.path.join(
+            args.journal_dir, f"{args.replica_id}.jsonl"
+        )
+    return ReplicaRunner(
+        srv, transport, args.replica_id, journal_path=journal,
+        poll_interval=args.poll_interval,
+        round_floor_s=args.round_floor_ms / 1000.0,
+    )
+
+
+def drive(args, transport, core=None):
+    """Submit the seeded request stream at Poisson arrivals, poll every
+    result, print the summary line the tests and bench key on."""
+    import numpy as np
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.serving import ServeClient
+
+    try:
+        from examples import serve_common
+    except ImportError:
+        import serve_common
+
+    cfg = llama.LlamaConfig.tiny(n_layer=2)
+    prompts, _ = serve_common.seeded_requests(
+        cfg, args.requests, args.seed + 1
+    )
+    arr_rng = np.random.RandomState(args.seed + 7)
+    gaps = arr_rng.exponential(1.0 / max(args.rps, 1e-6),
+                               size=args.requests)
+    client = ServeClient(transport)
+    t0 = time.perf_counter()
+    for i, prompt in enumerate(prompts):
+        time.sleep(float(gaps[i]))
+        ack = client.submit(
+            f"req-{i}", prompt, args.max_new_tokens,
+            deadline_s=args.deadline_s,
+        )
+        print(f"SUBMIT req-{i} status={ack.status}", flush=True)
+    done = 0
+    total_new = 0
+    for i in range(args.requests):
+        reply = client.result(f"req-{i}", timeout=args.timeout)
+        n = len(reply.tokens)
+        print(
+            f"RESULT req-{i} state={reply.state} new_tokens={n} "
+            f"replica={reply.replica}", flush=True,
+        )
+        if reply.state == "done":
+            done += 1
+            total_new += n
+    dt = time.perf_counter() - t0
+    extra = ""
+    if core is not None:
+        c = core.stats_snapshot()["counters"]
+        extra = (f" redispatched={c['redispatched']} "
+                 f"duplicates={c['duplicate_completions']}")
+    print(
+        f"FLEET_DONE requests={args.requests} completed={done} "
+        f"new_tokens={total_new} tokens_per_sec={total_new / dt:.1f}"
+        f"{extra}", flush=True,
+    )
+    return 0 if done == args.requests else 1
+
+
+def main() -> int:
+    args = parse_args()
+
+    from dlrover_tpu.common.jax_env import ensure_platform
+
+    ensure_platform()
+
+    if args.role == "gateway":
+        from dlrover_tpu.serving import Gateway, GatewayConfig
+
+        gw = Gateway(port=args.port, config=GatewayConfig(
+            queue_cap=args.queue_cap,
+            lease_timeout_s=args.lease_timeout,
+        ))
+        gw.start()
+        print(f"GATEWAY_READY port={gw.port}", flush=True)
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
+        while not stop.wait(2.0):
+            snap = gw.core.stats_snapshot()
+            print(
+                "FLEET_STATS "
+                + json.dumps({
+                    "queue": snap["queue_depth"],
+                    "alive": snap["replicas_alive"],
+                    "occupancy": round(snap["occupancy"], 3),
+                    "completed": snap["counters"]["completed"],
+                    "ttft_p95_ms": gw.ttft_ms.percentile(0.95),
+                }), flush=True,
+            )
+        gw.stop()
+        return 0
+
+    if args.role == "replica":
+        from dlrover_tpu.common.rpc import RpcClient
+
+        class _T:
+            """RpcClient with the runner's best-effort budget."""
+
+            def __init__(self, addr):
+                self._c = RpcClient(addr, timeout=5.0)
+
+            def call(self, msg, **kw):
+                return self._c.call(msg, deadline=10.0,
+                                    idempotent=True, **kw)
+
+        runner = build_replica(args, _T(args.gateway))
+        print(f"REPLICA_READY id={args.replica_id}", flush=True)
+        runner.run()
+        print(
+            f"REPLICA_DONE id={args.replica_id} served="
+            f"{runner.served} replayed={runner.replayed}", flush=True,
+        )
+        return 0
+
+    if args.role == "driver":
+        from dlrover_tpu.common.rpc import RpcClient
+
+        return drive(args, RpcClient(args.gateway, timeout=10.0))
+
+    # --role all: one-process fleet (demo): loopback gateway, replica
+    # threads, inline driver.
+    from dlrover_tpu.serving import (
+        Gateway,
+        GatewayConfig,
+        LoopbackTransport,
+    )
+
+    gw = Gateway(port=0, config=GatewayConfig(queue_cap=args.queue_cap))
+    gw.start()
+    transport = LoopbackTransport(gw.handle)
+    threads = []
+    runners = []
+    for i in range(args.replicas):
+        rargs = argparse.Namespace(**vars(args))
+        rargs.replica_id = f"r{i}"
+        runner = build_replica(rargs, transport)
+        runners.append(runner)
+        th = threading.Thread(target=runner.run, daemon=True,
+                              name=f"replica-{i}")
+        th.start()
+        threads.append(th)
+    try:
+        rc = drive(args, transport, core=gw.core)
+    finally:
+        for runner in runners:
+            gw.core.drain(runner.replica_id)
+        for th in threads:
+            th.join(timeout=30)
+        gw.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

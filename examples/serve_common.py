@@ -1,0 +1,39 @@
+"""Shared setup for the serving examples: one seeded tiny-llama and one
+seeded request stream, so ``llama_serve.py``, ``llama_serve_elastic.py``
+and ``llama_serve_fleet.py`` cannot drift apart on the model/workload
+they demonstrate (and the elastic/fleet replay contracts — which depend
+on every incarnation rebuilding the SAME model and prompts — are
+spelled in exactly one place)."""
+
+from __future__ import annotations
+
+
+def tiny_llama(seed: int = 0, n_layer: int = 2, dtype=None):
+    """Seeded tiny Llama: ``(params, cfg)``.  ``dtype`` (e.g.
+    ``jnp.float32``) pins the decode numerics — the elastic/fleet
+    examples use float32 so greedy replay is byte-identical independent
+    of slot-batch shape (bf16 argmax can flip near ties)."""
+    import jax
+
+    from dlrover_tpu.models import llama
+
+    kw = {} if dtype is None else {"dtype": dtype}
+    cfg = llama.LlamaConfig.tiny(n_layer=n_layer, **kw)
+    params = llama.init_params(jax.random.PRNGKey(seed), cfg)
+    return params, cfg
+
+
+def seeded_requests(cfg, requests: int, seed: int,
+                    min_len: int = 4, max_len: int = 12):
+    """The seeded mixed-length request stream: ``(prompts, rng)``.
+    ``rng`` continues the stream (``llama_serve.py`` draws its shared
+    prefix from it) so callers reproduce the exact pre-refactor
+    draws."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=(int(n),)).astype(np.int32)
+        for n in rng.randint(min_len, max_len, size=(requests,))
+    ]
+    return prompts, rng

@@ -157,3 +157,47 @@ def test_progress_handles_closed_after_measurement(tmp_path):
     bench._progress_mark(sidecar, "again")
     bench._progress_close()
     assert sum(1 for _ in open(sidecar)) == 3
+
+
+def test_serve_bench_smoke_schema(tmp_path):
+    """Tier-1 gate for ISSUE 5's serving-fleet bench: the smoke config
+    (one in-process loopback replica, tiny workload, no round floor)
+    runs end-to-end on CPU inside the budget and emits schema-valid
+    JSON — the workload block, a complete single-replica row with TTFT
+    percentiles, and the standard metric line."""
+    import os
+    import subprocess
+    import time
+
+    out = tmp_path / "SERVE_BENCH_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DLROVER_TPU_FAULTS", None)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(Path(bench.__file__)), "--serve_bench",
+         "--smoke", f"--out={out}"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(Path(bench.__file__).parent),
+    )
+    elapsed = time.time() - t0
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # <5s is the spec on an idle host; allow CI contention headroom but
+    # fail loudly if the smoke config ever becomes heavyweight.
+    assert elapsed < 30.0, f"smoke serve bench took {elapsed:.1f}s"
+    result = json.loads(out.read_text())
+    assert result["complete"] is True
+    assert result["workload"]["requests"] == 5
+    assert result["device_round_ms"] == 0.0
+    assert len(result["rows"]) == 1
+    row = result["rows"][0]
+    assert row["replicas"] == 1
+    assert row["completed"] == 5
+    assert row["new_tokens"] == 5 * 6  # full budget, greedy, no EOS
+    assert row["tokens_per_sec"] > 0
+    assert row["ttft_ms_p50"] > 0 and row["ttft_ms_p99"] >= \
+        row["ttft_ms_p50"]
+    assert row["latency_ms_p99"] >= row["latency_ms_p50"]
+    assert row["rejected"] == 0 and row["redispatched"] == 0
+    metric = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert metric["metric"] == "serve_fleet_speedup"
+    assert metric["artifact"] == str(out)

@@ -363,3 +363,184 @@ class TestCorruptCommittedShard:
         )
         assert fsck2.returncode == 1
         assert "quarantined" in fsck2.stdout.lower()
+
+
+@pytest.mark.serving
+class TestServingFleetKillAndDrain:
+    """ISSUE 5 flagship: a 2-replica fleet under a real process tree.
+
+    Replica r0 is chaos-killed mid-stream (``serving.replica_kill``
+    fires after its 2nd completion, with work in flight); the gateway
+    re-dispatches its in-flight requests, the relaunched r0 replays its
+    journal, and EVERY admitted request completes exactly once — no
+    loss (all results arrive), no duplicate (the gateway's completed
+    counter equals the request count; journal-replay dupes are counted
+    and dropped).  Then a scale-down drain retires one replica with
+    requests in flight and nothing observes the shrink."""
+
+    def _spawn(self, tmp_path, name, argv, env_extra=None):
+        log = open(tmp_path / f"{name}.log", "w")
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "examples", "llama_serve_fleet.py"),
+             *argv],
+            cwd=REPO, env=_env(env_extra), stdout=log,
+            stderr=subprocess.STDOUT, start_new_session=True,
+        )
+        return proc, tmp_path / f"{name}.log"
+
+    def test_exactly_once_across_kill_and_drain(self, tmp_path):
+        from dlrover_tpu.common.messages import (
+            ServeDrainRequest,
+            ServeFleetStats,
+            ServeFleetStatsRequest,
+        )
+        from dlrover_tpu.common.rpc import RpcClient, find_free_port
+        from dlrover_tpu.serving import ServeClient
+
+        port = find_free_port()
+        journal_dir = str(tmp_path / "journals")
+        procs = []
+        gw_proc, gw_log = self._spawn(
+            tmp_path, "gateway",
+            ["--role", "gateway", "--port", str(port),
+             "--lease_timeout", "3"],
+        )
+        procs.append(gw_proc)
+
+        def spawn_replica(rid, faults=None):
+            extra = {"DLROVER_TPU_FAULTS": faults} if faults else None
+            proc, log = self._spawn(
+                tmp_path, f"replica-{rid}",
+                ["--role", "replica", "--gateway",
+                 f"127.0.0.1:{port}", "--replica_id", rid,
+                 "--slots", "2", "--max_len", "64",
+                 "--journal_dir", journal_dir,
+                 "--poll_interval", "0.02",
+                 "--round_floor_ms", "40"],
+                env_extra=extra,
+            )
+            procs.append(proc)
+            return proc, log
+
+        try:
+            # r0 dies the moment its 3rd completion would start
+            # (served==2), leaving admitted work in flight.
+            r0, r0_log = spawn_replica(
+                "r0", faults="serving.replica_kill:step=2",
+            )
+            r1, _ = spawn_replica("r1")
+            rpc = RpcClient(f"127.0.0.1:{port}", timeout=10.0)
+
+            def fleet_stats():
+                reply = rpc.call(ServeFleetStatsRequest(),
+                                 idempotent=True)
+                assert isinstance(reply, ServeFleetStats), reply
+                return reply.stats
+
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                try:
+                    if fleet_stats()["replicas_alive"] >= 2:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            else:
+                raise AssertionError(
+                    "fleet never formed: " + _read(gw_log)[-2000:]
+                )
+
+            client = ServeClient(rpc, poll_interval=0.05)
+            n_req = 12
+            prompts = [[(7 * i + j) % 50 + 1 for j in range(5)]
+                       for i in range(n_req)]
+            # STAGGERED budgets: equal budgets finish a replica's two
+            # slots in the same emit pass, and the kill (which fires at
+            # the tick AFTER the 2nd completion) would then land with
+            # nothing in flight.  Desynchronized completions guarantee
+            # r0 dies holding admitted work — the re-dispatch path
+            # under test.
+            budgets = [8 + (i % 7) for i in range(n_req)]
+            for i, prompt in enumerate(prompts):
+                ack = client.submit(f"req-{i}", prompt, budgets[i])
+                assert ack.status in ("accepted", "done"), ack
+
+            # The chaos kill lands mid-stream: r0 exits 78.
+            rc0 = r0.wait(timeout=120)
+            assert rc0 == 78, _read(r0_log)[-2000:]
+
+            # The supervisor's role: relaunch r0 (spent crash site
+            # scrubbed), same journal -> replay + re-register.
+            r0b, r0b_log = spawn_replica("r0")
+
+            results = {}
+            for i in range(n_req):
+                reply = client.result(f"req-{i}", timeout=120)
+                assert reply.state == "done", (
+                    f"req-{i}: {reply.state} {reply.reason}; gateway: "
+                    + _read(gw_log)[-2000:]
+                )
+                results[i] = list(reply.tokens)
+                # Full budget, no EOS cut, whoever served it.
+                assert len(results[i]) == budgets[i]
+
+            # r0's relaunch replays its journal when it registers —
+            # wait for that report to land (its pre-kill completions
+            # were already answered, so the replay MUST dedupe).
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                c = fleet_stats()["counters"]
+                if c["duplicate_completions"] >= 1:
+                    break
+                time.sleep(0.5)
+            stats = fleet_stats()
+            c = stats["counters"]
+            # No loss, no duplicate: every admitted request completed
+            # EXACTLY once at the gateway.
+            assert c["completed"] == n_req, c
+            assert c["failed"] == 0 and c["timeout"] == 0, c
+            # The kill actually cost in-flight work that was
+            # re-dispatched (lease expiry or r0's re-register).
+            assert c["redispatched"] >= 1, c
+            # r0's journal replay re-reported its pre-kill completions;
+            # dedupe dropped them.
+            assert c["duplicate_completions"] >= 1, c
+
+            # Exactly-once is also client-visible: resubmitting every
+            # request answers from the dedupe cache with the SAME
+            # tokens (no second decode, byte-identical).
+            for i in range(n_req):
+                ack = client.submit(f"req-{i}", prompts[i], budgets[i])
+                assert ack.status == "done", ack
+                assert list(ack.tokens) == results[i]
+            assert fleet_stats()["counters"]["completed"] == n_req
+
+            # --- scale-down drain with requests in flight ---
+            for i in range(6):
+                client.submit(f"late-{i}", prompts[i], 12)
+            assert rpc.call(
+                ServeDrainRequest(replica_id="r1")
+            ).success
+            for i in range(6):
+                reply = client.result(f"late-{i}", timeout=120)
+                assert reply.state == "done", (reply.state,
+                                               reply.reason)
+                assert len(reply.tokens) == 12
+            # The drained replica exits cleanly after finishing its
+            # in-flight work; the fleet shrinks to r0 only.
+            assert r1.wait(timeout=60) == 0, _read(gw_log)[-1000:]
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if fleet_stats()["replicas_alive"] == 1:
+                    break
+                time.sleep(0.5)
+            stats = fleet_stats()
+            assert stats["replicas_alive"] == 1, stats
+            c = stats["counters"]
+            assert c["completed"] == n_req + 6, c
+            assert c["failed"] == 0 and c["timeout"] == 0, c
+            content = _read(tmp_path / "replica-r0.log")
+            assert "REPLICA_READY id=r0" in content
+        finally:
+            _terminate(procs)

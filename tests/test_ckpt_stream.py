@@ -648,3 +648,66 @@ class TestSpeedMonitorStall:
         sm.mark_down()
         sm.record_ckpt_stall(5.0)
         assert sm.ckpt_stall_total == 0.0  # charged to downtime already
+
+
+class TestWorkerPerfTTLCache:
+    """``AsyncCheckpointSaver.worker_perf``'s 1s TTL cache (ISSUE 4
+    follow-up): one Prometheus scrape samples several gauges, and each
+    must NOT cost its own SharedDict round trip against a possibly-sick
+    stat server — one bounded trip per TTL window, fresh values after
+    expiry."""
+
+    def _saver(self):
+        from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+        class FakeStat:
+            def __init__(self):
+                self.calls = 0
+                self.data = {"stall_ms_0": 40.0, "staged_mbps_0": 5000.0}
+
+            def to_dict(self, timeout=None):
+                self.calls += 1
+                return dict(self.data)
+
+        saver = AsyncCheckpointSaver.__new__(AsyncCheckpointSaver)
+        saver._stat = FakeStat()
+        saver._perf_cache = (0.0, {})
+        return saver
+
+    def test_one_round_trip_per_ttl_window(self):
+        import time as _time
+
+        saver = self._saver()
+        # One scrape samples several gauges; all ride ONE snapshot.
+        assert saver.worker_perf() == saver._stat.data
+        assert saver.last_stall_ms() == 40.0
+        assert saver.staged_mbps() == 5000.0
+        assert saver._stat.calls == 1
+
+    def test_fresh_values_after_expiry(self):
+        import time as _time
+
+        saver = self._saver()
+        saver.worker_perf()
+        assert saver._stat.calls == 1
+        saver._stat.data = {"stall_ms_0": 99.0, "staged_mbps_0": 100.0}
+        # Inside the window: stale-by-design snapshot, no new trip.
+        assert saver.last_stall_ms() == 40.0
+        assert saver._stat.calls == 1
+        # Age the cache past the 1s TTL: the next sample re-fetches.
+        ts, snap = saver._perf_cache
+        saver._perf_cache = (_time.time() - 1.5, snap)
+        assert saver.last_stall_ms() == 99.0
+        assert saver._stat.calls == 2
+
+    def test_failed_snapshot_degrades_to_empty_not_raise(self):
+        saver = self._saver()
+
+        def boom(timeout=None):
+            saver._stat.calls += 1
+            raise TimeoutError("stat server hung")
+
+        saver._stat.to_dict = boom
+        assert saver.worker_perf() == {}
+        assert saver.last_stall_ms() == 0.0  # rides the cached {}
+        assert saver._stat.calls == 1

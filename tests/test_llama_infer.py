@@ -1780,3 +1780,86 @@ class TestServeStats:
         )
         assert srv.last_stats["emitted_tokens"] == 4 - 1
         assert srv.last_stats != first
+
+
+class TestIncrementalAdmission:
+    """The fleet-replica surface on the REAL server (ISSUE 5):
+    submit()/serve_incremental feed slots mid-decode, every request
+    carries its own budget, abort() sheds an in-flight slot, and the
+    results match batch serve() exactly."""
+
+    def _server(self, slots=2):
+        cfg = llama.LlamaConfig.tiny(n_layer=1, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        return llama_infer.DecodeServer(
+            params, cfg, slots=slots, max_len=48,
+            prompt_buckets=(8, 16),
+        ), cfg
+
+    def test_incremental_matches_batch_with_per_request_budgets(self):
+        srv, cfg = self._server()
+        rng = np.random.RandomState(5)
+        prompts = [
+            rng.randint(1, cfg.vocab_size, n).astype(np.int32)
+            for n in (3, 7, 5, 4)
+        ]
+        budgets = [4, 6, 3, 5]
+        finished = {}
+        fed = [0]
+
+        def tick():
+            # Feed one request per tick while any remain; stop once
+            # everything submitted AND finished.
+            if fed[0] < len(prompts):
+                srv.submit(fed[0], prompts[fed[0]], budgets[fed[0]])
+                fed[0] += 1
+                return True
+            return len(finished) < len(prompts)
+
+        res = srv.serve_incremental(
+            tick=tick, on_finish=lambda r, t: finished.__setitem__(r, t),
+        )
+        assert res == {}  # incremental mode retains nothing
+        assert set(finished) == {0, 1, 2, 3}
+        for i, p in enumerate(prompts):
+            # Each equals its solo batch-serve decode at ITS budget.
+            solo = srv.serve([p], max_new_tokens=budgets[i])[0]
+            np.testing.assert_array_equal(finished[i], solo)
+            assert len(finished[i]) == len(p) + budgets[i]
+
+    def test_abort_sheds_in_flight_slot_and_readmits(self):
+        srv, cfg = self._server(slots=1)
+        rng = np.random.RandomState(6)
+        long_p = rng.randint(1, cfg.vocab_size, 4).astype(np.int32)
+        short_p = rng.randint(1, cfg.vocab_size, 4).astype(np.int32)
+        finished = {}
+        state = {"fed": False, "aborted": False}
+
+        def tick():
+            if not state["fed"]:
+                srv.submit("long", long_p, 30)
+                srv.submit("short", short_p, 3)
+                state["fed"] = True
+                return True
+            if not state["aborted"] and "long" in srv.active_rids():
+                # Shed the long request mid-decode: the single slot
+                # must free for "short".
+                assert srv.abort("long")
+                state["aborted"] = True
+                return True
+            return "short" not in finished
+
+        srv.serve_incremental(
+            tick=tick,
+            on_finish=lambda r, t: finished.__setitem__(r, t),
+        )
+        # The aborted request never finished; the short one did, on
+        # the slot the abort freed.
+        assert set(finished) == {"short"}
+        solo = srv.serve([short_p], max_new_tokens=3)[0]
+        np.testing.assert_array_equal(finished["short"], solo)
+
+    def test_submit_capacity_check_rejects_immediately(self):
+        srv, cfg = self._server()
+        with pytest.raises(ValueError, match="exceeds max_len"):
+            srv.submit("x", np.arange(1, 9, dtype=np.int32), 100)

@@ -1,0 +1,1006 @@
+"""Serving-fleet control-plane units (ISSUE 5) — tier-1, sub-second.
+
+Everything here runs WITHOUT jax or sockets: the gateway core takes an
+injectable clock, the replica runner takes a fake decode server with
+the real incremental-admission surface, and transports are loopback.
+The real-model integration rides the ``serving+slow`` e2e lane
+(``test_chaos_e2e.py``) and ``bench.py --serve_bench``.
+"""
+
+import collections
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.common.messages import (
+    ServeDone,
+    ServeGrants,
+    ServeReplicaDeregister,
+    ServeReplicaPoll,
+    ServeReplicaRegister,
+    ServeSubmit,
+    ServeTokens,
+    deserialize,
+    serialize,
+)
+from dlrover_tpu.serving import (
+    GatewayConfig,
+    GatewayCore,
+    LoopbackTransport,
+    ReplicaRunner,
+    ScalePolicy,
+    ScaleState,
+    decide,
+)
+
+pytestmark = pytest.mark.serving
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_core(**kw):
+    clock = FakeClock()
+    cfg = GatewayConfig(**kw)
+    return GatewayCore(cfg, clock=clock), clock
+
+
+# ---------------------------------------------------------------------------
+# Admission / backpressure / dedupe
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_accept_then_reject_past_cap_with_retry_after(self):
+        core, _ = make_core(queue_cap=2, retry_after_s=1.5)
+        assert core.submit("a", [1], 4).status == "accepted"
+        assert core.submit("b", [2], 4).status == "accepted"
+        ack = core.submit("c", [3], 4)
+        assert ack.status == "rejected"
+        assert ack.retry_after_s == 1.5
+        assert "queue full" in ack.reason
+        assert core.counters["rejected"] == 1
+
+    def test_cap_counts_assigned_work_not_just_queued(self):
+        """Backpressure is on total in-flight: granting work to a
+        replica must not open admission back up."""
+        core, _ = make_core(queue_cap=2)
+        core.register("r0", 2)
+        core.submit("a", [1], 4)
+        core.submit("b", [2], 4)
+        core.poll("r0", 2, [])  # both now assigned, queue empty
+        assert core.submit("c", [3], 4).status == "rejected"
+
+    def test_duplicate_submit_while_in_flight_is_single_entry(self):
+        core, _ = make_core()
+        core.submit("a", [1], 4)
+        ack = core.submit("a", [1], 4)
+        assert ack.status == "accepted"
+        assert ack.reason == "duplicate-submit"
+        assert core.stats_snapshot()["queue_depth"] == 1
+
+    def test_resubmit_of_completed_request_answers_from_cache(self):
+        """The req-id IS the idempotency token: a client retry after
+        the answer was produced never decodes twice."""
+        core, _ = make_core()
+        core.register("r0", 1)
+        core.submit("a", [1], 4)
+        core.poll("r0", 1, [])
+        core.complete("r0", "a", [7, 8, 9])
+        ack = core.submit("a", [1], 4)
+        assert ack.status == "done"
+        assert ack.tokens == [7, 8, 9]
+        assert core.counters["dedupe_hits"] == 1
+        assert core.counters["completed"] == 1
+
+    def test_status_lifecycle(self):
+        core, _ = make_core()
+        assert core.status("a").state == "unknown"
+        core.submit("a", [1], 4)
+        assert core.status("a").state == "queued"
+        core.register("r0", 1)
+        core.poll("r0", 1, [])
+        assert core.status("a").state == "running"
+        core.stream("r0", "a", [5])
+        assert core.status("a").tokens == [5]
+        core.complete("r0", "a", [5, 6])
+        st = core.status("a")
+        assert st.state == "done" and st.tokens == [5, 6]
+        assert st.replica == "r0"
+
+
+# ---------------------------------------------------------------------------
+# Routing / grants
+# ---------------------------------------------------------------------------
+
+
+class TestRouting:
+    def test_grants_capped_by_free_slots(self):
+        core, _ = make_core()
+        core.register("r0", 4)
+        for i in range(5):
+            core.submit(f"q{i}", [i], 4)
+        g = core.poll("r0", 2, [])
+        assert [r.req_id for r in g.requests] == ["q0", "q1"]
+        g = core.poll("r0", 0, ["q0", "q1"])
+        assert g.requests == []
+
+    def test_work_flows_to_the_replica_with_free_slots(self):
+        """Pull routing == least-loaded routing: the saturated replica
+        polls with 0 free slots and gets nothing; the idle one drains
+        the queue."""
+        core, _ = make_core()
+        core.register("busy", 2)
+        core.register("idle", 2)
+        for i in range(4):
+            core.submit(f"q{i}", [i], 4)
+        g_busy = core.poll("busy", 0, [])
+        g_idle = core.poll("idle", 2, [])
+        assert g_busy.requests == []
+        assert [r.req_id for r in g_idle.requests] == ["q0", "q1"]
+
+    def test_unknown_replica_is_told_to_reregister(self):
+        core, _ = make_core()
+        g = core.poll("ghost", 2, [])
+        assert g.known is False
+
+
+# ---------------------------------------------------------------------------
+# Deadlines
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlines:
+    def test_queued_request_times_out(self):
+        core, clock = make_core()
+        core.submit("a", [1], 4, deadline_s=5.0)
+        clock.advance(6.0)
+        core.sweep()
+        st = core.status("a")
+        assert st.state == "timeout"
+        assert core.counters["timeout"] == 1
+
+    def test_expired_request_never_granted(self):
+        core, clock = make_core()
+        core.register("r0", 1)
+        core.submit("a", [1], 4, deadline_s=5.0)
+        clock.advance(6.0)
+        g = core.poll("r0", 1, [])
+        assert g.requests == []
+        assert core.status("a").state == "timeout"
+
+    def test_in_flight_deadline_cancels_at_replica(self):
+        core, clock = make_core()
+        core.register("r0", 1)
+        core.submit("a", [1], 4, deadline_s=5.0)
+        core.poll("r0", 1, [])
+        clock.advance(6.0)
+        g = core.poll("r0", 0, ["a"])
+        assert g.cancel == ["a"]
+        assert core.status("a").state == "timeout"
+
+    def test_resubmit_of_timed_out_request_acks_timeout_not_done(self):
+        """A terminal timeout must not be masked as a zero-token
+        success on resubmit — the ack carries the cached outcome."""
+        core, clock = make_core()
+        core.submit("a", [1], 4, deadline_s=5.0)
+        clock.advance(6.0)
+        core.sweep()
+        ack = core.submit("a", [1], 4)
+        assert ack.status == "timeout"
+        assert ack.tokens == []
+        assert "deadline" in ack.reason
+
+    def test_late_completion_after_timeout_is_dropped(self):
+        core, clock = make_core()
+        core.register("r0", 1)
+        core.submit("a", [1], 4, deadline_s=5.0)
+        core.poll("r0", 1, [])
+        clock.advance(6.0)
+        core.poll("r0", 0, ["a"])  # timeout recorded here
+        assert core.complete("r0", "a", [9]) == "duplicate"
+        assert core.status("a").state == "timeout"
+        # Work finished after its gateway timeout is a LATE completion,
+        # not a dedupe event — the duplicate counter stays meaningful
+        # as journal-replay evidence.
+        assert core.counters["late_completions"] == 1
+        assert core.counters["duplicate_completions"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Replica death / re-dispatch / exactly-once
+# ---------------------------------------------------------------------------
+
+
+class TestRedispatch:
+    def test_lease_expiry_requeues_in_flight_at_front(self):
+        core, clock = make_core(lease_timeout_s=10.0)
+        core.register("r0", 2)
+        core.submit("a", [1], 4)
+        core.submit("b", [2], 4)
+        core.poll("r0", 1, [])  # 'a' assigned
+        clock.advance(11.0)
+        core.sweep()
+        assert core.counters["replicas_lost"] == 1
+        assert core.counters["redispatched"] == 1
+        core.register("r1", 2)
+        g = core.poll("r1", 2, [])
+        # The re-dispatched request goes FIRST (it has waited longest).
+        assert [r.req_id for r in g.requests] == ["a", "b"]
+
+    def test_duplicate_completion_from_journal_replay_is_dropped(self):
+        """The exactly-once law: re-dispatch races journal replay, the
+        first terminal report wins, the second is counted and dropped."""
+        core, clock = make_core(lease_timeout_s=10.0)
+        core.register("r0", 1)
+        core.submit("a", [1], 4)
+        core.poll("r0", 1, [])
+        clock.advance(11.0)
+        core.sweep()  # r0 presumed dead; 'a' re-queued
+        core.register("r1", 1)
+        core.poll("r1", 1, [])
+        assert core.complete("r1", "a", [5, 6]) == "recorded"
+        # r0 restarts and replays its journal for the same request.
+        assert core.complete("r0", "a", [5, 6], replayed=True) == \
+            "duplicate"
+        assert core.counters["completed"] == 1
+        assert core.counters["duplicate_completions"] == 1
+        assert core.status("a").tokens == [5, 6]
+
+    def test_reregister_requeues_assigned_work(self):
+        """A replica that crashed and re-registered cannot still be
+        running its old assignment: it is re-dispatched (its journal
+        replay, if any, wins the dedupe race instead)."""
+        core, _ = make_core()
+        core.register("r0", 1)
+        core.submit("a", [1], 4)
+        core.poll("r0", 1, [])
+        core.register("r0", 1)  # restart, same id
+        assert core.stats_snapshot()["queue_depth"] == 1
+        assert core.counters["redispatched"] == 1
+
+    def test_lost_grant_reconciled_from_owned_set(self):
+        """chaos serving.drop_request's recovery path: a grant the
+        replica never admits is absent from its owned set two polls
+        later and goes back to the queue."""
+        core, _ = make_core()
+        core.register("r0", 2)
+        core.submit("a", [1], 4)
+        g = core.poll("r0", 2, [])
+        assert [r.req_id for r in g.requests] == ["a"]
+        # Poll without owning it: one poll of grace (the grant may have
+        # raced this poll)...
+        core.poll("r0", 2, [])
+        assert core.status("a").state == "running"
+        # ...then the next unowning poll proves it lost.
+        g = core.poll("r0", 2, [])
+        assert core.counters["redispatched"] == 1
+        assert [r.req_id for r in g.requests] == ["a"]
+
+    def test_poison_request_fails_terminally_after_max_attempts(self):
+        """A request that keeps getting lost (or keeps killing its
+        replica) must not head-of-line-block the fleet forever: after
+        max_attempts re-dispatches it fails terminally."""
+        core, clock = make_core(lease_timeout_s=5.0, max_attempts=3)
+        core.submit("poison", [1], 4)
+        core.submit("healthy", [2], 4)
+        for round_i in range(3):
+            rid = f"r{round_i}"
+            core.register(rid, 1)
+            g = core.poll(rid, 1, [])
+            assert g.requests and g.requests[0].req_id == "poison"
+            clock.advance(6.0)
+            core.sweep()  # replica "died"; poison re-queued at front
+        st = core.status("poison")
+        assert st.state == "failed"
+        assert "re-dispatched 3 times" in st.reason
+        assert core.counters["failed"] == 1
+        # The healthy request is now at the head for the next replica.
+        core.register("r9", 1)
+        g = core.poll("r9", 1, [])
+        assert [r.req_id for r in g.requests] == ["healthy"]
+
+    def test_stale_stream_from_superseded_assignment_ignored(self):
+        core, clock = make_core(lease_timeout_s=10.0)
+        core.register("r0", 1)
+        core.submit("a", [1], 4)
+        core.poll("r0", 1, [])
+        core.stream("r0", "a", [5])
+        clock.advance(11.0)
+        core.sweep()
+        core.register("r1", 1)
+        core.poll("r1", 1, [])
+        core.stream("r0", "a", [6])  # zombie r0 streams on
+        st = core.status("a")
+        # Partial buffer reset at re-dispatch; zombie tokens dropped.
+        assert st.tokens == [] and st.replica == "r1"
+
+
+# ---------------------------------------------------------------------------
+# Drain (scale-down)
+# ---------------------------------------------------------------------------
+
+
+class TestDrain:
+    def test_draining_replica_gets_no_new_grants(self):
+        core, _ = make_core()
+        core.register("r0", 2)
+        core.submit("a", [1], 4)
+        core.poll("r0", 2, [])
+        core.submit("b", [2], 4)
+        assert core.drain("r0")
+        g = core.poll("r0", 1, ["a"])
+        assert g.requests == [] and g.drain is False
+        # In-flight work finishes normally; only then drain=True.
+        core.complete("r0", "a", [5])
+        g = core.poll("r0", 2, [])
+        assert g.drain is True
+        # The queued request is still there for the survivors.
+        core.register("r1", 2)
+        g = core.poll("r1", 2, [])
+        assert [r.req_id for r in g.requests] == ["b"]
+
+    def test_pick_drain_victim_is_least_loaded(self):
+        core, _ = make_core()
+        core.register("r0", 2)
+        core.register("r1", 2)
+        for i in range(3):
+            core.submit(f"q{i}", [i], 4)
+        core.poll("r0", 2, [])
+        core.poll("r1", 1, [])
+        assert core.pick_drain_victim() == "r1"
+        core.drain("r1")
+        assert core.pick_drain_victim() == "r0"
+        core.drain("r0")
+        assert core.pick_drain_victim() is None
+
+
+# ---------------------------------------------------------------------------
+# Autoscale policy
+# ---------------------------------------------------------------------------
+
+
+class TestAutoscalePolicy:
+    def _snap(self, alive, queue, occ=0.5, ttft=0.0):
+        return {"replicas_alive": alive, "queue_depth": queue,
+                "occupancy": occ, "ttft_p95_ms": ttft}
+
+    def test_scale_up_needs_sustained_pressure(self):
+        pol = ScalePolicy(queue_high_per_replica=4, up_patience=2,
+                          max_replicas=4)
+        st = ScaleState()
+        assert decide(self._snap(1, 10), pol, st) == 1  # pass 1: wait
+        assert decide(self._snap(1, 10), pol, st) == 2  # pass 2: grow
+        assert st.up_streak == 0  # streak consumed
+
+    def test_pressure_blip_resets_streak(self):
+        pol = ScalePolicy(queue_high_per_replica=4, up_patience=2)
+        st = ScaleState()
+        decide(self._snap(1, 10), pol, st)
+        assert decide(self._snap(1, 1), pol, st) == 1
+        assert st.up_streak == 0
+
+    def test_ttft_signal_triggers_up(self):
+        pol = ScalePolicy(queue_high_per_replica=1e9,
+                          ttft_p95_high_ms=500, up_patience=1)
+        st = ScaleState()
+        assert decide(self._snap(2, 0, ttft=900), pol, st) == 3
+
+    def test_scale_down_needs_idle_and_patience_and_floor(self):
+        pol = ScalePolicy(min_replicas=1, down_patience=3,
+                          queue_low_per_replica=0.5, occupancy_low=0.3)
+        st = ScaleState()
+        idle = self._snap(2, 0, occ=0.1)
+        assert decide(idle, pol, st) == 2
+        assert decide(idle, pol, st) == 2
+        assert decide(idle, pol, st) == 1  # third consecutive: shrink
+        st2 = ScaleState()
+        one = self._snap(1, 0, occ=0.0)
+        for _ in range(10):
+            assert decide(one, pol, st2) == 1  # never below min
+
+    def test_busy_but_not_pressured_holds_steady(self):
+        pol = ScalePolicy()
+        st = ScaleState()
+        mid = self._snap(2, 2, occ=0.7)
+        for _ in range(10):
+            assert decide(mid, pol, st) == 2
+
+    def test_up_capped_at_max(self):
+        pol = ScalePolicy(max_replicas=2, up_patience=1,
+                          queue_high_per_replica=1)
+        st = ScaleState()
+        assert decide(self._snap(2, 50), pol, st) == 2
+
+
+# ---------------------------------------------------------------------------
+# ServingFleetAutoScaler (master hook)
+# ---------------------------------------------------------------------------
+
+
+class TestServingFleetAutoScaler:
+    def _scaler(self, core):
+        from dlrover_tpu.master.job_auto_scaler import (
+            ServingFleetAutoScaler,
+        )
+
+        class Group:
+            min_count = 1
+            max_count = 4
+            count = 1
+
+        class JobArgs:
+            workers = Group()
+            node_unit = 1
+
+        class JM:
+            def __init__(self):
+                self.targets = []
+                self.live = 0
+
+            def scale_workers_to(self, n):
+                self.targets.append(n)
+                return n - self.live
+
+            def alive_workers(self):
+                return [object()] * self.live
+
+            def pending_workers(self):
+                return []
+
+        jm = JM()
+        sc = ServingFleetAutoScaler(JobArgs(), jm, core, interval=999)
+        sc._policy.up_patience = 1
+        sc._policy.down_patience = 1
+        return sc, jm
+
+    def test_scale_up_on_queue_pressure(self):
+        core, _ = make_core()
+        core.register("r0", 2)
+        for i in range(20):
+            core.submit(f"q{i}", [i], 4)
+        sc, jm = self._scaler(core)
+        jm.live = 1
+        sc.scale_once()
+        assert jm.targets == [2]
+
+    def test_scale_up_held_while_workers_warm_up(self):
+        """Launched-but-unregistered workers are capacity on its way:
+        pressure must not trigger an absolute scale target computed
+        from the REGISTERED count (which could even kill the warming
+        workers)."""
+        core, _ = make_core()
+        core.register("r0", 2)
+        for i in range(20):
+            core.submit(f"q{i}", [i], 4)
+        sc, jm = self._scaler(core)
+        jm.live = 3  # 2 workers still warming toward registration
+        sc.scale_once()
+        assert jm.targets == []
+
+    def test_scale_down_is_two_phase_drain_first(self):
+        """Scale-down must never kill a live worker: the manager's
+        count drops only after the drained victim deregistered AND its
+        worker exit was reaped."""
+        core, _ = make_core()
+        core.register("r0", 2)
+        core.register("r1", 2)
+        sc, jm = self._scaler(core)
+        jm.live = 2
+        sc.scale_once()
+        # Phase A: drain only — no scale_workers_to yet.
+        assert jm.targets == []
+        assert core.stats_snapshot()["replicas_draining"] == 1
+        victim = sc._pending_drain[0]
+        # Still draining (replica present): every pass holds.
+        sc.scale_once()
+        assert jm.targets == []
+        # Victim deregisters but its worker exit is not yet reaped:
+        # still held (an absolute shrink now would kill a live one).
+        core.deregister(victim)
+        sc.scale_once()
+        assert jm.targets == []
+        # Worker exit reaped -> phase B: pure-bookkeeping target drop.
+        jm.live = 1
+        sc.scale_once()
+        assert jm.targets == [1]
+        assert sc._pending_drain is None
+
+    def test_factory_falls_back_without_gateway_instead_of_crashing(self):
+        """dist_master never wires a gateway today: a serving-strategy
+        job must still boot (training scaler + loud error), not crash
+        the master at startup."""
+        from dlrover_tpu.master.job_auto_scaler import (
+            AllreduceTrainingAutoScaler,
+            ServingFleetAutoScaler,
+            new_job_auto_scaler,
+        )
+
+        class JobArgs:
+            distribution_strategy = "serving"
+            workers = None
+
+        sc = new_job_auto_scaler(JobArgs(), None, None)
+        assert isinstance(sc, AllreduceTrainingAutoScaler)
+        # With a gateway wired, the serving scaler is selected.
+        class Group:
+            min_count = 1
+            max_count = 4
+
+        class ServingJobArgs:
+            distribution_strategy = "serving"
+            workers = Group()
+
+        core, _ = make_core()
+        sc2 = new_job_auto_scaler(
+            ServingJobArgs(), None, None, serving_gateway=core
+        )
+        assert isinstance(sc2, ServingFleetAutoScaler)
+
+
+def test_gateway_wrapper_injects_ttft_p95_into_snapshot():
+    """The autoscaler's ttft_p95_high_ms signal reads ttft_p95_ms off
+    the production snapshot — the Gateway wrapper must inject it."""
+    from dlrover_tpu.serving import Gateway
+
+    gw = Gateway(port=0)
+    try:
+        gw.core.observe_ttft_ms(700.0)
+        snap = gw.core.stats_snapshot()
+        assert snap["ttft_p95_ms"] == 1000.0  # bucket upper bound
+        assert "latency_p95_ms" in snap
+        # And the signal actually drives decide().
+        pol = ScalePolicy(queue_high_per_replica=1e9,
+                          ttft_p95_high_ms=500, up_patience=1)
+        assert decide(snap, pol, ScaleState()) == 2
+    finally:
+        gw.stop()
+
+
+def test_replica_register_survives_dead_gateway():
+    """A gateway still booting (or flapping right after a known=False
+    poll) must not kill the replica: register is best-effort and the
+    next poll retries it."""
+    class DeadTransport:
+        def call(self, msg, **_kw):
+            raise ConnectionError("gateway down")
+
+    runner = ReplicaRunner(FakeDecodeServer(1), DeadTransport(), "r0")
+    runner.register()  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# Histogram (gateway latency instrument)
+# ---------------------------------------------------------------------------
+
+class TestHistogram:
+    def test_percentiles_are_bucket_upper_bounds(self):
+        from dlrover_tpu.agent.metrics import Histogram
+
+        h = Histogram(buckets=(10, 100, 1000))
+        for _ in range(98):
+            h.observe(5)
+        h.observe(50)
+        h.observe(500)
+        assert h.count == 100
+        assert h.percentile(0.5) == 10
+        assert h.percentile(0.99) == 100
+        assert h.percentile(1.0) == 1000
+
+    def test_empty_and_overflow(self):
+        from dlrover_tpu.agent.metrics import Histogram
+
+        h = Histogram(buckets=(10,))
+        assert h.percentile(0.99) == 0.0
+        h.observe(99999)  # beyond the last bound: saturates
+        assert h.percentile(0.5) == 10
+        assert h.snapshot()["count"] == 1.0
+
+    def test_windowed_histogram_decays_instead_of_ratcheting(self):
+        """The autoscaler's TTFT signal must forget a bad warmup
+        period: with window_s set, observations older than two windows
+        fall out of the percentiles."""
+        from dlrover_tpu.agent.metrics import Histogram
+
+        clk = FakeClock()
+        h = Histogram(buckets=(10, 1000, 10000), window_s=60.0,
+                      clock=clk)
+        for _ in range(100):
+            h.observe(5000.0)  # terrible cold-start TTFTs
+        assert h.percentile(0.95) == 10000
+        clk.advance(61.0)
+        for _ in range(20):
+            h.observe(5.0)  # warm steady state
+        # Previous window still in view: p95 still reflects the spike.
+        assert h.percentile(0.95) == 10000
+        clk.advance(61.0)
+        for _ in range(20):
+            h.observe(5.0)
+        # The spike aged out: only steady-state observations remain.
+        assert h.percentile(0.95) == 10
+        # Fully idle for 2+ windows: empty, not stale.
+        clk.advance(200.0)
+        assert h.percentile(0.95) == 0.0
+        assert h.count == 0
+
+    def test_register_gauges(self):
+        from dlrover_tpu.agent.metrics import (
+            Histogram,
+            MetricsRegistry,
+        )
+
+        h = Histogram()
+        reg = MetricsRegistry()
+        h.register_gauges(reg, "serve_ttft")
+        h.observe(42.0)
+        text = reg.render()
+        assert "serve_ttft_count 1.0" in text
+        assert "serve_ttft_p99_ms 50.0" in text
+
+
+# ---------------------------------------------------------------------------
+# Replica runner protocol (fake decode server, loopback fleet)
+# ---------------------------------------------------------------------------
+
+
+class FakeDecodeServer:
+    """The incremental-admission surface of DecodeServer, with a
+    deterministic arithmetic 'decode' (token i of prompt p is
+    ``(sum(p) + i) % 97``) — the runner protocol under test, not the
+    model."""
+
+    def __init__(self, slots=2):
+        self.slots = slots
+        self._pending = collections.deque()
+        self._active = {}
+        self.last_stats = {}
+
+    def submit(self, rid, prompt, mnt):
+        self._pending.append((rid, [int(t) for t in prompt], int(mnt)))
+
+    def cancel(self, rid):
+        for i, item in enumerate(self._pending):
+            if item[0] == rid:
+                del self._pending[i]
+                return True
+        return False
+
+    def abort(self, rid):
+        if self.cancel(rid):
+            return True
+        return self._active.pop(rid, None) is not None
+
+    def pending_count(self):
+        return len(self._pending)
+
+    def pending_rids(self):
+        return [r for r, _, _ in self._pending]
+
+    def active_rids(self):
+        return list(self._active)
+
+    def free_slots(self):
+        return max(
+            0, self.slots - len(self._active) - len(self._pending)
+        )
+
+    def serve_incremental(self, tick=None, on_finish=None,
+                          on_token=None, idle_wait=0.0005):
+        results = {}
+        while True:
+            keep = tick() is not False if tick else True
+            while self._pending and len(self._active) < self.slots:
+                rid, p, mnt = self._pending.popleft()
+                self._active[rid] = (p, [], mnt)
+            if not self._active:
+                if not self._pending:
+                    if tick is None or not keep:
+                        break
+                    time.sleep(idle_wait)
+                continue
+            for rid in list(self._active):
+                p, out, mnt = self._active[rid]
+                t = (sum(p) + len(out)) % 97
+                out.append(t)
+                if on_token:
+                    on_token(rid, t)
+                if len(out) >= mnt:
+                    full = list(p) + out
+                    results[rid] = full
+                    del self._active[rid]
+                    if on_finish:
+                        on_finish(rid, full)
+        return results
+
+
+def make_loopback_fleet(core, n=1, slots=2, tmp=None, poll=0.001):
+    """Wire N fake-server runners to a GatewayCore over loopback."""
+    def handle(msg):
+        if isinstance(msg, ServeReplicaRegister):
+            core.register(msg.replica_id, msg.slots)
+        elif isinstance(msg, ServeReplicaDeregister):
+            core.deregister(msg.replica_id)
+        elif isinstance(msg, ServeReplicaPoll):
+            return core.poll(msg.replica_id, msg.free_slots,
+                             msg.active, msg.stats)
+        elif isinstance(msg, ServeTokens):
+            core.stream(msg.replica_id, msg.req_id, msg.tokens)
+        elif isinstance(msg, ServeDone):
+            core.complete(msg.replica_id, msg.req_id, msg.tokens,
+                          msg.ok, msg.reason, msg.replayed)
+        return None
+
+    transport = LoopbackTransport(handle)
+    runners = []
+    for i in range(n):
+        journal = f"{tmp}/r{i}.jsonl" if tmp else None
+        runners.append(ReplicaRunner(
+            FakeDecodeServer(slots), transport, f"r{i}",
+            journal_path=journal, poll_interval=poll,
+        ))
+    return runners
+
+
+def expected_tokens(prompt, mnt):
+    return [(sum(int(t) for t in prompt) + i) % 97 for i in range(mnt)]
+
+
+def wait_for(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+class TestReplicaRunner:
+    def test_end_to_end_loopback_fleet(self, tmp_path):
+        core = GatewayCore(GatewayConfig())
+        (runner,) = make_loopback_fleet(core, 1, tmp=str(tmp_path))
+        th = threading.Thread(target=runner.run, daemon=True)
+        th.start()
+        for i in range(5):
+            core.submit(f"q{i}", [i + 1, i + 2], 4)
+        assert wait_for(lambda: core.counters["completed"] == 5)
+        for i in range(5):
+            st = core.status(f"q{i}")
+            assert st.state == "done"
+            assert st.tokens == expected_tokens([i + 1, i + 2], 4)
+        core.drain("r0")
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert runner.served == 5
+        # Drained replica deregistered itself.
+        assert core.stats_snapshot()["replicas_alive"] == 0
+
+    def test_journal_replay_reports_not_redecodes(self, tmp_path):
+        core = GatewayCore(GatewayConfig())
+        (r1,) = make_loopback_fleet(core, 1, tmp=str(tmp_path))
+        th = threading.Thread(target=r1.run, daemon=True)
+        th.start()
+        core.submit("a", [3, 4], 4)
+        assert wait_for(lambda: core.counters["completed"] == 1)
+        core.drain("r0")
+        th.join(timeout=10)
+        # "Restart": a fresh runner over the same journal; the gateway
+        # still remembers the request (dedupe) — the replayed report is
+        # dropped, and nothing decodes twice.
+        (r2,) = make_loopback_fleet(core, 1, tmp=str(tmp_path))
+        r2.register()
+        assert r2.replayed == 1
+        assert core.counters["duplicate_completions"] == 1
+        assert core.counters["completed"] == 1
+
+    def test_journal_grant_hit_answers_without_decoding(self, tmp_path):
+        """A re-dispatched request landing on the SAME restarted
+        replica is answered from its journal at grant time."""
+        core = GatewayCore(GatewayConfig())
+        (r1,) = make_loopback_fleet(core, 1, tmp=str(tmp_path))
+        th = threading.Thread(target=r1.run, daemon=True)
+        th.start()
+        core.submit("a", [5, 6], 4)
+        assert wait_for(lambda: core.counters["completed"] == 1)
+        core.drain("r0")
+        th.join(timeout=10)
+        # Fresh gateway (lost all state) + restarted replica with the
+        # old journal: the same request re-submitted must be served
+        # from the journal, not re-decoded.
+        core2 = GatewayCore(GatewayConfig())
+        (r2,) = make_loopback_fleet(core2, 1, tmp=str(tmp_path))
+        served_before = r2.served
+        th2 = threading.Thread(target=r2.run, daemon=True)
+        th2.start()
+        core2.submit("a", [5, 6], 4)
+        assert wait_for(lambda: core2.counters["completed"] == 1)
+        assert core2.status("a").tokens == expected_tokens([5, 6], 4)
+        assert r2.served == served_before  # no fresh decode
+        assert r2.replayed >= 1
+        core2.drain("r0")
+        th2.join(timeout=10)
+
+    def test_cancel_sheds_in_flight_slot_via_abort(self):
+        """A gateway cancel for a request already decoding frees the
+        slot mid-stream instead of letting it run to its budget."""
+        class ScriptedTransport:
+            def call(self, msg, **_kw):
+                if isinstance(msg, ServeReplicaPoll):
+                    return ServeGrants(cancel=["a"], known=True)
+                return None
+
+        srv = FakeDecodeServer(1)
+        runner = ReplicaRunner(srv, ScriptedTransport(), "r0",
+                               poll_interval=0.0)
+        srv._active["a"] = ([1, 2], [5], 1000000)  # mid-decode
+        runner._granted["a"] = {"prompt": [1, 2]}
+        assert runner.tick() is True
+        assert srv.active_rids() == []  # slot shed
+        assert "a" not in runner._granted
+
+    def test_journal_is_bounded_and_compacts(self, tmp_path):
+        from dlrover_tpu.serving.replica import CompletionJournal
+
+        path = str(tmp_path / "j.jsonl")
+        j = CompletionJournal(path, max_records=8)
+        for i in range(8 + 64 + 1):  # crosses the cap+slack threshold
+            j.append(f"q{i}", [i], [i, i])
+        # Compaction fired at the 72nd append (cap 8 + slack 64),
+        # trimming to the newest 8; one more append lands after it.
+        assert len(j.replayable()) == 9
+        # Oldest dropped, newest kept — on disk too.
+        assert j.lookup("q0", [0]) is None
+        assert j.lookup("q72", [72]) == [72, 72]
+        j.close()
+        lines = open(path).read().strip().split("\n")
+        assert len(lines) == 9
+        # Reload honours the cap (constructor compacts past-cap files)
+        # and still replays the survivors.
+        j2 = CompletionJournal(path, max_records=8)
+        assert len(j2.replayable()) == 8
+        assert j2.lookup("q72", [72]) == [72, 72]
+
+    def test_journal_replay_happens_once_per_incarnation(self, tmp_path):
+        """A gateway flap (known=False poll -> re-register) must NOT
+        re-send the whole journal: replay is once per process start;
+        re-dispatched grants hit the journal at grant time instead."""
+        core = GatewayCore(GatewayConfig())
+        (r1,) = make_loopback_fleet(core, 1, tmp=str(tmp_path))
+        th = threading.Thread(target=r1.run, daemon=True)
+        th.start()
+        core.submit("a", [3, 4], 4)
+        assert wait_for(lambda: core.counters["completed"] == 1)
+        core.drain("r0")
+        th.join(timeout=10)
+        (r2,) = make_loopback_fleet(core, 1, tmp=str(tmp_path))
+        r2.register()
+        assert r2.replayed == 1
+        r2.register()  # flap: second register of the same incarnation
+        assert r2.replayed == 1  # no bulk re-replay
+
+    def test_torn_journal_tail_is_ignored(self, tmp_path):
+        from dlrover_tpu.serving.replica import CompletionJournal
+
+        j = CompletionJournal(str(tmp_path / "j.jsonl"))
+        j.append("a", [1, 2], [7, 8])
+        j.close()
+        with open(tmp_path / "j.jsonl", "a") as f:
+            f.write('{"rid": "b", "ph": "x", "tok')  # SIGKILL mid-append
+        j2 = CompletionJournal(str(tmp_path / "j.jsonl"))
+        assert set(j2.replayable()) == {"a"}
+        assert j2.lookup("a", [1, 2]) == [7, 8]
+        # Prompt-hash mismatch (journal-path reuse): no stale replay.
+        assert j2.lookup("a", [9, 9]) is None
+
+    def test_drop_request_chaos_recovers_via_reconcile(self, tmp_path):
+        from dlrover_tpu import chaos
+
+        core = GatewayCore(GatewayConfig())
+        (runner,) = make_loopback_fleet(core, 1, tmp=str(tmp_path))
+        chaos.configure("serving.drop_request:p=1,times=1,seed=3")
+        try:
+            th = threading.Thread(target=runner.run, daemon=True)
+            th.start()
+            core.submit("a", [2, 3], 4)
+            # Dropped once, re-dispatched by reconcile, then served.
+            assert wait_for(lambda: core.counters["completed"] == 1)
+            assert core.counters["redispatched"] >= 1
+            assert runner.dropped == 1
+            assert core.status("a").tokens == expected_tokens([2, 3], 4)
+            core.drain("r0")
+            th.join(timeout=10)
+        finally:
+            chaos.reset()
+
+    def test_cancel_prunes_replica_pending(self):
+        """A gateway cancel (deadline expiry) drops a granted request
+        still waiting in the replica's pending queue — in-flight work
+        is never interrupted, queued work is."""
+        class ScriptedTransport:
+            def __init__(self):
+                self.sent = []
+
+            def call(self, msg, **_kw):
+                self.sent.append(msg)
+                if isinstance(msg, ServeReplicaPoll):
+                    return ServeGrants(cancel=["a"], known=True)
+                return None
+
+        srv = FakeDecodeServer(2)
+        transport = ScriptedTransport()
+        runner = ReplicaRunner(srv, transport, "r0",
+                               poll_interval=0.0)
+        srv.submit("a", [1, 2], 4)
+        runner._granted["a"] = {"prompt": [1, 2]}
+        assert runner.tick() is True
+        assert srv.pending_count() == 0  # cancelled before admission
+        assert "a" not in runner._granted
+
+
+# ---------------------------------------------------------------------------
+# Wire round-trip of the new messages
+# ---------------------------------------------------------------------------
+
+
+def test_serving_messages_roundtrip():
+    g = ServeGrants(
+        requests=[ServeSubmit(req_id="x", prompt=[1, 2],
+                              max_new_tokens=9, deadline_s=1.5)],
+        cancel=["y"], drain=True, known=False,
+    )
+    g2 = deserialize(serialize(g))
+    assert isinstance(g2, ServeGrants)
+    assert g2.requests[0].prompt == [1, 2]
+    assert g2.requests[0].max_new_tokens == 9
+    assert g2.cancel == ["y"] and g2.drain and g2.known is False
+    d = deserialize(serialize(ServeDone(
+        replica_id="r", req_id="x", tokens=[3], replayed=True,
+    )))
+    assert d.replayed is True and d.tokens == [3]
+
+
+def test_empty_req_id_is_rejected_terminally():
+    """'' is BoundedTokenCache's no-token sentinel: the completion
+    would be unrecordable and the client would poll 'unknown' forever."""
+    core = GatewayCore(GatewayConfig())
+    ack = core.submit("", [1, 2], 4)
+    assert ack.status == "failed"
+    assert "empty req_id" in ack.reason
+    assert core.stats_snapshot()["queue_depth"] == 0
+
+
+def test_journal_eager_replay_is_capped(tmp_path):
+    """Restart replay must not storm the gateway with one RPC per
+    journal record (a full journal would stall polls past the lease):
+    only the newest replay_limit records replay eagerly."""
+    from dlrover_tpu.serving.replica import CompletionJournal
+
+    path = str(tmp_path / "j.jsonl")
+    j = CompletionJournal(path)
+    for i in range(40):
+        j.append(f"q{i}", [i], [i])
+    j.close()
+
+    sent = []
+
+    class T:
+        def call(self, msg, **_kw):
+            sent.append(msg)
+            return None
+
+    runner = ReplicaRunner(FakeDecodeServer(1), T(), "r0",
+                           journal_path=path, replay_limit=10)
+    runner.register()
+    dones = [m for m in sent if isinstance(m, ServeDone)]
+    assert len(dones) == 10
+    # Newest records replay; the older ones answer via grant-time
+    # lookup instead.
+    assert {m.req_id for m in dones} == {f"q{i}" for i in range(30, 40)}
