@@ -31,6 +31,11 @@ _LAZY = {
     # checkpointing
     "FlashCheckpointer": "dlrover_tpu.checkpoint.checkpointer",
     "CheckpointEngine": "dlrover_tpu.checkpoint.engine",
+    # live resharding (restart-free elasticity)
+    "build_plan": "dlrover_tpu.reshard.plan",
+    "ReshardPlan": "dlrover_tpu.reshard.plan",
+    "reshard_state": "dlrover_tpu.reshard.coordinator",
+    "ReshardError": "dlrover_tpu.reshard.coordinator",
     # trainer SDK
     "Trainer": "dlrover_tpu.trainer.trainer",
     "TrainingArgs": "dlrover_tpu.trainer.trainer",

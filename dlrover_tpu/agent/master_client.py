@@ -305,6 +305,39 @@ class MasterClient:
             timeout=1.0, retries=1, deadline=1.0,
         )
 
+    def get_reshard_epoch(self) -> m.ReshardEpochInfo:
+        """Poll the master's resize-epoch broadcast (live resharding).
+        Short budget, no retries: this rides the step loop — a sick
+        master must cost one bounded timeout, not a retry ladder; the
+        next step polls again anyway."""
+        resp = self._client.call(
+            m.ReshardEpochRequest(node_id=self.node_id),
+            timeout=2.0, retries=1, deadline=2.0,
+        )
+        if isinstance(resp, m.ReshardEpochInfo):
+            return resp
+        return m.ReshardEpochInfo()
+
+    def report_reshard(
+        self,
+        epoch: int,
+        ok: bool,
+        reason: str = "",
+        downtime_ms: float = 0.0,
+        moved_mb: float = 0.0,
+    ) -> bool:
+        """Report this node's verdict on a resize epoch.  ``idempotent``:
+        the master keys reports by node — a retried duplicate is a
+        harmless overwrite."""
+        resp = self._client.call(
+            m.ReshardReport(
+                node_id=self.node_id, epoch=epoch, ok=ok, reason=reason,
+                downtime_ms=downtime_ms, moved_mb=moved_mb,
+            ),
+            idempotent=True,
+        )
+        return bool(getattr(resp, "success", False))
+
     def report_used_resource(
         self, cpu_percent: float, memory_mb: float,
         tpu_duty_cycle: float = 0.0, hbm_used_mb: float = 0.0,

@@ -55,6 +55,7 @@ EXIT_CKPT_AFTER_COMMIT = 67
 EXIT_WORKER_KILL = 77
 EXIT_MASTER_RESTART = 42
 EXIT_REPLICA_KILL = 78
+EXIT_RESHARD_CRASH = 79
 
 #: site name -> (kind, defaults).  Kinds: ``error`` (caller raises),
 #: ``latency`` (inject() sleeps), ``crash`` (inject() calls os._exit),
@@ -92,6 +93,16 @@ SITES: Dict[str, dict] = {
     "serving.slow_replica": {"kind": "latency", "delay": 0.5},
     "master.restart": {
         "kind": "crash", "exit": EXIT_MASTER_RESTART, "times": 1,
+    },
+    # Live-reshard sites (ISSUE 6): a plan segment lost in flight (the
+    # mover must fail the move, not hang or accept torn bytes), a
+    # stalled peer slowing every pull, and a puller hard-killed between
+    # segment applies — all three must degrade to the checkpoint-restart
+    # ladder with fsck-clean storage.
+    "reshard.drop_segment": {"kind": "flag", "times": 1},
+    "reshard.stall_peer": {"kind": "latency", "delay": 0.5},
+    "reshard.crash_mid_move": {
+        "kind": "crash", "exit": EXIT_RESHARD_CRASH, "times": 1,
     },
 }
 

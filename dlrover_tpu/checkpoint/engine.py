@@ -97,6 +97,10 @@ class CheckpointEngine:
         # step -> "a corrupt shard was seen while reading this step's
         # candidates" (populated per load; drives quarantine decisions).
         self._step_had_corruption: Dict[int, bool] = {}
+        # {path: [box, ...]} of the current load()'s target — drives the
+        # reshard-plan shard selection on the storage path; None when
+        # loading without a target (ShardSource mode reads everything).
+        self._restore_boxes = None
 
         self.agent_mode = os.path.exists(
             socket_path("queue", ckpt_queue_name(self.job_name))
@@ -386,12 +390,25 @@ class CheckpointEngine:
 
     # -- load ---------------------------------------------------------------
     def load(
-        self, target: Any = None
+        self, target: Any = None, *, target_mesh=None
     ) -> Optional[Tuple[Any, dict]]:
         """Restore the newest available state: shm (warm) else storage.
 
         With ``target`` given, returns (pytree-like-target, meta); without,
-        returns (ShardSource, meta) for caller-side assembly."""
+        returns (ShardSource, meta) for caller-side assembly.
+
+        ``target_mesh`` (restore-to-any-mesh, ROADMAP item 2 entry
+        point): re-home ``target`` onto that mesh before assembly — each
+        leaf keeps its PartitionSpec (replicated for non-NamedSharding
+        leaves) but lands on the NEW world's devices, so a checkpoint
+        saved by any M-process world restores onto whatever mesh the new
+        world has.  The storage path then reads only the source shards
+        the reshard plan proves it needs (see :meth:`_select_pids`)."""
+        if target is not None and target_mesh is not None:
+            target = self._retarget(target, target_mesh)
+        self._restore_boxes = (
+            self._target_boxes(target) if target is not None else None
+        )
         # Zero-copy shm read when the tree is materialized HERE and this
         # process is provably the arena's only writer: with a target,
         # restore_to_target device_puts every piece before load() returns,
@@ -606,6 +623,119 @@ class CheckpointEngine:
         )
         return source, extra
 
+    @staticmethod
+    def _retarget(target: Any, target_mesh) -> Any:
+        """Re-home a target tree onto ``target_mesh``: sharding-bearing
+        leaves become ShapeDtypeStruct placeholders with the SAME
+        PartitionSpec on the new mesh (NamedSharding leaves keep their
+        factorization; any other sharding replicates); host leaves pass
+        through untouched."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def per_leaf(leaf):
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is None:
+                return leaf
+            spec = (
+                sharding.spec
+                if isinstance(sharding, NamedSharding)
+                else PartitionSpec()
+            )
+            return jax.ShapeDtypeStruct(
+                tuple(leaf.shape),
+                leaf.dtype,
+                sharding=NamedSharding(target_mesh, spec),
+            )
+
+        return jax.tree_util.tree_map(per_leaf, target)
+
+    @staticmethod
+    def _target_boxes(target: Any) -> Optional[Dict[str, list]]:
+        """{path: [addressable boxes]} of a target tree — the question
+        the reshard planner answers shard selection for.  ``None`` when
+        the tree cannot be described (selection then reads everything)."""
+        try:
+            from jax.tree_util import keystr, tree_flatten_with_path
+
+            from dlrover_tpu.checkpoint.tree_utils import (
+                _leaf_placements,
+                _norm_index,
+            )
+
+            out: Dict[str, list] = {}
+            for path, leaf in tree_flatten_with_path(target)[0]:
+                name = keystr(path)
+                placed = _leaf_placements(leaf)
+                if placed is not None:
+                    _s, gshape, placements = placed
+                    boxes = {
+                        _norm_index(idx, gshape) for _d, idx in placements
+                    }
+                else:
+                    shape = tuple(
+                        getattr(leaf, "shape", np.shape(leaf))
+                    )
+                    boxes = {tuple((0, d) for d in shape)}
+                out[name] = sorted(boxes)
+            return out
+        except Exception as e:  # noqa: BLE001 - selection is an
+            # optimization; an undescribable target just reads all shards
+            logger.debug("target-box derivation failed: %s", e)
+            return None
+
+    def _select_pids(self, step: int, pids: list) -> list:
+        """Plan-driven shard selection: of a step's shards, which source
+        ranks' pieces does THIS process's target actually overlap?  A
+        dp=16 world restoring replicated params should read one rank's
+        shard, not sixteen.  Any failure (unreadable meta, uncoverable
+        target, planner error) falls back to reading everything —
+        selection is bandwidth, never correctness.
+
+        Cost model: this pays one header+meta read (KBs) per shard up
+        front even when the plan ends up needing every rank; that is
+        accepted — the full-shard data reads it can avoid are orders of
+        magnitude larger, and read_shard re-verifies its own meta anyway
+        (sharing decoded metas across the two passes would couple the
+        verified read path to this optimization)."""
+        boxes = self._restore_boxes
+        if boxes is None or len(pids) <= 1:
+            return pids
+        try:
+            infos_by_rank = {}
+            for pid in pids:
+                extra = shard_file.read_shard_meta(
+                    self.storage, self.ckpt_dir, step, pid
+                )
+                if extra is None:
+                    continue
+                info = extra.get("tensors_info") or {}
+                if not info:
+                    return pids
+                infos_by_rank[pid] = info
+            if not infos_by_rank:
+                return pids
+            from dlrover_tpu.reshard.plan import ranks_needed
+
+            need = ranks_needed(
+                infos_by_rank, boxes, dst_rank=self.process_id
+            )
+            chosen = [p for p in pids if p in set(need)]
+            if not chosen:
+                return pids
+            if len(chosen) < len(pids):
+                logger.info(
+                    "flash ckpt: reshard plan needs %d/%d shards of "
+                    "step %d", len(chosen), len(pids), step,
+                )
+            return chosen
+        except Exception as e:  # noqa: BLE001 - see docstring: selection
+            # must never turn a restorable step into a failed one
+            logger.debug(
+                "shard selection for step %d fell back to full read: %s",
+                step, e,
+            )
+            return pids
+
     def _storage_candidates(self):
         """Yield (source, extra) per restorable storage step: the committed
         (tracker) step first, then remaining step dirs newest-first.  The
@@ -633,31 +763,52 @@ class CheckpointEngine:
             source = tree_utils.ShardSource()
             extra_out = None
             corrupt = False
-            for pid in shard_file.list_shard_ids(
-                self.storage, self.ckpt_dir, step
-            ):
+            read_failed = False
+
+            def _read_into(pid: int) -> None:
+                nonlocal extra_out, corrupt, read_failed
                 try:
                     got = shard_file.read_shard(
                         self.storage, self.ckpt_dir, step, pid
                     )
                 except shard_file.ShardCorruptionError as e:
                     corrupt = True
+                    read_failed = True
                     self._note_corruption(step, pid, e)
-                    continue
+                    return
                 except Exception as e:  # noqa: BLE001 - I/O hiccup: treat
                     # the shard as absent (no quarantine — nothing proves
                     # the bytes themselves are damaged).
+                    read_failed = True
                     logger.warning(
                         "shard (step %d, proc %d) unreadable (%s: %s); "
                         "skipping", step, pid, type(e).__name__, e,
                     )
-                    continue
+                    return
                 if got is None:
-                    continue
+                    # Absent counts as a failed SELECTED read too: a
+                    # shard GC'd between list and read must trigger the
+                    # unselected-replica fallback below, not starve it.
+                    read_failed = True
+                    return
                 tensors, extra = got
                 source.add(tensors, extra.get("tensors_info", {}))
                 if pid == self.process_id or extra_out is None:
                     extra_out = extra
+
+            pids = shard_file.list_shard_ids(
+                self.storage, self.ckpt_dir, step
+            )
+            chosen = self._select_pids(step, pids)
+            for pid in chosen:
+                _read_into(pid)
+            if read_failed and len(chosen) < len(pids):
+                # A plan-selected shard was damaged/absent; the skipped
+                # ranks may still cover the target (replicated layouts).
+                # Selection saves bandwidth — it must never cost a
+                # restorable step.
+                for pid in (p for p in pids if p not in set(chosen)):
+                    _read_into(pid)
             self._step_had_corruption[step] = corrupt
             if extra_out is None:
                 if corrupt:

@@ -356,16 +356,13 @@ def verify_shard(data: bytes, path: str = "") -> dict:
     return meta["extra"]
 
 
-def verify_shard_file(
-    f, path: str = "", chunk_bytes: int = STREAM_CHUNK_BYTES
-) -> Tuple[dict, int]:
-    """:func:`verify_shard` over a seekable binary file in bounded chunks.
-
-    Peak memory is ``max(meta_len, chunk_bytes)`` regardless of shard
-    size, so fsck can verify shards larger than host RAM headroom.
-    Returns ``(extra, format_version)``; raises
-    :class:`ShardCorruptionError` on any damage (same reasons as the
-    in-memory verifier — both ride the shared parse helpers)."""
+def _read_file_meta(f, path: str = "") -> Tuple[dict, int, int, int]:
+    """Validated header + meta blob from a seekable shard file WITHOUT
+    touching the data region; returns (meta, version, file_size,
+    data_base).  The one implementation of the bounded meta read —
+    the streaming verifier and the meta-only reader must never drift on
+    header validation.  Raises :class:`ShardCorruptionError` (the meta
+    CRC covers everything read here)."""
     f.seek(0, os.SEEK_END)
     size = f.tell()
     f.seek(0)
@@ -383,7 +380,20 @@ def verify_shard_file(
         )
     f.seek(base)
     meta = _decode_meta(f.read(meta_len), meta_crc, path)
-    data_base = base + meta_len
+    return meta, version, size, base + meta_len
+
+
+def verify_shard_file(
+    f, path: str = "", chunk_bytes: int = STREAM_CHUNK_BYTES
+) -> Tuple[dict, int]:
+    """:func:`verify_shard` over a seekable binary file in bounded chunks.
+
+    Peak memory is ``max(meta_len, chunk_bytes)`` regardless of shard
+    size, so fsck can verify shards larger than host RAM headroom.
+    Returns ``(extra, format_version)``; raises
+    :class:`ShardCorruptionError` on any damage (same reasons as the
+    in-memory verifier — both ride the shared parse helpers)."""
+    meta, version, size, data_base = _read_file_meta(f, path)
     # Offset order == file order for packed/streamed shards; sorting keeps
     # the read head moving forward even on adversarial metas.
     items = sorted(
@@ -747,6 +757,27 @@ def read_shard(
     if data is None:
         return None
     return unpack_shard(data, path=path)
+
+
+def read_shard_meta(
+    storage: CheckpointStorage, ckpt_dir: str, step: int, process_id: int
+) -> Optional[dict]:
+    """Header + meta-only read of one shard: the ``extra`` dict (step,
+    ``tensors_info`` placement, world metadata) WITHOUT touching the
+    data region — the reshard planner's input, so restore-to-any-mesh
+    can decide which ranks' shards it actually needs before paying for
+    any tensor bytes.  ``None`` when absent; raises
+    :class:`ShardCorruptionError` on structural damage (the meta CRC
+    covers everything read here)."""
+    path = shard_path(ckpt_dir, step, process_id)
+    f = storage.open_read(path)
+    if f is None:
+        return None
+    try:
+        meta, _version, _size, _data_base = _read_file_meta(f, path)
+        return meta["extra"]
+    finally:
+        f.close()
 
 
 def list_shard_ids(storage: CheckpointStorage, ckpt_dir: str, step: int) -> list:

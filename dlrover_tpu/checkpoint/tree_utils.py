@@ -144,31 +144,48 @@ def _owned(piece: np.ndarray) -> np.ndarray:
     return np.array(piece) if piece.base is not None else piece
 
 
+def _leaf_placements(leaf):
+    """For a sharding-bearing leaf (a live ``jax.Array`` OR a
+    ``ShapeDtypeStruct`` carrying a sharding — the restore-to-any-mesh
+    placeholder), return ``(sharding, gshape, [(device, index), ...])``
+    for its addressable shards without materializing anything; ``None``
+    for plain host leaves.  The indices map is the same source of truth
+    the reshard planner's boxes are pinned against."""
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is None or not hasattr(
+        sharding, "addressable_devices_indices_map"
+    ):
+        return None
+    gshape = tuple(leaf.shape)
+    imap = sharding.addressable_devices_indices_map(gshape)
+    return sharding, gshape, list(imap.items())
+
+
 def restore_to_target(
     target: Any, source: ShardSource
 ) -> Any:
     """Fill ``target`` (pytree of jax.Array / ShapeDtypeStruct / np arrays)
-    from ``source``.  jax.Array targets are rebuilt shard-by-shard on their
-    existing devices+sharding; others become full np arrays."""
+    from ``source``.  Sharding-bearing targets (live arrays, or
+    ShapeDtypeStructs with an explicit sharding — e.g. placeholders for a
+    mesh the saving world never had) are rebuilt shard-by-shard on their
+    devices; others become full np arrays."""
     flat, treedef = jax.tree_util.tree_flatten(target)
     paths_leaves = tree_flatten_with_path(target)[0]
     out_leaves = []
     for (path, leaf) in paths_leaves:
         name = keystr(path)
-        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
-            sharding = leaf.sharding
-            gshape = tuple(leaf.shape)
+        placed = _leaf_placements(leaf)
+        if placed is not None:
+            sharding, gshape, placements = placed
             arrays = []
-            devices = []
-            for shard in leaf.addressable_shards:
-                idx = _norm_index(shard.index, gshape)
+            for device, index in placements:
+                idx = _norm_index(index, gshape)
                 piece = source.assemble(name, idx, dtype=leaf.dtype)
                 if piece is None:
                     raise KeyError(
                         f"checkpoint missing data for {name} index {idx}"
                     )
-                arrays.append(jax.device_put(_owned(piece), shard.device))
-                devices.append(shard.device)
+                arrays.append(jax.device_put(_owned(piece), device))
             restored = jax.make_array_from_single_device_arrays(
                 gshape, sharding, arrays
             )

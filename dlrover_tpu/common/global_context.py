@@ -59,6 +59,19 @@ class Context:
         # Cross-node in-memory checkpoint replicas (flash-ckpt replica.py
         # analogue); off by default — costs DCN bandwidth per save.
         self.ckpt_replica: bool = False
+        # Live (restart-free) resharding on world change (ISSUE 6): a
+        # resize is first announced as a reshard epoch so surviving
+        # workers can move state mesh-to-mesh; any failure or deadline
+        # lapse falls back to the checkpoint-restart ladder unchanged.
+        self.live_reshard: bool = True
+        # How long the master waits for every worker's ok before
+        # declaring the live path failed and letting the restart ladder
+        # run.  Bounded: live reshard may never make recovery slower
+        # than the <90s restart path it replaces.
+        self.reshard_deadline_s: float = 60.0
+        # Worker-side throttle for the resize-epoch poll that rides the
+        # step-report path.
+        self.reshard_poll_interval: float = 2.0
         self._apply_env_overrides()
 
     def _apply_env_overrides(self) -> None:

@@ -52,6 +52,21 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> bool:
         # small programs too (the defaults skip fast compiles).
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # The cache backend LATCHES its directory (or a "no cache"
+        # decision) at the first compile and silently ignores config
+        # updates afterwards — a process that already jitted anything
+        # (warm-up probe, an earlier job in the same interpreter) would
+        # keep writing to the old location forever.  Drop the latch so
+        # the next compile re-binds from the config just set.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception as e:  # noqa: BLE001 - private API; losing the
+            # reset only re-creates the old latched-dir behaviour
+            from dlrover_tpu.common.log import logger
+
+            logger.debug("compilation-cache unlatch unavailable: %s", e)
         return True
     except Exception:  # noqa: BLE001 - cache is an optimization only
         return False

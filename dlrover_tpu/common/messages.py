@@ -692,3 +692,70 @@ class EmbeddingResult(Message):
     rows: bytes = b""
     blob: bytes = b""
     count: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Live resharding (ISSUE 6): mesh-to-mesh state moves without restart
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ReshardFetch(Message):
+    """Pull one plan segment's bytes from a peer's published shard table.
+
+    ``box`` is the segment's region in global tensor coordinates
+    (``[[start, stop], ...]``); the peer slices it out of its local shard
+    and answers with CRC-verified bytes."""
+
+    epoch: int = 0
+    step: int = -1
+    src_rank: int = 0
+    key: str = ""  # "<path>|<k>" shard key in the peer's table
+    box: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ReshardSegment(Message):
+    found: bool = False
+    reason: str = ""
+    payload: bytes = b""
+    crc32: int = 0
+    dtype: str = ""
+    shape: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ReshardEpochRequest(Message):
+    """Worker poll: is a live resize pending? (``epoch`` = last epoch the
+    caller observed; the master answers with the current one)."""
+
+    node_id: int = 0
+    epoch: int = -1
+
+
+@dataclasses.dataclass
+class ReshardEpochInfo(Message):
+    """The master's resize broadcast: at ``epoch`` the job wants
+    ``target_num_processes`` processes laid out as ``target_spec``
+    (MeshSpec axis sizes).  ``status`` in {idle, preparing, done,
+    aborted}."""
+
+    epoch: int = -1
+    status: str = "idle"
+    target_num_processes: int = 0
+    target_spec: dict = dataclasses.field(default_factory=dict)
+    deadline_s: float = 0.0
+
+
+@dataclasses.dataclass
+class ReshardReport(Message):
+    """A worker's verdict on one resize epoch: live reshard completed
+    (``ok``) or failed with ``reason`` (the master then lets the
+    checkpoint-restart ladder run)."""
+
+    node_id: int = 0
+    epoch: int = 0
+    ok: bool = False
+    reason: str = ""
+    downtime_ms: float = 0.0
+    moved_mb: float = 0.0

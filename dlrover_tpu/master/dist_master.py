@@ -116,11 +116,15 @@ class DistributedJobMaster(JobMaster):
         self.job_manager.on_critical_failure = lambda node: self.request_stop(
             False, JobExitReason.NODE_ERROR
         )
+        from dlrover_tpu.master.reshard import ReshardManager
+
+        self.reshard_manager = ReshardManager()
         self.auto_scaler = new_job_auto_scaler(
             job_args,
             self.job_manager,
             self.speed_monitor,
             self.resource_optimizer,
+            reshard_manager=self.reshard_manager,
         )
         self.strategy_generator = SimpleStrategyGenerator(
             self.job_manager, self.speed_monitor
@@ -135,6 +139,7 @@ class DistributedJobMaster(JobMaster):
             speed_monitor=self.speed_monitor,
             diagnosis_manager=self.diagnosis_manager,
             job_context=self,
+            reshard_manager=self.reshard_manager,
         )
         self._server = RpcServer(port, self.servicer)
         self.run_config: dict = {}

@@ -44,6 +44,7 @@ class AllreduceTrainingAutoScaler(JobAutoScaler):
         speed_monitor: SpeedMonitor,
         resource_optimizer: Optional[ResourceOptimizer] = None,
         interval: Optional[float] = None,
+        reshard_manager=None,
     ):
         self._job_args = job_args
         self._job_manager = job_manager
@@ -54,6 +55,13 @@ class AllreduceTrainingAutoScaler(JobAutoScaler):
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._speed_history: list = []
+        # Live-reshard two-phase resize (ISSUE 6): a grow/shrink decision
+        # first ANNOUNCES a resize epoch so surviving workers can move
+        # state mesh-to-mesh without restart; the process-level
+        # scale_workers_to (the restart ladder) runs only if the epoch
+        # aborts.  ``(epoch, target)`` while a resize is in flight.
+        self._reshard = reshard_manager
+        self._pending_resize: Optional[tuple] = None
 
     def start_auto_scaling(self) -> None:
         if self._thread is None:
@@ -74,11 +82,16 @@ class AllreduceTrainingAutoScaler(JobAutoScaler):
 
     def scale_once(self) -> int:
         """One decision pass; returns the applied worker delta."""
+        held = self._check_pending_resize()
+        if held is not None:
+            return held
         group = self._job_args.workers
         alive = len(self._job_manager.alive_workers())
         pending = len(self._job_manager.pending_workers())
         live = alive + pending
-        # 1) Backfill lost workers toward the configured count.
+        # 1) Backfill lost workers toward the configured count.  A LOST
+        # worker's state is unreachable — live reshard cannot help; the
+        # restart ladder (breakpoint save + rendezvous) owns this case.
         if live < group.min_count:
             target = self._round_to_unit(group.count)
             logger.info(
@@ -114,7 +127,7 @@ class AllreduceTrainingAutoScaler(JobAutoScaler):
                     logger.info(
                         "auto-scaler: growing workers %d -> %d", live, target
                     )
-                    return self._job_manager.scale_workers_to(target)
+                    return self._resize(alive, target)
             elif suggested is not None and 0 < suggested.count < live:
                 # Shrink: the optimizer judged the tail workers wasted
                 # (diminishing-returns walk-down); release them — but
@@ -131,8 +144,71 @@ class AllreduceTrainingAutoScaler(JobAutoScaler):
                         "auto-scaler: shrinking workers %d -> %d",
                         live, target,
                     )
-                    return self._job_manager.scale_workers_to(target)
+                    return self._resize(alive, target)
         return 0
+
+    def _resize(self, alive: int, target: int) -> int:
+        """Apply a grow/shrink decision.  A SHRINK with live, polling
+        workers goes through the restart-free path first: announce the
+        epoch, hold, and let survivors move the leaving ranks' state
+        mesh-to-mesh; the restart-path ``scale_workers_to`` runs only
+        when the epoch aborts (see :meth:`_check_pending_resize`).
+
+        A GROW always restart-scales: new processes must be provisioned
+        and rendezvous'd before any bytes could move into them — that
+        provisioning IS the existing ladder.  And with no recent epoch
+        poll from any worker (a training loop that never wired
+        ``poll_reshard``), announcing would only stall every resize for
+        the full deadline, so the scaler goes straight to the ladder."""
+        ctx = get_context()
+        if (
+            self._reshard is None
+            or not ctx.live_reshard
+            or alive <= 0
+            or target >= alive
+            or not self._reshard.has_observers(
+                max(15.0, 5 * ctx.reshard_poll_interval)
+            )
+        ):
+            return self._job_manager.scale_workers_to(target)
+        epoch = self._reshard.announce(target, expected_reports=alive)
+        self._pending_resize = (epoch, target)
+        return 0
+
+    def _check_pending_resize(self) -> Optional[int]:
+        """While a resize epoch is in flight every scaling decision is
+        held (the two-phase pattern the serving scaler uses for drains).
+        Returns the delta to report while holding, or ``None`` when the
+        pass should proceed normally."""
+        if self._pending_resize is None:
+            return None
+        epoch, target = self._pending_resize
+        from dlrover_tpu.master import reshard as rs
+
+        status = self._reshard.status
+        if status == rs.PREPARING:
+            return 0  # workers are moving bytes; hold everything
+        self._pending_resize = None
+        if status == rs.DONE:
+            # Survivors hold all the state now; the leaving (highest
+            # rank) workers are state-free.  Releasing them is the
+            # point of the shrink — what the live path saved is the
+            # SURVIVORS' teardown/restore, not the surplus workers'
+            # exit.  Without this the job would keep paying for workers
+            # the optimizer already judged wasted, and the next pass
+            # would announce the same shrink forever.
+            logger.info(
+                "auto-scaler: resize epoch %d completed live; releasing "
+                "surplus workers -> %d (survivors keep running)",
+                epoch, target,
+            )
+            return self._job_manager.scale_workers_to(target)
+        logger.warning(
+            "auto-scaler: resize epoch %d did not complete live (%s); "
+            "falling back to the restart path -> %d workers",
+            epoch, status, target,
+        )
+        return self._job_manager.scale_workers_to(target)
 
     def _round_to_unit(self, n: int) -> int:
         unit = max(1, self._job_args.node_unit)
@@ -303,6 +379,7 @@ def new_job_auto_scaler(
     speed_monitor: SpeedMonitor,
     resource_optimizer: Optional[ResourceOptimizer] = None,
     serving_gateway=None,
+    reshard_manager=None,
 ) -> JobAutoScaler:
     """Factory (reference ``new_job_auto_scaler :41``).  A serving job
     (``distribution_strategy == "serving"``) needs the gateway handle —
@@ -327,5 +404,6 @@ def new_job_auto_scaler(
             job_args, job_manager, resource_optimizer
         )
     return AllreduceTrainingAutoScaler(
-        job_args, job_manager, speed_monitor, resource_optimizer
+        job_args, job_manager, speed_monitor, resource_optimizer,
+        reshard_manager=reshard_manager,
     )

@@ -109,6 +109,9 @@ class LocalJobMaster(JobMaster):
             diagnosis_manager=self.diagnosis_manager,
         ).on_node_failed
 
+        from dlrover_tpu.master.reshard import ReshardManager
+
+        self.reshard_manager = ReshardManager()
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             job_manager=self.job_manager,
@@ -118,6 +121,7 @@ class LocalJobMaster(JobMaster):
             speed_monitor=self.speed_monitor,
             diagnosis_manager=self.diagnosis_manager,
             job_context=self,
+            reshard_manager=self.reshard_manager,
         )
         self._server = RpcServer(port, self.servicer)
 

@@ -1,0 +1,183 @@
+"""Master-side resize-epoch broadcast for live (restart-free) resharding.
+
+The control half of ``dlrover_tpu/reshard/``: when the job wants a new
+world size (autoscaler decision, operator request), the master *announces*
+a resize epoch instead of immediately tearing the world down.  Surviving
+workers observe the epoch between steps (``ElasticContext.poll_reshard``),
+quiesce, execute the mesh-to-mesh plan, re-jit, and report back.  The
+broadcast is advisory by design:
+
+- every worker reports ``ok`` within the deadline  → the resize completed
+  as a data-plane move; no rendezvous restart happens;
+- any worker reports failure, or the deadline lapses → the epoch is
+  ABORTED and the normal checkpoint-restart ladder (scaler + rendezvous)
+  proceeds exactly as it does today.  Live reshard can therefore never
+  make recovery *worse* than the restart path it replaces.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.global_context import get_context
+from dlrover_tpu.common.log import logger
+
+IDLE = "idle"
+PREPARING = "preparing"
+DONE = "done"
+ABORTED = "aborted"
+
+
+class ReshardManager:
+    """Resize-epoch state machine (one live resize in flight at a time)."""
+
+    def __init__(self, clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._epoch = 0
+        self._status = IDLE
+        self._target_num = 0
+        self._target_spec: dict = {}
+        self._deadline = 0.0
+        self._expected: int = 0
+        self._reports: Dict[int, m.ReshardReport] = {}
+        # Last time ANY worker polled the epoch (info()): the scaler
+        # only goes live when someone is actually listening — a job
+        # whose training loop never wired poll_reshard must not pay the
+        # announce deadline on every resize.
+        self._last_poll = float("-inf")
+
+    def has_observers(self, window_s: float = 30.0) -> bool:
+        """True when a worker polled the resize epoch within
+        ``window_s`` — the scaler's precondition for announcing a live
+        resize instead of restart-scaling immediately."""
+        with self._lock:
+            return self._clock() - self._last_poll <= window_s
+
+    # -- announce (autoscaler / operator) -----------------------------------
+    def announce(
+        self,
+        target_num_processes: int,
+        target_spec: Optional[dict] = None,
+        expected_reports: int = 0,
+        deadline_s: Optional[float] = None,
+    ) -> int:
+        """Broadcast a new resize epoch; returns the epoch id.  A resize
+        already in flight is aborted first (the newer target wins)."""
+        ctx = get_context()
+        with self._lock:
+            if self._status == PREPARING:
+                logger.warning(
+                    "reshard: epoch %d superseded before completion",
+                    self._epoch,
+                )
+            self._epoch += 1
+            self._status = PREPARING
+            self._target_num = int(target_num_processes)
+            self._target_spec = dict(target_spec or {})
+            self._expected = int(expected_reports)
+            self._reports = {}
+            budget = (
+                ctx.reshard_deadline_s if deadline_s is None else deadline_s
+            )
+            self._deadline = self._clock() + budget
+            logger.info(
+                "reshard: announcing epoch %d -> %d processes (spec=%s, "
+                "deadline %.0fs)",
+                self._epoch, self._target_num, self._target_spec, budget,
+            )
+            return self._epoch
+
+    def abort(self, reason: str = "") -> None:
+        with self._lock:
+            if self._status == PREPARING:
+                logger.warning(
+                    "reshard: epoch %d aborted (%s) — falling back to the "
+                    "checkpoint-restart ladder", self._epoch, reason,
+                )
+                self._status = ABORTED
+
+    # -- worker-facing -------------------------------------------------------
+    def info(self) -> m.ReshardEpochInfo:
+        self._sweep_expiry()
+        with self._lock:
+            self._last_poll = self._clock()
+            return m.ReshardEpochInfo(
+                epoch=self._epoch,
+                status=self._status,
+                target_num_processes=self._target_num,
+                target_spec=dict(self._target_spec),
+                deadline_s=max(0.0, self._deadline - self._clock())
+                if self._status == PREPARING
+                else 0.0,
+            )
+
+    def report(self, msg: m.ReshardReport) -> m.BaseResponse:
+        with self._lock:
+            if msg.epoch != self._epoch:
+                return m.BaseResponse(
+                    success=False,
+                    reason=f"stale epoch {msg.epoch} (current {self._epoch})",
+                )
+            self._reports[msg.node_id] = msg
+            if not msg.ok:
+                logger.warning(
+                    "reshard: node %d failed epoch %d: %s",
+                    msg.node_id, msg.epoch, msg.reason,
+                )
+                if self._status == PREPARING:
+                    self._status = ABORTED
+                return m.BaseResponse(success=True)
+            logger.info(
+                "reshard: node %d completed epoch %d in %.0fms "
+                "(%.1f MB moved)",
+                msg.node_id, msg.epoch, msg.downtime_ms, msg.moved_mb,
+            )
+            oks = sum(1 for r in self._reports.values() if r.ok)
+            if (
+                self._status == PREPARING
+                and self._expected > 0
+                and oks >= self._expected
+            ):
+                self._status = DONE
+                logger.info(
+                    "reshard: epoch %d DONE — %d/%d nodes resized live, "
+                    "no restart", self._epoch, oks, self._expected,
+                )
+            return m.BaseResponse(success=True)
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _sweep_expiry(self) -> None:
+        """Abort a PREPARING epoch whose deadline lapsed.  Takes the lock
+        itself; readers call it BEFORE their own locked read (a report
+        flipping the status concurrently is a legitimate ordering, not a
+        race)."""
+        with self._lock:
+            if self._status != PREPARING or self._clock() <= self._deadline:
+                return
+            logger.warning(
+                "reshard: epoch %d deadline lapsed with %d/%d ok "
+                "reports; aborting (restart ladder takes over)",
+                self._epoch,
+                sum(1 for r in self._reports.values() if r.ok),
+                self._expected,
+            )
+            self._status = ABORTED
+
+    @property
+    def status(self) -> str:
+        self._sweep_expiry()
+        with self._lock:
+            return self._status
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def reports(self) -> Dict[int, m.ReshardReport]:
+        with self._lock:
+            return dict(self._reports)

@@ -33,6 +33,7 @@ class MasterServicer:
         speed_monitor=None,
         diagnosis_manager=None,
         job_context=None,
+        reshard_manager=None,
     ):
         self.task_manager = task_manager
         self.job_manager = job_manager
@@ -42,6 +43,7 @@ class MasterServicer:
         self.speed_monitor = speed_monitor
         self.diagnosis_manager = diagnosis_manager
         self.job_context = job_context  # the master itself (stop control)
+        self.reshard_manager = reshard_manager
         self._dispatch = {
             m.NodeMeta: self._on_node_meta,
             m.ReportNodeStatus: self._on_node_status,
@@ -76,6 +78,8 @@ class MasterServicer:
             m.ElasticRunConfigRequest: self._on_run_config,
             m.ParallelConfigRequest: self._on_paral_config,
             m.JobExitRequest: self._on_job_exit,
+            m.ReshardEpochRequest: self._on_reshard_epoch,
+            m.ReshardReport: self._on_reshard_report,
         }
 
     def __call__(self, msg: m.Message) -> Optional[m.Message]:
@@ -339,3 +343,16 @@ class MasterServicer:
         if self.job_context is not None:
             self.job_context.request_stop(msg.success, msg.reason)
         return None
+
+    # -- live resharding (ISSUE 6) ------------------------------------------
+    def _on_reshard_epoch(self, msg: m.ReshardEpochRequest):
+        if self.reshard_manager is None:
+            return m.ReshardEpochInfo()  # epoch=-1, idle: nothing pending
+        return self.reshard_manager.info()
+
+    def _on_reshard_report(self, msg: m.ReshardReport):
+        if self.reshard_manager is None:
+            return m.BaseResponse(
+                success=False, reason="no reshard manager on this master"
+            )
+        return self.reshard_manager.report(msg)

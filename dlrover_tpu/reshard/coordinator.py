@@ -1,0 +1,238 @@
+"""Live-reshard orchestration: quiesce → plan → move → re-jit, no restart.
+
+The resize control flow (ElasWave's elastic-native resizing, TPU-style):
+
+1. the master broadcasts a **resize epoch** (``master/reshard.py``
+   ``ReshardManager``); workers observe it between steps via
+   ``ElasticContext.poll_reshard``;
+2. each surviving worker quiesces at the step boundary
+   (``jax.block_until_ready`` — async dispatch drained, state bytes
+   stable), snapshots its host shards, and publishes them for peers;
+3. the pure planner maps the source layout onto the target layout; the
+   mover executes the segments (zero-copy local, CRC-verified RPC
+   cross-host);
+4. the mesh is rebuilt via ``parallel.mesh.build_mesh`` and the step
+   re-jitted on the new world — **surviving processes never exit**;
+5. on *any* plan/move/verify failure a :class:`ReshardError` surfaces
+   loudly and the caller falls back to the existing checkpoint-restart
+   ladder (storage commit protocol + restore), which remains the
+   correctness backstop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.reshard import plan as plan_mod
+from dlrover_tpu.reshard.mover import (
+    LocalShardSource,
+    ReshardMoveError,
+    SegmentMover,
+)
+
+
+class ReshardError(RuntimeError):
+    """Live reshard impossible or failed — fall back to the
+    checkpoint-restart ladder.  The message says why, loudly."""
+
+
+@dataclasses.dataclass
+class ReshardOutcome:
+    """What one live reshard did (feeds the master report + the bench)."""
+
+    ok: bool = False
+    epoch: int = -1
+    downtime_s: float = 0.0
+    moved_local_mb: float = 0.0
+    moved_cross_mb: float = 0.0
+    segments: int = 0
+    reason: str = ""
+
+    @property
+    def moved_mb(self) -> float:
+        return self.moved_local_mb + self.moved_cross_mb
+
+
+def spec_of_leaf(leaf) -> Any:
+    """The PartitionSpec of a leaf's NamedSharding (replicated for
+    anything else) — how an old state's layout is re-expressed on a new
+    mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = getattr(leaf, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        return sharding.spec
+    return PartitionSpec()
+
+
+def target_placeholders(
+    state: Any, mesh, specs: Any = None
+) -> Any:
+    """Placeholder tree for ``state`` re-homed onto ``mesh``:
+    ShapeDtypeStructs carrying ``NamedSharding(mesh, spec)`` — the shape
+    ``restore_to_target`` (and therefore the whole plan/move pipeline)
+    assembles onto without materializing a byte.  ``specs`` defaults to
+    each leaf's current PartitionSpec (same parallelism, new world);
+    pass a spec tree to change the factorization as well."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    if specs is None:
+        specs = jax.tree_util.tree_map(spec_of_leaf, state)
+
+    def make(leaf, spec):
+        return jax.ShapeDtypeStruct(
+            np.shape(leaf),
+            getattr(leaf, "dtype", np.asarray(leaf).dtype),
+            sharding=NamedSharding(mesh, spec),
+        )
+
+    return jax.tree_util.tree_map(make, state, specs)
+
+
+def _dst_layout_from_targets(
+    target: Any, rank: int = 0
+) -> plan_mod.MeshLayout:
+    """Target layout (this rank's addressable boxes) from a placeholder
+    tree — boxes come from jax's own ``addressable_devices_indices_map``,
+    so the plan is pinned to exactly what the re-jitted step will expect."""
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    from dlrover_tpu.checkpoint.tree_utils import (
+        _leaf_placements,
+        _norm_index,
+    )
+
+    tensors: Dict[str, plan_mod.TensorInfo] = {}
+    keyed: Dict[str, plan_mod.Box] = {}
+    for path, leaf in tree_flatten_with_path(target)[0]:
+        name = keystr(path)
+        placed = _leaf_placements(leaf)
+        if placed is not None:
+            _sharding, gshape, placements = placed
+            boxes = {
+                _norm_index(index, gshape) for _d, index in placements
+            }
+            dtype = np.dtype(leaf.dtype).name
+        else:
+            gshape = tuple(np.shape(leaf))
+            boxes = {tuple((0, d) for d in gshape)}
+            dtype = np.dtype(
+                getattr(leaf, "dtype", np.asarray(leaf).dtype)
+            ).name
+        tensors[name] = plan_mod.TensorInfo(name, tuple(gshape), dtype)
+        for k, box in enumerate(sorted(boxes)):
+            keyed[f"{name}|{k}"] = box
+    return plan_mod.MeshLayout(tensors=tensors, shards={rank: keyed})
+
+
+def reshard_shards(
+    local_tensors: Dict[str, np.ndarray],
+    local_infos: Dict[str, dict],
+    target: Any,
+    *,
+    rank: int = 0,
+    src_infos_by_rank: Optional[Dict[int, Dict[str, dict]]] = None,
+    extra_local_sources: Optional[Dict[int, LocalShardSource]] = None,
+    fetch=None,
+    epoch: int = -1,
+) -> Any:
+    """Rank-local reshard: rebuild this rank's slice of ``target`` (a
+    placeholder tree from :func:`target_placeholders`, or a live state)
+    from staged source shards.  ``local_tensors``/``local_infos`` is this
+    rank's own staged state (``flatten_to_shards`` format);
+    ``src_infos_by_rank`` describes every source rank's shards (defaults
+    to just this rank — the single-process case where one rank holds
+    everything); remote ranks' bytes arrive through ``fetch``.
+
+    Raises :class:`ReshardError` on any plan/move/verify failure."""
+    from dlrover_tpu.checkpoint.tree_utils import (
+        ShardSource,
+        restore_to_target,
+    )
+
+    dtypes = {
+        meta["path"]: np.asarray(local_tensors[key]).dtype.name
+        for key, meta in local_infos.items()
+        if key in local_tensors
+    }
+    if src_infos_by_rank is None:
+        src_infos_by_rank = {rank: local_infos}
+    try:
+        src_layout = plan_mod.layout_from_tensors_info(
+            src_infos_by_rank, dtypes
+        )
+        dst_layout = _dst_layout_from_targets(target, rank)
+        the_plan = plan_mod.build_plan(src_layout, dst_layout)
+    except plan_mod.PlanError as e:
+        raise ReshardError(f"reshard plan failed: {e}") from e
+    sources = {rank: LocalShardSource(local_tensors, local_infos)}
+    if extra_local_sources:
+        sources.update(extra_local_sources)
+    mover = SegmentMover(rank, sources, fetch=fetch)
+    try:
+        out_tensors, out_infos, stats = mover.execute(the_plan)
+    except ReshardMoveError as e:
+        raise ReshardError(f"reshard move failed: {e}") from e
+    source = ShardSource()
+    source.add(out_tensors, out_infos)
+    try:
+        new_state = restore_to_target(target, source)
+    except KeyError as e:
+        raise ReshardError(f"reshard verify failed: {e}") from e
+    logger.info(
+        "reshard (epoch %d): %d segments, %.1f MB local / %.1f MB cross "
+        "in %.3fs",
+        epoch, stats["segments"], stats["local_bytes"] / (1 << 20),
+        stats["cross_bytes"] / (1 << 20), stats["elapsed_s"],
+    )
+    return new_state, stats
+
+
+def reshard_state(
+    state: Any,
+    target_mesh,
+    specs: Any = None,
+    *,
+    epoch: int = -1,
+) -> Any:
+    """In-process live reshard of a whole sharded state onto
+    ``target_mesh`` — quiesce, snapshot host shards, plan, move, rebuild.
+    Returns ``(new_state, ReshardOutcome)``; raises :class:`ReshardError`
+    (after logging loudly) when the caller must take the
+    checkpoint-restart ladder instead."""
+    import jax
+
+    from dlrover_tpu.checkpoint.tree_utils import flatten_to_shards
+
+    t0 = time.perf_counter()
+    try:
+        # Quiesce: drain async dispatch so the host snapshot reads a
+        # stable step boundary, not bytes a queued donated-buffer update
+        # is about to rewrite.
+        jax.block_until_ready(state)
+        tensors, infos = flatten_to_shards(state)
+        target = target_placeholders(state, target_mesh, specs)
+    except ReshardError:
+        raise
+    except Exception as e:  # noqa: BLE001 - anything here means the live
+        # path is unusable; the ladder below is the safety net.
+        logger.error("live reshard aborted before the move: %s", e)
+        raise ReshardError(f"reshard snapshot failed: {e}") from e
+    new_state, stats = reshard_shards(
+        tensors, infos, target, epoch=epoch
+    )
+    outcome = ReshardOutcome(
+        ok=True,
+        epoch=epoch,
+        downtime_s=time.perf_counter() - t0,
+        moved_local_mb=stats["local_bytes"] / (1 << 20),
+        moved_cross_mb=stats["cross_bytes"] / (1 << 20),
+        segments=stats["segments"],
+    )
+    return new_state, outcome

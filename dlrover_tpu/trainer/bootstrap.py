@@ -44,6 +44,8 @@ class ElasticContext:
         self.client: Optional[MasterClient] = None
         self.distributed = False
         self._last_metrics_report = 0.0
+        self._last_reshard_poll = 0.0
+        self._last_reshard_epoch = -1
 
     @property
     def is_leader(self) -> bool:
@@ -82,6 +84,60 @@ class ElasticContext:
                     # Missing a heartbeat is survivable; a silent
                     # string of them looks like a hang to the master.
                     logger.debug("step-metrics report failed: %s", e)
+
+
+    # -- live resharding (ISSUE 6) ------------------------------------------
+    def poll_reshard(self):
+        """Between-steps check for a pending resize epoch (the master's
+        live-reshard broadcast).  Throttled to
+        ``Context.reshard_poll_interval`` so it can ride the step loop;
+        returns a ``ReshardEpochInfo`` exactly once per NEW preparing
+        epoch, else ``None``.  The caller (the training loop) quiesces at
+        the step boundary, runs ``ElasticTrainer.reshard_live``, and
+        reports the verdict via :meth:`report_reshard`."""
+        if self.client is None:
+            return None
+        import time as _time
+
+        from dlrover_tpu.common.global_context import get_context
+
+        now = _time.time()
+        if now - self._last_reshard_poll < get_context().reshard_poll_interval:
+            return None
+        self._last_reshard_poll = now
+        try:
+            info = self.client.get_reshard_epoch()
+        except Exception as e:  # noqa: BLE001
+            logger.debug("reshard-epoch poll failed: %s", e)
+            return None
+        if info.status != "preparing" or info.epoch <= self._last_reshard_epoch:
+            return None
+        self._last_reshard_epoch = info.epoch
+        logger.info(
+            "reshard: observed resize epoch %d -> %d processes (spec=%s)",
+            info.epoch, info.target_num_processes, info.target_spec,
+        )
+        return info
+
+    def report_reshard(self, epoch: int, outcome=None, error: str = "") -> None:
+        """Report a live-reshard verdict back to the master (best-effort:
+        a lost report only means the epoch times out into the restart
+        ladder — safe, just slower)."""
+        if self.client is None:
+            return
+        try:
+            if outcome is not None and getattr(outcome, "ok", False):
+                self.client.report_reshard(
+                    epoch, True,
+                    downtime_ms=outcome.downtime_s * 1000.0,
+                    moved_mb=outcome.moved_mb,
+                )
+            else:
+                self.client.report_reshard(
+                    epoch, False, reason=error or "reshard failed"
+                )
+        except Exception as e:  # noqa: BLE001
+            logger.warning("reshard report failed: %s", e)
 
 
 _ctx: Optional[ElasticContext] = None

@@ -36,3 +36,58 @@ def cpu_mesh_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected >=8 virtual cpu devices, got {len(devs)}"
     return devs
+
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cpu_mesh_subprocess(
+    code, devices=8, env_extra=None, timeout=300, check=False
+):
+    """Run a python snippet in a FRESH process with ``devices`` forced
+    host CPU devices — the ``--xla_force_host_platform_device_count``
+    subprocess pattern from ``test_e2e_elastic``, shared so planner /
+    mover / reshard equivalence tests run tier-1 without real TPUs (and
+    so crash-site chaos tests can assert on exit codes without taking
+    the test runner down with them).
+
+    Returns the ``subprocess.CompletedProcess`` (text mode, output
+    captured).  ``env_extra`` overlays the environment — e.g. a
+    ``DLROVER_TPU_FAULTS`` plan; without one the variable is scrubbed so
+    an operator's ambient chaos plan can't leak into assertions."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (
+                f"--xla_force_host_platform_device_count={devices}"
+            ),
+            "PYTHONPATH": REPO_ROOT,
+        }
+    )
+    env.pop("DLROVER_TPU_FAULTS", None)
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.run(
+        [_sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=REPO_ROOT,
+    )
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"subprocess failed rc={proc.returncode}:\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+        )
+    return proc
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_subprocess():
+    """Session fixture handle on :func:`run_cpu_mesh_subprocess`."""
+    return run_cpu_mesh_subprocess

@@ -201,3 +201,46 @@ def test_serve_bench_smoke_schema(tmp_path):
     metric = json.loads(proc.stdout.strip().splitlines()[-1])
     assert metric["metric"] == "serve_fleet_speedup"
     assert metric["artifact"] == str(out)
+
+
+def test_reshard_bench_smoke_schema(tmp_path):
+    """Tier-1 gate for ISSUE 6's live-reshard bench: the smoke config
+    (4MB state, 2->4->2 over forced host devices) runs end-to-end on CPU
+    inside the budget and emits schema-valid JSON — one live and one
+    restart row per transition, the per-transition speedup map, and a
+    rc=0 verdict that requires the live path strictly below the restart
+    path (the PR's acceptance criterion, enforced on every tier-1 run)."""
+    import os
+    import subprocess
+    import time
+
+    out = tmp_path / "RESHARD_BENCH_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DLROVER_TPU_FAULTS", None)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(Path(bench.__file__)), "--reshard_bench",
+         "--smoke", f"--out={out}"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(Path(bench.__file__).parent),
+    )
+    elapsed = time.time() - t0
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert elapsed < 30.0, f"smoke reshard bench took {elapsed:.1f}s"
+    result = json.loads(out.read_text())
+    assert result["complete"] is True
+    assert result["live_strictly_faster"] is True
+    paths = [(r["resize"], r["path"]) for r in result["rows"]]
+    assert set(paths) == {
+        ("2->4", "live"), ("4->2", "live"),
+        ("2->4", "restart"), ("4->2", "restart"),
+    }
+    live = {r["resize"]: r for r in result["rows"] if r["path"] == "live"}
+    assert all(r["segments"] > 0 and r["moved_mb"] > 0
+               for r in live.values())
+    assert set(result["speedup_restart_over_live"]) == {"2->4", "4->2"}
+    assert result["speedup_total"] > 1.0
+    # The metric line is the last stdout line and carries the artifact.
+    metric = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert metric["metric"] == "reshard_live_vs_restart_downtime"
+    assert metric["artifact"] == str(out)

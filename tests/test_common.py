@@ -1,5 +1,6 @@
 """L0 substrate tests: messages, RPC, node model, storage, context."""
 
+import os
 import threading
 import time
 
@@ -371,6 +372,12 @@ class TestPublicAPI:
 
 class TestCompilationCache:
     def test_enable_compilation_cache_modes(self, tmp_path, monkeypatch):
+        """Order-independent by design: the cache backend latches its
+        directory at the first compile in the process, so this test used
+        to pass only when nothing had jitted before it (tier-1 ordering);
+        ``enable_compilation_cache`` now drops that latch itself, and the
+        teardown drops it again so the NEXT test never inherits a cache
+        pointed at this test's deleted tmp dir."""
         import jax
 
         from dlrover_tpu.common.jax_env import enable_compilation_cache
@@ -386,9 +393,19 @@ class TestCompilationCache:
             assert jax.config.jax_compilation_cache_dir == d
             assert (tmp_path / "xla").is_dir()
 
-            # A compiled program actually lands in the cache dir.
-            jax.jit(lambda x: x * 2 + 1)(jax.numpy.ones((32,))
+            # A compiled program actually lands in the cache dir — a
+            # FRESH computation (unique shape) so neither the in-memory
+            # executable cache nor an earlier persistent entry can
+            # satisfy it without writing here.
+            n = 32 + (os.getpid() % 17)
+            jax.jit(lambda x: x * 2 + 1)(jax.numpy.ones((n,))
                                          ).block_until_ready()
             assert any((tmp_path / "xla").iterdir())
         finally:
             jax.config.update("jax_compilation_cache_dir", prev)
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception:  # noqa: BLE001 - best-effort unlatch
+                pass
