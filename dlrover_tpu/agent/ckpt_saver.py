@@ -23,7 +23,7 @@ from typing import Dict, Optional
 
 from dlrover_tpu import chaos
 from dlrover_tpu.agent.metrics import integrity_counters, perf_stats
-from dlrover_tpu.checkpoint import shard_file
+from dlrover_tpu.checkpoint import shard_file, slicer
 from dlrover_tpu.checkpoint.engine import (
     ckpt_lock_name,
     ckpt_queue_name,
@@ -75,6 +75,12 @@ class AsyncCheckpointSaver:
             lr: threading.Lock() for lr in range(nproc_per_node)
         }
         self._persisted: Dict[int, int] = {}  # local_rank -> step
+        # Dirty-fence memory per local rank (incremental saves), keyed
+        # by the (ckpt_dir, process_id, world) scope it was built for —
+        # an elastic re-rendezvous that re-identifies the rank resets it
+        # (the next save is then full, never wrong).
+        self._dirty: Dict[int, slicer.DirtyTracker] = {}
+        self._dirty_scope: Dict[int, tuple] = {}
         self._perf_cache: tuple = (0.0, {})  # (fetched_at, stat snapshot)
         self._last_event: Dict[int, dict] = {}
         self._stop = threading.Event()
@@ -305,23 +311,40 @@ class AsyncCheckpointSaver:
                     )
                     return
                 if not copy_mode:
-                    stats = self._persist(ckpt_dir, step, pid, tensors, extra)
+                    stats = self._persist(
+                        ckpt_dir, step, pid, tensors, extra, lr=lr,
+                        sliced=not event.get("breakpoint"),
+                        world=nproc_global,
+                    )
         finally:
             if lock is not None:
                 lock.release()
         if copy_mode:
             # Stable copies: persist outside the locks, then push.
-            stats = self._persist(ckpt_dir, step, pid, tensors, extra)
+            stats = self._persist(
+                ckpt_dir, step, pid, tensors, extra, lr=lr,
+                sliced=not event.get("breakpoint"), world=nproc_global,
+            )
             if self.replica is not None:
                 self._pool.submit(
                     self.replica.backup_shard, pid, step, tensors, extra
                 )
         self._report_persist_perf(step, stats["mbps"])
         self._persisted[lr] = step
-        self._stat.set(f"persisted_{lr}", step)
+        # One round trip for the whole rank row: the persisted-step ack
+        # plus the per-rank gauges the agg scrape sums.
+        self._stat.update(
+            {
+                f"persisted_{lr}": step,
+                f"persist_mbps_{lr}": round(stats["mbps"], 1),
+                f"tensors_skipped_{lr}": stats.get("skipped", 0),
+            }
+        )
         logger.info(
-            "saver: persisted rank %d step %d in %.2fs (%.0f MB/s)",
+            "saver: persisted rank %d step %d in %.2fs (%.0f MB/s, "
+            "%d tensors ref'd unchanged)",
             lr, step, stats["seconds"], stats["mbps"],
+            stats.get("skipped", 0),
         )
         if pid == 0:
             # Commit waits for the OTHER ranks' shards — never block the
@@ -330,34 +353,92 @@ class AsyncCheckpointSaver:
                 self._commit, ckpt_dir, step, nproc_global, keep_last
             )
 
+    def _tracker(
+        self, lr: int, ckpt_dir: str, pid: int, world: int
+    ) -> slicer.DirtyTracker:
+        scope = (ckpt_dir, pid, world)
+        if self._dirty_scope.get(lr) != scope:
+            self._dirty[lr] = slicer.DirtyTracker()
+            self._dirty_scope[lr] = scope
+        return self._dirty[lr]
+
     def _persist(
-        self, ckpt_dir: str, step: int, pid: int, tensors, extra
+        self, ckpt_dir: str, step: int, pid: int, tensors, extra,
+        *, lr: int = 0, sliced: bool = True, world: Optional[int] = None,
     ) -> dict:
-        """One streamed shard write + throughput stats/gauges."""
+        """One streamed shard write + throughput stats/gauges.
+
+        The rank writes only its disjoint slice of replicated tensors
+        (``sliced=False`` on breakpoint saves: a dying partial world must
+        leave restorable FULL shards, not orphan slices) and refs
+        tensors whose dirty fence has not tripped since their holder
+        step."""
         t0 = time.perf_counter()
         chaos.inject("ckpt.slow_storage", step=step, rank=pid)
+        world = int(world or extra.get("num_processes") or self.nproc)
+        plan = slicer.plan_persist(
+            tensors, extra,
+            process_id=pid, num_processes=world,
+            sliced=sliced and self._ctx.ckpt_sliced_persist,
+            tracker=(
+                self._tracker(lr, ckpt_dir, pid, world)
+                if self._ctx.ckpt_incremental else None
+            ),
+            holder_exists=lambda s: self.storage.exists(
+                shard_file.shard_path(ckpt_dir, s, pid)
+            ),
+        )
         stats = shard_file.write_shard_from_views(
-            self.storage, ckpt_dir, step, pid, tensors, extra,
+            self.storage, ckpt_dir, step, pid, plan.tensors, plan.extra,
             workers=self._ctx.ckpt_persist_workers,
+            meta_extra=plan.meta_extra,
+        )
+        self._tracker(lr, ckpt_dir, pid, world).note_plan(
+            plan, step, stats.get("crcs", {})
         )
         stats["seconds"] = max(1e-9, time.perf_counter() - t0)
         stats["mbps"] = stats["total_bytes"] / stats["seconds"] / (1 << 20)
+        stats["skipped"] = plan.skipped
         perf_stats.set("ckpt_persist_mbps", stats["mbps"])
         return stats
 
     def _report_persist_perf(self, step: int, mbps: float) -> None:
         """Throughput-only CkptPerf to the master (stall_ms=0 touches no
-        stall bookkeeping).  Called AFTER the fencing lock/arena mutex
-        are released — a slow master must never stretch the lock hold
-        the trainer's next save waits on.  Best-effort, short budget."""
+        stall bookkeeping) including the node's AGGREGATE persist rate
+        and skipped-tensor count for the goodput/diagnosis log.  Called
+        AFTER the fencing lock/arena mutex are released — a slow master
+        must never stretch the lock hold the trainer's next save waits
+        on.  Best-effort, short budget."""
         if self.client is None:
             return
         try:
             self.client.report_ckpt_perf(
-                step=step, stall_ms=0.0, persist_mbps=mbps
+                step=step, stall_ms=0.0, persist_mbps=mbps,
+                agg_persist_mbps=self.agg_persist_mbps(),
+                tensors_skipped=self.tensors_skipped_total(),
             )
         except Exception as e:  # noqa: BLE001
             logger.debug("persist perf report failed: %s", e)
+
+    def agg_persist_mbps(self) -> float:
+        """Sum of every local rank's last persist throughput — the
+        node-level aggregate bandwidth the sliced persist exists to
+        scale; rides the same one-round-trip stat snapshot as the other
+        gauges."""
+        snap = self.worker_perf()
+        return sum(
+            float(v) for k, v in snap.items()
+            if k.startswith("persist_mbps_") and v is not None
+        )
+
+    def tensors_skipped_total(self) -> int:
+        """Sum of every local rank's last dirty-fence skip count (the
+        ``ckpt_tensors_skipped`` gauge)."""
+        snap = self.worker_perf()
+        return int(sum(
+            int(v) for k, v in snap.items()
+            if k.startswith("tensors_skipped_") and v is not None
+        ))
 
     def worker_perf(self) -> Dict[str, float]:
         """One snapshot of the workers' reported perf stats — a single
@@ -410,6 +491,13 @@ class AsyncCheckpointSaver:
             )
         while time.time() < deadline:
             if shard_file.all_shards_done(self.storage, ckpt_dir, step, world):
+                # Votes in hand, writes finished: an unprovable slice
+                # cover is terminal for this step (the previous
+                # committed step stays the restore point).
+                if self._ctx.ckpt_commit_coverage and not slicer.commit_gate(
+                    self.storage, ckpt_dir, step
+                ):
+                    return
                 shard_file.commit(
                     self.storage, ckpt_dir, step, keep_last=keep_last
                 )
@@ -477,5 +565,10 @@ class AsyncCheckpointSaver:
                     "process_id": extra.get("process_id", lr),
                     "num_processes": extra.get("num_processes", self.nproc),
                     "ckpt_dir": ckpt_dir,
+                    # A breakpoint save may be the last write a dying
+                    # world ever makes: write FULL shards — orphan slices
+                    # from a partial world would be unrestorable, where a
+                    # full replicated shard from any one rank is.
+                    "breakpoint": True,
                 }
             )

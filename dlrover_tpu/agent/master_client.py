@@ -289,6 +289,7 @@ class MasterClient:
     def report_ckpt_perf(
         self, step: int, stall_ms: float,
         staged_mbps: float = 0.0, persist_mbps: float = 0.0,
+        agg_persist_mbps: float = 0.0, tensors_skipped: int = -1,
     ) -> None:
         """Feed the master's goodput accounting with the measured
         save_to_memory stall (flash-ckpt fast path observability).
@@ -301,6 +302,8 @@ class MasterClient:
             m.CkptPerf(
                 node_id=self.node_id, step=step, stall_ms=stall_ms,
                 staged_mbps=staged_mbps, persist_mbps=persist_mbps,
+                agg_persist_mbps=agg_persist_mbps,
+                tensors_skipped=int(tensors_skipped),
             ),
             timeout=1.0, retries=1, deadline=1.0,
         )

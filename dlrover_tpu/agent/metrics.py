@@ -53,6 +53,7 @@ INTEGRITY_COUNTER_NAMES = (
     "ckpt_step_quarantined",  # step dir renamed/markered out of the ladder
     "ckpt_replica_rejected",  # replica payload failed verification
     "ckpt_staged_rejected",  # shm-staged state refused before persist
+    "ckpt_commit_blocked",  # slice coverage proof refused a commit
 )
 
 integrity_counters = CounterSet()

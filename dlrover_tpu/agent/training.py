@@ -497,6 +497,26 @@ class ElasticTrainingAgent:
                     else perf_stats.get("ckpt_staged_mbps")
                 ),
             )
+            # Scale-out checkpoint gauges (ISSUE 7), riding the saver's
+            # one-round-trip stat snapshot: aggregate = the node's summed
+            # per-rank slice-write bandwidth; skipped = dirty-fence refs
+            # in the ranks' last incremental saves.
+            reg.gauge(
+                "ckpt_agg_persist_mbps",
+                lambda: (
+                    self.saver.agg_persist_mbps()
+                    if self.saver is not None
+                    else perf_stats.get("ckpt_agg_persist_mbps")
+                ),
+            )
+            reg.gauge(
+                "ckpt_tensors_skipped",
+                lambda: (
+                    float(self.saver.tensors_skipped_total())
+                    if self.saver is not None
+                    else perf_stats.get("ckpt_tensors_skipped")
+                ),
+            )
             reg.gauge(
                 "node_cpu_percent",
                 lambda: current_usage()["cpu_percent"],

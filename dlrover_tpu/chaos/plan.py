@@ -56,6 +56,7 @@ EXIT_WORKER_KILL = 77
 EXIT_MASTER_RESTART = 42
 EXIT_REPLICA_KILL = 78
 EXIT_RESHARD_CRASH = 79
+EXIT_SLICE_CRASH = 80
 
 #: site name -> (kind, defaults).  Kinds: ``error`` (caller raises),
 #: ``latency`` (inject() sleeps), ``crash`` (inject() calls os._exit),
@@ -99,6 +100,14 @@ SITES: Dict[str, dict] = {
     # stalled peer slowing every pull, and a puller hard-killed between
     # segment applies — all three must degrade to the checkpoint-restart
     # ladder with fsck-clean storage.
+    # Scale-out checkpoint site (ISSUE 7): a rank dies after streaming
+    # its slice bytes but BEFORE the atomic publish + done-vote — the
+    # step's slice set no longer covers the state, so the coverage proof
+    # must block commit and restore must fall back to the previous
+    # committed step.
+    "storage.slice_crash": {
+        "kind": "crash", "exit": EXIT_SLICE_CRASH, "times": 1,
+    },
     "reshard.drop_segment": {"kind": "flag", "times": 1},
     "reshard.stall_peer": {"kind": "latency", "delay": 0.5},
     "reshard.crash_mid_move": {

@@ -89,9 +89,68 @@ def _scan_step_dir(storage: CheckpointStorage, dirpath: str):
     return shards, done
 
 
+def _check_ref_chain(
+    report: FsckReport,
+    storage: CheckpointStorage,
+    ckpt_dir: str,
+    step: int,
+    pid: int,
+    path: str,
+    committed: bool,
+    man=None,
+) -> None:
+    """Verify an incremental shard's reference chain (ISSUE 7): every
+    ``ref`` entry must resolve to a live holder shard whose entry has the
+    same slice bounds and the promised CRC.  A broken chain on the
+    COMMITTED step is damage (the promised restore point cannot be
+    rebuilt); on an uncommitted step it only warns (the ladder skips it).
+    ``man`` reuses an already-fetched manifest (one meta pass per shard
+    per step, the engine's _man_cache discipline)."""
+    sev = SEV_DAMAGE if committed else SEV_WARN
+    if man is None:
+        try:
+            man = shard_file.read_shard_manifest(
+                storage, ckpt_dir, step, pid
+            )
+        except shard_file.ShardCorruptionError:
+            return  # already reported by the shard verification pass
+    if man is None:
+        return
+    cache: dict = {}
+    refs = 0
+    for key, tm in man.tensors.items():
+        if not isinstance(tm.get("ref"), dict):
+            continue
+        refs += 1
+        ref_step = tm["ref"].get("step")
+        if isinstance(ref_step, int) and shard_file.is_step_quarantined(
+            storage, ckpt_dir, ref_step
+        ):
+            report.add(
+                sev, step, path,
+                f"tensor {key!r} references QUARANTINED step {ref_step}",
+            )
+            continue
+        try:
+            shard_file._read_ref_blob(
+                storage, ckpt_dir, pid, key, tm, cache
+            )
+        except shard_file.ShardCorruptionError as e:
+            report.add(
+                sev, step, path, f"broken ref chain: {e.reason}"
+            )
+    if refs:
+        report.add(
+            SEV_INFO, step, path,
+            f"incremental shard: {refs} tensor(s) reference prior steps "
+            f"{sorted(man.extra.get('ref_steps') or [])}",
+        )
+
+
 def _check_step_dir(
     report: FsckReport,
     storage: CheckpointStorage,
+    ckpt_dir: str,
     dirpath: str,
     step: int,
     committed: bool,
@@ -99,6 +158,9 @@ def _check_step_dir(
     shards, done = _scan_step_dir(storage, dirpath)
     world: Optional[int] = None
     verified = set()
+    sliced = False
+    has_refs = False
+    ref_shards: list = []  # (pid, path) needing a chain check
     for pid in sorted(shards):
         path = os.path.join(dirpath, shards[pid])
         # Stream + incremental CRC: peak memory is one chunk (+ meta), so
@@ -134,6 +196,16 @@ def _check_step_dir(
             report.add(
                 SEV_INFO, step, path, "legacy v1 shard (no CRCs to verify)"
             )
+        sliced = sliced or bool(extra.get("sliced"))
+        if extra.get("ref_steps"):
+            has_refs = True
+            # Refs resolve against the LIVE ckpt_dir layout, so only
+            # in-place step dirs can be chain-checked (a quarantine-
+            # renamed dir's refs are reported via its own findings);
+            # deferred below so the manifests are fetched ONCE and
+            # shared with the coverage proof.
+            if dirpath == shard_file.step_dir(ckpt_dir, step):
+                ref_shards.append((pid, path))
         w = extra.get("num_processes")
         if isinstance(w, int) and w > 0:
             world = max(world or 0, w)
@@ -160,6 +232,45 @@ def _check_step_dir(
                 SEV_DAMAGE, step, dirpath,
                 f"committed step covers {len(verified)}/{world} shards "
                 f"(missing or corrupt: {missing})",
+            )
+    live = dirpath == shard_file.step_dir(ckpt_dir, step)
+    coverage = committed and (sliced or has_refs) and live
+    if not (ref_shards or coverage):
+        return
+    # One header+meta fetch per shard, shared by the ref-chain walk and
+    # the coverage proof (the verify pass above streams data CRCs and
+    # cannot hand back decoded metas).
+    manifests: dict = {}
+    for pid in sorted(verified):
+        try:
+            man = shard_file.read_shard_manifest(
+                storage, ckpt_dir, step, pid
+            )
+        except shard_file.ShardCorruptionError:
+            continue  # already reported by the verify pass
+        if man is not None:
+            manifests[pid] = man
+    for pid, path in ref_shards:
+        _check_ref_chain(
+            report, storage, ckpt_dir, step, pid, path, committed,
+            man=manifests.get(pid),
+        )
+    if coverage:
+        # The commit gate's tiling proof, re-run offline: a committed
+        # sliced/incremental step whose present slices no longer cover
+        # every tensor is not the restore point the tracker promises.
+        from dlrover_tpu.checkpoint import slicer
+
+        if manifests:
+            ok, reason = slicer.step_covers(
+                storage, ckpt_dir, step, manifests=manifests
+            )
+        else:
+            ok, reason = False, "no readable shard meta"
+        if not ok:
+            report.add(
+                SEV_DAMAGE, step, dirpath,
+                f"slice coverage unprovable: {reason}",
             )
 
 
@@ -202,7 +313,8 @@ def fsck(
     for step in live_steps:
         report.steps_checked += 1
         _check_step_dir(
-            report, storage, shard_file.step_dir(ckpt_dir, step), step,
+            report, storage, ckpt_dir,
+            shard_file.step_dir(ckpt_dir, step), step,
             committed=(step == committed),
         )
 
@@ -213,7 +325,9 @@ def fsck(
             SEV_DAMAGE, step, dirpath,
             "step is quarantined (failed verification during restore)",
         )
-        _check_step_dir(report, storage, dirpath, step, committed=False)
+        _check_step_dir(
+            report, storage, ckpt_dir, dirpath, step, committed=False
+        )
 
     return report
 

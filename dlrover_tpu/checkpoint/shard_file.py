@@ -37,11 +37,12 @@ full-state buffers, optional parallel range workers).
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import struct
 import time
 import zlib
-from typing import Dict, Optional, Tuple
+from typing import Dict, Iterable, Optional, Set, Tuple
 
 import msgpack
 import numpy as np
@@ -217,7 +218,16 @@ def _byte_view(arr: np.ndarray) -> memoryview:
     return memoryview(contig.reshape(-1).view(np.uint8))
 
 
-def pack_shard(tensors: Dict[str, np.ndarray], extra: dict) -> bytes:
+def pack_shard(
+    tensors: Dict[str, np.ndarray],
+    extra: dict,
+    meta_extra: Optional[Dict[str, dict]] = None,
+) -> bytes:
+    """``meta_extra`` optionally overlays per-tensor meta fields — the
+    sliced/incremental persist passes flat uint8 slice payloads here with
+    the REAL dtype/shape plus ``slice``/``full_nbytes``/``ref`` fields
+    (see the module docstring's format notes); field order matches the
+    streaming writer so outputs stay byte-identical."""
     metas = {}
     blobs = []
     offset = 0
@@ -234,6 +244,8 @@ def pack_shard(tensors: Dict[str, np.ndarray], extra: dict) -> bytes:
             "nbytes": int(arr.nbytes),
             "crc32": crc32_bytes(blob),
         }
+        if meta_extra and key in meta_extra:
+            metas[key].update(meta_extra[key])
         blobs.append(blob)
         offset += arr.nbytes
     meta_blob = msgpack.packb(
@@ -438,19 +450,18 @@ def unpack_shard(
     meta, base, version = _parse_meta(data, path)
     tensors = {}
     for key, tm in meta["tensors"].items():
+        if tm.get("slice") is not None or isinstance(tm.get("ref"), dict):
+            # This payload alone cannot rebuild the tensor (bytes live in
+            # other ranks' slices or an older step); callers of the
+            # standalone decoder (replica exchange, interop) must never
+            # see such payloads — treat as a rejected payload.
+            raise ShardCorruptionError(
+                f"tensor {key!r} is a sliced/incremental entry; decode "
+                "via read_shard_pieces", path,
+            )
         buf = _tensor_blob(data, base, key, tm, path)
         _check_tensor_crc(buf, key, tm, version, path)
-        try:
-            arr = (
-                np.frombuffer(buf, dtype=np.dtype(tm["dtype"]))
-                .reshape(tm["shape"])
-                .copy()
-            )
-        except Exception as e:  # noqa: BLE001 - garbage dtype/shape meta
-            raise ShardCorruptionError(
-                f"tensor {key!r} undecodable: {e}", path
-            ) from e
-        tensors[key] = arr
+        tensors[key] = _materialize_tensor(key, tm, buf, path)
     return tensors, meta["extra"]
 
 
@@ -520,12 +531,15 @@ def write_shard(
     process_id: int,
     tensors: Dict[str, np.ndarray],
     extra: dict,
+    meta_extra: Optional[Dict[str, dict]] = None,
 ) -> None:
     """Legacy pack-then-write persist (one monolithic blob).  The hot
     paths use :func:`write_shard_from_views`; this stays as the reference
     implementation the interop tests compare against byte-for-byte."""
     storage.safe_makedirs(step_dir(ckpt_dir, step))
-    blob = _chaos_damage_blob(pack_shard(tensors, extra), step, process_id)
+    blob = _chaos_damage_blob(
+        pack_shard(tensors, extra, meta_extra), step, process_id
+    )
     storage.write(blob, shard_path(ckpt_dir, step, process_id))
     storage.write(str(time.time()), done_path(ckpt_dir, step, process_id))
 
@@ -564,6 +578,7 @@ class ShardStreamWriter:
         workers: int = 1,
         chunk_bytes: int = STREAM_CHUNK_BYTES,
         damage_ctx: Optional[Tuple[int, int]] = None,
+        meta_extra: Optional[Dict[str, dict]] = None,
     ):
         self._storage = storage
         self._path = path
@@ -572,6 +587,7 @@ class ShardStreamWriter:
         self._workers = max(1, int(workers))
         self._chunk = max(1 << 16, int(chunk_bytes))
         self._damage_ctx = damage_ctx
+        self._meta_extra = meta_extra or {}
         self._crcs: Dict[str, int] = {}
         self._stats: dict = {}
 
@@ -596,6 +612,8 @@ class ShardStreamWriter:
                 # relayout pass just to shrink a placeholder.
                 "crc32": _CRC_PLACEHOLDER if arr.nbytes else 0,
             }
+            if key in self._meta_extra:
+                metas[key].update(self._meta_extra[key])
             views.append((key, view, offset))
             offset += int(arr.nbytes)
         return metas, views, offset
@@ -695,6 +713,7 @@ class ShardStreamWriter:
             workers=self._workers,
             finalize=_finalize,
         )
+        self._stats["crcs"] = dict(self._crcs)
         return dict(self._stats)
 
     def _apply_chaos(self, sink, total: int) -> None:
@@ -703,6 +722,11 @@ class ShardStreamWriter:
         if self._damage_ctx is None:
             return
         step, pid = self._damage_ctx
+        # Every data byte is in the (unpublished) tmp file: the widow-
+        # slice crash — the rank dies with its slice streamed but never
+        # published or done-voted, so the step's slice set cannot cover
+        # the state and the coverage proof must block commit.
+        chaos.inject("storage.slice_crash", step=step, rank=pid)
         if chaos.inject(
             "storage.corrupt_shard", step=step, rank=pid
         ) is not None:
@@ -726,12 +750,13 @@ def write_shard_from_views(
     *,
     workers: int = 1,
     chunk_bytes: int = STREAM_CHUNK_BYTES,
+    meta_extra: Optional[Dict[str, dict]] = None,
 ) -> dict:
     """Streamed, zero-copy counterpart of :func:`write_shard`: same file
     bytes, same done-file vote, no intermediate full-state buffers.
     ``tensors`` may be live shm-arena views — see
     :class:`ShardStreamWriter` for the lifetime contract.  Returns the
-    writer's stats dict (bytes, passes, workers)."""
+    writer's stats dict (bytes, passes, workers, per-tensor crcs)."""
     storage.safe_makedirs(step_dir(ckpt_dir, step))
     writer = ShardStreamWriter(
         storage,
@@ -741,22 +766,51 @@ def write_shard_from_views(
         workers=workers,
         chunk_bytes=chunk_bytes,
         damage_ctx=(step, process_id),
+        meta_extra=meta_extra,
     )
     stats = writer.write()
     storage.write(str(time.time()), done_path(ckpt_dir, step, process_id))
     return stats
 
 
-def read_shard(
+@dataclasses.dataclass
+class ShardManifest:
+    """One shard's validated header + meta, read WITHOUT touching the
+    data region: everything the restore planner needs to decide what to
+    read (placement ``tensors_info``, per-tensor blob offsets, slice
+    bounds, refs) — fetched once and reused by the data read, so shard
+    selection never pays a second header+meta pass (ISSUE 7 satellite).
+    The meta CRC covers everything held here."""
+
+    meta: dict
+    version: int
+    size: int
+    data_base: int
+    path: str
+
+    @property
+    def tensors(self) -> dict:
+        return self.meta["tensors"]
+
+    @property
+    def extra(self) -> dict:
+        return self.meta["extra"]
+
+
+def read_shard_manifest(
     storage: CheckpointStorage, ckpt_dir: str, step: int, process_id: int
-) -> Optional[Tuple[Dict[str, np.ndarray], dict]]:
-    """Read + verify one shard.  ``None`` when absent; raises
-    :class:`ShardCorruptionError` (with the path filled in) on damage."""
+) -> Optional[ShardManifest]:
+    """Meta-only read of one shard.  ``None`` when absent; raises
+    :class:`ShardCorruptionError` on structural damage."""
     path = shard_path(ckpt_dir, step, process_id)
-    data = storage.read(path)
-    if data is None:
+    f = storage.open_read(path)
+    if f is None:
         return None
-    return unpack_shard(data, path=path)
+    try:
+        meta, version, size, data_base = _read_file_meta(f, path)
+        return ShardManifest(meta, version, size, data_base, path)
+    finally:
+        f.close()
 
 
 def read_shard_meta(
@@ -764,20 +818,178 @@ def read_shard_meta(
 ) -> Optional[dict]:
     """Header + meta-only read of one shard: the ``extra`` dict (step,
     ``tensors_info`` placement, world metadata) WITHOUT touching the
-    data region — the reshard planner's input, so restore-to-any-mesh
-    can decide which ranks' shards it actually needs before paying for
-    any tensor bytes.  ``None`` when absent; raises
+    data region.  ``None`` when absent; raises
     :class:`ShardCorruptionError` on structural damage (the meta CRC
     covers everything read here)."""
-    path = shard_path(ckpt_dir, step, process_id)
-    f = storage.open_read(path)
-    if f is None:
-        return None
+    man = read_shard_manifest(storage, ckpt_dir, step, process_id)
+    return None if man is None else man.extra
+
+
+def _materialize_tensor(key: str, tm, blob, path: str) -> np.ndarray:
+    """Decode one full (unsliced) tensor blob into its real array."""
     try:
-        meta, _version, _size, _data_base = _read_file_meta(f, path)
-        return meta["extra"]
+        return (
+            np.frombuffer(blob, dtype=np.dtype(tm["dtype"]))
+            .reshape(tm["shape"])
+            .copy()
+        )
+    except Exception as e:  # noqa: BLE001 - garbage dtype/shape meta
+        raise ShardCorruptionError(
+            f"tensor {key!r} undecodable: {e}", path
+        ) from e
+
+
+def _read_blob_at(f, man: ShardManifest, key: str, tm) -> bytes:
+    """Read + CRC-verify one tensor's blob from an open shard file."""
+    offset, nbytes = _blob_bounds(
+        key, tm, man.size - man.data_base, man.path
+    )
+    f.seek(man.data_base + offset)
+    blob = f.read(nbytes)
+    if len(blob) != nbytes:
+        raise ShardCorruptionError(
+            f"tensor {key!r} blob (offset={offset}, nbytes={nbytes}) "
+            "truncated or out of bounds", man.path,
+        )
+    _check_tensor_crc(blob, key, tm, man.version, man.path)
+    return blob
+
+
+def _read_ref_blob(
+    storage: CheckpointStorage,
+    ckpt_dir: str,
+    process_id: int,
+    key: str,
+    tm,
+    man_cache: Dict[int, ShardManifest],
+    depth: int = 0,
+) -> bytes:
+    """Resolve an incremental-save reference: the bytes live in an older
+    step's shard for the SAME rank and key (chains are flattened at save
+    time — every ref targets the step that physically holds the bytes —
+    but resolution stays depth-bounded defensively).  Any break in the
+    chain (missing step, missing key, bounds/CRC mismatch) is corruption
+    of THIS shard: the restore ladder then falls back a step."""
+    if depth > 8:
+        raise ShardCorruptionError(
+            f"tensor {key!r} ref chain exceeds depth 8 (cycle?)"
+        )
+    ref = tm["ref"]
+    try:
+        ref_step = int(ref["step"])
+        ref_crc = int(ref["crc32"])
+        ref_nbytes = int(ref["nbytes"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise ShardCorruptionError(
+            f"tensor {key!r} ref meta invalid: {e}"
+        ) from e
+    man = man_cache.get(ref_step)
+    if man is None:
+        man = read_shard_manifest(storage, ckpt_dir, ref_step, process_id)
+        if man is None:
+            raise ShardCorruptionError(
+                f"tensor {key!r} references step {ref_step} whose shard "
+                "is missing (GC'd or lost)"
+            )
+        man_cache[ref_step] = man
+    tm2 = man.tensors.get(key)
+    if tm2 is None:
+        raise ShardCorruptionError(
+            f"tensor {key!r} missing from referenced step {ref_step}",
+            man.path,
+        )
+    if tm2.get("slice") != tm.get("slice"):
+        raise ShardCorruptionError(
+            f"tensor {key!r} slice bounds changed across the ref chain "
+            f"({tm.get('slice')} vs {tm2.get('slice')})", man.path,
+        )
+    if isinstance(tm2.get("ref"), dict):
+        return _read_ref_blob(
+            storage, ckpt_dir, process_id, key, tm2, man_cache, depth + 1
+        )
+    if int(tm2.get("nbytes", -1)) != ref_nbytes or int(
+        tm2.get("crc32", -1)
+    ) != ref_crc:
+        raise ShardCorruptionError(
+            f"tensor {key!r} referenced bytes in step {ref_step} do not "
+            "match the reference (rewritten or damaged)", man.path,
+        )
+    f = storage.open_read(man.path)
+    if f is None:
+        raise ShardCorruptionError(
+            f"tensor {key!r} referenced shard unreadable", man.path
+        )
+    try:
+        return _read_blob_at(f, man, key, tm2)
     finally:
         f.close()
+
+
+def read_shard_pieces(
+    storage: CheckpointStorage,
+    ckpt_dir: str,
+    step: int,
+    process_id: int,
+    *,
+    manifest: Optional[ShardManifest] = None,
+    keys: Optional[Set[str]] = None,
+) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, dict], dict]]:
+    """Read + verify one shard's pieces, resolving incremental refs.
+
+    Returns ``(tensors, slices, extra)``: full entries come back as real
+    arrays; sliced entries as flat uint8 payloads with ``slices[key]``
+    holding their tensor meta (``slice``/``full_nbytes``/dtype/shape) for
+    :meth:`ShardSource.add`.  ``manifest`` reuses an already-fetched
+    (CRC-verified) header+meta; ``keys`` restricts the data reads to the
+    named tensors — the plan-driven restore's minimal slice set.
+    ``None`` when absent; raises :class:`ShardCorruptionError` on damage.
+    """
+    man = manifest or read_shard_manifest(storage, ckpt_dir, step, process_id)
+    if man is None:
+        return None
+    f = storage.open_read(man.path)
+    if f is None:
+        return None
+    man_cache: Dict[int, ShardManifest] = {}
+    tensors: Dict[str, np.ndarray] = {}
+    slices: Dict[str, dict] = {}
+    try:
+        for key, tm in man.tensors.items():
+            if keys is not None and key not in keys:
+                continue
+            if isinstance(tm.get("ref"), dict):
+                blob = _read_ref_blob(
+                    storage, ckpt_dir, process_id, key, tm, man_cache
+                )
+            else:
+                blob = _read_blob_at(f, man, key, tm)
+            if tm.get("slice") is not None:
+                tensors[key] = np.frombuffer(blob, dtype=np.uint8).copy()
+                slices[key] = tm
+            else:
+                tensors[key] = _materialize_tensor(key, tm, blob, man.path)
+    finally:
+        f.close()
+    return tensors, slices, man.extra
+
+
+def read_shard(
+    storage: CheckpointStorage, ckpt_dir: str, step: int, process_id: int
+) -> Optional[Tuple[Dict[str, np.ndarray], dict]]:
+    """Read + verify one COMPLETE shard (refs resolved; refuses sliced
+    shards, whose bytes live across ranks — use :func:`read_shard_pieces`
+    for those).  ``None`` when absent; raises
+    :class:`ShardCorruptionError` (with the path filled in) on damage."""
+    got = read_shard_pieces(storage, ckpt_dir, step, process_id)
+    if got is None:
+        return None
+    tensors, slices, extra = got
+    if slices:
+        raise ValueError(
+            f"shard (step {step}, proc {process_id}) holds cross-replica "
+            "slices; assemble via read_shard_pieces + ShardSource"
+        )
+    return tensors, extra
 
 
 def list_shard_ids(storage: CheckpointStorage, ckpt_dir: str, step: int) -> list:
@@ -844,11 +1056,58 @@ def commit(
     chaos.inject("ckpt.crash_after_commit", step=step)
     logger.info("checkpoint step %d committed at %s", step, ckpt_dir)
     # Rotation only counts live steps: quarantined dirs are operator
-    # evidence, neither GC'd here nor taking a keep_last slot.
+    # evidence, neither GC'd here nor taking a keep_last slot.  Steps
+    # whose bytes a retained step still REFERENCES (incremental saves)
+    # are holders, not garbage: deleting one would break every newer
+    # step's ref chain, so they survive rotation until unreferenced.
     steps = list_steps(storage, ckpt_dir)
-    for old in sorted(steps)[:-keep_last] if keep_last > 0 else []:
-        if old != step:
-            storage.safe_rmtree(step_dir(ckpt_dir, old))
+    doomed = sorted(steps)[:-keep_last] if keep_last > 0 else []
+    if not doomed:
+        return
+    retained = [s for s in steps if s not in set(doomed)] + [step]
+    try:
+        protected = referenced_steps(storage, ckpt_dir, retained)
+    except Exception as e:  # noqa: BLE001 - rotation is housekeeping:
+        # an unreadable meta must never fail the commit, and keeping a
+        # step too long is safe where deleting a holder is not.
+        logger.warning("rotation ref scan failed (keeping all): %s", e)
+        protected = set(steps)
+    for old in doomed:
+        if old == step:
+            continue
+        if old in protected:
+            logger.info(
+                "rotation: keeping step %d (referenced by a newer "
+                "incremental step)", old,
+            )
+            continue
+        storage.safe_rmtree(step_dir(ckpt_dir, old))
+
+
+def referenced_steps(
+    storage: CheckpointStorage, ckpt_dir: str, roots: Iterable[int]
+) -> Set[int]:
+    """Transitive closure of the steps referenced by ``roots``'s shards
+    (the ``ref_steps`` summary each incremental shard records) — what
+    rotation must not delete and fsck walks.  A shard whose meta cannot
+    be read contributes nothing (its step is unrestorable regardless)."""
+    seen: Set[int] = set(int(s) for s in roots)
+    frontier = list(seen)
+    out: Set[int] = set()
+    while frontier:
+        s = frontier.pop()
+        for pid in list_shard_ids(storage, ckpt_dir, s):
+            try:
+                extra = read_shard_meta(storage, ckpt_dir, s, pid)
+            except ShardCorruptionError:
+                continue
+            for r in (extra or {}).get("ref_steps") or []:
+                r = int(r)
+                out.add(r)
+                if r not in seen:
+                    seen.add(r)
+                    frontier.append(r)
+    return out
 
 
 def is_step_quarantined(

@@ -32,13 +32,37 @@ def _norm_index(index, shape) -> Tuple[Tuple[int, int], ...]:
     return tuple(out)
 
 
+def _box_owners(leaf, gshape):
+    """{normalized box: sorted process ids holding that box} from a leaf's
+    GLOBAL device->index map — every process computes the same answer
+    locally, which is what lets the sliced persist assign disjoint slices
+    of replicated state without any cross-rank negotiation.  ``None``
+    when the sharding cannot answer (callers then never slice the leaf).
+    """
+    try:
+        sharding = leaf.sharding
+        imap = sharding.devices_indices_map(gshape)
+        out: Dict[Tuple[Tuple[int, int], ...], set] = {}
+        for dev, idx in imap.items():
+            out.setdefault(_norm_index(idx, gshape), set()).add(
+                int(dev.process_index)
+            )
+        return {box: sorted(ranks) for box, ranks in out.items()}
+    except Exception:  # noqa: BLE001 - unknown sharding kinds: unsliced
+        return None
+
+
 def flatten_to_shards(
     state: Any,
 ) -> Tuple[Dict[str, np.ndarray], Dict[str, dict]]:
     """Flatten a pytree of arrays into this process's shard dict.
 
     Returns (tensors, info): ``tensors["path|k"]`` is the k-th unique local
-    shard of leaf ``path``; ``info["path|k"]`` records global_shape + index.
+    shard of leaf ``path``; ``info["path|k"]`` records global_shape + index,
+    plus the slicing inputs of ISSUE 7 — ``owners`` (every process id
+    holding this same box, from the global indices map) for device arrays
+    and ``host: True`` for host leaves (identical on every rank by the
+    same assumption the restore path already makes).
     """
     leaves = tree_flatten_with_path(state)[0]
     tensors: Dict[str, np.ndarray] = {}
@@ -53,6 +77,7 @@ def flatten_to_shards(
                 if idx in seen:
                     continue
                 seen[idx] = np.asarray(shard.data)
+            owners_by_box = _box_owners(leaf, gshape)
             for k, (idx, arr) in enumerate(sorted(seen.items())):
                 key = f"{name}|{k}"
                 tensors[key] = arr
@@ -61,6 +86,8 @@ def flatten_to_shards(
                     "global_shape": list(gshape),
                     "index": [list(p) for p in idx],
                 }
+                if owners_by_box is not None:
+                    info[key]["owners"] = owners_by_box.get(idx)
         else:
             arr = np.asarray(leaf)
             key = f"{name}|0"
@@ -69,25 +96,78 @@ def flatten_to_shards(
                 "path": name,
                 "global_shape": list(arr.shape),
                 "index": [[0, d] for d in arr.shape],
+                "host": True,
             }
     return tensors, info
 
 
 class ShardSource:
     """All pieces known for the leaves of one checkpoint (possibly from
-    several processes' shard files)."""
+    several processes' shard files).
+
+    Pieces may arrive *sliced* (ISSUE 7): a flat uint8 byte range of one
+    box's C-order buffer, as the cross-replica sliced persist wrote them.
+    Slices accumulate per (path, box) and materialize into a normal piece
+    the moment they tile the full buffer; a box whose slices never
+    complete simply contributes nothing (``assemble`` then reports the
+    region uncovered and the restore ladder falls back)."""
 
     def __init__(self):
         # path -> list of (index, np.ndarray)
         self.pieces: Dict[str, List[Tuple[Tuple[Tuple[int, int], ...], np.ndarray]]] = {}
+        # (path, index) -> {"full", "dtype", "shape", "parts": {(lo,hi): bytes}}
+        self._partial: Dict[Tuple[str, tuple], dict] = {}
 
-    def add(self, tensors: Dict[str, np.ndarray], info: Dict[str, dict]) -> None:
+    def add(
+        self,
+        tensors: Dict[str, np.ndarray],
+        info: Dict[str, dict],
+        slices: Optional[Dict[str, dict]] = None,
+    ) -> None:
+        """``slices[key]``, when present, is the shard file's tensor meta
+        for a sliced entry (``slice``/``full_nbytes``/``dtype``/``shape``)
+        and ``tensors[key]`` is the flat uint8 slice payload."""
         for key, arr in tensors.items():
             meta = info.get(key)
             if meta is None:
                 continue
             idx = tuple(tuple(p) for p in meta["index"])
-            self.pieces.setdefault(meta["path"], []).append((idx, arr))
+            sl = (slices or {}).get(key)
+            if sl is None:
+                self.pieces.setdefault(meta["path"], []).append((idx, arr))
+                continue
+            lo, hi = (int(v) for v in sl["slice"])
+            ent = self._partial.setdefault(
+                (meta["path"], idx),
+                {
+                    "full": int(sl.get("full_nbytes", 0)),
+                    "dtype": sl["dtype"],
+                    "shape": tuple(int(d) for d in sl["shape"]),
+                    "parts": {},
+                },
+            )
+            ent["parts"][(lo, hi)] = np.asarray(arr, np.uint8).reshape(-1)
+            self._materialize_if_complete(meta["path"], idx, ent)
+
+    def _materialize_if_complete(self, path: str, idx, ent: dict) -> None:
+        if ent.get("done"):
+            return
+        pos = 0
+        parts = sorted(ent["parts"].items())
+        for (lo, hi), _ in parts:
+            if lo > pos:
+                return  # gap: some rank's slice still missing
+            pos = max(pos, hi)
+        if pos < ent["full"]:
+            return
+        arr = np.empty(ent["shape"], dtype=np.dtype(ent["dtype"]))
+        flat = arr.reshape(-1).view(np.uint8)
+        if flat.size != ent["full"]:
+            return  # meta lies about the buffer size: leave uncovered
+        for (lo, hi), chunk in parts:
+            flat[lo:hi] = chunk[: hi - lo]
+        self.pieces.setdefault(path, []).append((idx, arr))
+        ent["done"] = True
 
     def paths(self) -> List[str]:
         return list(self.pieces.keys())

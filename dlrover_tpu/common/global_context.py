@@ -55,6 +55,23 @@ class Context:
         # is worse than one extra state copy, set False to restore the
         # old bounded stall: copy under the lock, persist from the copy.
         self.ckpt_zero_copy: bool = True
+        # Scale-out checkpoint (ISSUE 7).  Sliced persist: when a tensor
+        # is replicated (or partially replicated) across dp replicas,
+        # each owning rank streams only a disjoint, byte-balanced slice
+        # of it, so aggregate save bandwidth scales with world size; the
+        # commit protocol then requires the slice set to provably cover
+        # every tensor (the reshard planner's tiling proof, reused).
+        self.ckpt_sliced_persist: bool = True
+        # Incremental saves: skip tensors whose per-tensor CRC fence has
+        # not tripped since the last step this rank persisted, writing a
+        # meta reference to the holder step's bytes instead (rotation
+        # keeps referenced steps; fsck verifies the chain).
+        self.ckpt_incremental: bool = True
+        # Commit gate: refuse to advance the tracker when the present
+        # shards' slices do not tile every tensor (a rank that died after
+        # a partial slice write must never produce a "committed" step
+        # that cannot be restored).
+        self.ckpt_commit_coverage: bool = True
         self.auto_tune: bool = False
         # Cross-node in-memory checkpoint replicas (flash-ckpt replica.py
         # analogue); off by default — costs DCN bandwidth per save.

@@ -383,13 +383,20 @@ class GlobalStep(Message):
 class CkptPerf(Message):
     """Per-save flash-checkpoint timings (ISSUE 4): the worker's
     save_to_memory stall feeds the master's goodput accounting — a
-    synchronous stall is lost train time even without a restart."""
+    synchronous stall is lost train time even without a restart.
+    ISSUE 7 adds the scale-out gauges: the node's AGGREGATE persist
+    throughput (sliced persist sums the ranks' disjoint-slice writes)
+    and the dirty-fence skip count of the last incremental save."""
 
     node_id: int = 0
     step: int = 0
     stall_ms: float = 0.0
     staged_mbps: float = 0.0
     persist_mbps: float = 0.0
+    agg_persist_mbps: float = 0.0
+    # -1 = "not measured by this report" (stall-only reports must not
+    # zero a node's skip gauge); >= 0 is a real count.
+    tensors_skipped: int = -1
 
 
 @dataclasses.dataclass

@@ -43,6 +43,7 @@ class DiagnosisManager:
         alive_nodes_fn=None,  # () -> node ids; expands whole-job actions
     ):
         self.alive_nodes_fn = alive_nodes_fn
+        self.speed_monitor = speed_monitor
         # TTL must exceed the hang timeout or per-node stall detection can
         # never fire: a stalled node's records would expire before the
         # stall becomes diagnosable.
@@ -75,6 +76,8 @@ class DiagnosisManager:
         self._redeliver_cooldown_s = self.data_manager._ttl
         # Newest ckpt-integrity record already echoed to the master log.
         self._integrity_seen_ts = 0.0
+        # Last (agg_mbps, skipped) ckpt-perf pair already surfaced.
+        self._ckpt_perf_seen: tuple = (0.0, 0)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -186,8 +189,34 @@ class DiagnosisManager:
                 "ckpt integrity (node %d): %s", rec.node_id, rec.content
             )
 
+    def _surface_ckpt_perf(self) -> None:
+        """Echo the scale-out checkpoint gauges into the master log when
+        they move (once per diagnosis pass at most): aggregate sliced-
+        persist bandwidth and the dirty-fence skip count are the two
+        numbers an operator needs to see that save cost is scaling with
+        the fleet and shrinking with the dirty set."""
+        sm = self.speed_monitor
+        if sm is None:
+            return
+        try:
+            cur = (
+                round(float(sm.ckpt_agg_persist_mbps), 1),
+                int(sm.ckpt_tensors_skipped),
+            )
+        except AttributeError:  # a bare stub monitor in tests
+            return
+        if cur == self._ckpt_perf_seen or cur[0] <= 0.0:
+            return
+        self._ckpt_perf_seen = cur
+        logger.info(
+            "ckpt perf: aggregate persist %.0f MB/s, %d tensors skipped "
+            "by dirty fences (goodput %.3f)",
+            cur[0], cur[1], sm.goodput(),
+        )
+
     def diagnose_once(self) -> Dict[int, List[m.DiagnosisAction]]:
         self._surface_integrity_reports()
+        self._surface_ckpt_perf()
         hypotheses = [
             Inference(InferenceName.TRAINING_HANG),
             Inference(InferenceName.NODE_FAILURE),

@@ -188,8 +188,11 @@ class DistributedJobMaster(JobMaster):
         self._exit_code = 0 if success else 1
         self._exit_reason = reason
         logger.info(
-            "master stopping: success=%s reason=%s goodput=%.3f",
+            "master stopping: success=%s reason=%s goodput=%.3f "
+            "ckpt_agg_persist_mbps=%.0f ckpt_tensors_skipped=%d",
             success, reason, self.speed_monitor.goodput(),
+            self.speed_monitor.ckpt_agg_persist_mbps,
+            self.speed_monitor.ckpt_tensors_skipped,
         )
         self._stop_event.set()
 

@@ -280,6 +280,9 @@ class MasterServicer:
                 msg.stall_ms / 1000.0, step=msg.step,
                 persist_mbps=msg.persist_mbps,
                 staged_mbps=msg.staged_mbps,
+                agg_persist_mbps=getattr(msg, "agg_persist_mbps", 0.0),
+                tensors_skipped=getattr(msg, "tensors_skipped", -1),
+                node_id=msg.node_id,
             )
         return None
 

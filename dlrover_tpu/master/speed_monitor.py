@@ -46,6 +46,12 @@ class SpeedMonitor:
         self._ckpt_stall_by_step: Dict[int, float] = {}
         self._ckpt_persist_mbps = 0.0
         self._ckpt_staged_mbps = 0.0
+        # Scale-out checkpoint gauges (ISSUE 7): each node reports its
+        # own local-rank sum; the fleet aggregate is the SUM of every
+        # node's last report (kept per node so one node's report never
+        # masquerades as the fleet's).
+        self._ckpt_agg_by_node: Dict[int, float] = {}
+        self._ckpt_skipped_by_node: Dict[int, int] = {}
 
     def collect_global_step(self, step: int, timestamp: float = 0.0) -> None:
         ts = timestamp or time.time()
@@ -68,6 +74,11 @@ class SpeedMonitor:
         with self._lock:
             if self._down_since is None:
                 self._down_since = time.time()
+            # The world is changing: a departed node must not keep
+            # contributing its last report to the fleet ckpt aggregates
+            # forever.  Survivors repopulate at their next save.
+            self._ckpt_agg_by_node.clear()
+            self._ckpt_skipped_by_node.clear()
 
     def mark_up(self) -> None:
         with self._lock:
@@ -78,6 +89,8 @@ class SpeedMonitor:
     def record_ckpt_stall(
         self, seconds: float, step: Optional[int] = None,
         persist_mbps: float = 0.0, staged_mbps: float = 0.0,
+        agg_persist_mbps: float = 0.0, tensors_skipped: int = -1,
+        node_id: int = 0,
     ) -> None:
         """One worker-reported save_to_memory stall (CkptPerf message).
         Not counted while already inside a down window — that time is
@@ -92,6 +105,12 @@ class SpeedMonitor:
                 self._ckpt_persist_mbps = persist_mbps
             if staged_mbps > 0.0:
                 self._ckpt_staged_mbps = staged_mbps
+            if agg_persist_mbps > 0.0:
+                self._ckpt_agg_by_node[int(node_id)] = agg_persist_mbps
+            if tensors_skipped >= 0:
+                self._ckpt_skipped_by_node[int(node_id)] = int(
+                    tensors_skipped
+                )
             if seconds <= 0.0:
                 return
             self._ckpt_stall_last_ms = seconds * 1000.0
@@ -123,6 +142,20 @@ class SpeedMonitor:
         """Last worker-reported worker->shm staging throughput."""
         with self._lock:
             return self._ckpt_staged_mbps
+
+    @property
+    def ckpt_agg_persist_mbps(self) -> float:
+        """Fleet AGGREGATE persist throughput: the sum of every node's
+        last-reported local-rank slice-write sum."""
+        with self._lock:
+            return float(sum(self._ckpt_agg_by_node.values()))
+
+    @property
+    def ckpt_tensors_skipped(self) -> int:
+        """Dirty-fence skip count summed over every node's last
+        reported incremental save."""
+        with self._lock:
+            return int(sum(self._ckpt_skipped_by_node.values()))
 
     @property
     def ckpt_stall_total(self) -> float:

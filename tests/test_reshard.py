@@ -1141,21 +1141,34 @@ class TestRestoreToAnyMesh:
             "['w']": [((0, 8), (0, 4))],
             "['b']": [((0, 8),)],
         }
-        reads = []
-        real_read = shard_file.read_shard
+        piece_reads = []
+        meta_reads = []
+        real_pieces = shard_file.read_shard_pieces
+        real_manifest = shard_file.read_shard_manifest
 
-        def counting_read(storage, d, s, pid):
-            reads.append(pid)
-            return real_read(storage, d, s, pid)
+        def counting_pieces(storage, d, s, pid, **kw):
+            piece_reads.append(pid)
+            return real_pieces(storage, d, s, pid, **kw)
 
-        monkeypatch.setattr(shard_file, "read_shard", counting_read)
+        def counting_manifest(storage, d, s, pid):
+            meta_reads.append(pid)
+            return real_manifest(storage, d, s, pid)
+
+        monkeypatch.setattr(shard_file, "read_shard_pieces", counting_pieces)
+        monkeypatch.setattr(
+            shard_file, "read_shard_manifest", counting_manifest
+        )
         pids = shard_file.list_shard_ids(eng.storage, ckpt_dir, step)
         chosen = eng._select_pids(step, pids)
         assert chosen == [0, 1]  # rows 0..8 live on ranks 0 and 1
-        # and the full candidate walk reads only those two
-        for _src, _extra in eng._storage_candidates():
+        # and the full candidate walk reads data from only those two
+        for _src, _extra, _sel in eng._storage_candidates():
             break
-        assert set(reads) == {0, 1}
+        assert set(piece_reads) == {0, 1}
+        # the metas fetched during selection are REUSED on the read path:
+        # exactly one header+meta read per shard, never two (the PR 6
+        # double read is retired).
+        assert sorted(meta_reads) == pids
         eng.close()
 
     def test_selection_falls_back_when_chosen_shard_corrupt(
