@@ -1941,6 +1941,19 @@ def serve_bench_main(argv: list) -> int:
     opts = {
         "requests": 24, "mnt": 24, "slots": 2, "rps": 50.0,
         "seed": 0, "device_round_ms": 20.0, "timeout": 300.0,
+        # Routing rows (ISSUE 8): a Zipf-skewed shared-prefix workload
+        # at `routing_replicas`, measured under three data planes —
+        # least-loaded (fingerprints withheld), prefix-aware routing,
+        # and prefill/decode disaggregation with int8 KV handoff.
+        # The routing rows run near fleet capacity on a model sized so
+        # admission prefill is a real cost (256-wide, 4 layers, long
+        # shared prefix) — the regime prefix caching exists for.
+        "routing_replicas": 4, "routing_requests": 40,
+        "routing_mnt": 16, "routing_rps": 20.0,
+        "routing_layers": 4, "routing_d_model": 256,
+        "routing_d_ff": 512,
+        "prefix_len": 192, "prefix_templates": 6, "zipf_a": 1.3,
+        "prefix_cache_cap": 2,
     }
     replicas_rows = [1, 2]
     out_path = None
@@ -1949,7 +1962,11 @@ def serve_bench_main(argv: list) -> int:
         if a == "--smoke":
             smoke = True
             opts.update(requests=5, mnt=6, device_round_ms=0.0,
-                        timeout=60.0)
+                        timeout=60.0, routing_replicas=1,
+                        routing_requests=5, routing_mnt=6,
+                        routing_rps=50.0, routing_layers=2,
+                        routing_d_model=64, routing_d_ff=128,
+                        prefix_len=28, prefix_templates=2)
             replicas_rows = [1]
         elif a.startswith("--out="):
             out_path = a.split("=", 1)[1]
@@ -1989,10 +2006,6 @@ def serve_bench_main(argv: list) -> int:
     prompts, _ = serve_common.seeded_requests(
         cfg, opts["requests"], opts["seed"] + 1
     )
-    arr_rng = np.random.RandomState(opts["seed"] + 7)
-    gaps = arr_rng.exponential(
-        1.0 / max(opts["rps"], 1e-6), size=opts["requests"]
-    )
     result = {
         "bench": "serve_fleet",
         "backend": backend,
@@ -2021,54 +2034,135 @@ def serve_bench_main(argv: list) -> int:
         with open(out_path, "w") as f:
             json.dump(result, f, indent=1)
 
-    def run_row(n_replicas: int) -> dict:
+    def zipf_workload(n_requests: int):
+        """Shared-prefix workload: K templates, Zipf(a) popularity,
+        4-12 own tokens per request.  Returns [(full_prompt,
+        prefix_len)] — the fingerprint is derived at submit."""
+        rng = np.random.RandomState(opts["seed"] + 11)
+        K = opts["prefix_templates"]
+        p0 = opts["prefix_len"]
+        templates = [
+            rng.randint(1, cfg.vocab_size, size=(p0,)).astype(np.int32)
+            for _ in range(K)
+        ]
+        w = 1.0 / np.arange(1, K + 1) ** opts["zipf_a"]
+        w /= w.sum()
+        reqs = []
+        for _ in range(n_requests):
+            k = int(rng.choice(K, p=w))
+            own = rng.randint(
+                1, cfg.vocab_size, size=(int(rng.randint(4, 12)),)
+            ).astype(np.int32)
+            reqs.append((np.concatenate([templates[k], own]), p0))
+        return reqs
+
+    def run_row(n_replicas: int, mode: str = "plain") -> dict:
+        """One fleet measurement.  ``plain`` = the uniform workload at
+        least-loaded routing (the PR-5 rows); the routing modes share
+        one Zipf prefix workload: ``least_loaded`` withholds the
+        fingerprints, ``prefix`` routes on them, ``disagg`` splits the
+        fleet into prefill/decode pools with int8 KV handoff."""
         tmp = tempfile.mkdtemp(prefix="serve_bench_")
-        gw = Gateway(port=0, config=GatewayConfig(queue_cap=512))
+        gw = Gateway(
+            port=0,
+            config=GatewayConfig(queue_cap=512, prefix_reserve_s=3.0),
+            # Finer than the 1-2-5 default: routing-mode TTFT deltas
+            # land inside one default bucket and would read as ties.
+            histogram_buckets=(
+                10, 25, 50, 100, 200, 350, 500, 700, 900, 1100,
+                1350, 1600, 2000, 2400, 2900, 3500, 4200, 5000,
+                6000, 7500, 10000, 15000, 30000,
+            ),
+        )
         gw.start()
         procs = []
         threads = []
         runners = []
+        roles = ["unified"] * n_replicas
+        quant = False
+        if mode == "disagg":
+            half = max(1, n_replicas // 2)
+            roles = ["prefill"] * (n_replicas - half) + \
+                ["decode"] * half
+            quant = True
+        if mode == "plain":
+            max_len = 16 + opts["mnt"] + 16
+            warm_p0 = 0
+            row_mnt = opts["mnt"]
+            row_rps = opts["rps"]
+            model_kw = {"n_layer": 2, "d_model": 64, "d_ff": 128}
+            workload = [(p, 0) for p in prompts]
+        else:
+            row_mnt = opts["routing_mnt"]
+            row_rps = opts["routing_rps"]
+            max_len = opts["prefix_len"] + 16 + row_mnt + 8
+            warm_p0 = opts["prefix_len"]
+            model_kw = {
+                "n_layer": opts["routing_layers"],
+                "d_model": opts["routing_d_model"],
+                "d_ff": opts["routing_d_ff"],
+            }
+            workload = zipf_workload(opts["routing_requests"])
+        arr_rng = np.random.RandomState(opts["seed"] + 7)
+        row_gaps = arr_rng.exponential(
+            1.0 / max(row_rps, 1e-6), size=len(workload)
+        )
         try:
             if smoke:
-                # In-process loopback replica: the tier-1 gate must not
-                # pay subprocess jax imports.
-                fleet_args = argparse.Namespace(
-                    slots=opts["slots"], max_len=64,
-                    journal_dir=os.path.join(tmp, "j"),
-                    replica_id="r0", seed=opts["seed"],
-                    poll_interval=0.005, round_floor_ms=0.0,
-                )
+                # In-process loopback replicas: the tier-1 gate must
+                # not pay subprocess jax imports.
                 sys.path.insert(0, os.path.join(repo, "examples"))
                 import llama_serve_fleet as fleet_mod
-                runner = fleet_mod.build_replica(
-                    fleet_args, LoopbackTransport(gw.handle)
-                )
-                runners.append(runner)
-                th = threading.Thread(target=runner.run, daemon=True)
-                th.start()
-                threads.append(th)
+                for i in range(n_replicas):
+                    fleet_args = argparse.Namespace(
+                        slots=opts["slots"], max_len=max_len,
+                        journal_dir=os.path.join(tmp, "j"),
+                        replica_id=f"r{i}", seed=opts["seed"],
+                        poll_interval=0.005, round_floor_ms=0.0,
+                        replica_role=roles[i], quant_kv=quant,
+                        prefix_cache_cap=opts["prefix_cache_cap"],
+                        warm_prefix_len=warm_p0, **model_kw,
+                    )
+                    runner = fleet_mod.build_replica(
+                        fleet_args, LoopbackTransport(gw.handle)
+                    )
+                    runners.append(runner)
+                    th = threading.Thread(target=runner.run,
+                                          daemon=True)
+                    th.start()
+                    threads.append(th)
             else:
                 env = dict(os.environ, JAX_PLATFORMS="cpu",
                            PYTHONPATH=repo)
                 env.pop("DLROVER_TPU_FAULTS", None)
                 for i in range(n_replicas):
                     log = open(os.path.join(tmp, f"r{i}.log"), "w")
+                    cmd = [
+                        sys.executable,
+                        os.path.join(repo, "examples",
+                                     "llama_serve_fleet.py"),
+                        "--role", "replica",
+                        "--gateway", f"127.0.0.1:{gw.port}",
+                        "--replica_id", f"r{i}",
+                        "--replica_role", roles[i],
+                        "--slots", str(opts["slots"]),
+                        "--max_len", str(max_len),
+                        "--journal_dir", os.path.join(tmp, "j"),
+                        "--seed", str(opts["seed"]),
+                        "--poll_interval", "0.01",
+                        "--prefix_cache_cap",
+                        str(opts["prefix_cache_cap"]),
+                        "--warm_prefix_len", str(warm_p0),
+                        "--n_layer", str(model_kw["n_layer"]),
+                        "--d_model", str(model_kw["d_model"]),
+                        "--d_ff", str(model_kw["d_ff"]),
+                        "--round_floor_ms",
+                        str(opts["device_round_ms"]),
+                    ]
+                    if quant:
+                        cmd.append("--quant_kv")
                     procs.append((subprocess.Popen(
-                        [sys.executable,
-                         os.path.join(repo, "examples",
-                                      "llama_serve_fleet.py"),
-                         "--role", "replica",
-                         "--gateway", f"127.0.0.1:{gw.port}",
-                         "--replica_id", f"r{i}",
-                         "--slots", str(opts["slots"]),
-                         "--max_len",
-                         str(16 + opts["mnt"] + 16),
-                         "--journal_dir", os.path.join(tmp, "j"),
-                         "--seed", str(opts["seed"]),
-                         "--poll_interval", "0.01",
-                         "--round_floor_ms",
-                         str(opts["device_round_ms"])],
-                        cwd=repo, env=env, stdout=log,
+                        cmd, cwd=repo, env=env, stdout=log,
                         stderr=subprocess.STDOUT,
                     ), log))
             deadline = time.time() + opts["timeout"]
@@ -2083,16 +2177,20 @@ def serve_bench_main(argv: list) -> int:
                 )
             client = ServeClient(LoopbackTransport(gw.handle),
                                  poll_interval=0.01)
+            tag = f"{mode[0]}{n_replicas}"
             t0 = time.perf_counter()
-            for i, prompt in enumerate(prompts):
-                time.sleep(float(gaps[i]))
-                client.submit(f"b{n_replicas}-{i}", prompt,
-                              opts["mnt"])
+            for i, (prompt, p0) in enumerate(workload):
+                time.sleep(float(row_gaps[i]))
+                client.submit(
+                    f"{tag}-{i}", prompt, row_mnt,
+                    prefix_len=p0 if mode in ("prefix", "disagg")
+                    else 0,
+                )
             completed = 0
             total_new = 0
-            for i in range(opts["requests"]):
+            for i in range(len(workload)):
                 reply = client.result(
-                    f"b{n_replicas}-{i}",
+                    f"{tag}-{i}",
                     timeout=max(5.0, deadline - time.time()),
                 )
                 if reply.state == "done":
@@ -2100,7 +2198,8 @@ def serve_bench_main(argv: list) -> int:
                     total_new += len(reply.tokens)
             dt = max(time.perf_counter() - t0, 1e-9)
             snap = gw.core.stats_snapshot()
-            return {
+            counters = snap["counters"]
+            row = {
                 "replicas": n_replicas,
                 "completed": completed,
                 "new_tokens": total_new,
@@ -2110,11 +2209,40 @@ def serve_bench_main(argv: list) -> int:
                 "latency_ms_p50": gw.latency_ms.percentile(0.50),
                 "latency_ms_p99": gw.latency_ms.percentile(0.99),
                 "elapsed_s": round(dt, 2),
-                "rejected": snap["counters"]["rejected"],
-                "redispatched": snap["counters"]["redispatched"],
+                "rejected": counters["rejected"],
+                "redispatched": counters["redispatched"],
                 "duplicate_completions":
-                    snap["counters"]["duplicate_completions"],
+                    counters["duplicate_completions"],
             }
+            if mode != "plain":
+                row["mode"] = mode
+                routed = (counters["prefix_hits"]
+                          + counters["prefix_misses"]
+                          + counters["prefix_steals"])
+                row["prefix"] = {
+                    "hits": counters["prefix_hits"],
+                    "misses": counters["prefix_misses"],
+                    "steals": counters["prefix_steals"],
+                    "hit_rate": round(
+                        counters["prefix_hits"] / routed, 3
+                    ) if routed else 0.0,
+                }
+            if mode == "disagg":
+                fp32 = counters["kv_fp32_bytes"]
+                row["kv"] = {
+                    "handoffs": counters["kv_handoffs"],
+                    "rejects": counters["kv_rejects"],
+                    "bytes_shipped": counters["kv_bytes"],
+                    "fp32_segment_bytes": fp32,
+                    "bytes_over_fp32": round(
+                        counters["kv_bytes"] / fp32, 3
+                    ) if fp32 else 0.0,
+                }
+                row["pools"] = {
+                    r: snap["pools"][r]["alive"]
+                    for r in ("prefill", "decode")
+                }
+            return row
         finally:
             for runner in runners:
                 gw.core.drain(runner.replica_id)
@@ -2137,7 +2265,7 @@ def serve_bench_main(argv: list) -> int:
     def run_rows(dest: list, label: str = "") -> None:
         for n in replicas_rows:
             try:
-                row = run_row(n)
+                row = run_row(n, mode="plain")
             except Exception as e:  # noqa: BLE001 - record the row
                 row = {"replicas": n,
                        "error": f"{type(e).__name__}: {str(e)[:200]}"}
@@ -2171,6 +2299,61 @@ def serve_bench_main(argv: list) -> int:
         if raw_speedup is not None:
             result["raw_speedup_multi_vs_single"] = raw_speedup
 
+    # Routing + disaggregation rows (ISSUE 8): one Zipf prefix
+    # workload, three data planes, same arrival process.
+    routing = {
+        "replicas": opts["routing_replicas"],
+        "requests": opts["routing_requests"],
+        "max_new_tokens": opts["routing_mnt"],
+        "poisson_rps": opts["routing_rps"],
+        "model": {"layers": opts["routing_layers"],
+                  "d_model": opts["routing_d_model"],
+                  "d_ff": opts["routing_d_ff"],
+                  "dtype": "float32"},
+        "prefix_len": opts["prefix_len"],
+        "templates": opts["prefix_templates"],
+        "zipf_a": opts["zipf_a"],
+        "prefix_cache_cap": opts["prefix_cache_cap"],
+        "note": (
+            "least_loaded withholds the prefix fingerprints (the "
+            "PR-5 router); prefix routes them to warm replicas "
+            "(residency map from poll reports, overload-steal guard); "
+            "disagg splits the fleet into prefill/decode pools with "
+            "the int8 KV segment shipped through the gateway"
+        ),
+        "rows": [],
+    }
+    result["routing"] = routing
+    for mode in ("least_loaded", "prefix", "disagg"):
+        n = opts["routing_replicas"]
+        if mode == "disagg":
+            n = max(2, n)  # at least one prefill + one decode
+        try:
+            row = run_row(n, mode=mode)
+        except Exception as e:  # noqa: BLE001 - record the row
+            row = {"mode": mode,
+                   "error": f"{type(e).__name__}: {str(e)[:200]}"}
+        routing["rows"].append(row)
+        flush()
+        print(f"routing mode={mode}: {row}", file=sys.stderr)
+    by_mode = {
+        r.get("mode"): r for r in routing["rows"] if "error" not in r
+    }
+    if "least_loaded" in by_mode and "prefix" in by_mode:
+        ll, pf = by_mode["least_loaded"], by_mode["prefix"]
+        routing["prefix_vs_least_loaded"] = {
+            "tokens_per_sec_x": round(
+                pf["tokens_per_sec"] / ll["tokens_per_sec"], 2
+            ) if ll["tokens_per_sec"] else 0.0,
+            "ttft_p99_ms": {
+                "least_loaded": ll["ttft_ms_p99"],
+                "prefix": pf["ttft_ms_p99"],
+            },
+            "wins_tokens_per_sec":
+                pf["tokens_per_sec"] > ll["tokens_per_sec"],
+            "wins_ttft_p99": pf["ttft_ms_p99"] <= ll["ttft_ms_p99"],
+        }
+
     speedup, best_n = _speedup(result["rows"])
     if speedup is not None:
         result["speedup_multi_vs_single"] = speedup
@@ -2178,9 +2361,13 @@ def serve_bench_main(argv: list) -> int:
     else:
         speedup = 0.0
     main_ok = [r for r in result["rows"] if "error" not in r]
+    routing_ok = [r for r in routing["rows"] if "error" not in r]
     result["complete"] = (
         len(main_ok) == len(replicas_rows)
         and all(r["completed"] == opts["requests"] for r in main_ok)
+        and len(routing_ok) == 3
+        and all(r["completed"] == opts["routing_requests"]
+                for r in routing_ok)
     )
     result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
     flush()

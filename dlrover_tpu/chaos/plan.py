@@ -92,6 +92,14 @@ SITES: Dict[str, dict] = {
     },
     "serving.drop_request": {"kind": "flag", "times": 1},
     "serving.slow_replica": {"kind": "latency", "delay": 0.5},
+    # KV-handoff site (ISSUE 8): the prefill->decode KV segment is lost
+    # or torn in flight.  ``method=export`` (the default evaluation
+    # point) drops the payload before the kv-ready send — the gateway's
+    # poll-reconcile must re-dispatch the prefill; ``method=import``
+    # tears the bytes at the decode replica — the embedded CRC must
+    # reject it (never decode from a torn segment) and the gateway
+    # re-prefills, terminally failing after max_attempts.
+    "serving.kv_drop": {"kind": "flag", "times": 1},
     "master.restart": {
         "kind": "crash", "exit": EXIT_MASTER_RESTART, "times": 1,
     },

@@ -544,12 +544,27 @@ class ServeSubmit(Message):
     """Client -> gateway: one inference request.  ``req_id`` doubles as
     the idempotency token (BoundedTokenCache dedupe): a retried submit
     of a completed request returns the cached result instead of
-    decoding twice."""
+    decoding twice.
+
+    Prefix-aware routing (ISSUE 8): ``prompt`` carries the FULL token
+    sequence; ``prefix_len > 0`` declares its leading tokens a shared
+    template whose fingerprint ``prefix_fp`` the gateway routes on
+    (warm replicas first) and the replica prefix-caches.
+
+    The same dataclass is the gateway -> replica grant: ``stage``
+    selects the path (``full`` = prefill+decode on one replica;
+    ``prefill`` = score the prompt and hand the KV segment back;
+    ``decode`` = continue from the attached ``kv`` segment, packed by
+    ``llama_infer.pack_kv_segment`` with an embedded CRC)."""
 
     req_id: str = ""
     prompt: List[int] = dataclasses.field(default_factory=list)
     max_new_tokens: int = 16
     deadline_s: float = 0.0  # 0 = no per-request deadline
+    prefix_len: int = 0  # leading tokens shared with other requests
+    prefix_fp: str = ""  # fingerprint of prompt[:prefix_len]
+    stage: str = "full"  # full | prefill | decode (grant direction)
+    kv: bytes = b""  # packed KV segment (decode grants only)
 
 
 @dataclasses.dataclass
@@ -588,8 +603,14 @@ class ServeStatusReply(Message):
 
 @dataclasses.dataclass
 class ServeReplicaRegister(Message):
+    """``role`` (ISSUE 8): ``unified`` replicas run the full
+    prefill+decode path; ``prefill`` replicas only score prompts and
+    export KV segments; ``decode`` replicas only continue from imported
+    segments (missing field on old senders decodes to "" = unified)."""
+
     replica_id: str = ""
     slots: int = 0
+    role: str = "unified"  # unified | prefill | decode
 
 
 @dataclasses.dataclass
@@ -609,6 +630,10 @@ class ServeReplicaPoll(Message):
     free_slots: int = 0
     active: List[str] = dataclasses.field(default_factory=list)
     stats: dict = dataclasses.field(default_factory=dict)
+    #: Prefix-template fingerprints this replica holds warm (ISSUE 8):
+    #: replaces the gateway's residency entry wholesale every poll, so
+    #: the routing map self-corrects (LRU evictions, restarts).
+    warm_prefixes: List[str] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -649,6 +674,33 @@ class ServeDone(Message):
     ok: bool = True
     reason: str = ""
     replayed: bool = False
+
+
+@dataclasses.dataclass
+class ServeKvReady(Message):
+    """Prefill replica -> gateway: the prefill-grant's KV segment is
+    ready (stage two of the disaggregated path, ISSUE 8).  ``payload``
+    is ``llama_infer.pack_kv_segment`` bytes (CRC embedded);
+    ``fp32_bytes`` is the segment's un-quantized size so the int8
+    transfer saving is measurable at the gateway without unpacking."""
+
+    replica_id: str = ""
+    req_id: str = ""
+    payload: bytes = b""
+    fp32_bytes: int = 0
+
+
+@dataclasses.dataclass
+class ServeKvReject(Message):
+    """Decode replica -> gateway: the decode-grant's KV segment failed
+    verification (torn in flight — chaos ``serving.kv_drop``).  The
+    gateway drops the payload and re-queues the request for a fresh
+    prefill (bounded by ``max_attempts``); a torn segment is NEVER
+    decoded from."""
+
+    replica_id: str = ""
+    req_id: str = ""
+    reason: str = ""
 
 
 @dataclasses.dataclass

@@ -979,6 +979,142 @@ def generate_speculative_batched(
     )
 
 
+def prefix_fingerprint(tokens) -> str:
+    """Fingerprint of a shared prefix template: what requests carry for
+    prefix-aware routing (ISSUE 8) and what keys the per-replica
+    template store.  Canonical definition lives jax-free in
+    ``serving.replica`` (the journal's prompt-hash family); this
+    delegate keeps the model-side surface in one import."""
+    from dlrover_tpu.serving.replica import prefix_fingerprint as _fp
+
+    return _fp(tokens)
+
+
+class KvSegmentError(ValueError):
+    """A packed KV segment failed verification (torn bytes, CRC
+    mismatch, or a shape/dtype/config mismatch with the importing
+    server).  The decode side must NEVER admit such a segment — the
+    fleet re-prefills instead (``ServeKvReject``)."""
+
+    #: Duck-typed marker the replica runner branches on (the control
+    #: plane must not import this jax-loaded module to classify an
+    #: exception; test fakes raise their own marker-carrying error).
+    KV_REJECT = True
+
+
+KV_SEGMENT_VERSION = 1
+
+
+def pack_kv_segment(layers, n: int, first_token: int,
+                    quant: bool) -> Tuple[bytes, int]:
+    """Pack a prefilled KV segment for the prefill->decode handoff
+    (ISSUE 8).  ``layers`` is the per-layer list of HOST arrays sliced
+    to the ``n`` written slots (``[1, KV, n, D]`` codes — int8 +
+    per-slot f32 scales when ``quant``, the model dtype otherwise).
+
+    Returns ``(payload, fp32_bytes)``: a self-describing msgpack blob
+    with the data CRC-32 embedded (verified by
+    :func:`unpack_kv_segment`, the replica-ring payload contract), and
+    the segment's un-quantized fp32 size — the int8 transfer saving is
+    ``len(payload) / fp32_bytes``."""
+    import msgpack
+    import zlib
+
+    keys = sorted(layers[0]) if layers else []
+    shapes = {}
+    chunks = []
+    for kk in keys:
+        arr = layers[0][kk]
+        shapes[kk] = [list(arr.shape), str(arr.dtype)]
+    for lay in layers:
+        for kk in keys:
+            arr = np.ascontiguousarray(lay[kk])
+            if list(arr.shape) != shapes[kk][0]:
+                raise ValueError(
+                    f"ragged KV segment: layer {kk} shape {arr.shape} "
+                    f"!= {shapes[kk][0]}"
+                )
+            chunks.append(arr.tobytes())
+    data = b"".join(chunks)
+    # fp32 equivalent: the k/v codes at 4 bytes/element (scale arrays
+    # only exist in the quant layout; they have no fp32 counterpart).
+    fp32_bytes = 0
+    for kk in ("k", "v"):
+        if kk in shapes:
+            fp32_bytes += len(layers) * int(
+                np.prod(shapes[kk][0])
+            ) * 4
+    meta = {
+        "v": KV_SEGMENT_VERSION,
+        "n": int(n),
+        "first": int(first_token),
+        "quant": bool(quant),
+        "layers": len(layers),
+        "keys": keys,
+        "shapes": shapes,
+    }
+    payload = msgpack.packb(
+        {"meta": meta, "crc": zlib.crc32(data), "data": data},
+        use_bin_type=True,
+    )
+    return payload, fp32_bytes
+
+
+def unpack_kv_segment(payload: bytes) -> Dict[str, Any]:
+    """Verify + unpack a :func:`pack_kv_segment` blob.  Raises
+    :class:`KvSegmentError` on ANY damage (unparseable envelope, CRC
+    mismatch, inconsistent sizes) — a torn segment must be rejected,
+    never decoded from.  Returns ``{"layers": [...], "n", "first",
+    "quant"}`` with per-layer HOST arrays."""
+    import msgpack
+    import zlib
+
+    try:
+        obj = msgpack.unpackb(payload, raw=False)
+        meta = obj["meta"]
+        crc = int(obj["crc"])
+        data = obj["data"]
+        keys = list(meta["keys"])
+        shapes = meta["shapes"]
+        n_layers = int(meta["layers"])
+    except Exception as e:
+        raise KvSegmentError(f"undecodable KV segment: {e}") from None
+    if meta.get("v") != KV_SEGMENT_VERSION:
+        raise KvSegmentError(
+            f"KV segment version {meta.get('v')} != "
+            f"{KV_SEGMENT_VERSION}"
+        )
+    if zlib.crc32(data) != crc:
+        raise KvSegmentError("KV segment CRC mismatch (torn payload)")
+    sizes = {
+        kk: int(np.prod(shapes[kk][0])) * np.dtype(shapes[kk][1]).itemsize
+        for kk in keys
+    }
+    if sum(sizes.values()) * n_layers != len(data):
+        raise KvSegmentError(
+            f"KV segment size mismatch: meta promises "
+            f"{sum(sizes.values()) * n_layers} bytes, have {len(data)}"
+        )
+    layers = []
+    off = 0
+    for _ in range(n_layers):
+        lay = {}
+        for kk in keys:
+            shape, dt = shapes[kk]
+            lay[kk] = np.frombuffer(
+                data, dtype=np.dtype(dt), count=int(np.prod(shape)),
+                offset=off,
+            ).reshape(shape)
+            off += sizes[kk]
+        layers.append(lay)
+    return {
+        "layers": layers,
+        "n": int(meta["n"]),
+        "first": int(meta["first"]),
+        "quant": bool(meta["quant"]),
+    }
+
+
 def _adapt_spec_k(cur_k: int, draft_k: int, acc: float) -> int:
     """The adaptive-speculation policy, pure so the arithmetic is
     directly testable.  ``acc`` is measured tokens-per-active-row-round
@@ -1039,6 +1175,14 @@ class DecodeServer:
         # finishing slot (covered by the capacity check's headroom;
         # finished slots are re-zeroed at admission).
         decode_chunk: int = 1,
+        # Warm prefix templates retained (ISSUE 8): the incremental
+        # path caches one prefilled template per prefix fingerprint so
+        # requests sharing a system prompt admit with a row copy + one
+        # chunk score instead of a full prefill; the gateway routes
+        # fp-carrying requests to replicas already holding the
+        # template.  LRU-bounded — each template is n_layer full cache
+        # rows of memory.
+        prefix_cache_cap: int = 4,
     ):
         # Sliding-window models serve on a DENSE cache (init_cache
         # ring=False): the window mask still applies in attention; the
@@ -1101,6 +1245,18 @@ class DecodeServer:
         self._pending: "collections.deque" = collections.deque()
         self._pending_mu = threading.Lock()
         self._abort_rids: set = set()
+        # Prefix-template store (ISSUE 8): fp -> {"prefix", "p0",
+        # "layers": {role: template layers}}, LRU order.  Hit/miss
+        # counts feed the replica's poll stats so the gateway's
+        # residency map self-corrects.
+        self.prefix_cache_cap = max(1, int(prefix_cache_cap))
+        self._prefix_store: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        # Prefill-role exports (ISSUE 8): rid -> prefilled slot rows
+        # awaiting export_kv (host arrays; dropped on export).
+        self._kv_exports: Dict[Any, Dict[str, Any]] = {}
         # Live views for the replica runner's poll report (valid while
         # a serve loop runs; empty otherwise).
         self._live_active: Any = None
@@ -1177,16 +1333,36 @@ class DecodeServer:
                 f"= {need} exceeds max_len {self.max_len}"
             )
 
-    def submit(self, rid, prompt, max_new_tokens: int) -> None:
+    def submit(self, rid, prompt, max_new_tokens: int,
+               prefix_len: int = 0, prefix_fp: str = "") -> None:
         """Enqueue one request for incremental admission: the running
         serve loop (``serve_incremental``) admits it the next time a
         slot frees.  ``rid`` is the caller's request key (any hashable
         — the fleet uses gateway request-id strings).  Raises
-        ValueError immediately if the request can never fit."""
+        ValueError immediately if the request can never fit.
+
+        ``prefix_len > 0`` declares ``prompt[:prefix_len]`` a shared
+        template (ISSUE 8): admission rides the per-fingerprint prefix
+        store — a warm template admits with a row copy + one chunk
+        score; a cold one is prefilled once and retained (LRU) for the
+        next request carrying the same fingerprint.  Results are
+        byte-identical to the untemplated path."""
         p = np.asarray(prompt, np.int32)
         self.check_capacity(len(p), max_new_tokens)
+        extra = None
+        if prefix_len:
+            if not 0 < int(prefix_len) < len(p):
+                raise ValueError(
+                    f"prefix_len {prefix_len} out of range for a "
+                    f"{len(p)}-token prompt"
+                )
+            extra = {
+                "prefix_len": int(prefix_len),
+                "prefix_fp": prefix_fp
+                or prefix_fingerprint(p[: int(prefix_len)]),
+            }
         with self._pending_mu:
-            self._pending.append((rid, p, int(max_new_tokens)))
+            self._pending.append((rid, p, int(max_new_tokens), extra))
 
     def cancel(self, rid) -> bool:
         """Drop a not-yet-admitted request (deadline expiry at the
@@ -1241,6 +1417,265 @@ class DecodeServer:
         act = self._live_active
         busy = int(act.sum()) if act is not None else 0
         return max(0, self.slots - busy - self.pending_count())
+
+    # -- prefix templates & prefill/decode disaggregation (ISSUE 8) ------
+
+    def warm_prefix_fps(self) -> list:
+        """Fingerprints of the prefix templates currently held warm —
+        what the replica reports in its gateway poll so the router can
+        steer matching requests here."""
+        with self._pending_mu:
+            return list(self._prefix_store)
+
+    def clear_prefix_templates(self) -> None:
+        """Drop every warm template and zero the hit/miss counters —
+        warmup hygiene: a compile-warming dummy must not occupy the
+        LRU, report warm to the router, or skew the hit-rate."""
+        with self._pending_mu:
+            self._prefix_store.clear()
+            self.prefix_hits = 0
+            self.prefix_misses = 0
+
+    def _roles(self):
+        roles = [("t", self.params, self.cfg)]
+        if self.draft is not None:
+            roles.append(("d", self.draft[0], self.draft[1]))
+        return roles
+
+    def _template_layers(self, role, mparams, mcfg, pref_dev, P0):
+        """Prefill ``pref_dev`` [1, P0] into a fresh 1-row cache and
+        return its layers — THE template build, shared by the batch
+        path (``_build_prefix_templates``) and the fingerprint store.
+        Memoized per (role, prefix length); only the cache is returned,
+        so XLA dead-code-eliminates the lm_head matmul."""
+        tc = init_cache(mcfg, 1, self.max_len,
+                        quant_kv=self.quant_kv, ring=False)
+        jkey = ("tmpl_prefill", role, P0)
+        if jkey not in self._prefill_jit:
+            def fn(p, pr, c, _cfg=mcfg):
+                return forward_step(p, pr, _cfg, c)[1]
+
+            self._prefill_jit[jkey] = jax.jit(fn)
+        return self._prefill_jit[jkey](mparams, pref_dev, tc)["layers"]
+
+    def _ensure_prefix_template(self, prefix, fp: str) -> Dict[str, Any]:
+        """Template-store lookup/build for one fingerprint: a hit
+        returns the warm entry (LRU-refreshed); a miss — or an entry
+        whose stored prefix MISMATCHES the fingerprint's claimed tokens
+        (collision, stale reuse) — prefills the template once and
+        retains it, evicting the coldest past ``prefix_cache_cap``."""
+        prefix = np.asarray(prefix, np.int32)
+        # Store mutations ride _pending_mu (the readers —
+        # warm_prefix_fps from a poll thread, clear_prefix_templates —
+        # already do); the template BUILD runs outside the lock, it is
+        # seconds of XLA on a cold fingerprint.
+        with self._pending_mu:
+            entry = self._prefix_store.get(fp)
+            if entry is not None and (
+                entry["p0"] != len(prefix)
+                or not np.array_equal(entry["prefix"], prefix)
+            ):
+                # Fingerprint mismatch: never serve another prefix's
+                # rows.
+                del self._prefix_store[fp]
+                entry = None
+            if entry is not None:
+                self.prefix_hits += 1
+                self._prefix_store.move_to_end(fp)
+                return entry
+            self.prefix_misses += 1
+        P0 = len(prefix)
+        pref_dev = jnp.asarray(prefix)[None, :]
+        layers = {
+            role: self._template_layers(role, mparams, mcfg,
+                                        pref_dev, P0)
+            for role, mparams, mcfg in self._roles()
+        }
+        entry = {"prefix": prefix, "p0": P0, "layers": layers}
+        with self._pending_mu:
+            self._prefix_store[fp] = entry
+            while len(self._prefix_store) > self.prefix_cache_cap:
+                self._prefix_store.popitem(last=False)
+        return entry
+
+    def prefill_request(self, rid, prompt, max_new_tokens: int,
+                        prefix_len: int = 0,
+                        prefix_fp: str = "") -> int:
+        """Prefill-role entry (ISSUE 8): score ``prompt`` into a fresh
+        1-row cache (prefix templates honoured), sample the first
+        token, and stage the written rows for :meth:`export_kv`.
+        Returns the first token.  Host-synchronous — a prefill replica
+        does nothing else with its slots."""
+        if self.draft is not None:
+            raise ValueError(
+                "prefill/decode disaggregation does not compose with "
+                "a draft model (the draft cache is not shipped)"
+            )
+        p = np.asarray(prompt, np.int32)
+        n = len(p)
+        self.check_capacity(n, max_new_tokens)
+        C = self.buckets[-1]
+        tmpl = None
+        p0 = 0
+        if prefix_len and n > C:
+            p0 = int(prefix_len)
+            if not 0 < p0 < n:
+                raise ValueError(
+                    f"prefix_len {prefix_len} out of range for a "
+                    f"{n}-token prompt"
+                )
+            fp = prefix_fp or prefix_fingerprint(p[:p0])
+            tmpl = self._ensure_prefix_template(p[:p0], fp)
+        if tmpl is None and n <= C:
+            # One bucketed prefill, memoized per bucket size.
+            b = self._bucket(n)
+            jkey = ("solo", b)
+            if jkey not in self._prefill_jit:
+                def fn(params, padded, plen, key):
+                    c = init_cache(self.cfg, 1, self.max_len,
+                                   quant_kv=self.quant_kv, ring=False)
+                    logits, c = forward_step(params, padded, self.cfg, c)
+                    first = self._pick(logits[0, plen - 1][None, :],
+                                       key)[0]
+                    return c["layers"], first
+
+                self._prefill_jit[jkey] = jax.jit(fn)
+            padded = np.zeros((b,), np.int32)
+            padded[:n] = p
+            layers, first = self._prefill_jit[jkey](
+                self.params, jnp.asarray(padded)[None, :],
+                jnp.asarray(n, jnp.int32), self._next_key(),
+            )
+        else:
+            # Chunked prefill on the 1-row cache: every chunk is FULL,
+            # the final window shifts back to [n-C, n) — the re-score
+            # is value-identical (complete prefix, causal attention;
+            # see admit_one_cache's derivation).
+            if tmpl is not None:
+                layers = tmpl["layers"]["t"]
+                c_start = min(C * (p0 // C), n - C)
+            else:
+                layers = init_cache(
+                    self.cfg, 1, self.max_len,
+                    quant_kv=self.quant_kv, ring=False,
+                )["layers"]
+                c_start = 0
+            jkey = ("solo_chunk", C)
+            if jkey not in self._prefill_jit:
+                def fn(params, layers_, chunk, off):
+                    logits, c = forward_step(
+                        params, chunk, self.cfg,
+                        {"layers": layers_, "offset": off},
+                    )
+                    return c["layers"], logits[0]
+
+                self._prefill_jit[jkey] = jax.jit(fn)
+            step = self._prefill_jit[jkey]
+            last = None
+            for c0 in range(c_start, n, C):
+                start = c0 if c0 + C <= n else n - C
+                layers, logits = step(
+                    self.params, layers,
+                    jnp.asarray(p[start: start + C])[None, :],
+                    jnp.asarray(start, jnp.int32),
+                )
+                if start + C >= n:
+                    last = logits[(n - 1) - start]
+            first = self._pick(last[None, :], self._next_key())[0]
+        layers_host = [
+            {kk: np.asarray(cl[kk])[:, :, :n] for kk in cl}
+            for cl in layers
+        ]
+        first = int(first)
+        self._kv_exports[rid] = {
+            "layers": layers_host, "n": n, "first": first,
+        }
+        return first
+
+    def export_kv(self, rid) -> Tuple[bytes, int]:
+        """Package the staged prefill rows of ``rid`` for the handoff:
+        ``(payload, fp32_bytes)`` from :func:`pack_kv_segment` (int8
+        codes + per-slot scales when ``quant_kv``; CRC embedded).  The
+        staged entry is consumed — a lost payload re-prefills."""
+        info = self._kv_exports.pop(rid, None)
+        if info is None:
+            raise ValueError(f"no staged prefill for request {rid!r}")
+        return pack_kv_segment(
+            info["layers"], info["n"], info["first"], self.quant_kv
+        )
+
+    def import_kv(self, rid, payload: bytes, prompt,
+                  max_new_tokens: int) -> None:
+        """Decode-role admission from a shipped KV segment: verify
+        (:func:`unpack_kv_segment` CRC + shape/dtype/config coherence
+        against THIS server), pad the rows to the slot length, and
+        enqueue for the serve loop to write into a freeing slot.
+        Raises :class:`KvSegmentError` on any mismatch — a torn or
+        foreign segment is never decoded from."""
+        if self.draft is not None:
+            raise ValueError(
+                "KV import does not compose with a draft model (the "
+                "draft cache is not shipped)"
+            )
+        seg = unpack_kv_segment(payload)
+        p = np.asarray(prompt, np.int32)
+        n = seg["n"]
+        if n != len(p):
+            raise KvSegmentError(
+                f"KV segment covers {n} tokens but the grant prompt "
+                f"has {len(p)}"
+            )
+        if seg["quant"] != self.quant_kv:
+            raise KvSegmentError(
+                f"KV segment quant={seg['quant']} but this server has "
+                f"quant_kv={self.quant_kv}"
+            )
+        self.check_capacity(n, max_new_tokens)
+        cfg = self.cfg
+        want_keys = {"k", "v", "ks", "vs"} if self.quant_kv else \
+            {"k", "v"}
+        if len(seg["layers"]) != cfg.n_layer:
+            raise KvSegmentError(
+                f"KV segment has {len(seg['layers'])} layers, model "
+                f"has {cfg.n_layer}"
+            )
+        ref = init_cache(cfg, 1, 1, quant_kv=self.quant_kv, ring=False)
+        ref_layer = ref["layers"][0]
+        padded = []
+        for lay in seg["layers"]:
+            if set(lay) != want_keys:
+                raise KvSegmentError(
+                    f"KV segment keys {sorted(lay)} != "
+                    f"{sorted(want_keys)}"
+                )
+            out = {}
+            for kk, arr in lay.items():
+                want_dt = np.dtype(ref_layer[kk].dtype)
+                # Expectation from the REFERENCE layout, never from the
+                # untrusted payload's own ndim — a mis-declared meta
+                # must reject cleanly here, not crash the jitted
+                # writeback inside the serve loop.
+                want_shape = (1, cfg.n_kv_head, n) + (
+                    (cfg.head_dim,) if ref_layer[kk].ndim == 4 else ()
+                )
+                if arr.shape != want_shape or \
+                        np.dtype(arr.dtype) != want_dt:
+                    raise KvSegmentError(
+                        f"KV segment {kk}: shape {arr.shape} dtype "
+                        f"{arr.dtype} != expected {want_shape} "
+                        f"{want_dt}"
+                    )
+                pad = [(0, 0)] * arr.ndim
+                pad[2] = (0, self.max_len - n)
+                out[kk] = np.pad(arr, pad)
+            padded.append(out)
+        extra = {"kv": {
+            "layers": padded, "n": n, "first": seg["first"],
+        }}
+        with self._pending_mu:
+            self._pending.append(
+                (rid, p, int(max_new_tokens), extra)
+            )
 
     @staticmethod
     def _slot_subcache(cache: Dict, s) -> list:
@@ -1390,7 +1825,7 @@ class DecodeServer:
             for rid, prompt in enumerate(prompts):
                 self._pending.append(
                     (rid, onp.asarray(prompt, onp.int32),
-                     int(max_new_tokens))
+                     int(max_new_tokens), None)
                 )
         results = self._run(
             on_finish=on_finish, on_token=on_token,
@@ -1431,30 +1866,10 @@ class DecodeServer:
             # admission scratch-prefills and the template would be
             # built for nothing)
             pref_dev = jnp.asarray(prefix)[None, :]
-            roles = [("t", self.params, self.cfg)]
-            if self.draft is not None:
-                roles.append(("d", self.draft[0], self.draft[1]))
-            for role, mparams, mcfg in roles:
-                tc = init_cache(mcfg, 1, self.max_len,
-                                quant_kv=self.quant_kv, ring=False)
-                # Memoized per (role, prefix length): a fresh lambda
-                # every serve() would recompile the whole prefix
-                # forward each call (jax.jit caches by function
-                # identity) and eat the very FLOPs the template saves.
-                # Only the CACHE is returned — the template never needs
-                # logits, and dropping them inside the jit lets XLA
-                # dead-code-eliminate the whole lm_head matmul.
-                jkey = ("tmpl_prefill", role, P0)
-                if jkey not in self._prefill_jit:
-                    def fn(p, pr, c, _cfg=mcfg):
-                        return forward_step(p, pr, _cfg, c)[1]
-
-                    # graftcheck: disable=JX003 -- memoized in
-                    # self._prefill_jit keyed by (role, P0): compiled
-                    # at most once per prefix length, by construction
-                    self._prefill_jit[jkey] = jax.jit(fn)
-                tc = self._prefill_jit[jkey](mparams, pref_dev, tc)
-                templates[role] = tc["layers"]
+            for role, mparams, mcfg in self._roles():
+                templates[role] = self._template_layers(
+                    role, mparams, mcfg, pref_dev, P0
+                )
         return templates
 
     def _run(self, on_finish=None, on_token=None, prefix=None,
@@ -1493,38 +1908,40 @@ class DecodeServer:
         # rows here; see _spec_decode_round's max_off).
         slot_bound = onp.zeros((B,), onp.int64)
 
-        def copy_template(c, slot, role):
+        def copy_template(c, tmpl_layers, slot, p0, role):
             """Slot rows := template rows (one dynamic_update_slice per
-            layer array); slot offset := P0.  The prefix LENGTH rides
-            as a dynamic scalar — the compiled copy is memoized across
-            serve() calls, which may use different prefixes."""
+            layer array); slot offset := p0.  The template ARRAYS and
+            the prefix length both ride as traced args — the compiled
+            copy is memoized across serve() calls and across the
+            fingerprint store's many templates."""
             jkey = ("tmplcopy", role)
             if jkey not in self._prefill_jit:
-                def fn(cache, tmpl, s, p0):
+                def fn(cache, tmpl, s, p0_):
                     new_layers = self._slot_writeback(cache, tmpl, s)
                     return dict(
                         cache, layers=new_layers,
-                        offset=cache["offset"].at[s].set(p0),
+                        offset=cache["offset"].at[s].set(p0_),
                     )
 
                 self._prefill_jit[jkey] = jax.jit(fn)
             return self._prefill_jit[jkey](
-                c, templates[role], jnp.asarray(slot),
-                jnp.asarray(P0, jnp.int32),
+                c, tmpl_layers, jnp.asarray(slot),
+                jnp.asarray(p0, jnp.int32),
             )
 
         def admit_one_cache(slot, prompt, n, c, mparams, mcfg, role,
-                            use_template=False):
+                            tmpl=None, p0=0):
             """Prefill ``prompt`` into ``c``'s slot rows under one
             model (target or draft); returns (new cache, first sampled
             token — meaningful for the target only; the draft role uses
             a CONSTANT key so its discarded pick never shifts the
-            sampling stream).  ``use_template``: ``prompt`` is the
-            prefix+request combined array; slot rows start as a copy of
-            the prefix template and chunk scoring begins at the first
-            chunk containing a non-prefix token (positions re-scored
-            inside that chunk recompute identical kv — complete prefix,
-            causal attention)."""
+            sampling stream).  ``tmpl`` (a {role: layers} dict):
+            ``prompt`` is the prefix+request combined array; slot rows
+            start as a copy of the prefix template and chunk scoring
+            begins at the first chunk containing a non-prefix token
+            (positions re-scored inside that chunk recompute identical
+            kv — complete prefix, causal attention)."""
+            use_template = tmpl is not None
             if use_template or n > self.buckets[-1]:
                 # Chunked prefill: every chunk is FULL — the final
                 # chunk's window shifts back to [n-C, n), re-scoring
@@ -1550,16 +1967,16 @@ class DecodeServer:
                 step = self._prefill_jit[jkey]
                 c_start = 0
                 if use_template:
-                    c = copy_template(c, slot, role)
+                    c = copy_template(c, tmpl[role], slot, p0, role)
                     # Skip chunks fully inside the prefix (their kv
                     # just arrived via the template copy); the copy
                     # also zeroed the slot, so no chunk needs
                     # zero_first.  Clamp to n - C so at least one
                     # chunk always runs — an EMPTY request prompt with
-                    # P0 a multiple of C would otherwise skip the loop
+                    # p0 a multiple of C would otherwise skip the loop
                     # entirely and leave no last-logits to sample the
                     # first token from.
-                    c_start = min(C * (P0 // C), n - C)
+                    c_start = min(C * (p0 // C), n - C)
                 last = None
                 for c0 in range(c_start, n, C):
                     start = c0 if c0 + C <= n else n - C
@@ -1589,26 +2006,10 @@ class DecodeServer:
                 jnp.asarray(n, jnp.int32), key,
             )
 
-        def admit(slot, item):
-            rid, prompt, mnt = item
-            if prefix is not None:
-                # Output contract matches serve([prefix + p ...]).
-                prompt = onp.concatenate([prefix, prompt])
-            n = len(prompt)
-            # Short combined prompts fit one bucketed prefill anyway —
-            # the template saves nothing there; scratch-prefill them.
-            use_tmpl = prefix is not None and n > self.buckets[-1]
-            nonlocal cache, cache_d, toks
-            cache, first = admit_one_cache(
-                slot, prompt, n, cache, self.params, self.cfg, "t",
-                use_template=use_tmpl,
-            )
-            if self.draft is not None:
-                cache_d, _ = admit_one_cache(
-                    slot, prompt, n, cache_d, self.draft[0],
-                    self.draft[1], "d", use_template=use_tmpl,
-                )
-            toks = toks.at[slot].set(first.astype(toks.dtype))
+        def seat(slot, rid, prompt, n, mnt, first):
+            """Shared post-admission bookkeeping: the slot is live,
+            its first token (sampled at prefill or shipped with the KV
+            segment) is emitted, EOS/budget-0 finishes immediately."""
             slot_bound[slot] = n + mnt
             active[slot] = True
             slot_req[slot] = rid
@@ -1619,6 +2020,70 @@ class DecodeServer:
                 on_token(rid, int(first))
             if int(first) == self.eos_token or budget[slot] <= 0:
                 finish(slot)
+
+        def admit_imported(slot, rid, prompt, mnt, kvinfo):
+            """Admission from a shipped KV segment (ISSUE 8): the
+            verified, max_len-padded rows are written straight into
+            the slot — a memory move, zero prefill FLOPs; decode
+            continues from the segment's first token."""
+            nonlocal cache, toks
+            jkey = ("kvimport",)
+            if jkey not in self._prefill_jit:
+                def fn(c, arrs, s, n_):
+                    new_layers = self._slot_writeback(c, arrs, s)
+                    return dict(
+                        c, layers=new_layers,
+                        offset=c["offset"].at[s].set(n_),
+                    )
+
+                self._prefill_jit[jkey] = jax.jit(fn)
+            cache = self._prefill_jit[jkey](
+                cache, kvinfo["layers"], jnp.asarray(slot),
+                jnp.asarray(kvinfo["n"], jnp.int32),
+            )
+            toks = toks.at[slot].set(kvinfo["first"])
+            seat(slot, rid, prompt, kvinfo["n"], mnt, kvinfo["first"])
+
+        def admit(slot, item):
+            rid, prompt, mnt, extra = item
+            extra = extra or {}
+            if "kv" in extra:
+                admit_imported(slot, rid, prompt, mnt, extra["kv"])
+                return
+            tmpl = None
+            p0 = 0
+            if prefix is not None:
+                # Output contract matches serve([prefix + p ...]).
+                prompt = onp.concatenate([prefix, prompt])
+                # Short combined prompts fit one bucketed prefill
+                # anyway — the template saves nothing there;
+                # scratch-prefill them.
+                if len(prompt) > self.buckets[-1] and templates:
+                    tmpl, p0 = templates, P0
+            elif extra.get("prefix_len") and \
+                    len(prompt) > self.buckets[-1]:
+                # Incremental path (ISSUE 8): per-request template from
+                # the fingerprint store — warm admits copy rows, cold
+                # ones prefill the template once and warm the replica.
+                entry = self._ensure_prefix_template(
+                    prompt[: extra["prefix_len"]],
+                    extra.get("prefix_fp")
+                    or prefix_fingerprint(prompt[: extra["prefix_len"]]),
+                )
+                tmpl, p0 = entry["layers"], entry["p0"]
+            n = len(prompt)
+            nonlocal cache, cache_d, toks
+            cache, first = admit_one_cache(
+                slot, prompt, n, cache, self.params, self.cfg, "t",
+                tmpl=tmpl, p0=p0,
+            )
+            if self.draft is not None:
+                cache_d, _ = admit_one_cache(
+                    slot, prompt, n, cache_d, self.draft[0],
+                    self.draft[1], "d", tmpl=tmpl, p0=p0,
+                )
+            toks = toks.at[slot].set(first.astype(toks.dtype))
+            seat(slot, rid, prompt, n, mnt, first)
 
         def finish(slot):
             rid = slot_req[slot]

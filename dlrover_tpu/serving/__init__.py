@@ -6,9 +6,11 @@ This package composes what the repo already owns into one elastic
 inference service:
 
 - :mod:`dlrover_tpu.serving.gateway` — typed-RPC front door: bounded
-  admission queue with explicit backpressure, least-loaded routing,
-  per-request deadlines, request-id dedupe (exactly-once completion
-  across replica kills and re-dispatch).
+  admission queue with explicit backpressure, prefix-residency-aware
+  least-loaded routing (warm replicas first, overload-steal guard),
+  the two-stage prefill/decode grant path with gateway-held KV
+  segments, per-request deadlines, request-id dedupe (exactly-once
+  completion across replica kills and re-dispatch).
 - :mod:`dlrover_tpu.serving.replica` — the long-lived worker loop that
   feeds gateway grants into a continuous-batching ``DecodeServer`` as
   slots free, streams tokens back, journals completions, and reports
@@ -22,10 +24,12 @@ Imports stay lazy: the gateway and autoscaler are pure control plane
 """
 
 from dlrover_tpu.serving.autoscale import (  # noqa: F401
+    PoolAutoScaler,
     ScalePolicy,
     ScaleState,
     ServeAutoScaler,
     decide,
+    decide_pools,
 )
 from dlrover_tpu.serving.gateway import (  # noqa: F401
     Gateway,
@@ -34,4 +38,7 @@ from dlrover_tpu.serving.gateway import (  # noqa: F401
     LoopbackTransport,
     ServeClient,
 )
-from dlrover_tpu.serving.replica import ReplicaRunner  # noqa: F401
+from dlrover_tpu.serving.replica import (  # noqa: F401
+    ReplicaRunner,
+    prefix_fingerprint,
+)

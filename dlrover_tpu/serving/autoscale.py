@@ -94,6 +94,114 @@ def decide(snapshot: Dict[str, Any], policy: ScalePolicy,
     return target
 
 
+#: Which snapshot percentile signal matters per role: TTFT is an
+#: admission signal — in a disaggregated fleet the prefill pool owns
+#: admission latency; decode pressure shows as queue/occupancy only.
+_TTFT_ROLES = ("unified", "prefill")
+
+
+def decide_pools(snapshot: Dict[str, Any],
+                 policies: Dict[str, ScalePolicy],
+                 states: Dict[str, ScaleState]) -> Dict[str, int]:
+    """Per-role pool decisions (ISSUE 8): one independent
+    :func:`decide` pass per role over the gateway snapshot's ``pools``
+    block (``GatewayCore.stats_snapshot``: per-role alive/occupancy
+    plus the queue depth THAT pool drains — stage-queued work for
+    prefill, kv_ready work for decode).  Returns role -> target count;
+    roles absent from the snapshot scale against an empty pool.
+    ``states`` entries are created on demand, so one dict carries all
+    hysteresis."""
+    pools = snapshot.get("pools", {})
+    targets: Dict[str, int] = {}
+    for role, policy in policies.items():
+        pool = pools.get(role, {})
+        sub = {
+            "replicas_alive": pool.get("alive", 0),
+            "queue_depth": pool.get("queue_depth", 0),
+            "occupancy": pool.get("occupancy", 0.0),
+        }
+        if role in _TTFT_ROLES:
+            sub["ttft_p95_ms"] = snapshot.get("ttft_p95_ms", 0.0)
+        targets[role] = decide(
+            sub, policy, states.setdefault(role, ScaleState())
+        )
+    return targets
+
+
+class PoolAutoScaler:
+    """Per-role actuator around :func:`decide_pools` — the
+    disaggregated-fleet peer of :class:`ServeAutoScaler`.
+    ``scale_up_fn(role, n)`` provisions ``n`` replicas of ``role``;
+    ``drain_fn(role)`` picks and drains one replica of that role
+    (``GatewayCore.pick_drain_victim(role=...)`` + ``drain``)."""
+
+    def __init__(
+        self,
+        snapshot_fn: Callable[[], Dict[str, Any]],
+        scale_up_fn: Callable[[str, int], Any],
+        drain_fn: Callable[[str], Any],
+        policies: Dict[str, ScalePolicy],
+        interval: float = 1.0,
+    ):
+        self.policies = dict(policies)
+        self.states: Dict[str, ScaleState] = {}
+        self._snapshot_fn = snapshot_fn
+        self._scale_up_fn = scale_up_fn
+        self._drain_fn = drain_fn
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.decisions: list = []  # (ts, role, alive, target)
+
+    def scale_once(self) -> Dict[str, int]:
+        """One pass; returns role -> applied delta."""
+        snap = self._snapshot_fn()
+        pools = snap.get("pools", {})
+        targets = decide_pools(snap, self.policies, self.states)
+        deltas: Dict[str, int] = {}
+        for role, target in targets.items():
+            alive = int(pools.get(role, {}).get("alive", 0))
+            if target == alive:
+                deltas[role] = 0
+                continue
+            self.decisions.append((time.time(), role, alive, target))
+            if target > alive:
+                logger.info(
+                    "serve-autoscaler: scaling %s pool up %d -> %d",
+                    role, alive, target,
+                )
+                self._scale_up_fn(role, target - alive)
+            else:
+                logger.info(
+                    "serve-autoscaler: draining one %s replica "
+                    "(%d -> %d)", role, alive, target,
+                )
+                self._drain_fn(role)
+            deltas[role] = target - alive
+        return deltas
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="serve-pool-autoscaler",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.scale_once()
+            except Exception:  # noqa: BLE001 - scaler must survive
+                logger.exception("serve pool-autoscale pass failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
 class ServeAutoScaler:
     """Periodic actuator around :func:`decide`.
 

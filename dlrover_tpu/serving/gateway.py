@@ -32,6 +32,30 @@ Design contracts:
   its poll reply carries ``drain=True`` once, the replica finishes
   in-flight work, deregisters, and exits — no request observes the
   shrink.
+- **Prefix-aware routing (ISSUE 8).**  Requests may carry a prefix
+  fingerprint (hash of their leading shared-template tokens); each
+  replica's poll reports which templates it holds warm, and the grant
+  scan prefers handing a fingerprinted request to a warm replica (the
+  admission then costs a row copy + one chunk score instead of a full
+  prefill, ~4.4x).  A request whose template is warm ELSEWHERE is
+  deferred for that replica — bounded by the stealable-overload guard:
+  once the warm holders are saturated or the request has waited
+  ``prefix_reserve_s``, any capable replica steals it (counted), so a
+  hot prefix can never starve the rest of the queue, and the queue
+  scan skips deferred requests so requests BEHIND a hot prefix are
+  never starved either.
+- **Prefill/decode disaggregation (ISSUE 8).**  Replicas register a
+  role: ``unified`` (the full path), ``prefill`` (score the prompt,
+  export the KV segment), or ``decode`` (continue from an imported
+  segment).  A queued request granted to a prefill replica follows the
+  two-stage path: prefill-grant -> ``kv_ready`` (the CRC-carrying
+  segment is held by the gateway and the request re-queues at the
+  FRONT for the decode pool) -> decode-grant (segment attached).  Every
+  stage rides the existing lease/reconcile/journal/dedupe contracts
+  keyed by req_id, so a kill between stages re-queues cleanly: a dead
+  prefill replica re-prefills elsewhere, a dead decode replica's grant
+  re-ships the SAME held segment, and a torn segment (``ServeKvReject``
+  — never decoded from) re-prefills, all bounded by ``max_attempts``.
 """
 
 from __future__ import annotations
@@ -51,6 +75,8 @@ from dlrover_tpu.common.messages import (
     ServeFleetStats,
     ServeFleetStatsRequest,
     ServeGrants,
+    ServeKvReady,
+    ServeKvReject,
     ServeReplicaDeregister,
     ServeReplicaPoll,
     ServeReplicaRegister,
@@ -73,12 +99,18 @@ class GatewayConfig:
         retry_after_s: float = 0.5,
         done_cache_cap: int = 4096,
         max_attempts: int = 5,
+        prefix_reserve_s: float = 2.0,
     ):
         self.queue_cap = queue_cap
         self.lease_timeout_s = lease_timeout_s
         self.default_deadline_s = default_deadline_s
         self.retry_after_s = retry_after_s
         self.done_cache_cap = done_cache_cap
+        #: How long a queued request whose prefix template is warm on a
+        #: replica WITH capacity is held for that replica before any
+        #: capable replica may steal it (saturated warm holders are
+        #: stealable immediately — the overload guard).
+        self.prefix_reserve_s = prefix_reserve_s
         #: Re-dispatches a request may survive before it is failed
         #: terminally: a poison request (one that reliably crashes its
         #: replica, or is repeatedly lost) re-queues at the FRONT and
@@ -90,12 +122,12 @@ class _Request:
     __slots__ = (
         "req_id", "prompt", "max_new_tokens", "deadline", "submitted_at",
         "attempts", "assigned_to", "grant_seq", "first_token_at",
-        "partial",
+        "partial", "prefix_len", "prefix_fp", "stage", "kv",
     )
 
     def __init__(self, req_id: str, prompt: List[int],
                  max_new_tokens: int, deadline: Optional[float],
-                 now: float):
+                 now: float, prefix_len: int = 0, prefix_fp: str = ""):
         self.req_id = req_id
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
@@ -106,15 +138,23 @@ class _Request:
         self.grant_seq = -1
         self.first_token_at: Optional[float] = None
         self.partial: List[int] = []
+        self.prefix_len = int(prefix_len)
+        self.prefix_fp = prefix_fp
+        #: queued -> (full | prefill) -> kv_ready -> decode; a requeue
+        #: falls back to kv_ready when the gateway still holds the
+        #: segment, queued otherwise (re-prefill).
+        self.stage = "queued"
+        self.kv: bytes = b""
 
 
 class _Replica:
     __slots__ = (
         "replica_id", "slots", "assigned", "last_seen", "poll_seq",
-        "draining", "stats",
+        "draining", "stats", "role", "warm",
     )
 
-    def __init__(self, replica_id: str, slots: int, now: float):
+    def __init__(self, replica_id: str, slots: int, now: float,
+                 role: str = "unified"):
         self.replica_id = replica_id
         self.slots = int(slots)
         self.assigned: Dict[str, _Request] = {}
@@ -122,6 +162,10 @@ class _Replica:
         self.poll_seq = 0
         self.draining = False
         self.stats: Dict[str, Any] = {}
+        self.role = role or "unified"
+        #: Prefix fingerprints held warm — replaced wholesale by every
+        #: poll report, so evictions/restarts self-correct the map.
+        self.warm: set = set()
 
 
 class GatewayCore:
@@ -152,6 +196,14 @@ class GatewayCore:
             "completed", "failed", "timeout", "duplicate_completions",
             "redispatched", "replicas_lost", "streamed_tokens",
             "late_completions",
+            # Prefix-router outcomes (ISSUE 8): a fingerprinted grant
+            # to a warm replica / to a cold one with no warm holder /
+            # stolen from a warm holder by the overload guard.
+            "prefix_hits", "prefix_misses", "prefix_steals",
+            # Disaggregation (ISSUE 8): completed prefill->decode
+            # handoffs, rejected (torn) segments, and the shipped vs
+            # fp32-equivalent byte volume (the int8 saving, measured).
+            "kv_handoffs", "kv_rejects", "kv_bytes", "kv_fp32_bytes",
         ):
             self._counters.inc(name, 0)
         self._last_sweep = float("-inf")
@@ -171,7 +223,8 @@ class GatewayCore:
     # -- client surface ---------------------------------------------------
 
     def submit(self, req_id: str, prompt: List[int],
-               max_new_tokens: int, deadline_s: float = 0.0) -> ServeAck:
+               max_new_tokens: int, deadline_s: float = 0.0,
+               prefix_len: int = 0, prefix_fp: str = "") -> ServeAck:
         now = self._clock()
         if not req_id:
             # BoundedTokenCache treats "" as no-token: the completion
@@ -215,6 +268,7 @@ class GatewayCore:
             req = _Request(
                 req_id, prompt, max_new_tokens,
                 now + deadline_s if deadline_s > 0 else None, now,
+                prefix_len=prefix_len, prefix_fp=prefix_fp,
             )
             self._queue.append(req)
             self._by_id[req_id] = req
@@ -243,16 +297,17 @@ class GatewayCore:
 
     # -- replica surface --------------------------------------------------
 
-    def register(self, replica_id: str, slots: int) -> None:
+    def register(self, replica_id: str, slots: int,
+                 role: str = "unified") -> None:
         with self._mu:
             rep = self._replicas.get(replica_id)
             if rep is None:
                 self._replicas[replica_id] = _Replica(
-                    replica_id, slots, self._clock()
+                    replica_id, slots, self._clock(), role=role
                 )
                 logger.info(
-                    "gateway: replica %s registered (%d slots)",
-                    replica_id, slots,
+                    "gateway: replica %s registered (%d slots, %s)",
+                    replica_id, slots, role or "unified",
                 )
             else:
                 # Restarted replica re-registering under the same id:
@@ -262,6 +317,8 @@ class GatewayCore:
                 rep.slots = int(slots)
                 rep.last_seen = self._clock()
                 rep.draining = False
+                rep.role = role or "unified"
+                rep.warm = set()
                 self._requeue_assigned_locked(rep, "re-register")
 
     def deregister(self, replica_id: str) -> None:
@@ -273,7 +330,8 @@ class GatewayCore:
             logger.info("gateway: replica %s deregistered", replica_id)
 
     def poll(self, replica_id: str, free_slots: int,
-             active: List[str], stats: Optional[dict] = None
+             active: List[str], stats: Optional[dict] = None,
+             warm_prefixes: Optional[List[str]] = None
              ) -> ServeGrants:
         now = self._clock()
         with self._mu:
@@ -292,6 +350,11 @@ class GatewayCore:
             rep.poll_seq += 1
             if stats:
                 rep.stats = dict(stats)
+            if warm_prefixes is not None:
+                # Wholesale replacement: the replica's own report is
+                # the truth (LRU evictions and restarts self-correct
+                # the routing map).
+                rep.warm = set(warm_prefixes)
             owned = set(active)
             # Reconcile lost grants: anything granted before this
             # replica's PREVIOUS poll must show up in its owned set by
@@ -316,16 +379,39 @@ class GatewayCore:
                     )
             grants: List[ServeSubmit] = []
             if not rep.draining:
-                while len(grants) < max(0, int(free_slots)) and self._queue:
-                    req = self._queue.pop(0)
+                # Ordered scan, not a head pop: requests this replica
+                # cannot take (wrong role for the stage) or should not
+                # take yet (template warm elsewhere, within the reserve
+                # window) are SKIPPED, never blocking what's behind.
+                free = max(0, int(free_slots))
+                i = 0
+                while len(grants) < free and i < len(self._queue):
+                    req = self._queue[i]
                     if req.deadline is not None and now > req.deadline:
+                        self._queue.pop(i)
                         self._finish_locked(
                             req, "timeout", [], "",
                             reason="deadline exceeded in queue",
                         )
                         continue
+                    stage = self._stage_for_locked(rep, req)
+                    if stage is None:
+                        i += 1
+                        continue
+                    if stage in ("full", "prefill") and req.prefix_fp:
+                        route = self._prefix_route_locked(rep, req, now)
+                        if route == "defer":
+                            i += 1
+                            continue
+                        self._counters.inc(
+                            {"hit": "prefix_hits",
+                             "miss": "prefix_misses",
+                             "steal": "prefix_steals"}[route]
+                        )
+                    self._queue.pop(i)
                     req.assigned_to = replica_id
                     req.grant_seq = rep.poll_seq
+                    req.stage = stage
                     rep.assigned[req.req_id] = req
                     grants.append(ServeSubmit(
                         req_id=req.req_id, prompt=list(req.prompt),
@@ -334,6 +420,10 @@ class GatewayCore:
                             max(0.0, req.deadline - now)
                             if req.deadline is not None else 0.0
                         ),
+                        prefix_len=req.prefix_len,
+                        prefix_fp=req.prefix_fp,
+                        stage=stage,
+                        kv=req.kv if stage == "decode" else b"",
                     ))
             drain = rep.draining and not rep.assigned
             return ServeGrants(
@@ -395,6 +485,69 @@ class GatewayCore:
                 )
             return "recorded"
 
+    def kv_ready(self, replica_id: str, req_id: str, payload: bytes,
+                 fp32_bytes: int = 0) -> str:
+        """Stage two of the disaggregated path: the prefill replica's
+        KV segment arrives.  The request leaves the prefill replica's
+        books, the gateway holds the segment, and the request re-queues
+        at the FRONT in stage ``kv_ready`` for the decode pool (the
+        prefill investment is sunk — decode capacity should consume it
+        before fresh prefills).  Returns ``recorded`` | ``stale`` |
+        ``unknown`` (tests branch; the replica does not)."""
+        with self._mu:
+            req = self._by_id.get(req_id)
+            if req is None:
+                # Already terminal (timeout while prefilling) or never
+                # admitted: drop the payload.
+                return "unknown"
+            if req.assigned_to != replica_id:
+                # Superseded assignment (the prefill replica was
+                # presumed dead and the request re-dispatched): the
+                # live assignment produces its own segment.
+                return "stale"
+            rep = self._replicas.get(replica_id)
+            if rep is not None:
+                rep.assigned.pop(req_id, None)
+            req.assigned_to = None
+            req.kv = bytes(payload)
+            req.stage = "kv_ready"
+            self._queue.insert(0, req)
+            self._counters.inc("kv_handoffs")
+            self._counters.inc("kv_bytes", len(payload))
+            self._counters.inc("kv_fp32_bytes", int(fp32_bytes))
+            return "recorded"
+
+    def kv_reject(self, replica_id: str, req_id: str,
+                  reason: str = "") -> str:
+        """A decode replica refused a KV segment (CRC/shape mismatch —
+        torn in flight, chaos ``serving.kv_drop``).  The held segment
+        is DROPPED (never re-shipped, never decoded from) and the
+        request re-queues for a fresh prefill — through
+        ``_requeue_locked``, so a persistently-torn handoff fails
+        terminally after ``max_attempts`` instead of looping."""
+        with self._mu:
+            req = self._by_id.get(req_id)
+            if req is None:
+                return "unknown"
+            if req.assigned_to != replica_id:
+                # Superseded assignment (a stalled decode replica
+                # rejecting after the lease machinery re-granted the
+                # segment elsewhere): the LIVE assignment owns the
+                # request — tearing it down here would orphan an
+                # in-flight decode and burn attempts on a healthy
+                # request.  Same guard as kv_ready/stream/complete.
+                return "stale"
+            self._counters.inc("kv_rejects")
+            rep = self._replicas.get(replica_id)
+            if rep is not None:
+                rep.assigned.pop(req_id, None)
+            req.assigned_to = None
+            req.kv = b""
+            self._requeue_locked(
+                req, f"kv segment rejected by {replica_id}: {reason}"
+            )
+            return "recorded"
+
     # -- operator surface -------------------------------------------------
 
     def drain(self, replica_id: str) -> bool:
@@ -406,12 +559,16 @@ class GatewayCore:
             logger.info("gateway: draining replica %s", replica_id)
             return True
 
-    def pick_drain_victim(self) -> Optional[str]:
-        """Least-loaded non-draining replica — the scale-down choice."""
+    def pick_drain_victim(self, role: Optional[str] = None
+                          ) -> Optional[str]:
+        """Least-loaded non-draining replica — the scale-down choice.
+        ``role`` restricts to one pool (the per-role autoscaler)."""
         with self._mu:
             best = None
             for rep in self._replicas.values():
                 if rep.draining:
+                    continue
+                if role is not None and rep.role != role:
                     continue
                 key = (len(rep.assigned), rep.replica_id)
                 if best is None or key < best[0]:
@@ -429,6 +586,8 @@ class GatewayCore:
                     "slots": rep.slots,
                     "assigned": len(rep.assigned),
                     "draining": rep.draining,
+                    "role": rep.role,
+                    "warm_prefixes": sorted(rep.warm),
                     "stats": dict(rep.stats),
                 }
                 for rid_key, rep in self._replicas.items()
@@ -436,14 +595,42 @@ class GatewayCore:
             alive = [r for r in self._replicas.values() if not r.draining]
             total_slots = sum(r.slots for r in alive)
             total_assigned = sum(len(r.assigned) for r in alive)
+            # Per-role pools (ISSUE 8): each role's capacity plus the
+            # queue depth IT drains — stage-queued work feeds the
+            # prefill pool when one exists (else unified), kv_ready
+            # work the decode pool — so each pool's autoscale signal is
+            # independent.
+            queued_stage = sum(
+                1 for r in self._queue if r.stage != "kv_ready"
+            )
+            kv_ready_depth = len(self._queue) - queued_stage
+            pools: Dict[str, Dict[str, Any]] = {}
+            for role in ("unified", "prefill", "decode"):
+                members = [r for r in alive if r.role == role]
+                slots = sum(r.slots for r in members)
+                assigned = sum(len(r.assigned) for r in members)
+                pools[role] = {
+                    "alive": len(members),
+                    "slots": slots,
+                    "assigned": assigned,
+                    "occupancy": assigned / slots if slots else 0.0,
+                    "queue_depth": 0,
+                }
+            fed = "prefill" if pools["prefill"]["alive"] else "unified"
+            pools[fed]["queue_depth"] += queued_stage
+            fed = "decode" if pools["decode"]["alive"] else "unified"
+            pools[fed]["queue_depth"] += kv_ready_depth
             snap = {
                 "queue_depth": len(self._queue),
+                "queue_prefill": queued_stage,
+                "queue_kv_ready": kv_ready_depth,
                 "in_flight": len(self._by_id),
                 "replicas_alive": len(alive),
                 "replicas_draining": len(self._replicas) - len(alive),
                 "occupancy": (
                     total_assigned / total_slots if total_slots else 0.0
                 ),
+                "pools": pools,
                 "counters": self._counters.snapshot(),
                 "replicas": reps,
             }
@@ -459,6 +646,51 @@ class GatewayCore:
         return snap
 
     # -- internals (call with self._mu held) ------------------------------
+
+    def _stage_for_locked(self, rep: _Replica,
+                          req: _Request) -> Optional[str]:
+        """Which grant stage this replica could run this request at —
+        None = ineligible (skip in the scan)."""
+        if req.stage == "kv_ready":
+            return ("decode" if rep.role in ("decode", "unified")
+                    else None)
+        if rep.role == "unified":
+            return "full"
+        if rep.role == "prefill":
+            # Prefilling is only worth the work while someone can
+            # decode the result; otherwise the segment would sit in
+            # the queue to its deadline.
+            return ("prefill" if self._decode_capable_locked()
+                    else None)
+        return None  # decode-only replicas never prefill
+
+    def _decode_capable_locked(self) -> bool:
+        return any(
+            r.role in ("decode", "unified") and not r.draining
+            for r in self._replicas.values()
+        )
+
+    def _prefix_route_locked(self, rep: _Replica, req: _Request,
+                             now: float) -> str:
+        """Routing outcome for a fingerprinted request at this
+        replica's poll: ``hit`` (warm here), ``miss`` (warm nowhere
+        else capable), ``defer`` (reserved for a warm holder with
+        capacity, within the reserve window), or ``steal`` (warm
+        elsewhere but the overload guard fired)."""
+        fp = req.prefix_fp
+        if fp in rep.warm:
+            return "hit"
+        warm = [
+            r for r in self._replicas.values()
+            if r is not rep and not r.draining and fp in r.warm
+            and r.role in ("prefill", "unified")
+        ]
+        if not warm:
+            return "miss"
+        if any(len(r.assigned) < r.slots for r in warm) and \
+                now - req.submitted_at < self.cfg.prefix_reserve_s:
+            return "defer"
+        return "steal"
 
     def _detach_locked(self, req: _Request) -> None:
         self._by_id.pop(req.req_id, None)
@@ -497,6 +729,10 @@ class GatewayCore:
         req.assigned_to = None
         req.attempts += 1
         req.partial = []
+        # Fall back to the right stage: a held KV segment survives its
+        # decode replica's death (re-ship it), a lost prefill
+        # re-prefills from scratch.
+        req.stage = "kv_ready" if req.kv else "queued"
         if req.attempts >= self.cfg.max_attempts:
             self._finish_locked(
                 req, "failed", [], "",
@@ -552,7 +788,8 @@ class Gateway:
                  config: Optional[GatewayConfig] = None,
                  sweep_interval: float = 1.0,
                  metrics_registry=None,
-                 histogram_window_s: float = 60.0):
+                 histogram_window_s: float = 60.0,
+                 histogram_buckets=None):
         from dlrover_tpu.agent.metrics import Histogram
         from dlrover_tpu.common.rpc import RpcServer
 
@@ -560,8 +797,14 @@ class Gateway:
         # Windowed: these percentiles steer the autoscaler and the
         # gauges — a lifetime histogram would ratchet (one bad warmup
         # period keeps p95 high forever and the fleet never shrinks).
-        self.latency_ms = Histogram(window_s=histogram_window_s)
-        self.ttft_ms = Histogram(window_s=histogram_window_s)
+        # ``histogram_buckets`` overrides the default ms bounds (the
+        # bench uses a finer ladder: routing deltas are real at a
+        # resolution the 1-2-5 default rounds away).
+        kw = {"window_s": histogram_window_s}
+        if histogram_buckets is not None:
+            kw["buckets"] = tuple(histogram_buckets)
+        self.latency_ms = Histogram(**kw)
+        self.ttft_ms = Histogram(**kw)
         self.core.observe_latency_ms = self.latency_ms.observe
         self.core.observe_ttft_ms = self.ttft_ms.observe
         self.core.snapshot_extras = lambda: {
@@ -604,25 +847,59 @@ class Gateway:
             return read
 
         for key in ("queue_depth", "in_flight", "replicas_alive",
-                    "occupancy"):
+                    "occupancy", "queue_prefill", "queue_kv_ready"):
             registry.gauge(f"serve_{key}", _snap_gauge(key))
+
+        # Prefix-router counters + per-role pool gauges (ISSUE 8).
+        def _counter_gauge(name):
+            def read():
+                return float(
+                    _snap().get("counters", {}).get(name, 0)
+                )
+            return read
+
+        for name in ("prefix_hits", "prefix_misses", "prefix_steals",
+                     "kv_handoffs", "kv_rejects", "kv_bytes"):
+            registry.gauge(f"serve_{name}", _counter_gauge(name))
+
+        def _pool_gauge(role, key):
+            def read():
+                return float(
+                    _snap().get("pools", {}).get(role, {}).get(key, 0)
+                )
+            return read
+
+        for role in ("unified", "prefill", "decode"):
+            for key in ("alive", "assigned", "queue_depth",
+                        "occupancy"):
+                registry.gauge(f"serve_pool_{role}_{key}",
+                               _pool_gauge(role, key))
 
     def handle(self, msg: Message) -> Optional[Message]:
         core = self.core
         if isinstance(msg, ServeSubmit):
             return core.submit(msg.req_id, msg.prompt,
-                               msg.max_new_tokens, msg.deadline_s)
+                               msg.max_new_tokens, msg.deadline_s,
+                               msg.prefix_len, msg.prefix_fp)
         if isinstance(msg, ServeStatusRequest):
             return core.status(msg.req_id)
         if isinstance(msg, ServeReplicaRegister):
-            core.register(msg.replica_id, msg.slots)
+            core.register(msg.replica_id, msg.slots, msg.role)
             return BaseResponse(success=True)
         if isinstance(msg, ServeReplicaDeregister):
             core.deregister(msg.replica_id)
             return BaseResponse(success=True)
         if isinstance(msg, ServeReplicaPoll):
             return core.poll(msg.replica_id, msg.free_slots,
-                             msg.active, msg.stats)
+                             msg.active, msg.stats, msg.warm_prefixes)
+        if isinstance(msg, ServeKvReady):
+            outcome = core.kv_ready(msg.replica_id, msg.req_id,
+                                    msg.payload, msg.fp32_bytes)
+            return BaseResponse(success=True, reason=outcome)
+        if isinstance(msg, ServeKvReject):
+            outcome = core.kv_reject(msg.replica_id, msg.req_id,
+                                     msg.reason)
+            return BaseResponse(success=True, reason=outcome)
         if isinstance(msg, ServeTokens):
             core.stream(msg.replica_id, msg.req_id, msg.tokens)
             return BaseResponse(success=True)
@@ -689,17 +966,24 @@ class ServeClient:
         self._poll_interval = poll_interval
 
     def submit(self, req_id: str, prompt, max_new_tokens: int,
-               deadline_s: float = 0.0, submit_timeout: float = 30.0
-               ) -> ServeAck:
+               deadline_s: float = 0.0, submit_timeout: float = 30.0,
+               prefix_len: int = 0, prefix_fp: str = "") -> ServeAck:
         """Submit, honouring rejection backpressure: sleeps the
         gateway's ``retry_after_s`` and retries until accepted (or
         ``submit_timeout`` is spent — then the last rejected ack is
-        returned for the caller to surface)."""
+        returned for the caller to surface).  ``prefix_len``/
+        ``prefix_fp`` declare the prompt's leading shared template for
+        prefix-aware routing (the fingerprint is derived when omitted)."""
+        if prefix_len and not prefix_fp:
+            from dlrover_tpu.serving.replica import prefix_fingerprint
+
+            prefix_fp = prefix_fingerprint(prompt[:prefix_len])
         start = time.monotonic()
         while True:
             ack = self._t.call(ServeSubmit(
                 req_id=req_id, prompt=[int(t) for t in prompt],
                 max_new_tokens=max_new_tokens, deadline_s=deadline_s,
+                prefix_len=prefix_len, prefix_fp=prefix_fp,
             ))
             if not isinstance(ack, ServeAck) or ack.status != "rejected":
                 return ack

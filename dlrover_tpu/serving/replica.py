@@ -40,6 +40,8 @@ from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.messages import (
     ServeDone,
     ServeGrants,
+    ServeKvReady,
+    ServeKvReject,
     ServeReplicaDeregister,
     ServeReplicaPoll,
     ServeReplicaRegister,
@@ -51,6 +53,15 @@ def _prompt_hash(prompt) -> str:
     return hashlib.sha1(
         np.asarray(prompt, np.int32).tobytes()
     ).hexdigest()[:16]
+
+
+def prefix_fingerprint(tokens) -> str:
+    """Fingerprint of a shared prefix template (ISSUE 8): what requests
+    carry for prefix-aware routing, what replicas report as warm, and
+    what keys ``DecodeServer``'s template store.  The journal's prompt
+    hash family, defined HERE (jax-free) so clients and the gateway can
+    compute it without the model stack; ``llama_infer`` delegates."""
+    return _prompt_hash(tokens)
 
 
 class CompletionJournal:
@@ -162,11 +173,13 @@ class ReplicaRunner:
         poll_interval: float = 0.05,
         round_floor_s: float = 0.0,
         replay_limit: int = 256,
+        role: str = "unified",  # unified | prefill | decode (ISSUE 8)
         clock=time.monotonic,
     ):
         self.server = server
         self.transport = transport
         self.replica_id = replica_id
+        self.role = role or "unified"
         self.journal = (
             CompletionJournal(journal_path) if journal_path else None
         )
@@ -195,6 +208,8 @@ class ReplicaRunner:
         self.served = 0
         self.replayed = 0
         self.dropped = 0
+        self.prefilled = 0  # KV segments produced (prefill role)
+        self.kv_rejected = 0  # torn segments refused (decode role)
 
     # -- protocol steps ---------------------------------------------------
 
@@ -205,6 +220,7 @@ class ReplicaRunner:
         # known=False reply retries the registration.
         self._call_quiet(ServeReplicaRegister(
             replica_id=self.replica_id, slots=self.server.slots,
+            role=self.role,
         ))
         if self.journal is not None and not self._journal_replayed:
             # Journal replay, ONCE per incarnation: report every
@@ -269,11 +285,13 @@ class ReplicaRunner:
             return not self._stopped and not self._done_draining()
         self._last_poll = now
         self._flush_streams()
+        warm = getattr(self.server, "warm_prefix_fps", None)
         reply = self._call_quiet(ServeReplicaPoll(
             replica_id=self.replica_id,
             free_slots=self.server.free_slots(),
             active=self._owned_rids(),
             stats=self._stats(),
+            warm_prefixes=list(warm()) if warm is not None else [],
         ))
         if isinstance(reply, ServeGrants):
             if not reply.known:
@@ -311,13 +329,19 @@ class ReplicaRunner:
 
     def _admit(self, grant) -> None:
         rid_key = grant.req_id
+        stage = getattr(grant, "stage", "full") or "full"
+        if stage == "prefill":
+            self._handle_prefill(grant)
+            return
         if rid_key in self._granted or rid_key in self._owned_rids():
             return  # duplicate grant (shouldn't happen; be safe)
         if self.journal is not None:
             cached = self.journal.lookup(rid_key, grant.prompt)
             if cached is not None:
                 # This replica already served it in a previous
-                # incarnation: answer from the journal, never re-decode.
+                # incarnation: answer from the journal, never re-decode
+                # (a decode-grant's shipped segment is simply unused —
+                # the gateway drops it at the terminal completion).
                 self.replayed += 1
                 self._call_quiet(ServeDone(
                     replica_id=self.replica_id, req_id=rid_key,
@@ -336,11 +360,50 @@ class ReplicaRunner:
             )
             return
         try:
-            self.server.submit(
-                rid_key, np.asarray(grant.prompt, np.int32),
-                grant.max_new_tokens,
-            )
+            if stage == "decode":
+                # Disaggregated decode (ISSUE 8): verify + admit the
+                # shipped KV segment.  A torn segment is NEVER decoded
+                # from — the gateway re-prefills on the reject.
+                payload = grant.kv
+                if chaos.inject(
+                    "serving.kv_drop", replica=self.replica_id,
+                    method="import",
+                ) is not None:
+                    torn = bytearray(payload)
+                    if torn:
+                        torn[len(torn) // 2] ^= 0xFF
+                    payload = bytes(torn)
+                self.server.import_kv(
+                    rid_key, payload,
+                    np.asarray(grant.prompt, np.int32),
+                    grant.max_new_tokens,
+                )
+            else:
+                kw = {}
+                if getattr(grant, "prefix_len", 0):
+                    # Only prefixed grants ride the kwargs — plain
+                    # submits keep working against any server with the
+                    # bare (rid, prompt, mnt) surface.
+                    kw = {
+                        "prefix_len": grant.prefix_len,
+                        "prefix_fp": getattr(grant, "prefix_fp", ""),
+                    }
+                self.server.submit(
+                    rid_key, np.asarray(grant.prompt, np.int32),
+                    grant.max_new_tokens, **kw,
+                )
         except ValueError as e:
+            if stage == "decode" and getattr(e, "KV_REJECT", False):
+                self.kv_rejected += 1
+                logger.warning(
+                    "replica %s: KV segment for %s rejected: %s",
+                    self.replica_id, rid_key, e,
+                )
+                self._call_quiet(ServeKvReject(
+                    replica_id=self.replica_id, req_id=rid_key,
+                    reason=str(e)[:200],
+                ))
+                return
             # Can never fit this replica's cache: a terminal, visible
             # failure beats a silent requeue loop.
             self._call_quiet(ServeDone(
@@ -352,6 +415,54 @@ class ReplicaRunner:
             "prompt": [int(t) for t in grant.prompt],
         }
         self._admitted_at[rid_key] = self._clock()
+
+    def _handle_prefill(self, grant) -> None:
+        """Prefill-grant path (ISSUE 8), host-synchronous within the
+        tick: score the prompt, export the KV segment, report
+        kv-ready.  Failure modes all converge on the gateway's
+        recovery ladder: a capacity error fails terminally, a lost
+        payload (chaos ``serving.kv_drop`` at export, or a failed
+        send) leaves the rid unowned so the 2-poll reconcile
+        re-dispatches the prefill."""
+        rid_key = grant.req_id
+        try:
+            self.server.prefill_request(
+                rid_key, np.asarray(grant.prompt, np.int32),
+                grant.max_new_tokens,
+                prefix_len=getattr(grant, "prefix_len", 0),
+                prefix_fp=getattr(grant, "prefix_fp", ""),
+            )
+            payload, fp32_bytes = self.server.export_kv(rid_key)
+        except ValueError as e:
+            self._call_quiet(ServeDone(
+                replica_id=self.replica_id, req_id=rid_key,
+                tokens=[], ok=False, reason=f"prefill: {e}",
+            ))
+            return
+        self.prefilled += 1
+        if chaos.inject(
+            "serving.kv_drop", replica=self.replica_id,
+            method="export",
+        ) is not None:
+            # The segment evaporates in flight: no kv-ready ever
+            # reaches the gateway, the rid is absent from this
+            # replica's owned set, and poll-reconcile re-dispatches.
+            self.dropped += 1
+            logger.warning(
+                "replica %s: chaos dropped KV segment for %s",
+                self.replica_id, rid_key,
+            )
+            return
+        # The kill-mid-handoff window: after the prefill investment,
+        # before the gateway learns the segment exists.
+        chaos.inject(
+            "serving.replica_kill", replica=self.replica_id,
+            method="prefill_export",
+        )
+        self._call_quiet(ServeKvReady(
+            replica_id=self.replica_id, req_id=rid_key,
+            payload=payload, fp32_bytes=int(fp32_bytes),
+        ))
 
     def _on_token(self, rid_key, tok) -> None:
         self._stream_buf.setdefault(rid_key, []).append(int(tok))
@@ -413,7 +524,16 @@ class ReplicaRunner:
             "ttft_ms_last": round(self._last_ttft_ms, 2),
             "served": self.served,
             "replayed": self.replayed,
+            "role": self.role,
         }
+        if self.prefilled:
+            stats["prefilled"] = self.prefilled
+        hits = getattr(self.server, "prefix_hits", None)
+        if hits is not None:
+            # Template hit/miss telemetry: how well the router's
+            # residency map matches this replica's actual store.
+            stats["prefix_hits"] = hits
+            stats["prefix_misses"] = self.server.prefix_misses
         last = getattr(self.server, "last_stats", None)
         if last and "tokens_per_round" in last:
             # Speculative acceptance (or plain tokens/round) telemetry.

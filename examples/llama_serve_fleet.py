@@ -42,10 +42,28 @@ def parse_args(argv=None):
     p.add_argument("--gateway", default="",
                    help="(replica/driver) gateway host:port")
     p.add_argument("--replica_id", default="r0")
+    p.add_argument("--replica_role", default="unified",
+                   choices=("unified", "prefill", "decode"),
+                   help="(replica) disaggregated role: prefill scores "
+                        "prompts and exports KV segments; decode "
+                        "continues from imported segments")
+    p.add_argument("--quant_kv", action="store_true",
+                   help="(replica) int8 KV cache — halves the "
+                        "prefill->decode segment transfer")
+    p.add_argument("--prefix_cache_cap", type=int, default=4,
+                   help="(replica) warm prefix templates retained")
+    p.add_argument("--warm_prefix_len", type=int, default=0,
+                   help="(replica) pre-compile the prefix-template "
+                        "path for this prefix length (the bench warms "
+                        "XLA before registration so TTFT measures "
+                        "admission, not compiles)")
     p.add_argument("--replicas", type=int, default=2,
                    help="(all) replica threads to run")
     p.add_argument("--slots", type=int, default=2)
     p.add_argument("--max_len", type=int, default=96)
+    p.add_argument("--n_layer", type=int, default=2)
+    p.add_argument("--d_model", type=int, default=64)
+    p.add_argument("--d_ff", type=int, default=128)
     p.add_argument("--requests", type=int, default=12)
     p.add_argument("--max_new_tokens", type=int, default=16)
     p.add_argument("--rps", type=float, default=50.0,
@@ -82,19 +100,54 @@ def build_replica(args, transport):
         import serve_common
 
     params, cfg = serve_common.tiny_llama(
-        seed=args.seed, dtype=jnp.float32
+        seed=args.seed, dtype=jnp.float32,
+        n_layer=getattr(args, "n_layer", 2),
+        d_model=getattr(args, "d_model", 64),
+        d_ff=getattr(args, "d_ff", 128),
     )
+    role = getattr(args, "replica_role", "unified")
     srv = llama_infer.DecodeServer(
         params, cfg, slots=args.slots, max_len=args.max_len,
         prompt_buckets=(16, 32), seed=args.seed,
+        quant_kv=getattr(args, "quant_kv", False),
+        prefix_cache_cap=getattr(args, "prefix_cache_cap", 4),
     )
     import numpy as np
 
     # Warm the compile caches BEFORE registering with the gateway: the
     # fleet's TTFT percentiles must measure admission+decode latency,
     # not the first request's XLA compile (~1.5s for even the tiny
-    # model on CPU).
-    srv.serve([np.arange(1, 5, dtype=np.int32)], max_new_tokens=2)
+    # model on CPU).  Each role warms ITS admission path; with
+    # --warm_prefix_len the prefix-template jits (keyed by prefix
+    # length) are compiled too.  The dummy template is dropped so it
+    # never occupies the LRU or reports warm.
+    warm_p0 = getattr(args, "warm_prefix_len", 0)
+    dummy = np.arange(1, 5, dtype=np.int32)
+    if role != "prefill":
+        srv.serve([dummy], max_new_tokens=2)
+    if role in ("prefill", "decode"):
+        srv.prefill_request("__warm", dummy, 2)
+        payload, _ = srv.export_kv("__warm")
+        if role == "decode":
+            srv.import_kv("__warm", payload, dummy, 2)
+            srv.serve_incremental(tick=lambda: bool(
+                srv.pending_count() or srv.active_rids()
+            ))
+    if warm_p0 > 0 and role != "decode":
+        # The template path only engages when the COMBINED prompt
+        # exceeds the largest bucket — a short warm prefix with a
+        # short dummy tail would silently warm nothing.
+        n_warm = max(warm_p0, srv.buckets[-1]) + 9
+        wp = np.arange(1, n_warm + 1, dtype=np.int32)
+        if role == "prefill":
+            srv.prefill_request("__warmp", wp, 2, prefix_len=warm_p0)
+            srv.export_kv("__warmp")
+        else:
+            srv.submit("__warmp", wp, 2, prefix_len=warm_p0)
+            srv.serve_incremental(tick=lambda: bool(
+                srv.pending_count() or srv.active_rids()
+            ))
+        srv.clear_prefix_templates()
     journal = None
     if args.journal_dir:
         os.makedirs(args.journal_dir, exist_ok=True)
@@ -105,6 +158,7 @@ def build_replica(args, transport):
         srv, transport, args.replica_id, journal_path=journal,
         poll_interval=args.poll_interval,
         round_floor_s=args.round_floor_ms / 1000.0,
+        role=role,
     )
 
 

@@ -8,16 +8,21 @@ spelled in exactly one place)."""
 from __future__ import annotations
 
 
-def tiny_llama(seed: int = 0, n_layer: int = 2, dtype=None):
+def tiny_llama(seed: int = 0, n_layer: int = 2, dtype=None, **over):
     """Seeded tiny Llama: ``(params, cfg)``.  ``dtype`` (e.g.
     ``jnp.float32``) pins the decode numerics — the elastic/fleet
     examples use float32 so greedy replay is byte-identical independent
-    of slot-batch shape (bf16 argmax can flip near ties)."""
+    of slot-batch shape (bf16 argmax can flip near ties).  ``over``
+    passes further ``LlamaConfig.tiny`` overrides (the serve bench's
+    routing rows size the model up so admission prefill costs what it
+    does in production)."""
     import jax
 
     from dlrover_tpu.models import llama
 
-    kw = {} if dtype is None else {"dtype": dtype}
+    kw = dict(over)
+    if dtype is not None:
+        kw["dtype"] = dtype
     cfg = llama.LlamaConfig.tiny(n_layer=n_layer, **kw)
     params = llama.init_params(jax.random.PRNGKey(seed), cfg)
     return params, cfg

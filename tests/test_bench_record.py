@@ -217,6 +217,29 @@ def test_serve_bench_smoke_schema(tmp_path):
         row["ttft_ms_p50"]
     assert row["latency_ms_p99"] >= row["latency_ms_p50"]
     assert row["rejected"] == 0 and row["redispatched"] == 0
+    # Routing rows (ISSUE 8): one Zipf prefix workload under the three
+    # data planes — least-loaded, prefix-aware, disaggregated.
+    routing = result["routing"]
+    assert routing["prefix_len"] == 28 and routing["templates"] == 2
+    rows = {r["mode"]: r for r in routing["rows"]}
+    assert set(rows) == {"least_loaded", "prefix", "disagg"}
+    for r in rows.values():
+        assert r["completed"] == routing["requests"]
+    # Fingerprints withheld = the router can't route on them.
+    assert rows["least_loaded"]["prefix"]["hits"] == 0
+    # The prefix row actually exercised the template store.
+    pf = rows["prefix"]["prefix"]
+    assert pf["hits"] + pf["misses"] + pf["steals"] == \
+        routing["requests"]
+    assert pf["hits"] > 0
+    # Disagg: every request went through a KV handoff; the int8
+    # segment ships at under half the fp32 bytes.
+    kv = rows["disagg"]["kv"]
+    assert kv["handoffs"] >= routing["requests"]
+    assert kv["rejects"] == 0
+    assert 0 < kv["bytes_over_fp32"] < 0.5
+    assert rows["disagg"]["pools"] == {"prefill": 1, "decode": 1}
+    assert "prefix_vs_least_loaded" in routing
     metric = json.loads(proc.stdout.strip().splitlines()[-1])
     assert metric["metric"] == "serve_fleet_speedup"
     assert metric["artifact"] == str(out)

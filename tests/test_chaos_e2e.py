@@ -659,3 +659,160 @@ sys.exit(0 if rc == 0 else 4)
         assert "LADDER_RESTORED step=1 resumed_on=4dev" in out
         assert "fsck_rc=0" in out
         assert "DONE" in out
+
+
+@pytest.mark.serving
+class TestDisaggKillMidHandoff:
+    """ISSUE 8 acceptance e2e: a prefill replica is chaos-killed in
+    the kill-mid-handoff window — AFTER taking a prefill-grant and
+    producing the KV segment, BEFORE the kv-ready reaches the gateway
+    (``serving.replica_kill:method=prefill_export``).  The gateway's
+    lease machinery re-dispatches the prefill to the surviving prefill
+    replica, the decode pool imports the re-shipped segment, and every
+    request completes EXACTLY once: the journal/dedupe contracts keyed
+    by req_id make the replay clean (resubmits answer byte-identically
+    from the cache; the completed counter equals the request count)."""
+
+    def _spawn(self, tmp_path, name, argv, env_extra=None):
+        log = open(tmp_path / f"{name}.log", "w")
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "examples", "llama_serve_fleet.py"),
+             *argv],
+            cwd=REPO, env=_env(env_extra), stdout=log,
+            stderr=subprocess.STDOUT, start_new_session=True,
+        )
+        return proc, tmp_path / f"{name}.log"
+
+    def test_prefill_kill_replays_and_completes_exactly_once(
+            self, tmp_path):
+        from dlrover_tpu.common.messages import (
+            ServeFleetStats,
+            ServeFleetStatsRequest,
+        )
+        from dlrover_tpu.common.rpc import RpcClient, find_free_port
+        from dlrover_tpu.serving import ServeClient
+
+        port = find_free_port()
+        journal_dir = str(tmp_path / "journals")
+        procs = []
+        gw_proc, gw_log = self._spawn(
+            tmp_path, "gateway",
+            ["--role", "gateway", "--port", str(port),
+             "--lease_timeout", "3"],
+        )
+        procs.append(gw_proc)
+
+        def spawn_replica(rid, role, faults=None):
+            extra = {"DLROVER_TPU_FAULTS": faults} if faults else None
+            proc, log = self._spawn(
+                tmp_path, f"replica-{rid}",
+                ["--role", "replica", "--gateway",
+                 f"127.0.0.1:{port}", "--replica_id", rid,
+                 "--replica_role", role,
+                 "--slots", "2", "--max_len", "64",
+                 "--journal_dir", journal_dir,
+                 "--poll_interval", "0.02",
+                 "--round_floor_ms", "20"],
+                env_extra=extra,
+            )
+            procs.append(proc)
+            return proc, log
+
+        try:
+            # p0 dies exporting its FIRST KV segment (the window
+            # between prefill-grant and decode-grant); p1 survives.
+            p0, p0_log = spawn_replica(
+                "p0", "prefill",
+                faults="serving.replica_kill:method=prefill_export",
+            )
+            p1, _ = spawn_replica("p1", "prefill")
+            d0, _ = spawn_replica("d0", "decode")
+            rpc = RpcClient(f"127.0.0.1:{port}", timeout=10.0)
+
+            def fleet_stats():
+                reply = rpc.call(ServeFleetStatsRequest(),
+                                 idempotent=True)
+                assert isinstance(reply, ServeFleetStats), reply
+                return reply.stats
+
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                try:
+                    if fleet_stats()["replicas_alive"] >= 3:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            else:
+                raise AssertionError(
+                    "fleet never formed: " + _read(gw_log)[-2000:]
+                )
+
+            client = ServeClient(rpc, poll_interval=0.05)
+            n_req = 8
+            prompts = [[(5 * i + j) % 50 + 1 for j in range(5)]
+                       for i in range(n_req)]
+            budgets = [6 + (i % 5) for i in range(n_req)]
+            for i, prompt in enumerate(prompts):
+                ack = client.submit(f"req-{i}", prompt, budgets[i])
+                assert ack.status in ("accepted", "done"), ack
+
+            # The chaos kill lands in the handoff window: p0 exits 78.
+            rc0 = p0.wait(timeout=120)
+            assert rc0 == 78, _read(p0_log)[-2000:]
+
+            results = {}
+            for i in range(n_req):
+                reply = client.result(f"req-{i}", timeout=150)
+                assert reply.state == "done", (
+                    f"req-{i}: {reply.state} {reply.reason}; gateway: "
+                    + _read(gw_log)[-2000:]
+                )
+                results[i] = list(reply.tokens)
+                assert len(results[i]) == budgets[i]
+
+            stats = fleet_stats()
+            c = stats["counters"]
+            # Exactly once at the gateway, despite the mid-handoff
+            # kill: no loss, no double-complete, and the killed
+            # prefill's work really was re-dispatched.
+            assert c["completed"] == n_req, c
+            assert c["failed"] == 0 and c["timeout"] == 0, c
+            assert c["redispatched"] >= 1, c
+            assert c["kv_handoffs"] >= n_req, c
+            assert c["duplicate_completions"] == 0, c
+
+            # Client-visible exactly-once: resubmits answer from the
+            # dedupe cache, byte-identical, with no second decode.
+            for i in range(n_req):
+                ack = client.submit(f"req-{i}", prompts[i], budgets[i])
+                assert ack.status == "done", ack
+                assert list(ack.tokens) == results[i]
+            assert fleet_stats()["counters"]["completed"] == n_req
+
+            # The decode journal replays across a decode-replica
+            # restart: kill d0, relaunch on the same journal; its
+            # replay reports dedupe instead of double-completing.
+            d0.send_signal(signal.SIGKILL)
+            d0.wait(timeout=30)
+            d0b, _ = spawn_replica("d0", "decode")
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                if fleet_stats()["counters"][
+                        "duplicate_completions"] >= 1:
+                    break
+                time.sleep(0.5)
+            c = fleet_stats()["counters"]
+            assert c["duplicate_completions"] >= 1, c
+            assert c["completed"] == n_req, c
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.send_signal(signal.SIGTERM)
+            for proc in procs:
+                try:
+                    proc.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()

@@ -296,6 +296,10 @@ class TestMultiNode:
 
 @pytest.mark.e2e
 class TestScaleUp:
+    # slow-lane (ISSUE 8 satellite): 25s, and multi-process XLA
+    # collectives cannot run on this CI container anyway — the tier-1
+    # budget is better spent on tests that can pass here.
+    @pytest.mark.slow
     def test_node_join_grows_world(self, tmp_path):
         """Elastic scale-UP: training starts with one node (min_nodes=1),
         a second node joins mid-run, the master's waiting-list triggers a
@@ -413,6 +417,9 @@ class TestScaleUp:
 
 @pytest.mark.e2e
 class TestScaleDown:
+    # slow-lane (ISSUE 8 satellite): 21s, multi-process XLA collectives
+    # (see TestScaleUp).
+    @pytest.mark.slow
     def test_node_loss_shrinks_world(self, tmp_path):
         """Elastic scale-DOWN: two nodes train; one dies and is NOT
         replaced; with min_nodes=1 the survivor must re-rendezvous as a
@@ -524,6 +531,9 @@ class TestScaleDown:
 
 @pytest.mark.e2e
 class TestJobFileLaunch:
+    # slow-lane (ISSUE 8 satellite): 20s full job-file launch (see
+    # TestScaleUp).
+    @pytest.mark.slow
     def test_yaml_job_file_launches_nanogpt(self, tmp_path):
         """The declarative ElasticJob YAML drives tpurun end-to-end
         (VERDICT r2 next #10): script, args, nproc and ckpt config all

@@ -303,6 +303,9 @@ class TestFp8Strategy:
     the r2 verdict flagged as shelf-ware (VERDICT r2 next #3; reference
     Fp8Optimization, atorch/auto/opt_lib/amp_optimization.py:396)."""
 
+    # slow-lane (ISSUE 8 satellite): 21s training-loop parity run; the
+    # fp8 numerics stay guarded by this file's faster units.
+    @pytest.mark.slow
     def test_accelerate_fp8_trains_and_matches_bf16(
         self, cpu_mesh_devices
     ):

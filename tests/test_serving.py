@@ -16,6 +16,8 @@ import pytest
 from dlrover_tpu.common.messages import (
     ServeDone,
     ServeGrants,
+    ServeKvReady,
+    ServeKvReject,
     ServeReplicaDeregister,
     ServeReplicaPoll,
     ServeReplicaRegister,
@@ -28,10 +30,12 @@ from dlrover_tpu.serving import (
     GatewayConfig,
     GatewayCore,
     LoopbackTransport,
+    PoolAutoScaler,
     ReplicaRunner,
     ScalePolicy,
     ScaleState,
     decide,
+    decide_pools,
 )
 
 pytestmark = pytest.mark.serving
@@ -652,6 +656,35 @@ class TestHistogram:
 # ---------------------------------------------------------------------------
 
 
+class FakeKvError(ValueError):
+    """The runner branches on the duck-typed marker, exactly as it
+    does for the real ``llama_infer.KvSegmentError``."""
+
+    KV_REJECT = True
+
+
+def _fake_segment(prompt, first):
+    """A checksummed fake KV payload: enough structure to prove the
+    verify-before-decode law without the model stack."""
+    import json
+    import zlib
+
+    data = json.dumps(
+        {"prompt": [int(t) for t in prompt], "first": int(first)}
+    ).encode()
+    return zlib.crc32(data).to_bytes(4, "big") + data
+
+
+def _parse_segment(payload):
+    import json
+    import zlib
+
+    if len(payload) < 4 or \
+            zlib.crc32(payload[4:]) != int.from_bytes(payload[:4], "big"):
+        raise FakeKvError("fake KV segment CRC mismatch")
+    return json.loads(payload[4:])
+
+
 class FakeDecodeServer:
     """The incremental-admission surface of DecodeServer, with a
     deterministic arithmetic 'decode' (token i of prompt p is
@@ -663,8 +696,20 @@ class FakeDecodeServer:
         self._pending = collections.deque()
         self._active = {}
         self.last_stats = {}
+        self.imported = 0
 
-    def submit(self, rid, prompt, mnt):
+    def submit(self, rid, prompt, mnt, prefix_len=0, prefix_fp=""):
+        self._pending.append((rid, [int(t) for t in prompt], int(mnt)))
+
+    def import_kv(self, rid, payload, prompt, mnt):
+        """Verify-then-admit: a torn payload raises the duck-typed
+        reject error; a clean one enqueues — the fake's arithmetic
+        token law makes the result identical to a unified decode, so
+        disagg exactness is assertable."""
+        seg = _parse_segment(payload)
+        if seg["prompt"] != [int(t) for t in prompt]:
+            raise FakeKvError("fake KV segment prompt mismatch")
+        self.imported += 1
         self._pending.append((rid, [int(t) for t in prompt], int(mnt)))
 
     def cancel(self, rid):
@@ -722,30 +767,83 @@ class FakeDecodeServer:
         return results
 
 
-def make_loopback_fleet(core, n=1, slots=2, tmp=None, poll=0.001):
-    """Wire N fake-server runners to a GatewayCore over loopback."""
+class FakePrefillServer(FakeDecodeServer):
+    """Prefill-role fake: stages checksummed segments for export; its
+    first token matches the decode law's token 0, so the handed-off
+    decode reproduces the unified result exactly."""
+
+    def __init__(self, slots=2):
+        super().__init__(slots)
+        self._exports = {}
+        self.prefills = 0
+
+    def prefill_request(self, rid, prompt, mnt, prefix_len=0,
+                        prefix_fp=""):
+        p = [int(t) for t in prompt]
+        first = sum(p) % 97
+        self._exports[rid] = _fake_segment(p, first)
+        self.prefills += 1
+        return first
+
+    def export_kv(self, rid):
+        payload = self._exports.pop(rid)
+        return payload, len(payload) * 4  # fake fp32 equivalent
+
+
+def core_handle(core):
+    """The Gateway.handle dispatch over a bare core (loopback fleets)."""
     def handle(msg):
         if isinstance(msg, ServeReplicaRegister):
-            core.register(msg.replica_id, msg.slots)
+            core.register(msg.replica_id, msg.slots, msg.role)
         elif isinstance(msg, ServeReplicaDeregister):
             core.deregister(msg.replica_id)
         elif isinstance(msg, ServeReplicaPoll):
             return core.poll(msg.replica_id, msg.free_slots,
-                             msg.active, msg.stats)
+                             msg.active, msg.stats, msg.warm_prefixes)
         elif isinstance(msg, ServeTokens):
             core.stream(msg.replica_id, msg.req_id, msg.tokens)
         elif isinstance(msg, ServeDone):
             core.complete(msg.replica_id, msg.req_id, msg.tokens,
                           msg.ok, msg.reason, msg.replayed)
+        elif isinstance(msg, ServeKvReady):
+            core.kv_ready(msg.replica_id, msg.req_id, msg.payload,
+                          msg.fp32_bytes)
+        elif isinstance(msg, ServeKvReject):
+            core.kv_reject(msg.replica_id, msg.req_id, msg.reason)
         return None
 
-    transport = LoopbackTransport(handle)
+    return handle
+
+
+def make_loopback_fleet(core, n=1, slots=2, tmp=None, poll=0.001):
+    """Wire N fake-server runners to a GatewayCore over loopback."""
+    transport = LoopbackTransport(core_handle(core))
     runners = []
     for i in range(n):
         journal = f"{tmp}/r{i}.jsonl" if tmp else None
         runners.append(ReplicaRunner(
             FakeDecodeServer(slots), transport, f"r{i}",
             journal_path=journal, poll_interval=poll,
+        ))
+    return runners
+
+
+def make_disagg_fleet(core, prefill=1, decode=1, slots=2, tmp=None,
+                      poll=0.001):
+    """A disaggregated loopback fleet: prefill-role + decode-role
+    runners over fake servers."""
+    transport = LoopbackTransport(core_handle(core))
+    runners = []
+    for i in range(prefill):
+        runners.append(ReplicaRunner(
+            FakePrefillServer(slots), transport, f"p{i}",
+            poll_interval=poll, role="prefill",
+        ))
+    for i in range(decode):
+        journal = f"{tmp}/d{i}.jsonl" if tmp else None
+        runners.append(ReplicaRunner(
+            FakeDecodeServer(slots), transport, f"d{i}",
+            journal_path=journal, poll_interval=poll, role="decode",
         ))
     return runners
 
@@ -975,6 +1073,479 @@ def test_empty_req_id_is_rejected_terminally():
     assert ack.status == "failed"
     assert "empty req_id" in ack.reason
     assert core.stats_snapshot()["queue_depth"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Prefix-aware routing (ISSUE 8): the residency map and its guards
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixRouting:
+    def test_warm_replica_preferred_cold_defers(self):
+        core, _ = make_core()
+        core.register("warm", 2)
+        core.register("cold", 2)
+        core.poll("warm", 0, [], warm_prefixes=["fpA"])
+        core.submit("a", [1, 2, 3], 4, prefix_len=2, prefix_fp="fpA")
+        # Cold polls first: the request is reserved for the warm
+        # holder (which has capacity, inside the reserve window).
+        g = core.poll("cold", 2, [])
+        assert g.requests == []
+        g = core.poll("warm", 1, [], warm_prefixes=["fpA"])
+        assert [r.req_id for r in g.requests] == ["a"]
+        assert core.counters["prefix_hits"] == 1
+        assert core.counters["prefix_steals"] == 0
+
+    def test_deferred_prefix_does_not_starve_queue_behind_it(self):
+        core, _ = make_core()
+        core.register("warm", 2)
+        core.register("cold", 2)
+        core.poll("warm", 0, [], warm_prefixes=["fpA"])
+        core.submit("hot", [1, 2, 3], 4, prefix_len=2, prefix_fp="fpA")
+        core.submit("plain", [5, 6], 4)
+        # The cold replica skips the reserved request and takes the
+        # plain one behind it.
+        g = core.poll("cold", 2, [])
+        assert [r.req_id for r in g.requests] == ["plain"]
+
+    def test_saturated_warm_holder_is_stolen_from(self):
+        core, _ = make_core()
+        core.register("warm", 1)
+        core.register("cold", 2)
+        core.submit("a", [1, 2, 3], 4, prefix_len=2, prefix_fp="fpA")
+        g = core.poll("warm", 1, [], warm_prefixes=["fpA"])
+        assert [r.req_id for r in g.requests] == ["a"]  # warm busy now
+        core.submit("b", [1, 2, 9], 4, prefix_len=2, prefix_fp="fpA")
+        # warm has 1/1 assigned: the overload guard lets cold steal.
+        g = core.poll("cold", 2, [])
+        assert [r.req_id for r in g.requests] == ["b"]
+        assert core.counters["prefix_steals"] == 1
+
+    def test_reserve_window_expiry_steals(self):
+        core, clock = make_core(prefix_reserve_s=2.0)
+        core.register("warm", 2)
+        core.register("cold", 2)
+        core.poll("warm", 0, [], warm_prefixes=["fpA"])
+        core.submit("a", [1, 2, 3], 4, prefix_len=2, prefix_fp="fpA")
+        assert core.poll("cold", 1, []).requests == []
+        clock.advance(3.0)
+        g = core.poll("cold", 1, [])
+        assert [r.req_id for r in g.requests] == ["a"]
+        assert core.counters["prefix_steals"] == 1
+
+    def test_no_warm_holder_is_plain_miss(self):
+        """Fingerprint nobody holds (or a stale fp after journal-path
+        reuse): falls straight back to least-loaded, counted a miss."""
+        core, _ = make_core()
+        core.register("r0", 2)
+        core.submit("a", [1, 2, 3], 4, prefix_len=2, prefix_fp="fpX")
+        g = core.poll("r0", 1, [])
+        assert [r.req_id for r in g.requests] == ["a"]
+        assert core.counters["prefix_misses"] == 1
+
+    def test_residency_evicted_on_deregister(self):
+        core, _ = make_core()
+        core.register("warm", 2)
+        core.register("cold", 2)
+        core.poll("warm", 0, [], warm_prefixes=["fpA"])
+        core.deregister("warm")
+        core.submit("a", [1, 2, 3], 4, prefix_len=2, prefix_fp="fpA")
+        # No defer against a dead replica: immediate miss-grant.
+        g = core.poll("cold", 1, [])
+        assert [r.req_id for r in g.requests] == ["a"]
+        assert core.counters["prefix_misses"] == 1
+
+    def test_residency_evicted_on_lease_expiry(self):
+        core, clock = make_core(lease_timeout_s=5.0)
+        core.register("warm", 2)
+        core.register("cold", 2)
+        core.poll("warm", 0, [], warm_prefixes=["fpA"])
+        clock.advance(3.0)
+        core.poll("cold", 0, [])  # cold stays fresh
+        clock.advance(3.0)
+        core.sweep()  # warm's lease lapsed (6s); cold is 3s fresh
+        core.submit("a", [1, 2, 3], 4, prefix_len=2, prefix_fp="fpA")
+        g = core.poll("cold", 1, [])
+        assert [r.req_id for r in g.requests] == ["a"]
+        assert core.counters["prefix_misses"] == 1
+
+    def test_poll_report_replaces_residency_wholesale(self):
+        """LRU eviction on the replica must self-correct the map: the
+        next poll stops reporting the fp and the reservation ends."""
+        core, _ = make_core()
+        core.register("warm", 2)
+        core.register("cold", 2)
+        core.poll("warm", 0, [], warm_prefixes=["fpA"])
+        core.poll("warm", 0, [], warm_prefixes=["fpB"])  # fpA evicted
+        core.submit("a", [1, 2, 3], 4, prefix_len=2, prefix_fp="fpA")
+        g = core.poll("cold", 1, [])
+        assert [r.req_id for r in g.requests] == ["a"]
+        assert core.counters["prefix_misses"] == 1
+
+    def test_snapshot_carries_prefix_counters_and_warm_sets(self):
+        core, _ = make_core()
+        core.register("r0", 2)
+        core.poll("r0", 0, [], warm_prefixes=["fpZ"])
+        snap = core.stats_snapshot()
+        assert snap["replicas"]["r0"]["warm_prefixes"] == ["fpZ"]
+        for key in ("prefix_hits", "prefix_misses", "prefix_steals"):
+            assert key in snap["counters"]
+
+    def test_runner_reports_server_warm_fps(self):
+        """The runner's poll carries the decode server's warm set."""
+        polls = []
+
+        class T:
+            def call(self, msg, **_kw):
+                if isinstance(msg, ServeReplicaPoll):
+                    polls.append(msg)
+                return None
+
+        srv = FakeDecodeServer(1)
+        srv.warm_prefix_fps = lambda: ["fpQ"]
+        runner = ReplicaRunner(srv, T(), "r0", poll_interval=0.0)
+        runner.tick()
+        assert polls and polls[-1].warm_prefixes == ["fpQ"]
+
+
+# ---------------------------------------------------------------------------
+# Prefill/decode disaggregation (ISSUE 8): the two-stage grant path
+# ---------------------------------------------------------------------------
+
+
+class TestDisaggregationCore:
+    def test_two_stage_flow(self):
+        core, _ = make_core()
+        core.register("p0", 1, role="prefill")
+        core.register("d0", 1, role="decode")
+        core.submit("x", [4, 5, 6], 5)
+        assert core.poll("d0", 1, []).requests == []  # decode: no prefill
+        g = core.poll("p0", 1, [])
+        assert g.requests[0].stage == "prefill"
+        assert core.kv_ready("p0", "x", b"SEG", fp32_bytes=40) == \
+            "recorded"
+        assert core.poll("p0", 1, []).requests == []  # prefill: no decode
+        g = core.poll("d0", 1, [])
+        assert g.requests[0].stage == "decode"
+        assert g.requests[0].kv == b"SEG"
+        assert core.complete("d0", "x", [1, 2]) == "recorded"
+        c = core.counters
+        assert c["kv_handoffs"] == 1 and c["kv_bytes"] == 3
+        assert c["kv_fp32_bytes"] == 40
+
+    def test_prefill_withheld_without_decode_capacity(self):
+        """A prefill-only fleet must not burn prefills into segments
+        nobody can decode."""
+        core, _ = make_core()
+        core.register("p0", 1, role="prefill")
+        core.submit("x", [4], 5)
+        assert core.poll("p0", 1, []).requests == []
+        core.register("u0", 1, role="unified")
+        g = core.poll("p0", 1, [])
+        assert g.requests and g.requests[0].stage == "prefill"
+
+    def test_unified_replica_serves_both_stages(self):
+        core, _ = make_core()
+        core.register("u0", 2, role="unified")
+        core.register("p0", 1, role="prefill")
+        core.submit("x", [4], 5)
+        g = core.poll("p0", 1, [])
+        assert g.requests[0].stage == "prefill"
+        core.kv_ready("p0", "x", b"S")
+        g = core.poll("u0", 1, [])
+        assert g.requests[0].stage == "decode" and g.requests[0].kv
+
+    def test_kill_between_prefill_grant_and_kv_ready_requeues(self):
+        core, clock = make_core(lease_timeout_s=10.0)
+        core.register("p0", 1, role="prefill")
+        core.register("d0", 1, role="decode")
+        core.submit("y", [7, 8], 5)
+        core.poll("p0", 1, [])
+        clock.advance(6.0)
+        core.poll("d0", 1, [])  # decode lease stays fresh
+        clock.advance(5.0)
+        core.sweep()  # p0 dead between the stages
+        core.register("p1", 1, role="prefill")
+        g = core.poll("p1", 1, [])
+        # Re-dispatched as a FRESH prefill (no segment existed yet).
+        assert g.requests[0].req_id == "y"
+        assert g.requests[0].stage == "prefill"
+        assert core.counters["redispatched"] == 1
+
+    def test_kill_after_kv_ready_reships_same_segment(self):
+        core, clock = make_core(lease_timeout_s=10.0)
+        core.register("p0", 1, role="prefill")
+        core.register("d0", 1, role="decode")
+        core.submit("y", [7, 8], 5)
+        core.poll("p0", 1, [])
+        core.kv_ready("p0", "y", b"SEG2")
+        g = core.poll("d0", 1, [])
+        assert g.requests[0].kv == b"SEG2"
+        clock.advance(6.0)
+        core.poll("p0", 0, [])
+        clock.advance(5.0)
+        core.sweep()  # d0 dead mid-decode; the segment is NOT lost
+        core.register("d1", 1, role="decode")
+        g = core.poll("d1", 1, [])
+        assert g.requests[0].stage == "decode"
+        assert g.requests[0].kv == b"SEG2"
+        assert core.complete("d1", "y", [3]) == "recorded"
+
+    def test_stale_kv_ready_from_superseded_prefill_dropped(self):
+        core, clock = make_core(lease_timeout_s=10.0)
+        core.register("p0", 1, role="prefill")
+        core.register("d0", 1, role="decode")
+        core.submit("y", [7], 5)
+        core.poll("p0", 1, [])
+        clock.advance(6.0)
+        core.poll("d0", 1, [])
+        clock.advance(5.0)
+        core.sweep()
+        core.register("p1", 1, role="prefill")
+        core.poll("p1", 1, [])  # y re-granted to p1
+        # Zombie p0 finally reports its segment: dropped.
+        assert core.kv_ready("p0", "y", b"ZOMBIE") == "stale"
+        core.kv_ready("p1", "y", b"LIVE")
+        g = core.poll("d0", 1, [])
+        assert g.requests[0].kv == b"LIVE"
+
+    def test_stale_kv_reject_from_superseded_decode_dropped(self):
+        """A stalled decode replica rejecting AFTER the lease machinery
+        re-granted the segment elsewhere must not tear down the live
+        assignment (nor burn attempts on a healthy request)."""
+        core, clock = make_core(lease_timeout_s=10.0)
+        core.register("p0", 1, role="prefill")
+        core.register("d0", 1, role="decode")
+        core.submit("y", [7], 5)
+        core.poll("p0", 1, [])
+        core.kv_ready("p0", "y", b"SEG")
+        core.poll("d0", 1, [])  # d0 granted, then stalls
+        clock.advance(6.0)
+        core.poll("p0", 0, [])
+        clock.advance(5.0)
+        core.sweep()  # d0 presumed dead; segment kept
+        core.register("d1", 1, role="decode")
+        g = core.poll("d1", 1, [])
+        assert g.requests and g.requests[0].kv == b"SEG"
+        # Zombie d0 finally rejects: dropped, d1's decode undisturbed.
+        assert core.kv_reject("d0", "y", "late") == "stale"
+        assert core.counters["kv_rejects"] == 0
+        assert core.status("y").state == "running"
+        assert core.complete("d1", "y", [3]) == "recorded"
+
+    def test_torn_segments_fail_terminally_after_max_attempts(self):
+        """kv_reject re-prefills, bounded: never hangs, never decodes
+        a torn segment."""
+        core, _ = make_core(max_attempts=3)
+        core.register("p0", 1, role="prefill")
+        core.register("d0", 1, role="decode")
+        core.submit("z", [9], 5)
+        for _ in range(3):
+            g = core.poll("p0", 1, [])
+            assert g.requests and g.requests[0].stage == "prefill"
+            core.kv_ready("p0", "z", b"TORN")
+            g = core.poll("d0", 1, [])
+            assert g.requests and g.requests[0].req_id == "z"
+            core.kv_reject("d0", "z", "crc mismatch")
+        st = core.status("z")
+        assert st.state == "failed" and "re-dispatched" in st.reason
+        assert core.counters["kv_rejects"] == 3
+
+    def test_pools_in_snapshot(self):
+        core, _ = make_core()
+        core.register("p0", 2, role="prefill")
+        core.register("d0", 4, role="decode")
+        core.submit("a", [1], 4)
+        core.submit("b", [2], 4)
+        g = core.poll("p0", 1, [])
+        assert g.requests[0].req_id == "a"
+        core.kv_ready("p0", "a", b"S")
+        snap = core.stats_snapshot()
+        pools = snap["pools"]
+        assert pools["prefill"]["alive"] == 1
+        assert pools["decode"]["alive"] == 1
+        # 'b' is stage-queued (feeds the prefill pool); 'a' is a held
+        # segment awaiting decode capacity (feeds the decode pool).
+        assert pools["prefill"]["queue_depth"] == 1
+        assert pools["decode"]["queue_depth"] == 1
+        assert snap["queue_prefill"] == 1
+        assert snap["queue_kv_ready"] == 1
+
+
+class TestDisaggFleet:
+    """Runner-level loopback fleets over the fake servers."""
+
+    def _run(self, core, runners):
+        threads = []
+        for runner in runners:
+            th = threading.Thread(target=runner.run, daemon=True)
+            th.start()
+            threads.append(th)
+        return threads
+
+    def _stop(self, core, runners, threads):
+        for runner in runners:
+            core.drain(runner.replica_id)
+        for th in threads:
+            th.join(timeout=10)
+            assert not th.is_alive()
+
+    def test_disagg_results_match_unified_law(self, tmp_path):
+        core = GatewayCore(GatewayConfig())
+        runners = make_disagg_fleet(core, prefill=1, decode=1,
+                                    tmp=str(tmp_path))
+        threads = self._run(core, runners)
+        try:
+            for i in range(6):
+                core.submit(f"q{i}", [i + 1, i + 2], 4)
+            assert wait_for(lambda: core.counters["completed"] == 6)
+            for i in range(6):
+                st = core.status(f"q{i}")
+                assert st.state == "done"
+                assert st.tokens == expected_tokens([i + 1, i + 2], 4)
+            c = core.counters
+            assert c["kv_handoffs"] == 6 and c["kv_rejects"] == 0
+            assert c["kv_bytes"] > 0
+        finally:
+            self._stop(core, runners, threads)
+
+    def test_kv_drop_at_export_recovers_via_reconcile(self, tmp_path):
+        from dlrover_tpu import chaos
+
+        core = GatewayCore(GatewayConfig(lease_timeout_s=0.5))
+        runners = make_disagg_fleet(core, prefill=1, decode=1,
+                                    tmp=str(tmp_path))
+        chaos.configure("serving.kv_drop:method=export,times=1,seed=3")
+        try:
+            threads = self._run(core, runners)
+            core.submit("a", [2, 3], 4)
+            assert wait_for(lambda: core.counters["completed"] == 1)
+            assert core.status("a").tokens == expected_tokens([2, 3], 4)
+            assert runners[0].dropped == 1
+            assert core.counters["redispatched"] >= 1
+            self._stop(core, runners, threads)
+        finally:
+            chaos.reset()
+
+    def test_kv_drop_at_import_reprefills_then_completes(self,
+                                                         tmp_path):
+        from dlrover_tpu import chaos
+
+        core = GatewayCore(GatewayConfig())
+        runners = make_disagg_fleet(core, prefill=1, decode=1,
+                                    tmp=str(tmp_path))
+        chaos.configure("serving.kv_drop:method=import,times=1,seed=3")
+        try:
+            threads = self._run(core, runners)
+            core.submit("a", [2, 3], 4)
+            assert wait_for(lambda: core.counters["completed"] == 1)
+            assert core.status("a").tokens == expected_tokens([2, 3], 4)
+            c = core.counters
+            assert c["kv_rejects"] == 1
+            assert c["kv_handoffs"] == 2  # torn once, re-prefilled
+            assert runners[1].kv_rejected == 1
+            self._stop(core, runners, threads)
+        finally:
+            chaos.reset()
+
+    def test_always_torn_fails_terminally_never_hangs(self, tmp_path):
+        from dlrover_tpu import chaos
+
+        core = GatewayCore(GatewayConfig(max_attempts=3))
+        runners = make_disagg_fleet(core, prefill=1, decode=1,
+                                    tmp=str(tmp_path))
+        chaos.configure(
+            "serving.kv_drop:method=import,times=-1,seed=3"
+        )
+        try:
+            threads = self._run(core, runners)
+            core.submit("a", [2, 3], 4)
+            assert wait_for(
+                lambda: core.status("a").state == "failed"
+            )
+            assert "re-dispatched" in core.status("a").reason
+            assert core.counters["completed"] == 0
+            self._stop(core, runners, threads)
+        finally:
+            chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# Per-role pool autoscale (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+class TestPoolAutoscale:
+    def _pools(self, prefill, decode):
+        return {"pools": {
+            "prefill": prefill, "decode": decode,
+        }}
+
+    def test_independent_signals(self):
+        policies = {
+            "prefill": ScalePolicy(up_patience=1,
+                                   queue_high_per_replica=2),
+            "decode": ScalePolicy(up_patience=1, down_patience=2,
+                                  queue_high_per_replica=2),
+        }
+        states = {}
+        snap = self._pools(
+            {"alive": 1, "queue_depth": 10, "occupancy": 1.0},
+            {"alive": 2, "queue_depth": 0, "occupancy": 0.1},
+        )
+        t = decide_pools(snap, policies, states)
+        assert t["prefill"] == 2  # pressure
+        assert t["decode"] == 2  # down_patience not yet consumed
+        t = decide_pools(snap, policies, states)
+        assert t["decode"] == 1  # second idle pass shrinks decode
+
+    def test_ttft_signal_reaches_prefill_not_decode(self):
+        policies = {
+            role: ScalePolicy(up_patience=1, ttft_p95_high_ms=500,
+                              queue_high_per_replica=1e9)
+            for role in ("prefill", "decode")
+        }
+        snap = self._pools(
+            {"alive": 1, "queue_depth": 0, "occupancy": 0.5},
+            {"alive": 1, "queue_depth": 0, "occupancy": 0.5},
+        )
+        snap["ttft_p95_ms"] = 900.0
+        t = decide_pools(snap, policies, {})
+        # Admission latency is the prefill pool's signal.
+        assert t["prefill"] == 2
+        assert t["decode"] == 1
+
+    def test_pool_autoscaler_actuates_per_role(self):
+        ups = []
+        drains = []
+        snap = self._pools(
+            {"alive": 1, "queue_depth": 10, "occupancy": 1.0},
+            {"alive": 3, "queue_depth": 0, "occupancy": 0.0},
+        )
+        sc = PoolAutoScaler(
+            snapshot_fn=lambda: snap,
+            scale_up_fn=lambda role, n: ups.append((role, n)),
+            drain_fn=lambda role: drains.append(role),
+            policies={
+                "prefill": ScalePolicy(up_patience=1,
+                                       queue_high_per_replica=2),
+                "decode": ScalePolicy(down_patience=1),
+            },
+        )
+        deltas = sc.scale_once()
+        assert ups == [("prefill", 1)]
+        assert drains == ["decode"]
+        assert deltas == {"prefill": 1, "decode": -1}
+
+    def test_gateway_pick_drain_victim_by_role(self):
+        core, _ = make_core()
+        core.register("p0", 2, role="prefill")
+        core.register("d0", 2, role="decode")
+        core.register("d1", 2, role="decode")
+        assert core.pick_drain_victim(role="prefill") == "p0"
+        assert core.pick_drain_victim(role="decode") == "d0"
+        core.drain("d0")
+        assert core.pick_drain_victim(role="decode") == "d1"
 
 
 def test_journal_eager_replay_is_capped(tmp_path):
