@@ -169,6 +169,16 @@ class MasterClient:
         )
         return resp.kvs if isinstance(resp, m.KVStoreMultiValue) else {}
 
+    def kv_store_scan(self, prefix: str) -> Dict[str, bytes]:
+        resp = self._client.call(
+            m.KVStoreScan(prefix=prefix), idempotent=True
+        )
+        return resp.kvs if isinstance(resp, m.KVStoreScanResult) else {}
+
+    def kv_store_delete(self, key: str) -> bool:
+        resp = self._client.call(m.KVStoreDelete(key=key), idempotent=True)
+        return bool(getattr(resp, "success", False))
+
     def kv_store_add(self, key: str, delta: int = 1) -> int:
         # The token lets the master dedupe a retried add (exactly-once
         # counter semantics even when the first reply was lost).

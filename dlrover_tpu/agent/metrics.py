@@ -209,6 +209,74 @@ class Histogram:
             "p99": self.percentile(0.99),
         }
 
+    def state(self) -> Dict[str, object]:
+        """Mergeable wire form (ISSUE 9): bucket bounds + the counts
+        covering the live window span (current + previous window,
+        rolled first so aged-out observations are excluded).  This is
+        what one gateway of a sharded tier ships in its stats snapshot
+        — percentiles themselves are NOT mergeable (averaging two p95s
+        whipsaws the autoscaler); bucket counts are."""
+        with self._lock:
+            self._roll_locked()
+            return {
+                "bounds": list(self._bounds),
+                "counts": [
+                    c + p for c, p in
+                    zip(self._counts, self._prev_counts)
+                ],
+                "total": self._total + self._prev_total,
+                "sum": self._sum + self._prev_sum,
+            }
+
+    def merge(self, other) -> None:
+        """Fold another histogram (or a :meth:`state` dict) into this
+        one, bucket-wise.  Window-aware on both sides: ``other``'s
+        state covers only its live windows, and the merged counts land
+        in THIS histogram's current window (so they age out on this
+        instance's clock).  Bounds must match exactly — merging
+        differently-bucketed histograms would silently misbin.
+
+        The tier aggregator builds a FRESH histogram per pass and
+        merges every gateway's state into it, so counts are never
+        double-folded across passes."""
+        st = other.state() if isinstance(other, Histogram) else other
+        if list(st.get("bounds", [])) != list(self._bounds):
+            raise ValueError(
+                f"histogram bounds mismatch: {st.get('bounds')} != "
+                f"{list(self._bounds)}"
+            )
+        counts = st["counts"]
+        if len(counts) != len(self._counts):
+            raise ValueError(
+                f"histogram count vector length {len(counts)} != "
+                f"{len(self._counts)}"
+            )
+        with self._lock:
+            self._roll_locked()
+            for i, c in enumerate(counts):
+                self._counts[i] += int(c)
+            self._total += int(st["total"])
+            self._sum += float(st["sum"])
+
+    @classmethod
+    def merged(cls, states, buckets=None) -> "Histogram":
+        """A fresh (windowless) histogram holding the bucket-wise sum
+        of ``states`` (:meth:`state` dicts and/or Histograms); empty
+        input yields an empty histogram over the default buckets."""
+        states = list(states)
+        if buckets is None:
+            for st in states:
+                src = st.state() if isinstance(st, Histogram) else st
+                if src.get("bounds"):
+                    buckets = tuple(src["bounds"])
+                    break
+            else:
+                buckets = cls.DEFAULT_BUCKETS_MS
+        agg = cls(buckets=buckets)
+        for st in states:
+            agg.merge(st)
+        return agg
+
     def register_gauges(self, registry: "MetricsRegistry",
                         name: str) -> None:
         """Expose count/p50/p95/p99 as ``<name>_*`` gauges."""

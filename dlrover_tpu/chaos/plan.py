@@ -21,6 +21,11 @@ Spec keys:
 ``seed``    decision seed (plan-wide; the last spec that sets it wins)
 ``at``      only fire once this many seconds have elapsed (``10s``/``500ms``)
 ``step``    only fire when the site reports this step
+``step_ge`` only fire once the site reports a step >= this (monotone
+            progress counters — e.g. the gateway tier's heartbeat
+            reports its completed-request count, so ``step_ge=2``
+            means "once two requests finished", deterministic even
+            when the counter skips values between evaluations)
 ``rank``    only fire for this rank / process id / node rank
 ``method``  only fire for this RPC message type (e.g. ``JoinRendezvous``)
 ``times``   max firings (default 1 for crash sites, unlimited otherwise)
@@ -57,6 +62,7 @@ EXIT_MASTER_RESTART = 42
 EXIT_REPLICA_KILL = 78
 EXIT_RESHARD_CRASH = 79
 EXIT_SLICE_CRASH = 80
+EXIT_GATEWAY_KILL = 81
 
 #: site name -> (kind, defaults).  Kinds: ``error`` (caller raises),
 #: ``latency`` (inject() sleeps), ``crash`` (inject() calls os._exit),
@@ -100,6 +106,16 @@ SITES: Dict[str, dict] = {
     # reject it (never decode from a torn segment) and the gateway
     # re-prefills, terminally failing after max_attempts.
     "serving.kv_drop": {"kind": "flag", "times": 1},
+    # Gateway-tier site (ISSUE 9): hard-kill one gateway of a sharded
+    # tier mid-stream.  Fires in the tier node's registry heartbeat
+    # (``method=<gateway_id>`` selects which); the surviving gateways
+    # adopt the dead one's hash range via the registry lease expiry,
+    # clients re-route + resubmit, and replica journals + gateway
+    # dedupe keep every admitted request exactly-once across the
+    # failover.
+    "serving.gateway_kill": {
+        "kind": "crash", "exit": EXIT_GATEWAY_KILL, "times": 1,
+    },
     "master.restart": {
         "kind": "crash", "exit": EXIT_MASTER_RESTART, "times": 1,
     },
@@ -142,6 +158,7 @@ class FaultSpec:
     p: float = 1.0
     at: Optional[float] = None
     step: Optional[int] = None
+    step_ge: Optional[int] = None
     rank: Optional[int] = None
     method: str = ""
     times: int = -1  # -1 = unlimited
@@ -186,6 +203,8 @@ class FaultSpec:
                 spec.at = _parse_duration(val)
             elif key == "step":
                 spec.step = int(val)
+            elif key == "step_ge":
+                spec.step_ge = int(val)
             elif key == "rank":
                 spec.rank = int(val)
             elif key == "method":
@@ -257,6 +276,11 @@ class FaultPlan:
                 if spec.rank is not None and ctx.get("rank") != spec.rank:
                     continue
                 if spec.step is not None and ctx.get("step") != spec.step:
+                    continue
+                if spec.step_ge is not None and (
+                    ctx.get("step") is None
+                    or ctx.get("step") < spec.step_ge
+                ):
                     continue
                 if spec.method and ctx.get("method") != spec.method:
                     continue

@@ -20,6 +20,12 @@ import msgpack
 
 _REGISTRY: Dict[str, type] = {}
 
+#: Per-class field-name tuples, filled lazily on first encode.  Lazy
+#: because ``__init_subclass__`` runs BEFORE the ``@dataclass``
+#: decorator processes the class body, so fields aren't knowable at
+#: registration time.
+_FIELD_CACHE: Dict[type, tuple] = {}
+
 
 class Message:
     """Base for all wire messages.  Subclasses must be dataclasses."""
@@ -29,19 +35,52 @@ class Message:
         _REGISTRY[cls.__name__] = cls
 
 
+def _fields_of(cls: type) -> tuple:
+    names = _FIELD_CACHE.get(cls)
+    if names is None:
+        names = tuple(
+            f.name for f in dataclasses.fields(cls)  # type: ignore[arg-type]
+        )
+        _FIELD_CACHE[cls] = names
+    return names
+
+
+# The encode/decode pair below is the serving tier's admission hot
+# path (every submit/grant/poll crosses it; ISSUE 9's load-harness
+# profile named it).  Two fast paths keep it cheap without losing
+# generality:
+#
+# - per-class field names come from ``_FIELD_CACHE`` instead of a
+#   ``dataclasses.fields`` reflection walk per message;
+# - scalar containers pass through UNTOUCHED: a prompt of 200 ints (or
+#   a stats dict of floats) needs no per-element _encode call and no
+#   copied list — msgpack packs the original directly.  Only containers
+#   actually holding a Message / dict / list keep the recursive walk.
+#
+# ``serialize_baseline`` keeps the original reflection-everywhere
+# implementation alive as the load bench's measured reference point.
+
+_RECURSE = (Message, dict, list, tuple)
+
+
 def _encode(obj: Any) -> Any:
     if isinstance(obj, Message):
-        return {
-            "__msg__": type(obj).__name__,
-            "f": {
-                f.name: _encode(getattr(obj, f.name))
-                for f in dataclasses.fields(obj)  # type: ignore[arg-type]
-            },
-        }
+        cls = type(obj)
+        out = {}
+        for name in _fields_of(cls):
+            v = getattr(obj, name)
+            out[name] = _encode(v) if isinstance(v, _RECURSE) else v
+        return {"__msg__": cls.__name__, "f": out}
     if isinstance(obj, dict):
-        return {k: _encode(v) for k, v in obj.items()}
+        for v in obj.values():
+            if isinstance(v, _RECURSE):
+                return {k: _encode(v) for k, v in obj.items()}
+        return obj
     if isinstance(obj, (list, tuple)):
-        return [_encode(v) for v in obj]
+        for v in obj:
+            if isinstance(v, _RECURSE):
+                return [_encode(v) for v in obj]
+        return obj if isinstance(obj, list) else list(obj)
     return obj
 
 
@@ -49,11 +88,20 @@ def _decode(obj: Any) -> Any:
     if isinstance(obj, dict):
         if "__msg__" in obj:
             cls = _REGISTRY[obj["__msg__"]]
-            fields = {k: _decode(v) for k, v in obj["f"].items()}
+            fields = {
+                k: _decode(v) if isinstance(v, (dict, list)) else v
+                for k, v in obj["f"].items()
+            }
             return cls(**fields)
-        return {k: _decode(v) for k, v in obj.items()}
+        for v in obj.values():
+            if isinstance(v, (dict, list)):
+                return {k: _decode(v) for k, v in obj.items()}
+        return obj
     if isinstance(obj, list):
-        return [_decode(v) for v in obj]
+        for v in obj:
+            if isinstance(v, (dict, list)):
+                return [_decode(v) for v in obj]
+        return obj
     return obj
 
 
@@ -63,6 +111,30 @@ def serialize(msg: Message) -> bytes:
 
 def deserialize(data: bytes) -> Message:
     return _decode(msgpack.unpackb(data, raw=False, strict_map_key=False))
+
+
+def _encode_generic(obj: Any) -> Any:
+    """The pre-fast-path encoder (reflection + per-element recursion
+    everywhere) — kept as the measured baseline for ``bench.py
+    --load_bench``'s serialization profile; not used on any wire path."""
+    if isinstance(obj, Message):
+        return {
+            "__msg__": type(obj).__name__,
+            "f": {
+                f.name: _encode_generic(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)  # type: ignore[arg-type]
+            },
+        }
+    if isinstance(obj, dict):
+        return {k: _encode_generic(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_encode_generic(v) for v in obj]
+    return obj
+
+
+def serialize_baseline(msg: Message) -> bytes:
+    """Byte-identical to :func:`serialize`, via the slow generic walk."""
+    return msgpack.packb(_encode_generic(msg), use_bin_type=True)
 
 
 # ---------------------------------------------------------------------------
@@ -251,6 +323,28 @@ class KVStoreAdd(Message):
 @dataclasses.dataclass
 class KVStoreCount(Message):
     value: int = 0
+
+
+@dataclasses.dataclass
+class KVStoreScan(Message):
+    """Prefix scan (ISSUE 9): the serving tier's shared registry lists
+    its gateway/replica entries (``serve/{job}/gw/``,
+    ``serve/{job}/rep/``) without maintaining a racy index key."""
+
+    prefix: str = ""
+
+
+@dataclasses.dataclass
+class KVStoreScanResult(Message):
+    kvs: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class KVStoreDelete(Message):
+    """Delete one key (ISSUE 9): registry GC of stale gateway/replica
+    leases needs removal, not just overwrite."""
+
+    key: str = ""
 
 
 # ---------------------------------------------------------------------------
@@ -564,7 +658,20 @@ class ServeSubmit(Message):
     prefix_len: int = 0  # leading tokens shared with other requests
     prefix_fp: str = ""  # fingerprint of prompt[:prefix_len]
     stage: str = "full"  # full | prefill | decode (grant direction)
-    kv: bytes = b""  # packed KV segment (decode grants only)
+    kv: bytes = b""  # packed KV segment (relayed decode grants only)
+    # Peer-to-peer KV handoff (ISSUE 9).  On a decode grant, a
+    # non-empty ``kv_addr`` is a TICKET: the decode replica pulls the
+    # segment bytes directly from the prefill replica's segment server
+    # at that address (``KvSegmentFetch``), verifying ``kv_crc32`` /
+    # ``kv_nbytes`` / ``kv_fp`` — the gateway never touched the bytes.
+    # On a prefill grant, ``kv_relay=True`` orders the old
+    # through-the-gateway payload path (the fallback after a failed
+    # pull, and the compat mode for non-P2P replicas).
+    kv_addr: str = ""
+    kv_fp: str = ""
+    kv_crc32: int = 0
+    kv_nbytes: int = 0
+    kv_relay: bool = False
 
 
 @dataclasses.dataclass
@@ -682,12 +789,41 @@ class ServeKvReady(Message):
     ready (stage two of the disaggregated path, ISSUE 8).  ``payload``
     is ``llama_infer.pack_kv_segment`` bytes (CRC embedded);
     ``fp32_bytes`` is the segment's un-quantized size so the int8
-    transfer saving is measurable at the gateway without unpacking."""
+    transfer saving is measurable at the gateway without unpacking.
+
+    Peer-to-peer mode (ISSUE 9): ``payload`` stays EMPTY and the
+    message carries only a ticket — ``addr`` of the prefill replica's
+    segment server plus the segment's ``seg_fp``/``crc32``/``nbytes``
+    — which the gateway holds and attaches to the decode grant; the
+    decode replica pulls the bytes directly from the peer."""
 
     replica_id: str = ""
     req_id: str = ""
     payload: bytes = b""
     fp32_bytes: int = 0
+    addr: str = ""  # non-empty = ticket mode (P2P)
+    seg_fp: str = ""
+    crc32: int = 0
+    nbytes: int = 0
+
+
+@dataclasses.dataclass
+class KvSegmentFetch(Message):
+    """Decode replica -> prefill replica's segment server (ISSUE 9):
+    pull the published KV segment for ``req_id``.  ``seg_fp`` pins the
+    exact segment the ticket promised — a re-prefilled request must
+    never decode from a stale publication under the same req_id."""
+
+    req_id: str = ""
+    seg_fp: str = ""
+
+
+@dataclasses.dataclass
+class KvSegmentData(Message):
+    found: bool = False
+    reason: str = ""
+    payload: bytes = b""
+    crc32: int = 0
 
 
 @dataclasses.dataclass

@@ -72,6 +72,15 @@ class KVStoreService:
         with self._cond:
             return self._store.pop(key, None) is not None
 
+    def scan(self, prefix: str) -> Dict[str, bytes]:
+        """All keys under ``prefix`` (ISSUE 9: the serving tier's
+        registry lists gateways/replicas without an index key)."""
+        with self._cond:
+            return {
+                k: v for k, v in self._store.items()
+                if k.startswith(prefix)
+            }
+
     def clear(self, prefix: str = "") -> None:
         """Drop keys (optionally by prefix) — used when a new rendezvous
         round invalidates stale bootstrap data."""

@@ -57,6 +57,8 @@ class MasterServicer:
             m.KVStoreMultiSet: self._on_kv_multi_set,
             m.KVStoreMultiGet: self._on_kv_multi_get,
             m.KVStoreAdd: self._on_kv_add,
+            m.KVStoreScan: self._on_kv_scan,
+            m.KVStoreDelete: self._on_kv_delete,
             m.DatasetShardParams: self._on_dataset_params,
             m.TaskRequest: self._on_task_request,
             m.TaskResult: self._on_task_result,
@@ -181,6 +183,12 @@ class MasterServicer:
         return m.KVStoreCount(
             value=self.kv_store.add(msg.key, msg.delta, token=msg.token)
         )
+
+    def _on_kv_scan(self, msg: m.KVStoreScan):
+        return m.KVStoreScanResult(kvs=self.kv_store.scan(msg.prefix))
+
+    def _on_kv_delete(self, msg: m.KVStoreDelete):
+        return m.BaseResponse(success=self.kv_store.delete(msg.key))
 
     # -- data sharding -----------------------------------------------------
     def _on_dataset_params(self, msg: m.DatasetShardParams):

@@ -18,6 +18,18 @@ inference service:
 - :mod:`dlrover_tpu.serving.autoscale` — queue-depth and p95-TTFT
   driven replica-count policy with drain-aware scale-down (no request
   ever observes the shrink).
+- :mod:`dlrover_tpu.serving.tier` (ISSUE 9) — the HORIZONTAL front
+  door: N gateway processes over a shared leased registry, requests
+  consistent-hashed by req_id to one owning gateway, replicas polling
+  every gateway through one fan-out transport, gateway death healed by
+  range adoption + client resubmit + journal/dedupe, and per-gateway
+  windowed histograms merged bucket-wise for the tier-wide autoscale
+  signals.
+- :mod:`dlrover_tpu.serving.kvseg` (ISSUE 9) — peer-to-peer KV
+  handoff: prefill replicas publish segments on a local segment
+  server, the gateway holds only a ticket (addr, fp, crc32, nbytes),
+  and the decode replica pulls the bytes directly — with the
+  through-the-gateway relay kept as the bounded fallback.
 
 Imports stay lazy: the gateway and autoscaler are pure control plane
 (no jax); only the replica touches the model stack.
@@ -38,7 +50,26 @@ from dlrover_tpu.serving.gateway import (  # noqa: F401
     LoopbackTransport,
     ServeClient,
 )
+from dlrover_tpu.serving.kvseg import (  # noqa: F401
+    KvPullError,
+    KvSegmentServer,
+    KvSegmentStore,
+    pull_kv_segment,
+)
 from dlrover_tpu.serving.replica import (  # noqa: F401
     ReplicaRunner,
     prefix_fingerprint,
+)
+from dlrover_tpu.serving.tier import (  # noqa: F401
+    GatewayTierNode,
+    HashRing,
+    LocalKv,
+    MasterKv,
+    RegistryServer,
+    RpcKv,
+    ServeRegistry,
+    TierClient,
+    TierReplicaLink,
+    TierStats,
+    merge_snapshots,
 )

@@ -100,12 +100,20 @@ class GatewayConfig:
         done_cache_cap: int = 4096,
         max_attempts: int = 5,
         prefix_reserve_s: float = 2.0,
+        kv_p2p: bool = True,
     ):
         self.queue_cap = queue_cap
         self.lease_timeout_s = lease_timeout_s
         self.default_deadline_s = default_deadline_s
         self.retry_after_s = retry_after_s
         self.done_cache_cap = done_cache_cap
+        #: Allow peer-to-peer KV handoff (ISSUE 9): prefill grants are
+        #: issued WITHOUT ``kv_relay`` so a P2P-capable prefill replica
+        #: publishes a ticket instead of relaying the payload.  False =
+        #: every prefill grant orders the relay path (the PR-8 data
+        #: plane).  Per-request fallback is automatic either way: a
+        #: failed pull flips that request to relay on its re-prefill.
+        self.kv_p2p = kv_p2p
         #: How long a queued request whose prefix template is warm on a
         #: replica WITH capacity is held for that replica before any
         #: capable replica may steal it (saturated warm holders are
@@ -123,6 +131,7 @@ class _Request:
         "req_id", "prompt", "max_new_tokens", "deadline", "submitted_at",
         "attempts", "assigned_to", "grant_seq", "first_token_at",
         "partial", "prefix_len", "prefix_fp", "stage", "kv",
+        "kv_addr", "kv_fp", "kv_crc32", "kv_nbytes", "kv_relay",
     )
 
     def __init__(self, req_id: str, prompt: List[int],
@@ -142,9 +151,30 @@ class _Request:
         self.prefix_fp = prefix_fp
         #: queued -> (full | prefill) -> kv_ready -> decode; a requeue
         #: falls back to kv_ready when the gateway still holds the
-        #: segment, queued otherwise (re-prefill).
+        #: segment OR a ticket for it, queued otherwise (re-prefill).
         self.stage = "queued"
         self.kv: bytes = b""
+        # P2P ticket (ISSUE 9): a non-empty kv_addr means the segment
+        # bytes live on the prefill replica's segment server and only
+        # the ticket rides the decode grant.  kv_relay flips to True
+        # after a failed pull: the NEXT prefill grant orders the
+        # through-the-gateway payload path instead.
+        self.kv_addr = ""
+        self.kv_fp = ""
+        self.kv_crc32 = 0
+        self.kv_nbytes = 0
+        self.kv_relay = False
+
+    def clear_kv(self) -> None:
+        self.kv = b""
+        self.kv_addr = ""
+        self.kv_fp = ""
+        self.kv_crc32 = 0
+        self.kv_nbytes = 0
+
+    @property
+    def has_kv(self) -> bool:
+        return bool(self.kv) or bool(self.kv_addr)
 
 
 class _Replica:
@@ -203,7 +233,13 @@ class GatewayCore:
             # Disaggregation (ISSUE 8): completed prefill->decode
             # handoffs, rejected (torn) segments, and the shipped vs
             # fp32-equivalent byte volume (the int8 saving, measured).
+            # kv_bytes counts RELAYED payload bytes only — in the P2P
+            # plane (ISSUE 9) it stays ~0 and kv_p2p_bytes counts the
+            # ticketed bytes that moved peer-to-peer instead;
+            # kv_relay_fallbacks counts requests that fell back to the
+            # relay path after a failed pull.
             "kv_handoffs", "kv_rejects", "kv_bytes", "kv_fp32_bytes",
+            "kv_p2p_bytes", "kv_relay_fallbacks",
         ):
             self._counters.inc(name, 0)
         self._last_sweep = float("-inf")
@@ -413,6 +449,14 @@ class GatewayCore:
                     req.grant_seq = rep.poll_seq
                     req.stage = stage
                     rep.assigned[req.req_id] = req
+                    if stage == "decode" and req.kv_addr:
+                        # Ticketed bytes GRANTED for a peer pull: a
+                        # re-shipped ticket (decode-replica death)
+                        # counts again, matching the pulls actually
+                        # attempted — counting at kv_ready would book
+                        # bytes that never moved.
+                        self._counters.inc("kv_p2p_bytes",
+                                           req.kv_nbytes)
                     grants.append(ServeSubmit(
                         req_id=req.req_id, prompt=list(req.prompt),
                         max_new_tokens=req.max_new_tokens,
@@ -424,6 +468,20 @@ class GatewayCore:
                         prefix_fp=req.prefix_fp,
                         stage=stage,
                         kv=req.kv if stage == "decode" else b"",
+                        kv_addr=req.kv_addr if stage == "decode"
+                        else "",
+                        kv_fp=req.kv_fp if stage == "decode" else "",
+                        kv_crc32=req.kv_crc32
+                        if stage == "decode" else 0,
+                        kv_nbytes=req.kv_nbytes
+                        if stage == "decode" else 0,
+                        # Order the relay path on a prefill grant when
+                        # P2P is off tier-wide or this request already
+                        # burned a failed pull.
+                        kv_relay=(
+                            stage == "prefill"
+                            and (req.kv_relay or not self.cfg.kv_p2p)
+                        ),
                     ))
             drain = rep.draining and not rep.assigned
             return ServeGrants(
@@ -486,14 +544,19 @@ class GatewayCore:
             return "recorded"
 
     def kv_ready(self, replica_id: str, req_id: str, payload: bytes,
-                 fp32_bytes: int = 0) -> str:
+                 fp32_bytes: int = 0, addr: str = "",
+                 seg_fp: str = "", crc32: int = 0,
+                 nbytes: int = 0) -> str:
         """Stage two of the disaggregated path: the prefill replica's
-        KV segment arrives.  The request leaves the prefill replica's
-        books, the gateway holds the segment, and the request re-queues
-        at the FRONT in stage ``kv_ready`` for the decode pool (the
-        prefill investment is sunk — decode capacity should consume it
-        before fresh prefills).  Returns ``recorded`` | ``stale`` |
-        ``unknown`` (tests branch; the replica does not)."""
+        KV segment arrives — as relayed ``payload`` bytes (PR 8), or
+        as a P2P TICKET (ISSUE 9: non-empty ``addr``; the bytes stay
+        on the prefill replica's segment server and the decode replica
+        pulls them directly).  Either way the request leaves the
+        prefill replica's books and re-queues at the FRONT in stage
+        ``kv_ready`` for the decode pool (the prefill investment is
+        sunk — decode capacity should consume it before fresh
+        prefills).  Returns ``recorded`` | ``stale`` | ``unknown``
+        (tests branch; the replica does not)."""
         with self._mu:
             req = self._by_id.get(req_id)
             if req is None:
@@ -509,22 +572,35 @@ class GatewayCore:
             if rep is not None:
                 rep.assigned.pop(req_id, None)
             req.assigned_to = None
-            req.kv = bytes(payload)
+            req.clear_kv()
+            if addr:
+                req.kv_addr = addr
+                req.kv_fp = seg_fp
+                req.kv_crc32 = int(crc32)
+                req.kv_nbytes = int(nbytes)
+                # kv_p2p_bytes is counted at DECODE-GRANT time, when
+                # the ticket is actually handed to a puller.
+            else:
+                req.kv = bytes(payload)
+                self._counters.inc("kv_bytes", len(payload))
             req.stage = "kv_ready"
             self._queue.insert(0, req)
             self._counters.inc("kv_handoffs")
-            self._counters.inc("kv_bytes", len(payload))
             self._counters.inc("kv_fp32_bytes", int(fp32_bytes))
             return "recorded"
 
     def kv_reject(self, replica_id: str, req_id: str,
                   reason: str = "") -> str:
         """A decode replica refused a KV segment (CRC/shape mismatch —
-        torn in flight, chaos ``serving.kv_drop``).  The held segment
-        is DROPPED (never re-shipped, never decoded from) and the
-        request re-queues for a fresh prefill — through
+        torn in flight, chaos ``serving.kv_drop`` — or a FAILED P2P
+        PULL: dead peer, evicted/stale publication).  The held segment
+        or ticket is DROPPED (never re-shipped, never decoded from)
+        and the request re-queues for a fresh prefill — through
         ``_requeue_locked``, so a persistently-torn handoff fails
-        terminally after ``max_attempts`` instead of looping."""
+        terminally after ``max_attempts`` instead of looping.  A
+        request whose TICKET failed re-prefills in RELAY mode: the
+        peer path already proved unreliable for it, and the bounded
+        attempts budget must not be spent re-proving that."""
         with self._mu:
             req = self._by_id.get(req_id)
             if req is None:
@@ -542,7 +618,10 @@ class GatewayCore:
             if rep is not None:
                 rep.assigned.pop(req_id, None)
             req.assigned_to = None
-            req.kv = b""
+            if req.kv_addr:
+                req.kv_relay = True
+                self._counters.inc("kv_relay_fallbacks")
+            req.clear_kv()
             self._requeue_locked(
                 req, f"kv segment rejected by {replica_id}: {reason}"
             )
@@ -729,10 +808,10 @@ class GatewayCore:
         req.assigned_to = None
         req.attempts += 1
         req.partial = []
-        # Fall back to the right stage: a held KV segment survives its
-        # decode replica's death (re-ship it), a lost prefill
-        # re-prefills from scratch.
-        req.stage = "kv_ready" if req.kv else "queued"
+        # Fall back to the right stage: a held KV segment OR ticket
+        # survives its decode replica's death (re-ship it), a lost
+        # prefill re-prefills from scratch.
+        req.stage = "kv_ready" if req.has_kv else "queued"
         if req.attempts >= self.cfg.max_attempts:
             self._finish_locked(
                 req, "failed", [], "",
@@ -807,9 +886,15 @@ class Gateway:
         self.ttft_ms = Histogram(**kw)
         self.core.observe_latency_ms = self.latency_ms.observe
         self.core.observe_ttft_ms = self.ttft_ms.observe
+        # The *_hist entries are Histogram.state() dicts — the
+        # MERGEABLE form a sharded tier aggregates bucket-wise
+        # (Histogram.merged) before reading percentiles; merging the
+        # per-gateway p95s themselves would whipsaw the autoscaler.
         self.core.snapshot_extras = lambda: {
             "ttft_p95_ms": self.ttft_ms.percentile(0.95),
             "latency_p95_ms": self.latency_ms.percentile(0.95),
+            "ttft_hist": self.ttft_ms.state(),
+            "latency_hist": self.latency_ms.state(),
         }
         if metrics_registry is not None:
             self.register_gauges(metrics_registry)
@@ -859,7 +944,8 @@ class Gateway:
             return read
 
         for name in ("prefix_hits", "prefix_misses", "prefix_steals",
-                     "kv_handoffs", "kv_rejects", "kv_bytes"):
+                     "kv_handoffs", "kv_rejects", "kv_bytes",
+                     "kv_p2p_bytes", "kv_relay_fallbacks"):
             registry.gauge(f"serve_{name}", _counter_gauge(name))
 
         def _pool_gauge(role, key):
@@ -894,7 +980,9 @@ class Gateway:
                              msg.active, msg.stats, msg.warm_prefixes)
         if isinstance(msg, ServeKvReady):
             outcome = core.kv_ready(msg.replica_id, msg.req_id,
-                                    msg.payload, msg.fp32_bytes)
+                                    msg.payload, msg.fp32_bytes,
+                                    msg.addr, msg.seg_fp, msg.crc32,
+                                    msg.nbytes)
             return BaseResponse(success=True, reason=outcome)
         if isinstance(msg, ServeKvReject):
             outcome = core.kv_reject(msg.replica_id, msg.req_id,

@@ -174,12 +174,28 @@ class ReplicaRunner:
         round_floor_s: float = 0.0,
         replay_limit: int = 256,
         role: str = "unified",  # unified | prefill | decode (ISSUE 8)
+        kv_p2p: bool = True,
+        kv_server=None,  # injectable KvSegmentServer (tests)
+        kv_connect=None,  # addr -> transport override for pulls (tests)
         clock=time.monotonic,
     ):
         self.server = server
         self.transport = transport
         self.replica_id = replica_id
         self.role = role or "unified"
+        #: Peer-to-peer KV handoff (ISSUE 9): when True, a prefill
+        #: grant WITHOUT ``kv_relay`` publishes the exported segment on
+        #: this replica's segment server and sends the gateway only a
+        #: ticket; the decode replica pulls the bytes directly.  The
+        #: segment server is started lazily on the first P2P prefill
+        #: (decode-only and unified-relay fleets never pay the port).
+        self.kv_p2p = kv_p2p
+        self._kv_server = kv_server
+        self._kv_connect = kv_connect
+        #: addr -> cached pull client: a decode replica pulls from the
+        #: same few prefill peers over and over — per-pull channel
+        #: setup would put connection churn on the data-plane hot path.
+        self._kv_clients: Dict[str, Any] = {}
         self.journal = (
             CompletionJournal(journal_path) if journal_path else None
         )
@@ -210,6 +226,9 @@ class ReplicaRunner:
         self.dropped = 0
         self.prefilled = 0  # KV segments produced (prefill role)
         self.kv_rejected = 0  # torn segments refused (decode role)
+        self.kv_published = 0  # segments published P2P (prefill role)
+        self.kv_pulled = 0  # segments pulled P2P (decode role)
+        self.kv_pull_failed = 0  # pulls that fell to the relay ladder
 
     # -- protocol steps ---------------------------------------------------
 
@@ -264,6 +283,21 @@ class ReplicaRunner:
             ))
             if self.journal is not None:
                 self.journal.close()
+            if self._kv_server is not None:
+                # Un-pulled publications die with the replica; the
+                # gateway's reject->relay ladder re-prefills them.
+                stop = getattr(self._kv_server, "stop", None)
+                if stop is not None:
+                    stop()
+            for cli in self._kv_clients.values():
+                close = getattr(cli, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:  # noqa: BLE001 - teardown
+                        logger.debug("kv pull client close failed",
+                                     exc_info=True)
+            self._kv_clients.clear()
 
     def tick(self) -> bool:
         """One admission-point visit from the decode loop: rate-limited
@@ -364,7 +398,46 @@ class ReplicaRunner:
                 # Disaggregated decode (ISSUE 8): verify + admit the
                 # shipped KV segment.  A torn segment is NEVER decoded
                 # from — the gateway re-prefills on the reject.
+                # ISSUE 9: a grant carrying a TICKET (kv_addr) means
+                # the bytes live on the prefill replica's segment
+                # server — pull them directly; a failed pull rides the
+                # same reject ladder (the gateway re-prefills in relay
+                # mode).
                 payload = grant.kv
+                if getattr(grant, "kv_addr", ""):
+                    from dlrover_tpu.serving.kvseg import (
+                        KvPullError,
+                        pull_kv_segment,
+                    )
+
+                    try:
+                        if chaos.inject(
+                            "serving.kv_drop",
+                            replica=self.replica_id, method="pull",
+                        ) is not None:
+                            raise KvPullError(
+                                "chaos: segment pull dropped"
+                            )
+                        payload = pull_kv_segment(
+                            grant.kv_addr, rid_key, grant.kv_fp,
+                            grant.kv_crc32, grant.kv_nbytes,
+                            transport=self._kv_transport(
+                                grant.kv_addr
+                            ),
+                        )
+                        self.kv_pulled += 1
+                    except KvPullError as e:
+                        self.kv_pull_failed += 1
+                        logger.warning(
+                            "replica %s: KV pull for %s failed: %s",
+                            self.replica_id, rid_key, e,
+                        )
+                        self._call_quiet(ServeKvReject(
+                            replica_id=self.replica_id,
+                            req_id=rid_key,
+                            reason=f"pull: {str(e)[:200]}",
+                        ))
+                        return
                 if chaos.inject(
                     "serving.kv_drop", replica=self.replica_id,
                     method="import",
@@ -459,10 +532,82 @@ class ReplicaRunner:
             "serving.replica_kill", replica=self.replica_id,
             method="prefill_export",
         )
+        relay = getattr(grant, "kv_relay", False) or not self.kv_p2p
+        if not relay:
+            # P2P (ISSUE 9): publish locally, ship only the ticket.
+            server = self._ensure_kv_server()
+            if server is None:
+                relay = True  # segment server unavailable: relay
+        if not relay:
+            ticket = server.store.put(rid_key, payload)
+            if ticket is None:
+                # The store could not retain the segment (oversized,
+                # or evicted by the publication pressure the bound
+                # exists for): shipping a dead ticket would burn an
+                # attempt on a guaranteed-failed pull — relay instead.
+                logger.warning(
+                    "replica %s: segment for %s not retainable "
+                    "(%d bytes); relaying through the gateway",
+                    self.replica_id, rid_key, len(payload),
+                )
+                relay = True
+        if not relay:
+            seg_fp, crc, nb = ticket
+            self.kv_published += 1
+            self._call_quiet(ServeKvReady(
+                replica_id=self.replica_id, req_id=rid_key,
+                fp32_bytes=int(fp32_bytes), addr=server.addr,
+                seg_fp=seg_fp, crc32=crc, nbytes=nb,
+            ))
+            return
         self._call_quiet(ServeKvReady(
             replica_id=self.replica_id, req_id=rid_key,
             payload=payload, fp32_bytes=int(fp32_bytes),
         ))
+
+    def _kv_transport(self, addr: str):
+        """Cached pull transport per peer address (bounded; LRU-ish
+        oldest-first eviction closes the retired client)."""
+        if self._kv_connect is not None:
+            return self._kv_connect(addr)
+        cli = self._kv_clients.get(addr)
+        if cli is None:
+            from dlrover_tpu.common.rpc import RpcClient
+
+            cli = RpcClient(addr, timeout=10.0)
+            self._kv_clients[addr] = cli
+            while len(self._kv_clients) > 16:
+                old = self._kv_clients.pop(
+                    next(iter(self._kv_clients))
+                )
+                try:
+                    old.close()
+                except Exception:  # noqa: BLE001 - teardown
+                    logger.debug("kv pull client close failed",
+                                 exc_info=True)
+        return cli
+
+    def _ensure_kv_server(self):
+        """Lazy segment server for P2P publishes; a failure to bind
+        degrades to the relay path rather than killing the replica."""
+        if self._kv_server is None:
+            try:
+                from dlrover_tpu.serving.kvseg import KvSegmentServer
+
+                self._kv_server = KvSegmentServer()
+                logger.info(
+                    "replica %s: KV segment server on %s",
+                    self.replica_id, self._kv_server.addr,
+                )
+            except Exception as e:  # noqa: BLE001 - degrade to relay
+                logger.warning(
+                    "replica %s: KV segment server failed (%s); "
+                    "relaying segments through the gateway",
+                    self.replica_id, e,
+                )
+                self.kv_p2p = False
+                return None
+        return self._kv_server
 
     def _on_token(self, rid_key, tok) -> None:
         self._stream_buf.setdefault(rid_key, []).append(int(tok))
@@ -528,6 +673,11 @@ class ReplicaRunner:
         }
         if self.prefilled:
             stats["prefilled"] = self.prefilled
+        if self.kv_published:
+            stats["kv_published"] = self.kv_published
+        if self.kv_pulled or self.kv_pull_failed:
+            stats["kv_pulled"] = self.kv_pulled
+            stats["kv_pull_failed"] = self.kv_pull_failed
         hits = getattr(self.server, "prefix_hits", None)
         if hits is not None:
             # Template hit/miss telemetry: how well the router's

@@ -16,6 +16,18 @@ elastic agent)::
     python examples/llama_serve_fleet.py --role driver \
         --gateway 127.0.0.1:8710 --requests 12 --rps 20
 
+Sharded tier (ISSUE 9): point every role at a shared registry instead
+of one gateway — gateways announce themselves and own a hash range,
+replicas poll every live gateway, the driver consistent-hashes request
+ids to their owner and rides out gateway deaths by resubmitting::
+
+    python examples/llama_serve_fleet.py --role gateway \
+        --registry 127.0.0.1:8700 --gateway_id g0     # and g1, ...
+    python examples/llama_serve_fleet.py --role replica \
+        --registry 127.0.0.1:8700 --replica_id r0 --journal_dir /tmp/j
+    python examples/llama_serve_fleet.py --role driver \
+        --registry 127.0.0.1:8700 --requests 12 --rps 20
+
 Every replica rebuilds the SAME seeded float32 tiny-llama
 (``serve_common``), so greedy decode is byte-identical across replicas
 — a re-dispatched request completes with exactly the tokens its first
@@ -40,7 +52,26 @@ def parse_args(argv=None):
     p.add_argument("--port", type=int, default=0,
                    help="(gateway) listen port; 0 = ephemeral")
     p.add_argument("--gateway", default="",
-                   help="(replica/driver) gateway host:port")
+                   help="(replica/driver) gateway host:port "
+                        "(single-gateway mode)")
+    p.add_argument("--registry", default="",
+                   help="shared registry host:port (a "
+                        "serving.RegistryServer or a master's KV): "
+                        "switches every role to the SHARDED TIER — "
+                        "gateways announce themselves, replicas poll "
+                        "every live gateway, drivers consistent-hash "
+                        "requests to their owner (ISSUE 9)")
+    p.add_argument("--job", default="fleet",
+                   help="(tier) registry namespace")
+    p.add_argument("--gateway_id", default="g0",
+                   help="(tier gateway) this gateway's id on the ring")
+    p.add_argument("--kv_relay", action="store_true",
+                   help="(gateway) force the prefill->decode KV "
+                        "segment through the gateway (the PR-8 relay "
+                        "plane) instead of peer-to-peer tickets")
+    p.add_argument("--no_kv_p2p", action="store_true",
+                   help="(replica) never publish KV segments "
+                        "peer-to-peer (always relay the payload)")
     p.add_argument("--replica_id", default="r0")
     p.add_argument("--replica_role", default="unified",
                    choices=("unified", "prefill", "decode"),
@@ -159,12 +190,15 @@ def build_replica(args, transport):
         poll_interval=args.poll_interval,
         round_floor_s=args.round_floor_ms / 1000.0,
         role=role,
+        kv_p2p=not getattr(args, "no_kv_p2p", False),
     )
 
 
-def drive(args, transport, core=None):
+def drive(args, transport, core=None, client=None):
     """Submit the seeded request stream at Poisson arrivals, poll every
-    result, print the summary line the tests and bench key on."""
+    result, print the summary line the tests and bench key on.
+    ``client`` overrides the transport-bound ServeClient (the tier
+    driver passes a consistent-hash-routing TierClient)."""
     import numpy as np
 
     from dlrover_tpu.models import llama
@@ -182,7 +216,8 @@ def drive(args, transport, core=None):
     arr_rng = np.random.RandomState(args.seed + 7)
     gaps = arr_rng.exponential(1.0 / max(args.rps, 1e-6),
                                size=args.requests)
-    client = ServeClient(transport)
+    if client is None:
+        client = ServeClient(transport)
     t0 = time.perf_counter()
     for i, prompt in enumerate(prompts):
         time.sleep(float(gaps[i]))
@@ -224,15 +259,42 @@ def main() -> int:
 
     ensure_platform()
 
-    if args.role == "gateway":
-        from dlrover_tpu.serving import Gateway, GatewayConfig
+    def tier_registry():
+        from dlrover_tpu.serving import RpcKv, ServeRegistry
 
-        gw = Gateway(port=args.port, config=GatewayConfig(
+        return ServeRegistry(
+            RpcKv(args.registry), job=args.job,
+            lease_s=args.lease_timeout,
+        )
+
+    if args.role == "gateway":
+        from dlrover_tpu.serving import (
+            Gateway,
+            GatewayConfig,
+            GatewayTierNode,
+        )
+
+        cfg = GatewayConfig(
             queue_cap=args.queue_cap,
             lease_timeout_s=args.lease_timeout,
-        ))
-        gw.start()
-        print(f"GATEWAY_READY port={gw.port}", flush=True)
+            kv_p2p=not args.kv_relay,
+        )
+        if args.registry:
+            node = GatewayTierNode(
+                args.gateway_id, tier_registry(), port=args.port,
+                config=cfg,
+            )
+            node.start()
+            gw = node.gateway
+            print(
+                f"GATEWAY_READY port={gw.port} id={args.gateway_id}",
+                flush=True,
+            )
+        else:
+            node = None
+            gw = Gateway(port=args.port, config=cfg)
+            gw.start()
+            print(f"GATEWAY_READY port={gw.port}", flush=True)
         stop = threading.Event()
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
         signal.signal(signal.SIGINT, lambda *_: stop.set())
@@ -248,23 +310,34 @@ def main() -> int:
                     "ttft_p95_ms": gw.ttft_ms.percentile(0.95),
                 }), flush=True,
             )
-        gw.stop()
+        if node is not None:
+            node.stop()
+        else:
+            gw.stop()
         return 0
 
     if args.role == "replica":
-        from dlrover_tpu.common.rpc import RpcClient
+        if args.registry:
+            from dlrover_tpu.serving import TierReplicaLink
 
-        class _T:
-            """RpcClient with the runner's best-effort budget."""
+            transport = TierReplicaLink(
+                tier_registry(), args.replica_id,
+            )
+        else:
+            from dlrover_tpu.common.rpc import RpcClient
 
-            def __init__(self, addr):
-                self._c = RpcClient(addr, timeout=5.0)
+            class _T:
+                """RpcClient with the runner's best-effort budget."""
 
-            def call(self, msg, **kw):
-                return self._c.call(msg, deadline=10.0,
-                                    idempotent=True, **kw)
+                def __init__(self, addr):
+                    self._c = RpcClient(addr, timeout=5.0)
 
-        runner = build_replica(args, _T(args.gateway))
+                def call(self, msg, **kw):
+                    return self._c.call(msg, deadline=10.0,
+                                        idempotent=True, **kw)
+
+            transport = _T(args.gateway)
+        runner = build_replica(args, transport)
         print(f"REPLICA_READY id={args.replica_id}", flush=True)
         runner.run()
         print(
@@ -274,6 +347,15 @@ def main() -> int:
         return 0
 
     if args.role == "driver":
+        if args.registry:
+            from dlrover_tpu.serving import TierClient
+
+            client = TierClient(tier_registry())
+            rc = drive(args, None, client=client)
+            print(
+                f"DRIVER_RESUBMITTED {client.resubmitted}", flush=True,
+            )
+            return rc
         from dlrover_tpu.common.rpc import RpcClient
 
         return drive(args, RpcClient(args.gateway, timeout=10.0))

@@ -222,7 +222,8 @@ def test_serve_bench_smoke_schema(tmp_path):
     routing = result["routing"]
     assert routing["prefix_len"] == 28 and routing["templates"] == 2
     rows = {r["mode"]: r for r in routing["rows"]}
-    assert set(rows) == {"least_loaded", "prefix", "disagg"}
+    assert set(rows) == {"least_loaded", "prefix", "disagg",
+                         "disagg_p2p"}
     for r in rows.values():
         assert r["completed"] == routing["requests"]
     # Fingerprints withheld = the router can't route on them.
@@ -232,17 +233,117 @@ def test_serve_bench_smoke_schema(tmp_path):
     assert pf["hits"] + pf["misses"] + pf["steals"] == \
         routing["requests"]
     assert pf["hits"] > 0
-    # Disagg: every request went through a KV handoff; the int8
-    # segment ships at under half the fp32 bytes.
+    # Disagg (relay plane): every request went through a KV handoff;
+    # the int8 segment moves at under half the fp32 bytes, THROUGH
+    # the gateway.
     kv = rows["disagg"]["kv"]
     assert kv["handoffs"] >= routing["requests"]
     assert kv["rejects"] == 0
     assert 0 < kv["bytes_over_fp32"] < 0.5
+    assert kv["bytes_shipped"] > 0 and kv["p2p_bytes"] == 0
     assert rows["disagg"]["pools"] == {"prefill": 1, "decode": 1}
+    # Disagg P2P (ISSUE 9): same handoffs, but the gateway relays
+    # ZERO segment bytes — only tickets — while the bytes move
+    # peer-to-peer at the same int8 ratio.
+    kvp = rows["disagg_p2p"]["kv"]
+    assert kvp["handoffs"] >= routing["requests"]
+    assert kvp["rejects"] == 0 and kvp["relay_fallbacks"] == 0
+    assert kvp["bytes_shipped"] == 0
+    assert kvp["p2p_bytes"] > 0
+    assert 0 < kvp["bytes_over_fp32"] < 0.5
     assert "prefix_vs_least_loaded" in routing
     metric = json.loads(proc.stdout.strip().splitlines()[-1])
     assert metric["metric"] == "serve_fleet_speedup"
     assert metric["artifact"] == str(out)
+
+
+def test_load_bench_smoke_schema(tmp_path):
+    """Tier-1 gate for ISSUE 9's open-loop load harness: the smoke
+    config (1-vs-2 paced in-process gateways, two sweep points
+    bracketing the modeled knee, one bursty + one diurnal phase
+    trace) runs end-to-end WITHOUT jax inside the budget and emits
+    schema-valid JSON — conservation across every point, a knee at
+    the single gateway, the >=1.5x tier verdict, per-phase TTFT, and
+    the admission-profile section with the measured serialization
+    fast-path delta."""
+    import os
+    import subprocess
+    import time
+
+    out = tmp_path / "LOAD_BENCH_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DLROVER_TPU_FAULTS", None)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(Path(bench.__file__)), "--load_bench",
+         "--smoke", f"--out={out}"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(Path(bench.__file__).parent),
+    )
+    elapsed = time.time() - t0
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert elapsed < 30.0, f"smoke load bench took {elapsed:.1f}s"
+    result = json.loads(out.read_text())["load"]
+    assert result["complete"] is True
+    assert result["bench"] == "serve_load"
+    # Sweep: 2 rates x 2 tier sizes, conservation at every point.
+    assert len(result["sweep"]) == 4
+    for p in result["sweep"]:
+        assert p["submitted"] == p["accepted"] + p["rejected"] \
+            + p["wire_dropped"]
+        assert p["accepted"] == p["completed"] + p["timeout"] \
+            + p["failed"]
+        assert p["ttft_ms_p99"] >= p["ttft_ms_p50"] > 0
+    over = [p for p in result["sweep"]
+            if p["gateways"] == 1
+            and p["offered_rps"] > result[
+                "est_single_gateway_knee_rps"]]
+    assert over and any(p["rejected"] > 0 for p in over), \
+        "single gateway never saturated past the knee"
+    # The tier verdict: 2 gateways sustain >=1.5x the single
+    # gateway's saturation admission throughput.
+    assert result["tier_speedup_gateways"] == 2
+    assert result["tier_speedup_x"] >= 1.5
+    assert result["meets_1p5x"] is True
+    assert set(result["saturation_admit_rps"]) == {"1", "2"}
+    # Phase traces: bursty + diurnal with per-phase TTFT.
+    traces = {t["trace"]: t for t in result["traces"]}
+    assert set(traces) == {"bursty", "diurnal"}
+    assert set(traces["bursty"]["phases"]) == {"burst", "idle"}
+    assert set(traces["diurnal"]["phases"]) == {"peak", "trough"}
+    for t in traces.values():
+        for ph in t["phases"].values():
+            assert ph["count"] > 0
+    # Admission profile + the serialization fast path it justifies.
+    prof = result["admission_profile"]
+    assert prof["messages"] > 0
+    assert 0 <= prof["serialize_frac_of_hot_loop"] <= 1
+    assert prof["fast_path_us"]["submit"] > 0
+    assert prof["baseline_us"]["submit"] >= \
+        prof["fast_path_us"]["submit"] * 0.8
+    assert result["serialize_speedup_x"] > 0
+    metric = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert metric["metric"] == "serve_tier_saturation_speedup"
+    assert metric["artifact"] == str(out)
+
+
+def test_load_bench_merges_into_existing_artifact(tmp_path):
+    """--load_bench owns only the `load` key: a prior serve_bench
+    artifact's sections survive the merge (and serve_bench preserves
+    `load` on its own rewrite — the two benches share one committed
+    file).  In-process with a micro config: this checks the merge
+    contract, not the measurement (the smoke gate above does that)."""
+    out = tmp_path / "SERVE.json"
+    out.write_text(json.dumps({"bench": "serve_fleet", "rows": [1]}))
+    bench.load_bench_main([
+        f"--out={out}", "--gateways=1", "--rates=80",
+        "--duration_s=0.2", "--replicas=1", "--slots=8",
+        "--drain_s=5.0",
+    ])
+    merged = json.loads(out.read_text())
+    assert merged["bench"] == "serve_fleet"
+    assert merged["rows"] == [1]
+    assert merged["load"]["bench"] == "serve_load"
 
 
 def test_reshard_bench_smoke_schema(tmp_path):

@@ -816,3 +816,169 @@ class TestDisaggKillMidHandoff:
                 except subprocess.TimeoutExpired:
                     proc.kill()
                     proc.wait()
+
+
+@pytest.mark.serving
+class TestGatewayKillFailover:
+    """ISSUE 9 flagship: a SHARDED gateway tier under a real process
+    tree — registry server in-test, two tier gateways and two
+    journaled replicas as subprocesses, a consistent-hash TierClient
+    driver.
+
+    ``serving.gateway_kill:method=g1,step_ge=2`` hard-kills gateway g1
+    (exit 81) at its first registry heartbeat after two requests
+    COMPLETED at it — deterministically mid-stream, seeded, no
+    wall-clock guess.  The failover law under test: g1's lease ages
+    out of the shared registry, the ring re-forms so the surviving
+    gateway adopts g1's hash range, the client resubmits every id it
+    never saw a result for, the replicas' fan-out link re-registers
+    and re-routes reports — and every admitted request completes
+    EXACTLY once: results for g1's orphaned ids arrive via the
+    adopting gateway (journal replay answering for already-decoded
+    work), and a second resubmit round returns byte-identical tokens
+    from the dedupe cache."""
+
+    def _spawn(self, tmp_path, name, argv, env_extra=None):
+        log = open(tmp_path / f"{name}.log", "w")
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "examples", "llama_serve_fleet.py"),
+             *argv],
+            cwd=REPO, env=_env(env_extra), stdout=log,
+            stderr=subprocess.STDOUT, start_new_session=True,
+        )
+        return proc, tmp_path / f"{name}.log"
+
+    def test_surviving_gateway_adopts_range_exactly_once(
+            self, tmp_path):
+        from dlrover_tpu.chaos.plan import EXIT_GATEWAY_KILL
+        from dlrover_tpu.serving import (
+            RegistryServer,
+            RpcKv,
+            ServeRegistry,
+            TierClient,
+        )
+
+        registry_server = RegistryServer()
+        journal_dir = str(tmp_path / "journals")
+        procs = []
+        try:
+            def spawn_gateway(gid, faults=None):
+                extra = {"DLROVER_TPU_FAULTS": faults} if faults \
+                    else None
+                proc, log = self._spawn(
+                    tmp_path, f"gateway-{gid}",
+                    ["--role", "gateway", "--registry",
+                     registry_server.addr, "--gateway_id", gid,
+                     "--lease_timeout", "2"],
+                    env_extra=extra,
+                )
+                procs.append(proc)
+                return proc, log
+
+            g0, _g0_log = spawn_gateway("g0")
+            g1, _g1_log = spawn_gateway(
+                "g1", "serving.gateway_kill:method=g1,step_ge=2,seed=7"
+            )
+
+            def spawn_replica(rid):
+                proc, log = self._spawn(
+                    tmp_path, f"replica-{rid}",
+                    ["--role", "replica", "--registry",
+                     registry_server.addr, "--lease_timeout", "2",
+                     "--replica_id", rid,
+                     "--slots", "2", "--max_len", "96",
+                     "--journal_dir", journal_dir,
+                     "--poll_interval", "0.02",
+                     "--round_floor_ms", "30"],
+                )
+                procs.append(proc)
+                return proc, log
+
+            spawn_replica("r0")
+            spawn_replica("r1")
+
+            registry = ServeRegistry(
+                RpcKv(registry_server.addr), job="fleet", lease_s=2.0,
+            )
+            cli = TierClient(registry, poll_interval=0.05,
+                             refresh_s=0.2)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                snaps = cli.stats()
+                if len(snaps) == 2 and all(
+                    s.get("replicas_alive", 0) >= 2 for s in snaps
+                ):
+                    break
+                time.sleep(0.5)
+            else:
+                pytest.fail("tier never became 2 gateways x 2 "
+                            "replicas")
+
+            # Wave 1 primes the kill trigger (g1 needs >= 2
+            # completions); wave 2's longer budgets keep work in
+            # flight across the death.  Prompts are the seeded
+            # deterministic stream, so every decode of one id yields
+            # identical tokens wherever it runs.
+            import numpy as np
+
+            rng = np.random.RandomState(3)
+            prompts = {
+                f"req-{i}": rng.randint(
+                    1, 64, size=(int(rng.randint(4, 10)),)
+                ).astype(int).tolist()
+                for i in range(12)
+            }
+            budgets = {}
+            for i, (rid, prompt) in enumerate(prompts.items()):
+                budgets[rid] = 6 if i < 4 else 24
+                ack = cli.submit(rid, prompt, budgets[rid],
+                                 submit_timeout=30)
+                assert ack.status in ("accepted", "done"), (rid, ack)
+                time.sleep(0.05)
+
+            # The chaos site must fire: g1 exits with the tier's
+            # dedicated code while the fleet still holds work.
+            try:
+                g1.wait(timeout=90)
+            except subprocess.TimeoutExpired:
+                pytest.fail("gateway g1 never chaos-killed")
+            assert g1.returncode == EXIT_GATEWAY_KILL
+
+            # Every admitted request reaches DONE through the
+            # survivor; ids orphaned at g1 arrive via failover
+            # resubmit + journal replay/dedupe.
+            tokens = {}
+            for rid in prompts:
+                reply = cli.result(rid, timeout=120)
+                assert reply.state == "done", (rid, reply)
+                assert len(reply.tokens) == budgets[rid], rid
+                tokens[rid] = list(reply.tokens)
+            assert cli.resubmitted >= 1  # failover actually exercised
+
+            # Exactly-once, proven from the outside: a full resubmit
+            # round answers every id from the dedupe cache,
+            # byte-identical — nothing re-decodes, nothing is lost.
+            snaps = cli.stats()
+            assert len(snaps) == 1  # only the survivor remains
+            completed_before = snaps[0]["counters"]["completed"]
+            for rid, prompt in prompts.items():
+                ack = cli.submit(rid, prompt, budgets[rid],
+                                 submit_timeout=30)
+                assert ack.status == "done", (rid, ack)
+                assert list(ack.tokens) == tokens[rid], rid
+            after = cli.stats()[0]["counters"]
+            assert after["completed"] == completed_before
+            assert after["dedupe_hits"] >= len(prompts)
+            assert g0.poll() is None  # the survivor is still up
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.send_signal(signal.SIGTERM)
+            for proc in procs:
+                try:
+                    proc.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+            registry_server.stop()

@@ -807,7 +807,8 @@ def core_handle(core):
                           msg.ok, msg.reason, msg.replayed)
         elif isinstance(msg, ServeKvReady):
             core.kv_ready(msg.replica_id, msg.req_id, msg.payload,
-                          msg.fp32_bytes)
+                          msg.fp32_bytes, msg.addr, msg.seg_fp,
+                          msg.crc32, msg.nbytes)
         elif isinstance(msg, ServeKvReject):
             core.kv_reject(msg.replica_id, msg.req_id, msg.reason)
         return None
@@ -831,19 +832,22 @@ def make_loopback_fleet(core, n=1, slots=2, tmp=None, poll=0.001):
 def make_disagg_fleet(core, prefill=1, decode=1, slots=2, tmp=None,
                       poll=0.001):
     """A disaggregated loopback fleet: prefill-role + decode-role
-    runners over fake servers."""
+    runners over fake servers.  kv_p2p=False keeps these units on the
+    relay plane and socket-free; the P2P plane has its own loopback
+    fleets in test_serving_tier.py."""
     transport = LoopbackTransport(core_handle(core))
     runners = []
     for i in range(prefill):
         runners.append(ReplicaRunner(
             FakePrefillServer(slots), transport, f"p{i}",
-            poll_interval=poll, role="prefill",
+            poll_interval=poll, role="prefill", kv_p2p=False,
         ))
     for i in range(decode):
         journal = f"{tmp}/d{i}.jsonl" if tmp else None
         runners.append(ReplicaRunner(
             FakeDecodeServer(slots), transport, f"d{i}",
             journal_path=journal, poll_interval=poll, role="decode",
+            kv_p2p=False,
         ))
     return runners
 
