@@ -2734,8 +2734,11 @@ def load_bench_main(argv: list) -> int:
     rates_override = None
     out_path = None
     smoke = False
+    calibrate = False
     for a in argv:
-        if a == "--smoke":
+        if a == "--calibrate":
+            calibrate = True
+        elif a == "--smoke":
             smoke = True
             opts.update(replicas=2, slots=32, duration_s=0.5,
                         drain_s=5.0, burst_period_s=0.4,
@@ -3259,6 +3262,142 @@ def load_bench_main(argv: list) -> int:
         with open(out_path, "w") as f:
             json.dump(full, f, indent=1)
 
+    def calibrate_gw_service() -> dict:
+        """ROADMAP 4c satellite: measure the REAL per-message admission
+        CPU of a gateway — a SUBPROCESS gateway over real sockets, fed
+        by the real TierClient/TierReplicaLink wire path — and record
+        it beside the modeled ``gw_service_us`` floor the paced
+        pipelines charge.  CPU is read from /proc/<pid>/stat
+        (utime+stime, whole process: deserialize + GatewayCore dispatch
+        + serialize + gRPC/socket work); the denominator is the
+        gateway's served-request counter, shipped in its stats snapshot
+        (``rpc_calls``).  Registry heartbeats (~1/s) ride inside the
+        measurement and are noted, not subtracted."""
+        import subprocess
+        import threading
+
+        from dlrover_tpu.serving import (
+            RegistryServer,
+            RpcKv,
+            ServeRegistry,
+            TierActuator,
+            TierClient,
+        )
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        n_req = 60 if smoke else 400
+        reg_server = RegistryServer()
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo})
+        env.pop("DLROVER_TPU_FAULTS", None)
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(repo, "examples", "llama_serve_fleet.py"),
+             "--role", "gateway", "--registry", reg_server.addr,
+             "--job", "calib", "--gateway_id", "cal0",
+             "--lease_timeout", "10"],
+            cwd=repo, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        registry = ServeRegistry(
+            RpcKv(reg_server.addr), job="calib", lease_s=10.0
+        )
+        link = TierReplicaLink(registry, "calrep")
+        runner = ReplicaRunner(
+            _StubDecodeServer(64, opts["mnt"]), link, "calrep",
+            poll_interval=0.005, kv_p2p=False,
+        )
+        cli = TierClient(registry, poll_interval=0.005, refresh_s=0.5)
+        clk = os.sysconf("SC_CLK_TCK")
+
+        def cpu_s():
+            with open(f"/proc/{proc.pid}/stat") as f:
+                parts = f.read().rsplit(") ", 1)[1].split()
+            return (int(parts[11]) + int(parts[12])) / clk
+
+        def gw_stats():
+            snaps = cli.stats()
+            return snaps[0] if snaps else {}
+
+        th = threading.Thread(target=runner.run, daemon=True)
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if registry.gateways():
+                    break
+                if proc.poll() is not None:
+                    return {"error":
+                            f"gateway exited rc={proc.returncode}"}
+                time.sleep(0.2)
+            else:
+                return {"error": "gateway never announced within 60s"}
+            th.start()
+            while time.monotonic() < deadline:
+                if gw_stats().get("replicas_alive", 0) >= 1:
+                    break
+                time.sleep(0.1)
+            else:
+                return {"error":
+                        "replica never registered at the gateway"}
+            # Warm the wire (channel setup, first-call paths), then
+            # measure a steady window.
+            for i in range(10):
+                cli.submit(f"warm-{i}", list(range(8)), opts["mnt"],
+                           submit_timeout=10)
+            for i in range(10):
+                cli.result(f"warm-{i}", timeout=30)
+            calls0 = int(gw_stats().get("rpc_calls", 0))
+            cpu0 = cpu_s()
+            t0 = time.perf_counter()
+            for i in range(n_req):
+                cli.submit(f"cal-{i}", list(range(8)), opts["mnt"],
+                           submit_timeout=10)
+            for i in range(n_req):
+                cli.result(f"cal-{i}", timeout=60)
+            wall = time.perf_counter() - t0
+            cpu1 = cpu_s()
+            calls1 = int(gw_stats().get("rpc_calls", 0))
+            msgs = calls1 - calls0
+            if msgs <= 0 or proc.poll() is not None:
+                return {"error": f"no messages measured ({msgs})"}
+            measured = (cpu1 - cpu0) * 1e6 / msgs
+            out = {
+                "requests": n_req,
+                "messages": msgs,
+                "gateway_cpu_s": round(cpu1 - cpu0, 3),
+                "wall_s": round(wall, 2),
+                "gw_service_us_measured": round(measured, 1),
+                "gw_service_us": opts["gw_service_us"],
+                "measured_over_modeled": round(
+                    measured / opts["gw_service_us"], 2
+                ),
+                "note": (
+                    "subprocess gateway over real sockets; CPU from "
+                    "/proc utime+stime across the window divided by "
+                    "the gateway's served-request count (submits, "
+                    "status polls, replica fan-out polls, reports); "
+                    "includes gRPC/socket CPU and ~1/s registry "
+                    "heartbeats"
+                ),
+            }
+            return out
+        finally:
+            try:
+                TierActuator(registry=registry).drain("calrep")
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+            runner._stopped = True  # noqa: SLF001 - bench teardown
+            th.join(timeout=15) if th.is_alive() else None
+            cli.close()
+            link.close()
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            reg_server.stop()
+
     flush()
     prof = profile_admission()
     result["admission_profile"] = prof
@@ -3266,6 +3405,11 @@ def load_bench_main(argv: list) -> int:
     base = prof["baseline_us"]["submit"]
     result["serialize_speedup_x"] = round(base / fast, 2) if fast else 0
     flush()
+
+    if calibrate:
+        result["calibration"] = calibrate_gw_service()
+        print(f"calibration: {result['calibration']}", file=sys.stderr)
+        flush()
 
     for n in gateways_rows:
         for rate in rates:
@@ -3342,6 +3486,426 @@ def load_bench_main(argv: list) -> int:
     return 0 if ok else 1
 
 
+def fleet_bench_main(argv: list) -> int:
+    """Mixed-fleet control-plane bench (ISSUE 10): ONE FleetManager
+    supervising a training role (real job manager + autoscaler over the
+    in-memory platform — control-plane stub workers, the container
+    cannot run multi-process XLA) AND a serving role (real-socket
+    gateway tier + drain-aware replicas) in one process, measuring the
+    two fleet laws end to end:
+
+    - SUPERVISED GATEWAY RELAUNCH: a crashed tier gateway (heartbeats
+      stop, registry entry ages out) is observed and respawned under
+      its own id; time from crash to the registry showing the full
+      desired set again, with in-flight requests completing
+      exactly-once through the churn.
+    - CROSS-ROLE BORROW: a sustained serving-queue spike borrows a
+      training chip (drain-first: the live-reshard epoch completes
+      BEFORE the worker is released, serving grows only after), and
+      the chip is handed back on decay (serving drains first).
+
+    Flags: ``--requests=N`` ``--interval=F`` (reconcile pass pacing)
+    ``--out=PATH`` (default FLEET_BENCH_CPU.json) ``--smoke``.
+    """
+    import os
+    import threading
+
+    from dlrover_tpu.common import messages as wire
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.fleet import (
+        BorrowPolicy,
+        ChipBorrowArbiter,
+        FleetManager,
+        GatewayRole,
+        RoleSpec,
+        ServingReplicaRole,
+        TrainingRole,
+    )
+    from dlrover_tpu.master import reshard as rs
+    from dlrover_tpu.master.dist_job_manager import DistributedJobManager
+    from dlrover_tpu.master.job_auto_scaler import (
+        AllreduceTrainingAutoScaler,
+    )
+    from dlrover_tpu.master.reshard import ReshardManager
+    from dlrover_tpu.master.scaler import PlatformScaler
+    from dlrover_tpu.master.speed_monitor import SpeedMonitor
+    from dlrover_tpu.scheduler.job import JobArgs, NodeGroupArgs
+    from dlrover_tpu.scheduler.platform import InMemoryPlatform
+    from dlrover_tpu.serving import (
+        GatewayTierNode,
+        RegistryServer,
+        ReplicaRunner,
+        RpcKv,
+        ServeRegistry,
+        TierActuator,
+        TierClient,
+        TierReplicaLink,
+    )
+    from dlrover_tpu.serving.autoscale import ScalePolicy
+    from dlrover_tpu.serving.gateway import GatewayConfig
+
+    t_start = time.perf_counter()
+    opts = {"requests": 24, "spike_requests": 40, "interval": 0.1,
+            "decode_ms": 200.0, "lease_s": 1.5, "seed": 0}
+    out_path = None
+    smoke = False
+    for a in argv:
+        if a == "--smoke":
+            smoke = True
+            opts.update(requests=8, spike_requests=30, decode_ms=150.0)
+        elif a.startswith("--out="):
+            out_path = a.split("=", 1)[1]
+        elif "=" in a and a.startswith("--"):
+            k, v = a[2:].split("=", 1)
+            if k in opts:
+                opts[k] = type(opts[k])(v)
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "FLEET_BENCH_CPU.json",
+        )
+
+    class _SlowDecode:
+        """Deterministic stub decode server with a real service time
+        per request, so queue pressure (the borrow signal) is real
+        while the measurement stays about the CONTROL PLANE."""
+
+        def __init__(self, slots, decode_s):
+            self.slots = slots
+            self.decode_s = decode_s
+            self._pending = []
+            self._mu = threading.Lock()
+
+        def submit(self, rid, prompt, mnt, **_kw):
+            with self._mu:
+                self._pending.append((rid, list(prompt), int(mnt)))
+
+        def cancel(self, rid):
+            with self._mu:
+                for i, item in enumerate(self._pending):
+                    if item[0] == rid:
+                        del self._pending[i]
+                        return True
+            return False
+
+        def pending_count(self):
+            with self._mu:
+                return len(self._pending)
+
+        def pending_rids(self):
+            with self._mu:
+                return [r for r, _, _ in self._pending]
+
+        def active_rids(self):
+            return []
+
+        def free_slots(self):
+            with self._mu:
+                return max(0, self.slots - len(self._pending))
+
+        def serve_incremental(self, tick=None, on_finish=None,
+                              on_token=None):
+            while True:
+                keep = tick() is not False if tick else True
+                with self._mu:
+                    batch = self._pending[: self.slots]
+                    self._pending = self._pending[self.slots:]
+                for rid, prompt, mnt in batch:
+                    time.sleep(self.decode_s)
+                    out = list(prompt)
+                    for i in range(mnt):
+                        tok = (len(prompt) + i) % 97
+                        out.append(tok)
+                        if on_token:
+                            on_token(rid, tok)
+                    if on_finish:
+                        on_finish(rid, out)
+                if not keep and not batch:
+                    return {}
+                if not batch:
+                    time.sleep(0.001)
+
+    reg_server = RegistryServer()
+    job = "fleetbench"
+
+    def new_registry():
+        return ServeRegistry(RpcKv(reg_server.addr), job=job,
+                             lease_s=opts["lease_s"])
+
+    # -- serving side: supervised gateway tier + replica role.
+    nodes = {}  # gid -> [GatewayTierNode incarnations]
+    node_mu = threading.Lock()
+
+    def spawn_gateway(gid):
+        node = GatewayTierNode(
+            gid, new_registry(), port=0,
+            # Replica lease well above the worst-case fan-out stall a
+            # dying peer gateway can inject into the SERIAL poll loop
+            # (the replica is not dead, its poll is late).
+            config=GatewayConfig(lease_timeout_s=15.0),
+            heartbeat_s=0.3,
+        )
+        node.start()
+        with node_mu:
+            nodes.setdefault(gid, []).append(node)
+
+    runners = []  # (runner, thread)
+
+    def spawn_replica(n=1, role=None):
+        for _ in range(n):
+            rid = f"r{len(runners)}"
+            runner = ReplicaRunner(
+                _SlowDecode(1, opts["decode_ms"] / 1000.0),
+                TierReplicaLink(new_registry(), rid), rid,
+                poll_interval=0.01, kv_p2p=False,
+            )
+            th = threading.Thread(target=runner.run, daemon=True)
+            th.start()
+            runners.append((runner, th))
+
+    actuator = TierActuator(registry=new_registry())
+
+    # -- training side: real manager/scaler/reshard epoch.
+    job_args = JobArgs(job_name=job)
+    job_args.node_groups[NodeType.WORKER] = NodeGroupArgs(
+        count=3, min_count=2, max_count=4
+    )
+    platform = InMemoryPlatform()
+    jm = DistributedJobManager(
+        job_args, platform, PlatformScaler(job, platform)
+    )
+    jm.start()
+    rm = ReshardManager()
+    scaler = AllreduceTrainingAutoScaler(
+        job_args, jm, SpeedMonitor(), None, reshard_manager=rm
+    )
+
+    # -- ONE fleet.
+    fleet = FleetManager(interval=999)
+    t_role = fleet.add_role(TrainingRole(
+        RoleSpec("training", desired=3, min_count=2, max_count=4),
+        scaler, jm,
+    ))
+    fleet.add_role(GatewayRole(
+        RoleSpec("gateway", desired=2, min_count=1, max_count=3),
+        new_registry(), spawn_gateway, id_prefix="g",
+    ))
+    s_role = fleet.add_role(ServingReplicaRole(
+        RoleSpec("serving", desired=2, min_count=1, max_count=4,
+                 # The merged membership view can flicker for a pass
+                 # while a crashed gateway's lease ages out — a blip
+                 # must not add real capacity.
+                 spawn_confirm_passes=3),
+        actuator, spawn_replica,
+        policy=ScalePolicy(up_patience=10**9, down_patience=10**9),
+    ))
+    arbiter = fleet.add_cross_policy(ChipBorrowArbiter(
+        t_role, s_role,
+        BorrowPolicy(queue_high_per_member=4.0, spike_patience=2,
+                     queue_low_per_member=1.0, decay_patience=3,
+                     cooldown_passes=2),
+    ))
+
+    def drive(cond, timeout, report_done=False):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            rm.info()  # stub workers poll the resize epoch
+            if report_done and rm.status == rs.PREPARING:
+                epoch = rm.epoch
+                for node_id in range(3):
+                    rm.report(wire.ReshardReport(
+                        node_id=node_id, epoch=epoch, ok=True,
+                        downtime_ms=5.0, moved_mb=1.0,
+                    ))
+            fleet.reconcile_once()
+            time.sleep(opts["interval"])
+        return cond()
+
+    result = {
+        "bench": "fleet",
+        "smoke": smoke,
+        "note": (
+            "one FleetManager, three roles: training (real job "
+            "manager + allreduce scaler + live-reshard epoch over the "
+            "in-memory platform — control-plane stub workers, this "
+            "container cannot run multi-process XLA), a supervised "
+            "gateway tier (real sockets, registry-leased health) and "
+            "drain-aware serving replicas (stub decode with a real "
+            "per-request service time).  Exactly-once is judged from "
+            "the CLIENT: every submitted id reaches done with "
+            "deterministic tokens across gateway churn."
+        ),
+        "params": dict(opts),
+        "complete": False,
+    }
+
+    def flush():
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+
+    cli = TierClient(new_registry(), poll_interval=0.02, refresh_s=0.3)
+    rc = 1
+    try:
+        # -- formation: every role reaches its desired shape.
+        t0 = time.perf_counter()
+        ok = drive(
+            lambda: len(cli.stats()) == 2
+            and actuator.stats_snapshot()["replicas_alive"] >= 2
+            and len(jm.alive_workers()) == 3,
+            timeout=60,
+        )
+        result["formation_s"] = round(time.perf_counter() - t0, 2)
+        result["formation_ok"] = ok
+        flush()
+        if not ok:
+            print("fleet bench: formation failed", file=sys.stderr)
+            return 1
+
+        # -- steady traffic, then CRASH g1 with work in flight.
+        submitted = {}
+        for i in range(opts["requests"]):
+            rid = f"req-{i}"
+            prompt = [(7 * i + j) % 50 + 1 for j in range(6)]
+            submitted[rid] = prompt
+            cli.submit(rid, prompt, 4, submit_timeout=30)
+        with node_mu:
+            victim = nodes["g1"][0]
+        crash_t = time.perf_counter()
+        victim.crash()
+
+        def tier_restored():
+            if len(nodes.get("g1", [])) < 2:
+                return False
+            gids = {s.get("gateway_id") for s in cli.stats()}
+            return gids == {"g0", "g1"}
+
+        ok = drive(tier_restored, timeout=60)
+        relaunch_s = time.perf_counter() - crash_t
+        done = 0
+        for rid in submitted:
+            reply = cli.result(rid, timeout=60)
+            done += reply.state == "done"
+        result["gateway_relaunch"] = {
+            "relaunched": ok,
+            "crash_to_restored_s": round(relaunch_s, 2),
+            "incarnations_g1": len(nodes.get("g1", [])),
+            "inflight_total": len(submitted),
+            "inflight_completed": done,
+            "client_resubmitted": cli.resubmitted,
+        }
+        flush()
+
+        # -- borrow cycle: spike -> drain-first lend -> grow; decay ->
+        # drain-first shrink -> reclaim.
+        workers_before = len(jm.alive_workers())
+        replicas_before = actuator.stats_snapshot()["replicas_alive"]
+        spike_ids = []
+        spike_t = time.perf_counter()
+        for i in range(opts["spike_requests"]):
+            rid = f"spike-{i}"
+            spike_ids.append(rid)
+            cli.submit(rid, [1, 2, 3, 4], 2, submit_timeout=30)
+        ok_borrow = drive(
+            lambda: arbiter.phase == "borrowed", timeout=90,
+            report_done=True,
+        )
+        borrow_s = time.perf_counter() - spike_t
+        workers_during = len(jm.alive_workers())
+        replicas_during = actuator.stats_snapshot()["replicas_alive"]
+        # Decay: the (now larger) pool drains the spike queue.
+        handback_t = time.perf_counter()
+        ok_back = drive(
+            lambda: arbiter.phase == "idle"
+            and len(jm.alive_workers()) == workers_before,
+            timeout=120,
+        )
+        handback_s = time.perf_counter() - handback_t
+        spike_done = 0
+        for rid in spike_ids:
+            reply = cli.result(rid, timeout=60)
+            spike_done += reply.state == "done"
+        result["borrow"] = {
+            "borrowed": ok_borrow,
+            "handed_back": ok_back,
+            "time_to_borrow_s": round(borrow_s, 2),
+            "time_to_handback_s": round(handback_s, 2),
+            "reshard_status": rm.status,
+            "workers_before": workers_before,
+            "workers_during_borrow": workers_during,
+            "workers_after": len(jm.alive_workers()),
+            "replicas_before": replicas_before,
+            "replicas_during_borrow": replicas_during,
+            "replicas_after":
+                actuator.stats_snapshot()["replicas_alive"],
+            "spike_completed": spike_done,
+            "spike_total": len(spike_ids),
+            "transitions": [t for _f, t, _r in arbiter.events],
+        }
+        result["requests"] = {
+            "submitted": len(submitted) + len(spike_ids),
+            "completed": done + spike_done,
+        }
+        result["complete"] = bool(
+            result["formation_ok"]
+            and result["gateway_relaunch"]["relaunched"]
+            and done == len(submitted)
+            and ok_borrow and ok_back
+            and spike_done == len(spike_ids)
+            and rm.status == rs.DONE
+        )
+        result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        flush()
+        print(json.dumps({
+            "metric": "fleet_gateway_relaunch_s",
+            "value": result["gateway_relaunch"]["crash_to_restored_s"],
+            "unit": "s_crash_to_desired_restored",
+            "vs_baseline": 0.0,
+            "backend": "cpu",
+            "artifact": out_path,
+        }))
+        rc = 0 if result["complete"] else 1
+        return rc
+    finally:
+        # Each teardown step stands alone: a failure (e.g. draining
+        # against an already-dead registry) must not skip the stops
+        # below it — a leaked gRPC server would wedge the process past
+        # the smoke gate's subprocess timeout.
+        def _teardown(step):
+            try:
+                step()
+            except Exception:  # noqa: BLE001 - teardown must not mask rc
+                print("fleet bench teardown step failed",
+                      file=sys.stderr)
+
+        def _drain_all():
+            for rid in list(
+                actuator.stats_snapshot().get("replicas", {})
+            ):
+                actuator.drain(rid)
+
+        def _stop_runners():
+            for runner, _th in runners:
+                runner._stopped = True  # noqa: SLF001 - bench teardown
+            for _runner, th in runners:
+                th.join(timeout=10)
+
+        def _stop_nodes():
+            with node_mu:
+                for incs in nodes.values():
+                    for node in incs:
+                        _teardown(lambda n=node: n.stop(0.0))
+
+        _teardown(_drain_all)
+        _teardown(_stop_runners)
+        _teardown(cli.close)
+        _teardown(actuator.close)
+        _teardown(_stop_nodes)
+        _teardown(jm.stop)
+        _teardown(reg_server.stop)
+
+
 def _measure_one_cmd(argv: list) -> int:
     if len(argv) != 1:
         print("usage: bench.py --measure-one SPEC_PATH", file=sys.stderr)
@@ -3359,6 +3923,7 @@ SUBCOMMANDS = {
     "--serve_bench": serve_bench_main,
     "--load_bench": load_bench_main,
     "--reshard_bench": reshard_bench_main,
+    "--fleet_bench": fleet_bench_main,
 }
 
 

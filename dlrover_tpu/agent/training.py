@@ -60,6 +60,10 @@ class ElasticLaunchConfig:
     log_dir: str = ""
     job_name: str = "local-job"
     slice_id: str = ""
+    #: Fleet role of this node (ISSUE 10): the master's job manager
+    #: files it under the matching node group (worker / gateway /
+    #: embedding) so one ElasticJob can launch heterogeneous roles.
+    node_role: str = "worker"
 
     def auto_configure(self) -> None:
         """Fill derived params from env (chips per host etc.)."""
@@ -136,7 +140,10 @@ class ElasticTrainingAgent:
         exhausts its RPC retries (master restarting, network flap) must
         never take down the agent that is supposed to survive it."""
         try:
-            self.client.report_node_status(status, exit_reason=exit_reason)
+            self.client.report_node_status(
+                status, node_type=self.config.node_role or "worker",
+                exit_reason=exit_reason,
+            )
         except Exception as e:  # noqa: BLE001
             logger.warning(
                 "status report %r failed (continuing): %s", status, e
@@ -202,8 +209,53 @@ class ElasticTrainingAgent:
         last_join = 0.0
         join_failures = 0
 
+        if cfg.node_role not in ("worker", "chief"):
+            # Service roles (gateway / embedding store, ISSUE 10)
+            # register for supervision + heartbeats but must NOT join
+            # the training rendezvous — they have no place in the XLA
+            # mesh, and a join would count them into the world size.
+            # Their "world" is themselves.
+            while True:
+                try:
+                    self.client.register_node(
+                        node_type=cfg.node_role,
+                        node_rank=cfg.node_rank,
+                        host=self._host,
+                        agent_port=coord_port,
+                        slice_id=cfg.slice_id,
+                        local_world_size=cfg.nproc_per_node,
+                    )
+                    break
+                except Exception as e:  # noqa: BLE001
+                    if time.time() >= deadline:
+                        # Same contract as the worker path's rendezvous
+                        # timeout: an agent that never registered must
+                        # NOT launch an unsupervised orphan (the fleet
+                        # reconciler would spawn a duplicate beside it).
+                        raise TimeoutError(
+                            f"{cfg.node_role}-role registration did "
+                            f"not succeed within {cfg.rdzv_timeout}s"
+                        ) from e
+                    logger.warning(
+                        "%s-role registration failed (will retry): %s",
+                        cfg.node_role, e,
+                    )
+                    time.sleep(1.0)
+            return {
+                "round": 0,
+                "world": {0: {
+                    "node_id": cfg.node_id,
+                    "local_world_size": cfg.nproc_per_node,
+                    "process_id_base": 0,
+                }},
+                "my_rank": 0,
+                "coordinator": "",
+                "num_processes": cfg.nproc_per_node,
+            }
+
         def _join() -> None:
             self.client.register_node(
+                node_type=cfg.node_role,
                 node_rank=cfg.node_rank,
                 host=self._host,
                 agent_port=coord_port,
@@ -353,6 +405,7 @@ class ElasticTrainingAgent:
             env["DLROVER_TPU_LOCAL_RANK"] = str(lr)
             env["DLROVER_TPU_LOCAL_WORLD_SIZE"] = str(cfg.nproc_per_node)
             env["DLROVER_TPU_RDZV_ROUND"] = str(world_info["round"])
+            env["DLROVER_TPU_NODE_ROLE"] = cfg.node_role or "worker"
             log_file = None
             stdout = stderr = None
             if cfg.log_dir:

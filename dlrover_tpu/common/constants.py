@@ -24,6 +24,9 @@ class NodeType:
     EVALUATOR = "evaluator"
     # Host-side sparse embedding store servers (TFPlus KvVariable analogue).
     EMBEDDING = "embedding"
+    # Serving front-door gateways supervised as a fleet role (ISSUE 10):
+    # spawned/relaunched by the job manager, health = serve-registry lease.
+    GATEWAY = "gateway"
 
 
 class NodeStatus:

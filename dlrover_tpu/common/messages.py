@@ -856,6 +856,20 @@ class ServeFleetStats(Message):
     stats: dict = dataclasses.field(default_factory=dict)
 
 
+@dataclasses.dataclass
+class FleetStatsRequest(Message):
+    """Fleet control-plane view (ISSUE 10): per-role desired/observed
+    membership, drains in flight and cross-role policy phases."""
+
+    pass
+
+
+@dataclasses.dataclass
+class FleetStats(Message):
+    roles: dict = dataclasses.field(default_factory=dict)
+    policies: list = dataclasses.field(default_factory=list)
+
+
 # ---------------------------------------------------------------------------
 # Embedding store service (PS analogue; reference tfplus KvVariable serving)
 # ---------------------------------------------------------------------------

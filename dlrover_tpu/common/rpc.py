@@ -15,6 +15,7 @@ token the master dedupes on) additionally retry DEADLINE_EXCEEDED.
 
 from __future__ import annotations
 
+import itertools
 import random
 import socket
 import threading
@@ -109,6 +110,11 @@ class RpcServer:
         self._handler = handler
         self._port = port
         self._host = host
+        #: Requests served (monotone; itertools.count is GIL-atomic).
+        #: The load-bench calibration divides a process's measured CPU
+        #: by this to get real per-message admission cost.
+        self._calls = itertools.count()
+        self._calls_now = 0
         self._server = grpc.server(
             futures.ThreadPoolExecutor(
                 max_workers=max_workers, thread_name_prefix="rpc"
@@ -117,6 +123,7 @@ class RpcServer:
         )
 
         def _unary(request: bytes, context) -> bytes:
+            self._calls_now = next(self._calls) + 1
             try:
                 msg = deserialize(request)
             except Exception as e:  # noqa: BLE001 - control plane stays up
@@ -155,6 +162,11 @@ class RpcServer:
     @property
     def port(self) -> int:
         return self._bound_port
+
+    @property
+    def calls(self) -> int:
+        """Requests served so far (including failed dispatches)."""
+        return self._calls_now
 
     def start(self) -> None:
         self._server.start()

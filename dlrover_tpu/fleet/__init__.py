@@ -1,0 +1,50 @@
+"""One role/fleet control plane for training, serving, gateway and
+embedding roles (ISSUE 10 / ROADMAP item 5).
+
+- :mod:`dlrover_tpu.fleet.role` — the contract: :class:`RoleSpec`
+  (desired count, floors/ceilings, relaunch budget),
+  :class:`RoleStatus` (one observation), :class:`RoleAdapter` (spawn /
+  observe / drain-first shrink / borrow surface).
+- :mod:`dlrover_tpu.fleet.roles` — the four families migrated onto
+  it: training workers (the allreduce scaler's optimizer walk +
+  live-reshard hold run unchanged), serving replicas (single-gateway
+  or merged multi-gateway tier view, per-role sub-pools), gateways as
+  a SUPERVISED role (registry-leased health, relaunch under the same
+  id re-adopts the dead ring ranges), embedding stores.
+- :mod:`dlrover_tpu.fleet.manager` — :class:`FleetManager`, the
+  reconciler pumping every role once per pass, then the cross-role
+  policies; :func:`build_job_fleet` composes one for a mixed
+  ElasticJob.
+- :mod:`dlrover_tpu.fleet.policy` — :class:`ChipBorrowArbiter`: a
+  sustained serving-queue spike borrows a chip from training,
+  drain-first in both directions.
+- :mod:`dlrover_tpu.fleet.registry` — role-family factories: how
+  ``distribution_strategy`` resolves to a scaler.
+
+Everything here is jax-free pure control plane.
+"""
+
+from dlrover_tpu.fleet.manager import (  # noqa: F401
+    FleetManager,
+    build_job_fleet,
+)
+from dlrover_tpu.fleet.policy import (  # noqa: F401
+    BorrowPolicy,
+    ChipBorrowArbiter,
+)
+from dlrover_tpu.fleet.registry import (  # noqa: F401
+    register_role_family,
+    resolve_job_scaler,
+    role_families,
+)
+from dlrover_tpu.fleet.role import (  # noqa: F401
+    RoleAdapter,
+    RoleSpec,
+    RoleStatus,
+)
+from dlrover_tpu.fleet.roles import (  # noqa: F401
+    EmbeddingRole,
+    GatewayRole,
+    ServingReplicaRole,
+    TrainingRole,
+)

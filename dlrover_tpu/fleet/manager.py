@@ -1,0 +1,223 @@
+"""FleetManager: one reconciler supervising N heterogeneous roles.
+
+The fleet pass is deliberately boring — that is the point.  Each role
+adapter owns its family's machinery (the training scaler's optimizer
+walk and live-reshard hold, the serving drain two-phase, the gateway
+registry lease); the manager just pumps every role once per interval
+and then runs the cross-role policies (the borrow arbiter) over the
+uniform surface.  Nothing here knows what a worker, replica or gateway
+*is* — which is exactly what lets a single ElasticJob run all of them.
+
+The manager also duck-types the :class:`JobAutoScaler` interface
+(``start_auto_scaling`` / ``stop_auto_scaling``) so the master can slot
+it where a single-role scaler goes today.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common.global_context import get_context
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.fleet.role import RoleAdapter
+
+
+class FleetManager:
+    def __init__(self, interval: Optional[float] = None):
+        self._roles: Dict[str, RoleAdapter] = {}
+        self._policies: List[Any] = []  # objects with .step(fleet)
+        self._interval = interval or get_context().scale_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._mu = threading.Lock()
+        #: Audit trail of reconcile outcomes: (pass_no, role, delta).
+        self.events: List[tuple] = []
+        self._passes = 0
+
+    # -- composition --------------------------------------------------------
+
+    def add_role(self, adapter: RoleAdapter) -> RoleAdapter:
+        with self._mu:
+            if adapter.name in self._roles:
+                raise ValueError(f"role {adapter.name!r} already added")
+            self._roles[adapter.name] = adapter
+        logger.info(
+            "fleet: role %s added (desired=%d, [%d, %d])",
+            adapter.name, adapter.spec.desired,
+            adapter.spec.min_count, adapter.spec.max_count,
+        )
+        return adapter
+
+    def role(self, name: str) -> RoleAdapter:
+        with self._mu:
+            return self._roles[name]
+
+    def roles(self) -> Dict[str, RoleAdapter]:
+        with self._mu:
+            return dict(self._roles)
+
+    def add_cross_policy(self, policy) -> Any:
+        """A cross-role policy: ``step(fleet)`` once per pass, AFTER
+        every role reconciled (it sees a current view and its
+        desired-count movements take effect next pass)."""
+        with self._mu:
+            self._policies.append(policy)
+        return policy
+
+    # -- the pass ------------------------------------------------------------
+
+    def reconcile_once(self) -> Dict[str, int]:
+        """One fleet pass; returns role -> applied delta."""
+        deltas: Dict[str, int] = {}
+        with self._mu:
+            roles = list(self._roles.items())
+            policies = list(self._policies)
+            self._passes += 1
+            n = self._passes
+        for name, adapter in roles:
+            try:
+                delta = int(adapter.reconcile() or 0)
+            except Exception:
+                logger.exception("fleet: role %s reconcile failed", name)
+                delta = 0
+            deltas[name] = delta
+            if delta:
+                with self._mu:
+                    self.events.append((n, name, delta))
+        for policy in policies:
+            try:
+                policy.step(self)
+            except Exception:
+                logger.exception(
+                    "fleet: cross-role policy %s failed",
+                    type(policy).__name__,
+                )
+        return deltas
+
+    # -- views ---------------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """Fleet summary (the servicer's ``FleetStatsRequest`` body)."""
+        out: Dict[str, Any] = {"roles": {}, "policies": []}
+        for name, adapter in self.roles().items():
+            try:
+                out["roles"][name] = adapter.summary()
+            except Exception as e:  # noqa: BLE001 - a sick role must not
+                # blind the whole fleet view
+                out["roles"][name] = {"error": str(e)}
+        with self._mu:
+            for policy in self._policies:
+                desc = getattr(policy, "describe", None)
+                out["policies"].append(
+                    desc() if callable(desc) else type(policy).__name__
+                )
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="fleet-manager", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # JobAutoScaler duck surface: the master can treat the fleet
+    # manager exactly like a single-role scaler.
+    start_auto_scaling = start
+    stop_auto_scaling = stop
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.reconcile_once()
+            except Exception:
+                logger.exception("fleet reconcile pass failed")
+
+
+def build_job_fleet(
+    job_args,
+    job_manager,
+    auto_scaler,
+    kv_store=None,
+    gateway_spawn_fn=None,
+) -> Optional[FleetManager]:
+    """Compose a FleetManager for a MIXED ElasticJob (a ``gateway``
+    node group beside the workers, or an embedding fleet riding a
+    training job).  Returns ``None`` for plain single-role jobs — the
+    master then runs the resolved scaler directly, exactly as before
+    this layer existed.
+
+    The training role wraps the already-built ``auto_scaler`` (the
+    same object, so starting the fleet INSTEAD of the scaler thread
+    never double-actuates); the gateway role rides the serve registry
+    in the master's own KV store (``serve/{job}/gw/...`` — where tier
+    gateways already announce), spawning via ``gateway_spawn_fn`` or
+    the job manager's gateway node group."""
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.fleet.roles import GatewayRole, TrainingRole
+    from dlrover_tpu.master.job_auto_scaler import (
+        AllreduceTrainingAutoScaler,
+    )
+    from dlrover_tpu.fleet.role import RoleSpec
+
+    gw_group = job_args.node_groups.get(NodeType.GATEWAY)
+    if gw_group is None or gw_group.count <= 0 or kv_store is None:
+        return None
+    fleet = FleetManager()
+    if isinstance(auto_scaler, AllreduceTrainingAutoScaler):
+        workers = job_args.workers
+        fleet.add_role(TrainingRole(
+            RoleSpec(
+                name="training",
+                desired=workers.count,
+                min_count=workers.min_count,
+                max_count=workers.max_count,
+            ),
+            auto_scaler, job_manager,
+        ))
+    from dlrover_tpu.serving.tier import ServeRegistry
+
+    registry = ServeRegistry(kv_store, job=job_args.job_name)
+    gw_role = GatewayRole(
+        RoleSpec(
+            name="gateway",
+            desired=gw_group.count,
+            min_count=gw_group.min_count,
+            max_count=gw_group.max_count,
+            relaunch_limit=gw_group.restart_count,
+        ),
+        registry, gateway_spawn_fn or (lambda gid: None),
+        id_prefix="gw",
+    )
+    if gateway_spawn_fn is None:
+        # Platform spawn is COUNT-idempotent: ask the job manager for
+        # the role's desired node count (the process-level relaunch
+        # ladder owns per-node replacement; the registry lease owns
+        # announce-level health).  A per-gid spawn here would grow
+        # platform nodes unboundedly while a sick gateway process
+        # never announces.
+        def _spawn(gid, _jm=job_manager, _role=gw_role):
+            _jm.scale_role_to(NodeType.GATEWAY, _role.spec.desired)
+
+        # Graceful shrink actually STOPS a process: drop the platform
+        # node count by one (highest rank — matching the role's pick
+        # of the highest-sorted gid); registry-only removal would race
+        # the live gateway's heartbeat and time the drain out.
+        def _stop(gid, _jm=job_manager):
+            live = len(_jm.alive_nodes_of(NodeType.GATEWAY)) + len(
+                _jm.pending_nodes_of(NodeType.GATEWAY)
+            )
+            _jm.scale_role_to(NodeType.GATEWAY, max(0, live - 1))
+
+        gw_role._spawn_fn = _spawn
+        gw_role._stop_fn = _stop
+    fleet.add_role(gw_role)
+    return fleet

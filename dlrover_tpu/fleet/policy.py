@@ -1,0 +1,186 @@
+"""Cross-role policies: decisions no single role can make.
+
+The first one is the ROADMAP item-5 flagship: a sustained serving
+queue spike BORROWS a chip from the co-scheduled training role.  Both
+directions are drain-first —
+
+- borrow: the TRAINING role drains first (two-phase resize; the PR-6
+  live-reshard path moves the leaving ranks' state mesh-to-mesh when
+  eligible, the restart ladder otherwise) and the serving role grows
+  only after the lender's drain completed — the chip is genuinely free
+  before anything new is scheduled onto it;
+- hand-back: the SERVING role drains first (the gateway two-phase: the
+  borrowed replica stops being granted work, finishes in flight,
+  deregisters) and training reclaims only after the drain completed.
+
+Spike/decay detection is hysteretic (patience counters, the
+``autoscale.decide`` shape) so a bursty queue cannot flap chips back
+and forth, and a cooldown separates consecutive borrows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.fleet.role import RoleAdapter
+
+IDLE = "idle"
+LENDING = "lending"          # lender draining (training reshard/restart)
+BORROWED = "borrowed"        # chip moved; serving grew
+RECLAIMING = "reclaiming"    # borrower draining (gateway two-phase)
+
+
+@dataclasses.dataclass
+class BorrowPolicy:
+    #: Spike: borrower queue depth per alive member above this ...
+    queue_high_per_member: float = 8.0
+    #: ... for this many consecutive passes.
+    spike_patience: int = 3
+    #: Decay: queue per member below this ...
+    queue_low_per_member: float = 1.0
+    #: ... for this many consecutive passes hands the chip back.
+    decay_patience: int = 5
+    #: Units on loan at once (drains are serialized anyway).
+    max_borrow: int = 1
+    #: Passes to sit idle after a full borrow/hand-back cycle.
+    cooldown_passes: int = 3
+
+
+class ChipBorrowArbiter:
+    """Lender/borrower state machine over the uniform role surface.
+
+    ``signal_fn`` returns the borrower's load view (defaults to the
+    borrower's observed signals): needs ``queue_depth`` and the alive
+    member count.  ``step`` runs once per fleet pass (wired via
+    :meth:`FleetManager.add_cross_policy`)."""
+
+    def __init__(
+        self,
+        lender: RoleAdapter,
+        borrower: RoleAdapter,
+        policy: Optional[BorrowPolicy] = None,
+        signal_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+    ):
+        self.lender = lender
+        self.borrower = borrower
+        self.policy = policy or BorrowPolicy()
+        self._signal_fn = signal_fn
+        self.phase = IDLE
+        self.borrowed = 0
+        self._spike_streak = 0
+        self._decay_streak = 0
+        self._cooldown = 0
+        #: Audit trail: (phase_from, phase_to, reason) transitions.
+        self.events: List[tuple] = []
+
+    # -- signals ------------------------------------------------------------
+
+    def _signals(self) -> Dict[str, Any]:
+        if self._signal_fn is not None:
+            return self._signal_fn()
+        status = self.borrower.observe()
+        sig = dict(status.signals)
+        sig.setdefault("members_alive", len(status.members))
+        return sig
+
+    def _queue_per_member(self) -> float:
+        sig = self._signals()
+        alive = max(
+            1,
+            int(sig.get("members_alive")
+                or len(self.borrower.observe().members) or 1),
+        )
+        return float(sig.get("queue_depth", 0)) / alive
+
+    # -- the pass ------------------------------------------------------------
+
+    def step(self, fleet=None) -> str:
+        qpm = self._queue_per_member()
+        if qpm > self.policy.queue_high_per_member:
+            self._spike_streak += 1
+            self._decay_streak = 0
+        elif qpm < self.policy.queue_low_per_member:
+            self._decay_streak += 1
+            self._spike_streak = 0
+        else:
+            self._spike_streak = 0
+            self._decay_streak = 0
+
+        if self.phase == IDLE:
+            if self._cooldown > 0:
+                self._cooldown -= 1
+            elif (
+                self._spike_streak >= self.policy.spike_patience
+                and self.borrowed < self.policy.max_borrow
+                # The borrower must have HEADROOM before the lender
+                # drains anything: a chip released toward a role
+                # already at max_count would be pure waste.
+                and self.borrower.spec.desired
+                < self.borrower.spec.max_count
+                and self.lender.can_lend()
+            ):
+                if self.lender.lend_one():
+                    self._move(
+                        LENDING,
+                        f"queue/member {qpm:.1f} > "
+                        f"{self.policy.queue_high_per_member} for "
+                        f"{self._spike_streak} passes",
+                    )
+                    self._spike_streak = 0
+        elif self.phase == LENDING:
+            if not self.lender.lend_pending():
+                # The lender's drain protocol completed: the chip is
+                # free.  Only NOW does the borrower grow onto it.
+                if not self.borrower.grow_one():
+                    # Headroom vanished while the lender drained (a
+                    # concurrent policy grow): don't strand the chip —
+                    # hand it straight back.
+                    logger.warning(
+                        "fleet borrow: borrower %s refused the grow "
+                        "(at max?); reclaiming the lent chip",
+                        self.borrower.name,
+                    )
+                    self.lender.reclaim_one()
+                    self._cooldown = self.policy.cooldown_passes
+                    self._move(IDLE, "borrower grow refused; reclaimed")
+                    return self.phase
+                self.borrowed += 1
+                self._move(BORROWED, "lender drain complete")
+        elif self.phase == BORROWED:
+            if self._decay_streak >= self.policy.decay_patience:
+                # Hand-back begins with the BORROWER's drain protocol.
+                if self.borrower.shrink_one():
+                    self._move(
+                        RECLAIMING,
+                        f"queue/member {qpm:.1f} < "
+                        f"{self.policy.queue_low_per_member} for "
+                        f"{self._decay_streak} passes",
+                    )
+                    self._decay_streak = 0
+        elif self.phase == RECLAIMING:
+            if not self.borrower.drain_pending():
+                self.lender.reclaim_one()
+                self.borrowed -= 1
+                self._cooldown = self.policy.cooldown_passes
+                self._move(IDLE, "borrower drain complete; reclaimed")
+        return self.phase
+
+    def _move(self, phase: str, reason: str) -> None:
+        logger.info(
+            "fleet borrow [%s->%s] %s -> %s: %s",
+            self.lender.name, self.borrower.name, self.phase, phase,
+            reason,
+        )
+        self.events.append((self.phase, phase, reason))
+        self.phase = phase
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "policy": "chip_borrow",
+            "lender": self.lender.name,
+            "borrower": self.borrower.name,
+            "phase": self.phase,
+            "borrowed": self.borrowed,
+        }

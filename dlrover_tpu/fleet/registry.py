@@ -1,0 +1,67 @@
+"""Role-family registry: how a job's strategy resolves to a scaler.
+
+``new_job_auto_scaler`` used to be a hard-coded if-chain over
+``distribution_strategy`` — adding a role family meant editing the
+master.  Factories now register here (the built-in four at
+``master.job_auto_scaler`` import time) and resolution is a lookup,
+so an out-of-tree role family plugs in the same way a chaos site or a
+bench subcommand does.
+
+A factory is ``f(job_args, job_manager, speed_monitor, *,
+resource_optimizer=None, serving_gateway=None, reshard_manager=None)
+-> JobAutoScaler``.  Unknown strategies fall back to the default
+(training) family with a loud log — a typo'd strategy must not crash
+a master at boot, same contract as the gatewayless serving fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+from dlrover_tpu.common.log import logger
+
+DEFAULT_FAMILY = "allreduce"
+
+_FAMILIES: Dict[str, Callable] = {}
+
+
+def register_role_family(strategy: str, factory: Callable,
+                         replace: bool = False) -> None:
+    """Register ``factory`` for ``distribution_strategy == strategy``.
+    Re-registering without ``replace=True`` raises — two families
+    silently fighting over a strategy is exactly the bug this registry
+    exists to prevent."""
+    if not replace and strategy in _FAMILIES \
+            and _FAMILIES[strategy] is not factory:
+        raise ValueError(
+            f"role family {strategy!r} already registered"
+        )
+    _FAMILIES[strategy] = factory
+
+
+def role_families() -> Tuple[str, ...]:
+    _ensure_builtin()
+    return tuple(sorted(_FAMILIES))
+
+
+def resolve_job_scaler(job_args, job_manager, speed_monitor, **kw):
+    """Resolve ``job_args.distribution_strategy`` through the registry
+    and build the scaler."""
+    _ensure_builtin()
+    strategy = getattr(job_args, "distribution_strategy", DEFAULT_FAMILY)
+    factory = _FAMILIES.get(strategy)
+    if factory is None:
+        logger.error(
+            "unknown distribution_strategy %r (registered: %s); "
+            "falling back to the %r role family",
+            strategy, sorted(_FAMILIES), DEFAULT_FAMILY,
+        )
+        factory = _FAMILIES[DEFAULT_FAMILY]
+    return factory(job_args, job_manager, speed_monitor, **kw)
+
+
+def _ensure_builtin() -> None:
+    """The built-in families register when ``master.job_auto_scaler``
+    imports; pull it in if resolution runs first."""
+    if DEFAULT_FAMILY not in _FAMILIES:
+        from dlrover_tpu.master import job_auto_scaler  # noqa: F401

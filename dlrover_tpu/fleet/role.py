@@ -1,0 +1,293 @@
+"""Role model: the one contract every fleet member family implements.
+
+ISSUE 10 / ROADMAP item 5: the master/agent tree special-cased training
+workers (``dist_job_manager`` filtering on ``NodeType.WORKER``) versus
+serving replicas (``ServingFleetAutoScaler`` bolted beside
+``JobAutoScaler``) versus embedding servers — so no single ElasticJob
+could run a mixed fleet and nothing could reason across roles.  This
+module is the decoupling VirtualFlow (2009.09523) argues for: a *role*
+is what runs (training worker, serving replica, gateway, embedding
+store), the hardware beneath is fungible, and every family exposes the
+SAME lifecycle to the reconciler:
+
+    spawn -> observe (health) -> drain (role's own protocol) ->
+    release -> relaunch
+
+The surface is deliberately small and synchronous — adapters are
+polled by the :class:`~dlrover_tpu.fleet.manager.FleetManager` pass
+(the shape every scaler in this repo already uses: signals in, one
+decision out, actuation elsewhere) — and every resize, in ANY role, is
+a first-class drain-aware event (ElasWave 2510.00606): growth spawns,
+shrink ALWAYS goes through :meth:`RoleAdapter.begin_drain` /
+:meth:`RoleAdapter.drain_pending` so no role's in-flight work observes
+the change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from dlrover_tpu.common.log import logger
+
+
+@dataclasses.dataclass
+class RoleSpec:
+    """Desired shape of one role inside the fleet.
+
+    ``desired`` is the reconciler's set-point: supervision restores the
+    observed member count to it, per-role autoscale policies and the
+    cross-role borrow arbiter MOVE it (always within
+    ``[min_count, max_count]``).  ``relaunch_limit`` bounds supervised
+    replacements per member id — a member that keeps dying stops being
+    respawned (and is logged), exactly like the node relaunch budget in
+    the job manager."""
+
+    name: str
+    desired: int = 1
+    min_count: int = 0
+    max_count: int = 64
+    relaunch_limit: int = 3
+    #: Seconds a spawned member may stay unobserved before the
+    #: reconciler treats the spawn as lost and tries again.
+    spawn_grace_s: float = 30.0
+    #: Consecutive passes a member deficit must persist before
+    #: supervision spawns a replacement.  1 = react immediately; roles
+    #: whose membership view can FLICKER (a serving replica's gateway
+    #: lease lapsing for one poll during tier churn) set 2-3 so a
+    #: transient blip does not add real capacity.
+    spawn_confirm_passes: int = 1
+
+    def clamp(self, n: int) -> int:
+        return max(self.min_count, min(self.max_count, int(n)))
+
+
+@dataclasses.dataclass
+class RoleStatus:
+    """One observation of a role: who is alive, who is still coming up,
+    who is on the way out, plus the role's load signals (queue depth,
+    occupancy, speed — whatever its policy consumes)."""
+
+    members: Tuple[str, ...] = ()
+    pending: Tuple[str, ...] = ()
+    draining: Tuple[str, ...] = ()
+    signals: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def live(self) -> int:
+        """Members counted against ``desired``: alive + on their way
+        up.  Draining members are already spoken for (they leave when
+        their drain completes) and never count as capacity."""
+        return len(self.members) + len(self.pending)
+
+
+class RoleAdapter:
+    """Base adapter: the lifecycle primitives plus a generic
+    reconcile pass built from them.
+
+    Subclasses implement :meth:`observe`, :meth:`spawn` and the drain
+    trio; families with richer native machinery (the training scaler's
+    optimizer walk + live-reshard hold) override :meth:`reconcile`
+    wholesale and keep their exact semantics — the uniform model is the
+    *contract*, not a rewrite of every policy.
+
+    The borrow surface (:meth:`can_lend` / :meth:`lend_one` /
+    :meth:`lend_pending` / :meth:`reclaim_one`) is what cross-role
+    policies drive; the defaults ride the same drain path so a borrow
+    can never bypass a role's drain protocol."""
+
+    def __init__(self, spec: RoleSpec):
+        self.spec = spec
+        self._mu = threading.Lock()
+        #: member id -> supervised relaunch count (budget enforcement).
+        self._relaunches: Dict[str, int] = {}
+        #: member ids whose relaunch budget is spent: while such an id
+        #: stays dead the role runs degraded instead of thrashing.
+        self._blocked: set = set()
+        self._last_seen: Tuple[str, ...] = ()
+        self._deficit_streak = 0
+        #: Members observed gone while a deficit is still being
+        #: CONFIRMED (spawn_confirm_passes > 1): the budget is charged
+        #: on the pass that actually spawns, not the pass that first
+        #: noticed — and a blip that heals on its own charges nobody.
+        self._pending_gone: list = []
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    # -- primitives every role implements ---------------------------------
+
+    def observe(self) -> RoleStatus:
+        raise NotImplementedError
+
+    def spawn(self, n: int) -> int:
+        """Ask for ``n`` more members; returns how many were actually
+        requested (budget / platform limits may bite)."""
+        raise NotImplementedError
+
+    def begin_drain(self) -> Optional[str]:
+        """Start the role's drain protocol on ONE member (or one
+        resize unit).  Returns a token identifying the drain (usually
+        the member id) or ``None`` when nothing is eligible.  Shrinks
+        are serialized: one drain in flight per role."""
+        raise NotImplementedError
+
+    def drain_pending(self) -> bool:
+        """A previously begun drain has not completed yet.  While true
+        the reconciler holds every other decision for this role (the
+        two-phase pattern the serving scaler pioneered)."""
+        return False
+
+    def pump_drain(self) -> None:
+        """Advance an in-flight drain (poll completion, release the
+        freed resources).  Called once per reconcile pass while
+        :meth:`drain_pending`."""
+
+    # -- borrow surface (cross-role policies) ------------------------------
+
+    def can_lend(self) -> bool:
+        """One unit could leave without violating the floor."""
+        return self.observe().live - 1 >= self.spec.min_count
+
+    def lend_one(self) -> bool:
+        """Begin a drain-first release of one unit for another role's
+        benefit.  Default: the ordinary shrink path."""
+        return self.shrink_one()
+
+    def lend_pending(self) -> bool:
+        return self.drain_pending()
+
+    def reclaim_one(self) -> bool:
+        """Take a previously lent unit back (the hand-back direction)."""
+        return self.grow_one()
+
+    # -- desired-count movements ------------------------------------------
+
+    def grow_one(self) -> bool:
+        target = self.spec.clamp(self.spec.desired + 1)
+        if target == self.spec.desired:
+            return False
+        self.spec.desired = target
+        status = self.observe()
+        if status.live < target:
+            self.spawn(target - status.live)
+        return True
+
+    def shrink_one(self) -> bool:
+        target = self.spec.clamp(self.spec.desired - 1)
+        if target == self.spec.desired or self.drain_pending():
+            return False
+        if self.begin_drain() is None:
+            return False
+        self.spec.desired = target
+        return True
+
+    # -- per-role autoscale policy ----------------------------------------
+
+    def policy_target(self, status: RoleStatus) -> Optional[int]:
+        """This role's own autoscale opinion for the pass (None = no
+        opinion).  The generic reconcile moves ``desired`` toward it."""
+        return None
+
+    # -- the generic pass --------------------------------------------------
+
+    def reconcile(self) -> int:
+        """One supervision + policy pass; returns the applied member
+        delta (0 while holding)."""
+        if self.drain_pending():
+            self.pump_drain()
+            return 0
+        status = self.observe()
+        gone = self._note_seen(status)
+        # 1) Supervision: dead members are replaced toward desired
+        # (drain removals already lowered desired, so this never
+        # resurrects a drained member).
+        if status.live < self.spec.desired:
+            self._deficit_streak += 1
+            self._pending_gone.extend(
+                m for m in gone if m not in self._pending_gone
+            )
+            if self._deficit_streak < self.spec.spawn_confirm_passes:
+                return 0
+            want = self.spec.desired - status.live
+            charged, self._pending_gone = tuple(self._pending_gone), []
+            allowed = self._budgeted(charged, status, want)
+            if allowed > 0:
+                self._deficit_streak = 0
+                logger.info(
+                    "fleet[%s]: %d live < %d desired; spawning %d",
+                    self.name, status.live, self.spec.desired, allowed,
+                )
+                return self.spawn(allowed)
+            return 0
+        self._deficit_streak = 0
+        self._pending_gone.clear()  # the blip healed; nobody charged
+        # 2) Per-role policy.
+        target = self.policy_target(status)
+        if target is None:
+            return 0
+        target = self.spec.clamp(target)
+        if target > self.spec.desired:
+            self.spec.desired = target
+            if status.live < target:
+                return self.spawn(target - status.live)
+        elif target < self.spec.desired:
+            self.shrink_one()  # serialized, drain-first
+        return 0
+
+    # -- relaunch budget ---------------------------------------------------
+
+    def _note_seen(self, status: RoleStatus) -> Tuple[str, ...]:
+        """Track live membership across passes; returns the members
+        that vanished (not via a drain) since the last observation —
+        the ones a supervision spawn would be replacing."""
+        with self._mu:
+            gone = tuple(
+                m for m in self._last_seen
+                if m not in status.members and m not in status.draining
+            )
+            self._last_seen = status.members
+            return gone
+
+    def _budgeted(self, gone: Tuple[str, ...], status: RoleStatus,
+                  want: int) -> int:
+        """Charge supervised replacements against the per-member
+        relaunch budget.  A member id over budget is BLOCKED: while it
+        stays dead the role runs degraded (one fewer spawn) rather
+        than thrashing a relaunch loop — only meaningful for id-stable
+        roles (gateways relaunch under their own id); id-fresh roles
+        never re-kill a blocked id, so nothing accumulates."""
+        with self._mu:
+            for member in gone:
+                count = self._relaunches.get(member, 0) + 1
+                self._relaunches[member] = count
+                if (
+                    count > self.spec.relaunch_limit
+                    and member not in self._blocked
+                ):
+                    logger.error(
+                        "fleet[%s]: member %s exceeded relaunch budget "
+                        "(%d); not replacing it",
+                        self.name, member, self.spec.relaunch_limit,
+                    )
+                    self._blocked.add(member)
+            dead_blocked = sum(
+                1 for m in self._blocked
+                if m not in status.members and m not in status.draining
+            )
+            return max(0, want - dead_blocked)
+
+    # -- views --------------------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        status = self.observe()
+        return {
+            "desired": self.spec.desired,
+            "members": sorted(status.members),
+            "pending": len(status.pending),
+            "draining": sorted(status.draining),
+            "signals": dict(status.signals),
+            "drain_pending": self.drain_pending(),
+        }

@@ -1,0 +1,503 @@
+"""The four role families, migrated onto the fleet contract.
+
+Each adapter WRAPS its family's existing machinery rather than
+re-deriving it — the training scaler's optimizer walk, live-reshard
+hold and shrink-only live gating; the serving drain two-phase; the
+embedding group resize; the gateway registry lease — so every behavior
+those components already prove in their own test suites flows through
+the fleet layer unchanged.
+
+- :class:`TrainingRole` — wraps :class:`AllreduceTrainingAutoScaler`.
+  Its reconcile IS the scaler's pass; lending a chip goes through the
+  scaler's two-phase resize (live-reshard shrink when eligible, the
+  restart ladder otherwise) so a borrow can never bypass the epoch
+  protocol.
+- :class:`ServingReplicaRole` — replicas behind a gateway-shaped
+  actuator (a single ``GatewayCore`` or the tier-wide
+  :class:`~dlrover_tpu.serving.tier.TierActuator` over the MERGED
+  snapshot).  Shrink is the drain-first two-phase; per-role sub-pools
+  (prefill/decode) ride ``decide_pools``.
+- :class:`GatewayRole` — gateways as a SUPERVISED role (ROADMAP 4a):
+  membership is the leased registry, a dead gateway is relaunched
+  UNDER ITS OWN ID so the replacement re-adopts exactly the dead hash
+  ranges, and graceful shrink deregisters before stopping.
+- :class:`EmbeddingRole` — the host-side embedding-store group; resize
+  rebalances shards via the embedding router's consistent hashing, so
+  drain is the count drop itself (watched to completion).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.fleet.role import RoleAdapter, RoleSpec, RoleStatus
+
+
+class TrainingRole(RoleAdapter):
+    """Training workers as a fleet role.
+
+    ``scaler`` is an :class:`AllreduceTrainingAutoScaler` (already
+    wired to the job manager, speed monitor, optimizer and reshard
+    manager); its ``scale_once`` pass — backfill, optimizer walk,
+    live-reshard two-phase hold — runs unchanged as this role's
+    reconcile.  While chips are LENT to another role the ordinary
+    policy is held (the optimizer would fight the borrow by re-growing)
+    and only the in-flight resize is pumped."""
+
+    def __init__(self, spec: RoleSpec, scaler, job_manager):
+        super().__init__(spec)
+        self._scaler = scaler
+        self._job_manager = job_manager
+        self._drain_target: Optional[int] = None
+        self.lent = 0
+
+    def observe(self) -> RoleStatus:
+        alive = tuple(
+            f"w{n.rank_index}" for n in self._job_manager.alive_workers()
+        )
+        pending = tuple(
+            f"w{n.rank_index}" for n in self._job_manager.pending_workers()
+        )
+        signals: Dict[str, Any] = {"lent": self.lent}
+        speed = getattr(self._scaler, "_speed_monitor", None)
+        if speed is not None:
+            signals["speed"] = speed.running_speed()
+        return RoleStatus(members=alive, pending=pending, signals=signals)
+
+    def reconcile(self) -> int:
+        if self.lent > 0:
+            # Chips on loan: hold the ordinary grow/shrink policy and
+            # only pump the in-flight two-phase resize (epoch DONE ->
+            # release surplus workers; ABORT -> restart ladder).
+            return self._scaler.pump()
+        return self._scaler.scale_once()
+
+    # -- drain / borrow surface --------------------------------------------
+
+    def spawn(self, n: int) -> int:
+        status = self.observe()
+        return self._job_manager.scale_workers_to(status.live + n)
+
+    def begin_drain(self) -> Optional[str]:
+        status = self.observe()
+        unit = self._scaler.node_unit
+        target = status.live - unit
+        if target < self.spec.min_count:
+            return None
+        if not self._scaler.request_resize(target):
+            return None
+        self._drain_target = target
+        return f"resize->{target}"
+
+    def drain_pending(self) -> bool:
+        if self._drain_target is None:
+            return False
+        if self._scaler.resize_pending:
+            return True
+        if self.observe().live > self._drain_target:
+            return True
+        self._drain_target = None
+        return False
+
+    def pump_drain(self) -> None:
+        self._scaler.pump()
+
+    def can_lend(self) -> bool:
+        return (
+            not self.drain_pending()
+            and self.observe().live - self._scaler.node_unit
+            >= self.spec.min_count
+        )
+
+    def lend_one(self) -> bool:
+        """Drain-first chip release: the two-phase resize (live-reshard
+        when eligible — survivors move the leaving ranks' state
+        mesh-to-mesh before any process dies).  One "unit" is the
+        job's node_unit (TPU slices are all-or-nothing)."""
+        if self.begin_drain() is None:
+            return False
+        self.spec.desired = max(
+            self.spec.min_count,
+            self.spec.desired - self._scaler.node_unit,
+        )
+        self.lent += 1
+        return True
+
+    def reclaim_one(self) -> bool:
+        if self.lent <= 0:
+            return False
+        self.lent -= 1
+        self.spec.desired = self.spec.clamp(
+            self.spec.desired + self._scaler.node_unit
+        )
+        self._job_manager.scale_workers_to(self.spec.desired)
+        return True
+
+
+class ServingReplicaRole(RoleAdapter):
+    """Serving replicas as a fleet role.
+
+    ``actuator`` is gateway-shaped — ``stats_snapshot`` /
+    ``pick_drain_victim`` / ``drain`` — which a single
+    :class:`GatewayCore` satisfies directly and the tier-wide
+    :class:`~dlrover_tpu.serving.tier.TierActuator` satisfies over the
+    MERGED multi-gateway view (ROADMAP 4b: provisioning decisions read
+    the whole tier, drains broadcast to every gateway).
+
+    ``spawn_fn(n, role=None)`` provisions replicas (the job manager in
+    a supervised fleet, a thread/subprocess spawner in benches and
+    e2e); ``release_fn(victim)`` runs after a drained victim fully
+    deregistered (phase B bookkeeping — e.g. lowering the worker
+    target, which by then kills nobody live)."""
+
+    def __init__(
+        self,
+        spec: RoleSpec,
+        actuator,
+        spawn_fn: Callable[..., Any],
+        policy=None,
+        pool_policies: Optional[Dict[str, Any]] = None,
+        release_fn: Optional[Callable[[str], Any]] = None,
+    ):
+        super().__init__(spec)
+        from dlrover_tpu.serving.autoscale import ScalePolicy, ScaleState
+
+        self._actuator = actuator
+        self._spawn_fn = spawn_fn
+        self._release_fn = release_fn
+        self._policy = policy or ScalePolicy(
+            min_replicas=max(1, spec.min_count),
+            max_replicas=max(1, spec.max_count),
+        )
+        self._state = ScaleState()
+        self._pool_policies = dict(pool_policies or {})
+        self._pool_states: Dict[str, Any] = {}
+        self._drain_victim: Optional[str] = None
+        #: Spawns not yet visible as registered replicas, with a
+        #: deadline after which the spawn is presumed lost.
+        self._expected: list = []
+        #: One tier fan-out per reconcile pass: drain_pending, observe
+        #: and policy_target all read the SAME snapshot (an actuator
+        #: over a registry pays one RPC per live gateway per fetch).
+        #: The snapshot is kept until the NEXT pass refreshes it, so
+        #: cross-role policies running after the roles (the borrow
+        #: arbiter's observe/grow calls) reuse this pass's fan-out too
+        #: — at most one pass of staleness, by construction.
+        self._pass_snap: Optional[Dict[str, Any]] = None
+
+    def reconcile(self) -> int:
+        self._pass_snap = self._actuator.stats_snapshot()
+        return super().reconcile()
+
+    def _snapshot(self) -> Dict[str, Any]:
+        if self._pass_snap is not None:
+            return self._pass_snap
+        return self._actuator.stats_snapshot()
+
+    # -- observation --------------------------------------------------------
+
+    def observe(self) -> RoleStatus:
+        snap = self._snapshot()
+        replicas = snap.get("replicas", {})
+        members = tuple(
+            rid for rid, r in replicas.items() if not r.get("draining")
+        )
+        draining = tuple(
+            rid for rid, r in replicas.items() if r.get("draining")
+        )
+        now = time.monotonic()
+        # Under the role lock: status() (the servicer's FleetStats
+        # read) calls observe concurrently with the fleet thread's
+        # spawn — an unguarded rebuild could drop fresh spawn
+        # deadlines and over-provision on the next pass.
+        with self._mu:
+            self._expected = [
+                d for d in self._expected if d > now
+            ][: max(0, self.spec.desired - len(members))]
+            pending = tuple(
+                f"pending-{i}" for i in range(len(self._expected))
+            )
+        return RoleStatus(
+            members=members,
+            pending=pending,
+            draining=draining,
+            signals={
+                "queue_depth": snap.get("queue_depth", 0),
+                "occupancy": snap.get("occupancy", 0.0),
+                "ttft_p95_ms": snap.get("ttft_p95_ms", 0.0),
+                "pools": snap.get("pools", {}),
+                "gateways": snap.get("gateways", 1),
+            },
+        )
+
+    def policy_target(self, status: RoleStatus) -> Optional[int]:
+        from dlrover_tpu.serving.autoscale import decide, decide_pools
+
+        snap = self._snapshot()
+        if self._pool_policies:
+            # Per-role sub-pools (the PoolAutoScaler arithmetic): each
+            # pool gets its own decision; the ROLE target is their sum
+            # and pool-level grow/drain is actuated here directly.
+            targets = decide_pools(
+                snap, self._pool_policies, self._pool_states
+            )
+            pools = snap.get("pools", {})
+            for role, target in targets.items():
+                alive = int(pools.get(role, {}).get("alive", 0))
+                if target > alive:
+                    self._spawn_fn(target - alive, role=role)
+                elif target < alive and not self.drain_pending():
+                    victim = self._actuator.pick_drain_victim(role=role)
+                    if victim is not None:
+                        self._actuator.drain(victim)
+                        self._drain_victim = victim
+            return None  # pool path actuates itself
+        return decide(snap, self._policy, self._state)
+
+    # -- actuation ----------------------------------------------------------
+
+    def spawn(self, n: int) -> int:
+        deadline = time.monotonic() + self.spec.spawn_grace_s
+        with self._mu:
+            self._expected.extend([deadline] * n)
+        self._spawn_fn(n)
+        return n
+
+    def begin_drain(self) -> Optional[str]:
+        if self._drain_victim is not None:
+            return None
+        victim = self._actuator.pick_drain_victim()
+        if victim is None:
+            return None
+        self._actuator.drain(victim)
+        self._drain_victim = victim
+        logger.info("fleet[%s]: draining replica %s", self.name, victim)
+        return victim
+
+    def drain_pending(self) -> bool:
+        if self._drain_victim is None:
+            return False
+        snap = self._snapshot()
+        if self._drain_victim in snap.get("replicas", {}):
+            return True
+        victim, self._drain_victim = self._drain_victim, None
+        if self._release_fn is not None:
+            try:
+                self._release_fn(victim)
+            except Exception:
+                logger.exception(
+                    "fleet[%s]: release of %s failed", self.name, victim
+                )
+        logger.info(
+            "fleet[%s]: drain of %s complete", self.name, victim
+        )
+        return False
+
+    def pump_drain(self) -> None:
+        self.drain_pending()
+
+
+class GatewayRole(RoleAdapter):
+    """Gateways as a SUPERVISED role (ROADMAP 4a).
+
+    Membership is the leased ``ServeRegistry``: a gateway that stops
+    heartbeating ages out of the registry and the reconciler replaces
+    it — under the SAME gateway id, so the replacement's virtual nodes
+    land exactly on the dead gateway's hash ranges and the ring heals
+    to its pre-death shape (clients and replicas re-route within one
+    lease either way).
+
+    ``spawn_fn(gid)`` launches one gateway process (job manager node,
+    subprocess, or thread); ``stop_fn(gid)`` gracefully stops one for
+    scale-down (deregister first — the registry entry vanishing IS the
+    drain completion signal, after which no client routes to it)."""
+
+    def __init__(
+        self,
+        spec: RoleSpec,
+        registry,
+        spawn_fn: Callable[[str], Any],
+        stop_fn: Optional[Callable[[str], Any]] = None,
+        id_prefix: str = "gw",
+    ):
+        super().__init__(spec)
+        self.registry = registry
+        self._spawn_fn = spawn_fn
+        self._stop_fn = stop_fn
+        self._id_prefix = id_prefix
+        #: Every id this role ever launched (dead ones are relaunch
+        #: candidates; ids, not processes, are the stable identity).
+        self._known: list = []
+        #: gid -> spawn deadline while the announce is awaited.
+        self._spawning: Dict[str, float] = {}
+        self._drain_gid: Optional[str] = None
+        self._drain_deadline = 0.0
+        #: Seconds for a graceful stop to take effect (entry gone from
+        #: the registry) before the drain is ABANDONED — a stop_fn that
+        #: cannot actually stop the process (or the default
+        #: registry-only removal racing a live heartbeat) must not
+        #: wedge the whole role's reconciliation forever.
+        self.drain_timeout_s = 30.0
+
+    def observe(self) -> RoleStatus:
+        live = self.registry.gateways()
+        now = time.monotonic()
+        # Under the role lock: the servicer's status() observe races
+        # the fleet thread's spawn bookkeeping on _known/_spawning.
+        with self._mu:
+            # Adopted members (announced by someone else) become
+            # relaunch candidates too: identity is the id, not who
+            # launched it.
+            for gid in live:
+                if gid not in self._known:
+                    self._known.append(gid)
+            for gid in list(self._spawning):
+                if gid in live or self._spawning[gid] <= now:
+                    self._spawning.pop(gid, None)
+            pending = tuple(self._spawning)
+        draining = (
+            (self._drain_gid,)
+            if self._drain_gid is not None and self._drain_gid in live
+            else ()
+        )
+        members = tuple(g for g in live if g not in draining)
+        return RoleStatus(
+            members=members,
+            pending=pending,
+            draining=draining,
+            signals={"addrs": dict(live)},
+        )
+
+    def spawn(self, n: int) -> int:
+        live = set(self.registry.gateways())
+        launched = 0
+        for _ in range(n):
+            with self._mu:
+                live |= set(self._spawning)
+                gid = self._pick_id(live)
+                live.add(gid)
+                if gid not in self._known:
+                    self._known.append(gid)
+                self._spawning[gid] = (
+                    time.monotonic() + self.spec.spawn_grace_s
+                )
+            logger.info("fleet[%s]: launching gateway %s", self.name, gid)
+            try:
+                self._spawn_fn(gid)
+                launched += 1
+            except Exception:
+                logger.exception(
+                    "fleet[%s]: gateway %s spawn failed", self.name, gid
+                )
+                with self._mu:
+                    self._spawning.pop(gid, None)
+        return launched
+
+    def _pick_id(self, live) -> str:
+        # Dead known ids first: the replacement re-adopts the dead
+        # gateway's ring ranges (same id = same vnodes).  Budget-
+        # blocked ids are never picked — relaunching the crash-looper
+        # would defeat the budget AND starve a healthy slot's
+        # replacement.
+        for gid in self._known:
+            if gid not in live and gid not in self._blocked:
+                return gid
+        k = len(self._known)
+        while f"{self._id_prefix}{k}" in live \
+                or f"{self._id_prefix}{k}" in self._blocked:
+            k += 1
+        return f"{self._id_prefix}{k}"
+
+    def begin_drain(self) -> Optional[str]:
+        status = self.observe()
+        if not status.members or self._drain_gid is not None:
+            return None
+        gid = sorted(status.members)[-1]
+        self._drain_gid = gid
+        self._drain_deadline = time.monotonic() + self.drain_timeout_s
+        try:
+            if self._stop_fn is not None:
+                self._stop_fn(gid)
+            else:
+                # Best-effort without a stop hook: deregister so
+                # clients re-route.  A LIVE gateway will re-announce on
+                # its next heartbeat — the drain then times out below
+                # rather than wedging the role (provide a stop_fn for
+                # a real graceful shrink).
+                self.registry.remove_gateway(gid)
+        except Exception:
+            logger.exception(
+                "fleet[%s]: gateway %s stop failed", self.name, gid
+            )
+        return gid
+
+    def drain_pending(self) -> bool:
+        if self._drain_gid is None:
+            return False
+        if self._drain_gid in self.registry.gateways():
+            if time.monotonic() > self._drain_deadline:
+                logger.error(
+                    "fleet[%s]: gateway %s still announcing %.0fs "
+                    "after its drain began (stop_fn missing or "
+                    "ineffective); ABANDONING the drain so the role "
+                    "keeps reconciling",
+                    self.name, self._drain_gid, self.drain_timeout_s,
+                )
+                self._drain_gid = None
+                return False
+            return True
+        self._drain_gid = None
+        return False
+
+    def pump_drain(self) -> None:
+        self.drain_pending()
+
+
+class EmbeddingRole(RoleAdapter):
+    """Host-side embedding-store servers as a fleet role.  The store
+    group rebalances shards by consistent hashing on ANY resize, so
+    the drain protocol is the resize itself, watched to completion."""
+
+    def __init__(self, spec: RoleSpec, job_manager,
+                 node_type: str = NodeType.EMBEDDING):
+        super().__init__(spec)
+        self._job_manager = job_manager
+        self._node_type = node_type
+        self._drain_target: Optional[int] = None
+
+    def observe(self) -> RoleStatus:
+        alive = tuple(
+            f"e{n.rank_index}"
+            for n in self._job_manager.alive_nodes_of(self._node_type)
+        )
+        pending = tuple(
+            f"e{n.rank_index}"
+            for n in self._job_manager.pending_nodes_of(self._node_type)
+        )
+        return RoleStatus(members=alive, pending=pending)
+
+    def spawn(self, n: int) -> int:
+        status = self.observe()
+        return self._job_manager.scale_role_to(
+            self._node_type, status.live + n
+        )
+
+    def begin_drain(self) -> Optional[str]:
+        status = self.observe()
+        target = status.live - 1
+        if target < self.spec.min_count:
+            return None
+        self._job_manager.scale_role_to(self._node_type, target)
+        self._drain_target = target
+        return f"resize->{target}"
+
+    def drain_pending(self) -> bool:
+        if self._drain_target is None:
+            return False
+        if self.observe().live > self._drain_target:
+            return True
+        self._drain_target = None
+        return False

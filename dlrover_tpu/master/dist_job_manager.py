@@ -212,13 +212,21 @@ class DistributedJobManager(LocalJobManager):
     def scale_workers_to(self, count: int) -> int:
         """Adjust live worker count to ``count`` (auto-scaler entry).
         Returns the delta actually applied."""
-        group = self._job_args.workers
-        count = group.clamp(count)
+        return self.scale_role_to(NodeType.WORKER, count)
+
+    def scale_role_to(self, node_type: str, count: int) -> int:
+        """Adjust the live count of ONE role's node group (ISSUE 10:
+        the fleet layer's generic actuation — training workers,
+        gateways and embedding stores all resize through this one
+        path).  Returns the delta actually applied."""
+        group = self._job_args.node_groups.get(node_type)
+        if group is not None:
+            count = group.clamp(count)
         with self._lock:
             live = [
                 n
                 for n in self._nodes.values()
-                if n.type == NodeType.WORKER
+                if n.type == node_type
                 and not n.is_released
                 and n.status
                 in (NodeStatus.INITIAL, NodeStatus.PENDING, NodeStatus.RUNNING)
@@ -240,11 +248,16 @@ class DistributedJobManager(LocalJobManager):
                     used_ranks.add(next_rank)
                     node_id = next(self._id_iter)
                     node = Node(
-                        NodeType.WORKER,
+                        node_type,
                         node_id,
                         rank_index=next_rank,
-                        config_resource=group.resource,
-                        max_relaunch_count=group.restart_count,
+                        config_resource=(
+                            group.resource if group is not None
+                            else NodeResource()
+                        ),
+                        max_relaunch_count=(
+                            group.restart_count if group is not None else 3
+                        ),
                     )
                     self._nodes[node_id] = node
                     plan.launch_nodes.append(node)
@@ -278,23 +291,28 @@ class DistributedJobManager(LocalJobManager):
         )
 
     # -- views -------------------------------------------------------------
-    def alive_workers(self) -> List[Node]:
+    def alive_nodes_of(self, node_type: str) -> List[Node]:
         with self._lock:
             return [
                 n
                 for n in self._nodes.values()
-                if n.type == NodeType.WORKER
-                and n.status == NodeStatus.RUNNING
+                if n.type == node_type and n.status == NodeStatus.RUNNING
             ]
 
-    def pending_workers(self) -> List[Node]:
+    def pending_nodes_of(self, node_type: str) -> List[Node]:
         with self._lock:
             return [
                 n
                 for n in self._nodes.values()
-                if n.type == NodeType.WORKER
+                if n.type == node_type
                 and n.status in (NodeStatus.INITIAL, NodeStatus.PENDING)
             ]
+
+    def alive_workers(self) -> List[Node]:
+        return self.alive_nodes_of(NodeType.WORKER)
+
+    def pending_workers(self) -> List[Node]:
+        return self.pending_nodes_of(NodeType.WORKER)
 
     def all_workers_exited(self) -> bool:
         with self._lock:

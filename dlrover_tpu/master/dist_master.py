@@ -129,6 +129,21 @@ class DistributedJobMaster(JobMaster):
         self.strategy_generator = SimpleStrategyGenerator(
             self.job_manager, self.speed_monitor
         )
+        # Mixed fleet (ISSUE 10): a job whose spec carries extra role
+        # groups (a `gateway` group beside the workers) is supervised
+        # by ONE FleetManager wrapping the resolved scaler — the fleet
+        # thread then replaces the scaler's own (same object, so
+        # behavior is identical for the training role and gateways get
+        # spawn/relaunch supervision on top).  Plain jobs keep the
+        # single-role scaler path untouched (fleet_manager is None).
+        from dlrover_tpu.fleet import build_job_fleet
+
+        self.fleet_manager = build_job_fleet(
+            job_args,
+            self.job_manager,
+            self.auto_scaler,
+            kv_store=self.kv_store,
+        )
 
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
@@ -140,6 +155,7 @@ class DistributedJobMaster(JobMaster):
             diagnosis_manager=self.diagnosis_manager,
             job_context=self,
             reshard_manager=self.reshard_manager,
+            fleet_manager=self.fleet_manager,
         )
         self._server = RpcServer(port, self.servicer)
         self.run_config: dict = {}
@@ -156,7 +172,15 @@ class DistributedJobMaster(JobMaster):
         self._server.start()
         self.task_manager.start()
         self.job_manager.start()
-        self.auto_scaler.start_auto_scaling()
+        if self.fleet_manager is not None:
+            self.fleet_manager.start()
+        if self.fleet_manager is None or \
+                "training" not in self.fleet_manager.roles():
+            # The fleet pass pumps a WRAPPED scaler itself (starting
+            # both threads would double-actuate); a scaler the fleet
+            # did not wrap (embedding/serving strategies) still needs
+            # its own thread.
+            self.auto_scaler.start_auto_scaling()
         self.diagnosis_manager.start()
         if self._ctx.auto_tune:
             self.strategy_generator.start()
@@ -198,6 +222,8 @@ class DistributedJobMaster(JobMaster):
 
     def stop(self) -> None:
         self.stage = JobStage.STOPPED
+        if self.fleet_manager is not None:
+            self.fleet_manager.stop()
         self.auto_scaler.stop_auto_scaling()
         self.task_manager.stop()
         self.job_manager.stop()

@@ -147,6 +147,42 @@ class AllreduceTrainingAutoScaler(JobAutoScaler):
                     return self._resize(alive, target)
         return 0
 
+    @property
+    def node_unit(self) -> int:
+        return max(1, self._job_args.node_unit)
+
+    @property
+    def resize_pending(self) -> bool:
+        """A two-phase resize epoch is in flight."""
+        return self._pending_resize is not None
+
+    def pump(self) -> int:
+        """Advance (only) an in-flight two-phase resize — the fleet
+        layer's hook for holding ordinary policy (e.g. while chips are
+        lent to another role) without stalling an epoch mid-move."""
+        held = self._check_pending_resize()
+        return 0 if held is None else held
+
+    def request_resize(self, target: int) -> bool:
+        """External resize entry (fleet roles, the borrow arbiter):
+        move the worker count toward ``target`` through the SAME
+        two-phase path ``scale_once`` uses — live-reshard shrink when
+        eligible, the restart ladder otherwise.  Refused while another
+        resize is in flight (drains are serialized)."""
+        if self._pending_resize is not None:
+            return False
+        group = self._job_args.workers
+        target = self._round_to_unit(group.clamp(target))
+        alive = len(self._job_manager.alive_workers())
+        if target == alive + len(self._job_manager.pending_workers()):
+            return False
+        logger.info(
+            "auto-scaler: externally requested resize -> %d workers",
+            target,
+        )
+        self._resize(alive, target)
+        return True
+
     def _resize(self, alive: int, target: int) -> int:
         """Apply a grow/shrink decision.  A SHRINK with live, polling
         workers goes through the restart-free path first: announce the
@@ -373,6 +409,59 @@ class ServingFleetAutoScaler(JobAutoScaler):
                 logger.exception("serving auto-scale pass failed")
 
 
+# -- role-family factories (resolved through the fleet registry) -----------
+
+
+def _training_family(
+    job_args, job_manager, speed_monitor, *,
+    resource_optimizer=None, serving_gateway=None, reshard_manager=None,
+) -> JobAutoScaler:
+    return AllreduceTrainingAutoScaler(
+        job_args, job_manager, speed_monitor, resource_optimizer,
+        reshard_manager=reshard_manager,
+    )
+
+
+def _embedding_family(
+    job_args, job_manager, speed_monitor, *,
+    resource_optimizer=None, serving_gateway=None, reshard_manager=None,
+) -> JobAutoScaler:
+    return EmbeddingStoreAutoScaler(
+        job_args, job_manager, resource_optimizer
+    )
+
+
+def _serving_family(
+    job_args, job_manager, speed_monitor, *,
+    resource_optimizer=None, serving_gateway=None, reshard_manager=None,
+) -> JobAutoScaler:
+    """A serving job needs the gateway handle — its scaler steers on
+    live admission-queue signals, not speed.  Without one (today's
+    dist_master does not wire a gateway) the job still boots: it falls
+    back to the training scaler with a loud error, rather than
+    crashing the master at startup."""
+    if serving_gateway is None:
+        logger.error(
+            "serving-strategy job has no gateway wired into the "
+            "master (pass new_job_auto_scaler(serving_gateway=...)"
+            "); falling back to the speed-based training scaler — "
+            "queue/TTFT-driven serving autoscale is DISABLED"
+        )
+        return _training_family(
+            job_args, job_manager, speed_monitor,
+            resource_optimizer=resource_optimizer,
+            reshard_manager=reshard_manager,
+        )
+    return ServingFleetAutoScaler(job_args, job_manager, serving_gateway)
+
+
+from dlrover_tpu.fleet import registry as _fleet_registry  # noqa: E402
+
+_fleet_registry.register_role_family("allreduce", _training_family)
+_fleet_registry.register_role_family("embedding", _embedding_family)
+_fleet_registry.register_role_family("serving", _serving_family)
+
+
 def new_job_auto_scaler(
     job_args: JobArgs,
     job_manager: DistributedJobManager,
@@ -381,29 +470,14 @@ def new_job_auto_scaler(
     serving_gateway=None,
     reshard_manager=None,
 ) -> JobAutoScaler:
-    """Factory (reference ``new_job_auto_scaler :41``).  A serving job
-    (``distribution_strategy == "serving"``) needs the gateway handle —
-    its scaler steers on live admission-queue signals, not speed.
-    Without one (today's dist_master does not wire a gateway) the job
-    still boots: it falls back to the training scaler with a loud
-    error, rather than crashing the master at startup."""
-    if job_args.distribution_strategy == "serving":
-        if serving_gateway is None:
-            logger.error(
-                "serving-strategy job has no gateway wired into the "
-                "master (pass new_job_auto_scaler(serving_gateway=...)"
-                "); falling back to the speed-based training scaler — "
-                "queue/TTFT-driven serving autoscale is DISABLED"
-            )
-        else:
-            return ServingFleetAutoScaler(
-                job_args, job_manager, serving_gateway
-            )
-    if job_args.distribution_strategy == "embedding":
-        return EmbeddingStoreAutoScaler(
-            job_args, job_manager, resource_optimizer
-        )
-    return AllreduceTrainingAutoScaler(
-        job_args, job_manager, speed_monitor, resource_optimizer,
+    """Factory (reference ``new_job_auto_scaler :41``), resolved
+    through the fleet role registry (ISSUE 10): the strategy -> scaler
+    mapping is a registration, not an if-chain, so new role families
+    (or tests) plug in via
+    :func:`dlrover_tpu.fleet.register_role_family`."""
+    return _fleet_registry.resolve_job_scaler(
+        job_args, job_manager, speed_monitor,
+        resource_optimizer=resource_optimizer,
+        serving_gateway=serving_gateway,
         reshard_manager=reshard_manager,
     )

@@ -80,6 +80,12 @@ class LocalJobManager:
         with self._lock:
             return dict(self._nodes)
 
+    def nodes_of(self, node_type: str) -> list:
+        """All registered nodes of one role (ISSUE 10: the fleet layer
+        reads per-role membership instead of assuming worker-only)."""
+        with self._lock:
+            return [n for n in self._nodes.values() if n.type == node_type]
+
     # -- status ------------------------------------------------------------
     def update_node_status(
         self, node_id: int, node_type: str, status: str, exit_reason: str = ""
@@ -154,20 +160,17 @@ class LocalJobManager:
                     self.on_node_dead(node)
 
     # -- job-level views ---------------------------------------------------
+    # Job completion is judged on the WORKER role only: supervised
+    # service roles (gateways, embedding stores) run for the job's
+    # lifetime and must not block exit.
     def all_workers_exited(self) -> bool:
-        with self._lock:
-            workers = [
-                n for n in self._nodes.values() if n.type == NodeType.WORKER
-            ]
-            return bool(workers) and all(
-                n.status in NodeStatus.TERMINAL for n in workers
-            )
+        workers = self.nodes_of(NodeType.WORKER)
+        return bool(workers) and all(
+            n.status in NodeStatus.TERMINAL for n in workers
+        )
 
     def all_workers_succeeded(self) -> bool:
-        with self._lock:
-            workers = [
-                n for n in self._nodes.values() if n.type == NodeType.WORKER
-            ]
-            return bool(workers) and all(
-                n.status == NodeStatus.SUCCEEDED for n in workers
-            )
+        workers = self.nodes_of(NodeType.WORKER)
+        return bool(workers) and all(
+            n.status == NodeStatus.SUCCEEDED for n in workers
+        )

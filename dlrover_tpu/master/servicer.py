@@ -34,6 +34,7 @@ class MasterServicer:
         diagnosis_manager=None,
         job_context=None,
         reshard_manager=None,
+        fleet_manager=None,
     ):
         self.task_manager = task_manager
         self.job_manager = job_manager
@@ -44,6 +45,7 @@ class MasterServicer:
         self.diagnosis_manager = diagnosis_manager
         self.job_context = job_context  # the master itself (stop control)
         self.reshard_manager = reshard_manager
+        self.fleet_manager = fleet_manager
         self._dispatch = {
             m.NodeMeta: self._on_node_meta,
             m.ReportNodeStatus: self._on_node_status,
@@ -82,6 +84,7 @@ class MasterServicer:
             m.JobExitRequest: self._on_job_exit,
             m.ReshardEpochRequest: self._on_reshard_epoch,
             m.ReshardReport: self._on_reshard_report,
+            m.FleetStatsRequest: self._on_fleet_stats,
         }
 
     def __call__(self, msg: m.Message) -> Optional[m.Message]:
@@ -367,3 +370,13 @@ class MasterServicer:
                 success=False, reason="no reshard manager on this master"
             )
         return self.reshard_manager.report(msg)
+
+    # -- fleet control plane (ISSUE 10) -------------------------------------
+    def _on_fleet_stats(self, msg: m.FleetStatsRequest):
+        if self.fleet_manager is None:
+            return m.FleetStats()  # single-role job: no fleet layer
+        status = self.fleet_manager.status()
+        return m.FleetStats(
+            roles=status.get("roles", {}),
+            policies=status.get("policies", []),
+        )

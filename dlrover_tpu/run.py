@@ -69,6 +69,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--log_dir", default="")
     p.add_argument("--job_name", default=os.environ.get(
         "DLROVER_TPU_JOB_NAME", "local-job"))
+    p.add_argument("--node_role", default=os.environ.get(
+        "DLROVER_TPU_NODE_ROLE", "worker"),
+        help="fleet role of this node (ISSUE 10): 'worker' joins the "
+             "training rendezvous; service roles ('gateway', "
+             "'embedding') register for supervision only and run "
+             "their entrypoint outside the XLA mesh")
     p.add_argument("--no_python", action="store_true",
                    help="entrypoint is a program, not a python script")
     p.add_argument("--job_file", default="",
@@ -258,6 +264,7 @@ def _gc_shm_arenas(
 
 def run(args: argparse.Namespace) -> int:
     set_role(f"agent-{args.node_rank}")
+    os.environ["DLROVER_TPU_NODE_ROLE"] = args.node_role
     # One id per launcher invocation: namespaces host-local IPC (shm
     # arenas/queues/locks) so stale state from a previous launch of the
     # same job name can't leak into this one.
@@ -307,6 +314,7 @@ def run(args: argparse.Namespace) -> int:
         comm_perf_test=args.comm_perf_test,
         log_dir=args.log_dir,
         job_name=args.job_name,
+        node_role=args.node_role,
     )
     config.auto_configure()
 

@@ -68,8 +68,10 @@ from dlrover_tpu.serving.tier import (  # noqa: F401
     RegistryServer,
     RpcKv,
     ServeRegistry,
+    TierActuator,
     TierClient,
     TierReplicaLink,
     TierStats,
     merge_snapshots,
+    pick_drain_victim_merged,
 )

@@ -895,6 +895,11 @@ class Gateway:
             "latency_p95_ms": self.latency_ms.percentile(0.95),
             "ttft_hist": self.ttft_ms.state(),
             "latency_hist": self.latency_ms.state(),
+            # Wire messages served by this gateway process: the
+            # load-bench calibration divides measured process CPU by
+            # this to get the REAL per-message admission cost
+            # (gw_service_us_measured vs the modeled gw_service_us).
+            "rpc_calls": self._server.calls,
         }
         if metrics_registry is not None:
             self.register_gauges(metrics_registry)

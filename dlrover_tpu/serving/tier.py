@@ -69,6 +69,7 @@ from dlrover_tpu.common.messages import (
     ServeGrants,
     ServeKvReady,
     ServeKvReject,
+    ServeDrainRequest,
     ServeReplicaDeregister,
     ServeReplicaPoll,
     ServeReplicaRegister,
@@ -495,6 +496,16 @@ class GatewayTierNode:
                            self.gateway_id, exc_info=True)
         self.gateway.stop(grace)
 
+    def crash(self) -> None:
+        """Die WITHOUT deregistering (tests/benches): heartbeats stop,
+        the RPC server closes, the registry entry is left to age out —
+        exactly what a killed gateway process looks like to the fleet."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.gateway.stop(0.0)
+
 
 # ---------------------------------------------------------------------------
 # Transport plumbing shared by clients and replicas
@@ -593,6 +604,27 @@ class _GatewaySet:
 # ---------------------------------------------------------------------------
 # Client side: consistent-hash routing + failover resubmit
 # ---------------------------------------------------------------------------
+
+
+def _fetch_gateway_stats(gw_set: _GatewaySet) -> List[Dict[str, Any]]:
+    """One ``ServeFleetStatsRequest`` per live gateway in ``gw_set``,
+    skipping (and dropping) unreachable ones — the shared read loop
+    behind :meth:`TierClient.stats` and :class:`TierActuator`."""
+    snaps: List[Dict[str, Any]] = []
+    gw_set.refresh()
+    for gid, _addr in gw_set.items():
+        tr = gw_set.transport(gid)
+        if tr is None:
+            continue
+        try:
+            resp = tr.call(ServeFleetStatsRequest(), deadline=10.0)
+        except Exception:  # noqa: BLE001 - skip dead gateways
+            gw_set.drop(gid)
+            continue
+        stats = getattr(resp, "stats", None)
+        if isinstance(stats, dict):
+            snaps.append(stats)
+    return snaps
 
 
 class TierClient:
@@ -719,21 +751,7 @@ class TierClient:
     def stats(self) -> List[dict]:
         """One stats snapshot per live gateway (skipping unreachable
         ones) — :func:`merge_snapshots` input."""
-        snaps = []
-        self._set.refresh()
-        for gid, _addr in self._set.items():
-            tr = self._set.transport(gid)
-            if tr is None:
-                continue
-            try:
-                resp = tr.call(ServeFleetStatsRequest(), deadline=10.0)
-            except Exception:  # noqa: BLE001 - skip dead gateways
-                self._set.drop(gid)
-                continue
-            stats = getattr(resp, "stats", None)
-            if isinstance(stats, dict):
-                snaps.append(stats)
-        return snaps
+        return _fetch_gateway_stats(self._set)
 
     def close(self) -> None:
         self._set.close()
@@ -1077,3 +1095,110 @@ class TierStats:
             except Exception:  # noqa: BLE001 - skip dead gateways
                 logger.warning("tier stats fetch failed", exc_info=True)
         return merge_snapshots(snaps)
+
+
+def pick_drain_victim_merged(merged: Dict[str, Any],
+                             role: Optional[str] = None) -> Optional[str]:
+    """Least-loaded non-draining replica by the TIER-WIDE assigned
+    count (the merged snapshot's union view) — the scale-down choice a
+    single gateway cannot make correctly once grants are spread across
+    the shard (its local ``assigned`` undercounts every replica)."""
+    best = None
+    for rid, rep in merged.get("replicas", {}).items():
+        if rep.get("draining"):
+            continue
+        if role is not None and rep.get("role", "unified") != role:
+            continue
+        key = (int(rep.get("assigned", 0)), rid)
+        if best is None or key < best[0]:
+            best = (key, rid)
+    return best[1] if best else None
+
+
+class TierActuator:
+    """Tier-wide serving actuation (ROADMAP 4b): the gateway-shaped
+    surface the autoscalers and the fleet's serving role drive —
+    ``stats_snapshot`` / ``pick_drain_victim`` / ``drain`` — backed by
+    the WHOLE multi-gateway fleet instead of one gateway's view.
+
+    - ``stats_snapshot``: :func:`merge_snapshots` over every live
+      gateway (a single gateway's snapshot sees only its own hash
+      range's queue and its own grants);
+    - ``pick_drain_victim``: least-loaded by the merged union view;
+    - ``drain``: BROADCAST — a replica registers at every gateway, so
+      the drain flag must be set at all of them or the others keep
+      granting and the drain never completes.
+
+    Backends: ``cores`` (in-process ``GatewayCore``/``Gateway.core``
+    handles — master-side and bench fleets) and/or a ``registry``
+    (+``connect``) for subprocess gateways over the wire
+    (``ServeDrainRequest`` / ``ServeFleetStatsRequest``).  A
+    single-entry actuator behaves exactly like the bare core, so the
+    existing ``ServingFleetAutoScaler`` runs unchanged against it."""
+
+    def __init__(self, cores: Optional[List[Any]] = None,
+                 registry: Optional[ServeRegistry] = None,
+                 connect: Optional[Callable[[str], Any]] = None,
+                 refresh_s: float = 1.0):
+        self._cores = list(cores or [])
+        self._set = (
+            _GatewaySet(registry, connect, refresh_s)
+            if registry is not None else None
+        )
+
+    # -- reads --------------------------------------------------------------
+
+    def _snaps(self) -> List[Dict[str, Any]]:
+        snaps = []
+        for core in self._cores:
+            try:
+                snaps.append(core.stats_snapshot())
+            except Exception:  # noqa: BLE001 - skip sick gateways
+                logger.warning("tier actuator: core snapshot failed",
+                               exc_info=True)
+        if self._set is not None:
+            snaps.extend(_fetch_gateway_stats(self._set))
+        return snaps
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        return merge_snapshots(self._snaps())
+
+    def pick_drain_victim(self, role: Optional[str] = None
+                          ) -> Optional[str]:
+        return pick_drain_victim_merged(self.stats_snapshot(), role)
+
+    # -- writes -------------------------------------------------------------
+
+    def drain(self, replica_id: str) -> bool:
+        """Broadcast the drain to every gateway; True if ANY gateway
+        knew the replica (late joiners learn the flag when the replica
+        re-registers there — drain is sticky per gateway)."""
+        any_ok = False
+        for core in self._cores:
+            try:
+                any_ok = core.drain(replica_id) or any_ok
+            except Exception:  # noqa: BLE001 - best-effort broadcast
+                logger.warning("tier actuator: core drain failed",
+                               exc_info=True)
+        if self._set is not None:
+            self._set.refresh()
+            for gid, _addr in self._set.items():
+                tr = self._set.transport(gid)
+                if tr is None:
+                    continue
+                try:
+                    resp = tr.call(
+                        ServeDrainRequest(replica_id=replica_id),
+                        deadline=10.0,
+                    )
+                    any_ok = any_ok or bool(
+                        getattr(resp, "success", False)
+                    )
+                except Exception:  # noqa: BLE001 - dead gateway can't
+                    # grant to the victim anyway
+                    self._set.drop(gid)
+        return any_ok
+
+    def close(self) -> None:
+        if self._set is not None:
+            self._set.close()

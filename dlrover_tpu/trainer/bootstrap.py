@@ -39,6 +39,9 @@ class ElasticContext:
             os.environ.get("DLROVER_TPU_RESTART_COUNT", 0)
         )
         self.rdzv_round = int(os.environ.get("DLROVER_TPU_RDZV_ROUND", 0))
+        #: Fleet role of this process (ISSUE 10): entrypoints shared by
+        #: several roles (e.g. llama_serve_fleet) branch on it.
+        self.node_role = os.environ.get("DLROVER_TPU_NODE_ROLE", "worker")
         self.job_name = env_utils.get_job_name()
         self.master_addr = env_utils.get_master_addr()
         self.client: Optional[MasterClient] = None

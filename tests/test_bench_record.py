@@ -276,13 +276,13 @@ def test_load_bench_smoke_schema(tmp_path):
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, str(Path(bench.__file__)), "--load_bench",
-         "--smoke", f"--out={out}"],
+         "--smoke", "--calibrate", f"--out={out}"],
         capture_output=True, text=True, timeout=120, env=env,
         cwd=str(Path(bench.__file__).parent),
     )
     elapsed = time.time() - t0
     assert proc.returncode == 0, proc.stderr[-2000:]
-    assert elapsed < 30.0, f"smoke load bench took {elapsed:.1f}s"
+    assert elapsed < 45.0, f"smoke load bench took {elapsed:.1f}s"
     result = json.loads(out.read_text())["load"]
     assert result["complete"] is True
     assert result["bench"] == "serve_load"
@@ -322,8 +322,71 @@ def test_load_bench_smoke_schema(tmp_path):
     assert prof["baseline_us"]["submit"] >= \
         prof["fast_path_us"]["submit"] * 0.8
     assert result["serialize_speedup_x"] > 0
+    # Calibration (ROADMAP 4c): real per-message admission CPU from a
+    # subprocess gateway over real sockets, recorded BESIDE the
+    # modeled floor the paced pipelines charge.
+    cal = result["calibration"]
+    assert "error" not in cal, cal
+    assert cal["messages"] > 0
+    assert cal["gw_service_us_measured"] > 0
+    assert cal["gw_service_us"] == result["gw_service_us"]
+    ratio = cal["gw_service_us_measured"] / cal["gw_service_us"]
+    assert abs(cal["measured_over_modeled"] - ratio) < 0.05
     metric = json.loads(proc.stdout.strip().splitlines()[-1])
     assert metric["metric"] == "serve_tier_saturation_speedup"
+    assert metric["artifact"] == str(out)
+
+
+def test_fleet_bench_smoke_schema(tmp_path):
+    """Tier-1 gate for ISSUE 10's mixed-fleet bench: ONE FleetManager
+    (training + supervised gateway tier + serving replicas) runs the
+    two fleet laws end to end in the smoke config — a crashed gateway
+    is RELAUNCHED under its own id with in-flight requests completing
+    exactly-once, and a serving spike borrows a training chip through
+    the live-reshard epoch (drain-first both directions) and hands it
+    back on decay."""
+    import os
+    import subprocess
+    import time
+
+    out = tmp_path / "FLEET_BENCH_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DLROVER_TPU_FAULTS", None)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(Path(bench.__file__)), "--fleet_bench",
+         "--smoke", f"--out={out}"],
+        capture_output=True, text=True, timeout=180, env=env,
+        cwd=str(Path(bench.__file__).parent),
+    )
+    elapsed = time.time() - t0
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert elapsed < 60.0, f"smoke fleet bench took {elapsed:.1f}s"
+    result = json.loads(out.read_text())
+    assert result["bench"] == "fleet"
+    assert result["complete"] is True
+    assert result["formation_ok"] is True
+    gw = result["gateway_relaunch"]
+    assert gw["relaunched"] is True
+    assert gw["incarnations_g1"] >= 2
+    assert gw["inflight_completed"] == gw["inflight_total"]
+    borrow = result["borrow"]
+    assert borrow["borrowed"] and borrow["handed_back"]
+    assert borrow["reshard_status"] == "done"  # the live path, no abort
+    assert borrow["workers_during_borrow"] == \
+        borrow["workers_before"] - 1
+    assert borrow["replicas_during_borrow"] == \
+        borrow["replicas_before"] + 1
+    assert borrow["workers_after"] == borrow["workers_before"]
+    assert borrow["replicas_after"] == borrow["replicas_before"]
+    assert borrow["spike_completed"] == borrow["spike_total"]
+    assert borrow["transitions"] == [
+        "lending", "borrowed", "reclaiming", "idle"
+    ]
+    req = result["requests"]
+    assert req["completed"] == req["submitted"]
+    metric = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert metric["metric"] == "fleet_gateway_relaunch_s"
     assert metric["artifact"] == str(out)
 
 

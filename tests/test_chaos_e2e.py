@@ -982,3 +982,253 @@ class TestGatewayKillFailover:
                     proc.kill()
                     proc.wait()
             registry_server.stop()
+
+
+@pytest.mark.serving
+@pytest.mark.fleet
+class TestFleetGatewayRelaunchMixed:
+    """ISSUE 10 acceptance e2e: ONE fleet — training workers (a real
+    job manager over the in-memory platform, the control-plane-only
+    worker pattern scenario 2 uses) AND a serving role (two subprocess
+    tier gateways + two journaled subprocess replicas) — under one
+    FleetManager.
+
+    ``serving.gateway_kill:method=g1,step_ge=2`` hard-kills gateway g1
+    (exit 81) after two completions with work still in flight.  Where
+    the ISSUE-9 e2e proved the tier merely SURVIVES (survivors adopt
+    the range), the law here is SUPERVISED REPLACEMENT: the fleet
+    reconciler observes the lease lapse, relaunches the gateway under
+    the SAME id (so the replacement re-adopts exactly the dead hash
+    ranges), desired count is restored — and every in-flight request
+    still completes exactly once, with the training role untouched by
+    the churn."""
+
+    def _spawn(self, tmp_path, name, argv, env_extra=None):
+        log = open(tmp_path / f"{name}.log", "w")
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "examples", "llama_serve_fleet.py"),
+             *argv],
+            cwd=REPO, env=_env(env_extra), stdout=log,
+            stderr=subprocess.STDOUT, start_new_session=True,
+        )
+        return proc, tmp_path / f"{name}.log"
+
+    def test_supervisor_replaces_killed_gateway_exactly_once(
+            self, tmp_path):
+        import threading
+
+        from dlrover_tpu.chaos.plan import EXIT_GATEWAY_KILL
+        from dlrover_tpu.common.constants import NodeType
+        from dlrover_tpu.fleet import (
+            FleetManager,
+            GatewayRole,
+            RoleSpec,
+            TrainingRole,
+        )
+        from dlrover_tpu.master.dist_job_manager import (
+            DistributedJobManager,
+        )
+        from dlrover_tpu.master.job_auto_scaler import (
+            AllreduceTrainingAutoScaler,
+        )
+        from dlrover_tpu.master.scaler import PlatformScaler
+        from dlrover_tpu.master.speed_monitor import SpeedMonitor
+        from dlrover_tpu.scheduler.job import JobArgs, NodeGroupArgs
+        from dlrover_tpu.scheduler.platform import InMemoryPlatform
+        from dlrover_tpu.serving import (
+            HashRing,
+            RegistryServer,
+            RpcKv,
+            ServeRegistry,
+            TierClient,
+        )
+
+        registry_server = RegistryServer()
+        journal_dir = str(tmp_path / "journals")
+        procs = []
+        gw_launches = {}  # gid -> [proc, ...] in launch order
+        mu = threading.Lock()
+
+        def spawn_gateway(gid):
+            with mu:
+                first = gid not in gw_launches
+                n = len(gw_launches.setdefault(gid, [])) + 1
+            faults = (
+                "serving.gateway_kill:method=g1,step_ge=2,seed=7"
+                if gid == "g1" and first else None
+            )
+            extra = {"DLROVER_TPU_FAULTS": faults} if faults else None
+            proc, _log = self._spawn(
+                tmp_path, f"gateway-{gid}-{n}",
+                ["--role", "gateway", "--registry",
+                 registry_server.addr, "--gateway_id", gid,
+                 "--lease_timeout", "2"],
+                env_extra=extra,
+            )
+            with mu:
+                gw_launches[gid].append(proc)
+                procs.append(proc)
+            return proc
+
+        # -- the ONE fleet: training role + supervised gateway role.
+        job_args = JobArgs(job_name="fleet")
+        job_args.node_groups[NodeType.WORKER] = NodeGroupArgs(
+            count=2, min_count=1, max_count=4
+        )
+        platform = InMemoryPlatform()
+        jm = DistributedJobManager(
+            job_args, platform, PlatformScaler("fleet", platform)
+        )
+        jm.start()
+        scaler = AllreduceTrainingAutoScaler(
+            job_args, jm, SpeedMonitor(), None
+        )
+        fleet = FleetManager(interval=0.5)
+        fleet.add_role(TrainingRole(
+            RoleSpec("training", desired=2, min_count=1, max_count=4),
+            scaler, jm,
+        ))
+        fleet.add_role(GatewayRole(
+            RoleSpec("gateway", desired=2, min_count=1, max_count=3),
+            ServeRegistry(RpcKv(registry_server.addr), job="fleet",
+                          lease_s=2.0),
+            spawn_gateway, id_prefix="g",
+        ))
+
+        def spawn_replica(rid):
+            proc, log = self._spawn(
+                tmp_path, f"replica-{rid}",
+                ["--role", "replica", "--registry",
+                 registry_server.addr, "--lease_timeout", "2",
+                 "--replica_id", rid,
+                 "--slots", "2", "--max_len", "96",
+                 "--journal_dir", journal_dir,
+                 "--poll_interval", "0.02",
+                 "--round_floor_ms", "30"],
+            )
+            procs.append(proc)
+            return proc, log
+
+        try:
+            fleet.start()  # spawns g0 + g1 on the first pass
+            spawn_replica("r0")
+            spawn_replica("r1")
+
+            registry = ServeRegistry(
+                RpcKv(registry_server.addr), job="fleet", lease_s=2.0,
+            )
+            cli = TierClient(registry, poll_interval=0.05,
+                             refresh_s=0.2)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                snaps = cli.stats()
+                if len(snaps) == 2 and all(
+                    s.get("replicas_alive", 0) >= 2 for s in snaps
+                ):
+                    break
+                time.sleep(0.5)
+            else:
+                pytest.fail("fleet never became 2 gateways x 2 "
+                            "replicas")
+            assert len(jm.alive_workers()) == 2  # training side is up
+
+            import numpy as np
+
+            rng = np.random.RandomState(3)
+            prompts = {
+                f"req-{i}": rng.randint(
+                    1, 64, size=(int(rng.randint(4, 10)),)
+                ).astype(int).tolist()
+                for i in range(12)
+            }
+            budgets = {}
+            for i, (rid, prompt) in enumerate(prompts.items()):
+                budgets[rid] = 6 if i < 4 else 24
+                ack = cli.submit(rid, prompt, budgets[rid],
+                                 submit_timeout=30)
+                assert ack.status in ("accepted", "done"), (rid, ack)
+                time.sleep(0.05)
+
+            # The chaos site fires: g1's FIRST incarnation exits 81.
+            g1_first = None
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                with mu:
+                    launches = gw_launches.get("g1", [])
+                    g1_first = launches[0] if launches else None
+                if g1_first is not None and \
+                        g1_first.poll() is not None:
+                    break
+                time.sleep(0.5)
+            assert g1_first is not None and \
+                g1_first.returncode == EXIT_GATEWAY_KILL, (
+                    "gateway g1 never chaos-killed"
+                )
+
+            # SUPERVISED REPLACEMENT: the reconciler relaunches g1
+            # under its own id; the registry shows the full desired
+            # set again (not merely the survivor adopting the range).
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                with mu:
+                    relaunched = len(gw_launches.get("g1", [])) >= 2
+                if set(registry.gateways()) == {"g0", "g1"} \
+                        and relaunched:
+                    break
+                time.sleep(0.5)
+            assert set(registry.gateways()) == {"g0", "g1"}, (
+                "gateway count never returned to desired"
+            )
+            with mu:
+                assert len(gw_launches["g1"]) >= 2  # real relaunch
+
+            # Every in-flight request completes EXACTLY once across
+            # the death + replacement.
+            tokens = {}
+            for rid in prompts:
+                reply = cli.result(rid, timeout=120)
+                assert reply.state == "done", (rid, reply)
+                assert len(reply.tokens) == budgets[rid], rid
+                tokens[rid] = list(reply.tokens)
+
+            # Exactly-once proven from outside: a full resubmit round
+            # answers byte-identical from journals/dedupe caches.
+            for rid, prompt in prompts.items():
+                ack = cli.submit(rid, prompt, budgets[rid],
+                                 submit_timeout=30)
+                assert ack.status == "done", (rid, ack)
+                assert list(ack.tokens) == tokens[rid], rid
+
+            # The replacement really OWNS the re-adopted ranges: a
+            # fresh request consistent-hashed to g1 completes there.
+            ring = HashRing(["g0", "g1"])
+            extra_rid = next(
+                f"extra-{i}" for i in range(1000)
+                if ring.owner(f"extra-{i}") == "g1"
+            )
+            ack = cli.submit(extra_rid, [1, 2, 3, 4], 6,
+                             submit_timeout=30)
+            assert ack.status in ("accepted", "done")
+            reply = cli.result(extra_rid, timeout=60)
+            assert reply.state == "done"
+
+            # The training role rode through the serving churn.
+            assert len(jm.alive_workers()) == 2
+            status = fleet.status()
+            assert status["roles"]["gateway"]["desired"] == 2
+        finally:
+            fleet.stop()
+            jm.stop()
+            with mu:
+                all_procs = list(procs)
+            for proc in all_procs:
+                if proc.poll() is None:
+                    proc.send_signal(signal.SIGTERM)
+            for proc in all_procs:
+                try:
+                    proc.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+            registry_server.stop()
