@@ -63,6 +63,7 @@ EXIT_REPLICA_KILL = 78
 EXIT_RESHARD_CRASH = 79
 EXIT_SLICE_CRASH = 80
 EXIT_GATEWAY_KILL = 81
+EXIT_DRAFT_KILL = 82
 
 #: site name -> (kind, defaults).  Kinds: ``error`` (caller raises),
 #: ``latency`` (inject() sleeps), ``crash`` (inject() calls os._exit),
@@ -115,6 +116,16 @@ SITES: Dict[str, dict] = {
     # failover.
     "serving.gateway_kill": {
         "kind": "crash", "exit": EXIT_GATEWAY_KILL, "times": 1,
+    },
+    # Draft-replica site (ISSUE 11): kill the speculation proposal
+    # server mid-round, in its proposal loop (``method=<worker_id>``
+    # selects which; ``step`` reports completed rolls so ``step_ge``
+    # gates on progress).  Correctness is owned by the TARGET's
+    # acceptance, so the only legal observable effect on request
+    # streams is degradation: spec targets count spec_fallbacks and
+    # finish every in-flight request exactly-once via plain decode.
+    "serving.draft_kill": {
+        "kind": "crash", "exit": EXIT_DRAFT_KILL, "times": 1,
     },
     "master.restart": {
         "kind": "crash", "exit": EXIT_MASTER_RESTART, "times": 1,

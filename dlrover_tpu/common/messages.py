@@ -713,11 +713,21 @@ class ServeReplicaRegister(Message):
     """``role`` (ISSUE 8): ``unified`` replicas run the full
     prefill+decode path; ``prefill`` replicas only score prompts and
     export KV segments; ``decode`` replicas only continue from imported
-    segments (missing field on old senders decodes to "" = unified)."""
+    segments (missing field on old senders decodes to "" = unified).
+
+    Speculative serving (ISSUE 11): ``spec`` advertises that this
+    replica can run speculative decode rounds (a local draft model, or
+    a server sized to accept a remote draft handle) — the gateway's
+    grant scan prefers spec replicas for long-decode requests.  A
+    ``draft``-role replica additionally announces ``draft_addr``, the
+    address of its proposal server, which the gateway hands to spec
+    targets in every poll reply."""
 
     replica_id: str = ""
     slots: int = 0
-    role: str = "unified"  # unified | prefill | decode
+    role: str = "unified"  # unified | prefill | decode | draft
+    spec: bool = False
+    draft_addr: str = ""
 
 
 @dataclasses.dataclass
@@ -755,6 +765,11 @@ class ServeGrants(Message):
     cancel: List[str] = dataclasses.field(default_factory=list)
     drain: bool = False
     known: bool = True
+    #: Current draft-proposal endpoint (ISSUE 11): the address of a
+    #: live draft-role replica's proposal server, refreshed every poll
+    #: so spec targets attach/detach their remote draft as draft
+    #: replicas come and go ("" = no draft alive).
+    draft_addr: str = ""
 
 
 @dataclasses.dataclass
@@ -781,6 +796,12 @@ class ServeDone(Message):
     ok: bool = True
     reason: str = ""
     replayed: bool = False
+    #: Per-request speculation telemetry (ISSUE 11): the accepted-
+    #: tokens-per-round this request earned and the speculative rounds
+    #: it rode.  Journaled with the completion, so a replay reports
+    #: the SAME numbers the request earned live (0 = never speculated).
+    tokens_per_round: float = 0.0
+    spec_rounds: int = 0
 
 
 @dataclasses.dataclass
@@ -824,6 +845,37 @@ class KvSegmentData(Message):
     reason: str = ""
     payload: bytes = b""
     crc32: int = 0
+
+
+@dataclasses.dataclass
+class DraftRoll(Message):
+    """Spec target replica -> draft replica's proposal server (ISSUE
+    11): one speculative round's proposal fetch for every stream the
+    target is speculating.  Each entry of ``streams`` is a dict —
+    ``{"rid": str, "ctx": [ints emitted since the last roll], "open":
+    [prompt tokens]}`` (``open`` only on the first roll of a stream, or
+    after the draft evicted it) — the draft catches its per-stream
+    cache up from exactly that delta, rolls ``k`` proposals, and ships
+    them back CRC-wrapped (the KV-segment envelope idiom).  ``close``
+    piggybacks finished/aborted stream ids for cache hygiene."""
+
+    replica_id: str = ""
+    k: int = 4
+    sample: bool = False
+    streams: List[dict] = dataclasses.field(default_factory=list)
+    close: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class DraftProposals(Message):
+    """Proposal-server reply: ``payload`` is the CRC-wrapped msgpack
+    proposal bundle (``serving.draft.pack_proposals``); ``found=False``
+    carries the failure reason — the target degrades to plain decode,
+    it never waits."""
+
+    found: bool = False
+    reason: str = ""
+    payload: bytes = b""
 
 
 @dataclasses.dataclass

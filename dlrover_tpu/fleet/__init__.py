@@ -43,6 +43,7 @@ from dlrover_tpu.fleet.role import (  # noqa: F401
     RoleStatus,
 )
 from dlrover_tpu.fleet.roles import (  # noqa: F401
+    DraftRole,
     EmbeddingRole,
     GatewayRole,
     ServingReplicaRole,

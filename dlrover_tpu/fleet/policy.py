@@ -46,6 +46,17 @@ class BorrowPolicy:
     max_borrow: int = 1
     #: Passes to sit idle after a full borrow/hand-back cycle.
     cooldown_passes: int = 3
+    #: GAIN mode (ISSUE 11, draft-vs-target arbitration): when the
+    #: arbiter is built with a ``gain_fn`` (a measured earned-value
+    #: signal — accepted tokens/round for a draft pool), the borrow
+    #: trigger is the signal EXCEEDING ``gain_high`` (the pool is
+    #: earning more than a chip costs; typically break-even + margin)
+    #: and the hand-back trigger is a MEASURED signal below
+    #: ``gain_low`` (typically break-even: below it the chips decode
+    #: faster as plain capacity).  An unmeasured signal (0) holds —
+    #: silence must not flap chips.
+    gain_high: float = 0.0
+    gain_low: float = 0.0
 
 
 class ChipBorrowArbiter:
@@ -62,11 +73,17 @@ class ChipBorrowArbiter:
         borrower: RoleAdapter,
         policy: Optional[BorrowPolicy] = None,
         signal_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        gain_fn: Optional[Callable[[], float]] = None,
     ):
         self.lender = lender
         self.borrower = borrower
         self.policy = policy or BorrowPolicy()
         self._signal_fn = signal_fn
+        #: GAIN mode (ISSUE 11): arbitrate by a measured earned-value
+        #: signal instead of queue depth — the draft-vs-target split
+        #: follows measured tokens/round, not hardware identity (the
+        #: VirtualFlow decoupling argument).
+        self._gain_fn = gain_fn
         self.phase = IDLE
         self.borrowed = 0
         self._spike_streak = 0
@@ -97,11 +114,28 @@ class ChipBorrowArbiter:
     # -- the pass ------------------------------------------------------------
 
     def step(self, fleet=None) -> str:
-        qpm = self._queue_per_member()
-        if qpm > self.policy.queue_high_per_member:
+        if self._gain_fn is not None:
+            # GAIN mode: spike = the borrower's measured earned value
+            # EXCEEDS gain_high (it deserves another chip); decay = a
+            # MEASURED value below gain_low (below break-even the chip
+            # is worth more back at the lender).  Unmeasured (0) holds
+            # every streak — silence must not move chips.
+            qpm = float(self._gain_fn() or 0.0)
+            metric = "tokens/round"
+            high, low = self.policy.gain_high, self.policy.gain_low
+            spike = high > 0 and qpm > high
+            decay = 0 < qpm < low
+        else:
+            qpm = self._queue_per_member()
+            metric = "queue/member"
+            high = self.policy.queue_high_per_member
+            low = self.policy.queue_low_per_member
+            spike = qpm > high
+            decay = qpm < low
+        if spike:
             self._spike_streak += 1
             self._decay_streak = 0
-        elif qpm < self.policy.queue_low_per_member:
+        elif decay:
             self._decay_streak += 1
             self._spike_streak = 0
         else:
@@ -124,8 +158,7 @@ class ChipBorrowArbiter:
                 if self.lender.lend_one():
                     self._move(
                         LENDING,
-                        f"queue/member {qpm:.1f} > "
-                        f"{self.policy.queue_high_per_member} for "
+                        f"{metric} {qpm:.1f} > {high} for "
                         f"{self._spike_streak} passes",
                     )
                     self._spike_streak = 0
@@ -154,8 +187,7 @@ class ChipBorrowArbiter:
                 if self.borrower.shrink_one():
                     self._move(
                         RECLAIMING,
-                        f"queue/member {qpm:.1f} < "
-                        f"{self.policy.queue_low_per_member} for "
+                        f"{metric} {qpm:.1f} < {low} for "
                         f"{self._decay_streak} passes",
                     )
                     self._decay_streak = 0
@@ -179,6 +211,7 @@ class ChipBorrowArbiter:
     def describe(self) -> Dict[str, Any]:
         return {
             "policy": "chip_borrow",
+            "mode": "gain" if self._gain_fn is not None else "queue",
             "lender": self.lender.name,
             "borrower": self.borrower.name,
             "phase": self.phase,

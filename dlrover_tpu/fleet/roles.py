@@ -300,6 +300,139 @@ class ServingReplicaRole(RoleAdapter):
         self.drain_pending()
 
 
+class DraftRole(RoleAdapter):
+    """Draft replicas as the FIFTH role family (ISSUE 11): small
+    speculation proposal servers (``serving.draft``) behind the same
+    gateway-shaped actuator the serving role uses.
+
+    The role's own policy is the EARNED-VALUE signal: the acceptance
+    its proposals win at the spec targets (the gateway snapshot's
+    ``pools["draft"]["tokens_per_round"]``, measured at the CONSUMERS).
+    A MEASURED value below ``break_even`` sustained ``low_patience``
+    passes shrinks the pool toward its floor — below break-even a
+    draft chip decodes more tokens as plain target capacity, so the
+    role hands it back (the :class:`~dlrover_tpu.fleet.policy.
+    ChipBorrowArbiter` in gain mode drives the cross-role half).
+    Growth is driven from outside (the arbiter's reclaim/borrow, or an
+    operator raising ``desired``) — an unmeasured signal never grows a
+    pool speculatively.  Shrink is the serving drain two-phase: the
+    draft deregisters, spec targets detach on their next poll and
+    degrade to plain decode mid-request (speculation is an
+    optimization, never a dependency)."""
+
+    def __init__(
+        self,
+        spec: RoleSpec,
+        actuator,
+        spawn_fn: Callable[..., Any],
+        break_even: float = 3.3,
+        low_patience: int = 3,
+        release_fn: Optional[Callable[[str], Any]] = None,
+    ):
+        super().__init__(spec)
+        self._actuator = actuator
+        self._spawn_fn = spawn_fn
+        self.break_even = float(break_even)
+        self.low_patience = max(1, int(low_patience))
+        self._release_fn = release_fn
+        self._low_streak = 0
+        self._drain_victim: Optional[str] = None
+        self._expected: list = []
+        self._pass_snap: Optional[Dict[str, Any]] = None
+
+    def reconcile(self) -> int:
+        self._pass_snap = self._actuator.stats_snapshot()
+        return super().reconcile()
+
+    def _snapshot(self) -> Dict[str, Any]:
+        if self._pass_snap is not None:
+            return self._pass_snap
+        return self._actuator.stats_snapshot()
+
+    def observe(self) -> RoleStatus:
+        snap = self._snapshot()
+        replicas = snap.get("replicas", {})
+        members = tuple(
+            rid for rid, r in replicas.items()
+            if r.get("role") == "draft" and not r.get("draining")
+        )
+        draining = tuple(
+            rid for rid, r in replicas.items()
+            if r.get("role") == "draft" and r.get("draining")
+        )
+        now = time.monotonic()
+        with self._mu:
+            self._expected = [
+                d for d in self._expected if d > now
+            ][: max(0, self.spec.desired - len(members))]
+            pending = tuple(
+                f"pending-{i}" for i in range(len(self._expected))
+            )
+        pool = snap.get("pools", {}).get("draft", {})
+        counters = snap.get("counters", {})
+        return RoleStatus(
+            members=members,
+            pending=pending,
+            draining=draining,
+            signals={
+                "tokens_per_round": pool.get("tokens_per_round", 0.0),
+                "spec_fallbacks": counters.get("spec_fallbacks", 0),
+                "spec_rounds": counters.get("spec_rounds", 0),
+            },
+        )
+
+    def policy_target(self, status: RoleStatus) -> Optional[int]:
+        tpr = float(status.signals.get("tokens_per_round", 0.0))
+        if 0 < tpr < self.break_even and status.members:
+            self._low_streak += 1
+            if self._low_streak >= self.low_patience:
+                self._low_streak = 0
+                return self.spec.desired - 1
+        else:
+            self._low_streak = 0
+        return None
+
+    def spawn(self, n: int) -> int:
+        deadline = time.monotonic() + self.spec.spawn_grace_s
+        with self._mu:
+            self._expected.extend([deadline] * n)
+        self._spawn_fn(n, role="draft")
+        return n
+
+    def begin_drain(self) -> Optional[str]:
+        if self._drain_victim is not None:
+            return None
+        victim = self._actuator.pick_drain_victim(role="draft")
+        if victim is None:
+            return None
+        self._actuator.drain(victim)
+        self._drain_victim = victim
+        logger.info("fleet[%s]: draining draft %s", self.name, victim)
+        return victim
+
+    def drain_pending(self) -> bool:
+        if self._drain_victim is None:
+            return False
+        snap = self._snapshot()
+        if self._drain_victim in snap.get("replicas", {}):
+            return True
+        victim, self._drain_victim = self._drain_victim, None
+        if self._release_fn is not None:
+            try:
+                self._release_fn(victim)
+            except Exception:
+                logger.exception(
+                    "fleet[%s]: release of %s failed", self.name, victim
+                )
+        logger.info(
+            "fleet[%s]: drain of draft %s complete", self.name, victim
+        )
+        return False
+
+    def pump_drain(self) -> None:
+        self.drain_pending()
+
+
 class GatewayRole(RoleAdapter):
     """Gateways as a SUPERVISED role (ROADMAP 4a).
 

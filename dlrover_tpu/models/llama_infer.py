@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dlrover_tpu.common.log import logger
 from dlrover_tpu.models import llama
 from dlrover_tpu.models.llama import LlamaConfig, _rope
 from dlrover_tpu.ops.rmsnorm import rmsnorm
@@ -592,6 +593,7 @@ def _spec_accept_batch(
     d: np.ndarray,  # [B, k] draft proposals
     done: np.ndarray,  # [B] frozen rows (consume draws, results ignored)
     np_rng: "np.random.Generator",
+    k_row: Optional[np.ndarray] = None,  # [B] per-row width <= k
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Vectorized rejection-sampling acceptance over the batch — the
     numpy-batched form of :func:`_spec_accept_round` (the scalar
@@ -600,7 +602,15 @@ def _spec_accept_batch(
     single host sync per round.  Returns ``(j, tok)``: per row the
     accepted-prefix length and the round's final sampled token.  Frozen
     rows draw uniforms they ignore; each active row's law is unchanged
-    (independent draws)."""
+    (independent draws).
+
+    ``k_row`` (ISSUE 11, per-request adaptive k): row b behaves as a
+    ``k_row[b]``-proposal round — proposals beyond its width are
+    ignored, and a row accepting its full width draws the bonus token
+    from the target law at that position (``k_row[b] == 0`` is plain
+    target sampling).  The SAME uniforms are consumed with or without
+    truncation, so each stream's law is exactly the scalar spec's at
+    its own width."""
     B, k = d.shape
     V = p.shape[-1]
     rows = np.arange(B)
@@ -610,12 +620,19 @@ def _spec_accept_batch(
     acc = np_rng.random((B, k)) < p_sel / np.maximum(q_sel, 1e-30)
     # First rejected position (k if none): the accepted-prefix length.
     j = acc.astype(np.int64).cumprod(axis=1).sum(axis=1)
+    if k_row is not None:
+        kw = np.minimum(np.asarray(k_row, np.int64), k)
+        j = np.minimum(j, kw)
+    else:
+        kw = np.full(B, k, np.int64)
     j = np.where(done, 0, j)
     # Rejected rows draw from the residual law at position j; fully
-    # accepting rows draw the bonus token from the target's p[k].
+    # accepting rows (at their own width) draw the bonus token from
+    # the target's p at that position.
     p_j = p[rows, j]  # [B, V]
     q_j = q[rows, np.minimum(j, k - 1)]  # [B, V]
-    resid = np.where((j < k)[:, None], np.clip(p_j - q_j, 0.0, None), p_j)
+    resid = np.where((j < kw)[:, None], np.clip(p_j - q_j, 0.0, None),
+                     p_j)
     s = resid.sum(axis=1)
     # p == q to numerical precision: the residual is empty; any draw
     # from p is distribution-correct.
@@ -766,6 +783,7 @@ def _spec_decode_round(
     np_rng: "np.random.Generator",
     sub: jax.Array,  # draft-sampling key (dead in the greedy trace)
     max_off: Optional[np.ndarray] = None,  # [B] per-row offset bound
+    k_row: Optional[np.ndarray] = None,  # [B] per-row width <= k
 ) -> Tuple[list, np.ndarray, Dict, Dict]:
     """ONE speculative round over a ragged batch: draft k proposals per
     row, one chunked (k+1)-token verify at per-row offsets, per-row
@@ -775,7 +793,8 @@ def _spec_decode_round(
     the round's emitted tokens for row b (empty when frozen) BEFORE any
     EOS/budget truncation — truncation only marks rows done, it never
     changes cache state, so callers (the batched generator, the
-    speculative DecodeServer) own it."""
+    speculative DecodeServer) own it.  ``k_row`` truncates each row to
+    its own speculation width (see :func:`_spec_accept_batch`)."""
     B = int(cur.shape[0])
     n_dev = cache_t["offset"]  # [B] handle; fetched with the round's sync
     d, q, cache_d = progs["draft_roll"](draft_params, cache_d, cur, sub)
@@ -792,12 +811,19 @@ def _spec_decode_round(
         n, d_host, g_raw, q_raw = jax.device_get((n_dev, d, g, q))
         g_host = np.asarray(g_raw, np.float64)  # [B, k+1, V]
         q_host = np.asarray(q_raw, np.float64)  # [B, k, V]
-        j, tok = _spec_accept_batch(g_host, q_host, d_host, done, np_rng)
+        j, tok = _spec_accept_batch(g_host, q_host, d_host, done, np_rng,
+                                    k_row=k_row)
         nxt = np.where(done, cur_h, tok).astype(cur_h.dtype)
     else:
         n, d_host, g_host = jax.device_get((n_dev, d, g))  # g [B, k+1]
         match = (d_host == g_host[:, :k]).astype(np.int64)
         j = match.cumprod(axis=1).sum(axis=1)  # longest matching prefix
+        if k_row is not None:
+            # Per-row width: the greedy law at width k_b emits the
+            # matched prefix up to k_b plus the target's own token at
+            # the truncation point — still exactly the target's greedy
+            # stream, whatever the draft proposed beyond the width.
+            j = np.minimum(j, np.asarray(k_row, np.int64))
         j = np.where(done, 0, j)
         nxt = np.where(done, cur_h, g_host[rows, j]).astype(cur_h.dtype)
     n = np.asarray(n)
@@ -1131,6 +1157,84 @@ def _adapt_spec_k(cur_k: int, draft_k: int, acc: float) -> int:
     return cur_k
 
 
+def _spec_k_request(ewma: float, draft_k: int, break_even: float) -> int:
+    """Per-STREAM speculation width from its measured acceptance EWMA
+    (ISSUE 11) — pure, so the serving arithmetic is directly testable.
+    ``ewma`` is the stream's accepted-tokens-per-round (0 = no
+    measurement yet: start at full width and let the first rounds
+    decide).  Below ``break_even`` — the measured round-cost ratio
+    ``(t_draft_roll + t_verify) / t_plain_step`` from
+    ``SPEC_DECODE_CPU.json``'s components row — drafting costs more
+    target-equivalent time than it saves, so the stream decodes PLAIN
+    (k = 0): a bad draft can never make a request slower than a
+    spec-less replica serves it.  Above break-even the stream keeps a
+    width it actually fills (capped at ``draft_k``: the cache headroom
+    was sized with it)."""
+    if ewma <= 0.0:
+        return draft_k
+    if ewma < break_even:
+        return 0
+    return max(1, min(draft_k, int(ewma)))
+
+
+def _spec_remote_round(
+    progs: Dict,
+    params: Dict,
+    cache_t: Dict,
+    cur: jax.Array,  # [B] current input token per row
+    done: np.ndarray,  # [B] frozen rows
+    d_host: np.ndarray,  # [B, k] proposals (remote draft; zeros ok)
+    q_host: Optional[np.ndarray],  # [B, k, V] draft probs (sampled)
+    k: int,
+    sample: bool,
+    np_rng: "np.random.Generator",
+    k_row: Optional[np.ndarray] = None,
+    max_off: Optional[np.ndarray] = None,
+) -> Tuple[list, np.ndarray, Dict]:
+    """ONE speculative round whose proposals arrived from a REMOTE
+    draft replica (ISSUE 11): the target-side half of
+    :func:`_spec_decode_round` — chunked verify, per-row acceptance,
+    cache rewind — with no local draft cache to maintain (the draft
+    replica keeps its own per-stream cache and catches up from the
+    context deltas the next roll ships).  Acceptance laws are shared
+    with the local path, so the emitted stream per row is identical to
+    sequential target decoding whatever the remote draft proposes."""
+    B = int(cur.shape[0])
+    n_dev = cache_t["offset"]
+    chunk = jnp.concatenate(
+        [cur[:, None], jnp.asarray(d_host, jnp.int32)], axis=1
+    )  # [B, k+1]
+    g, cache_t = progs["target_verify"](params, cache_t, chunk)
+    rows = np.arange(B)
+    cur_h = np.asarray(cur)
+    if sample:
+        n, g_raw = jax.device_get((n_dev, g))
+        g_h = np.asarray(g_raw, np.float64)  # [B, k+1, V]
+        j, tok = _spec_accept_batch(
+            g_h, np.asarray(q_host, np.float64), d_host, done, np_rng,
+            k_row=k_row,
+        )
+        nxt = np.where(done, cur_h, tok).astype(cur_h.dtype)
+    else:
+        n, g_h = jax.device_get((n_dev, g))  # g [B, k+1]
+        match = (d_host == g_h[:, :k]).astype(np.int64)
+        j = match.cumprod(axis=1).sum(axis=1)
+        if k_row is not None:
+            j = np.minimum(j, np.asarray(k_row, np.int64))
+        j = np.where(done, 0, j)
+        nxt = np.where(done, cur_h, g_h[rows, j]).astype(cur_h.dtype)
+    n = np.asarray(n)
+    new_n = np.where(done, n, n + 1 + j)
+    if max_off is not None:
+        new_n = np.minimum(new_n, max_off)
+    cache_t = dict(cache_t, offset=jnp.asarray(new_n, jnp.int32))
+    accepted_rows = [
+        [] if done[b] else list(d_host[b, : j[b]]) + [nxt[b]]
+        for b in range(B)
+    ]
+    return accepted_rows, nxt, cache_t
+
+
 class DecodeServer:
     """Continuous-batching greedy/sampled decode over fixed slots — the
     role vllm plays for the reference's RL engine
@@ -1167,6 +1271,24 @@ class DecodeServer:
         draft_k: int = 4,
         adapt_k: bool = False,  # shrink/regrow k from measured acceptance
         adapt_every: int = 16,  # rounds per adaptation window
+        # Per-REQUEST adaptive k (ISSUE 11, the serving mode): each
+        # stream carries its own acceptance EWMA and speculation width
+        # (``_spec_k_request``); below ``spec_break_even`` the stream
+        # decodes plain (k=0, probed again every ``spec_probe_every``
+        # of its rounds), so a bad draft can never make a request
+        # slower than a spec-less replica.  Mutually exclusive with the
+        # global ``adapt_k`` window policy.
+        adapt_k_per_request: bool = False,
+        spec_break_even: float = 0.0,  # 0 = 1 + 0.6*draft_k (measured
+        # shape of SPEC_DECODE_CPU.json's break-even at k=4)
+        spec_probe_every: int = 32,
+        spec_ewma_alpha: float = 0.25,
+        # Remote-draft speculation (ISSUE 11): the server may be handed
+        # a draft PROPOSAL handle (``set_remote_draft``) whose rolls
+        # run on a separate draft replica; declaring the intent at
+        # construction sizes the cache-write headroom for speculative
+        # overshoot even before a draft is attached.
+        spec_remote: bool = False,
         # Plain (non-speculative) decode: tokens per dispatch.  K > 1
         # runs K steps under one lax.scan dispatch — K x fewer device
         # round-trips and host emit loops.  The cost is admission
@@ -1203,10 +1325,39 @@ class DecodeServer:
         self.draft_k = draft_k
         self.adapt_k = adapt_k
         self.adapt_every = max(1, adapt_every)
+        self.adapt_k_per_request = adapt_k_per_request
+        if adapt_k and adapt_k_per_request:
+            raise ValueError(
+                "adapt_k (global window) and adapt_k_per_request "
+                "(per-stream EWMA) are mutually exclusive policies"
+            )
+        self.spec_break_even = (
+            float(spec_break_even) if spec_break_even > 0
+            else 1.0 + 0.6 * draft_k
+        )
+        self.spec_probe_every = max(1, int(spec_probe_every))
+        self.spec_ewma_alpha = float(spec_ewma_alpha)
+        self.spec_remote = bool(spec_remote)
+        #: Remote draft-proposal handle (``propose(reqs, k, sample=,
+        #: close=) -> {rid: {"d": [k] ints, "q": [k, V] or None}}``);
+        #: set/cleared by the replica runner as draft replicas come and
+        #: go.  Any handle failure degrades THIS serve loop to plain
+        #: decode until a DIFFERENT handle is attached.
+        self._remote_draft: Optional[Any] = None
+        #: Reusable [slots, draft_k, V] draft-prob buffer for sampled
+        #: remote rounds (a fresh float64 alloc per round would be MBs
+        #: of churn at production vocab sizes; stale values in rows a
+        #: round does not ship are never read past their width).
+        self._spec_q_buf: Optional[np.ndarray] = None
+        if spec_remote and draft is not None:
+            raise ValueError(
+                "spec_remote does not compose with a local draft "
+                "model (one proposal source per server)"
+            )
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, got "
                              f"{decode_chunk}")
-        if decode_chunk > 1 and draft is not None:
+        if decode_chunk > 1 and (draft is not None or spec_remote):
             # Speculative rounds already batch k+1 tokens per dispatch;
             # silently ignoring the flag would let a user believe they
             # are benchmarking the K-dispatch lever while measuring
@@ -1257,6 +1408,13 @@ class DecodeServer:
         # Prefill-role exports (ISSUE 8): rid -> prefilled slot rows
         # awaiting export_kv (host arrays; dropped on export).
         self._kv_exports: Dict[Any, Dict[str, Any]] = {}
+        # Per-request speculation telemetry (ISSUE 11): finished
+        # requests park their accepted-tokens-per-round here until the
+        # runner pops them into the ServeDone/journal record.  Bounded
+        # oldest-first — the runner pops immediately, the cap only
+        # guards a caller that never does.
+        self._req_stats_out: "collections.OrderedDict" = \
+            collections.OrderedDict()
         # Live views for the replica runner's poll report (valid while
         # a serve loop runs; empty otherwise).
         self._live_active: Any = None
@@ -1309,14 +1467,46 @@ class DecodeServer:
 
     def _write_slack(self) -> int:
         """Cache-write headroom past the emission budget: speculative
-        rounds overshoot by up to draft_k+1 slots before the rewind;
-        chunked decode writes up to decode_chunk-1 slots past a
-        mid-chunk finish.  An out-of-range scatter is silently DROPPED
-        by JAX, so every capacity check must include this."""
+        rounds (local OR remote draft) overshoot by up to draft_k+1
+        slots before the rewind; chunked decode writes up to
+        decode_chunk-1 slots past a mid-chunk finish.  An out-of-range
+        scatter is silently DROPPED by JAX, so every capacity check
+        must include this."""
         return (
-            (self.draft_k + 1) if self.draft is not None
+            (self.draft_k + 1) if self.spec_capable
             else self.decode_chunk - 1
         )
+
+    @property
+    def spec_capable(self) -> bool:
+        """This server can run speculative rounds: a local draft model,
+        or the declared intent to accept a remote draft handle — what
+        the replica advertises in ``ServeReplicaRegister.spec``."""
+        return self.draft is not None or self.spec_remote
+
+    def set_remote_draft(self, handle) -> None:
+        """Attach (or with ``None`` detach) a remote draft-proposal
+        handle.  Only legal on a ``spec_remote`` server — the cache
+        headroom and capacity checks were sized for speculation at
+        construction; attaching a draft to an unsized server could
+        scatter past max_len."""
+        if handle is not None and not self.spec_remote:
+            raise ValueError(
+                "set_remote_draft on a server built without "
+                "spec_remote=True (capacity headroom not sized for "
+                "speculative overshoot)"
+            )
+        self._remote_draft = handle
+
+    def pop_request_stats(self, rid) -> Optional[Dict[str, Any]]:
+        """Consume the per-request speculation telemetry recorded when
+        ``rid`` finished: ``{"tokens_per_round", "spec_rounds",
+        "k_last"}`` — what the runner folds into the ServeDone report
+        and the journal record (so a replay reports the SAME
+        acceptance the request earned live).  None for requests that
+        never ran speculative rounds."""
+        with self._pending_mu:
+            return self._req_stats_out.pop(rid, None)
 
     def check_capacity(self, prompt_len: int, max_new_tokens: int,
                        prefix_len: int = 0) -> None:
@@ -1704,6 +1894,95 @@ class DecodeServer:
             for cl, sc in zip(cache["layers"], sub_layers)
         ]
 
+    def _remote_propose(self, handle, k: int, k_arr, active, slot_req,
+                        slot_prompt, slot_out, draft_mark, draft_open,
+                        draft_close, sample: bool):
+        """Collect per-stream context deltas and fetch one round of
+        proposals from the remote draft handle (ISSUE 11).  Streams
+        unknown to the draft ship their full prompt (``open``); known
+        ones ship only the tokens emitted since the last roll — the
+        draft catches its cache up from exactly that delta.  Returns
+        ``(d [B, k], q [B, k, V] | None, k_arr)`` with rows the draft
+        dropped (evicted stream) forced to width 0 for this round, or
+        ``None`` on a handle failure — the caller degrades to plain
+        decode, it never stalls."""
+        import numpy as onp
+
+        B = self.slots
+        reqs = []
+        shipped = []
+        for s in range(B):
+            if not active[s] or (k_arr is not None and k_arr[s] == 0):
+                continue
+            # rids normalize to str on the wire (msgpack map keys);
+            # batch-mode int rids must round-trip identically.
+            entry: Dict[str, Any] = {"rid": str(slot_req[s])}
+            if draft_open[s]:
+                entry["ctx"] = [
+                    int(t) for t in slot_out[s][draft_mark[s]:]
+                ]
+            else:
+                entry["open"] = [int(t) for t in slot_prompt[s]]
+                entry["ctx"] = [int(t) for t in slot_out[s]]
+            reqs.append(entry)
+            shipped.append(s)
+        close, draft_close[:] = list(draft_close), []
+        try:
+            props = handle.propose(reqs, k, sample=sample, close=close)
+        except Exception as e:  # noqa: BLE001 - degrade, never stall
+            draft_close.extend(close)  # undelivered; retry on re-attach
+            logger.warning("remote draft proposal failed: %s", e)
+            return None
+        V = self.cfg.vocab_size
+        d = onp.zeros((B, k), onp.int64)
+        q = None
+        if sample:
+            # Width-0 / dropped rows never read their q past their
+            # width — the uniform filler (and any stale probs from a
+            # previous round) only keeps the batched arithmetic
+            # finite, so the buffer is reused across rounds.
+            if self._spec_q_buf is None:
+                self._spec_q_buf = onp.full(
+                    (B, self.draft_k, V), 1.0 / V, onp.float64
+                )
+            q = self._spec_q_buf[:, :k]
+        if k_arr is None:
+            k_arr = onp.where(
+                onp.asarray(active, bool), k, 0
+            ).astype(onp.int64)
+        else:
+            k_arr = onp.asarray(k_arr, onp.int64).copy()
+        props = props or {}
+        for s in shipped:
+            got = props.get(str(slot_req[s]))
+            if got is None:
+                # The draft dropped/evicted this stream: plain law for
+                # the round; re-open (full context) on the next roll.
+                k_arr[s] = 0
+                draft_open[s] = False
+                continue
+            dk = onp.asarray(got["d"], onp.int64).reshape(-1)[:k]
+            d[s, : len(dk)] = dk
+            if len(dk) < k:
+                k_arr[s] = min(int(k_arr[s]), len(dk))
+            if sample:
+                qk = onp.asarray(got.get("q"), onp.float64)
+                if qk.ndim != 2 or qk.shape[1] != V:
+                    # A malformed proposal law is a broken draft, not a
+                    # dropped stream: the worker already advanced its
+                    # cache by this ctx, so re-shipping would corrupt
+                    # its offsets — fail the handle instead.
+                    logger.warning(
+                        "remote draft returned malformed probs for "
+                        "%s; dropping the draft", slot_req[s],
+                    )
+                    return None
+                qn = min(k, qk.shape[0])
+                q[s, :qn] = qk[:qn]
+            draft_mark[s] = len(slot_out[s])
+            draft_open[s] = True
+        return d, q, k_arr
+
     def _prefill(self, bucket: int, cfg: Optional[LlamaConfig] = None):
         """Jitted: score one right-padded prompt into slot ``s``'s cache
         rows; returns (cache, first sampled token).  ``cfg`` defaults
@@ -1907,6 +2186,18 @@ class DecodeServer:
         # Per-slot offset bound (speculative rounds clamp finishing
         # rows here; see _spec_decode_round's max_off).
         slot_bound = onp.zeros((B,), onp.int64)
+        # Per-slot speculation state (ISSUE 11): per-REQUEST width and
+        # acceptance EWMA (adapt_k_per_request), per-request telemetry,
+        # and the remote-draft context-sync marks (how many of the
+        # slot's emitted tokens the draft replica has already scored).
+        req_k = [self.draft_k] * B
+        req_ewma = [0.0] * B
+        req_rounds = [0] * B       # spec rounds this request rode
+        req_tokens = [0] * B       # tokens those rounds accepted
+        req_plain = [0] * B        # consecutive plain rounds at k == 0
+        draft_mark = [0] * B       # slot_out tokens shipped to draft
+        draft_open = [False] * B   # stream opened at the remote draft
+        draft_close: list = []     # finished rids to close remotely
 
         def copy_template(c, tmpl_layers, slot, p0, role):
             """Slot rows := template rows (one dynamic_update_slice per
@@ -2016,6 +2307,13 @@ class DecodeServer:
             slot_prompt[slot] = prompt
             slot_out[slot] = [int(first)]
             budget[slot] = mnt - 1
+            # Fresh per-request speculation state: every request
+            # starts at full width and earns its own EWMA.
+            req_k[slot] = self.draft_k
+            req_ewma[slot] = 0.0
+            req_rounds[slot] = req_tokens[slot] = req_plain[slot] = 0
+            draft_mark[slot] = 0
+            draft_open[slot] = False
             if on_token is not None:
                 on_token(rid, int(first))
             if int(first) == self.eos_token or budget[slot] <= 0:
@@ -2090,6 +2388,21 @@ class DecodeServer:
             out = onp.concatenate(
                 [slot_prompt[slot], onp.asarray(slot_out[slot], onp.int32)]
             )
+            if self.spec_capable and req_rounds[slot]:
+                # Park the request's earned acceptance for the runner
+                # to fold into ServeDone + the journal (ISSUE 11).
+                with self._pending_mu:
+                    self._req_stats_out[rid] = {
+                        "tokens_per_round": (
+                            req_tokens[slot] / req_rounds[slot]
+                        ),
+                        "spec_rounds": req_rounds[slot],
+                        "k_last": req_k[slot],
+                    }
+                    while len(self._req_stats_out) > 512:
+                        self._req_stats_out.popitem(last=False)
+            if draft_open[slot]:
+                draft_close.append(rid)
             if tick is None:
                 # Batch mode returns the result dict; the incremental
                 # loop delivers via on_finish ONLY — retaining every
@@ -2132,28 +2445,27 @@ class DecodeServer:
 
         sample = self.temperature > 0.0
         greedy_key = jax.random.PRNGKey(0)  # dead in the greedy trace
-        spec_progs = None
         cur_k = self.draft_k
         # Acceptance telemetry (whole serve + current adaptation
         # window): tokens_per_round over ACTIVE row-rounds is the
         # speculation-efficiency signal adapt_k steers on.
         spec_rounds = spec_row_rounds = spec_tokens = 0
+        spec_fallback_rounds = 0  # plain dispatches by a spec server
+        spec_draft_failures = 0   # remote-draft handle failures
         win_row_rounds = win_tokens = 0
         plain_rounds = plain_tokens = 0
         k_history = [cur_k]
-        if self.draft is not None:
-            spec_progs = _spec_programs(
-                cfg, self.draft[1], cur_k, self.temperature,
-                self.top_k, self.top_p,
-            )
+        remote_seen: Any = None   # handle identity (re-attach resets)
+        remote_dead = False
 
         def publish_stats():
             """Refresh ``last_stats`` from the running counters —
             called every loop iteration so an incremental tick (the
             fleet replica's poll) reports LIVE telemetry, not the
             previous call's final numbers."""
-            if self.draft is not None:
+            if self.spec_capable:
                 self.last_stats = {
+                    "path": "spec",
                     "rounds": spec_rounds,
                     "active_row_rounds": spec_row_rounds,
                     "accepted_tokens": spec_tokens,
@@ -2163,6 +2475,11 @@ class DecodeServer:
                     ),
                     "k_final": cur_k,
                     "k_history": k_history,
+                    # Plain dispatches this spec-capable server ran —
+                    # every stream below break-even, no draft attached,
+                    # or the remote draft dead (ISSUE 11).
+                    "spec_fallback_rounds": spec_fallback_rounds,
+                    "spec_draft_failures": spec_draft_failures,
                 }
             else:
                 self.last_stats = {
@@ -2190,6 +2507,8 @@ class DecodeServer:
                     if active[s] and slot_req[s] in doomed:
                         # Shed the slot: partial output discarded, no
                         # on_finish; admission re-zeros the rows.
+                        if draft_open[s]:
+                            draft_close.append(slot_req[s])
                         active[s] = False
                         slot_req[s] = None
                         slot_prompt[s] = None
@@ -2208,29 +2527,116 @@ class DecodeServer:
                     # the next tick feeds the queue.
                     time.sleep(idle_wait)
                 continue
-            if self.draft is not None:
-                # Speculative round over ALL slots: each drafts k, one
-                # chunked ragged verify, per-slot acceptance; idle
-                # slots ride along frozen (done mask).
-                round_active = int(active.sum())
-                accepted_rows, nxt, cache, cache_d = _spec_decode_round(
-                    spec_progs, self.params, self.draft[0], cache,
-                    cache_d, toks, ~active, cur_k, sample,
-                    self._np_rng,
-                    self._next_key() if sample else greedy_key,
-                    max_off=slot_bound,
+            rd = self._remote_draft
+            if rd is not remote_seen:
+                # A (re)attached draft handle: fresh streams (the new
+                # draft holds no caches), fresh chance after a failure.
+                remote_seen = rd
+                remote_dead = False
+                for s in range(B):
+                    draft_open[s] = False
+                    draft_mark[s] = 0
+            spec_live = self.draft is not None or (
+                rd is not None and not remote_dead
+            )
+            if spec_live:
+                # Per-row widths (ISSUE 11 per-request adaptive k): a
+                # stream below break-even rides at width 0 (plain law,
+                # zero draft work charged to it) and is re-probed at
+                # width 1 every spec_probe_every of its plain rounds.
+                if self.adapt_k_per_request:
+                    k_arr = onp.zeros(B, onp.int64)
+                    for s in range(B):
+                        if not active[s]:
+                            continue
+                        ks = req_k[s]
+                        if ks == 0 and \
+                                req_plain[s] >= self.spec_probe_every:
+                            ks = 1
+                            req_plain[s] = 0
+                        k_arr[s] = ks
+                    round_k = int(k_arr.max()) if B else 0
+                else:
+                    round_k = cur_k
+                    k_arr = None
+                spec_live = round_k > 0
+            if spec_live:
+                progs = _spec_programs(
+                    cfg,
+                    self.draft[1] if self.draft is not None else cfg,
+                    round_k, self.temperature, self.top_k, self.top_p,
                 )
+                if self.draft is not None:
+                    # Local draft: one batched roll over all slots,
+                    # one chunked ragged verify, per-slot acceptance;
+                    # idle slots ride along frozen (done mask).
+                    accepted_rows, nxt, cache, cache_d = \
+                        _spec_decode_round(
+                            progs, self.params, self.draft[0], cache,
+                            cache_d, toks, ~active, round_k, sample,
+                            self._np_rng,
+                            self._next_key() if sample else greedy_key,
+                            max_off=slot_bound, k_row=k_arr,
+                        )
+                else:
+                    # Remote draft (ISSUE 11): context deltas out,
+                    # proposals back over the draft replica's segment
+                    # path; ANY failure degrades to plain decode (a
+                    # dead draft must never stall the serve loop).
+                    got = self._remote_propose(
+                        rd, round_k, k_arr, active, slot_req,
+                        slot_prompt, slot_out, draft_mark, draft_open,
+                        draft_close, sample,
+                    )
+                    if got is None:
+                        remote_dead = True
+                        spec_draft_failures += 1
+                        continue
+                    d_host, q_host, k_arr = got
+                    accepted_rows, nxt, cache = _spec_remote_round(
+                        progs, self.params, cache, toks, ~active,
+                        d_host, q_host, round_k, sample, self._np_rng,
+                        k_row=k_arr, max_off=slot_bound,
+                    )
                 toks = jnp.asarray(nxt)
-                # Acceptance BEFORE EOS/budget truncation: what the
-                # draft earned, the signal k adapts on.
-                round_tokens = sum(
-                    len(accepted_rows[s]) for s in range(B) if active[s]
-                )
+                # Acceptance BEFORE EOS/budget truncation — what the
+                # draft earned, the signal k adapts on.  Only rows
+                # that actually SPECULATED this round (width > 0)
+                # count: width-0 riders earn exactly 1 plain token
+                # each and would dilute tokens_per_round toward 1.0,
+                # starving the DraftRole/arbiter signal of the value
+                # the speculating streams really get.
+                round_spec_rows = 0
+                round_tokens = 0
+                # Per-request EWMA + width BEFORE emit (emit can free
+                # the slot; seat() resets the arrays on re-admission).
+                for s in range(B):
+                    if not active[s]:
+                        continue
+                    width = round_k if k_arr is None else int(k_arr[s])
+                    if width <= 0:
+                        req_plain[s] += 1
+                        continue
+                    earned = len(accepted_rows[s])
+                    round_spec_rows += 1
+                    round_tokens += earned
+                    req_rounds[s] += 1
+                    req_tokens[s] += earned
+                    if self.adapt_k_per_request:
+                        a = self.spec_ewma_alpha
+                        req_ewma[s] = (
+                            float(earned) if req_ewma[s] <= 0.0
+                            else a * earned + (1 - a) * req_ewma[s]
+                        )
+                        req_k[s] = _spec_k_request(
+                            req_ewma[s], self.draft_k,
+                            self.spec_break_even,
+                        )
                 emit_rows(accepted_rows)
                 spec_rounds += 1
-                spec_row_rounds += round_active
+                spec_row_rounds += round_spec_rows
                 spec_tokens += round_tokens
-                win_row_rounds += round_active
+                win_row_rounds += round_spec_rows
                 win_tokens += round_tokens
                 if (
                     self.adapt_k
@@ -2244,12 +2650,17 @@ class DecodeServer:
                     if new_k != cur_k:
                         cur_k = new_k
                         k_history.append(cur_k)
-                        spec_progs = _spec_programs(
-                            cfg, self.draft[1], cur_k, self.temperature,
-                            self.top_k, self.top_p,
-                        )
                     win_row_rounds = win_tokens = 0
                 continue
+            if self.spec_capable:
+                # A spec-capable server running a plain dispatch:
+                # every stream below break-even, no draft attached
+                # yet, or the remote draft dead — the degradation the
+                # gateway's spec_fallbacks counter measures.
+                spec_fallback_rounds += 1
+                for s in range(B):
+                    if active[s]:
+                        req_plain[s] += 1
             if self.decode_chunk > 1:
                 cache, toks, chunk = self._chunk_step(
                     self.params, cache, toks, jnp.asarray(active),

@@ -30,6 +30,12 @@ inference service:
   server, the gateway holds only a ticket (addr, fp, crc32, nbytes),
   and the decode replica pulls the bytes directly — with the
   through-the-gateway relay kept as the bounded fallback.
+- :mod:`dlrover_tpu.serving.draft` (ISSUE 11) — speculative proposals
+  as a fleet service: small draft replicas roll per-round proposals
+  for spec-capable targets over the segment-path idiom (CRC-wrapped
+  bundles, pull-verified), targets degrade to plain decode on any
+  draft failure, and per-request adaptive k keeps a bad draft from
+  ever serving slower than a spec-less replica.
 
 Imports stay lazy: the gateway and autoscaler are pure control plane
 (no jax); only the replica touches the model stack.
@@ -42,6 +48,14 @@ from dlrover_tpu.serving.autoscale import (  # noqa: F401
     ServeAutoScaler,
     decide,
     decide_pools,
+)
+from dlrover_tpu.serving.draft import (  # noqa: F401
+    DraftReplicaRunner,
+    DraftServer,
+    DraftUnavailable,
+    DraftWorker,
+    RemoteDraftClient,
+    connect_remote_draft,
 )
 from dlrover_tpu.serving.gateway import (  # noqa: F401
     Gateway,

@@ -46,6 +46,13 @@ class ScalePolicy:
     #: replica's worth; shrink is always one at a time — drains are
     #: serialized so capacity never cliff-drops).
     up_step: int = 1
+    #: Earned-value floor (ISSUE 11, the draft pool's signal): when a
+    #: pool's MEASURED accepted-tokens-per-round falls below this, the
+    #: pool is not earning its chips — the pass counts as idle (down
+    #: pressure) regardless of occupancy, and up pressure is
+    #: suppressed.  0 = signal off; an UNMEASURED pool (0.0 reported)
+    #: is never punished.
+    tokens_per_round_low: float = 0.0
 
 
 @dataclasses.dataclass
@@ -74,6 +81,14 @@ def decide(snapshot: Dict[str, Any], policy: ScalePolicy,
         queue_per < policy.queue_low_per_replica
         and occupancy < policy.occupancy_low
     )
+    tpr = float(snapshot.get("tokens_per_round", 0.0))
+    if policy.tokens_per_round_low > 0 and \
+            0 < tpr < policy.tokens_per_round_low:
+        # Below break-even the pool is not earning its chips (ISSUE
+        # 11): shed one regardless of occupancy — the chips are worth
+        # more wherever the borrow arbiter sends them.
+        pressure = False
+        idle = True
     if pressure:
         state.up_streak += 1
         state.down_streak = 0
@@ -92,6 +107,27 @@ def decide(snapshot: Dict[str, Any], policy: ScalePolicy,
         target = max(policy.min_replicas, alive - 1)
         state.down_streak = 0
     return target
+
+
+def mean_measured(values) -> float:
+    """Mean over the MEASURED entries (> 0) of an iterable, 0.0 when
+    none — the pool-signal aggregation rule (an unmeasured member must
+    not drag a pool's signal toward zero)."""
+    vals = [v for v in values if v > 0]
+    return round(sum(vals) / len(vals), 3) if vals else 0.0
+
+
+def draft_pool_tokens_per_round(members) -> float:
+    """THE draft-pool earned-value convention (ISSUE 11), defined once
+    so the per-gateway snapshot and the tier-wide merge cannot drift:
+    a draft pool's value is the mean measured accepted-tokens-per-round
+    its CONSUMERS report — the spec-capable non-draft members whose
+    acceptance says what the proposals are worth.  ``members`` yields
+    ``(spec, role, tokens_per_round)`` triples."""
+    return mean_measured(
+        t for spec, role, t in members
+        if spec and (role or "unified") != "draft"
+    )
 
 
 #: Which snapshot percentile signal matters per role: TTFT is an
@@ -119,6 +155,9 @@ def decide_pools(snapshot: Dict[str, Any],
             "replicas_alive": pool.get("alive", 0),
             "queue_depth": pool.get("queue_depth", 0),
             "occupancy": pool.get("occupancy", 0.0),
+            # Earned-value signal (ISSUE 11): the draft pool's is the
+            # acceptance its CONSUMERS measure (gateway snapshot).
+            "tokens_per_round": pool.get("tokens_per_round", 0.0),
         }
         if role in _TTFT_ROLES:
             sub["ttft_p95_ms"] = snapshot.get("ttft_p95_ms", 0.0)

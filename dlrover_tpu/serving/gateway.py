@@ -101,6 +101,8 @@ class GatewayConfig:
         max_attempts: int = 5,
         prefix_reserve_s: float = 2.0,
         kv_p2p: bool = True,
+        spec_decode_min_tokens: int = 0,
+        spec_reserve_s: float = 2.0,
     ):
         self.queue_cap = queue_cap
         self.lease_timeout_s = lease_timeout_s
@@ -124,6 +126,18 @@ class GatewayConfig:
         #: replica, or is repeatedly lost) re-queues at the FRONT and
         #: would otherwise head-of-line-block the fleet forever.
         self.max_attempts = max_attempts
+        #: Spec-aware routing (ISSUE 11): a ``full``-stage request
+        #: whose max_new_tokens reaches this is a LONG decode — the
+        #: grant scan prefers spec-capable replicas for it (the
+        #: speculation win scales with decode length; admission cost
+        #: is identical).  0 = routing preference off.
+        self.spec_decode_min_tokens = int(spec_decode_min_tokens)
+        #: How long a long-decode request is held for a spec-capable
+        #: replica WITH capacity before any replica may take it (the
+        #: prefix_reserve_s shape — saturated spec replicas are
+        #: bypassed immediately, so speculation never starves the
+        #: queue).
+        self.spec_reserve_s = float(spec_reserve_s)
 
 
 class _Request:
@@ -180,11 +194,13 @@ class _Request:
 class _Replica:
     __slots__ = (
         "replica_id", "slots", "assigned", "last_seen", "poll_seq",
-        "draining", "stats", "role", "warm",
+        "draining", "stats", "role", "warm", "spec", "draft_addr",
+        "spec_seen",
     )
 
     def __init__(self, replica_id: str, slots: int, now: float,
-                 role: str = "unified"):
+                 role: str = "unified", spec: bool = False,
+                 draft_addr: str = ""):
         self.replica_id = replica_id
         self.slots = int(slots)
         self.assigned: Dict[str, _Request] = {}
@@ -196,6 +212,14 @@ class _Replica:
         #: Prefix fingerprints held warm — replaced wholesale by every
         #: poll report, so evictions/restarts self-correct the map.
         self.warm: set = set()
+        #: Speculative capability + (draft role) proposal-server addr
+        #: (ISSUE 11).
+        self.spec = bool(spec)
+        self.draft_addr = draft_addr or ""
+        #: Last cumulative spec counters seen in a poll report — the
+        #: baseline the gateway's counter deltas fold from (reset on
+        #: restart: a smaller report re-baselines).
+        self.spec_seen: Dict[str, int] = {}
 
 
 class GatewayCore:
@@ -240,6 +264,14 @@ class GatewayCore:
             # relay path after a failed pull.
             "kv_handoffs", "kv_rejects", "kv_bytes", "kv_fp32_bytes",
             "kv_p2p_bytes", "kv_relay_fallbacks",
+            # Speculative serving (ISSUE 11).  spec_rounds /
+            # spec_accepted / spec_fallbacks aggregate the replicas'
+            # cumulative poll reports as deltas (restart-safe
+            # re-baselining); spec_grants / spec_bypass are the
+            # router's long-decode outcomes (granted to a spec replica
+            # / given up to a plain one after the reserve window).
+            "spec_rounds", "spec_accepted", "spec_fallbacks",
+            "spec_grants", "spec_bypass",
         ):
             self._counters.inc(name, 0)
         self._last_sweep = float("-inf")
@@ -334,16 +366,19 @@ class GatewayCore:
     # -- replica surface --------------------------------------------------
 
     def register(self, replica_id: str, slots: int,
-                 role: str = "unified") -> None:
+                 role: str = "unified", spec: bool = False,
+                 draft_addr: str = "") -> None:
         with self._mu:
             rep = self._replicas.get(replica_id)
             if rep is None:
                 self._replicas[replica_id] = _Replica(
-                    replica_id, slots, self._clock(), role=role
+                    replica_id, slots, self._clock(), role=role,
+                    spec=spec, draft_addr=draft_addr,
                 )
                 logger.info(
-                    "gateway: replica %s registered (%d slots, %s)",
+                    "gateway: replica %s registered (%d slots, %s%s)",
                     replica_id, slots, role or "unified",
+                    ", spec" if spec else "",
                 )
             else:
                 # Restarted replica re-registering under the same id:
@@ -355,6 +390,9 @@ class GatewayCore:
                 rep.draining = False
                 rep.role = role or "unified"
                 rep.warm = set()
+                rep.spec = bool(spec)
+                rep.draft_addr = draft_addr or ""
+                rep.spec_seen = {}
                 self._requeue_assigned_locked(rep, "re-register")
 
     def deregister(self, replica_id: str) -> None:
@@ -385,6 +423,7 @@ class GatewayCore:
             rep.last_seen = now
             rep.poll_seq += 1
             if stats:
+                self._fold_spec_stats_locked(rep, stats)
                 rep.stats = dict(stats)
             if warm_prefixes is not None:
                 # Wholesale replacement: the replica's own report is
@@ -444,6 +483,25 @@ class GatewayCore:
                              "miss": "prefix_misses",
                              "steal": "prefix_steals"}[route]
                         )
+                    if (
+                        stage == "full"
+                        and self.cfg.spec_decode_min_tokens > 0
+                        and req.max_new_tokens
+                        >= self.cfg.spec_decode_min_tokens
+                    ):
+                        # Long decode (ISSUE 11): prefer a spec-capable
+                        # replica — its accepted-tokens-per-round win
+                        # scales with decode length.  Bounded reserve:
+                        # once every capable spec replica is saturated
+                        # or the window expires, anyone takes it.
+                        route = self._spec_route_locked(rep, req, now)
+                        if route == "defer":
+                            i += 1
+                            continue
+                        self._counters.inc(
+                            "spec_grants" if route == "grant"
+                            else "spec_bypass"
+                        )
                     self._queue.pop(i)
                     req.assigned_to = replica_id
                     req.grant_seq = rep.poll_seq
@@ -486,6 +544,7 @@ class GatewayCore:
             drain = rep.draining and not rep.assigned
             return ServeGrants(
                 requests=grants, cancel=cancels, drain=drain, known=True,
+                draft_addr=self._draft_addr_locked(),
             )
 
     def stream(self, replica_id: str, req_id: str,
@@ -506,7 +565,8 @@ class GatewayCore:
 
     def complete(self, replica_id: str, req_id: str, tokens: List[int],
                  ok: bool = True, reason: str = "",
-                 replayed: bool = False) -> str:
+                 replayed: bool = False, tokens_per_round: float = 0.0,
+                 spec_rounds: int = 0) -> str:
         """Terminal report.  Returns ``recorded`` | ``duplicate`` |
         ``unknown`` (the replica does not branch on it; tests do)."""
         with self._mu:
@@ -535,6 +595,11 @@ class GatewayCore:
             state = "done" if ok else "failed"
             self._finish_locked(
                 req, state, tokens, replica_id, reason=reason,
+                extra=(
+                    {"tokens_per_round": float(tokens_per_round),
+                     "spec_rounds": int(spec_rounds)}
+                    if tokens_per_round else None
+                ),
             )
             if replayed:
                 logger.info(
@@ -666,6 +731,8 @@ class GatewayCore:
                     "assigned": len(rep.assigned),
                     "draining": rep.draining,
                     "role": rep.role,
+                    "spec": rep.spec,
+                    "draft_addr": rep.draft_addr,
                     "warm_prefixes": sorted(rep.warm),
                     "stats": dict(rep.stats),
                 }
@@ -683,8 +750,21 @@ class GatewayCore:
                 1 for r in self._queue if r.stage != "kv_ready"
             )
             kv_ready_depth = len(self._queue) - queued_stage
+            from dlrover_tpu.serving.autoscale import (
+                draft_pool_tokens_per_round,
+                mean_measured,
+            )
+
+            def _tpr(rep: _Replica) -> float:
+                try:
+                    return float(
+                        rep.stats.get("tokens_per_round", 0.0)
+                    )
+                except (TypeError, ValueError):
+                    return 0.0
+
             pools: Dict[str, Dict[str, Any]] = {}
-            for role in ("unified", "prefill", "decode"):
+            for role in ("unified", "prefill", "decode", "draft"):
                 members = [r for r in alive if r.role == role]
                 slots = sum(r.slots for r in members)
                 assigned = sum(len(r.assigned) for r in members)
@@ -694,7 +774,21 @@ class GatewayCore:
                     "assigned": assigned,
                     "occupancy": assigned / slots if slots else 0.0,
                     "queue_depth": 0,
+                    # Accepted-tokens-per-round signal (ISSUE 11):
+                    # mean over the pool's reporting members; 0 =
+                    # unmeasured.
+                    "tokens_per_round": mean_measured(
+                        _tpr(r) for r in members
+                    ),
                 }
+            # The DRAFT pool's earned value is measured at its
+            # CONSUMERS (the shared convention in serving.autoscale):
+            # decide_pools steers the draft pool on this (shrink
+            # below break-even).
+            pools["draft"]["tokens_per_round"] = \
+                draft_pool_tokens_per_round(
+                    (r.spec, r.role, _tpr(r)) for r in alive
+                )
             fed = "prefill" if pools["prefill"]["alive"] else "unified"
             pools[fed]["queue_depth"] += queued_stage
             fed = "decode" if pools["decode"]["alive"] else "unified"
@@ -771,6 +865,60 @@ class GatewayCore:
             return "defer"
         return "steal"
 
+    def _spec_route_locked(self, rep: _Replica, req: _Request,
+                           now: float) -> str:
+        """Routing outcome for a LONG-decode request at this replica's
+        poll (ISSUE 11): ``grant`` (this replica speculates),
+        ``defer`` (a spec-capable replica with capacity exists, within
+        the reserve window), or ``bypass`` (no spec capacity — plain
+        decode beats queueing)."""
+        if rep.spec:
+            return "grant"
+        capable = [
+            r for r in self._replicas.values()
+            if r is not rep and not r.draining and r.spec
+            and r.role in ("unified", "decode")
+        ]
+        if any(len(r.assigned) < r.slots for r in capable) and \
+                now - req.submitted_at < self.cfg.spec_reserve_s:
+            return "defer"
+        return "bypass"
+
+    def _fold_spec_stats_locked(self, rep: _Replica, stats: dict) -> None:
+        """Fold a poll report's CUMULATIVE spec counters into the
+        gateway counters as deltas.  A replica restart resets its
+        cumulative numbers — a smaller report re-baselines instead of
+        going negative."""
+        for src, dst in (
+            ("spec_rounds", "spec_rounds"),
+            ("spec_accepted", "spec_accepted"),
+            ("spec_fallbacks", "spec_fallbacks"),
+        ):
+            if src not in stats:
+                continue
+            new = int(stats[src])
+            old = rep.spec_seen.get(src, 0)
+            delta = new - old if new >= old else new
+            if delta > 0:
+                self._counters.inc(dst, delta)
+            rep.spec_seen[src] = new
+
+    def _draft_addr_locked(self) -> str:
+        """The proposal-server address spec targets should use right
+        now: the least-loaded live draft replica's (sorted for
+        determinism), "" when none is alive — targets then fall back
+        to plain decode until one registers."""
+        best = ""
+        best_key = None
+        for rep in self._replicas.values():
+            if rep.role != "draft" or rep.draining or not rep.draft_addr:
+                continue
+            key = (int(rep.stats.get("streams", 0)), rep.replica_id)
+            if best_key is None or key < best_key:
+                best_key = key
+                best = rep.draft_addr
+        return best
+
     def _detach_locked(self, req: _Request) -> None:
         self._by_id.pop(req.req_id, None)
         if req.assigned_to is not None:
@@ -782,12 +930,16 @@ class GatewayCore:
 
     def _finish_locked(self, req: _Request, state: str,
                        tokens: List[int], replica_id: str,
-                       reason: str = "") -> None:
+                       reason: str = "",
+                       extra: Optional[dict] = None) -> None:
         self._detach_locked(req)
-        self._done.put(req.req_id, {
+        rec = {
             "state": state, "tokens": [int(t) for t in tokens],
             "replica": replica_id, "reason": reason,
-        })
+        }
+        if extra:
+            rec.update(extra)
+        self._done.put(req.req_id, rec)
         now = self._clock()
         if state == "done":
             self._counters.inc("completed")
@@ -950,7 +1102,9 @@ class Gateway:
 
         for name in ("prefix_hits", "prefix_misses", "prefix_steals",
                      "kv_handoffs", "kv_rejects", "kv_bytes",
-                     "kv_p2p_bytes", "kv_relay_fallbacks"):
+                     "kv_p2p_bytes", "kv_relay_fallbacks",
+                     "spec_rounds", "spec_accepted", "spec_fallbacks",
+                     "spec_grants", "spec_bypass"):
             registry.gauge(f"serve_{name}", _counter_gauge(name))
 
         def _pool_gauge(role, key):
@@ -960,9 +1114,9 @@ class Gateway:
                 )
             return read
 
-        for role in ("unified", "prefill", "decode"):
+        for role in ("unified", "prefill", "decode", "draft"):
             for key in ("alive", "assigned", "queue_depth",
-                        "occupancy"):
+                        "occupancy", "tokens_per_round"):
                 registry.gauge(f"serve_pool_{role}_{key}",
                                _pool_gauge(role, key))
 
@@ -975,7 +1129,8 @@ class Gateway:
         if isinstance(msg, ServeStatusRequest):
             return core.status(msg.req_id)
         if isinstance(msg, ServeReplicaRegister):
-            core.register(msg.replica_id, msg.slots, msg.role)
+            core.register(msg.replica_id, msg.slots, msg.role,
+                          msg.spec, msg.draft_addr)
             return BaseResponse(success=True)
         if isinstance(msg, ServeReplicaDeregister):
             core.deregister(msg.replica_id)
@@ -999,7 +1154,8 @@ class Gateway:
         if isinstance(msg, ServeDone):
             outcome = core.complete(
                 msg.replica_id, msg.req_id, msg.tokens, msg.ok,
-                msg.reason, msg.replayed,
+                msg.reason, msg.replayed, msg.tokens_per_round,
+                msg.spec_rounds,
             )
             return BaseResponse(success=True, reason=outcome)
         if isinstance(msg, ServeDrainRequest):

@@ -110,12 +110,23 @@ class CompletionJournal:
         return dict(self._records)
 
     def lookup(self, req_id: str, prompt) -> Optional[List[int]]:
-        rec = self._records.get(req_id)
-        if rec is None or rec.get("ph") != _prompt_hash(prompt):
+        rec = self.lookup_record(req_id, prompt)
+        if rec is None:
             return None
         return [int(t) for t in rec["tokens"]]
 
-    def append(self, req_id: str, prompt, tokens) -> None:
+    def lookup_record(self, req_id: str,
+                      prompt) -> Optional[Dict[str, Any]]:
+        """The full journal record (tokens + per-request telemetry) —
+        what replay paths report from, so a replayed completion
+        carries the SAME acceptance numbers it earned live."""
+        rec = self._records.get(req_id)
+        if rec is None or rec.get("ph") != _prompt_hash(prompt):
+            return None
+        return rec
+
+    def append(self, req_id: str, prompt, tokens,
+               extra: Optional[Dict[str, Any]] = None) -> None:
         if self._f is None:
             self._f = open(self.path, "a")
         rec = {
@@ -123,6 +134,8 @@ class CompletionJournal:
             "ph": _prompt_hash(prompt),
             "tokens": [int(t) for t in tokens],
         }
+        if extra:
+            rec.update(extra)
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
         os.fsync(self._f.fileno())
@@ -177,6 +190,7 @@ class ReplicaRunner:
         kv_p2p: bool = True,
         kv_server=None,  # injectable KvSegmentServer (tests)
         kv_connect=None,  # addr -> transport override for pulls (tests)
+        draft_connect=None,  # addr -> proposal handle override (tests)
         clock=time.monotonic,
     ):
         self.server = server
@@ -196,6 +210,15 @@ class ReplicaRunner:
         #: same few prefill peers over and over — per-pull channel
         #: setup would put connection churn on the data-plane hot path.
         self._kv_clients: Dict[str, Any] = {}
+        #: Remote-draft attachment (ISSUE 11): when the server is
+        #: spec-remote capable, every poll reply's ``draft_addr`` is
+        #: applied — a new address builds a proposal handle via
+        #: ``draft_connect`` (default: one RpcClient per endpoint) and
+        #: hands it to ``DecodeServer.set_remote_draft``; "" detaches.
+        self._draft_connect = draft_connect
+        self._draft_addr = ""
+        self._draft_handle = None
+        self._draft_failures_seen = 0
         self.journal = (
             CompletionJournal(journal_path) if journal_path else None
         )
@@ -240,6 +263,7 @@ class ReplicaRunner:
         self._call_quiet(ServeReplicaRegister(
             replica_id=self.replica_id, slots=self.server.slots,
             role=self.role,
+            spec=bool(getattr(self.server, "spec_capable", False)),
         ))
         if self.journal is not None and not self._journal_replayed:
             # Journal replay, ONCE per incarnation: report every
@@ -266,6 +290,10 @@ class ReplicaRunner:
                     replica_id=self.replica_id, req_id=req_id,
                     tokens=[int(t) for t in rec["tokens"]],
                     ok=True, replayed=True,
+                    # Telemetry rides the journal (ISSUE 11): a replay
+                    # reports the acceptance the request earned live.
+                    tokens_per_round=float(rec.get("tpr", 0.0)),
+                    spec_rounds=int(rec.get("spr", 0)),
                 ))
 
     def run(self) -> None:
@@ -298,6 +326,15 @@ class ReplicaRunner:
                         logger.debug("kv pull client close failed",
                                      exc_info=True)
             self._kv_clients.clear()
+            if self._draft_handle is not None:
+                close = getattr(self._draft_handle, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:  # noqa: BLE001 - teardown
+                        logger.debug("draft handle close failed",
+                                     exc_info=True)
+                self._draft_handle = None
 
     def tick(self) -> bool:
         """One admission-point visit from the decode loop: rate-limited
@@ -348,9 +385,69 @@ class ReplicaRunner:
                     self._forget(rid_key)
             for grant in reply.requests:
                 self._admit(grant)
+            # A handle failure latches the serve loop onto plain
+            # decode until a NEW handle attaches — so a TRANSIENT
+            # draft fault (one timed-out roll) must trigger a
+            # reconnect even when the gateway keeps offering the same
+            # unchanged address: drop our record of it and let this
+            # very reply's offer rebuild the handle.  Rate-limited
+            # naturally: one reconnect per observed failure, and a
+            # genuinely dead draft ages out of the gateway's offers
+            # within a lease.
+            last = getattr(self.server, "last_stats", None) or {}
+            fails = int(last.get("spec_draft_failures", 0))
+            if fails > self._draft_failures_seen:
+                self._draft_failures_seen = fails
+                self._draft_addr = ""
+            self._apply_draft_addr(getattr(reply, "draft_addr", ""))
             if reply.drain:
                 self._draining = True
         return not self._stopped and not self._done_draining()
+
+    def _apply_draft_addr(self, addr: str) -> None:
+        """Attach/detach the remote draft per the gateway's current
+        endpoint (ISSUE 11).  Only spec-remote servers participate; a
+        server with a LOCAL draft keeps it.  A changed address (draft
+        relaunch lands on a new port) rebuilds the handle — which also
+        resets the serve loop's dead-draft latch."""
+        if not getattr(self.server, "spec_remote", False):
+            return
+        if addr == self._draft_addr:
+            return
+        old, self._draft_handle = self._draft_handle, None
+        if old is not None:
+            close = getattr(old, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 - teardown
+                    logger.debug("draft handle close failed",
+                                 exc_info=True)
+        self._draft_addr = addr
+        if addr:
+            try:
+                if self._draft_connect is not None:
+                    self._draft_handle = self._draft_connect(addr)
+                else:
+                    from dlrover_tpu.serving.draft import (
+                        connect_remote_draft,
+                    )
+
+                    self._draft_handle = connect_remote_draft(
+                        addr, replica_id=self.replica_id
+                    )
+            except Exception as e:  # noqa: BLE001 - plain decode
+                logger.warning(
+                    "replica %s: draft connect to %s failed: %s",
+                    self.replica_id, addr, e,
+                )
+                self._draft_handle = None
+                self._draft_addr = ""
+        logger.info(
+            "replica %s: remote draft %s", self.replica_id,
+            addr or "detached",
+        )
+        self.server.set_remote_draft(self._draft_handle)
 
     # -- internals --------------------------------------------------------
 
@@ -370,7 +467,7 @@ class ReplicaRunner:
         if rid_key in self._granted or rid_key in self._owned_rids():
             return  # duplicate grant (shouldn't happen; be safe)
         if self.journal is not None:
-            cached = self.journal.lookup(rid_key, grant.prompt)
+            cached = self.journal.lookup_record(rid_key, grant.prompt)
             if cached is not None:
                 # This replica already served it in a previous
                 # incarnation: answer from the journal, never re-decode
@@ -379,7 +476,10 @@ class ReplicaRunner:
                 self.replayed += 1
                 self._call_quiet(ServeDone(
                     replica_id=self.replica_id, req_id=rid_key,
-                    tokens=cached, ok=True, replayed=True,
+                    tokens=[int(t) for t in cached["tokens"]],
+                    ok=True, replayed=True,
+                    tokens_per_round=float(cached.get("tpr", 0.0)),
+                    spec_rounds=int(cached.get("spr", 0)),
                 ))
                 return
         if chaos.inject(
@@ -626,13 +726,23 @@ class ReplicaRunner:
         # client gets exactly the NEW tokens (the journal stores the
         # same, so replay and fresh serve agree byte-for-byte).
         new_tokens = [int(t) for t in tokens[len(prompt):]]
+        # Per-request speculation telemetry (ISSUE 11): journaled WITH
+        # the completion so replay reports what the request earned.
+        pop = getattr(self.server, "pop_request_stats", None)
+        st = pop(rid_key) if pop is not None else None
+        tpr = round(float(st["tokens_per_round"]), 3) if st else 0.0
+        spr = int(st["spec_rounds"]) if st else 0
         if self.journal is not None:
-            self.journal.append(rid_key, prompt, new_tokens)
+            self.journal.append(
+                rid_key, prompt, new_tokens,
+                extra={"tpr": tpr, "spr": spr} if st else None,
+            )
         self.served += 1
         self._flush_streams(only=rid_key)
         self._call_quiet(ServeDone(
             replica_id=self.replica_id, req_id=rid_key,
             tokens=new_tokens, ok=True,
+            tokens_per_round=tpr, spec_rounds=spr,
         ))
         self._forget(rid_key)
 
@@ -689,6 +799,19 @@ class ReplicaRunner:
             # Speculative acceptance (or plain tokens/round) telemetry.
             stats["tokens_per_round"] = round(
                 last["tokens_per_round"], 3
+            )
+        if last and last.get("path") == "spec":
+            # Cumulative spec counters (ISSUE 11): the gateway folds
+            # these as deltas into its fleet-wide spec_* counters.
+            stats["spec_rounds"] = int(last.get("rounds", 0))
+            stats["spec_accepted"] = int(
+                last.get("accepted_tokens", 0)
+            )
+            stats["spec_fallbacks"] = int(
+                last.get("spec_fallback_rounds", 0)
+            )
+            stats["spec_draft_failures"] = int(
+                last.get("spec_draft_failures", 0)
             )
         return stats
 

@@ -943,6 +943,11 @@ class TierReplicaLink:
             free = max(0, free - len(reply.requests))
             merged.cancel.extend(reply.cancel)
             drain_votes.append(reply.drain)
+            if reply.draft_addr and not merged.draft_addr:
+                # Draft endpoint (ISSUE 11): first gateway offering
+                # one wins — draft replicas register at EVERY gateway,
+                # so any offer names a live proposal server.
+                merged.draft_addr = reply.draft_addr
         merged.drain = bool(drain_votes) and all(drain_votes)
         return merged
 
@@ -1027,8 +1032,21 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
     total_slots = sum(int(r.get("slots", 0)) for r in alive.values())
     total_assigned = sum(int(r["assigned"]) for r in alive.values())
+    from dlrover_tpu.serving.autoscale import (
+        draft_pool_tokens_per_round,
+        mean_measured,
+    )
+
+    def _tpr(r) -> float:
+        try:
+            return float(
+                (r.get("stats") or {}).get("tokens_per_round", 0.0)
+            )
+        except (TypeError, ValueError):
+            return 0.0
+
     pools: Dict[str, Dict[str, Any]] = {}
-    for role in ("unified", "prefill", "decode"):
+    for role in ("unified", "prefill", "decode", "draft"):
         members = [
             r for r in alive.values()
             if r.get("role", "unified") == role
@@ -1041,7 +1059,17 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
             "assigned": assigned,
             "occupancy": assigned / slots if slots else 0.0,
             "queue_depth": pool_queues.get(role, 0),
+            "tokens_per_round": mean_measured(
+                _tpr(r) for r in members
+            ),
         }
+    # Draft pool earned value = the acceptance its CONSUMERS (spec
+    # targets) report — ONE convention, shared with the per-gateway
+    # snapshot via serving.autoscale (ISSUE 11).
+    pools["draft"]["tokens_per_round"] = draft_pool_tokens_per_round(
+        (bool(r.get("spec")), r.get("role", "unified"), _tpr(r))
+        for r in alive.values()
+    )
     merged: Dict[str, Any] = {
         **sums,
         "replicas_alive": len(alive),

@@ -48,7 +48,8 @@ import time
 def parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--role", default="all",
-                   choices=("all", "gateway", "replica", "driver"))
+                   choices=("all", "gateway", "replica", "driver",
+                            "draft"))
     p.add_argument("--port", type=int, default=0,
                    help="(gateway) listen port; 0 = ephemeral")
     p.add_argument("--gateway", default="",
@@ -88,6 +89,34 @@ def parse_args(argv=None):
                         "path for this prefix length (the bench warms "
                         "XLA before registration so TTFT measures "
                         "admission, not compiles)")
+    p.add_argument("--spec", action="store_true",
+                   help="(replica) speculative serving (ISSUE 11): "
+                        "advertise spec capability, attach the "
+                        "gateway-announced remote draft, run draft/"
+                        "verify/accept rounds with per-request "
+                        "adaptive k (below break-even a stream "
+                        "decodes plain)")
+    p.add_argument("--draft_k", type=int, default=4,
+                   help="(replica/draft) speculation width ceiling")
+    p.add_argument("--spec_break_even", type=float, default=0.0,
+                   help="(replica) accepted-tokens/round below which "
+                        "a stream rides plain (0 = 1 + 0.6*draft_k, "
+                        "the SPEC_DECODE_CPU.json break-even shape)")
+    p.add_argument("--spec_min_tokens", type=int, default=0,
+                   help="(gateway) max_new_tokens at which the grant "
+                        "scan prefers spec-capable replicas (0 = off)")
+    p.add_argument("--draft_layers", type=int, default=1,
+                   help="(draft) draft model depth")
+    p.add_argument("--draft_seed", type=int, default=-1,
+                   help="(draft) draft init seed; -1 = share the "
+                        "target seed AND shape (the ceiling draft "
+                        "standing in for a trained one)")
+    p.add_argument("--draft_streams", type=int, default=32,
+                   help="(draft) concurrent stream caches retained")
+    p.add_argument("--draft_floor_ms", type=float, default=0.0,
+                   help="(draft) per-roll latency floor — the draft "
+                        "chip's device time in the bench's "
+                        "device-bound model")
     p.add_argument("--replicas", type=int, default=2,
                    help="(all) replica threads to run")
     p.add_argument("--slots", type=int, default=2)
@@ -115,9 +144,11 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def build_replica(args, transport):
+def build_replica(args, transport, draft_connect=None):
     """One seeded replica: tiny float32 llama + DecodeServer +
-    ReplicaRunner (all replicas identical by construction)."""
+    ReplicaRunner (all replicas identical by construction).
+    ``draft_connect`` overrides the remote-draft handle factory
+    (in-process fleets: the bench smoke wires a loopback draft)."""
     import os
 
     import jax.numpy as jnp
@@ -137,11 +168,19 @@ def build_replica(args, transport):
         d_ff=getattr(args, "d_ff", 128),
     )
     role = getattr(args, "replica_role", "unified")
+    spec = bool(getattr(args, "spec", False))
     srv = llama_infer.DecodeServer(
         params, cfg, slots=args.slots, max_len=args.max_len,
         prompt_buckets=(16, 32), seed=args.seed,
         quant_kv=getattr(args, "quant_kv", False),
         prefix_cache_cap=getattr(args, "prefix_cache_cap", 4),
+        # Speculative serving (ISSUE 11): remote-draft intent sizes the
+        # cache headroom; per-request adaptive k guarantees a bad
+        # draft can never make a stream slower than plain decode.
+        spec_remote=spec,
+        draft_k=getattr(args, "draft_k", 4),
+        adapt_k_per_request=spec,
+        spec_break_even=getattr(args, "spec_break_even", 0.0),
     )
     import numpy as np
 
@@ -179,6 +218,22 @@ def build_replica(args, transport):
                 srv.pending_count() or srv.active_rids()
             ))
         srv.clear_prefix_templates()
+    if spec and role != "prefill":
+        # Warm the speculative verify programs for the widths the
+        # adaptive policy actually visits (full width + the k=1
+        # probe); intermediate widths compile on demand.
+        cache_w = llama_infer.init_cache(
+            cfg, args.slots, args.max_len, ring=False
+        )
+        cache_w = dict(
+            cache_w, offset=jnp.zeros((args.slots,), jnp.int32)
+        )
+        for kw_ in {1, getattr(args, "draft_k", 4)}:
+            progs = llama_infer._spec_programs(cfg, cfg, kw_, 0.0, 0, 0)
+            progs["target_verify"](
+                params, cache_w,
+                jnp.zeros((args.slots, kw_ + 1), jnp.int32),
+            )
     journal = None
     if args.journal_dir:
         os.makedirs(args.journal_dir, exist_ok=True)
@@ -191,6 +246,7 @@ def build_replica(args, transport):
         round_floor_s=args.round_floor_ms / 1000.0,
         role=role,
         kv_p2p=not getattr(args, "no_kv_p2p", False),
+        draft_connect=draft_connect,
     )
 
 
@@ -278,6 +334,7 @@ def main() -> int:
             queue_cap=args.queue_cap,
             lease_timeout_s=args.lease_timeout,
             kv_p2p=not args.kv_relay,
+            spec_decode_min_tokens=args.spec_min_tokens,
         )
         if args.registry:
             node = GatewayTierNode(
@@ -316,6 +373,79 @@ def main() -> int:
             gw.stop()
         return 0
 
+    class _T:
+        """RpcClient with the runner's best-effort budget."""
+
+        def __init__(self, addr):
+            from dlrover_tpu.common.rpc import RpcClient
+
+            self._c = RpcClient(addr, timeout=5.0)
+
+        def call(self, msg, **kw):
+            return self._c.call(msg, deadline=10.0,
+                                idempotent=True, **kw)
+
+    if args.role == "draft":
+        # Draft replica (ISSUE 11): a small proposal server registered
+        # as the fifth role family; spec targets learn its address
+        # from the gateway's poll replies and pull per-round
+        # proposals directly (the P2P segment-path shape).
+        import jax.numpy as jnp
+
+        from dlrover_tpu.serving import (
+            DraftReplicaRunner,
+            DraftServer,
+            DraftWorker,
+        )
+
+        try:
+            from examples import serve_common
+        except ImportError:
+            import serve_common
+
+        if args.draft_seed < 0:
+            # Ceiling draft: the target itself (stands in for a
+            # trained draft — acceptance ~k+1; the committed
+            # SPEC_DECODE_CPU.json bounds the realistic range).
+            dparams, dcfg = serve_common.tiny_llama(
+                seed=args.seed, dtype=jnp.float32,
+                n_layer=args.n_layer, d_model=args.d_model,
+                d_ff=args.d_ff,
+            )
+        else:
+            dparams, dcfg = serve_common.tiny_llama(
+                seed=args.draft_seed, dtype=jnp.float32,
+                n_layer=args.draft_layers, d_model=args.d_model,
+                d_ff=args.d_ff,
+            )
+        worker = DraftWorker(
+            dparams, dcfg, max_len=args.max_len,
+            draft_k=args.draft_k, max_streams=args.draft_streams,
+            seed=args.seed, worker_id=args.replica_id,
+            round_floor_s=args.draft_floor_ms / 1000.0,
+        )
+        # Warm every roll/score program BEFORE registering, so target
+        # TTFT never pays a draft-side XLA compile.  warm() bypasses
+        # the proposal loop: the chaos site's step gate (completed
+        # rolls) must only count real serving traffic.
+        worker.warm()
+        server = DraftServer(worker)
+        runner = DraftReplicaRunner(
+            server, _T(args.gateway), args.replica_id,
+            poll_interval=max(args.poll_interval, 0.05),
+        )
+        signal.signal(signal.SIGTERM, lambda *_: runner.stop())
+        print(
+            f"DRAFT_READY id={args.replica_id} addr={server.addr}",
+            flush=True,
+        )
+        runner.run()
+        print(
+            f"DRAFT_DONE id={args.replica_id} rolls={worker.rolls} "
+            f"proposed={worker.proposed_tokens}", flush=True,
+        )
+        return 0
+
     if args.role == "replica":
         if args.registry:
             from dlrover_tpu.serving import TierReplicaLink
@@ -324,18 +454,6 @@ def main() -> int:
                 tier_registry(), args.replica_id,
             )
         else:
-            from dlrover_tpu.common.rpc import RpcClient
-
-            class _T:
-                """RpcClient with the runner's best-effort budget."""
-
-                def __init__(self, addr):
-                    self._c = RpcClient(addr, timeout=5.0)
-
-                def call(self, msg, **kw):
-                    return self._c.call(msg, deadline=10.0,
-                                        idempotent=True, **kw)
-
             transport = _T(args.gateway)
         runner = build_replica(args, transport)
         print(f"REPLICA_READY id={args.replica_id}", flush=True)

@@ -195,14 +195,17 @@ def test_serve_bench_smoke_schema(tmp_path):
     proc = subprocess.run(
         [sys.executable, str(Path(bench.__file__)), "--serve_bench",
          "--smoke", f"--out={out}"],
-        capture_output=True, text=True, timeout=120, env=env,
+        capture_output=True, text=True, timeout=240, env=env,
         cwd=str(Path(bench.__file__).parent),
     )
     elapsed = time.time() - t0
     assert proc.returncode == 0, proc.stderr[-2000:]
-    # <5s is the spec on an idle host; allow CI contention headroom but
-    # fail loudly if the smoke config ever becomes heavyweight.
-    assert elapsed < 30.0, f"smoke serve bench took {elapsed:.1f}s"
+    # ~65s observed on an idle host: the smoke now stands up eight
+    # small fleets (plain + 4 routing planes + 4 speculation rows) and
+    # each fresh DecodeServer instance pays its own XLA warmup
+    # compiles; allow CI contention headroom but fail loudly if the
+    # smoke config ever becomes heavyweight beyond that.
+    assert elapsed < 150.0, f"smoke serve bench took {elapsed:.1f}s"
     result = json.loads(out.read_text())
     assert result["complete"] is True
     assert result["workload"]["requests"] == 5
@@ -252,6 +255,35 @@ def test_serve_bench_smoke_schema(tmp_path):
     assert kvp["p2p_bytes"] > 0
     assert 0 < kvp["bytes_over_fp32"] < 0.5
     assert "prefix_vs_least_loaded" in routing
+    # Speculation rows (ISSUE 11): on/off at matched chip budget with
+    # goodput fields, acceptance arithmetic, and a fallback row whose
+    # bad draft visibly degraded to plain decode.
+    spec = result["spec"]
+    srows = {r["mode"]: r for r in spec["rows"]}
+    assert set(srows) == {"off", "on", "off_floor", "fallback"}
+    for r in srows.values():
+        assert r["completed"] == spec["requests"]
+        assert r["goodput_tokens_per_sec"] >= 0
+        assert r["goodput_per_chip"] >= 0
+        assert r["chips"] == r["targets"] + r["drafts"]
+    # Matched chip budget is the on-vs-off contract.
+    assert srows["on"]["chips"] == srows["off"]["chips"]
+    assert srows["on"]["drafts"] == 1 and srows["off"]["drafts"] == 0
+    # Acceptance-rate arithmetic: the ceiling draft accepted real
+    # tokens over real rounds, and the routing preferred spec targets.
+    on = srows["on"]["spec"]
+    assert on["rounds"] > 0
+    assert on["accepted"] >= on["rounds"]
+    assert on["grants"] == spec["requests"]
+    assert on["tokens_per_round"] > 1.0
+    # Plain rows never speculate; their long decodes were bypassed.
+    assert srows["off"]["spec"]["rounds"] == 0
+    assert srows["off"]["spec"]["bypass"] == spec["requests"]
+    # The bad draft degraded: fallback rounds counted, acceptance ~1.
+    fb = srows["fallback"]["spec"]
+    assert fb["fallbacks"] > 0
+    assert fb["tokens_per_round"] <= 2.0
+    assert "verdict" in spec and "matched_chips" in spec["verdict"]
     metric = json.loads(proc.stdout.strip().splitlines()[-1])
     assert metric["metric"] == "serve_fleet_speedup"
     assert metric["artifact"] == str(out)

@@ -963,3 +963,162 @@ class TestChipBorrowAcceptance:
             ]
         finally:
             jm.stop()
+
+
+# ---------------------------------------------------------------------------
+# DraftRole + gain-mode arbitration (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+class DraftHarness:
+    """GatewayCore with a draft pool + spec targets whose poll reports
+    set the pool's earned-value signal."""
+
+    def __init__(self, drafts=1, targets=2):
+        self.clock = FakeClock()
+        self.core = GatewayCore(
+            GatewayConfig(lease_timeout_s=50.0), clock=self.clock
+        )
+        self._ids = itertools.count()
+        self.released = []
+        for i in range(targets):
+            self.core.register(f"t{i}", 2, spec=True)
+        self.spawn_calls = []
+
+        def spawn_fn(n, role=None):
+            self.spawn_calls.append((n, role))
+            for _ in range(n):
+                self.core.register(
+                    f"d{next(self._ids)}", 8, role="draft", spec=True,
+                    draft_addr=f"h:{next(self._ids)}",
+                )
+
+        spawn_fn(drafts)
+        self.spawn_calls.clear()
+        from dlrover_tpu.fleet import DraftRole
+
+        self.role = DraftRole(
+            RoleSpec("draft", desired=drafts, min_count=0,
+                     max_count=4),
+            self.core, spawn_fn, break_even=3.3, low_patience=2,
+            release_fn=self.released.append,
+        )
+
+    def report_acceptance(self, tpr):
+        for rid in list(self.core.stats_snapshot()["replicas"]):
+            if rid.startswith("t"):
+                self.core.poll(rid, 0, [],
+                               stats={"tokens_per_round": tpr})
+
+    def pump_drafts(self):
+        self.clock.advance(1.0)
+        snap = self.core.stats_snapshot()
+        for rid, rep in snap["replicas"].items():
+            if rid.startswith("d"):
+                if rep["draining"]:
+                    self.core.deregister(rid)
+                else:
+                    self.core.poll(rid, 0, [])
+
+
+@pytest.mark.spec
+class TestDraftRole:
+    def test_observes_draft_members_and_consumer_signal(self):
+        h = DraftHarness()
+        h.report_acceptance(4.2)
+        status = h.role.observe()
+        assert len(status.members) == 1
+        assert status.members[0].startswith("d")
+        assert status.signals["tokens_per_round"] == 4.2
+
+    def test_below_break_even_shrinks_after_patience(self):
+        h = DraftHarness()
+        h.report_acceptance(1.5)
+        assert h.role.reconcile() == 0  # pass 1: streak building
+        assert h.role.spec.desired == 1
+        h.role.reconcile()  # pass 2: patience met -> drain begins
+        snap = h.core.stats_snapshot()
+        draining = [r for r, rep in snap["replicas"].items()
+                    if rep["draining"]]
+        assert len(draining) == 1 and draining[0].startswith("d")
+        assert h.role.spec.desired == 0
+        # Drain completes when the draft deregisters; the next
+        # reconcile pass (fresh snapshot) observes it; release fires.
+        h.pump_drafts()
+        h.role.reconcile()
+        assert h.role.drain_pending() is False
+        assert h.released == draining
+
+    def test_above_break_even_and_unmeasured_hold(self):
+        h = DraftHarness()
+        for tpr in (4.5, 4.5, 0.0, 0.0, 4.5):
+            h.report_acceptance(tpr)
+            h.role.reconcile()
+        assert h.role.spec.desired == 1
+        assert not h.core.stats_snapshot()["replicas"]["d0"]["draining"]
+
+    def test_supervision_respawns_a_dead_draft(self):
+        h = DraftHarness()
+        h.core.deregister("d0")
+        h.role.reconcile()
+        assert h.spawn_calls == [(1, "draft")]
+
+
+@pytest.mark.spec
+class TestGainModeArbiter:
+    def _pair(self):
+        lender = StubRole("target", desired=3, min_count=1)
+        borrower = StubRole("draft", desired=1, min_count=0,
+                            max_count=4)
+        return lender, borrower
+
+    def test_gain_above_high_borrows_below_low_hands_back(self):
+        lender, borrower = self._pair()
+        gain = {"v": 5.0}
+        arb = ChipBorrowArbiter(
+            lender, borrower,
+            BorrowPolicy(spike_patience=2, decay_patience=2,
+                         cooldown_passes=0, gain_high=4.0,
+                         gain_low=3.3),
+            gain_fn=lambda: gain["v"],
+        )
+        assert arb.describe()["mode"] == "gain"
+        arb.step()
+        arb.step()  # patience met -> lender begins its drain
+        assert arb.phase == "lending"
+        lender.reconcile()  # the fleet pass pumps the lender's drain
+        arb.step()
+        assert arb.phase == "borrowed"
+        assert len(borrower.members) == 2
+        # Below break-even: the draft pool is not earning its chip.
+        gain["v"] = 1.0
+        arb.step()
+        arb.step()
+        assert arb.phase == "reclaiming"
+        borrower.reconcile()  # pump the borrower's drain
+        arb.step()
+        assert arb.phase == "idle" and arb.borrowed == 0
+        assert len(lender.members) == 3
+
+    def test_unmeasured_gain_holds_all_streaks(self):
+        lender, borrower = self._pair()
+        arb = ChipBorrowArbiter(
+            lender, borrower,
+            BorrowPolicy(spike_patience=1, decay_patience=1,
+                         gain_high=4.0, gain_low=3.3),
+            gain_fn=lambda: 0.0,
+        )
+        for _ in range(5):
+            arb.step()
+        assert arb.phase == "idle" and arb.borrowed == 0
+
+    def test_queue_mode_unchanged_without_gain_fn(self):
+        lender, borrower = self._pair()
+        borrower.signals = {"queue_depth": 100, "members_alive": 1}
+        arb = ChipBorrowArbiter(
+            lender, borrower,
+            BorrowPolicy(spike_patience=1, cooldown_passes=0),
+        )
+        assert arb.describe()["mode"] == "queue"
+        arb.step()
+        assert arb.phase == "lending"
