@@ -2038,8 +2038,14 @@ def serve_bench_main(argv: list) -> int:
     replicas_rows = [1, 2]
     out_path = None
     smoke = False
+    #: Re-measure ONLY the tracing-overhead pair (ISSUE 12) and merge
+    #: it into the existing artifact — the committed overhead row does
+    #: not require re-running the whole serve bench.
+    tracing_only = False
     for a in argv:
-        if a == "--smoke":
+        if a == "--tracing_only":
+            tracing_only = True
+        elif a == "--smoke":
             smoke = True
             opts.update(requests=5, mnt=6, device_round_ms=0.0,
                         timeout=60.0, routing_replicas=1,
@@ -2112,14 +2118,23 @@ def serve_bench_main(argv: list) -> int:
         "rows": [],
     }
     # --load_bench owns the `load` section of this artifact; a
-    # serve_bench rewrite must not silently erase it.
+    # serve_bench rewrite must not silently erase it.  --tracing_only
+    # goes further: the WHOLE prior artifact is the base and only the
+    # tracing section is re-measured.
     try:
         with open(out_path) as f:
             prior = json.load(f)
-        if isinstance(prior, dict) and "load" in prior:
-            result["load"] = prior["load"]
+        if isinstance(prior, dict):
+            if tracing_only:
+                prior.setdefault("rows", [])
+                result = prior
+            elif "load" in prior:
+                result["load"] = prior["load"]
     except (OSError, ValueError):
-        pass
+        if tracing_only:
+            print("--tracing_only needs an existing artifact at "
+                  f"{out_path}", file=sys.stderr)
+            return 2
 
     def flush():
         with open(out_path, "w") as f:
@@ -2147,20 +2162,28 @@ def serve_bench_main(argv: list) -> int:
             reqs.append((np.concatenate([templates[k], own]), p0))
         return reqs
 
-    def run_row(n_replicas: int, mode: str = "plain") -> dict:
+    def run_row(n_replicas: int, mode: str = "plain",
+                trace_sample=None) -> dict:
         """One fleet measurement.  ``plain`` = the uniform workload at
         least-loaded routing (the PR-5 rows); the routing modes share
         one Zipf prefix workload: ``least_loaded`` withholds the
         fingerprints, ``prefix`` routes on them, ``disagg`` splits the
-        fleet into prefill/decode pools with int8 KV handoff."""
+        fleet into prefill/decode pools with int8 KV handoff.
+        ``trace_sample`` overrides the gateway's head-based trace
+        sampling (ISSUE 12): the tracing-overhead pair runs the prefix
+        plane at 0.0 vs 1.0."""
         tmp = tempfile.mkdtemp(prefix="serve_bench_")
+        cfg_kw = {}
+        if trace_sample is not None:
+            cfg_kw["trace_sample"] = float(trace_sample)
         gw = Gateway(
             port=0,
             # disagg = the PR-8 relay plane (kv_p2p off); disagg_p2p =
             # ticket-only handoff, the segment bytes never transit the
             # gateway (ISSUE 9).
             config=GatewayConfig(queue_cap=512, prefix_reserve_s=3.0,
-                                 kv_p2p=(mode == "disagg_p2p")),
+                                 kv_p2p=(mode == "disagg_p2p"),
+                                 **cfg_kw),
             # Finer than the 1-2-5 default: routing-mode TTFT deltas
             # land inside one default bucket and would read as ties.
             histogram_buckets=(
@@ -2310,6 +2333,12 @@ def serve_bench_main(argv: list) -> int:
                 "duplicate_completions":
                     counters["duplicate_completions"],
             }
+            if trace_sample is not None:
+                row["trace"] = {
+                    "sample": float(trace_sample),
+                    "sampled": counters["trace_sampled"],
+                    "unsampled": counters["trace_unsampled"],
+                }
             if mode != "plain":
                 row["mode"] = mode
                 routed = (counters["prefix_hits"]
@@ -2383,7 +2412,8 @@ def serve_bench_main(argv: list) -> int:
             flush()
             print(f"{label}replicas={n}: {row}", file=sys.stderr)
 
-    run_rows(result["rows"])
+    if not tracing_only:
+        run_rows(result["rows"])
 
     def _speedup(rows):
         ok = [r for r in rows if "error" not in r]
@@ -2396,7 +2426,7 @@ def serve_bench_main(argv: list) -> int:
             return None, None
         return round(by_n[best_n]["tokens_per_sec"] / base, 2), best_n
 
-    if not smoke and opts["device_round_ms"] > 0:
+    if not smoke and not tracing_only and opts["device_round_ms"] > 0:
         # Honesty rows: the same fleet with NO round floor — the raw
         # 1-core timeshared regime, where replica scaling measures
         # XLA-CPU contention rather than the control plane.
@@ -2436,36 +2466,113 @@ def serve_bench_main(argv: list) -> int:
         ),
         "rows": [],
     }
-    result["routing"] = routing
-    for mode in ("least_loaded", "prefix", "disagg", "disagg_p2p"):
-        n = opts["routing_replicas"]
-        if mode in ("disagg", "disagg_p2p"):
-            n = max(2, n)  # at least one prefill + one decode
-        try:
-            row = run_row(n, mode=mode)
-        except Exception as e:  # noqa: BLE001 - record the row
-            row = {"mode": mode,
-                   "error": f"{type(e).__name__}: {str(e)[:200]}"}
-        routing["rows"].append(row)
-        flush()
-        print(f"routing mode={mode}: {row}", file=sys.stderr)
-    by_mode = {
-        r.get("mode"): r for r in routing["rows"] if "error" not in r
-    }
-    if "least_loaded" in by_mode and "prefix" in by_mode:
-        ll, pf = by_mode["least_loaded"], by_mode["prefix"]
-        routing["prefix_vs_least_loaded"] = {
-            "tokens_per_sec_x": round(
-                pf["tokens_per_sec"] / ll["tokens_per_sec"], 2
-            ) if ll["tokens_per_sec"] else 0.0,
-            "ttft_p99_ms": {
-                "least_loaded": ll["ttft_ms_p99"],
-                "prefix": pf["ttft_ms_p99"],
-            },
-            "wins_tokens_per_sec":
-                pf["tokens_per_sec"] > ll["tokens_per_sec"],
-            "wins_ttft_p99": pf["ttft_ms_p99"] <= ll["ttft_ms_p99"],
+    if tracing_only:
+        routing = result.get("routing", routing)
+    else:
+        result["routing"] = routing
+        for mode in ("least_loaded", "prefix", "disagg",
+                     "disagg_p2p"):
+            n = opts["routing_replicas"]
+            if mode in ("disagg", "disagg_p2p"):
+                n = max(2, n)  # at least one prefill + one decode
+            try:
+                row = run_row(n, mode=mode)
+            except Exception as e:  # noqa: BLE001 - record the row
+                row = {"mode": mode,
+                       "error": f"{type(e).__name__}: {str(e)[:200]}"}
+            routing["rows"].append(row)
+            flush()
+            print(f"routing mode={mode}: {row}", file=sys.stderr)
+        by_mode = {
+            r.get("mode"): r
+            for r in routing["rows"] if "error" not in r
         }
+        if "least_loaded" in by_mode and "prefix" in by_mode:
+            ll, pf = by_mode["least_loaded"], by_mode["prefix"]
+            routing["prefix_vs_least_loaded"] = {
+                "tokens_per_sec_x": round(
+                    pf["tokens_per_sec"] / ll["tokens_per_sec"], 2
+                ) if ll["tokens_per_sec"] else 0.0,
+                "ttft_p99_ms": {
+                    "least_loaded": ll["ttft_ms_p99"],
+                    "prefix": pf["ttft_ms_p99"],
+                },
+                "wins_tokens_per_sec":
+                    pf["tokens_per_sec"] > ll["tokens_per_sec"],
+                "wins_ttft_p99":
+                    pf["ttft_ms_p99"] <= ll["ttft_ms_p99"],
+            }
+
+    # Tracing-overhead rows (ISSUE 12): the SAME prefix data plane and
+    # load as the routing bench, measured with tracing off (sample 0)
+    # vs FULL-SAMPLING on (sample 1.0, every request carrying spans
+    # through gateway + replicas) — the committed evidence that the
+    # flight recorder is cheap enough to leave on.
+    tracing = {
+        "replicas": opts["routing_replicas"],
+        "requests": opts["routing_requests"],
+        "max_new_tokens": opts["routing_mnt"],
+        "poisson_rps": opts["routing_rps"],
+        "note": (
+            "prefix routing plane at the routing bench's load; off = "
+            "trace_sample 0.0 (every request counted unsampled, no "
+            "spans), on = trace_sample 1.0 (gateway phase spans + "
+            "grant trace contexts + replica-side spans into the "
+            "bounded ring; no dump directory, so the measured cost is "
+            "the hot-path recording itself)"
+        ),
+        "rows": [],
+    }
+    result["tracing"] = tracing
+    from dlrover_tpu.obs import get_recorder
+
+    for sample in (0.0, 1.0):
+        label = "on" if sample else "off"
+        before = get_recorder().stats()
+        try:
+            row = run_row(opts["routing_replicas"], mode="prefix",
+                          trace_sample=sample)
+            after = get_recorder().stats()
+            # Spans recorded in THIS (gateway-hosting) process; the
+            # subprocess replicas' rings die with them by design.
+            row["trace"]["gw_spans"] = (
+                after["spans"] - before["spans"]
+            )
+            row["trace"]["ring_dropped"] = (
+                after["dropped"] - before["dropped"]
+            )
+        except Exception as e:  # noqa: BLE001 - record the row
+            row = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+        row["trace_mode"] = label
+        tracing["rows"].append(row)
+        flush()
+        print(f"tracing {label}: {row}", file=sys.stderr)
+    t_by = {
+        r.get("trace_mode"): r
+        for r in tracing["rows"] if "error" not in r
+    }
+    if {"off", "on"} <= set(t_by):
+        off_r, on_r = t_by["off"], t_by["on"]
+        tracing["overhead"] = {
+            "tokens_per_sec": {
+                "off": off_r["tokens_per_sec"],
+                "on": on_r["tokens_per_sec"],
+            },
+            "tokens_per_sec_x": round(
+                on_r["tokens_per_sec"] / off_r["tokens_per_sec"], 4
+            ) if off_r["tokens_per_sec"] else 0.0,
+            "ttft_p99_ms": {
+                "off": off_r["ttft_ms_p99"],
+                "on": on_r["ttft_ms_p99"],
+            },
+            # The acceptance bar: full-sampling tracing costs <= 3%
+            # tokens/s at the routing bench's load.
+            "within_3pct": (
+                on_r["tokens_per_sec"]
+                >= 0.97 * off_r["tokens_per_sec"]
+            ),
+        }
+        flush()
 
     # Speculation rows (ISSUE 11): on/off at MATCHED chip budget, a
     # long-decode workload arriving at the speculation-off fleet's
@@ -2797,20 +2904,23 @@ def serve_bench_main(argv: list) -> int:
         ),
         "rows": [],
     }
-    result["spec"] = spec_sec
-    for mode in ("off", "on", "off_floor", "fallback"):
-        try:
-            row = run_spec_row(mode)
-        except Exception as e:  # noqa: BLE001 - record the row
-            row = {"mode": mode,
-                   "error": f"{type(e).__name__}: {str(e)[:200]}"}
-        spec_sec["rows"].append(row)
-        flush()
-        print(f"spec mode={mode}: {row}", file=sys.stderr)
+    if tracing_only:
+        spec_sec = result.get("spec", spec_sec)
+    else:
+        result["spec"] = spec_sec
+        for mode in ("off", "on", "off_floor", "fallback"):
+            try:
+                row = run_spec_row(mode)
+            except Exception as e:  # noqa: BLE001 - record the row
+                row = {"mode": mode,
+                       "error": f"{type(e).__name__}: {str(e)[:200]}"}
+            spec_sec["rows"].append(row)
+            flush()
+            print(f"spec mode={mode}: {row}", file=sys.stderr)
     spec_by = {
         r.get("mode"): r for r in spec_sec["rows"] if "error" not in r
     }
-    if {"off", "on", "off_floor", "fallback"} <= set(spec_by):
+    if not tracing_only and             {"off", "on", "off_floor", "fallback"} <= set(spec_by):
         on, off = spec_by["on"], spec_by["off"]
         fb, off_f = spec_by["fallback"], spec_by["off_floor"]
         spec_sec["verdict"] = {
@@ -2845,15 +2955,22 @@ def serve_bench_main(argv: list) -> int:
     main_ok = [r for r in result["rows"] if "error" not in r]
     routing_ok = [r for r in routing["rows"] if "error" not in r]
     spec_ok = [r for r in spec_sec["rows"] if "error" not in r]
+    tracing_ok = [r for r in tracing["rows"] if "error" not in r]
     result["complete"] = (
-        len(main_ok) == len(replicas_rows)
-        and all(r["completed"] == opts["requests"] for r in main_ok)
+        (tracing_only or (
+            len(main_ok) == len(replicas_rows)
+            and all(r["completed"] == opts["requests"]
+                    for r in main_ok)
+        ))
         and len(routing_ok) == 4
         and all(r["completed"] == opts["routing_requests"]
                 for r in routing_ok)
         and len(spec_ok) == 4
         and all(r["completed"] == opts["spec_requests"]
                 for r in spec_ok)
+        and len(tracing_ok) == 2
+        and all(r["completed"] == opts["routing_requests"]
+                for r in tracing_ok)
     )
     result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
     flush()

@@ -38,6 +38,7 @@ from dlrover_tpu.common.multi_process import (
 )
 from dlrover_tpu.common.shm import SharedMemoryArena, arena_name
 from dlrover_tpu.common.storage import PosixDiskStorage
+from dlrover_tpu.obs import journal
 
 
 class AsyncCheckpointSaver:
@@ -448,14 +449,14 @@ class AsyncCheckpointSaver:
         cache collapses the multiple gauges sampled by one scrape into
         ONE round trip (and one bounded wait against a hung server)."""
         ts, snap = self._perf_cache
-        if time.time() - ts < 1.0:
+        if time.monotonic() - ts < 1.0:
             return snap
         try:
             snap = self._stat.to_dict(timeout=2.0) or {}
         except Exception as e:  # noqa: BLE001
             logger.debug("perf stat snapshot failed: %s", e)
             snap = {}
-        self._perf_cache = (time.time(), snap)
+        self._perf_cache = (time.monotonic(), snap)
         return snap
 
     def last_stall_ms(self) -> float:
@@ -497,10 +498,16 @@ class AsyncCheckpointSaver:
                 if self._ctx.ckpt_commit_coverage and not slicer.commit_gate(
                     self.storage, ckpt_dir, step
                 ):
+                    journal("ckpt.commit", step=step, ok=False,
+                            verdict="coverage_blocked")
                     return
                 shard_file.commit(
                     self.storage, ckpt_dir, step, keep_last=keep_last
                 )
+                journal("ckpt.commit", step=step, ok=True,
+                        verdict="coverage_proven"
+                        if self._ctx.ckpt_commit_coverage
+                        else "ungated")
                 return
             if self._stop.is_set():
                 # Saver shutdown while shards are still missing: these

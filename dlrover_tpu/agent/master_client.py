@@ -150,12 +150,12 @@ class MasterClient:
     def kv_store_wait_get(
         self, key: str, timeout: float = 60.0, poll: float = 0.2
     ) -> Optional[bytes]:
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while True:
             val = self.kv_store_get(key)
             if val is not None:
                 return val
-            remaining = deadline - time.time()
+            remaining = deadline - time.monotonic()
             if remaining <= 0:
                 return None
             time.sleep(min(poll, remaining))
@@ -405,11 +405,11 @@ class MasterClient:
     def barrier(self, sync_name: str, timeout: float = 120.0) -> bool:
         """Join + poll a named barrier until it opens."""
         self.join_sync(sync_name)
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while True:
             if self.sync_finished(sync_name):
                 return True
-            remaining = deadline - time.time()
+            remaining = deadline - time.monotonic()
             if remaining <= 0:
                 return False
             time.sleep(min(0.2, remaining))

@@ -291,16 +291,41 @@ class Histogram:
 class MetricsRegistry:
     """Name -> callable returning a float (sampled at scrape time)."""
 
+    #: Consecutive scrape failures before a gauge's callback failure is
+    #: promoted from per-scrape debug to a once-per-gauge WARNING: one
+    #: blip during startup is noise, a gauge that never answers is a
+    #: blind spot an operator believes is being watched.
+    FAIL_PROMOTE_AFTER = 3
+
     def __init__(self):
         self._lock = threading.Lock()
         self._gauges: Dict[str, Callable[[], float]] = {}
+        self._overwrite_warned: set = set()
+        self._fail_streak: Dict[str, int] = {}
+        self._fail_warned: set = set()
 
     def gauge(self, name: str, fn: Callable[[], float]) -> None:
         with self._lock:
+            if name in self._gauges and \
+                    name not in self._overwrite_warned:
+                # Registering over an existing name silently replaced
+                # it before ISSUE 12: two subsystems exporting the same
+                # name means one of them is unknowingly dark.  Warn
+                # ONCE per name (re-registration is also a legitimate
+                # restart idiom — it must not spam every relaunch).
+                self._overwrite_warned.add(name)
+                logger.warning(
+                    "metrics: gauge %r re-registered; previous "
+                    "callback replaced (this warning fires once per "
+                    "name)", name,
+                )
             self._gauges[name] = fn
 
     def set(self, name: str, value: float) -> None:
-        self.gauge(name, lambda v=value: v)
+        """Pin a constant value.  Repeated sets UPDATE by design (the
+        last-value idiom) — no overwrite warning."""
+        with self._lock:
+            self._gauges[name] = lambda v=value: v
 
     def render(self) -> str:
         lines = []
@@ -310,10 +335,33 @@ class MetricsRegistry:
             try:
                 val = float(fn())
             except Exception as e:  # noqa: BLE001
-                # A broken gauge callback should not kill the scrape,
-                # but a permanently-failing one deserves a trace.
-                logger.debug("metrics: gauge %s failed: %s", name, e)
+                # A broken gauge callback should not kill the scrape;
+                # one that fails PERSISTENTLY is promoted to a
+                # once-per-gauge warning (a debug line per scrape is
+                # exactly how a dead gauge hides for weeks).
+                with self._lock:
+                    streak = self._fail_streak.get(name, 0) + 1
+                    self._fail_streak[name] = streak
+                    promote = (
+                        streak >= self.FAIL_PROMOTE_AFTER
+                        and name not in self._fail_warned
+                    )
+                    if promote:
+                        self._fail_warned.add(name)
+                if promote:
+                    logger.warning(
+                        "metrics: gauge %s has failed %d consecutive "
+                        "scrapes (%s) — it is exporting NOTHING",
+                        name, streak, e,
+                    )
+                else:
+                    logger.debug("metrics: gauge %s failed: %s",
+                                 name, e)
                 continue
+            with self._lock:
+                if self._fail_streak.pop(name, None):
+                    # Recovered: a later relapse deserves a new warning.
+                    self._fail_warned.discard(name)
             lines.append(f"# TYPE {PREFIX}_{name} gauge")
             lines.append(f"{PREFIX}_{name} {val}")
         return "\n".join(lines) + "\n"

@@ -203,7 +203,7 @@ class ElasticTrainingAgent:
         cfg = self.config
         coord_port = find_free_port()
         attempt_id = uuid.uuid4().hex
-        deadline = time.time() + cfg.rdzv_timeout
+        deadline = time.monotonic() + cfg.rdzv_timeout
         rejoin_interval = max(1.0, self._ctx.rdzv_rejoin_interval)
         joined = False
         last_join = 0.0
@@ -268,18 +268,18 @@ class ElasticTrainingAgent:
                 attempt_id=attempt_id,
             )
 
-        while time.time() < deadline:
-            if not joined or time.time() - last_join >= rejoin_interval:
+        while time.monotonic() < deadline:
+            if not joined or time.monotonic() - last_join >= rejoin_interval:
                 try:
                     _join()
                     if joined:
                         logger.info(
                             "rendezvous: re-sent join (no world after "
                             "%.0fs; master may have restarted)",
-                            time.time() - last_join,
+                            time.monotonic() - last_join,
                         )
                     joined = True
-                    last_join = time.time()
+                    last_join = time.monotonic()
                     join_failures = 0
                 except Exception as e:  # noqa: BLE001
                     join_failures += 1
@@ -290,7 +290,7 @@ class ElasticTrainingAgent:
                         # A channel that rode out a master restart can
                         # stay wedged in TRANSIENT_FAILURE; start fresh.
                         self.client.reconnect()
-                    time.sleep(min(1.0, max(0.0, deadline - time.time())))
+                    time.sleep(min(1.0, max(0.0, deadline - time.monotonic())))
                     continue
             try:
                 round_, _, world, coordinator = self.client.get_comm_world(
@@ -300,7 +300,7 @@ class ElasticTrainingAgent:
                 logger.warning(
                     "rendezvous poll failed (will retry): %s", e
                 )
-                time.sleep(min(1.0, max(0.0, deadline - time.time())))
+                time.sleep(min(1.0, max(0.0, deadline - time.monotonic())))
                 continue
             if world:
                 my_rank = None
@@ -444,9 +444,9 @@ class ElasticTrainingAgent:
                     os.killpg(os.getpgid(w.proc.pid), signal.SIGTERM)
                 except (ProcessLookupError, PermissionError):
                     pass
-        deadline = time.time() + grace
+        deadline = time.monotonic() + grace
         for w in self._workers:
-            remaining = max(0.1, deadline - time.time())
+            remaining = max(0.1, deadline - time.monotonic())
             try:
                 w.proc.wait(timeout=remaining)
             except subprocess.TimeoutExpired:

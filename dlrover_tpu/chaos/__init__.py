@@ -17,6 +17,7 @@ from dlrover_tpu.chaos.plan import (  # noqa: F401
     active_plan,
     configure,
     inject,
+    on_crash,
     reset,
     scrub_env,
     without_sites,

@@ -325,6 +325,21 @@ class FaultPlan:
 
 _PLAN: Optional[FaultPlan] = None
 
+#: Pre-crash callbacks (ISSUE 12): a chaos crash simulates SIGKILL for
+#: every subsystem under test (no atexit, no finally) — but the flight
+#: recorder is precisely the black box that must survive the crash, so
+#: registered hooks run (guarded) in the last instants before
+#: ``os._exit``.  Hooks must be fast and must never raise the process
+#: back to life: exceptions are swallowed (logged), and the exit
+#: proceeds regardless.
+_CRASH_HOOKS: List = []
+
+
+def on_crash(hook) -> None:
+    """Register ``hook(site, ctx)`` to run before a chaos crash exits."""
+    if hook not in _CRASH_HOOKS:
+        _CRASH_HOOKS.append(hook)
+
 
 def _load_from_env() -> Optional[FaultPlan]:
     text = os.environ.get(ENV_VAR, "").strip()
@@ -373,6 +388,7 @@ def inject(site: str, **ctx) -> Optional[FaultSpec]:
     spec = plan.fire(site, **ctx)
     if spec is None:
         return None
+    _journal_firing(site, spec, ctx)
     if spec.kind == "latency":
         logger.warning(
             "chaos: %s fired (ctx=%s): sleeping %.3fs", site, ctx, spec.delay
@@ -383,12 +399,36 @@ def inject(site: str, **ctx) -> Optional[FaultSpec]:
             "chaos: %s fired (ctx=%s): os._exit(%d)", site, ctx,
             spec.exit_code,
         )
+        for hook in list(_CRASH_HOOKS):
+            try:
+                hook(site, dict(ctx))
+            except Exception:  # noqa: BLE001 - the exit must proceed
+                logger.warning("chaos: crash hook failed", exc_info=True)
         # Hard exit on purpose: a chaos crash simulates SIGKILL/OOM — no
-        # atexit hooks, no finally blocks, no flushing beyond this line.
+        # atexit hooks, no finally blocks, no flushing beyond this line
+        # (the flight-recorder spill above is the one sanctioned
+        # exception: the black box that must survive the crash).
         os._exit(spec.exit_code)
     else:
         logger.warning("chaos: %s fired (ctx=%s)", site, ctx)
     return spec
+
+
+def _journal_firing(site: str, spec: FaultSpec, ctx: dict) -> None:
+    """Every chaos firing is a control-plane journal event (ISSUE 12):
+    a postmortem must show the injection beside its consequences.  Lazy
+    import (obs pulls nothing heavy, but chaos must import first)."""
+    try:
+        from dlrover_tpu.obs import journal
+
+        journal(
+            "chaos.inject", site=site, fault_kind=spec.kind,
+            fired=spec.fired,
+            ctx={k: v for k, v in ctx.items()
+                 if isinstance(v, (str, int, float, bool))},
+        )
+    except Exception:  # noqa: BLE001 - chaos must fire regardless
+        logger.debug("chaos: journal emit failed", exc_info=True)
 
 
 def without_sites(plan_text: str, sites) -> str:

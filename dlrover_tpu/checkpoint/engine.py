@@ -44,6 +44,7 @@ from dlrover_tpu.common.multi_process import (
 )
 from dlrover_tpu.common.shm import SharedMemoryArena, arena_name
 from dlrover_tpu.common.storage import CheckpointStorage, PosixDiskStorage
+from dlrover_tpu.obs import journal
 
 
 def ckpt_queue_name(job_name: str) -> str:
@@ -220,6 +221,9 @@ class CheckpointEngine:
         )
         perf_stats.set("ckpt_stall_ms_last", self.last_stall_ms)
         perf_stats.set("ckpt_staged_mbps", staged_mbps)
+        journal("ckpt.stage", step=step, rank=self.process_id,
+                stall_ms=round(self.last_stall_ms, 1),
+                mbps=round(staged_mbps, 1))
         logger.info(
             "flash ckpt: staged step %d to shm in %.3fs (%.0f MB/s, "
             "train stalled %.1fms)",
@@ -364,6 +368,10 @@ class CheckpointEngine:
             stats["total_bytes"]
             / max(time.perf_counter() - t0, 1e-9) / (1 << 20)
         )
+        journal("ckpt.persist", step=step, rank=self.process_id,
+                mbps=round(mbps, 1),
+                bytes=int(stats["total_bytes"]),
+                skipped=int(plan.skipped))
         perf_stats.set("ckpt_persist_mbps", mbps)
         # Standalone = one rank per process: its own persist rate IS its
         # contribution to the fleet aggregate the bench/master sum up.
@@ -404,6 +412,8 @@ class CheckpointEngine:
                 if self._ctx.ckpt_commit_coverage and not slicer.commit_gate(
                     self.storage, self.ckpt_dir, step
                 ):
+                    journal("ckpt.commit", step=step, ok=False,
+                            verdict="coverage_blocked")
                     return False
                 shard_file.commit(
                     self.storage, self.ckpt_dir, step,
@@ -411,9 +421,14 @@ class CheckpointEngine:
                         self.max_to_keep
                     ),
                 )
+                journal("ckpt.commit", step=step, ok=True,
+                        verdict="coverage_proven"
+                        if self._ctx.ckpt_commit_coverage
+                        else "ungated")
                 return True
             time.sleep(0.5)
         logger.warning("commit of step %d timed out", step)
+        journal("ckpt.commit", step=step, ok=False, verdict="timeout")
         return False
 
     def wait(self, timeout: float = 600.0) -> bool:

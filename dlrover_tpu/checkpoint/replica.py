@@ -228,7 +228,7 @@ class CkptReplicaManager:
     ) -> bool:
         if self.world_size <= 1:
             return False
-        now = time.time()
+        now = time.monotonic()
         if not force and now - self._last_push.get(process_id, 0.0) < (
             self.push_interval
         ):

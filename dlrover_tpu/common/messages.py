@@ -28,7 +28,17 @@ _FIELD_CACHE: Dict[type, tuple] = {}
 
 
 class Message:
-    """Base for all wire messages.  Subclasses must be dataclasses."""
+    """Base for all wire messages.  Subclasses must be dataclasses.
+
+    ``_WIRE_OPTIONAL`` names fields that are OMITTED from the encoded
+    form while empty/falsy (decode fills them from the dataclass
+    default).  This is how a message grows a field — the observability
+    trace context (ISSUE 12) — without changing the bytes of messages
+    that don't carry it: the serving fast path stays byte-identical,
+    and mixed-version peers interoperate (a missing key decodes to the
+    default)."""
+
+    _WIRE_OPTIONAL: frozenset = frozenset()
 
     def __init_subclass__(cls, **kwargs):
         super().__init_subclass__(**kwargs)
@@ -36,13 +46,15 @@ class Message:
 
 
 def _fields_of(cls: type) -> tuple:
-    names = _FIELD_CACHE.get(cls)
-    if names is None:
+    """(field names, wire-optional names) for ``cls``, cached."""
+    entry = _FIELD_CACHE.get(cls)
+    if entry is None:
         names = tuple(
             f.name for f in dataclasses.fields(cls)  # type: ignore[arg-type]
         )
-        _FIELD_CACHE[cls] = names
-    return names
+        entry = (names, cls._WIRE_OPTIONAL)
+        _FIELD_CACHE[cls] = entry
+    return entry
 
 
 # The encode/decode pair below is the serving tier's admission hot
@@ -67,8 +79,11 @@ def _encode(obj: Any) -> Any:
     if isinstance(obj, Message):
         cls = type(obj)
         out = {}
-        for name in _fields_of(cls):
+        names, optional = _fields_of(cls)
+        for name in names:
             v = getattr(obj, name)
+            if not v and name in optional:
+                continue  # wire-optional and empty: omit (byte compat)
             out[name] = _encode(v) if isinstance(v, _RECURSE) else v
         return {"__msg__": cls.__name__, "f": out}
     if isinstance(obj, dict):
@@ -118,11 +133,13 @@ def _encode_generic(obj: Any) -> Any:
     everywhere) — kept as the measured baseline for ``bench.py
     --load_bench``'s serialization profile; not used on any wire path."""
     if isinstance(obj, Message):
+        optional = type(obj)._WIRE_OPTIONAL
         return {
             "__msg__": type(obj).__name__,
             "f": {
                 f.name: _encode_generic(getattr(obj, f.name))
                 for f in dataclasses.fields(obj)  # type: ignore[arg-type]
+                if getattr(obj, f.name) or f.name not in optional
             },
         }
     if isinstance(obj, dict):
@@ -672,6 +689,13 @@ class ServeSubmit(Message):
     kv_crc32: int = 0
     kv_nbytes: int = 0
     kv_relay: bool = False
+    #: Distributed-trace context (ISSUE 12): ``{"tid": trace_id,
+    #: "sid": parent span id}``.  Wire-optional — a trace-less submit
+    #: (or an unsampled request's grant) encodes byte-identically to
+    #: the pre-trace wire, keeping the msgpack fast path intact.
+    trace: dict = dataclasses.field(default_factory=dict)
+
+    _WIRE_OPTIONAL = frozenset({"trace"})
 
 
 @dataclasses.dataclass
@@ -802,6 +826,15 @@ class ServeDone(Message):
     #: the SAME numbers the request earned live (0 = never speculated).
     tokens_per_round: float = 0.0
     spec_rounds: int = 0
+    #: Trace context of a JOURNAL-REPLAYED completion (ISSUE 12): the
+    #: replica ships the trace id the request earned when served live,
+    #: so a replay landing at a fresh gateway (failover adoption) joins
+    #: the ORIGINAL trace instead of orphaning a new one.  Empty on
+    #: live completions (the gateway already holds the context) and
+    #: omitted from the wire (byte compat).
+    trace: dict = dataclasses.field(default_factory=dict)
+
+    _WIRE_OPTIONAL = frozenset({"trace"})
 
 
 @dataclasses.dataclass
@@ -826,6 +859,10 @@ class ServeKvReady(Message):
     seg_fp: str = ""
     crc32: int = 0
     nbytes: int = 0
+    #: Trace context (ISSUE 12), wire-optional (byte compat).
+    trace: dict = dataclasses.field(default_factory=dict)
+
+    _WIRE_OPTIONAL = frozenset({"trace"})
 
 
 @dataclasses.dataclass
@@ -906,6 +943,28 @@ class ServeFleetStatsRequest(Message):
 @dataclasses.dataclass
 class ServeFleetStats(Message):
     stats: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ObsScrapeRequest(Message):
+    """Live flight-recorder scrape (ISSUE 12): pull the process's
+    bounded event ring over the existing RPC idiom.  ``since_seq``
+    resumes an incremental scrape (0 = everything still in the ring)."""
+
+    since_seq: int = 0
+
+
+@dataclasses.dataclass
+class ObsScrape(Message):
+    """Scrape reply: ``events`` are the recorder's structured dicts
+    (spans + journal events), ``dropped`` the ring's lifetime drop
+    count (bounded ring — every drop is counted, never silent), and
+    ``next_seq`` the cursor for the next incremental scrape."""
+
+    process: str = ""
+    events: list = dataclasses.field(default_factory=list)
+    dropped: int = 0
+    next_seq: int = 0
 
 
 @dataclasses.dataclass

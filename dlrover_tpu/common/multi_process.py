@@ -152,14 +152,14 @@ class _Client:
         reply_margin: Optional[float] = None,
     ) -> Any:
         margin = self._REPLY_MARGIN if reply_margin is None else reply_margin
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         last: Optional[Exception] = None
         while True:
             sent = False
             try:
                 with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
                     s.settimeout(
-                        max(0.1, deadline - time.time()) + margin
+                        max(0.1, deadline - time.monotonic()) + margin
                     )
                     s.connect(self._path)
                     _send_msg(s, [op, *args])
@@ -219,7 +219,7 @@ class SharedLockServer(LocalSocketServer):
         return True
 
     def op_acquire(self, holder: str, blocking: bool, timeout: float) -> bool:
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         with self._cond:
             while self._owner is not None and self._owner != holder:
                 if not self._holder_alive(self._owner):
@@ -231,7 +231,7 @@ class SharedLockServer(LocalSocketServer):
                     break
                 if not blocking:
                     return False
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
                 self._cond.wait(min(remaining, 1.0))
@@ -306,10 +306,10 @@ class SharedQueueServer(LocalSocketServer):
         super().__init__(name)
 
     def op_put(self, item: Any, timeout: float) -> bool:
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         with self._cond:
             while self._maxsize and len(self._q) >= self._maxsize:
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
                 self._cond.wait(min(remaining, 1.0))
@@ -318,10 +318,10 @@ class SharedQueueServer(LocalSocketServer):
             return True
 
     def op_get(self, timeout: float) -> list:
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         with self._cond:
             while not self._q:
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return [False, None]
                 self._cond.wait(min(remaining, 1.0))

@@ -62,9 +62,9 @@ def addr_connectable(addr: str, timeout: float = 3.0) -> bool:
         port = int(port_s)
     except ValueError:
         return False
-    deadline = time.time() + timeout
+    deadline = time.monotonic() + timeout
     while True:
-        remaining = deadline - time.time()
+        remaining = deadline - time.monotonic()
         if remaining <= 0:
             return False
         try:
@@ -76,7 +76,7 @@ def addr_connectable(addr: str, timeout: float = 3.0) -> bool:
             ):
                 return True
         except OSError:
-            remaining = deadline - time.time()
+            remaining = deadline - time.monotonic()
             if remaining <= 0:
                 return False
             time.sleep(min(0.5, remaining))
@@ -281,7 +281,7 @@ class RpcClient:
             if deadline is None
             else deadline
         )
-        start = time.time()
+        start = time.monotonic()
         last_err: Optional[Exception] = None
         name = type(msg).__name__
         for attempt in range(retries):
@@ -291,7 +291,7 @@ class RpcClient:
                     raise ChaosRpcError(
                         grpc.StatusCode.UNAVAILABLE, "chaos: rpc.unavailable"
                     )
-                remaining = budget - (time.time() - start)
+                remaining = budget - (time.monotonic() - start)
                 if remaining <= 0:
                     break
                 data = self._call(
@@ -314,7 +314,7 @@ class RpcClient:
                 # lockstep (the fixed backoff*2**attempt schedule did).
                 base = min(backoff * (2**attempt), 8.0)
                 sleep = random.uniform(0.5 * base, base)
-                remaining = budget - (time.time() - start)
+                remaining = budget - (time.monotonic() - start)
                 if remaining <= sleep:
                     break  # the budget is spent; re-raise below
                 logger.warning(

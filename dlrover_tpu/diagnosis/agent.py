@@ -68,6 +68,8 @@ class TrainingLogCollector:
                 path = os.path.join(self._log_dir, name)
                 if not os.path.isfile(path):
                     continue
+                # graftcheck: disable=OB301 -- vs the log file's wall
+                # mtime; wall time is the point
                 if now - os.stat(path).st_mtime > self._max_age:
                     continue
                 with open(path, "rb") as f:
@@ -200,11 +202,14 @@ class HangingDetector:
             # No step ever recorded: the first XLA compile can take tens
             # of minutes — apply the grace window even if a heartbeat
             # file was created (but not yet touched) at startup.
+            # last_progress folds in the heartbeat FILE's wall mtime,
+            # so the compare clock must be wall too; a step only bends
+            # a coarse grace window.
             return (
-                now - self._started > self._grace
-                and now - last_progress > self._timeout
+                now - self._started > self._grace  # graftcheck: disable=OB301 -- wall-mtime family (see above)
+                and now - last_progress > self._timeout  # graftcheck: disable=OB301 -- wall-mtime family
             )
-        return now - last_progress > self._timeout
+        return now - last_progress > self._timeout  # graftcheck: disable=OB301 -- wall-mtime family
 
     # -- background watcher ------------------------------------------------
     def start(self) -> None:

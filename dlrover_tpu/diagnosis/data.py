@@ -71,6 +71,9 @@ class DiagnosisDataManager:
         return out
 
     def _expire_locked(self, series: List[DiagnosisRecord]) -> None:
+        # graftcheck: disable=OB301 -- record timestamps arrive from
+        # WORKERS' wall clocks (DiagnosisReport.timestamp); wall is the
+        # only shared timeline, and a step only bends a coarse TTL
         cutoff = time.time() - self._ttl
         while series and series[0].timestamp < cutoff:
             series.pop(0)

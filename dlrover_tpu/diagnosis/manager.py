@@ -114,6 +114,9 @@ class DiagnosisManager:
                 # incident with the same reason: purge them first.
                 existing = [
                     e for e in self._pending.get(nid, [])
+                    # graftcheck: disable=OB301 -- "created" rides the
+                    # DiagnosisAction payload (wire contract: wall);
+                    # a step only bends a coarse TTL
                     if now - e.payload.get("created", now)
                     < self.BROADCAST_TTL_S
                 ]
@@ -146,6 +149,8 @@ class DiagnosisManager:
         with self._lock:
             out = [
                 a for a in self._pending.pop(node_id, [])
+                # graftcheck: disable=OB301 -- "created" is wall by the
+                # payload's wire contract (see enqueue_broadcast)
                 if now - a.payload.get("created", now)
                 < self.BROADCAST_TTL_S
             ]
@@ -249,6 +254,9 @@ class DiagnosisManager:
         now = time.time()
         with self._lock:
             for key, ts in list(self._delivered.items()):
+                # graftcheck: disable=OB301 -- shares the wall clock of
+                # the payload "created" stamps set below (one clock
+                # family per record; a step bends a coarse cooldown)
                 if now - ts > self._redeliver_cooldown_s:
                     del self._delivered[key]
             whole_job: List[tuple] = []

@@ -37,13 +37,13 @@ class CheckTrainingHangOperator(InferenceOperator):
         self._speed_monitor = speed_monitor
         self._hang_timeout = hang_timeout_s
         self._compile_grace = compile_grace_s
-        self._started_at = time.time()
+        self._started_at = time.monotonic()
 
     def is_compatible(self, inference: Inference) -> bool:
         return inference.name == InferenceName.TRAINING_HANG
 
     def infer(self, inferences: List[Inference]) -> List[Inference]:
-        now = time.time()
+        now = time.monotonic()
         # Whole-job hang: the global step stopped advancing.
         if self._speed_monitor is not None:
             if (
@@ -174,10 +174,12 @@ class CheckStragglerOperator(InferenceOperator):
 
     def infer(self, inferences: List[Inference]) -> List[Inference]:
         latest = self._data.latest_per_node(DiagnosisDataType.OP_METRICS)
-        now = time.time()
+        now = time.time()  # vs worker-stamped record timestamps (wall)
         p50 = {}
         coll = {}
         for nid, rec in latest.items():
+            # graftcheck: disable=OB301 -- rec.timestamp is the WORKER's
+            # wall clock; wall is the only shared timeline
             if now - rec.timestamp > self._stale:
                 continue
             try:

@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.fleet.role import RoleAdapter
+from dlrover_tpu.obs import journal
 
 
 class FleetManager:
@@ -85,6 +86,12 @@ class FleetManager:
             if delta:
                 with self._mu:
                     self.events.append((n, name, delta))
+                # Every applied reconcile decision lands in the flight
+                # recorder (ISSUE 12): a postmortem must show WHY the
+                # fleet moved, next to what it did to requests.
+                journal("fleet.reconcile", role=name, delta=delta,
+                        reconcile_pass=n,
+                        desired=adapter.spec.desired)
         for policy in policies:
             try:
                 policy.step(self)

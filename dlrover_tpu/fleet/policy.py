@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.fleet.role import RoleAdapter
+from dlrover_tpu.obs import journal
 
 IDLE = "idle"
 LENDING = "lending"          # lender draining (training reshard/restart)
@@ -206,6 +207,12 @@ class ChipBorrowArbiter:
             reason,
         )
         self.events.append((self.phase, phase, reason))
+        # Loans are the decisions operators second-guess first: every
+        # transition is a flight-recorder entry (ISSUE 12).
+        journal("fleet.borrow", lender=self.lender.name,
+                borrower=self.borrower.name, phase_from=self.phase,
+                phase_to=phase, reason=reason,
+                borrowed=self.borrowed)
         self.phase = phase
 
     def describe(self) -> Dict[str, Any]:

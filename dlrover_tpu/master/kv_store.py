@@ -35,10 +35,10 @@ class KVStoreService:
     def wait(self, keys: List[str], timeout: float = 60.0) -> bool:
         """Block until all ``keys`` exist (torch-Store ``wait`` semantics the
         agent's KV client exposes)."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         with self._cond:
             while not all(k in self._store for k in keys):
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
                 self._cond.wait(min(remaining, 1.0))

@@ -150,7 +150,8 @@ class LocalJobMaster(JobMaster):
         last_report = 0.0
         try:
             while not self._stop_event.wait(2.0):
-                if report is not None and time.time() - last_report >= 30:
+                if report is not None and \
+                        time.monotonic() - last_report >= 30:
                     speed = self.speed_monitor.running_speed()
                     # Only LIVE workers: counting exited nodes would file
                     # the post-shrink speed under the old worker count
@@ -163,7 +164,7 @@ class LocalJobMaster(JobMaster):
                         in (NodeStatus.RUNNING, NodeStatus.INITIAL)
                     )
                     if speed > 0 and workers > 0:
-                        last_report = time.time()
+                        last_report = time.monotonic()
                         report(workers, speed)
                 if self.job_manager.all_workers_exited():
                     success = self.job_manager.all_workers_succeeded()

@@ -144,6 +144,9 @@ class LocalJobManager:
                     if (
                         node.status == NodeStatus.RUNNING
                         and node.heartbeat_time
+                        # graftcheck: disable=OB301 -- heartbeat_time is
+                        # the WORKER's wall stamp (Heartbeat.timestamp);
+                        # wall is the only shared timeline
                         and now - node.heartbeat_time
                         > self._ctx.node_heartbeat_timeout
                     ):
@@ -151,6 +154,8 @@ class LocalJobManager:
             for node in dead:
                 logger.warning(
                     "node %d heartbeat timeout (%.0fs)",
+                    # graftcheck: disable=OB301 -- same cross-process
+                    # wall-stamp family as the detection above
                     node.id, now - node.heartbeat_time,
                 )
                 self.update_node_status(

@@ -151,7 +151,7 @@ class RendezvousManager:
                 "attempt_id": attempt_id,
             }
             self._alive_nodes.add(node_id)
-            self._lastcall_time = time.time()
+            self._lastcall_time = time.monotonic()
             if not self._start_waiting_time:
                 self._start_waiting_time = self._lastcall_time
             logger.info(
@@ -165,7 +165,7 @@ class RendezvousManager:
         n = len(self._waiting_nodes)
         if n < self._min_nodes:
             return
-        lastcall_elapsed = time.time() - self._lastcall_time
+        lastcall_elapsed = time.monotonic() - self._lastcall_time
         if n < self._max_nodes and lastcall_elapsed < self._waiting_timeout:
             return
         # Round down to the node-unit quantum (reference node_unit rounding).
@@ -243,7 +243,8 @@ class RendezvousManager:
             if not self._start_waiting_time:
                 return False
             return (
-                time.time() - self._start_waiting_time > self._ctx.rdzv_timeout
+                time.monotonic() - self._start_waiting_time
+                > self._ctx.rdzv_timeout
             )
 
     @property

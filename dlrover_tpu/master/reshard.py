@@ -24,6 +24,7 @@ from typing import Dict, Optional
 from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.obs import journal
 
 IDLE = "idle"
 PREPARING = "preparing"
@@ -89,6 +90,9 @@ class ReshardManager:
                 "deadline %.0fs)",
                 self._epoch, self._target_num, self._target_spec, budget,
             )
+            journal("reshard.epoch", epoch=self._epoch,
+                    status=PREPARING, target=self._target_num,
+                    deadline_s=budget)
             return self._epoch
 
     def abort(self, reason: str = "") -> None:
@@ -99,6 +103,8 @@ class ReshardManager:
                     "checkpoint-restart ladder", self._epoch, reason,
                 )
                 self._status = ABORTED
+                journal("reshard.epoch", epoch=self._epoch,
+                        status=ABORTED, reason=reason[:200])
 
     # -- worker-facing -------------------------------------------------------
     def info(self) -> m.ReshardEpochInfo:
@@ -130,6 +136,9 @@ class ReshardManager:
                 )
                 if self._status == PREPARING:
                     self._status = ABORTED
+                    journal("reshard.epoch", epoch=self._epoch,
+                            status=ABORTED, node=msg.node_id,
+                            reason=msg.reason[:200])
                 return m.BaseResponse(success=True)
             logger.info(
                 "reshard: node %d completed epoch %d in %.0fms "
@@ -147,6 +156,9 @@ class ReshardManager:
                     "reshard: epoch %d DONE — %d/%d nodes resized live, "
                     "no restart", self._epoch, oks, self._expected,
                 )
+                journal("reshard.epoch", epoch=self._epoch,
+                        status=DONE, ok_reports=oks,
+                        expected=self._expected)
             return m.BaseResponse(success=True)
 
     # -- bookkeeping ---------------------------------------------------------
@@ -166,6 +178,8 @@ class ReshardManager:
                 self._expected,
             )
             self._status = ABORTED
+            journal("reshard.epoch", epoch=self._epoch,
+                    status=ABORTED, reason="deadline lapsed")
 
     @property
     def status(self) -> str:

@@ -65,7 +65,7 @@ class SpeedMonitor:
             self._last_step_time = ts
             self._sample_count += 1
             if self._down_since is not None:
-                self._downtime_total += ts - self._down_since
+                self._downtime_total += ts - self._down_since  # graftcheck: disable=OB301 -- step ts is the WORKER's wall stamp; wall is the shared timeline
                 self._down_since = None
 
     def mark_down(self) -> None:
@@ -83,7 +83,7 @@ class SpeedMonitor:
     def mark_up(self) -> None:
         with self._lock:
             if self._down_since is not None:
-                self._downtime_total += time.time() - self._down_since
+                self._downtime_total += time.time() - self._down_since  # graftcheck: disable=OB301 -- one clock family with the worker-stamped step times
                 self._down_since = None
 
     def record_ckpt_stall(
@@ -192,10 +192,10 @@ class SpeedMonitor:
             if self._first_step_time is None:
                 return 0.0
             now = time.time()
-            elapsed = now - self._first_step_time
+            elapsed = now - self._first_step_time  # graftcheck: disable=OB301 -- first/last step times are worker wall stamps
             down = self._downtime_total + self._ckpt_stall_total
             if self._down_since is not None:
-                down += now - self._down_since
+                down += now - self._down_since  # graftcheck: disable=OB301 -- same wall family
             if elapsed <= 0:
                 return 0.0
             return max(0.0, min(1.0, (elapsed - down) / elapsed))
@@ -212,8 +212,8 @@ class SpeedMonitor:
             if self._down_since is not None:
                 # Known pause (restart -> recompile): give it double the
                 # hang budget before calling the recovery itself hung.
-                return time.time() - self._down_since > 2 * t
-            return time.time() - self._last_step_time > t
+                return time.time() - self._down_since > 2 * t  # graftcheck: disable=OB301 -- wall family of worker step stamps
+            return time.time() - self._last_step_time > t  # graftcheck: disable=OB301 -- last_step_time is the worker's wall stamp
 
     def reset_running_speed_monitor(self) -> None:
         with self._lock:

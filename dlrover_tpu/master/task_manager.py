@@ -60,7 +60,7 @@ class DatasetManager:
             return None
         task_id, shard = self._todo.pop(0)
         self._doing[task_id] = DoingTask(
-            task_id, worker_id, time.time(), shard, task_type
+            task_id, worker_id, time.monotonic(), shard, task_type
         )
         self._dispatched += 1
         return task_id, shard, self.splitter.epoch
@@ -86,7 +86,7 @@ class DatasetManager:
         return recovered
 
     def reassign_timeout_tasks(self) -> int:
-        now = time.time()
+        now = time.monotonic()
         n = 0
         for task_id in list(self._doing.keys()):
             if now - self._doing[task_id].start_time > self._task_timeout:
@@ -164,7 +164,7 @@ class TaskManager:
             ds = self._datasets.get(dataset_name)
             if ds is None:
                 return None
-            self._worker_last_task[worker_id] = time.time()
+            self._worker_last_task[worker_id] = time.monotonic()
             got = ds.get_task(worker_id)
             if got is not None:
                 self._fetch_tokens.put(token, got)

@@ -1,0 +1,41 @@
+"""Fleet flight recorder (ISSUE 12): distributed request tracing, a
+control-plane event journal, and crash postmortems.
+
+Three layers, all jax-free:
+
+- :mod:`~dlrover_tpu.obs.span` — trace identity (trace_id derived from
+  the request id, so a failover resubmit joins the SAME trace with no
+  wire coordination) and monotonic-clock spans with per-process epoch
+  anchoring (each process pins ``wall - monotonic`` once at import, so
+  merged timelines align across processes to clock-sync precision
+  without ever measuring durations on the wall clock).
+- :mod:`~dlrover_tpu.obs.recorder` — the per-process
+  :class:`FlightRecorder`: a bounded ring of structured events (spans +
+  control-plane journal entries) spilled as fsync'd JSONL on exit,
+  SIGTERM, and chaos crashes (``chaos.on_crash``), and scrapeable live
+  over the repo RPC idiom (``ObsScrapeRequest``).  Every ring drop is
+  counted, never silent.
+- :mod:`~dlrover_tpu.obs.collect` / :mod:`~dlrover_tpu.obs.postmortem`
+  — merge per-process dumps by trace_id into one Perfetto-loadable
+  chrome trace (``utils/trace_analysis.py`` consumes it for rollups),
+  validate span trees, and reconstruct a killed fleet's last seconds.
+
+Enabled by ``DLROVER_TPU_OBS_DIR`` (dump directory; unset = ring-only,
+still live-scrapeable).  ``DLROVER_TPU_OBS_PROCESS`` names the process
+in dumps and merged traces.
+"""
+
+from dlrover_tpu.obs.recorder import (  # noqa: F401
+    FlightRecorder,
+    configure,
+    get_recorder,
+    journal,
+    record_span,
+    reset,
+    set_process,
+)
+from dlrover_tpu.obs.span import (  # noqa: F401
+    anchored_us,
+    new_span_id,
+    trace_id_for,
+)

@@ -1,0 +1,252 @@
+"""Merge per-process flight-recorder dumps into one fleet trace.
+
+The collector is pure host code over the JSONL dumps
+(:meth:`FlightRecorder.dump`) — run it after an e2e, a chaos run, or a
+production incident:
+
+- :func:`build_chrome_trace` emits a Perfetto-loadable chrome trace:
+  every span becomes a complete ``"X"`` event (pid = the producing
+  process, one lane per trace id, so a request's cross-process path
+  reads as one aligned row group), journal events become instants, and
+  process-name metadata labels the lanes.
+  ``utils/trace_analysis.TraceAnalysis`` consumes the same file for
+  busy/hotspot/critical-path rollups.
+- :func:`validate_traces` checks each trace's structural law: at least
+  one span, exactly one EFFECTIVE terminal (a failover replay may
+  legitimately produce a superseded terminal at the dead gateway — the
+  collector keeps the last and verifies the duplicates AGREE, which is
+  exactly-once evidence, not a violation), and gateway phase spans that
+  tile the terminal to within tolerance.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def load_dump(path: str) -> Optional[Dict[str, Any]]:
+    """One dump file -> {"meta": header dict, "events": [...]}; a torn
+    tail line (crash mid-write never happens — dumps are atomic — but
+    foreign files might) is skipped, an unreadable file returns None."""
+    meta: Dict[str, Any] = {}
+    events: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("k") == "meta":
+                    meta = rec
+                else:
+                    events.append(rec)
+    except OSError:
+        return None
+    if not meta:
+        meta = {"process": os.path.basename(path), "pid": 0}
+    return {"meta": meta, "events": events, "path": path}
+
+
+def load_dir(dump_dir: str) -> List[Dict[str, Any]]:
+    """Every ``flight-*.jsonl`` dump under ``dump_dir``."""
+    out = []
+    for path in sorted(glob.glob(
+            os.path.join(dump_dir, "flight-*.jsonl"))):
+        d = load_dump(path)
+        if d is not None:
+            out.append(d)
+    return out
+
+
+def _lane(trace_id: str) -> int:
+    """Stable per-trace thread lane (chrome tid) — groups one request's
+    spans into one row; 0 is the process-level lane (rounds, events)."""
+    if not trace_id:
+        return 0
+    try:
+        h = int(trace_id[:8], 16)
+    except ValueError:  # foreign/synthetic trace ids need a lane too
+        import zlib
+
+        h = zlib.crc32(trace_id.encode())
+    return (h % 100000) + 1
+
+
+def build_chrome_trace(dumps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Perfetto-loadable chrome trace dict from loaded dumps."""
+    events: List[Dict[str, Any]] = []
+    for dump in dumps:
+        meta = dump["meta"]
+        pid = int(meta.get("pid", 0))
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": str(meta.get("process", pid))},
+        })
+        for rec in dump["events"]:
+            if rec.get("k") == "span":
+                args = dict(rec.get("args") or {})
+                for key in ("tid", "sid", "psid"):
+                    if rec.get(key):
+                        args[f"trace_{key}" if key == "tid"
+                             else key] = rec[key]
+                events.append({
+                    "ph": "X", "name": rec.get("name", ""),
+                    "cat": rec.get("cat", ""),
+                    "ts": float(rec.get("ts", 0.0)),
+                    "dur": float(rec.get("dur", 0.0)),
+                    "pid": pid, "tid": _lane(rec.get("tid", "")),
+                    "args": args,
+                })
+            elif rec.get("k") == "ev":
+                events.append({
+                    "ph": "i", "s": "p",
+                    "name": rec.get("kind", "event"),
+                    "ts": float(rec.get("ts", 0.0)),
+                    "pid": pid, "tid": 0,
+                    "args": {k: v for k, v in rec.items()
+                             if k not in ("k", "ts", "seq")},
+                })
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(dump_dir: str, out_path: str) -> str:
+    """Merge every dump under ``dump_dir`` into a chrome-trace file."""
+    with open(out_path, "w") as f:
+        json.dump(build_chrome_trace(load_dir(dump_dir)), f)
+    return out_path
+
+
+# ---------------------------------------------------------------------------
+# Structural validation
+# ---------------------------------------------------------------------------
+
+
+def spans_by_trace(dumps: List[Dict[str, Any]]
+                   ) -> Dict[str, List[Dict[str, Any]]]:
+    """trace_id -> its spans across every dump, each annotated with the
+    producing process/pid under ``_proc``/``_pid``."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for dump in dumps:
+        meta = dump["meta"]
+        for rec in dump["events"]:
+            if rec.get("k") != "span" or not rec.get("tid"):
+                continue
+            rec = dict(rec)
+            rec["_proc"] = str(meta.get("process", ""))
+            rec["_pid"] = int(meta.get("pid", 0))
+            out.setdefault(rec["tid"], []).append(rec)
+    for spans in out.values():
+        spans.sort(key=lambda s: (s.get("ts", 0.0), s.get("seq", 0)))
+    return out
+
+
+def validate_trace(spans: List[Dict[str, Any]]
+                   ) -> Dict[str, Any]:
+    """Structural report for one trace's merged spans (see module
+    docstring for the law being checked)."""
+    terminals = [
+        s for s in spans
+        if (s.get("args") or {}).get("terminal")
+    ]
+    report: Dict[str, Any] = {
+        "spans": len(spans),
+        "terminal_spans": len(terminals),
+        "complete": False,
+        "duplicates_agree": True,
+        "superseded_terminals": max(0, len(terminals) - 1),
+    }
+    if not terminals:
+        return report
+    # Effective terminal = the last one recorded: an earlier terminal
+    # only exists when a kill orphaned an already-answered completion
+    # and the journal replay re-recorded it at the adopting gateway.
+    terminals.sort(
+        key=lambda s: s.get("ts", 0.0) + s.get("dur", 0.0)
+    )
+    term = terminals[-1]
+    targs = term.get("args") or {}
+    report["state"] = targs.get("state", "")
+    report["terminal_process"] = term.get("_proc", "")
+    for a, b in zip(terminals, terminals[1:]):
+        aa, ba = a.get("args") or {}, b.get("args") or {}
+        if (aa.get("state"), aa.get("tokens")) != \
+                (ba.get("state"), ba.get("tokens")):
+            report["duplicates_agree"] = False
+    # Phase tiling: the gateway's phase spans are contiguous marks on
+    # ONE clock, so within the terminal's own process they must sum to
+    # the terminal's duration (and the pre-TTFT subset to the measured
+    # TTFT) exactly — the merged-trace check allows small float slack.
+    pid = term.get("_pid", 0)
+    phases = [s for s in spans
+              if s.get("cat") == "phase" and s.get("_pid") == pid]
+    report["phase_spans"] = len(phases)
+    report["phase_sum_us"] = round(
+        sum(float(s.get("dur", 0.0)) for s in phases), 1
+    )
+    report["ttft_phase_sum_us"] = round(
+        sum(float(s.get("dur", 0.0)) for s in phases
+            if (s.get("args") or {}).get("pre_ttft")), 1
+    )
+    report["latency_us"] = round(float(term.get("dur", 0.0)), 1)
+    ttft_ms = targs.get("ttft_ms")
+    if ttft_ms is not None:
+        report["ttft_us"] = round(float(ttft_ms) * 1000.0, 1)
+    report["complete"] = bool(spans) and report["duplicates_agree"]
+    return report
+
+
+def validate_traces(dumps: List[Dict[str, Any]],
+                    tolerance: float = 0.05) -> Dict[str, Any]:
+    """Per-trace structural reports plus a fleet summary.  A trace
+    passes when it has exactly one effective terminal, agreeing
+    duplicates, and phase spans summing to the terminal's measured
+    latency (and TTFT) within ``tolerance``."""
+    traces = spans_by_trace(dumps)
+    reports: Dict[str, Any] = {}
+    ok = 0
+    for tid_key, spans in traces.items():
+        rep = validate_trace(spans)
+        rep["phase_sum_ok"] = _within(
+            rep.get("phase_sum_us"), rep.get("latency_us"), tolerance
+        )
+        rep["ttft_sum_ok"] = _within(
+            rep.get("ttft_phase_sum_us"), rep.get("ttft_us"),
+            tolerance,
+        ) if "ttft_us" in rep else True
+        rep["ok"] = bool(
+            rep["complete"] and rep["terminal_spans"] >= 1
+            and rep["phase_sum_ok"] and rep["ttft_sum_ok"]
+        )
+        ok += rep["ok"]
+        reports[tid_key] = rep
+    return {
+        "traces": reports,
+        "total": len(reports),
+        "ok": ok,
+    }
+
+
+def _within(a: Optional[float], b: Optional[float],
+            tol: float) -> bool:
+    if a is None or b is None:
+        return False
+    if b <= 0:
+        return a <= 0
+    # Absolute floor: sub-millisecond phases against a sub-millisecond
+    # terminal are all float noise — 5% of nothing proves nothing.
+    return abs(a - b) <= max(tol * b, 500.0)
+
+
+def trace_ids_for(req_ids) -> Dict[str, str]:
+    """req_id -> trace_id convenience for test assertions."""
+    from dlrover_tpu.obs.span import trace_id_for
+
+    return {rid: trace_id_for(rid) for rid in req_ids}

@@ -1,0 +1,189 @@
+"""Crash postmortem CLI: reconstruct a killed fleet's last seconds.
+
+::
+
+    python -m dlrover_tpu.obs.postmortem DUMP_DIR [--out trace.json]
+
+Reads every per-process flight-recorder dump under ``DUMP_DIR`` and
+answers the three questions an operator asks after a kill:
+
+- **who died** — each process's dump reason (clean exit / SIGTERM /
+  chaos crash, naming the injected site) and its last recorded instant;
+- **what it held** — requests a dead process had in flight (spans in
+  its ring with no terminal of its own) and its final journal events;
+- **where work went** — traces whose spans appear in more than one
+  process's dump, with the process that recorded the effective
+  terminal (the failover/replay destination).
+
+``--out`` additionally writes the merged Perfetto-loadable chrome
+trace (:func:`dlrover_tpu.obs.collect.build_chrome_trace`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Dict, List
+
+from dlrover_tpu.obs.collect import (
+    load_dir,
+    spans_by_trace,
+    validate_trace,
+    write_chrome_trace,
+)
+
+
+def _fmt_ts(us: float) -> str:
+    return f"{us / 1e6:.3f}s"
+
+
+def analyze(dump_dir: str) -> Dict[str, Any]:
+    """The postmortem as data (the CLI renders it; tests assert on it)."""
+    dumps = load_dir(dump_dir)
+    traces = spans_by_trace(dumps)
+    processes: List[Dict[str, Any]] = []
+    for dump in dumps:
+        meta = dump["meta"]
+        evs = dump["events"]
+        last_ts = max(
+            (float(e.get("ts", 0.0)) + float(e.get("dur", 0.0))
+             for e in evs), default=0.0,
+        )
+        proc = {
+            "process": str(meta.get("process", "")),
+            "pid": int(meta.get("pid", 0)),
+            "reason": str(meta.get("reason", "")),
+            "chaos_site": str(meta.get("chaos_site", "")),
+            "events": len(evs),
+            "dropped": int(meta.get("dropped", 0)),
+            "last_ts_us": last_ts,
+            "journal_tail": [
+                {k: v for k, v in e.items() if k not in ("k", "seq")}
+                for e in evs if e.get("k") == "ev"
+            ][-5:],
+        }
+        # In-flight at death: traces this process touched but never
+        # CLOSED from its own point of view.  Closure is role-shaped:
+        # a gateway closes with the terminal span, a replica with the
+        # decode-completion or a journal replay (a replica never
+        # records terminals, so "no terminal" alone would damn every
+        # request it ever finished).
+        held = []
+        closed = set()
+        touched = {}
+        for e in evs:
+            if e.get("k") != "span" or not e.get("tid"):
+                continue
+            args = e.get("args") or {}
+            rid = args.get("rid") or args.get("req_id") or ""
+            touched.setdefault(e["tid"], rid)
+            if args.get("terminal") or e.get("name") in (
+                "rep.decode", "rep.journal_replay", "rep.kv_export",
+            ):
+                closed.add(e["tid"])
+        for tid_key, rid in touched.items():
+            if tid_key not in closed:
+                held.append(rid or tid_key)
+        proc["held_in_flight"] = sorted(held)
+        processes.append(proc)
+    # Where orphaned work went: traces spanning >1 process.
+    rerouted = []
+    for tid_key, spans in traces.items():
+        procs = sorted({s.get("_proc", "") for s in spans})
+        if len(procs) < 2:
+            continue
+        rep = validate_trace(spans)
+        rid = next(
+            (str((s.get("args") or {}).get("rid") or "")
+             for s in spans if (s.get("args") or {}).get("rid")), "",
+        )
+        rerouted.append({
+            "trace_id": tid_key,
+            "req_id": rid,
+            "processes": procs,
+            "terminal_process": rep.get("terminal_process", ""),
+            "state": rep.get("state", ""),
+            "superseded_terminals": rep.get("superseded_terminals", 0),
+        })
+    rerouted.sort(key=lambda r: r["trace_id"])
+    crashed = [p for p in processes if p["reason"] == "chaos"]
+    return {
+        "dump_dir": dump_dir,
+        "processes": processes,
+        "crashed": [p["process"] for p in crashed],
+        "chaos_sites": sorted(
+            {p["chaos_site"] for p in crashed if p["chaos_site"]}
+        ),
+        "traces": len(traces),
+        "rerouted": rerouted,
+    }
+
+
+def render(report: Dict[str, Any]) -> str:
+    lines = [f"fleet postmortem: {report['dump_dir']}"]
+    lines.append(
+        f"  {len(report['processes'])} process dump(s), "
+        f"{report['traces']} trace(s)"
+    )
+    lines.append("who died:")
+    for proc in report["processes"]:
+        tag = proc["reason"]
+        if proc["chaos_site"]:
+            tag += f" [{proc['chaos_site']}]"
+        lines.append(
+            f"  {proc['process']:<16} pid={proc['pid']:<7} "
+            f"reason={tag:<28} events={proc['events']} "
+            f"dropped={proc['dropped']} "
+            f"last={_fmt_ts(proc['last_ts_us'])}"
+        )
+        if proc["reason"] == "chaos":
+            held = proc["held_in_flight"]
+            lines.append(
+                f"    held in flight at death: "
+                f"{', '.join(held) if held else '(nothing)'}"
+            )
+            for ev in proc["journal_tail"]:
+                lines.append(f"    last journal: {json.dumps(ev)}")
+    if report["rerouted"]:
+        lines.append("requests that crossed processes:")
+        for r in report["rerouted"]:
+            extra = (
+                f" ({r['superseded_terminals']} superseded terminal)"
+                if r["superseded_terminals"] else ""
+            )
+            lines.append(
+                f"  {r['req_id'] or r['trace_id']:<12} "
+                f"{' -> '.join(r['processes'])} "
+                f"finished at {r['terminal_process'] or '?'} "
+                f"state={r['state']}{extra}"
+            )
+    else:
+        lines.append("no request crossed processes")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dlrover_tpu.obs.postmortem",
+        description="reconstruct a killed fleet's last seconds from "
+                    "flight-recorder dumps",
+    )
+    ap.add_argument("dump_dir", help="directory of flight-*.jsonl dumps")
+    ap.add_argument("--out", default="",
+                    help="also write the merged chrome trace here")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of text")
+    args = ap.parse_args(argv)
+    report = analyze(args.dump_dir)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render(report))
+    if args.out:
+        write_chrome_trace(args.dump_dir, args.out)
+        print(f"merged chrome trace: {args.out}")
+    return 0 if report["processes"] else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - thin CLI shell
+    raise SystemExit(main())

@@ -1,0 +1,292 @@
+"""The per-process flight recorder (ISSUE 12).
+
+A bounded ring of structured events — request spans and control-plane
+journal entries — that SURVIVES the process's death:
+
+- normal exit: an ``atexit`` hook spills the ring as fsync'd JSONL;
+- SIGTERM: a handler (installed only when the process had no handler of
+  its own — embedders' handlers are never displaced) spills, restores
+  the default disposition, and re-raises;
+- chaos crash: :func:`dlrover_tpu.chaos.on_crash` fires the spill
+  BEFORE ``os._exit``, naming the injected site in the dump header —
+  a chaos kill simulates SIGKILL for every OTHER subsystem (no atexit,
+  no finally), but the flight recorder is exactly the black box that
+  must survive the crash, so it gets the one pre-exit callback;
+- live: any process holding the repo RPC idiom can answer
+  ``ObsScrapeRequest`` from :meth:`FlightRecorder.snapshot`.
+
+The ring is bounded (``capacity`` events) because a flight recorder's
+job is the LAST seconds, not an archive; every eviction is counted in
+``dropped`` and exported — a drop is never silent.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.obs.span import EPOCH_ANCHOR, anchored_us, new_span_id
+
+ENV_DIR = "DLROVER_TPU_OBS_DIR"
+ENV_PROCESS = "DLROVER_TPU_OBS_PROCESS"
+ENV_CAPACITY = "DLROVER_TPU_OBS_CAPACITY"
+
+
+class FlightRecorder:
+    """Bounded, thread-safe ring of span/journal events.
+
+    All public methods are cheap enough for the serving data plane's
+    per-request rate (a dict build + deque append under one lock); the
+    decision whether a request is traced at all is the gateway's
+    head-based sampling, not this class's concern."""
+
+    def __init__(self, capacity: int = 4096, process: str = "",
+                 out_dir: Optional[str] = None,
+                 clock=time.monotonic):
+        self.capacity = int(capacity)
+        self.process = process or f"pid{os.getpid()}"
+        self.out_dir = out_dir
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._seq = 0
+        self.dropped = 0
+        self.spans = 0
+        self.events = 0
+        self._dumped_reason: Optional[str] = None
+
+    # -- recording --------------------------------------------------------
+
+    def _append_locked(self, rec: Dict[str, Any]) -> None:
+        self._seq += 1
+        rec["seq"] = self._seq
+        if len(self._ring) == self.capacity:
+            self.dropped += 1
+        self._ring.append(rec)
+
+    def span(self, name: str, cat: str, start_s: float, end_s: float,
+             trace_id: str = "", span_id: Optional[str] = None,
+             parent: str = "", args: Optional[dict] = None) -> str:
+        """Record one completed span (monotonic instants in, anchored
+        microseconds stored).  Returns the span id."""
+        sid = span_id or new_span_id()
+        rec: Dict[str, Any] = {
+            "k": "span", "name": name, "cat": cat,
+            "ts": round(anchored_us(start_s), 1),
+            "dur": round(max(0.0, end_s - start_s) * 1e6, 1),
+            "tid": trace_id, "sid": sid,
+        }
+        if parent:
+            rec["psid"] = parent
+        if args:
+            rec["args"] = args
+        with self._mu:
+            self.spans += 1
+            self._append_locked(rec)
+        return sid
+
+    def event(self, kind: str, **fields: Any) -> None:
+        """Record one control-plane journal event (reshard transition,
+        checkpoint commit verdict, reconcile decision, chaos firing,
+        ...).  ``fields`` must be JSON/msgpack-safe scalars/containers."""
+        rec: Dict[str, Any] = {
+            "k": "ev", "kind": kind,
+            "ts": round(anchored_us(self._clock()), 1),
+        }
+        rec.update(fields)
+        with self._mu:
+            self.events += 1
+            self._append_locked(rec)
+
+    # -- reading ----------------------------------------------------------
+
+    def snapshot(self, since_seq: int = 0
+                 ) -> Tuple[List[Dict[str, Any]], int, int]:
+        """(events newer than ``since_seq``, lifetime drop count, next
+        cursor) — the live-scrape read."""
+        with self._mu:
+            evs = [dict(r) for r in self._ring
+                   if r["seq"] > since_seq]
+            return evs, self.dropped, self._seq
+
+    def stats(self) -> Dict[str, int]:
+        with self._mu:
+            return {"spans": self.spans, "events": self.events,
+                    "dropped": self.dropped, "ring": len(self._ring)}
+
+    # -- spilling ---------------------------------------------------------
+
+    def dump_path(self) -> Optional[str]:
+        if not self.out_dir:
+            return None
+        return os.path.join(
+            self.out_dir,
+            f"flight-{self.process}-{os.getpid()}.jsonl",
+        )
+
+    def dump(self, path: Optional[str] = None, reason: str = "exit",
+             chaos_site: str = "") -> Optional[str]:
+        """Spill the ring as fsync'd JSONL (atomic tmp+rename): a meta
+        header line, then every retained event.  Safe to call multiple
+        times (each dump rewrites with the current ring — the LAST one
+        wins, which is the crash semantics a flight recorder wants).
+        Returns the path, or None when no target is configured."""
+        path = path or self.dump_path()
+        if path is None:
+            return None
+        with self._mu:
+            evs = list(self._ring)
+            meta = {
+                "k": "meta", "process": self.process,
+                "pid": os.getpid(), "anchor": EPOCH_ANCHOR,
+                "reason": reason, "chaos_site": chaos_site,
+                "dumped_at": round(anchored_us(self._clock()), 1),
+                "dropped": self.dropped, "events": len(evs),
+            }
+            self._dumped_reason = reason
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(tmp, "w") as f:
+                f.write(json.dumps(meta) + "\n")
+                for rec in evs:
+                    f.write(json.dumps(rec) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning("flight recorder dump to %s failed: %s",
+                           path, e)
+            return None
+        return path
+
+
+# ---------------------------------------------------------------------------
+# The process-global recorder
+# ---------------------------------------------------------------------------
+
+_mu = threading.Lock()
+_RECORDER: Optional[FlightRecorder] = None
+_hooks_installed = False
+
+
+def _install_hooks(rec: FlightRecorder) -> None:
+    """Exit/crash spill hooks, once per process.  Only when a dump
+    directory exists — a ring-only recorder has nothing to spill."""
+    global _hooks_installed
+    if _hooks_installed or not rec.out_dir:
+        return
+    _hooks_installed = True
+
+    def _atexit_dump() -> None:
+        r = _RECORDER
+        if r is not None and r._dumped_reason is None:
+            r.dump(reason="exit")
+
+    atexit.register(_atexit_dump)
+
+    from dlrover_tpu import chaos
+
+    def _chaos_dump(site: str, ctx: dict) -> None:
+        r = _RECORDER
+        if r is not None:
+            r.event("chaos.crash", site=site,
+                    ctx={k: v for k, v in ctx.items()
+                         if isinstance(v, (str, int, float, bool))})
+            r.dump(reason="chaos", chaos_site=site)
+
+    chaos.on_crash(_chaos_dump)
+
+    # SIGTERM: spill, then die with the default disposition.  Installed
+    # ONLY when the process has no handler (embedders that set their
+    # own — the fleet example's clean-stop path — reach the atexit
+    # spill instead; displacing their handler would break their
+    # shutdown).  Never from a non-main thread (signal.signal raises).
+    try:
+        if (threading.current_thread() is threading.main_thread()
+                and signal.getsignal(signal.SIGTERM)
+                == signal.SIG_DFL):
+            def _term(signum, frame):
+                r = _RECORDER
+                if r is not None:
+                    r.dump(reason="sigterm")
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, _term)
+    except (ValueError, OSError) as e:
+        logger.debug("obs: SIGTERM hook not installed: %s", e)
+
+
+def get_recorder() -> FlightRecorder:
+    """The process recorder, created on first use from the environment
+    (``DLROVER_TPU_OBS_DIR`` / ``_PROCESS`` / ``_CAPACITY``)."""
+    global _RECORDER
+    rec = _RECORDER
+    if rec is not None:
+        return rec
+    with _mu:
+        if _RECORDER is None:
+            out_dir = os.environ.get(ENV_DIR) or None
+            try:
+                cap = int(os.environ.get(ENV_CAPACITY, "") or 4096)
+            except ValueError:
+                cap = 4096
+            _RECORDER = FlightRecorder(
+                capacity=cap,
+                process=os.environ.get(ENV_PROCESS, ""),
+                out_dir=out_dir,
+            )
+            _install_hooks(_RECORDER)
+        return _RECORDER
+
+
+def configure(out_dir: Optional[str] = None, process: str = "",
+              capacity: int = 4096) -> FlightRecorder:
+    """Install a fresh process recorder explicitly (tests, embedders).
+    Replaces any existing one; the exit hooks always act on the
+    CURRENT recorder, so replacement never dangles a hook."""
+    global _RECORDER
+    with _mu:
+        _RECORDER = FlightRecorder(
+            capacity=capacity, process=process, out_dir=out_dir,
+        )
+        _install_hooks(_RECORDER)
+        return _RECORDER
+
+
+def reset() -> None:
+    """Drop the process recorder (tests).  The next use re-reads env."""
+    global _RECORDER
+    with _mu:
+        _RECORDER = None
+
+
+def set_process(name: str) -> None:
+    """Name this process in dumps/merged traces (``gw-g0``, ``rep-r1``)
+    — later configuration wins, env stays the default."""
+    if name:
+        get_recorder().process = name
+
+
+def journal(kind: str, **fields: Any) -> None:
+    """Record one control-plane event on the process recorder — the
+    one-liner the fleet/reshard/checkpoint/autoscale layers call."""
+    get_recorder().event(kind, **fields)
+
+
+def record_span(name: str, cat: str, start_s: float, end_s: float,
+                trace_id: str = "", span_id: Optional[str] = None,
+                parent: str = "", args: Optional[dict] = None) -> str:
+    """Record one span on the process recorder (hot-path one-liner)."""
+    return get_recorder().span(
+        name, cat, start_s, end_s, trace_id=trace_id,
+        span_id=span_id, parent=parent, args=args,
+    )

@@ -1,0 +1,55 @@
+"""Trace identity and clock anchoring for the fleet flight recorder.
+
+Deliberately tiny and jax-free: these helpers run on the serving hot
+path (one call per traced request per phase), in every process of the
+fleet (gateways, replicas, drafts, masters, clients).
+
+Two design decisions carry the whole cross-process story:
+
+- **trace_id is a pure function of the request id.**  A failover
+  resubmit, a journal replay, or a re-dispatched grant lands in the
+  SAME trace without any process shipping state to any other; sampling
+  (head-based at the gateway) is equally deterministic, so every
+  gateway of a sharded tier makes the identical keep/drop decision for
+  a given request.
+- **durations are monotonic, timelines are anchored.**  Spans measure
+  with ``time.monotonic`` (wall-clock steps under NTP must never bend
+  a duration — the OB301 rule enforces this repo-wide); each process
+  pins ``wall - monotonic`` ONCE at import (:data:`EPOCH_ANCHOR`) and
+  dump/merge converts monotonic instants to an absolute microsecond
+  timeline, so per-process traces line up to clock-sync precision when
+  merged.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+
+#: Per-process epoch anchor, pinned once at import: wall-clock seconds
+#: at this process's monotonic zero.  Dumps carry it so the collector
+#: can reason about residual skew between processes.
+# graftcheck: disable=OB301 -- the anchor IS the one sanctioned
+# wall-minus-monotonic: it converts monotonic instants to an absolute
+# timeline at dump time; it is never used as a duration
+EPOCH_ANCHOR: float = time.time() - time.monotonic()
+
+
+def anchored_us(mono_s: float) -> float:
+    """A monotonic instant as absolute microseconds on this process's
+    anchored timeline (the chrome-trace ``ts`` unit)."""
+    return (EPOCH_ANCHOR + mono_s) * 1e6
+
+
+def trace_id_for(req_id: str) -> str:
+    """The trace id of a request — derived, never allocated, so every
+    process (and every incarnation across failovers) agrees on it."""
+    return hashlib.sha1(req_id.encode()).hexdigest()[:16]
+
+
+def new_span_id() -> str:
+    """A fresh span id.  Random, not derived: the same request may be
+    admitted twice (failover resubmit) and each admission's spans must
+    stay distinct within the shared trace."""
+    return os.urandom(8).hex()

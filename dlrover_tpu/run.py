@@ -255,6 +255,8 @@ def _gc_shm_arenas(
     now = _time.time()
     for path in glob.glob(f"/dev/shm/dlrtpu_{scope}_*"):
         try:
+            # graftcheck: disable=OB301 -- compared against the file's
+            # wall-clock mtime; wall time is the point here
             if not run_id and now - os.stat(path).st_mtime < min_age_s:
                 continue
             os.unlink(path)

@@ -26,6 +26,7 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.obs import journal
 
 
 @dataclasses.dataclass
@@ -204,6 +205,11 @@ class PoolAutoScaler:
                 deltas[role] = 0
                 continue
             self.decisions.append((time.time(), role, alive, target))
+            journal("autoscale.decide", scope="pool", role=role,
+                    alive=alive, target=target,
+                    queue_depth=int(
+                        pools.get(role, {}).get("queue_depth", 0)
+                    ))
             if target > alive:
                 logger.info(
                     "serve-autoscaler: scaling %s pool up %d -> %d",
@@ -216,6 +222,8 @@ class PoolAutoScaler:
                     "(%d -> %d)", role, alive, target,
                 )
                 self._drain_fn(role)
+            journal("autoscale.actuate", scope="pool", role=role,
+                    delta=target - alive)
             deltas[role] = target - alive
         return deltas
 
@@ -278,6 +286,10 @@ class ServeAutoScaler:
         if target == alive:
             return 0
         self.decisions.append((time.time(), alive, target))
+        journal("autoscale.decide", scope="fleet", alive=alive,
+                target=target,
+                queue_depth=int(snap.get("queue_depth", 0)),
+                ttft_p95_ms=float(snap.get("ttft_p95_ms", 0.0)))
         if target > alive:
             logger.info(
                 "serve-autoscaler: scaling up %d -> %d "
@@ -291,6 +303,8 @@ class ServeAutoScaler:
                 alive, target,
             )
             self._drain_fn()
+        journal("autoscale.actuate", scope="fleet",
+                delta=target - alive)
         return target - alive
 
     def start(self) -> None:

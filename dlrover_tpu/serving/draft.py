@@ -504,13 +504,43 @@ def handle_draft(worker: DraftWorker,
             success=False,
             reason=f"unknown message {type(msg).__name__}",
         )
+    from dlrover_tpu import chaos
+    from dlrover_tpu.obs import get_recorder, record_span
+
+    # Draft rolls are the highest-frequency loop in spec serving and
+    # carry no per-request trace context, so their round spans are
+    # emitted only when the fleet is actually being OBSERVED (a dump
+    # directory is configured, or a chaos plan is under study) — an
+    # unobserved fleet must not churn its bounded ring with
+    # untraceable round spans and evict the control-plane journal the
+    # recorder exists to preserve.
+    observed = (
+        get_recorder().out_dir is not None
+        or chaos.active_plan() is not None
+    )
+    t0 = time.monotonic()
     try:
         props = worker.propose(
             msg.streams, msg.k, sample=msg.sample, close=msg.close
         )
     except Exception as e:  # noqa: BLE001 - a failed roll degrades
         logger.warning("draft %s: roll failed: %s", worker.worker_id, e)
+        if observed:
+            record_span(
+                "draft.roll", "round", t0, time.monotonic(),
+                args={"worker": worker.worker_id, "k": int(msg.k),
+                      "streams": len(msg.streams), "failed": True},
+            )
         return DraftProposals(found=False, reason=str(e)[:200])
+    # One speculative draft round as a span (ISSUE 12) — the draft
+    # side of the spec draft/verify pair (the target side shows as
+    # ``rep.spec_round`` on its replica's lane).
+    if observed:
+        record_span(
+            "draft.roll", "round", t0, time.monotonic(),
+            args={"worker": worker.worker_id, "k": int(msg.k),
+                  "streams": len(msg.streams)},
+        )
     return DraftProposals(found=True, payload=pack_proposals(props))
 
 

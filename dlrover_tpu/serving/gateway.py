@@ -64,11 +64,14 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from dlrover_tpu import chaos
 from dlrover_tpu.agent.metrics import CounterSet
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.messages import (
     BaseResponse,
     Message,
+    ObsScrape,
+    ObsScrapeRequest,
     ServeAck,
     ServeDone,
     ServeDrainRequest,
@@ -86,6 +89,7 @@ from dlrover_tpu.common.messages import (
     ServeTokens,
 )
 from dlrover_tpu.common.token_cache import BoundedTokenCache
+from dlrover_tpu.obs import new_span_id, record_span, trace_id_for
 
 
 class GatewayConfig:
@@ -103,6 +107,7 @@ class GatewayConfig:
         kv_p2p: bool = True,
         spec_decode_min_tokens: int = 0,
         spec_reserve_s: float = 2.0,
+        trace_sample: float = 1.0,
     ):
         self.queue_cap = queue_cap
         self.lease_timeout_s = lease_timeout_s
@@ -138,6 +143,16 @@ class GatewayConfig:
         #: bypassed immediately, so speculation never starves the
         #: queue).
         self.spec_reserve_s = float(spec_reserve_s)
+        #: Head-based trace sampling (ISSUE 12): the fraction of
+        #: admitted requests that get a distributed trace, decided HERE
+        #: (the head) and deterministically from the request id — every
+        #: gateway of a sharded tier makes the identical decision, so a
+        #: failover resubmit keeps its sampled/unsampled fate.  1.0 in
+        #: tests/benches; chaos runs are ALWAYS fully sampled (an
+        #: active fault plan means someone is studying failure paths —
+        #: an unsampled kill would be unexplainable).  Every unsampled
+        #: request is counted (``trace_unsampled``), never silent.
+        self.trace_sample = float(trace_sample)
 
 
 class _Request:
@@ -146,6 +161,7 @@ class _Request:
         "attempts", "assigned_to", "grant_seq", "first_token_at",
         "partial", "prefix_len", "prefix_fp", "stage", "kv",
         "kv_addr", "kv_fp", "kv_crc32", "kv_nbytes", "kv_relay",
+        "trace_tid", "trace_root", "phase_mark",
     )
 
     def __init__(self, req_id: str, prompt: List[int],
@@ -178,6 +194,14 @@ class _Request:
         self.kv_crc32 = 0
         self.kv_nbytes = 0
         self.kv_relay = False
+        # Tracing (ISSUE 12): trace id + root span id of a SAMPLED
+        # request, and the rolling phase mark — each gateway phase
+        # span covers [phase_mark, now] and advances the mark, so the
+        # phases tile [submitted_at, terminal] EXACTLY on one clock
+        # (the per-request TTFT/latency decomposition law).
+        self.trace_tid = ""
+        self.trace_root = ""
+        self.phase_mark = now
 
     def clear_kv(self) -> None:
         self.kv = b""
@@ -272,6 +296,10 @@ class GatewayCore:
             # / given up to a plain one after the reserve window).
             "spec_rounds", "spec_accepted", "spec_fallbacks",
             "spec_grants", "spec_bypass",
+            # Tracing (ISSUE 12): head-based sampling outcomes — every
+            # request is one or the other; a drop is counted, never
+            # silent.
+            "trace_sampled", "trace_unsampled",
         ):
             self._counters.inc(name, 0)
         self._last_sweep = float("-inf")
@@ -292,7 +320,8 @@ class GatewayCore:
 
     def submit(self, req_id: str, prompt: List[int],
                max_new_tokens: int, deadline_s: float = 0.0,
-               prefix_len: int = 0, prefix_fp: str = "") -> ServeAck:
+               prefix_len: int = 0, prefix_fp: str = "",
+               trace: Optional[dict] = None) -> ServeAck:
         now = self._clock()
         if not req_id:
             # BoundedTokenCache treats "" as no-token: the completion
@@ -338,6 +367,7 @@ class GatewayCore:
                 now + deadline_s if deadline_s > 0 else None, now,
                 prefix_len=prefix_len, prefix_fp=prefix_fp,
             )
+            self._trace_admit_locked(req, trace)
             self._queue.append(req)
             self._by_id[req_id] = req
             self._counters.inc("accepted")
@@ -507,6 +537,26 @@ class GatewayCore:
                     req.grant_seq = rep.poll_seq
                     req.stage = stage
                     rep.assigned[req.req_id] = req
+                    if req.trace_tid:
+                        # The wait this grant ends: fresh admission ->
+                        # queue_wait; a held KV segment -> kv_wait
+                        # (decode-pool capacity wait).  Plus the scan
+                        # pass that found it, as a detail span.
+                        self._phase_locked(
+                            req,
+                            "gw.kv_wait" if stage == "decode"
+                            and req.has_kv else "gw.queue_wait",
+                            now,
+                        )
+                        record_span(
+                            "gw.grant_scan", "gateway", now,
+                            self._clock(),
+                            trace_id=req.trace_tid,
+                            parent=req.trace_root,
+                            args={"rid": req.req_id,
+                                  "replica": replica_id,
+                                  "stage": stage},
+                        )
                     if stage == "decode" and req.kv_addr:
                         # Ticketed bytes GRANTED for a peer pull: a
                         # re-shipped ticket (decode-replica death)
@@ -540,6 +590,11 @@ class GatewayCore:
                             stage == "prefill"
                             and (req.kv_relay or not self.cfg.kv_p2p)
                         ),
+                        trace=(
+                            {"tid": req.trace_tid,
+                             "sid": req.trace_root}
+                            if req.trace_tid else {}
+                        ),
                     ))
             drain = rep.draining and not rep.assigned
             return ServeGrants(
@@ -555,6 +610,10 @@ class GatewayCore:
             if req is None or req.assigned_to != replica_id:
                 return  # stale stream from a superseded assignment
             if req.first_token_at is None and tokens:
+                # Phase closes BEFORE first_token_at is set, so the
+                # exec span still carries pre_ttft — the TTFT subset
+                # ends exactly here.
+                self._phase_locked(req, "gw.exec_to_first_token", now)
                 req.first_token_at = now
                 if self.observe_ttft_ms is not None:
                     self.observe_ttft_ms(
@@ -566,7 +625,8 @@ class GatewayCore:
     def complete(self, replica_id: str, req_id: str, tokens: List[int],
                  ok: bool = True, reason: str = "",
                  replayed: bool = False, tokens_per_round: float = 0.0,
-                 spec_rounds: int = 0) -> str:
+                 spec_rounds: int = 0,
+                 trace: Optional[dict] = None) -> str:
         """Terminal report.  Returns ``recorded`` | ``duplicate`` |
         ``unknown`` (the replica does not branch on it; tests do)."""
         with self._mu:
@@ -592,6 +652,20 @@ class GatewayCore:
                 # admitted (fresh gateway, old journal): nothing to
                 # complete.
                 return "unknown"
+            if not req.trace_tid and (trace or {}).get("tid"):
+                # A journal replay carrying the ORIGINAL trace for a
+                # request this gateway admitted untraced (sampling
+                # knobs differ across restarts): adopt it — the replay
+                # must join the original trace, not orphan a new one.
+                req.trace_tid = str(trace["tid"])
+                req.trace_root = new_span_id()
+            if replayed and req.trace_tid:
+                now = self._clock()
+                record_span(
+                    "gw.replay_completion", "gateway", now, now,
+                    trace_id=req.trace_tid, parent=req.trace_root,
+                    args={"rid": req_id, "replica": replica_id},
+                )
             state = "done" if ok else "failed"
             self._finish_locked(
                 req, state, tokens, replica_id, reason=reason,
@@ -611,7 +685,8 @@ class GatewayCore:
     def kv_ready(self, replica_id: str, req_id: str, payload: bytes,
                  fp32_bytes: int = 0, addr: str = "",
                  seg_fp: str = "", crc32: int = 0,
-                 nbytes: int = 0) -> str:
+                 nbytes: int = 0,
+                 trace: Optional[dict] = None) -> str:
         """Stage two of the disaggregated path: the prefill replica's
         KV segment arrives — as relayed ``payload`` bytes (PR 8), or
         as a P2P TICKET (ISSUE 9: non-empty ``addr``; the bytes stay
@@ -636,6 +711,14 @@ class GatewayCore:
             rep = self._replicas.get(replica_id)
             if rep is not None:
                 rep.assigned.pop(req_id, None)
+            if not req.trace_tid and (trace or {}).get("tid"):
+                # Handoff arriving at a gateway that admitted this
+                # request untraced (failover adoption): join the
+                # original trace, the ServeDone.trace contract.
+                req.trace_tid = str(trace["tid"])
+                req.trace_root = new_span_id()
+            # The prefill stage ends here: segment (or ticket) in hand.
+            self._phase_locked(req, "gw.prefill_exec", self._clock())
             req.assigned_to = None
             req.clear_kv()
             if addr:
@@ -820,6 +903,46 @@ class GatewayCore:
 
     # -- internals (call with self._mu held) ------------------------------
 
+    def _trace_admit_locked(self, req: _Request,
+                            trace: Optional[dict]) -> None:
+        """Head-based sampling at admission (ISSUE 12).  A client-sent
+        trace context forces sampling; otherwise the decision is a pure
+        function of (req_id, trace_sample) — deterministic across every
+        gateway of the tier — and chaos runs are always fully sampled
+        (a fault plan means failure paths are under study)."""
+        tid = (trace or {}).get("tid", "")
+        if not tid:
+            sample = self.cfg.trace_sample
+            if sample < 1.0 and chaos.active_plan() is None:
+                if sample <= 0.0 or (
+                    int(trace_id_for(req.req_id)[:8], 16) % 10000
+                    >= int(sample * 10000)
+                ):
+                    self._counters.inc("trace_unsampled")
+                    return
+            tid = trace_id_for(req.req_id)
+        self._counters.inc("trace_sampled")
+        req.trace_tid = tid
+        req.trace_root = new_span_id()
+
+    def _phase_locked(self, req: _Request, name: str,
+                      now: float) -> None:
+        """Emit one phase span [phase_mark, now] and advance the mark.
+        Phases are contiguous on the gateway's single clock, so per
+        request they SUM EXACTLY to the measured latency (and the
+        pre-first-token subset to the measured TTFT) — the decomposed
+        view can never drift from the histogram's truth."""
+        if not req.trace_tid or now < req.phase_mark:
+            return
+        args: Dict[str, Any] = {"rid": req.req_id}
+        if req.first_token_at is None:
+            args["pre_ttft"] = True
+        record_span(
+            name, "phase", req.phase_mark, now,
+            trace_id=req.trace_tid, parent=req.trace_root, args=args,
+        )
+        req.phase_mark = now
+
     def _stage_for_locked(self, rep: _Replica,
                           req: _Request) -> Optional[str]:
         """Which grant stage this replica could run this request at —
@@ -941,6 +1064,38 @@ class GatewayCore:
             rec.update(extra)
         self._done.put(req.req_id, rec)
         now = self._clock()
+        if req.trace_tid:
+            # Final phase: streamed decode after the first token, raw
+            # exec when none arrived (lost/failed), pure queue wait
+            # when never granted — then THE terminal span (the span
+            # tree's root; exactly one per completion this gateway
+            # records).
+            if req.first_token_at is not None:
+                final = "gw.decode_stream"
+            elif req.grant_seq >= 0:
+                final = "gw.exec"
+            else:
+                final = "gw.queue_wait"
+            self._phase_locked(req, final, now)
+            targs: Dict[str, Any] = {
+                "rid": req.req_id, "terminal": True, "state": state,
+                "tokens": len(tokens), "replica": replica_id,
+                "latency_ms": round(
+                    (now - req.submitted_at) * 1000.0, 3
+                ),
+                "attempts": req.attempts,
+            }
+            if req.first_token_at is not None:
+                targs["ttft_ms"] = round(
+                    (req.first_token_at - req.submitted_at) * 1000.0, 3
+                )
+            if reason:
+                targs["reason"] = reason[:200]
+            record_span(
+                "gw.request", "gateway", req.submitted_at, now,
+                trace_id=req.trace_tid, span_id=req.trace_root,
+                args=targs,
+            )
         if state == "done":
             self._counters.inc("completed")
             if self.observe_latency_ms is not None:
@@ -957,6 +1112,10 @@ class GatewayCore:
         or fail it terminally once it has burned ``max_attempts``
         re-dispatches (a poison request must not serially kill the
         fleet while head-of-line-blocking everything behind it)."""
+        # The phase the grant was burning ends HERE, visibly: a lost
+        # assignment is a named slice of the request's latency, not a
+        # silent gap (the tiling law holds across re-dispatches).
+        self._phase_locked(req, "gw.exec_lost", self._clock())
         req.assigned_to = None
         req.attempts += 1
         req.partial = []
@@ -1104,7 +1263,8 @@ class Gateway:
                      "kv_handoffs", "kv_rejects", "kv_bytes",
                      "kv_p2p_bytes", "kv_relay_fallbacks",
                      "spec_rounds", "spec_accepted", "spec_fallbacks",
-                     "spec_grants", "spec_bypass"):
+                     "spec_grants", "spec_bypass",
+                     "trace_sampled", "trace_unsampled"):
             registry.gauge(f"serve_{name}", _counter_gauge(name))
 
         def _pool_gauge(role, key):
@@ -1125,7 +1285,8 @@ class Gateway:
         if isinstance(msg, ServeSubmit):
             return core.submit(msg.req_id, msg.prompt,
                                msg.max_new_tokens, msg.deadline_s,
-                               msg.prefix_len, msg.prefix_fp)
+                               msg.prefix_len, msg.prefix_fp,
+                               msg.trace)
         if isinstance(msg, ServeStatusRequest):
             return core.status(msg.req_id)
         if isinstance(msg, ServeReplicaRegister):
@@ -1142,7 +1303,7 @@ class Gateway:
             outcome = core.kv_ready(msg.replica_id, msg.req_id,
                                     msg.payload, msg.fp32_bytes,
                                     msg.addr, msg.seg_fp, msg.crc32,
-                                    msg.nbytes)
+                                    msg.nbytes, msg.trace)
             return BaseResponse(success=True, reason=outcome)
         if isinstance(msg, ServeKvReject):
             outcome = core.kv_reject(msg.replica_id, msg.req_id,
@@ -1155,9 +1316,18 @@ class Gateway:
             outcome = core.complete(
                 msg.replica_id, msg.req_id, msg.tokens, msg.ok,
                 msg.reason, msg.replayed, msg.tokens_per_round,
-                msg.spec_rounds,
+                msg.spec_rounds, msg.trace,
             )
             return BaseResponse(success=True, reason=outcome)
+        if isinstance(msg, ObsScrapeRequest):
+            # Live flight-recorder scrape (ISSUE 12): the ring over
+            # the same RPC route everything else rides.
+            from dlrover_tpu.obs import get_recorder
+
+            rec = get_recorder()
+            events, dropped, next_seq = rec.snapshot(msg.since_seq)
+            return ObsScrape(process=rec.process, events=events,
+                             dropped=dropped, next_seq=next_seq)
         if isinstance(msg, ServeDrainRequest):
             ok = core.drain(msg.replica_id)
             return BaseResponse(success=ok)

@@ -47,6 +47,7 @@ from dlrover_tpu.common.messages import (
     ServeReplicaRegister,
     ServeTokens,
 )
+from dlrover_tpu.obs import record_span
 
 
 def _prompt_hash(prompt) -> str:
@@ -236,6 +237,14 @@ class ReplicaRunner:
         self._stopped = False
         self._journal_replayed = False
         self._granted: Dict[str, Dict[str, Any]] = {}  # rid -> grant
+        #: rid -> grant trace context (ISSUE 12): the gateway's trace
+        #: id + parent span id for this replica's detail spans.
+        self._traces: Dict[str, Dict[str, str]] = {}
+        #: Previous tick's instant: traced in-flight work turns the
+        #: gap between consecutive admission-point visits into one
+        #: decode-round span (spec rounds labelled from the server's
+        #: reported path).
+        self._round_mark: Optional[float] = None
         self._stream_buf: Dict[str, List[int]] = {}
         self._first_token_at: Dict[str, float] = {}
         self._admitted_at: Dict[str, float] = {}
@@ -294,6 +303,7 @@ class ReplicaRunner:
                     # reports the acceptance the request earned live.
                     tokens_per_round=float(rec.get("tpr", 0.0)),
                     spec_rounds=int(rec.get("spr", 0)),
+                    trace=self._replay_trace(req_id, rec),
                 ))
 
     def run(self) -> None:
@@ -352,6 +362,24 @@ class ReplicaRunner:
         if self.round_floor_s > 0:
             time.sleep(self.round_floor_s)
         now = self._clock()
+        # Decode-round spans (ISSUE 12): each gap between admission-
+        # point visits is one round of the incremental serve loop.
+        # Emitted only while TRACED work is in flight (zero cost on an
+        # untraced fleet), on the process lane (a round serves the
+        # whole ragged batch, not one request); spec rounds are
+        # labelled from the server's reported path.
+        if self._traces and self._round_mark is not None:
+            active = len(self.server.active_rids())
+            if active and now > self._round_mark:
+                last = getattr(self.server, "last_stats", None) or {}
+                record_span(
+                    "rep.spec_round" if last.get("path") == "spec"
+                    else "rep.decode_round",
+                    "round", self._round_mark, now,
+                    args={"active": active,
+                          "replica": self.replica_id},
+                )
+        self._round_mark = now
         if now - self._last_poll < self.poll_interval:
             return not self._stopped and not self._done_draining()
         self._last_poll = now
@@ -451,6 +479,24 @@ class ReplicaRunner:
 
     # -- internals --------------------------------------------------------
 
+    def _replay_trace(self, req_id: str, rec: Dict[str, Any]) -> dict:
+        """Trace context of a journal replay (ISSUE 12): the id the
+        request earned when served live (``tr`` in the record), plus a
+        replay span so the resurrection is VISIBLE in the merged trace,
+        not a duplicate trace.  A record WITHOUT ``tr`` was served
+        unsampled (or pre-trace): the replay must stay unsampled too —
+        fabricating a derived id here would punch through head-based
+        sampling and break the sampled/unsampled accounting."""
+        tid = str(rec.get("tr") or "")
+        if not tid:
+            return {}
+        now = self._clock()
+        record_span(
+            "rep.journal_replay", "replica", now, now, trace_id=tid,
+            args={"rid": req_id, "replica": self.replica_id},
+        )
+        return {"tid": tid}
+
     def _done_draining(self) -> bool:
         return self._draining and not self._owned_rids()
 
@@ -466,6 +512,7 @@ class ReplicaRunner:
             return
         if rid_key in self._granted or rid_key in self._owned_rids():
             return  # duplicate grant (shouldn't happen; be safe)
+        gtrace = dict(getattr(grant, "trace", None) or {})
         if self.journal is not None:
             cached = self.journal.lookup_record(rid_key, grant.prompt)
             if cached is not None:
@@ -480,8 +527,11 @@ class ReplicaRunner:
                     ok=True, replayed=True,
                     tokens_per_round=float(cached.get("tpr", 0.0)),
                     spec_rounds=int(cached.get("spr", 0)),
+                    trace=self._replay_trace(rid_key, cached),
                 ))
                 return
+        tid = str(gtrace.get("tid", ""))
+        psid = str(gtrace.get("sid", ""))
         if chaos.inject(
             "serving.drop_request", replica=self.replica_id,
         ) is not None:
@@ -510,6 +560,7 @@ class ReplicaRunner:
                         pull_kv_segment,
                     )
 
+                    t_pull = self._clock()
                     try:
                         if chaos.inject(
                             "serving.kv_drop",
@@ -526,8 +577,24 @@ class ReplicaRunner:
                             ),
                         )
                         self.kv_pulled += 1
+                        if tid:
+                            record_span(
+                                "rep.kv_pull", "replica", t_pull,
+                                self._clock(), trace_id=tid,
+                                parent=psid,
+                                args={"rid": rid_key,
+                                      "bytes": len(payload)},
+                            )
                     except KvPullError as e:
                         self.kv_pull_failed += 1
+                        if tid:
+                            record_span(
+                                "rep.kv_pull", "replica", t_pull,
+                                self._clock(), trace_id=tid,
+                                parent=psid,
+                                args={"rid": rid_key, "failed": True,
+                                      "reason": str(e)[:120]},
+                            )
                         logger.warning(
                             "replica %s: KV pull for %s failed: %s",
                             self.replica_id, rid_key, e,
@@ -546,11 +613,18 @@ class ReplicaRunner:
                     if torn:
                         torn[len(torn) // 2] ^= 0xFF
                     payload = bytes(torn)
+                t_imp = self._clock()
                 self.server.import_kv(
                     rid_key, payload,
                     np.asarray(grant.prompt, np.int32),
                     grant.max_new_tokens,
                 )
+                if tid:
+                    record_span(
+                        "rep.kv_import", "replica", t_imp,
+                        self._clock(), trace_id=tid, parent=psid,
+                        args={"rid": rid_key, "bytes": len(payload)},
+                    )
             else:
                 kw = {}
                 if getattr(grant, "prefix_len", 0):
@@ -587,6 +661,8 @@ class ReplicaRunner:
         self._granted[rid_key] = {
             "prompt": [int(t) for t in grant.prompt],
         }
+        if tid:
+            self._traces[rid_key] = {"tid": tid, "sid": psid}
         self._admitted_at[rid_key] = self._clock()
 
     def _handle_prefill(self, grant) -> None:
@@ -598,6 +674,10 @@ class ReplicaRunner:
         send) leaves the rid unowned so the 2-poll reconcile
         re-dispatches the prefill."""
         rid_key = grant.req_id
+        gtrace = dict(getattr(grant, "trace", None) or {})
+        tid = str(gtrace.get("tid", ""))
+        psid = str(gtrace.get("sid", ""))
+        t0 = self._clock()
         try:
             self.server.prefill_request(
                 rid_key, np.asarray(grant.prompt, np.int32),
@@ -605,7 +685,21 @@ class ReplicaRunner:
                 prefix_len=getattr(grant, "prefix_len", 0),
                 prefix_fp=getattr(grant, "prefix_fp", ""),
             )
+            t1 = self._clock()
+            if tid:
+                record_span(
+                    "rep.prefill_score", "replica", t0, t1,
+                    trace_id=tid, parent=psid,
+                    args={"rid": rid_key,
+                          "prompt_len": len(grant.prompt)},
+                )
             payload, fp32_bytes = self.server.export_kv(rid_key)
+            if tid:
+                record_span(
+                    "rep.kv_export", "replica", t1, self._clock(),
+                    trace_id=tid, parent=psid,
+                    args={"rid": rid_key, "bytes": len(payload)},
+                )
         except ValueError as e:
             self._call_quiet(ServeDone(
                 replica_id=self.replica_id, req_id=rid_key,
@@ -651,18 +745,23 @@ class ReplicaRunner:
                     self.replica_id, rid_key, len(payload),
                 )
                 relay = True
+        # The kv-ready report carries the grant's trace context back
+        # (ISSUE 12): a gateway that adopted this request after a
+        # failover (and admitted it untraced) joins the original trace
+        # at the handoff, the same contract as ServeDone.trace.
+        ktrace = {"tid": tid, "sid": psid} if tid else {}
         if not relay:
             seg_fp, crc, nb = ticket
             self.kv_published += 1
             self._call_quiet(ServeKvReady(
                 replica_id=self.replica_id, req_id=rid_key,
                 fp32_bytes=int(fp32_bytes), addr=server.addr,
-                seg_fp=seg_fp, crc32=crc, nbytes=nb,
+                seg_fp=seg_fp, crc32=crc, nbytes=nb, trace=ktrace,
             ))
             return
         self._call_quiet(ServeKvReady(
             replica_id=self.replica_id, req_id=rid_key,
-            payload=payload, fp32_bytes=int(fp32_bytes),
+            payload=payload, fp32_bytes=int(fp32_bytes), trace=ktrace,
         ))
 
     def _kv_transport(self, addr: str):
@@ -718,6 +817,17 @@ class ReplicaRunner:
             admitted = self._admitted_at.get(rid_key)
             if admitted is not None:
                 self._last_ttft_ms = (now - admitted) * 1000.0
+                trace = self._traces.get(rid_key)
+                if trace is not None:
+                    # Admission -> first token: the replica's own view
+                    # of the prefill cost inside the gateway's exec
+                    # phase (the RPC/poll transit is their difference).
+                    record_span(
+                        "rep.prefill", "replica", admitted, now,
+                        trace_id=trace["tid"], parent=trace["sid"],
+                        args={"rid": rid_key,
+                              "replica": self.replica_id},
+                    )
 
     def _on_finish(self, rid_key, tokens) -> None:
         grant = self._granted.get(rid_key)
@@ -732,10 +842,32 @@ class ReplicaRunner:
         st = pop(rid_key) if pop is not None else None
         tpr = round(float(st["tokens_per_round"]), 3) if st else 0.0
         spr = int(st["spec_rounds"]) if st else 0
+        trace = self._traces.get(rid_key)
+        if trace is not None:
+            now = self._clock()
+            start = self._first_token_at.get(
+                rid_key, self._admitted_at.get(rid_key, now)
+            )
+            args = {"rid": rid_key, "replica": self.replica_id,
+                    "new_tokens": len(new_tokens)}
+            if st:
+                args["tokens_per_round"] = tpr
+                args["spec_rounds"] = spr
+            record_span(
+                "rep.decode", "replica", start, now,
+                trace_id=trace["tid"], parent=trace["sid"], args=args,
+            )
+        extra: Dict[str, Any] = {}
+        if st:
+            extra["tpr"] = tpr
+            extra["spr"] = spr
+        if trace is not None:
+            # The trace id rides the journal record so a replay joins
+            # the ORIGINAL trace (ISSUE 12).
+            extra["tr"] = trace["tid"]
         if self.journal is not None:
             self.journal.append(
-                rid_key, prompt, new_tokens,
-                extra={"tpr": tpr, "spr": spr} if st else None,
+                rid_key, prompt, new_tokens, extra=extra or None,
             )
         self.served += 1
         self._flush_streams(only=rid_key)
@@ -748,6 +880,7 @@ class ReplicaRunner:
 
     def _forget(self, rid_key) -> None:
         self._granted.pop(rid_key, None)
+        self._traces.pop(rid_key, None)
         self._stream_buf.pop(rid_key, None)
         self._admitted_at.pop(rid_key, None)
         self._first_token_at.pop(rid_key, None)

@@ -78,6 +78,7 @@ from dlrover_tpu.common.messages import (
     ServeSubmit,
     ServeTokens,
 )
+from dlrover_tpu.obs import record_span, trace_id_for
 from dlrover_tpu.serving.gateway import Gateway, GatewayConfig
 
 
@@ -407,6 +408,7 @@ class GatewayTierNode:
                  port: int = 0,
                  config: Optional[GatewayConfig] = None,
                  heartbeat_s: float = 1.0, addr: Optional[str] = None,
+                 metrics_port: Optional[int] = None,
                  **gateway_kw):
         from dlrover_tpu.common.rpc import local_ip
 
@@ -428,6 +430,83 @@ class GatewayTierNode:
             return out
 
         self.gateway.core.snapshot_extras = tier_extras
+        # Name this process's flight recorder after the gateway id:
+        # postmortems read "gw-g1 died holding req-3", not "pid 4121".
+        # FIRST node wins and an explicit env/configure name is never
+        # displaced — the recorder is process-global, and a harness
+        # hosting several tier nodes in one process must not have the
+        # last-constructed node relabel everyone's events.
+        import os as _os
+
+        from dlrover_tpu import obs
+
+        rec = obs.get_recorder()
+        if not _os.environ.get("DLROVER_TPU_OBS_PROCESS") and \
+                rec.process.startswith("pid"):
+            obs.set_process(f"gw-{gateway_id}")
+        #: Optional /metrics endpoint (ISSUE 12 satellite): OFF by
+        #: default (None); a port (0 = ephemeral) serves this
+        #: gateway's own CounterSet/Histogram gauges, the MERGED tier
+        #: view over the shared registry, and the trace/flight-
+        #: recorder drop counters.
+        self.metrics: Optional[Any] = None
+        self._metrics_set: Optional[_GatewaySet] = None
+        if metrics_port is not None:
+            self._start_metrics(metrics_port)
+
+    def _start_metrics(self, port: int) -> None:
+        """Prometheus endpoint for one tier gateway: own gauges +
+        merged tier view + observability health (every trace/ring
+        drop is a counter, never silent)."""
+        from dlrover_tpu import obs
+        from dlrover_tpu.agent.metrics import (
+            MetricsRegistry,
+            MetricsServer,
+        )
+
+        registry = MetricsRegistry()
+        self.gateway.register_gauges(registry)
+        # Merged tier view: the same union this node's TierActuator
+        # consumers see, TTL-cached — a scrape must not fan RPCs out
+        # to every peer gateway more than once per interval.
+        self._metrics_set = _GatewaySet(self.registry)
+        cache = {"ts": float("-inf"), "snap": {}}
+
+        def _merged():
+            now = time.monotonic()
+            if now - cache["ts"] > 2.0:
+                snaps = [self.gateway.core.stats_snapshot()]
+                snaps.extend(
+                    s for s in _fetch_gateway_stats(self._metrics_set)
+                    if s.get("gateway_id") != self.gateway_id
+                )
+                cache["snap"] = merge_snapshots(snaps)
+                cache["ts"] = now
+            return cache["snap"]
+
+        def _tier_gauge(key):
+            def read():
+                return float(_merged().get(key, 0.0))
+            return read
+
+        for key in ("queue_depth", "in_flight", "replicas_alive",
+                    "gateways", "occupancy", "ttft_p95_ms",
+                    "latency_p95_ms"):
+            registry.gauge(f"tier_{key}", _tier_gauge(key))
+
+        def _obs_gauge(key):
+            def read():
+                return float(obs.get_recorder().stats().get(key, 0))
+            return read
+
+        for key in ("spans", "events", "dropped"):
+            registry.gauge(f"obs_flight_{key}", _obs_gauge(key))
+        self.metrics = MetricsServer(registry, port)
+        self.metrics.start()
+
+    @property
+    def metrics_port(self) -> Optional[int]:
+        return self.metrics.port if self.metrics is not None else None
 
     @property
     def addr(self) -> str:
@@ -484,6 +563,18 @@ class GatewayTierNode:
                     self.gateway_id,
                 )
 
+    def _stop_metrics(self) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.stop()
+            except Exception:  # noqa: BLE001 - teardown
+                logger.debug("metrics server stop failed",
+                             exc_info=True)
+            self.metrics = None
+        if self._metrics_set is not None:
+            self._metrics_set.close()
+            self._metrics_set = None
+
     def stop(self, grace: float = 1.0) -> None:
         self._stop.set()
         if self._thread is not None:
@@ -494,17 +585,31 @@ class GatewayTierNode:
         except Exception:  # noqa: BLE001 - best-effort deregistration
             logger.warning("gateway %s deregistration failed",
                            self.gateway_id, exc_info=True)
+        self._stop_metrics()
         self.gateway.stop(grace)
 
     def crash(self) -> None:
         """Die WITHOUT deregistering (tests/benches): heartbeats stop,
         the RPC server closes, the registry entry is left to age out —
-        exactly what a killed gateway process looks like to the fleet."""
+        exactly what a killed gateway process looks like to the fleet.
+        The flight recorder spills like a real crash's chaos hook —
+        but ONLY when this node owns the process-global recorder (one
+        node per process): in a multi-node-in-one-process harness the
+        ring holds the SURVIVORS' events too, and dumping it under the
+        victim's name would misattribute them and mark the shared ring
+        spilled."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        self._stop_metrics()
         self.gateway.stop(0.0)
+        from dlrover_tpu import obs
+
+        rec = obs.get_recorder()
+        if rec.process == f"gw-{self.gateway_id}":
+            rec.dump(reason="chaos",
+                     chaos_site="serving.gateway_kill")
 
 
 # ---------------------------------------------------------------------------
@@ -775,6 +880,7 @@ class TierClient:
         gid, tr = self._owner_transport(req_id)
         if tr is None:
             return
+        t0 = time.monotonic()
         try:
             ack = tr.call(ent["msg"], deadline=10.0)
         except Exception as e:  # noqa: BLE001 - next poll retries
@@ -784,6 +890,16 @@ class TierClient:
             )
             return
         self.resubmitted += 1
+        # The failover hop joins the request's ORIGINAL trace (ISSUE
+        # 12): the trace id is derived from the req_id, so the client
+        # needs no coordination with the dead owner to continue it —
+        # the resubmit is a span in one trace, never a second trace.
+        record_span(
+            "client.resubmit", "client", t0, time.monotonic(),
+            trace_id=trace_id_for(req_id),
+            args={"rid": req_id, "to": gid,
+                  "ack": str(getattr(ack, "status", ack))[:40]},
+        )
         logger.info(
             "tier client: resubmitted %s to %s after gateway "
             "failover (ack=%s)", req_id, gid,

@@ -73,15 +73,15 @@ class ElasticContext:
         if self.local_rank == 0:
             import time as _time
 
-            now = _time.time()
-            if now - self._last_metrics_report > 30.0:
-                self._last_metrics_report = now
+            nowm = _time.monotonic()
+            if nowm - self._last_metrics_report > 30.0:
+                self._last_metrics_report = nowm
                 try:
                     import json as _json
 
                     self.client.report_diagnosis_data(
                         "step_metrics",
-                        _json.dumps({"step": step, "ts": now}),
+                        _json.dumps({"step": step, "ts": _time.time()}),
                     )
                 except Exception as e:  # noqa: BLE001
                     # Missing a heartbeat is survivable; a silent
@@ -104,7 +104,7 @@ class ElasticContext:
 
         from dlrover_tpu.common.global_context import get_context
 
-        now = _time.time()
+        now = _time.monotonic()
         if now - self._last_reshard_poll < get_context().reshard_poll_interval:
             return None
         self._last_reshard_poll = now

@@ -66,6 +66,10 @@ def parse_args(argv=None):
                    help="(tier) registry namespace")
     p.add_argument("--gateway_id", default="g0",
                    help="(tier gateway) this gateway's id on the ring")
+    p.add_argument("--metrics_port", type=int, default=-1,
+                   help="(tier gateway) /metrics port (-1 = off, "
+                        "0 = ephemeral): own gauges + merged tier "
+                        "view + trace/flight-recorder drop counters")
     p.add_argument("--kv_relay", action="store_true",
                    help="(gateway) force the prefill->decode KV "
                         "segment through the gateway (the PR-8 relay "
@@ -315,6 +319,18 @@ def main() -> int:
 
     ensure_platform()
 
+    # Name this process's flight recorder after its role (ISSUE 12):
+    # merged traces and postmortems read "gw-g1"/"rep-r0", not pids.
+    # No-op beyond the label unless DLROVER_TPU_OBS_DIR is set.
+    from dlrover_tpu import obs
+
+    obs.set_process({
+        "gateway": f"gw-{args.gateway_id}",
+        "replica": f"rep-{args.replica_id}",
+        "draft": f"draft-{args.replica_id}",
+        "driver": "driver",
+    }.get(args.role, "fleet"))
+
     def tier_registry():
         from dlrover_tpu.serving import RpcKv, ServeRegistry
 
@@ -340,11 +356,17 @@ def main() -> int:
             node = GatewayTierNode(
                 args.gateway_id, tier_registry(), port=args.port,
                 config=cfg,
+                metrics_port=(
+                    args.metrics_port if args.metrics_port >= 0
+                    else None
+                ),
             )
             node.start()
             gw = node.gateway
             print(
-                f"GATEWAY_READY port={gw.port} id={args.gateway_id}",
+                f"GATEWAY_READY port={gw.port} id={args.gateway_id}"
+                + (f" metrics={node.metrics_port}"
+                   if node.metrics_port is not None else ""),
                 flush=True,
             )
         else:

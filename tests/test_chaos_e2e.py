@@ -851,6 +851,7 @@ class TestGatewayKillFailover:
 
     def test_surviving_gateway_adopts_range_exactly_once(
             self, tmp_path):
+        from dlrover_tpu import obs
         from dlrover_tpu.chaos.plan import EXIT_GATEWAY_KILL
         from dlrover_tpu.serving import (
             RegistryServer,
@@ -861,11 +862,18 @@ class TestGatewayKillFailover:
 
         registry_server = RegistryServer()
         journal_dir = str(tmp_path / "journals")
+        # Flight-recorder dumps (ISSUE 12): every role spills here —
+        # g1 via the chaos pre-exit hook, g0/replicas at shutdown, the
+        # in-test driver explicitly — and the trace-verified
+        # assertions after teardown merge them.
+        obs_dir = str(tmp_path / "obs")
+        obs.configure(out_dir=obs_dir, process="driver")
         procs = []
         try:
             def spawn_gateway(gid, faults=None):
-                extra = {"DLROVER_TPU_FAULTS": faults} if faults \
-                    else None
+                extra = {"DLROVER_TPU_OBS_DIR": obs_dir}
+                if faults:
+                    extra["DLROVER_TPU_FAULTS"] = faults
                 proc, log = self._spawn(
                     tmp_path, f"gateway-{gid}",
                     ["--role", "gateway", "--registry",
@@ -891,6 +899,7 @@ class TestGatewayKillFailover:
                      "--journal_dir", journal_dir,
                      "--poll_interval", "0.02",
                      "--round_floor_ms", "30"],
+                    env_extra={"DLROVER_TPU_OBS_DIR": obs_dir},
                 )
                 procs.append(proc)
                 return proc, log
@@ -982,6 +991,59 @@ class TestGatewayKillFailover:
                     proc.kill()
                     proc.wait()
             registry_server.stop()
+
+        # ---- Trace-verified epilogue (ISSUE 12) -----------------------
+        # Every process has now spilled its flight recorder: g1 via the
+        # chaos pre-exit hook, g0 via its clean-shutdown atexit, the
+        # replicas via the SIGTERM hook — and the in-test driver here.
+        from dlrover_tpu.obs import collect
+        from dlrover_tpu.obs.postmortem import analyze
+        from dlrover_tpu.utils.trace_analysis import TraceAnalysis
+
+        obs.get_recorder().dump(reason="exit")
+        dumps = collect.load_dir(obs_dir)
+        by_proc = {d["meta"]["process"]: d["meta"] for d in dumps}
+        # The kill is VISIBLE: a dump whose header names the injected
+        # chaos site, from the dead gateway itself.
+        assert by_proc["gw-g1"]["reason"] == "chaos", by_proc
+        assert by_proc["gw-g1"]["chaos_site"] == \
+            "serving.gateway_kill"
+        assert "gw-g0" in by_proc and "driver" in by_proc
+        assert any(p.startswith("rep-") for p in by_proc)
+        # One merged, Perfetto-loadable fleet trace; the repo's own
+        # chrome-trace tooling consumes it.
+        merged_path = str(tmp_path / "fleet_trace.json")
+        collect.write_chrome_trace(obs_dir, merged_path)
+        ta = TraceAnalysis.from_file(merged_path)
+        assert ta.events, "merged chrome trace holds no spans"
+        # Every admitted request: a complete span tree ending in
+        # exactly one EFFECTIVE terminal (a journal replay at the
+        # adopting gateway may supersede the dead gateway's terminal —
+        # the duplicates must AGREE, which is exactly-once evidence),
+        # with the gateway's phase spans summing to the measured
+        # TTFT/latency within 5%.
+        rep = collect.validate_traces(dumps, tolerance=0.05)
+        for rid in prompts:
+            tr = rep["traces"].get(obs.trace_id_for(rid))
+            assert tr is not None, f"{rid}: no trace in the merge"
+            assert tr["ok"], (rid, tr)
+            assert tr["state"] == "done", (rid, tr)
+        # The failover is visible as resubmit spans in the ORIGINAL
+        # traces (the driver's dump), never as duplicate traces.
+        driver = next(d for d in dumps
+                      if d["meta"]["process"] == "driver")
+        resub_tids = {e.get("tid") for e in driver["events"]
+                      if e.get("name") == "client.resubmit"}
+        assert resub_tids, "no resubmit spans recorded"
+        assert resub_tids <= {
+            obs.trace_id_for(rid) for rid in prompts
+        }
+        # The postmortem reconstructs the incident from the dumps.
+        pm = analyze(obs_dir)
+        assert pm["crashed"] == ["gw-g1"]
+        assert pm["chaos_sites"] == ["serving.gateway_kill"]
+        assert any(r["terminal_process"] in ("gw-g0", "gw-g1")
+                   for r in pm["rerouted"]) or pm["rerouted"] == []
 
 
 @pytest.mark.serving

@@ -538,4 +538,89 @@ class TestRepoGate:
         assert set(RULES) >= {
             "JX001", "JX002", "JX003", "JX004", "JX005",
             "CC101", "CC102", "CC103", "CC104", "GC000",
+            "OB301",
         }
+
+
+class TestObsRules:
+    """OB301 (ISSUE 12): time.time() deltas used as durations."""
+
+    def test_direct_wall_delta_flagged(self):
+        assert "OB301" in rules_of("""
+            import time
+            def f(start):
+                return time.time() - start
+        """)
+
+    def test_deadline_minus_now_flagged(self):
+        assert "OB301" in rules_of("""
+            import time
+            def f(deadline):
+                return deadline - time.time()
+        """)
+
+    def test_local_name_assigned_from_wall_clock_flagged(self):
+        assert "OB301" in rules_of("""
+            import time
+            def f(last):
+                now = time.time()
+                return now - last
+        """)
+
+    def test_self_attr_assigned_from_wall_clock_flagged(self):
+        assert "OB301" in rules_of("""
+            import time
+            class C:
+                def start(self):
+                    self._t0 = time.time()
+                def elapsed(self):
+                    now = time.monotonic()
+                    return now - self._t0
+        """)
+
+    def test_or_default_idiom_tracked(self):
+        assert "OB301" in rules_of("""
+            import time
+            def f(ts, then):
+                now = ts or time.time()
+                return now - then
+        """)
+
+    def test_monotonic_delta_not_flagged(self):
+        src = """
+            import time
+            def f(start):
+                deadline = time.monotonic() + 5.0
+                return (time.monotonic() - start,
+                        deadline - time.monotonic(),
+                        time.perf_counter() - start)
+        """
+        assert "OB301" not in rules_of(src)
+
+    def test_wall_sum_not_flagged(self):
+        # Building a wall deadline is not the hazard; subtracting one
+        # is (and THAT is what gets flagged, wherever it happens).
+        assert "OB301" not in rules_of("""
+            import time
+            def f():
+                return time.time() + 30.0
+        """)
+
+    def test_plain_timestamp_use_not_flagged(self):
+        assert "OB301" not in rules_of("""
+            import time
+            def f(msg):
+                msg.timestamp = time.time()
+                return msg
+        """)
+
+    def test_suppression_honored_with_justification(self):
+        findings = check_source(textwrap.dedent("""
+            import time
+            def f(file_mtime):
+                # graftcheck: disable=OB301 -- vs a wall-clock mtime
+                return time.time() - file_mtime
+        """))
+        ob = [f for f in findings if f.rule == "OB301"]
+        assert len(ob) == 1 and ob[0].suppressed
+        assert "mtime" in ob[0].justification

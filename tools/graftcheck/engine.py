@@ -33,6 +33,8 @@ RULES: Dict[str, str] = {
     "CC103": "non-daemon thread never joined",
     "CC104": "except:/except Exception: with a pass-only body swallows "
              "errors",
+    "OB301": "time.time() delta used as a duration/deadline "
+             "(monotonic/perf_counter required; wall clocks step)",
 }
 
 
@@ -123,7 +125,7 @@ def _parse_suppressions(
 def check_source(source: str, path: str = "<string>") -> List[Finding]:
     """Run every rule over one source blob; returns ALL findings,
     suppressed ones included (``suppressed=True`` + justification)."""
-    from . import concurrency_rules, jax_rules
+    from . import concurrency_rules, jax_rules, obs_rules
 
     try:
         tree = ast.parse(source, filename=path)
@@ -133,7 +135,7 @@ def check_source(source: str, path: str = "<string>") -> List[Finding]:
             f"file does not parse: {e.msg}",
         )]
     suppress, findings = _parse_suppressions(source, path)
-    for rule_mod in (jax_rules, concurrency_rules):
+    for rule_mod in (jax_rules, concurrency_rules, obs_rules):
         findings.extend(rule_mod.check(tree, path))
     for f in findings:
         just = suppress.get(f.line, {}).get(f.rule)

@@ -1,0 +1,157 @@
+"""Observability rules (OB3xx).
+
+OB301: a ``time.time()`` delta used as a duration/deadline.  Wall
+clocks STEP — NTP slews and jumps bend any subtraction of two wall
+instants (the PR-9 registry leases were bitten by exactly this; the
+reader-side observation window was the fix).  Durations and local
+deadlines must use ``time.monotonic()`` / ``time.perf_counter()``.
+The legitimate exceptions — comparing wall TIMESTAMPS that crossed a
+process boundary (heartbeats, diagnosis reports), where wall time is
+the point — carry a justified suppression.
+
+Detection is lexical, matching the repo idiom: a ``Sub`` expression
+where either operand is *wallish* — a direct ``time.time()`` /
+``_time.time()`` call, a local name assigned from one in the same
+function, or a ``self.<attr>`` assigned from one anywhere in the
+enclosing class.  Sums (``time.time() + timeout``) are untouched:
+building a wall deadline is only a hazard when it is later
+subtracted, and that subtraction is what gets flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .engine import Finding
+
+_WALL_CALLS = {"time.time", "_time.time"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_wall_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and _dotted(node.func) in _WALL_CALLS
+    )
+
+
+def _assigned_names(node: ast.AST) -> List[str]:
+    """Dotted targets of an assignment whose value is a wall call
+    (``x = time.time()``, ``self._t0 = time.time()``, and the
+    ``x = y or time.time()`` / ``timestamp or time.time()`` idiom)."""
+    value = None
+    targets: List[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        value, targets = node.value, list(node.targets)
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        value, targets = node.value, [node.target]
+    if value is None:
+        return []
+    wall = _is_wall_call(value) or (
+        isinstance(value, ast.BoolOp)
+        and any(_is_wall_call(v) for v in value.values)
+    )
+    if not wall:
+        return []
+    out = []
+    for t in targets:
+        name = _dotted(t)
+        if name:
+            out.append(name)
+    return out
+
+
+def _wall_attrs_of_class(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        for name in _assigned_names(node):
+            if name.startswith("self."):
+                out.add(name)
+    return out
+
+
+class _SubWalk(ast.NodeVisitor):
+    """One function's walk: track wallish local names, flag Subs."""
+
+    def __init__(self, path: str, wall_attrs: Set[str]):
+        self.path = path
+        self.wall_attrs = wall_attrs
+        self.local: Set[str] = set()
+        self.findings: List[Finding] = []
+
+    def _wallish(self, node: ast.AST) -> bool:
+        if _is_wall_call(node):
+            return True
+        name = _dotted(node)
+        if name is None:
+            return False
+        return name in self.local or name in self.wall_attrs
+
+    def visit_Assign(self, node):
+        for name in _assigned_names(node):
+            self.local.add(name)
+        self.generic_visit(node)
+
+    visit_AnnAssign = visit_Assign
+    visit_AugAssign = visit_Assign
+
+    def visit_BinOp(self, node):
+        if isinstance(node.op, ast.Sub) and (
+            self._wallish(node.left) or self._wallish(node.right)
+        ):
+            self.findings.append(Finding(
+                "OB301", self.path, node.lineno,
+                "time.time() delta used as a duration/deadline — the "
+                "wall clock steps under NTP (the PR-9 lease bug); use "
+                "time.monotonic()/perf_counter(), or suppress where "
+                "cross-process wall timestamps are the point",
+            ))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # Nested defs get their own scope walk from check(); don't
+        # leak this scope's names into them.
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def check(tree: ast.Module, path: str) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    # Map every function to its enclosing class's wallish attrs.
+    class_of = {}
+    for cls in ast.walk(tree):
+        if isinstance(cls, ast.ClassDef):
+            attrs = _wall_attrs_of_class(cls)
+            for node in ast.walk(cls):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    class_of.setdefault(node, attrs)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            w = _SubWalk(path, class_of.get(node, set()))
+            for stmt in node.body:
+                w.visit(stmt)
+            findings.extend(w.findings)
+    # Module-level statements (rare; scripts).
+    w = _SubWalk(path, set())
+    for stmt in tree.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            w.visit(stmt)
+    findings.extend(w.findings)
+    uniq = {}
+    for f in findings:
+        uniq.setdefault((f.rule, f.line), f)
+    return list(uniq.values())
