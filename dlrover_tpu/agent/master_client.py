@@ -13,15 +13,48 @@ import uuid
 from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import messages as m
-from dlrover_tpu.common.env import get_master_addr, get_node_id
-from dlrover_tpu.common.rpc import RpcClient
+from dlrover_tpu.common.env import (
+    get_master_addr,
+    get_master_standby_addr,
+    get_master_state_dir,
+    get_node_id,
+)
+from dlrover_tpu.common.rpc import RpcClient, addr_connectable
 
 
 class MasterClient:
-    def __init__(self, master_addr: str, node_id: int = 0):
-        self._client = RpcClient(master_addr)
+    def __init__(self, master_addr: str, node_id: int = 0,
+                 state_dir: str = "", standby_addr: str = ""):
+        # Failover re-resolve (ISSUE 13): on every channel rebuild the
+        # RPC layer asks _resolve_addr for the freshest master address.
+        # Source order: (1) the ``addr`` file the CURRENT leader
+        # publishes in the HA state dir (env DLROVER_TPU_MASTER_STATE_DIR
+        # — works across repeated failovers), (2) the static standby
+        # address (env DLROVER_TPU_MASTER_STANDBY_ADDR) once the primary
+        # stops answering a quick TCP probe, (3) the address we have.
+        self._state_dir = state_dir or get_master_state_dir()
+        self._standby_addr = standby_addr or get_master_standby_addr()
+        self._client = RpcClient(
+            master_addr, addr_provider=self._resolve_addr
+        )
         self.node_id = node_id
         self.master_addr = master_addr
+
+    def _resolve_addr(self) -> str:
+        if self._state_dir:
+            from dlrover_tpu.master.state import read_addr
+
+            published = read_addr(self._state_dir)
+            if published:
+                self.master_addr = published
+                return published
+        if self._standby_addr and self._standby_addr != self.master_addr:
+            # Cheap probes only on the (rate-limited) reconnect path.
+            if not addr_connectable(self.master_addr, timeout=0.5) and \
+                    addr_connectable(self._standby_addr, timeout=0.5):
+                self.master_addr = self._standby_addr
+                return self._standby_addr
+        return self.master_addr
 
     # -- registration / lifecycle -----------------------------------------
     def register_node(
@@ -331,6 +364,40 @@ class MasterClient:
             return resp
         return m.ReshardEpochInfo()
 
+    def announce_reshard(
+        self,
+        target_num_processes: int,
+        target_spec: Optional[dict] = None,
+        expected_reports: int = 0,
+        deadline_s: float = 0.0,
+    ) -> m.ReshardEpochInfo:
+        """Operator/admin resize request (ISSUE 13): open a live resize
+        epoch from outside the master process."""
+        resp = self._client.call(
+            m.ReshardAnnounce(
+                node_id=self.node_id,
+                target_num_processes=target_num_processes,
+                target_spec=dict(target_spec or {}),
+                expected_reports=expected_reports,
+                deadline_s=deadline_s,
+            )
+        )
+        if isinstance(resp, m.ReshardEpochInfo):
+            return resp
+        return m.ReshardEpochInfo()
+
+    def journal_fetch(self, offset: int, max_bytes: int = 1 << 20) \
+            -> m.JournalChunk:
+        """Raw control-state journal bytes (standby streaming
+        replication; ``offset=-1`` = the snapshot file)."""
+        resp = self._client.call(
+            m.JournalFetch(offset=offset, max_bytes=max_bytes),
+            idempotent=True,
+        )
+        if isinstance(resp, m.JournalChunk):
+            return resp
+        return m.JournalChunk(found=False)
+
     def report_reshard(
         self,
         epoch: int,
@@ -444,15 +511,38 @@ class MasterClient:
 
 _client_lock = threading.Lock()
 _client: Optional[MasterClient] = None
+#: The env-resolved address the cached singleton was built from.  An
+#: env-default build latched the address forever (ISSUE 13 satellite): a
+#: post-failover DLROVER_TPU_MASTER_ADDR change was silently ignored for
+#: the life of the process.  Tracking the source lets build re-resolve.
+_client_env_addr: str = ""
 
 
 def build_master_client(
     master_addr: str = "", node_id: Optional[int] = None
 ) -> MasterClient:
     """Process-wide singleton (reference ``build_master_client :480``);
-    defaults from the agent-provided env contract."""
-    global _client
+    defaults from the agent-provided env contract.
+
+    An env-defaulted singleton is INVALIDATED (closed + rebuilt) when
+    the env-resolved address has changed since it was built — a
+    supervisor that re-points DLROVER_TPU_MASTER_ADDR after a failover
+    must be picked up, not latched over.  An explicit ``master_addr``
+    returns the cached client as before when it matches; use
+    :func:`reset_master_client` to force a rebuild.
+    """
+    global _client, _client_env_addr
     with _client_lock:
+        if _client is not None and not master_addr and _client_env_addr:
+            # Only an ENV-BUILT singleton re-resolves: a client built
+            # with an explicit address (_client_env_addr == "") stays
+            # authoritative — tearing it down under concurrent RPC
+            # threads because the env happens to be set would fail
+            # their in-flight calls for no reason.
+            env_addr = get_master_addr()
+            if env_addr and env_addr != _client_env_addr:
+                _client.close()
+                _client = None
         if _client is None:
             addr = master_addr or get_master_addr()
             nid = node_id if node_id is not None else get_node_id()
@@ -462,12 +552,33 @@ def build_master_client(
                     "master_addr"
                 )
             _client = MasterClient(addr, nid)
+            _client_env_addr = "" if master_addr else addr
         return _client
 
 
+def invalidate_master_client() -> None:
+    """Explicit re-resolve hook (ISSUE 13 satellite): drop the cached
+    singleton so the NEXT :func:`build_master_client` re-reads the env
+    contract.  Unlike :func:`reset_master_client` this is safe to call
+    speculatively from failover paths — it never raises (a failing
+    channel teardown is logged, the cache is dropped regardless)."""
+    global _client, _client_env_addr
+    with _client_lock:
+        if _client is not None:
+            try:
+                _client.close()
+            except Exception as e:  # noqa: BLE001 - speculative path
+                from dlrover_tpu.common.log import logger
+
+                logger.debug("stale master client close failed: %s", e)
+        _client = None
+        _client_env_addr = ""
+
+
 def reset_master_client() -> None:
-    global _client
+    global _client, _client_env_addr
     with _client_lock:
         if _client is not None:
             _client.close()
         _client = None
+        _client_env_addr = ""

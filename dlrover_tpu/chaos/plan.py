@@ -64,6 +64,8 @@ EXIT_RESHARD_CRASH = 79
 EXIT_SLICE_CRASH = 80
 EXIT_GATEWAY_KILL = 81
 EXIT_DRAFT_KILL = 82
+EXIT_MASTER_KILL = 83
+EXIT_JOURNAL_TORN = 84
 
 #: site name -> (kind, defaults).  Kinds: ``error`` (caller raises),
 #: ``latency`` (inject() sleeps), ``crash`` (inject() calls os._exit),
@@ -129,6 +131,20 @@ SITES: Dict[str, dict] = {
     },
     "master.restart": {
         "kind": "crash", "exit": EXIT_MASTER_RESTART, "times": 1,
+    },
+    # Master HA sites (ISSUE 13).  ``master.kill`` is the UNCLEAN exit —
+    # distinct from the supervised ``master.restart`` cold path — fired
+    # from the master main's chaos poller (``at=`` gates the timing);
+    # the warm standby must adopt the journaled state instead of a
+    # blank-state relaunch.  ``master.journal_torn`` crashes INSIDE a
+    # ControlStateJournal append between the first and second half of a
+    # frame — the literal crash-mid-fsync'd-write; reopen must truncate
+    # the torn tail and lose exactly the unacked record.
+    "master.kill": {
+        "kind": "crash", "exit": EXIT_MASTER_KILL, "times": 1,
+    },
+    "master.journal_torn": {
+        "kind": "crash", "exit": EXIT_JOURNAL_TORN, "times": 1,
     },
     # Live-reshard sites (ISSUE 6): a plan segment lost in flight (the
     # mover must fail the move, not hang or accept torn bytes), a
@@ -272,6 +288,18 @@ class FaultPlan:
 
     def has_site(self, site: str) -> bool:
         return any(s.site == site for s in self.specs)
+
+    def site_armed(self, site: str) -> bool:
+        """True while ``site`` can STILL fire (firing budget not
+        exhausted).  Hot paths that pay extra work only to give a crash
+        site its window (the control journal's split-write) gate on
+        this instead of :meth:`has_site`, so a consumed one-shot stops
+        costing anything."""
+        with self._lock:
+            return any(
+                s.site == site and (s.times < 0 or s.fired < s.times)
+                for s in self.specs
+            )
 
     def elapsed(self) -> float:
         return time.monotonic() - self._t0

@@ -34,6 +34,20 @@ def get_master_addr() -> str:
     return get_env_str(NodeEnv.MASTER_ADDR)
 
 
+def get_master_standby_addr() -> str:
+    """Address of the warm-standby master (ISSUE 13), if one is running.
+    Clients fail over to it when the primary stops answering."""
+    return get_env_str("DLROVER_TPU_MASTER_STANDBY_ADDR")
+
+
+def get_master_state_dir() -> str:
+    """The master's durable control-plane state dir (ISSUE 13).  When
+    set, clients re-resolve the serving master's address from the
+    ``addr`` file the current leader publishes there — the chain that
+    keeps working across repeated failovers."""
+    return get_env_str("DLROVER_TPU_MASTER_STATE_DIR")
+
+
 def get_job_name() -> str:
     return get_env_str(NodeEnv.JOB_NAME, "local-job")
 

@@ -89,6 +89,22 @@ class Context:
         # Worker-side throttle for the resize-epoch poll that rides the
         # step-report path.
         self.reshard_poll_interval: float = 2.0
+        # Master HA (ISSUE 13).  ``ha_lease_s`` is the READER-side leader
+        # lease: the warm standby declares the primary dead once the
+        # journal/lease file stops changing for this long on the
+        # standby's OWN clock (writer and reader wall clocks are never
+        # compared — the PR-9 registry idiom).  ``ha_lease_interval_s``
+        # is how often the primary's keeper bumps the lease file;
+        # must be well under ha_lease_s.
+        self.ha_lease_s: float = 4.0
+        self.ha_lease_interval_s: float = 1.0
+        # Standby journal-tail poll period.
+        self.ha_tail_poll_s: float = 0.2
+        # Snapshot + WAL compaction every this-many appended records.
+        self.ha_snapshot_every: int = 1000
+        # Throttle for journaling SpeedMonitor step baselines (each
+        # report is a gauge; only a periodic baseline needs durability).
+        self.ha_speed_journal_s: float = 15.0
         self._apply_env_overrides()
 
     def _apply_env_overrides(self) -> None:

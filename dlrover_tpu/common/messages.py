@@ -1079,3 +1079,49 @@ class ReshardReport(Message):
     reason: str = ""
     downtime_ms: float = 0.0
     moved_mb: float = 0.0
+
+
+@dataclasses.dataclass
+class ReshardAnnounce(Message):
+    """Operator/admin request: announce a live resize epoch (ISSUE 13).
+    Until now only the in-process autoscaler could announce; this RPC
+    lets an operator (or a test harness) open an epoch from outside.
+    The reply is a ``ReshardEpochInfo`` for the announced epoch."""
+
+    node_id: int = 0
+    target_num_processes: int = 0
+    target_spec: dict = dataclasses.field(default_factory=dict)
+    expected_reports: int = 0
+    deadline_s: float = 0.0  # 0 = the master's configured default
+
+
+@dataclasses.dataclass
+class JournalFetch(Message):
+    """Standby -> primary streaming replication (ISSUE 13): read the
+    control-state WAL from byte ``offset``.  ``offset=-1`` asks for the
+    current snapshot file instead; the mirror then (re-)reads the WAL
+    from byte 0 — frames carry their own seq, so a tail dedupes any
+    overlap, and a compaction is detected via the reply's
+    ``wal_size``/``wal_ino``."""
+
+    offset: int = 0
+    max_bytes: int = 1 << 20
+
+
+@dataclasses.dataclass
+class JournalChunk(Message):
+    """A chunk of the primary's WAL (or snapshot, for ``offset=-1``).
+    ``eof`` means no bytes past ``offset`` right now (poll again);
+    ``found`` is False when the primary runs without a state journal.
+    ``wal_size``/``wal_ino`` identify the remote WAL file (size + inode
+    of the open fd the bytes were read from): a mirror that sees the
+    inode change — or its offset exceed the size — knows the primary
+    compacted (atomic-replaced) the file and rebuilds instead of
+    appending new-inode bytes at an old-inode offset."""
+
+    data: bytes = b""
+    offset: int = 0  # offset of the FIRST byte of ``data``
+    eof: bool = True
+    found: bool = True
+    wal_size: int = -1
+    wal_ino: int = 0

@@ -207,9 +207,16 @@ class RpcClient:
     #: hammering a restarting master must converge, not queue forever.
     DEFAULT_DEADLINE = 60.0
 
-    def __init__(self, addr: str, timeout: float = 30.0):
+    def __init__(self, addr: str, timeout: float = 30.0,
+                 addr_provider: Optional[Callable[[], str]] = None):
         self._addr = addr
         self._timeout = timeout
+        # Optional re-resolve hook (ISSUE 13): consulted on every channel
+        # rebuild.  Returning a different address re-homes the client —
+        # the master-failover path (a warm standby published its address
+        # after takeover, so retries land on the new leader instead of
+        # hammering the dead one).
+        self._addr_provider = addr_provider
         self._reconnect_mu = threading.Lock()
         self._connect()
 
@@ -234,6 +241,17 @@ class RpcClient:
         with self._reconnect_mu:
             if not force and time.monotonic() - self._connected_at < 2.0:
                 return  # another caller just rebuilt it
+            if self._addr_provider is not None:
+                try:
+                    fresh = self._addr_provider()
+                except Exception as e:  # noqa: BLE001 - resolve is best-effort
+                    logger.warning("RPC addr re-resolve failed: %s", e)
+                    fresh = ""
+                if fresh and fresh != self._addr:
+                    logger.warning(
+                        "RPC client re-homing %s -> %s", self._addr, fresh
+                    )
+                    self._addr = fresh
             old = self._channel
             self._connect()
             # Retire the old channel instead of closing it immediately:

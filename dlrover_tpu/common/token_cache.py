@@ -40,3 +40,17 @@ class BoundedTokenCache:
 
     def __len__(self) -> int:
         return len(self._items)
+
+    # -- HA snapshot surface (ISSUE 13) ---------------------------------
+    # The master's control-state snapshot must carry the dedupe caches:
+    # replaying a journal tail that overlaps the snapshot re-applies
+    # tokened mutations, and only the token cache makes that re-apply
+    # idempotent (same token -> first result, no double effect).
+    def dump_state(self) -> list:
+        """Insertion-ordered ``[token, result]`` pairs."""
+        return [[t, r] for t, r in self._items.items()]
+
+    def load_state(self, items: list) -> None:
+        self._items.clear()
+        for token, result in items:
+            self.put(token, result)

@@ -57,6 +57,7 @@ class DistributedJobMaster(JobMaster):
         platform: Optional[PlatformClient] = None,
         scaler: Optional[Scaler] = None,
         resource_optimizer: Optional[ResourceOptimizer] = None,
+        state_dir: str = "",
     ):
         self.job_args = job_args
         self._ctx = get_context()
@@ -159,6 +160,16 @@ class DistributedJobMaster(JobMaster):
         )
         self._server = RpcServer(port, self.servicer)
         self.run_config: dict = {}
+        # Durable control-plane state (ISSUE 13): same wiring as the
+        # local master — journal mutations, recover at construction.
+        self.state_dir = state_dir
+        self._ha_journal = None
+        self._ha_state = None
+        self._ha_keeper = None
+        if state_dir:
+            from dlrover_tpu.master.state import attach_state
+
+            attach_state(self, state_dir)
 
     @property
     def port(self) -> int:
@@ -170,6 +181,12 @@ class DistributedJobMaster(JobMaster):
 
     def prepare(self) -> None:
         self._server.start()
+        if self._ha_journal is not None:
+            from dlrover_tpu.master.state import write_addr
+
+            write_addr(self.state_dir, self.addr)
+            self._ha_journal.write_lease()
+            self._ha_keeper.start()
         self.task_manager.start()
         self.job_manager.start()
         if self.fleet_manager is not None:
@@ -230,4 +247,12 @@ class DistributedJobMaster(JobMaster):
         self.diagnosis_manager.stop()
         self.strategy_generator.stop()
         self._server.stop()
+        if self._ha_keeper is not None:
+            self._ha_keeper.stop()
+        if self._ha_journal is not None:
+            # Clean end of job: a tailing standby stands down.
+            self._ha_journal.append(
+                "ha.shutdown", {"reason": self._exit_reason}
+            )
+            self._ha_journal.close()
         self.platform.close()

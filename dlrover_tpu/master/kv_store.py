@@ -6,6 +6,12 @@ the agent-side ``MasterKVStore`` (a torch ``Store`` backed by master RPCs,
 rank/port exchange before ``jax.distributed.initialize`` and any user-level
 cross-process key exchange; it replaces etcd/c10d-TCPStore so the master is
 the only stateful control-plane service.
+
+Every mutation is journaled (when master HA is on, ISSUE 13) BEFORE the
+RPC ack: an acked set/add/delete is durable and a warm standby replays it.
+``add`` journals its RESULT so replay reproduces the idempotency-token
+cache — an RPC retried across a failover blackout still gets the first
+answer.
 """
 
 from __future__ import annotations
@@ -15,9 +21,10 @@ import time
 from typing import Dict, List, Optional
 
 from dlrover_tpu.common.token_cache import BoundedTokenCache
+from dlrover_tpu.master.state import JournalBound
 
 
-class KVStoreService:
+class KVStoreService(JournalBound):
     def __init__(self) -> None:
         self._store: Dict[str, bytes] = {}
         self._cond = threading.Condition()
@@ -26,6 +33,7 @@ class KVStoreService:
     def set(self, key: str, value: bytes) -> None:
         with self._cond:
             self._store[key] = value
+            self._jrec("kv.set", key=key, value=value)
             self._cond.notify_all()
 
     def get(self, key: str) -> Optional[bytes]:
@@ -56,12 +64,15 @@ class KVStoreService:
             cur += delta
             self._store[key] = str(cur).encode()
             self._add_tokens.put(token, cur)
+            self._jrec("kv.add", key=key, delta=delta, token=token,
+                       result=cur)
             self._cond.notify_all()
             return cur
 
     def multi_set(self, kvs: Dict[str, bytes]) -> None:
         with self._cond:
             self._store.update(kvs)
+            self._jrec("kv.multi_set", kvs=dict(kvs))
             self._cond.notify_all()
 
     def multi_get(self, keys: List[str]) -> Dict[str, bytes]:
@@ -70,7 +81,10 @@ class KVStoreService:
 
     def delete(self, key: str) -> bool:
         with self._cond:
-            return self._store.pop(key, None) is not None
+            found = self._store.pop(key, None) is not None
+            if found:
+                self._jrec("kv.delete", key=key)
+            return found
 
     def scan(self, prefix: str) -> Dict[str, bytes]:
         """All keys under ``prefix`` (ISSUE 9: the serving tier's
@@ -90,3 +104,18 @@ class KVStoreService:
             else:
                 for k in [k for k in self._store if k.startswith(prefix)]:
                     del self._store[k]
+            self._jrec("kv.clear", prefix=prefix)
+
+    # -- HA snapshot surface (ISSUE 13) ---------------------------------
+    def dump_state(self) -> dict:
+        with self._cond:
+            return {
+                "store": dict(self._store),
+                "add_tokens": self._add_tokens.dump_state(),
+            }
+
+    def load_state(self, state: dict) -> None:
+        with self._cond:
+            self._store = dict(state.get("store", {}))
+            self._add_tokens.load_state(state.get("add_tokens", []))
+            self._cond.notify_all()

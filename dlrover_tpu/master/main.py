@@ -17,21 +17,27 @@ from dlrover_tpu.common.log import logger, set_role
 
 
 def _arm_chaos_restart() -> None:
-    """If the fault plan schedules a ``master.restart``, poll it from a
-    daemon thread: the injection point hard-exits this process (exit 42)
-    when its time/filters match, and the launcher's local-master
-    supervisor (run.py) relaunches us on the same port."""
+    """If the fault plan schedules a ``master.restart`` (supervised cold
+    relaunch, exit 42) or a ``master.kill`` (unclean death, exit 83 —
+    the warm standby's cue, ISSUE 13), poll it from a daemon thread: the
+    injection point hard-exits this process when its time/filters
+    match."""
     plan = chaos.active_plan()
-    if plan is None or not plan.has_site("master.restart"):
+    sites = [
+        s for s in ("master.restart", "master.kill")
+        if plan is not None and plan.has_site(s)
+    ]
+    if not sites:
         return
 
     def loop() -> None:
         while True:
-            chaos.inject("master.restart")
+            for site in sites:
+                chaos.inject(site)
             time.sleep(0.2)
 
     threading.Thread(
-        target=loop, name="chaos-master-restart", daemon=True
+        target=loop, name="chaos-master-crash", daemon=True
     ).start()
 
 
@@ -50,10 +56,49 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--brain_addr", default="",
                    help="host:port of a Brain service; resource decisions "
                         "are delegated to it (reference brain_optimizer)")
+    p.add_argument("--state_dir", default="",
+                   help="durable control-plane state dir (ISSUE 13): "
+                        "journal mutations, recover on relaunch, and let "
+                        "a warm standby adopt the state")
+    p.add_argument("--standby", action="store_true",
+                   help="run as a WARM STANDBY: tail --state_dir, bind "
+                        "the port up front, take over on primary silence")
+    p.add_argument("--primary_addr", default="",
+                   help="standby mode: the primary's host:port (defaults "
+                        "to the addr file in --state_dir); probed before "
+                        "a takeover so a stalled filesystem cannot cause "
+                        "a split brain")
     return p.parse_args(argv)
 
 
+def run_standby(args: argparse.Namespace) -> int:
+    """Warm-standby entry: bind, tail, take over, serve."""
+    set_role("master-standby")
+    if not args.state_dir:
+        logger.error("--standby requires --state_dir")
+        return 2
+    from dlrover_tpu.master.standby import StandbyMaster
+
+    sb = StandbyMaster(
+        args.state_dir,
+        port=args.port,
+        primary_addr=args.primary_addr,
+        job_name=args.job_name,
+        min_nodes=args.min_nodes,
+        max_nodes=args.max_nodes,
+        node_unit=args.node_unit,
+        network_check=args.network_check,
+    )
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(str(sb.port))
+    logger.info("standby master bound on port %d", sb.port)
+    return sb.run()
+
+
 def run(args: argparse.Namespace) -> int:
+    if args.standby:
+        return run_standby(args)
     set_role("master")
     optimizer = None
     if args.brain_addr:
@@ -74,6 +119,7 @@ def run(args: argparse.Namespace) -> int:
             node_unit=args.node_unit,
             network_check=args.network_check,
             resource_optimizer=optimizer,
+            state_dir=args.state_dir,
         )
     else:
         from dlrover_tpu.master.dist_master import DistributedJobMaster
@@ -96,6 +142,7 @@ def run(args: argparse.Namespace) -> int:
             job_args,
             port=args.port,
             resource_optimizer=optimizer,
+            state_dir=args.state_dir,
         )
     rc = 1
     _arm_chaos_restart()

@@ -61,6 +61,7 @@ class LocalJobMaster(JobMaster):
         network_check: bool = False,
         run_config: Optional[dict] = None,
         resource_optimizer=None,
+        state_dir: str = "",
     ):
         self.job_name = job_name
         # Local mode has no platform to scale, but a Brain-backed optimizer
@@ -124,6 +125,16 @@ class LocalJobMaster(JobMaster):
             reshard_manager=self.reshard_manager,
         )
         self._server = RpcServer(port, self.servicer)
+        # Durable control-plane state (ISSUE 13): journal mutations,
+        # recover a previous incarnation's state at construction.
+        self.state_dir = state_dir
+        self._ha_journal = None
+        self._ha_state = None
+        self._ha_keeper = None
+        if state_dir:
+            from dlrover_tpu.master.state import attach_state
+
+            attach_state(self, state_dir)
 
     @property
     def port(self) -> int:
@@ -140,6 +151,12 @@ class LocalJobMaster(JobMaster):
         if self._ctx.auto_tune:
             self.strategy_generator.start()
         self._server.start()
+        if self._ha_journal is not None:
+            from dlrover_tpu.master.state import write_addr
+
+            write_addr(self.state_dir, self.addr)
+            self._ha_journal.write_lease()
+            self._ha_keeper.start()
         self.stage = JobStage.RUNNING
         logger.info("local master for %s ready on :%d", self.job_name, self.port)
 
@@ -200,6 +217,15 @@ class LocalJobMaster(JobMaster):
         self.diagnosis_manager.stop()
         self.strategy_generator.stop()
         self._server.stop()
+        if self._ha_keeper is not None:
+            self._ha_keeper.stop()
+        if self._ha_journal is not None:
+            # Tell any tailing standby this is a CLEAN end of the job —
+            # it must stand down, not adopt a finished master's state.
+            self._ha_journal.append(
+                "ha.shutdown", {"reason": self._exit_reason}
+            )
+            self._ha_journal.close()
 
 
 def run_master_forever(master: JobMaster) -> int:

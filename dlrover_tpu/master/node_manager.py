@@ -19,9 +19,10 @@ from dlrover_tpu.common.constants import NodeStatus, NodeType
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.state import JournalBound
 
 
-class LocalJobManager:
+class LocalJobManager(JournalBound):
     """Tracks nodes of a single-host job (reference ``LocalJobManager:26``)."""
 
     def __init__(self, job_name: str = "local-job"):
@@ -36,6 +37,58 @@ class LocalJobManager:
         self._heartbeat_thread: Optional[threading.Thread] = None
         # Callbacks: diagnosis manager subscribes to heartbeat timeouts.
         self.on_node_dead = None
+
+    # -- HA snapshot surface (ISSUE 13) -------------------------------------
+    def dump_state(self) -> dict:
+        with self._lock:
+            return {
+                "nodes": {
+                    nid: {
+                        "type": n.type,
+                        "rank": n.rank_index,
+                        "status": n.status,
+                        "exit_reason": n.exit_reason,
+                        "host": n.host,
+                        "agent_port": n.agent_port,
+                        "slice_id": n.slice_id,
+                        "host_id": n.host_id,
+                    }
+                    for nid, n in self._nodes.items()
+                },
+                "meta": {
+                    nid: dict(meta_) for nid, meta_ in self._node_meta.items()
+                },
+            }
+
+    def load_state(self, state: dict) -> None:
+        with self._lock:
+            self._nodes.clear()
+            for nid, d in state.get("nodes", {}).items():
+                nid = int(nid)
+                node = Node(
+                    d.get("type") or NodeType.WORKER, nid,
+                    rank_index=d.get("rank"),
+                    status=d.get("status", NodeStatus.INITIAL),
+                )
+                node.exit_reason = d.get("exit_reason", "")
+                node.host = d.get("host", "")
+                node.agent_port = int(d.get("agent_port", 0))
+                node.slice_id = d.get("slice_id", "")
+                node.host_id = d.get("host_id", "")
+                self._nodes[nid] = node
+            self._node_meta = {
+                int(nid): dict(meta_)
+                for nid, meta_ in state.get("meta", {}).items()
+            }
+
+    def rearm_heartbeats(self) -> None:
+        """Takeover re-arm: running nodes get a fresh heartbeat stamp so
+        the liveness monitor doesn't declare the whole fleet dead for
+        silence that happened on the dead PRIMARY's watch."""
+        with self._lock:
+            for node in self._nodes.values():
+                if node.status == NodeStatus.RUNNING:
+                    node.update_heartbeat()
 
     # -- registration ------------------------------------------------------
     def register_node_meta(self, meta: m.NodeMeta) -> None:
@@ -63,6 +116,14 @@ class LocalJobManager:
                 "local_world_size": meta.local_world_size,
                 "tpu_chips": meta.tpu_chips,
             }
+            self._jrec(
+                "node.meta", node_type=meta.node_type,
+                node_id=meta.node_id, node_rank=meta.node_rank,
+                host=meta.host, agent_port=meta.agent_port,
+                slice_id=meta.slice_id, host_id=meta.host_id,
+                tpu_chips=meta.tpu_chips,
+                local_world_size=meta.local_world_size,
+            )
             logger.info(
                 "registered node %d (%s) at %s slice=%s",
                 meta.node_id, meta.node_type, meta.host, meta.slice_id,
@@ -95,9 +156,15 @@ class LocalJobManager:
             if node is None:
                 node = Node(node_type or NodeType.WORKER, node_id)
                 self._nodes[node_id] = node
+            prev = node.status
             node.update_status(status)
             if exit_reason:
                 node.exit_reason = exit_reason
+            if node.status != prev:
+                self._jrec(
+                    "node.status", node_id=node_id, node_type=node.type,
+                    status=node.status, exit_reason=exit_reason,
+                )
 
     def collect_heartbeat(self, node_id: int, ts: float) -> None:
         with self._lock:

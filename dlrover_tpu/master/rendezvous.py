@@ -23,6 +23,7 @@ Round protocol (mirrors reference ``join_rendezvous :255`` /
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -30,10 +31,11 @@ from typing import Dict, List, Optional, Tuple
 from dlrover_tpu import chaos
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.state import JournalBound
 from dlrover_tpu.master.topology import DpTopologySorter, NodeTopologyMeta
 
 
-class RendezvousManager:
+class RendezvousManager(JournalBound):
     def __init__(self, name: str):
         self.name = name
         self._lock = threading.Lock()
@@ -55,6 +57,7 @@ class RendezvousManager:
         self._start_waiting_time = 0.0
         self._alive_nodes: set = set()
         self._sorter = DpTopologySorter()
+        self._ckpt_steps: Dict[int, int] = {}
 
     # -- config ------------------------------------------------------------
     def update_rdzv_params(
@@ -74,9 +77,15 @@ class RendezvousManager:
 
     def remove_alive_node(self, node_id: int) -> None:
         with self._lock:
+            was_known = (
+                node_id in self._alive_nodes
+                or node_id in self._waiting_nodes
+            )
             self._alive_nodes.discard(node_id)
             if node_id in self._waiting_nodes:
                 del self._waiting_nodes[node_id]
+            if was_known:
+                self._jrec("rdzv.remove", name=self.name, node_id=node_id)
 
     def alive_nodes(self) -> list:
         with self._lock:
@@ -151,6 +160,12 @@ class RendezvousManager:
                 "attempt_id": attempt_id,
             }
             self._alive_nodes.add(node_id)
+            self._jrec(
+                "rdzv.join", name=self.name, node_id=node_id,
+                node_rank=node_rank, local_world_size=local_world_size,
+                host=host, coordinator_port=coordinator_port,
+                slice_id=slice_id, host_id=host_id, attempt_id=attempt_id,
+            )
             self._lastcall_time = time.monotonic()
             if not self._start_waiting_time:
                 self._start_waiting_time = self._lastcall_time
@@ -173,16 +188,33 @@ class RendezvousManager:
         if usable < self._min_nodes:
             return
         ordered = self._sorter.sort(self._waiting_nodes)[:usable]
-        self._rdzv_nodes = {m.node_id: m for m in ordered}
-        for nid in list(self._rdzv_nodes):
-            del self._waiting_nodes[nid]
-        self._latched_round = self._rdzv_round
-        self._rdzv_round += 1
         # graftcheck: disable=CC101 -- caller holds self._lock: the
         # _locked suffix is this file's lock-transfer contract (every
         # call site is inside `with self._lock:`)
+        self._rdzv_nodes = {m.node_id: m for m in ordered}
+        for nid in list(self._rdzv_nodes):
+            del self._waiting_nodes[nid]
+        # graftcheck: disable=CC101 -- same _locked contract as above
+        self._latched_round = self._rdzv_round
+        # graftcheck: disable=CC101 -- same _locked contract as above
+        self._rdzv_round += 1
+        # graftcheck: disable=CC101 -- same _locked contract as above
         self._start_waiting_time = 0.0
+        # graftcheck: disable=CC101 -- same _locked contract as above
         self._latched_world = self._build_world_locked(ordered)
+        # The completion DECISION is wall-clock (lastcall quiescence), so
+        # replay cannot re-derive it; the RESULT is journaled as a state
+        # record a standby applies verbatim (rdzv.world).
+        self._jrec(
+            "rdzv.world", name=self.name,
+            latched_round=self._latched_round,
+            rdzv_round=self._rdzv_round,
+            nodes={
+                m.node_id: dataclasses.asdict(m)
+                for m in self._rdzv_nodes.values()
+            },
+            world=dict(self._latched_world),
+        )
         logger.info(
             "rdzv[%s]: round %d complete with %d nodes (left waiting: %d)",
             self.name, self._latched_round, usable, len(self._waiting_nodes),
@@ -260,8 +292,9 @@ class RendezvousManager:
     def sync_ckpt_nodes(self, node_id: int, step: int) -> bool:
         """True once every node of the current world reported ``step``."""
         with self._lock:
-            if not hasattr(self, "_ckpt_steps"):
-                self._ckpt_steps: Dict[int, int] = {}
+            if self._ckpt_steps.get(node_id) != step:
+                self._jrec("rdzv.ckpt_vote", name=self.name,
+                           node_id=node_id, step=step)
             self._ckpt_steps[node_id] = step
             world = set(self._rdzv_nodes.keys())
             if not world:
@@ -269,6 +302,87 @@ class RendezvousManager:
             return all(
                 self._ckpt_steps.get(nid) == step for nid in world
             )
+
+    # -- HA snapshot / replay surface (ISSUE 13) ----------------------------
+    def restore_world(self, rec: dict) -> None:
+        """Apply a journaled ``rdzv.world`` record: the latched world of
+        a completed round, including removing its members from the
+        waiting set (the completion already consumed them)."""
+        with self._lock:
+            nodes = {
+                int(nid): NodeTopologyMeta(**meta)
+                for nid, meta in (rec.get("nodes") or {}).items()
+            }
+            self._rdzv_nodes = nodes
+            self._latched_world = {
+                int(r): dict(info)
+                for r, info in (rec.get("world") or {}).items()
+            }
+            self._latched_round = int(rec.get("latched_round", -1))
+            self._rdzv_round = int(rec.get("rdzv_round", 0))
+            for nid in nodes:
+                self._waiting_nodes.pop(nid, None)
+                self._alive_nodes.add(nid)
+            self._start_waiting_time = 0.0
+
+    def dump_state(self) -> dict:
+        with self._lock:
+            return {
+                "waiting": {
+                    nid: dataclasses.asdict(m)
+                    for nid, m in self._waiting_nodes.items()
+                },
+                "extra": {
+                    nid: dict(e) for nid, e in self._node_extra.items()
+                },
+                "rdzv_nodes": {
+                    nid: dataclasses.asdict(m)
+                    for nid, m in self._rdzv_nodes.items()
+                },
+                "world": dict(self._latched_world),
+                "latched_round": self._latched_round,
+                "rdzv_round": self._rdzv_round,
+                "alive": sorted(self._alive_nodes),
+                "ckpt_steps": dict(self._ckpt_steps),
+            }
+
+    def load_state(self, state: dict) -> None:
+        with self._lock:
+            self._waiting_nodes = {
+                int(nid): NodeTopologyMeta(**m)
+                for nid, m in state.get("waiting", {}).items()
+            }
+            self._node_extra = {
+                int(nid): dict(e)
+                for nid, e in state.get("extra", {}).items()
+            }
+            self._rdzv_nodes = {
+                int(nid): NodeTopologyMeta(**m)
+                for nid, m in state.get("rdzv_nodes", {}).items()
+            }
+            self._latched_world = {
+                int(r): dict(info)
+                for r, info in state.get("world", {}).items()
+            }
+            self._latched_round = int(state.get("latched_round", -1))
+            self._rdzv_round = int(state.get("rdzv_round", 0))
+            self._alive_nodes = set(state.get("alive", []))
+            self._ckpt_steps = {
+                int(nid): int(s)
+                for nid, s in state.get("ckpt_steps", {}).items()
+            }
+
+    def rearm_clocks(self) -> None:
+        """Takeover re-arm: restart the lastcall / pending windows on
+        this process's clock so a replayed waiting set neither completes
+        instantly nor reads as timed out."""
+        with self._lock:
+            now = time.monotonic()
+            if self._waiting_nodes:
+                self._lastcall_time = now
+                self._start_waiting_time = now
+            else:
+                self._start_waiting_time = 0.0
 
 
 class ElasticTrainingRendezvousManager(RendezvousManager):
@@ -294,6 +408,28 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         # check round -> node_id -> (succeeded, elapsed)
         self._results: Dict[int, Dict[int, Tuple[bool, float]]] = {}
         self._check_round = 0
+
+    def dump_state(self) -> dict:
+        state = super().dump_state()
+        with self._lock:
+            state["results"] = {
+                r: {nid: list(v) for nid, v in by_node.items()}
+                for r, by_node in self._results.items()
+            }
+            state["check_round"] = self._check_round
+        return state
+
+    def load_state(self, state: dict) -> None:
+        super().load_state(state)
+        with self._lock:
+            self._results = {
+                int(r): {
+                    int(nid): (bool(v[0]), float(v[1]))
+                    for nid, v in by_node.items()
+                }
+                for r, by_node in state.get("results", {}).items()
+            }
+            self._check_round = int(state.get("check_round", 0))
 
     def get_comm_world(
         self, node_id: int

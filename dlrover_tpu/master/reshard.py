@@ -24,6 +24,7 @@ from typing import Dict, Optional
 from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.state import JournalBound
 from dlrover_tpu.obs import journal
 
 IDLE = "idle"
@@ -32,7 +33,7 @@ DONE = "done"
 ABORTED = "aborted"
 
 
-class ReshardManager:
+class ReshardManager(JournalBound):
     """Resize-epoch state machine (one live resize in flight at a time)."""
 
     def __init__(self, clock=time.monotonic):
@@ -50,6 +51,56 @@ class ReshardManager:
         # whose training loop never wired poll_reshard must not pay the
         # announce deadline on every resize.
         self._last_poll = float("-inf")
+        self._deadline_budget = 0.0  # last announce's budget (for re-arm)
+
+    # -- HA snapshot surface (ISSUE 13) --------------------------------------
+    def dump_state(self) -> dict:
+        with self._lock:
+            return {
+                "epoch": self._epoch,
+                "status": self._status,
+                "target_num": self._target_num,
+                "target_spec": dict(self._target_spec),
+                "expected": self._expected,
+                "deadline_budget": self._deadline_budget,
+                "reports": {
+                    nid: {"ok": r.ok, "reason": r.reason}
+                    for nid, r in self._reports.items()
+                },
+            }
+
+    def load_state(self, state: dict) -> None:
+        with self._lock:
+            self._epoch = int(state.get("epoch", 0))
+            self._status = state.get("status", IDLE)
+            self._target_num = int(state.get("target_num", 0))
+            self._target_spec = dict(state.get("target_spec", {}))
+            self._expected = int(state.get("expected", 0))
+            self._deadline_budget = float(state.get("deadline_budget", 0.0))
+            self._reports = {
+                int(nid): m.ReshardReport(
+                    node_id=int(nid), epoch=self._epoch,
+                    ok=bool(r.get("ok")), reason=r.get("reason", ""),
+                )
+                for nid, r in state.get("reports", {}).items()
+            }
+            if self._status == PREPARING:
+                # Loaded deadline is another incarnation's clock; arm a
+                # fresh full budget here, refined by rearm_deadline().
+                budget = self._deadline_budget or \
+                    get_context().reshard_deadline_s
+                self._deadline = self._clock() + budget
+
+    def rearm_deadline(self) -> None:
+        """Takeover re-arm: a PREPARING epoch gets a fresh full budget on
+        this process's clock — workers either report within it (DONE) or
+        the epoch aborts cleanly to the restart ladder.  The inherited
+        deadline would lapse instantly (or never)."""
+        with self._lock:
+            if self._status != PREPARING:
+                return
+            budget = self._deadline_budget or get_context().reshard_deadline_s
+            self._deadline = self._clock() + budget
 
     def has_observers(self, window_s: float = 30.0) -> bool:
         """True when a worker polled the resize epoch within
@@ -85,6 +136,12 @@ class ReshardManager:
                 ctx.reshard_deadline_s if deadline_s is None else deadline_s
             )
             self._deadline = self._clock() + budget
+            self._deadline_budget = budget
+            self._jrec(
+                "reshard.announce", epoch=self._epoch,
+                target=self._target_num, spec=dict(self._target_spec),
+                expected=self._expected, deadline_s=budget,
+            )
             logger.info(
                 "reshard: announcing epoch %d -> %d processes (spec=%s, "
                 "deadline %.0fs)",
@@ -103,6 +160,8 @@ class ReshardManager:
                     "checkpoint-restart ladder", self._epoch, reason,
                 )
                 self._status = ABORTED
+                self._jrec("reshard.abort", epoch=self._epoch,
+                           reason=reason[:200])
                 journal("reshard.epoch", epoch=self._epoch,
                         status=ABORTED, reason=reason[:200])
 
@@ -129,6 +188,10 @@ class ReshardManager:
                     reason=f"stale epoch {msg.epoch} (current {self._epoch})",
                 )
             self._reports[msg.node_id] = msg
+            self._jrec(
+                "reshard.report", epoch=msg.epoch, node_id=msg.node_id,
+                ok=msg.ok, reason=msg.reason[:200],
+            )
             if not msg.ok:
                 logger.warning(
                     "reshard: node %d failed epoch %d: %s",
@@ -178,6 +241,8 @@ class ReshardManager:
                 self._expected,
             )
             self._status = ABORTED
+            self._jrec("reshard.abort", epoch=self._epoch,
+                       reason="deadline lapsed")
             journal("reshard.epoch", epoch=self._epoch,
                     status=ABORTED, reason="deadline lapsed")
 

@@ -84,7 +84,9 @@ class MasterServicer:
             m.JobExitRequest: self._on_job_exit,
             m.ReshardEpochRequest: self._on_reshard_epoch,
             m.ReshardReport: self._on_reshard_report,
+            m.ReshardAnnounce: self._on_reshard_announce,
             m.FleetStatsRequest: self._on_fleet_stats,
+            m.JournalFetch: self._on_journal_fetch,
         }
 
     def __call__(self, msg: m.Message) -> Optional[m.Message]:
@@ -198,7 +200,9 @@ class MasterServicer:
         from dlrover_tpu.master.dataset_splitter import new_dataset_splitter
 
         if not self.task_manager.has_dataset(msg.dataset_name):
-            splitter = new_dataset_splitter(
+            # params double as the journal record / snapshot form: the
+            # standby recreates the splitter from exactly these kwargs.
+            params = dict(
                 dataset_name=msg.dataset_name,
                 dataset_size=msg.dataset_size,
                 shard_size=msg.shard_size,
@@ -206,7 +210,9 @@ class MasterServicer:
                 shuffle=msg.shuffle,
                 storage_type=msg.storage_type,
             )
-            self.task_manager.new_dataset(splitter)
+            self.task_manager.new_dataset(
+                new_dataset_splitter(**params), params=params
+            )
         return None
 
     def _on_task_request(self, msg: m.TaskRequest):
@@ -370,6 +376,54 @@ class MasterServicer:
                 success=False, reason="no reshard manager on this master"
             )
         return self.reshard_manager.report(msg)
+
+    def _on_reshard_announce(self, msg: m.ReshardAnnounce):
+        """Operator/admin resize request (ISSUE 13): announce a live
+        resize epoch from outside the master process."""
+        if self.reshard_manager is None:
+            return m.ReshardEpochInfo()
+        self.reshard_manager.announce(
+            msg.target_num_processes,
+            msg.target_spec,
+            expected_reports=msg.expected_reports,
+            deadline_s=msg.deadline_s or None,
+        )
+        return self.reshard_manager.info()
+
+    # -- master HA (ISSUE 13) ------------------------------------------------
+    def _on_journal_fetch(self, msg: m.JournalFetch):
+        """Streaming replication: serve raw control-state WAL (or
+        snapshot, ``offset=-1``) bytes to a tailing standby."""
+        import os
+
+        journal = getattr(self.job_context, "_ha_journal", None)
+        if journal is None:
+            return m.JournalChunk(found=False)
+        from dlrover_tpu.master import state as ha_state
+
+        if msg.offset < 0:
+            snap = os.path.join(journal.state_dir, ha_state.SNAP_NAME)
+            try:
+                with open(snap, "rb") as f:
+                    data = f.read()
+            except OSError:
+                data = b""
+            return m.JournalChunk(data=data, offset=-1, eof=True)
+        wal = os.path.join(journal.state_dir, ha_state.WAL_NAME)
+        try:
+            with open(wal, "rb") as f:
+                # size + inode from the SAME open fd as the data read:
+                # a compaction's os.replace between a getsize and the
+                # open would otherwise mix old metadata with new bytes.
+                st = os.fstat(f.fileno())
+                f.seek(msg.offset)
+                data = f.read(max(0, min(msg.max_bytes, 16 << 20)))
+        except OSError:
+            return m.JournalChunk(offset=msg.offset, eof=True)
+        return m.JournalChunk(
+            data=data, offset=msg.offset, eof=not data,
+            wal_size=st.st_size, wal_ino=st.st_ino,
+        )
 
     # -- fleet control plane (ISSUE 10) -------------------------------------
     def _on_fleet_stats(self, msg: m.FleetStatsRequest):

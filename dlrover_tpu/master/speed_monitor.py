@@ -15,9 +15,10 @@ from typing import Deque, Dict, List, Optional, Tuple
 from collections import deque
 
 from dlrover_tpu.common.global_context import get_context
+from dlrover_tpu.master.state import JournalBound
 
 
-class SpeedMonitor:
+class SpeedMonitor(JournalBound):
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._ctx = get_context()
@@ -52,6 +53,11 @@ class SpeedMonitor:
         # masquerades as the fleet's).
         self._ckpt_agg_by_node: Dict[int, float] = {}
         self._ckpt_skipped_by_node: Dict[int, int] = {}
+        # Master HA (ISSUE 13): step reports are gauges, so only a
+        # throttled BASELINE is journaled — enough for goodput/progress
+        # accounting to survive a failover without paying an fsync per
+        # step report.
+        self._last_step_journal = float("-inf")  # monotonic, own clock
 
     def collect_global_step(self, step: int, timestamp: float = 0.0) -> None:
         ts = timestamp or time.time()
@@ -64,9 +70,42 @@ class SpeedMonitor:
                 self._first_step_time = ts
             self._last_step_time = ts
             self._sample_count += 1
+            if self._journal is not None:
+                now = time.monotonic()
+                if now - self._last_step_journal >= \
+                        self._ctx.ha_speed_journal_s:
+                    self._last_step_journal = now
+                    self._journal.append(
+                        "speed.step", {"step": step, "ts": ts}
+                    )
             if self._down_since is not None:
                 self._downtime_total += ts - self._down_since  # graftcheck: disable=OB301 -- step ts is the WORKER's wall stamp; wall is the shared timeline
                 self._down_since = None
+
+    # -- HA snapshot surface (ISSUE 13) ---------------------------------
+    def dump_state(self) -> dict:
+        with self._lock:
+            return {
+                "global_step": self._global_step,
+                "records": [list(r) for r in self._records],
+                "first_step_time": self._first_step_time,
+                "last_step_time": self._last_step_time,
+                "downtime_total": self._downtime_total,
+                "ckpt_stall_total": self._ckpt_stall_total,
+            }
+
+    def load_state(self, state: dict) -> None:
+        with self._lock:
+            self._global_step = int(state.get("global_step", 0))
+            self._records.clear()
+            for ts, step in state.get("records", []):
+                self._records.append((float(ts), int(step)))
+            self._first_step_time = state.get("first_step_time")
+            self._last_step_time = state.get("last_step_time")
+            self._downtime_total = float(state.get("downtime_total", 0.0))
+            self._ckpt_stall_total = float(
+                state.get("ckpt_stall_total", 0.0)
+            )
 
     def mark_down(self) -> None:
         """Called when the job manager knows training paused (restart,

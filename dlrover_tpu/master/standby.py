@@ -1,0 +1,387 @@
+"""Warm-standby master: tail the control-state journal, take over hot.
+
+ISSUE 13's takeover half.  A :class:`StandbyMaster` builds the same
+manager set as the primary (server bound but NOT serving), bootstraps
+from the snapshot + WAL, then tails the journal applying new records as
+they land.  Leadership is a READER-side lease (the PR-9 registry idiom):
+the primary is alive while the journal or its lease file keeps CHANGING,
+observed on the standby's OWN clock — writer and reader wall clocks are
+never compared.  On primary silence past ``ha_lease_s`` (confirmed by a
+TCP probe when the primary's address is known — a stalled shared
+filesystem must not trigger a split-brain takeover while the primary
+still answers), the standby:
+
+1. opens the journal as the next writer generation (torn tail truncated,
+   exactly the unacked record lost),
+2. replays any records its tail had not yet seen,
+3. re-arms every clock-bearing state (task timeouts, reshard deadline,
+   heartbeats, rendezvous windows) on its own clock,
+4. binds the journal, starts serving, and publishes its address in the
+   state dir — clients with the state-dir resolve hook re-home on their
+   next transport failure.
+
+The PR-2 idempotency tokens + ``BoundedTokenCache`` (replayed into the
+standby) make RPCs retried across the blackout exactly-once: a task
+fetch or kv add whose ack died with the primary returns its FIRST result
+from the replayed dedupe cache.
+
+Journal transport is a shared directory by default; where the dirs are
+not shared, :class:`RpcJournalSource` mirrors the primary's snapshot +
+WAL bytes over the ``JournalFetch`` RPC into a local dir the standby
+tails identically.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.global_context import get_context
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import addr_connectable
+from dlrover_tpu.master.master import LocalJobMaster
+from dlrover_tpu.master.state import (
+    SNAP_NAME,
+    WAL_NAME,
+    ControlStateJournal,
+    JournalKeeper,
+    JournalTail,
+    MasterState,
+    _atomic_write,
+    read_addr,
+    read_lease,
+    read_state_dir,
+    recover_into,
+)
+
+
+class RpcJournalSource:
+    """Streaming replication: mirror the primary's snapshot + WAL into a
+    local dir over ``JournalFetch`` RPCs.  The mirror is byte-for-byte,
+    so the standby's :class:`JournalTail` consumes it unchanged.
+
+    A primary-side WAL compaction shrinks the remote file below the
+    mirrored offset; the chunk's ``wal_size`` exposes that, and the
+    mirror REBUILDS: re-fetch the snapshot, atomically replace the
+    local WAL with the remote's compacted bytes (the tail detects the
+    inode swap and dedupes by seq — records already applied are
+    skipped, records the compaction dropped live in state already).
+    """
+
+    def __init__(self, transport, dest_dir: str):
+        from dlrover_tpu.common import messages as m
+
+        self._m = m
+        self._transport = transport  # .call(msg) -> reply (RpcClient shape)
+        self.dest_dir = dest_dir
+        os.makedirs(dest_dir, exist_ok=True)
+        self._wal_path = os.path.join(dest_dir, WAL_NAME)
+        self._offset = 0
+        self._remote_ino = 0  # remote WAL identity; change = compaction
+        if os.path.exists(self._wal_path):
+            self._offset = os.path.getsize(self._wal_path)
+        self.fetch_snapshot()
+
+    def fetch_snapshot(self) -> bool:
+        try:
+            chunk = self._transport.call(self._m.JournalFetch(offset=-1))
+        except Exception as e:  # noqa: BLE001 - source may be dying
+            logger.debug("journal source: snapshot fetch failed: %s", e)
+            return False
+        if not getattr(chunk, "found", False) or not chunk.data:
+            return False
+        _atomic_write(os.path.join(self.dest_dir, SNAP_NAME), chunk.data)
+        return True
+
+    def sync(self) -> int:
+        """Pull new WAL bytes; returns how many were appended."""
+        total = 0
+        while True:
+            try:
+                chunk = self._transport.call(
+                    self._m.JournalFetch(offset=self._offset)
+                )
+            except Exception as e:  # noqa: BLE001 - primary dying is the point
+                logger.debug("journal source: wal fetch failed: %s", e)
+                return total
+            if not getattr(chunk, "found", False):
+                return total
+            wal_size = getattr(chunk, "wal_size", -1)
+            wal_ino = getattr(chunk, "wal_ino", 0)
+            swapped = (
+                self._remote_ino and wal_ino
+                and wal_ino != self._remote_ino
+            )
+            if swapped or 0 <= wal_size < self._offset:
+                # The primary compacted (atomic-replaced) its WAL under
+                # us — detected by the inode change even when the new
+                # file is LARGER than our offset (appending new-inode
+                # bytes at an old-inode offset would corrupt the mirror
+                # mid-file).  Rebuild from the compacted file (snapshot
+                # first, so a fresh bootstrap of this dir stays
+                # complete).
+                self.fetch_snapshot()
+                rebuilt = self._rebuild_wal()
+                if rebuilt == 0:
+                    return total  # rebuild failed; retry next sync
+                total += rebuilt
+                continue
+            if wal_ino:
+                self._remote_ino = wal_ino
+            if not chunk.data:
+                return total
+            with open(self._wal_path, "ab") as f:
+                f.write(chunk.data)
+            self._offset += len(chunk.data)
+            total += len(chunk.data)
+            if chunk.eof:
+                return total
+
+    def _rebuild_wal(self) -> int:
+        """Replace the local WAL with the remote's (compacted) bytes.
+        Atomic rename: a tailing JournalTail sees the inode swap,
+        reopens, and seq-dedupes records it already applied."""
+        blob = b""
+        offset = 0
+        while True:
+            try:
+                chunk = self._transport.call(
+                    self._m.JournalFetch(offset=offset)
+                )
+            except Exception as e:  # noqa: BLE001 - primary may be dying
+                logger.debug("journal source: rebuild fetch failed: %s", e)
+                return 0
+            if not getattr(chunk, "found", False):
+                return 0
+            blob += chunk.data
+            offset += len(chunk.data)
+            self._remote_ino = getattr(chunk, "wal_ino", 0)
+            if chunk.eof or not chunk.data:
+                break
+        _atomic_write(self._wal_path, blob)
+        self._offset = len(blob)
+        logger.info(
+            "journal source: mirror rebuilt after primary compaction "
+            "(%d bytes)", len(blob),
+        )
+        return len(blob)
+
+
+class StandbyMaster:
+    """A warm standby for a local/process-platform master."""
+
+    def __init__(
+        self,
+        state_dir: str,
+        *,
+        port: int = 0,
+        primary_addr: str = "",
+        job_name: str = "local-job",
+        min_nodes: int = 1,
+        max_nodes: int = 1,
+        node_unit: int = 1,
+        network_check: bool = False,
+        lease_s: Optional[float] = None,
+        tail_poll_s: Optional[float] = None,
+        rpc_source: Optional[RpcJournalSource] = None,
+        run_config: Optional[dict] = None,
+    ):
+        ctx = get_context()
+        self.state_dir = state_dir
+        self.lease_s = ctx.ha_lease_s if lease_s is None else lease_s
+        self.tail_poll_s = (
+            ctx.ha_tail_poll_s if tail_poll_s is None else tail_poll_s
+        )
+        self.primary_addr = primary_addr or read_addr(state_dir)
+        self._rpc_source = rpc_source
+        # Same composition as the primary; the RPC port is BOUND here
+        # (launchers can advertise the standby address up front) but not
+        # served until takeover.  No state_dir yet: the standby must not
+        # write the journal while the primary owns it.
+        self.master = LocalJobMaster(
+            port,
+            job_name=job_name,
+            min_nodes=min_nodes,
+            max_nodes=max_nodes,
+            node_unit=node_unit,
+            network_check=network_check,
+            run_config=run_config,
+        )
+        self.state = MasterState.of_master(self.master)
+        contents = read_state_dir(state_dir)
+        _, divergences = recover_into(self.state, contents)
+        for div in divergences:
+            logger.warning("standby bootstrap divergence: %s", div)
+        self.records_applied = len(contents.records)
+        self._tail = JournalTail(state_dir, from_seq=contents.last_seq)
+        self._last_lease = read_lease(state_dir)
+        self._last_change = time.monotonic()
+        self._stop = threading.Event()
+        self._took_over = threading.Event()
+        self.takeover_s = 0.0  # silence-declared -> serving
+        logger.info(
+            "standby master bound on %s tailing %s (%d records warm, "
+            "lease %.1fs)",
+            self.addr, state_dir, self.records_applied, self.lease_s,
+        )
+
+    @property
+    def addr(self) -> str:
+        return self.master.addr
+
+    @property
+    def port(self) -> int:
+        return self.master.port
+
+    def took_over(self) -> bool:
+        return self._took_over.is_set()
+
+    def rebootstrap(self) -> None:
+        """Rebuild the warm state from snapshot + WAL (full restore —
+        the snapshot replaces manager state wholesale, replay is
+        idempotent).  Used when the tail detected a compaction gap."""
+        contents = read_state_dir(self.state_dir)
+        _, divergences = recover_into(self.state, contents)
+        for div in divergences:
+            logger.warning("standby rebootstrap divergence: %s", div)
+        self.records_applied = len(contents.records)
+        self._tail.last_seq = max(self._tail.last_seq, contents.last_seq)
+        self._tail.gap = False
+        self._last_change = time.monotonic()
+        logger.info(
+            "standby: re-bootstrapped from snapshot seq=%d + %d records "
+            "(compaction outran the tail)",
+            contents.snap_seq, len(contents.records),
+        )
+
+    def wait_takeover(self, timeout: float) -> bool:
+        return self._took_over.wait(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._took_over.is_set():
+            self.master.request_stop(True, "standby stopped")
+            self.master.stop()
+
+    # -- the watch loop ----------------------------------------------------
+    def watch(self) -> bool:
+        """Tail until the primary goes silent (-> take over, True) or
+        :meth:`stop` is called (False)."""
+        while not self._stop.wait(self.tail_poll_s):
+            if self._rpc_source is not None:
+                self._rpc_source.sync()
+            recs = self._tail.poll()
+            if self._tail.gap:
+                # A compaction outran this tail: records between our
+                # position and the snapshot label were dropped from the
+                # WAL before we read them.  They live in the snapshot —
+                # re-bootstrap from the dir instead of applying a tail
+                # with a hole in it.
+                self.rebootstrap()
+                continue
+            if recs:
+                if any(r.get("k") == "ha.shutdown" for r in recs):
+                    # Clean end of the job: the primary stopped on
+                    # purpose.  Adopting a finished master's state
+                    # would resurrect a dead job — stand down.
+                    logger.info(
+                        "standby: primary shut down cleanly; standing "
+                        "down without takeover"
+                    )
+                    return False
+                for div in self.state.replay(recs):
+                    logger.warning("standby tail divergence: %s", div)
+                self.records_applied += len(recs)
+                self._last_change = time.monotonic()
+                continue
+            lease = read_lease(self.state_dir)
+            if lease != self._last_lease:
+                self._last_lease = lease
+                self._last_change = time.monotonic()
+                continue
+            if time.monotonic() - self._last_change < self.lease_s:
+                continue
+            if self.primary_addr and \
+                    addr_connectable(self.primary_addr, timeout=0.5):
+                # Journal silent but the primary still answers TCP: a
+                # stalled shared filesystem must not cause a split-brain
+                # takeover.  Keep waiting (and keep probing).
+                self._last_change = time.monotonic()
+                logger.warning(
+                    "standby: journal silent %.1fs but primary %s still "
+                    "connectable; holding", self.lease_s, self.primary_addr,
+                )
+                continue
+            self.take_over("primary silent")
+            return True
+        return False
+
+    def take_over(self, reason: str = "") -> None:
+        """Adopt the journaled state and serve."""
+        t0 = time.monotonic()
+        ctx = get_context()
+        journal = ControlStateJournal(
+            self.state_dir, snapshot_every=ctx.ha_snapshot_every,
+        )
+        missed = [
+            r for r in journal.recovered.records
+            if int(r.get("s", 0)) > self._tail.last_seq
+        ]
+        first_missed = int(missed[0].get("s", 0)) if missed else None
+        if self._tail.gap or (
+            first_missed is not None
+            and first_missed > self._tail.last_seq + 1
+        ):
+            # A compaction between our last poll and the takeover left
+            # a hole in the tail; adopt the FULL snapshot + records.
+            if journal.recovered.snapshot is not None:
+                self.state.restore(journal.recovered.snapshot)
+            missed = journal.recovered.records
+        divergences = self.state.replay(missed)
+        for div in divergences:
+            logger.warning("standby takeover divergence: %s", div)
+        self.records_applied += len(missed)
+        journal.drop_recovered()
+        self._tail.close()
+        self.state.rearm()
+        self.state.bind(journal)
+        master = self.master
+        master.state_dir = self.state_dir
+        master._ha_journal = journal
+        master._ha_state = self.state
+        master._ha_keeper = JournalKeeper(
+            journal, self.state, lease_interval_s=ctx.ha_lease_interval_s
+        )
+        journal.append(
+            "ha.takeover",
+            {"reason": reason, "addr": master.addr,
+             "records": self.records_applied},
+        )
+        master.prepare()  # serves + publishes addr + starts the keeper
+        self.takeover_s = time.monotonic() - t0
+        self._took_over.set()
+        try:
+            from dlrover_tpu.obs import journal as obs_journal
+
+            obs_journal(
+                "ha.takeover", reason=reason, addr=master.addr,
+                generation=journal.generation,
+                records_replayed=self.records_applied,
+                takeover_ms=self.takeover_s * 1000.0,
+            )
+        except Exception:  # noqa: BLE001 - observability never blocks HA
+            logger.debug("ha.takeover obs event failed", exc_info=True)
+        logger.warning(
+            "standby TOOK OVER as generation %d on %s (%s): %d records "
+            "replayed, takeover %.0fms",
+            journal.generation, master.addr, reason or "requested",
+            self.records_applied, self.takeover_s * 1000.0,
+        )
+
+    def run(self) -> int:
+        """Watch; on takeover, run the master's loop to job completion."""
+        if not self.watch():
+            return 0
+        return self.master.run()

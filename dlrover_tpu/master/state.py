@@ -1,0 +1,904 @@
+"""Durable control-plane state: the master's write-ahead journal (ISSUE 13).
+
+Until now every master crash was a blank-state relaunch: rendezvous
+rounds, KV entries, data-shard leases, reshard epochs and speed baselines
+all evaporated, and recovery leaned on agents re-seeding the replacement
+(re-join loops, task re-dispatch).  This module is the replicated-state-
+machine recipe (journal mutations, replay on takeover — log + snapshot)
+applied to the master:
+
+- :class:`ControlStateJournal` — an fsync'd, CRC-framed append log with
+  periodic snapshots and bounded WAL compaction.  Every mutating servicer
+  path appends **before acking**, so an acked write is durable by
+  contract.  Frame: ``u32 len | u32 crc32(payload) | payload`` where the
+  payload is msgpack ``{"s": seq, "g": generation, "t": wall, "k": kind,
+  "d": fields}``.  A torn tail (crash mid-append) is truncated away at
+  the next writer open — exactly the unacked record is lost.
+- :class:`MasterState` — the manager set the journal protects, with
+  ``capture()`` (full-state snapshot), ``restore()`` and ``apply()``
+  (replay one record through the REAL manager methods).
+- :class:`JournalTail` — incremental reader for the warm standby
+  (shared-dir mode; the ``JournalFetch`` RPC streams the same bytes).
+
+Record kinds (the journal's schema):
+
+==================  ====================================================
+``kv.set/multi_set  KVStoreService mutations (``kv.add`` carries the
+/add/delete/clear`` token + result so replay reproduces the dedupe cache)
+``task.dataset``    dataset registration (splitter params)
+``task.grant``      one task dispatched (dataset, worker, token, task_id)
+``task.report``     task result (success/failure requeue)
+``task.recover``    dead worker's doing set re-queued
+``task.requeue``    timeout reassignment (explicit ids — replay must not
+                    depend on the primary's clock)
+``task.restore``    dataset cursor restored from a shard checkpoint
+``rdzv.join``       one node entered the waiting set
+``rdzv.remove``     node removed (death)
+``rdzv.world``      a round completed: the latched world, journaled as a
+                    STATE record (completion is a wall-clock decision —
+                    replay applies the result, never re-decides)
+``rdzv.ckpt_vote``  sync_ckpt_nodes vote
+``reshard.announce  resize-epoch state machine transitions
+/report/abort``
+``node.meta``       node registration (membership)
+``node.status``     node status transition
+``speed.step``      throttled global-step baseline (goodput survives)
+``ha.owner``        a new writer generation opened the journal
+``ha.takeover``     a standby adopted the state (annotation, no-op)
+==================  ====================================================
+
+Replay is **idempotent**: re-applying a record that the snapshot already
+reflects is a no-op (token caches dedupe grants/adds, joins dedupe on
+attempt_id, world/status records overwrite, the reshard epoch guard skips
+stale announces).  That is what makes the snapshot boundary safe to be
+fuzzy by the in-flight append window: the snapshot is labeled with the
+sequence number read BEFORE capture starts, so every record ``<= label``
+is provably included and records after it simply re-apply.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+from dlrover_tpu import chaos
+from dlrover_tpu.common.log import logger
+
+WAL_MAGIC = b"DLRTPUW1"
+SNAP_MAGIC = b"DLRTPUS1"
+_FRAME_HDR = struct.Struct("<II")  # payload len, payload crc32
+_SNAP_HDR = struct.Struct("<QI")  # payload len, payload crc32
+
+WAL_NAME = "wal.log"
+SNAP_NAME = "snap.bin"
+LEASE_NAME = "lease"
+ADDR_NAME = "addr"
+
+
+class JournalError(Exception):
+    """Structural damage in a control-state journal."""
+
+
+class JournalBound:
+    """Mixin: the manager side of the journal hook.  Managers call
+    ``self._jrec(kind, **fields)`` at each mutation — a single
+    None-check no-op until :class:`MasterState` binds a journal (and
+    again during replay, which runs unbound so applying a record never
+    re-appends it)."""
+
+    _journal: Optional["ControlStateJournal"] = None
+
+    def bind_journal(self, journal) -> None:
+        self._journal = journal
+
+    def _jrec(self, kind: str, **fields) -> None:
+        if self._journal is not None:
+            self._journal.append(kind, fields)
+
+
+def _crc32(buf: bytes) -> int:
+    import zlib
+
+    return zlib.crc32(buf) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# file helpers (addr / lease are tiny sidecar files, atomically replaced)
+# ---------------------------------------------------------------------------
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_addr(state_dir: str, addr: str) -> None:
+    """Publish the CURRENT leader's serving address.  Clients with a
+    state-dir resolve hook re-read this after transport failures — the
+    chain that keeps working across repeated failovers."""
+    _atomic_write(os.path.join(state_dir, ADDR_NAME), addr.encode())
+
+
+def read_addr(state_dir: str) -> str:
+    try:
+        with open(os.path.join(state_dir, ADDR_NAME), "rb") as f:
+            return f.read().decode().strip()
+    except OSError:
+        return ""
+
+
+def read_lease(state_dir: str) -> str:
+    """Raw lease content — liveness is observed READER-side: the content
+    CHANGING re-arms the observer's own clock; its value is never
+    compared against the reader's wall time."""
+    try:
+        with open(os.path.join(state_dir, LEASE_NAME), "rb") as f:
+            return f.read().decode(errors="replace")
+    except OSError:
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# read side
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class JournalContents:
+    """What a read of a state dir found (statecheck / standby bootstrap /
+    writer recovery all share this one scan)."""
+
+    snapshot: Optional[dict] = None  # full state dict (or None)
+    snap_seq: int = 0  # records <= this are inside the snapshot
+    snap_gen: int = 0
+    records: List[dict] = dataclasses.field(default_factory=list)
+    wal_end: int = 0  # offset of the last GOOD frame's end
+    torn_tail_bytes: int = 0  # trailing bytes truncated as a torn append
+    damage: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def last_seq(self) -> int:
+        if self.records:
+            return int(self.records[-1]["s"])
+        return self.snap_seq
+
+    @property
+    def last_gen(self) -> int:
+        gens = [int(r.get("g", 0)) for r in self.records]
+        gens.append(self.snap_gen)
+        return max(gens)
+
+
+def _read_snapshot(path: str, out: JournalContents) -> None:
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return
+    if len(blob) < len(SNAP_MAGIC) + _SNAP_HDR.size:
+        out.damage.append("snapshot: short file")
+        return
+    if blob[: len(SNAP_MAGIC)] != SNAP_MAGIC:
+        out.damage.append("snapshot: bad magic")
+        return
+    plen, crc = _SNAP_HDR.unpack_from(blob, len(SNAP_MAGIC))
+    body = blob[len(SNAP_MAGIC) + _SNAP_HDR.size:]
+    if len(body) < plen:
+        out.damage.append("snapshot: payload truncated")
+        return
+    payload = body[:plen]
+    if _crc32(payload) != crc:
+        out.damage.append("snapshot: payload CRC mismatch")
+        return
+    try:
+        snap = msgpack.unpackb(payload, raw=False, strict_map_key=False)
+    except Exception as e:  # noqa: BLE001 - classified as damage
+        out.damage.append(f"snapshot: undecodable ({type(e).__name__})")
+        return
+    out.snapshot = snap.get("state")
+    out.snap_seq = int(snap.get("seq", 0))
+    out.snap_gen = int(snap.get("gen", 0))
+
+
+def read_state_dir(state_dir: str) -> JournalContents:
+    """Scan snapshot + WAL.  A torn TAIL (incomplete or CRC-failed last
+    frame) is normal crash damage and reported via ``torn_tail_bytes``;
+    a bad frame with good frames after it is structural ``damage``."""
+    out = JournalContents()
+    _read_snapshot(os.path.join(state_dir, SNAP_NAME), out)
+    wal = os.path.join(state_dir, WAL_NAME)
+    try:
+        with open(wal, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return out
+    if len(blob) < len(WAL_MAGIC):
+        if blob:
+            out.damage.append("wal: short header")
+        return out
+    if blob[: len(WAL_MAGIC)] != WAL_MAGIC:
+        out.damage.append("wal: bad magic")
+        return out
+    off = len(WAL_MAGIC)
+    good_end = off
+    while off + _FRAME_HDR.size <= len(blob):
+        plen, crc = _FRAME_HDR.unpack_from(blob, off)
+        end = off + _FRAME_HDR.size + plen
+        if plen > (64 << 20):
+            # A bit-flipped length must classify as damage, not as a
+            # giant torn tail silently truncated away.
+            out.damage.append(f"wal: implausible frame length at {off}")
+            break
+        if end > len(blob):
+            break  # incomplete tail frame (crash mid-append)
+        payload = blob[off + _FRAME_HDR.size: end]
+        if _crc32(payload) != crc:
+            # The frame's bytes are ALL present yet the CRC fails: a
+            # crash mid-append can only leave an incomplete suffix, so
+            # this is real corruption (bit rot, concurrent writers),
+            # not a torn tail.
+            out.damage.append(f"wal: frame CRC mismatch at {off}")
+            break
+        try:
+            rec = msgpack.unpackb(payload, raw=False, strict_map_key=False)
+        except Exception as e:  # noqa: BLE001 - classified as damage
+            out.damage.append(
+                f"wal: undecodable frame at {off} ({type(e).__name__})"
+            )
+            break
+        out.records.append(rec)
+        off = end
+        good_end = end
+    out.wal_end = good_end
+    out.torn_tail_bytes = len(blob) - good_end
+    return out
+
+
+class JournalTail:
+    """Incremental WAL reader for the warm standby.  Tolerates the
+    writer's compaction (inode swap / shrink -> reopen, records deduped
+    by seq) and an in-flight append (incomplete frame -> wait)."""
+
+    def __init__(self, state_dir: str, from_seq: int = 0):
+        self._wal = os.path.join(state_dir, WAL_NAME)
+        self._f = None
+        self._ino = -1
+        self._offset = 0
+        self.last_seq = from_seq
+        #: Set when a record arrived with seq > last_seq + 1: records in
+        #: between were compacted away before this tail read them (they
+        #: live in the snapshot).  The reader must re-bootstrap from the
+        #: snapshot, not just keep applying the tail.
+        self.gap = False
+
+    def _reopen(self) -> bool:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+        try:
+            self._f = open(self._wal, "rb")
+            st = os.fstat(self._f.fileno())
+        except OSError:
+            return False
+        self._ino = st.st_ino
+        head = self._f.read(len(WAL_MAGIC))
+        if head != WAL_MAGIC:
+            self._f.close()
+            self._f = None
+            return False
+        self._offset = len(WAL_MAGIC)
+        return True
+
+    def poll(self) -> List[dict]:
+        """New complete records since the last poll (may be empty)."""
+        try:
+            st = os.stat(self._wal)
+        except OSError:
+            return []
+        if self._f is None or st.st_ino != self._ino or \
+                st.st_size < self._offset:
+            if not self._reopen():
+                return []
+        out: List[dict] = []
+        f = self._f
+        while True:
+            f.seek(self._offset)
+            hdr = f.read(_FRAME_HDR.size)
+            if len(hdr) < _FRAME_HDR.size:
+                break
+            plen, crc = _FRAME_HDR.unpack(hdr)
+            if plen > (64 << 20):
+                break  # damaged length: stop; takeover truncation decides
+            payload = f.read(plen)
+            if len(payload) < plen or _crc32(payload) != crc:
+                break  # in-flight append (or torn tail): wait
+            try:
+                rec = msgpack.unpackb(payload, raw=False,
+                                      strict_map_key=False)
+            except Exception:  # noqa: BLE001 - wait for a clean frame
+                break
+            self._offset += _FRAME_HDR.size + plen
+            seq = int(rec.get("s", 0))
+            if seq <= self.last_seq:
+                continue  # compaction replay overlap
+            if self.last_seq > 0 and seq > self.last_seq + 1:
+                self.gap = True  # compaction outran this tail
+            self.last_seq = seq
+            out.append(rec)
+        return out
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+
+# ---------------------------------------------------------------------------
+# write side
+# ---------------------------------------------------------------------------
+
+
+class ControlStateJournal:
+    """The master's fsync'd control-state WAL + snapshot writer.
+
+    Opening as writer recovers the dir: the torn tail (if any) is
+    truncated, the sequence counter resumes past the last good record,
+    and the writer claims the next ``generation`` (an ``ha.owner``
+    record marks the claim — postmortems can tell which incarnation
+    wrote what).  ``recovered`` holds what the open found so the caller
+    can replay it into the managers, then ``drop_recovered()``.
+    """
+
+    def __init__(self, state_dir: str, *, fsync: bool = True,
+                 snapshot_every: int = 1000):
+        os.makedirs(state_dir, exist_ok=True)
+        self.state_dir = state_dir
+        self._fsync = fsync
+        self._snapshot_every = max(1, int(snapshot_every))
+        self._mu = threading.Lock()
+        self._closed = False
+        self._wal_path = os.path.join(state_dir, WAL_NAME)
+        self.recovered = read_state_dir(state_dir)
+        if self.recovered.damage:
+            logger.warning(
+                "control journal %s opened with damage: %s",
+                state_dir, "; ".join(self.recovered.damage),
+            )
+        self._seq = self.recovered.last_seq
+        self.generation = self.recovered.last_gen + 1
+        self._since_snapshot = len(self.recovered.records)
+        self._lease_count = 0
+        fresh = not os.path.exists(self._wal_path)
+        if not fresh and self.recovered.wal_end < len(WAL_MAGIC):
+            # The file exists but no readable header survived (a crash
+            # between create and the magic fsync, or a mangled header).
+            # A plain truncate-to-8 would ZERO-FILL the header and make
+            # every future record unreadable; rewrite from scratch —
+            # no record was readable, so nothing real is discarded.
+            logger.warning(
+                "control journal: wal has no readable header (%d bytes); "
+                "rewriting", os.path.getsize(self._wal_path),
+            )
+            os.unlink(self._wal_path)
+            fresh = True
+        self._f = open(self._wal_path, "ab" if fresh else "r+b")
+        if fresh:
+            self._f.write(WAL_MAGIC)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        else:
+            end = self.recovered.wal_end
+            if self.recovered.torn_tail_bytes:
+                logger.warning(
+                    "control journal: truncating %d torn tail bytes "
+                    "(crash mid-append; the record was never acked)",
+                    self.recovered.torn_tail_bytes,
+                )
+            self._f.truncate(end)
+            self._f.seek(end)
+        self.append("ha.owner", {"pid": os.getpid()})
+
+    @property
+    def seq(self) -> int:
+        with self._mu:
+            return self._seq
+
+    def drop_recovered(self) -> None:
+        self.recovered = JournalContents()
+
+    def append(self, kind: str, fields: Dict[str, Any]) -> int:
+        """Durably append one record; returns its seq.  This runs BEFORE
+        the mutation is acked to the client — the durability contract.
+        A no-op (-1) once closed: teardown paths race manager threads'
+        last mutations, which must not crash on a closed file."""
+        with self._mu:
+            if self._closed:
+                return -1
+            self._seq += 1
+            payload = msgpack.packb(
+                {"s": self._seq, "g": self.generation, "t": time.time(),
+                 "k": kind, "d": fields},
+                use_bin_type=True,
+            )
+            frame = _FRAME_HDR.pack(len(payload), _crc32(payload)) + payload
+            plan = chaos.active_plan()
+            if plan is not None and plan.site_armed("master.journal_torn"):
+                # Crash-mid-append site: make the first half durable,
+                # then give the plan its chance to kill us between the
+                # halves — the literal torn-tail crash the reopen
+                # truncation must heal.
+                split = max(1, len(frame) // 2)
+                self._f.write(frame[:split])
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                chaos.inject("master.journal_torn", method=kind)
+                self._f.write(frame[split:])
+            else:
+                self._f.write(frame)
+            self._f.flush()
+            if self._fsync:
+                os.fsync(self._f.fileno())
+            self._since_snapshot += 1
+            return self._seq
+
+    # -- snapshots -----------------------------------------------------
+    def snapshot_due(self) -> bool:
+        with self._mu:
+            return self._since_snapshot >= self._snapshot_every
+
+    def maybe_snapshot(self, state_fn: Callable[[], dict]) -> bool:
+        """Snapshot + compact when due.  NEVER called from inside
+        ``append`` (appenders hold manager locks; ``state_fn`` takes
+        them) — the master's keeper thread drives this."""
+        if not self.snapshot_due():
+            return False
+        self.snapshot(state_fn)
+        return True
+
+    def snapshot(self, state_fn: Callable[[], dict]) -> int:
+        # Label = seq BEFORE capture: every record <= label finished
+        # before its manager was dumped, so it is provably inside the
+        # state; later records stay in the tail and re-apply (replay is
+        # idempotent by design).
+        with self._mu:
+            label = self._seq
+        state = state_fn()  # manager locks only — journal lock NOT held
+        payload = msgpack.packb(
+            {"seq": label, "gen": self.generation, "t": time.time(),
+             "state": state},
+            use_bin_type=True,
+        )
+        blob = SNAP_MAGIC + _SNAP_HDR.pack(len(payload), _crc32(payload)) \
+            + payload
+        _atomic_write(os.path.join(self.state_dir, SNAP_NAME), blob)
+        with self._mu:
+            self._compact_locked(label)
+            self._since_snapshot = max(0, self._seq - label)
+        try:
+            from dlrover_tpu.obs import journal as obs_journal
+
+            obs_journal("ha.snapshot", seq=label, gen=self.generation,
+                        bytes=len(blob))
+        except Exception:  # noqa: BLE001 - observability never blocks HA
+            logger.debug("ha.snapshot obs event failed", exc_info=True)
+        logger.info(
+            "control journal: snapshot at seq=%d (%d bytes), wal compacted",
+            label, len(blob),
+        )
+        return label
+
+    def _compact_locked(self, keep_after_seq: int) -> None:
+        """Rewrite the WAL keeping only frames with seq > keep_after_seq
+        (everything else is subsumed by the snapshot).  Atomic: tmp +
+        rename; tailing readers detect the inode swap and dedupe by seq.
+        """
+        # graftcheck: disable=CC101 -- caller holds self._mu: the _locked
+        # suffix is this file's lock-transfer contract
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        tmp = self._wal_path + ".compact"
+        with open(self._wal_path, "rb") as src, open(tmp, "wb") as dst:
+            dst.write(WAL_MAGIC)
+            src.seek(len(WAL_MAGIC))
+            while True:
+                hdr = src.read(_FRAME_HDR.size)
+                if len(hdr) < _FRAME_HDR.size:
+                    break
+                plen, crc = _FRAME_HDR.unpack(hdr)
+                payload = src.read(plen)
+                if len(payload) < plen or _crc32(payload) != crc:
+                    break
+                rec = msgpack.unpackb(payload, raw=False,
+                                      strict_map_key=False)
+                if int(rec.get("s", 0)) > keep_after_seq:
+                    dst.write(hdr + payload)
+            dst.flush()
+            os.fsync(dst.fileno())
+        self._f.close()
+        os.replace(tmp, self._wal_path)
+        self._f = open(self._wal_path, "r+b")
+        self._f.seek(0, os.SEEK_END)
+
+    # -- lease ---------------------------------------------------------
+    def write_lease(self) -> None:
+        """Bump the leader lease file.  Liveness is the content CHANGING
+        as observed on the reader's own clock (reader-side lease)."""
+        self._lease_count += 1
+        _atomic_write(
+            os.path.join(self.state_dir, LEASE_NAME),
+            f"{self.generation}:{self._lease_count}\n".encode(),
+        )
+
+    def close(self) -> None:
+        with self._mu:
+            self._closed = True
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except (OSError, ValueError):
+                pass
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# the state machine: capture / restore / apply
+# ---------------------------------------------------------------------------
+
+
+class MasterState:
+    """The manager set one journal protects.
+
+    ``apply`` replays a record by re-driving the REAL manager methods —
+    those are deterministic (FIFO task queues, seeded shuffles, tokened
+    dedupe) — except the rendezvous world latch, which is a wall-clock
+    decision and is therefore journaled (and applied) as a state record.
+    """
+
+    def __init__(
+        self,
+        *,
+        kv_store=None,
+        task_manager=None,
+        rdzv_managers=None,
+        reshard_manager=None,
+        job_manager=None,
+        speed_monitor=None,
+    ):
+        self.kv_store = kv_store
+        self.task_manager = task_manager
+        self.rdzv_managers = rdzv_managers or {}
+        self.reshard_manager = reshard_manager
+        self.job_manager = job_manager
+        self.speed_monitor = speed_monitor
+
+    @classmethod
+    def of_master(cls, master) -> "MasterState":
+        return cls(
+            kv_store=getattr(master, "kv_store", None),
+            task_manager=getattr(master, "task_manager", None),
+            rdzv_managers=getattr(master, "rdzv_managers", None),
+            reshard_manager=getattr(master, "reshard_manager", None),
+            job_manager=getattr(master, "job_manager", None),
+            speed_monitor=getattr(master, "speed_monitor", None),
+        )
+
+    def _managers(self):
+        out = [self.kv_store, self.task_manager, self.reshard_manager,
+               self.job_manager, self.speed_monitor]
+        out.extend(self.rdzv_managers.values())
+        return [mgr for mgr in out if mgr is not None]
+
+    def bind(self, journal: Optional[ControlStateJournal]) -> None:
+        """Attach (or detach, with None) the journal to every manager
+        that has the hook.  Replay runs UNBOUND so applying a record
+        never re-appends it."""
+        for mgr in self._managers():
+            binder = getattr(mgr, "bind_journal", None)
+            if binder is not None:
+                binder(journal)
+
+    # -- snapshot ------------------------------------------------------
+    def capture(self) -> dict:
+        state: Dict[str, Any] = {}
+        if self.kv_store is not None:
+            state["kv"] = self.kv_store.dump_state()
+        if self.task_manager is not None:
+            state["task"] = self.task_manager.dump_state()
+        if self.rdzv_managers:
+            state["rdzv"] = {
+                name: mgr.dump_state()
+                for name, mgr in self.rdzv_managers.items()
+            }
+        if self.reshard_manager is not None:
+            state["reshard"] = self.reshard_manager.dump_state()
+        if self.job_manager is not None and \
+                hasattr(self.job_manager, "dump_state"):
+            state["nodes"] = self.job_manager.dump_state()
+        if self.speed_monitor is not None:
+            state["speed"] = self.speed_monitor.dump_state()
+        return state
+
+    def restore(self, state: dict) -> None:
+        if self.kv_store is not None and "kv" in state:
+            self.kv_store.load_state(state["kv"])
+        if self.task_manager is not None and "task" in state:
+            self.task_manager.load_state(state["task"])
+        for name, sub in (state.get("rdzv") or {}).items():
+            mgr = self.rdzv_managers.get(name)
+            if mgr is not None:
+                mgr.load_state(sub)
+        if self.reshard_manager is not None and "reshard" in state:
+            self.reshard_manager.load_state(state["reshard"])
+        if self.job_manager is not None and "nodes" in state and \
+                hasattr(self.job_manager, "load_state"):
+            self.job_manager.load_state(state["nodes"])
+        if self.speed_monitor is not None and "speed" in state:
+            self.speed_monitor.load_state(state["speed"])
+
+    # -- replay --------------------------------------------------------
+    def apply(self, rec: dict) -> Optional[str]:
+        """Apply one journal record.  Returns a divergence description
+        when the replayed outcome does not match what the journal
+        promised (statecheck treats that as damage), else None."""
+        kind = rec.get("k", "")
+        d = rec.get("d", {}) or {}
+        try:
+            return self._apply(kind, d)
+        except Exception as e:  # noqa: BLE001 - replay must report, not die
+            return f"{kind}: apply raised {type(e).__name__}: {e}"
+
+    def _apply(self, kind: str, d: dict) -> Optional[str]:
+        from dlrover_tpu.common import messages as m
+
+        if kind in ("ha.owner", "ha.takeover", "ha.shutdown", "ha.lease"):
+            return None
+        if kind.startswith("kv."):
+            kv = self.kv_store
+            if kv is None:
+                return f"{kind}: no kv store to apply to"
+            if kind == "kv.set":
+                kv.set(d["key"], d["value"])
+            elif kind == "kv.multi_set":
+                kv.multi_set(d["kvs"])
+            elif kind == "kv.add":
+                got = kv.add(d["key"], d["delta"], token=d.get("token", ""))
+                want = d.get("result")
+                if want is not None and got != want:
+                    return f"kv.add {d['key']}: replayed {got}, wanted {want}"
+            elif kind == "kv.delete":
+                kv.delete(d["key"])
+            elif kind == "kv.clear":
+                kv.clear(d.get("prefix", ""))
+            else:
+                return f"unknown journal kind {kind}"
+            return None
+        if kind.startswith("task."):
+            tm = self.task_manager
+            if tm is None:
+                return f"{kind}: no task manager to apply to"
+            if kind == "task.dataset":
+                from dlrover_tpu.master.dataset_splitter import (
+                    new_dataset_splitter,
+                )
+
+                params = dict(d["params"])
+                if not tm.has_dataset(params["dataset_name"]):
+                    tm.new_dataset(new_dataset_splitter(**params),
+                                   params=params)
+            elif kind == "task.grant":
+                got = tm.get_task(d["dataset"], d["worker"],
+                                  token=d.get("token", ""))
+                want = d.get("task_id", -1)
+                got_id = got[0] if got is not None else -1
+                if got_id != want:
+                    return (
+                        f"task.grant {d['dataset']}: replayed task "
+                        f"{got_id}, journal promised {want}"
+                    )
+            elif kind == "task.report":
+                tm.report_task_result(d["dataset"], d["task_id"],
+                                      d["success"])
+            elif kind == "task.recover":
+                tm.recover_worker_tasks(d["worker"])
+            elif kind == "task.requeue":
+                tm.requeue_tasks(d["dataset"], d["task_ids"])
+            elif kind == "task.restore":
+                tm.restore_dataset(d["dataset"], d["content"])
+            else:
+                return f"unknown journal kind {kind}"
+            return None
+        if kind.startswith("rdzv."):
+            mgr = self.rdzv_managers.get(d.get("name", ""))
+            if mgr is None:
+                return f"{kind}: no rendezvous manager {d.get('name')!r}"
+            if kind == "rdzv.join":
+                mgr.join(
+                    d["node_id"], d["node_rank"], d["local_world_size"],
+                    host=d.get("host", ""),
+                    coordinator_port=d.get("coordinator_port", 0),
+                    slice_id=d.get("slice_id", ""),
+                    host_id=d.get("host_id", ""),
+                    attempt_id=d.get("attempt_id", ""),
+                )
+            elif kind == "rdzv.remove":
+                mgr.remove_alive_node(d["node_id"])
+            elif kind == "rdzv.world":
+                mgr.restore_world(d)
+            elif kind == "rdzv.ckpt_vote":
+                mgr.sync_ckpt_nodes(d["node_id"], d["step"])
+            else:
+                return f"unknown journal kind {kind}"
+            return None
+        if kind.startswith("reshard."):
+            rm = self.reshard_manager
+            if rm is None:
+                return f"{kind}: no reshard manager to apply to"
+            if kind == "reshard.announce":
+                if d["epoch"] <= rm.epoch:
+                    return None  # snapshot already holds this epoch
+                got = rm.announce(
+                    d["target"], d.get("spec") or {},
+                    expected_reports=d.get("expected", 0),
+                    deadline_s=d.get("deadline_s") or None,
+                )
+                if got != d["epoch"]:
+                    return (
+                        f"reshard.announce: replayed epoch {got}, "
+                        f"journal promised {d['epoch']}"
+                    )
+            elif kind == "reshard.report":
+                rm.report(m.ReshardReport(
+                    node_id=d["node_id"], epoch=d["epoch"], ok=d["ok"],
+                    reason=d.get("reason", ""),
+                ))
+            elif kind == "reshard.abort":
+                rm.abort(d.get("reason", "replayed abort"))
+            else:
+                return f"unknown journal kind {kind}"
+            return None
+        if kind == "node.meta":
+            if self.job_manager is not None:
+                self.job_manager.register_node_meta(m.NodeMeta(**d))
+            return None
+        if kind == "node.status":
+            if self.job_manager is not None:
+                self.job_manager.update_node_status(
+                    d["node_id"], d.get("node_type", ""), d["status"],
+                    d.get("exit_reason", ""),
+                )
+            return None
+        if kind == "speed.step":
+            if self.speed_monitor is not None:
+                self.speed_monitor.collect_global_step(
+                    d["step"], d.get("ts", 0.0)
+                )
+            return None
+        return f"unknown journal kind {kind}"
+
+    def replay(self, records: List[dict]) -> List[str]:
+        """Apply records in order; returns the divergence list."""
+        divergences = []
+        for rec in records:
+            div = self.apply(rec)
+            if div is not None:
+                divergences.append(f"seq {rec.get('s', '?')}: {div}")
+        return divergences
+
+    # -- takeover ------------------------------------------------------
+    def rearm(self) -> None:
+        """Re-arm every replayed deadline/timeout on THIS process's
+        clock: doing-task timeouts, the reshard epoch deadline, node
+        heartbeats, the rendezvous lastcall window.  A replayed deadline
+        from the dead primary's clock would either fire instantly or
+        never — both wrong."""
+        if self.task_manager is not None:
+            self.task_manager.rearm_doing()
+        if self.reshard_manager is not None:
+            self.reshard_manager.rearm_deadline()
+        if self.job_manager is not None and \
+                hasattr(self.job_manager, "rearm_heartbeats"):
+            self.job_manager.rearm_heartbeats()
+        for mgr in self.rdzv_managers.values():
+            mgr.rearm_clocks()
+
+
+def recover_into(state: MasterState, contents: JournalContents) -> \
+        Tuple[int, List[str]]:
+    """Snapshot-restore + tail-replay ``contents`` into ``state``.
+    Returns (records applied, divergences).  Callers bind the journal
+    AFTER this so replay never re-journals."""
+    if contents.snapshot is not None:
+        state.restore(contents.snapshot)
+    divergences = state.replay(contents.records)
+    return len(contents.records), divergences
+
+
+class JournalKeeper:
+    """The primary's housekeeping thread: bumps the leader lease and
+    takes due snapshots (snapshots must never run inside ``append`` —
+    state capture takes manager locks appenders already hold)."""
+
+    def __init__(self, journal: ControlStateJournal, state: MasterState,
+                 lease_interval_s: float = 1.0):
+        self._journal = journal
+        self._state = state
+        self._interval = lease_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="ha-keeper", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._journal.write_lease()
+            except OSError as e:
+                logger.warning("ha keeper: lease write failed: %s", e)
+            try:
+                self._journal.maybe_snapshot(self._state.capture)
+            except Exception:  # noqa: BLE001 - keeper must keep leasing
+                logger.warning("ha keeper: snapshot failed", exc_info=True)
+
+
+def attach_state(master, state_dir: str, *, recover: bool = True,
+                 fsync: bool = True) -> ControlStateJournal:
+    """Wire durable control-plane state into a master (both flavours):
+    recover whatever a previous incarnation journaled, open the journal
+    as the next writer generation, and bind it to every manager.  The
+    master's ``prepare`` starts the keeper (``master._ha_keeper``) and
+    publishes its address with :func:`write_addr`."""
+    from dlrover_tpu.common.global_context import get_context
+
+    ctx = get_context()
+    state = MasterState.of_master(master)
+    journal = ControlStateJournal(
+        state_dir, fsync=fsync, snapshot_every=ctx.ha_snapshot_every,
+    )
+    if recover and (journal.recovered.snapshot is not None
+                    or journal.recovered.records):
+        applied, divergences = recover_into(state, journal.recovered)
+        for div in divergences:
+            logger.warning("control journal recovery divergence: %s", div)
+        state.rearm()
+        logger.info(
+            "control journal: recovered %d records (snapshot seq=%d, "
+            "generation now %d)",
+            applied, journal.recovered.snap_seq, journal.generation,
+        )
+    journal.drop_recovered()
+    state.bind(journal)
+    master._ha_state = state
+    master._ha_journal = journal
+    master._ha_keeper = JournalKeeper(
+        journal, state, lease_interval_s=ctx.ha_lease_interval_s
+    )
+    return journal

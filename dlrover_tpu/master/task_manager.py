@@ -11,6 +11,14 @@ workers pull tasks (shards) instead of owning a static partition, so
 
 This is the elasticity mechanism for the input pipeline; the model-state
 elasticity lives in rendezvous + flash checkpoint.
+
+Master HA (ISSUE 13): every mutation — dataset creation, task grant,
+result report, dead-worker recovery, timeout requeue — is journaled
+before the RPC ack, so a warm standby replays the exact queue state and
+no data-shard task is lost or double-dispatched across a master crash.
+Grants journal the chosen task id; replay re-drives ``get_task`` (FIFO
+queues + seeded shuffles are deterministic) and statecheck flags any
+divergence.
 """
 
 from __future__ import annotations
@@ -24,6 +32,7 @@ from typing import Dict, List, Optional
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.token_cache import BoundedTokenCache
 from dlrover_tpu.master.dataset_splitter import DatasetSplitter, Shard
+from dlrover_tpu.master.state import JournalBound
 
 
 @dataclasses.dataclass
@@ -38,8 +47,10 @@ class DoingTask:
 class DatasetManager:
     """One dataset's task queues (reference ``BatchDatasetManager:29``)."""
 
-    def __init__(self, splitter: DatasetSplitter, task_timeout: float = 1800.0):
+    def __init__(self, splitter: DatasetSplitter, task_timeout: float = 1800.0,
+                 params: Optional[dict] = None):
         self.splitter = splitter
+        self.params = dict(params) if params else {}
         self._task_timeout = task_timeout
         self._todo: List[tuple] = []  # (task_id, Shard)
         self._doing: Dict[int, DoingTask] = {}
@@ -85,15 +96,41 @@ class DatasetManager:
                 recovered += 1
         return recovered
 
-    def reassign_timeout_tasks(self) -> int:
+    def reassign_timeout_tasks(self) -> List[int]:
+        """Re-queue doing tasks past the timeout; returns their ids (the
+        task manager journals them — replay must move the SAME tasks,
+        not re-run a clock-dependent decision)."""
         now = time.monotonic()
-        n = 0
+        moved: List[int] = []
         for task_id in list(self._doing.keys()):
             if now - self._doing[task_id].start_time > self._task_timeout:
                 doing = self._doing.pop(task_id)
                 self._todo.insert(0, (task_id, doing.shard))
+                moved.append(task_id)
+        return moved
+
+    def requeue_tasks(self, task_ids: List[int]) -> int:
+        """Move specific doing tasks back to the todo front (journal
+        replay of a timeout reassignment).  Ids no longer doing —
+        already reported, already requeued — are skipped, which is what
+        makes re-applying the record idempotent."""
+        n = 0
+        for task_id in task_ids:
+            doing = self._doing.pop(task_id, None)
+            if doing is not None:
+                self._todo.insert(0, (task_id, doing.shard))
                 n += 1
         return n
+
+    def rearm_doing(self) -> None:
+        """Restart every doing task's timeout clock on THIS process's
+        monotonic clock (standby takeover / checkpoint restore): an
+        inherited deadline from another incarnation would be instantly
+        stale and the task would be reassigned — double-dispatching work
+        a live worker is still running."""
+        now = time.monotonic()
+        for doing in self._doing.values():
+            doing.start_time = now
 
     def completed(self) -> bool:
         self._refill_if_empty()
@@ -105,28 +142,54 @@ class DatasetManager:
     def checkpoint(self) -> str:
         todo = [(tid, dataclasses.asdict(s)) for tid, s in self._todo]
         doing = [
-            (t.task_id, dataclasses.asdict(t.shard)) for t in self._doing.values()
+            (t.task_id, dataclasses.asdict(t.shard), t.worker_id)
+            for t in self._doing.values()
         ]
         return json.dumps(
             {
                 "dataset_name": self.splitter.dataset_name,
-                "todo": todo + doing,  # doing counts as not-done on resume
+                "todo": todo,
+                "doing": doing,
                 "epoch": self.splitter.epoch,
                 "task_id_seq": self._task_id_seq,
             }
         )
 
-    def restore(self, content: str) -> None:
+    def restore(self, content: str, keep_doing: bool = False) -> None:
+        """Restore the cursor.  Two callers, two worlds:
+
+        - ``keep_doing=False`` (the worker-initiated shard-checkpoint
+          restore after a full restart): the grants died with the old
+          worker incarnations, so doing folds into the todo FRONT and
+          is immediately re-dispatchable — holding them as doing would
+          stall those shards for the whole task_timeout.
+        - ``keep_doing=True`` (the HA snapshot path, where the granted
+          workers are STILL ALIVE across a master failover): doing
+          restores as doing with a RE-ARMED timeout clock — this
+          process's monotonic now, never the writer's; an inherited
+          start_time would read as instantly stale and double-dispatch
+          work a live worker is still running.
+        """
         data = json.loads(content)
         self._todo = [
             (tid, Shard(**shard)) for tid, shard in data.get("todo", [])
         ]
         self._doing.clear()
+        now = time.monotonic()
+        for entry in data.get("doing", []):
+            tid, shard = entry[0], entry[1]
+            worker_id = entry[2] if len(entry) > 2 else -1
+            if keep_doing:
+                self._doing[tid] = DoingTask(
+                    tid, worker_id, now, Shard(**shard)
+                )
+            else:
+                self._todo.insert(0, (tid, Shard(**shard)))
         self.splitter.epoch = data.get("epoch", 0)
         self._task_id_seq = data.get("task_id_seq", len(self._todo))
 
 
-class TaskManager:
+class TaskManager(JournalBound):
     """All datasets of one job + the timeout-reassignment loop
     (reference ``TaskManager:37``)."""
 
@@ -140,12 +203,14 @@ class TaskManager:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def new_dataset(self, splitter: DatasetSplitter) -> None:
+    def new_dataset(self, splitter: DatasetSplitter,
+                    params: Optional[dict] = None) -> None:
         with self._lock:
             if splitter.dataset_name not in self._datasets:
                 self._datasets[splitter.dataset_name] = DatasetManager(
-                    splitter, self._task_timeout
+                    splitter, self._task_timeout, params=params
                 )
+                self._jrec("task.dataset", params=dict(params or {}))
                 logger.info("task manager: registered dataset %s",
                             splitter.dataset_name)
 
@@ -168,6 +233,10 @@ class TaskManager:
             got = ds.get_task(worker_id)
             if got is not None:
                 self._fetch_tokens.put(token, got)
+                self._jrec(
+                    "task.grant", dataset=dataset_name, worker=worker_id,
+                    token=token, task_id=got[0],
+                )
             return got
 
     def report_task_result(
@@ -177,13 +246,32 @@ class TaskManager:
             ds = self._datasets.get(dataset_name)
             if ds is not None:
                 ds.report_task_result(task_id, success)
+                self._jrec(
+                    "task.report", dataset=dataset_name, task_id=task_id,
+                    success=success,
+                )
 
     def recover_worker_tasks(self, worker_id: int) -> int:
         with self._lock:
-            return sum(
+            n = sum(
                 ds.recover_worker_tasks(worker_id)
                 for ds in self._datasets.values()
             )
+            if n:
+                self._jrec("task.recover", worker=worker_id)
+            return n
+
+    def requeue_tasks(self, dataset_name: str, task_ids: List[int]) -> int:
+        """Journal-replay surface: move specific tasks doing -> todo."""
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            return ds.requeue_tasks(task_ids) if ds is not None else 0
+
+    def rearm_doing(self) -> None:
+        """Takeover re-arm: every doing task's timeout restarts now."""
+        with self._lock:
+            for ds in self._datasets.values():
+                ds.rearm_doing()
 
     def dataset_completed(self, dataset_name: str) -> bool:
         with self._lock:
@@ -207,7 +295,55 @@ class TaskManager:
             if ds is None or not content:
                 return False
             ds.restore(content)
+            self._jrec("task.restore", dataset=dataset_name, content=content)
             return True
+
+    # -- HA snapshot surface (ISSUE 13) ---------------------------------
+    def dump_state(self) -> dict:
+        with self._lock:
+            datasets = {}
+            for name, ds in self._datasets.items():
+                datasets[name] = {
+                    "params": dict(ds.params),
+                    "cursor": ds.checkpoint(),
+                    "completed": sorted(ds._completed_ids),
+                    "dispatched": ds._dispatched,
+                    "splitter_offset": getattr(ds.splitter, "_offset", None),
+                }
+            return {
+                "datasets": datasets,
+                "fetch_tokens": self._fetch_tokens.dump_state(),
+            }
+
+    def load_state(self, state: dict) -> None:
+        from dlrover_tpu.master.dataset_splitter import new_dataset_splitter
+
+        with self._lock:
+            self._datasets.clear()
+            for name, sub in state.get("datasets", {}).items():
+                params = dict(sub.get("params") or {})
+                if not params:
+                    logger.warning(
+                        "task manager: dataset %s snapshot has no splitter "
+                        "params; skipping", name,
+                    )
+                    continue
+                ds = DatasetManager(
+                    new_dataset_splitter(**params), self._task_timeout,
+                    params=params,
+                )
+                cursor = sub.get("cursor", "")
+                if cursor:
+                    # HA snapshot: the granted workers are alive across
+                    # the failover — doing stays doing, clocks re-armed.
+                    ds.restore(cursor, keep_doing=True)
+                ds._completed_ids = set(sub.get("completed", []))
+                ds._dispatched = int(sub.get("dispatched", 0))
+                offset = sub.get("splitter_offset")
+                if offset is not None and hasattr(ds.splitter, "_offset"):
+                    ds.splitter._offset = offset
+                self._datasets[name] = ds
+            self._fetch_tokens.load_state(state.get("fetch_tokens", []))
 
     # -- background loop ---------------------------------------------------
     def start(self) -> None:
@@ -224,9 +360,11 @@ class TaskManager:
         while not self._stop.wait(30.0):
             with self._lock:
                 for name, ds in self._datasets.items():
-                    n = ds.reassign_timeout_tasks()
-                    if n:
+                    moved = ds.reassign_timeout_tasks()
+                    if moved:
+                        self._jrec("task.requeue", dataset=name,
+                                   task_ids=moved)
                         logger.warning(
                             "task manager: re-queued %d timed-out tasks of %s",
-                            n, name,
+                            len(moved), name,
                         )

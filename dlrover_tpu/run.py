@@ -50,6 +50,15 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     )
     p.add_argument("--standalone", action="store_true",
                    help="single-host mode: auto-spawn a local master")
+    p.add_argument("--standby", action="store_true",
+                   help="master HA (ISSUE 13): give the standalone local "
+                        "master a durable state journal plus a WARM "
+                        "STANDBY that adopts the state on a crash "
+                        "(instead of the cold blank-state relaunch)")
+    p.add_argument("--master_state_dir", default="",
+                   help="control-plane journal dir for --standby "
+                        "(default: a run-scoped dir under the system "
+                        "temp dir)")
     p.add_argument("--nnodes", default="1",
                    help="'N' or 'MIN:MAX' elastic node range")
     p.add_argument("--nproc_per_node", type=int, default=1)
@@ -142,7 +151,8 @@ def _apply_job_file(parser: argparse.ArgumentParser,
         ]
 
 
-def _master_cmd(args, port: int, port_file: str = "") -> List[str]:
+def _master_cmd(args, port: int, port_file: str = "",
+                state_dir: str = "") -> List[str]:
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
     cmd = [
         sys.executable, "-m", "dlrover_tpu.master.main",
@@ -155,14 +165,17 @@ def _master_cmd(args, port: int, port_file: str = "") -> List[str]:
     ]
     if port_file:
         cmd += ["--port_file", port_file]
+    if state_dir:
+        cmd += ["--state_dir", state_dir]
     return cmd
 
 
-def _launch_local_master(args) -> Tuple[subprocess.Popen, str, int]:
+def _launch_local_master(args, state_dir: str = "") \
+        -> Tuple[subprocess.Popen, str, int]:
     """Spawn ``python -m dlrover_tpu.master.main`` and wait for its port
     (reference ``_launch_dlrover_local_master :245``)."""
     port_file = tempfile.mktemp(prefix="dlrtpu_master_port_")
-    proc = subprocess.Popen(_master_cmd(args, 0, port_file))
+    proc = subprocess.Popen(_master_cmd(args, 0, port_file, state_dir))
     deadline = time.time() + 60
     while time.time() < deadline:
         if os.path.exists(port_file):
@@ -177,6 +190,45 @@ def _launch_local_master(args) -> Tuple[subprocess.Popen, str, int]:
             )
         time.sleep(0.2)
     raise TimeoutError("local master did not report its port in 60s")
+
+
+#: Chaos crash sites aimed at the PRIMARY master; a standby inheriting
+#: the env verbatim would arm them too and die alongside it.
+_MASTER_CRASH_SITES = ("master.kill", "master.restart",
+                       "master.journal_torn")
+
+
+def _launch_standby_master(args, state_dir: str, primary_addr: str) \
+        -> Tuple[subprocess.Popen, str]:
+    """Spawn a warm standby (``master.main --standby``) and wait for the
+    port it BOUND (it serves only after takeover)."""
+    port_file = tempfile.mktemp(prefix="dlrtpu_standby_port_")
+    cmd = [
+        sys.executable, "-m", "dlrover_tpu.master.main",
+        "--standby", "--state_dir", state_dir,
+        "--primary_addr", primary_addr,
+        "--port", "0", "--port_file", port_file,
+        "--job_name", args.job_name,
+    ]
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+    cmd += ["--min_nodes", str(min_nodes), "--max_nodes", str(max_nodes),
+            "--node_unit", str(args.node_unit)]
+    env = chaos.scrub_env(dict(os.environ), _MASTER_CRASH_SITES)
+    proc = subprocess.Popen(cmd, env=env)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                content = f.read().strip()
+            if content:
+                os.unlink(port_file)
+                return proc, f"127.0.0.1:{content}"
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"standby master exited early with code {proc.returncode}"
+            )
+        time.sleep(0.2)
+    raise TimeoutError("standby master did not report its port in 60s")
 
 
 def _supervise_local_master(
@@ -238,6 +290,110 @@ def _supervise_local_master(
     return thread
 
 
+def _supervise_ha_masters(
+    args,
+    state_dir: str,
+    primary_holder: List[subprocess.Popen],
+    standby_holder: List[subprocess.Popen],
+    stop_evt: threading.Event,
+    max_restarts: int = 3,
+) -> threading.Thread:
+    """The --standby supervision mode (ISSUE 13), next to the cold
+    ``_supervise_local_master`` path: on a primary crash the standby
+    ADOPTS the journaled state (hot), so the supervisor's job is not to
+    relaunch the dead primary but to (a) wait for the takeover, (b)
+    promote the standby process into the primary slot, and (c) spawn a
+    FRESH standby behind the new leader so the next crash is also hot.
+    Agents follow the leader via the state-dir ``addr`` file chain, so
+    repeated failovers need no env changes.  A standby that dies while
+    the primary is healthy is simply respawned."""
+    from dlrover_tpu.master.state import read_addr
+
+    def loop() -> None:
+        restarts = 0
+        while not stop_evt.wait(1.0):
+            primary, standby = primary_holder[0], standby_holder[0]
+            prc = primary.poll()
+            if prc is None:
+                src = standby.poll()
+                if src is not None and src != 0 and not stop_evt.is_set():
+                    if restarts >= max_restarts:
+                        logger.error(
+                            "standby exited rc=%d and restart budget (%d) "
+                            "is spent; next master crash will be cold",
+                            src, max_restarts,
+                        )
+                        return
+                    restarts += 1
+                    logger.warning(
+                        "standby exited rc=%d; respawning (restart %d/%d)",
+                        src, restarts, max_restarts,
+                    )
+                    try:
+                        standby_holder[0], _ = _launch_standby_master(
+                            args, state_dir, read_addr(state_dir)
+                        )
+                    except (RuntimeError, TimeoutError) as e:
+                        logger.error(
+                            "could not respawn a standby: %s; next "
+                            "master crash will be cold", e,
+                        )
+                        return
+                continue
+            if prc == 0 or (prc < 0 and stop_evt.is_set()):
+                # Job finished, or launcher teardown signalled the
+                # master.  Unlike the cold supervisor, a signal death
+                # alone is NOT teardown here: an external SIGKILL/OOM
+                # kill of the primary is exactly the failure HA covers,
+                # so only rc<0 paired with our own stop event returns.
+                return
+            # Primary crashed: the standby should take over.  Wait for
+            # the new leader to publish its address (bounded).
+            old_addr = read_addr(state_dir)
+            deadline = time.time() + 60
+            new_addr = ""
+            while time.time() < deadline and not stop_evt.is_set():
+                cur = read_addr(state_dir)
+                if cur and cur != old_addr:
+                    new_addr = cur
+                    break
+                if standby_holder[0].poll() is not None:
+                    break  # standby died too — cold path below
+                time.sleep(0.2)
+            if not new_addr:
+                logger.error(
+                    "primary exited rc=%d and no takeover observed; "
+                    "agents will time out", prc,
+                )
+                return
+            logger.warning(
+                "primary exited rc=%d; standby took over at %s",
+                prc, new_addr,
+            )
+            # Promote, then back the new leader with a fresh standby.
+            primary_holder[0] = standby_holder[0]
+            if restarts >= max_restarts:
+                logger.error(
+                    "standby restart budget (%d) spent; the next master "
+                    "crash will be cold", max_restarts,
+                )
+                return
+            restarts += 1
+            try:
+                standby_holder[0], _ = _launch_standby_master(
+                    args, state_dir, new_addr
+                )
+            except (RuntimeError, TimeoutError) as e:
+                logger.error("could not respawn a standby: %s", e)
+                return
+
+    thread = threading.Thread(
+        target=loop, name="master-ha-supervisor", daemon=True
+    )
+    thread.start()
+    return thread
+
+
 def _gc_shm_arenas(
     job_name: str, run_id: str = "", min_age_s: float = 3600.0
 ) -> None:
@@ -285,12 +441,44 @@ def run(args: argparse.Namespace) -> int:
         )
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
     master_holder: List[subprocess.Popen] = []
+    standby_holder: List[subprocess.Popen] = []
     master_stop = threading.Event()
     master_addr = args.master_addr
+    ha_state_dir = ""
     if args.standalone and not master_addr:
-        proc, master_addr, master_port = _launch_local_master(args)
+        if args.standby:
+            ha_state_dir = args.master_state_dir or os.path.join(
+                tempfile.gettempdir(),
+                f"dlrtpu_ha_{args.job_name}_"
+                f"{os.environ['DLROVER_TPU_RUN_ID']}",
+            )
+            os.makedirs(ha_state_dir, exist_ok=True)
+        proc, master_addr, master_port = _launch_local_master(
+            args, ha_state_dir
+        )
         master_holder.append(proc)
-        _supervise_local_master(args, master_holder, master_port, master_stop)
+        if args.standby:
+            sb_proc, standby_addr = _launch_standby_master(
+                args, ha_state_dir, master_addr
+            )
+            standby_holder.append(sb_proc)
+            # Agents (and their workers, which inherit the env) learn
+            # both the failover chain (state-dir addr file) and the
+            # static standby address.
+            os.environ["DLROVER_TPU_MASTER_STATE_DIR"] = ha_state_dir
+            os.environ["DLROVER_TPU_MASTER_STANDBY_ADDR"] = standby_addr
+            _supervise_ha_masters(
+                args, ha_state_dir, master_holder, standby_holder,
+                master_stop, args.max_restarts,
+            )
+            atexit.register(
+                lambda: standby_holder[0].poll() is None
+                and standby_holder[0].terminate()
+            )
+        else:
+            _supervise_local_master(
+                args, master_holder, master_port, master_stop
+            )
         atexit.register(
             lambda: master_holder[0].poll() is None
             and master_holder[0].terminate()
@@ -321,7 +509,9 @@ def run(args: argparse.Namespace) -> int:
     config.auto_configure()
 
     # Merge master-pushed run config (reference _elastic_config_from_master).
-    client = MasterClient(master_addr, node_id)
+    # The state-dir hook makes the launcher's own client follow a
+    # failover (the final job-exit report must reach the NEW leader).
+    client = MasterClient(master_addr, node_id, state_dir=ha_state_dir)
     def _coerce(cur, val):
         # bool("false") is True: string-valued run configs (the usual
         # wire form) need explicit truthiness parsing for bool fields.

@@ -504,3 +504,47 @@ def test_reshard_bench_smoke_schema(tmp_path):
     metric = json.loads(proc.stdout.strip().splitlines()[-1])
     assert metric["metric"] == "reshard_live_vs_restart_downtime"
     assert metric["artifact"] == str(out)
+
+
+def test_ha_bench_smoke_schema(tmp_path):
+    """Tier-1 gate for ISSUE 13's master-HA bench: the smoke config
+    (one trial, 0.5s reader lease) runs the full cold-vs-warm failover
+    on CPU inside the budget and emits schema-valid JSON — blackout
+    fields present for both paths, warm STRICTLY below cold (the PR's
+    acceptance criterion), the warm path provably stateful (marker
+    readable, shard queue continues in place) while cold really is
+    blank, and the surviving journal statecheck-clean."""
+    import os
+    import subprocess
+    import time
+
+    out = tmp_path / "HA_BENCH_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DLROVER_TPU_FAULTS", None)
+    env.pop("DLROVER_TPU_MASTER_STATE_DIR", None)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(Path(bench.__file__)), "--ha_bench",
+         "--smoke", f"--out={out}"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(Path(bench.__file__).parent),
+    )
+    elapsed = time.time() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+    assert elapsed < 60.0, f"smoke ha bench took {elapsed:.1f}s"
+    result = json.loads(out.read_text())
+    assert result["bench"] == "ha"
+    assert result["complete"] is True
+    cold, warm = result["cold"], result["warm"]
+    assert cold["blackout_s"] > 0 and warm["blackout_s"] > 0
+    assert result["hot_strictly_faster"] is True
+    assert warm["blackout_s"] < cold["blackout_s"]
+    assert warm["state_recovered"] is True
+    assert warm["queue_continues"] is True
+    assert cold["state_recovered"] is False  # blank-state relaunch
+    assert result["statecheck_rc"] == 0
+    metric = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert metric["metric"] == "ha_failover_blackout_s"
+    assert metric["value"] == warm["blackout_s"]
+    assert metric["vs_baseline"] == cold["blackout_s"]
+    assert metric["artifact"] == str(out)

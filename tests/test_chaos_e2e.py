@@ -1294,3 +1294,324 @@ class TestFleetGatewayRelaunchMixed:
                     proc.kill()
                     proc.wait()
             registry_server.stop()
+
+
+class TestMasterKillWarmFailover:
+    """Flagship ISSUE 13 scenario: training + serving fleet in flight,
+    the PRIMARY master is chaos-SIGKILLed (``master.kill``, exit 83 —
+    the unclean death, distinct from the supervised ``master.restart``
+    cold path) mid-rendezvous and mid-task.  The warm standby replays
+    the control-state journal and takes over; the proof obligations:
+
+    - no data-shard task is lost or double-completed across the
+      blackout (held doing tasks complete exactly once, the rest of the
+      queue drains with every task id granted exactly once);
+    - the half-formed rendezvous (node 0 waiting, node 1 absent)
+      completes on the NEW master when node 1 finally joins;
+    - the in-flight reshard epoch resolves (DONE after both workers
+      report ok post-takeover);
+    - the master-backed serving registry never observes a blank master
+      (the gateway entry is visible at the first post-takeover read),
+      and every serving request submitted across the window finishes
+      exactly-once;
+    - ``statecheck`` exits 0 on the surviving journal.
+    """
+
+    @pytest.mark.ha
+    def test_training_and_serving_ride_warm_takeover(self, tmp_path):
+        import threading
+
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.common.rpc import addr_connectable
+        from dlrover_tpu.master.state import read_addr
+        from dlrover_tpu.serving import (
+            GatewayConfig,
+            GatewayCore,
+            LoopbackTransport,
+            ReplicaRunner,
+        )
+        from dlrover_tpu.serving.tier import MasterKv, ServeRegistry
+
+        job = "hakill"
+        state_dir = tmp_path / "state"
+        state_dir.mkdir()
+
+        def start_master_proc(extra_args, faults, log_name, extra_env=None):
+            env = _env({"DLROVER_TPU_FAULTS": faults} if faults else None)
+            if extra_env:
+                env.update(extra_env)
+            env.pop("DLROVER_TPU_MASTER_STATE_DIR", None)
+            port_file = tmp_path / f"{log_name}.port"
+            log = open(tmp_path / f"{log_name}.log", "w")
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "dlrover_tpu.master.main",
+                    "--port=0", f"--port_file={port_file}",
+                    f"--job_name={job}", "--min_nodes=2", "--max_nodes=2",
+                    f"--state_dir={state_dir}", *extra_args,
+                ],
+                cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
+            )
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if port_file.exists() and port_file.read_text().strip():
+                    return proc, f"127.0.0.1:{port_file.read_text().strip()}"
+                assert proc.poll() is None, (
+                    f"{log_name} died rc={proc.returncode}:\n"
+                    + _read(tmp_path / f"{log_name}.log")[-3000:]
+                )
+                time.sleep(0.2)
+            raise TimeoutError(f"{log_name} never reported a port")
+
+        # Primary: chaos-killed ~7s after its import (setup below takes
+        # ~2-3s, so the kill lands with tasks doing, a reshard epoch
+        # PREPARING, node 0 alone in the waiting set, and serving
+        # traffic mid-stream).
+        primary, paddr = start_master_proc(
+            [], "master.kill:at=7s", "primary"
+        )
+        standby, saddr = start_master_proc(
+            ["--standby", f"--primary_addr={paddr}"], None, "standby",
+            extra_env={
+                "DLROVER_TPU_HA_LEASE_S": "1.5",
+                "DLROVER_TPU_HA_TAIL_POLL_S": "0.1",
+            },
+        )
+        procs = [primary, standby]
+
+        class FakeServer:
+            """Deterministic arithmetic decode over the real
+            ReplicaRunner protocol (token i = (sum(prompt)+i) % 97)."""
+
+            def __init__(self, slots=4):
+                self.slots = slots
+                self._pending = []
+                self._active = {}
+                self.last_stats = {}
+
+            def submit(self, rid, prompt, mnt, prefix_len=0, prefix_fp=""):
+                self._pending.append((rid, [int(t) for t in prompt],
+                                      int(mnt)))
+
+            def cancel(self, rid):
+                before = len(self._pending)
+                self._pending = [p for p in self._pending if p[0] != rid]
+                return len(self._pending) < before
+
+            def abort(self, rid):
+                return self.cancel(rid) or \
+                    self._active.pop(rid, None) is not None
+
+            def pending_count(self):
+                return len(self._pending)
+
+            def pending_rids(self):
+                return [r for r, _, _ in self._pending]
+
+            def active_rids(self):
+                return list(self._active)
+
+            def free_slots(self):
+                return max(
+                    0, self.slots - len(self._active) - len(self._pending)
+                )
+
+            def serve_incremental(self, tick=None, on_finish=None,
+                                  on_token=None, idle_wait=0.0005):
+                while True:
+                    if tick is not None and tick() is False:
+                        return {}
+                    while self._pending and len(self._active) < self.slots:
+                        rid, p, mnt = self._pending.pop(0)
+                        self._active[rid] = (p, mnt)
+                    for rid in list(self._active):
+                        p, mnt = self._active.pop(rid)
+                        new = [(sum(p) + i) % 97 for i in range(mnt)]
+                        if on_finish is not None:
+                            # Contract: the full sequence (prompt echoed
+                            # + new tokens); the runner strips the echo.
+                            on_finish(rid, list(p) + new)
+                    time.sleep(idle_wait)
+
+        hb_stop = threading.Event()
+        clients = []
+        try:
+            c0 = MasterClient(paddr, 0, state_dir=str(state_dir))
+            c1 = MasterClient(paddr, 1, state_dir=str(state_dir))
+            clients += [c0, c1]
+            for nid, c in ((0, c0), (1, c1)):
+                c.register_node(node_rank=nid, host="127.0.0.1",
+                                agent_port=9100 + nid, local_world_size=1)
+            # Mid-rendezvous: ONLY node 0 joins pre-kill.
+            c0.join_rendezvous(node_rank=0, local_world_size=1)
+            # Data sharding: 12 shards; 2 completed, 2 HELD doing
+            # across the kill.
+            c0.report_dataset_shard_params(
+                dataset_name="ds", dataset_size=120, shard_size=10
+            )
+            granted_ids = []
+            pre = [c0.get_task("ds") for _ in range(4)]
+            granted_ids += [t.task_id for t in pre]
+            assert all(t.task_id >= 0 for t in pre)
+            c0.report_task_result("ds", pre[0].task_id, True)
+            c0.report_task_result("ds", pre[1].task_id, True)
+            held = pre[2:]
+            # In-flight reshard epoch.
+            epoch_info = c0.announce_reshard(
+                2, {"dp": 2}, expected_reports=2, deadline_s=120.0
+            )
+            epoch = epoch_info.epoch
+            assert epoch >= 1 and epoch_info.status == "preparing"
+            # Serving: master-backed registry + a real loopback fleet.
+            reg_client = MasterClient(paddr, 9, state_dir=str(state_dir))
+            clients.append(reg_client)
+            registry = ServeRegistry(MasterKv(reg_client), job=job,
+                                     lease_s=60.0)
+            registry.announce_gateway("g0", "127.0.0.1:7777")
+
+            def heartbeat():
+                while not hb_stop.wait(0.5):
+                    try:
+                        registry.announce_gateway("g0", "127.0.0.1:7777")
+                    except Exception:  # noqa: BLE001 - blackout window
+                        pass
+
+            threading.Thread(target=heartbeat, daemon=True).start()
+
+            core = GatewayCore(GatewayConfig())
+            transport = LoopbackTransport(self._core_handle(core))
+            runner = ReplicaRunner(
+                FakeServer(), transport, "rep0", poll_interval=0.005,
+            )
+            threading.Thread(target=runner.run, daemon=True).start()
+            serve_ids = []
+            serve_stop = threading.Event()
+
+            def submit_loop():
+                i = 0
+                while not serve_stop.wait(0.15):
+                    rid = f"s{i}"
+                    core.submit(rid, [i + 1, i + 2], 4)
+                    serve_ids.append(rid)
+                    i += 1
+
+            threading.Thread(target=submit_loop, daemon=True).start()
+
+            # --- the kill -------------------------------------------------
+            rc = primary.wait(timeout=90)
+            assert rc == 83, (
+                f"primary exited {rc}, wanted chaos master.kill 83:\n"
+                + _read(tmp_path / "primary.log")[-3000:]
+            )
+            t_kill = time.monotonic()
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if read_addr(str(state_dir)) == saddr and \
+                        addr_connectable(saddr, timeout=0.5):
+                    break
+                assert standby.poll() is None, (
+                    "standby died:\n"
+                    + _read(tmp_path / "standby.log")[-3000:]
+                )
+                time.sleep(0.2)
+            assert read_addr(str(state_dir)) == saddr, (
+                "no takeover observed:\n"
+                + _read(tmp_path / "standby.log")[-3000:]
+            )
+            blackout_s = time.monotonic() - t_kill
+            # The registry never observes a blank master: the FIRST
+            # post-takeover read shows the journaled gateway entry.
+            fresh = MasterClient(saddr, 8)
+            clients.append(fresh)
+            gws = ServeRegistry(MasterKv(fresh), job=job,
+                                lease_s=60.0).gateways()
+            assert "g0" in gws, f"blank registry after takeover: {gws}"
+
+            # Held doing tasks complete EXACTLY once on the new master.
+            for t in held:
+                c0.report_task_result("ds", t.task_id, True)
+            # Node 1 finally joins: the half-formed round completes on
+            # the standby (its waiting set replayed node 0).
+            c1.join_rendezvous(node_rank=1, local_world_size=1)
+            world = {}
+            deadline = time.time() + 60
+            while time.time() < deadline and len(world) != 2:
+                _, _, world, coord = c0.get_comm_world()
+                time.sleep(0.2)
+            assert len(world) == 2, "rendezvous never completed"
+            node_ids = sorted(w["node_id"] for w in world.values())
+            assert node_ids == [0, 1]
+
+            # Drain the queue: every task id granted exactly once
+            # fleet-wide, none lost, none double-completed.
+            while True:
+                t = c1.get_task("ds")
+                if t.task_id < 0:
+                    break
+                granted_ids.append(t.task_id)
+                c1.report_task_result("ds", t.task_id, True)
+            assert sorted(granted_ids) == list(range(12)), granted_ids
+            assert len(set(granted_ids)) == 12  # no double grants
+
+            # The in-flight reshard epoch resolves DONE.
+            assert c0.report_reshard(epoch, ok=True)
+            assert c1.report_reshard(epoch, ok=True)
+            assert c0.get_reshard_epoch().status == "done"
+
+            # Serving: stop admitting, everything submitted across the
+            # window finishes exactly-once with correct bytes.
+            serve_stop.set()
+            time.sleep(0.3)
+            deadline = time.time() + 60
+            while time.time() < deadline and \
+                    core.counters["completed"] < len(serve_ids):
+                time.sleep(0.1)
+            assert core.counters["completed"] == len(serve_ids)
+            assert core.counters["duplicate_completions"] == 0
+            for i, rid in enumerate(serve_ids):
+                st = core.status(rid)
+                assert st.state == "done"
+                assert st.tokens == [
+                    (2 * i + 3 + k) % 97 for k in range(4)
+                ]
+            hb_stop.set()
+            core.drain("rep0")
+            print(f"WARM_FAILOVER_OK blackout_s={blackout_s:.2f} "
+                  f"serving={len(serve_ids)} tasks=12")
+        finally:
+            hb_stop.set()
+            for c in clients:
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001 - teardown
+                    pass
+            _terminate(procs)
+        # The surviving journal passes fsck (after the standby exited).
+        proc = subprocess.run(
+            [sys.executable, "-m", "dlrover_tpu.master.statecheck",
+             str(state_dir)],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    @staticmethod
+    def _core_handle(core):
+        """Gateway.handle dispatch over a bare core (loopback)."""
+        from dlrover_tpu.common import messages as m
+
+        def handle(msg):
+            if isinstance(msg, m.ServeReplicaRegister):
+                core.register(msg.replica_id, msg.slots, msg.role)
+            elif isinstance(msg, m.ServeReplicaDeregister):
+                core.deregister(msg.replica_id)
+            elif isinstance(msg, m.ServeReplicaPoll):
+                return core.poll(msg.replica_id, msg.free_slots,
+                                 msg.active, msg.stats, msg.warm_prefixes)
+            elif isinstance(msg, m.ServeTokens):
+                core.stream(msg.replica_id, msg.req_id, msg.tokens)
+            elif isinstance(msg, m.ServeDone):
+                core.complete(msg.replica_id, msg.req_id, msg.tokens,
+                              msg.ok, msg.reason, msg.replayed)
+            return None
+
+        return handle

@@ -205,7 +205,11 @@ class TestDataSharding:
         t = c.get_task("ds3")
         ckpt = c.get_shard_checkpoint("ds3")
         assert ckpt
-        # Restore -> undone shards (incl. in-flight t) come back.
+        # Worker-initiated restore (the full-restart resume path):
+        # undone shards INCLUDING the in-flight t come back immediately
+        # — the grants died with the old worker incarnations.  (The HA
+        # snapshot path restores doing as doing with re-armed clocks
+        # instead; see tests/test_ha.py TestRestoreRearm.)
         assert c.restore_shard_checkpoint("ds3", ckpt)
         seen = set()
         while True:
