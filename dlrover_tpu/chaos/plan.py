@@ -70,99 +70,178 @@ EXIT_JOURNAL_TORN = 84
 #: site name -> (kind, defaults).  Kinds: ``error`` (caller raises),
 #: ``latency`` (inject() sleeps), ``crash`` (inject() calls os._exit),
 #: ``flag`` (caller applies the effect, e.g. "pretend the read was torn").
+#:
+#: ``doc`` is each site's one-line operator contract: graftcheck's
+#: ``--chaos-table`` reporter generates the README's injection-point
+#: catalog from exactly these strings (a tier-1 test pins the README
+#: table to the generated one), so the documentation lives beside the
+#: declaration and cannot drift from it.
 SITES: Dict[str, dict] = {
-    "rpc.unavailable": {"kind": "error"},
-    "rpc.latency": {"kind": "latency", "delay": 0.2},
-    "rpc.drop": {"kind": "error"},
-    "rdzv.late_join": {"kind": "latency", "delay": 2.0},
-    "rdzv.lost_node": {"kind": "flag"},
+    "rpc.unavailable": {
+        "kind": "error",
+        "doc": "synthetic UNAVAILABLE at `RpcClient.call` before the "
+               "send (the request never left)",
+    },
+    "rpc.latency": {
+        "kind": "latency", "delay": 0.2,
+        "doc": "sleep `delay` at `RpcClient.call` before the send",
+    },
+    "rpc.drop": {
+        "kind": "error",
+        "doc": "request aborted UNAVAILABLE at `RpcServer`; the "
+               "handler never runs",
+    },
+    "rdzv.late_join": {
+        "kind": "latency", "delay": 2.0,
+        "doc": "sleep `delay` in the master rendezvous join (late "
+               "joiner)",
+    },
+    "rdzv.lost_node": {
+        "kind": "flag",
+        "doc": "rendezvous join silently discarded; the agent's "
+               "re-join loop must recover",
+    },
     "ckpt.crash_before_commit": {
         "kind": "crash", "exit": EXIT_CKPT_BEFORE_COMMIT, "times": 1,
+        "doc": "`os._exit(66)` in shard-file commit BEFORE the "
+               "tracker write — previous step stays committed",
     },
     "ckpt.crash_after_commit": {
         "kind": "crash", "exit": EXIT_CKPT_AFTER_COMMIT, "times": 1,
+        "doc": "`os._exit(67)` in shard-file commit AFTER the tracker "
+               "write — the new step is durable",
     },
-    "ckpt.slow_storage": {"kind": "latency", "delay": 1.0},
-    "shm.torn_read": {"kind": "flag", "times": 1},
+    "ckpt.slow_storage": {
+        "kind": "latency", "delay": 1.0,
+        "doc": "sleep `delay` per shard persist (saver + engine) — "
+               "the bounded-stall knobs are what must absorb it",
+    },
+    "shm.torn_read": {
+        "kind": "flag", "times": 1,
+        "doc": "one shm-arena read reports torn state; validation "
+               "must refuse it",
+    },
     # Data-corruption sites: the caller damages the payload it was about
     # to write/send (silent bit-rot, torn transfers) — the commit
     # protocol proceeds normally, so restore-side verification is what
     # must catch it.
-    "storage.corrupt_shard": {"kind": "flag", "times": 1},
-    "storage.truncate_shard": {"kind": "flag", "times": 1},
-    "replica.torn_push": {"kind": "flag", "times": 1},
-    "worker.kill": {"kind": "crash", "exit": EXIT_WORKER_KILL, "times": 1},
+    "storage.corrupt_shard": {
+        "kind": "flag", "times": 1,
+        "doc": "one written shard gets a flipped byte (silent "
+               "bit-rot); CRC verification must catch it at restore",
+    },
+    "storage.truncate_shard": {
+        "kind": "flag", "times": 1,
+        "doc": "one written shard loses its second half (torn write); "
+               "the restore ladder falls back a step",
+    },
+    "replica.torn_push": {
+        "kind": "flag", "times": 1,
+        "doc": "only a payload prefix 'arrives' at the replica ring; "
+               "the receiver must reject it",
+    },
+    "worker.kill": {
+        "kind": "crash", "exit": EXIT_WORKER_KILL, "times": 1,
+        "doc": "`os._exit(77)` at the worker step hook at "
+               "`rank`/`step`",
+    },
     # Serving-fleet sites (ISSUE 5): kill a replica mid-stream, lose a
     # granted request before the replica ever sees it (the gateway's
     # poll-reconcile must re-dispatch), or slow one replica's rounds
     # (the p95-TTFT signal the autoscaler steers on).
     "serving.replica_kill": {
         "kind": "crash", "exit": EXIT_REPLICA_KILL, "times": 1,
+        "doc": "`os._exit(78)` in the replica's tick mid-stream; "
+               "journal replay + gateway dedupe keep exactly-once",
     },
-    "serving.drop_request": {"kind": "flag", "times": 1},
-    "serving.slow_replica": {"kind": "latency", "delay": 0.5},
+    "serving.drop_request": {
+        "kind": "flag", "times": 1,
+        "doc": "a granted request evaporates before the replica sees "
+               "it; poll-reconcile must re-dispatch",
+    },
+    "serving.slow_replica": {
+        "kind": "latency", "delay": 0.5,
+        "doc": "sleep `delay` in one replica's tick — the p95-TTFT "
+               "signal the autoscaler steers on",
+    },
     # KV-handoff site (ISSUE 8): the prefill->decode KV segment is lost
-    # or torn in flight.  ``method=export`` (the default evaluation
-    # point) drops the payload before the kv-ready send — the gateway's
-    # poll-reconcile must re-dispatch the prefill; ``method=import``
-    # tears the bytes at the decode replica — the embedded CRC must
-    # reject it (never decode from a torn segment) and the gateway
-    # re-prefills, terminally failing after max_attempts.
-    "serving.kv_drop": {"kind": "flag", "times": 1},
+    # or torn in flight.
+    "serving.kv_drop": {
+        "kind": "flag", "times": 1,
+        "doc": "KV handoff fault (`method=export`/`import`/`pull`): "
+               "segment lost before kv-ready / torn at the decode "
+               "import / P2P pull dropped (CRC must reject; "
+               "re-prefill — a failed pull falls back to relay — "
+               "bounded by max_attempts)",
+    },
     # Gateway-tier site (ISSUE 9): hard-kill one gateway of a sharded
-    # tier mid-stream.  Fires in the tier node's registry heartbeat
-    # (``method=<gateway_id>`` selects which); the surviving gateways
-    # adopt the dead one's hash range via the registry lease expiry,
-    # clients re-route + resubmit, and replica journals + gateway
-    # dedupe keep every admitted request exactly-once across the
-    # failover.
+    # tier mid-stream.
     "serving.gateway_kill": {
         "kind": "crash", "exit": EXIT_GATEWAY_KILL, "times": 1,
+        "doc": "`os._exit(81)` in the tier heartbeat "
+               "(`method=<gateway_id>`, `step_ge=N` completions) — "
+               "survivors adopt the hash range; client resubmit + "
+               "journal/dedupe keep exactly-once",
     },
     # Draft-replica site (ISSUE 11): kill the speculation proposal
-    # server mid-round, in its proposal loop (``method=<worker_id>``
-    # selects which; ``step`` reports completed rolls so ``step_ge``
-    # gates on progress).  Correctness is owned by the TARGET's
-    # acceptance, so the only legal observable effect on request
-    # streams is degradation: spec targets count spec_fallbacks and
-    # finish every in-flight request exactly-once via plain decode.
+    # server mid-round.  Correctness is owned by the TARGET's
+    # acceptance, so the only legal observable effect is degradation.
     "serving.draft_kill": {
         "kind": "crash", "exit": EXIT_DRAFT_KILL, "times": 1,
+        "doc": "`os._exit(82)` in the draft proposal loop "
+               "(`method=<worker_id>`, `step_ge=N` rolls) — spec "
+               "targets degrade to plain decode (`spec_fallbacks`), "
+               "every in-flight request exactly-once, no token "
+               "changes",
     },
     "master.restart": {
         "kind": "crash", "exit": EXIT_MASTER_RESTART, "times": 1,
+        "doc": "`os._exit(42)` at elapsed `at` — the SUPERVISED cold "
+               "path (launcher relaunches on the same port)",
     },
     # Master HA sites (ISSUE 13).  ``master.kill`` is the UNCLEAN exit —
-    # distinct from the supervised ``master.restart`` cold path — fired
-    # from the master main's chaos poller (``at=`` gates the timing);
-    # the warm standby must adopt the journaled state instead of a
-    # blank-state relaunch.  ``master.journal_torn`` crashes INSIDE a
-    # ControlStateJournal append between the first and second half of a
-    # frame — the literal crash-mid-fsync'd-write; reopen must truncate
-    # the torn tail and lose exactly the unacked record.
+    # distinct from the supervised ``master.restart`` cold path;
+    # ``master.journal_torn`` crashes INSIDE a ControlStateJournal
+    # append between the two halves of a frame.
     "master.kill": {
         "kind": "crash", "exit": EXIT_MASTER_KILL, "times": 1,
+        "doc": "`os._exit(83)` at elapsed `at` — the UNCLEAN death "
+               "the warm standby must absorb (no supervisor "
+               "relaunch)",
     },
     "master.journal_torn": {
         "kind": "crash", "exit": EXIT_JOURNAL_TORN, "times": 1,
+        "doc": "crash `os._exit(84)` BETWEEN the two halves of a WAL "
+               "frame — the literal crash-mid-append; reopen "
+               "truncates the torn tail, losing exactly the unacked "
+               "record",
     },
-    # Live-reshard sites (ISSUE 6): a plan segment lost in flight (the
-    # mover must fail the move, not hang or accept torn bytes), a
-    # stalled peer slowing every pull, and a puller hard-killed between
-    # segment applies — all three must degrade to the checkpoint-restart
-    # ladder with fsck-clean storage.
     # Scale-out checkpoint site (ISSUE 7): a rank dies after streaming
-    # its slice bytes but BEFORE the atomic publish + done-vote — the
-    # step's slice set no longer covers the state, so the coverage proof
-    # must block commit and restore must fall back to the previous
-    # committed step.
+    # its slice bytes but BEFORE the atomic publish + done-vote.
     "storage.slice_crash": {
         "kind": "crash", "exit": EXIT_SLICE_CRASH, "times": 1,
+        "doc": "`os._exit(80)` after slice bytes hit the unpublished "
+               "tmp file — widow slice; the coverage proof must "
+               "block commit",
     },
-    "reshard.drop_segment": {"kind": "flag", "times": 1},
-    "reshard.stall_peer": {"kind": "latency", "delay": 0.5},
+    # Live-reshard sites (ISSUE 6): all three must degrade to the
+    # checkpoint-restart ladder with fsck-clean storage.
+    "reshard.drop_segment": {
+        "kind": "flag", "times": 1,
+        "doc": "a plan segment vanishes in flight; the mover must "
+               "fail the move (never hang or accept torn bytes) and "
+               "fall to the restart ladder",
+    },
+    "reshard.stall_peer": {
+        "kind": "latency", "delay": 0.5,
+        "doc": "sleep `delay` in a peer's segment server — a stalled "
+               "NIC slowing every pull",
+    },
     "reshard.crash_mid_move": {
         "kind": "crash", "exit": EXIT_RESHARD_CRASH, "times": 1,
+        "doc": "`os._exit(79)` between segment applies — the "
+               "survivors detect the lost rank; restart ladder with "
+               "fsck-clean storage",
     },
 }
 

@@ -167,6 +167,9 @@ class BaseResponse(Message):
 
 @dataclasses.dataclass
 class Empty(Message):
+    """No-op probe: deliberately handler-less — tests ping servicers
+    with it to exercise the unhandled-message path."""
+
     pass
 
 
@@ -359,9 +362,17 @@ class KVStoreScanResult(Message):
 @dataclasses.dataclass
 class KVStoreDelete(Message):
     """Delete one key (ISSUE 9): registry GC of stale gateway/replica
-    leases needs removal, not just overwrite."""
+    leases needs removal, not just overwrite.
+
+    ``token`` (ISSUE 14, graftcheck PC403): the delete is retried
+    ``idempotent=True``, but its reply carries whether THIS call
+    removed the key — a DEADLINE-retried duplicate whose first reply
+    was lost would answer found=False for a delete that actually
+    happened.  The master caches token -> first answer, the same
+    exactly-once contract as ``KVStoreAdd``."""
 
     key: str = ""
+    token: str = ""
 
 
 # ---------------------------------------------------------------------------

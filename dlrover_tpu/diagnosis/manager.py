@@ -140,6 +140,10 @@ class DiagnosisManager:
             )
         return queued
 
+    # graftcheck: disable=PC404 -- deliberately unjournaled: heartbeat
+    # action delivery is at-most-once BY DESIGN (Heartbeat is never
+    # DEADLINE-retried for the same reason); pending actions lost in a
+    # failover are re-derived by the next diagnose_once pass
     def pop_actions(self, node_id: int) -> List[m.DiagnosisAction]:
         """Actions for ``node_id``, consumed on delivery (reference
         heartbeat-reply piggyback).  Entries older than

@@ -29,6 +29,7 @@ class KVStoreService(JournalBound):
         self._store: Dict[str, bytes] = {}
         self._cond = threading.Condition()
         self._add_tokens = BoundedTokenCache()
+        self._del_tokens = BoundedTokenCache()
 
     def set(self, key: str, value: bytes) -> None:
         with self._cond:
@@ -79,11 +80,21 @@ class KVStoreService(JournalBound):
         with self._cond:
             return {k: self._store[k] for k in keys if k in self._store}
 
-    def delete(self, key: str) -> bool:
+    def delete(self, key: str, token: str = "") -> bool:
+        """Delete ``key``; the reply says whether THIS call removed it.
+        A non-empty ``token`` makes the delete idempotent: an
+        RPC-retried duplicate (same token) gets the FIRST answer —
+        without it, a retry whose first reply was lost reports
+        found=False for a delete that actually happened (graftcheck
+        PC403, the destructive-retry bug class)."""
         with self._cond:
+            cached = self._del_tokens.get(token)
+            if cached is not None:
+                return bool(cached)
             found = self._store.pop(key, None) is not None
+            self._del_tokens.put(token, found)
             if found:
-                self._jrec("kv.delete", key=key)
+                self._jrec("kv.delete", key=key, token=token)
             return found
 
     def scan(self, prefix: str) -> Dict[str, bytes]:
@@ -112,10 +123,12 @@ class KVStoreService(JournalBound):
             return {
                 "store": dict(self._store),
                 "add_tokens": self._add_tokens.dump_state(),
+                "del_tokens": self._del_tokens.dump_state(),
             }
 
     def load_state(self, state: dict) -> None:
         with self._cond:
             self._store = dict(state.get("store", {}))
             self._add_tokens.load_state(state.get("add_tokens", []))
+            self._del_tokens.load_state(state.get("del_tokens", []))
             self._cond.notify_all()

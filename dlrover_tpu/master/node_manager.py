@@ -179,6 +179,9 @@ class LocalJobManager(JournalBound):
                 node.used_resource.cpu = msg.cpu_percent
                 node.used_resource.memory_mb = int(msg.memory_mb)
 
+    # graftcheck: disable=PC404 -- write-only parity surface: nothing
+    # master-side consumes _model_info yet, and trainers re-report it
+    # at every bootstrap; journaling it would durably store dead state
     def collect_model_info(self, msg: m.ModelInfo) -> None:
         with self._lock:
             self._model_info = msg

@@ -482,6 +482,9 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         groups = [ordered[i : i + 2] for i in range(0, len(ordered), 2)]
         return groups
 
+    # graftcheck: disable=PC404 -- per-round pre-flight results are
+    # ephemeral on purpose: a failover mid-network-check loses at most
+    # one round, which the agents re-run and re-report wholesale
     def report_result(
         self, node_id: int, succeeded: bool, elapsed: float, round_: int = -1
     ) -> None:

@@ -193,7 +193,9 @@ class MasterServicer:
         return m.KVStoreScanResult(kvs=self.kv_store.scan(msg.prefix))
 
     def _on_kv_delete(self, msg: m.KVStoreDelete):
-        return m.BaseResponse(success=self.kv_store.delete(msg.key))
+        return m.BaseResponse(
+            success=self.kv_store.delete(msg.key, token=msg.token)
+        )
 
     # -- data sharding -----------------------------------------------------
     def _on_dataset_params(self, msg: m.DatasetShardParams):

@@ -107,6 +107,9 @@ class SpeedMonitor(JournalBound):
                 state.get("ckpt_stall_total", 0.0)
             )
 
+    # graftcheck: disable=PC404 -- goodput bookkeeping, not control
+    # state: the down-window marker re-arms from live signals on the
+    # standby; only the throttled speed.step baseline is journaled
     def mark_down(self) -> None:
         """Called when the job manager knows training paused (restart,
         rendezvous)."""
@@ -125,6 +128,9 @@ class SpeedMonitor(JournalBound):
                 self._downtime_total += time.time() - self._down_since  # graftcheck: disable=OB301 -- one clock family with the worker-stamped step times
                 self._down_since = None
 
+    # graftcheck: disable=PC404 -- gauge telemetry (stall/persist MB/s
+    # maps): every save re-reports it; a failover loses window samples
+    # of the goodput estimate, never control-plane decisions
     def record_ckpt_stall(
         self, seconds: float, step: Optional[int] = None,
         persist_mbps: float = 0.0, staged_mbps: float = 0.0,

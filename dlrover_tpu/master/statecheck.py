@@ -39,6 +39,7 @@ def _fresh_state() -> MasterState:
     )
     from dlrover_tpu.master.reshard import ReshardManager
     from dlrover_tpu.master.speed_monitor import SpeedMonitor
+    from dlrover_tpu.master.sync_service import SyncService
     from dlrover_tpu.master.task_manager import TaskManager
 
     return MasterState(
@@ -51,6 +52,7 @@ def _fresh_state() -> MasterState:
         reshard_manager=ReshardManager(),
         job_manager=LocalJobManager(),
         speed_monitor=SpeedMonitor(),
+        sync_service=SyncService(),
     )
 
 

@@ -4,6 +4,15 @@
 A worker joins a named sync; when every node currently in the training world
 has joined (or the owner explicitly finishes it), the barrier opens.  Used
 e.g. to align all nodes before a mesh re-layout or a coordinated checkpoint.
+
+Journaled (ISSUE 14, graftcheck PC404): workers join a barrier ONCE and
+then poll ``sync_finished`` — a master failover that lost the joins
+would leave every already-joined worker polling a barrier that can
+never open (until the client-side timeout).  Membership, the finish
+latch, and the world set are journaled before the RPC acks, so a warm
+standby resumes half-formed barriers in place; the latch is journaled
+as its own record (``sync.finished``) so replay applies the decision
+verbatim instead of re-deriving it.
 """
 
 from __future__ import annotations
@@ -11,8 +20,10 @@ from __future__ import annotations
 import threading
 from typing import Dict, Set
 
+from dlrover_tpu.master.state import JournalBound
 
-class SyncService:
+
+class SyncService(JournalBound):
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._syncs: Dict[str, Set[int]] = {}
@@ -22,14 +33,21 @@ class SyncService:
 
     def set_world(self, node_ids) -> None:
         with self._lock:
-            self._world_nodes = set(node_ids)
+            new = set(node_ids)
+            if new != self._world_nodes:
+                self._world_nodes = new
+                self._jrec("sync.world", nodes=sorted(new))
 
     def join_sync(self, sync_name: str, node_id: int) -> bool:
         with self._lock:
             members = self._syncs.setdefault(sync_name, set())
-            members.add(node_id)
-            if self._world_nodes and self._world_nodes.issubset(members):
-                self._finished.add(sync_name)
+            if node_id not in members:
+                members.add(node_id)
+                self._jrec("sync.join", name=sync_name,
+                           node_id=node_id)
+            if self._world_nodes and \
+                    self._world_nodes.issubset(members):
+                self._finish_locked(sync_name)
             return True
 
     def sync_finished(self, sync_name: str) -> bool:
@@ -39,10 +57,40 @@ class SyncService:
     def finish_sync(self, sync_name: str) -> bool:
         """Force-open a barrier (owner override, reference ``barrier``)."""
         with self._lock:
-            self._finished.add(sync_name)
+            self._finish_locked(sync_name)
             return True
+
+    def _finish_locked(self, sync_name: str) -> None:
+        if sync_name not in self._finished:
+            self._finished.add(sync_name)
+            self._jrec("sync.finished", name=sync_name)
 
     def remove_sync(self, sync_name: str) -> None:
         with self._lock:
+            existed = sync_name in self._syncs or \
+                sync_name in self._finished
             self._syncs.pop(sync_name, None)
             self._finished.discard(sync_name)
+            if existed:
+                self._jrec("sync.remove", name=sync_name)
+
+    # -- HA snapshot surface (ISSUE 13/14) ------------------------------
+    def dump_state(self) -> dict:
+        with self._lock:
+            return {
+                "syncs": {
+                    name: sorted(members)
+                    for name, members in self._syncs.items()
+                },
+                "finished": sorted(self._finished),
+                "world": sorted(self._world_nodes),
+            }
+
+    def load_state(self, state: dict) -> None:
+        with self._lock:
+            self._syncs = {
+                name: set(members)
+                for name, members in state.get("syncs", {}).items()
+            }
+            self._finished = set(state.get("finished", []))
+            self._world_nodes = set(state.get("world", []))

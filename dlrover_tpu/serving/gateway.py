@@ -1259,9 +1259,19 @@ class Gateway:
                 )
             return read
 
-        for name in ("prefix_hits", "prefix_misses", "prefix_steals",
+        # EVERY core counter is exported (graftcheck MT601): the
+        # admission/exactly-once counters below were visible only via
+        # the stats-snapshot RPC — an operator watching /metrics could
+        # not see completed/failed/timeout at all.
+        for name in ("submitted", "accepted", "rejected", "completed",
+                     "failed", "timeout", "dedupe_hits",
+                     "duplicate_completions", "late_completions",
+                     "redispatched", "replicas_lost",
+                     "streamed_tokens",
+                     "prefix_hits", "prefix_misses", "prefix_steals",
                      "kv_handoffs", "kv_rejects", "kv_bytes",
-                     "kv_p2p_bytes", "kv_relay_fallbacks",
+                     "kv_p2p_bytes", "kv_fp32_bytes",
+                     "kv_relay_fallbacks",
                      "spec_rounds", "spec_accepted", "spec_fallbacks",
                      "spec_grants", "spec_bypass",
                      "trace_sampled", "trace_unsampled"):

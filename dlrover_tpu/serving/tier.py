@@ -49,6 +49,7 @@ import hashlib
 import json
 import threading
 import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from dlrover_tpu import chaos
@@ -212,8 +213,12 @@ class RpcKv:
         return resp.kvs if isinstance(resp, KVStoreScanResult) else {}
 
     def delete(self, key: str) -> bool:
-        resp = self._c.call(KVStoreDelete(key=key), deadline=5.0,
-                            idempotent=True)
+        # Tokened (graftcheck PC403): a DEADLINE-retried delete must
+        # answer what the FIRST attempt did, not "key already gone".
+        resp = self._c.call(
+            KVStoreDelete(key=key, token=uuid.uuid4().hex),
+            deadline=5.0, idempotent=True,
+        )
         return bool(getattr(resp, "success", False))
 
     def close(self) -> None:
@@ -228,8 +233,17 @@ class RegistryServer:
 
     def __init__(self, port: int = 0):
         from dlrover_tpu.common.rpc import RpcServer, local_ip
+        from dlrover_tpu.common.token_cache import BoundedTokenCache
 
         self.kv = LocalKv()
+        # Tokened delete dedupe (graftcheck PC403): the wire path is
+        # DEADLINE-retried; the reply must be the FIRST attempt's.
+        # BoundedTokenCache is not thread-safe by itself and handle()
+        # runs on the RPC thread pool, so the check-delete-put
+        # sequence holds one lock — a retry racing its own slow first
+        # attempt must not double-pop and latch the wrong answer.
+        self._del_tokens = BoundedTokenCache()
+        self._del_mu = threading.Lock()
         self._server = RpcServer(port, self.handle)
         self._server.start()
         self.addr = f"{local_ip()}:{self._server.port}"
@@ -245,7 +259,13 @@ class RegistryServer:
         if isinstance(msg, KVStoreScan):
             return KVStoreScanResult(kvs=self.kv.scan(msg.prefix))
         if isinstance(msg, KVStoreDelete):
-            return BaseResponse(success=self.kv.delete(msg.key))
+            with self._del_mu:
+                cached = self._del_tokens.get(msg.token)
+                if cached is not None:
+                    return BaseResponse(success=bool(cached))
+                found = self.kv.delete(msg.key)
+                self._del_tokens.put(msg.token, found)
+            return BaseResponse(success=found)
         return BaseResponse(
             success=False, reason=f"unhandled {type(msg).__name__}"
         )

@@ -1,0 +1,14 @@
+"""Top-level alias so ``python -m graftcheck dlrover_tpu/`` works from
+the repo root — the canonical entry point stays
+``python -m tools.graftcheck`` (same engine, same flags)."""
+
+import sys
+
+from tools.graftcheck.engine import main
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `graftcheck ... | head` closed the pipe: not an error.
+        sys.exit(0)

@@ -385,6 +385,41 @@ class TestIdempotencyTokens:
         other = tm.get_task("d", worker_id=0, token="tok2")
         assert other[0] != first[0]
 
+    def test_kv_delete_token_dedups(self):
+        """ISSUE 14 (graftcheck PC403): KVStoreDelete is DEADLINE-
+        retried, so its found/not-found answer must come from the
+        FIRST attempt — a retried duplicate of a delete that landed
+        must not report found=False."""
+        from dlrover_tpu.master.kv_store import KVStoreService
+
+        kv = KVStoreService()
+        kv.set("k", b"v")
+        assert kv.delete("k", token="t1") is True
+        assert kv.delete("k", token="t1") is True  # retried duplicate
+        assert kv.delete("k", token="t2") is False  # genuinely gone
+        kv.set("k2", b"v")
+        assert kv.delete("k2") is True  # tokenless keeps old semantics
+
+    def test_tokened_delete_over_the_wire(self):
+        from dlrover_tpu.master.kv_store import KVStoreService
+        from dlrover_tpu.master.servicer import MasterServicer
+
+        servicer = MasterServicer(kv_store=KVStoreService())
+        server = RpcServer(0, servicer)
+        server.start()
+        try:
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            client.call(msgs.KVStoreSet(key="k", value=b"v"))
+            rm = msgs.KVStoreDelete(key="k", token="wire-tok")
+            r1 = client.call(rm)
+            r2 = client.call(rm)  # simulated retry of the same request
+            assert r1.success and r2.success
+            r3 = client.call(msgs.KVStoreDelete(key="k", token="t2"))
+            assert not r3.success
+            client.close()
+        finally:
+            server.stop()
+
     def test_tokened_add_over_the_wire(self):
         from dlrover_tpu.master.kv_store import KVStoreService
         from dlrover_tpu.master.servicer import MasterServicer
@@ -468,3 +503,66 @@ class TestCommitCrashSites:
             out.stderr[-2000:]
         )
         assert shard_file.latest_step(PosixDiskStorage(), str(tmp_path)) == 5
+
+
+class TestLatencySites:
+    """The two latency sites graftcheck CH503 found untested (ISSUE
+    14): both are armed here against their documented contracts, so
+    the chaos table's claims about them are properties, not prose."""
+
+    def test_ckpt_slow_storage_delays_matching_step_only(self):
+        chaos.configure("ckpt.slow_storage:delay=60ms,step=3,times=1")
+        t0 = time.monotonic()
+        # Step filter: the persist loops report (step, rank) exactly
+        # like engine._stream_shard / the agent saver do.
+        assert chaos.inject("ckpt.slow_storage", step=2, rank=0) is None
+        assert time.monotonic() - t0 < 0.05
+        t1 = time.monotonic()
+        spec = chaos.inject("ckpt.slow_storage", step=3, rank=0)
+        assert spec is not None and spec.kind == "latency"
+        assert time.monotonic() - t1 >= 0.055
+        # One-shot: the next matching persist is fast again.
+        t2 = time.monotonic()
+        assert chaos.inject("ckpt.slow_storage", step=3, rank=0) is None
+        assert time.monotonic() - t2 < 0.05
+
+    def test_serving_slow_replica_stalls_the_real_tick(self):
+        """Arm ``serving.slow_replica`` and drive the REAL injection
+        point — ``ReplicaRunner.tick`` — with a gateway-less
+        transport: the tick slows by the configured delay and the
+        runner keeps working (degradation, never breakage)."""
+        from dlrover_tpu.serving.replica import ReplicaRunner
+
+        class _Srv:
+            # The minimal incremental-admission surface tick touches
+            # on an idle, grant-less round.
+            last_stats = {}
+            slots = 1
+
+            def free_slots(self):
+                return 1
+
+            def pending_rids(self):
+                return []
+
+            def active_rids(self):
+                return []
+
+            def pending_count(self):
+                return 0
+
+        class _DeadTransport:
+            def call(self, msg, **_kw):
+                raise ConnectionError("gateway down")
+
+        runner = ReplicaRunner(_Srv(), _DeadTransport(), "r-slow")
+        chaos.configure("serving.slow_replica:delay=80ms,times=1")
+        t0 = time.monotonic()
+        runner.tick()  # slow round: the site fires here
+        slow = time.monotonic() - t0
+        t1 = time.monotonic()
+        runner.tick()  # budget spent: fast again
+        fast = time.monotonic() - t1
+        assert slow >= 0.075
+        assert fast < 0.05
+        assert chaos.active_plan().stats()["serving.slow_replica"] == 1

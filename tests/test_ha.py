@@ -1019,3 +1019,79 @@ class TestChaosSites:
         assert "master.kill" not in env["DLROVER_TPU_FAULTS"]
         assert "rpc.latency" in env["DLROVER_TPU_FAULTS"]
         assert "seed=5" in env["DLROVER_TPU_FAULTS"]
+
+
+@pytest.mark.ha
+class TestSyncServiceJournal:
+    """ISSUE 14 (graftcheck PC404): sync barriers are journaled.
+    Workers join a named barrier ONCE and then only poll — before this
+    the joins died with the primary and every already-joined worker
+    polled a barrier that could never open."""
+
+    def _recover(self, tmp_path):
+        state2 = _fresh_state()
+        recover_into(state2, read_state_dir(str(tmp_path)))
+        return state2.sync_service
+
+    def test_mid_barrier_joins_survive_failover(self, tmp_path):
+        j = ControlStateJournal(str(tmp_path), snapshot_every=10_000)
+        state = _fresh_state()
+        state.bind(j)
+        ss = state.sync_service
+        ss.set_world([0, 1])
+        ss.join_sync("ckpt-fence", 0)  # node 1 not in yet
+        j.close()
+
+        s2 = self._recover(tmp_path)
+        assert not s2.sync_finished("ckpt-fence")
+        # The missing node joins at the STANDBY: the barrier completes
+        # from the replayed membership + world.
+        s2.join_sync("ckpt-fence", 1)
+        assert s2.sync_finished("ckpt-fence")
+
+    def test_finished_latch_and_force_open_replay(self, tmp_path):
+        j = ControlStateJournal(str(tmp_path), snapshot_every=10_000)
+        state = _fresh_state()
+        state.bind(j)
+        ss = state.sync_service
+        ss.set_world([0, 1])
+        ss.join_sync("all", 0)
+        ss.join_sync("all", 1)   # completes -> sync.finished record
+        ss.finish_sync("forced")  # owner override latch
+        ss.join_sync("gone", 0)
+        ss.remove_sync("gone")
+        j.close()
+
+        s2 = self._recover(tmp_path)
+        assert s2.sync_finished("all")
+        assert s2.sync_finished("forced")
+        assert not s2.sync_finished("gone")
+
+    def test_snapshot_carries_sync_state(self, tmp_path):
+        j = ControlStateJournal(str(tmp_path), snapshot_every=10_000)
+        state = _fresh_state()
+        state.bind(j)
+        ss = state.sync_service
+        ss.set_world([3, 4])
+        ss.join_sync("warm", 3)
+        j.snapshot(state.capture)  # compacts the WAL away
+        j.close()
+
+        s2 = self._recover(tmp_path)
+        assert not s2.sync_finished("warm")
+        s2.join_sync("warm", 4)
+        assert s2.sync_finished("warm")
+
+    def test_world_journaled_only_on_change(self, tmp_path):
+        j = ControlStateJournal(str(tmp_path), snapshot_every=10_000)
+        state = _fresh_state()
+        state.bind(j)
+        ss = state.sync_service
+        seq0 = j.seq
+        ss.set_world([0, 1])
+        seq1 = j.seq
+        assert seq1 == seq0 + 1
+        for _ in range(5):  # the per-poll set_world must not spam WAL
+            ss.set_world([1, 0])
+        assert j.seq == seq1
+        j.close()

@@ -1579,3 +1579,30 @@ def test_journal_eager_replay_is_capped(tmp_path):
     # Newest records replay; the older ones answer via grant-time
     # lookup instead.
     assert {m.req_id for m in dones} == {f"q{i}" for i in range(30, 40)}
+
+
+def test_every_core_counter_is_exported_as_a_gauge():
+    """ISSUE 14 (graftcheck MT601): the admission/exactly-once
+    counters (submitted/completed/failed/timeout/...) were visible
+    only via the stats-snapshot RPC — /metrics showed none of them.
+    Every GatewayCore counter now has a ``serve_<name>`` gauge."""
+    from dlrover_tpu.agent.metrics import MetricsRegistry
+    from dlrover_tpu.serving.gateway import Gateway
+
+    gw = Gateway(port=0)
+    try:
+        reg = MetricsRegistry()
+        gw.register_gauges(reg)
+        core = gw.core
+        core.register("r0", slots=2)
+        core.submit("rq1", [1, 2, 3], 4, 0.0)
+        body = reg.render()
+        for name in core.counters:
+            assert f"serve_{name} " in body, (
+                f"counter {name!r} has no serve_{name} gauge"
+            )
+        # And the fix's headline signals carry real values.
+        assert "serve_submitted 1.0" in body
+        assert "serve_accepted 1.0" in body
+    finally:
+        gw.stop(grace=0.1)

@@ -1065,3 +1065,69 @@ def test_gateway_tier_node_heartbeats_and_gcs(tmp_path):
     finally:
         node.stop()
     assert kv.get("serve/node/gw/g0") is None
+
+
+def test_registry_server_tokened_delete_answers_first_result():
+    """ISSUE 14 (graftcheck PC403): RpcKv.delete retries DEADLINE, so
+    the standalone registry dedupes delete tokens exactly like the
+    master KV — a retried duplicate of a landed delete answers True."""
+    from dlrover_tpu.common.messages import (
+        KVStoreDelete,
+        KVStoreSet,
+    )
+    from dlrover_tpu.serving.tier import RegistryServer
+
+    srv = RegistryServer(port=0)
+    try:
+        srv.handle(KVStoreSet(key="k", value=b"v"))
+        rm = KVStoreDelete(key="k", token="tok")
+        assert srv.handle(rm).success
+        assert srv.handle(rm).success  # retried duplicate
+        assert not srv.handle(
+            KVStoreDelete(key="k", token="tok2")
+        ).success
+    finally:
+        srv.stop()
+
+
+def test_registry_server_delete_dedupe_is_race_safe():
+    """A DEADLINE retry can race its own slow first attempt: both must
+    answer the FIRST result (True), and the cache must not latch the
+    loser's False (the handle() pool is 64 threads wide)."""
+    import threading as _threading
+
+    from dlrover_tpu.common.messages import KVStoreDelete, KVStoreSet
+    from dlrover_tpu.serving.tier import RegistryServer
+
+    srv = RegistryServer(port=0)
+    try:
+        srv.handle(KVStoreSet(key="k", value=b"v"))
+        slow = _threading.Event()
+        real_delete = srv.kv.delete
+
+        def slow_delete(key):
+            got = real_delete(key)
+            slow.wait(0.2)  # hold the first attempt mid-sequence
+            return got
+
+        srv.kv.delete = slow_delete
+        results = {}
+
+        def attempt(tag):
+            results[tag] = srv.handle(
+                KVStoreDelete(key="k", token="tok")
+            ).success
+
+        t1 = _threading.Thread(target=attempt, args=("first",))
+        t2 = _threading.Thread(target=attempt, args=("retry",))
+        t1.start()
+        t2.start()
+        slow.set()
+        t1.join()
+        t2.join()
+        assert results == {"first": True, "retry": True}
+        # The cached answer stays True for any further retry.
+        assert srv.handle(KVStoreDelete(key="k", token="tok")).success
+    finally:
+        srv.kv.delete = real_delete
+        srv.stop()

@@ -1,11 +1,19 @@
-"""graftcheck — repo-native static analysis for JAX/TPU and
-concurrency hazards.
+"""graftcheck — repo-native static analysis for JAX/TPU, concurrency,
+and cross-module protocol hazards.
 
 The classes of bug that hurt this codebase most are exactly the ones
 the test suite catches late or never: tracer leaks and silent
-recompilation in the jit-heavy data plane, and lock-discipline races in
-the threaded master/agent control plane.  graftcheck is an AST pass
-that flags those shapes *before* they run.
+recompilation in the jit-heavy data plane, lock-discipline races in
+the threaded master/agent control plane, and — since the control plane
+became a real distributed protocol — contracts that only exist BETWEEN
+modules: which messages have handlers, which RPC retries are safe,
+which mutations the HA journal covers, which chaos sites and counters
+are real.  graftcheck flags those shapes *before* they run.
+
+v2 is a two-pass engine: pass 1 builds a whole-program project model
+(``project_model.py``); pass 2 runs the per-file AST families below on
+each analyzed file plus the cross-module families (``proto_rules.py``)
+over the model.
 
 Rule families
 -------------
@@ -35,11 +43,50 @@ Concurrency (control plane):
 - ``CC104`` — ``except:`` / ``except Exception:`` whose body is only
   ``pass``/``continue``: swallows errors on RPC/retry paths.
 
+Observability:
+
+- ``OB301`` — a ``time.time()`` delta used as a duration/deadline
+  (wall clocks step; use monotonic/perf_counter).
+
+Protocol (cross-module, over the project model):
+
+- ``PC401`` — a message sent at a ``.call(...)`` site that no dispatch
+  table or ``isinstance`` handler accepts.
+- ``PC402`` — a dispatch-table entry for a non-message type.
+- ``PC403`` — ``idempotent=True`` retry of a handler that
+  destructively consumes state without reading an idempotency token
+  (the Heartbeat destructive-retry bug class).
+- ``PC404`` — a mutating manager method reachable from a journaled
+  servicer's handler that never reaches ``_jrec`` (acks before the
+  control-state journal append on the HA path).
+- ``PC405`` — a message class referenced nowhere outside its defining
+  module (product or tests): dead protocol surface.
+
+Lock discipline (cross-module):
+
+- ``LK201`` — whole-program lock-order cycle / nested re-acquisition
+  of a non-reentrant Lock (potential deadlock; RLock re-entry exempt).
+- ``LK202`` — a ``_*_locked`` method called without the lock held.
+
+Chaos coverage:
+
+- ``CH501`` — a ``SITES`` entry never injected anywhere.
+- ``CH502`` — an injected site string not declared in ``SITES``.
+- ``CH503`` — a declared site no test references.
+
+Metrics drift:
+
+- ``MT601`` — a counter incremented but never exported by any gauge
+  registration.
+- ``MT602`` — one module registering the same gauge name twice.
+
 Meta:
 
 - ``GC000`` — a suppression comment without a justification.  An
   unjustified suppression does NOT suppress; the policy is enforced by
   the tool itself.
+- ``GC001`` — a stale suppression whose rule no longer fires on the
+  covered line (delete it).  Neither meta rule is suppressible.
 
 Suppression syntax
 ------------------
@@ -55,6 +102,9 @@ from .engine import (  # noqa: F401
     RULES,
     check_source,
     check_file,
+    check_project,
     run_paths,
+    run_project,
+    render_chaos_table,
     main,
 )

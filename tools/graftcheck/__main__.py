@@ -3,4 +3,8 @@ import sys
 from .engine import main
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `graftcheck ... | head` closed the pipe: not an error.
+        sys.exit(0)

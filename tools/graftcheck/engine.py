@@ -1,10 +1,14 @@
 """graftcheck engine: file walking, suppression parsing, reporters.
 
-The engine owns everything rule-independent: turning a source blob into
-an AST plus a suppression map, dispatching to the rule modules, marking
-findings suppressed, and rendering human/JSON reports.  Rules live in
-``jax_rules.py`` and ``concurrency_rules.py`` and are pure functions
-``(tree, path) -> Iterable[Finding]``.
+The engine owns everything rule-independent: turning source blobs into
+ASTs plus suppression maps, the TWO-PASS drive (pass 1 builds the
+whole-program :mod:`project_model`; pass 2 runs the per-file rule
+modules on each analyzed file and the cross-module
+:mod:`proto_rules` over the model), marking findings suppressed,
+stale-suppression detection (GC001), and rendering human/JSON/chaos-
+table reports.  Per-file rules live in ``jax_rules.py``,
+``concurrency_rules.py`` and ``obs_rules.py``; cross-module rules in
+``proto_rules.py`` — all are pure functions over ASTs/model.
 """
 
 from __future__ import annotations
@@ -12,14 +16,19 @@ from __future__ import annotations
 import argparse
 import ast
 import dataclasses
+import io
 import json
 import os
 import re
+import subprocess
 import sys
-from typing import Dict, Iterable, List, Tuple
+import tokenize
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 RULES: Dict[str, str] = {
     "GC000": "suppression comment without justification",
+    "GC001": "stale suppression: the named rule no longer fires on "
+             "the covered line",
     "JX001": "Python if/while branches on a traced value inside jit",
     "JX002": "host sync inside jit scope (float()/.item()/np.asarray/"
              "block_until_ready)",
@@ -35,7 +44,32 @@ RULES: Dict[str, str] = {
              "errors",
     "OB301": "time.time() delta used as a duration/deadline "
              "(monotonic/perf_counter required; wall clocks step)",
+    "PC401": "message sent via .call() that no handler accepts",
+    "PC402": "dispatch-table entry for a non-message type",
+    "PC403": "idempotent=True retry of a handler that destructively "
+             "mutates without consuming an idempotency token",
+    "PC404": "mutating servicer-reachable manager method that never "
+             "appends to the control-state journal (acks before the "
+             "journal write on the HA path)",
+    "PC405": "message class referenced nowhere outside its defining "
+             "module (dead protocol surface)",
+    "LK201": "whole-program lock-order cycle / nested re-acquisition "
+             "of a non-reentrant lock (potential deadlock)",
+    "LK202": "_locked-suffix method called without the documented "
+             "lock held",
+    "CH501": "chaos site declared in SITES but never injected",
+    "CH502": "injected chaos site not declared in SITES (plan parser "
+             "rejects it — dead injection point)",
+    "CH503": "chaos site referenced by no test",
+    "MT601": "counter incremented but never exported by any gauge "
+             "registration",
+    "MT602": "gauge name registered twice in one module (first "
+             "callback silently dark)",
 }
+
+#: Meta rules the suppression machinery itself emits; a suppression
+#: cannot silence them (the fix is editing the suppression).
+_UNSUPPRESSIBLE = {"GC000", "GC001"}
 
 
 @dataclasses.dataclass
@@ -57,6 +91,24 @@ _SUPPRESS_RE = re.compile(
 )
 
 
+def _comment_cols(source: str) -> Optional[Dict[int, int]]:
+    """line -> start column of that line's comment token.  Tokenizing
+    keeps suppression syntax QUOTED in docstrings/strings (the tool's
+    own documentation!) from registering as live suppressions — a
+    line-regex alone saw them, and GC001 then flagged the examples as
+    stale.  None = source does not tokenize (caller falls back to the
+    lexical scan; such files already fail parsing anyway)."""
+    cols: Dict[int, int] = {}
+    try:
+        for tok in tokenize.generate_tokens(
+                io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                cols[tok.start[0]] = tok.start[1]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
+    return cols
+
+
 def _parse_suppressions(
     source: str, path: str
 ) -> Tuple[Dict[int, Dict[str, str]], List[Finding]]:
@@ -67,15 +119,22 @@ def _parse_suppressions(
     blank lines — e.g. a justification spanning several comment lines —
     are skipped).  A suppression with no ``-- justification`` text
     covers NOTHING and is itself a GC000 finding — the justification
-    policy is enforced here, not by review.
+    policy is enforced here, not by review.  Only REAL comment tokens
+    count: the suppression syntax quoted inside a string/docstring is
+    documentation, not a directive.
     """
     per_line: Dict[int, Dict[str, str]] = {}
     meta: List[Finding] = []
     pending: Dict[str, str] = {}
     pending_line = 0
+    comments = _comment_cols(source)
     for lineno, text in enumerate(source.splitlines(), start=1):
         stripped = text.strip()
         m = _SUPPRESS_RE.search(text)
+        if m is not None and comments is not None:
+            col = comments.get(lineno)
+            if col is None or m.start() < col:
+                m = None  # inside a string literal, not a comment
         comment_only = stripped.startswith("#")
         if pending and stripped and not comment_only:
             # First code line after a standalone suppression — it gets
@@ -122,42 +181,112 @@ def _parse_suppressions(
     return per_line, meta
 
 
-def check_source(source: str, path: str = "<string>") -> List[Finding]:
-    """Run every rule over one source blob; returns ALL findings,
-    suppressed ones included (``suppressed=True`` + justification)."""
-    from . import concurrency_rules, jax_rules, obs_rules
+# ---------------------------------------------------------------------------
+# two-pass analysis
+# ---------------------------------------------------------------------------
 
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [Finding(
-            "GC000", path, e.lineno or 1,
-            f"file does not parse: {e.msg}",
-        )]
-    suppress, findings = _parse_suppressions(source, path)
-    for rule_mod in (jax_rules, concurrency_rules, obs_rules):
-        findings.extend(rule_mod.check(tree, path))
+
+def _analyze_sources(
+    sources: Dict[str, str],
+    targets: Optional[Set[str]] = None,
+    test_text: Optional[str] = None,
+):
+    """The core drive: parse every file, build the project model over
+    ALL of them, run per-file + cross-module rules, apply
+    suppressions, detect stale ones.  ``targets`` restricts which
+    files findings are REPORTED for (the ``--changed`` fast loop) —
+    the model always spans every supplied source so cross-module
+    rules stay sound.  Returns (findings, model)."""
+    from . import concurrency_rules, jax_rules, obs_rules, proto_rules
+    from .project_model import FileInfo, build_model
+
+    if targets is None:
+        targets = set(sources)
+    findings: List[Finding] = []
+    infos: List[FileInfo] = []
+    suppress: Dict[str, Dict[int, Dict[str, str]]] = {}
+    for path, source in sources.items():
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            if path in targets:
+                findings.append(Finding(
+                    "GC000", path, e.lineno or 1,
+                    f"file does not parse: {e.msg}",
+                ))
+            continue
+        infos.append(FileInfo(path=path, source=source, tree=tree))
+        sup, meta = _parse_suppressions(source, path)
+        suppress[path] = sup
+        if path in targets:
+            findings.extend(meta)
+            for rule_mod in (jax_rules, concurrency_rules, obs_rules):
+                findings.extend(rule_mod.check(tree, path))
+    model = build_model(infos, test_text=test_text)
+    findings.extend(
+        f for f in proto_rules.check_project(model)
+        if f.path in targets
+    )
+    used: Set[Tuple[str, int, str]] = set()
     for f in findings:
-        just = suppress.get(f.line, {}).get(f.rule)
-        if just is not None and f.rule != "GC000":
+        just = suppress.get(f.path, {}).get(f.line, {}).get(f.rule)
+        if just is not None and f.rule not in _UNSUPPRESSIBLE:
             f.suppressed = True
             f.justification = just
+            used.add((f.path, f.line, f.rule))
+    # GC001: a justified suppression whose rule no longer fires on the
+    # covered line is dead weight that silently licenses FUTURE
+    # instances of the hazard — surface it so it gets deleted.
+    for path in sorted(set(suppress) & targets):
+        for line in sorted(suppress[path]):
+            for rid, _just in sorted(suppress[path][line].items()):
+                if (path, line, rid) not in used:
+                    findings.append(Finding(
+                        "GC001", path, line,
+                        f"stale suppression: {rid} does not fire on "
+                        "this line any more — delete the comment "
+                        "(keeping it would silently cover a future "
+                        "regression)",
+                    ))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, model
+
+
+def check_source(source: str, path: str = "<string>") -> List[Finding]:
+    """Run every rule over one source blob (the blob is the whole
+    program for the cross-module families); returns ALL findings,
+    suppressed ones included (``suppressed=True`` + justification)."""
+    findings, _model = _analyze_sources({path: source})
     return findings
 
 
-def check_file(path: str) -> List[Finding]:
+def check_project(sources: Dict[str, str],
+                  test_text: Optional[str] = None) -> List[Finding]:
+    """Multi-file fixture entry point (tests): ``sources`` maps
+    virtual paths to source blobs; the project model spans all of
+    them."""
+    findings, _model = _analyze_sources(sources, test_text=test_text)
+    return findings
+
+
+def _read_source(path: str) -> Tuple[Optional[str], Optional[Finding]]:
     try:
         with open(path, "r", encoding="utf-8") as fh:
-            source = fh.read()
+            return fh.read(), None
     except UnicodeDecodeError as e:
         # Same contract as a SyntaxError: one finding, not a crash —
         # the gate must stay readable on a stray latin-1 file.
-        return [Finding(
+        return None, Finding(
             "GC000", path, 1,
             f"file is not valid UTF-8 ({e.reason} at byte "
             f"{e.start}); not analyzed",
-        )]
+        )
+
+
+def check_file(path: str) -> List[Finding]:
+    source, err = _read_source(path)
+    if err is not None:
+        return [err]
     return check_source(source, path)
 
 
@@ -182,11 +311,150 @@ def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
                     yield os.path.join(root, name)
 
 
+def _detect_tests_dir(paths: Iterable[str]) -> Optional[str]:
+    """The repo's test tree, for CH503/PC405: a ``tests`` directory
+    beside an analyzed root, or under the cwd."""
+    bases = [os.path.dirname(os.path.abspath(p)) for p in paths]
+    bases.append(os.getcwd())
+    for base in bases:
+        cand = os.path.join(base, "tests")
+        if os.path.isdir(cand):
+            return cand
+    return None
+
+
+def _read_test_text(tests_dir: Optional[str]) -> Optional[str]:
+    if not tests_dir or not os.path.isdir(tests_dir):
+        return None
+    chunks = []
+    for path in iter_py_files([tests_dir]):
+        try:
+            with open(path, "r", encoding="utf-8",
+                      errors="replace") as fh:
+                chunks.append(fh.read())
+        except OSError:
+            continue
+    return "\n".join(chunks)
+
+
+def run_project(
+    paths: Iterable[str],
+    model_paths: Optional[Iterable[str]] = None,
+    tests_dir: Optional[str] = None,
+    targets: Optional[Iterable[str]] = None,
+):
+    """Analyze ``paths`` with a model spanning ``model_paths`` (default
+    = ``paths``).  ``targets`` further restricts reporting to specific
+    files (``--changed``).  Returns (findings, model)."""
+    paths = list(paths)
+    target_files = [
+        os.path.normpath(p) for p in iter_py_files(paths)
+    ]
+    model_files = list(target_files)
+    if model_paths is not None:
+        seen = set(model_files)
+        for p in iter_py_files(model_paths):
+            norm = os.path.normpath(p)
+            if norm not in seen:
+                seen.add(norm)
+                model_files.append(norm)
+    if targets is not None:
+        # Absolute-path matching: git names are repo-root-relative
+        # while the analyzed paths may be absolute or cwd-relative —
+        # a spelling mismatch must never silently report "clean".
+        wanted = {os.path.abspath(t) for t in targets}
+        target_set = {
+            p for p in target_files if os.path.abspath(p) in wanted
+        }
+    else:
+        target_set = set(target_files)
+    sources: Dict[str, str] = {}
+    pre: List[Finding] = []
+    for path in model_files:
+        source, err = _read_source(path)
+        if err is not None:
+            if path in target_set:
+                pre.append(err)
+            continue
+        sources[path] = source
+    if tests_dir is None:
+        tests_dir = _detect_tests_dir(
+            list(paths) + list(model_paths or [])
+        )
+    findings, model = _analyze_sources(
+        sources, targets=target_set,
+        test_text=_read_test_text(tests_dir),
+    )
+    findings = sorted(
+        pre + findings, key=lambda f: (f.path, f.line, f.rule)
+    )
+    return findings, model
+
+
 def run_paths(paths: Iterable[str]) -> List[Finding]:
-    findings: List[Finding] = []
-    for path in iter_py_files(paths):
-        findings.extend(check_file(path))
+    findings, _model = run_project(paths)
     return findings
+
+
+def changed_files(ref: str = "HEAD",
+                  cwd: Optional[str] = None) -> List[str]:
+    """Changed AND untracked .py files as absolute paths — the
+    ``--changed`` pre-commit loop's target set.  Untracked files are
+    included (`git ls-files --others`): a brand-new module is exactly
+    where findings are most likely.  Paths are resolved against the
+    git toplevel so the caller's cwd and path spelling don't matter."""
+    top = subprocess.run(
+        ["git", "rev-parse", "--show-toplevel"],
+        capture_output=True, text=True, cwd=cwd, check=True,
+    ).stdout.strip()
+    # Both listings run FROM the toplevel: ls-files --others is
+    # cwd-scoped and cwd-relative, so a subdirectory cwd would both
+    # hide untracked files elsewhere and mis-resolve the names.
+    out = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"],
+        capture_output=True, text=True, cwd=top, check=True,
+    ).stdout
+    out += subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        capture_output=True, text=True, cwd=top, check=True,
+    ).stdout
+    files = []
+    for line in out.splitlines():
+        name = line.strip()
+        if not name.endswith(".py"):
+            continue
+        path = os.path.join(top, name)
+        if os.path.isfile(path):
+            files.append(path)
+    return files
+
+
+def find_model_root(paths: Iterable[str]) -> Optional[str]:
+    """The ``dlrover_tpu`` package root governing ``paths``: walk up
+    from each analyzed path (NOT the cwd — a subset run from another
+    directory must still get the whole-program model or cross-module
+    rules see orphans everywhere), then fall back to the cwd."""
+    candidates = [os.path.abspath(p) for p in paths]
+    candidates.append(os.getcwd())
+    for start in candidates:
+        cur = start if os.path.isdir(start) else os.path.dirname(start)
+        while True:
+            if os.path.basename(cur) == "dlrover_tpu" and \
+                    os.path.isdir(cur):
+                return cur
+            cand = os.path.join(cur, "dlrover_tpu")
+            if os.path.isdir(cand):
+                return cand
+            parent = os.path.dirname(cur)
+            if parent == cur:
+                break
+            cur = parent
+    return None
+
+
+# ---------------------------------------------------------------------------
+# reporters
+# ---------------------------------------------------------------------------
 
 
 def render_human(findings: List[Finding], show_suppressed=False) -> str:
@@ -217,11 +485,53 @@ def render_json(findings: List[Finding]) -> str:
     }, indent=2)
 
 
+def render_chaos_table(model) -> str:
+    """The README chaos-site table, generated from the project model
+    so docs cannot drift from ``chaos/plan.py``: site + kind (with
+    exit code / default delay), the modules that inject it, and the
+    declaration's ``doc`` text."""
+    from .project_model import module_of
+
+    lines = [
+        "| Site | Kind | Injected in | Effect |",
+        "|------|------|-------------|--------|",
+    ]
+    injects: Dict[str, Set[str]] = {}
+    for i in model.injects:
+        injects.setdefault(i.name, set()).add(module_of(i.path))
+    for site in sorted(model.chaos_sites):
+        decl = model.chaos_sites[site]
+        kind = decl.kind
+        if kind == "crash" and decl.exit_code:
+            kind = f"crash (exit {decl.exit_code})"
+        elif kind == "latency" and decl.delay:
+            kind = f"latency ({decl.delay:g}s)"
+        where = injects.get(site, set())
+        if not where:
+            # Sites armed through variables (the master main's
+            # has_site tuple): any module whose source names the site.
+            where = {
+                module_of(p) for p, fi in model.files.items()
+                if p != decl.path and site in fi.source
+            }
+        lines.append(
+            f"| `{site}` | {kind} | "
+            f"{', '.join(f'`{w}`' for w in sorted(where)) or '—'} | "
+            f"{decl.doc or '—'} |"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftcheck",
-        description="repo-native static analysis for JAX/TPU and "
-                    "concurrency hazards",
+        description="repo-native static analysis for JAX/TPU, "
+                    "concurrency, and cross-module protocol hazards",
     )
     ap.add_argument("paths", nargs="*", default=["dlrover_tpu"],
                     help="files or directories (default: dlrover_tpu)")
@@ -230,13 +540,68 @@ def main(argv=None) -> int:
     ap.add_argument("--show-suppressed", action="store_true",
                     help="include suppressed findings in human output")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None,
+        metavar="REF",
+        help="report only findings in files of `git diff --name-only "
+             "REF` (default HEAD); the project model is still built "
+             "over the full paths so cross-module rules stay sound",
+    )
+    ap.add_argument(
+        "--chaos-table", action="store_true",
+        help="print the chaos-site markdown table generated from the "
+             "project model (the README embeds exactly this)",
+    )
+    ap.add_argument(
+        "--tests", default=None, metavar="DIR",
+        help="test tree for CH503 coverage checks (default: a "
+             "'tests' directory beside the analyzed root)",
+    )
     args = ap.parse_args(argv)
     if args.list_rules:
         for rid, desc in sorted(RULES.items()):
             print(f"{rid}  {desc}")
         return 0
+    paths = args.paths or ["dlrover_tpu"]
+    targets = None
+    if args.changed is not None:
+        try:
+            targets = changed_files(args.changed)
+        except (subprocess.CalledProcessError, OSError) as e:
+            print(f"graftcheck: --changed failed: {e}",
+                  file=sys.stderr)
+            return 2
+        if not targets:
+            print("graftcheck: 0 finding(s) (no changed .py files)")
+            return 0
+    # Partial invocations (a single file, a subdirectory) still get a
+    # sound whole-program model: cross-module rules over a file subset
+    # would see orphan messages, missing handlers, and — worse — emit
+    # GC001 "stale suppression" for suppressions the FULL model needs.
+    # The root is derived from the ANALYZED paths (cwd only as a
+    # fallback), and run_project dedupes the union, so expanding is
+    # free when the root was already given.
+    root = find_model_root(paths)
+    model_paths = [root] if root is not None else None
+    if args.chaos_table:
+        # The table is derived purely from the pass-1 model — skip
+        # the rule pipeline entirely (targets=[]) so the README
+        # regeneration loop stays fast.
+        try:
+            _findings, model = run_project(
+                paths, model_paths=model_paths, tests_dir=args.tests,
+                targets=[],
+            )
+        except FileNotFoundError as e:
+            print(e, file=sys.stderr)
+            return 2
+        print(render_chaos_table(model))
+        return 0
     try:
-        findings = run_paths(args.paths or ["dlrover_tpu"])
+        findings, model = run_project(
+            paths, model_paths=model_paths, tests_dir=args.tests,
+            targets=targets,
+        )
     except FileNotFoundError as e:
         print(e, file=sys.stderr)
         return 2

@@ -1,0 +1,927 @@
+"""graftcheck pass 1: the whole-program project model.
+
+Per-file AST rules (JX/CC/OB) see one file at a time; the contracts
+that actually hold this control plane together — which message types
+have servicer handlers, which ``RpcClient.call`` sites may retry,
+which mutations the HA journal covers, which chaos sites are real,
+which counters reach an operator — span modules.  This pass walks
+every analyzed file ONCE and builds the cross-module index the PC/LK/
+CH/MT rule families (``proto_rules.py``) run over.
+
+Everything here is lexical, matching the repo's idioms:
+
+- message classes: ``class X(Message)`` dataclasses;
+- dispatch tables: ``{m.X: self._on_x, ...}`` dict literals, and
+  ``isinstance(msg, X)`` guards inside handler functions;
+- RPC call sites: ``<client>.call(X(...), ..., idempotent=...)``;
+- chaos: the ``SITES`` dict literal in ``chaos/plan.py`` vs the string
+  literals fed to ``inject(...)`` / ``site_armed(...)`` /
+  ``has_site(...)``;
+- metrics: ``<counters>.inc("name")`` vs gauge registrations —
+  including the repo's loop-over-literal-tuple registration idiom,
+  whose f-string gauge names are expanded here;
+- locks: ``with self.<lock>:`` acquisition nesting plus the
+  one-level call graph (self methods, ``self.attr = Class(...)``
+  typed attributes, same-module functions) that turns per-class lock
+  use into a whole-program lock-order graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .jax_rules import _Ancestry, _ancestors, _dotted
+
+#: Container-mutator method names: a ``self.<attr>.<verb>(...)`` call
+#: with one of these verbs writes instance state.
+_MUTATOR_VERBS = {
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "push",
+}
+
+#: Verbs that are DESTRUCTIVE under retry: re-running them consumes /
+#: drops something a lost first reply already consumed (the PR-2
+#: Heartbeat bug: the handler pops pending DiagnosisActions).
+_DESTRUCTIVE_VERBS = {"pop", "popleft", "popitem"}
+
+#: Message fields that act as dedupe keys: a handler that reads one of
+#: these participates in the idempotency-token protocol.
+_TOKEN_FIELDS = {"token", "attempt_id", "req_id"}
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+_INJECT_FUNCS = {"inject", "site_armed", "has_site"}
+
+
+def module_of(path: str) -> str:
+    """A stable, repo-relative module label for ``path`` (used in
+    reports and the chaos table, where absolute tmp/CI prefixes would
+    make output non-deterministic)."""
+    norm = path.replace("\\", "/")
+    for anchor in ("dlrover_tpu/", "tools/"):
+        i = norm.rfind(anchor)
+        if i >= 0:
+            return norm[i:]
+    return norm.rsplit("/", 1)[-1]
+
+
+# ---------------------------------------------------------------------------
+# records
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FileInfo:
+    path: str
+    source: str
+    tree: ast.Module
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One ``<client>.call(Msg(...), ...)`` site."""
+
+    msg: str
+    path: str
+    line: int
+    idempotent: bool
+
+
+@dataclasses.dataclass
+class DispatchEntry:
+    """One ``{m.X: self._on_x}`` dispatch-table row."""
+
+    msg: str
+    handler: str  # method attr name ("" when not a self method)
+    path: str
+    line: int
+    cls: Optional[ast.ClassDef]
+
+
+@dataclasses.dataclass
+class IsinstanceHandler:
+    """One ``isinstance(<var>, X)`` guard over a known message type."""
+
+    msg: str
+    var: str
+    path: str
+    line: int
+    func: Optional[ast.AST]  # enclosing function (handler body scope)
+
+
+@dataclasses.dataclass
+class ChaosSite:
+    name: str
+    kind: str
+    path: str
+    line: int
+    exit_code: int = 0
+    times: int = -1
+    delay: float = 0.0
+    doc: str = ""
+
+
+@dataclasses.dataclass
+class InjectSite:
+    name: str
+    path: str
+    line: int
+
+
+@dataclasses.dataclass
+class CounterInc:
+    name: str
+    path: str
+    line: int
+
+
+@dataclasses.dataclass
+class GaugeReg:
+    """One registered gauge name (f-strings over literal loops are
+    expanded; ``values`` are the placeholder strings that produced the
+    name — the counter keys a registration loop exports)."""
+
+    name: str
+    path: str
+    line: int
+    values: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class MethodInfo:
+    name: str
+    node: ast.AST
+    writes_state: bool = False  # any self-state write
+    destructive: bool = False  # retry-unsafe consumption
+    has_jrec: bool = False  # calls self._jrec(...)
+    self_calls: Set[str] = dataclasses.field(default_factory=set)
+    # (held_lock_id or None, acquired_lock_id) nesting, plus calls made
+    # while holding each lock — the LK201 edge inputs.
+    acquires: List[Tuple[Optional[str], str, int]] = \
+        dataclasses.field(default_factory=list)
+    calls_under: List[Tuple[str, "_CallRef", int]] = \
+        dataclasses.field(default_factory=list)
+    #: every outgoing call regardless of lock state (transitive lock-
+    #: acquisition closure) and ``self.<m>()`` calls made while NOT
+    #: holding any lock (the LK202 `_locked`-contract check).
+    attr_calls: List[Tuple[str, str]] = \
+        dataclasses.field(default_factory=list)
+    func_calls: Set[str] = dataclasses.field(default_factory=set)
+    self_calls_unlocked: List[Tuple[str, int]] = \
+        dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class _CallRef:
+    """A call made while holding a lock: ``self.m()``,
+    ``self.attr.m()`` or a bare same-module ``fn()``."""
+
+    kind: str  # "self" | "attr" | "func"
+    attr: str  # manager/collaborator attribute ("" for self/func)
+    method: str
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    path: str
+    node: ast.ClassDef
+    bases: Tuple[str, ...]
+    lock_attrs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: ``self.<attr> = ClassName(...)`` typed collaborators.
+    attr_types: Dict[str, Set[str]] = \
+        dataclasses.field(default_factory=dict)
+    methods: Dict[str, MethodInfo] = \
+        dataclasses.field(default_factory=dict)
+
+    def lock_id(self, attr: str) -> str:
+        return f"{module_of(self.path)}::{self.name}.{attr}"
+
+
+# ---------------------------------------------------------------------------
+# literal-string resolution (the repo's loop/dict/ifexp idioms)
+# ---------------------------------------------------------------------------
+
+
+def _const_strs(node: ast.AST) -> Optional[List[str]]:
+    """The string elements of a literal tuple/list (None when any
+    element is not a plain string)."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for el in node.elts:
+        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+            out.append(el.value)
+        else:
+            return None
+    return out
+
+
+class _Resolver:
+    """Resolves an expression to the set of string literals it can
+    evaluate to, using enclosing ``for`` loops over literal iterables
+    and module-level string-tuple constants.  Returns None when any
+    path is unresolvable — rules skip rather than guess."""
+
+    def __init__(self, consts: Dict[str, List[str]]):
+        self.consts = consts
+
+    def resolve(self, node: ast.AST, depth: int = 0) \
+            -> Optional[Set[str]]:
+        if depth > 6:
+            return None
+        if isinstance(node, ast.Constant):
+            return {node.value} if isinstance(node.value, str) else None
+        if isinstance(node, ast.IfExp):
+            a = self.resolve(node.body, depth + 1)
+            b = self.resolve(node.orelse, depth + 1)
+            return a | b if a is not None and b is not None else None
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Dict):
+            # {"hit": "prefix_hits", ...}[route] — all values possible.
+            vals: Set[str] = set()
+            for v in node.value.values:
+                got = self.resolve(v, depth + 1)
+                if got is None:
+                    return None
+                vals |= got
+            return vals
+        if isinstance(node, ast.Name):
+            return self._resolve_name(node, depth)
+        return None
+
+    def _iter_values(self, it: ast.AST, depth: int) \
+            -> Optional[List[ast.AST]]:
+        if isinstance(it, (ast.Tuple, ast.List)):
+            return list(it.elts)
+        name = _dotted(it)
+        if name is not None:
+            vals = self.consts.get(name.split(".")[-1])
+            if vals is not None:
+                return [ast.Constant(value=v) for v in vals]
+        return None
+
+    def _resolve_name(self, node: ast.Name, depth: int) \
+            -> Optional[Set[str]]:
+        # Walk enclosing For loops: ``for name in ("a", "b")`` and the
+        # tuple-unpacking ``for src, dst in (("a","b"), ...)`` forms.
+        for anc in _ancestors(node):
+            if not isinstance(anc, ast.For):
+                continue
+            tgt = anc.target
+            if isinstance(tgt, ast.Name) and tgt.id == node.id:
+                elts = self._iter_values(anc.iter, depth)
+                if elts is None:
+                    return None
+                out: Set[str] = set()
+                for el in elts:
+                    got = self.resolve(el, depth + 1)
+                    if got is None:
+                        return None
+                    out |= got
+                return out
+            if isinstance(tgt, ast.Tuple):
+                for idx, sub in enumerate(tgt.elts):
+                    if isinstance(sub, ast.Name) and sub.id == node.id:
+                        elts = self._iter_values(anc.iter, depth)
+                        if elts is None:
+                            return None
+                        out = set()
+                        for el in elts:
+                            if not isinstance(el, (ast.Tuple, ast.List)) \
+                                    or idx >= len(el.elts):
+                                return None
+                            got = self.resolve(el.elts[idx], depth + 1)
+                            if got is None:
+                                return None
+                            out |= got
+                        return out
+        vals = self.consts.get(node.id)
+        return set(vals) if vals is not None else None
+
+    def expand_fstring(self, node: ast.JoinedStr) \
+            -> Optional[List[Tuple[str, Tuple[str, ...]]]]:
+        """Expand an f-string to [(name, placeholder values)] — the
+        gauge-registration loop idiom.  None when unresolvable."""
+        parts: List[List[Tuple[str, Tuple[str, ...]]]] = \
+            [[("", ())]]
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                opts = [(str(piece.value), ())]
+            elif isinstance(piece, ast.FormattedValue):
+                got = self.resolve(piece.value)
+                if got is None:
+                    return None
+                opts = [(v, (v,)) for v in sorted(got)]
+            else:
+                return None
+            parts.append(opts)
+        combos: List[Tuple[str, Tuple[str, ...]]] = [("", ())]
+        for opts in parts:
+            combos = [
+                (pre + txt, vals + v)
+                for pre, vals in combos
+                for txt, v in opts
+            ]
+        return combos
+
+
+# ---------------------------------------------------------------------------
+# per-class analysis
+# ---------------------------------------------------------------------------
+
+
+def _self_attr_of(node: ast.AST) -> Optional[str]:
+    """'x' for ``self.x`` / ``self.x[...]`` chains."""
+    base = node
+    while isinstance(base, ast.Subscript):
+        base = base.value
+    if (isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"):
+        return base.attr
+    return None
+
+
+def _is_lockish(expr: ast.AST, lock_attrs: Dict[str, str]) -> bool:
+    name = _dotted(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = _dotted(expr.func)
+    if name is None:
+        return False
+    last = name.split(".")[-1]
+    if name.startswith("self.") and name.split(".", 1)[1] in lock_attrs:
+        return True
+    low = last.lower()
+    return "lock" in low or low.endswith("_mu") or low == "cond" or \
+        "cond" in low
+
+
+def _lock_name_of(expr: ast.AST) -> str:
+    name = _dotted(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = _dotted(expr.func) or "<lock>"
+    return name or "<lock>"
+
+
+class _MethodWalk(ast.NodeVisitor):
+    """One method's walk: state writes, destructive ops, _jrec, lock
+    acquisition nesting and calls-under-lock.  Nested defs reset lock
+    state (a closure defined under a lock does not RUN under it)."""
+
+    def __init__(self, cls: ClassInfo, info: MethodInfo):
+        self.cls = cls
+        self.info = info
+        self.held: List[str] = []
+
+    # -- locks ----------------------------------------------------------
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        name = _lock_name_of(expr)
+        if name.startswith("self."):
+            return self.cls.lock_id(name.split(".", 1)[1])
+        return f"{module_of(self.cls.path)}::{name}"
+
+    def visit_With(self, node):
+        entered: List[str] = []
+        for item in node.items:
+            if _is_lockish(item.context_expr, self.cls.lock_attrs):
+                lid = self._lock_id(item.context_expr)
+                if lid is not None:
+                    held = self.held[-1] if self.held else None
+                    self.info.acquires.append(
+                        (held, lid, node.lineno)
+                    )
+                    self.held.append(lid)
+                    entered.append(lid)
+        for child in node.body:
+            self.visit(child)
+        for _ in entered:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _visit_fn(self, node):
+        prev, self.held = self.held, []
+        for child in node.body:
+            self.visit(child)
+        self.held = prev
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # -- calls ----------------------------------------------------------
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _DESTRUCTIVE_VERBS or \
+                    f.attr.startswith("pop"):
+                # A bare-statement pop is cleanup (the popped value is
+                # discarded); consuming the VALUE is what makes a
+                # retry destructive (the Heartbeat pop_actions shape).
+                parent = next(iter(_ancestors(node)), None)
+                if not isinstance(parent, ast.Expr):
+                    self.info.destructive = True
+            tgt = _self_attr_of(f.value) if not (
+                isinstance(f.value, ast.Name) and f.value.id == "self"
+            ) else None
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                if f.attr == "_jrec":
+                    self.info.has_jrec = True
+                self.info.self_calls.add(f.attr)
+                if self.held:
+                    self.info.calls_under.append((
+                        self.held[-1],
+                        _CallRef("self", "", f.attr),
+                        node.lineno,
+                    ))
+                else:
+                    self.info.self_calls_unlocked.append(
+                        (f.attr, node.lineno)
+                    )
+            elif tgt is not None:
+                if tgt == "_journal" and f.attr == "append":
+                    # Direct journal writes (the speed monitor's
+                    # throttled baseline) count the same as _jrec.
+                    self.info.has_jrec = True
+                if f.attr in _MUTATOR_VERBS:
+                    self.info.writes_state = True
+                self.info.attr_calls.append((tgt, f.attr))
+                if self.held:
+                    self.info.calls_under.append((
+                        self.held[-1],
+                        _CallRef("attr", tgt, f.attr),
+                        node.lineno,
+                    ))
+        elif isinstance(f, ast.Name):
+            self.info.func_calls.add(f.id)
+            if self.held:
+                self.info.calls_under.append((
+                    self.held[-1], _CallRef("func", "", f.id),
+                    node.lineno,
+                ))
+        self.generic_visit(node)
+
+    # -- writes ---------------------------------------------------------
+    def _note_write(self, target: ast.AST, aug: bool) -> None:
+        attr = _self_attr_of(target)
+        if attr is None:
+            return
+        self.info.writes_state = True
+        if aug and isinstance(target, ast.Subscript):
+            # read-modify-write on keyed state: retry-unsafe.
+            self.info.destructive = True
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._note_write(t, aug=False)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._note_write(node.target, aug=True)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._note_write(node.target, aug=False)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node):
+        for t in node.targets:
+            if _self_attr_of(t) is not None:
+                self.info.writes_state = True
+                self.info.destructive = True
+        self.generic_visit(node)
+
+
+def _analyze_class(path: str, cls: ast.ClassDef) -> ClassInfo:
+    bases = tuple(
+        b for b in (_dotted(x) for x in cls.bases) if b is not None
+    )
+    info = ClassInfo(name=cls.name, path=path, node=cls, bases=bases)
+    def _ctor_name(v: ast.AST) -> Optional[str]:
+        if not isinstance(v, ast.Call):
+            return None
+        if isinstance(v.func, ast.Attribute):
+            return v.func.attr
+        if isinstance(v.func, ast.Name):
+            return v.func.id
+        return None
+
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        ctors: List[str] = []
+        fname = _ctor_name(v)
+        if fname is not None:
+            ctors = [fname]
+        elif isinstance(v, ast.Dict):
+            # self.rdzv_managers = {NAME: Manager(), ...}
+            ctors = [
+                c for c in (_ctor_name(dv) for dv in v.values)
+                if c is not None
+            ]
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                if fname in _LOCK_FACTORIES:
+                    info.lock_attrs[t.attr] = fname or ""
+                else:
+                    for c in ctors:
+                        if c and c[0].isupper():
+                            info.attr_types.setdefault(
+                                t.attr, set()
+                            ).add(c)
+    for meth in cls.body:
+        if not isinstance(meth, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        mi = MethodInfo(name=meth.name, node=meth)
+        walker = _MethodWalk(info, mi)
+        for stmt in meth.body:
+            walker.visit(stmt)
+        info.methods[meth.name] = mi
+    return info
+
+
+# ---------------------------------------------------------------------------
+# the model
+# ---------------------------------------------------------------------------
+
+
+class ProjectModel:
+    def __init__(self):
+        self.files: Dict[str, FileInfo] = {}
+        self.messages: Dict[str, Tuple[str, int]] = {}
+        self.dispatch: List[DispatchEntry] = []
+        self.iso_handlers: List[IsinstanceHandler] = []
+        self.call_sites: List[CallSite] = []
+        self.classes: Dict[str, List[ClassInfo]] = {}
+        self.class_by_node: Dict[int, ClassInfo] = {}
+        self.chaos_sites: Dict[str, ChaosSite] = {}
+        self.injects: List[InjectSite] = []
+        self.counter_incs: List[CounterInc] = []
+        self.gauge_regs: List[GaugeReg] = []
+        self.unresolved_gauge_regs: int = 0
+        #: path -> every Name id / Attribute attr mentioned (cheap
+        #: reference index for orphan detection).
+        self.mentions: Dict[str, Set[str]] = {}
+        #: module-level str-tuple constants, by bare name (global).
+        self.consts: Dict[str, List[str]] = {}
+        #: module-level int constants (the chaos EXIT_* codes).
+        self.int_consts: Dict[str, int] = {}
+        #: concatenated raw text of the test tree ("" = not supplied;
+        #: CH503 only runs when it is).
+        self.test_text: Optional[str] = None
+        #: functions per module path (for same-module call edges).
+        self.module_funcs: Dict[str, Dict[str, MethodInfo]] = {}
+        #: constructor-ish call sites indexed by callee name (one pass
+        #: over every tree — rules must never re-walk the program per
+        #: dispatch entry; the ``--changed`` loop has a latency budget).
+        self.ctor_calls: Dict[str, List[Tuple[str, "ast.Call"]]] = {}
+
+    # -- lookups used by the rules --------------------------------------
+    def classes_named(self, name: str) -> List[ClassInfo]:
+        return self.classes.get(name, [])
+
+    def handled_messages(self) -> Set[str]:
+        out = {e.msg for e in self.dispatch}
+        out |= {h.msg for h in self.iso_handlers}
+        return out
+
+    def mentioned_outside(self, name: str, def_path: str) -> bool:
+        return any(
+            name in names for p, names in self.mentions.items()
+            if p != def_path
+        )
+
+    def resolve_method(self, class_name: str, method: str,
+                       _seen: Optional[Set[str]] = None) \
+            -> Optional[Tuple["ClassInfo", "MethodInfo"]]:
+        """Find ``method`` on ``class_name`` or (lexically) its bases
+        — the owner class is what the mutation/journal analysis runs
+        over, so a subclass inheriting a journaled base method is
+        judged by the base's body."""
+        seen = _seen or set()
+        if class_name in seen:
+            return None
+        seen.add(class_name)
+        for ci in self.classes_named(class_name):
+            mi = ci.methods.get(method)
+            if mi is not None:
+                return ci, mi
+            for base in ci.bases:
+                got = self.resolve_method(
+                    base.split(".")[-1], method, seen
+                )
+                if got is not None:
+                    return got
+        return None
+
+    def _method_flag(self, class_name: str, method: str, flag: str,
+                     follow_private_only: bool,
+                     _seen: Optional[Set[Tuple[str, str]]] = None) \
+            -> bool:
+        seen = _seen if _seen is not None else set()
+        key = (class_name, method)
+        if key in seen:
+            return False
+        seen.add(key)
+        got = self.resolve_method(class_name, method)
+        if got is None:
+            # Unresolvable body: destructiveness is judged by name —
+            # the Heartbeat bug is literally a ``pop_*`` call.
+            return flag == "destructive" and method.startswith("pop")
+        _, mi = got
+        if getattr(mi, flag):
+            return True
+        return any(
+            self._method_flag(class_name, callee, flag,
+                              follow_private_only, seen)
+            for callee in mi.self_calls
+            if not follow_private_only or callee.startswith("_")
+        )
+
+    def method_reaches_jrec(self, class_name: str,
+                            method: str) -> bool:
+        return self._method_flag(class_name, method, "has_jrec",
+                                 follow_private_only=False)
+
+    def method_mutates(self, class_name: str, method: str) -> bool:
+        # Only PRIVATE callees propagate: a public callee owns its own
+        # journal/idempotency contract and is judged separately.
+        return self._method_flag(class_name, method, "writes_state",
+                                 follow_private_only=True)
+
+    def method_destructive(self, class_name: str,
+                           method: str) -> bool:
+        return self._method_flag(class_name, method, "destructive",
+                                 follow_private_only=False)
+
+
+def _msg_name_of(node: ast.AST) -> Optional[str]:
+    """The message-class name a dispatch key / isinstance arg / call
+    argument refers to (``m.X`` -> "X", bare ``X`` -> "X")."""
+    name = _dotted(node)
+    if name is None:
+        return None
+    return name.split(".")[-1]
+
+
+def _node_classdef(model: ProjectModel, fi: FileInfo,
+                   node: ast.ClassDef) -> None:
+    for base in node.bases:
+        name = _dotted(base)
+        if name is not None and name.split(".")[-1] == "Message":
+            model.messages[node.name] = (fi.path, node.lineno)
+            break
+    ci = _analyze_class(fi.path, node)
+    model.classes.setdefault(node.name, []).append(ci)
+    model.class_by_node[id(node)] = ci
+
+
+def _node_dict(model: ProjectModel, fi: FileInfo,
+               node: ast.Dict) -> None:
+    rows = []
+    for k, v in zip(node.keys, node.values):
+        if k is None:
+            continue
+        msg = _msg_name_of(k)
+        handler = ""
+        if (isinstance(v, ast.Attribute)
+                and isinstance(v.value, ast.Name)
+                and v.value.id == "self"):
+            handler = v.attr
+        if msg is not None and handler:
+            rows.append((msg, handler, k.lineno))
+    # A dispatch table is a dict that is MOSTLY msg -> self-method
+    # rows; one stray pair in an unrelated dict must not count.
+    if len(rows) < 2:
+        return
+    cls = None
+    for anc in _ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            cls = anc
+            break
+    for msg, handler, line in rows:
+        model.dispatch.append(DispatchEntry(
+            msg=msg, handler=handler, path=fi.path, line=line,
+            cls=cls,
+        ))
+
+
+def _node_sites_assign(model: ProjectModel, fi: FileInfo,
+                       node: ast.AST) -> None:
+    targets = node.targets if isinstance(node, ast.Assign) \
+        else [node.target]
+    tnames = {t.id for t in targets if isinstance(t, ast.Name)}
+    if "SITES" not in tnames or not isinstance(node.value, ast.Dict):
+        return
+    for k, v in zip(node.value.keys, node.value.values):
+        if not (isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+                and isinstance(v, ast.Dict)):
+            continue
+        fields: Dict[str, object] = {}
+        for fk, fv in zip(v.keys, v.values):
+            if not isinstance(fk, ast.Constant):
+                continue
+            if isinstance(fv, ast.Constant):
+                fields[fk.value] = fv.value
+            elif isinstance(fv, ast.Name):
+                # EXIT_* module constants resolve to their int.
+                fields[fk.value] = model.int_consts.get(fv.id, 0)
+        model.chaos_sites[k.value] = ChaosSite(
+            name=k.value,
+            kind=str(fields.get("kind", "flag")),
+            path=fi.path, line=k.lineno,
+            exit_code=fields.get("exit", 0)  # type: ignore
+            if isinstance(fields.get("exit"), int) else 0,
+            times=int(fields.get("times", -1))  # type: ignore
+            if isinstance(fields.get("times"), int) else -1,
+            delay=float(fields.get("delay", 0.0))  # type: ignore
+            if isinstance(fields.get("delay"), (int, float))
+            else 0.0,
+            doc=str(fields.get("doc", "")),
+        )
+
+
+def _node_call(model: ProjectModel, fi: FileInfo, node: ast.Call,
+               resolver: _Resolver) -> None:
+    f = node.func
+    fname = None
+    if isinstance(f, ast.Name):
+        fname = f.id
+    elif isinstance(f, ast.Attribute):
+        fname = f.attr
+    if fname and fname[0].isupper():
+        model.ctor_calls.setdefault(fname, []).append((fi.path, node))
+    # isinstance(msg, X) handler guards.
+    if (isinstance(f, ast.Name) and f.id == "isinstance"
+            and len(node.args) == 2):
+        var = _dotted(node.args[0]) or ""
+        cand = node.args[1]
+        classes = (
+            [_msg_name_of(e) for e in cand.elts]
+            if isinstance(cand, ast.Tuple) else [_msg_name_of(cand)]
+        )
+        func = None
+        for anc in _ancestors(node):
+            if isinstance(anc, (ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                func = anc
+                break
+        for cname in classes:
+            if cname is not None:
+                model.iso_handlers.append(IsinstanceHandler(
+                    msg=cname, var=var.split(".")[-1], path=fi.path,
+                    line=node.lineno, func=func,
+                ))
+        return
+    if not node.args:
+        return
+    # <client>.call(Msg(...), ..., idempotent=...) sites.
+    if isinstance(f, ast.Attribute) and f.attr == "call" and \
+            isinstance(node.args[0], ast.Call):
+        msg = _msg_name_of(node.args[0].func)
+        if msg is not None:
+            idem = False
+            for kw in node.keywords:
+                if kw.arg == "idempotent":
+                    idem = bool(
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                    )
+            model.call_sites.append(CallSite(
+                msg=msg, path=fi.path, line=node.lineno,
+                idempotent=idem,
+            ))
+    # inject("site") / site_armed("site") / has_site("site").
+    if fname in _INJECT_FUNCS:
+        got = resolver.resolve(node.args[0])
+        if got is not None:
+            for site in sorted(got):
+                model.injects.append(InjectSite(
+                    name=site, path=fi.path, line=node.lineno,
+                ))
+    # metrics: counter incs + gauge registrations.
+    if not isinstance(f, ast.Attribute):
+        return
+    if f.attr == "inc":
+        got = resolver.resolve(node.args[0])
+        if got is not None:
+            for name in sorted(got):
+                model.counter_incs.append(CounterInc(
+                    name=name, path=fi.path, line=node.lineno,
+                ))
+    elif f.attr == "gauge":
+        arg0 = node.args[0]
+        if isinstance(arg0, ast.JoinedStr):
+            combos = resolver.expand_fstring(arg0)
+            if combos is None:
+                model.unresolved_gauge_regs += 1
+                return
+            for name, values in combos:
+                model.gauge_regs.append(GaugeReg(
+                    name=name, path=fi.path, line=node.lineno,
+                    values=values,
+                ))
+        else:
+            got = resolver.resolve(arg0)
+            if got is None:
+                model.unresolved_gauge_regs += 1
+                return
+            for name in sorted(got):
+                model.gauge_regs.append(GaugeReg(
+                    name=name, path=fi.path, line=node.lineno,
+                    values=(name,),
+                ))
+    elif f.attr == "register_gauges" and len(node.args) >= 2:
+        # Histogram.register_gauges(registry, "prefix") expands to
+        # the metrics.py suffix set.
+        got = resolver.resolve(node.args[1])
+        if got is not None:
+            for prefix in sorted(got):
+                for suffix in ("_count", "_p50_ms", "_p95_ms",
+                               "_p99_ms"):
+                    model.gauge_regs.append(GaugeReg(
+                        name=prefix + suffix, path=fi.path,
+                        line=node.lineno,
+                    ))
+
+
+def _collect_consts(model: ProjectModel, fi: FileInfo) -> None:
+    for node in fi.tree.body:
+        if isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, int):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        model.int_consts[t.id] = node.value.value
+                continue
+            vals = _const_strs(node.value)
+            if vals is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    model.consts[t.id] = vals
+
+
+def _collect_module_funcs(model: ProjectModel, fi: FileInfo) -> None:
+    funcs: Dict[str, MethodInfo] = {}
+    shell = ClassInfo(name="<module>", path=fi.path,
+                      node=ast.ClassDef(
+                          name="<module>", bases=[], keywords=[],
+                          body=[], decorator_list=[]),
+                      bases=())
+    for node in fi.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mi = MethodInfo(name=node.name, node=node)
+            walker = _MethodWalk(shell, mi)
+            for stmt in node.body:
+                walker.visit(stmt)
+            funcs[node.name] = mi
+    model.module_funcs[fi.path] = funcs
+
+
+def build_model(files: Iterable[FileInfo],
+                test_text: Optional[str] = None) -> ProjectModel:
+    model = ProjectModel()
+    infos = list(files)
+    for fi in infos:
+        _Ancestry().visit(fi.tree)
+        model.files[fi.path] = fi
+        _collect_consts(model, fi)
+    resolver = _Resolver(model.consts)
+    # ONE walk per file: every collector below is a per-node dispatch
+    # (the naive one-pass-per-collector layout dominated the
+    # ``--changed`` latency budget).
+    for fi in infos:
+        _collect_module_funcs(model, fi)
+        mentions: Set[str] = set()
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Name):
+                mentions.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                # Reference index for orphan detection (PC405): bare
+                # names count; attribute references only off the
+                # messages-module aliases — ``queue.Empty`` must not
+                # keep a dead ``Empty`` message alive.
+                base = _dotted(node.value)
+                if base in ("m", "messages", "msg", "msgs"):
+                    mentions.add(node.attr)
+            elif isinstance(node, ast.Call):
+                _node_call(model, fi, node, resolver)
+            elif isinstance(node, ast.ClassDef):
+                _node_classdef(model, fi, node)
+            elif isinstance(node, ast.Dict):
+                _node_dict(model, fi, node)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                _node_sites_assign(model, fi, node)
+        model.mentions[fi.path] = mentions
+    model.test_text = test_text
+    return model

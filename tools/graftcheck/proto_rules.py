@@ -1,0 +1,753 @@
+"""graftcheck pass 2: whole-program protocol rules (PC/LK/CH/MT).
+
+These run over the :mod:`project_model`, not a single file — each rule
+checks a contract that only exists BETWEEN modules:
+
+PC4xx — RPC contracts
+    PC401  a message type constructed at a ``.call(...)`` site that no
+           dispatch table or ``isinstance`` handler anywhere accepts;
+    PC402  a dispatch-table entry for a name that is not a registered
+           message class;
+    PC403  a call site retried with ``idempotent=True`` whose handler
+           destructively consumes state without reading an idempotency
+           token (``token``/``attempt_id``/``req_id``) — the PR-2
+           Heartbeat destructive-retry bug, now a lint;
+    PC404  a mutating manager method reachable from a journaled
+           servicer's handler that never appends to the control-state
+           journal (``_jrec``) — on the HA path the ack would precede
+           (or never get) the ControlStateJournal append, so a warm
+           standby adopts state missing that mutation;
+    PC405  a message class in a messages module that nothing outside
+           its defining file references (dead protocol surface).
+
+LK2xx — lock discipline
+    LK201  a cycle in the whole-program lock-order graph (edges from
+           lexically nested ``with`` acquisitions plus the one-level
+           call graph), or a nested re-acquisition of a plain
+           non-reentrant ``Lock``;
+    LK202  a ``self._*_locked(...)`` call made while no lock is held
+           (and not from another ``*_locked`` method) — the documented
+           caller-holds-the-lock contract, violated.
+
+CH5xx — chaos coverage
+    CH501  a site declared in ``SITES`` that no ``inject``/
+           ``site_armed``/``has_site`` call (or site-string literal
+           anywhere in product code) references;
+    CH502  an injected site string that is not declared in ``SITES``
+           (it can never fire — the plan parser rejects it);
+    CH503  a declared site no test file mentions (an untested failure
+           mode; only checked when the engine found a test tree).
+
+MT6xx — metrics drift
+    MT601  a counter name passed to ``.inc(...)`` that no gauge
+           registration exports (invisible to operators — the inverse
+           of the PR-12 registered-but-never-incremented warning);
+    MT602  the same gauge name registered on two different lines of
+           one module (one of the two callbacks is silently dark).
+
+Every rule is lexical and conservative: unresolvable names make a rule
+skip, not guess, and a deliberate instance is suppressed at the
+anchoring line with a justified ``# graftcheck: disable=ID`` comment
+like every other family.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import Finding
+from .project_model import ClassInfo, ProjectModel, _TOKEN_FIELDS
+
+#: Manager methods PC404 never charges: replay/restore entry points run
+#: journal-UNBOUND by design, and binding itself is not a mutation.
+_PC404_EXEMPT = {"bind_journal", "load_state", "restore", "replay",
+                 "apply", "rearm_clocks", "rearm_doing",
+                 "rearm_deadline", "rearm_heartbeats"}
+
+
+# ---------------------------------------------------------------------------
+# shared handler analysis
+# ---------------------------------------------------------------------------
+
+
+def _servicer_mgr_types(model: ProjectModel,
+                        servicer: ClassInfo) -> Dict[str, Set[str]]:
+    """attr -> candidate manager class names for a dispatch-table
+    servicer: the class's own ``self.x = Class()`` assignments plus
+    constructor keywords resolved at every ``Servicer(kw=self.y)``
+    call site (the masters wire managers in this way).  Memoized on
+    the model — PC403 and PC404 both consult it per handler."""
+    cache = getattr(model, "_mgr_types_cache", None)
+    if cache is None:
+        cache = model._mgr_types_cache = {}
+    got = cache.get(id(servicer.node))
+    if got is not None:
+        return got
+    out: Dict[str, Set[str]] = {
+        k: set(v) for k, v in servicer.attr_types.items()
+    }
+    for path, node in model.ctor_calls.get(servicer.name, []):
+        caller = _enclosing_classinfo(model, path, node)
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue
+            classes = _expr_classes(model, caller, kw.value)
+            if classes:
+                out.setdefault(kw.arg, set()).update(classes)
+    cache[id(servicer.node)] = out
+    return out
+
+
+def _enclosing_classinfo(model: ProjectModel, path: str,
+                         node: ast.AST) -> Optional[ClassInfo]:
+    from .jax_rules import _ancestors
+
+    for anc in _ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            return model.class_by_node.get(id(anc))
+    return None
+
+
+def _expr_classes(model: ProjectModel, caller: Optional[ClassInfo],
+                  expr: ast.AST) -> Set[str]:
+    """Candidate class names an expression evaluates to: a direct
+    ``Class(...)`` construction, ``self.x`` resolved through the
+    caller's typed attributes, or a dict of either."""
+    out: Set[str] = set()
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None
+        )
+        if name and name[0].isupper():
+            out.add(name)
+    elif isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and \
+            expr.value.id == "self" and caller is not None:
+        out |= caller.attr_types.get(expr.attr, set())
+    elif isinstance(expr, ast.Dict):
+        for v in expr.values:
+            out |= _expr_classes(model, caller, v)
+    return out
+
+
+def _local_mgr_types(servicer: Optional[ClassInfo], meth,
+                     mgr_types: Dict[str, Set[str]]) \
+        -> Dict[str, Set[str]]:
+    """Handler-local variables typed to manager classes: ``mgr =
+    self.rdzv_managers.get(...)`` or ``mgr = self._rdzv(name)`` where
+    the helper's body touches a manager attribute.  ``meth`` is an AST
+    node or a list of statements."""
+    out: Dict[str, Set[str]] = {}
+    if servicer is None:
+        return out
+    stmts = meth if isinstance(meth, list) else [meth]
+
+    def classes_of_value(value: ast.AST,
+                         depth: int = 0) -> Set[str]:
+        found: Set[str] = set()
+        for sub in ast.walk(value):
+            if (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"):
+                if sub.attr in mgr_types:
+                    found |= mgr_types[sub.attr]
+                elif depth < 1:
+                    helper = servicer.methods.get(sub.attr)
+                    if helper is not None:
+                        for stmt in ast.walk(helper.node):
+                            if isinstance(stmt, (ast.Return,
+                                                 ast.Assign)):
+                                v = getattr(stmt, "value", None)
+                                if v is not None:
+                                    found |= classes_of_value(
+                                        v, depth + 1
+                                    )
+        return found
+
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Assign):
+                continue
+            classes = classes_of_value(node.value)
+            if not classes:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, set()).update(classes)
+    return out
+
+
+def _manager_calls(meth: ast.AST, servicer: ClassInfo,
+                   mgr_types: Dict[str, Set[str]]) \
+        -> List[Tuple[Set[str], str, int]]:
+    """(candidate classes, method, line) for every manager-method call
+    a handler makes — ``self.<mgr>.<m>(...)`` and typed-local
+    ``var.<m>(...)`` forms."""
+    local = _local_mgr_types(servicer, meth, mgr_types)
+    out: List[Tuple[Set[str], str, int]] = []
+    for node in ast.walk(meth):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        f = node.func
+        if (isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "self"
+                and f.value.attr in mgr_types):
+            out.append((mgr_types[f.value.attr], f.attr, node.lineno))
+        elif isinstance(f.value, ast.Name) and f.value.id in local:
+            out.append((local[f.value.id], f.attr, node.lineno))
+    return out
+
+
+def _mentions_token_field(body: Iterable[ast.AST]) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in _TOKEN_FIELDS:
+                return True
+    return False
+
+
+def _handler_bodies(model: ProjectModel, msg: str) \
+        -> List[Tuple[str, int, List[ast.AST], Optional[ClassInfo],
+                      str]]:
+    """(path, line, body statements, servicer class, label) for every
+    handler of message type ``msg`` — dict-dispatch methods plus
+    isinstance-guarded blocks."""
+    out = []
+    for e in model.dispatch:
+        if e.msg != msg or e.cls is None:
+            continue
+        ci = model.class_by_node.get(id(e.cls))
+        if ci is None:
+            continue
+        mi = ci.methods.get(e.handler)
+        if mi is None:
+            continue
+        out.append((
+            ci.path, mi.node.lineno, list(mi.node.body), ci,
+            f"{ci.name}.{e.handler}",
+        ))
+    for h in model.iso_handlers:
+        if h.msg != msg or h.func is None:
+            continue
+        ci = _enclosing_classinfo(model, h.path, h.func)
+        # Positive ``if isinstance(msg, X):`` guards scope the handler
+        # to the If body; the negated early-return idiom (``if not
+        # isinstance: return``) means the whole function IS the
+        # handler.
+        body: List[ast.AST] = list(h.func.body)
+        label = getattr(h.func, "name", "<handler>")
+        for node in ast.walk(h.func):
+            if isinstance(node, ast.If) and \
+                    node.lineno <= h.line and any(
+                        getattr(n, "lineno", -1) == h.line
+                        and isinstance(n, ast.Call)
+                        for n in ast.walk(node.test)
+                    ):
+                negated = isinstance(node.test, ast.UnaryOp) and \
+                    isinstance(node.test.op, ast.Not)
+                if not negated:
+                    body = list(node.body)
+                break
+        if ci is not None:
+            label = f"{ci.name}.{label}"
+        out.append((h.path, h.line, body, ci, label))
+    return out
+
+
+def _body_destructive(model: ProjectModel, body: List[ast.AST],
+                      owner: Optional[ClassInfo],
+                      mgr_types: Dict[str, Set[str]]) -> bool:
+    """Does a handler body destructively consume state — directly, via
+    a self method, or via a (resolvable) manager/collaborator call
+    (including handler-local ``mgr = self._rdzv(...)`` typed vars)?"""
+    local = _local_mgr_types(owner, body, mgr_types)
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Delete):
+                return True
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            f = node.func
+            if f.attr.startswith("pop"):
+                from .jax_rules import _ancestors
+
+                parent = next(iter(_ancestors(node)), None)
+                if not isinstance(parent, ast.Expr):
+                    return True
+            # self.<m>() on the owner class.
+            if (isinstance(f.value, ast.Name)
+                    and f.value.id == "self" and owner is not None):
+                if model.method_destructive(owner.name, f.attr):
+                    return True
+                continue
+            # manager / typed-attribute calls.
+            classes: Set[str] = set()
+            if (isinstance(f.value, ast.Attribute)
+                    and isinstance(f.value.value, ast.Name)
+                    and f.value.value.id == "self"):
+                attr = f.value.attr
+                classes = mgr_types.get(attr, set())
+                if not classes and owner is not None:
+                    classes = owner.attr_types.get(attr, set())
+            elif isinstance(f.value, ast.Name):
+                classes = local.get(f.value.id, set())
+            for cname in classes:
+                if model.method_destructive(cname, f.attr):
+                    return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# PC4xx — RPC contracts
+# ---------------------------------------------------------------------------
+
+
+def _check_pc401(model: ProjectModel, findings: List[Finding]) -> None:
+    handled = model.handled_messages()
+    seen: Set[str] = set()
+    for cs in model.call_sites:
+        if cs.msg not in model.messages or cs.msg in handled:
+            continue
+        if cs.msg in seen:
+            continue
+        seen.add(cs.msg)
+        findings.append(Finding(
+            "PC401", cs.path, cs.line,
+            f"message {cs.msg} is sent here but no dispatch table or "
+            "isinstance handler anywhere accepts it — every call gets "
+            "the servicer's 'unhandled message type' error",
+        ))
+
+
+def _check_pc402(model: ProjectModel, findings: List[Finding]) -> None:
+    if not model.messages:
+        return
+    for e in model.dispatch:
+        if e.msg not in model.messages:
+            findings.append(Finding(
+                "PC402", e.path, e.line,
+                f"dispatch-table entry for {e.msg} which is not a "
+                "registered Message subclass — the key can never "
+                "match a deserialized request",
+            ))
+
+
+def _check_pc403(model: ProjectModel, findings: List[Finding]) -> None:
+    seen: Set[Tuple[str, int]] = set()
+    for cs in model.call_sites:
+        if not cs.idempotent or cs.msg not in model.messages:
+            continue
+        for path, line, body, owner, label in \
+                _handler_bodies(model, cs.msg):
+            mgr_types: Dict[str, Set[str]] = {}
+            if owner is not None:
+                mgr_types = _servicer_mgr_types(model, owner)
+            if _mentions_token_field(body):
+                continue  # participates in the token protocol
+            if not _body_destructive(model, body, owner, mgr_types):
+                continue
+            site = (cs.path, cs.line)
+            if site in seen:
+                continue
+            seen.add(site)
+            findings.append(Finding(
+                "PC403", cs.path, cs.line,
+                f"{cs.msg} is retried with idempotent=True but its "
+                f"handler {label} destructively consumes state "
+                "without reading an idempotency token — a "
+                "DEADLINE-retried duplicate re-consumes (the "
+                "Heartbeat destructive-retry bug class); drop the "
+                "flag or thread a token the handler dedupes on",
+            ))
+
+
+def _model_has_journal(model: ProjectModel) -> bool:
+    return any(
+        "_jrec" in ci.methods or mi.has_jrec
+        for lst in model.classes.values() for ci in lst
+        for mi in ci.methods.values()
+    )
+
+
+def _check_pc404(model: ProjectModel, findings: List[Finding]) -> None:
+    if not _model_has_journal(model):
+        return
+    reported: Set[Tuple[str, str]] = set()
+    for e in model.dispatch:
+        if e.cls is None:
+            continue
+        servicer = model.class_by_node.get(id(e.cls))
+        if servicer is None:
+            continue
+        mi = servicer.methods.get(e.handler)
+        if mi is None:
+            continue
+        mgr_types = _servicer_mgr_types(model, servicer)
+        # Only journaled control planes are held to journal-before-ack:
+        # a servicer none of whose managers ever journals (a gateway, a
+        # test fixture) has its own durability story.
+        plane_journaled = any(
+            model.method_reaches_jrec(cname, m.name)
+            for classes in mgr_types.values() for cname in classes
+            for ci in model.classes_named(cname)
+            for m in ci.methods.values()
+        )
+        if not plane_journaled:
+            continue
+        for classes, meth, line in _manager_calls(
+                mi.node, servicer, mgr_types):
+            if meth in _PC404_EXEMPT or meth.startswith("get") or \
+                    meth.startswith("dump"):
+                continue
+            for cname in sorted(classes):
+                got = model.resolve_method(cname, meth)
+                if got is None:
+                    continue
+                owner_ci, owner_mi = got
+                if not model.method_mutates(cname, meth):
+                    continue
+                if model.method_reaches_jrec(cname, meth):
+                    continue
+                key = (owner_ci.name, meth)
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(Finding(
+                    "PC404", owner_ci.path, owner_mi.node.lineno,
+                    f"{owner_ci.name}.{meth} mutates master state and "
+                    f"is reachable from servicer handler "
+                    f"{servicer.name}.{e.handler} ({e.msg}) but never "
+                    "journals (_jrec): on the HA path the RPC acks "
+                    "before any ControlStateJournal append, so a warm "
+                    "standby loses this mutation — journal it, or "
+                    "suppress documenting why the state is ephemeral",
+                ))
+
+
+def _check_pc405(model: ProjectModel, findings: List[Finding]) -> None:
+    import re as _re
+
+    for name, (path, line) in sorted(model.messages.items()):
+        if not path.replace("\\", "/").endswith("messages.py"):
+            continue
+        if model.mentioned_outside(name, path):
+            continue
+        if model.test_text and _re.search(
+                r"\b%s\b" % _re.escape(name), model.test_text):
+            continue  # tests are consumers too (probe messages)
+        findings.append(Finding(
+            "PC405", path, line,
+            f"message class {name} is referenced nowhere outside its "
+            "defining module — dead protocol surface (no sender, no "
+            "handler); delete it or wire it up",
+        ))
+
+
+# ---------------------------------------------------------------------------
+# LK2xx — lock discipline
+# ---------------------------------------------------------------------------
+
+
+def _acquired_closure(model: ProjectModel, class_name: str,
+                      method: str,
+                      _seen: Optional[Set[Tuple[str, str]]] = None,
+                      _depth: int = 0) -> Set[str]:
+    """Every lock id a call into ``class_name.method`` may acquire,
+    through the one-level-resolved call graph (bounded depth)."""
+    seen = _seen if _seen is not None else set()
+    key = (class_name, method)
+    if key in seen or _depth > 6:
+        return set()
+    seen.add(key)
+    got = model.resolve_method(class_name, method)
+    if got is None:
+        return set()
+    ci, mi = got
+    out = {acq for (_held, acq, _ln) in mi.acquires}
+    for callee in mi.self_calls:
+        out |= _acquired_closure(model, class_name, callee, seen,
+                                 _depth + 1)
+    for attr, meth in mi.attr_calls:
+        for cname in ci.attr_types.get(attr, set()):
+            out |= _acquired_closure(model, cname, meth, seen,
+                                     _depth + 1)
+    for fname in mi.func_calls:
+        fmi = model.module_funcs.get(ci.path, {}).get(fname)
+        if fmi is not None:
+            out |= {acq for (_h, acq, _ln) in fmi.acquires}
+    return out
+
+
+def _lock_factory_of(model: ProjectModel, lock_id: str) \
+        -> Optional[str]:
+    """'Lock'/'RLock'/... for a ``module::Class.attr`` lock id when the
+    attr was assigned from a known factory, else None."""
+    if "::" not in lock_id:
+        return None
+    _mod, rest = lock_id.split("::", 1)
+    if "." not in rest:
+        return None
+    cls_name, attr = rest.rsplit(".", 1)
+    for ci in model.classes_named(cls_name):
+        fac = ci.lock_attrs.get(attr)
+        if fac:
+            return fac
+    return None
+
+
+def _check_lk201(model: ProjectModel, findings: List[Finding]) -> None:
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add_edge(a: str, b: str, path: str, line: int) -> None:
+        if a == b:
+            return
+        edges.setdefault((a, b), (path, line))
+
+    all_infos = [ci for lst in model.classes.values() for ci in lst]
+    for ci in all_infos:
+        for mi in ci.methods.values():
+            for held, acq, line in mi.acquires:
+                if held is None:
+                    continue
+                if held == acq:
+                    fac = _lock_factory_of(model, held)
+                    if fac == "Lock":
+                        findings.append(Finding(
+                            "LK201", ci.path, line,
+                            f"nested re-acquisition of non-reentrant "
+                            f"lock {held.split('::')[-1]} in "
+                            f"{ci.name}.{mi.name} — self-deadlock "
+                            "(use RLock or restructure onto the "
+                            "lock-inside pattern)",
+                        ))
+                    continue
+                add_edge(held, acq, ci.path, line)
+            for held, ref, line in mi.calls_under:
+                targets: Set[str] = set()
+                if ref.kind == "self":
+                    targets = _acquired_closure(
+                        model, ci.name, ref.method
+                    )
+                elif ref.kind == "attr":
+                    for cname in ci.attr_types.get(ref.attr, set()):
+                        targets |= _acquired_closure(
+                            model, cname, ref.method
+                        )
+                elif ref.kind == "func":
+                    fmi = model.module_funcs.get(ci.path, {}) \
+                        .get(ref.method)
+                    if fmi is not None:
+                        targets = {
+                            acq for (_h, acq, _l) in fmi.acquires
+                        }
+                for tgt in targets:
+                    if tgt == held:
+                        fac = _lock_factory_of(model, held)
+                        if fac == "Lock":
+                            findings.append(Finding(
+                                "LK201", ci.path, line,
+                                f"call under non-reentrant lock "
+                                f"{held.split('::')[-1]} re-acquires "
+                                f"it via {ref.method}() — "
+                                "self-deadlock",
+                            ))
+                        continue
+                    add_edge(held, tgt, ci.path, line)
+    # Cycle detection over the edge set (iterative DFS).
+    graph: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    color: Dict[str, int] = {}
+    stack_path: List[str] = []
+    cycles: List[List[str]] = []
+
+    def dfs(start: str) -> None:
+        stack: List[Tuple[str, Iterable[str]]] = \
+            [(start, iter(graph[start]))]
+        color[start] = 1
+        stack_path.append(start)
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if color.get(nxt, 0) == 0:
+                    color[nxt] = 1
+                    stack_path.append(nxt)
+                    stack.append((nxt, iter(graph[nxt])))
+                    advanced = True
+                    break
+                if color.get(nxt) == 1:
+                    i = stack_path.index(nxt)
+                    cyc = stack_path[i:] + [nxt]
+                    if len(cyc) > 2:
+                        cycles.append(cyc)
+            if not advanced:
+                color[node] = 2
+                stack_path.pop()
+                stack.pop()
+
+    for node in sorted(graph):
+        if color.get(node, 0) == 0:
+            dfs(node)
+    reported: Set[frozenset] = set()
+    for cyc in cycles:
+        key = frozenset(cyc)
+        if key in reported:
+            continue
+        reported.add(key)
+        # Anchor at the lexically-first edge of the cycle.
+        sites = []
+        for a, b in zip(cyc, cyc[1:]):
+            site = edges.get((a, b))
+            if site is not None:
+                sites.append(site)
+        if not sites:
+            continue
+        path, line = min(sites)
+        chain = " -> ".join(n.split("::")[-1] for n in cyc)
+        findings.append(Finding(
+            "LK201", path, line,
+            f"lock-order cycle {chain}: two threads taking these "
+            "locks in opposite orders deadlock — pick one global "
+            "order or narrow one of the critical sections",
+        ))
+
+
+def _check_lk202(model: ProjectModel, findings: List[Finding]) -> None:
+    for lst in model.classes.values():
+        for ci in lst:
+            if ci.name == "<module>":
+                continue
+            for mi in ci.methods.values():
+                if mi.name.endswith("_locked"):
+                    continue  # contract: the caller holds the lock
+                for meth, line in mi.self_calls_unlocked:
+                    if not (meth.startswith("_")
+                            and meth.endswith("_locked")):
+                        continue
+                    findings.append(Finding(
+                        "LK202", ci.path, line,
+                        f"{ci.name}.{mi.name} calls self.{meth}() "
+                        "without holding a lock — the _locked suffix "
+                        "documents that the caller must hold the "
+                        "object's lock; wrap the call in the lock or "
+                        "rename the method",
+                    ))
+
+
+# ---------------------------------------------------------------------------
+# CH5xx — chaos coverage
+# ---------------------------------------------------------------------------
+
+
+def _check_chaos(model: ProjectModel, findings: List[Finding]) -> None:
+    if not model.chaos_sites:
+        return
+    injected = {i.name for i in model.injects}
+    declared = set(model.chaos_sites)
+    # A site referenced by LITERAL anywhere outside its declaring file
+    # counts as injected (the master main's has_site tuple idiom).
+    for site, decl in model.chaos_sites.items():
+        if site in injected:
+            continue
+        referenced = any(
+            site in fi.source
+            for p, fi in model.files.items() if p != decl.path
+        )
+        if not referenced:
+            findings.append(Finding(
+                "CH501", decl.path, decl.line,
+                f"chaos site {site!r} is declared in SITES but no "
+                "injection point references it — it can never fire; "
+                "wire an inject() or delete the declaration",
+            ))
+    for i in model.injects:
+        if i.name not in declared:
+            findings.append(Finding(
+                "CH502", i.path, i.line,
+                f"inject site {i.name!r} is not declared in "
+                "chaos.SITES — FaultSpec.parse rejects any plan "
+                "naming it, so this injection point is dead; declare "
+                "it or fix the string",
+            ))
+    if model.test_text:
+        for site, decl in sorted(model.chaos_sites.items()):
+            if site not in model.test_text:
+                findings.append(Finding(
+                    "CH503", decl.path, decl.line,
+                    f"chaos site {site!r} is referenced by no test — "
+                    "an untested failure mode is a claim, not a "
+                    "property; add a unit/e2e that arms it",
+                ))
+
+
+# ---------------------------------------------------------------------------
+# MT6xx — metrics drift
+# ---------------------------------------------------------------------------
+
+
+def _check_metrics(model: ProjectModel,
+                   findings: List[Finding]) -> None:
+    if model.gauge_regs:
+        exported: Set[str] = set()
+        for g in model.gauge_regs:
+            exported.add(g.name)
+            exported.update(g.values)
+        # Anchor each unexported counter at its LAST inc site: the
+        # first is typically the zero-priming loop, where a dozen
+        # names share one line (one finding would shadow the rest).
+        sites: Dict[str, Tuple[str, int]] = {}
+        for inc in model.counter_incs:
+            if inc.name not in exported:
+                cur = sites.get(inc.name)
+                if cur is None or (inc.path, inc.line) > cur:
+                    sites[inc.name] = (inc.path, inc.line)
+        for c in sorted(sites):
+            path, line = sites[c]
+            findings.append(Finding(
+                "MT601", path, line,
+                f"counter {c!r} is incremented but no gauge "
+                "registration exports it — the signal never reaches "
+                "/metrics (the inverse of the registered-but-never-"
+                "incremented drift); add it to a register_gauges "
+                "loop or suppress documenting the intended surface",
+            ))
+    # MT602: one module registering the same gauge name twice.
+    per_file: Dict[Tuple[str, str], List[int]] = {}
+    for g in model.gauge_regs:
+        per_file.setdefault((g.path, g.name), []).append(g.line)
+    for (path, name), lines in sorted(per_file.items()):
+        distinct = sorted(set(lines))
+        if len(distinct) < 2:
+            continue
+        findings.append(Finding(
+            "MT602", path, distinct[-1],
+            f"gauge {name!r} is registered here and on line "
+            f"{distinct[0]} of the same module — the earlier "
+            "callback is silently replaced (one of the two signals "
+            "is dark)",
+        ))
+
+
+def check_project(model: ProjectModel) -> List[Finding]:
+    findings: List[Finding] = []
+    _check_pc401(model, findings)
+    _check_pc402(model, findings)
+    _check_pc403(model, findings)
+    _check_pc404(model, findings)
+    _check_pc405(model, findings)
+    _check_lk201(model, findings)
+    _check_lk202(model, findings)
+    _check_chaos(model, findings)
+    _check_metrics(model, findings)
+    uniq: Dict[Tuple[str, str, int], Finding] = {}
+    for f in findings:
+        uniq.setdefault((f.rule, f.path, f.line), f)
+    return list(uniq.values())
