@@ -4781,6 +4781,401 @@ def ha_bench_main(argv: list) -> int:
     return 0 if result["complete"] else 1
 
 
+def cell_bench_main(argv: list) -> int:
+    """Multi-cell control-plane bench (ISSUE 15 acceptance artifact).
+
+    Measures CONTROL-PLANE ops/s at 1 vs N cells under an open-loop
+    arrival stream (the PR-9 harness shape: arrivals never slow down
+    for a struggling server — the queue just grows): real
+    ``master.main --cell_id`` subprocesses over real gRPC, each with a
+    PR-13 state journal, a shared registry subprocess, and ops routed
+    to their node id's OWNING cell by the ``common.hashring`` ring.
+
+    Each op is a journaled mutating RPC (``KVStoreSet``) — the class
+    every rendezvous join, task grant and registry write belongs to.
+    ``--floor_ms`` (default 2.0) sets
+    ``DLROVER_TPU_JOURNAL_APPEND_FLOOR_MS`` in the masters: the
+    modeled durable-log write latency (networked disk, the regime at
+    fleet scale), serialized under the append lock — the control-plane
+    analogue of the serve bench's device_round_ms.  The 1-cell row's
+    ceiling is then structural (one serialized log), and the N-cell
+    speedup measures real shard parallelism, not tmpfs noise; a
+    ``floor_ms=0`` honesty row records the raw 1-core regime.
+
+    A ``failover`` section (full runs only) composes with PR 13: two
+    cells with warm standbys, SIGKILL one primary mid-stream, and the
+    PER-CELL blackout extends HA_BENCH_CPU.json's fleet-wide metric —
+    the killed cell recovers within lease+replay while the OTHER cell
+    must never black out.
+
+    Flags: ``--cells=1,2`` ``--duration_s=F`` ``--clients=N``
+    ``--floor_ms=F`` ``--rate_mult=F`` (offered load as a multiple of
+    the 1-cell floor ceiling) ``--lease_s=F`` ``--out=PATH`` (default
+    CELL_BENCH_CPU.json) ``--smoke`` (tiny durations, no failover
+    section; the tier-1 schema gate).
+    """
+    import os
+    import queue as _queue
+    import signal as _signal
+    import subprocess
+    import tempfile
+    import threading
+
+    from dlrover_tpu.cells.cell import cell_for_node
+    from dlrover_tpu.common import messages as wire
+    from dlrover_tpu.common.rpc import RpcClient
+    from dlrover_tpu.master.state import read_addr
+
+    t_start = time.perf_counter()
+    opts = {"cells": "1,2", "duration_s": 6.0, "clients": 12,
+            "floor_ms": 2.0, "rate_mult": 2.2, "lease_s": 0.5,
+            "warmup_s": 1.0}
+    out_path = None
+    smoke = False
+    for a in argv:
+        if a == "--smoke":
+            smoke = True
+            opts.update(duration_s=1.2, warmup_s=0.4)
+        elif a.startswith("--out="):
+            out_path = a.split("=", 1)[1]
+        elif "=" in a and a.startswith("--"):
+            k, v = a[2:].split("=", 1)
+            if k in opts:
+                opts[k] = type(opts[k])(v)
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "CELL_BENCH_CPU.json",
+        )
+    cell_counts = [int(c) for c in str(opts["cells"]).split(",") if c]
+    result = {
+        "bench": "cell",
+        "smoke": smoke,
+        "opts": dict(opts),
+        "rows": [],
+        "note": (
+            "ops/s = completed journaled mutating RPCs (KVStoreSet) "
+            "under an OPEN-LOOP arrival stream offered at rate_mult x "
+            "the 1-cell floor ceiling, routed to each key's owning "
+            "cell by consistent hash; real master.main subprocesses "
+            "over gRPC, each with its own PR-13 state journal.  "
+            "floor_ms models the durable-log write latency a "
+            "production control plane pays per mutation (networked "
+            "disk), serialized under the append lock — the 1-cell "
+            "ceiling is structural, so the N-cell speedup measures "
+            "shard parallelism (the serve bench's device_round_ms "
+            "precedent).  floor_ms=0 rows record the raw 1-core "
+            "container regime.  failover: per-cell blackout (SIGKILL "
+            "-> first successful 0.5s-budget RPC per cell) extending "
+            "HA_BENCH_CPU.json's fleet-wide metric."
+        ),
+    }
+
+    def flush():
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+        os.replace(tmp, out_path)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DLROVER_TPU_FAULTS", None)
+
+    def wait_port(port_file, proc, name):
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                with open(port_file) as f:
+                    content = f.read().strip()
+                if content:
+                    return f"127.0.0.1:{content}"
+            except OSError:
+                pass
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"{name} exited early rc={proc.returncode}"
+                )
+            time.sleep(0.05)
+        raise TimeoutError(f"{name} never reported a port")
+
+    def spawn_registry(workdir):
+        port_file = os.path.join(workdir, "registry.port")
+        log = open(os.path.join(workdir, "registry.log"), "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dlrover_tpu.cells.main",
+             "--registry", "--port", "0", "--port_file", port_file],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+        return proc, wait_port(port_file, proc, "registry")
+
+    def spawn_cell(workdir, cid, reg_addr, floor_ms, standby_of="",
+                   state_dir="", tag=""):
+        tag = tag or cid
+        port_file = os.path.join(workdir, f"{tag}.port")
+        cmd = [sys.executable, "-m", "dlrover_tpu.master.main",
+               "--port=0", f"--port_file={port_file}",
+               "--job_name=cell-bench",
+               f"--cell_id={cid}", f"--cell_registry={reg_addr}",
+               "--min_nodes=1", "--max_nodes=8"]
+        state_dir = state_dir or os.path.join(workdir, f"state_{cid}")
+        cmd += [f"--state_dir={state_dir}"]
+        if standby_of:
+            cmd += ["--standby", f"--primary_addr={standby_of}"]
+        senv = dict(env,
+                    DLROVER_TPU_JOURNAL_APPEND_FLOOR_MS=str(floor_ms),
+                    DLROVER_TPU_CELL_LEASE_S=str(opts["lease_s"]))
+        if standby_of:
+            senv["DLROVER_TPU_HA_LEASE_S"] = str(opts["lease_s"])
+            senv["DLROVER_TPU_HA_TAIL_POLL_S"] = "0.05"
+        log = open(os.path.join(workdir, f"{tag}.log"), "w")
+        proc = subprocess.Popen(cmd, env=senv, stdout=log,
+                                stderr=subprocess.STDOUT)
+        return proc, wait_port(port_file, proc, tag), state_dir
+
+    def run_row(workdir, n_cells, floor_ms, offered_rps):
+        """Open-loop: an arrival thread enqueues op tokens at
+        ``offered_rps`` (never waiting on completions); ``clients``
+        workers drain the queue against the owning cells."""
+        procs = []
+        os.makedirs(workdir, exist_ok=True)
+        try:
+            reg_proc, reg_addr = spawn_registry(workdir)
+            procs.append(reg_proc)
+            cids = [f"cell{i}" for i in range(n_cells)]
+            addrs = {}
+            for cid in cids:
+                p, addr, _sd = spawn_cell(
+                    workdir, cid, reg_addr, floor_ms,
+                    tag=f"{cid}_f{floor_ms}",
+                )
+                procs.append(p)
+                addrs[cid] = addr
+            owner_of = {}
+            clients = {}
+
+            def client_for(tid, key):
+                cid = owner_of.get(key)
+                if cid is None:
+                    cid = cell_for_node(key, cids)
+                    owner_of[key] = cid
+                cli = clients.get((tid, cid))
+                if cli is None:
+                    cli = RpcClient(addrs[cid], timeout=5.0)
+                    clients[(tid, cid)] = cli
+                return cli
+
+            arrivals: "_queue.Queue" = _queue.Queue()
+            stop = threading.Event()
+            measuring = threading.Event()
+            counts = {"completed": 0, "measured": 0, "errors": 0}
+            cmu = threading.Lock()
+
+            def arrival_loop():
+                # Deterministic uniform arrivals at offered_rps; the
+                # stream NEVER waits on the servers (open loop).
+                period = 1.0 / max(1.0, offered_rps)
+                i = 0
+                next_t = time.monotonic()
+                while not stop.is_set():
+                    now = time.monotonic()
+                    if now < next_t:
+                        time.sleep(min(period, next_t - now))
+                        continue
+                    arrivals.put(i)
+                    i += 1
+                    next_t += period
+
+            def worker(tid):
+                while not stop.is_set():
+                    try:
+                        i = arrivals.get(timeout=0.1)
+                    except _queue.Empty:
+                        continue
+                    key = i % 256
+                    cli = client_for(tid, key)
+                    try:
+                        cli.call(
+                            wire.KVStoreSet(
+                                key=f"bench/n{key}",
+                                value=b"x" * 64,
+                            ),
+                            deadline=5.0, idempotent=True,
+                        )
+                    except Exception:  # noqa: BLE001 - overload path
+                        with cmu:
+                            counts["errors"] += 1
+                        continue
+                    with cmu:
+                        counts["completed"] += 1
+                        if measuring.is_set():
+                            counts["measured"] += 1
+
+            threads = [threading.Thread(target=arrival_loop,
+                                        daemon=True)]
+            threads += [
+                threading.Thread(target=worker, args=(t,), daemon=True)
+                for t in range(int(opts["clients"]))
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(opts["warmup_s"])
+            measuring.set()
+            t0 = time.monotonic()
+            time.sleep(opts["duration_s"])
+            elapsed = time.monotonic() - t0
+            measuring.clear()
+            stop.set()
+            for t in threads:
+                t.join(timeout=2.0)
+            for cli in clients.values():
+                cli.close()
+            return {
+                "cells": n_cells,
+                "floor_ms": floor_ms,
+                "offered_rps": round(offered_rps, 1),
+                "ops_per_s": round(counts["measured"] / elapsed, 1),
+                "completed": counts["completed"],
+                "errors": counts["errors"],
+                "clients": int(opts["clients"]),
+                "duration_s": round(elapsed, 2),
+            }
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
+    def run_failover(workdir):
+        """Two cells + warm standbys; SIGKILL cell0's primary
+        mid-stream; per-cell blackout via 0.5s-budget probes."""
+        procs = []
+        try:
+            reg_proc, reg_addr = spawn_registry(workdir)
+            procs.append(reg_proc)
+            info = {}
+            for cid in ("cell0", "cell1"):
+                p, addr, sd = spawn_cell(
+                    workdir, cid, reg_addr, 0.0, tag=f"fo_{cid}",
+                )
+                procs.append(p)
+                sb, sb_addr, _ = spawn_cell(
+                    workdir, cid, reg_addr, 0.0, standby_of=addr,
+                    state_dir=sd, tag=f"fo_{cid}_sb",
+                )
+                procs.append(sb)
+                info[cid] = {"proc": p, "addr": addr, "state": sd}
+            # Seed a marker through each cell so recovery has state to
+            # prove, then kill cell0's primary.
+            for cid, ent in info.items():
+                cli = RpcClient(ent["addr"], timeout=5.0)
+                cli.call(wire.KVStoreSet(key=f"marker/{cid}",
+                                         value=b"pre-kill"),
+                         deadline=5.0, idempotent=True)
+                cli.close()
+            time.sleep(0.3)  # standby tails reach head
+            os.kill(info["cell0"]["proc"].pid, _signal.SIGKILL)
+            t_kill = time.monotonic()
+
+            def probe(cid, follow_state):
+                """Seconds from the kill to the first successful RPC,
+                and whether the marker survived."""
+                ent = info[cid]
+                while time.monotonic() - t_kill < 60:
+                    addr = ent["addr"]
+                    if follow_state:
+                        cur = read_addr(ent["state"])
+                        if cur:
+                            addr = cur
+                    cli = RpcClient(addr, timeout=0.5)
+                    try:
+                        resp = cli.call(
+                            wire.KVStoreGet(key=f"marker/{cid}"),
+                            timeout=0.5, retries=1, deadline=0.5,
+                            idempotent=True,
+                        )
+                        return (time.monotonic() - t_kill,
+                                bool(getattr(resp, "found", False)))
+                    except Exception:  # noqa: BLE001 - still black
+                        pass
+                    finally:
+                        cli.close()
+                    time.sleep(0.02)
+                raise TimeoutError(f"{cid} never answered")
+
+            # cell1 FIRST: its gap is the headline "never blacks out"
+            # number and must not include time spent waiting on cell0.
+            c1_s, c1_found = probe("cell1", follow_state=False)
+            c0_s, c0_found = probe("cell0", follow_state=True)
+            return {
+                "killed_cell_blackout_s": round(c0_s, 3),
+                "killed_cell_state_recovered": c0_found,
+                "surviving_cell_gap_s": round(c1_s, 3),
+                "surviving_cell_state_intact": c1_found,
+                "surviving_never_blacked_out": c1_s < 0.5 and c1_found,
+                "lease_s": opts["lease_s"],
+            }
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for p in procs:
+                p.wait()
+
+    with tempfile.TemporaryDirectory(prefix="cell_bench_") as workdir:
+        floor = float(opts["floor_ms"])
+        ceiling_1cell = 1000.0 / floor if floor > 0 else 2000.0
+        offered = ceiling_1cell * float(opts["rate_mult"])
+        for n in cell_counts:
+            row = run_row(
+                os.path.join(workdir, f"r{n}"), n, floor, offered
+            )
+            result["rows"].append(row)
+            flush()
+        if not smoke:
+            for n in cell_counts:
+                row = run_row(
+                    os.path.join(workdir, f"r{n}f0"), n, 0.0, offered
+                )
+                result["rows"].append(row)
+                flush()
+            os.makedirs(os.path.join(workdir, "fo"), exist_ok=True)
+            result["failover"] = run_failover(
+                os.path.join(workdir, "fo")
+            )
+            flush()
+
+    floored = {
+        r["cells"]: r["ops_per_s"] for r in result["rows"]
+        if r["floor_ms"] == float(opts["floor_ms"])
+    }
+    base = floored.get(min(floored)) or 1.0
+    peak_cells = max(floored)
+    result["speedup"] = round(floored[peak_cells] / base, 2)
+    result["complete"] = bool(
+        len(floored) >= 2 and result["speedup"] >= 1.5
+        and (smoke or result.get("failover", {}).get(
+            "surviving_never_blacked_out"))
+        and (smoke or result.get("failover", {}).get(
+            "killed_cell_state_recovered"))
+    )
+    result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+    flush()
+    print(json.dumps({
+        "metric": "cell_control_plane_ops_per_s",
+        "value": floored[peak_cells],
+        "unit": f"journaled_ops_per_s_at_{peak_cells}_cells",
+        "vs_baseline": base,
+        "speedup": result["speedup"],
+        "backend": "cpu",
+        "artifact": out_path,
+    }))
+    return 0 if result["complete"] else 1
+
+
 #: Subcommand table: every bench registers here (satellite of ISSUE 5 —
 #: the tail-of-file if-chain made each new bench a copy-paste edit).
 SUBCOMMANDS = {
@@ -4793,6 +5188,7 @@ SUBCOMMANDS = {
     "--reshard_bench": reshard_bench_main,
     "--fleet_bench": fleet_bench_main,
     "--ha_bench": ha_bench_main,
+    "--cell_bench": cell_bench_main,
 }
 
 

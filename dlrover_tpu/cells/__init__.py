@@ -1,0 +1,42 @@
+"""Multi-cell control plane (ISSUE 15): sharded masters + federation.
+
+At millions-of-users scale one master process is both the throughput
+ceiling and the blast radius.  This package partitions the fleet into
+**cells** — each with its OWN full master (servicer + KV + rendezvous
++ data sharding + fleet pass, carrying its own PR-13 control-state
+journal and warm standby) — with membership decided by consistent-hash
+ownership over node ids and a **federation tier** that never sits on a
+hot path:
+
+- :mod:`dlrover_tpu.cells.registry` — leased cell-master announcements
+  in a shared KV (the PR-9 ``ServeRegistry`` idiom: reader-side lease,
+  zero cross-owner coordination; cell death = the ring re-forms and
+  PEER cells adopt the dead node range).
+- :mod:`dlrover_tpu.cells.cell` — :func:`cell_for_node` ownership,
+  the client-side :class:`CellMap` re-home view, the registry
+  :class:`CellHeartbeat` (chaos ``cell.master_kill`` / ``cell.split``
+  live here) and the :class:`CellMaster` composition.
+- :mod:`dlrover_tpu.cells.manager` — the journaled per-cell state
+  (placement epochs, published ring view) every master carries.
+- :mod:`dlrover_tpu.cells.federation` — snapshot merge, split
+  detection, deterministic role placement across cells, and the
+  cell-aware ``ChipBorrowArbiter`` signal path.
+
+Everything here is jax-free control plane.
+"""
+
+from dlrover_tpu.cells.cell import (  # noqa: F401
+    CellHeartbeat,
+    CellMap,
+    CellMaster,
+    cell_for_node,
+    node_key,
+)
+from dlrover_tpu.cells.federation import (  # noqa: F401
+    FederationTier,
+    detect_splits,
+    merge_cell_snapshots,
+    place_roles,
+)
+from dlrover_tpu.cells.manager import CellManager  # noqa: F401
+from dlrover_tpu.cells.registry import CellRegistry  # noqa: F401

@@ -1,0 +1,300 @@
+"""One cell of the sharded control plane.
+
+A **cell** is a slice of the fleet with its OWN full master — gRPC
+servicer, KV store, rendezvous, data sharding, and (optionally) a
+FleetManager pass — so at millions-of-users scale no single process is
+either the throughput ceiling or the blast radius (ROADMAP item 5,
+SCALE half; the HA half landed in PR 13 and composes here: each cell
+master carries its own control-state journal + warm standby).
+
+Membership is pure consistent hashing (:func:`cell_for_node` over the
+live cell set from the :class:`~dlrover_tpu.cells.registry.CellRegistry`),
+so cells need ZERO cross-owner coordination:
+
+- a node's owning cell is a pure function of (node id, live cell ids);
+- a cell-master death = the lease ages out, the ring re-forms, and the
+  dead cell's node ranges are ADOPTED by the surviving cells — while
+  the dead cell's own clients re-home to its warm standby via the
+  existing ``RpcClient`` addr-provider hook (state-dir addr chain);
+- the federation tier (:mod:`dlrover_tpu.cells.federation`) never sits
+  on a hot path: it only merges per-cell snapshots and places roles.
+
+Chaos: ``cell.master_kill`` (exit 85) fires in the cell heartbeat
+(``method=<cell_id>``); ``cell.split`` makes one heartbeat publish a
+self-only ring view — the forged two-owners-for-one-range state the
+federation's split detector must catch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu import chaos
+from dlrover_tpu.common.hashring import HashRing
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.cells.registry import CellRegistry
+
+
+def node_key(node_id) -> str:
+    """Canonical ring key for a node id — shared by owners and
+    detectors so 'who owns node 7' has exactly one spelling."""
+    return f"node:{node_id}"
+
+
+def cell_for_node(node_id, cell_ids, vnodes: int = 64) -> Optional[str]:
+    """The owning cell of a node id: pure function of (node id, live
+    cell set).  Every layer — agents picking a master, the federation
+    checking splits, tests — computes ownership through here."""
+    return HashRing(cell_ids, vnodes=vnodes).owner(node_key(node_id))
+
+
+class CellMap:
+    """A cached ring over the live cell set, with the addr lookup
+    clients need: ``addr_for_node`` answers "which master do I talk
+    to?" and re-resolves as the registry view changes (the client-side
+    re-home hook when a cell dies and its range is adopted)."""
+
+    def __init__(self, registry: CellRegistry, refresh_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.registry = registry
+        self._refresh_s = refresh_s
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._cells: Dict[str, dict] = {}
+        self._ring = HashRing(())
+        self._last = float("-inf")
+
+    def refresh(self, force: bool = False) -> Dict[str, dict]:
+        with self._mu:
+            now = self._clock()
+            if not force and now - self._last < self._refresh_s \
+                    and self._cells:
+                return dict(self._cells)
+        # Registry read OUTSIDE the lock: in the RPC-backed case it can
+        # block for the transport timeout, and concurrent owner()/addr
+        # lookups must keep answering from the cached view meanwhile.
+        try:
+            cells = self.registry.cells()
+        except Exception as e:  # noqa: BLE001 - keep the last view
+            logger.warning("cell registry read failed: %s", e)
+            with self._mu:
+                return dict(self._cells)
+        with self._mu:
+            self._last = self._clock()
+            if cells.keys() != self._cells.keys():
+                self._ring = HashRing(cells.keys())
+            self._cells = cells
+            return dict(cells)
+
+    def cell_ids(self) -> List[str]:
+        self.refresh()
+        with self._mu:
+            return sorted(self._cells)
+
+    def owner(self, node_id) -> Optional[str]:
+        self.refresh()
+        with self._mu:
+            return self._ring.owner(node_key(node_id))
+
+    def addr_for_node(self, node_id) -> str:
+        cid = self.owner(node_id)
+        with self._mu:
+            return (self._cells.get(cid) or {}).get("addr", "") \
+                if cid else ""
+
+    def addr_of(self, cell_id: str) -> str:
+        self.refresh()
+        with self._mu:
+            return (self._cells.get(cell_id) or {}).get("addr", "")
+
+
+class CellHeartbeat:
+    """The registry heartbeat of one cell master: announce
+    ``(addr, view, placement epoch)``, refresh the believed live-cell
+    view, sweep stale entries once per lease.  Runs beside ANY master
+    flavour (primary or a standby that just took over)."""
+
+    def __init__(self, cell_id: str, registry: CellRegistry,
+                 addr_fn: Callable[[], str], cell_manager=None,
+                 heartbeat_s: float = 1.0):
+        self.cell_id = cell_id
+        self.registry = registry
+        self._addr_fn = addr_fn
+        self._cell_manager = cell_manager
+        self._heartbeat_s = heartbeat_s
+        self._beats = 0
+        self._last_gc = float("-inf")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        try:
+            self.beat_once()
+        except Exception:  # noqa: BLE001 - a transient registry blip
+            # at startup must not kill the whole cell master; the loop
+            # below retries every heartbeat_s.
+            logger.warning(
+                "cell %s first registry announce failed; retrying in "
+                "the heartbeat loop", self.cell_id, exc_info=True,
+            )
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"cell-hb-{self.cell_id}",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def beat_once(self) -> None:
+        # The cell kill site (ISSUE 15): a crash here is a whole cell
+        # master dying between heartbeats — its lease expires, the ring
+        # re-forms, peer cells adopt the node range, and the cell's own
+        # clients re-home to its warm standby.  method=<cell_id> picks
+        # the victim; step counts this master's heartbeats so
+        # ``step_ge=N`` kills deterministically after N announces.
+        chaos.inject("cell.master_kill", method=self.cell_id,
+                     step=self._beats)
+        self._beats += 1
+        view = sorted(
+            set(self.registry.cells()) | {self.cell_id}
+        )
+        if chaos.inject("cell.split", method=self.cell_id) is not None:
+            # Forged split: publish a self-only view — this master now
+            # claims the WHOLE ring while its peers claim their ranges
+            # too.  Self-healing (the next beat recomputes the real
+            # view); the federation's detector must flag the overlap
+            # window.
+            view = [self.cell_id]
+        if self._cell_manager is not None:
+            self._cell_manager.set_view(view)
+        epoch = (
+            self._cell_manager.placement_epoch
+            if self._cell_manager is not None else -1
+        )
+        self.registry.announce_cell(
+            self.cell_id, self._addr_fn(), view=view, epoch=epoch,
+        )
+        now = time.monotonic()
+        if now - self._last_gc >= self.registry.lease_s:
+            self._last_gc = now
+            self.registry.gc_stale()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._heartbeat_s):
+            try:
+                self.beat_once()
+            except Exception:  # noqa: BLE001 - heartbeat must survive
+                logger.exception(
+                    "cell %s registry heartbeat failed", self.cell_id
+                )
+
+    def stop(self, deregister: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if deregister:
+            try:
+                self.registry.remove_cell(self.cell_id)
+            except Exception:  # noqa: BLE001 - best-effort removal
+                logger.warning("cell %s deregistration failed",
+                               self.cell_id, exc_info=True)
+
+
+def start_cell_heartbeat(cell_id: str, registry_addr: str,
+                         job_name: str, addr_fn: Callable[[], str],
+                         cell_manager=None) -> CellHeartbeat:
+    """Wire + start the registry heartbeat for a master serving one
+    cell over the wire — THE one implementation both the primary entry
+    (``master.main``) and the standby's post-takeover path use, so the
+    ``DLROVER_TPU_CELL_LEASE_S`` knob can never apply to one and not
+    the other."""
+    import os
+
+    from dlrover_tpu.serving.tier import RpcKv
+
+    lease_s = float(
+        os.environ.get("DLROVER_TPU_CELL_LEASE_S", "10") or 10
+    )
+    hb = CellHeartbeat(
+        cell_id,
+        CellRegistry(RpcKv(registry_addr), job=job_name,
+                     lease_s=lease_s),
+        addr_fn,
+        cell_manager=cell_manager,
+    )
+    hb.start()
+    return hb
+
+
+class CellMaster:
+    """One cell's control plane: a full ``LocalJobMaster`` (servicer +
+    KV + rendezvous + task manager, with the PR-13 journal when
+    ``state_dir`` is given) plus the registry heartbeat.  The master
+    does NOT know its peer cells' internals — ownership lives in the
+    clients' rings over the registry."""
+
+    def __init__(self, cell_id: str, registry: CellRegistry, *,
+                 port: int = 0, job_name: str = "cell-job",
+                 min_nodes: int = 1, max_nodes: int = 64,
+                 state_dir: str = "", heartbeat_s: float = 1.0,
+                 fleet_manager=None):
+        from dlrover_tpu.master.master import LocalJobMaster
+
+        self.cell_id = cell_id
+        self.registry = registry
+        self.master = LocalJobMaster(
+            port,
+            job_name=job_name,
+            min_nodes=min_nodes,
+            max_nodes=max_nodes,
+            state_dir=state_dir,
+            cell_id=cell_id,
+        )
+        #: Optional per-cell FleetManager (role reconciler + borrow
+        #: arbiter): the cell pass stays LOCAL — the federation only
+        #: pushes placements, never reconciles members itself.
+        self.fleet_manager = fleet_manager
+        if fleet_manager is not None:
+            self.master.servicer.fleet_manager = fleet_manager
+        self.heartbeat = CellHeartbeat(
+            cell_id, registry, lambda: self.master.addr,
+            cell_manager=self.master.cell_manager,
+            heartbeat_s=heartbeat_s,
+        )
+
+    @property
+    def addr(self) -> str:
+        return self.master.addr
+
+    @property
+    def cell_manager(self):
+        return self.master.cell_manager
+
+    def start(self) -> None:
+        self.master.prepare()
+        if self.fleet_manager is not None:
+            self.fleet_manager.start()
+        self.heartbeat.start()
+        logger.info("cell %s master up at %s (job %s)",
+                    self.cell_id, self.addr, self.master.job_name)
+
+    def run(self) -> int:
+        return self.master.run()
+
+    def stop(self) -> None:
+        self.heartbeat.stop()
+        if self.fleet_manager is not None:
+            self.fleet_manager.stop()
+        self.master.request_stop(True, "cell master stopped")
+        self.master.stop()
+
+    def crash(self) -> None:
+        """Die WITHOUT deregistering (tests/benches): heartbeats stop,
+        the RPC server closes, the registry entry ages out — what a
+        SIGKILLed cell master looks like to the fleet."""
+        self.heartbeat.stop(deregister=False)
+        if self.fleet_manager is not None:
+            self.fleet_manager.stop()
+        self.master.stop()

@@ -1,0 +1,462 @@
+"""The federation tier: merge per-cell snapshots, place roles.
+
+The federation NEVER sits on a hot path (VirtualFlow's decoupling:
+the capacity/placement plan is a pure function of observed load, and
+computing it needs none of the hardware holding the roles).  Cells
+run their own admission, rendezvous, task queues and fleet passes;
+the federation only
+
+- **merges** per-cell ``CellSnapshot`` bodies into one fleet view
+  (:func:`merge_cell_snapshots` — the ``serving.tier.merge_snapshots``
+  pattern: sums for disjoint-by-construction quantities, per-cell
+  sub-views preserved);
+- **places** roles across cells (:func:`place_roles` — a PURE,
+  deterministic plan: which cell hosts training vs serving vs draft vs
+  embedding pools), pushed as epoch-stamped ``CellPlacementUpdate``
+  messages each cell adopts idempotently (and journals before acking);
+- **detects splits** (:func:`detect_splits`): every cell publishes
+  the ring view it believes in; if two cells' views both make them
+  the owner of one node range, the federation flags it (chaos
+  ``cell.split`` forges exactly this) — the resolution is time (views
+  self-heal on the next heartbeat), the DETECTION is the product;
+- makes the ``ChipBorrowArbiter`` loan path cell-aware:
+  :meth:`FederationTier.borrow_signal` feeds a cell's arbiter the
+  FEDERATED queue depth for the borrower role, so a loan decision sees
+  fleet-wide pressure while actuation stays local to the lending cell
+  (zero cross-owner coordination, as everywhere else).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from dlrover_tpu.agent.metrics import CounterSet
+from dlrover_tpu.common.hashring import HashRing
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.cells.cell import CellMap, node_key
+from dlrover_tpu.cells.registry import CellRegistry
+from dlrover_tpu.obs import journal
+
+
+#: Roles that belong on CPU node pools (control/front-door processes)
+#: vs TPU pools (chip-holding workers).  The CPU classification is THE
+#: platform layer's (``scheduler.platform.CPU_POOL_ROLES``) — one
+#: list, so a role the GKE layer schedules onto CPU pools is never
+#: chip-charged by the placement (and vice versa).  TPU roles split by
+#: placement style: SPREAD (latency fans out with users) vs PACK
+#: (collectives want locality) — :func:`place_roles` iterates exactly
+#: these, so a new chip role added here is placed, not silently
+#: dropped.
+from dlrover_tpu.scheduler.platform import CPU_POOL_ROLES as CPU_ROLES
+
+SPREAD_ROLES = ("serving", "draft")
+PACK_ROLES = ("training", "embedding")
+TPU_ROLES = SPREAD_ROLES + PACK_ROLES
+
+
+def merge_cell_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold per-cell snapshot dicts into one fleet view.
+
+    Sums are safe by construction — cells own disjoint node ranges, so
+    their node/task/queue counts never overlap; per-cell bodies are
+    preserved under ``cells`` so the placement (and operators) can see
+    the distribution, not just the totals."""
+    snaps = [s for s in snaps if s]
+    merged: Dict[str, Any] = {
+        "cells": {}, "cells_alive": 0, "nodes": 0, "tasks_doing": 0,
+        "tasks_pending": 0, "placement_epochs": {},
+    }
+    pools: Dict[str, Dict[str, float]] = {}
+    for snap in snaps:
+        cid = str(snap.get("cell_id", f"cell{len(merged['cells'])}"))
+        merged["cells"][cid] = snap
+        merged["cells_alive"] += 1
+        for key in ("nodes", "tasks_doing", "tasks_pending"):
+            merged[key] += int(snap.get(key, 0))
+        merged["placement_epochs"][cid] = int(
+            snap.get("placement_epoch", -1)
+        )
+        for role, pool in (snap.get("pools") or {}).items():
+            agg = pools.setdefault(
+                role, {"alive": 0, "slots": 0, "assigned": 0,
+                       "queue_depth": 0},
+            )
+            for key in agg:
+                agg[key] += int(pool.get(key, 0))
+    for role, agg in pools.items():
+        agg["occupancy"] = (
+            agg["assigned"] / agg["slots"] if agg["slots"] else 0.0
+        )
+    merged["pools"] = pools
+    return merged
+
+
+def detect_splits(cells: Dict[str, dict], probes: int = 128,
+                  vnodes: int = 64) -> List[Tuple[str, List[str]]]:
+    """Cross-check published ring views: a node range with TWO owners.
+
+    Each cell's announce carries ``view`` — the live-cell set it hashes
+    over.  For a deterministic probe set of node keys, a cell CLAIMS a
+    key when hashing over *its own view* names it the owner.  Healthy
+    fleets agree (every view is the same set, claims partition the
+    ring); a stale or forged view (chaos ``cell.split``) makes two
+    masters both claim a range.  Returns ``[(probe_key, claimants)]``
+    for every multiply-claimed probe."""
+    rings = {
+        cid: HashRing(ent.get("view") or [cid], vnodes=vnodes)
+        for cid, ent in cells.items()
+    }
+    split: List[Tuple[str, List[str]]] = []
+    for i in range(probes):
+        key = node_key(i)
+        claimants = sorted(
+            cid for cid, ring in rings.items() if ring.owner(key) == cid
+        )
+        if len(claimants) > 1:
+            split.append((key, claimants))
+    return split
+
+
+def place_roles(
+    cells: Dict[str, Dict[str, Any]],
+    demands: Dict[str, int],
+    pinned: Optional[Dict[str, Dict[str, int]]] = None,
+) -> Dict[str, Dict[str, int]]:
+    """Deterministic role placement across cells — a PURE plan.
+
+    ``cells``: cell id -> {"capacity": chip slots} (0-capacity cells
+    host only CPU roles).  ``demands``: role -> member count.
+    ``pinned``: role -> {cell: count} overrides that are honoured
+    before the free remainder is placed.
+
+    Policy (stable under re-runs — sorted orders everywhere):
+
+    - CPU roles (:data:`CPU_ROLES`) spread round-robin over ALL cells
+      (front doors and masters want fault-domain spread, not chips);
+    - ``serving`` (and its ``draft`` sidekick) spread round-robin over
+      TPU-capacity cells — latency fans out with the user population;
+    - ``training`` and ``embedding`` PACK into the fewest
+      largest-capacity cells — collectives want locality;
+    - capacity is respected: a cell never receives more TPU-role
+      members than it has remaining capacity; what cannot be placed is
+      returned under the pseudo-cell ``"!unplaced"`` so callers alarm
+      instead of silently under-provisioning."""
+    pinned = pinned or {}
+    cids = sorted(cells)
+    cap = {
+        cid: max(0, int(cells[cid].get("capacity", 0))) for cid in cids
+    }
+    out: Dict[str, Dict[str, int]] = {}
+
+    def take(role: str, cid: str, n: int, charge: bool) -> int:
+        if charge:
+            n = min(n, cap[cid])
+            cap[cid] -= n
+        if n > 0:
+            out.setdefault(role, {})
+            out[role][cid] = out[role].get(cid, 0) + n
+        return n
+
+    for role, per_cell in sorted(pinned.items()):
+        charge = role not in CPU_ROLES
+        for cid, n in sorted(per_cell.items()):
+            if cid in cap:
+                take(role, cid, int(n), charge)
+
+    def remaining(role: str) -> int:
+        placed = sum((out.get(role) or {}).values())
+        return max(0, int(demands.get(role, 0)) - placed)
+
+    # CPU roles: spread over every cell, no capacity charge.
+    for role in CPU_ROLES:
+        want = remaining(role)
+        for i in range(want):
+            take(role, cids[i % len(cids)], 1, charge=False)
+
+    tpu_cells = [cid for cid in cids if cap[cid] > 0 or
+                 int(cells[cid].get("capacity", 0)) > 0]
+    # Spread roles: round-robin over TPU cells with headroom.
+    for role in SPREAD_ROLES:
+        want = remaining(role)
+        i = 0
+        while want > 0 and any(cap[c] > 0 for c in tpu_cells):
+            cid = tpu_cells[i % len(tpu_cells)]
+            i += 1
+            if cap[cid] > 0:
+                want -= take(role, cid, 1, charge=True)
+    # Pack roles: fill the largest remaining-capacity cell first
+    # (capacity desc, id asc for determinism).
+    for role in PACK_ROLES:
+        want = remaining(role)
+        for cid in sorted(tpu_cells, key=lambda c: (-cap[c], c)):
+            if want <= 0:
+                break
+            want -= take(role, cid, want, charge=True)
+    for role in sorted(demands):
+        short = remaining(role)
+        if short > 0 and role not in CPU_ROLES:
+            out.setdefault(role, {})["!unplaced"] = short
+    return out
+
+
+#: Every federation counter is exported as a gauge (graftcheck MT601).
+FEDERATION_COUNTER_NAMES = (
+    "cell_snapshot_fetches",
+    "cell_snapshot_failures",
+    "cell_split_detected",
+    "cell_placement_pushes",
+    "cell_placement_rejected",
+)
+
+
+def _default_connect(addr: str):
+    from dlrover_tpu.common.rpc import RpcClient
+
+    return RpcClient(addr, timeout=5.0)
+
+
+class FederationTier:
+    """The thin fleet-wide layer over N cell masters.
+
+    Reads: the registry (live cells + published views) and one
+    ``CellSnapshotRequest`` per cell, TTL-cached — a federation read
+    costs each cell at most one RPC per ``refresh_s``.  Writes: ONLY
+    epoch-stamped placement pushes.  Nothing here is on a request or
+    training hot path; the federation process can die and every cell
+    keeps serving (it just stops re-placing)."""
+
+    def __init__(self, registry: CellRegistry,
+                 connect: Optional[Callable[[str], Any]] = None,
+                 refresh_s: float = 2.0,
+                 demands: Optional[Dict[str, int]] = None):
+        self.registry = registry
+        self.cell_map = CellMap(registry, refresh_s=min(1.0, refresh_s))
+        self._connect = connect or _default_connect
+        self._refresh_s = refresh_s
+        self._mu = threading.Lock()
+        self._transports: Dict[str, Any] = {}
+        self._view: Dict[str, Any] = {}
+        self._view_ts = float("-inf")
+        self._prev_splits: set = set()
+        self._epoch = 0
+        self._last_plan: Optional[Dict[str, Dict[str, int]]] = None
+        self.demands = dict(demands or {})
+        self.counters = CounterSet()
+        for name in FEDERATION_COUNTER_NAMES:
+            self.counters.inc(name, 0)
+
+    # -- transports --------------------------------------------------------
+
+    def _transport(self, cid: str, addr: str):
+        with self._mu:
+            tr = self._transports.get(cid)
+            if tr is None and addr:
+                tr = self._connect(addr)
+                self._transports[cid] = tr
+            return tr
+
+    def _drop_transport(self, cid: str) -> None:
+        with self._mu:
+            tr = self._transports.pop(cid, None)
+        close = getattr(tr, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 - teardown
+                logger.debug("cell transport close failed", exc_info=True)
+
+    # -- reads -------------------------------------------------------------
+
+    def fleet_view(self, force: bool = False) -> Dict[str, Any]:
+        """Merged fleet view: registry entries + per-cell snapshots +
+        split detection.  TTL-cached (``refresh_s``)."""
+        with self._mu:
+            if not force and time.monotonic() - self._view_ts \
+                    < self._refresh_s and self._view:
+                return dict(self._view)
+        entries = self.cell_map.refresh(force=True)
+        snaps: List[Dict[str, Any]] = []
+        for cid in sorted(entries):
+            addr = entries[cid].get("addr", "")
+            tr = self._transport(cid, addr)
+            if tr is None:
+                continue
+            self.counters.inc("cell_snapshot_fetches")
+            try:
+                resp = tr.call(m.CellSnapshotRequest(cell_id=cid),
+                               deadline=10.0, idempotent=True)
+            except Exception as e:  # noqa: BLE001 - dead cell: lease
+                # machinery owns liveness, the view just skips it
+                logger.warning("cell %s snapshot fetch failed: %s",
+                               cid, e)
+                self.counters.inc("cell_snapshot_failures")
+                self._drop_transport(cid)
+                continue
+            body = getattr(resp, "snapshot", None)
+            if isinstance(body, dict) and getattr(resp, "found", True):
+                body.setdefault("cell_id", cid)
+                snaps.append(body)
+            else:
+                self.counters.inc("cell_snapshot_failures")
+        view = merge_cell_snapshots(snaps)
+        view["registry"] = entries
+        splits = detect_splits(entries)
+        view["splits"] = splits
+        # Debounced confirmation: a range split in TWO consecutive
+        # federation reads.  Bootstrap view-races (a cell's first beat
+        # landing before a peer announced) heal within one heartbeat
+        # and must not page anyone; a REAL split — a stale view that
+        # keeps claiming (chaos ``cell.split`` between beats, a wedged
+        # heartbeat thread) — persists and fires.
+        confirmed = [s for s in splits if s[0] in self._prev_splits]
+        view["splits_confirmed"] = confirmed
+        self._prev_splits = {k for k, _ in splits}
+        if confirmed:
+            self.counters.inc("cell_split_detected")
+            claimants = sorted(
+                {c for _, cs in confirmed for c in cs}
+            )
+            journal("cells.split", ranges=len(confirmed),
+                    claimants=claimants)
+            logger.warning(
+                "federation: SPLIT ownership CONFIRMED on %d probe "
+                "ranges across consecutive reads (claimants %s)",
+                len(confirmed), claimants,
+            )
+        with self._mu:
+            self._view = view
+            self._view_ts = time.monotonic()
+        return dict(view)
+
+    # -- placement ---------------------------------------------------------
+
+    def plan_placement(self, view: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Dict[str, int]]:
+        view = view or self.fleet_view()
+        cells = {
+            cid: {"capacity": int(
+                (view["cells"].get(cid) or {}).get("capacity", 0)
+            )}
+            for cid in view.get("registry", {})
+        }
+        if not cells:
+            return {}
+        return place_roles(cells, self.demands)
+
+    def push_placement(self, view: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, bool]:
+        """Compute and push the current plan to every live cell.  The
+        epoch is bumped once per push; cells adopt idempotently, so a
+        retried push (or two federations racing) converges on the
+        highest epoch."""
+        view = view or self.fleet_view()
+        plan = self.plan_placement(view)
+        if not plan:
+            return {}
+        with self._mu:
+            settled = all(
+                e >= self._epoch
+                for e in view.get("placement_epochs", {}).values()
+            ) and len(view.get("placement_epochs", {})) == len(
+                view.get("registry", {})
+            )
+            if plan == self._last_plan and settled and self._epoch > 0:
+                # Nothing moved and every cell already adopted the
+                # current epoch: re-pushing would bump epochs forever
+                # and spam one journal record per cell per interval.
+                return {}
+            self._last_plan = plan
+        with self._mu:
+            self._epoch = max(
+                self._epoch + 1,
+                max(view.get("placement_epochs", {}).values(),
+                    default=0) + 1,
+            )
+            epoch = self._epoch
+        results: Dict[str, bool] = {}
+        for cid in sorted(view.get("registry", {})):
+            per_cell = {
+                role: alloc.get(cid, 0)
+                for role, alloc in plan.items() if alloc.get(cid, 0)
+            }
+            tr = self._transport(
+                cid, view["registry"][cid].get("addr", "")
+            )
+            if tr is None:
+                results[cid] = False
+                continue
+            try:
+                resp = tr.call(
+                    m.CellPlacementUpdate(
+                        cell_id=cid, epoch=epoch, placement=per_cell,
+                    ),
+                    deadline=10.0, idempotent=True,
+                )
+                ok = bool(getattr(resp, "success", False))
+            except Exception as e:  # noqa: BLE001 - next push retries
+                logger.warning("cell %s placement push failed: %s",
+                               cid, e)
+                self._drop_transport(cid)
+                ok = False
+            results[cid] = ok
+            self.counters.inc(
+                "cell_placement_pushes" if ok
+                else "cell_placement_rejected"
+            )
+        journal("cells.placement", epoch=epoch,
+                cells={c: ok for c, ok in results.items()},
+                roles=sorted(plan))
+        return results
+
+    # -- cell-aware borrow path (ISSUE 15) ---------------------------------
+
+    def borrow_signal(self, role: str) -> Dict[str, Any]:
+        """The federated load view a cell's ``ChipBorrowArbiter`` uses
+        as its ``signal_fn``: queue depth and alive members for
+        ``role`` summed ACROSS cells.  The loan DECISION sees
+        fleet-wide pressure (requests are routed fleet-wide), while
+        actuation stays inside the deciding cell — no cross-cell
+        coordination on the loan path."""
+        view = self.fleet_view()
+        pool = (view.get("pools") or {}).get(role) or {}
+        return {
+            "queue_depth": int(pool.get("queue_depth", 0)),
+            "members_alive": max(1, int(pool.get("alive", 0))),
+        }
+
+    def borrow_signal_fn(self, role: str) -> Callable[[], Dict[str, Any]]:
+        return lambda: self.borrow_signal(role)
+
+    def pick_lender_cell(self, role: str = "training") -> Optional[str]:
+        """The cell with the most ``role`` members — where a cross-cell
+        placement move would take a chip from first (largest lender =
+        smallest relative disruption)."""
+        view = self.fleet_view()
+        best: Optional[Tuple[int, str]] = None
+        for cid, snap in sorted(view.get("cells", {}).items()):
+            n = int((snap.get("placement") or {}).get(role, 0))
+            if n > 0 and (best is None or n > best[0]):
+                best = (n, cid)
+        return best[1] if best else None
+
+    # -- metrics -----------------------------------------------------------
+
+    def register_gauges(self, registry) -> None:
+        for name in FEDERATION_COUNTER_NAMES:
+            registry.gauge(
+                f"fed_{name}",
+                (lambda n: lambda: float(self.counters.get(n)))(name),
+            )
+        registry.gauge(
+            "fed_cells_alive",
+            lambda: float(len(self.cell_map.cell_ids())),
+        )
+
+    def close(self) -> None:
+        with self._mu:
+            cids = list(self._transports)
+        for cid in cids:
+            self._drop_transport(cid)

@@ -1,0 +1,115 @@
+"""Cell-plane process entries: ``python -m dlrover_tpu.cells.main``.
+
+Two roles (cell MASTERS themselves run as ``master.main --cell_id
+--cell_registry`` so they inherit the full HA supervision surface):
+
+- ``--registry``: the shared cell-registry KV — a standalone
+  :class:`~dlrover_tpu.serving.tier.RegistryServer` speaking the
+  ``KVStore*`` messages.  ``tpurun --cell N`` spawns one; fleets that
+  already have a master can point cells at its KV instead.
+- ``--federation``: the thin federation loop — periodically merge
+  per-cell snapshots and push role placements.  Deliberately
+  crash-tolerant by irrelevance: cells keep serving if it dies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+from dlrover_tpu.common.log import logger, set_role
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("dlrover_tpu cells")
+    p.add_argument("--registry", action="store_true",
+                   help="run the shared cell-registry KV server")
+    p.add_argument("--federation", action="store_true",
+                   help="run the federation snapshot/placement loop")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--port_file", default="",
+                   help="write the bound port to this file")
+    p.add_argument("--registry_addr",
+                   default=os.environ.get(
+                       "DLROVER_TPU_CELL_REGISTRY", ""),
+                   help="federation mode: host:port of the registry KV "
+                        "(default: $DLROVER_TPU_CELL_REGISTRY, which "
+                        "`tpurun --cell` exports)")
+    p.add_argument("--job_name", default="cell-job")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="federation refresh/push interval seconds")
+    p.add_argument("--demands", default="",
+                   help="federation role demands, 'training=4,serving=2'")
+    return p.parse_args(argv)
+
+
+def _parse_demands(text: str) -> dict:
+    out = {}
+    for part in filter(None, (p.strip() for p in text.split(","))):
+        role, _, n = part.partition("=")
+        out[role.strip()] = int(n or 0)
+    return out
+
+
+def run_registry(args) -> int:
+    set_role("cell-registry")
+    from dlrover_tpu.serving.tier import RegistryServer
+
+    server = RegistryServer(port=args.port)
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(server.addr.rsplit(":", 1)[1])
+    logger.info("cell registry serving at %s", server.addr)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+def run_federation(args) -> int:
+    set_role("federation")
+    if not args.registry_addr:
+        logger.error("--federation requires --registry_addr")
+        return 2
+    from dlrover_tpu.cells.federation import FederationTier
+    from dlrover_tpu.cells.registry import CellRegistry
+    from dlrover_tpu.serving.tier import RpcKv
+
+    tier = FederationTier(
+        CellRegistry(RpcKv(args.registry_addr), job=args.job_name),
+        refresh_s=args.interval,
+        demands=_parse_demands(args.demands),
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    logger.info("federation up over registry %s (demands %s)",
+                args.registry_addr, tier.demands)
+    while not stop.wait(args.interval):
+        try:
+            view = tier.fleet_view(force=True)
+            if tier.demands and view.get("registry"):
+                tier.push_placement(view)
+        except Exception:  # noqa: BLE001 - the loop must outlive blips
+            logger.exception("federation pass failed")
+    tier.close()
+    return 0
+
+
+def main() -> None:
+    args = parse_args()
+    if args.registry:
+        sys.exit(run_registry(args))
+    if args.federation:
+        sys.exit(run_federation(args))
+    print("one of --registry / --federation is required", file=sys.stderr)
+    sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

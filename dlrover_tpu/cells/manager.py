@@ -1,0 +1,134 @@
+"""CellManager: the per-cell control-plane state one master carries.
+
+Every :class:`~dlrover_tpu.master.master.LocalJobMaster` owns one (a
+cell-less master just has an idle manager with ``cell_id=""``), so the
+HA machinery — journal capture/restore, standby replay, statecheck —
+treats cell state exactly like the task queue or the KV store: the
+placement the federation pushed survives a cell-master failover
+because it was journaled BEFORE the ack (PR-13 contract, graftcheck
+PC404).
+
+State held here:
+
+- **identity**: the cell id, and the ring ``view`` (the set of live
+  cell ids this master believes in) published with every registry
+  heartbeat — the federation cross-checks views to detect split
+  ownership (two masters both claiming a node range);
+- **placement**: the role -> per-cell count plan the federation tier
+  computed (:func:`dlrover_tpu.cells.federation.place_roles`), applied
+  idempotently by epoch so a DEADLINE-retried
+  ``CellPlacementUpdate`` is harmless (graftcheck PC403: nothing is
+  consumed — a replayed epoch is a no-op).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.state import JournalBound
+
+
+class CellManager(JournalBound):
+    def __init__(self, cell_id: str = "", capacity: int = 0):
+        self.cell_id = cell_id
+        #: Chip slots this cell controls (the hosting master's worker
+        #: ceiling) — the federation's placement budget for TPU roles.
+        #: Config, not adopted state: a standby backing this cell is
+        #: constructed with the same value.
+        self.capacity = int(capacity)
+        self._mu = threading.Lock()
+        self._placement: Dict[str, int] = {}
+        self._placement_epoch = -1
+        self._view: List[str] = [cell_id] if cell_id else []
+
+    # -- identity / ring view ---------------------------------------------
+
+    def set_view(self, cell_ids) -> None:
+        """Record the live cell set this master currently believes in
+        (refreshed from the registry each heartbeat).  View churn is
+        ephemeral ring state, not journaled: a recovering master
+        re-reads the registry before its first announce."""
+        with self._mu:
+            self._view = sorted(set(cell_ids) | ({self.cell_id}
+                                                 if self.cell_id else set()))
+
+    def view(self) -> List[str]:
+        with self._mu:
+            return list(self._view)
+
+    # -- placement ---------------------------------------------------------
+
+    def apply_placement(self, epoch: int, placement: Dict[str, int],
+                        _replay: bool = False) -> bool:
+        """Adopt the federation's role plan for THIS cell.  Idempotent
+        by epoch: an older or equal epoch is acknowledged without
+        effect, so retries and journal replays converge.  Returns True
+        when the plan actually changed."""
+        with self._mu:
+            if epoch <= self._placement_epoch:
+                return False
+            # Journal BEFORE the mutation is visible (PC404): a standby
+            # adopting this cell must reconcile toward the same plan.
+            self._jrec("cell.placement", epoch=int(epoch),
+                       placement=dict(placement))
+            self._placement_epoch = int(epoch)
+            self._placement = {
+                str(role): int(n) for role, n in (placement or {}).items()
+            }
+        if not _replay:
+            logger.info(
+                "cell %s: placement epoch %d adopted: %s",
+                self.cell_id or "-", epoch, placement,
+            )
+        return True
+
+    def placement(self) -> Dict[str, int]:
+        with self._mu:
+            return dict(self._placement)
+
+    @property
+    def placement_epoch(self) -> int:
+        with self._mu:
+            return self._placement_epoch
+
+    # -- snapshot surface (MasterState capture/restore) --------------------
+
+    def dump_state(self) -> dict:
+        with self._mu:
+            return {
+                "cell_id": self.cell_id,
+                "placement": dict(self._placement),
+                "epoch": self._placement_epoch,
+            }
+
+    def load_state(self, state: dict) -> None:
+        with self._mu:
+            # Identity is construction-time config, not adopted from a
+            # snapshot: a standby knows which cell it backs.  An empty
+            # own id (statecheck's fresh replay set) takes the
+            # snapshot's so divergence checks compare real state.
+            if not self.cell_id:
+                self.cell_id = str(state.get("cell_id", ""))
+            self._placement = {
+                str(k): int(v)
+                for k, v in (state.get("placement") or {}).items()
+            }
+            self._placement_epoch = int(state.get("epoch", -1))
+
+    def snapshot(self, extra: Optional[dict] = None) -> dict:
+        """The federation-facing snapshot body (``CellSnapshot``):
+        identity + placement + whatever live stats the hosting master
+        folds in (node counts, task queue depths, serving pools)."""
+        with self._mu:
+            out = {
+                "cell_id": self.cell_id,
+                "capacity": self.capacity,
+                "view": list(self._view),
+                "placement": dict(self._placement),
+                "placement_epoch": self._placement_epoch,
+            }
+        if extra:
+            out.update(extra)
+        return out

@@ -1,0 +1,68 @@
+"""CellRegistry: leased cell-master announcements in a shared KV.
+
+The PR-9 ``ServeRegistry`` idiom, verbatim (it IS the superclass):
+entries carry a heartbeat timestamp, liveness is judged reader-side
+(the value *changing* within ``lease_s`` of the reader's own clock —
+writer and reader clocks are never compared), dead entries go
+invisible at the next read and any member's sweep physically GC's
+them.  Zero cross-owner coordination: a cell-master death is purely
+its lease aging out, at which point the ring re-forms and the PEER
+cells adopt the dead cell's node ranges (``cells.cell.cell_for_node``
+over the surviving set), while the dead cell's own clients re-home
+via the PR-13 state-dir addr chain to its warm standby.
+
+Keys: ``cells/{job}/cell/{cell_id}`` -> JSON
+``{"addr", "ts", "view": [cell ids], "epoch"}``.  ``view`` is the
+announcing master's believed live-cell set — the federation
+cross-checks views to detect split ownership (chaos ``cell.split``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from dlrover_tpu.serving.tier import ServeRegistry
+
+
+class CellRegistry(ServeRegistry):
+    NAMESPACE = "cells"
+    SUBSPACES = ("cell/",)
+
+    # -- key layout -------------------------------------------------------
+
+    def cell_key(self, cell_id: str) -> str:
+        return f"{self._prefix}cell/{cell_id}"
+
+    # -- cells ------------------------------------------------------------
+
+    def announce_cell(self, cell_id: str, addr: str, view=(),
+                      epoch: int = -1) -> None:
+        now = self._clock()
+        self.kv.set(self.cell_key(cell_id), json.dumps({
+            "addr": addr,
+            "view": sorted(set(view) | {cell_id}),
+            "epoch": int(epoch),
+            "ts": now,
+        }).encode())
+        # The announcing handle observed its own heartbeat: its reads
+        # age the entry from NOW, not from a first-read grace.
+        self._seen[self.cell_key(cell_id)] = (now, now)
+
+    def remove_cell(self, cell_id: str) -> None:
+        self.kv.delete(self.cell_key(cell_id))
+        self._seen.pop(self.cell_key(cell_id), None)
+
+    def cells(self) -> Dict[str, dict]:
+        """Live (lease-valid) cell id -> {addr, view, epoch}."""
+        out: Dict[str, dict] = {}
+        for key, raw in self.kv.scan(f"{self._prefix}cell/").items():
+            ent = self._parse(key, raw)
+            if ent is None:
+                continue
+            if self._observe_live(key, float(ent.get("ts", 0.0))):
+                out[key.rsplit("/", 1)[1]] = ent
+        return out
+
+    def cell_addrs(self) -> Dict[str, str]:
+        return {cid: e.get("addr", "") for cid, e in self.cells().items()}

@@ -66,6 +66,7 @@ EXIT_GATEWAY_KILL = 81
 EXIT_DRAFT_KILL = 82
 EXIT_MASTER_KILL = 83
 EXIT_JOURNAL_TORN = 84
+EXIT_CELL_MASTER_KILL = 85
 
 #: site name -> (kind, defaults).  Kinds: ``error`` (caller raises),
 #: ``latency`` (inject() sleeps), ``crash`` (inject() calls os._exit),
@@ -215,6 +216,24 @@ SITES: Dict[str, dict] = {
                "frame — the literal crash-mid-append; reopen "
                "truncates the torn tail, losing exactly the unacked "
                "record",
+    },
+    # Multi-cell sites (ISSUE 15).  ``cell.master_kill`` is one cell's
+    # master dying UNCLEANLY — the cell's warm standby absorbs it while
+    # every OTHER cell must not black out; ``cell.split`` forges the
+    # two-owners-for-one-range state the federation's view cross-check
+    # must detect.
+    "cell.master_kill": {
+        "kind": "crash", "exit": EXIT_CELL_MASTER_KILL, "times": 1,
+        "doc": "`os._exit(85)` in one cell master's registry heartbeat "
+               "(`method=<cell_id>`, `step_ge=N` beats) — its standby "
+               "adopts the journaled state; peer cells never black out",
+    },
+    "cell.split": {
+        "kind": "flag", "times": 1,
+        "doc": "one cell heartbeat publishes a SELF-ONLY ring view "
+               "(`method=<cell_id>`) — the federation sees two owners "
+               "for one node range (`cell_split_detected`); views "
+               "self-heal on the next beat",
     },
     # Scale-out checkpoint site (ISSUE 7): a rank dies after streaming
     # its slice bytes but BEFORE the atomic publish + done-vote.

@@ -1,0 +1,69 @@
+"""Consistent hashing — the fleet's one ownership primitive.
+
+Extracted from ``serving/tier.py`` (ISSUE 15): the gateway tier proved
+the shape (requests hashed to gateways, death = the successor adopts
+the dead range, zero cross-owner coordination), and the multi-cell
+control plane reuses it verbatim for NODE -> CELL ownership.  One
+implementation, because two rings that drift is a split brain: every
+layer that answers "who owns this id?" must compute the identical
+answer from the identical member set.
+
+``serving.tier`` re-exports :class:`HashRing`/:func:`ring_hash`, so
+existing imports keep working; ring assignments are pinned by unit
+tests across the move (no ownership churn from the refactor).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Tuple
+
+
+def ring_hash(text: str) -> int:
+    """Stable 32-bit ring position.  sha1, not ``hash()``: must agree
+    across processes and interpreter runs (PYTHONHASHSEED)."""
+    return int.from_bytes(
+        hashlib.sha1(text.encode()).digest()[:4], "big"
+    )
+
+
+class HashRing:
+    """Consistent-hash ring over a member id set (gateway ids, cell
+    ids).
+
+    Each member owns ``vnodes`` points; a key's owner is the first
+    point clockwise from its hash.  Removing a dead member hands each
+    of its arcs to the SUCCESSOR point's member — the "adopts the dead
+    one's hash range" failover event, with no other ownership moving
+    (consistent hashing's whole point: a member death reshuffles only
+    the dead range)."""
+
+    def __init__(self, member_ids, vnodes: int = 64):
+        self.member_ids = tuple(sorted(set(member_ids)))
+        self.vnodes = int(vnodes)
+        points: List[Tuple[int, str]] = []
+        for mid in self.member_ids:
+            for v in range(self.vnodes):
+                points.append((ring_hash(f"{mid}#{v}"), mid))
+        points.sort()
+        self._points = points
+
+    # The serving tier named the member set after its members; kept as
+    # an alias so tier-era callers and reprs read unchanged.
+    @property
+    def gateway_ids(self) -> Tuple[str, ...]:
+        return self.member_ids
+
+    def owner(self, key: str) -> Optional[str]:
+        if not self._points:
+            return None
+        h = ring_hash(key)
+        # Binary search for the first point >= h (wrap to the start).
+        lo, hi = 0, len(self._points)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._points[mid][0] < h:
+                lo = mid + 1
+            else:
+                hi = mid
+        return self._points[lo % len(self._points)][1]

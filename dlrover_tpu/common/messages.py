@@ -1136,3 +1136,44 @@ class JournalChunk(Message):
     found: bool = True
     wal_size: int = -1
     wal_ino: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Multi-cell control plane (ISSUE 15): cell snapshot + placement wire
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CellSnapshotRequest(Message):
+    """Federation -> cell master: one snapshot read (identity, ring
+    view, placement epoch, node/task/pool counts).  Pure read — safe
+    for ``idempotent=True`` retries — and the ONLY recurring RPC the
+    federation tier makes, TTL-cached on its side so a cell pays at
+    most one per refresh interval."""
+
+    cell_id: str = ""
+
+
+@dataclasses.dataclass
+class CellSnapshot(Message):
+    """A cell master's snapshot body (``CellManager.snapshot`` plus
+    the hosting master's live stats).  ``found=False`` means the
+    answering master carries no cell identity (a plain single-master
+    job asked by mistake)."""
+
+    cell_id: str = ""
+    snapshot: dict = dataclasses.field(default_factory=dict)
+    found: bool = True
+
+
+@dataclasses.dataclass
+class CellPlacementUpdate(Message):
+    """Federation -> cell master: adopt this role plan (role -> member
+    count for THIS cell).  Idempotent by ``epoch`` — the handler
+    journals then applies only strictly-newer epochs, so a
+    DEADLINE-retried push (or two federations racing) converges on the
+    highest epoch without tokens (nothing is consumed)."""
+
+    cell_id: str = ""
+    epoch: int = -1
+    placement: dict = dataclasses.field(default_factory=dict)

@@ -75,11 +75,18 @@ class ChipBorrowArbiter:
         policy: Optional[BorrowPolicy] = None,
         signal_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         gain_fn: Optional[Callable[[], float]] = None,
+        scope: str = "",
     ):
         self.lender = lender
         self.borrower = borrower
         self.policy = policy or BorrowPolicy()
         self._signal_fn = signal_fn
+        #: Cell scope (ISSUE 15): which cell this arbiter actuates in.
+        #: A cell-aware loan path wires ``signal_fn`` to the federation
+        #: (``FederationTier.borrow_signal_fn``) so the DECISION sees
+        #: fleet-wide pressure, while lend/grow/reclaim stay inside
+        #: this cell — zero cross-cell coordination on the loan path.
+        self.scope = scope
         #: GAIN mode (ISSUE 11): arbitrate by a measured earned-value
         #: signal instead of queue depth — the draft-vs-target split
         #: follows measured tokens/round, not hardware identity (the
@@ -212,7 +219,7 @@ class ChipBorrowArbiter:
         journal("fleet.borrow", lender=self.lender.name,
                 borrower=self.borrower.name, phase_from=self.phase,
                 phase_to=phase, reason=reason,
-                borrowed=self.borrowed)
+                borrowed=self.borrowed, cell=self.scope)
         self.phase = phase
 
     def describe(self) -> Dict[str, Any]:
@@ -223,4 +230,5 @@ class ChipBorrowArbiter:
             "borrower": self.borrower.name,
             "phase": self.phase,
             "borrowed": self.borrowed,
+            "cell": self.scope,
         }

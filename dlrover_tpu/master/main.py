@@ -68,6 +68,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "to the addr file in --state_dir); probed before "
                         "a takeover so a stalled filesystem cannot cause "
                         "a split brain")
+    p.add_argument("--cell_id", default="",
+                   help="multi-cell mode (ISSUE 15): this master owns "
+                        "one CELL of the fleet (consistent-hash node "
+                        "ranges); announces itself in the shared cell "
+                        "registry each heartbeat")
+    p.add_argument("--cell_registry", default="",
+                   help="host:port of the shared cell-registry KV "
+                        "(a serving.tier RegistryServer or any master "
+                        "speaking KVStore*); required with --cell_id")
     return p.parse_args(argv)
 
 
@@ -88,6 +97,8 @@ def run_standby(args: argparse.Namespace) -> int:
         max_nodes=args.max_nodes,
         node_unit=args.node_unit,
         network_check=args.network_check,
+        cell_id=args.cell_id,
+        cell_registry_addr=args.cell_registry,
     )
     if args.port_file:
         with open(args.port_file, "w") as f:
@@ -120,6 +131,7 @@ def run(args: argparse.Namespace) -> int:
             network_check=args.network_check,
             resource_optimizer=optimizer,
             state_dir=args.state_dir,
+            cell_id=args.cell_id,
         )
     else:
         from dlrover_tpu.master.dist_master import DistributedJobMaster
@@ -146,14 +158,25 @@ def run(args: argparse.Namespace) -> int:
         )
     rc = 1
     _arm_chaos_restart()
+    cell_hb = None
     try:
         master.prepare()
+        if args.cell_id and args.cell_registry:
+            from dlrover_tpu.cells.cell import start_cell_heartbeat
+
+            cell_hb = start_cell_heartbeat(
+                args.cell_id, args.cell_registry, args.job_name,
+                lambda: f"127.0.0.1:{master.port}",
+                getattr(master, "cell_manager", None),
+            )
         if args.port_file:
             with open(args.port_file, "w") as f:
                 f.write(str(master.port))
         logger.info("master listening on port %d", master.port)
         rc = master.run()
     finally:
+        if cell_hb is not None:
+            cell_hb.stop()
         if optimizer is not None:
             # Mark the job terminal in the brain store even on a crash —
             # the cross-job cold-start path only learns from terminal

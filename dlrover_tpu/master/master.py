@@ -62,6 +62,7 @@ class LocalJobMaster(JobMaster):
         run_config: Optional[dict] = None,
         resource_optimizer=None,
         state_dir: str = "",
+        cell_id: str = "",
     ):
         self.job_name = job_name
         # Local mode has no platform to scale, but a Brain-backed optimizer
@@ -113,6 +114,16 @@ class LocalJobMaster(JobMaster):
         from dlrover_tpu.master.reshard import ReshardManager
 
         self.reshard_manager = ReshardManager()
+        # Multi-cell identity (ISSUE 15).  Every master carries a
+        # CellManager — a cell-less job just has an idle one — so the
+        # HA capture/replay/statecheck surface is uniform and a journal
+        # written by a cell master replays anywhere.  Capacity = this
+        # master's worker ceiling: the federation's placement budget
+        # for chip-holding roles in this cell.
+        from dlrover_tpu.cells.manager import CellManager
+
+        self.cell_manager = CellManager(cell_id=cell_id,
+                                        capacity=max_nodes)
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             job_manager=self.job_manager,
@@ -123,6 +134,7 @@ class LocalJobMaster(JobMaster):
             diagnosis_manager=self.diagnosis_manager,
             job_context=self,
             reshard_manager=self.reshard_manager,
+            cell_manager=self.cell_manager,
         )
         self._server = RpcServer(port, self.servicer)
         # Durable control-plane state (ISSUE 13): journal mutations,

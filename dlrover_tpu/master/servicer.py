@@ -35,6 +35,7 @@ class MasterServicer:
         job_context=None,
         reshard_manager=None,
         fleet_manager=None,
+        cell_manager=None,
     ):
         self.task_manager = task_manager
         self.job_manager = job_manager
@@ -46,6 +47,7 @@ class MasterServicer:
         self.job_context = job_context  # the master itself (stop control)
         self.reshard_manager = reshard_manager
         self.fleet_manager = fleet_manager
+        self.cell_manager = cell_manager
         self._dispatch = {
             m.NodeMeta: self._on_node_meta,
             m.ReportNodeStatus: self._on_node_status,
@@ -87,6 +89,8 @@ class MasterServicer:
             m.ReshardAnnounce: self._on_reshard_announce,
             m.FleetStatsRequest: self._on_fleet_stats,
             m.JournalFetch: self._on_journal_fetch,
+            m.CellSnapshotRequest: self._on_cell_snapshot,
+            m.CellPlacementUpdate: self._on_cell_placement,
         }
 
     def __call__(self, msg: m.Message) -> Optional[m.Message]:
@@ -426,6 +430,59 @@ class MasterServicer:
             data=data, offset=msg.offset, eof=not data,
             wal_size=st.st_size, wal_ino=st.st_ino,
         )
+
+    # -- multi-cell control plane (ISSUE 15) ---------------------------------
+    def _on_cell_snapshot(self, msg: m.CellSnapshotRequest):
+        """Federation read: identity + placement + live control-plane
+        load.  Pure read (idempotent-retry safe)."""
+        cm = self.cell_manager
+        if cm is None or not cm.cell_id:
+            return m.CellSnapshot(cell_id=msg.cell_id, found=False)
+        extra = {}
+        if self.job_manager is not None and \
+                hasattr(self.job_manager, "all_nodes"):
+            extra["nodes"] = len(self.job_manager.all_nodes())
+        if self.task_manager is not None and \
+                hasattr(self.task_manager, "queue_depths"):
+            doing, todo = self.task_manager.queue_depths()
+            extra["tasks_doing"] = doing
+            extra["tasks_pending"] = todo
+        if self.fleet_manager is not None:
+            status = self.fleet_manager.status()
+            extra["pools"] = {
+                role: {
+                    "alive": len(body.get("members") or ()),
+                    "slots": int(body.get("desired", 0)),
+                    "assigned": len(body.get("members") or ()),
+                    "queue_depth": int(
+                        (body.get("signals") or {}).get("queue_depth", 0)
+                        if isinstance(body.get("signals"), dict) else 0
+                    ),
+                }
+                for role, body in status.get("roles", {}).items()
+                if isinstance(body, dict) and "error" not in body
+            }
+        return m.CellSnapshot(
+            cell_id=cm.cell_id, snapshot=cm.snapshot(extra),
+        )
+
+    def _on_cell_placement(self, msg: m.CellPlacementUpdate):
+        """Adopt a federation role plan.  Idempotent by epoch — the
+        manager journals BEFORE the plan becomes visible, so a standby
+        adopting this cell reconciles toward the same placement."""
+        cm = self.cell_manager
+        if cm is None or not cm.cell_id:
+            return m.BaseResponse(
+                success=False, reason="no cell identity on this master"
+            )
+        if msg.cell_id and msg.cell_id != cm.cell_id:
+            return m.BaseResponse(
+                success=False,
+                reason=f"placement for {msg.cell_id!r} sent to "
+                       f"{cm.cell_id!r}",
+            )
+        cm.apply_placement(msg.epoch, msg.placement or {})
+        return m.BaseResponse(success=True)
 
     # -- fleet control plane (ISSUE 10) -------------------------------------
     def _on_fleet_stats(self, msg: m.FleetStatsRequest):

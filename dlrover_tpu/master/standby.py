@@ -187,6 +187,8 @@ class StandbyMaster:
         tail_poll_s: Optional[float] = None,
         rpc_source: Optional[RpcJournalSource] = None,
         run_config: Optional[dict] = None,
+        cell_id: str = "",
+        cell_registry_addr: str = "",
     ):
         ctx = get_context()
         self.state_dir = state_dir
@@ -208,7 +210,16 @@ class StandbyMaster:
             node_unit=node_unit,
             network_check=network_check,
             run_config=run_config,
+            cell_id=cell_id,
         )
+        # Multi-cell composition (ISSUE 15): a standby backing a CELL
+        # master re-announces the cell in the shared registry after a
+        # takeover, so the federation (and any client resolving by
+        # ring) re-homes to the new leader; the state-dir addr chain
+        # covers the cell's already-connected clients either way.
+        self.cell_id = cell_id
+        self._cell_registry_addr = cell_registry_addr
+        self._cell_heartbeat = None
         self.state = MasterState.of_master(self.master)
         contents = read_state_dir(state_dir)
         _, divergences = recover_into(self.state, contents)
@@ -261,6 +272,9 @@ class StandbyMaster:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._cell_heartbeat is not None:
+            self._cell_heartbeat.stop()
+            self._cell_heartbeat = None
         if self._took_over.is_set():
             self.master.request_stop(True, "standby stopped")
             self.master.stop()
@@ -360,6 +374,22 @@ class StandbyMaster:
              "records": self.records_applied},
         )
         master.prepare()  # serves + publishes addr + starts the keeper
+        if self.cell_id and self._cell_registry_addr:
+            try:
+                from dlrover_tpu.cells.cell import start_cell_heartbeat
+
+                self._cell_heartbeat = start_cell_heartbeat(
+                    self.cell_id, self._cell_registry_addr,
+                    master.job_name, lambda: master.addr,
+                    cell_manager=master.cell_manager,
+                )
+            except Exception:  # noqa: BLE001 - the takeover must
+                # complete even if the registry is briefly unreachable;
+                # clients still re-home via the addr file
+                logger.warning(
+                    "cell %s: post-takeover registry announce failed",
+                    self.cell_id, exc_info=True,
+                )
         self.takeover_s = time.monotonic() - t0
         self._took_over.set()
         try:

@@ -30,6 +30,7 @@ from dlrover_tpu.master.state import MasterState, read_state_dir
 
 
 def _fresh_state() -> MasterState:
+    from dlrover_tpu.cells.manager import CellManager
     from dlrover_tpu.common.constants import RendezvousName
     from dlrover_tpu.master.kv_store import KVStoreService
     from dlrover_tpu.master.node_manager import LocalJobManager
@@ -53,6 +54,7 @@ def _fresh_state() -> MasterState:
         job_manager=LocalJobManager(),
         speed_monitor=SpeedMonitor(),
         sync_service=SyncService(),
+        cell_manager=CellManager(),
     )
 
 

@@ -27,7 +27,7 @@ import dataclasses
 import json
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.token_cache import BoundedTokenCache
@@ -217,6 +217,16 @@ class TaskManager(JournalBound):
     def has_dataset(self, name: str) -> bool:
         with self._lock:
             return name in self._datasets
+
+    def queue_depths(self) -> Tuple[int, int]:
+        """(doing, todo) task counts across every dataset — the
+        control-plane load signal a cell snapshot reports (ISSUE 15)."""
+        with self._lock:
+            doing = sum(
+                len(ds._doing) for ds in self._datasets.values()
+            )
+            todo = sum(len(ds._todo) for ds in self._datasets.values())
+            return doing, todo
 
     def get_task(self, dataset_name: str, worker_id: int, token: str = ""):
         """Pop the next task.  A non-empty ``token`` makes the fetch

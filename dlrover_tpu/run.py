@@ -59,6 +59,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="control-plane journal dir for --standby "
                         "(default: a run-scoped dir under the system "
                         "temp dir)")
+    p.add_argument("--cell", type=int, default=0,
+                   help="multi-cell control plane (ISSUE 15): spawn a "
+                        "shared cell registry plus N cell masters "
+                        "(consistent-hash node ownership); this node "
+                        "talks to its node id's OWNING cell.  Composes "
+                        "with --standby: every cell master then gets "
+                        "its own journal + warm standby")
     p.add_argument("--nnodes", default="1",
                    help="'N' or 'MIN:MAX' elastic node range")
     p.add_argument("--nproc_per_node", type=int, default=1)
@@ -167,6 +174,12 @@ def _master_cmd(args, port: int, port_file: str = "",
         cmd += ["--port_file", port_file]
     if state_dir:
         cmd += ["--state_dir", state_dir]
+    # Multi-cell launches stash the per-cell identity on a COPY of the
+    # args namespace (the count flag itself is ``--cell``), so every
+    # relaunch path — cold supervisor, HA promote — reproduces it.
+    if getattr(args, "cell_id", ""):
+        cmd += ["--cell_id", args.cell_id,
+                "--cell_registry", getattr(args, "cell_registry", "")]
     return cmd
 
 
@@ -210,6 +223,9 @@ def _launch_standby_master(args, state_dir: str, primary_addr: str) \
         "--port", "0", "--port_file", port_file,
         "--job_name", args.job_name,
     ]
+    if getattr(args, "cell_id", ""):
+        cmd += ["--cell_id", args.cell_id,
+                "--cell_registry", getattr(args, "cell_registry", "")]
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
     cmd += ["--min_nodes", str(min_nodes), "--max_nodes", str(max_nodes),
             "--node_unit", str(args.node_unit)]
@@ -394,6 +410,100 @@ def _supervise_ha_masters(
     return thread
 
 
+def _launch_cell_registry(args) -> Tuple[subprocess.Popen, str]:
+    """Spawn the shared cell-registry KV and wait for its port."""
+    port_file = tempfile.mktemp(prefix="dlrtpu_cellreg_port_")
+    proc = subprocess.Popen([
+        sys.executable, "-m", "dlrover_tpu.cells.main",
+        "--registry", "--port", "0", "--port_file", port_file,
+    ])
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                content = f.read().strip()
+            if content:
+                os.unlink(port_file)
+                return proc, f"127.0.0.1:{content}"
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"cell registry exited early rc={proc.returncode}"
+            )
+        time.sleep(0.2)
+    raise TimeoutError("cell registry did not report its port in 60s")
+
+
+def _launch_cells(args, master_stop: threading.Event) -> str:
+    """``--cell N`` (ISSUE 15): registry + N cell masters, each under
+    the SAME supervision ladder a single master gets (cold relaunch,
+    or journal + warm standby with ``--standby``).  Returns the addr of
+    THIS node's owning cell master."""
+    import argparse as _argparse
+
+    from dlrover_tpu.cells.cell import cell_for_node
+
+    reg_proc, reg_addr = _launch_cell_registry(args)
+    atexit.register(
+        lambda: reg_proc.poll() is None and reg_proc.terminate()
+    )
+    # Exported for sidecar tooling: `python -m dlrover_tpu.cells.main
+    # --federation` (and operator debugging) defaults its registry
+    # address from this.
+    os.environ["DLROVER_TPU_CELL_REGISTRY"] = reg_addr
+    cell_ids = [f"cell{i}" for i in range(args.cell)]
+    base_state = args.master_state_dir or os.path.join(
+        tempfile.gettempdir(),
+        f"dlrtpu_cells_{args.job_name}_"
+        f"{os.environ['DLROVER_TPU_RUN_ID']}",
+    )
+    addrs: dict = {}
+    for cid in cell_ids:
+        cell_args = _argparse.Namespace(**vars(args))
+        cell_args.cell_id = cid
+        cell_args.cell_registry = reg_addr
+        state_dir = ""
+        if args.standby:
+            state_dir = os.path.join(base_state, cid)
+            os.makedirs(state_dir, exist_ok=True)
+        holder: List[subprocess.Popen] = []
+        proc, addr, port = _launch_local_master(cell_args, state_dir)
+        holder.append(proc)
+        addrs[cid] = (addr, state_dir)
+        atexit.register(
+            lambda h=holder: h[0].poll() is None and h[0].terminate()
+        )
+        if args.standby:
+            sb_holder: List[subprocess.Popen] = []
+            sb_proc, _sb_addr = _launch_standby_master(
+                cell_args, state_dir, addr
+            )
+            sb_holder.append(sb_proc)
+            atexit.register(
+                lambda h=sb_holder: h[0].poll() is None
+                and h[0].terminate()
+            )
+            _supervise_ha_masters(
+                cell_args, state_dir, holder, sb_holder, master_stop,
+                args.max_restarts,
+            )
+        else:
+            _supervise_local_master(
+                cell_args, holder, port, master_stop, args.max_restarts
+            )
+    node_id = args.node_id if args.node_id >= 0 else args.node_rank
+    own = cell_for_node(node_id, cell_ids)
+    own_addr, own_state = addrs[own]
+    if own_state:
+        # The agent's failover chain follows the OWNING cell's journal.
+        os.environ["DLROVER_TPU_MASTER_STATE_DIR"] = own_state
+    logger.info(
+        "multi-cell control plane up: registry %s, cells %s; node %d "
+        "-> %s at %s", reg_addr,
+        {c: a for c, (a, _s) in addrs.items()}, node_id, own, own_addr,
+    )
+    return own_addr
+
+
 def _gc_shm_arenas(
     job_name: str, run_id: str = "", min_age_s: float = 3600.0
 ) -> None:
@@ -445,7 +555,10 @@ def run(args: argparse.Namespace) -> int:
     master_stop = threading.Event()
     master_addr = args.master_addr
     ha_state_dir = ""
-    if args.standalone and not master_addr:
+    if args.standalone and not master_addr and args.cell > 0:
+        master_addr = _launch_cells(args, master_stop)
+        ha_state_dir = os.environ.get("DLROVER_TPU_MASTER_STATE_DIR", "")
+    elif args.standalone and not master_addr:
         if args.standby:
             ha_state_dir = args.master_state_dir or os.path.join(
                 tempfile.gettempdir(),

@@ -228,6 +228,37 @@ _GKE_TPU_ACCELERATOR = {
 _RFC1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
 _TOPOLOGY = re.compile(r"^\d+x\d+(x\d+)?$")
 
+#: Node roles that belong on CPU node pools: control-plane and
+#: front-door processes own no chips, and scheduling them onto TPU
+#: hosts burns slice capacity (a cell master on a v5p host idles 4
+#: chips).  Everything else — workers, serving replicas — rides the
+#: TPU pool its resource/topology selectors name.  THE one CPU-role
+#: classification: ``cells.federation`` imports this, so a role the
+#: platform schedules onto CPU pools is never chip-charged by the
+#: placement (and vice versa).
+CPU_POOL_ROLES = ("master", "cell-master", "gateway", "registry")
+
+
+def role_node_pools(cpu_pool: str, tpu_pool: str = "",
+                    extra: Optional[Dict[str, str]] = None
+                    ) -> Dict[str, str]:
+    """The role -> GKE node-pool map the multi-cell launcher pins with
+    (ISSUE 15, on top of ``tpurun --node_role``): CPU pools for cell
+    masters/gateways/registries, TPU pools for chip-holding roles.  An
+    empty ``tpu_pool`` leaves TPU roles unpinned (the accelerator +
+    topology selectors already constrain them); ``extra`` overrides
+    win."""
+    pools: Dict[str, str] = {}
+    for role in CPU_POOL_ROLES:
+        if cpu_pool:
+            pools[role] = cpu_pool
+    if tpu_pool:
+        for role in ("worker", "chief", "replica", "draft",
+                     "embedding"):
+            pools[role] = tpu_pool
+    pools.update(extra or {})
+    return pools
+
 
 def gke_tpu_accelerator(tpu_type: str) -> str:
     """Map a NodeResource.tpu_type (``v5e``) to GKE's accelerator node
@@ -247,7 +278,8 @@ def gke_tpu_accelerator(tpu_type: str) -> str:
     )
 
 
-def validate_gke_tpu_pod(pod, expect_tpu: bool = True) -> None:
+def validate_gke_tpu_pod(pod, expect_tpu: bool = True,
+                         cpu_pools: frozenset = frozenset()) -> None:
     """Schema-validate a pod we are about to submit against the GKE TPU
     contract — the closest this environment gets to the reference's
     envtest-based controller validation
@@ -298,6 +330,18 @@ def validate_gke_tpu_pod(pod, expect_tpu: bool = True) -> None:
         if topo is not None and not _TOPOLOGY.match(str(topo)):
             errs.append(f"gke-tpu-topology {topo!r} must look like "
                         "'2x4' or '4x4x4'")
+    pool = selector.get("cloud.google.com/gke-nodepool")
+    if pool is not None:
+        if not _RFC1123.match(str(pool)):
+            errs.append(f"gke-nodepool {pool!r} is not RFC1123")
+        # Role/pool coherence (ISSUE 15): a chip-requesting pod pinned
+        # to a declared CPU pool sits Pending forever (no google.com/tpu
+        # capacity there) — reject at submit, not at 3am.
+        if expect_tpu and pool in cpu_pools:
+            errs.append(
+                f"pod requests google.com/tpu but is pinned to CPU "
+                f"node pool {pool!r}"
+            )
     if errs:
         raise ValueError(
             "pod spec violates the GKE TPU contract: " + "; ".join(errs)
@@ -323,6 +367,7 @@ class GkePlatform(PlatformClient):
         api=None,
         client_mod=None,
         watch_mod=None,
+        node_pools: Optional[Dict[str, str]] = None,
     ):
         if api is not None:
             self._core = api
@@ -346,6 +391,15 @@ class GkePlatform(PlatformClient):
             self._client_mod = client
         self._namespace = namespace
         self._image = image
+        #: Role/node-type -> GKE node-pool pin (ISSUE 15): CPU pools
+        #: for cell masters/gateways, TPU pools for workers — see
+        #: :func:`role_node_pools`.  CPU pools are remembered so the
+        #: validator can reject a chip-requesting pod pinned to one.
+        self._node_pools = dict(node_pools or {})
+        self._cpu_pools = frozenset(
+            pool for role, pool in self._node_pools.items()
+            if role in CPU_POOL_ROLES
+        )
 
     def create_node(self, node: Node, job_name: str) -> PlatformNode:
         name = _node_name(job_name, node)
@@ -374,6 +428,9 @@ class GkePlatform(PlatformClient):
                 selector["cloud.google.com/gke-tpu-topology"] = (
                     res.tpu_topology
                 )
+        pool = self._node_pools.get(node.type)
+        if pool:
+            selector["cloud.google.com/gke-nodepool"] = pool
         pod = c.V1Pod(
             metadata=c.V1ObjectMeta(
                 name=name,
@@ -396,7 +453,8 @@ class GkePlatform(PlatformClient):
                 ],
             ),
         )
-        validate_gke_tpu_pod(pod, expect_tpu=bool(res.tpu_chips))
+        validate_gke_tpu_pod(pod, expect_tpu=bool(res.tpu_chips),
+                             cpu_pools=self._cpu_pools)
         self._core.create_namespaced_pod(self._namespace, pod)
         return PlatformNode(
             name=name,

@@ -45,7 +45,6 @@ heartbeat loop, ``method=<gateway_id>`` selecting the victim.
 
 from __future__ import annotations
 
-import hashlib
 import json
 import threading
 import time
@@ -84,51 +83,12 @@ from dlrover_tpu.serving.gateway import Gateway, GatewayConfig
 
 
 # ---------------------------------------------------------------------------
-# Consistent hashing
+# Consistent hashing — extracted to common/hashring.py (ISSUE 15: the
+# multi-cell control plane shares the exact ownership primitive);
+# re-exported here so tier-era imports keep working.
 # ---------------------------------------------------------------------------
 
-
-def ring_hash(text: str) -> int:
-    """Stable 32-bit ring position.  sha1, not ``hash()``: must agree
-    across processes and interpreter runs (PYTHONHASHSEED)."""
-    return int.from_bytes(
-        hashlib.sha1(text.encode()).digest()[:4], "big"
-    )
-
-
-class HashRing:
-    """Consistent-hash ring over a gateway id set.
-
-    Each gateway owns ``vnodes`` points; a request id's owner is the
-    first point clockwise from its hash.  Removing a dead gateway hands
-    each of its arcs to the SUCCESSOR point's gateway — the "adopts the
-    dead one's hash range" failover event, with no other ownership
-    moving (consistent hashing's whole point: a gateway death reshuffles
-    only the dead range)."""
-
-    def __init__(self, gateway_ids, vnodes: int = 64):
-        self.gateway_ids = tuple(sorted(set(gateway_ids)))
-        self.vnodes = int(vnodes)
-        points: List[Tuple[int, str]] = []
-        for gid in self.gateway_ids:
-            for v in range(self.vnodes):
-                points.append((ring_hash(f"{gid}#{v}"), gid))
-        points.sort()
-        self._points = points
-
-    def owner(self, req_id: str) -> Optional[str]:
-        if not self._points:
-            return None
-        h = ring_hash(req_id)
-        # Binary search for the first point >= h (wrap to the start).
-        lo, hi = 0, len(self._points)
-        while lo < hi:
-            mid = (lo + hi) // 2
-            if self._points[mid][0] < h:
-                lo = mid + 1
-            else:
-                hi = mid
-        return self._points[lo % len(self._points)][1]
+from dlrover_tpu.common.hashring import HashRing, ring_hash  # noqa: E402,F401
 
 
 # ---------------------------------------------------------------------------
@@ -300,13 +260,20 @@ class ServeRegistry:
     Keys are namespaced per job so two jobs sharing one master KV
     never see each other's fleets."""
 
+    #: KV namespace and the leased sub-spaces under it.  Subclasses
+    #: (the cell registry, ISSUE 15) override these two and inherit the
+    #: reader-side lease machinery unchanged — one lease implementation
+    #: for every fleet-membership surface.
+    NAMESPACE = "serve"
+    SUBSPACES = ("gw/", "rep/")
+
     def __init__(self, kv, job: str = "default", lease_s: float = 10.0,
                  clock: Callable[[], float] = time.time):
         self.kv = kv
         self.job = job
         self.lease_s = float(lease_s)
         self._clock = clock
-        self._prefix = f"serve/{job}/"
+        self._prefix = f"{self.NAMESPACE}/{job}/"
         #: key -> (last seen ts VALUE, local time that value appeared).
         self._seen: Dict[str, Tuple[float, float]] = {}
 
@@ -379,7 +346,7 @@ class ServeRegistry:
         never delete peers' fresh entries (any tier member may sweep;
         deletes are idempotent).  Returns the deleted keys."""
         dead: List[str] = []
-        for sub in ("gw/", "rep/"):
+        for sub in self.SUBSPACES:
             for key, raw in self.kv.scan(self._prefix + sub).items():
                 ent = self._parse(key, raw)
                 if ent is None or not self._observe_live(

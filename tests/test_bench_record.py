@@ -548,3 +548,50 @@ def test_ha_bench_smoke_schema(tmp_path):
     assert metric["value"] == warm["blackout_s"]
     assert metric["vs_baseline"] == cold["blackout_s"]
     assert metric["artifact"] == str(out)
+
+
+def test_cell_bench_smoke_schema(tmp_path):
+    """Tier-1 gate for ISSUE 15's multi-cell bench: the smoke config
+    runs real registry + cell-master subprocesses over gRPC with the
+    modeled journal-append floor and emits schema-valid JSON — per-row
+    ops/s present for 1 and 2 cells, 2 cells sustaining >= 1.5x the
+    single master (the PR's acceptance criterion) under the open-loop
+    stream, and the metric line naming the artifact."""
+    import os
+    import subprocess
+    import time
+
+    out = tmp_path / "CELL_BENCH_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DLROVER_TPU_FAULTS", None)
+    env.pop("DLROVER_TPU_MASTER_STATE_DIR", None)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(Path(bench.__file__)), "--cell_bench",
+         "--smoke", "--floor_ms=3", "--clients=16", f"--out={out}"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(Path(bench.__file__).parent),
+    )
+    elapsed = time.time() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+    assert elapsed < 60.0, f"smoke cell bench took {elapsed:.1f}s"
+    result = json.loads(out.read_text())
+    assert result["bench"] == "cell"
+    assert result["complete"] is True
+    assert result["smoke"] is True
+    by_cells = {r["cells"]: r for r in result["rows"]}
+    assert set(by_cells) == {1, 2}
+    for row in result["rows"]:
+        assert row["ops_per_s"] > 0
+        assert row["completed"] > 0
+        assert row["offered_rps"] > 0
+        assert row["floor_ms"] == 3.0
+    assert result["speedup"] >= 1.5
+    assert by_cells[2]["ops_per_s"] > by_cells[1]["ops_per_s"]
+    # Smoke skips the failover section (subprocess-heavy; the full
+    # bench and the chaos e2e own it).
+    assert "failover" not in result
+    metric = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert metric["metric"] == "cell_control_plane_ops_per_s"
+    assert metric["value"] == by_cells[2]["ops_per_s"]
+    assert metric["artifact"] == str(out)

@@ -1,0 +1,564 @@
+"""Multi-cell control plane units (ISSUE 15): HashRing extraction,
+cell ownership/registry, the journaled CellManager, the servicer's
+cell surface, federation merge/placement/split detection, chaos sites,
+and placement surviving a journal recovery.  All tier-1 (marker
+``cells``); the process-tree failover e2e lives in test_chaos_e2e.py.
+"""
+
+import os
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from dlrover_tpu import chaos  # noqa: E402
+from dlrover_tpu.cells import (  # noqa: E402
+    CellHeartbeat,
+    CellManager,
+    CellMap,
+    CellRegistry,
+    FederationTier,
+    cell_for_node,
+    detect_splits,
+    merge_cell_snapshots,
+    node_key,
+    place_roles,
+)
+from dlrover_tpu.common import messages as m  # noqa: E402
+from dlrover_tpu.common.hashring import HashRing, ring_hash  # noqa: E402
+from dlrover_tpu.serving.tier import LocalKv  # noqa: E402
+
+pytestmark = pytest.mark.cells
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# HashRing extraction (satellite: no ownership churn across the move)
+# ---------------------------------------------------------------------------
+
+
+class TestHashRingExtraction:
+    def test_tier_reexport_is_the_common_class(self):
+        from dlrover_tpu.common import hashring
+        from dlrover_tpu.serving import tier
+
+        assert tier.HashRing is hashring.HashRing
+        assert tier.ring_hash is hashring.ring_hash
+        # The package-level serving export follows too.
+        from dlrover_tpu import serving
+
+        assert serving.HashRing is hashring.HashRing
+
+    def test_ring_assignments_pinned_across_move(self):
+        """Golden owners recorded at extraction time: any change to
+        the hash, the vnode naming, or the search would re-own live
+        requests/nodes during a rolling upgrade."""
+        ring = HashRing(["g0", "g1", "g2"])
+        assert {k: ring.owner(k) for k in (
+            "req-0", "req-1", "req-2", "req-3", "alpha", "beta",
+        )} == {
+            "req-0": "g1", "req-1": "g0", "req-2": "g2",
+            "req-3": "g2", "alpha": "g0", "beta": "g1",
+        }
+        assert ring_hash("req-0") == 2987311802
+
+    def test_gateway_ids_alias(self):
+        ring = HashRing(["b", "a"])
+        assert ring.member_ids == ("a", "b")
+        assert ring.gateway_ids == ring.member_ids
+
+
+# ---------------------------------------------------------------------------
+# Cell ownership
+# ---------------------------------------------------------------------------
+
+
+class TestCellOwnership:
+    def test_pinned_node_owners(self):
+        owners = {
+            i: cell_for_node(i, ["c0", "c1", "c2"]) for i in range(8)
+        }
+        assert owners == {0: "c0", 1: "c0", 2: "c1", 3: "c1",
+                          4: "c1", 5: "c1", 6: "c2", 7: "c0"}
+
+    def test_death_moves_only_the_dead_range(self):
+        cells = ["c0", "c1", "c2"]
+        before = {i: cell_for_node(i, cells) for i in range(256)}
+        after = {i: cell_for_node(i, ["c0", "c2"]) for i in range(256)}
+        for i in range(256):
+            if before[i] != "c1":
+                assert after[i] == before[i]
+        moved = [i for i in range(256) if before[i] == "c1"]
+        assert moved  # the dead range really existed
+        assert all(after[i] in ("c0", "c2") for i in moved)
+
+    def test_node_key_is_canonical(self):
+        assert node_key(7) == "node:7"
+        assert cell_for_node("7", ["c0", "c1"]) == \
+            cell_for_node(7, ["c0", "c1"])
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+class TestCellRegistry:
+    def test_announce_lease_and_gc(self):
+        now = [100.0]
+        reg = CellRegistry(LocalKv(), job="j", lease_s=5.0,
+                           clock=lambda: now[0])
+        reg.announce_cell("c0", "h:1", view=["c0", "c1"], epoch=3)
+        reg.announce_cell("c1", "h:2")
+        cells = reg.cells()
+        assert cells["c0"]["addr"] == "h:1"
+        assert cells["c0"]["view"] == ["c0", "c1"]
+        assert cells["c0"]["epoch"] == 3
+        assert cells["c1"]["view"] == ["c1"]  # self always included
+        # c1 stops beating; c0 keeps going.
+        now[0] = 104.0
+        reg.announce_cell("c0", "h:1")
+        now[0] = 106.0
+        assert set(reg.cells()) == {"c0"}  # c1's lease expired
+        dead = reg.gc_stale()
+        assert dead == ["cells/j/cell/c1"]
+        assert reg.kv.get("cells/j/cell/c1") is None
+
+    def test_namespace_isolated_from_serving(self):
+        from dlrover_tpu.serving.tier import ServeRegistry
+
+        kv = LocalKv()
+        serve = ServeRegistry(kv, job="j")
+        cellr = CellRegistry(kv, job="j")
+        serve.announce_gateway("g0", "h:1")
+        cellr.announce_cell("c0", "h:2")
+        assert set(cellr.cells()) == {"c0"}
+        assert set(serve.gateways()) == {"g0"}
+
+    def test_cell_map_reroutes_on_death(self):
+        now = [0.0]
+        reg = CellRegistry(LocalKv(), job="j", lease_s=2.0,
+                           clock=lambda: now[0])
+        reg.announce_cell("c0", "h:1")
+        reg.announce_cell("c1", "h:2")
+        cmap = CellMap(reg, refresh_s=0.0, clock=lambda: now[0])
+        assert cmap.cell_ids() == ["c0", "c1"]
+        owners = {i: cmap.owner(i) for i in range(32)}
+        assert cmap.addr_for_node(0) in ("h:1", "h:2")
+        # c1 dies; its nodes re-home to c0, others never move.
+        now[0] = 3.0
+        reg.announce_cell("c0", "h:1")
+        for i in range(32):
+            if owners[i] == "c0":
+                assert cmap.owner(i) == "c0"
+            else:
+                assert cmap.owner(i) == "c0"  # adopted
+        assert cmap.addr_for_node(5) == "h:1"
+
+
+# ---------------------------------------------------------------------------
+# CellManager: journaled placement
+# ---------------------------------------------------------------------------
+
+
+class _FakeJournal:
+    def __init__(self):
+        self.records = []
+
+    def append(self, kind, fields):
+        self.records.append((kind, dict(fields)))
+        return len(self.records)
+
+
+class TestCellManager:
+    def test_placement_epoch_idempotent(self):
+        cm = CellManager("c0")
+        assert cm.apply_placement(1, {"training": 2}) is True
+        assert cm.apply_placement(1, {"training": 9}) is False
+        assert cm.apply_placement(0, {"training": 9}) is False
+        assert cm.placement() == {"training": 2}
+        assert cm.apply_placement(2, {"training": 3}) is True
+        assert cm.placement_epoch == 2
+
+    def test_placement_journaled_before_visible(self):
+        cm = CellManager("c0")
+        j = _FakeJournal()
+        cm.bind_journal(j)
+        cm.apply_placement(5, {"serving": 1})
+        assert j.records == [
+            ("cell.placement",
+             {"epoch": 5, "placement": {"serving": 1}}),
+        ]
+        # A stale epoch never journals (replay must converge).
+        cm.apply_placement(5, {"serving": 9})
+        assert len(j.records) == 1
+
+    def test_dump_load_roundtrip(self):
+        cm = CellManager("c0")
+        cm.apply_placement(4, {"training": 2, "gateway": 1})
+        fresh = CellManager()
+        fresh.load_state(cm.dump_state())
+        assert fresh.cell_id == "c0"
+        assert fresh.placement() == {"training": 2, "gateway": 1}
+        assert fresh.placement_epoch == 4
+
+    def test_snapshot_body(self):
+        cm = CellManager("c0")
+        cm.set_view(["c1", "c0"])
+        cm.apply_placement(1, {"training": 2})
+        snap = cm.snapshot({"nodes": 3})
+        assert snap["cell_id"] == "c0"
+        assert snap["view"] == ["c0", "c1"]
+        assert snap["placement"] == {"training": 2}
+        assert snap["placement_epoch"] == 1
+        assert snap["nodes"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Servicer surface (in-process: the dispatch table, no sockets)
+# ---------------------------------------------------------------------------
+
+
+def _cell_master(cell_id="c0", state_dir=""):
+    from dlrover_tpu.master.master import LocalJobMaster
+
+    return LocalJobMaster(0, job_name="t", cell_id=cell_id,
+                          state_dir=state_dir)
+
+
+class TestCellServicer:
+    def test_snapshot_request(self):
+        master = _cell_master()
+        resp = master.servicer(m.CellSnapshotRequest(cell_id="c0"))
+        assert isinstance(resp, m.CellSnapshot) and resp.found
+        assert resp.cell_id == "c0"
+        assert resp.snapshot["cell_id"] == "c0"
+        assert "tasks_doing" in resp.snapshot
+        assert "nodes" in resp.snapshot
+
+    def test_cell_less_master_answers_not_found(self):
+        master = _cell_master(cell_id="")
+        resp = master.servicer(m.CellSnapshotRequest(cell_id="c0"))
+        assert isinstance(resp, m.CellSnapshot) and not resp.found
+
+    def test_placement_update_and_stale_retry(self):
+        master = _cell_master()
+        ok = master.servicer(m.CellPlacementUpdate(
+            cell_id="c0", epoch=1, placement={"training": 2},
+        ))
+        assert ok.success
+        # A DEADLINE-retried duplicate acks without effect.
+        dup = master.servicer(m.CellPlacementUpdate(
+            cell_id="c0", epoch=1, placement={"training": 99},
+        ))
+        assert dup.success
+        assert master.cell_manager.placement() == {"training": 2}
+
+    def test_misrouted_placement_rejected(self):
+        master = _cell_master()
+        resp = master.servicer(m.CellPlacementUpdate(
+            cell_id="c9", epoch=1, placement={"training": 2},
+        ))
+        assert not resp.success and "c9" in resp.reason
+        assert master.cell_manager.placement_epoch == -1
+
+
+# ---------------------------------------------------------------------------
+# Federation: merge / placement / split detection
+# ---------------------------------------------------------------------------
+
+
+class TestFederationPure:
+    def test_merge_cell_snapshots(self):
+        merged = merge_cell_snapshots([
+            {"cell_id": "c0", "nodes": 2, "tasks_doing": 1,
+             "tasks_pending": 4, "placement_epoch": 3,
+             "pools": {"serving": {"alive": 2, "slots": 4,
+                                   "assigned": 3, "queue_depth": 5}}},
+            {"cell_id": "c1", "nodes": 3, "tasks_doing": 2,
+             "tasks_pending": 1, "placement_epoch": 3,
+             "pools": {"serving": {"alive": 1, "slots": 2,
+                                   "assigned": 1, "queue_depth": 2}}},
+            {},
+        ])
+        assert merged["cells_alive"] == 2
+        assert merged["nodes"] == 5
+        assert merged["tasks_doing"] == 3
+        assert merged["tasks_pending"] == 5
+        pool = merged["pools"]["serving"]
+        assert pool["alive"] == 3 and pool["slots"] == 6
+        assert pool["queue_depth"] == 7
+        assert pool["occupancy"] == pytest.approx(4 / 6)
+        assert set(merged["cells"]) == {"c0", "c1"}
+
+    def test_detect_splits_healthy_and_forged(self):
+        healthy = {
+            "c0": {"view": ["c0", "c1"]},
+            "c1": {"view": ["c0", "c1"]},
+        }
+        assert detect_splits(healthy) == []
+        forged = {
+            "c0": {"view": ["c0"]},  # claims the whole ring
+            "c1": {"view": ["c0", "c1"]},
+        }
+        splits = detect_splits(forged)
+        assert splits
+        assert all(claim == ["c0", "c1"] for _, claim in splits)
+
+    def test_place_roles_properties(self):
+        cells = {"c0": {"capacity": 4}, "c1": {"capacity": 4},
+                 "c2": {"capacity": 0}}
+        demands = {"training": 6, "serving": 2, "gateway": 3,
+                   "cell-master": 3, "draft": 1}
+        plan = place_roles(cells, demands)
+        assert plan == place_roles(cells, demands)  # deterministic
+        # CPU roles spread over ALL cells, no capacity charge.
+        assert sum(plan["gateway"].values()) == 3
+        assert sum(plan["cell-master"].values()) == 3
+        assert set(plan["cell-master"]) == {"c0", "c1", "c2"}
+        # Serving spreads over TPU cells; training packs the rest.
+        assert set(plan["serving"]) == {"c0", "c1"}
+        charged = {
+            cid: sum(plan[r].get(cid, 0)
+                     for r in ("training", "serving", "draft"))
+            for cid in ("c0", "c1")
+        }
+        assert all(v <= 4 for v in charged.values())
+        # 8 chips, 9 TPU-role members demanded -> 1 unplaced, loudly.
+        placed = sum(
+            sum(v for c, v in plan[r].items() if c != "!unplaced")
+            for r in ("training", "serving", "draft")
+        )
+        assert placed == 8
+        assert plan["training"]["!unplaced"] == 1
+
+    def test_place_roles_pinned(self):
+        plan = place_roles(
+            {"c0": {"capacity": 4}, "c1": {"capacity": 4}},
+            {"training": 2},
+            pinned={"training": {"c1": 2}},
+        )
+        assert plan["training"] == {"c1": 2}
+
+
+class _Loopback:
+    """connect() stand-in: routes RPC calls straight to a servicer."""
+
+    def __init__(self, servicer):
+        self._servicer = servicer
+
+    def call(self, msg, **_kw):
+        return self._servicer(msg)
+
+    def close(self):
+        pass
+
+
+class TestFederationTier:
+    def _fleet(self, n=2, lease_s=30.0):
+        kv = LocalKv()
+        masters = {}
+        addr_to = {}
+        for i in range(n):
+            cid = f"c{i}"
+            master = _cell_master(cell_id=cid)
+            reg = CellRegistry(kv, job="j", lease_s=lease_s)
+            hb = CellHeartbeat(cid, reg, lambda c=cid: f"addr-{c}",
+                               cell_manager=master.cell_manager)
+            masters[cid] = (master, hb)
+            addr_to[f"addr-{cid}"] = master.servicer
+        for _cid, (_master, hb) in masters.items():
+            hb.beat_once()
+        # Second beat round: every view now includes every peer.
+        for _cid, (_master, hb) in masters.items():
+            hb.beat_once()
+        tier = FederationTier(
+            CellRegistry(kv, job="j", lease_s=lease_s),
+            connect=lambda addr: _Loopback(addr_to[addr]),
+            refresh_s=0.0,
+            demands={"training": 2, "serving": 2, "gateway": 2},
+        )
+        return kv, masters, tier
+
+    def test_fleet_view_and_no_false_split(self):
+        _kv, masters, tier = self._fleet()
+        view = tier.fleet_view(force=True)
+        assert set(view["registry"]) == {"c0", "c1"}
+        assert view["cells_alive"] == 2
+        assert view["splits"] == []
+        assert tier.counters.get("cell_split_detected") == 0
+        assert tier.counters.get("cell_snapshot_fetches") == 2
+
+    def test_placement_push_adopted_and_epochs_converge(self):
+        _kv, masters, tier = self._fleet()
+        res = tier.push_placement()
+        assert res == {"c0": True, "c1": True}
+        epochs = {
+            cid: master.cell_manager.placement_epoch
+            for cid, (master, _hb) in masters.items()
+        }
+        assert set(epochs.values()) == {1}
+        # Every cell got its CPU-role share AND a chip-role share —
+        # each master reports capacity (its worker ceiling, 1 here),
+        # so the live snapshot path really places TPU roles.
+        for cid, (master, _hb) in masters.items():
+            placed = master.cell_manager.placement()
+            assert placed.get("gateway") == 1
+            assert placed.get("serving") == 1
+        # A second push with NOTHING changed is a no-op: epochs hold,
+        # no journal-spamming re-adoption (the federation loop runs
+        # every interval forever).
+        assert tier.push_placement() == {}
+        for cid, (master, _hb) in masters.items():
+            assert master.cell_manager.placement_epoch == 1
+        # A demand change really re-places, bumping the epoch.
+        tier.demands["gateway"] = 4
+        res2 = tier.push_placement()
+        assert res2 == {"c0": True, "c1": True}
+        for cid, (master, _hb) in masters.items():
+            assert master.cell_manager.placement_epoch == 2
+            assert master.cell_manager.placement().get("gateway") == 2
+
+    def test_live_snapshot_carries_capacity(self):
+        _kv, _masters, tier = self._fleet()
+        view = tier.fleet_view(force=True)
+        for cid, snap in view["cells"].items():
+            assert snap["capacity"] == 1  # LocalJobMaster max_nodes
+        plan = tier.plan_placement(view)
+        # TPU demand lands on real cells, not "!unplaced"-only.
+        assert set(plan["serving"]) <= {"c0", "c1"}
+        assert sum(plan["serving"].values()) == 2
+
+    def test_split_detected_only_when_persistent(self):
+        _kv, masters, tier = self._fleet()
+        assert tier.fleet_view(force=True)["splits"] == []
+        # Forge a split: c0 claims the whole ring via chaos.
+        chaos.configure("cell.split:method=c0")
+        masters["c0"][1].beat_once()
+        v1 = tier.fleet_view(force=True)
+        assert v1["splits"]  # seen ...
+        assert v1["splits_confirmed"] == []  # ... but not yet confirmed
+        assert tier.counters.get("cell_split_detected") == 0
+        # Still split on the NEXT read (no healing beat in between):
+        # now it is confirmed and counted.
+        v2 = tier.fleet_view(force=True)
+        assert v2["splits_confirmed"]
+        assert tier.counters.get("cell_split_detected") == 1
+        # The victim's next beat heals the view (one-shot site spent).
+        masters["c0"][1].beat_once()
+        v3 = tier.fleet_view(force=True)
+        assert v3["splits"] == []
+
+    def test_borrow_signal_is_federated(self):
+        _kv, masters, tier = self._fleet()
+        # Give each cell a serving pool via a fake fleet status.
+        class _FakeFleet:
+            def __init__(self, queue):
+                self._q = queue
+
+            def status(self):
+                return {"roles": {"serving": {
+                    "desired": 2, "members": ["r0"],
+                    "signals": {"queue_depth": self._q},
+                }}, "policies": []}
+
+        masters["c0"][0].servicer.fleet_manager = _FakeFleet(7)
+        masters["c1"][0].servicer.fleet_manager = _FakeFleet(5)
+        sig = tier.borrow_signal_fn("serving")()
+        assert sig["queue_depth"] == 12  # summed across cells
+        assert sig["members_alive"] == 2
+
+    def test_dead_cell_skipped_not_fatal(self):
+        kv, masters, tier = self._fleet()
+        kv.delete("cells/j/cell/c1")
+        view = tier.fleet_view(force=True)
+        assert set(view["registry"]) == {"c0"}
+        assert view["cells_alive"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Chaos sites
+# ---------------------------------------------------------------------------
+
+
+class TestCellChaos:
+    def test_master_kill_fires_in_heartbeat(self, monkeypatch):
+        exits = []
+        monkeypatch.setattr(os, "_exit",
+                            lambda code: exits.append(code))
+        chaos.configure("cell.master_kill:method=c1,step_ge=2")
+        reg = CellRegistry(LocalKv(), job="j")
+        cm = CellManager("c1")
+        hb = CellHeartbeat("c1", reg, lambda: "h:1", cell_manager=cm)
+        hb.beat_once()  # step 0
+        hb.beat_once()  # step 1
+        assert exits == []
+        hb.beat_once()  # step 2 -> fires
+        assert exits == [chaos.EXIT_CELL_MASTER_KILL]
+
+    def test_master_kill_method_filter(self, monkeypatch):
+        exits = []
+        monkeypatch.setattr(os, "_exit",
+                            lambda code: exits.append(code))
+        chaos.configure("cell.master_kill:method=c1")
+        reg = CellRegistry(LocalKv(), job="j")
+        hb = CellHeartbeat("c0", reg, lambda: "h:1")
+        hb.beat_once()
+        assert exits == []  # wrong cell: never fires
+
+    def test_split_site_is_one_shot(self):
+        chaos.configure("cell.split:method=c0")
+        kv = LocalKv()
+        reg = CellRegistry(kv, job="j")
+        reg.announce_cell("c1", "h:2")
+        cm = CellManager("c0")
+        hb = CellHeartbeat("c0", reg, lambda: "h:1", cell_manager=cm)
+        hb.beat_once()
+        assert reg.cells()["c0"]["view"] == ["c0"]  # forged
+        hb.beat_once()
+        assert reg.cells()["c0"]["view"] == ["c0", "c1"]  # healed
+
+
+# ---------------------------------------------------------------------------
+# HA composition: placement survives journal recovery + statecheck
+# ---------------------------------------------------------------------------
+
+
+class TestCellHA:
+    def test_placement_survives_recovery(self, tmp_path):
+        state_dir = str(tmp_path / "state")
+        master = _cell_master(cell_id="c0", state_dir=state_dir)
+        ok = master.servicer(m.CellPlacementUpdate(
+            cell_id="c0", epoch=7,
+            placement={"training": 3, "gateway": 1},
+        ))
+        assert ok.success
+        master._ha_journal.close()
+        reborn = _cell_master(cell_id="c0", state_dir=state_dir)
+        assert reborn.cell_manager.placement() == \
+            {"training": 3, "gateway": 1}
+        assert reborn.cell_manager.placement_epoch == 7
+        reborn._ha_journal.close()
+
+    def test_statecheck_clean_over_cell_journal(self, tmp_path):
+        from dlrover_tpu.master.statecheck import check_state_dir
+
+        state_dir = str(tmp_path / "state")
+        master = _cell_master(cell_id="c0", state_dir=state_dir)
+        master.servicer(m.CellPlacementUpdate(
+            cell_id="c0", epoch=1, placement={"serving": 2},
+        ))
+        master.kv_store.set("k", b"v")
+        master._ha_journal.close()
+        report = check_state_dir(state_dir)
+        assert report["damage"] == []
+        assert report["divergences"] == []

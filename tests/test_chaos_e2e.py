@@ -1615,3 +1615,249 @@ class TestMasterKillWarmFailover:
             return None
 
         return handle
+
+
+@pytest.mark.cells
+@pytest.mark.ha
+class TestCellMasterKillFailover:
+    """Flagship ISSUE 15 scenario: TWO cells, each a full master with
+    its own PR-13 journal + warm standby, training-shaped (data-shard
+    queues) and serving-shaped (master-KV serve registry) control-plane
+    load on BOTH.  Cell0's master is chaos-SIGKILLed
+    (``cell.master_kill``, exit 85) mid-stream.  Proof obligations:
+
+    - cell0's warm standby adopts the journaled state: the partly
+      consumed shard queue continues exactly-once (no task id lost or
+      double-granted fleet-wide), and the serving-registry entries
+      announced pre-kill are visible post-takeover;
+    - cell1 NEVER blacks out: its probe stream of short-budget RPCs
+      shows no gap above one probe budget while cell0 fails over (the
+      per-cell blackout metric extending HA_BENCH_CPU.json's
+      fleet-wide one);
+    - the shared cell registry re-learns cell0 from the promoted
+      standby, so the ring covers both cells again;
+    - ``statecheck`` exits 0 on cell0's surviving journal.
+    """
+
+    def test_one_cell_dies_the_other_never_blacks_out(self, tmp_path):
+        import json as _json
+        import threading
+
+        from dlrover_tpu import chaos as _chaos
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.cells.registry import CellRegistry
+        from dlrover_tpu.common import messages as wire
+        from dlrover_tpu.common.rpc import RpcClient
+        from dlrover_tpu.master.state import read_addr
+        from dlrover_tpu.serving.tier import RpcKv, ServeRegistry, MasterKv
+
+        job = "cellkill"
+
+        def start(cmd_args, log_name, extra_env=None):
+            env = _env(extra_env)
+            env.pop("DLROVER_TPU_MASTER_STATE_DIR", None)
+            port_file = tmp_path / f"{log_name}.port"
+            log = open(tmp_path / f"{log_name}.log", "w")
+            proc = subprocess.Popen(
+                [sys.executable, *cmd_args,
+                 f"--port_file={port_file}"],
+                cwd=REPO, env=env, stdout=log,
+                stderr=subprocess.STDOUT,
+            )
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if port_file.exists() and port_file.read_text().strip():
+                    return proc, (
+                        f"127.0.0.1:{port_file.read_text().strip()}"
+                    )
+                assert proc.poll() is None, (
+                    f"{log_name} died rc={proc.returncode}:\n"
+                    + _read(tmp_path / f"{log_name}.log")[-3000:]
+                )
+                time.sleep(0.1)
+            raise TimeoutError(f"{log_name} never reported a port")
+
+        procs = []
+        try:
+            reg_proc, reg_addr = start(
+                ["-m", "dlrover_tpu.cells.main", "--registry",
+                 "--port", "0"],
+                "registry",
+            )
+            procs.append(reg_proc)
+
+            cells = {}
+            for cid in ("cell0", "cell1"):
+                state_dir = tmp_path / f"state_{cid}"
+                state_dir.mkdir()
+                base = ["-m", "dlrover_tpu.master.main", "--port=0",
+                        f"--job_name={job}", "--min_nodes=1",
+                        "--max_nodes=4", f"--cell_id={cid}",
+                        f"--cell_registry={reg_addr}",
+                        f"--state_dir={state_dir}"]
+                hb_env = {"DLROVER_TPU_CELL_LEASE_S": "2.0"}
+                prim_env = dict(hb_env)
+                if cid == "cell0":
+                    # The kill site fires in the cell heartbeat after
+                    # ~4s — mid-task-queue, mid-serving-announce.
+                    prim_env["DLROVER_TPU_FAULTS"] = (
+                        "cell.master_kill:method=cell0,at=4s"
+                    )
+                primary, paddr = start(base, f"{cid}_primary",
+                                       extra_env=prim_env)
+                standby, saddr = start(
+                    base + ["--standby", f"--primary_addr={paddr}"],
+                    f"{cid}_standby",
+                    extra_env={
+                        **hb_env,
+                        "DLROVER_TPU_HA_LEASE_S": "1.0",
+                        "DLROVER_TPU_HA_TAIL_POLL_S": "0.05",
+                    },
+                )
+                procs += [primary, standby]
+                cells[cid] = {
+                    "primary": primary, "standby": standby,
+                    "addr": paddr, "state": str(state_dir),
+                }
+
+            # Training-shaped load: a data-shard queue per cell,
+            # partly consumed pre-kill.
+            tasks_per_cell = 12
+            granted = {"cell0": [], "cell1": []}
+            clients = {}
+            for cid, ent in cells.items():
+                cli = MasterClient(ent["addr"], 0,
+                                   state_dir=ent["state"])
+                clients[cid] = cli
+                cli.report_dataset_shard_params(
+                    dataset_name=f"ds-{cid}",
+                    dataset_size=tasks_per_cell * 10, shard_size=10,
+                )
+                for _ in range(4):
+                    t = cli.get_task(f"ds-{cid}")
+                    granted[cid].append(t.task_id)
+                cli.report_task_result(f"ds-{cid}",
+                                       granted[cid][0], True)
+            # Serving-shaped load: serve-registry announcements riding
+            # each cell's master KV.
+            for cid in cells:
+                sreg = ServeRegistry(MasterKv(clients[cid]), job=job)
+                sreg.announce_gateway(f"gw-{cid}", f"10.0.0.1:{cid}")
+                sreg.announce_replica(f"rep-{cid}", slots=4)
+
+            # Cell1's never-blacks-out probe: short-budget RPCs on a
+            # tight loop; the max success gap IS the per-cell blackout.
+            stop_probe = threading.Event()
+            gaps = {"max": 0.0, "count": 0}
+
+            def probe_cell1():
+                addr = cells["cell1"]["addr"]
+                last = time.monotonic()
+                while not stop_probe.is_set():
+                    cli = RpcClient(addr, timeout=0.5)
+                    try:
+                        cli.call(
+                            wire.KVStoreGet(key="probe"),
+                            timeout=0.5, retries=1, deadline=0.5,
+                            idempotent=True,
+                        )
+                        now = time.monotonic()
+                        gaps["max"] = max(gaps["max"], now - last)
+                        gaps["count"] += 1
+                        last = now
+                    except Exception:  # noqa: BLE001 - counted as gap
+                        pass
+                    finally:
+                        cli.close()
+                    time.sleep(0.05)
+
+            prober = threading.Thread(target=probe_cell1, daemon=True)
+            prober.start()
+
+            # Wait for the chaos kill (exit 85).
+            rc = cells["cell0"]["primary"].wait(timeout=60)
+            assert rc == _chaos.EXIT_CELL_MASTER_KILL, (
+                _read(tmp_path / "cell0_primary.log")[-3000:]
+            )
+            t_kill = time.monotonic()
+            # The standby takes over: the addr file flips.
+            old = cells["cell0"]["addr"]
+            deadline = time.time() + 30
+            new_addr = ""
+            while time.time() < deadline:
+                cur = read_addr(cells["cell0"]["state"])
+                if cur and cur != old:
+                    new_addr = cur
+                    break
+                time.sleep(0.1)
+            assert new_addr, "cell0 standby never took over"
+
+            # Drain cell0's queue through the failover-aware client:
+            # every remaining task id granted exactly once.
+            cli0 = clients["cell0"]
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    t = cli0.get_task("ds-cell0")
+                except Exception:  # noqa: BLE001 - blackout window
+                    time.sleep(0.2)
+                    continue
+                if t.task_id < 0:
+                    break
+                granted["cell0"].append(t.task_id)
+            ids0 = granted["cell0"]
+            assert sorted(ids0) == list(range(tasks_per_cell)), ids0
+            assert len(set(ids0)) == len(ids0), "task double-granted"
+
+            # The pre-kill serving registry survived into the new
+            # leader (journaled KV writes replayed).
+            sreg0 = ServeRegistry(MasterKv(cli0), job=job)
+            assert f"gw-cell0" in sreg0.gateways()
+            assert f"rep-cell0" in sreg0.replicas()
+
+            # Cell1 never blacked out, and drains its own queue too.
+            stop_probe.set()
+            prober.join(timeout=5)
+            assert gaps["count"] > 10
+            assert gaps["max"] < 1.0, (
+                f"cell1 observed a {gaps['max']:.2f}s gap"
+            )
+            cli1 = clients["cell1"]
+            while True:
+                t = cli1.get_task("ds-cell1")
+                if t.task_id < 0:
+                    break
+                granted["cell1"].append(t.task_id)
+            assert sorted(granted["cell1"]) == \
+                list(range(tasks_per_cell))
+
+            # The shared registry re-learned cell0 from the promoted
+            # standby: the ring covers both cells again.
+            creg = CellRegistry(RpcKv(reg_addr), job=job, lease_s=2.0)
+            deadline = time.time() + 20
+            live = {}
+            while time.time() < deadline:
+                live = creg.cells()
+                if set(live) == {"cell0", "cell1"} and \
+                        live["cell0"]["addr"] == new_addr:
+                    break
+                time.sleep(0.2)
+            assert set(live) == {"cell0", "cell1"}, live
+            assert live["cell0"]["addr"] == new_addr
+
+            for cli in clients.values():
+                cli.close()
+
+            # The surviving journal is statecheck-clean.
+            check = subprocess.run(
+                [sys.executable, "-m",
+                 "dlrover_tpu.master.statecheck",
+                 cells["cell0"]["state"], "--json"],
+                capture_output=True, text=True, timeout=120,
+                cwd=REPO, env=_env(),
+            )
+            assert check.returncode == 0, check.stdout + check.stderr
+            report = _json.loads(check.stdout)
+            assert report["damage"] == []
+        finally:
+            _terminate(procs)

@@ -1568,3 +1568,52 @@ class TestChangedModePathResolution:
         )
         assert r.returncode == 0, r.stdout + r.stderr
         assert "PC405" not in r.stdout
+
+
+@pytest.mark.graftcheck
+class TestCellSurfaceModeled:
+    """ISSUE 15 satellite: the multi-cell protocol surface is IN the
+    project model from day one, so PC4xx (contracts, journal-before-
+    ack), CH5xx (chaos drift) and MT6xx (dark counters) cover it — a
+    refactor that drops the cell messages, handlers, sites or gauges
+    out of the model would silently exempt them from every rule."""
+
+    @pytest.fixture(scope="class")
+    def repo_model(self):
+        _findings, model = run_project(
+            [os.path.join(REPO, "dlrover_tpu")]
+        )
+        return model
+
+    def test_cell_messages_and_handlers_modeled(self, repo_model):
+        msgs = set(repo_model.messages)
+        assert {"CellSnapshotRequest", "CellSnapshot",
+                "CellPlacementUpdate"} <= msgs
+        handled = repo_model.handled_messages()
+        assert "CellSnapshotRequest" in handled
+        assert "CellPlacementUpdate" in handled
+
+    def test_cell_chaos_sites_declared_and_injected(self, repo_model):
+        assert {"cell.master_kill", "cell.split"} <= set(
+            repo_model.chaos_sites
+        )
+        injected = {i.name for i in repo_model.injects}
+        assert {"cell.master_kill", "cell.split"} <= injected
+
+    def test_placement_handler_reaches_journal(self, repo_model):
+        # The PC404 obligation is LIVE on the new surface: the
+        # placement mutation journals before the servicer acks.
+        assert repo_model.method_reaches_jrec(
+            "CellManager", "apply_placement"
+        )
+
+    def test_federation_counters_all_exported(self, repo_model):
+        from dlrover_tpu.cells.federation import (
+            FEDERATION_COUNTER_NAMES,
+        )
+
+        incs = {c.name for c in repo_model.counter_incs}
+        gauges = {str(g.name) for g in repo_model.gauge_regs}
+        for name in FEDERATION_COUNTER_NAMES:
+            assert name in incs
+            assert f"fed_{name}" in gauges

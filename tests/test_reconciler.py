@@ -640,3 +640,95 @@ spec:
         ]
         assert len(workers) == 3
         assert all(n.resource.tpu_chips == 8 for n in workers)
+
+
+# ---------------------------------------------------------------------------
+# Role node pools (ISSUE 15): CPU pools for control-plane roles, TPU
+# pools for chip-holding workers, pinned on top of --node_role.
+# ---------------------------------------------------------------------------
+
+
+class TestRoleNodePools:
+    def _gke(self, pools):
+        api = FakeKubeApi()
+        platform = GkePlatform(
+            namespace="test", image="img",
+            api=api, client_mod=_FakeClientMod, watch_mod=_FakeWatchMod,
+            node_pools=pools,
+        )
+        return api, platform
+
+    def test_role_node_pools_mapping(self):
+        from dlrover_tpu.scheduler.platform import role_node_pools
+
+        pools = role_node_pools("cp-pool", "tpu-pool")
+        assert pools["master"] == "cp-pool"
+        assert pools["cell-master"] == "cp-pool"
+        assert pools["gateway"] == "cp-pool"
+        assert pools["worker"] == "tpu-pool"
+        # No TPU pool named: TPU roles stay unpinned (the accelerator
+        # selectors already constrain them).
+        unpinned = role_node_pools("cp-pool")
+        assert "worker" not in unpinned
+        # Explicit overrides win.
+        extra = role_node_pools("cp", "tpu", extra={"worker": "big"})
+        assert extra["worker"] == "big"
+
+    def test_gateway_pod_pinned_to_cpu_pool_without_tpu(self):
+        from dlrover_tpu.scheduler.platform import role_node_pools
+
+        api, platform = self._gke(role_node_pools("cp-pool", "tpu-pool"))
+        node = Node(
+            NodeType.GATEWAY, 0, rank_index=0,
+            config_resource=NodeResource(cpu=2, memory_mb=2048),
+        )
+        platform.create_node(node, "jobp")
+        pod = api.pods["jobp-gateway-0"]
+        sel = pod.spec.node_selector
+        assert sel["cloud.google.com/gke-nodepool"] == "cp-pool"
+        assert "cloud.google.com/gke-tpu-accelerator" not in sel
+        limits = pod.spec.containers[0].resources.limits
+        assert "google.com/tpu" not in limits
+
+    def test_worker_pod_pinned_to_tpu_pool_with_selectors(self):
+        from dlrover_tpu.scheduler.platform import role_node_pools
+
+        api, platform = self._gke(role_node_pools("cp-pool", "tpu-pool"))
+        node = Node(
+            NodeType.WORKER, 1, rank_index=1,
+            config_resource=NodeResource(
+                tpu_chips=4, tpu_type="v5e", tpu_topology="2x4",
+            ),
+        )
+        platform.create_node(node, "jobp")
+        sel = api.pods["jobp-worker-1"].spec.node_selector
+        assert sel["cloud.google.com/gke-nodepool"] == "tpu-pool"
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == (
+            "tpu-v5-lite-podslice"
+        )
+
+    def test_tpu_pod_pinned_to_cpu_pool_rejected_at_submit(self):
+        """A chip-requesting pod pinned to a declared CPU pool would
+        sit Pending forever — the validator refuses the submit."""
+        import pytest as _pytest
+
+        api, platform = self._gke({"worker": "cp-pool",
+                                   "master": "cp-pool"})
+        node = Node(
+            NodeType.WORKER, 0, rank_index=0,
+            config_resource=NodeResource(tpu_chips=4, tpu_type="v5e"),
+        )
+        with _pytest.raises(ValueError, match="CPU node pool"):
+            platform.create_node(node, "jobp")
+        assert api.pods == {}
+
+    def test_bad_pool_name_rejected(self):
+        import pytest as _pytest
+
+        api, platform = self._gke({"worker": "Bad_Pool!"})
+        node = Node(
+            NodeType.WORKER, 0, rank_index=0,
+            config_resource=NodeResource(tpu_chips=4, tpu_type="v5e"),
+        )
+        with _pytest.raises(ValueError, match="RFC1123"):
+            platform.create_node(node, "jobp")
