@@ -19,7 +19,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from dlrover_tpu import chaos
 from dlrover_tpu.agent.metrics import integrity_counters, perf_stats
@@ -83,6 +83,11 @@ class AsyncCheckpointSaver:
         self._dirty: Dict[int, slicer.DirtyTracker] = {}
         self._dirty_scope: Dict[int, tuple] = {}
         self._perf_cache: tuple = (0.0, {})  # (fetched_at, stat snapshot)
+        # TTL-cache clock seam: tests age the cache by stepping a fake
+        # clock instead of sleeping (or back-dating with the WRONG
+        # clock family — the old wall-stamp aging never expired a
+        # monotonic-compared cache).
+        self._perf_clock: Callable[[], float] = time.monotonic
         self._last_event: Dict[int, dict] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -449,14 +454,14 @@ class AsyncCheckpointSaver:
         cache collapses the multiple gauges sampled by one scrape into
         ONE round trip (and one bounded wait against a hung server)."""
         ts, snap = self._perf_cache
-        if time.monotonic() - ts < 1.0:
+        if self._perf_clock() - ts < 1.0:
             return snap
         try:
             snap = self._stat.to_dict(timeout=2.0) or {}
         except Exception as e:  # noqa: BLE001
             logger.debug("perf stat snapshot failed: %s", e)
             snap = {}
-        self._perf_cache = (time.monotonic(), snap)
+        self._perf_cache = (self._perf_clock(), snap)
         return snap
 
     def last_stall_ms(self) -> float:

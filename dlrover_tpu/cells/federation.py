@@ -231,9 +231,15 @@ class FederationTier:
     def __init__(self, registry: CellRegistry,
                  connect: Optional[Callable[[str], Any]] = None,
                  refresh_s: float = 2.0,
-                 demands: Optional[Dict[str, int]] = None):
+                 demands: Optional[Dict[str, int]] = None,
+                 clock: Callable[[], float] = time.monotonic):
         self.registry = registry
-        self.cell_map = CellMap(registry, refresh_s=min(1.0, refresh_s))
+        # ONE clock for the tier and its cell map (graftcheck DET701):
+        # the fleet-view TTL and the ring-refresh TTL must advance
+        # together under a simulated clock.
+        self._clock = clock
+        self.cell_map = CellMap(registry, refresh_s=min(1.0, refresh_s),
+                                clock=clock)
         self._connect = connect or _default_connect
         self._refresh_s = refresh_s
         self._mu = threading.Lock()
@@ -274,7 +280,7 @@ class FederationTier:
         """Merged fleet view: registry entries + per-cell snapshots +
         split detection.  TTL-cached (``refresh_s``)."""
         with self._mu:
-            if not force and time.monotonic() - self._view_ts \
+            if not force and self._clock() - self._view_ts \
                     < self._refresh_s and self._view:
                 return dict(self._view)
         entries = self.cell_map.refresh(force=True)
@@ -328,7 +334,7 @@ class FederationTier:
             )
         with self._mu:
             self._view = view
-            self._view_ts = time.monotonic()
+            self._view_ts = self._clock()
         return dict(view)
 
     # -- placement ---------------------------------------------------------

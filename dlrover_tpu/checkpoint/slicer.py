@@ -170,7 +170,12 @@ def plan_persist(
     it — a holder lost to GC/quarantine forces a rewrite, never a
     dangling reference.  The dirty probe CRCs the staged slice bytes
     in-process (memory speed); the writes it avoids run at storage-link
-    speed, which is the asymmetry incremental saves monetize."""
+    speed, which is the asymmetry incremental saves monetize.
+
+    Registered as a sim-bound pure policy (graftcheck DET70x): slice
+    assignment is a function of (tensors, process_id, num_processes)
+    only — no ambient effects, so every rank computes the identical
+    partition without coordination."""
     from dlrover_tpu.checkpoint.shard_file import crc32_bytes, _dtype_key
 
     info = extra.get("tensors_info") or {}

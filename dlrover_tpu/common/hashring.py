@@ -11,6 +11,10 @@ answer from the identical member set.
 ``serving.tier`` re-exports :class:`HashRing`/:func:`ring_hash`, so
 existing imports keep working; ring assignments are pinned by unit
 tests across the move (no ownership churn from the refactor).
+
+Registered as a sim-bound pure policy (graftcheck DET70x, ISSUE 16):
+same member set ⇒ same ring, no ambient effects — sha1, never
+``hash()`` (PYTHONHASHSEED must not move ownership).
 """
 
 from __future__ import annotations

@@ -16,6 +16,12 @@ directions are drain-first —
 Spike/decay detection is hysteretic (patience counters, the
 ``autoscale.decide`` shape) so a bursty queue cannot flap chips back
 and forth, and a cooldown separates consecutive borrows.
+
+:class:`ChipBorrowArbiter` is a registered sim-bound pure policy
+(graftcheck DET70x, ISSUE 16): every decision is a function of the
+adapters' observed signals and the scripted pass sequence — no
+ambient clock, randomness, or I/O reachable from ``step``
+(``tests/test_determinism.py`` pins the double-run law).
 """
 
 from __future__ import annotations

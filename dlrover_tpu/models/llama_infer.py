@@ -1143,7 +1143,8 @@ def unpack_kv_segment(payload: bytes) -> Dict[str, Any]:
 
 def _adapt_spec_k(cur_k: int, draft_k: int, acc: float) -> int:
     """The adaptive-speculation policy, pure so the arithmetic is
-    directly testable.  ``acc`` is measured tokens-per-active-row-round
+    directly testable (and registered as a sim-bound policy —
+    graftcheck DET70x keeps it ambient-effect-free).  ``acc`` is measured tokens-per-active-row-round
     in [1, cur_k+1].  A weak draft (acc near 1) makes every round pay
     cur_k wasted draft forwards — halve.  A strong draft saturating its
     window (acc near cur_k+1) earns a bigger one — double, CAPPED at

@@ -434,7 +434,10 @@ def build_plan(
     """Cover every target shard from the source pieces, preferring
     same-rank sources (replicated leaves then move zero bytes), closest
     ranks next.  Raises :class:`PlanError` when any target region is not
-    covered by the union of source pieces."""
+    covered by the union of source pieces.
+
+    Registered as a sim-bound pure policy (graftcheck DET70x): same
+    src/dst layouts ⇒ byte-identical plan, no ambient effects."""
     segments: List[Segment] = []
     piece_cache: Dict[str, List[Tuple[int, str, Box]]] = {}
     for path in dst.tensors:

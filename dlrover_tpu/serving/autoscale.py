@@ -182,6 +182,7 @@ class PoolAutoScaler:
         drain_fn: Callable[[str], Any],
         policies: Dict[str, ScalePolicy],
         interval: float = 1.0,
+        clock: Callable[[], float] = time.time,
     ):
         self.policies = dict(policies)
         self.states: Dict[str, ScaleState] = {}
@@ -189,6 +190,10 @@ class PoolAutoScaler:
         self._scale_up_fn = scale_up_fn
         self._drain_fn = drain_fn
         self._interval = interval
+        # Audit stamps flow through this seam (graftcheck DET705):
+        # replay feeds a simulated clock and compares decision
+        # sequences byte-for-byte; production keeps wall time.
+        self._clock = clock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.decisions: list = []  # (ts, role, alive, target)
@@ -204,7 +209,7 @@ class PoolAutoScaler:
             if target == alive:
                 deltas[role] = 0
                 continue
-            self.decisions.append((time.time(), role, alive, target))
+            self.decisions.append((self._clock(), role, alive, target))
             journal("autoscale.decide", scope="pool", role=role,
                     alive=alive, target=target,
                     queue_depth=int(
@@ -267,6 +272,7 @@ class ServeAutoScaler:
         drain_fn: Callable[[], Any],
         policy: Optional[ScalePolicy] = None,
         interval: float = 1.0,
+        clock: Callable[[], float] = time.time,
     ):
         self.policy = policy or ScalePolicy()
         self.state = ScaleState()
@@ -274,6 +280,9 @@ class ServeAutoScaler:
         self._scale_up_fn = scale_up_fn
         self._drain_fn = drain_fn
         self._interval = interval
+        # Same DET705 seam as PoolAutoScaler: injected for replay,
+        # wall time by default for operators reading the audit trail.
+        self._clock = clock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.decisions: list = []  # (ts, alive, target) audit trail
@@ -285,7 +294,7 @@ class ServeAutoScaler:
         target = decide(snap, self.policy, self.state)
         if target == alive:
             return 0
-        self.decisions.append((time.time(), alive, target))
+        self.decisions.append((self._clock(), alive, target))
         journal("autoscale.decide", scope="fleet", alive=alive,
                 target=target,
                 queue_depth=int(snap.get("queue_depth", 0)),

@@ -402,6 +402,10 @@ class GatewayTierNode:
         self.gateway_id = gateway_id
         self.registry = registry
         self.gateway = Gateway(port=port, config=config, **gateway_kw)
+        # ONE clock with the wrapped gateway (graftcheck DET701): the
+        # merged-metrics TTL and the heartbeat GC throttle advance
+        # with whatever clock the gateway was built on.
+        self._clock = self.gateway._clock
         self._heartbeat_s = heartbeat_s
         self._addr_override = addr
         self._local_ip = local_ip()
@@ -460,7 +464,7 @@ class GatewayTierNode:
         cache = {"ts": float("-inf"), "snap": {}}
 
         def _merged():
-            now = time.monotonic()
+            now = self._clock()
             if now - cache["ts"] > 2.0:
                 snaps = [self.gateway.core.stats_snapshot()]
                 snaps.extend(
@@ -540,7 +544,7 @@ class GatewayTierNode:
                 # The sweep is hygiene, not liveness (readers filter
                 # stale entries themselves): one full-namespace scan
                 # per LEASE per gateway, not per heartbeat.
-                now = time.monotonic()
+                now = self._clock()
                 if now - self._last_gc >= self.registry.lease_s:
                     self._last_gc = now
                     self.registry.gc_stale()

@@ -669,14 +669,26 @@ class TestWorkerPerfTTLCache:
                 self.calls += 1
                 return dict(self.data)
 
+        class FakeClock:
+            """Injectable TTL clock: tests AGE the cache by stepping
+            this, never by sleeping (and never by back-dating the
+            stamp with the wrong clock family — the old wall-stamp
+            aging compared ``time.time()`` stamps against a
+            ``time.monotonic()`` now and never expired)."""
+
+            def __init__(self):
+                self.now = 100.0
+
+            def __call__(self):
+                return self.now
+
         saver = AsyncCheckpointSaver.__new__(AsyncCheckpointSaver)
         saver._stat = FakeStat()
         saver._perf_cache = (0.0, {})
+        saver._perf_clock = FakeClock()
         return saver
 
     def test_one_round_trip_per_ttl_window(self):
-        import time as _time
-
         saver = self._saver()
         # One scrape samples several gauges; all ride ONE snapshot.
         assert saver.worker_perf() == saver._stat.data
@@ -685,18 +697,16 @@ class TestWorkerPerfTTLCache:
         assert saver._stat.calls == 1
 
     def test_fresh_values_after_expiry(self):
-        import time as _time
-
         saver = self._saver()
         saver.worker_perf()
         assert saver._stat.calls == 1
         saver._stat.data = {"stall_ms_0": 99.0, "staged_mbps_0": 100.0}
         # Inside the window: stale-by-design snapshot, no new trip.
+        saver._perf_clock.now += 0.5
         assert saver.last_stall_ms() == 40.0
         assert saver._stat.calls == 1
-        # Age the cache past the 1s TTL: the next sample re-fetches.
-        ts, snap = saver._perf_cache
-        saver._perf_cache = (_time.time() - 1.5, snap)
+        # Step the clock past the 1s TTL: the next sample re-fetches.
+        saver._perf_clock.now += 1.0
         assert saver.last_stall_ms() == 99.0
         assert saver._stat.calls == 2
 

@@ -1,0 +1,260 @@
+"""Double-run determinism: the law the DET70x families enforce,
+pinned end-to-end (ISSUE 16).
+
+Each test drives a registered pure-policy object (the SAME objects the
+ROADMAP-item-7 wind tunnel will drive) through a scripted synthetic
+workload TWICE — fresh object, same injected clock schedule, same
+inputs — and asserts the serialized decision sequences are
+byte-identical.  ``json.dumps(..., sort_keys=True)`` is the comparison
+form: if any decision depends on an ambient clock, unseeded
+randomness, or hash order, the two byte strings diverge here before
+they diverge in a 10,000-node replay.
+
+Pure-AST/CPU tests — no jax import, no devices, no sleeps.
+"""
+
+import json
+
+import pytest
+
+from dlrover_tpu.cells.federation import (
+    detect_splits,
+    merge_cell_snapshots,
+    place_roles,
+)
+from dlrover_tpu.fleet.policy import BorrowPolicy, ChipBorrowArbiter
+from dlrover_tpu.serving.autoscale import ScalePolicy, decide_pools
+from dlrover_tpu.serving.gateway import GatewayConfig, GatewayCore
+
+pytestmark = pytest.mark.determinism
+
+
+class FakeClock:
+    """The injected seam: tests advance time, never read it."""
+
+    def __init__(self, start=1000.0):
+        self.t = start
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _bytes(trace) -> bytes:
+    return json.dumps(trace, sort_keys=True).encode()
+
+
+# ---------------------------------------------------------------------------
+# GatewayCore grant scan
+# ---------------------------------------------------------------------------
+
+
+def _gateway_trace() -> bytes:
+    """A scripted admission/grant/complete workload over the injected
+    clock; the trace records every externally visible decision."""
+    clock = FakeClock()
+    core = GatewayCore(GatewayConfig(queue_cap=16), clock=clock)
+    trace = []
+    for rid, slots in (("r2", 2), ("r1", 2), ("r3", 1)):
+        core.register(rid, slots)
+    for i in range(10):
+        ack = core.submit(f"req-{i}", [1, 2, i], 8,
+                          deadline_s=30.0)
+        trace.append(("submit", ack.req_id, ack.status))
+        clock.advance(0.01)
+    # Two grant rounds: every replica polls, grants recorded in order.
+    for _round in range(2):
+        for rid in ("r1", "r2", "r3"):
+            grants = core.poll(rid, free_slots=2, active=[])
+            trace.append(("grants", rid,
+                          [g.req_id for g in grants.requests]))
+            clock.advance(0.05)
+        # The first granted request of the round completes.
+        for rid, req, tokens in (("r1", None, [7, 8]),):
+            pass
+    snap = core.stats_snapshot()
+    trace.append(("counters", sorted(snap["counters"].items())))
+    trace.append(("queue_depth", snap["queue_depth"]))
+    return _bytes(trace)
+
+
+class TestGatewayCoreDeterminism:
+    def test_double_run_grant_scan_byte_identical(self):
+        assert _gateway_trace() == _gateway_trace()
+
+
+# ---------------------------------------------------------------------------
+# decide_pools
+# ---------------------------------------------------------------------------
+
+
+def _autoscale_trace() -> bytes:
+    policies = {
+        "prefill": ScalePolicy(max_replicas=8),
+        "decode": ScalePolicy(max_replicas=8),
+        "draft": ScalePolicy(max_replicas=4),
+    }
+    states = {}
+    trace = []
+    # A synthetic load ramp: queue builds, then drains.
+    for step in range(12):
+        depth = max(0, 40 - abs(step - 6) * 10)
+        snapshot = {
+            "ttft_p95_ms": 100.0 + depth * 5.0,
+            "pools": {
+                role: {
+                    "alive": 2,
+                    "queue_depth": depth,
+                    "occupancy": min(1.0, depth / 10.0),
+                    "tokens_per_round": 3.0,
+                }
+                for role in policies
+            },
+        }
+        targets = decide_pools(snapshot, policies, states)
+        trace.append(sorted(targets.items()))
+    return _bytes(trace)
+
+
+class TestDecidePoolsDeterminism:
+    def test_double_run_byte_identical(self):
+        assert _autoscale_trace() == _autoscale_trace()
+
+
+# ---------------------------------------------------------------------------
+# federation: merge + split detection + placement
+# ---------------------------------------------------------------------------
+
+
+def _federation_trace() -> bytes:
+    snaps = [
+        {"cell_id": f"cell-{i}", "capacity": 8 + i,
+         "roles": {"serving": 2, "training": 4},
+         "epoch": 3 + (i % 2)}
+        for i in range(5)
+    ]
+    view = merge_cell_snapshots(snaps)
+    registry = {
+        f"cell-{i}": {"addr": f"10.0.0.{i}:70", "ranges": [[0, 99]]}
+        for i in range(5)
+    }
+    splits = detect_splits(registry)
+    cells = {f"cell-{i}": {"capacity": 8 + i} for i in range(5)}
+    demands = {"serving": 6, "training": 9, "master": 3, "draft": 2}
+    plan = place_roles(cells, demands)
+    return _bytes([sorted(view.items(), key=lambda kv: kv[0]),
+                   splits, sorted(plan.items())])
+
+
+class TestPlacementDeterminism:
+    def test_double_run_byte_identical(self):
+        assert _federation_trace() == _federation_trace()
+
+
+# ---------------------------------------------------------------------------
+# ChipBorrowArbiter
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedRole:
+    """Minimal RoleAdapter stand-in: count-backed members, scripted
+    signals, single-pass drains — everything the arbiter touches."""
+
+    def __init__(self, name, members):
+        self.name = name
+        self.members = list(members)
+        self.min_count = 0
+        self.max_count = 8
+        self.signals = {}
+        self._victim = None
+
+    def observe(self):
+        from dlrover_tpu.fleet.role import RoleStatus
+
+        return RoleStatus(members=tuple(self.members),
+                          signals=dict(self.signals))
+
+    def spawn(self, n):
+        for i in range(n):
+            self.members.append(f"{self.name}-b{len(self.members)}")
+        return n
+
+    def begin_drain(self):
+        if not self.members:
+            return None
+        self._victim = self.members[-1]
+        return self._victim
+
+    def drain_pending(self):
+        return False
+
+    def pump_drain(self):
+        pass
+
+    def reconcile(self):
+        if self._victim in self.members:
+            self.members.remove(self._victim)
+        self._victim = None
+
+
+def _arbiter_trace() -> bytes:
+    from dlrover_tpu.fleet.role import RoleAdapter, RoleSpec
+
+    class Lender(RoleAdapter):
+        def __init__(self):
+            super().__init__(RoleSpec("target", desired=3,
+                                      min_count=1, max_count=8))
+            self._impl = _ScriptedRole("target",
+                                       ["t0", "t1", "t2"])
+
+        def observe(self):
+            return self._impl.observe()
+
+        def spawn(self, n):
+            return self._impl.spawn(n)
+
+        def begin_drain(self):
+            return self._impl.begin_drain()
+
+        def drain_pending(self):
+            return self._impl.drain_pending()
+
+        def pump_drain(self):
+            self._impl.pump_drain()
+
+        def reconcile(self):
+            self._impl.reconcile()
+
+    class Borrower(Lender):
+        def __init__(self):
+            RoleAdapter.__init__(self, RoleSpec(
+                "draft", desired=1, min_count=0, max_count=4))
+            self._impl = _ScriptedRole("draft", ["d0"])
+
+    # A scripted gain curve: earns its chip for 6 passes, then stops.
+    gains = [5.0, 5.0, 5.0, 5.0, 5.0, 5.0, 1.0, 1.0, 1.0, 1.0, 1.0]
+    lender, borrower = Lender(), Borrower()
+    it = iter(gains)
+    arb = ChipBorrowArbiter(
+        lender, borrower,
+        BorrowPolicy(spike_patience=2, decay_patience=2,
+                     cooldown_passes=0, gain_high=4.0, gain_low=3.3),
+        gain_fn=lambda: next(it, 1.0),
+    )
+    trace = []
+    for _pass in range(len(gains)):
+        phase = arb.step()
+        lender.reconcile()
+        borrower.reconcile()
+        trace.append((phase, arb.borrowed,
+                      len(lender._impl.members),
+                      len(borrower._impl.members)))
+    trace.append([e[:3] for e in arb.events])
+    return _bytes(trace)
+
+
+class TestBorrowArbiterDeterminism:
+    def test_double_run_byte_identical(self):
+        assert _arbiter_trace() == _arbiter_trace()

@@ -10,10 +10,15 @@ modules: which messages have handlers, which RPC retries are safe,
 which mutations the HA journal covers, which chaos sites and counters
 are real.  graftcheck flags those shapes *before* they run.
 
-v2 is a two-pass engine: pass 1 builds a whole-program project model
+v3 is a three-pass engine: pass 1 builds a whole-program project model
 (``project_model.py``); pass 2 runs the per-file AST families below on
 each analyzed file plus the cross-module families (``proto_rules.py``)
-over the model.
+over the model; pass 3 computes a transitive ambient-effect set for
+every function/method (``effects.py``) and enforces the sim-readiness
+contract on the pure-policy registry (``policy_registry.py``,
+``effect_rules.py``) — ROADMAP item 7's wind tunnel can only drive
+policy objects whose whole behavior flows through injected clocks and
+caller-owned seeds.
 
 Rule families
 -------------
@@ -79,6 +84,22 @@ Metrics drift:
 - ``MT601`` — a counter incremented but never exported by any gauge
   registration.
 - ``MT602`` — one module registering the same gauge name twice.
+
+Determinism / sim-readiness (effect inference over the model):
+
+- ``DET701`` — an ambient clock read reachable from a registered pure
+  policy, or a direct ambient read in a class with an injected clock
+  seam in reach (own ``self._clock`` or a seamed collaborator).
+- ``DET702`` — unseeded randomness (``random.*``, ``uuid4``,
+  ``os.urandom``, ``np.random.*``) reachable from a registered policy.
+- ``DET703`` — a sandbox escape reachable from a registered policy:
+  thread/process spawn, blocking I/O, env read, global mutation.
+- ``DET704`` — hash-order nondeterminism reachable from a registered
+  policy: iterating / ``next(iter(...))`` / ``.pop()`` on a set
+  without a ``sorted()`` total order.
+- ``DET705`` — a wall-clock stamp recorded into decision/audit state
+  (``self.x.append((time.time(), ...))``); the OB301 cousin for
+  stored state replay compares.
 
 Meta:
 

@@ -1,0 +1,307 @@
+"""graftcheck pass 3 rules: determinism / sim-readiness (DET7xx).
+
+These run over the effect index (:mod:`effects`) and the pure-policy
+registry (:mod:`policy_registry`).  A registered policy object is one
+the ROADMAP-item-7 wind tunnel will drive with a synthetic trace; the
+contract is that its ENTIRE transitive behavior is a function of its
+inputs, the injected clock, and the caller's seed:
+
+DET701  an ambient clock read (``time.time``/``time.monotonic``/
+        ``datetime.now`` …) reachable from a registered policy, or a
+        direct ambient read inside a class that HAS an injected
+        ``clock`` seam (a seam you bypass is worse than no seam — the
+        object is half-simulable and the divergence is silent);
+DET702  unseeded/ambient randomness (``random.*``, ``uuid4``,
+        ``os.urandom``, ``np.random.*``) reachable from a registered
+        policy — a replayed decision sequence can never match;
+DET703  an effect that escapes the simulator's sandbox reachable from
+        a registered policy: thread/process spawn, blocking I/O
+        (sockets, files, sleeps), env reads, global mutation;
+DET704  hash-order nondeterminism reachable from a registered policy:
+        iterating a ``set`` (or ``next(iter(s))`` / ``s.pop()``) to
+        pick victims/owners/grants without a ``sorted()`` total order
+        — the pick flips with PYTHONHASHSEED and insertion history;
+DET705  a wall-clock timestamp recorded into decision/audit state
+        (``self.<attr>.append((time.time(), ...))`` and kin) — the
+        OB301 cousin for STORED state: replay compares two runs'
+        decision logs, and wall stamps make byte-identical sequences
+        impossible.  Repo-wide, not registry-scoped: audit trails live
+        on actuators, not on the pure policies themselves.
+
+Like every graftcheck family the rules are conservative: an
+unresolvable callee contributes nothing (that is what "behind a seam"
+means — an injected callable is invisible to the closure), and a
+deliberate ambient site carries a justified suppression at the
+anchoring line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import Finding
+from .effects import Effect, EffectIndex, WALL_CALLS
+from .jax_rules import _dotted
+from .policy_registry import REGISTRY, PolicyObject
+from .project_model import ClassInfo, ProjectModel, module_of
+
+_CLOCK_KINDS = {"wall_clock", "monotonic"}
+_SANDBOX_KINDS = {"thread_spawn", "blocking_io", "env_read",
+                  "global_mutation"}
+
+#: self-attrs that ARE the clock seam.  Assigning a callable here is
+#: the repo's injection idiom (``self._clock = clock``); ambient reads
+#: elsewhere in the same class bypass it.
+_SEAM_ATTRS = {"self._clock", "self.clock"}
+
+_AUDIT_MUTATORS = {"append", "add", "insert", "setdefault", "update"}
+
+
+# ---------------------------------------------------------------------------
+# registry resolution
+# ---------------------------------------------------------------------------
+
+
+def resolve_policy(model: ProjectModel, policy: PolicyObject) \
+        -> Optional[Tuple[str, object]]:
+    """Locate a registry entry in the analyzed tree.
+
+    Returns ``(path, ClassInfo)`` for classes and ``(path, name)`` for
+    functions, or None when the entry's module is outside the analyzed
+    set (fixtures resolve too: matching is by repo-relative module
+    suffix, so a test file parsed under a virtual
+    ``dlrover_tpu/serving/autoscale.py`` path carries the contract)."""
+    if policy.kind == "class":
+        for ci in model.classes_named(policy.name):
+            if module_of(ci.path).endswith(policy.module):
+                return ci.path, ci
+        return None
+    for path, funcs in model.module_funcs.items():
+        if module_of(path).endswith(policy.module) and \
+                policy.name in funcs:
+            return path, policy.name
+    return None
+
+
+def policy_effects(model: ProjectModel, index: EffectIndex,
+                   policy: PolicyObject) -> Optional[Set[Effect]]:
+    """The transitive ambient-effect set of one registry entry, or
+    None when it does not resolve in the analyzed tree."""
+    got = resolve_policy(model, policy)
+    if got is None:
+        return None
+    path, target = got
+    if policy.kind == "class":
+        return set(index.class_closure(policy.name, target))
+    return set(index.func_closure(path, target))
+
+
+# ---------------------------------------------------------------------------
+# DET701–704: ambient effects reachable from registered policies
+# ---------------------------------------------------------------------------
+
+
+def _policy_findings(model: ProjectModel, index: EffectIndex) \
+        -> List[Finding]:
+    findings: List[Finding] = []
+    for policy in REGISTRY:
+        effs = policy_effects(model, index, policy)
+        if not effs:
+            continue
+        for e in sorted(effs, key=lambda e: (e.path, e.line, e.kind)):
+            if e.kind in _CLOCK_KINDS:
+                findings.append(Finding(
+                    "DET701", e.path, e.line,
+                    f"ambient clock read ({e.detail}) reachable from "
+                    f"registered policy {policy.label} — the wind "
+                    "tunnel cannot advance an ambient clock; read the "
+                    "injected `clock` seam instead",
+                ))
+            elif e.kind == "rng":
+                findings.append(Finding(
+                    "DET702", e.path, e.line,
+                    f"unseeded randomness ({e.detail}) reachable from "
+                    f"registered policy {policy.label} — replayed "
+                    "decision sequences can never match; take a seed/"
+                    "rng from the caller",
+                ))
+            elif e.kind in _SANDBOX_KINDS:
+                findings.append(Finding(
+                    "DET703", e.path, e.line,
+                    f"{e.kind} ({e.detail}) reachable from registered "
+                    f"policy {policy.label} — escapes the simulator's "
+                    "sandbox; move it to the actuator/transport layer "
+                    "behind a seam",
+                ))
+            elif e.kind == "hash_order":
+                findings.append(Finding(
+                    "DET704", e.path, e.line,
+                    f"hash-order nondeterminism ({e.detail}) reachable "
+                    f"from registered policy {policy.label} — the pick "
+                    "flips with PYTHONHASHSEED; impose a sorted() "
+                    "total order",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DET701 (seam-bypass form): ambient reads inside seam-bearing classes
+# ---------------------------------------------------------------------------
+
+
+def _has_clock_seam(cls: ast.ClassDef) -> bool:
+    """Does the class assign a CALLABLE to ``self._clock``/``
+    self.clock``?  ``self._clock = clock`` (param) and ``self._clock =
+    time.monotonic`` (default) are seams; ``= time.monotonic()`` (a
+    stored instant) is not."""
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, (ast.Name, ast.Attribute,
+                                       ast.BoolOp)):
+            continue
+        for t in node.targets:
+            if _dotted(t) in _SEAM_ATTRS:
+                return True
+    return False
+
+
+def _seam_source(model: ProjectModel, ci: ClassInfo) -> Optional[str]:
+    """Where this class COULD read an injected clock: its own seam
+    (``"self._clock"``), or a typed collaborator whose class carries
+    one (``"self.core (GatewayCore)"``).  None = genuinely seamless —
+    DET701's bypass form stays silent (registration is how an object
+    opts into the contract from scratch)."""
+    if isinstance(ci.node, ast.ClassDef) and _has_clock_seam(ci.node):
+        return "self._clock"
+    for attr in sorted(ci.attr_types):
+        for cname in sorted(ci.attr_types[attr]):
+            for collab in model.classes_named(cname):
+                if isinstance(collab.node, ast.ClassDef) and \
+                        _has_clock_seam(collab.node):
+                    return f"self.{attr} ({cname})"
+    return None
+
+
+def _seam_bypass_findings(model: ProjectModel, index: EffectIndex) \
+        -> List[Finding]:
+    findings: List[Finding] = []
+    for classes in model.classes.values():
+        for ci in classes:
+            if not isinstance(ci.node, ast.ClassDef):
+                continue
+            seam = _seam_source(model, ci)
+            if seam is None:
+                continue
+            for mname in sorted(ci.methods):
+                mi = ci.methods[mname]
+                for e in index.direct_of(ci.path, mi, ci):
+                    if e.kind in _CLOCK_KINDS:
+                        findings.append(Finding(
+                            "DET701", e.path, e.line,
+                            f"ambient clock read ({e.detail}) in "
+                            f"{ci.name}.{mname}, but an injected "
+                            f"clock seam is in reach ({seam}) — "
+                            "bypassing it makes the object half-"
+                            "simulable; route the read through the "
+                            "seam",
+                        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DET705: wall stamps recorded into decision/audit state
+# ---------------------------------------------------------------------------
+
+
+def _contains_wall_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _dotted(sub.func) in WALL_CALLS:
+            return True
+    return False
+
+
+def _audit_stamp_findings(model: ProjectModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in model.files.values():
+        for node in ast.walk(fi.tree):
+            line = getattr(node, "lineno", 0)
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _AUDIT_MUTATORS:
+                container = _dotted(node.func.value)
+                if container is None or \
+                        not container.startswith("self."):
+                    continue
+                if any(_contains_wall_call(a) for a in node.args) or \
+                        any(_contains_wall_call(kw.value)
+                            for kw in node.keywords):
+                    findings.append(Finding(
+                        "DET705", fi.path, line,
+                        f"wall-clock stamp recorded into {container} — "
+                        "replay compares stored decision/audit "
+                        "sequences, and wall stamps can never be "
+                        "byte-identical across runs; stamp via the "
+                        "injected clock",
+                    ))
+            elif isinstance(node, ast.Assign) and \
+                    _contains_wall_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        container = _dotted(t.value)
+                        if container is not None and \
+                                container.startswith("self."):
+                            findings.append(Finding(
+                                "DET705", fi.path, line,
+                                f"wall-clock stamp stored into "
+                                f"{container}[...] — replayed state "
+                                "can never match; stamp via the "
+                                "injected clock",
+                            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def check_project(model: ProjectModel,
+                  index: Optional[EffectIndex] = None) \
+        -> List[Finding]:
+    index = index if index is not None else EffectIndex(model)
+    findings: List[Finding] = []
+    findings.extend(_policy_findings(model, index))
+    findings.extend(_seam_bypass_findings(model, index))
+    findings.extend(_audit_stamp_findings(model))
+    uniq: Dict[Tuple[str, str, int], Finding] = {}
+    for f in findings:
+        uniq.setdefault((f.rule, f.path, f.line), f)
+    return list(uniq.values())
+
+
+# ---------------------------------------------------------------------------
+# the --effects manifest
+# ---------------------------------------------------------------------------
+
+MANIFEST_SCHEMA = "graftcheck.policy_effects.v1"
+
+
+def effects_manifest(model: ProjectModel,
+                     index: Optional[EffectIndex] = None) -> dict:
+    """The per-policy effect manifest the future ``sim/`` harness (and
+    the tier-1 drift gate) consumes.  Kinds only, no line numbers —
+    line drift must not churn the committed ``POLICY_EFFECTS.json``."""
+    from .effects import effects_summary
+    index = index if index is not None else EffectIndex(model)
+    policies = {}
+    for policy in sorted(REGISTRY, key=lambda p: p.label):
+        effs = policy_effects(model, index, policy)
+        policies[policy.label] = {
+            "kind": policy.kind,
+            "doc": policy.doc,
+            "resolved": effs is not None,
+            "ambient_effects": effects_summary(effs or ()),
+        }
+    return {"schema": MANIFEST_SCHEMA, "policies": policies}

@@ -1,0 +1,386 @@
+"""graftcheck pass 3: effect inference (the sim-readiness analysis).
+
+ROADMAP item 7 (the 10,000-node wind tunnel) needs every fleet policy
+to be a pure state machine over an INJECTED clock and seeded
+randomness, so the real policy objects can run inside a discrete-event
+simulator.  That property is structural, not behavioral — it can be
+read off the AST.  This pass computes, for every function/method in
+the analyzed tree, a **transitive ambient-effect set**:
+
+- ``wall_clock``      — ``time.time()`` / ``datetime.now()`` reads;
+- ``monotonic``       — ``time.monotonic()`` / ``perf_counter()``;
+- ``rng``             — unseeded randomness: ``random.*`` module
+                        calls, ``uuid4``, ``os.urandom``,
+                        ``np.random.*`` (``jax.random`` is keyed —
+                        JX004 owns key discipline, not this pass);
+- ``thread_spawn``    — ``threading.Thread``/``Timer``,
+                        ``multiprocessing.Process``, executors;
+- ``blocking_io``     — ``time.sleep``, sockets, ``open``,
+                        ``subprocess``, ``os.fsync``/``system``;
+- ``env_read``        — ``os.environ`` / ``os.getenv``;
+- ``global_mutation`` — a ``global`` declaration inside a function;
+- ``hash_order``      — iterating / ``next(iter(...))`` / ``.pop()``
+                        over a *set* without a ``sorted()`` total
+                        order (victim/owner/grant picks must not
+                        depend on PYTHONHASHSEED or insertion races).
+
+Direct effects are lexical; the transitive part propagates them
+through the PR-14 one-level call graph — ``self.<m>()`` calls
+(including inherited methods), ``self.<attr>.<m>()`` calls through the
+typed-collaborator index, and same-module function calls.  Calls that
+do not resolve (imported functions, untyped locals) contribute
+nothing: like every other graftcheck family, the analysis skips rather
+than guesses — which is exactly the seam contract: an *injected*
+callable (``self._clock()``, an ``observe_latency_ms`` hook, the obs
+recorder) is invisible here, and that invisibility is what "behind the
+seam" means.
+
+A nested ``def`` is charged to its definer: the closure a method
+builds (the gateway's gauge-snapshot reader) runs with the ambient
+reads its body contains, and the definer is the object that must
+route them through a seam.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, \
+    Tuple
+
+from .jax_rules import _dotted
+from .project_model import ClassInfo, MethodInfo, ProjectModel
+
+#: The closed effect vocabulary (the manifest schema pins this).
+EFFECT_KINDS = (
+    "wall_clock", "monotonic", "rng", "thread_spawn", "blocking_io",
+    "env_read", "global_mutation", "hash_order",
+)
+
+#: Wall-clock reads: instants that step under NTP; a replayed decision
+#: log stamped with these is incomparable across runs.
+WALL_CALLS = {
+    "time.time", "_time.time", "time.time_ns",
+    "time.ctime", "time.localtime", "time.gmtime", "time.strftime",
+    "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "date.today", "datetime.date.today",
+}
+
+#: Monotonic reads: safe for durations, still AMBIENT — a simulator
+#: cannot advance them; policies must read the injected clock.
+MONO_CALLS = {
+    "time.monotonic", "time.perf_counter",
+    "time.monotonic_ns", "time.perf_counter_ns",
+    "_time.monotonic", "_time.perf_counter",
+}
+
+#: Unseeded / process-global randomness.  ``jax.random`` is excluded
+#: by construction (keyed; the caller owns the seed).
+_RNG_EXACT = {"uuid.uuid4", "uuid4", "os.urandom", "getrandbits"}
+_RNG_PREFIXES = ("random.", "_random.", "secrets.", "np.random.",
+                 "numpy.random.")
+
+_THREAD_CALLS = {
+    "threading.Thread", "Thread", "threading.Timer", "Timer",
+    "multiprocessing.Process", "Process",
+    "ThreadPoolExecutor", "ProcessPoolExecutor",
+    "concurrent.futures.ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor",
+}
+
+_IO_EXACT = {
+    "time.sleep", "_time.sleep", "open", "os.fsync", "os.system",
+    "os.popen", "select.select", "socket.create_connection",
+}
+_IO_PREFIXES = ("socket.", "subprocess.", "shutil.")
+
+
+@dataclasses.dataclass(frozen=True)
+class Effect:
+    """One ambient-effect origin site."""
+
+    kind: str
+    path: str
+    line: int
+    detail: str
+
+
+# ---------------------------------------------------------------------------
+# set-typed name tracking (hash_order)
+# ---------------------------------------------------------------------------
+
+
+def _is_set_expr(node: ast.AST, settish: Set[str]) -> bool:
+    """Does ``node`` evaluate to a set?  Set displays/comprehensions,
+    ``set(...)``/``frozenset(...)`` calls, names assigned from one
+    (``settish`` carries both locals and ``self.x`` spellings), and
+    unions/intersections of sets."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        fname = _dotted(node.func)
+        return fname in ("set", "frozenset")
+    name = _dotted(node)
+    if name is not None and name in settish:
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub)):
+        return _is_set_expr(node.left, settish) or \
+            _is_set_expr(node.right, settish)
+    return False
+
+
+def set_attrs_of_class(cls: ast.ClassDef) -> Set[str]:
+    """``self.x`` attributes assigned a set anywhere in the class."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if value is None or not _is_set_expr(value, out):
+            # Two sweeps would catch chains; one keeps it cheap and
+            # conservative (misses only set-of-set aliasing).
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            name = _dotted(t)
+            if name is not None and name.startswith("self."):
+                out.add(name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# direct effects of one function body
+# ---------------------------------------------------------------------------
+
+
+class _EffectWalk(ast.NodeVisitor):
+    """One function's lexical ambient effects.  Walks nested defs too
+    (a closure's effects belong to its definer — see module doc)."""
+
+    def __init__(self, path: str, set_attrs: Set[str]):
+        self.path = path
+        self.settish: Set[str] = set(set_attrs)
+        self.effects: List[Effect] = []
+
+    def _add(self, kind: str, node: ast.AST, detail: str) -> None:
+        self.effects.append(Effect(
+            kind=kind, path=self.path,
+            line=getattr(node, "lineno", 0), detail=detail,
+        ))
+
+    # -- names that become settish --------------------------------------
+    def visit_Assign(self, node):
+        if _is_set_expr(node.value, self.settish):
+            for t in node.targets:
+                name = _dotted(t)
+                if name is not None:
+                    self.settish.add(name)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None and \
+                _is_set_expr(node.value, self.settish):
+            name = _dotted(node.target)
+            if name is not None:
+                self.settish.add(name)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        # ``s |= {...}`` keeps/creates settishness.
+        if isinstance(node.op, (ast.BitOr, ast.BitAnd)) and \
+                _is_set_expr(node.value, self.settish):
+            name = _dotted(node.target)
+            if name is not None:
+                self.settish.add(name)
+        self.generic_visit(node)
+
+    # -- iteration order -------------------------------------------------
+    def _check_iter(self, it: ast.AST) -> None:
+        if isinstance(it, ast.Call) and \
+                _dotted(it.func) in ("sorted", "len", "sum", "min",
+                                     "max", "any", "all"):
+            return  # a total order (or an order-free reduction)
+        if _is_set_expr(it, self.settish):
+            self._add("hash_order", it,
+                      "iterates a set in hash order")
+
+    def visit_For(self, node):
+        self._check_iter(node.iter)
+        self.generic_visit(node)
+
+    visit_AsyncFor = visit_For
+
+    def _visit_comp(self, node):
+        for gen in node.generators:
+            self._check_iter(gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_DictComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+
+    def visit_SetComp(self, node):
+        # Building a set is order-free; only its ITERATION sources
+        # matter.
+        for gen in node.generators:
+            self._check_iter(gen.iter)
+        self.generic_visit(node)
+
+    # -- ambient calls ---------------------------------------------------
+    def visit_Call(self, node):
+        fname = _dotted(node.func)
+        if fname is not None:
+            if fname in WALL_CALLS:
+                self._add("wall_clock", node, f"{fname}()")
+            elif fname in MONO_CALLS:
+                self._add("monotonic", node, f"{fname}()")
+            elif fname in _RNG_EXACT or \
+                    fname.startswith(_RNG_PREFIXES):
+                self._add("rng", node, f"{fname}()")
+            elif fname in _THREAD_CALLS:
+                self._add("thread_spawn", node, f"{fname}(...)")
+            elif fname in _IO_EXACT or fname.startswith(_IO_PREFIXES):
+                self._add("blocking_io", node, f"{fname}(...)")
+            elif fname in ("os.getenv", "os.environ.get"):
+                self._add("env_read", node, fname)
+            elif fname == "next" and node.args and \
+                    isinstance(node.args[0], ast.Call) and \
+                    _dotted(node.args[0].func) == "iter" and \
+                    node.args[0].args and _is_set_expr(
+                        node.args[0].args[0], self.settish):
+                self._add("hash_order", node,
+                          "next(iter(<set>)) picks in hash order")
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "pop" and not node.args and \
+                _is_set_expr(node.func.value, self.settish):
+            self._add("hash_order", node,
+                      "set.pop() picks in hash order")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if _dotted(node) == "os.environ":
+            self._add("env_read", node, "os.environ")
+        self.generic_visit(node)
+
+    def visit_Global(self, node):
+        self._add("global_mutation", node,
+                  "global " + ", ".join(node.names))
+        self.generic_visit(node)
+
+
+def direct_effects(path: str, func_node: ast.AST,
+                   set_attrs: Optional[Set[str]] = None) \
+        -> Tuple[Effect, ...]:
+    """The lexical ambient effects of one function/method body."""
+    walker = _EffectWalk(path, set_attrs or set())
+    for stmt in getattr(func_node, "body", []):
+        walker.visit(stmt)
+    return tuple(walker.effects)
+
+
+# ---------------------------------------------------------------------------
+# transitive closure over the call graph
+# ---------------------------------------------------------------------------
+
+
+class EffectIndex:
+    """Memoized direct + transitive effect sets over a project model.
+
+    Propagation mirrors ``proto_rules._acquired_closure``: self calls
+    (through lexical inheritance), typed-collaborator attr calls, and
+    same-module function calls; bounded depth, cycle-safe."""
+
+    def __init__(self, model: ProjectModel):
+        self.model = model
+        self._direct: Dict[int, Tuple[Effect, ...]] = {}
+        self._set_attrs: Dict[int, Set[str]] = {}
+        self._closure: Dict[Tuple[str, str, str], FrozenSet[Effect]] \
+            = {}
+
+    # -- direct ----------------------------------------------------------
+    def _class_set_attrs(self, ci: ClassInfo) -> Set[str]:
+        got = self._set_attrs.get(id(ci.node))
+        if got is None:
+            got = set_attrs_of_class(ci.node) \
+                if isinstance(ci.node, ast.ClassDef) else set()
+            self._set_attrs[id(ci.node)] = got
+        return got
+
+    def direct_of(self, path: str, mi: MethodInfo,
+                  ci: Optional[ClassInfo] = None) -> Tuple[Effect, ...]:
+        got = self._direct.get(id(mi.node))
+        if got is None:
+            attrs = self._class_set_attrs(ci) if ci is not None \
+                else set()
+            got = direct_effects(path, mi.node, attrs)
+            self._direct[id(mi.node)] = got
+        return got
+
+    # -- transitive ------------------------------------------------------
+    def method_closure(self, class_name: str, method: str,
+                       _seen: Optional[Set[Tuple[str, str]]] = None,
+                       _depth: int = 0) -> FrozenSet[Effect]:
+        key = ("m", class_name, method)
+        cached = self._closure.get(key)
+        if cached is not None:
+            return cached
+        seen = _seen if _seen is not None else set()
+        if (class_name, method) in seen or _depth > 6:
+            return frozenset()
+        seen.add((class_name, method))
+        got = self.model.resolve_method(class_name, method)
+        if got is None:
+            return frozenset()
+        ci, mi = got
+        out: Set[Effect] = set(self.direct_of(ci.path, mi, ci))
+        for callee in mi.self_calls:
+            out |= self.method_closure(class_name, callee, seen,
+                                       _depth + 1)
+        for attr, meth in mi.attr_calls:
+            for cname in ci.attr_types.get(attr, set()):
+                out |= self.method_closure(cname, meth, seen,
+                                           _depth + 1)
+        for fname in mi.func_calls:
+            out |= self.func_closure(ci.path, fname, seen, _depth + 1)
+        if _seen is None:  # only memoize complete (non-reentrant) runs
+            self._closure[key] = frozenset(out)
+        return frozenset(out)
+
+    def func_closure(self, path: str, func: str,
+                     _seen: Optional[Set[Tuple[str, str]]] = None,
+                     _depth: int = 0) -> FrozenSet[Effect]:
+        key = ("f", path, func)
+        cached = self._closure.get(key)
+        if cached is not None:
+            return cached
+        seen = _seen if _seen is not None else set()
+        skey = (f"<mod:{path}>", func)
+        if skey in seen or _depth > 6:
+            return frozenset()
+        seen.add(skey)
+        fmi = self.model.module_funcs.get(path, {}).get(func)
+        if fmi is None:
+            return frozenset()
+        out: Set[Effect] = set(self.direct_of(path, fmi))
+        for fname in fmi.func_calls:
+            out |= self.func_closure(path, fname, seen, _depth + 1)
+        if _seen is None:
+            self._closure[key] = frozenset(out)
+        return frozenset(out)
+
+    def class_closure(self, class_name: str, ci: ClassInfo) \
+            -> FrozenSet[Effect]:
+        """Union over every method — a policy OBJECT is sim-ready only
+        when its whole surface is (the simulator drives all of it)."""
+        out: Set[Effect] = set()
+        for mname in sorted(ci.methods):
+            out |= self.method_closure(class_name, mname)
+        return frozenset(out)
+
+
+def effects_summary(effects: Iterable[Effect]) -> List[str]:
+    """Sorted distinct kinds — the manifest form."""
+    return sorted({e.kind for e in effects})

@@ -1,14 +1,17 @@
 """graftcheck engine: file walking, suppression parsing, reporters.
 
 The engine owns everything rule-independent: turning source blobs into
-ASTs plus suppression maps, the TWO-PASS drive (pass 1 builds the
+ASTs plus suppression maps, the THREE-PASS drive (pass 1 builds the
 whole-program :mod:`project_model`; pass 2 runs the per-file rule
 modules on each analyzed file and the cross-module
-:mod:`proto_rules` over the model), marking findings suppressed,
-stale-suppression detection (GC001), and rendering human/JSON/chaos-
-table reports.  Per-file rules live in ``jax_rules.py``,
-``concurrency_rules.py`` and ``obs_rules.py``; cross-module rules in
-``proto_rules.py`` — all are pure functions over ASTs/model.
+:mod:`proto_rules` over the model; pass 3 computes transitive
+ambient-effect sets (:mod:`effects`) and runs the DET determinism
+families (:mod:`effect_rules`) over them), marking findings
+suppressed, stale-suppression detection (GC001), and rendering
+human/JSON/chaos-table/effects-manifest reports.  Per-file rules live
+in ``jax_rules.py``, ``concurrency_rules.py`` and ``obs_rules.py``;
+cross-module rules in ``proto_rules.py`` and ``effect_rules.py`` —
+all are pure functions over ASTs/model.
 """
 
 from __future__ import annotations
@@ -65,6 +68,21 @@ RULES: Dict[str, str] = {
              "registration",
     "MT602": "gauge name registered twice in one module (first "
              "callback silently dark)",
+    "DET701": "ambient clock read reachable from a registered pure "
+              "policy (or bypassing a class's injected clock seam) — "
+              "the wind tunnel cannot advance an ambient clock",
+    "DET702": "unseeded/ambient randomness reachable from a "
+              "registered pure policy (replayed decision sequences "
+              "can never match)",
+    "DET703": "sandbox escape reachable from a registered pure "
+              "policy: thread/process spawn, blocking I/O, env read, "
+              "or global mutation",
+    "DET704": "hash-order nondeterminism reachable from a registered "
+              "pure policy (set iteration / next(iter) / .pop() "
+              "without a sorted() total order)",
+    "DET705": "wall-clock timestamp recorded into decision/audit "
+              "state that replay compares (stamp via the injected "
+              "clock)",
 }
 
 #: Meta rules the suppression machinery itself emits; a suppression
@@ -86,7 +104,8 @@ class Finding:
 
 
 _SUPPRESS_RE = re.compile(
-    r"#\s*graftcheck:\s*disable=([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
+    r"#\s*graftcheck:\s*disable="
+    r"([A-Z]{2,3}\d{3}(?:\s*,\s*[A-Z]{2,3}\d{3})*)"
     r"\s*(?:--\s*(\S.*?))?\s*$"
 )
 
@@ -197,7 +216,8 @@ def _analyze_sources(
     files findings are REPORTED for (the ``--changed`` fast loop) —
     the model always spans every supplied source so cross-module
     rules stay sound.  Returns (findings, model)."""
-    from . import concurrency_rules, jax_rules, obs_rules, proto_rules
+    from . import (concurrency_rules, effect_rules, jax_rules,
+                   obs_rules, proto_rules)
     from .project_model import FileInfo, build_model
 
     if targets is None:
@@ -225,6 +245,14 @@ def _analyze_sources(
     model = build_model(infos, test_text=test_text)
     findings.extend(
         f for f in proto_rules.check_project(model)
+        if f.path in targets
+    )
+    # Pass 3: effect inference + the DET determinism families.  Same
+    # contract as pass 2 — the closure spans the whole model so a
+    # --changed run still sees effects a policy reaches through
+    # UNCHANGED collaborators, and reporting is target-filtered.
+    findings.extend(
+        f for f in effect_rules.check_project(model)
         if f.path in targets
     )
     used: Set[Tuple[str, int, str]] = set()
@@ -553,6 +581,12 @@ def main(argv=None) -> int:
              "project model (the README embeds exactly this)",
     )
     ap.add_argument(
+        "--effects", action="store_true",
+        help="print the per-policy ambient-effect manifest as JSON "
+             "(the committed POLICY_EFFECTS.json is exactly this; "
+             "the sim/ harness consumes it as its gate)",
+    )
+    ap.add_argument(
         "--tests", default=None, metavar="DIR",
         help="test tree for CH503 coverage checks (default: a "
              "'tests' directory beside the analyzed root)",
@@ -596,6 +630,21 @@ def main(argv=None) -> int:
             print(e, file=sys.stderr)
             return 2
         print(render_chaos_table(model))
+        return 0
+    if args.effects:
+        # Like --chaos-table: pure pass-1+3 over the model, no rule
+        # pipeline (targets=[]) — the manifest loop stays fast.
+        from .effect_rules import effects_manifest
+        try:
+            _findings, model = run_project(
+                paths, model_paths=model_paths, tests_dir=args.tests,
+                targets=[],
+            )
+        except FileNotFoundError as e:
+            print(e, file=sys.stderr)
+            return 2
+        print(json.dumps(effects_manifest(model), indent=2,
+                         sort_keys=True))
         return 0
     try:
         findings, model = run_project(

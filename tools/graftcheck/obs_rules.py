@@ -9,6 +9,10 @@ The legitimate exceptions — comparing wall TIMESTAMPS that crossed a
 process boundary (heartbeats, diagnosis reports), where wall time is
 the point — carry a justified suppression.
 
+(OB301 covers wall deltas used as *durations*; its v3 cousin DET705 —
+``effect_rules.py`` — covers wall stamps recorded into *stored*
+decision/audit state that replay compares.)
+
 Detection is lexical, matching the repo idiom: a ``Sub`` expression
 where either operand is *wallish* — a direct ``time.time()`` /
 ``_time.time()`` call, a local name assigned from one in the same

@@ -1,0 +1,108 @@
+"""The pure-policy registry: the objects the wind tunnel will drive.
+
+ROADMAP item 7's discrete-event simulator replays a synthetic
+10,000-node trace against the REAL policy objects — the same grant
+scan, autoscale decision function, placement solver, and borrow
+arbiter that run in production.  That only works if those objects are
+pure state machines over an *injected* clock and *seeded* randomness:
+any ambient ``time.time()``, ``random.random()``, thread spawn, or
+hash-order pick makes the simulated run diverge from the replayed one
+and the whole exercise meaningless.
+
+This module is the contract's source of truth.  Registering an object
+here turns the DET701–DET705 families on for it: graftcheck computes
+its transitive ambient-effect set (``effects.py``) and fails the build
+if the set is non-empty.  The ``--effects`` manifest
+(``POLICY_EFFECTS.json``) is generated from the same registry, and a
+tier-1 test pins it against drift.
+
+How to register a new policy object
+-----------------------------------
+Add a ``PolicyObject`` entry below.  ``module`` is the repo-relative
+path suffix (matched against the analyzed file's ``module_of`` label,
+so fixtures under virtual paths with the same suffix also resolve);
+``name`` is the class or module-level function name; ``kind`` is
+``"class"`` (the whole method surface must be effect-free) or
+``"function"`` (the function plus its same-module callees).  Then run
+``python -m graftcheck --effects dlrover_tpu/`` and commit the
+regenerated ``POLICY_EFFECTS.json``.
+
+The entries deliberately name WHERE the code lives today — the
+``_spec_k_request`` family sits in ``models/llama_infer.py`` (the
+serving draft loop imports it from there), not a hypothetical
+``serving/draft.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicyObject:
+    """One sim-bound object the determinism families protect."""
+
+    module: str   # repo-relative path suffix, e.g. "serving/gateway.py"
+    name: str     # class or function name inside that module
+    kind: str     # "class" | "function"
+    doc: str      # one line: what the simulator drives it for
+
+    @property
+    def label(self) -> str:
+        return f"{self.module}::{self.name}"
+
+
+REGISTRY: Tuple[PolicyObject, ...] = (
+    PolicyObject(
+        "dlrover_tpu/serving/gateway.py", "GatewayCore", "class",
+        "admission/grant scan + queue policy over the injected clock",
+    ),
+    PolicyObject(
+        "dlrover_tpu/serving/autoscale.py", "decide", "function",
+        "single-pool scaling decision (pure snapshot -> Decision)",
+    ),
+    PolicyObject(
+        "dlrover_tpu/serving/autoscale.py", "decide_pools", "function",
+        "multi-pool scaling with the shared-budget tie-break",
+    ),
+    PolicyObject(
+        "dlrover_tpu/common/hashring.py", "HashRing", "class",
+        "consistent-hash ownership: same members -> same ring",
+    ),
+    PolicyObject(
+        "dlrover_tpu/cells/federation.py", "merge_cell_snapshots",
+        "function",
+        "federation view merge (newest-wins, deterministic order)",
+    ),
+    PolicyObject(
+        "dlrover_tpu/cells/federation.py", "place_roles", "function",
+        "role placement across cells (sorted candidate order)",
+    ),
+    PolicyObject(
+        "dlrover_tpu/cells/federation.py", "detect_splits", "function",
+        "split-brain detection over the merged view",
+    ),
+    PolicyObject(
+        "dlrover_tpu/fleet/policy.py", "ChipBorrowArbiter", "class",
+        "cross-job chip borrow/reclaim arbitration",
+    ),
+    PolicyObject(
+        "dlrover_tpu/reshard/plan.py", "build_plan", "function",
+        "reshard transfer planning (same src/dst -> same plan)",
+    ),
+    PolicyObject(
+        "dlrover_tpu/checkpoint/slicer.py", "plan_persist", "function",
+        "per-process slice assignment for sliced checkpoints",
+    ),
+    PolicyObject(
+        "dlrover_tpu/models/llama_infer.py", "_spec_k_request",
+        "function",
+        "speculative-k controller (request-level EWMA policy)",
+    ),
+    PolicyObject(
+        "dlrover_tpu/models/llama_infer.py", "_adapt_spec_k",
+        "function",
+        "speculative-k controller (per-step adaptation policy)",
+    ),
+)
