@@ -3238,9 +3238,175 @@ def reshard_bench_main(argv: list) -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def zipf_cell_trace(rate: float, duration: float, n_cells: int,
+                    zipf_a: float, seed: int):
+    """Zipf-over-CELLS hot-cell traffic (ISSUE 17): one global Poisson
+    arrival stream where each request's HOME CELL is drawn from a
+    Zipf(``zipf_a``) distribution over cells — cell 0 is the hot
+    region, the tail cells sit on headroom.  Seeded and fully
+    deterministic (`np.random.RandomState`), so the spillover and
+    static-partitioning rows of the global bench replay the IDENTICAL
+    trace.  Returns ``(arrival_times, home_cells)`` parallel lists."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / max(rate, 1e-9),
+                           size=int(rate * duration * 3) + 16)
+    times = np.cumsum(gaps)
+    times = times[times < duration]
+    w = 1.0 / np.arange(1, max(n_cells, 1) + 1) ** float(zipf_a)
+    homes = rng.choice(max(n_cells, 1), size=len(times), p=w / w.sum())
+    return times.tolist(), [int(c) for c in homes]
+
+
+class _StubDecodeServer:
+    """Decode stand-in with the incremental admission surface.  The
+    load bench measures the FRONT DOOR, so its decode is instant
+    (``service_s=0``: slots are wide, tokens are free); the global
+    bench models a finite decode capacity instead — ``service_s`` is
+    charged per finished request, so a cell's replicas saturate at
+    ``replicas / service_s`` rps and admission pressure (the spillover
+    trigger) is real."""
+
+    def __init__(self, slots, mnt, service_s=0.0):
+        import threading
+
+        self.slots = slots
+        self.mnt = mnt
+        self.service_s = service_s
+        self._pending = []
+        self._mu = threading.Lock()
+
+    def submit(self, rid, prompt, mnt, **_kw):
+        with self._mu:
+            self._pending.append((rid, list(prompt), int(mnt)))
+
+    def cancel(self, rid):
+        with self._mu:
+            for i, item in enumerate(self._pending):
+                if item[0] == rid:
+                    del self._pending[i]
+                    return True
+        return False
+
+    def pending_count(self):
+        with self._mu:
+            return len(self._pending)
+
+    def pending_rids(self):
+        with self._mu:
+            return [r for r, _, _ in self._pending]
+
+    def active_rids(self):
+        return []
+
+    def free_slots(self):
+        with self._mu:
+            return max(0, self.slots - len(self._pending))
+
+    def serve_incremental(self, tick=None, on_finish=None,
+                          on_token=None):
+        while True:
+            keep = tick() is not False if tick else True
+            with self._mu:
+                batch, self._pending = self._pending, []
+            for rid, prompt, mnt in batch:
+                if self.service_s:
+                    time.sleep(self.service_s)
+                out = list(prompt)
+                for i in range(mnt):
+                    tok = (len(prompt) + i) % 97
+                    out.append(tok)
+                    if on_token:
+                        on_token(rid, tok)
+                if on_finish:
+                    on_finish(rid, out)
+            if not keep and not batch:
+                return {}
+            if not batch:
+                time.sleep(0.0005)
+
+
+class _PacedPipeline:
+    """One gateway's modeled event loop: serialized handling with a
+    per-message service-time floor; real handler CPU is charged
+    against the budget.  ``cast`` is the open-loop client edge (a
+    full queue DROPS, like a saturated listen backlog); ``call`` is
+    the blocking replica/ops edge."""
+
+    _DONE = object()
+
+    def __init__(self, handle, floor, cap):
+        import queue
+        import threading
+
+        self._handle = handle
+        self._floor = floor
+        self.q = queue.Queue(maxsize=cap)
+        self.wire_dropped = 0
+        self.handled = 0
+        self.errors = 0
+        self.busy_s = 0.0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True
+        )
+        self._thread.start()
+
+    def cast(self, data: bytes) -> None:
+        import queue
+
+        try:
+            self.q.put_nowait((data, None))
+        except queue.Full:
+            self.wire_dropped += 1
+
+    def call(self, msg, **_kw):
+        import threading
+
+        from dlrover_tpu.common import messages as wire
+
+        slot = [None, threading.Event()]
+        self.q.put((wire.serialize(msg), slot))
+        slot[1].wait(timeout=30.0)
+        data = slot[0]
+        return wire.deserialize(data) if data is not None else None
+
+    def _run(self):
+        from dlrover_tpu.common import messages as wire
+
+        while True:
+            item = self.q.get()
+            if item is self._DONE:
+                return
+            data, slot = item
+            t0 = time.perf_counter()
+            out = None
+            try:
+                reply = self._handle(wire.deserialize(data))
+                if reply is not None:
+                    out = wire.serialize(reply)
+            except Exception as e:  # noqa: BLE001 - pipe survives
+                self.errors += 1
+                print(f"pipeline handler error: {e!r}",
+                      file=sys.stderr)
+            dt = time.perf_counter() - t0
+            self.busy_s += dt
+            self.handled += 1
+            if slot is not None:
+                slot[0] = out
+                slot[1].set()
+            if dt < self._floor:
+                time.sleep(self._floor - dt)
+
+    def stop(self):
+        self.q.put(self._DONE)
+        self._thread.join(timeout=10.0)
+
+
 def load_bench_main(argv: list) -> int:
     """Open-loop load harness for the serving front door (ISSUE 9
-    acceptance artifact): Poisson / bursty / diurnal arrival traces at
+    acceptance artifact): Poisson / bursty / diurnal / Zipf-over-cells
+    arrival traces at
     thousands of requests per second against a SHARDED GATEWAY TIER,
     with SLO-attainment reporting and a profile of the admission hot
     loop.
@@ -3301,6 +3467,7 @@ def load_bench_main(argv: list) -> int:
         "poll_interval": 0.01, "queue_cap": 512,
         "burst_period_s": 1.0, "burst_duty": 0.35, "burst_high_x": 2.5,
         "diurnal_period_s": 3.0, "diurnal_amp": 0.8,
+        "zipf_cells_a": 1.4,
     }
     gateways_rows = [1, 2]
     rates_override = None
@@ -3352,128 +3519,6 @@ def load_bench_main(argv: list) -> int:
         1, 2, 5, 10, 20, 35, 50, 75, 100, 150, 200, 350, 500, 750,
         1000, 1500, 2000, 3000, 5000, 10000, 30000,
     )
-
-    class _StubDecodeServer:
-        """Instant-decode stand-in with the incremental admission
-        surface: the harness measures the FRONT DOOR, so decode must
-        never be the bottleneck (slots are wide, tokens are free)."""
-
-        def __init__(self, slots, mnt):
-            self.slots = slots
-            self.mnt = mnt
-            self._pending = []
-            self._mu = threading.Lock()
-
-        def submit(self, rid, prompt, mnt, **_kw):
-            with self._mu:
-                self._pending.append((rid, list(prompt), int(mnt)))
-
-        def cancel(self, rid):
-            with self._mu:
-                for i, item in enumerate(self._pending):
-                    if item[0] == rid:
-                        del self._pending[i]
-                        return True
-            return False
-
-        def pending_count(self):
-            with self._mu:
-                return len(self._pending)
-
-        def pending_rids(self):
-            with self._mu:
-                return [r for r, _, _ in self._pending]
-
-        def active_rids(self):
-            return []
-
-        def free_slots(self):
-            with self._mu:
-                return max(0, self.slots - len(self._pending))
-
-        def serve_incremental(self, tick=None, on_finish=None,
-                              on_token=None):
-            while True:
-                keep = tick() is not False if tick else True
-                with self._mu:
-                    batch, self._pending = self._pending, []
-                for rid, prompt, mnt in batch:
-                    out = list(prompt)
-                    for i in range(mnt):
-                        tok = (len(prompt) + i) % 97
-                        out.append(tok)
-                        if on_token:
-                            on_token(rid, tok)
-                    if on_finish:
-                        on_finish(rid, out)
-                if not keep and not batch:
-                    return {}
-                if not batch:
-                    time.sleep(0.0005)
-
-    class _PacedPipeline:
-        """One gateway's modeled event loop: serialized handling with
-        a per-message service-time floor; real handler CPU is charged
-        against the budget.  ``cast`` is the open-loop client edge (a
-        full queue DROPS, like a saturated listen backlog); ``call``
-        is the blocking replica/ops edge."""
-
-        _DONE = object()
-
-        def __init__(self, handle, floor, cap):
-            self._handle = handle
-            self._floor = floor
-            self.q = queue.Queue(maxsize=cap)
-            self.wire_dropped = 0
-            self.handled = 0
-            self.errors = 0
-            self.busy_s = 0.0
-            self._thread = threading.Thread(
-                target=self._run, daemon=True
-            )
-            self._thread.start()
-
-        def cast(self, data: bytes) -> None:
-            try:
-                self.q.put_nowait((data, None))
-            except queue.Full:
-                self.wire_dropped += 1
-
-        def call(self, msg, **_kw):
-            slot = [None, threading.Event()]
-            self.q.put((wire.serialize(msg), slot))
-            slot[1].wait(timeout=30.0)
-            data = slot[0]
-            return wire.deserialize(data) if data is not None else None
-
-        def _run(self):
-            while True:
-                item = self.q.get()
-                if item is self._DONE:
-                    return
-                data, slot = item
-                t0 = time.perf_counter()
-                out = None
-                try:
-                    reply = self._handle(wire.deserialize(data))
-                    if reply is not None:
-                        out = wire.serialize(reply)
-                except Exception as e:  # noqa: BLE001 - pipe survives
-                    self.errors += 1
-                    print(f"pipeline handler error: {e!r}",
-                          file=sys.stderr)
-                dt = time.perf_counter() - t0
-                self.busy_s += dt
-                self.handled += 1
-                if slot is not None:
-                    slot[0] = out
-                    slot[1].set()
-                if dt < self._floor:
-                    time.sleep(self._floor - dt)
-
-        def stop(self):
-            self.q.put(self._DONE)
-            self._thread.join(timeout=10.0)
 
     def make_trace(kind: str, rate: float, duration: float, seed: int):
         """-> (arrival_times, [(t_start, phase_name), ...]).  Arrivals
@@ -3598,8 +3643,25 @@ def load_bench_main(argv: list) -> int:
             threads.append(th)
 
         ring = HashRing(gids)
-        times, phases = make_trace(kind, rate, opts["duration_s"],
-                                   opts["seed"] + int(rate))
+        homes = None
+        if kind == "zipf_cells":
+            # ISSUE 17 regional-skew model: gateways stand in for
+            # cells, cell 0 is hot — arrivals route by HOME, not by
+            # the uniform request-id hash, so the hot shard's TTFT
+            # inflation under skew is measured (the spillover
+            # motivation; the global bench replays the same trace
+            # across real cells).
+            times, homes = zipf_cell_trace(
+                rate, opts["duration_s"], n_gateways,
+                opts["zipf_cells_a"], opts["seed"] + int(rate),
+            )
+            phases = [
+                (at, "hot-cell" if c == 0 else "cold-cell")
+                for at, c in zip(times, homes)
+            ] or [(0.0, "hot-cell")]
+        else:
+            times, phases = make_trace(kind, rate, opts["duration_s"],
+                                       opts["seed"] + int(rate))
         for name in {p[1] for p in phases}:
             phase_hists[name] = Histogram(buckets=ttft_buckets)
         prompt = list(range(1, opts["prompt_tokens"] + 1))
@@ -3627,7 +3689,8 @@ def load_bench_main(argv: list) -> int:
                     time.sleep(at - now)
                 else:
                     behind_s = max(behind_s, now - at)
-                owner = ring.owner(rid)
+                owner = (gids[homes[i]] if homes is not None
+                         else ring.owner(rid))
                 pipes[owner].cast(data)
             # Drain: every submitted request reaches a terminal state
             # (done / timeout / shed at the wire).
@@ -4029,6 +4092,17 @@ def load_bench_main(argv: list) -> int:
         flush()
         print(f"load trace: {point}", file=sys.stderr)
 
+    # Regional skew (ISSUE 17): the same offered rate, but arrivals
+    # routed by a Zipf-over-cells HOME assignment (gateway 0 hot)
+    # instead of the uniform id hash — the hot shard saturates while
+    # the cold shards idle, the collapse cross-cell spillover exists
+    # to fix.  The global bench replays this trace across real cells.
+    point = run_point(n_trace, "zipf_cells",
+                      float(rates[-2 if len(rates) > 1 else 0]))
+    result["skew"] = point
+    flush()
+    print(f"load skew: {point}", file=sys.stderr)
+
     # Conservation: every submission was shed at the wire, rejected by
     # backpressure, or accepted — and every accepted request reached a
     # terminal state within the drain budget.
@@ -4041,6 +4115,7 @@ def load_bench_main(argv: list) -> int:
             and p["accepted"] == p["completed"] + p["timeout"]
             + p["failed"]
             for p in result["sweep"] + result["traces"]
+            + [result["skew"]]
         )
     )
     result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
@@ -5176,6 +5251,408 @@ def cell_bench_main(argv: list) -> int:
     return 0 if result["complete"] else 1
 
 
+def global_bench_main(argv: list) -> int:
+    """Global data-plane bench (ISSUE 17 acceptance artifact): SLO
+    goodput across TWO CELLS under hot-cell Zipf skew, with the hot
+    cell blacked out mid-trace.
+
+    Rows compare STATIC cell partitioning (a request lives and dies in
+    its home cell — no cross-cell anything) against the cross-cell
+    data plane (``CellSpillRouter`` spillover + post-blackout chip
+    moves) on the IDENTICAL seeded ``zipf_cell_trace``.  Each cell is
+    one paced-pipeline gateway (the load bench's
+    max(real_cpu, gw_service_us) budget) plus replicas whose stub
+    decode charges ``service_ms`` per request, so a cell SATURATES at
+    ``replicas / service_ms`` rps and admission pressure — the
+    spillover trigger — is real.  The cross-cell hop runs the real
+    router/policy/dedupe code (``gateway.handle`` → router → sibling
+    ``gateway.handle``), charged against the origin pipeline's budget.
+
+    Blackout semantics: at ``blackout_frac`` of the trace the hot
+    cell answers NOTHING more (its gateway drops every message, its
+    replicas stop un-drained) — in-core work is STRANDED and counted.
+    In spillover mode the driver re-homes later arrivals to the
+    survivor (the ``GlobalClient`` failover contract, proven
+    exactly-once in the chaos e2e); ``move_delay_s`` later the dead
+    cell's chips arrive at the survivor as fresh replicas — the
+    capacity outcome of the drain-first ``CrossCellMover`` ladder,
+    whose actuation mechanics the fleet units own.  In static mode
+    those arrivals have no cell and are counted ``blackout_lost``.
+
+    Conservation ACROSS THE HOP per row, via
+    ``merge_global_snapshots`` (a forwarded request is ``submitted``
+    at both ends, deduped by the sibling's ``spill_ingress`` mark):
+    arrivals == submitted_unique + wire_dropped + blackout_lost +
+    blackout_dropped, and accepted == completed + timeout + failed +
+    stranded.
+
+    Flags: ``--replicas=N`` (per cell) ``--service_ms=F``
+    ``--gw_service_us=F`` ``--rate_mult=F`` (of total decode
+    capacity) ``--zipf_a=F`` ``--duration_s=F`` ``--blackout_frac=F``
+    ``--move_delay_s=F`` ``--slo_ms=F`` ``--out=PATH`` (default
+    GLOBAL_BENCH_CPU.json) ``--smoke`` (blackout pair only; the
+    tier-1 schema gate)."""
+    import os
+    import threading
+
+    from dlrover_tpu.common import messages as wire
+    from dlrover_tpu.serving import (
+        Gateway,
+        GatewayConfig,
+        LocalKv,
+        ReplicaRunner,
+        ServeRegistry,
+        TierReplicaLink,
+        merge_snapshots,
+    )
+    from dlrover_tpu.serving.spillover import (
+        CellSpillRouter,
+        SpilloverPolicy,
+        merge_global_snapshots,
+    )
+
+    t_start = time.perf_counter()
+    opts = {
+        "cells": 2, "replicas": 2, "service_ms": 6.0,
+        "gw_service_us": 250.0, "rate_mult": 0.9, "zipf_a": 1.4,
+        "duration_s": 4.0, "drain_s": 10.0, "blackout_frac": 0.5,
+        "move_delay_s": 0.4, "slo_ms": 1000.0, "deadline_s": 2.0,
+        "queue_cap": 48, "slots": 32, "prompt_tokens": 8, "mnt": 1,
+        "poll_interval": 0.01, "seed": 0,
+    }
+    out_path = None
+    smoke = False
+    for a in argv:
+        if a == "--smoke":
+            smoke = True
+            opts.update(replicas=1, service_ms=4.0, duration_s=1.2,
+                        drain_s=6.0)
+        elif a.startswith("--out="):
+            out_path = a.split("=", 1)[1]
+        elif "=" in a and a.startswith("--"):
+            k, v = a[2:].split("=", 1)
+            if k in opts:
+                opts[k] = type(opts[k])(v)
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "GLOBAL_BENCH_CPU.json",
+        )
+    n_cells = int(opts["cells"])
+    service_s = opts["service_ms"] / 1e3
+    floor_s = opts["gw_service_us"] / 1e6
+    cell_capacity = opts["replicas"] / service_s
+    rate = opts["rate_mult"] * n_cells * cell_capacity
+    ttft_buckets = (
+        1, 2, 5, 10, 20, 35, 50, 75, 100, 150, 200, 350, 500, 750,
+        1000, 1500, 2000, 3000, 5000, 10000, 30000,
+    )
+
+    result = {
+        "bench": "global_serve",
+        "smoke": smoke,
+        "opts": dict(opts),
+        "offered_rps": round(rate, 1),
+        "cell_capacity_rps": round(cell_capacity, 1),
+        "rows": [],
+        "note": (
+            "SLO goodput across 2 cells under the SAME seeded "
+            "Zipf-over-cells trace (cell 0 hot): static partitioning "
+            "(requests live and die in their home cell) vs the "
+            "cross-cell data plane (CellSpillRouter spillover through "
+            "the real gateway dispatch + post-blackout capacity moves "
+            "after the drain-first ladder's move_delay_s).  Blackout "
+            "rows kill the HOT cell mid-trace: its gateway answers "
+            "nothing, its replicas stop un-drained, in-core work is "
+            "counted stranded.  Conservation holds ACROSS the hop via "
+            "merge_global_snapshots' submitted_unique dedupe."
+        ),
+    }
+
+    def flush():
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+        os.replace(tmp, out_path)
+
+    class _CellTransport:
+        """The inter-cell hop: serialize -> sibling gateway dispatch
+        -> deserialize, on the CALLER's pipeline thread (the hop's
+        cost charges the origin's budget).  ``dead`` models the
+        sibling cell blacking out mid-hop."""
+
+        def __init__(self, gw):
+            self._gw = gw
+            self.dead = False
+
+        def call(self, msg, **_kw):
+            if self.dead:
+                raise ConnectionError("cell blacked out")
+            reply = self._gw.handle(wire.deserialize(
+                wire.serialize(msg)
+            ))
+            if reply is None:
+                raise ConnectionError("cell blacked out")
+            return wire.deserialize(wire.serialize(reply))
+
+    def run_row(mode: str, blackout: bool) -> dict:
+        cell_ids = [f"c{i}" for i in range(n_cells)]
+        dead_cells = set()
+        gws, pipes, registries = {}, {}, {}
+        in_slo = {cid: 0 for cid in cell_ids}
+        blackout_dropped = [0]
+        runners, threads = [], []
+
+        def connect_for(cid):
+            return lambda addr: pipes[addr.split("//", 1)[1]]
+
+        def make_handle(cid, gw):
+            def handle(msg):
+                if cid in dead_cells:
+                    # A dead cell answers NOTHING — casts already on
+                    # the wire at blackout are dropped, not admitted.
+                    if isinstance(msg, wire.ServeSubmit):
+                        blackout_dropped[0] += 1
+                    return None
+                return gw.handle(msg)
+            return handle
+
+        def start_replica(cid, rid):
+            link = TierReplicaLink(registries[cid], rid,
+                                   connect=connect_for(cid),
+                                   refresh_s=1.0)
+            runner = ReplicaRunner(
+                _StubDecodeServer(opts["slots"], opts["mnt"],
+                                  service_s=service_s),
+                link, rid, poll_interval=opts["poll_interval"],
+                kv_p2p=False,
+            )
+            th = threading.Thread(target=runner.run, daemon=True)
+            th.start()
+            runners.append((cid, runner))
+            threads.append(th)
+
+        for cid in cell_ids:
+            registries[cid] = ServeRegistry(LocalKv(),
+                                            job=f"gbl-{cid}",
+                                            lease_s=3600.0)
+            gw = Gateway(
+                port=0,
+                config=GatewayConfig(
+                    queue_cap=opts["queue_cap"],
+                    default_deadline_s=opts["deadline_s"],
+                ),
+                histogram_buckets=ttft_buckets,
+            )
+            orig_lat = gw.core.observe_latency_ms
+
+            def lat_obs(v, _o=orig_lat, _c=cid):
+                _o(v)
+                if v <= opts["slo_ms"]:
+                    in_slo[_c] += 1
+
+            gw.core.observe_latency_ms = lat_obs
+            gws[cid] = gw
+            cap = max(64, int(1.0 / floor_s))
+            pipes[cid] = _PacedPipeline(make_handle(cid, gw),
+                                        floor_s, cap)
+            registries[cid].announce_gateway(f"{cid}-g0",
+                                             f"pipe://{cid}")
+            for i in range(opts["replicas"]):
+                start_replica(cid, f"{cid}-r{i}")
+
+        transports = {cid: _CellTransport(gws[cid])
+                      for cid in cell_ids}
+        if mode == "spillover":
+            for cid in cell_ids:
+                sibs = {c: transports[c] for c in cell_ids
+                        if c != cid}
+
+                def view(_sibs=sibs):
+                    return {
+                        c: dict(gws[c].core.pressure(),
+                                alive=c not in dead_cells)
+                        for c in _sibs
+                    }
+
+                gws[cid].spill_router = CellSpillRouter(
+                    cid, gws[cid].core, sibs,
+                    policy=SpilloverPolicy(), view_fn=view,
+                )
+
+        times, homes = zipf_cell_trace(
+            rate, opts["duration_s"], n_cells, opts["zipf_a"],
+            opts["seed"],
+        )
+        hot = cell_ids[0]
+        blackout_at = (opts["duration_s"] * opts["blackout_frac"]
+                       if blackout else float("inf"))
+        move_at = blackout_at + opts["move_delay_s"]
+        moved = 0
+        blackout_lost = 0
+        prompt = list(range(1, opts["prompt_tokens"] + 1))
+        t0 = time.perf_counter()
+        try:
+            for i, at in enumerate(times):
+                now = time.perf_counter() - t0
+                if now < at:
+                    time.sleep(at - now)
+                if at >= blackout_at and hot not in dead_cells:
+                    # The whole hot cell goes dark as ONE event: the
+                    # gateway answers nothing, the sibling's transport
+                    # to it fails, replicas stop with work abandoned.
+                    dead_cells.add(hot)
+                    transports[hot].dead = True
+                    for cid_r, runner in runners:
+                        if cid_r == hot:
+                            runner._stopped = True  # noqa: SLF001
+                            runner.server._pending.clear()  # noqa: SLF001
+                if (mode == "spillover" and blackout and moved == 0
+                        and at >= move_at):
+                    # The dead cell's chips land at the survivor — the
+                    # capacity outcome of the drain-first cross-cell
+                    # move ladder (fleet units own its mechanics).
+                    survivor = next(c for c in cell_ids
+                                    if c not in dead_cells)
+                    for j in range(opts["replicas"]):
+                        start_replica(survivor, f"moved-r{j}")
+                        moved += 1
+                cid = cell_ids[homes[i]]
+                if cid in dead_cells:
+                    if mode == "static":
+                        blackout_lost += 1
+                        continue
+                    cid = next(c for c in cell_ids
+                               if c not in dead_cells)
+                msg = wire.ServeSubmit(
+                    req_id=f"{mode[0]}{int(blackout)}-{i}",
+                    prompt=prompt, max_new_tokens=opts["mnt"],
+                    deadline_s=opts["deadline_s"],
+                )
+                pipes[cid].cast(wire.serialize(msg))
+            drain_end = time.monotonic() + opts["drain_s"]
+            while time.monotonic() < drain_end:
+                live = [c for c in cell_ids if c not in dead_cells]
+                if all(pipes[c].q.empty() for c in live) and all(
+                    gws[c].core.stats_snapshot()["in_flight"] == 0
+                    for c in live
+                ):
+                    break
+                time.sleep(0.05)
+            elapsed = time.perf_counter() - t0
+            merged = merge_global_snapshots({
+                cid: merge_snapshots([gws[cid].core.stats_snapshot()])
+                for cid in cell_ids
+            })
+            counters = merged["counters"]
+            stranded = merged["in_flight"]
+            slo_total = sum(in_slo.values())
+            arrivals = len(times)
+            row = {
+                "mode": mode,
+                "blackout": blackout,
+                "offered_rps": round(rate, 1),
+                "arrivals": arrivals,
+                "hot_share": round(
+                    homes.count(0) / max(arrivals, 1), 3
+                ),
+                "blackout_lost": blackout_lost,
+                "blackout_dropped": blackout_dropped[0],
+                "wire_dropped": sum(p.wire_dropped
+                                    for p in pipes.values()),
+                "submitted_unique": merged["submitted_unique"],
+                "spill_forwarded": merged["spill_forwarded"],
+                "spill_ingress": merged["spill_ingress"],
+                "spill_rebuffed": merged["spill_rebuffed"],
+                "spill_adopted": merged["spill_adopted"],
+                "accepted": counters.get("accepted", 0),
+                "rejected": counters.get("rejected", 0),
+                "completed": counters.get("completed", 0),
+                "timeout": counters.get("timeout", 0),
+                "failed": counters.get("failed", 0),
+                "stranded": stranded,
+                "completed_in_slo": slo_total,
+                "goodput_rps": round(slo_total / max(elapsed, 1e-9),
+                                     1),
+                "moved_replicas": moved,
+                "elapsed_s": round(elapsed, 2),
+                "cells": {
+                    c: dict(
+                        in_flight=snap["in_flight"],
+                        replicas_alive=snap["replicas_alive"],
+                        **{k: snap["counters"].get(k, 0)
+                           for k in ("submitted", "accepted",
+                                     "rejected", "completed",
+                                     "timeout", "failed",
+                                     "spill_forwarded",
+                                     "spill_ingress",
+                                     "spill_rebuffed",
+                                     "spill_adopted")},
+                    )
+                    for c, snap in merged["cells"].items()
+                },
+            }
+            row["conservation_ok"] = (
+                arrivals == row["submitted_unique"]
+                + row["wire_dropped"] + row["blackout_lost"]
+                + row["blackout_dropped"]
+                and row["accepted"] == row["completed"]
+                + row["timeout"] + row["failed"] + row["stranded"]
+            )
+            return row
+        finally:
+            dead_cells.update(cell_ids)  # handles answer nothing more
+            for _cid, runner in runners:
+                runner._stopped = True  # noqa: SLF001
+            for th in threads:
+                th.join(timeout=15)
+            for pipe in pipes.values():
+                pipe.stop()
+
+    modes = ["static", "spillover"]
+    shapes = [True] if smoke else [False, True]
+    rows = {}
+    for blackout in shapes:
+        for mode in modes:
+            row = run_row(mode, blackout)
+            rows[(mode, blackout)] = row
+            result["rows"].append(row)
+            flush()
+            print(f"global row: {row}", file=sys.stderr)
+
+    spill_bo = rows[("spillover", True)]
+    static_bo = rows[("static", True)]
+    result["verdicts"] = {
+        "spillover_beats_static_blackout":
+            spill_bo["goodput_rps"] > static_bo["goodput_rps"],
+        "hop_conserved": all(r["conservation_ok"]
+                             for r in result["rows"]),
+        "spill_forwarded_nonzero": spill_bo["spill_forwarded"] > 0,
+    }
+    if not smoke:
+        result["verdicts"]["spillover_beats_static_skew"] = (
+            rows[("spillover", False)]["goodput_rps"]
+            > rows[("static", False)]["goodput_rps"]
+        )
+    result["blackout_goodput_speedup_x"] = round(
+        spill_bo["goodput_rps"] / max(static_bo["goodput_rps"], 1e-9),
+        2,
+    )
+    result["complete"] = all(result["verdicts"].values())
+    result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+    flush()
+    print(json.dumps({
+        "metric": "global_slo_goodput_under_blackout",
+        "value": spill_bo["goodput_rps"],
+        "unit": "slo_goodput_rps_hot_zipf_one_cell_killed",
+        "vs_baseline": static_bo["goodput_rps"],
+        "speedup": result["blackout_goodput_speedup_x"],
+        "backend": "cpu",
+        "artifact": out_path,
+    }))
+    return 0 if result["complete"] else 1
+
+
 #: Subcommand table: every bench registers here (satellite of ISSUE 5 —
 #: the tail-of-file if-chain made each new bench a copy-paste edit).
 SUBCOMMANDS = {
@@ -5189,6 +5666,7 @@ SUBCOMMANDS = {
     "--fleet_bench": fleet_bench_main,
     "--ha_bench": ha_bench_main,
     "--cell_bench": cell_bench_main,
+    "--global_bench": global_bench_main,
 }
 
 

@@ -37,6 +37,7 @@ from dlrover_tpu.cells.federation import (  # noqa: F401
     detect_splits,
     merge_cell_snapshots,
     place_roles,
+    plan_moves,
 )
 from dlrover_tpu.cells.manager import CellManager  # noqa: F401
 from dlrover_tpu.cells.registry import CellRegistry  # noqa: F401

@@ -155,6 +155,17 @@ class CellHeartbeat:
         # ``step_ge=N`` kills deterministically after N announces.
         chaos.inject("cell.master_kill", method=self.cell_id,
                      step=self._beats)
+        # The whole-cell blackout site (ISSUE 17): ONE fault spec
+        # (``method=<cell_id>``) extinguishes the entire cell — this
+        # master exits 86 here and every gateway of the same cell
+        # fires the same site from its own heartbeat (tier nodes
+        # carry ``cell_id``), so within one beat the cell is simply
+        # gone: no standby takeover, no lease renewal.  Survival is
+        # the GLOBAL data plane's job — sibling cells absorb the
+        # spillover and every admitted request still completes
+        # exactly once.
+        chaos.inject("cell.blackout", method=self.cell_id,
+                     step=self._beats)
         self._beats += 1
         view = sorted(
             set(self.registry.cells()) | {self.cell_id}

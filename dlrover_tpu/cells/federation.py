@@ -202,6 +202,48 @@ def place_roles(
     return out
 
 
+def plan_moves(
+    current: Dict[str, Dict[str, int]],
+    target: Dict[str, Dict[str, int]],
+) -> List[Tuple[str, str, str, int]]:
+    """Diff two placements (role -> {cell: count}, the
+    :func:`place_roles` shape) into cross-cell MOVE orders — a PURE
+    plan (ISSUE 17): deterministic under re-runs, no clock, no I/O.
+
+    Returns ``[(role, src_cell, dst_cell, n)]``: for each role, cells
+    holding more than the target lend to cells holding less, matched
+    greedily in sorted cell order so the same diff always yields the
+    same orders.  The ``"!unplaced"`` pseudo-cell is never a source or
+    destination — capacity that does not exist cannot move; a target
+    that shrank a role globally produces no order either (the cell's
+    own reconciler shrinks in place, no hop needed)."""
+    moves: List[Tuple[str, str, str, int]] = []
+    for role in sorted(set(current) | set(target)):
+        cur = {c: int(n) for c, n in (current.get(role) or {}).items()
+               if c != "!unplaced" and int(n) > 0}
+        tgt = {c: int(n) for c, n in (target.get(role) or {}).items()
+               if c != "!unplaced" and int(n) > 0}
+        surplus: List[List[Any]] = []
+        deficit: List[List[Any]] = []
+        for cell in sorted(set(cur) | set(tgt)):
+            d = cur.get(cell, 0) - tgt.get(cell, 0)
+            if d > 0:
+                surplus.append([cell, d])
+            elif d < 0:
+                deficit.append([cell, -d])
+        si = di = 0
+        while si < len(surplus) and di < len(deficit):
+            n = min(surplus[si][1], deficit[di][1])
+            moves.append((role, surplus[si][0], deficit[di][0], n))
+            surplus[si][1] -= n
+            deficit[di][1] -= n
+            if surplus[si][1] == 0:
+                si += 1
+            if deficit[di][1] == 0:
+                di += 1
+    return moves
+
+
 #: Every federation counter is exported as a gauge (graftcheck MT601).
 FEDERATION_COUNTER_NAMES = (
     "cell_snapshot_fetches",
@@ -249,6 +291,12 @@ class FederationTier:
         self._prev_splits: set = set()
         self._epoch = 0
         self._last_plan: Optional[Dict[str, Dict[str, int]]] = None
+        #: True once the last placement push was adopted by EVERY live
+        #: cell — the no-op guard's memory.  The TTL-cached fleet view
+        #: can lag a push by up to ``refresh_s``; judging "settled"
+        #: from stale epochs alone re-pushed an UNCHANGED plan (epoch
+        #: bump + one journal record per cell) every interval.
+        self._last_push_ok = False
         self.demands = dict(demands or {})
         self.counters = CounterSet()
         for name in FEDERATION_COUNTER_NAMES:
@@ -369,12 +417,18 @@ class FederationTier:
             ) and len(view.get("placement_epochs", {})) == len(
                 view.get("registry", {})
             )
-            if plan == self._last_plan and settled and self._epoch > 0:
+            if plan == self._last_plan and self._epoch > 0 and (
+                    settled or self._last_push_ok):
                 # Nothing moved and every cell already adopted the
                 # current epoch: re-pushing would bump epochs forever
                 # and spam one journal record per cell per interval.
+                # ``_last_push_ok`` covers the stale-view window: the
+                # TTL-cached view may still carry pre-push epochs, but
+                # a push every cell acked needs no retry — an unchanged
+                # merged snapshot must be a NO-OP.
                 return {}
             self._last_plan = plan
+            self._last_push_ok = False
         with self._mu:
             self._epoch = max(
                 self._epoch + 1,
@@ -412,6 +466,8 @@ class FederationTier:
                 "cell_placement_pushes" if ok
                 else "cell_placement_rejected"
             )
+        with self._mu:
+            self._last_push_ok = bool(results) and all(results.values())
         journal("cells.placement", epoch=epoch,
                 cells={c: ok for c, ok in results.items()},
                 roles=sorted(plan))
@@ -435,6 +491,32 @@ class FederationTier:
 
     def borrow_signal_fn(self, role: str) -> Callable[[], Dict[str, Any]]:
         return lambda: self.borrow_signal(role)
+
+    def lending_hold(self) -> bool:
+        """True while any REGISTERED cell is unreachable (a blackout in
+        progress: leased entry, no snapshot): surviving cells freeze
+        chip LOANS while they absorb the dead cell's spillover — wired
+        as ``ChipBorrowArbiter``'s ``hold_fn`` (ISSUE 17)."""
+        view = self.fleet_view()
+        return len(view.get("cells", {})) < len(view.get("registry", {}))
+
+    def lending_hold_fn(self) -> Callable[[], bool]:
+        return self.lending_hold
+
+    def plan_cell_moves(self, view: Optional[Dict[str, Any]] = None
+                        ) -> List[Tuple[str, str, str, int]]:
+        """Cross-cell move orders for the CURRENT fleet (ISSUE 17):
+        diff what each cell reports it is running (its snapshot's
+        ``placement``) against :meth:`plan_placement`'s target.  The
+        orders actuate through ``fleet.CrossCellMover`` — drain-first
+        both ways, restart ladder on any mid-move failure."""
+        view = view or self.fleet_view()
+        current: Dict[str, Dict[str, int]] = {}
+        for cid, snap in (view.get("cells") or {}).items():
+            for role, n in (snap.get("placement") or {}).items():
+                if int(n) > 0:
+                    current.setdefault(role, {})[cid] = int(n)
+        return plan_moves(current, self.plan_placement(view))
 
     def pick_lender_cell(self, role: str = "training") -> Optional[str]:
         """The cell with the most ``role`` members — where a cross-cell

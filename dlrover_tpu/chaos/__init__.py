@@ -9,6 +9,7 @@ from dlrover_tpu.chaos.plan import (  # noqa: F401
     ENV_VAR,
     EXIT_CKPT_AFTER_COMMIT,
     EXIT_CKPT_BEFORE_COMMIT,
+    EXIT_CELL_BLACKOUT,
     EXIT_CELL_MASTER_KILL,
     EXIT_JOURNAL_TORN,
     EXIT_MASTER_KILL,

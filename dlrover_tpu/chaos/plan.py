@@ -67,6 +67,7 @@ EXIT_DRAFT_KILL = 82
 EXIT_MASTER_KILL = 83
 EXIT_JOURNAL_TORN = 84
 EXIT_CELL_MASTER_KILL = 85
+EXIT_CELL_BLACKOUT = 86
 
 #: site name -> (kind, defaults).  Kinds: ``error`` (caller raises),
 #: ``latency`` (inject() sleeps), ``crash`` (inject() calls os._exit),
@@ -234,6 +235,19 @@ SITES: Dict[str, dict] = {
                "(`method=<cell_id>`) — the federation sees two owners "
                "for one node range (`cell_split_detected`); views "
                "self-heal on the next beat",
+    },
+    # Correlated whole-cell failure (ISSUE 17): the unit of failure is
+    # an entire cell — master, warm standby, and every gateway/replica
+    # in it die as ONE event.  Admitted in-flight requests must still
+    # complete exactly once via sibling-cell spillover.
+    "cell.blackout": {
+        "kind": "crash", "exit": EXIT_CELL_BLACKOUT, "times": 1,
+        "doc": "`os._exit(86)` kills one WHOLE cell as a single event "
+               "(`method=<cell_id>`): the cell master and every "
+               "gateway of that cell fire this site from their "
+               "heartbeats, so the cell is gone within one beat — no "
+               "standby takeover; in-flight requests complete exactly "
+               "once by spilling to a sibling cell",
     },
     # Scale-out checkpoint site (ISSUE 7): a rank dies after streaming
     # its slice bytes but BEFORE the atomic publish + done-vote.

@@ -705,8 +705,19 @@ class ServeSubmit(Message):
     #: (or an unsampled request's grant) encodes byte-identically to
     #: the pre-trace wire, keeping the msgpack fast path intact.
     trace: dict = dataclasses.field(default_factory=dict)
+    #: Cross-cell spillover (ISSUE 17).  A saturated/dying cell's
+    #: gateway forwards the submit to a sibling cell UNDER THE SAME
+    #: req_id — the hop rides the existing req_id-keyed dedupe/journal
+    #: contracts, so killing either side mid-hop still completes the
+    #: request exactly once.  ``spill_from`` names the origin cell;
+    #: ``spill_hops`` counts forwards so depth stays bounded (a request
+    #: never ping-pongs between two saturated cells).  Both are
+    #: wire-optional: a local submit encodes byte-identically to the
+    #: pre-spillover wire.
+    spill_from: str = ""
+    spill_hops: int = 0
 
-    _WIRE_OPTIONAL = frozenset({"trace"})
+    _WIRE_OPTIONAL = frozenset({"trace", "spill_from", "spill_hops"})
 
 
 @dataclasses.dataclass

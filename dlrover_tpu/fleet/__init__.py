@@ -31,6 +31,8 @@ from dlrover_tpu.fleet.manager import (  # noqa: F401
 from dlrover_tpu.fleet.policy import (  # noqa: F401
     BorrowPolicy,
     ChipBorrowArbiter,
+    CrossCellMover,
+    MovePolicy,
 )
 from dlrover_tpu.fleet.registry import (  # noqa: F401
     register_role_family,

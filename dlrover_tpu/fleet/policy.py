@@ -82,11 +82,18 @@ class ChipBorrowArbiter:
         signal_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         gain_fn: Optional[Callable[[], float]] = None,
         scope: str = "",
+        hold_fn: Optional[Callable[[], bool]] = None,
     ):
         self.lender = lender
         self.borrower = borrower
         self.policy = policy or BorrowPolicy()
         self._signal_fn = signal_fn
+        #: Fleet-level freeze (ISSUE 17): while ``hold_fn`` returns
+        #: True no NEW borrow begins (in-flight phases still pump to
+        #: completion).  A federation wires this to its blackout view —
+        #: a surviving cell absorbing a dead sibling's spillover must
+        #: not simultaneously lend its serving chips away.
+        self._hold_fn = hold_fn
         #: Cell scope (ISSUE 15): which cell this arbiter actuates in.
         #: A cell-aware loan path wires ``signal_fn`` to the federation
         #: (``FederationTier.borrow_signal_fn``) so the DECISION sees
@@ -107,6 +114,16 @@ class ChipBorrowArbiter:
         self.events: List[tuple] = []
 
     # -- signals ------------------------------------------------------------
+
+    def _held(self) -> bool:
+        if self._hold_fn is None:
+            return False
+        try:
+            return bool(self._hold_fn())
+        except Exception:  # noqa: BLE001 - a broken freeze signal must
+            # fail SAFE (hold): lending into an unknown fleet state is
+            # the risky direction.
+            return True
 
     def _signals(self) -> Dict[str, Any]:
         if self._signal_fn is not None:
@@ -157,7 +174,9 @@ class ChipBorrowArbiter:
             self._decay_streak = 0
 
         if self.phase == IDLE:
-            if self._cooldown > 0:
+            if self._held():
+                pass  # frozen: a sibling-cell emergency outranks loans
+            elif self._cooldown > 0:
                 self._cooldown -= 1
             elif (
                 self._spike_streak >= self.policy.spike_patience
@@ -237,4 +256,191 @@ class ChipBorrowArbiter:
             "phase": self.phase,
             "borrowed": self.borrowed,
             "cell": self.scope,
+            "held": self._held(),
+        }
+
+
+# -- cross-cell chip MOVES (ISSUE 17) ---------------------------------------
+
+MOVE_IDLE = "idle"
+MOVE_DRAINING = "draining"   # source cell draining (reshard epoch)
+
+
+@dataclasses.dataclass
+class MovePolicy:
+    #: Passes a source drain may take before the move is ABORTED to
+    #: the restart ladder (a stuck reshard must not wedge the fleet).
+    drain_budget_passes: int = 20
+    #: Passes to sit idle after a completed or laddered move —
+    #: consecutive moves stay serialized and spaced (the ElasWave
+    #: bounded-disruption argument: one reshard wave at a time).
+    cooldown_passes: int = 2
+    #: Total moves this mover may actuate (0 = unbounded).
+    max_moves: int = 0
+
+
+class CrossCellMover:
+    """Actuates federation cross-cell MOVE orders — the PR-15
+    remainder: a ``place_roles`` decision finally moves workers
+    BETWEEN cells instead of only describing where they should be.
+
+    ``orders_fn`` returns the current move orders (``[(role, src_cell,
+    dst_cell, n)]`` — ``FederationTier.plan_cell_moves``); ``cells``
+    maps cell_id -> {role: RoleAdapter} (each cell's own adapters,
+    pumped by that cell's FleetManager).  One move is in flight at a
+    time, drain-first BOTH ways:
+
+    - the SOURCE cell drains first (``lend_one`` — for training this
+      is the PR-6/10 two-phase resize through a reshard epoch; for
+      serving, the gateway drain protocol), so the chip is genuinely
+      free before anything crosses the boundary;
+    - only after the source drain completes does the DESTINATION cell
+      grow (``grow_one`` — itself confirmed by the destination role's
+      own reconcile/spawn-grace machinery).
+
+    Any mid-move failure — the source drain stuck past
+    ``drain_budget_passes``, the destination refusing the grow — falls
+    back to the RESTART LADDER: ``reclaim_one`` at the source
+    re-establishes the pre-move placement through the proven
+    checkpoint-restart path, and the event is journaled with
+    ``ladder=True``.  Like :class:`ChipBorrowArbiter`, every decision
+    is a function of the adapters' observed signals and the scripted
+    pass sequence — no ambient clock, randomness, or I/O reachable
+    from ``step`` (sim-bound, graftcheck DET70x)."""
+
+    def __init__(
+        self,
+        orders_fn: Callable[[], List[tuple]],
+        cells: Dict[str, Dict[str, RoleAdapter]],
+        policy: Optional[MovePolicy] = None,
+    ):
+        self._orders_fn = orders_fn
+        self._cells = cells
+        self.policy = policy or MovePolicy()
+        self.phase = MOVE_IDLE
+        #: The in-flight order, (role, src_cell, dst_cell).
+        self.current: Optional[tuple] = None
+        self._drain_passes = 0
+        self._cooldown = 0
+        self.moved = 0
+        self.laddered = 0
+        #: Audit trail: (phase_from, phase_to, reason) transitions.
+        self.events: List[tuple] = []
+
+    def _adapter(self, cell: str, role: str) -> Optional[RoleAdapter]:
+        return (self._cells.get(cell) or {}).get(role)
+
+    # -- the pass ------------------------------------------------------------
+
+    def step(self, fleet=None) -> str:
+        if self.phase == MOVE_IDLE:
+            if self._cooldown > 0:
+                self._cooldown -= 1
+                return self.phase
+            if self.policy.max_moves and self.moved >= self.policy.max_moves:
+                return self.phase
+            try:
+                orders = list(self._orders_fn() or [])
+            except Exception as e:  # noqa: BLE001 - federation read may
+                # race a dying cell; a missed pass beats a wedged mover
+                logger.warning("fleet move: orders fetch failed: %s", e)
+                return self.phase
+            for role, src, dst, n in orders:
+                src_a = self._adapter(src, role)
+                dst_a = self._adapter(dst, role)
+                if src_a is None or dst_a is None:
+                    continue
+                if dst_a.spec.desired >= dst_a.spec.max_count:
+                    continue
+                if not src_a.can_lend():
+                    continue
+                if src_a.lend_one():
+                    self.current = (role, src, dst)
+                    self._drain_passes = 0
+                    self._move(
+                        MOVE_DRAINING,
+                        f"order {role}: {src} -> {dst} (want {n}); "
+                        f"source draining",
+                    )
+                    break
+            return self.phase
+        # MOVE_DRAINING: one order in flight.
+        role, src, dst = self.current
+        src_a = self._adapter(src, role)
+        dst_a = self._adapter(dst, role)
+        if src_a is None or dst_a is None:
+            # A cell vanished mid-move (blackout): nothing to reclaim
+            # against — the restart ladder inside the surviving cell's
+            # own reconciler recovers its membership.
+            self.laddered += 1
+            self._cooldown = self.policy.cooldown_passes
+            self._finish(f"cell vanished mid-move ({src} -> {dst})",
+                         ladder=True)
+            return self.phase
+        src_a.pump_drain()
+        self._drain_passes += 1
+        if src_a.lend_pending():
+            if self._drain_passes > self.policy.drain_budget_passes:
+                # Stuck reshard/drain: ABORT to the restart ladder —
+                # reclaim the unit at the source; its proven
+                # checkpoint-restart path re-establishes the pre-move
+                # placement.
+                src_a.reclaim_one()
+                self.laddered += 1
+                self._cooldown = self.policy.cooldown_passes
+                self._finish(
+                    f"source drain stuck after {self._drain_passes} "
+                    f"passes; restart ladder reclaimed at {src}",
+                    ladder=True,
+                )
+            return self.phase
+        # The source drain completed: the chip is free — only NOW does
+        # the destination cell grow onto it.
+        if not dst_a.grow_one():
+            src_a.reclaim_one()
+            self.laddered += 1
+            self._cooldown = self.policy.cooldown_passes
+            self._finish(
+                f"destination {dst} refused the grow (at max?); "
+                f"restart ladder reclaimed at {src}",
+                ladder=True,
+            )
+            return self.phase
+        # The unit left the source cell for GOOD: release its on-loan
+        # hold so the source's ordinary policy resumes post-move.
+        src_a.confirm_departure()
+        self.moved += 1
+        self._cooldown = self.policy.cooldown_passes
+        self._finish(f"move complete: one {role} unit {src} -> {dst}")
+        return self.phase
+
+    def _finish(self, reason: str, ladder: bool = False) -> None:
+        self._move(MOVE_IDLE, reason, ladder=ladder)
+        self.current = None
+        self._drain_passes = 0
+
+    def _move(self, phase: str, reason: str,
+              ladder: bool = False) -> None:
+        role, src, dst = self.current or ("", "", "")
+        logger.info(
+            "fleet move [%s: %s->%s] %s -> %s: %s",
+            role, src, dst, self.phase, phase, reason,
+        )
+        self.events.append((self.phase, phase, reason))
+        # Cross-cell moves are the most operator-visible decisions the
+        # federation makes: every transition is a flight-recorder
+        # entry, ladder fallbacks flagged.
+        journal("fleet.move", role=role, src=src, dst=dst,
+                phase_from=self.phase, phase_to=phase, reason=reason,
+                moved=self.moved, ladder=ladder)
+        self.phase = phase
+
+    def describe(self) -> Dict[str, Any]:
+        role, src, dst = self.current or ("", "", "")
+        return {
+            "policy": "cross_cell_move",
+            "phase": self.phase,
+            "role": role, "src": src, "dst": dst,
+            "moved": self.moved,
+            "laddered": self.laddered,
         }

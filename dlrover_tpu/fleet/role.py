@@ -163,6 +163,13 @@ class RoleAdapter:
         """Take a previously lent unit back (the hand-back direction)."""
         return self.grow_one()
 
+    def confirm_departure(self) -> None:
+        """A lent unit left PERMANENTLY (a cross-cell move, ISSUE 17):
+        unlike a loan there is no hand-back to wait for — the role
+        stops treating the unit as on-loan and its ordinary policy
+        resumes at the new, smaller desired count.  Default: no-op
+        (roles without loan bookkeeping have nothing to release)."""
+
     # -- desired-count movements ------------------------------------------
 
     def grow_one(self) -> bool:

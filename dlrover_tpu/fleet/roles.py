@@ -136,6 +136,14 @@ class TrainingRole(RoleAdapter):
         self._job_manager.scale_workers_to(self.spec.desired)
         return True
 
+    def confirm_departure(self) -> None:
+        """The lent unit moved to another CELL for good (ISSUE 17):
+        drop the on-loan hold so :meth:`reconcile` resumes the
+        ordinary policy at the post-move size — a permanent move must
+        not freeze the source cell's autoscaling forever."""
+        if self.lent > 0:
+            self.lent -= 1
+
 
 class ServingReplicaRole(RoleAdapter):
     """Serving replicas as a fleet role.

@@ -52,6 +52,11 @@ class ReshardOutcome:
     moved_cross_mb: float = 0.0
     segments: int = 0
     reason: str = ""
+    #: What drove this epoch (ISSUE 17): "" for an ordinary elastic
+    #: resize, "cell:<src>-><dst>" when the epoch is the source-side
+    #: drain of a cross-cell chip move — the postmortem attributes the
+    #: wave to the federation decision instead of a mystery resize.
+    scope: str = ""
 
     @property
     def moved_mb(self) -> float:
@@ -200,6 +205,7 @@ def reshard_state(
     specs: Any = None,
     *,
     epoch: int = -1,
+    scope: str = "",
 ) -> Any:
     """In-process live reshard of a whole sharded state onto
     ``target_mesh`` — quiesce, snapshot host shards, plan, move, rebuild.
@@ -234,5 +240,6 @@ def reshard_state(
         moved_local_mb=stats["local_bytes"] / (1 << 20),
         moved_cross_mb=stats["cross_bytes"] / (1 << 20),
         segments=stats["segments"],
+        scope=scope,
     )
     return new_state, outcome

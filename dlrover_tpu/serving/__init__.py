@@ -74,6 +74,14 @@ from dlrover_tpu.serving.replica import (  # noqa: F401
     ReplicaRunner,
     prefix_fingerprint,
 )
+from dlrover_tpu.serving.spillover import (  # noqa: F401
+    CellSpillRouter,
+    GlobalClient,
+    SpillDecision,
+    SpilloverConfig,
+    SpilloverPolicy,
+    merge_global_snapshots,
+)
 from dlrover_tpu.serving.tier import (  # noqa: F401
     GatewayTierNode,
     HashRing,

@@ -300,6 +300,16 @@ class GatewayCore:
             # request is one or the other; a drop is counted, never
             # silent.
             "trace_sampled", "trace_unsampled",
+            # Cross-cell spillover (ISSUE 17).  spill_forwarded: submits
+            # this cell forwarded to a sibling instead of queueing;
+            # spill_ingress: submits RECEIVED with a hop mark
+            # (spill_hops>0) — global merges subtract it from the
+            # summed `submitted` so a forwarded request counts once;
+            # spill_rebuffed: hop-marked submits this cell had to
+            # reject (both cells saturated); spill_adopted: sibling
+            # terminals folded into the local dedupe cache.
+            "spill_forwarded", "spill_ingress", "spill_rebuffed",
+            "spill_adopted",
         ):
             self._counters.inc(name, 0)
         self._last_sweep = float("-inf")
@@ -321,7 +331,8 @@ class GatewayCore:
     def submit(self, req_id: str, prompt: List[int],
                max_new_tokens: int, deadline_s: float = 0.0,
                prefix_len: int = 0, prefix_fp: str = "",
-               trace: Optional[dict] = None) -> ServeAck:
+               trace: Optional[dict] = None,
+               spill_hops: int = 0) -> ServeAck:
         now = self._clock()
         if not req_id:
             # BoundedTokenCache treats "" as no-token: the completion
@@ -331,6 +342,12 @@ class GatewayCore:
                             reason="empty req_id")
         with self._mu:
             self._counters.inc("submitted")
+            if spill_hops > 0:
+                # Cross-cell hop mark (ISSUE 17): the origin cell
+                # already counted this req_id as submitted when it
+                # forwarded — merged GLOBAL stats subtract ingress
+                # from the summed `submitted` to dedupe the hop.
+                self._counters.inc("spill_ingress")
             hit = self._done.get(req_id)
             if hit is not None:
                 # Idempotent resubmit of a request with a TERMINAL
@@ -354,6 +371,10 @@ class GatewayCore:
             in_flight = len(self._by_id)
             if in_flight >= self.cfg.queue_cap:
                 self._counters.inc("rejected")
+                if spill_hops > 0:
+                    # Both cells saturated: the forwarded request is
+                    # rebuffed back to the origin's own reject path.
+                    self._counters.inc("spill_rebuffed")
                 return ServeAck(
                     req_id=req_id, status="rejected",
                     retry_after_s=self.cfg.retry_after_s,
@@ -392,6 +413,75 @@ class GatewayCore:
                     tokens=list(req.partial), replica=req.assigned_to,
                 )
             return ServeStatusReply(req_id=req_id, state="queued")
+
+    # -- cross-cell spillover surface (ISSUE 17) --------------------------
+
+    def peek_admission(self, req_id: str) -> str:
+        """What :meth:`submit` would do RIGHT NOW, without counting or
+        admitting anything: ``terminal`` (dedupe cache answers),
+        ``duplicate`` (already in flight here), ``full`` (queue cap —
+        the spillover trigger), or ``admit``.  The router probes this
+        BEFORE local admission so a forwarded request never pollutes
+        the origin's queue, counters, or latency histograms."""
+        with self._mu:
+            if not req_id:
+                return "admit"  # submit() fails it with a reason
+            if self._done.get(req_id) is not None:
+                return "terminal"
+            if req_id in self._by_id:
+                return "duplicate"
+            if len(self._by_id) >= self.cfg.queue_cap:
+                return "full"
+            return "admit"
+
+    def pressure(self) -> Dict[str, Any]:
+        """Cheap admission-pressure read for the spillover policy —
+        the handful of fields a forward decision needs, without the
+        full :meth:`stats_snapshot` pool walk."""
+        with self._mu:
+            alive = [r for r in self._replicas.values()
+                     if not r.draining]
+            slots = sum(r.slots for r in alive)
+            assigned = sum(len(r.assigned) for r in alive)
+            return {
+                "in_flight": len(self._by_id),
+                "queue_cap": self.cfg.queue_cap,
+                "occupancy": assigned / slots if slots else 0.0,
+                "replicas_alive": len(alive),
+            }
+
+    def adopt_terminal(self, req_id: str, state: str,
+                       tokens: List[int], replica: str = "",
+                       reason: str = "") -> str:
+        """Fold a terminal outcome owned by a SIBLING cell into this
+        cell's dedupe cache: once a spilled request finishes remotely,
+        a resubmit HERE answers byte-identical without another hop.
+        Counts ``spill_adopted`` — never ``completed``/``failed``; the
+        decode happened (and was counted) in the cell that served it."""
+        if not req_id or state not in ("done", "failed", "timeout"):
+            return "ignored"
+        with self._mu:
+            if self._done.get(req_id) is not None:
+                return "duplicate"
+            req = self._by_id.get(req_id)
+            if req is not None:
+                # A local copy raced the hop (client resubmitted while
+                # the sibling was already serving it): the sibling owns
+                # the terminal — release the local copy un-decoded.
+                self._detach_locked(req)
+            self._done.put(req_id, {
+                "state": state, "tokens": [int(t) for t in tokens],
+                "replica": replica, "reason": reason,
+            })
+            self._counters.inc("spill_adopted")
+            return "adopted"
+
+    def fold_external(self, name: str, n: int = 1) -> None:
+        """Spillover-router hook: count an admission event that
+        happened OUTSIDE :meth:`submit` — e.g. a submit this cell
+        forwarded without locally queueing — so per-cell snapshots
+        stay complete."""
+        self._counters.inc(name, n)
 
     # -- replica surface --------------------------------------------------
 
@@ -1217,6 +1307,10 @@ class Gateway:
             # (gw_service_us_measured vs the modeled gw_service_us).
             "rpc_calls": self._server.calls,
         }
+        #: Optional :class:`serving.spillover.CellSpillRouter` — when
+        #: attached, ServeSubmit/ServeStatusRequest dispatch through it
+        #: so a saturated cell forwards admission to a sibling cell.
+        self.spill_router = None
         if metrics_registry is not None:
             self.register_gauges(metrics_registry)
         self._sweep_interval = sweep_interval
@@ -1279,7 +1373,9 @@ class Gateway:
                      "kv_relay_fallbacks",
                      "spec_rounds", "spec_accepted", "spec_fallbacks",
                      "spec_grants", "spec_bypass",
-                     "trace_sampled", "trace_unsampled"):
+                     "trace_sampled", "trace_unsampled",
+                     "spill_forwarded", "spill_ingress",
+                     "spill_rebuffed", "spill_adopted"):
             registry.gauge(f"serve_{name}", _counter_gauge(name))
 
         def _pool_gauge(role, key):
@@ -1298,11 +1394,19 @@ class Gateway:
     def handle(self, msg: Message) -> Optional[Message]:
         core = self.core
         if isinstance(msg, ServeSubmit):
+            if self.spill_router is not None:
+                # Cross-cell spillover (ISSUE 17): the router decides
+                # local-vs-forward; a hop-marked submit (spill_hops>0)
+                # arriving FROM a sibling always lands locally — the
+                # router's depth bound keeps it from bouncing back.
+                return self.spill_router.submit(msg)
             return core.submit(msg.req_id, msg.prompt,
                                msg.max_new_tokens, msg.deadline_s,
                                msg.prefix_len, msg.prefix_fp,
-                               msg.trace)
+                               msg.trace, spill_hops=msg.spill_hops)
         if isinstance(msg, ServeStatusRequest):
+            if self.spill_router is not None:
+                return self.spill_router.status(msg.req_id)
             return core.status(msg.req_id)
         if isinstance(msg, ServeReplicaRegister):
             core.register(msg.replica_id, msg.slots, msg.role,

@@ -396,10 +396,15 @@ class GatewayTierNode:
                  config: Optional[GatewayConfig] = None,
                  heartbeat_s: float = 1.0, addr: Optional[str] = None,
                  metrics_port: Optional[int] = None,
-                 **gateway_kw):
+                 cell_id: str = "", **gateway_kw):
         from dlrover_tpu.common.rpc import local_ip
 
         self.gateway_id = gateway_id
+        #: Which cell this gateway belongs to ("" = single-cell tier).
+        #: Lets the ``cell.blackout`` chaos site select this process by
+        #: CELL, so one fault spec takes the master and every gateway
+        #: of the same cell down together (ISSUE 17).
+        self.cell_id = cell_id
         self.registry = registry
         self.gateway = Gateway(port=port, config=config, **gateway_kw)
         # ONE clock with the wrapped gateway (graftcheck DET701): the
@@ -537,6 +542,18 @@ class GatewayTierNode:
                 "serving.gateway_kill", method=self.gateway_id,
                 step=self.gateway.core.counters.get("completed", 0),
             )
+            if self.cell_id:
+                # Whole-cell blackout (ISSUE 17): the same single
+                # fault spec that kills this cell's master also takes
+                # its gateways down — method selects by CELL, step by
+                # this gateway's completion count so the blackout
+                # lands deterministically MID-STREAM.
+                chaos.inject(
+                    "cell.blackout", method=self.cell_id,
+                    step=self.gateway.core.counters.get(
+                        "completed", 0
+                    ),
+                )
             try:
                 self.registry.announce_gateway(
                     self.gateway_id, self.addr
@@ -811,6 +828,24 @@ class TierClient:
         # under a fresh id.
         self._forget(req_id)
         return last
+
+    def call(self, msg, deadline: float = 10.0):
+        """Owner-route one RAW admission message (ServeSubmit /
+        ServeStatusRequest) — the cross-cell spillover door (ISSUE
+        17).  A forwarded submit must keep its ``spill_from`` /
+        ``spill_hops`` marks and its original trace context, which
+        the kwarg surface of :meth:`submit` would rebuild without;
+        routing it raw also hands the forward the sibling cell's own
+        ring routing and gateway failover."""
+        req_id = getattr(msg, "req_id", "")
+        gid, tr = self._owner_transport(req_id)
+        if tr is None:
+            raise RuntimeError("no live gateway")
+        try:
+            return tr.call(msg, deadline=deadline)
+        except Exception:
+            self._set.drop(gid)
+            raise
 
     def status(self, req_id: str) -> ServeStatusReply:
         gid, tr = self._owner_transport(req_id)

@@ -368,6 +368,16 @@ def test_load_bench_smoke_schema(tmp_path):
     for t in traces.values():
         for ph in t["phases"].values():
             assert ph["count"] > 0
+    # Regional skew (ISSUE 17): the seeded Zipf-over-cells row routes
+    # by HOME cell (gateway 0 hot) — the hot shard must carry the
+    # majority the Zipf weights dictate.
+    skew = result["skew"]
+    assert skew["trace"] == "zipf_cells"
+    assert skew["submitted"] == skew["accepted"] + skew["rejected"] \
+        + skew["wire_dropped"]
+    hot = skew["phases"]["hot-cell"]["count"]
+    cold = skew["phases"]["cold-cell"]["count"]
+    assert hot > cold > 0
     # Admission profile + the serialization fast path it justifies.
     prof = result["admission_profile"]
     assert prof["messages"] > 0
@@ -594,4 +604,72 @@ def test_cell_bench_smoke_schema(tmp_path):
     metric = json.loads(proc.stdout.strip().splitlines()[-1])
     assert metric["metric"] == "cell_control_plane_ops_per_s"
     assert metric["value"] == by_cells[2]["ops_per_s"]
+    assert metric["artifact"] == str(out)
+
+
+def test_global_bench_smoke_schema(tmp_path):
+    """Tier-1 gate for ISSUE 17's global data-plane bench: the smoke
+    config (2 in-process cells, the blackout row pair on the SAME
+    seeded Zipf-over-cells trace) runs end-to-end inside the budget
+    and emits schema-valid JSON — conservation ACROSS the spillover
+    hop (merge_global_snapshots' submitted_unique dedupe), the
+    blackout row present with the hot cell's stranded work counted,
+    and the spillover-vs-static verdict asserted."""
+    import os
+    import subprocess
+    import time
+
+    out = tmp_path / "GLOBAL_BENCH_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DLROVER_TPU_FAULTS", None)
+    env.pop("DLROVER_TPU_MASTER_STATE_DIR", None)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(Path(bench.__file__)), "--global_bench",
+         "--smoke", f"--out={out}"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(Path(bench.__file__).parent),
+    )
+    elapsed = time.time() - t0
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert elapsed < 60.0, f"smoke global bench took {elapsed:.1f}s"
+    result = json.loads(out.read_text())
+    assert result["bench"] == "global_serve"
+    assert result["complete"] is True
+    assert result["smoke"] is True
+    rows = {(r["mode"], r["blackout"]) for r in result["rows"]}
+    assert rows == {("static", True), ("spillover", True)}
+    for row in result["rows"]:
+        # Conservation across the hop: every arrival is accounted —
+        # deduped gateway-level submission, wire shed, or lost to the
+        # blackout — and every accepted request reached a terminal
+        # state or is counted stranded in the dead cell.
+        assert row["conservation_ok"] is True
+        assert row["arrivals"] == row["submitted_unique"] \
+            + row["wire_dropped"] + row["blackout_lost"] \
+            + row["blackout_dropped"]
+        assert row["accepted"] == row["completed"] + row["timeout"] \
+            + row["failed"] + row["stranded"]
+        assert row["spill_forwarded"] == row["spill_ingress"] \
+            + row["spill_rebuffed"]
+        assert row["hot_share"] > 0.5  # cell 0 IS hot under the Zipf
+    by_mode = {r["mode"]: r for r in result["rows"]}
+    # Static partitioning loses every post-blackout arrival homed at
+    # the dead cell; the spillover row re-homes them all.
+    assert by_mode["static"]["blackout_lost"] > 0
+    assert by_mode["spillover"]["blackout_lost"] == 0
+    assert by_mode["spillover"]["spill_forwarded"] > 0
+    assert by_mode["spillover"]["moved_replicas"] > 0
+    # The verdict: the cross-cell data plane strictly beats static
+    # cell partitioning on SLO goodput under skew + whole-cell death.
+    verdicts = result["verdicts"]
+    assert verdicts["spillover_beats_static_blackout"] is True
+    assert verdicts["hop_conserved"] is True
+    assert verdicts["spill_forwarded_nonzero"] is True
+    assert by_mode["spillover"]["goodput_rps"] > \
+        by_mode["static"]["goodput_rps"]
+    metric = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert metric["metric"] == "global_slo_goodput_under_blackout"
+    assert metric["value"] == by_mode["spillover"]["goodput_rps"]
+    assert metric["speedup"] > 1.0
     assert metric["artifact"] == str(out)

@@ -26,6 +26,7 @@ from dlrover_tpu.cells import (  # noqa: E402
     merge_cell_snapshots,
     node_key,
     place_roles,
+    plan_moves,
 )
 from dlrover_tpu.common import messages as m  # noqa: E402
 from dlrover_tpu.common.hashring import HashRing, ring_hash  # noqa: E402
@@ -364,7 +365,7 @@ class _Loopback:
 
 
 class TestFederationTier:
-    def _fleet(self, n=2, lease_s=30.0):
+    def _fleet(self, n=2, lease_s=30.0, refresh_s=0.0):
         kv = LocalKv()
         masters = {}
         addr_to = {}
@@ -384,7 +385,7 @@ class TestFederationTier:
         tier = FederationTier(
             CellRegistry(kv, job="j", lease_s=lease_s),
             connect=lambda addr: _Loopback(addr_to[addr]),
-            refresh_s=0.0,
+            refresh_s=refresh_s,
             demands={"training": 2, "serving": 2, "gateway": 2},
         )
         return kv, masters, tier
@@ -484,6 +485,65 @@ class TestFederationTier:
         assert set(view["registry"]) == {"c0"}
         assert view["cells_alive"] == 1
 
+    def test_push_placement_noop_on_stale_cached_view(self):
+        """ISSUE 17 satellite: an unchanged plan must not re-push just
+        because the TTL-cached view has not observed the cells adopt
+        the epoch yet — before the fix the federation loop re-pushed
+        the identical plan every interval, bumping epochs and writing
+        one journal record per cell forever."""
+        _kv, masters, tier = self._fleet(refresh_s=3600.0)
+        assert tier.push_placement() == {"c0": True, "c1": True}
+        # The cached view still carries pre-adoption epochs (its TTL
+        # is an hour away) -- the push memory must carry the no-op.
+        assert tier.push_placement() == {}
+        assert tier.push_placement() == {}
+        for _cid, (master, _hb) in masters.items():
+            assert master.cell_manager.placement_epoch == 1
+        # A real demand change still pushes, bumping the epoch once.
+        tier.demands["gateway"] = 4
+        assert tier.push_placement() == {"c0": True, "c1": True}
+        for _cid, (master, _hb) in masters.items():
+            assert master.cell_manager.placement_epoch == 2
+
+    def test_plan_cell_moves_diffs_running_against_target(self):
+        _kv, masters, tier = self._fleet()
+        tier.push_placement()
+        for _cid, (_master, hb) in masters.items():
+            hb.beat_once()
+        view = tier.fleet_view(force=True)
+        # Settled fleet: what the cells run IS the target -> no orders.
+        assert tier.plan_cell_moves(view) == []
+        # Drift: c0 runs a serving unit the target places at c1.
+        view["cells"]["c0"]["placement"]["serving"] = 2
+        view["cells"]["c1"]["placement"]["serving"] = 0
+        orders = tier.plan_cell_moves(view)
+        assert ("serving", "c0", "c1", 1) in orders
+
+
+class TestPlanMoves:
+    def test_surplus_feeds_deficit_deterministically(self):
+        cur = {"training": {"a": 4, "b": 2}}
+        tgt = {"training": {"a": 3, "b": 3}}
+        assert plan_moves(cur, tgt) == [("training", "a", "b", 1)]
+        assert plan_moves(cur, tgt) == plan_moves(cur, tgt)
+
+    def test_settled_and_unplaced_produce_no_orders(self):
+        cur = {"t": {"a": 2, "b": 1}}
+        assert plan_moves(cur, cur) == []
+        # Capacity that does not exist cannot move.
+        assert plan_moves({"t": {"a": 2}},
+                          {"t": {"a": 1, "!unplaced": 1}}) == []
+
+    def test_global_shrink_is_in_place_not_a_hop(self):
+        # The cell's own reconciler shrinks in place; no hop needed.
+        assert plan_moves({"t": {"a": 2}}, {"t": {"a": 1}}) == []
+
+    def test_multi_cell_greedy_match_in_sorted_order(self):
+        cur = {"t": {"a": 3, "b": 0, "c": 0}}
+        tgt = {"t": {"a": 0, "b": 2, "c": 1}}
+        assert plan_moves(cur, tgt) == [("t", "a", "b", 2),
+                                        ("t", "a", "c", 1)]
+
 
 # ---------------------------------------------------------------------------
 # Chaos sites
@@ -514,6 +574,20 @@ class TestCellChaos:
         hb = CellHeartbeat("c0", reg, lambda: "h:1")
         hb.beat_once()
         assert exits == []  # wrong cell: never fires
+
+    def test_blackout_fires_in_master_heartbeat_with_exit_86(
+            self, monkeypatch):
+        exits = []
+        monkeypatch.setattr(os, "_exit",
+                            lambda code: exits.append(code))
+        chaos.configure("cell.blackout:method=c1")
+        reg = CellRegistry(LocalKv(), job="j")
+        hb0 = CellHeartbeat("c0", reg, lambda: "h:0")
+        hb0.beat_once()
+        assert exits == []  # method selects the CELL: c0 untouched
+        hb1 = CellHeartbeat("c1", reg, lambda: "h:1")
+        hb1.beat_once()
+        assert exits == [chaos.EXIT_CELL_BLACKOUT]
 
     def test_split_site_is_one_shot(self):
         chaos.configure("cell.split:method=c0")

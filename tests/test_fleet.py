@@ -27,9 +27,11 @@ from dlrover_tpu.common.constants import NodeType
 from dlrover_tpu.fleet import (
     BorrowPolicy,
     ChipBorrowArbiter,
+    CrossCellMover,
     EmbeddingRole,
     FleetManager,
     GatewayRole,
+    MovePolicy,
     RoleAdapter,
     RoleSpec,
     RoleStatus,
@@ -1122,3 +1124,168 @@ class TestGainModeArbiter:
         assert arb.describe()["mode"] == "queue"
         arb.step()
         assert arb.phase == "lending"
+
+    def test_hold_fn_freezes_new_loans_during_blackout(self):
+        """ISSUE 17: while a sibling cell is blacked out the surviving
+        cells absorb its spillover — their load signals SPIKE, but
+        lending a chip away mid-incident would shrink exactly the
+        capacity doing the absorbing."""
+        lender, borrower = self._pair()
+        borrower.signals = {"queue_depth": 100, "members_alive": 1}
+        hold = {"v": True}
+        arb = ChipBorrowArbiter(
+            lender, borrower,
+            BorrowPolicy(spike_patience=1, cooldown_passes=0),
+            hold_fn=lambda: hold["v"],
+        )
+        for _ in range(3):
+            arb.step()
+        assert arb.phase == "idle" and arb.borrowed == 0
+        assert arb.describe()["held"] is True
+        hold["v"] = False  # incident over: ordinary arbitration resumes
+        arb.step()
+        assert arb.phase == "lending"
+
+    def test_hold_fn_failure_is_fail_safe(self):
+        lender, borrower = self._pair()
+        borrower.signals = {"queue_depth": 100, "members_alive": 1}
+
+        def broken():
+            raise RuntimeError("federation unreachable")
+
+        arb = ChipBorrowArbiter(
+            lender, borrower,
+            BorrowPolicy(spike_patience=1, cooldown_passes=0),
+            hold_fn=broken,
+        )
+        arb.step()
+        assert arb.phase == "idle"  # unknown = frozen, never lends
+
+
+# ---------------------------------------------------------------------------
+# Cross-cell chip moves (ISSUE 17): CrossCellMover state machine
+# ---------------------------------------------------------------------------
+
+
+class MoveStubRole(StubRole):
+    """StubRole with controllable grow + departure bookkeeping."""
+
+    def __init__(self, *a, grow_ok=True, **kw):
+        super().__init__(*a, **kw)
+        self.grow_ok = grow_ok
+        self.departed = 0
+
+    def grow_one(self):
+        if not self.grow_ok:
+            return False
+        return super().grow_one()
+
+    def confirm_departure(self):
+        self.departed += 1
+
+
+class TestCrossCellMover:
+    def _mover(self, orders, src_kw=None, dst_kw=None, **pol_kw):
+        src = MoveStubRole("training", desired=4, min_count=0,
+                           **(src_kw or {}))
+        dst = MoveStubRole("training", desired=2, min_count=0,
+                           **(dst_kw or {}))
+        pol_kw.setdefault("drain_budget_passes", 5)
+        pol_kw.setdefault("cooldown_passes", 0)
+        mover = CrossCellMover(
+            orders, {"A": {"training": src}, "B": {"training": dst}},
+            MovePolicy(**pol_kw),
+        )
+        return mover, src, dst
+
+    def test_move_completes_drain_first_both_ways(self):
+        orders = [("training", "A", "B", 1)]
+        mover, src, dst = self._mover(
+            lambda: list(orders), src_kw={"drain_passes": 2},
+        )
+        assert mover.step() == "draining"  # source drains FIRST
+        # The destination has NOT grown while the source drains.
+        assert len(dst.members) == 2 and src.drain_pending()
+        mover.step()  # pump pass 1 (drain not done yet)
+        assert mover.phase == "draining"
+        mover.step()  # drain completes -> destination grows
+        orders.clear()
+        assert mover.phase == "idle"
+        assert mover.moved == 1 and mover.laddered == 0
+        assert len(src.members) == 3 and len(dst.members) == 3
+        assert src.departed == 1  # permanent: loan hold released
+        assert dst.spec.desired == 3
+
+    def test_stuck_drain_falls_back_to_restart_ladder(self):
+        mover, src, dst = self._mover(
+            lambda: [("training", "A", "B", 1)],
+            src_kw={"drain_passes": 99}, drain_budget_passes=3,
+        )
+        for _ in range(6):
+            mover.step()
+        assert mover.laddered >= 1 and mover.moved == 0
+        assert len(dst.members) == 2  # destination never grew
+        assert src.departed == 0
+
+    def test_refused_grow_reclaims_at_source(self):
+        mover, src, dst = self._mover(
+            lambda: [("training", "A", "B", 1)],
+            src_kw={"drain_passes": 1}, dst_kw={"grow_ok": False},
+            max_moves=1,
+        )
+        mover.step()   # begin drain
+        mover.step()   # drain done -> grow refused -> ladder
+        assert mover.laddered == 1 and mover.moved == 0
+        assert src.departed == 0
+        # reclaim_one (grow_one at the source) restored desired.
+        assert src.spec.desired == 4
+
+    def test_vanished_cell_mid_move_ladders_without_reclaim(self):
+        cells = {}
+        mover = CrossCellMover(
+            lambda: [("training", "A", "B", 1)], cells, MovePolicy(
+                drain_budget_passes=5, cooldown_passes=0,
+            ),
+        )
+        src = MoveStubRole("training", desired=4, drain_passes=9)
+        dst = MoveStubRole("training", desired=2)
+        cells["A"] = {"training": src}
+        cells["B"] = {"training": dst}
+        mover.step()
+        assert mover.phase == "draining"
+        del cells["A"]  # the source cell blacked out mid-move
+        mover.step()
+        assert mover.phase == "idle"
+        assert mover.laddered == 1 and mover.moved == 0
+
+    def test_moves_are_serialized_with_cooldown(self):
+        orders = [("training", "A", "B", 1),
+                  ("training", "A", "B", 1)]
+        mover, src, dst = self._mover(
+            lambda: list(orders), src_kw={"drain_passes": 1},
+            cooldown_passes=2,
+        )
+        mover.step()  # first move starts
+        mover.step()  # completes
+        assert mover.moved == 1
+        mover.step()  # cooldown 2
+        mover.step()  # cooldown 1
+        assert mover.phase == "idle" and mover.moved == 1
+        mover.step()  # second move may start now
+        assert mover.phase == "draining"
+
+    def test_orders_fetch_failure_is_contained(self):
+        def broken():
+            raise RuntimeError("federation read raced a dying cell")
+
+        mover = CrossCellMover(broken, {}, MovePolicy())
+        assert mover.step() == "idle"
+
+    def test_training_role_confirm_departure_releases_lent(self):
+        role = TrainingRole.__new__(TrainingRole)
+        role.lent = 2
+        role.confirm_departure()
+        assert role.lent == 1
+        role.confirm_departure()
+        role.confirm_departure()  # never below zero
+        assert role.lent == 0

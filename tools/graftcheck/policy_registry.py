@@ -84,8 +84,20 @@ REGISTRY: Tuple[PolicyObject, ...] = (
         "split-brain detection over the merged view",
     ),
     PolicyObject(
+        "dlrover_tpu/cells/federation.py", "plan_moves", "function",
+        "cross-cell move orders from a placement diff (sorted greedy)",
+    ),
+    PolicyObject(
         "dlrover_tpu/fleet/policy.py", "ChipBorrowArbiter", "class",
         "cross-job chip borrow/reclaim arbitration",
+    ),
+    PolicyObject(
+        "dlrover_tpu/fleet/policy.py", "CrossCellMover", "class",
+        "cross-cell chip-move actuation (drain-first, restart ladder)",
+    ),
+    PolicyObject(
+        "dlrover_tpu/serving/spillover.py", "SpilloverPolicy", "class",
+        "cross-cell spillover forward/stay decision (injected clock)",
     ),
     PolicyObject(
         "dlrover_tpu/reshard/plan.py", "build_plan", "function",
