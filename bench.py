@@ -5653,6 +5653,274 @@ def global_bench_main(argv: list) -> int:
     return 0 if result["complete"] else 1
 
 
+def sim_bench_main(argv: list) -> int:
+    """Wind-tunnel bench (ROADMAP item 7 acceptance artifact): the
+    deterministic fleet simulator, in two halves.
+
+    **Fidelity** — the sim must EARN the right to extrapolate: the
+    micro rig replays the committed ``GLOBAL_BENCH_CPU.json`` rows
+    (identical seeded ``zipf_cell_trace``, identical opts, real
+    ``GatewayCore``/``CellSpillRouter`` objects, virtual time) and the
+    control-plane rig replays ``CELL_BENCH_CPU.json``'s row grid (real
+    ``cell_for_node`` routing).  Each rig carries ONE calibrated
+    overhead constant fitted to ONE committed row; every other row is
+    a prediction and must land within the stated tolerance
+    (``tolerance_global``/``tolerance_cell``).
+
+    **Storm** — the run no real bench could stage: 10,000 nodes, 24
+    cells, a day-long diurnal Zipf trace (~86M requests) with a
+    correlated two-cell blackout at the diurnal peak, a gray-network
+    window and a churn wave — static partitioning vs the global data
+    plane (ring re-home + spillover + chip borrows + federation
+    moves), all REAL policy objects.  Both modes run the IDENTICAL
+    trace; the global mode runs TWICE and the double-run law (same
+    seed + trace => byte-identical event log) is asserted on the
+    sha256 of the per-step event log.
+
+    Flags: ``--seed=N`` ``--overhead_ms=F`` (micro-rig calibration)
+    ``--cell_overhead_ms=F`` (cell-rig calibration) ``--out=PATH``
+    (default SIM_BENCH.json) ``--smoke`` (scaled storm, sub-5s; the
+    tier-1 schema gate)."""
+    import logging
+    import os
+
+    from dlrover_tpu.sim import (
+        FleetStormSim,
+        StormSpec,
+        TraceConfig,
+        run_cell_rows,
+        run_global_rows,
+    )
+
+    logging.getLogger("dlrover_tpu").setLevel(logging.WARNING)
+    t_start = time.perf_counter()
+    opts = {
+        "seed": 0,
+        #: Micro-rig calibration: completion-RPC turnaround + host
+        #: scheduling per decode round, fitted to the committed
+        #: static/no-blackout row.
+        "overhead_ms": 0.8,
+        #: Cell-rig calibration: per-op request-path cost around the
+        #: durable-log floor, fitted to the committed 1-cell floored
+        #: row (1000/218.6 - floor_ms).
+        "cell_overhead_ms": 1.575,
+        "tolerance_global": 0.05,
+        "tolerance_cell": 0.15,
+        "fed_every": 10,
+    }
+    out_path = None
+    smoke = False
+    for a in argv:
+        if a == "--smoke":
+            smoke = True
+        elif a.startswith("--out="):
+            out_path = a.split("=", 1)[1]
+        elif "=" in a and a.startswith("--"):
+            k, v = a[2:].split("=", 1)
+            if k in opts:
+                opts[k] = type(opts[k])(v)
+    here = os.path.dirname(os.path.abspath(__file__))
+    if out_path is None:
+        out_path = os.path.join(here, "SIM_BENCH.json")
+
+    result = {
+        "bench": "sim",
+        "smoke": smoke,
+        "opts": dict(opts),
+        "fidelity_global": {"rows": []},
+        "fidelity_cell": {"rows": []},
+        "storm": {},
+        "note": (
+            "Wind tunnel (ROADMAP 7).  Fidelity: the micro rig "
+            "replays the committed GLOBAL_BENCH_CPU.json rows (real "
+            "GatewayCore/CellSpillRouter over the identical seeded "
+            "zipf_cell_trace, virtual time) and the cell rig replays "
+            "CELL_BENCH_CPU.json's grid (real cell_for_node "
+            "routing); one calibrated overhead constant per rig, "
+            "fitted to one committed row each, every other row a "
+            "prediction gated by the stated tolerance.  Storm: 10k "
+            "nodes / 24 cells / a diurnal day (~86M requests) with a "
+            "correlated 2-hot-cell blackout at peak, a gray-network "
+            "window (delay+duplicate, receiver dedupes) and a churn "
+            "wave — static partitioning vs the global data plane "
+            "(ring re-home + SpilloverPolicy + ChipBorrowArbiter + "
+            "place_roles/plan_moves/CrossCellMover), identical "
+            "trace; the global mode runs twice and the event-log "
+            "sha256 must be byte-identical (the double-run law)."
+        ),
+    }
+
+    def flush():
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+        os.replace(tmp, out_path)
+
+    # -- fidelity vs the committed global bench -----------------------------
+    with open(os.path.join(here, "GLOBAL_BENCH_CPU.json")) as f:
+        gref = json.load(f)
+    gopts = dict(gref["opts"])
+    rate = (gopts["rate_mult"] * gopts["cells"]
+            * gopts["replicas"] / (gopts["service_ms"] / 1e3))
+    times, homes = zipf_cell_trace(
+        rate, gopts["duration_s"], int(gopts["cells"]),
+        gopts["zipf_a"], int(gopts["seed"]),
+    )
+    shapes = [True] if gref.get("smoke") else [False, True]
+    sim_rows = run_global_rows(gopts, times, homes,
+                               overhead_ms=opts["overhead_ms"],
+                               shapes=shapes)
+    ref_by = {(r["mode"], r["blackout"]): r for r in gref["rows"]}
+    g_ok = True
+    for srow in sim_rows:
+        ref = ref_by[(srow["mode"], srow["blackout"])]
+        err = abs(srow["goodput_rps"] - ref["goodput_rps"]) / max(
+            ref["goodput_rps"], 1e-9)
+        within = err <= opts["tolerance_global"]
+        g_ok = g_ok and within and srow["conservation_ok"]
+        result["fidelity_global"]["rows"].append({
+            "mode": srow["mode"], "blackout": srow["blackout"],
+            "goodput_ref": ref["goodput_rps"],
+            "goodput_sim": srow["goodput_rps"],
+            "err": round(err, 4), "within_tolerance": within,
+            "blackout_lost_ref": ref["blackout_lost"],
+            "blackout_lost_sim": srow["blackout_lost"],
+            "stranded_ref": ref["stranded"],
+            "stranded_sim": srow["stranded"],
+            "spill_forwarded_ref": ref["spill_forwarded"],
+            "spill_forwarded_sim": srow["spill_forwarded"],
+            "conservation_ok": srow["conservation_ok"],
+        })
+    result["fidelity_global"]["tolerance"] = opts["tolerance_global"]
+    result["fidelity_global"]["ok"] = g_ok
+    flush()
+
+    # -- fidelity vs the committed cell bench -------------------------------
+    with open(os.path.join(here, "CELL_BENCH_CPU.json")) as f:
+        cref = json.load(f)
+    copts = dict(cref["opts"])
+    cell_counts = [int(c) for c in str(copts["cells"]).split(",")]
+    crows = run_cell_rows(
+        cell_counts, float(copts["floor_ms"]),
+        float(copts["rate_mult"]), int(copts["clients"]),
+        float(copts["duration_s"]), float(copts["warmup_s"]),
+        overhead_ms=opts["cell_overhead_ms"],
+    )
+    cref_by = {(r["cells"], r["floor_ms"]): r for r in cref["rows"]}
+    c_ok = True
+    for srow in crows:
+        ref = cref_by[(srow["cells"], srow["floor_ms"])]
+        err = abs(srow["ops_per_s"] - ref["ops_per_s"]) / max(
+            ref["ops_per_s"], 1e-9)
+        within = err <= opts["tolerance_cell"]
+        c_ok = c_ok and within
+        result["fidelity_cell"]["rows"].append({
+            "cells": srow["cells"], "floor_ms": srow["floor_ms"],
+            "ops_ref": ref["ops_per_s"], "ops_sim": srow["ops_per_s"],
+            "err": round(err, 4), "within_tolerance": within,
+        })
+    result["fidelity_cell"]["tolerance"] = opts["tolerance_cell"]
+    result["fidelity_cell"]["ok"] = c_ok
+    flush()
+
+    # -- the storm ----------------------------------------------------------
+    if smoke:
+        trace_cfg = TraceConfig(
+            seed=int(opts["seed"]), n_cells=8, nodes=2000,
+            duration_s=3600.0, step_s=30.0, base_rps=300.0,
+            diurnal_amp=0.6, diurnal_period_s=3600.0, zipf_a=0.6,
+            storms=(
+                StormSpec(kind="blackout", at_s=1500.0,
+                          duration_s=600.0, cells=(0, 1)),
+                StormSpec(kind="net_gray", at_s=2250.0,
+                          duration_s=300.0, cells=(0,),
+                          severity=0.05, delay_steps=2),
+                StormSpec(kind="churn", at_s=2700.0,
+                          duration_s=300.0, cells=(2, 3),
+                          severity=0.3),
+            ),
+        )
+    else:
+        # The full day: blackout the TWO hottest cells for two hours
+        # at the diurnal peak, a gray-network hour on the hot cell
+        # during recovery, a churn wave in the evening.
+        trace_cfg = TraceConfig(
+            seed=int(opts["seed"]), n_cells=24, nodes=10000,
+            duration_s=86400.0, step_s=30.0, base_rps=1000.0,
+            diurnal_amp=0.6, diurnal_period_s=86400.0, zipf_a=0.6,
+            storms=(
+                StormSpec(kind="blackout", at_s=36000.0,
+                          duration_s=7200.0, cells=(0, 1)),
+                StormSpec(kind="net_gray", at_s=50400.0,
+                          duration_s=3600.0, cells=(0,),
+                          severity=0.05, delay_steps=2),
+                StormSpec(kind="churn", at_s=64800.0,
+                          duration_s=1800.0, cells=(2, 3),
+                          severity=0.3),
+            ),
+        )
+
+    storm_rows = {}
+    walls = {}
+    for mode in ("static", "global"):
+        t0 = time.perf_counter()
+        storm_rows[mode] = FleetStormSim(
+            trace_cfg, mode=mode, fed_every=int(opts["fed_every"]),
+        ).run()
+        walls[mode] = round(time.perf_counter() - t0, 1)
+        result["storm"][mode] = storm_rows[mode]
+        result["storm"][mode]["wall_s"] = walls[mode]
+        flush()
+        print(f"sim storm [{mode}]: wall {walls[mode]}s "
+              f"slo_goodput {storm_rows[mode]['slo_goodput']} "
+              f"storm_goodput {storm_rows[mode]['storm_goodput']}",
+              file=sys.stderr)
+    t0 = time.perf_counter()
+    rerun = FleetStormSim(
+        trace_cfg, mode="global", fed_every=int(opts["fed_every"]),
+    ).run()
+    walls["global_rerun"] = round(time.perf_counter() - t0, 1)
+    result["storm"]["double_run_identical"] = (
+        rerun["event_log_sha256"]
+        == storm_rows["global"]["event_log_sha256"]
+    )
+    result["storm"]["wall_s"] = walls
+
+    g, s = storm_rows["global"], storm_rows["static"]
+    result["verdicts"] = {
+        "fidelity_global_ok": bool(result["fidelity_global"]["ok"]),
+        "fidelity_cell_ok": bool(result["fidelity_cell"]["ok"]),
+        "storm_conserved": bool(
+            g["conservation_ok"] and s["conservation_ok"]),
+        "global_beats_static_storm":
+            g["storm_goodput"] > s["storm_goodput"],
+        "double_run_identical":
+            bool(result["storm"]["double_run_identical"]),
+        "spill_exercised": g["spilled"] > 0,
+        "day_under_60s_wall": max(walls.values()) < 60.0,
+    }
+    if not smoke:
+        # Full-run-only verdicts: the smoke window is too short for a
+        # federation move cycle, and its offered load is tiny.
+        result["verdicts"]["moves_exercised"] = g["moved_blocks"] > 0
+        result["verdicts"]["offered_ge_1m"] = g["offered"] >= 1_000_000
+    result["storm_goodput_speedup_x"] = round(
+        g["storm_goodput"] / max(s["storm_goodput"], 1e-9), 2)
+    result["complete"] = all(result["verdicts"].values())
+    result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+    flush()
+    print(json.dumps({
+        "metric": "sim_storm_slo_goodput_10k_nodes",
+        "value": g["storm_goodput"],
+        "unit": "slo_goodput_frac_two_cell_blackout_at_peak",
+        "vs_baseline": s["storm_goodput"],
+        "speedup": result["storm_goodput_speedup_x"],
+        "backend": "cpu",
+        "artifact": out_path,
+    }))
+    return 0 if result["complete"] else 1
+
+
 #: Subcommand table: every bench registers here (satellite of ISSUE 5 —
 #: the tail-of-file if-chain made each new bench a copy-paste edit).
 SUBCOMMANDS = {
@@ -5667,6 +5935,7 @@ SUBCOMMANDS = {
     "--ha_bench": ha_bench_main,
     "--cell_bench": cell_bench_main,
     "--global_bench": global_bench_main,
+    "--sim_bench": sim_bench_main,
 }
 
 

@@ -93,6 +93,17 @@ SITES: Dict[str, dict] = {
         "doc": "request aborted UNAVAILABLE at `RpcServer`; the "
                "handler never runs",
     },
+    # Gray network (ISSUE 18): the RPC SUCCEEDS — the failure modes
+    # are time and multiplicity, not loss.  Worse than a clean outage:
+    # nothing trips the retry/failover machinery, so dedupe and
+    # timeout budgets are what must hold.
+    "net.gray": {
+        "kind": "flag", "delay": 0.2,
+        "doc": "gray network at `RpcClient.call`: the reply arrives "
+               "but `delay` LATE, and the request is re-sent once "
+               "(wire duplicate) — the receiver's idempotency/dedupe "
+               "must absorb it; nothing is dropped",
+    },
     "rdzv.late_join": {
         "kind": "latency", "delay": 2.0,
         "doc": "sleep `delay` in the master rendezvous join (late "

@@ -316,6 +316,22 @@ class RpcClient:
                     serialize(msg),
                     timeout=min(timeout or self._timeout, remaining),
                 )
+                gray = chaos.inject("net.gray", method=name)
+                if gray is not None:
+                    # Gray network: the call SUCCEEDED but the reply
+                    # comes back late, and the request hits the wire a
+                    # second time (a spurious retransmit the server
+                    # executes again) — the receiver's dedupe, not the
+                    # retry machinery, is what must absorb it.
+                    if gray.delay > 0:
+                        time.sleep(gray.delay)
+                    try:
+                        self._call(
+                            serialize(msg),
+                            timeout=timeout or self._timeout,
+                        )
+                    except grpc.RpcError:
+                        pass  # the duplicate may lose the race; fine
                 return deserialize(data)
             except grpc.RpcError as e:
                 last_err = e

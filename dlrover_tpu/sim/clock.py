@@ -1,0 +1,35 @@
+"""The wind tunnel's clock: virtual seconds, advanced only by events.
+
+Every policy object in the registry takes an injected ``clock``
+callable (the DET701 seam).  In production that is ``time.monotonic``;
+in the simulator it is a :class:`VirtualClock` the event scheduler
+advances — no code under test can tell the difference, and a 24-hour
+diurnal trace runs in however long its *events* take to process, not
+24 hours.
+
+Monotonicity is enforced here rather than trusted: an event handler
+that tried to move time backwards would silently corrupt every
+latency/ cooldown computation downstream, so ``advance_to`` clamps.
+"""
+
+from __future__ import annotations
+
+
+class VirtualClock:
+    """A monotonic virtual clock, callable like ``time.monotonic``."""
+
+    def __init__(self, start: float = 0.0):
+        self.t = float(start)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance_to(self, t: float) -> float:
+        """Move time forward to ``t`` (never backward); returns now."""
+        if t > self.t:
+            self.t = float(t)
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        """Move time forward by ``dt`` (negative deltas are ignored)."""
+        return self.advance_to(self.t + dt)

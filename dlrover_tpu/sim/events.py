@@ -1,0 +1,66 @@
+"""The discrete-event heart of the wind tunnel.
+
+:class:`SimScheduler` is a registered policy object (graftcheck's
+DET70x families verify its whole method surface is ambient-effect
+free): a seeded event queue over the injected :class:`VirtualClock`.
+Determinism comes from two properties the double-run tests pin:
+
+* ties break on an insertion sequence number, never on payload
+  identity or hash order — two events at the same virtual instant
+  always pop in the order they were pushed;
+* popping an event *advances the injected clock* to the event's time,
+  so every policy call made from a handler observes exactly the
+  event's timestamp — there is no other source of time.
+
+Handlers schedule follow-up events at or after "now"; a push into the
+past is clamped to now (the simulated analogue of a late timer, which
+fires immediately rather than rewriting history).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any, List, Optional, Tuple
+
+#: (time, seq, kind, payload) — seq is globally unique per scheduler,
+#: so heap comparison never reaches the payload.
+Event = Tuple[float, int, str, Any]
+
+
+class SimScheduler:
+    """A deterministic event queue bound to one virtual clock."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self._heap: List[Event] = []
+        self._seq = 0
+        self.popped = 0
+
+    def push(self, at: float, kind: str, payload: Any = None) -> int:
+        """Schedule ``kind`` at virtual time ``at`` (clamped to now);
+        returns the event's sequence number (its FIFO tie-break)."""
+        now = self.clock()
+        if at < now:
+            at = now
+        self._seq += 1
+        heapq.heappush(self._heap, (float(at), self._seq, kind, payload))
+        return self._seq
+
+    def pop(self) -> Optional[Event]:
+        """Next event in (time, insertion) order; advances the clock
+        to its timestamp.  None when the queue is empty."""
+        if not self._heap:
+            return None
+        ev = heapq.heappop(self._heap)
+        self.clock.advance_to(ev[0])
+        self.popped += 1
+        return ev
+
+    def peek_time(self) -> Optional[float]:
+        return self._heap[0][0] if self._heap else None
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def empty(self) -> bool:
+        return not self._heap

@@ -1,0 +1,104 @@
+"""Virtual roles: the RoleAdapter contract over simulated node blocks.
+
+:class:`SimRole` is a real :class:`~dlrover_tpu.fleet.role.RoleAdapter`
+subclass — the arbiters and movers under test call the same
+``observe``/``spawn``/drain-trio surface they call in production, and
+the generic borrow/lend/reclaim machinery of the base class runs
+unmodified.  Members are named blocks (``"c3/serving-7"``); a member
+"process" is ``block_nodes`` fleet nodes, so a 10,000-node fleet is a
+few hundred adapter members, not ten thousand Python objects.
+
+Drains are modeled as a countdown: ``begin_drain`` marks the youngest
+member, and each ``pump_drain`` pass burns one of ``drain_passes``
+before the member actually leaves — which is exactly the shape the
+``CrossCellMover`` ladder budgets against (``drain_budget_passes``).
+Everything is plain lists; there is deliberately no wall time, no
+randomness, and no thread anywhere in this file.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.fleet.role import RoleAdapter, RoleSpec, RoleStatus
+
+
+class SimRole(RoleAdapter):
+    """A count-backed role whose members exist only in the sim."""
+
+    def __init__(self, spec: RoleSpec, prefix: str,
+                 block_nodes: int = 1, drain_passes: int = 2):
+        super().__init__(spec)
+        self.prefix = prefix
+        self.block_nodes = int(block_nodes)
+        self.drain_passes = int(drain_passes)
+        self.members: List[str] = [
+            f"{prefix}-{i}" for i in range(spec.desired)
+        ]
+        self._next_id = spec.desired
+        #: member -> remaining pump passes before it leaves.
+        self._draining: Dict[str, int] = {}
+        self.signals: Dict[str, object] = {}
+        self.spawned = 0
+        self.drained = 0
+
+    # -- RoleAdapter primitives -------------------------------------------
+
+    def observe(self) -> RoleStatus:
+        return RoleStatus(
+            members=tuple(self.members),
+            draining=tuple(self._draining),
+            signals=dict(self.signals),
+        )
+
+    def spawn(self, n: int) -> int:
+        for _ in range(max(0, int(n))):
+            self.members.append(f"{self.prefix}-{self._next_id}")
+            self._next_id += 1
+        self.spawned += max(0, int(n))
+        return max(0, int(n))
+
+    def begin_drain(self) -> Optional[str]:
+        if self._draining:
+            return None  # one drain in flight per role
+        for m in reversed(self.members):
+            self.members.remove(m)
+            self._draining[m] = self.drain_passes
+            return m
+        return None
+
+    def drain_pending(self) -> bool:
+        return bool(self._draining)
+
+    def pump_drain(self) -> None:
+        done = []
+        for m in self._draining:
+            self._draining[m] -= 1
+            if self._draining[m] <= 0:
+                done.append(m)
+        for m in done:
+            del self._draining[m]
+            self.drained += 1
+
+    # -- sim surface -------------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        return len(self.members)
+
+    @property
+    def node_count(self) -> int:
+        return len(self.members) * self.block_nodes
+
+    def fail(self, n: int) -> int:
+        """``n`` members die abruptly (churn wave): no drain, they are
+        simply gone next observe.  Returns how many actually died."""
+        n = min(max(0, int(n)), len(self.members))
+        for _ in range(n):
+            self.members.pop()
+        return n
+
+    def snapshot(self) -> Tuple[int, int, int]:
+        """(members, draining, desired) — the event log's view."""
+        return (len(self.members), len(self._draining),
+                self.spec.desired)

@@ -1,0 +1,101 @@
+"""Seeded, ambient-free randomness for the wind tunnel.
+
+The simulator may not touch ``random`` or ``np.random`` — graftcheck's
+effect analysis bans both for anything reachable from a registered
+policy object, because module-global RNG state makes a replay depend
+on call *order across components*, not on the trace.  Instead every
+draw here is a pure function of ``(seed, site, n)``, hashed through
+SHA-1 exactly like ``common.hashring``'s ring positions and the chaos
+plan's crc32 decisions: same coordinates, same draw, forever, on any
+platform.
+
+``site`` is a free-form string naming the decision point
+(``"arr:120:cell3"``); ``n`` disambiguates multiple draws at one
+site.  Nothing is stateful, so concurrent sim components can never
+steal each other's draws.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from typing import List, Sequence, Tuple
+
+#: 53 bits of hash -> a float in [0, 1) with full double precision.
+_DENOM = float(1 << 53)
+
+
+def u01(seed: int, site: str, n: int = 0) -> float:
+    """Uniform draw in [0, 1), a pure function of its coordinates."""
+    h = hashlib.sha1(f"{seed}:{site}:{n}".encode()).digest()
+    return (int.from_bytes(h[:8], "big") >> 11) / _DENOM
+
+
+def exp_gap(seed: int, site: str, n: int, mean: float) -> float:
+    """Exponential inter-arrival gap with the given mean."""
+    u = u01(seed, site, n)
+    # 1-u is in (0, 1]; log of it is finite.
+    return -float(mean) * math.log(1.0 - u)
+
+
+def poisson(seed: int, site: str, lam: float) -> int:
+    """Poisson count with mean ``lam``.
+
+    Knuth's product method below ``lam < 30`` (exact, one sub-draw per
+    event); above that a clamped normal approximation — at fleet
+    scale the per-cell arrival counts this feeds are hundreds to
+    thousands, where the approximation error is far below the model
+    error the fidelity section states.
+    """
+    lam = float(lam)
+    if lam <= 0.0:
+        return 0
+    if lam < 30.0:
+        limit = math.exp(-lam)
+        k = 0
+        prod = u01(seed, site, 0)
+        while prod > limit:
+            k += 1
+            prod *= u01(seed, site, k)
+        return k
+    z = normal01(seed, site)
+    return max(0, int(round(lam + math.sqrt(lam) * z)))
+
+
+def normal01(seed: int, site: str) -> float:
+    """Standard normal via Box-Muller from two coordinate draws."""
+    u1 = u01(seed, site, 1000001)
+    u2 = u01(seed, site, 1000002)
+    r = math.sqrt(-2.0 * math.log(1.0 - u1))
+    return r * math.cos(2.0 * math.pi * u2)
+
+
+def zipf_shares(n: int, a: float) -> List[float]:
+    """Zipf(``a``) probability over ranks 0..n-1 (rank 0 hottest)."""
+    if n <= 0:
+        return []
+    w = [1.0 / float(k) ** float(a) for k in range(1, n + 1)]
+    total = sum(w)
+    return [x / total for x in w]
+
+
+def cdf_of(shares: Sequence[float]) -> Tuple[float, ...]:
+    """Cumulative form of a share vector, for :func:`pick`."""
+    acc = 0.0
+    out = []
+    for s in shares:
+        acc += s
+        out.append(acc)
+    return tuple(out)
+
+
+def pick(u: float, cdf: Sequence[float]) -> int:
+    """Index of the first cdf entry >= u (inverse-CDF sampling)."""
+    lo, hi = 0, len(cdf) - 1
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if cdf[mid] < u:
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo

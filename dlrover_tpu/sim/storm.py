@@ -1,0 +1,697 @@
+"""The 10,000-node wind tunnel: a fleet-scale chaos-storm rig.
+
+:class:`FleetStormSim` drives the REAL control-plane policy objects —
+per-cell :class:`~dlrover_tpu.fleet.policy.ChipBorrowArbiter` over
+real :class:`~dlrover_tpu.sim.fleet.SimRole` adapters, the federation
+triple :func:`~dlrover_tpu.cells.federation.merge_cell_snapshots` /
+:func:`~dlrover_tpu.cells.federation.place_roles` /
+:func:`~dlrover_tpu.cells.federation.plan_moves` actuated by a real
+:class:`~dlrover_tpu.fleet.policy.CrossCellMover`, the
+:class:`~dlrover_tpu.serving.spillover.SpilloverPolicy` forward/stay
+decision, :func:`~dlrover_tpu.serving.autoscale.decide` and the
+:class:`~dlrover_tpu.common.hashring.HashRing` re-home path — over a
+synthetic 10,000-node fleet and a day-long diurnal trace, in seconds
+of wall clock.  Only the *plant* is simulated (request counts age
+through per-cell backlog buckets instead of per-request objects); the
+*decisions* are the production code paths, unmodified.
+
+Two modes make the paper's argument measurable:
+
+* ``static`` — partitioned cells: a request's home cell is its fate.
+  Blackouts lose the dead cells' arrivals, hot cells drown alone
+  (chip borrows still run — the delta below isolates the DATA plane).
+* ``global`` — the full PR-17 posture: dead cells' arrivals re-home
+  through the consistent-hash ring over the surviving cell set,
+  saturated cells spill overflow to policy-chosen siblings, and the
+  federation's move orders rebalance blocks between cells.
+
+Chaos storms come from the trace (:class:`StormSpec`), not from the
+harness: correlated blackouts (the N hottest cells at the diurnal
+peak), gray networks (spill transfers DELAYED and DUPLICATED, never
+dropped — the receiver dedupes), and churn waves.  Every run appends
+one JSON line per step to an event log and returns its sha256 — the
+double-run law for a 10k-node day is one string comparison.
+
+Accounting is conservative by construction and checked:
+``offered == served + timeout + blackout_lost + stranded + backlog +
+in_transit`` at the end of every run (duplicates are counted apart —
+they are copies, not offered load).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from dlrover_tpu.cells.cell import cell_for_node
+from dlrover_tpu.cells.federation import (
+    merge_cell_snapshots,
+    place_roles,
+    plan_moves,
+)
+from dlrover_tpu.fleet.policy import (
+    BorrowPolicy,
+    ChipBorrowArbiter,
+    CrossCellMover,
+    MovePolicy,
+)
+from dlrover_tpu.fleet.role import RoleSpec
+from dlrover_tpu.serving.autoscale import ScalePolicy, ScaleState, decide
+from dlrover_tpu.serving.spillover import SpilloverConfig, SpilloverPolicy
+
+from .clock import VirtualClock
+from .fleet import SimRole
+from .trace import TraceConfig, TraceGenerator
+
+#: Coarse shard keys for the re-home path: dead-cell load re-homes by
+#: the REAL ring over these keys, so the survivor split is exactly
+#: what production consistent hashing would produce.
+N_SHARD_KEYS = 256
+
+
+class _Cell:
+    """One cell's simulated plant: roles, backlog, counters."""
+
+    def __init__(self, cid: str, blocks: int, block_nodes: int):
+        self.cid = cid
+        self.blocks = blocks
+        srv = blocks // 2
+        self.serving = SimRole(
+            RoleSpec(name=f"{cid}/serving", desired=srv, min_count=2,
+                     max_count=blocks),
+            prefix=f"{cid}/srv", block_nodes=block_nodes,
+        )
+        self.training = SimRole(
+            RoleSpec(name=f"{cid}/training", desired=blocks - srv,
+                     min_count=2, max_count=blocks),
+            prefix=f"{cid}/trn", block_nodes=block_nodes,
+        )
+        #: FIFO backlog as [enqueue_step, count] buckets, oldest first.
+        self.backlog: List[List[int]] = []
+        self.dead = False
+
+    def backlog_n(self) -> int:
+        return sum(n for _, n in self.backlog)
+
+    def enqueue(self, step: int, n: int) -> None:
+        if n <= 0:
+            return
+        if self.backlog and self.backlog[-1][0] == step:
+            self.backlog[-1][1] += n
+        else:
+            self.backlog.append([step, n])
+
+    def enqueue_aged(self, buckets: List[List[int]]) -> None:
+        """Merge transferred buckets, preserving request age (SLO
+        clocks keep running across the wire)."""
+        for enq, n in buckets:
+            if n <= 0:
+                continue
+            placed = False
+            for b in self.backlog:
+                if b[0] == enq:
+                    b[1] += n
+                    placed = True
+                    break
+            if not placed:
+                self.backlog.append([enq, n])
+        self.backlog.sort(key=lambda b: b[0])
+
+
+class FleetStormSim:
+    """One mode's day in the wind tunnel.  ``run()`` returns the
+    result row; see the module doc for the physics."""
+
+    def __init__(
+        self,
+        trace_cfg: TraceConfig,
+        mode: str = "global",
+        per_block_rps: float = 6.0,
+        block_nodes: int = 8,
+        slo_steps: int = 2,
+        timeout_steps: int = 10,
+        fed_every: int = 10,
+        mover_passes: int = 2,
+        spill_rounds: int = 3,
+        spill_cap: int = 2000,
+    ):
+        if mode not in ("static", "global"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.mode = mode
+        self.trace = TraceGenerator(trace_cfg)
+        self.cfg = trace_cfg
+        self.per_block_rps = float(per_block_rps)
+        self.slo_steps = int(slo_steps)
+        self.timeout_steps = int(timeout_steps)
+        self.fed_every = int(fed_every)
+        self.mover_passes = int(mover_passes)
+        self.spill_rounds = int(spill_rounds)
+        self.spill_cap = int(spill_cap)
+        self.clock = VirtualClock()
+
+        # -- fleet construction: cfg.nodes spread over cfg.n_cells in
+        # block_nodes-node blocks, remainder blocks to the first cells.
+        n = trace_cfg.n_cells
+        base = trace_cfg.nodes // (n * block_nodes)
+        extra = (trace_cfg.nodes - base * n * block_nodes) // block_nodes
+        self.cell_ids = [f"c{i:02d}" for i in range(n)]
+        self.cells: Dict[str, _Cell] = {}
+        for i, cid in enumerate(self.cell_ids):
+            blocks = base + (1 if i < extra else 0)
+            self.cells[cid] = _Cell(cid, blocks, block_nodes)
+        self.node_count = sum(
+            c.serving.node_count + c.training.node_count
+            for c in self.cells.values()
+        )
+
+        # -- the real policy objects under test.
+        self.spill_policy = SpilloverPolicy(
+            SpilloverConfig(max_hops=1, spill_at=1.0,
+                            sibling_headroom=0.85,
+                            failure_cooldown_s=5.0 * trace_cfg.step_s),
+            clock=self.clock,
+        )
+        self.arbiters: Dict[str, ChipBorrowArbiter] = {}
+        for cid in self.cell_ids:
+            cell = self.cells[cid]
+            self.arbiters[cid] = ChipBorrowArbiter(
+                lender=cell.training,
+                borrower=cell.serving,
+                policy=BorrowPolicy(
+                    queue_high_per_member=60.0, spike_patience=2,
+                    queue_low_per_member=5.0, decay_patience=8,
+                    max_borrow=4, cooldown_passes=4,
+                ),
+                signal_fn=(lambda c=cell: {
+                    "queue_depth": c.backlog_n(),
+                    "members_alive": c.serving.count,
+                }),
+                scope=cid,
+                hold_fn=(lambda c=cell: c.dead),
+            )
+        self._orders: List[tuple] = []
+        self.mover = CrossCellMover(
+            orders_fn=self._live_orders,
+            cells={
+                cid: {"serving": self.cells[cid].serving,
+                      "training": self.cells[cid].training}
+                for cid in self.cell_ids
+            },
+            policy=MovePolicy(drain_budget_passes=20, cooldown_passes=2),
+        )
+        self.scale_states: Dict[str, ScaleState] = {
+            cid: ScaleState() for cid in self.cell_ids
+        }
+        self.scale_policy = ScalePolicy(
+            min_replicas=2, max_replicas=10_000,
+            queue_high_per_replica=30.0, up_patience=2,
+        )
+
+        #: In-flight spill/re-home transfers:
+        #: [deliver_step, dst_cid, buckets, dup_n].
+        self.transfers: List[List[Any]] = []
+        self._ring_cache: Dict[Tuple[str, ...], Dict[int, str]] = {}
+        self._home_keys = {
+            cid: [k for k in range(N_SHARD_KEYS)
+                  if cell_for_node(k, self.cell_ids) == cid]
+            for cid in self.cell_ids
+        }
+
+        # -- counters (fleet totals; conservation checked at the end).
+        self.offered = 0
+        self.served = 0
+        self.served_in_slo = 0
+        self.timeout = 0
+        self.blackout_lost = 0
+        self.stranded = 0
+        self.spilled = 0
+        self.spill_ingress = 0
+        self.rehomed = 0
+        self.dup_dropped = 0
+        self.storm_offered = 0
+        self.storm_in_slo = 0
+        self.storm_lost = 0
+        self._storm_tail = 0
+        self._digest = hashlib.sha256()
+        self._log_lines = 0
+
+    # -- helpers -------------------------------------------------------------
+
+    def _alive(self) -> List[str]:
+        return [cid for cid in self.cell_ids
+                if not self.cells[cid].dead]
+
+    def _owner_map(self, alive: List[str]) -> Dict[int, str]:
+        key = tuple(alive)
+        got = self._ring_cache.get(key)
+        if got is None:
+            got = {k: cell_for_node(k, alive)
+                   for k in range(N_SHARD_KEYS)}
+            self._ring_cache[key] = got
+        return got
+
+    def _live_orders(self) -> List[tuple]:
+        """Mover's order feed: the latest federation plan, minus any
+        order touching a currently dead cell."""
+        return [o for o in self._orders
+                if not self.cells[o[1]].dead
+                and not self.cells[o[2]].dead]
+
+    def _capacity(self, cell: _Cell) -> int:
+        """Requests one step of this cell's serving pool absorbs."""
+        if cell.dead:
+            return 0
+        return int(cell.serving.count * self.per_block_rps
+                   * self.cfg.step_s)
+
+    def _rehome(self, step: int, src: str, n: int,
+                alive: List[str]) -> Dict[str, int]:
+        """Split ``n`` dead-homed requests over the survivors the way
+        the REAL ring does: the dead cell's shard keys re-hash over
+        the alive set, load follows the keys."""
+        out: Dict[str, int] = {}
+        keys = self._home_keys[src]
+        if not keys or not alive:
+            return out
+        owners = self._owner_map(alive)
+        per, rem = divmod(n, len(keys))
+        for j, k in enumerate(keys):
+            share = per + (1 if j < rem else 0)
+            if share <= 0:
+                continue
+            dst = owners[k]
+            out[dst] = out.get(dst, 0) + share
+        return out
+
+    # -- one step ------------------------------------------------------------
+
+    def _storm_flags(self, t: float):
+        dead_idx = self.trace.dead_cells(t)
+        grays = self.trace.gray_at(t)
+        churn_storms = [s for s in self.trace.storms_at(t)
+                        if s.kind == "churn"]
+        return dead_idx, grays, churn_storms
+
+    def _apply_blackouts(self, dead_idx: Tuple[int, ...]) -> int:
+        """Flip cell liveness to match the trace; returns requests
+        stranded by cells that died this step."""
+        stranded = 0
+        dead_now = {self.cell_ids[i] for i in dead_idx}
+        for cid in self.cell_ids:
+            cell = self.cells[cid]
+            if cid in dead_now and not cell.dead:
+                cell.dead = True
+                lost = cell.backlog_n()
+                stranded += lost
+                cell.backlog = []
+            elif cid not in dead_now and cell.dead:
+                cell.dead = False
+        return stranded
+
+    def _apply_churn(self, step: int, churn_storms) -> int:
+        """Storm waves + background churn; returns members failed."""
+        failed = 0
+        t = step * self.cfg.step_s
+        for s in churn_storms:
+            # The wave hits once, at the storm's first step.
+            if int(s.at_s / self.cfg.step_s) != step:
+                continue
+            for i in s.cells:
+                cell = self.cells[self.cell_ids[i]]
+                if cell.dead:
+                    continue
+                failed += cell.serving.fail(
+                    int(cell.serving.count * s.severity)
+                )
+        for i, cid in enumerate(self.cell_ids):
+            cell = self.cells[cid]
+            if cell.dead:
+                continue
+            leaves = self.trace.churn_leaves(step, i)
+            if leaves:
+                role = cell.serving if (step + i) % 2 == 0 \
+                    else cell.training
+                failed += role.fail(leaves)
+        return failed
+
+    def _deliver_transfers(self, step: int, alive: List[str]) -> int:
+        """Land transfers due this step; gray duplicates are deduped
+        at the receiver.  Returns requests delivered."""
+        due = [tr for tr in self.transfers if tr[0] <= step]
+        if not due:
+            return 0
+        self.transfers = [tr for tr in self.transfers if tr[0] > step]
+        landed = 0
+        for _, dst, buckets, dup_n in due:
+            self.dup_dropped += dup_n
+            n = sum(b[1] for b in buckets)
+            cell = self.cells[dst]
+            if cell.dead:
+                # The target died while the transfer was in flight:
+                # re-home again over the current survivor set.
+                if not alive:
+                    self.blackout_lost += n
+                    continue
+                for nxt, share in sorted(
+                        self._rehome(step, dst, n, alive).items()):
+                    self.transfers.append(
+                        [step + 1, nxt, [[buckets[0][0], share]], 0]
+                    )
+                continue
+            cell.enqueue_aged(buckets)
+            self.spill_ingress += n
+            landed += n
+        return landed
+
+    def _serve(self, step: int, cell: _Cell) -> Tuple[int, int, int]:
+        """Drain one step of capacity FIFO; returns (served, in_slo,
+        timed_out)."""
+        # Age out requests past the deadline first (they would be
+        # rejected by the gateway's deadline sweep, not served late).
+        timed_out = 0
+        keep: List[List[int]] = []
+        for enq, n in cell.backlog:
+            if step - enq > self.timeout_steps:
+                timed_out += n
+            else:
+                keep.append([enq, n])
+        cell.backlog = keep
+        cap = self._capacity(cell)
+        served = in_slo = 0
+        while cap > 0 and cell.backlog:
+            enq, n = cell.backlog[0]
+            take = min(n, cap)
+            served += take
+            if step - enq <= self.slo_steps:
+                in_slo += take
+            cap -= take
+            if take == n:
+                cell.backlog.pop(0)
+            else:
+                cell.backlog[0][1] = n - take
+        return served, in_slo, timed_out
+
+    def _spill(self, step: int, alive: List[str],
+               grays) -> Tuple[int, int]:
+        """Policy-gated overflow forwarding for every saturated cell;
+        returns (spilled, duplicated)."""
+        spilled = dup_total = 0
+        views = {}
+        for cid in self.cell_ids:
+            cell = self.cells[cid]
+            cap = max(1, self._capacity(cell)) if not cell.dead else 1
+            views[cid] = {
+                "alive": not cell.dead,
+                "pressure": round(cell.backlog_n() / cap, 4),
+            }
+        for cid in alive:
+            cell = self.cells[cid]
+            cap = max(1, self._capacity(cell))
+            overflow = cell.backlog_n() - cap
+            rounds = 0
+            while overflow > 0 and rounds < self.spill_rounds:
+                rounds += 1
+                local = {"pressure": views[cid]["pressure"],
+                         "draining": False}
+                sibs = {c: views[c] for c in self.cell_ids if c != cid}
+                d = self.spill_policy.decide(local, sibs, hops=0)
+                if not d.forward:
+                    break
+                chunk = min(overflow, self.spill_cap)
+                buckets = self._take_newest(cell, chunk)
+                moved = sum(b[1] for b in buckets)
+                if moved <= 0:
+                    break
+                delay = 1
+                dup_n = 0
+                for s in grays:
+                    touched = {self.cell_ids[i] for i in s.cells}
+                    if cid in touched or d.target in touched:
+                        delay += s.delay_steps
+                        dup_n += sum(
+                            1 for j in range(moved)
+                            if self.trace.gray_duplicates(
+                                step, self.cell_ids.index(cid), j,
+                                s.severity)
+                        )
+                self.transfers.append(
+                    [step + delay, d.target, buckets, dup_n]
+                )
+                spilled += moved
+                dup_total += dup_n
+                overflow -= moved
+                tcap = max(1, self._capacity(self.cells[d.target]))
+                views[d.target]["pressure"] = round(
+                    views[d.target]["pressure"] + moved / tcap, 4
+                )
+        return spilled, dup_total
+
+    @staticmethod
+    def _take_newest(cell: _Cell, n: int) -> List[List[int]]:
+        """Pull up to ``n`` requests from the NEWEST buckets — the
+        router spills fresh admissions, never the queue head the local
+        pool is about to serve."""
+        taken: List[List[int]] = []
+        while n > 0 and cell.backlog:
+            enq, have = cell.backlog[-1]
+            take = min(have, n)
+            taken.append([enq, take])
+            n -= take
+            if take == have:
+                cell.backlog.pop()
+            else:
+                cell.backlog[-1][1] = have - take
+        taken.reverse()
+        return taken
+
+    def _federate(self, step: int) -> None:
+        """The real federation pass: merge -> place -> diff."""
+        alive = self._alive()
+        snaps = []
+        for cid in alive:
+            cell = self.cells[cid]
+            snaps.append({
+                "cell_id": cid,
+                "nodes": cell.serving.node_count
+                + cell.training.node_count,
+                "tasks_doing": self._capacity(cell),
+                "tasks_pending": cell.backlog_n(),
+                "placement_epoch": step // self.fed_every,
+                "pools": {
+                    "serving": {
+                        "alive": cell.serving.count,
+                        "slots": cell.serving.count,
+                        "assigned": min(cell.serving.count,
+                                        cell.backlog_n()),
+                        "queue_depth": cell.backlog_n(),
+                    },
+                },
+            })
+        merged = merge_cell_snapshots(snaps)
+        caps = {cid: {"capacity": self.cells[cid].blocks}
+                for cid in alive}
+        demands = {
+            "serving": sum(self.cells[c].serving.spec.desired
+                           for c in alive),
+            "training": sum(self.cells[c].training.spec.desired
+                            for c in alive),
+        }
+        # Training stays pinned where it runs (collectives in place);
+        # serving is the mobile role the mover rebalances.  The
+        # planner's one opinion: cells under sustained queue pressure
+        # get pinned ABOVE the uniform spread — the diff against the
+        # current placement becomes the mover's move orders (capacity
+        # follows load, the VirtualFlow argument).
+        uniform = demands["serving"] // max(1, len(alive))
+        pressured = sorted(
+            (
+                (ent["tasks_pending"]
+                 / max(1, ent["tasks_doing"]), cid)
+                for cid, ent in merged["cells"].items()
+            ),
+            reverse=True,
+        )
+        pinned_serving = {
+            cid: min(self.cells[cid].blocks, uniform + 2)
+            for p, cid in pressured[:2] if p > 0.5
+        }
+        pinned = {"training": {
+            c: self.cells[c].training.spec.desired for c in alive
+        }}
+        if pinned_serving:
+            pinned["serving"] = pinned_serving
+        target = place_roles(caps, demands, pinned=pinned)
+        current = {
+            "serving": {c: self.cells[c].serving.count for c in alive},
+            "training": {c: self.cells[c].training.count
+                         for c in alive},
+        }
+        self._orders = plan_moves(current, target)
+        self._merged_alive = merged.get("cells_alive", len(alive))
+
+    def _step(self, step: int) -> Dict[str, Any]:
+        t = step * self.cfg.step_s
+        self.clock.advance_to(t)
+        dead_idx, grays, churn_storms = self._storm_flags(t)
+        stranded = self._apply_blackouts(dead_idx)
+        self.stranded += stranded
+        churned = self._apply_churn(step, churn_storms)
+        alive = self._alive()
+
+        delivered = self._deliver_transfers(step, alive)
+
+        # -- arrivals.
+        arr = self.trace.arrivals(step)
+        offered = sum(arr)
+        self.offered += offered
+        lost = 0
+        rehomed = 0
+        for i, cid in enumerate(self.cell_ids):
+            n = arr[i]
+            if n <= 0:
+                continue
+            cell = self.cells[cid]
+            if not cell.dead:
+                cell.enqueue(step, n)
+                continue
+            if self.mode == "static" or not alive:
+                lost += n
+                continue
+            for dst, share in sorted(
+                    self._rehome(step, cid, n, alive).items()):
+                self.cells[dst].enqueue(step, share)
+            rehomed += n
+        self.blackout_lost += lost
+        self.rehomed += rehomed
+
+        # -- the autoscale opinion (logged; capacity moves via the
+        # borrow arbiter and the mover, which conserve nodes).
+        targets = {}
+        for cid in alive:
+            cell = self.cells[cid]
+            cap = max(1, self._capacity(cell))
+            targets[cid] = decide(
+                {
+                    "replicas_alive": cell.serving.count,
+                    "queue_depth": cell.backlog_n(),
+                    "occupancy": min(1.0, round(arr[
+                        self.cell_ids.index(cid)] / cap, 4)),
+                },
+                self.scale_policy,
+                self.scale_states[cid],
+            )
+
+        # -- serve one step of capacity everywhere.
+        served = in_slo = timed_out = 0
+        for cid in alive:
+            s, g, to = self._serve(step, self.cells[cid])
+            served += s
+            in_slo += g
+            timed_out += to
+        self.served += served
+        self.served_in_slo += in_slo
+        self.timeout += timed_out
+
+        # -- the data plane (global mode only): overflow spills.
+        spilled = dup_n = 0
+        if self.mode == "global":
+            spilled, dup_n = self._spill(step, alive, grays)
+            self.spilled += spilled
+
+        # -- the control plane: borrows, supervision, federation.
+        for cid in alive:
+            self.arbiters[cid].step()
+            self.cells[cid].serving.reconcile()
+            self.cells[cid].training.reconcile()
+        if self.mode == "global" and step % self.fed_every == 0:
+            self._federate(step)
+            for _ in range(self.mover_passes):
+                self.mover.step()
+        elif self.mode == "global":
+            for _ in range(self.mover_passes):
+                self.mover.step()
+
+        # -- storm-window accounting (blackout window + a 1h tail).
+        in_storm = bool(dead_idx)
+        if in_storm:
+            self._storm_tail = int(3600.0 / self.cfg.step_s)
+        elif self._storm_tail > 0:
+            self._storm_tail -= 1
+        if in_storm or self._storm_tail > 0:
+            self.storm_offered += offered
+            self.storm_in_slo += in_slo
+            self.storm_lost += lost + stranded
+
+        backlogs = tuple(self.cells[c].backlog_n()
+                         for c in self.cell_ids)
+        line = {
+            "t": step,
+            "off": offered,
+            "rh": rehomed,
+            "sv": served,
+            "slo": in_slo,
+            "to": timed_out,
+            "lost": lost,
+            "str": stranded,
+            "sp": spilled,
+            "dl": delivered,
+            "dup": dup_n,
+            "bl": sum(backlogs),
+            "bh": zlib.crc32(repr(backlogs).encode()),
+            "dead": list(dead_idx),
+            "ch": churned,
+            "bor": sum(a.borrowed for a in self.arbiters.values()),
+            "mv": self.mover.moved,
+            "lad": self.mover.laddered,
+            "tgt": zlib.crc32(repr(sorted(targets.items())).encode()),
+        }
+        self._digest.update(
+            (json.dumps(line, sort_keys=True) + "\n").encode()
+        )
+        self._log_lines += 1
+        return line
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self) -> Dict[str, Any]:
+        for step in range(self.cfg.n_steps):
+            self._step(step)
+        backlog_final = sum(c.backlog_n() for c in self.cells.values())
+        in_transit = sum(sum(b[1] for b in tr[2])
+                         for tr in self.transfers)
+        accounted = (self.served + self.timeout + self.blackout_lost
+                     + self.stranded + backlog_final + in_transit)
+        storm_off = max(1, self.storm_offered)
+        return {
+            "mode": self.mode,
+            "trace": self.trace.describe(),
+            "nodes": self.node_count,
+            "steps": self.cfg.n_steps,
+            "offered": self.offered,
+            "served": self.served,
+            "served_in_slo": self.served_in_slo,
+            "slo_goodput": round(
+                self.served_in_slo / max(1, self.offered), 4),
+            "timeout": self.timeout,
+            "blackout_lost": self.blackout_lost,
+            "stranded": self.stranded,
+            "spilled": self.spilled,
+            "spill_ingress": self.spill_ingress,
+            "rehomed": self.rehomed,
+            "dup_dropped": self.dup_dropped,
+            "borrow_events": sum(len(a.events)
+                                 for a in self.arbiters.values()),
+            "moved_blocks": self.mover.moved,
+            "laddered": self.mover.laddered,
+            "storm_offered": self.storm_offered,
+            "storm_in_slo": self.storm_in_slo,
+            "storm_goodput": round(self.storm_in_slo / storm_off, 4),
+            "storm_lost": self.storm_lost,
+            "backlog_final": backlog_final,
+            "in_transit_final": in_transit,
+            "conservation_ok": accounted == self.offered,
+            "event_log_lines": self._log_lines,
+            "event_log_sha256": self._digest.hexdigest(),
+        }

@@ -1,0 +1,176 @@
+"""Synthetic fleet traces: diurnal load, hot-cell skew, chaos storms.
+
+:class:`TraceGenerator` is a registered policy object and a pure
+function of its :class:`TraceConfig` — the VirtualFlow premise
+(capacity planning as a function of predicted load) is only testable
+if the load itself replays bit-for-bit.  Every number it emits is
+derived through :mod:`dlrover_tpu.sim.rand`'s coordinate hashing, so
+``TraceGenerator(cfg).arrivals(step)`` is the same tuple on every
+call, every run, every machine with the same ``cfg.seed``.
+
+The trace grammar (README "Wind tunnel" documents it for PR authors):
+
+* **load**: a sinusoidal diurnal rate around ``base_rps`` with
+  amplitude ``diurnal_amp`` (trough at t=0, peak at half period),
+  split over cells by a Zipf(``zipf_a``) share vector — cell 0 is the
+  hot region; per-(step, cell) request counts are Poisson draws.
+* **storms**: first-class trace events, not harness hacks.  A
+  :class:`StormSpec` names a kind (``blackout`` — the named cells
+  answer nothing for the window; ``net_gray`` — cross-cell transfers
+  succeed but arrive ``delay_steps`` late and duplicate with
+  probability ``severity``; ``churn`` — a wave that detaches
+  ``severity`` of each named cell's nodes, which rejoin after the
+  window), a window ``[at_s, at_s + duration_s)`` and the target
+  cells.  Correlated failure is the default posture: one storm, many
+  cells, same instant.
+* **churn noise**: below storm scale, background node churn per
+  (step, cell) is itself a seeded Poisson draw at ``churn_rate_s``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Tuple
+
+from .rand import cdf_of, poisson, u01, zipf_shares
+
+
+@dataclasses.dataclass(frozen=True)
+class StormSpec:
+    """One chaos storm as a trace event (see module doc for kinds)."""
+
+    kind: str                  # "blackout" | "net_gray" | "churn"
+    at_s: float
+    duration_s: float
+    cells: Tuple[int, ...] = ()
+    #: net_gray: duplicate probability; churn: fraction detached.
+    severity: float = 0.0
+    #: net_gray: extra transfer latency, in whole steps.
+    delay_steps: int = 1
+
+    def active(self, t: float) -> bool:
+        return self.at_s <= t < self.at_s + self.duration_s
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceConfig:
+    """Everything the trace is a function of.  Frozen: the config IS
+    the trace identity (plus nothing)."""
+
+    seed: int = 0
+    n_cells: int = 24
+    nodes: int = 10000
+    duration_s: float = 86400.0
+    step_s: float = 30.0
+    base_rps: float = 1000.0
+    diurnal_amp: float = 0.6
+    diurnal_period_s: float = 86400.0
+    zipf_a: float = 0.6
+    churn_rate_s: float = 0.001   # background leaves/s per cell
+    storms: Tuple[StormSpec, ...] = ()
+
+    @property
+    def n_steps(self) -> int:
+        return int(self.duration_s / self.step_s)
+
+
+class TraceGenerator:
+    """Pure trace oracle: same config -> same trace, query by query."""
+
+    def __init__(self, config: TraceConfig):
+        self.cfg = config
+        self._shares = zipf_shares(config.n_cells, config.zipf_a)
+        self._cdf = cdf_of(self._shares)
+
+    # -- load --------------------------------------------------------------
+
+    def rate_at(self, t: float) -> float:
+        """Fleet-wide arrival rate (rps) at virtual time ``t``: the
+        diurnal sinusoid, trough at t=0."""
+        cfg = self.cfg
+        phase = 2.0 * math.pi * (t / cfg.diurnal_period_s)
+        return max(
+            0.0,
+            cfg.base_rps * (1.0 + cfg.diurnal_amp * -math.cos(phase)),
+        )
+
+    def share(self, cell: int) -> float:
+        return self._shares[cell]
+
+    def arrivals(self, step: int) -> Tuple[int, ...]:
+        """Request count per cell for ``step`` (Poisson per cell)."""
+        cfg = self.cfg
+        t = step * cfg.step_s
+        lam_total = self.rate_at(t) * cfg.step_s
+        return tuple(
+            poisson(cfg.seed, f"arr:{step}:{c}",
+                    lam_total * self._shares[c])
+            for c in range(cfg.n_cells)
+        )
+
+    def home_of(self, step: int, n: int) -> int:
+        """Home cell of the ``n``-th request of ``step`` — the
+        per-request view of the same Zipf split, for micro rigs."""
+        return self._pick_cell(u01(self.cfg.seed, f"home:{step}", n))
+
+    def _pick_cell(self, u: float) -> int:
+        lo, hi = 0, len(self._cdf) - 1
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._cdf[mid] < u:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    # -- chaos -------------------------------------------------------------
+
+    def storms_at(self, t: float) -> Tuple[StormSpec, ...]:
+        """Storms whose window covers virtual time ``t``, in trace
+        order (the declaration order in the config)."""
+        return tuple(s for s in self.cfg.storms if s.active(t))
+
+    def dead_cells(self, t: float) -> Tuple[int, ...]:
+        """Cells blacked out at ``t`` (sorted, deduplicated)."""
+        dead: List[int] = []
+        for s in self.cfg.storms:
+            if s.kind == "blackout" and s.active(t):
+                dead.extend(s.cells)
+        return tuple(sorted({c: None for c in dead}))
+
+    def gray_at(self, t: float) -> Tuple[StormSpec, ...]:
+        return tuple(s for s in self.cfg.storms
+                     if s.kind == "net_gray" and s.active(t))
+
+    def gray_duplicates(self, step: int, cell: int, n: int,
+                        severity: float) -> bool:
+        """Does the ``n``-th gray transfer out of ``cell`` at ``step``
+        get duplicated?  A seeded coin, same shape as the chaos plan's
+        crc32 decision."""
+        return u01(self.cfg.seed, f"gray:{step}:{cell}", n) < severity
+
+    def churn_leaves(self, step: int, cell: int) -> int:
+        """Background node departures for (step, cell)."""
+        cfg = self.cfg
+        return poisson(cfg.seed, f"churn:{step}:{cell}",
+                       cfg.churn_rate_s * cfg.step_s)
+
+    # -- identity ----------------------------------------------------------
+
+    def describe(self) -> Dict[str, object]:
+        """A json-stable summary for event logs and artifacts."""
+        cfg = self.cfg
+        return {
+            "seed": cfg.seed,
+            "n_cells": cfg.n_cells,
+            "nodes": cfg.nodes,
+            "duration_s": cfg.duration_s,
+            "step_s": cfg.step_s,
+            "base_rps": cfg.base_rps,
+            "diurnal_amp": cfg.diurnal_amp,
+            "zipf_a": cfg.zipf_a,
+            "hot_share": round(self._shares[0], 4) if self._shares
+            else 0.0,
+            "storms": [dataclasses.asdict(s) for s in cfg.storms],
+        }

@@ -673,3 +673,75 @@ def test_global_bench_smoke_schema(tmp_path):
     assert metric["value"] == by_mode["spillover"]["goodput_rps"]
     assert metric["speedup"] > 1.0
     assert metric["artifact"] == str(out)
+
+
+def test_sim_bench_smoke_schema(tmp_path):
+    """Tier-1 gate for ISSUE 18's wind tunnel: ``--sim_bench --smoke``
+    runs all three rigs end to end on CPU — the fidelity replays of the
+    committed GLOBAL/CELL bench artifacts, a scaled chaos-storm day
+    (blackout + gray network + churn over 2,000 nodes) in static and
+    global modes, and the double-run digest — inside the sub-5s spec,
+    emitting schema-valid JSON and the standard metric line."""
+    import os
+    import subprocess
+    import time
+
+    out = tmp_path / "SIM_BENCH_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(Path(bench.__file__)), "--sim_bench",
+         "--smoke", f"--out={out}"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(Path(bench.__file__).parent),
+    )
+    elapsed = time.time() - t0
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # <5s is the spec on an idle host; allow CI contention headroom but
+    # fail loudly if the smoke config ever becomes heavyweight.
+    assert elapsed < 30.0, f"smoke sim bench took {elapsed:.1f}s"
+    result = json.loads(out.read_text())
+    assert result["bench"] == "sim"
+    assert result["smoke"] is True
+    assert result["complete"] is True
+    # Fidelity: every replayed row of BOTH committed artifacts within
+    # its rig's stated tolerance (the constants are calibrated against
+    # ONE row each; the rest are predictions).
+    for rig in ("fidelity_global", "fidelity_cell"):
+        sect = result[rig]
+        assert sect["ok"] is True and sect["rows"], rig
+        for row in sect["rows"]:
+            assert row["within_tolerance"] is True, (rig, row)
+            assert row["err"] <= sect["tolerance"]
+    assert {(r["mode"], r["blackout"])
+            for r in result["fidelity_global"]["rows"]} \
+        >= {("static", True), ("spillover", True)}
+    # The storm: identical trace in both modes, conservation exact,
+    # the global data plane strictly better through the storm window,
+    # and the double-run law on the event-log digest.
+    storm = result["storm"]
+    for mode in ("static", "global"):
+        row = storm[mode]
+        assert row["conservation_ok"] is True
+        assert row["offered"] == row["served"] + row["timeout"] \
+            + row["blackout_lost"] + row["stranded"] \
+            + row["backlog_final"] + row["in_transit_final"]
+        assert row["nodes"] == 2000 and row["event_log_lines"] > 0
+    assert storm["static"]["blackout_lost"] > 0
+    # The global plane re-homes every dead-cell arrival: none lost.
+    assert storm["global"]["blackout_lost"] == 0
+    assert storm["global"]["rehomed"] > 0
+    assert storm["global"]["spilled"] > 0
+    assert storm["double_run_identical"] is True
+    verdicts = result["verdicts"]
+    for key in ("fidelity_global_ok", "fidelity_cell_ok",
+                "storm_conserved", "global_beats_static_storm",
+                "double_run_identical", "spill_exercised",
+                "day_under_60s_wall"):
+        assert verdicts[key] is True, key
+    assert storm["global"]["storm_goodput"] > \
+        storm["static"]["storm_goodput"]
+    metric = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert metric["metric"] == "sim_storm_slo_goodput_10k_nodes"
+    assert metric["value"] == storm["global"]["storm_goodput"]
+    assert metric["artifact"] == str(out)

@@ -566,3 +566,85 @@ class TestLatencySites:
         assert slow >= 0.075
         assert fast < 0.05
         assert chaos.active_plan().stats()["serving.slow_replica"] == 1
+
+
+@pytest.mark.chaos
+class TestGrayNetwork:
+    """``net.gray`` (ISSUE 18): the RPC *succeeds* — the failure modes
+    are time and multiplicity.  Armed against the real injection point
+    (``RpcClient.call`` after a successful send) and against the
+    documented contract: the receiver's dedupe, not the retry
+    machinery, absorbs the wire duplicate."""
+
+    def test_delays_and_duplicates_over_the_wire(self):
+        seen = []
+
+        def handler(msg):
+            seen.append(type(msg).__name__)
+            return msgs.BaseResponse(success=True)
+
+        server = RpcServer(0, handler)
+        server.start()
+        try:
+            chaos.configure("net.gray:times=1,delay=60ms")
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            t0 = time.monotonic()
+            resp = client.call(msgs.Heartbeat())
+            gray = time.monotonic() - t0
+            # The call SUCCEEDED (nothing dropped) ...
+            assert isinstance(resp, msgs.BaseResponse) and resp.success
+            # ... but the reply came back late and the server executed
+            # the request TWICE (the wire duplicate).
+            assert gray >= 0.055
+            assert seen == ["Heartbeat", "Heartbeat"]
+            # Budget spent: the next call is fast and single.
+            t1 = time.monotonic()
+            client.call(msgs.Heartbeat())
+            assert time.monotonic() - t1 < 0.05
+            assert seen == ["Heartbeat", "Heartbeat", "Heartbeat"]
+            assert chaos.active_plan().stats()["net.gray"] == 1
+            client.close()
+        finally:
+            server.stop()
+
+    def test_duplicate_absorbed_by_receiver_dedupe(self):
+        """The site's contract end to end: a gray-duplicated tokened
+        mutation executes twice on the wire but mutates ONCE — the
+        idempotency token, not luck, is what holds."""
+        from dlrover_tpu.master.kv_store import KVStoreService
+        from dlrover_tpu.master.servicer import MasterServicer
+
+        servicer = MasterServicer(kv_store=KVStoreService())
+        server = RpcServer(0, servicer)
+        server.start()
+        try:
+            chaos.configure("net.gray:times=1,delay=0ms")
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            add = msgs.KVStoreAdd(key="g", delta=2, token="gray-tok")
+            r = client.call(add)  # duplicated on the wire by the site
+            assert r.value == 2
+            assert chaos.active_plan().stats()["net.gray"] == 1
+            # A fresh token proves the counter itself still moves.
+            r2 = client.call(
+                msgs.KVStoreAdd(key="g", delta=2, token="tok-2")
+            )
+            assert r2.value == 4
+            client.close()
+        finally:
+            server.stop()
+
+    def test_seeded_decisions_are_deterministic(self):
+        """The n-th evaluation's fire/skip decision is a pure function
+        of (seed, site, n): two plans with the same seed produce the
+        identical firing pattern, a different seed a different one."""
+        def pattern(seed):
+            plan = FaultPlan.parse(f"net.gray:p=0.5,seed={seed}")
+            return [
+                plan.fire("net.gray", method="Heartbeat") is not None
+                for _ in range(64)
+            ]
+
+        a, b, c = pattern(11), pattern(11), pattern(12)
+        assert a == b
+        assert 0 < sum(a) < 64  # p=0.5 actually flips both ways
+        assert a != c

@@ -22,9 +22,15 @@ from dlrover_tpu.cells.federation import (
     merge_cell_snapshots,
     place_roles,
 )
-from dlrover_tpu.fleet.policy import BorrowPolicy, ChipBorrowArbiter
+from dlrover_tpu.fleet.policy import (
+    BorrowPolicy,
+    ChipBorrowArbiter,
+    CrossCellMover,
+    MovePolicy,
+)
 from dlrover_tpu.serving.autoscale import ScalePolicy, decide_pools
 from dlrover_tpu.serving.gateway import GatewayConfig, GatewayCore
+from dlrover_tpu.serving.spillover import SpilloverConfig, SpilloverPolicy
 
 pytestmark = pytest.mark.determinism
 
@@ -258,3 +264,150 @@ def _arbiter_trace() -> bytes:
 class TestBorrowArbiterDeterminism:
     def test_double_run_byte_identical(self):
         assert _arbiter_trace() == _arbiter_trace()
+
+
+# ---------------------------------------------------------------------------
+# SpilloverPolicy (ISSUE 18: the wind tunnel drives this per request)
+# ---------------------------------------------------------------------------
+
+
+def _spillover_trace() -> bytes:
+    """A scripted saturation ramp with a transport failure mid-way:
+    the cooldown bookkeeping rides the injected clock, so the same
+    schedule must pick the same siblings byte-for-byte."""
+    clock = FakeClock()
+    policy = SpilloverPolicy(
+        SpilloverConfig(failure_cooldown_s=5.0), clock=clock)
+    trace = []
+    for step in range(8):
+        local = {"pressure": 0.2 * step, "draining": False}
+        siblings = {
+            "cell-east": {"alive": True, "pressure": 0.3 + 0.05 * step},
+            "cell-west": {"alive": True,
+                          "in_flight": 4 * step, "queue_cap": 64},
+            "cell-down": {"alive": False, "pressure": 0.0},
+        }
+        d = policy.decide(local, siblings, hops=0)
+        trace.append(("decide", step, d.forward, d.target, d.reason))
+        if d.forward and step == 5:
+            # The forward's transport fails: the target cools down.
+            policy.note_failure(d.target)
+            trace.append(("note_failure", d.target))
+        clock.advance(1.0)
+    # Inside the cooldown window the failed sibling is excluded...
+    hot = {"pressure": 1.0, "draining": False}
+    view = {
+        "cell-east": {"alive": True, "pressure": 0.1},
+        "cell-west": {"alive": True, "pressure": 0.2},
+    }
+    d = policy.decide(hot, view, hops=0)
+    trace.append(("cooldown", d.forward, d.target, d.reason))
+    # ...and past it the sibling is offered again.
+    clock.advance(10.0)
+    d = policy.decide(hot, view, hops=0)
+    trace.append(("recovered", d.forward, d.target, d.reason))
+    # Hop budget and drain-forced forwards are part of the surface.
+    d = policy.decide(hot, view, hops=1)
+    trace.append((d.forward, d.target, d.reason))
+    d = policy.decide({"pressure": 0.0, "draining": True}, view, hops=0)
+    trace.append((d.forward, d.target, d.reason))
+    return _bytes(trace)
+
+
+class TestSpilloverDeterminism:
+    def test_double_run_byte_identical(self):
+        assert _spillover_trace() == _spillover_trace()
+
+
+# ---------------------------------------------------------------------------
+# CrossCellMover (ISSUE 18: the wind tunnel actuates federation moves)
+# ---------------------------------------------------------------------------
+
+
+class _MoverRole:
+    """Scripted cell-role backend for the mover: drains take a
+    scripted number of pumps (0 = immediate), members leave when the
+    drain completes."""
+
+    def __init__(self, name, members, holds):
+        self.name = name
+        self.members = list(members)
+        self._holds = list(holds)   # per-drain pump counts, in order
+        self._hold = 0
+        self._victim = None
+
+    def observe(self):
+        from dlrover_tpu.fleet.role import RoleStatus
+
+        return RoleStatus(members=tuple(self.members))
+
+    def spawn(self, n):
+        for _ in range(n):
+            self.members.append(f"{self.name}-g{len(self.members)}")
+        return n
+
+    def begin_drain(self):
+        if not self.members:
+            return None
+        self._victim = self.members[-1]
+        self._hold = self._holds.pop(0) if self._holds else 0
+        return self._victim
+
+    def drain_pending(self):
+        return self._hold > 0
+
+    def pump_drain(self):
+        if self._hold > 0:
+            self._hold -= 1
+            if self._hold == 0 and self._victim in self.members:
+                self.members.remove(self._victim)
+                self._victim = None
+
+
+def _mover_trace() -> bytes:
+    from dlrover_tpu.fleet.role import RoleAdapter, RoleSpec
+
+    def adapter(spec, impl):
+        a = RoleAdapter.__new__(RoleAdapter)
+        RoleAdapter.__init__(a, spec)
+        for m in ("observe", "spawn", "begin_drain",
+                  "drain_pending", "pump_drain"):
+            setattr(a, m, getattr(impl, m))
+        return a
+
+    src_impl = _MoverRole("a", ["a0", "a1", "a2"], holds=[1, 9])
+    dst_impl = _MoverRole("b", ["b0"], holds=[])
+    src = adapter(RoleSpec("serving", desired=3, min_count=1,
+                           max_count=8), src_impl)
+    dst = adapter(RoleSpec("serving", desired=1, min_count=0,
+                           max_count=4), dst_impl)
+    orders = [("serving", "cell-a", "cell-b", 2)]
+    mover = CrossCellMover(
+        lambda: orders,
+        {"cell-a": {"serving": src}, "cell-b": {"serving": dst}},
+        MovePolicy(drain_budget_passes=3, cooldown_passes=1),
+    )
+    trace = []
+    for _pass in range(14):
+        phase = mover.step()
+        trace.append((phase, mover.moved, mover.laddered,
+                      len(src_impl.members), len(dst_impl.members),
+                      src.spec.desired, dst.spec.desired))
+        if mover.moved + mover.laddered >= 2:
+            orders = []  # both scripted drains consumed: stop ordering
+    trace.append(mover.events)
+    return _bytes(trace)
+
+
+class TestCrossCellMoverDeterminism:
+    def test_double_run_byte_identical(self):
+        trace = _mover_trace()
+        assert trace == _mover_trace()
+
+    def test_scripted_moves_and_ladder_both_fire(self):
+        """The trace exercises BOTH outcomes: one completed move (the
+        1-pump drain) and one restart-ladder abort (the 9-pump drain
+        blowing the 3-pass budget)."""
+        trace = json.loads(_mover_trace().decode())
+        final = trace[-2]
+        assert final[1] == 1 and final[2] == 1, trace

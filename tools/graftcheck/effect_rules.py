@@ -55,7 +55,9 @@ _SANDBOX_KINDS = {"thread_spawn", "blocking_io", "env_read",
 #: elsewhere in the same class bypass it.
 _SEAM_ATTRS = {"self._clock", "self.clock"}
 
-_AUDIT_MUTATORS = {"append", "add", "insert", "setdefault", "update"}
+#: the mutator-attr set itself lives in project_model (the pass-1
+#: walk collects candidate call sites for us).
+from .project_model import _AUDIT_MUTATOR_ATTRS as _AUDIT_MUTATORS  # noqa: E402,F401
 
 
 # ---------------------------------------------------------------------------
@@ -154,7 +156,13 @@ def _has_clock_seam(cls: ast.ClassDef) -> bool:
     """Does the class assign a CALLABLE to ``self._clock``/``
     self.clock``?  ``self._clock = clock`` (param) and ``self._clock =
     time.monotonic`` (default) are seams; ``= time.monotonic()`` (a
-    stored instant) is not."""
+    stored instant) is not.  Memoized on the node itself: seam-source
+    resolution re-asks this for every (class, collaborator) pair, and
+    re-walking a big class body each time dominated DET701."""
+    cached = getattr(cls, "_graftcheck_has_seam", None)
+    if cached is not None:
+        return cached
+    found = False
     for node in ast.walk(cls):
         if not isinstance(node, ast.Assign):
             continue
@@ -163,8 +171,12 @@ def _has_clock_seam(cls: ast.ClassDef) -> bool:
             continue
         for t in node.targets:
             if _dotted(t) in _SEAM_ATTRS:
-                return True
-    return False
+                found = True
+                break
+        if found:
+            break
+    cls._graftcheck_has_seam = found
+    return found
 
 
 def _seam_source(model: ProjectModel, ci: ClassInfo) -> Optional[str]:
@@ -223,42 +235,40 @@ def _contains_wall_call(node: ast.AST) -> bool:
 
 
 def _audit_stamp_findings(model: ProjectModel) -> List[Finding]:
+    # Candidates come from the model's single pass-1 walk
+    # (``mutator_calls`` / ``subscript_assigns``) — re-walking every
+    # tree here dominated the --changed latency budget.
     findings: List[Finding] = []
-    for fi in model.files.values():
-        for node in ast.walk(fi.tree):
-            line = getattr(node, "lineno", 0)
-            if isinstance(node, ast.Call) and \
-                    isinstance(node.func, ast.Attribute) and \
-                    node.func.attr in _AUDIT_MUTATORS:
-                container = _dotted(node.func.value)
-                if container is None or \
-                        not container.startswith("self."):
-                    continue
-                if any(_contains_wall_call(a) for a in node.args) or \
-                        any(_contains_wall_call(kw.value)
-                            for kw in node.keywords):
+    for path, node in model.mutator_calls:
+        container = _dotted(node.func.value)
+        if container is None or not container.startswith("self."):
+            continue
+        if any(_contains_wall_call(a) for a in node.args) or \
+                any(_contains_wall_call(kw.value)
+                    for kw in node.keywords):
+            findings.append(Finding(
+                "DET705", path, node.lineno,
+                f"wall-clock stamp recorded into {container} — "
+                "replay compares stored decision/audit "
+                "sequences, and wall stamps can never be "
+                "byte-identical across runs; stamp via the "
+                "injected clock",
+            ))
+    for path, node in model.subscript_assigns:
+        if not _contains_wall_call(node.value):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                container = _dotted(t.value)
+                if container is not None and \
+                        container.startswith("self."):
                     findings.append(Finding(
-                        "DET705", fi.path, line,
-                        f"wall-clock stamp recorded into {container} — "
-                        "replay compares stored decision/audit "
-                        "sequences, and wall stamps can never be "
-                        "byte-identical across runs; stamp via the "
+                        "DET705", path, node.lineno,
+                        f"wall-clock stamp stored into "
+                        f"{container}[...] — replayed state "
+                        "can never match; stamp via the "
                         "injected clock",
                     ))
-            elif isinstance(node, ast.Assign) and \
-                    _contains_wall_call(node.value):
-                for t in node.targets:
-                    if isinstance(t, ast.Subscript):
-                        container = _dotted(t.value)
-                        if container is not None and \
-                                container.startswith("self."):
-                            findings.append(Finding(
-                                "DET705", fi.path, line,
-                                f"wall-clock stamp stored into "
-                                f"{container}[...] — replayed state "
-                                "can never match; stamp via the "
-                                "injected clock",
-                            ))
     return findings
 
 

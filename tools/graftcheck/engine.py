@@ -146,6 +146,10 @@ def _parse_suppressions(
     meta: List[Finding] = []
     pending: Dict[str, str] = {}
     pending_line = 0
+    if "graftcheck:" not in source:
+        # No directive can possibly match — skip the tokenize pass
+        # (it dominates suppression parsing on a clean tree).
+        return per_line, meta
     comments = _comment_cols(source)
     for lineno, text in enumerate(source.splitlines(), start=1):
         stripped = text.strip()
@@ -236,9 +240,12 @@ def _analyze_sources(
                 ))
             continue
         infos.append(FileInfo(path=path, source=source, tree=tree))
-        sup, meta = _parse_suppressions(source, path)
-        suppress[path] = sup
         if path in targets:
+            # Suppressions only ever apply to REPORTED findings, and
+            # reporting is target-filtered — parsing them for the
+            # whole model would pay tokenize for nothing.
+            sup, meta = _parse_suppressions(source, path)
+            suppress[path] = sup
             findings.extend(meta)
             for rule_mod in (jax_rules, concurrency_rules, obs_rules):
                 findings.extend(rule_mod.check(tree, path))
@@ -380,11 +387,16 @@ def run_project(
     ]
     model_files = list(target_files)
     if model_paths is not None:
-        seen = set(model_files)
+        # Dedupe on ABSOLUTE identity: the CLI passes cwd-relative
+        # `paths` alongside an absolute model root, and a file parsed
+        # under both spellings would enter the model twice — the
+        # duplicate then dodges every `p != decl.path` exclusion
+        # (e.g. the chaos table listed plan.py as its own injector).
+        seen = {os.path.abspath(p) for p in model_files}
         for p in iter_py_files(model_paths):
             norm = os.path.normpath(p)
-            if norm not in seen:
-                seen.add(norm)
+            if os.path.abspath(norm) not in seen:
+                seen.add(os.path.abspath(norm))
                 model_files.append(norm)
     if targets is not None:
         # Absolute-path matching: git names are repo-root-relative

@@ -108,6 +108,14 @@ REGISTRY: Tuple[PolicyObject, ...] = (
         "per-process slice assignment for sliced checkpoints",
     ),
     PolicyObject(
+        "dlrover_tpu/sim/events.py", "SimScheduler", "class",
+        "the wind tunnel's event queue (seeded order, injected clock)",
+    ),
+    PolicyObject(
+        "dlrover_tpu/sim/trace.py", "TraceGenerator", "class",
+        "synthetic fleet traces (pure function of TraceConfig)",
+    ),
+    PolicyObject(
         "dlrover_tpu/models/llama_infer.py", "_spec_k_request",
         "function",
         "speculative-k controller (request-level EWMA policy)",

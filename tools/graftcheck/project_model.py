@@ -56,6 +56,11 @@ _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
 
 _INJECT_FUNCS = {"inject", "site_armed", "has_site"}
 
+#: container-mutating method names whose call sites feed the DET705
+#: audit-stamp scan (effect_rules imports this — single source).
+_AUDIT_MUTATOR_ATTRS = {"append", "add", "insert", "setdefault",
+                        "update"}
+
 
 def module_of(path: str) -> str:
     """A stable, repo-relative module label for ``path`` (used in
@@ -583,6 +588,12 @@ class ProjectModel:
         #: over every tree — rules must never re-walk the program per
         #: dispatch entry; the ``--changed`` loop has a latency budget).
         self.ctor_calls: Dict[str, List[Tuple[str, "ast.Call"]]] = {}
+        #: DET705 candidates, collected in the same single walk:
+        #: ``self.<container>.append/add/...(...)`` calls and
+        #: ``<target>[...] = <value>`` subscript assigns.  The audit-
+        #: stamp rule filters these instead of re-walking every tree.
+        self.mutator_calls: List[Tuple[str, "ast.Call"]] = []
+        self.subscript_assigns: List[Tuple[str, "ast.Assign"]] = []
 
     # -- lookups used by the rules --------------------------------------
     def classes_named(self, name: str) -> List[ClassInfo]:
@@ -718,6 +729,9 @@ def _node_sites_assign(model: ProjectModel, fi: FileInfo,
                        node: ast.AST) -> None:
     targets = node.targets if isinstance(node, ast.Assign) \
         else [node.target]
+    if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Subscript) for t in targets):
+        model.subscript_assigns.append((fi.path, node))
     tnames = {t.id for t in targets if isinstance(t, ast.Name)}
     if "SITES" not in tnames or not isinstance(node.value, ast.Dict):
         return
@@ -760,6 +774,9 @@ def _node_call(model: ProjectModel, fi: FileInfo, node: ast.Call,
         fname = f.attr
     if fname and fname[0].isupper():
         model.ctor_calls.setdefault(fname, []).append((fi.path, node))
+    if isinstance(f, ast.Attribute) and f.attr in _AUDIT_MUTATOR_ATTRS \
+            and (node.args or node.keywords):
+        model.mutator_calls.append((fi.path, node))
     # isinstance(msg, X) handler guards.
     if (isinstance(f, ast.Name) and f.id == "isinstance"
             and len(node.args) == 2):
