@@ -1996,7 +1996,9 @@ def serve_bench_main(argv: list) -> int:
     ``--replicas=1,2`` (rows) ``--device_round_ms=F`` (20)
     ``--seed=N`` ``--out=PATH`` ``--smoke`` (tiny single-replica
     in-process row for the tier-1 gate: loopback transport, no
-    subprocesses, no round floor).
+    subprocesses, no round floor).  ``--tracing_only`` /
+    ``--paged_only`` re-measure just that section and merge it into
+    the existing artifact.
     """
     import argparse
     import os
@@ -2034,6 +2036,18 @@ def serve_bench_main(argv: list) -> int:
         "spec_chips": 4, "spec_requests": 32, "spec_mnt": 48,
         "spec_rps": 0.0, "spec_slo_ms": 0.0, "spec_k": 4,
         "spec_draft_ratio": 0.25,
+        # Paged-KV rows (ISSUE 19): direct in-process DecodeServer A/B
+        # at MATCHED KV memory — `slotted` reserves paged_slots x
+        # max_len tokens per layer; `paged` gets a block pool of the
+        # SAME token count (paged_slots x max_len / block_size blocks)
+        # but paged_seat_factor x more seats, so admission is bounded
+        # by memory actually needed, not by reservations.  Two
+        # workloads: `uniform` (moderate length spread) and `longtail`
+        # (Zipf sequence lengths — where slotted strands the most
+        # capacity behind max_len reservations).
+        "paged_requests": 24, "paged_mnt": 16, "paged_slots": 4,
+        "paged_block_size": 8, "paged_max_len": 64,
+        "paged_seat_factor": 3,
     }
     replicas_rows = [1, 2]
     out_path = None
@@ -2042,9 +2056,15 @@ def serve_bench_main(argv: list) -> int:
     #: it into the existing artifact — the committed overhead row does
     #: not require re-running the whole serve bench.
     tracing_only = False
+    #: Same contract for the paged-KV section (ISSUE 19): re-measure
+    #: ONLY the slotted-vs-paged A/B and merge it into the existing
+    #: artifact.
+    paged_only = False
     for a in argv:
         if a == "--tracing_only":
             tracing_only = True
+        elif a == "--paged_only":
+            paged_only = True
         elif a == "--smoke":
             smoke = True
             opts.update(requests=5, mnt=6, device_round_ms=0.0,
@@ -2054,7 +2074,9 @@ def serve_bench_main(argv: list) -> int:
                         routing_d_model=64, routing_d_ff=128,
                         prefix_len=28, prefix_templates=2,
                         spec_chips=2, spec_requests=4, spec_mnt=12,
-                        spec_rps=50.0, spec_k=3)
+                        spec_rps=50.0, spec_k=3,
+                        paged_requests=6, paged_mnt=6, paged_slots=2,
+                        paged_max_len=32)
             replicas_rows = [1]
         elif a.startswith("--out="):
             out_path = a.split("=", 1)[1]
@@ -2125,15 +2147,15 @@ def serve_bench_main(argv: list) -> int:
         with open(out_path) as f:
             prior = json.load(f)
         if isinstance(prior, dict):
-            if tracing_only:
+            if tracing_only or paged_only:
                 prior.setdefault("rows", [])
                 result = prior
             elif "load" in prior:
                 result["load"] = prior["load"]
     except (OSError, ValueError):
-        if tracing_only:
-            print("--tracing_only needs an existing artifact at "
-                  f"{out_path}", file=sys.stderr)
+        if tracing_only or paged_only:
+            print("--tracing_only/--paged_only need an existing "
+                  f"artifact at {out_path}", file=sys.stderr)
             return 2
 
     def flush():
@@ -2412,7 +2434,7 @@ def serve_bench_main(argv: list) -> int:
             flush()
             print(f"{label}replicas={n}: {row}", file=sys.stderr)
 
-    if not tracing_only:
+    if not tracing_only and not paged_only:
         run_rows(result["rows"])
 
     def _speedup(rows):
@@ -2426,7 +2448,8 @@ def serve_bench_main(argv: list) -> int:
             return None, None
         return round(by_n[best_n]["tokens_per_sec"] / base, 2), best_n
 
-    if not smoke and not tracing_only and opts["device_round_ms"] > 0:
+    if not smoke and not tracing_only and not paged_only \
+            and opts["device_round_ms"] > 0:
         # Honesty rows: the same fleet with NO round floor — the raw
         # 1-core timeshared regime, where replica scaling measures
         # XLA-CPU contention rather than the control plane.
@@ -2466,7 +2489,7 @@ def serve_bench_main(argv: list) -> int:
         ),
         "rows": [],
     }
-    if tracing_only:
+    if tracing_only or paged_only:
         routing = result.get("routing", routing)
     else:
         result["routing"] = routing
@@ -2523,30 +2546,34 @@ def serve_bench_main(argv: list) -> int:
         ),
         "rows": [],
     }
-    result["tracing"] = tracing
-    from dlrover_tpu.obs import get_recorder
+    if paged_only:
+        tracing = result.get("tracing", tracing)
+    else:
+        result["tracing"] = tracing
+        from dlrover_tpu.obs import get_recorder
 
-    for sample in (0.0, 1.0):
-        label = "on" if sample else "off"
-        before = get_recorder().stats()
-        try:
-            row = run_row(opts["routing_replicas"], mode="prefix",
-                          trace_sample=sample)
-            after = get_recorder().stats()
-            # Spans recorded in THIS (gateway-hosting) process; the
-            # subprocess replicas' rings die with them by design.
-            row["trace"]["gw_spans"] = (
-                after["spans"] - before["spans"]
-            )
-            row["trace"]["ring_dropped"] = (
-                after["dropped"] - before["dropped"]
-            )
-        except Exception as e:  # noqa: BLE001 - record the row
-            row = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
-        row["trace_mode"] = label
-        tracing["rows"].append(row)
-        flush()
-        print(f"tracing {label}: {row}", file=sys.stderr)
+        for sample in (0.0, 1.0):
+            label = "on" if sample else "off"
+            before = get_recorder().stats()
+            try:
+                row = run_row(opts["routing_replicas"], mode="prefix",
+                              trace_sample=sample)
+                after = get_recorder().stats()
+                # Spans recorded in THIS (gateway-hosting) process;
+                # the subprocess replicas' rings die with them by
+                # design.
+                row["trace"]["gw_spans"] = (
+                    after["spans"] - before["spans"]
+                )
+                row["trace"]["ring_dropped"] = (
+                    after["dropped"] - before["dropped"]
+                )
+            except Exception as e:  # noqa: BLE001 - record the row
+                row = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+            row["trace_mode"] = label
+            tracing["rows"].append(row)
+            flush()
+            print(f"tracing {label}: {row}", file=sys.stderr)
     t_by = {
         r.get("trace_mode"): r
         for r in tracing["rows"] if "error" not in r
@@ -2904,7 +2931,7 @@ def serve_bench_main(argv: list) -> int:
         ),
         "rows": [],
     }
-    if tracing_only:
+    if tracing_only or paged_only:
         spec_sec = result.get("spec", spec_sec)
     else:
         result["spec"] = spec_sec
@@ -2946,6 +2973,227 @@ def serve_bench_main(argv: list) -> int:
             "fallback_fallbacks": fb["spec"]["fallbacks"],
         }
 
+    # ------------------------------------------------------------------
+    # Paged-KV rows (ISSUE 19): block-table memory vs slotted
+    # reservations at MATCHED KV memory.
+    # ------------------------------------------------------------------
+    def paged_workload(workload: str):
+        """Prompt set shared by both modes of one comparison (same
+        seed -> same prompts -> greedy outputs must match byte-for-
+        byte across modes)."""
+        rng = np.random.RandomState(opts["seed"] + 23)
+        n = opts["paged_requests"]
+        p_max = opts["paged_max_len"] - opts["paged_mnt"]
+        if workload == "uniform":
+            lens = rng.randint(
+                max(1, int(p_max * 0.55)), int(p_max * 0.9) + 1,
+                size=n,
+            )
+        else:  # longtail: Zipf sequence lengths, most short, few long
+            step = max(1, p_max // 8)
+            lens = np.minimum(step + step * rng.zipf(1.6, size=n),
+                              p_max)
+        return [
+            rng.randint(1, cfg.vocab_size, size=(int(L),)).astype(
+                np.int32
+            )
+            for L in lens
+        ]
+
+    paged_params = None
+
+    def run_paged_row(workload: str, mode: str, prompts_w) -> dict:
+        """One in-process DecodeServer measurement.  Occupancy is
+        sampled once per decode round from the serve loop's tick:
+        tokens RESIDENT for admitted requests (prompt + emitted so
+        far) over the matched memory budget — the fraction of the KV
+        budget holding live work rather than stranded reservation
+        padding."""
+        nonlocal paged_params
+        from dlrover_tpu.models import llama, llama_infer
+
+        if paged_params is None:
+            paged_params = llama.init_params(
+                jax.random.PRNGKey(opts["seed"]), cfg
+            )
+        mnt = opts["paged_mnt"]
+        S = opts["paged_slots"]
+        BS = opts["paged_block_size"]
+        ML = opts["paged_max_len"]
+        pool_blocks = S * (ML // BS)
+        pool_tokens = S * ML
+        paged = mode == "paged"
+        seats = S * opts["paged_seat_factor"] if paged else S
+        kw = dict(paged=True, block_size=BS,
+                  pool_blocks=pool_blocks) if paged else {}
+        srv = llama_infer.DecodeServer(
+            paged_params, cfg, slots=seats, max_len=ML, **kw
+        )
+        # Warm every prefill bucket this workload touches (plus the
+        # decode-step jit) so the timed run measures serving, not XLA.
+        reps: dict = {}
+        for p in prompts_w:
+            b = next(b for b in srv.buckets if len(p) <= b)
+            if b not in reps or len(p) > len(reps[b]):
+                reps[b] = p
+        srv.serve(list(reps.values()), max_new_tokens=2)
+        plen = {i: len(p) for i, p in enumerate(prompts_w)}
+        emitted: dict = {}
+        outs: dict = {}
+
+        def on_token(rid, _t):
+            emitted[rid] = emitted.get(rid, 0) + 1
+
+        def on_finish(rid, tokens):
+            outs[rid] = [int(t) for t in tokens]
+
+        samples: list = []
+        deadline = time.time() + opts["timeout"]
+
+        def tick():
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"paged row {workload}/{mode} overran "
+                    f"{opts['timeout']}s"
+                )
+            act = srv._live_active
+            sreq = srv._live_slot_req
+            resident = adm = 0
+            for s in range(len(sreq)):
+                if act[s] and sreq[s] is not None:
+                    adm += 1
+                    resident += (plen[sreq[s]]
+                                 + emitted.get(sreq[s], 0))
+            if adm:
+                samples.append((
+                    resident / pool_tokens, adm,
+                    float(srv.last_stats.get("occupancy", 0.0)),
+                ))
+            return False  # drain mode: finish everything, then return
+
+        for i, p in enumerate(prompts_w):
+            srv.submit(i, p, mnt)
+        t0 = time.perf_counter()
+        srv.serve_incremental(tick=tick, on_finish=on_finish,
+                              on_token=on_token)
+        wall = max(time.perf_counter() - t0, 1e-9)
+        new = sum(len(outs[r]) - plen[r] for r in outs)
+        occ = [s[0] for s in samples] or [0.0]
+        adm = [s[1] for s in samples] or [0]
+        rep = [s[2] for s in samples] or [0.0]
+        row = {
+            "workload": workload,
+            "mode": mode,
+            "requests": len(prompts_w),
+            "completed": len(outs),
+            "seats": seats,
+            "kv_pool_tokens": pool_tokens,
+            "new_tokens": new,
+            "tokens_per_sec": round(new / wall, 2),
+            "decode_rounds": len(samples),
+            "admitted_batch_mean": round(float(np.mean(adm)), 2),
+            "admitted_batch_occupancy": round(float(np.mean(occ)), 4),
+            "reported_occupancy_mean": round(float(np.mean(rep)), 4),
+            "elapsed_s": round(wall, 2),
+            "outputs": outs,
+        }
+        if paged:
+            row["block_size"] = BS
+            row["pool_blocks"] = pool_blocks
+            row["preemptions"] = srv.preemptions
+        return row
+
+    paged_sec = {
+        "requests": opts["paged_requests"],
+        "max_new_tokens": opts["paged_mnt"],
+        "block_size": opts["paged_block_size"],
+        "max_len": opts["paged_max_len"],
+        "kv_pool_tokens": opts["paged_slots"] * opts["paged_max_len"],
+        "note": (
+            "matched KV memory: `slotted` reserves paged_slots full "
+            "max_len rows; `paged` gets a block pool of the same "
+            "token count (+1 scratch block) with paged_seat_factor x "
+            "more seats, admission priced by blocks actually needed "
+            "and grown on demand (preempt-youngest when dry).  "
+            "admitted_batch_occupancy = mean fraction of the memory "
+            "budget holding live request tokens per decode round; "
+            "greedy outputs must be byte-identical across modes "
+            "(outputs_match).  tokens_per_sec on this CPU host "
+            "timeshares seat-width decode compute, so the committed "
+            "claim is the occupancy/admission gap, not CPU tok/s"
+        ),
+        "rows": [],
+    }
+    if tracing_only:
+        paged_sec = result.get("paged", paged_sec)
+    else:
+        result["paged"] = paged_sec
+        for workload in ("uniform", "longtail"):
+            prompts_w = paged_workload(workload)
+            for mode in ("slotted", "paged"):
+                try:
+                    row = run_paged_row(workload, mode, prompts_w)
+                except Exception as e:  # noqa: BLE001 - record the row
+                    row = {"workload": workload, "mode": mode,
+                           "error":
+                           f"{type(e).__name__}: {str(e)[:200]}"}
+                paged_sec["rows"].append(row)
+                print(
+                    f"paged {workload}/{mode}: "
+                    + json.dumps({k: v for k, v in row.items()
+                                  if k != "outputs"}),
+                    file=sys.stderr,
+                )
+        pg_by = {
+            (r.get("workload"), r.get("mode")): r
+            for r in paged_sec["rows"] if "error" not in r
+        }
+        if len(pg_by) == 4:
+            verdict = {}
+            for workload in ("uniform", "longtail"):
+                sl = pg_by[(workload, "slotted")]
+                pg = pg_by[(workload, "paged")]
+                verdict[workload] = {
+                    "occupancy_x": round(
+                        pg["admitted_batch_occupancy"]
+                        / sl["admitted_batch_occupancy"], 2
+                    ) if sl["admitted_batch_occupancy"] else 0.0,
+                    "admitted_x": round(
+                        pg["admitted_batch_mean"]
+                        / sl["admitted_batch_mean"], 2
+                    ) if sl["admitted_batch_mean"] else 0.0,
+                    # The parity pin, measured end to end: greedy
+                    # outputs byte-identical across the memory layouts.
+                    "outputs_match": sl["outputs"] == pg["outputs"],
+                }
+            # Paged may tie slotted when every request fills its
+            # reservation anyway (the uniform smoke config); it must
+            # never be LOWER, and the long-tail row — where slotted
+            # strands max_len reservations behind short requests — is
+            # where the strict win is required.
+            verdict["paged_never_lower"] = all(
+                pg_by[(w, "paged")]["admitted_batch_occupancy"]
+                >= pg_by[(w, "slotted")]["admitted_batch_occupancy"]
+                - 1e-9
+                for w in ("uniform", "longtail")
+            )
+            verdict["longtail_paged_higher"] = (
+                pg_by[("longtail", "paged")]
+                ["admitted_batch_occupancy"]
+                > pg_by[("longtail", "slotted")]
+                ["admitted_batch_occupancy"]
+            )
+            verdict["longtail_gap_largest"] = (
+                verdict["longtail"]["occupancy_x"]
+                >= verdict["uniform"]["occupancy_x"]
+            )
+            paged_sec["verdict"] = verdict
+        # The raw token streams verified outputs_match; they have no
+        # further value in the committed artifact.
+        for r in paged_sec["rows"]:
+            r.pop("outputs", None)
+        flush()
+
     speedup, best_n = _speedup(result["rows"])
     if speedup is not None:
         result["speedup_multi_vs_single"] = speedup
@@ -2955,6 +3203,7 @@ def serve_bench_main(argv: list) -> int:
     main_ok = [r for r in result["rows"] if "error" not in r]
     routing_ok = [r for r in routing["rows"] if "error" not in r]
     spec_ok = [r for r in spec_sec["rows"] if "error" not in r]
+    paged_ok = [r for r in paged_sec["rows"] if "error" not in r]
     tracing_ok = [r for r in tracing["rows"] if "error" not in r]
     result["complete"] = (
         (tracing_only or (
@@ -2968,6 +3217,16 @@ def serve_bench_main(argv: list) -> int:
         and len(spec_ok) == 4
         and all(r["completed"] == opts["spec_requests"]
                 for r in spec_ok)
+        and len(paged_ok) == 4
+        and all(r["completed"] == opts["paged_requests"]
+                for r in paged_ok)
+        and all(v["outputs_match"]
+                for v in (paged_sec.get("verdict") or {}).values()
+                if isinstance(v, dict))
+        and bool((paged_sec.get("verdict") or {})
+                 .get("paged_never_lower"))
+        and bool((paged_sec.get("verdict") or {})
+                 .get("longtail_paged_higher"))
         and len(tracing_ok) == 2
         and all(r["completed"] == opts["routing_requests"]
                 for r in tracing_ok)

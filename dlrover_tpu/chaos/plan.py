@@ -187,6 +187,18 @@ SITES: Dict[str, dict] = {
                "re-prefill — a failed pull falls back to relay — "
                "bounded by max_attempts)",
     },
+    # Paged-KV site (ISSUE 19): a block's free is dropped on the
+    # abort/finish path — refcount zero, but the block never returns
+    # to the free list.  The arena's per-iteration scavenge rebuilds
+    # the free list from the refcounts; the tier-1 invariant is
+    # conservation: free_blocks + used_blocks == pool size after any
+    # chaos run.
+    "serving.block_leak": {
+        "kind": "flag", "times": 1,
+        "doc": "drop a KV block's free on the abort path (`block=id`); "
+               "the arena scavenge must repair it — conservation law "
+               "`free + used == pool` holds after any run",
+    },
     # Gateway-tier site (ISSUE 9): hard-kill one gateway of a sharded
     # tier mid-stream.
     "serving.gateway_kill": {

@@ -1032,11 +1032,21 @@ KV_SEGMENT_VERSION = 1
 
 
 def pack_kv_segment(layers, n: int, first_token: int,
-                    quant: bool) -> Tuple[bytes, int]:
+                    quant: bool, block_size: int = 0) -> Tuple[bytes, int]:
     """Pack a prefilled KV segment for the prefill->decode handoff
     (ISSUE 8).  ``layers`` is the per-layer list of HOST arrays sliced
     to the ``n`` written slots (``[1, KV, n, D]`` codes — int8 +
     per-slot f32 scales when ``quant``, the model dtype otherwise).
+
+    ``block_size > 0`` (ISSUE 19, paged servers) frames the payload as
+    a BLOCK LIST instead of one monolithic byte run: the slot axis is
+    split into ``ceil(n / block_size)`` fixed-size blocks (last block
+    zero-padded), each block's bytes framed contiguously with its OWN
+    CRC-32 in the meta — so a torn transfer is localized to a block,
+    and a paged decode server can write the frames straight into pool
+    blocks.  :func:`unpack_kv_segment` reassembles either framing into
+    the same trimmed per-layer arrays; the consumer never cares which
+    rode the wire.
 
     Returns ``(payload, fp32_bytes)``: a self-describing msgpack blob
     with the data CRC-32 embedded (verified by
@@ -1048,26 +1058,57 @@ def pack_kv_segment(layers, n: int, first_token: int,
 
     keys = sorted(layers[0]) if layers else []
     shapes = {}
-    chunks = []
-    for kk in keys:
-        arr = layers[0][kk]
-        shapes[kk] = [list(arr.shape), str(arr.dtype)]
-    for lay in layers:
+    meta_extra: Dict[str, Any] = {}
+    if block_size > 0:
+        bs = int(block_size)
+        nblk = -(-int(n) // bs)
         for kk in keys:
-            arr = np.ascontiguousarray(lay[kk])
-            if list(arr.shape) != shapes[kk][0]:
-                raise ValueError(
-                    f"ragged KV segment: layer {kk} shape {arr.shape} "
-                    f"!= {shapes[kk][0]}"
-                )
-            chunks.append(arr.tobytes())
-    data = b"".join(chunks)
+            arr = layers[0][kk]
+            shapes[kk] = [
+                list(arr.shape[:2]) + [bs] + list(arr.shape[3:]),
+                str(arr.dtype),
+            ]
+        frames = []
+        bcrc = []
+        for b in range(nblk):
+            parts = []
+            for lay in layers:
+                for kk in keys:
+                    arr = np.ascontiguousarray(lay[kk])
+                    blk = arr[:, :, b * bs: (b + 1) * bs]
+                    if blk.shape[2] < bs:
+                        pad = [(0, 0)] * blk.ndim
+                        pad[2] = (0, bs - blk.shape[2])
+                        blk = np.pad(blk, pad)
+                    parts.append(np.ascontiguousarray(blk).tobytes())
+            frame = b"".join(parts)
+            bcrc.append(zlib.crc32(frame))
+            frames.append(frame)
+        data = b"".join(frames)
+        meta_extra = {"bs": bs, "nblk": nblk, "bcrc": bcrc}
+        n_units = nblk
+    else:
+        chunks = []
+        for kk in keys:
+            arr = layers[0][kk]
+            shapes[kk] = [list(arr.shape), str(arr.dtype)]
+        for lay in layers:
+            for kk in keys:
+                arr = np.ascontiguousarray(lay[kk])
+                if list(arr.shape) != shapes[kk][0]:
+                    raise ValueError(
+                        f"ragged KV segment: layer {kk} shape "
+                        f"{arr.shape} != {shapes[kk][0]}"
+                    )
+                chunks.append(arr.tobytes())
+        data = b"".join(chunks)
+        n_units = 1
     # fp32 equivalent: the k/v codes at 4 bytes/element (scale arrays
     # only exist in the quant layout; they have no fp32 counterpart).
     fp32_bytes = 0
     for kk in ("k", "v"):
         if kk in shapes:
-            fp32_bytes += len(layers) * int(
+            fp32_bytes += n_units * len(layers) * int(
                 np.prod(shapes[kk][0])
             ) * 4
     meta = {
@@ -1078,6 +1119,7 @@ def pack_kv_segment(layers, n: int, first_token: int,
         "layers": len(layers),
         "keys": keys,
         "shapes": shapes,
+        **meta_extra,
     }
     payload = msgpack.packb(
         {"meta": meta, "crc": zlib.crc32(data), "data": data},
@@ -1116,6 +1158,64 @@ def unpack_kv_segment(payload: bytes) -> Dict[str, Any]:
         kk: int(np.prod(shapes[kk][0])) * np.dtype(shapes[kk][1]).itemsize
         for kk in keys
     }
+    n = int(meta["n"])
+    if "bs" in meta:
+        # Block-list framing (ISSUE 19): per-block CRC first — a torn
+        # transfer is localized to the block that tore — then the
+        # blocks reassemble along the slot axis and trim to ``n``.
+        import zlib as _zlib
+
+        bs = int(meta["bs"])
+        nblk = int(meta["nblk"])
+        bcrc = list(meta["bcrc"])
+        frame_size = sum(sizes.values()) * n_layers
+        if bs < 1 or nblk < 1 or len(bcrc) != nblk or \
+                not (nblk - 1) * bs < n <= nblk * bs:
+            raise KvSegmentError(
+                f"KV segment block meta incoherent: n={n} bs={bs} "
+                f"nblk={nblk} crcs={len(bcrc)}"
+            )
+        if frame_size * nblk != len(data):
+            raise KvSegmentError(
+                f"KV segment size mismatch: {nblk} blocks of "
+                f"{frame_size} bytes promised, have {len(data)}"
+            )
+        per_block: list = []
+        for b in range(nblk):
+            frame = data[b * frame_size: (b + 1) * frame_size]
+            if _zlib.crc32(frame) != int(bcrc[b]):
+                raise KvSegmentError(
+                    f"KV segment block {b}/{nblk} CRC mismatch "
+                    "(torn block)"
+                )
+            off = 0
+            lays = []
+            for _ in range(n_layers):
+                lay = {}
+                for kk in keys:
+                    shape, dt = shapes[kk]
+                    lay[kk] = np.frombuffer(
+                        frame, dtype=np.dtype(dt),
+                        count=int(np.prod(shape)), offset=off,
+                    ).reshape(shape)
+                    off += sizes[kk]
+                lays.append(lay)
+            per_block.append(lays)
+        layers = [
+            {
+                kk: np.concatenate(
+                    [per_block[b][li][kk] for b in range(nblk)], axis=2
+                )[:, :, :n]
+                for kk in keys
+            }
+            for li in range(n_layers)
+        ]
+        return {
+            "layers": layers, "n": n,
+            "first": int(meta["first"]),
+            "quant": bool(meta["quant"]),
+            "block_size": bs, "blocks": nblk,
+        }
     if sum(sizes.values()) * n_layers != len(data):
         raise KvSegmentError(
             f"KV segment size mismatch: meta promises "
@@ -1135,7 +1235,7 @@ def unpack_kv_segment(payload: bytes) -> Dict[str, Any]:
         layers.append(lay)
     return {
         "layers": layers,
-        "n": int(meta["n"]),
+        "n": n,
         "first": int(meta["first"]),
         "quant": bool(meta["quant"]),
     }
@@ -1236,6 +1336,281 @@ def _spec_remote_round(
     return accepted_rows, nxt, cache_t
 
 
+# -- paged KV: block-table memory for the decode hot path (ISSUE 19) -----
+#
+# The slotted server reserves one contiguous [max_len] cache row per
+# slot, so admitted-batch occupancy is bounded by WORST-CASE sequence
+# length — most of that memory is stranded headroom.  The paged arena
+# (the vllm/PagedAttention idiom) decouples a request's logical KV from
+# physical placement: the cache is a pool of fixed-size blocks
+# ([n_blocks + 1, KV, block_size, D] per layer, one shared block-id
+# space across layers; the +1 row is a scratch block that absorbs
+# writes through unallocated table entries), and each slot maps logical
+# block i to a physical block through a host-owned [slots, max_blocks]
+# table.  The decode/chunk/prefill jits re-index through the table:
+# gather ``pool[table]`` -> the SAME dense [B, KV, max_len, D] view the
+# slotted jits compute on (so the attention math — and the greedy token
+# stream — is byte-identical by construction), then scatter the view
+# back through the table.  Stale bytes in not-yet-written block slots
+# are invisible: the causal mask sends every position > offset to
+# -1e30 before softmax, an exactly-0.0 weight on both the score*ks and
+# p*vs paths.
+
+def _paged_block_split(x: jax.Array, n_blocks: int,
+                       block_size: int) -> jax.Array:
+    """[KV, L(, D)] -> [n_blocks, KV, block_size(, D)] (L >= nb*bs)."""
+    x = x[:, : n_blocks * block_size]
+    x = x.reshape(
+        (x.shape[0], n_blocks, block_size) + x.shape[2:]
+    )
+    return jnp.moveaxis(x, 0, 1)
+
+
+def _paged_dense_view(pool_layers: list, table: jax.Array) -> list:
+    """Gather the per-slot dense cache view through the block table:
+    pool [NB+1, KV, BS, ...] + table [B, MB] -> [B, KV, MB*BS, ...]."""
+    out = []
+    for pl in pool_layers:
+        lay = {}
+        for kk, arr in pl.items():
+            g = arr[table]                      # [B, MB, KV, BS, ...]
+            g = jnp.moveaxis(g, 2, 1)           # [B, KV, MB, BS, ...]
+            lay[kk] = g.reshape(
+                g.shape[:2] + (g.shape[2] * g.shape[3],) + g.shape[4:]
+            )
+        out.append(lay)
+    return out
+
+
+def _paged_scatter_back(pool_layers: list, dense_layers: list,
+                        table: jax.Array) -> list:
+    """Inverse of :func:`_paged_dense_view`: write the dense view back
+    through the table.  Table entries may repeat (CoW-shared prefix
+    blocks, the scratch sentinel): shared blocks are only ever written
+    VALUES THEY ALREADY HOLD (writes land at >= the sharer's first
+    owned position), so duplicate-index resolution order cannot change
+    the result; the scratch block absorbs every write through an
+    unallocated entry and is never meaningfully read (causal mask)."""
+    B, MB = table.shape
+    out = []
+    for pl, dl in zip(pool_layers, dense_layers):
+        lay = {}
+        for kk, arr in pl.items():
+            d = dl[kk]                          # [B, KV, MB*BS, ...]
+            d = d.reshape(
+                d.shape[:2] + (MB, d.shape[2] // MB) + d.shape[3:]
+            )
+            d = jnp.moveaxis(d, 1, 2)           # [B, MB, KV, BS, ...]
+            lay[kk] = arr.at[table].set(d)
+        out.append(lay)
+    return out
+
+
+def _paged_row_view(pool_layers: list, table_s: jax.Array) -> list:
+    """One slot's dense [1, KV, MB*BS, ...] view (table_s: [MB])."""
+    out = []
+    for pl in pool_layers:
+        lay = {}
+        for kk, arr in pl.items():
+            g = arr[table_s]                    # [MB, KV, BS, ...]
+            g = jnp.moveaxis(g, 0, 1)           # [KV, MB, BS, ...]
+            lay[kk] = g.reshape(
+                (g.shape[0], g.shape[1] * g.shape[2]) + g.shape[3:]
+            )[None]
+        out.append(lay)
+    return out
+
+
+def _paged_row_scatter(pool_layers: list, dense_layers: list,
+                       table_s: jax.Array) -> list:
+    """Write one slot's dense [1, KV, MB*BS, ...] rows back through its
+    table row (same duplicate-index safety as the batch scatter)."""
+    MB = table_s.shape[0]
+    out = []
+    for pl, dl in zip(pool_layers, dense_layers):
+        lay = {}
+        for kk, arr in pl.items():
+            d = dl[kk][0]                       # [KV, MB*BS, ...]
+            lay[kk] = arr.at[table_s].set(
+                _paged_block_split(d, MB, d.shape[1] // MB)
+            )
+        out.append(lay)
+    return out
+
+
+def init_paged_pool(cfg: LlamaConfig, n_blocks: int, block_size: int,
+                    *, quant_kv: bool = False) -> Dict:
+    """Zeroed paged KV pool: per-layer [n_blocks + 1, KV, block_size,
+    D] arrays (+ absmax scales under ``quant_kv``), one block-id space
+    shared by every layer (block i is backed at row i of EVERY layer's
+    arrays, the vllm layout).  Row ``n_blocks`` is the scratch block —
+    never allocated; unassigned table entries point here so stray
+    writes land somewhere harmless."""
+    KV, D = cfg.n_kv_head, cfg.head_dim
+    NB = n_blocks + 1
+
+    def _layer() -> Dict:
+        if quant_kv:
+            return {
+                "k": jnp.zeros((NB, KV, block_size, D), jnp.int8),
+                "v": jnp.zeros((NB, KV, block_size, D), jnp.int8),
+                "ks": jnp.zeros((NB, KV, block_size), jnp.float32),
+                "vs": jnp.zeros((NB, KV, block_size), jnp.float32),
+            }
+        return {
+            "k": jnp.zeros((NB, KV, block_size, D), cfg.dtype),
+            "v": jnp.zeros((NB, KV, block_size, D), cfg.dtype),
+        }
+
+    return {"layers": [_layer() for _ in range(cfg.n_layer)]}
+
+
+class PagedKvArena:
+    """Host-side allocator for the paged KV pool: the free list, the
+    per-slot block table, and the per-block refcounts that make
+    copy-on-write prefix sharing safe.  Pure bookkeeping — no device
+    arrays; the serve loop uploads ``table`` per dispatch and the jits
+    re-index through it.
+
+    Conservation law (the tier-1 invariant): every block is either on
+    the free list or referenced (by a slot table or a held template) —
+    ``free_blocks + used_blocks == n_blocks`` always, where
+    ``used_blocks`` counts each physical block ONCE however many
+    tables share it.  The chaos site ``serving.block_leak`` models a
+    dropped free (refcount reaches zero but the block never returns to
+    the list); :meth:`scavenge` — run every serve-loop iteration — is
+    the defense that rebuilds the free list from the refcounts, so the
+    law holds after any chaos run."""
+
+    def __init__(self, n_blocks: int, block_size: int, slots: int,
+                 max_len: int):
+        if max_len % block_size:
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of block_size "
+                f"{block_size} (the gathered dense view must match the "
+                "slotted cache shape exactly for byte-identity)"
+            )
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self.slots = int(slots)
+        self.max_blocks = max_len // block_size
+        #: Scratch sentinel: one past the last allocatable block (the
+        #: pool arrays carry an extra physical row for it).
+        self.scratch = self.n_blocks
+        self.leaks_repaired = 0
+        self.reset()
+
+    def reset(self) -> None:
+        self.table = np.full(
+            (self.slots, self.max_blocks), self.scratch, np.int32
+        )
+        self.lens = np.zeros((self.slots,), np.int64)
+        self.ref = np.zeros((self.n_blocks,), np.int64)
+        self._free = list(range(self.n_blocks - 1, -1, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        """Physical blocks referenced at least once (shared prefix
+        blocks count ONCE — this is real memory, not table entries)."""
+        return int((self.ref > 0).sum())
+
+    def table_tokens(self) -> int:
+        """Total LOGICAL tokens of table capacity currently mapped
+        (``sum(table lens)`` in block units x block_size) — the
+        admitted-batch footprint the occupancy metric reports."""
+        return int(self.lens.sum()) * self.block_size
+
+    def blocks_for(self, tokens: int) -> int:
+        return -(-int(tokens) // self.block_size)
+
+    def conserved(self) -> bool:
+        """``free_blocks + used_blocks == n_blocks`` AND the free list
+        agrees with the refcounts — the invariant the block-leak chaos
+        site attacks and :meth:`scavenge` defends."""
+        return (
+            len(self._free) + self.used_blocks == self.n_blocks
+            and all(self.ref[b] == 0 for b in self._free)
+        )
+
+    def scavenge(self) -> int:
+        """Rebuild the free list from the refcounts, reclaiming any
+        block whose frees were dropped (the ``serving.block_leak``
+        fault).  Returns the number of leaked blocks repaired."""
+        free = [b for b in range(self.n_blocks) if self.ref[b] == 0]
+        leaked = len(free) - len(self._free)
+        if leaked > 0:
+            self.leaks_repaired += leaked
+        self._free = free
+        return max(0, leaked)
+
+    def _take(self) -> int:
+        blk = self._free.pop()
+        self.ref[blk] = 1
+        return blk
+
+    def alloc_upto(self, s: int, tokens: int) -> bool:
+        """Grow slot ``s``'s table to cover ``tokens`` logical
+        positions (grow-on-demand: a request only ever holds the
+        blocks its CURRENT offset + this round's writes need).  False
+        — with no state change — when the pool cannot cover it."""
+        need = min(self.blocks_for(tokens), self.max_blocks)
+        add = need - int(self.lens[s])
+        if add <= 0:
+            return True
+        if add > len(self._free):
+            return False
+        for _ in range(add):
+            self.table[s, self.lens[s]] = self._take()
+            self.lens[s] += 1
+        return True
+
+    def share(self, s: int, blocks: list) -> None:
+        """Map slot ``s``'s first logical blocks onto ``blocks``
+        (prefix sharing: refcount up, zero copies).  Only legal on an
+        empty slot row."""
+        assert self.lens[s] == 0
+        for i, b in enumerate(blocks):
+            self.table[s, i] = b
+            self.ref[b] += 1
+        self.lens[s] = len(blocks)
+
+    def hold(self, n: int) -> Optional[list]:
+        """Allocate ``n`` blocks owned by a prefix TEMPLATE (refcount
+        held by the store, not any slot).  None if the pool is too
+        tight — the caller falls back to an untemplated admission."""
+        if n > len(self._free):
+            return None
+        return [self._take() for _ in range(n)]
+
+    def release(self, blocks: list) -> None:
+        """Drop a template's hold on ``blocks`` (store eviction)."""
+        for b in blocks:
+            self._drop_ref(int(b))
+
+    def _drop_ref(self, blk: int) -> None:
+        self.ref[blk] -= 1
+        if self.ref[blk] == 0:
+            from dlrover_tpu import chaos
+            if chaos.inject("serving.block_leak", block=blk):
+                # Fault: the free is dropped — the block is referenced
+                # by nobody and on no list.  scavenge() repairs.
+                return
+            self._free.append(blk)
+
+    def free_slot(self, s: int) -> None:
+        """Return slot ``s``'s blocks (abort, deadline shed, finish,
+        preemption): refcount down, back on the free list at zero —
+        shared prefix blocks survive for their other holders."""
+        for i in range(int(self.lens[s])):
+            self._drop_ref(int(self.table[s, i]))
+        self.table[s, :] = self.scratch
+        self.lens[s] = 0
+
+
 class DecodeServer:
     """Continuous-batching greedy/sampled decode over fixed slots — the
     role vllm plays for the reference's RL engine
@@ -1306,6 +1681,20 @@ class DecodeServer:
         # template.  LRU-bounded — each template is n_layer full cache
         # rows of memory.
         prefix_cache_cap: int = 4,
+        # Paged KV (ISSUE 19): the cache becomes a pool of fixed-size
+        # blocks plus a per-slot block table; admission reserves only
+        # the blocks a request needs NOW and grows on demand, prefix
+        # templates share blocks copy-on-write, and abort/finish
+        # return blocks to the pool instantly.  ``pool_blocks``
+        # defaults to slots * max_len / block_size — exactly the
+        # slotted layout's memory, so paged-vs-slotted comparisons are
+        # at matched memory unless the caller says otherwise.  Greedy
+        # output is byte-identical to slotted mode (the jits gather a
+        # dense view through the table and run the SAME attention
+        # program).
+        paged: bool = False,
+        block_size: int = 16,
+        pool_blocks: Optional[int] = None,
     ):
         # Sliding-window models serve on a DENSE cache (init_cache
         # ring=False): the window mask still applies in attention; the
@@ -1402,6 +1791,40 @@ class DecodeServer:
         # counts feed the replica's poll stats so the gateway's
         # residency map self-corrects.
         self.prefix_cache_cap = max(1, int(prefix_cache_cap))
+        # Paged KV arena (ISSUE 19).
+        self.paged = bool(paged)
+        self.block_size = int(block_size)
+        if self.paged:
+            if block_size < 1:
+                raise ValueError(f"block_size must be >= 1, got "
+                                 f"{block_size}")
+            if max_len % self.block_size:
+                raise ValueError(
+                    f"paged mode needs max_len ({max_len}) to be a "
+                    f"multiple of block_size ({block_size}): the "
+                    "gathered view must match the slotted cache shape "
+                    "exactly for byte-identical output"
+                )
+        self.pool_blocks = (
+            int(pool_blocks) if pool_blocks is not None
+            else slots * (max_len // self.block_size)
+        ) if self.paged else 0
+        self.kv_arena: Optional[PagedKvArena] = (
+            PagedKvArena(self.pool_blocks, self.block_size, slots,
+                         max_len)
+            if self.paged else None
+        )
+        #: Preemptions this serve call (paged grow-on-demand sheds the
+        #: youngest slot when the pool runs dry; the request requeues
+        #: at the FRONT and greedy decode regenerates its stream).
+        self.preemptions = 0
+        #: rid -> tokens already delivered via on_token before a
+        #: preemption (re-admission suppresses re-emitting them).
+        self._preempt_emitted: Dict[Any, int] = {}
+        #: Monotone serve-call counter: paged prefix templates
+        #: materialize pool blocks per RUN (the pool is rebuilt each
+        #: serve call) and tag them with this.
+        self._paged_run_seq = 0
         self._prefix_store: "collections.OrderedDict" = \
             collections.OrderedDict()
         self.prefix_hits = 0
@@ -1452,6 +1875,76 @@ class DecodeServer:
             return cache, toks, jnp.moveaxis(ys, 0, 1)  # [B, K]
 
         self._chunk_step = jax.jit(chunk_step)
+
+        if self.paged:
+            # The decode hot path re-indexed through the block table
+            # (ISSUE 19): gather pool[table] -> the SAME dense view the
+            # slotted jits compute on, run the identical step program,
+            # scatter the view back.  One compiled program per shape,
+            # memoized like every other jit here; the chunk variant
+            # amortizes the gather/scatter over decode_chunk steps.
+            def step_paged(params, pool_layers, table, offset, toks,
+                           active, sub):
+                dense = {
+                    "layers": _paged_dense_view(pool_layers, table),
+                    "offset": offset,
+                }
+                new_dense, nxt = step(params, dense, toks, active, sub)
+                return (
+                    _paged_scatter_back(
+                        pool_layers, new_dense["layers"], table
+                    ),
+                    new_dense["offset"], nxt,
+                )
+
+            self._step_paged = jax.jit(step_paged)
+
+            def chunk_step_paged(params, pool_layers, table, offset,
+                                 toks, active, sub):
+                dense = {
+                    "layers": _paged_dense_view(pool_layers, table),
+                    "offset": offset,
+                }
+                dense, toks, ys = chunk_step(
+                    params, dense, toks, active, sub
+                )
+                return (
+                    _paged_scatter_back(
+                        pool_layers, dense["layers"], table
+                    ),
+                    dense["offset"], toks, ys,
+                )
+
+            self._chunk_step_paged = jax.jit(chunk_step_paged)
+
+            # Whole-cache gather/scatter, for the speculative rounds:
+            # the spec programs (_spec_decode_round and friends) run
+            # unchanged on the gathered dense view, then the view
+            # scatters back — two extra dispatches per spec round buy
+            # zero drift from the slotted acceptance laws.
+            def gather_all(pool_layers, table, offset):
+                return {
+                    "layers": _paged_dense_view(pool_layers, table),
+                    "offset": offset,
+                }
+
+            self._paged_gather = jax.jit(gather_all)
+            self._paged_scatter = jax.jit(_paged_scatter_back)
+
+    def block_stats(self) -> Optional[Dict[str, Any]]:
+        """Live block-pool telemetry (None on a slotted server): what
+        the replica folds into its gateway poll so admission and
+        autoscale see real memory headroom instead of slot counts."""
+        arena = self.kv_arena
+        if arena is None:
+            return None
+        used = arena.used_blocks
+        return {
+            "total_blocks": arena.n_blocks,
+            "free_blocks": arena.free_blocks,
+            "block_occupancy": used / max(1, arena.n_blocks),
+            "preemptions": self.preemptions,
+        }
 
     def _next_key(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -1523,6 +2016,18 @@ class DecodeServer:
                 f"{max_new_tokens} + headroom {self._write_slack()} "
                 f"= {need} exceeds max_len {self.max_len}"
             )
+        if self.paged:
+            # Pool-wide law: a request that could never fit the WHOLE
+            # pool (even alone) must reject at submit, not livelock
+            # the admission loop waiting for blocks that cannot exist.
+            blocks = self.kv_arena.blocks_for(need)
+            if blocks > self.pool_blocks:
+                raise ValueError(
+                    f"request needs {blocks} KV blocks "
+                    f"({need} tokens at block_size "
+                    f"{self.block_size}) but the pool holds only "
+                    f"{self.pool_blocks}"
+                )
 
     def submit(self, rid, prompt, max_new_tokens: int,
                prefix_len: int = 0, prefix_fp: str = "") -> None:
@@ -1623,6 +2128,8 @@ class DecodeServer:
         warmup hygiene: a compile-warming dummy must not occupy the
         LRU, report warm to the router, or skew the hit-rate."""
         with self._pending_mu:
+            for entry in self._prefix_store.values():
+                self._release_template_blocks(entry)
             self._prefix_store.clear()
             self.prefix_hits = 0
             self.prefix_misses = 0
@@ -1669,6 +2176,7 @@ class DecodeServer:
                 # Fingerprint mismatch: never serve another prefix's
                 # rows.
                 del self._prefix_store[fp]
+                self._release_template_blocks(entry)
                 entry = None
             if entry is not None:
                 self.prefix_hits += 1
@@ -1686,8 +2194,21 @@ class DecodeServer:
         with self._pending_mu:
             self._prefix_store[fp] = entry
             while len(self._prefix_store) > self.prefix_cache_cap:
-                self._prefix_store.popitem(last=False)
+                _, old = self._prefix_store.popitem(last=False)
+                self._release_template_blocks(old)
         return entry
+
+    def _release_template_blocks(self, entry: Dict[str, Any]) -> None:
+        """Return an evicted template's pool blocks (paged mode): the
+        store's refcount hold drops; blocks a live slot still SHARES
+        survive on that slot's own refcount."""
+        pb = entry.pop("_paged", None)
+        if (
+            pb is not None
+            and self.kv_arena is not None
+            and pb.get("run") == self._paged_run_seq
+        ):
+            self.kv_arena.release(pb["ids"])
 
     def prefill_request(self, rid, prompt, max_new_tokens: int,
                         prefix_len: int = 0,
@@ -1792,7 +2313,10 @@ class DecodeServer:
         if info is None:
             raise ValueError(f"no staged prefill for request {rid!r}")
         return pack_kv_segment(
-            info["layers"], info["n"], info["first"], self.quant_kv
+            info["layers"], info["n"], info["first"], self.quant_kv,
+            # Paged servers ship a BLOCK LIST (per-block CRCs; the
+            # decode side writes frames straight into pool blocks).
+            block_size=self.block_size if self.paged else 0,
         )
 
     def import_kv(self, rid, payload: bytes, prompt,
@@ -1856,8 +2380,15 @@ class DecodeServer:
                         f"{arr.dtype} != expected {want_shape} "
                         f"{want_dt}"
                     )
+                if self.paged:
+                    # Paged admission writes whole blocks: pad only to
+                    # the block boundary, not the full slot length.
+                    tail = self.kv_arena.blocks_for(n) \
+                        * self.block_size - n
+                else:
+                    tail = self.max_len - n
                 pad = [(0, 0)] * arr.ndim
-                pad[2] = (0, self.max_len - n)
+                pad[2] = (0, tail)
                 out[kk] = np.pad(arr, pad)
             padded.append(out)
         extra = {"kv": {
@@ -2170,9 +2701,37 @@ class DecodeServer:
         templates = templates or {}
         P0 = 0 if prefix is None else len(prefix)
         results: Dict[Any, Any] = {}
-        cache = init_cache(cfg, B, self.max_len,
-                           quant_kv=self.quant_kv, ring=False)
-        cache = dict(cache, offset=jnp.zeros((B,), jnp.int32))
+        arena = self.kv_arena
+        table_dev: Any = None  # device copy of arena.table, lazy
+        if self.paged:
+            # Fresh pool per serve call (the slotted path rebuilds its
+            # cache per call too); templates re-materialize their
+            # blocks lazily under the new run tag.
+            arena.reset()
+            self._paged_run_seq += 1
+            self.preemptions = 0
+            pool = init_paged_pool(
+                cfg, self.pool_blocks, self.block_size,
+                quant_kv=self.quant_kv,
+            )
+            cache = {
+                "layers": pool["layers"],
+                "offset": jnp.zeros((B,), jnp.int32),
+            }
+        else:
+            cache = init_cache(cfg, B, self.max_len,
+                               quant_kv=self.quant_kv, ring=False)
+            cache = dict(cache, offset=jnp.zeros((B,), jnp.int32))
+
+        def table_device():
+            nonlocal table_dev
+            if table_dev is None:
+                table_dev = jnp.asarray(arena.table)
+            return table_dev
+
+        def table_dirty():
+            nonlocal table_dev
+            table_dev = None
         cache_d = None
         if self.draft is not None:
             cache_d = init_cache(self.draft[1], B, self.max_len,
@@ -2184,6 +2743,15 @@ class DecodeServer:
         slot_prompt: list = [None] * B  # prefix+prompt per slot
         slot_out: list = [None] * B
         budget = [0] * B
+        # Paged bookkeeping: the original queue item per slot (so a
+        # preemption can requeue it verbatim), admission order (the
+        # preemption victim policy sheds the YOUNGEST — vllm's
+        # recompute-last), and per-slot counts of already-delivered
+        # tokens to mute after a preempted request re-admits.
+        slot_item: list = [None] * B
+        admit_seq = [0] * B
+        slot_mute = [0] * B
+        admit_counter = 0
         # Per-slot offset bound (speculative rounds clamp finishing
         # rows here; see _spec_decode_round's max_off).
         slot_bound = onp.zeros((B,), onp.int64)
@@ -2220,6 +2788,297 @@ class DecodeServer:
                 c, tmpl_layers, jnp.asarray(slot),
                 jnp.asarray(p0, jnp.int32),
             )
+
+        # -- paged admission (ISSUE 19) -------------------------------
+        batch_tmpl_memo: Dict[str, Any] = {}
+
+        def blk_writer(nblk):
+            """Jit that writes a dense [1, KV, >=nblk*BS, ...] row's
+            first nblk blocks into pool blocks ``ids`` — template
+            materialization and KV-segment import share it."""
+            tk = ("blk_write", nblk)
+            if tk not in self._prefill_jit:
+                def ftb(pool_layers, row_layers, ids_):
+                    out = []
+                    for pl, rl in zip(pool_layers, row_layers):
+                        lay = {}
+                        for kk, v in pl.items():
+                            lay[kk] = v.at[ids_].set(
+                                _paged_block_split(
+                                    jnp.asarray(rl[kk])[0], nblk,
+                                    self.block_size,
+                                )
+                            )
+                        out.append(lay)
+                    return out
+
+                self._prefill_jit[tk] = jax.jit(ftb)
+            return self._prefill_jit[tk]
+
+        def paged_template_ids(tmpl_t_layers, p0, store_entry):
+            """Materialize (once per RUN — the pool is rebuilt each
+            serve call) a prefix template's pool blocks from its dense
+            1-row layers.  The store holds a refcount on them until
+            eviction; admissions SHARE the fully-before-rescore blocks
+            and copy the rest.  None when the pool is too tight — the
+            caller admits untemplated instead."""
+            nonlocal cache
+            memo = store_entry if store_entry is not None \
+                else batch_tmpl_memo
+            pb = memo.get("_paged")
+            if pb is not None and pb.get("run") == self._paged_run_seq:
+                return pb["ids"]
+            nblk = arena.blocks_for(p0)
+            ids = arena.hold(nblk)
+            if ids is None:
+                return None
+            cache = dict(cache, layers=blk_writer(nblk)(
+                cache["layers"], tmpl_t_layers,
+                jnp.asarray(ids, jnp.int32),
+            ))
+            memo["_paged"] = {"run": self._paged_run_seq, "ids": ids}
+            return ids
+
+        def drop_template_holds():
+            """Release every template's materialized pool blocks (the
+            admission gate's last resort when even an UNtemplated
+            admission can't fit): check_capacity guarantees any single
+            accepted request fits the bare pool, so after this the
+            empty batch always re-admits."""
+            for memo in [batch_tmpl_memo] + list(
+                self._prefix_store.values()
+            ):
+                pb = memo.pop("_paged", None)
+                if pb is not None and \
+                        pb.get("run") == self._paged_run_seq:
+                    arena.release(pb["ids"])
+
+        def admit_paged(slot, prompt, n, tmpl, p0, store_entry):
+            """Paged-target admission: SHARE whole template blocks
+            strictly below the first re-scored position w0 (refcount
+            up, zero copies — partial prefix overlap finally counts),
+            COPY the template blocks in [w0, p0) — they are about to
+            be re-written by the chunk re-score, which is exactly
+            copy-on-first-divergent-write at block granularity — and
+            allocate only the blocks the prompt needs now.  The token
+            law matches the dense path byte-for-byte: positions below
+            w0 carry template values, positions in [w0, n) carry the
+            same chunk-program values dense admission writes."""
+            nonlocal cache
+            C = self.buckets[-1]
+            BSZ = self.block_size
+            ids = None
+            w0 = 0
+            if tmpl is not None and p0:
+                w0 = min(C * (p0 // C), n - C)
+                if w0 > 0:
+                    ids = paged_template_ids(tmpl["t"], p0, store_entry)
+            jkey = ("paged_chunk",)
+            if jkey not in self._prefill_jit:
+                def fnc(params, pool_layers, table_s, chunk, off,
+                        zero_first):
+                    sub_layers = [
+                        {
+                            kk: jnp.where(
+                                zero_first, jnp.zeros_like(v), v
+                            )
+                            for kk, v in lay.items()
+                        }
+                        for lay in _paged_row_view(pool_layers, table_s)
+                    ]
+                    logits, sub = forward_step(
+                        params, chunk, cfg,
+                        {"layers": sub_layers, "offset": off},
+                    )
+                    return (
+                        _paged_row_scatter(
+                            pool_layers, sub["layers"], table_s
+                        ),
+                        logits[0],
+                    )
+
+                self._prefill_jit[jkey] = jax.jit(fnc)
+            chunk_fn = self._prefill_jit[jkey]
+            if ids is not None:
+                share_n = w0 // BSZ
+                arena.share(slot, ids[:share_n])
+                copy_src = ids[share_n: arena.blocks_for(p0)]
+            else:
+                copy_src = []
+            if not arena.alloc_upto(slot, n):
+                raise RuntimeError(
+                    "paged admission allocation failed after the "
+                    "free-block gate — arena accounting bug"
+                )
+            table_dirty()
+            if copy_src:
+                dst = [
+                    int(arena.table[slot, (w0 // BSZ) + i])
+                    for i in range(len(copy_src))
+                ]
+                ck = ("paged_copy", len(copy_src))
+                if ck not in self._prefill_jit:
+                    def fcp(pool_layers, src, dst_):
+                        return [
+                            {
+                                kk: v.at[dst_].set(v[src])
+                                for kk, v in lay.items()
+                            }
+                            for lay in pool_layers
+                        ]
+
+                    self._prefill_jit[ck] = jax.jit(fcp)
+                cache = dict(cache, layers=self._prefill_jit[ck](
+                    cache["layers"],
+                    jnp.asarray(copy_src, jnp.int32),
+                    jnp.asarray(dst, jnp.int32),
+                ))
+            tbl_s = table_device()[slot]
+            if ids is None and n <= self.buckets[-1]:
+                b = self._bucket(n)
+                sk = ("paged_solo", b)
+                if sk not in self._prefill_jit:
+                    def fns(params, pool_layers, table_s, padded,
+                            plen, key):
+                        # Mirror _prefill's trace on the row view:
+                        # fresh zero rows, scalar offset, same pick.
+                        sub = {
+                            "layers": [
+                                {
+                                    kk: jnp.zeros_like(v)
+                                    for kk, v in lay.items()
+                                }
+                                for lay in _paged_row_view(
+                                    pool_layers, table_s
+                                )
+                            ],
+                            "offset": jnp.zeros((), jnp.int32),
+                        }
+                        logits, sub = forward_step(
+                            params, padded[None, :], cfg, sub
+                        )
+                        last = logits[0, plen - 1, :]
+                        first = self._pick(last[None, :], key)[0]
+                        return (
+                            _paged_row_scatter(
+                                pool_layers, sub["layers"], table_s
+                            ),
+                            first,
+                        )
+
+                    self._prefill_jit[sk] = jax.jit(fns)
+                padded = onp.zeros((b,), onp.int32)
+                padded[:n] = prompt
+                new_layers, first = self._prefill_jit[sk](
+                    self.params, cache["layers"], tbl_s,
+                    jnp.asarray(padded), jnp.asarray(n, jnp.int32),
+                    self._next_key(),
+                )
+                cache = dict(cache, layers=new_layers)
+            else:
+                # Chunked prefill through the table (fresh blocks when
+                # untemplated; from w0 when sharing — the first chunk
+                # must NOT zero, that would wipe shared blocks).
+                c_start = w0 if ids is not None else 0
+                zero_ok = ids is None
+                last = None
+                for c0 in range(c_start, n, C):
+                    start = c0 if c0 + C <= n else n - C
+                    piece = prompt[start: start + C]
+                    new_layers, logits = chunk_fn(
+                        self.params, cache["layers"], tbl_s,
+                        jnp.asarray(piece)[None],
+                        jnp.asarray(start, jnp.int32),
+                        jnp.asarray(zero_ok and start == 0),
+                    )
+                    cache = dict(cache, layers=new_layers)
+                    if start + C >= n:
+                        last = logits[(n - 1) - start]
+                first = self._pick(last[None, :], self._next_key())[0]
+            cache = dict(
+                cache, offset=cache["offset"].at[slot].set(n)
+            )
+            return first
+
+        def paged_admit_need(item, bare=False) -> int:
+            """Blocks this admission takes from the pool RIGHT NOW
+            (the ISSUE 19 admission law — not a full-slot
+            reservation).  ``bare`` prices the untemplated fallback."""
+            rid_, prompt_, mnt_, extra_ = item
+            extra_ = extra_ or {}
+            if "kv" in extra_:
+                return arena.blocks_for(extra_["kv"]["n"])
+            n = len(prompt_) + (P0 if prefix is not None else 0)
+            need = arena.blocks_for(n)
+            if bare:
+                return need
+            p0, entry = 0, None
+            C = self.buckets[-1]
+            if prefix is not None and n > C and templates:
+                p0, entry = P0, batch_tmpl_memo
+            elif extra_.get("prefix_len") and len(prompt_) > C:
+                p0 = int(extra_["prefix_len"])
+                with self._pending_mu:
+                    entry = self._prefix_store.get(
+                        extra_.get("prefix_fp") or ""
+                    )
+            if p0:
+                w0 = min(C * (p0 // C), n - C)
+                if w0 > 0:
+                    pb = (entry or {}).get("_paged")
+                    if pb and pb.get("run") == self._paged_run_seq:
+                        # Warm template: the shared blocks arrive free.
+                        need -= w0 // self.block_size
+                    else:
+                        # Cold: materializing the template costs its
+                        # blocks too.
+                        need += arena.blocks_for(p0)
+            return max(0, need)
+
+        def preempt(victim):
+            """Shed a slot when the pool runs dry (grow-on-demand's
+            escape hatch): its blocks return to the pool instantly and
+            the request re-queues at the FRONT.  Greedy decode
+            regenerates the identical stream; tokens already delivered
+            through on_token are muted on re-admission."""
+            rid = slot_req[victim]
+            self.preemptions += 1
+            if draft_open[victim]:
+                draft_close.append(rid)
+            self._preempt_emitted[rid] = len(slot_out[victim])
+            with self._pending_mu:
+                self._pending.appendleft(slot_item[victim])
+            arena.free_slot(victim)
+            table_dirty()
+            active[victim] = False
+            slot_req[victim] = None
+            slot_prompt[victim] = None
+            slot_out[victim] = None
+
+        def ensure_round_blocks(round_need: int) -> None:
+            """Grow every active slot to cover this round's writes —
+            INCLUDING the speculative / chunked overshoot, whose
+            accepted prefix becomes real KV after the rewind — before
+            the dispatch.  Oldest admissions grow first; when the pool
+            cannot cover someone, the youngest admission is preempted
+            (vllm's recompute-last policy) until the rest fit."""
+            off = onp.asarray(cache["offset"])
+            order = sorted(
+                (s for s in range(B) if active[s]),
+                key=lambda s: admit_seq[s],
+            )
+            for s in order:
+                while active[s] and not arena.alloc_upto(
+                    s, int(off[s]) + round_need
+                ):
+                    if arena.scavenge():
+                        continue
+                    victim = max(
+                        (v for v in range(B) if active[v]),
+                        key=lambda v: admit_seq[v],
+                    )
+                    preempt(victim)
+            table_dirty()
 
         def admit_one_cache(slot, prompt, n, c, mparams, mcfg, role,
                             tmpl=None, p0=0):
@@ -2302,12 +3161,19 @@ class DecodeServer:
             """Shared post-admission bookkeeping: the slot is live,
             its first token (sampled at prefill or shipped with the KV
             segment) is emitted, EOS/budget-0 finishes immediately."""
+            nonlocal admit_counter
             slot_bound[slot] = n + mnt
             active[slot] = True
             slot_req[slot] = rid
             slot_prompt[slot] = prompt
             slot_out[slot] = [int(first)]
             budget[slot] = mnt - 1
+            admit_counter += 1
+            admit_seq[slot] = admit_counter
+            # A preempted request regenerates its stream from scratch;
+            # tokens the caller already received stay muted (greedy
+            # decode makes the regenerated prefix identical).
+            slot_mute[slot] = self._preempt_emitted.pop(rid, 0)
             # Fresh per-request speculation state: every request
             # starts at full width and earns its own EWMA.
             req_k[slot] = self.draft_k
@@ -2315,7 +3181,9 @@ class DecodeServer:
             req_rounds[slot] = req_tokens[slot] = req_plain[slot] = 0
             draft_mark[slot] = 0
             draft_open[slot] = False
-            if on_token is not None:
+            if slot_mute[slot] > 0:
+                slot_mute[slot] -= 1
+            elif on_token is not None:
                 on_token(rid, int(first))
             if int(first) == self.eos_token or budget[slot] <= 0:
                 finish(slot)
@@ -2326,31 +3194,56 @@ class DecodeServer:
             the slot — a memory move, zero prefill FLOPs; decode
             continues from the segment's first token."""
             nonlocal cache, toks
-            jkey = ("kvimport",)
-            if jkey not in self._prefill_jit:
-                def fn(c, arrs, s, n_):
-                    new_layers = self._slot_writeback(c, arrs, s)
-                    return dict(
-                        c, layers=new_layers,
-                        offset=c["offset"].at[s].set(n_),
+            if self.paged:
+                # Paged target: the import rows are padded to the block
+                # boundary — allocate exactly the blocks the segment
+                # occupies and block-write them (same writer the
+                # templates use).
+                n_ = int(kvinfo["n"])
+                if not arena.alloc_upto(slot, n_):
+                    raise RuntimeError(
+                        "paged import allocation failed after the "
+                        "free-block gate — arena accounting bug"
                     )
+                table_dirty()
+                nblk = arena.blocks_for(n_)
+                ids = [int(arena.table[slot, i]) for i in range(nblk)]
+                cache = dict(
+                    cache,
+                    layers=blk_writer(nblk)(
+                        cache["layers"], kvinfo["layers"],
+                        jnp.asarray(ids, jnp.int32),
+                    ),
+                    offset=cache["offset"].at[slot].set(n_),
+                )
+            else:
+                jkey = ("kvimport",)
+                if jkey not in self._prefill_jit:
+                    def fn(c, arrs, s, n_):
+                        new_layers = self._slot_writeback(c, arrs, s)
+                        return dict(
+                            c, layers=new_layers,
+                            offset=c["offset"].at[s].set(n_),
+                        )
 
-                self._prefill_jit[jkey] = jax.jit(fn)
-            cache = self._prefill_jit[jkey](
-                cache, kvinfo["layers"], jnp.asarray(slot),
-                jnp.asarray(kvinfo["n"], jnp.int32),
-            )
+                    self._prefill_jit[jkey] = jax.jit(fn)
+                cache = self._prefill_jit[jkey](
+                    cache, kvinfo["layers"], jnp.asarray(slot),
+                    jnp.asarray(kvinfo["n"], jnp.int32),
+                )
             toks = toks.at[slot].set(kvinfo["first"])
             seat(slot, rid, prompt, kvinfo["n"], mnt, kvinfo["first"])
 
-        def admit(slot, item):
+        def admit(slot, item, paged_no_tmpl=False):
             rid, prompt, mnt, extra = item
             extra = extra or {}
+            slot_item[slot] = item
             if "kv" in extra:
                 admit_imported(slot, rid, prompt, mnt, extra["kv"])
                 return
             tmpl = None
             p0 = 0
+            store_entry = None
             if prefix is not None:
                 # Output contract matches serve([prefix + p ...]).
                 prompt = onp.concatenate([prefix, prompt])
@@ -2370,13 +3263,24 @@ class DecodeServer:
                     or prefix_fingerprint(prompt[: extra["prefix_len"]]),
                 )
                 tmpl, p0 = entry["layers"], entry["p0"]
+                store_entry = entry
             n = len(prompt)
             nonlocal cache, cache_d, toks
-            cache, first = admit_one_cache(
-                slot, prompt, n, cache, self.params, self.cfg, "t",
-                tmpl=tmpl, p0=p0,
-            )
+            if self.paged:
+                first = admit_paged(
+                    slot, prompt, n,
+                    None if paged_no_tmpl else tmpl,
+                    p0, store_entry,
+                )
+            else:
+                cache, first = admit_one_cache(
+                    slot, prompt, n, cache, self.params, self.cfg, "t",
+                    tmpl=tmpl, p0=p0,
+                )
             if self.draft is not None:
+                # The draft's tiny cache stays dense even under paged
+                # target KV — it is a constant-size side array, not the
+                # stranded-memory cost the arena exists to reclaim.
                 cache_d, _ = admit_one_cache(
                     slot, prompt, n, cache_d, self.draft[0],
                     self.draft[1], "d", tmpl=tmpl, p0=p0,
@@ -2410,6 +3314,12 @@ class DecodeServer:
                 # completion would grow without bound for the life of
                 # a fleet replica.
                 results[rid] = out
+            if self.paged:
+                # Blocks return to the pool the instant the slot
+                # frees — the next admission can take them this same
+                # loop iteration.
+                arena.free_slot(slot)
+                table_dirty()
             active[slot] = False
             slot_req[slot] = None
             slot_prompt[slot] = None
@@ -2434,7 +3344,11 @@ class DecodeServer:
                     slot_out[s].append(int(t))
                     appended += 1
                     budget[s] -= 1
-                    if on_token is not None:
+                    if slot_mute[s] > 0:
+                        # Re-serving after a paged preemption: this
+                        # token was already delivered before the shed.
+                        slot_mute[s] -= 1
+                    elif on_token is not None:
                         on_token(slot_req[s], int(t))
                     if (
                         int(t) == self.eos_token
@@ -2493,6 +3407,26 @@ class DecodeServer:
                         if plain_rounds else 0.0
                     ),
                 }
+            if self.paged:
+                # The stats-drift fix (ISSUE 19 satellite): under
+                # paged mode ``occupancy`` IS block-pool utilization —
+                # tokens held, not slots seated — so gateway admission
+                # and autoscale hysteresis see real memory headroom
+                # with no discontinuity at the flag flip.
+                used = int(arena.used_blocks)
+                self.last_stats.update(
+                    paged=True,
+                    total_blocks=arena.n_blocks,
+                    free_blocks=arena.free_blocks,
+                    block_occupancy=used / max(1, arena.n_blocks),
+                    occupancy=used / max(1, arena.n_blocks),
+                    preemptions=self.preemptions,
+                    leaks_repaired=arena.leaks_repaired,
+                )
+            else:
+                self.last_stats["occupancy"] = (
+                    float(active.sum()) / max(1, B)
+                )
 
         self._live_active = active
         self._live_slot_req = slot_req
@@ -2510,16 +3444,48 @@ class DecodeServer:
                         # on_finish; admission re-zeros the rows.
                         if draft_open[s]:
                             draft_close.append(slot_req[s])
+                        if self.paged:
+                            # Abort/deadline shed returns blocks to
+                            # the pool INSTANTLY (ISSUE 19c) — the
+                            # chaos site inside _drop_ref models a
+                            # lost free here.
+                            arena.free_slot(s)
+                            table_dirty()
                         active[s] = False
                         slot_req[s] = None
                         slot_prompt[s] = None
                         slot_out[s] = None
+            if self.paged:
+                # Leak-repair sweep (the conservation law's defense):
+                # any block whose refcount says free but which sits on
+                # no free list — e.g. a chaos-dropped free — is
+                # rebuilt into the pool before admission prices it.
+                arena.scavenge()
             for s in range(B):
                 if not active[s]:
                     item = self._pop_pending()
                     if item is None:
                         break
-                    admit(s, item)
+                    no_tmpl = False
+                    if self.paged:
+                        need = paged_admit_need(item)
+                        if arena.free_blocks < need:
+                            if active.any():
+                                # The blocks it needs NOW aren't
+                                # free: wait for decode to release
+                                # some before seating it.
+                                with self._pending_mu:
+                                    self._pending.appendleft(item)
+                                break
+                            # Empty batch: the request MUST admit —
+                            # give up the template (and, if still
+                            # tight, every template's held blocks)
+                            # rather than livelock.
+                            no_tmpl = True
+                            bare = paged_admit_need(item, bare=True)
+                            if arena.free_blocks < bare:
+                                drop_template_holds()
+                    admit(s, item, no_tmpl)
             if not active.any():
                 if self.pending_count() == 0:
                     if tick is None or not keep:
@@ -2567,13 +3533,30 @@ class DecodeServer:
                     self.draft[1] if self.draft is not None else cfg,
                     round_k, self.temperature, self.top_k, self.top_p,
                 )
+                if self.paged:
+                    # Paged target under speculation: grow every slot
+                    # to cover the round's k+1 verify writes, then run
+                    # the UNCHANGED spec round on a gathered dense
+                    # view and scatter the result back through the
+                    # table — two extra dispatches buy byte-exact
+                    # reuse of the whole acceptance machinery.
+                    ensure_round_blocks(round_k + 1)
+                    if not active.any():
+                        continue
+                    pool_layers = cache["layers"]
+                    dense = self._paged_gather(
+                        pool_layers, table_device(), cache["offset"]
+                    )
+                else:
+                    pool_layers = None
+                    dense = cache
                 if self.draft is not None:
                     # Local draft: one batched roll over all slots,
                     # one chunked ragged verify, per-slot acceptance;
                     # idle slots ride along frozen (done mask).
-                    accepted_rows, nxt, cache, cache_d = \
+                    accepted_rows, nxt, dense, cache_d = \
                         _spec_decode_round(
-                            progs, self.params, self.draft[0], cache,
+                            progs, self.params, self.draft[0], dense,
                             cache_d, toks, ~active, round_k, sample,
                             self._np_rng,
                             self._next_key() if sample else greedy_key,
@@ -2594,11 +3577,21 @@ class DecodeServer:
                         spec_draft_failures += 1
                         continue
                     d_host, q_host, k_arr = got
-                    accepted_rows, nxt, cache = _spec_remote_round(
-                        progs, self.params, cache, toks, ~active,
+                    accepted_rows, nxt, dense = _spec_remote_round(
+                        progs, self.params, dense, toks, ~active,
                         d_host, q_host, round_k, sample, self._np_rng,
                         k_row=k_arr, max_off=slot_bound,
                     )
+                if self.paged:
+                    cache = {
+                        "layers": self._paged_scatter(
+                            pool_layers, dense["layers"],
+                            table_device(),
+                        ),
+                        "offset": dense["offset"],
+                    }
+                else:
+                    cache = dense
                 toks = jnp.asarray(nxt)
                 # Acceptance BEFORE EOS/budget truncation — what the
                 # draft earned, the signal k adapts on.  Only rows
@@ -2663,17 +3656,40 @@ class DecodeServer:
                     if active[s]:
                         req_plain[s] += 1
             if self.decode_chunk > 1:
-                cache, toks, chunk = self._chunk_step(
-                    self.params, cache, toks, jnp.asarray(active),
-                    self._next_key(),
-                )
+                if self.paged:
+                    ensure_round_blocks(self.decode_chunk)
+                    if not active.any():
+                        continue
+                    new_layers, offs, toks, chunk = \
+                        self._chunk_step_paged(
+                            self.params, cache["layers"],
+                            table_device(), cache["offset"], toks,
+                            jnp.asarray(active), self._next_key(),
+                        )
+                    cache = {"layers": new_layers, "offset": offs}
+                else:
+                    cache, toks, chunk = self._chunk_step(
+                        self.params, cache, toks, jnp.asarray(active),
+                        self._next_key(),
+                    )
                 plain_rounds += 1
                 plain_tokens += emit_rows(onp.asarray(chunk))  # [B, K]
                 continue
-            cache, nxt = self._step(
-                self.params, cache, toks, jnp.asarray(active),
-                self._next_key(),
-            )
+            if self.paged:
+                ensure_round_blocks(1)
+                if not active.any():
+                    continue
+                new_layers, offs, nxt = self._step_paged(
+                    self.params, cache["layers"], table_device(),
+                    cache["offset"], toks, jnp.asarray(active),
+                    self._next_key(),
+                )
+                cache = {"layers": new_layers, "offset": offs}
+            else:
+                cache, nxt = self._step(
+                    self.params, cache, toks, jnp.asarray(active),
+                    self._next_key(),
+                )
             toks = nxt
             plain_rounds += 1
             plain_tokens += emit_rows(onp.asarray(nxt)[:, None])

@@ -54,6 +54,13 @@ class ScalePolicy:
     #: suppressed.  0 = signal off; an UNMEASURED pool (0.0 reported)
     #: is never punished.
     tokens_per_round_low: float = 0.0
+    #: Memory-pressure ceiling (ISSUE 19, paged KV): scale up when the
+    #: fleet's ``kv_occupancy`` — block-pool utilization under paged
+    #: KV, the slot fraction otherwise — exceeds this.  A nearly-full
+    #: block pool preempts/queues work even while free SLOTS remain,
+    #: a pressure the queue-depth signal lags.  0 = signal off
+    #: (default: no behavior change for existing fleets).
+    mem_high_occupancy: float = 0.0
 
 
 @dataclasses.dataclass
@@ -71,12 +78,21 @@ def decide(snapshot: Dict[str, Any], policy: ScalePolicy,
     change is warranted)."""
     alive = max(1, int(snapshot.get("replicas_alive", 1)))
     queue_per = snapshot.get("queue_depth", 0) / alive
-    occupancy = float(snapshot.get("occupancy", 0.0))
+    # Memory occupancy when reported (ISSUE 19: block-pool utilization
+    # under paged KV, identical to the slot fraction otherwise — the
+    # two agree in dense mode, so hysteresis sees no step at the flag
+    # flip), slot occupancy for older snapshots.
+    occupancy = float(
+        snapshot.get("kv_occupancy", snapshot.get("occupancy", 0.0))
+    )
     ttft_p95 = float(snapshot.get("ttft_p95_ms", 0.0))
 
     pressure = queue_per > policy.queue_high_per_replica or (
         policy.ttft_p95_high_ms > 0
         and ttft_p95 > policy.ttft_p95_high_ms
+    ) or (
+        policy.mem_high_occupancy > 0
+        and occupancy > policy.mem_high_occupancy
     )
     idle = (
         queue_per < policy.queue_low_per_replica
@@ -160,6 +176,9 @@ def decide_pools(snapshot: Dict[str, Any],
             # acceptance its CONSUMERS measure (gateway snapshot).
             "tokens_per_round": pool.get("tokens_per_round", 0.0),
         }
+        if "kv_occupancy" in pool:
+            # Memory headroom carry-through (ISSUE 19).
+            sub["kv_occupancy"] = pool.get("kv_occupancy", 0.0)
         if role in _TTFT_ROLES:
             sub["ttft_p95_ms"] = snapshot.get("ttft_p95_ms", 0.0)
         targets[role] = decide(

@@ -374,6 +374,24 @@ class DraftWorker:
         with self._mu:
             return len(self._streams)
 
+    def kv_stats(self) -> Dict[str, Any]:
+        """The draft's KV memory view in the fleet's ``kv_occupancy``
+        convention (ISSUE 19).  Draft stream caches stay DENSE — each
+        is a constant 1-row [max_len] array, tiny next to the target's
+        pool, and streams churn with the LRU bound rather than growing
+        — so occupancy here is committed tokens over stream capacity,
+        the honest analogue of the target's block-pool utilization."""
+        with self._mu:
+            held = sum(int(st["off"]) for st in self._streams.values())
+            n = len(self._streams)
+        cap = self.max_streams * self.max_len
+        return {
+            "kv_occupancy": round(held / cap, 4) if cap else 0.0,
+            "kv_tokens_held": held,
+            "kv_token_capacity": cap,
+            "streams": n,
+        }
+
     # -- the proposal loop -------------------------------------------------
 
     def propose(self, reqs: List[dict], k: int, sample: bool = False,
@@ -658,9 +676,12 @@ class DraftReplicaRunner:
                     replica_id=self.replica_id, free_slots=0,
                     active=[], stats={
                         "role": "draft",
-                        "streams": w.stream_count(),
                         "rolls": w.rolls,
                         "proposed_tokens": w.proposed_tokens,
+                        # Memory view (ISSUE 19): committed stream
+                        # tokens over capacity — the draft pool's
+                        # kv_occupancy in the gateway snapshot.
+                        **w.kv_stats(),
                     },
                 ))
                 if isinstance(reply, ServeGrants):

@@ -579,6 +579,20 @@ class GatewayCore:
                 # take yet (template warm elsewhere, within the reserve
                 # window) are SKIPPED, never blocking what's behind.
                 free = max(0, int(free_slots))
+                if stats:
+                    # Paged-KV memory gate (ISSUE 19): a replica whose
+                    # block pool is exhausted has free SLOTS but no
+                    # free MEMORY — granting into it would only queue
+                    # (or preempt) replica-side.  Let another poll
+                    # take the work.
+                    try:
+                        if int(stats.get("total_blocks", 0) or 0) > 0 \
+                                and int(
+                                    stats.get("free_blocks", 0) or 0
+                                ) == 0:
+                            free = 0
+                    except (TypeError, ValueError):
+                        pass
                 i = 0
                 while len(grants) < free and i < len(self._queue):
                     req = self._queue[i]
@@ -936,16 +950,51 @@ class GatewayCore:
                 except (TypeError, ValueError):
                     return 0.0
 
+            def _kvocc(rep: _Replica) -> Optional[float]:
+                """The replica's reported memory occupancy (block-pool
+                utilization under paged KV, slot fraction otherwise —
+                ISSUE 19); None when the replica predates the field."""
+                try:
+                    v = rep.stats.get("kv_occupancy")
+                    return None if v is None else float(v)
+                except (TypeError, ValueError):
+                    return None
+
+            def _blk(rep: _Replica, key: str) -> int:
+                try:
+                    return int(rep.stats.get(key, 0) or 0)
+                except (TypeError, ValueError):
+                    return 0
+
             pools: Dict[str, Dict[str, Any]] = {}
             for role in ("unified", "prefill", "decode", "draft"):
                 members = [r for r in alive if r.role == role]
                 slots = sum(r.slots for r in members)
                 assigned = sum(len(r.assigned) for r in members)
+                reported = [
+                    x for x in (_kvocc(r) for r in members)
+                    if x is not None
+                ]
                 pools[role] = {
                     "alive": len(members),
                     "slots": slots,
                     "assigned": assigned,
                     "occupancy": assigned / slots if slots else 0.0,
+                    # Real memory headroom (ISSUE 19): mean reported
+                    # kv_occupancy, falling back to the slot fraction
+                    # for fleets that don't report it — continuous
+                    # across the paged-flag flip, so autoscale
+                    # hysteresis never sees a step.
+                    "kv_occupancy": (
+                        sum(reported) / len(reported) if reported
+                        else (assigned / slots if slots else 0.0)
+                    ),
+                    "free_blocks": sum(
+                        _blk(r, "free_blocks") for r in members
+                    ),
+                    "total_blocks": sum(
+                        _blk(r, "total_blocks") for r in members
+                    ),
                     "queue_depth": 0,
                     # Accepted-tokens-per-round signal (ISSUE 11):
                     # mean over the pool's reporting members; 0 =
@@ -975,6 +1024,19 @@ class GatewayCore:
                 "replicas_draining": len(self._replicas) - len(alive),
                 "occupancy": (
                     total_assigned / total_slots if total_slots else 0.0
+                ),
+                # Fleet memory occupancy (ISSUE 19): slot-weighted
+                # mean of each replica's reported kv_occupancy
+                # (falling back to its slot fraction) — what paged-KV
+                # admission and autoscale read for real headroom.
+                "kv_occupancy": (
+                    sum(
+                        (
+                            _kvocc(r) if _kvocc(r) is not None
+                            else len(r.assigned) / max(1, r.slots)
+                        ) * r.slots
+                        for r in alive
+                    ) / total_slots if total_slots else 0.0
                 ),
                 "pools": pools,
                 "counters": self._counters.snapshot(),

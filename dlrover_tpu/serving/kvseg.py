@@ -60,6 +60,23 @@ def segment_fingerprint(payload: bytes) -> str:
     return hashlib.sha1(payload).hexdigest()[:16]
 
 
+def segment_block_info(payload: bytes) -> Optional[Tuple[int, int]]:
+    """Peek a segment's block framing (ISSUE 19) without touching the
+    array data: ``(block_size, n_blocks)`` for a block-list payload
+    from a paged prefill server, ``None`` for a monolithic one (or
+    anything unparseable — the store treats payloads as opaque bytes,
+    so a peek failure is telemetry lost, never an error)."""
+    try:
+        import msgpack
+
+        meta = msgpack.unpackb(payload, raw=False)["meta"]
+        if "bs" in meta:
+            return int(meta["bs"]), int(meta["nblk"])
+    except Exception as e:  # noqa: BLE001 - telemetry-only peek
+        logger.debug("segment block-info peek failed: %s", e)
+    return None
+
+
 class KvSegmentStore:
     """Bounded, TTL'd req_id -> segment table on the prefill replica.
 
@@ -136,6 +153,27 @@ class KvSegmentStore:
     def nbytes(self) -> int:
         with self._mu:
             return self._bytes
+
+    def stats(self) -> Dict[str, int]:
+        """Store telemetry including the block framing (ISSUE 19):
+        how many retained segments ride as block lists and the total
+        KV blocks they hold — the handoff-side view of the paged
+        fleet's memory motion."""
+        with self._mu:
+            entries = [p for p, _f, _c, _t in self._segs.values()]
+        paged = 0
+        blocks = 0
+        for p in entries:
+            info = segment_block_info(p)
+            if info is not None:
+                paged += 1
+                blocks += info[1]
+        return {
+            "segments": len(entries),
+            "bytes": sum(len(p) for p in entries),
+            "paged_segments": paged,
+            "blocks_held": blocks,
+        }
 
     # -- internals (called under self._mu; RLock re-entry keeps the
     # writes lexically lock-scoped) ---------------------------------------

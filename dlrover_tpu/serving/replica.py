@@ -907,6 +907,11 @@ class ReplicaRunner:
         active = len(self.server.active_rids())
         stats = {
             "slot_occupancy": active / max(1, self.server.slots),
+            # Memory occupancy in BOTH modes (the ISSUE 19 stats-drift
+            # fix): block-pool utilization under paged KV, the slot
+            # fraction otherwise — one continuous signal, so autoscale
+            # hysteresis sees no discontinuity at the flag flip.
+            "kv_occupancy": active / max(1, self.server.slots),
             "queue_depth": self.server.pending_count(),
             "tokens_per_sec": round(self._last_tps, 2),
             "ttft_ms_last": round(self._last_ttft_ms, 2),
@@ -914,6 +919,15 @@ class ReplicaRunner:
             "replayed": self.replayed,
             "role": self.role,
         }
+        blocks = getattr(self.server, "block_stats", None)
+        blocks = blocks() if blocks is not None else None
+        if blocks is not None:
+            stats["kv_occupancy"] = round(
+                blocks["block_occupancy"], 4
+            )
+            stats["free_blocks"] = int(blocks["free_blocks"])
+            stats["total_blocks"] = int(blocks["total_blocks"])
+            stats["preemptions"] = int(blocks["preemptions"])
         if self.prefilled:
             stats["prefilled"] = self.prefilled
         if self.kv_published:

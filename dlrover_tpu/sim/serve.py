@@ -216,8 +216,24 @@ class GlobalServeSim:
         opts = self.opts
         core = self.cores[cid]
         self._last_poll[rid] = self.clock()
-        grants = core.poll(rid, free_slots=int(opts["slots"]),
-                           active=[])
+        # Report a paged-KV memory view (ISSUE 19) so the sim's
+        # gateway exercises the real pools carry-through: the stub
+        # models one token per block — outstanding batch tokens are
+        # the blocks held, slot capacity the pool.  A saturated stub
+        # (free_blocks == 0) hits the same admission gate a real
+        # paged replica does.
+        held = sum(t for _r, t in (self._batch.get(rid) or []))
+        cap = int(opts["slots"]) * (
+            int(opts["prompt_tokens"]) + int(opts["mnt"])
+        )
+        grants = core.poll(
+            rid, free_slots=int(opts["slots"]), active=[],
+            stats={
+                "kv_occupancy": round(held / cap, 4) if cap else 0.0,
+                "free_blocks": max(0, cap - held),
+                "total_blocks": cap,
+            },
+        )
         now = self.clock()
         if not grants.requests:
             if (self._arrived >= len(self.times)

@@ -86,6 +86,16 @@ def parse_args(argv=None):
     p.add_argument("--quant_kv", action="store_true",
                    help="(replica) int8 KV cache — halves the "
                         "prefill->decode segment transfer")
+    p.add_argument("--paged", action="store_true",
+                   help="(replica) paged KV (ISSUE 19): block-pool "
+                        "arena + per-request block tables; admission "
+                        "by blocks actually needed, the poll reports "
+                        "real memory headroom")
+    p.add_argument("--block_size", type=int, default=16,
+                   help="(replica) tokens per KV block under --paged")
+    p.add_argument("--pool_blocks", type=int, default=0,
+                   help="(replica) KV pool size in blocks under "
+                        "--paged (0 = slots * max_len / block_size)")
     p.add_argument("--prefix_cache_cap", type=int, default=4,
                    help="(replica) warm prefix templates retained")
     p.add_argument("--warm_prefix_len", type=int, default=0,
@@ -185,6 +195,11 @@ def build_replica(args, transport, draft_connect=None):
         draft_k=getattr(args, "draft_k", 4),
         adapt_k_per_request=spec,
         spec_break_even=getattr(args, "spec_break_even", 0.0),
+        # Paged KV (ISSUE 19): block-pool arena; pool_blocks 0 keeps
+        # the matched-memory default (slots * max_len / block_size).
+        paged=getattr(args, "paged", False),
+        block_size=getattr(args, "block_size", 16),
+        pool_blocks=(getattr(args, "pool_blocks", 0) or None),
     )
     import numpy as np
 

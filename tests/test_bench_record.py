@@ -200,12 +200,13 @@ def test_serve_bench_smoke_schema(tmp_path):
     )
     elapsed = time.time() - t0
     assert proc.returncode == 0, proc.stderr[-2000:]
-    # ~50-70s observed on an idle host: the smoke now stands up ten
+    # ~60-80s observed on an idle host: the smoke now stands up ten
     # small fleets (plain + 4 routing planes + 2 tracing rows + 4
-    # speculation rows) and each fresh DecodeServer instance pays its
-    # own XLA warmup compiles; allow CI contention headroom but fail
-    # loudly if the smoke config ever becomes heavyweight beyond that.
-    assert elapsed < 180.0, f"smoke serve bench took {elapsed:.1f}s"
+    # speculation rows) plus four in-process paged-KV A/B servers, and
+    # each fresh DecodeServer instance pays its own XLA warmup
+    # compiles; allow CI contention headroom but fail loudly if the
+    # smoke config ever becomes heavyweight beyond that.
+    assert elapsed < 200.0, f"smoke serve bench took {elapsed:.1f}s"
     result = json.loads(out.read_text())
     assert result["complete"] is True
     assert result["workload"]["requests"] == 5
@@ -306,6 +307,33 @@ def test_serve_bench_smoke_schema(tmp_path):
     assert fb["fallbacks"] > 0
     assert fb["tokens_per_round"] <= 2.0
     assert "verdict" in spec and "matched_chips" in spec["verdict"]
+    # Paged-KV rows (ISSUE 19): slotted vs paged at MATCHED KV memory
+    # over uniform and long-tail (Zipf) sequence-length workloads,
+    # with the end-to-end greedy byte-parity pin in the verdict.
+    paged = result["paged"]
+    prows = {(r["workload"], r["mode"]): r for r in paged["rows"]}
+    assert set(prows) == {
+        ("uniform", "slotted"), ("uniform", "paged"),
+        ("longtail", "slotted"), ("longtail", "paged"),
+    }
+    for r in prows.values():
+        assert r["completed"] == paged["requests"]
+        assert r["tokens_per_sec"] > 0
+        assert 0 < r["admitted_batch_occupancy"] <= 1.0
+    for w in ("uniform", "longtail"):
+        sl, pg = prows[(w, "slotted")], prows[(w, "paged")]
+        # Matched memory is the contract: same token budget, the
+        # paged side spending it as blocks with more seats.
+        assert sl["kv_pool_tokens"] == pg["kv_pool_tokens"]
+        assert pg["seats"] > sl["seats"]
+        assert pg["pool_blocks"] * paged["block_size"] == \
+            pg["kv_pool_tokens"]
+        assert "preemptions" in pg and "preemptions" not in sl
+    v = paged["verdict"]
+    assert v["uniform"]["outputs_match"] is True
+    assert v["longtail"]["outputs_match"] is True
+    assert v["paged_never_lower"] is True
+    assert v["longtail_paged_higher"] is True
     metric = json.loads(proc.stdout.strip().splitlines()[-1])
     assert metric["metric"] == "serve_fleet_speedup"
     assert metric["artifact"] == str(out)

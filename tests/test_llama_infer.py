@@ -2141,3 +2141,337 @@ class TestPrefixStore:
         entry = srv._ensure_prefix_template(p2, "colliding-fp")
         assert srv.prefix_misses == 2 and srv.prefix_hits == 0
         np.testing.assert_array_equal(entry["prefix"], p2)
+
+
+class TestPagedKv:
+    """ISSUE 19: the paged KV arena (block pool + per-request block
+    table) must be byte-invisible to greedy decode — every serving
+    surface reproduces the slotted server's outputs exactly — while
+    admitting by blocks actually needed and freeing at block
+    granularity (abort, CoW prefix sharing, preemption)."""
+
+    BS = 8
+    _model_cache: list = []
+
+    def _models(self):
+        if not self._model_cache:
+            cfg = llama.LlamaConfig.tiny(n_layer=2, dtype=jnp.float32)
+            params = llama.init_params(jax.random.PRNGKey(0), cfg)
+            self._model_cache.append((cfg, params))
+        return self._model_cache[0]
+
+    def _prompts(self, cfg, lens, seed=7):
+        rng = np.random.RandomState(seed)
+        return [rng.randint(1, cfg.vocab_size, L).astype(np.int32)
+                for L in lens]
+
+    def _pair(self, cfg, params, **kw):
+        """(slotted, paged) servers with identical serving config.
+        The base matches the file's dominant slotted shape (slots=2,
+        max_len=64, bucket 8) so the reference side reuses compiles
+        from the earlier suites."""
+        base = dict(slots=2, max_len=64, prompt_buckets=(8,), seed=0)
+        base.update(kw)
+
+        def mk(paged):
+            return llama_infer.DecodeServer(
+                params, cfg, paged=paged, block_size=self.BS, **base
+            )
+
+        return mk(False), mk(True)
+
+    def _assert_parity(self, slotted, paged, prompts, mnt,
+                       all_free=True, **serve_kw):
+        ref = slotted.serve(prompts, max_new_tokens=mnt, **serve_kw)
+        got = paged.serve(prompts, max_new_tokens=mnt, **serve_kw)
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+        arena = paged.kv_arena
+        assert arena.conserved()
+        if all_free:
+            assert arena.free_blocks == arena.n_blocks  # all returned
+
+    def test_greedy_parity_plain(self):
+        cfg, params = self._models()
+        slotted, paged = self._pair(cfg, params)
+        self._assert_parity(
+            slotted, paged, self._prompts(cfg, [5, 13, 22]), 8
+        )
+
+    def test_greedy_parity_chunked(self):
+        cfg, params = self._models()
+        slotted, paged = self._pair(cfg, params, decode_chunk=3)
+        self._assert_parity(
+            slotted, paged, self._prompts(cfg, [6, 14, 21]), 7
+        )
+
+    def test_greedy_parity_quant_kv(self):
+        cfg, params = self._models()
+        # max_len=32: the quant suite's slotted shape (compile reuse).
+        slotted, paged = self._pair(cfg, params, quant_kv=True,
+                                    max_len=32)
+        self._assert_parity(
+            slotted, paged, self._prompts(cfg, [5, 13, 22]), 8
+        )
+
+    def test_greedy_parity_spec_draft(self):
+        cfg, params = self._models()
+        dcfg = llama.LlamaConfig.tiny(n_layer=1, dtype=jnp.float32)
+        draft = llama.init_params(jax.random.PRNGKey(7), dcfg)
+        # max_len=48: the spec suite's slotted shape (compile reuse).
+        slotted, paged = self._pair(
+            cfg, params, draft=(draft, dcfg), draft_k=3, max_len=48
+        )
+        self._assert_parity(
+            slotted, paged, self._prompts(cfg, [4, 6, 5]), 6
+        )
+
+    def test_greedy_parity_shared_prefix_template(self):
+        """Batch-mode shared prefix: the paged template SHARES whole
+        prefix blocks copy-on-write instead of copying rows."""
+        cfg, params = self._models()
+        slotted, paged = self._pair(cfg, params, max_len=64)
+        prefix = self._prompts(cfg, [17], seed=3)[0]
+        # all_free=False: the batch template's blocks stay HELD for
+        # the run (a later admission may still share them); the next
+        # serve() resets the arena.
+        self._assert_parity(
+            slotted, paged, self._prompts(cfg, [6, 9, 5]), 8,
+            all_free=False, shared_prefix=prefix,
+        )
+
+    def test_cow_divergence_keeps_sharer_byte_identical(self):
+        """Two requests share a prefix template's blocks; each
+        diverges into its own copied boundary block and the other's
+        output is byte-identical to its solo decode (the CoW
+        correctness pin)."""
+        cfg, params = self._models()
+        _, srv = self._pair(cfg, params, max_len=64)
+        prefix = self._prompts(cfg, [16], seed=5)[0]
+        tails = self._prompts(cfg, [5, 7], seed=6)
+        fulls = [np.concatenate([prefix, t]) for t in tails]
+        solo = [
+            llama_infer.DecodeServer(
+                params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+                seed=0,
+            ).serve([f], max_new_tokens=8)[0]
+            for f in fulls
+        ]
+        got = {}
+        for i, f in enumerate(fulls):
+            srv.submit(i, f, 8, prefix_len=len(prefix))
+        srv.serve_incremental(
+            tick=lambda: bool(
+                srv.pending_count() or srv.active_rids()
+            ),
+            on_finish=lambda r, t: got.__setitem__(r, t),
+        )
+        # The second admission rode the warm per-fingerprint store
+        # (share + boundary copy), not a fresh prefill.
+        assert srv.prefix_hits >= 1
+        for i in range(2):
+            np.testing.assert_array_equal(
+                np.asarray(got[i]), np.asarray(solo[i])
+            )
+        assert srv.kv_arena.conserved()
+
+    def test_tight_pool_preempts_and_stays_byte_identical(self):
+        """A pool too small for every admitted request to grow to its
+        full length must preempt (youngest first) and re-decode — and
+        still emit exactly the slotted outputs, no duplicates through
+        on_token."""
+        cfg, params = self._models()
+        slotted, paged = self._pair(
+            cfg, params, slots=3, pool_blocks=6
+        )
+        prompts = self._prompts(cfg, [10, 9, 8], seed=9)
+        streamed = {}
+        ref = slotted.serve(prompts, max_new_tokens=8)
+        got = paged.serve(
+            prompts, max_new_tokens=8,
+            on_token=lambda r, t: streamed.setdefault(r, []).append(t),
+        )
+        assert paged.preemptions > 0
+        for i, (r, g) in enumerate(zip(ref, got)):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+            # The token stream matches the continuation exactly —
+            # a preempted request's re-decode never double-emits.
+            np.testing.assert_array_equal(
+                np.asarray(streamed[i]),
+                np.asarray(g)[len(prompts[i]):],
+            )
+        assert paged.kv_arena.conserved()
+
+    def test_abort_frees_blocks_and_readmits_within_a_round(self):
+        """ISSUE 19c: an abort returns the victim's blocks to the pool
+        instantly — a request that was blocked on memory seats within
+        one loop iteration of the shed."""
+        cfg, params = self._models()
+        _, srv = self._pair(cfg, params, pool_blocks=5)
+        a, b = self._prompts(cfg, [30, 10], seed=11)
+        solo_b = llama_infer.DecodeServer(
+            params, cfg, slots=1, max_len=48, prompt_buckets=(8,),
+            seed=0,
+        ).serve([b], max_new_tokens=6)[0]
+        srv.submit("A", a, 8)
+        srv.submit("B", b, 6)
+        ticks = [0]
+        abort_at = {}
+        b_seated = {}
+        got = {}
+
+        def tick():
+            ticks[0] += 1
+            live = {
+                r for s, r in enumerate(srv._live_slot_req)
+                if srv._live_active[s]
+            }
+            if "B" in live and not b_seated:
+                b_seated["tick"] = ticks[0]
+            if ticks[0] == 3:
+                # A holds 4 of 5 blocks; B (needs 2) cannot seat.
+                assert "B" not in live
+                abort_at["tick"] = ticks[0]
+                srv.abort("A")
+            return False  # drain: finish B, then return
+
+        srv.serve_incremental(
+            tick=tick, on_finish=lambda r, t: got.__setitem__(r, t)
+        )
+        assert "A" not in got  # aborted: partial output discarded
+        np.testing.assert_array_equal(
+            np.asarray(got["B"]), np.asarray(solo_b)
+        )
+        # The shed freed blocks the SAME iteration; B seats at the
+        # very next admission pass.
+        assert b_seated["tick"] <= abort_at["tick"] + 1
+        arena = srv.kv_arena
+        assert arena.conserved()
+        assert arena.free_blocks == arena.n_blocks
+
+    def test_block_leak_chaos_is_repaired_and_conserved(self):
+        """Chaos `serving.block_leak` drops a free on the release
+        path; the serve loop's scavenge rebuilds the free list from
+        the refcounts — the conservation law `free + used == pool`
+        holds after any chaos run."""
+        from dlrover_tpu import chaos
+
+        cfg, params = self._models()
+        _, srv = self._pair(cfg, params)
+        chaos.configure("serving.block_leak:p=1,times=1,seed=5")
+        try:
+            srv.serve(
+                self._prompts(cfg, [5, 9, 13], seed=13),
+                max_new_tokens=6,
+            )
+        finally:
+            chaos.reset()
+        arena = srv.kv_arena
+        assert arena.leaks_repaired >= 1
+        assert arena.conserved()
+        # free + table-mapped blocks == pool (all tables empty here).
+        assert arena.free_blocks + int(arena.lens.sum()) \
+            == arena.n_blocks
+
+    def test_paged_handoff_ships_block_lists(self):
+        """Disagg handoff from a paged prefill server frames the
+        segment as a per-block list (CRC per block); a paged decode
+        server imports it straight into pool blocks and reproduces
+        the unified slotted decode.  Dense segments stay importable
+        (cross-mode fleet)."""
+        from dlrover_tpu.serving import kvseg
+
+        cfg, params = self._models()
+        prompt = self._prompts(cfg, [13], seed=15)[0]
+
+        def server(paged):
+            return llama_infer.DecodeServer(
+                params, cfg, slots=1, max_len=48, prompt_buckets=(8,),
+                seed=0, paged=paged, block_size=self.BS,
+            )
+
+        ref = server(False).serve([prompt], max_new_tokens=6)[0]
+
+        def drain(dec):
+            out = {}
+            dec.serve_incremental(
+                tick=lambda: bool(
+                    dec.pending_count() or dec.active_rids()
+                ),
+                on_finish=lambda r, t: out.__setitem__(r, t),
+            )
+            return out
+
+        pf = server(True)
+        pf.prefill_request("x", prompt, 6)
+        payload, _ = pf.export_kv("x")
+        # Block framing is visible in the segment meta (and to the
+        # kvseg store's telemetry peek) without touching array bytes.
+        assert kvseg.segment_block_info(payload) == (
+            self.BS, -(-len(prompt) // self.BS)
+        )
+        dec = server(True)
+        dec.import_kv("x", payload, prompt, 6)
+        np.testing.assert_array_equal(
+            np.asarray(drain(dec)["x"]), np.asarray(ref)
+        )
+        # A torn BLOCK is caught by the per-block CRC at unpack.
+        torn = bytearray(payload)
+        torn[len(torn) // 2] ^= 0xFF
+        with pytest.raises(llama_infer.KvSegmentError):
+            server(True).import_kv("x", bytes(torn), prompt, 6)
+        # Cross-mode: a slotted prefill's monolithic segment imports
+        # into a paged decode server unchanged.
+        pf_dense = server(False)
+        pf_dense.prefill_request("y", prompt, 6)
+        dense_payload, _ = pf_dense.export_kv("y")
+        assert kvseg.segment_block_info(dense_payload) is None
+        dec2 = server(True)
+        dec2.import_kv("y", dense_payload, prompt, 6)
+        np.testing.assert_array_equal(
+            np.asarray(drain(dec2)["y"]), np.asarray(ref)
+        )
+
+    def test_paged_stats_report_block_pool(self):
+        """last_stats under paged mode reports block-pool occupancy
+        (tokens held, not slots seated) plus the pool gauges the
+        replica poll ships to the gateway."""
+        cfg, params = self._models()
+        _, srv = self._pair(cfg, params)
+        assert srv.block_stats() == {
+            "total_blocks": srv.pool_blocks,
+            "free_blocks": srv.pool_blocks,
+            "block_occupancy": 0.0,
+            "preemptions": 0,
+        }
+        seen = []
+
+        def tick():
+            st = srv.last_stats
+            if st.get("paged"):
+                seen.append(
+                    (st["occupancy"], st["free_blocks"],
+                     st["total_blocks"])
+                )
+            return bool(srv.pending_count() or srv.active_rids())
+
+        srv.submit("r", self._prompts(cfg, [9], seed=17)[0], 6)
+        srv.serve_incremental(tick=tick)
+        mid = [s for s in seen if s[0] > 0]
+        assert mid, "no in-flight stats sample saw blocks held"
+        occ, free, total = mid[0]
+        assert total == srv.pool_blocks
+        assert occ == pytest.approx((total - free) / total)
+
+    def test_paged_capacity_guards(self):
+        """max_len must align to block_size and a request that could
+        never fit the whole pool rejects at submit."""
+        cfg, params = self._models()
+        with pytest.raises(ValueError, match="multiple of block_size"):
+            llama_infer.DecodeServer(
+                params, cfg, slots=1, max_len=45, prompt_buckets=(8,),
+                paged=True, block_size=self.BS,
+            )
+        _, srv = self._pair(cfg, params, pool_blocks=3)
+        with pytest.raises(ValueError, match="KV blocks"):
+            srv.submit("big", self._prompts(cfg, [30])[0], 8)

@@ -1606,3 +1606,105 @@ def test_every_core_counter_is_exported_as_a_gauge():
         assert "serve_accepted 1.0" in body
     finally:
         gw.stop(grace=0.1)
+
+
+# ---------------------------------------------------------------------------
+# Paged KV at the serving layer (ISSUE 19): memory gate, snapshot
+# gauges, autoscale memory-pressure signal
+# ---------------------------------------------------------------------------
+
+
+class TestPagedKvServing:
+    def test_exhausted_block_pool_gates_grants_despite_free_slots(self):
+        core, _ = make_core()
+        core.register("r0", 4)
+        assert core.submit("a", [1, 2], 4).status == "accepted"
+        # Free SLOTS but zero free BLOCKS: granting would only queue
+        # (or preempt) replica-side, so the poll comes back empty.
+        g = core.poll("r0", 4, [],
+                      stats={"total_blocks": 8, "free_blocks": 0})
+        assert g.requests == []
+        # Blocks freed (a finish or abort replica-side): the very same
+        # request is granted on the next poll.
+        g = core.poll("r0", 4, [],
+                      stats={"total_blocks": 8, "free_blocks": 3})
+        assert [r.req_id for r in g.requests] == ["a"]
+
+    def test_dense_replica_stats_never_trip_the_gate(self):
+        core, _ = make_core()
+        core.register("r0", 2)
+        core.submit("a", [1], 4)
+        # A slotted replica reports no block gauges (total_blocks 0 /
+        # absent): the gate must stay out of its way.
+        g = core.poll("r0", 2, [], stats={"occupancy": 0.5})
+        assert [r.req_id for r in g.requests] == ["a"]
+
+    def test_snapshot_carries_block_gauges_and_kv_occupancy(self):
+        core, _ = make_core()
+        core.register("d0", 2, role="decode")
+        core.register("d1", 2, role="decode")
+        core.poll("d0", 2, [], stats={
+            "kv_occupancy": 0.75, "total_blocks": 8, "free_blocks": 2,
+        })
+        core.poll("d1", 2, [], stats={
+            "kv_occupancy": 0.25, "total_blocks": 8, "free_blocks": 6,
+        })
+        snap = core.stats_snapshot()
+        pool = snap["pools"]["decode"]
+        assert pool["kv_occupancy"] == pytest.approx(0.5)
+        assert pool["total_blocks"] == 16
+        assert pool["free_blocks"] == 8
+        # Fleet roll-up: slot-weighted mean of the reported values.
+        assert snap["kv_occupancy"] == pytest.approx(0.5)
+
+    def test_kv_occupancy_falls_back_to_slot_fraction(self):
+        core, _ = make_core()
+        core.register("r0", 2)
+        core.submit("a", [1], 4)
+        g = core.poll("r0", 2, [])
+        assert len(g.requests) == 1
+        snap = core.stats_snapshot()
+        # One of two slots assigned, nobody reporting kv_occupancy:
+        # the gauge degrades to the slot fraction — continuous across
+        # the paged-flag flip, so hysteresis never sees a step.
+        assert snap["kv_occupancy"] == pytest.approx(0.5)
+        assert snap["pools"]["unified"]["kv_occupancy"] == \
+            pytest.approx(0.5)
+
+    def test_mem_high_occupancy_scales_up_on_block_pressure(self):
+        # Queue empty, slot occupancy moderate — but the block pool is
+        # nearly full.  Only the memory signal sees this pressure.
+        snap = {"replicas_alive": 2, "queue_depth": 0,
+                "occupancy": 0.5, "kv_occupancy": 0.95}
+        pol = ScalePolicy(max_replicas=4, up_patience=1,
+                          mem_high_occupancy=0.8)
+        assert decide(snap, pol, ScaleState()) == 3
+        # Default 0.0 = signal off: identical snapshot holds steady.
+        assert decide(snap, ScalePolicy(max_replicas=4, up_patience=1),
+                      ScaleState()) == 2
+
+    def test_decide_prefers_kv_occupancy_over_slot_fraction(self):
+        # Slot fraction says idle; the block pool says otherwise — the
+        # memory gauge wins, suppressing the scale-down.
+        pol = ScalePolicy(min_replicas=1, down_patience=1,
+                          queue_low_per_replica=0.5, occupancy_low=0.3)
+        busy = {"replicas_alive": 2, "queue_depth": 0,
+                "occupancy": 0.1, "kv_occupancy": 0.9}
+        assert decide(busy, pol, ScaleState()) == 2
+        idle = {"replicas_alive": 2, "queue_depth": 0,
+                "occupancy": 0.1, "kv_occupancy": 0.1}
+        assert decide(idle, pol, ScaleState()) == 1
+
+    def test_decide_pools_carries_kv_occupancy_through(self):
+        policies = {"decode": ScalePolicy(max_replicas=4, up_patience=1,
+                                          mem_high_occupancy=0.8)}
+        states = {}
+        snap = {
+            "ttft_p95_ms": 0.0,
+            "pools": {
+                "decode": {"alive": 2, "queue_depth": 0,
+                           "occupancy": 0.5, "kv_occupancy": 0.95},
+            },
+        }
+        targets = decide_pools(snap, policies, states)
+        assert targets["decode"] == 3

@@ -1131,3 +1131,26 @@ def test_registry_server_delete_dedupe_is_race_safe():
     finally:
         srv.kv.delete = real_delete
         srv.stop()
+
+
+def test_kv_segment_store_stats_report_block_framing():
+    """ISSUE 19: store telemetry distinguishes block-list segments
+    (paged prefill handoff) from monolithic ones and totals the KV
+    blocks held — the handoff-side view of the fleet's memory."""
+    import msgpack
+
+    store = KvSegmentStore()
+    paged = msgpack.packb(
+        {"meta": {"bs": 8, "nblk": 3}, "data": b"x" * 16},
+        use_bin_type=True,
+    )
+    store.put("p1", paged)
+    store.put("d1", b"monolithic-segment-bytes")
+    st = store.stats()
+    assert st["segments"] == 2
+    assert st["bytes"] == len(paged) + len(b"monolithic-segment-bytes")
+    assert st["paged_segments"] == 1
+    assert st["blocks_held"] == 3
+    store.discard("p1")
+    st = store.stats()
+    assert st["paged_segments"] == 0 and st["blocks_held"] == 0

@@ -772,3 +772,32 @@ class TestDraftKillFleet:
         runner2._draining = True
         runner2._stopped = True
         rth2.join(timeout=10)
+
+
+class TestDraftKvStats:
+    def test_kv_stats_track_streams_in_fleet_convention(self):
+        """ISSUE 19: the draft worker reports its (dense) stream cache
+        in the same ``kv_occupancy`` convention the paged target uses,
+        so the gateway's memory roll-up covers the draft pool too."""
+        cfg, params, dcfg, draft = _models()
+        w = DraftWorker(draft, dcfg, max_len=32, draft_k=2,
+                        max_streams=4)
+        empty = w.kv_stats()
+        assert empty == {"kv_occupancy": 0.0, "kv_tokens_held": 0,
+                         "kv_token_capacity": 4 * 32, "streams": 0}
+        p = [int(t) for t in _prompts()[0]]
+        w.propose([{"rid": "a", "ctx": [], "open": p}], 2)
+        st = w.kv_stats()
+        assert st["streams"] == 1
+        # Committed tokens only: proposals count when the next
+        # round's ctx acks them, so the open round holds the prompt.
+        assert st["kv_tokens_held"] == len(p)
+        assert st["kv_occupancy"] == pytest.approx(
+            st["kv_tokens_held"] / st["kv_token_capacity"], abs=1e-4
+        )
+        # LRU eviction returns the held tokens to the pool.
+        for i in range(4):
+            w.propose([{"rid": f"b{i}", "ctx": [], "open": p}], 2)
+        st = w.kv_stats()
+        assert st["streams"] == 4
+        assert "a" not in w._streams
