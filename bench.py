@@ -5945,6 +5945,7 @@ def sim_bench_main(argv: list) -> int:
 
     from dlrover_tpu.sim import (
         FleetStormSim,
+        OfflineTierSim,
         StormSpec,
         TraceConfig,
         run_cell_rows,
@@ -5966,6 +5967,12 @@ def sim_bench_main(argv: list) -> int:
         "tolerance_global": 0.05,
         "tolerance_cell": 0.15,
         "fed_every": 10,
+        #: Offline-tier chunk submissions per step, in units of the
+        #: fleet's block count: deep enough that the tier's sizing is
+        #: SUPPLY-bound all day (a drained batch queue would shrink
+        #: the lendable pool below the baseline's and turn an idle
+        #: queue into an online regression).
+        "offline_submit_factor": 3.0,
     }
     out_path = None
     smoke = False
@@ -6145,6 +6152,39 @@ def sim_bench_main(argv: list) -> int:
     )
     result["storm"]["wall_s"] = walls
 
+    # -- the offline tier over the same storm trace (ISSUE 20) --------------
+    # Baseline (trough chips idle) vs the preemptible tier (trough
+    # chips run batch chunks), identical online plant: the acceptance
+    # row for priority classes at 10k-node scale.
+    result["offline_tier"] = {}
+    off_rows = {}
+    off_walls = {}
+    for mode in ("baseline", "offline"):
+        t0 = time.perf_counter()
+        off_rows[mode] = OfflineTierSim(
+            trace_cfg, mode=mode,
+            submit_factor=float(opts["offline_submit_factor"]),
+        ).run()
+        off_walls[mode] = round(time.perf_counter() - t0, 1)
+        result["offline_tier"][mode] = off_rows[mode]
+        result["offline_tier"][mode]["wall_s"] = off_walls[mode]
+        flush()
+        print(f"sim offline [{mode}]: wall {off_walls[mode]}s "
+              f"slo_goodput {off_rows[mode]['slo_goodput']} "
+              f"utilization {off_rows[mode]['utilization']}",
+              file=sys.stderr)
+    t0 = time.perf_counter()
+    off_rerun = OfflineTierSim(
+        trace_cfg, mode="offline",
+        submit_factor=float(opts["offline_submit_factor"]),
+    ).run()
+    off_walls["offline_rerun"] = round(time.perf_counter() - t0, 1)
+    result["offline_tier"]["double_run_identical"] = (
+        off_rerun["event_log_sha256"]
+        == off_rows["offline"]["event_log_sha256"]
+    )
+    result["offline_tier"]["wall_s"] = off_walls
+
     g, s = storm_rows["global"], storm_rows["static"]
     result["verdicts"] = {
         "fidelity_global_ok": bool(result["fidelity_global"]["ok"]),
@@ -6158,6 +6198,25 @@ def sim_bench_main(argv: list) -> int:
         "spill_exercised": g["spilled"] > 0,
         "day_under_60s_wall": max(walls.values()) < 60.0,
     }
+    ob, oo = off_rows["baseline"], off_rows["offline"]
+    result["verdicts"].update({
+        # The offline-tier laws (ISSUE 20): batch work soaks the
+        # trough and a blackout evacuates the tier completely, with
+        # ZERO online SLO regression (the only coupling — the
+        # arbiter's cooldown exemption — can only help online).
+        "offline_no_slo_regression":
+            oo["slo_goodput"] >= ob["slo_goodput"],
+        "offline_trough_soaked": oo["chunks_done_trough"] > 0,
+        "offline_utilization_up":
+            oo["utilization"] > ob["utilization"],
+        "offline_blackout_evacuated": bool(oo["evacuations_ok"]),
+        "offline_chunks_conserved":
+            bool(oo["chunk_conservation_ok"]),
+        "offline_reclaim_le_one_round":
+            oo["max_reclaim_rounds"] <= 1,
+        "offline_double_run_identical":
+            bool(result["offline_tier"]["double_run_identical"]),
+    })
     if not smoke:
         # Full-run-only verdicts: the smoke window is too short for a
         # federation move cycle, and its offered load is tiny.
@@ -6180,6 +6239,425 @@ def sim_bench_main(argv: list) -> int:
     return 0 if result["complete"] else 1
 
 
+class _ArithDecodeServer:
+    """The ``DecodeServer`` incremental surface with the arithmetic
+    token law (token *i* of prompt *p* is ``(sum(p) + i) % 97``) — the
+    same fake the offline unit tests drive, so the bench's replay row
+    can verify every journaled token EXACTLY instead of trusting
+    counters."""
+
+    def __init__(self, slots: int = 4):
+        import collections
+
+        self.slots = slots
+        self._pending = collections.deque()
+        self._active = {}
+
+    def submit(self, rid, prompt, mnt, prefix_len=0, prefix_fp=""):
+        self._pending.append((rid, [int(t) for t in prompt], int(mnt)))
+
+    def abort(self, rid):
+        for i, item in enumerate(self._pending):
+            if item[0] == rid:
+                del self._pending[i]
+                return True
+        return self._active.pop(rid, None) is not None
+
+    def serve_incremental(self, tick=None, on_finish=None,
+                          on_token=None, idle_wait=0.0005):
+        while True:
+            keep = tick() is not False if tick else True
+            while self._pending and len(self._active) < self.slots:
+                rid, p, mnt = self._pending.popleft()
+                self._active[rid] = (p, [], mnt)
+            if not self._active:
+                if not self._pending and (tick is None or not keep):
+                    break
+                continue
+            for rid in list(self._active):
+                p, out, mnt = self._active[rid]
+                t = (sum(p) + len(out)) % 97
+                out.append(t)
+                if on_token:
+                    on_token(rid, t)
+                if len(out) >= mnt:
+                    del self._active[rid]
+                    if on_finish:
+                        on_finish(rid, list(p) + out)
+
+
+def _offline_worker_cmd(argv: list) -> int:
+    """Hidden helper behind ``--offline_worker`` (argv: ``queue_path
+    worker_id``): ONE offline replay worker in its OWN process, so the
+    ``serving.replica_kill`` chaos crash (``os._exit(78)``, armed via
+    the ``DLROVER_TPU_FAULTS`` env) is a true process death and the
+    relaunched worker's journal replay is what the bench measures."""
+    from dlrover_tpu.offline import OfflineRunner, OfflineWorkQueue
+
+    queue = OfflineWorkQueue(argv[0])
+    row = OfflineRunner(_ArithDecodeServer(), queue, argv[1]).run()
+    queue.close()
+    print("WORKER_ROW " + json.dumps(row))
+    return 0
+
+
+def offline_bench_main(argv: list) -> int:
+    """Offline-tier bench (ISSUE 20 acceptance artifact), three rows:
+
+    **Tier** — :class:`OfflineTierSim` baseline (trough chips idle)
+    vs offline (trough chips run batch chunks) over an identical
+    diurnal storm trace: online SLO goodput must stay within
+    ``goodput_noise`` of the baseline while offline throughput rides
+    the trough and fleet utilization strictly rises.
+
+    **Replay** — a REAL journaled queue + chunk runner; worker 1 is
+    killed by ``serving.replica_kill`` chaos (``os._exit(78)`` mid
+    chunk, a true process death), worker 2 relaunches over the same
+    journal; every chunk must complete EXACTLY once and every token
+    must match the arithmetic law.
+
+    **Reclaim** — the loopback fleet plant: a real
+    :class:`ChipBorrowArbiter` (lender = ``OfflineRole`` over a live
+    runner mid-chunk, ``offline.chunk_kill`` chaos armed) reclaims
+    the chip; the measured latency must be <= ONE decode round, with
+    the wall-clock microseconds reported beside it.
+
+    Flags: ``--out=PATH`` (default OFFLINE_BENCH_CPU.json)
+    ``--smoke`` (scaled trace + replay, sub-5s; the tier-1 schema
+    gate) plus ``--key=val`` for any opt below."""
+    import logging
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+
+    from dlrover_tpu import chaos
+    from dlrover_tpu.fleet.policy import (
+        BORROWED,
+        LENDING,
+        BorrowPolicy,
+        ChipBorrowArbiter,
+    )
+    from dlrover_tpu.fleet.role import RoleAdapter, RoleSpec, RoleStatus
+    from dlrover_tpu.fleet.roles import OfflineRole
+    from dlrover_tpu.offline import (
+        OfflinePolicy,
+        OfflineRunner,
+        OfflineWorkQueue,
+    )
+    from dlrover_tpu.sim import OfflineTierSim, StormSpec, TraceConfig
+
+    logging.getLogger("dlrover_tpu").setLevel(logging.WARNING)
+    t_start = time.perf_counter()
+    opts = {
+        "seed": 0,
+        #: Two-sided tolerance on the baseline-vs-offline online SLO
+        #: goodput delta ("unchanged within noise").
+        "goodput_noise": 0.02,
+        #: See sim_bench_main: keep the tier supply-bound all day.
+        "submit_factor": 3.0,
+        "reclaim_trials": 3,
+        "replay_jobs": 3,
+        "replay_prompts": 16,
+        "replay_chunk": 4,
+        "replay_mnt": 8,
+        #: Runner tick at which chaos kills worker 1 (~3 chunks in).
+        "replay_kill_step": 30,
+    }
+    out_path = None
+    smoke = False
+    for a in argv:
+        if a == "--smoke":
+            smoke = True
+        elif a.startswith("--out="):
+            out_path = a.split("=", 1)[1]
+        elif "=" in a and a.startswith("--"):
+            k, v = a[2:].split("=", 1)
+            if k in opts:
+                opts[k] = type(opts[k])(v)
+    here = os.path.dirname(os.path.abspath(__file__))
+    if out_path is None:
+        out_path = os.path.join(here, "OFFLINE_BENCH_CPU.json")
+    if smoke:
+        opts.update(replay_jobs=1, replay_prompts=8, replay_chunk=2,
+                    replay_mnt=6, replay_kill_step=6,
+                    reclaim_trials=1)
+
+    result = {
+        "bench": "offline",
+        "smoke": smoke,
+        "opts": dict(opts),
+        "tier": {},
+        "replay": {},
+        "reclaim": {},
+        "note": (
+            "Priority classes (ISSUE 20).  Tier: OfflineTierSim "
+            "baseline (trough chips idle) vs offline (the "
+            "preemptible tier soaks them) over an identical diurnal "
+            "storm trace — real OfflinePolicy + ChipBorrowArbiter "
+            "decisions, integer plant, double-run byte-identical.  "
+            "Replay: a real journaled OfflineWorkQueue + "
+            "OfflineRunner; worker 1 dies by serving.replica_kill "
+            "chaos (os._exit(78) mid-chunk), worker 2 replays the "
+            "journal; every chunk exactly-once, every token checked "
+            "against the arithmetic law.  Reclaim: a real arbiter "
+            "with OfflineRole as lender preempts a live runner "
+            "mid-chunk (offline.chunk_kill armed); decode rounds "
+            "from reclaim request to chip grant must be <= 1."
+        ),
+    }
+
+    def flush():
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+        os.replace(tmp, out_path)
+
+    # -- tier: baseline vs offline over the storm ---------------------------
+    if smoke:
+        trace_cfg = TraceConfig(
+            seed=int(opts["seed"]), n_cells=4, nodes=400,
+            duration_s=600.0, step_s=30.0, base_rps=120.0,
+            diurnal_amp=0.4, diurnal_period_s=600.0, zipf_a=0.6,
+            storms=(
+                StormSpec(kind="blackout", at_s=120.0,
+                          duration_s=180.0, cells=(0, 1)),
+            ),
+        )
+    else:
+        trace_cfg = TraceConfig(
+            seed=int(opts["seed"]), n_cells=8, nodes=2000,
+            duration_s=7200.0, step_s=30.0, base_rps=300.0,
+            diurnal_amp=0.6, diurnal_period_s=7200.0, zipf_a=0.6,
+            storms=(
+                StormSpec(kind="blackout", at_s=1800.0,
+                          duration_s=600.0, cells=(0, 1)),
+                StormSpec(kind="churn", at_s=5400.0,
+                          duration_s=600.0, cells=(2, 3),
+                          severity=0.3),
+            ),
+        )
+    tier_rows = {}
+    for mode in ("baseline", "offline"):
+        t0 = time.perf_counter()
+        tier_rows[mode] = OfflineTierSim(
+            trace_cfg, mode=mode,
+            submit_factor=float(opts["submit_factor"]),
+        ).run()
+        tier_rows[mode]["wall_s"] = round(time.perf_counter() - t0, 2)
+        result["tier"][mode] = tier_rows[mode]
+        flush()
+    rerun = OfflineTierSim(
+        trace_cfg, mode="offline",
+        submit_factor=float(opts["submit_factor"]),
+    ).run()
+    base, off = tier_rows["baseline"], tier_rows["offline"]
+    result["tier"]["double_run_identical"] = (
+        rerun["event_log_sha256"] == off["event_log_sha256"])
+    result["tier"]["goodput_delta"] = round(
+        off["slo_goodput"] - base["slo_goodput"], 4)
+    result["tier"]["utilization_gain"] = round(
+        off["utilization"] - base["utilization"], 4)
+    flush()
+
+    # -- replay: a chaos-killed worker loses zero work ----------------------
+    tmpdir = tempfile.mkdtemp(prefix="offline_bench_")
+    qpath = os.path.join(tmpdir, "queue.jsonl")
+    chunk_sz = int(opts["replay_chunk"])
+    mnt = int(opts["replay_mnt"])
+    jobs = {}
+    queue = OfflineWorkQueue(qpath, chunk_size=chunk_sz)
+    total_chunks = 0
+    for j in range(int(opts["replay_jobs"])):
+        prompts = [
+            [(j * 31 + i * 7 + k) % 97 for k in range(4)]
+            for i in range(int(opts["replay_prompts"]))
+        ]
+        jobs[f"batch-{j}"] = prompts
+        total_chunks += queue.submit(f"batch-{j}", prompts, mnt)
+    queue.close()
+
+    def run_worker(wid, fault):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        if fault:
+            env[chaos.ENV_VAR] = fault
+        else:
+            env.pop(chaos.ENV_VAR, None)
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--offline_worker", qpath, wid],
+            capture_output=True, text=True, timeout=120, cwd=here,
+            env=env,
+        )
+        row = None
+        for ln in (proc.stdout or "").splitlines():
+            if ln.startswith("WORKER_ROW "):
+                row = json.loads(ln[len("WORKER_ROW "):])
+        return proc.returncode, row, round(
+            time.perf_counter() - t0, 2)
+
+    kill = (f"serving.replica_kill:step={int(opts['replay_kill_step'])}"
+            f",seed={int(opts['seed'])}")
+    rc1, row1, wall1 = run_worker("ow-victim", kill)
+    rc2, row2, wall2 = run_worker("ow-survivor", None)
+
+    verify = OfflineWorkQueue(qpath)
+    final_stats = verify.stats()
+    tokens_exact = True
+    for job_id, prompts in sorted(jobs.items()):
+        n_chunks = -(-len(prompts) // chunk_sz)
+        for idx in range(n_chunks):
+            got = verify.result(f"{job_id}/{idx}")
+            if got is None:
+                tokens_exact = False
+                continue
+            lo = idx * chunk_sz
+            for i, p in enumerate(prompts[lo:lo + chunk_sz]):
+                want = list(p) + [(sum(p) + t) % 97 for t in range(mnt)]
+                if got.get(f"{job_id}/{idx}#{i}") != want:
+                    tokens_exact = False
+    verify.close()
+    result["replay"] = {
+        "chunks_total": total_chunks,
+        "fault": kill,
+        "victim_exit": rc1,
+        "victim_row": row1,
+        "victim_wall_s": wall1,
+        "survivor_exit": rc2,
+        "survivor_row": row2,
+        "survivor_wall_s": wall2,
+        "final_stats": final_stats,
+        "tokens_exact": tokens_exact,
+    }
+    flush()
+
+    # -- reclaim: measured latency under chaos ------------------------------
+    class _OnlineStub(RoleAdapter):
+        def __init__(self):
+            super().__init__(RoleSpec(name="online", desired=2,
+                                      min_count=1, max_count=8))
+            self.count = 2
+
+        def observe(self):
+            return RoleStatus(
+                members=tuple(f"on{i}" for i in range(self.count)))
+
+        def spawn(self, n):
+            self.count += n
+            return n
+
+    trials = []
+    for t_i in range(int(opts["reclaim_trials"])):
+        q2 = OfflineWorkQueue(
+            os.path.join(tmpdir, f"reclaim{t_i}.jsonl"), chunk_size=2)
+        q2.submit("hold", [[1, 2], [3]], 10 ** 6)  # never finishes
+        runner = OfflineRunner(_ArithDecodeServer(), q2, f"ow{t_i}",
+                               stop_when_drained=False)
+        workers = {runner.worker_id: runner}
+        role = OfflineRole(
+            RoleSpec(name="offline", desired=1, min_count=0,
+                     max_count=4),
+            workers_fn=lambda w=workers: w,
+            spawn_fn=lambda n: n,
+            queue=q2, policy=OfflinePolicy(),
+        )
+        online = _OnlineStub()
+        arb = ChipBorrowArbiter(
+            lender=role, borrower=online,
+            policy=BorrowPolicy(queue_high_per_member=8.0,
+                                spike_patience=1, max_borrow=1),
+            signal_fn=lambda c=online: {"queue_depth": 1000,
+                                        "members_alive": c.count},
+        )
+        chaos.configure(
+            f"offline.chunk_kill:p=1,times=1,"
+            f"seed={int(opts['seed']) + t_i}")
+        th = threading.Thread(target=runner.run)
+        th.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while not runner.busy and time.monotonic() < deadline:
+                time.sleep(0.0005)
+            t0 = time.perf_counter()
+            arb.step()  # spike -> begin_drain -> request_reclaim
+            th.join(timeout=10.0)
+            wall_us = (time.perf_counter() - t0) * 1e6
+            passes = 0
+            while arb.phase == LENDING and passes < 100:
+                passes += 1
+                arb.step()
+            trials.append({
+                "trial": t_i,
+                "phase_after": arb.phase,
+                "decode_rounds": runner.reclaim_rounds,
+                "arbiter_passes": passes,
+                "chunk_kills": runner.chunk_kills,
+                "requeued_backlog": q2.backlog(),
+                "reclaim_wall_us": round(wall_us, 1),
+            })
+        finally:
+            chaos.reset()
+            runner.request_reclaim()
+            th.join(timeout=5.0)
+            q2.close()
+    result["reclaim"] = {
+        "trials": trials,
+        "max_decode_rounds": max(
+            (t["decode_rounds"] or 0) for t in trials),
+        "max_arbiter_passes": max(
+            t["arbiter_passes"] for t in trials),
+    }
+    shutil.rmtree(tmpdir, ignore_errors=True)
+
+    result["verdicts"] = {
+        "slo_goodput_within_noise":
+            abs(off["slo_goodput"] - base["slo_goodput"])
+            <= float(opts["goodput_noise"]),
+        "offline_throughput_through_trough":
+            off["chunks_done_trough"] > 0,
+        "utilization_strictly_higher":
+            off["utilization"] > base["utilization"],
+        "chunks_conserved": bool(off["chunk_conservation_ok"]),
+        "blackout_evacuation_total": bool(off["evacuations_ok"]),
+        "no_overcommit": off["overcommit_steps"] == 0,
+        "sim_reclaims_exercised": off["reclaims"] > 0,
+        "sim_reclaim_le_one_round": off["max_reclaim_rounds"] <= 1,
+        "tier_double_run_identical":
+            bool(result["tier"]["double_run_identical"]),
+        "replay_victim_died_by_chaos": rc1 == 78,
+        "replay_survivor_clean_exit": rc2 == 0,
+        "replay_survivor_did_work": bool(
+            row2 and row2["chunks_done"] > 0),
+        "replay_exactly_once": (
+            final_stats["done"] == total_chunks
+            and final_stats["pending"] == 0
+            and final_stats["leased"] == 0
+            and tokens_exact),
+        "reclaim_le_one_decode_round": all(
+            t["decode_rounds"] is not None
+            and t["decode_rounds"] <= 1
+            and t["phase_after"] == BORROWED
+            for t in trials),
+    }
+    result["complete"] = all(result["verdicts"].values())
+    result["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+    flush()
+    print(json.dumps({
+        "metric": "offline_tier_fleet_utilization",
+        "value": off["utilization"],
+        "unit": "mean_chip_utilization_frac_diurnal_storm",
+        "vs_baseline": base["utilization"],
+        "speedup": round(
+            off["utilization"] / max(base["utilization"], 1e-9), 2),
+        "backend": "cpu",
+        "artifact": out_path,
+    }))
+    return 0 if result["complete"] else 1
+
+
 #: Subcommand table: every bench registers here (satellite of ISSUE 5 —
 #: the tail-of-file if-chain made each new bench a copy-paste edit).
 SUBCOMMANDS = {
@@ -6195,6 +6673,8 @@ SUBCOMMANDS = {
     "--cell_bench": cell_bench_main,
     "--global_bench": global_bench_main,
     "--sim_bench": sim_bench_main,
+    "--offline_bench": offline_bench_main,
+    "--offline_worker": _offline_worker_cmd,
 }
 
 

@@ -143,11 +143,25 @@ def place_roles(
     - capacity is respected: a cell never receives more TPU-role
       members than it has remaining capacity; what cannot be placed is
       returned under the pseudo-cell ``"!unplaced"`` so callers alarm
-      instead of silently under-provisioning."""
+      instead of silently under-provisioning;
+    - honest economics (ISSUE 20c): a cell may carry a
+      ``"speed_weight"`` (its hardware generation's per-chip decode
+      weight, ``scheduler.platform.chip_speed_weight``).  Spread roles
+      visit faster cells FIRST (same round-robin, weighted order) and
+      pack roles rank cells by weighted capacity ``cap * weight`` —
+      64 v6e chips outrank 100 v4 chips.  Cells that state no weight
+      weigh 1.0, which reproduces the unweighted plan exactly."""
     pinned = pinned or {}
     cids = sorted(cells)
     cap = {
         cid: max(0, int(cells[cid].get("capacity", 0))) for cid in cids
+    }
+    spd = {
+        cid: (
+            float(cells[cid].get("speed_weight", 1.0))
+            if float(cells[cid].get("speed_weight", 1.0)) > 0 else 1.0
+        )
+        for cid in cids
     }
     out: Dict[str, Dict[str, int]] = {}
 
@@ -178,20 +192,27 @@ def place_roles(
 
     tpu_cells = [cid for cid in cids if cap[cid] > 0 or
                  int(cells[cid].get("capacity", 0)) > 0]
-    # Spread roles: round-robin over TPU cells with headroom.
+    # Spread roles: round-robin over TPU cells with headroom, fastest
+    # generation first (weight desc, id asc — at uniform weights this
+    # IS the old sorted-cid order).
+    spread_order = sorted(tpu_cells, key=lambda c: (-spd[c], c))
     for role in SPREAD_ROLES:
         want = remaining(role)
         i = 0
-        while want > 0 and any(cap[c] > 0 for c in tpu_cells):
-            cid = tpu_cells[i % len(tpu_cells)]
+        while want > 0 and any(cap[c] > 0 for c in spread_order):
+            cid = spread_order[i % len(spread_order)]
             i += 1
             if cap[cid] > 0:
                 want -= take(role, cid, 1, charge=True)
-    # Pack roles: fill the largest remaining-capacity cell first
-    # (capacity desc, id asc for determinism).
+    # Pack roles: fill the largest WEIGHTED remaining capacity first
+    # (cap * speed_weight desc, id asc for determinism) — collectives
+    # get the most throughput per cell boundary crossed, not the most
+    # chips.
     for role in PACK_ROLES:
         want = remaining(role)
-        for cid in sorted(tpu_cells, key=lambda c: (-cap[c], c)):
+        for cid in sorted(
+            tpu_cells, key=lambda c: (-cap[c] * spd[c], c)
+        ):
             if want <= 0:
                 break
             want -= take(role, cid, want, charge=True)

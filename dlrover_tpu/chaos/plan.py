@@ -199,6 +199,17 @@ SITES: Dict[str, dict] = {
                "the arena scavenge must repair it — conservation law "
                "`free + used == pool` holds after any run",
     },
+    # Offline-tier site (ISSUE 20): kill one offline worker's CHUNK
+    # machinery at the chunk loop's admission point — partial decode
+    # output evaporates, the chunk requeues, and the journaled work
+    # queue's dedupe makes the replay exactly-once (`method=<worker>`
+    # scopes the victim; whole-worker death reuses replica_kill).
+    "offline.chunk_kill": {
+        "kind": "flag", "times": 1,
+        "doc": "offline worker dies mid-chunk (`method=<worker_id>`): "
+               "partials discarded, chunk requeued intact; the "
+               "journal-before-ack queue replays it exactly-once",
+    },
     # Gateway-tier site (ISSUE 9): hard-kill one gateway of a sharded
     # tier mid-stream.
     "serving.gateway_kill": {

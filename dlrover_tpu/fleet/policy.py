@@ -209,7 +209,8 @@ class ChipBorrowArbiter:
                         self.borrower.name,
                     )
                     self.lender.reclaim_one()
-                    self._cooldown = self.policy.cooldown_passes
+                    if not getattr(self.lender, "preemptible", False):
+                        self._cooldown = self.policy.cooldown_passes
                     self._move(IDLE, "borrower grow refused; reclaimed")
                     return self.phase
                 self.borrowed += 1
@@ -228,7 +229,13 @@ class ChipBorrowArbiter:
             if not self.borrower.drain_pending():
                 self.lender.reclaim_one()
                 self.borrowed -= 1
-                self._cooldown = self.policy.cooldown_passes
+                # Cooldown exists to damp loan CHURN — a chip bouncing
+                # between two SLO roles.  Reclaiming from a PREEMPTIBLE
+                # lender (the offline tier) is not churn: taking back a
+                # free chip must never make an online role wait out a
+                # cooldown to evict batch work (ISSUE 20 small fix).
+                if not getattr(self.lender, "preemptible", False):
+                    self._cooldown = self.policy.cooldown_passes
                 self._move(IDLE, "borrower drain complete; reclaimed")
         return self.phase
 

@@ -97,6 +97,13 @@ class RoleAdapter:
     policies drive; the defaults ride the same drain path so a borrow
     can never bypass a role's drain protocol."""
 
+    #: Priority class (ISSUE 20).  ``True`` marks a NON-SLO role whose
+    #: capacity is virtual: it bids zero for chips, drains within one
+    #: decode round when reclaimed, and taking chips BACK from it costs
+    #: the borrow arbiter no cooldown (evicting batch work is not loan
+    #: churn).  SLO-bearing roles stay ``False``.
+    preemptible = False
+
     def __init__(self, spec: RoleSpec):
         self.spec = spec
         self._mu = threading.Lock()

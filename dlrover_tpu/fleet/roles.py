@@ -24,6 +24,10 @@ the fleet layer unchanged.
 - :class:`EmbeddingRole` — the host-side embedding-store group; resize
   rebalances shards via the embedding router's consistent hashing, so
   drain is the count drop itself (watched to completion).
+- :class:`OfflineRole` — the preemptible offline tier (ISSUE 20): the
+  first NON-SLO family, virtual capacity (zero borrow bid), drain =
+  the runner's instant-reclaim contract (one decode round, preempt
+  youngest, chunk requeued).
 """
 
 from __future__ import annotations
@@ -642,3 +646,136 @@ class EmbeddingRole(RoleAdapter):
             return True
         self._drain_target = None
         return False
+
+
+class OfflineRole(RoleAdapter):
+    """The preemptible offline tier as a fleet role (ISSUE 20).
+
+    The sixth family and the first NON-SLO one.  Its capacity is
+    *virtual*: ``observe`` always reports ``queue_depth: 0`` (the real
+    backlog rides a separate ``offline_backlog`` signal the borrow
+    arbiter never reads), so an arbiter with this role as the borrower
+    can never spike a loan on batch pressure — every chip it holds was
+    idle by construction.  ``preemptible = True`` is what exempts
+    reclaims FROM this role from the arbiter's cooldown.
+
+    ``workers_fn()`` returns the live worker handles in SPAWN ORDER
+    (worker_id -> handle with the :class:`OfflineRunner` surface:
+    ``running``, ``busy``, ``request_reclaim()``); ``spawn_fn(n)``
+    launches ``n`` more workers.  The drain protocol IS the runner's
+    instant-reclaim contract: ``begin_drain`` preempts the YOUNGEST
+    worker (least sunk chunk cost, mirroring the paged arena's
+    admission law) via ``request_reclaim()``, and the drain is
+    complete when that worker's loop has exited — at most one decode
+    round later, the hard bound the tier-1 loopback test clocks."""
+
+    preemptible = True
+
+    def __init__(
+        self,
+        spec: RoleSpec,
+        workers_fn: Callable[[], Dict[str, Any]],
+        spawn_fn: Callable[[int], int],
+        queue=None,
+        policy=None,
+        idle_chips_fn: Optional[Callable[[], int]] = None,
+        speed_weight: float = 1.0,
+    ):
+        super().__init__(spec)
+        self._workers_fn = workers_fn
+        self._spawn_fn = spawn_fn
+        self._queue = queue
+        self._policy = policy
+        self._idle_chips_fn = idle_chips_fn
+        self.speed_weight = float(speed_weight)
+        self._drain_wid: Optional[str] = None
+
+    def observe(self) -> RoleStatus:
+        workers = self._workers_fn()
+        members = tuple(
+            wid for wid, w in workers.items()
+            if getattr(w, "running", True)
+        )
+        backlog = self._queue.backlog() if self._queue is not None else 0
+        busy = sum(
+            1 for wid in members if getattr(workers[wid], "busy", False)
+        )
+        return RoleStatus(
+            members=members,
+            draining=(
+                (self._drain_wid,)
+                if self._drain_wid is not None
+                and self._drain_wid in members else ()
+            ),
+            signals={
+                # Zero bid, ALWAYS: batch backlog is not pressure and
+                # must never pull a chip from an SLO-bearing role.
+                "queue_depth": (
+                    self._policy.borrow_bid()
+                    if self._policy is not None else 0
+                ),
+                "offline_backlog": backlog,
+                "busy_workers": busy,
+            },
+        )
+
+    def spawn(self, n: int) -> int:
+        try:
+            return int(self._spawn_fn(n))
+        except Exception:
+            logger.exception(
+                "fleet[%s]: offline worker spawn failed", self.name
+            )
+            return 0
+
+    def begin_drain(self) -> Optional[str]:
+        if self._drain_wid is not None:
+            return None
+        workers = self._workers_fn()
+        running = [
+            wid for wid, w in workers.items()
+            if getattr(w, "running", True)
+        ]
+        if not running:
+            return None
+        # Preempt-youngest: the newest worker holds the chunk with the
+        # least sunk decode cost (its abandoned chunk requeues intact).
+        wid = running[-1]
+        workers[wid].request_reclaim()
+        self._drain_wid = wid
+        return wid
+
+    def drain_pending(self) -> bool:
+        if self._drain_wid is None:
+            return False
+        workers = self._workers_fn()
+        w = workers.get(self._drain_wid)
+        if w is not None and getattr(w, "running", False):
+            return True
+        self._drain_wid = None
+        return False
+
+    def pump_drain(self) -> None:
+        self.drain_pending()
+
+    def can_lend(self) -> bool:
+        """ALWAYS willing while anything runs: a preemptible role has
+        no floor worth defending against an SLO-bearing claimant."""
+        return self.drain_pending() is False and bool(
+            self.observe().members
+        )
+
+    def policy_target(self, status: RoleStatus) -> Optional[int]:
+        if self._policy is None or self._idle_chips_fn is None:
+            return None
+        # Idle supply EXCLUDES chips this role already holds: the
+        # target is sized against what the online roles left over.
+        idle = int(self._idle_chips_fn())
+        return self._policy.target_workers(
+            idle_chips=idle + len(status.members),
+            backlog_chunks=int(
+                status.signals.get("offline_backlog", 0)
+            ),
+            online_pressure=self._drain_wid is not None,
+            speed_weight=self.speed_weight,
+        )

@@ -455,11 +455,38 @@ def _serving_family(
     return ServingFleetAutoScaler(job_args, job_manager, serving_gateway)
 
 
+def _offline_family(
+    job_args, job_manager, speed_monitor, *,
+    resource_optimizer=None, serving_gateway=None, reshard_manager=None,
+) -> JobAutoScaler:
+    """The preemptible offline tier (ISSUE 20) has NO scaler of its
+    own by design: its capacity is virtual — sized by the fleet
+    reconciler's :class:`~dlrover_tpu.fleet.roles.OfflineRole` (zero
+    borrow bid, instant reclaim) against whatever the SLO roles left
+    idle, never by a per-job autoscale loop that could fight a
+    reclaim.  A job submitted under the ``offline`` strategy therefore
+    gets the plain speed-based scaler for its own pods and a loud
+    pointer at the fleet wiring that actually governs it."""
+    logger.error(
+        "offline-strategy job: per-job autoscale is intentionally "
+        "inert for the preemptible tier — size it through the fleet "
+        "reconciler (fleet.roles.OfflineRole + offline.OfflinePolicy); "
+        "falling back to the speed-based training scaler for pod "
+        "supervision only"
+    )
+    return _training_family(
+        job_args, job_manager, speed_monitor,
+        resource_optimizer=resource_optimizer,
+        reshard_manager=reshard_manager,
+    )
+
+
 from dlrover_tpu.fleet import registry as _fleet_registry  # noqa: E402
 
 _fleet_registry.register_role_family("allreduce", _training_family)
 _fleet_registry.register_role_family("embedding", _embedding_family)
 _fleet_registry.register_role_family("serving", _serving_family)
+_fleet_registry.register_role_family("offline", _offline_family)
 
 
 def new_job_auto_scaler(

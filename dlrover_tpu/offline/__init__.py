@@ -1,0 +1,40 @@
+"""The offline tier (ROADMAP item 8): a preemptible priority class.
+
+The sixth role family and the first NON-SLO workload class: batch
+inference (eval sweeps, synthetic-data generation, embedding backfill)
+that soaks whatever chips the online roles are not using and vanishes
+— drain-first, bounded by ONE decode round — the instant an
+SLO-bearing role wants them back.  VirtualFlow (2009.09523) argues the
+workload's view of resources should be decoupled from the hardware;
+this package is that decoupling as a *priority class*: the offline
+tier's capacity is VIRTUAL (it bids zero in the borrow arbiter, owns
+nothing, and is charged for nothing), so every chip it holds is by
+construction a chip nobody with an SLO wanted.
+
+Three pieces:
+
+- :class:`~dlrover_tpu.offline.queue.OfflineWorkQueue` — the work
+  plane: a journaled (fsync'd JSONL, the PR-5 ``CompletionJournal``
+  idiom) queue of batch jobs split into bounded *chunks*, req-id-keyed
+  dedupe, so a preempted or chaos-killed worker replays exactly-once
+  with zero lost work.
+- :class:`~dlrover_tpu.offline.runner.OfflineRunner` — rides the
+  existing ``DecodeServer`` incremental surface to execute chunks on
+  otherwise-idle replicas; honours the instant-reclaim contract at its
+  tick (the decode loop's admission point).
+- :class:`~dlrover_tpu.offline.policy.OfflinePolicy` — the pure
+  virtual-capacity policy (graftcheck DET701–705): target worker count
+  from idle weighted chips and backlog, zero borrow bid, evacuate on
+  online pressure.
+"""
+
+from dlrover_tpu.offline.policy import OfflinePolicy
+from dlrover_tpu.offline.queue import OfflineChunk, OfflineWorkQueue
+from dlrover_tpu.offline.runner import OfflineRunner
+
+__all__ = [
+    "OfflineChunk",
+    "OfflinePolicy",
+    "OfflineRunner",
+    "OfflineWorkQueue",
+]

@@ -1,0 +1,85 @@
+"""The virtual-capacity policy: how many chips batch work may soak.
+
+A PURE decision object (graftcheck DET701–705, registered in
+``tools/graftcheck/policy_registry.py``): every answer is a function
+of the arguments — no ambient clock, randomness, threads, or I/O —
+so the wind tunnel (``sim/offline.py``) drives the production object
+over a 10k-node day and the double-run law holds byte-for-byte.
+
+The priority-class contract, in arithmetic:
+
+- **zero bid** (:meth:`OfflinePolicy.borrow_bid`): the offline tier
+  never registers demand with the borrow arbiter, no matter how deep
+  its backlog — its capacity is *virtual*, carved only from chips no
+  SLO-bearing role wanted this round;
+- **soak** (:meth:`OfflinePolicy.target_workers`): the worker target
+  is the min of idle chips (past an operator reserve), the backlog,
+  and the cap — sized in *weighted* chips when the fleet mixes
+  hardware generations (ISSUE 20c: a v6e chip soaks more work than a
+  v4 chip, and the policy must not pretend otherwise);
+- **evacuate** (:meth:`OfflinePolicy.target_workers` with
+  ``online_pressure=True``, and :meth:`evacuate`): any online
+  pressure — a reclaim in flight, a blackout freeze, a queue spike —
+  zeroes the target immediately.  The drain bound itself (one decode
+  round) is the runner's contract; the policy's job is never to be
+  the reason a chip was held.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class OfflinePolicy:
+    """Pure sizing policy for the preemptible offline worker pool."""
+
+    #: Hard cap on offline workers (0 = uncapped beyond idle supply).
+    max_workers: int = 64
+    #: Chips one offline worker occupies (TPU slices are the grain).
+    chips_per_worker: int = 1
+    #: Idle chips NEVER soaked — operator headroom so an online spike
+    #: can grow without even the one-round offline drain in its path.
+    reserve_chips: int = 0
+    #: Chunks of backlog one worker is worth spawning for: with a
+    #: backlog below ``workers * chunks_per_worker`` the pool shrinks
+    #: toward the tail of the queue instead of idling chips.
+    chunks_per_worker: int = 1
+
+    def borrow_bid(self) -> int:
+        """The offline tier's demand as seen by the chip-borrow
+        arbiter: ALWAYS zero.  Virtual capacity never bids — a backlog
+        of batch work is not pressure, and must never pull a chip from
+        an SLO-bearing role."""
+        return 0
+
+    def target_workers(self, idle_chips: int, backlog_chunks: int,
+                       online_pressure: bool = False,
+                       speed_weight: float = 1.0) -> int:
+        """Worker target for one pass.
+
+        ``idle_chips`` is the cell's unclaimed chip count AFTER every
+        online role took what it wanted; ``backlog_chunks`` the work
+        queue's pending depth; ``speed_weight`` the pool's mean
+        per-chip speed weight (faster chips drain more backlog, so
+        fewer workers cover the same queue).  ``online_pressure``
+        True means an SLO-bearing role wants chips (reclaim in
+        flight, blackout freeze, queue spike): the answer is 0,
+        unconditionally."""
+        if online_pressure:
+            return 0
+        idle = max(0, int(idle_chips) - max(0, int(self.reserve_chips)))
+        supply = idle // max(1, int(self.chips_per_worker))
+        weight = speed_weight if speed_weight > 0 else 1.0
+        per_worker = max(1.0, self.chunks_per_worker * weight)
+        demand = -(-int(backlog_chunks) // int(per_worker))  # ceil div
+        target = min(supply, demand)
+        if self.max_workers > 0:
+            target = min(target, int(self.max_workers))
+        return max(0, target)
+
+    def evacuate(self, current_workers: int) -> int:
+        """Workers to preempt NOW (all of them) when the cell must be
+        vacated — a blackout, a whole-cell reclaim.  Split out so call
+        sites read as policy, not arithmetic."""
+        return max(0, int(current_workers))

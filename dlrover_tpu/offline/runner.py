@@ -1,0 +1,214 @@
+"""The offline worker loop: chunks through a decode server, reclaim in one round.
+
+``OfflineRunner`` is the batch-tier sibling of the serving
+``ReplicaRunner``: it drives the SAME ``DecodeServer.serve_incremental``
+surface (tick = the decode loop's admission point), but feeds it from
+the journaled :class:`~dlrover_tpu.offline.queue.OfflineWorkQueue`
+instead of gateway grants.  One chunk is in flight at a time — the
+chunk IS the preemption grain.
+
+The instant-reclaim contract lives here: :meth:`request_reclaim` (the
+fleet's ``OfflineRole.begin_drain`` calls it) is honoured at the very
+next tick — every in-flight request is aborted (the paged arena frees
+its blocks at that same admission point), the active chunk is requeued
+intact, and the loop drains.  The hard bound — at most ONE decode
+round between the request and the chip being free — is what the tier-1
+loopback test and the bench's reclaim-latency row assert.
+
+Chaos sites wired at the admission point, mirroring the replica
+runner:
+
+- ``offline.chunk_kill`` (flag): THIS worker dies mid-chunk, scoped to
+  the chunk machinery — partial results are discarded, the chunk
+  requeued; the journal's dedupe makes the replay exactly-once.
+- ``serving.replica_kill`` (crash): the whole worker process dies
+  (``os._exit(78)``), exactly as a serving replica would — the
+  journal-before-ack ordering is what the relaunched worker's replay
+  leans on.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu import chaos
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.obs import journal
+from dlrover_tpu.offline.queue import OfflineChunk, OfflineWorkQueue
+
+
+class OfflineRunner:
+    """One offline worker: leases chunks, decodes them, commits results.
+
+    ``server`` is anything with the ``DecodeServer`` incremental
+    surface (``submit`` / ``abort`` / ``serve_incremental``);
+    ``queue`` the shared :class:`OfflineWorkQueue`.  ``round_floor_s``
+    models the device-bound round time on CPU benches (same knob as
+    the replica runner)."""
+
+    def __init__(
+        self,
+        server,
+        queue: OfflineWorkQueue,
+        worker_id: str,
+        max_chunks: int = 0,          # 0 = run until drained/stopped
+        stop_when_drained: bool = True,
+        round_floor_s: float = 0.0,
+        clock=time.monotonic,
+    ):
+        self.server = server
+        self.queue = queue
+        self.worker_id = worker_id
+        self.max_chunks = int(max_chunks)
+        self.stop_when_drained = stop_when_drained
+        self.round_floor_s = round_floor_s
+        self._clock = clock
+        self._chunk: Optional[OfflineChunk] = None
+        self._results: Dict[str, List[int]] = {}
+        self._reclaim_requested = False
+        self._request_tick: Optional[int] = None
+        self._ticks = 0
+        self.running = False
+        self.chunks_done = 0
+        self.chunk_kills = 0
+        self.tokens_out = 0
+        #: Decode rounds between request_reclaim() and the loop
+        #: draining — the instant-reclaim bound (must be <= 1).
+        self.reclaim_rounds: Optional[int] = None
+
+    # -- the instant-reclaim contract ---------------------------------------
+
+    @property
+    def busy(self) -> bool:
+        return self._chunk is not None
+
+    def request_reclaim(self) -> None:
+        """An SLO-bearing role wants this chip.  Thread-safe flag; the
+        next tick aborts in-flight work, requeues the chunk, and
+        drains the loop — at most one decode round away."""
+        if not self._reclaim_requested:
+            self._reclaim_requested = True
+            self._request_tick = self._ticks
+
+    # -- chunk bookkeeping ---------------------------------------------------
+
+    def _abandon_chunk(self) -> None:
+        """Discard the active chunk's in-flight work and requeue it
+        intact: aborts free paged-KV blocks at this same admission
+        point, partial tokens are dropped (exactly-once is owed to the
+        JOURNALED results, not the partials), and the journal's dedupe
+        absorbs any completion that raced ahead."""
+        chunk, self._chunk = self._chunk, None
+        self._results = {}
+        if chunk is None:
+            return
+        for rid in chunk.request_ids:
+            try:
+                self.server.abort(rid)
+            except Exception:  # noqa: BLE001 - a dead rid is already free
+                logger.debug(
+                    "offline[%s]: abort of %s failed (already gone)",
+                    self.worker_id, rid, exc_info=True,
+                )
+        self.queue.requeue(chunk.chunk_id)
+
+    def _on_finish(self, rid, tokens) -> None:
+        if self._chunk is None or rid not in self._chunk.request_ids:
+            return  # a stale completion from an abandoned chunk
+        self._results[rid] = [int(t) for t in tokens]
+
+    def _commit_if_complete(self) -> None:
+        chunk = self._chunk
+        if chunk is None or len(self._results) < len(chunk.prompts):
+            return
+        # Journal-before-ack: complete() fsyncs the results record
+        # before we account the chunk done anywhere else.
+        fresh = self.queue.complete(chunk.chunk_id, self._results)
+        if fresh:
+            self.chunks_done += 1
+            self.tokens_out += sum(
+                len(t) for t in self._results.values()
+            )
+        journal("offline.chunk", worker=self.worker_id,
+                chunk=chunk.chunk_id, fresh=fresh,
+                prompts=len(chunk.prompts))
+        self._chunk = None
+        self._results = {}
+
+    def _lease_next(self) -> bool:
+        chunk = self.queue.lease()
+        if chunk is None:
+            return False
+        self._chunk = chunk
+        self._results = {}
+        for rid, prompt in zip(chunk.request_ids, chunk.prompts):
+            self.server.submit(rid, list(prompt), chunk.max_new_tokens)
+        return True
+
+    # -- the loop ------------------------------------------------------------
+
+    def _tick(self) -> bool:
+        self._ticks += 1
+        # Whole-worker death, exactly as a serving replica dies: the
+        # relaunched worker's queue replay is what must hold.
+        chaos.inject("serving.replica_kill", replica=self.worker_id,
+                     step=self._ticks)
+        if self._reclaim_requested:
+            # Instant reclaim: abort, requeue, drain — all at THIS
+            # admission point, so the chip frees within one round.
+            self.reclaim_rounds = self._ticks - (
+                self._request_tick
+                if self._request_tick is not None else self._ticks
+            )
+            self._abandon_chunk()
+            return False
+        self._commit_if_complete()
+        if self._chunk is not None and chaos.inject(
+            "offline.chunk_kill", method=self.worker_id,
+            chunk=self._chunk.chunk_id, step=self._ticks,
+        ):
+            # Scoped worker death: this chunk's work evaporates as if
+            # the process died, and the queue replays it exactly-once.
+            self.chunk_kills += 1
+            self._abandon_chunk()
+        if self._chunk is None and not self._lease_next():
+            if self.stop_when_drained:
+                return False
+        if self.max_chunks and self.chunks_done >= self.max_chunks:
+            return False
+        if self.round_floor_s > 0:
+            time.sleep(self.round_floor_s)
+        return True
+
+    def run(self) -> Dict[str, Any]:
+        """Run until the queue drains, ``max_chunks`` is hit, or a
+        reclaim evicts this worker.  Returns the worker's counters."""
+        self.running = True
+        try:
+            self.server.serve_incremental(
+                tick=self._tick, on_finish=self._on_finish,
+            )
+            # The loop may exit with a fully-decoded chunk not yet
+            # committed (drain finished the in-flight work after the
+            # last tick): commit it — unless we were reclaimed, where
+            # the chunk was already requeued and partials dropped.
+            if not self._reclaim_requested:
+                self._commit_if_complete()
+            if self._chunk is not None:
+                self._abandon_chunk()
+        finally:
+            self.running = False
+        logger.info(
+            "offline[%s]: done=%d kills=%d tokens=%d reclaim_rounds=%s",
+            self.worker_id, self.chunks_done, self.chunk_kills,
+            self.tokens_out, self.reclaim_rounds,
+        )
+        return {
+            "worker": self.worker_id,
+            "chunks_done": self.chunks_done,
+            "chunk_kills": self.chunk_kills,
+            "tokens_out": self.tokens_out,
+            "reclaim_rounds": self.reclaim_rounds,
+            "ticks": self._ticks,
+        }

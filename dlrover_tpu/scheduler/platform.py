@@ -278,6 +278,35 @@ def gke_tpu_accelerator(tpu_type: str) -> str:
     )
 
 
+# Per-chip decode speed weights by accelerator generation (ISSUE 20c:
+# honest economics).  Normalized to v4 = 1.0; ratios approximate
+# relative decode tokens/s per chip across generations — coarse on
+# purpose (bidding and packing need the ORDER and rough magnitude, not
+# a benchmark), and operator-overridable at every call site because
+# the real ratio is model- and batch-shape-dependent.
+CHIP_SPEED_WEIGHTS = {
+    "v4": 1.0,
+    "v5e": 0.8,
+    "v5litepod": 0.8,
+    "v5p": 1.9,
+    "v6e": 2.7,
+}
+
+
+def chip_speed_weight(tpu_type: str,
+                      overrides: Optional[Dict[str, float]] = None
+                      ) -> float:
+    """Relative per-chip decode speed for a TPU generation, the weight
+    ``decide_pools`` and ``place_roles`` use so mixed fleets bid and
+    pack by throughput instead of counting chips as equal.  Unknown or
+    empty types weigh 1.0 — a fleet that never states its hardware mix
+    behaves exactly as before the weights existed."""
+    t = (tpu_type or "").lower()
+    if overrides and t in overrides:
+        return float(overrides[t])
+    return float(CHIP_SPEED_WEIGHTS.get(t, 1.0))
+
+
 def validate_gke_tpu_pod(pod, expect_tpu: bool = True,
                          cpu_pools: frozenset = frozenset()) -> None:
     """Schema-validate a pod we are about to submit against the GKE TPU

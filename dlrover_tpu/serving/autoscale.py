@@ -61,6 +61,15 @@ class ScalePolicy:
     #: a pressure the queue-depth signal lags.  0 = signal off
     #: (default: no behavior change for existing fleets).
     mem_high_occupancy: float = 0.0
+    #: Per-chip speed weight (ISSUE 20c: honest economics).  Queue
+    #: pressure is judged per WEIGHTED replica — a pool of v6e chips
+    #: (weight 2.7) drains ~2.7x the queue of the same v4 count, so it
+    #: should not bid for more chips at the same raw depth.  A
+    #: snapshot-level ``speed_weight`` (per-pool hardware mix) wins
+    #: over this policy default.  1.0 = chips count equal (exactly the
+    #: pre-weight behavior).  See ``scheduler.platform.
+    #: chip_speed_weight`` for the generation -> weight map.
+    speed_weight: float = 1.0
 
 
 @dataclasses.dataclass
@@ -77,7 +86,13 @@ def decide(snapshot: Dict[str, Any], policy: ScalePolicy,
     Mutates ``state`` streaks; returns the target (== alive when no
     change is warranted)."""
     alive = max(1, int(snapshot.get("replicas_alive", 1)))
-    queue_per = snapshot.get("queue_depth", 0) / alive
+    # Weighted capacity (ISSUE 20c): queue depth per unit of decode
+    # THROUGHPUT, not per chip — a fast generation absorbs more queue
+    # before it deserves another chip, a slow one less.
+    weight = float(snapshot.get("speed_weight", policy.speed_weight))
+    if weight <= 0:
+        weight = 1.0
+    queue_per = snapshot.get("queue_depth", 0) / (alive * weight)
     # Memory occupancy when reported (ISSUE 19: block-pool utilization
     # under paged KV, identical to the slot fraction otherwise — the
     # two agree in dense mode, so hysteresis sees no step at the flag
@@ -179,6 +194,10 @@ def decide_pools(snapshot: Dict[str, Any],
         if "kv_occupancy" in pool:
             # Memory headroom carry-through (ISSUE 19).
             sub["kv_occupancy"] = pool.get("kv_occupancy", 0.0)
+        if "speed_weight" in pool:
+            # Per-pool hardware mix (ISSUE 20c): a pool's reported
+            # mean chip speed weight re-scales its queue pressure.
+            sub["speed_weight"] = pool.get("speed_weight", 1.0)
         if role in _TTFT_ROLES:
             sub["ttft_p95_ms"] = snapshot.get("ttft_p95_ms", 0.0)
         targets[role] = decide(

@@ -16,6 +16,10 @@ fleets and traces in virtual time.  Three rigs, one law:
   10,000 nodes, 24 cells, a day-long diurnal trace and chaos storms
   (correlated blackouts, gray networks, churn waves) no real bench
   could stage.
+* :class:`~dlrover_tpu.sim.offline.OfflineTierSim` — the priority-
+  class rig (ISSUE 20): the preemptible offline tier soaking the
+  diurnal trough, instant reclaim at the peak, total evacuation
+  under blackout storms — baseline vs offline over the same trace.
 
 The law: same seed + same trace ⇒ byte-identical event log (the
 double-run digest), because the only clock is the injected
@@ -27,6 +31,7 @@ from dlrover_tpu.sim.cellsim import CellPlaneSim, run_cell_rows
 from dlrover_tpu.sim.clock import VirtualClock
 from dlrover_tpu.sim.events import SimScheduler
 from dlrover_tpu.sim.fleet import SimRole
+from dlrover_tpu.sim.offline import OfflineTierSim, PreemptibleSimRole
 from dlrover_tpu.sim.serve import GlobalServeSim, run_global_rows
 from dlrover_tpu.sim.storm import FleetStormSim
 from dlrover_tpu.sim.trace import StormSpec, TraceConfig, TraceGenerator
@@ -35,6 +40,8 @@ __all__ = [
     "CellPlaneSim",
     "FleetStormSim",
     "GlobalServeSim",
+    "OfflineTierSim",
+    "PreemptibleSimRole",
     "SimRole",
     "SimScheduler",
     "StormSpec",

@@ -1,0 +1,499 @@
+"""The offline-tier wind tunnel: priority classes at 10k-node scale.
+
+:class:`OfflineTierSim` drives the REAL priority-class objects — the
+:class:`~dlrover_tpu.offline.policy.OfflinePolicy` sizing decision and
+a per-cell :class:`~dlrover_tpu.fleet.policy.ChipBorrowArbiter` whose
+LENDER is the preemptible tier — over the same diurnal storm trace the
+PR-18 rig replays.  Only the plant is simulated (chunks and requests
+are counts, not objects); the decisions are production code paths.
+
+Two modes make ISSUE 20's argument measurable:
+
+* ``baseline`` — no offline tier.  The online pool runs at its
+  mean-demand size and borrows PEAK capacity from a plain idle-chip
+  pool through the arbiter; trough chips simply idle.
+* ``offline`` — the same online plant, but the idle pool is replaced
+  by the preemptible tier: chips the online roles are not using run
+  batch chunks.  The lender now has ``preemptible = True``, so (a)
+  every reclaim requeues the victim's chunks (exactly-once is the
+  journal's job in production; conservation is the sim's law) and
+  (b) the arbiter charges NO cooldown on reclaims — online re-borrows
+  at the next spike pass instead of waiting one out.
+
+The three verdicts the bench derives from a baseline/offline pair:
+online SLO goodput not regressed (the online plant only ever GAINS
+capacity from the tier's cooldown exemption), fleet utilization
+strictly higher (trough chips now work), and the measured reclaim
+latency — steps the arbiter spends in LENDING before the chip is
+granted to online work — bounded by ONE round.
+
+Everything here is integer arithmetic over the seeded trace: no
+clock, no randomness, no threads, no float in the event log.  Same
+config + seed ⇒ byte-identical event log (sha256-pinned, the
+double-run law).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import zlib
+from typing import Any, Dict, List, Tuple
+
+from dlrover_tpu.fleet.policy import (
+    BorrowPolicy,
+    ChipBorrowArbiter,
+    LENDING,
+)
+from dlrover_tpu.fleet.role import RoleSpec
+from dlrover_tpu.offline.policy import OfflinePolicy
+from dlrover_tpu.scheduler.platform import chip_speed_weight
+
+from .fleet import SimRole
+from .trace import TraceConfig, TraceGenerator
+
+#: Hardware generations cycled over cells (cell i gets GENERATIONS[i %
+#: len]) so every run exercises mixed-fleet speed weights (ISSUE 20c).
+GENERATIONS = ("v4", "v5e", "v5p", "v6e")
+
+
+class PreemptibleSimRole(SimRole):
+    """The offline tier's count-backed role: same SimRole machinery,
+    ``preemptible = True`` — which is the ONLY thing the arbiter's
+    cooldown exemption keys on."""
+
+    preemptible = True
+
+
+class _Cell:
+    """One cell's plant: an online pool, a lender pool (idle chips in
+    ``baseline`` mode, the preemptible tier in ``offline`` mode), an
+    online request backlog, and the offline chunk ledger."""
+
+    def __init__(self, cid: str, blocks: int, block_nodes: int,
+                 online_base: int, offline_mode: bool):
+        self.cid = cid
+        self.blocks = blocks
+        self.online = SimRole(
+            RoleSpec(name=f"{cid}/online", desired=online_base,
+                     min_count=1, max_count=blocks),
+            prefix=f"{cid}/on", block_nodes=block_nodes,
+            drain_passes=1,
+        )
+        lender_cls = PreemptibleSimRole if offline_mode else SimRole
+        self.lender = lender_cls(
+            RoleSpec(name=f"{cid}/offline" if offline_mode
+                     else f"{cid}/idle",
+                     desired=0, min_count=0, max_count=blocks),
+            prefix=f"{cid}/off" if offline_mode else f"{cid}/idle",
+            block_nodes=block_nodes, drain_passes=1,
+        )
+        #: Online FIFO backlog as [enqueue_step, count] buckets.
+        self.backlog: List[List[int]] = []
+        self.dead = False
+        #: Chunks leased and not yet completed (counts, not objects).
+        self.in_flight = 0
+        #: Worker count at lease time — a later drop is a preemption
+        #: and the difference's chunks requeue before completion.
+        self.lease_workers = 0
+        #: Integer tenths of chunk-throughput carry (weight 2.7 = 27).
+        self.rem_tenths = 0
+        #: Steps the arbiter has been in LENDING (reclaim in flight).
+        self.lending_for = 0
+
+    def backlog_n(self) -> int:
+        return sum(n for _, n in self.backlog)
+
+    def enqueue(self, step: int, n: int) -> None:
+        if n <= 0:
+            return
+        if self.backlog and self.backlog[-1][0] == step:
+            self.backlog[-1][1] += n
+        else:
+            self.backlog.append([step, n])
+
+
+class OfflineTierSim:
+    """One mode's day in the offline wind tunnel; ``run()`` returns
+    the result row (see the module doc for the physics)."""
+
+    def __init__(
+        self,
+        trace_cfg: TraceConfig,
+        mode: str = "offline",
+        per_block_rps: float = 6.0,
+        block_nodes: int = 8,
+        slo_steps: int = 2,
+        timeout_steps: int = 10,
+        submit_factor: float = 0.8,
+        reserve_chips: int = 0,
+    ):
+        if mode not in ("baseline", "offline"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.mode = mode
+        self.offline_mode = mode == "offline"
+        self.trace = TraceGenerator(trace_cfg)
+        self.cfg = trace_cfg
+        self.per_block_rps = float(per_block_rps)
+        self.slo_steps = int(slo_steps)
+        self.timeout_steps = int(timeout_steps)
+
+        n = trace_cfg.n_cells
+        base = trace_cfg.nodes // (n * block_nodes)
+        extra = (trace_cfg.nodes - base * n * block_nodes) // block_nodes
+        self.cell_ids = [f"c{i:02d}" for i in range(n)]
+        #: Per-cell speed weight in integer TENTHS (v6e = 27): the one
+        #: representation both throughput laws and the event log share.
+        self.w_tenths: Dict[str, int] = {}
+        self.cells: Dict[str, _Cell] = {}
+        mean_step_load = trace_cfg.base_rps * trace_cfg.step_s
+        for i, cid in enumerate(self.cell_ids):
+            blocks = base + (1 if i < extra else 0)
+            w = chip_speed_weight(GENERATIONS[i % len(GENERATIONS)])
+            self.w_tenths[cid] = int(round(w * 10))
+            # Online base size: the cell's MEAN demand in weighted
+            # blocks — peaks are the arbiter's job, troughs the
+            # tier's.  Same formula in both modes (trace-pure).
+            cap_per_block = (self.per_block_rps * trace_cfg.step_s
+                             * self.w_tenths[cid]) / 10.0
+            want = int(mean_step_load * self.trace.share(i)
+                       / max(1.0, cap_per_block)) + 1
+            online_base = max(1, min(blocks - 1, want))
+            self.cells[cid] = _Cell(
+                cid, blocks, block_nodes, online_base,
+                self.offline_mode,
+            )
+        self.total_blocks = sum(c.blocks for c in self.cells.values())
+        self.node_count = self.total_blocks * block_nodes
+
+        # The real policy objects under test.
+        self.policy = OfflinePolicy(
+            max_workers=0, chips_per_worker=1,
+            reserve_chips=int(reserve_chips), chunks_per_worker=1,
+        )
+        self.arbiters: Dict[str, ChipBorrowArbiter] = {}
+        for cid in self.cell_ids:
+            cell = self.cells[cid]
+            self.arbiters[cid] = ChipBorrowArbiter(
+                lender=cell.lender,
+                borrower=cell.online,
+                policy=BorrowPolicy(
+                    queue_high_per_member=30.0, spike_patience=2,
+                    queue_low_per_member=2.0, decay_patience=6,
+                    max_borrow=cell.blocks, cooldown_passes=4,
+                ),
+                signal_fn=(lambda c=cell: {
+                    "queue_depth": c.backlog_n(),
+                    "members_alive": c.online.count,
+                }),
+                scope=cid,
+                hold_fn=(lambda c=cell: c.dead),
+            )
+
+        #: Chunks submitted to the (global) offline queue per step.
+        self.submit_per_step = (
+            int(self.total_blocks * float(submit_factor))
+            if self.offline_mode else 0
+        )
+        self.chunk_backlog = 0
+
+        # Fleet counters.
+        self.offered = 0
+        self.served = 0
+        self.served_in_slo = 0
+        self.timeout = 0
+        self.blackout_lost = 0
+        self.chunks_submitted = 0
+        self.chunks_done = 0
+        self.chunks_done_trough = 0
+        self.chunk_requeues = 0
+        self.reclaims = 0
+        self.max_reclaim_rounds = 0
+        self.evacuations_ok = True
+        self.overcommit_steps = 0
+        self.util_milli_sum = 0
+        self._digest = hashlib.sha256()
+        self._log_lines = 0
+
+    # -- plant helpers ------------------------------------------------------
+
+    def _capacity(self, cell: _Cell) -> int:
+        """Requests one step of the cell's online pool absorbs
+        (weighted: a v6e block drains 2.7x a v4 block)."""
+        if cell.dead:
+            return 0
+        return int(cell.online.count * self.per_block_rps
+                   * self.cfg.step_s * self.w_tenths[cell.cid]) // 10
+
+    def _serve(self, step: int, cell: _Cell) -> Tuple[int, int, int]:
+        timed_out = 0
+        keep: List[List[int]] = []
+        for enq, n in cell.backlog:
+            if step - enq > self.timeout_steps:
+                timed_out += n
+            else:
+                keep.append([enq, n])
+        cell.backlog = keep
+        cap = self._capacity(cell)
+        served = in_slo = 0
+        while cap > 0 and cell.backlog:
+            enq, n = cell.backlog[0]
+            take = min(n, cap)
+            served += take
+            if step - enq <= self.slo_steps:
+                in_slo += take
+            cap -= take
+            if take == n:
+                cell.backlog.pop(0)
+            else:
+                cell.backlog[0][1] = n - take
+        return served, in_slo, timed_out
+
+    def _requeue(self, cell: _Cell, n: int) -> None:
+        n = min(max(0, n), cell.in_flight)
+        if n <= 0:
+            return
+        cell.in_flight -= n
+        self.chunk_backlog += n
+        self.chunk_requeues += n
+
+    def _offline_chunks(self, step: int, cell: _Cell,
+                        trough: bool) -> int:
+        """One cell's chunk cycle: requeue preempted leases, complete
+        the survivors, lease against this step's worker throughput.
+        Returns chunks completed."""
+        workers = cell.lender.count
+        # Preemption since lease time (arbiter lend, policy shrink,
+        # churn): each departed worker's chunk requeues BEFORE any
+        # completion is counted — zero lost work, possibly re-done.
+        if workers < cell.lease_workers:
+            self._requeue(cell, cell.lease_workers - workers)
+        done = cell.in_flight
+        cell.in_flight = 0
+        self.chunks_done += done
+        if trough:
+            self.chunks_done_trough += done
+        # Lease: weighted worker-steps of throughput, integer tenths.
+        cell.rem_tenths += workers * self.w_tenths[cell.cid]
+        cap = cell.rem_tenths // 10
+        cell.rem_tenths -= cap * 10
+        take = min(self.chunk_backlog, cap)
+        self.chunk_backlog -= take
+        cell.in_flight = take
+        cell.lease_workers = workers
+        return done
+
+    # -- one step ------------------------------------------------------------
+
+    def _step(self, step: int) -> Dict[str, Any]:
+        t = step * self.cfg.step_s
+        dead_idx = self.trace.dead_cells(t)
+        dead_now = {self.cell_ids[i] for i in dead_idx}
+        stranded = 0
+        for cid in self.cell_ids:
+            cell = self.cells[cid]
+            if cid in dead_now and not cell.dead:
+                cell.dead = True
+                stranded += cell.backlog_n()
+                cell.backlog = []
+                # Blackout evacuation: every in-flight chunk requeues,
+                # every offline worker is gone (the cell answers
+                # nothing); the journal makes the replay exactly-once
+                # in production — conservation is the law here.
+                self._requeue(cell, cell.in_flight)
+                cell.lender.fail(cell.lender.count)
+                cell.lease_workers = 0
+            elif cid not in dead_now and cell.dead:
+                cell.dead = False
+        self.blackout_lost += stranded
+
+        # Background churn hits the online pool (supervision respawns
+        # under the relaunch budget, exactly as the storm rig models).
+        churned = 0
+        for i, cid in enumerate(self.cell_ids):
+            cell = self.cells[cid]
+            if cell.dead:
+                continue
+            leaves = self.trace.churn_leaves(step, i)
+            if leaves:
+                churned += cell.online.fail(leaves)
+
+        # Arrivals (dead cells' arrivals are lost: this rig is the
+        # PRIORITY plane; re-homing is the PR-17 global rig's story).
+        arr = self.trace.arrivals(step)
+        offered = sum(arr)
+        self.offered += offered
+        lost = 0
+        for i, cid in enumerate(self.cell_ids):
+            cell = self.cells[cid]
+            if cell.dead:
+                lost += arr[i]
+            else:
+                cell.enqueue(step, arr[i])
+        self.blackout_lost += lost
+
+        # Offline submissions ride the global queue.
+        if self.offline_mode:
+            self.chunk_backlog += self.submit_per_step
+            self.chunks_submitted += self.submit_per_step
+
+        # Serve one step of online capacity everywhere.
+        served = in_slo = timed_out = 0
+        for cid in self.cell_ids:
+            cell = self.cells[cid]
+            if cell.dead:
+                continue
+            s, g, to = self._serve(step, cell)
+            served += s
+            in_slo += g
+            timed_out += to
+        self.served += served
+        self.served_in_slo += in_slo
+        self.timeout += timed_out
+
+        # The control plane: the REAL arbiter decides peak borrows and
+        # trough hand-backs; reconcile pumps drains and supervision.
+        for cid in self.cell_ids:
+            cell = self.cells[cid]
+            if cell.dead:
+                continue
+            arb = self.arbiters[cid]
+            arb.step()
+            if arb.phase == LENDING:
+                cell.lending_for += 1
+                self.max_reclaim_rounds = max(
+                    self.max_reclaim_rounds, cell.lending_for)
+            else:
+                if cell.lending_for > 0:
+                    self.reclaims += 1
+                cell.lending_for = 0
+            cell.online.reconcile()
+            cell.lender.reconcile()
+
+        # The tier's own sizing: the REAL OfflinePolicy over idle
+        # chips and backlog (baseline mode sizes the plain idle pool
+        # with the same arithmetic so both modes' arbiters have chips
+        # to lend at the peak).
+        rate = self.trace.rate_at(t)
+        trough = rate < self.cfg.base_rps
+        done_step = 0
+        for cid in self.cell_ids:
+            cell = self.cells[cid]
+            if cell.dead:
+                continue
+            if not cell.lender.drain_pending():
+                idle = cell.blocks - cell.online.count \
+                    - cell.lender.count
+                # Baseline's idle pool is sized by the same policy
+                # under a synthetic always-deep backlog: both modes'
+                # arbiters see the same lendable supply at the peak.
+                backlog = self.chunk_backlog if self.offline_mode \
+                    else cell.blocks * 10
+                target = self.policy.target_workers(
+                    idle_chips=idle + cell.lender.count,
+                    backlog_chunks=backlog,
+                    online_pressure=(
+                        self.arbiters[cid].phase == LENDING),
+                    speed_weight=self.w_tenths[cid] / 10.0,
+                )
+                target = min(target, cell.lender.count + max(0, idle))
+                delta = target - cell.lender.count
+                if delta > 0:
+                    cell.lender.spec.desired = target
+                    cell.lender.spawn(delta)
+                elif delta < 0:
+                    cell.lender.spec.desired = target
+                    cell.lender.fail(-delta)
+            # Hard law: priority classes never overcommit a cell.
+            over = (cell.online.count + cell.lender.count
+                    - cell.blocks)
+            if over > 0:
+                self.overcommit_steps += 1
+                cell.lender.spec.desired = max(
+                    0, cell.lender.spec.desired - over)
+                cell.lender.fail(over)
+            if self.offline_mode:
+                done_step += self._offline_chunks(step, cell, trough)
+        for cell in self.cells.values():
+            # The blackout law: a dead cell holds NO chunk and no
+            # offline worker — evacuation is total, every step.
+            if cell.dead and (cell.in_flight or cell.lender.count):
+                self.evacuations_ok = False
+
+        online_n = sum(c.online.count for c in self.cells.values()
+                       if not c.dead)
+        offline_n = sum(c.lender.count for c in self.cells.values()
+                        if not c.dead) if self.offline_mode else 0
+        self.util_milli_sum += (
+            (online_n + offline_n) * 1000 // max(1, self.total_blocks)
+        )
+
+        backlogs = tuple(self.cells[c].backlog_n()
+                         for c in self.cell_ids)
+        line = {
+            "t": step,
+            "off": offered,
+            "sv": served,
+            "slo": in_slo,
+            "to": timed_out,
+            "lost": lost,
+            "str": stranded,
+            "ch": churned,
+            "dead": list(dead_idx),
+            "on": online_n,
+            "ofw": offline_n,
+            "bor": sum(a.borrowed for a in self.arbiters.values()),
+            "cb": self.chunk_backlog,
+            "cif": sum(c.in_flight for c in self.cells.values()),
+            "cd": done_step,
+            "rq": self.chunk_requeues,
+            "bl": sum(backlogs),
+            "bh": zlib.crc32(repr(backlogs).encode()),
+        }
+        self._digest.update(
+            (json.dumps(line, sort_keys=True) + "\n").encode()
+        )
+        self._log_lines += 1
+        return line
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self) -> Dict[str, Any]:
+        for step in range(self.cfg.n_steps):
+            self._step(step)
+        in_flight = sum(c.in_flight for c in self.cells.values())
+        chunk_accounted = (self.chunks_done + self.chunk_backlog
+                           + in_flight)
+        return {
+            "mode": self.mode,
+            "trace": self.trace.describe(),
+            "nodes": self.node_count,
+            "blocks": self.total_blocks,
+            "steps": self.cfg.n_steps,
+            "offered": self.offered,
+            "served": self.served,
+            "served_in_slo": self.served_in_slo,
+            "slo_goodput": round(
+                self.served_in_slo / max(1, self.offered), 4),
+            "timeout": self.timeout,
+            "blackout_lost": self.blackout_lost,
+            "utilization": round(
+                self.util_milli_sum / max(1, self.cfg.n_steps) / 1000,
+                4),
+            "borrow_events": sum(len(a.events)
+                                 for a in self.arbiters.values()),
+            "reclaims": self.reclaims,
+            "max_reclaim_rounds": self.max_reclaim_rounds,
+            "chunks_submitted": self.chunks_submitted,
+            "chunks_done": self.chunks_done,
+            "chunks_done_trough": self.chunks_done_trough,
+            "chunk_requeues": self.chunk_requeues,
+            "chunk_backlog_final": self.chunk_backlog,
+            "chunk_in_flight_final": in_flight,
+            "chunk_conservation_ok": (
+                chunk_accounted == self.chunks_submitted),
+            "evacuations_ok": self.evacuations_ok,
+            "overcommit_steps": self.overcommit_steps,
+            "event_log_lines": self._log_lines,
+            "event_log_sha256": self._digest.hexdigest(),
+        }

@@ -1,0 +1,543 @@
+"""Offline tier tests (ISSUE 20): the preemptible priority class.
+
+Tier-1, all sub-second.  Four surfaces:
+
+- the journaled :class:`OfflineWorkQueue` (submit dedupe, chunking,
+  torn-tail reopen, exactly-once completion, preempt-youngest);
+- the :class:`OfflineRunner` chunk loop over the fake decode server's
+  incremental surface, including the ``offline.chunk_kill`` chaos
+  site's exactly-once replay;
+- the instant-reclaim bound: the loopback fleet unit where the REAL
+  :class:`ChipBorrowArbiter` reclaims mid-chunk and the assertion is
+  on decode ROUNDS elapsed (<= 1) before the chip is granted online;
+  plus the arbiter's cooldown exemption for preemptible lenders;
+- the speed-weight economics: ``chip_speed_weight``, the weighted
+  ``decide``/``decide_pools`` queue pressure, and weighted
+  ``place_roles`` ordering (with the weight-1.0 backward-compat law).
+"""
+
+import collections
+import os
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.fleet.policy import (
+    BorrowPolicy,
+    ChipBorrowArbiter,
+    BORROWED,
+    IDLE,
+    LENDING,
+)
+from dlrover_tpu.fleet.role import RoleAdapter, RoleSpec, RoleStatus
+from dlrover_tpu.fleet.roles import OfflineRole
+from dlrover_tpu.offline import (
+    OfflinePolicy,
+    OfflineRunner,
+    OfflineWorkQueue,
+)
+
+pytestmark = pytest.mark.offline
+
+
+class FakeOfflineServer:
+    """The DecodeServer incremental surface with the arithmetic token
+    law (token i of prompt p is ``(sum(p) + i) % 97``) — same fake as
+    the serving runner tests, trimmed to what the offline loop uses."""
+
+    def __init__(self, slots=4):
+        self.slots = slots
+        self._pending = collections.deque()
+        self._active = {}
+
+    def submit(self, rid, prompt, mnt, prefix_len=0, prefix_fp=""):
+        self._pending.append((rid, [int(t) for t in prompt], int(mnt)))
+
+    def cancel(self, rid):
+        for i, item in enumerate(self._pending):
+            if item[0] == rid:
+                del self._pending[i]
+                return True
+        return False
+
+    def abort(self, rid):
+        if self.cancel(rid):
+            return True
+        return self._active.pop(rid, None) is not None
+
+    def serve_incremental(self, tick=None, on_finish=None,
+                          on_token=None, idle_wait=0.0005):
+        results = {}
+        while True:
+            keep = tick() is not False if tick else True
+            while self._pending and len(self._active) < self.slots:
+                rid, p, mnt = self._pending.popleft()
+                self._active[rid] = (p, [], mnt)
+            if not self._active:
+                if not self._pending:
+                    if tick is None or not keep:
+                        break
+                    time.sleep(idle_wait)
+                continue
+            for rid in list(self._active):
+                p, out, mnt = self._active[rid]
+                t = (sum(p) + len(out)) % 97
+                out.append(t)
+                if on_token:
+                    on_token(rid, t)
+                if len(out) >= mnt:
+                    full = list(p) + out
+                    results[rid] = full
+                    del self._active[rid]
+                    if on_finish:
+                        on_finish(rid, full)
+        return results
+
+
+def expected_tokens(prompt, mnt):
+    out = list(prompt)
+    for i in range(mnt):
+        out.append((sum(prompt) + i) % 97)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the work plane
+# ---------------------------------------------------------------------------
+
+
+class TestOfflineWorkQueue:
+    def test_submit_chunks_and_is_idempotent(self, tmp_path):
+        q = OfflineWorkQueue(str(tmp_path / "q.jsonl"), chunk_size=2)
+        n = q.submit("job-a", [[1, 2], [3], [4, 5], [6], [7]], 8)
+        assert n == 3
+        assert q.backlog() == 3
+        # Same id + same prompts: a no-op (req-id-keyed dedupe).
+        assert q.submit("job-a", [[1, 2], [3], [4, 5], [6], [7]], 8) == 3
+        assert q.backlog() == 3
+        # Same id + DIFFERENT prompts: refused loudly.
+        with pytest.raises(ValueError):
+            q.submit("job-a", [[9]], 8)
+        with pytest.raises(ValueError):
+            q.submit("job-b", [], 8)
+
+    def test_complete_is_exactly_once(self, tmp_path):
+        q = OfflineWorkQueue(str(tmp_path / "q.jsonl"), chunk_size=2)
+        q.submit("j", [[1], [2], [3]], 4)
+        c = q.lease()
+        results = {rid: [1, 2, 3] for rid in c.request_ids}
+        assert q.complete(c.chunk_id, results) is True
+        # The replayed completion dedupes: no double count, no write.
+        assert q.complete(c.chunk_id, results) is False
+        assert q.result(c.chunk_id) == {
+            rid: [1, 2, 3] for rid in c.request_ids
+        }
+        with pytest.raises(KeyError):
+            q.complete("nope/0", {})
+        c2 = q.lease()
+        with pytest.raises(ValueError):
+            q.complete(c2.chunk_id, {})  # missing rids
+
+    def test_reopen_replays_jobs_minus_done(self, tmp_path):
+        path = str(tmp_path / "q.jsonl")
+        q = OfflineWorkQueue(path, chunk_size=1)
+        q.submit("j", [[1], [2], [3]], 4)
+        c = q.lease()
+        q.complete(c.chunk_id, {rid: [7] for rid in c.request_ids})
+        q.lease()  # leased-but-never-completed: scratch state
+        q.close()
+        q2 = OfflineWorkQueue(path, chunk_size=1)
+        st = q2.stats()
+        # The done chunk stays done; the dangling lease is pending
+        # again — a lease that died with its worker must replay.
+        assert st["done"] == 1
+        assert st["pending"] == 2
+        assert st["leased"] == 0
+        assert q2.result(c.chunk_id) == {
+            rid: [7] for rid in c.request_ids
+        }
+
+    def test_torn_tail_is_truncated_on_reopen(self, tmp_path):
+        path = str(tmp_path / "q.jsonl")
+        q = OfflineWorkQueue(path, chunk_size=1)
+        q.submit("j", [[1], [2]], 4)
+        q.close()
+        with open(path, "a") as f:
+            f.write('{"kind": "chunk", "rid": "j/0", "tok')  # SIGKILL
+        q2 = OfflineWorkQueue(path, chunk_size=1)
+        assert q2.stats()["pending"] == 2
+        # The next append lands on a clean line boundary.
+        c = q2.lease()
+        q2.complete(c.chunk_id, {rid: [5] for rid in c.request_ids})
+        q3 = OfflineWorkQueue(path, chunk_size=1)
+        assert q3.stats()["done"] == 1
+
+    def test_requeue_goes_to_front_preempt_picks_youngest(
+            self, tmp_path):
+        q = OfflineWorkQueue(str(tmp_path / "q.jsonl"), chunk_size=1)
+        q.submit("j", [[1], [2], [3]], 4)
+        a = q.lease()
+        b = q.lease()
+        # preempt-youngest: b is the newest lease, the least sunk cost.
+        assert q.preempt_youngest() == b.chunk_id
+        assert q.lease().chunk_id == b.chunk_id  # requeued to the FRONT
+        assert q.requeue(a.chunk_id) is True
+        assert q.lease().chunk_id == a.chunk_id
+        assert q.requeue("never-leased/0") is False
+
+
+# ---------------------------------------------------------------------------
+# the runner
+# ---------------------------------------------------------------------------
+
+
+class TestOfflineRunner:
+    def test_runs_queue_to_drained_with_correct_tokens(self, tmp_path):
+        q = OfflineWorkQueue(str(tmp_path / "q.jsonl"), chunk_size=2)
+        q.submit("a", [[1, 2], [3], [4]], 5)
+        q.submit("b", [[9]], 3)
+        srv = FakeOfflineServer(slots=4)
+        r = OfflineRunner(srv, q, "ow0")
+        row = r.run()
+        assert q.drained()
+        assert row["chunks_done"] == 3
+        assert q.job_progress("a") == (2, 2)
+        assert q.job_progress("b") == (1, 1)
+        got = q.result("a/0")
+        assert got["a/0#0"] == expected_tokens([1, 2], 5)
+        assert got["a/0#1"] == expected_tokens([3], 5)
+
+    def test_chunk_kill_replays_exactly_once(self, tmp_path):
+        from dlrover_tpu import chaos
+
+        q = OfflineWorkQueue(str(tmp_path / "q.jsonl"), chunk_size=2)
+        q.submit("a", [[1, 2], [3], [4], [5]], 6)
+        chaos.configure("offline.chunk_kill:p=1,times=1,seed=3")
+        try:
+            srv = FakeOfflineServer(slots=4)
+            r = OfflineRunner(srv, q, "ow0")
+            row = r.run()
+        finally:
+            chaos.reset()
+        # The killed chunk replayed: every chunk completed exactly
+        # once, the kill cost a requeue, never a lost or doubled chunk.
+        assert row["chunk_kills"] == 1
+        assert row["chunks_done"] == 2
+        assert q.drained()
+        assert q.stats()["requeues"] == 1
+        assert q.result("a/0")["a/0#0"] == expected_tokens([1, 2], 6)
+        assert q.result("a/1")["a/1#1"] == expected_tokens([5], 6)
+
+    def test_replayed_completion_dedupes_across_workers(self, tmp_path):
+        """A chunk completed by a crashed worker's replay must not
+        double-count when a second worker re-executes it (the journal
+        record, not the partials, owns exactly-once)."""
+        q = OfflineWorkQueue(str(tmp_path / "q.jsonl"), chunk_size=1)
+        q.submit("a", [[1]], 4)
+        c = q.lease()
+        q.complete(c.chunk_id, {
+            rid: expected_tokens([1], 4) for rid in c.request_ids
+        })
+        # Simulate the raced worker: the same chunk leased elsewhere
+        # (pre-crash) finishes late — requeue then re-run.
+        q.submit("a", [[1]], 4)  # idempotent; chunk already done
+        srv = FakeOfflineServer()
+        r = OfflineRunner(srv, q, "ow1")
+        row = r.run()
+        assert row["chunks_done"] == 0  # dedupe hit, not a fresh chunk
+        assert q.drained()
+
+    def test_instant_reclaim_within_one_round(self, tmp_path):
+        """The hard bound: request_reclaim -> the loop drains at the
+        NEXT tick (<= 1 decode round), chunk requeued intact."""
+        q = OfflineWorkQueue(str(tmp_path / "q.jsonl"), chunk_size=2)
+        # Effectively-infinite decode: the reclaim MUST land mid-chunk.
+        q.submit("a", [[1, 2], [3]], 10**6)
+        srv = FakeOfflineServer(slots=4)
+        r = OfflineRunner(srv, q, "ow0", stop_when_drained=False)
+        th = threading.Thread(target=r.run)
+        th.start()
+        deadline = time.monotonic() + 5.0
+        while not r.busy and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert r.busy
+        r.request_reclaim()
+        th.join(timeout=5.0)
+        assert not th.is_alive()
+        assert r.reclaim_rounds is not None
+        assert r.reclaim_rounds <= 1
+        # Zero lost work: the chunk is pending again, nothing done.
+        assert q.backlog() == 1
+        assert q.stats()["leased"] == 0
+        assert r.chunks_done == 0
+
+
+# ---------------------------------------------------------------------------
+# priority classes in the fleet core
+# ---------------------------------------------------------------------------
+
+
+class _StubOnlineRole(RoleAdapter):
+    """A borrower that grows instantly; counts grants."""
+
+    def __init__(self, name="online", max_count=8):
+        super().__init__(RoleSpec(name=name, desired=2, min_count=1,
+                                  max_count=max_count))
+        self.count = 2
+        self.grants = 0
+
+    def observe(self):
+        return RoleStatus(
+            members=tuple(f"on{i}" for i in range(self.count)))
+
+    def spawn(self, n):
+        self.count += n
+        return n
+
+    def grow_one(self):
+        if super().grow_one():
+            self.grants += 1
+            return True
+        return False
+
+    def begin_drain(self):
+        if self.count <= self.spec.min_count:
+            return None
+        self.count -= 1
+        return f"on{self.count}"
+
+
+class _StubLenderRole(RoleAdapter):
+    """A non-preemptible lender with a one-pass drain (cooldown
+    contrast fixture)."""
+
+    def __init__(self):
+        super().__init__(RoleSpec(name="idle", desired=4, min_count=0,
+                                  max_count=8))
+        self.count = 4
+        self._draining = 0
+
+    def observe(self):
+        return RoleStatus(
+            members=tuple(f"i{i}" for i in range(self.count)))
+
+    def spawn(self, n):
+        self.count += n
+        return n
+
+    def begin_drain(self):
+        if self.count <= 0:
+            return None
+        self.count -= 1
+        self._draining = 1
+        return "i"
+
+    def drain_pending(self):
+        return self._draining > 0
+
+    def pump_drain(self):
+        self._draining = max(0, self._draining - 1)
+
+
+class TestOfflineRoleFleet:
+    def _spiky_arbiter(self, lender, borrower):
+        sig = {"queue_depth": 1000, "members_alive": borrower.count}
+        arb = ChipBorrowArbiter(
+            lender=lender, borrower=borrower,
+            policy=BorrowPolicy(
+                queue_high_per_member=8.0, spike_patience=1,
+                queue_low_per_member=1.0, decay_patience=1,
+                max_borrow=4, cooldown_passes=3,
+            ),
+            signal_fn=lambda: dict(sig),
+        )
+        return arb, sig
+
+    def test_arbiter_reclaims_offline_chip_within_one_round(
+            self, tmp_path):
+        """The loopback fleet unit: a REAL arbiter, a REAL OfflineRole
+        over a REAL runner mid-chunk.  The assertion is on rounds
+        elapsed — decode rounds AND arbiter passes — before the chip
+        is granted to online work."""
+        q = OfflineWorkQueue(str(tmp_path / "q.jsonl"), chunk_size=2)
+        q.submit("a", [[1, 2], [3]], 10**6)
+        srv = FakeOfflineServer(slots=4)
+        runner = OfflineRunner(srv, q, "ow0", stop_when_drained=False)
+        workers = {"ow0": runner}
+        role = OfflineRole(
+            RoleSpec(name="offline", desired=1, min_count=0,
+                     max_count=4),
+            workers_fn=lambda: workers,
+            spawn_fn=lambda n: n,
+            queue=q,
+            policy=OfflinePolicy(),
+        )
+        online = _StubOnlineRole()
+        arb, sig = self._spiky_arbiter(role, online)
+        th = threading.Thread(target=runner.run)
+        th.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while not runner.busy and time.monotonic() < deadline:
+                time.sleep(0.001)
+            assert runner.busy
+            assert arb.step() == LENDING  # spike: reclaim requested
+            # The drain must complete within ONE decode round of the
+            # worker loop: wait for the loop to exit, then ONE more
+            # arbiter pass grants the chip.
+            th.join(timeout=5.0)
+            assert not th.is_alive()
+            assert runner.reclaim_rounds is not None
+            assert runner.reclaim_rounds <= 1
+            passes_in_lending = 0
+            while arb.phase == LENDING:
+                passes_in_lending += 1
+                assert passes_in_lending <= 1, (
+                    "arbiter stuck LENDING past the one-round bound")
+                arb.step()
+            assert arb.phase == BORROWED
+            assert online.grants == 1
+            # The preempted chunk survived intact.
+            assert q.backlog() == 1
+        finally:
+            runner.request_reclaim()
+            th.join(timeout=1.0)
+
+    def test_offline_role_bids_zero_whatever_the_backlog(
+            self, tmp_path):
+        q = OfflineWorkQueue(str(tmp_path / "q.jsonl"), chunk_size=1)
+        q.submit("a", [[n] for n in range(50)], 4)
+        role = OfflineRole(
+            RoleSpec(name="offline", desired=0, min_count=0,
+                     max_count=4),
+            workers_fn=dict, spawn_fn=lambda n: n,
+            queue=q, policy=OfflinePolicy(),
+        )
+        st = role.observe()
+        assert st.signals["queue_depth"] == 0
+        assert st.signals["offline_backlog"] == 50
+        assert OfflinePolicy().borrow_bid() == 0
+
+    def test_cooldown_exempt_for_preemptible_lender_only(self):
+        """The ISSUE 20 small fix: a borrow cycle that reclaims FROM
+        the offline tier charges no cooldown — the next spike borrows
+        immediately; the same cycle against an SLO lender still
+        cools down."""
+        for preemptible, expect_relend in ((True, True), (False, False)):
+            lender = _StubLenderRole()
+            lender.preemptible = preemptible
+            online = _StubOnlineRole()
+            arb, sig = self._spiky_arbiter(lender, online)
+            assert arb.step() == LENDING
+            lender.pump_drain()
+            assert arb.step() == BORROWED
+            # Decay: hand the chip back (borrower drains instantly).
+            sig["queue_depth"] = 0
+            assert arb.step() == "reclaiming"
+            assert arb.step() == IDLE  # borrower drain done; reclaimed
+            # Spike again at the very next pass.
+            sig["queue_depth"] = 1000
+            phase = arb.step()
+            if expect_relend:
+                assert phase == LENDING, (
+                    "preemptible reclaim must not impose a cooldown")
+            else:
+                assert phase == IDLE, (
+                    "SLO-lender reclaim must keep its cooldown")
+
+    def test_offline_role_policy_target_soaks_idle(self):
+        role = OfflineRole(
+            RoleSpec(name="offline", desired=0, min_count=0,
+                     max_count=64),
+            workers_fn=dict, spawn_fn=lambda n: n,
+            policy=OfflinePolicy(reserve_chips=2),
+            idle_chips_fn=lambda: 10,
+        )
+        status = role.observe()
+        # 10 idle - 2 reserve = 8 workers, capped by backlog.
+        assert role._policy.target_workers(10, 100) == 8
+        assert role._policy.target_workers(10, 3) == 3
+        assert role._policy.target_workers(10, 100,
+                                           online_pressure=True) == 0
+        # Faster chips need fewer workers for the same backlog.
+        assert role._policy.target_workers(10, 8, speed_weight=2.0) == 4
+        assert role.policy_target(status) == 0  # empty queue: nothing
+
+
+# ---------------------------------------------------------------------------
+# honest economics: speed weights
+# ---------------------------------------------------------------------------
+
+
+class TestSpeedWeights:
+    def test_chip_speed_weight_map_and_overrides(self):
+        from dlrover_tpu.scheduler.platform import chip_speed_weight
+
+        assert chip_speed_weight("v4") == 1.0
+        assert chip_speed_weight("v6e") > chip_speed_weight("v5p") > 1.0
+        assert chip_speed_weight("v5e") < 1.0
+        assert chip_speed_weight("") == 1.0
+        assert chip_speed_weight("tpu-v9-future") == 1.0
+        assert chip_speed_weight("v5e", overrides={"v5e": 1.5}) == 1.5
+
+    def test_decide_judges_queue_per_weighted_replica(self):
+        from dlrover_tpu.serving.autoscale import (
+            ScalePolicy,
+            ScaleState,
+            decide,
+        )
+
+        pol = ScalePolicy(queue_high_per_replica=4.0, up_patience=1,
+                          max_replicas=10)
+        # 10 queued over 2 unweighted replicas: pressure (5 > 4).
+        assert decide({"replicas_alive": 2, "queue_depth": 10},
+                      pol, ScaleState()) == 3
+        # The same depth over v6e-weighted replicas: no pressure
+        # (10 / (2 * 2.7) < 4) — fast chips absorb more queue.
+        assert decide({"replicas_alive": 2, "queue_depth": 10,
+                       "speed_weight": 2.7}, pol, ScaleState()) == 2
+        # Weight 1.0 is EXACTLY the old behavior.
+        assert decide({"replicas_alive": 2, "queue_depth": 10,
+                       "speed_weight": 1.0}, pol, ScaleState()) == 3
+
+    def test_decide_pools_carries_pool_speed_weight(self):
+        from dlrover_tpu.serving.autoscale import (
+            ScalePolicy,
+            decide_pools,
+        )
+
+        pol = {"decode": ScalePolicy(queue_high_per_replica=4.0,
+                                     up_patience=1, max_replicas=10)}
+        snap = {"pools": {"decode": {
+            "alive": 2, "queue_depth": 10, "occupancy": 0.9,
+            "speed_weight": 2.7,
+        }}}
+        assert decide_pools(snap, pol, {}) == {"decode": 2}
+        snap["pools"]["decode"].pop("speed_weight")
+        assert decide_pools(snap, pol, {}) == {"decode": 3}
+
+    def test_place_roles_weighted_ordering(self):
+        from dlrover_tpu.cells.federation import place_roles
+
+        cells = {
+            "a": {"capacity": 100},                       # v4
+            "b": {"capacity": 64, "speed_weight": 2.7},   # v6e
+        }
+        out = place_roles(cells, {"serving": 1, "training": 60})
+        # Spread visits the fastest cell first; pack ranks by
+        # weighted capacity (64 * 2.7 > 100 * 1.0).
+        assert out["serving"] == {"b": 1}
+        assert out["training"]["b"] == 60
+
+    def test_place_roles_unweighted_is_byte_compatible(self):
+        from dlrover_tpu.cells.federation import place_roles
+
+        cells_plain = {"a": {"capacity": 6}, "b": {"capacity": 4}}
+        cells_w1 = {
+            "a": {"capacity": 6, "speed_weight": 1.0},
+            "b": {"capacity": 4, "speed_weight": 1.0},
+        }
+        demands = {"serving": 3, "training": 5, "master": 2}
+        assert place_roles(cells_plain, demands) == \
+            place_roles(cells_w1, demands)

@@ -20,6 +20,7 @@ from dlrover_tpu.fleet.role import RoleSpec
 from dlrover_tpu.sim import (
     CellPlaneSim,
     FleetStormSim,
+    OfflineTierSim,
     SimRole,
     SimScheduler,
     StormSpec,
@@ -274,3 +275,39 @@ class TestFleetStormSim:
         assert glob["rehomed"] == static["blackout_lost"]
         assert glob["served"] > static["served"]
         assert glob["storm_lost"] < static["storm_lost"]
+
+
+# ---------------------------------------------------------------------------
+# macro rig: the offline tier (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+class TestOfflineTierSim:
+    def test_double_run_event_log_digest_identical(self):
+        a = OfflineTierSim(_STORM_CFG, mode="offline").run()
+        b = OfflineTierSim(_STORM_CFG, mode="offline").run()
+        assert a["event_log_sha256"] == b["event_log_sha256"]
+        assert a["event_log_lines"] == b["event_log_lines"] > 0
+
+    def test_tier_soaks_trough_without_slo_regression(self):
+        base = OfflineTierSim(_STORM_CFG, mode="baseline").run()
+        off = OfflineTierSim(_STORM_CFG, mode="offline").run()
+        # The priority-class laws, end to end over the storm trace:
+        # batch work soaks the trough, utilization strictly rises,
+        # the online SLO plane never pays for it (the online plant is
+        # trace-pure and identical in both modes), reclaims stay
+        # within the one-round bound, blackout evacuation is total,
+        # and no chunk is ever lost or double-counted.
+        assert off["slo_goodput"] >= base["slo_goodput"]
+        assert off["utilization"] > base["utilization"]
+        assert off["chunks_done"] > 0
+        assert off["chunks_done_trough"] > 0
+        assert off["max_reclaim_rounds"] <= 1
+        assert off["chunk_conservation_ok"] is True
+        assert off["evacuations_ok"] is True
+        assert off["overcommit_steps"] == 0
+        # Request conservation (inequality: the end-of-run online
+        # backlog stays inside the plant and is not exported).
+        for row in (base, off):
+            assert row["served"] + row["timeout"] \
+                + row["blackout_lost"] <= row["offered"]

@@ -125,4 +125,12 @@ REGISTRY: Tuple[PolicyObject, ...] = (
         "function",
         "speculative-k controller (per-step adaptation policy)",
     ),
+    PolicyObject(
+        "dlrover_tpu/offline/policy.py", "OfflinePolicy", "class",
+        "virtual-capacity sizing for the preemptible offline tier",
+    ),
+    PolicyObject(
+        "dlrover_tpu/sim/offline.py", "OfflineTierSim", "class",
+        "the priority-class wind tunnel (baseline vs offline tier)",
+    ),
 )
