@@ -28,6 +28,7 @@ The priority-class contract, in arithmetic:
 from __future__ import annotations
 
 import dataclasses
+import math
 
 
 @dataclasses.dataclass
@@ -71,8 +72,11 @@ class OfflinePolicy:
         idle = max(0, int(idle_chips) - max(0, int(self.reserve_chips)))
         supply = idle // max(1, int(self.chips_per_worker))
         weight = speed_weight if speed_weight > 0 else 1.0
+        # Float ceiling, not integer ceil-div: truncating the weighted
+        # divisor (2.7 -> 2, 1.9 -> 1) overstates worker demand and
+        # erases fractional weights entirely.
         per_worker = max(1.0, self.chunks_per_worker * weight)
-        demand = -(-int(backlog_chunks) // int(per_worker))  # ceil div
+        demand = math.ceil(int(backlog_chunks) / per_worker)
         target = min(supply, demand)
         if self.max_workers > 0:
             target = min(target, int(self.max_workers))

@@ -14,7 +14,14 @@ dedupe, torn-tail truncation on reopen — with two record kinds:
 - ``chunk`` records commit one chunk's RESULTS.  The record is fsync'd
   BEFORE the chunk is acknowledged done (journal-before-ack, the
   replica runner's exactly-once contract), so a worker killed between
-  the append and the ack replays to a dedupe hit, never a re-execute.
+  the append and the ack replays to a dedupe hit, never a re-execute;
+- ``job_done`` records are compaction tombstones: a fully-complete
+  job's ``job`` record and ALL of its ``chunk`` records are retired
+  together, replaced by one tombstone pinning the job's identity and
+  chunk count.  Reopen skips re-indexing tombstoned jobs (nothing
+  goes pending again), a late replayed completion still dedupes, and
+  a retried submit is still a no-op — only the result PAYLOADS age
+  out past the retention cap, never the completion state.
 
 Leases are deliberately NOT journaled: a lease is scratch state (who
 is working on what right now), and any chunk leased but never
@@ -85,6 +92,9 @@ class OfflineWorkQueue:
         self._f = None
         #: job_id -> job record (identity + chunking).
         self._jobs: Dict[str, Dict[str, Any]] = {}
+        #: job_id -> job_done tombstone (identity + chunk count) for
+        #: fully-complete jobs whose records compaction retired.
+        self._done_jobs: Dict[str, Dict[str, Any]] = {}
         #: chunk_id -> done record (results live here; dedupe key).
         self._done: Dict[str, Dict[str, Any]] = {}
         #: Submitted chunk bodies, by id (prompts are re-derivable from
@@ -126,6 +136,8 @@ class OfflineWorkQueue:
                         # graftcheck: disable=CC101 -- caller _load
                         # holds self._mu; the only call site.
                         self._jobs[str(rec["rid"])] = rec
+                    elif rec.get("kind") == "job_done":
+                        self._done_jobs[str(rec["rid"])] = rec
                     elif rec.get("kind") == "chunk":
                         # graftcheck: disable=CC101 -- caller _load
                         # holds self._mu; the only call site.
@@ -134,8 +146,12 @@ class OfflineWorkQueue:
             pass  # no journal yet
         # Rebuild the pending set: every submitted chunk not journaled
         # done is pending again (leases are scratch — a lease that died
-        # with its worker must replay).
+        # with its worker must replay).  Tombstoned jobs are COMPLETE:
+        # re-indexing one would re-lease and re-execute acknowledged
+        # work, the exactly-once violation compaction must not create.
         for job_id in sorted(self._jobs):
+            if job_id in self._done_jobs:
+                continue
             rec = self._jobs[job_id]
             prompts = tuple(
                 tuple(int(t) for t in p) for p in rec["prompts"]
@@ -152,26 +168,43 @@ class OfflineWorkQueue:
         os.fsync(self._f.fileno())
 
     def _maybe_compact(self) -> None:
+        # Caller holds self._mu (the only call site is complete()).
         if len(self._done) < self.max_records + max(
             64, self.max_records // 4
         ):
             return
-        # Drop the oldest completions past the cap — but NEVER a chunk
-        # whose job is still incomplete (its dedupe record is what
-        # keeps a late replay exactly-once); rewrite atomically.
-        removable = [
-            cid for cid in self._done
-            if self.job_progress(cid.rsplit("/", 1)[0])[0]
-            >= self.job_progress(cid.rsplit("/", 1)[0])[1]
-        ]
-        drop = len(self._done) - self.max_records
-        for cid in removable[:drop]:
-            del self._done[cid]
+        # Retire fully-complete jobs WHOLE, oldest job id first: the
+        # job record and all of its done records drop together,
+        # replaced by one job_done tombstone — a reopen must never see
+        # a job record without the done records that prove its chunks
+        # finished (that re-indexes completed work as pending and
+        # re-executes it).  A job with ANY incomplete chunk keeps
+        # everything: its done records are the dedupe that keeps a
+        # late replay exactly-once.  Rewrite atomically.
+        excess = len(self._done) - self.max_records
+        for job_id in sorted(self._jobs):
+            if excess <= 0:
+                break
+            done, total = self._job_progress_under_mu(job_id)
+            if done < total:
+                continue
+            rec = self._jobs.pop(job_id)
+            self._done_jobs[job_id] = {
+                "kind": "job_done", "rid": job_id,
+                "ph": rec["ph"], "n": total,
+            }
+            for idx in range(total):
+                cid = f"{job_id}/{idx}"
+                if self._done.pop(cid, None) is not None:
+                    excess -= 1
+                self._chunks.pop(cid, None)
         if self._f is not None:
             self._f.close()
             self._f = None
         tmp = self.path + ".compact"
         with open(tmp, "w") as f:
+            for rec in self._done_jobs.values():
+                f.write(json.dumps(rec) + "\n")
             for rec in self._jobs.values():
                 f.write(json.dumps(rec) + "\n")
             for rec in self._done.values():
@@ -217,6 +250,16 @@ class OfflineWorkQueue:
             raise ValueError("offline job with no prompts")
         ph = _prompts_hash(canon)
         with self._mu:
+            gone = self._done_jobs.get(job_id)
+            if gone is not None:
+                # The job completed and compaction retired it: a
+                # retried submit is still a no-op, never a re-run.
+                if gone["ph"] != ph:
+                    raise ValueError(
+                        f"offline job id {job_id!r} resubmitted with "
+                        "different prompts"
+                    )
+                return int(gone["n"])
             known = self._jobs.get(job_id)
             if known is not None:
                 if known["ph"] != ph:
@@ -284,7 +327,11 @@ class OfflineWorkQueue:
         ``False`` (and writes nothing) when the chunk is already done:
         the dedupe that makes a replayed chunk exactly-once."""
         with self._mu:
-            if chunk_id in self._done:
+            if (chunk_id in self._done
+                    or chunk_id.rsplit("/", 1)[0] in self._done_jobs):
+                # Already journaled done — or so long done that the
+                # whole job was compacted to a tombstone.  Either way
+                # the replayed completion dedupes, never re-executes.
                 if chunk_id in self._leased:
                     self._leased.remove(chunk_id)
                 return False
@@ -318,16 +365,25 @@ class OfflineWorkQueue:
     # -- views --------------------------------------------------------------
 
     def result(self, chunk_id: str) -> Optional[Dict[str, List[int]]]:
-        rec = self._done.get(chunk_id)
-        if rec is None:
-            return None
-        return {
-            rid: [int(t) for t in toks]
-            for rid, toks in rec["tokens"].items()
-        }
+        """One done chunk's tokens, or ``None``.  Result PAYLOADS are
+        retained up to ``max_records`` completions: once compaction
+        retires a fully-complete job, its chunks stay done (dedupe,
+        progress, resubmit-no-op all hold) but this returns ``None`` —
+        consumers drain results before a job ages past the cap."""
+        with self._mu:
+            rec = self._done.get(chunk_id)
+            if rec is None:
+                return None
+            return {
+                rid: [int(t) for t in toks]
+                for rid, toks in rec["tokens"].items()
+            }
 
-    def job_progress(self, job_id: str) -> Tuple[int, int]:
-        """(chunks done, chunks total) for one job."""
+    def _job_progress_under_mu(self, job_id: str) -> Tuple[int, int]:
+        gone = self._done_jobs.get(job_id)
+        if gone is not None:
+            n = int(gone["n"])
+            return n, n
         total = done = 0
         for cid, chunk in self._chunks.items():
             if chunk.job_id != job_id:
@@ -337,10 +393,16 @@ class OfflineWorkQueue:
                 done += 1
         return done, total
 
+    def job_progress(self, job_id: str) -> Tuple[int, int]:
+        """(chunks done, chunks total) for one job."""
+        with self._mu:
+            return self._job_progress_under_mu(job_id)
+
     def stats(self) -> Dict[str, int]:
         with self._mu:
             return {
                 "jobs": len(self._jobs),
+                "retired_jobs": len(self._done_jobs),
                 "pending": len(self._pending),
                 "leased": len(self._leased),
                 "done": len(self._done),

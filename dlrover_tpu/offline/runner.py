@@ -9,9 +9,10 @@ chunk IS the preemption grain.
 
 The instant-reclaim contract lives here: :meth:`request_reclaim` (the
 fleet's ``OfflineRole.begin_drain`` calls it) is honoured at the very
-next tick — every in-flight request is aborted (the paged arena frees
-its blocks at that same admission point), the active chunk is requeued
-intact, and the loop drains.  The hard bound — at most ONE decode
+next tick — a chunk whose decode already finished is committed (one
+local fsync, not a wasted replay), every still-in-flight request is
+aborted (the paged arena frees its blocks at that same admission
+point), the active chunk is requeued intact, and the loop drains.  The hard bound — at most ONE decode
 round between the request and the chip being free — is what the tier-1
 loopback test and the bench's reclaim-latency row assert.
 
@@ -155,12 +156,16 @@ class OfflineRunner:
         chaos.inject("serving.replica_kill", replica=self.worker_id,
                      step=self._ticks)
         if self._reclaim_requested:
-            # Instant reclaim: abort, requeue, drain — all at THIS
-            # admission point, so the chip frees within one round.
+            # Instant reclaim: commit, abort, requeue, drain — all at
+            # THIS admission point, so the chip frees within one
+            # round.  A chunk whose decode already finished last round
+            # is COMMITTED first (one local fsync, inside the round
+            # bound) rather than discarded and re-decoded elsewhere.
             self.reclaim_rounds = self._ticks - (
                 self._request_tick
                 if self._request_tick is not None else self._ticks
             )
+            self._commit_if_complete()
             self._abandon_chunk()
             return False
         self._commit_if_complete()
